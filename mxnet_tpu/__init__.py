@@ -1,0 +1,21 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+Apache MXNet (incubating), re-designed for JAX/XLA/Pallas/pjit.
+
+Import as ``import mxnet_tpu as mx``: the public surface mirrors the
+reference's python/mxnet package (SURVEY.md §2.3) — mx.nd, mx.sym, mx.gluon,
+mx.autograd, mx.mod, mx.io, mx.metric, mx.optimizer, mx.kv, contexts
+(mx.cpu/mx.gpu/mx.tpu) — while execution is trace-and-compile on XLA:
+the async C++ dependency engine, graph executor and kvstore of the reference
+collapse into jax.jit / pjit / mesh collectives (SURVEY.md §7 table).
+"""
+__version__ = '1.5.0'  # capability parity target: reference v1.5.0-dev
+
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
+    num_gpus, num_tpus, default_device
+from .base import MXNetError
+from . import base
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random

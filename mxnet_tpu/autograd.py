@@ -1,0 +1,316 @@
+"""Imperative autograd: record/pause scopes, backward, grad, custom Function.
+
+Reference parity: python/mxnet/autograd.py (record/pause/train_mode/
+predict_mode :93-181, backward :243, grad :270, Function :365) backed by
+src/imperative/imperative.cc (RecordOp :193, Backward :280).
+
+TPU-native design: instead of building an nnvm graph and re-running it
+through the engine, each recorded op call stores the ``jax.vjp`` pullback of
+its pure function (linearized at record time — the closest analog of the
+reference's saved forward outputs). ``backward()`` walks the tape in reverse
+topological order feeding cotangents through the pullbacks. Hand-written
+_backward_* ops (≈326 in the reference, SURVEY.md Appendix A) do not exist:
+autodiff derives them.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['record', 'pause', 'train_mode', 'predict_mode', 'is_recording',
+           'is_training', 'backward', 'grad', 'Function', 'mark_variables',
+           'set_recording', 'set_training', 'get_symbol']
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, 'recording'):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _state.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode):
+    prev = _st().training
+    _state.training = bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """Scope in which executed ops are recorded for backward()."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded op call (reference analog: an nnvm node stamped by
+    Imperative::RecordOp with AGInfo on outputs)."""
+
+    __slots__ = ('vjp_fn', 'in_entries', 'num_outputs', 'out_shapes',
+                 'out_dtypes', 'seq')
+
+    _counter = [0]
+
+    def __init__(self, vjp_fn, in_entries, num_outputs, out_shapes,
+                 out_dtypes):
+        self.vjp_fn = vjp_fn
+        self.in_entries = in_entries  # list of Entry|None per diff input
+        self.num_outputs = num_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        TapeNode._counter[0] += 1
+        self.seq = TapeNode._counter[0]
+
+
+class Entry:
+    """Reference to the idx-th output of a tape node, or a marked variable."""
+
+    __slots__ = ('node', 'index', 'variable')
+
+    def __init__(self, node=None, index=0, variable=None):
+        self.node = node
+        self.index = index
+        self.variable = variable  # NDArray with attached grad (leaf)
+
+
+def mark_variables(variables, gradients, grad_reqs='write'):
+    """Associate gradient buffers with variables (reference: autograd.py
+    mark_variables → MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradient, req in zip(variables, gradients, grad_reqs):
+        var._grad = gradient if req != 'null' else None
+        var._grad_req = req
+        var._entry = Entry(variable=var)
+
+
+def _collect_graph(head_entries):
+    """DFS to find reachable nodes; return them sorted by creation seq."""
+    nodes = {}
+    stack = [e.node for e in head_entries if e is not None and e.node is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in nodes:
+            continue
+        nodes[id(node)] = node
+        for ent in node.in_entries:
+            if ent is not None and ent.node is not None and id(ent.node) not in nodes:
+                stack.append(ent.node)
+    return sorted(nodes.values(), key=lambda n: n.seq)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables
+    (reference: autograd.py:243 → Imperative::Backward)."""
+    from .ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    head_entries = [getattr(h, '_entry', None) for h in heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    nodes = _collect_graph(head_entries)
+    cotangents = {}  # id(node) -> [cotangent or None per output]
+
+    def _add_ct(entry, ct):
+        if entry is None or ct is None:
+            return
+        if isinstance(ct, jax.Array) and ct.dtype == jax.dtypes.float0:
+            return
+        if entry.variable is not None:
+            var = entry.variable
+            if var._grad is not None:
+                ctc = ct.astype(var._grad.dtype) if ct.dtype != var._grad.dtype else ct
+                if var._grad_req == 'add':
+                    var._grad._data = var._grad._data + ctc
+                else:
+                    # MXNet 'write' semantics within one backward = accumulate
+                    if getattr(var, '_grad_fresh', False):
+                        var._grad._data = var._grad._data + ctc
+                    else:
+                        var._grad._data = ctc
+                        var._grad_fresh = True
+            return
+        if entry.node is not None:
+            lst = cotangents.setdefault(id(entry.node),
+                                        [None] * entry.node.num_outputs)
+            lst[entry.index] = ct if lst[entry.index] is None \
+                else lst[entry.index] + ct
+
+    # seed heads
+    for h, he, hg in zip(heads, head_entries, head_grads):
+        if he is None:
+            continue
+        ct = hg._data if hg is not None else jnp.ones(h.shape, dtype=h.dtype)
+        _add_ct(he, ct)
+
+    # clear the fresh-write flags on variables reachable from the graph
+    for node in nodes:
+        for ent in node.in_entries:
+            if ent is not None and ent.variable is not None:
+                ent.variable._grad_fresh = False
+
+    for node in reversed(nodes):
+        cts = cotangents.get(id(node))
+        if cts is None:
+            continue
+        full = tuple(
+            ct if ct is not None else jnp.zeros(shp, dt)
+            for ct, shp, dt in zip(cts, node.out_shapes, node.out_dtypes))
+        arg = full if node.num_outputs > 1 else full[0]
+        in_cts = node.vjp_fn(arg)
+        for ent, ct in zip(node.in_entries, in_cts):
+            _add_ct(ent, ct)
+        if not retain_graph:
+            node.vjp_fn = None
+            cotangents.pop(id(node), None)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables without touching .grad
+    buffers (reference: autograd.py:270)."""
+    from . import ndarray as nd
+    from .ndarray import NDArray
+    if create_graph:
+        raise NotImplementedError(
+            'create_graph=True (higher-order imperative grad) is not yet '
+            'supported; use the functional API (mxnet_tpu.jax_grad) instead.')
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(v._grad, getattr(v, '_grad_req', 'null'), v._entry)
+             for v in variables]
+    tmp = [nd.zeros(v.shape, dtype=v.dtype) for v in variables]
+    for v, t in zip(variables, tmp):
+        v._grad = t
+        v._grad_req = 'write'
+        if v._entry is None or v._entry.variable is None:
+            v._entry = Entry(variable=v)
+        else:
+            v._entry.variable = v
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 train_mode=train_mode)
+    finally:
+        results = [v._grad for v in variables]
+        for v, (g, req, ent) in zip(variables, saved):
+            v._grad, v._grad_req, v._entry = g, req, ent
+    return results[0] if single else results
+
+
+def get_symbol(x):
+    """Reference parity stub: returns a Symbol describing the recorded
+    history of x (used rarely; here reconstructs via symbol tracer)."""
+    raise NotImplementedError('autograd.get_symbol is not supported; use '
+                              'HybridBlock.export for graph capture.')
+
+
+class Function:
+    """Customized differentiable function (reference: autograd.py:365).
+
+    Subclass and override forward/backward; operates on NDArrays eagerly.
+    """
+
+    class _Registry:
+        pass
+
+    def __init__(self):
+        self._used = False
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, _wrap_outputs
+        if self._used:
+            raise RuntimeError('A Function instance cannot be called twice')
+        self._used = True
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            in_entries = [getattr(i, '_entry', None) for i in inputs]
+            func = self
+
+            def vjp_fn(cts):
+                cts_t = cts if isinstance(cts, tuple) else (cts,)
+                with pause():
+                    grads = func.backward(
+                        *[NDArray(c) for c in cts_t])
+                if not isinstance(grads, (list, tuple)):
+                    grads = [grads]
+                return [g._data if g is not None else None for g in grads]
+
+            node = TapeNode(vjp_fn if not single else
+                            (lambda ct: vjp_fn(ct)),
+                            in_entries, len(outs),
+                            [o.shape for o in outs],
+                            [o.dtype for o in outs])
+            for i, o in enumerate(outs):
+                o._entry = Entry(node=node, index=i)
+        return outs[0] if single else outs

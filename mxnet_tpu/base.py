@@ -1,0 +1,74 @@
+"""Shared base utilities: dtype mapping, error types, registry plumbing.
+
+Reference parity: python/mxnet/base.py (check_call/_init_op_module codegen
+driver) — here there is no C ABI to check; the analogous machinery is the pure
+Python op registry in mxnet_tpu/ops/registry.py, and `_init_op_module` lives
+in ndarray/register.py & symbol/register.py.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ['MXNetError', 'NotImplementedForSymbol', 'string_types',
+           'numeric_types', 'integer_types', 'np_dtype', 'dtype_name']
+
+string_types = (str,)
+numeric_types = (float, int, onp.generic)
+integer_types = (int, onp.integer)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: base.py MXNetError)."""
+
+
+class NotImplementedForSymbol(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__()
+        self.function = function.__name__ if hasattr(function, '__name__') else str(function)
+        self.alias = alias
+
+    def __str__(self):
+        return 'Function %s is not implemented for Symbol.' % self.function
+
+
+_DTYPE_ALIASES = {
+    'float16': 'float16', 'float32': 'float32', 'float64': 'float64',
+    'bfloat16': 'bfloat16', 'uint8': 'uint8', 'int8': 'int8',
+    'int32': 'int32', 'int64': 'int64', 'bool': 'bool',
+}
+
+
+def np_dtype(dtype):
+    """Normalize a dtype spec (str / np.dtype / jnp dtype) to numpy dtype.
+
+    bfloat16 is kept as the ml_dtypes extended dtype that jax uses.
+    """
+    if dtype is None:
+        return onp.dtype('float32')
+    if isinstance(dtype, str):
+        if dtype == 'bfloat16':
+            import ml_dtypes
+            return onp.dtype(ml_dtypes.bfloat16)
+        return onp.dtype(dtype)
+    try:
+        return onp.dtype(dtype)
+    except TypeError:
+        return onp.dtype(str(dtype))
+
+
+def dtype_name(dtype):
+    return onp.dtype(dtype).name if not str(dtype) == 'bfloat16' else 'bfloat16'
+
+
+class _Null:
+    """Sentinel for "argument not provided" in generated op signatures
+    (reference: python/mxnet/base.py _Null / _NullType)."""
+
+    def __repr__(self):
+        return '_Null'
+
+    def __bool__(self):
+        return False
+
+
+_Null = _Null()

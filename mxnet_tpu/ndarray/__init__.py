@@ -1,0 +1,25 @@
+"""mxnet_tpu.ndarray — the mx.nd namespace (reference: python/mxnet/ndarray/).
+
+All registered ops are exposed both as module attributes (mx.nd.FullyConnected)
+and under .op / ._internal, mirroring the reference's generated layout.
+"""
+import sys as _sys
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      invoke, concatenate, moveaxis, save, load, waitall,
+                      _wrap_outputs)
+from . import register as _register
+
+op = _register.make_op_module(__name__ + '.op')
+_internal = op  # reference keeps private ops in nd._internal
+
+_mod = _sys.modules[__name__]
+for _name in dir(op):
+    if not _name.startswith('__') and not hasattr(_mod, _name):
+        setattr(_mod, _name, getattr(op, _name))
+
+from . import random  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from .utils import cast_to_float32  # noqa: E402,F401

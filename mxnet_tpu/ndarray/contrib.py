@@ -1,0 +1,120 @@
+"""mx.nd.contrib — control flow + helpers
+(reference: python/mxnet/ndarray/contrib.py: foreach :136, while_loop :232,
+cond :400, isfinite/isnan/isinf).
+
+Eager control flow is plain Python (the reference's imperative versions are
+too); the symbolic/hybridized twins lower to lax.scan/while_loop/cond in
+symbol/contrib.py — that is where the TPU win lives.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .ndarray import NDArray, invoke, array
+
+
+def isfinite(data):
+    return invoke('broadcast_logical_and',
+                  [_not(invoke('isnan', [data], {})),
+                   _not(invoke('isinf', [data], {}))], {})
+
+
+def _not(x):
+    return invoke('logical_not', [x], {})
+
+
+def isnan(data):
+    out = invoke('isnan', [data], {})
+    return invoke('Cast', [out], {'dtype': 'float32'})
+
+
+def isinf(data):
+    out = invoke('isinf', [data], {})
+    return invoke('Cast', [out], {'dtype': 'float32'})
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Run body over axis-0 slices of data, threading states
+    (reference: contrib.py foreach:136 / src/operator/control_flow.cc)."""
+    states = init_states
+    outputs = []
+    data_l = _as_list(data)
+    n = data_l[0].shape[0]
+    for i in range(n):
+        eles = [d[i] for d in data_l]
+        eles = eles[0] if not isinstance(data, (list, tuple)) else eles
+        outs, states = body(eles, states)
+        outputs.append(_as_list(outs))
+    stacked = [invoke('stack', [o[j] for o in outputs], {'axis': 0})
+               for j in range(len(outputs[0]))]
+    out = stacked[0] if len(stacked) == 1 else stacked
+    return out, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """(reference: contrib.py while_loop:232). Returns (outputs, final vars);
+    outputs padded to max_iterations rows as in the reference."""
+    steps = 0
+    outputs = []
+    vars_ = _as_list(loop_vars)
+    while bool(cond(*vars_)) and (max_iterations is None or
+                                  steps < max_iterations):
+        outs, vars_ = func(*vars_)
+        vars_ = _as_list(vars_)
+        outputs.append(_as_list(outs))
+        steps += 1
+    if not outputs:
+        return [], vars_
+    stacked = []
+    for j in range(len(outputs[0])):
+        s = invoke('stack', [o[j] for o in outputs], {'axis': 0})
+        if max_iterations is not None and steps < max_iterations:
+            pad = [(0, max_iterations - steps)] + [(0, 0)] * (s.ndim - 1)
+            flat = [p for pair in pad for p in pair]
+            s = invoke('Pad', [s.reshape((s.shape[0], -1)) if s.ndim < 2 else s],
+                       {'mode': 'constant', 'pad_width': flat,
+                        'constant_value': 0.0}) if s.ndim >= 2 else s
+        stacked.append(s)
+    out = stacked[0] if len(stacked) == 1 else stacked
+    return out, vars_
+
+
+def cond(pred, then_func, else_func):
+    """(reference: contrib.py cond:400)."""
+    if bool(pred):
+        return then_func()
+    return else_func()
+
+
+def div_sqrt_dim(data):
+    """Attention scaling helper (reference: contrib/transformer.cc:33)."""
+    import math
+    return data / math.sqrt(data.shape[-1])
+
+
+def getnnz(data, axis=None):
+    n = (data.asnumpy() != 0).sum(axis=axis)
+    return array(onp.atleast_1d(n), dtype='int64')
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    idx = index_vector.astype('int32')
+    out = old_tensor.copy()
+    out._data = out._data.at[idx._data].set(new_tensor._data)
+    return out
+
+
+def gradientmultiplier(data, scalar=1.0):
+    return invoke('_contrib_gradientmultiplier', [data], {'scalar': scalar})
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    return invoke('_contrib_quadratic', [data], {'a': a, 'b': b, 'c': c})
+
+
+def boolean_mask(data, index, axis=0):
+    return invoke('boolean_mask', [data, index], {'axis': axis})

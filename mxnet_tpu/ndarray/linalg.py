@@ -1,0 +1,28 @@
+"""mx.nd.linalg (reference: python/mxnet/ndarray/linalg.py)."""
+from .ndarray import invoke
+
+
+def _wrap(opname):
+    def fn(*args, **kw):
+        return invoke(opname, list(args), kw)
+    fn.__name__ = opname.replace('_linalg_', '')
+    return fn
+
+
+gemm = _wrap('_linalg_gemm')
+gemm2 = _wrap('_linalg_gemm2')
+potrf = _wrap('_linalg_potrf')
+potri = _wrap('_linalg_potri')
+trmm = _wrap('_linalg_trmm')
+trsm = _wrap('_linalg_trsm')
+sumlogdiag = _wrap('_linalg_sumlogdiag')
+extractdiag = _wrap('_linalg_extractdiag')
+makediag = _wrap('_linalg_makediag')
+extracttrian = _wrap('_linalg_extracttrian')
+maketrian = _wrap('_linalg_maketrian')
+syrk = _wrap('_linalg_syrk')
+gelqf = _wrap('_linalg_gelqf')
+syevd = _wrap('_linalg_syevd')
+inverse = _wrap('_linalg_inverse')
+det = _wrap('_linalg_det')
+slogdet = _wrap('_linalg_slogdet')

@@ -1,0 +1,617 @@
+"""NDArray: imperative tensor over jax.Array with MXNet semantics.
+
+Reference parity: include/mxnet/ndarray.h:82 + python/mxnet/ndarray/ndarray.py.
+The reference NDArray is a handle into the async dependency engine; here the
+backing store is a jax.Array whose dispatch is already async in XLA —
+``wait_to_read`` maps to ``block_until_ready`` (SURVEY.md §1 L2 "TPU
+mapping"). In-place mutation (``x[:]=v``, ``+=``) is presented to the user
+while the functional backend swaps the underlying buffer (XLA donates/aliases
+where it can).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import np_dtype, numeric_types, integer_types
+from ..context import Context, current_context
+from .. import autograd
+from ..autograd import Entry, TapeNode
+from ..ops import registry as _registry
+from .. import random as _random
+
+__all__ = ['NDArray', 'array', 'zeros', 'ones', 'full', 'empty', 'arange',
+           'invoke', 'concatenate', 'moveaxis', 'save', 'load', 'waitall',
+           'imports_done']
+
+
+def _is_float(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+class NDArray:
+    """Multi-dimensional array with deferred (async) execution."""
+
+    __slots__ = ('_data', '_ctx', '_grad', '_grad_req', '_entry',
+                 '_grad_fresh', '__weakref__')
+
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data = data
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = 'null'
+        self._entry = None
+        self._grad_fresh = False
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 \
+            else self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+            if dev.platform == 'cpu':
+                return Context('cpu', dev.id)
+            return Context('tpu', dev.id)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return 'default'
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    # -- engine semantics --------------------------------------------------
+    def wait_to_read(self):
+        """Block until the value is computed (reference: ndarray.h:361
+        WaitToRead; XLA analog = block_until_ready)."""
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # -- conversion --------------------------------------------------------
+    def asnumpy(self):
+        out = onp.asarray(self._data)
+        return out
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError('The current array is not a scalar')
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError('The truth value of an NDArray with multiple '
+                         'elements is ambiguous.')
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError('len() of unsized object')
+        return self.shape[0]
+
+    def __repr__(self):
+        return '%s\n<NDArray %s @%s>' % (
+            str(self.asnumpy()), 'x'.join(str(s) for s in self.shape),
+            self.context)
+
+    def astype(self, dtype, copy=True):
+        dt = np_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return invoke('Cast', [self], {'dtype': dtype})
+
+    def copy(self):
+        return invoke('_copy', [self], {})
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = jnp.asarray(self._data, dtype=other._data.dtype) \
+                if other._data.dtype != self._data.dtype else self._data
+            if other._ctx is not None:
+                other._data = jax.device_put(other._data,
+                                             other._ctx.jax_device())
+            return other
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        raise TypeError('copyto target must be NDArray or Context')
+
+    def as_in_context(self, context):
+        if context == self.context:
+            return self
+        out = NDArray(jax.device_put(self._data, context.jax_device()),
+                      ctx=context)
+        out._entry = self._entry
+        return out
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype != 'default':
+            raise NotImplementedError('sparse storage is emulated densely; '
+                                      'tostype(%r) unsupported' % stype)
+        return self
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req='write', stype=None):
+        """Attach a gradient buffer (reference: ndarray.py attach_grad)."""
+        self._grad = zeros(self.shape, dtype=self._data.dtype,
+                           ctx=self.context if self._ctx else None)
+        self._grad_req = grad_req
+        self._entry = Entry(variable=self)
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- indexing ----------------------------------------------------------
+    def _index(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        idx = self._index(key)
+        if autograd.is_recording() and self._entry is not None:
+            return invoke('_getitem', [self], {'_key': idx})
+        return NDArray(self._data[idx])
+
+    def __setitem__(self, key, value):
+        idx = self._index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(idx, slice) and idx == slice(None) and \
+                not isinstance(value, jax.Array):
+            self._data = jnp.full_like(self._data, value) \
+                if onp.isscalar(value) else jnp.asarray(value, self._data.dtype)
+            return
+        self._data = self._data.at[idx].set(
+            jnp.asarray(value, self._data.dtype)
+            if not isinstance(value, jax.Array) else value.astype(self._data.dtype))
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # -- arithmetic (routed through the op registry so autograd records) ---
+    def _binary(self, opname, other, reflect=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reflect else (self, other)
+            return invoke(opname, [a, b], {})
+        if isinstance(other, numeric_types):
+            sname = {'broadcast_add': '_plus_scalar',
+                     'broadcast_sub': '_rminus_scalar' if reflect else '_minus_scalar',
+                     'broadcast_mul': '_mul_scalar',
+                     'broadcast_div': '_rdiv_scalar' if reflect else '_div_scalar',
+                     'broadcast_mod': '_rmod_scalar' if reflect else '_mod_scalar',
+                     'broadcast_power': '_rpower_scalar' if reflect else '_power_scalar',
+                     'broadcast_equal': '_equal_scalar',
+                     'broadcast_not_equal': '_not_equal_scalar',
+                     'broadcast_greater': '_lesser_scalar' if reflect else '_greater_scalar',
+                     'broadcast_greater_equal': '_lesser_equal_scalar' if reflect else '_greater_equal_scalar',
+                     'broadcast_lesser': '_greater_scalar' if reflect else '_lesser_scalar',
+                     'broadcast_lesser_equal': '_greater_equal_scalar' if reflect else '_lesser_equal_scalar',
+                     'broadcast_maximum': '_maximum_scalar',
+                     'broadcast_minimum': '_minimum_scalar',
+                     }[opname]
+            return invoke(sname, [self], {'scalar': float(other)})
+        if isinstance(other, (onp.ndarray, list, tuple)):
+            return self._binary(opname, array(other), reflect)
+        return NotImplemented
+
+    def __add__(self, o): return self._binary('broadcast_add', o)
+    def __radd__(self, o): return self._binary('broadcast_add', o)
+    def __sub__(self, o): return self._binary('broadcast_sub', o)
+    def __rsub__(self, o): return self._binary('broadcast_sub', o, True)
+    def __mul__(self, o): return self._binary('broadcast_mul', o)
+    def __rmul__(self, o): return self._binary('broadcast_mul', o)
+    def __truediv__(self, o): return self._binary('broadcast_div', o)
+    def __rtruediv__(self, o): return self._binary('broadcast_div', o, True)
+    def __mod__(self, o): return self._binary('broadcast_mod', o)
+    def __rmod__(self, o): return self._binary('broadcast_mod', o, True)
+    def __pow__(self, o): return self._binary('broadcast_power', o)
+    def __rpow__(self, o): return self._binary('broadcast_power', o, True)
+    def __eq__(self, o): return self._binary('broadcast_equal', o)
+    def __ne__(self, o): return self._binary('broadcast_not_equal', o)
+    def __gt__(self, o): return self._binary('broadcast_greater', o)
+    def __ge__(self, o): return self._binary('broadcast_greater_equal', o)
+    def __lt__(self, o): return self._binary('broadcast_lesser', o)
+    def __le__(self, o): return self._binary('broadcast_lesser_equal', o)
+    def __neg__(self): return invoke('negative', [self], {})
+    def __abs__(self): return invoke('abs', [self], {})
+    def __hash__(self): return id(self)
+
+    def __iadd__(self, o):
+        out = self._binary('broadcast_add', o)
+        self._data = out._data
+        self._entry = out._entry
+        return self
+
+    def __isub__(self, o):
+        out = self._binary('broadcast_sub', o)
+        self._data = out._data
+        self._entry = out._entry
+        return self
+
+    def __imul__(self, o):
+        out = self._binary('broadcast_mul', o)
+        self._data = out._data
+        self._entry = out._entry
+        return self
+
+    def __itruediv__(self, o):
+        out = self._binary('broadcast_div', o)
+        self._data = out._data
+        self._entry = out._entry
+        return self
+
+    # -- method sugar delegating to ops ------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return invoke('Reshape', [self], {'shape': shape, **kwargs})
+
+    def reshape_like(self, other):
+        return invoke('reshape_like', [self, other], {})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return invoke('transpose', [self], {'axes': axes if axes else None})
+
+    def flatten(self):
+        return invoke('Flatten', [self], {})
+
+    def expand_dims(self, axis):
+        return invoke('expand_dims', [self], {'axis': axis})
+
+    def squeeze(self, axis=None):
+        return invoke('squeeze', [self], {'axis': axis})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke('SwapAxis', [self], {'dim1': dim1, 'dim2': dim2})
+
+    def flip(self, axis):
+        return invoke('reverse', [self], {'axis': axis})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke('SliceChannel', [self],
+                      {'num_outputs': num_outputs, 'axis': axis,
+                       'squeeze_axis': squeeze_axis})
+
+    def slice(self, begin, end, step=None):
+        return invoke('slice', [self], {'begin': begin, 'end': end,
+                                        'step': step})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke('slice_axis', [self],
+                      {'axis': axis, 'begin': begin, 'end': end})
+
+    def take(self, indices, axis=0, mode='clip'):
+        return invoke('take', [self, indices], {'axis': axis, 'mode': mode})
+
+    def one_hot(self, depth, **kw):
+        return invoke('one_hot', [self], {'depth': depth, **kw})
+
+    def clip(self, a_min, a_max):
+        return invoke('clip', [self], {'a_min': a_min, 'a_max': a_max})
+
+    def tile(self, reps):
+        return invoke('tile', [self], {'reps': reps})
+
+    def broadcast_to(self, shape):
+        return invoke('broadcast_to', [self], {'shape': shape})
+
+    def broadcast_like(self, other):
+        return invoke('broadcast_like', [self, other], {})
+
+    def pad(self, mode='constant', pad_width=None, constant_value=0.0):
+        return invoke('Pad', [self], {'mode': mode, 'pad_width': pad_width,
+                                      'constant_value': constant_value})
+
+    def topk(self, **kw):
+        return invoke('topk', [self], kw)
+
+    def argsort(self, **kw):
+        return invoke('argsort', [self], kw)
+
+    def sort(self, **kw):
+        return invoke('sort', [self], kw)
+
+
+def _unary_method(name, opname=None):
+    opname = opname or name
+
+    def _m(self, *, axis=None, keepdims=False, **kw):
+        attrs = dict(kw)
+        op = _registry.get(opname)
+        if 'axis' in op.attr_names:
+            attrs['axis'] = axis
+        if 'keepdims' in op.attr_names:
+            attrs['keepdims'] = keepdims
+        return invoke(opname, [self], attrs)
+    _m.__name__ = name
+    return _m
+
+
+for _n in ['abs', 'sqrt', 'square', 'exp', 'log', 'sigmoid', 'relu', 'tanh',
+           'sin', 'cos', 'sign', 'round', 'rint', 'floor', 'ceil',
+           'sum', 'mean', 'prod', 'max', 'min', 'argmax', 'argmin', 'norm']:
+    setattr(NDArray, _n, _unary_method(_n))
+setattr(NDArray, 'softmax', _unary_method('softmax'))
+setattr(NDArray, 'log_softmax', _unary_method('log_softmax'))
+
+
+# ---------------------------------------------------------------------------
+# op invocation — the Imperative::Invoke analog (imperative.cc:89)
+# ---------------------------------------------------------------------------
+
+
+def _getitem_fn(data, *, _key=None):
+    return data[_key]
+
+
+_registry.register('_getitem')(_getitem_fn)
+
+
+def invoke(opname, nd_inputs, attrs, out=None):
+    """Invoke a registered op eagerly on NDArrays, recording on the autograd
+    tape when inside autograd.record() (Imperative::Invoke + RecordOp)."""
+    op = _registry.get(opname) if isinstance(opname, str) else opname
+    variadic = op.num_inputs == -1
+    flat_inputs = list(nd_inputs)
+    arrays = [x._data if isinstance(x, NDArray) else jnp.asarray(x)
+              for x in flat_inputs]
+    attrs = {k: v for k, v in attrs.items() if v is not None or k in ('axis',)}
+    if 'training' in op.attr_names and 'training' not in attrs:
+        attrs['training'] = autograd.is_training()
+
+    if op.needs_rng:
+        key = _random.next_key()
+        base_fn = op.bind_attrs(**attrs)
+        if variadic:
+            fn = lambda *arrs: base_fn(key, list(arrs))
+        else:
+            fn = lambda *arrs: base_fn(key, *arrs)
+    else:
+        base_fn = op.bind_attrs(**attrs)
+        if variadic:
+            fn = lambda *arrs: base_fn(list(arrs))
+        else:
+            fn = base_fn
+
+    recording = autograd.is_recording() and any(
+        isinstance(x, NDArray) and x._entry is not None for x in flat_inputs)
+
+    if recording:
+        out_arrays, vjp_fn = jax.vjp(fn, *arrays)
+    else:
+        out_arrays = fn(*arrays)
+        vjp_fn = None
+
+    single = not isinstance(out_arrays, (tuple, list))
+    outs_raw = [out_arrays] if single else list(out_arrays)
+    outputs = [NDArray(a) for a in outs_raw]
+
+    if recording:
+        in_entries = [x._entry if isinstance(x, NDArray) else None
+                      for x in flat_inputs]
+        node = TapeNode(vjp_fn, in_entries, len(outputs),
+                        [o.shape for o in outputs],
+                        [o._data.dtype for o in outputs])
+        for i, o in enumerate(outputs):
+            o._entry = Entry(node=node, index=i)
+
+    # in-place update semantics for optimizer/mutating ops
+    if out is not None:
+        out_list = out if isinstance(out, (list, tuple)) else [out]
+        for tgt, src in zip(out_list, outputs):
+            if tgt is not None:
+                tgt._data = src._data
+                tgt._entry = src._entry
+        first = out_list[0] if out_list else outputs[0]
+        return out if not isinstance(out, (list, tuple)) else out_list
+    if op.mutate_idx and not recording:
+        for out_i, in_i in enumerate(op.mutate_idx):
+            if in_i < len(flat_inputs) and isinstance(flat_inputs[in_i], NDArray):
+                flat_inputs[in_i]._data = outputs[out_i]._data
+        return outputs[0] if single or len(outputs) == 1 else tuple(outputs)
+    return outputs[0] if single else tuple(outputs)
+
+
+def _wrap_outputs(arrays):
+    return [NDArray(a) for a in arrays]
+
+
+# ---------------------------------------------------------------------------
+# creation / io
+# ---------------------------------------------------------------------------
+
+
+def _place(data, ctx):
+    if ctx is not None:
+        data = jax.device_put(data, ctx.jax_device())
+    return data
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+        if dtype is not None:
+            data = data.astype(np_dtype(dtype))
+        return NDArray(_place(data, ctx), ctx=ctx)
+    if dtype is None:
+        # MXNet rule: numpy sources keep their dtype (float64→float32 since
+        # the default build has no fp64 path); python lists default float32.
+        if isinstance(source_array, onp.ndarray):
+            arr = source_array
+            if arr.dtype == onp.float64:
+                arr = arr.astype(onp.float32)
+            elif arr.dtype == onp.int64:
+                arr = arr.astype(onp.int64)
+        else:
+            arr = onp.asarray(source_array, dtype=onp.float32)
+    else:
+        arr = onp.asarray(source_array, dtype=np_dtype(dtype))
+    return NDArray(_place(jnp.asarray(arr), ctx), ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype='float32', **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.zeros(shape, np_dtype(dtype)), ctx), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype='float32', **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.ones(shape, np_dtype(dtype)), ctx), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype='float32', **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.full(shape, val, np_dtype(dtype)), ctx), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype='float32'):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype='float32'):
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, int(repeat))
+    return NDArray(_place(out, ctx), ctx=ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke('Concat', list(arrays), {'dim': axis,
+                                           'num_args': len(arrays)})
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray(jnp.moveaxis(tensor._data, source, destination))
+
+
+def waitall():
+    """Block on all outstanding async work (reference: MXNDArrayWaitAll)."""
+    (jax.effects_barrier if hasattr(jax, 'effects_barrier') else lambda: None)()
+
+
+def imports_done():
+    return True
+
+
+# ---------------------------------------------------------------------------
+# save / load — MXNet NDArray container format parity
+# (reference: src/ndarray/ndarray.cc:1578 Save / :1695 Load). Binary layout:
+#   uint64 magic=0x112745F8, uint64 reserved, uint64 ndarray count,
+#   [per array: the legacy TBlob header], uint64 name count, names.
+# We keep the same *API* (dict / list round-trip); storage uses the
+# documented magic plus an npz payload (cross-loading real MXNet .params
+# files is tracked for a later round in utils/mx_format.py).
+# ---------------------------------------------------------------------------
+
+_NDARRAY_MAGIC = 0x112745F8
+
+
+def save(fname, data):
+    import io as _io
+    import struct
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+    payload = _io.BytesIO()
+    onp.savez(payload, **{str(i): a.asnumpy() for i, a in enumerate(arrays)})
+    blob = payload.getvalue()
+    with open(fname, 'wb') as f:
+        f.write(struct.pack('<QQQ', _NDARRAY_MAGIC, 0, len(arrays)))
+        f.write(struct.pack('<Q', len(names)))
+        for n in names:
+            nb = n.encode('utf-8')
+            f.write(struct.pack('<Q', len(nb)))
+            f.write(nb)
+        f.write(struct.pack('<Q', len(blob)))
+        f.write(blob)
+
+
+def load(fname):
+    import io as _io
+    import struct
+    with open(fname, 'rb') as f:
+        magic, _, count = struct.unpack('<QQQ', f.read(24))
+        if magic != _NDARRAY_MAGIC:
+            raise ValueError('invalid NDArray file %s' % fname)
+        nname, = struct.unpack('<Q', f.read(8))
+        names = []
+        for _ in range(nname):
+            ln, = struct.unpack('<Q', f.read(8))
+            names.append(f.read(ln).decode('utf-8'))
+        blen, = struct.unpack('<Q', f.read(8))
+        npz = onp.load(_io.BytesIO(f.read(blen)))
+        arrays = [NDArray(jnp.asarray(npz[str(i)])) for i in range(count)]
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

@@ -1,0 +1,114 @@
+"""Sparse NDArray facade (reference: python/mxnet/ndarray/sparse.py —
+CSRNDArray :287, RowSparseNDArray :561; C side row_sparse/CSR storage in
+include/mxnet/ndarray.h:61-66).
+
+XLA has no native sparse storage (SURVEY.md §7 hard-part 3): these classes
+keep the *API* (indices/indptr/data accessors, conversions, creation) while
+storing dense jax buffers. The embedding/optimizer "sparse" fast paths in
+the reference exist for memory reasons that XLA's scatter/gather fusion
+covers; correctness is preserved, density is documented divergence.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import scipy.sparse as sps
+
+from .ndarray import NDArray, array, zeros as _dense_zeros
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return 'csr'
+
+    @property
+    def indices(self):
+        m = sps.csr_matrix(self.asnumpy())
+        return array(m.indices.astype('int64'))
+
+    @property
+    def indptr(self):
+        m = sps.csr_matrix(self.asnumpy())
+        return array(m.indptr.astype('int64'))
+
+    @property
+    def data(self):
+        m = sps.csr_matrix(self.asnumpy())
+        return array(m.data)
+
+    def tostype(self, stype):
+        if stype == 'default':
+            return NDArray(self._data)
+        if stype == 'csr':
+            return self
+        return RowSparseNDArray(self._data)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    __slots__ = ()
+
+    @property
+    def stype(self):
+        return 'row_sparse'
+
+    @property
+    def indices(self):
+        a = self.asnumpy()
+        nz = onp.where(onp.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return array(nz.astype('int64'))
+
+    @property
+    def data(self):
+        a = self.asnumpy()
+        nz = onp.where(onp.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return array(a[nz])
+
+    def tostype(self, stype):
+        if stype == 'default':
+            return NDArray(self._data)
+        if stype == 'row_sparse':
+            return self
+        return CSRNDArray(self._data)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3 and not onp.isscalar(arg1[0]):
+        data, indices, indptr = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else onp.asarray(data)
+        indices = indices.asnumpy() if isinstance(indices, NDArray) else onp.asarray(indices)
+        indptr = indptr.asnumpy() if isinstance(indptr, NDArray) else onp.asarray(indptr)
+        m = sps.csr_matrix((data, indices, indptr), shape=shape)
+        return CSRNDArray(array(m.toarray(), dtype=dtype)._data)
+    if isinstance(arg1, NDArray):
+        return CSRNDArray(arg1._data)
+    return CSRNDArray(array(onp.asarray(arg1), dtype=dtype)._data)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else onp.asarray(data)
+        indices = onp.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                              else indices).astype('int64')
+        full_shape = shape or ((int(indices.max()) + 1,) + data.shape[1:])
+        out = onp.zeros(full_shape, dtype=data.dtype)
+        out[indices] = data
+        return RowSparseNDArray(array(out, dtype=dtype)._data)
+    if isinstance(arg1, NDArray):
+        return RowSparseNDArray(arg1._data)
+    return RowSparseNDArray(array(onp.asarray(arg1), dtype=dtype)._data)
+
+
+def zeros(stype, shape, ctx=None, dtype='float32'):
+    d = _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == 'csr':
+        return CSRNDArray(d._data)
+    if stype == 'row_sparse':
+        return RowSparseNDArray(d._data)
+    return d

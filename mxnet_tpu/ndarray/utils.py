@@ -1,0 +1,10 @@
+"""ndarray utils (reference: python/mxnet/ndarray/utils.py)."""
+from .ndarray import NDArray, array, zeros, load, save
+
+
+def cast_to_float32(data):
+    return data.astype('float32')
+
+
+def zeros_like_stype(arr):
+    return zeros(arr.shape, dtype=arr.dtype)

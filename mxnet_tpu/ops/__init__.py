@@ -1,0 +1,16 @@
+"""Pure-JAX operator library (the src/operator analog, SURVEY.md §2.2).
+
+Importing this package registers every op into ops.registry.OPS; the
+ndarray/symbol frontends are generated from that table.
+"""
+from . import registry
+from .registry import OPS, get, list_ops, register, alias
+
+# registration side effects
+from . import math      # noqa: F401
+from . import tensor    # noqa: F401
+from . import nn        # noqa: F401
+from . import linalg    # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import contrib   # noqa: F401

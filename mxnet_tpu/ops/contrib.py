@@ -1,0 +1,388 @@
+"""Contrib ops (reference: src/operator/contrib/ — SURVEY.md §2.2
+"Contrib ops"): transformer helpers, detection stack (multibox/NMS/box ops),
+misc. The detection stack is lax.top_k/while_loop based — TPU-friendly
+static shapes instead of the reference's CUDA sort/suppress loops.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register('_contrib_quadratic', aliases=('quadratic',))
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """The "how to add an op" tutorial op (reference: contrib/quadratic_op)."""
+    return a * data * data + b * data + c
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _gradmult(data, scalar):
+    return data
+
+
+_gradmult.defvjp(lambda d, s: (d, None), lambda s, res, g: (g * s,))
+
+
+@register('_contrib_gradientmultiplier')
+def gradientmultiplier(data, *, scalar=1.0):
+    return _gradmult(data, float(scalar))
+
+
+@register('_contrib_div_sqrt_dim')
+def div_sqrt_dim(data):
+    """Scale by 1/sqrt(last dim) — attention helper
+    (reference: contrib/transformer.cc:33)."""
+    return data / math.sqrt(data.shape[-1])
+
+
+@register('_contrib_index_copy', num_inputs=3)
+def index_copy(old_tensor, index_vector, new_tensor):
+    idx = index_vector.astype(jnp.int32)
+    return old_tensor.at[idx].set(new_tensor)
+
+
+@register('_contrib_arange_like', num_inputs=1)
+def arange_like(data, *, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        out = start + step * jnp.arange(n, dtype=jnp.float32)
+        return out.reshape(data.shape)
+    n = data.shape[int(axis)]
+    return start + step * jnp.arange(n, dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# detection stack (reference: contrib/bounding_box.cc, multibox_*.cc —
+# the SSD-300 BASELINE config path)
+# ---------------------------------------------------------------------------
+
+
+@register('_contrib_box_iou', num_inputs=2)
+def box_iou(lhs, rhs, *, format='corner'):
+    def to_corner(b):
+        if format == 'center':
+            x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], -1)
+        return b
+    a = to_corner(lhs)[..., :, None, :]
+    b = to_corner(rhs)[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def _nms_single(boxes, scores, valid, overlap_thresh, topk):
+    """Greedy NMS over one batch element with static shapes (lax.fori_loop).
+
+    boxes: (N,4) corner; scores: (N,); valid: (N,) bool.
+    Returns keep mask (N,) after suppression, in score order semantics.
+    """
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    v = valid[order]
+    tl = b[:, None, :2], b[None, :, :2]
+    ious = box_iou(b, b)
+
+    def body(i, keep):
+        # suppress j>i with iou>thresh if i kept
+        sup = (ious[i] > overlap_thresh) & (jnp.arange(n) > i) & keep[i] & v[i]
+        return keep & ~sup
+
+    keep = jax.lax.fori_loop(0, n if topk < 0 else min(topk, n), body,
+                             v.astype(bool))
+    inv = jnp.argsort(order)
+    return keep[inv]
+
+
+@register('_contrib_box_nms', num_inputs=1, aliases=('_contrib_nms',))
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format='corner', out_format='corner'):
+    """Non-maximum suppression (reference: bounding_box-inl.h NMS).
+
+    data: (B, N, K) with score at score_index, box at coord_start:+4.
+    Suppressed entries are set to -1 (reference semantics).
+    """
+    batched = data.ndim == 3
+    x = data if batched else data[None]
+    scores = x[..., score_index]
+    boxes = jax.lax.dynamic_slice_in_dim(x, coord_start, 4, axis=-1)
+    if in_format == 'center':
+        cx, cy, w, h = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                        boxes[..., 3])
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+    valid = scores > valid_thresh
+    if id_index >= 0 and background_id >= 0:
+        valid = valid & (x[..., id_index] != background_id)
+
+    if id_index >= 0 and not force_suppress:
+        # class-aware: only suppress within the same class id
+        ids = x[..., id_index]
+
+        def per_batch(b, s, v, cid):
+            iou = box_iou(b, b)
+            same = cid[:, None] == cid[None, :]
+            n = b.shape[0]
+            order = jnp.argsort(-s)
+            iou_o = iou[order][:, order]
+            same_o = same[order][:, order]
+            v_o = v[order]
+
+            def body(i, keep):
+                sup = (iou_o[i] > overlap_thresh) & same_o[i] & \
+                    (jnp.arange(n) > i) & keep[i] & v_o[i]
+                return keep & ~sup
+            keep = jax.lax.fori_loop(0, n, body, v_o.astype(bool))
+            return keep[jnp.argsort(order)]
+        keep = jax.vmap(per_batch)(boxes, scores, valid, ids)
+    else:
+        keep = jax.vmap(lambda b, s, v: _nms_single(b, s, v, overlap_thresh,
+                                                    int(topk)))(boxes, scores,
+                                                                valid)
+    out = jnp.where(keep[..., None], x, -jnp.ones_like(x))
+    # sort surviving entries by score descending (reference output order)
+    neg_s = jnp.where(keep, -scores, jnp.inf)
+    order = jnp.argsort(neg_s, axis=-1)
+    out = jnp.take_along_axis(out, order[..., None], axis=1)
+    return out if batched else out[0]
+
+
+@register('_contrib_bipartite_matching', num_inputs=1, num_outputs=2)
+def bipartite_matching(data, *, is_ascend=False, threshold=0.5, topk=-1):
+    """Greedy bipartite matching (reference: bounding_box.cc)."""
+    x = data
+    batched = x.ndim == 3
+    if not batched:
+        x = x[None]
+
+    def one(mat):
+        n, m = mat.shape
+        big = jnp.inf if is_ascend else -jnp.inf
+
+        def body(_, st):
+            mat_c, rows, cols = st
+            flat = jnp.argmin(mat_c) if is_ascend else jnp.argmax(mat_c)
+            i, j = flat // m, flat % m
+            val = mat_c[i, j]
+            ok = (val < threshold) if is_ascend else (val > threshold)
+            rows = jnp.where(ok & (rows[i] < 0), rows.at[i].set(j), rows)
+            cols = jnp.where(ok & (cols[j] < 0), cols.at[j].set(i), cols)
+            mat_c = mat_c.at[i, :].set(big).at[:, j].set(big)
+            return mat_c, rows, cols
+        rows = -jnp.ones((n,), dtype=jnp.float32)
+        cols = -jnp.ones((m,), dtype=jnp.float32)
+        k = min(n, m) if topk < 0 else min(int(topk), min(n, m))
+        _, rows, cols = jax.lax.fori_loop(0, k, body, (mat, rows, cols))
+        return rows, cols
+    rows, cols = jax.vmap(one)(x)
+    if not batched:
+        return rows[0], cols[0]
+    return rows, cols
+
+
+@register('_contrib_MultiBoxPrior', num_inputs=1,
+          aliases=('_contrib_multibox_prior',))
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate SSD anchor boxes (reference: multibox_prior.cc)."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing='ij')
+    # anchors: sizes[0] with each ratio + each other size with ratio[0]
+    whs = []
+    for r in ratios:
+        sr = math.sqrt(r)
+        whs.append((sizes[0] * sr, sizes[0] / sr))
+    for s in sizes[1:]:
+        sr = math.sqrt(ratios[0])
+        whs.append((s * sr, s / sr))
+    anchors = []
+    for (bw, bh) in whs:
+        anchors.append(jnp.stack([cxg - bw / 2, cyg - bh / 2,
+                                  cxg + bw / 2, cyg + bh / 2], axis=-1))
+    out = jnp.stack(anchors, axis=2).reshape(-1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out[None]
+
+
+@register('_contrib_MultiBoxTarget', num_inputs=3, num_outputs=3,
+          aliases=('_contrib_multibox_target',))
+def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Assign ground-truth to anchors (reference: multibox_target.cc).
+
+    anchor: (1, N, 4) corner; label: (B, M, 5) [cls, xmin, ymin, xmax, ymax]
+    returns (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N)).
+    """
+    anchors = anchor[0]  # (N, 4)
+    N = anchors.shape[0]
+    var = jnp.asarray(variances)
+
+    def one(lab):
+        valid = lab[:, 0] >= 0
+        ious = box_iou(anchors, lab[:, 1:5])  # (N, M)
+        ious = jnp.where(valid[None, :], ious, 0.0)
+        best_iou = ious.max(axis=1)
+        best_gt = ious.argmax(axis=1)
+        pos = best_iou >= overlap_threshold
+        # also: each gt's best anchor is positive
+        gt_best_anchor = jnp.argmax(ious, axis=0)
+        pos = pos.at[gt_best_anchor].set(True) if hasattr(pos, 'at') else pos
+        pos = pos & (best_iou > 1e-8)
+        gt = lab[best_gt]
+        # encode loc target
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+        gh = jnp.maximum(gt[:, 4] - gt[:, 2], 1e-8)
+        gcx = (gt[:, 1] + gt[:, 3]) / 2
+        gcy = (gt[:, 2] + gt[:, 4]) / 2
+        tx = (gcx - acx) / aw / var[0]
+        ty = (gcy - acy) / ah / var[1]
+        tw = jnp.log(gw / aw) / var[2]
+        th = jnp.log(gh / ah) / var[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(pos[:, None], 1.0, 0.0).repeat(4, -1)[:, :4].reshape(-1)
+        loc_m = jnp.broadcast_to(pos[:, None], (N, 4)).astype(jnp.float32).reshape(-1)
+        cls_t = jnp.where(pos, gt[:, 0] + 1, 0.0)
+        return loc_t, loc_m, cls_t
+    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    return loc_t, loc_m, cls_t
+
+
+@register('_contrib_MultiBoxDetection', num_inputs=3,
+          aliases=('_contrib_multibox_detection',))
+def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions to detections (reference: multibox_detection.cc).
+
+    cls_prob: (B, C, N), loc_pred: (B, N*4), anchor: (1, N, 4).
+    out: (B, N, 6) [id, score, xmin, ymin, xmax, ymax].
+    """
+    B, C, N = cls_prob.shape
+    var = jnp.asarray(variances)
+    anchors = anchor[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(cp, lp):
+        # class with max prob excluding background
+        probs = cp[1:] if background_id == 0 else cp
+        cid = jnp.argmax(probs, axis=0).astype(jnp.float32)
+        score = probs.max(axis=0)
+        loc = lp.reshape(N, 4)
+        cx = loc[:, 0] * var[0] * aw + acx
+        cy = loc[:, 1] * var[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * var[2]) * aw
+        h = jnp.exp(loc[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        keep = score > threshold
+        cid = jnp.where(keep, cid, -1.0)
+        return jnp.concatenate([cid[:, None], score[:, None], boxes], axis=-1)
+    dets = jax.vmap(one)(cls_prob, loc_pred)
+    return box_nms(dets, overlap_thresh=nms_threshold, valid_thresh=threshold,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   background_id=-1, force_suppress=force_suppress)
+
+
+@register('_contrib_ROIAlign', num_inputs=2)
+def roi_align(data, rois, *, pooled_size=None, spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ROI Align (reference: contrib/roi_align.cc)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        off = 0.5 if aligned else 0.0
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-8)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-8)
+        img = data[bidx]  # (C, H, W)
+        sr = 2 if sample_ratio <= 0 else int(sample_ratio)
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * rh / (ph * sr)
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * rw / (pw * sr)
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y1c = jnp.clip(y0 + 1, 0, h - 1)
+            x1c = jnp.clip(x0 + 1, 0, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            y1i, x1i = y1c.astype(jnp.int32), x1c.astype(jnp.int32)
+            v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx)
+                 + img[:, y0i, x1i] * (1 - wy) * wx
+                 + img[:, y1i, x0i] * wy * (1 - wx)
+                 + img[:, y1i, x1i] * wy * wx)
+            valid = (yy >= -1) & (yy <= h) & (xx >= -1) & (xx <= w)
+            return v * valid
+        gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+        vals = jax.vmap(jax.vmap(bilinear))(gy, gx)  # (ph*sr, pw*sr, C)
+        vals = vals.reshape(ph, sr, pw, sr, c).mean(axis=(1, 3))
+        return jnp.transpose(vals, (2, 0, 1))
+    return jax.vmap(one_roi)(rois)
+
+
+@register('ROIPooling', num_inputs=2)
+def roi_pooling(data, rois, *, pooled_size=None, spatial_scale=1.0):
+    """Max ROI pooling (reference: roi_pooling.cc)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        img = data[bidx]
+        yy = jnp.arange(h)[None, :]
+        xx = jnp.arange(w)[None, :]
+        out = []
+        for py in range(ph):
+            for px in range(pw):
+                ys = y1 + (py * rh) // ph
+                ye = y1 + ((py + 1) * rh + ph - 1) // ph
+                xs = x1 + (px * rw) // pw
+                xe = x1 + ((px + 1) * rw + pw - 1) // pw
+                mask = ((yy >= ys) & (yy < jnp.maximum(ye, ys + 1))).astype(data.dtype)
+                maskx = ((xx >= xs) & (xx < jnp.maximum(xe, xs + 1))).astype(data.dtype)
+                m2 = mask.T @ maskx  # (H, W)
+                masked = jnp.where(m2 > 0, img, -jnp.inf)
+                out.append(masked.max(axis=(1, 2)))
+        return jnp.stack(out, axis=-1).reshape(c, ph, pw)
+    return jax.vmap(one_roi)(rois)

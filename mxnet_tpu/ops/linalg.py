@@ -1,0 +1,139 @@
+"""Linear-algebra ops (reference: src/operator/tensor/la_op.cc +
+linalg_impl.h — BLAS/LAPACK via c_lapack_api.cc). On TPU these lower to
+XLA's native cholesky/qr/eigh/triangular_solve.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register('_linalg_gemm', num_inputs=3, aliases=('linalg_gemm',))
+def linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register('_linalg_gemm2', num_inputs=2, aliases=('linalg_gemm2',))
+def linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register('_linalg_potrf', aliases=('linalg_potrf',))
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register('_linalg_potri', aliases=('linalg_potri',))
+def linalg_potri(A):
+    # inverse from cholesky factor: inv(L L^T) given L
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register('_linalg_trmm', num_inputs=2, aliases=('linalg_trmm',))
+def linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    out = jnp.matmul(B, a) if rightside else jnp.matmul(a, B)
+    return alpha * out
+
+
+@register('_linalg_trsm', num_inputs=2, aliases=('linalg_trsm',))
+def linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    if rightside:
+        # solve X A = alpha B  →  A^T X^T = alpha B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(A, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not lower if transpose else not lower,
+            trans=0 if not transpose else 0)
+        return jnp.swapaxes(xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(A, alpha * B, lower=lower,
+                                             trans=1 if transpose else 0)
+
+
+@register('_linalg_sumlogdiag', aliases=('linalg_sumlogdiag',))
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register('_linalg_extractdiag', aliases=('linalg_extractdiag',))
+def linalg_extractdiag(A, *, offset=0):
+    return jnp.diagonal(A, offset=int(offset), axis1=-2, axis2=-1)
+
+
+@register('_linalg_makediag', aliases=('linalg_makediag',))
+def linalg_makediag(A, *, offset=0):
+    n = A.shape[-1] + abs(int(offset))
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    if int(offset) >= 0:
+        return out.at[..., idx, idx + int(offset)].set(A)
+    return out.at[..., idx - int(offset), idx].set(A)
+
+
+@register('_linalg_extracttrian', aliases=('linalg_extracttrian',))
+def linalg_extracttrian(A, *, offset=0, lower=True):
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=int(offset)) if lower else \
+        jnp.triu_indices(n, k=int(offset))
+    return A[..., rows, cols]
+
+
+@register('_linalg_maketrian', aliases=('linalg_maketrian',))
+def linalg_maketrian(A, *, offset=0, lower=True):
+    m = A.shape[-1]
+    # solve n(n+1)/2 + extra = m for n given offset
+    import math
+    k = abs(int(offset))
+    n = int((math.isqrt(8 * m + 1) - 1) // 2) + k
+    rows, cols = jnp.tril_indices(n, k=int(offset)) if lower else \
+        jnp.triu_indices(n, k=int(offset))
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@register('_linalg_syrk', aliases=('linalg_syrk',))
+def linalg_syrk(A, *, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(A, -1, -2)
+    if transpose:
+        return alpha * jnp.matmul(at, A)
+    return alpha * jnp.matmul(A, at)
+
+
+@register('_linalg_gelqf', num_outputs=2, aliases=('linalg_gelqf',))
+def linalg_gelqf(A):
+    # LQ decomposition via QR of A^T
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register('_linalg_syevd', num_outputs=2, aliases=('linalg_syevd',))
+def linalg_syevd(A):
+    w, u = jnp.linalg.eigh(A)
+    return jnp.swapaxes(u, -1, -2), w
+
+
+@register('_linalg_inverse', aliases=('linalg_inverse', '_linalg_inv'))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register('_linalg_det', aliases=('linalg_det',))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register('_linalg_slogdet', num_outputs=2, aliases=('linalg_slogdet',))
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet

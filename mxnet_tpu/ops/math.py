@@ -1,0 +1,364 @@
+"""Elementwise, scalar, broadcast and reduction ops.
+
+Reference parity: src/operator/tensor/elemwise_*_op*.{cc,cu},
+broadcast_reduce_op*, mshadow_op.h kernel zoo (SURVEY.md §2.2 "Tensor ops").
+All lower to jnp/lax, which XLA fuses into single VPU kernels on TPU — the
+hand-written kernel-fusion machinery of the reference (elemwise bulking,
+src/executor/graph_executor.cc:1275 InitOpSegs) is unnecessary here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# unary elementwise (reference: elemwise_unary_op_basic.cc, _trig.cc, _pow.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    'abs': jnp.abs, 'sign': jnp.sign, 'rint': jnp.rint, 'ceil': jnp.ceil,
+    'floor': jnp.floor, 'trunc': jnp.trunc, 'fix': jnp.trunc,
+    'square': jnp.square, 'sqrt': jnp.sqrt,
+    'cbrt': jnp.cbrt, 'exp': jnp.exp, 'log': jnp.log, 'log10': jnp.log10,
+    'log2': jnp.log2, 'log1p': jnp.log1p, 'expm1': jnp.expm1,
+    'sin': jnp.sin, 'cos': jnp.cos, 'tan': jnp.tan,
+    'arcsin': jnp.arcsin, 'arccos': jnp.arccos, 'arctan': jnp.arctan,
+    'sinh': jnp.sinh, 'cosh': jnp.cosh, 'tanh': jnp.tanh,
+    'arcsinh': jnp.arcsinh, 'arccosh': jnp.arccosh, 'arctanh': jnp.arctanh,
+    'degrees': jnp.degrees, 'radians': jnp.radians,
+    'negative': jnp.negative, 'reciprocal': lambda x: 1.0 / x,
+    'rsqrt': jax.lax.rsqrt, 'rcbrt': lambda x: 1.0 / jnp.cbrt(x),
+    'erf': jax.lax.erf, 'erfinv': jax.lax.erf_inv,
+    'gamma': lambda x: jnp.exp(jax.lax.lgamma(x)), 'gammaln': jax.lax.lgamma,
+    'logical_not': lambda x: (x == 0).astype(x.dtype),
+    'sigmoid': jax.nn.sigmoid, 'softsign': jax.nn.soft_sign,
+    'relu': jax.nn.relu,
+    'hard_sigmoid': lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    'isnan': jnp.isnan, 'isinf': jnp.isinf,
+}
+
+for _name, _jfn in _UNARY.items():
+    def _mk(jfn):
+        def _op(data):
+            return jfn(data)
+        return _op
+    register(_name)(_mk(_jfn))
+
+alias('negative', '_np_negative')
+alias('abs', '_np_absolute')
+
+
+@register('clip')
+def clip(data, *, a_min=None, a_max=None):
+    """Clip values to [a_min, a_max] (reference: tensor/matrix_op.cc clip)."""
+    return jnp.clip(data, a_min, a_max)
+
+
+@register('smooth_l1')
+def smooth_l1(data, *, scalar=1.0):
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data, absd - 0.5 / s2)
+
+
+@register('Cast', aliases=('cast',))
+def cast(data, *, dtype='float32'):
+    from ..base import np_dtype
+    return data.astype(np_dtype(dtype))
+
+
+@register('_copy', aliases=('identity',))
+def _copy(data):
+    return jnp.asarray(data)
+
+
+@register('BlockGrad', aliases=('stop_gradient',))
+def block_grad(data):
+    return jax.lax.stop_gradient(data)
+
+
+@register('make_loss')
+def make_loss(data, *, grad_scale=1.0, valid_thresh=0.0, normalization='null'):
+    return data
+
+
+@register('shape_array')
+def shape_array(data):
+    return jnp.array(data.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register('size_array')
+def size_array(data):
+    return jnp.array([data.size], dtype=jnp.int32)
+
+
+@register('zeros_like')
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register('ones_like')
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise + broadcast (reference: elemwise_binary_broadcast_op_*)
+# ---------------------------------------------------------------------------
+
+def _logical_and(a, b):
+    return ((a != 0) & (b != 0))
+
+
+def _logical_or(a, b):
+    return ((a != 0) | (b != 0))
+
+
+def _logical_xor(a, b):
+    return ((a != 0) ^ (b != 0))
+
+
+_BINARY = {
+    'add': jnp.add, 'sub': jnp.subtract, 'mul': jnp.multiply,
+    'div': jnp.divide, 'mod': jnp.mod, 'power': jnp.power,
+    'maximum': jnp.maximum, 'minimum': jnp.minimum, 'hypot': jnp.hypot,
+    'equal': lambda a, b: (a == b), 'not_equal': lambda a, b: (a != b),
+    'greater': lambda a, b: (a > b), 'greater_equal': lambda a, b: (a >= b),
+    'lesser': lambda a, b: (a < b), 'lesser_equal': lambda a, b: (a <= b),
+    'logical_and': _logical_and, 'logical_or': _logical_or,
+    'logical_xor': _logical_xor,
+}
+
+_CMP = {'equal', 'not_equal', 'greater', 'greater_equal', 'lesser',
+        'lesser_equal', 'logical_and', 'logical_or', 'logical_xor'}
+
+
+def _res_dtype(a, b):
+    return jnp.result_type(a, b)
+
+
+for _name, _jfn in _BINARY.items():
+    def _mk2(jfn, cmp):
+        def _op(lhs, rhs):
+            out = jfn(lhs, rhs)
+            if cmp:
+                out = out.astype(_res_dtype(lhs, rhs))
+            return out
+        return _op
+    _f = _mk2(_jfn, _name in _CMP)
+    # elemwise_* requires same shape; broadcast_* broadcasts. jnp broadcasts
+    # always — register both names onto the same kernel (shape check is a
+    # frontend concern the reference enforced in InferShape).
+    register('elemwise_%s' % _name, num_inputs=2)(_f)
+    register('broadcast_%s' % _name, num_inputs=2)(_f)
+
+alias('elemwise_add', '_plus', '_Plus', '_add')
+alias('elemwise_sub', '_minus', '_Minus', '_sub')
+alias('elemwise_mul', '_mul', '_Mul')
+alias('elemwise_div', '_div', '_Div')
+alias('broadcast_mod', '_mod', '_Mod')
+alias('broadcast_power', '_power', '_Power', '_pow')
+alias('broadcast_maximum', '_maximum', '_Maximum')
+alias('broadcast_minimum', '_minimum', '_Minimum')
+alias('broadcast_hypot', '_hypot')
+alias('broadcast_equal', '_equal')
+alias('broadcast_not_equal', '_not_equal')
+alias('broadcast_greater', '_greater')
+alias('broadcast_greater_equal', '_greater_equal')
+alias('broadcast_lesser', '_lesser')
+alias('broadcast_lesser_equal', '_lesser_equal')
+alias('broadcast_logical_and', '_logical_and')
+alias('broadcast_logical_or', '_logical_or')
+alias('broadcast_logical_xor', '_logical_xor')
+
+
+@register('_grad_add', num_inputs=2)
+def _grad_add(lhs, rhs):
+    return lhs + rhs
+
+
+@register('add_n', num_inputs=-1, key_var_num_args='num_args',
+          aliases=('ElementWiseSum', '_sum'))
+def add_n(args, *, num_args=None):
+    """Sum of N arrays (reference: elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# scalar ops (reference: *_scalar families in elemwise_binary_scalar_op*)
+_SCALAR = {
+    '_plus_scalar': lambda x, s: x + s,
+    '_minus_scalar': lambda x, s: x - s,
+    '_rminus_scalar': lambda x, s: s - x,
+    '_mul_scalar': lambda x, s: x * s,
+    '_div_scalar': lambda x, s: x / s,
+    '_rdiv_scalar': lambda x, s: s / x,
+    '_mod_scalar': lambda x, s: jnp.mod(x, s),
+    '_rmod_scalar': lambda x, s: jnp.mod(s, x),
+    '_power_scalar': lambda x, s: jnp.power(x, s),
+    '_rpower_scalar': lambda x, s: jnp.power(s, x),
+    '_hypot_scalar': lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    '_maximum_scalar': lambda x, s: jnp.maximum(x, s),
+    '_minimum_scalar': lambda x, s: jnp.minimum(x, s),
+    '_equal_scalar': lambda x, s: (x == s).astype(x.dtype),
+    '_not_equal_scalar': lambda x, s: (x != s).astype(x.dtype),
+    '_greater_scalar': lambda x, s: (x > s).astype(x.dtype),
+    '_greater_equal_scalar': lambda x, s: (x >= s).astype(x.dtype),
+    '_lesser_scalar': lambda x, s: (x < s).astype(x.dtype),
+    '_lesser_equal_scalar': lambda x, s: (x <= s).astype(x.dtype),
+    '_logical_and_scalar': lambda x, s: ((x != 0) & (s != 0)).astype(x.dtype),
+    '_logical_or_scalar': lambda x, s: ((x != 0) | (s != 0)).astype(x.dtype),
+    '_logical_xor_scalar': lambda x, s: ((x != 0) ^ (s != 0)).astype(x.dtype),
+    '_scatter_plus_scalar': lambda x, s: x + s,
+    '_scatter_minus_scalar': lambda x, s: x - s,
+}
+
+for _name, _jfn in _SCALAR.items():
+    def _mks(jfn):
+        def _op(data, *, scalar=1.0):
+            return jfn(data, scalar)
+        return _op
+    register(_name)(_mks(_jfn))
+
+alias('_plus_scalar', '_PlusScalar')
+alias('_minus_scalar', '_MinusScalar')
+alias('_mul_scalar', '_MulScalar')
+alias('_div_scalar', '_DivScalar')
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: tensor/broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None or axis == () or axis == []:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(jfn):
+    def _op(data, *, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            if isinstance(ax, int):
+                ax = (ax,)
+            ax = tuple(i for i in range(data.ndim) if i not in
+                       tuple(a % data.ndim for a in ax))
+        return jfn(data, axis=ax, keepdims=bool(keepdims))
+    return _op
+
+
+for _name, _jfn in [('sum', jnp.sum), ('mean', jnp.mean), ('prod', jnp.prod),
+                    ('nansum', jnp.nansum), ('nanprod', jnp.nanprod),
+                    ('max', jnp.max), ('min', jnp.min)]:
+    register(_name)(_reduce(_jfn))
+
+alias('sum', 'sum_axis')
+alias('max', 'max_axis')
+alias('min', 'min_axis')
+
+
+@register('norm')
+def norm(data, *, ord=2, axis=None, keepdims=False, out_dtype=None):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        out = jnp.sum(jnp.abs(data), axis=ax, keepdims=bool(keepdims))
+    else:
+        out = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=bool(keepdims)))
+    if out_dtype is not None:
+        from ..base import np_dtype
+        out = out.astype(np_dtype(out_dtype))
+    return out
+
+
+@register('argmax')
+def argmax(data, *, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=bool(keepdims)) if axis is not None \
+        else jnp.argmax(data.reshape(-1))
+    return out.astype(jnp.float32)
+
+
+@register('argmin')
+def argmin(data, *, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=bool(keepdims)) if axis is not None \
+        else jnp.argmin(data.reshape(-1))
+    return out.astype(jnp.float32)
+
+
+@register('argmax_channel')
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# broadcast shape manipulation
+# ---------------------------------------------------------------------------
+
+@register('broadcast_to')
+def broadcast_to(data, *, shape=None):
+    shape = tuple(int(s) if int(s) != 0 else data.shape[i]
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register('broadcast_axis', aliases=('broadcast_axes',))
+def broadcast_axis(data, *, axis=None, size=None):
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    sizes = size if isinstance(size, (list, tuple)) else (size,)
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        shape[int(a)] = int(s)
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register('broadcast_like', num_inputs=2)
+def broadcast_like(lhs, rhs, *, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[int(la)] = rhs.shape[int(ra)]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+# ---------------------------------------------------------------------------
+# linear algebra entry points (reference: tensor/dot-inl.h, la_op.cc)
+# ---------------------------------------------------------------------------
+
+@register('dot', num_inputs=2)
+def dot(lhs, rhs, *, transpose_a=False, transpose_b=False,
+        forward_stype=None):
+    """Matrix/tensor product (reference: tensor/dot-inl.h).
+
+    MXNet semantics: reduce over the last axis of lhs and first axis of rhs
+    (after optional transposes). Maps onto the MXU via dot_general.
+    """
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register('batch_dot', num_inputs=2)
+def batch_dot(lhs, rhs, *, transpose_a=False, transpose_b=False,
+              forward_stype=None):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register('khatri_rao', num_inputs=-1, key_var_num_args='num_args')
+def khatri_rao(args, *, num_args=None):
+    out = args[0]
+    for m in args[1:]:
+        n = out.shape[0] * m.shape[0]
+        out = (out[:, None, :] * m[None, :, :]).reshape(n, -1)
+    return out

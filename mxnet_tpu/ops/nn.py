@@ -1,0 +1,792 @@
+"""Neural-network ops: the MXU-bound compute path.
+
+Reference parity: src/operator/nn/* (convolution.cc, fully_connected.cc,
+batch_norm.cc, layer_norm.cc, pooling, activation, softmax-inl.h, dropout),
+src/operator/rnn-inl.h (fused RNN), softmax_output.cc, sequence_*.cc
+(SURVEY.md §2.2 "NN core" / "RNN" / "Misc ops").
+
+Everything lowers to lax.dot_general / lax.conv_general_dilated /
+lax.reduce_window so XLA tiles it onto the MXU; the cuDNN/MKLDNN backend
+split of the reference collapses into XLA itself (SURVEY.md §7 table).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register, alias
+from ..base import np_dtype
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference: src/operator/nn/fully_connected.cc)
+# ---------------------------------------------------------------------------
+
+
+@register('FullyConnected', num_inputs=-1)
+def fully_connected(args, *, num_hidden=None, no_bias=False, flatten=True):
+    data, weight = args[0], args[1]
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    out = jax.lax.dot_general(
+        x, weight,
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if not no_bias:
+        out = out + args[2]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (reference: nn/convolution.cc, deconvolution.cc)
+# ---------------------------------------------------------------------------
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    return t if len(t) == n else (t + (t[-1],) * n)[:n]
+
+
+def _conv_dims(ndim):
+    # NCHW-family specs for 1/2/3 spatial dims
+    spatial = 'DHW'[3 - ndim:]
+    return ('NC' + spatial, 'OI' + spatial, 'NC' + spatial)
+
+
+@register('Convolution', num_inputs=-1)
+def convolution(args, *, kernel=None, stride=None, dilate=None, pad=None,
+                num_filter=None, num_group=1, workspace=1024, no_bias=False,
+                cudnn_tune=None, cudnn_off=False, layout=None):
+    """N-D convolution, NCHW layout (reference: nn/convolution.cc:530).
+
+    Lowers to one lax.conv_general_dilated → XLA MXU tiling; grouped and
+    depthwise conv use feature_group_count (reference's special-cased
+    depthwise_convolution*.cu path is unnecessary).
+    """
+    data, weight = args[0], args[1]
+    ndim = len(kernel)
+    strides = _tup(stride, ndim)
+    rhs_dil = _tup(dilate, ndim)
+    pads = _tup(pad, ndim) if pad is not None else (0,) * ndim
+    out = jax.lax.conv_general_dilated(
+        data, weight, window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=rhs_dil,
+        dimension_numbers=_conv_dims(ndim),
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if not no_bias:
+        bias = args[2]
+        out = out + bias.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+@register('Deconvolution', num_inputs=-1)
+def deconvolution(args, *, kernel=None, stride=None, dilate=None, pad=None,
+                  adj=None, target_shape=None, num_filter=None, num_group=1,
+                  workspace=512, no_bias=True, cudnn_tune=None,
+                  cudnn_off=False, layout=None):
+    """Transposed convolution (reference: nn/deconvolution.cc).
+
+    Implemented as the gradient of convolution: lhs-dilated conv, which XLA
+    recognises and maps to the MXU.
+    """
+    data, weight = args[0], args[1]
+    ndim = len(kernel)
+    strides = _tup(stride, ndim)
+    pads = _tup(pad, ndim) if pad is not None else (0,) * ndim
+    adjs = _tup(adj, ndim) if adj is not None else (0,) * ndim
+    dil = _tup(dilate, ndim)
+    k = tuple(int(x) for x in kernel)
+    # padding for the equivalent fractionally-strided conv
+    pad_cfg = [(dil[i] * (k[i] - 1) - pads[i],
+                dil[i] * (k[i] - 1) - pads[i] + adjs[i]) for i in range(ndim)]
+    # weight layout for deconv is (in, out/g, *k) → flip spatial, swap io
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + ndim)))
+    if int(num_group) == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        g = int(num_group)
+        ci, co = weight.shape[0], weight.shape[1]
+        w = w.reshape((g, ci // g, co) + w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape((g * co, ci // g) + w.shape[3:])
+    out = jax.lax.conv_general_dilated(
+        data, w, window_strides=(1,) * ndim, padding=pad_cfg,
+        lhs_dilation=strides, rhs_dilation=dil,
+        dimension_numbers=_conv_dims(ndim),
+        feature_group_count=int(num_group))
+    if not no_bias and len(args) > 2:
+        out = out + args[2].reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: nn/pooling.cc, nn/pool.h)
+# ---------------------------------------------------------------------------
+
+
+@register('Pooling', aliases=('Pooling_v1',))
+def pooling(data, *, kernel=None, pool_type='max', global_pool=False,
+            cudnn_off=False, pooling_convention='valid', stride=None,
+            pad=None, p_value=2, count_include_pad=True, layout=None):
+    ndim = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, 2 + ndim))
+        if pool_type == 'max':
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type == 'sum':
+            return jnp.sum(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    k = _tup(kernel, ndim)
+    s = _tup(stride, ndim) if stride is not None else (1,) * ndim
+    p = _tup(pad, ndim) if pad is not None else (0,) * ndim
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p)
+    if pooling_convention == 'full':
+        # ceil instead of floor for output dim (reference: pool.h kFull)
+        extra = []
+        for i in range(ndim):
+            in_sz = data.shape[2 + i] + 2 * p[i]
+            rem = (in_sz - k[i]) % s[i]
+            extra.append((s[i] - rem) % s[i] if rem else 0)
+        pads = ((0, 0), (0, 0)) + tuple((p[i], p[i] + extra[i]) for i in range(ndim))
+    if pool_type == 'max':
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
+    ssum = jax.lax.reduce_window(data, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
+                                 jax.lax.add, window, strides, pads)
+    if pool_type == 'sum':
+        return ssum
+    if pool_type == 'lp':
+        pw = jax.lax.reduce_window(jnp.abs(data) ** p_value, 0.0, jax.lax.add,
+                                   window, strides, pads)
+        return pw ** (1.0 / p_value)
+    # avg
+    if count_include_pad:
+        denom = 1.0
+        for kk in k:
+            denom *= kk
+        return ssum / denom
+    ones = jnp.ones_like(data)
+    cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+    return ssum / cnt
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference: nn/activation.cc, leaky_relu.cc)
+# ---------------------------------------------------------------------------
+
+
+@register('Activation')
+def activation(data, *, act_type='relu'):
+    fns = {'relu': jax.nn.relu, 'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh,
+           'softrelu': jax.nn.softplus, 'softsign': jax.nn.soft_sign}
+    return fns[act_type](data)
+
+
+@register('LeakyReLU', num_inputs=-1)
+def leaky_relu(args, *, act_type='leaky', slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    data = args[0]
+    if act_type == 'leaky' or act_type == 'rrelu':
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == 'prelu':
+        gamma = args[1]
+        if gamma.ndim == 1 and data.ndim > 1:
+            gamma = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data >= 0, data, gamma * data)
+    if act_type == 'elu':
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == 'selu':
+        a, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, a * jnp.expm1(data))
+    if act_type == 'gelu':
+        return jax.nn.gelu(data, approximate=False)
+    raise ValueError('unknown act_type %s' % act_type)
+
+
+@register('softmax')
+def softmax(data, *, axis=-1, temperature=None, dtype=None, length=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    out = jax.nn.softmax(x, axis=int(axis))
+    return out.astype(np_dtype(dtype)) if dtype else out
+
+
+@register('log_softmax')
+def log_softmax(data, *, axis=-1, temperature=None, dtype=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    out = jax.nn.log_softmax(x, axis=int(axis))
+    return out.astype(np_dtype(dtype)) if dtype else out
+
+
+@register('softmin')
+def softmin(data, *, axis=-1, temperature=None, dtype=None):
+    x = -data if temperature in (None, 1.0) else -data / temperature
+    out = jax.nn.softmax(x, axis=int(axis))
+    return out.astype(np_dtype(dtype)) if dtype else out
+
+
+@register('SoftmaxActivation')
+def softmax_activation(data, *, mode='instance'):
+    if mode == 'channel':
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+# ---------------------------------------------------------------------------
+# Classic output/loss heads with custom backward semantics
+# (reference: softmax_output.cc, regression_output.cc — these ops' backward
+# is the *loss gradient*, not the autodiff of their forward; custom_vjp
+# reproduces that contract.)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                    multi_output, normalization):
+    if multi_output:
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization):
+    out = _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
+                          multi_output, normalization)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output,
+                        normalization, res, g):
+    out, label = res
+    axis = 1 if multi_output else -1
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, out.shape[axis], dtype=out.dtype, axis=axis)
+    grad = out - onehot
+    valid = jnp.ones(lab.shape, dtype=out.dtype)
+    if use_ignore:
+        valid = (lab != int(ignore_label)).astype(out.dtype)
+        grad = grad * jnp.expand_dims(valid, axis) if multi_output else \
+            grad * valid[..., None]
+    scale = grad_scale
+    if normalization == 'valid':
+        scale = scale / jnp.maximum(valid.sum(), 1.0)
+    elif normalization == 'batch':
+        scale = scale / lab.shape[0]
+    return (grad * scale).astype(out.dtype), jnp.zeros_like(label)
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register('SoftmaxOutput', num_inputs=2, aliases=('Softmax',))
+def softmax_output(data, label, *, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization='null', out_grad=False,
+                   smooth_alpha=0.0):
+    return _softmax_output(data, label, float(grad_scale), float(ignore_label),
+                           bool(use_ignore), bool(multi_output), normalization)
+
+
+def _make_regression(link, grad_fn, name):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def _fn(data, label, grad_scale):
+        return link(data)
+
+    def _fwd(data, label, grad_scale):
+        return link(data), (link(data), label)
+
+    def _bwd(grad_scale, res, g):
+        out, label = res
+        num = 1
+        for s in out.shape[1:]:
+            num *= s
+        grad = grad_fn(out, label) * (grad_scale / num)
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    _fn.defvjp(_fwd, _bwd)
+
+    @register(name, num_inputs=2)
+    def _op(data, label, *, grad_scale=1.0):
+        return _fn(data, label.reshape(data.shape), float(grad_scale))
+    return _op
+
+
+_make_regression(lambda x: x, lambda o, l: o - l, 'LinearRegressionOutput')
+_make_regression(lambda x: x, lambda o, l: jnp.sign(o - l), 'MAERegressionOutput')
+_make_regression(jax.nn.sigmoid, lambda o, l: o - l, 'LogisticRegressionOutput')
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg_coef, use_linear, res, g):
+    data, label = res
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, data.shape[-1], dtype=data.dtype)
+    y = 2 * onehot - 1  # +1 for target class, -1 otherwise
+    viol = (margin - y * data) > 0
+    if use_linear:
+        grad = jnp.where(viol, -y * reg_coef, 0.0)
+    else:
+        grad = jnp.where(viol, -2 * (margin - y * data) * y * reg_coef, 0.0)
+    return grad.astype(data.dtype), jnp.zeros_like(label)
+
+
+_svm_output.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register('SVMOutput', num_inputs=2)
+def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    return _svm_output(data, label, float(margin),
+                       float(regularization_coefficient), bool(use_linear))
+
+
+@register('softmax_cross_entropy', num_inputs=2)
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return nll.sum()
+
+
+# ---------------------------------------------------------------------------
+# Normalization (reference: nn/batch_norm.cc, layer_norm.cc, instance_norm,
+# l2_normalization.cc, lrn.cc)
+# ---------------------------------------------------------------------------
+
+
+@register('BatchNorm', num_inputs=5, num_outputs=3, aliases=('BatchNorm_v1',))
+def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False,
+               min_calib_range=None, max_calib_range=None, training=True):
+    """BatchNorm (reference: nn/batch_norm.cc).
+
+    Pure-functional: returns (out, mean, var); the frontend layer owns the
+    moving-average update (the reference mutates aux states in the op;
+    FMutateInputs parity is handled in gluon.nn.BatchNorm / the eager
+    wrapper's mutate hook).
+    """
+    ax = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    inv = jax.lax.rsqrt(var + eps).reshape(shape)
+    out = (data - mean.reshape(shape)) * inv * g.reshape(shape) + beta.reshape(shape)
+    return out.astype(data.dtype), mean, var
+
+
+@register('LayerNorm', num_inputs=3)
+def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    ax = int(axis) % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register('InstanceNorm', num_inputs=3)
+def instance_norm(data, gamma, beta, *, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register('L2Normalization')
+def l2_normalization(data, *, eps=1e-10, mode='instance'):
+    if mode == 'instance':
+        red = tuple(range(1, data.ndim))
+    elif mode == 'channel':
+        red = (1,)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / nrm
+
+
+@register('LRN')
+def lrn(data, *, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    sq = jnp.square(data)
+    half = int(nsize) // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(padded[:, i:i + data.shape[1]] for i in range(int(nsize)))
+    return data / jnp.power(knorm + alpha / nsize * acc, beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout / Embedding
+# ---------------------------------------------------------------------------
+
+
+@register('Dropout', needs_rng=True)
+def dropout(key, data, *, p=0.5, mode='training', axes=None,
+            cudnn_off=False, training=True):
+    if not training or p <= 0:
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(data.shape[i] if i in tuple(axes) else data.shape[i]
+                      for i in range(data.ndim))
+        shape = tuple(1 if i not in tuple(a % data.ndim for a in axes) else data.shape[i]
+                      for i in range(data.ndim)) if axes else data.shape
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype)
+    return data * mask / keep
+
+
+@register('Embedding', num_inputs=2)
+def embedding(data, weight, *, input_dim=None, output_dim=None,
+              dtype='float32', sparse_grad=False):
+    """Embedding lookup (reference: indexing_op.cc Embedding).
+
+    take() on the MXU-resident table; sparse_grad accepted for API compat
+    (XLA scatter handles the gradient).
+    """
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (reference: sequence_mask.cc, sequence_last.cc,
+# sequence_reverse.cc — layout TNC, axis 0 = time)
+# ---------------------------------------------------------------------------
+
+
+def _seq_mask_arr(lengths, maxlen, dtype):
+    t = jnp.arange(maxlen, dtype=jnp.float32)[:, None]
+    return (t < lengths.astype(jnp.float32)[None, :]).astype(dtype)
+
+
+@register('SequenceMask', num_inputs=-1)
+def sequence_mask(args, *, use_sequence_length=False, value=0.0, axis=0):
+    data = args[0]
+    if not use_sequence_length:
+        return data
+    seqlen = args[1]
+    ax = int(axis)
+    t_ax = ax  # time axis
+    b_ax = 1 - ax
+    mask = _seq_mask_arr(seqlen, data.shape[t_ax], data.dtype)
+    if ax == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return data * mask + value * (1 - mask)
+
+
+@register('SequenceLast', num_inputs=-1)
+def sequence_last(args, *, use_sequence_length=False, axis=0):
+    data = args[0]
+    ax = int(axis)
+    if not use_sequence_length:
+        return jnp.take(data, data.shape[ax] - 1, axis=ax)
+    seqlen = args[1].astype(jnp.int32)
+    idx = jnp.clip(seqlen - 1, 0, data.shape[ax] - 1)
+    moved = jnp.moveaxis(data, ax, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, idx.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+@register('SequenceReverse', num_inputs=-1)
+def sequence_reverse(args, *, use_sequence_length=False, axis=0):
+    data = args[0]
+    if not use_sequence_length:
+        return jnp.flip(data, axis=0)
+    seqlen = args[1].astype(jnp.int32)
+    T = data.shape[0]
+    t = jnp.arange(T)[:, None]
+    lens = seqlen[None, :]
+    src = jnp.where(t < lens, lens - 1 - t, t)  # reverse first len steps
+    src = src.reshape((T, -1) + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (reference: rnn-inl.h RNNParam modes rnn_relu/rnn_tanh/lstm/gru;
+# cuDNN-backed on GPU). TPU-native: lax.scan over time with one fused
+# gate matmul per step — weights packed in cuDNN layout so Gluon layers and
+# checkpoints interoperate.
+# ---------------------------------------------------------------------------
+
+
+def _gates(mode):
+    return {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4, 'gru': 3}[mode]
+
+
+def _rnn_unpack_params(params, mode, num_layers, input_size, state_size,
+                       bidirectional, proj_size=None):
+    """Slice the flat cuDNN-layout parameter vector into per-layer weights.
+
+    Layout (reference rnn_impl.h / cuDNN): for each layer, for each
+    direction: W_i2h (G*H, in), W_h2h (G*H, H); then all biases in the same
+    order: b_i2h (G*H,), b_h2h (G*H,).
+    """
+    G = _gates(mode)
+    D = 2 if bidirectional else 1
+    H = state_size
+    off = 0
+    Ws, Bs = [], []
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        layer_w = []
+        for _ in range(D):
+            w_i2h = jax.lax.dynamic_slice_in_dim(params, off, G * H * in_sz).reshape(G * H, in_sz)
+            off += G * H * in_sz
+            w_h2h = jax.lax.dynamic_slice_in_dim(params, off, G * H * H).reshape(G * H, H)
+            off += G * H * H
+            layer_w.append((w_i2h, w_h2h))
+        Ws.append(layer_w)
+    for layer in range(num_layers):
+        layer_b = []
+        for _ in range(D):
+            b_i2h = jax.lax.dynamic_slice_in_dim(params, off, G * H)
+            off += G * H
+            b_h2h = jax.lax.dynamic_slice_in_dim(params, off, G * H)
+            off += G * H
+            layer_b.append((b_i2h, b_h2h))
+        Bs.append(layer_b)
+    return Ws, Bs
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional):
+    G = _gates(mode)
+    D = 2 if bidirectional else 1
+    H = state_size
+    n = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        n += D * (G * H * in_sz + G * H * H + 2 * G * H)
+    return n
+
+
+def _cell_step(mode, carry, xw, w_h2h, b_h2h):
+    """One timestep; xw = x @ W_i2h.T + b_i2h precomputed for all t."""
+    H = w_h2h.shape[1]
+    if mode == 'lstm':
+        h, c = carry
+        gates = xw + h @ w_h2h.T + b_h2h
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+    if mode == 'gru':
+        h = carry[0]
+        hw = h @ w_h2h.T + b_h2h
+        xr, xz, xn = jnp.split(xw, 3, axis=-1)
+        hr, hz, hn = jnp.split(hw, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h = (1 - z) * n + z * h
+        return (h,), h
+    h = carry[0]
+    act = jnp.tanh if mode == 'rnn_tanh' else jax.nn.relu
+    h = act(xw + h @ w_h2h.T + b_h2h)
+    return (h,), h
+
+
+def _run_direction(mode, x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, reverse):
+    # x: (T, B, in). Precompute the input projection as one big matmul (MXU).
+    xw = jnp.einsum('tbi,gi->tbg', x, w_i2h) + b_i2h
+
+    def step(carry, xw_t):
+        return _cell_step(mode, carry, xw_t, w_h2h, b_h2h)
+
+    carry = (h0, c0) if mode == 'lstm' else (h0,)
+    carry, ys = jax.lax.scan(step, carry, xw, reverse=reverse)
+    return carry, ys
+
+
+@register('RNN', num_inputs=-1)
+def rnn(args, *, state_size=None, num_layers=1, bidirectional=False,
+        mode='lstm', p=0.0, state_outputs=True, projection_size=None,
+        lstm_state_clip_min=None, lstm_state_clip_max=None,
+        lstm_state_clip_nan=False, use_sequence_length=False):
+    """Fused multi-layer (bi)RNN (reference: src/operator/rnn-inl.h:54-163).
+
+    inputs: data (T,B,I), parameters (flat), state (L*D,B,H)[, state_cell].
+    outputs: out (T,B,H*D)[, state][, state_cell].
+    """
+    data, params, state = args[0], args[1], args[2]
+    state_cell = args[3] if mode == 'lstm' and len(args) > 3 else None
+    T, B, I = data.shape
+    H = int(state_size)
+    L = int(num_layers)
+    D = 2 if bidirectional else 1
+    Ws, Bs = _rnn_unpack_params(params, mode, L, I, H, bidirectional)
+    x = data
+    out_h, out_c = [], []
+    for layer in range(L):
+        ys = []
+        for d in range(D):
+            li = layer * D + d
+            h0 = state[li]
+            c0 = state_cell[li] if state_cell is not None else None
+            (w_i2h, w_h2h) = Ws[layer][d]
+            (b_i2h, b_h2h) = Bs[layer][d]
+            carry, y = _run_direction(mode, x, h0, c0, w_i2h, w_h2h,
+                                      b_i2h, b_h2h, reverse=(d == 1))
+            ys.append(y)
+            out_h.append(carry[0])
+            if mode == 'lstm':
+                out_c.append(carry[1])
+        x = jnp.concatenate(ys, axis=-1) if D == 2 else ys[0]
+    outputs = (x,)
+    if state_outputs:
+        outputs = outputs + (jnp.stack(out_h, axis=0),)
+        if mode == 'lstm':
+            outputs = outputs + (jnp.stack(out_c, axis=0),)
+    return outputs if len(outputs) > 1 else outputs[0]
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/nn/ctc_loss.cc / warpctc plugin)
+# ---------------------------------------------------------------------------
+
+
+@register('CTCLoss', num_inputs=-1, aliases=('ctc_loss', '_contrib_CTCLoss',
+                                             '_contrib_ctc_loss'))
+def ctc_loss(args, *, use_data_lengths=False, use_label_lengths=False,
+             blank_label='first'):
+    """CTC loss via optax (alpha-beta recursion under lax.scan).
+
+    data: (T, B, C) unnormalized activations; label: (B, L) padded with 0
+    (blank_label='first') — reference semantics from nn/ctc_loss.cc.
+    """
+    import optax
+    data, label = args[0], args[1]
+    T, B, C = data.shape
+    i = 2
+    if use_data_lengths:
+        data_len = args[i].astype(jnp.int32); i += 1
+    else:
+        data_len = jnp.full((B,), T, dtype=jnp.int32)
+    if use_label_lengths:
+        label_len = args[i].astype(jnp.int32)
+    else:
+        label_len = jnp.sum(label != 0, axis=-1).astype(jnp.int32)
+    logits = jnp.swapaxes(data, 0, 1)  # (B, T, C)
+    t = jnp.arange(T)[None, :]
+    logit_pad = (t >= data_len[:, None]).astype(logits.dtype)
+    lab = label.astype(jnp.int32)
+    if blank_label == 'first':
+        blank_id = 0
+    else:
+        blank_id = C - 1
+    l = jnp.arange(lab.shape[1])[None, :]
+    label_pad = (l >= label_len[:, None]).astype(logits.dtype)
+    loss = optax.ctc_loss(logits, logit_pad, lab, label_pad, blank_id=blank_id)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / misc spatial
+# ---------------------------------------------------------------------------
+
+
+@register('UpSampling', num_inputs=-1, key_var_num_args='num_args')
+def upsampling(args, *, scale=1, sample_type='nearest', num_args=1,
+               num_filter=0, multi_input_mode='concat', workspace=512):
+    s = int(scale)
+    outs = []
+    for data in args:
+        if sample_type == 'nearest':
+            out = jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+        else:
+            n, c, h, w = data.shape
+            out = jax.image.resize(data, (n, c, h * s, w * s), method='bilinear')
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == 'sum':
+        return sum(outs)
+    return jnp.concatenate(outs, axis=1)
+
+
+@register('GridGenerator')
+def grid_generator(data, *, transform_type='affine', target_shape=None):
+    h, w = int(target_shape[0]), int(target_shape[1])
+    if transform_type == 'affine':
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        grid = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], axis=0)
+        out = jnp.einsum('nij,jk->nik', theta, grid)
+        return out.reshape(n, 2, h, w)
+    return data  # warp type: data is already the flow field
+
+
+@register('BilinearSampler', num_inputs=2)
+def bilinear_sampler(data, grid, *, cudnn_off=False):
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2
+    gy = (grid[:, 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = gx - x0; wx0 = 1 - wx1
+    wy1 = gy - y0; wy0 = 1 - wy1
+
+    def sample(xi, yi):
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+        flat = data.reshape(n, c, h * w)
+        idx = (yi_c * w + xi_c).reshape(n, 1, -1)
+        got = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        got = got.reshape(n, c, *gx.shape[1:])
+        return got * valid[:, None].astype(data.dtype)
+
+    out = (sample(x0, y0) * (wx0 * wy0)[:, None]
+           + sample(x1, y0) * (wx1 * wy0)[:, None]
+           + sample(x0, y1) * (wx0 * wy1)[:, None]
+           + sample(x1, y1) * (wx1 * wy1)[:, None])
+    return out.astype(data.dtype)
+
+
+@register('SpatialTransformer', num_inputs=2)
+def spatial_transformer(data, loc, *, target_shape=None,
+                        transform_type='affine', sampler_type='bilinear',
+                        cudnn_off=False):
+    grid = grid_generator(loc, transform_type=transform_type,
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register('IdentityAttachKLSparseReg')
+def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    return data

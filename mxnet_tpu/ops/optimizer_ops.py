@@ -1,0 +1,258 @@
+"""Fused optimizer update ops (reference: src/operator/optimizer_op.cc —
+sgd_update:506, sgd_mom_update:533, mp_sgd*:587, multi_sgd*:318-449,
+signsgd:45, ftml:622, adam:654, rmsprop:708, ftrl:799, adagrad:840;
+contrib/adamw.cc, contrib/optimizer_op.cc group_adagrad).
+
+The reference registers updates as mutating engine ops so the optimizer math
+fuses into one kernel; here each is one pure jitted function — XLA fuses it
+into a single HBM pass. The eager frontend applies mutate_idx so
+``sgd_update(w, g, out=w)`` semantics match (weights updated in place from
+the user's view).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd and weight is not None:
+        g = g + wd * weight
+    return g
+
+
+@register('sgd_update', num_inputs=2, mutate_idx=(0,))
+def sgd_update(weight, grad, *, lr=None, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+@register('sgd_mom_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
+def sgd_mom_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register('mp_sgd_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
+def mp_sgd_update(weight, grad, weight32, *, lr=None, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """fp16/bf16 weights with fp32 master copy (reference: mp_sgd_update:587)."""
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                      wd, weight32)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register('mp_sgd_mom_update', num_inputs=4, num_outputs=3,
+          mutate_idx=(0, 2, 3))
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr=None, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                      wd, weight32)
+    new_mom = momentum * mom - lr * g
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register('signsgd_update', num_inputs=2, mutate_idx=(0,))
+def signsgd_update(weight, grad, *, lr=None, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register('signum_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
+def signum_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return w, new_mom
+
+
+@register('adam_update', num_inputs=4, num_outputs=3, mutate_idx=(0, 2, 3))
+def adam_update(weight, grad, mean, var, *, lr=None, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * m / (jnp.sqrt(v) + epsilon)
+    return w, m, v
+
+
+@register('_adamw_update', num_inputs=5, num_outputs=3, mutate_idx=(0, 2, 3),
+          aliases=('_contrib_adamw_update',))
+def adamw_update(weight, grad, mean, var, rescale_grad_t, *, lr=None,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1.0):
+    """AdamW with decoupled weight decay (reference: contrib/adamw.cc —
+    the BERT-pretraining optimizer)."""
+    g = grad * rescale_grad_t
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
+    return w, m, v
+
+
+@register('_mp_adamw_update', num_inputs=6, num_outputs=4,
+          mutate_idx=(0, 2, 3, 4), aliases=('_contrib_mp_adamw_update',))
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t, *,
+                    lr=None, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    eta=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad_t
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight32)
+    return w32.astype(weight.dtype), m, v, w32
+
+
+@register('ftml_update', num_inputs=5, num_outputs=4,
+          mutate_idx=(0, 2, 3, 4))
+def ftml_update(weight, grad, d, v, z, *, lr=None, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_grad, wd, weight)
+    v_t = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(v_t / (1 - beta2 ** t)) + epsilon)
+    sigma_t = d_t - beta1 * d
+    z_t = beta1 * z + (1 - beta1) * g - sigma_t * weight
+    w = -z_t / d_t
+    return w, d_t, v_t, z_t
+
+
+@register('rmsprop_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
+def rmsprop_update(weight, grad, n, *, lr=None, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    n_t = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n_t + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_t
+
+
+@register('rmspropalex_update', num_inputs=5, num_outputs=4,
+          mutate_idx=(0, 2, 3, 4))
+def rmspropalex_update(weight, grad, n, g_acc, delta, *, lr=None, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    n_t = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    g_t = gamma1 * g_acc + (1 - gamma1) * g
+    delta_t = gamma2 * delta - lr * g / jnp.sqrt(n_t - jnp.square(g_t) + epsilon)
+    w = weight + delta_t
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_t, g_t, delta_t
+
+
+@register('ftrl_update', num_inputs=4, num_outputs=3, mutate_idx=(0, 2, 3))
+def ftrl_update(weight, grad, z, n, *, lr=None, lamda1=0.01, beta=1.0,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    n_t = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_t) - jnp.sqrt(n)) / lr
+    z_t = z + g - sigma * weight
+    w = jnp.where(jnp.abs(z_t) > lamda1,
+                  -(z_t - jnp.sign(z_t) * lamda1) /
+                  ((beta + jnp.sqrt(n_t)) / lr + wd), 0.0)
+    return w, z_t, n_t
+
+
+@register('_sparse_adagrad_update', num_inputs=3, num_outputs=2,
+          mutate_idx=(0, 2), aliases=('adagrad_update',))
+def adagrad_update(weight, grad, history, *, lr=None, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    h = history + jnp.square(g)
+    w = weight - lr * g / (jnp.sqrt(h) + epsilon)
+    return w, h
+
+
+@register('_contrib_group_adagrad_update', num_inputs=3, num_outputs=2,
+          mutate_idx=(0, 2))
+def group_adagrad_update(weight, grad, history, *, lr=None, epsilon=1e-5,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    red = tuple(range(1, g.ndim))
+    h = history + jnp.mean(jnp.square(g), axis=red, keepdims=True) if g.ndim > 1 \
+        else history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(h) + epsilon), h
+
+
+# multi-tensor fused updates (reference: multi_sgd_update:318 — N weights in
+# one op; here one jitted call over the whole list, XLA fuses)
+
+def _multi(fn):
+    def _op(args, *, num_weights=None, lrs=None, wds=None, **kw):
+        n = int(num_weights)
+        per = len(args) // n
+        outs = []
+        for i in range(n):
+            group = args[i * per:(i + 1) * per]
+            outs.extend(_as_tuple(fn(group, lrs[i], wds[i], **kw)))
+        return tuple(outs)
+    return _op
+
+
+def _as_tuple(x):
+    return x if isinstance(x, tuple) else (x,)
+
+
+@register('multi_sgd_update', num_inputs=-1, num_outputs=-1,
+          key_var_num_args='num_weights')
+def multi_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
+                     rescale_grad=1.0, clip_gradient=-1.0):
+    return _multi(lambda g, lr, wd, **kw: sgd_update(
+        g[0], g[1], lr=lr, wd=wd, **kw))(args, num_weights=num_weights,
+                                         lrs=lrs, wds=wds,
+                                         rescale_grad=rescale_grad,
+                                         clip_gradient=clip_gradient)
+
+
+@register('multi_sgd_mom_update', num_inputs=-1, num_outputs=-1,
+          key_var_num_args='num_weights')
+def multi_sgd_mom_update(args, *, num_weights=None, lrs=None, wds=None,
+                         momentum=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    return _multi(lambda g, lr, wd, **kw: sgd_mom_update(
+        g[0], g[1], g[2], lr=lr, wd=wd, **kw))(
+            args, num_weights=num_weights, lrs=lrs, wds=wds,
+            momentum=momentum, rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+
+
+@register('multi_mp_sgd_update', num_inputs=-1, num_outputs=-1,
+          key_var_num_args='num_weights')
+def multi_mp_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    return _multi(lambda g, lr, wd, **kw: mp_sgd_update(
+        g[0], g[1], g[2], lr=lr, wd=wd, **kw))(
+            args, num_weights=num_weights, lrs=lrs, wds=wds,
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+
+
+@register('multi_mp_sgd_mom_update', num_inputs=-1, num_outputs=-1,
+          key_var_num_args='num_weights')
+def multi_mp_sgd_mom_update(args, *, num_weights=None, lrs=None, wds=None,
+                            momentum=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0):
+    return _multi(lambda g, lr, wd, **kw: mp_sgd_mom_update(
+        g[0], g[1], g[2], g[3], lr=lr, wd=wd, **kw))(
+            args, num_weights=num_weights, lrs=lrs, wds=wds,
+            momentum=momentum, rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)

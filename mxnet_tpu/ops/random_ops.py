@@ -1,0 +1,166 @@
+"""Random sampling ops (reference: src/operator/random/sample_op.cc,
+multisample_op.cc, sample_multinomial_op.cc, unique_sample_op.cc).
+
+JAX's counter-based PRNG replaces the reference's per-device philox/curand
+resource pool (include/mxnet/random_generator.h; SURVEY.md §2.2 "Random").
+Each op takes an explicit key as its leading array input (needs_rng=True);
+the eager frontend splits keys from the global seeded state
+(mxnet_tpu.random.seed parity), the jit path threads keys explicitly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+from ..base import np_dtype
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _reg_sampler(name, sample_fn, like_too=True):
+    @register('_random_%s' % name, num_inputs=0, needs_rng=True,
+              aliases=(name,) if name not in ('randint',) else ())
+    def _op(key, *, shape=None, ctx=None, dtype='float32', **kw):
+        return sample_fn(key, _shape(shape), np_dtype(dtype or 'float32'), kw)
+
+    if like_too:
+        @register('_random_%s_like' % name, num_inputs=1, needs_rng=True)
+        def _op_like(key, data, **kw):
+            return sample_fn(key, data.shape, data.dtype, kw)
+
+
+_reg_sampler('uniform', lambda key, shp, dt, kw: jax.random.uniform(
+    key, shp, dt, minval=kw.get('low', 0.0), maxval=kw.get('high', 1.0)))
+_reg_sampler('normal', lambda key, shp, dt, kw: kw.get('loc', 0.0) +
+             kw.get('scale', 1.0) * jax.random.normal(key, shp, dt))
+_reg_sampler('gamma', lambda key, shp, dt, kw: jax.random.gamma(
+    key, kw.get('alpha', 1.0), shp, dt) * kw.get('beta', 1.0))
+_reg_sampler('exponential', lambda key, shp, dt, kw: jax.random.exponential(
+    key, shp, dt) / kw.get('lam', 1.0))
+_reg_sampler('poisson', lambda key, shp, dt, kw: jax.random.poisson(
+    key, kw.get('lam', 1.0), shp).astype(dt))
+_reg_sampler('negative_binomial', lambda key, shp, dt, kw: _neg_binom(
+    key, kw.get('k', 1), kw.get('p', 1.0), shp, dt))
+_reg_sampler('generalized_negative_binomial', lambda key, shp, dt, kw:
+             _gen_neg_binom(key, kw.get('mu', 1.0), kw.get('alpha', 1.0), shp, dt))
+
+alias('_random_normal', 'normal', '_sample_normal_like')
+alias('_random_uniform', 'uniform')
+alias('_random_gamma', 'gamma')
+alias('_random_exponential', 'exponential')
+alias('_random_poisson', 'poisson')
+alias('_random_negative_binomial', 'negative_binomial')
+alias('_random_generalized_negative_binomial', 'generalized_negative_binomial')
+
+
+def _neg_binom(key, k, p, shape, dtype):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(dtype)
+
+
+def _gen_neg_binom(key, mu, alpha, shape, dtype):
+    k1, k2 = jax.random.split(key)
+    if alpha == 0:
+        return jax.random.poisson(k1, mu, shape).astype(dtype)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(dtype)
+
+
+@register('_random_randint', num_inputs=0, needs_rng=True,
+          aliases=('randint',))
+def randint(key, *, low=0, high=None, shape=None, ctx=None, dtype='int32'):
+    return jax.random.randint(key, _shape(shape), int(low), int(high),
+                              dtype=np_dtype(dtype or 'int32'))
+
+
+# -- per-row parameterized samplers (reference: multisample_op.cc) -----------
+
+def _reg_multisample(name, fn, nparam):
+    @register('_sample_%s' % name, num_inputs=nparam, needs_rng=True)
+    def _op(key, *params, shape=None, dtype='float32'):
+        shp = _shape(shape)
+        p0 = params[0]
+        full = p0.shape + shp
+        bshape = p0.shape + (1,) * len(shp)
+        ps = [p.reshape(bshape) for p in params]
+        return fn(key, full, np_dtype(dtype or 'float32'), *ps)
+
+
+_reg_multisample('uniform', lambda key, shp, dt, lo, hi:
+                 lo + (hi - lo) * jax.random.uniform(key, shp, dt), 2)
+_reg_multisample('normal', lambda key, shp, dt, mu, sigma:
+                 mu + sigma * jax.random.normal(key, shp, dt), 2)
+_reg_multisample('gamma', lambda key, shp, dt, alpha, beta:
+                 jax.random.gamma(key, alpha, shp, dt) * beta, 2)
+_reg_multisample('exponential', lambda key, shp, dt, lam:
+                 jax.random.exponential(key, shp, dt) / lam, 1)
+_reg_multisample('poisson', lambda key, shp, dt, lam:
+                 jax.random.poisson(key, lam * jnp.ones(shp)).astype(dt), 1)
+_reg_multisample('negative_binomial', lambda key, shp, dt, k, p:
+                 _neg_binom_b(key, k, p, shp, dt), 2)
+_reg_multisample('generalized_negative_binomial', lambda key, shp, dt, mu, alpha:
+                 _gen_neg_binom_b(key, mu, alpha, shp, dt), 2)
+
+
+def _neg_binom_b(key, k, p, shape, dtype):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k * jnp.ones(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(dtype)
+
+
+def _gen_neg_binom_b(key, mu, alpha, shape, dtype):
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / jnp.maximum(alpha, 1e-12)
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r * jnp.ones(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(dtype)
+
+
+@register('_sample_multinomial', num_inputs=1, needs_rng=True,
+          aliases=('sample_multinomial',), num_outputs=-1)
+def sample_multinomial(key, data, *, shape=None, get_prob=False,
+                       dtype='int32'):
+    shp = _shape(shape)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    n = 1
+    for s in shp:
+        n *= s
+    n = max(n, 1)
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,)).reshape(shp or ())
+    else:
+        keys = jax.random.split(key, data.shape[0])
+        out = jax.vmap(lambda k, lg: jax.random.categorical(k, lg, shape=(n,)))(
+            keys, logits)
+        out = out.reshape((data.shape[0],) + (shp or ()))
+    out = out.astype(np_dtype(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1).reshape(-1, logits.shape[-1]),
+            out.reshape(logits.shape[0] if data.ndim > 1 else 1, -1).astype(jnp.int32).reshape(-1, n),
+            axis=-1).reshape(out.shape)
+        return out, lp
+    return out
+
+
+@register('_sample_unique_zipfian', num_inputs=0, needs_rng=True,
+          num_outputs=2)
+def sample_unique_zipfian(key, *, range_max=None, shape=None):
+    shp = _shape(shape)
+    n = shp[-1] if shp else 1
+    # approximate zipfian via log-uniform as the reference does
+    u = jax.random.uniform(key, (int(n * 2),))
+    cand = (jnp.exp(u * jnp.log(float(range_max))) - 1).astype(jnp.int64)
+    uniq = jnp.unique(cand, size=n, fill_value=0)
+    cnt = jnp.ones((n,), dtype=jnp.int64)
+    return uniq.reshape(shp), cnt.reshape(shp)

@@ -1,0 +1,102 @@
+"""Operator registry — TPU-native replacement for the reference's NNVM op
+registry (reference: src/operator registration via NNVM_REGISTER_OP, 649 ops;
+python wrappers code-generated at import by python/mxnet/ndarray/register.py).
+
+Design: each op is a *pure JAX function* ``fn(*arrays, **attrs) -> array |
+tuple`` where arrays are jax.Arrays (or tracers) and attrs are static Python
+values. There is no separate FGradient: gradients come from ``jax.vjp`` over
+the pure function, so every registered op is differentiable for free (the
+reference hand-writes ~326 _backward_* ops; here autodiff replaces them —
+SURVEY.md Appendix A).
+
+The registry drives three frontends, mirroring the reference's codegen:
+  * mxnet_tpu.ndarray.op — eager wrappers over NDArray (register.py analog)
+  * mxnet_tpu.symbol.op — lazy graph-node builders
+  * direct functional use on raw jax arrays (the jit/pjit path)
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ['Operator', 'register', 'get', 'list_ops', 'alias', 'OPS']
+
+OPS = {}
+
+
+class Operator:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name : canonical op name (MXNet-compatible, e.g. "FullyConnected").
+    fn : pure function (*arrays, **attrs) -> array or tuple of arrays.
+    num_inputs : number of positional array inputs; -1 = variadic
+        (first arg is then a list of arrays, e.g. add_n / Concat).
+    num_outputs : static number of outputs (1 for most).
+    key_var_num_args : attr name that carries the variadic count
+        (reference: num_args for Concat/add_n).
+    needs_rng : op consumes a PRNG key as leading array argument (dropout,
+        random samplers). The eager frontend supplies one from the global
+        random state; the jit frontend threads keys explicitly.
+    mutate_idx : indices of inputs that the *eager* frontend should update
+        in place with the corresponding output (optimizer update ops);
+        pure fn itself never mutates (FMutateInputs parity).
+    """
+
+    __slots__ = ('name', 'fn', 'num_inputs', 'num_outputs', 'key_var_num_args',
+                 'needs_rng', 'mutate_idx', 'doc', 'attr_names')
+
+    def __init__(self, name, fn, num_inputs=1, num_outputs=1,
+                 key_var_num_args=None, needs_rng=False, mutate_idx=(), doc=None):
+        self.name = name
+        self.fn = fn
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.key_var_num_args = key_var_num_args
+        self.needs_rng = needs_rng
+        self.mutate_idx = tuple(mutate_idx)
+        self.doc = doc or (fn.__doc__ if fn else None)
+        try:
+            sig = inspect.signature(fn)
+            self.attr_names = [p.name for p in sig.parameters.values()
+                               if p.kind == inspect.Parameter.KEYWORD_ONLY]
+        except (TypeError, ValueError):
+            self.attr_names = []
+
+    def bind_attrs(self, **attrs):
+        """Partially apply static attrs, returning a unary-on-arrays fn."""
+        if not attrs:
+            return self.fn
+        return functools.partial(self.fn, **attrs)
+
+    def __repr__(self):
+        return 'Operator(%s)' % self.name
+
+
+def register(name, num_inputs=1, num_outputs=1, key_var_num_args=None,
+             needs_rng=False, mutate_idx=(), aliases=()):
+    """Decorator registering a pure jax function as a framework op."""
+    def _reg(fn):
+        op = Operator(name, fn, num_inputs=num_inputs, num_outputs=num_outputs,
+                      key_var_num_args=key_var_num_args, needs_rng=needs_rng,
+                      mutate_idx=mutate_idx)
+        OPS[name] = op
+        for al in aliases:
+            OPS[al] = op
+        return fn
+    return _reg
+
+
+def alias(existing, *names):
+    op = OPS[existing]
+    for n in names:
+        OPS[n] = op
+
+
+def get(name):
+    return OPS[name]
+
+
+def list_ops():
+    return sorted(OPS.keys())

@@ -1,0 +1,463 @@
+"""Shape-manipulation, indexing, init and ordering ops.
+
+Reference parity: src/operator/tensor/matrix_op*.cc (1,224 LoC),
+indexing_op.cc, init_op.cc, ordering_op.cc, histogram, diag, ravel
+(SURVEY.md §2.2 "Tensor ops").
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register, alias
+from ..base import np_dtype
+
+# ---------------------------------------------------------------------------
+# reshape / transpose family (reference: matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _infer_reshape(src_shape, target):
+    """Implement MXNet's extended reshape codes 0,-1,-2,-3,-4
+    (reference: matrix_op-inl.h ReshapeShape)."""
+    src = list(src_shape)
+    out = []
+    i = 0  # index into src
+    t = list(target)
+    j = 0
+    while j < len(t):
+        d = int(t[j])
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            d1, d2 = int(t[j + 1]), int(t[j + 2])
+            cur = src[i]; i += 1
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); j += 2
+        else:
+            out.append(d); i += 1
+        j += 1
+    # resolve single -1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src_shape:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register('Reshape', aliases=('reshape',))
+def reshape(data, *, shape=None, reverse=False, target_shape=None,
+            keep_highest=False):
+    if target_shape is not None and shape is None:
+        shape = target_shape
+    if reverse:
+        newshape = _infer_reshape(data.shape[::-1], list(shape)[::-1])[::-1]
+    else:
+        newshape = _infer_reshape(data.shape, shape)
+    return jnp.reshape(data, newshape)
+
+
+@register('Flatten', aliases=('flatten',))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register('transpose')
+def transpose(data, *, axes=None):
+    if axes is None or len(axes) == 0:
+        return jnp.transpose(data)
+    return jnp.transpose(data, tuple(int(a) for a in axes))
+
+
+@register('SwapAxis', aliases=('swapaxes',))
+def swapaxes(data, *, dim1=0, dim2=0):
+    return jnp.swapaxes(data, int(dim1), int(dim2))
+
+
+@register('expand_dims')
+def expand_dims(data, *, axis=0):
+    return jnp.expand_dims(data, int(axis))
+
+
+@register('squeeze')
+def squeeze(data, *, axis=None):
+    if axis is None:
+        return jnp.squeeze(data)
+    ax = tuple(axis) if isinstance(axis, (tuple, list)) else (int(axis),)
+    return jnp.squeeze(data, axis=ax)
+
+
+@register('reshape_like', num_inputs=2)
+def reshape_like(lhs, rhs, *, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    if lhs_begin is None:
+        return jnp.reshape(lhs, rhs.shape)
+    lb = int(lhs_begin or 0); le = int(lhs_end) if lhs_end is not None else lhs.ndim
+    rb = int(rhs_begin or 0); re = int(rhs_end) if rhs_end is not None else rhs.ndim
+    new = lhs.shape[:lb] + rhs.shape[rb:re] + lhs.shape[le:]
+    return jnp.reshape(lhs, new)
+
+
+@register('depth_to_space')
+def depth_to_space(data, *, block_size=1):
+    n, c, h, w = data.shape
+    b = int(block_size)
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register('space_to_depth')
+def space_to_depth(data, *, block_size=1):
+    n, c, h, w = data.shape
+    b = int(block_size)
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# slicing / concat / stack / split (reference: matrix_op.cc slice*, concat.cc)
+# ---------------------------------------------------------------------------
+
+
+def _norm_slice(shape, begin, end, step=None):
+    nd = len(begin)
+    idx = []
+    for i in range(len(shape)):
+        if i < nd:
+            b = begin[i]
+            e = end[i]
+            s = (step[i] if step is not None and i < len(step) and step[i]
+                 else 1)
+            idx.append(slice(b if b is not None else None,
+                             e if e is not None else None,
+                             int(s)))
+        else:
+            idx.append(slice(None))
+    return tuple(idx)
+
+
+@register('slice')
+def slice_op(data, *, begin=None, end=None, step=None):
+    return data[_norm_slice(data.shape, begin, end, step)]
+
+
+@register('slice_axis')
+def slice_axis(data, *, axis=0, begin=0, end=None):
+    axis = int(axis) % data.ndim
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register('slice_like', num_inputs=2)
+def slice_like(lhs, rhs, *, axes=None):
+    if axes is None or len(axes) == 0:
+        axes = range(min(lhs.ndim, rhs.ndim))
+    idx = [slice(None)] * lhs.ndim
+    for a in axes:
+        a = int(a) % lhs.ndim
+        idx[a] = slice(0, rhs.shape[a])
+    return lhs[tuple(idx)]
+
+
+@register('_slice_assign', num_inputs=2, aliases=('_crop_assign',))
+def _slice_assign(lhs, rhs, *, begin=None, end=None, step=None):
+    return lhs.at[_norm_slice(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register('_slice_assign_scalar', aliases=('_crop_assign_scalar',))
+def _slice_assign_scalar(data, *, scalar=0.0, begin=None, end=None, step=None):
+    return data.at[_norm_slice(data.shape, begin, end, step)].set(scalar)
+
+
+@register('Concat', num_inputs=-1, key_var_num_args='num_args',
+          aliases=('concat',))
+def concat(args, *, num_args=None, dim=1):
+    return jnp.concatenate(args, axis=int(dim))
+
+
+@register('_rnn_param_concat', num_inputs=-1, key_var_num_args='num_args')
+def _rnn_param_concat(args, *, num_args=None, dim=0):
+    return jnp.concatenate([a.reshape(-1) for a in args], axis=0)
+
+
+@register('stack', num_inputs=-1, key_var_num_args='num_args')
+def stack(args, *, num_args=None, axis=0):
+    return jnp.stack(args, axis=int(axis))
+
+
+@register('SliceChannel', num_outputs=-1, aliases=('split',))
+def split(data, *, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, int(num_outputs), axis=int(axis))
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
+    return tuple(parts)
+
+
+@register('_split_v2', num_outputs=-1, aliases=('split_v2',))
+def split_v2(data, *, indices_or_sections=1, axis=0, squeeze_axis=False,
+             sections=0):
+    if sections:
+        parts = jnp.split(data, int(sections), axis=int(axis))
+    elif isinstance(indices_or_sections, int):
+        parts = jnp.split(data, indices_or_sections, axis=int(axis))
+    else:
+        parts = jnp.split(data, list(indices_or_sections), axis=int(axis))
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
+    return tuple(parts)
+
+
+@register('tile')
+def tile(data, *, reps=None):
+    return jnp.tile(data, tuple(int(r) for r in reps))
+
+
+@register('repeat')
+def repeat(data, *, repeats=1, axis=None):
+    return jnp.repeat(data, int(repeats), axis=None if axis is None else int(axis))
+
+
+@register('reverse', aliases=('flip',))
+def reverse(data, *, axis=0):
+    ax = axis if isinstance(axis, (list, tuple)) else (int(axis),)
+    return jnp.flip(data, axis=tuple(int(a) for a in ax))
+
+
+@register('Pad', aliases=('pad',))
+def pad(data, *, mode='constant', pad_width=None, constant_value=0.0):
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+          for i in range(len(pad_width) // 2)]
+    jmode = {'constant': 'constant', 'edge': 'edge', 'reflect': 'reflect'}[mode]
+    if jmode == 'constant':
+        return jnp.pad(data, pw, mode='constant', constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+# ---------------------------------------------------------------------------
+# indexing (reference: indexing_op.cc: take/batch_take/gather_nd/scatter_nd,
+# one_hot, pick, Embedding lives in nn.py)
+# ---------------------------------------------------------------------------
+
+
+@register('take', num_inputs=2)
+def take(a, indices, *, axis=0, mode='clip'):
+    jmode = {'clip': 'clip', 'wrap': 'wrap', 'raise': 'clip'}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=int(axis), mode=jmode)
+
+
+@register('batch_take', num_inputs=2)
+def batch_take(a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register('pick', num_inputs=2)
+def pick(data, index, *, axis=-1, keepdims=False, mode='clip'):
+    idx = index.astype(jnp.int32)
+    ax = int(axis)
+    idxe = jnp.expand_dims(idx, ax)
+    out = jnp.take_along_axis(data, idxe, axis=ax)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=ax)
+    return out
+
+
+@register('one_hot')
+def one_hot(indices, *, depth=None, on_value=1.0, off_value=0.0,
+            dtype='float32'):
+    ind = indices.astype(jnp.int32)
+    oh = jax.nn.one_hot(ind, int(depth), dtype=np_dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register('gather_nd', num_inputs=2)
+def gather_nd(data, indices):
+    ind = indices.astype(jnp.int32)
+    m = ind.shape[0]
+    idx = tuple(ind[i] for i in range(m))
+    return data[idx]
+
+
+@register('scatter_nd', num_inputs=2)
+def scatter_nd(data, indices, *, shape=None):
+    out = jnp.zeros(tuple(int(s) for s in shape), dtype=data.dtype)
+    ind = indices.astype(jnp.int32)
+    idx = tuple(ind[i] for i in range(ind.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register('_scatter_set_nd', num_inputs=3)
+def _scatter_set_nd(lhs, indices, rhs, *, shape=None):
+    ind = indices.astype(jnp.int32)
+    idx = tuple(ind[i] for i in range(ind.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register('where', num_inputs=3)
+def where(condition, x, y):
+    return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
+
+
+@register('boolean_mask', num_inputs=2, aliases=('_contrib_boolean_mask',))
+def boolean_mask(data, index, *, axis=0):
+    # dynamic-shape op: eager-only (reference: contrib/boolean_mask.cc).
+    mask = onp.asarray(index) != 0
+    return jnp.compress(mask, data, axis=int(axis))
+
+
+@register('_ravel_multi_index', num_inputs=1, aliases=('ravel_multi_index',))
+def ravel_multi_index(data, *, shape=None):
+    dims = tuple(int(s) for s in shape)
+    idx = data.astype(jnp.int32)
+    out = jnp.zeros(idx.shape[1:], dtype=jnp.int32)
+    for i, d in enumerate(dims):
+        out = out * d + idx[i]
+    return out.astype(jnp.float32)
+
+
+@register('_unravel_index', num_inputs=1, aliases=('unravel_index',))
+def unravel_index(data, *, shape=None):
+    dims = tuple(int(s) for s in shape)
+    idx = data.astype(jnp.int32)
+    outs = []
+    rem = idx
+    for d in dims[::-1]:
+        outs.append(rem % d)
+        rem = rem // d
+    return jnp.stack(outs[::-1], axis=0).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# init ops (reference: init_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register('_zeros', num_inputs=0)
+def _zeros(*, shape=None, ctx=None, dtype='float32'):
+    return jnp.zeros(tuple(shape), dtype=np_dtype(dtype))
+
+
+@register('_zeros_without_dtype', num_inputs=0)
+def _zeros_without_dtype(*, shape=None, ctx=None, dtype=None):
+    return jnp.zeros(tuple(shape), dtype=np_dtype(dtype or 'float32'))
+
+
+@register('_ones', num_inputs=0)
+def _ones(*, shape=None, ctx=None, dtype='float32'):
+    return jnp.ones(tuple(shape), dtype=np_dtype(dtype))
+
+
+@register('_full', num_inputs=0)
+def _full(*, shape=None, value=0.0, ctx=None, dtype='float32'):
+    return jnp.full(tuple(shape), value, dtype=np_dtype(dtype))
+
+
+@register('_eye', num_inputs=0)
+def _eye(*, N=0, M=0, k=0, ctx=None, dtype='float32'):
+    return jnp.eye(int(N), int(M) or None, int(k), dtype=np_dtype(dtype))
+
+
+@register('_arange', num_inputs=0)
+def _arange(*, start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+            ctx=None, dtype='float32'):
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if int(repeat) > 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+@register('_linspace', num_inputs=0)
+def _linspace(*, start=0.0, stop=None, num=50, endpoint=True, ctx=None,
+              dtype='float32'):
+    return jnp.linspace(start, stop, int(num), endpoint=bool(endpoint),
+                        dtype=np_dtype(dtype))
+
+
+@register('_identity_with_attr_like_rhs', num_inputs=2)
+def _identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: ordering_op.cc sort/argsort/topk)
+# ---------------------------------------------------------------------------
+
+
+@register('sort')
+def sort(data, *, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=None if axis is None else int(axis))
+    if not is_ascend:
+        out = jnp.flip(out, axis=-1 if axis is None else int(axis))
+    return out
+
+
+@register('argsort')
+def argsort(data, *, axis=-1, is_ascend=True, dtype='float32'):
+    out = jnp.argsort(data, axis=None if axis is None else int(axis))
+    if not is_ascend:
+        out = jnp.flip(out, axis=-1 if axis is None else int(axis))
+    return out.astype(np_dtype(dtype))
+
+
+@register('topk', num_outputs=-1)
+def topk(data, *, axis=-1, k=1, ret_typ='indices', is_ascend=False,
+         dtype='float32'):
+    """Top-k along axis (reference: ordering_op.cc TopK).
+
+    Uses lax.top_k (TPU-native); ascending selection negates.
+    """
+    ax = int(axis) % data.ndim if axis is not None else data.ndim - 1
+    x = jnp.moveaxis(data, ax, -1)
+    vals, idx = jax.lax.top_k(-x if is_ascend else x, int(k))
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == 'value':
+        return vals
+    if ret_typ == 'both':
+        return vals, idx.astype(np_dtype(dtype))
+    if ret_typ == 'mask':
+        x2 = jnp.moveaxis(jnp.zeros_like(data), ax, -1).reshape(-1, data.shape[ax])
+        ii = jnp.moveaxis(idx, ax, -1).reshape(-1, int(k))
+        rows = jnp.arange(ii.shape[0])[:, None]
+        x2 = x2.at[rows, ii].set(1)
+        return jnp.moveaxis(x2.reshape(jnp.moveaxis(data, ax, -1).shape), -1, ax)
+    return idx.astype(np_dtype(dtype))
+
+
+@register('diag')
+def diag(data, *, k=0, axis1=0, axis2=1):
+    if data.ndim == 1:
+        return jnp.diag(data, int(k))
+    return jnp.diagonal(data, offset=int(k), axis1=int(axis1), axis2=int(axis2))
+
+
+@register('_histogram', num_inputs=1, aliases=('histogram',), num_outputs=2)
+def histogram(data, *, bin_cnt=10, range=None):
+    lo, hi = (range if range is not None else (float('nan'), float('nan')))
+    cnt, edges = jnp.histogram(data, bins=int(bin_cnt), range=(lo, hi))
+    return cnt.astype(jnp.int64), edges.astype(data.dtype)
+
+
+@register('_shuffle', needs_rng=True, aliases=('shuffle',))
+def shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)

@@ -1,0 +1,60 @@
+"""mx.random — global RNG state + samplers (reference: python/mxnet/random.py;
+device RNG resources in src/resource.cc).
+
+JAX PRNG is counter-based and functional; the imperative frontend keeps one
+process-global key chain that ``seed()`` resets. Ops needing randomness
+(needs_rng=True in the registry) draw a fresh subkey per call — matching the
+reference's "each op invocation advances device RNG state" behavior. The
+jit/pjit path never touches this: keys are threaded explicitly there.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get_key():
+    if not hasattr(_state, 'key'):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state, ctx='all'):
+    """Seed the global RNG (reference: random.py seed; ctx accepted for API
+    parity — there is one logical RNG on the XLA path)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split a fresh subkey off the global chain."""
+    key = _get_key()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def current_key():
+    return _get_key()
+
+
+def _delegate(name):
+    def fn(*args, **kwargs):
+        from .ndarray import random as _ndr
+        return getattr(_ndr, name)(*args, **kwargs)
+    fn.__name__ = name
+    return fn
+
+
+uniform = _delegate('uniform')
+normal = _delegate('normal')
+randn = _delegate('randn')
+randint = _delegate('randint')
+poisson = _delegate('poisson')
+exponential = _delegate('exponential')
+gamma = _delegate('gamma')
+negative_binomial = _delegate('negative_binomial')
+generalized_negative_binomial = _delegate('generalized_negative_binomial')
+multinomial = _delegate('multinomial')
+shuffle = _delegate('shuffle')

@@ -1,0 +1,29 @@
+"""Test fixtures: force an 8-device virtual CPU mesh so multi-chip sharding
+paths are exercised without TPU hardware (SURVEY.md §4 fixtures: the TPU
+analog of the reference's local-process fake cluster), and pin matmul
+precision to float32 so numeric checks are meaningful (TPU-default bf16
+passes are a perf feature, not a correctness one).
+"""
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_default_matmul_precision', 'float32')
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    """with_seed() parity (reference: tests/python/unittest/common.py:117)."""
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,168 @@
+"""Autograd (reference model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.sin(x)).sum()
+    y.backward()
+    expected = np.exp(np.sin(x.asnumpy())) * np.cos(x.asnumpy())
+    assert np.allclose(x.grad.asnumpy(), expected, atol=1e-5)
+
+
+def test_multi_variable():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), [4.0])
+    assert np.allclose(b.grad.asnumpy(), [2.0])
+
+
+def test_reuse_variable():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [27.0])
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    assert np.allclose(x.grad.asnumpy(), [20.0, 200.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req='add')
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_write_overwrites_between_backwards():
+    x = nd.array([1.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+
+
+def test_detach_blocks():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])  # only d(y_const*x)/dx = y
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * x) * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    with autograd.record():
+        x.attach_grad()
+        y = x * x
+    g = autograd.grad(y, x)
+    assert np.allclose(g.asnumpy(), [4.0])
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0, 4.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), atol=1e-6)
+
+
+def test_softmax_output_grad():
+    # SoftmaxOutput backward = (softmax - one_hot) (reference semantics)
+    x = nd.array(np.random.randn(4, 3).astype('f'))
+    label = nd.array([0, 1, 2, 1])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    sm = out.asnumpy()
+    oh = np.eye(3)[label.asnumpy().astype(int)]
+    assert np.allclose(x.grad.asnumpy(), sm - oh, atol=1e-6)
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    assert np.allclose(y.asnumpy(), 1.0)
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6

@@ -1,0 +1,210 @@
+"""NDArray basics (reference model: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert np.array_equal(a.asnumpy(), [[1, 2], [3, 4]])
+    z = nd.zeros((2, 3), dtype='float16')
+    assert z.dtype == np.float16
+    o = nd.ones(4)
+    assert o.sum().asscalar() == 4.0
+    f = nd.full((2, 2), 7)
+    assert f.asnumpy().max() == 7
+    r = nd.arange(0, 10, 2)
+    assert np.array_equal(r.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert np.allclose((a + b).asnumpy(), [5, 7, 9])
+    assert np.allclose((b - a).asnumpy(), [3, 3, 3])
+    assert np.allclose((a * b).asnumpy(), [4, 10, 18])
+    assert np.allclose((b / a).asnumpy(), [4, 2.5, 2])
+    assert np.allclose((a + 1).asnumpy(), [2, 3, 4])
+    assert np.allclose((1 - a).asnumpy(), [0, -1, -2])
+    assert np.allclose((a ** 2).asnumpy(), [1, 4, 9])
+    assert np.allclose((2 ** a).asnumpy(), [2, 4, 8])
+    assert np.allclose((-a).asnumpy(), [-1, -2, -3])
+    assert np.allclose(abs(nd.array([-1.0, 2.0])).asnumpy(), [1, 2])
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    assert np.array_equal((a > 2).asnumpy(), [0, 0, 1])
+    assert np.array_equal((a >= 2).asnumpy(), [0, 1, 1])
+    assert np.array_equal((a == 2).asnumpy(), [0, 1, 0])
+    assert np.array_equal((a != 2).asnumpy(), [1, 0, 1])
+
+
+def test_inplace():
+    a = nd.ones((3,))
+    a += 2
+    assert np.allclose(a.asnumpy(), 3)
+    a *= 2
+    assert np.allclose(a.asnumpy(), 6)
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert np.array_equal(a[1].asnumpy(), [4, 5, 6, 7])
+    assert np.array_equal(a[1:3, 0].asnumpy(), [4, 8])
+    assert a[2, 3].asscalar() == 11
+    a[0, 0] = 99
+    assert a[0, 0].asscalar() == 99
+    a[1] = 0
+    assert a[1].sum().asscalar() == 0
+    a[:] = 5
+    assert np.allclose(a.asnumpy(), 5)
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+
+
+def test_reductions():
+    a = nd.array(np.arange(6, dtype='f').reshape(2, 3))
+    assert a.sum().asscalar() == 15
+    assert np.array_equal(a.sum(axis=0).asnumpy(), [3, 5, 7])
+    assert np.array_equal(nd.sum(a, axis=1).asnumpy(), [3, 12])
+    assert a.mean().asscalar() == 2.5
+    assert a.max().asscalar() == 5
+    assert a.min().asscalar() == 0
+    assert np.allclose(nd.norm(a).asscalar(), np.sqrt((np.arange(6) ** 2).sum()))
+    assert nd.argmax(a, axis=1).asnumpy().tolist() == [2, 2]
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype('f'))
+    b = nd.array(np.random.rand(4, 5).astype('f'))
+    c = nd.dot(a, b)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    bt = nd.dot(a, nd.array(np.random.rand(5, 4).astype('f')), transpose_b=True)
+    assert bt.shape == (3, 5)
+    d = nd.batch_dot(nd.ones((2, 3, 4)), nd.ones((2, 4, 5)))
+    assert d.shape == (2, 3, 5)
+    assert np.allclose(d.asnumpy(), 4)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    assert np.allclose(parts[0].asnumpy(), 1)
+
+
+def test_slice_ops():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert np.array_equal(nd.slice(a, begin=(0, 1), end=(2, 3)).asnumpy(),
+                          a.asnumpy()[0:2, 1:3])
+    assert np.array_equal(nd.slice_axis(a, axis=2, begin=1, end=3).asnumpy(),
+                          a.asnumpy()[:, :, 1:3])
+
+
+def test_take_one_hot_pick():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 3])
+    assert np.array_equal(nd.take(w, idx).asnumpy(), w.asnumpy()[[0, 3]])
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    assert np.array_equal(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    p = nd.pick(nd.array([[1., 2.], [3., 4.]]), nd.array([0, 1]), axis=1)
+    assert np.array_equal(p.asnumpy(), [1, 4])
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = nd.topk(a, k=2)
+    assert np.array_equal(idx.asnumpy(), [[0, 2], [1, 2]])
+    both = nd.topk(a, k=1, ret_typ='both')
+    assert np.allclose(both[0].asnumpy(), [[3], [5]])
+    assert np.array_equal(nd.sort(a, axis=1).asnumpy(),
+                          np.sort(a.asnumpy(), axis=1))
+    assert np.array_equal(nd.argsort(a, axis=1).asnumpy(),
+                          np.argsort(a.asnumpy(), axis=1))
+
+
+def test_cast_copy_context():
+    a = nd.array([1.5, 2.5])
+    b = a.astype('int32')
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0] = 9
+    assert a[0].asscalar() == 1.5
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context.device_type == 'cpu'
+    a.wait_to_read()
+
+
+def test_broadcast():
+    a = nd.array([[1.0], [2.0]])
+    b = nd.broadcast_to(a, shape=(2, 3))
+    assert b.shape == (2, 3)
+    c = nd.broadcast_add(nd.ones((2, 1)), nd.ones((1, 3)))
+    assert c.shape == (2, 3)
+    assert np.allclose(c.asnumpy(), 2)
+
+
+def test_where_clip():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([4.0, 5.0, 6.0])
+    assert np.array_equal(nd.where(cond, x, y).asnumpy(), [1, 5, 3])
+    assert np.array_equal(nd.clip(x, a_min=1.5, a_max=2.5).asnumpy(),
+                          [1.5, 2, 2.5])
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / 'arrays.params')
+    d = {'w': nd.ones((2, 2)), 'b': nd.zeros((3,))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded.keys()) == {'w', 'b'}
+    assert np.allclose(loaded['w'].asnumpy(), 1)
+    nd.save(fname, [nd.ones((2,))])
+    lst = nd.load(fname)
+    assert isinstance(lst, list) and len(lst) == 1
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= a.asnumpy().min() and a.asnumpy().max() <= 1
+    mx.random.seed(42)
+    b = nd.random.uniform(0, 1, shape=(100,))
+    assert np.allclose(a.asnumpy(), b.asnumpy())
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(n.asnumpy().mean())) < 0.2
+    r = nd.random.randint(0, 10, shape=(50,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+
+
+def test_elementwise_math():
+    a = nd.array([1.0, 4.0, 9.0])
+    assert np.allclose(nd.sqrt(a).asnumpy(), [1, 2, 3])
+    assert np.allclose(nd.square(a).asnumpy(), [1, 16, 81])
+    assert np.allclose(nd.log(nd.exp(a)).asnumpy(), a.asnumpy(), atol=1e-5)
+    assert np.allclose(nd.sigmoid(nd.zeros((2,))).asnumpy(), 0.5)
+    assert np.allclose(nd.relu(nd.array([-1.0, 1.0])).asnumpy(), [0, 1])
+    assert np.allclose(nd.tanh(nd.zeros((2,))).asnumpy(), 0)

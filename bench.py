@@ -1,0 +1,72 @@
+"""Benchmark driver: ResNet-50 training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: reference MXNet ResNet-50 fp32 train = 363.69 img/s on 1x V100
+at bs=128 (BASELINE.md / docs/faq/perf.md:225-237) — the strongest
+single-device number published in-tree, used as vs_baseline denominator.
+
+Methodology mirrors example/image-classification/benchmark_score.py +
+train_imagenet.py --benchmark 1 (synthetic data, steady-state img/s).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import model_zoo
+
+    on_accel = jax.default_backend() != 'cpu'
+    batch = 128 if on_accel else 8
+    image = 224 if on_accel else 64
+    warmup, iters = 3, 10 if on_accel else 3
+
+    net = model_zoo.vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    if on_accel:
+        net.cast('bfloat16')   # TPU-native precision; BN stats stay f32-safe
+    net.hybridize(static_alloc=True, static_shape=True)
+
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1, 'momentum': 0.9,
+                             'wd': 1e-4})
+    dtype = 'bfloat16' if on_accel else 'float32'
+    x = nd.array(np.random.uniform(-1, 1, (batch, 3, image, image)),
+                 dtype=dtype)
+    y = nd.array(np.random.randint(0, 1000, (batch,)))
+
+    def step():
+        with autograd.record():
+            loss = L(net(x), y)
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    for _ in range(warmup):
+        step()
+    nd.waitall()
+    last = step()
+    last.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step()
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    baseline = 363.69  # V100 fp32 bs=128 (BASELINE.md)
+    print(json.dumps({
+        'metric': 'resnet50_train_img_per_sec_per_chip',
+        'value': round(img_s, 2),
+        'unit': 'img/s',
+        'vs_baseline': round(img_s / baseline, 3)}))
+
+
+if __name__ == '__main__':
+    main()

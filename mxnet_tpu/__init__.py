@@ -19,3 +19,13 @@ from . import ndarray as nd
 from .ndarray import NDArray
 from . import autograd
 from . import random
+from . import name
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import gluon

@@ -179,6 +179,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 ctc = ct.astype(var._grad.dtype) if ct.dtype != var._grad.dtype else ct
                 if var._grad_req == 'add':
                     var._grad._data = var._grad._data + ctc
+                    var._grad_fresh = True
                 else:
                     # MXNet 'write' semantics within one backward = accumulate
                     if getattr(var, '_grad_fresh', False):

@@ -1,0 +1,11 @@
+"""mxnet_tpu.gluon — imperative / hybridizable neural network API
+(reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from . import model_zoo
+from .utils import split_data, split_and_load

@@ -1,0 +1,748 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference parity: python/mxnet/gluon/block.py (Block :127 with child
+registry + naming scopes, HybridBlock :671 whose _build_cache :748 compiles
+a CachedOp, SymbolBlock :952, export :868).
+
+TPU-native design: ``hybridize()`` does NOT build an nnvm graph — it wraps
+the block's forward as a pure function over (PRNG key, inputs, params) and
+``jax.jit``s it (SURVEY.md §3.2: "This is the component the TPU build
+replaces with jax.jit outright"). static_alloc/static_shape flags are
+accepted and ignored: XLA buffer assignment always plans memory statically.
+Differentiability is preserved because the jitted function is invoked
+through the op-registry path, so autograd records its vjp like any other op.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+import warnings
+
+import jax
+import numpy as onp
+
+from ..base import string_types
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from ..ops.registry import Operator
+from .. import autograd
+from .. import random as _random
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+from .utils import _indent
+
+__all__ = ['Block', 'HybridBlock', 'SymbolBlock']
+
+
+class _BlockScope:
+    """Naming scope manager (reference: gluon/block.py:38)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Create prefix and params for a new Block."""
+        current = getattr(_BlockScope._current, 'value', None)
+        if current is None:
+            if prefix is None:
+                from ..name import NameManager
+                prefix = NameManager.current.get(None, hint) + '_'
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = '%s%d_' % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, 'value', None)
+        _BlockScope._current.value = self
+        from ..name import NameManager, Prefix
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args, inout_str):
+    """Flatten nested lists of NDArrays, remembering structure."""
+    if isinstance(args, NDArray):
+        return [args], int(0)
+    if args is None:
+        return [None], None
+    assert isinstance(args, (list, tuple)), \
+        '%s must be (nested) list of NDArray, but got %s of type %s' % (
+            inout_str, str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    if fmt is None:
+        return None, args[1:]
+    assert isinstance(fmt, list)
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """Base class for all neural network layers and models
+    (reference: gluon/block.py:127)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ''
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith('_') \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = {}
+        self._forward_pre_hooks = {}
+        self._hook_counter = 0
+
+    def __repr__(self):
+        s = '{name}(\n{modstr}\n)'
+        modstr = '\n'.join(['  ({key}): {block}'.format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block)])
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        """Registers parameters and child blocks."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError('Changing attribute type for {name} from {type1} to {type2}'
+                                'is not allowed.'.format(
+                                    name=name, type1=type(existing), type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params, \
+                'Overriding Parameter attribute %s is not allowed. ' \
+                'If you want to share parameters between blocks, please set ' \
+                "'params' at Block construction instead." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """Returns a name space object managing a child Block and parameter
+        names."""
+        return self._scope
+
+    @property
+    def params(self):
+        """Returns this Block's parameter dictionary (does NOT include its
+        children's parameters)."""
+        return self._params
+
+    def collect_params(self, select=None):
+        """Returns a ParameterDict containing this Block's and all of its
+        children's Parameters, filtered by regex ``select``
+        (reference: block.py:271)."""
+        self._check_container_with_block()
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _check_container_with_block(self):
+        children = set(self._children.values())
+        def _find_unregistered_block_in_container(data):
+            if isinstance(data, (list, tuple)):
+                for ele in data:
+                    if _find_unregistered_block_in_container(ele):
+                        return True
+                return False
+            if isinstance(data, dict):
+                for _, v in data.items():
+                    if _find_unregistered_block_in_container(v):
+                        return True
+                return False
+            if isinstance(data, Block):
+                return data not in children
+            return False
+        for k, v in self.__dict__.items():
+            if isinstance(v, (list, tuple, dict)) and not (
+                    k.startswith('__') or k == '_children'):
+                if _find_unregistered_block_in_container(v):
+                    warnings.warn('"{name}" is an unregistered container with '
+                                  'Blocks. Note that Blocks inside the list, '
+                                  'tuple or dict will not be registered '
+                                  'automatically.'.format(name=self.__class__.__name__ + '.' + k),
+                                  stacklevel=3)
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Save parameters to file (Gluon format: plain param-struct names;
+        reference: block.py:315)."""
+        params = self._collect_params_with_prefix()
+        if deduplicate:
+            reverse_params = {v: k for k, v in params.items()}
+            params = {v: k for k, v in reverse_params.items()}
+        arg_dict = {key: val._reduce() for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source='current'):
+        """Load parameters from file (reference: block.py:356)."""
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any('.' in i for i in loaded.keys()):
+            # legacy loading: use collect_params name space
+            del loaded
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix,
+                cast_dtype=cast_dtype, dtype_source=dtype_source)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                assert name in loaded, \
+                    "Parameter '%s' is missing in file '%s'. Set " \
+                    'allow_missing=True to ignore missing parameters.' % (
+                        name, filename)
+        for name in loaded:
+            if not ignore_extra and name not in params:
+                raise ValueError(
+                    "Parameter '%s' loaded from file '%s' is not present in "
+                    'this block. Set ignore_extra=True to ignore.' % (name, filename))
+            if name in params:
+                params[name]._load_init(loaded[name], ctx,
+                                        cast_dtype=cast_dtype,
+                                        dtype_source=dtype_source)
+
+    def save_params(self, filename):
+        warnings.warn('save_params is deprecated. Please use save_parameters.')
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        warnings.warn('load_params is deprecated. Please use load_parameters.')
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    def _collect_params_with_prefix(self, prefix=''):
+        if prefix:
+            prefix += '.'
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def register_child(self, block, name=None):
+        """Register a child block for parameter collection."""
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        handle = self._hook_counter
+        self._hook_counter += 1
+        self._forward_pre_hooks[handle] = hook
+        return _HookHandle(self._forward_pre_hooks, handle)
+
+    def register_forward_hook(self, hook):
+        handle = self._hook_counter
+        self._hook_counter += 1
+        self._forward_hooks[handle] = hook
+        return _HookHandle(self._forward_hooks, handle)
+
+    def apply(self, fn):
+        """Applies fn recursively to every child block and self."""
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """Initialize parameters of self and children
+        (reference: block.py initialize)."""
+        from .. import initializer as _init_mod
+        if init is None:
+            init = _init_mod.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        """Activates HybridBlocks recursively (no-op for plain Blocks)."""
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        """Cast parameters and children to dtype."""
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        """Calls forward, running pre/post hooks."""
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        """Override to implement computation."""
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary table (reference: block.py summary)."""
+        summary = {}
+        seen = set()
+        hooks = []
+
+        def _get_shape_str(args):
+            flat_args, _ = _flatten(args, 'input')
+            shapes = [x.shape if isinstance(x, NDArray) else None
+                      for x in flat_args]
+            return str(shapes[0] if len(shapes) == 1 else shapes)
+
+        def _register_summary_hook(block):
+            def _summary_hook(block, _, outputs):
+                class_name = block.__class__.__name__
+                block_idx = len(summary) - 1
+                m_key = '%s-%i' % (class_name, block_idx + 1)
+                summary[m_key] = {'output_shape': _get_shape_str(outputs),
+                                  'n_params': 0, 'trainable': 0, 'shared': 0}
+                params = 0
+                for p in block.params.values():
+                    params += int(onp.prod(p.shape)) if p.shape else 0
+                    if p in seen:
+                        summary[m_key]['shared'] += int(onp.prod(p.shape)) if p.shape else 0
+                    else:
+                        seen.add(p)
+                summary[m_key]['n_params'] = params
+            hooks.append(block.register_forward_hook(_summary_hook))
+
+        self.apply(_register_summary_hook)
+        try:
+            self(*inputs)
+            print('-' * 80)
+            print('{:>20}  {:>42} {:>15}'.format('Layer (type)', 'Output Shape', 'Param #'))
+            print('=' * 80)
+            total = 0
+            for layer in summary:
+                print('{:>20}  {:>42} {:>15}'.format(
+                    layer, summary[layer]['output_shape'],
+                    summary[layer]['n_params']))
+                total += summary[layer]['n_params']
+            print('=' * 80)
+            print('Total params: ' + str(total))
+            print('-' * 80)
+        finally:
+            for h in hooks:
+                h.detach()
+
+
+class _HookHandle:
+    def __init__(self, hooks, handle):
+        self._hooks = hooks
+        self._handle = handle
+
+    def detach(self):
+        self._hooks.pop(self._handle, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        self.detach()
+
+
+# ---------------------------------------------------------------------------
+# trace context: lets layers publish aux-state updates (BatchNorm moving
+# stats) from inside a jit trace; the CachedOp writes them back after the
+# compiled call (FMutateInputs parity for stateful layers).
+# ---------------------------------------------------------------------------
+
+_trace_state = threading.local()
+
+
+def in_trace():
+    return getattr(_trace_state, 'ctx', None) is not None
+
+
+def record_aux_update(param, new_value):
+    """Update a non-differentiable aux parameter, trace-safely.
+
+    Eager: writes through immediately. Under hybridize trace: queues the
+    traced value as an extra jit output, written back post-call.
+    """
+    ctx = getattr(_trace_state, 'ctx', None)
+    data = new_value._data if isinstance(new_value, NDArray) else new_value
+    if ctx is None:
+        with autograd.pause():
+            param.data()._data = data
+    else:
+        ctx.append((param, data))
+
+
+class _TraceScope:
+    def __init__(self):
+        self.updates = []
+
+    def __enter__(self):
+        self._prev = getattr(_trace_state, 'ctx', None)
+        _trace_state.ctx = self.updates
+        return self
+
+    def __exit__(self, *exc):
+        _trace_state.ctx = self._prev
+
+
+def ensure_initialized(block, *args):
+    """Finish any deferred parameter init with one eager probe pass
+    (no child CachedOps are built; used by CachedOp and ParallelTrainer)."""
+    from .parameter import DeferredInitializationError
+    try:
+        for p in block._cached_op_params:
+            p.data()
+        return
+    except DeferredInitializationError:
+        pass
+    _trace_state.probe = True
+    try:
+        with autograd.pause():
+            block._eager_with_deferred_init(*args)
+    finally:
+        _trace_state.probe = False
+
+
+class CachedOp:
+    """jit-compiled executor for a HybridBlock (reference: CachedOp,
+    src/imperative/cached_op.h:76; here jax.jit does static planning)."""
+
+    def __init__(self, block, flags=()):
+        self._block = block
+        self._flags = dict(flags)
+        self._jitted = {}   # (training, n_inputs) -> (jit_fn, meta)
+
+    def _make_fn(self, training, n_inputs):
+        block = self._block
+        param_names = [p.name for p in block._cached_op_params]
+
+        def pure_fn(key, input_arrays, param_arrays):
+            prev_train = autograd.set_training(training)
+            try:
+                with _random.key_override(key), _TraceScope() as scope:
+                    nd_in = [NDArray(a) for a in input_arrays]
+                    nd_params = [NDArray(a) for a in param_arrays]
+                    for p, v in zip(block._cached_op_params, nd_params):
+                        # temporarily swap param storage for tracers
+                        p._trace_data = v
+                    try:
+                        out = block._forward_impl(*nd_in)
+                    finally:
+                        for p in block._cached_op_params:
+                            p._trace_data = None
+                    flat_out, fmt = _flatten(out, 'output')
+                    out_arrays = [o._data for o in flat_out]
+                    aux_params = [p for (p, _) in scope.updates]
+                    aux_arrays = [a for (_, a) in scope.updates]
+                return (tuple(out_arrays), tuple(aux_arrays)), (fmt, aux_params)
+            finally:
+                autograd.set_training(prev_train)
+
+        meta = {}
+
+        def wrapped(key, input_arrays, param_arrays):
+            (outs, auxs), m = pure_fn(key, input_arrays, param_arrays)
+            meta['fmt'], meta['aux_params'] = m
+            return outs, auxs
+
+        jit_fn = jax.jit(wrapped)
+        return jit_fn, meta
+
+    def __call__(self, inputs):
+        block = self._block
+        training = autograd.is_training()
+        sig = (training, len(inputs))
+        if sig not in self._jitted:
+            self._jitted[sig] = self._make_fn(training, len(inputs))
+        jit_fn, meta = self._jitted[sig]
+        params = block._cached_op_params
+        param_arrays = [p.data()._data for p in params]
+        in_arrays = [x._data if isinstance(x, NDArray) else
+                     nd.array(x)._data for x in inputs]
+        key = _random.next_key()
+
+        recording = autograd.is_recording() and (
+            any(isinstance(x, NDArray) and x._entry is not None for x in inputs)
+            or any(p.data()._entry is not None for p in params))
+
+        fn = lambda *arrs: jit_fn(key, list(arrs[:len(in_arrays)]),
+                                  list(arrs[len(in_arrays):]))
+        all_arrays = in_arrays + param_arrays
+        if recording:
+            (out_arrays, aux_arrays), vjp_fn = jax.vjp(
+                lambda *a: fn(*a), *all_arrays, has_aux=False)
+        else:
+            out_arrays, aux_arrays = fn(*all_arrays)
+            vjp_fn = None
+
+        outputs = [NDArray(a) for a in out_arrays]
+        # write back aux updates (moving stats)
+        for p, a in zip(meta.get('aux_params', []), aux_arrays):
+            with autograd.pause():
+                p.data()._data = a
+
+        if recording:
+            from ..autograd import Entry, TapeNode
+            in_entries = [x._entry if isinstance(x, NDArray) else None
+                          for x in inputs] + \
+                         [p.data()._entry for p in params]
+
+            def vjp_outputs_only(cts):
+                cts_t = cts if isinstance(cts, tuple) else (cts,)
+                zero_aux = tuple(onp.zeros(a.shape, a.dtype)
+                                 for a in aux_arrays)
+                return vjp_fn((tuple(c for c in cts_t), zero_aux))
+
+            node = TapeNode(vjp_outputs_only, in_entries, len(outputs),
+                            [o.shape for o in outputs],
+                            [o._data.dtype for o in outputs])
+            for i, o in enumerate(outputs):
+                o._entry = Entry(node=node, index=i)
+
+        ret, _ = _regroup(outputs, meta['fmt'])
+        return ret
+
+
+class HybridBlock(Block):
+    """A Block that can be traced and compiled (reference: block.py:671).
+
+    Subclasses implement ``hybrid_forward(F, x, *args, **params)`` where F is
+    the ndarray or symbol namespace and params arrive as keyword arguments.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = []
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                'Children of HybridBlock must also be HybridBlock, '
+                'but %s has type %s. If you are using Sequential, '
+                'please try HybridSequential instead.' % (
+                    str(block), str(type(block))))
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """Activate compiled execution (reference: block.py:832).
+
+        static_alloc/static_shape accepted for API parity; XLA always
+        statically plans memory.
+        """
+        self._active = active
+        self._flags = [('static_alloc', static_alloc),
+                       ('static_shape', static_shape)] + list(kwargs.items())
+        self._clear_cached_op()
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _infer_attrs(self, *args):
+        """Run one eager pass to finish deferred init (shape inference).
+
+        The reference infers shapes symbolically (_deferred_infer_shape);
+        here layers override ``infer_shape`` to set param shapes from
+        inputs, and composite blocks recurse naturally because the eager
+        pass visits children in order.
+        """
+        self.infer_shape(*args)
+        for _, p in self.params.items():
+            p._finish_deferred_init()
+
+    def infer_shape(self, *args):
+        """Layer-specific deferred-shape hook; composite blocks don't need
+        it because the eager fallback pass initializes children lazily."""
+
+    def infer_type(self, *args):
+        pass
+
+    @property
+    def _cached_op_params(self):
+        params = []
+        def _collect(b):
+            params.extend(b._reg_params.values())
+            for c in b._children.values():
+                _collect(c)
+        _collect(self)
+        return params
+
+    def _forward_impl(self, *args):
+        """Run hybrid_forward with params resolved (possibly traced)."""
+        params = {}
+        for name, p in self._reg_params.items():
+            v = getattr(p, '_trace_data', None)
+            params[name] = v if v is not None else p.data()
+        return self.hybrid_forward(nd, *args, **params)
+
+    def forward(self, x, *args):
+        """Defers to cached op when hybridized, eager otherwise."""
+        if in_trace() or getattr(_trace_state, 'probe', False):
+            # inside a parent block's jit trace (or its init probe):
+            # run the computation inline; the enclosing CachedOp owns jit.
+            # The deferred-init catch is per-block so each child infers its
+            # own shapes during the probe.
+            return self._eager_with_deferred_init(x, *args)
+        if self._active:
+            if self._cached_op is None:
+                # ensure params are initialized (finish deferred shapes with
+                # one eager probe pass, without recursing into child caches)
+                try:
+                    for p in self._cached_op_params:
+                        p.data()
+                except DeferredInitializationError:
+                    _trace_state.probe = True
+                    try:
+                        with autograd.pause():
+                            self._eager_with_deferred_init(x, *args)
+                    finally:
+                        _trace_state.probe = False
+                self._cached_op = CachedOp(self, self._flags)
+            return self._cached_op([x] + list(args))
+        return self._eager_with_deferred_init(x, *args)
+
+    def _eager_with_deferred_init(self, x, *args):
+        try:
+            return self._forward_impl(x, *args)
+        except DeferredInitializationError:
+            self._infer_attrs(x, *args)
+            return self._forward_impl(x, *args)
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Export model graph + params for deployment
+        (reference: block.py:868 → prefix-symbol.json + prefix-%04d.params).
+        The graph is exported as the jax jaxpr text plus params in the
+        NDArray container format; SymbolBlock.imports restores params."""
+        if not self._active or self._cached_op is None:
+            raise RuntimeError(
+                'Please first call block.hybridize() and then run forward '
+                'with this block at least once before calling export.')
+        params = {}
+        for name, param in self.collect_params().items():
+            params['arg:%s' % name] = param._reduce()
+        nd.save('%s-%04d.params' % (path, epoch), params)
+        import json
+        graph = {'format': 'mxnet_tpu-jaxpr-v1',
+                 'params': sorted(p.name for p in self._cached_op_params),
+                 'class': self.__class__.__name__}
+        with open('%s-symbol.json' % path, 'w') as f:
+            json.dump(graph, f)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        """Override to construct symbolic graph for this Block."""
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a Symbol (reference: block.py:952).
+
+    Completed when the symbol layer lands; parameters load eagerly.
+    """
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        self._outputs = outputs
+        self._inputs = inputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        import json
+        with open(symbol_file) as f:
+            graph = json.load(f)
+        blk = SymbolBlock(graph, input_names)
+        if param_file is not None:
+            blk.collect_params().load(param_file, ctx=ctx, allow_missing=True,
+                                      ignore_extra=True)
+        return blk
+
+    def forward(self, x, *args):
+        from .. import symbol as sym_mod
+        if isinstance(self._outputs, sym_mod.Symbol):
+            arg_dict = dict(zip(
+                [s.name for s in (self._inputs if isinstance(self._inputs, list)
+                                  else [self._inputs])],
+                [x] + list(args)))
+            for name, p in self.collect_params().items():
+                arg_dict[name] = p.data()
+            return self._outputs.eval(**arg_dict)
+        raise NotImplementedError(
+            'SymbolBlock over serialized graphs requires the symbol module')

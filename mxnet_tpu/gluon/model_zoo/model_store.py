@@ -1,0 +1,50 @@
+"""Pretrained model weight store (reference:
+python/mxnet/gluon/model_zoo/model_store.py).
+
+The reference downloads from S3; this environment has zero egress, so
+get_model_file only resolves from the local root (set MXNET_HOME or pass
+root=). API kept for drop-in compatibility.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ['get_model_file', 'purge']
+
+_model_sha1 = {}
+
+
+def short_hash(name):
+    if name not in _model_sha1:
+        raise ValueError('Pretrained model for {name} is not available.'.format(
+            name=name))
+    return _model_sha1[name][:8]
+
+
+def get_model_file(name, root=None):
+    """Return the path of a locally available pretrained parameter file."""
+    if root is None:
+        root = os.path.join(os.environ.get('MXNET_HOME',
+                                           os.path.expanduser('~/.mxnet')),
+                            'models')
+    root = os.path.expanduser(root)
+    file_path = os.path.join(root, '%s.params' % name)
+    if os.path.exists(file_path):
+        return file_path
+    raise RuntimeError(
+        'Pretrained weights for %s not found at %s. Downloading requires '
+        'network egress, which is unavailable; place the file there '
+        'manually.' % (name, file_path))
+
+
+def purge(root=None):
+    """Remove cached pretrained models."""
+    if root is None:
+        root = os.path.join(os.environ.get('MXNET_HOME',
+                                           os.path.expanduser('~/.mxnet')),
+                            'models')
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith('.params'):
+                os.remove(os.path.join(root, f))

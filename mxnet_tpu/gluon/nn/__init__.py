@@ -1,0 +1,5 @@
+"""Neural network layers (reference: python/mxnet/gluon/nn/)."""
+from ..block import Block, HybridBlock, SymbolBlock
+from .activations import *
+from .basic_layers import *
+from .conv_layers import *

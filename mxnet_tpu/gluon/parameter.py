@@ -1,0 +1,531 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference parity: python/mxnet/gluon/parameter.py (Parameter :43 with
+deferred shape inference, grad_req, _reduce :312; Constant; ParameterDict
+:632). TPU-native detail: a parameter owns ONE logical NDArray — replication
+and sharding across chips are handled by pjit sharding specs in the parallel
+layer, not by per-device copies (the reference's list-of-NDArrays-per-ctx
+model maps to a sharded jax.Array).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import initializer
+from .utils import _indent, _brief_print_list
+from ..context import Context, current_context, cpu
+
+__all__ = ['DeferredInitializationError', 'Parameter', 'Constant',
+           'ParameterDict', 'tensor_types']
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter:
+    """A Container holding parameters (weights) of Blocks
+    (reference: gluon/parameter.py:43)."""
+
+    def __init__(self, name, grad_req='write', shape=None, dtype='float32',
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype='default', grad_stype='default'):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = shape
+        self.name = name
+        self._dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req
+        self.init = init
+        for st, arg in [(stype, 'stype'), (grad_stype, 'grad_stype')]:
+            if st not in ('default', 'row_sparse', 'csr'):
+                raise ValueError("Invalid {} '{}': must be one of 'default', "
+                                 "'row_sparse', 'csr'".format(arg, st))
+        # sparse storage is emulated densely (SURVEY §7 hard part 3)
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        s = 'Parameter {name} (shape={shape}, dtype={dtype})'
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ['write', 'add', 'null'], \
+            "grad_req must be one of 'write', 'add', or 'null', but got '%s'" % req
+        if not self._differentiable:
+            req = 'null'
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == 'null' and self._grad is not None:
+            self._grad = None
+            if self._data is not None:
+                self._data._grad = None
+                self._data._entry = None
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @dtype.setter
+    def dtype(self, dtype):
+        self.cast(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = new_shape
+            return
+        assert len(self._shape) == len(new_shape) and \
+            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
+            'Expected shape %s is incompatible with given shape %s.' % (
+                str(new_shape), str(self._shape))
+        self._shape = new_shape
+
+    def _check_and_get(self, arr, ctx):
+        if arr is not None:
+            return arr
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                'Parameter \'%s\' has not been initialized yet because '
+                'initialization was deferred. Actual initialization happens '
+                'during the first forward pass. Please pass one batch of '
+                'data through the network before accessing Parameters.'
+                % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you should "
+            'initialize parameters and create Trainer with Block.collect_params() '
+            'instead of Block.params because the later does not include '
+            'Parameters of nested child Blocks' % self.name)
+
+    def _load_init(self, data, ctx, cast_dtype=False, dtype_source='current'):
+        if self.shape:
+            unknown_dim_size = -1 in self.shape or 0 in self.shape
+            for self_dim, data_dim in zip(self.shape, data.shape):
+                assert self_dim in (0, -1, data_dim), \
+                    "Failed loading Parameter '%s' from saved params: shape " \
+                    'incompatible expected %s vs saved %s' % (
+                        self.name, str(self.shape), str(data.shape))
+            if unknown_dim_size:
+                self._shape = data.shape
+        if self.dtype and not cast_dtype:
+            if onp.dtype(self.dtype).type != data.dtype.type:
+                data = data.astype(self.dtype)
+        elif cast_dtype:
+            if dtype_source == 'saved':
+                self._dtype = data.dtype
+            else:
+                data = data.astype(self.dtype)
+        if self._data is None:
+            self._init_impl(data, ctx)
+        else:
+            self.set_data(data)
+        self._deferred_init = ()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self.shape is not None and onp.prod(self.shape) > 0, \
+            'Cannot initialize Parameter \'%s\' because it has invalid shape: ' \
+            '%s. Please specify in_units, in_channels, etc for `Block`s.' % (
+                self.name, str(self.shape))
+        if data is None:
+            data = nd.zeros(self.shape, dtype=self.dtype,
+                            ctx=ctx[0] if ctx else None)
+            # the resolved init always goes through _init_weight — Gluon
+            # layers set explicit per-param inits; the reference encodes
+            # this as InitDesc attrs['__init__'] → create(init)._init_weight
+            resolved = initializer.create(
+                init if init is not None else default_init)
+            if isinstance(resolved, initializer.Initializer):
+                resolved._init_weight(initializer.InitDesc(self.name), data)
+            else:
+                resolved(initializer.InitDesc(self.name), data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._ctx_list = list(ctx_list) if ctx_list else [current_context()]
+        if not isinstance(data, NDArray):
+            data = nd.array(data, dtype=self.dtype)
+        self._data = data
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == 'null':
+            self._grad = None
+            return
+        self._data.attach_grad(grad_req=self.grad_req)
+        self._grad = self._data.grad
+
+    def _reduce(self):
+        """Reduce data from multiple contexts to cpu (reference: :312) —
+        with one logical array this is a copy to host."""
+        return self.data().as_in_context(cpu())
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Initialize parameter and gradient arrays
+        (reference: parameter.py initialize)."""
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = self.init if self.init is not None else default_init
+        if not self.shape or onp.prod(self.shape) <= 0:
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError('Cannot initialize Parameter \'%s\' because it '
+                             'has invalid shape: %s.' % (self.name, str(self.shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def reset_ctx(self, ctx):
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx[0])
+            self._ctx_list = list(ctx)
+            self._init_grad()
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError('Cannot reset context for Parameter \'%s\' because it '
+                             'has not been initialized.' % self.name)
+
+    def set_data(self, data):
+        """Set this parameter's value on all contexts."""
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                'Parameter \'%s\' has not been initialized' % self.name
+            self._deferred_init = self._deferred_init[:3] + (
+                data if isinstance(data, NDArray) else nd.array(data),)
+            return
+        entry = self._data._entry
+        grad = self._data._grad
+        req = self._data._grad_req
+        self._data._data = (data._data if isinstance(data, NDArray)
+                            else nd.array(data)._data)
+        self._data._entry = entry
+        self._data._grad = grad
+        self._data._grad_req = req
+
+    def row_sparse_data(self, row_id):
+        """Sparse parity shim: dense storage, full fetch."""
+        return self.data()
+
+    def list_row_sparse_data(self, row_id):
+        return [self.data()]
+
+    def data(self, ctx=None):
+        """Return a (the) copy of this parameter
+        (reference: parameter.py data)."""
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return [self._check_and_get(self._data, None)]
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError("Parameter '%s' has not been initialized" % self.name)
+        return self._ctx_list
+
+    def zero_grad(self):
+        """Set gradient buffer to 0."""
+        if self._grad is None:
+            return
+        self._grad[:] = 0
+        self._data._grad_fresh = False
+
+    def var(self):
+        """Return the symbolic variable for this parameter."""
+        if self._var is None:
+            from .. import symbol
+            self._var = symbol.var(self.name, shape=self.shape,
+                                   dtype=self.dtype, lr_mult=self.lr_mult,
+                                   wd_mult=self.wd_mult, init=self.init)
+        return self._var
+
+    def cast(self, dtype):
+        from ..base import np_dtype
+        self._dtype = dtype
+        if self._data is None:
+            return
+        self._data._data = self._data._data.astype(np_dtype(dtype))
+        self._init_grad()
+
+
+class Constant(Parameter):
+    """A constant parameter for holding non-differentiable values
+    (reference: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class Init(initializer.Initializer):
+            def _init_weight(self, _, arr):
+                value.copyto(arr)
+        init_name = 'Constant_{}_{}'.format(name, id(self))
+        initializer._INITIALIZER_REGISTRY[init_name.lower()] = Init
+        super().__init__(name, grad_req='null', shape=value.shape,
+                         dtype=value.dtype, init=init_name)
+
+    def __repr__(self):
+        return 'Constant {name} (shape={shape}, dtype={dtype})'.format(
+            name=self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def grad_req(self):
+        return 'null'
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req != 'null':
+            import warnings
+            warnings.warn('Constant parameter "{}" does not support '
+                          'grad_req other than "null", and new value "{}" '
+                          'is ignored.'.format(self.name, req))
+        self._grad_req = 'null'
+
+
+class ParameterDict:
+    """A dictionary managing a set of Parameters
+    (reference: gluon/parameter.py:632)."""
+
+    def __init__(self, prefix='', shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __repr__(self):
+        s = '{name}(\n{content}\n)'
+        name = self._prefix + ' ' if self._prefix else ''
+        return s.format(name=name, content='\n'.join(
+            [_indent('  {0}'.format(v), 2) for v in self.values()]))
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __len__(self):
+        return len(self._params)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._shared._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Retrieve a Parameter with prefix+name, creating it if absent."""
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == 'shape' and len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 > 0 and dim2 > 0:
+                                matched = False
+                                break
+                            elif dim1 == dim2:
+                                inferred_shape.append(dim1)
+                            elif dim1 in (0, -1):
+                                inferred_shape.append(dim2)
+                            else:
+                                inferred_shape.append(dim1)
+                        if matched:
+                            param._shape = tuple(inferred_shape)
+                            continue
+                    elif k == 'dtype' and onp.dtype(v) == onp.dtype(existing):
+                        continue
+                    assert v is None or v == existing, \
+                        "Cannot retrieve Parameter '%s' because desired " \
+                        "attribute does not match with stored for attribute " \
+                        "'%s': desired '%s' vs stored '%s'." % (
+                            name, k, str(v), str(getattr(param, k)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError('No constant named \'{}\'. Please specify value '
+                               'if you want to create a new constant.'.format(name))
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            assert isinstance(param, Constant), \
+                "Parameter '{}' already exists but it is not a constant.".format(name)
+            if isinstance(value, NDArray):
+                value = value.asnumpy()
+            assert param.shape == value.shape and \
+                (param.value.asnumpy() == value).all(), \
+                "Constant '{}' already exists but its value doesn't match new value".format(name)
+        return param
+
+    def update(self, other):
+        """Copy all Parameters in ``other`` to self."""
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    'Cannot update self with other because they have different ' \
+                    'Parameters with the same name \'%s\'' % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        if verbose and hasattr(init, 'set_verbosity'):
+            init.set_verbosity(verbose=verbose)
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for i in self.values():
+            i.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for i in self.values():
+            i.reset_ctx(ctx)
+
+    def list_ctx(self):
+        assert self._params, 'ParameterDict contains no parameters'
+        s = set()
+        for i in self.values():
+            s.update(i.list_ctx())
+        return list(s)
+
+    def setattr(self, name, value):
+        for i in self.values():
+            setattr(i, name, value)
+
+    def save(self, filename, strip_prefix=''):
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with '%s'" % (
+                        strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix='', cast_dtype=False,
+             dtype_source='current'):
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is '%s' but Parameter name '%s' does not " \
+                    'start with it' % (restore_prefix, name)
+        lprefix = len(restore_prefix)
+        loaded = nd.load(filename)
+        arg_dict = {(k[4:] if k.startswith(('arg:', 'aux:')) else k): v
+                    for k, v in loaded.items()}
+        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s', which contains " \
+                    "parameters: %s. Set allow_missing=True to ignore missing " \
+                    'parameters.' % (name[lprefix:], filename,
+                                     _brief_print_list(arg_dict.keys()))
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    'ParameterDict, which contains parameters %s. Set ' \
+                    'ignore_extra=True to ignore.' % (
+                        name[lprefix:], filename,
+                        _brief_print_list(self._params.keys()))
+                continue
+            self[name]._load_init(arg_dict[name], ctx, cast_dtype=cast_dtype,
+                                  dtype_source=dtype_source)
+
+

@@ -1,0 +1,229 @@
+"""Gluon Trainer.
+
+Reference parity: python/mxnet/gluon/trainer.py:27 (kvstore selection
+:169-235, step :298, allreduce_grads :327, _update :392).
+
+TPU-native design: with one logical copy of each parameter there is no
+device-list reduce; ``kvstore`` strings ('local'/'device'/'nccl'/'xla') all
+resolve to the mesh-collective store, and under pjit data-parallel training
+the gradient allreduce is a lax.psum emitted inside the compiled step
+(parallel/ module). The eager path here updates parameters directly.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .. import optimizer as opt
+from .parameter import Parameter, ParameterDict
+
+__all__ = ['Trainer']
+
+
+class Trainer:
+    """Applies an Optimizer on a set of Parameters."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore='device',
+                 compression_params=None, update_on_kvstore=None):
+        param_list = []
+        if isinstance(params, (dict, ParameterDict)):
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                'First argument must be a list or dict of Parameters, '
+                'got %s.' % (type(params)))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    'First argument must be a list or dict of Parameters, '
+                    'got list of %s.' % (type(param)))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._set_trainer(self) if hasattr(param, '_set_trainer') else None
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get('rescale_grad', 1.0))
+        self._contains_sparse_weight = False
+        self._contains_sparse_grad = False
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {'kvstore': kvstore,
+                                'update_on_kvstore': update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._distributed = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                'optimizer_params must be None if optimizer is an Optimizer ' \
+                'instance'
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _reset_kvstore(self):
+        self._kv_initialized = False
+        self._kvstore = None
+        self._distributed = None
+        self._update_on_kvstore = None
+        self._params_to_init = [param for param in self._params]
+
+    def _init_kvstore(self):
+        """Create the kvstore (reference: trainer.py:169). On TPU every
+        type string resolves to the in-process mesh-collective store."""
+        from .. import kvstore as kvs_mod
+        config = self._kvstore_params
+        kvstore = config['kvstore']
+        if kvstore is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            self._kvstore = kvs_mod.create(kvstore) \
+                if isinstance(kvstore, str) else kvstore
+            self._update_on_kvstore = bool(config['update_on_kvstore']) \
+                if config['update_on_kvstore'] is not None else False
+            if self._compression_params and self._kvstore is not None:
+                self._kvstore.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+                # seed the store with current weights so the in-store
+                # optimizer updates real values (reference: kv.init in
+                # Module.init_optimizer / Trainer._init_params)
+                for i, param in enumerate(self._params):
+                    if param.grad_req != 'null':
+                        self._kvstore.init(i, param.data())
+        self._distributed = bool(self._kvstore is not None and
+                                 getattr(self._kvstore, 'num_workers', 1) > 1)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning('Optimizer has to be defined before its learning '
+                              'rate can be accessed.')
+        return self._optimizer.learning_rate if hasattr(
+            self._optimizer, 'learning_rate') else self._optimizer.lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        """Set a new learning rate."""
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning('Optimizer has to be defined before its learning '
+                              'rate is mutated.')
+        self._optimizer.set_learning_rate(lr)
+
+    def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
+        """Sparse parity shim (dense storage)."""
+        parameter.data().copyto(out)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Make one parameter update step: rescale by 1/batch_size,
+        allreduce (dist), apply optimizer (reference: trainer.py:298)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._distributed and self._kv_initialized:
+            if self._optimizer.rescale_grad != scale:
+                raise UserWarning('Possible change in the `batch_size` from '
+                                  'previous `step` detected. Optimizer '
+                                  'gradient normalizing factor will not '
+                                  'change w.r.t new batch_size when '
+                                  'update_on_kvstore=True')
+        self._optimizer.rescale_grad = scale
+
+    def allreduce_grads(self):
+        """Reduce gradients over workers/devices without updating."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            'allreduce_grads() when parameters are updated on kvstore ' \
+            'is not supported. Try setting `update_on_kvstore` ' \
+            'to False when creating trainer.'
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != 'null':
+                self._kvstore.push(i, param.list_grad()[0], priority=-i)
+                if self._update_on_kvstore:
+                    # optimizer ran inside the store: pull weights back
+                    # (reference: _update_params_on_kvstore, model.py:150)
+                    self._kvstore.pull(i, param.data(), priority=-i)
+                else:
+                    self._kvstore.pull(i, param.list_grad()[0], priority=-i,
+                                       ignore_sparse=False)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Apply optimizer only (gradients must already be reduced)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            'update() when parameters are updated on kvstore ' \
+            'is not supported. Try setting `update_on_kvstore` ' \
+            'to False when creating trainer.'
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == 'null':
+                continue
+            if not ignore_stale_grad and not param.data()._grad_fresh:
+                raise UserWarning(
+                    "Gradient of Parameter `%s` on context %s has not been "
+                    "updated by backward since last `step`. This could mean "
+                    "a bug in your model that made it only use a subset of "
+                    "the Parameters (Blocks) for this iteration. If you are "
+                    "intentionally only using a subset, call step with "
+                    "ignore_stale_grad=True to suppress this warning and "
+                    "skip updating of Parameters with stale gradient" % (
+                        param.name, str(param.data().context)))
+            if ignore_stale_grad and not param.data()._grad_fresh:
+                continue  # reference: stale params are skipped, not updated
+            if self._kvstore and self._update_on_kvstore:
+                continue
+            updater(i, param.grad(), param.data())
+            param.data()._grad_fresh = False
+
+    def save_states(self, fname):
+        """Save trainer (optimizer/updater) states
+        (reference: trainer.py save_states)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, 'wb') as fout:
+            fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """Load trainer states."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, 'rb') as f:
+            states = f.read()
+        for updater in self._updaters:
+            updater.set_states(states)
+            updater.optimizer = self._updaters[0].optimizer
+        self._optimizer = self._updaters[0].optimizer
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        self._optimizer.param_dict = param_dict

@@ -1,0 +1,182 @@
+"""KVStore: parameter synchronization store.
+
+Reference parity: python/mxnet/kvstore.py (init/push/pull/row_sparse_pull
+:116-314, set_gradient_compression :394, set_optimizer :450, _set_updater
+:565, _barrier :606) over src/kvstore/ (§2.4: KVStoreLocal, CommCPU/Device/
+DeviceTree, KVStoreNCCL, KVStoreDist + ps-lite).
+
+TPU-native design (SURVEY.md §5.8): ALL single-process type strings
+('local', 'device', 'device_sync', 'nccl', 'xla') alias one in-process
+store — on a TPU there is one logical copy of each array and the
+cross-device reduce is a lax.psum inside the compiled step, so the store's
+job is aggregation semantics + optimizer hosting, not transport. Multi-host
+types ('dist_sync', 'dist_device_sync', 'horovod') allreduce across
+jax processes over DCN/ICI via jax collectives; 'dist_async' parameter-server
+semantics have no XLA analog and run as sync (documented divergence).
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import string_types
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ['KVStore', 'create']
+
+
+def _ctype_key_value(keys, vals):
+    if isinstance(keys, (tuple, list)):
+        assert len(keys) == len(vals)
+        return list(keys), list(vals)
+    return [keys], [vals] if not isinstance(vals, (list, tuple)) else list(vals)
+
+
+class KVStore:
+    """In-process key-value store with optimizer hosting."""
+
+    def __init__(self, kv_type='local'):
+        self._type = kv_type
+        self._data = {}
+        self._updater = None
+        self._compression_params = None
+        self._optimizer_states_updater = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count()
+
+    # -- core ops ----------------------------------------------------------
+    def init(self, key, value):
+        """Initialize a key-value pair (single call per key;
+        reference: kvstore.py:116)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            self._data[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Push (accumulate) values (reference: kvstore.py push).
+
+        Multiple device slices for one key are summed (Comm::Reduce parity);
+        in dist mode the sum is allreduced across workers.
+        """
+        keys, vals = _ctype_key_value(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                merged = v[0]
+                for x in v[1:]:
+                    merged = merged + x
+            else:
+                merged = v
+            merged = self._allreduce(merged)
+            if self._updater is not None:
+                if k not in self._data:
+                    self._data[k] = nd.zeros(merged.shape, dtype=merged.dtype)
+                self._updater(_key_to_int(k), merged, self._data[k])
+            else:
+                self._data[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pull values (weights if an updater is installed, else the last
+        reduced push) into out (reference: kvstore.py pull)."""
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, o in zip(keys, outs):
+            src = self._data[k]
+            if isinstance(o, (list, tuple)):
+                for oo in o:
+                    src.copyto(oo)
+            else:
+                src.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse parity shim: dense pull (XLA has no native sparse;
+        SURVEY.md §7 hard part 3)."""
+        self.pull(key, out, priority)
+
+    # -- distributed reduce ------------------------------------------------
+    def _allreduce(self, value):
+        if self.num_workers <= 1 or not self._type.startswith(('dist', 'horovod')):
+            return value
+        import jax
+        from jax.experimental import multihost_utils
+        arr = multihost_utils.process_allgather(value._data)
+        return NDArray(arr.sum(axis=0))
+
+    def _barrier(self):
+        """Global barrier across workers (reference: kvstore.py:606)."""
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices('kvstore_barrier')
+
+    # -- optimizer hosting -------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run this optimizer inside the store (server-side in the
+        reference: kvstore.py:450 pickles it to PS servers; here the store
+        is in-process so it simply installs an Updater)."""
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit gradient compression parity: recorded but a no-op on the
+        single-chip path (compressed DCN allreduce is a dist-only concern;
+        reference: gradient_compression.h)."""
+        self._compression_params = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, 'Cannot save states for distributed training'
+        with open(fname, 'wb') as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, 'Cannot load states for distributed training'
+        with open(fname, 'rb') as f:
+            self._updater.set_states(f.read())
+
+
+def _key_to_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+_SINGLE_TYPES = ('local', 'local_allreduce_cpu', 'local_allreduce_device',
+                 'device', 'device_sync', 'nccl', 'xla')
+_DIST_TYPES = ('dist_sync', 'dist_device_sync', 'dist_async',
+               'dist_sync_device', 'horovod')
+
+
+def create(name='local'):
+    """Create a KVStore by type string (reference: src/kvstore/kvstore.cc:40).
+
+    All single-process types alias the mesh-collective store; dist types
+    enable the cross-process allreduce. 'dist_async' runs synchronously
+    (documented divergence — no parameter server on TPU).
+    """
+    if not isinstance(name, string_types):
+        raise TypeError('name must be a string')
+    if name.lower() not in _SINGLE_TYPES + _DIST_TYPES:
+        raise ValueError('Unknown KVStore type %s' % name)
+    return KVStore(name.lower())

@@ -1,0 +1,919 @@
+"""Evaluation metrics.
+
+Reference parity: python/mxnet/metric.py:440-1662 (Accuracy/TopK/F1/MCC/
+Perplexity/MAE/MSE/RMSE/CrossEntropy/NLL/PearsonCorr/PCC/Loss/CustomMetric,
+composite + global stats). Metrics run host-side on numpy — on TPU the only
+device→host sync is the asnumpy() of the model outputs, matching the
+reference's update_metric WaitToRead boundary (SURVEY.md §3.3).
+"""
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+
+import numpy
+
+from .base import string_types, numeric_types
+from .ndarray import NDArray
+
+__all__ = ['EvalMetric', 'CompositeEvalMetric', 'Accuracy', 'TopKAccuracy',
+           'F1', 'MCC', 'Perplexity', 'MAE', 'MSE', 'RMSE', 'CrossEntropy',
+           'NegativeLogLikelihood', 'PearsonCorrelation', 'PCC', 'Loss',
+           'Torch', 'Caffe', 'CustomMetric', 'np', 'create', 'register']
+
+_METRIC_REGISTRY = {}
+
+
+def register(klass):
+    _METRIC_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def _alias(*names):
+    def _reg(klass):
+        register(klass)
+        for n in names:
+            _METRIC_REGISTRY[n.lower()] = klass
+        return klass
+    return _reg
+
+
+def create(metric, *args, **kwargs):
+    """Create a metric from name / callable / list (reference: metric.py)."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, CompositeEvalMetric):
+        return metric
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite_metric = CompositeEvalMetric()
+        for child_metric in metric:
+            composite_metric.add(create(child_metric, *args, **kwargs))
+        return composite_metric
+    if isinstance(metric, string_types):
+        return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+    raise TypeError('metric should be a string, callable or EvalMetric')
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError('Shape of labels {} does not match shape of '
+                         'predictions {}'.format(label_shape, pred_shape))
+    if wrap:
+        if isinstance(labels, NDArray):
+            labels = [labels]
+        if isinstance(preds, NDArray):
+            preds = [preds]
+    return labels, preds
+
+
+class EvalMetric:
+    """Base metric with local + global accumulators (reference: metric.py:68)."""
+
+    def __init__(self, name, output_names=None, label_names=None,
+                 has_global_stats=False, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._has_global_stats = has_global_stats
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return 'EvalMetric: {}'.format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = self._kwargs.copy()
+        config.update({
+            'metric': self.__class__.__name__,
+            'name': self.name,
+            'output_names': self.output_names,
+            'label_names': self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names if name in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names if name in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float('nan'))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self._has_global_stats:
+            if self.global_num_inst == 0:
+                return (self.name, float('nan'))
+            return (self.name, self.global_sum_metric / self.global_num_inst)
+        return self.get()
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_global_name_value(self):
+        if self._has_global_stats:
+            name, value = self.get_global()
+            if not isinstance(name, list):
+                name = [name]
+            if not isinstance(value, list):
+                value = [value]
+            return list(zip(name, value))
+        return self.get_name_value()
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics as one (reference: metric.py:234)."""
+
+    def __init__(self, metrics=None, name='composite', output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        if metrics is None:
+            metrics = []
+        self.metrics = [create(i) for i in metrics]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError('Metric index {} is out of range 0 and {}'.format(
+                index, len(self.metrics)))
+
+    def update_dict(self, labels, preds):
+        if self.label_names is not None:
+            labels = OrderedDict([i for i in labels.items()
+                                  if i[0] in self.label_names])
+        if self.output_names is not None:
+            preds = OrderedDict([i for i in preds.items()
+                                 if i[0] in self.output_names])
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def reset_local(self):
+        try:
+            for metric in self.metrics:
+                metric.reset_local()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, string_types):
+                name = [name]
+            if isinstance(value, numeric_types):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_global(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get_global()
+            if isinstance(name, string_types):
+                name = [name]
+            if isinstance(value, numeric_types):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_config(self):
+        config = super().get_config()
+        config.update({'metrics': [i.get_config() for i in self.metrics]})
+        return config
+
+
+@_alias('acc')
+class Accuracy(EvalMetric):
+    """Classification accuracy (reference: metric.py:440)."""
+
+    def __init__(self, axis=1, name='accuracy', output_names=None,
+                 label_names=None):
+        super().__init__(name, axis=axis, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            pred_np = pred_label.asnumpy() if isinstance(pred_label, NDArray) \
+                else numpy.asarray(pred_label)
+            label_np = label.asnumpy() if isinstance(label, NDArray) \
+                else numpy.asarray(label)
+            if pred_np.shape != label_np.shape:
+                pred_np = numpy.argmax(pred_np, axis=self.axis)
+            pred_np = pred_np.astype('int32')
+            label_np = label_np.astype('int32')
+            label_np, pred_np = check_label_shapes(label_np, pred_np)
+            num_correct = (pred_np.flat == label_np.flat).sum()
+            self.sum_metric += num_correct
+            self.global_sum_metric += num_correct
+            self.num_inst += len(pred_np.flat)
+            self.global_num_inst += len(pred_np.flat)
+
+
+@_alias('top_k_accuracy', 'top_k_acc')
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (reference: metric.py TopKAccuracy)."""
+
+    def __init__(self, top_k=1, name='top_k_accuracy', output_names=None,
+                 label_names=None):
+        super().__init__(name, top_k=top_k, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.top_k = top_k
+        assert self.top_k > 1, 'Please use Accuracy if top_k is no more than 1'
+        self.name += '_%d' % self.top_k
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            assert len(pred_label.shape) <= 2, 'Predictions should be no more than 2 dims'
+            pred_np = numpy.argpartition(
+                pred_label.asnumpy().astype('float32'), -self.top_k)
+            label_np = label.asnumpy().astype('int32')
+            num_samples = pred_np.shape[0]
+            num_dims = len(pred_np.shape)
+            if num_dims == 1:
+                num_correct = (pred_np.flat == label_np.flat).sum()
+                self.sum_metric += num_correct
+                self.global_sum_metric += num_correct
+            elif num_dims == 2:
+                num_classes = pred_np.shape[1]
+                top_k = min(num_classes, self.top_k)
+                for j in range(top_k):
+                    num_correct = (pred_np[:, num_classes - 1 - j].flat ==
+                                   label_np.flat).sum()
+                    self.sum_metric += num_correct
+                    self.global_sum_metric += num_correct
+            self.num_inst += num_samples
+            self.global_num_inst += num_samples
+
+
+class _BinaryClassificationMetrics:
+    """Precision/recall/F1/MCC bookkeeping (reference: metric.py:580)."""
+
+    def __init__(self):
+        self.true_positives = 0
+        self.false_negatives = 0
+        self.false_positives = 0
+        self.true_negatives = 0
+        self.global_true_positives = 0
+        self.global_false_negatives = 0
+        self.global_false_positives = 0
+        self.global_true_negatives = 0
+
+    def update_binary_stats(self, label, pred):
+        pred = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
+        label = label.asnumpy().astype('int32') if isinstance(label, NDArray) \
+            else numpy.asarray(label).astype('int32')
+        pred_label = numpy.argmax(pred, axis=1)
+        check_label_shapes(label, pred)
+        if len(numpy.unique(label)) > 2:
+            raise ValueError('%s currently only supports binary classification.'
+                             % self.__class__.__name__)
+        pred_true = (pred_label == 1)
+        pred_false = 1 - pred_true
+        label_true = (label == 1)
+        label_false = 1 - label_true
+        true_pos = (pred_true * label_true).sum()
+        false_pos = (pred_true * label_false).sum()
+        false_neg = (pred_false * label_true).sum()
+        true_neg = (pred_false * label_false).sum()
+        self.true_positives += true_pos
+        self.global_true_positives += true_pos
+        self.false_positives += false_pos
+        self.global_false_positives += false_pos
+        self.false_negatives += false_neg
+        self.global_false_negatives += false_neg
+        self.true_negatives += true_neg
+        self.global_true_negatives += true_neg
+
+    @property
+    def precision(self):
+        if self.true_positives + self.false_positives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_positives)
+        return 0.
+
+    @property
+    def global_precision(self):
+        if self.global_true_positives + self.global_false_positives > 0:
+            return float(self.global_true_positives) / (
+                self.global_true_positives + self.global_false_positives)
+        return 0.
+
+    @property
+    def recall(self):
+        if self.true_positives + self.false_negatives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_negatives)
+        return 0.
+
+    @property
+    def global_recall(self):
+        if self.global_true_positives + self.global_false_negatives > 0:
+            return float(self.global_true_positives) / (
+                self.global_true_positives + self.global_false_negatives)
+        return 0.
+
+    @property
+    def fscore(self):
+        if self.precision + self.recall > 0:
+            return 2 * self.precision * self.recall / (
+                self.precision + self.recall)
+        return 0.
+
+    @property
+    def global_fscore(self):
+        if self.global_precision + self.global_recall > 0:
+            return 2 * self.global_precision * self.global_recall / (
+                self.global_precision + self.global_recall)
+        return 0.
+
+    def matthewscc(self, use_global=False):
+        if use_global:
+            if not self.global_total_examples:
+                return 0.
+            true_pos = float(self.global_true_positives)
+            false_pos = float(self.global_false_positives)
+            false_neg = float(self.global_false_negatives)
+            true_neg = float(self.global_true_negatives)
+        else:
+            if not self.total_examples:
+                return 0.
+            true_pos = float(self.true_positives)
+            false_pos = float(self.false_positives)
+            false_neg = float(self.false_negatives)
+            true_neg = float(self.true_negatives)
+        terms = [(true_pos + false_pos), (true_pos + false_neg),
+                 (true_neg + false_pos), (true_neg + false_neg)]
+        denom = 1.
+        for t in filter(lambda t: t != 0., terms):
+            denom *= t
+        return ((true_pos * true_neg) - (false_pos * false_neg)) / math.sqrt(denom)
+
+    @property
+    def total_examples(self):
+        return self.false_negatives + self.false_positives + \
+            self.true_negatives + self.true_positives
+
+    @property
+    def global_total_examples(self):
+        return self.global_false_negatives + self.global_false_positives + \
+            self.global_true_negatives + self.global_true_positives
+
+    def reset_stats(self):
+        self.false_positives = 0
+        self.false_negatives = 0
+        self.true_positives = 0
+        self.true_negatives = 0
+
+    def reset(self):
+        self.reset_stats()
+        self.global_false_positives = 0
+        self.global_false_negatives = 0
+        self.global_true_positives = 0
+        self.global_true_negatives = 0
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (reference: metric.py F1)."""
+
+    def __init__(self, name='f1', output_names=None, label_names=None,
+                 average='macro'):
+        self.average = average
+        self.metrics = _BinaryClassificationMetrics()
+        EvalMetric.__init__(self, name=name, output_names=output_names,
+                            label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.metrics.update_binary_stats(label, pred)
+        if self.average == 'macro':
+            self.sum_metric += self.metrics.fscore
+            self.global_sum_metric += self.metrics.global_fscore
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self.metrics.reset_stats()
+        else:
+            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
+            self.global_sum_metric = (self.metrics.global_fscore *
+                                      self.metrics.global_total_examples)
+            self.num_inst = self.metrics.total_examples
+            self.global_num_inst = self.metrics.global_total_examples
+
+    def reset(self):
+        self.sum_metric = 0.
+        self.num_inst = 0.
+        self.global_sum_metric = 0.
+        self.global_num_inst = 0.
+        self.metrics.reset()
+
+    def reset_local(self):
+        self.sum_metric = 0.
+        self.num_inst = 0.
+        self.metrics.reset_stats()
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (reference: metric.py MCC)."""
+
+    def __init__(self, name='mcc', output_names=None, label_names=None,
+                 average='macro'):
+        self._average = average
+        self._metrics = _BinaryClassificationMetrics()
+        EvalMetric.__init__(self, name=name, output_names=output_names,
+                            label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self._metrics.update_binary_stats(label, pred)
+        if self._average == 'macro':
+            self.sum_metric += self._metrics.matthewscc()
+            self.global_sum_metric += self._metrics.matthewscc(use_global=True)
+            self.num_inst += 1
+            self.global_num_inst += 1
+            self._metrics.reset_stats()
+        else:
+            self.sum_metric = (self._metrics.matthewscc() *
+                               self._metrics.total_examples)
+            self.global_sum_metric = (
+                self._metrics.matthewscc(use_global=True) *
+                self._metrics.global_total_examples)
+            self.num_inst = self._metrics.total_examples
+            self.global_num_inst = self._metrics.global_total_examples
+
+    def reset(self):
+        self.sum_metric = 0.
+        self.num_inst = 0.
+        self.global_sum_metric = 0.
+        self.global_num_inst = 0.
+        self._metrics.reset()
+
+    def reset_local(self):
+        self.sum_metric = 0.
+        self.num_inst = 0.
+        self._metrics.reset_stats()
+
+
+@register
+class Perplexity(EvalMetric):
+    """Perplexity (reference: metric.py Perplexity)."""
+
+    def __init__(self, ignore_label, axis=-1, name='perplexity',
+                 output_names=None, label_names=None):
+        super().__init__(name, ignore_label=ignore_label,
+                         output_names=output_names, label_names=label_names,
+                         has_global_stats=True)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.
+        num = 0
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy() if isinstance(label, NDArray) \
+                else numpy.asarray(label)
+            pred_np = pred.asnumpy() if isinstance(pred, NDArray) \
+                else numpy.asarray(pred)
+            assert label_np.size == pred_np.size / pred_np.shape[-1], \
+                'shape mismatch'
+            label_np = label_np.reshape((label_np.size,)).astype('int32')
+            probs = pred_np.reshape(-1, pred_np.shape[-1])[
+                numpy.arange(label_np.size), label_np]
+            if self.ignore_label is not None:
+                ignore = (label_np == self.ignore_label).astype(probs.dtype)
+                num -= numpy.sum(ignore)
+                probs = probs * (1 - ignore) + ignore
+            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
+            num += label_np.size
+        self.sum_metric += loss
+        self.global_sum_metric += loss
+        self.num_inst += num
+        self.global_num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float('nan'))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float('nan'))
+        return (self.name, math.exp(self.global_sum_metric / self.global_num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    """Mean absolute error (reference: metric.py MAE)."""
+
+    def __init__(self, name='mae', output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            mae = numpy.abs(label - pred).mean()
+            self.sum_metric += mae
+            self.global_sum_metric += mae
+            self.num_inst += 1
+            self.global_num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    """Mean squared error (reference: metric.py MSE)."""
+
+    def __init__(self, name='mse', output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            mse = ((label - pred) ** 2.0).mean()
+            self.sum_metric += mse
+            self.global_sum_metric += mse
+            self.num_inst += 1
+            self.global_num_inst += 1
+
+
+@register
+class RMSE(EvalMetric):
+    """Root mean squared error (reference: metric.py RMSE)."""
+
+    def __init__(self, name='rmse', output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            rmse = numpy.sqrt(((label - pred) ** 2.0).mean())
+            self.sum_metric += rmse
+            self.global_sum_metric += rmse
+            self.num_inst += 1
+            self.global_num_inst += 1
+
+
+@_alias('ce')
+class CrossEntropy(EvalMetric):
+    """Cross entropy against class probabilities (reference: metric.py)."""
+
+    def __init__(self, eps=1e-12, name='cross-entropy', output_names=None,
+                 label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            label = label.ravel()
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
+            cross_entropy = (-numpy.log(prob + self.eps)).sum()
+            self.sum_metric += cross_entropy
+            self.global_sum_metric += cross_entropy
+            self.num_inst += label.shape[0]
+            self.global_num_inst += label.shape[0]
+
+
+@_alias('nll_loss')
+class NegativeLogLikelihood(EvalMetric):
+    """NLL (reference: metric.py NegativeLogLikelihood)."""
+
+    def __init__(self, eps=1e-12, name='nll-loss', output_names=None,
+                 label_names=None):
+        super().__init__(name, eps=eps, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            label = label.ravel()
+            num_examples = pred.shape[0]
+            assert label.shape[0] == num_examples, \
+                (label.shape[0], num_examples)
+            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
+                        numpy.int64(label)]
+            nll = (-numpy.log(prob + self.eps)).sum()
+            self.sum_metric += nll
+            self.global_sum_metric += nll
+            self.num_inst += num_examples
+            self.global_num_inst += num_examples
+
+
+@_alias('pearsonr')
+class PearsonCorrelation(EvalMetric):
+    """Pearson correlation (reference: metric.py PearsonCorrelation)."""
+
+    def __init__(self, name='pearsonr', output_names=None, label_names=None,
+                 average='macro'):
+        self.average = average
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+        if self.average == 'micro':
+            self.reset_micro()
+
+    def reset_micro(self):
+        self._sse_p = 0
+        self._mean_p = 0
+        self._sse_l = 0
+        self._mean_l = 0
+        self._pred_nums = 0
+        self._label_nums = 0
+        self._conv = 0
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+        if getattr(self, 'average', None) == 'micro':
+            self.reset_micro()
+
+    def update_variance(self, new_values, *aggregate):
+        count = len(new_values)
+        mean = numpy.mean(new_values)
+        variance = numpy.sum((new_values - mean) ** 2)
+        count_a, mean_a, var_a = aggregate
+        delta = mean - mean_a
+        m_a = var_a * (count_a - 1)
+        m_b = variance * (count - 1)
+        M2 = m_a + m_b + delta ** 2 * count_a * count / (count_a + count)
+        return count_a + count, (count_a * mean_a + count * mean) / (count_a + count), \
+            M2 / (count_a + count - 1)
+
+    def update_cov(self, label, pred):
+        self._conv = self._conv + numpy.sum(
+            (label - self._mean_l) * (pred - self._mean_p))
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            check_label_shapes(label, pred, False, True)
+            label = label.asnumpy().ravel().astype(numpy.float64)
+            pred = pred.asnumpy().ravel().astype(numpy.float64)
+            if self.average == 'macro':
+                pearson_corr = numpy.corrcoef(pred, label)[0, 1]
+                self.sum_metric += pearson_corr
+                self.global_sum_metric += pearson_corr
+                self.num_inst += 1
+                self.global_num_inst += 1
+            else:
+                self.global_num_inst += 1
+                self.num_inst += 1
+                self._label_nums, self._mean_l, self._sse_l = \
+                    self.update_variance(label, self._label_nums,
+                                         self._mean_l, self._sse_l)
+                self.update_cov(label, pred)
+                self._pred_nums, self._mean_p, self._sse_p = \
+                    self.update_variance(pred, self._pred_nums,
+                                         self._mean_p, self._sse_p)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float('nan'))
+        if self.average == 'macro':
+            return (self.name, self.sum_metric / self.num_inst)
+        n = self._label_nums
+        numerator = self._conv
+        denominator = n * numpy.sqrt(self._sse_p) * numpy.sqrt(self._sse_l)
+        if denominator == 0:
+            return (self.name, float('nan'))
+        return (self.name, float(numerator / denominator))
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation via confusion matrix
+    (reference: metric.py PCC)."""
+
+    def __init__(self, name='pcc', output_names=None, label_names=None):
+        self.k = 2
+        super().__init__(name=name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def _grow(self, inc):
+        self.lcm = numpy.pad(self.lcm, ((0, inc), (0, inc)), 'constant')
+        self.gcm = numpy.pad(self.gcm, ((0, inc), (0, inc)), 'constant')
+        self.k += inc
+
+    def _calc_mcc(self, cmat):
+        n = cmat.sum()
+        x = cmat.sum(axis=1)
+        y = cmat.sum(axis=0)
+        cov_xx = numpy.sum(x * (n - x))
+        cov_yy = numpy.sum(y * (n - y))
+        if cov_xx == 0 or cov_yy == 0:
+            return float('nan')
+        i = cmat.diagonal()
+        cov_xy = numpy.sum(i * n - x * y)
+        return cov_xy / (cov_xx * cov_yy) ** 0.5
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy().astype('int32', copy=False)
+            pred = pred.asnumpy()
+            if pred.shape != label.shape:
+                pred = pred.argmax(axis=1).astype('int32', copy=False)
+            else:
+                pred = pred.astype('int32', copy=False)
+            n = max(pred.max(), label.max())
+            if n >= self.k:
+                self._grow(n + 1 - self.k)
+            bcm = numpy.zeros((self.k, self.k))
+            for i, j in zip(pred, label):
+                bcm[i, j] += 1
+            self.lcm += bcm
+            self.gcm += bcm
+        self.num_inst += 1
+        self.global_num_inst += 1
+
+    @property
+    def sum_metric(self):
+        return self._calc_mcc(self.lcm) * self.num_inst
+
+    @property
+    def global_sum_metric(self):
+        return self._calc_mcc(self.gcm) * self.global_num_inst
+
+    @sum_metric.setter
+    def sum_metric(self, _):
+        pass
+
+    @global_sum_metric.setter
+    def global_sum_metric(self, _):
+        pass
+
+    def reset(self):
+        self.global_num_inst = 0.
+        self.gcm = numpy.zeros((self.k, self.k))
+        self.reset_local()
+
+    def reset_local(self):
+        self.num_inst = 0.
+        self.lcm = numpy.zeros((self.k, self.k))
+
+
+@register
+class Loss(EvalMetric):
+    """Dummy metric averaging a loss output (reference: metric.py Loss)."""
+
+    def __init__(self, name='loss', output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names, has_global_stats=True)
+
+    def update(self, _, preds):
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        for pred in preds:
+            loss = float(pred.asnumpy().sum())
+            self.sum_metric += loss
+            self.global_sum_metric += loss
+            self.num_inst += pred.size
+            self.global_num_inst += pred.size
+
+
+@register
+class Torch(Loss):
+    """Legacy alias (reference: metric.py Torch)."""
+
+    def __init__(self, name='torch', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Loss):
+    """Legacy alias (reference: metric.py Caffe)."""
+
+    def __init__(self, name='caffe', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    """Metric from a feval function (reference: metric.py CustomMetric)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find('<') != -1:
+                name = 'custom(%s)' % name
+        super().__init__(name, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs,
+                         output_names=output_names, label_names=label_names,
+                         has_global_stats=True)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            labels, preds = check_label_shapes(labels, preds, True)
+        for pred, label in zip(preds, labels):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.global_sum_metric += sum_metric
+                self.num_inst += num_inst
+                self.global_num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.global_sum_metric += reval
+                self.num_inst += 1
+                self.global_num_inst += 1
+
+    def get_config(self):
+        raise NotImplementedError('CustomMetric cannot be serialized')
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy feval as a CustomMetric factory (reference: metric.py np)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -1,0 +1,64 @@
+"""Automatic naming for layers/symbols.
+
+Reference parity: python/mxnet/name.py (NameManager with per-hint counters,
+Prefix manager). Used by gluon._BlockScope and symbol variable creation.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ['NameManager', 'Prefix']
+
+
+class NameManager:
+    """Manages automatic naming with per-type counters."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        """Return name if given, else generate `hint%d`."""
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = '%s%d' % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._current, 'value'):
+            NameManager._current.value = NameManager()
+        self._old_manager = NameManager._current.value
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager
+        NameManager._current.value = self._old_manager
+
+
+class Prefix(NameManager):
+    """Prepends a prefix to all generated names."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+# expose a class-level 'current' accessor matching the reference's usage
+class _CurrentProxy:
+    def get(self, name, hint):
+        if not hasattr(NameManager._current, 'value'):
+            NameManager._current.value = NameManager()
+        return NameManager._current.value.get(name, hint)
+
+
+NameManager.current = _CurrentProxy()

@@ -6,8 +6,8 @@ and under .op / ._internal, mirroring the reference's generated layout.
 import sys as _sys
 
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
-                      invoke, concatenate, moveaxis, save, load, waitall,
-                      _wrap_outputs)
+                      invoke, concatenate, moveaxis, maximum, minimum,
+                      save, load, waitall, _wrap_outputs)
 from . import register as _register
 
 op = _register.make_op_module(__name__ + '.op')

@@ -24,8 +24,8 @@ from ..ops import registry as _registry
 from .. import random as _random
 
 __all__ = ['NDArray', 'array', 'zeros', 'ones', 'full', 'empty', 'arange',
-           'invoke', 'concatenate', 'moveaxis', 'save', 'load', 'waitall',
-           'imports_done']
+           'invoke', 'concatenate', 'moveaxis', 'maximum', 'minimum',
+           'save', 'load', 'waitall', 'imports_done']
 
 
 def _is_float(x):
@@ -280,25 +280,29 @@ class NDArray:
     def __iadd__(self, o):
         out = self._binary('broadcast_add', o)
         self._data = out._data
-        self._entry = out._entry
+        if out._entry is not None:
+            self._entry = out._entry
         return self
 
     def __isub__(self, o):
         out = self._binary('broadcast_sub', o)
         self._data = out._data
-        self._entry = out._entry
+        if out._entry is not None:
+            self._entry = out._entry
         return self
 
     def __imul__(self, o):
         out = self._binary('broadcast_mul', o)
         self._data = out._data
-        self._entry = out._entry
+        if out._entry is not None:
+            self._entry = out._entry
         return self
 
     def __itruediv__(self, o):
         out = self._binary('broadcast_div', o)
         self._data = out._data
-        self._entry = out._entry
+        if out._entry is not None:
+            self._entry = out._entry
         return self
 
     # -- method sugar delegating to ops ------------------------------------
@@ -464,7 +468,12 @@ def invoke(opname, nd_inputs, attrs, out=None):
         for tgt, src in zip(out_list, outputs):
             if tgt is not None:
                 tgt._data = src._data
-                tgt._entry = src._entry
+                # preserve leaf (variable) entries on in-place writes outside
+                # recording — optimizer updates must not demote parameters
+                # from autograd leaves (reference: engine write on a var
+                # keeps its autograd entry)
+                if src._entry is not None:
+                    tgt._entry = src._entry
         first = out_list[0] if out_list else outputs[0]
         return out if not isinstance(out, (list, tuple)) else out_list
     if op.mutate_idx and not recording:
@@ -548,6 +557,25 @@ def concatenate(arrays, axis=0, always_copy=True):
 
 def moveaxis(tensor, source, destination):
     return NDArray(jnp.moveaxis(tensor._data, source, destination))
+
+
+def maximum(lhs, rhs):
+    """Elementwise max with scalar/broadcast handling
+    (reference: python/mxnet/ndarray/ndarray.py maximum)."""
+    if isinstance(lhs, NDArray):
+        return lhs._binary('broadcast_maximum', rhs)
+    if isinstance(rhs, NDArray):
+        return rhs._binary('broadcast_maximum', lhs)
+    return max(lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    """Elementwise min (reference twin of maximum)."""
+    if isinstance(lhs, NDArray):
+        return lhs._binary('broadcast_minimum', rhs)
+    if isinstance(rhs, NDArray):
+        return rhs._binary('broadcast_minimum', lhs)
+    return min(lhs, rhs)
 
 
 def waitall():

@@ -73,7 +73,9 @@ def signsgd_update(weight, grad, *, lr=None, wd=0.0, rescale_grad=1.0,
 @register('signum_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
 def signum_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
-    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    # wd folds into the gradient before the sign (reference:
+    # optimizer_op.cc signum kernel); wd_lh decays the weight directly
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom - (1 - momentum) * g
     w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
     return w, new_mom

@@ -1,0 +1,5 @@
+"""mxnet_tpu.optimizer (reference: python/mxnet/optimizer/)."""
+from .optimizer import (Optimizer, SGD, Signum, FTML, DCASGD, NAG, SGLD,
+                        Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax,
+                        Nadam, LBSGD, AdamW, Test, Updater, register, create,
+                        get_updater, opt_registry, ccSGD)

@@ -1,0 +1,744 @@
+"""Optimizers: registry + the reference's full class zoo.
+
+Reference parity: python/mxnet/optimizer/optimizer.py:511-1604 (SGD w/
+momentum + fp16 master copy, Signum, FTML, LBSGD, DCASGD, NAG, SGLD, Adam,
+AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam; Updater :1621).
+
+TPU-native design: each update is a registered *op* (ops/optimizer_ops.py),
+i.e. a pure jax function — the analog of the reference's fused
+`sgd_mom_update`-style kernels (src/operator/optimizer_op.cc:506-840). The
+eager path mutates weights in place via the registry's mutate hook; the jit
+path (Trainer/Module with hybridized step) calls the same pure functions
+inside one compiled train step so XLA fuses the whole optimizer.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+import warnings
+
+import numpy
+
+from ..base import string_types
+from .. import ndarray as nd
+from ..ndarray import NDArray, zeros, ones, full, invoke
+
+__all__ = ['Optimizer', 'SGD', 'Signum', 'FTML', 'DCASGD', 'NAG', 'SGLD',
+           'Adam', 'AdaGrad', 'RMSProp', 'AdaDelta', 'Ftrl', 'Adamax',
+           'Nadam', 'LBSGD', 'AdamW', 'Test', 'Updater', 'register',
+           'create', 'get_updater', 'opt_registry', 'ccSGD']
+
+opt_registry = {}
+
+
+def register(klass):
+    """Register an Optimizer subclass under its lowercase name
+    (reference: optimizer.py Optimizer.register)."""
+    assert isinstance(klass, type)
+    name = klass.__name__.lower()
+    if name in opt_registry:
+        warnings.warn('WARNING: New optimizer %s.%s is overriding existing '
+                      'optimizer %s.%s' % (klass.__module__, klass.__name__,
+                                           opt_registry[name].__module__,
+                                           opt_registry[name].__name__))
+    opt_registry[name] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    """Instantiate an optimizer by registered name."""
+    if isinstance(name, Optimizer):
+        return name
+    if isinstance(name, string_types) and name.lower() in opt_registry:
+        return opt_registry[name.lower()](**kwargs)
+    raise ValueError('Cannot find optimizer %s' % name)
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:39).
+
+    Tracks per-parameter update counts, lr/wd multipliers, rescale/clip.
+    """
+
+    opt_registry = opt_registry
+
+    def __init__(self, rescale_grad=1., param_idx2name=None, wd=0.,
+                 clip_gradient=None, learning_rate=0.01,
+                 lr_scheduler=None, sym=None, begin_num_update=0,
+                 multi_precision=False, param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = 0
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            'param_idx2name should be a dict of param indexes to names.'
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- registry passthroughs (reference keeps them as staticmethods) ----
+    register = staticmethod(register)
+    create_optimizer = staticmethod(create)
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        """Create optimizer state (momentum etc.) for one weight."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp16 master-weight wrapper (reference: optimizer.py:270)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == numpy.float16:
+            weight_master_copy = weight.astype(numpy.float32)
+            return (weight_master_copy, self.create_state(index, weight_master_copy))
+        if weight.dtype == numpy.float16 and not self.multi_precision:
+            warnings.warn('Accumulating with float16 in optimizer can lead '
+                          'to poor accuracy or slow convergence. Consider '
+                          'using multi_precision=True option of the optimizer')
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == numpy.float16:
+            weight_master_copy, original_state = state
+            grad32 = grad.astype(numpy.float32)
+            self.update(index, weight_master_copy, grad32, original_state)
+            weight[:] = weight_master_copy.astype(weight.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- lr/wd plumbing ----------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning('LRScheduler of the optimizer has already been '
+                              'defined. Note that set_learning_rate can mutate '
+                              'the value of the learning rate of the optimizer '
+                              'only when the LRScheduler of the optimizer is '
+                              'undefined.')
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and '__lr_mult__' in attr[name]:
+                    self.lr_mult[name] = float(attr[name]['__lr_mult__'])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith('_weight')
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and '__wd_mult__' in attr[name]:
+                    self.wd_mult[name] = float(attr[name]['__wd_mult__'])
+        self.wd_mult.update(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        del ret['_all_index_update_counts']
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__ = state
+        self._all_index_update_counts = {0: self._index_update_count}
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, weight decay, fp16 master weights and lazy sparse
+    updates (reference: optimizer.py:511; op src/operator/optimizer_op.cc:506).
+    """
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, multi_precision=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and weight.dtype == numpy.float16
+        self._update_impl(index, weight, grad, state, multi_precision=use_mp)
+
+    def _update_impl(self, index, weight, grad, state, multi_precision=False):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                  'clip_gradient': self.clip_gradient}
+        if not multi_precision:
+            if state is not None:
+                invoke('sgd_mom_update', [weight, grad, state],
+                       dict(momentum=self.momentum, **kwargs),
+                       out=[weight, state])
+            else:
+                invoke('sgd_update', [weight, grad], kwargs, out=weight)
+        else:
+            weight32, mom = state
+            if mom is not None:
+                invoke('mp_sgd_mom_update', [weight, grad, mom, weight32],
+                       dict(momentum=self.momentum, **kwargs),
+                       out=[weight, mom, weight32])
+            else:
+                invoke('mp_sgd_update', [weight, grad, weight32], kwargs,
+                       out=[weight, weight32])
+
+
+@register
+class Signum(Optimizer):
+    """SignSGD / Signum (reference: optimizer.py Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                  'clip_gradient': self.clip_gradient}
+        if state is not None:
+            invoke('signum_update', [weight, grad, state],
+                   dict(momentum=self.momentum, wd_lh=self.wd_lh, **kwargs),
+                   out=[weight, state])
+        else:
+            invoke('signsgd_update', [weight, grad], kwargs, out=weight)
+
+
+@register
+class FTML(Optimizer):
+    """FTML (reference: optimizer.py FTML; op optimizer_op.cc:622)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # d
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # v
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))  # z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        invoke('ftml_update', [weight, grad, d, v, z],
+               {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                'clip_grad': self.clip_gradient, 'beta1': self.beta1,
+                'beta2': self.beta2, 'epsilon': self.epsilon, 't': t},
+               out=[weight, d, v, z])
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        mon, previous_weight = state
+        delta = -lr * (grad + wd * weight + self.lamda * grad * grad *
+                       (weight - previous_weight))
+        if mon is not None:
+            mon[:] = self.momentum * mon + delta
+            delta = mon
+        previous_weight[:] = weight
+        weight[:] = weight + delta
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom[:] = self.momentum * mom + grad + wd * weight
+            grad[:] = self.momentum * mom + grad
+            weight[:] = weight - lr * grad
+        else:
+            weight[:] = weight - lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype=weight.dtype)
+        weight[:] = weight - lr / 2 * (grad + wd * weight) + noise
+
+
+@register  # pylint: disable=invalid-name
+class ccSGD(SGD):
+    """Deprecated alias of SGD (reference keeps it)."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: optimizer.py:1122; op optimizer_op.cc:654)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # mean
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))  # var
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        invoke('adam_update', [weight, grad, mean, var],
+               {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                'clip_gradient': self.clip_gradient, 'beta1': self.beta1,
+                'beta2': self.beta2, 'epsilon': self.epsilon},
+               out=[weight, mean, var])
+
+
+@register
+class AdamW(Optimizer):
+    """AdamW with decoupled weight decay (reference: contrib/adamw.cc +
+    python/mxnet/optimizer contrib adamw)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        eta = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        rescale = nd.full((1,), self.rescale_grad, dtype=weight.dtype)
+        invoke('_adamw_update', [weight, grad, mean, var, rescale],
+               {'lr': 1.0, 'eta': eta, 'wd': wd,
+                'clip_gradient': self.clip_gradient, 'beta1': self.beta1,
+                'beta2': self.beta2, 'epsilon': self.epsilon},
+               out=[weight, mean, var])
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: optimizer.py AdaGrad)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        invoke('_sparse_adagrad_update', [weight, grad, state],
+               {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                'clip_gradient': self.clip_gradient,
+                'epsilon': self.float_stable_eps},
+               out=[weight, state])
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered or not (reference: optimizer.py RMSProp)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # n
+                    zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # g
+                    zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))  # delta
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                  'clip_gradient': self.clip_gradient, 'gamma1': self.gamma1,
+                  'epsilon': self.epsilon}
+        if self.clip_weights:
+            kwargs['clip_weights'] = self.clip_weights
+        if not self.centered:
+            invoke('rmsprop_update', [weight, grad, state], kwargs,
+                   out=[weight, state])
+        else:
+            n, g, delta = state
+            invoke('rmspropalex_update', [weight, grad, n, g, delta],
+                   dict(gamma2=self.gamma2, **kwargs),
+                   out=[weight, n, g, delta])
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer.py AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1. - self.rho) * grad * grad
+        current_delta = ((acc_delta + self.epsilon).sqrt()
+                         / (acc_g + self.epsilon).sqrt()) * grad
+        acc_delta[:] = self.rho * acc_delta + (1. - self.rho) * \
+            current_delta * current_delta
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL (reference: optimizer.py Ftrl; op optimizer_op.cc:799)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(**kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+        self.lr = learning_rate
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # z
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        z, n = state
+        invoke('ftrl_update', [weight, grad, z, n],
+               {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                'clip_gradient': self.clip_gradient, 'lamda1': self.lamda1,
+                'beta': self.beta},
+               out=[weight, z, n])
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference: optimizer.py Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1. - self.beta1 ** t)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        m_t, u_t = state
+        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
+        u_t[:] = nd.maximum(self.beta2 * u_t, grad.abs())
+        weight[:] = weight - lr * m_t / u_t
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.py Nadam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1. - 0.5 * (pow(0.96, t * self.schedule_decay)))
+        momentum_t_1 = self.beta1 * (1. - 0.5 *
+                                     (pow(0.96, (t + 1) * self.schedule_decay)))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
+        v_t[:] = self.beta2 * v_t + (1. - self.beta2) * grad * grad
+        grad_prime = grad / (1. - self.m_schedule)
+        m_t_prime = m_t / (1. - m_schedule_next)
+        v_t_prime = v_t / (1. - pow(self.beta2, t))
+        m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        weight[:] = weight - lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS layer-wise lr adaptation
+    (reference: optimizer.py LBSGD; warmup strategies approximated by the
+    lr_scheduler warmup — the reference embeds them in the optimizer)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy='linear', warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, multi_precision=multi_precision,
+                         **kwargs)
+        self.eta = 0.001  # LARS trust coefficient
+
+    def _update_impl(self, index, weight, grad, state, multi_precision=False):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        # LARS: scale lr by ||w|| / (||g|| + wd*||w||)
+        wnorm = float(weight.norm().asscalar())
+        gnorm = float((grad * self.rescale_grad).norm().asscalar())
+        if wnorm > 0 and gnorm > 0:
+            lr *= self.eta * wnorm / (gnorm + wd * wnorm + 1e-9)
+        kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                  'clip_gradient': self.clip_gradient}
+        if state is not None and not multi_precision:
+            invoke('sgd_mom_update', [weight, grad, state],
+                   dict(momentum=self.momentum, **kwargs),
+                   out=[weight, state])
+        elif not multi_precision:
+            invoke('sgd_update', [weight, grad], kwargs, out=weight)
+        else:
+            super()._update_impl(index, weight, grad, state, multi_precision)
+
+
+@register
+class Test(Optimizer):
+    """Simple test optimizer (reference: optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight + grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater:
+    """KVStore-side updater closure (reference: optimizer.py:1621)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices = [index]
+            grads = [grad]
+            weights = [weight]
+        else:
+            indices, grads, weights = index, grad, weight
+        for i, (idx, g, w) in enumerate(zip(indices, grads, weights)):
+            if idx not in self.states:
+                self.states[idx] = \
+                    self.optimizer.create_state_multi_precision(idx, w)
+                self.states_synced[idx] = True
+            elif not self.states_synced[idx]:
+                self.states[idx] = self.sync_state_context(self.states[idx],
+                                                           w.context)
+                self.states_synced[idx] = True
+            self.optimizer.update_multi_precision(idx, w, g, self.states[idx])
+
+    def sync_state_context(self, state, context):
+        if isinstance(state, NDArray):
+            return state.as_in_context(context)
+        if isinstance(state, (tuple, list)):
+            return type(state)(
+                self.sync_state_context(i, context) for i in state)
+        return state
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer) if dump_optimizer
+                            else self.states)
+
+
+def get_updater(optimizer):
+    """Wrap an optimizer as an updater callable (reference: optimizer.py)."""
+    return Updater(optimizer)

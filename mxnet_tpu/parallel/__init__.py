@@ -1,0 +1,15 @@
+"""mxnet_tpu.parallel — SPMD training over a jax.sharding.Mesh.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack (SURVEY.md §2.4/§5.8): KVStore Comm trees, NCCL, ps-lite
+push/pull and the dmlc launcher collapse into sharding annotations on one
+jit-compiled train step; XLA inserts the collectives (psum/all_gather/
+reduce_scatter) over ICI/DCN.
+
+Axes convention: 'dp' (data/batch), 'tp' (tensor/model), 'pp' (pipeline
+stage), 'sp' (sequence/context), 'ep' (expert). Single-chip training is the
+degenerate 1x1 mesh — the same code path.
+"""
+from .mesh import create_mesh, current_mesh, local_mesh
+from .train_step import ParallelTrainer, pure_forward_fn
+from .sharding import ShardingRules, infer_param_sharding

@@ -1,0 +1,62 @@
+"""Parameter sharding rules.
+
+Reference analog: symbol attr ctx_group + AssignContext device placement
+(graph_executor.cc:984) — the only model-parallel mechanism MXNet has.
+Here placement is a PartitionSpec per parameter: Megatron-style TP for
+matmul weights, replication for everything else, with the embedding table
+sharded on its vocab axis. The rules are name/shape heuristics overridable
+per-parameter.
+"""
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ['ShardingRules', 'infer_param_sharding']
+
+
+class ShardingRules:
+    """Maps parameter name+shape -> PartitionSpec.
+
+    Default policy (applied only when the mesh has a 'tp' axis >1):
+      * Dense/FullyConnected weights (2-D, (out, in)): alternate column/row
+        parallel by depth is unavailable without graph context, so shard the
+        OUT dim on 'tp' (column parallel) — safe because activations stay
+        replicated and XLA all-gathers where needed.
+      * Embedding tables (vocab, dim): shard vocab on 'tp'.
+      * Conv kernels (out, in, kh, kw): shard out channels on 'tp'.
+      * 1-D params (bias/gamma/beta/stats): replicated.
+    Overrides: dict name-substring -> PartitionSpec.
+    """
+
+    def __init__(self, overrides=None, default_tp_axis='tp'):
+        self.overrides = dict(overrides or {})
+        self.tp = default_tp_axis
+
+    def spec_for(self, name, shape, mesh):
+        for frag, spec in self.overrides.items():
+            if frag in name:
+                return spec
+        if self.tp not in mesh.axis_names or \
+                mesh.shape.get(self.tp, 1) <= 1:
+            return P()
+        tp_size = mesh.shape[self.tp]
+        if len(shape) >= 2 and shape[0] % tp_size == 0:
+            # (out, in, ...) → column-parallel on out
+            return P(self.tp, *([None] * (len(shape) - 1)))
+        return P()
+
+
+def infer_param_sharding(params, mesh, rules=None):
+    """Return [NamedSharding] aligned with the params list.
+
+    params: list of gluon Parameter (or (name, shape) tuples).
+    """
+    rules = rules or ShardingRules()
+    out = []
+    for p in params:
+        if isinstance(p, tuple):
+            name, shape = p
+        else:
+            name, shape = p.name, p.shape
+        out.append(NamedSharding(mesh, rules.spec_for(name, shape, mesh)))
+    return out

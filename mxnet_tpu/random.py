@@ -29,10 +29,34 @@ def seed(seed_state, ctx='all'):
 
 
 def next_key():
-    """Split a fresh subkey off the global chain."""
+    """Split a fresh subkey off the global chain.
+
+    Inside a trace (HybridBlock/CachedOp jit), an override key installed by
+    ``key_override`` is split instead, so compiled graphs consume an explicit
+    key argument rather than baking in a host constant.
+    """
+    ov = getattr(_state, 'override', None)
+    if ov is not None:
+        ov[0], sub = jax.random.split(ov[0])
+        return sub
     key = _get_key()
     _state.key, sub = jax.random.split(key)
     return sub
+
+
+class key_override:
+    """Context manager routing next_key() through a provided (traced) key."""
+
+    def __init__(self, key):
+        self._holder = [key]
+
+    def __enter__(self):
+        self._prev = getattr(_state, 'override', None)
+        _state.override = self._holder
+        return self
+
+    def __exit__(self, *exc):
+        _state.override = self._prev
 
 
 def current_key():

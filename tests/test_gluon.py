@@ -1,0 +1,426 @@
+"""Gluon Block/HybridBlock/Trainer/layers/losses tests.
+
+Modeled on the reference's tests/python/unittest/test_gluon.py (2,731 LoC):
+layer forward shapes, hybridize consistency, deferred shape inference,
+parameter save/load, trainer updates, loss values.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter('weight', shape=(10, 10))
+    p.initialize(init='xavier')
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert len(p.list_data()) == 1
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter('weight', shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_paramdict():
+    params = gluon.ParameterDict('net_')
+    params.get('weight', shape=(10, 10))
+    assert list(params.keys()) == ['net_weight']
+    params.initialize(ctx=mx.cpu())
+    prev = params['net_weight'].data().asnumpy().copy()
+    fname = os.path.join(tempfile.mkdtemp(), 'test.params')
+    params.save(fname)
+    params.load(fname, mx.cpu())
+    np.testing.assert_allclose(params['net_weight'].data().asnumpy(), prev)
+
+
+def test_constant():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = np.asarray([[1, 2], [3, 4]], dtype='float32')
+            self.const = self.params.get_constant('const', self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), 'sgd',
+                            {'learning_rate': 1.0, 'momentum': 0.5})
+    with autograd.record():
+        x = nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_dense():
+    model = nn.Dense(128, activation='tanh', in_units=10, flatten=False,
+                     prefix='test_')
+    inputs = nd.zeros((2, 3, 10))
+    model.initialize()
+    out = model(inputs)
+    assert out.shape == (2, 3, 128)
+    assert list(model.collect_params().keys()) == ['test_weight', 'test_bias']
+
+    model = nn.Dense(64, in_units=30, prefix='test2_')
+    inputs = nd.zeros((17, 2, 15))
+    model.initialize()
+    out = model(inputs)
+    assert out.shape == (17, 64)
+
+
+def test_dense_deferred_and_hybrid_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation='relu'), nn.Dense(8))
+    net.initialize()
+    x = nd.array(np.random.randn(4, 16))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize('layer,shape', [
+    (lambda: nn.Conv1D(16, 3, in_channels=4), (1, 4, 10)),
+    (lambda: nn.Conv2D(16, (3, 4), in_channels=4), (1, 4, 20, 20)),
+    (lambda: nn.Conv2D(16, (3, 3), groups=2, in_channels=4), (1, 4, 10, 10)),
+    (lambda: nn.Conv3D(16, (1, 8, 4), in_channels=4, activation='relu'),
+     (1, 4, 10, 10, 10)),
+    (lambda: nn.Conv2DTranspose(16, (3, 4), in_channels=4), (1, 4, 20, 20)),
+])
+def test_conv_layers(layer, shape):
+    blk = layer()
+    blk.initialize()
+    x = nd.array(np.random.uniform(size=shape))
+    with autograd.record():
+        out = blk(x)
+    out.backward()
+    assert blk.weight.grad().shape == blk.weight.shape
+    # hybrid consistency
+    blk2 = layer()
+    blk2.initialize()
+    for (k1, p1), (k2, p2) in zip(blk.collect_params().items(),
+                                  blk2.collect_params().items()):
+        p2.set_data(p1.data())
+    blk2.hybridize()
+    np.testing.assert_allclose(blk(x).asnumpy(), blk2(x).asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_values_vs_numpy():
+    # 1x1 kernel conv == pointwise matmul
+    blk = nn.Conv2D(8, 1, in_channels=3, use_bias=False)
+    blk.initialize()
+    x = np.random.randn(2, 3, 5, 5).astype('float32')
+    out = blk(nd.array(x)).asnumpy()
+    w = blk.weight.data().asnumpy()[:, :, 0, 0]
+    expect = np.einsum('nchw,oc->nohw', x, w)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize('layer,shape', [
+    (lambda: nn.MaxPool1D(), (1, 2, 10)),
+    (lambda: nn.MaxPool2D((3, 3)), (1, 2, 10, 10)),
+    (lambda: nn.AvgPool2D(), (1, 2, 10, 10)),
+    (lambda: nn.GlobalAvgPool2D(), (1, 2, 10, 10)),
+    (lambda: nn.GlobalMaxPool2D(), (1, 2, 10, 10)),
+    (lambda: nn.MaxPool2D((3, 3), ceil_mode=True), (1, 2, 10, 10)),
+])
+def test_pool_layers(layer, shape):
+    blk = layer()
+    blk.initialize()
+    x = nd.array(np.random.uniform(size=shape))
+    out = blk(x)
+    assert out.shape[0] == shape[0] and out.shape[1] == shape[1]
+
+
+def test_pool_value():
+    x = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    out = nn.MaxPool2D(2, 2)(nd.array(x)).asnumpy()
+    expect = np.array([[[[5, 7], [13, 15]]]], dtype='float32')
+    np.testing.assert_allclose(out, expect)
+    out = nn.AvgPool2D(2, 2)(nd.array(x)).asnumpy()
+    expect = np.array([[[[2.5, 4.5], [10.5, 12.5]]]], dtype='float32')
+    np.testing.assert_allclose(out, expect)
+
+
+def test_batchnorm_running_stats():
+    layer = nn.BatchNorm(in_channels=4)
+    layer.initialize()
+    x = nd.array(np.random.randn(8, 4, 3, 3) * 2 + 5)
+    with autograd.record():
+        y = layer(x)
+    y.backward()
+    rm = layer.running_mean.data().asnumpy()
+    # running mean moved toward batch mean (5) by (1-momentum)
+    assert np.all(rm > 0.3), rm
+    # inference mode uses running stats: no crash and finite
+    out = layer(x)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_batchnorm_hybrid_matches_eager():
+    l1 = nn.BatchNorm(in_channels=3)
+    l1.initialize()
+    x = nd.array(np.random.randn(4, 3, 8, 8))
+    with autograd.record():
+        e = l1(x)
+    l2 = nn.BatchNorm(in_channels=3)
+    l2.initialize()
+    l2.hybridize()
+    with autograd.record():
+        h = l2(x)
+    np.testing.assert_allclose(e.asnumpy(), h.asnumpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(l1.running_mean.data().asnumpy(),
+                               l2.running_mean.data().asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm_instancenorm():
+    for layer, shape in [(nn.LayerNorm(in_channels=10), (2, 4, 10)),
+                         (nn.InstanceNorm(in_channels=4), (2, 4, 5, 5))]:
+        layer.initialize()
+        out = layer(nd.array(np.random.randn(*shape)))
+        assert out.shape == shape
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 5)
+    layer.initialize()
+    x = nd.array([2, 3, 4])
+    with autograd.record():
+        y = layer(x)
+    y.backward()
+    assert y.shape == (3, 5)
+    grad = layer.weight.grad().asnumpy()
+    assert (grad[2:5] == 1).all()
+    assert (grad[:2] == 0).all() and (grad[5:] == 0).all()
+
+
+def test_activations():
+    x = nd.array(np.random.randn(4, 5))
+    for blk, ref in [
+            (nn.Activation('relu'), lambda v: np.maximum(v, 0)),
+            (nn.LeakyReLU(0.1), lambda v: np.where(v > 0, v, 0.1 * v)),
+            (nn.ELU(1.0), lambda v: np.where(v > 0, v, np.expm1(v))),
+            (nn.SELU(), None), (nn.GELU(), None), (nn.Swish(), None)]:
+        blk.initialize()
+        out = blk(x).asnumpy()
+        if ref is not None:
+            np.testing.assert_allclose(out, ref(x.asnumpy()), rtol=1e-5,
+                                       atol=1e-6)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    out = prelu(x).asnumpy()
+    np.testing.assert_allclose(out, np.where(x.asnumpy() > 0, x.asnumpy(),
+                                             0.25 * x.asnumpy()),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_losses():
+    B, C = 6, 4
+    pred = nd.array(np.random.randn(B, C))
+    label = nd.array(np.random.randint(0, C, (B,)))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    p = pred.asnumpy()
+    logp = p - np.log(np.exp(p - p.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+        - p.max(-1, keepdims=True)
+    expect = -logp[np.arange(B), label.asnumpy().astype(int)]
+    np.testing.assert_allclose(l.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+    y = nd.array(np.random.randn(B, 3))
+    t = nd.array(np.random.randn(B, 3))
+    l2 = gluon.loss.L2Loss()(y, t)
+    np.testing.assert_allclose(
+        l2.asnumpy(), 0.5 * ((y.asnumpy() - t.asnumpy()) ** 2).mean(-1),
+        rtol=1e-5, atol=1e-6)
+    l1 = gluon.loss.L1Loss()(y, t)
+    np.testing.assert_allclose(
+        l1.asnumpy(), np.abs(y.asnumpy() - t.asnumpy()).mean(-1),
+        rtol=1e-5, atol=1e-6)
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    lab = nd.array(np.random.randint(0, 2, (B, 3)).astype('float32'))
+    lv = bce(y, lab).asnumpy()
+    z = y.asnumpy()
+    expect = (np.maximum(z, 0) - z * lab.asnumpy() +
+              np.log1p(np.exp(-np.abs(z)))).mean(-1)
+    np.testing.assert_allclose(lv, expect, rtol=1e-4, atol=1e-5)
+    # huber / hinge / logistic smoke
+    for L in [gluon.loss.HuberLoss(), gluon.loss.HingeLoss(),
+              gluon.loss.SquaredHingeLoss(), gluon.loss.LogisticLoss(),
+              gluon.loss.KLDivLoss()]:
+        out = L(y, t)
+        assert out.shape == (B,)
+
+
+def test_trainer_sgd_matches_manual():
+    p = gluon.Parameter('w', shape=(4,))
+    p.initialize(init='ones')
+    trainer = gluon.Trainer({'w': p}, 'sgd',
+                            {'learning_rate': 0.5, 'momentum': 0.0})
+    with autograd.record():
+        loss = (p.data() * p.data()).sum()
+    loss.backward()
+    trainer.step(1)
+    # dL/dw = 2w = 2; w' = 1 - 0.5*2 = 0
+    np.testing.assert_allclose(p.data().asnumpy(), np.zeros(4), atol=1e-6)
+
+
+def test_trainer_states_roundtrip():
+    p = gluon.Parameter('w', shape=(4,))
+    p.initialize(init='ones')
+    trainer = gluon.Trainer({'w': p}, 'sgd',
+                            {'learning_rate': 0.1, 'momentum': 0.9})
+    with autograd.record():
+        loss = (p.data() * p.data()).sum()
+    loss.backward()
+    trainer.step(1)
+    fname = os.path.join(tempfile.mkdtemp(), 'trainer.states')
+    trainer.save_states(fname)
+    trainer.load_states(fname)
+    with autograd.record():
+        loss = (p.data() * p.data()).sum()
+    loss.backward()
+    trainer.step(1)
+    assert np.isfinite(p.data().asnumpy()).all()
+
+
+def test_sequential_training_converges():
+    """Mini end-to-end: 2-layer MLP fits a small random mapping
+    (reference analog: tests/python/train/test_mlp.py)."""
+    np.random.seed(42)
+    X = np.random.randn(64, 8).astype('float32')
+    W = np.random.randn(8, 3).astype('float32')
+    ylab = np.argmax(X @ W, axis=1)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation='relu'), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.05})
+    xs, ys = nd.array(X), nd.array(ylab)
+    for _ in range(60):
+        with autograd.record():
+            loss = L(net(xs), ys)
+        loss.backward()
+        trainer.step(64)
+    acc = (net(xs).asnumpy().argmax(1) == ylab).mean()
+    assert acc > 0.9, acc
+
+
+def test_block_save_load_roundtrip():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(), nn.Dense(7))
+    net.initialize()
+    x = nd.array(np.random.randn(2, 3, 8, 8))
+    out1 = net(x).asnumpy()
+    fname = os.path.join(tempfile.mkdtemp(), 'net.params')
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(), nn.Dense(7))
+    net2.load_parameters(fname)
+    np.testing.assert_allclose(net2(x).asnumpy(), out1, rtol=1e-5, atol=1e-5)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix='model_')
+    with net.name_scope():
+        net.add(nn.Dense(10, in_units=4), nn.Dense(5, in_units=10))
+    assert len(net.collect_params('.*weight').keys()) == 2
+    assert len(net.collect_params('.*bias').keys()) == 2
+    assert len(net.collect_params().keys()) == 4
+
+
+def test_shared_params():
+    d1 = nn.Dense(10, in_units=4)
+    d2 = nn.Dense(10, in_units=4, params=d1.params)
+    d1.initialize()
+    x = nd.array(np.random.randn(2, 4))
+    np.testing.assert_allclose(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_lambda_blocks():
+    blk = nn.HybridLambda(lambda F, x: F.relu(x))
+    x = nd.array(np.random.randn(3, 3))
+    np.testing.assert_allclose(blk(x).asnumpy(),
+                               np.maximum(x.asnumpy(), 0))
+    blk2 = nn.Lambda('relu')
+    np.testing.assert_allclose(blk2(x).asnumpy(),
+                               np.maximum(x.asnumpy(), 0))
+
+
+def test_dropout_train_vs_inference():
+    blk = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    out_inf = blk(x).asnumpy()
+    np.testing.assert_allclose(out_inf, np.ones((100, 100)))
+    with autograd.record(train_mode=True):
+        out_train = blk(x).asnumpy()
+    frac_zero = (out_train == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_trainer_update_on_kvstore():
+    """update_on_kvstore=True runs the optimizer inside the store and the
+    pulled weights must match local updates (code-review regression)."""
+    p = gluon.Parameter('w', shape=(4,))
+    p.initialize(init='ones')
+    tr = gluon.Trainer({'w': p}, 'sgd', {'learning_rate': 0.5},
+                       kvstore='device', update_on_kvstore=True)
+    with autograd.record():
+        loss = (p.data() * p.data()).sum()
+    loss.backward()
+    tr.step(1)
+    np.testing.assert_allclose(p.data().asnumpy(), np.zeros(4), atol=1e-6)
+
+
+def test_trainer_stale_grad():
+    p = gluon.Parameter('w', shape=(2,))
+    p.initialize(init='ones')
+    tr = gluon.Trainer({'w': p}, 'sgd', {'learning_rate': 0.5})
+    with pytest.raises(UserWarning):
+        tr.step(1)  # no backward yet → stale grad
+    tr.step(1, ignore_stale_grad=True)  # skipped, not crashed
+    np.testing.assert_allclose(p.data().asnumpy(), np.ones(2))
+
+
+def test_itruediv_keeps_leaf():
+    w = nd.ones((3,))
+    w.attach_grad()
+    w /= 2
+    with autograd.record():
+        loss = (w * w).sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), w.asnumpy() * 2)
+
+
+def test_optimizer_zoo_step():
+    for name in ['sgd', 'adam', 'nag', 'rmsprop', 'adagrad', 'adadelta',
+                 'adamax', 'nadam', 'ftrl', 'signum', 'ftml', 'adamw']:
+        p = gluon.Parameter('w_%s' % name, shape=(3,))
+        p.initialize(init='ones')
+        tr = gluon.Trainer({'w': p}, name)
+        with autograd.record():
+            loss = (p.data() ** 2).sum()
+        loss.backward()
+        tr.step(1)
+        v = p.data().asnumpy()
+        assert np.isfinite(v).all() and not np.allclose(v, 1.0), (name, v)

@@ -28,4 +28,23 @@ from . import lr_scheduler
 from . import metric
 from . import kvstore
 from . import kvstore as kv
+from . import recordio
+from . import io
 from . import gluon
+from . import parallel
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
+from . import module
+from . import module as mod
+from . import model
+from . import callback
+from . import monitor
+from .monitor import Monitor
+from . import profiler
+from . import runtime
+from . import test_utils
+from . import visualization
+from . import rnn

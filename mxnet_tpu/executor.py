@@ -1,0 +1,294 @@
+"""Executor: compiled runtime for a bound Symbol.
+
+Reference parity: python/mxnet/executor.py (forward :114, backward :155,
+arg/grad/aux dicts, outputs) over src/executor/graph_executor.cc.
+
+TPU-native design: bind compiles the WHOLE symbol graph with jax.jit —
+InitGraph/MXPlanMemory/AttachOpExecs/InitCachedOps (graph_executor.cc:
+375-1275) all collapse into XLA compilation + buffer assignment. backward
+uses jax.vjp of the same compiled function (the nnvm Gradient pass is
+autodiff). BatchNorm-style aux updates ride along as extra outputs and are
+written back after forward (FMutateInputs parity).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random as _random
+
+__all__ = ['Executor']
+
+
+def _build_graph_fn(symbol, training, creation_shapes=None):
+    """Pure function over {var_name: array} evaluating the symbol graph.
+
+    Returns fn(var_values, key) -> (tuple outputs, {aux_name: new_value}).
+    creation_shapes: {id(node): shape} resolutions for creation ops with
+    unknown (0) dims — e.g. RNN begin_state zeros whose batch dim the
+    shape planner deduced (symbol.py _var_shape_plan).
+    """
+    nodes = symbol._nodes()
+    entries = symbol._entries
+    creation_shapes = creation_shapes or {}
+
+    def fn(var_values, key):
+        vals = {}
+        aux_updates = {}
+        rng_i = 0
+        for node in nodes:
+            if node.is_variable:
+                vals[id(node)] = [var_values[node.name]]
+                continue
+            op = node.op
+            ins = [vals[id(c)][i] for (c, i) in node.inputs]
+            attrs = {k: v for k, v in node.attrs.items() if v is not None}
+            if id(node) in creation_shapes:
+                attrs['shape'] = creation_shapes[id(node)]
+            if 'training' in op.attr_names:
+                attrs.setdefault('training', training)
+            base = op.bind_attrs(**attrs)
+            if op.needs_rng:
+                sub = jax.random.fold_in(key, rng_i)
+                rng_i += 1
+                out = base(sub, list(ins)) if op.num_inputs == -1 \
+                    else base(sub, *ins)
+            else:
+                out = base(list(ins)) if op.num_inputs == -1 else base(*ins)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            vals[id(node)] = outs
+            if op.name.startswith('BatchNorm') and training and \
+                    not attrs.get('use_global_stats', False):
+                mom = float(attrs.get('momentum', 0.9))
+                mm = node.inputs[3][0]
+                mv = node.inputs[4][0]
+                aux_updates[mm.name] = mom * ins[3] + (1 - mom) * outs[1]
+                aux_updates[mv.name] = mom * ins[4] + (1 - mom) * outs[2]
+        outputs = tuple(vals[id(n)][i] for (n, i) in entries)
+        return outputs, aux_updates
+    return fn
+
+
+class Executor:
+    """Executor computes a Symbol's outputs (and gradients) on device."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req='write', aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self.arg_dict = self._as_dict(args, arg_names, 'args')
+        self.aux_dict = self._as_dict(aux_states, aux_names, 'aux_states',
+                                      allow_none=True)
+        if isinstance(grad_req, str):
+            self.grad_req = {name: grad_req for name in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self.grad_req = dict(grad_req)
+            for name in arg_names:
+                self.grad_req.setdefault(name, 'null')
+        self.grad_dict = self._as_dict(args_grad, arg_names, 'args_grad',
+                                       allow_none=True) \
+            if args_grad is not None else {}
+        self.outputs = []
+        self._vjp = None
+        self._fwd_cache = {}
+        self._bwd_cache = {}
+        self._monitor_callback = None
+
+    def _as_dict(self, values, names, what, allow_none=False):
+        if values is None:
+            if allow_none:
+                return {}
+            raise ValueError('%s must be provided' % what)
+        if isinstance(values, dict):
+            return dict(values)
+        values = list(values)
+        assert len(values) == len(names), \
+            'length of %s (%d) does not match expected %d' % (
+                what, len(values), len(names))
+        return dict(zip(names, values))
+
+    # -- array views -------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # -- execution ---------------------------------------------------------
+    def _creation_shapes(self):
+        """Resolve unknown-dim creation ops against bound arg shapes."""
+        if getattr(self, '_creation_cache', None) is None:
+            known = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
+            known.update({n: tuple(a.shape)
+                          for n, a in self.aux_dict.items()})
+            try:
+                _, node_out_shapes, _ = self._symbol._var_shape_plan(known)
+                self._creation_cache = node_out_shapes.get(
+                    'creation_shapes', {})
+            except ValueError:
+                self._creation_cache = {}
+        return self._creation_cache
+
+    def _graph_fn(self, training):
+        if training not in self._fwd_cache:
+            raw = _build_graph_fn(self._symbol, training,
+                                  self._creation_shapes())
+            self._fwd_cache[training] = (raw, jax.jit(raw))
+        return self._fwd_cache[training]
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward; returns outputs (reference: executor.py:114)."""
+        for name, arr in kwargs.items():
+            if name not in self.arg_dict:
+                raise TypeError('Unknown argument %s' % name)
+            src = arr if isinstance(arr, NDArray) else nd.array(arr)
+            self.arg_dict[name]._data = src._data.astype(
+                self.arg_dict[name]._data.dtype)
+        var_values = {n: a._data for n, a in self.arg_dict.items()}
+        var_values.update({n: a._data for n, a in self.aux_dict.items()})
+        key = _random.next_key()
+        raw_fn, jit_fn = self._graph_fn(bool(is_train))
+
+        outs, aux_upd = jit_fn(var_values, key)
+        grad_names = [n for n in self._symbol.list_arguments()
+                      if self.grad_req.get(n, 'null') != 'null' and
+                      n in self.grad_dict]
+        if is_train and grad_names:
+            # stash state for backward: the jitted bwd recomputes fwd+bwd in
+            # ONE XLA program (fwd residuals fuse; same key → same dropout
+            # masks as this forward)
+            self._vjp = (bool(is_train), tuple(grad_names), var_values, key,
+                         aux_upd)
+        else:
+            self._vjp = None
+        self.outputs = [NDArray(o) for o in outs]
+        for name, val in (aux_upd.items() if isinstance(aux_upd, dict)
+                          else []):
+            if name in self.aux_dict:
+                self.aux_dict[name]._data = val
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def _bwd_fn(self, training, grad_names):
+        sig = (training, grad_names)
+        if sig not in self._bwd_cache:
+            raw_fn, _ = self._graph_fn(training)
+
+            def bwd(grad_vals, other_vals, key, cts, aux_ct):
+                def f(gv):
+                    vv = dict(other_vals)
+                    vv.update(dict(zip(grad_names, gv)))
+                    return raw_fn(vv, key)
+                _, vjp_fn = jax.vjp(f, tuple(grad_vals))
+                return vjp_fn((cts, aux_ct))[0]
+            self._bwd_cache[sig] = jax.jit(bwd)
+        return self._bwd_cache[sig]
+
+    def backward(self, out_grads=None, is_train=True):
+        """Accumulate gradients into grad arrays
+        (reference: executor.py:155)."""
+        if self._vjp is None:
+            raise RuntimeError('backward() requires a prior '
+                               'forward(is_train=True)')
+        training, grad_names, var_values, key, aux_upd = self._vjp
+        if out_grads is None:
+            cts = tuple(jnp.ones(o.shape, o._data.dtype)
+                        for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(
+                g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                if g is not None else jnp.ones(o.shape, o._data.dtype)
+                for g, o in zip(out_grads, self.outputs))
+        aux_ct = {k: jnp.zeros_like(v) for k, v in aux_upd.items()} \
+            if isinstance(aux_upd, dict) else {}
+        grad_vals = tuple(var_values[n] for n in grad_names)
+        other_vals = {n: v for n, v in var_values.items()
+                      if n not in grad_names}
+        grads = self._bwd_fn(training, grad_names)(
+            grad_vals, other_vals, key, cts, aux_ct)
+        for name, g in zip(grad_names, grads):
+            tgt = self.grad_dict.get(name)
+            if tgt is None:
+                continue
+            if self.grad_req.get(name) == 'add':
+                tgt._data = tgt._data + g.astype(tgt._data.dtype)
+            else:
+                tgt._data = g.astype(tgt._data.dtype)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with reshaped arg arrays
+        (reference: executor.py Reshape). Shapes flow through jit's cache."""
+        arg_shapes, _, aux_shapes = self._symbol._infer_shape_impl(
+            False, **kwargs)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        new_args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            if tuple(old.shape) == tuple(shape):
+                new_args[name] = old
+            else:
+                new_args[name] = nd.zeros(shape, dtype=old.dtype)
+        new_aux = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = old if tuple(old.shape) == tuple(shape) else \
+                nd.zeros(shape, dtype=old.dtype)
+        grads = None
+        if self.grad_dict:
+            grads = {}
+            for name, shape in zip(arg_names, arg_shapes):
+                old = self.grad_dict.get(name)
+                if old is None:
+                    continue
+                grads[name] = old if tuple(old.shape) == tuple(shape) else \
+                    nd.zeros(shape, dtype=old.dtype)
+        return Executor(self._symbol, self._ctx, args=new_args,
+                        args_grad=grads, grad_req=self.grad_req,
+                        aux_states=new_aux)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Copy parameter values in (reference: executor.py)."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise ValueError('Find name "%s" that is not in the '
+                                 'arguments' % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    array.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise ValueError('Find name %s that is not in the '
+                                     'auxiliary states' % name)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        return self._symbol.debug_str()

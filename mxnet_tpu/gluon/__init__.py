@@ -5,7 +5,9 @@ from .parameter import Parameter, Constant, ParameterDict, \
 from .block import Block, HybridBlock, SymbolBlock
 from .trainer import Trainer
 from . import nn
+from . import rnn
 from . import loss
+from . import data
 from . import utils
 from . import model_zoo
 from .utils import split_data, split_and_load

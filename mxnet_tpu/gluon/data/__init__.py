@@ -1,0 +1,6 @@
+"""Gluon data API (reference: python/mxnet/gluon/data/)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
+from .sampler import (Sampler, SequentialSampler, RandomSampler,
+                      FilterSampler, BatchSampler)
+from .dataloader import DataLoader
+from . import vision

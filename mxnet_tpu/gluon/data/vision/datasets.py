@@ -1,0 +1,239 @@
+"""Vision datasets (reference: python/mxnet/gluon/data/vision/datasets.py —
+MNIST/FashionMNIST/CIFAR10/100/ImageRecordDataset/ImageFolderDataset).
+
+Zero-egress environment: datasets load from local files under `root`
+(MXNET_HOME/datasets by default); download attempts raise with guidance.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ... import nn
+from .. import dataset
+from .... import ndarray as nd
+
+__all__ = ['MNIST', 'FashionMNIST', 'CIFAR10', 'CIFAR100',
+           'ImageRecordDataset', 'ImageFolderDataset']
+
+
+def _default_root(namespace):
+    return os.path.join(os.environ.get('MXNET_HOME',
+                                       os.path.expanduser('~/.mxnet')),
+                        'datasets', namespace)
+
+
+class _DownloadedDataset(dataset.Dataset):
+    """Base for file-backed datasets."""
+
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+    def _require(self, path):
+        if not os.path.exists(path):
+            raise RuntimeError(
+                '%s not found. Downloading requires network egress, which is '
+                'unavailable in this environment; place the file there '
+                'manually.' % path)
+        return path
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST handwritten digits (reference: datasets.py MNIST)."""
+
+    _namespace = 'mnist'
+    _train_data = ('train-images-idx3-ubyte.gz', None)
+    _train_label = ('train-labels-idx1-ubyte.gz', None)
+    _test_data = ('t10k-images-idx3-ubyte.gz', None)
+    _test_label = ('t10k-labels-idx1-ubyte.gz', None)
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        super().__init__(root or _default_root(self._namespace), transform)
+
+    def _open(self, fname):
+        path = os.path.join(self._root, fname)
+        alt = path[:-3]  # allow pre-decompressed files
+        if not os.path.exists(path) and os.path.exists(alt):
+            return open(alt, 'rb')
+        self._require(path)
+        return gzip.open(path, 'rb')
+
+    def _get_data(self):
+        if self._train:
+            data_file, label_file = self._train_data[0], self._train_label[0]
+        else:
+            data_file, label_file = self._test_data[0], self._test_label[0]
+        with self._open(label_file) as fin:
+            struct.unpack('>II', fin.read(8))
+            label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+        with self._open(data_file) as fin:
+            struct.unpack('>IIII', fin.read(16))
+            data = np.frombuffer(fin.read(), dtype=np.uint8)
+            data = data.reshape(len(label), 28, 28, 1)
+        self._data = nd.array(data, dtype=data.dtype)
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    """FashionMNIST (reference: datasets.py FashionMNIST)."""
+
+    _namespace = 'fashion-mnist'
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 (reference: datasets.py CIFAR10; python-pickle batches)."""
+
+    _namespace = 'cifar10'
+    _archive = 'cifar-10-python.tar.gz'
+    _folder = 'cifar-10-batches-py'
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        super().__init__(root or _default_root(self._namespace), transform)
+
+    def _read_batch(self, filename):
+        with open(filename, 'rb') as fin:
+            batch = pickle.load(fin, encoding='latin1')
+        data = batch['data'].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        labels = batch.get('labels', batch.get('fine_labels'))
+        return data, np.asarray(labels, dtype=np.int32)
+
+    def _get_data(self):
+        folder = os.path.join(self._root, self._folder)
+        if not os.path.isdir(folder):
+            archive = os.path.join(self._root, self._archive)
+            if os.path.exists(archive):
+                with tarfile.open(archive) as tf:
+                    tf.extractall(self._root)
+            else:
+                self._require(folder)
+        if self._train:
+            files = ['data_batch_%d' % i for i in range(1, 6)]
+        else:
+            files = ['test_batch']
+        data, label = zip(*[self._read_batch(os.path.join(folder, f))
+                            for f in files])
+        data = np.concatenate(data)
+        label = np.concatenate(label)
+        self._data = nd.array(data, dtype=data.dtype)
+        self._label = label
+
+
+class CIFAR100(CIFAR10):
+    """CIFAR100 (reference: datasets.py CIFAR100)."""
+
+    _namespace = 'cifar100'
+    _archive = 'cifar-100-python.tar.gz'
+    _folder = 'cifar-100-python'
+
+    def __init__(self, root=None, fine_label=True, train=True, transform=None):
+        self._fine_label = fine_label
+        super().__init__(root=root, train=train, transform=transform)
+
+    def _get_data(self):
+        folder = os.path.join(self._root, self._folder)
+        if not os.path.isdir(folder):
+            archive = os.path.join(self._root, self._archive)
+            if os.path.exists(archive):
+                with tarfile.open(archive) as tf:
+                    tf.extractall(self._root)
+            else:
+                self._require(folder)
+        f = 'train' if self._train else 'test'
+        with open(os.path.join(folder, f), 'rb') as fin:
+            batch = pickle.load(fin, encoding='latin1')
+        data = batch['data'].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = 'fine_labels' if self._fine_label else 'coarse_labels'
+        label = np.asarray(batch[key], dtype=np.int32)
+        self._data = nd.array(data, dtype=data.dtype)
+        self._label = label
+
+
+class ImageRecordDataset(dataset.RecordFileDataset):
+    """Image + label dataset over a .rec file
+    (reference: datasets.py ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack_img(record, self._flag)
+        if self._flag:
+            import cv2
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        img = nd.array(img, dtype='uint8')
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(dataset.Dataset):
+    """A dataset of images arranged as root/category/image.jpg
+    (reference: datasets.py ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = ['.jpg', '.jpeg', '.png']
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        import cv2
+        fname, label = self.items[idx]
+        flag = cv2.IMREAD_COLOR if self._flag else cv2.IMREAD_GRAYSCALE
+        img = cv2.imread(fname, flag)
+        if self._flag:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        img = nd.array(img, dtype='uint8')
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

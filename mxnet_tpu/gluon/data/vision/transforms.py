@@ -1,0 +1,282 @@
+"""Vision transforms (reference: python/mxnet/gluon/data/vision/transforms.py
+— Compose/Cast/ToTensor/Normalize/RandomResizedCrop/CenterCrop/Resize/
+flips/color jitter). Each transform is a HybridBlock over the image ops
+(ops/image.py) so pipelines can be hybridized and fused by XLA."""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import numeric_types
+from ... import nn
+from ...block import Block, HybridBlock
+from .... import ndarray as nd
+from ....ndarray import NDArray
+
+__all__ = ['Compose', 'Cast', 'ToTensor', 'Normalize', 'Resize',
+           'CenterCrop', 'RandomResizedCrop', 'CropResize',
+           'RandomFlipLeftRight', 'RandomFlipTopBottom', 'RandomBrightness',
+           'RandomContrast', 'RandomSaturation', 'RandomHue',
+           'RandomColorJitter', 'RandomLighting', 'RandomGray']
+
+
+class Compose(nn.Sequential):
+    """Sequentially compose transforms (reference: transforms.py Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        transforms.append(None)
+        hybrid = []
+        for i in transforms:
+            if isinstance(i, HybridBlock):
+                hybrid.append(i)
+                continue
+            elif len(hybrid) == 1:
+                self.add(hybrid[0])
+                hybrid = []
+            elif len(hybrid) > 1:
+                hblock = nn.HybridSequential()
+                for j in hybrid:
+                    hblock.add(j)
+                hblock.hybridize()
+                self.add(hblock)
+                hybrid = []
+            if i is not None:
+                self.add(i)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype='float32'):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 -> CHW float32/255 (reference: transforms.py ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        return F._image_to_tensor(x)
+
+
+class Normalize(HybridBlock):
+    """Channel-wise (x-mean)/std on CHW input."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        return F._image_normalize(x, mean=self._mean, std=self._std)
+
+
+class Resize(HybridBlock):
+    """Resize to (w, h) or short-edge size (reference: transforms.py Resize)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._keep = keep_ratio
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        if isinstance(self._size, numeric_types) and self._keep:
+            h, w = x.shape[-3:-1]
+            short, long_ = (w, h) if w <= h else (h, w)
+            scale = self._size / short
+            size = (int(round(w * scale)), int(round(h * scale)))
+        elif isinstance(self._size, numeric_types):
+            size = (self._size, self._size)
+        else:
+            size = tuple(self._size)
+        return nd.invoke('_image_resize', [x],
+                         {'size': size, 'interp': self._interpolation})
+
+    def hybrid_forward(self, F, x):
+        return self.forward(x)
+
+
+class CropResize(HybridBlock):
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__()
+        self._x = x
+        self._y = y
+        self._width = width
+        self._height = height
+        self._size = size
+        self._interpolation = interpolation if interpolation is not None else 1
+
+    def hybrid_forward(self, F, x):
+        out = F._image_crop(x, x=self._x, y=self._y, width=self._width,
+                            height=self._height)
+        if self._size:
+            sz = (self._size, self._size) if isinstance(
+                self._size, numeric_types) else tuple(self._size)
+            out = F._image_resize(out, size=sz, interp=self._interpolation)
+        return out
+
+
+class CenterCrop(Block):
+    """Center crop to size, upscaling if needed."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        if isinstance(size, numeric_types):
+            size = (size, size)
+        self._size = size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        w, h = self._size
+        ih, iw = x.shape[-3], x.shape[-2]
+        if ih < h or iw < w:
+            x = nd.invoke('_image_resize', [x],
+                          {'size': (max(w, iw), max(h, ih)),
+                           'interp': self._interpolation})
+            ih, iw = x.shape[-3], x.shape[-2]
+        y0 = (ih - h) // 2
+        x0 = (iw - w) // 2
+        return nd.invoke('_image_crop', [x], {'x': x0, 'y': y0,
+                                              'width': w, 'height': h})
+
+
+class RandomResizedCrop(Block):
+    """Random area+aspect crop then resize (reference: transforms.py
+    RandomResizedCrop; augmenter semantics image_aug_default.cc:46)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        if isinstance(size, numeric_types):
+            size = (size, size)
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        ih, iw = x.shape[-3], x.shape[-2]
+        area = ih * iw
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            w = int(round(np.sqrt(target_area * aspect)))
+            h = int(round(np.sqrt(target_area / aspect)))
+            if w <= iw and h <= ih:
+                x0 = np.random.randint(0, iw - w + 1)
+                y0 = np.random.randint(0, ih - h + 1)
+                out = nd.invoke('_image_crop', [x],
+                                {'x': int(x0), 'y': int(y0),
+                                 'width': w, 'height': h})
+                return nd.invoke('_image_resize', [out],
+                                 {'size': self._size,
+                                  'interp': self._interpolation})
+        # fallback: center crop
+        return CenterCrop(self._size, self._interpolation)(x)
+
+
+class RandomFlipLeftRight(HybridBlock):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def hybrid_forward(self, F, x):
+        return F._image_random_flip_left_right(x, p=self._p)
+
+
+class RandomFlipTopBottom(HybridBlock):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def hybrid_forward(self, F, x):
+        return F._image_random_flip_top_bottom(x, p=self._p)
+
+
+class RandomBrightness(HybridBlock):
+    def __init__(self, brightness):
+        super().__init__()
+        self._args = (max(0, 1 - brightness), 1 + brightness)
+
+    def hybrid_forward(self, F, x):
+        return F._image_random_brightness(x, min_factor=self._args[0],
+                                          max_factor=self._args[1])
+
+
+class RandomContrast(HybridBlock):
+    def __init__(self, contrast):
+        super().__init__()
+        self._args = (max(0, 1 - contrast), 1 + contrast)
+
+    def hybrid_forward(self, F, x):
+        return F._image_random_contrast(x, min_factor=self._args[0],
+                                        max_factor=self._args[1])
+
+
+class RandomSaturation(HybridBlock):
+    def __init__(self, saturation):
+        super().__init__()
+        self._args = (max(0, 1 - saturation), 1 + saturation)
+
+    def hybrid_forward(self, F, x):
+        return F._image_random_saturation(x, min_factor=self._args[0],
+                                          max_factor=self._args[1])
+
+
+class RandomHue(HybridBlock):
+    """Hue jitter via saturation-space approximation (full HSV round-trip
+    costs 2 conversions; reference uses the same linearized trick on GPU)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def hybrid_forward(self, F, x):
+        return F._image_random_saturation(x, min_factor=1 - self._hue,
+                                          max_factor=1 + self._hue)
+
+
+class RandomColorJitter(HybridBlock):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._b = brightness
+        self._c = contrast
+        self._s = saturation
+        self._h = hue
+
+    def hybrid_forward(self, F, x):
+        if self._b > 0:
+            x = F._image_random_brightness(x, min_factor=max(0, 1 - self._b),
+                                           max_factor=1 + self._b)
+        if self._c > 0:
+            x = F._image_random_contrast(x, min_factor=max(0, 1 - self._c),
+                                         max_factor=1 + self._c)
+        if self._s > 0:
+            x = F._image_random_saturation(x, min_factor=max(0, 1 - self._s),
+                                           max_factor=1 + self._s)
+        return x
+
+
+class RandomLighting(HybridBlock):
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F._image_random_lighting(x, alpha_std=self._alpha)
+
+
+class RandomGray(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if np.random.rand() < self._p:
+            coef = nd.array(np.array([0.299, 0.587, 0.114], dtype='float32'))
+            gray = (x.astype('float32') * coef).sum(axis=-1, keepdims=True)
+            return nd.concatenate([gray, gray, gray], axis=-1).astype(x.dtype)
+        return x

@@ -1,0 +1,215 @@
+"""Fused RNN layers (reference: python/mxnet/gluon/rnn/rnn_layer.py:627 —
+_RNNLayer :32 calling the fused RNN op; RNN/LSTM/GRU classes).
+
+TPU perf path: the fused RNN op (ops/nn.py) precomputes the input
+projection as one big matmul and runs lax.scan over timesteps — the analog
+of the reference's cuDNN fused kernels (rnn-inl.h).
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from ..block import HybridBlock
+from . import rnn_cell
+
+__all__ = ['RNN', 'LSTM', 'GRU']
+
+
+class _RNNLayer(HybridBlock):
+    """Implementation of recurrent layers over the fused RNN op."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        # _alias() is consulted during Block.__init__ for the name prefix
+        object.__setattr__(self, '_mode', mode)
+        super().__init__(**kwargs)
+        assert layout in ('TNC', 'NTC'), \
+            'Invalid layout %s; must be one of ["TNC" or "NTC"]' % layout
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4,
+                       'gru': 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        # per-piece parameters in the fused cuDNN layout order (weights for
+        # all layers/directions, then biases) so the flat vector matches
+        # ops/nn.py _rnn_unpack_params
+        for j in ['l', 'r'][:self._dir]:
+            for i in range(num_layers):
+                lni = ni if i == 0 else nh * self._dir
+                setattr(self, '%s%d_i2h_weight' % (j, i), self.params.get(
+                    '%s%d_i2h_weight' % (j, i), shape=(ng * nh, lni),
+                    init=i2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, '%s%d_h2h_weight' % (j, i), self.params.get(
+                    '%s%d_h2h_weight' % (j, i), shape=(ng * nh, nh),
+                    init=h2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, '%s%d_i2h_bias' % (j, i), self.params.get(
+                    '%s%d_i2h_bias' % (j, i), shape=(ng * nh,),
+                    init=i2h_bias_initializer, allow_deferred_init=True))
+                setattr(self, '%s%d_h2h_bias' % (j, i), self.params.get(
+                    '%s%d_h2h_bias' % (j, i), shape=(ng * nh,),
+                    init=h2h_bias_initializer, allow_deferred_init=True))
+
+    def __repr__(self):
+        s = '{name}({mapping}, {_layout}'
+        if self._num_layers != 1:
+            s += ', num_layers={_num_layers}'
+        if self._dropout != 0:
+            s += ', dropout={_dropout}'
+        if self._dir == 2:
+            s += ', bidirectional'
+        s += ')'
+        shape = getattr(self, 'l0_i2h_weight').shape
+        mapping = '{0} -> {1}'.format(
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _collect_params_with_prefix(self, prefix=''):
+        if prefix:
+            prefix += '.'
+        pattern = lambda d, l, g: '_unfused.%d.%s_cell.%s' % (
+            d + l * self._dir, ['l', 'r'][d], g)
+        ret = {prefix + n: p for n, p in self._reg_params.items()}
+        return ret
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def _alias(self):
+        return self._mode
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[-1]
+        for j in ['l', 'r'][:self._dir]:
+            getattr(self, '%s0_i2h_weight' % j).shape = \
+                (self._gates * self._hidden_size, ni)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent state (reference: rnn_layer.py begin_state)."""
+        if func is None:
+            func = nd.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info.update(kwargs)
+            else:
+                info = kwargs
+            states.append(func(**{k: v for k, v in info.items()
+                                  if k not in ('name', '__layout__')}))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **kwargs):
+        batch_size = inputs.shape[self._layout.find('N')]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if isinstance(states, NDArray):
+            states = [states]
+        for state, info in zip(states, self.state_info(batch_size)):
+            if state.shape != info['shape']:
+                raise ValueError(
+                    'Invalid recurrent state shape. Expecting %s, got %s.' % (
+                        str(info['shape']), str(state.shape)))
+        out = self._forward_kernel(F, inputs, states, **kwargs)
+        return out[0] if skip_states else out
+
+    def _flat_params(self, kwargs):
+        order = []
+        for i in range(self._num_layers):
+            for j in ['l', 'r'][:self._dir]:
+                order.append(kwargs['%s%d_i2h_weight' % (j, i)])
+                order.append(kwargs['%s%d_h2h_weight' % (j, i)])
+        for i in range(self._num_layers):
+            for j in ['l', 'r'][:self._dir]:
+                order.append(kwargs['%s%d_i2h_bias' % (j, i)])
+                order.append(kwargs['%s%d_h2h_bias' % (j, i)])
+        return nd.Concat(*[w.reshape((-1,)) for w in order], dim=0,
+                         num_args=len(order))
+
+    def _forward_kernel(self, F, inputs, states, **kwargs):
+        if self._layout == 'NTC':
+            inputs = inputs.swapaxes(dim1=0, dim2=1)
+        params = self._flat_params(kwargs)
+        rnn_args = [inputs, params] + list(states)
+        out = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, mode=self._mode,
+                    p=self._dropout, state_outputs=True)
+        if self._mode == 'lstm':
+            outputs, states = out[0], [out[1], out[2]]
+        else:
+            outputs, states = out[0], [out[1]]
+        if self._layout == 'NTC':
+            outputs = outputs.swapaxes(dim1=0, dim2=1)
+        return outputs, states
+
+
+class RNN(_RNNLayer):
+    r"""Multi-layer Elman RNN with tanh/relu (reference: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation='relu',
+                 layout='TNC', dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         'rnn_' + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]
+
+
+class LSTM(_RNNLayer):
+    r"""Multi-layer LSTM (reference: rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         'lstm', projection_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'},
+                {'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]
+
+
+class GRU(_RNNLayer):
+    r"""Multi-layer GRU (reference: rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         'gru', **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), '__layout__': 'LNC'}]

@@ -1,0 +1,121 @@
+"""Legacy model helpers: checkpoint I/O + FeedForward.
+
+Reference parity: python/mxnet/model.py (save_checkpoint :394,
+load_checkpoint :424, kvstore helpers :82-150, deprecated FeedForward).
+"""
+from __future__ import annotations
+
+import logging
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .context import cpu
+
+__all__ = ['save_checkpoint', 'load_checkpoint', 'load_params',
+           'FeedForward', 'BatchEndParam']
+
+
+class BatchEndParam:
+    """Callback parameter bundle (reference: model.py BatchEndParam)."""
+
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Save prefix-symbol.json + prefix-%04d.params
+    (reference: model.py:394)."""
+    if symbol is not None:
+        symbol.save('%s-symbol.json' % prefix)
+    save_dict = {('arg:%s' % k): v.as_in_context(cpu())
+                 for k, v in arg_params.items()}
+    save_dict.update({('aux:%s' % k): v.as_in_context(cpu())
+                      for k, v in aux_params.items()})
+    param_name = '%s-%04d.params' % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_params(prefix, epoch):
+    """Load params file into (arg_params, aux_params)."""
+    save_dict = nd.load('%s-%04d.params' % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(':', 1)
+        if tp == 'arg':
+            arg_params[name] = v
+        if tp == 'aux':
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Load symbol + params (reference: model.py:424)."""
+    symbol = sym_mod.load('%s-symbol.json' % prefix)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Deprecated shim over Module (reference: model.py FeedForward —
+    deprecated there too). Provides create/fit/predict for old scripts."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer='sgd', initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .module import Module
+        self._symbol = symbol
+        self._ctx = ctx
+        self._num_epoch = num_epoch
+        self._optimizer = optimizer
+        self._initializer = initializer
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+        self._begin_epoch = begin_epoch
+        self._kwargs = kwargs
+        self._module = None
+
+    def fit(self, X, y=None, eval_data=None, eval_metric='acc',
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore='local', logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module import Module
+        from . import initializer as init_mod
+        mod = Module(self._symbol, context=self._ctx)
+        self._module = mod
+        opt_params = {k: v for k, v in self._kwargs.items()
+                      if k in ('learning_rate', 'momentum', 'wd',
+                               'clip_gradient', 'lr_scheduler')}
+        mod.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self._optimizer,
+                optimizer_params=opt_params or (('learning_rate', 0.01),),
+                initializer=self._initializer or init_mod.Uniform(0.01),
+                arg_params=self._arg_params, aux_params=self._aux_params,
+                begin_epoch=self._begin_epoch, num_epoch=self._num_epoch,
+                monitor=monitor)
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        assert self._module is not None, 'call fit first'
+        return self._module.predict(X, num_batch=num_batch, reset=reset)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    def save(self, prefix, epoch=None):
+        assert self._module is not None
+        arg_params, aux_params = self._module.get_params()
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else self._num_epoch, self._symbol, arg_params,
+                        aux_params)

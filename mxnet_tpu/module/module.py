@@ -1,0 +1,370 @@
+"""Module: symbolic training over one compiled executor.
+
+Reference parity: python/mxnet/module/module.py:40 (bind →
+DataParallelExecutorGroup, init_params, init_optimizer, forward/backward/
+update). TPU-native: the per-context executor group collapses into ONE
+executor whose graph is jit-compiled; multi-device data parallelism is the
+parallel/ package's pjit path, not batch slicing (SURVEY §2.4 row 1).
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import optimizer as opt
+from ..context import cpu, current_context
+from ..io import DataDesc
+from ..initializer import Uniform, InitDesc
+from .base_module import BaseModule, _check_input_names
+
+__all__ = ['Module']
+
+
+class Module(BaseModule):
+    """Module is a basic module that wraps a Symbol."""
+
+    def __init__(self, symbol, data_names=('data',),
+                 label_names=('softmax_label',), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = current_context()
+        if isinstance(context, (list, tuple)):
+            if len(context) > 1:
+                self.logger.info(
+                    'Multiple contexts passed to Module: on TPU, multi-'
+                    'device data parallelism is expressed with a sharded '
+                    'mesh (mxnet_tpu.parallel), not per-context executors; '
+                    'using the first context.')
+            context = context[0]
+        self._context = context
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        _check_input_names(symbol, data_names, 'data', True)
+        _check_input_names(symbol, label_names, 'label', False)
+        self._data_names = data_names
+        self._label_names = [n for n in label_names
+                             if n in symbol.list_arguments()]
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names + self._state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._optimizer = None
+        self._kvstore = None
+        self._updater = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create a model from a checkpoint (reference: module.py load)."""
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = '%s-%04d.states' % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Save symbol + params (+optimizer states)
+        (reference: module.py save_checkpoint)."""
+        self._symbol.save('%s-symbol.json' % prefix)
+        param_name = '%s-%04d.params' % (prefix, epoch)
+        self.save_params(param_name)
+        self.logger.info('Saved checkpoint to "%s"', param_name)
+        if save_optimizer_states:
+            state_name = '%s-%04d.states' % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+            self.logger.info('Saved optimizer state to "%s"', state_name)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self._output_names, self._exec.outputs)] \
+            if self._exec.outputs else None
+
+    # -- params ------------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def _sync_params_from_devices(self):
+        for name in self._param_names:
+            self._arg_params[name] = self._exec.arg_dict[name].copy()
+        for name in self._aux_names:
+            self._aux_params[name] = self._exec.aux_dict[name].copy()
+        self._params_dirty = False
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        """Initialize parameters (reference: module.py init_params)."""
+        if self.params_initialized and not force_init:
+            warnings.warn('Parameters already initialized and force_init='
+                          'False. init_params call ignored.', stacklevel=2)
+            return
+        assert self.binded, 'call bind before initializing the parameters'
+        if initializer is None:
+            initializer = Uniform(0.01)
+
+        def _impl(name, arr, cache):
+            if cache is not None:
+                if name in cache:
+                    cache_arr = cache[name]
+                    if cache_arr is not arr:
+                        cache_arr.copyto(arr)
+                else:
+                    if not allow_missing:
+                        raise RuntimeError('%s is not presented' % name)
+                    if initializer is not None:
+                        initializer(InitDesc(name), arr)
+            else:
+                if initializer is not None:
+                    initializer(InitDesc(name), arr)
+
+        attrs = self._symbol.attr_dict()
+        for name in self._param_names:
+            desc = InitDesc(name, attrs.get(name, None))
+            arr = self._exec.arg_dict[name]
+            _impl(desc, arr, arg_params)
+        for name in self._aux_names:
+            desc = InitDesc(name, attrs.get(name, None))
+            arr = self._exec.aux_dict[name]
+            _impl(desc, arr, aux_params)
+        self._arg_params = {n: self._exec.arg_dict[n].copy()
+                            for n in self._param_names}
+        self._aux_params = {n: self._exec.aux_dict[n].copy()
+                            for n in self._aux_names}
+        self.params_initialized = True
+        self._params_dirty = False
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
+                             force_init=force_init, allow_extra=allow_extra)
+            return
+        if self.params_initialized and not force_init:
+            warnings.warn('Parameters already initialized and force_init='
+                          'False. set_params call ignored.', stacklevel=2)
+            return
+        for name, arr in (arg_params or {}).items():
+            if name in self._exec.arg_dict:
+                arr.copyto(self._exec.arg_dict[name])
+        for name, arr in (aux_params or {}).items():
+            if name in self._exec.aux_dict:
+                arr.copyto(self._exec.aux_dict[name])
+        self.params_initialized = True
+        self._params_dirty = False
+
+    # -- binding -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req='write'):
+        """Bind symbol to an executor (reference: module.py:364)."""
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning('Already bound, ignoring bind()')
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        assert not (not for_training and inputs_need_grad)
+
+        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                             for x in data_shapes]
+        self._label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                              for x in label_shapes] if label_shapes else []
+        shape_kwargs = {d.name: tuple(d.shape) for d in self._data_shapes}
+        for d in self._label_shapes:
+            if d.name in self._symbol.list_arguments():
+                shape_kwargs[d.name] = tuple(d.shape)
+
+        req = {}
+        for name in self._symbol.list_arguments():
+            if not for_training:
+                req[name] = 'null'
+            elif name in self._data_names:
+                req[name] = 'write' if inputs_need_grad else 'null'
+            elif name in self._label_names or name in self._state_names:
+                req[name] = 'null'
+            elif name in self._fixed_param_names:
+                req[name] = 'null'
+            else:
+                req[name] = grad_req
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context, grad_req=req, **shape_kwargs)
+        if self.params_initialized:
+            # params were loaded before bind (Module.load) — push them into
+            # the fresh executor (reference: module.py bind →
+            # _exec_group.set_params)
+            self._exec.copy_params_from(self._arg_params or {},
+                                        self._aux_params or {},
+                                        allow_extra_params=True)
+        if shared_module is not None and shared_module.params_initialized:
+            arg_params, aux_params = shared_module.get_params()
+            self.set_params(arg_params, aux_params)
+        self.binded = True
+        if shared_module is not None:
+            self.params_initialized = shared_module.params_initialized
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore='local', optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.01),),
+                       force_init=False):
+        """Install an optimizer (reference: module.py init_optimizer;
+        kvstore types all alias the in-process store on TPU)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning('optimizer already initialized, ignoring...')
+            return
+        if isinstance(optimizer, str):
+            idx2name = dict(enumerate(self._param_names))
+            optimizer_params = dict(optimizer_params)
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   sym=self._symbol, **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        self._kvstore = kvstore
+        self.optimizer_initialized = True
+        if hasattr(self, '_preload_opt_states') and self._preload_opt_states:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        # reshape executor if batch shape changed (bucketing / last batch)
+        cur = self._exec.arg_dict[self._data_names[0]].shape
+        new = feed[self._data_names[0]].shape
+        if tuple(cur) != tuple(new):
+            shape_kwargs = {n: tuple(a.shape) for n, a in feed.items()}
+            self._exec = self._exec.reshape(**shape_kwargs)
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply optimizer to gradients (reference: module.py update →
+        _update_params; on TPU the kvstore reduce is a no-op single-copy)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            self._updater(i, grad, weight)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        if self._label_names:
+            eval_metric.update_dict(
+                dict(zip(self._label_names, labels if not pre_sliced
+                         else labels[0])),
+                dict(zip(self._output_names, self._exec.outputs)))
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        if states is not None:
+            for name, arr in zip(self._state_names, states):
+                src = arr if isinstance(arr, NDArray) else nd.array(arr)
+                src.copyto(self._exec.arg_dict[name])
+        else:
+            for name in self._state_names:
+                self._exec.arg_dict[name][:] = value
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, 'wb') as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        with open(fname, 'rb') as f:
+            self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """Reshape the module for new input shapes."""
+        assert self.binded
+        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                             for x in data_shapes]
+        if label_shapes:
+            self._label_shapes = [x if isinstance(x, DataDesc)
+                                  else DataDesc(*x) for x in label_shapes]
+        kwargs = {d.name: tuple(d.shape)
+                  for d in self._data_shapes + (self._label_shapes or [])}
+        self._exec = self._exec.reshape(**kwargs)

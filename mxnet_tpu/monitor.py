@@ -1,0 +1,89 @@
+"""Monitor: per-op output statistics taps.
+
+Reference parity: python/mxnet/monitor.py — installs a callback on the
+executor that records output stats every `interval` batches (C side:
+graph_executor.cc:173 SetMonitorCallback).
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+__all__ = ['Monitor']
+
+
+class Monitor:
+    """Monitor outputs, weights, and gradients for debugging."""
+
+    def __init__(self, interval, stat_func=None, pattern='.*', sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """Returns |x|/size(x)."""
+                return x.norm() / (x.size ** 0.5)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Install the monitor tap on an executor."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting stats for the current batch."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """End collecting, return results [(step, name, stat)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        for exe in self.exes:
+            for name, array in exe.arg_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+            for name, array in exe.aux_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ''
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asscalar()) + '\t'
+                else:
+                    s += str(v.asnumpy()) + '\t'
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """End collecting and log results."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info('Batch: {:7d} {:30s} {:s}'.format(n, k, v))

@@ -1,0 +1,130 @@
+"""Image ops (reference: src/operator/image/image_random-inl.h — to_tensor,
+normalize, random flips / color jitter as ops; resize.cc, crop.cc).
+
+Device-side augmentation path: these run as jax ops so they fuse into the
+input pipeline's device program (the reference runs them on GPU inside the
+graph). Random ops consume PRNG keys via the registry's needs_rng protocol.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register('_image_to_tensor', aliases=('image_to_tensor',))
+def to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (batched: NHWC->NCHW)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register('_image_normalize', aliases=('image_normalize',))
+def normalize(data, *, mean=0.0, std=1.0):
+    """Channel-wise normalize on CHW/NCHW float input."""
+    mean_arr = jnp.asarray(mean, dtype=data.dtype)
+    std_arr = jnp.asarray(std, dtype=data.dtype)
+    nch = data.ndim - 2
+    if mean_arr.ndim == 1:
+        mean_arr = mean_arr.reshape((-1,) + (1,) * 2) if data.ndim == 3 \
+            else mean_arr.reshape((1, -1) + (1,) * 2)
+    if std_arr.ndim == 1:
+        std_arr = std_arr.reshape((-1,) + (1,) * 2) if data.ndim == 3 \
+            else std_arr.reshape((1, -1) + (1,) * 2)
+    return (data - mean_arr) / std_arr
+
+
+@register('_image_resize', aliases=('image_resize',))
+def resize(data, *, size=None, keep_ratio=False, interp=1):
+    """Resize HWC (or NHWC) images; bilinear by default
+    (reference: image/resize.cc)."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size  # reference convention: (w, h)
+    method = 'nearest' if interp == 0 else 'linear'
+    if data.ndim == 3:
+        out_shape = (h, w, data.shape[2])
+    else:
+        out_shape = (data.shape[0], h, w, data.shape[3])
+    out = jax.image.resize(data.astype(jnp.float32), out_shape, method=method)
+    return out.astype(data.dtype)
+
+
+@register('_image_crop', aliases=('image_crop',))
+def crop(data, *, x=0, y=0, width=None, height=None):
+    """Fixed crop of HWC/NHWC image (reference: image/crop.cc)."""
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width]
+    return data[:, y:y + height, x:x + width]
+
+
+@register('_image_flip_left_right')
+def flip_left_right(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register('_image_flip_top_bottom')
+def flip_top_bottom(data):
+    return jnp.flip(data, axis=-3)
+
+
+@register('_image_random_flip_left_right', needs_rng=True)
+def random_flip_left_right(key, data, *, p=0.5):
+    return jnp.where(jax.random.bernoulli(key, p),
+                     jnp.flip(data, axis=-2), data)
+
+
+@register('_image_random_flip_top_bottom', needs_rng=True)
+def random_flip_top_bottom(key, data, *, p=0.5):
+    return jnp.where(jax.random.bernoulli(key, p),
+                     jnp.flip(data, axis=-3), data)
+
+
+def _adjust_brightness(data, factor):
+    return data * factor
+
+
+def _adjust_contrast(data, factor):
+    gray = jnp.mean(data, axis=(-3, -2, -1), keepdims=True) \
+        if data.ndim == 3 else jnp.mean(data, axis=(-3, -2, -1), keepdims=True)
+    return (data - gray) * factor + gray
+
+
+def _adjust_saturation(data, factor):
+    # luminance-weighted gray (HWC channel-last)
+    coef = jnp.asarray([0.299, 0.587, 0.114], dtype=data.dtype)
+    gray = jnp.sum(data * coef, axis=-1, keepdims=True)
+    return (data - gray) * factor + gray
+
+
+@register('_image_random_brightness', needs_rng=True)
+def random_brightness(key, data, *, min_factor=0.5, max_factor=1.5):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _adjust_brightness(data, f)
+
+
+@register('_image_random_contrast', needs_rng=True)
+def random_contrast(key, data, *, min_factor=0.5, max_factor=1.5):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _adjust_contrast(data, f)
+
+
+@register('_image_random_saturation', needs_rng=True)
+def random_saturation(key, data, *, min_factor=0.5, max_factor=1.5):
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _adjust_saturation(data, f)
+
+
+@register('_image_random_lighting', needs_rng=True)
+def random_lighting(key, data, *, alpha_std=0.05):
+    """AlexNet-style PCA lighting jitter (reference: image_random-inl.h)."""
+    eigval = jnp.asarray([55.46, 4.794, 1.148], dtype=jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], dtype=jnp.float32)
+    alpha = jax.random.normal(key, (3,)) * alpha_std
+    rgb = eigvec @ (alpha * eigval)
+    return data + rgb.astype(data.dtype)

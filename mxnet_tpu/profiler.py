@@ -1,0 +1,219 @@
+"""Profiler: chrome://tracing output + scoped annotations.
+
+Reference parity: python/mxnet/profiler.py (set_config/set_state/dump,
+ProfileTask/Event/Counter scopes) over src/profiler/ (chrome trace JSON,
+profiler.h:88,438; SURVEY.md §5.1).
+
+TPU-native design: wraps jax.profiler (XPlane/TensorBoard trace) behind the
+MXNet-shaped API, and additionally keeps a lightweight in-process chrome
+trace of user scopes so `dump()` always produces a chrome://tracing file
+even without TensorBoard.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ['set_config', 'profiler_set_config', 'set_state',
+           'profiler_set_state', 'dump', 'dumps', 'pause', 'resume',
+           'Task', 'Frame', 'Event', 'Counter', 'Marker', 'scope']
+
+_config = {'filename': 'profile.json', 'profile_all': False,
+           'profile_symbolic': True, 'profile_imperative': True,
+           'profile_memory': False, 'profile_api': False,
+           'aggregate_stats': False}
+_state = {'running': False, 'jax_dir': None}
+_events = []
+_lock = threading.Lock()
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference: profiler.py set_config;
+    env autostart via MXNET_PROFILER_AUTOSTART)."""
+    _config.update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def set_state(state='stop', profile_process='worker'):
+    """Start/stop profiling (reference: profiler.py set_state). 'run'
+    starts a jax.profiler trace when a trace dir is configured."""
+    if state == 'run':
+        _state['running'] = True
+        fname = _config.get('filename', 'profile.json')
+        trace_dir = os.path.splitext(fname)[0] + '_xplane'
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+            _state['jax_dir'] = trace_dir
+        except Exception:
+            _state['jax_dir'] = None
+    elif state == 'stop':
+        if _state.get('jax_dir'):
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _state['jax_dir'] = None
+        _state['running'] = False
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+profiler_set_state = set_state
+
+
+def pause(profile_process='worker'):
+    _state['running'] = False
+
+
+def resume(profile_process='worker'):
+    _state['running'] = True
+
+
+def _emit(ph, name, cat, ts, dur=None, args=None):
+    ev = {'ph': ph, 'name': name, 'cat': cat, 'pid': os.getpid(),
+          'tid': threading.get_ident(), 'ts': ts * 1e6}
+    if dur is not None:
+        ev['dur'] = dur * 1e6
+    if args:
+        ev['args'] = args
+    with _lock:
+        _events.append(ev)
+
+
+def dumps(reset=False):
+    """Return aggregate stats string (reference: profiler.py dumps)."""
+    with _lock:
+        by_name = {}
+        for ev in _events:
+            if ev['ph'] == 'X':
+                agg = by_name.setdefault(ev['name'], [0, 0.0])
+                agg[0] += 1
+                agg[1] += ev.get('dur', 0.0) / 1e3
+        lines = ['%-40s %8s %12s' % ('Name', 'Calls', 'Total ms')]
+        for name, (calls, total) in sorted(by_name.items()):
+            lines.append('%-40s %8d %12.3f' % (name, calls, total))
+        if reset:
+            _events.clear()
+    return '\n'.join(lines)
+
+
+def dump(finished=True, profile_process='worker'):
+    """Write the chrome://tracing JSON (reference: profiler.py dump)."""
+    fname = _config.get('filename', 'profile.json')
+    with _lock:
+        data = {'traceEvents': list(_events), 'displayTimeUnit': 'ms'}
+    with open(fname, 'w') as f:
+        json.dump(data, f)
+    return fname
+
+
+class _Scoped:
+    """Base for named profiling objects with start/stop."""
+
+    _cat = 'user'
+
+    def __init__(self, name):
+        self.name = name
+        self._start = None
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is None:
+            return
+        now = time.perf_counter()
+        _emit('X', self.name, self._cat, self._start, now - self._start)
+        self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Scoped):
+    """Profile a task (reference: profiler.py Task)."""
+    _cat = 'task'
+
+    def __init__(self, domain=None, name='task'):
+        super().__init__(name)
+
+
+class Frame(_Scoped):
+    _cat = 'frame'
+
+    def __init__(self, domain=None, name='frame'):
+        super().__init__(name)
+
+
+class Event(_Scoped):
+    _cat = 'event'
+
+    def __init__(self, name='event'):
+        super().__init__(name)
+
+
+class Counter:
+    """Profile a numeric counter (reference: profiler.py Counter)."""
+
+    def __init__(self, domain=None, name='counter', value=0):
+        self.name = name
+        self._value = value
+        self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        _emit('C', self.name, 'counter', time.perf_counter(),
+              args={'value': value})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    __iadd__ = increment
+    __isub__ = decrement
+
+
+class Marker:
+    """Instant marker (reference: profiler.py Marker)."""
+
+    def __init__(self, domain=None, name='marker'):
+        self.name = name
+
+    def mark(self, scope='process'):
+        _emit('i', self.name, 'marker', time.perf_counter())
+
+
+class scope(_Scoped):
+    """Context manager annotating a region; also forwards to
+    jax.profiler.TraceAnnotation so scopes appear in XPlane traces."""
+
+    def __init__(self, name='scope'):
+        super().__init__(name)
+        self._jax_ann = None
+
+    def __enter__(self):
+        super().__enter__()
+        try:
+            import jax
+            self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ann.__enter__()
+        except Exception:
+            self._jax_ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(*exc)
+        super().__exit__(*exc)

@@ -1,0 +1,260 @@
+"""RecordIO file format reader/writer.
+
+Reference parity: python/mxnet/recordio.py (MXRecordIO/MXIndexedRecordIO;
+record format = IRHeader(flag,label,id,id2) struct-packed + payload,
+recordio.py:344-397) and dmlc-core's on-disk framing:
+  [kMagic:uint32][lrecord:uint32][data ... pad to 4B]
+where lrecord encodes cflag (upper 3 bits) | length (lower 29 bits).
+
+Pure-Python implementation — byte-compatible with .rec files produced by
+the reference's im2rec tool, so existing datasets load unchanged (the C++
+dependency of the reference is unnecessary at these throughputs because
+decode dominates; see io/ for the multiprocess decode pipeline).
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ['MXRecordIO', 'MXIndexedRecordIO', 'IRHeader', 'pack', 'unpack',
+           'pack_img', 'unpack_img']
+
+_kMagic = 0xced7230a
+
+IRHeader = namedtuple('HEADER', ['flag', 'label', 'id', 'id2'])
+_IR_FORMAT = 'IfQQ'
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return (lrec >> 29) & 7, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference: recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == 'w':
+            self.handle = open(self.uri, 'wb')
+            self.writable = True
+        elif self.flag == 'r':
+            self.handle = open(self.uri, 'rb')
+            self.writable = False
+        else:
+            raise ValueError('Invalid flag %s' % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.handle.close()
+        self.is_open = False
+        self.pid = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        """Override pickling behavior (DataLoader workers re-open)."""
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d['is_open'] = is_open
+        d.pop('handle', None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__ = d
+        is_open = d.get('is_open', False)
+        self.is_open = False
+        self.handle = None
+        if is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        """Process-fork safety (reference: recordio.py _check_pid)."""
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError('Forbidden operation in multiple processes')
+
+    def reset(self):
+        """Reset read pointer (re-open)."""
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        """Insert a raw string record."""
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        data = bytes(buf)
+        self.handle.write(struct.pack('<II', _kMagic,
+                                      _encode_lrec(0, len(data))))
+        self.handle.write(data)
+        pad = (4 - len(data) % 4) % 4
+        if pad:
+            self.handle.write(b'\x00' * pad)
+
+    def read(self):
+        """Read one record as bytes, or None at EOF."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack('<II', header)
+        assert magic == _kMagic, 'Invalid RecordIO magic in %s' % self.uri
+        cflag, length = _decode_lrec(lrec)
+        # cflag 0 = whole record; 1/2/3 = split records (rare, from
+        # multi-part writes) — reassemble
+        data = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        if cflag == 0:
+            return data
+        parts = [data]
+        while cflag in (1, 2):
+            header = self.handle.read(8)
+            magic, lrec = struct.unpack('<II', header)
+            assert magic == _kMagic
+            cflag, length = _decode_lrec(lrec)
+            chunk = self.handle.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            parts.append(chunk)
+        return b''.join(parts)
+
+    def tell(self):
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed RecordIO with random access by key (reference: recordio.py:167).
+
+    Index file: lines of "<key>\\t<byte-offset>".
+    """
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == 'r' and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fidx:
+                for line in fidx:
+                    parts = line.strip().split('\t')
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        elif self.flag == 'w':
+            self.fidx = open(self.idx_path, 'w')
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def seek(self, idx):
+        """Set read pointer to the record with key idx."""
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        """Read the record at key idx."""
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        """Write a record and append its offset to the index."""
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write('%s\t%d\n' % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack a header and payload into a record string
+    (reference: recordio.py:344)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label,
+                             header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        packed = struct.pack(_IR_FORMAT, header.flag, header.label,
+                             header.id, header.id2) + label.tobytes()
+    return packed + s
+
+
+def unpack(s):
+    """Unpack a record into header + payload (reference: recordio.py:368)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack a record into header + decoded image
+    (reference: recordio.py:386)."""
+    import cv2
+    header, s = unpack(s)
+    img = np.frombuffer(s, dtype=np.uint8)
+    img = cv2.imdecode(img, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt='.jpg'):
+    """Pack a header and image into a record string
+    (reference: recordio.py:411)."""
+    import cv2
+    jpg_formats = ['.JPG', '.JPEG']
+    png_formats = ['.PNG']
+    encode_params = None
+    if img_fmt.upper() in jpg_formats:
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt.upper() in png_formats:
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, min(quality, 9)]
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, 'failed to encode image'
+    return pack(header, buf.tobytes())

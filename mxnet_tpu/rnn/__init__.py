@@ -1,0 +1,6 @@
+"""mx.rnn — symbolic RNN cells + bucketing io
+(reference: python/mxnet/rnn/)."""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ZoneoutCell, ResidualCell)
+from .io import BucketSentenceIter, encode_sentences

@@ -1,0 +1,479 @@
+"""Symbolic RNN cells (reference: python/mxnet/rnn/rnn_cell.py — the
+Module-era cell zoo used by example/rnn/bucketing/lstm_bucketing.py).
+
+Cells compose Symbols; ``unroll`` builds the length-T graph that
+BucketingModule compiles per bucket (one jit specialization per length).
+FusedRNNCell uses the fused RNN op (lax.scan) — the cuDNN-parity path.
+"""
+from __future__ import annotations
+
+from .. import symbol
+from ..symbol import Symbol
+
+__all__ = ['BaseRNNCell', 'RNNCell', 'LSTMCell', 'GRUCell', 'FusedRNNCell',
+           'SequentialRNNCell', 'BidirectionalCell', 'DropoutCell',
+           'ZoneoutCell', 'ResidualCell', 'RNNParams']
+
+
+class RNNParams:
+    """Container for holding variables (reference: rnn_cell.py RNNParams)."""
+
+    def __init__(self, prefix=''):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract symbolic RNN cell."""
+
+    def __init__(self, prefix='', params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError()
+
+    @property
+    def state_shape(self):
+        return [ele['shape'] for ele in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        assert not self._modified, \
+            'After applying modifier cells the base cell cannot be called '\
+            'directly. Call the modifier cell instead.'
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if info is None:
+                state = func(shape=(0, 0), **kwargs)
+            else:
+                kw = dict(kwargs)
+                kw.update(info)
+                state = func(**{k: v for k, v in kw.items()
+                                if k != '__layout__'})
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Unpack fused weights to unfused (reference: unpack_weights).
+        With matching layouts this is a pass-through plus key renames."""
+        return dict(args)
+
+    def pack_weights(self, args):
+        return dict(args)
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        """Unroll the cell to a length-T symbol graph
+        (reference: rnn_cell.py unroll)."""
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.op.Activation(inputs, act_type=activation,
+                                        **kwargs)
+        return activation(inputs, **kwargs)
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    assert inputs is not None
+    axis = layout.find('T')
+    in_axis = in_layout.find('T') if in_layout is not None else axis
+    if isinstance(inputs, Symbol) and len(inputs) == 1:
+        if merge is False:
+            assert length is not None
+            inputs = list(symbol.op.SliceChannel(
+                inputs, axis=in_axis, num_outputs=length, squeeze_axis=1))
+    else:
+        if isinstance(inputs, Symbol):
+            inputs = list(inputs)
+        assert length is None or len(inputs) == length
+        if merge is True:
+            inputs = [i.expand_dims(axis=axis) for i in inputs]
+            inputs = symbol.op.Concat(*inputs, dim=axis)
+            in_axis = axis
+    if isinstance(inputs, Symbol) and len(inputs) == 1 and axis != in_axis:
+        inputs = symbol.op.SwapAxis(inputs, dim1=axis, dim2=in_axis)
+    return inputs, axis
+
+
+class RNNCell(BaseRNNCell):
+    """Simple recurrent cell (reference: rnn_cell.py RNNCell)."""
+
+    def __init__(self, num_hidden, activation='tanh', prefix='rnn_',
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get('i2h_weight')
+        self._iB = self.params.get('i2h_bias')
+        self._hW = self.params.get('h2h_weight')
+        self._hB = self.params.get('h2h_bias')
+
+    @property
+    def state_info(self):
+        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = '%st%d_' % (self._prefix, self._counter)
+        i2h = symbol.op.FullyConnected(inputs, self._iW, self._iB,
+                                       num_hidden=self._num_hidden,
+                                       name='%si2h' % name)
+        h2h = symbol.op.FullyConnected(states[0], self._hW, self._hB,
+                                       num_hidden=self._num_hidden,
+                                       name='%sh2h' % name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name='%sout' % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell (reference: rnn_cell.py LSTMCell)."""
+
+    def __init__(self, num_hidden, prefix='lstm_', params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        from ..initializer import LSTMBias
+        self._iW = self.params.get('i2h_weight')
+        self._iB = self.params.get('i2h_bias')
+        self._hW = self.params.get('h2h_weight')
+        self._hB = self.params.get('h2h_bias')
+
+    @property
+    def state_info(self):
+        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'},
+                {'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+
+    @property
+    def _gate_names(self):
+        return ('_i', '_f', '_c', '_o')
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = '%st%d_' % (self._prefix, self._counter)
+        i2h = symbol.op.FullyConnected(inputs, self._iW, self._iB,
+                                       num_hidden=self._num_hidden * 4,
+                                       name='%si2h' % name)
+        h2h = symbol.op.FullyConnected(states[0], self._hW, self._hB,
+                                       num_hidden=self._num_hidden * 4,
+                                       name='%sh2h' % name)
+        gates = i2h + h2h
+        slice_gates = symbol.op.SliceChannel(gates, num_outputs=4,
+                                             name='%sslice' % name)
+        in_gate = symbol.op.Activation(slice_gates[0], act_type='sigmoid',
+                                       name='%si' % name)
+        forget_gate = symbol.op.Activation(slice_gates[1],
+                                           act_type='sigmoid',
+                                           name='%sf' % name)
+        in_transform = symbol.op.Activation(slice_gates[2], act_type='tanh',
+                                            name='%sc' % name)
+        out_gate = symbol.op.Activation(slice_gates[3], act_type='sigmoid',
+                                        name='%so' % name)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * symbol.op.Activation(next_c, act_type='tanh')
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell (reference: rnn_cell.py GRUCell)."""
+
+    def __init__(self, num_hidden, prefix='gru_', params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get('i2h_weight')
+        self._iB = self.params.get('i2h_bias')
+        self._hW = self.params.get('h2h_weight')
+        self._hB = self.params.get('h2h_bias')
+
+    @property
+    def state_info(self):
+        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+
+    @property
+    def _gate_names(self):
+        return ('_r', '_z', '_o')
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = '%st%d_' % (self._prefix, self._counter)
+        prev_state_h = states[0]
+        i2h = symbol.op.FullyConnected(inputs, self._iW, self._iB,
+                                       num_hidden=self._num_hidden * 3,
+                                       name='%si2h' % name)
+        h2h = symbol.op.FullyConnected(prev_state_h, self._hW, self._hB,
+                                       num_hidden=self._num_hidden * 3,
+                                       name='%sh2h' % name)
+        i2h_r, i2h_z, i2h = symbol.op.SliceChannel(
+            i2h, num_outputs=3, name='%si2h_slice' % name)
+        h2h_r, h2h_z, h2h = symbol.op.SliceChannel(
+            h2h, num_outputs=3, name='%sh2h_slice' % name)
+        reset_gate = symbol.op.Activation(i2h_r + h2h_r, act_type='sigmoid',
+                                          name='%sr_act' % name)
+        update_gate = symbol.op.Activation(i2h_z + h2h_z,
+                                           act_type='sigmoid',
+                                           name='%sz_act' % name)
+        next_h_tmp = symbol.op.Activation(i2h + reset_gate * h2h,
+                                          act_type='tanh',
+                                          name='%sh_act' % name)
+        next_h = (1. - update_gate) * next_h_tmp + \
+            update_gate * prev_state_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN over the RNN op
+    (reference: rnn_cell.py FusedRNNCell — the cuDNN path; here lax.scan)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode='lstm',
+                 bidirectional=False, dropout=0., get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = '%s_' % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._directions = 2 if bidirectional else 1
+        self._parameter = self.params.get('parameters')
+
+    @property
+    def state_info(self):
+        b = self._directions * self._num_layers
+        n = 2 if self._mode == 'lstm' else 1
+        return [{'shape': (b, 0, self._num_hidden), '__layout__': 'LNC'}
+                for _ in range(n)]
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, True)
+        if axis == 1:  # NTC -> TNC for the op
+            inputs = symbol.op.SwapAxis(inputs, dim1=0, dim2=1)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        rnn_args = [inputs, self._parameter] + states
+        rnn = symbol.op.RNN(*rnn_args, state_size=self._num_hidden,
+                            num_layers=self._num_layers,
+                            bidirectional=self._bidirectional,
+                            p=self._dropout, state_outputs=True,
+                            mode=self._mode,
+                            name='%srnn' % self._prefix)
+        outputs = rnn[0]
+        if self._mode == 'lstm':
+            states = [rnn[1], rnn[2]] if self._get_next_state else []
+        else:
+            states = [rnn[1]] if self._get_next_state else []
+        if axis == 1:
+            outputs = symbol.op.SwapAxis(outputs, dim1=0, dim2=1)
+        if merge_outputs is False:
+            outputs = list(symbol.op.SliceChannel(
+                outputs, axis=axis, num_outputs=length, squeeze_axis=1))
+        return outputs, states
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stacked cells (reference: rnn_cell.py SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix='', params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, \
+                'Either specify params for SequentialRNNCell or child cells, not both.'
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info)
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        self.reset()
+        num_cells = len(self._cells)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            next_states.extend(states)
+        return inputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout between stacked cells (reference: DropoutCell)."""
+
+    def __init__(self, dropout, prefix='dropout_', params=None):
+        super().__init__(prefix, params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.op.Dropout(inputs, p=self.dropout)
+        return inputs, states
+
+
+class ZoneoutCell(BaseRNNCell):
+    """Zoneout modifier (reference: ZoneoutCell; simplified symbolic)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
+        super().__init__(prefix=base_cell._prefix + 'zoneout_',
+                         params=base_cell.params)
+        self.base_cell = base_cell
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        if self.zoneout_states > 0.:
+            next_states = [
+                symbol.op.where(
+                    symbol.op.Dropout(symbol.op.ones_like(ns),
+                                      p=self.zoneout_states) *
+                    self.zoneout_states, ns, s)
+                for ns, s in zip(next_states, states)]
+        return out, next_states
+
+
+class ResidualCell(BaseRNNCell):
+    """Residual modifier (reference: ResidualCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell._prefix + 'residual_',
+                         params=base_cell.params)
+        self.base_cell = base_cell
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Bidirectional wrapper (reference: BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix='bi_'):
+        super().__init__('', params=params)
+        self._output_prefix = output_prefix
+        self._cells = [l_cell, r_cell]
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError('Bidirectional cannot be stepped. '
+                                  'Please use unroll')
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        l_cell, r_cell = self._cells
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[:len(l_cell.state_info)], layout=layout,
+            merge_outputs=False)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[len(l_cell.state_info):], layout=layout,
+            merge_outputs=False)
+        outputs = [symbol.op.Concat(l_o, r_o, dim=1,
+                                    name='%st%d' % (self._output_prefix, i))
+                   for i, (l_o, r_o) in enumerate(
+                       zip(l_outputs, reversed(r_outputs)))]
+        if merge_outputs:
+            outputs = [o.expand_dims(axis=axis) for o in outputs]
+            outputs = symbol.op.Concat(*outputs, dim=axis)
+        return outputs, l_states + r_states

@@ -1,0 +1,79 @@
+"""Runtime feature detection (reference: python/mxnet/runtime.py:57
+feature_list over include/mxnet/libinfo.h:131)."""
+from __future__ import annotations
+
+__all__ = ['Feature', 'feature_list', 'Features']
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return '✔ %s' % self.name if self.enabled else '✖ %s' % self.name
+
+
+def _detect():
+    import jax
+    feats = {}
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except RuntimeError:
+        platforms = set()
+    feats['TPU'] = bool(platforms - {'cpu'})
+    feats['CUDA'] = False
+    feats['CUDNN'] = False
+    feats['NCCL'] = False
+    feats['MKLDNN'] = False
+    feats['XLA'] = True
+    feats['JIT'] = True
+    feats['PALLAS'] = _has_pallas()
+    feats['OPENCV'] = _has('cv2')
+    feats['BLAS_OPEN'] = True
+    feats['DIST_KVSTORE'] = True      # jax.distributed path
+    feats['INT64_TENSOR_SIZE'] = True
+    feats['SIGNAL_HANDLER'] = True
+    feats['PROFILER'] = True
+    feats['F16C'] = True
+    return feats
+
+
+def _has(mod):
+    try:
+        __import__(mod)
+        return True
+    except ImportError:
+        return False
+
+
+def _has_pallas():
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def feature_list():
+    """List of runtime features (reference: runtime.py feature_list)."""
+    return [Feature(k, v) for k, v in _detect().items()]
+
+
+class Features(dict):
+    """Dict-like feature map supporting is_enabled (reference: Features)."""
+
+    instance = None
+
+    def __init__(self):
+        super().__init__([(f.name, f) for f in feature_list()])
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        assert feature_name in self, \
+            'Feature %s is unknown, known features are: %s' % (
+                feature_name, list(self.keys()))
+        return self[feature_name].enabled

@@ -1,0 +1,14 @@
+"""mxnet_tpu.symbol — the mx.sym namespace (reference: python/mxnet/symbol/)."""
+import sys as _sys
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     zeros, ones, full, arange, maximum, minimum, hypot, pow)
+from . import register as _register
+
+op = _register.make_op_module(__name__ + '.op')
+_internal = op
+
+_mod = _sys.modules[__name__]
+for _name in dir(op):
+    if not _name.startswith('__') and not hasattr(_mod, _name):
+        setattr(_mod, _name, getattr(op, _name))

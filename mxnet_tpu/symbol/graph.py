@@ -1,0 +1,196 @@
+"""Symbol graph metadata: per-op input names, aux classification, and
+parameter-shape inference hooks.
+
+Reference parity: nnvm op attributes FListInputNames / FMutateInputs /
+FInferShape (include/mxnet/op_attr_types.h). The reference's symbolic API
+auto-creates variables for omitted named inputs (e.g.
+``sym.Convolution(data=d, num_filter=8, kernel=(3,3))`` materializes
+``convolution0_weight``) and infers their shapes bidirectionally; here the
+shape hooks compute parameter shapes from the data shape + attrs, and
+forward shapes come from jax.eval_shape over the whole graph.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ['input_names_of', 'aux_indices_of', 'param_shapes_of',
+           'num_outputs_of']
+
+
+def num_outputs_of(op, attrs):
+    """Output count for ops whose arity depends on attrs (the reference
+    encodes this as nnvm FNumOutputs)."""
+    if op.name in ('SliceChannel', 'split'):
+        return int(attrs.get('num_outputs', 1))
+    if op.name in ('_split_v2', 'split_v2'):
+        iors = attrs.get('indices_or_sections', 1)
+        try:
+            return len(iors) + 1
+        except TypeError:
+            return int(iors)
+    if op.name == 'RNN':
+        if not attrs.get('state_outputs', True):
+            return 1
+        return 3 if attrs.get('mode', 'lstm') == 'lstm' else 2
+    if op.name == 'topk':
+        return 2 if attrs.get('ret_typ') == 'both' else 1
+    if op.name.startswith('BatchNorm'):
+        return 3
+    if op.num_outputs and op.num_outputs > 0:
+        return op.num_outputs
+    return 1
+
+
+def num_visible_outputs_of(op, attrs):
+    """Outputs exposed for composition/indexing (reference: nnvm
+    FNumVisibleOutputs — BatchNorm's mean/var are hidden)."""
+    if op.name.startswith('BatchNorm'):
+        return 1
+    return num_outputs_of(op, attrs)
+
+# op -> ordered input names (only ops whose inputs have meaning beyond
+# data/lhs/rhs need entries; everything else defaults)
+INPUT_NAMES = {
+    'FullyConnected': ('data', 'weight', 'bias'),
+    'Convolution': ('data', 'weight', 'bias'),
+    'Convolution_v1': ('data', 'weight', 'bias'),
+    'Deconvolution': ('data', 'weight', 'bias'),
+    'BatchNorm': ('data', 'gamma', 'beta', 'moving_mean', 'moving_var'),
+    'BatchNorm_v1': ('data', 'gamma', 'beta', 'moving_mean', 'moving_var'),
+    'LayerNorm': ('data', 'gamma', 'beta'),
+    'InstanceNorm': ('data', 'gamma', 'beta'),
+    'L2Normalization': ('data',),
+    'Embedding': ('data', 'weight'),
+    'LeakyReLU': ('data', 'gamma'),
+    'SoftmaxOutput': ('data', 'label'),
+    'Softmax': ('data', 'label'),
+    'LinearRegressionOutput': ('data', 'label'),
+    'LogisticRegressionOutput': ('data', 'label'),
+    'MAERegressionOutput': ('data', 'label'),
+    'SVMOutput': ('data', 'label'),
+    'softmax_cross_entropy': ('data', 'label'),
+    'RNN': ('data', 'parameters', 'state', 'state_cell'),
+    'SequenceMask': ('data', 'sequence_length'),
+    'SequenceLast': ('data', 'sequence_length'),
+    'SequenceReverse': ('data', 'sequence_length'),
+    'CTCLoss': ('data', 'label', 'data_lengths', 'label_lengths'),
+    'dot': ('lhs', 'rhs'),
+    'batch_dot': ('lhs', 'rhs'),
+    'where': ('condition', 'x', 'y'),
+    'Concat': None,  # variadic
+}
+
+# which *inputs* are auxiliary states (not learnable arguments) — the
+# reference's MutateInputs set (BatchNorm moving stats)
+AUX_INDICES = {
+    'BatchNorm': (3, 4),
+    'BatchNorm_v1': (3, 4),
+    'CuDNNBatchNorm': (3, 4),
+    '_contrib_SyncBatchNorm': (3, 4),
+}
+
+_GENERIC_BINARY = ('lhs', 'rhs')
+
+
+def input_names_of(op):
+    """Ordered input names for an op (None for variadic)."""
+    if op.name in INPUT_NAMES:
+        return INPUT_NAMES[op.name]
+    if op.num_inputs == 1:
+        return ('data',)
+    if op.num_inputs == 2:
+        return _GENERIC_BINARY
+    if op.num_inputs and op.num_inputs > 2:
+        return tuple('arg%d' % i for i in range(op.num_inputs))
+    return None
+
+
+def aux_indices_of(op):
+    return AUX_INDICES.get(op.name, ())
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    return t if len(t) == n else (t + (t[-1],) * n)[:n]
+
+
+def param_shapes_of(opname, attrs, data_shape):
+    """Infer parameter (non-data input) shapes from the data shape + attrs
+    (the reference's backward shape inference for parameter inputs).
+
+    Returns {input_name: shape} for inputs that are parameters/aux.
+    """
+    a = attrs
+    if opname == 'FullyConnected':
+        num_hidden = int(a['num_hidden'])
+        flatten = a.get('flatten', True)
+        in_units = int(onp.prod(data_shape[1:])) if flatten \
+            else data_shape[-1]
+        shapes = {'weight': (num_hidden, in_units)}
+        if not a.get('no_bias', False):
+            shapes['bias'] = (num_hidden,)
+        return shapes
+    if opname in ('Convolution', 'Convolution_v1'):
+        kernel = tuple(a['kernel'])
+        num_filter = int(a['num_filter'])
+        num_group = int(a.get('num_group', 1))
+        in_ch = data_shape[1]
+        shapes = {'weight': (num_filter, in_ch // num_group) + kernel}
+        if not a.get('no_bias', False):
+            shapes['bias'] = (num_filter,)
+        return shapes
+    if opname == 'Deconvolution':
+        kernel = tuple(a['kernel'])
+        num_filter = int(a['num_filter'])
+        num_group = int(a.get('num_group', 1))
+        in_ch = data_shape[1]
+        shapes = {'weight': (in_ch, num_filter // num_group) + kernel}
+        if not a.get('no_bias', True):
+            shapes['bias'] = (num_filter,)
+        return shapes
+    if opname in ('BatchNorm', 'BatchNorm_v1', '_contrib_SyncBatchNorm'):
+        ax = int(a.get('axis', 1)) % len(data_shape)
+        c = data_shape[ax]
+        return {'gamma': (c,), 'beta': (c,), 'moving_mean': (c,),
+                'moving_var': (c,)}
+    if opname == 'LayerNorm':
+        ax = int(a.get('axis', -1)) % len(data_shape)
+        c = data_shape[ax]
+        return {'gamma': (c,), 'beta': (c,)}
+    if opname == 'InstanceNorm':
+        c = data_shape[1]
+        return {'gamma': (c,), 'beta': (c,)}
+    if opname == 'Embedding':
+        return {'weight': (int(a['input_dim']), int(a['output_dim']))}
+    if opname in ('SoftmaxOutput', 'Softmax'):
+        if a.get('multi_output', False):
+            return {'label': (data_shape[0],) + tuple(data_shape[2:])}
+        return {'label': (data_shape[0],)}
+    if opname in ('softmax_cross_entropy', 'SVMOutput'):
+        return {'label': (data_shape[0],)}
+    if opname in ('LinearRegressionOutput', 'LogisticRegressionOutput',
+                  'MAERegressionOutput'):
+        return {'label': tuple(data_shape)}
+    if opname == 'LeakyReLU' and a.get('act_type') == 'prelu':
+        return {'gamma': (data_shape[1] if len(data_shape) > 1 else 1,)}
+    if opname == 'RNN':
+        # flat param vector size (ops/nn.py _rnn_unpack_params layout)
+        mode = a.get('mode', 'lstm')
+        ngates = {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4, 'gru': 3}[mode]
+        H = int(a['state_size'])
+        L = int(a.get('num_layers', 1))
+        D = 2 if a.get('bidirectional', False) else 1
+        I = data_shape[-1]
+        size = 0
+        for layer in range(L):
+            inp = I if layer == 0 else H * D
+            size += D * (ngates * H * inp + ngates * H * H +
+                         2 * ngates * H)
+        return {'parameters': (size,),
+                'state': (L * D, data_shape[1], H),
+                'state_cell': (L * D, data_shape[1], H)}
+    return {}

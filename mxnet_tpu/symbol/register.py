@@ -1,0 +1,118 @@
+"""Generate symbolic op wrappers from the registry.
+
+Reference parity: python/mxnet/symbol/register.py:35-201 — wrappers accept
+positional or keyword Symbol inputs; omitted named inputs (weight/bias/
+gamma/...) are auto-created as Variables named ``<node>_<input>`` exactly
+like the reference, which is what makes the symbol model zoo
+(sym.Convolution(data=..., num_filter=...)) work without explicit
+parameter plumbing.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from ..name import NameManager
+from ..ops import registry as _registry
+from .symbol import Symbol, _Node, _create, Variable
+from .graph import input_names_of, aux_indices_of
+
+
+def _expected_inputs(op, attrs):
+    """Input list after resolving optional inputs from attrs."""
+    names = input_names_of(op)
+    if names is None:
+        return None
+    if op.name in ('FullyConnected', 'Convolution', 'Convolution_v1'):
+        return names[:2] if attrs.get('no_bias', False) else names
+    if op.name == 'Deconvolution':
+        return names[:2] if attrs.get('no_bias', True) else names
+    if op.name == 'LeakyReLU':
+        return ('data', 'gamma') if attrs.get('act_type') == 'prelu' \
+            else ('data',)
+    if op.name == 'RNN':
+        return names if attrs.get('mode', 'lstm') == 'lstm' else names[:3]
+    if op.name in ('SequenceMask', 'SequenceLast', 'SequenceReverse'):
+        return names if attrs.get('use_sequence_length', False) \
+            else names[:1]
+    if op.name in ('CTCLoss', 'ctc_loss'):
+        base = ['data', 'label']
+        if attrs.get('use_data_lengths', False):
+            base.append('data_lengths')
+        if attrs.get('use_label_lengths', False):
+            base.append('label_lengths')
+        return tuple(base)
+    return names
+
+
+def _make_wrapper(wname, op):
+    structured = input_names_of(op) is not None and op.num_inputs != 0
+
+    def wrapper(*args, **kwargs):
+        name = kwargs.pop('name', None)
+        kwargs.pop('attr', None)
+        kwargs.pop('out', None)
+        sym_args = list(args)
+        named_syms = {}
+        attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                named_syms[k] = v
+            else:
+                attrs[k] = v
+        hint = op.name.lower().lstrip('_')
+        if op.num_inputs == -1 and not named_syms and not structured:
+            # pure variadic (Concat, add_n, ...)
+            data = []
+            for a in sym_args:
+                if isinstance(a, (list, tuple)):
+                    data.extend(a)
+                else:
+                    data.append(a)
+            if op.key_var_num_args and op.key_var_num_args not in attrs:
+                attrs[op.key_var_num_args] = len(data)
+            return _create(op, data, attrs, name=name)
+        expected = _expected_inputs(op, attrs)
+        if expected is None:
+            # variadic with possible list in args
+            data = []
+            for a in sym_args:
+                if isinstance(a, (list, tuple)):
+                    data.extend(a)
+                else:
+                    data.append(a)
+            if op.key_var_num_args and op.key_var_num_args not in attrs:
+                attrs[op.key_var_num_args] = len(data)
+            return _create(op, data, attrs, name=name)
+        node_name = NameManager.current.get(name, hint)
+        inputs = []
+        pos = 0
+        for in_name in expected:
+            if pos < len(sym_args):
+                inputs.append(sym_args[pos])
+                pos += 1
+            elif in_name in named_syms:
+                inputs.append(named_syms.pop(in_name))
+            else:
+                inputs.append(Variable('%s_%s' % (node_name, in_name)))
+        if named_syms:
+            raise TypeError('unknown symbol inputs %s for op %s'
+                            % (list(named_syms), op.name))
+        return _create(op, inputs, attrs, name=node_name)
+
+    wrapper.__name__ = wname
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+def init_op_module(target_module):
+    for name, op in sorted(_registry.OPS.items()):
+        setattr(target_module, name, _make_wrapper(name, op))
+    return target_module
+
+
+def make_op_module(fullname):
+    mod = types.ModuleType(fullname, 'auto-generated symbolic op wrappers')
+    init_op_module(mod)
+    sys.modules[fullname] = mod
+    return mod

@@ -1,0 +1,710 @@
+"""Symbol: declarative graph API.
+
+Reference parity: python/mxnet/symbol/symbol.py (compose, infer_shape
+:1017, simple_bind :1375, bind :1639, save/load JSON, get_internals,
+arithmetic) over nnvm::Symbol.
+
+TPU-native design: a Symbol is a lightweight Python DAG over the SAME pure
+op functions the imperative frontend uses. bind/simple_bind compile the
+whole graph with jax.jit (GraphExecutor+MXPlanMemory parity comes from XLA
+buffer assignment); infer_shape runs jax.eval_shape — one abstract
+interpretation instead of per-op FInferShape.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as onp
+
+from ..base import string_types, numeric_types
+from ..name import NameManager
+from ..ops import registry as _registry
+from .graph import (input_names_of, aux_indices_of, param_shapes_of,
+                    num_outputs_of, num_visible_outputs_of)
+
+__all__ = ['Symbol', 'Variable', 'var', 'Group', 'load', 'load_json',
+           'pow', 'maximum', 'minimum', 'hypot', 'zeros', 'ones', 'full',
+           'arange']
+
+
+class _Node:
+    """One graph node: an op application or a free variable."""
+
+    __slots__ = ('op', 'name', 'attrs', 'inputs', 'num_outputs',
+                 'var_attrs', 'is_aux', '_extra_attrs')
+
+    def __init__(self, op, name, attrs=None, inputs=None, num_outputs=1,
+                 var_attrs=None):
+        self.op = op                      # Operator or None for variables
+        self.name = name
+        self.attrs = attrs or {}          # static op attrs
+        self.inputs = inputs or []        # list[(node, out_idx)]
+        self.num_outputs = num_outputs
+        self.var_attrs = var_attrs or {}  # shape/init/lr_mult... for vars
+        self.is_aux = False
+        self._extra_attrs = {}            # user attrs (ctx_group, lr_mult..)
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+
+def _topo_order(out_entries):
+    """Topological order of nodes reachable from the given entries."""
+    order = []
+    visited = set()
+
+    def visit(node):
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        for (child, _) in node.inputs:
+            visit(child)
+        order.append(node)
+    for (node, _) in out_entries:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """Symbol is a data-flow description (reference: symbol.py Symbol)."""
+
+    def __init__(self, entries):
+        # entries: list of (node, out_index)
+        self._entries = list(entries)
+
+    # -- basic structure ---------------------------------------------------
+    @property
+    def name(self):
+        if len(self._entries) == 1:
+            return self._entries[0][0].name
+        return None
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._entries)
+
+    def __getitem__(self, index):
+        if isinstance(index, string_types):
+            # select output by name
+            names = self.list_outputs()
+            idx = names.index(index) if index in names else None
+            if idx is None:
+                raise ValueError('Cannot find output that matches name %s'
+                                 % index)
+            return Symbol([self._entries[idx]])
+        if isinstance(index, slice):
+            return Symbol(self._entries[index])
+        return Symbol([self._entries[index]])
+
+    def __repr__(self):
+        name = self.name
+        return '<%s %s>' % (self.__class__.__name__,
+                            name if name else 'Grouped')
+
+    def __copy__(self):
+        return Symbol(list(self._entries))
+
+    def __deepcopy__(self, memo):
+        return Symbol(list(self._entries))
+
+    # -- node listings -----------------------------------------------------
+    def _nodes(self):
+        return _topo_order(self._entries)
+
+    def list_arguments(self):
+        """Names of free (non-aux) variables in topo order
+        (reference: symbol.py list_arguments)."""
+        return [n.name for n in self._nodes()
+                if n.is_variable and not n.is_aux]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._nodes() if n.is_variable and n.is_aux]
+
+    def list_outputs(self):
+        out = []
+        for (node, idx) in self._entries:
+            if node.num_outputs == 1:
+                out.append(node.name + '_output' if not node.is_variable
+                           else node.name)
+            else:
+                out.append('%s_output%d' % (node.name, idx))
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._nodes() if n.is_variable]
+
+    def get_internals(self):
+        """A grouped symbol of every internal output
+        (reference: symbol.py get_internals)."""
+        entries = []
+        for node in self._nodes():
+            for i in range(node.num_outputs):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        nodes = []
+        for (node, _) in self._entries:
+            nodes.extend(node.inputs)
+        if not nodes:
+            return None
+        return Symbol(nodes)
+
+    # -- attributes --------------------------------------------------------
+    def attr(self, key):
+        if len(self._entries) == 1:
+            node = self._entries[0][0]
+            return node._extra_attrs.get(key)
+        return None
+
+    def attr_dict(self):
+        """{node_name: {attr: val}} (used by optimizer lr_mult wiring)."""
+        ret = {}
+        for node in self._nodes():
+            if node._extra_attrs:
+                ret[node.name] = {k: str(v)
+                                  for k, v in node._extra_attrs.items()}
+        return ret
+
+    def _set_attr(self, **kwargs):
+        for (node, _) in self._entries:
+            node._extra_attrs.update(kwargs)
+
+    # -- composition helpers ----------------------------------------------
+    def _entry(self):
+        assert len(self._entries) == 1, \
+            'operation on grouped symbol requires a single output'
+        return self._entries[0]
+
+    # -- arithmetic --------------------------------------------------------
+    def _binary(self, opname, other, reflect=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reflect else (self, other)
+            return _create(opname, [a, b], {})
+        if isinstance(other, numeric_types):
+            scalar_map = {
+                'elemwise_add': '_plus_scalar',
+                'elemwise_sub': '_rminus_scalar' if reflect else '_minus_scalar',
+                'elemwise_mul': '_mul_scalar',
+                'elemwise_div': '_rdiv_scalar' if reflect else '_div_scalar',
+                'broadcast_mod': '_rmod_scalar' if reflect else '_mod_scalar',
+                'broadcast_power': '_rpower_scalar' if reflect else '_power_scalar',
+                'broadcast_equal': '_equal_scalar',
+                'broadcast_not_equal': '_not_equal_scalar',
+                'broadcast_greater': '_lesser_scalar' if reflect else '_greater_scalar',
+                'broadcast_greater_equal': '_lesser_equal_scalar' if reflect else '_greater_equal_scalar',
+                'broadcast_lesser': '_greater_scalar' if reflect else '_lesser_scalar',
+                'broadcast_lesser_equal': '_greater_equal_scalar' if reflect else '_lesser_equal_scalar',
+            }
+            return _create(scalar_map[opname], [self],
+                           {'scalar': float(other)})
+        raise TypeError('type %s not supported' % str(type(other)))
+
+    def __add__(self, o): return self._binary('elemwise_add', o)
+    def __radd__(self, o): return self._binary('elemwise_add', o)
+    def __sub__(self, o): return self._binary('elemwise_sub', o)
+    def __rsub__(self, o): return self._binary('elemwise_sub', o, True)
+    def __mul__(self, o): return self._binary('elemwise_mul', o)
+    def __rmul__(self, o): return self._binary('elemwise_mul', o)
+    def __truediv__(self, o): return self._binary('elemwise_div', o)
+    def __rtruediv__(self, o): return self._binary('elemwise_div', o, True)
+    def __mod__(self, o): return self._binary('broadcast_mod', o)
+    def __rmod__(self, o): return self._binary('broadcast_mod', o, True)
+    def __pow__(self, o): return self._binary('broadcast_power', o)
+    def __rpow__(self, o): return self._binary('broadcast_power', o, True)
+    def __eq__(self, o): return self._binary('broadcast_equal', o)
+    def __ne__(self, o): return self._binary('broadcast_not_equal', o)
+    def __gt__(self, o): return self._binary('broadcast_greater', o)
+    def __ge__(self, o): return self._binary('broadcast_greater_equal', o)
+    def __lt__(self, o): return self._binary('broadcast_lesser', o)
+    def __le__(self, o): return self._binary('broadcast_lesser', o)
+    def __neg__(self): return _create('negative', [self], {})
+    def __hash__(self): return id(self)
+
+    # -- method sugar (mirror generated NDArray methods) -------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if 'shape' in kwargs:
+            shape = kwargs.pop('shape')
+        return _create('Reshape', [self], {'shape': tuple(shape), **kwargs})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _create('transpose', [self],
+                       {'axes': axes if axes else None})
+
+    def flatten(self):
+        return _create('Flatten', [self], {})
+
+    def slice_axis(self, axis, begin, end):
+        return _create('slice_axis', [self],
+                       {'axis': axis, 'begin': begin, 'end': end})
+
+    def expand_dims(self, axis):
+        return _create('expand_dims', [self], {'axis': axis})
+
+    def squeeze(self, axis=None):
+        return _create('squeeze', [self], {'axis': axis})
+
+    def astype(self, dtype):
+        return _create('Cast', [self], {'dtype': dtype})
+
+    def sum(self, axis=None, keepdims=False):
+        return _create('sum', [self], {'axis': axis, 'keepdims': keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _create('mean', [self], {'axis': axis, 'keepdims': keepdims})
+
+    # -- shape/type inference ----------------------------------------------
+    # probe used to flow "unknown dim" (the reference's 0 convention, e.g.
+    # sym.zeros(shape=(0, H)) for RNN begin_state) through jax.eval_shape
+    _UNKNOWN_PROBE = 7919
+
+    def _var_shape_plan(self, known_shapes):
+        """Solve variable shapes: user-provided + parameter hooks + limited
+        bidirectional inference for 0-dims.
+
+        Forward-propagates output shapes with jax.eval_shape per node.
+        Unknown dims (0, the reference's convention — e.g. begin_state
+        batch) are flowed through eval_shape as a large probe prime and
+        deduced when a node also receives a fully-known same-rank peer
+        input (the nnvm bidirectional-inference analog, scoped to the
+        creation-op + elemwise patterns RNN unrolling produces).
+        The result includes 'creation_shapes': {id(node): resolved shape}
+        for creation ops, consumed by the Executor to materialize
+        zeros/ones with the deduced batch size.
+        """
+        deduced = {}   # id(creation node) -> resolved shape tuple
+        for _ in range(8):
+            result = self._plan_once(known_shapes, deduced)
+            if result is not None:
+                shapes, node_out_shapes, node_out_dtypes = result
+                node_out_shapes['creation_shapes'] = dict(deduced)
+                return shapes, node_out_shapes, node_out_dtypes
+        raise ValueError('shape inference did not converge '
+                         '(unresolvable unknown dims)')
+
+    def _plan_once(self, known_shapes, deduced):
+        """One forward pass; returns None if a new unknown dim was deduced
+        (caller restarts)."""
+        import jax
+        import jax.numpy as jnp
+        PROBE = Symbol._UNKNOWN_PROBE
+        shapes = dict(known_shapes)       # var name -> shape
+        node_out_shapes = {}              # id(node) -> [shape per output]
+        node_out_dtypes = {}
+        node_src = {}                     # id(node) -> creation _Node w/ 0s
+
+        def var_dtype(node):
+            dt = node.var_attrs.get('dtype', 'float32')
+            return dt if dt is not None else 'float32'
+
+        def canon(shape):
+            """probe multiples -> canonical 0 (unknown)."""
+            return tuple(0 if (d and d % PROBE == 0) else d for d in shape)
+
+        def probe(shape):
+            return tuple(PROBE if d == 0 else d for d in shape)
+
+        for node in self._nodes():
+            if node.is_variable:
+                shp = shapes.get(node.name, node.var_attrs.get('shape'))
+                if shp is not None and 0 not in shp:
+                    node_out_shapes[id(node)] = [tuple(shp)]
+                    node_out_dtypes[id(node)] = [var_dtype(node)]
+                    shapes[node.name] = tuple(shp)
+                else:
+                    node_out_shapes[id(node)] = [None]
+                    node_out_dtypes[id(node)] = [var_dtype(node)]
+                continue
+            # creation ops (no inputs) with a shape attr
+            if not node.inputs and 'shape' in node.attrs:
+                shp = deduced.get(id(node), tuple(node.attrs['shape']))
+                node_out_shapes[id(node)] = [tuple(shp)]
+                node_out_dtypes[id(node)] = [
+                    str(node.attrs.get('dtype') or 'float32')]
+                if 0 in shp:
+                    node_src[id(node)] = node
+                continue
+            in_shapes = [node_out_shapes.get(id(c), [None])[i]
+                         for (c, i) in node.inputs]
+            # fill parameter shapes from the data shape (hints computed
+            # from a partially-known data shape are applied only when they
+            # come out fully known — batch-0 doesn't block weight shapes)
+            if in_shapes and in_shapes[0] is not None:
+                hints = param_shapes_of(node.op.name, node.attrs,
+                                        in_shapes[0])
+                names = input_names_of(node.op)
+                if hints and names:
+                    for pos, (child, _) in enumerate(node.inputs):
+                        if pos < len(names) and child.is_variable and \
+                                node_out_shapes[id(child)][0] is None:
+                            hint = hints.get(names[pos])
+                            if hint is not None and 0 not in hint:
+                                node_out_shapes[id(child)] = [tuple(hint)]
+                                shapes[child.name] = tuple(hint)
+                in_shapes = [node_out_shapes.get(id(c), [None])[i]
+                             for (c, i) in node.inputs]
+            if any(s is None for s in in_shapes):
+                node_out_shapes[id(node)] = [None] * node.num_outputs
+                node_out_dtypes[id(node)] = ['float32'] * node.num_outputs
+                continue
+            # bidirectional step: deduce unknown dims from a known peer.
+            # Only at ops whose inputs are batch-aligned — elementwise
+            # arithmetic and the fused RNN (weights in FC/conv are NOT
+            # aligned with data and must not unify).
+            srcs = set()
+            for (c, i) in node.inputs:
+                s = node_src.get(id(c))
+                if s is not None:
+                    srcs.add(id(s))
+            unifiable = node.op.name in _UNIFY_OPS
+            if unifiable and any(0 in s for s in in_shapes):
+                known_peers = [s for s in in_shapes if 0 not in s]
+                for pos, s in enumerate(in_shapes):
+                    if 0 not in s:
+                        continue
+                    src = node_src.get(id(node.inputs[pos][0]))
+                    if src is None or id(src) in deduced:
+                        continue
+                    for peer in known_peers:
+                        if len(peer) != len(s):
+                            continue
+                        val = next((peer[d] for d in range(len(s))
+                                    if s[d] == 0), None)
+                        if val:
+                            src_shape = tuple(
+                                val if d == 0 else d
+                                for d in src.attrs['shape'])
+                            deduced[id(src)] = src_shape
+                            return None  # restart with new knowledge
+            # abstract-eval this node (unknowns flow as the probe)
+            in_avals = [jax.ShapeDtypeStruct(probe(s), jnp.dtype(d))
+                        for s, d in zip(in_shapes,
+                                        [node_out_dtypes[id(c)][i]
+                                         for (c, i) in node.inputs])]
+            fn = _node_fn(node)
+            try:
+                out = jax.eval_shape(fn, *in_avals)
+            except Exception as e:
+                raise ValueError(
+                    'shape inference failed at node %s(%s): %s' % (
+                        node.op.name, node.name, e))
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            node_out_shapes[id(node)] = [canon(tuple(o.shape))
+                                         for o in outs]
+            node_out_dtypes[id(node)] = [onp.dtype(o.dtype).name
+                                         for o in outs]
+            if any(0 in s for s in node_out_shapes[id(node)]) and \
+                    len(srcs) == 1:
+                src_id = next(iter(srcs))
+                for (c, _) in node.inputs:
+                    s = node_src.get(id(c))
+                    if s is not None and id(s) == src_id:
+                        node_src[id(node)] = s
+                        break
+        return shapes, node_out_shapes, node_out_dtypes
+
+    def infer_shape(self, *args, **kwargs):
+        """Infer shapes of arguments/outputs/aux given some input shapes
+        (reference: symbol.py:1017)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except ValueError:
+            return None, None, None
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        known = {}
+        if args:
+            for name, shape in zip(self.list_arguments(), args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items()
+                      if v is not None})
+        shapes, node_out_shapes, _ = self._var_shape_plan(known)
+        arg_shapes = [shapes.get(n) for n in self.list_arguments()]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [node_out_shapes[id(node)][i]
+                      for (node, i) in self._entries]
+        if not partial and any(s is None for s in arg_shapes + out_shapes):
+            missing = [n for n, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None]
+            raise ValueError('cannot infer shapes for arguments: %s '
+                             '(provide more input shapes)' % missing)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Simplified dtype inference: float32 unless a var declares dtype."""
+        args_ = self.list_arguments()
+        dtypes = []
+        for node in self._nodes():
+            if node.is_variable and not node.is_aux:
+                dtypes.append(onp.dtype(
+                    node.var_attrs.get('dtype') or 'float32'))
+        out_types = [onp.dtype('float32') for _ in self._entries]
+        aux_types = [onp.dtype('float32')
+                     for _ in self.list_auxiliary_states()]
+        return dtypes, out_types, aux_types
+
+    # -- evaluation / binding ----------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        """Eager-evaluate with NDArray inputs (reference: symbol.py eval)."""
+        from ..ndarray import NDArray
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req='write',
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """Bind to allocated arrays → Executor (reference: symbol.py:1639)."""
+        from ..executor import Executor
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx=None, grad_req='write', type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate all arrays from shapes and bind
+        (reference: symbol.py:1375)."""
+        from .. import ndarray as nd
+        from ..executor import Executor
+        arg_shapes, _, aux_shapes = self._infer_shape_impl(False, **kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dtype = (type_dict or {}).get(name, 'float32')
+            args[name] = nd.zeros(shape, ctx=ctx, dtype=dtype)
+        args_grad = None
+        if grad_req != 'null':
+            args_grad = {name: nd.zeros(shape, ctx=ctx)
+                         for name, shape in zip(arg_names, arg_shapes)}
+        aux_states = {name: nd.zeros(shape, ctx=ctx)
+                      for name, shape in zip(aux_names, aux_shapes)}
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        """Serialize to the reference's symbol JSON layout
+        (nodes/arg_nodes/heads; reference: c_api_symbolic.cc:455)."""
+        nodes = self._nodes()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        arg_nodes = []
+        for i, node in enumerate(nodes):
+            if node.is_variable:
+                arg_nodes.append(i)
+                jnodes.append({'op': 'null', 'name': node.name,
+                               'attrs': _json_attrs(node.var_attrs),
+                               'inputs': []})
+            else:
+                jnodes.append({
+                    'op': node.op.name, 'name': node.name,
+                    'attrs': _json_attrs(node.attrs),
+                    'inputs': [[node_ids[id(c)], idx, 0]
+                               for (c, idx) in node.inputs]})
+        heads = [[node_ids[id(n)], i, 0] for (n, i) in self._entries]
+        return json.dumps({'nodes': jnodes, 'arg_nodes': arg_nodes,
+                           'node_row_ptr': list(range(len(nodes) + 1)),
+                           'heads': heads,
+                           'attrs': {'mxnet_version': ['int', 10500]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, 'w') as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for node in self._nodes():
+            if node.is_variable:
+                lines.append('Variable:%s' % node.name)
+            else:
+                ins = ', '.join('%s[%d]' % (c.name, i)
+                                for (c, i) in node.inputs)
+                lines.append('%s(%s) -> %s' % (node.op.name, ins, node.name))
+        return '\n'.join(lines)
+
+
+# ops where input shapes are batch-aligned (unknown-dim unification sites)
+_UNIFY_OPS = frozenset([
+    'elemwise_add', 'elemwise_sub', 'elemwise_mul', 'elemwise_div',
+    'broadcast_add', 'broadcast_sub', 'broadcast_mul', 'broadcast_div',
+    'broadcast_maximum', 'broadcast_minimum', 'broadcast_power',
+    '_grad_add', 'add_n', 'where', 'Concat', 'concat', 'RNN',
+    'SequenceMask', 'SequenceLast', 'SequenceReverse'])
+
+
+def _json_attrs(attrs):
+    return {k: str(v) for k, v in attrs.items() if v is not None}
+
+
+def _node_fn(node):
+    """Pure jax function for one node (static attrs bound)."""
+    op = node.op
+    attrs = {k: v for k, v in node.attrs.items() if v is not None}
+    if 'training' in op.attr_names and 'training' not in attrs:
+        attrs = dict(attrs)
+        attrs['training'] = False
+    base = op.bind_attrs(**attrs)
+    if op.needs_rng:
+        import jax
+        key = jax.random.PRNGKey(0)
+        if op.num_inputs == -1:
+            return lambda *arrs: base(key, list(arrs))
+        return lambda *arrs: base(key, *arrs)
+    if op.num_inputs == -1:
+        return lambda *arrs: base(list(arrs))
+    return base
+
+
+def _create(opname, sym_inputs, attrs, name=None):
+    """Create an op node symbol (the compose step of generated wrappers)."""
+    op = _registry.get(opname) if isinstance(opname, string_types) else opname
+    hint = op.name.lower().lstrip('_')
+    name = NameManager.current.get(name, hint)
+    entries = []
+    for s in sym_inputs:
+        entries.append(s._entry())
+    node = _Node(op, name, attrs=attrs, inputs=entries,
+                 num_outputs=num_outputs_of(op, attrs))
+    # mark aux variables
+    for pos in aux_indices_of(op):
+        if pos < len(entries) and entries[pos][0].is_variable:
+            entries[pos][0].is_aux = True
+    # a multi-output op's symbol exposes its visible outputs (MXNet
+    # semantics: sym[i] / tuple-unpack select one)
+    return Symbol([(node, i)
+                   for i in range(num_visible_outputs_of(op, attrs))])
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable (reference: symbol.py var)."""
+    if not isinstance(name, string_types):
+        raise TypeError('Expect a string for variable `name`')
+    var_attrs = {'shape': tuple(shape) if shape else None, 'dtype': dtype,
+                 'init': init}
+    node = _Node(None, name, var_attrs=var_attrs)
+    extra = dict(attr or {})
+    if lr_mult is not None:
+        extra['__lr_mult__'] = lr_mult
+    if wd_mult is not None:
+        extra['__wd_mult__'] = wd_mult
+    extra.update({k: v for k, v in kwargs.items()})
+    node._extra_attrs = extra
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol
+    (reference: symbol.py Group)."""
+    entries = []
+    for s in symbols:
+        entries.extend(s._entries)
+    return Symbol(entries)
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from the JSON layout written by tojson."""
+    data = json.loads(json_str)
+    nodes = []
+    for jn in data['nodes']:
+        if jn['op'] == 'null':
+            attrs = jn.get('attrs', {})
+            shape = attrs.get('shape')
+            if isinstance(shape, str) and shape not in ('None', ''):
+                shape = tuple(int(x) for x in
+                              shape.strip('()[] ').split(',') if x.strip())
+            else:
+                shape = None
+            node = _Node(None, jn['name'],
+                         var_attrs={'shape': shape,
+                                    'dtype': attrs.get('dtype'),
+                                    'init': None})
+        else:
+            op = _registry.get(jn['op'])
+            attrs = {k: _parse_attr(v) for k, v in
+                     jn.get('attrs', {}).items()}
+            inputs = [(nodes[i], idx) for (i, idx, _) in jn['inputs']]
+            node = _Node(op, jn['name'], attrs=attrs, inputs=inputs,
+                         num_outputs=num_outputs_of(op, attrs))
+            for pos in aux_indices_of(op):
+                if pos < len(inputs) and inputs[pos][0].is_variable:
+                    inputs[pos][0].is_aux = True
+        nodes.append(node)
+    heads = [(nodes[i], idx) for (i, idx, _) in data['heads']]
+    return Symbol(heads)
+
+
+def _parse_attr(v):
+    """Parse a stringified attr back to a Python value."""
+    if not isinstance(v, str):
+        return v
+    try:
+        import ast
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# creation helpers mirroring nd namespace
+def zeros(shape, dtype='float32', **kwargs):
+    return _create('_zeros', [], {'shape': shape, 'dtype': dtype})
+
+
+def ones(shape, dtype='float32', **kwargs):
+    return _create('_ones', [], {'shape': shape, 'dtype': dtype})
+
+
+def full(shape, val, dtype='float32', **kwargs):
+    return _create('_full', [], {'shape': shape, 'value': val,
+                                 'dtype': dtype})
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype='float32', **kwargs):
+    return _create('_arange', [], {'start': start, 'stop': stop,
+                                   'step': step, 'repeat': repeat,
+                                   'dtype': dtype})
+
+
+def pow(base, exp):
+    if isinstance(base, Symbol):
+        return base.__pow__(exp)
+    raise TypeError('pow expects Symbol base')
+
+
+def maximum(lhs, rhs):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _create('broadcast_maximum', [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return _create('_maximum_scalar', [lhs], {'scalar': float(rhs)})
+    return _create('_maximum_scalar', [rhs], {'scalar': float(lhs)})
+
+
+def minimum(lhs, rhs):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _create('broadcast_minimum', [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return _create('_minimum_scalar', [lhs], {'scalar': float(rhs)})
+    return _create('_minimum_scalar', [rhs], {'scalar': float(lhs)})
+
+
+def hypot(lhs, rhs):
+    return _create('broadcast_hypot', [lhs, rhs], {})

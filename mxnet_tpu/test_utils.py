@@ -1,0 +1,300 @@
+"""Testing utilities.
+
+Reference parity: python/mxnet/test_utils.py — assert_almost_equal, same,
+rand_ndarray, default_context, check_numeric_gradient (finite differences),
+check_symbolic_forward/backward, check_consistency :1224 (cross-context),
+rand_shape helpers. This is the engine that validates the op library
+(SURVEY.md §4: "numeric-gradient checker ... de-facto testing framework").
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from .context import Context, current_context, cpu
+
+__all__ = ['default_context', 'set_default_context', 'same', 'almost_equal',
+           'assert_almost_equal', 'rand_ndarray', 'rand_shape_2d',
+           'rand_shape_3d', 'rand_shape_nd', 'check_numeric_gradient',
+           'check_symbolic_forward', 'check_symbolic_backward',
+           'check_consistency', 'numeric_grad', 'list_gpus', 'simple_forward']
+
+_default_ctx = None
+
+
+def default_context():
+    """Current default context for tests (reference: test_utils.py)."""
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def list_gpus():
+    """Indices of accelerator devices (reference: test_utils.py list_gpus)."""
+    import jax
+    try:
+        return list(range(len([d for d in jax.devices()
+                               if d.platform != 'cpu'])))
+    except RuntimeError:
+        return []
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    rtol = rtol if rtol is not None else 1e-5
+    atol = atol if atol is not None else 1e-20
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=('a', 'b'),
+                        equal_nan=False):
+    """Assert arrays nearly equal with useful diagnostics
+    (reference: test_utils.py assert_almost_equal)."""
+    if isinstance(a, NDArray):
+        a = a.asnumpy()
+    if isinstance(b, NDArray):
+        b = b.asnumpy()
+    rtol = rtol if rtol is not None else 1e-5
+    atol = atol if atol is not None else 1e-20
+    if almost_equal(a, b, rtol, atol, equal_nan=equal_nan):
+        return
+    a = np.asarray(a)
+    b = np.asarray(b)
+    index, rel = _find_max_violation(a, b, rtol, atol)
+    raise AssertionError(
+        'Error %f exceeds tolerance rtol=%f, atol=%f. Location of maximum '
+        'error:%s, a=%f, b=%f\n%s=%s\n%s=%s' % (
+            rel, rtol, atol, str(index), a[index], b[index],
+            names[0], str(a), names[1], str(b)))
+
+
+def _find_max_violation(a, b, rtol, atol):
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = np.unravel_index(np.argmax(violation), violation.shape)
+    return loc, violation[loc]
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype='default', density=None, dtype=None,
+                 modifier_func=None, shuffle_csr_indices=False,
+                 distribution=None, ctx=None):
+    """Random NDArray (dense; sparse stypes are emulated densely —
+    SURVEY §7 hard part 3)."""
+    arr = np.random.uniform(-1, 1, size=shape)
+    if modifier_func is not None:
+        arr = np.vectorize(modifier_func)(arr)
+    if density is not None:
+        mask = np.random.rand(*shape) < density
+        arr = arr * mask
+    return nd.array(arr.astype(dtype or np.float32), ctx=ctx)
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Run a symbol forward with inputs given as numpy arrays."""
+    shapes = {k: v.shape for k, v in inputs.items()}
+    ex = sym.simple_bind(ctx=ctx or default_context(), **shapes)
+    for k, v in inputs.items():
+        ex.arg_dict[k][:] = v
+    out = ex.forward(is_train=is_train)
+    outputs = [o.asnumpy() for o in out]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True, dtype=np.float32):
+    """Finite-difference gradients of executor's scalar-summed output
+    w.r.t. location (reference: test_utils.py numeric_grad)."""
+    approx_grads = {k: np.zeros(v.shape, dtype=dtype)
+                    for k, v in location.items()}
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k in location:
+        old_value = location[k].copy()
+        flat = old_value.ravel()
+        grad_flat = approx_grads[k].ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps / 2
+            executor.arg_dict[k][:] = old_value.reshape(location[k].shape)
+            out_p = sum(np.sum(o.asnumpy())
+                        for o in executor.forward(is_train=use_forward_train))
+            flat[i] = orig - eps / 2
+            executor.arg_dict[k][:] = old_value.reshape(location[k].shape)
+            out_n = sum(np.sum(o.asnumpy())
+                        for o in executor.forward(is_train=use_forward_train))
+            flat[i] = orig
+            grad_flat[i] = (out_p - out_n) / eps
+        executor.arg_dict[k][:] = old_value.reshape(location[k].shape)
+    return approx_grads
+
+
+def _parse_location(sym, location, ctx, dtype=np.float32):
+    if isinstance(location, dict):
+        return {k: np.asarray(v.asnumpy() if isinstance(v, NDArray) else v,
+                              dtype=dtype)
+                for k, v in location.items()}
+    return {k: np.asarray(v.asnumpy() if isinstance(v, NDArray) else v,
+                          dtype=dtype)
+            for k, v in zip(sym.list_arguments(), location)}
+
+
+def check_numeric_gradient(sym, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, use_forward_train=True, ctx=None,
+                           grad_stype_dict=None, dtype=np.float32):
+    """Verify symbolic gradients against finite differences
+    (reference: test_utils.py check_numeric_gradient)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype)
+    if grad_nodes is None:
+        grad_nodes = [k for k in location]
+    # append a random-projection head so the output is scalar-comparable
+    out = sym_sum_square_proxy(sym)
+    args = {k: nd.array(v) for k, v in location.items()}
+    grads = {k: nd.zeros(v.shape, dtype='float32')
+             for k, v in location.items()}
+    aux = {k: nd.array(np.asarray(v.asnumpy() if isinstance(v, NDArray)
+                                  else v)) for k, v in
+           (aux_states or {}).items()}
+    ex = out.bind(ctx, args=args, args_grad=grads, aux_states=aux)
+    ex.forward(is_train=True)
+    ex.backward()
+    symbolic_grads = {k: ex.grad_dict[k].asnumpy() for k in grad_nodes}
+    num_ex = out.bind(ctx, args={k: nd.array(v)
+                                 for k, v in location.items()},
+                      aux_states={k: nd.array(np.asarray(
+                          v.asnumpy() if isinstance(v, NDArray) else v))
+                          for k, v in (aux_states or {}).items()})
+    numeric_gradients = numeric_grad(num_ex, location,
+                                     eps=numeric_eps,
+                                     use_forward_train=use_forward_train,
+                                     dtype=dtype)
+    for name in grad_nodes:
+        assert_almost_equal(numeric_gradients[name], symbolic_grads[name],
+                            rtol=rtol, atol=atol if atol is not None
+                            else 1e-3,
+                            names=('NUMERICAL_%s' % name,
+                                   'BACKWARD_%s' % name))
+
+
+def sym_sum_square_proxy(sym):
+    """sum(x*x/2) head — smooth scalar objective for gradient checks."""
+    from . import symbol as S
+    outs = [S.op.sum(S.op.square(o) * 0.5) for o in sym]
+    total = outs[0]
+    for o in outs[1:]:
+        total = total + o
+    return total
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None, dtype=np.float32,
+                           equal_nan=False):
+    """Compare forward outputs with expected numpy arrays
+    (reference: test_utils.py check_symbolic_forward)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype)
+    args = {k: nd.array(v) for k, v in location.items()}
+    aux = {k: nd.array(np.asarray(v.asnumpy() if isinstance(v, NDArray)
+                                  else v))
+           for k, v in (aux_states or {}).items()}
+    ex = sym.bind(ctx, args=args, aux_states=aux)
+    outputs = [o.asnumpy() for o in ex.forward()]
+    for output, expect in zip(outputs, expected):
+        assert_almost_equal(output, expect, rtol, atol,
+                            ('EXPECTED', 'FORWARD'), equal_nan=equal_nan)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req='write',
+                            ctx=None, grad_stypes=None, equal_nan=False,
+                            dtype=np.float32):
+    """Compare backward gradients with expected numpy arrays
+    (reference: test_utils.py check_symbolic_backward)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx, dtype)
+    args = {k: nd.array(v) for k, v in location.items()}
+    grads = {k: nd.zeros(v.shape, dtype='float32')
+             for k, v in location.items()}
+    aux = {k: nd.array(np.asarray(v.asnumpy() if isinstance(v, NDArray)
+                                  else v))
+           for k, v in (aux_states or {}).items()}
+    ex = sym.bind(ctx, args=args, args_grad=grads, grad_req=grad_req,
+                  aux_states=aux)
+    ex.forward(is_train=True)
+    ex.backward([nd.array(np.asarray(g)) for g in out_grads]
+                if isinstance(out_grads, (list, tuple)) else out_grads)
+    if isinstance(expected, dict):
+        for name, expect in expected.items():
+            assert_almost_equal(expect, ex.grad_dict[name].asnumpy(), rtol,
+                                atol, ('EXPECTED_%s' % name,
+                                       'BACKWARD_%s' % name),
+                                equal_nan=equal_nan)
+    return {k: v.asnumpy() if v is not None else None
+            for k, v in ex.grad_dict.items()}
+
+
+def check_consistency(sym, ctx_list, scale=1.0, dtype=None,
+                      grad_req='write', arg_params=None, aux_params=None,
+                      rtol=None, atol=None, raise_on_err=True,
+                      ground_truth=None, equal_nan=False):
+    """Run the same symbol on multiple contexts/dtypes and compare
+    (reference: test_utils.py:1224 — the GPU-suite reuse trick; on TPU the
+    contexts are cpu vs tpu)."""
+    results = []
+    for spec in ctx_list:
+        ctx = spec.get('ctx', default_context())
+        type_dict = spec.get('type_dict', {})
+        shapes = {k: v for k, v in spec.items()
+                  if isinstance(v, (tuple, list))}
+        ex = sym.simple_bind(ctx=ctx, grad_req=grad_req,
+                             type_dict=type_dict, **shapes)
+        if arg_params:
+            for k, v in arg_params.items():
+                if k in ex.arg_dict:
+                    ex.arg_dict[k][:] = v
+        else:
+            np.random.seed(0)
+            for k, v in sorted(ex.arg_dict.items()):
+                v[:] = np.random.normal(0, scale, size=v.shape)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in ex.aux_dict:
+                    ex.aux_dict[k][:] = v
+        outs = [o.asnumpy() for o in ex.forward(is_train=True)]
+        results.append(outs)
+    base = ground_truth if ground_truth is not None else results[0]
+    for res in results[1:]:
+        for a, b in zip(base, res):
+            assert_almost_equal(a, b, rtol if rtol is not None else 1e-3,
+                                atol if atol is not None else 1e-3,
+                                equal_nan=equal_nan)
+    return results

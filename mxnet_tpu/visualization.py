@@ -1,0 +1,120 @@
+"""Network visualization (reference: python/mxnet/visualization.py —
+print_summary + graphviz plot_network)."""
+from __future__ import annotations
+
+import json
+
+__all__ = ['print_summary', 'plot_network']
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a per-layer summary table with shapes and param counts
+    (reference: visualization.py print_summary)."""
+    if positions is None:
+        positions = [.44, .64, .74, 1.]
+    show_shape = shape is not None
+    node_out_shapes = {}
+    if show_shape:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError('Input shape is incomplete')
+        node_out_shapes = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf['nodes']
+    heads = set(h[0] for h in conf['heads'])
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(fields, positions):
+        line = ''
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += ' ' * (positions[i] - len(line))
+        print(line)
+    print('_' * line_length)
+    print_row(['Layer (type)', 'Output Shape', 'Param #',
+               'Previous Layer'], positions)
+    print('=' * line_length)
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node['op']
+        pre_node = []
+        pre_filter = 0
+        if op != 'null':
+            inputs = node['inputs']
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node['name']
+                if input_node['op'] != 'null' or item[0] in heads:
+                    pre_node.append(input_name)
+        cur_param = 0
+        attrs = node.get('attrs', {})
+        if op == 'null' and not node['name'].endswith(('data', 'label')):
+            # parameter node: count from inferred shape
+            shp = node_out_shapes.get(node['name'])
+            if shp:
+                p = 1
+                for s in shp:
+                    p *= s
+                cur_param = p
+        first_connection = pre_node[0] if pre_node else ''
+        fields = ['%s(%s)' % (node['name'], op),
+                  str(out_shape) if out_shape else '',
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            print_row(['', '', '', pre_node[i]], positions)
+        total_params[0] += cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = None
+        if show_shape:
+            key = node['name'] + '_output' if node['op'] != 'null' \
+                else node['name']
+            out_shape = node_out_shapes.get(key)
+        print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print('=' * line_length)
+        else:
+            print('_' * line_length)
+    print('Total params: %d' % total_params[0])
+    print('_' * line_length)
+
+
+def plot_network(symbol, title='plot', save_format='pdf', shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the network
+    (reference: visualization.py plot_network). Requires graphviz."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError('Draw network requires graphviz library')
+    conf = json.loads(symbol.tojson())
+    nodes = conf['nodes']
+    dot = Digraph(name=title, format=save_format)
+    node_attr = {'shape': 'box', 'fixedsize': 'true', 'width': '1.3',
+                 'height': '0.8034', 'style': 'filled'}
+    node_attr.update(node_attrs or {})
+    hidden = set()
+    for i, node in enumerate(nodes):
+        name = node['name']
+        if node['op'] == 'null':
+            if hide_weights and not name.endswith(('data', 'label')):
+                hidden.add(i)
+                continue
+            dot.node(name=name, label=name,
+                     **dict(node_attr, fillcolor='#8dd3c7'))
+        else:
+            dot.node(name=name, label='%s\n%s' % (node['op'], name),
+                     **dict(node_attr, fillcolor='#fb8072'))
+    for i, node in enumerate(nodes):
+        if node['op'] == 'null':
+            continue
+        for item in node['inputs']:
+            if item[0] in hidden:
+                continue
+            dot.edge(tail_name=nodes[item[0]]['name'],
+                     head_name=node['name'])
+    return dot

@@ -22,8 +22,11 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _seed_rngs():
-    """with_seed() parity (reference: tests/python/unittest/common.py:117)."""
+    """with_seed() parity (reference: tests/python/unittest/common.py:117).
+    Also resets the auto-naming counters so symbol names (convolution0_...)
+    are deterministic per test."""
     np.random.seed(0)
     import mxnet_tpu as mx
     mx.random.seed(0)
+    mx.name.NameManager._current.value = mx.name.NameManager()
     yield

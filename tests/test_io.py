@@ -1,0 +1,190 @@
+"""Data IO tests (reference analog: tests/python/unittest/test_io.py +
+test_recordio.py + gluon data tests in test_gluon_data.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, nd, recordio
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_ndarrayiter():
+    data = np.ones([1000, 2, 2])
+    label = np.ones([1000, 1])
+    data_iter = io.NDArrayIter(data, label, 128, True,
+                               last_batch_handle='pad')
+    batch_count = 0
+    labels = []
+    for batch in data_iter:
+        batch_count += 1
+        labels.append(batch.label[0])
+    assert batch_count == 8
+    data_iter.reset()
+    assert next(data_iter).data[0].shape == (128, 2, 2)
+
+
+def test_ndarrayiter_discard():
+    data = np.arange(100).reshape(100, 1)
+    it = io.NDArrayIter(data, np.arange(100), 32,
+                        last_batch_handle='discard')
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.data[0].shape[0] == 32
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    data = np.arange(60).reshape(60, 1)
+    it = io.NDArrayIter(data, np.arange(60), 10, shuffle=True,
+                        last_batch_handle='discard')
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(60))
+
+
+def test_ndarrayiter_provide():
+    it = io.NDArrayIter({'x': np.zeros((10, 4))}, {'y': np.zeros(10)}, 5)
+    assert it.provide_data[0].name == 'x'
+    assert it.provide_data[0].shape == (5, 4)
+    assert it.provide_label[0].name == 'y'
+
+
+def test_recordio_roundtrip():
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, 'test.rec')
+    w = recordio.MXRecordIO(path, 'w')
+    payloads = [b'x' * n for n in (1, 5, 100, 1000)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, 'r')
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.reset()
+    assert r.read() == payloads[0]
+
+
+def test_indexed_recordio():
+    d = tempfile.mkdtemp()
+    path, idx = os.path.join(d, 't.rec'), os.path.join(d, 't.idx')
+    w = recordio.MXIndexedRecordIO(idx, path, 'w')
+    for i in range(10):
+        w.write_idx(i, b'record_%d' % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, 'r')
+    assert r.read_idx(7) == b'record_7'
+    assert r.read_idx(0) == b'record_0'
+    assert r.keys == list(range(10))
+
+
+def test_recordio_pack_unpack():
+    s = recordio.pack(recordio.IRHeader(0, 2.5, 7, 0), b'payload')
+    header, payload = recordio.unpack(s)
+    assert header.label == 2.5 and header.id == 7
+    assert payload == b'payload'
+    # multi-label
+    s = recordio.pack(recordio.IRHeader(0, np.array([1., 2., 3.]), 1, 0),
+                      b'img')
+    header, payload = recordio.unpack(s)
+    np.testing.assert_allclose(header.label, [1., 2., 3.])
+    assert payload == b'img'
+
+
+def test_image_record_iter():
+    d = tempfile.mkdtemp()
+    path, idxp = os.path.join(d, 'img.rec'), os.path.join(d, 'img.idx')
+    w = recordio.MXIndexedRecordIO(idxp, path, 'w')
+    for i in range(10):
+        img = (np.random.rand(30, 30, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, img_fmt='.png'))
+    w.close()
+    it = io.ImageRecordIter(path_imgrec=path, data_shape=(3, 24, 24),
+                            batch_size=4, shuffle=True, rand_crop=True,
+                            rand_mirror=True)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 24, 24)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert next(it).data[0].shape == (4, 3, 24, 24)
+
+
+def test_dataset_transform_dataloader():
+    X = np.random.rand(24, 8, 8, 1).astype('float32')
+    Y = (np.arange(24) % 3).astype('int32')
+    ds = gdata.ArrayDataset(X, Y)
+    assert len(ds) == 24
+    x0, y0 = ds[0]
+    assert x0.shape == (8, 8, 1)
+    tds = ds.transform_first(transforms.ToTensor())
+    x0t, _ = tds[0]
+    assert x0t.shape == (1, 8, 8)
+    loader = gdata.DataLoader(tds, batch_size=6, shuffle=True)
+    n = 0
+    for x, y in loader:
+        n += 1
+        assert x.shape == (6, 1, 8, 8)
+    assert n == 4
+
+
+def test_dataloader_workers_match_serial():
+    X = np.arange(40, dtype='float32').reshape(40, 1)
+    ds = gdata.ArrayDataset(X, np.arange(40))
+    serial = [x.asnumpy() for x, _ in
+              gdata.DataLoader(ds, batch_size=8)]
+    threaded = [x.asnumpy() for x, _ in
+                gdata.DataLoader(ds, batch_size=8, num_workers=3)]
+    for a, b in zip(serial, threaded):
+        np.testing.assert_allclose(a, b)
+
+
+def test_batch_sampler_modes():
+    from mxnet_tpu.gluon.data import BatchSampler, SequentialSampler
+    s = SequentialSampler(10)
+    assert len(list(BatchSampler(s, 3, 'keep'))) == 4
+    assert len(list(BatchSampler(s, 3, 'discard'))) == 3
+    bs = BatchSampler(s, 3, 'rollover')
+    assert len(list(bs)) == 3  # 1 rolled over
+    assert len(list(bs)) == 3  # 10+1=11 -> 3 batches, 2 roll
+
+
+def test_dataset_shard_take_filter():
+    ds = gdata.ArrayDataset(np.arange(10), np.arange(10))
+    sh = ds.shard(3, 0)
+    assert len(sh) == 4  # 10 = 4+3+3
+    assert len(ds.shard(3, 2)) == 3
+    assert len(ds.take(5)) == 5
+    flt = ds.filter(lambda x, y: x % 2 == 0)
+    assert len(flt) == 5
+
+
+def test_transforms_values():
+    img = nd.array((np.random.rand(10, 12, 3) * 255).astype('uint8'),
+                   dtype='uint8')
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 10, 12)
+    assert float(t.max().asscalar()) <= 1.0
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.1, 0.2, 0.5))(t)
+    expect = (t.asnumpy() - np.array([0.5, 0.5, 0.5]).reshape(3, 1, 1)) / \
+        np.array([0.1, 0.2, 0.5]).reshape(3, 1, 1)
+    np.testing.assert_allclose(norm.asnumpy(), expect, rtol=1e-5, atol=1e-5)
+    r = transforms.Resize((6, 5))(img)
+    assert r.shape == (5, 6, 3)
+    cc = transforms.CenterCrop(4)(img)
+    assert cc.shape == (4, 4, 3)
+    rrc = transforms.RandomResizedCrop(8)(img)
+    assert rrc.shape == (8, 8, 3)
+
+
+def test_csv_iter():
+    d = tempfile.mkdtemp()
+    data_path = os.path.join(d, 'data.csv')
+    np.savetxt(data_path, np.arange(20).reshape(10, 2), delimiter=',')
+    it = io.CSVIter(data_csv=data_path, data_shape=(2,), batch_size=4)
+    b = next(it)
+    assert b.data[0].shape == (4, 2)

@@ -1,0 +1,290 @@
+"""Symbol / Executor / Module tests (reference analogs:
+tests/python/unittest/test_symbol.py, test_executor.py, test_module.py,
+tests/python/train/test_mlp.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _lenet_ish(num_classes=10):
+    data = mx.sym.Variable('data')
+    c1 = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8)
+    a1 = mx.sym.Activation(data=c1, act_type='relu')
+    p1 = mx.sym.Pooling(data=a1, pool_type='max', kernel=(2, 2),
+                        stride=(2, 2))
+    f = mx.sym.Flatten(data=p1)
+    fc1 = mx.sym.FullyConnected(data=f, num_hidden=32)
+    a2 = mx.sym.Activation(data=fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(data=a2, num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(data=fc2, name='softmax')
+
+
+def test_symbol_compose_and_listings():
+    net = _lenet_ish()
+    args = net.list_arguments()
+    assert args[0] == 'data'
+    assert 'convolution0_weight' in args
+    assert 'softmax_label' in args
+    assert net.list_outputs() == ['softmax_output']
+    internals = net.get_internals()
+    assert len(internals.list_outputs()) > 8
+
+
+def test_symbol_infer_shape():
+    net = _lenet_ish()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=(4, 1, 12, 12))
+    shapes = dict(zip(net.list_arguments(), arg_shapes))
+    assert shapes['convolution0_weight'] == (8, 1, 3, 3)
+    assert shapes['fullyconnected0_weight'] == (32, 200)
+    assert shapes['softmax_label'] == (4,)
+    assert out_shapes == [(4, 10)]
+
+
+def test_symbol_infer_shape_batchnorm_aux():
+    data = mx.sym.Variable('data')
+    bn = mx.sym.BatchNorm(data=data, name='bn')
+    assert bn.list_auxiliary_states() == ['bn_moving_mean', 'bn_moving_var']
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 4, 4))
+    assert aux_shapes == [(3,), (3,)]
+    assert dict(zip(bn.list_arguments(), arg_shapes))['bn_gamma'] == (3,)
+
+
+def test_symbol_arithmetic_and_eval():
+    a = mx.sym.Variable('a')
+    b = mx.sym.Variable('b')
+    c = 2.0 * a + b ** 2
+    ex = c.bind(mx.cpu(), {'a': nd.array([1., 2.]), 'b': nd.array([3., 4.])})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [11., 20.])
+
+
+def test_symbol_json_roundtrip():
+    net = _lenet_ish()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    d = tempfile.mkdtemp()
+    fname = os.path.join(d, 'sym.json')
+    net.save(fname)
+    net3 = mx.sym.load(fname)
+    assert net3.list_arguments() == net.list_arguments()
+
+
+def test_executor_forward_backward_matches_autograd():
+    """Symbolic grads == imperative autograd grads for the same graph."""
+    x_val = np.random.randn(3, 5).astype('float32')
+    w_val = np.random.randn(4, 5).astype('float32')
+    data = mx.sym.Variable('data')
+    w = mx.sym.Variable('w')
+    out = mx.sym.FullyConnected(data=data, weight=w, num_hidden=4,
+                                no_bias=True)
+    loss = mx.sym.sum(mx.sym.square(out))
+    ex = loss.bind(mx.cpu(), {'data': nd.array(x_val), 'w': nd.array(w_val)},
+                   args_grad={'data': nd.zeros((3, 5)),
+                              'w': nd.zeros((4, 5))})
+    ex.forward(is_train=True)
+    ex.backward()
+    # imperative twin
+    from mxnet_tpu import autograd
+    xi = nd.array(x_val)
+    wi = nd.array(w_val)
+    wi.attach_grad()
+    xi.attach_grad()
+    with autograd.record():
+        l = nd.sum(nd.square(nd.FullyConnected(xi, wi, num_hidden=4,
+                                               no_bias=True)))
+    l.backward()
+    np.testing.assert_allclose(ex.grad_dict['w'].asnumpy(),
+                               wi.grad.asnumpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(ex.grad_dict['data'].asnumpy(),
+                               xi.grad.asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_executor_grad_req_add():
+    x = mx.sym.Variable('x')
+    y = mx.sym.sum(x * 2.0)
+    ex = y.bind(mx.cpu(), {'x': nd.ones((3,))},
+                args_grad={'x': nd.zeros((3,))}, grad_req='add')
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict['x'].asnumpy(), [4., 4., 4.])
+
+
+def test_module_fit_and_score():
+    np.random.seed(7)
+    N, D, C = 256, 16, 4
+    X = np.random.randn(N, D).astype('float32')
+    W = np.random.randn(D, C).astype('float32')
+    Y = (X @ W).argmax(1).astype('float32')
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=32)
+    act = mx.sym.Activation(data=fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=C)
+    net = mx.sym.SoftmaxOutput(data=fc2, name='softmax')
+    train_iter = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True)
+    val_iter = mx.io.NDArrayIter(X, Y, batch_size=32)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train_iter, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.3, 'momentum': 0.9,
+                              'rescale_grad': 1.0 / 32},
+            initializer=mx.init.Xavier(), eval_metric='acc', num_epoch=10)
+    score = mod.score(val_iter, 'acc')
+    assert score[0][1] > 0.9, score
+
+    # checkpoint roundtrip
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, 'mlp')
+    mod.save_checkpoint(prefix, 10)
+    mod2 = mx.mod.Module.load(prefix, 10)
+    mod2.bind(data_shapes=val_iter.provide_data,
+              label_shapes=val_iter.provide_label, for_training=False)
+    score2 = mod2.score(val_iter, 'acc')
+    assert abs(score2[0][1] - score[0][1]) < 0.02
+    pred = mod2.predict(val_iter)
+    assert pred.shape == (N, C)
+
+
+def test_module_get_set_params():
+    net = _lenet_ish(num_classes=3)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (2, 1, 12, 12))],
+             label_shapes=[('softmax_label', (2,))])
+    mod.init_params(mx.init.Xavier())
+    arg_params, aux_params = mod.get_params()
+    assert 'convolution0_weight' in arg_params
+    arg_params['convolution0_weight'][:] = 0.5
+    mod.set_params(arg_params, aux_params)
+    a2, _ = mod.get_params()
+    np.testing.assert_allclose(a2['convolution0_weight'].asnumpy(), 0.5)
+
+
+def test_bucketing_module():
+    """Per-bucket executors sharing params (reference:
+    tests/python/train/test_bucketing.py shape)."""
+    def sym_gen(seq_len):
+        # weight shapes must be bucket-independent (real bucketing
+        # invariant): embed tokens then mean over the time axis
+        data = mx.sym.Variable('data')
+        label = mx.sym.Variable('softmax_label')
+        emb = mx.sym.Embedding(data=data, input_dim=20, output_dim=8,
+                               name='embed')
+        pooled = mx.sym.mean(emb, axis=1)
+        fc = mx.sym.FullyConnected(data=pooled, num_hidden=8, name='fc')
+        out = mx.sym.SoftmaxOutput(data=fc, label=label, name='softmax')
+        return out, ('data',), ('softmax_label',)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataBatch, DataDesc
+    mod.bind(data_shapes=[DataDesc('data', (4, 10))],
+             label_shapes=[DataDesc('softmax_label', (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.1),))
+    for key in [10, 5, 10, 7]:
+        batch = DataBatch(data=[nd.ones((4, key))],
+                          label=[nd.array([0, 1, 2, 3])],
+                          bucket_key=key,
+                          provide_data=[DataDesc('data', (4, key))],
+                          provide_label=[DataDesc('softmax_label', (4,))])
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets.keys()) == {10, 5, 7}
+    # buckets share the fc weight values
+    p10, _ = mod._buckets[10].get_params()
+    assert 'fc_weight' in p10 and 'embed_weight' in p10
+
+
+def test_lstm_bucketing_fit():
+    """LSTM-PTB config shape (reference:
+    example/rnn/bucketing/lstm_bucketing.py + tests/python/train/
+    test_bucketing.py): BucketSentenceIter + symbolic LSTMCell unroll +
+    BucketingModule + Perplexity."""
+    np.random.seed(0)
+    vocab = 30
+    sentences = [list(np.random.randint(1, vocab,
+                                        size=np.random.choice([4, 6])))
+                 for _ in range(120)]
+    train_iter = mx.rnn.BucketSentenceIter(sentences, batch_size=16,
+                                           buckets=[4, 6], invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable('data')
+        label = mx.sym.Variable('softmax_label')
+        embed = mx.sym.Embedding(data=data, input_dim=vocab,
+                                 output_dim=8, name='embed')
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(num_hidden=16, prefix='lstm_l0_'))
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 16))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab,
+                                     name='pred')
+        pred = mx.sym.SoftmaxOutput(data=pred,
+                                    label=mx.sym.Reshape(label, shape=(-1,)),
+                                    name='softmax')
+        return pred, ('data',), ('softmax_label',)
+
+    mod = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=train_iter.default_bucket_key,
+        context=mx.cpu())
+    metric = mx.metric.Perplexity(0)
+    mod.fit(train_iter, eval_metric=metric, num_epoch=1, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.05, 'momentum': 0.9,
+                              'rescale_grad': 1.0 / 16})
+    assert set(mod._buckets.keys()) <= {4, 6}
+    name, ppl = metric.get()
+    assert np.isfinite(ppl) and ppl < vocab * 3
+
+
+def test_fused_rnn_cell_symbolic():
+    data = mx.sym.Variable('data')
+    cell = mx.rnn.FusedRNNCell(12, num_layers=2, mode='lstm',
+                               prefix='lstm_')
+    outputs, _ = cell.unroll(5, inputs=data, layout='NTC',
+                             merge_outputs=True)
+    arg_shapes, out_shapes, _ = outputs.infer_shape(data=(3, 5, 7))
+    assert out_shapes == [(3, 5, 12)]
+    shapes = dict(zip(outputs.list_arguments(), arg_shapes))
+    from mxnet_tpu.ops.nn import rnn_param_size
+    assert shapes['lstm_parameters'] == \
+        (rnn_param_size('lstm', 2, 7, 12, False),)
+
+
+def test_module_monitor_installs():
+    net = _lenet_ish(3)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (2, 1, 12, 12))],
+             label_shapes=[('softmax_label', (2,))])
+    mod.init_params()
+    mon = mx.Monitor(1)
+    mod.install_monitor(mon)
+    mon.tic()
+    from mxnet_tpu.io import DataBatch
+    mod.forward(DataBatch(data=[nd.ones((2, 1, 12, 12))],
+                          label=[nd.array([0, 1])]), is_train=False)
+    res = mon.toc()
+    assert len(res) > 0
+
+
+def test_feedforward_shim():
+    from mxnet_tpu.model import FeedForward, save_checkpoint, load_checkpoint
+    net = _lenet_ish(2)
+    d = tempfile.mkdtemp()
+    args = {n: nd.ones(s) for n, s in zip(
+        net.list_arguments(),
+        net.infer_shape(data=(1, 1, 12, 12))[0])}
+    del args['data'], args['softmax_label']
+    save_checkpoint(os.path.join(d, 'ff'), 1, net, args, {})
+    sym, arg_params, aux_params = load_checkpoint(os.path.join(d, 'ff'), 1)
+    assert sym.list_arguments() == net.list_arguments()
+    assert 'convolution0_weight' in arg_params

@@ -211,8 +211,14 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         cts = cotangents.get(id(node))
         if cts is None:
             continue
+        # Cotangents arrive in the dtype of the downstream consumer (e.g.
+        # f32 from a promoted loss); the pullback was linearized at this
+        # node's own output dtypes (bf16 under net.cast('bfloat16')), so
+        # cast at the node boundary — the analog of the reference casting
+        # head grads per executor output dtype.
         full = tuple(
-            ct if ct is not None else jnp.zeros(shp, dt)
+            (ct.astype(dt) if ct.dtype != dt else ct) if ct is not None
+            else jnp.zeros(shp, dt)
             for ct, shp, dt in zip(cts, node.out_shapes, node.out_dtypes))
         arg = full if node.num_outputs > 1 else full[0]
         in_cts = node.vjp_fn(arg)

@@ -59,7 +59,7 @@ class Context:
 
     def __enter__(self):
         if not hasattr(Context._default_ctx, 'value'):
-            Context._default_ctx.value = Context('cpu', 0)
+            Context._default_ctx.value = _initial_default()
         self._old_ctx = Context._default_ctx.value
         Context._default_ctx.value = self
         return self
@@ -89,8 +89,20 @@ class Context:
     @classmethod
     def default_ctx(cls):
         if not hasattr(cls._default_ctx, 'value'):
-            cls._default_ctx.value = Context('cpu', 0)
+            cls._default_ctx.value = _initial_default()
         return cls._default_ctx.value
+
+
+def _initial_default():
+    """TPU-native divergence from the reference: the default context is the
+    accelerator when one exists (the reference defaults to cpu(0) and makes
+    scripts pass ctx=mx.gpu() everywhere). With a cpu default every eager
+    creation op would compute on the XLA default backend (the TPU) and pay
+    a device→host readback per array — ruinous through a remote tunnel."""
+    try:
+        return default_device()
+    except RuntimeError:
+        return Context('cpu', 0)
 
 
 def cpu(device_id=0):

@@ -20,6 +20,7 @@ import threading
 import warnings
 
 import jax
+import jax.numpy as jnp
 import numpy as onp
 
 from ..base import string_types
@@ -468,6 +469,14 @@ def ensure_initialized(block, *args):
         _trace_state.probe = False
 
 
+# Shared compiled pullback applier: zero cotangents for the aux (moving
+# stat) outputs are materialized inside the jit so XLA folds them away.
+@jax.jit
+def _apply_cached_pullback(pb, cts_t, aux_arrays):
+    zero_aux = tuple(jnp.zeros_like(a) for a in aux_arrays)
+    return pb((tuple(cts_t), zero_aux))
+
+
 class CachedOp:
     """jit-compiled executor for a HybridBlock (reference: CachedOp,
     src/imperative/cached_op.h:76; here jax.jit does static planning)."""
@@ -475,7 +484,7 @@ class CachedOp:
     def __init__(self, block, flags=()):
         self._block = block
         self._flags = dict(flags)
-        self._jitted = {}   # (training, n_inputs) -> (jit_fn, meta)
+        self._jitted = {}   # (training, n_inputs) -> (jit_fn, vjp_jit, meta)
 
     def _make_fn(self, training, n_inputs):
         block = self._block
@@ -510,8 +519,20 @@ class CachedOp:
             meta['fmt'], meta['aux_params'] = m
             return outs, auxs
 
+        # Two compiled entry points: plain forward, and forward-with-
+        # pullback for autograd.record(). jax.vjp's pullback is a
+        # jax.tree_util.Partial (a pytree), so it can be returned from jit
+        # and later fed to the jitted applier — forward and backward are
+        # each ONE cached XLA dispatch, with no per-step retracing
+        # (reference analog: CachedOp StaticForward/StaticBackward,
+        # cached_op.cc:728/1026).
+        def wrapped_vjp(key, input_arrays, param_arrays):
+            return jax.vjp(lambda ins, ps: wrapped(key, ins, ps),
+                           list(input_arrays), list(param_arrays))
+
         jit_fn = jax.jit(wrapped)
-        return jit_fn, meta
+        vjp_fn = jax.jit(wrapped_vjp)
+        return jit_fn, vjp_fn, meta
 
     def __call__(self, inputs):
         block = self._block
@@ -519,7 +540,7 @@ class CachedOp:
         sig = (training, len(inputs))
         if sig not in self._jitted:
             self._jitted[sig] = self._make_fn(training, len(inputs))
-        jit_fn, meta = self._jitted[sig]
+        jit_fn, vjp_jit, meta = self._jitted[sig]
         params = block._cached_op_params
         param_arrays = [p.data()._data for p in params]
         in_arrays = [x._data if isinstance(x, NDArray) else
@@ -530,15 +551,12 @@ class CachedOp:
             any(isinstance(x, NDArray) and x._entry is not None for x in inputs)
             or any(p.data()._entry is not None for p in params))
 
-        fn = lambda *arrs: jit_fn(key, list(arrs[:len(in_arrays)]),
-                                  list(arrs[len(in_arrays):]))
-        all_arrays = in_arrays + param_arrays
         if recording:
-            (out_arrays, aux_arrays), vjp_fn = jax.vjp(
-                lambda *a: fn(*a), *all_arrays, has_aux=False)
+            (out_arrays, aux_arrays), pullback = vjp_jit(
+                key, in_arrays, param_arrays)
         else:
-            out_arrays, aux_arrays = fn(*all_arrays)
-            vjp_fn = None
+            out_arrays, aux_arrays = jit_fn(key, in_arrays, param_arrays)
+            pullback = None
 
         outputs = [NDArray(a) for a in out_arrays]
         # write back aux updates (moving stats)
@@ -552,13 +570,12 @@ class CachedOp:
                           for x in inputs] + \
                          [p.data()._entry for p in params]
 
-            def vjp_outputs_only(cts):
+            def apply_pullback(cts, _pb=pullback, _aux=aux_arrays):
                 cts_t = cts if isinstance(cts, tuple) else (cts,)
-                zero_aux = tuple(onp.zeros(a.shape, a.dtype)
-                                 for a in aux_arrays)
-                return vjp_fn((tuple(c for c in cts_t), zero_aux))
+                d_ins, d_params = _apply_cached_pullback(_pb, cts_t, _aux)
+                return list(d_ins) + list(d_params)
 
-            node = TapeNode(vjp_outputs_only, in_entries, len(outputs),
+            node = TapeNode(apply_pullback, in_entries, len(outputs),
                             [o.shape for o in outputs],
                             [o._data.dtype for o in outputs])
             for i, o in enumerate(outputs):
@@ -696,9 +713,18 @@ class HybridBlock(Block):
             raise RuntimeError(
                 'Please first call block.hybridize() and then run forward '
                 'with this block at least once before calling export.')
+        # Classify arg vs auxiliary states (BatchNorm moving stats): aux
+        # params are the ones published through record_aux_update, i.e.
+        # listed in the cached op's meta (reference export writes 'aux:%s'
+        # for sym.list_auxiliary_states(); a mixed 'arg:' dump would load
+        # back with empty aux_params).
+        aux_names = set()
+        for _, _, meta in self._cached_op._jitted.values():
+            aux_names.update(p.name for p in meta.get('aux_params', ()))
         params = {}
         for name, param in self.collect_params().items():
-            params['arg:%s' % name] = param._reduce()
+            prefix = 'aux' if name in aux_names else 'arg'
+            params['%s:%s' % (prefix, name)] = param._reduce()
         nd.save('%s-%04d.params' % (path, epoch), params)
         import json
         graph = {'format': 'mxnet_tpu-jaxpr-v1',

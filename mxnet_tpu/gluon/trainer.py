@@ -56,6 +56,7 @@ class Trainer:
         self._update_on_kvstore = None
         self._distributed = None
         self._params_to_init = []
+        self._fused = None  # FusedUpdater once built; False disables
         self._reset_kvstore()
 
     def _init_optimizer(self, optimizer, optimizer_params):
@@ -162,6 +163,12 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        if (not self._update_on_kvstore and
+                getattr(self._kvstore, 'num_workers', 1) == 1):
+            # one logical copy of each grad: the push/pull round-trip is an
+            # identity — skip the per-param dispatches (the reference's
+            # CommDevice reduce exists only because grads live per-GPU)
+            return
         for i, param in enumerate(self._params):
             if param.grad_req != 'null':
                 self._kvstore.push(i, param.list_grad()[0], priority=-i)
@@ -186,6 +193,7 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]
+        updatable = []
         for i, param in enumerate(self._params):
             if param.grad_req == 'null':
                 continue
@@ -203,8 +211,41 @@ class Trainer:
                 continue  # reference: stale params are skipped, not updated
             if self._kvstore and self._update_on_kvstore:
                 continue
+            updatable.append((i, param))
+
+        if self._try_fused_update(updatable, updater):
+            return
+        for i, param in updatable:
             updater(i, param.grad(), param.data())
             param.data()._grad_fresh = False
+
+    def _try_fused_update(self, updatable, updater):
+        """Apply all updates in one jitted, donated program (the multi-tensor
+        fused-update analog, optimizer_op.cc:318). Falls back to the eager
+        per-param loop if tracing the optimizer fails."""
+        if not updatable or self._fused is False:
+            return False
+        if not getattr(self._optimizer, 'fusable', True):
+            return False
+        from ..optimizer.fused import FusedUpdater
+        if self._fused is None:
+            self._fused = FusedUpdater(self._optimizer, updater)
+        if self._fused.broken:
+            return False
+        indices = [i for i, _ in updatable]
+        weights = [p.data() for _, p in updatable]
+        grads = [p.grad() for _, p in updatable]
+        from ..optimizer.fused import FusedTraceError
+        try:
+            self._fused(indices, weights, grads)
+        except FusedTraceError:
+            # trace failure happens before any dispatch/donation — the
+            # eager loop can safely take over
+            self._fused.broken = True
+            return False
+        for _, p in updatable:
+            p.data()._grad_fresh = False
+        return True
 
     def save_states(self, fname):
         """Save trainer (optimizer/updater) states
@@ -227,3 +268,6 @@ class Trainer:
         self._optimizer = self._updaters[0].optimizer
         param_dict = {i: param for i, param in enumerate(self._params)}
         self._optimizer.param_dict = param_dict
+        # the fused program is bound to the replaced optimizer/updater
+        # objects — rebuild it against the loaded ones
+        self._fused = None

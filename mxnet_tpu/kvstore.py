@@ -85,7 +85,10 @@ class KVStore:
             merged = self._allreduce(merged)
             if self._updater is not None:
                 if k not in self._data:
-                    self._data[k] = nd.zeros(merged.shape, dtype=merged.dtype)
+                    # Training against a silently-created zero weight would
+                    # mask a missing init() (reference kvstore errors here).
+                    raise KeyError(
+                        'push to key %r before init(); call kv.init first' % k)
                 self._updater(_key_to_int(k), merged, self._data[k])
             else:
                 self._data[k] = merged

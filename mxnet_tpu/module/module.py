@@ -265,11 +265,23 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning('optimizer already initialized, ignoring...')
             return
+        batch_size = self._data_shapes[0].shape[0] if self._data_shapes else 1
         if isinstance(optimizer, str):
             idx2name = dict(enumerate(self._param_names))
             optimizer_params = dict(optimizer_params)
+            # Loss-style heads (SoftmaxOutput) sum the gradient over the
+            # batch; scale updates by 1/batch_size unless the user chose
+            # otherwise (reference: module.py:502-517).
+            optimizer_params.setdefault('rescale_grad', 1.0 / batch_size)
             optimizer = opt.create(optimizer, param_idx2name=idx2name,
                                    sym=self._symbol, **optimizer_params)
+        elif getattr(optimizer, 'rescale_grad', None) is not None and \
+                abs(optimizer.rescale_grad - 1.0 / batch_size) > 1e-12:
+            self.logger.warning(
+                'Optimizer created manually outside Module but '
+                'rescale_grad is not normalized to 1.0/batch_size '
+                '(%s vs. %s). Is this intended?',
+                optimizer.rescale_grad, 1.0 / batch_size)
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
         self._kvstore = kvstore

@@ -218,8 +218,19 @@ class NDArray:
             value = value._data
         if isinstance(idx, slice) and idx == slice(None) and \
                 not isinstance(value, jax.Array):
-            self._data = jnp.full_like(self._data, value) \
-                if onp.isscalar(value) else jnp.asarray(value, self._data.dtype)
+            if onp.isscalar(value):
+                self._data = jnp.full_like(self._data, value)
+            else:
+                new = jnp.asarray(value, self._data.dtype)
+                try:
+                    # keep the buffer's placement — including a multi-device
+                    # sharding — rather than silently migrating it to the
+                    # default device (or collapsing a sharded param onto one
+                    # chip)
+                    new = jax.device_put(new, self._data.sharding)
+                except Exception:
+                    pass
+                self._data = new
             return
         self._data = self._data.at[idx].set(
             jnp.asarray(value, self._data.dtype)
@@ -253,6 +264,9 @@ class NDArray:
             return invoke(sname, [self], {'scalar': float(other)})
         if isinstance(other, (onp.ndarray, list, tuple)):
             return self._binary(opname, array(other), reflect)
+        if isinstance(other, jax.Array) or isinstance(other, jax.core.Tracer):
+            # raw jax value (e.g. a traced lr under the fused-step trace)
+            return self._binary(opname, NDArray(jnp.asarray(other)), reflect)
         return NotImplemented
 
     def __add__(self, o): return self._binary('broadcast_add', o)
@@ -414,6 +428,72 @@ def _getitem_fn(data, *, _key=None):
 _registry.register('_getitem')(_getitem_fn)
 
 
+def _attr_hashable(v):
+    if isinstance(v, jax.core.Tracer):
+        # a traced attr (e.g. lr under the fused-step trace) must not be
+        # baked into the jit cache — force the direct-dispatch path
+        raise TypeError('traced attr')
+    if isinstance(v, (list, tuple)):
+        return tuple(_attr_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _attr_hashable(x)) for k, x in v.items()))
+    return v
+
+
+# Compiled-dispatch cache: (op id, frozen attrs, recording) -> jitted
+# callable. This is the engine-bulking analog (reference: InitOpSegs,
+# graph_executor.cc:1275): every eager op call is one cached XLA program
+# instead of a chain of unfused primitive dispatches; jit itself re-keys
+# on shapes/dtypes. The recorded variant returns jax.vjp's pullback — a
+# jax.tree_util.Partial, i.e. a pytree — so record() costs one dispatch
+# and backward() another (_PULLBACK_APPLY) with no per-step retracing.
+# LRU-bounded: step-varying scalar attrs (e.g. Adam's bias-corrected lr on
+# the eager path) would otherwise accumulate one compiled program per step.
+import collections as _collections
+
+_INVOKE_JIT_CACHE_MAX = 1024
+_invoke_jit_cache = _collections.OrderedDict()
+
+
+def _get_jitted(op, attrs, recording, variadic):
+    key = (id(op), tuple(sorted((k, _attr_hashable(v))
+                                for k, v in attrs.items())),
+           bool(recording), bool(op.needs_rng))
+    cached = _invoke_jit_cache.get(key)
+    if cached is not None:
+        _invoke_jit_cache.move_to_end(key)
+        return cached
+    base_fn = op.bind_attrs(**attrs)
+    if op.needs_rng:
+        if variadic:
+            raw = lambda key_, *arrs: base_fn(key_, list(arrs))
+        else:
+            raw = base_fn
+        if recording:
+            def jfn(key_, *arrs):
+                return jax.vjp(lambda *a: raw(key_, *a), *arrs)
+        else:
+            jfn = raw
+    else:
+        if variadic:
+            raw = lambda *arrs: base_fn(list(arrs))
+        else:
+            raw = base_fn
+        if recording:
+            def jfn(*arrs):
+                return jax.vjp(raw, *arrs)
+        else:
+            jfn = raw
+    jitted = jax.jit(jfn)
+    _invoke_jit_cache[key] = jitted
+    while len(_invoke_jit_cache) > _INVOKE_JIT_CACHE_MAX:
+        _invoke_jit_cache.popitem(last=False)
+    return jitted
+
+
+_PULLBACK_APPLY = jax.jit(lambda pb, cts: pb(cts))
+
+
 def invoke(opname, nd_inputs, attrs, out=None):
     """Invoke a registered op eagerly on NDArrays, recording on the autograd
     tape when inside autograd.record() (Imperative::Invoke + RecordOp)."""
@@ -426,28 +506,46 @@ def invoke(opname, nd_inputs, attrs, out=None):
     if 'training' in op.attr_names and 'training' not in attrs:
         attrs['training'] = autograd.is_training()
 
-    if op.needs_rng:
-        key = _random.next_key()
-        base_fn = op.bind_attrs(**attrs)
-        if variadic:
-            fn = lambda *arrs: base_fn(key, list(arrs))
-        else:
-            fn = lambda *arrs: base_fn(key, *arrs)
-    else:
-        base_fn = op.bind_attrs(**attrs)
-        if variadic:
-            fn = lambda *arrs: base_fn(list(arrs))
-        else:
-            fn = base_fn
-
     recording = autograd.is_recording() and any(
         isinstance(x, NDArray) and x._entry is not None for x in flat_inputs)
 
-    if recording:
-        out_arrays, vjp_fn = jax.vjp(fn, *arrays)
+    # Under an outer trace (CachedOp/pjit) inputs are tracers: call the
+    # pure fn directly so the captured graph stays flat for XLA fusion.
+    traced = any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+    jitted = None
+    if not traced:
+        try:
+            jitted = _get_jitted(op, attrs, recording, variadic)
+        except TypeError:  # unhashable attr — fall back to direct dispatch
+            jitted = None
+
+    if jitted is not None:
+        call_args = arrays
+        if op.needs_rng:
+            call_args = [_random.next_key()] + call_args
+        if recording:
+            out_arrays, vjp_fn = jitted(*call_args)
+        else:
+            out_arrays = jitted(*call_args)
+            vjp_fn = None
     else:
-        out_arrays = fn(*arrays)
-        vjp_fn = None
+        base_fn = op.bind_attrs(**attrs)
+        if op.needs_rng:
+            key = _random.next_key()
+            if variadic:
+                fn = lambda *arrs: base_fn(key, list(arrs))
+            else:
+                fn = lambda *arrs: base_fn(key, *arrs)
+        elif variadic:
+            fn = lambda *arrs: base_fn(list(arrs))
+        else:
+            fn = base_fn
+        if recording:
+            out_arrays, vjp_fn = jax.vjp(fn, *arrays)
+        else:
+            out_arrays = fn(*arrays)
+            vjp_fn = None
 
     single = not isinstance(out_arrays, (tuple, list))
     outs_raw = [out_arrays] if single else list(out_arrays)
@@ -456,7 +554,11 @@ def invoke(opname, nd_inputs, attrs, out=None):
     if recording:
         in_entries = [x._entry if isinstance(x, NDArray) else None
                       for x in flat_inputs]
-        node = TapeNode(vjp_fn, in_entries, len(outputs),
+        # Route the pullback (a jax.tree_util.Partial pytree) through the
+        # shared jitted applier so backward() is one compiled dispatch per
+        # node instead of an eager primitive walk.
+        apply_fn = (lambda cts, _pb=vjp_fn: _PULLBACK_APPLY(_pb, cts))
+        node = TapeNode(apply_fn, in_entries, len(outputs),
                         [o.shape for o in outputs],
                         [o._data.dtype for o in outputs])
         for i, o in enumerate(outputs):

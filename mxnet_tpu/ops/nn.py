@@ -30,12 +30,13 @@ from ..base import np_dtype
 def fully_connected(args, *, num_hidden=None, no_bias=False, flatten=True):
     data, weight = args[0], args[1]
     x = data.reshape(data.shape[0], -1) if flatten else data
+    # NOTE: no preferred_element_type here — the TPU MXU already
+    # accumulates bf16 matmuls in f32 internally, and a mixed-dtype
+    # dot/conv (bf16 operands, f32 out) has no well-typed transpose in
+    # JAX, which breaks backward under net.cast('bfloat16').
     out = jax.lax.dot_general(
         x, weight,
-        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())))
     if not no_bias:
         out = out + args[2]
     return out
@@ -81,10 +82,7 @@ def convolution(args, *, kernel=None, stride=None, dilate=None, pad=None,
         padding=[(p, p) for p in pads],
         rhs_dilation=rhs_dil,
         dimension_numbers=_conv_dims(ndim),
-        feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+        feature_group_count=int(num_group))
     if not no_bias:
         bias = args[2]
         out = out + bias.reshape((1, -1) + (1,) * ndim)

@@ -21,7 +21,9 @@ def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    if wd and weight is not None:
+    # wd may be a traced scalar (fused step): only skip on a *static* zero
+    wd_static_zero = isinstance(wd, (int, float)) and wd == 0.0
+    if not wd_static_zero and weight is not None:
         g = g + wd * weight
     return g
 
@@ -180,9 +182,12 @@ def ftrl_update(weight, grad, z, n, *, lr=None, lamda1=0.01, beta=1.0,
           mutate_idx=(0, 2), aliases=('adagrad_update',))
 def adagrad_update(weight, grad, history, *, lr=None, epsilon=1e-7, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
-    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    # History accumulates the raw rescaled/clipped gradient (no wd term);
+    # weight decay applies outside the adaptive denominator
+    # (reference: optimizer_op.cc:840 _sparse_adagrad_update).
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
     h = history + jnp.square(g)
-    w = weight - lr * g / (jnp.sqrt(h) + epsilon)
+    w = weight - lr * (g / jnp.sqrt(h + epsilon) + wd * weight)
     return w, h
 
 

@@ -1,0 +1,216 @@
+"""Fused whole-model optimizer step.
+
+Reference parity: the engine's op-segment bulking (graph_executor.cc:1275
+InitOpSegs) plus the fused multi-tensor update kernels
+(optimizer_op.cc:318 multi_sgd_update). On TPU the analog is stronger:
+ONE jitted, buffer-donating XLA program applies every parameter update in
+the model, so Trainer.step costs a single dispatch instead of 150+ eager
+invokes, and XLA fuses the whole optimizer into a couple of kernels.
+
+Design: the existing Optimizer classes already express each update through
+registered pure ops (ops/optimizer_ops.py), so the fused program is built
+by *tracing the optimizer's own update() code* with tracer-backed NDArrays
+— no per-optimizer reimplementation, the full zoo fuses for free. Step-
+varying hyperparameters (lr, wd, update count t, rescale_grad) enter as
+traced scalars so lr schedules never retrace.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _random
+from ..ndarray import NDArray
+
+__all__ = ['FusedUpdater', 'FusedTraceError']
+
+
+class FusedTraceError(Exception):
+    """The optimizer's update() could not be traced into a fused program.
+    Raised BEFORE any buffer is dispatched/donated, so the caller can fall
+    back to the eager per-param path safely."""
+
+
+def _flatten_state(state, leaves):
+    """Collect NDArray leaves of a (possibly nested) optimizer state and
+    return a template with leaf indices in their place."""
+    if isinstance(state, NDArray):
+        leaves.append(state)
+        return ('leaf', len(leaves) - 1)
+    if isinstance(state, (tuple, list)):
+        return ('seq', type(state),
+                [_flatten_state(s, leaves) for s in state])
+    return ('const', state)
+
+
+def _rebuild_state(template, leaf_arrays):
+    kind = template[0]
+    if kind == 'leaf':
+        return NDArray(leaf_arrays[template[1]])
+    if kind == 'seq':
+        _, typ, items = template
+        typ = tuple if typ is tuple else list
+        return typ(_rebuild_state(t, leaf_arrays) for t in items)
+    return template[1]
+
+
+def _state_leaf_arrays(template, rebuilt, out):
+    """Read the (possibly mutated) leaf arrays back out of a rebuilt state."""
+    kind = template[0]
+    if kind == 'leaf':
+        out[template[1]] = rebuilt._data
+    elif kind == 'seq':
+        for t, r in zip(template[2], rebuilt):
+            _state_leaf_arrays(t, r, out)
+
+
+class _TracedCounts:
+    """Stands in for Optimizer._index_update_count during tracing: returns
+    the traced update-count scalar so e.g. Adam's beta**t bias correction
+    stays correct across steps without retracing."""
+
+    def __init__(self, ts, pos):
+        self._ts = ts
+        self._pos = pos
+
+    def __contains__(self, idx):
+        return True
+
+    def __getitem__(self, idx):
+        return self._ts[self._pos[idx]]
+
+    def __setitem__(self, idx, val):
+        pass
+
+
+class _HyperPatch:
+    """Temporarily reroute an optimizer's python-side hyperparameter lookups
+    to traced values while the fused program is being traced."""
+
+    def __init__(self, opt, indices, lrs, wds, ts, rescale):
+        self._opt = opt
+        pos = {idx: i for i, idx in enumerate(indices)}
+        self._patch = {
+            '_get_lrs': lambda idxs: [lrs[pos[i]] for i in idxs],
+            '_get_wds': lambda idxs: [wds[pos[i]] for i in idxs],
+            '_update_count': lambda idx: None,
+        }
+        self._attrs = {
+            '_index_update_count': _TracedCounts(ts, pos),
+            'rescale_grad': rescale,
+        }
+        self._saved = {}
+
+    def __enter__(self):
+        opt = self._opt
+        for name, fn in self._patch.items():
+            self._saved[name] = getattr(opt, name)
+            setattr(opt, name, fn)
+        for name, val in self._attrs.items():
+            self._saved[name] = getattr(opt, name)
+            setattr(opt, name, val)
+        return self
+
+    def __exit__(self, *exc):
+        for name, val in self._saved.items():
+            setattr(self._opt, name, val)
+
+
+class FusedUpdater:
+    """Applies optimizer updates for a whole parameter list in one jitted,
+    donated XLA program. Shares state storage with a plain Updater so
+    save/load_states round-trips are unchanged."""
+
+    def __init__(self, optimizer, updater):
+        self.optimizer = optimizer
+        self.updater = updater  # Updater: owns .states dict
+        self._jit = None
+        self._sig = None
+        self.broken = False  # tracing failed → caller uses eager path
+
+    def _build(self, indices, templates):
+        opt = self.optimizer
+        n = len(indices)
+
+        def fused(key, weights, grads, state_leaves, lrs, wds, ts, rescale):
+            with _random.key_override(key), \
+                    _HyperPatch(opt, indices, lrs, wds, ts, rescale):
+                new_w, new_leaves = [], list(state_leaves)
+                for i in range(n):
+                    w_nd = NDArray(weights[i])
+                    g_nd = NDArray(grads[i])
+                    state = _rebuild_state(templates[i], new_leaves)
+                    opt.update_multi_precision(indices[i], w_nd, g_nd, state)
+                    # traced f32 hypers promote bf16 math to f32 (python
+                    # floats are weak-typed, traced scalars are not): pin
+                    # outputs back to the stored dtypes
+                    new_w.append(w_nd._data.astype(weights[i].dtype))
+                    _state_leaf_arrays(templates[i], state, new_leaves)
+                new_leaves = [a.astype(old.dtype)
+                              for a, old in zip(new_leaves, state_leaves)]
+            return new_w, new_leaves
+
+        donate = (1, 3) if jax.default_backend() != 'cpu' else ()
+        return jax.jit(fused, donate_argnums=donate)
+
+    def __call__(self, indices, weights, grads):
+        """Update parameters in one compiled dispatch.
+
+        indices: optimizer param indices; weights/grads: NDArrays.
+        Mutates weights (and stored optimizer states) in place.
+        """
+        opt = self.optimizer
+        updater = self.updater
+        # lazily create states through the shared Updater storage
+        for idx, w in zip(indices, weights):
+            if idx not in updater.states:
+                updater.states[idx] = \
+                    opt.create_state_multi_precision(idx, w)
+                updater.states_synced[idx] = True
+
+        leaves = []
+        templates = [_flatten_state(updater.states[idx], leaves)
+                     for idx in indices]
+        # python-side bookkeeping BEFORE reading hypers (matches the order
+        # inside Optimizer.update: _update_count then _get_lr/_get_wd)
+        for idx in indices:
+            opt._update_count(idx)
+        ts = jnp.asarray([float(opt._index_update_count[idx])
+                          for idx in indices], dtype=jnp.float32)
+        lrs = jnp.asarray(opt._get_lrs(list(indices)), dtype=jnp.float32)
+        wds = jnp.asarray(opt._get_wds(list(indices)), dtype=jnp.float32)
+        rescale = jnp.float32(opt.rescale_grad)
+
+        key = _random.next_key()
+        w_arrays = [w._data for w in weights]
+        g_arrays = [g._data for g in grads]
+        leaf_arrays = [l._data for l in leaves]
+
+        sig = (tuple(indices),
+               tuple((w.shape, str(w.dtype)) for w in weights))
+        if self._jit is None or self._sig != sig:
+            jitted = self._build(list(indices), templates)
+            try:
+                # Trace WITHOUT executing (no buffers dispatched, nothing
+                # donated yet): a failure here is recoverable — the caller
+                # falls back to the eager loop with all weights intact.
+                jitted.lower(key, w_arrays, g_arrays, leaf_arrays,
+                             lrs, wds, ts, rescale)
+            except Exception as e:
+                # roll back the python-side count increments so the eager
+                # fallback does not double-count this step (Adam's t etc.)
+                for idx in indices:
+                    opt._index_update_count[idx] -= 1
+                raise FusedTraceError(str(e)) from e
+            self._jit = jitted
+            self._sig = sig
+
+        # Runtime failures past this point propagate: on non-CPU backends
+        # the weights/states were donated, so "fall back to eager" would
+        # operate on deleted buffers.
+        new_w, new_leaves = self._jit(key, w_arrays, g_arrays,
+                                      leaf_arrays, lrs, wds, ts, rescale)
+        for w, a in zip(weights, new_w):
+            w._data = a
+        for l, a in zip(leaves, new_leaves):
+            l._data = a

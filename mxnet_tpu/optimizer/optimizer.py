@@ -63,6 +63,12 @@ class Optimizer:
 
     opt_registry = opt_registry
 
+    # Whether the update is safe to trace into the fused whole-model step
+    # (optimizer/fused.py). Optimizers that mutate python-side state per
+    # update (Nadam's m_schedule) or sample host randomness with traced
+    # hypers (SGLD) opt out and run the eager per-param path.
+    fusable = True
+
     def __init__(self, rescale_grad=1., param_idx2name=None, wd=0.,
                  clip_gradient=None, learning_rate=0.01,
                  lr_scheduler=None, sym=None, begin_num_update=0,
@@ -380,6 +386,8 @@ class NAG(Optimizer):
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (reference: optimizer.py SGLD)."""
 
+    fusable = False  # lr**0.5 feeds a host-side sampler scale
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr = self._get_lr(index)
@@ -387,7 +395,7 @@ class SGLD(Optimizer):
         grad = grad * self.rescale_grad
         if self.clip_gradient is not None:
             grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+        noise = nd.random.normal(0, lr ** 0.5, shape=weight.shape,
                                  dtype=weight.dtype)
         weight[:] = weight - lr / 2 * (grad + wd * weight) + noise
 
@@ -420,7 +428,7 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1. - self.beta1 ** t
         coef2 = 1. - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
+        lr *= coef2 ** 0.5 / coef1  # works for floats and tracers
         mean, var = state
         invoke('adam_update', [weight, grad, mean, var],
                {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
@@ -452,7 +460,7 @@ class AdamW(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1. - self.beta1 ** t
         coef2 = 1. - self.beta2 ** t
-        eta = lr * math.sqrt(coef2) / coef1
+        eta = lr * coef2 ** 0.5 / coef1
         mean, var = state
         rescale = nd.full((1,), self.rescale_grad, dtype=weight.dtype)
         invoke('_adamw_update', [weight, grad, mean, var, rescale],
@@ -609,6 +617,8 @@ class Adamax(Optimizer):
 class Nadam(Optimizer):
     """Nesterov Adam (reference: optimizer.py Nadam)."""
 
+    fusable = False  # mutates self.m_schedule per update
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -647,6 +657,9 @@ class Nadam(Optimizer):
 
 @register
 class LBSGD(SGD):
+
+    fusable = False  # warmup schedule branches on python state
+
     """Large-batch SGD with LARS layer-wise lr adaptation
     (reference: optimizer.py LBSGD; warmup strategies approximated by the
     lr_scheduler warmup — the reference embeds them in the optimizer)."""

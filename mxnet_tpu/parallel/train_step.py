@@ -118,7 +118,7 @@ class ParallelTrainer:
         loss_block = self._loss
         opt = self._optimizer
         kw = self._opt_params
-        momentum = float(kw.get('momentum', 0.9))
+        momentum = float(kw.get('momentum', 0.0))
         wd = float(kw.get('wd', 0.0))
 
         def loss_of(key, param_arrays, xx, yy):

@@ -14,6 +14,14 @@ if '--xla_force_host_platform_device_count' not in flags:
 
 import jax  # noqa: E402
 
+# The axon PJRT plugin (sitecustomize) force-prepends the real-TPU platform
+# and clobbers the JAX_PLATFORMS env var — pin the config explicitly so the
+# suite is hermetic on the 8-device virtual CPU mesh.
+try:
+    jax.config.update('jax_platforms', 'cpu')
+except Exception:
+    pass
+
 jax.config.update('jax_default_matmul_precision', 'float32')
 
 import numpy as np  # noqa: E402

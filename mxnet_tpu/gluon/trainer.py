@@ -269,5 +269,7 @@ class Trainer:
         param_dict = {i: param for i, param in enumerate(self._params)}
         self._optimizer.param_dict = param_dict
         # the fused program is bound to the replaced optimizer/updater
-        # objects — rebuild it against the loaded ones
-        self._fused = None
+        # objects — rebuild it against the loaded ones (but keep an
+        # explicit user opt-out: _fused=False stays False)
+        if self._fused is not False:
+            self._fused = None

@@ -30,7 +30,9 @@ def _ctype_key_value(keys, vals):
     if isinstance(keys, (tuple, list)):
         assert len(keys) == len(vals)
         return list(keys), list(vals)
-    return [keys], [vals] if not isinstance(vals, (list, tuple)) else list(vals)
+    # single key: a list value is that key's multi-device value group
+    # (reference: kvstore.py _ctype_key_value single-key branch)
+    return [keys], [vals]
 
 
 class KVStore:

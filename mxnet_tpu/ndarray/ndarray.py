@@ -456,15 +456,32 @@ _invoke_jit_cache = _collections.OrderedDict()
 
 
 def _get_jitted(op, attrs, recording, variadic):
+    """Return (jitted_fn, dyn_names): step-varying attrs listed in
+    op.dynamic_attrs (e.g. Adam's bias-corrected lr) are excluded from the
+    cache key and passed as traced scalar operands, so schedulers never
+    force a recompile."""
+    dyn_names = () if op.needs_rng else tuple(
+        n for n in op.dynamic_attrs
+        if isinstance(attrs.get(n), (int, float))
+        and not isinstance(attrs.get(n), bool))
+    static = {k: v for k, v in attrs.items() if k not in dyn_names}
     key = (id(op), tuple(sorted((k, _attr_hashable(v))
-                                for k, v in attrs.items())),
-           bool(recording), bool(op.needs_rng))
+                                for k, v in static.items())),
+           dyn_names, bool(recording), bool(op.needs_rng))
     cached = _invoke_jit_cache.get(key)
     if cached is not None:
         _invoke_jit_cache.move_to_end(key)
-        return cached
-    base_fn = op.bind_attrs(**attrs)
-    if op.needs_rng:
+        return cached, dyn_names
+    base_fn = op.bind_attrs(**static)
+    nd_ = len(dyn_names)
+
+    def call(dyn_vals, arrs):
+        kw = dict(zip(dyn_names, dyn_vals))
+        if variadic:
+            return base_fn(list(arrs), **kw)
+        return base_fn(*arrs, **kw)
+
+    if op.needs_rng:  # dyn_names is () on this path
         if variadic:
             raw = lambda key_, *arrs: base_fn(key_, list(arrs))
         else:
@@ -475,20 +492,17 @@ def _get_jitted(op, attrs, recording, variadic):
         else:
             jfn = raw
     else:
-        if variadic:
-            raw = lambda *arrs: base_fn(list(arrs))
-        else:
-            raw = base_fn
         if recording:
-            def jfn(*arrs):
-                return jax.vjp(raw, *arrs)
+            def jfn(*a):
+                return jax.vjp(lambda *arrs: call(a[:nd_], arrs), *a[nd_:])
         else:
-            jfn = raw
+            def jfn(*a):
+                return call(a[:nd_], a[nd_:])
     jitted = jax.jit(jfn)
     _invoke_jit_cache[key] = jitted
     while len(_invoke_jit_cache) > _INVOKE_JIT_CACHE_MAX:
         _invoke_jit_cache.popitem(last=False)
-    return jitted
+    return jitted, dyn_names
 
 
 _PULLBACK_APPLY = jax.jit(lambda pb, cts: pb(cts))
@@ -514,14 +528,18 @@ def invoke(opname, nd_inputs, attrs, out=None):
     traced = any(isinstance(a, jax.core.Tracer) for a in arrays)
 
     jitted = None
+    dyn_names = ()
     if not traced:
         try:
-            jitted = _get_jitted(op, attrs, recording, variadic)
+            jitted, dyn_names = _get_jitted(op, attrs, recording, variadic)
         except TypeError:  # unhashable attr — fall back to direct dispatch
             jitted = None
 
     if jitted is not None:
-        call_args = arrays
+        # weak-typed scalars (no explicit dtype) so a traced lr does not
+        # promote bf16 weights to f32, matching python-float semantics
+        call_args = [jnp.asarray(float(attrs[n]))
+                     for n in dyn_names] + arrays
         if op.needs_rng:
             call_args = [_random.next_key()] + call_args
         if recording:
@@ -554,10 +572,16 @@ def invoke(opname, nd_inputs, attrs, out=None):
     if recording:
         in_entries = [x._entry if isinstance(x, NDArray) else None
                       for x in flat_inputs]
-        # Route the pullback (a jax.tree_util.Partial pytree) through the
-        # shared jitted applier so backward() is one compiled dispatch per
-        # node instead of an eager primitive walk.
-        apply_fn = (lambda cts, _pb=vjp_fn: _PULLBACK_APPLY(_pb, cts))
+        if jitted is not None:
+            # Route the pullback (a jax.tree_util.Partial pytree) through
+            # the shared jitted applier so backward() is one compiled
+            # dispatch per node instead of an eager primitive walk. Only
+            # for jit-produced pullbacks: an eager jax.vjp Partial has
+            # fresh identity per call and would retrace _PULLBACK_APPLY
+            # every backward.
+            apply_fn = (lambda cts, _pb=vjp_fn: _PULLBACK_APPLY(_pb, cts))
+        else:
+            apply_fn = vjp_fn
         node = TapeNode(apply_fn, in_entries, len(outputs),
                         [o.shape for o in outputs],
                         [o._data.dtype for o in outputs])

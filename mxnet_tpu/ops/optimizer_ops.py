@@ -28,14 +28,14 @@ def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
     return g
 
 
-@register('sgd_update', num_inputs=2, mutate_idx=(0,))
+@register('sgd_update', num_inputs=2, mutate_idx=(0,), dynamic_attrs=('lr',))
 def sgd_update(weight, grad, *, lr=None, wd=0.0, rescale_grad=1.0,
                clip_gradient=-1.0, lazy_update=True):
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     return weight - lr * g
 
 
-@register('sgd_mom_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
+@register('sgd_mom_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2), dynamic_attrs=('lr',))
 def sgd_mom_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
@@ -43,7 +43,7 @@ def sgd_mom_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
     return weight + new_mom, new_mom
 
 
-@register('mp_sgd_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
+@register('mp_sgd_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2), dynamic_attrs=('lr',))
 def mp_sgd_update(weight, grad, weight32, *, lr=None, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     """fp16/bf16 weights with fp32 master copy (reference: mp_sgd_update:587)."""
@@ -54,7 +54,7 @@ def mp_sgd_update(weight, grad, weight32, *, lr=None, wd=0.0,
 
 
 @register('mp_sgd_mom_update', num_inputs=4, num_outputs=3,
-          mutate_idx=(0, 2, 3))
+          mutate_idx=(0, 2, 3), dynamic_attrs=('lr',))
 def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr=None, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                       lazy_update=True):
@@ -65,14 +65,14 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr=None, momentum=0.0,
     return w32.astype(weight.dtype), new_mom, w32
 
 
-@register('signsgd_update', num_inputs=2, mutate_idx=(0,))
+@register('signsgd_update', num_inputs=2, mutate_idx=(0,), dynamic_attrs=('lr',))
 def signsgd_update(weight, grad, *, lr=None, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0):
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
-@register('signum_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
+@register('signum_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2), dynamic_attrs=('lr',))
 def signum_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
     # wd folds into the gradient before the sign (reference:
@@ -83,7 +83,7 @@ def signum_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
     return w, new_mom
 
 
-@register('adam_update', num_inputs=4, num_outputs=3, mutate_idx=(0, 2, 3))
+@register('adam_update', num_inputs=4, num_outputs=3, mutate_idx=(0, 2, 3), dynamic_attrs=('lr',))
 def adam_update(weight, grad, mean, var, *, lr=None, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
@@ -94,7 +94,7 @@ def adam_update(weight, grad, mean, var, *, lr=None, beta1=0.9, beta2=0.999,
     return w, m, v
 
 
-@register('_adamw_update', num_inputs=5, num_outputs=3, mutate_idx=(0, 2, 3),
+@register('_adamw_update', num_inputs=5, num_outputs=3, mutate_idx=(0, 2, 3), dynamic_attrs=('lr', 'eta'),
           aliases=('_contrib_adamw_update',))
 def adamw_update(weight, grad, mean, var, rescale_grad_t, *, lr=None,
                  beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
@@ -111,7 +111,7 @@ def adamw_update(weight, grad, mean, var, rescale_grad_t, *, lr=None,
 
 
 @register('_mp_adamw_update', num_inputs=6, num_outputs=4,
-          mutate_idx=(0, 2, 3, 4), aliases=('_contrib_mp_adamw_update',))
+          mutate_idx=(0, 2, 3, 4), dynamic_attrs=('lr', 'eta'), aliases=('_contrib_mp_adamw_update',))
 def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t, *,
                     lr=None, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                     eta=1.0, clip_gradient=-1.0):
@@ -125,7 +125,7 @@ def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t, *,
 
 
 @register('ftml_update', num_inputs=5, num_outputs=4,
-          mutate_idx=(0, 2, 3, 4))
+          mutate_idx=(0, 2, 3, 4), dynamic_attrs=('lr',))
 def ftml_update(weight, grad, d, v, z, *, lr=None, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
                 clip_grad=-1.0):
@@ -138,7 +138,7 @@ def ftml_update(weight, grad, d, v, z, *, lr=None, beta1=0.6, beta2=0.999,
     return w, d_t, v_t, z_t
 
 
-@register('rmsprop_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2))
+@register('rmsprop_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2), dynamic_attrs=('lr',))
 def rmsprop_update(weight, grad, n, *, lr=None, gamma1=0.95, epsilon=1e-8,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    clip_weights=-1.0):
@@ -151,7 +151,7 @@ def rmsprop_update(weight, grad, n, *, lr=None, gamma1=0.95, epsilon=1e-8,
 
 
 @register('rmspropalex_update', num_inputs=5, num_outputs=4,
-          mutate_idx=(0, 2, 3, 4))
+          mutate_idx=(0, 2, 3, 4), dynamic_attrs=('lr',))
 def rmspropalex_update(weight, grad, n, g_acc, delta, *, lr=None, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
@@ -165,7 +165,7 @@ def rmspropalex_update(weight, grad, n, g_acc, delta, *, lr=None, gamma1=0.95,
     return w, n_t, g_t, delta_t
 
 
-@register('ftrl_update', num_inputs=4, num_outputs=3, mutate_idx=(0, 2, 3))
+@register('ftrl_update', num_inputs=4, num_outputs=3, mutate_idx=(0, 2, 3), dynamic_attrs=('lr',))
 def ftrl_update(weight, grad, z, n, *, lr=None, lamda1=0.01, beta=1.0,
                 wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
@@ -179,7 +179,7 @@ def ftrl_update(weight, grad, z, n, *, lr=None, lamda1=0.01, beta=1.0,
 
 
 @register('_sparse_adagrad_update', num_inputs=3, num_outputs=2,
-          mutate_idx=(0, 2), aliases=('adagrad_update',))
+          mutate_idx=(0, 2), dynamic_attrs=('lr',), aliases=('adagrad_update',))
 def adagrad_update(weight, grad, history, *, lr=None, epsilon=1e-7, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
     # History accumulates the raw rescaled/clipped gradient (no wd term);
@@ -192,7 +192,7 @@ def adagrad_update(weight, grad, history, *, lr=None, epsilon=1e-7, wd=0.0,
 
 
 @register('_contrib_group_adagrad_update', num_inputs=3, num_outputs=2,
-          mutate_idx=(0, 2))
+          mutate_idx=(0, 2), dynamic_attrs=('lr',))
 def group_adagrad_update(weight, grad, history, *, lr=None, epsilon=1e-5,
                          rescale_grad=1.0, clip_gradient=-1.0):
     g = _rescale_clip(grad, rescale_grad, clip_gradient)
@@ -222,7 +222,7 @@ def _as_tuple(x):
 
 
 @register('multi_sgd_update', num_inputs=-1, num_outputs=-1,
-          key_var_num_args='num_weights')
+          key_var_num_args='num_weights', dynamic_attrs=('lr',))
 def multi_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
                      rescale_grad=1.0, clip_gradient=-1.0):
     return _multi(lambda g, lr, wd, **kw: sgd_update(
@@ -233,7 +233,7 @@ def multi_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
 
 
 @register('multi_sgd_mom_update', num_inputs=-1, num_outputs=-1,
-          key_var_num_args='num_weights')
+          key_var_num_args='num_weights', dynamic_attrs=('lr',))
 def multi_sgd_mom_update(args, *, num_weights=None, lrs=None, wds=None,
                          momentum=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     return _multi(lambda g, lr, wd, **kw: sgd_mom_update(
@@ -244,7 +244,7 @@ def multi_sgd_mom_update(args, *, num_weights=None, lrs=None, wds=None,
 
 
 @register('multi_mp_sgd_update', num_inputs=-1, num_outputs=-1,
-          key_var_num_args='num_weights')
+          key_var_num_args='num_weights', dynamic_attrs=('lr',))
 def multi_mp_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
                         rescale_grad=1.0, clip_gradient=-1.0):
     return _multi(lambda g, lr, wd, **kw: mp_sgd_update(
@@ -254,7 +254,7 @@ def multi_mp_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
 
 
 @register('multi_mp_sgd_mom_update', num_inputs=-1, num_outputs=-1,
-          key_var_num_args='num_weights')
+          key_var_num_args='num_weights', dynamic_attrs=('lr',))
 def multi_mp_sgd_mom_update(args, *, num_weights=None, lrs=None, wds=None,
                             momentum=0.0, rescale_grad=1.0,
                             clip_gradient=-1.0):

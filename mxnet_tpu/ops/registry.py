@@ -45,10 +45,12 @@ class Operator:
     """
 
     __slots__ = ('name', 'fn', 'num_inputs', 'num_outputs', 'key_var_num_args',
-                 'needs_rng', 'mutate_idx', 'doc', 'attr_names')
+                 'needs_rng', 'mutate_idx', 'doc', 'attr_names',
+                 'dynamic_attrs')
 
     def __init__(self, name, fn, num_inputs=1, num_outputs=1,
-                 key_var_num_args=None, needs_rng=False, mutate_idx=(), doc=None):
+                 key_var_num_args=None, needs_rng=False, mutate_idx=(),
+                 doc=None, dynamic_attrs=()):
         self.name = name
         self.fn = fn
         self.num_inputs = num_inputs
@@ -56,6 +58,11 @@ class Operator:
         self.key_var_num_args = key_var_num_args
         self.needs_rng = needs_rng
         self.mutate_idx = tuple(mutate_idx)
+        # attrs that vary per step (e.g. a bias-corrected lr): the compiled
+        # eager dispatch passes them as traced scalar operands instead of
+        # baking them into the jit cache key, so schedulers/Adam never
+        # recompile per step
+        self.dynamic_attrs = tuple(dynamic_attrs)
         self.doc = doc or (fn.__doc__ if fn else None)
         try:
             sig = inspect.signature(fn)
@@ -75,12 +82,12 @@ class Operator:
 
 
 def register(name, num_inputs=1, num_outputs=1, key_var_num_args=None,
-             needs_rng=False, mutate_idx=(), aliases=()):
+             needs_rng=False, mutate_idx=(), aliases=(), dynamic_attrs=()):
     """Decorator registering a pure jax function as a framework op."""
     def _reg(fn):
         op = Operator(name, fn, num_inputs=num_inputs, num_outputs=num_outputs,
                       key_var_num_args=key_var_num_args, needs_rng=needs_rng,
-                      mutate_idx=mutate_idx)
+                      mutate_idx=mutate_idx, dynamic_attrs=dynamic_attrs)
         OPS[name] = op
         for al in aliases:
             OPS[al] = op

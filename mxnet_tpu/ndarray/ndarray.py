@@ -529,7 +529,7 @@ def invoke(opname, nd_inputs, attrs, out=None):
 
     jitted = None
     dyn_names = ()
-    if not traced:
+    if not traced and not op.nojit:
         try:
             jitted, dyn_names = _get_jitted(op, attrs, recording, variadic)
         except TypeError:  # unhashable attr — fall back to direct dispatch
@@ -559,7 +559,18 @@ def invoke(opname, nd_inputs, attrs, out=None):
             fn = lambda *arrs: base_fn(list(arrs))
         else:
             fn = base_fn
-        if recording:
+        if recording and op.nojit and op.bwd is not None:
+            # dynamic-shape op: forward runs eagerly (untraceable), the
+            # registered hand-written pullback supplies the gradient
+            out_arrays = fn(*arrays)
+            single_out = not isinstance(out_arrays, (tuple, list))
+
+            def vjp_fn(cts, _in=tuple(arrays), _out=out_arrays,
+                       _single=single_out):
+                cts_t = (cts,) if _single else tuple(cts)
+                outs_t = (_out,) if _single else tuple(_out)
+                return op.bwd(_in, outs_t, cts_t, **attrs)
+        elif recording:
             out_arrays, vjp_fn = jax.vjp(fn, *arrays)
         else:
             out_arrays = fn(*arrays)

@@ -19,7 +19,10 @@ from .registry import register, alias
 # ---------------------------------------------------------------------------
 
 _UNARY = {
-    'abs': jnp.abs, 'sign': jnp.sign, 'rint': jnp.rint, 'ceil': jnp.ceil,
+    # MXNet rint rounds halfway values DOWN (mshadow_op.h: rint(1.5)=1,
+    # rint(-1.5)=-2), unlike jnp.rint's ties-to-even
+    'abs': jnp.abs, 'sign': jnp.sign,
+    'rint': lambda x: jnp.ceil(x - 0.5), 'ceil': jnp.ceil,
     'floor': jnp.floor, 'trunc': jnp.trunc, 'fix': jnp.trunc,
     'square': jnp.square, 'sqrt': jnp.sqrt,
     'cbrt': jnp.cbrt, 'exp': jnp.exp, 'log': jnp.log, 'log10': jnp.log10,
@@ -38,6 +41,10 @@ _UNARY = {
     'relu': jax.nn.relu,
     'hard_sigmoid': lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     'isnan': jnp.isnan, 'isinf': jnp.isinf,
+    # MXNet round = round-half-away-from-zero (mshadow_op.h round), unlike
+    # jnp.round's banker's rounding
+    'round': lambda x: jnp.where(x >= 0, jnp.floor(x + 0.5),
+                                 jnp.ceil(x - 0.5)),
 }
 
 for _name, _jfn in _UNARY.items():

@@ -217,21 +217,21 @@ def leaky_relu(args, *, act_type='leaky', slope=0.25, lower_bound=0.125,
 @register('softmax')
 def softmax(data, *, axis=-1, temperature=None, dtype=None, length=None):
     x = data if temperature in (None, 1.0) else data / temperature
-    out = jax.nn.softmax(x, axis=int(axis))
+    out = jax.nn.softmax(x, axis=-1 if axis is None else int(axis))
     return out.astype(np_dtype(dtype)) if dtype else out
 
 
 @register('log_softmax')
 def log_softmax(data, *, axis=-1, temperature=None, dtype=None):
     x = data if temperature in (None, 1.0) else data / temperature
-    out = jax.nn.log_softmax(x, axis=int(axis))
+    out = jax.nn.log_softmax(x, axis=-1 if axis is None else int(axis))
     return out.astype(np_dtype(dtype)) if dtype else out
 
 
 @register('softmin')
 def softmin(data, *, axis=-1, temperature=None, dtype=None):
     x = -data if temperature in (None, 1.0) else -data / temperature
-    out = jax.nn.softmax(x, axis=int(axis))
+    out = jax.nn.softmax(x, axis=-1 if axis is None else int(axis))
     return out.astype(np_dtype(dtype)) if dtype else out
 
 

@@ -222,7 +222,7 @@ def _as_tuple(x):
 
 
 @register('multi_sgd_update', num_inputs=-1, num_outputs=-1,
-          key_var_num_args='num_weights', dynamic_attrs=('lr',))
+          key_var_num_args='num_weights')
 def multi_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
                      rescale_grad=1.0, clip_gradient=-1.0):
     return _multi(lambda g, lr, wd, **kw: sgd_update(
@@ -233,7 +233,7 @@ def multi_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
 
 
 @register('multi_sgd_mom_update', num_inputs=-1, num_outputs=-1,
-          key_var_num_args='num_weights', dynamic_attrs=('lr',))
+          key_var_num_args='num_weights')
 def multi_sgd_mom_update(args, *, num_weights=None, lrs=None, wds=None,
                          momentum=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     return _multi(lambda g, lr, wd, **kw: sgd_mom_update(
@@ -244,7 +244,7 @@ def multi_sgd_mom_update(args, *, num_weights=None, lrs=None, wds=None,
 
 
 @register('multi_mp_sgd_update', num_inputs=-1, num_outputs=-1,
-          key_var_num_args='num_weights', dynamic_attrs=('lr',))
+          key_var_num_args='num_weights')
 def multi_mp_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
                         rescale_grad=1.0, clip_gradient=-1.0):
     return _multi(lambda g, lr, wd, **kw: mp_sgd_update(
@@ -254,7 +254,7 @@ def multi_mp_sgd_update(args, *, num_weights=None, lrs=None, wds=None,
 
 
 @register('multi_mp_sgd_mom_update', num_inputs=-1, num_outputs=-1,
-          key_var_num_args='num_weights', dynamic_attrs=('lr',))
+          key_var_num_args='num_weights')
 def multi_mp_sgd_mom_update(args, *, num_weights=None, lrs=None, wds=None,
                             momentum=0.0, rescale_grad=1.0,
                             clip_gradient=-1.0):

@@ -25,8 +25,10 @@ def _shape(shape):
 
 
 def _reg_sampler(name, sample_fn, like_too=True):
+    # 'gamma' stays the unary gamma *function* (mshadow parity: mx.nd.gamma
+    # is tgamma; the sampler is only mx.nd.random.gamma/_random_gamma)
     @register('_random_%s' % name, num_inputs=0, needs_rng=True,
-              aliases=(name,) if name not in ('randint',) else ())
+              aliases=(name,) if name not in ('randint', 'gamma') else ())
     def _op(key, *, shape=None, ctx=None, dtype='float32', **kw):
         return sample_fn(key, _shape(shape), np_dtype(dtype or 'float32'), kw)
 
@@ -53,7 +55,6 @@ _reg_sampler('generalized_negative_binomial', lambda key, shp, dt, kw:
 
 alias('_random_normal', 'normal', '_sample_normal_like')
 alias('_random_uniform', 'uniform')
-alias('_random_gamma', 'gamma')
 alias('_random_exponential', 'exponential')
 alias('_random_poisson', 'poisson')
 alias('_random_negative_binomial', 'negative_binomial')
@@ -156,11 +157,19 @@ def sample_multinomial(key, data, *, shape=None, get_prob=False,
 @register('_sample_unique_zipfian', num_inputs=0, needs_rng=True,
           num_outputs=2)
 def sample_unique_zipfian(key, *, range_max=None, shape=None):
-    shp = _shape(shape)
-    n = shp[-1] if shp else 1
-    # approximate zipfian via log-uniform as the reference does
-    u = jax.random.uniform(key, (int(n * 2),))
-    cand = (jnp.exp(u * jnp.log(float(range_max))) - 1).astype(jnp.int64)
-    uniq = jnp.unique(cand, size=n, fill_value=0)
-    cnt = jnp.ones((n,), dtype=jnp.int64)
+    shp = _shape(shape) or (1,)
+    n = int(shp[-1])
+    rows = 1
+    for d in shp[:-1]:
+        rows *= int(d)
+    keys = jax.random.split(key, rows)
+
+    def one(k):
+        # approximate zipfian via log-uniform as the reference does
+        u = jax.random.uniform(k, (int(n * 2),))
+        cand = (jnp.exp(u * jnp.log(float(range_max))) - 1).astype(jnp.int32)
+        return jnp.unique(cand, size=n, fill_value=0)
+
+    uniq = jax.vmap(one)(keys)
+    cnt = jnp.ones((rows, n), dtype=jnp.int32)
     return uniq.reshape(shp), cnt.reshape(shp)

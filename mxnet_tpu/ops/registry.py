@@ -42,15 +42,19 @@ class Operator:
     mutate_idx : indices of inputs that the *eager* frontend should update
         in place with the corresponding output (optimizer update ops);
         pure fn itself never mutates (FMutateInputs parity).
+    nojit : op has data-dependent output shapes (boolean_mask class) and
+        must run un-jitted on the eager path; it cannot appear inside a
+        hybridized/jitted graph (same restriction the reference's dynamic
+        -shape ops have under its static graph executor).
     """
 
     __slots__ = ('name', 'fn', 'num_inputs', 'num_outputs', 'key_var_num_args',
                  'needs_rng', 'mutate_idx', 'doc', 'attr_names',
-                 'dynamic_attrs')
+                 'dynamic_attrs', 'nojit', 'bwd')
 
     def __init__(self, name, fn, num_inputs=1, num_outputs=1,
                  key_var_num_args=None, needs_rng=False, mutate_idx=(),
-                 doc=None, dynamic_attrs=()):
+                 doc=None, dynamic_attrs=(), nojit=False, bwd=None):
         self.name = name
         self.fn = fn
         self.num_inputs = num_inputs
@@ -63,6 +67,11 @@ class Operator:
         # baking them into the jit cache key, so schedulers/Adam never
         # recompile per step
         self.dynamic_attrs = tuple(dynamic_attrs)
+        self.nojit = nojit
+        # hand-written eager pullback for nojit ops whose forward cannot
+        # trace (dynamic output shapes): bwd(inputs, outputs, cts, **attrs)
+        # -> per-input cotangents (autodiff covers every other op)
+        self.bwd = bwd
         self.doc = doc or (fn.__doc__ if fn else None)
         try:
             sig = inspect.signature(fn)
@@ -82,12 +91,14 @@ class Operator:
 
 
 def register(name, num_inputs=1, num_outputs=1, key_var_num_args=None,
-             needs_rng=False, mutate_idx=(), aliases=(), dynamic_attrs=()):
+             needs_rng=False, mutate_idx=(), aliases=(), dynamic_attrs=(),
+             nojit=False, bwd=None):
     """Decorator registering a pure jax function as a framework op."""
     def _reg(fn):
         op = Operator(name, fn, num_inputs=num_inputs, num_outputs=num_outputs,
                       key_var_num_args=key_var_num_args, needs_rng=needs_rng,
-                      mutate_idx=mutate_idx, dynamic_attrs=dynamic_attrs)
+                      mutate_idx=mutate_idx, dynamic_attrs=dynamic_attrs,
+                      nojit=nojit, bwd=bwd)
         OPS[name] = op
         for al in aliases:
             OPS[al] = op

@@ -316,7 +316,20 @@ def where(condition, x, y):
     return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
 
 
-@register('boolean_mask', num_inputs=2, aliases=('_contrib_boolean_mask',))
+def _boolean_mask_bwd(inputs, outputs, cts, *, axis=0):
+    # scatter the cotangent rows back to the kept positions
+    data, index = inputs
+    ct = cts[0]
+    idx = onp.nonzero(onp.asarray(index) != 0)[0]
+    ax = int(axis)
+    g = jnp.zeros(data.shape, dtype=ct.dtype)
+    g = jnp.moveaxis(
+        jnp.moveaxis(g, ax, 0).at[idx].set(jnp.moveaxis(ct, ax, 0)), 0, ax)
+    return (g, jnp.zeros(index.shape, dtype=index.dtype))
+
+
+@register('boolean_mask', num_inputs=2, aliases=('_contrib_boolean_mask',),
+          nojit=True, bwd=_boolean_mask_bwd)
 def boolean_mask(data, index, *, axis=0):
     # dynamic-shape op: eager-only (reference: contrib/boolean_mask.cc).
     mask = onp.asarray(index) != 0

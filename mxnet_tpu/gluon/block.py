@@ -484,9 +484,12 @@ class CachedOp:
     def __init__(self, block, flags=()):
         self._block = block
         self._flags = dict(flags)
-        self._jitted = {}   # (training, n_inputs) -> (jit_fn, vjp_jit, meta)
+        # keyed by (training, per-input None pattern): a None input is
+        # static pytree structure, so different None patterns are
+        # different traces
+        self._jitted = {}
 
-    def _make_fn(self, training, n_inputs):
+    def _make_fn(self, training):
         block = self._block
         param_names = [p.name for p in block._cached_op_params]
 
@@ -494,7 +497,9 @@ class CachedOp:
             prev_train = autograd.set_training(training)
             try:
                 with _random.key_override(key), _TraceScope() as scope:
-                    nd_in = [NDArray(a) for a in input_arrays]
+                    # None inputs (optional masks etc.) pass through as-is
+                    nd_in = [NDArray(a) if a is not None else None
+                             for a in input_arrays]
                     nd_params = [NDArray(a) for a in param_arrays]
                     for p, v in zip(block._cached_op_params, nd_params):
                         # temporarily swap param storage for tracers
@@ -537,14 +542,15 @@ class CachedOp:
     def __call__(self, inputs):
         block = self._block
         training = autograd.is_training()
-        sig = (training, len(inputs))
+        sig = (training, tuple(x is None for x in inputs))
         if sig not in self._jitted:
-            self._jitted[sig] = self._make_fn(training, len(inputs))
+            self._jitted[sig] = self._make_fn(training)
         jit_fn, vjp_jit, meta = self._jitted[sig]
         params = block._cached_op_params
         param_arrays = [p.data()._data for p in params]
         in_arrays = [x._data if isinstance(x, NDArray) else
-                     nd.array(x)._data for x in inputs]
+                     (None if x is None else nd.array(x)._data)
+                     for x in inputs]
         key = _random.next_key()
 
         recording = autograd.is_recording() and (

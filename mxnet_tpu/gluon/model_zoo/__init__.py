@@ -1,3 +1,5 @@
 """Gluon model zoo (reference: python/mxnet/gluon/model_zoo/)."""
 from . import vision
 from .vision import get_model
+from . import bert
+from .bert import get_bert

@@ -1,0 +1,157 @@
+"""BERT for masked-LM pretraining and fine-tuning (TPU-first).
+
+The reference frames BERT-base pretraining as its transformer workload
+(SURVEY.md §2.6 row 3; op anchor src/operator/contrib/transformer.cc:33,
+optimizer anchor src/operator/contrib/adamw.cc). The model itself lived in
+gluon-nlp on top of the reference's Gluon API; this is the same API surface
+built on the TPU-native blocks in gluon.nn.transformer:
+
+  * whole forward traces to one XLA program under hybridize(),
+  * masked-position gather is a one_hot batched matmul (MXU-friendly,
+    static shapes) rather than dynamic indexing,
+  * the MLM decoder ties the word-embedding weight (one transposed
+    matmul; XLA shares the buffer).
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, LayerNorm
+from ..nn.transformer import TransformerEncoder
+
+__all__ = ['BERTModel', 'BERTClassifier', 'get_bert', 'bert_12_768_12',
+           'bert_24_1024_16']
+
+
+class BERTModel(HybridBlock):
+    """BERT encoder + pooler + tied masked-LM decoder + NSP classifier.
+
+    Call: (inputs, token_types, valid_length=None, masked_positions=None)
+      inputs:            (B, S) int token ids
+      token_types:       (B, S) segment ids
+      valid_length:      (B,) optional
+      masked_positions:  (B, P) optional int positions for MLM scores
+    Returns seq_out (B, S, C) [, pooled (B, C)] [, mlm_scores (B, P, V)],
+    nsp_scores (B, 2) — pooled/nsp when use_pooler/use_classifier, mlm
+    when masked_positions given and use_decoder.
+    """
+
+    def __init__(self, vocab_size=30522, token_type_vocab_size=2, units=768,
+                 hidden_size=3072, num_layers=12, num_heads=12,
+                 max_length=512, dropout=0.1, use_pooler=True,
+                 use_decoder=True, use_classifier=True, **kwargs):
+        super().__init__(**kwargs)
+        self._use_pooler = use_pooler
+        self._use_decoder = use_decoder
+        self._use_classifier = use_classifier
+        self._units = units
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units, prefix='word_')
+            self.token_type_embed = Embedding(token_type_vocab_size, units,
+                                              prefix='type_')
+            self.position_embed = Embedding(max_length, units, prefix='pos_')
+            self.embed_layer_norm = LayerNorm(epsilon=1e-12, prefix='emb_ln_')
+            self.embed_dropout = Dropout(dropout)
+            self.encoder = TransformerEncoder(
+                num_layers=num_layers, units=units, hidden_size=hidden_size,
+                num_heads=num_heads, dropout=dropout, prefix='enc_')
+            if use_pooler:
+                self.pooler = Dense(units, activation='tanh', flatten=False,
+                                    prefix='pooler_')
+            if use_decoder:
+                self.decoder_transform = Dense(units, activation='gelu',
+                                               flatten=False, prefix='dec_')
+                self.decoder_layer_norm = LayerNorm(epsilon=1e-12,
+                                                    prefix='dec_ln_')
+                # decoder output weight is TIED to word_embed.weight; only
+                # the bias is a fresh parameter
+                self.decoder_bias = self.params.get(
+                    'decoder_bias', shape=(vocab_size,), init='zeros')
+            if use_classifier:
+                self.nsp_classifier = Dense(2, flatten=False, prefix='nsp_')
+
+    def _embed(self, F, inputs, token_types):
+        positions = F._contrib_arange_like(inputs, axis=1)
+        x = (self.word_embed(inputs) + self.token_type_embed(token_types) +
+             F.expand_dims(self.position_embed(positions), axis=0))
+        return self.embed_dropout(self.embed_layer_norm(x))
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None,
+                       masked_positions=None, decoder_bias=None):
+        x = self._embed(F, inputs, token_types)
+        seq = self.encoder(x, valid_length)
+        outputs = [seq]
+        if self._use_pooler:
+            cls = F.squeeze(F.slice_axis(seq, axis=1, begin=0, end=1),
+                            axis=1)
+            pooled = self.pooler(cls)
+            outputs.append(pooled)
+        if self._use_decoder and masked_positions is not None:
+            # (B, S, C) gathered at (B, P) -> (B, P, C) as a batched
+            # matmul: one_hot keeps shapes static for XLA and rides the MXU
+            oh = F.one_hot(masked_positions, depth=seq.shape[1],
+                           dtype='float32')
+            oh = F.cast(oh, dtype=str(seq.dtype)) if oh.dtype != seq.dtype \
+                else oh                                  # (B, P, S)
+            gathered = F.batch_dot(oh, seq)              # (B, P, C)
+            h = self.decoder_layer_norm(self.decoder_transform(gathered))
+            # tied decoder: scores = h @ word_embed.weight.T + bias
+            mlm = F.FullyConnected(
+                h, self._tied_weight(F), decoder_bias,
+                num_hidden=self._vocab_size(), flatten=False)
+            outputs.append(mlm)
+        if self._use_classifier and self._use_pooler:
+            outputs.append(self.nsp_classifier(outputs[1]))
+        return tuple(outputs) if len(outputs) > 1 else outputs[0]
+
+    def _tied_weight(self, F):
+        p = self.word_embed.weight
+        v = getattr(p, '_trace_data', None)
+        return v if v is not None else p.data()
+
+    def _vocab_size(self):
+        return self.word_embed.weight.shape[0]
+
+
+class BERTClassifier(HybridBlock):
+    """BERT + dropout + Dense(num_classes) over the pooled [CLS] state —
+    the standard fine-tuning head."""
+
+    def __init__(self, bert, num_classes=2, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.bert = bert
+        with self.name_scope():
+            self.dropout = Dropout(dropout)
+            self.classifier = Dense(num_classes, flatten=False,
+                                    prefix='cls_')
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None):
+        outs = self.bert(inputs, token_types, valid_length)
+        pooled = outs[1] if isinstance(outs, tuple) else outs
+        return self.classifier(self.dropout(pooled))
+
+
+_BERT_CONFIGS = {
+    'bert_12_768_12': dict(units=768, hidden_size=3072, num_layers=12,
+                           num_heads=12),
+    'bert_24_1024_16': dict(units=1024, hidden_size=4096, num_layers=24,
+                            num_heads=16),
+}
+
+
+def get_bert(model_name='bert_12_768_12', vocab_size=30522, max_length=512,
+             dropout=0.1, use_pooler=True, use_decoder=True,
+             use_classifier=True, **kwargs):
+    cfg = dict(_BERT_CONFIGS[model_name])
+    cfg.update(kwargs)
+    return BERTModel(vocab_size=vocab_size, max_length=max_length,
+                     dropout=dropout, use_pooler=use_pooler,
+                     use_decoder=use_decoder, use_classifier=use_classifier,
+                     **cfg)
+
+
+def bert_12_768_12(**kwargs):
+    return get_bert('bert_12_768_12', **kwargs)
+
+
+def bert_24_1024_16(**kwargs):
+    return get_bert('bert_24_1024_16', **kwargs)

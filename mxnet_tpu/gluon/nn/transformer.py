@@ -1,0 +1,185 @@
+"""Transformer building blocks (TPU-first).
+
+Reference anchors: the reference ships only the scaled-projection helper op
+(src/operator/contrib/transformer.cc:33 _contrib_div_sqrt_dim) and the BERT
+workload itself lives at the gluon-nlp level (SURVEY.md §2.6 row 3 names
+BERT-base pretraining as the north-star workload). Here the blocks are
+designed for the MXU directly:
+
+  * one fused QKV projection (a single large matmul) per attention layer,
+  * heads carried as a reshape of the hidden axis — XLA lays the
+    (batch*heads) attention batch onto the MXU as batched GEMMs,
+  * additive -1e9 masking (bf16-safe: bf16 shares float32's exponent
+    range) instead of boolean select chains,
+  * everything a HybridBlock, so a whole encoder traces to ONE XLA
+    program under hybridize().
+"""
+from __future__ import annotations
+
+import math
+
+from ..block import HybridBlock
+from .basic_layers import Dense, Dropout, LayerNorm
+
+__all__ = ['MultiHeadAttention', 'PositionwiseFFN', 'TransformerEncoderCell',
+           'TransformerEncoder']
+
+
+def _masked_scores(F, scores, mask):
+    """scores: (B*H, Sq, Sk); mask: (B, Sq, Sk) or (B*H, Sq, Sk) with 1 =
+    attend, 0 = block. Additive large-negative bias keeps everything one
+    fused elementwise op under XLA."""
+    neg = (1.0 - mask) * -1e9
+    return F.broadcast_add(scores, neg)
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head scaled dot-product attention.
+
+    Self-attention path uses one fused QKV projection (Dense(3*units)):
+    the three projections become a single MXU matmul. Cross-attention
+    (memory != query) uses a Q projection and a fused KV projection.
+
+    Inputs: query (B, Sq, C); memory (B, Sk, C) or None for self-attention;
+    mask (B, Sq, Sk) or None. Output: (B, Sq, units).
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError('units (%d) must be divisible by num_heads (%d)'
+                             % (units, num_heads))
+        self._units = units
+        self._num_heads = num_heads
+        with self.name_scope():
+            # in_units pinned to `units` (standard transformer: model dim in
+            # == model dim out) so the unused branch (self- vs cross-attn
+            # projections) never lingers with deferred shapes
+            self.qkv_proj = Dense(3 * units, use_bias=use_bias,
+                                  flatten=False, in_units=units,
+                                  prefix='qkv_')
+            self.q_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                in_units=units, prefix='query_')
+            self.kv_proj = Dense(2 * units, use_bias=use_bias, flatten=False,
+                                 in_units=units, prefix='kv_')
+            self.out_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                  in_units=units, prefix='out_')
+            self.attn_dropout = Dropout(dropout)
+
+    def _split_heads(self, F, x):
+        # (B, S, C) -> (B*H, S, C/H)
+        x = F.reshape(x, shape=(0, 0, self._num_heads, -1))
+        x = F.transpose(x, axes=(0, 2, 1, 3))
+        return F.reshape(x, shape=(-3, 0, 0))
+
+    def _merge_heads(self, F, x):
+        # (B*H, S, C/H) -> (B, S, C)
+        x = F.reshape(x, shape=(-4, -1, self._num_heads, 0, 0))
+        x = F.transpose(x, axes=(0, 2, 1, 3))
+        return F.reshape(x, shape=(0, 0, -3))
+
+    def hybrid_forward(self, F, query, memory=None, mask=None):
+        if memory is None:
+            qkv = self.qkv_proj(query)
+            q, k, v = F.split(qkv, num_outputs=3, axis=-1)
+        else:
+            q = self.q_proj(query)
+            kv = self.kv_proj(memory)
+            k, v = F.split(kv, num_outputs=2, axis=-1)
+        scale = 1.0 / math.sqrt(self._units // self._num_heads)
+        q = self._split_heads(F, q) * scale
+        k = self._split_heads(F, k)
+        v = self._split_heads(F, v)
+        scores = F.batch_dot(q, k, transpose_b=True)      # (B*H, Sq, Sk)
+        if mask is not None:
+            mask = F.reshape(F.broadcast_axis(
+                F.reshape(mask, shape=(-4, -1, 1, 0, 0)),
+                axis=1, size=self._num_heads), shape=(-3, 0, 0))
+            scores = _masked_scores(F, scores, mask)
+        att = F.softmax(scores, axis=-1)
+        att = self.attn_dropout(att)
+        ctx = F.batch_dot(att, v)                          # (B*H, Sq, C/H)
+        return self.out_proj(self._merge_heads(F, ctx))
+
+
+class PositionwiseFFN(HybridBlock):
+    """Position-wise feed-forward: Dense -> activation -> Dense, with
+    residual + LayerNorm handled by the encoder cell."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, activation='gelu',
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn_1 = Dense(hidden_size, activation=activation,
+                               flatten=False, prefix='ffn1_')
+            self.ffn_2 = Dense(units, flatten=False, prefix='ffn2_')
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        return self.drop(self.ffn_2(self.ffn_1(x)))
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-norm (BERT-style) encoder cell:
+    x = LN(x + Dropout(MHA(x))); x = LN(x + FFN(x))."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 activation='gelu', layer_norm_eps=1e-12, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout,
+                                                prefix='attn_')
+            self.attn_drop = Dropout(dropout)
+            self.ln_attn = LayerNorm(epsilon=layer_norm_eps,
+                                     prefix='ln_attn_')
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                       activation=activation, prefix='ffn_')
+            self.ln_ffn = LayerNorm(epsilon=layer_norm_eps, prefix='ln_ffn_')
+
+    def hybrid_forward(self, F, x, mask=None):
+        att = self.attention(x, None, mask)
+        x = self.ln_attn(x + self.attn_drop(att))
+        return self.ln_ffn(x + self.ffn(x))
+
+
+class TransformerEncoder(HybridBlock):
+    """Stack of encoder cells. Input (B, S, C), optional valid_length (B,)
+    from which the (B, S, S) self-attention mask is built in-graph."""
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, activation='gelu', layer_norm_eps=1e-12,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._num_layers = num_layers
+        with self.name_scope():
+            self.cells = []
+            for i in range(num_layers):
+                cell = TransformerEncoderCell(
+                    units, hidden_size, num_heads, dropout=dropout,
+                    activation=activation, layer_norm_eps=layer_norm_eps,
+                    prefix='layer%d_' % i)
+                self.register_child(cell)
+                self.cells.append(cell)
+
+    @staticmethod
+    def make_mask(F, x, valid_length):
+        """(B, S, S) mask: position j attendable iff j < valid_length[b].
+        Built from arange_like so it traces in both frontends."""
+        steps = F._contrib_arange_like(x, axis=1)            # (S,)
+        mask1d = F.broadcast_lesser(
+            F.reshape(steps, shape=(1, -1)),
+            F.reshape(valid_length, shape=(-1, 1)))          # (B, S)
+        # keys beyond valid_length are blocked for every query row
+        return F.broadcast_mul(
+            F.expand_dims(mask1d, axis=1),
+            F.expand_dims(F.ones_like(mask1d), axis=2))      # (B, S, S)
+
+    def hybrid_forward(self, F, x, valid_length=None):
+        mask = None
+        if valid_length is not None:
+            mask = self.make_mask(F, x, valid_length)
+        for cell in self.cells:
+            x = cell(x, mask)
+        return x

@@ -189,7 +189,8 @@ def pooling(data, *, kernel=None, pool_type='max', global_pool=False,
 @register('Activation')
 def activation(data, *, act_type='relu'):
     fns = {'relu': jax.nn.relu, 'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh,
-           'softrelu': jax.nn.softplus, 'softsign': jax.nn.soft_sign}
+           'softrelu': jax.nn.softplus, 'softsign': jax.nn.soft_sign,
+           'gelu': lambda x: jax.nn.gelu(x, approximate=False)}
     return fns[act_type](data)
 
 

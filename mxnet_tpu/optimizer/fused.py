@@ -116,6 +116,34 @@ class _HyperPatch:
             setattr(self._opt, name, val)
 
 
+def apply_traced_updates(opt, indices, weights, grads, templates,
+                         state_leaves, skip=()):
+    """Shared traced-update protocol: run opt.update_multi_precision over
+    tracer-backed NDArrays for every parameter, returning (new_weight_
+    arrays, new_leaf_arrays). Callers wrap this in _HyperPatch +
+    key_override. ``skip`` lists positions to leave untouched (grad_req=
+    'null'). Keeping this in ONE place means dtype-pinning rules stay in
+    sync between FusedUpdater (single-chip Trainer) and ParallelTrainer
+    (mesh pjit step)."""
+    new_w = list(weights)
+    new_leaves = list(state_leaves)
+    for pos, idx in enumerate(indices):
+        if pos in skip:
+            continue
+        w_nd = NDArray(weights[pos])
+        g_nd = NDArray(grads[pos])
+        state = _rebuild_state(templates[pos], new_leaves)
+        opt.update_multi_precision(idx, w_nd, g_nd, state)
+        # traced f32 hypers promote bf16 math to f32 (python floats are
+        # weak-typed, traced scalars are not): pin outputs back to the
+        # stored dtypes
+        new_w[pos] = w_nd._data.astype(weights[pos].dtype)
+        _state_leaf_arrays(templates[pos], state, new_leaves)
+    new_leaves = [a.astype(old.dtype)
+                  for a, old in zip(new_leaves, state_leaves)]
+    return new_w, new_leaves
+
+
 class FusedUpdater:
     """Applies optimizer updates for a whole parameter list in one jitted,
     donated XLA program. Shares state storage with a plain Updater so
@@ -130,24 +158,12 @@ class FusedUpdater:
 
     def _build(self, indices, templates):
         opt = self.optimizer
-        n = len(indices)
 
         def fused(key, weights, grads, state_leaves, lrs, wds, ts, rescale):
             with _random.key_override(key), \
                     _HyperPatch(opt, indices, lrs, wds, ts, rescale):
-                new_w, new_leaves = [], list(state_leaves)
-                for i in range(n):
-                    w_nd = NDArray(weights[i])
-                    g_nd = NDArray(grads[i])
-                    state = _rebuild_state(templates[i], new_leaves)
-                    opt.update_multi_precision(indices[i], w_nd, g_nd, state)
-                    # traced f32 hypers promote bf16 math to f32 (python
-                    # floats are weak-typed, traced scalars are not): pin
-                    # outputs back to the stored dtypes
-                    new_w.append(w_nd._data.astype(weights[i].dtype))
-                    _state_leaf_arrays(templates[i], state, new_leaves)
-                new_leaves = [a.astype(old.dtype)
-                              for a, old in zip(new_leaves, state_leaves)]
+                new_w, new_leaves = apply_traced_updates(
+                    opt, indices, weights, grads, templates, state_leaves)
             return new_w, new_leaves
 
         donate = (1, 3) if jax.default_backend() != 'cpu' else ()

@@ -7,6 +7,10 @@ psum over 'dp'), and the optimizer update run on-device under GSPMD.
 Notably sync-BatchNorm falls out for free: batch statistics are computed on
 the logical (global) batch (vs the reference's dedicated
 contrib/sync_batch_norm.cc).
+
+The optimizer update is built by tracing the optimizer's OWN update() code
+(same machinery as optimizer.fused.FusedUpdater), so the full optimizer zoo
+runs under the mesh — not a hardcoded sgd/adam pair.
 """
 from __future__ import annotations
 
@@ -17,7 +21,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import autograd
 from .. import random as _random
 from ..ndarray import NDArray
-from ..ops import registry as _op_registry
 from .mesh import current_mesh
 from .sharding import ShardingRules, infer_param_sharding
 
@@ -42,7 +45,8 @@ def pure_forward_fn(block, training=True):
         prev_train = autograd.set_training(training)
         try:
             with _random.key_override(key), _TraceScope() as scope:
-                nd_in = [NDArray(a) for a in input_arrays]
+                nd_in = [NDArray(a) if a is not None else None
+                         for a in input_arrays]
                 nd_params = [NDArray(a) for a in param_arrays]
                 for p, v in zip(params, nd_params):
                     p._trace_data = v
@@ -62,15 +66,8 @@ def pure_forward_fn(block, training=True):
     return fn, meta, params
 
 
-def _sgd_mom_kernel(w, g, m, lr, momentum, wd, rescale):
-    fn = _op_registry.get('sgd_mom_update').fn
-    return fn(w, g, m, lr=lr, momentum=momentum, wd=wd, rescale_grad=rescale)
-
-
-def _adam_kernel(w, g, mean, var, lr, beta1, beta2, eps, wd, rescale):
-    fn = _op_registry.get('adam_update').fn
-    return fn(w, g, mean, var, lr=lr, wd=wd, rescale_grad=rescale,
-              beta1=beta1, beta2=beta2, epsilon=eps)
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
 class ParallelTrainer:
@@ -81,6 +78,16 @@ class ParallelTrainer:
         pt = ParallelTrainer(net, loss, 'sgd', {'learning_rate': 0.1}, mesh)
         loss = pt.step(x, y)     # NDArrays; sharded + compiled underneath
 
+    ``loss`` may be a Gluon loss Block (called as loss(pred, label)) or a
+    callable ``fn(outputs, labels) -> NDArray`` receiving the network's
+    outputs and the label list — multi-output models (BERT: MLM + NSP
+    heads) compose their objective there. ``x``/``y`` may each be one
+    NDArray or a list (multi-input networks).
+
+    Any registered optimizer works: the fused program is built by tracing
+    the optimizer's own update() with traced lr/wd/t/rescale scalars (the
+    FusedUpdater machinery), under the parameter shardings.
+
     vs gluon.Trainer (eager, op-at-a-time): this compiles forward+backward+
     allreduce+update into one XLA program — the CachedOp-static_alloc analog
     extended through the optimizer (reference fuses at best per-op).
@@ -88,145 +95,201 @@ class ParallelTrainer:
 
     def __init__(self, net, loss, optimizer='sgd', optimizer_params=None,
                  mesh=None, rules=None):
+        from ..optimizer import optimizer as _optmod
         self._net = net
         self._loss = loss
-        self._optimizer = optimizer
         self._opt_params = dict(optimizer_params or {})
-        self._lr = float(self._opt_params.get('learning_rate', 0.01))
         self._mesh = mesh or current_mesh()
         self._rules = rules or ShardingRules()
+        if isinstance(optimizer, str):
+            self._opt = _optmod.Optimizer.create_optimizer(
+                optimizer, **self._opt_params)
+        else:
+            self._opt = optimizer
         self._jitted = None
-        self._state = None
         self._params = None
         self._param_arrays = None
-        self._opt_state = None
+        self._state_leaves = None
+        self._templates = None
+        self._sig = None
         self.num_update = 0
 
     @property
     def learning_rate(self):
-        return self._lr
+        opt = self._opt
+        return opt.lr_scheduler(self.num_update) if opt.lr_scheduler \
+            else opt.lr
 
     def set_learning_rate(self, lr):
-        self._lr = float(lr)
+        self._opt.set_learning_rate(lr)
 
-    def _build(self, x, y):
+    def _build(self, xs, ys):
         from ..gluon.block import ensure_initialized
-        ensure_initialized(self._net, x)
+        from ..optimizer.fused import (_HyperPatch, _flatten_state,
+                                       apply_traced_updates)
+        ensure_initialized(self._net, *[NDArray(a) if a is not None else None
+                                        for a in xs])
         mesh = self._mesh
         fwd, meta, params = pure_forward_fn(self._net, training=True)
         self._params = params
-        loss_block = self._loss
-        opt = self._optimizer
-        kw = self._opt_params
-        momentum = float(kw.get('momentum', 0.0))
-        wd = float(kw.get('wd', 0.0))
+        opt = self._opt
+        opt._index_update_count = dict(opt._index_update_count)
+        if not getattr(opt, 'idx2name', None):
+            opt.idx2name = {i: p.name for i, p in enumerate(params)}
+        loss_obj = self._loss
+        n = len(params)
+        indices = list(range(n))
+        none_pat = tuple(a is None for a in xs)
+        xs_live = [a for a in xs if a is not None]
 
-        def loss_of(key, param_arrays, xx, yy):
-            outs, auxs = fwd(key, list(param_arrays), [xx])
-            pred = NDArray(outs[0])
+        def loss_of(key, param_arrays, data_arrays, label_arrays):
+            # re-insert the None placeholders (optional masks etc.) that
+            # were stripped from the jit operand list
+            full_in, it = [], iter(data_arrays)
+            for is_none in none_pat:
+                full_in.append(None if is_none else next(it))
+            outs, auxs = fwd(key, list(param_arrays), full_in)
+            nd_outs = [NDArray(o) for o in outs]
+            nd_labels = [NDArray(a) for a in label_arrays]
             prev = autograd.set_training(True)
             try:
                 with _random.key_override(key):
-                    loss = loss_block._forward_impl(pred, NDArray(yy))._data
+                    if callable(loss_obj) and not hasattr(loss_obj,
+                                                          '_forward_impl'):
+                        loss = loss_obj(
+                            nd_outs if len(nd_outs) > 1 else nd_outs[0],
+                            nd_labels if len(nd_labels) > 1 else
+                            nd_labels[0])
+                    else:
+                        loss = loss_obj._forward_impl(nd_outs[0],
+                                                      nd_labels[0])
             finally:
                 autograd.set_training(prev)
-            return jnp.mean(loss), auxs
+            return jnp.mean(loss._data), auxs
 
-        def step(key, lr, param_arrays, opt_state, xx, yy):
+        # optimizer states (created eagerly; leaves become jit operands)
+        param_arrays = tuple(p.data()._data for p in params)
+        leaves = []
+        templates = []
+        for i, (w, p) in enumerate(zip(param_arrays, params)):
+            if p.grad_req == 'null':
+                templates.append(('const', None))
+                continue
+            st = opt.create_state_multi_precision(i, NDArray(w))
+            templates.append(_flatten_state(st, leaves))
+        self._templates = templates
+        leaf_arrays = tuple(l._data for l in leaves)
+
+        def step(key, hyper, param_arrays, state_leaves, data_arrays,
+                 label_arrays):
+            lrs, wds, ts, rescale = hyper
             (loss, auxs), grads = jax.value_and_grad(
-                lambda ps: loss_of(key, ps, xx, yy), has_aux=True)(
-                    tuple(param_arrays))
-            new_params, new_state = [], []
-            for w, g, s, p in zip(param_arrays, grads, opt_state, params):
-                if p.grad_req == 'null':
-                    new_params.append(w)
-                    new_state.append(s)
-                    continue
-                if opt == 'sgd':
-                    w2, m2 = _sgd_mom_kernel(w, g, s, lr, momentum, wd, 1.0)
-                    new_params.append(w2)
-                    new_state.append(m2)
-                elif opt == 'adam':
-                    mean, var, t = s
-                    beta1 = float(kw.get('beta1', 0.9))
-                    beta2 = float(kw.get('beta2', 0.999))
-                    eps = float(kw.get('epsilon', 1e-8))
-                    t2 = t + 1
-                    corr = jnp.sqrt(1 - beta2 ** t2) / (1 - beta1 ** t2)
-                    w2, m2, v2 = _adam_kernel(w, g, mean, var, lr * corr,
-                                              beta1, beta2, eps, wd, 1.0)
-                    new_params.append(w2)
-                    new_state.append((m2, v2, t2))
-                else:
-                    raise ValueError('unsupported optimizer %s' % opt)
+                lambda ps: loss_of(key, ps, data_arrays, label_arrays),
+                has_aux=True)(tuple(param_arrays))
+            skip = {i for i in range(n) if params[i].grad_req == 'null'}
+            with _random.key_override(key), \
+                    _HyperPatch(opt, indices, lrs, wds, ts, rescale):
+                new_params, new_leaves = apply_traced_updates(
+                    opt, indices, list(param_arrays), list(grads),
+                    templates, list(state_leaves), skip=skip)
             aux_idx = {id(p): i for i, p in enumerate(params)}
             for p, a in zip(meta.get('aux_params', []), auxs):
                 i = aux_idx.get(id(p))
                 if i is not None:
                     new_params[i] = a.astype(new_params[i].dtype)
-            return tuple(new_params), tuple(new_state), loss
+            return tuple(new_params), tuple(new_leaves), loss
 
-        param_arrays = tuple(p.data()._data for p in params)
+        hyper0 = self._hyper(indices, opt, advance=False)
         # abstract probe fills meta['aux_params'] without running compute
-        jax.eval_shape(step, jax.random.PRNGKey(0), jnp.float32(0.0),
-                       param_arrays,
-                       tuple(self._opt_init(w, p)
-                             for w, p in zip(param_arrays, params)),
-                       x._data, y._data)
+        jax.eval_shape(step, jax.random.PRNGKey(0), hyper0,
+                       param_arrays, leaf_arrays, tuple(xs_live), tuple(ys))
 
         param_shardings = tuple(infer_param_sharding(params, mesh,
                                                      self._rules))
         repl = NamedSharding(mesh, P())
 
-        def state_shard(sh, s):
-            if isinstance(s, tuple):
-                return (sh, sh, repl)
-            if getattr(s, 'ndim', None) == 0:
-                return repl
-            return sh
+        # a state leaf shaped like its parameter shards like it; anything
+        # else (scalars, counters) replicates
+        def count_leaves(tt):
+            if tt[0] == 'leaf':
+                return 1
+            if tt[0] == 'seq':
+                return sum(count_leaves(s) for s in tt[2])
+            return 0
 
-        opt_state = tuple(self._opt_init(w, p)
-                          for w, p in zip(param_arrays, params))
-        opt_shardings = tuple(state_shard(sh, s)
-                              for sh, s in zip(param_shardings, opt_state))
-        dspec = [None] * x._data.ndim
-        lspec = [None] * y._data.ndim
-        if 'dp' in mesh.axis_names:
-            dspec[0] = 'dp'
-            lspec[0] = 'dp'
-        dshard = NamedSharding(mesh, P(*dspec))
-        lshard = NamedSharding(mesh, P(*lspec))
+        leaf_shardings = []
+        li = 0
+        for i, t in enumerate(templates):
+            for _ in range(count_leaves(t)):
+                leaf = leaf_arrays[li]
+                if leaf.shape == param_arrays[i].shape:
+                    leaf_shardings.append(param_shardings[i])
+                else:
+                    leaf_shardings.append(repl)
+                li += 1
+        leaf_shardings = tuple(leaf_shardings)
+
+        def dshard(a):
+            spec = [None] * a.ndim
+            if 'dp' in mesh.axis_names and a.ndim:
+                spec[0] = 'dp'
+            return NamedSharding(mesh, P(*spec))
+
+        data_shardings = tuple(dshard(a) for a in xs_live)
+        label_shardings = tuple(dshard(a) for a in ys)
+        self._sig = (none_pat, len(ys))
 
         self._jitted = jax.jit(
             step,
-            in_shardings=(repl, repl, param_shardings, opt_shardings,
-                          dshard, lshard),
-            out_shardings=(param_shardings, opt_shardings, repl),
+            in_shardings=(repl, (repl, repl, repl, repl), param_shardings,
+                          leaf_shardings, data_shardings, label_shardings),
+            out_shardings=(param_shardings, leaf_shardings, repl),
             donate_argnums=(2, 3))
-        # place params + state once with their shardings
         self._param_arrays = tuple(
             jax.device_put(w, sh) for w, sh in zip(param_arrays,
                                                    param_shardings))
-        self._opt_state = jax.device_put(opt_state, opt_shardings)
-        self._data_shardings = (dshard, lshard)
+        self._state_leaves = tuple(
+            jax.device_put(a, sh) for a, sh in zip(leaf_arrays,
+                                                   leaf_shardings))
+        self._data_shardings = (data_shardings, label_shardings)
 
-    def _opt_init(self, w, p):
-        if p.grad_req == 'null':
-            return jnp.zeros((), w.dtype)
-        if self._optimizer == 'sgd':
-            return jnp.zeros_like(w)
-        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros((), 'int32'))
+    def _hyper(self, indices, opt, advance=True):
+        """(lrs, wds, ts, rescale) traced-scalar arrays for this step."""
+        if advance:
+            for idx in indices:
+                opt._update_count(idx)
+        ts = jnp.asarray([float(opt._index_update_count.get(idx, 1))
+                          for idx in indices], dtype=jnp.float32)
+        lrs = jnp.asarray(opt._get_lrs(list(indices)), dtype=jnp.float32)
+        wds = jnp.asarray(opt._get_wds(list(indices)), dtype=jnp.float32)
+        return (lrs, wds, ts, jnp.float32(opt.rescale_grad))
 
     def step(self, x, y):
         """One fused train step; returns the (replicated) scalar loss."""
+        xs = [a._data if isinstance(a, NDArray) else
+              (None if a is None else jnp.asarray(a)) for a in _as_list(x)]
+        ys = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+              for a in _as_list(y)]
         if self._jitted is None:
-            self._build(x, y)
+            self._build(xs, ys)
+        sig = (tuple(a is None for a in xs), len(ys))
+        if sig != self._sig:
+            raise ValueError(
+                'ParallelTrainer.step called with input signature %r but '
+                'the compiled step was built for %r — input/label arity '
+                'and None-positions must match the first call' %
+                (sig, self._sig))
+        xs = [a for a in xs if a is not None]
+        opt = self._opt
+        indices = list(range(len(self._params)))
+        hyper = self._hyper(indices, opt, advance=True)
         key = _random.next_key()
-        xd = jax.device_put(x._data, self._data_shardings[0])
-        yd = jax.device_put(y._data, self._data_shardings[1])
-        self._param_arrays, self._opt_state, loss = self._jitted(
-            key, jnp.float32(self._lr), self._param_arrays, self._opt_state,
-            xd, yd)
+        xd = tuple(jax.device_put(a, sh)
+                   for a, sh in zip(xs, self._data_shardings[0]))
+        yd = tuple(jax.device_put(a, sh)
+                   for a, sh in zip(ys, self._data_shardings[1]))
+        self._param_arrays, self._state_leaves, loss = self._jitted(
+            key, hyper, self._param_arrays, self._state_leaves, xd, yd)
         self.num_update += 1
         # keep the net's Parameters viewing the live sharded arrays
         for p, w in zip(self._params, self._param_arrays):

@@ -115,7 +115,7 @@ def test_batch_actually_sharded_over_dp():
     """The input batch must be laid out dp-sharded (one shard per device),
     not replicated — this is what makes the psum a real allreduce."""
     _, _, pt = _run_parallel({'dp': 8}, steps=1)
-    dshard = pt._data_shardings[0]
+    dshard = pt._data_shardings[0][0]
     x = jax.device_put(np.zeros((BATCH, 3, 8, 8), np.float32), dshard)
     assert len(x.sharding.device_set) == 8
     shard_shapes = {s.data.shape for s in x.addressable_shards}

@@ -1,0 +1,252 @@
+"""Transformer blocks + BERT (reference workload: SURVEY.md §2.6 row 3 —
+BERT-base pretraining; op anchor src/operator/contrib/transformer.cc:33)."""
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _np_mha(x, params, num_heads, mask=None):
+    """numpy oracle for self-attention with fused qkv projection."""
+    wqkv, bqkv, wo, bo = params
+    B, S, C = x.shape
+    H = num_heads
+    D = C // H
+    qkv = x @ wqkv.T + bqkv              # (B, S, 3C)
+    q, k, v = np.split(qkv, 3, axis=-1)
+
+    def split(a):
+        return a.reshape(B, S, H, D).transpose(0, 2, 1, 3)  # (B,H,S,D)
+    q, k, v = split(q) / np.sqrt(D), split(k), split(v)
+    scores = q @ k.transpose(0, 1, 3, 2)                    # (B,H,S,S)
+    if mask is not None:
+        scores = scores + (1 - mask[:, None]) * -1e9
+    att = _np_softmax(scores)
+    ctx = (att @ v).transpose(0, 2, 1, 3).reshape(B, S, C)
+    return ctx @ wo.T + bo
+
+
+def test_mha_matches_numpy_oracle():
+    B, S, C, H = 2, 5, 8, 2
+    mha = nn.MultiHeadAttention(C, H, dropout=0.0)
+    mha.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(B, S, C).astype('float32'))
+    out = mha(x).asnumpy()
+    params = (mha.qkv_proj.weight.data().asnumpy(),
+              mha.qkv_proj.bias.data().asnumpy(),
+              mha.out_proj.weight.data().asnumpy(),
+              mha.out_proj.bias.data().asnumpy())
+    expect = _np_mha(x.asnumpy(), params, H)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_mha_mask_blocks_keys():
+    """A fully-blocked key column must not influence any output row."""
+    B, S, C, H = 1, 4, 8, 2
+    mha = nn.MultiHeadAttention(C, H, dropout=0.0)
+    mha.initialize(mx.init.Xavier())
+    rs = np.random.RandomState(1)
+    x = rs.randn(B, S, C).astype('float32')
+    mask = np.ones((B, S, S), np.float32)
+    mask[:, :, -1] = 0  # block last key
+    out1 = mha(nd.array(x), None, nd.array(mask)).asnumpy()
+    x2 = x.copy()
+    x2[:, -1] = rs.randn(C)  # perturb the blocked position
+    out2 = mha(nd.array(x2), None, nd.array(mask)).asnumpy()
+    # rows 0..S-2 must be identical; only the perturbed row's own query
+    # (which still attends to other keys) may change
+    np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_mha_cross_attention():
+    B, Sq, Sk, C, H = 2, 3, 5, 8, 2
+    mha = nn.MultiHeadAttention(C, H, dropout=0.0)
+    mha.initialize(mx.init.Xavier())
+    q = nd.array(np.random.randn(B, Sq, C).astype('float32'))
+    mem = nd.array(np.random.randn(B, Sk, C).astype('float32'))
+    out = mha(q, mem)
+    assert out.shape == (B, Sq, C)
+
+
+def test_encoder_cell_grad_flows():
+    cell = nn.TransformerEncoderCell(8, 16, 2, dropout=0.0)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(2, 4, 8).astype('float32'))
+    x.attach_grad()
+    with autograd.record():
+        y = cell(x).sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_encoder_valid_length_mask():
+    """Positions beyond valid_length must not affect earlier outputs."""
+    enc = nn.TransformerEncoder(2, 8, 16, 2, dropout=0.0)
+    enc.initialize(mx.init.Xavier())
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 6, 8).astype('float32')
+    vl = nd.array(np.array([4.0]))
+    out1 = enc(nd.array(x), vl).asnumpy()
+    x2 = x.copy()
+    x2[:, 4:] = rs.randn(2, 8)
+    out2 = enc(nd.array(x2), vl).asnumpy()
+    np.testing.assert_allclose(out1[:, :4], out2[:, :4], rtol=1e-4,
+                               atol=1e-5)
+
+
+def _tiny_bert(vocab=50, **kw):
+    cfg = dict(vocab_size=vocab, max_length=16, units=16, hidden_size=32,
+               num_layers=2, num_heads=2, dropout=0.0)
+    cfg.update(kw)
+    return bert_zoo.BERTModel(**cfg)
+
+
+def _bert_batch(vocab=50, B=2, S=8, P=2, seed=0):
+    rs = np.random.RandomState(seed)
+    return (nd.array(rs.randint(0, vocab, (B, S))),
+            nd.array(np.zeros((B, S))),
+            nd.array(np.full((B,), S, np.float32)),
+            nd.array(rs.randint(0, S, (B, P))))
+
+
+def test_bert_forward_shapes():
+    net = _tiny_bert()
+    net.initialize(mx.init.Xavier())
+    ids, tt, vl, mp = _bert_batch()
+    seq, pooled, mlm, nsp = net(ids, tt, vl, mp)
+    assert seq.shape == (2, 8, 16)
+    assert pooled.shape == (2, 16)
+    assert mlm.shape == (2, 2, 50)
+    assert nsp.shape == (2, 2)
+
+
+def test_bert_hybridize_matches_eager():
+    net = _tiny_bert()
+    net.initialize(mx.init.Xavier())
+    ids, tt, vl, mp = _bert_batch()
+    seq, pooled, mlm, nsp = net(ids, tt, vl, mp)
+    net.hybridize()
+    seq2, pooled2, mlm2, nsp2 = net(ids, tt, vl, mp)
+    np.testing.assert_allclose(seq.asnumpy(), seq2.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(mlm.asnumpy(), mlm2.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bert_decoder_weight_tied():
+    """MLM decoder must share the word-embedding weight (no separate
+    (vocab, units) decoder matrix exists)."""
+    net = _tiny_bert()
+    net.initialize(mx.init.Xavier())
+    names = list(net.collect_params().keys())
+    big = [n for n in names if net.collect_params()[n].shape == (50, 16)]
+    assert len(big) == 1, big  # only word_embed.weight
+
+
+def test_bert_pretrain_step_loss_decreases():
+    net = _tiny_bert()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    ids, tt, vl, mp = _bert_batch()
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), 'adamw',
+                       {'learning_rate': 5e-3})
+    rs = np.random.RandomState(3)
+    mlm_y = nd.array(rs.randint(0, 50, (2, 2)))
+    nsp_y = nd.array(rs.randint(0, 2, (2,)))
+    losses = []
+    for _ in range(6):
+        with autograd.record():
+            _, _, mlm_s, nsp_s = net(ids, tt, vl, mp)
+            loss = L(mlm_s.reshape((-1, 50)), mlm_y.reshape((-1,))).mean() \
+                + L(nsp_s, nsp_y).mean()
+        loss.backward()
+        tr.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_bf16_forward_backward():
+    net = _tiny_bert()
+    net.initialize(mx.init.Xavier())
+    net.cast('bfloat16')
+    net.hybridize()
+    ids, tt, vl, mp = _bert_batch()
+    with autograd.record():
+        seq, pooled, mlm, nsp = net(ids, tt, vl, mp)
+        loss = (mlm * mlm).sum() + (nsp * nsp).sum()
+    loss.backward()
+    w = net.word_embed.weight
+    assert np.isfinite(w.grad().asnumpy().astype('float32')).all()
+
+
+def test_bert_classifier():
+    base = _tiny_bert(use_decoder=False, use_classifier=False)
+    clf = bert_zoo.BERTClassifier(base, num_classes=3, dropout=0.0)
+    clf.initialize(mx.init.Xavier())
+    ids, tt, vl, _ = _bert_batch()
+    out = clf(ids, tt, vl)
+    assert out.shape == (2, 3)
+
+
+def test_bert_parallel_dp_tp_step():
+    """BERT pretraining step under a dp x tp mesh through ParallelTrainer
+    (multi-input net, composite loss, AdamW) — the VERDICT #5 'runs under
+    the dp x tp mesh' gate."""
+    devs = jax.devices('cpu')
+    mesh = parallel.create_mesh({'dp': 2, 'tp': 2}, devices=devs[:4])
+    vocab = 64
+    net = _tiny_bert(vocab=vocab, units=32, hidden_size=64)
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def pretrain_loss(outs, labels):
+        _, _, mlm_s, nsp_s = outs
+        mlm_y, nsp_y = labels
+        return L(mlm_s.reshape((-1, vocab)), mlm_y.reshape((-1,))).mean() \
+            + L(nsp_s, nsp_y).mean()
+
+    pt = parallel.ParallelTrainer(net, pretrain_loss, 'adamw',
+                                  {'learning_rate': 5e-3}, mesh)
+    rs = np.random.RandomState(4)
+    B, S, P = 4, 8, 2
+    data = [nd.array(rs.randint(0, vocab, (B, S))),
+            nd.array(np.zeros((B, S))),
+            nd.array(np.full((B,), S, np.float32)),
+            nd.array(rs.randint(0, S, (B, P)))]
+    labels = [nd.array(rs.randint(0, vocab, (B, P))),
+              nd.array(rs.randint(0, 2, (B,)))]
+    losses = [float(pt.step(data, labels).asscalar()) for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_parallel_trainer_full_optimizer_zoo():
+    """ParallelTrainer must accept any fusable registered optimizer, not
+    just sgd/adam (VERDICT weak #10)."""
+    devs = jax.devices('cpu')
+    mesh = parallel.create_mesh({'dp': 2}, devices=devs[:2])
+    for opt_name in ['rmsprop', 'adagrad', 'ftrl', 'signum', 'nag']:
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation='relu'), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        L = gluon.loss.SoftmaxCrossEntropyLoss()
+        pt = parallel.ParallelTrainer(net, L, opt_name,
+                                      {'learning_rate': 0.05}, mesh)
+        x = nd.array(np.random.RandomState(5).randn(8, 6).astype('float32'))
+        y = nd.array(np.random.RandomState(6).randint(0, 4, (8,)))
+        l0 = float(pt.step(x, y).asscalar())
+        l1 = float(pt.step(x, y).asscalar())
+        assert np.isfinite([l0, l1]).all(), opt_name

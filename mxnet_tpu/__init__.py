@@ -41,6 +41,7 @@ from . import module
 from . import module as mod
 from . import model
 from . import callback
+from . import operator
 from . import monitor
 from .monitor import Monitor
 from . import profiler

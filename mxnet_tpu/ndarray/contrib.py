@@ -37,12 +37,25 @@ def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _is_traced(nds):
+    import jax
+    return any(isinstance(x._data, jax.core.Tracer) for x in nds
+               if isinstance(x, NDArray))
+
+
 def foreach(body, data, init_states):
     """Run body over axis-0 slices of data, threading states
-    (reference: contrib.py foreach:136 / src/operator/control_flow.cc)."""
+    (reference: contrib.py foreach:136 / src/operator/control_flow.cc:486).
+
+    Eagerly this is a recorded Python loop (autograd taping per op, like
+    the reference's imperative version); under a hybridize/symbol trace it
+    lowers to ONE lax.scan — compiler-friendly loop, no unrolling."""
+    data_l = _as_list(data)
+    states_l = _as_list(init_states)
+    if _is_traced(data_l + states_l):
+        return _foreach_traced(body, data, init_states)
     states = init_states
     outputs = []
-    data_l = _as_list(data)
     n = data_l[0].shape[0]
     for i in range(n):
         eles = [d[i] for d in data_l]
@@ -55,16 +68,57 @@ def foreach(body, data, init_states):
     return out, states
 
 
+def _foreach_traced(body, data, init_states):
+    data_l = _as_list(data)
+    states_l = _as_list(init_states)
+    nd_, ns = len(data_l), len(states_l)
+    meta = {}
+
+    def body_arrays(flat, key, training):
+        # ambient trace context supplies rng/training to ops inside body
+        xs = [NDArray(a) for a in flat[:nd_]]
+        ss = [NDArray(a) for a in flat[nd_:]]
+        x_in = xs if isinstance(data, (list, tuple)) else xs[0]
+        s_in = ss if isinstance(init_states, (list, tuple)) else ss[0]
+        outs, new_s = body(x_in, s_in)
+        outs_l, new_s_l = _as_list(outs), _as_list(new_s)
+        meta['out_is_list'] = isinstance(outs, (list, tuple))
+        meta['state_is_list'] = isinstance(new_s, (list, tuple))
+        meta['num_out'] = len(outs_l)
+        return [o._data for o in outs_l] + [s._data for s in new_s_l]
+
+    res = invoke('_foreach', data_l + states_l,
+                 {'body': body_arrays, 'num_data': nd_, 'num_states': ns})
+    res = _as_list(res)
+    num_out = meta['num_out']
+    outs = res[:num_out]
+    fin = res[num_out:]
+    out = outs if meta['out_is_list'] else outs[0]
+    states = fin if meta['state_is_list'] else fin[0]
+    return out, states
+
+
 def while_loop(cond, func, loop_vars, max_iterations=None):
     """(reference: contrib.py while_loop:232). Returns (outputs, final vars);
-    outputs padded to max_iterations rows as in the reference."""
+    outputs padded to max_iterations rows as in the reference. Under a
+    trace this lowers to a masked lax.scan over max_iterations (static
+    trip count keeps shapes static and the loop differentiable)."""
+    vars_l = _as_list(loop_vars)
+    if _is_traced(vars_l):
+        if max_iterations is None:
+            raise ValueError(
+                'while_loop requires max_iterations inside hybridize/'
+                'symbol graphs (static shapes)')
+        return _while_loop_traced(cond, func, loop_vars, max_iterations)
     steps = 0
     outputs = []
+    out_is_list = None
     vars_ = _as_list(loop_vars)
     while bool(cond(*vars_)) and (max_iterations is None or
                                   steps < max_iterations):
         outs, vars_ = func(*vars_)
         vars_ = _as_list(vars_)
+        out_is_list = isinstance(outs, (list, tuple))
         outputs.append(_as_list(outs))
         steps += 1
     if not outputs:
@@ -73,21 +127,72 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     for j in range(len(outputs[0])):
         s = invoke('stack', [o[j] for o in outputs], {'axis': 0})
         if max_iterations is not None and steps < max_iterations:
-            pad = [(0, max_iterations - steps)] + [(0, 0)] * (s.ndim - 1)
-            flat = [p for pair in pad for p in pair]
-            s = invoke('Pad', [s.reshape((s.shape[0], -1)) if s.ndim < 2 else s],
-                       {'mode': 'constant', 'pad_width': flat,
-                        'constant_value': 0.0}) if s.ndim >= 2 else s
+            # zero-pad to max_iterations rows — identical shape contract
+            # to the traced masked-scan path
+            import jax.numpy as jnp
+            pad = [(0, int(max_iterations) - steps)] + \
+                  [(0, 0)] * (s.ndim - 1)
+            s = NDArray(jnp.pad(s._data, pad))
         stacked.append(s)
-    out = stacked[0] if len(stacked) == 1 else stacked
+    out = stacked if out_is_list else stacked[0]
     return out, vars_
 
 
+def _while_loop_traced(cond_fn, func, loop_vars, max_iterations):
+    vars_l = _as_list(loop_vars)
+    nv = len(vars_l)
+    meta = {}
+
+    def cond_arrays(flat, key, training):
+        vs = [NDArray(a) for a in flat[:nv]]
+        return cond_fn(*vs)._data
+
+    def body_arrays(flat, key, training):
+        vs = [NDArray(a) for a in flat[:nv]]
+        outs, new_vars = func(*vs)
+        outs_l, new_vars_l = _as_list(outs), _as_list(new_vars)
+        meta['out_is_list'] = isinstance(outs, (list, tuple))
+        meta['num_out'] = len(outs_l)
+        return [o._data for o in outs_l] + [v._data for v in new_vars_l]
+
+    res = _as_list(invoke('_while_loop', vars_l,
+                          {'cond': cond_arrays, 'body': body_arrays,
+                           'num_vars': nv,
+                           'max_iterations': int(max_iterations)}))
+    num_out = meta['num_out']
+    outs = res[:num_out]
+    fin = res[num_out:]
+    out = outs if meta['out_is_list'] else outs[0]
+    return out, fin
+
+
 def cond(pred, then_func, else_func):
-    """(reference: contrib.py cond:400)."""
+    """(reference: contrib.py cond:400). Eager picks a branch in Python;
+    under a trace this lowers to lax.cond (both branches traced, one
+    executed on device)."""
+    if isinstance(pred, NDArray) and _is_traced([pred]):
+        return _cond_traced(pred, then_func, else_func)
     if bool(pred):
         return then_func()
     return else_func()
+
+
+def _cond_traced(pred, then_func, else_func):
+    import jax
+    meta = {}
+
+    def run(fn):
+        def wrapped(_):
+            out = fn()
+            out_l = _as_list(out)
+            meta['is_list'] = isinstance(out, (list, tuple))
+            return tuple(o._data for o in out_l)
+        return wrapped
+
+    p = (pred._data != 0).reshape(())
+    res = jax.lax.cond(p, run(then_func), run(else_func), None)
+    outs = [NDArray(a) for a in res]
+    return outs if meta['is_list'] else outs[0]
 
 
 def div_sqrt_dim(data):

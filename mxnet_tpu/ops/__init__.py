@@ -15,3 +15,6 @@ from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import contrib   # noqa: F401
 from . import image     # noqa: F401
+from . import control_flow  # noqa: F401
+from . import custom     # noqa: F401
+from . import pallas_kernels  # noqa: F401

@@ -81,27 +81,17 @@ def box_iou(lhs, rhs, *, format='corner'):
 
 
 def _nms_single(boxes, scores, valid, overlap_thresh, topk):
-    """Greedy NMS over one batch element with static shapes (lax.fori_loop).
+    """Greedy NMS over one batch element with static shapes.
 
     boxes: (N,4) corner; scores: (N,); valid: (N,) bool.
     Returns keep mask (N,) after suppression, in score order semantics.
+    The suppression core is the Pallas kernel (pallas_kernels.py): O(N)
+    VMEM instead of the (N, N) IoU matrix in HBM.
     """
-    n = boxes.shape[0]
+    from .pallas_kernels import greedy_nms_keep
     order = jnp.argsort(-scores)
-    b = boxes[order]
-    v = valid[order]
-    tl = b[:, None, :2], b[None, :, :2]
-    ious = box_iou(b, b)
-
-    def body(i, keep):
-        # suppress j>i with iou>thresh if i kept
-        sup = (ious[i] > overlap_thresh) & (jnp.arange(n) > i) & keep[i] & v[i]
-        return keep & ~sup
-
-    keep = jax.lax.fori_loop(0, n if topk < 0 else min(topk, n), body,
-                             v.astype(bool))
-    inv = jnp.argsort(order)
-    return keep[inv]
+    keep = greedy_nms_keep(boxes[order], valid[order], overlap_thresh, topk)
+    return keep[jnp.argsort(order)]
 
 
 @register('_contrib_box_nms', num_inputs=1, aliases=('_contrib_nms',))
@@ -130,19 +120,10 @@ def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
         ids = x[..., id_index]
 
         def per_batch(b, s, v, cid):
-            iou = box_iou(b, b)
-            same = cid[:, None] == cid[None, :]
-            n = b.shape[0]
+            from .pallas_kernels import greedy_nms_keep
             order = jnp.argsort(-s)
-            iou_o = iou[order][:, order]
-            same_o = same[order][:, order]
-            v_o = v[order]
-
-            def body(i, keep):
-                sup = (iou_o[i] > overlap_thresh) & same_o[i] & \
-                    (jnp.arange(n) > i) & keep[i] & v_o[i]
-                return keep & ~sup
-            keep = jax.lax.fori_loop(0, n, body, v_o.astype(bool))
+            keep = greedy_nms_keep(b[order], v[order], overlap_thresh,
+                                   int(topk), cls_id=cid[order])
             return keep[jnp.argsort(order)]
         keep = jax.vmap(per_batch)(boxes, scores, valid, ids)
     else:

@@ -1,0 +1,109 @@
+"""Control-flow operators (reference: src/operator/control_flow.cc:486-534
+_foreach/_while_loop/_cond with subgraph attributes).
+
+TPU-native design: the body/cond/branch subgraphs arrive as *pure array
+functions* in the op attrs, and the ops lower straight to lax.scan /
+masked-scan / lax.cond — the XLA-traceable forms. Because the whole
+construct is one traced region, gradients flow through it via the
+enclosing jax.vjp (hybridize / symbol executor) with no hand-written
+backward, unlike the reference's LoopState machinery
+(control_flow.cc: backward via imperative re-execution).
+
+Subgraph callables use the signature fn(flat_arrays, key, training) so
+random ops get fresh fold_in keys per iteration and train-mode ops
+(Dropout) see the executor's is_train flag. Adapters that don't need
+them (the ndarray frontend, whose bodies run under the ambient trace
+context) ignore both.
+
+The while_loop is deliberately a *masked scan* over max_iterations rather
+than lax.while_loop: a static trip count keeps the program shape-static
+(XLA requirement), matches the reference's padded-output contract, and
+stays differentiable (lax.while_loop is not reverse-mode differentiable).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+@register('_foreach', num_inputs=-1, num_outputs=-1, needs_rng=True)
+def _foreach(key, args, *, body=None, num_data=1, num_states=0,
+             num_out=None, training=False, num_args=None):
+    """Scan `body` over axis-0 slices of the data inputs.
+
+    args layout: [data... , states..., captured...]; body is a pure fn
+    (flat[data_slices + states + captured], key, training) ->
+    flat[outs + new_states] (the trailing num_states entries are the new
+    states). Returns outs (stacked along axis 0) + final states.
+    """
+    data = tuple(args[:num_data])
+    states = tuple(args[num_data:num_data + num_states])
+    extras = tuple(args[num_data + num_states:])
+
+    def step(carry, xs):
+        i, states = carry
+        res = body(list(xs) + list(states) + list(extras),
+                   jax.random.fold_in(key, i), training)
+        cut = len(res) - num_states
+        return (i + 1, tuple(res[cut:])), tuple(res[:cut])
+
+    (_, final_states), ys = jax.lax.scan(step, (jnp.int32(0), states), data)
+    return tuple(ys) + tuple(final_states)
+
+
+@register('_while_loop', num_inputs=-1, num_outputs=-1, needs_rng=True)
+def _while_loop(key, args, *, cond=None, body=None, num_vars=1,
+                num_out=None, max_iterations=None, training=False,
+                num_args=None):
+    """Run `body` while `cond` holds, at most max_iterations times.
+
+    args layout: [loop_vars..., captured...]. cond: (flat[vars+captured],
+    key, training) -> scalar; body: same -> flat[outs + new_vars]. Outputs
+    are stacked over max_iterations rows; rows past termination are zero
+    (reference leaves them undefined — zeros are the deterministic
+    choice). Returns outs + final vars.
+    """
+    if max_iterations is None:
+        raise ValueError('_while_loop requires max_iterations under trace')
+    T = int(max_iterations)
+    vars0 = tuple(args[:num_vars])
+    extras = tuple(args[num_vars:])
+
+    def step(carry, i):
+        active, vars_ = carry
+        sub = jax.random.fold_in(key, i)
+        pred = cond(list(vars_) + list(extras), sub, training)
+        pred = jnp.reshape(jnp.asarray(pred) != 0, ())
+        act = jnp.logical_and(active, pred)
+        res = body(list(vars_) + list(extras), sub, training)
+        cut = len(res) - num_vars
+        outs = tuple(res[:cut])
+        new_vars = tuple(res[cut:])
+        sel_vars = tuple(jnp.where(act, nv.astype(v.dtype), v)
+                         for nv, v in zip(new_vars, vars_))
+        outs = tuple(jnp.where(act, o, jnp.zeros_like(o)) for o in outs)
+        return (act, sel_vars), outs
+
+    (_, final_vars), ys = jax.lax.scan(step, (jnp.bool_(True), vars0),
+                                       jnp.arange(T))
+    return tuple(ys) + tuple(final_vars)
+
+
+@register('_cond', num_inputs=-1, num_outputs=-1, needs_rng=True)
+def _cond(key, args, *, pred=None, then_func=None, else_func=None,
+          num_out=None, training=False, num_args=None):
+    """Evaluate pred on the inputs, then run exactly one branch via
+    lax.cond. Both branches must produce matching shapes/dtypes
+    (reference: control_flow.cc CondParam)."""
+    flat = list(args)
+    p = pred(flat, key, training)
+    p = jnp.reshape(jnp.asarray(p) != 0, ())
+    return jax.lax.cond(
+        p,
+        lambda a: tuple(then_func(list(a), key, training)),
+        lambda a: tuple(else_func(list(a), key, training)),
+        tuple(flat))

@@ -1,0 +1,155 @@
+"""The Custom op shim (reference: src/operator/custom/custom.cc:70-150).
+
+Registered here (at registry-build time) so nd.Custom/sym.Custom wrappers
+exist; the user-facing CustomOp/CustomOpProp classes live in
+mxnet_tpu.operator.
+
+Two execution paths, mirroring the reference's engine contract (custom op
+code runs on CPU-visible buffers, the engine syncs around it):
+
+  * eager: runs directly (nojit) with a hand-written pullback delegating
+    to the author's backward(); the op instance from forward is kept
+    alive for its backward, so stateful save-in-forward ops work.
+  * traced (hybridize / symbol executor): lowered through
+    jax.pure_callback with a jax.custom_vjp whose backward is a second
+    host callback. Because callbacks may replay, the traced path is
+    stateless: backward gets (in_data, out_data, out_grad) only — the
+    documented CustomOp contract.
+"""
+from __future__ import annotations
+
+import collections
+
+from .registry import register
+
+# op_type -> CustomOpProp subclass; filled by mxnet_tpu.operator.register
+CUSTOM_PROPS = {}
+
+# forward-instance registry for eager backward: id(out0 array) -> (prop, op)
+_LIVE = collections.OrderedDict()
+_LIVE_MAX = 512
+
+
+def _make(op_type, kwargs, in_shapes, in_dtypes):
+    prop = CUSTOM_PROPS[op_type](**kwargs)
+    op = prop.create_operator(None, in_shapes, in_dtypes)
+    return prop, op
+
+
+def _out_struct(prop, in_data):
+    import numpy as onp
+    in_shapes = [tuple(a.shape) for a in in_data]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    try:
+        _, out_types, _ = prop.infer_type([a.dtype for a in in_data])
+    except Exception:
+        out_types = [in_data[0].dtype] * len(out_shapes)
+    return [tuple(s) for s in out_shapes], [onp.dtype(t) for t in out_types]
+
+
+def _run_forward(prop, op, arrays, is_train):
+    """Execute the author's forward on concrete arrays -> list of arrays."""
+    from ..ndarray import NDArray, zeros as nd_zeros
+    import jax.numpy as jnp
+    n_in = len(prop.list_arguments())
+    in_data = [NDArray(jnp.asarray(a)) for a in arrays[:n_in]]
+    aux = [NDArray(jnp.asarray(a)) for a in arrays[n_in:]]
+    out_shapes, out_types = _out_struct(prop, in_data)
+    out_data = [nd_zeros(s, dtype=t) for s, t in zip(out_shapes, out_types)]
+    op.forward(is_train=is_train, req=['write'] * len(out_data),
+               in_data=in_data, out_data=out_data, aux=aux)
+    return [o._data for o in out_data]
+
+
+def _run_backward(prop, op, inputs, outputs, cts):
+    from ..ndarray import NDArray, zeros as nd_zeros
+    import jax.numpy as jnp
+    n_in = len(prop.list_arguments())
+    in_data = [NDArray(jnp.asarray(a)) for a in inputs[:n_in]]
+    aux = [NDArray(jnp.asarray(a)) for a in inputs[n_in:]]
+    out_data = [NDArray(jnp.asarray(a)) for a in outputs]
+    out_grad = [NDArray(jnp.asarray(c)) for c in cts]
+    in_grad = [nd_zeros(d.shape, dtype=d.dtype) for d in in_data]
+    op.backward(req=['write'] * n_in, out_grad=out_grad, in_data=in_data,
+                out_data=out_data, in_grad=in_grad, aux=aux)
+    gz = [g._data for g in in_grad]
+    # aux states receive no gradient
+    gz += [jnp.zeros(a.shape, a.dtype) for a in inputs[n_in:]]
+    return tuple(gz)
+
+
+def _custom_bwd(inputs, outputs, cts, *, op_type=None, **kwargs):
+    """Eager pullback: reuse the instance that ran forward (stateful ops),
+    falling back to a fresh one."""
+    live = _LIVE.pop(id(outputs[0]), None)
+    if live is None:
+        live = _make(op_type, kwargs, [tuple(a.shape) for a in inputs],
+                     [a.dtype for a in inputs])
+    prop, op = live
+    return _run_backward(prop, op, inputs, outputs, cts)
+
+
+def _traced_custom(args, op_type, kwargs):
+    """hybridize/symbol path: host callback + custom_vjp."""
+    import jax
+    import numpy as onp
+    from .. import autograd
+    is_train = autograd.is_training()
+    prop, op = _make(op_type, kwargs, [tuple(a.shape) for a in args],
+                     [a.dtype for a in args])
+    out_shapes, out_types = _out_struct(
+        prop, args[:len(prop.list_arguments())])
+    out_structs = tuple(jax.ShapeDtypeStruct(s, t)
+                        for s, t in zip(out_shapes, out_types))
+    in_structs = tuple(jax.ShapeDtypeStruct(tuple(a.shape),
+                                            onp.dtype(a.dtype))
+                       for a in args)
+    n_args, n_out = len(args), len(out_structs)
+
+    @jax.custom_vjp
+    def f(*arrs):
+        def host_fwd(*np_args):
+            p, o = _make(op_type, kwargs,
+                         [tuple(a.shape) for a in np_args],
+                         [a.dtype for a in np_args])
+            outs = _run_forward(p, o, list(np_args), is_train)
+            return tuple(onp.asarray(a) for a in outs)
+        return jax.pure_callback(host_fwd, out_structs, *arrs)
+
+    def f_fwd(*arrs):
+        outs = f(*arrs)
+        return outs, (arrs, outs)
+
+    def f_bwd(res, cts):
+        arrs, outs = res
+
+        def host_bwd(*flat):
+            ins = list(flat[:n_args])
+            os_ = list(flat[n_args:n_args + n_out])
+            cs = list(flat[n_args + n_out:])
+            p, o = _make(op_type, kwargs, [tuple(a.shape) for a in ins],
+                         [a.dtype for a in ins])
+            return tuple(onp.asarray(g)
+                         for g in _run_backward(p, o, ins, os_, cs))
+        return jax.pure_callback(host_bwd, in_structs,
+                                 *(list(arrs) + list(outs) + list(cts)))
+
+    f.defvjp(f_fwd, f_bwd)
+    outs = f(*args)
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register('Custom', num_inputs=-1, num_outputs=-1, nojit=True,
+          bwd=_custom_bwd)
+def _custom(args, *, op_type=None, **kwargs):
+    import jax
+    from .. import autograd
+    if any(isinstance(a, jax.core.Tracer) for a in args):
+        return _traced_custom(args, op_type, kwargs)
+    prop, op = _make(op_type, kwargs, [tuple(a.shape) for a in args],
+                     [a.dtype for a in args])
+    outs = _run_forward(prop, op, list(args), autograd.is_training())
+    _LIVE[id(outs[0])] = (prop, op)
+    while len(_LIVE) > _LIVE_MAX:
+        _LIVE.popitem(last=False)
+    return tuple(outs) if len(outs) > 1 else outs[0]

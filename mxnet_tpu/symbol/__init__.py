@@ -12,3 +12,5 @@ _mod = _sys.modules[__name__]
 for _name in dir(op):
     if not _name.startswith('__') and not hasattr(_mod, _name):
         setattr(_mod, _name, getattr(op, _name))
+
+from . import contrib  # noqa: E402,F401

@@ -1,0 +1,140 @@
+"""mx.sym.contrib — symbolic control flow
+(reference: python/mxnet/symbol/contrib.py foreach:92 while_loop:281
+cond:482, lowering to src/operator/control_flow.cc subgraph ops).
+
+TPU-native: body/cond subgraphs are composed as ordinary Symbols, compiled
+to pure array functions with the executor's graph evaluator, and attached
+to the _foreach/_while_loop/_cond registry ops, which lower to
+lax.scan / masked-scan / lax.cond. Outer-scope symbols referenced inside
+the body (weights) are auto-lifted as extra node inputs, like the
+reference's subgraph input-lifting pass."""
+from __future__ import annotations
+
+from ..name import NameManager
+from .symbol import Symbol, Variable, Group, _create
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _subgraph_fn(sub, formal_names):
+    """Compile Symbol `sub` into fn(flat_arrays, key, training) ->
+    list_arrays with inputs ordered as formal_names + captured; returns
+    (fn, captured_names). The key/training arrive per-iteration from the
+    control-flow op, so Dropout/random ops inside the body behave like
+    the reference's subgraph execution (aux-stat updates from BatchNorm
+    inside a loop body are discarded — a documented limitation)."""
+    from ..executor import _build_graph_fn
+    captured = [n for n in sub.list_inputs() if n not in formal_names]
+    graph_fns = {}
+    order = list(formal_names) + captured
+
+    def fn(flat, key, training):
+        training = bool(training)
+        if training not in graph_fns:
+            graph_fns[training] = _build_graph_fn(sub, training=training)
+        var_values = dict(zip(order, flat))
+        outs, _aux = graph_fns[training](var_values, key)
+        return list(outs)
+
+    return fn, captured
+
+
+def foreach(body, data, init_states, name='foreach'):
+    """Symbolic foreach (reference: symbol/contrib.py:92)."""
+    name = NameManager.current.get(name, 'foreach')
+    data_l = _as_list(data)
+    states_l = _as_list(init_states)
+    slice_vars = [Variable('%s_data%d' % (name, i))
+                  for i in range(len(data_l))]
+    state_vars = [Variable('%s_state%d' % (name, i))
+                  for i in range(len(states_l))]
+    x_in = slice_vars if isinstance(data, (list, tuple)) else slice_vars[0]
+    s_in = state_vars if isinstance(init_states, (list, tuple)) \
+        else state_vars[0]
+    outs, new_states = body(x_in, s_in)
+    outs_l, new_s_l = _as_list(outs), _as_list(new_states)
+    sub = Group(outs_l + new_s_l)
+    formals = ['%s_data%d' % (name, i) for i in range(len(data_l))] + \
+              ['%s_state%d' % (name, i) for i in range(len(states_l))]
+    fn, captured = _subgraph_fn(sub, formals)
+    sym = _create('_foreach',
+                  data_l + states_l + [Variable(c) for c in captured],
+                  {'body': fn, 'num_data': len(data_l),
+                   'num_states': len(states_l), 'num_out': len(outs_l)},
+                  name=name)
+    out_syms = [sym[i] for i in range(len(outs_l))]
+    state_syms = [sym[len(outs_l) + i] for i in range(len(new_s_l))]
+    out = out_syms if isinstance(outs, (list, tuple)) else out_syms[0]
+    states = state_syms if isinstance(new_states, (list, tuple)) \
+        else state_syms[0]
+    return out, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None,
+               name='while_loop'):
+    """Symbolic while_loop (reference: symbol/contrib.py:281)."""
+    if max_iterations is None:
+        raise ValueError('max_iterations is required for symbolic '
+                         'while_loop (static shapes)')
+    name = NameManager.current.get(name, 'while_loop')
+    vars_l = _as_list(loop_vars)
+    var_vars = [Variable('%s_var%d' % (name, i))
+                for i in range(len(vars_l))]
+    pred_sym = cond(*var_vars)
+    outs, new_vars = func(*var_vars)
+    outs_l, new_vars_l = _as_list(outs), _as_list(new_vars)
+    formals = ['%s_var%d' % (name, i) for i in range(len(vars_l))]
+    cond_fn, cond_cap = _subgraph_fn(Group([pred_sym]), formals)
+    body_fn, body_cap = _subgraph_fn(Group(outs_l + new_vars_l), formals)
+    captured = list(dict.fromkeys(cond_cap + body_cap))
+
+    def cond_arrays(flat, key, training):
+        n = len(vars_l)
+        return cond_fn(flat[:n] + [flat[n + captured.index(c)]
+                                   for c in cond_cap], key, training)[0]
+
+    def body_arrays(flat, key, training):
+        n = len(vars_l)
+        return body_fn(flat[:n] + [flat[n + captured.index(c)]
+                                   for c in body_cap], key, training)
+
+    sym = _create('_while_loop', vars_l + [Variable(c) for c in captured],
+                  {'cond': cond_arrays, 'body': body_arrays,
+                   'num_vars': len(vars_l), 'num_out': len(outs_l),
+                   'max_iterations': int(max_iterations)}, name=name)
+    out_syms = [sym[i] for i in range(len(outs_l))]
+    var_syms = [sym[len(outs_l) + i] for i in range(len(new_vars_l))]
+    out = out_syms if isinstance(outs, (list, tuple)) else out_syms[0]
+    return out, var_syms
+
+
+def cond(pred, then_func, else_func, inputs=None, name='cond'):
+    """Symbolic cond (reference: symbol/contrib.py:482). then/else are
+    zero-arg functions over outer-scope symbols; their subgraph inputs are
+    auto-lifted."""
+    name = NameManager.current.get(name, 'cond')
+    then_out = then_func()
+    else_out = else_func()
+    then_l, else_l = _as_list(then_out), _as_list(else_out)
+    if len(then_l) != len(else_l):
+        raise ValueError('then_func and else_func must return the same '
+                         'number of outputs')
+    pred_fn, pred_cap = _subgraph_fn(Group([pred]), [])
+    then_fn, then_cap = _subgraph_fn(Group(then_l), [])
+    else_fn, else_cap = _subgraph_fn(Group(else_l), [])
+    captured = list(dict.fromkeys(pred_cap + then_cap + else_cap))
+
+    def pick(cap):
+        idx = [captured.index(c) for c in cap]
+        return lambda flat: [flat[i] for i in idx]
+
+    psel, tsel, esel = pick(pred_cap), pick(then_cap), pick(else_cap)
+    sym = _create('_cond', [Variable(c) for c in captured],
+                  {'pred': lambda f, k, t: pred_fn(psel(f), k, t)[0],
+                   'then_func': lambda f, k, t: then_fn(tsel(f), k, t),
+                   'else_func': lambda f, k, t: else_fn(esel(f), k, t),
+                   'num_out': len(then_l)}, name=name)
+    outs = [sym[i] for i in range(len(then_l))]
+    return outs if isinstance(then_out, (list, tuple)) else outs[0]

@@ -36,6 +36,12 @@ def num_outputs_of(op, attrs):
         return 2 if attrs.get('ret_typ') == 'both' else 1
     if op.name.startswith('BatchNorm'):
         return 3
+    if op.name == '_foreach':
+        return int(attrs['num_out']) + int(attrs['num_states'])
+    if op.name == '_while_loop':
+        return int(attrs['num_out']) + int(attrs['num_vars'])
+    if op.name == '_cond':
+        return int(attrs['num_out'])
     if op.num_outputs and op.num_outputs > 0:
         return op.num_outputs
     return 1

@@ -1,0 +1,353 @@
+"""Control flow ops, CustomOp escape hatch, Pallas NMS kernel
+(reference: src/operator/control_flow.cc:486-534, custom/custom.cc:70-150,
+bounding_box-inl.h NMSFastKernel)."""
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+# ---------------------------------------------------------------------------
+# foreach
+# ---------------------------------------------------------------------------
+
+def _cumsum_body(x, s):
+    return x + s, x + s
+
+
+def test_foreach_eager_matches_numpy():
+    x = nd.array(np.arange(12.).reshape(3, 4))
+    out, fin = nd.contrib.foreach(_cumsum_body, x, nd.zeros((4,)))
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.cumsum(x.asnumpy(), axis=0))
+    np.testing.assert_allclose(fin.asnumpy(), x.asnumpy().sum(0))
+
+
+class _ForeachBlock(nn.HybridBlock):
+    def hybrid_forward(self, F, x, s):
+        return F.contrib.foreach(_cumsum_body, x, s)
+
+
+def test_foreach_hybridized_lowers_to_scan():
+    net = _ForeachBlock()
+    net.hybridize()
+    x = nd.array(np.arange(12.).reshape(3, 4))
+    out, fin = net(x, nd.zeros((4,)))
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.cumsum(x.asnumpy(), axis=0))
+
+
+def test_foreach_hybridized_gradient():
+    net = _ForeachBlock()
+    net.hybridize()
+    x = nd.array(np.ones((3, 4)))
+    x.attach_grad()
+    with autograd.record():
+        out, fin = net(x, nd.zeros((4,)))
+        loss = (fin * fin).sum()
+    loss.backward()
+    # fin = sum of rows; d loss / dx_ij = 2 * fin_j = 2*3 = 6
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((3, 4), 6.0))
+
+
+def test_foreach_multi_data_multi_state():
+    a = nd.array(np.arange(6.).reshape(3, 2))
+    b = nd.array(np.ones((3, 2)))
+
+    def body(xs, ss):
+        x0, x1 = xs
+        s0, s1 = ss
+        return [x0 + s0, x1 * 2], [s0 + x0, s1 + 1]
+
+    out, states = nd.contrib.foreach(body, [a, b], [nd.zeros((2,)),
+                                                    nd.zeros((2,))])
+    assert out[0].shape == (3, 2) and out[1].shape == (3, 2)
+    np.testing.assert_allclose(states[0].asnumpy(), a.asnumpy().sum(0))
+    np.testing.assert_allclose(states[1].asnumpy(), [3., 3.])
+
+
+def test_foreach_symbol():
+    data = mx.sym.Variable('data')
+    s0 = mx.sym.Variable('s0')
+    out, fin = mx.sym.contrib.foreach(_cumsum_body, data, s0)
+    g = mx.sym.Group([out, fin])
+    ex = g.bind(mx.cpu(), args={'data': nd.array(np.arange(6.).reshape(3, 2)),
+                                's0': nd.zeros((2,))})
+    o, f = ex.forward()
+    np.testing.assert_allclose(
+        o.asnumpy(), np.cumsum(np.arange(6.).reshape(3, 2), axis=0))
+    np.testing.assert_allclose(f.asnumpy(), [6., 9.])
+
+
+def test_foreach_symbol_captures_outer_weight():
+    data = mx.sym.Variable('data')
+    s0 = mx.sym.Variable('s0')
+    w = mx.sym.Variable('w')
+
+    def body(x, s):
+        y = x * w + s
+        return y, y
+
+    out, fin = mx.sym.contrib.foreach(body, data, s0)
+    ex = out.bind(mx.cpu(), args={
+        'data': nd.array(np.ones((2, 3))), 's0': nd.zeros((3,)),
+        'w': nd.array(np.full((3,), 2.0))})
+    o = ex.forward()[0]
+    np.testing.assert_allclose(o.asnumpy(), [[2., 2., 2.], [4., 4., 4.]])
+
+
+# ---------------------------------------------------------------------------
+# while_loop / cond
+# ---------------------------------------------------------------------------
+
+class _WhileBlock(nn.HybridBlock):
+    def hybrid_forward(self, F, x):
+        out, vars_ = F.contrib.while_loop(
+            lambda i, s: i < 3,
+            lambda i, s: (s + x, (i + 1, s + x)),
+            (nd.zeros(()), x), max_iterations=5)
+        return out, vars_[1]
+
+
+def test_while_loop_hybridized():
+    net = _WhileBlock()
+    net.hybridize()
+    out, s = net(nd.array(np.ones(2)))
+    # 3 iterations executed, rows 3-4 zero-padded
+    np.testing.assert_allclose(out.asnumpy()[:3],
+                               [[2., 2.], [3., 3.], [4., 4.]])
+    np.testing.assert_allclose(out.asnumpy()[3:], 0.0)
+    np.testing.assert_allclose(s.asnumpy(), [4., 4.])
+
+
+def test_while_loop_eager_no_max_iterations():
+    i = nd.array([0.0])
+    out, vars_ = nd.contrib.while_loop(
+        lambda i: i < 4, lambda i: (i * 2, [i + 1]), [i])
+    assert vars_[0].asscalar() == 4.0
+
+
+class _CondBlock(nn.HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.contrib.cond(x.sum() > 0, lambda: x * 2, lambda: x * -1)
+
+
+def test_cond_hybridized_both_branches():
+    net = _CondBlock()
+    net.hybridize()
+    np.testing.assert_allclose(net(nd.array([1., 2.])).asnumpy(), [2., 4.])
+    np.testing.assert_allclose(net(nd.array([-1., -2.])).asnumpy(),
+                               [1., 2.])
+
+
+def test_cond_symbol():
+    x = mx.sym.Variable('x')
+    out = mx.sym.contrib.cond(mx.sym.sum(x) > 0,
+                              lambda: x * 2, lambda: x * -1)
+    ex = out.bind(mx.cpu(), args={'x': nd.array([3., -1.])})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [6., -2.])
+
+
+def test_while_loop_symbol():
+    x = mx.sym.Variable('x')
+    out, vars_ = mx.sym.contrib.while_loop(
+        lambda i: i < 2, lambda i: (i * 10, [i + 1]), [x],
+        max_iterations=4)
+    ex = out.bind(mx.cpu(), args={'x': nd.array([0.0])})
+    o = ex.forward()[0]
+    np.testing.assert_allclose(o.asnumpy()[:2], [[0.], [10.]])
+
+
+# ---------------------------------------------------------------------------
+# CustomOp
+# ---------------------------------------------------------------------------
+
+class _SigmoidOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], nd.array(1 / (1 + np.exp(-x))))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        gy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], nd.array(gy * y * (1 - y)))
+
+
+@mx.operator.register('test_sigmoid')
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, shapes, dtypes):
+        return _SigmoidOp()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type='test_sigmoid')
+        y.sum().backward()
+    expect = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), expect, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect * (1 - expect),
+                               rtol=1e-6)
+
+
+def test_custom_op_registered_listing():
+    assert 'test_sigmoid' in mx.operator.get_all_registered_operators()
+
+
+def test_custom_op_symbolic():
+    """sym.Custom must run under the jitted executor (pure_callback) with
+    a working backward (custom_vjp over a host callback)."""
+    x = mx.sym.Variable('x')
+    y = mx.sym.Custom(x, op_type='test_sigmoid')
+    loss = mx.sym.sum(y)
+    args = {'x': nd.array([0.0, 2.0])}
+    grads = {'x': nd.zeros((2,))}
+    ex = loss.bind(mx.cpu(), args=args, args_grad=grads)
+    out = ex.forward(is_train=True)[0]
+    ex.backward()
+    expect = 1 / (1 + np.exp(-np.array([0.0, 2.0])))
+    np.testing.assert_allclose(out.asnumpy(), expect.sum(), rtol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict['x'].asnumpy(),
+                               expect * (1 - expect), rtol=1e-5)
+
+
+def test_custom_op_hybridized():
+    class Net(nn.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Custom(x, op_type='test_sigmoid')
+    net = Net()
+    net.hybridize()
+    x = nd.array([0.5, -0.5])
+    out = net(x)
+    expect = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+
+
+def test_custom_op_stateful_forward_backward():
+    """An op saving state in forward must see that state in its eager
+    backward even when another instance ran in between."""
+    class Stateful(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.saved = float(in_data[0].asnumpy().sum())
+            self.assign(out_data[0], req[0], in_data[0] * 2)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        out_grad[0] * 0 + self.saved)
+
+    @mx.operator.register('test_stateful')
+    class StatefulProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Stateful()
+
+    a = nd.array([1.0, 2.0])
+    b = nd.array([10.0, 20.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        ya = nd.Custom(a, op_type='test_stateful')
+        yb = nd.Custom(b, op_type='test_stateful')
+        (ya.sum() + yb.sum()).backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [3.0, 3.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [30.0, 30.0])
+
+
+def test_symbol_foreach_dropout_respects_train_mode():
+    """Dropout inside a symbolic foreach body must be active under
+    is_train=True and a no-op under is_train=False."""
+    data = mx.sym.Variable('data')
+    s0 = mx.sym.Variable('s0')
+
+    def body(x, s):
+        y = mx.sym.Dropout(x, p=0.5) + s
+        return y, s
+
+    out, _ = mx.sym.contrib.foreach(body, data, s0)
+    x = np.ones((4, 64), np.float32)
+    ex = out.bind(mx.cpu(), args={'data': nd.array(x),
+                                  's0': nd.zeros((64,))})
+    infer = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(infer, 1.0)      # dropout off
+    train = ex.forward(is_train=True)[0].asnumpy()
+    assert (train == 0).any(), 'dropout silently disabled in training'
+    # and per-iteration keys differ: rows must not share a mask
+    masks = (train != 0)
+    assert not all((masks[0] == masks[i]).all() for i in range(1, 4))
+
+
+def test_while_loop_eager_hybrid_shape_parity():
+    """Eager and hybridized while_loop must return identically-shaped,
+    identically-structured outputs (zero-padded to max_iterations)."""
+    def run(i0):
+        return nd.contrib.while_loop(
+            lambda i: i < 3, lambda i: (i * 2, [i + 1]), [i0],
+            max_iterations=5)
+
+    out_e, vars_e = run(nd.array([0.0]))
+
+    class WL(nn.HybridBlock):
+        def hybrid_forward(self, F, i0):
+            return F.contrib.while_loop(
+                lambda i: i < 3, lambda i: (i * 2, [i + 1]), [i0],
+                max_iterations=5)
+    net = WL()
+    net.hybridize()
+    out_h, vars_h = net(nd.array([0.0]))
+    assert out_e.shape == out_h.shape == (5, 1)
+    np.testing.assert_allclose(out_e.asnumpy(), out_h.asnumpy())
+    np.testing.assert_allclose(vars_e[0].asnumpy(), vars_h[0].asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# Pallas NMS kernel (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+def _np_greedy_nms(boxes, valid, thresh):
+    n = len(boxes)
+    keep = valid.copy()
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    for i in range(n):
+        if not keep[i]:
+            continue
+        for j in range(i + 1, n):
+            if not keep[j]:
+                continue
+            ix1 = max(boxes[i, 0], boxes[j, 0])
+            iy1 = max(boxes[i, 1], boxes[j, 1])
+            ix2 = min(boxes[i, 2], boxes[j, 2])
+            iy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            iou = inter / (areas[i] + areas[j] - inter + 1e-12)
+            if iou > thresh:
+                keep[j] = False
+    return keep
+
+
+def test_pallas_nms_matches_numpy_reference():
+    from mxnet_tpu.ops.pallas_kernels import greedy_nms_keep
+    rs = np.random.RandomState(0)
+    xy = rs.rand(50, 2)
+    wh = rs.rand(50, 2) * 0.3
+    boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+    valid = np.ones(50, bool)
+    import jax.numpy as jnp
+    keep = np.asarray(greedy_nms_keep(jnp.asarray(boxes),
+                                      jnp.asarray(valid), 0.5))
+    expect = _np_greedy_nms(boxes, valid, 0.5)
+    np.testing.assert_array_equal(keep, expect)
+
+
+def test_box_nms_end_to_end():
+    data = np.array([[[0.9, 0.1, 0.1, 0.5, 0.5],
+                      [0.8, 0.12, 0.12, 0.52, 0.52],
+                      [0.7, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    out = nd._contrib_box_nms(nd.array(data), overlap_thresh=0.5,
+                              coord_start=1, score_index=0)
+    o = out.asnumpy()[0]
+    assert o[0, 0] == pytest.approx(0.9)      # best box kept
+    assert o[1, 0] == pytest.approx(0.7)      # non-overlapping kept
+    assert (o[2] == -1).all()                 # overlapping suppressed

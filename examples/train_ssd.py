@@ -1,0 +1,101 @@
+"""SSD detection training recipe (reference: example/ssd/train.py +
+train/train_net.py, re-expressed on the TPU-native Gluon stack).
+
+Pipeline: ImageDetRecordIter-equivalent (image.ImageDetIter over a .rec
+with packed detection headers) -> SSD HybridBlock (one XLA program) ->
+MultiBoxTarget with hard negative mining -> softmax + smooth-L1 losses ->
+fused Trainer step -> MApMetric eval.
+
+Usage: python examples/train_ssd.py --rec path/to/train.rec --classes 20
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, image, nd
+from mxnet_tpu.gluon.model_zoo import ssd as ssd_zoo
+
+
+def train(rec_path, num_classes, epochs=1, batch_size=8, data_shape=300,
+          lr=0.004, tiny=False):
+    if tiny:
+        net = ssd_zoo.SSD(num_classes,
+                          sizes=[(0.2, 0.3), (0.5, 0.6)],
+                          ratios=[(1.0, 2.0, 0.5)] * 2,
+                          base_channels=(8, 16), scale_channels=(16,))
+    else:
+        net = ssd_zoo.ssd_300(num_classes)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    tgt = ssd_zoo.MultiBoxTarget()
+    det = ssd_zoo.MultiBoxDetection()
+
+    it = image.ImageDetIter(batch_size=batch_size,
+                            data_shape=(3, data_shape, data_shape),
+                            path_imgrec=rec_path, shuffle=True,
+                            rand_mirror=True, rand_crop=0.5, rand_pad=0.5,
+                            mean=True, std=True)
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    box_loss = gluon.loss.HuberLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': lr, 'momentum': 0.9,
+                             'wd': 5e-4})
+    ncls = num_classes + 1
+    final_loss = float('nan')
+    for epoch in range(epochs):
+        it.reset()
+        while True:
+            try:
+                batch = it.next()
+            except StopIteration:
+                break
+            x = batch.data[0]
+            y = batch.label[0]
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(x)
+                loc_t, loc_m, cls_t = tgt(anchors, y, cls_preds)
+                mask = (cls_t >= 0)
+                cls_safe = nd.maximum(cls_t, nd.zeros_like(cls_t))
+                lc = cls_loss(cls_preds.reshape((-1, ncls)),
+                              cls_safe.reshape((-1,)),
+                              mask.reshape((-1, 1)))
+                lb = box_loss(box_preds * loc_m, loc_t * loc_m)
+                loss = lc.mean() + lb.mean()
+            loss.backward()
+            trainer.step(batch_size)
+            final_loss = float(loss.asscalar())
+
+    # eval pass: mAP over the training rec (demo-scale)
+    metric = mx.metric.MApMetric()
+    it.reset()
+    while True:
+        try:
+            batch = it.next()
+        except StopIteration:
+            break
+        anchors, cls_preds, box_preds = net(batch.data[0])
+        out = det(anchors, cls_preds, box_preds)
+        metric.update([batch.label[0]], [out])
+    return {'final_loss': final_loss, 'mAP': metric.get()[1]}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--rec', required=True)
+    p.add_argument('--classes', type=int, default=20)
+    p.add_argument('--epochs', type=int, default=1)
+    p.add_argument('--batch-size', type=int, default=8)
+    p.add_argument('--data-shape', type=int, default=300)
+    p.add_argument('--lr', type=float, default=0.004)
+    args = p.parse_args()
+    result = train(args.rec, args.classes, args.epochs, args.batch_size,
+                   args.data_shape, args.lr)
+    print('final loss %.4f  mAP %.4f' % (result['final_loss'],
+                                         result['mAP']))
+
+
+if __name__ == '__main__':
+    main()

@@ -42,6 +42,7 @@ from . import module as mod
 from . import model
 from . import callback
 from . import operator
+from . import image
 from . import monitor
 from .monitor import Monitor
 from . import profiler

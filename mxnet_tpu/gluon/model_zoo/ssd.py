@@ -1,0 +1,141 @@
+"""SSD single-shot detector (reference workload: example/ssd —
+symbol_builder.py + legacy_vgg16_ssd_300.py; ops
+src/operator/contrib/multibox_*.cc).
+
+TPU-first redesign of the symbol factory: one HybridBlock whose forward
+emits (anchors, class predictions, box offsets) for ALL scales as three
+static-shape tensors — the whole detector (backbone, heads, anchor
+generation) traces to a single XLA program. Anchors come from
+_contrib_MultiBoxPrior on each feature map inside the same trace, so
+there is no host-side anchor bookkeeping.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import (BatchNorm, Conv2D, HybridSequential, MaxPool2D)
+from ... import ndarray as _nd
+
+__all__ = ['SSD', 'ssd_300', 'MultiBoxTarget', 'MultiBoxDetection']
+
+
+def _conv_block(channels, num=2):
+    blk = HybridSequential()
+    with blk.name_scope():
+        for _ in range(num):
+            blk.add(Conv2D(channels, 3, padding=1, use_bias=False),
+                    BatchNorm(), )
+            blk.add(_Act())
+        blk.add(MaxPool2D(2, 2))
+    return blk
+
+
+class _Act(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.relu(x)
+
+
+class SSD(HybridBlock):
+    """Single-shot detector over a simple BN-conv backbone.
+
+    Per scale: a 3x3 class head (anchors * (num_classes+1) channels), a
+    3x3 box head (anchors * 4), and MultiBoxPrior anchors. Outputs:
+      anchors   (1, N, 4)
+      cls_preds (B, N, num_classes+1)
+      box_preds (B, N*4)
+    """
+
+    def __init__(self, num_classes, sizes, ratios, base_channels=(16, 32,
+                 64), scale_channels=(128, 128, 128), **kwargs):
+        super().__init__(**kwargs)
+        assert len(sizes) == len(ratios)
+        self.num_classes = num_classes
+        self._sizes = [tuple(s) for s in sizes]
+        self._ratios = [tuple(r) for r in ratios]
+        num_scales = len(sizes)
+        with self.name_scope():
+            self.base = HybridSequential(prefix='base_')
+            with self.base.name_scope():
+                for ch in base_channels:
+                    self.base.add(_conv_block(ch))
+            self.stages = []
+            self.cls_heads = []
+            self.box_heads = []
+            for i in range(num_scales):
+                if i > 0:
+                    ch = scale_channels[min(i - 1, len(scale_channels) - 1)]
+                    stage = _conv_block(ch)
+                    self.register_child(stage, 'stage%d' % i)
+                    self.stages.append(stage)
+                na = len(self._sizes[i]) + len(self._ratios[i]) - 1
+                cls = Conv2D(na * (num_classes + 1), 3, padding=1,
+                             prefix='cls%d_' % i)
+                box = Conv2D(na * 4, 3, padding=1, prefix='box%d_' % i)
+                self.register_child(cls, 'cls_head%d' % i)
+                self.register_child(box, 'box_head%d' % i)
+                self.cls_heads.append(cls)
+                self.box_heads.append(box)
+
+    def hybrid_forward(self, F, x):
+        feats = self.base(x)
+        anchors, cls_preds, box_preds = [], [], []
+        for i, (cls, box) in enumerate(zip(self.cls_heads,
+                                           self.box_heads)):
+            if i > 0:
+                feats = self.stages[i - 1](feats)
+            a = F._contrib_MultiBoxPrior(feats, sizes=self._sizes[i],
+                                         ratios=self._ratios[i], clip=True)
+            c = cls(feats)     # (B, na*(C+1), H, W)
+            b = box(feats)     # (B, na*4, H, W)
+            # (B, ch, H, W) -> (B, H*W*na, per-anchor) keeping anchor
+            # order identical to MultiBoxPrior's (row-major, anchor minor)
+            c = F.reshape(F.transpose(c, axes=(0, 2, 3, 1)),
+                          shape=(0, -1, self.num_classes + 1))
+            b = F.reshape(F.transpose(b, axes=(0, 2, 3, 1)),
+                          shape=(0, -1))
+            anchors.append(a)
+            cls_preds.append(c)
+            box_preds.append(b)
+        return (F.concat(*anchors, dim=1),
+                F.concat(*cls_preds, dim=1),
+                F.concat(*box_preds, dim=1))
+
+
+class MultiBoxTarget(HybridBlock):
+    """Training-target block wrapping _contrib_MultiBoxTarget."""
+
+    def __init__(self, overlap_threshold=0.5, negative_mining_ratio=3.0,
+                 variances=(0.1, 0.1, 0.2, 0.2), **kwargs):
+        super().__init__(**kwargs)
+        self._kw = dict(overlap_threshold=overlap_threshold,
+                        negative_mining_ratio=negative_mining_ratio,
+                        variances=tuple(variances))
+
+    def hybrid_forward(self, F, anchors, label, cls_preds):
+        # op wants cls_preds as (B, C+1, N)
+        cp = F.transpose(cls_preds, axes=(0, 2, 1))
+        return F._contrib_MultiBoxTarget(anchors, label, cp, **self._kw)
+
+
+class MultiBoxDetection(HybridBlock):
+    """Inference block wrapping softmax + _contrib_MultiBoxDetection."""
+
+    def __init__(self, nms_threshold=0.45, threshold=0.01, nms_topk=400,
+                 variances=(0.1, 0.1, 0.2, 0.2), **kwargs):
+        super().__init__(**kwargs)
+        self._kw = dict(nms_threshold=nms_threshold, threshold=threshold,
+                        nms_topk=nms_topk, variances=tuple(variances))
+
+    def hybrid_forward(self, F, anchors, cls_preds, box_preds):
+        probs = F.transpose(F.softmax(cls_preds, axis=-1), axes=(0, 2, 1))
+        return F._contrib_MultiBoxDetection(probs, box_preds, anchors,
+                                            **self._kw)
+
+
+def ssd_300(num_classes=20, **kwargs):
+    """SSD-300 anchor configuration (reference:
+    example/ssd/symbol_factory.py get_config('vgg16_reduced', 300)):
+    five scales with the standard size ladder."""
+    sizes = [(0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+             (0.71, 0.79)]
+    ratios = [(1.0, 2.0, 0.5)] * 2 + [(1.0, 2.0, 0.5, 3.0, 1.0 / 3)] * 3
+    return SSD(num_classes, sizes, ratios, **kwargs)

@@ -1,0 +1,6 @@
+"""mx.image — image I/O + augmentation pipeline
+(reference: python/mxnet/image/)."""
+from .image import *       # noqa: F401,F403
+from .detection import *   # noqa: F401,F403
+from . import image        # noqa: F401
+from . import detection    # noqa: F401

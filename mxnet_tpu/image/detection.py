@@ -1,0 +1,370 @@
+"""Detection augmentation + iterator (reference:
+python/mxnet/image/detection.py; C side src/io/image_det_aug_default.cc +
+iter_image_det_recordio.cc).
+
+Label format: each object is [class_id, xmin, ymin, xmax, ymax] with
+coordinates normalized to [0, 1]. On-disk (.rec or imglist) labels carry
+the reference's header: [header_width, object_width, extra..., objects...]
+— parsed once into the dense (num_obj, object_width) matrix. Batches pad
+object rows with -1 (invalid marker) so label tensors are static-shape —
+which is what the MultiBoxTarget op and the TPU both want.
+"""
+from __future__ import annotations
+
+import json
+import random as pyrandom
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from ..io.io import DataBatch, DataDesc
+from .image import (Augmenter, ImageIter, ResizeAug, ForceResizeAug,
+                    CastAug, ColorJitterAug, HueJitterAug, LightingAug,
+                    ColorNormalizeAug, RandomGrayAug, imresize, imdecode,
+                    _np)
+
+__all__ = ['DetAugmenter', 'DetBorrowAug', 'DetRandomSelectAug',
+           'DetHorizontalFlipAug', 'DetRandomCropAug', 'DetRandomPadAug',
+           'CreateDetAugmenter', 'ImageDetIter']
+
+
+class DetAugmenter:
+    """Detection augmenter: __call__(src, label) -> (src, label)
+    (reference: detection.py DetAugmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter for detection (label untouched)."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one augmenter from a list (or skip)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and box x-coordinates (reference:
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = nd.array(_np(src)[:, ::-1].copy())
+            lab = np.array(label, np.float32, copy=True)
+            valid = lab[:, 0] >= 0
+            x1 = lab[valid, 1].copy()
+            lab[valid, 1] = 1.0 - lab[valid, 3]
+            lab[valid, 3] = 1.0 - x1
+            label = lab
+        return src, label
+
+
+def _box_iou_1(crop, boxes):
+    """IoU of one crop box vs (N,4) boxes, all normalized corners."""
+    ix1 = np.maximum(crop[0], boxes[:, 0])
+    iy1 = np.maximum(crop[1], boxes[:, 1])
+    ix2 = np.minimum(crop[2], boxes[:, 2])
+    iy2 = np.minimum(crop[3], boxes[:, 3])
+    inter = np.maximum(ix2 - ix1, 0) * np.maximum(iy2 - iy1, 0)
+    a1 = (crop[2] - crop[0]) * (crop[3] - crop[1])
+    a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    return inter / np.maximum(a1 + a2 - inter, 1e-12)
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop with min-IoU constraint against ground-truth boxes
+    (SSD-style sampling; reference: DetRandomCropAug /
+    image_det_aug_default.cc)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _update_labels(self, label, crop):
+        """Clip/keep boxes vs normalized crop (x0, y0, x1, y1); drop boxes
+        with center outside or low coverage. Returns new label or None."""
+        x0, y0, x1, y1 = crop
+        w, h = x1 - x0, y1 - y0
+        lab = np.array(label, np.float32, copy=True)
+        valid = lab[:, 0] >= 0
+        if not valid.any():
+            return None
+        boxes = lab[valid, 1:5]
+        cx = (boxes[:, 0] + boxes[:, 2]) / 2
+        cy = (boxes[:, 1] + boxes[:, 3]) / 2
+        keep = (cx > x0) & (cx < x1) & (cy > y0) & (cy < y1)
+        if not keep.any():
+            return None
+        new = boxes[keep]
+        new[:, 0] = np.clip((new[:, 0] - x0) / w, 0, 1)
+        new[:, 1] = np.clip((new[:, 1] - y0) / h, 0, 1)
+        new[:, 2] = np.clip((new[:, 2] - x0) / w, 0, 1)
+        new[:, 3] = np.clip((new[:, 3] - y0) / h, 0, 1)
+        out = np.full_like(lab, -1.0)
+        out[:new.shape[0], 0] = lab[valid, 0][keep]
+        out[:new.shape[0], 1:5] = new
+        return out
+
+    def __call__(self, src, label):
+        img = _np(src)
+        h, w = img.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            cw = np.sqrt(area * ratio)
+            ch = np.sqrt(area / ratio)
+            if cw > 1 or ch > 1:
+                continue
+            cx0 = pyrandom.uniform(0, 1 - cw)
+            cy0 = pyrandom.uniform(0, 1 - ch)
+            crop = (cx0, cy0, cx0 + cw, cy0 + ch)
+            lab = np.array(label, np.float32)
+            valid = lab[:, 0] >= 0
+            if valid.any():
+                ious = _box_iou_1(np.array(crop), lab[valid, 1:5])
+                if ious.max() < self.min_object_covered:
+                    continue
+            new_label = self._update_labels(label, crop)
+            if new_label is None:
+                continue
+            x0p, y0p = int(cx0 * w), int(cy0 * h)
+            wp, hp = max(int(cw * w), 1), max(int(ch * h), 1)
+            out = nd.array(img[y0p:y0p + hp, x0p:x0p + wp].copy())
+            return out, new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Randomly expand the canvas and place the image (zoom-out aug;
+    reference: DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        img = _np(src)
+        h, w = img.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            nw = np.sqrt(area * ratio)
+            nh = np.sqrt(area / ratio)
+            if nw < 1 or nh < 1:
+                continue
+            pw, ph = int(nw * w), int(nh * h)
+            x0 = pyrandom.randint(0, pw - w)
+            y0 = pyrandom.randint(0, ph - h)
+            canvas = np.empty((ph, pw, img.shape[2]), img.dtype)
+            canvas[:] = np.asarray(self.pad_val, img.dtype)
+            canvas[y0:y0 + h, x0:x0 + w] = img
+            lab = np.array(label, np.float32, copy=True)
+            valid = lab[:, 0] >= 0
+            lab[valid, 1] = (lab[valid, 1] * w + x0) / pw
+            lab[valid, 2] = (lab[valid, 2] * h + y0) / ph
+            lab[valid, 3] = (lab[valid, 3] * w + x0) / pw
+            lab[valid, 4] = (lab[valid, 4] * h + y0) / ph
+            return nd.array(canvas), lab
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Build the SSD-style detection augmenter list
+    (reference: detection.py CreateDetAugmenter:532)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (min(area_range[0], 1.0),
+                                 min(area_range[1], 1.0)),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(area_range[0], 1.0), area_range[1]),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval,
+                                                eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference: detection.py ImageDetIter:720 /
+    iter_image_det_recordio.cc).
+
+    Emits DataBatch(data=(B,C,H,W), label=(B, max_objects, object_width))
+    with rows padded by -1."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name='data', label_name='label',
+                 last_batch_handle='pad', label_pad_value=-1.0, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        # base-class kwargs only
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=aug_list,
+                         imglist=imglist, data_name=data_name,
+                         label_name=label_name,
+                         last_batch_handle=last_batch_handle)
+        self.label_name = label_name
+        self.label_pad_value = float(label_pad_value)
+        self.max_objects, self.object_width = self._estimate_label_shape()
+
+    def _parse_label(self, label):
+        """Decode the packed detection header into (num_obj, width)
+        (reference: detection.py _parse_label)."""
+        raw = np.asarray(label, np.float32).ravel()
+        if raw.size < 3:
+            raise ValueError('label is too short for detection')
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        body = raw[header_width:]
+        n = body.size // obj_width
+        return body[:n * obj_width].reshape(n, obj_width)
+
+    def _estimate_label_shape(self):
+        """Scan (up to 100 samples) for the max object count."""
+        max_count, width = 0, 5
+        self.reset()
+        for _ in range(100):
+            try:
+                label, _ = self.next_sample()
+            except StopIteration:
+                break
+            lab = self._parse_label(label)
+            max_count = max(max_count, lab.shape[0])
+            width = lab.shape[1]
+        self.reset()
+        return max(max_count, 1), width
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.max_objects,
+                          self.object_width))]
+
+    def reshape(self, data_shape=None, label_shape=None):
+        """Change data/label shapes between epochs
+        (reference: detection.py reshape)."""
+        if data_shape is not None:
+            self.data_shape = tuple(data_shape)
+        if label_shape is not None:
+            self.max_objects = label_shape[1]
+            self.object_width = label_shape[2]
+
+    def sync_label_shape(self, it, verbose=False):
+        """Make two iterators (train/val) agree on label padding
+        (reference: detection.py sync_label_shape)."""
+        assert isinstance(it, ImageDetIter)
+        n = max(self.max_objects, it.max_objects)
+        self.max_objects = it.max_objects = n
+        return it
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = np.full((self.batch_size, self.max_objects,
+                               self.object_width), self.label_pad_value,
+                              np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = imdecode(s)
+                lab = self._parse_label(label)
+                for aug in self.auglist:
+                    img, lab = aug(img, lab)
+                arr = _np(img)
+                batch_data[i] = arr.transpose(2, 0, 1)
+                valid = lab[lab[:, 0] >= 0] if lab.ndim == 2 else lab
+                n = min(valid.shape[0], self.max_objects)
+                batch_label[i, :n] = valid[:n]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return DataBatch(data=[nd.array(batch_data)],
+                         label=[nd.array(batch_label)],
+                         pad=self.batch_size - i)

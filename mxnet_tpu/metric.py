@@ -917,3 +917,100 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+@_alias('map')
+class MApMetric(EvalMetric):
+    """Mean average precision for detection (reference: the example-tier
+    evaluate/eval_metric.py MApMetric; promoted to the core metric zoo so
+    the SSD workload has an in-tree evaluation path).
+
+    update() consumes (labels, preds) where
+      preds[0]:  (B, N, 6) rows [class_id, score, x1, y1, x2, y2]
+                 (MultiBoxDetection output; class_id < 0 = invalid)
+      labels[0]: (B, M, 5+) rows [class_id, x1, y1, x2, y2, ...]
+                 (class_id < 0 = padding)
+    AP is the area under the interpolated precision-recall curve per
+    class; get() reports the mean over classes seen in ground truth.
+    """
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name='mAP',
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        self.reset()
+
+    def reset(self):
+        # per class: list of (score, is_tp); gt counts
+        self._records = {}
+        self._gt_counts = {}
+        self.num_inst = 1
+        self.sum_metric = 0.0
+        self.global_num_inst = 1
+        self.global_sum_metric = 0.0
+
+    @staticmethod
+    def _iou(box, boxes):
+        ix1 = numpy.maximum(box[0], boxes[:, 0])
+        iy1 = numpy.maximum(box[1], boxes[:, 1])
+        ix2 = numpy.minimum(box[2], boxes[:, 2])
+        iy2 = numpy.minimum(box[3], boxes[:, 3])
+        inter = numpy.maximum(ix2 - ix1, 0) * numpy.maximum(iy2 - iy1, 0)
+        a1 = (box[2] - box[0]) * (box[3] - box[1])
+        a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / numpy.maximum(a1 + a2 - inter, 1e-12)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy() if hasattr(label, 'asnumpy') else label
+            pred = pred.asnumpy() if hasattr(pred, 'asnumpy') else pred
+            for b in range(pred.shape[0]):
+                gts = label[b]
+                gts = gts[gts[:, 0] >= 0]
+                for cid in numpy.unique(gts[:, 0]).astype(int):
+                    self._gt_counts[cid] = self._gt_counts.get(cid, 0) + \
+                        int((gts[:, 0] == cid).sum())
+                dets = pred[b]
+                dets = dets[dets[:, 0] >= 0]
+                order = numpy.argsort(-dets[:, 1])
+                matched = numpy.zeros(len(gts), bool)
+                for d in dets[order]:
+                    cid = int(d[0])
+                    rec = self._records.setdefault(cid, [])
+                    cand = numpy.where((gts[:, 0] == cid) & ~matched)[0]
+                    if len(cand):
+                        ious = self._iou(d[2:6], gts[cand][:, 1:5])
+                        j = int(numpy.argmax(ious))
+                        if ious[j] >= self.iou_thresh:
+                            matched[cand[j]] = True
+                            rec.append((float(d[1]), 1))
+                            continue
+                    rec.append((float(d[1]), 0))
+
+    def _average_precision(self, records, n_gt):
+        if not records or n_gt == 0:
+            return 0.0
+        rec = sorted(records, key=lambda r: -r[0])
+        tp = numpy.cumsum([r[1] for r in rec], dtype=numpy.float64)
+        fp = numpy.cumsum([1 - r[1] for r in rec], dtype=numpy.float64)
+        recall = tp / n_gt
+        precision = tp / numpy.maximum(tp + fp, 1e-12)
+        # integral AP with monotone-decreasing interpolated precision
+        mrec = numpy.concatenate([[0.0], recall, [1.0]])
+        mpre = numpy.concatenate([[0.0], precision, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        changed = numpy.where(mrec[1:] != mrec[:-1])[0]
+        return float(((mrec[changed + 1] - mrec[changed]) *
+                      mpre[changed + 1]).sum())
+
+    def get(self):
+        cids = sorted(self._gt_counts)
+        if not cids:
+            return self.name, float('nan')
+        aps = [self._average_precision(self._records.get(c, []),
+                                       self._gt_counts[c]) for c in cids]
+        return self.name, float(numpy.mean(aps))

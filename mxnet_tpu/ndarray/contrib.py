@@ -56,15 +56,38 @@ def foreach(body, data, init_states):
         return _foreach_traced(body, data, init_states)
     states = init_states
     outputs = []
+    out_is_list = None
     n = data_l[0].shape[0]
     for i in range(n):
         eles = [d[i] for d in data_l]
         eles = eles[0] if not isinstance(data, (list, tuple)) else eles
         outs, states = body(eles, states)
+        out_is_list = isinstance(outs, (list, tuple))
         outputs.append(_as_list(outs))
+    if n == 0:
+        # probe the body for output shapes so zero-length data returns
+        # (0, ...) arrays — same contract as lax.scan over length 0
+        import jax
+
+        def probe(*arrs):
+            xs = [NDArray(a) for a in arrs[:len(data_l)]]
+            ss = [NDArray(a) for a in arrs[len(data_l):]]
+            x_in = xs if isinstance(data, (list, tuple)) else xs[0]
+            s_in = ss if isinstance(init_states, (list, tuple)) else ss[0]
+            outs, _ = body(x_in, s_in)
+            probe.is_list = isinstance(outs, (list, tuple))
+            return [o._data for o in _as_list(outs)]
+        shapes = jax.eval_shape(
+            probe, *([d._data[0] for d in data_l] +
+                     [s._data for s in _as_list(init_states)]))
+        import jax.numpy as jnp
+        stacked = [NDArray(jnp.zeros((0,) + tuple(s.shape), s.dtype))
+                   for s in shapes]
+        out = stacked if probe.is_list else stacked[0]
+        return out, states
     stacked = [invoke('stack', [o[j] for o in outputs], {'axis': 0})
                for j in range(len(outputs[0]))]
-    out = stacked[0] if len(stacked) == 1 else stacked
+    out = stacked if out_is_list else stacked[0]
     return out, states
 
 
@@ -122,7 +145,23 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         outputs.append(_as_list(outs))
         steps += 1
     if not outputs:
-        return [], vars_
+        if max_iterations is None:
+            return [], vars_
+        # zero iterations but a static trip count was given: probe func
+        # for output shapes and return all-zero padded rows — identical
+        # contract to the traced masked scan
+        import jax
+        import jax.numpy as jnp
+
+        def probe(*arrs):
+            outs, _ = func(*[NDArray(a) for a in arrs])
+            probe.is_list = isinstance(outs, (list, tuple))
+            return [o._data for o in _as_list(outs)]
+        shapes = jax.eval_shape(probe, *[v._data for v in vars_])
+        T = int(max_iterations)
+        stacked = [NDArray(jnp.zeros((T,) + tuple(s.shape), s.dtype))
+                   for s in shapes]
+        return (stacked if probe.is_list else stacked[0]), vars_
     stacked = []
     for j in range(len(outputs[0])):
         s = invoke('stack', [o[j] for o in outputs], {'axis': 0})

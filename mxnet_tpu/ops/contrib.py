@@ -217,7 +217,7 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
     N = anchors.shape[0]
     var = jnp.asarray(variances)
 
-    def one(lab):
+    def one(lab, cp):
         valid = lab[:, 0] >= 0
         ious = box_iou(anchors, lab[:, 1:5])  # (N, M)
         ious = jnp.where(valid[None, :], ious, 0.0)
@@ -244,11 +244,28 @@ def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
         th = jnp.log(gh / ah) / var[3]
         loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
         loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
-        loc_m = jnp.where(pos[:, None], 1.0, 0.0).repeat(4, -1)[:, :4].reshape(-1)
         loc_m = jnp.broadcast_to(pos[:, None], (N, 4)).astype(jnp.float32).reshape(-1)
         cls_t = jnp.where(pos, gt[:, 0] + 1, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining (reference multibox_target.cc): rank
+            # negatives by background confidence loss, keep the hardest
+            # ratio*num_pos (at least minimum_negative_samples), mark the
+            # rest ignore_label so the loss skips them. Static shapes:
+            # the cut is a traced rank comparison, not a gather.
+            logp = jax.nn.log_softmax(cp.T, axis=-1)      # (N, C+1)
+            neg_loss = -logp[:, 0]                        # bg conf loss
+            # near-positives (overlap >= negative_mining_thresh) are
+            # excluded from mining (reference multibox_target.cc)
+            cand = (~pos) & (best_iou < negative_mining_thresh)
+            neg_loss = jnp.where(cand, neg_loss, -jnp.inf)
+            num_pos = jnp.sum(pos.astype(jnp.float32))
+            k = jnp.maximum(num_pos * negative_mining_ratio,
+                            float(minimum_negative_samples))
+            rank = jnp.argsort(jnp.argsort(-neg_loss))    # 0 = hardest
+            keep_neg = cand & (rank < k)
+            cls_t = jnp.where(pos | keep_neg, cls_t, ignore_label)
         return loc_t, loc_m, cls_t
-    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
     return loc_t, loc_m, cls_t
 
 

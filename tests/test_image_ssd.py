@@ -1,0 +1,290 @@
+"""image/ package + detection pipeline + SSD workload
+(reference: python/mxnet/image/*, src/io/image_det_aug_default.cc,
+example/ssd)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, image, nd
+from mxnet_tpu.gluon.model_zoo import ssd as ssd_zoo
+from mxnet_tpu.recordio import MXRecordIO, IRHeader, pack_img
+
+
+def _img(h=40, w=60, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, 255, (h, w, 3)).astype(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# helpers + augmenters
+# ---------------------------------------------------------------------------
+
+def test_resize_short_and_crops():
+    img = _img(40, 60)
+    out = image.resize_short(img, 32)
+    assert min(out.shape[:2]) == 32
+    crop, rect = image.center_crop(img, (20, 24))
+    assert crop.shape == (24, 20, 3)
+    crop, rect = image.random_crop(img, (20, 24))
+    assert crop.shape == (24, 20, 3)
+    x0, y0, w, h = rect
+    assert 0 <= x0 <= 60 - w and 0 <= y0 <= 40 - h
+
+
+def test_imresize_and_fixed_crop():
+    img = _img()
+    out = image.imresize(img, 30, 20)
+    assert out.shape == (20, 30, 3)
+    out = image.fixed_crop(img, 5, 5, 20, 20, size=(10, 10))
+    assert out.shape == (10, 10, 3)
+
+
+def test_augmenter_zoo_runs_and_dumps():
+    img = nd.array(_img().astype(np.float32))
+    augs = [image.BrightnessJitterAug(0.3), image.ContrastJitterAug(0.3),
+            image.SaturationJitterAug(0.3), image.HueJitterAug(0.1),
+            image.LightingAug(0.1, np.ones(3), np.ones((3, 3))),
+            image.ColorNormalizeAug([128, 128, 128], [1, 1, 1]),
+            image.RandomGrayAug(1.0), image.HorizontalFlipAug(1.0),
+            image.CastAug()]
+    for aug in augs:
+        out = aug(img)
+        assert out.shape == img.shape, type(aug).__name__
+        aug.dumps()
+
+
+def test_horizontal_flip_flips():
+    img = nd.array(_img().astype(np.float32))
+    out = image.HorizontalFlipAug(1.0)(img)
+    np.testing.assert_allclose(out.asnumpy(), img.asnumpy()[:, ::-1])
+
+
+def test_create_augmenter_pipeline():
+    augs = image.CreateAugmenter((3, 24, 24), resize=30, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1, pca_noise=0.1,
+                                 rand_gray=0.1)
+    img = nd.array(_img(), dtype='uint8')
+    for aug in augs:
+        img = aug(img)
+    assert img.shape == (24, 24, 3)
+    assert abs(float(img.asnumpy().mean())) < 20  # normalized
+
+
+# ---------------------------------------------------------------------------
+# ImageIter / ImageDetIter over a synthetic .rec
+# ---------------------------------------------------------------------------
+
+def _write_rec(path, n=8, det=False, seed=0):
+    rec = MXRecordIO(path, 'w')
+    rs = np.random.RandomState(seed)
+    for i in range(n):
+        img = rs.randint(0, 255, (32, 32, 3)).astype(np.uint8)
+        if det:
+            # one bright box per image, label [2, 5, cls, x1, y1, x2, y2]
+            cls = float(i % 3)
+            x1, y1 = rs.uniform(0.05, 0.4, 2)
+            x2, y2 = x1 + 0.3, y1 + 0.3
+            label = np.array([2, 5, cls, x1, y1, x2, y2], np.float32)
+        else:
+            label = float(i % 4)
+        s = pack_img(IRHeader(0, label, i, 0), img, quality=95)
+        rec.write(s)
+    rec.close()
+
+
+def test_image_iter_rec(tmp_path):
+    path = str(tmp_path / 'data.rec')
+    _write_rec(path, n=8)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                         path_imgrec=path, rand_crop=True,
+                         rand_mirror=True)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 28, 28)
+    assert batch.label[0].shape == (4,)
+    n_batches = 1 + sum(1 for _ in iter(it.next, None) if False)
+    it.reset()
+    count = 0
+    while True:
+        try:
+            b = it.next()
+            count += 1
+        except StopIteration:
+            break
+    assert count == 2
+
+
+def test_image_det_iter(tmp_path):
+    path = str(tmp_path / 'det.rec')
+    _write_rec(path, n=6, det=True)
+    it = image.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                            path_imgrec=path, rand_mirror=True,
+                            rand_crop=0.5, rand_pad=0.5, mean=True,
+                            std=True)
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape[0] == 3 and lab.shape[2] == 5
+    valid = lab[lab[:, :, 0] >= 0]
+    assert len(valid) >= 1
+    assert ((valid[:, 1:] >= -1e-5) & (valid[:, 1:] <= 1 + 1e-5)).all()
+
+
+def test_det_flip_updates_boxes():
+    img = nd.array(_img().astype(np.float32))
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    out, lab = image.DetHorizontalFlipAug(1.0)(img, label)
+    np.testing.assert_allclose(lab[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+
+
+def test_det_random_crop_keeps_box_valid():
+    img = nd.array(_img(64, 64).astype(np.float32))
+    label = np.array([[1, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    aug = image.DetRandomCropAug(min_object_covered=0.1,
+                                 area_range=(0.5, 1.0))
+    out, lab = aug(img, label)
+    valid = lab[lab[:, 0] >= 0]
+    assert len(valid) >= 1
+    assert (valid[:, 1:5] >= 0).all() and (valid[:, 1:5] <= 1).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    img = nd.array(_img(32, 32).astype(np.float32))
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    out, lab = image.DetRandomPadAug(area_range=(2.0, 3.0))(img, label)
+    w = lab[0, 3] - lab[0, 1]
+    h = lab[0, 4] - lab[0, 2]
+    assert w < 1.0 and h < 1.0
+
+
+# ---------------------------------------------------------------------------
+# SSD
+# ---------------------------------------------------------------------------
+
+def _tiny_ssd(num_classes=3):
+    return ssd_zoo.SSD(num_classes,
+                       sizes=[(0.2, 0.3), (0.5, 0.6)],
+                       ratios=[(1.0, 2.0, 0.5)] * 2,
+                       base_channels=(8, 16), scale_channels=(16,))
+
+
+def test_ssd_forward_shapes():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(2, 3, 32, 32).astype('float32'))
+    anchors, cls_preds, box_preds = net(x)
+    n = anchors.shape[1]
+    assert anchors.shape == (1, n, 4)
+    assert cls_preds.shape == (2, n, 4)     # 3 classes + background
+    assert box_preds.shape == (2, n * 4)
+    # 8x8 map with 4 anchors + 4x4 map with 4 anchors
+    assert n == 8 * 8 * 4 + 4 * 4 * 4
+
+
+def test_ssd_hybridize_matches_eager():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(1, 3, 32, 32).astype('float32'))
+    a1, c1, b1 = net(x)
+    net.hybridize()
+    a2, c2, b2 = net(x)
+    np.testing.assert_allclose(c1.asnumpy(), c2.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_multibox_target_assigns_positives():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(2, 3, 32, 32).astype('float32'))
+    anchors, cls_preds, box_preds = net(x)
+    label = nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.45, 0.45]], [[1, 0.5, 0.5, 0.95, 0.95]]],
+        np.float32))
+    tgt = ssd_zoo.MultiBoxTarget()
+    loc_t, loc_m, cls_t = tgt(anchors, label, cls_preds)
+    n = anchors.shape[1]
+    assert loc_t.shape == (2, n * 4)
+    assert cls_t.shape == (2, n)
+    ct = cls_t.asnumpy()
+    assert (ct[0] == 1).sum() >= 1          # class 0 -> target id 1
+    assert (ct[1] == 2).sum() >= 1
+    assert (ct == -1).sum() > 0             # hard-negative-mined ignores
+
+
+def test_ssd_train_step_loss_decreases():
+    np.random.seed(0)
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tgt = ssd_zoo.MultiBoxTarget()
+    x = nd.array(np.random.randn(2, 3, 32, 32).astype('float32'))
+    label = nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.45, 0.45]], [[1, 0.5, 0.5, 0.95, 0.95]]],
+        np.float32))
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    l1_loss = gluon.loss.HuberLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.05, 'momentum': 0.9})
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            loc_t, loc_m, cls_t = tgt(anchors, label, cls_preds)
+            mask = (cls_t >= 0)
+            cls_safe = nd.maximum(cls_t, nd.zeros_like(cls_t))
+            lc = cls_loss(cls_preds.reshape((-1, 4)),
+                          cls_safe.reshape((-1,)),
+                          mask.reshape((-1, 1)))
+            lb = l1_loss(box_preds * loc_m, loc_t * loc_m)
+            loss = lc.mean() + lb.mean()
+        loss.backward()
+        trainer.step(2)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_ssd_detection_inference():
+    net = _tiny_ssd()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.randn(1, 3, 32, 32).astype('float32'))
+    anchors, cls_preds, box_preds = net(x)
+    det = ssd_zoo.MultiBoxDetection(threshold=0.0)
+    out = det(anchors, cls_preds, box_preds)
+    o = out.asnumpy()
+    assert o.shape[0] == 1 and o.shape[2] == 6
+    kept = o[0][o[0, :, 0] >= 0]
+    assert len(kept) >= 1
+    assert (kept[:, 1] >= 0).all() and (kept[:, 1] <= 1).all()
+
+
+def test_map_metric():
+    m = mx.metric.MApMetric()
+    label = nd.array(np.array([[[0, 0.1, 0.1, 0.5, 0.5],
+                                [1, 0.6, 0.6, 0.9, 0.9]]], np.float32))
+    pred = nd.array(np.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                               [1, 0.8, 0.62, 0.62, 0.9, 0.9],
+                               [0, 0.3, 0.7, 0.7, 0.8, 0.8]]], np.float32))
+    m.update([label], [pred])
+    name, val = m.get()
+    assert name == 'mAP'
+    assert val == pytest.approx(1.0)
+    # a wrong-class detection lowers AP
+    m2 = mx.metric.MApMetric()
+    bad = nd.array(np.array([[[1, 0.9, 0.1, 0.1, 0.5, 0.5]]], np.float32))
+    m2.update([label], [bad])
+    assert m2.get()[1] < 0.5
+
+
+def test_ssd_training_script_runs(tmp_path):
+    """The end-to-end SSD-300 recipe: ImageDetIter over a .rec + multibox
+    training + MApMetric eval (VERDICT #6 done-gate)."""
+    import examples.train_ssd as ts
+    path = str(tmp_path / 'det.rec')
+    _write_rec(path, n=6, det=True)
+    result = ts.train(path, num_classes=3, epochs=2, batch_size=3,
+                      data_shape=64, tiny=True)
+    assert np.isfinite(result['final_loss'])
+    assert 0.0 <= result['mAP'] <= 1.0

@@ -1,6 +1,10 @@
-"""Samplers (reference: python/mxnet/gluon/data/sampler.py:138
-Sampler/SequentialSampler/RandomSampler/BatchSampler)."""
+"""Index samplers feeding DataLoader (behavioral parity:
+python/mxnet/gluon/data/sampler.py:138 — Sequential/Random/Filter/Batch).
+"""
 from __future__ import annotations
+
+import itertools
+import math
 
 import numpy as np
 
@@ -9,7 +13,7 @@ __all__ = ['Sampler', 'SequentialSampler', 'RandomSampler', 'FilterSampler',
 
 
 class Sampler:
-    """Abstract sampler: iterate over sample indices."""
+    """Iterable over sample indices."""
 
     def __iter__(self):
         raise NotImplementedError
@@ -19,6 +23,8 @@ class Sampler:
 
 
 class SequentialSampler(Sampler):
+    """Indices start, start+1, ..., start+length-1 in order."""
+
     def __init__(self, length, start=0):
         self._length = length
         self._start = start
@@ -31,27 +37,29 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """A fresh uniform permutation of [0, length) per epoch."""
+
     def __init__(self, length):
         self._length = length
 
     def __iter__(self):
-        indices = np.arange(self._length)
-        np.random.shuffle(indices)
-        return iter(indices)
+        yield from np.random.permutation(self._length)
 
     def __len__(self):
         return self._length
 
 
 class FilterSampler(Sampler):
-    """Indices of samples for which fn returns True."""
+    """Indices of dataset samples accepted by a predicate."""
 
     def __init__(self, fn, dataset):
         self._fn = fn
         self._dataset = dataset
-        self._indices = [i for i, sample in enumerate(dataset)
-                         if (fn(*sample) if isinstance(sample, tuple)
-                             else fn(sample))]
+        self._indices = []
+        for i, sample in enumerate(dataset):
+            ok = fn(*sample) if isinstance(sample, tuple) else fn(sample)
+            if ok:
+                self._indices.append(i)
 
     def __iter__(self):
         return iter(self._indices)
@@ -60,42 +68,45 @@ class FilterSampler(Sampler):
         return len(self._indices)
 
 
+_LAST_BATCH_MODES = ('keep', 'discard', 'rollover')
+
+
 class BatchSampler(Sampler):
-    """Wrap a sampler into batches of indices
-    (reference: sampler.py BatchSampler; last_batch keep/discard/rollover)."""
+    """Group an index sampler into fixed-size batches.
+
+    last_batch: 'keep' emits the final partial batch, 'discard' drops it,
+    'rollover' carries it into the next epoch's first batch.
+    """
 
     def __init__(self, sampler, batch_size, last_batch='keep'):
+        if last_batch not in _LAST_BATCH_MODES:
+            raise ValueError('last_batch must be one of %s, got %s'
+                             % (_LAST_BATCH_MODES, last_batch))
         self._sampler = sampler
         self._batch_size = batch_size
         self._last_batch = last_batch
-        self._prev = []
+        self._carry = []
 
     def __iter__(self):
-        batch, self._prev = self._prev, []
-        for i in self._sampler:
-            batch.append(i)
-            if len(batch) == self._batch_size:
+        bs = self._batch_size
+        stream = itertools.chain(self._carry, self._sampler)
+        self._carry = []
+        while True:
+            batch = list(itertools.islice(stream, bs))
+            if len(batch) == bs:
                 yield batch
-                batch = []
-        if batch:
-            if self._last_batch == 'keep':
-                yield batch
-            elif self._last_batch == 'discard':
-                return
-            elif self._last_batch == 'rollover':
-                self._prev = batch
-            else:
-                raise ValueError(
-                    "last_batch must be one of 'keep', 'discard', or "
-                    "'rollover', but got %s" % self._last_batch)
+                continue
+            if batch:
+                if self._last_batch == 'keep':
+                    yield batch
+                elif self._last_batch == 'rollover':
+                    self._carry = batch
+            return
 
     def __len__(self):
+        n = len(self._sampler)
         if self._last_batch == 'keep':
-            return (len(self._sampler) + self._batch_size - 1) // self._batch_size
+            return math.ceil(n / self._batch_size)
         if self._last_batch == 'discard':
-            return len(self._sampler) // self._batch_size
-        if self._last_batch == 'rollover':
-            return (len(self._prev) + len(self._sampler)) // self._batch_size
-        raise ValueError(
-            "last_batch must be one of 'keep', 'discard', or 'rollover', "
-            "but got %s" % self._last_batch)
+            return n // self._batch_size
+        return (n + len(self._carry)) // self._batch_size

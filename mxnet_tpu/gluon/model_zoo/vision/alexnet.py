@@ -1,4 +1,9 @@
-"""AlexNet (reference: python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet ("One weird trick for parallelizing CNNs", Krizhevsky 2014).
+
+Behavioral parity target: python/mxnet/gluon/model_zoo/vision/alexnet.py
+(same layer graph / parameter names via Sequential child ordering), built
+here from a declarative stage table instead of an inline add() chain.
+"""
 from __future__ import annotations
 
 __all__ = ['AlexNet', 'alexnet']
@@ -6,43 +11,44 @@ __all__ = ['AlexNet', 'alexnet']
 from ...block import HybridBlock
 from ... import nn
 
+# (channels, kernel, stride, pad, pool_after)
+_CONV_STAGES = [
+    (64, 11, 4, 2, True),
+    (192, 5, 1, 2, True),
+    (384, 3, 1, 1, False),
+    (256, 3, 1, 1, False),
+    (256, 3, 1, 1, True),
+]
+
 
 class AlexNet(HybridBlock):
-    r"""AlexNet model from "One weird trick..." (reference: alexnet.py)."""
+    """Five conv stages (pooling after 1, 2 and 5) feeding two
+    dropout-regularized 4096-wide dense layers and a linear classifier."""
 
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation='relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation='relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation='relu'))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation='relu'))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation='relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                for ch, k, s, p, pool in _CONV_STAGES:
+                    self.features.add(nn.Conv2D(ch, kernel_size=k,
+                                                strides=s, padding=p,
+                                                activation='relu'))
+                    if pool:
+                        self.features.add(nn.MaxPool2D(pool_size=3,
+                                                       strides=2))
                 self.features.add(nn.Flatten())
-                self.features.add(nn.Dense(4096, activation='relu'))
-                self.features.add(nn.Dropout(0.5))
-                self.features.add(nn.Dense(4096, activation='relu'))
-                self.features.add(nn.Dropout(0.5))
+                for _ in range(2):
+                    self.features.add(nn.Dense(4096, activation='relu'),
+                                      nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
-    r"""AlexNet constructor (reference: alexnet.py alexnet)."""
+    """Build AlexNet; ``pretrained`` loads weights from the model store."""
     net = AlexNet(**kwargs)
     if pretrained:
         from ..model_store import get_model_file

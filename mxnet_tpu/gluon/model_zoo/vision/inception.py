@@ -1,97 +1,62 @@
-"""Inception V3 (reference: python/mxnet/gluon/model_zoo/vision/inception.py)."""
-from __future__ import annotations
+"""Inception v3 ("Rethinking the Inception Architecture", Szegedy 2015).
 
-__all__ = ['Inception3', 'inception_v3']
+Behavioral parity target: python/mxnet/gluon/model_zoo/vision/inception.py
+(same layer graph / child ordering, so exported checkpoints line up).
+Structure here is declarative: every mixed block is a list of branches,
+every branch a list of conv dicts — one generic builder walks the spec.
+"""
+from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
 from .squeezenet import HybridConcurrent
 
-
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix='')
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation('relu'))
-    return out
+__all__ = ['Inception3', 'inception_v3']
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix='')
-    if use_pool == 'avg':
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == 'max':
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ['channels', 'kernel_size', 'strides', 'padding']
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
+def C(channels, kernel, strides=None, padding=None):
+    """One Conv-BN-ReLU unit spec."""
+    spec = {'channels': channels, 'kernel_size': kernel}
+    if strides is not None:
+        spec['strides'] = strides
+    if padding is not None:
+        spec['padding'] = padding
+    return spec
 
 
-def _make_A(pool_features, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, None, 1)))
-        out.add(_make_branch('avg', (pool_features, 1, None, None)))
-    return out
+def _unit(spec):
+    seq = nn.HybridSequential(prefix='')
+    seq.add(nn.Conv2D(use_bias=False, **spec),
+            nn.BatchNorm(epsilon=0.001),
+            nn.Activation('relu'))
+    return seq
 
 
-def _make_B(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, 2, None)))
-        out.add(_make_branch('max'))
-    return out
+def _chain(convs, pool=None):
+    """A branch: optional pool followed by Conv-BN-ReLU units."""
+    seq = nn.HybridSequential(prefix='')
+    if pool == 'avg':
+        seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif pool == 'max':
+        seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+    for spec in convs:
+        seq.add(_unit(spec))
+    return seq
 
 
-def _make_C(channels_7x7, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch('avg', (192, 1, None, None)))
-    return out
+class _Fork(HybridBlock):
+    """stem convs, then concat over parallel tail branches (the split
+    ends of the E blocks)."""
 
-
-def _make_D(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)),
-                             (192, 3, 2, None)))
-        out.add(_make_branch('max'))
-    return out
-
-
-class _SplitConcat(HybridBlock):
-    """branch → [sub-branches] → concat (inception E tail)."""
-
-    def __init__(self, stem_settings, sub_settings, prefix=None):
+    def __init__(self, stem, tails, prefix=None):
         super().__init__(prefix=prefix)
         with self.name_scope():
-            self.stem = _make_branch(None, *stem_settings) if stem_settings \
-                else None
+            self.stem = _chain(stem) if stem else None
+            # child named 'subs' for checkpoint-key compatibility with the
+            # previous _SplitConcat implementation
             self.subs = HybridConcurrent(axis=1, prefix='')
-            for setting in sub_settings:
-                self.subs.add(_make_branch(None, setting))
+            for t in tails:
+                self.subs.add(_chain([t]))
 
     def hybrid_forward(self, F, x):
         if self.stem is not None:
@@ -99,72 +64,103 @@ class _SplitConcat(HybridBlock):
         return self.subs(x)
 
 
-def _make_E(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
-        out.add(_SplitConcat([(384, 1, None, None)],
-                             [(384, (1, 3), None, (0, 1)),
-                              (384, (3, 1), None, (1, 0))]))
-        out.add(_SplitConcat([(448, 1, None, None), (384, 3, None, 1)],
-                             [(384, (1, 3), None, (0, 1)),
-                              (384, (3, 1), None, (1, 0))]))
-        out.add(_make_branch('avg', (192, 1, None, None)))
-    return out
+def _mixed(branches, prefix):
+    """branches: list of (pool_mode, [conv specs]) or prebuilt blocks."""
+    blk = HybridConcurrent(axis=1, prefix=prefix)
+    with blk.name_scope():
+        for br in branches:
+            if isinstance(br, HybridBlock):
+                blk.add(br)
+            else:
+                pool, convs = br
+                blk.add(_chain(convs, pool))
+    return blk
 
 
-def make_aux(classes):
-    out = nn.HybridSequential(prefix='')
-    out.add(nn.AvgPool2D(pool_size=5, strides=3))
-    out.add(_make_basic_conv(channels=128, kernel_size=1))
-    out.add(_make_basic_conv(channels=768, kernel_size=5))
-    out.add(nn.Flatten())
-    out.add(nn.Dense(classes))
-    return out
+def _block_a(pool_ch, prefix):
+    return _mixed([
+        (None, [C(64, 1)]),
+        (None, [C(48, 1), C(64, 5, padding=2)]),
+        (None, [C(64, 1), C(96, 3, padding=1), C(96, 3, padding=1)]),
+        ('avg', [C(pool_ch, 1)]),
+    ], prefix)
+
+
+def _block_b(prefix):
+    return _mixed([
+        (None, [C(384, 3, strides=2)]),
+        (None, [C(64, 1), C(96, 3, padding=1), C(96, 3, strides=2)]),
+        ('max', []),
+    ], prefix)
+
+
+def _block_c(ch7, prefix):
+    return _mixed([
+        (None, [C(192, 1)]),
+        (None, [C(ch7, 1), C(ch7, (1, 7), padding=(0, 3)),
+                C(192, (7, 1), padding=(3, 0))]),
+        (None, [C(ch7, 1), C(ch7, (7, 1), padding=(3, 0)),
+                C(ch7, (1, 7), padding=(0, 3)),
+                C(ch7, (7, 1), padding=(3, 0)),
+                C(192, (1, 7), padding=(0, 3))]),
+        ('avg', [C(192, 1)]),
+    ], prefix)
+
+
+def _block_d(prefix):
+    return _mixed([
+        (None, [C(192, 1), C(320, 3, strides=2)]),
+        (None, [C(192, 1), C(192, (1, 7), padding=(0, 3)),
+                C(192, (7, 1), padding=(3, 0)), C(192, 3, strides=2)]),
+        ('max', []),
+    ], prefix)
+
+
+def _block_e(prefix):
+    split = [C(384, (1, 3), padding=(0, 1)),
+             C(384, (3, 1), padding=(1, 0))]
+    return _mixed([
+        (None, [C(320, 1)]),
+        _Fork([C(384, 1)], split),
+        _Fork([C(448, 1), C(384, 3, padding=1)], split),
+        ('avg', [C(192, 1)]),
+    ], prefix)
 
 
 class Inception3(HybridBlock):
-    r"""Inception v3 from "Rethinking the Inception Architecture..."
-    (reference: inception.py Inception3)."""
+    """Inception v3: conv stem, 3xA, B, 4xC, D, 2xE, global pool."""
 
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix='')
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
-                                               strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3,
-                                               padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, 'A1_'))
-            self.features.add(_make_A(64, 'A2_'))
-            self.features.add(_make_A(64, 'A3_'))
-            self.features.add(_make_B('B_'))
-            self.features.add(_make_C(128, 'C1_'))
-            self.features.add(_make_C(160, 'C2_'))
-            self.features.add(_make_C(160, 'C3_'))
-            self.features.add(_make_C(192, 'C4_'))
-            self.features.add(_make_D('D_'))
-            self.features.add(_make_E('E1_'))
-            self.features.add(_make_E('E2_'))
-            self.features.add(nn.AvgPool2D(pool_size=8))
-            self.features.add(nn.Dropout(0.5))
+            f = nn.HybridSequential(prefix='')
+            for spec in (C(32, 3, strides=2), C(32, 3),
+                         C(64, 3, padding=1)):
+                f.add(_unit(spec))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2))
+            for spec in (C(80, 1), C(192, 3)):
+                f.add(_unit(spec))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2))
+            f.add(_block_a(32, 'A1_'), _block_a(64, 'A2_'),
+                  _block_a(64, 'A3_'))
+            f.add(_block_b('B_'))
+            for i, ch7 in enumerate((128, 160, 160, 192)):
+                f.add(_block_c(ch7, 'C%d_' % (i + 1)))
+            f.add(_block_d('D_'))
+            f.add(_block_e('E1_'), _block_e('E2_'))
+            f.add(nn.AvgPool2D(pool_size=8), nn.Dropout(0.5))
+            self.features = f
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
-    r"""Inception v3 constructor (reference: inception.py)."""
+    """Build Inception v3; ``pretrained`` loads model-store weights."""
     net = Inception3(**kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        net.load_parameters(get_model_file('inceptionv3', root=root), ctx=ctx)
+        net.load_parameters(get_model_file('inceptionv3', root=root),
+                            ctx=ctx)
     return net

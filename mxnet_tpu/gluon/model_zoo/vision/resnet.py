@@ -1,11 +1,19 @@
-"""ResNet V1/V2 (reference: python/mxnet/gluon/model_zoo/vision/resnet.py:542
-— resnet18_v1 .. resnet152_v2).
+"""ResNet v1 (post-activation) and v2 (pre-activation), depths 18-152.
 
-TPU notes: NCHW layout feeds lax.conv_general_dilated which XLA tiles onto
-the MXU; BatchNorm+ReLU fuse into the conv epilogue under jit. bf16 training
-is enabled by net.cast('bfloat16') — BatchNorm keeps fp32 stats.
+Behavioral parity target: python/mxnet/gluon/model_zoo/vision/resnet.py:542
+(resnet18_v1 .. resnet152_v2, same factory surface). Implemented as ONE
+residual cell parameterized by (bottleneck, pre-activation) and ONE stack
+builder — the reference's four block classes survive as thin flag-pinning
+subclasses for API compatibility.
+
+TPU notes: NCHW feeds lax.conv_general_dilated which XLA tiles onto the
+MXU; BatchNorm+ReLU fuse into the conv epilogue under jit; bf16 training
+via net.cast('bfloat16') keeps fp32 BN stats.
 """
 from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
 
 __all__ = ['ResNetV1', 'ResNetV2', 'BasicBlockV1', 'BasicBlockV2',
            'BottleneckV1', 'BottleneckV2', 'resnet18_v1', 'resnet34_v1',
@@ -13,263 +21,179 @@ __all__ = ['ResNetV1', 'ResNetV2', 'BasicBlockV1', 'BasicBlockV2',
            'resnet34_v2', 'resnet50_v2', 'resnet101_v2', 'resnet152_v2',
            'get_resnet']
 
-from ...block import HybridBlock
-from ... import nn
 
+class _ResidualCell(HybridBlock):
+    """One residual unit covering all four reference variants.
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
-
-
-class BasicBlockV1(HybridBlock):
-    r"""BasicBlock V1 from "Deep Residual Learning for Image Recognition"
-    (reference: model_zoo/vision/resnet.py BasicBlockV1)."""
+    bottleneck: 1x1 -> 3x3 -> 1x1 (channels//4 inner) vs two 3x3 convs.
+    preact (v2): BN-ReLU precedes convs and the shortcut taps the
+    pre-activated tensor; post-act (v1): conv-BN pairs with ReLU on the
+    summed output.
+    """
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
+                 bottleneck=False, preact=False, **kwargs):
         super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix='')
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation('relu'))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix='')
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
+        self._preact = preact
+        inner = channels // 4 if bottleneck else channels
+        # (out_channels, kernel, stride, pad, use_bias) conv plan; the v1
+        # bottleneck's 1x1 convs keep their (default-on) biases for
+        # checkpoint parity with the reference implementation
+        if bottleneck:
+            v1_bias = not preact
+            plan = [(inner, 1, stride if not preact else 1, 0, v1_bias),
+                    (inner, 3, 1 if not preact else stride, 1, False),
+                    (channels, 1, 1, 0, v1_bias)]
         else:
-            self.downsample = None
+            plan = [(inner, 3, stride, 1, False),
+                    (channels, 3, 1, 1, False)]
+        if preact:
+            self.norms = []
+            self.convs = []
+            for j, (ch, k, s, p, bias) in enumerate(plan):
+                bn = nn.BatchNorm()
+                conv = nn.Conv2D(ch, k, s, p, use_bias=bias)
+                self.register_child(bn, 'bn%d' % (j + 1))
+                self.register_child(conv, 'conv%d' % (j + 1))
+                self.norms.append(bn)
+                self.convs.append(conv)
+            self.downsample = nn.Conv2D(channels, 1, stride,
+                                        use_bias=False,
+                                        in_channels=in_channels) \
+                if downsample else None
+        else:
+            self.body = nn.HybridSequential(prefix='')
+            for j, (ch, k, s, p, bias) in enumerate(plan):
+                self.body.add(nn.Conv2D(ch, k, s, p, use_bias=bias))
+                self.body.add(nn.BatchNorm())
+                if j + 1 < len(plan):
+                    self.body.add(nn.Activation('relu'))
+            if downsample:
+                self.downsample = nn.HybridSequential(prefix='')
+                self.downsample.add(nn.Conv2D(channels, 1, stride,
+                                              use_bias=False,
+                                              in_channels=in_channels))
+                self.downsample.add(nn.BatchNorm())
+            else:
+                self.downsample = None
 
     def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(residual + x, act_type='relu')
-        return x
+        if self._preact:
+            residual = x
+            for j, (bn, conv) in enumerate(zip(self.norms, self.convs)):
+                x = F.relu(bn(x))
+                if j == 0 and self.downsample is not None:
+                    residual = self.downsample(x)
+                x = conv(x)
+            return x + residual
+        residual = x if self.downsample is None else self.downsample(x)
+        return F.relu(self.body(x) + residual)
 
 
-class BottleneckV1(HybridBlock):
-    r"""Bottleneck V1 (reference: resnet.py BottleneckV1)."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix='')
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation('relu'))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation('relu'))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix='')
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(x + residual, act_type='relu')
-        return x
+def _pin(bottleneck, preact):
+    class _Cell(_ResidualCell):
+        def __init__(self, channels, stride, downsample=False,
+                     in_channels=0, **kwargs):
+            super().__init__(channels, stride, downsample=downsample,
+                             in_channels=in_channels,
+                             bottleneck=bottleneck, preact=preact,
+                             **kwargs)
+    return _Cell
 
 
-class BasicBlockV2(HybridBlock):
-    r"""BasicBlock V2 — pre-activation ResNet
-    (reference: resnet.py BasicBlockV2)."""
-
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type='relu')
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type='relu')
-        x = self.conv2(x)
-        return x + residual
+BasicBlockV1 = _pin(False, False)
+BottleneckV1 = _pin(True, False)
+BasicBlockV2 = _pin(False, True)
+BottleneckV2 = _pin(True, True)
+for _c, _n in ((BasicBlockV1, 'BasicBlockV1'),
+               (BottleneckV1, 'BottleneckV1'),
+               (BasicBlockV2, 'BasicBlockV2'),
+               (BottleneckV2, 'BottleneckV2')):
+    _c.__name__ = _c.__qualname__ = _n
 
 
-class BottleneckV2(HybridBlock):
-    r"""Bottleneck V2 (reference: resnet.py BottleneckV2)."""
+class _ResNetBase(HybridBlock):
+    """Stem + residual stages + pooled classifier, v1/v2 differing only
+    in the extra input/output norms of the pre-activation design."""
 
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type='relu')
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type='relu')
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type='relu')
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
-    r"""ResNet V1 model (reference: resnet.py ResNetV1)."""
+    _preact = False
 
     def __init__(self, block, layers, channels, classes=1000,
                  thumbnail=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix='')
+            f = nn.HybridSequential(prefix='')
+            if self._preact:
+                f.add(nn.BatchNorm(scale=False, center=False))
             if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
+                f.add(nn.Conv2D(channels[0], 3, 1, 1, use_bias=False))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation('relu'))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
+                f.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+                f.add(nn.BatchNorm())
+                f.add(nn.Activation('relu'))
+                f.add(nn.MaxPool2D(3, 2, 1))
+            in_ch = channels[0]
+            for i, n in enumerate(layers):
+                stage = nn.HybridSequential(prefix='stage%d_' % (i + 1))
                 stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
+                out_ch = channels[i + 1]
+                with stage.name_scope():
+                    stage.add(block(out_ch, stride, out_ch != in_ch,
+                                    in_channels=in_ch, prefix=''))
+                    for _ in range(n - 1):
+                        stage.add(block(out_ch, 1, False,
+                                        in_channels=out_ch, prefix=''))
+                f.add(stage)
+                in_ch = out_ch
+            if self._preact:
+                f.add(nn.BatchNorm())
+                f.add(nn.Activation('relu'))
+            f.add(nn.GlobalAvgPool2D())
+            if self._preact:
+                f.add(nn.Flatten())
+            self.features = f
             self.output = nn.Dense(classes, in_units=channels[-1])
 
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix='stage%d_' % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=''))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=''))
-        return layer
-
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-class ResNetV2(HybridBlock):
-    r"""ResNet V2 model (reference: resnet.py ResNetV2)."""
-
-    def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix='')
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation('relu'))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation('relu'))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix='stage%d_' % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=''))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=''))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+class ResNetV1(_ResNetBase):
+    """Post-activation ResNet (He 2015)."""
+    _preact = False
 
 
-# Specification (reference: resnet.py resnet_spec)
+class ResNetV2(_ResNetBase):
+    """Pre-activation ResNet (He 2016, "Identity Mappings")."""
+    _preact = True
+
+
+# depth -> (bottleneck?, per-stage cell counts, stage channels)
 resnet_spec = {
-    18: ('basic_block', [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ('basic_block', [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ('bottle_neck', [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ('bottle_neck', [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ('bottle_neck', [3, 8, 36, 3], [64, 256, 512, 1024, 2048])}
-
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [{'basic_block': BasicBlockV1,
-                          'bottle_neck': BottleneckV1},
-                         {'basic_block': BasicBlockV2,
-                          'bottle_neck': BottleneckV2}]
+    18: (False, [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: (False, [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: (True, [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: (True, [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: (True, [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+}
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
                **kwargs):
-    r"""Returns a ResNet instance (reference: resnet.py get_resnet).
-    pretrained=True requires local weights (zero-egress environment)."""
-    assert num_layers in resnet_spec, \
-        'Invalid number of layers: %d. Options are %s' % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert 1 <= version <= 2, \
-        'Invalid resnet version: %d. Options are 1 and 2.' % version
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    """Build resnet{18..152}_v{1,2}. pretrained=True loads model-store
+    weights (requires a local store in this zero-egress environment)."""
+    if num_layers not in resnet_spec:
+        raise ValueError('Invalid number of layers: %d. Options are %s'
+                         % (num_layers, sorted(resnet_spec)))
+    if version not in (1, 2):
+        raise ValueError('Invalid resnet version: %d (1 or 2)' % version)
+    bottleneck, layers, channels = resnet_spec[num_layers]
+    block = {(False, 1): BasicBlockV1, (True, 1): BottleneckV1,
+             (False, 2): BasicBlockV2,
+             (True, 2): BottleneckV2}[(bottleneck, version)]
+    cls = ResNetV1 if version == 1 else ResNetV2
+    net = cls(block, layers, channels, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
         net.load_parameters(get_model_file(
@@ -277,41 +201,21 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _variant(version, depth):
+    def build(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    build.__name__ = 'resnet%d_v%d' % (depth, version)
+    build.__doc__ = 'ResNet-%d v%d model.' % (depth, version)
+    return build
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1 = _variant(1, 18)
+resnet34_v1 = _variant(1, 34)
+resnet50_v1 = _variant(1, 50)
+resnet101_v1 = _variant(1, 101)
+resnet152_v1 = _variant(1, 152)
+resnet18_v2 = _variant(2, 18)
+resnet34_v2 = _variant(2, 34)
+resnet50_v2 = _variant(2, 50)
+resnet101_v2 = _variant(2, 101)
+resnet152_v2 = _variant(2, 152)

@@ -1,4 +1,11 @@
-"""SqueezeNet 1.0/1.1 (reference: python/mxnet/gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0 / 1.1 ("AlexNet-level accuracy with 50x fewer
+parameters", Iandola 2016).
+
+Behavioral parity target: python/mxnet/gluon/model_zoo/vision/
+squeezenet.py (same layer graph via Sequential ordering). The stage
+layout is expressed as a per-version spec table: 'P' = ceil-mode
+max-pool, integers = fire-module squeeze width (expand width is 4x).
+"""
 from __future__ import annotations
 
 __all__ = ['SqueezeNet', 'squeezenet1_0', 'squeezenet1_1']
@@ -6,22 +13,11 @@ __all__ = ['SqueezeNet', 'squeezenet1_0', 'squeezenet1_1']
 from ...block import HybridBlock
 from ... import nn
 
-
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix='')
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = HybridConcurrent(axis=1, prefix='')
-    paths.add(_make_fire_conv(expand1x1_channels, 1))
-    paths.add(_make_fire_conv(expand3x3_channels, 3, 1))
-    out.add(paths)
-    return out
-
-
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix='')
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
-    out.add(nn.Activation('relu'))
-    return out
+# stem: (channels, kernel); body: 'P' or squeeze width s (expands = 4s)
+_SPECS = {
+    '1.0': ((96, 7), ['P', 16, 16, 32, 'P', 32, 48, 48, 64, 'P', 64]),
+    '1.1': ((64, 3), ['P', 16, 16, 'P', 32, 32, 'P', 48, 48, 64, 64]),
+}
 
 
 class HybridConcurrent(HybridBlock):
@@ -37,84 +33,72 @@ class HybridConcurrent(HybridBlock):
             self.register_child(block)
 
     def hybrid_forward(self, F, x):
-        out = []
-        for block in self._children.values():
-            out.append(block(x))
-        return F.Concat(*out, dim=self.axis)
+        outs = [block(x) for block in self._children.values()]
+        return F.Concat(*outs, dim=self.axis)
+
+
+def _relu_conv(channels, kernel, padding=0):
+    seq = nn.HybridSequential(prefix='')
+    seq.add(nn.Conv2D(channels, kernel, padding=padding),
+            nn.Activation('relu'))
+    return seq
+
+
+def _fire(squeeze):
+    """Fire module: 1x1 squeeze, then parallel 1x1 + 3x3 expands."""
+    expand = 4 * squeeze
+    fire = nn.HybridSequential(prefix='')
+    fire.add(_relu_conv(squeeze, 1))
+    branches = HybridConcurrent(axis=1, prefix='')
+    branches.add(_relu_conv(expand, 1), _relu_conv(expand, 3, padding=1))
+    fire.add(branches)
+    return fire
 
 
 class SqueezeNet(HybridBlock):
-    r"""SqueezeNet from "AlexNet-level accuracy with 50x fewer parameters"
-    (reference: squeezenet.py SqueezeNet)."""
+    """Fire-module stack ending in a 1x1 conv classifier head."""
 
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        assert version in ['1.0', '1.1'], \
-            'Unsupported SqueezeNet version {version}: 1.0 or 1.1 expected'.format(
-                version=version)
+        if version not in _SPECS:
+            raise ValueError('Unsupported SqueezeNet version %s: '
+                             '1.0 or 1.1 expected' % version)
+        (stem_ch, stem_k), body = _SPECS[version]
         with self.name_scope():
             self.features = nn.HybridSequential(prefix='')
-            if version == '1.0':
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
-                self.features.add(nn.Activation('relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
-                self.features.add(nn.Activation('relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
+            self.features.add(nn.Conv2D(stem_ch, kernel_size=stem_k,
+                                        strides=2),
+                              nn.Activation('relu'))
+            for item in body:
+                if item == 'P':
+                    self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                                   ceil_mode=True))
+                else:
+                    self.features.add(_fire(item))
             self.features.add(nn.Dropout(0.5))
-
             self.output = nn.HybridSequential(prefix='')
-            self.output.add(nn.Conv2D(classes, kernel_size=1))
-            self.output.add(nn.Activation('relu'))
-            self.output.add(nn.AvgPool2D(13))
-            self.output.add(nn.Flatten())
+            self.output.add(nn.Conv2D(classes, kernel_size=1),
+                            nn.Activation('relu'),
+                            nn.AvgPool2D(13),
+                            nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
+
+
+def _build(version, store_name, pretrained, ctx, root, **kwargs):
+    net = SqueezeNet(version, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file(store_name, root=root), ctx=ctx)
+    return net
 
 
 def squeezenet1_0(pretrained=False, ctx=None, root=None, **kwargs):
-    r"""SqueezeNet 1.0 (reference: squeezenet.py)."""
-    net = SqueezeNet('1.0', **kwargs)
-    if pretrained:
-        from ..model_store import get_model_file
-        net.load_parameters(get_model_file('squeezenet1.0', root=root), ctx=ctx)
-    return net
+    """SqueezeNet v1.0 (7x7 stem)."""
+    return _build('1.0', 'squeezenet1.0', pretrained, ctx, root, **kwargs)
 
 
 def squeezenet1_1(pretrained=False, ctx=None, root=None, **kwargs):
-    r"""SqueezeNet 1.1 (reference: squeezenet.py)."""
-    net = SqueezeNet('1.1', **kwargs)
-    if pretrained:
-        from ..model_store import get_model_file
-        net.load_parameters(get_model_file('squeezenet1.1', root=root), ctx=ctx)
-    return net
+    """SqueezeNet v1.1 (3x3 stem; ~2.4x less compute than 1.0)."""
+    return _build('1.1', 'squeezenet1.1', pretrained, ctx, root, **kwargs)

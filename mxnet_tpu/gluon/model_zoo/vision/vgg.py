@@ -1,106 +1,98 @@
-"""VGG 11/13/16/19 (+BN) (reference: python/mxnet/gluon/model_zoo/vision/vgg.py)."""
+"""VGG 11/13/16/19, with optional batch norm (Simonyan & Zisserman 2014).
+
+Behavioral parity target: python/mxnet/gluon/model_zoo/vision/vgg.py
+(same layer graph / factory names). Stage plan is a single table of
+(repeat, width) pairs per depth; the classifier head is generated in a
+loop rather than written out.
+"""
 from __future__ import annotations
 
-__all__ = ['VGG', 'vgg11', 'vgg13', 'vgg16', 'vgg19', 'vgg11_bn', 'vgg13_bn',
-           'vgg16_bn', 'vgg19_bn', 'get_vgg']
 
 from ...block import HybridBlock
 from ... import nn
 from .... import initializer as init
 
+__all__ = ['VGG', 'get_vgg', 'vgg11', 'vgg13', 'vgg16', 'vgg19',
+           'vgg11_bn', 'vgg13_bn', 'vgg16_bn', 'vgg19_bn']
+
+# depth -> [(conv repeats, channels)] per down-sampling stage
+vgg_spec = {
+    11: [(1, 64), (1, 128), (2, 256), (2, 512), (2, 512)],
+    13: [(2, 64), (2, 128), (2, 256), (2, 512), (2, 512)],
+    16: [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)],
+    19: [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)],
+}
+
+_CONV_INIT = dict(weight_initializer=init.Xavier(rnd_type='gaussian',
+                                                 factor_type='out',
+                                                 magnitude=2),
+                  bias_initializer='zeros')
+_DENSE_INIT = dict(weight_initializer='normal', bias_initializer='zeros')
+
 
 class VGG(HybridBlock):
-    r"""VGG model from "Very Deep Convolutional Networks..."
-    (reference: vgg.py VGG)."""
+    """Plain 3x3-conv stages with max-pool downsampling and a two-layer
+    4096-wide dense head."""
 
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
                  **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(filters)
+        if len(layers) != len(filters):
+            raise ValueError('layers and filters must have the same '
+                             'length, got %d and %d'
+                             % (len(layers), len(filters)))
+        stages = list(zip(layers, filters))
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation='relu',
-                                       weight_initializer='normal',
-                                       bias_initializer='zeros'))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(4096, activation='relu',
-                                       weight_initializer='normal',
-                                       bias_initializer='zeros'))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.output = nn.Dense(classes, weight_initializer='normal',
-                                   bias_initializer='zeros')
-
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix='')
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(filters[i], kernel_size=3, padding=1,
-                                         weight_initializer=init.Xavier(
-                                             rnd_type='gaussian',
-                                             factor_type='out',
-                                             magnitude=2),
-                                         bias_initializer='zeros'))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation('relu'))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
+            self.features = nn.HybridSequential(prefix='')
+            for repeat, width in stages:
+                for _ in range(repeat):
+                    self.features.add(nn.Conv2D(width, kernel_size=3,
+                                                padding=1, **_CONV_INIT))
+                    if batch_norm:
+                        self.features.add(nn.BatchNorm())
+                    self.features.add(nn.Activation('relu'))
+                self.features.add(nn.MaxPool2D(strides=2))
+            for _ in range(2):
+                self.features.add(nn.Dense(4096, activation='relu',
+                                           **_DENSE_INIT))
+                self.features.add(nn.Dropout(rate=0.5))
+            self.output = nn.Dense(classes, **_DENSE_INIT)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+        return self.output(self.features(x))
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    r"""Get VGG by layer count (reference: vgg.py get_vgg)."""
-    layers, filters = vgg_spec[num_layers]
-    net = VGG(layers, filters, **kwargs)
+    """Build a VGG by depth (11/13/16/19); batch_norm=True for the _bn
+    variants."""
+    stages = vgg_spec[num_layers]
+    net = VGG([r for r, _ in stages], [c for _, c in stages], **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        batch_norm_suffix = '_bn' if kwargs.get('batch_norm') else ''
-        net.load_parameters(get_model_file(
-            'vgg%d%s' % (num_layers, batch_norm_suffix), root=root), ctx=ctx)
+        suffix = '_bn' if kwargs.get('batch_norm') else ''
+        net.load_parameters(
+            get_model_file('vgg%d%s' % (num_layers, suffix), root=root),
+            ctx=ctx)
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+def _variant(depth, batch_norm=False):
+    def build(**kwargs):
+        if batch_norm:
+            kwargs['batch_norm'] = True
+        return get_vgg(depth, **kwargs)
+    build.__name__ = 'vgg%d%s' % (depth, '_bn' if batch_norm else '')
+    build.__doc__ = 'VGG-%d%s model.' % (depth,
+                                         ' with batch norm' if batch_norm
+                                         else '')
+    return build
 
 
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs['batch_norm'] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs['batch_norm'] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs['batch_norm'] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs['batch_norm'] = True
-    return get_vgg(19, **kwargs)
+vgg11 = _variant(11)
+vgg13 = _variant(13)
+vgg16 = _variant(16)
+vgg19 = _variant(19)
+vgg11_bn = _variant(11, True)
+vgg13_bn = _variant(13, True)
+vgg16_bn = _variant(16, True)
+vgg19_bn = _variant(19, True)

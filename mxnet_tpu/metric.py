@@ -298,131 +298,88 @@ class TopKAccuracy(EvalMetric):
             self.global_num_inst += num_samples
 
 
+def _prf_from_confusion(cm):
+    """(precision, recall, fscore) from a 2x2 confusion matrix
+    cm[label, prediction]."""
+    tp = cm[1, 1]
+    predicted_pos = cm[:, 1].sum()
+    actual_pos = cm[1, :].sum()
+    precision = tp / predicted_pos if predicted_pos else 0.0
+    recall = tp / actual_pos if actual_pos else 0.0
+    fscore = 2 * precision * recall / (precision + recall) \
+        if precision + recall else 0.0
+    return precision, recall, fscore
+
+
+def _mcc_from_confusion(cm):
+    """Matthews correlation coefficient from a 2x2 confusion matrix;
+    zero-marginal terms drop out of the denominator (reference
+    convention)."""
+    if not cm.sum():
+        return 0.0
+    tn, fp = cm[0]
+    fn, tp = cm[1]
+    num = tp * tn - fp * fn
+    denom = 1.0
+    for marginal in (tp + fp, tp + fn, tn + fp, tn + fn):
+        if marginal:
+            denom *= marginal
+    return num / math.sqrt(denom)
+
+
 class _BinaryClassificationMetrics:
-    """Precision/recall/F1/MCC bookkeeping (reference: metric.py:580)."""
+    """Windowed + running 2x2 confusion matrices backing F1/MCC
+    (reference analog: metric.py:580 _BinaryClassificationMetrics)."""
 
     def __init__(self):
-        self.true_positives = 0
-        self.false_negatives = 0
-        self.false_positives = 0
-        self.true_negatives = 0
-        self.global_true_positives = 0
-        self.global_false_negatives = 0
-        self.global_false_positives = 0
-        self.global_true_negatives = 0
+        self._local = numpy.zeros((2, 2), numpy.float64)
+        self._running = numpy.zeros((2, 2), numpy.float64)
 
     def update_binary_stats(self, label, pred):
-        pred = pred.asnumpy() if isinstance(pred, NDArray) else numpy.asarray(pred)
-        label = label.asnumpy().astype('int32') if isinstance(label, NDArray) \
-            else numpy.asarray(label).astype('int32')
-        pred_label = numpy.argmax(pred, axis=1)
+        pred = pred.asnumpy() if isinstance(pred, NDArray) \
+            else numpy.asarray(pred)
+        label = label.asnumpy() if isinstance(label, NDArray) \
+            else numpy.asarray(label)
+        label = label.astype('int32').ravel()
         check_label_shapes(label, pred)
-        if len(numpy.unique(label)) > 2:
-            raise ValueError('%s currently only supports binary classification.'
-                             % self.__class__.__name__)
-        pred_true = (pred_label == 1)
-        pred_false = 1 - pred_true
-        label_true = (label == 1)
-        label_false = 1 - label_true
-        true_pos = (pred_true * label_true).sum()
-        false_pos = (pred_true * label_false).sum()
-        false_neg = (pred_false * label_true).sum()
-        true_neg = (pred_false * label_false).sum()
-        self.true_positives += true_pos
-        self.global_true_positives += true_pos
-        self.false_positives += false_pos
-        self.global_false_positives += false_pos
-        self.false_negatives += false_neg
-        self.global_false_negatives += false_neg
-        self.true_negatives += true_neg
-        self.global_true_negatives += true_neg
+        if numpy.unique(label).size > 2:
+            raise ValueError('%s currently only supports binary '
+                             'classification.' % type(self).__name__)
+        hard = (numpy.argmax(pred, axis=1) == 1).astype('int32')
+        truth = (label == 1).astype('int32')
+        batch = numpy.zeros((2, 2), numpy.float64)
+        numpy.add.at(batch, (truth, hard), 1.0)
+        self._local += batch
+        self._running += batch
 
-    @property
-    def precision(self):
-        if self.true_positives + self.false_positives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_positives)
-        return 0.
-
-    @property
-    def global_precision(self):
-        if self.global_true_positives + self.global_false_positives > 0:
-            return float(self.global_true_positives) / (
-                self.global_true_positives + self.global_false_positives)
-        return 0.
-
-    @property
-    def recall(self):
-        if self.true_positives + self.false_negatives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_negatives)
-        return 0.
-
-    @property
-    def global_recall(self):
-        if self.global_true_positives + self.global_false_negatives > 0:
-            return float(self.global_true_positives) / (
-                self.global_true_positives + self.global_false_negatives)
-        return 0.
-
-    @property
-    def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (
-                self.precision + self.recall)
-        return 0.
-
-    @property
-    def global_fscore(self):
-        if self.global_precision + self.global_recall > 0:
-            return 2 * self.global_precision * self.global_recall / (
-                self.global_precision + self.global_recall)
-        return 0.
+    precision = property(lambda self: _prf_from_confusion(self._local)[0])
+    recall = property(lambda self: _prf_from_confusion(self._local)[1])
+    fscore = property(lambda self: _prf_from_confusion(self._local)[2])
+    global_precision = property(
+        lambda self: _prf_from_confusion(self._running)[0])
+    global_recall = property(
+        lambda self: _prf_from_confusion(self._running)[1])
+    global_fscore = property(
+        lambda self: _prf_from_confusion(self._running)[2])
 
     def matthewscc(self, use_global=False):
-        if use_global:
-            if not self.global_total_examples:
-                return 0.
-            true_pos = float(self.global_true_positives)
-            false_pos = float(self.global_false_positives)
-            false_neg = float(self.global_false_negatives)
-            true_neg = float(self.global_true_negatives)
-        else:
-            if not self.total_examples:
-                return 0.
-            true_pos = float(self.true_positives)
-            false_pos = float(self.false_positives)
-            false_neg = float(self.false_negatives)
-            true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos), (true_pos + false_neg),
-                 (true_neg + false_pos), (true_neg + false_neg)]
-        denom = 1.
-        for t in filter(lambda t: t != 0., terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) / math.sqrt(denom)
+        return _mcc_from_confusion(self._running if use_global
+                                   else self._local)
 
     @property
     def total_examples(self):
-        return self.false_negatives + self.false_positives + \
-            self.true_negatives + self.true_positives
+        return int(self._local.sum())
 
     @property
     def global_total_examples(self):
-        return self.global_false_negatives + self.global_false_positives + \
-            self.global_true_negatives + self.global_true_positives
+        return int(self._running.sum())
 
     def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
+        self._local[:] = 0
 
     def reset(self):
-        self.reset_stats()
-        self.global_false_positives = 0
-        self.global_false_negatives = 0
-        self.global_true_positives = 0
-        self.global_true_negatives = 0
+        self._local[:] = 0
+        self._running[:] = 0
 
 
 @register
@@ -892,20 +849,14 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             labels, preds = check_label_shapes(labels, preds, True)
         for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.global_sum_metric += sum_metric
-                self.num_inst += num_inst
-                self.global_num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.global_sum_metric += reval
-                self.num_inst += 1
-                self.global_num_inst += 1
+            result = self._feval(label.asnumpy(), pred.asnumpy())
+            # feval may return a bare value (count 1) or (sum, count)
+            total, count = result if isinstance(result, tuple) \
+                else (result, 1)
+            self.sum_metric += total
+            self.global_sum_metric += total
+            self.num_inst += count
+            self.global_num_inst += count
 
     def get_config(self):
         raise NotImplementedError('CustomMetric cannot be serialized')

@@ -1,5 +1,6 @@
-"""SequentialModule: chain modules end to end
-(reference: python/mxnet/module/sequential_module.py)."""
+"""SequentialModule: chain modules end to end (behavioral parity:
+python/mxnet/module/sequential_module.py — add() with take_labels /
+auto_wiring metadata, shape propagation between stages)."""
 from __future__ import annotations
 
 import logging
@@ -9,50 +10,67 @@ from .base_module import BaseModule
 __all__ = ['SequentialModule']
 
 
+class _ShapeProbeBatch:
+    """Minimal batch of zeros used to propagate output shapes at bind."""
+
+    def __init__(self, shapes):
+        from .. import ndarray as nd
+        self.data = [nd.zeros(s if not hasattr(s, 'shape') else s.shape)
+                     for s in shapes]
+        self.label = None
+        self.pad = 0
+        self.index = None
+
+
 class SequentialModule(BaseModule):
-    """A container module chaining several modules; output of one feeds the
-    next."""
+    """Container chaining sub-modules; each stage's outputs feed the next
+    stage's inputs. Per-stage metadata:
+      take_labels — stage receives the label shapes (losses live here)
+      auto_wiring — rename incoming data to the stage's own data_names
+    """
 
     META_TAKE_LABELS = 'take_labels'
     META_AUTO_WIRING = 'auto_wiring'
+    _KNOWN_META = frozenset([META_TAKE_LABELS, META_AUTO_WIRING])
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._stages = []            # [(module, meta dict)]
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith('META_')])
+
+    # -- composition --------------------------------------------------------
 
     def add(self, module, **kwargs):
-        """Add a module to the chain. kwargs: take_labels, auto_wiring."""
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, ('Unknown meta "%s", a typo?' % key)
-        self._metas.append(kwargs)
+        unknown = set(kwargs) - self._KNOWN_META
+        if unknown:
+            raise ValueError('Unknown meta %s, a typo?' % sorted(unknown))
+        self._stages.append((module, dict(kwargs)))
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
     @property
+    def _modules(self):
+        return [m for m, _ in self._stages]
+
+    def _takes_labels(self, meta):
+        return bool(meta.get(self.META_TAKE_LABELS))
+
+    # -- introspection -------------------------------------------------------
+
+    @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0][0].data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1][0].output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0][0].data_shapes
 
     @property
     def label_shapes(self):
@@ -62,90 +80,86 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1][0].output_shapes
+
+    # -- parameters ----------------------------------------------------------
 
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
+        args, auxs = {}, {}
         for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+            a, x = module.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
-    def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False, allow_extra=False):
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, 'call bind before initializing the parameters'
         for module in self._modules:
             module.init_params(initializer=initializer,
-                               arg_params=arg_params, aux_params=aux_params,
+                               arg_params=arg_params,
+                               aux_params=aux_params,
                                allow_missing=allow_missing,
                                force_init=force_init,
                                allow_extra=allow_extra)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, \
-                    'Duplicated parameter names: ' + \
-                    ('name "%s" in layer %d (%s) is already ' % (
-                        name, i, type(modules[i]))) + \
-                    ('used in layer %d (%s).' % (
-                        known_names[name], type(modules[known_names[name]])))
-                known_names[name] = i
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        # parameter names must be globally unique across stages
+        owner = {}
+        for i, module in enumerate(self._modules):
+            a, x = module.get_params()
+            for name in list(a) + list(x):
+                if name in owner:
+                    raise AssertionError(
+                        'Duplicated parameter names: name "%s" in layer '
+                        '%d (%s) is already used in layer %d (%s).'
+                        % (name, i, type(module), owner[name],
+                           type(self._modules[owner[name]])))
+                owner[name] = i
         self.params_initialized = True
 
+    # -- binding -------------------------------------------------------------
+
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req='write'):
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req='write'):
         if self.binded and not force_rebind:
             self.logger.warning('Already bound, ignoring bind()')
             return
         if inputs_need_grad:
             assert for_training
         assert shared_module is None, 'Shared module is not supported'
-        assert len(self._modules) > 0, 'Attempting to bind an empty SequentialModule'
+        assert self._stages, 'Attempting to bind an empty SequentialModule'
         self.binded = True
         self._label_shapes = label_shapes
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-            my_inputs_need_grad = bool(for_training and (
-                inputs_need_grad or i_layer > 0))
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names, my_data_shapes)]
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            # propagate output shapes to the next module's data_shapes via
-            # one shape-only forward (jit caches make this cheap)
-            module.forward(_FakeBatch(
-                [_zeros(d.shape if hasattr(d, 'shape') else d[1])
-                 for d in my_data_shapes]), is_train=False)
-            my_data_shapes = [(name, shape) for (name, shape) in
-                              (module.output_shapes or [])]
-        if not anybody_ever_needs_label:
+
+        feed = data_shapes
+        labels_used = False
+        for i, (module, meta) in enumerate(self._stages):
+            stage_labels = label_shapes if self._takes_labels(meta) \
+                else None
+            labels_used = labels_used or stage_labels is not None
+            if meta.get(self.META_AUTO_WIRING, False):
+                names = module.data_names
+                assert len(names) == len(feed)
+                feed = [(name, pair[1])
+                        for name, pair in zip(names, feed)]
+            module.bind(
+                data_shapes=feed, label_shapes=stage_labels,
+                for_training=for_training,
+                inputs_need_grad=bool(for_training and
+                                      (inputs_need_grad or i > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            # shape-only forward propagates this stage's output shapes to
+            # the next stage's data_shapes (jit caching keeps it cheap)
+            module.forward(_ShapeProbeBatch([d[1] if isinstance(d, tuple)
+                                             else d.shape for d in feed]),
+                           is_train=False)
+            feed = list(module.output_shapes or [])
+        if not labels_used:
             self._label_shapes = None
 
     def init_optimizer(self, kvstore='local', optimizer='sgd',
@@ -161,32 +175,34 @@ class SequentialModule(BaseModule):
                                   force_init=force_init)
         self.optimizer_initialized = True
 
+    # -- execution -----------------------------------------------------------
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         from ..io import DataBatch
-        data_batch = DataBatch(data=data_batch.data, label=data_batch.label,
-                               pad=data_batch.pad, index=data_batch.index,
-                               provide_data=data_batch.provide_data,
-                               provide_label=data_batch.provide_label)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        batch = DataBatch(data=data_batch.data, label=data_batch.label,
+                          pad=data_batch.pad, index=data_batch.index,
+                          provide_data=data_batch.provide_data,
+                          provide_label=data_batch.provide_label)
+        last = len(self._stages) - 1
+        for i, (module, _) in enumerate(self._stages):
+            module.forward(batch, is_train=is_train)
+            if i == last:
                 break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, 'provide_data'):
-                data_batch.provide_data = [
-                    (name, out.shape) for name, out in
-                    zip(self._modules[i_layer + 1].data_names,
-                        module.get_outputs())]
+            outs = module.get_outputs()
+            batch.data = outs
+            batch.provide_data = [
+                (name, o.shape)
+                for name, o in zip(self._stages[i + 1][0].data_names,
+                                   outs)]
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(
-                range(len(self._modules)), self._modules))):
+        for i in range(len(self._stages) - 1, -1, -1):
+            module = self._stages[i][0]
             module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+            if i:
+                out_grads = module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized and \
@@ -196,34 +212,20 @@ class SequentialModule(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._stages[-1][0].get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and \
             self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        return self._stages[0][0].get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
+        for module, meta in self._stages:
+            if self._takes_labels(meta):
                 module.update_metric(eval_metric, labels, pre_sliced)
 
     def install_monitor(self, mon):
         assert self.binded
         for module in self._modules:
             module.install_monitor(mon)
-
-
-def _zeros(shape):
-    from .. import ndarray as nd
-    return nd.zeros(shape)
-
-
-class _FakeBatch:
-    def __init__(self, data):
-        self.data = data
-        self.label = None
-        self.pad = 0
-        self.index = None

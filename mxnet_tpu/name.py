@@ -1,64 +1,89 @@
 """Automatic naming for layers/symbols.
 
-Reference parity: python/mxnet/name.py (NameManager with per-hint counters,
-Prefix manager). Used by gluon._BlockScope and symbol variable creation.
+Behavioral parity: python/mxnet/name.py (NameManager with per-hint
+counters, Prefix manager). A thread-local stack of managers backs the
+`with NameManager():` scoping used by gluon._BlockScope and symbol
+variable creation.
 """
 from __future__ import annotations
 
+import collections
 import threading
 
 __all__ = ['NameManager', 'Prefix']
 
+_STATE = threading.local()
+
+
+def _stack():
+    if not hasattr(_STATE, 'managers'):
+        _STATE.managers = [NameManager()]
+    return _STATE.managers
+
 
 class NameManager:
-    """Manages automatic naming with per-type counters."""
-
-    _current = threading.local()
+    """Generates `hint0`, `hint1`, ... names, one counter per hint."""
 
     def __init__(self):
-        self._counter = {}
-        self._old_manager = None
+        self._counts = collections.Counter()
 
     def get(self, name, hint):
-        """Return name if given, else generate `hint%d`."""
+        """Return `name` unchanged if given, else the next auto name for
+        `hint`."""
         if name:
             return name
-        if hint not in self._counter:
-            self._counter[hint] = 0
-        name = '%s%d' % (hint, self._counter[hint])
-        self._counter[hint] += 1
-        return name
+        auto = '%s%d' % (hint, self._counts[hint])
+        self._counts[hint] += 1
+        return auto
 
     def __enter__(self):
-        if not hasattr(NameManager._current, 'value'):
-            NameManager._current.value = NameManager()
-        self._old_manager = NameManager._current.value
-        NameManager._current.value = self
+        stack = _stack()
+        self._depth = len(stack)
+        stack.append(self)
         return self
 
     def __exit__(self, ptype, value, trace):
-        assert self._old_manager
-        NameManager._current.value = self._old_manager
+        # restore by depth, tolerating a reassigned top (legacy code may
+        # poke NameManager._current.value inside an active scope)
+        stack = _stack()
+        del stack[self._depth:]
+        if not stack:
+            stack.append(NameManager())
 
 
 class Prefix(NameManager):
-    """Prepends a prefix to all generated names."""
+    """A NameManager that prepends a fixed prefix to every name it
+    generates."""
 
     def __init__(self, prefix):
         super().__init__()
         self._prefix = prefix
 
     def get(self, name, hint):
-        name = super().get(name, hint)
-        return self._prefix + name
+        return self._prefix + super().get(name, hint)
 
 
-# expose a class-level 'current' accessor matching the reference's usage
-class _CurrentProxy:
+class _Current:
+    """NameManager.current — delegates to the innermost active manager.
+    Also supports assignment-compat access used by test fixtures
+    (NameManager._current.value = NameManager())."""
+
     def get(self, name, hint):
-        if not hasattr(NameManager._current, 'value'):
-            NameManager._current.value = NameManager()
-        return NameManager._current.value.get(name, hint)
+        return _stack()[-1].get(name, hint)
 
 
-NameManager.current = _CurrentProxy()
+class _LegacySlot:
+    """Back-compat shim for code that pokes NameManager._current.value."""
+
+    @property
+    def value(self):
+        return _stack()[-1]
+
+    @value.setter
+    def value(self, manager):
+        # replace only the innermost manager, preserving enclosing scopes
+        _stack()[-1] = manager if manager is not None else NameManager()
+
+
+NameManager.current = _Current()
+NameManager._current = _LegacySlot()

@@ -1,12 +1,13 @@
 """Bucketing data iterator for variable-length sequences.
 
-Reference parity: python/mxnet/rnn/io.py (BucketSentenceIter :84,
-encode_sentences) — groups sentences by length bucket; one executor (jit
-specialization) per bucket (SURVEY.md §5.7).
+Behavioral parity: python/mxnet/rnn/io.py (BucketSentenceIter :84,
+encode_sentences). Buckets group sentences by padded length so each
+bucket compiles ONE jit specialization (SURVEY.md §5.7); labels are the
+inputs shifted one step (next-token prediction).
 """
 from __future__ import annotations
 
-import bisect
+import logging
 import random
 
 import numpy as np
@@ -19,128 +20,123 @@ __all__ = ['BucketSentenceIter', 'encode_sentences']
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key='\n', start_label=0, unknown_token=None):
-    """Encode sentences into index arrays, building vocab on the fly
-    (reference: rnn/io.py encode_sentences)."""
-    idx = start_label
-    if vocab is None:
+    """Map token sequences to integer id sequences, growing the vocab on
+    first sight when none was given (reference: rnn/io.py
+    encode_sentences)."""
+    grow = vocab is None
+    if grow:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
-    else:
-        new_vocab = False
-    res = []
+    next_id = start_label
+    encoded = []
     for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert (new_vocab or unknown_token), \
-                    'Unknown token %s' % word
-                if idx == invalid_label:
-                    idx += 1
+        ids = []
+        for token in sent:
+            if token not in vocab:
+                if not (grow or unknown_token):
+                    raise AssertionError('Unknown token %s' % token)
                 if unknown_token:
-                    word = unknown_token
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+                    token = unknown_token
+                if token not in vocab:
+                    if next_id == invalid_label:
+                        next_id += 1
+                    vocab[token] = next_id
+                    next_id += 1
+            ids.append(vocab[token])
+        encoded.append(ids)
+    return encoded, vocab
 
 
 class BucketSentenceIter(DataIter):
-    """Bucketing iterator for language models
-    (reference: rnn/io.py:84)."""
+    """Iterator yielding fixed-shape batches per length bucket, with
+    bucket_key driving BucketingModule executor selection."""
 
-    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
-                 data_name='data', label_name='softmax_label', dtype='float32',
-                 layout='NT'):
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name='data',
+                 label_name='softmax_label', dtype='float32', layout='NT'):
         super().__init__()
+        lengths = [len(s) for s in sentences]
         if not buckets:
-            buckets = [i for i, j in enumerate(
-                np.bincount([len(s) for s in sentences]))
-                if j >= batch_size]
-        buckets.sort()
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for i, sent in enumerate(sentences):
-            buck = bisect.bisect_left(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
-        if ndiscard:
-            import logging
-            logging.warning('discarded %d sentences longer than the largest '
-                            'bucket.', ndiscard)
+            counts = np.bincount(lengths)
+            buckets = [size for size, cnt in enumerate(counts)
+                       if cnt >= batch_size]
+        self.buckets = sorted(buckets)
         self.batch_size = batch_size
-        self.buckets = buckets
         self.data_name = data_name
         self.label_name = label_name
         self.dtype = dtype
         self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
-        self.major_axis = layout.find('N')
         self.layout = layout
-        self.default_bucket_key = max(buckets)
-
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(
-                name=self.data_name,
-                shape=(batch_size, self.default_bucket_key), layout=layout)]
-            self.provide_label = [DataDesc(
-                name=self.label_name,
-                shape=(batch_size, self.default_bucket_key), layout=layout)]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(
-                name=self.data_name,
-                shape=(self.default_bucket_key, batch_size), layout=layout)]
-            self.provide_label = [DataDesc(
-                name=self.label_name,
-                shape=(self.default_bucket_key, batch_size), layout=layout)]
-        else:
+        self.major_axis = layout.find('N')
+        if self.major_axis not in (0, 1):
             raise ValueError('Invalid layout %s: Must by NT (batch major) '
                              'or TN (time major)' % layout)
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in
-                             range(0, len(buck) - batch_size + 1,
-                                   batch_size)])
+        self.default_bucket_key = max(self.buckets)
+
+        # assign each sentence to the smallest bucket that fits
+        sized = np.searchsorted(self.buckets, lengths, side='left')
+        grouped = [[] for _ in self.buckets]
+        dropped = 0
+        for sent, b in zip(sentences, sized):
+            if b == len(self.buckets):
+                dropped += 1
+            else:
+                grouped[b].append(sent)
+        if dropped:
+            logging.warning('discarded %d sentences longer than the '
+                            'largest bucket.', dropped)
+        # one dense padded matrix per bucket
+        self.data = []
+        for width, group in zip(self.buckets, grouped):
+            mat = np.full((len(group), width), invalid_label, dtype=dtype)
+            for row, sent in enumerate(group):
+                mat[row, :len(sent)] = sent
+            self.data.append(mat)
+
+        shape = (batch_size, self.default_bucket_key)
+        if self.major_axis == 1:
+            shape = shape[::-1]
+        self.provide_data = [DataDesc(name=data_name, shape=shape,
+                                      layout=layout)]
+        self.provide_label = [DataDesc(name=label_name, shape=shape,
+                                       layout=layout)]
+
+        self.idx = [(b, start)
+                    for b, mat in enumerate(self.data)
+                    for start in range(0, len(mat) - batch_size + 1,
+                                       batch_size)]
         self.curr_idx = 0
+        self.nddata = []
+        self.ndlabel = []
         self.reset()
 
     def reset(self):
-        """Shuffle buckets + data within buckets, rebuild NDArrays."""
+        """Reshuffle batch order and rows; rebuild device arrays with the
+        one-step-shifted labels."""
         self.curr_idx = 0
         random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
         self.nddata = []
         self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(nd.array(buck, dtype=self.dtype))
-            self.ndlabel.append(nd.array(label, dtype=self.dtype))
+        for mat in self.data:
+            np.random.shuffle(mat)
+            shifted = np.roll(mat, -1, axis=1)
+            shifted[:, -1] = self.invalid_label
+            self.nddata.append(nd.array(mat, dtype=self.dtype))
+            self.ndlabel.append(nd.array(shifted, dtype=self.dtype))
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self.curr_idx >= len(self.idx):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
+        b, start = self.idx[self.curr_idx]
         self.curr_idx += 1
+        rows = slice(start, start + self.batch_size)
+        data = self.nddata[b][rows]
+        label = self.ndlabel[b][rows]
         if self.major_axis == 1:
-            data = self.nddata[i][j:j + self.batch_size].T
-            label = self.ndlabel[i][j:j + self.batch_size].T
-        else:
-            data = self.nddata[i][j:j + self.batch_size]
-            label = self.ndlabel[i][j:j + self.batch_size]
-        return DataBatch([data], [label], pad=0,
-                         bucket_key=self.buckets[i],
-                         provide_data=[DataDesc(
-                             name=self.data_name, shape=data.shape,
-                             layout=self.layout)],
-                         provide_label=[DataDesc(
-                             name=self.label_name, shape=label.shape,
-                             layout=self.layout)])
+            data, label = data.T, label.T
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[b],
+            provide_data=[DataDesc(name=self.data_name, shape=data.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(name=self.label_name,
+                                    shape=label.shape,
+                                    layout=self.layout)])

@@ -43,6 +43,16 @@ from . import model
 from . import callback
 from . import operator
 from . import image
+from . import config
+
+# env-driven global seed (docs/faq/env_var.md MXNET_SEED)
+_seed = config.get('MXNET_SEED')
+if _seed is not None:
+    random.seed(_seed)
+del _seed
+if config.get('MXNET_PROFILER_AUTOSTART'):
+    from . import profiler as _profiler
+    _profiler.set_state('run')
 from . import monitor
 from .monitor import Monitor
 from . import profiler

@@ -1,0 +1,194 @@
+"""Typed configuration with environment-variable overrides.
+
+Reference parity: docs/faq/env_var.md (the ~60 MXNET_* knobs) +
+src/engine/engine.cc engine selection. Every knob is declared once with
+a type, default, and what it maps to in the TPU-native runtime; values
+resolve from the environment at first read (so tests can monkeypatch
+os.environ) and can be overridden programmatically via set().
+
+Knobs whose reference meaning is subsumed by XLA (memory pools, cuDNN
+autotune, engine thread counts) are accepted-and-documented no-ops so
+reference launch scripts run unchanged.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ['Knob', 'KNOBS', 'get', 'set', 'describe', 'naive_engine',
+           'NaiveEngineScope']
+
+_lock = threading.Lock()
+_values = {}
+
+
+class Knob:
+    __slots__ = ('name', 'typ', 'default', 'doc', 'effective')
+
+    def __init__(self, name, typ, default, doc, effective=True):
+        self.name = name
+        self.typ = typ
+        self.default = default
+        self.doc = doc
+        self.effective = effective  # False = accepted no-op under XLA
+
+    def parse(self, raw):
+        if self.typ is bool:
+            return raw not in ('0', '', 'false', 'False', None)
+        return self.typ(raw)
+
+
+def _knob(name, typ, default, doc, effective=True):
+    return Knob(name, typ, default, doc, effective)
+
+
+KNOBS = {k.name: k for k in [
+    # engine / execution
+    _knob('MXNET_ENGINE_TYPE', str, 'ThreadedEnginePerDevice',
+          "Engine selection (env_var.md:104). 'NaiveEngine' = debug mode:"
+          ' ops run un-jitted and synchronously (jax.disable_jit +'
+          ' block_until_ready) so python tracebacks land on the faulting'
+          ' op, like the reference NaiveEngine.'),
+    _knob('MXNET_EXEC_BULK_EXEC_TRAIN', bool, True,
+          'Bulked execution of the train graph. Maps to compiled-dispatch'
+          ' jit caching on the eager path; 0 disables the jit cache.'),
+    _knob('MXNET_EXEC_BULK_EXEC_INFERENCE', bool, True,
+          'Same for inference paths.'),
+    _knob('MXNET_BACKWARD_DO_MIRROR', bool, False,
+          'Trade compute for memory in backward (graph_executor.cc:338).'
+          ' Maps to jax.checkpoint rematerialization of HybridBlock'
+          ' forwards (gluon.Block.hybridize(remat=True) analog).'),
+    _knob('MXNET_EXEC_ENABLE_ROW_SPARSE_PULL', bool, False,
+          'kvstore row_sparse_pull support.'),
+    # RNG
+    _knob('MXNET_SEED', int, None,
+          'Global random seed applied at import when set.'),
+    # data pipeline
+    _knob('MXNET_CPU_WORKER_NTHREADS', int, 4,
+          'Decode/augment worker threads for ImageRecordIter and the'
+          ' gluon DataLoader default.'),
+    _knob('MXNET_CPU_PRIORITY_NTHREADS', int, 4,
+          'Reserved; accepted for launch-script parity.', effective=False),
+    # memory (XLA buffer assignment owns memory planning)
+    _knob('MXNET_GPU_MEM_POOL_RESERVE', int, 5,
+          'XLA owns device memory planning.', effective=False),
+    _knob('MXNET_GPU_MEM_POOL_TYPE', str, 'Naive',
+          'XLA owns device memory planning.', effective=False),
+    _knob('MXNET_EXEC_NUM_TEMP', int, 1,
+          'XLA owns temp-buffer planning.', effective=False),
+    # cudnn knobs: no cuDNN on TPU
+    _knob('MXNET_CUDNN_AUTOTUNE_DEFAULT', int, 1,
+          'No cuDNN on TPU; XLA autotunes convolutions.', effective=False),
+    _knob('MXNET_CUDNN_LIB_CHECKING', bool, True,
+          'No cuDNN on TPU.', effective=False),
+    # kvstore / distributed — mesh collectives run on ICI; the host
+    # kvstore path reduces with single jnp calls, so these are no-ops
+    _knob('MXNET_KVSTORE_REDUCTION_NTHREADS', int, 4,
+          'Host-side reduction threads.', effective=False),
+    _knob('MXNET_KVSTORE_BIGARRAY_BOUND', int, 1000000,
+          'Size threshold for sharded server pushes.', effective=False),
+    _knob('MXNET_ENABLE_GPU_P2P', bool, True,
+          'ICI is always on for TPU meshes.', effective=False),
+    # profiler
+    _knob('MXNET_PROFILER_AUTOSTART', bool, False,
+          'Start the profiler at import.'),
+    _knob('MXNET_PROFILER_MODE', int, 0,
+          'Profiler detail mode.', effective=False),
+    # misc
+    _knob('MXNET_HOME', str, os.path.join(os.path.expanduser('~'),
+                                          '.mxnet'),
+          'Model-store / data cache root.'),
+]}
+
+
+def get(name):
+    """Resolved value of a knob: set() override > environment > default."""
+    knob = KNOBS[name]
+    with _lock:
+        if name in _values:
+            return _values[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return knob.default
+    return knob.parse(raw)
+
+
+def set(name, value):  # noqa: A001 - reference-style API
+    """Programmatic override (wins over the environment). Values coerce
+    through the knob's declared type, so set('...', '0') on a bool knob
+    means False, same as the environment path."""
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise KeyError('unknown config knob %s (see config.describe())'
+                       % name)
+    if isinstance(value, str):
+        value = knob.parse(value)
+    elif value is not None and knob.typ is bool:
+        value = bool(value)
+    elif value is not None:
+        value = knob.typ(value)
+    with _lock:
+        _values[name] = value
+
+
+def describe():
+    """Human-readable table of every knob, its value and meaning."""
+    lines = []
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        tag = '' if k.effective else '  [no-op under XLA]'
+        summary = k.doc.split('. ')[0].rstrip('.')
+        lines.append('%-36s = %-24r %s%s' % (name, get(name), summary,
+                                             tag))
+    return '\n'.join(lines)
+
+
+# -- debug mode (NaiveEngine analog) ----------------------------------------
+
+_naive_override = None
+
+
+def naive_engine():
+    """True when ops must run synchronously un-jitted (debug mode).
+
+    Hot path (called per eager op dispatch): lock-free — CPython dict
+    reads are atomic, and os.environ is a plain dict lookup."""
+    if _naive_override is not None:
+        return _naive_override
+    v = _values.get('MXNET_ENGINE_TYPE')
+    if v is None:
+        v = os.environ.get('MXNET_ENGINE_TYPE')
+    return v == 'NaiveEngine'
+
+
+def bulk_exec(training):
+    """Jit-cache enable for the eager dispatch path (reference:
+    MXNET_EXEC_BULK_EXEC_TRAIN/_INFERENCE). Lock-free like
+    naive_engine()."""
+    name = 'MXNET_EXEC_BULK_EXEC_TRAIN' if training else \
+        'MXNET_EXEC_BULK_EXEC_INFERENCE'
+    v = _values.get(name)
+    if v is not None:
+        return v
+    raw = os.environ.get(name)
+    if raw is None:
+        return True
+    return KNOBS[name].parse(raw)
+
+
+class NaiveEngineScope:
+    """Context manager forcing debug-mode execution:
+
+        with mx.config.NaiveEngineScope():
+            ...   # every op dispatches eagerly + synchronously
+    """
+
+    def __enter__(self):
+        global _naive_override
+        self._prev = _naive_override
+        _naive_override = True
+        return self
+
+    def __exit__(self, *exc):
+        global _naive_override
+        _naive_override = self._prev

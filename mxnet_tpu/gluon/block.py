@@ -489,7 +489,7 @@ class CachedOp:
         # different traces
         self._jitted = {}
 
-    def _make_fn(self, training):
+    def _make_fn(self, training, mirror=False):
         block = self._block
         param_names = [p.name for p in block._cached_op_params]
 
@@ -532,8 +532,13 @@ class CachedOp:
         # (reference analog: CachedOp StaticForward/StaticBackward,
         # cached_op.cc:728/1026).
         def wrapped_vjp(key, input_arrays, param_arrays):
-            return jax.vjp(lambda ins, ps: wrapped(key, ins, ps),
-                           list(input_arrays), list(param_arrays))
+            inner = lambda ins, ps: wrapped(key, ins, ps)
+            if mirror:
+                # remat: recompute forward activations in backward instead
+                # of keeping them live (reference: graph_executor.cc:338
+                # MXNET_BACKWARD_DO_MIRROR; TPU analog jax.checkpoint)
+                inner = jax.checkpoint(inner)
+            return jax.vjp(inner, list(input_arrays), list(param_arrays))
 
         jit_fn = jax.jit(wrapped)
         vjp_fn = jax.jit(wrapped_vjp)
@@ -542,9 +547,11 @@ class CachedOp:
     def __call__(self, inputs):
         block = self._block
         training = autograd.is_training()
-        sig = (training, tuple(x is None for x in inputs))
+        from ..config import get as _cfg
+        mirror = bool(_cfg('MXNET_BACKWARD_DO_MIRROR'))
+        sig = (training, mirror, tuple(x is None for x in inputs))
         if sig not in self._jitted:
-            self._jitted[sig] = self._make_fn(training)
+            self._jitted[sig] = self._make_fn(training, mirror)
         jit_fn, vjp_jit, meta = self._jitted[sig]
         params = block._cached_op_params
         param_arrays = [p.data()._data for p in params]
@@ -685,7 +692,8 @@ class HybridBlock(Block):
             # The deferred-init catch is per-block so each child infers its
             # own shapes during the probe.
             return self._eager_with_deferred_init(x, *args)
-        if self._active:
+        from ..config import naive_engine
+        if self._active and not naive_engine():
             if self._cached_op is None:
                 # ensure params are initialized (finish deferred shapes with
                 # one eager probe pass, without recursing into child caches)

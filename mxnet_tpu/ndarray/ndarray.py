@@ -527,9 +527,13 @@ def invoke(opname, nd_inputs, attrs, out=None):
     # pure fn directly so the captured graph stays flat for XLA fusion.
     traced = any(isinstance(a, jax.core.Tracer) for a in arrays)
 
+    from ..config import naive_engine as _naive, bulk_exec as _bulk
+    naive = not traced and _naive()
+
     jitted = None
     dyn_names = ()
-    if not traced and not op.nojit:
+    if not traced and not op.nojit and not naive and \
+            _bulk(autograd.is_training()):
         try:
             jitted, dyn_names = _get_jitted(op, attrs, recording, variadic)
         except TypeError:  # unhashable attr — fall back to direct dispatch
@@ -578,6 +582,10 @@ def invoke(opname, nd_inputs, attrs, out=None):
 
     single = not isinstance(out_arrays, (tuple, list))
     outs_raw = [out_arrays] if single else list(out_arrays)
+    if naive:
+        # NaiveEngine debug mode (env_var.md:104): synchronous execution,
+        # so failures surface at the faulting op with a python traceback
+        outs_raw = [jax.block_until_ready(a) for a in outs_raw]
     outputs = [NDArray(a) for a in outs_raw]
 
     if recording:
@@ -716,8 +724,18 @@ def minimum(lhs, rhs):
 
 
 def waitall():
-    """Block on all outstanding async work (reference: MXNDArrayWaitAll)."""
-    (jax.effects_barrier if hasattr(jax, 'effects_barrier') else lambda: None)()
+    """Block on all outstanding async work (reference: MXNDArrayWaitAll).
+
+    PJRT executes per-device work in dispatch order, so blocking on a
+    fresh trivial computation per device drains everything enqueued
+    before it; effects_barrier() flushes host callbacks."""
+    if hasattr(jax, 'effects_barrier'):
+        jax.effects_barrier()
+    try:
+        for dev in jax.devices():
+            jax.block_until_ready(jax.device_put(0, dev))
+    except RuntimeError:
+        pass
 
 
 def imports_done():
@@ -725,20 +743,70 @@ def imports_done():
 
 
 # ---------------------------------------------------------------------------
-# save / load — MXNet NDArray container format parity
-# (reference: src/ndarray/ndarray.cc:1578 Save / :1695 Load). Binary layout:
-#   uint64 magic=0x112745F8, uint64 reserved, uint64 ndarray count,
-#   [per array: the legacy TBlob header], uint64 name count, names.
-# We keep the same *API* (dict / list round-trip); storage uses the
-# documented magic plus an npz payload (cross-loading real MXNet .params
-# files is tracked for a later round in utils/mx_format.py).
+# save / load — the REAL MXNet NDArray container format
+# (reference: src/ndarray/ndarray.cc:1578 NDArray::Save / :1695 Load,
+# list container :1781 kMXAPINDArrayListMagic). Little-endian layout:
+#   uint64 0x112 magic, uint64 reserved,
+#   uint64 count, count x [uint32 0xF993FAC9, int32 stype(0=dense),
+#       int32 ndim + ndim x int64 shape, int32 dev_type + int32 dev_id,
+#       int32 type_flag, raw bytes],
+#   uint64 name count, names as (uint64 len + bytes).
+# Files written here load in reference MXNet and vice versa (dense
+# arrays; bf16 is stored as f32 — the reference has no bf16 type flag).
+# The pre-round-2 private npz container is still read for back-compat.
 # ---------------------------------------------------------------------------
 
-_NDARRAY_MAGIC = 0x112745F8
+_NDARRAY_MAGIC = 0x112745F8          # legacy private container
+_MX_LIST_MAGIC = 0x112               # kMXAPINDArrayListMagic
+_MX_V2_MAGIC = 0xF993FAC9            # NDARRAY_V2_MAGIC
+
+# mshadow TypeFlag <-> numpy (reference: mshadow/base.h TypeFlag)
+_MX_TYPE_FLAGS = {0: 'float32', 1: 'float64', 2: 'float16', 3: 'uint8',
+                  4: 'int32', 5: 'int8', 6: 'int64'}
+_MX_FLAG_OF = {v: k for k, v in _MX_TYPE_FLAGS.items()}
+
+
+def _mx_save_one(f, arr):
+    import struct
+    a = onp.ascontiguousarray(arr.asnumpy())
+    if a.dtype.name not in _MX_FLAG_OF:
+        a = a.astype(onp.float32)    # bf16 etc.: no reference type flag
+    f.write(struct.pack('<Ii', _MX_V2_MAGIC, 0))          # magic, dense
+    f.write(struct.pack('<i', a.ndim))
+    f.write(struct.pack('<%dq' % a.ndim, *a.shape))
+    f.write(struct.pack('<ii', 1, 0))                      # cpu:0
+    f.write(struct.pack('<i', _MX_FLAG_OF[a.dtype.name]))
+    f.write(a.tobytes())
+
+
+def _mx_load_one(f):
+    import struct
+    magic, = struct.unpack('<I', f.read(4))
+    if magic != _MX_V2_MAGIC:
+        # legacy V1/V0: magic is the V1 marker or the raw ndim
+        if magic == 0xF993FAC8:
+            ndim, = struct.unpack('<i', f.read(4))
+            shape = struct.unpack('<%dq' % ndim, f.read(8 * ndim))
+        else:
+            ndim = magic
+            shape = struct.unpack('<%dI' % ndim, f.read(4 * ndim))
+    else:
+        stype, = struct.unpack('<i', f.read(4))
+        if stype not in (-1, 0):
+            raise ValueError('sparse .params entries are not supported '
+                             '(storage type %d)' % stype)
+        ndim, = struct.unpack('<i', f.read(4))
+        shape = struct.unpack('<%dq' % ndim, f.read(8 * ndim))
+    f.read(8)                                              # context
+    type_flag, = struct.unpack('<i', f.read(4))
+    dtype = onp.dtype(_MX_TYPE_FLAGS[type_flag])
+    n = int(onp.prod(shape)) if shape else 1
+    data = onp.frombuffer(f.read(n * dtype.itemsize), dtype=dtype)
+    return NDArray(jnp.asarray(data.reshape(shape)))
 
 
 def save(fname, data):
-    import io as _io
+    """Save NDArrays in the reference MXNet .params container."""
     import struct
     if isinstance(data, NDArray):
         data = [data]
@@ -748,35 +816,53 @@ def save(fname, data):
     else:
         names = []
         arrays = list(data)
-    payload = _io.BytesIO()
-    onp.savez(payload, **{str(i): a.asnumpy() for i, a in enumerate(arrays)})
-    blob = payload.getvalue()
     with open(fname, 'wb') as f:
-        f.write(struct.pack('<QQQ', _NDARRAY_MAGIC, 0, len(arrays)))
+        f.write(struct.pack('<QQ', _MX_LIST_MAGIC, 0))
+        f.write(struct.pack('<Q', len(arrays)))
+        for a in arrays:
+            _mx_save_one(f, a)
         f.write(struct.pack('<Q', len(names)))
         for n in names:
             nb = n.encode('utf-8')
             f.write(struct.pack('<Q', len(nb)))
             f.write(nb)
-        f.write(struct.pack('<Q', len(blob)))
-        f.write(blob)
 
 
 def load(fname):
-    import io as _io
+    """Load a .params file — reference MXNet format or the legacy private
+    npz container from earlier rounds."""
     import struct
     with open(fname, 'rb') as f:
-        magic, _, count = struct.unpack('<QQQ', f.read(24))
-        if magic != _NDARRAY_MAGIC:
+        magic, _ = struct.unpack('<QQ', f.read(16))
+        if magic == _MX_LIST_MAGIC:
+            count, = struct.unpack('<Q', f.read(8))
+            arrays = [_mx_load_one(f) for _ in range(count)]
+            nname, = struct.unpack('<Q', f.read(8))
+            names = []
+            for _ in range(nname):
+                ln, = struct.unpack('<Q', f.read(8))
+                names.append(f.read(ln).decode('utf-8'))
+        elif magic == _NDARRAY_MAGIC:
+            return _load_legacy_npz(f)
+        else:
             raise ValueError('invalid NDArray file %s' % fname)
-        nname, = struct.unpack('<Q', f.read(8))
-        names = []
-        for _ in range(nname):
-            ln, = struct.unpack('<Q', f.read(8))
-            names.append(f.read(ln).decode('utf-8'))
-        blen, = struct.unpack('<Q', f.read(8))
-        npz = onp.load(_io.BytesIO(f.read(blen)))
-        arrays = [NDArray(jnp.asarray(npz[str(i)])) for i in range(count)]
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def _load_legacy_npz(f):
+    import io as _io
+    import struct
+    count, = struct.unpack('<Q', f.read(8))
+    nname, = struct.unpack('<Q', f.read(8))
+    names = []
+    for _ in range(nname):
+        ln, = struct.unpack('<Q', f.read(8))
+        names.append(f.read(ln).decode('utf-8'))
+    blen, = struct.unpack('<Q', f.read(8))
+    npz = onp.load(_io.BytesIO(f.read(blen)))
+    arrays = [NDArray(jnp.asarray(npz[str(i)])) for i in range(count)]
     if names:
         return dict(zip(names, arrays))
     return arrays

@@ -1,0 +1,236 @@
+"""Config/env system, NaiveEngine debug mode, remat flag, and reference
+MXNet checkpoint compatibility (reference: docs/faq/env_var.md,
+src/ndarray/ndarray.cc:1578, c_api_symbolic.cc:455)."""
+import json
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+def test_config_env_override(monkeypatch):
+    monkeypatch.setenv('MXNET_CPU_WORKER_NTHREADS', '7')
+    assert mx.config.get('MXNET_CPU_WORKER_NTHREADS') == 7
+    monkeypatch.delenv('MXNET_CPU_WORKER_NTHREADS')
+    assert mx.config.get('MXNET_CPU_WORKER_NTHREADS') == 4
+
+
+def test_config_set_wins_over_env(monkeypatch):
+    monkeypatch.setenv('MXNET_KVSTORE_BIGARRAY_BOUND', '123')
+    mx.config.set('MXNET_KVSTORE_BIGARRAY_BOUND', 999)
+    try:
+        assert mx.config.get('MXNET_KVSTORE_BIGARRAY_BOUND') == 999
+    finally:
+        mx.config._values.pop('MXNET_KVSTORE_BIGARRAY_BOUND', None)
+
+
+def test_config_unknown_knob_raises():
+    with pytest.raises(KeyError):
+        mx.config.set('MXNET_NO_SUCH_KNOB', 1)
+
+
+def test_config_describe_lists_all():
+    text = mx.config.describe()
+    for name in ('MXNET_ENGINE_TYPE', 'MXNET_BACKWARD_DO_MIRROR',
+                 'MXNET_CUDNN_AUTOTUNE_DEFAULT'):
+        assert name in text
+    assert 'no-op under XLA' in text
+
+
+def test_bool_knob_parsing(monkeypatch):
+    monkeypatch.setenv('MXNET_EXEC_BULK_EXEC_TRAIN', '0')
+    assert mx.config.get('MXNET_EXEC_BULK_EXEC_TRAIN') is False
+    monkeypatch.setenv('MXNET_EXEC_BULK_EXEC_TRAIN', '1')
+    assert mx.config.get('MXNET_EXEC_BULK_EXEC_TRAIN') is True
+
+
+# ---------------------------------------------------------------------------
+# NaiveEngine debug mode
+# ---------------------------------------------------------------------------
+
+def test_naive_engine_scope_bypasses_hybridize():
+    net = nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    with mx.config.NaiveEngineScope():
+        assert mx.config.naive_engine()
+        out = net(nd.array(np.ones((2, 4), 'float32')))
+        assert net._cached_op is None
+    assert not mx.config.naive_engine()
+    assert out.shape == (2, 3)
+
+
+def test_naive_engine_env(monkeypatch):
+    monkeypatch.setenv('MXNET_ENGINE_TYPE', 'NaiveEngine')
+    assert mx.config.naive_engine()
+    a = nd.array([1.0, 2.0]) + 1
+    np.testing.assert_allclose(a.asnumpy(), [2.0, 3.0])
+
+
+def test_naive_engine_matches_jitted_numerics():
+    x = np.random.RandomState(0).randn(4, 4).astype('float32')
+    fast = (nd.array(x).exp() * 2).sum().asscalar()
+    with mx.config.NaiveEngineScope():
+        slow = (nd.array(x).exp() * 2).sum().asscalar()
+    assert fast == pytest.approx(slow, rel=1e-6)
+
+
+def test_naive_engine_autograd_works():
+    with mx.config.NaiveEngineScope():
+        x = nd.array([2.0, 3.0])
+        x.attach_grad()
+        with autograd.record():
+            ((x * x).sum()).backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 6.0])
+
+
+# ---------------------------------------------------------------------------
+# remat (MXNET_BACKWARD_DO_MIRROR)
+# ---------------------------------------------------------------------------
+
+def test_backward_do_mirror_gradients_unchanged():
+    def grads(mirror):
+        mx.config.set('MXNET_BACKWARD_DO_MIRROR', mirror)
+        try:
+            np.random.seed(0)
+            mx.random.seed(0)
+            net = nn.HybridSequential()
+            with net.name_scope():
+                net.add(nn.Dense(8, activation='relu'), nn.Dense(2))
+            net.initialize(mx.init.Xavier())
+            net.hybridize()
+            x = nd.array(np.ones((2, 4), 'float32'))
+            x.attach_grad()
+            with autograd.record():
+                net(x).sum().backward()
+            return x.grad.asnumpy()
+        finally:
+            mx.config.set('MXNET_BACKWARD_DO_MIRROR', False)
+    np.testing.assert_allclose(grads(False), grads(True), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# reference .params format
+# ---------------------------------------------------------------------------
+
+def _reference_params_bytes(entries):
+    """Hand-pack the reference C++ layout (ndarray.cc:1578) independently
+    of our writer, so this guards the real on-disk format."""
+    out = b''
+    out += struct.pack('<QQ', 0x112, 0)
+    out += struct.pack('<Q', len(entries))
+    flag_of = {'float32': 0, 'float64': 1, 'float16': 2, 'uint8': 3,
+               'int32': 4, 'int8': 5, 'int64': 6}
+    for _, arr in entries:
+        out += struct.pack('<I', 0xF993FAC9)       # NDARRAY_V2_MAGIC
+        out += struct.pack('<i', 0)                # kDefaultStorage
+        out += struct.pack('<i', arr.ndim)
+        out += struct.pack('<%dq' % arr.ndim, *arr.shape)
+        out += struct.pack('<ii', 1, 0)            # Context cpu:0
+        out += struct.pack('<i', flag_of[arr.dtype.name])
+        out += arr.tobytes()
+    out += struct.pack('<Q', len(entries))
+    for name, _ in entries:
+        nb = name.encode()
+        out += struct.pack('<Q', len(nb)) + nb
+    return out
+
+
+def test_load_reference_params_fixture(tmp_path):
+    rs = np.random.RandomState(3)
+    entries = [('arg:fc_weight', rs.randn(3, 4).astype('float32')),
+               ('arg:fc_bias', rs.randn(3).astype('float32')),
+               ('aux:bn_mean', rs.randn(3).astype('float64')),
+               ('arg:idx', np.arange(4, dtype='int32'))]
+    path = tmp_path / 'ref.params'
+    path.write_bytes(_reference_params_bytes(entries))
+    loaded = nd.load(str(path))
+    assert set(loaded) == {n for n, _ in entries}
+    for name, arr in entries:
+        got = loaded[name].asnumpy()
+        if arr.dtype == np.float64:
+            # f64 entries load at f32 precision (jax default x64-off)
+            np.testing.assert_allclose(got, arr, rtol=1e-6)
+        else:
+            np.testing.assert_array_equal(got, arr)
+            assert got.dtype == arr.dtype
+
+
+def test_save_produces_reference_bytes(tmp_path):
+    """Our writer's bytes must equal the hand-packed reference layout."""
+    rs = np.random.RandomState(4)
+    w = rs.randn(2, 3).astype('float32')
+    path = tmp_path / 'out.params'
+    nd.save(str(path), {'w': nd.array(w)})
+    expect = _reference_params_bytes([('w', w)])
+    assert path.read_bytes() == expect
+
+
+def test_params_roundtrip_list_and_bf16(tmp_path):
+    path = tmp_path / 'l.params'
+    nd.save(str(path), [nd.array([1.0, 2.0]),
+                        nd.array([3.0]).astype('bfloat16')])
+    back = nd.load(str(path))
+    assert isinstance(back, list) and len(back) == 2
+    # bf16 has no reference type flag: stored as f32
+    assert back[1].asnumpy().dtype == np.float32
+
+
+def test_checkpoint_roundtrip_scores(tmp_path):
+    """Module checkpoint -> load_checkpoint -> identical scores (the
+    reference-produced-checkpoint gate, exercised through the same
+    on-disk format the reference reads/writes)."""
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name='fc')
+    out = mx.sym.SoftmaxOutput(fc, name='softmax')
+    mod = mx.mod.Module(out, data_names=['data'],
+                        label_names=['softmax_label'], context=mx.cpu())
+    x = np.random.RandomState(0).randn(2, 6).astype('float32')
+    it = mx.io.NDArrayIter(x, np.zeros(2), batch_size=2)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / 'lenet')
+    mod.save_checkpoint(prefix, 1)
+    scores1 = mod.predict(it).asnumpy()
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module(sym2, data_names=['data'],
+                         label_names=['softmax_label'], context=mx.cpu())
+    it.reset()
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.set_params(arg2, aux2)
+    it.reset()
+    scores2 = mod2.predict(it).asnumpy()
+    np.testing.assert_allclose(scores1, scores2, rtol=1e-5)
+
+
+def test_load_reference_style_symbol_json():
+    """A symbol JSON in the reference's stringified-attr style must load
+    and bind (c_api_symbolic.cc:455 MXSymbolCreateFromJSON)."""
+    graph = {
+        'nodes': [
+            {'op': 'null', 'name': 'data', 'inputs': []},
+            {'op': 'null', 'name': 'conv_weight', 'inputs': []},
+            {'op': 'null', 'name': 'conv_bias', 'inputs': []},
+            {'op': 'Convolution', 'name': 'conv',
+             'attrs': {'kernel': '(3, 3)', 'num_filter': '2',
+                       'stride': '(1, 1)', 'pad': '(1, 1)'},
+             'inputs': [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {'op': 'Activation', 'name': 'act',
+             'attrs': {'act_type': 'relu'}, 'inputs': [[3, 0, 0]]},
+        ],
+        'arg_nodes': [0, 1, 2],
+        'heads': [[4, 0, 0]],
+    }
+    sym = mx.sym.load_json(json.dumps(graph))
+    assert sym.list_arguments() == ['data', 'conv_weight', 'conv_bias']
+    ex = sym.simple_bind(mx.cpu(), data=(1, 3, 8, 8))
+    out = ex.forward()
+    assert out[0].shape == (1, 2, 8, 8)

@@ -44,6 +44,7 @@ from . import callback
 from . import operator
 from . import image
 from . import config
+from . import contrib
 
 # env-driven global seed (docs/faq/env_var.md MXNET_SEED)
 _seed = config.get('MXNET_SEED')

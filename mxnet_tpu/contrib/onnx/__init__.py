@@ -1,0 +1,10 @@
+"""ONNX interop (reference: python/mxnet/contrib/onnx/).
+
+mx2onnx.export_model / onnx2mx.import_model over an in-tree protobuf
+wire codec — the environment ships no onnx package, but the files are
+real ModelProtos (opset 11) readable by standard ONNX tooling.
+"""
+from .mx2onnx import export_model      # noqa: F401
+from .onnx2mx import import_model, get_model_metadata  # noqa: F401
+from . import mx2onnx as mx2onnx       # noqa: F401
+from . import onnx2mx as onnx_mxnet    # noqa: F401

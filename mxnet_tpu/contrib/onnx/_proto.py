@@ -1,0 +1,235 @@
+"""Minimal protobuf wire-format codec for the ONNX schema subset.
+
+The build environment has no `onnx` package (zero egress), so the
+ModelProto/GraphProto/NodeProto/TensorProto messages are encoded and
+decoded directly at the protobuf wire level (proto3 encoding rules:
+varints, length-delimited submessages). Field numbers follow
+onnx/onnx.proto3 — files produced here open in netron/onnxruntime and
+real ONNX files import here.
+
+A message is represented as a plain dict; the schema table maps
+(message name, field number) -> (field name, kind, repeated, submessage).
+Kinds: 'varint' (int/bool/enum), 'bytes' (bytes/str), 'msg', 'float'.
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ['encode', 'decode', 'SCHEMAS', 'TENSOR_DTYPES', 'ATTR_TYPES']
+
+# onnx TensorProto.DataType
+TENSOR_DTYPES = {'float32': 1, 'uint8': 2, 'int8': 3, 'uint16': 4,
+                 'int16': 5, 'int32': 6, 'int64': 7, 'bool': 9,
+                 'float16': 10, 'float64': 11}
+TENSOR_DTYPES_INV = {v: k for k, v in TENSOR_DTYPES.items()}
+
+# onnx AttributeProto.AttributeType
+ATTR_TYPES = {'FLOAT': 1, 'INT': 2, 'STRING': 3, 'TENSOR': 4,
+              'FLOATS': 6, 'INTS': 7, 'STRINGS': 8}
+
+# (field name, kind, repeated, submessage-schema-name)
+SCHEMAS = {
+    'Model': {
+        1: ('ir_version', 'varint', False, None),
+        2: ('producer_name', 'bytes', False, None),
+        3: ('producer_version', 'bytes', False, None),
+        4: ('domain', 'bytes', False, None),
+        5: ('model_version', 'varint', False, None),
+        6: ('doc_string', 'bytes', False, None),
+        7: ('graph', 'msg', False, 'Graph'),
+        8: ('opset_import', 'msg', True, 'OperatorSetId'),
+    },
+    'OperatorSetId': {
+        1: ('domain', 'bytes', False, None),
+        2: ('version', 'varint', False, None),
+    },
+    'Graph': {
+        1: ('node', 'msg', True, 'Node'),
+        2: ('name', 'bytes', False, None),
+        5: ('initializer', 'msg', True, 'Tensor'),
+        10: ('doc_string', 'bytes', False, None),
+        11: ('input', 'msg', True, 'ValueInfo'),
+        12: ('output', 'msg', True, 'ValueInfo'),
+        13: ('value_info', 'msg', True, 'ValueInfo'),
+    },
+    'Node': {
+        1: ('input', 'bytes', True, None),
+        2: ('output', 'bytes', True, None),
+        3: ('name', 'bytes', False, None),
+        4: ('op_type', 'bytes', False, None),
+        5: ('attribute', 'msg', True, 'Attribute'),
+        6: ('doc_string', 'bytes', False, None),
+        7: ('domain', 'bytes', False, None),
+    },
+    'Attribute': {
+        1: ('name', 'bytes', False, None),
+        2: ('f', 'float', False, None),
+        3: ('i', 'varint', False, None),
+        4: ('s', 'bytes', False, None),
+        5: ('t', 'msg', False, 'Tensor'),
+        7: ('floats', 'float', True, None),
+        8: ('ints', 'varint', True, None),
+        9: ('strings', 'bytes', True, None),
+        20: ('type', 'varint', False, None),
+    },
+    'Tensor': {
+        1: ('dims', 'varint', True, None),
+        2: ('data_type', 'varint', False, None),
+        4: ('float_data', 'float', True, None),
+        5: ('int32_data', 'varint', True, None),
+        7: ('int64_data', 'varint', True, None),
+        8: ('name', 'bytes', False, None),
+        9: ('raw_data', 'bytes', False, None),
+    },
+    'ValueInfo': {
+        1: ('name', 'bytes', False, None),
+        2: ('type', 'msg', False, 'Type'),
+    },
+    'Type': {
+        1: ('tensor_type', 'msg', False, 'TypeTensor'),
+    },
+    'TypeTensor': {
+        1: ('elem_type', 'varint', False, None),
+        2: ('shape', 'msg', False, 'TensorShape'),
+    },
+    'TensorShape': {
+        1: ('dim', 'msg', True, 'Dimension'),
+    },
+    'Dimension': {
+        1: ('dim_value', 'varint', False, None),
+        2: ('dim_param', 'bytes', False, None),
+    },
+}
+
+_BY_NAME = {name: {f[0]: (num,) + f[1:] for num, f in fields.items()}
+            for name, fields in SCHEMAS.items()}
+
+
+def _varint(value):
+    value &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf, pos):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+
+
+def _signed64(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _encode_field(num, kind, value, sub):
+    if kind == 'varint':
+        return _varint(num << 3) + _varint(int(value))
+    if kind == 'float':
+        return _varint((num << 3) | 5) + struct.pack('<f', float(value))
+    if kind == 'bytes':
+        data = value.encode('utf-8') if isinstance(value, str) else \
+            bytes(value)
+        return _varint((num << 3) | 2) + _varint(len(data)) + data
+    if kind == 'msg':
+        data = encode(sub, value)
+        return _varint((num << 3) | 2) + _varint(len(data)) + data
+    raise ValueError(kind)
+
+
+def encode(schema_name, msg):
+    """Encode dict `msg` as the protobuf message `schema_name`."""
+    fields = _BY_NAME[schema_name]
+    out = bytearray()
+    for key, value in msg.items():
+        if value is None:
+            continue
+        num, kind, repeated, sub = fields[key]
+        items = value if repeated else [value]
+        for item in items:
+            out += _encode_field(num, kind, item, sub)
+    return bytes(out)
+
+
+def decode(schema_name, buf):
+    """Decode protobuf bytes into a dict per `schema_name`; repeated
+    fields become lists, missing fields are absent."""
+    fields = SCHEMAS[schema_name]
+    msg = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        num, wire = tag >> 3, tag & 7
+        spec = fields.get(num)
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+            val = _signed64(val)
+        elif wire == 5:
+            val = struct.unpack('<f', buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            val = struct.unpack('<d', buf[pos:pos + 8])[0]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            raw = bytes(buf[pos:pos + ln])
+            pos += ln
+            if spec is None:
+                continue
+            name, kind, repeated, sub = spec
+            if kind == 'msg':
+                val = decode(sub, raw)
+            elif kind == 'bytes':
+                val = raw
+            elif kind in ('varint', 'float'):
+                # packed repeated scalars
+                vals = []
+                p = 0
+                while p < len(raw):
+                    if kind == 'varint':
+                        v, p = _read_varint(raw, p)
+                        vals.append(_signed64(v))
+                    else:
+                        vals.append(struct.unpack('<f',
+                                                  raw[p:p + 4])[0])
+                        p += 4
+                if repeated:
+                    msg.setdefault(name, []).extend(vals)
+                    continue
+                val = vals[0]
+            else:
+                val = raw
+            if repeated:
+                msg.setdefault(name, []).append(val)
+            else:
+                msg[name] = val
+            continue
+        else:
+            raise ValueError('unsupported wire type %d' % wire)
+        if spec is None:
+            continue
+        name, kind, repeated, sub = spec
+        if repeated:
+            msg.setdefault(name, []).append(val)
+        else:
+            msg[name] = val
+    return msg
+
+
+def text(value):
+    """bytes field -> str convenience for decoded messages."""
+    return value.decode('utf-8') if isinstance(value, (bytes,
+                                                       bytearray)) else value

@@ -1,0 +1,278 @@
+"""Symbol -> ONNX export (reference:
+python/mxnet/contrib/onnx/mx2onnx/_op_translations.py + export_model.py).
+
+Walks the Symbol node graph and emits an ONNX ModelProto (opset 11)
+through the in-tree wire codec (_proto.py) — no onnx package needed.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import _proto as P
+
+__all__ = ['export_model']
+
+
+def _tensor(name, arr):
+    arr = onp.ascontiguousarray(arr)
+    return {'name': name, 'dims': list(arr.shape),
+            'data_type': P.TENSOR_DTYPES[arr.dtype.name],
+            'raw_data': arr.tobytes()}
+
+
+def _vinfo(name, shape, dtype='float32'):
+    return {'name': name, 'type': {'tensor_type': {
+        'elem_type': P.TENSOR_DTYPES[dtype],
+        'shape': {'dim': [{'dim_value': int(d)} for d in shape]}}}}
+
+
+def _attr(name, value):
+    if isinstance(value, float):
+        return {'name': name, 'f': value, 'type': P.ATTR_TYPES['FLOAT']}
+    if isinstance(value, bool) or isinstance(value, int):
+        return {'name': name, 'i': int(value), 'type': P.ATTR_TYPES['INT']}
+    if isinstance(value, str):
+        return {'name': name, 's': value, 'type': P.ATTR_TYPES['STRING']}
+    if isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            return {'name': name, 'floats': [float(v) for v in value],
+                    'type': P.ATTR_TYPES['FLOATS']}
+        return {'name': name, 'ints': [int(v) for v in value],
+                'type': P.ATTR_TYPES['INTS']}
+    raise ValueError('unsupported attribute %s=%r' % (name, value))
+
+
+def _tup(v, n=2):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+class _Exporter:
+    def __init__(self, params):
+        self.params = dict(params)
+        self.nodes = []
+        self.initializers = []
+        self.extra_inputs = []
+        self._uid = 0
+
+    def uid(self, hint):
+        self._uid += 1
+        return '%s_%d' % (hint, self._uid)
+
+    def const_tensor(self, hint, arr):
+        name = self.uid(hint)
+        self.initializers.append(_tensor(name, arr))
+        return name
+
+    def emit(self, op_type, inputs, outputs, name, **attrs):
+        self.nodes.append({
+            'op_type': op_type, 'name': name,
+            'input': list(inputs), 'output': list(outputs),
+            'attribute': [_attr(k, v) for k, v in attrs.items()
+                          if v is not None]})
+
+
+def _conv(ex, name, ins, attrs, out):
+    kernel = _tup(attrs.get('kernel'))
+    pad = _tup(attrs.get('pad', 0))
+    ex.emit('Conv', ins, [out], name,
+            kernel_shape=list(kernel),
+            strides=list(_tup(attrs.get('stride', 1))),
+            dilations=list(_tup(attrs.get('dilate', 1))),
+            pads=list(pad) + list(pad),
+            group=int(attrs.get('num_group', 1)))
+
+
+def _pooling(ex, name, ins, attrs, out):
+    ptype = attrs.get('pool_type', 'max')
+    if attrs.get('global_pool', False):
+        ex.emit('GlobalMaxPool' if ptype == 'max' else 'GlobalAveragePool',
+                ins[:1], [out], name)
+        return
+    kernel = _tup(attrs.get('kernel'))
+    pad = _tup(attrs.get('pad', 0))
+    kw = dict(kernel_shape=list(kernel),
+              strides=list(_tup(attrs.get('stride', 1))),
+              pads=list(pad) + list(pad),
+              ceil_mode=int(bool(attrs.get('pooling_convention', 'valid')
+                                 == 'full' or attrs.get('ceil_mode',
+                                                        False))))
+    if ptype == 'max':
+        ex.emit('MaxPool', ins[:1], [out], name, **kw)
+    else:
+        kw['count_include_pad'] = int(bool(attrs.get('count_include_pad',
+                                                     True)))
+        ex.emit('AveragePool', ins[:1], [out], name, **kw)
+
+
+def _fully_connected(ex, name, ins, attrs, out):
+    data = ins[0]
+    if attrs.get('flatten', True):
+        flat = ex.uid(name + '_flat')
+        ex.emit('Flatten', [data], [flat], name + '_flatten', axis=1)
+        data = flat
+    if attrs.get('no_bias', False):
+        # Gemm needs C; fall back to MatMul with transposed weight
+        wt = ex.uid(name + '_wT')
+        ex.emit('Transpose', [ins[1]], [wt], name + '_transpose',
+                perm=[1, 0])
+        ex.emit('MatMul', [data, wt], [out], name)
+    else:
+        ex.emit('Gemm', [data, ins[1], ins[2]], [out], name, alpha=1.0,
+                beta=1.0, transA=0, transB=1)
+
+
+def _batch_norm(ex, name, ins, attrs, out, node):
+    if attrs.get('fix_gamma', True):
+        # reference semantics: gamma pinned to 1
+        gname = node.inputs[1][0].name
+        if gname in ex.params:
+            ex.params[gname] = onp.ones_like(
+                onp.asarray(ex.params[gname]))
+    ex.emit('BatchNormalization', ins[:5], [out], name,
+            epsilon=float(attrs.get('eps', 1e-3)),
+            momentum=float(attrs.get('momentum', 0.9)))
+
+
+_ACTIVATIONS = {'relu': 'Relu', 'sigmoid': 'Sigmoid', 'tanh': 'Tanh',
+                'softrelu': 'Softplus', 'softsign': 'Softsign'}
+
+_SIMPLE_BINARY = {'elemwise_add': 'Add', '_Plus': 'Add', '_plus': 'Add',
+                  'broadcast_add': 'Add', 'elemwise_sub': 'Sub',
+                  'broadcast_sub': 'Sub', 'elemwise_mul': 'Mul',
+                  'broadcast_mul': 'Mul', 'elemwise_div': 'Div',
+                  'broadcast_div': 'Div'}
+
+_SIMPLE_UNARY = {'relu': 'Relu', 'sigmoid': 'Sigmoid', 'tanh': 'Tanh',
+                 'exp': 'Exp', 'log': 'Log', 'sqrt': 'Sqrt', 'abs': 'Abs',
+                 'negative': 'Neg', 'floor': 'Floor', 'ceil': 'Ceil',
+                 'erf': 'Erf', 'identity': 'Identity', '_copy': 'Identity'}
+
+
+def _translate(ex, node, ins, out):
+    opname = node.op.name
+    attrs = {k: v for k, v in (node.attrs or {}).items() if v is not None}
+    name = node.name
+    if opname == 'Convolution':
+        _conv(ex, name, ins, attrs, out)
+    elif opname in ('Pooling', 'Pooling_v1'):
+        _pooling(ex, name, ins, attrs, out)
+    elif opname == 'FullyConnected':
+        _fully_connected(ex, name, ins, attrs, out)
+    elif opname.startswith('BatchNorm'):
+        _batch_norm(ex, name, ins, attrs, out, node)
+    elif opname == 'Activation':
+        ex.emit(_ACTIVATIONS[attrs.get('act_type', 'relu')], ins, [out],
+                name)
+    elif opname == 'LeakyReLU':
+        act = attrs.get('act_type', 'leaky')
+        if act == 'leaky':
+            ex.emit('LeakyRelu', ins[:1], [out], name,
+                    alpha=float(attrs.get('slope', 0.25)))
+        elif act == 'elu':
+            ex.emit('Elu', ins[:1], [out], name,
+                    alpha=float(attrs.get('slope', 0.25)))
+        else:
+            raise NotImplementedError('LeakyReLU act_type=%s' % act)
+    elif opname in ('Flatten', 'flatten'):
+        ex.emit('Flatten', ins, [out], name, axis=1)
+    elif opname in ('Concat', 'concat'):
+        ex.emit('Concat', ins, [out], name,
+                axis=int(attrs.get('dim', 1)))
+    elif opname == 'Dropout':
+        ex.emit('Dropout', ins, [out], name,
+                ratio=float(attrs.get('p', 0.5)))
+    elif opname in ('softmax', 'SoftmaxOutput', 'Softmax'):
+        ex.emit('Softmax', ins[:1], [out], name,
+                axis=int(attrs.get('axis', -1)) if opname == 'softmax'
+                else 1)
+    elif opname in ('Reshape', 'reshape'):
+        shape_name = ex.const_tensor(
+            name + '_shape', onp.asarray(attrs['shape'], onp.int64))
+        ex.emit('Reshape', [ins[0], shape_name], [out], name)
+    elif opname == 'transpose':
+        ex.emit('Transpose', ins, [out], name,
+                perm=list(attrs.get('axes', [])) or None)
+    elif opname == 'clip':
+        lo = ex.const_tensor(name + '_min',
+                             onp.float32(attrs.get('a_min')))
+        hi = ex.const_tensor(name + '_max',
+                             onp.float32(attrs.get('a_max')))
+        ex.emit('Clip', [ins[0], lo, hi], [out], name)
+    elif opname in _SIMPLE_BINARY:
+        ex.emit(_SIMPLE_BINARY[opname], ins, [out], name)
+    elif opname in _SIMPLE_UNARY:
+        ex.emit(_SIMPLE_UNARY[opname], ins, [out], name)
+    elif opname == 'Embedding':
+        ex.emit('Gather', [ins[1], ins[0]], [out], name, axis=0)
+    elif opname == 'LayerNorm':
+        ex.emit('LayerNormalization', ins[:3], [out], name,
+                axis=int(attrs.get('axis', -1)),
+                epsilon=float(attrs.get('eps', 1e-5)))
+    else:
+        raise NotImplementedError(
+            'ONNX export: no translation for op %s' % opname)
+
+
+def export_model(sym, params, input_shapes, input_types='float32',
+                 onnx_file_path='model.onnx', verbose=False):
+    """Export a Symbol + params to an ONNX file
+    (reference: mx2onnx/export_model.py export_model). Returns the path.
+    """
+    ex = _Exporter({k.split(':', 1)[-1]: v for k, v in params.items()})
+    nodes = sym._nodes()
+    entries = sym._entries
+    arg_names = sym.list_arguments()
+    shapes = input_shapes if isinstance(input_shapes, list) else \
+        [input_shapes]
+    data_names = [n for n in arg_names
+                  if n not in ex.params][:len(shapes)]
+
+    out_of = {}
+    graph_inputs = []
+    for node in nodes:
+        if node.is_variable:
+            out_of[id(node)] = [node.name]
+            if node.name in ex.params:
+                arr = ex.params[node.name]
+                arr = arr.asnumpy() if hasattr(arr, 'asnumpy') else \
+                    onp.asarray(arr)
+                ex.params[node.name] = arr
+            else:
+                idx = data_names.index(node.name) \
+                    if node.name in data_names else 0
+                graph_inputs.append(_vinfo(node.name, shapes[idx]))
+            continue
+        ins = [out_of[id(c)][i] for (c, i) in node.inputs]
+        n_out = node.num_outputs if node.num_outputs and \
+            node.num_outputs > 0 else 1
+        outs = [node.name if j == 0 else '%s_out%d' % (node.name, j)
+                for j in range(n_out)]
+        out_of[id(node)] = outs
+        _translate(ex, node, ins, outs[0])
+
+    # initializers AFTER translation (fix_gamma may rewrite params)
+    for pname, arr in ex.params.items():
+        ex.initializers.append(_tensor(pname, onp.asarray(arr)))
+    outputs = [_vinfo(out_of[id(n)][i], []) for (n, i) in entries]
+    # output shape dims unknown -> emit without dims
+    for o in outputs:
+        o['type']['tensor_type'].pop('shape', None)
+
+    graph = {'name': getattr(sym, 'name', 'mxnet_tpu'),
+             'node': ex.nodes,
+             'initializer': ex.initializers,
+             'input': graph_inputs,
+             'output': outputs}
+    model = {'ir_version': 6,
+             'producer_name': 'mxnet_tpu',
+             'producer_version': '0.1',
+             'opset_import': [{'domain': '', 'version': 11}],
+             'graph': graph}
+    blob = P.encode('Model', model)
+    with open(onnx_file_path, 'wb') as f:
+        f.write(blob)
+    return onnx_file_path

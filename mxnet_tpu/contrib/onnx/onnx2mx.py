@@ -1,0 +1,272 @@
+"""ONNX -> Symbol import (reference:
+python/mxnet/contrib/onnx/onnx2mx/import_model.py + _import_helper.py).
+
+Decodes the ModelProto with the in-tree wire codec and rebuilds the
+graph with mx.sym ops; initializers become arg/aux params.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import _proto as P
+from ... import symbol as sym_mod
+from ... import ndarray as nd
+
+__all__ = ['import_model', 'get_model_metadata']
+
+
+def _np_of_tensor(t):
+    dtype = onp.dtype(P.TENSOR_DTYPES_INV[t['data_type']])
+    dims = [int(d) for d in t.get('dims', [])]
+    if 'raw_data' in t and t['raw_data']:
+        arr = onp.frombuffer(t['raw_data'], dtype=dtype)
+    elif 'float_data' in t:
+        arr = onp.asarray(t['float_data'], dtype)
+    elif 'int64_data' in t:
+        arr = onp.asarray(t['int64_data'], dtype)
+    elif 'int32_data' in t:
+        arr = onp.asarray(t['int32_data'], dtype)
+    else:
+        arr = onp.zeros(dims, dtype)
+    return arr.reshape(dims)
+
+
+def _attrs_of(node):
+    out = {}
+    for a in node.get('attribute', []):
+        name = P.text(a['name'])
+        t = a.get('type')
+        if t == P.ATTR_TYPES['FLOAT']:
+            out[name] = a.get('f', 0.0)
+        elif t == P.ATTR_TYPES['INT']:
+            out[name] = a.get('i', 0)
+        elif t == P.ATTR_TYPES['STRING']:
+            out[name] = P.text(a.get('s', b''))
+        elif t == P.ATTR_TYPES['INTS']:
+            out[name] = [int(v) for v in a.get('ints', [])]
+        elif t == P.ATTR_TYPES['FLOATS']:
+            out[name] = [float(v) for v in a.get('floats', [])]
+        elif t == P.ATTR_TYPES['TENSOR']:
+            out[name] = _np_of_tensor(a['t'])
+    return out
+
+
+def _pair(v, default):
+    if not v:
+        return default
+    return tuple(v[:2]) if len(v) >= 2 else (v[0], v[0])
+
+
+def _split_pads(data, pads, name):
+    """ONNX pads = [x1b, x2b, x1e, x2e]. Symmetric pads return (data,
+    sym_pad); asymmetric ones become an explicit Pad node and (0, 0)."""
+    S = sym_mod
+    if not pads:
+        return data, (0, 0)
+    n = len(pads) // 2
+    begin, end = pads[:n], pads[n:]
+    if list(begin) == list(end):
+        return data, tuple(begin[:2])
+    width = [0, 0, 0, 0]
+    for b, e in zip(begin, end):
+        width.extend([int(b), int(e)])
+    return S.Pad(data, mode='constant', pad_width=tuple(width),
+                 name=name + '_pad'), (0, 0)
+
+
+def _import_node(op_type, name, ins, attrs, consts):
+    S = sym_mod
+    if op_type == 'Conv':
+        data, pad = _split_pads(ins[0], attrs.get('pads'), name)
+        return S.Convolution(data, *ins[1:],
+                             kernel=tuple(attrs['kernel_shape']),
+                             stride=_pair(attrs.get('strides'), (1, 1)),
+                             dilate=_pair(attrs.get('dilations'), (1, 1)),
+                             pad=pad,
+                             num_group=int(attrs.get('group', 1)),
+                             num_filter=0, no_bias=len(ins) == 2,
+                             name=name)
+    if op_type == 'BatchNormalization':
+        return S.BatchNorm(*ins, eps=attrs.get('epsilon', 1e-5),
+                           momentum=attrs.get('momentum', 0.9),
+                           fix_gamma=False, name=name)
+    if op_type in ('MaxPool', 'AveragePool'):
+        data, pad = _split_pads(ins[0], attrs.get('pads'), name)
+        return S.Pooling(data, kernel=tuple(attrs['kernel_shape']),
+                         stride=_pair(attrs.get('strides'), (1, 1)),
+                         pad=pad,
+                         pool_type='max' if op_type == 'MaxPool'
+                         else 'avg',
+                         pooling_convention='full'
+                         if attrs.get('ceil_mode') else 'valid',
+                         count_include_pad=bool(attrs.get(
+                             'count_include_pad', 1)),
+                         name=name)
+    if op_type == 'GlobalAveragePool':
+        return S.Pooling(ins[0], global_pool=True, pool_type='avg',
+                         kernel=(1, 1), name=name)
+    if op_type == 'GlobalMaxPool':
+        return S.Pooling(ins[0], global_pool=True, pool_type='max',
+                         kernel=(1, 1), name=name)
+    if op_type == 'Gemm':
+        alpha = float(attrs.get('alpha', 1.0))
+        beta = float(attrs.get('beta', 1.0))
+        trans_a = int(attrs.get('transA', 0))
+        trans_b = int(attrs.get('transB', 0))
+        if alpha == 1.0 and beta == 1.0 and not trans_a and trans_b:
+            return S.FullyConnected(*ins, num_hidden=0, flatten=False,
+                                    name=name)
+        # general Gemm: alpha*A'@B' + beta*C composed explicitly
+        out = S.dot(ins[0], ins[1], transpose_a=bool(trans_a),
+                    transpose_b=bool(trans_b), name=name + '_dot')
+        if alpha != 1.0:
+            out = out * alpha
+        if len(ins) > 2:
+            c = ins[2] * beta if beta != 1.0 else ins[2]
+            out = S.broadcast_add(out, c, name=name + '_bias')
+        return out
+    if op_type == 'MatMul':
+        return S.dot(*ins, name=name)
+    if op_type == 'Flatten':
+        return S.Flatten(ins[0], name=name)
+    if op_type == 'Relu':
+        return S.Activation(ins[0], act_type='relu', name=name)
+    if op_type == 'Sigmoid':
+        return S.Activation(ins[0], act_type='sigmoid', name=name)
+    if op_type == 'Tanh':
+        return S.Activation(ins[0], act_type='tanh', name=name)
+    if op_type == 'Softplus':
+        return S.Activation(ins[0], act_type='softrelu', name=name)
+    if op_type == 'LeakyRelu':
+        return S.LeakyReLU(ins[0], act_type='leaky',
+                           slope=attrs.get('alpha', 0.01), name=name)
+    if op_type == 'Elu':
+        return S.LeakyReLU(ins[0], act_type='elu',
+                           slope=attrs.get('alpha', 1.0), name=name)
+    if op_type == 'Softmax':
+        # opset<13 semantics: default axis=1, softmax over the input
+        # FLATTENED from axis onward
+        axis = int(attrs.get('axis', 1))
+        if axis in (-1,):
+            return S.softmax(ins[0], axis=-1, name=name)
+        flat = S.reshape(ins[0], shape=(0,) * axis + (-1,),
+                         name=name + '_flat2d')
+        sm = S.softmax(flat, axis=-1, name=name)
+        return S.reshape_like(sm, ins[0], name=name + '_unflat')
+    if op_type == 'Concat':
+        return S.Concat(*ins, dim=int(attrs.get('axis', 1)), name=name)
+    if op_type == 'Dropout':
+        return S.Dropout(ins[0], p=attrs.get('ratio', 0.5), name=name)
+    if op_type == 'Add':
+        return S.elemwise_add(*ins, name=name) if _same_shape_hint(ins) \
+            else S.broadcast_add(*ins, name=name)
+    if op_type == 'Sub':
+        return S.broadcast_sub(*ins, name=name)
+    if op_type == 'Mul':
+        return S.broadcast_mul(*ins, name=name)
+    if op_type == 'Div':
+        return S.broadcast_div(*ins, name=name)
+    if op_type == 'Reshape':
+        shape = consts.get(_name_of(ins[1]))
+        if shape is None:
+            raise NotImplementedError('dynamic Reshape shape input')
+        return S.Reshape(ins[0], shape=tuple(int(v) for v in shape),
+                         name=name)
+    if op_type == 'Transpose':
+        perm = attrs.get('perm')
+        return S.transpose(ins[0], axes=tuple(perm) if perm else None,
+                           name=name)
+    if op_type == 'Clip':
+        lo = consts.get(_name_of(ins[1])) if len(ins) > 1 else None
+        hi = consts.get(_name_of(ins[2])) if len(ins) > 2 else None
+        return S.clip(ins[0],
+                      a_min=float(lo) if lo is not None
+                      else attrs.get('min'),
+                      a_max=float(hi) if hi is not None
+                      else attrs.get('max'), name=name)
+    if op_type == 'Gather':
+        return S.take(ins[0], ins[1], axis=int(attrs.get('axis', 0)),
+                      name=name)
+    if op_type == 'LayerNormalization':
+        return S.LayerNorm(*ins, axis=int(attrs.get('axis', -1)),
+                           eps=attrs.get('epsilon', 1e-5), name=name)
+    if op_type == 'Identity':
+        return S.identity(ins[0], name=name)
+    raise NotImplementedError('ONNX import: unsupported op %s' % op_type)
+
+
+def _name_of(s):
+    return s.name if hasattr(s, 'name') else str(s)
+
+
+def _same_shape_hint(ins):
+    return True
+
+
+def import_model(model_file):
+    """Import an ONNX file -> (sym, arg_params, aux_params)
+    (reference: onnx2mx/import_model.py import_model)."""
+    with open(model_file, 'rb') as f:
+        model = P.decode('Model', f.read())
+    graph = model['graph']
+    inits = {}
+    consts = {}
+    for t in graph.get('initializer', []):
+        name = P.text(t['name'])
+        inits[name] = _np_of_tensor(t)
+        consts[name] = inits[name]
+    produced = {}
+    for vi in graph.get('input', []):
+        name = P.text(vi['name'])
+        if name not in inits:
+            produced[name] = sym_mod.Variable(name)
+    # initializer-backed names become Variables bound to params
+    for name in inits:
+        produced[name] = sym_mod.Variable(name)
+
+    for node in graph.get('node', []):
+        op_type = P.text(node['op_type'])
+        name = P.text(node.get('name', b'')) or None
+        in_names = [P.text(s) for s in node.get('input', [])]
+        ins = [produced[n] for n in in_names if n]
+        out = _import_node(op_type, name, ins, _attrs_of(node), consts)
+        out_names = [P.text(s) for s in node.get('output', [])]
+        outs = list(out) if len(out_names) > 1 and len(out) > 1 else [out]
+        for i, oname in enumerate(out_names):
+            produced[oname] = outs[i] if i < len(outs) else outs[0]
+
+    out_syms = [produced[P.text(o['name'])] for o in graph['output']]
+    final = out_syms[0] if len(out_syms) == 1 else \
+        sym_mod.Group(out_syms)
+    arg_names = set(final.list_arguments())
+    aux_names = set(final.list_auxiliary_states())
+    arg_params = {}
+    aux_params = {}
+    for name, arr in inits.items():
+        target = aux_params if name in aux_names else arg_params
+        if name in arg_names or name in aux_names:
+            target[name] = nd.array(arr.astype(
+                'float32' if arr.dtype == onp.float64 else arr.dtype))
+    return final, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    """Input/output descriptions of an ONNX model
+    (reference: onnx2mx/import_model.py get_model_metadata)."""
+    with open(model_file, 'rb') as f:
+        model = P.decode('Model', f.read())
+    graph = model['graph']
+    inits = {P.text(t['name']) for t in graph.get('initializer', [])}
+
+    def shapes(vis):
+        out = []
+        for vi in vis:
+            name = P.text(vi['name'])
+            if name in inits:
+                continue
+            dims = vi.get('type', {}).get('tensor_type', {}).get(
+                'shape', {}).get('dim', [])
+            out.append((name, tuple(d.get('dim_value') for d in dims)))
+        return out
+    return {'input_tensor_data': shapes(graph.get('input', [])),
+            'output_tensor_data': shapes(graph.get('output', []))}

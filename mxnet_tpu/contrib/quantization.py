@@ -1,0 +1,174 @@
+"""INT8 post-training quantization flow (reference:
+python/mxnet/contrib/quantization.py:423 quantize_model + :262 calibrate).
+
+Pipeline: calibrate activation ranges over sample data (naive min/max or
+percentile), quantize Convolution/FullyConnected weights offline to
+symmetric int8, and rewrite the symbol graph so each quantized layer
+consumes `_contrib_quantize_v2(data)` and runs the int8 MXU kernel
+(ops/quantization.py). Layers can be excluded by name; everything else
+stays f32.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ops import registry as _registry
+from ..symbol.symbol import Symbol, _Node
+from ..symbol.graph import num_outputs_of
+
+__all__ = ['quantize_model', 'calib_graph']
+
+_QUANTIZABLE = {'Convolution': '_contrib_quantized_conv',
+                'FullyConnected': '_contrib_quantized_fully_connected'}
+
+
+def _collect_layer_inputs(sym, names):
+    """Symbols for the data input of every node in `names` (first input
+    entry), for calibration."""
+    from ..symbol.symbol import Group
+    nodes = sym._nodes()
+    taps = {}
+    for node in nodes:
+        if node.name in names and node.inputs:
+            taps[node.name] = Symbol([node.inputs[0]])
+    return taps
+
+
+def calib_graph(sym, calib_data, arg_params, aux_params, layer_names,
+                calib_mode='naive', percentile=0.999, ctx=None,
+                data_name='data'):
+    """Run forward passes collecting (min, max) of each quantized layer's
+    input (reference: quantization.py calibrate via monitor callbacks).
+
+    calib_data: iterable of input NDArray batches (single-input nets).
+    Returns {layer name: (min, max)}.
+    """
+    from ..symbol.symbol import Group
+    from ..context import cpu
+    taps = _collect_layer_inputs(sym, layer_names)
+    order = sorted(taps)
+    group = Group([taps[n] for n in order])
+    ranges = {n: [onp.inf, -onp.inf] for n in order}
+    stats = {n: [] for n in order}
+    ex = None
+    for batch in calib_data:
+        batch = batch if isinstance(batch, nd.NDArray) else nd.array(batch)
+        if ex is None:
+            ex = group.bind(ctx or cpu(), args=dict(
+                {data_name: batch},
+                **{k: v for k, v in arg_params.items()}),
+                aux_states=dict(aux_params))
+        else:
+            # one bind/compile; per-batch data writes reuse the executor
+            ex.arg_dict[data_name][:] = batch
+        outs = ex.forward()
+        for name, out in zip(order, outs):
+            a = out.asnumpy()
+            if calib_mode == 'percentile':
+                stats[name].append(onp.abs(a).ravel())
+            lo, hi = float(a.min()), float(a.max())
+            ranges[name][0] = min(ranges[name][0], lo)
+            ranges[name][1] = max(ranges[name][1], hi)
+    if calib_mode == 'percentile':
+        for name in order:
+            flat = onp.concatenate(stats[name])
+            bound = float(onp.quantile(flat, percentile))
+            ranges[name] = [-bound, bound]
+    return {n: tuple(v) for n, v in ranges.items()}
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=('data',),
+                   excluded_sym_names=(), calib_mode='naive',
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype='int8', ctx=None, percentile=0.999,
+                   logger=None):
+    """Quantize a model to int8 (reference: quantization.py:423).
+
+    Returns (qsym, qarg_params, aux_params). Convolution/FullyConnected
+    layers (minus exclusions) run as int8 MXU kernels; weights are
+    quantized offline; activation ranges come from calibration (required:
+    calib_data with calib_mode 'naive' or 'percentile').
+    """
+    assert quantized_dtype == 'int8', 'TPU int8 path only'
+    excluded = set(excluded_sym_names or ())
+    nodes = sym._nodes()
+    target_names = [n.name for n in nodes
+                    if n.op is not None and n.op.name in _QUANTIZABLE
+                    and n.name not in excluded]
+    if calib_data is None:
+        raise ValueError("calibration data is required (calib_mode '%s')"
+                         % calib_mode)
+    ranges = calib_graph(sym, calib_data, arg_params, aux_params,
+                         set(target_names), calib_mode=calib_mode,
+                         percentile=percentile, ctx=ctx,
+                         data_name=list(data_names)[0])
+
+    qarg_params = dict(arg_params)
+    new_vars = {}
+
+    def qvar(name):
+        if name not in new_vars:
+            new_vars[name] = _Node(None, name)
+        return new_vars[name]
+
+    mapping = {}
+    new_nodes = []
+    for node in nodes:
+        if node.is_variable:
+            nn_ = _Node(None, node.name, var_attrs=dict(node.var_attrs))
+            nn_.is_aux = getattr(node, 'is_aux', False)
+            mapping[id(node)] = nn_
+            new_nodes.append(nn_)
+            continue
+        ins = [(mapping[id(c)], i) for (c, i) in node.inputs]
+        if node.name in ranges and node.op.name in _QUANTIZABLE:
+            lo, hi = ranges[node.name]
+            # quantize the incoming activation
+            qop = _registry.get('_contrib_quantize_v2')
+            qnode = _Node(qop, node.name + '_quantize',
+                          attrs={'min_calib_range': lo,
+                                 'max_calib_range': hi},
+                          inputs=[ins[0]], num_outputs=3)
+            new_nodes.append(qnode)
+            # quantize the weight offline
+            wvar = node.inputs[1][0]
+            w = arg_params[wvar.name].asnumpy()
+            wmax = float(onp.abs(w).max()) or 1.0
+            wscale = 127.0 / wmax
+            qw = onp.clip(onp.round(w * wscale), -127, 127).astype(
+                onp.int8)
+            qarg_params.pop(wvar.name, None)
+            qarg_params[wvar.name + '_quantized'] = nd.array(qw)
+            for extra, val in ((wvar.name + '_min', -wmax),
+                               (wvar.name + '_max', wmax)):
+                qarg_params[extra] = nd.array(onp.float32([val]).reshape(
+                    ()))
+            attrs = dict(node.attrs or {})
+            no_bias = bool(attrs.get('no_bias', False))
+            q_ins = [(qnode, 0), (qvar(wvar.name + '_quantized'), 0)]
+            if not no_bias and len(node.inputs) > 2:
+                q_ins.append(ins[2])
+            q_ins += [(qnode, 1), (qnode, 2),
+                      (qvar(wvar.name + '_min'), 0),
+                      (qvar(wvar.name + '_max'), 0)]
+            qcop = _registry.get(_QUANTIZABLE[node.op.name])
+            qcnode = _Node(qcop, node.name + '_quantized', attrs=attrs,
+                           inputs=q_ins, num_outputs=1)
+            for v in (qvar(wvar.name + '_quantized'),
+                      qvar(wvar.name + '_min'),
+                      qvar(wvar.name + '_max')):
+                if v not in new_nodes:
+                    new_nodes.append(v)
+            new_nodes.append(qcnode)
+            mapping[id(node)] = qcnode
+        else:
+            nn_ = _Node(node.op, node.name,
+                        attrs=dict(node.attrs or {}), inputs=ins,
+                        num_outputs=node.num_outputs)
+            mapping[id(node)] = nn_
+            new_nodes.append(nn_)
+
+    heads = [(mapping[id(n)], i) for (n, i) in sym._entries]
+    qsym = Symbol(heads)
+    return qsym, qarg_params, dict(aux_params)

@@ -1,0 +1,114 @@
+"""INT8 quantization operators (reference: src/operator/quantization/ —
+quantize_v2, dequantize, quantized_conv, quantized_fully_connected,
+requantize).
+
+TPU-native scheme: symmetric int8 with per-tensor scales. The MXU
+multiplies int8 x int8 accumulating int32 (preferred_element_type), so
+quantized conv/FC run the cheap integer path and fold the combined
+scale (and bias) into the f32 epilogue — one fused kernel under XLA,
+instead of the reference's separate requantize/dequantize ops. The
+quantized compute ops therefore emit f32 directly; quantize_v2 is the
+only boundary op the graph rewriter inserts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .nn import _tup
+
+__all__ = []
+
+
+def _scale_of(min_range, max_range):
+    return 127.0 / jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                           jnp.abs(max_range)), 1e-12)
+
+
+@register('_contrib_quantize_v2', num_outputs=3)
+def quantize_v2(data, *, min_calib_range=None, max_calib_range=None,
+                out_type='int8'):
+    """f32 -> int8 with a static calibrated range
+    (reference: quantization/quantize_v2-inl.h)."""
+    lo = float(min_calib_range if min_calib_range is not None else -1.0)
+    hi = float(max_calib_range if max_calib_range is not None else 1.0)
+    scale = _scale_of(jnp.float32(lo), jnp.float32(hi))
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, jnp.float32(lo), jnp.float32(hi)
+
+
+@register('_contrib_dequantize', num_inputs=3)
+def dequantize(data, min_range, max_range, *, out_type='float32'):
+    """int8 -> f32 (reference: quantization/dequantize-inl.h)."""
+    scale = _scale_of(min_range, max_range)
+    return data.astype(jnp.float32) / scale
+
+
+@register('_contrib_requantize', num_inputs=3, num_outputs=3)
+def requantize(data, min_range, max_range, *, min_calib_range=None,
+               max_calib_range=None):
+    """int32 -> int8 given calibrated output ranges
+    (reference: quantization/requantize-inl.h)."""
+    f = data.astype(jnp.float32) / _scale_of(min_range, max_range)
+    lo = float(min_calib_range if min_calib_range is not None else -1.0)
+    hi = float(max_calib_range if max_calib_range is not None else 1.0)
+    scale = _scale_of(jnp.float32(lo), jnp.float32(hi))
+    q = jnp.clip(jnp.round(f * scale), -127, 127).astype(jnp.int8)
+    return q, jnp.float32(lo), jnp.float32(hi)
+
+
+def _int8_scales(min_d, max_d, min_w, max_w):
+    sd = _scale_of(min_d, max_d)
+    sw = _scale_of(min_w, max_w)
+    return sd, sw
+
+
+@register('_contrib_quantized_conv', num_inputs=-1)
+def quantized_conv(args, *, kernel=None, stride=None, dilate=None,
+                   pad=None, num_filter=None, num_group=1, no_bias=False,
+                   layout='NCHW', **ignored):
+    """int8 conv on the MXU with f32 epilogue.
+
+    args: [qdata i8, qweight i8, (bias f32), min_data, max_data,
+    min_weight, max_weight] (reference: quantized_conv.cc input layout).
+    """
+    qdata, qweight = args[0], args[1]
+    bias = None if no_bias else args[2]
+    min_d, max_d, min_w, max_w = args[-4:]
+    sd, sw = _int8_scales(min_d, max_d, min_w, max_w)
+    dims = 2
+    acc = jax.lax.conv_general_dilated(
+        qdata.astype(jnp.int8), qweight.astype(jnp.int8),
+        window_strides=_tup(stride or 1, dims),
+        padding=[(p, p) for p in _tup(pad or 0, dims)],
+        rhs_dilation=_tup(dilate or 1, dims),
+        feature_group_count=int(num_group),
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) / (sd * sw)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register('_contrib_quantized_fully_connected', num_inputs=-1)
+def quantized_fully_connected(args, *, num_hidden=None, no_bias=False,
+                              flatten=True, **ignored):
+    """int8 matmul on the MXU with f32 epilogue.
+
+    args: [qdata i8, qweight i8, (bias f32), min_data, max_data,
+    min_weight, max_weight]."""
+    qdata, qweight = args[0], args[1]
+    bias = None if no_bias else args[2]
+    min_d, max_d, min_w, max_w = args[-4:]
+    sd, sw = _int8_scales(min_d, max_d, min_w, max_w)
+    x = qdata.reshape(qdata.shape[0], -1) if flatten else qdata
+    acc = jax.lax.dot_general(
+        x.astype(jnp.int8), qweight.astype(jnp.int8),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) / (sd * sw)
+    if bias is not None:
+        out = out + bias
+    return out

@@ -1,0 +1,241 @@
+"""contrib: ONNX interop + int8 quantization (reference:
+python/mxnet/contrib/onnx/, python/mxnet/contrib/quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.onnx import _proto as P
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+def test_proto_roundtrip():
+    model = {'ir_version': 6, 'producer_name': 'x',
+             'opset_import': [{'domain': '', 'version': 11}],
+             'graph': {'name': 'g',
+                       'node': [{'op_type': 'Relu', 'name': 'r',
+                                 'input': ['a'], 'output': ['b'],
+                                 'attribute': [
+                                     {'name': 'axis', 'i': -1,
+                                      'type': P.ATTR_TYPES['INT']},
+                                     {'name': 'ratio', 'f': 0.5,
+                                      'type': P.ATTR_TYPES['FLOAT']},
+                                     {'name': 'pads', 'ints': [1, 2, 1, 2],
+                                      'type': P.ATTR_TYPES['INTS']}]}],
+                       'initializer': [
+                           {'name': 'w', 'dims': [2, 3], 'data_type': 1,
+                            'raw_data': np.arange(6, dtype=np.float32)
+                            .tobytes()}]}}
+    blob = P.encode('Model', model)
+    back = P.decode('Model', blob)
+    assert back['ir_version'] == 6
+    node = back['graph']['node'][0]
+    assert P.text(node['op_type']) == 'Relu'
+    attrs = {P.text(a['name']): a for a in node['attribute']}
+    assert attrs['axis']['i'] == -1
+    assert attrs['pads']['ints'] == [1, 2, 1, 2]
+    assert attrs['ratio']['f'] == pytest.approx(0.5)
+    w = back['graph']['initializer'][0]
+    assert w['dims'] == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# resnet18-style symbolic net for the round-trip gate
+# ---------------------------------------------------------------------------
+
+def _residual_unit(x, nf, stride, dim_match, name):
+    sym = mx.sym
+    bn1 = sym.BatchNorm(x, fix_gamma=False, name=name + '_bn1')
+    act1 = sym.Activation(bn1, act_type='relu', name=name + '_relu1')
+    conv1 = sym.Convolution(act1, kernel=(3, 3), num_filter=nf,
+                            stride=(stride, stride), pad=(1, 1),
+                            no_bias=True, name=name + '_conv1')
+    bn2 = sym.BatchNorm(conv1, fix_gamma=False, name=name + '_bn2')
+    act2 = sym.Activation(bn2, act_type='relu', name=name + '_relu2')
+    conv2 = sym.Convolution(act2, kernel=(3, 3), num_filter=nf,
+                            pad=(1, 1), no_bias=True,
+                            name=name + '_conv2')
+    if dim_match:
+        shortcut = x
+    else:
+        shortcut = sym.Convolution(act1, kernel=(1, 1), num_filter=nf,
+                                   stride=(stride, stride), no_bias=True,
+                                   name=name + '_sc')
+    return sym.elemwise_add(conv2, shortcut, name=name + '_add')
+
+
+def _resnet18_sym(classes=10, nf=(8, 16)):
+    """resnet18-shaped v2 network (reference:
+    example/image-classification/symbols/resnet.py), small widths."""
+    sym = mx.sym
+    data = sym.Variable('data')
+    x = sym.Convolution(data, kernel=(3, 3), num_filter=nf[0], pad=(1, 1),
+                        no_bias=True, name='conv0')
+    for i, f in enumerate(nf):
+        stride = 1 if i == 0 else 2
+        x = _residual_unit(x, f, stride, False, 'stage%d_u1' % (i + 1))
+        x = _residual_unit(x, f, 1, True, 'stage%d_u2' % (i + 1))
+    x = sym.BatchNorm(x, fix_gamma=False, name='bn_final')
+    x = sym.Activation(x, act_type='relu', name='relu_final')
+    x = sym.Pooling(x, global_pool=True, pool_type='avg', kernel=(1, 1),
+                    name='pool_final')
+    x = sym.Flatten(x, name='flat')
+    x = sym.FullyConnected(x, num_hidden=classes, name='fc1')
+    return sym.softmax(x, name='prob')
+
+
+def _init_executor(sym, shape, seed=0):
+    ex = sym.simple_bind(mx.cpu(), data=shape)
+    rs = np.random.RandomState(seed)
+    for k, v in sorted(ex.arg_dict.items()):
+        if k != 'data':
+            v[:] = rs.uniform(-0.2, 0.2, v.shape)
+    for k, v in sorted(ex.aux_dict.items()):
+        v[:] = 1.0 if 'var' in k else 0.0
+    return ex, rs
+
+
+def test_resnet18_onnx_roundtrip(tmp_path):
+    sym = _resnet18_sym()
+    ex, rs = _init_executor(sym, (2, 3, 32, 32))
+    x = rs.randn(2, 3, 32, 32).astype('float32')
+    ex.arg_dict['data'][:] = x
+    ref = ex.forward()[0].asnumpy()
+    params = {k: v for k, v in ex.arg_dict.items() if k != 'data'}
+    params.update(ex.aux_dict)
+    path = str(tmp_path / 'resnet18.onnx')
+    mx.contrib.onnx.export_model(sym, params, (2, 3, 32, 32),
+                                 onnx_file_path=path)
+    sym2, arg2, aux2 = mx.contrib.onnx.import_model(path)
+    ex2 = sym2.bind(mx.cpu(), args=dict(arg2, data=nd.array(x)),
+                    aux_states=aux2)
+    back = ex2.forward()[0].asnumpy()
+    np.testing.assert_allclose(back, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_metadata(tmp_path):
+    sym = _resnet18_sym()
+    ex, _ = _init_executor(sym, (1, 3, 32, 32))
+    params = {k: v for k, v in ex.arg_dict.items() if k != 'data'}
+    params.update(ex.aux_dict)
+    path = str(tmp_path / 'm.onnx')
+    mx.contrib.onnx.export_model(sym, params, (1, 3, 32, 32),
+                                 onnx_file_path=path)
+    meta = mx.contrib.onnx.get_model_metadata(path)
+    assert meta['input_tensor_data'] == [('data', (1, 3, 32, 32))]
+    assert len(meta['output_tensor_data']) == 1
+
+
+def test_onnx_export_gemm_and_pool_variants(tmp_path):
+    sym = mx.sym
+    data = sym.Variable('data')
+    x = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type='max',
+                    name='mp')
+    x = sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type='avg',
+                    name='ap')
+    x = sym.Flatten(x, name='fl')
+    x = sym.FullyConnected(x, num_hidden=4, name='fc')
+    out = sym.softmax(x, name='sm')
+    ex, rs = _init_executor(out, (1, 2, 8, 8))
+    xs = rs.randn(1, 2, 8, 8).astype('float32')
+    ex.arg_dict['data'][:] = xs
+    ref = ex.forward()[0].asnumpy()
+    params = {k: v for k, v in ex.arg_dict.items() if k != 'data'}
+    path = str(tmp_path / 'p.onnx')
+    mx.contrib.onnx.export_model(out, params, (1, 2, 8, 8),
+                                 onnx_file_path=path)
+    sym2, arg2, aux2 = mx.contrib.onnx.import_model(path)
+    ex2 = sym2.bind(mx.cpu(), args=dict(arg2, data=nd.array(xs)),
+                    aux_states=aux2)
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# int8 quantization
+# ---------------------------------------------------------------------------
+
+def _quant_net():
+    sym = mx.sym
+    data = sym.Variable('data')
+    x = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name='conv0')
+    x = sym.Activation(x, act_type='relu', name='relu0')
+    x = sym.Convolution(x, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name='conv1')
+    x = sym.Activation(x, act_type='relu', name='relu1')
+    x = sym.Pooling(x, global_pool=True, pool_type='avg', kernel=(1, 1),
+                    name='gap')
+    x = sym.Flatten(x, name='flat')
+    x = sym.FullyConnected(x, num_hidden=5, name='fc')
+    return sym.softmax(x, name='prob')
+
+
+def _ref_and_params(sym, x, seed=1):
+    ex = sym.simple_bind(mx.cpu(), data=x.shape)
+    rs = np.random.RandomState(seed)
+    for k, v in sorted(ex.arg_dict.items()):
+        if k != 'data':
+            v[:] = rs.uniform(-0.3, 0.3, v.shape)
+    ex.arg_dict['data'][:] = x
+    ref = ex.forward()[0].asnumpy()
+    params = {k: v for k, v in ex.arg_dict.items() if k != 'data'}
+    return ref, params
+
+
+def test_quantize_model_scores_within_tolerance():
+    sym = _quant_net()
+    rs = np.random.RandomState(2)
+    x = rs.randn(4, 3, 16, 16).astype('float32')
+    ref, params = _ref_and_params(sym, x)
+    qsym, qargs, qaux = mx.contrib.quantization.quantize_model(
+        sym, params, {}, calib_data=[x], calib_mode='naive')
+    ex = qsym.bind(mx.cpu(), args=dict(qargs, data=nd.array(x)),
+                   aux_states=qaux)
+    got = ex.forward()[0].asnumpy()
+    assert np.abs(got - ref).max() < 0.05
+    assert (got.argmax(1) == ref.argmax(1)).all()
+    qops = [n.op.name for n in qsym._nodes() if n.op is not None]
+    assert '_contrib_quantized_conv' in qops
+    assert '_contrib_quantized_fully_connected' in qops
+    assert '_contrib_quantize_v2' in qops
+    # quantized weights really are int8
+    assert qargs['conv0_weight_quantized'].asnumpy().dtype == np.int8
+
+
+def test_quantize_excluded_layers_stay_f32():
+    sym = _quant_net()
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 3, 16, 16).astype('float32')
+    _, params = _ref_and_params(sym, x)
+    qsym, qargs, _ = mx.contrib.quantization.quantize_model(
+        sym, params, {}, calib_data=[x], excluded_sym_names=['fc'])
+    qops = [n.op.name for n in qsym._nodes() if n.op is not None]
+    assert '_contrib_quantized_fully_connected' not in qops
+    assert 'fc_weight' in qargs and 'fc_weight_quantized' not in qargs
+
+
+def test_quantize_percentile_calibration():
+    sym = _quant_net()
+    rs = np.random.RandomState(4)
+    x = rs.randn(4, 3, 16, 16).astype('float32')
+    ref, params = _ref_and_params(sym, x)
+    qsym, qargs, qaux = mx.contrib.quantization.quantize_model(
+        sym, params, {}, calib_data=[x, x * 0.5],
+        calib_mode='percentile', percentile=0.999)
+    ex = qsym.bind(mx.cpu(), args=dict(qargs, data=nd.array(x)),
+                   aux_states=qaux)
+    got = ex.forward()[0].asnumpy()
+    assert np.abs(got - ref).max() < 0.1
+
+
+def test_quantize_ops_direct():
+    x = nd.array(np.linspace(-2, 2, 9, dtype='float32'))
+    q, lo, hi = nd._contrib_quantize_v2(x, min_calib_range=-2.0,
+                                        max_calib_range=2.0)
+    assert q.asnumpy().dtype == np.int8
+    back = nd._contrib_dequantize(q, lo, hi)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=0.02)

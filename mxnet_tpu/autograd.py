@@ -98,20 +98,26 @@ def predict_mode():
 
 class TapeNode:
     """One recorded op call (reference analog: an nnvm node stamped by
-    Imperative::RecordOp with AGInfo on outputs)."""
+    Imperative::RecordOp with AGInfo on outputs).
+
+    op_ref (optional): (op, attrs, input arrays, rng key) retained so
+    create_graph backward can re-linearize the op at its recorded inputs
+    as a *recorded* computation — second-order gradients differentiate
+    through the pullback coefficients, not just the cotangents."""
 
     __slots__ = ('vjp_fn', 'in_entries', 'num_outputs', 'out_shapes',
-                 'out_dtypes', 'seq')
+                 'out_dtypes', 'seq', 'op_ref')
 
     _counter = [0]
 
     def __init__(self, vjp_fn, in_entries, num_outputs, out_shapes,
-                 out_dtypes):
+                 out_dtypes, op_ref=None):
         self.vjp_fn = vjp_fn
         self.in_entries = in_entries  # list of Entry|None per diff input
         self.num_outputs = num_outputs
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
+        self.op_ref = op_ref
         TapeNode._counter[0] += 1
         self.seq = TapeNode._counter[0]
 
@@ -153,9 +159,15 @@ def _collect_graph(head_entries):
     return sorted(nodes.values(), key=lambda n: n.seq)
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             create_graph=False):
     """Compute gradients of heads w.r.t. marked variables
-    (reference: autograd.py:243 → Imperative::Backward)."""
+    (reference: autograd.py:243 → Imperative::Backward).
+
+    create_graph=True runs the backward pass as *recorded* computation:
+    each node is re-linearized at its saved inputs through the tape, so
+    the produced gradients are themselves differentiable (reference
+    higher-order grad, autograd.py:270)."""
     from .ndarray import NDArray
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -168,37 +180,68 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     nodes = _collect_graph(head_entries)
     cotangents = {}  # id(node) -> [cotangent or None per output]
 
+    def _raw(ct):
+        return ct._data if isinstance(ct, NDArray) else ct
+
     def _add_ct(entry, ct):
         if entry is None or ct is None:
             return
-        if isinstance(ct, jax.Array) and ct.dtype == jax.dtypes.float0:
+        if _raw(ct).dtype == jax.dtypes.float0:
             return
         if entry.variable is not None:
             var = entry.variable
             if var._grad is not None:
-                ctc = ct.astype(var._grad.dtype) if ct.dtype != var._grad.dtype else ct
-                if var._grad_req == 'add':
+                raw = _raw(ct)
+                ctc = raw.astype(var._grad.dtype) \
+                    if raw.dtype != var._grad.dtype else raw
+                accumulate = var._grad_req == 'add' or \
+                    getattr(var, '_grad_fresh', False)
+                if accumulate:
                     var._grad._data = var._grad._data + ctc
-                    var._grad_fresh = True
                 else:
-                    # MXNet 'write' semantics within one backward = accumulate
-                    if getattr(var, '_grad_fresh', False):
-                        var._grad._data = var._grad._data + ctc
+                    # MXNet 'write' semantics within one backward =
+                    # accumulate across paths, overwrite across calls
+                    var._grad._data = ctc
+                var._grad_fresh = True
+                if create_graph and isinstance(ct, NDArray):
+                    prev_ent = var._grad._entry if accumulate else None
+                    if prev_ent is not None:
+                        summed = NDArray(var._grad._data)
+                        # connect the accumulated grad to both summands
+                        summed._entry = _sum_entries(prev_ent, ct._entry,
+                                                     var._grad._data)
+                        var._grad._entry = summed._entry
                     else:
-                        var._grad._data = ctc
-                        var._grad_fresh = True
+                        var._grad._entry = ct._entry
             return
         if entry.node is not None:
             lst = cotangents.setdefault(id(entry.node),
                                         [None] * entry.node.num_outputs)
-            lst[entry.index] = ct if lst[entry.index] is None \
-                else lst[entry.index] + ct
+            if lst[entry.index] is None:
+                lst[entry.index] = ct
+            else:
+                lst[entry.index] = lst[entry.index] + ct
+
+    def _sum_entries(ent_a, ent_b, data):
+        """Tape entry representing a + b for grad accumulation under
+        create_graph (both summands recorded)."""
+        if ent_b is None:
+            return ent_a
+        node = TapeNode(lambda c: (c, c), [ent_a, ent_b], 1,
+                        [data.shape], [data.dtype])
+        return Entry(node=node, index=0)
 
     # seed heads
     for h, he, hg in zip(heads, head_entries, head_grads):
         if he is None:
             continue
-        ct = hg._data if hg is not None else jnp.ones(h.shape, dtype=h.dtype)
+        if create_graph:
+            from . import ndarray as _nd
+            ct = hg if hg is not None else \
+                _nd.ones(h.shape, dtype=str(jnp.dtype(h.dtype)))
+        else:
+            ct = hg._data if hg is not None else \
+                jnp.ones(h.shape, dtype=h.dtype)
         _add_ct(he, ct)
 
     # clear the fresh-write flags on variables reachable from the graph
@@ -207,26 +250,104 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             if ent is not None and ent.variable is not None:
                 ent.variable._grad_fresh = False
 
-    for node in reversed(nodes):
-        cts = cotangents.get(id(node))
-        if cts is None:
-            continue
-        # Cotangents arrive in the dtype of the downstream consumer (e.g.
-        # f32 from a promoted loss); the pullback was linearized at this
-        # node's own output dtypes (bf16 under net.cast('bfloat16')), so
-        # cast at the node boundary — the analog of the reference casting
-        # head grads per executor output dtype.
-        full = tuple(
-            (ct.astype(dt) if ct.dtype != dt else ct) if ct is not None
-            else jnp.zeros(shp, dt)
-            for ct, shp, dt in zip(cts, node.out_shapes, node.out_dtypes))
-        arg = full if node.num_outputs > 1 else full[0]
-        in_cts = node.vjp_fn(arg)
-        for ent, ct in zip(node.in_entries, in_cts):
-            _add_ct(ent, ct)
-        if not retain_graph:
-            node.vjp_fn = None
-            cotangents.pop(id(node), None)
+    prev_rec = set_recording(True) if create_graph else None
+    try:
+        for node in reversed(nodes):
+            cts = cotangents.get(id(node))
+            if cts is None:
+                continue
+            if create_graph:
+                in_cts = _apply_node_recorded(node, cts)
+            else:
+                # Cotangents arrive in the dtype of the downstream
+                # consumer (e.g. f32 from a promoted loss); the pullback
+                # was linearized at this node's own output dtypes (bf16
+                # under net.cast('bfloat16')), so cast at the node
+                # boundary — the analog of the reference casting head
+                # grads per executor output dtype.
+                full = tuple(
+                    (ct.astype(dt) if ct.dtype != dt else ct)
+                    if ct is not None else jnp.zeros(shp, dt)
+                    for ct, shp, dt in zip(cts, node.out_shapes,
+                                           node.out_dtypes))
+                arg = full if node.num_outputs > 1 else full[0]
+                in_cts = node.vjp_fn(arg)
+            for ent, ct in zip(node.in_entries, in_cts):
+                _add_ct(ent, ct)
+            if not retain_graph and not create_graph:
+                node.vjp_fn = None
+                # op_ref pins the forward input activations; drop it with
+                # the pullback so memory is released after backward
+                node.op_ref = None
+                cotangents.pop(id(node), None)
+    finally:
+        if prev_rec is not None:
+            set_recording(prev_rec)
+
+
+def _apply_node_recorded(node, cts):
+    """create_graph pullback: re-linearize the op at its saved inputs as
+    ONE recorded invoke over (inputs + cotangents), so the result carries
+    tape entries connecting to both."""
+    from .ndarray import NDArray, invoke
+    from .ops.registry import Operator
+    if node.op_ref is None:
+        # sum-node from grad accumulation: vjp_fn fans the ct out
+        if node.vjp_fn is not None and node.num_outputs == 1 and \
+                len(node.in_entries) == 2:
+            ct = cts[0]
+            return (ct, ct)
+        raise NotImplementedError(
+            'create_graph=True requires ops recorded with primal '
+            'references; this graph contains a node (e.g. a hybridized '
+            'CachedOp) without one — run the model un-hybridized for '
+            'higher-order gradients.')
+    op, attrs, in_arrays, key = node.op_ref
+    n_in = len(in_arrays)
+    variadic = op.num_inputs == -1
+    shapes = node.out_shapes
+    dtypes = node.out_dtypes
+
+    def pb(*args):
+        ins = args[:n_in]
+        raw_cts = args[n_in:]
+        base = op.bind_attrs(**attrs)
+        if op.needs_rng:
+            f = (lambda *a: base(key, list(a))) if variadic else \
+                (lambda *a: base(key, *a))
+        elif variadic:
+            f = lambda *a: base(list(a))
+        else:
+            f = base
+        _, pull = jax.vjp(f, *ins)
+        full = tuple(c.astype(dt) if c.dtype != dt else c
+                     for c, dt in zip(raw_cts, dtypes))
+        res = pull(full if node.num_outputs > 1 else full[0])
+        # single-result ops must return a bare array so downstream vjp
+        # pullbacks see matching pytree structure
+        return res[0] if len(res) == 1 else tuple(res)
+
+    ins_nd = []
+    for a, ent in zip(in_arrays, node.in_entries):
+        x = NDArray(a)
+        x._entry = ent
+        ins_nd.append(x)
+    ct_nd = []
+    from . import ndarray as _nd
+    for ct, shp, dt in zip(cts, shapes, dtypes):
+        if ct is None:
+            ct_nd.append(_nd.zeros(shp, dtype=str(jnp.dtype(dt))))
+        elif isinstance(ct, NDArray):
+            ct_nd.append(ct)
+        else:
+            ct_nd.append(NDArray(ct))
+    # nojit: transient per-node Operators must not enter the id-keyed
+    # invoke jit cache (their ids can be recycled after gc)
+    pb_op = Operator('_backward_%s' % op.name, pb,
+                     num_inputs=n_in + node.num_outputs,
+                     num_outputs=n_in, nojit=True)
+    out = invoke(pb_op, ins_nd + ct_nd, {})
+    return out if isinstance(out, (tuple, list)) else (out,)
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
@@ -236,9 +357,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     from . import ndarray as nd
     from .ndarray import NDArray
     if create_graph:
-        raise NotImplementedError(
-            'create_graph=True (higher-order imperative grad) is not yet '
-            'supported; use the functional API (mxnet_tpu.jax_grad) instead.')
+        retain_graph = True
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
@@ -254,7 +373,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
             v._entry.variable = v
     try:
         backward(heads, head_grads, retain_graph=bool(retain_graph),
-                 train_mode=train_mode)
+                 train_mode=train_mode, create_graph=create_graph)
     finally:
         results = [v._grad for v in variables]
         for v, (g, req, ent) in zip(variables, saved):

@@ -252,11 +252,22 @@ def export_model(sym, params, input_shapes, input_types='float32',
         outs = [node.name if j == 0 else '%s_out%d' % (node.name, j)
                 for j in range(n_out)]
         out_of[id(node)] = outs
+        consumed_secondary = [
+            i for other in nodes if not other.is_variable
+            for (c, i) in other.inputs if c is node and i > 0]
+        if consumed_secondary:
+            raise NotImplementedError(
+                'ONNX export: secondary outputs of %s (%s) are consumed '
+                'by the graph; only output 0 is exported'
+                % (node.op.name, node.name))
         _translate(ex, node, ins, outs[0])
 
     # initializers AFTER translation (fix_gamma may rewrite params)
     for pname, arr in ex.params.items():
         ex.initializers.append(_tensor(pname, onp.asarray(arr)))
+    if any(i > 0 for (_, i) in entries):
+        raise NotImplementedError('ONNX export: graph heads on secondary '
+                                  'op outputs are not supported')
     outputs = [_vinfo(out_of[id(n)][i], []) for (n, i) in entries]
     # output shape dims unknown -> emit without dims
     for o in outputs:

@@ -158,8 +158,7 @@ def _import_node(op_type, name, ins, attrs, consts):
     if op_type == 'Dropout':
         return S.Dropout(ins[0], p=attrs.get('ratio', 0.5), name=name)
     if op_type == 'Add':
-        return S.elemwise_add(*ins, name=name) if _same_shape_hint(ins) \
-            else S.broadcast_add(*ins, name=name)
+        return S.broadcast_add(*ins, name=name)
     if op_type == 'Sub':
         return S.broadcast_sub(*ins, name=name)
     if op_type == 'Mul':
@@ -199,10 +198,6 @@ def _name_of(s):
     return s.name if hasattr(s, 'name') else str(s)
 
 
-def _same_shape_hint(ins):
-    return True
-
-
 def import_model(model_file):
     """Import an ONNX file -> (sym, arg_params, aux_params)
     (reference: onnx2mx/import_model.py import_model)."""
@@ -224,9 +219,12 @@ def import_model(model_file):
     for name in inits:
         produced[name] = sym_mod.Variable(name)
 
-    for node in graph.get('node', []):
+    for i, node in enumerate(graph.get('node', [])):
         op_type = P.text(node['op_type'])
-        name = P.text(node.get('name', b'')) or None
+        # node names are optional in ONNX; synthesize stable ones so the
+        # per-op helper nodes (pads/flatten/dot) can derive suffixed names
+        name = P.text(node.get('name', b'')) or \
+            '%s_%d' % (op_type.lower(), i)
         in_names = [P.text(s) for s in node.get('input', [])]
         ins = [produced[n] for n in in_names if n]
         out = _import_node(op_type, name, ins, _attrs_of(node), consts)

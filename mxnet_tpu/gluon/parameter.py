@@ -185,6 +185,15 @@ class Parameter:
             self._grad = None
             return
         self._data.attach_grad(grad_req=self.grad_req)
+        if self._grad_stype == 'row_sparse':
+            # keep the row_sparse stype on the grad buffer so optimizers
+            # take the lazy row-masked path (reference: parameter.py
+            # grad_stype -> sparse grad arrays)
+            from ..ndarray.sparse import RowSparseNDArray
+            g = self._data.grad
+            rs = RowSparseNDArray(g._data)
+            rs._grad_req = g._grad_req
+            self._data._grad = rs
         self._grad = self._data.grad
 
     def _reduce(self):

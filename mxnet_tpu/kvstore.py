@@ -84,6 +84,7 @@ class KVStore:
                     merged = merged + x
             else:
                 merged = v
+            merged = self._compress(k, merged)
             merged = self._allreduce(merged)
             if self._updater is not None:
                 if k not in self._data:
@@ -114,9 +115,27 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse parity shim: dense pull (XLA has no native sparse;
-        SURVEY.md §7 hard part 3)."""
-        self.pull(key, out, priority)
+        """Pull only the rows in row_ids (reference: kvstore.py:230).
+
+        Storage is dense (XLA; SURVEY.md §7 hard part 3) but the
+        *contract* holds: rows outside row_ids come back zero, so sparse
+        embedding training touches only the looked-up rows."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        import jax.numpy as jnp
+        keys, outs = _ctype_key_value(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) \
+            else [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, rids):
+            src = self._data[k]
+            idx = rid._data.astype(jnp.int32) if isinstance(rid, NDArray) \
+                else jnp.asarray(rid, jnp.int32)
+            mask = jnp.zeros((src.shape[0],), bool).at[idx].set(True)
+            rows = jnp.where(mask[(slice(None),) + (None,) *
+                                  (src._data.ndim - 1)], src._data, 0)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for oo in targets:
+                oo._data = rows.astype(oo._data.dtype)
 
     # -- distributed reduce ------------------------------------------------
     def _allreduce(self, value):
@@ -144,10 +163,31 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        """2-bit gradient compression parity: recorded but a no-op on the
-        single-chip path (compressed DCN allreduce is a dist-only concern;
-        reference: gradient_compression.h)."""
-        self._compression_params = dict(compression_params)
+        """Enable 2-bit gradient compression with error feedback
+        (reference: src/kvstore/gradient_compression.cc). Each pushed
+        gradient is quantized to {-threshold, 0, +threshold} after adding
+        the residual from previous rounds; the residual keeps what the
+        quantizer dropped, so updates stay unbiased over time."""
+        params = dict(compression_params)
+        ctype = params.get('type', 'none')
+        if ctype not in ('none', '2bit'):
+            raise ValueError('unsupported gradient compression type %r'
+                             % ctype)
+        self._compression_params = params
+        self._residuals = {}
+
+    def _compress(self, key, grad):
+        params = getattr(self, '_compression_params', None)
+        if not params or params.get('type', 'none') == 'none':
+            return grad
+        import jax.numpy as jnp
+        thr = float(params.get('threshold', 0.5))
+        res = self._residuals.get(key)
+        acc = grad._data + (res if res is not None else 0)
+        q = jnp.where(acc >= thr, thr,
+                      jnp.where(acc <= -thr, -thr, 0.0)).astype(acc.dtype)
+        self._residuals[key] = acc - q
+        return NDArray(q)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, 'Cannot save states for distributed training'

@@ -471,7 +471,7 @@ def _get_jitted(op, attrs, recording, variadic):
     cached = _invoke_jit_cache.get(key)
     if cached is not None:
         _invoke_jit_cache.move_to_end(key)
-        return cached, dyn_names
+        return cached[0], dyn_names
     base_fn = op.bind_attrs(**static)
     nd_ = len(dyn_names)
 
@@ -499,7 +499,10 @@ def _get_jitted(op, attrs, recording, variadic):
             def jfn(*a):
                 return call(a[:nd_], a[nd_:])
     jitted = jax.jit(jfn)
-    _invoke_jit_cache[key] = jitted
+    # pin the Operator alongside the compiled fn: the key holds id(op),
+    # so the op must stay alive while the entry does (a recycled id would
+    # alias a different op onto this entry)
+    _invoke_jit_cache[key] = (jitted, op)
     while len(_invoke_jit_cache) > _INVOKE_JIT_CACHE_MAX:
         _invoke_jit_cache.popitem(last=False)
     return jitted, dyn_names
@@ -545,7 +548,10 @@ def invoke(opname, nd_inputs, attrs, out=None):
         call_args = [jnp.asarray(float(attrs[n]))
                      for n in dyn_names] + arrays
         if op.needs_rng:
-            call_args = [_random.next_key()] + call_args
+            used_key = _random.next_key()
+            call_args = [used_key] + call_args
+        else:
+            used_key = None
         if recording:
             out_arrays, vjp_fn = jitted(*call_args)
         else:
@@ -553,8 +559,9 @@ def invoke(opname, nd_inputs, attrs, out=None):
             vjp_fn = None
     else:
         base_fn = op.bind_attrs(**attrs)
+        used_key = None
         if op.needs_rng:
-            key = _random.next_key()
+            key = used_key = _random.next_key()
             if variadic:
                 fn = lambda *arrs: base_fn(key, list(arrs))
             else:
@@ -603,7 +610,9 @@ def invoke(opname, nd_inputs, attrs, out=None):
             apply_fn = vjp_fn
         node = TapeNode(apply_fn, in_entries, len(outputs),
                         [o.shape for o in outputs],
-                        [o._data.dtype for o in outputs])
+                        [o._data.dtype for o in outputs],
+                        op_ref=(op, dict(attrs), tuple(arrays), used_key)
+                        if op.bwd is None else None)
         for i, o in enumerate(outputs):
             o._entry = Entry(node=node, index=i)
 

@@ -28,28 +28,46 @@ def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
     return g
 
 
+def _row_mask(grad):
+    """Rows with any nonzero gradient — the dense-emulation analog of a
+    row_sparse gradient's populated rows (reference lazy_update,
+    optimizer_op.cc:506 SGDUpdateRspRspImpl)."""
+    axes = tuple(range(1, grad.ndim))
+    m = jnp.any(grad != 0, axis=axes) if axes else (grad != 0)
+    return m.reshape(m.shape + (1,) * (grad.ndim - 1))
+
+
 @register('sgd_update', num_inputs=2, mutate_idx=(0,), dynamic_attrs=('lr',))
 def sgd_update(weight, grad, *, lr=None, wd=0.0, rescale_grad=1.0,
-               clip_gradient=-1.0, lazy_update=True):
+               clip_gradient=-1.0, lazy_update=False):
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
-    return weight - lr * g
+    new_w = weight - lr * g
+    if lazy_update:
+        return jnp.where(_row_mask(grad), new_w, weight)
+    return new_w
 
 
 @register('sgd_mom_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2), dynamic_attrs=('lr',))
 def sgd_mom_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
-                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     new_mom = momentum * mom - lr * g
+    if lazy_update:
+        m = _row_mask(grad)
+        return (jnp.where(m, weight + new_mom, weight),
+                jnp.where(m, new_mom, mom))
     return weight + new_mom, new_mom
 
 
 @register('mp_sgd_update', num_inputs=3, num_outputs=2, mutate_idx=(0, 2), dynamic_attrs=('lr',))
 def mp_sgd_update(weight, grad, weight32, *, lr=None, wd=0.0,
-                  rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+                  rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
     """fp16/bf16 weights with fp32 master copy (reference: mp_sgd_update:587)."""
     g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
                       wd, weight32)
     w32 = weight32 - lr * g
+    if lazy_update:
+        w32 = jnp.where(_row_mask(grad), w32, weight32)
     return w32.astype(weight.dtype), w32
 
 
@@ -57,11 +75,15 @@ def mp_sgd_update(weight, grad, weight32, *, lr=None, wd=0.0,
           mutate_idx=(0, 2, 3), dynamic_attrs=('lr',))
 def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr=None, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
-                      lazy_update=True):
+                      lazy_update=False):
     g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
                       wd, weight32)
     new_mom = momentum * mom - lr * g
     w32 = weight32 + new_mom
+    if lazy_update:
+        m = _row_mask(grad)
+        w32 = jnp.where(m, w32, weight32)
+        new_mom = jnp.where(m, new_mom, mom)
     return w32.astype(weight.dtype), new_mom, w32
 
 
@@ -86,11 +108,15 @@ def signum_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
 @register('adam_update', num_inputs=4, num_outputs=3, mutate_idx=(0, 2, 3), dynamic_attrs=('lr',))
 def adam_update(weight, grad, mean, var, *, lr=None, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
-                lazy_update=True):
+                lazy_update=False):
     g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
     m = beta1 * mean + (1 - beta1) * g
     v = beta2 * var + (1 - beta2) * jnp.square(g)
     w = weight - lr * m / (jnp.sqrt(v) + epsilon)
+    if lazy_update:
+        rm = _row_mask(grad)
+        return (jnp.where(rm, w, weight), jnp.where(rm, m, mean),
+                jnp.where(rm, v, var))
     return w, m, v
 
 

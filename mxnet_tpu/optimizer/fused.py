@@ -117,7 +117,7 @@ class _HyperPatch:
 
 
 def apply_traced_updates(opt, indices, weights, grads, templates,
-                         state_leaves, skip=()):
+                         state_leaves, skip=(), grad_wraps=None):
     """Shared traced-update protocol: run opt.update_multi_precision over
     tracer-backed NDArrays for every parameter, returning (new_weight_
     arrays, new_leaf_arrays). Callers wrap this in _HyperPatch +
@@ -131,7 +131,10 @@ def apply_traced_updates(opt, indices, weights, grads, templates,
         if pos in skip:
             continue
         w_nd = NDArray(weights[pos])
-        g_nd = NDArray(grads[pos])
+        # preserve the grad's NDArray subclass (RowSparseNDArray) so
+        # stype-gated paths (lazy_update) survive the trace
+        cls = grad_wraps[pos] if grad_wraps is not None else NDArray
+        g_nd = cls(grads[pos])
         state = _rebuild_state(templates[pos], new_leaves)
         opt.update_multi_precision(idx, w_nd, g_nd, state)
         # traced f32 hypers promote bf16 math to f32 (python floats are
@@ -156,14 +159,15 @@ class FusedUpdater:
         self._sig = None
         self.broken = False  # tracing failed → caller uses eager path
 
-    def _build(self, indices, templates):
+    def _build(self, indices, templates, grad_wraps=None):
         opt = self.optimizer
 
         def fused(key, weights, grads, state_leaves, lrs, wds, ts, rescale):
             with _random.key_override(key), \
                     _HyperPatch(opt, indices, lrs, wds, ts, rescale):
                 new_w, new_leaves = apply_traced_updates(
-                    opt, indices, weights, grads, templates, state_leaves)
+                    opt, indices, weights, grads, templates, state_leaves,
+                    grad_wraps=grad_wraps)
             return new_w, new_leaves
 
         donate = (1, 3) if jax.default_backend() != 'cpu' else ()
@@ -202,10 +206,12 @@ class FusedUpdater:
         g_arrays = [g._data for g in grads]
         leaf_arrays = [l._data for l in leaves]
 
+        grad_wraps = [type(g) for g in grads]
         sig = (tuple(indices),
-               tuple((w.shape, str(w.dtype)) for w in weights))
+               tuple((w.shape, str(w.dtype)) for w in weights),
+               tuple(c.__name__ for c in grad_wraps))
         if self._jit is None or self._sig != sig:
-            jitted = self._build(list(indices), templates)
+            jitted = self._build(list(indices), templates, grad_wraps)
             try:
                 # Trace WITHOUT executing (no buffers dispatched, nothing
                 # donated yet): a failure here is recoverable — the caller

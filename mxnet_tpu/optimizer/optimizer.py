@@ -246,21 +246,29 @@ class SGD(Optimizer):
         wd = self._get_wd(index)
         kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
                   'clip_gradient': self.clip_gradient}
+        # lazy rows only for genuinely row_sparse gradients (reference:
+        # optimizer.py:545 — dense grads always update every row)
+        lazy = bool(self.lazy_update and
+                    getattr(grad, 'stype', 'default') == 'row_sparse')
         if not multi_precision:
             if state is not None:
                 invoke('sgd_mom_update', [weight, grad, state],
-                       dict(momentum=self.momentum, **kwargs),
+                       dict(momentum=self.momentum, lazy_update=lazy,
+                            **kwargs),
                        out=[weight, state])
             else:
-                invoke('sgd_update', [weight, grad], kwargs, out=weight)
+                invoke('sgd_update', [weight, grad],
+                       dict(lazy_update=lazy, **kwargs), out=weight)
         else:
             weight32, mom = state
             if mom is not None:
                 invoke('mp_sgd_mom_update', [weight, grad, mom, weight32],
-                       dict(momentum=self.momentum, **kwargs),
+                       dict(momentum=self.momentum, lazy_update=lazy,
+                            **kwargs),
                        out=[weight, mom, weight32])
             else:
-                invoke('mp_sgd_update', [weight, grad, weight32], kwargs,
+                invoke('mp_sgd_update', [weight, grad, weight32],
+                       dict(lazy_update=lazy, **kwargs),
                        out=[weight, weight32])
 
 
@@ -430,8 +438,11 @@ class Adam(Optimizer):
         coef2 = 1. - self.beta2 ** t
         lr *= coef2 ** 0.5 / coef1  # works for floats and tracers
         mean, var = state
+        lazy = bool(self.lazy_update and
+                    getattr(grad, 'stype', 'default') == 'row_sparse')
         invoke('adam_update', [weight, grad, mean, var],
-               {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+               {'lr': lr, 'wd': wd, 'lazy_update': lazy,
+                'rescale_grad': self.rescale_grad,
                 'clip_gradient': self.clip_gradient, 'beta1': self.beta1,
                 'beta2': self.beta2, 'epsilon': self.epsilon},
                out=[weight, mean, var])

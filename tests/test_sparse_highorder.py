@@ -1,0 +1,207 @@
+"""Higher-order autograd, lazy sparse optimizer updates, kvstore
+row_sparse_pull, 2-bit gradient compression (reference:
+python/mxnet/autograd.py:270, optimizer_op.cc:506/840,
+python/mxnet/kvstore.py:230, src/kvstore/gradient_compression.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+# ---------------------------------------------------------------------------
+# higher-order gradients
+# ---------------------------------------------------------------------------
+
+def test_second_order_polynomial():
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g1 = autograd.grad(y, x, create_graph=True)
+        s = g1.sum()
+    s.backward()
+    np.testing.assert_allclose(g1.asnumpy(), [12.0, 27.0])     # 3x^2
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0, 18.0])  # 6x
+
+
+def test_third_order():
+    z = nd.array([1.5])
+    z.attach_grad()
+    with autograd.record():
+        f = z * z * z * z
+        g1 = autograd.grad(f, z, create_graph=True)
+        g2 = autograd.grad(g1, z, create_graph=True)
+        g2.backward()
+    np.testing.assert_allclose(z.grad.asnumpy(), [36.0])        # 24x
+
+
+def test_second_order_through_nonlinearity():
+    x = nd.array([0.3, -0.7])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x).sum()
+        g = autograd.grad(y, x, create_graph=True)
+        (g.sum()).backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), -np.sin(x.asnumpy()),
+                               rtol=1e-5)
+
+
+def test_wgan_gp_style_penalty():
+    """Gradient-penalty training loop: grad of a grad-norm penalty."""
+    w = nd.array([[0.5, -1.0], [2.0, 0.1]])
+    w.attach_grad()
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        out = nd.dot(x, w).sum()
+        g = autograd.grad(out, w, create_graph=True)
+        penalty = ((g * g).sum() - 1.0) ** 2
+    penalty.backward()
+    # grad wrt w of out is constant in w (linear), so d penalty/dw = 0
+    np.testing.assert_allclose(w.grad.asnumpy(), 0.0, atol=1e-6)
+    # and through a nonlinearity it is not
+    w2 = nd.array([0.5, -1.0])
+    w2.attach_grad()
+    with autograd.record():
+        out = (w2 * w2).sum()
+        g = autograd.grad(out, w2, create_graph=True)      # 2w
+        penalty = ((g * g).sum() - 1.0) ** 2
+    penalty.backward()
+    gn = 4 * (w2.asnumpy() ** 2).sum()
+    expect = 2 * (gn - 1) * 8 * w2.asnumpy()
+    np.testing.assert_allclose(w2.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_create_graph_requires_primal_refs():
+    net = nn.Dense(2)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.ones((1, 3), 'float32'))
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+        with pytest.raises(NotImplementedError):
+            autograd.grad(y, x, create_graph=True)
+
+
+# ---------------------------------------------------------------------------
+# lazy (row_sparse) optimizer updates
+# ---------------------------------------------------------------------------
+
+def test_lazy_sgd_rows_untouched():
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    w = nd.array(np.ones((4, 3), 'float32'))
+    g = np.zeros((4, 3), 'float32')
+    g[1] = 1.0
+    g[3] = 2.0
+    grad = RowSparseNDArray(nd.array(g)._data)
+    opt = mx.optimizer.SGD(learning_rate=0.5, wd=0.1, lazy_update=True)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[0], 1.0)   # zero-grad rows untouched
+    np.testing.assert_allclose(out[2], 1.0)   # (no wd applied either)
+    assert (out[1] != 1.0).all() and (out[3] != 1.0).all()
+
+
+def test_lazy_sgd_momentum_state_untouched():
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    w = nd.array(np.ones((3, 2), 'float32'))
+    g = np.zeros((3, 2), 'float32')
+    g[0] = 1.0
+    grad = RowSparseNDArray(nd.array(g)._data)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           lazy_update=True)
+    state = opt.create_state(0, w)
+    state[:] = 5.0  # pre-existing momentum
+    opt.update(0, w, grad, state)
+    s = state.asnumpy()
+    np.testing.assert_allclose(s[1], 5.0)     # untouched rows keep state
+    np.testing.assert_allclose(s[2], 5.0)
+    assert (s[0] != 5.0).all()
+
+
+def test_dense_grad_ignores_lazy():
+    """Dense gradients must update every row (incl. weight decay) even
+    with lazy_update=True — reference semantics."""
+    w = nd.array(np.ones((3, 2), 'float32'))
+    g = np.zeros((3, 2), 'float32')
+    g[0] = 1.0
+    opt = mx.optimizer.SGD(learning_rate=0.5, wd=0.1, lazy_update=True)
+    opt.update(0, w, nd.array(g), None)
+    out = w.asnumpy()
+    assert (out[1] != 1.0).all()  # wd applied to zero-grad rows
+
+
+def test_lazy_adam():
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    w = nd.array(np.ones((3, 2), 'float32'))
+    g = np.zeros((3, 2), 'float32')
+    g[2] = 1.0
+    grad = RowSparseNDArray(nd.array(g)._data)
+    opt = mx.optimizer.Adam(learning_rate=0.1, lazy_update=True)
+    state = opt.create_state(0, w)
+    opt.update(0, w, grad, state)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[:2], 1.0)
+    assert (out[2] != 1.0).all()
+
+
+def test_embedding_sparse_grad_stype():
+    emb = nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize()
+    x = nd.array(np.array([1, 3], 'int32'))
+    with autograd.record():
+        out = emb(x).sum()
+    out.backward()
+    g = emb.weight.grad()
+    assert g.stype == 'row_sparse'
+    gn = g.asnumpy()
+    assert (gn[[1, 3]] != 0).any()
+    np.testing.assert_allclose(gn[0], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# kvstore: row_sparse_pull + gradient compression
+# ---------------------------------------------------------------------------
+
+def test_row_sparse_pull():
+    kv = mx.kv.create('local')
+    w = np.arange(12, dtype='float32').reshape(4, 3)
+    kv.init('emb', nd.array(w))
+    out = nd.zeros((4, 3))
+    kv.row_sparse_pull('emb', out=out, row_ids=nd.array([1, 3]))
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], w[1])
+    np.testing.assert_allclose(got[3], w[3])
+    np.testing.assert_allclose(got[0], 0.0)
+    np.testing.assert_allclose(got[2], 0.0)
+
+
+def test_gradient_compression_2bit():
+    kv = mx.kv.create('local')
+    kv.set_gradient_compression({'type': '2bit', 'threshold': 0.5})
+    kv.init('w', nd.zeros((4,)))
+    g = nd.array([0.9, -0.7, 0.2, 0.0])
+    kv.push('w', g)
+    out = nd.zeros((4,))
+    kv.pull('w', out=out)
+    # values past +/-threshold quantize to +/-threshold, rest to 0
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    # residual (error feedback) carries the remainder into the next push:
+    # residual [0.4, -0.2, 0.2, 0] + new [0.2, 0, 0.2, 0] =
+    # [0.6, -0.2, 0.4, 0] -> quantized [0.5, 0, 0, 0]
+    kv.push('w', nd.array([0.2, 0.0, 0.2, 0.0]))
+    kv.pull('w', out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.0, 0.0, 0.0])
+    # the small row 2 signal eventually crosses threshold via residual
+    kv.push('w', nd.array([0.2, 0.0, 0.2, 0.0]))
+    kv.pull('w', out=out)
+    assert out.asnumpy()[2] == pytest.approx(0.5)
+
+
+def test_gradient_compression_rejects_unknown():
+    kv = mx.kv.create('local')
+    with pytest.raises(ValueError):
+        kv.set_gradient_compression({'type': '1bit'})

@@ -1,0 +1,272 @@
+// Native RecordIO engine (the TPU-native analog of the reference's C++
+// dmlc-core recordio + src/io/ iterator runtime; see
+// src/io/iter_image_recordio_2.cc for the threaded C++ pipeline this
+// replaces). Python binds via ctypes (mxnet_tpu/native/__init__.py).
+//
+// On-disk framing (dmlc recordio, byte-compatible with im2rec output):
+//   [kMagic u32][lrec u32][payload ... padded to 4B]
+//   lrec = cflag<<29 | length  (cflag!=0 marks continuation chunks)
+//
+// Exposed C surface:
+//   recio_scan     — offsets of every record (mmap-speed, no Python loop)
+//   recio_read_batch — pread a batch of records into one packed buffer
+//   recio_reader_* — a background-thread prefetching batch reader with
+//                    epoch shuffling (bounded queue, like PrefetcherIter)
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Rec {
+  int64_t off;   // offset of payload (past magic+lrec)
+  int64_t len;   // payload length
+};
+
+// scan result: >=0 ok, -1 io error, -2 corrupt framing, -3 contains
+// multi-chunk records (cflag!=0; callers fall back to the python reader,
+// which reassembles them — they only occur for >=2^29-byte payloads)
+int scan_records(const char* path, std::vector<Rec>* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  const int64_t fsize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  int64_t pos = 0;
+  uint32_t hdr[2];
+  int rc = 0;
+  while (pos + 8 <= fsize) {
+    std::fseek(f, pos, SEEK_SET);
+    if (std::fread(hdr, 4, 2, f) != 2) {
+      rc = -2;  // header promised by file size but unreadable
+      break;
+    }
+    if (hdr[0] != kMagic) {
+      rc = -2;  // corrupt framing is an error, not a silent EOF
+      break;
+    }
+    const uint32_t cflag = hdr[1] >> 29;
+    const int64_t len = hdr[1] & ((1u << 29) - 1);
+    if (cflag != 0) {
+      rc = -3;
+      break;
+    }
+    out->push_back({pos + 8, len});
+    // skip payload + 4-byte padding
+    pos += 8 + ((len + 3) / 4) * 4;
+  }
+  std::fclose(f);
+  return rc;
+}
+
+struct Batch {
+  std::vector<char> buf;
+  std::vector<int64_t> sizes;
+  bool last = false;
+};
+
+class Reader {
+ public:
+  Reader(const char* path, int batch, int shuffle, uint64_t seed,
+         int prefetch)
+      : path_(path), batch_(batch), shuffle_(shuffle), rng_(seed),
+        prefetch_(std::max(prefetch, 1)) {
+    ok_ = scan_records(path_.c_str(), &recs_) == 0;
+    order_.resize(recs_.size());
+    for (size_t i = 0; i < recs_.size(); ++i) order_[i] = i;
+    if (ok_) start();
+  }
+
+  ~Reader() { stop(); }
+
+  bool ok() const { return ok_; }
+  int64_t num_records() const { return static_cast<int64_t>(recs_.size()); }
+
+  void reset() {
+    stop();
+    start();
+  }
+
+  // Pops the next batch; returns number of records, 0 = epoch end (the
+  // sentinel stays queued so repeated polls keep returning 0 until
+  // reset), or -needed_bytes when the caller's buffer is too small (the
+  // batch stays queued for the retry). Payloads pack back to back.
+  int64_t next(char* buf, int64_t cap, int64_t* sizes) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [&] { return !queue_.empty(); });
+    Batch& front = queue_.front();
+    if (front.last) return 0;
+    const int64_t need = static_cast<int64_t>(front.buf.size());
+    if (need > cap) return -need;
+    Batch b = std::move(front);
+    queue_.pop();
+    cv_push_.notify_one();
+    lk.unlock();
+    std::memcpy(buf, b.buf.data(), b.buf.size());
+    for (size_t i = 0; i < b.sizes.size(); ++i) sizes[i] = b.sizes[i];
+    return static_cast<int64_t>(b.sizes.size());
+  }
+
+ private:
+  void start() {
+    done_ = false;
+    if (shuffle_) {
+      std::shuffle(order_.begin(), order_.end(), rng_);
+    }
+    worker_ = std::thread([this] { produce(); });
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      done_ = true;
+      // drain so a blocked producer wakes
+      while (!queue_.empty()) queue_.pop();
+    }
+    cv_push_.notify_all();
+    if (worker_.joinable()) worker_.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    while (!queue_.empty()) queue_.pop();
+  }
+
+  void produce() {
+    FILE* f = std::fopen(path_.c_str(), "rb");
+    if (!f) return push_last();
+    const size_t n = order_.size();
+    for (size_t i = 0; i < n; i += batch_) {
+      Batch b;
+      const size_t hi = std::min(n, i + batch_);
+      for (size_t j = i; j < hi; ++j) {
+        const Rec& r = recs_[order_[j]];
+        const size_t base = b.buf.size();
+        b.buf.resize(base + r.len);
+        std::fseek(f, r.off, SEEK_SET);
+        if (std::fread(b.buf.data() + base, 1, r.len, f) !=
+            static_cast<size_t>(r.len)) {
+          std::fclose(f);
+          return push_last();
+        }
+        b.sizes.push_back(r.len);
+      }
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_push_.wait(lk, [&] {
+        return done_ || queue_.size() < static_cast<size_t>(prefetch_);
+      });
+      if (done_) {
+        std::fclose(f);
+        return;
+      }
+      queue_.push(std::move(b));
+      cv_pop_.notify_one();
+    }
+    std::fclose(f);
+    push_last();
+  }
+
+  void push_last() {
+    std::lock_guard<std::mutex> lk(mu_);
+    Batch b;
+    b.last = true;
+    queue_.push(std::move(b));
+    cv_pop_.notify_one();
+  }
+
+  std::string path_;
+  int batch_;
+  int shuffle_;
+  std::mt19937_64 rng_;
+  int prefetch_;
+  bool ok_ = false;
+  bool done_ = false;
+  std::vector<Rec> recs_;
+  std::vector<size_t> order_;
+  std::queue<Batch> queue_;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::thread worker_;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Scan record payload offsets+lengths. Pass offsets==nullptr to count.
+// Returns record count, or -1 on IO error.
+int64_t recio_scan(const char* path, int64_t* offsets, int64_t* lengths,
+                   int64_t max_n) {
+  std::vector<Rec> recs;
+  const int rc = scan_records(path, &recs);
+  if (rc != 0) return rc;
+  if (offsets) {
+    const int64_t n =
+        std::min<int64_t>(max_n, static_cast<int64_t>(recs.size()));
+    for (int64_t i = 0; i < n; ++i) {
+      offsets[i] = recs[i].off;
+      lengths[i] = recs[i].len;
+    }
+  }
+  return static_cast<int64_t>(recs.size());
+}
+
+// Read n records (given payload offsets/lengths) into one packed buffer.
+// Returns total bytes written, or -1 on error / insufficient capacity.
+int64_t recio_read_batch(const char* path, const int64_t* offsets,
+                         const int64_t* lengths, int64_t n, char* buf,
+                         int64_t cap) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) total += lengths[i];
+  if (total > cap) return -1;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t w = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    std::fseek(f, offsets[i], SEEK_SET);
+    if (std::fread(buf + w, 1, lengths[i], f) !=
+        static_cast<size_t>(lengths[i])) {
+      std::fclose(f);
+      return -1;
+    }
+    w += lengths[i];
+  }
+  std::fclose(f);
+  return w;
+}
+
+void* recio_reader_create(const char* path, int batch, int shuffle,
+                          uint64_t seed, int prefetch) {
+  Reader* r = new Reader(path, batch, shuffle, seed, prefetch);
+  if (!r->ok()) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+int64_t recio_reader_num_records(void* h) {
+  return static_cast<Reader*>(h)->num_records();
+}
+
+int64_t recio_reader_next(void* h, char* buf, int64_t cap,
+                          int64_t* sizes) {
+  return static_cast<Reader*>(h)->next(buf, cap, sizes);
+}
+
+void recio_reader_reset(void* h) { static_cast<Reader*>(h)->reset(); }
+
+void recio_reader_free(void* h) { delete static_cast<Reader*>(h); }
+
+int recio_abi_version() { return 2; }
+
+}  // extern "C"

@@ -1,0 +1,134 @@
+"""Native C++ recordio engine (native/src/recio.cc bound via ctypes —
+the TPU-native analog of dmlc recordio + src/io/ threaded iterators)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native, nd
+from mxnet_tpu.recordio import MXRecordIO, IRHeader, pack, unpack
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native toolchain unavailable')
+
+
+def _write_rec(path, n=20, seed=0):
+    rs = np.random.RandomState(seed)
+    payloads = []
+    rec = MXRecordIO(path, 'w')
+    for i in range(n):
+        body = rs.bytes(rs.randint(10, 3000))
+        s = pack(IRHeader(0, float(i), i, 0), body)
+        payloads.append(s)
+        rec.write(s)
+    rec.close()
+    return payloads
+
+
+def test_scan_matches_python_reader(tmp_path):
+    path = str(tmp_path / 'a.rec')
+    expect = _write_rec(path)
+    offs, lens = native.scan_offsets(path)
+    assert len(offs) == len(expect)
+    for ln, e in zip(lens, expect):
+        assert ln == len(e)
+
+
+def test_read_batch_bytes_identical(tmp_path):
+    path = str(tmp_path / 'b.rec')
+    expect = _write_rec(path)
+    offs, lens = native.scan_offsets(path)
+    got = native.read_batch(path, offs, lens)
+    for g, e in zip(got, expect):
+        assert g == e
+    # subset, out of order
+    idx = [5, 1, 9]
+    got = native.read_batch(path, offs[idx], lens[idx])
+    for g, i in zip(got, idx):
+        assert g == expect[i]
+
+
+def test_rec_reader_epochs(tmp_path):
+    path = str(tmp_path / 'c.rec')
+    expect = _write_rec(path, n=11)
+    r = native.RecReader(path, batch_size=4, shuffle=False)
+    assert r.num_records == 11
+    seen = []
+    while True:
+        b = r.next_batch()
+        if b is None:
+            break
+        seen.extend(b)
+    assert seen == expect          # order preserved without shuffle
+    r.reset()
+    seen2 = []
+    while True:
+        b = r.next_batch()
+        if b is None:
+            break
+        seen2.extend(b)
+    assert seen2 == expect
+    r.close()
+
+
+def test_rec_reader_shuffles(tmp_path):
+    path = str(tmp_path / 'd.rec')
+    expect = _write_rec(path, n=32)
+    r = native.RecReader(path, batch_size=8, shuffle=True, seed=3)
+    seen = []
+    while True:
+        b = r.next_batch()
+        if b is None:
+            break
+        seen.extend(b)
+    assert sorted(seen) == sorted(expect)
+    assert seen != expect          # 32! permutations: all-but-certainly moved
+    r.close()
+
+
+def test_rec_reader_grows_buffer(tmp_path):
+    path = str(tmp_path / 'e.rec')
+    rec = MXRecordIO(path, 'w')
+    big = bytes(np.random.RandomState(0).bytes(3 << 20))  # 3 MB record
+    rec.write(pack(IRHeader(0, 0.0, 0, 0), big))
+    rec.close()
+    r = native.RecReader(path, batch_size=1)
+    b = r.next_batch()
+    assert b is not None and b[0] == pack(IRHeader(0, 0.0, 0, 0), big)
+    r.close()
+
+
+def test_image_record_iter_uses_native(tmp_path):
+    """End to end: ImageRecordIter batches decoded through the native
+    reader match the pure-python fallback."""
+    import cv2
+    from mxnet_tpu.recordio import pack_img
+    path = str(tmp_path / 'img.rec')
+    rs = np.random.RandomState(1)
+    rec = MXRecordIO(path, 'w')
+    for i in range(8):
+        img = rs.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+        rec.write(pack_img(IRHeader(0, float(i % 3), i, 0), img,
+                           quality=95))
+    rec.close()
+
+    def collect(force_python):
+        orig = native.available
+        if force_python:
+            native.available = lambda: False
+        try:
+            it = mx.io.ImageRecordIter(path_imgrec=path, batch_size=4,
+                                       data_shape=(3, 16, 16),
+                                       shuffle=False)
+            assert (it._payload_spans is None) == force_python
+            labels = []
+            while True:
+                try:
+                    b = it.next()
+                except StopIteration:
+                    break
+                labels.append(b.label[0].asnumpy())
+            return np.concatenate(labels)
+        finally:
+            native.available = orig
+
+    np.testing.assert_array_equal(collect(False), collect(True))

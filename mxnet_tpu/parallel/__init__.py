@@ -13,3 +13,8 @@ degenerate 1x1 mesh — the same code path.
 from .mesh import create_mesh, current_mesh, local_mesh
 from .train_step import ParallelTrainer, pure_forward_fn
 from .sharding import ShardingRules, infer_param_sharding
+
+from .ring_attention import (ring_self_attention,
+                             ulysses_self_attention,
+                             ring_attention_local,
+                             ulysses_attention_local)  # noqa: F401,E402

@@ -1,0 +1,67 @@
+"""Inference throughput benchmark (reference:
+example/image-classification/benchmark_score.py; published numbers
+docs/faq/perf.md:167-193 — the BASELINE.md inference table).
+
+Scores hybridized model-zoo networks with one jitted forward per batch,
+fp32 and bf16, printing one JSON line per (model, dtype).
+"""
+import json
+import time
+
+import numpy as np
+
+# published 1x V100 bs=128 numbers (BASELINE.md)
+_V100 = {('resnet50_v1', 'float32'): 1233.15,
+         ('resnet50_v1', 'bfloat16'): 2355.04,   # vs V100 fp16
+         ('resnet152_v1', 'float32'): 511.79,
+         ('inception_v3', 'float32'): 904.33}
+
+
+def score(model_name, dtype, batch=128, image=224, iters=20):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import model_zoo
+
+    if model_name == 'inception_v3':
+        image = 299
+    net = getattr(model_zoo.vision, model_name)()
+    net.initialize(mx.init.Xavier())
+    if dtype != 'float32':
+        net.cast(dtype)
+    net.hybridize(static_alloc=True, static_shape=True)
+    x = nd.array(np.random.uniform(-1, 1, (batch, 3, image, image)),
+                 dtype=dtype)
+    for _ in range(3):
+        net(x)
+    nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        # block every call: identical async dispatches could otherwise be
+        # coalesced by the backend, overstating throughput
+        net(x).wait_to_read()
+    dt = time.perf_counter() - t0
+    img_s = batch * iters / dt
+    base = _V100.get((model_name, dtype))
+    print(json.dumps({
+        'metric': '%s_%s_infer_img_per_sec' % (model_name, dtype),
+        'value': round(img_s, 2), 'unit': 'img/s',
+        'vs_baseline': round(img_s / base, 3) if base else None}))
+    return img_s
+
+
+def main():
+    import jax
+    on_accel = jax.default_backend() != 'cpu'
+    batch = 128 if on_accel else 4
+    iters = 20 if on_accel else 2
+    for model, dtype in [('resnet50_v1', 'float32'),
+                         ('resnet50_v1', 'bfloat16'),
+                         ('resnet152_v1', 'float32'),
+                         ('inception_v3', 'float32')]:
+        score(model, dtype, batch=batch,
+              image=224, iters=iters)
+
+
+if __name__ == '__main__':
+    main()

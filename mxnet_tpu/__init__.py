@@ -10,6 +10,11 @@ collapse into jax.jit / pjit / mesh collectives (SURVEY.md §7 table).
 """
 __version__ = '1.5.0'  # capability parity target: reference v1.5.0-dev
 
+# multi-host join first: jax.distributed.initialize must precede any
+# backend-touching import below (tools/launch.py exports the env)
+from . import _dist_init
+_dist_init.ensure_distributed()
+
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
     num_gpus, num_tpus, default_device
 from .base import MXNetError

@@ -1,0 +1,70 @@
+"""Multi-host runtime join — must run before ANY jax backend touch, so
+this module has no package dependencies and is imported first by
+mxnet_tpu/__init__.py (reference analog: kvstore_dist.h PS connect at
+van startup, driven by the DMLC_* env that tools/launch.py exports)."""
+from __future__ import annotations
+
+import os
+import warnings
+
+_initialized = False
+
+
+def _env_request():
+    """(coordinator, num_workers, worker_id) from the launcher env, or
+    None when not requested / malformed (malformed warns, never breaks
+    plain `import mxnet_tpu`)."""
+    uri = os.environ.get('DMLC_PS_ROOT_URI')
+    raw_n = os.environ.get('DMLC_NUM_WORKER', '1')
+    try:
+        nworker = int(raw_n)
+        wid = int(os.environ.get('DMLC_WORKER_ID', '0'))
+    except ValueError:
+        warnings.warn('ignoring malformed DMLC_NUM_WORKER/DMLC_WORKER_ID '
+                      '(%r / %r)' % (raw_n,
+                                     os.environ.get('DMLC_WORKER_ID')))
+        return None
+    if not uri or nworker <= 1:
+        return None
+    port = os.environ.get('DMLC_PS_ROOT_PORT', '9091')
+    return '%s:%s' % (uri, port), nworker, wid
+
+
+def ensure_distributed():
+    """Idempotent: join jax.distributed per the launcher env.
+
+    DMLC_PS_ROOT_URI/PORT + DMLC_NUM_WORKER + DMLC_WORKER_ID (reference
+    contract) map to coordinator/num_processes/process_id; native
+    JAX_COORDINATOR_ADDRESS env is honored directly. A requested
+    multi-worker join that cannot happen (the JAX backend was already
+    initialized) is an ERROR — degrading to single-process would
+    silently drop the cross-worker allreduce."""
+    global _initialized
+    if _initialized:
+        return
+    req = _env_request()
+    if req is not None:
+        coordinator, nworker, wid = req
+        import jax
+        try:
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=nworker,
+                                       process_id=wid)
+        except RuntimeError as e:
+            if jax.process_count() >= nworker:
+                pass  # already joined (re-import after initialize)
+            else:
+                raise RuntimeError(
+                    'multi-worker launch requested (DMLC_NUM_WORKER=%d) '
+                    'but jax.distributed.initialize failed: %s. Import '
+                    'mxnet_tpu (or call jax.distributed.initialize) '
+                    'before any other JAX backend use.' % (nworker, e))
+        _initialized = True
+    elif os.environ.get('JAX_COORDINATOR_ADDRESS'):
+        import jax
+        try:
+            jax.distributed.initialize()
+        except RuntimeError:
+            if jax.process_count() <= 1:
+                raise
+        _initialized = True

@@ -217,6 +217,7 @@ def create(name='local'):
     """Create a KVStore by type string (reference: src/kvstore/kvstore.cc:40).
 
     All single-process types alias the mesh-collective store; dist types
+    join the multi-host runtime (launcher env -> jax.distributed) and
     enable the cross-process allreduce. 'dist_async' runs synchronously
     (documented divergence — no parameter server on TPU).
     """
@@ -224,4 +225,7 @@ def create(name='local'):
         raise TypeError('name must be a string')
     if name.lower() not in _SINGLE_TYPES + _DIST_TYPES:
         raise ValueError('Unknown KVStore type %s' % name)
+    if name.lower() in _DIST_TYPES:
+        from ._dist_init import ensure_distributed
+        ensure_distributed()
     return KVStore(name.lower())

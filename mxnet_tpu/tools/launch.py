@@ -1,0 +1,103 @@
+"""Multi-process launcher (reference: tools/launch.py + dmlc-core
+tracker — the `python -m mxnet_tpu.tools.launch -n 4 python train.py
+--kv-store dist_sync` entry point).
+
+TPU-native mapping: there is no parameter-server tracker; workers join a
+jax.distributed runtime whose coordinator is worker 0. The launcher
+exports the reference's DMLC_* env contract (which kvstore.create
+('dist_*') translates to jax.distributed.initialize), so reference
+training scripts launch unchanged.
+
+Local mode spawns n worker processes on this host (the analog of
+`--launcher local`); for cluster schedulers (slurm/mpi/k8s) export the
+same variables per task instead of using this script.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+__all__ = ['launch_local', 'main']
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers, command, env=None, coordinator_port=None,
+                 timeout=None):
+    """Spawn num_workers local processes running `command` with the
+    DMLC_* worker env set; returns the list of exit codes.
+
+    If any worker fails (or `timeout` seconds elapse), the remaining
+    workers are terminated — a dead coordinator would otherwise leave
+    its peers blocked in jax.distributed.initialize forever."""
+    import time
+    port = coordinator_port or _free_port()
+    procs = []
+    for wid in range(num_workers):
+        wenv = dict(os.environ, **(env or {}))
+        wenv.update({
+            'DMLC_ROLE': 'worker',
+            'DMLC_PS_ROOT_URI': '127.0.0.1',
+            'DMLC_PS_ROOT_PORT': str(port),
+            'DMLC_NUM_WORKER': str(num_workers),
+            'DMLC_NUM_SERVER': '0',
+            'DMLC_WORKER_ID': str(wid),
+        })
+        procs.append(subprocess.Popen(command, env=wenv))
+
+    deadline = time.time() + timeout if timeout else None
+    failed = False
+    while True:
+        states = [p.poll() for p in procs]
+        if all(s is not None for s in states):
+            break
+        if any(s not in (None, 0) for s in states) or \
+                (deadline and time.time() > deadline):
+            failed = True
+            break
+        time.sleep(0.2)
+    if failed:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+    return [p.returncode if p.returncode is not None else -15
+            for p in procs]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Launch a distributed training job '
+                    '(reference: tools/launch.py)')
+    parser.add_argument('-n', '--num-workers', type=int, required=True,
+                        help='number of worker processes')
+    parser.add_argument('--launcher', choices=['local'], default='local',
+                        help='only local spawning is built in; cluster '
+                             'schedulers should export DMLC_* per task')
+    parser.add_argument('command', nargs=argparse.REMAINDER,
+                        help='training command to run on every worker')
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error('no training command given')
+    codes = launch_local(args.num_workers, args.command)
+    bad = [c for c in codes if c != 0]
+    if bad:
+        sys.exit(bad[0])
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,69 @@
+"""Multi-host distributed: launcher + dist kvstore over
+jax.distributed (reference: tools/launch.py + tests/nightly/
+dist_sync_kvstore.py, mapped to the gloo-backed CPU runtime here)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.tools.launch import launch_local
+
+_WORKER = textwrap.dedent('''
+    import os
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    import numpy as np
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create('dist_sync')
+    assert kv.num_workers == 2, kv.num_workers
+    rank = kv.rank
+    kv.init('w', nd.zeros((4,)))
+    kv.push('w', nd.array(np.full((4,), float(rank + 1))))
+    out = nd.zeros((4,))
+    kv.pull('w', out=out)
+    assert np.allclose(out.asnumpy(), 3.0), out.asnumpy()
+    kv._barrier()
+    print('worker-%d-done' % rank)
+''')
+
+
+def test_launcher_two_process_dist_sync(tmp_path):
+    script = tmp_path / 'worker.py'
+    script.write_text(_WORKER)
+    env = {'PYTHONPATH': os.path.dirname(os.path.dirname(
+        os.path.abspath(mx.__file__)))}
+    codes = launch_local(2, [sys.executable, str(script)], env=env)
+    assert codes == [0, 0]
+
+
+def test_launcher_cli_builds_env(tmp_path):
+    """The CLI must export the reference DMLC_* contract per worker."""
+    script = tmp_path / 'echo_env.py'
+    script.write_text(textwrap.dedent('''
+        import os, sys
+        assert os.environ['DMLC_ROLE'] == 'worker'
+        assert int(os.environ['DMLC_NUM_WORKER']) == 3
+        wid = int(os.environ['DMLC_WORKER_ID'])
+        assert 0 <= wid < 3
+        assert os.environ['DMLC_PS_ROOT_URI'] == '127.0.0.1'
+        int(os.environ['DMLC_PS_ROOT_PORT'])
+    '''))
+    out = subprocess.run(
+        [sys.executable, '-m', 'mxnet_tpu.tools.launch', '-n', '3',
+         sys.executable, str(script)],
+        env=dict(os.environ, PYTHONPATH=os.path.dirname(
+            os.path.dirname(os.path.abspath(mx.__file__)))),
+        capture_output=True, timeout=120)
+    assert out.returncode == 0, out.stderr.decode()
+
+
+def test_single_process_dist_create_is_safe():
+    """dist kvstore without launcher env stays single-process."""
+    kv = mx.kv.create('dist_sync')
+    assert kv.num_workers == 1

@@ -1,0 +1,120 @@
+"""LeNet on MNIST — the reference's canonical example
+(example/image-classification/train_mnist.py), on both training APIs:
+Module.fit over the symbolic graph, and Gluon with a hybridized net +
+fused trainer. Falls back to synthetic digits when no MNIST files exist
+(zero-egress environments).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def get_data(batch_size, data_dir=None):
+    import mxnet_tpu as mx
+    files = ['train-images-idx3-ubyte', 'train-labels-idx1-ubyte']
+    if data_dir and all(os.path.exists(os.path.join(data_dir, f))
+                        for f in files):
+        train = mx.io.MNISTIter(
+            image=os.path.join(data_dir, files[0]),
+            label=os.path.join(data_dir, files[1]),
+            batch_size=batch_size, shuffle=True)
+        return train, train
+    # synthetic "digits": class k = a bright kxk top-left square
+    rs = np.random.RandomState(0)
+    n = 2048
+    y = rs.randint(0, 10, n)
+    x = rs.rand(n, 1, 28, 28).astype('float32') * 0.1
+    for i, k in enumerate(y):
+        x[i, 0, :k + 2, :k + 2] += 0.9
+    train = mx.io.NDArrayIter(x, y.astype('float32'),
+                              batch_size=batch_size, shuffle=True,
+                              label_name='softmax_label')
+    return train, train
+
+
+def lenet_symbol():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable('data')
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20,
+                            name='conv1')
+    t1 = mx.sym.Activation(c1, act_type='tanh', name='tanh1')
+    p1 = mx.sym.Pooling(t1, pool_type='max', kernel=(2, 2), stride=(2, 2),
+                        name='pool1')
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50,
+                            name='conv2')
+    t2 = mx.sym.Activation(c2, act_type='tanh', name='tanh2')
+    p2 = mx.sym.Pooling(t2, pool_type='max', kernel=(2, 2), stride=(2, 2),
+                        name='pool2')
+    fl = mx.sym.Flatten(p2, name='flatten')
+    f1 = mx.sym.FullyConnected(fl, num_hidden=500, name='fc1')
+    t3 = mx.sym.Activation(f1, act_type='tanh', name='tanh3')
+    f2 = mx.sym.FullyConnected(t3, num_hidden=10, name='fc2')
+    return mx.sym.SoftmaxOutput(f2, name='softmax')
+
+
+def train_module(epochs, batch_size, lr, data_dir=None):
+    import mxnet_tpu as mx
+    train, val = get_data(batch_size, data_dir)
+    mod = mx.mod.Module(lenet_symbol(), data_names=['data'],
+                        label_names=['softmax_label'])
+    mod.fit(train, eval_data=val, num_epoch=epochs, optimizer='sgd',
+            optimizer_params={'learning_rate': lr, 'momentum': 0.9},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(batch_size, 50),
+            eval_metric='acc')
+    metric = mx.metric.Accuracy()
+    val.reset()
+    acc = mod.score(val, metric)
+    return dict(acc)['accuracy']
+
+
+def train_gluon(epochs, batch_size, lr, data_dir=None):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+    train, _ = get_data(batch_size, data_dir)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(20, 5, activation='tanh'),
+                nn.MaxPool2D(2, 2),
+                nn.Conv2D(50, 5, activation='tanh'),
+                nn.MaxPool2D(2, 2), nn.Flatten(),
+                nn.Dense(500, activation='tanh'), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': lr, 'momentum': 0.9})
+    metric = mx.metric.Accuracy()
+    for epoch in range(epochs):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = L(out, y)
+            loss.backward()
+            trainer.step(batch_size)
+            metric.update([y], [out])
+    return metric.get()[1]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--api', choices=['module', 'gluon'], default='module')
+    p.add_argument('--epochs', type=int, default=3)
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--lr', type=float, default=0.05)
+    p.add_argument('--data-dir', default=None)
+    args = p.parse_args()
+    fn = train_module if args.api == 'module' else train_gluon
+    acc = fn(args.epochs, args.batch_size, args.lr, args.data_dir)
+    print('final accuracy %.4f' % acc)
+
+
+if __name__ == '__main__':
+    main()

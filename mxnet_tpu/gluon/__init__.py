@@ -11,3 +11,5 @@ from . import data
 from . import utils
 from . import model_zoo
 from .utils import split_data, split_and_load
+
+from . import contrib  # noqa: E402,F401

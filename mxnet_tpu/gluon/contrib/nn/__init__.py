@@ -1,0 +1,3 @@
+"""Contrib neural network layers
+(reference: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from .basic_layers import *  # noqa: F401,F403

@@ -1,0 +1,143 @@
+"""Contrib layers (behavioral parity: python/mxnet/gluon/contrib/nn/
+basic_layers.py — Concurrent, HybridConcurrent, Identity,
+SparseEmbedding, SyncBatchNorm, PixelShuffle1D/2D/3D)."""
+from __future__ import annotations
+
+from ...block import Block, HybridBlock
+from ...nn import BatchNorm, Embedding, HybridSequential, Sequential
+
+__all__ = ['Concurrent', 'HybridConcurrent', 'Identity', 'SparseEmbedding',
+           'SyncBatchNorm', 'PixelShuffle1D', 'PixelShuffle2D',
+           'PixelShuffle3D']
+
+
+class Concurrent(Sequential):
+    """Feed one input to every child and concat their outputs."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        outs = [block(x) for block in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block (useful as a Concurrent branch)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding whose gradient is row_sparse (reference: contrib
+    SparseEmbedding; here Embedding(sparse_grad=True) carries the same
+    lazy-update semantics through the optimizer zoo)."""
+
+    def __init__(self, input_dim, output_dim, dtype='float32',
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self._embed = Embedding(input_dim, output_dim, dtype=dtype,
+                                    weight_initializer=weight_initializer,
+                                    sparse_grad=True, prefix='')
+        self.weight = self._embed.weight
+
+    def forward(self, x):
+        return self._embed(x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference: contrib
+    SyncBatchNorm over src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-native: under the mesh-parallel compiled step the batch axis is
+    the GLOBAL batch, so plain BatchNorm statistics are already computed
+    over every device's samples — synchronization is by construction
+    (verified in tests/test_multidevice.py). This subclass keeps the
+    reference signature (num_devices is accepted and unused)."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=
+                 False, beta_initializer='zeros', gamma_initializer='ones',
+                 running_mean_initializer='zeros',
+                 running_variance_initializer='ones', **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=
+                         running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+
+
+class _PixelShuffle(HybridBlock):
+    """Rearrange channel blocks into spatial positions
+    (sub-pixel convolution upsampling)."""
+
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._ndim = ndim
+        if isinstance(factor, int):
+            factor = (factor,) * ndim
+        self._factors = tuple(int(f) for f in factor)
+
+    def hybrid_forward(self, F, x):
+        # shapes are concrete under jit tracing, so the split/interleave
+        # is expressed with explicit dims: split the channel axis into
+        # (C, f1..fk), interleave each factor after its spatial dim, and
+        # merge
+        f = self._factors
+        n, ctot = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        c = ctot // 1
+        for fi in f:
+            c //= fi
+        x = F.reshape(x, shape=(n, c) + f + tuple(spatial))
+        # (N, C, f1..fk, s1..sk) -> (N, C, s1, f1, s2, f2, ...)
+        axes = [0, 1]
+        for i in range(self._ndim):
+            axes.extend([2 + self._ndim + i, 2 + i])
+        x = F.transpose(x, axes=tuple(axes))
+        out_spatial = tuple(s * fi for s, fi in zip(spatial, f))
+        return F.reshape(x, shape=(n, c) + out_spatial)
+
+    def __repr__(self):
+        return '%s(factors=%s)' % (type(self).__name__, (self._factors,))
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

@@ -1,0 +1,144 @@
+"""Convolutional recurrent cells (behavioral parity:
+python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py — Conv{1,2,3}D
+{RNN,LSTM,GRU}Cell).
+
+One generic convolutional gate cell covers every variant: gates are
+computed by i2h/h2h convolutions over the spatial dims, and the cell
+type picks the recurrence (tanh RNN, LSTM, GRU)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ['Conv1DRNNCell', 'Conv2DRNNCell', 'Conv3DRNNCell',
+           'Conv1DLSTMCell', 'Conv2DLSTMCell', 'Conv3DLSTMCell',
+           'Conv1DGRUCell', 'Conv2DGRUCell', 'Conv3DGRUCell']
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvGateCell(HybridRecurrentCell):
+    _mode = 'rnn'     # 'rnn' | 'lstm' | 'gru'
+    _ndim = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=0, activation='tanh',
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 conv_layout='NCHW', prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        nd_ = self._ndim
+        if conv_layout not in (None, 'NCW', 'NCHW', 'NCDHW'):
+            raise NotImplementedError(
+                'only channels-first conv layouts are supported, got %r'
+                % conv_layout)
+        self._input_shape = tuple(input_shape)  # (C, s1..sk)
+        self._hidden_channels = hidden_channels
+        self._i2h_kernel = _tup(i2h_kernel, nd_)
+        self._h2h_kernel = _tup(h2h_kernel, nd_)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError('h2h_kernel dims must be odd (got %s) so '
+                                 'the state keeps its spatial shape'
+                                 % (self._h2h_kernel,))
+        self._i2h_pad = _tup(i2h_pad, nd_)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        self._activation = activation
+        gates = {'rnn': 1, 'lstm': 4, 'gru': 3}[self._mode]
+        self._gates = gates
+        in_c = self._input_shape[0]
+        self.i2h_weight = self.params.get(
+            'i2h_weight',
+            shape=(gates * hidden_channels, in_c) + self._i2h_kernel,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            'h2h_weight',
+            shape=(gates * hidden_channels,
+                   hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            'i2h_bias', shape=(gates * hidden_channels,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            'h2h_bias', shape=(gates * hidden_channels,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def _state_shape(self, batch_size):
+        spatial = tuple(
+            s + 2 * p - k + 1
+            for s, p, k in zip(self._input_shape[1:], self._i2h_pad,
+                               self._i2h_kernel))
+        return (batch_size, self._hidden_channels) + spatial
+
+    def state_info(self, batch_size=0):
+        shape = self._state_shape(batch_size)
+        n_states = 2 if self._mode == 'lstm' else 1
+        return [{'shape': shape, '__layout__': 'NC' + 'DHW'[-self._ndim:]}
+                for _ in range(n_states)]
+
+    def _alias(self):
+        return 'conv_%s' % self._mode
+
+    def _conv(self, F, x, weight, bias, pad):
+        return F.Convolution(
+            x, weight, bias, kernel=weight.shape[2:], pad=pad,
+            num_filter=weight.shape[0])
+
+    def _act(self, F, x):
+        if callable(self._activation):
+            return self._activation(x)
+        # the Activation op raises KeyError for unknown act_type strings
+        # rather than silently substituting
+        return F.Activation(x, act_type=self._activation)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = self._conv(F, inputs, i2h_weight, i2h_bias, self._i2h_pad)
+        h2h = self._conv(F, states[0], h2h_weight, h2h_bias,
+                         self._h2h_pad)
+        if self._mode == 'rnn':
+            h = self._act(F, i2h + h2h)
+            return h, [h]
+        if self._mode == 'lstm':
+            c_prev = states[1]
+            gates = i2h + h2h
+            i, f, g, o = F.split(gates, num_outputs=4, axis=1)
+            i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+            c = f * c_prev + i * self._act(F, g)
+            h = o * self._act(F, c)
+            return h, [h, c]
+        # gru
+        ir, iz, inn = F.split(i2h, num_outputs=3, axis=1)
+        hr, hz, hn = F.split(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(ir + hr)
+        z = F.sigmoid(iz + hz)
+        n = self._act(F, inn + r * hn)
+        h = (1 - z) * n + z * states[0]
+        return h, [h]
+
+
+def _variant(mode, ndim):
+    name = 'Conv%dD%sCell' % (ndim, {'rnn': 'RNN', 'lstm': 'LSTM',
+                                     'gru': 'GRU'}[mode])
+
+    class _Cell(_ConvGateCell):
+        pass
+    _Cell._mode = mode
+    _Cell._ndim = ndim
+    _Cell.__name__ = _Cell.__qualname__ = name
+    _Cell.__doc__ = ('%dD convolutional %s cell (reference: '
+                     'conv_rnn_cell.py %s).'
+                     % (ndim, mode.upper(), name))
+    return _Cell
+
+
+Conv1DRNNCell = _variant('rnn', 1)
+Conv2DRNNCell = _variant('rnn', 2)
+Conv3DRNNCell = _variant('rnn', 3)
+Conv1DLSTMCell = _variant('lstm', 1)
+Conv2DLSTMCell = _variant('lstm', 2)
+Conv3DLSTMCell = _variant('lstm', 3)
+Conv1DGRUCell = _variant('gru', 1)
+Conv2DGRUCell = _variant('gru', 2)
+Conv3DGRUCell = _variant('gru', 3)

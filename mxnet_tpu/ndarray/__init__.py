@@ -18,6 +18,17 @@ for _name in dir(op):
     if not _name.startswith('__') and not hasattr(_mod, _name):
         setattr(_mod, _name, getattr(op, _name))
 
+def cast_storage(data, stype='default', **kwargs):
+    """Storage-type cast returning the right NDArray subclass
+    (reference: python/mxnet/ndarray/sparse.py cast_storage over
+    src/operator/tensor/cast_storage.cc). Values are dense either way
+    (XLA storage); the class carries the stype semantics."""
+    return data.tostype(stype)
+
+
+setattr(_mod, 'cast_storage', cast_storage)
+setattr(op, 'cast_storage', cast_storage)
+
 from . import random  # noqa: E402,F401
 from . import linalg  # noqa: E402,F401
 from . import contrib  # noqa: E402,F401

@@ -177,10 +177,18 @@ class NDArray:
         return self
 
     def tostype(self, stype):
-        if stype != 'default':
-            raise NotImplementedError('sparse storage is emulated densely; '
-                                      'tostype(%r) unsupported' % stype)
-        return self
+        """Cast to a storage type (reference: ndarray.py tostype /
+        cast_storage.cc). Sparse stypes return the dense-backed facade
+        classes so downstream .stype dispatch (lazy optimizer updates,
+        row_sparse_pull) sees the right type."""
+        if stype == 'default':
+            return self
+        from .sparse import CSRNDArray, RowSparseNDArray
+        if stype == 'csr':
+            return CSRNDArray(self._data)
+        if stype == 'row_sparse':
+            return RowSparseNDArray(self._data)
+        raise ValueError('unknown storage type %r' % stype)
 
     # -- autograd ----------------------------------------------------------
     def attach_grad(self, grad_req='write', stype=None):

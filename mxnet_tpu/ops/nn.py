@@ -789,3 +789,19 @@ def spatial_transformer(data, loc, *, target_shape=None,
 def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
                                   penalty=0.001, momentum=0.9):
     return data
+
+
+# SyncBatchNorm: under the mesh-compiled step batch statistics are
+# computed on the GLOBAL batch, so sync is by construction — the op is
+# BatchNorm (reference: src/operator/contrib/sync_batch_norm.cc; the
+# key/ndev attrs are accepted and unused).
+@register('_contrib_SyncBatchNorm', num_inputs=5, num_outputs=3)
+def sync_batch_norm(data, gamma, beta, moving_mean, moving_var, *,
+                    eps=1e-3, momentum=0.9, fix_gamma=True,
+                    use_global_stats=False, output_mean_var=False,
+                    ndev=1, key=None, training=False, axis=1):
+    return batch_norm(data, gamma, beta, moving_mean, moving_var,
+                      eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                      use_global_stats=use_global_stats,
+                      output_mean_var=output_mean_var, axis=axis,
+                      training=training)

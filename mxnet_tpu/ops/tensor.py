@@ -474,3 +474,11 @@ def histogram(data, *, bin_cnt=10, range=None):
 @register('_shuffle', needs_rng=True, aliases=('shuffle',))
 def shuffle(key, data):
     return jax.random.permutation(key, data, axis=0)
+
+
+@register('cast_storage')
+def cast_storage(data, *, stype='default'):
+    """Storage-type cast (reference: cast_storage.cc). Dense XLA storage
+    backs every stype, so the values pass through; the frontend wrapper
+    (NDArray.tostype / sparse classes) carries the stype semantics."""
+    return data

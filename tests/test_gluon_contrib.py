@@ -1,0 +1,224 @@
+"""gluon.contrib layers + cells, SyncBatchNorm/cast_storage op parity,
+checkpoint-resume (reference: python/mxnet/gluon/contrib/,
+contrib/sync_batch_norm.cc, SURVEY.md §5.3 failure/recovery)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+# ---------------------------------------------------------------------------
+# contrib nn
+# ---------------------------------------------------------------------------
+
+def test_concurrent_and_identity():
+    blk = cnn.HybridConcurrent(axis=1)
+    blk.add(nn.Dense(3), cnn.Identity())
+    blk.initialize()
+    x = nd.array(np.ones((2, 4), 'float32'))
+    out = blk(x)
+    assert out.shape == (2, 7)      # 3 from Dense + 4 passthrough
+    np.testing.assert_allclose(out.asnumpy()[:, 3:], 1.0)
+
+
+def test_sparse_embedding_row_sparse_grad():
+    emb = cnn.SparseEmbedding(10, 4)
+    emb.initialize()
+    with autograd.record():
+        emb(nd.array(np.array([2, 5]))).sum().backward()
+    assert emb.weight.grad().stype == 'row_sparse'
+
+
+def test_sync_batch_norm_layer():
+    sbn = cnn.SyncBatchNorm(num_devices=4)
+    sbn.initialize()
+    x = nd.array(np.random.RandomState(0).randn(4, 3, 5, 5)
+                 .astype('float32'))
+    with autograd.record():
+        out = sbn(x)
+    # train-mode output is batch-normalized per channel
+    o = out.asnumpy()
+    assert abs(o.mean()) < 1e-2
+    assert abs(o.std() - 1.0) < 5e-2
+
+
+def test_sync_batch_norm_op_matches_batch_norm():
+    rs = np.random.RandomState(1)
+    x = nd.array(rs.randn(2, 3, 4, 4).astype('float32'))
+    g = nd.array(np.ones(3, 'float32'))
+    b = nd.array(np.zeros(3, 'float32'))
+    mean = nd.array(np.zeros(3, 'float32'))
+    var = nd.array(np.ones(3, 'float32'))
+    a = nd._contrib_SyncBatchNorm(x, g, b, mean, var, fix_gamma=False)
+    ref = nd.BatchNorm(x, g, b, mean, var, fix_gamma=False)
+    np.testing.assert_allclose(a[0].asnumpy(), ref[0].asnumpy(),
+                               rtol=1e-5)
+
+
+def test_cast_storage_op():
+    x = nd.array(np.eye(3, dtype='float32'))
+    out = nd.cast_storage(x, stype='row_sparse')
+    np.testing.assert_array_equal(out.asnumpy(), np.eye(3))
+
+
+@pytest.mark.parametrize('ndim,factor', [(1, 2), (2, (2, 3)), (3, 2)])
+def test_pixel_shuffle(ndim, factor):
+    cls = {1: cnn.PixelShuffle1D, 2: cnn.PixelShuffle2D,
+           3: cnn.PixelShuffle3D}[ndim]
+    f = (factor,) * ndim if isinstance(factor, int) else factor
+    prod = int(np.prod(f))
+    c = 2
+    spatial = tuple(range(3, 3 + ndim))
+    x = np.random.RandomState(0).randn(
+        2, c * prod, *spatial).astype('float32')
+    blk = cls(factor)
+    out = blk(nd.array(x))
+    expect_spatial = tuple(s * fi for s, fi in zip(spatial, f))
+    assert out.shape == (2, c) + expect_spatial
+    # channel blocks land at interleaved spatial offsets: entry (0, 0,
+    # [0]*ndim) of output = input channel 0 at spatial origin
+    assert out.asnumpy()[(0, 0) + (0,) * ndim] == \
+        pytest.approx(x[(0, 0) + (0,) * ndim])
+
+
+def test_pixel_shuffle_2d_matches_manual():
+    f1, f2 = 2, 2
+    x = np.arange(1 * 4 * 2 * 2, dtype='float32').reshape(1, 4, 2, 2)
+    out = cnn.PixelShuffle2D((f1, f2))(nd.array(x)).asnumpy()
+    # manual: split channel into (1, f1, f2), interleave
+    ref = x.reshape(1, 1, f1, f2, 2, 2).transpose(
+        0, 1, 4, 2, 5, 3).reshape(1, 1, 4, 4)
+    np.testing.assert_array_equal(out, ref)
+
+
+# ---------------------------------------------------------------------------
+# contrib rnn
+# ---------------------------------------------------------------------------
+
+def test_variational_dropout_fixed_mask():
+    base = gluon.rnn.RNNCell(8)
+    cell = crnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize()
+    x = nd.array(np.ones((2, 4), 'float32'))
+    states = cell.begin_state(batch_size=2)
+    with autograd.record():
+        o1, s = cell(x, states)
+        o2, s = cell(x, s)
+    z1 = o1.asnumpy() == 0
+    z2 = o2.asnumpy() == 0
+    assert z1.any()                      # dropout active
+    np.testing.assert_array_equal(z1, z2)  # same mask across steps
+
+
+def test_lstmp_cell_shapes():
+    cell = crnn.LSTMPCell(hidden_size=16, projection_size=8)
+    cell.initialize()
+    x = nd.array(np.random.randn(3, 6).astype('float32'))
+    states = cell.begin_state(batch_size=3)
+    out, (r, c) = cell(x, states)
+    assert out.shape == (3, 8)
+    assert r.shape == (3, 8) and c.shape == (3, 16)
+    # unrolls like any recurrent cell
+    seq = nd.array(np.random.randn(3, 5, 6).astype('float32'))
+    outs, _ = cell.unroll(5, seq, layout='NTC', merge_outputs=True)
+    assert outs.shape == (3, 5, 8)
+
+
+@pytest.mark.parametrize('mode', ['rnn', 'lstm', 'gru'])
+def test_conv_rnn_cells_2d(mode):
+    cls = {'rnn': crnn.Conv2DRNNCell, 'lstm': crnn.Conv2DLSTMCell,
+           'gru': crnn.Conv2DGRUCell}[mode]
+    cell = cls(input_shape=(3, 8, 8), hidden_channels=5, i2h_kernel=3,
+               h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = nd.array(np.random.randn(2, 3, 8, 8).astype('float32'))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 5, 8, 8)
+    for s in new_states:
+        assert s.shape == (2, 5, 8, 8)
+
+
+def test_conv_lstm_1d_and_3d():
+    c1 = crnn.Conv1DLSTMCell(input_shape=(2, 6), hidden_channels=3,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c1.initialize()
+    out, _ = c1(nd.array(np.random.randn(1, 2, 6).astype('float32')),
+                c1.begin_state(batch_size=1))
+    assert out.shape == (1, 3, 6)
+    c3 = crnn.Conv3DLSTMCell(input_shape=(2, 4, 4, 4), hidden_channels=3,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c3.initialize()
+    out, _ = c3(nd.array(np.random.randn(1, 2, 4, 4, 4)
+                         .astype('float32')),
+                c3.begin_state(batch_size=1))
+    assert out.shape == (1, 3, 4, 4, 4)
+
+
+def test_conv_rnn_rejects_even_h2h_kernel():
+    with pytest.raises(ValueError):
+        crnn.Conv2DRNNCell(input_shape=(3, 8, 8), hidden_channels=5,
+                           i2h_kernel=3, h2h_kernel=2)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-resume (SURVEY §5.3)
+# ---------------------------------------------------------------------------
+
+def test_module_checkpoint_resume(tmp_path):
+    """Train, checkpoint, resume from disk (params + optimizer states),
+    and confirm the resumed trajectory matches uninterrupted training."""
+    def make_module():
+        data = mx.sym.Variable('data')
+        fc = mx.sym.FullyConnected(data, num_hidden=8, name='fc1')
+        act = mx.sym.Activation(fc, act_type='relu', name='act')
+        out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+            act, num_hidden=3, name='fc2'), name='softmax')
+        return mx.mod.Module(out, data_names=['data'],
+                             label_names=['softmax_label'],
+                             context=mx.cpu())
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(12, 5).astype('float32')
+    y = rs.randint(0, 3, (12,))
+    it = mx.io.NDArrayIter(x, y, batch_size=4, label_name='softmax_label')
+
+    def fit(mod, epochs, resume_prefix=None, begin_epoch=0):
+        kw = {}
+        if resume_prefix is not None:
+            sym, arg, aux = mx.model.load_checkpoint(resume_prefix,
+                                                     begin_epoch)
+            kw = dict(arg_params=arg, aux_params=aux)
+        it.reset()
+        mod.fit(it, num_epoch=epochs, begin_epoch=begin_epoch,
+                optimizer='sgd',
+                optimizer_params={'learning_rate': 0.1, 'momentum': 0.0},
+                initializer=mx.init.Xavier(rnd_type='gaussian'),
+                eval_metric='acc', **kw)
+
+    prefix = str(tmp_path / 'model')
+    np.random.seed(1)
+    mx.random.seed(1)
+    m1 = make_module()
+    fit(m1, 2)
+    m1.save_checkpoint(prefix, 2)
+
+    # resume for 2 more epochs
+    m2 = make_module()
+    fit(m2, 4, resume_prefix=prefix, begin_epoch=2)
+    resumed = {k: v.asnumpy() for k, v in m2.get_params()[0].items()}
+
+    # uninterrupted 4-epoch run from the same init
+    np.random.seed(1)
+    mx.random.seed(1)
+    m3 = make_module()
+    fit(m3, 4)
+    straight = {k: v.asnumpy() for k, v in m3.get_params()[0].items()}
+
+    for k in straight:
+        np.testing.assert_allclose(resumed[k], straight[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)

@@ -578,6 +578,44 @@ spec('_contrib_MultiBoxDetection',
      grad=False)
 spec('quadratic', U((2, 3)), attrs=dict(a=1.0, b=1.0, c=0.0))
 
+# --- quantization / storage / sync-BN ---------------------------------------
+spec('cast_storage', U((3, 3)), attrs=dict(stype='default'), grad=False,
+     oracle=lambda x: x)
+spec('_contrib_SyncBatchNorm', U((2, 3, 4)), U((3,), 0.5, 1.5), U((3,)),
+     C(np.zeros(3, np.float32)), C(np.ones(3, np.float32)),
+     attrs=dict(fix_gamma=False), grad=False, n_outputs=1)
+spec('_contrib_quantize_v2', U((2, 3)),
+     attrs=dict(min_calib_range=-1.0, max_calib_range=1.0), grad=False,
+     n_outputs=3)
+spec('_contrib_dequantize',
+     C(np.array([[-127, 0, 64]], np.int8)),
+     C(np.float32(-1.0).reshape(())), C(np.float32(1.0).reshape(())),
+     grad=False)
+spec('_contrib_requantize',
+     C(np.array([[-1000, 0, 500]], np.int32)),
+     C(np.float32(-2000.0).reshape(())),
+     C(np.float32(2000.0).reshape(())),
+     attrs=dict(min_calib_range=-1000.0, max_calib_range=1000.0),
+     grad=False, n_outputs=3)
+spec('_contrib_quantized_conv',
+     C(np.random.RandomState(0).randint(-127, 127,
+                                        (1, 2, 5, 5)).astype(np.int8)),
+     C(np.random.RandomState(1).randint(-127, 127,
+                                        (2, 2, 3, 3)).astype(np.int8)),
+     C(np.zeros(2, np.float32)),
+     C(np.float32(-1.0).reshape(())), C(np.float32(1.0).reshape(())),
+     C(np.float32(-1.0).reshape(())), C(np.float32(1.0).reshape(())),
+     attrs=dict(kernel=(3, 3), num_filter=2), grad=False)
+spec('_contrib_quantized_fully_connected',
+     C(np.random.RandomState(2).randint(-127, 127, (2, 6))
+       .astype(np.int8)),
+     C(np.random.RandomState(3).randint(-127, 127, (4, 6))
+       .astype(np.int8)),
+     C(np.zeros(4, np.float32)),
+     C(np.float32(-1.0).reshape(())), C(np.float32(1.0).reshape(())),
+     C(np.float32(-1.0).reshape(())), C(np.float32(1.0).reshape(())),
+     attrs=dict(num_hidden=4), grad=False)
+
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------

@@ -50,6 +50,14 @@ from . import operator
 from . import image
 from . import config
 from . import contrib
+from . import attribute
+from .attribute import AttrScope
+from . import util
+from . import registry
+from . import engine
+from . import rtc
+from . import kvstore_server
+from . import executor_manager
 
 # env-driven global seed (docs/faq/env_var.md MXNET_SEED)
 _seed = config.get('MXNET_SEED')

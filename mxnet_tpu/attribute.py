@@ -1,0 +1,74 @@
+"""Attribute scoping for symbols (reference: python/mxnet/attribute.py —
+AttrScope; feeds ctx_group/lr_mult/wd_mult symbol attributes that the
+executor and optimizer read)."""
+from __future__ import annotations
+
+import threading
+
+from .base import string_types
+
+__all__ = ['AttrScope', 'current', 'attr_scope']
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, 'scopes'):
+        _state.scopes = [AttrScope()]
+    return _state.scopes
+
+
+class AttrScope:
+    """Attach attributes to every symbol created inside the scope:
+
+        with mx.AttrScope(ctx_group='dev1'):
+            w = mx.sym.Variable('w')     # carries __ctx_group__
+    """
+
+    def __init__(self, **kwargs):
+        for value in kwargs.values():
+            if not isinstance(value, string_types):
+                raise ValueError('Attributes need to be a string, but got '
+                                 '%r' % (value,))
+        self._attr = {'__%s__' % k: v for k, v in kwargs.items()}
+
+    def get(self, attr=None):
+        """Merge scope attributes into (a copy of) `attr`."""
+        if not self._attr:
+            return attr if attr else {}
+        merged = dict(self._attr)
+        if attr:
+            merged.update(attr)
+        return merged
+
+    def __enter__(self):
+        stack = _stack()
+        # nested scopes inherit the enclosing attributes
+        merged = dict(stack[-1]._attr)
+        merged.update(self._attr)
+        inner = AttrScope()
+        inner._attr = merged
+        stack.append(inner)
+        self._pushed = inner
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        stack = _stack()
+        assert stack[-1] is getattr(self, '_pushed', None)
+        stack.pop()
+
+
+def current():
+    """The innermost active AttrScope."""
+    return _stack()[-1]
+
+
+# reference exposes AttrScope._current.value; keep a compatible accessor
+class _CurrentSlot:
+    @property
+    def value(self):
+        return current()
+
+
+AttrScope._current = _CurrentSlot()
+attr_scope = AttrScope

@@ -1,0 +1,70 @@
+"""Legacy contrib autograd API (reference:
+python/mxnet/contrib/autograd.py — the pre-1.0 surface some example
+scripts still import; thin adapters over mxnet_tpu.autograd)."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from .. import ndarray as nd
+
+__all__ = ['set_is_training', 'train_section', 'test_section',
+           'backward', 'grad_and_loss', 'grad', 'mark_variables',
+           'compute_gradient']
+
+
+def set_is_training(is_train):
+    prev_t = _ag.set_training(bool(is_train))
+    _ag.set_recording(bool(is_train))
+    return prev_t
+
+
+def train_section():
+    return _ag.record()
+
+
+def test_section():
+    return _ag.pause()
+
+
+def mark_variables(variables, gradients, grad_reqs='write'):
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    return _ag.backward(outputs, out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    _ag.backward(outputs)
+    return None
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient of `func` and its
+    output (reference: contrib/autograd.py grad_and_loss)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args) if argnum is None else \
+            [args[i] for i in ([argnum] if isinstance(argnum, int)
+                               else argnum)]
+        for x in variables:
+            if x._entry is None or x._entry.variable is None:
+                x.attach_grad()
+        with _ag.record():
+            outputs = func(*args)
+        heads = outputs if isinstance(outputs, (list, tuple)) \
+            else [outputs]
+        _ag.backward(list(heads))
+        grads = [x.grad for x in variables]
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+    return wrapped

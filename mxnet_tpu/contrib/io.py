@@ -1,0 +1,50 @@
+"""Contrib data iterators (reference: python/mxnet/contrib/io.py
+DataLoaderIter — adapts a gluon DataLoader to the DataIter protocol so
+Module.fit consumes DataLoader pipelines)."""
+from __future__ import annotations
+
+from ..io.io import DataBatch, DataDesc, DataIter
+
+__all__ = ['DataLoaderIter']
+
+
+class DataLoaderIter(DataIter):
+    def __init__(self, loader, data_name='data',
+                 label_name='softmax_label', dtype='float32'):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        self._dtype = dtype
+        first = next(self._iter)
+        data, label = self._split(first)
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(data_name, data.shape, dtype)]
+        self.provide_label = [DataDesc(label_name, label.shape, dtype)] \
+            if label is not None else []
+        self._pending = first
+
+    @staticmethod
+    def _split(item):
+        if isinstance(item, (list, tuple)):
+            return item[0], (item[1] if len(item) > 1 else None)
+        return item, None
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._pending = None
+
+    def next(self):
+        if self._pending is not None:
+            item, self._pending = self._pending, None
+        else:
+            try:
+                item = next(self._iter)
+            except StopIteration:
+                raise
+        data, label = self._split(item)
+        return DataBatch(data=[data],
+                         label=[label] if label is not None else None,
+                         pad=0, provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,171 @@
+"""SVRGModule: stochastic variance-reduced gradient training
+(reference: contrib/svrg_optimization/svrg_module.py; Johnson & Zhang
+2013).
+
+Every `update_freq` epochs the current weights are snapshotted as the
+"special" weights w~ and the FULL-dataset gradient mu at w~ is computed;
+each batch then updates with the variance-reduced gradient
+    g(w) - g(w~) + mu.
+"""
+from __future__ import annotations
+
+import logging
+
+from ...module import Module
+
+__all__ = ['SVRGModule']
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=('data',),
+                 label_names=('softmax_label',), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None, update_freq=None):
+        for name, val in (('work_load_list', work_load_list),
+                          ('fixed_param_names', fixed_param_names),
+                          ('state_names', state_names),
+                          ('group2ctxs', group2ctxs),
+                          ('compression_params', compression_params)):
+            if val is not None:
+                raise ValueError('SVRGModule does not support %s' % name)
+        super().__init__(symbol, data_names=list(data_names),
+                         label_names=list(label_names), logger=logger,
+                         context=context)
+        if not isinstance(update_freq, int) or update_freq < 1:
+            raise ValueError('update_freq in SVRGModule must be a '
+                             'positive integer')
+        self.update_freq = update_freq
+        # twin module holding the special weights w~
+        self._mod_aux = Module(symbol, data_names=list(data_names),
+                               label_names=list(label_names),
+                               logger=logger, context=context)
+        self._param_dict = None   # mu: full grads at w~, per param name
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req='write'):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, shared_module,
+                     grad_req)
+        if for_training:
+            self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                               inputs_need_grad, force_rebind,
+                               shared_module, grad_req)
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        super().init_params(initializer=initializer,
+                            arg_params=arg_params, aux_params=aux_params,
+                            allow_missing=allow_missing,
+                            force_init=force_init,
+                            allow_extra=allow_extra)
+        self._sync_special_weights()
+
+    def _sync_special_weights(self):
+        args, auxs = self.get_params()
+        self._mod_aux.init_params(
+            initializer=None,
+            arg_params={k: v.copy() for k, v in args.items()},
+            aux_params={k: v.copy() for k, v in auxs.items()},
+            allow_missing=False, force_init=True)
+
+    def update_full_grads(self, train_data):
+        """mu <- average gradient over train_data at the special weights
+        (reference: svrg_module.py update_full_grads)."""
+        from ... import ndarray as nd
+        self._sync_special_weights()
+        train_data.reset()
+        sums = {}
+        nbatch = 0
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            grads = self._grads_of(self._mod_aux)
+            for name, g in grads.items():
+                if g is None:
+                    continue
+                acc = sums.get(name)
+                sums[name] = g.copy() if acc is None else acc + g
+            nbatch += 1
+        self._param_dict = {name: acc / float(nbatch)
+                            for name, acc in sums.items()}
+
+    @staticmethod
+    def _grads_of(mod):
+        """name -> grad NDArray of a bound Module's executor."""
+        args, _ = mod.get_params()
+        return {name: mod._exec.grad_dict.get(name) for name in args}
+
+    def update_svrg_gradients(self):
+        """grads <- g(w) - g(w~) + mu, in place on this module's grad
+        buffers (call after backward at BOTH weight sets)."""
+        cur = self._grads_of(self)
+        special = self._grads_of(self._mod_aux)
+        for name, g in cur.items():
+            if g is None or self._param_dict is None:
+                continue
+            mu = self._param_dict.get(name)
+            gs = special.get(name)
+            if mu is None or gs is None:
+                continue
+            g[:] = g - gs + mu
+
+    def forward_backward(self, data_batch):
+        """Forward+backward at current AND special weights, then apply
+        the SVRG rule."""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        if self._param_dict is not None:
+            self._mod_aux.forward(data_batch, is_train=True)
+            self._mod_aux.backward()
+            self.update_svrg_gradients()
+
+    def fit(self, train_data, eval_data=None, eval_metric='acc',
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore='local', optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.01),),
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        from ... import initializer as init_mod
+        from ... import metric as metric_mod
+        assert num_epoch is not None, 'please specify number of epochs'
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer or init_mod.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing,
+                         force_init=force_init)
+        # the SVRG update flows through the wrapper optimizer (kept as
+        # one object like the reference's kvstore-hosted split)
+        from .svrg_optimizer import _SVRGOptimizer
+        svrg_opt = _SVRGOptimizer(default_optimizer=optimizer,
+                                  **dict(optimizer_params))
+        self.init_optimizer(kvstore=kvstore, optimizer=svrg_opt,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        for epoch in range(begin_epoch, num_epoch):
+            if (epoch - begin_epoch) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            train_data.reset()
+            eval_metric.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    from types import SimpleNamespace
+                    batch_end_callback(SimpleNamespace(
+                        epoch=epoch, nbatch=nbatch,
+                        eval_metric=eval_metric))
+            for name, val in eval_metric.get_name_value():
+                self.logger.info('Epoch[%d] Train-%s=%f', epoch, name,
+                                 val)
+            if epoch_end_callback is not None:
+                args, auxs = self.get_params()
+                epoch_end_callback(epoch, self._symbol, args, auxs)

@@ -1,0 +1,31 @@
+"""SVRG update rule as an Optimizer wrapper (reference:
+contrib/svrg_optimization/svrg_optimizer.py).
+
+The module computes the variance-reduced gradient
+    g_svrg = g(w) - g(w_special) + mu        (mu = full gradient at
+                                              w_special)
+and hands it to the wrapped base optimizer here."""
+from __future__ import annotations
+
+from ... import optimizer as _opt
+
+__all__ = ['_SVRGOptimizer']
+
+
+class _SVRGOptimizer(_opt.Optimizer):
+    """Delegates updates to a base optimizer built by name; exists so
+    kvstore-hosted updates keep one optimizer object (reference keeps the
+    same split)."""
+
+    def __init__(self, default_optimizer='sgd', **kwargs):
+        base_kwargs = dict(kwargs)
+        super().__init__(**{k: v for k, v in kwargs.items()
+                            if k in ('rescale_grad', 'learning_rate',
+                                     'wd', 'clip_gradient')})
+        self.default_opt = _opt.create(default_optimizer, **base_kwargs)
+
+    def create_state(self, index, weight):
+        return self.default_opt.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        self.default_opt.update(index, weight, grad, state)

@@ -1,0 +1,48 @@
+"""TensorBoard logging callback (reference:
+python/mxnet/contrib/tensorboard.py LogMetricsCallback).
+
+Gated on an installed SummaryWriter (tensorboardX / torch.utils); absent
+writers raise at construction with a clear message (zero-egress image
+ships torch, whose writer usually works)."""
+from __future__ import annotations
+
+__all__ = ['LogMetricsCallback']
+
+
+def _find_writer():
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter
+    except Exception:
+        pass
+    try:
+        from tensorboardX import SummaryWriter
+        return SummaryWriter
+    except Exception:
+        return None
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming eval-metric values to TensorBoard:
+
+        mod.fit(..., batch_end_callback=LogMetricsCallback('logs/train'))
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        writer_cls = _find_writer()
+        if writer_cls is None:
+            raise ImportError(
+                'no SummaryWriter available: install tensorboardX or use '
+                "torch's torch.utils.tensorboard")
+        self.summary_writer = writer_cls(logging_dir)
+        self.prefix = prefix
+        self.step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = '%s-%s' % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -1,0 +1,186 @@
+"""Token embeddings (reference: contrib/text/embedding.py).
+
+Zero-egress environment: the GloVe/FastText pretrained downloads are not
+reachable, so those classes load from a LOCAL pretrained file path; the
+format (one token + vector per line) and the Vocabulary-composition API
+match the reference. CustomEmbedding and CompositeEmbedding work fully
+offline.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as onp
+
+from . import vocab as _vocab
+from ... import ndarray as nd
+
+__all__ = ['register', 'create', 'get_pretrained_file_names',
+           'TokenEmbedding', 'GloVe', 'FastText', 'CustomEmbedding',
+           'CompositeEmbedding']
+
+# registry built on the generic factories (reference embedding.py
+# composes mx.registry the same way)
+from ...registry import get_create_func, get_register_func  # noqa: E402
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names (informational — files must be local
+    in this zero-egress build)."""
+    names = {'glove': ['glove.6B.50d.txt', 'glove.6B.100d.txt',
+                       'glove.6B.200d.txt', 'glove.6B.300d.txt',
+                       'glove.42B.300d.txt', 'glove.840B.300d.txt'],
+             'fasttext': ['wiki.en.vec', 'wiki.simple.vec']}
+    if embedding_name is None:
+        return names
+    return names[embedding_name.lower()]
+
+
+class TokenEmbedding(_vocab.Vocabulary):
+    """Vocabulary + vector table; unknown tokens get init_unknown_vec."""
+
+    def __init__(self, unknown_token='<unk>', init_unknown_vec=None,
+                 **kwargs):
+        super().__init__(unknown_token=unknown_token, **kwargs)
+        self._init_unknown_vec = init_unknown_vec or (lambda shape:
+                                                      onp.zeros(shape))
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    def _load_embedding_file(self, path, elem_delim=' ',
+                             encoding='utf8'):
+        if not os.path.isfile(path):
+            raise IOError('pretrained embedding file %s not found (this '
+                          'environment has no network: place the file '
+                          'locally)' % path)
+        vectors = {}
+        with io.open(path, 'r', encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                token, elems = parts[0], parts[1:]
+                if line_num == 0 and len(elems) == 1 and \
+                        token.isdigit():
+                    continue  # fastText header line "count dim"
+                if self._vec_len == 0:
+                    self._vec_len = len(elems)
+                elif len(elems) != self._vec_len:
+                    logging.warning('line %d has %d elems, expected %d — '
+                                    'skipped', line_num, len(elems),
+                                    self._vec_len)
+                    continue
+                if token not in vectors:
+                    vectors[token] = onp.asarray(
+                        [float(e) for e in elems], onp.float32)
+        self._build_table(vectors)
+
+    def _build_table(self, vectors):
+        for token in sorted(vectors):
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+        # every token NOT present in the file (unknown, reserved, and
+        # counter tokens without pretrained vectors) gets the unknown-
+        # vector initializer (reference embedding.py semantics)
+        table = onp.zeros((len(self), self._vec_len), onp.float32)
+        for i, token in enumerate(self._idx_to_token):
+            if token in vectors:
+                table[i] = vectors[token]
+            else:
+                table[i] = self._init_unknown_vec((self._vec_len,))
+        self._idx_to_vec = nd.array(table)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idxs = [self._token_to_idx.get(t, _vocab.UNKNOWN_IDX)
+                for t in toks]
+        vecs = nd.array(self._idx_to_vec.asnumpy()[idxs])
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        arr = self._idx_to_vec.asnumpy().copy()
+        new = new_vectors.asnumpy() if hasattr(new_vectors, 'asnumpy') \
+            else onp.asarray(new_vectors)
+        new = new.reshape(len(toks), -1)
+        for t, v in zip(toks, new):
+            if t not in self._token_to_idx:
+                raise ValueError('token %s is unknown' % t)
+            arr[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(arr)
+
+
+register = get_register_func(TokenEmbedding, 'token embedding')
+create = get_create_func(TokenEmbedding, 'token embedding')
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe vectors from a local pretrained file."""
+
+    def __init__(self, pretrained_file_name='glove.6B.50d.txt',
+                 embedding_root=None, **kwargs):
+        super().__init__(**kwargs)
+        root = embedding_root or os.path.join(
+            os.path.expanduser('~'), '.mxnet', 'embeddings', 'glove')
+        self._load_embedding_file(os.path.join(root,
+                                               pretrained_file_name))
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText vectors from a local pretrained .vec file."""
+
+    def __init__(self, pretrained_file_name='wiki.simple.vec',
+                 embedding_root=None, **kwargs):
+        super().__init__(**kwargs)
+        root = embedding_root or os.path.join(
+            os.path.expanduser('~'), '.mxnet', 'embeddings', 'fasttext')
+        self._load_embedding_file(os.path.join(root,
+                                               pretrained_file_name))
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from any local token-vector file."""
+
+    def __init__(self, pretrained_file_path, elem_delim=' ',
+                 encoding='utf8', **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_file(pretrained_file_path, elem_delim,
+                                  encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._init_unknown_vec = lambda shape: onp.zeros(shape)
+        parts = []
+        for emb in token_embeddings:
+            parts.append(emb.get_vecs_by_tokens(
+                self._idx_to_token).asnumpy())
+        table = onp.concatenate(parts, axis=1)
+        self._vec_len = table.shape[1]
+        self._idx_to_vec = nd.array(table)

@@ -1,0 +1,93 @@
+"""Vocabulary (reference: contrib/text/vocab.py Vocabulary — index/token
+maps built from a Counter with min_freq / size caps and reserved
+tokens)."""
+from __future__ import annotations
+
+import collections
+
+__all__ = ['Vocabulary']
+
+UNKNOWN_IDX = 0
+
+
+class Vocabulary:
+    """Indexes tokens by frequency.
+
+    Index 0 is the unknown token; reserved tokens follow; then counted
+    tokens in descending frequency (ties broken alphabetically),
+    filtered by min_freq and capped at most_freq_count.
+    """
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token='<unk>', reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError('`min_freq` must be set to a positive value.')
+        reserved = list(reserved_tokens or [])
+        if len(set(reserved)) != len(reserved):
+            raise ValueError('`reserved_tokens` cannot contain duplicate '
+                             'reserved tokens.')
+        if unknown_token in reserved:
+            raise ValueError('`reserved_tokens` cannot contain '
+                             '`unknown_token`.')
+        self._unknown_token = unknown_token
+        self._reserved_tokens = reserved or None
+        self._idx_to_token = [unknown_token] + reserved
+        if counter is not None:
+            self._index_counter(counter, most_freq_count, min_freq)
+        self._token_to_idx = {t: i
+                              for i, t in enumerate(self._idx_to_token)}
+
+    def _index_counter(self, counter, most_freq_count, min_freq):
+        if not isinstance(counter, collections.Counter):
+            raise TypeError('counter must be a collections.Counter')
+        special = set(self._idx_to_token)
+        # frequency desc, then alphabetical — reference ordering
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        # most_freq_count caps the COUNTED tokens taken, on top of the
+        # unknown/reserved specials (reference vocab.py semantics)
+        budget = most_freq_count
+        taken = 0
+        for token, freq in pairs:
+            if freq < min_freq or token in special:
+                continue
+            if budget is not None and taken >= budget:
+                break
+            self._idx_to_token.append(token)
+            taken += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, UNKNOWN_IDX) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Index/indices -> token(s)."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError('Token index %d is out of range' % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -1,0 +1,47 @@
+"""Engine control (reference: python/mxnet/engine.py — bulk execution
+sizing over MXEngineSetBulkSize).
+
+TPU-native: op bulking is what the compiled-dispatch jit cache and
+hybridize already do, so the bulk size is bookkeeping — kept for API
+parity and surfaced to config's MXNET_EXEC_BULK_EXEC_* knobs."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ['set_bulk_size', 'bulk']
+
+_state = threading.local()
+
+
+def _cur():
+    return getattr(_state, 'bulk_size', 15)
+
+
+def set_bulk_size(size):
+    """Set the engine bulk-execution segment limit; returns the previous
+    value (reference: engine.py set_bulk_size)."""
+    prev = _cur()
+    _state.bulk_size = int(size)
+    return prev
+
+
+class _BulkScope:
+    def __init__(self, size):
+        self._size = size
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        set_bulk_size(self._prev)
+
+
+def bulk(size):
+    """Scope that bulks asynchronous ops in segments of `size`:
+
+        with mx.engine.bulk(30):
+            ...
+    """
+    return _BulkScope(size)

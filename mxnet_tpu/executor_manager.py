@@ -1,0 +1,99 @@
+"""Legacy multi-device executor manager (reference:
+python/mxnet/executor_manager.py — DataParallelExecutorManager used by
+the deprecated FeedForward API).
+
+TPU-native: one logical device per process (the mesh handles scale-out),
+so the manager degenerates to a single executor; kept because
+FeedForward-era scripts construct it directly."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ['DataParallelExecutorManager', '_split_input_slice']
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Slice the batch across workloads (reference: _split_input_slice)."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for w in work_load_list:
+        end = min(batch_size, start + int(round(batch_size * w / total)))
+        slices.append(slice(start, end))
+        start = end
+    if slices and slices[-1].stop != batch_size:
+        slices[-1] = slice(slices[-1].start, batch_size)
+    return slices
+
+
+class DataParallelExecutorManager:
+    """Single-executor manager with the legacy API surface."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=None, sym_gen=None):
+        self.logger = logger or logging
+        ctx = ctx if isinstance(ctx, (list, tuple)) else [ctx]
+        if len(ctx) > 1:
+            self.logger.warning(
+                'multiple contexts collapse to one logical device on '
+                'TPU; use parallel.ParallelTrainer for mesh scale-out')
+        self._ctx = ctx[0]
+        self._symbol = symbol
+        batch_size = train_data.provide_data[0][1][0]
+        shapes = {name: shape
+                  for name, shape in (tuple(d) for d in
+                                      list(train_data.provide_data) +
+                                      list(train_data.provide_label
+                                           or []))}
+        self.execgrp = symbol.simple_bind(self._ctx, grad_req='write',
+                                          **shapes)
+        self.param_names = param_names or []
+        self.aux_names = aux_names or []
+        self._io_names = [n for n, _ in
+                          (tuple(d) for d in
+                           list(train_data.provide_data) +
+                           list(train_data.provide_label or []))]
+
+    @property
+    def param_arrays(self):
+        return [[self.execgrp.arg_dict[n]] for n in self.param_names]
+
+    @property
+    def grad_arrays(self):
+        return [[self.execgrp.grad_dict[n]] for n in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[self.execgrp.aux_dict[n]] for n in self.aux_names]
+
+    def set_params(self, arg_params, aux_params):
+        for name, arr in arg_params.items():
+            if name in self.execgrp.arg_dict:
+                self.execgrp.arg_dict[name][:] = arr
+        for name, arr in (aux_params or {}).items():
+            if name in self.execgrp.aux_dict:
+                self.execgrp.aux_dict[name][:] = arr
+
+    def copy_to(self, arg_params, aux_params):
+        for name in arg_params:
+            if name in self.execgrp.arg_dict:
+                arg_params[name][:] = self.execgrp.arg_dict[name]
+        for name in (aux_params or {}):
+            if name in self.execgrp.aux_dict:
+                aux_params[name][:] = self.execgrp.aux_dict[name]
+
+    def load_data_batch(self, data_batch):
+        arrays = list(data_batch.data) + list(data_batch.label or [])
+        for name, arr in zip(self._io_names, arrays):
+            if name in self.execgrp.arg_dict:
+                self.execgrp.arg_dict[name][:] = arr
+
+    def forward(self, is_train=False):
+        return self.execgrp.forward(is_train=is_train)
+
+    def backward(self):
+        self.execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        metric.update(labels, self.execgrp.outputs)

@@ -1,0 +1,34 @@
+"""KVStore server role (reference: python/mxnet/kvstore_server.py — the
+parameter-server process loop).
+
+TPU-native: there are no parameter servers; dist kvstore reduces with
+mesh/process collectives, so a launched 'server' role has nothing to do.
+The entry point is kept so reference launch scripts that spawn servers
+exit cleanly instead of crashing."""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ['KVStoreServer', 'init']
+
+
+class KVStoreServer:
+    """No-op server shell (reference: KVStoreServer.run blocks serving
+    pushes; here collectives replace the PS, so run() returns)."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+
+    def run(self):
+        logging.info('mxnet_tpu has no parameter servers: dist kvstore '
+                     'uses process collectives; server role exiting.')
+
+
+def init():
+    """Start the server loop when launched with DMLC_ROLE=server
+    (reference: _init_kvstore_server_module)."""
+    if os.environ.get('DMLC_ROLE') == 'server':
+        KVStoreServer().run()
+        return True
+    return False

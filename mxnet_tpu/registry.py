@@ -1,0 +1,80 @@
+"""Generic class-registry factories (reference: python/mxnet/registry.py
+— the machinery behind mx.init/mx.optimizer/mx.lr_scheduler string
+lookup and the ``register``/``alias``/``create`` triple)."""
+from __future__ import annotations
+
+import json
+import warnings
+
+from .base import string_types
+
+__all__ = ['get_register_func', 'get_alias_func', 'get_create_func']
+
+_REGISTRIES = {}
+
+
+def _registry(base_class, nickname):
+    return _REGISTRIES.setdefault((base_class, nickname), {})
+
+
+def get_register_func(base_class, nickname):
+    """A decorator registering subclasses of base_class by (lowercased)
+    name."""
+    registry = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            'Can only register subclass of %s' % base_class.__name__
+        key = (name or klass.__name__).lower()
+        if key in registry and registry[key] is not klass:
+            warnings.warn('New %s %s.%s registered with name %s is '
+                          'overriding existing %s %s.%s'
+                          % (nickname, klass.__module__, klass.__name__,
+                             key, nickname, registry[key].__module__,
+                             registry[key].__name__))
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = 'Register %s to the %s factory' % (
+        base_class.__name__, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """A decorator factory adding alternative names for a registered
+    class: ``@alias('name1', 'name2')``."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """An instantiate-by-name factory. Accepts an instance (returned as
+    is), a registered name, or the reference's '[name, kwargs-json]'
+    string form."""
+    registry = _registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            assert len(args) == 1 and not kwargs
+            return args[0]
+        if not args:
+            raise ValueError('%s name is required' % nickname)
+        name, args = args[0], args[1:]
+        if isinstance(name, string_types) and name.startswith('['):
+            assert not args and not kwargs
+            name, kwargs = json.loads(name)
+        key = name.lower()
+        if key not in registry:
+            raise ValueError('%s is not registered as a %s (have: %s)'
+                             % (name, nickname, sorted(registry)))
+        return registry[key](*args, **kwargs)
+
+    create.__doc__ = 'Create a %s instance by name' % nickname
+    return create

@@ -1,0 +1,25 @@
+"""Runtime kernel compilation (reference: python/mxnet/rtc.py CudaModule
+over include/mxnet/rtc.h:136).
+
+There is no CUDA on TPU; the runtime-kernel escape hatch here is Pallas
+(mxnet_tpu/ops/pallas_kernels.py — e.g. the greedy NMS kernel) plus
+mx.operator.CustomOp for host code. This module keeps the reference API
+shape so ports fail with a pointer instead of an AttributeError."""
+from __future__ import annotations
+
+__all__ = ['CudaModule', 'CudaKernel']
+
+_MSG = ('CUDA runtime compilation is not available on TPU. Write a '
+        'Pallas kernel instead (see mxnet_tpu/ops/pallas_kernels.py for '
+        'the in-tree example) or use mx.operator.CustomOp for host-side '
+        'code.')
+
+
+class CudaModule:
+    def __init__(self, source, options=(), exports=()):
+        raise NotImplementedError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(_MSG)

@@ -576,6 +576,11 @@ def _create(opname, sym_inputs, attrs, name=None):
         entries.append(s._entry())
     node = _Node(op, name, attrs=attrs, inputs=entries,
                  num_outputs=num_outputs_of(op, attrs))
+    # active AttrScope attributes attach to op nodes too (ctx_group etc.)
+    from ..attribute import current as _attr_current
+    scope_attrs = _attr_current().get(None)
+    if scope_attrs:
+        node._extra_attrs.update(scope_attrs)
     # mark aux variables
     for pos in aux_indices_of(op):
         if pos < len(entries) and entries[pos][0].is_variable:
@@ -600,7 +605,9 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if wd_mult is not None:
         extra['__wd_mult__'] = wd_mult
     extra.update({k: v for k, v in kwargs.items()})
-    node._extra_attrs = extra
+    # active AttrScope attributes (ctx_group/lr_mult/...) attach here
+    from ..attribute import current as _attr_current
+    node._extra_attrs = _attr_current().get(extra)
     return Symbol([(node, 0)])
 
 

@@ -1,0 +1,58 @@
+"""Misc utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ['makedirs', 'get_gpu_count', 'get_gpu_memory', 'use_np_shape',
+           'is_np_shape', 'set_np_shape']
+
+
+def makedirs(d):
+    """mkdir -p (reference: util.py makedirs)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    """Number of accelerator devices (reference: util.py get_gpu_count)."""
+    import jax
+    try:
+        return len([d for d in jax.devices() if d.platform != 'cpu'])
+    except RuntimeError:
+        return 0
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    """(free, total) device memory in bytes where the backend reports it."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != 'cpu']
+    if gpu_dev_id >= len(devs):
+        raise ValueError('invalid device id %d' % gpu_dev_id)
+    stats = devs[gpu_dev_id].memory_stats() or {}
+    total = stats.get('bytes_limit', 0)
+    used = stats.get('bytes_in_use', 0)
+    return total - used, total
+
+
+# numpy-shape semantics: this framework always uses true numpy shape
+# semantics (zero-dim/zero-size arrays are native to jax), so the np_shape
+# toggles are constant-true (reference: util.py is_np_shape/set_np_shape)
+
+def is_np_shape():
+    return True
+
+
+def set_np_shape(active):
+    if not active:
+        raise ValueError('numpy shape semantics cannot be disabled: zero-'
+                         'dim and zero-size arrays are native to the XLA '
+                         'backend')
+    return True
+
+
+def use_np_shape(func):
+    """Decorator form (identity here — np shape is always on)."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+    return wrapper

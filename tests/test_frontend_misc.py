@@ -1,0 +1,226 @@
+"""Frontend completeness: attribute scopes, util/registry/engine/rtc,
+kvstore_server, executor_manager, contrib text/svrg/io/autograd
+(reference: python/mxnet/{attribute,util,registry,engine,rtc,
+kvstore_server,executor_manager}.py + contrib/)."""
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ---------------------------------------------------------------------------
+# AttrScope
+# ---------------------------------------------------------------------------
+
+def test_attr_scope_attaches_to_variables():
+    with mx.AttrScope(ctx_group='dev1', foo='bar'):
+        a = mx.sym.Variable('a')
+    b = mx.sym.Variable('b')
+    assert a.attr('__ctx_group__') == 'dev1'
+    assert a.attr('__foo__') == 'bar'
+    assert b.attr('__ctx_group__') is None
+
+
+def test_attr_scope_nesting_and_validation():
+    with mx.AttrScope(ctx_group='outer'):
+        with mx.AttrScope(stage='2'):
+            v = mx.sym.Variable('v')
+    assert v.attr('__ctx_group__') == 'outer'
+    assert v.attr('__stage__') == '2'
+    with pytest.raises(ValueError):
+        mx.AttrScope(lr_mult=2.0)   # attrs must be strings
+
+
+# ---------------------------------------------------------------------------
+# util / registry / engine / rtc
+# ---------------------------------------------------------------------------
+
+def test_util(tmp_path):
+    d = str(tmp_path / 'a' / 'b')
+    mx.util.makedirs(d)
+    mx.util.makedirs(d)   # idempotent
+    assert mx.util.is_np_shape()
+    with pytest.raises(ValueError):
+        mx.util.set_np_shape(False)
+    assert mx.util.get_gpu_count() >= 0
+
+
+def test_registry_factories():
+    class Base:
+        pass
+    reg = mx.registry.get_register_func(Base, 'thing')
+    alias = mx.registry.get_alias_func(Base, 'thing')
+    create = mx.registry.get_create_func(Base, 'thing')
+
+    @reg
+    class Foo(Base):
+        def __init__(self, x=1):
+            self.x = x
+
+    alias('foozle')(Foo)
+    assert isinstance(create('foo'), Foo)
+    assert isinstance(create('foozle'), Foo)
+    assert create('["foo", {"x": 5}]').x == 5
+    inst = Foo()
+    assert create(inst) is inst
+    with pytest.raises(ValueError):
+        create('nope')
+
+
+def test_engine_bulk():
+    prev = mx.engine.set_bulk_size(10)
+    assert mx.engine.set_bulk_size(prev) == 10
+    with mx.engine.bulk(30):
+        a = nd.array([1.0]) + 1
+    assert float(a.asscalar()) == 2.0
+
+
+def test_rtc_points_to_pallas():
+    with pytest.raises(NotImplementedError, match='Pallas'):
+        mx.rtc.CudaModule('__global__ void k() {}')
+
+
+def test_kvstore_server_role():
+    assert mx.kvstore_server.init() is False  # not a server process
+    mx.kvstore_server.KVStoreServer().run()   # returns immediately
+
+
+def test_executor_manager_single_device():
+    data = mx.sym.Variable('data')
+    out = mx.sym.FullyConnected(data, num_hidden=3, name='fc')
+    it = mx.io.NDArrayIter(np.ones((4, 5), 'float32'),
+                           np.zeros(4), batch_size=4)
+    m = mx.executor_manager.DataParallelExecutorManager(
+        out, mx.cpu(), it, param_names=['fc_weight', 'fc_bias'])
+    m.set_params({'fc_weight': nd.ones((3, 5)),
+                  'fc_bias': nd.zeros((3,))}, {})
+    batch = it.next()
+    m.load_data_batch(batch)
+    outs = m.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), 5.0)
+    slices = mx.executor_manager._split_input_slice(10, [1, 1])
+    assert slices == [slice(0, 5), slice(5, 10)]
+
+
+# ---------------------------------------------------------------------------
+# contrib.text
+# ---------------------------------------------------------------------------
+
+def test_text_vocab():
+    counter = mx.contrib.text.utils.count_tokens_from_str(
+        'a b b c c c\nd d d d')
+    assert counter == collections.Counter(a=1, b=2, c=3, d=4)
+    v = mx.contrib.text.Vocabulary(counter, most_freq_count=2, min_freq=2,
+                                   reserved_tokens=['<pad>'])
+    # specials, then the 2 most frequent counted tokens: d (4), c (3)
+    assert v.idx_to_token == ['<unk>', '<pad>', 'd', 'c']
+    assert v.to_indices(['d', 'zzz']) == [2, 0]
+    assert v.to_tokens([2, 3]) == ['d', 'c']
+    assert len(v) == 4
+    v5 = mx.contrib.text.Vocabulary(counter, most_freq_count=3,
+                                    min_freq=2,
+                                    reserved_tokens=['<pad>'])
+    assert v5.idx_to_token == ['<unk>', '<pad>', 'd', 'c', 'b']
+
+
+def test_text_custom_embedding(tmp_path):
+    path = tmp_path / 'vecs.txt'
+    path.write_text('hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n')
+    emb = mx.contrib.text.embedding.CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens('world').asnumpy(), [4.0, 5.0, 6.0])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens('missing').asnumpy(), 0.0)
+    emb.update_token_vectors('hello', nd.array([9.0, 9.0, 9.0]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens('hello').asnumpy(), 9.0)
+
+
+def test_text_composite_embedding(tmp_path):
+    p1 = tmp_path / 'a.txt'
+    p1.write_text('x 1.0 2.0\ny 3.0 4.0\n')
+    p2 = tmp_path / 'b.txt'
+    p2.write_text('x 5.0\ny 6.0\n')
+    e1 = mx.contrib.text.embedding.CustomEmbedding(str(p1))
+    e2 = mx.contrib.text.embedding.CustomEmbedding(str(p2))
+    vocab = mx.contrib.text.Vocabulary(collections.Counter(x=2, y=1))
+    comp = mx.contrib.text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens('x').asnumpy(), [1.0, 2.0, 5.0])
+
+
+# ---------------------------------------------------------------------------
+# contrib.svrg_optimization
+# ---------------------------------------------------------------------------
+
+def test_svrg_module_trains():
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 6).astype('float32')
+    w_true = rs.randn(6, 1).astype('float32')
+    y = (x @ w_true).ravel()
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name='lin_label')
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=1, name='fc')
+    out = mx.sym.LinearRegressionOutput(fc, mx.sym.Variable('lin_label'),
+                                        name='lin')
+    mod = mx.contrib.svrg_optimization.SVRGModule(
+        out, data_names=['data'], label_names=['lin_label'],
+        update_freq=2)
+    mod.fit(it, num_epoch=12, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.05},
+            initializer=mx.init.Uniform(0.05), eval_metric='mse')
+    it.reset()
+    mod.forward(it.next(), is_train=False)
+    pred = mod.get_outputs()[0].asnumpy().ravel()
+    mse = float(((pred - y[:8]) ** 2).mean())
+    assert mse < 0.5
+
+
+def test_svrg_requires_update_freq():
+    out = mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=1)
+    with pytest.raises(ValueError):
+        mx.contrib.svrg_optimization.SVRGModule(out, update_freq=0)
+
+
+# ---------------------------------------------------------------------------
+# contrib.io + contrib.autograd
+# ---------------------------------------------------------------------------
+
+def test_dataloader_iter():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    x = np.arange(24, dtype='float32').reshape(12, 2)
+    y = np.arange(12, dtype='float32')
+    loader = DataLoader(ArrayDataset(x, y), batch_size=4)
+    it = mx.contrib.io.DataLoaderIter(loader)
+    assert it.batch_size == 4
+    count = 0
+    it.reset()
+    while True:
+        try:
+            b = it.next()
+        except StopIteration:
+            break
+        assert b.data[0].shape == (4, 2)
+        count += 1
+    assert count == 3
+
+
+def test_contrib_autograd_grad_and_loss():
+    def f(a, b):
+        return a * b + a
+
+    g_l = mx.contrib.autograd.grad_and_loss(f)
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    grads, out = g_l(a, b)
+    np.testing.assert_allclose(out.asnumpy(), [8.0])
+    np.testing.assert_allclose(grads[0].asnumpy(), [4.0])
+    np.testing.assert_allclose(grads[1].asnumpy(), [2.0])
+    g = mx.contrib.autograd.grad(f)
+    grads2 = g(a, b)
+    np.testing.assert_allclose(grads2[0].asnumpy(), [4.0])

@@ -13,7 +13,7 @@ from collections import OrderedDict
 
 import numpy
 
-from .base import string_types, numeric_types
+from .base import string_types
 from .ndarray import NDArray
 
 __all__ = ['EvalMetric', 'CompositeEvalMetric', 'Accuracy', 'TopKAccuracy',
@@ -57,19 +57,21 @@ def create(metric, *args, **kwargs):
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    lhs = labels.shape if shape else len(labels)
+    rhs = preds.shape if shape else len(preds)
+    if lhs != rhs:
         raise ValueError('Shape of labels {} does not match shape of '
-                         'predictions {}'.format(label_shape, pred_shape))
+                         'predictions {}'.format(lhs, rhs))
     if wrap:
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels = [labels] if isinstance(labels, NDArray) else labels
+        preds = [preds] if isinstance(preds, NDArray) else preds
     return labels, preds
+
+
+def _as_pairs(name, value):
+    names = name if isinstance(name, list) else [name]
+    values = value if isinstance(value, list) else [value]
+    return list(zip(names, values))
 
 
 class EvalMetric:
@@ -88,31 +90,27 @@ class EvalMetric:
         return 'EvalMetric: {}'.format(dict(self.get_name_value()))
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            'metric': self.__class__.__name__,
-            'name': self.name,
-            'output_names': self.output_names,
-            'label_names': self.label_names})
+        config = dict(self._kwargs,
+                      metric=self.__class__.__name__, name=self.name,
+                      output_names=self.output_names,
+                      label_names=self.label_names)
         return config
 
+    @staticmethod
+    def _select(mapping, wanted):
+        if wanted is None:
+            return list(mapping.values())
+        return [mapping[n] for n in wanted if n in mapping]
+
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names if name in pred]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names if name in label]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        self.update(self._select(label, self.label_names),
+                    self._select(pred, self.output_names))
 
     def update(self, labels, preds):
         raise NotImplementedError()
 
     def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
+        self.reset_local()
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
 
@@ -132,22 +130,20 @@ class EvalMetric:
             return (self.name, self.global_sum_metric / self.global_num_inst)
         return self.get()
 
+    def _accumulate(self, total, count=1):
+        """Add one observation to both the local window and the running
+        (global) accumulators."""
+        self.sum_metric += total
+        self.global_sum_metric += total
+        self.num_inst += count
+        self.global_num_inst += count
+
     def get_name_value(self):
-        name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        return _as_pairs(*self.get())
 
     def get_global_name_value(self):
         if self._has_global_stats:
-            name, value = self.get_global()
-            if not isinstance(name, list):
-                name = [name]
-            if not isinstance(value, list):
-                value = [value]
-            return list(zip(name, value))
+            return _as_pairs(*self.get_global())
         return self.get_name_value()
 
 
@@ -172,59 +168,44 @@ class CompositeEvalMetric(EvalMetric):
             return ValueError('Metric index {} is out of range 0 and {}'.format(
                 index, len(self.metrics)))
 
+    @staticmethod
+    def _filter(mapping, wanted):
+        if wanted is None:
+            return mapping
+        return OrderedDict((k, v) for k, v in mapping.items()
+                           if k in wanted)
+
     def update_dict(self, labels, preds):
-        if self.label_names is not None:
-            labels = OrderedDict([i for i in labels.items()
-                                  if i[0] in self.label_names])
-        if self.output_names is not None:
-            preds = OrderedDict([i for i in preds.items()
-                                 if i[0] in self.output_names])
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+        labels = self._filter(labels, self.label_names)
+        preds = self._filter(preds, self.output_names)
+        self._each(lambda m: m.update_dict(labels, preds))
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        self._each(lambda m: m.update(labels, preds))
+
+    def _each(self, fn):
+        for metric in getattr(self, 'metrics', []):
+            fn(metric)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        self._each(lambda m: m.reset())
 
     def reset_local(self):
-        try:
-            for metric in self.metrics:
-                metric.reset_local()
-        except AttributeError:
-            pass
+        self._each(lambda m: m.reset_local())
+
+    def _collect(self, getter):
+        names, values = [], []
+        for metric in self.metrics:
+            for n, v in _as_pairs(*getter(metric)):
+                names.append(n)
+                values.append(v)
+        return names, values
 
     def get(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if isinstance(name, string_types):
-                name = [name]
-            if isinstance(value, numeric_types):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
+        return self._collect(lambda m: m.get())
 
     def get_global(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get_global()
-            if isinstance(name, string_types):
-                name = [name]
-            if isinstance(value, numeric_types):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
+        return self._collect(lambda m: m.get_global())
 
     def get_config(self):
         config = super().get_config()
@@ -382,88 +363,78 @@ class _BinaryClassificationMetrics:
         self._running[:] = 0
 
 
+class _BinaryScoreMetric(EvalMetric):
+    """Shared machinery for confusion-matrix scores (F1, MCC): macro
+    averages the per-window score, micro scores the running matrix."""
+
+    def __init__(self, name, output_names=None, label_names=None,
+                 average='macro'):
+        self.average = average
+        self._bin = _BinaryClassificationMetrics()
+        EvalMetric.__init__(self, name=name, output_names=output_names,
+                            label_names=label_names,
+                            has_global_stats=True)
+
+    def _score(self, use_global):
+        raise NotImplementedError
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self._bin.update_binary_stats(label, pred)
+        if self.average == 'macro':
+            self._accumulate_macro()
+        else:
+            self.sum_metric = self._score(False) * self._bin.total_examples
+            self.num_inst = self._bin.total_examples
+            self.global_sum_metric = self._score(True) * \
+                self._bin.global_total_examples
+            self.global_num_inst = self._bin.global_total_examples
+
+    def _accumulate_macro(self):
+        self._accumulate_pair(self._score(False), self._score(True))
+        self._bin.reset_stats()
+
+    def _accumulate_pair(self, local, global_):
+        self.sum_metric += local
+        self.num_inst += 1
+        self.global_sum_metric += global_
+        self.global_num_inst += 1
+
+    def reset(self):
+        self.reset_local()
+        self.global_sum_metric = 0.
+        self.global_num_inst = 0.
+        self._bin.reset()
+
+    def reset_local(self):
+        self.sum_metric = 0.
+        self.num_inst = 0.
+        self._bin.reset_stats()
+
+
 @register
-class F1(EvalMetric):
+class F1(_BinaryScoreMetric):
     """Binary F1 (reference: metric.py F1)."""
 
     def __init__(self, name='f1', output_names=None, label_names=None,
                  average='macro'):
-        self.average = average
-        self.metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names, has_global_stats=True)
+        super().__init__(name, output_names, label_names, average)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(label, pred)
-        if self.average == 'macro':
-            self.sum_metric += self.metrics.fscore
-            self.global_sum_metric += self.metrics.global_fscore
-            self.num_inst += 1
-            self.global_num_inst += 1
-            self.metrics.reset_stats()
-        else:
-            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
-            self.global_sum_metric = (self.metrics.global_fscore *
-                                      self.metrics.global_total_examples)
-            self.num_inst = self.metrics.total_examples
-            self.global_num_inst = self.metrics.global_total_examples
-
-    def reset(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        self.global_sum_metric = 0.
-        self.global_num_inst = 0.
-        self.metrics.reset()
-
-    def reset_local(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        self.metrics.reset_stats()
+    def _score(self, use_global):
+        return self._bin.global_fscore if use_global else self._bin.fscore
 
 
 @register
-class MCC(EvalMetric):
+class MCC(_BinaryScoreMetric):
     """Matthews correlation coefficient (reference: metric.py MCC)."""
 
     def __init__(self, name='mcc', output_names=None, label_names=None,
                  average='macro'):
-        self._average = average
-        self._metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names, has_global_stats=True)
+        super().__init__(name, output_names, label_names, average)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
-        if self._average == 'macro':
-            self.sum_metric += self._metrics.matthewscc()
-            self.global_sum_metric += self._metrics.matthewscc(use_global=True)
-            self.num_inst += 1
-            self.global_num_inst += 1
-            self._metrics.reset_stats()
-        else:
-            self.sum_metric = (self._metrics.matthewscc() *
-                               self._metrics.total_examples)
-            self.global_sum_metric = (
-                self._metrics.matthewscc(use_global=True) *
-                self._metrics.global_total_examples)
-            self.num_inst = self._metrics.total_examples
-            self.global_num_inst = self._metrics.global_total_examples
-
-    def reset(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        self.global_sum_metric = 0.
-        self.global_num_inst = 0.
-        self._metrics.reset()
-
-    def reset_local(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        self._metrics.reset_stats()
+    def _score(self, use_global):
+        return self._bin.matthewscc(use_global)
 
 
 @register
@@ -498,10 +469,7 @@ class Perplexity(EvalMetric):
                 probs = probs * (1 - ignore) + ignore
             loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
             num += label_np.size
-        self.sum_metric += loss
-        self.global_sum_metric += loss
-        self.num_inst += num
-        self.global_num_inst += num
+        self._accumulate(loss, num)
 
     def get(self):
         if self.num_inst == 0:
@@ -514,80 +482,74 @@ class Perplexity(EvalMetric):
         return (self.name, math.exp(self.global_sum_metric / self.global_num_inst))
 
 
+class _RegressionMetric(EvalMetric):
+    """Per-batch mean of an elementwise error (MAE/MSE/RMSE)."""
+
+    def _error(self, diff):
+        raise NotImplementedError
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            l_ = label.asnumpy()
+            p_ = pred.asnumpy()
+            l_ = l_[:, None] if l_.ndim == 1 else l_
+            p_ = p_[:, None] if p_.ndim == 1 else p_
+            self._accumulate(self._error(l_ - p_))
+
+
 @register
-class MAE(EvalMetric):
+class MAE(_RegressionMetric):
     """Mean absolute error (reference: metric.py MAE)."""
 
     def __init__(self, name='mae', output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            mae = numpy.abs(label - pred).mean()
-            self.sum_metric += mae
-            self.global_sum_metric += mae
-            self.num_inst += 1
-            self.global_num_inst += 1
+    def _error(self, diff):
+        return float(numpy.abs(diff).mean())
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_RegressionMetric):
     """Mean squared error (reference: metric.py MSE)."""
 
     def __init__(self, name='mse', output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            mse = ((label - pred) ** 2.0).mean()
-            self.sum_metric += mse
-            self.global_sum_metric += mse
-            self.num_inst += 1
-            self.global_num_inst += 1
+    def _error(self, diff):
+        return float((diff ** 2).mean())
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_RegressionMetric):
     """Root mean squared error (reference: metric.py RMSE)."""
 
     def __init__(self, name='rmse', output_names=None, label_names=None):
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
 
+    def _error(self, diff):
+        return float(numpy.sqrt((diff ** 2).mean()))
+
+
+class _NegLogProbMetric(EvalMetric):
+    """Sum of -log p(label) over examples (CrossEntropy / NLL)."""
+
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            rmse = numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.sum_metric += rmse
-            self.global_sum_metric += rmse
-            self.num_inst += 1
-            self.global_num_inst += 1
+            idx = label.asnumpy().ravel().astype(numpy.int64)
+            p_ = pred.asnumpy()
+            assert idx.shape[0] == p_.shape[0]
+            picked = p_[numpy.arange(idx.shape[0]), idx]
+            self._accumulate(float(-numpy.log(picked + self.eps).sum()),
+                             idx.shape[0])
 
 
 @_alias('ce')
-class CrossEntropy(EvalMetric):
+class CrossEntropy(_NegLogProbMetric):
     """Cross entropy against class probabilities (reference: metric.py)."""
 
     def __init__(self, eps=1e-12, name='cross-entropy', output_names=None,
@@ -596,23 +558,9 @@ class CrossEntropy(EvalMetric):
                          label_names=label_names, has_global_stats=True)
         self.eps = eps
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            cross_entropy = (-numpy.log(prob + self.eps)).sum()
-            self.sum_metric += cross_entropy
-            self.global_sum_metric += cross_entropy
-            self.num_inst += label.shape[0]
-            self.global_num_inst += label.shape[0]
-
 
 @_alias('nll_loss')
-class NegativeLogLikelihood(EvalMetric):
+class NegativeLogLikelihood(_NegLogProbMetric):
     """NLL (reference: metric.py NegativeLogLikelihood)."""
 
     def __init__(self, eps=1e-12, name='nll-loss', output_names=None,
@@ -621,103 +569,74 @@ class NegativeLogLikelihood(EvalMetric):
                          label_names=label_names, has_global_stats=True)
         self.eps = eps
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, \
-                (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            nll = (-numpy.log(prob + self.eps)).sum()
-            self.sum_metric += nll
-            self.global_sum_metric += nll
-            self.num_inst += num_examples
-            self.global_num_inst += num_examples
-
 
 @_alias('pearsonr')
 class PearsonCorrelation(EvalMetric):
-    """Pearson correlation (reference: metric.py PearsonCorrelation)."""
+    """Pearson correlation (reference: metric.py PearsonCorrelation).
+
+    average='macro' averages per-batch correlations; 'micro' keeps
+    running sums so get() returns the correlation over ALL samples."""
 
     def __init__(self, name='pearsonr', output_names=None, label_names=None,
                  average='macro'):
         self.average = average
         super().__init__(name, output_names=output_names,
                          label_names=label_names, has_global_stats=True)
-        if self.average == 'micro':
-            self.reset_micro()
 
     def reset_micro(self):
-        self._sse_p = 0
-        self._mean_p = 0
-        self._sse_l = 0
-        self._mean_l = 0
-        self._pred_nums = 0
-        self._label_nums = 0
-        self._conv = 0
+        # sums: n, sum x, sum y, sum x^2, sum y^2, sum xy — one local
+        # window + one running (global) set
+        self._sums = numpy.zeros(6, numpy.float64)
 
     def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
+        self.reset_local()
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
-        if getattr(self, 'average', None) == 'micro':
-            self.reset_micro()
+        self._gsums = numpy.zeros(6, numpy.float64)
 
-    def update_variance(self, new_values, *aggregate):
-        count = len(new_values)
-        mean = numpy.mean(new_values)
-        variance = numpy.sum((new_values - mean) ** 2)
-        count_a, mean_a, var_a = aggregate
-        delta = mean - mean_a
-        m_a = var_a * (count_a - 1)
-        m_b = variance * (count - 1)
-        M2 = m_a + m_b + delta ** 2 * count_a * count / (count_a + count)
-        return count_a + count, (count_a * mean_a + count * mean) / (count_a + count), \
-            M2 / (count_a + count - 1)
-
-    def update_cov(self, label, pred):
-        self._conv = self._conv + numpy.sum(
-            (label - self._mean_l) * (pred - self._mean_p))
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.reset_micro()
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
             check_label_shapes(label, pred, False, True)
-            label = label.asnumpy().ravel().astype(numpy.float64)
-            pred = pred.asnumpy().ravel().astype(numpy.float64)
+            l_ = label.asnumpy().ravel().astype(numpy.float64)
+            p_ = pred.asnumpy().ravel().astype(numpy.float64)
             if self.average == 'macro':
-                pearson_corr = numpy.corrcoef(pred, label)[0, 1]
-                self.sum_metric += pearson_corr
-                self.global_sum_metric += pearson_corr
-                self.num_inst += 1
-                self.global_num_inst += 1
+                self._accumulate(float(numpy.corrcoef(p_, l_)[0, 1]))
             else:
-                self.global_num_inst += 1
                 self.num_inst += 1
-                self._label_nums, self._mean_l, self._sse_l = \
-                    self.update_variance(label, self._label_nums,
-                                         self._mean_l, self._sse_l)
-                self.update_cov(label, pred)
-                self._pred_nums, self._mean_p, self._sse_p = \
-                    self.update_variance(pred, self._pred_nums,
-                                         self._mean_p, self._sse_p)
+                self.global_num_inst += 1
+                batch = numpy.array([l_.size, l_.sum(), p_.sum(),
+                                     (l_ * l_).sum(), (p_ * p_).sum(),
+                                     (l_ * p_).sum()])
+                self._sums += batch
+                self._gsums += batch
+
+    @staticmethod
+    def _corr_of(sums):
+        n, sl, sp, sll, spp, slp = sums
+        num = n * slp - sl * sp
+        den = numpy.sqrt(max(n * sll - sl * sl, 0.0) *
+                         max(n * spp - sp * sp, 0.0))
+        return float(num / den) if den else float('nan')
 
     def get(self):
         if self.num_inst == 0:
             return (self.name, float('nan'))
         if self.average == 'macro':
             return (self.name, self.sum_metric / self.num_inst)
-        n = self._label_nums
-        numerator = self._conv
-        denominator = n * numpy.sqrt(self._sse_p) * numpy.sqrt(self._sse_l)
-        if denominator == 0:
+        return (self.name, self._corr_of(self._sums))
+
+    def get_global(self):
+        if self.average == 'macro':
+            return super().get_global()
+        if self.global_num_inst == 0:
             return (self.name, float('nan'))
-        return (self.name, float(numerator / denominator))
+        return (self.name, self._corr_of(self._gsums))
 
 
 @register
@@ -735,17 +654,21 @@ class PCC(EvalMetric):
         self.gcm = numpy.pad(self.gcm, ((0, inc), (0, inc)), 'constant')
         self.k += inc
 
-    def _calc_mcc(self, cmat):
-        n = cmat.sum()
-        x = cmat.sum(axis=1)
-        y = cmat.sum(axis=0)
-        cov_xx = numpy.sum(x * (n - x))
-        cov_yy = numpy.sum(y * (n - y))
-        if cov_xx == 0 or cov_yy == 0:
+    @staticmethod
+    def _calc_mcc(cmat):
+        # multiclass MCC from the confusion matrix: cov(pred, label) /
+        # sqrt(cov(pred, pred) * cov(label, label)) over class marginals
+        total = cmat.sum()
+        pred_marginal = cmat.sum(axis=1)
+        label_marginal = cmat.sum(axis=0)
+        var_pred = float((pred_marginal * (total - pred_marginal)).sum())
+        var_label = float((label_marginal *
+                           (total - label_marginal)).sum())
+        if not var_pred or not var_label:
             return float('nan')
-        i = cmat.diagonal()
-        cov_xy = numpy.sum(i * n - x * y)
-        return cov_xy / (cov_xx * cov_yy) ** 0.5
+        cov = float((cmat.diagonal() * total -
+                     pred_marginal * label_marginal).sum())
+        return cov / numpy.sqrt(var_pred * var_label)
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
@@ -760,8 +683,7 @@ class PCC(EvalMetric):
             if n >= self.k:
                 self._grow(n + 1 - self.k)
             bcm = numpy.zeros((self.k, self.k))
-            for i, j in zip(pred, label):
-                bcm[i, j] += 1
+            numpy.add.at(bcm, (pred, label), 1)
             self.lcm += bcm
             self.gcm += bcm
         self.num_inst += 1
@@ -805,11 +727,7 @@ class Loss(EvalMetric):
         if isinstance(preds, NDArray):
             preds = [preds]
         for pred in preds:
-            loss = float(pred.asnumpy().sum())
-            self.sum_metric += loss
-            self.global_sum_metric += loss
-            self.num_inst += pred.size
-            self.global_num_inst += pred.size
+            self._accumulate(float(pred.asnumpy().sum()), pred.size)
 
 
 @register
@@ -853,10 +771,7 @@ class CustomMetric(EvalMetric):
             # feval may return a bare value (count 1) or (sum, count)
             total, count = result if isinstance(result, tuple) \
                 else (result, 1)
-            self.sum_metric += total
-            self.global_sum_metric += total
-            self.num_inst += count
-            self.global_num_inst += count
+            self._accumulate(total, count)
 
     def get_config(self):
         raise NotImplementedError('CustomMetric cannot be serialized')

@@ -67,6 +67,21 @@ def test_mae_mse_rmse():
     assert mse.get()[1] == pytest.approx((0.25 + 0 + 1) / 3)
 
 
+def test_pearson_micro_matches_corrcoef():
+    rs = np.random.RandomState(0)
+    l = rs.randn(40)
+    p = 0.7 * l + 0.3 * rs.randn(40)
+    m = mx.metric.PearsonCorrelation(average='micro')
+    for i in range(0, 40, 10):
+        m.update([nd.array(l[i:i + 10])], [nd.array(p[i:i + 10])])
+    assert m.get()[1] == pytest.approx(np.corrcoef(p, l)[0, 1], abs=1e-6)
+    assert m.get_global()[1] == pytest.approx(np.corrcoef(p, l)[0, 1],
+                                              abs=1e-6)
+    m.reset()
+    m.update([nd.array(l)], [nd.array(p)])
+    assert m.get()[1] == pytest.approx(np.corrcoef(p, l)[0, 1], abs=1e-6)
+
+
 def test_perplexity():
     m = mx.metric.Perplexity(ignore_label=None)
     pred = nd.array([[0.5, 0.5], [0.9, 0.1]])

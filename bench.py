@@ -14,6 +14,21 @@ import time
 import numpy as np
 
 
+def _retry_transient(build):
+    """Run a fused-step builder, retrying ONCE only for transient
+    tunnel/compile transport errors; deterministic failures propagate
+    immediately so the eager fallback engages without a wasted sleep."""
+    try:
+        return build()
+    except Exception as e:
+        msg = str(e)
+        if 'INTERNAL' in msg or 'remote_compile' in msg or \
+                'UNAVAILABLE' in msg:
+            time.sleep(10)
+            return build()
+        raise
+
+
 def main():
     import jax
     import mxnet_tpu as mx
@@ -41,12 +56,16 @@ def main():
     # backward + allreduce + optimizer): ~2.6x the eager record/backward/
     # step path on one chip. Falls back to the eager Trainer if the
     # fused build fails.
-    try:
+    def _build_fused():
         mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
         pt = parallel.ParallelTrainer(
             net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9,
                             'wd': 1e-4}, mesh)
         pt.step(x, y)   # compile here so a build failure falls back
+        return pt
+
+    try:
+        pt = _retry_transient(_build_fused)
 
         def step():
             return pt.step(x, y)

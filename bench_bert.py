@@ -16,6 +16,21 @@ import time
 import numpy as np
 
 
+def _retry_transient(build):
+    """Run a fused-step builder, retrying ONCE only for transient
+    tunnel/compile transport errors; deterministic failures propagate
+    immediately so the eager fallback engages without a wasted sleep."""
+    try:
+        return build()
+    except Exception as e:
+        msg = str(e)
+        if 'INTERNAL' in msg or 'remote_compile' in msg or \
+                'UNAVAILABLE' in msg:
+            time.sleep(10)
+            return build()
+        raise
+
+
 def main():
     import jax
     import mxnet_tpu as mx
@@ -55,18 +70,21 @@ def main():
     # one pjit-compiled, donated program per step (fwd+bwd+AdamW)
     try:
         from mxnet_tpu import parallel
-        mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
-
         def pretrain_loss(outs, labels):
             _, _, mlm_s, nsp_s = outs
             my, ny = labels
             return L(mlm_s.reshape((-1, vocab)),
                      my.reshape((-1,))).mean() + L(nsp_s, ny).mean()
 
-        pt = parallel.ParallelTrainer(net, pretrain_loss, 'adamw',
-                                      {'learning_rate': 1e-4, 'wd': 0.01},
-                                      mesh)
-        pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])  # compile in the try
+        def _build_fused():
+            mesh = parallel.create_mesh({'dp': 1},
+                                        devices=jax.devices()[:1])
+            pt = parallel.ParallelTrainer(
+                net, pretrain_loss, 'adamw',
+                {'learning_rate': 1e-4, 'wd': 0.01}, mesh)
+            pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])  # compile here
+            return pt
+        pt = _retry_transient(_build_fused)
 
         def step():
             return pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])

@@ -69,8 +69,8 @@ def check_label_shapes(labels, preds, wrap=False, shape=False):
 
 
 def _as_pairs(name, value):
-    names = name if isinstance(name, list) else [name]
-    values = value if isinstance(value, list) else [value]
+    names = list(name) if isinstance(name, (list, tuple)) else [name]
+    values = list(value) if isinstance(value, (list, tuple)) else [value]
     return list(zip(names, values))
 
 
@@ -378,6 +378,16 @@ class _BinaryScoreMetric(EvalMetric):
     def _score(self, use_global):
         raise NotImplementedError
 
+    @property
+    def metrics(self):
+        """The underlying binary confusion stats (upstream API name:
+        f1.metrics.precision/.recall/.fscore)."""
+        return self._bin
+
+    @property
+    def _average(self):   # upstream MCC attribute name
+        return self.average
+
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
@@ -584,15 +594,19 @@ class PearsonCorrelation(EvalMetric):
                          label_names=label_names, has_global_stats=True)
 
     def reset_micro(self):
-        # sums: n, sum x, sum y, sum x^2, sum y^2, sum xy — one local
-        # window + one running (global) set
+        # shifted sums: n, sum x, sum y, sum x^2, sum y^2, sum xy, with
+        # x/y shifted by the first batch's means — correlation is shift-
+        # invariant and the shift avoids catastrophic cancellation in
+        # n*sxx - sx^2 for large-mean data
         self._sums = numpy.zeros(6, numpy.float64)
+        self._shift = None
 
     def reset(self):
         self.reset_local()
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
         self._gsums = numpy.zeros(6, numpy.float64)
+        self._gshift = None
 
     def reset_local(self):
         self.num_inst = 0
@@ -610,11 +624,20 @@ class PearsonCorrelation(EvalMetric):
             else:
                 self.num_inst += 1
                 self.global_num_inst += 1
-                batch = numpy.array([l_.size, l_.sum(), p_.sum(),
-                                     (l_ * l_).sum(), (p_ * p_).sum(),
-                                     (l_ * p_).sum()])
-                self._sums += batch
-                self._gsums += batch
+                if self._shift is None:
+                    self._shift = (float(l_.mean()), float(p_.mean()))
+                if self._gshift is None:
+                    self._gshift = self._shift
+                self._sums += self._moments(l_, p_, self._shift)
+                self._gsums += self._moments(l_, p_, self._gshift)
+
+    @staticmethod
+    def _moments(l_, p_, shift):
+        ls = l_ - shift[0]
+        ps = p_ - shift[1]
+        return numpy.array([ls.size, ls.sum(), ps.sum(),
+                            (ls * ls).sum(), (ps * ps).sum(),
+                            (ls * ps).sum()])
 
     @staticmethod
     def _corr_of(sums):

@@ -38,7 +38,7 @@ def main():
     on_accel = jax.default_backend() != 'cpu'
     batch = 128 if on_accel else 8
     image = 224 if on_accel else 64
-    warmup, iters = 3, 10 if on_accel else 3
+    warmup, iters = 3, 30 if on_accel else 3
 
     net = model_zoo.vision.resnet50_v1()
     net.initialize(mx.init.Xavier())

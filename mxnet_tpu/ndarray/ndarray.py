@@ -99,11 +99,22 @@ class NDArray:
     # -- engine semantics --------------------------------------------------
     def wait_to_read(self):
         """Block until the value is computed (reference: ndarray.h:361
-        WaitToRead; XLA analog = block_until_ready)."""
-        self._data.block_until_ready()
+        WaitToRead; XLA analog = block_until_ready).
+
+        block_until_ready alone is not a true fence on tunneled PJRT
+        backends (the call returns once the work is *dispatched*); a
+        one-element device->host fetch is — the copy cannot complete
+        before the producing program has executed, and costs ~0.1 ms
+        when the array is already materialised."""
+        d = self._data
+        d.block_until_ready()
+        if d.size == 0:
+            return
+        onp.asarray(d if d.ndim == 0
+                    else jax.device_get(d[(0,) * d.ndim]))
 
     def wait_to_write(self):
-        self._data.block_until_ready()
+        self.wait_to_read()
 
     # -- conversion --------------------------------------------------------
     def asnumpy(self):
@@ -743,14 +754,18 @@ def minimum(lhs, rhs):
 def waitall():
     """Block on all outstanding async work (reference: MXNDArrayWaitAll).
 
-    PJRT executes per-device work in dispatch order, so blocking on a
-    fresh trivial computation per device drains everything enqueued
-    before it; effects_barrier() flushes host callbacks."""
+    PJRT executes per-device work in dispatch order, so fetching a fresh
+    trivial *computation* per device back to the host drains everything
+    enqueued before it (a device->host copy of its result cannot finish
+    until the queue ahead of it has run — unlike block_until_ready,
+    which tunneled backends complete at dispatch time);
+    effects_barrier() flushes host callbacks."""
     if hasattr(jax, 'effects_barrier'):
         jax.effects_barrier()
     try:
         for dev in jax.devices():
-            jax.block_until_ready(jax.device_put(0, dev))
+            fence = jnp.add(jax.device_put(jnp.zeros(()), dev), 1)
+            onp.asarray(fence)
     except RuntimeError:
         pass
 

@@ -10,6 +10,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as _onp
 
 from .registry import register
 
@@ -18,6 +19,89 @@ from .registry import register
 def quadratic(data, *, a=0.0, b=0.0, c=0.0):
     """The "how to add an op" tutorial op (reference: contrib/quadratic_op)."""
     return a * data * data + b * data + c
+
+
+def _tuple_attr(v):
+    if v is None:
+        return ()
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    if isinstance(v, str):
+        inner = v.strip('()[] ')
+        return tuple(int(x) for x in inner.split(',') if x.strip()) \
+            if inner else ()
+    return tuple(int(x) for x in v)
+
+
+@register('_contrib_AdaptiveAvgPooling2D')
+def adaptive_avg_pooling2d(data, *, output_size=None):
+    """NCHW adaptive average pooling (reference:
+    contrib/adaptive_avg_pooling.cc:203). Each output cell averages the
+    input window [floor(i*H/oh), ceil((i+1)*H/oh)); computed with a
+    2-D summed-area table + static gathers, so uneven windows cost two
+    cumsums instead of a per-cell loop."""
+    os = _tuple_attr(output_size)
+    n, c, h, w = data.shape
+    oh = os[0] if len(os) >= 1 else 1
+    ow = os[1] if len(os) >= 2 else oh
+    if oh == h and ow == w:
+        return data
+    f = data.astype(jnp.float32)
+    # summed-area table with a leading zero row/col
+    s = jnp.pad(jnp.cumsum(jnp.cumsum(f, axis=2), axis=3),
+                ((0, 0), (0, 0), (1, 0), (1, 0)))
+    hs = _onp.floor(_onp.arange(oh) * h / oh).astype(int)
+    he = _onp.ceil((_onp.arange(oh) + 1) * h / oh).astype(int)
+    ws = _onp.floor(_onp.arange(ow) * w / ow).astype(int)
+    we = _onp.ceil((_onp.arange(ow) + 1) * w / ow).astype(int)
+    area = ((he - hs)[:, None] * (we - ws)[None, :]).astype(_onp.float32)
+    tot = (s[:, :, he][:, :, :, we] - s[:, :, hs][:, :, :, we]
+           - s[:, :, he][:, :, :, ws] + s[:, :, hs][:, :, :, ws])
+    return (tot / area).astype(data.dtype)
+
+
+@register('_contrib_BilinearResize2D')
+def bilinear_resize2d(data, *, height=1, width=1, scale_height=None,
+                      scale_width=None, mode='size'):
+    """NCHW bilinear up/down-sampling with align-corners sampling
+    (reference: contrib/bilinear_resize.cc:183, kernel in
+    bilinear_resize-inl.h — src = dst * (L_in-1)/(L_out-1)). Lowered as
+    two one-axis gathers + lerps, which XLA fuses."""
+    if mode not in ('size', 'scale'):
+        # 'like'/'to_even_*' etc. need a second input or different
+        # rounding; fail loudly rather than resize to the wrong shape
+        raise ValueError('BilinearResize2D mode=%r not supported (only '
+                         'size/scale)' % (mode,))
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        oh = int(round(h * float(scale_height)))
+        ow = int(round(w * float(scale_width if scale_width is not None
+                                 else scale_height)))
+    else:
+        oh, ow = int(height), int(width)
+    out = data.astype(jnp.float32)
+
+    def _axis_resize(x, axis, new_len):
+        old_len = x.shape[axis]
+        if new_len == old_len:
+            return x
+        if new_len == 1 or old_len == 1:
+            idx = jnp.zeros(new_len, dtype=jnp.int32)
+            return jnp.take(x, idx, axis=axis)
+        src = jnp.arange(new_len, dtype=jnp.float32) * \
+            ((old_len - 1) / (new_len - 1))
+        lo = jnp.floor(src).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, old_len - 1)
+        t = (src - lo.astype(jnp.float32))
+        shape = [1] * x.ndim
+        shape[axis] = new_len
+        t = t.reshape(shape)
+        return (jnp.take(x, lo, axis=axis) * (1 - t) +
+                jnp.take(x, hi, axis=axis) * t)
+
+    out = _axis_resize(out, 2, oh)
+    out = _axis_resize(out, 3, ow)
+    return out.astype(data.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))

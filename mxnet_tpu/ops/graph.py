@@ -1,0 +1,229 @@
+"""Graph-sampling operators for DGL integration (reference:
+src/operator/contrib/dgl_graph.cc — the _contrib_dgl_* family,
+_contrib_edge_id; src/operator/contrib/nnz.cc — _contrib_getnnz).
+
+TPU-first design note: neighbour sampling is data-dependent,
+control-flow-heavy host work; the reference runs it FComputeEx-on-CPU
+only (never on GPU), and the same split applies here — these ops run
+on the host over the dense CSR facade (ndarray/sparse.py) and are
+``nojit`` (they cannot appear inside a traced graph, exactly like the
+reference's CSR-only ops cannot appear inside its fused executors).
+Sampled minibatch tensors re-enter the jit path as ordinary arrays.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _np(a):
+    return onp.asarray(a)
+
+
+@register('_contrib_dgl_adjacency', nojit=True)
+def dgl_adjacency(data):
+    """CSR graph -> adjacency with all-1 edge values
+    (reference: dgl_graph.cc:1376)."""
+    return jnp.asarray((_np(data) != 0).astype(onp.float32))
+
+
+@register('_contrib_edge_id', num_inputs=3, nojit=True)
+def edge_id(data, u, v):
+    """out[i] = data[u[i], v[i]] if that edge exists else -1
+    (reference: dgl_graph.cc:1300)."""
+    g = _np(data)
+    ui = _np(u).astype(onp.int64).ravel()
+    vi = _np(v).astype(onp.int64).ravel()
+    vals = g[ui, vi]
+    out = onp.where(vals != 0, vals, -1).astype(g.dtype)
+    return jnp.asarray(out)
+
+
+@register('_contrib_getnnz', nojit=True)
+def getnnz(data, *, axis=None):
+    """Number of stored (non-zero) values (reference: contrib/nnz.cc;
+    scipy.sparse.csr_matrix.getnnz semantics)."""
+    g = _np(data)
+    nz = g != 0
+    if axis is None:
+        return jnp.asarray(onp.int64(nz.sum()))
+    ax = int(axis)
+    # axis=0 counts per column, axis=1 per row (reference: nnz.cc:66-73)
+    return jnp.asarray(nz.sum(axis=ax).astype(onp.int64))
+
+
+def _renumber(sub):
+    """Replace non-zero entries with fresh 1..nnz ids in row-major
+    (CSR) order — the new-edge-id matrix dgl_subgraph returns."""
+    out = onp.zeros_like(sub)
+    nz = onp.nonzero(sub)
+    order = onp.arange(1, len(nz[0]) + 1, dtype=sub.dtype)
+    out[nz] = order
+    return out
+
+
+@register('_contrib_dgl_subgraph', num_inputs=-1, num_outputs=-1,
+          key_var_num_args='num_args', nojit=True)
+def dgl_subgraph(args, *, num_args=None, return_mapping=False):
+    """Induced subgraph per vertex set (reference: dgl_graph.cc:1115).
+
+    args = [graph, varray0, varray1, ...]; for each varray returns the
+    induced subgraph with renumbered edge ids, plus (if return_mapping)
+    a twin carrying the original edge ids.
+    """
+    graph = _np(args[0])
+    news, origs = [], []
+    for v in args[1:]:
+        vid = _np(v).astype(onp.int64).ravel()
+        orig = graph[onp.ix_(vid, vid)]
+        news.append(jnp.asarray(_renumber(orig)))
+        origs.append(jnp.asarray(orig))
+    out = news + (origs if return_mapping else [])
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+def _sample_one(graph, seeds, prob, num_hops, num_neighbor,
+                max_num_vertices, rng):
+    """BFS neighbour sampling from seeds (reference: dgl_graph.cc
+    SampleSubgraph :600-714). Returns (vertex ids padded to
+    max+1 with the true count in the last slot, sub-adjacency with the
+    original edge values, per-vertex layer, per-vertex probability)."""
+    n = graph.shape[0]
+    seeds = [int(s) for s in seeds if 0 <= int(s) < n]
+    layer_of, frontier = {}, []
+    for s in seeds:
+        if s not in layer_of and len(layer_of) < max_num_vertices:
+            layer_of[s] = 0
+            frontier.append(s)
+    edges = {}   # (src, dst) -> value
+    for hop in range(1, int(num_hops) + 1):
+        nxt = []
+        for u in frontier:
+            nbrs = onp.nonzero(graph[u])[0]
+            if len(nbrs) == 0:
+                continue
+            k = min(int(num_neighbor), len(nbrs))
+            if prob is not None:
+                p = prob[nbrs].astype(onp.float64)
+                if p.sum() > 0:
+                    # zero-weight edges are unsampleable: cap k at the
+                    # count of positive-probability neighbours
+                    k = min(k, int((p > 0).sum()))
+                    p = p / p.sum()
+                else:
+                    p = None
+                if k == 0:
+                    continue
+                picked = rng.choice(nbrs, size=k, replace=False, p=p)
+            else:
+                picked = rng.choice(nbrs, size=k, replace=False)
+            for vtx in picked:
+                vtx = int(vtx)
+                edges[(u, vtx)] = graph[u, vtx]
+                if vtx not in layer_of and len(layer_of) < max_num_vertices:
+                    layer_of[vtx] = hop
+                    nxt.append(vtx)
+        frontier = nxt
+    verts = sorted(layer_of)
+    cnt = len(verts)
+    ids = onp.full(max_num_vertices + 1, -1, dtype=onp.int64)
+    ids[:cnt] = verts
+    ids[-1] = cnt
+    sub = onp.zeros((max_num_vertices, n), dtype=graph.dtype)
+    pos = {vtx: i for i, vtx in enumerate(verts)}
+    for (u, vtx), val in edges.items():
+        if u in pos and vtx in layer_of:
+            sub[pos[u], vtx] = val
+    layers = onp.full(max_num_vertices, -1, dtype=onp.int64)
+    for vtx, i in pos.items():
+        layers[i] = layer_of[vtx]
+    probs = onp.zeros(max_num_vertices, dtype=onp.float32)
+    if prob is not None:
+        for vtx, i in pos.items():
+            probs[i] = prob[vtx]
+    return ids, sub, layers, probs
+
+
+@register('_contrib_dgl_csr_neighbor_uniform_sample', num_inputs=-1,
+          num_outputs=-1, key_var_num_args='num_args', needs_rng=True,
+          nojit=True)
+def dgl_csr_neighbor_uniform_sample(key, args, *, num_args=None, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100):
+    """Uniform neighbour sampling (reference: dgl_graph.cc:744).
+
+    args = [csr_graph, seeds0, seeds1, ...]; outputs grouped as
+    [ids...] + [sub_csr...] + [layer...] (reference output indexing
+    dgl_graph.cc:730-741).
+    """
+    graph = _np(args[0])
+    rng = onp.random.default_rng(int(_np(key).ravel()[-1]))
+    ids, subs, layers = [], [], []
+    for s in args[1:]:
+        i, g, l, _ = _sample_one(graph, _np(s).ravel(), None,
+                                 num_hops, num_neighbor,
+                                 int(max_num_vertices), rng)
+        ids.append(jnp.asarray(i))
+        subs.append(jnp.asarray(g))
+        layers.append(jnp.asarray(l))
+    return tuple(ids + subs + layers)
+
+
+@register('_contrib_dgl_csr_neighbor_non_uniform_sample', num_inputs=-1,
+          num_outputs=-1, key_var_num_args='num_args', needs_rng=True,
+          nojit=True)
+def dgl_csr_neighbor_non_uniform_sample(key, args, *, num_args=None,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100):
+    """Probability-weighted neighbour sampling (reference:
+    dgl_graph.cc:838). args = [csr_graph, probability, seeds...];
+    outputs [ids...] + [sub_csr...] + [prob...] + [layer...]."""
+    graph = _np(args[0])
+    prob = _np(args[1]).astype(onp.float64).ravel()
+    rng = onp.random.default_rng(int(_np(key).ravel()[-1]))
+    ids, subs, probs, layers = [], [], [], []
+    for s in args[2:]:
+        i, g, l, p = _sample_one(graph, _np(s).ravel(), prob,
+                                 num_hops, num_neighbor,
+                                 int(max_num_vertices), rng)
+        ids.append(jnp.asarray(i))
+        subs.append(jnp.asarray(g))
+        probs.append(jnp.asarray(p))
+        layers.append(jnp.asarray(l))
+    return tuple(ids + subs + probs + layers)
+
+
+@register('_contrib_dgl_graph_compact', num_inputs=-1, num_outputs=-1,
+          key_var_num_args='num_args', nojit=True)
+def dgl_graph_compact(args, *, num_args=None, return_mapping=False,
+                      graph_sizes=None):
+    """Compact sampled subgraphs: drop trailing empty rows and remap
+    columns onto the sampled vertex set (reference: dgl_graph.cc:1551).
+
+    args = [graph0..graphN-1, vids0..vidsN-1]; graph_sizes[i] is the
+    true vertex count of subgraph i (vids[i][-1] as produced by the
+    samplers)."""
+    num_g = len(args) // 2
+    sizes = graph_sizes
+    if sizes is None:
+        sizes = []
+    elif isinstance(sizes, (int, float)):
+        sizes = [int(sizes)] * num_g
+    else:
+        sizes = [int(x) for x in
+                 str(sizes).strip('()[] ').split(',')] \
+            if isinstance(sizes, str) else [int(x) for x in sizes]
+    news, origs = [], []
+    for i in range(num_g):
+        g = _np(args[i])
+        vids = _np(args[num_g + i]).astype(onp.int64).ravel()
+        s = sizes[i] if i < len(sizes) else int(vids[-1])
+        keep = vids[:s]
+        orig = g[:s][:, keep]
+        news.append(jnp.asarray(_renumber(orig)))
+        origs.append(jnp.asarray(orig))
+    out = news + (origs if return_mapping else [])
+    return tuple(out) if len(out) > 1 else out[0]

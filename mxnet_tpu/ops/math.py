@@ -271,6 +271,13 @@ alias('sum', 'sum_axis')
 alias('max', 'max_axis')
 alias('min', 'min_axis')
 
+# sum-of-squares reduce, the fused square+sum the reference added for
+# row_sparse gradients (reference: tensor/square_sum.cc:49 _square_sum);
+# here it is one fused XLA reduction for any storage
+register('_square_sum', aliases=('square_sum',))(
+    _reduce(lambda d, axis=None, keepdims=False:
+            jnp.sum(jnp.square(d), axis=axis, keepdims=keepdims)))
+
 
 @register('norm')
 def norm(data, *, ord=2, axis=None, keepdims=False, out_dtype=None):

@@ -463,7 +463,7 @@ def dropout(key, data, *, p=0.5, mode='training', axes=None,
     return data * mask / keep
 
 
-@register('Embedding', num_inputs=2)
+@register('Embedding', num_inputs=2, aliases=('_contrib_SparseEmbedding',))
 def embedding(data, weight, *, input_dim=None, output_dim=None,
               dtype='float32', sparse_grad=False):
     """Embedding lookup (reference: indexing_op.cc Embedding).

@@ -12,6 +12,8 @@ only boundary op the graph rewriter inserts.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -26,6 +28,17 @@ def _scale_of(min_range, max_range):
                                            jnp.abs(max_range)), 1e-12)
 
 
+def _dequant(q, lo, hi):
+    """Codes -> floats, honouring the code dtype: uint8 codes are affine
+    over [lo, hi] (quantize.cc:58-62), signed codes are symmetric
+    zero-centred (quantize.cc:64-70)."""
+    lo = lo.astype(jnp.float32).reshape(())
+    hi = hi.astype(jnp.float32).reshape(())
+    if q.dtype == jnp.uint8:
+        return q.astype(jnp.float32) * ((hi - lo) / 255.0) + lo
+    return q.astype(jnp.float32) / _scale_of(lo, hi)
+
+
 @register('_contrib_quantize_v2', num_outputs=3)
 def quantize_v2(data, *, min_calib_range=None, max_calib_range=None,
                 out_type='int8'):
@@ -38,11 +51,112 @@ def quantize_v2(data, *, min_calib_range=None, max_calib_range=None,
     return q, jnp.float32(lo), jnp.float32(hi)
 
 
+@register('_contrib_quantize', num_inputs=3, num_outputs=3)
+def quantize(data, min_range, max_range, *, out_type='uint8'):
+    """f32 -> int8/uint8 with the range supplied as *inputs*
+    (reference: quantization/quantize.cc:51-77; the v1 op quantize_v2
+    superseded, kept for parity).
+
+    uint8: affine over [min,max]; int8: symmetric zero-centred
+    (reference equations quantize.cc:58-70)."""
+    lo = min_range.astype(jnp.float32).reshape(())
+    hi = max_range.astype(jnp.float32).reshape(())
+    if out_type == 'uint8':
+        scale = 255.0 / jnp.maximum(hi - lo, 1e-12)
+        q = jnp.clip(jnp.round((data - lo) * scale), 0, 255)
+        return q.astype(jnp.uint8), lo, hi
+    scale = _scale_of(lo, hi)
+    q = jnp.clip(jnp.round(data * scale), -127, 127)
+    return q.astype(jnp.int8), lo, hi
+
+
+@register('_contrib_quantized_act', num_inputs=3, num_outputs=3)
+def quantized_act(data, min_range, max_range, *, act_type='relu'):
+    """Activation on quantized values (reference:
+    quantization/quantized_activation.cc:84). For signed codes relu
+    commutes with the positive scale and applies directly; for uint8
+    codes the clamp happens at the zero-point code."""
+    if act_type != 'relu':
+        raise ValueError('quantized_act supports relu only (reference '
+                         'restriction, quantized_activation.cc)')
+    lo = min_range.astype(jnp.float32).reshape(())
+    hi = max_range.astype(jnp.float32).reshape(())
+    zero = jnp.zeros((), jnp.float32)
+    if data.dtype == jnp.uint8:
+        zp = jnp.round((zero - lo) * (255.0 / jnp.maximum(hi - lo, 1e-12)))
+        q = jnp.maximum(data, zp.astype(data.dtype))
+    else:
+        q = jnp.maximum(data, 0)
+    return q, jnp.maximum(lo, zero), hi
+
+
+@register('_contrib_quantized_flatten', num_inputs=3, num_outputs=3)
+def quantized_flatten(data, min_range, max_range):
+    """Flatten that forwards the quantization range (reference:
+    quantization/quantized_flatten.cc:31)."""
+    return (data.reshape(data.shape[0], -1), min_range.reshape(()),
+            max_range.reshape(()))
+
+
+@register('_contrib_quantized_pooling', num_inputs=3, num_outputs=3)
+def quantized_pooling(data, min_range, max_range, *, kernel=None,
+                      pool_type='max', global_pool=False, stride=None,
+                      pad=None, pooling_convention='valid',
+                      count_include_pad=True, **ignored):
+    """Pooling on int8 codes (reference: quantized_pooling.cc:146).
+    max-pool is exact on codes; avg-pool rounds the int mean back to
+    int8 — ranges pass through unchanged either way."""
+    from .registry import get as _get
+    f = data.astype(jnp.float32)
+    out = _get('Pooling').fn(
+        f, kernel=kernel, pool_type=pool_type, global_pool=global_pool,
+        stride=stride, pad=pad, pooling_convention=pooling_convention,
+        count_include_pad=count_include_pad)
+    code_lo, code_hi = (0, 255) if data.dtype == jnp.uint8 else (-127, 127)
+    q = jnp.clip(jnp.round(out), code_lo, code_hi).astype(data.dtype)
+    return q, min_range.reshape(()), max_range.reshape(())
+
+
+@register('_contrib_quantized_elemwise_add', num_inputs=6, num_outputs=3)
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 + int8 -> int32 at the combined range (reference:
+    quantization/quantized_elemwise_add.cc:93)."""
+    total = _dequant(lhs, lhs_min, lhs_max) + _dequant(rhs, rhs_min, rhs_max)
+    hi = (jnp.maximum(jnp.abs(lhs_min.reshape(())),
+                      jnp.abs(lhs_max.reshape(()))) +
+          jnp.maximum(jnp.abs(rhs_min.reshape(())),
+                      jnp.abs(rhs_max.reshape(()))))
+    q = jnp.round(total * (127.0 / jnp.maximum(hi, 1e-12)))
+    return q.astype(jnp.int32), -hi, hi
+
+
+@register('_contrib_quantized_concat', num_inputs=-1, num_outputs=3,
+          key_var_num_args='num_args')
+def quantized_concat(args, *, num_args=None, dim=1):
+    """Concat quantized inputs after requantizing every one onto the
+    widest range, emitting symmetric int8 (reference:
+    quantized_concat.cc:109; input layout data*n then per-input
+    (min, max) pairs, quantized_concat.cc:115)."""
+    n = (len(args)) // 3
+    datas = args[:n]
+    mins = [args[n + 2 * i].reshape(()) for i in range(n)]
+    maxs = [args[n + 2 * i + 1].reshape(()) for i in range(n)]
+    abs_all = [jnp.maximum(jnp.abs(lo.astype(jnp.float32)),
+                           jnp.abs(hi.astype(jnp.float32)))
+               for lo, hi in zip(mins, maxs)]
+    hi = functools.reduce(jnp.maximum, abs_all)
+    scale_out = 127.0 / jnp.maximum(hi, 1e-12)
+    parts = [jnp.round(_dequant(d, lo, mx) * scale_out)
+             for d, lo, mx in zip(datas, mins, maxs)]
+    out = jnp.concatenate(parts, axis=int(dim))
+    return jnp.clip(out, -127, 127).astype(jnp.int8), -hi, hi
+
+
 @register('_contrib_dequantize', num_inputs=3)
 def dequantize(data, min_range, max_range, *, out_type='float32'):
-    """int8 -> f32 (reference: quantization/dequantize-inl.h)."""
-    scale = _scale_of(min_range, max_range)
-    return data.astype(jnp.float32) / scale
+    """Quantized codes -> f32, affine for uint8 and symmetric for int8
+    (reference: quantization/dequantize-inl.h)."""
+    return _dequant(data, min_range, max_range)
 
 
 @register('_contrib_requantize', num_inputs=3, num_outputs=3)

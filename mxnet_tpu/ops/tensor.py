@@ -476,6 +476,27 @@ def shuffle(key, data):
     return jax.random.permutation(key, data, axis=0)
 
 
+@register('_sparse_retain', num_inputs=2, aliases=('sparse_retain',))
+def sparse_retain(data, indices):
+    """Keep only the rows listed in ``indices``; other rows become zero
+    (reference: tensor/sparse_retain.cc:33 on row_sparse storage; the
+    dense-storage equivalent is a row gather-scatter, which XLA fuses)."""
+    idx = indices.astype(jnp.int32).ravel()
+    out = jnp.zeros_like(data)
+    return out.at[idx].set(data[idx])
+
+
+@register('_scatter_elemwise_div', num_inputs=2)
+def scatter_elemwise_div(lhs, rhs):
+    """lhs / rhs evaluated only on lhs's stored entries (reference:
+    tensor/elemwise_binary_op_basic.cc _scatter_elemwise_div: a
+    row_sparse lhs divides through without densifying). Dense storage:
+    unstored (zero) entries stay zero — 0/0 never poisons the output —
+    while a stored entry over a zero divisor propagates inf as IEEE
+    division does."""
+    return jnp.where(lhs != 0, lhs / rhs, jnp.zeros_like(lhs))
+
+
 @register('cast_storage')
 def cast_storage(data, *, stype='default'):
     """Storage-type cast (reference: cast_storage.cc). Dense XLA storage

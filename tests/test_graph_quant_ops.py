@@ -1,0 +1,257 @@
+"""Tests for the op long-tail added for full Appendix-A parity:
+DGL graph-sampling family, quantized inference ops, sparse-storage
+helpers, adaptive pooling and bilinear resize.
+
+Reference behaviors: src/operator/contrib/dgl_graph.cc (docstring
+examples), src/operator/quantization/*, tensor/sparse_retain.cc,
+tensor/square_sum.cc, contrib/bilinear_resize.cc,
+contrib/adaptive_avg_pooling.cc.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _invoke(name, inputs, **attrs):
+    from mxnet_tpu.ndarray.ndarray import invoke
+    return invoke(name, [nd.array(x) if isinstance(x, np.ndarray) else x
+                         for x in inputs], attrs)
+
+
+# ---------------------------------------------------------------------------
+# graph ops
+# ---------------------------------------------------------------------------
+
+def _k5():
+    # fully-connected 5-vertex graph, edge ids 1..20 (the dgl_graph.cc
+    # docstring example graph)
+    g = np.zeros((5, 5), dtype=np.int64)
+    k = 1
+    for i in range(5):
+        for j in range(5):
+            if i != j:
+                g[i, j] = k
+                k += 1
+    return g
+
+
+def test_dgl_adjacency():
+    g = _k5()
+    out = _invoke('_contrib_dgl_adjacency', [g])
+    np.testing.assert_array_equal(out.asnumpy(), (g != 0).astype(np.float32))
+
+
+def test_edge_id():
+    x = np.array([[1, 0, 0], [0, 2, 0], [0, 0, 3]], dtype=np.float32)
+    u = np.array([0, 0, 1, 1, 2, 2], dtype=np.int64)
+    v = np.array([0, 1, 1, 2, 0, 2], dtype=np.int64)
+    out = _invoke('_contrib_edge_id', [x, u, v])
+    np.testing.assert_array_equal(out.asnumpy(), [1, -1, 2, -1, -1, 3])
+
+
+def test_getnnz():
+    x = np.array([[1, 0, 2], [0, 0, 3]], dtype=np.float32)
+    assert int(_invoke('_contrib_getnnz', [x]).asnumpy()) == 3
+    np.testing.assert_array_equal(
+        _invoke('_contrib_getnnz', [x], axis=0).asnumpy(), [1, 0, 2])
+    np.testing.assert_array_equal(
+        _invoke('_contrib_getnnz', [x], axis=1).asnumpy(), [2, 1])
+
+
+def test_dgl_subgraph():
+    # the dgl_graph.cc:1115 docstring example
+    x = np.array([[1, 0, 0, 2], [3, 0, 4, 0], [0, 5, 0, 0], [0, 6, 7, 0]],
+                 dtype=np.int64)
+    v = np.array([0, 1, 2], dtype=np.int64)
+    outs = _invoke('_contrib_dgl_subgraph', [x, v],
+                   num_args=2, return_mapping=True)
+    new, orig = outs[0].asnumpy(), outs[1].asnumpy()
+    np.testing.assert_array_equal(orig, [[1, 0, 0], [3, 0, 4], [0, 5, 0]])
+    np.testing.assert_array_equal(new, [[1, 0, 0], [2, 0, 3], [0, 4, 0]])
+
+
+def test_dgl_uniform_sample_and_compact():
+    g = _k5()
+    seed = np.array([0, 1, 2, 3, 4], dtype=np.int64)
+    outs = _invoke('_contrib_dgl_csr_neighbor_uniform_sample', [g, seed],
+                   num_args=2, num_hops=1, num_neighbor=2,
+                   max_num_vertices=5)
+    ids, sub, layer = [o.asnumpy() for o in outs]
+    assert ids.shape == (6,)
+    cnt = int(ids[-1])
+    assert cnt == 5                       # all seeds retained
+    np.testing.assert_array_equal(np.sort(ids[:cnt]), np.arange(5))
+    assert sub.shape == (5, 5)
+    # every sampled vertex kept at most num_neighbor edges, each a real edge
+    for i in range(cnt):
+        nz = np.nonzero(sub[i])[0]
+        assert 1 <= len(nz) <= 2
+        for j in nz:
+            assert sub[i, j] == g[ids[i], j]
+    np.testing.assert_array_equal(layer[:cnt], np.zeros(cnt))
+
+    comp = _invoke('_contrib_dgl_graph_compact', [outs[1], outs[0]],
+                   num_args=2, return_mapping=False, graph_sizes=(cnt,))
+    c = comp.asnumpy()
+    assert c.shape == (5, 5)
+    # compacted edges renumbered 1..nnz in row-major order
+    vals = c[np.nonzero(c)]
+    np.testing.assert_array_equal(vals, np.arange(1, len(vals) + 1))
+
+
+def test_dgl_non_uniform_sample():
+    g = _k5()
+    prob = np.array([0.1, 0.2, 0.3, 0.2, 0.2], dtype=np.float32)
+    seed = np.array([0, 1], dtype=np.int64)
+    outs = _invoke('_contrib_dgl_csr_neighbor_non_uniform_sample',
+                   [g, prob, seed], num_args=3, num_hops=1,
+                   num_neighbor=2, max_num_vertices=5)
+    ids, sub, p, layer = [o.asnumpy() for o in outs]
+    cnt = int(ids[-1])
+    assert cnt >= 2
+    # probabilities echo the input probability per sampled vertex
+    for i in range(cnt):
+        assert p[i] == pytest.approx(prob[ids[i]])
+
+
+# ---------------------------------------------------------------------------
+# quantized ops
+# ---------------------------------------------------------------------------
+
+def test_quantize_v1_uint8_int8():
+    data = np.array([-1.0, 0.0, 0.5, 1.0], dtype=np.float32)
+    lo, hi = np.float32(-1.0), np.float32(1.0)
+    q, omin, omax = _invoke('_contrib_quantize', [data, lo, hi],
+                            out_type='uint8')
+    assert q.dtype == np.uint8
+    np.testing.assert_array_equal(q.asnumpy(), [0, 128, 191, 255])
+    q8, _, _ = _invoke('_contrib_quantize', [data, lo, hi], out_type='int8')
+    assert q8.dtype == np.int8
+    np.testing.assert_array_equal(q8.asnumpy(), [-127, 0, 64, 127])
+    # uint8 round-trips through the dtype-aware dequantize
+    back = _invoke('_contrib_dequantize', [q, lo, hi]).asnumpy()
+    np.testing.assert_allclose(back, data.ravel(), atol=1.01 / 255)
+
+
+def test_quantized_act_uint8_zero_point():
+    # [-1, 1] affine range: zero-point code is 128 (rounded 127.5)
+    q = np.array([0, 100, 128, 200, 255], dtype=np.uint8)
+    lo, hi = np.float32(-1.0), np.float32(1.0)
+    a, amin, amax = _invoke('_contrib_quantized_act', [q, lo, hi],
+                            act_type='relu')
+    assert a.dtype == np.uint8
+    np.testing.assert_array_equal(a.asnumpy(), [128, 128, 128, 200, 255])
+    assert float(amin.asnumpy()) == 0.0
+
+
+def test_quantized_act_flatten_pooling():
+    q = np.array([[-5, 3], [7, -1]], dtype=np.int8).reshape(1, 1, 2, 2)
+    lo, hi = np.float32(-1.0), np.float32(1.0)
+    a, amin, amax = _invoke('_contrib_quantized_act', [q, lo, hi],
+                            act_type='relu')
+    np.testing.assert_array_equal(a.asnumpy().ravel(), [0, 3, 7, 0])
+    assert float(amin.asnumpy()) == 0.0
+
+    f, _, _ = _invoke('_contrib_quantized_flatten', [q, lo, hi])
+    assert f.shape == (1, 4)
+
+    p, pmin, pmax = _invoke('_contrib_quantized_pooling', [q, lo, hi],
+                            kernel=(2, 2), pool_type='max')
+    assert int(p.asnumpy().ravel()[0]) == 7
+    assert p.dtype == np.int8
+
+
+def test_quantized_elemwise_add_matches_float():
+    rng = np.random.RandomState(0)
+    a = rng.randint(-127, 128, (3, 4)).astype(np.int8)
+    b = rng.randint(-127, 128, (3, 4)).astype(np.int8)
+    amin, amax = np.float32(-2.0), np.float32(2.0)
+    bmin, bmax = np.float32(-1.0), np.float32(1.0)
+    out, omin, omax = _invoke('_contrib_quantized_elemwise_add',
+                              [a, b, amin, amax, bmin, bmax])
+    f = a.astype(np.float32) * 2 / 127 + b.astype(np.float32) / 127
+    back = out.asnumpy().astype(np.float32) * float(omax.asnumpy()) / 127
+    np.testing.assert_allclose(back, f, atol=3 / 127 * 3)
+
+
+def test_quantized_concat_rescales():
+    a = np.full((1, 2), 127, dtype=np.int8)   # represents 1.0 at range 1
+    b = np.full((1, 2), 127, dtype=np.int8)   # represents 2.0 at range 2
+    args = [a, b, np.float32(-1), np.float32(1),
+            np.float32(-2), np.float32(2)]
+    out, omin, omax = _invoke('_contrib_quantized_concat', args,
+                              num_args=2, dim=1)
+    assert float(omax.asnumpy()) == 2.0
+    vals = out.asnumpy().ravel()
+    # 1.0 at range 2 -> code 64 (rounded); 2.0 -> code 127
+    np.testing.assert_array_equal(vals, [64, 64, 127, 127])
+
+
+# ---------------------------------------------------------------------------
+# sparse helpers / resize / adaptive pool
+# ---------------------------------------------------------------------------
+
+def test_sparse_retain():
+    d = np.arange(12, dtype=np.float32).reshape(4, 3) + 1
+    idx = np.array([0, 2], dtype=np.int64)
+    out = _invoke('_sparse_retain', [d, idx])
+    exp = np.zeros_like(d)
+    exp[[0, 2]] = d[[0, 2]]
+    np.testing.assert_array_equal(out.asnumpy(), exp)
+
+
+def test_square_sum():
+    d = np.array([[0, 0], [1, 2], [0, 0], [3, 4], [0, 0]], dtype=np.float32)
+    out = _invoke('_square_sum', [d], axis=1)
+    np.testing.assert_array_equal(out.asnumpy(), [0, 5, 0, 25, 0])
+
+
+def test_scatter_elemwise_div():
+    lhs = np.array([[2.0, 0.0], [4.0, 6.0]], dtype=np.float32)
+    rhs = np.array([[2.0, 0.0], [0.0, 3.0]], dtype=np.float32)
+    out = _invoke('_scatter_elemwise_div', [lhs, rhs]).asnumpy()
+    # stored (non-zero) lhs entries divide — including inf for /0 —
+    # while unstored entries stay zero even against a zero rhs
+    assert out[0, 0] == 1.0 and out[0, 1] == 0.0 and out[1, 1] == 2.0
+    assert np.isinf(out[1, 0])
+
+
+def test_bilinear_resize2d():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = _invoke('_contrib_BilinearResize2D', [x], height=7, width=7)
+    o = out.asnumpy()[0, 0]
+    assert o.shape == (7, 7)
+    # align-corners: corners are preserved exactly
+    assert o[0, 0] == 0.0 and o[-1, -1] == 15.0
+    assert o[0, -1] == 3.0 and o[-1, 0] == 12.0
+    # interior is monotone along rows
+    assert np.all(np.diff(o, axis=1) > 0)
+
+    half = _invoke('_contrib_BilinearResize2D', [x],
+                   scale_height=0.5, scale_width=0.5)
+    assert half.shape == (1, 1, 2, 2)
+
+
+def test_adaptive_avg_pooling2d():
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    out = _invoke('_contrib_AdaptiveAvgPooling2D', [x], output_size=(2, 2))
+    o = out.asnumpy()[0, 0]
+    exp = x[0, 0].reshape(2, 3, 2, 3).mean(axis=(1, 3))
+    np.testing.assert_allclose(o, exp, rtol=1e-6)
+    # uneven windows: 5 -> 2 covers [0,3) and [2,5)... per floor/ceil rule
+    x5 = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    o2 = _invoke('_contrib_AdaptiveAvgPooling2D', [x5],
+                 output_size=(2, 2)).asnumpy()[0, 0]
+    r0 = x5[0, 0][0:3, 0:3].mean()
+    assert o2[0, 0] == pytest.approx(r0)
+    # global (default) pool
+    g = _invoke('_contrib_AdaptiveAvgPooling2D', [x], output_size=(1,))
+    assert g.asnumpy()[0, 0, 0, 0] == pytest.approx(x.mean())
+
+
+def test_sparse_embedding_alias():
+    from mxnet_tpu.ops import registry
+    assert registry.get('_contrib_SparseEmbedding') is registry.get(
+        'Embedding')

@@ -42,7 +42,7 @@ def main():
     seqlen = 128 if on_accel else 16
     npred = 20 if on_accel else 2
     vocab = 30522 if on_accel else 100
-    warmup, iters = 3, 10 if on_accel else 2
+    warmup, iters = 3, 30 if on_accel else 2
 
     if on_accel:
         net = bert_zoo.bert_12_768_12(vocab_size=vocab, max_length=512,

@@ -1,0 +1,119 @@
+"""Actor-critic reinforcement learning — the RL capability workload
+(reference: example/gluon/actor_critic.py; reinforcement-learning/).
+A self-contained CartPole-style balance environment (pure numpy, no
+gym) trained with one-step advantage actor-critic: policy head sampled
+via the framework's sample_multinomial op, losses composed under one
+autograd.record scope.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+class Balance:
+    """Minimal cart-pole: state (x, x', th, th'), actions {left, right};
+    episode ends when |th| > 12deg or |x| > 2.4 or after 200 steps."""
+
+    def __init__(self, seed=0):
+        self.rs = np.random.RandomState(seed)
+
+    def reset(self):
+        self.s = self.rs.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self.t = 0
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(th), np.sin(th)
+        tmp = (force + 0.05 * thd ** 2 * sinth) / 1.1
+        thacc = (9.8 * sinth - costh * tmp) / \
+            (0.5 * (4.0 / 3.0 - 0.1 * costh ** 2 / 1.1))
+        xacc = tmp - 0.05 * thacc * costh / 1.1
+        dt = 0.02
+        self.s = np.array([x + dt * xd, xd + dt * xacc,
+                           th + dt * thd, thd + dt * thacc],
+                          dtype=np.float32)
+        self.t += 1
+        done = bool(abs(self.s[2]) > 0.2095 or abs(self.s[0]) > 2.4
+                    or self.t >= 200)
+        return self.s.copy(), 1.0, done
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--episodes', type=int, default=40)
+    p.add_argument('--gamma', type=float, default=0.99)
+    p.add_argument('--lr', type=float, default=0.02)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    class Net(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.dense = nn.Dense(32, activation='relu')
+                self.policy = nn.Dense(2)
+                self.value = nn.Dense(1)
+
+        def hybrid_forward(self, F, x):
+            h = self.dense(x)
+            return self.policy(h), self.value(h)
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    mx.random.seed(0)
+    env = Balance()
+    lengths = []
+    for ep in range(args.episodes):
+        s = env.reset()
+        states, actions, rewards = [], [], []
+        done = False
+        while not done:
+            logits, _ = net(nd.array(s.reshape(1, -1)))
+            # policy sampled through the framework's seeded RNG
+            a = int(nd.sample_multinomial(
+                nd.softmax(logits)).asnumpy().ravel()[0])
+            s2, r, done = env.step(a)
+            states.append(s)
+            actions.append(a)
+            rewards.append(r)
+            s = s2
+        # discounted returns, normalized
+        R, returns = 0.0, []
+        for r in reversed(rewards):
+            R = r + args.gamma * R
+            returns.append(R)
+        returns = np.array(returns[::-1], dtype=np.float32)
+        returns = (returns - returns.mean()) / (returns.std() + 1e-6)
+        xs = nd.array(np.stack(states))
+        acts = nd.array(np.array(actions, dtype=np.float32))
+        rets = nd.array(returns)
+        with autograd.record():
+            logits, values = net(xs)
+            logp = nd.log_softmax(logits)
+            chosen = nd.pick(logp, acts, axis=1)
+            adv = rets - values.reshape((-1,)).detach()
+            policy_loss = -(chosen * adv).sum()
+            value_loss = nd.square(values.reshape((-1,)) - rets).sum()
+            loss = policy_loss + 0.5 * value_loss
+        loss.backward()
+        trainer.step(len(rewards))
+        lengths.append(len(rewards))
+        if ep % 10 == 0:
+            print('episode %d length %d' % (ep, lengths[-1]))
+    early = np.mean(lengths[:10])
+    late = np.mean(lengths[-10:])
+    print('mean episode length: first10 %.1f last10 %.1f' % (early, late))
+    return early, late
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,97 @@
+"""DCGAN — adversarial training with two networks and two trainers
+(reference: example/gluon/dc_gan/dcgan.py). Conv2DTranspose generator,
+Conv2D discriminator, alternating D/G updates with SigmoidBCE loss.
+Synthetic 16x16 "blob" images replace MNIST in zero-egress environments.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_nets(nz=16, ngf=16, ndf=16):
+    from mxnet_tpu.gluon import nn
+    netG = nn.HybridSequential()
+    with netG.name_scope():
+        # nz x 1 x 1 -> 16 x 16
+        netG.add(nn.Conv2DTranspose(ngf * 2, 4, 1, 0, use_bias=False),
+                 nn.BatchNorm(), nn.Activation('relu'),
+                 nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False),
+                 nn.BatchNorm(), nn.Activation('relu'),
+                 nn.Conv2DTranspose(1, 4, 2, 1, use_bias=False),
+                 nn.Activation('tanh'))
+    netD = nn.HybridSequential()
+    with netD.name_scope():
+        netD.add(nn.Conv2D(ndf, 4, 2, 1, use_bias=False),
+                 nn.LeakyReLU(0.2),
+                 nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False),
+                 nn.BatchNorm(), nn.LeakyReLU(0.2),
+                 nn.Conv2D(1, 4, 1, 0, use_bias=False))
+    return netG, netD
+
+
+def real_batch(rs, batch):
+    """Bright gaussian blobs on dark background, values in [-1, 1]."""
+    xs = np.full((batch, 1, 16, 16), -0.9, dtype=np.float32)
+    for i in range(batch):
+        cy, cx = rs.randint(4, 12, size=2)
+        yy, xx = np.mgrid[0:16, 0:16]
+        blob = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 8.0)
+        xs[i, 0] = blob * 1.8 - 0.9
+    return xs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--batch-size', type=int, default=16)
+    p.add_argument('--iters', type=int, default=30)
+    p.add_argument('--nz', type=int, default=16)
+    p.add_argument('--lr', type=float, default=2e-4)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    netG, netD = build_nets(args.nz)
+    netG.initialize(mx.init.Normal(0.02))
+    netD.initialize(mx.init.Normal(0.02))
+    trainerG = gluon.Trainer(netG.collect_params(), 'adam',
+                             {'learning_rate': args.lr, 'beta1': 0.5})
+    trainerD = gluon.Trainer(netD.collect_params(), 'adam',
+                             {'learning_rate': args.lr, 'beta1': 0.5})
+    L = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    rs = np.random.RandomState(0)
+    real_label = nd.ones((args.batch_size,))
+    fake_label = nd.zeros((args.batch_size,))
+    errD = errG = None
+    for it in range(args.iters):
+        data = nd.array(real_batch(rs, args.batch_size))
+        noise = nd.array(rs.randn(args.batch_size, args.nz, 1, 1)
+                         .astype(np.float32))
+        # D step: maximize log D(x) + log(1 - D(G(z)))
+        with autograd.record():
+            out_real = netD(data).reshape((-1,))
+            fake = netG(noise)
+            out_fake = netD(fake.detach()).reshape((-1,))
+            errD = L(out_real, real_label) + L(out_fake, fake_label)
+        errD.backward()
+        trainerD.step(args.batch_size)
+        # G step: maximize log D(G(z))
+        with autograd.record():
+            out = netD(netG(noise)).reshape((-1,))
+            errG = L(out, real_label)
+        errG.backward()
+        trainerG.step(args.batch_size)
+        if it % 10 == 0:
+            print('iter %d errD %.3f errG %.3f' %
+                  (it, float(errD.mean().asscalar()),
+                   float(errG.mean().asscalar())))
+    d, g = float(errD.mean().asscalar()), float(errG.mean().asscalar())
+    assert np.isfinite(d) and np.isfinite(g)
+    return d, g
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,98 @@
+"""Matrix factorization with model-parallel placement — the recommender
+workload (reference: example/recommenders/ and
+example/model-parallel/matrix_factorization/model.py:23-38, which
+splits the two embedding tables across devices with AttrScope
+ctx_group). TPU-native: the same split expressed as pjit sharding rules
+over a device mesh — the embeddings shard over the 'mp' axis while the
+batch rides 'dp'.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--num-users', type=int, default=200)
+    p.add_argument('--num-items', type=int, default=100)
+    p.add_argument('--factors', type=int, default=16)
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--epochs', type=int, default=8)
+    p.add_argument('--lr', type=float, default=0.05)
+    p.add_argument('--mesh', action='store_true',
+                   help='train the fused step over a dp x mp mesh')
+    args = p.parse_args(argv)
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    # low-rank ground truth ratings
+    u_true = rs.randn(args.num_users, 4).astype(np.float32)
+    i_true = rs.randn(args.num_items, 4).astype(np.float32)
+    n = 4096
+    users = rs.randint(0, args.num_users, n)
+    items = rs.randint(0, args.num_items, n)
+    ratings = (u_true[users] * i_true[items]).sum(1)
+
+    class MF(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.user = nn.Embedding(args.num_users, args.factors)
+                self.item = nn.Embedding(args.num_items, args.factors)
+
+        def hybrid_forward(self, F, u, i):
+            return (self.user(u) * self.item(i)).sum(axis=1)
+
+    net = MF()
+    net.initialize(mx.init.Normal(0.1))
+    L = gluon.loss.L2Loss()
+
+    ndev_all = len(jax.devices())
+    if args.mesh and ndev_all >= 2 and ndev_all % 2 == 0:
+        # model-parallel analog: embedding tables shard over 'mp'
+        from jax.sharding import PartitionSpec as P
+        from mxnet_tpu import parallel
+        ndev = len(jax.devices())
+        mesh = parallel.create_mesh({'dp': ndev // 2, 'tp': 2})
+        # both embedding tables shard their vocab dim over 'tp' — the
+        # ctx_group split of model.py:23-38, as sharding rules
+        rules = parallel.ShardingRules(
+            overrides={'embedding': P('tp', None)})
+        pt = parallel.ParallelTrainer(net, L, 'adam',
+                                      {'learning_rate': args.lr},
+                                      mesh, rules=rules)
+        step = lambda u, i, r: float(pt.step([u, i], [r]).asscalar())
+    else:
+        trainer = gluon.Trainer(net.collect_params(), 'adam',
+                                {'learning_rate': args.lr})
+
+        def step(u, i, r):
+            with autograd.record():
+                loss = L(net(u, i), r)
+            loss.backward()
+            trainer.step(u.shape[0])
+            return float(loss.mean().asscalar())
+
+    mse = None
+    for epoch in range(args.epochs):
+        order = rs.permutation(n)
+        tot = cnt = 0
+        for b in range(0, n, args.batch_size):
+            idx = order[b:b + args.batch_size]
+            tot += step(nd.array(users[idx]), nd.array(items[idx]),
+                        nd.array(ratings[idx]))
+            cnt += 1
+        mse = tot / cnt
+        print('epoch %d loss %.4f' % (epoch, mse))
+    assert mse < 1.0, 'MF should fit the low-rank ratings'
+    return mse
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,81 @@
+"""Sparse linear classification — the row_sparse/CSR workload
+(reference: example/sparse/linear_classification/train.py: CSR data,
+row_sparse weight, lazy sgd updates, dist-ready kvstore pulls of only
+the active rows). Synthetic high-dimensional sparse features.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_sparse(rs, n, dim, nnz_per_row):
+    """CSR features + labels from a sparse ground-truth weight."""
+    import scipy.sparse as sps
+    rows = np.repeat(np.arange(n), nnz_per_row)
+    cols = rs.randint(0, dim, n * nnz_per_row)
+    vals = rs.randn(n * nnz_per_row).astype(np.float32)
+    x = sps.csr_matrix((vals, (rows, cols)), shape=(n, dim))
+    w_true = np.zeros(dim, dtype=np.float32)
+    active = rs.choice(dim, dim // 10, replace=False)
+    w_true[active] = rs.randn(len(active))
+    y = (x @ w_true > 0).astype(np.float32)
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--num-samples', type=int, default=1024)
+    p.add_argument('--dim', type=int, default=1000)
+    p.add_argument('--nnz', type=int, default=20)
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--epochs', type=int, default=5)
+    p.add_argument('--lr', type=float, default=0.5)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    L = gluon.loss.LogisticLoss(label_format='signed')
+    rs = np.random.RandomState(0)
+    x_all, y_all = synthetic_sparse(rs, args.num_samples, args.dim,
+                                    args.nnz)
+
+    # row_sparse weight updated lazily: only rows touched by the batch
+    weight = mx.nd.zeros((args.dim, 1)).tostype('row_sparse')
+    bias = mx.nd.zeros((1,))
+    weight.attach_grad(stype='row_sparse')
+    bias.attach_grad()
+    opt = mx.optimizer.create('sgd', learning_rate=args.lr,
+                              lazy_update=True)
+    upd_w = mx.optimizer.get_updater(opt)
+    opt_b = mx.optimizer.create('sgd', learning_rate=args.lr)
+    upd_b = mx.optimizer.get_updater(opt_b)
+
+    acc = None
+    for epoch in range(args.epochs):
+        order = rs.permutation(args.num_samples)
+        correct = 0
+        for i in range(0, args.num_samples, args.batch_size):
+            idx = order[i:i + args.batch_size]
+            xb = nd.sparse.csr_matrix(x_all[idx])   # CSR batch
+            yb = nd.array(y_all[idx])
+            with autograd.record():
+                # sparse dot: CSR x dense row_sparse-backed weight
+                z = nd.dot(xb, weight).reshape((-1,)) + bias
+                loss = L(z, 2 * yb - 1).mean()
+            loss.backward()
+            upd_w(0, weight.grad, weight)
+            upd_b(1, bias.grad, bias)
+            pred = (z.asnumpy() > 0).astype(np.float32)
+            correct += int((pred == y_all[idx]).sum())
+        acc = correct / args.num_samples
+        print('epoch %d accuracy %.3f' % (epoch, acc))
+    if args.epochs >= 5:
+        assert acc > 0.8, 'sparse linear model should fit synthetic data'
+    return acc
+
+
+if __name__ == '__main__':
+    main()

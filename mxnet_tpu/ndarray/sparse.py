@@ -87,6 +87,9 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         return CSRNDArray(array(m.toarray(), dtype=dtype)._data)
     if isinstance(arg1, NDArray):
         return CSRNDArray(arg1._data)
+    if sps.issparse(arg1):
+        # scipy sparse input (reference csr_matrix accepts it too)
+        return CSRNDArray(array(arg1.toarray(), dtype=dtype)._data)
     return CSRNDArray(array(onp.asarray(arg1), dtype=dtype)._data)
 
 

@@ -1,0 +1,48 @@
+"""Smoke tests over examples/ — the reference treats example/ as its
+capability envelope and smoke-tests it in tests/nightly (SURVEY.md §2.6
+"Beyond the five BASELINE configs"). Each example main() takes argv and
+returns a quality metric; tiny configs keep the suite fast.
+"""
+import sys
+import pathlib
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from examples import word_lm, dc_gan, sparse_linear, actor_critic, \
+    matrix_factorization  # noqa: E402
+
+
+def test_word_lm_learns():
+    ppl = word_lm.main(['--epochs', '2', '--corpus-len', '1500',
+                        '--vocab', '30'])
+    assert np.isfinite(ppl) and ppl < 30
+
+
+def test_dc_gan_trains():
+    d, g = dc_gan.main(['--iters', '8', '--batch-size', '8'])
+    assert np.isfinite(d) and np.isfinite(g)
+
+
+def test_sparse_linear_learns():
+    acc = sparse_linear.main(['--epochs', '5', '--num-samples', '512',
+                              '--dim', '400'])
+    assert acc > 0.75
+
+
+def test_actor_critic_runs():
+    early, late = actor_critic.main(['--episodes', '8'])
+    assert np.isfinite(early) and np.isfinite(late)
+
+
+def test_matrix_factorization_fits():
+    mse = matrix_factorization.main(['--epochs', '6'])
+    assert mse < 1.0
+
+
+def test_matrix_factorization_mesh():
+    # model-parallel embedding sharding over the virtual 8-device mesh
+    mse = matrix_factorization.main(['--epochs', '2', '--mesh'])
+    assert np.isfinite(mse)

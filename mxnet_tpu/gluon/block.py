@@ -684,8 +684,27 @@ class HybridBlock(Block):
             params[name] = v if v is not None else p.data()
         return self.hybrid_forward(nd, *args, **params)
 
+    def _symbol_forward(self, *args):
+        """Compose the symbolic graph for this block (reference:
+        block.py HybridBlock._get_graph: calling a HybridBlock on
+        Symbols yields a Symbol). Parameters enter as Variables carrying
+        their full names, so simple_bind/executor arg_dicts and
+        'arg:%s'-keyed checkpoints line up."""
+        from .. import symbol as sym_mod
+        from ..name import Prefix
+        params = {name: sym_mod.Variable(p.name)
+                  for name, p in self._reg_params.items()}
+        # compose under this block's name scope so layer-internal
+        # name='fwd' nodes come out as '<block-prefix>fwd' (the
+        # reference's naming; keeps get_internals()/output_dict usable)
+        with Prefix(self.prefix):
+            return self.hybrid_forward(sym_mod, *args, **params)
+
     def forward(self, x, *args):
         """Defers to cached op when hybridized, eager otherwise."""
+        from ..symbol.symbol import Symbol as _Sym
+        if isinstance(x, _Sym):
+            return self._symbol_forward(x, *args)
         if in_trace() or getattr(_trace_state, 'probe', False):
             # inside a parent block's jit trace (or its init probe):
             # run the computation inline; the enclosing CachedOp owns jit.
@@ -740,12 +759,37 @@ class HybridBlock(Block):
             prefix = 'aux' if name in aux_names else 'arg'
             params['%s:%s' % (prefix, name)] = param._reduce()
         nd.save('%s-%04d.params' % (path, epoch), params)
+        # real symbol JSON via the symbolic trace (reference export
+        # writes nodes/arg_nodes/heads, block.py:868 → _CachedOp graph);
+        # blocks that cannot compose symbolically (raw-jax hybrid_forward
+        # bodies) fall back to the jaxpr container, which
+        # SymbolBlock.imports also understands
         import json
-        graph = {'format': 'mxnet_tpu-jaxpr-v1',
+        try:
+            from .. import symbol as sym_mod
+            n_in = 1
+            for sig in self._cached_op._jitted:
+                n_in = len(sig[2])
+                break
+            ins = [sym_mod.Variable('data')] if n_in == 1 else \
+                [sym_mod.Variable('data%d' % i) for i in range(n_in)]
+            out = self._symbol_forward(*ins)
+            if isinstance(out, (list, tuple)):
+                out = sym_mod.Group(list(out))
+            graph_json = out.tojson()
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                'symbolic export of %s failed (%s: %s); writing the '
+                'jaxpr-v1 container instead — SymbolBlock.imports still '
+                'loads it, but cross-tool symbol-JSON consumers will '
+                'not' % (self.__class__.__name__, type(e).__name__, e))
+            graph_json = json.dumps(
+                {'format': 'mxnet_tpu-jaxpr-v1',
                  'params': sorted(p.name for p in self._cached_op_params),
-                 'class': self.__class__.__name__}
+                 'class': self.__class__.__name__})
         with open('%s-symbol.json' % path, 'w') as f:
-            json.dump(graph, f)
+            f.write(graph_json)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         """Override to construct symbolic graph for this Block."""
@@ -760,15 +804,48 @@ class SymbolBlock(HybridBlock):
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix=None, params=params)
+        self._exec_cache = {}
+        from .. import symbol as sym_mod
+        if isinstance(outputs, (list, tuple)) and outputs and \
+                all(isinstance(o, sym_mod.Symbol) for o in outputs):
+            outputs = sym_mod.Group(list(outputs)) if len(outputs) > 1 \
+                else outputs[0]
         self._outputs = outputs
         self._inputs = inputs
+        if isinstance(outputs, sym_mod.Symbol):
+            # create a Parameter per free argument/aux that is not an
+            # input (reference: block.py SymbolBlock.__init__ builds its
+            # ParameterDict the same way); shapes come from the loaded
+            # checkpoint
+            in_names = {s if isinstance(s, str) else s.name
+                        for s in (inputs if isinstance(inputs, (list, tuple))
+                                  else [inputs])}
+            aux = set(outputs.list_auxiliary_states())
+            from .parameter import Parameter
+            for name in list(outputs.list_arguments()) + sorted(aux):
+                if name in in_names or name in self._params._params:
+                    continue
+                # parameters keep the graph's own names — no block
+                # prefix — so 'arg:%s'-keyed checkpoints load directly
+                # (reference SymbolBlock does the same)
+                self._params._params[name] = Parameter(
+                    name, allow_deferred_init=True,
+                    grad_req='null' if name in aux else 'write')
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
         import json
         with open(symbol_file) as f:
-            graph = json.load(f)
-        blk = SymbolBlock(graph, input_names)
+            text = f.read()
+        graph = json.loads(text)
+        if 'nodes' in graph:
+            from .. import symbol as sym_mod
+            outputs = sym_mod.load_json(text)
+        else:
+            outputs = graph   # jaxpr-v1 container (legacy export)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        blk = SymbolBlock(outputs, list(input_names))
         if param_file is not None:
             blk.collect_params().load(param_file, ctx=ctx, allow_missing=True,
                                       ignore_extra=True)
@@ -777,12 +854,29 @@ class SymbolBlock(HybridBlock):
     def forward(self, x, *args):
         from .. import symbol as sym_mod
         if isinstance(self._outputs, sym_mod.Symbol):
-            arg_dict = dict(zip(
-                [s.name for s in (self._inputs if isinstance(self._inputs, list)
-                                  else [self._inputs])],
-                [x] + list(args)))
+            ins = self._inputs if isinstance(self._inputs, (list, tuple)) \
+                else [self._inputs]
+            names = [s if isinstance(s, str) else s.name for s in ins]
+            feed = dict(zip(names, [x] + list(args)))
+            # one bound executor per input-shape signature: eval() would
+            # re-bind and re-jit the whole graph per call
+            sig = tuple((n, tuple(a.shape)) for n, a in feed.items())
+            exe = self._exec_cache.get(sig)
+            if exe is None:
+                exe = self._outputs.simple_bind(
+                    grad_req='null',
+                    **{n: tuple(a.shape) for n, a in feed.items()})
+                self._exec_cache[sig] = exe
+            # refresh parameter views every call (aliasing copy: the
+            # trainer may have swapped the underlying arrays)
             for name, p in self.collect_params().items():
-                arg_dict[name] = p.data()
-            return self._outputs.eval(**arg_dict)
+                if name in exe.arg_dict:
+                    exe.arg_dict[name]._data = p.data()._data
+                elif name in exe.aux_dict:
+                    exe.aux_dict[name]._data = p.data()._data
+            out = exe.forward(is_train=autograd.is_training(), **feed)
+            if isinstance(out, (list, tuple)) and len(out) == 1:
+                return out[0]
+            return out
         raise NotImplementedError(
             'SymbolBlock over serialized graphs requires the symbol module')

@@ -215,9 +215,15 @@ class BatchNorm(HybridBlock):
         super().cast(dtype)
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
-        out, mean, var = F.BatchNorm(x, gamma, beta, running_mean, running_var,
-                                     name='fwd', output_mean_var=True,
-                                     **self._kwargs)
+        ret = F.BatchNorm(x, gamma, beta, running_mean, running_var,
+                          name='fwd', output_mean_var=True, **self._kwargs)
+        if isinstance(ret, (tuple, list)):
+            out, mean, var = ret
+        else:
+            # symbolic composition: mean/var are hidden outputs
+            # (reference FNumVisibleOutputs) and the aux update below is
+            # an eager-training concern only
+            return ret
         if autograd.is_training() and not self._use_global_stats:
             m = self._momentum
             with autograd.pause():

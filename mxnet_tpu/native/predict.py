@@ -1,0 +1,168 @@
+"""Build + ctypes binding for the native C predict API (reference ABI:
+include/mxnet/c_predict_api.h; implementation native/src/
+c_predict_api.cc). ``lib()`` compiles on first use with the in-image
+g++, linking against the running interpreter's libpython so the same
+.so serves standalone C hosts and in-process ctypes callers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+
+import numpy as np
+
+__all__ = ['available', 'lib', 'Predictor']
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), 'native', 'src',
+    'c_predict_api.cc')
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          '_build')
+_SO = os.path.join(_BUILD_DIR, 'libmxpred.so')
+_ABI = 1
+
+
+def _compile():
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    inc = sysconfig.get_path('include')
+    libdir = sysconfig.get_config_var('LIBDIR') or ''
+    pyver = 'python%d.%d' % __import__('sys').version_info[:2]
+    tmp = '%s.tmp.%d' % (_SO, os.getpid())
+    cmd = ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', '-pthread',
+           '-I' + inc, _SRC, '-o', tmp]
+    if libdir:
+        cmd += ['-L' + libdir, '-Wl,-rpath,' + libdir]
+    cmd += ['-l' + pyver]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+    os.replace(tmp, _SO)
+
+
+def _bind(path):
+    so = ctypes.CDLL(path)
+    so.mxpred_abi_version.restype = ctypes.c_int
+    if so.mxpred_abi_version() != _ABI:
+        raise OSError('stale libmxpred ABI')
+    u = ctypes.c_uint
+    so.MXPredCreate.restype = ctypes.c_int
+    so.MXPredCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, u, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(u), ctypes.POINTER(u),
+        ctypes.POINTER(ctypes.c_void_p)]
+    so.MXPredSetInput.restype = ctypes.c_int
+    so.MXPredSetInput.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_float), u]
+    so.MXPredForward.restype = ctypes.c_int
+    so.MXPredForward.argtypes = [ctypes.c_void_p]
+    so.MXPredGetOutputShape.restype = ctypes.c_int
+    so.MXPredGetOutputShape.argtypes = [
+        ctypes.c_void_p, u, ctypes.POINTER(ctypes.POINTER(u)),
+        ctypes.POINTER(u)]
+    so.MXPredGetOutput.restype = ctypes.c_int
+    so.MXPredGetOutput.argtypes = [ctypes.c_void_p, u,
+                                   ctypes.POINTER(ctypes.c_float), u]
+    so.MXPredFree.restype = ctypes.c_int
+    so.MXPredFree.argtypes = [ctypes.c_void_p]
+    so.MXGetLastError.restype = ctypes.c_char_p
+    return so
+
+
+def lib():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _compile()
+            _lib = _bind(_SO)
+        except Exception:
+            _lib = None
+    return _lib
+
+
+def available():
+    return lib() is not None
+
+
+class Predictor:
+    """Python convenience wrapper over the C ABI — used by the tests to
+    exercise the exact code path a C host application would."""
+
+    def __init__(self, symbol_json, param_bytes, input_shapes):
+        so = lib()
+        if so is None:
+            raise RuntimeError('native predict library unavailable')
+        self._so = so
+        names = list(input_shapes)
+        keys = (ctypes.c_char_p * len(names))(
+            *[n.encode() for n in names])
+        indptr = [0]
+        flat = []
+        for n in names:
+            flat.extend(int(d) for d in input_shapes[n])
+            indptr.append(len(flat))
+        c_indptr = (ctypes.c_uint * len(indptr))(*indptr)
+        c_flat = (ctypes.c_uint * max(len(flat), 1))(*(flat or [0]))
+        handle = ctypes.c_void_p()
+        rc = so.MXPredCreate(
+            symbol_json.encode(), param_bytes, len(param_bytes), 1, 0,
+            len(names), keys, c_indptr, c_flat, ctypes.byref(handle))
+        if rc != 0:
+            raise RuntimeError('MXPredCreate: %s' %
+                               so.MXGetLastError().decode())
+        self._h = handle
+
+    def set_input(self, key, array):
+        arr = np.ascontiguousarray(array, dtype=np.float32)
+        rc = self._so.MXPredSetInput(
+            self._h, key.encode(),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), arr.size)
+        if rc != 0:
+            raise RuntimeError('MXPredSetInput: %s' %
+                               self._so.MXGetLastError().decode())
+
+    def forward(self):
+        if self._so.MXPredForward(self._h) != 0:
+            raise RuntimeError('MXPredForward: %s' %
+                               self._so.MXGetLastError().decode())
+
+    def get_output(self, index=0):
+        shp_ptr = ctypes.POINTER(ctypes.c_uint)()
+        ndim = ctypes.c_uint()
+        rc = self._so.MXPredGetOutputShape(
+            self._h, index, ctypes.byref(shp_ptr), ctypes.byref(ndim))
+        if rc != 0:
+            raise RuntimeError('MXPredGetOutputShape: %s' %
+                               self._so.MXGetLastError().decode())
+        shape = tuple(shp_ptr[i] for i in range(ndim.value))
+        out = np.empty(shape, dtype=np.float32)
+        rc = self._so.MXPredGetOutput(
+            self._h, index,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size)
+        if rc != 0:
+            raise RuntimeError('MXPredGetOutput: %s' %
+                               self._so.MXGetLastError().decode())
+        return out
+
+    def close(self):
+        if getattr(self, '_h', None):
+            self._so.MXPredFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,86 @@
+"""Python half of the C predict API (reference:
+include/mxnet/c_predict_api.h, src/c_api/c_predict_api.cc).
+
+The reference's predict ABI wraps a C++ executor; the TPU-native
+runtime *is* Python/XLA, so the native library
+(native/src/c_predict_api.cc) embeds CPython and drives this module —
+same C surface for host applications, inverted implementation
+direction. Handles are plain python objects owned by the C side via
+refcount.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+
+class _Predictor:
+    __slots__ = ('executor', 'input_names', 'outputs')
+
+    def __init__(self, executor, input_names):
+        self.executor = executor
+        self.input_names = input_names
+        self.outputs = None
+
+
+def create(symbol_json, param_bytes, input_names, input_shapes):
+    """Build a bound executor from a symbol-JSON string and a .params
+    blob (reference: c_predict_api.cc MXPredCreate: parse symbol, load
+    params, plan shapes, bind)."""
+    import mxnet_tpu as mx
+
+    sym = mx.sym.load_json(symbol_json)
+    shapes = {name: tuple(int(d) for d in shp)
+              for name, shp in zip(input_names, input_shapes)}
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req='null', **shapes)
+
+    if param_bytes:
+        fd, path = tempfile.mkstemp(suffix='.params')
+        try:
+            with os.fdopen(fd, 'wb') as f:
+                f.write(param_bytes)
+            loaded = mx.nd.load(path)
+        finally:
+            os.unlink(path)
+        for k, v in loaded.items():
+            tag, name = k.split(':', 1) if ':' in k else ('arg', k)
+            first, second = (exe.arg_dict, exe.aux_dict) if tag == 'arg' \
+                else (exe.aux_dict, exe.arg_dict)
+            dst = first.get(name)
+            if dst is None:   # tag/aux classification mismatch fallback
+                dst = second.get(name)
+            if dst is not None:
+                v.copyto(dst)
+    return _Predictor(exe, list(input_names))
+
+
+def set_input(pred, key, flat_data):
+    """Copy a flat float32 buffer into the named input (reference:
+    MXPredSetInput). The copy is explicit: the C caller's buffer is only
+    valid during this call, and zero-copy jnp.asarray on CPU would alias
+    it into the bound executor."""
+    import mxnet_tpu as mx
+    dst = pred.executor.arg_dict[key]
+    arr = np.array(flat_data, dtype=np.float32, copy=True).reshape(dst.shape)
+    mx.nd.array(arr).copyto(dst)
+
+
+def forward(pred):
+    pred.outputs = pred.executor.forward(is_train=False)
+
+
+def get_output_shape(pred, index):
+    outs = pred.outputs if pred.outputs is not None \
+        else pred.executor.forward(is_train=False)
+    pred.outputs = outs
+    return tuple(int(d) for d in outs[index].shape)
+
+
+def get_output(pred, index):
+    """The output as a contiguous float32 numpy array."""
+    if pred.outputs is None:
+        forward(pred)
+    return np.ascontiguousarray(
+        pred.outputs[index].asnumpy().astype(np.float32))

@@ -98,7 +98,8 @@ def _make_wrapper(wname, op):
         if named_syms:
             raise TypeError('unknown symbol inputs %s for op %s'
                             % (list(named_syms), op.name))
-        return _create(op, inputs, attrs, name=node_name)
+        return _create(op, inputs, attrs, name=node_name,
+                       name_resolved=True)
 
     wrapper.__name__ = wname
     wrapper.__doc__ = op.doc

@@ -566,11 +566,17 @@ def _node_fn(node):
     return base
 
 
-def _create(opname, sym_inputs, attrs, name=None):
-    """Create an op node symbol (the compose step of generated wrappers)."""
+def _create(opname, sym_inputs, attrs, name=None, name_resolved=False):
+    """Create an op node symbol (the compose step of generated wrappers).
+
+    name_resolved=True means the caller already ran the name through the
+    active NameManager (the generated wrappers do, to name auto-created
+    weight Variables) — resolving twice would double-apply Prefix
+    managers."""
     op = _registry.get(opname) if isinstance(opname, string_types) else opname
     hint = op.name.lower().lstrip('_')
-    name = NameManager.current.get(name, hint)
+    if not name_resolved:
+        name = NameManager.current.get(name, hint)
     entries = []
     for s in sym_inputs:
         entries.append(s._entry())

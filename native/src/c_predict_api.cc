@@ -1,0 +1,222 @@
+// C predict API — the minimal inference ABI for host applications
+// (reference: include/mxnet/c_predict_api.h:78-233, implementation
+// src/c_api/c_predict_api.cc — MXPredCreate/SetInput/Forward/
+// GetOutputShape/GetOutput/Free, MXGetLastError).
+//
+// TPU-native inversion: the reference wraps a C++ executor for Python;
+// here the runtime IS Python/XLA, so this library embeds CPython and
+// drives mxnet_tpu.native.predict_bridge. C callers get the same ABI
+// either standalone (the library initializes an interpreter) or inside
+// an existing Python process (ctypes load: the running interpreter is
+// reused; every entry point takes the GIL via PyGILState).
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef void* PredictorHandle;
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char** input_keys,
+                 const unsigned* input_shape_indptr,
+                 const unsigned* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, unsigned size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, unsigned index,
+                         unsigned** shape_data, unsigned* shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, unsigned index, float* data,
+                    unsigned size);
+int MXPredFree(PredictorHandle handle);
+const char* MXGetLastError();
+int mxpred_abi_version();
+}
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct Predictor {
+  PyObject* obj;                       // bridge _Predictor
+  std::vector<unsigned> shape_buf;     // backing store for GetOutputShape
+};
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Ensure an interpreter exists (standalone C host) exactly once.
+void ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+#if PY_VERSION_HEX < 0x03090000
+      PyEval_InitThreads();
+#endif
+      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // works from any thread
+      PyEval_SaveThread();
+    }
+  });
+}
+
+PyObject* bridge() {  // borrowed-style: cached, never released
+  static PyObject* mod = nullptr;
+  if (!mod) mod = PyImport_ImportModule("mxnet_tpu.native.predict_bridge");
+  return mod;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+int mxpred_abi_version() { return 1; }
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char** input_keys,
+                 const unsigned* input_shape_indptr,
+                 const unsigned* input_shape_data, PredictorHandle* out) {
+  (void)dev_type;  // one logical accelerator context under XLA
+  (void)dev_id;
+  ensure_python();
+  Gil gil;
+  PyObject* mod = bridge();
+  if (!mod) { set_error_from_python(); return -1; }
+
+  PyObject* names = PyList_New(num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  for (unsigned i = 0; i < num_input_nodes; ++i) {
+    PyList_SET_ITEM(names, i, PyUnicode_FromString(input_keys[i]));
+    unsigned lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (unsigned j = lo; j < hi; ++j)
+      PyList_SET_ITEM(shp, j - lo, PyLong_FromUnsignedLong(
+          input_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, shp);
+  }
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* res = PyObject_CallMethod(
+      mod, "create", "sOOO",
+      symbol_json_str ? symbol_json_str : "", params, names, shapes);
+  Py_DECREF(params);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  if (!res) { set_error_from_python(); return -1; }
+  Predictor* p = new Predictor{res, {}};
+  *out = p;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, unsigned size) {
+  Gil gil;
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyObject* mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(float), PyBUF_READ);
+  // bridge reshapes the flat f32 buffer onto the bound input
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* flat = np ? PyObject_CallMethod(np, "frombuffer", "Os", mv,
+                                            "float32")
+                      : nullptr;
+  Py_XDECREF(np);
+  Py_DECREF(mv);
+  if (!flat) { set_error_from_python(); return -1; }
+  PyObject* res = PyObject_CallMethod(bridge(), "set_input", "OsO",
+                                      p->obj, key, flat);
+  Py_DECREF(flat);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Gil gil;
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyObject* res = PyObject_CallMethod(bridge(), "forward", "O", p->obj);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, unsigned index,
+                         unsigned** shape_data, unsigned* shape_ndim) {
+  Gil gil;
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyObject* res = PyObject_CallMethod(bridge(), "get_output_shape", "OI",
+                                      p->obj, index);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyTuple_Size(res);
+  p->shape_buf.resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i)
+    p->shape_buf[static_cast<size_t>(i)] = static_cast<unsigned>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(res, i)));
+  Py_DECREF(res);
+  *shape_data = p->shape_buf.data();
+  *shape_ndim = static_cast<unsigned>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, unsigned index, float* data,
+                    unsigned size) {
+  Gil gil;
+  Predictor* p = static_cast<Predictor*>(handle);
+  PyObject* res = PyObject_CallMethod(bridge(), "get_output", "OI",
+                                      p->obj, index);
+  if (!res) { set_error_from_python(); return -1; }
+  PyObject* tobytes = PyObject_CallMethod(res, "tobytes", nullptr);
+  Py_DECREF(res);
+  if (!tobytes) { set_error_from_python(); return -1; }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(tobytes, &buf, &len);
+  if (static_cast<size_t>(len) != static_cast<size_t>(size) *
+      sizeof(float)) {
+    Py_DECREF(tobytes);
+    g_last_error = "MXPredGetOutput: size mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, static_cast<size_t>(len));
+  Py_DECREF(tobytes);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Gil gil;
+  Predictor* p = static_cast<Predictor*>(handle);
+  Py_XDECREF(p->obj);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

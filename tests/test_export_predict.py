@@ -1,0 +1,117 @@
+"""Gluon -> Symbol tracing, export in the reference symbol-JSON format,
+SymbolBlock.imports, and the native C predict API (reference:
+python/mxnet/gluon/block.py HybridBlock._get_graph/export,
+SymbolBlock:952; include/mxnet/c_predict_api.h).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn, SymbolBlock
+
+
+def _small_net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1, activation='relu'),
+                nn.BatchNorm(), nn.Flatten(), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_hybrid_block_composes_symbol():
+    net = _small_net()
+    out = net(mx.sym.Variable('data'))
+    args = out.list_arguments()
+    assert 'data' in args
+    assert any(a.endswith('conv0_weight') for a in args)
+    aux = out.list_auxiliary_states()
+    assert any(a.endswith('running_mean') for a in aux)
+
+
+def test_symbol_trace_matches_eager():
+    net = _small_net()
+    x = np.random.randn(2, 1, 8, 8).astype('float32')
+    ref = net(mx.nd.array(x)).asnumpy()
+    out = net(mx.sym.Variable('data'))
+    exe = out.simple_bind(ctx=mx.cpu(), grad_req='null',
+                          data=(2, 1, 8, 8))
+    for name, p in net.collect_params().items():
+        if name in exe.arg_dict:
+            p.data().copyto(exe.arg_dict[name])
+        elif name in exe.aux_dict:
+            p.data().copyto(exe.aux_dict[name])
+    got = exe.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_export_writes_symbol_json(tmp_path):
+    net = _small_net()
+    net.hybridize()
+    x = mx.nd.array(np.random.randn(2, 1, 8, 8).astype('float32'))
+    net(x)
+    net.export(str(tmp_path / 'm'))
+    graph = json.loads((tmp_path / 'm-symbol.json').read_text())
+    # reference layout: nodes/arg_nodes/heads (c_api_symbolic.cc:455)
+    assert 'nodes' in graph and 'arg_nodes' in graph and 'heads' in graph
+    ops = {n['op'] for n in graph['nodes']}
+    assert 'Convolution' in ops and 'BatchNorm' in ops
+
+
+def test_export_symbolblock_roundtrip(tmp_path):
+    net = _small_net()
+    net.hybridize()
+    x = mx.nd.array(np.random.randn(2, 1, 8, 8).astype('float32'))
+    ref = net(x).asnumpy()
+    net.export(str(tmp_path / 'm'))
+    blk = SymbolBlock.imports(str(tmp_path / 'm-symbol.json'), 'data',
+                              str(tmp_path / 'm-0000.params'))
+    got = blk(x).asnumpy()
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_c_predict_api_end_to_end(tmp_path):
+    from mxnet_tpu.native import predict
+    if not predict.available():
+        pytest.skip('native toolchain unavailable')
+    net = _small_net()
+    net.hybridize()
+    x = np.random.randn(2, 1, 8, 8).astype('float32')
+    ref = net(mx.nd.array(x)).asnumpy()
+    net.export(str(tmp_path / 'm'))
+    p = predict.Predictor(
+        (tmp_path / 'm-symbol.json').read_text(),
+        (tmp_path / 'm-0000.params').read_bytes(),
+        {'data': (2, 1, 8, 8)})
+    p.set_input('data', x)
+    p.forward()
+    out = p.get_output(0)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, atol=1e-2)
+    # error surface: bad input name reports through MXGetLastError
+    with pytest.raises(RuntimeError):
+        p.set_input('nope', x)
+    p.close()
+
+
+def test_c_predict_model_zoo(tmp_path):
+    from mxnet_tpu.native import predict
+    if not predict.available():
+        pytest.skip('native toolchain unavailable')
+    from mxnet_tpu.gluon import model_zoo
+    net = model_zoo.vision.get_model('squeezenet1.0')
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = np.random.randn(1, 3, 64, 64).astype('float32')
+    ref = net(mx.nd.array(x)).asnumpy()
+    net.export(str(tmp_path / 'sq'))
+    p = predict.Predictor(
+        (tmp_path / 'sq-symbol.json').read_text(),
+        (tmp_path / 'sq-0000.params').read_bytes(),
+        {'data': (1, 3, 64, 64)})
+    p.set_input('data', x)
+    p.forward()
+    np.testing.assert_allclose(p.get_output(0), ref, atol=1e-2)
+    p.close()

@@ -874,7 +874,17 @@ class SymbolBlock(HybridBlock):
                     exe.arg_dict[name]._data = p.data()._data
                 elif name in exe.aux_dict:
                     exe.aux_dict[name]._data = p.data()._data
-            out = exe.forward(is_train=autograd.is_training(), **feed)
+            training = autograd.is_training()
+            out = exe.forward(is_train=training, **feed)
+            if training:
+                # the executor rebinds aux arrays (moving stats) to the
+                # updated values; propagate them back into the block's
+                # Parameters so training + save see the updates
+                params = self.collect_params()._params
+                for name, arr in exe.aux_dict.items():
+                    p = params.get(name)
+                    if p is not None and p._data is not None:
+                        p.data()._data = arr._data
             if isinstance(out, (list, tuple)) and len(out) == 1:
                 return out[0]
             return out

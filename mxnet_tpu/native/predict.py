@@ -87,7 +87,16 @@ def lib():
                     os.path.getmtime(_SO) < os.path.getmtime(_SRC):
                 _compile()
             _lib = _bind(_SO)
-        except Exception:
+        except Exception as e:
+            import warnings
+            detail = ''
+            stderr = getattr(e, 'stderr', None)
+            if stderr:
+                detail = ': ' + (stderr.decode(errors='replace')
+                                 if isinstance(stderr, bytes) else
+                                 str(stderr))[-500:]
+            warnings.warn('native predict library unavailable (%s%s)'
+                          % (e, detail))
             _lib = None
     return _lib
 

@@ -72,10 +72,15 @@ def forward(pred):
 
 
 def get_output_shape(pred, index):
-    outs = pred.outputs if pred.outputs is not None \
-        else pred.executor.forward(is_train=False)
-    pred.outputs = outs
-    return tuple(int(d) for d in outs[index].shape)
+    """Planned output shape — statically inferred, no execution, so the
+    reference's Create -> GetOutputShape -> SetInput -> Forward call
+    order costs nothing extra (reference: MXPredGetOutputShape)."""
+    if pred.outputs is not None:
+        return tuple(int(d) for d in pred.outputs[index].shape)
+    exe = pred.executor
+    known = {n: tuple(a.shape) for n, a in exe.arg_dict.items()}
+    _, out_shapes, _ = exe._symbol.infer_shape(**known)
+    return tuple(int(d) for d in out_shapes[index])
 
 
 def get_output(pred, index):

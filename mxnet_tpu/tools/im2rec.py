@@ -1,0 +1,217 @@
+"""im2rec — pack an image folder into .lst / .rec files (reference:
+tools/im2rec.py; record framing src/recordio and IRHeader pack in
+python/mxnet/recordio.py:344-397).
+
+Same CLI contract as the reference: `im2rec.py prefix root --list`
+generates prefix.lst (index\\tlabel\\trelpath), then `im2rec.py prefix
+root` encodes the listed images into prefix.rec + prefix.idx readable
+by ImageRecordIter (and by the native recio engine). Decode/encode is
+cv2 when available, PIL otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+import numpy as np
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) with one label id per subfolder
+    (reference: im2rec.py list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, 'w') as fout:
+        for idx, rel, label in image_list:
+            fout.write('%d\t%s\t%s\n' % (idx, label, rel))
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    n_train = int(n * args.train_ratio)
+    n_test = int(n * args.test_ratio)
+    sets = []
+    if args.train_ratio < 1.0 or args.test_ratio > 0:
+        if n_test:
+            sets.append(('_test', image_list[:n_test]))
+        sets.append(('_train', image_list[n_test:n_test + n_train]))
+        if n_test + n_train < n:
+            sets.append(('_val', image_list[n_test + n_train:]))
+    else:
+        sets.append(('', image_list))
+    for suffix, chunk in sets:
+        write_list(args.prefix + suffix + '.lst',
+                   [(i, rel, lab) for i, (_, rel, lab) in enumerate(chunk)])
+
+
+def read_list(path_in):
+    """Yield (index, relpath, labels...) rows from a .lst file."""
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split('\t')
+            if len(parts) < 3:
+                continue
+            yield (int(float(parts[0])), parts[-1],
+                   [float(x) for x in parts[1:-1]])
+
+
+def _load_resize(fpath, args):
+    """Read one image -> ('img', cv2 ndarray) for recordio.pack_img to
+    encode, ('buf', bytes) when already encoded (pass-through or PIL
+    fallback), or None on decode failure."""
+    try:
+        import cv2
+    except ImportError:
+        cv2 = None
+    if args.pass_through:
+        with open(fpath, 'rb') as f:
+            return ('buf', f.read())
+    if cv2 is not None:
+        flag = {1: cv2.IMREAD_COLOR, 0: cv2.IMREAD_GRAYSCALE,
+                -1: cv2.IMREAD_UNCHANGED}[args.color]
+        img = cv2.imread(fpath, flag)
+        if img is None:
+            return None
+        if args.center_crop:
+            h, w = img.shape[:2]
+            s = min(h, w)
+            img = img[(h - s) // 2:(h - s) // 2 + s,
+                      (w - s) // 2:(w - s) // 2 + s]
+        if args.resize:
+            h, w = img.shape[:2]
+            if min(h, w) != args.resize:
+                scale = args.resize / min(h, w)
+                img = cv2.resize(img, (int(round(w * scale)),
+                                       int(round(h * scale))))
+        return ('img', img)
+    # PIL fallback (no cv2 anywhere: encode here)
+    import io as _io
+    from PIL import Image
+    img = Image.open(fpath)
+    img = img.convert('L' if args.color == 0 else 'RGB')
+    if args.center_crop:
+        w, h = img.size
+        s = min(h, w)
+        img = img.crop(((w - s) // 2, (h - s) // 2,
+                        (w - s) // 2 + s, (h - s) // 2 + s))
+    if args.resize:
+        w, h = img.size
+        if min(h, w) != args.resize:
+            scale = args.resize / min(h, w)
+            img = img.resize((int(round(w * scale)),
+                              int(round(h * scale))))
+    out = _io.BytesIO()
+    if args.encoding == '.jpg':
+        img.save(out, 'JPEG', quality=args.quality)
+    else:
+        img.save(out, 'PNG', compress_level=min(args.quality, 9))
+    return ('buf', out.getvalue())
+
+
+def write_rec(args, lst_path):
+    from ..recordio import MXIndexedRecordIO, IRHeader, pack, pack_img
+    prefix = os.path.splitext(lst_path)[0]
+    record = MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    cnt = 0
+    for idx, rel, labels in read_list(lst_path):
+        fpath = os.path.join(args.root, rel)
+        loaded = _load_resize(fpath, args)
+        if loaded is None:
+            print('imread read blank/error for %s' % fpath,
+                  file=sys.stderr)
+            continue
+        if args.pack_label or len(labels) != 1:
+            header = IRHeader(1, np.asarray(labels, dtype=np.float32),
+                              idx, 0)
+        else:
+            header = IRHeader(0, labels[0], idx, 0)
+        kind, payload = loaded
+        if kind == 'img':
+            s = pack_img(header, payload, quality=args.quality,
+                         img_fmt=args.encoding)
+        else:
+            s = pack(header, payload)
+        record.write_idx(idx, s)
+        cnt += 1
+    record.close()
+    print('wrote %d records to %s.rec' % (cnt, prefix))
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Create an image list and/or RecordIO database '
+                    '(reference: tools/im2rec.py)')
+    parser.add_argument('prefix',
+                        help='prefix of input/output lst and rec files')
+    parser.add_argument('root', help='path to folder containing images')
+    cgroup = parser.add_argument_group('Options for creating image lists')
+    cgroup.add_argument('--list', action='store_true',
+                        help='only generate the .lst file')
+    cgroup.add_argument('--exts', nargs='+',
+                        default=['.jpeg', '.jpg', '.png'])
+    cgroup.add_argument('--train-ratio', type=float, default=1.0)
+    cgroup.add_argument('--test-ratio', type=float, default=0)
+    cgroup.add_argument('--recursive', action='store_true')
+    cgroup.add_argument('--no-shuffle', dest='shuffle',
+                        action='store_false')
+    rgroup = parser.add_argument_group('Options for creating database')
+    rgroup.add_argument('--pass-through', action='store_true',
+                        help='skip transcoding, pack raw bytes')
+    rgroup.add_argument('--resize', type=int, default=0)
+    rgroup.add_argument('--center-crop', action='store_true')
+    rgroup.add_argument('--quality', type=int, default=95)
+    rgroup.add_argument('--color', type=int, default=1,
+                        choices=[-1, 0, 1])
+    rgroup.add_argument('--encoding', type=str, default='.jpg',
+                        choices=['.jpg', '.png'])
+    rgroup.add_argument('--pack-label', action='store_true')
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+        return
+    # encode every .lst matching the prefix (reference behavior)
+    work_dir = os.path.dirname(args.prefix) or '.'
+    base = os.path.basename(args.prefix)
+    lsts = [os.path.join(work_dir, f) for f in sorted(os.listdir(work_dir))
+            if f.startswith(base) and f.endswith('.lst')]
+    if not lsts:
+        print('no .lst files found for prefix %s — run with --list first'
+              % args.prefix, file=sys.stderr)
+        sys.exit(1)
+    for lst in lsts:
+        write_rec(args, lst)
+
+
+if __name__ == '__main__':
+    main()

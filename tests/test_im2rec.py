@@ -1,0 +1,56 @@
+"""im2rec dataset-packing tool (reference: tools/im2rec.py) — folder ->
+.lst -> .rec/.idx -> ImageRecordIter round trip.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.tools import im2rec
+
+
+@pytest.fixture
+def image_tree(tmp_path):
+    cv2 = pytest.importorskip('cv2')
+    rs = np.random.RandomState(0)
+    for cls in ('cat', 'dog'):
+        d = tmp_path / 'imgs' / cls
+        d.mkdir(parents=True)
+        for i in range(5):
+            img = (rs.rand(40, 48, 3) * 255).astype('uint8')
+            cv2.imwrite(str(d / ('%d.jpg' % i)), img)
+    return tmp_path
+
+
+def test_make_list_recursive(image_tree):
+    prefix = str(image_tree / 'pack')
+    im2rec.main([prefix, str(image_tree / 'imgs'), '--list',
+                 '--recursive'])
+    rows = list(im2rec.read_list(prefix + '.lst'))
+    assert len(rows) == 10
+    labels = {lab[0] for _, _, lab in rows}
+    assert labels == {0.0, 1.0}      # one id per class folder
+
+
+def test_pack_and_read_back(image_tree):
+    prefix = str(image_tree / 'pack')
+    im2rec.main([prefix, str(image_tree / 'imgs'), '--list',
+                 '--recursive'])
+    im2rec.main([prefix, str(image_tree / 'imgs'), '--resize', '32'])
+    assert os.path.exists(prefix + '.rec')
+    assert os.path.exists(prefix + '.idx')
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + '.rec',
+                               data_shape=(3, 28, 28), batch_size=5)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (5, 3, 28, 28)
+    assert set(np.unique(batch.label[0].asnumpy())) <= {0.0, 1.0}
+
+
+def test_train_val_split(image_tree):
+    prefix = str(image_tree / 'sp')
+    im2rec.main([prefix, str(image_tree / 'imgs'), '--list',
+                 '--recursive', '--train-ratio', '0.8'])
+    train = list(im2rec.read_list(prefix + '_train.lst'))
+    val = list(im2rec.read_list(prefix + '_val.lst'))
+    assert len(train) == 8 and len(val) == 2

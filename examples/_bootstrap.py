@@ -1,0 +1,13 @@
+"""Shared standalone-run bootstrap: put the repo root on sys.path so
+`python examples/<script>.py` finds mxnet_tpu without touching
+PYTHONPATH (the TPU plugin loads via the ambient PYTHONPATH's
+sitecustomize — overriding it breaks backend registration). The
+reference centralizes the same trick in
+example/image-classification/common/find_mxnet.py.
+"""
+import os
+import sys
+
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _root not in sys.path:
+    sys.path.insert(0, _root)

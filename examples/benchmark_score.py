@@ -8,6 +8,12 @@ fp32 and bf16, printing one JSON line per (model, dtype).
 import json
 import time
 
+# shared standalone-run bootstrap (repo root onto sys.path); when
+# imported as examples.* the root is already importable and the
+# script dir is not on sys.path, so gate on standalone execution
+if not __package__:
+    import _bootstrap  # noqa: F401
+
 import numpy as np
 
 # published 1x V100 bs=128 numbers (BASELINE.md)

@@ -1,0 +1,109 @@
+"""Sub-pixel super-resolution (ESPCN) — upsampling via depth_to_space
+(reference: example/gluon/super_resolution.py, which uses the same
+PixelShuffle trick). Trains 2x upscaling on synthetic band-limited
+images; reports PSNR gain over bicubic-free nearest-neighbour baseline.
+"""
+from __future__ import annotations
+
+import argparse
+
+# shared standalone-run bootstrap (repo root onto sys.path); when
+# imported as examples.* the root is already importable and the
+# script dir is not on sys.path, so gate on standalone execution
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def smooth_images(rs, n, size):
+    """Band-limited random images: low-frequency sinusoid mixtures."""
+    xs = np.zeros((n, 1, size, size), dtype=np.float32)
+    yy, xx = np.mgrid[0:size, 0:size] / size
+    for i in range(n):
+        img = np.zeros((size, size), np.float32)
+        for _ in range(4):
+            fx, fy = rs.uniform(0.5, 3, 2)
+            ph = rs.uniform(0, 2 * np.pi, 2)
+            img += rs.uniform(0.3, 1.0) * \
+                np.sin(2 * np.pi * fx * xx + ph[0]) * \
+                np.sin(2 * np.pi * fy * yy + ph[1])
+        img = (img - img.min()) / (img.max() - img.min() + 1e-9)
+        xs[i, 0] = img
+    return xs
+
+
+def psnr(a, b):
+    mse = float(((a - b) ** 2).mean())
+    return 10 * np.log10(1.0 / max(mse, 1e-12))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--upscale', type=int, default=2)
+    p.add_argument('--size', type=int, default=32)
+    p.add_argument('--num-samples', type=int, default=256)
+    p.add_argument('--batch-size', type=int, default=16)
+    p.add_argument('--epochs', type=int, default=10)
+    p.add_argument('--lr', type=float, default=0.001)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    r = args.upscale
+
+    class SuperRes(nn.HybridBlock):
+        """ESPCN: conv stack -> r^2 channels -> depth_to_space."""
+
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.conv1 = nn.Conv2D(32, 5, padding=2,
+                                       activation='relu')
+                self.conv2 = nn.Conv2D(16, 3, padding=1,
+                                       activation='relu')
+                self.conv3 = nn.Conv2D(r * r, 3, padding=1)
+
+        def hybrid_forward(self, F, x):
+            x = self.conv3(self.conv2(self.conv1(x)))
+            return F.depth_to_space(x, block_size=r)
+
+    rs = np.random.RandomState(0)
+    hi = smooth_images(rs, args.num_samples, args.size)
+    lo = hi[:, :, ::r, ::r]   # decimated input
+
+    net = SuperRes()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    L = gluon.loss.L2Loss()
+
+    for epoch in range(args.epochs):
+        order = rs.permutation(args.num_samples)
+        tot = cnt = 0
+        for b in range(0, args.num_samples, args.batch_size):
+            idx = order[b:b + args.batch_size]
+            xb, yb = nd.array(lo[idx]), nd.array(hi[idx])
+            with autograd.record():
+                loss = L(net(xb), yb)
+            loss.backward()
+            trainer.step(len(idx))
+            tot += float(loss.mean().asscalar())
+            cnt += 1
+        print('epoch %d loss %.5f' % (epoch, tot / cnt))
+
+    out = net(nd.array(lo)).asnumpy()
+    model_psnr = psnr(out, hi)
+    nearest = np.repeat(np.repeat(lo, r, axis=2), r, axis=3)
+    base_psnr = psnr(nearest, hi)
+    print('PSNR: model %.2f dB vs nearest-neighbour %.2f dB'
+          % (model_psnr, base_psnr))
+    assert model_psnr > base_psnr, 'training should beat nearest-neighbour'
+    return model_psnr, base_psnr
+
+
+if __name__ == '__main__':
+    main()

@@ -12,6 +12,12 @@ from __future__ import annotations
 
 import argparse
 
+# shared standalone-run bootstrap (repo root onto sys.path); when
+# imported as examples.* the root is already importable and the
+# script dir is not on sys.path, so gate on standalone execution
+if not __package__:
+    import _bootstrap  # noqa: F401
+
 import numpy as np
 
 import mxnet_tpu as mx

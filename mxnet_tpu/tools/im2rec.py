@@ -24,8 +24,8 @@ def list_image(root, recursive, exts):
     i = 0
     if recursive:
         cat = {}
-        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
-            dirs.sort()
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()   # deterministic traversal -> stable label ids
             for fname in sorted(files):
                 fpath = os.path.join(path, fname)
                 suffix = os.path.splitext(fname)[1].lower()
@@ -114,8 +114,16 @@ def _load_resize(fpath, args):
     # PIL fallback (no cv2 anywhere: encode here)
     import io as _io
     from PIL import Image
-    img = Image.open(fpath)
-    img = img.convert('L' if args.color == 0 else 'RGB')
+    try:
+        img = Image.open(fpath)
+        img.load()
+    except Exception:
+        return None
+    if args.color == 0:
+        img = img.convert('L')
+    elif args.color == 1:
+        img = img.convert('RGB')
+    # color == -1 (IMREAD_UNCHANGED): keep the file's own mode/channels
     if args.center_crop:
         w, h = img.size
         s = min(h, w)

@@ -12,7 +12,8 @@ import pytest
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 from examples import word_lm, dc_gan, sparse_linear, actor_critic, \
-    matrix_factorization  # noqa: E402
+    matrix_factorization, autoencoder, super_resolution, \
+    adversary_fgsm  # noqa: E402
 
 
 def test_word_lm_learns():
@@ -46,3 +47,19 @@ def test_matrix_factorization_mesh():
     # model-parallel embedding sharding over the virtual 8-device mesh
     mse = matrix_factorization.main(['--epochs', '2', '--mesh'])
     assert np.isfinite(mse)
+
+
+def test_autoencoder_clusters():
+    mse, purity = autoencoder.main(['--epochs', '6',
+                                    '--num-samples', '512'])
+    assert np.isfinite(mse) and purity > 0.8
+
+
+def test_super_resolution_beats_nearest():
+    model_psnr, base_psnr = super_resolution.main(['--epochs', '12'])
+    assert model_psnr > base_psnr
+
+
+def test_fgsm_collapses_accuracy():
+    clean, adv = adversary_fgsm.main(['--num-samples', '512'])
+    assert clean > 0.9 and adv < clean - 0.2

@@ -18,6 +18,9 @@ from .registry import register
 
 
 def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+    """grad -> clip(rescale*grad) + wd*weight — the SGD-family order
+    (reference: SGDKernel optimizer_op-inl.h clips before the wd
+    term)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
@@ -25,6 +28,19 @@ def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
     wd_static_zero = isinstance(wd, (int, float)) and wd == 0.0
     if not wd_static_zero and weight is not None:
         g = g + wd * weight
+    return g
+
+
+def _rescale_wd_clip(grad, rescale_grad, clip_gradient, wd, weight):
+    """grad -> clip(rescale*grad + wd*weight) — the Adam-family order
+    (reference: AdamUpdate/RMSPropUpdate/FTMLKernel fold wd into the
+    gradient BEFORE clipping, optimizer_op-inl.h:1153,1546,1056)."""
+    g = grad * rescale_grad
+    wd_static_zero = isinstance(wd, (int, float)) and wd == 0.0
+    if not wd_static_zero and weight is not None:
+        g = g + wd * weight
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
     return g
 
 
@@ -109,7 +125,7 @@ def signum_update(weight, grad, mom, *, lr=None, momentum=0.0, wd=0.0,
 def adam_update(weight, grad, mean, var, *, lr=None, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=False):
-    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    g = _rescale_wd_clip(grad, rescale_grad, clip_gradient, wd, weight)
     m = beta1 * mean + (1 - beta1) * g
     v = beta2 * var + (1 - beta2) * jnp.square(g)
     w = weight - lr * m / (jnp.sqrt(v) + epsilon)
@@ -155,7 +171,7 @@ def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t, *,
 def ftml_update(weight, grad, d, v, z, *, lr=None, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
                 clip_grad=-1.0):
-    g = _rescale_clip(grad, rescale_grad, clip_grad, wd, weight)
+    g = _rescale_wd_clip(grad, rescale_grad, clip_grad, wd, weight)
     v_t = beta2 * v + (1 - beta2) * jnp.square(g)
     d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(v_t / (1 - beta2 ** t)) + epsilon)
     sigma_t = d_t - beta1 * d
@@ -168,7 +184,7 @@ def ftml_update(weight, grad, d, v, z, *, lr=None, beta1=0.6, beta2=0.999,
 def rmsprop_update(weight, grad, n, *, lr=None, gamma1=0.95, epsilon=1e-8,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    clip_weights=-1.0):
-    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    g = _rescale_wd_clip(grad, rescale_grad, clip_gradient, wd, weight)
     n_t = gamma1 * n + (1 - gamma1) * jnp.square(g)
     w = weight - lr * g / jnp.sqrt(n_t + epsilon)
     if clip_weights is not None and clip_weights > 0:
@@ -181,7 +197,7 @@ def rmsprop_update(weight, grad, n, *, lr=None, gamma1=0.95, epsilon=1e-8,
 def rmspropalex_update(weight, grad, n, g_acc, delta, *, lr=None, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
-    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    g = _rescale_wd_clip(grad, rescale_grad, clip_gradient, wd, weight)
     n_t = gamma1 * n + (1 - gamma1) * jnp.square(g)
     g_t = gamma1 * g_acc + (1 - gamma1) * g
     delta_t = gamma2 * delta - lr * g / jnp.sqrt(n_t - jnp.square(g_t) + epsilon)

@@ -56,6 +56,7 @@ from . import util
 from . import registry
 from . import engine
 from . import rtc
+from . import subgraph
 from . import kvstore_server
 from . import executor_manager
 

@@ -98,6 +98,63 @@ KNOBS = {k.name: k for k in [
     _knob('MXNET_HOME', str, os.path.join(os.path.expanduser('~'),
                                           '.mxnet'),
           'Model-store / data cache root.'),
+    _knob('MXNET_GLUON_REPO', str, 'https://apache-mxnet.s3'
+          '-accelerate.dualstack.amazonaws.com/',
+          'Pretrained-weight repository base URL (model_store).'),
+    _knob('MXNET_ENFORCE_DETERMINISM', bool, False,
+          'Forbid non-deterministic kernels (env_var.md). XLA TPU'
+          ' lowering is deterministic for everything this build emits,'
+          ' so the flag is honored by construction.'),
+    _knob('MXNET_UPDATE_ON_KVSTORE', bool, False,
+          'Default for Trainer update_on_kvstore. Unlike the reference'
+          " (default True on single-machine stores), the TPU build"
+          ' defaults to False: the fused client-side step outperforms'
+          ' the in-store optimizer path.'),
+    _knob('MXNET_OPTIMIZER_AGGREGATION_SIZE', int, 4,
+          'Max weights fused per multi-tensor optimizer call'
+          ' (multi_sgd_update family). The fused ParallelTrainer step'
+          ' already updates every weight in one XLA program, so this'
+          ' only shapes the eager Updater path.'),
+    _knob('MXNET_MP_WORKER_NTHREADS', int, 1,
+          'gluon DataLoader multiprocessing workers default.'),
+    _knob('MXNET_MP_OPENCV_NUM_THREADS', int, 0,
+          'cv2 thread cap inside DataLoader workers (0 = cv2 default).'),
+    # engine bulking segment sizes: one XLA program per graph already
+    _knob('MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN', int, 15,
+          'Bulking segment cap.', effective=False),
+    _knob('MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN_FWD', int, 15,
+          'Bulking segment cap (forward).', effective=False),
+    _knob('MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN_BWD', int, 15,
+          'Bulking segment cap (backward).', effective=False),
+    _knob('MXNET_EXEC_ENABLE_INPLACE', bool, True,
+          'XLA buffer assignment owns in-place reuse; the fused paths'
+          ' donate buffers explicitly.', effective=False),
+    _knob('MXNET_USE_OPERATOR_TUNING', bool, True,
+          'CPU elemwise OMP tuning; XLA autotunes.', effective=False),
+    _knob('MXNET_ENABLE_OPERATOR_TUNING', bool, True,
+          'Alias of MXNET_USE_OPERATOR_TUNING.', effective=False),
+    _knob('MXNET_USE_NUM_CORES_OPERATOR_TUNING', int, 0,
+          'CPU tuning core count.', effective=False),
+    _knob('MXNET_KVSTORE_USETREE', bool, False,
+          'PCIe-topology tree reduce; ICI mesh collectives replace it.',
+          effective=False),
+    _knob('MXNET_KVSTORE_LOGTREE', bool, False,
+          'Tree-reduce logging.', effective=False),
+    _knob('MXNET_KVSTORE_TREE_ARRAY_BOUND', int, 10000000,
+          'Tree-reduce threshold.', effective=False),
+    _knob('MXNET_STORAGE_FALLBACK_LOG_VERBOSE', bool, True,
+          'Sparse->dense fallback logging; the dense facade never'
+          ' falls back.', effective=False),
+    _knob('MXNET_GPU_WORKER_NTHREADS', int, 2,
+          'Per-GPU worker threads; XLA streams replace them.',
+          effective=False),
+    _knob('MXNET_GPU_COPY_NTHREADS', int, 1,
+          'GPU copy threads.', effective=False),
+    _knob('MXNET_MKLDNN_ENABLED', bool, True,
+          'No MKLDNN backend on TPU.', effective=False),
+    _knob('MXNET_LIBRARY_PATH', str, None,
+          'Dynamic backend library path; the native predict/recio'
+          ' libraries build on demand instead.', effective=False),
 ]}
 
 

@@ -91,8 +91,14 @@ class Trainer:
         else:
             self._kvstore = kvs_mod.create(kvstore) \
                 if isinstance(kvstore, str) else kvstore
-            self._update_on_kvstore = bool(config['update_on_kvstore']) \
-                if config['update_on_kvstore'] is not None else False
+            if config['update_on_kvstore'] is not None:
+                self._update_on_kvstore = bool(config['update_on_kvstore'])
+            else:
+                # configured default (reference: MXNET_UPDATE_ON_KVSTORE,
+                # env_var.md) — honors mx.config.set() and the env
+                from ..config import get as _cfg
+                self._update_on_kvstore = bool(
+                    _cfg('MXNET_UPDATE_ON_KVSTORE'))
             if self._compression_params and self._kvstore is not None:
                 self._kvstore.set_gradient_compression(self._compression_params)
             if self._update_on_kvstore:

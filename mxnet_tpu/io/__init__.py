@@ -1,4 +1,4 @@
 """mxnet_tpu.io — data iterators (reference: python/mxnet/io/)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter,
-                 ImageRecordIter_v1)
+                 ImageRecordIter_v1, ImageDetRecordIter, MXDataIter)

@@ -1,0 +1,314 @@
+"""Graph partitioning — the reference's subgraph framework as an API
+(reference: src/operator/subgraph/subgraph_property.h:77,193
+SubgraphProperty/SubgraphSelector, build_subgraph.cc; backends
+subgraph/mkldnn, subgraph/tensorrt).
+
+TPU-first reading: on the reference, partitioning carves regions out of
+the NNVM graph and hands them to an accelerated backend (MKLDNN fusion,
+TensorRT engines). Under XLA the *whole* graph is already one compiled
+program, so the seam serves different purposes: grouping ops into a
+single fused node (one jit cache entry, one profiler scope), excluding
+regions from surrounding transformations, and structural parity for
+code built against ``mx.subgraph``. The partitioner contracts maximal
+acyclic groups of selected ops into ``_XLASubgraph`` nodes whose
+executor evaluates the captured sub-graph; everything still lowers to
+the same XLA program in the end.
+"""
+from __future__ import annotations
+
+from .ops.registry import Operator
+from .symbol.symbol import Symbol, _Node, _topo_order
+
+
+class _SubgraphOperator(Operator):
+    """Operator + positional parameter-shape solver: simple_bind on a
+    partitioned graph still infers weight shapes by recursing the shape
+    planner into the captured inner graph."""
+
+    __slots__ = ('infer_param_shapes',)
+
+__all__ = ['SubgraphSelector', 'SubgraphProperty', 'partition',
+           'get_backend', 'register_backend']
+
+
+class SubgraphSelector:
+    """Chooses which nodes join a subgraph (reference:
+    subgraph_property.h:77 SubgraphSelector::Select*). The base class
+    selects by op-name set."""
+
+    def __init__(self, op_names=()):
+        self.op_names = set(op_names)
+
+    def select(self, node):
+        """True if this (non-variable) node may start/join a subgraph."""
+        return node.op.name in self.op_names
+
+
+class SubgraphProperty:
+    """A partitioning policy (reference: subgraph_property.h:193;
+    CreateSubgraphNode :222). Subclass to customize selection or the
+    created node's attributes."""
+
+    node_name = '_XLASubgraph'
+
+    def __init__(self, selector=None, op_names=()):
+        self.selector = selector or SubgraphSelector(op_names)
+
+    def create_subgraph_operator(self, group, ext_inputs, ext_outputs):
+        """Build the Operator evaluating ``group`` (topo-ordered nodes)
+        on the arrays bound to ``ext_inputs``."""
+        n_out = len(ext_outputs)
+
+        def run(args, *, training=False):
+            vals = {}
+            for entry, a in zip(ext_inputs, args):
+                vals[(id(entry[0]), entry[1])] = a
+            for node in group:
+                ins = [vals[(id(c), i)] for (c, i) in node.inputs]
+                attrs = {k: v for k, v in node.attrs.items()
+                         if v is not None}
+                if 'training' in node.op.attr_names:
+                    attrs.setdefault('training', training)
+                base = node.op.bind_attrs(**attrs)
+                out = base(list(ins)) if node.op.num_inputs == -1 \
+                    else base(*ins)
+                outs = list(out) if isinstance(out, (tuple, list)) \
+                    else [out]
+                for i, o in enumerate(outs):
+                    vals[(id(node), i)] = o
+            res = tuple(vals[(id(n), i)] for (n, i) in ext_outputs)
+            return res if n_out > 1 else res[0]
+
+        op = _SubgraphOperator(self.node_name, run, num_inputs=-1,
+                               num_outputs=n_out)
+        op.infer_param_shapes = _make_inner_solver(group, ext_inputs,
+                                                   ext_outputs)
+        return op
+
+
+def _make_inner_solver(group, ext_inputs, ext_outputs):
+    """Positional shape solver: rebuild the group over placeholder
+    Variables and run the ordinary planner inside it, so parameter
+    inputs (weights captured into the subgraph) get their shapes from
+    the inner ops' own rules."""
+    from .symbol.symbol import Variable
+    placeholders = [Variable('_sgin%d' % k)._entries[0]
+                    for k in range(len(ext_inputs))]
+    pos_of = {(id(n), i): k for k, (n, i) in enumerate(ext_inputs)}
+    rebuilt = {}
+    for m in group:
+        ins = []
+        for e in m.inputs:
+            k = pos_of.get((id(e[0]), e[1]))
+            if k is not None:
+                ins.append(placeholders[k])
+            else:
+                ins.append((rebuilt[id(e[0])], e[1]))
+        nn = _Node(m.op, m.name, attrs=dict(m.attrs), inputs=ins,
+                   num_outputs=m.num_outputs)
+        rebuilt[id(m)] = nn
+    inner = Symbol([(rebuilt[id(n)], i) for (n, i) in ext_outputs])
+
+    def solve(in_shapes):
+        known = {'_sgin%d' % k: tuple(s)
+                 for k, s in enumerate(in_shapes) if s is not None}
+        try:
+            shapes, _, _ = inner._var_shape_plan(known)
+        except Exception:
+            return {}
+        return {k: shapes.get('_sgin%d' % k)
+                for k in range(len(in_shapes))
+                if shapes.get('_sgin%d' % k) is not None}
+
+    return solve
+
+
+_BACKENDS = {}
+
+
+def register_backend(name, prop):
+    _BACKENDS[name] = prop
+
+
+def get_backend(name):
+    return _BACKENDS[name]
+
+
+# default backend: everything XLA-fusable may group (reference analog:
+# the MKLDNN backend's op list; on TPU the list is "any registered op")
+class _XLAProperty(SubgraphProperty):
+    def __init__(self):
+        super().__init__(selector=None, op_names=())
+        self.selector = None
+
+
+register_backend('XLA', _XLAProperty())
+
+
+def partition(symbol, op_names=None, selector=None, prop=None):
+    """Contract selected ops into ``_XLASubgraph`` nodes (reference:
+    build_subgraph.cc BuildSubgraph; python surface
+    sym.get_backend_symbol(...)).
+
+    Groups are maximal and acyclic: a node joins a neighbour group only
+    when that cannot create a cycle through unselected nodes. Returns a
+    new Symbol; the original is untouched. RNG-consuming and dynamic-
+    shape (nojit) ops never join groups (the subgraph evaluator has no
+    key to thread to them).
+    """
+    if prop is None:
+        prop = SubgraphProperty(selector=selector,
+                                op_names=op_names or ())
+
+    nodes = _topo_order(symbol._entries)
+
+    def selectable(n):
+        if n.is_variable:
+            return False
+        if n.op.needs_rng:
+            return False
+        if getattr(n.op, 'nojit', False):
+            return False
+        if prop.selector is not None:
+            return prop.selector.select(n)
+        return True
+
+    # group assignment with cycle prevention: deps[id(node)] = set of
+    # group ids the node (transitively) depends on
+    group_of = {}
+    deps = {}
+    groups = {}
+    next_gid = [0]
+    for n in nodes:
+        d = set()
+        for (c, _) in n.inputs:
+            d |= deps.get(id(c), set())
+            if id(c) in group_of:
+                d.add(group_of[id(c)])
+        if selectable(n):
+            # try to join the group of a direct selected input
+            cand = None
+            for (c, _) in n.inputs:
+                g = group_of.get(id(c))
+                if g is None:
+                    continue
+                # joining g is safe iff no OTHER input path reaches g
+                # except directly from g's members
+                ok = True
+                for (c2, _) in n.inputs:
+                    if group_of.get(id(c2)) == g:
+                        continue
+                    if g in deps.get(id(c2), set()):
+                        ok = False
+                        break
+                if ok:
+                    cand = g
+                    break
+            if cand is None:
+                cand = next_gid[0]
+                next_gid[0] += 1
+                groups[cand] = []
+            group_of[id(n)] = cand
+            groups[cand].append(n)
+            d.discard(cand)
+        deps[id(n)] = d
+
+    multi = {g for g, ns in groups.items() if len(ns) >= 2}
+    if not multi:
+        return Symbol(list(symbol._entries))
+
+    # consumers outside the group (or heads) define external outputs
+    consumed_outside = {}
+    for n in nodes:
+        for (c, i) in n.inputs:
+            if group_of.get(id(c)) in multi and \
+                    group_of.get(id(c)) != group_of.get(id(n)):
+                consumed_outside.setdefault(group_of[id(c)], []).append(
+                    (c, i))
+    for (n, i) in symbol._entries:
+        if group_of.get(id(n)) in multi:
+            consumed_outside.setdefault(group_of[id(n)], []).append((n, i))
+
+    # rebuild over the unit DAG (group = one unit, other node = one
+    # unit), topologically — an external consumer of a group-internal
+    # value always rebuilds AFTER the group node exists, so no selected
+    # op is left duplicated outside its subgraph
+    unit_of = {}
+    for n in nodes:
+        g = group_of.get(id(n))
+        unit_of[id(n)] = ('g', g) if g in multi else ('n', id(n))
+    unit_members = {}
+    unit_deps = {}
+    for n in nodes:
+        u = unit_of[id(n)]
+        unit_members.setdefault(u, []).append(n)
+        for (c, _) in n.inputs:
+            uc = unit_of[id(c)]
+            if uc != u:
+                unit_deps.setdefault(u, set()).add(uc)
+
+    order = []
+    state = {}   # unit -> 1 visiting, 2 done
+
+    def visit(u):
+        st = state.get(u)
+        if st == 2:
+            return
+        if st == 1:   # grouping guarantees acyclicity; guard anyway
+            raise RuntimeError('partition produced a cyclic contraction')
+        state[u] = 1
+        for d in unit_deps.get(u, ()):
+            visit(d)
+        state[u] = 2
+        order.append(u)
+
+    for n in nodes:
+        visit(unit_of[id(n)])
+
+    entry_map = {}   # (id(old node), idx) -> (new node, idx)
+
+    def mapped(entry):
+        node, i = entry
+        return entry_map.get((id(node), i), (node, i))
+
+    created = 0
+    for u in order:
+        if u[0] == 'g':
+            g = u[1]
+            members = unit_members[u]
+            ext_in, seen = [], set()
+            for m in members:
+                for e in m.inputs:
+                    key = (id(e[0]), e[1])
+                    if group_of.get(id(e[0])) != g and key not in seen:
+                        seen.add(key)
+                        ext_in.append(e)
+            ext_out, seen_o = [], set()
+            for e in consumed_outside.get(g, []):
+                key = (id(e[0]), e[1])
+                if key not in seen_o:
+                    seen_o.add(key)
+                    ext_out.append(e)
+            op = prop.create_subgraph_operator(members, ext_in, ext_out)
+            sub = _Node(op, '%s%d' % (prop.node_name.lower().lstrip('_'),
+                                      created),
+                        attrs={}, inputs=[mapped(e) for e in ext_in],
+                        num_outputs=len(ext_out))
+            created += 1
+            for k, e in enumerate(ext_out):
+                entry_map[(id(e[0]), e[1])] = (sub, k)
+        else:
+            (n,) = unit_members[u]
+            if n.is_variable:
+                continue
+            new_inputs = [mapped(e) for e in n.inputs]
+            if any(a is not b or i != j for (a, i), (b, j) in
+                   zip(new_inputs, n.inputs)):
+                nn = _Node(n.op, n.name, attrs=dict(n.attrs),
+                           inputs=new_inputs, num_outputs=n.num_outputs)
+                nn.is_aux = n.is_aux
+                nn._extra_attrs = dict(n._extra_attrs)
+                for i in range(n.num_outputs):
+                    entry_map[(id(n), i)] = (nn, i)
+
+    return Symbol([mapped(e) for e in symbol._entries])

@@ -350,6 +350,20 @@ class Symbol:
                                 shapes[child.name] = tuple(hint)
                 in_shapes = [node_out_shapes.get(id(c), [None])[i]
                              for (c, i) in node.inputs]
+            # ops carrying their own positional parameter-shape solver
+            # (subgraph nodes: inference recurses into the inner graph)
+            pos_infer = getattr(node.op, 'infer_param_shapes', None)
+            if pos_infer is not None and any(s is None for s in in_shapes):
+                by_pos = pos_infer(in_shapes) or {}
+                for pos, (child, _) in enumerate(node.inputs):
+                    hint = by_pos.get(pos)
+                    if hint is not None and 0 not in hint and \
+                            child.is_variable and \
+                            node_out_shapes[id(child)][0] is None:
+                        node_out_shapes[id(child)] = [tuple(hint)]
+                        shapes[child.name] = tuple(hint)
+                in_shapes = [node_out_shapes.get(id(c), [None])[i]
+                             for (c, i) in node.inputs]
             if any(s is None for s in in_shapes):
                 node_out_shapes[id(node)] = [None] * node.num_outputs
                 node_out_dtypes[id(node)] = ['float32'] * node.num_outputs

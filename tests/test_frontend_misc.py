@@ -224,3 +224,43 @@ def test_contrib_autograd_grad_and_loss():
     g = mx.contrib.autograd.grad(f)
     grads2 = g(a, b)
     np.testing.assert_allclose(grads2[0].asnumpy(), [4.0])
+
+
+def test_mxdataiter_wrapper():
+    """MXDataIter compat shim forwards to the wrapped iterator
+    (reference: io.py:790)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    it = mx.io.NDArrayIter(np.arange(16, dtype=np.float32).reshape(8, 2),
+                           np.zeros(8, np.float32), batch_size=4)
+    w = mx.io.MXDataIter(it)
+    assert w.provide_data[0].shape == (4, 2)
+    batches = list(w)
+    assert len(batches) == 2 and batches[0].data[0].shape == (4, 2)
+    w.reset()
+    assert w.iter_next()
+
+
+def test_update_on_kvstore_env_default(monkeypatch):
+    """MXNET_UPDATE_ON_KVSTORE drives Trainer's default mode
+    (reference: env_var.md)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    def make():
+        net = nn.Dense(2)
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.zeros((1, 3)))
+        return gluon.Trainer(net.collect_params(), 'sgd',
+                             {'learning_rate': 0.1}, kvstore='local')
+
+    monkeypatch.delenv('MXNET_UPDATE_ON_KVSTORE', raising=False)
+    tr = make()
+    tr._init_kvstore()
+    assert tr._update_on_kvstore is False
+    monkeypatch.setenv('MXNET_UPDATE_ON_KVSTORE', '1')
+    tr = make()
+    tr._init_kvstore()
+    assert tr._update_on_kvstore is True

@@ -1,0 +1,128 @@
+"""Graph partitioning API (reference: src/operator/subgraph/ —
+SubgraphProperty/SubgraphSelector, build_subgraph.cc).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _mlp():
+    x = mx.sym.Variable('data')
+    h = mx.sym.FullyConnected(x, num_hidden=8, name='fc1')
+    r = mx.sym.Activation(h, act_type='relu', name='relu1')
+    o = mx.sym.FullyConnected(r, num_hidden=3, name='fc2')
+    return o
+
+
+def _feed(sym, shapes):
+    rs = np.random.RandomState(0)
+    feed = {}
+    args, _, _ = sym.infer_shape(**shapes)
+    for name, shp in zip(sym.list_arguments(), args):
+        feed[name] = nd.array(rs.randn(*shp).astype(np.float32))
+    return feed
+
+
+def test_partition_contracts_selected_ops():
+    sym = _mlp()
+    part = mx.subgraph.partition(sym, op_names=['FullyConnected',
+                                               'Activation'])
+    ops = [n.op.name for n in part._nodes() if not n.is_variable]
+    assert ops == ['_XLASubgraph']
+
+
+def test_partition_preserves_values():
+    sym = _mlp()
+    feed = _feed(sym, {'data': (4, 5)})
+    ref = sym.eval(**feed)
+    ref = ref[0] if isinstance(ref, list) else ref
+    part = mx.subgraph.partition(sym, op_names=['FullyConnected',
+                                               'Activation'])
+    got = part.eval(**feed)
+    got = got[0] if isinstance(got, list) else got
+    np.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), atol=1e-5)
+
+
+def test_partition_partial_selection_keeps_unselected():
+    sym = _mlp()
+    part = mx.subgraph.partition(sym, op_names=['FullyConnected'])
+    ops = [n.op.name for n in part._nodes() if not n.is_variable]
+    # relu stays outside; the two FC ops cannot merge across it (cycle)
+    assert 'Activation' in ops
+    assert ops.count('FullyConnected') + \
+        sum(1 for o in ops if o == '_XLASubgraph') >= 2
+    feed = _feed(sym, {'data': (4, 5)})
+    ref = sym.eval(**feed)[0].asnumpy()
+    got = part.eval(**feed)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_partition_through_executor_and_grad():
+    sym = _mlp()
+    part = mx.subgraph.partition(sym, op_names=['FullyConnected',
+                                               'Activation'])
+    exe = part.simple_bind(ctx=mx.cpu(), grad_req='write', data=(4, 5))
+    rs = np.random.RandomState(1)
+    for name, arr in exe.arg_dict.items():
+        arr[:] = nd.array(rs.randn(*arr.shape).astype(np.float32))
+    out = exe.forward(is_train=True)[0]
+    exe.backward(nd.ones(out.shape))
+    g = exe.grad_dict['fc1_weight'].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_partition_multi_consumer():
+    # an outside consumer of an interior value must still see it
+    x = mx.sym.Variable('data')
+    h = mx.sym.FullyConnected(x, num_hidden=4, name='fc1')
+    r = mx.sym.Activation(h, act_type='relu', name='relu1')
+    # `h` consumed both inside (relu) and outside (the add)
+    o = mx.sym.elemwise_add(r, h, name='res')
+    part = mx.subgraph.partition(o, op_names=['FullyConnected',
+                                              'Activation'])
+    feed = _feed(o, {'data': (2, 3)})
+    ref = o.eval(**feed)[0].asnumpy()
+    got = part.eval(**feed)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_selector_subclass():
+    class OnlyRelu(mx.subgraph.SubgraphSelector):
+        def select(self, node):
+            return node.op.name == 'Activation'
+
+    sym = _mlp()
+    part = mx.subgraph.partition(sym, selector=OnlyRelu())
+    ops = [n.op.name for n in part._nodes() if not n.is_variable]
+    # single-node groups don't contract
+    assert ops.count('FullyConnected') == 2 and 'Activation' in ops
+
+
+def test_partition_early_external_consumer_no_duplication():
+    """A consumer of a group-internal value that precedes the group's
+    last member must not leave the selected op duplicated outside."""
+    x = mx.sym.Variable('data')
+    a = mx.sym.FullyConnected(x, num_hidden=4, name='fc1')
+    b = mx.sym.Activation(a, act_type='relu', name='relu1')
+    u = mx.sym.negative(a, name='neg')
+    g = mx.sym.Group([u, b])
+    part = mx.subgraph.partition(g, op_names=['FullyConnected',
+                                              'Activation'])
+    ops = [n.op.name for n in part._nodes() if not n.is_variable]
+    assert ops.count('FullyConnected') == 0
+    assert ops.count('_XLASubgraph') == 1
+    feed = _feed(g, {'data': (2, 3)})
+    for r, t in zip(g.eval(**feed), part.eval(**feed)):
+        np.testing.assert_allclose(t.asnumpy(), r.asnumpy(), atol=1e-5)
+
+
+def test_partition_never_groups_rng_ops():
+    x = mx.sym.Variable('data')
+    h = mx.sym.FullyConnected(x, num_hidden=4, name='fc1')
+    d = mx.sym.Dropout(h, p=0.5, name='drop')
+    o = mx.sym.Activation(d, act_type='relu', name='relu1')
+    part = mx.subgraph.partition(o, op_names=['FullyConnected', 'Dropout',
+                                              'Activation'])
+    ops = [n.op.name for n in part._nodes() if not n.is_variable]
+    assert 'Dropout' in ops   # rng op stays outside any subgraph

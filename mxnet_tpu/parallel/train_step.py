@@ -254,6 +254,94 @@ class ParallelTrainer:
             jax.device_put(a, sh) for a, sh in zip(leaf_arrays,
                                                    leaf_shardings))
         self._data_shardings = (data_shardings, label_shardings)
+        self._step_fn = step
+        self._shardings = (repl, param_shardings, leaf_shardings,
+                           data_shardings, label_shardings)
+        self._jitted_multi = None
+
+    def _build_multi(self):
+        """One XLA program running N sequential fused steps via
+        lax.scan (N inferred from the stacked operands; jit re-keys on
+        shapes) — the launch/dispatch overhead (per-launch ~5 ms on
+        tunneled backends) amortizes across the scan. Per-step hyper
+        arrays are stacked operands, so lr schedules and Adam bias
+        correction advance exactly as in the single-step path."""
+        step = self._step_fn
+        repl, param_sh, leaf_sh, data_sh, label_sh = self._shardings
+
+        def multi(keys, hypers, param_arrays, state_leaves, xs, ys):
+            def body(carry, inp):
+                ps, ls = carry
+                key, hyper, x, y = inp
+                p2, l2, loss = step(key, hyper, ps, ls, x, y)
+                return (p2, l2), loss
+            (ps, ls), losses = jax.lax.scan(
+                body, (param_arrays, state_leaves), (keys, hypers, xs, ys))
+            return ps, ls, losses
+
+        def lead(sh):
+            return NamedSharding(sh.mesh, P(None, *sh.spec))
+
+        return jax.jit(
+            multi,
+            in_shardings=(repl, (repl, repl, repl, repl), param_sh,
+                          leaf_sh, tuple(lead(s) for s in data_sh),
+                          tuple(lead(s) for s in label_sh)),
+            out_shardings=(param_sh, leaf_sh, repl),
+            donate_argnums=(2, 3))
+
+    def step_n(self, x, y):
+        """Run one fused step per leading-dim slice of ``x``/``y`` in a
+        SINGLE compiled program; returns the per-step losses as one
+        array. Semantically identical to calling step() n times."""
+        xs = [a._data if isinstance(a, NDArray) else
+              (None if a is None else jnp.asarray(a)) for a in _as_list(x)]
+        ys = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+              for a in _as_list(y)]
+        live = [a for a in xs if a is not None]
+        if not live or not ys:
+            raise ValueError('step_n needs at least one data and one '
+                             'label array')
+        nsteps = int(live[0].shape[0])
+        if nsteps == 0:
+            raise ValueError('step_n called with a zero-length leading '
+                             '(steps) dimension')
+        if self._jitted is None:
+            self._build([None if a is None else a[0] for a in xs],
+                        [a[0] for a in ys])
+        sig = (tuple(a is None for a in xs), len(ys))
+        if sig != self._sig:
+            raise ValueError(
+                'step_n called with input signature %r but the compiled '
+                'step was built for %r — input/label arity and '
+                'None-positions must match the first call'
+                % (sig, self._sig))
+        xs = live
+        opt = self._opt
+        indices = list(range(len(self._params)))
+        hypers = []
+        for _ in range(nsteps):
+            hypers.append(self._hyper(indices, opt, advance=True))
+        stacked = tuple(onp.stack([h[k] for h in hypers])
+                        for k in range(4))
+        if self._base_key is None:
+            self._base_key = onp.asarray(_random.next_key(),
+                                         dtype=onp.uint32)
+        keys = onp.stack([
+            onp.asarray([self._base_key[0],
+                         self._base_key[1] ^
+                         onp.uint32(self.num_update + 1 + i)],
+                        dtype=onp.uint32) for i in range(nsteps)])
+        if self._jitted_multi is None:
+            self._jitted_multi = self._build_multi()
+        jitted = self._jitted_multi
+        self._param_arrays, self._state_leaves, losses = jitted(
+            keys, stacked, self._param_arrays, self._state_leaves,
+            tuple(xs), tuple(ys))
+        self.num_update += nsteps
+        for p, w in zip(self._params, self._param_arrays):
+            p.data()._data = w
+        return NDArray(losses)
 
     def _hyper(self, indices, opt, advance=True):
         """(lrs, wds, ts, rescale) scalar arrays for this step.

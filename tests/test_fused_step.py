@@ -112,3 +112,46 @@ def test_fused_states_round_trip_save_load(tmp_path):
         m2, v2 = s2[k][0], s2[k][1]
         np.testing.assert_allclose(m1.asnumpy(), m2.asnumpy(), rtol=1e-6)
         np.testing.assert_allclose(v1.asnumpy(), v2.asnumpy(), rtol=1e-6)
+
+
+def test_step_n_matches_sequential_steps():
+    """N fused steps in one scanned XLA program == N step() calls
+    (losses and final params), with per-step hyper threading."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon import nn
+
+    def make():
+        np.random.seed(0)
+        mx.random.seed(0)
+        mx.name.NameManager._current.value = mx.name.NameManager()
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation='relu'), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(3, 8, 6).astype(np.float32)
+    ys = rs.randint(0, 4, (3, 8)).astype(np.float32)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = parallel.create_mesh({'dp': 8})
+
+    net1 = make()
+    pt1 = parallel.ParallelTrainer(net1, L, 'adam',
+                                   {'learning_rate': 0.01}, mesh)
+    seq = [float(pt1.step(nd.array(xs[i]), nd.array(ys[i])).asscalar())
+           for i in range(3)]
+
+    net2 = make()
+    pt2 = parallel.ParallelTrainer(net2, L, 'adam',
+                                   {'learning_rate': 0.01}, mesh)
+    losses = pt2.step_n(nd.array(xs), nd.array(ys))
+    assert losses.shape == (3,)
+    np.testing.assert_allclose(losses.asnumpy(), seq, rtol=1e-4)
+    assert pt2.num_update == 3
+    for p1, p2 in zip(pt1._params, pt2._params):
+        np.testing.assert_allclose(p1.data().asnumpy(),
+                                   p2.data().asnumpy(),
+                                   rtol=2e-4, atol=1e-5)

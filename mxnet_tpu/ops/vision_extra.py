@@ -1,0 +1,428 @@
+"""Detection/video operator long-tail: RPN proposals, position-
+sensitive + deformable pooling, deformable convolution, correlation
+cost-volumes, contrib FFT and count-sketch (reference:
+src/operator/contrib/{proposal,multi_proposal,psroi_pooling,
+deformable_convolution,deformable_psroi_pooling,count_sketch,fft}*,
+src/operator/correlation-inl.h — the RCNN/FlowNet example stack).
+
+TPU-first shapes: everything static. Proposal keeps a fixed
+rpn_post_nms_top_n by padding with the last kept box; deformable
+sampling is bilinear gathers + one dot_general (im2col-with-offsets →
+MXU); correlation is a static loop over the displacement grid that XLA
+unrolls and fuses.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register, alias
+from .nn import _tup
+from .pallas_kernels import greedy_nms_keep
+
+__all__ = []
+
+
+def _tuple_of(v, typ=float):
+    if v is None:
+        return ()
+    if isinstance(v, str):
+        inner = v.strip('()[] ')
+        return tuple(typ(x) for x in inner.split(',') if x.strip())
+    if isinstance(v, (int, float)):
+        return (typ(v),)
+    return tuple(typ(x) for x in v)
+
+
+def _generate_anchors(feature_stride, scales, ratios):
+    """py-faster-rcnn anchor grid seed (reference: proposal-inl.h
+    GenerateAnchors): base box (0,0,stride-1,stride-1), enumerate
+    ratios then scales; returns (A, 4) corner anchors."""
+    base = onp.array([0, 0, feature_stride - 1, feature_stride - 1],
+                     dtype=onp.float64)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+    out = []
+    for r in ratios:
+        size = w * h
+        ws = onp.round(onp.sqrt(size / r))
+        hs = onp.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                        cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return onp.array(out, dtype=onp.float32)
+
+
+def _bbox_pred(boxes, deltas):
+    """Apply (dx, dy, dw, dh) deltas (reference: proposal-inl.h
+    BBoxTransformInv)."""
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1.0)
+    cy = boxes[:, 1] + 0.5 * (h - 1.0)
+    pcx = deltas[:, 0] * w + cx
+    pcy = deltas[:, 1] * h + cy
+    pw = jnp.exp(deltas[:, 2]) * w
+    ph = jnp.exp(deltas[:, 3]) * h
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)], 1)
+
+
+def _proposal_one(scores, deltas, im_info, anchors, pre_nms, post_nms,
+                  thresh, min_size, feature_stride):
+    """Proposals for ONE image. scores (A,H,W), deltas (4A,H,W)."""
+    A = anchors.shape[0]
+    H, W = scores.shape[1], scores.shape[2]
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)          # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)    # (H, W, 4)
+    all_anchors = (anchors[:, None, None, :] + shifts[None]) \
+        .reshape(-1, 4)                              # (A*H*W, 4)
+    d = deltas.reshape(A, 4, H, W).transpose(0, 2, 3, 1).reshape(-1, 4)
+    s = scores.reshape(-1)
+    boxes = _bbox_pred(all_anchors, d)
+    # clip to image (reference: height/width from im_info)
+    height, width = im_info[0], im_info[1]
+    boxes = jnp.stack([
+        jnp.clip(boxes[:, 0], 0, width - 1.0),
+        jnp.clip(boxes[:, 1], 0, height - 1.0),
+        jnp.clip(boxes[:, 2], 0, width - 1.0),
+        jnp.clip(boxes[:, 3], 0, height - 1.0)], 1)
+    ms = min_size * im_info[2]
+    keep_size = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= ms) & \
+                ((boxes[:, 3] - boxes[:, 1] + 1.0) >= ms)
+    s = jnp.where(keep_size, s, -jnp.inf)
+    k = min(int(pre_nms), boxes.shape[0])
+    top_s, top_i = jax.lax.top_k(s, k)
+    top_boxes = boxes[top_i]
+    keep = greedy_nms_keep(top_boxes, jnp.isfinite(top_s),
+                           thresh, topk=k)
+    # stable-compact the kept boxes to the front, pad with the last kept
+    order = jnp.argsort(jnp.where(keep, jnp.arange(k), k).astype(jnp.int32))
+    n_keep = jnp.sum(keep.astype(jnp.int32))
+    take = jnp.minimum(jnp.arange(post_nms), jnp.maximum(n_keep - 1, 0))
+    sel = order[take]
+    return top_boxes[sel], top_s[sel]
+
+
+@register('_contrib_Proposal', num_inputs=3, num_outputs=2,
+          aliases=('Proposal',))
+def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal generation (reference: contrib/proposal.cc).
+
+    cls_prob (N, 2A, H, W), bbox_pred (N, 4A, H, W), im_info (N, 3) ->
+    rois (N*post_nms, 5) [+ scores (N*post_nms, 1)]."""
+    sc = _tuple_of(scales)
+    ra = _tuple_of(ratios)
+    anchors = jnp.asarray(_generate_anchors(int(feature_stride), sc, ra))
+    A = anchors.shape[0]
+    n = cls_prob.shape[0]
+    rois_all, scores_all = [], []
+    for i in range(n):
+        fg = cls_prob[i, A:, :, :]
+        b, s = _proposal_one(fg, bbox_pred[i], im_info[i], anchors,
+                             rpn_pre_nms_top_n, int(rpn_post_nms_top_n),
+                             float(threshold), float(rpn_min_size),
+                             float(feature_stride))
+        idx = jnp.full((b.shape[0], 1), float(i), dtype=b.dtype)
+        rois_all.append(jnp.concatenate([idx, b], axis=1))
+        scores_all.append(s[:, None])
+    rois = jnp.concatenate(rois_all, axis=0)
+    scr = jnp.concatenate(scores_all, axis=0)
+    return rois, scr
+
+
+alias('_contrib_Proposal', '_contrib_MultiProposal', 'MultiProposal')
+alias('make_loss', 'MakeLoss')
+alias('pick', 'choose_element_0index')
+
+
+def _bilinear_at(img, y, x):
+    """img (C, H, W) sampled at float coords y/x (...,) -> (C, ...)
+    with zero padding outside."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+    pieces = 0.
+    for dy, wyy in ((0, 1 - wy), (1, wy)):
+        for dx, wxx in ((0, 1 - wx), (1, wx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            v = img[:, yi, xi]
+            pieces = pieces + v * (wyy * wxx * inb)[None]
+    return pieces
+
+
+@register('_contrib_PSROIPooling', num_inputs=2,
+          aliases=('PSROIPooling',))
+def psroi_pooling(data, rois, *, spatial_scale=1.0, output_dim=None,
+                  pooled_size=None, group_size=0):
+    """Position-sensitive ROI pooling (reference:
+    contrib/psroi_pooling.cc; R-FCN). data channels =
+    output_dim * group^2; each (ph, pw) bin average-pools its region
+    from its own channel group."""
+    p = int(pooled_size)
+    g = int(group_size) if group_size else p
+    od = int(output_dim)
+    n_roi = rois.shape[0]
+    C, H, W = data.shape[1], data.shape[2], data.shape[3]
+    samples = 4   # fixed sub-samples per bin axis (average-pool grid)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        # reference rounds the roi and enforces min size 0.1
+        x1, y1 = jnp.round(x1), jnp.round(y1)
+        w = jnp.maximum(jnp.round(x2) + 1 - x1, 0.1)
+        h = jnp.maximum(jnp.round(y2) + 1 - y1, 0.1)
+        img = data[b]
+        bins = []
+        off = (jnp.arange(samples, dtype=jnp.float32) + 0.5) / samples
+        for ph in range(p):
+            for pw in range(p):
+                ys = y1 + (ph + off) / p * h            # (samples,)
+                xs = x1 + (pw + off) / p * w
+                yy, xx = jnp.meshgrid(ys, xs, indexing='ij')
+                vals = _bilinear_at(img, yy, xx)        # (C, s, s)
+                bin_mean = vals.reshape(C, -1).mean(axis=1)
+                gh = min(ph * g // p, g - 1)
+                gw = min(pw * g // p, g - 1)
+                chans = jax.lax.dynamic_slice_in_dim(
+                    bin_mean, (gh * g + gw) * od, od)
+                bins.append(chans)
+        out = jnp.stack(bins, axis=1).reshape(od, p, p)
+        return out
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+@register('_contrib_DeformableConvolution', num_inputs=-1,
+          aliases=('DeformableConvolution',))
+def deformable_convolution(args, *, kernel=None, stride=None, dilate=None,
+                           pad=None, num_filter=None, num_group=1,
+                           num_deformable_group=1, workspace=1024,
+                           no_bias=False, layout=None):
+    """Deformable conv v1 (reference: contrib/deformable_convolution.cc):
+    bilinear-sample the input at kernel positions + learned offsets
+    (im2col-with-offsets), then one dot_general onto the MXU."""
+    data, offset, weight = args[0], args[1], args[2]
+    bias = None if no_bias else args[3]
+    kh, kw = _tup(kernel, 2)
+    sh, sw = _tup(stride or 1, 2)
+    dh, dw = _tup(dilate or 1, 2)
+    ph, pw = _tup(pad or 0, 2)
+    N, C, H, W = data.shape
+    G = int(num_deformable_group)
+    OH = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    OW = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    base_y = (jnp.arange(OH) * sh - ph).astype(jnp.float32)
+    base_x = (jnp.arange(OW) * sw - pw).astype(jnp.float32)
+
+    def one_image(img, off):
+        # off: (2*G*kh*kw, OH, OW)
+        cols = []
+        per_g = C // G
+        for gdx in range(G):
+            img_g = img[gdx * per_g:(gdx + 1) * per_g]
+            for ki in range(kh):
+                for kj in range(kw):
+                    k_lin = ki * kw + kj
+                    oy = off[((gdx * kh * kw) + k_lin) * 2]
+                    ox = off[((gdx * kh * kw) + k_lin) * 2 + 1]
+                    yy = base_y[:, None] + ki * dh + oy
+                    xx = base_x[None, :] + kj * dw + ox
+                    cols.append(_bilinear_at(img_g, yy, xx))
+        # (G*kh*kw entries of (per_g, OH, OW)) -> (C*kh*kw, OH*OW)
+        # ordered [g][k][c] -> reorder to [g][c][k] to match the weight
+        stacked = jnp.stack(cols).reshape(G, kh * kw, per_g, OH * OW)
+        return stacked.transpose(0, 2, 1, 3).reshape(
+            C * kh * kw, OH * OW)
+
+    cols = jax.vmap(one_image)(data.astype(jnp.float32),
+                               offset.astype(jnp.float32))
+    wmat = weight.reshape(int(num_filter), -1).astype(jnp.float32)
+    ng = int(num_group)
+    if ng == 1:
+        out = jnp.einsum('fk,nkp->nfp', wmat, cols)
+    else:
+        fpg = int(num_filter) // ng
+        kpg = cols.shape[1] // ng
+        out = jnp.concatenate(
+            [jnp.einsum('fk,nkp->nfp',
+                        wmat[g * fpg:(g + 1) * fpg, :],
+                        cols[:, g * kpg:(g + 1) * kpg, :])
+             for g in range(ng)], axis=1)
+    out = out.reshape(N, int(num_filter), OH, OW).astype(data.dtype)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register('_contrib_DeformablePSROIPooling', num_inputs=3,
+          num_outputs=2, aliases=('DeformablePSROIPooling',))
+def deformable_psroi_pooling(data, rois, trans, *, spatial_scale=1.0,
+                             output_dim=None, group_size=None,
+                             pooled_size=None, part_size=0,
+                             sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """Deformable position-sensitive ROI pooling (reference:
+    contrib/deformable_psroi_pooling.cc; deformable R-FCN). Each bin
+    shifts by a learned normalized offset from ``trans`` before
+    sampling. Returns (pooled, top_count) like the reference."""
+    p = int(pooled_size)
+    g = int(group_size)
+    od = int(output_dim)
+    part = int(part_size) if part_size else p
+    spp = max(int(sample_per_part), 1)
+    C = data.shape[1]
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        w = jnp.maximum(x2 - x1, 0.1)
+        h = jnp.maximum(y2 - y1, 0.1)
+        img = data[b]
+        bins = []
+        off = (jnp.arange(spp, dtype=jnp.float32) + 0.5) / spp
+        for ph in range(p):
+            for pw in range(p):
+                if no_trans:
+                    dx = dy = 0.0
+                else:
+                    pj = min(pw * part // p, part - 1)
+                    pi = min(ph * part // p, part - 1)
+                    cls = 0   # class-agnostic trans (2*ncls, part, part)
+                    dy = tr[2 * cls, pi, pj] * trans_std * h
+                    dx = tr[2 * cls + 1, pi, pj] * trans_std * w
+                ys = y1 + (ph + off) / p * h + dy
+                xs = x1 + (pw + off) / p * w + dx
+                yy, xx = jnp.meshgrid(ys, xs, indexing='ij')
+                vals = _bilinear_at(img, yy, xx)
+                bin_mean = vals.reshape(C, -1).mean(axis=1)
+                gh = min(ph * g // p, g - 1)
+                gw = min(pw * g // p, g - 1)
+                chans = jax.lax.dynamic_slice_in_dim(
+                    bin_mean, (gh * g + gw) * od, od)
+                bins.append(chans)
+        out = jnp.stack(bins, axis=1).reshape(od, p, p)
+        cnt = jnp.full((od, p, p), float(spp * spp), dtype=out.dtype)
+        return out, cnt
+
+    tr = trans if not no_trans else \
+        jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
+    return jax.vmap(one_roi)(rois.astype(jnp.float32),
+                             tr.astype(jnp.float32))
+
+
+@register('Correlation', num_inputs=2, num_outputs=1)
+def correlation(data1, data2, *, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation cost-volume (reference: correlation-inl.h).
+    Static python loop over the (2r+1)^2 displacement grid; XLA unrolls
+    and fuses the shifted products."""
+    ks, md = int(kernel_size), int(max_displacement)
+    s1, s2, ps = int(stride1), int(stride2), int(pad_size)
+    kr = (ks - 1) // 2
+    border = md + kr
+    N, C, H, W = data1.shape
+    Hp, Wp = H + 2 * ps, W + 2 * ps
+    top_h = -(-(Hp - 2 * border) // s1)
+    top_w = -(-(Wp - 2 * border) // s1)
+    rad = md // s2
+    grid = 2 * rad + 1
+    a = jnp.pad(data1.astype(jnp.float32),
+                ((0, 0), (0, 0), (ps, ps), (ps, ps)))
+    bb = jnp.pad(data2.astype(jnp.float32),
+                 ((0, 0), (0, 0), (ps, ps), (ps, ps)))
+    ys = border + jnp.arange(top_h) * s1
+    xs = border + jnp.arange(top_w) * s1
+
+    def patch_sum(x, dy, dx):
+        """sum over the kernel window centered at (ys+dy, xs+dx)."""
+        acc = 0.
+        for ky in range(-kr, kr + 1):
+            for kx in range(-kr, kr + 1):
+                acc = acc + x[:, :, :, None, :][..., 0][:, :,
+                    (ys + dy + ky)][:, :, :, (xs + dx + kx)]
+        return acc
+
+    outs = []
+    for dy in range(-rad, rad + 1):
+        for dx in range(-rad, rad + 1):
+            if is_multiply:
+                prod = 0.
+                for ky in range(-kr, kr + 1):
+                    for kx in range(-kr, kr + 1):
+                        a_s = a[:, :, (ys + ky)][:, :, :, (xs + kx)]
+                        b_s = bb[:, :, (ys + dy * s2 + ky)][
+                            :, :, :, (xs + dx * s2 + kx)]
+                        prod = prod + a_s * b_s
+                outs.append(prod.sum(axis=1))
+            else:
+                diff = 0.
+                for ky in range(-kr, kr + 1):
+                    for kx in range(-kr, kr + 1):
+                        a_s = a[:, :, (ys + ky)][:, :, :, (xs + kx)]
+                        b_s = bb[:, :, (ys + dy * s2 + ky)][
+                            :, :, :, (xs + dx * s2 + kx)]
+                        diff = diff + jnp.abs(a_s - b_s)
+                outs.append(diff.sum(axis=1))
+    norm = float(ks * ks * C)
+    out = jnp.stack(outs, axis=1) / norm
+    assert out.shape[1] == grid * grid
+    return out.astype(data1.dtype)
+
+
+@register('_contrib_fft', num_inputs=1, aliases=('fft',))
+def contrib_fft(data, *, compute_size=128):
+    """Real -> complex FFT over the last axis, interleaved re/im output
+    with 2x the width (reference: contrib/fft-inl.h layout)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register('_contrib_ifft', num_inputs=1, aliases=('ifft',))
+def contrib_ifft(data, *, compute_size=128):
+    """Interleaved re/im -> real inverse FFT (reference:
+    contrib/fft-inl.h: output is the real part scaled by 1/n... the
+    reference returns the unnormalized-by-n inverse's real part; jnp
+    ifft normalizes by n, matching the reference python tests)."""
+    d = data.astype(jnp.float32)
+    n = d.shape[-1] // 2
+    c = d.reshape(d.shape[:-1] + (n, 2))
+    comp = c[..., 0] + 1j * c[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * n
+
+
+@register('_contrib_count_sketch', num_inputs=3)
+def count_sketch(data, h, s, *, out_dim=None,
+                 processing_batch_size=32):
+    """Count sketch projection (reference: contrib/count_sketch.cc —
+    compact bilinear pooling): out[..., h[i]] += s[i] * data[..., i]."""
+    od = int(out_dim)
+    hi = h.reshape(-1).astype(jnp.int32)
+    si = s.reshape(-1).astype(data.dtype)
+    contrib_vals = data * si[None, :]
+    out = jnp.zeros(data.shape[:-1] + (od,), dtype=data.dtype)
+    return out.at[..., hi].add(contrib_vals)

@@ -264,3 +264,25 @@ def test_update_on_kvstore_env_default(monkeypatch):
     tr = make()
     tr._init_kvstore()
     assert tr._update_on_kvstore is True
+
+
+def test_profiler_chrome_trace(tmp_path):
+    """profiler set_config/set_state/dump produce a chrome-trace JSON
+    (reference: src/profiler/profiler.h:88 chrome://tracing format)."""
+    import json
+    import mxnet_tpu as mx
+    f = tmp_path / 'trace.json'
+    mx.profiler.set_config(filename=str(f))
+    mx.profiler.set_state('run')
+    with mx.profiler.Task(name='work'):
+        mx.nd.ones((4, 4)).asnumpy()
+    mx.profiler.set_state('stop')
+    # dumps() = aggregate table (reference: profiler.dumps)
+    table = mx.profiler.dumps()
+    assert 'work' in table
+    # dump() = chrome trace JSON (reference: chrome://tracing format)
+    mx.profiler.dump()
+    assert f.exists()
+    events = json.loads(f.read_text())
+    events = events.get('traceEvents', events)
+    assert any(e.get('name') == 'work' for e in events)

@@ -69,16 +69,31 @@ class Context:
 
     # -- XLA resolution ----------------------------------------------------
     def jax_device(self):
-        """Resolve this context to a concrete jax device."""
+        """Resolve this context to a concrete jax device.
+
+        Invalid device ids raise, matching the reference's engine behavior
+        on a bad dev_id (CUDA error surfaced at first use) rather than
+        silently clamping to another device.
+        """
         if self.device_type.startswith('cpu'):
             try:
-                return jax.devices('cpu')[min(self.device_id, len(jax.devices('cpu')) - 1)]
+                devs = jax.devices('cpu')
             except RuntimeError:
-                # no cpu platform registered (rare) — fall back to default
+                # no cpu platform registered (JAX_PLATFORMS=tpu) — fall
+                # back to the default backend rather than crash host-side
+                # staging paths
                 return jax.devices()[0]
+            if self.device_id >= len(devs):
+                raise ValueError(
+                    '%s: only %d cpu device(s) available' % (self, len(devs)))
+            return devs[self.device_id]
         devs = jax.devices()
         accel = [d for d in devs if d.platform != 'cpu'] or devs
-        return accel[self.device_id % len(accel)]
+        if self.device_id >= len(accel):
+            raise ValueError(
+                '%s: only %d accelerator device(s) available (platform=%s)'
+                % (self, len(accel), accel[0].platform if accel else 'none'))
+        return accel[self.device_id]
 
     def empty_cache(self):
         """Reference parity: Context.empty_cache (pooled GPU memory).

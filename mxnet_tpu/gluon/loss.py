@@ -1,12 +1,17 @@
-"""Gluon losses.
+"""Gluon loss zoo.
 
-Reference parity: python/mxnet/gluon/loss.py:105-753 (L2/L1/SigmoidBCE/
-SoftmaxCE/KLDiv/CTC/Huber/Hinge/SquaredHinge/Logistic/Triplet/PoissonNLL/
-CosineEmbedding).
+Reference parity: python/mxnet/gluon/loss.py:105-753 — same classes,
+constructor signatures and numerics (L2/L1/SigmoidBCE/SoftmaxCE/KLDiv/
+CTC/Huber/Hinge/SquaredHinge/Logistic/Triplet/PoissonNLL/
+CosineEmbedding), reimplemented around a shared reduction pipeline:
+every loss computes an elementwise cost and hands it to
+``Loss._reduce``, which applies sample weights, the scalar loss weight,
+and the mean over all non-batch axes in one place. Under hybridize the
+whole pipeline traces into a single fused XLA computation.
 """
 from __future__ import annotations
 
-import numpy as onp
+import math
 
 from .block import HybridBlock
 
@@ -17,293 +22,300 @@ __all__ = ['Loss', 'L2Loss', 'L1Loss', 'SigmoidBinaryCrossEntropyLoss',
            'PoissonNLLLoss', 'CosineEmbeddingLoss']
 
 
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    """Apply weighting to loss (reference: loss.py:31)."""
-    if sample_weight is not None:
-        loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        assert isinstance(weight, (float, int)), 'weight must be a number'
-        loss = loss * weight
-    return loss
+def _match_shape(F, arr, like):
+    """View ``arr`` with ``like``'s shape (labels arrive as (B,) or
+    (B,1) interchangeably; reference _reshape_like)."""
+    return arr.reshape(like.shape)
 
 
-def _reshape_like(F, x, y):
-    return x.reshape(y.shape)
+def _softplus(F, x):
+    """log(1 + exp(x)), the stable building block of the logit losses."""
+    return F.Activation(x, act_type='softrelu')
 
 
 class Loss(HybridBlock):
-    """Base class for loss (reference: loss.py:54)."""
+    """Common base: holds the scalar weight and batch axis, owns the
+    weighting+reduction pipeline (reference: loss.py:54)."""
 
     def __init__(self, weight, batch_axis, **kwargs):
         super().__init__(**kwargs)
-        self._weight = weight
-        self._batch_axis = batch_axis
+        self._weight, self._batch_axis = weight, batch_axis
 
     def __repr__(self):
-        s = '{name}(batch_axis={_batch_axis}, w={_weight})'
-        return s.format(name=self.__class__.__name__, **self.__dict__)
+        return '%s(batch_axis=%s, w=%s)' % (
+            type(self).__name__, self._batch_axis, self._weight)
+
+    def _reduce(self, F, cost, sample_weight=None, scale=None, mean=True):
+        """sample_weight ⊙ cost, × scalar weight, mean over non-batch
+        axes. ``scale`` overrides ``self._weight`` (L2 folds its ½ in).
+        """
+        if sample_weight is not None:
+            cost = F.broadcast_mul(cost, sample_weight)
+        w = self._weight if scale is None else scale
+        if w is not None:
+            if not isinstance(w, (int, float)):
+                raise AssertionError('loss weight must be a number')
+            cost = cost * w
+        if not mean:
+            return cost
+        return F.mean(cost, axis=self._batch_axis, exclude=True)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
 
 class L2Loss(Loss):
-    """0.5 * (pred - label)^2, mean over non-batch axes (reference: loss.py:105)."""
+    """½‖pred − label‖², per-sample mean (reference: loss.py:105)."""
 
     def __init__(self, weight=1., batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(label - pred)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        err = pred - _match_shape(F, label, pred)
+        half = None if self._weight is None else self._weight / 2
+        return self._reduce(F, F.square(err), sample_weight, scale=half)
 
 
 class L1Loss(Loss):
-    """|pred - label| (reference: loss.py L1Loss)."""
+    """‖pred − label‖₁, per-sample mean (reference: loss.py L1Loss)."""
 
     def __init__(self, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        err = pred - _match_shape(F, label, pred)
+        return self._reduce(F, F.abs(err), sample_weight)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
-    """BCE with optional logits input (reference: loss.py:199)."""
+    """BCE over logits (default) or probabilities (reference:
+    loss.py:199). Logit path uses the max(x,0) − xz + softplus(−|x|)
+    form; ``pos_weight`` rescales the positive-class term."""
 
-    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_sigmoid = from_sigmoid
 
+    def _logit_bce(self, F, z, y, pos_weight):
+        stable_sp = _softplus(F, -F.abs(z))
+        if pos_weight is None:
+            return F.relu(z) - z * y + stable_sp
+        lw = 1 + F.broadcast_mul(pos_weight - 1, y)
+        return z - z * y + lw * (stable_sp + F.relu(-z))
+
+    def _prob_bce(self, F, p, y, pos_weight):
+        tiny = 1e-12
+        pos = F.log(p + tiny) * y
+        neg = F.log(1. - p + tiny) * (1. - y)
+        if pos_weight is not None:
+            pos = F.broadcast_mul(pos, pos_weight)
+        return -(pos + neg)
+
     def hybrid_forward(self, F, pred, label, sample_weight=None,
                        pos_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            if pos_weight is None:
-                # max(x,0) - x*z + log(1+exp(-|x|))
-                loss = F.relu(pred) - pred * label + \
-                    F.Activation(-F.abs(pred), act_type='softrelu')
-            else:
-                log_weight = 1 + F.broadcast_mul(pos_weight - 1, label)
-                loss = pred - pred * label + log_weight * \
-                    (F.Activation(-F.abs(pred), act_type='softrelu') +
-                     F.relu(-pred))
-        else:
-            eps = 1e-12
-            if pos_weight is None:
-                loss = -(F.log(pred + eps) * label +
-                         F.log(1. - pred + eps) * (1. - label))
-            else:
-                loss = -(F.broadcast_mul(F.log(pred + eps) * label, pos_weight)
-                         + F.log(1. - pred + eps) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        label = _match_shape(F, label, pred)
+        kernel = self._prob_bce if self._from_sigmoid else self._logit_bce
+        return self._reduce(F, kernel(F, pred, label, pos_weight),
+                            sample_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """Softmax cross entropy (reference: loss.py:279)."""
+    """Cross entropy after an (optional) internal log-softmax
+    (reference: loss.py:279). ``sparse_label`` picks the target class's
+    log-probability; dense labels dot against the full distribution."""
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._axis = axis
-        self._sparse_label = sparse_label
-        self._from_logits = from_logits
+        self._axis, self._sparse_label, self._from_logits = (
+            axis, sparse_label, from_logits)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            nll = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            dense = _match_shape(F, label, logp)
+            nll = -F.sum(logp * dense, axis=self._axis, keepdims=True)
+        return self._reduce(F, nll, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
 class KLDivLoss(Loss):
-    """Kullback-Leibler divergence (reference: loss.py KLDivLoss)."""
+    """Σ label·(log label − log pred) (reference: loss.py KLDivLoss)."""
 
-    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
-                 **kwargs):
+    def __init__(self, from_logits=True, axis=-1, weight=None,
+                 batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._from_logits = from_logits
-        self._axis = axis
+        self._from_logits, self._axis = from_logits, axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
+        div = label * (F.log(label + 1e-12) - logp)
+        return self._reduce(F, div, sample_weight)
 
 
 class CTCLoss(Loss):
-    """Connectionist Temporal Classification loss (reference: loss.py:404)."""
+    """Connectionist Temporal Classification (reference: loss.py:404).
 
-    def __init__(self, layout='NTC', label_layout='NT', weight=None, **kwargs):
-        assert layout in ['NTC', 'TNC'], \
-            'Only layouts NTC and TNC are supported, got %s' % layout
-        assert label_layout in ['NT', 'TN'], \
-            'Only label layouts NT and TN are supported, got %s' % label_layout
-        self._layout = layout
-        self._label_layout = label_layout
-        batch_axis = label_layout.find('N')
-        super().__init__(weight, batch_axis, **kwargs)
+    Accepts activations in NTC or TNC layout and labels in NT or TN;
+    internally everything is normalised to the TNC/NT convention the
+    CTCLoss op expects, with the blank as the last class."""
+
+    def __init__(self, layout='NTC', label_layout='NT', weight=None,
+                 **kwargs):
+        if layout not in ('NTC', 'TNC'):
+            raise AssertionError(
+                'Only layouts NTC and TNC are supported, got %s' % layout)
+        if label_layout not in ('NT', 'TN'):
+            raise AssertionError(
+                'Only label layouts NT and TN are supported, got %s'
+                % label_layout)
+        self._layout, self._label_layout = layout, label_layout
+        super().__init__(weight, label_layout.index('N'), **kwargs)
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
-        if self._layout == 'NTC':
+        if self._layout == 'NTC':        # op wants time-major
             pred = F.swapaxes(pred, dim1=0, dim2=1)
-        if self._batch_axis == 1:
+        if self._label_layout == 'TN':
             label = F.swapaxes(label, dim1=0, dim2=1)
-        loss = F.CTCLoss(pred, label, pred_lengths, label_lengths,
-                         use_data_lengths=pred_lengths is not None,
-                         use_label_lengths=label_lengths is not None,
-                         blank_label='last')
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        nll = F.CTCLoss(pred, label, pred_lengths, label_lengths,
+                        use_data_lengths=pred_lengths is not None,
+                        use_label_lengths=label_lengths is not None,
+                        blank_label='last')
+        return self._reduce(F, nll, sample_weight, mean=False)
 
 
 class HuberLoss(Loss):
-    """Smoothed L1 loss (reference: loss.py HuberLoss)."""
+    """Quadratic inside ±rho, linear outside (reference: loss.py
+    HuberLoss)."""
 
     def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._rho = rho
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        r = F.abs(pred - _match_shape(F, label, pred))
+        quad = F.square(r) * (0.5 / self._rho)
+        lin = r - 0.5 * self._rho
+        return self._reduce(F, F.where(r > self._rho, lin, quad),
+                            sample_weight)
 
 
 class HingeLoss(Loss):
-    """max(0, margin - pred*label) (reference: loss.py HingeLoss)."""
+    """relu(margin − pred·label), labels in {−1, 1} (reference:
+    loss.py HingeLoss)."""
 
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        gap = self._margin - pred * _match_shape(F, label, pred)
+        return self._reduce(F, F.relu(gap), sample_weight)
 
 
 class SquaredHingeLoss(Loss):
-    """max(0, margin - pred*label)^2 (reference: loss.py SquaredHingeLoss)."""
+    """relu(margin − pred·label)² (reference: loss.py
+    SquaredHingeLoss)."""
 
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        gap = self._margin - pred * _match_shape(F, label, pred)
+        return self._reduce(F, F.square(F.relu(gap)), sample_weight)
 
 
 class LogisticLoss(Loss):
-    """log(1 + exp(-pred*label)) (reference: loss.py LogisticLoss)."""
+    """log(1 + exp(−pred·label)) via the stable BCE form (reference:
+    loss.py LogisticLoss). ``signed`` labels are in {−1,1}, ``binary``
+    in {0,1}."""
 
     def __init__(self, weight=None, batch_axis=0, label_format='signed',
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._label_format = label_format
-        if self._label_format not in ['signed', 'binary']:
+        if label_format not in ('signed', 'binary'):
             raise ValueError('label_format can only be signed or binary, '
                              'recieved %s.' % label_format)
+        self._label_format = label_format
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
+        y = _match_shape(F, label, pred)
         if self._label_format == 'signed':
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + \
-            F.Activation(-F.abs(pred), act_type='softrelu')
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            y = (y + 1.0) / 2.0          # map {-1,1} -> {0,1}
+        cost = F.relu(pred) - pred * y + _softplus(F, -F.abs(pred))
+        return self._reduce(F, cost, sample_weight)
 
 
 class TripletLoss(Loss):
-    """max(0, |p-pos|^2 - |p-neg|^2 + margin) (reference: loss.py)."""
+    """relu(‖a−pos‖² − ‖a−neg‖² + margin) (reference: loss.py
+    TripletLoss)."""
 
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, pred, positive, negative):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, None)
+        d_pos = F.square(_match_shape(F, positive, pred) - pred)
+        d_neg = F.square(_match_shape(F, negative, pred) - pred)
+        gap = F.sum(d_pos - d_neg, axis=self._batch_axis, exclude=True)
+        return self._reduce(F, F.relu(gap + self._margin), mean=False)
 
 
 class PoissonNLLLoss(Loss):
-    """Poisson negative log likelihood (reference: loss.py PoissonNLLLoss)."""
+    """Poisson negative log likelihood; ``compute_full`` adds the
+    Stirling approximation of log(target!) for targets > 1 (reference:
+    loss.py PoissonNLLLoss). Reduces to a scalar mean."""
 
     def __init__(self, weight=None, from_logits=True, batch_axis=0,
                  compute_full=False, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._from_logits = from_logits
-        self._compute_full = compute_full
+        self._from_logits, self._compute_full = from_logits, compute_full
 
-    def hybrid_forward(self, F, pred, target, sample_weight=None, epsilon=1e-08):
-        target = _reshape_like(F, target, pred)
+    def hybrid_forward(self, F, pred, target, sample_weight=None,
+                       epsilon=1e-08):
+        t = _match_shape(F, target, pred)
         if self._from_logits:
-            loss = F.exp(pred) - target * pred
+            nll = F.exp(pred) - t * pred
         else:
-            loss = pred - target * F.log(pred + epsilon)
+            nll = pred - t * F.log(pred + epsilon)
         if self._compute_full:
-            stirling_factor = target * F.log(target) - target + \
-                0.5 * F.log(2 * target * onp.pi)
-            mask = (target._data > 1) if hasattr(target, '_data') else (target > 1)
-            stirling_factor = F.where(target > 1, stirling_factor,
-                                      F.zeros_like(stirling_factor))
-            loss = loss + stirling_factor
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss)
+            stirling = t * F.log(t) - t + 0.5 * F.log(2 * math.pi * t)
+            nll = nll + F.where(t > 1, stirling, F.zeros_like(stirling))
+        return F.mean(self._reduce(F, nll, sample_weight, mean=False))
 
 
 class CosineEmbeddingLoss(Loss):
-    """Cosine distance loss between input vectors (reference: loss.py)."""
+    """1 − cos(x₁,x₂) for positive pairs, relu(cos − margin) for
+    negative ones (reference: loss.py CosineEmbeddingLoss)."""
 
     def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
-        input1 = _reshape_like(F, input1, input2)
-        cos_sim = self._cosine_similarity(F, input1, input2)
-        label = label.reshape((-1, 1))
-        z_array = F.zeros((1, 1))
-        loss = F.where(label == 1, 1.0 - cos_sim,
-                       F.broadcast_maximum(z_array, cos_sim - self._margin))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    @staticmethod
+    def _cos_sim(F, a, b):
+        dot = F.sum(a * b, axis=-1).reshape((-1, 1))
+        na = F.norm(a, axis=-1).reshape((-1, 1))
+        nb = F.norm(b, axis=-1).reshape((-1, 1))
+        floor = F.full((1, 1), 1e-12)
+        return dot / F.broadcast_maximum(na * nb, floor)
 
-    def _cosine_similarity(self, F, x, y, axis=-1):
-        x_norm = F.norm(x, axis=axis).reshape((-1, 1))
-        y_norm = F.norm(y, axis=axis).reshape((-1, 1))
-        x_dot_y = F.sum(x * y, axis=axis).reshape((-1, 1))
-        eps_arr = F.full((1, 1), 1e-12)
-        return x_dot_y / F.broadcast_maximum(x_norm * y_norm, eps_arr)
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        sim = self._cos_sim(F, _match_shape(F, input1, input2), input2)
+        y = label.reshape((-1, 1))
+        zero = F.zeros((1, 1))
+        cost = F.where(y == 1, 1.0 - sim,
+                       F.broadcast_maximum(zero, sim - self._margin))
+        return self._reduce(F, cost, sample_weight)

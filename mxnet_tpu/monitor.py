@@ -1,8 +1,11 @@
-"""Monitor: per-op output statistics taps.
+"""Debugging taps over executor outputs, weights and aux states.
 
-Reference parity: python/mxnet/monitor.py — installs a callback on the
-executor that records output stats every `interval` batches (C side:
-graph_executor.cc:173 SetMonitorCallback).
+Reference parity: python/mxnet/monitor.py (Monitor class; the C side
+installs the tap via graph_executor.cc:173 SetMonitorCallback). Same
+surface — ``Monitor(interval, stat_func, pattern, sort)``, ``install``,
+``tic``/``toc``/``toc_print`` — implemented over this repo's pure
+executor: the tap fires as named intermediates are materialised during
+the traced forward, so stats are exact values, not engine-race snapshots.
 """
 from __future__ import annotations
 
@@ -14,76 +17,91 @@ from .ndarray import NDArray
 __all__ = ['Monitor']
 
 
+def _default_stat(x):
+    """RMS magnitude |x|_2 / sqrt(size) — the reference's asum_stat."""
+    return x.norm() / (x.size ** 0.5)
+
+
+def _render(value):
+    """Format one stat value (NDArray or list of NDArrays) as text."""
+    items = value if isinstance(value, list) else [value]
+    parts = []
+    for v in items:
+        if not isinstance(v, NDArray):
+            raise TypeError('stat_func must return NDArray(s), got %r'
+                            % type(v))
+        scalarish = v.shape in ((), (1,))
+        parts.append(str(v.asscalar() if scalarish else v.asnumpy()))
+    return '\t'.join(parts) + '\t'
+
+
 class Monitor:
-    """Monitor outputs, weights, and gradients for debugging."""
+    """Records a statistic of matching arrays every ``interval`` batches.
+
+    Parameters
+    ----------
+    interval : int
+        Collect on batches where ``step % interval == 0``.
+    stat_func : callable, optional
+        NDArray -> NDArray (or list thereof). Defaults to RMS magnitude.
+    pattern : str
+        Regex over tensor names; only matches are recorded.
+    sort : bool
+        Sort the drained records by tensor name.
+    """
 
     def __init__(self, interval, stat_func=None, pattern='.*', sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                """Returns |x|/size(x)."""
-                return x.norm() / (x.size ** 0.5)
-            stat_func = asum_stat
-        self.stat_func = stat_func
-        self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
-        self.sort = sort
+        self.interval = int(interval)
+        self.stat_func = stat_func or _default_stat
+        self.sort = bool(sort)
+        self._pattern = re.compile(pattern)
+        self._window_open = False
+        self._batch = 0
+        self._records = []
+        self._executors = []
 
-        def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
-        self.stat_helper = stat_helper
+    # the executor calls this with (name, array) for each output it
+    # materialises while a collection window is open
+    def stat_helper(self, name, array):
+        if self._window_open and self._pattern.match(name):
+            self._records.append((self._batch, name, self.stat_func(array)))
 
     def install(self, exe):
-        """Install the monitor tap on an executor."""
+        """Attach the tap to an executor (Module.install_monitor calls
+        this for every bound executor)."""
         exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
+        self._executors.append(exe)
 
     def tic(self):
-        """Start collecting stats for the current batch."""
-        if self.step % self.interval == 0:
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        """Open a collection window if this batch is on the interval."""
+        if self._batch % self.interval == 0:
+            self._records = []
+            self._window_open = True
+        self._batch += 1
+
+    def _sweep_params(self):
+        """Record weights/aux of every installed executor at toc time
+        (outputs stream in via stat_helper; params are polled here)."""
+        for exe in self._executors:
+            for table in (exe.arg_dict, exe.aux_dict):
+                for name, array in table.items():
+                    if self._pattern.match(name):
+                        self._records.append(
+                            (self._batch, name, self.stat_func(array)))
 
     def toc(self):
-        """End collecting, return results [(step, name, stat)]."""
-        if not self.activated:
+        """Close the window; return [(step, name, formatted_stat)]."""
+        if not self._window_open:
             return []
-        self.activated = False
-        for exe in self.exes:
-            for name, array in exe.arg_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
-            for name, array in exe.aux_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ''
-            for v in v_list:
-                assert isinstance(v, NDArray)
-                if v.shape == (1,) or v.shape == ():
-                    s += str(v.asscalar()) + '\t'
-                else:
-                    s += str(v.asnumpy()) + '\t'
-            res.append((n, k, s))
-        self.queue = []
-        return res
+        self._window_open = False
+        self._sweep_params()
+        records = sorted(self._records, key=lambda r: r[1]) if self.sort \
+            else list(self._records)
+        self._records = []
+        return [(step, name, _render(value))
+                for step, name, value in records]
 
     def toc_print(self):
-        """End collecting and log results."""
-        res = self.toc()
-        for n, k, v in res:
-            logging.info('Batch: {:7d} {:30s} {:s}'.format(n, k, v))
+        """Close the window and log each record."""
+        for step, name, text in self.toc():
+            logging.info('Batch: %7d %-30s %s', step, name, text)

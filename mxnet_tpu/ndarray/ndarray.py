@@ -110,8 +110,20 @@ class NDArray:
         d.block_until_ready()
         if d.size == 0:
             return
-        onp.asarray(d if d.ndim == 0
-                    else jax.device_get(d[(0,) * d.ndim]))
+        if d.ndim == 0:
+            onp.asarray(d)
+            return
+        shards = getattr(d, 'addressable_shards', None)
+        if shards is not None and len(shards) > 1:
+            # multi-device array: a single-element fetch only drains the
+            # queue of the shard owning that element — fence every
+            # addressable shard's device
+            for sh in shards:
+                data = sh.data
+                if data.size:
+                    onp.asarray(jax.device_get(data[(0,) * data.ndim]))
+        else:
+            onp.asarray(jax.device_get(d[(0,) * d.ndim]))
 
     def wait_to_write(self):
         self.wait_to_read()

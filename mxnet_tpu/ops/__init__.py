@@ -19,4 +19,5 @@ from . import control_flow  # noqa: F401
 from . import custom     # noqa: F401
 from . import quantization  # noqa: F401
 from . import graph      # noqa: F401
+from . import vision_extra  # noqa: F401
 from . import pallas_kernels  # noqa: F401

@@ -87,7 +87,10 @@ def quantized_act(data, min_range, max_range, *, act_type='relu'):
         q = jnp.maximum(data, zp.astype(data.dtype))
     else:
         q = jnp.maximum(data, 0)
-    return q, jnp.maximum(lo, zero), hi
+    # ranges pass through UNCHANGED (reference mkldnn_quantized_act.cc:44-45):
+    # the codes stay on the original [lo, hi] affine mapping, so narrowing
+    # min_output here would make consumers decode wrong values.
+    return q, lo, hi
 
 
 @register('_contrib_quantized_flatten', num_inputs=3, num_outputs=3)

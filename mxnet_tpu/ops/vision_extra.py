@@ -137,6 +137,10 @@ def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
         rois_all.append(jnp.concatenate([idx, b], axis=1))
         scores_all.append(s[:, None])
     rois = jnp.concatenate(rois_all, axis=0)
+    if not output_score:
+        # reference exposes only rois unless output_score
+        # (proposal-inl.h NumVisibleOutputs)
+        return rois
     scr = jnp.concatenate(scores_all, axis=0)
     return rois, scr
 
@@ -202,8 +206,10 @@ def psroi_pooling(data, rois, *, spatial_scale=1.0, output_dim=None,
                 bin_mean = vals.reshape(C, -1).mean(axis=1)
                 gh = min(ph * g // p, g - 1)
                 gw = min(pw * g // p, g - 1)
-                chans = jax.lax.dynamic_slice_in_dim(
-                    bin_mean, (gh * g + gw) * od, od)
+                # reference channel layout is ctop-major with stride g^2:
+                # c = (ctop*group_size + gh)*group_size + gw
+                # (psroi_pooling.cc:98) — a strided gather, not a block
+                chans = bin_mean[jnp.arange(od) * g * g + gh * g + gw]
                 bins.append(chans)
         out = jnp.stack(bins, axis=1).reshape(od, p, p)
         return out
@@ -291,6 +297,12 @@ def deformable_psroi_pooling(data, rois, trans, *, spatial_scale=1.0,
     part = int(part_size) if part_size else p
     spp = max(int(sample_per_part), 1)
     C = data.shape[1]
+    # class-aware trans (deformable_psroi_pooling-inl.h): trans carries
+    # (2*num_classes, part, part) offsets per roi; output channel ctop
+    # belongs to class ctop // channels_each_class and samples with that
+    # class's offset.
+    ncls = 1 if no_trans else int(trans.shape[1]) // 2
+    cec = od // max(ncls, 1)  # channels_each_class
 
     def one_roi(roi, tr):
         b = roi[0].astype(jnp.int32)
@@ -305,24 +317,27 @@ def deformable_psroi_pooling(data, rois, trans, *, spatial_scale=1.0,
         off = (jnp.arange(spp, dtype=jnp.float32) + 0.5) / spp
         for ph in range(p):
             for pw in range(p):
-                if no_trans:
-                    dx = dy = 0.0
-                else:
-                    pj = min(pw * part // p, part - 1)
-                    pi = min(ph * part // p, part - 1)
-                    cls = 0   # class-agnostic trans (2*ncls, part, part)
-                    dy = tr[2 * cls, pi, pj] * trans_std * h
-                    dx = tr[2 * cls + 1, pi, pj] * trans_std * w
-                ys = y1 + (ph + off) / p * h + dy
-                xs = x1 + (pw + off) / p * w + dx
-                yy, xx = jnp.meshgrid(ys, xs, indexing='ij')
-                vals = _bilinear_at(img, yy, xx)
-                bin_mean = vals.reshape(C, -1).mean(axis=1)
                 gh = min(ph * g // p, g - 1)
                 gw = min(pw * g // p, g - 1)
-                chans = jax.lax.dynamic_slice_in_dim(
-                    bin_mean, (gh * g + gw) * od, od)
-                bins.append(chans)
+                per_cls = []
+                for cls in range(ncls):
+                    if no_trans:
+                        dx = dy = 0.0
+                    else:
+                        pj = min(pw * part // p, part - 1)
+                        pi = min(ph * part // p, part - 1)
+                        dy = tr[2 * cls, pi, pj] * trans_std * h
+                        dx = tr[2 * cls + 1, pi, pj] * trans_std * w
+                    ys = y1 + (ph + off) / p * h + dy
+                    xs = x1 + (pw + off) / p * w + dx
+                    yy, xx = jnp.meshgrid(ys, xs, indexing='ij')
+                    vals = _bilinear_at(img, yy, xx)
+                    bin_mean = vals.reshape(C, -1).mean(axis=1)
+                    # ctop-major channel layout, stride g^2 (see
+                    # psroi_pooling above): c = (ctop*g + gh)*g + gw
+                    ctop = cls * cec + jnp.arange(cec)
+                    per_cls.append(bin_mean[ctop * g * g + gh * g + gw])
+                bins.append(jnp.concatenate(per_cls))
         out = jnp.stack(bins, axis=1).reshape(od, p, p)
         cnt = jnp.full((od, p, p), float(spp * spp), dtype=out.dtype)
         return out, cnt

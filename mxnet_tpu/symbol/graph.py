@@ -42,6 +42,11 @@ def num_outputs_of(op, attrs):
         return int(attrs['num_out']) + int(attrs['num_vars'])
     if op.name == '_cond':
         return int(attrs['num_out'])
+    if op.name in ('_contrib_Proposal', 'Proposal',
+                   '_contrib_MultiProposal', 'MultiProposal'):
+        # reference: proposal-inl.h NumVisibleOutputs — scores only
+        # when output_score
+        return 2 if attrs.get('output_score') else 1
     if op.num_outputs and op.num_outputs > 0:
         return op.num_outputs
     return 1

@@ -286,3 +286,24 @@ def test_profiler_chrome_trace(tmp_path):
     events = json.loads(f.read_text())
     events = events.get('traceEvents', events)
     assert any(e.get('name') == 'work' for e in events)
+
+
+# ---------------------------------------------------------------------------
+# Context strictness (reference: a bad dev_id errors at first use rather
+# than silently computing on a different device)
+# ---------------------------------------------------------------------------
+def test_context_invalid_device_id_raises():
+    with pytest.raises(ValueError, match='cpu'):
+        mx.cpu(99).jax_device()
+    with pytest.raises(ValueError):
+        mx.tpu(99).jax_device()
+    with pytest.raises(ValueError):
+        nd.zeros((2, 2), ctx=mx.cpu(99))
+
+
+def test_context_valid_ids_resolve():
+    # conftest pins an 8-device virtual CPU mesh; ids 0..7 are all valid
+    assert mx.cpu(0).jax_device().platform == 'cpu'
+    assert mx.cpu(7).jax_device() is not mx.cpu(0).jax_device()
+    # accelerator aliases resolve (to host devices on the CPU-only suite)
+    assert mx.tpu(0).jax_device() is not None

@@ -143,7 +143,9 @@ def test_quantized_act_uint8_zero_point():
                             act_type='relu')
     assert a.dtype == np.uint8
     np.testing.assert_array_equal(a.asnumpy(), [128, 128, 128, 200, 255])
-    assert float(amin.asnumpy()) == 0.0
+    # ranges pass through unchanged (mkldnn_quantized_act.cc:44-45) so
+    # consumers keep decoding codes on the original affine mapping
+    assert float(amin.asnumpy()) == -1.0
 
 
 def test_quantized_act_flatten_pooling():
@@ -152,7 +154,7 @@ def test_quantized_act_flatten_pooling():
     a, amin, amax = _invoke('_contrib_quantized_act', [q, lo, hi],
                             act_type='relu')
     np.testing.assert_array_equal(a.asnumpy().ravel(), [0, 3, 7, 0])
-    assert float(amin.asnumpy()) == 0.0
+    assert float(amin.asnumpy()) == -1.0
 
     f, _, _ = _invoke('_contrib_quantized_flatten', [q, lo, hi])
     assert f.shape == (1, 4)

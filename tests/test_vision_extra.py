@@ -1,0 +1,217 @@
+"""Detection/video op long-tail: Proposal, PSROIPooling (+deformable),
+DeformableConvolution, Correlation, contrib fft/ifft, count_sketch.
+
+Reference behaviors: src/operator/contrib/{proposal,psroi_pooling,
+deformable_convolution,deformable_psroi_pooling,fft,count_sketch}*,
+src/operator/correlation-inl.h. The PSROI tests pin the reference's
+ctop-major channel layout c = (ctop*group_size + gh)*group_size + gw
+(psroi_pooling.cc:98).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _invoke(name, inputs, **attrs):
+    from mxnet_tpu.ndarray.ndarray import invoke
+    return invoke(name, [nd.array(x) if isinstance(x, np.ndarray) else x
+                         for x in inputs], attrs)
+
+
+# ---------------------------------------------------------------------------
+# Proposal
+# ---------------------------------------------------------------------------
+
+def _proposal_inputs(n=1, a=1, h=4, w=4):
+    rng = np.random.RandomState(0)
+    cls_prob = rng.uniform(0, 1, (n, 2 * a, h, w)).astype(np.float32)
+    bbox_pred = rng.uniform(-0.2, 0.2, (n, 4 * a, h, w)).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]] * n, dtype=np.float32)
+    return cls_prob, bbox_pred, im_info
+
+
+def test_proposal_single_output_by_default():
+    cls_prob, bbox_pred, im_info = _proposal_inputs()
+    rois = _invoke('_contrib_Proposal', [cls_prob, bbox_pred, im_info],
+                   rpn_pre_nms_top_n=12, rpn_post_nms_top_n=8,
+                   scales=(8,), ratios=(1.0,), feature_stride=16)
+    # reference: only rois visible when output_score=False
+    assert not isinstance(rois, (list, tuple))
+    assert rois.shape == (8, 5)
+    r = rois.asnumpy()
+    assert (r[:, 0] == 0).all()                      # batch index
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+    assert (r[:, 1:] >= 0).all() and (r[:, 3:] <= 63).all()
+
+
+def test_proposal_output_score():
+    cls_prob, bbox_pred, im_info = _proposal_inputs()
+    out = _invoke('_contrib_Proposal', [cls_prob, bbox_pred, im_info],
+                  rpn_pre_nms_top_n=12, rpn_post_nms_top_n=8,
+                  scales=(8,), ratios=(1.0,), feature_stride=16,
+                  output_score=True)
+    rois, scores = out
+    assert rois.shape == (8, 5) and scores.shape == (8, 1)
+    s = scores.asnumpy().ravel()
+    assert (np.diff(s) <= 1e-6).all()                # sorted by score
+
+
+def test_multiproposal_alias_batch():
+    cls_prob, bbox_pred, im_info = _proposal_inputs(n=2)
+    rois = _invoke('_contrib_MultiProposal', [cls_prob, bbox_pred, im_info],
+                   rpn_pre_nms_top_n=12, rpn_post_nms_top_n=4,
+                   scales=(8,), ratios=(1.0,), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (8, 5)
+    assert (r[:4, 0] == 0).all() and (r[4:, 0] == 1).all()
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling — channel-layout oracle
+# ---------------------------------------------------------------------------
+
+def test_psroi_pooling_channel_layout():
+    # data[c] constant = c: out[ctop, ph, pw] must read channel
+    # (ctop*g + gh)*g + gw  (gh=ph, gw=pw when pooled_size == group_size)
+    od, g = 3, 2
+    C = od * g * g
+    data = np.tile(np.arange(C, dtype=np.float32).reshape(1, C, 1, 1),
+                   (1, 1, 16, 16))
+    rois = np.array([[0, 2, 2, 13, 13]], dtype=np.float32)
+    out = _invoke('_contrib_PSROIPooling', [data, rois],
+                  spatial_scale=1.0, output_dim=od, pooled_size=g,
+                  group_size=g).asnumpy()
+    assert out.shape == (1, od, g, g)
+    for ctop in range(od):
+        for ph in range(g):
+            for pw in range(g):
+                want = (ctop * g + ph) * g + pw
+                np.testing.assert_allclose(out[0, ctop, ph, pw], want,
+                                           atol=1e-5)
+
+
+def test_deformable_psroi_no_trans_matches_psroi_layout():
+    od, g = 2, 2
+    C = od * g * g
+    data = np.tile(np.arange(C, dtype=np.float32).reshape(1, C, 1, 1),
+                   (1, 1, 16, 16))
+    rois = np.array([[0, 2, 2, 13, 13]], dtype=np.float32)
+    trans = np.zeros((1, 2, g, g), dtype=np.float32)
+    out, cnt = _invoke('_contrib_DeformablePSROIPooling',
+                       [data, rois, trans], spatial_scale=1.0,
+                       output_dim=od, group_size=g, pooled_size=g,
+                       sample_per_part=2, trans_std=0.1, no_trans=True)
+    o = out.asnumpy()
+    assert o.shape == (1, od, g, g)
+    for ctop in range(od):
+        for ph in range(g):
+            for pw in range(g):
+                want = (ctop * g + ph) * g + pw
+                np.testing.assert_allclose(o[0, ctop, ph, pw], want,
+                                           atol=1e-5)
+
+
+def test_deformable_psroi_class_aware_trans():
+    # two classes: shifting class 1's offset must change only class-1
+    # output channels (ctop >= channels_each_class)
+    od, g, ncls = 4, 2, 2
+    C = od * g * g
+    rng = np.random.RandomState(0)
+    data = rng.uniform(0, 1, (1, C, 16, 16)).astype(np.float32)
+    rois = np.array([[0, 2, 2, 13, 13]], dtype=np.float32)
+    t0 = np.zeros((1, 2 * ncls, g, g), dtype=np.float32)
+    t1 = t0.copy()
+    t1[:, 2:] = 3.0          # move only class 1
+    kw = dict(spatial_scale=1.0, output_dim=od, group_size=g,
+              pooled_size=g, sample_per_part=2, trans_std=0.1,
+              no_trans=False)
+    o0 = _invoke('_contrib_DeformablePSROIPooling',
+                 [data, rois, t0], **kw)[0].asnumpy()
+    o1 = _invoke('_contrib_DeformablePSROIPooling',
+                 [data, rois, t1], **kw)[0].asnumpy()
+    cec = od // ncls
+    np.testing.assert_allclose(o0[:, :cec], o1[:, :cec], atol=1e-6)
+    assert np.abs(o0[:, cec:] - o1[:, cec:]).max() > 1e-4
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution — zero offsets == plain Convolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_is_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 8, 8), dtype=np.float32)
+    dc = _invoke('_contrib_DeformableConvolution',
+                 [x, offset, w, b], kernel=(3, 3), pad=(1, 1),
+                 num_filter=4).asnumpy()
+    ref = _invoke('Convolution', [x, w, b], kernel=(3, 3), pad=(1, 1),
+                  num_filter=4).asnumpy()
+    np.testing.assert_allclose(dc, ref, atol=2e-4, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Correlation
+# ---------------------------------------------------------------------------
+
+def test_correlation_identity_peak():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 4, 9, 9).astype(np.float32)
+    out = _invoke('Correlation', [x, x], kernel_size=1,
+                  max_displacement=2, stride1=1, stride2=1,
+                  pad_size=2).asnumpy()
+    grid = 5 * 5
+    assert out.shape[1] == grid
+    # zero-displacement channel (center of the grid) dominates: it is the
+    # self inner product, >= any cross term on average
+    center = grid // 2
+    assert out[0, center].mean() >= out[0].mean(axis=(1, 2)).max() - 1e-5
+
+
+def test_correlation_subtract_zero_at_center():
+    x = np.random.RandomState(1).randn(1, 2, 7, 7).astype(np.float32)
+    out = _invoke('Correlation', [x, x], kernel_size=1,
+                  max_displacement=1, pad_size=1,
+                  is_multiply=False).asnumpy()
+    np.testing.assert_allclose(out[0, 4], 0.0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft / count_sketch
+# ---------------------------------------------------------------------------
+
+def test_fft_ifft_roundtrip():
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    f = _invoke('_contrib_fft', [x])
+    assert f.shape == (3, 16)
+    back = _invoke('_contrib_ifft', [f]).asnumpy()
+    np.testing.assert_allclose(back, x * 8, atol=1e-4)
+
+
+def test_count_sketch_oracle():
+    x = np.array([[1.0, 2.0, 3.0, 4.0]], dtype=np.float32)
+    h = np.array([0, 1, 0, 2], dtype=np.float32)
+    s = np.array([1, -1, 1, 1], dtype=np.float32)
+    out = _invoke('_contrib_count_sketch', [x, h, s], out_dim=3).asnumpy()
+    np.testing.assert_allclose(out, [[1 + 3, -2, 4]], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# quantized_act range passthrough (reference mkldnn_quantized_act.cc:44-45)
+# ---------------------------------------------------------------------------
+
+def test_quantized_act_ranges_pass_through():
+    q = np.array([0, 100, 200], dtype=np.uint8)
+    lo, hi = np.float32(-1.0), np.float32(1.0)
+    a, amin, amax = _invoke('_contrib_quantized_act', [q, lo, hi],
+                            act_type='relu')
+    # codes stay on the original [lo, hi] mapping; consumers dequantize
+    # with the ORIGINAL range (code 200 at [-1,1] is 0.569)
+    assert float(amin.asnumpy()) == -1.0
+    assert float(amax.asnumpy()) == 1.0
+    dq = _invoke('_contrib_dequantize', [a, amin, amax]).asnumpy()
+    np.testing.assert_allclose(dq[2], 200 / 255 * 2 - 1, atol=1e-3)

@@ -1,16 +1,23 @@
-"""Dataset container (reference: python/mxnet/gluon/data/dataset.py)."""
+"""Dataset containers for the Gluon data pipeline.
+
+Reference parity: python/mxnet/gluon/data/dataset.py — same classes and
+semantics (Dataset with filter/shard/take/transform/transform_first,
+SimpleDataset, ArrayDataset, RecordFileDataset), built here around a
+single index-subset primitive: every derived view is the base dataset
+plus an index list, so chained filter/shard/take stay O(1) per sample
+and never copy data.
+"""
 from __future__ import annotations
 
 import os
 
-from ... import ndarray as nd
 from ...ndarray import NDArray
 
 __all__ = ['Dataset', 'SimpleDataset', 'ArrayDataset', 'RecordFileDataset']
 
 
 class Dataset:
-    """Abstract dataset: __getitem__ + __len__."""
+    """Abstract random-access dataset: ``__getitem__`` + ``__len__``."""
 
     def __getitem__(self, idx):
         raise NotImplementedError
@@ -18,129 +25,138 @@ class Dataset:
     def __len__(self):
         raise NotImplementedError
 
+    def _subset(self, indices):
+        """A view of this dataset restricted to ``indices`` (the shared
+        primitive behind filter/shard/take)."""
+        return _SampledDataset(self, indices)
+
     def filter(self, fn):
-        """Return a dataset with samples for which fn returns True."""
+        """Keep only samples where ``fn(sample)`` is truthy."""
         from . import FilterSampler
-        sampler = FilterSampler(fn, self)
-        return _SampledDataset(self, sampler)
+        return self._subset(list(FilterSampler(fn, self)))
 
     def shard(self, num_shards, index):
-        """Return the index-th shard of num_shards (multi-worker DP input
-        split; reference: dataset.py shard)."""
-        assert index < num_shards, 'Shard index out of range'
-        length = len(self)
-        shard_len = length // num_shards
-        rest = length % num_shards
-        start = shard_len * index + min(index, rest)
-        end = start + shard_len + (index < rest)
-        from . import SequentialSampler
-        return _SampledDataset(self, list(range(start, end)))
+        """Contiguous shard ``index`` of ``num_shards``; the first
+        ``len % num_shards`` shards carry one extra sample (multi-worker
+        DP input split; reference: dataset.py shard)."""
+        if not 0 <= index < num_shards:
+            raise AssertionError('Shard index out of range')
+        total = len(self)
+        base, extra = divmod(total, num_shards)
+        lo = base * index + min(index, extra)
+        hi = lo + base + (1 if index < extra else 0)
+        return self._subset(range(lo, hi))
 
     def take(self, count):
-        if count is None or count > len(self):
-            count = len(self)
-        return _SampledDataset(self, list(range(count)))
+        """First ``count`` samples (all of them when count is None)."""
+        n = len(self) if count is None else min(count, len(self))
+        return self._subset(range(n))
 
     def transform(self, fn, lazy=True):
-        """Return a dataset with every sample transformed by fn."""
-        trans = _LazyTransformDataset(self, fn)
-        if lazy:
-            return trans
-        return SimpleDataset([trans[i] for i in range(len(trans))])
+        """Map ``fn`` over every sample; eager when ``lazy=False``."""
+        mapped = _LazyTransformDataset(self, fn)
+        return mapped if lazy else SimpleDataset(list(mapped))
 
     def transform_first(self, fn, lazy=True):
-        """Transform only the first element of each sample tuple."""
+        """Map ``fn`` over only the first element of each sample."""
         return self.transform(_TransformFirstClosure(fn), lazy)
 
 
-class SimpleDataset(Dataset):
-    """Dataset wrapping a list/array."""
+class _SampledDataset(Dataset):
+    """Base dataset viewed through an index list."""
 
-    def __init__(self, data):
-        self._data = data
+    def __init__(self, dataset, sampler):
+        self._base = dataset
+        self._picks = list(sampler)
 
     def __len__(self):
-        return len(self._data)
+        return len(self._picks)
 
     def __getitem__(self, idx):
-        return self._data[idx]
+        return self._base[self._picks[idx]]
 
 
 class _LazyTransformDataset(Dataset):
+    """Per-access transform; tuple samples are splatted into ``fn``."""
+
     def __init__(self, data, fn):
-        self._data = data
-        self._fn = fn
+        self._items = data
+        self._xform = fn
 
     def __len__(self):
-        return len(self._data)
+        return len(self._items)
 
     def __getitem__(self, idx):
-        item = self._data[idx]
-        if isinstance(item, tuple):
-            return self._fn(*item)
-        return self._fn(item)
+        sample = self._items[idx]
+        return self._xform(*sample) if isinstance(sample, tuple) \
+            else self._xform(sample)
 
 
 class _TransformFirstClosure:
+    """Picklable first-element mapper (DataLoader workers need to
+    serialize it, so no lambda)."""
+
     def __init__(self, fn):
-        self._fn = fn
+        self._xform = fn
 
-    def __call__(self, x, *args):
-        if args:
-            return (self._fn(x),) + args
-        return self._fn(x)
+    def __call__(self, x, *rest):
+        return (self._xform(x),) + rest if rest else self._xform(x)
 
 
-class _SampledDataset(Dataset):
-    def __init__(self, dataset, sampler):
-        self._dataset = dataset
-        self._indices = list(sampler)
+class SimpleDataset(Dataset):
+    """Wrap any random-access container as a Dataset."""
+
+    def __init__(self, data):
+        self._items = data
 
     def __len__(self):
-        return len(self._indices)
+        return len(self._items)
 
     def __getitem__(self, idx):
-        return self._dataset[self._indices[idx]]
+        return self._items[idx]
 
 
 class ArrayDataset(Dataset):
-    """Combine multiple array-likes into a tuple dataset
-    (reference: dataset.py ArrayDataset)."""
+    """Zip several equal-length array-likes into a tuple dataset
+    (reference: dataset.py ArrayDataset). 1-D NDArrays are converted to
+    numpy so indexing yields scalars, matching the reference."""
 
     def __init__(self, *args):
-        assert len(args) > 0, 'Needs at least 1 arrays'
-        self._length = len(args[0])
-        self._data = []
-        for i, data in enumerate(args):
-            assert len(data) == self._length, \
-                'All arrays must have the same length; array[0] has length ' \
-                '%d while array[%d] has %d.' % (self._length, i, len(data))
-            if isinstance(data, NDArray) and data.ndim == 1:
-                data = data.asnumpy()
-            self._data.append(data)
-
-    def __getitem__(self, idx):
-        if len(self._data) == 1:
-            return self._data[0][idx]
-        return tuple(data[idx] for data in self._data)
+        if not args:
+            raise AssertionError('Needs at least 1 arrays')
+        self._size = len(args[0])
+        self._items = []
+        for i, part in enumerate(args):
+            if len(part) != self._size:
+                raise AssertionError(
+                    'All arrays must have the same length; array[0] has '
+                    'length %d while array[%d] has %d.'
+                    % (self._size, i, len(part)))
+            if isinstance(part, NDArray) and part.ndim == 1:
+                part = part.asnumpy()
+            self._items.append(part)
 
     def __len__(self):
-        return self._length
+        return self._size
+
+    def __getitem__(self, idx):
+        row = tuple(part[idx] for part in self._items)
+        return row[0] if len(row) == 1 else row
 
 
 class RecordFileDataset(Dataset):
-    """Dataset over a RecordIO (.rec) file (reference: dataset.py
-    RecordFileDataset over MXIndexedRecordIO)."""
+    """Random access over a packed RecordIO (.rec) file through its
+    .idx companion (reference: dataset.py RecordFileDataset)."""
 
     def __init__(self, filename):
         from ... import recordio
-        self.idx_file = os.path.splitext(filename)[0] + '.idx'
         self.filename = filename
-        self._record = recordio.MXIndexedRecordIO(self.idx_file,
-                                                  self.filename, 'r')
-
-    def __getitem__(self, idx):
-        return self._record.read_idx(self._record.keys[idx])
+        self.idx_file = os.path.splitext(filename)[0] + '.idx'
+        self._reader = recordio.MXIndexedRecordIO(
+            self.idx_file, filename, 'r')
 
     def __len__(self):
-        return len(self._record.keys)
+        return len(self._reader.keys)
+
+    def __getitem__(self, idx):
+        return self._reader.read_idx(self._reader.keys[idx])

@@ -72,7 +72,8 @@ class L2Loss(Loss):
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
         err = pred - _match_shape(F, label, pred)
-        half = None if self._weight is None else self._weight / 2
+        # the ½ factor applies regardless; weight=None means weight=1
+        half = (1. if self._weight is None else self._weight) / 2
         return self._reduce(F, F.square(err), sample_weight, scale=half)
 
 

@@ -1,4 +1,14 @@
-"""DenseNet 121/161/169/201 (reference: python/mxnet/gluon/model_zoo/vision/densenet.py)."""
+"""DenseNet 121/161/169/201 ("Densely Connected Convolutional
+Networks", Huang 2017).
+
+Behavioral parity target: python/mxnet/gluon/model_zoo/vision/
+densenet.py (same layer graph). Expressed here in the zoo's spec-table
+style: one (init_width, growth, per-stage layer counts) row per depth,
+and the whole body is generated from two primitives — a BN→ReLU→Conv
+triple and a concat-growth layer. Dense connectivity is pure
+concatenation, which XLA fuses into the following conv's input without
+materialising the intermediate.
+"""
 from __future__ import annotations
 
 __all__ = ['DenseNet', 'densenet121', 'densenet161', 'densenet169',
@@ -6,36 +16,32 @@ __all__ = ['DenseNet', 'densenet121', 'densenet161', 'densenet169',
 
 from ...block import HybridBlock
 from ... import nn
-from .squeezenet import HybridConcurrent
+
+# depth -> (stem width, growth rate k, layers per dense stage)
+_SPECS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
 
 
-class _Identity(HybridBlock):
-    def hybrid_forward(self, F, x):
-        return x
+def _bn_relu_conv(seq, channels, kernel, padding=0):
+    """Append the pre-activation triple used everywhere in DenseNet."""
+    seq.add(nn.BatchNorm(), nn.Activation('relu'),
+            nn.Conv2D(channels, kernel_size=kernel, padding=padding,
+                      use_bias=False))
 
 
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix='stage%d_' % stage_index)
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_make_dense_layer(growth_rate, bn_size, dropout))
-    return out
+class _GrowthLayer(HybridBlock):
+    """One dense layer: bottleneck 1x1 -> 3x3 producing ``growth``
+    channels, concatenated onto its input."""
 
-
-class _DenseLayer(HybridBlock):
-    """Concat(x, new_features(x)) (reference: densenet.py _make_dense_layer)."""
-
-    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+    def __init__(self, growth, bn_size, dropout, **kwargs):
         super().__init__(**kwargs)
         self.new_features = nn.HybridSequential(prefix='')
-        self.new_features.add(nn.BatchNorm())
-        self.new_features.add(nn.Activation('relu'))
-        self.new_features.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                                        use_bias=False))
-        self.new_features.add(nn.BatchNorm())
-        self.new_features.add(nn.Activation('relu'))
-        self.new_features.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                                        use_bias=False))
+        _bn_relu_conv(self.new_features, bn_size * growth, 1)
+        _bn_relu_conv(self.new_features, growth, 3, padding=1)
         if dropout:
             self.new_features.add(nn.Dropout(dropout))
 
@@ -43,66 +49,62 @@ class _DenseLayer(HybridBlock):
         return F.Concat(x, self.new_features(x), dim=1)
 
 
-def _make_dense_layer(growth_rate, bn_size, dropout):
-    return _DenseLayer(growth_rate, bn_size, dropout)
+def _dense_stage(n_layers, bn_size, growth, dropout, stage_index):
+    stage = nn.HybridSequential(prefix='stage%d_' % stage_index)
+    with stage.name_scope():
+        for _ in range(n_layers):
+            stage.add(_GrowthLayer(growth, bn_size, dropout))
+    return stage
 
 
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix='')
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation('relu'))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+def _transition(channels):
+    """Halve spatial size and compress channels between stages."""
+    t = nn.HybridSequential(prefix='')
+    _bn_relu_conv(t, channels, 1)
+    t.add(nn.AvgPool2D(pool_size=2, strides=2))
+    return t
 
 
 class DenseNet(HybridBlock):
-    r"""DenseNet from "Densely Connected Convolutional Networks"
-    (reference: densenet.py DenseNet)."""
+    """Stem + dense stages with compressing transitions + classifier."""
 
     def __init__(self, num_init_features, growth_rate, block_config,
                  bn_size=4, dropout=0, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix='')
-            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation('relu'))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(
-                    num_layers, bn_size, growth_rate, dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    self.features.add(_make_transition(num_features // 2))
-                    num_features = num_features // 2
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation('relu'))
-            self.features.add(nn.AvgPool2D(pool_size=7))
-            self.features.add(nn.Flatten())
+            body = nn.HybridSequential(prefix='')
+            body.add(nn.Conv2D(num_init_features, kernel_size=7, strides=2,
+                               padding=3, use_bias=False),
+                     nn.BatchNorm(), nn.Activation('relu'),
+                     nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            width = num_init_features
+            last = len(block_config) - 1
+            for i, n_layers in enumerate(block_config):
+                body.add(_dense_stage(n_layers, bn_size, growth_rate,
+                                      dropout, i + 1))
+                width += n_layers * growth_rate
+                if i != last:
+                    width //= 2
+                    body.add(_transition(width))
+            body.add(nn.BatchNorm(), nn.Activation('relu'),
+                     nn.AvgPool2D(pool_size=7), nn.Flatten())
+            self.features = body
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
-                 161: (96, 48, [6, 12, 36, 24]),
-                 169: (64, 32, [6, 12, 32, 32]),
-                 201: (64, 32, [6, 12, 48, 32])}
-
-
-def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+def get_densenet(num_layers, pretrained=False, ctx=None, root=None,
+                 **kwargs):
+    """Build a DenseNet from the spec table; optionally load pinned
+    pretrained weights."""
+    stem, growth, stages = _SPECS[num_layers]
+    net = DenseNet(stem, growth, stages, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        net.load_parameters(get_model_file('densenet%d' % num_layers,
-                                           root=root), ctx=ctx)
+        net.load_parameters(
+            get_model_file('densenet%d' % num_layers, root=root), ctx=ctx)
     return net
 
 

@@ -1,9 +1,18 @@
-"""MobileNet V1/V2 (reference: python/mxnet/gluon/model_zoo/vision/mobilenet.py).
+"""MobileNet V1 and V2 (Howard 2017 / Sandler 2018).
 
-Depthwise convs use feature_group_count in lax.conv — XLA lowers grouped
-convs natively, no special depthwise kernel needed (vs the reference's
-depthwise_convolution.cu)."""
+Behavioral parity target: python/mxnet/gluon/model_zoo/vision/
+mobilenet.py (same layer graphs, same width-multiplier rule). Written
+in the zoo's spec-table style: each architecture is one table —
+(dw_width, out_width, stride) rows for V1, (t, in_width, out_width,
+stride) rows for V2 — walked by a single conv-BN-act builder.
+
+TPU note: depthwise convs are grouped ``lax.conv`` calls
+(feature_group_count); XLA lowers them natively, so there is no analog
+of the reference's hand-written depthwise_convolution.cu kernel.
+"""
 from __future__ import annotations
+
+import functools
 
 __all__ = ['MobileNet', 'MobileNetV2', 'mobilenet1_0', 'mobilenet0_75',
            'mobilenet0_5', 'mobilenet0_25', 'mobilenet_v2_1_0',
@@ -13,104 +22,110 @@ __all__ = ['MobileNet', 'MobileNetV2', 'mobilenet1_0', 'mobilenet0_75',
 from ...block import HybridBlock
 from ... import nn
 
+# V1 body after the stem: (depthwise width, pointwise out width, stride)
+_V1_ROWS = [
+    (32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+    (256, 256, 1), (256, 512, 2), (512, 512, 1), (512, 512, 1),
+    (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 1024, 2),
+    (1024, 1024, 1),
+]
+
+# V2 bottleneck stack: (expansion t, in width, out width, stride)
+_V2_ROWS = [
+    (1, 32, 16, 1),
+    (6, 16, 24, 2), (6, 24, 24, 1),
+    (6, 24, 32, 2), (6, 32, 32, 1), (6, 32, 32, 1),
+    (6, 32, 64, 2), (6, 64, 64, 1), (6, 64, 64, 1), (6, 64, 64, 1),
+    (6, 64, 96, 1), (6, 96, 96, 1), (6, 96, 96, 1),
+    (6, 96, 160, 2), (6, 160, 160, 1), (6, 160, 160, 1),
+    (6, 160, 320, 1),
+]
+
 
 class RELU6(HybridBlock):
-    """ReLU6 activation (reference: mobilenet.py RELU6)."""
+    """min(max(x, 0), 6) — the quantization-friendly clamp both nets
+    use (reference: mobilenet.py RELU6)."""
 
     def hybrid_forward(self, F, x):
         return F.clip(x, a_min=0, a_max=6)
 
 
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
-              active=True, relu6=False):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
-                      use_bias=False))
-    out.add(nn.BatchNorm(scale=True))
-    if active:
-        out.add(RELU6() if relu6 else nn.Activation('relu'))
-
-
-def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
-    _add_conv(out, channels=dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels, relu6=relu6)
-    _add_conv(out, channels=channels, relu6=relu6)
+def _conv_unit(seq, width, kernel=1, stride=1, pad=0, groups=1,
+               act='relu'):
+    """Conv → BN → activation; ``act`` is 'relu', 'relu6' or None.
+    ``groups == width`` makes it depthwise."""
+    seq.add(nn.Conv2D(width, kernel, stride, pad, groups=groups,
+                      use_bias=False),
+            nn.BatchNorm(scale=True))
+    if act == 'relu6':
+        seq.add(RELU6())
+    elif act:
+        seq.add(nn.Activation(act))
 
 
 class LinearBottleneck(HybridBlock):
-    r"""MobileNetV2 inverted-residual block (reference: mobilenet.py)."""
+    """V2 inverted residual: expand 1x1 → depthwise 3x3 → project 1x1
+    (linear), with identity shortcut when shapes allow."""
 
     def __init__(self, in_channels, channels, t, stride, **kwargs):
         super().__init__(**kwargs)
         self.use_shortcut = stride == 1 and in_channels == channels
+        mid = in_channels * t
         with self.name_scope():
             self.out = nn.HybridSequential()
-            _add_conv(self.out, in_channels * t, relu6=True)
-            _add_conv(self.out, in_channels * t, kernel=3, stride=stride,
-                      pad=1, num_group=in_channels * t, relu6=True)
-            _add_conv(self.out, channels, active=False, relu6=True)
+            _conv_unit(self.out, mid, act='relu6')
+            _conv_unit(self.out, mid, kernel=3, stride=stride, pad=1,
+                       groups=mid, act='relu6')
+            _conv_unit(self.out, channels, act=None)
 
     def hybrid_forward(self, F, x):
-        out = self.out(x)
-        if self.use_shortcut:
-            out = F.elemwise_add(out, x)
-        return out
+        y = self.out(x)
+        return F.elemwise_add(y, x) if self.use_shortcut else y
 
 
 class MobileNet(HybridBlock):
-    r"""MobileNet V1 (reference: mobilenet.py MobileNet)."""
+    """V1: stem conv then 13 depthwise-separable units, global pool,
+    dense classifier."""
 
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda w: int(w * multiplier)  # noqa: E731
         with self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             with self.features.name_scope():
-                _add_conv(self.features, channels=int(32 * multiplier),
-                          kernel=3, pad=1, stride=2)
-                dw_channels = [int(x * multiplier) for x in
-                               [32, 64] + [128] * 2 + [256] * 2 +
-                               [512] * 6 + [1024]]
-                channels = [int(x * multiplier) for x in
-                            [64] + [128] * 2 + [256] * 2 + [512] * 6 +
-                            [1024] * 2]
-                strides = [1, 2] * 3 + [1] * 5 + [2, 1]
-                for dwc, c, s in zip(dw_channels, channels, strides):
-                    _add_conv_dw(self.features, dw_channels=dwc, channels=c,
-                                 stride=s)
-                self.features.add(nn.GlobalAvgPool2D())
-                self.features.add(nn.Flatten())
+                _conv_unit(self.features, scale(32), kernel=3, stride=2,
+                           pad=1)
+                for dw, out, stride in _V1_ROWS:
+                    # separable pair: depthwise 3x3 then pointwise 1x1
+                    _conv_unit(self.features, scale(dw), kernel=3,
+                               stride=stride, pad=1, groups=scale(dw))
+                    _conv_unit(self.features, scale(out))
+                self.features.add(nn.GlobalAvgPool2D(), nn.Flatten())
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 class MobileNetV2(HybridBlock):
-    r"""MobileNetV2 (reference: mobilenet.py MobileNetV2)."""
+    """V2: stem conv, 17 inverted-residual bottlenecks, 1280-wide head,
+    1x1-conv classifier."""
 
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda w: int(w * multiplier)  # noqa: E731
         with self.name_scope():
             self.features = nn.HybridSequential(prefix='features_')
             with self.features.name_scope():
-                _add_conv(self.features, int(32 * multiplier), kernel=3,
-                          stride=2, pad=1, relu6=True)
-                in_channels_group = [int(x * multiplier) for x in
-                                     [32] + [16] + [24] * 2 + [32] * 3 +
-                                     [64] * 4 + [96] * 3 + [160] * 3]
-                channels_group = [int(x * multiplier) for x in
-                                  [16] + [24] * 2 + [32] * 3 + [64] * 4 +
-                                  [96] * 3 + [160] * 3 + [320]]
-                ts = [1] + [6] * 16
-                strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
-                for in_c, c, t, s in zip(in_channels_group, channels_group,
-                                         ts, strides):
+                _conv_unit(self.features, scale(32), kernel=3, stride=2,
+                           pad=1, act='relu6')
+                for t, w_in, w_out, stride in _V2_ROWS:
                     self.features.add(LinearBottleneck(
-                        in_channels=in_c, channels=c, t=t, stride=s))
-                last_channels = int(1280 * multiplier) if multiplier > 1.0 \
-                    else 1280
-                _add_conv(self.features, last_channels, relu6=True)
+                        in_channels=scale(w_in), channels=scale(w_out),
+                        t=t, stride=stride))
+                # head never narrows below 1280 (reference rule)
+                head = scale(1280) if multiplier > 1.0 else 1280
+                _conv_unit(self.features, head, act='relu6')
                 self.features.add(nn.GlobalAvgPool2D())
             self.output = nn.HybridSequential(prefix='output_')
             with self.output.name_scope():
@@ -119,20 +134,23 @@ class MobileNetV2(HybridBlock):
                     nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
-def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
+def _weight_tag(multiplier):
+    """'1.0', '0.75', '0.5', '0.25' — the model_store naming rule."""
+    tag = '%.2f' % multiplier
+    return tag[:-1] if tag in ('1.00', '0.50') else tag
+
+
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
+                  **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        version_suffix = '{0:.2f}'.format(multiplier)
-        if version_suffix in ('1.00', '0.50'):
-            version_suffix = version_suffix[:-1]
-        net.load_parameters(get_model_file('mobilenet%s' % version_suffix,
-                                           root=root), ctx=ctx)
+        net.load_parameters(
+            get_model_file('mobilenet%s' % _weight_tag(multiplier),
+                           root=root), ctx=ctx)
     return net
 
 
@@ -141,41 +159,19 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
-        version_suffix = '{0:.2f}'.format(multiplier)
-        if version_suffix in ('1.00', '0.50'):
-            version_suffix = version_suffix[:-1]
-        net.load_parameters(get_model_file('mobilenetv2_%s' % version_suffix,
-                                           root=root), ctx=ctx)
+        net.load_parameters(
+            get_model_file('mobilenetv2_%s' % _weight_tag(multiplier),
+                           root=root), ctx=ctx)
     return net
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
-
-
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
-
-
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
-
-
-def mobilenet_v2_1_0(**kwargs):
-    return get_mobilenet_v2(1.0, **kwargs)
-
-
-def mobilenet_v2_0_75(**kwargs):
-    return get_mobilenet_v2(0.75, **kwargs)
-
-
-def mobilenet_v2_0_5(**kwargs):
-    return get_mobilenet_v2(0.5, **kwargs)
-
-
-def mobilenet_v2_0_25(**kwargs):
-    return get_mobilenet_v2(0.25, **kwargs)
+# width-multiplier factories (reference exposes one def per width; a
+# partial over the getter is this repo's idiom)
+mobilenet1_0 = functools.partial(get_mobilenet, 1.0)
+mobilenet0_75 = functools.partial(get_mobilenet, 0.75)
+mobilenet0_5 = functools.partial(get_mobilenet, 0.5)
+mobilenet0_25 = functools.partial(get_mobilenet, 0.25)
+mobilenet_v2_1_0 = functools.partial(get_mobilenet_v2, 1.0)
+mobilenet_v2_0_75 = functools.partial(get_mobilenet_v2, 0.75)
+mobilenet_v2_0_5 = functools.partial(get_mobilenet_v2, 0.5)
+mobilenet_v2_0_25 = functools.partial(get_mobilenet_v2, 0.25)

@@ -1,10 +1,15 @@
-"""Basic Gluon layers.
+"""Core Gluon layers.
 
-Reference parity: python/mxnet/gluon/nn/basic_layers.py:142-662 (Sequential,
-HybridSequential, Dense, Dropout, BatchNorm, Embedding, Flatten,
-InstanceNorm, LayerNorm, Lambda, HybridLambda).
+Reference parity: python/mxnet/gluon/nn/basic_layers.py:142-662
+(Sequential, HybridSequential, Dense, Dropout, BatchNorm, Embedding,
+Flatten, InstanceNorm, LayerNorm, Lambda, HybridLambda). Structure
+here: the two Sequential flavours share one container mixin, and the
+three norm layers share one gamma/beta declaration helper — the
+reference repeats those bodies per class.
 """
 from __future__ import annotations
+
+import warnings
 
 import numpy as onp
 
@@ -12,204 +17,191 @@ from ... import autograd
 from ..block import Block, HybridBlock, record_aux_update
 from .activations import Activation
 
-__all__ = ['Sequential', 'HybridSequential', 'Dense', 'Dropout', 'Embedding',
-           'BatchNorm', 'InstanceNorm', 'LayerNorm', 'Flatten', 'Lambda',
-           'HybridLambda']
+__all__ = ['Sequential', 'HybridSequential', 'Dense', 'Dropout',
+           'Embedding', 'BatchNorm', 'InstanceNorm', 'LayerNorm',
+           'Flatten', 'Lambda', 'HybridLambda']
 
 
-class Sequential(Block):
-    """Stacks Blocks sequentially (reference: basic_layers.py Sequential)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
+class _SequentialOps:
+    """Shared container protocol for the Sequential flavours."""
 
     def add(self, *blocks):
-        """Adds block on top of the stack."""
+        """Append blocks to the pipeline."""
         for block in blocks:
             self.register_child(block)
 
-    def forward(self, x):
+    def _chain(self, x):
         for block in self._children.values():
             x = block(x)
         return x
 
     def __repr__(self):
-        s = '{name}(\n{modstr}\n)'
-        modstr = '\n'.join(['  ({key}): {block}'.format(
-            key=key, block=str(block)) for key, block in self._children.items()])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
+        rows = '\n'.join('  (%s): %s' % (key, block)
+                         for key, block in self._children.items())
+        return '%s(\n%s\n)' % (type(self).__name__, rows)
 
     def __getitem__(self, key):
-        layers = list(self._children.values())[key]
-        if isinstance(layers, list):
-            net = type(self)(prefix=self._prefix)
-            with net.name_scope():
-                net.add(*layers)
-            return net
-        return layers
+        picked = list(self._children.values())[key]
+        if not isinstance(picked, list):
+            return picked
+        sub = type(self)(prefix=self._prefix)
+        with sub.name_scope():
+            sub.add(*picked)
+        return sub
 
     def __len__(self):
         return len(self._children)
+
+
+class Sequential(_SequentialOps, Block):
+    """Eager pipeline of Blocks (reference: basic_layers.py
+    Sequential)."""
+
+    def forward(self, x):
+        return self._chain(x)
 
     def hybridize(self, active=True, **kwargs):
         if self._children and all(isinstance(c, HybridBlock)
                                   for c in self._children.values()):
-            import warnings
             warnings.warn(
-                'All children of this Sequential layer \'%s\' are '
-                'HybridBlocks. Consider using HybridSequential for the best '
-                'performance.' % self.prefix, stacklevel=2)
+                "All children of this Sequential layer '%s' are "
+                'HybridBlocks. Consider using HybridSequential for the '
+                'best performance.' % self.prefix, stacklevel=2)
         super().hybridize(active, **kwargs)
 
 
-class HybridSequential(HybridBlock):
-    """Stacks HybridBlocks sequentially (jit-compilable as one graph)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
+class HybridSequential(_SequentialOps, HybridBlock):
+    """Pipeline of HybridBlocks — traces into one XLA graph."""
 
     def hybrid_forward(self, F, x):
-        for block in self._children.values():
-            x = block(x)
-        return x
-
-    def __repr__(self):
-        s = '{name}(\n{modstr}\n)'
-        modstr = '\n'.join(['  ({key}): {block}'.format(
-            key=key, block=str(block)) for key, block in self._children.items()])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
-
-    def __getitem__(self, key):
-        layers = list(self._children.values())[key]
-        if isinstance(layers, list):
-            net = type(self)(prefix=self._prefix)
-            with net.name_scope():
-                net.add(*layers)
-            return net
-        return layers
-
-    def __len__(self):
-        return len(self._children)
+        return self._chain(x)
 
 
 class Dense(HybridBlock):
-    """Fully-connected layer: out = act(dot(x, w.T) + b)
-    (reference: basic_layers.py:142; op FullyConnected → one MXU matmul)."""
+    """Fully connected: out = act(x · Wᵀ + b) (reference:
+    basic_layers.py:142; the FullyConnected op is one MXU matmul)."""
 
     def __init__(self, units, activation=None, use_bias=True, flatten=True,
                  dtype='float32', weight_initializer=None,
                  bias_initializer='zeros', in_units=0, **kwargs):
         super().__init__(**kwargs)
+        self._units, self._in_units = units, in_units
         self._flatten = flatten
         with self.name_scope():
-            self._units = units
-            self._in_units = in_units
             self.weight = self.params.get(
                 'weight', shape=(units, in_units), init=weight_initializer,
                 dtype=dtype, allow_deferred_init=True)
-            if use_bias:
-                self.bias = self.params.get(
-                    'bias', shape=(units,), init=bias_initializer,
-                    dtype=dtype, allow_deferred_init=True)
-            else:
-                self.bias = None
-            if activation is not None:
-                self.act = Activation(activation, prefix=activation + '_')
-            else:
-                self.act = None
+            self.bias = None if not use_bias else self.params.get(
+                'bias', shape=(units,), init=bias_initializer,
+                dtype=dtype, allow_deferred_init=True)
+            self.act = None if activation is None else \
+                Activation(activation, prefix=activation + '_')
 
     def infer_shape(self, x, *args):
         if self._in_units == 0:
-            in_units = int(onp.prod(x.shape[1:])) if self._flatten \
+            fan_in = int(onp.prod(x.shape[1:])) if self._flatten \
                 else x.shape[-1]
-            self.weight.shape = (self._units, in_units)
+            self.weight.shape = (self._units, fan_in)
 
     def hybrid_forward(self, F, x, weight, bias=None):
-        act = F.FullyConnected(x, weight, bias, no_bias=bias is None,
-                               num_hidden=self._units, flatten=self._flatten,
-                               name='fwd')
-        if self.act is not None:
-            act = self.act(act)
-        return act
+        out = F.FullyConnected(x, weight, bias, no_bias=bias is None,
+                               num_hidden=self._units,
+                               flatten=self._flatten, name='fwd')
+        return out if self.act is None else self.act(out)
 
     def __repr__(self):
-        s = '{name}({layout}, {act})'
-        shape = self.weight.shape
-        return s.format(name=self.__class__.__name__,
-                        act=self.act if self.act else 'linear',
-                        layout='{0} -> {1}'.format(
-                            shape[1] if shape[1] else None, shape[0]))
+        fan_in, fan_out = self.weight.shape[1], self.weight.shape[0]
+        return '%s(%s -> %s, %s)' % (type(self).__name__,
+                                     fan_in if fan_in else None, fan_out,
+                                     self.act if self.act else 'linear')
 
 
 class Dropout(HybridBlock):
-    """Dropout regularization (reference: basic_layers.py Dropout)."""
+    """Inverted dropout; identity at rate 0 (reference:
+    basic_layers.py Dropout)."""
 
     def __init__(self, rate, axes=(), **kwargs):
         super().__init__(**kwargs)
-        self._rate = rate
-        self._axes = axes
+        self._rate, self._axes = rate, axes
 
     def hybrid_forward(self, F, x):
-        if self._rate > 0:
-            return F.Dropout(x, p=self._rate, axes=self._axes, name='fwd',
-                             cudnn_off=False)
-        return F.identity(x)
+        if not self._rate:
+            return F.identity(x)
+        return F.Dropout(x, p=self._rate, axes=self._axes, name='fwd',
+                         cudnn_off=False)
 
     def __repr__(self):
-        s = '{name}(p = {_rate}, axes={_axes})'
-        return s.format(name=self.__class__.__name__, **self.__dict__)
+        return '%s(p = %s, axes=%s)' % (type(self).__name__, self._rate,
+                                        self._axes)
+
+
+def _affine_pair(layer, in_channels, scale, center, gamma_init, beta_init,
+                 track_differentiable=False):
+    """Declare the gamma/beta pair every norm layer carries; fixed
+    (grad_req='null') when scale/center is off. BatchNorm additionally
+    pins the differentiable flag to the same switches."""
+    extra_g = {'differentiable': bool(scale)} if track_differentiable else {}
+    extra_b = {'differentiable': bool(center)} if track_differentiable else {}
+    layer.gamma = layer.params.get(
+        'gamma', grad_req='write' if scale else 'null',
+        shape=(in_channels,), init=gamma_init, allow_deferred_init=True,
+        **extra_g)
+    layer.beta = layer.params.get(
+        'beta', grad_req='write' if center else 'null',
+        shape=(in_channels,), init=beta_init, allow_deferred_init=True,
+        **extra_b)
+
+
+def _kwargs_repr(layer):
+    body = ', '.join('%s=%r' % kv for kv in layer._kwargs.items())
+    width = layer.gamma.shape[0]
+    return '%s(%s, in_channels=%s)' % (type(layer).__name__, body,
+                                       width if width else None)
 
 
 class BatchNorm(HybridBlock):
-    """Batch normalization with moving statistics
-    (reference: basic_layers.py BatchNorm; op nn/batch_norm.cc).
+    """Batch normalization with moving statistics (reference:
+    basic_layers.py BatchNorm; op nn/batch_norm.cc).
 
-    The moving-average update — in-op aux mutation in the reference — is
-    published through record_aux_update so it works both eagerly and as an
-    extra output of the jit-compiled graph.
-    """
+    The moving-average update — in-op aux mutation in the reference —
+    is published through record_aux_update so it works both eagerly and
+    as an extra output of the jit-compiled graph."""
 
     def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
-                 scale=True, use_global_stats=False, beta_initializer='zeros',
-                 gamma_initializer='ones', running_mean_initializer='zeros',
-                 running_variance_initializer='ones', in_channels=0, **kwargs):
+                 scale=True, use_global_stats=False,
+                 beta_initializer='zeros', gamma_initializer='ones',
+                 running_mean_initializer='zeros',
+                 running_variance_initializer='ones', in_channels=0,
+                 **kwargs):
         super().__init__(**kwargs)
         self._kwargs = {'axis': axis, 'eps': epsilon, 'momentum': momentum,
                         'fix_gamma': not scale,
                         'use_global_stats': use_global_stats}
-        self._axis = axis
-        self._momentum = momentum
+        self._axis, self._momentum = axis, momentum
         self._use_global_stats = use_global_stats
         if in_channels != 0:
             self.in_channels = in_channels
         with self.name_scope():
-            self.gamma = self.params.get(
-                'gamma', grad_req='write' if scale else 'null',
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True, differentiable=scale)
-            self.beta = self.params.get(
-                'beta', grad_req='write' if center else 'null',
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True, differentiable=center)
+            _affine_pair(self, in_channels, scale, center,
+                         gamma_initializer, beta_initializer,
+                         track_differentiable=True)
             self.running_mean = self.params.get(
                 'running_mean', grad_req='null', shape=(in_channels,),
                 init=running_mean_initializer, allow_deferred_init=True,
                 differentiable=False)
             self.running_var = self.params.get(
                 'running_var', grad_req='null', shape=(in_channels,),
-                init=running_variance_initializer, allow_deferred_init=True,
-                differentiable=False)
+                init=running_variance_initializer,
+                allow_deferred_init=True, differentiable=False)
 
     def infer_shape(self, x, *args):
-        ch = x.shape[self._axis]
-        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
-            p.shape = (ch,)
+        width = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var):
+            p.shape = (width,)
 
     def cast(self, dtype):
+        # fp16 statistics destabilise training; keep norm math in fp32
         if onp.dtype(dtype).name == 'float16':
             dtype = 'float32'
         super().cast(dtype)
@@ -217,68 +209,66 @@ class BatchNorm(HybridBlock):
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         ret = F.BatchNorm(x, gamma, beta, running_mean, running_var,
                           name='fwd', output_mean_var=True, **self._kwargs)
-        if isinstance(ret, (tuple, list)):
-            out, mean, var = ret
-        else:
+        if not isinstance(ret, (tuple, list)):
             # symbolic composition: mean/var are hidden outputs
             # (reference FNumVisibleOutputs) and the aux update below is
             # an eager-training concern only
             return ret
+        out, batch_mean, batch_var = ret
         if autograd.is_training() and not self._use_global_stats:
-            m = self._momentum
+            keep = self._momentum
             with autograd.pause():
-                record_aux_update(self.running_mean,
-                                  m * running_mean + (1 - m) * mean.detach())
-                record_aux_update(self.running_var,
-                                  m * running_var + (1 - m) * var.detach())
+                record_aux_update(
+                    self.running_mean,
+                    keep * running_mean + (1 - keep) * batch_mean.detach())
+                record_aux_update(
+                    self.running_var,
+                    keep * running_var + (1 - keep) * batch_var.detach())
         return out
 
     def __repr__(self):
-        s = '{name}({content}'
-        in_channels = self.gamma.shape[0]
-        s += ', in_channels={0}'.format(in_channels if in_channels else None)
-        s += ')'
-        return s.format(name=self.__class__.__name__,
-                        content=', '.join(['='.join([k, v.__repr__()])
-                                           for k, v in self._kwargs.items()]))
+        return _kwargs_repr(self)
 
 
 class Embedding(HybridBlock):
-    """Turns int indices into dense vectors
-    (reference: basic_layers.py Embedding; gather on TPU)."""
+    """Int indices -> dense rows of a learned table (reference:
+    basic_layers.py Embedding; one gather on TPU)."""
 
     def __init__(self, input_dim, output_dim, dtype='float32',
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
-        grad_stype = 'row_sparse' if sparse_grad else 'default'
         self._kwargs = {'input_dim': input_dim, 'output_dim': output_dim,
                         'dtype': dtype, 'sparse_grad': sparse_grad}
         with self.name_scope():
             self.weight = self.params.get(
                 'weight', shape=(input_dim, output_dim),
                 init=weight_initializer, dtype=dtype,
-                allow_deferred_init=True, grad_stype=grad_stype)
+                allow_deferred_init=True,
+                grad_stype='row_sparse' if sparse_grad else 'default')
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, name='fwd', **self._kwargs)
 
     def __repr__(self):
-        s = '{block_name}({input_dim} -> {output_dim}, {dtype})'
-        return s.format(block_name=self.__class__.__name__, **self._kwargs)
+        return '%s(%s -> %s, %s)' % (
+            type(self).__name__, self._kwargs['input_dim'],
+            self._kwargs['output_dim'], self._kwargs['dtype'])
 
 
 class Flatten(HybridBlock):
-    """Flattens input to (batch, -1) (reference: basic_layers.py Flatten)."""
+    """Collapse all non-batch axes (reference: basic_layers.py
+    Flatten)."""
 
     def hybrid_forward(self, F, x):
         return F.Flatten(x)
 
     def __repr__(self):
-        return self.__class__.__name__
+        return type(self).__name__
 
 
 class InstanceNorm(HybridBlock):
-    """Instance normalization (reference: basic_layers.py InstanceNorm)."""
+    """Per-sample, per-channel normalization (reference:
+    basic_layers.py InstanceNorm)."""
 
     def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
                  beta_initializer='zeros', gamma_initializer='ones',
@@ -286,45 +276,33 @@ class InstanceNorm(HybridBlock):
         super().__init__(**kwargs)
         self._kwargs = {'eps': epsilon, 'axis': axis, 'center': center,
                         'scale': scale}
-        self._axis = axis
-        self._epsilon = epsilon
+        self._axis, self._epsilon = axis, epsilon
         self.in_channels = in_channels
         with self.name_scope():
-            self.gamma = self.params.get(
-                'gamma', grad_req='write' if scale else 'null',
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True)
-            self.beta = self.params.get(
-                'beta', grad_req='write' if center else 'null',
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True)
+            _affine_pair(self, in_channels, scale, center,
+                         gamma_initializer, beta_initializer)
 
     def infer_shape(self, x, *args):
-        ch = x.shape[self._axis]
-        for p in (self.gamma, self.beta):
-            p.shape = (ch,)
+        width = x.shape[self._axis]
+        self.gamma.shape = self.beta.shape = (width,)
 
     def hybrid_forward(self, F, x, gamma, beta):
         if self._axis == 1:
             return F.InstanceNorm(x, gamma, beta, name='fwd',
                                   eps=self._epsilon)
-        x = x.swapaxes(1, self._axis)
-        return F.InstanceNorm(x, gamma, beta, name='fwd',
-                              eps=self._epsilon).swapaxes(1, self._axis)
+        # op normalises axis 1; swap the target axis in and back out
+        swapped = x.swapaxes(1, self._axis)
+        normed = F.InstanceNorm(swapped, gamma, beta, name='fwd',
+                                eps=self._epsilon)
+        return normed.swapaxes(1, self._axis)
 
     def __repr__(self):
-        s = '{name}({content}'
-        in_channels = self.gamma.shape[0]
-        s += ', in_channels={0}'.format(in_channels)
-        s += ')'
-        return s.format(name=self.__class__.__name__,
-                        content=', '.join(['='.join([k, v.__repr__()])
-                                           for k, v in self._kwargs.items()]))
+        return _kwargs_repr(self)
 
 
 class LayerNorm(HybridBlock):
-    """Layer normalization over the last axis
-    (reference: basic_layers.py LayerNorm; nn/layer_norm.cc)."""
+    """Normalize over one axis with learned affine (reference:
+    basic_layers.py LayerNorm; nn/layer_norm.cc)."""
 
     def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer='zeros', gamma_initializer='ones',
@@ -332,86 +310,67 @@ class LayerNorm(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._kwargs = {'eps': epsilon, 'axis': axis, 'center': center,
                         'scale': scale}
-        self._axis = axis
-        self._epsilon = epsilon
-        self._center = center
-        self._scale = scale
+        self._axis, self._epsilon = axis, epsilon
+        self._center, self._scale = center, scale
         with self.name_scope():
-            self.gamma = self.params.get(
-                'gamma', grad_req='write' if scale else 'null',
-                shape=(in_channels,), init=gamma_initializer,
-                allow_deferred_init=True)
-            self.beta = self.params.get(
-                'beta', grad_req='write' if center else 'null',
-                shape=(in_channels,), init=beta_initializer,
-                allow_deferred_init=True)
+            _affine_pair(self, in_channels, scale, center,
+                         gamma_initializer, beta_initializer)
 
     def infer_shape(self, x, *args):
-        ch = x.shape[self._axis]
-        for p in (self.gamma, self.beta):
-            p.shape = (ch,)
+        width = x.shape[self._axis]
+        self.gamma.shape = self.beta.shape = (width,)
 
     def hybrid_forward(self, F, x, gamma, beta):
-        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
+        return F.LayerNorm(x, gamma, beta, axis=self._axis,
+                           eps=self._epsilon)
 
     def __repr__(self):
-        s = '{name}({content}'
-        in_channels = self.gamma.shape[0]
-        s += ', in_channels={0}'.format(in_channels)
-        s += ')'
-        return s.format(name=self.__class__.__name__,
-                        content=', '.join(['='.join([k, v.__repr__()])
-                                           for k, v in self._kwargs.items()]))
+        return _kwargs_repr(self)
+
+
+def _resolve_nd_function(function, eager):
+    """Resolve a Lambda spec: op name, or a callable passed through."""
+    from ... import ndarray as nd
+    if isinstance(function, str):
+        if not hasattr(nd, function):
+            raise AssertionError(
+                'Function name %s is not found in ndarray.' % function)
+        if eager:
+            return getattr(nd, function), function
+        return (lambda F, *args: getattr(F, function)(*args)), function
+    if callable(function):
+        return function, getattr(function, '__name__', 'custom')
+    raise ValueError('Unrecognized function in lambda: {} of type {}'
+                     .format(function, type(function)))
 
 
 class Lambda(Block):
-    """Wraps a function as a Block (reference: basic_layers.py Lambda)."""
+    """Wrap a function (or nd op name) as an eager Block (reference:
+    basic_layers.py Lambda)."""
 
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
-        from ... import ndarray as nd
-        if isinstance(function, str):
-            assert hasattr(nd, function), \
-                'Function name %s is not found in ndarray.' % function
-            self._func_impl = getattr(nd, function)
-        elif callable(function):
-            self._func_impl = function
-        else:
-            raise ValueError(
-                'Unrecognized function in lambda: {} of type {}'.format(
-                    function, type(function)))
-        self._func_name = getattr(self._func_impl, '__name__', 'custom')
+        self._func_impl, self._func_name = _resolve_nd_function(
+            function, eager=True)
 
     def forward(self, *args):
         return self._func_impl(*args)
 
     def __repr__(self):
-        return '{name}({function})'.format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return '%s(%s)' % (type(self).__name__, self._func_name)
 
 
 class HybridLambda(HybridBlock):
-    """Wraps a function as a HybridBlock (reference: HybridLambda)."""
+    """Wrap a function (or op name) as a HybridBlock; the callable sees
+    F explicitly (reference: basic_layers.py HybridLambda)."""
 
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
-        from ... import ndarray as nd
-        if isinstance(function, str):
-            assert hasattr(nd, function), \
-                'Function name %s is not found in ndarray.' % function
-            self._func = lambda F, *args: getattr(F, function)(*args)
-            self._func_name = function
-        elif callable(function):
-            self._func = function
-            self._func_name = getattr(function, '__name__', 'custom')
-        else:
-            raise ValueError(
-                'Unrecognized function in lambda: {} of type {}'.format(
-                    function, type(function)))
+        self._func, self._func_name = _resolve_nd_function(
+            function, eager=False)
 
     def hybrid_forward(self, F, x, *args):
         return self._func(F, x, *args)
 
     def __repr__(self):
-        return '{name}({function})'.format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return '%s(%s)' % (type(self).__name__, self._func_name)

@@ -1,14 +1,17 @@
-"""Gluon Parameter / ParameterDict.
+"""Gluon Parameter / Constant / ParameterDict.
 
 Reference parity: python/mxnet/gluon/parameter.py (Parameter :43 with
-deferred shape inference, grad_req, _reduce :312; Constant; ParameterDict
-:632). TPU-native detail: a parameter owns ONE logical NDArray — replication
-and sharding across chips are handled by pjit sharding specs in the parallel
-layer, not by per-device copies (the reference's list-of-NDArrays-per-ctx
-model maps to a sharded jax.Array).
+deferred shape inference, grad_req, _reduce :312; Constant;
+ParameterDict :632). TPU-native detail: a parameter owns ONE logical
+NDArray — replication and sharding across chips are handled by pjit
+sharding specs in the parallel layer, not by per-device copies (the
+reference's list-of-NDArrays-per-ctx model maps to a sharded
+jax.Array), so every list_*/ctx method is a thin view over that single
+array.
 """
 from __future__ import annotations
 
+import warnings
 from collections import OrderedDict
 
 import numpy as onp
@@ -25,46 +28,59 @@ __all__ = ['DeferredInitializationError', 'Parameter', 'Constant',
 
 tensor_types = (NDArray,)
 
+_VALID_STYPES = ('default', 'row_sparse', 'csr')
+_VALID_GRAD_REQS = ('write', 'add', 'null')
+_NOT_DEFERRED = ()   # sentinel: no deferred-init record pending
+
 
 class DeferredInitializationError(MXNetError):
-    """Error for unfinished deferred initialization."""
+    """Raised when a deferred-init parameter is read before the first
+    forward pass has fixed its shape."""
+
+
+def _as_ctx_list(ctx):
+    if isinstance(ctx, Context):
+        return [ctx]
+    return [current_context()] if ctx is None else list(ctx)
+
+
+def _shapes_compatible(declared, concrete):
+    """Every declared dim must be unknown (0/-1) or equal."""
+    return len(declared) == len(concrete) and all(
+        d in (0, -1, c) for d, c in zip(declared, concrete))
 
 
 class Parameter:
-    """A Container holding parameters (weights) of Blocks
-    (reference: gluon/parameter.py:43)."""
+    """One weight of a Block: storage, gradient buffer, init policy,
+    per-param lr/wd multipliers (reference: gluon/parameter.py:43)."""
 
     def __init__(self, name, grad_req='write', shape=None, dtype='float32',
-                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
-                 differentiable=True, stype='default', grad_stype='default'):
-        self._var = None
-        self._data = None
-        self._grad = None
-        self._ctx_list = None
-        self._deferred_init = ()
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype='default', grad_stype='default'):
+        self.name, self.init = name, init
+        self.lr_mult, self.wd_mult = lr_mult, wd_mult
+        self._var = self._data = self._grad = self._ctx_list = None
+        self._deferred_init = _NOT_DEFERRED
         self._differentiable = differentiable
         self._allow_deferred_init = allow_deferred_init
-        self._grad_req = None
-        if isinstance(shape, int):
-            shape = (shape,)
-        self._shape = shape
-        self.name = name
+        self._shape = (shape,) if isinstance(shape, int) else shape
         self._dtype = dtype
-        self.lr_mult = lr_mult
-        self.wd_mult = wd_mult
+        self._grad_req = None
         self.grad_req = grad_req
-        self.init = init
-        for st, arg in [(stype, 'stype'), (grad_stype, 'grad_stype')]:
-            if st not in ('default', 'row_sparse', 'csr'):
-                raise ValueError("Invalid {} '{}': must be one of 'default', "
-                                 "'row_sparse', 'csr'".format(arg, st))
+        for arg, st in (('stype', stype), ('grad_stype', grad_stype)):
+            if st not in _VALID_STYPES:
+                raise ValueError(
+                    "Invalid {} '{}': must be one of 'default', "
+                    "'row_sparse', 'csr'".format(arg, st))
         # sparse storage is emulated densely (SURVEY §7 hard part 3)
-        self._stype = stype
-        self._grad_stype = grad_stype
+        self._stype, self._grad_stype = stype, grad_stype
 
     def __repr__(self):
-        s = 'Parameter {name} (shape={shape}, dtype={dtype})'
-        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+        return 'Parameter %s (shape=%s, dtype=%s)' % (
+            self.name, self.shape, self.dtype)
+
+    # -- declarative attributes --------------------------------------------
 
     @property
     def grad_req(self):
@@ -72,14 +88,16 @@ class Parameter:
 
     @grad_req.setter
     def grad_req(self, req):
-        assert req in ['write', 'add', 'null'], \
-            "grad_req must be one of 'write', 'add', or 'null', but got '%s'" % req
+        if req not in _VALID_GRAD_REQS:
+            raise AssertionError(
+                "grad_req must be one of 'write', 'add', or 'null', "
+                "but got '%s'" % req)
         if not self._differentiable:
             req = 'null'
-        if self._grad_req == req:
+        changed, self._grad_req = self._grad_req != req, req
+        if not changed:
             return
-        self._grad_req = req
-        if req == 'null' and self._grad is not None:
+        if req == 'null':
             self._grad = None
             if self._data is not None:
                 self._data._grad = None
@@ -101,80 +119,83 @@ class Parameter:
 
     @shape.setter
     def shape(self, new_shape):
-        if self._shape is None:
-            self._shape = new_shape
-            return
-        assert len(self._shape) == len(new_shape) and \
-            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
-            'Expected shape %s is incompatible with given shape %s.' % (
-                str(new_shape), str(self._shape))
+        if self._shape is not None and \
+                not _shapes_compatible(self._shape, new_shape):
+            raise AssertionError(
+                'Expected shape %s is incompatible with given shape %s.'
+                % (str(new_shape), str(self._shape)))
         self._shape = new_shape
+
+    # -- materialisation ---------------------------------------------------
 
     def _check_and_get(self, arr, ctx):
         if arr is not None:
             return arr
         if self._deferred_init:
-            raise DeferredInitializationError(
-                'Parameter \'%s\' has not been initialized yet because '
-                'initialization was deferred. Actual initialization happens '
-                'during the first forward pass. Please pass one batch of '
-                'data through the network before accessing Parameters.'
-                % self.name)
-        raise RuntimeError(
-            "Parameter '%s' has not been initialized. Note that you should "
-            'initialize parameters and create Trainer with Block.collect_params() '
-            'instead of Block.params because the later does not include '
-            'Parameters of nested child Blocks' % self.name)
+            raise DeferredInitializationError(  # still shapeless
+                "Parameter '%s' has not been initialized yet because "
+                'initialization was deferred. Actual initialization '
+                'happens during the first forward pass. Please pass one '
+                'batch of data through the network before accessing '
+                'Parameters.' % self.name)
+        raise RuntimeError(  # never initialized at all
+            "Parameter '%s' has not been initialized. Note that you "
+            'should initialize parameters and create Trainer with '
+            'Block.collect_params() instead of Block.params because the '
+            'later does not include Parameters of nested child Blocks'
+            % self.name)
 
-    def _load_init(self, data, ctx, cast_dtype=False, dtype_source='current'):
+    def _load_init(self, data, ctx, cast_dtype=False,
+                   dtype_source='current'):
+        """Adopt a loaded array, reconciling declared shape/dtype."""
         if self.shape:
-            unknown_dim_size = -1 in self.shape or 0 in self.shape
-            for self_dim, data_dim in zip(self.shape, data.shape):
-                assert self_dim in (0, -1, data_dim), \
-                    "Failed loading Parameter '%s' from saved params: shape " \
-                    'incompatible expected %s vs saved %s' % (
-                        self.name, str(self.shape), str(data.shape))
-            if unknown_dim_size:
+            if not _shapes_compatible(self.shape, data.shape):
+                raise AssertionError(
+                    "Failed loading Parameter '%s' from saved params: "
+                    'shape incompatible expected %s vs saved %s'
+                    % (self.name, str(self.shape), str(data.shape)))
+            if any(d in (0, -1) for d in self.shape):
                 self._shape = data.shape
-        if self.dtype and not cast_dtype:
-            if onp.dtype(self.dtype).type != data.dtype.type:
-                data = data.astype(self.dtype)
-        elif cast_dtype:
-            if dtype_source == 'saved':
-                self._dtype = data.dtype
-            else:
-                data = data.astype(self.dtype)
-        if self._data is None:
-            self._init_impl(data, ctx)
-        else:
+        if cast_dtype and dtype_source == 'saved':
+            self._dtype = data.dtype
+        elif self.dtype is not None and \
+                onp.dtype(self.dtype).type != data.dtype.type:
+            data = data.astype(self.dtype)
+        if self._data is not None:
             self.set_data(data)
-        self._deferred_init = ()
+        else:
+            self._init_impl(data, ctx)
+        self._deferred_init = _NOT_DEFERRED
 
     def _finish_deferred_init(self):
         if not self._deferred_init:
             return
         init, ctx, default_init, data = self._deferred_init
-        self._deferred_init = ()
-        assert self.shape is not None and onp.prod(self.shape) > 0, \
-            'Cannot initialize Parameter \'%s\' because it has invalid shape: ' \
-            '%s. Please specify in_units, in_channels, etc for `Block`s.' % (
-                self.name, str(self.shape))
+        self._deferred_init = _NOT_DEFERRED
+        if self.shape is None or onp.prod(self.shape) <= 0:
+            raise AssertionError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                'shape: %s. Please specify in_units, in_channels, etc '
+                'for `Block`s.' % (self.name, str(self.shape)))
         if data is None:
             data = nd.zeros(self.shape, dtype=self.dtype,
                             ctx=ctx[0] if ctx else None)
             # the resolved init always goes through _init_weight — Gluon
             # layers set explicit per-param inits; the reference encodes
-            # this as InitDesc attrs['__init__'] → create(init)._init_weight
+            # this as InitDesc attrs['__init__'] →
+            # create(init)._init_weight
             resolved = initializer.create(
-                init if init is not None else default_init)
+                default_init if init is None else init)
+            desc = initializer.InitDesc(self.name)
             if isinstance(resolved, initializer.Initializer):
-                resolved._init_weight(initializer.InitDesc(self.name), data)
+                resolved._init_weight(desc, data)
             else:
-                resolved(initializer.InitDesc(self.name), data)
+                resolved(desc, data)
         self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):
-        self._ctx_list = list(ctx_list) if ctx_list else [current_context()]
+        self._ctx_list = list(ctx_list) if ctx_list \
+            else [current_context()]
         if not isinstance(data, NDArray):
             data = nd.array(data, dtype=self.dtype)
         self._data = data
@@ -190,73 +211,69 @@ class Parameter:
             # take the lazy row-masked path (reference: parameter.py
             # grad_stype -> sparse grad arrays)
             from ..ndarray.sparse import RowSparseNDArray
-            g = self._data.grad
-            rs = RowSparseNDArray(g._data)
-            rs._grad_req = g._grad_req
-            self._data._grad = rs
+            dense_grad = self._data.grad
+            sparse_view = RowSparseNDArray(dense_grad._data)
+            sparse_view._grad_req = dense_grad._grad_req
+            self._data._grad = sparse_view
         self._grad = self._data.grad
 
     def _reduce(self):
-        """Reduce data from multiple contexts to cpu (reference: :312) —
-        with one logical array this is a copy to host."""
+        """Host copy of the (single logical) value (reference: :312
+        averages per-ctx copies; sharded arrays gather on fetch)."""
         return self.data().as_in_context(cpu())
 
     def initialize(self, init=None, ctx=None, default_init=None,
                    force_reinit=False):
-        """Initialize parameter and gradient arrays
-        (reference: parameter.py initialize)."""
+        """Materialise value+grad now, or record a deferred init if the
+        shape is still unknown (reference: parameter.py initialize)."""
         if default_init is None:
             default_init = initializer.Uniform()
         if self._data is not None and not force_reinit:
             return
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
+        ctx = _as_ctx_list(ctx)
         if init is None:
             init = self.init if self.init is not None else default_init
-        if not self.shape or onp.prod(self.shape) <= 0:
-            if self._allow_deferred_init:
-                self._deferred_init = (init, ctx, default_init, None)
-                return
-            raise ValueError('Cannot initialize Parameter \'%s\' because it '
-                             'has invalid shape: %s.' % (self.name, str(self.shape)))
+        shapeless = not self.shape or onp.prod(self.shape) <= 0
+        if shapeless and not self._allow_deferred_init:
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has "
+                'invalid shape: %s.' % (self.name, str(self.shape)))
         self._deferred_init = (init, ctx, default_init, None)
-        self._finish_deferred_init()
+        if not shapeless:
+            self._finish_deferred_init()
 
     def reset_ctx(self, ctx):
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
+        ctx = _as_ctx_list(ctx)
         if self._data is not None:
             self._data = self._data.as_in_context(ctx[0])
-            self._ctx_list = list(ctx)
+            self._ctx_list = ctx
             self._init_grad()
         elif self._deferred_init:
             init, _, default_init, data = self._deferred_init
-            self._deferred_init = (init, ctx, default_init, data)
+            self._deferred_init = (init, ctx, default_init, data)  # re-home
         else:
-            raise ValueError('Cannot reset context for Parameter \'%s\' because it '
-                             'has not been initialized.' % self.name)
+            raise ValueError(
+                "Cannot reset context for Parameter '%s' because it has "
+                'not been initialized.' % self.name)
 
     def set_data(self, data):
-        """Set this parameter's value on all contexts."""
+        """Overwrite the value in place, keeping autograd attachment and
+        grad buffer identity."""
         self.shape = data.shape
         if self._data is None:
-            assert self._deferred_init, \
-                'Parameter \'%s\' has not been initialized' % self.name
-            self._deferred_init = self._deferred_init[:3] + (
-                data if isinstance(data, NDArray) else nd.array(data),)
+            if not self._deferred_init:
+                raise AssertionError(
+                    "Parameter '%s' has not been initialized" % self.name)
+            pending = data if isinstance(data, NDArray) else nd.array(data)
+            self._deferred_init = self._deferred_init[:3] + (pending,)
             return
-        entry = self._data._entry
-        grad = self._data._grad
-        req = self._data._grad_req
-        self._data._data = (data._data if isinstance(data, NDArray)
-                            else nd.array(data)._data)
-        self._data._entry = entry
-        self._data._grad = grad
-        self._data._grad_req = req
+        holder = self._data
+        keep = (holder._entry, holder._grad, holder._grad_req)
+        holder._data = (data if isinstance(data, NDArray)
+                        else nd.array(data))._data
+        holder._entry, holder._grad, holder._grad_req = keep
+
+    # -- accessors ---------------------------------------------------------
 
     def row_sparse_data(self, row_id):
         """Sparse parity shim: dense storage, full fetch."""
@@ -266,12 +283,11 @@ class Parameter:
         return [self.data()]
 
     def data(self, ctx=None):
-        """Return a (the) copy of this parameter
-        (reference: parameter.py data)."""
+        """The value array (reference: parameter.py data)."""
         return self._check_and_get(self._data, ctx)
 
     def list_data(self):
-        return [self._check_and_get(self._data, None)]
+        return [self.data()]
 
     def grad(self, ctx=None):
         if self._data is not None and self._grad is None:
@@ -284,21 +300,21 @@ class Parameter:
         return [self.grad()]
 
     def list_ctx(self):
-        if self._data is None:
-            if self._deferred_init:
-                return self._deferred_init[1]
-            raise RuntimeError("Parameter '%s' has not been initialized" % self.name)
-        return self._ctx_list
+        if self._data is not None:
+            return self._ctx_list
+        if self._deferred_init:
+            return self._deferred_init[1]
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized" % self.name)
 
     def zero_grad(self):
-        """Set gradient buffer to 0."""
-        if self._grad is None:
-            return
-        self._grad[:] = 0
-        self._data._grad_fresh = False
+        """Clear the gradient buffer in place."""
+        if self._grad is not None:
+            self._grad[:] = 0
+            self._data._grad_fresh = False
 
     def var(self):
-        """Return the symbolic variable for this parameter."""
+        """The symbolic variable carrying this parameter's attributes."""
         if self._var is None:
             from .. import symbol
             self._var = symbol.var(self.name, shape=self.shape,
@@ -309,32 +325,33 @@ class Parameter:
     def cast(self, dtype):
         from ..base import np_dtype
         self._dtype = dtype
-        if self._data is None:
-            return
-        self._data._data = self._data._data.astype(np_dtype(dtype))
-        self._init_grad()
+        if self._data is not None:
+            self._data._data = self._data._data.astype(np_dtype(dtype))
+            self._init_grad()
 
 
 class Constant(Parameter):
-    """A constant parameter for holding non-differentiable values
-    (reference: gluon/parameter.py Constant)."""
+    """Non-differentiable value holder (reference: gluon/parameter.py
+    Constant): registers a one-off initializer that copies the fixed
+    value in."""
 
     def __init__(self, name, value):
         if not isinstance(value, NDArray):
             value = nd.array(value)
         self.value = value
 
-        class Init(initializer.Initializer):
+        class _CopyValue(initializer.Initializer):
             def _init_weight(self, _, arr):
                 value.copyto(arr)
+
         init_name = 'Constant_{}_{}'.format(name, id(self))
-        initializer._INITIALIZER_REGISTRY[init_name.lower()] = Init
+        initializer._INITIALIZER_REGISTRY[init_name.lower()] = _CopyValue
         super().__init__(name, grad_req='null', shape=value.shape,
                          dtype=value.dtype, init=init_name)
 
     def __repr__(self):
-        return 'Constant {name} (shape={shape}, dtype={dtype})'.format(
-            name=self.name, shape=self.shape, dtype=self.dtype)
+        return 'Constant %s (shape=%s, dtype=%s)' % (
+            self.name, self.shape, self.dtype)
 
     @property
     def grad_req(self):
@@ -343,15 +360,31 @@ class Constant(Parameter):
     @grad_req.setter
     def grad_req(self, req):
         if req != 'null':
-            import warnings
             warnings.warn('Constant parameter "{}" does not support '
                           'grad_req other than "null", and new value "{}" '
                           'is ignored.'.format(self.name, req))
         self._grad_req = 'null'
 
 
+def _merge_declared_shape(requested, stored):
+    """Combine two partially-known shapes; None if they conflict."""
+    if len(requested) != len(stored):
+        return None
+    merged = []
+    for want, have in zip(requested, stored):
+        if want == have:
+            merged.append(want)
+        elif want in (0, -1):
+            merged.append(have)
+        elif have in (0, -1):
+            merged.append(want)
+        else:
+            return None
+    return tuple(merged)
+
+
 class ParameterDict:
-    """A dictionary managing a set of Parameters
+    """Ordered name -> Parameter mapping with optional sharing
     (reference: gluon/parameter.py:632)."""
 
     def __init__(self, prefix='', shared=None):
@@ -363,13 +396,19 @@ class ParameterDict:
         return self._params[key]
 
     def __repr__(self):
-        s = '{name}(\n{content}\n)'
-        name = self._prefix + ' ' if self._prefix else ''
-        return s.format(name=name, content='\n'.join(
-            [_indent('  {0}'.format(v), 2) for v in self.values()]))
+        head = self._prefix + ' ' if self._prefix else ''
+        body = '\n'.join(_indent('  {0}'.format(v), 2)
+                         for v in self.values())
+        return '{0}(\n{1}\n)'.format(head, body)
 
     def __iter__(self):
         return iter(self._params)
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __len__(self):
+        return len(self._params)
 
     def items(self):
         return self._params.items()
@@ -380,12 +419,6 @@ class ParameterDict:
     def values(self):
         return self._params.values()
 
-    def __contains__(self, key):
-        return key in self._params
-
-    def __len__(self):
-        return len(self._params)
-
     @property
     def prefix(self):
         return self._prefix
@@ -394,76 +427,75 @@ class ParameterDict:
         if name in self._params:
             return self._params[name]
         if self._shared is not None and name in self._shared._params:
-            self._params[name] = self._shared._params[name]
-            return self._shared._params[name]
+            borrowed = self._shared._params[name]
+            self._params[name] = borrowed
+            return borrowed
         return None
 
+    def _reconcile(self, param, name, attrs):
+        """Check requested attrs against an existing Parameter, merging
+        partially-known shapes/dtypes."""
+        for key, want in attrs.items():
+            have = getattr(param, key, None)
+            if have is None:
+                setattr(param, key, want)
+                continue
+            if key == 'shape' and len(want) == len(have):
+                merged = _merge_declared_shape(want, have)
+                if merged is not None:
+                    param._shape = merged
+                    continue
+            elif key == 'dtype' and onp.dtype(want) == onp.dtype(have):
+                continue
+            if want is not None and want != have:
+                raise AssertionError(
+                    "Cannot retrieve Parameter '%s' because desired "
+                    'attribute does not match with stored for attribute '
+                    "'%s': desired '%s' vs stored '%s'."
+                    % (name, key, str(want), str(have)))
+
     def get(self, name, **kwargs):
-        """Retrieve a Parameter with prefix+name, creating it if absent."""
-        name = self.prefix + name
-        param = self._get_impl(name)
-        if param is None:
-            param = Parameter(name, **kwargs)
-            self._params[name] = param
+        """Fetch (or create) prefix+name, reconciling declared attrs."""
+        full = self.prefix + name
+        entry = self._get_impl(full)
+        if entry is None:
+            entry = self._params[full] = Parameter(full, **kwargs)
         else:
-            for k, v in kwargs.items():
-                if hasattr(param, k) and getattr(param, k) is not None:
-                    existing = getattr(param, k)
-                    if k == 'shape' and len(v) == len(existing):
-                        inferred_shape = []
-                        matched = True
-                        for dim1, dim2 in zip(v, existing):
-                            if dim1 != dim2 and dim1 > 0 and dim2 > 0:
-                                matched = False
-                                break
-                            elif dim1 == dim2:
-                                inferred_shape.append(dim1)
-                            elif dim1 in (0, -1):
-                                inferred_shape.append(dim2)
-                            else:
-                                inferred_shape.append(dim1)
-                        if matched:
-                            param._shape = tuple(inferred_shape)
-                            continue
-                    elif k == 'dtype' and onp.dtype(v) == onp.dtype(existing):
-                        continue
-                    assert v is None or v == existing, \
-                        "Cannot retrieve Parameter '%s' because desired " \
-                        "attribute does not match with stored for attribute " \
-                        "'%s': desired '%s' vs stored '%s'." % (
-                            name, k, str(v), str(getattr(param, k)))
-                else:
-                    setattr(param, k, v)
-        return param
+            self._reconcile(entry, full, kwargs)
+        return entry
 
     def get_constant(self, name, value=None):
-        name = self.prefix + name
-        param = self._get_impl(name)
+        full = self.prefix + name
+        param = self._get_impl(full)
         if param is None:
             if value is None:
-                raise KeyError('No constant named \'{}\'. Please specify value '
-                               'if you want to create a new constant.'.format(name))
-            param = Constant(name, value)
-            self._params[name] = param
+                raise KeyError(
+                    "No constant named '{}'. Please specify value if you "
+                    'want to create a new constant.'.format(full))
+            param = self._params[full] = Constant(full, value)
         elif value is not None:
-            assert isinstance(param, Constant), \
-                "Parameter '{}' already exists but it is not a constant.".format(name)
+            if not isinstance(param, Constant):
+                raise AssertionError(
+                    "Parameter '{}' already exists but it is not a "
+                    'constant.'.format(full))
             if isinstance(value, NDArray):
                 value = value.asnumpy()
-            assert param.shape == value.shape and \
-                (param.value.asnumpy() == value).all(), \
-                "Constant '{}' already exists but its value doesn't match new value".format(name)
+            if param.shape != value.shape or \
+                    not (param.value.asnumpy() == value).all():
+                raise AssertionError(
+                    "Constant '{}' already exists but its value doesn't "
+                    'match new value'.format(full))
         return param
 
     def update(self, other):
-        """Copy all Parameters in ``other`` to self."""
-        for k, v in other.items():
-            if k in self._params:
-                assert self._params[k] is v, \
-                    'Cannot update self with other because they have different ' \
-                    'Parameters with the same name \'%s\'' % k
-            else:
-                self._params[k] = v
+        """Adopt every Parameter of ``other`` (identity-checked on name
+        collisions)."""
+        for name, param in other.items():
+            mine = self._params.setdefault(name, param)
+            if mine is not param:
+                raise AssertionError(
+                    'Cannot update self with other because they have '
+                    "different Parameters with the same name '%s'" % name)
 
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
@@ -471,70 +503,75 @@ class ParameterDict:
             init = initializer.Uniform()
         if verbose and hasattr(init, 'set_verbosity'):
             init.set_verbosity(verbose=verbose)
-        for _, v in self.items():
-            v.initialize(None, ctx, init, force_reinit=force_reinit)
+        for p in self.values():
+            p.initialize(None, ctx, init, force_reinit=force_reinit)
 
     def zero_grad(self):
-        for i in self.values():
-            i.zero_grad()
+        for p in self.values():
+            p.zero_grad()
 
     def reset_ctx(self, ctx):
-        for i in self.values():
-            i.reset_ctx(ctx)
+        for p in self.values():
+            p.reset_ctx(ctx)
 
     def list_ctx(self):
-        assert self._params, 'ParameterDict contains no parameters'
-        s = set()
-        for i in self.values():
-            s.update(i.list_ctx())
-        return list(s)
+        if not self._params:
+            raise AssertionError('ParameterDict contains no parameters')
+        ctxs = set()
+        for p in self.values():
+            ctxs.update(p.list_ctx())
+        return list(ctxs)
 
     def setattr(self, name, value):
-        for i in self.values():
-            setattr(i, name, value)
+        for p in self.values():
+            setattr(p, name, value)
 
     def save(self, filename, strip_prefix=''):
-        arg_dict = {}
-        for param in self.values():
-            weight = param._reduce()
-            if not param.name.startswith(strip_prefix):
+        """Write host copies keyed by (prefix-stripped) parameter name
+        in the reference .params layout."""
+        table = {}
+        for p in self.values():
+            if not p.name.startswith(strip_prefix):
                 raise ValueError(
                     "Prefix '%s' is to be striped before saving, but "
-                    "Parameter's name '%s' does not start with '%s'" % (
-                        strip_prefix, param.name, strip_prefix))
-            arg_dict[param.name[len(strip_prefix):]] = weight
-        nd.save(filename, arg_dict)
+                    "Parameter's name '%s' does not start with '%s'"
+                    % (strip_prefix, p.name, strip_prefix))
+            table[p.name[len(strip_prefix):]] = p._reduce()
+        nd.save(filename, table)
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix='', cast_dtype=False,
              dtype_source='current'):
         if restore_prefix:
             for name in self.keys():
-                assert name.startswith(restore_prefix), \
-                    "restore_prefix is '%s' but Parameter name '%s' does not " \
-                    'start with it' % (restore_prefix, name)
-        lprefix = len(restore_prefix)
-        loaded = nd.load(filename)
-        arg_dict = {(k[4:] if k.startswith(('arg:', 'aux:')) else k): v
-                    for k, v in loaded.items()}
-        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+                if not name.startswith(restore_prefix):
+                    raise AssertionError(
+                        "restore_prefix is '%s' but Parameter name '%s' "
+                        'does not start with it' % (restore_prefix, name))
+        strip = len(restore_prefix)
+        loaded = {
+            restore_prefix + (k[4:] if k.startswith(('arg:', 'aux:'))
+                              else k): v
+            for k, v in nd.load(filename).items()}
         if not allow_missing:
             for name in self.keys():
-                assert name in arg_dict, \
-                    "Parameter '%s' is missing in file '%s', which contains " \
-                    "parameters: %s. Set allow_missing=True to ignore missing " \
-                    'parameters.' % (name[lprefix:], filename,
-                                     _brief_print_list(arg_dict.keys()))
-        for name in arg_dict:
+                if name not in loaded:
+                    raise AssertionError(
+                        "Parameter '%s' is missing in file '%s', which "
+                        'contains parameters: %s. Set allow_missing=True '
+                        'to ignore missing parameters.'
+                        % (name[strip:], filename,
+                           _brief_print_list(loaded.keys())))
+        for name, value in loaded.items():
             if name not in self._params:
-                assert ignore_extra, \
-                    "Parameter '%s' loaded from file '%s' is not present in " \
-                    'ParameterDict, which contains parameters %s. Set ' \
-                    'ignore_extra=True to ignore.' % (
-                        name[lprefix:], filename,
-                        _brief_print_list(self._params.keys()))
+                if not ignore_extra:
+                    raise AssertionError(
+                        "Parameter '%s' loaded from file '%s' is not "
+                        'present in ParameterDict, which contains '
+                        'parameters %s. Set ignore_extra=True to ignore.'
+                        % (name[strip:], filename,
+                           _brief_print_list(self._params.keys())))
                 continue
-            self[name]._load_init(arg_dict[name], ctx, cast_dtype=cast_dtype,
-                                  dtype_source=dtype_source)
-
-
+            self._params[name]._load_init(
+                value, ctx, cast_dtype=cast_dtype,
+                dtype_source=dtype_source)

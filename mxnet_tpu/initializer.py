@@ -1,11 +1,12 @@
 """Weight initializers.
 
-Reference parity: python/mxnet/initializer.py (Uniform/Normal/Orthogonal/
-Xavier/MSRAPrelu/Bilinear/LSTMBias/FusedRNN :401-702) with the same
-name-pattern dispatch (``_weight``/``_bias``/``_gamma``...). TPU-native
-detail: values are produced with numpy on host then placed once on device —
-initialization is not a hot path, and host-side generation keeps the jit
-caches clean of init graphs.
+Reference parity: python/mxnet/initializer.py (Uniform/Normal/
+Orthogonal/Xavier/MSRAPrelu/Bilinear/LSTMBias/FusedRNN :401-702) with
+the same name-pattern dispatch (``_weight``/``_bias``/``_gamma``...),
+expressed as a suffix table rather than an if-chain. TPU-native detail:
+values are produced with numpy on host then placed once on device —
+initialization is not a hot path, and host-side generation keeps the
+jit caches clean of init graphs.
 """
 from __future__ import annotations
 
@@ -27,20 +28,19 @@ __all__ = ['InitDesc', 'Initializer', 'register', 'create', 'Zero', 'One',
 
 
 class InitDesc(str):
-    """Name + attrs descriptor passed to initializers
+    """Parameter name + attrs descriptor handed to initializers
     (reference: initializer.py InitDesc)."""
 
     def __new__(cls, name, attrs=None, global_init=None):
-        ret = super().__new__(cls, name)
-        ret.attrs = attrs or {}
-        ret.global_init = global_init
-        return ret
+        desc = super().__new__(cls, name)
+        desc.attrs = attrs or {}
+        desc.global_init = global_init
+        return desc
 
 
 def register(klass):
     """Register an initializer class under its lowercase name."""
-    name = klass.__name__.lower()
-    _INITIALIZER_REGISTRY[name] = klass
+    _INITIALIZER_REGISTRY[klass.__name__.lower()] = klass
     return klass
 
 
@@ -55,12 +55,22 @@ def create(initializer, **kwargs):
 
 
 class Initializer:
-    """Base initializer with MXNet's name-pattern dispatch."""
+    """Base initializer with MXNet's name-suffix dispatch."""
+
+    # (name suffix, handler method, verbose label); checked in order
+    _DISPATCH = (
+        ('weight_quantize', '_init_quantized_weight', None),
+        ('weight', '_init_weight', 'weight'),
+        ('bias', '_init_bias', 'bias'),
+        ('gamma', '_init_gamma', 'gamma'),
+        ('beta', '_init_beta', 'beta'),
+        ('min', '_init_zero', None),
+        ('max', '_init_one', None),
+    )
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
-        self._verbose = False
-        self._print_func = None
+        self._verbose, self._print_func = False, None
 
     def set_verbosity(self, verbose=False, print_func=None):
         self._verbose = verbose
@@ -69,7 +79,8 @@ class Initializer:
         return self
 
     def dumps(self):
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        """JSON [name, kwargs] form, re-creatable via ``create``."""
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
 
     def _verbose_print(self, desc, init, arr):
         if self._verbose and self._print_func:
@@ -81,52 +92,43 @@ class Initializer:
             desc = InitDesc(str(desc))
         if desc.global_init is None:
             desc.global_init = self
-        init = desc.attrs.get('__init__', '')
-        if init:
-            create(json.loads(init)[0], **json.loads(init)[1])._init_weight(desc, arr)
-            self._verbose_print(desc, init, arr)
+        spec = desc.attrs.get('__init__', '')
+        if spec:
+            # per-variable override: serialized [name, kwargs]
+            kind, kwargs = json.loads(spec)
+            create(kind, **kwargs)._init_weight(desc, arr)
+            self._verbose_print(desc, spec, arr)
             return
-        if desc.endswith('weight'):
-            self._init_weight(desc, arr)
-            self._verbose_print(desc, 'weight', arr)
-        elif desc.endswith('bias'):
-            self._init_bias(desc, arr)
-            self._verbose_print(desc, 'bias', arr)
-        elif desc.endswith('gamma'):
-            self._init_gamma(desc, arr)
-            self._verbose_print(desc, 'gamma', arr)
-        elif desc.endswith('beta'):
-            self._init_beta(desc, arr)
-            self._verbose_print(desc, 'beta', arr)
-        elif desc.endswith('min'):
-            self._init_zero(desc, arr)
-        elif desc.endswith('max'):
-            self._init_one(desc, arr)
-        elif desc.endswith('weight_quantize'):
-            self._init_quantized_weight(desc, arr)
-        else:
-            self._init_default(desc, arr)
+        for suffix, handler, label in self._DISPATCH:
+            if desc.endswith(suffix):
+                getattr(self, handler)(desc, arr)
+                if label:
+                    self._verbose_print(desc, label, arr)
+                return
+        self._init_default(desc, arr)
 
     # -- typed initializers ------------------------------------------------
-    def _set(self, arr, value):
-        if isinstance(arr, NDArray):
-            arr[:] = value
-        else:
-            arr[:] = value
+
+    @staticmethod
+    def _set(arr, value):
+        arr[:] = value
 
     def _init_bilinear(self, _, arr):
+        """Bilinear upsampling kernel (vectorized; the reference fills
+        element-by-element, bilinear_resize semantics are identical)."""
         shape = arr.shape
-        weight = onp.zeros(int(onp.prod(shape)), dtype='float32')
         f = onp.ceil(shape[3] / 2.)
         c = (2 * f - 1 - f % 2) / (2. * f)
-        for i in range(int(onp.prod(shape))):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        self._set(arr, weight.reshape(shape))
+        xs = onp.arange(shape[3], dtype='float32')
+        ys = onp.arange(shape[2], dtype='float32')
+        ky = 1 - onp.abs(ys / f - c)
+        kx = 1 - onp.abs(xs / f - c)
+        kernel = onp.outer(ky, kx).astype('float32')
+        self._set(arr, onp.broadcast_to(kernel, shape))
 
     def _init_loc_bias(self, _, arr):
-        assert arr.shape[0] == 6
+        if arr.shape[0] != 6:
+            raise AssertionError('loc bias expects 6 elements')
         self._set(arr, onp.array([1.0, 0, 0, 0, 1.0, 0], dtype='float32'))
 
     def _init_zero(self, _, arr):
@@ -145,7 +147,8 @@ class Initializer:
         self._set(arr, 0.0)
 
     def _init_quantized_weight(self, _, arr):
-        self._set(arr, onp.random.randint(-127, 127, size=arr.shape).astype('int8'))
+        codes = onp.random.randint(-127, 127, size=arr.shape)
+        self._set(arr, codes.astype('int8'))
 
     def _init_weight(self, name, arr):
         raise NotImplementedError('Must override it')
@@ -164,15 +167,13 @@ class Zero(Initializer):
         self._set(arr, 0.0)
 
 
-_INITIALIZER_REGISTRY['zeros'] = Zero
-
-
 @register
 class One(Initializer):
     def _init_weight(self, _, arr):
         self._set(arr, 1.0)
 
 
+_INITIALIZER_REGISTRY['zeros'] = Zero
 _INITIALIZER_REGISTRY['ones'] = One
 
 
@@ -183,164 +184,181 @@ class Constant(Initializer):
         self.value = value
 
     def _init_weight(self, _, arr):
-        if isinstance(self.value, (list, tuple, onp.ndarray, NDArray)):
-            v = self.value.asnumpy() if isinstance(self.value, NDArray) \
-                else onp.asarray(self.value)
-            self._set(arr, v)
-        else:
-            self._set(arr, self.value)
+        v = self.value
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        elif isinstance(v, (list, tuple)):
+            v = onp.asarray(v)
+        self._set(arr, v)
 
 
 @register
 class Uniform(Initializer):
-    """Uniform in [-scale, scale] (reference: initializer.py:401)."""
+    """U(-scale, scale) (reference: initializer.py:401)."""
 
     def __init__(self, scale=0.07):
         super().__init__(scale=scale)
         self.scale = scale
 
     def _init_weight(self, _, arr):
-        self._set(arr, onp.random.uniform(-self.scale, self.scale,
-                                          arr.shape).astype('float32'))
+        draw = onp.random.uniform(-self.scale, self.scale, arr.shape)
+        self._set(arr, draw.astype('float32'))
 
 
 @register
 class Normal(Initializer):
+    """N(0, sigma²) (reference: initializer.py Normal)."""
+
     def __init__(self, sigma=0.01):
         super().__init__(sigma=sigma)
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        self._set(arr, onp.random.normal(0, self.sigma,
-                                         arr.shape).astype('float32'))
+        draw = onp.random.normal(0, self.sigma, arr.shape)
+        self._set(arr, draw.astype('float32'))
 
 
 @register
 class Orthogonal(Initializer):
+    """Orthonormal rows/cols via SVD of a random matrix (reference:
+    initializer.py Orthogonal)."""
+
     def __init__(self, scale=1.414, rand_type='uniform'):
         super().__init__(scale=scale, rand_type=rand_type)
-        self.scale = scale
-        self.rand_type = rand_type
+        self.scale, self.rand_type = scale, rand_type
 
     def _init_weight(self, _, arr):
-        nout = arr.shape[0]
-        nin = int(onp.prod(arr.shape[1:]))
-        if self.rand_type == 'uniform':
-            tmp = onp.random.uniform(-1.0, 1.0, (nout, nin))
-        else:
-            tmp = onp.random.normal(0.0, 1.0, (nout, nin))
-        u, _, v = onp.linalg.svd(tmp, full_matrices=False)
-        q = u if u.shape == tmp.shape else v
-        self._set(arr, (self.scale * q).reshape(arr.shape).astype('float32'))
+        rows = arr.shape[0]
+        cols = int(onp.prod(arr.shape[1:]))
+        seed = onp.random.uniform(-1.0, 1.0, (rows, cols)) \
+            if self.rand_type == 'uniform' \
+            else onp.random.normal(0.0, 1.0, (rows, cols))
+        u, _, vt = onp.linalg.svd(seed, full_matrices=False)
+        basis = u if u.shape == seed.shape else vt
+        self._set(arr,
+                  (self.scale * basis).reshape(arr.shape).astype('float32'))
 
 
 @register
 class Xavier(Initializer):
-    """Xavier/Glorot (reference: initializer.py Xavier)."""
+    """Glorot scaling from fan-in/fan-out (reference: initializer.py
+    Xavier)."""
+
+    _FACTORS = {'avg': lambda fi, fo: (fi + fo) / 2.0,
+                'in': lambda fi, fo: fi,
+                'out': lambda fi, fo: fo}
 
     def __init__(self, rnd_type='uniform', factor_type='avg', magnitude=3):
         super().__init__(rnd_type=rnd_type, factor_type=factor_type,
                          magnitude=magnitude)
-        self.rnd_type = rnd_type
-        self.factor_type = factor_type
+        self.rnd_type, self.factor_type = rnd_type, factor_type
         self.magnitude = float(magnitude)
 
     def _init_weight(self, name, arr):
         shape = arr.shape
-        hw_scale = 1.
         if len(shape) < 2:
             raise ValueError(
                 'Xavier initializer cannot be applied to vector %s. It '
                 'requires at least 2D.' % name)
-        if len(shape) > 2:
-            hw_scale = onp.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.
-        if self.factor_type == 'avg':
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == 'in':
-            factor = fan_in
-        elif self.factor_type == 'out':
-            factor = fan_out
-        else:
+        receptive = onp.prod(shape[2:]) if len(shape) > 2 else 1.
+        fan_in, fan_out = shape[1] * receptive, shape[0] * receptive
+        try:
+            factor = self._FACTORS[self.factor_type](fan_in, fan_out)
+        except KeyError:
             raise ValueError('Incorrect factor type')
         scale = onp.sqrt(self.magnitude / factor)
         if self.rnd_type == 'uniform':
-            self._set(arr, onp.random.uniform(-scale, scale,
-                                              shape).astype('float32'))
+            draw = onp.random.uniform(-scale, scale, shape)
         elif self.rnd_type == 'gaussian':
-            self._set(arr, onp.random.normal(0, scale, shape).astype('float32'))
+            draw = onp.random.normal(0, scale, shape)
         else:
             raise ValueError('Unknown random type')
+        self._set(arr, draw.astype('float32'))
 
 
 @register
 class MSRAPrelu(Xavier):
+    """He init adjusted for PReLU slope (reference: initializer.py
+    MSRAPrelu)."""
+
     def __init__(self, factor_type='avg', slope=0.25):
-        magnitude = 2. / (1 + slope ** 2)
-        super().__init__('gaussian', factor_type, magnitude)
+        super().__init__('gaussian', factor_type, 2. / (1 + slope ** 2))
         self._kwargs = {'factor_type': factor_type, 'slope': slope}
 
 
 @register
 class Bilinear(Initializer):
+    """Bilinear upsampling kernels for Deconvolution (reference:
+    initializer.py Bilinear)."""
+
     def _init_weight(self, name, arr):
         self._init_bilinear(name, arr)
 
 
 @register
 class LSTMBias(Initializer):
-    """Forget-gate-biased LSTM bias (reference: initializer.py LSTMBias)."""
+    """Zero bias with the forget gate offset to keep early memory open
+    (reference: initializer.py LSTMBias)."""
 
     def __init__(self, forget_bias=1.0):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
 
     def _init_weight(self, name, arr):
-        b = onp.zeros(arr.shape, dtype='float32')
-        num_hidden = int(arr.shape[0] / 4)
-        b[num_hidden:2 * num_hidden] = self.forget_bias
-        self._set(arr, b)
+        gates = onp.zeros(arr.shape, dtype='float32')
+        width = int(arr.shape[0] / 4)       # i/f/c/o blocks
+        gates[width:2 * width] = self.forget_bias
+        self._set(arr, gates)
 
 
 @register
 class Load:
-    """Init from a dict of arrays, falling back to default_init."""
+    """Init from a dict (or .params file) of arrays, falling back to
+    ``default_init`` for absent names (reference: initializer.py
+    Load)."""
 
     def __init__(self, param, default_init=None, verbose=False):
         if isinstance(param, str):
             param = nd.load(param)
-        self.param = {}
-        for name, arr in param.items():
-            self.param[name[4:] if name.startswith(('arg:', 'aux:')) else name] = arr
+        self.param = {
+            (name[4:] if name.startswith(('arg:', 'aux:')) else name): arr
+            for name, arr in param.items()}
         self.default_init = default_init
         self.verbose = verbose
 
+    def _note(self, name, how):
+        if self.verbose:
+            logging.info('Initialized %s by %s', name, how)
+
     def __call__(self, name, arr):
-        if name in self.param:
-            src = self.param[name]
-            assert tuple(arr.shape) == tuple(src.shape), \
-                'Parameter %s cannot be initialized from loading. Shape ' \
-                'mismatch, target %s vs loaded %s' % (name, arr.shape, src.shape)
+        src = self.param.get(name)
+        if src is not None:
+            if tuple(arr.shape) != tuple(src.shape):
+                raise AssertionError(
+                    'Parameter %s cannot be initialized from loading. '
+                    'Shape mismatch, target %s vs loaded %s'
+                    % (name, arr.shape, src.shape))
             arr[:] = src.asnumpy() if isinstance(src, NDArray) else src
-            if self.verbose:
-                logging.info('Initialized %s by loading', name)
+            self._note(name, 'loading')
         else:
-            assert self.default_init is not None, \
-                "Cannot Initialize %s. Not found in loaded param and no " \
-                "default Initializer is provided." % name
+            if self.default_init is None:
+                raise AssertionError(
+                    'Cannot Initialize %s. Not found in loaded param and '
+                    'no default Initializer is provided.' % name)
             self.default_init(name, arr)
-            if self.verbose:
-                logging.info('Initialized %s by default', name)
+            self._note(name, 'default')
 
 
 @register
 class Mixed:
-    """Dispatch by regex on parameter name (reference: initializer.py Mixed)."""
+    """First-match regex dispatch over parameter names (reference:
+    initializer.py Mixed)."""
 
     def __init__(self, patterns, initializers):
-        assert len(patterns) == len(initializers)
-        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+        if len(patterns) != len(initializers):
+            raise AssertionError('need one initializer per pattern')
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
 
     def __call__(self, name, arr):
         for prog, init in self.map:
@@ -348,40 +366,37 @@ class Mixed:
                 init(name, arr)
                 return
         raise ValueError(
-            'Parameter name %s did not match any pattern. Consider adding a '
-            '".*" pattern at the and with default Initializer.' % name)
+            'Parameter name %s did not match any pattern. Consider adding '
+            'a ".*" pattern at the and with default Initializer.' % name)
 
 
 @register
 class FusedRNN(Initializer):
-    """Initialize fused RNN parameter blobs (reference: initializer.py:702).
-
-    The flat RNN param layout matches ops/nn.py _rnn_unpack_params.
-    """
+    """Initialize fused RNN parameter blobs (reference:
+    initializer.py:702). The flat RNN param layout matches ops/nn.py
+    _rnn_unpack_params."""
 
     def __init__(self, init, num_hidden, num_layers, mode,
                  bidirectional=False, forget_bias=1.0):
         if isinstance(init, str):
-            klass, kwargs = json.loads(init)
-            init = _INITIALIZER_REGISTRY[klass.lower()](**kwargs)
+            kind, kwargs = json.loads(init)
+            init = _INITIALIZER_REGISTRY[kind.lower()](**kwargs)
         super().__init__(init=init.dumps() if init is not None else None,
                          num_hidden=num_hidden, num_layers=num_layers,
                          mode=mode, bidirectional=bidirectional,
                          forget_bias=forget_bias)
         self._init = init
-        self._num_hidden = num_hidden
-        self._num_layers = num_layers
+        self._num_hidden, self._num_layers = num_hidden, num_layers
         self._mode = mode
-        self._bidirectional = bidirectional
-        self._forget_bias = forget_bias
+        self._bidirectional, self._forget_bias = bidirectional, forget_bias
 
     def _init_weight(self, desc, arr):
-        # initialize the full blob with the wrapped init, then stamp
-        # forget-gate biases for lstm
+        # fill the whole blob with the wrapped init; lstm forget-gate
+        # stamping is left to LSTMBias users (fused layout parity is
+        # covered by the rnn op tests)
         if self._init is not None:
             self._init._init_weight(desc, arr)
         if self._mode == 'lstm':
-            a = arr.asnumpy() if isinstance(arr, NDArray) else onp.asarray(arr)
-            # biases live at the tail; leave detailed stamping to LSTMBias
-            # users; the fused layout keeps parity via rnn op tests.
-            self._set(arr, a)
+            src = arr.asnumpy() if isinstance(arr, NDArray) \
+                else onp.asarray(arr)
+            self._set(arr, src)

@@ -1,7 +1,12 @@
-"""BaseModule: high-level training interface.
+"""BaseModule: the high-level train/score/predict interface.
 
-Reference parity: python/mxnet/module/base_module.py (fit :409 with the
-epoch/batch loop :514-560, score, predict, forward_backward).
+Reference parity: python/mxnet/module/base_module.py (fit at :409 with
+the lookahead epoch/batch loop :514-560, score, predict,
+forward_backward, save/load_params). The evaluation entry points here
+share one batch-iteration generator instead of three hand-rolled
+loops; ``fit`` keeps the reference's prefetch-next-then-prepare
+ordering because sparse row pulls (and our compiled-dispatch warmup)
+depend on ``prepare`` seeing the next batch before it is consumed.
 """
 from __future__ import annotations
 
@@ -13,142 +18,157 @@ import numpy as onp
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from ..ndarray import NDArray
-from ..io import DataDesc, DataBatch
+from ..io import DataBatch
 
 __all__ = ['BaseModule']
+
+_END = object()          # sentinel: iterator exhausted
 
 
 def _as_list(obj):
     if obj is None:
         return []
-    if isinstance(obj, (list, tuple)):
-        return list(obj)
-    return [obj]
+    return list(obj) if isinstance(obj, (list, tuple)) else [obj]
+
+
+def _fire(callbacks, **fields):
+    """Invoke every callback with a BatchEndParam-shaped record."""
+    if callbacks is None:
+        return
+    rec = _BatchEndParam(**fields)
+    for cb in _as_list(callbacks):
+        cb(rec)
+
+
+class _BatchEndParam:
+    """epoch/nbatch/eval_metric/locals record handed to callbacks
+    (reference: model.py BatchEndParam namedtuple)."""
+
+    __slots__ = ('epoch', 'nbatch', 'eval_metric', 'locals')
+
+    def __init__(self, epoch, nbatch, eval_metric, locals):
+        self.epoch, self.nbatch = epoch, nbatch
+        self.eval_metric, self.locals = eval_metric, locals
 
 
 def _check_input_names(symbol, names, typ, throw):
-    """Check that input names match symbol arguments
+    """Validate declared input names against the symbol's arguments
     (reference: base_module.py _check_input_names)."""
-    args = symbol.list_arguments()
+    known = symbol.list_arguments()
+    non_param = [a for a in known
+                 if not a.rsplit('_', 1)[-1] in
+                 ('weight', 'bias', 'gamma', 'beta')]
     for name in names:
-        if name in args:
+        if name in known:
             continue
-        candidates = [arg for arg in args if not arg.endswith('_weight')
-                      and not arg.endswith('_bias')
-                      and not arg.endswith('_gamma')
-                      and not arg.endswith('_beta')]
-        msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
-              "input with name '%s' is not found in symbol.list_arguments(). " \
-              "Did you mean one of:\n\t%s\033[0m" % (
-                  typ, str(names), name, '\n\t'.join(candidates))
+        msg = ("You created Module with Module(..., %s_names=%s) but "
+               "input with name '%s' is not found in "
+               "symbol.list_arguments(). Did you mean one of:\n\t%s"
+               % (typ, names, name, '\n\t'.join(non_param)))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
 
 
 class BaseModule:
-    """Base class defining the Module API."""
+    """Abstract Module: subclasses provide bind/forward/backward/update;
+    this class provides the composite train/eval/predict drivers."""
 
     def __init__(self, logger=logging):
-        self.logger = logger
-        self.binded = False
-        self.for_training = False
-        self.inputs_need_grad = False
-        self.params_initialized = False
-        self.optimizer_initialized = False
-        self._symbol = None
+        self.logger, self._symbol = logger, None
+        self.binded = self.for_training = self.inputs_need_grad = False
+        self.params_initialized = self.optimizer_initialized = False
         self._total_exec_bytes = 0
 
-    # -- high-level interface ----------------------------------------------
+    # -- composite drivers -------------------------------------------------
+
+    def _assert_ready(self):
+        if not (self.binded and self.params_initialized):
+            raise AssertionError('bind + init_params first')
+
     def forward_backward(self, data_batch):
-        """A convenient function that calls both forward and backward."""
+        """One fused fwd+bwd (the compiled path runs both in one XLA
+        program)."""
         self.forward(data_batch, is_train=True)
         self.backward()
+
+    def _eval_batches(self, eval_data, num_batch, reset):
+        """Shared iteration for score/iter_predict/predict: reset,
+        enumerate, stop at num_batch, forward in inference mode."""
+        if reset:
+            eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i == num_batch:
+                return
+            self.forward(batch, is_train=False)
+            yield i, batch
+
+    def _feed_metric(self, eval_metric, batch):
+        if isinstance(batch, list):
+            self.update_metric(eval_metric, [b.label for b in batch],
+                               pre_sliced=True)
+        else:
+            self.update_metric(eval_metric, batch.label)
+
+    def _unpadded_outputs(self, batch):
+        """Outputs with the iterator's tail padding stripped."""
+        keep = None if not batch.pad else -batch.pad
+        return [out[:keep] for out in self.get_outputs()]
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None,
               reset=True, epoch=0, sparse_row_id_fn=None):
-        """Run prediction on eval_data and evaluate (reference:
+        """Evaluate ``eval_metric`` over an iterator (reference:
         base_module.py score)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
+        self._assert_ready()
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            if isinstance(eval_batch, list):
-                self.update_metric(eval_metric,
-                                   [eb.label for eb in eval_batch],
-                                   pre_sliced=True)
-            else:
-                self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = _BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                        eval_metric=eval_metric, locals=None)
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = _BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                    eval_metric=eval_metric, locals=None)
-            for callback in _as_list(score_end_callback):
-                callback(params)
+        seen = 0
+        for i, batch in self._eval_batches(eval_data, num_batch, reset):
+            self._feed_metric(eval_metric, batch)
+            _fire(batch_end_callback, epoch=epoch, nbatch=i,
+                  eval_metric=eval_metric, locals=None)
+            seen += 1
+        _fire(score_end_callback, epoch=epoch, nbatch=seen,
+              eval_metric=eval_metric, locals=None)
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in
-                       self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        """Yield (outputs, nbatch, batch) per evaluation batch."""
+        self._assert_ready()
+        for i, batch in self._eval_batches(eval_data, num_batch, reset):
+            yield self._unpadded_outputs(batch), i, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False,
                 sparse_row_id_fn=None):
-        """Run prediction, collect outputs (reference: base_module.py
-        predict)."""
-        assert self.binded and self.params_initialized
+        """Collect prediction outputs (reference: base_module.py
+        predict). A bare array input runs a single forward."""
+        self._assert_ready()
         if isinstance(eval_data, (NDArray, onp.ndarray)):
-            if isinstance(eval_data, onp.ndarray):
-                eval_data = nd.array(eval_data)
-            self.forward(DataBatch([eval_data]))
+            arr = nd.array(eval_data) if isinstance(eval_data, onp.ndarray) \
+                else eval_data
+            self.forward(DataBatch([arr]))
             return self.get_outputs()[0]
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    'Cannot merge batches, as num of outputs is not the ' \
-                    'same in mini-batches. Maybe bucketing is used?'
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+
+        collected = [
+            [out.copy() for out in self._unpadded_outputs(batch)]
+            for _, batch in self._eval_batches(eval_data, num_batch, reset)]
+        if not collected:
+            return collected
+        if not merge_batches:
+            return collected
+        arity = len(collected[0])
+        if any(len(outs) != arity for outs in collected):
+            raise AssertionError(
+                'Cannot merge batches, as num of outputs is not the same '
+                'in mini-batches. Maybe bucketing is used?')
+        merged = [nd.concatenate([outs[i] for outs in collected])
+                  for i in range(arity)]
+        if arity == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric='acc',
             epoch_end_callback=None, batch_end_callback=None,
@@ -159,79 +179,65 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None):
-        """Train the module (reference: base_module.py:409)."""
-        assert num_epoch is not None, 'please specify number of epochs'
+        """The training driver (reference: base_module.py:409)."""
+        if num_epoch is None:
+            raise AssertionError('please specify number of epochs')
         from .. import initializer as init_mod
-        if initializer is None:
-            initializer = init_mod.Uniform(0.01)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+        self.init_params(initializer=initializer or init_mod.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
+        validation_metric = validation_metric or eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
-        ################################################################
-        # training loop
-        ################################################################
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            t_start = time.time()
             eval_metric.reset()
             nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
+            feed = iter(train_data)
+            batch = next(feed)
+            done = False
+            while not done:
+                if monitor:
                     monitor.tic()
-                self.forward_backward(data_batch)
+                self.forward_backward(batch)
                 self.update()
-                if isinstance(data_batch, list):
-                    self.update_metric(eval_metric,
-                                       [db.label for db in data_batch],
-                                       pre_sliced=True)
+                self._feed_metric(eval_metric, batch)
+                # lookahead: prepare() must see the NEXT batch before it
+                # is consumed (sparse row pull in the reference; bucket
+                # switch + dispatch warmup here)
+                nxt = next(feed, _END)
+                if nxt is _END:
+                    done = True
+                    epoch_summary = eval_metric.get_global_name_value()
                 else:
-                    self.update_metric(eval_metric, data_batch.label)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                if monitor is not None:
+                    self.prepare(nxt, sparse_row_id_fn=sparse_row_id_fn)
+                if monitor:
                     monitor.toc_print()
-                if end_of_batch:
-                    eval_name_vals = eval_metric.get_global_name_value()
-                if batch_end_callback is not None:
-                    batch_end_params = _BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
+                _fire(batch_end_callback, epoch=epoch, nbatch=nbatch,
+                      eval_metric=eval_metric, locals=locals())
+                batch = nxt
                 nbatch += 1
-            # one epoch of training is finished
-            for name, val in eval_name_vals:
-                self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
-            toc = time.time()
-            self.logger.info('Epoch[%d] Time cost=%.3f', epoch, (toc - tic))
 
+            for name, val in epoch_summary:
+                self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
+            self.logger.info('Epoch[%d] Time cost=%.3f', epoch,
+                             time.time() - t_start)
+
+            # sync params across executors at epoch boundary
             arg_params, aux_params = self.get_params()
             self.set_params(arg_params, aux_params)
-
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
+            for cb in _as_list(epoch_end_callback):
+                cb(epoch, self.symbol, arg_params, aux_params)
 
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
@@ -243,39 +249,7 @@ class BaseModule:
                                      name, val)
             train_data.reset()
 
-    # -- properties to be implemented --------------------------------------
-    @property
-    def symbol(self):
-        return self._symbol
-
-    @property
-    def data_names(self):
-        raise NotImplementedError()
-
-    @property
-    def output_names(self):
-        raise NotImplementedError()
-
-    @property
-    def data_shapes(self):
-        raise NotImplementedError()
-
-    @property
-    def label_shapes(self):
-        raise NotImplementedError()
-
-    @property
-    def output_shapes(self):
-        raise NotImplementedError()
-
-    # -- abstract interface -------------------------------------------------
-    def get_params(self):
-        raise NotImplementedError()
-
-    def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False,
-                    allow_extra=False):
-        raise NotImplementedError()
+    # -- param persistence -------------------------------------------------
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
@@ -284,77 +258,96 @@ class BaseModule:
                          force_init=force_init, allow_extra=allow_extra)
 
     def save_params(self, fname):
-        arg_params, aux_params = self.get_params()
-        save_dict = {('arg:%s' % k): v.as_in_context(_cpu())
-                     for k, v in arg_params.items()}
-        save_dict.update({('aux:%s' % k): v.as_in_context(_cpu())
-                          for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        """Write 'arg:'/'aux:'-prefixed host copies in the reference
+        .params layout."""
+        from ..context import cpu
+        table = {}
+        for tag, params in zip(('arg', 'aux'), self.get_params()):
+            table.update(('%s:%s' % (tag, k), v.as_in_context(cpu()))
+                         for k, v in params.items())
+        nd.save(fname, table)
 
     def load_params(self, fname):
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(':', 1)
-            if arg_type == 'arg':
-                arg_params[name] = value
-            elif arg_type == 'aux':
-                aux_params[name] = value
-            else:
+        split = {'arg': {}, 'aux': {}}
+        for key, value in nd.load(fname).items():
+            tag, _, name = key.partition(':')
+            if tag not in split or not name:
                 raise ValueError('Invalid param file ' + fname)
-        self.set_params(arg_params, aux_params)
+            split[tag][name] = value
+        self.set_params(split['arg'], split['aux'])
+
+    # -- surface for subclasses --------------------------------------------
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        raise NotImplementedError
+
+    @property
+    def output_names(self):
+        raise NotImplementedError
+
+    @property
+    def data_shapes(self):
+        raise NotImplementedError
+
+    @property
+    def label_shapes(self):
+        raise NotImplementedError
+
+    @property
+    def output_shapes(self):
+        raise NotImplementedError
+
+    def get_params(self):
+        raise NotImplementedError
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        raise NotImplementedError
 
     def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+        self._assert_ready()
         return []
 
     def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
+        self._assert_ready()
 
     def install_monitor(self, mon):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
-        """Prepare for processing a batch (sparse pull in reference)."""
+        """Hook before consuming a batch (sparse pull in the reference;
+        bucket switching here)."""
 
     def forward(self, data_batch, is_train=None):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def backward(self, out_grads=None):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def update(self):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def get_outputs(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def get_input_grads(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req='write'):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def init_optimizer(self, kvstore='local', optimizer='sgd',
                        optimizer_params=(('learning_rate', 0.01),),
                        force_init=False):
-        raise NotImplementedError()
-
-
-def _cpu():
-    from ..context import cpu
-    return cpu()
-
-
-class _BatchEndParam:
-    def __init__(self, epoch, nbatch, eval_metric, locals):
-        self.epoch = epoch
-        self.nbatch = nbatch
-        self.eval_metric = eval_metric
-        self.locals = locals
+        raise NotImplementedError

@@ -1,14 +1,16 @@
-"""BucketingModule: per-bucket executors with shared parameters.
+"""BucketingModule: one executor per bucket key, shared parameters.
 
-Reference parity: python/mxnet/module/bucketing_module.py:36-75 — one
-executor per bucket key (sequence length), parameters shared. TPU-native:
-each bucket is one jit specialization; the jit cache replaces the
-reference's memory-shared executor pool (SURVEY.md §5.7 bucketing row).
+Reference parity: python/mxnet/module/bucketing_module.py — a
+``sym_gen(key) -> (symbol, data_names, label_names)`` callback, a
+default bucket bound first, and lazy per-bucket executors that all
+share one parameter set and one optimizer state. TPU-native framing:
+every bucket is simply a distinct jit specialization (static shapes),
+so the jit cache plays the role of the reference's memory-shared
+executor pool (SURVEY.md §5.7).
 """
 from __future__ import annotations
 
 import logging
-import warnings
 
 from .base_module import BaseModule
 from .module import Module
@@ -17,222 +19,215 @@ __all__ = ['BucketingModule']
 
 
 class BucketingModule(BaseModule):
-    """Wraps a sym_gen returning (symbol, data_names, label_names) per
-    bucket key."""
+    """Dispatches every batch to the executor of its ``bucket_key``,
+    materialising that executor on first sight."""
 
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None, group2ctxs=None, compression_params=None):
         super().__init__(logger=logger)
-        assert default_bucket_key is not None
-        self._default_bucket_key = default_bucket_key
-        self._sym_gen = sym_gen
-        self._fixed_param_names = fixed_param_names
-        self._state_names = state_names
-        self._context = context
-        self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
-        self._params_dirty = False
-        self._monitor = None
-        self._grad_req = None
+        if default_bucket_key is None:
+            raise AssertionError('default_bucket_key is required')
+        self._sym_gen, self._default_key = sym_gen, default_bucket_key
+        self._make_kwargs = dict(
+            logger=logger, context=context,
+            fixed_param_names=fixed_param_names, state_names=state_names)
+        self._monitor = self._grad_req = None
+        self._reset_bind()
+
+    # -- bucket pool -------------------------------------------------------
 
     def _reset_bind(self):
-        self.binded = False
-        self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
+        self.binded = self._params_dirty = False
+        self._by_key, self._active, self._active_key = {}, None, None
+
+    def _generate(self, key):
+        return self._sym_gen(key)
+
+    def _materialise(self, key, data_shapes, label_shapes):
+        """Build + bind the Module for one bucket key."""
+        symbol, data_names, label_names = self._generate(key)
+        mod = Module(symbol, data_names, label_names, **self._make_kwargs)
+        mod.bind(data_shapes, label_shapes, self.for_training,
+                 self.inputs_need_grad, force_rebind=False,
+                 shared_module=None, grad_req=self._grad_req)
+        if self._monitor:
+            mod.install_monitor(self._monitor)
+        return mod
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Make ``bucket_key`` the active executor, creating it on first
+        use and carrying the freshest parameters over (reference:
+        bucketing_module.py:65-75)."""
+        if not self.binded:
+            raise AssertionError('call bind before switching bucket')
+        fresh = bucket_key not in self._by_key
+        if fresh:
+            mod = self._materialise(bucket_key, data_shapes, label_shapes)
+            if self.params_initialized:
+                mod.set_params(*self.get_params())
+            else:
+                mod.params_initialized = self._active.params_initialized
+            self._by_key[bucket_key] = mod
+        else:
+            mod = self._by_key[bucket_key]
+            if self.params_initialized and self._params_dirty \
+                    and mod is not self._active:
+                # previous bucket trained since last sync
+                mod.set_params(*self._active.get_params())
+        self._active = mod
+        self._active_key = bucket_key
+
+    # -- descriptive properties -------------------------------------------
 
     @property
     def data_names(self):
-        if self.binded:
-            return self._curr_module.data_names
-        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
-        return data_names
+        return self._active.data_names if self.binded \
+            else self._generate(self._default_key)[1]
 
     @property
     def output_names(self):
-        if self.binded:
-            return self._curr_module.output_names
-        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
-        return symbol.list_outputs()
+        return self._active.output_names if self.binded \
+            else self._generate(self._default_key)[0].list_outputs()
+
+    def _bound(self, attr):
+        if not self.binded:
+            raise AssertionError('not bound')
+        return getattr(self._active, attr)
 
     @property
     def data_shapes(self):
-        assert self.binded
-        return self._curr_module.data_shapes
+        return self._bound('data_shapes')
 
     @property
     def label_shapes(self):
-        assert self.binded
-        return self._curr_module.label_shapes
+        return self._bound('label_shapes')
 
     @property
     def output_shapes(self):
-        assert self.binded
-        return self._curr_module.output_shapes
+        return self._bound('output_shapes')
 
     @property
     def symbol(self):
-        assert self.binded
-        return self._curr_module.symbol
+        return self._bound('symbol')
 
-    def _call_sym_gen(self, bucket_key):
-        return self._sym_gen(bucket_key)
+    # -- params ------------------------------------------------------------
 
     def get_params(self):
-        assert self.params_initialized
-        if self._params_dirty:
-            # current module holds the freshest params
-            return self._curr_module.get_params()
-        return self._curr_module.get_params()
+        if not self.params_initialized:
+            raise AssertionError('params not initialized')
+        # the active module always holds the freshest copy
+        return self._active.get_params()
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
         if self.params_initialized and not force_init:
             return
-        assert self.binded, 'call bind before initializing the parameters'
-        self._curr_module.init_params(
+        if not self.binded:
+            raise AssertionError('call bind before initializing the '
+                                 'parameters')
+        self._active.init_params(
             initializer=initializer, arg_params=arg_params,
             aux_params=aux_params, allow_missing=allow_missing,
             force_init=force_init, allow_extra=allow_extra)
-        self.params_initialized = True
-        self._params_dirty = False
+        self.params_initialized, self._params_dirty = True, False
+
+    # -- lifecycle ---------------------------------------------------------
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req='write'):
         """Bind the default bucket (reference: bucketing_module.py bind)."""
-        assert shared_module is None, \
-            'shared_module for BucketingModule is not supported'
+        if shared_module is not None:
+            raise AssertionError(
+                'shared_module for BucketingModule is not supported')
         if force_rebind:
             self._reset_bind()
         if self.binded:
-            self.logger.warning('Already bound, ignoring bind()')
+            self.logger.warning('already bound; ignoring bind()')
             return
-        self.for_training = for_training
-        self.inputs_need_grad = inputs_need_grad
-        self.binded = True
-        self._grad_req = grad_req
-        symbol, data_names, label_names = self._call_sym_gen(
-            self._default_bucket_key)
-        module = Module(symbol, data_names, label_names,
-                        logger=self.logger, context=self._context,
-                        fixed_param_names=self._fixed_param_names,
-                        state_names=self._state_names)
-        module.bind(data_shapes, label_shapes, for_training,
-                    inputs_need_grad, force_rebind=False,
-                    shared_module=None, grad_req=self._grad_req)
-        self._curr_module = module
-        self._curr_bucket_key = self._default_bucket_key
-        self._buckets[self._default_bucket_key] = module
-
-    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
-        """Switch to a bucket, creating its executor on first use
-        (reference: bucketing_module.py:65-75)."""
-        assert self.binded, 'call bind before switching bucket'
-        if bucket_key not in self._buckets:
-            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
-            module = Module(symbol, data_names, label_names,
-                            logger=self.logger, context=self._context,
-                            fixed_param_names=self._fixed_param_names,
-                            state_names=self._state_names)
-            module.bind(data_shapes, label_shapes, self.for_training,
-                        self.inputs_need_grad, force_rebind=False,
-                        shared_module=None, grad_req=self._grad_req)
-            if self.params_initialized:
-                arg_params, aux_params = self.get_params()
-                module.set_params(arg_params, aux_params)
-            else:
-                module.params_initialized = self._curr_module.params_initialized
-            if self._monitor is not None:
-                module.install_monitor(self._monitor)
-            self._buckets[bucket_key] = module
-        else:
-            module = self._buckets[bucket_key]
-            if self.params_initialized and self._params_dirty:
-                # propagate freshest params from previous bucket
-                arg_params, aux_params = self._curr_module.get_params()
-                module.set_params(arg_params, aux_params)
-        self._curr_module = module
-        self._curr_bucket_key = bucket_key
+        self.for_training, self.inputs_need_grad = (for_training,
+                                                     inputs_need_grad)
+        self.binded, self._grad_req = True, grad_req
+        mod = self._materialise(self._default_key, data_shapes, label_shapes)
+        self._by_key[self._default_key] = mod
+        self._active = mod
+        self._active_key = self._default_key
 
     def init_optimizer(self, kvstore='local', optimizer='sgd',
                        optimizer_params=(('learning_rate', 0.01),),
                        force_init=False):
-        assert self.binded and self.params_initialized
+        if not (self.binded and self.params_initialized):
+            raise AssertionError('bind + init_params first')
         if self.optimizer_initialized and not force_init:
-            self.logger.warning('optimizer already initialized, ignoring.')
+            self.logger.warning('optimizer already initialized; ignoring.')
             return
-        self._curr_module.init_optimizer(kvstore, optimizer,
-                                         optimizer_params,
-                                         force_init=force_init)
-        # share the SAME updater (optimizer state) across buckets
-        for mod in self._buckets.values():
-            if mod is not self._curr_module:
-                mod._optimizer = self._curr_module._optimizer
-                mod._updater = self._curr_module._updater
-                mod.optimizer_initialized = True
-        self._shared_updater = self._curr_module._updater
-        self._shared_optimizer = self._curr_module._optimizer
+        self._active.init_optimizer(kvstore, optimizer, optimizer_params,
+                                    force_init=force_init)
+        # one optimizer state for the whole pool: late-created buckets
+        # pick it up in prepare()
+        self._shared_optimizer = self._active._optimizer
+        self._shared_updater = self._active._updater
+        for mod in self._by_key.values():
+            if mod is not self._active:
+                self._adopt_optimizer(mod)
         self.optimizer_initialized = True
 
+    def _adopt_optimizer(self, mod):
+        mod._optimizer = self._shared_optimizer
+        mod._updater = self._shared_updater
+        mod.optimizer_initialized = True
+
     def prepare(self, data_batch, sparse_row_id_fn=None):
-        assert self.binded
-        bucket_key = getattr(data_batch, 'bucket_key',
-                             self._default_bucket_key)
-        self.switch_bucket(bucket_key, data_batch.provide_data,
+        if not self.binded:
+            raise AssertionError('not bound')
+        key = getattr(data_batch, 'bucket_key', self._default_key)
+        self.switch_bucket(key, data_batch.provide_data,
                            data_batch.provide_label)
         if self.optimizer_initialized and \
-                not self._curr_module.optimizer_initialized:
-            self._curr_module._optimizer = self._shared_optimizer
-            self._curr_module._updater = self._shared_updater
-            self._curr_module.optimizer_initialized = True
+                not self._active.optimizer_initialized:
+            self._adopt_optimizer(self._active)
+
+    # -- compute -----------------------------------------------------------
 
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
         self.prepare(data_batch)
-        self._curr_module.forward(data_batch, is_train=is_train)
+        self._active.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
-        self._curr_module.backward(out_grads=out_grads)
-        self._params_dirty = True
+        self._active.backward(out_grads=out_grads)
+        self._params_dirty = True     # grads will change params next update
 
     def update(self):
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
+        if not self.optimizer_initialized:
+            raise AssertionError('init_optimizer first')
         self._params_dirty = True
-        self._curr_module.update()
+        self._active.update()
 
     def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        return self._curr_module.get_outputs(merge_multi_context)
+        return self._active.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        return self._curr_module.get_input_grads(merge_multi_context)
+        return self._active.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        assert self.binded and self.params_initialized
-        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+        self._active.update_metric(eval_metric, labels, pre_sliced)
 
     def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        return self._curr_module.get_states(merge_multi_context)
+        return self._active.get_states(merge_multi_context)
 
     def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
-        self._curr_module.set_states(states, value)
+        self._active.set_states(states, value)
 
     def install_monitor(self, mon):
-        assert self.binded
+        if not self.binded:
+            raise AssertionError('not bound')
         self._monitor = mon
-        for mod in self._buckets.values():
+        for mod in self._by_key.values():
             mod.install_monitor(mon)
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """Save current progress (reference: bucketing_module.py)."""
-        self.switch_bucket(self._default_bucket_key, None, None)
-        self._curr_module.save_checkpoint(prefix, epoch,
-                                          save_optimizer_states)
+        """Persist via the default bucket's module (reference:
+        bucketing_module.py save_checkpoint)."""
+        self.switch_bucket(self._default_key, None, None)
+        self._active.save_checkpoint(prefix, epoch, save_optimizer_states)

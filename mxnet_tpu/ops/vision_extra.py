@@ -302,7 +302,12 @@ def deformable_psroi_pooling(data, rois, trans, *, spatial_scale=1.0,
     # belongs to class ctop // channels_each_class and samples with that
     # class's offset.
     ncls = 1 if no_trans else int(trans.shape[1]) // 2
-    cec = od // max(ncls, 1)  # channels_each_class
+    if ncls < 1 or od % ncls:
+        raise ValueError(
+            'DeformablePSROIPooling: output_dim (%d) must be divisible '
+            'by the number of trans classes (%d = trans.shape[1]//2)'
+            % (od, ncls))
+    cec = od // ncls  # channels_each_class
 
     def one_roi(roi, tr):
         b = roi[0].astype(jnp.int32)

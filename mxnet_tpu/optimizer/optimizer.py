@@ -1,19 +1,22 @@
-"""Optimizers: registry + the reference's full class zoo.
+"""Optimizer zoo + registry.
 
 Reference parity: python/mxnet/optimizer/optimizer.py:511-1604 (SGD w/
-momentum + fp16 master copy, Signum, FTML, LBSGD, DCASGD, NAG, SGLD, Adam,
-AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam; Updater :1621).
+momentum + fp16 master copy, Signum, FTML, LBSGD, DCASGD, NAG, SGLD,
+Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Adamax, Nadam; Updater :1621).
 
-TPU-native design: each update is a registered *op* (ops/optimizer_ops.py),
-i.e. a pure jax function — the analog of the reference's fused
-`sgd_mom_update`-style kernels (src/operator/optimizer_op.cc:506-840). The
-eager path mutates weights in place via the registry's mutate hook; the jit
-path (Trainer/Module with hybridized step) calls the same pure functions
-inside one compiled train step so XLA fuses the whole optimizer.
+TPU-native design: each update is a registered *op* (ops/
+optimizer_ops.py), i.e. a pure jax function — the analog of the
+reference's fused `sgd_mom_update`-style kernels (src/operator/
+optimizer_op.cc:506-840). The eager path mutates weights in place via
+the registry's mutate hook; the fused path (optimizer/fused.py) traces
+``update_multi_precision`` with the lr/wd/count plumbing monkeypatched
+to traced values, so every optimizer below deliberately routes its
+per-step hyperparameters through ``self._update_count`` /
+``self._get_lr`` / ``self._get_wd`` / ``self._index_update_count`` —
+that protocol is load-bearing, not boilerplate.
 """
 from __future__ import annotations
 
-import logging
 import math
 import pickle
 import warnings
@@ -22,7 +25,7 @@ import numpy
 
 from ..base import string_types
 from .. import ndarray as nd
-from ..ndarray import NDArray, zeros, ones, full, invoke
+from ..ndarray import NDArray, zeros, invoke
 
 __all__ = ['Optimizer', 'SGD', 'Signum', 'FTML', 'DCASGD', 'NAG', 'SGLD',
            'Adam', 'AdaGrad', 'RMSProp', 'AdaDelta', 'Ftrl', 'Adamax',
@@ -33,21 +36,23 @@ opt_registry = {}
 
 
 def register(klass):
-    """Register an Optimizer subclass under its lowercase name
+    """Register an Optimizer subclass under its lowercase class name
     (reference: optimizer.py Optimizer.register)."""
-    assert isinstance(klass, type)
-    name = klass.__name__.lower()
-    if name in opt_registry:
+    if not isinstance(klass, type):
+        raise AssertionError('register expects a class')
+    key = klass.__name__.lower()
+    if key in opt_registry:
+        prev = opt_registry[key]
         warnings.warn('WARNING: New optimizer %s.%s is overriding existing '
                       'optimizer %s.%s' % (klass.__module__, klass.__name__,
-                                           opt_registry[name].__module__,
-                                           opt_registry[name].__name__))
-    opt_registry[name] = klass
+                                           prev.__module__, prev.__name__))
+    opt_registry[key] = klass
     return klass
 
 
 def create(name, **kwargs):
-    """Instantiate an optimizer by registered name."""
+    """Instantiate an optimizer by registered name (or pass one
+    through)."""
     if isinstance(name, Optimizer):
         return name
     if isinstance(name, string_types) and name.lower() in opt_registry:
@@ -55,11 +60,14 @@ def create(name, **kwargs):
     raise ValueError('Cannot find optimizer %s' % name)
 
 
-class Optimizer:
-    """Base optimizer (reference: optimizer.py:39).
+def _fresh(weight):
+    """A zero state buffer shaped/typed/placed like ``weight``."""
+    return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
 
-    Tracks per-parameter update counts, lr/wd multipliers, rescale/clip.
-    """
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:39): update counts,
+    lr/wd multiplier tables, rescale/clip, fp16 master-copy protocol."""
 
     opt_registry = opt_registry
 
@@ -73,144 +81,161 @@ class Optimizer:
                  clip_gradient=None, learning_rate=0.01,
                  lr_scheduler=None, sym=None, begin_num_update=0,
                  multi_precision=False, param_dict=None):
-        self.rescale_grad = rescale_grad
-        self.lr = learning_rate
+        self.rescale_grad, self.clip_gradient = rescale_grad, clip_gradient
+        self.lr, self.wd = learning_rate, wd
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
+            lr_scheduler.base_lr = learning_rate
+        self.num_update = self.begin_num_update = begin_num_update
         self._all_index_update_counts = {0: {}}
         self._index_update_count = self._all_index_update_counts[0]
-        self.clip_gradient = clip_gradient
         self.multi_precision = multi_precision
         self.aggregate_num = 0
         if param_idx2name is None:
             param_idx2name = {}
-        assert isinstance(param_idx2name, dict), \
-            'param_idx2name should be a dict of param indexes to names.'
-        self.idx2name = param_idx2name.copy()
-        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
-        self.param_dict = param_dict if param_dict else {}
+        if not isinstance(param_idx2name, dict):
+            raise AssertionError(
+                'param_idx2name should be a dict of param indexes to names.')
+        self.idx2name = dict(param_idx2name)
+        self.sym_info = () if sym is None \
+            else (sym.attr_dict(), sym.list_arguments())
+        self.param_dict = param_dict or {}
         self.set_lr_mult({})
         self.set_wd_mult({})
 
-    # -- registry passthroughs (reference keeps them as staticmethods) ----
+    # registry passthroughs (reference keeps them as staticmethods)
     register = staticmethod(register)
     create_optimizer = staticmethod(create)
 
     # -- state -------------------------------------------------------------
+
     def create_state(self, index, weight):
-        """Create optimizer state (momentum etc.) for one weight."""
+        """Optimizer state (momentum etc.) for one weight; None if
+        stateless."""
         return None
 
     def create_state_multi_precision(self, index, weight):
         """fp16 master-weight wrapper (reference: optimizer.py:270)."""
-        weight_master_copy = None
-        if self.multi_precision and weight.dtype == numpy.float16:
-            weight_master_copy = weight.astype(numpy.float32)
-            return (weight_master_copy, self.create_state(index, weight_master_copy))
-        if weight.dtype == numpy.float16 and not self.multi_precision:
+        if weight.dtype == numpy.float16:
+            if self.multi_precision:
+                master = weight.astype(numpy.float32)
+                return (master, self.create_state(index, master))
             warnings.warn('Accumulating with float16 in optimizer can lead '
                           'to poor accuracy or slow convergence. Consider '
-                          'using multi_precision=True option of the optimizer')
+                          'using multi_precision=True option of the '
+                          'optimizer')
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == numpy.float16:
-            weight_master_copy, original_state = state
-            grad32 = grad.astype(numpy.float32)
-            self.update(index, weight_master_copy, grad32, original_state)
-            weight[:] = weight_master_copy.astype(weight.dtype)
+            master, master_state = state
+            self.update(index, master, grad.astype(numpy.float32),
+                        master_state)
+            weight[:] = master.astype(weight.dtype)
         else:
             self.update(index, weight, grad, state)
 
-    # -- lr/wd plumbing ----------------------------------------------------
+    # -- per-step hyperparameter plumbing ----------------------------------
+    # fused.py swaps _get_lrs/_get_wds/_update_count/_index_update_count
+    # for traced equivalents; everything below must stay routed through
+    # them (see module docstring).
+
+    def _begin(self, index):
+        """Bump the update count and resolve (lr, wd) for one step."""
+        self._update_count(index)
+        return self._get_lr(index), self._get_wd(index)
+
+    def _step_of(self, index):
+        return self._index_update_count[index]
+
+    def _clipped(self, grad):
+        """rescale_grad ⊙ grad, then symmetric clip if configured."""
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _base_kwargs(self, lr, wd):
+        return {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
+                'clip_gradient': self.clip_gradient}
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
-            raise UserWarning('LRScheduler of the optimizer has already been '
-                              'defined. Note that set_learning_rate can mutate '
-                              'the value of the learning rate of the optimizer '
-                              'only when the LRScheduler of the optimizer is '
-                              'undefined.')
+            raise UserWarning('LRScheduler of the optimizer has already '
+                              'been defined. Note that set_learning_rate '
+                              'can mutate the value of the learning rate '
+                              'of the optimizer only when the LRScheduler '
+                              'of the optimizer is undefined.')
         self.lr = lr
 
-    def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
+    def _sym_mults(self, key):
+        """Collect __lr_mult__/__wd_mult__ attributes from bound symbol
+        info."""
+        table = {}
         if self.sym_info:
-            attr, arg_names = self.sym_info
+            attrs, arg_names = self.sym_info
             for name in arg_names:
-                if name in attr and '__lr_mult__' in attr[name]:
-                    self.lr_mult[name] = float(attr[name]['__lr_mult__'])
+                if name in attrs and key in attrs[name]:
+                    table[name] = float(attrs[name][key])
+        return table
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = self._sym_mults('__lr_mult__')
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            is_weight = n.endswith('_weight')
-            if not is_weight:
-                self.wd_mult[n] = 0.0
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and '__wd_mult__' in attr[name]:
-                    self.wd_mult[name] = float(attr[name]['__wd_mult__'])
+        # non-weight params (bias/gamma/beta...) default to wd 0
+        self.wd_mult = {n: 0.0 for n in self.idx2name.values()
+                        if not n.endswith('_weight')}
+        self.wd_mult.update(self._sym_mults('__wd_mult__'))
         self.wd_mult.update(args_wd_mult)
 
     def _set_current_context(self, device_id):
-        if device_id not in self._all_index_update_counts:
-            self._all_index_update_counts[device_id] = {}
-        self._index_update_count = self._all_index_update_counts[device_id]
+        counts = self._all_index_update_counts.setdefault(device_id, {})
+        self._index_update_count = counts
 
     def _update_count(self, index):
-        if not isinstance(index, (list, tuple)):
-            index = [index]
-        for idx in index:
-            if idx not in self._index_update_count:
-                self._index_update_count[idx] = self.begin_num_update
-            self._index_update_count[idx] += 1
-            self.num_update = max(self._index_update_count[idx], self.num_update)
+        indices = index if isinstance(index, (list, tuple)) else [index]
+        for idx in indices:
+            bumped = self._index_update_count.get(
+                idx, self.begin_num_update) + 1
+            self._index_update_count[idx] = bumped
+            self.num_update = max(bumped, self.num_update)
+
+    def _mult_of(self, index, table):
+        """Per-param multiplier: Parameter object beats explicit table
+        beats name lookup."""
+        if index in self.param_dict:
+            attr = 'lr_mult' if table is self.lr_mult else 'wd_mult'
+            return getattr(self.param_dict[index], attr)
+        if index in table:
+            return table[index]
+        if index in self.idx2name:
+            return table.get(self.idx2name[index], 1.0)
+        return 1.0
 
     def _get_lrs(self, indices):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        lrs = [lr for _ in indices]
-        for i, index in enumerate(indices):
-            if index in self.param_dict:
-                lrs[i] *= self.param_dict[index].lr_mult
-            elif index in self.lr_mult:
-                lrs[i] *= self.lr_mult[index]
-            elif index in self.idx2name:
-                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lrs
+        base = self.lr if self.lr_scheduler is None \
+            else self.lr_scheduler(self.num_update)
+        return [base * self._mult_of(i, self.lr_mult) for i in indices]
 
     def _get_lr(self, index):
         return self._get_lrs([index])[0]
 
     def _get_wds(self, indices):
-        wds = [self.wd for _ in indices]
-        for i, index in enumerate(indices):
-            if index in self.param_dict:
-                wds[i] *= self.param_dict[index].wd_mult
-            elif index in self.wd_mult:
-                wds[i] *= self.wd_mult[index]
-            elif index in self.idx2name:
-                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wds
+        return [self.wd * self._mult_of(i, self.wd_mult) for i in indices]
 
     def _get_wd(self, index):
         return self._get_wds([index])[0]
 
     def __getstate__(self):
-        ret = self.__dict__.copy()
-        del ret['_all_index_update_counts']
-        return ret
+        state = dict(self.__dict__)
+        # per-device count tables hold the live dict; keep only current
+        state.pop('_all_index_update_counts')
+        return state
 
     def __setstate__(self, state):
         self.__dict__ = state
@@ -219,19 +244,22 @@ class Optimizer:
 
 @register
 class SGD(Optimizer):
-    """SGD with momentum, weight decay, fp16 master weights and lazy sparse
-    updates (reference: optimizer.py:511; op src/operator/optimizer_op.cc:506).
-    """
+    """SGD with momentum, weight decay, fp16 master weights and lazy
+    sparse updates (reference: optimizer.py:511; op src/operator/
+    optimizer_op.cc:506)."""
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.lazy_update = lazy_update
+        self.momentum, self.lazy_update = momentum, lazy_update
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+        return _fresh(weight) if self.momentum != 0.0 else None
+
+    def _lazy(self, grad):
+        # lazy rows only for genuinely row_sparse gradients (reference:
+        # optimizer.py:545 — dense grads always update every row)
+        return bool(self.lazy_update and
+                    getattr(grad, 'stype', 'default') == 'row_sparse')
 
     def update(self, index, weight, grad, state):
         self._update_impl(index, weight, grad, state, multi_precision=False)
@@ -241,63 +269,50 @@ class SGD(Optimizer):
         self._update_impl(index, weight, grad, state, multi_precision=use_mp)
 
     def _update_impl(self, index, weight, grad, state, multi_precision=False):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
-                  'clip_gradient': self.clip_gradient}
-        # lazy rows only for genuinely row_sparse gradients (reference:
-        # optimizer.py:545 — dense grads always update every row)
-        lazy = bool(self.lazy_update and
-                    getattr(grad, 'stype', 'default') == 'row_sparse')
-        if not multi_precision:
-            if state is not None:
-                invoke('sgd_mom_update', [weight, grad, state],
-                       dict(momentum=self.momentum, lazy_update=lazy,
-                            **kwargs),
-                       out=[weight, state])
-            else:
-                invoke('sgd_update', [weight, grad],
-                       dict(lazy_update=lazy, **kwargs), out=weight)
-        else:
-            weight32, mom = state
+        lr, wd = self._begin(index)
+        kwargs = self._base_kwargs(lr, wd)
+        lazy = self._lazy(grad)
+        if multi_precision:
+            master, mom = state
             if mom is not None:
-                invoke('mp_sgd_mom_update', [weight, grad, mom, weight32],
+                invoke('mp_sgd_mom_update', [weight, grad, mom, master],
                        dict(momentum=self.momentum, lazy_update=lazy,
                             **kwargs),
-                       out=[weight, mom, weight32])
+                       out=[weight, mom, master])
             else:
-                invoke('mp_sgd_update', [weight, grad, weight32],
+                invoke('mp_sgd_update', [weight, grad, master],
                        dict(lazy_update=lazy, **kwargs),
-                       out=[weight, weight32])
+                       out=[weight, master])
+        elif state is not None:
+            invoke('sgd_mom_update', [weight, grad, state],
+                   dict(momentum=self.momentum, lazy_update=lazy, **kwargs),
+                   out=[weight, state])
+        else:
+            invoke('sgd_update', [weight, grad],
+                   dict(lazy_update=lazy, **kwargs), out=weight)
 
 
 @register
 class Signum(Optimizer):
     """SignSGD / Signum (reference: optimizer.py Signum)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.momentum = momentum
-        self.wd_lh = wd_lh
+        self.momentum, self.wd_lh = momentum, wd_lh
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+        return _fresh(weight) if self.momentum != 0.0 else None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
-                  'clip_gradient': self.clip_gradient}
-        if state is not None:
+        lr, wd = self._begin(index)
+        kwargs = self._base_kwargs(lr, wd)
+        if state is None:
+            invoke('signsgd_update', [weight, grad], kwargs, out=weight)
+        else:
             invoke('signum_update', [weight, grad, state],
                    dict(momentum=self.momentum, wd_lh=self.wd_lh, **kwargs),
                    out=[weight, state])
-        else:
-            invoke('signsgd_update', [weight, grad], kwargs, out=weight)
 
 
 @register
@@ -306,25 +321,19 @@ class FTML(Optimizer):
 
     def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # d
-                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # v
-                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))  # z
+        return tuple(_fresh(weight) for _ in 'dvz')
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        t = self._index_update_count[index]
+        lr, wd = self._begin(index)
         d, v, z = state
         invoke('ftml_update', [weight, grad, d, v, z],
                {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
                 'clip_grad': self.clip_gradient, 'beta1': self.beta1,
-                'beta2': self.beta2, 'epsilon': self.epsilon, 't': t},
+                'beta2': self.beta2, 'epsilon': self.epsilon,
+                't': self._step_of(index)},
                out=[weight, d, v, z])
 
 
@@ -334,30 +343,24 @@ class DCASGD(Optimizer):
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
+        self.momentum, self.lamda = momentum, lamda
         self.weight_previous = {}
-        self.lamda = lamda
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return (None, weight.copy())
-        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
-                weight.copy())
+        mom = _fresh(weight) if self.momentum != 0.0 else None
+        return (mom, weight.copy())
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        mon, previous_weight = state
-        delta = -lr * (grad + wd * weight + self.lamda * grad * grad *
-                       (weight - previous_weight))
-        if mon is not None:
-            mon[:] = self.momentum * mon + delta
-            delta = mon
-        previous_weight[:] = weight
+        lr, wd = self._begin(index)
+        g = self._clipped(grad)
+        mom, prev = state
+        # delay compensation: second-order term against the stale weight
+        delta = -lr * (g + wd * weight +
+                       self.lamda * g * g * (weight - prev))
+        if mom is not None:
+            mom[:] = self.momentum * mom + delta
+            delta = mom
+        prev[:] = weight
         weight[:] = weight + delta
 
 
@@ -370,42 +373,33 @@ class NAG(Optimizer):
         self.momentum = momentum
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+        return _fresh(weight) if self.momentum != 0.0 else None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        if state is not None:
-            mom = state
-            mom[:] = self.momentum * mom + grad + wd * weight
-            grad[:] = self.momentum * mom + grad
-            weight[:] = weight - lr * grad
+        lr, wd = self._begin(index)
+        g = self._clipped(grad)
+        if state is None:
+            weight[:] = weight - lr * (g + wd * weight)
         else:
-            weight[:] = weight - lr * (grad + wd * weight)
+            state[:] = self.momentum * state + g + wd * weight
+            # lookahead step: gradient evaluated past the momentum move
+            g[:] = self.momentum * state + g
+            weight[:] = weight - lr * g
 
 
 @register
 class SGLD(Optimizer):
-    """Stochastic Gradient Langevin Dynamics (reference: optimizer.py SGLD)."""
+    """Stochastic Gradient Langevin Dynamics (reference: optimizer.py
+    SGLD)."""
 
     fusable = False  # lr**0.5 feeds a host-side sampler scale
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        noise = nd.random.normal(0, lr ** 0.5, shape=weight.shape,
+        lr, wd = self._begin(index)
+        g = self._clipped(grad)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
                                  dtype=weight.dtype)
-        weight[:] = weight - lr / 2 * (grad + wd * weight) + noise
+        weight[:] = weight - lr / 2 * (g + wd * weight) + noise
 
 
 @register  # pylint: disable=invalid-name
@@ -413,30 +407,36 @@ class ccSGD(SGD):
     """Deprecated alias of SGD (reference keeps it)."""
 
 
-@register
-class Adam(Optimizer):
-    """Adam (reference: optimizer.py:1122; op optimizer_op.cc:654)."""
+class _AdamFamily(Optimizer):
+    """Shared (mean, var) state + bias-correction arithmetic."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, lazy_update=True, **kwargs):
+                 epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
-        self.lazy_update = lazy_update
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # mean
-                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))  # var
+        return (_fresh(weight), _fresh(weight))   # mean, var
+
+    def _bias_corrected(self, lr, t):
+        """lr * sqrt(1-b2^t) / (1-b1^t); works for floats and tracers."""
+        return lr * (1. - self.beta2 ** t) ** 0.5 / (1. - self.beta1 ** t)
+
+
+@register
+class Adam(_AdamFamily):
+    """Adam (reference: optimizer.py:1122; op optimizer_op.cc:654)."""
+
+    # explicit signature: reference callers pass these positionally
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kwargs)
+        self.lazy_update = lazy_update
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        t = self._index_update_count[index]
-        coef1 = 1. - self.beta1 ** t
-        coef2 = 1. - self.beta2 ** t
-        lr *= coef2 ** 0.5 / coef1  # works for floats and tracers
+        lr, wd = self._begin(index)
+        lr = self._bias_corrected(lr, self._step_of(index))
         mean, var = state
         lazy = bool(self.lazy_update and
                     getattr(grad, 'stype', 'default') == 'row_sparse')
@@ -449,29 +449,18 @@ class Adam(Optimizer):
 
 
 @register
-class AdamW(Optimizer):
+class AdamW(_AdamFamily):
     """AdamW with decoupled weight decay (reference: contrib/adamw.cc +
     python/mxnet/optimizer contrib adamw)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
-        super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
-
-    def create_state(self, index, weight):
-        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
-                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kwargs)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        t = self._index_update_count[index]
-        coef1 = 1. - self.beta1 ** t
-        coef2 = 1. - self.beta2 ** t
-        eta = lr * coef2 ** 0.5 / coef1
+        lr, wd = self._begin(index)
+        eta = self._bias_corrected(lr, self._step_of(index))
         mean, var = state
         rescale = nd.full((1,), self.rescale_grad, dtype=weight.dtype)
         invoke('_adamw_update', [weight, grad, mean, var, rescale],
@@ -490,12 +479,10 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+        return _fresh(weight)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin(index)
         invoke('_sparse_adagrad_update', [weight, grad, state],
                {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
                 'clip_gradient': self.clip_gradient,
@@ -505,41 +492,35 @@ class AdaGrad(Optimizer):
 
 @register
 class RMSProp(Optimizer):
-    """RMSProp, centered or not (reference: optimizer.py RMSProp)."""
+    """RMSProp, plain (Tieleman) or centered (Graves) (reference:
+    optimizer.py RMSProp)."""
 
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.gamma1 = gamma1
-        self.gamma2 = gamma2
-        self.centered = centered
-        self.epsilon = epsilon
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon, self.centered = epsilon, centered
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        if self.centered:
-            return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # n
-                    zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # g
-                    zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))  # delta
-        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+        if not self.centered:
+            return _fresh(weight)
+        return tuple(_fresh(weight) for _ in 'ngd')
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
-                  'clip_gradient': self.clip_gradient, 'gamma1': self.gamma1,
-                  'epsilon': self.epsilon}
+        lr, wd = self._begin(index)
+        kwargs = {'gamma1': self.gamma1, 'epsilon': self.epsilon,
+                  **self._base_kwargs(lr, wd)}
         if self.clip_weights:
             kwargs['clip_weights'] = self.clip_weights
-        if not self.centered:
-            invoke('rmsprop_update', [weight, grad, state], kwargs,
-                   out=[weight, state])
-        else:
+        if self.centered:
             n, g, delta = state
             invoke('rmspropalex_update', [weight, grad, n, g, delta],
                    dict(gamma2=self.gamma2, **kwargs),
                    out=[weight, n, g, delta])
+        else:
+            invoke('rmsprop_update', [weight, grad, state], kwargs,
+                   out=[weight, state])
 
 
 @register
@@ -548,26 +529,20 @@ class AdaDelta(Optimizer):
 
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
-        self.rho = rho
-        self.epsilon = epsilon
+        self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
-                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))
+        return (_fresh(weight), _fresh(weight))   # E[g^2], E[dx^2]
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        _, wd = self._begin(index)
+        g = self._clipped(grad)
         acc_g, acc_delta = state
-        acc_g[:] = self.rho * acc_g + (1. - self.rho) * grad * grad
-        current_delta = ((acc_delta + self.epsilon).sqrt()
-                         / (acc_g + self.epsilon).sqrt()) * grad
-        acc_delta[:] = self.rho * acc_delta + (1. - self.rho) * \
-            current_delta * current_delta
-        weight[:] = weight - current_delta - wd * weight
+        acc_g[:] = self.rho * acc_g + (1. - self.rho) * g * g
+        step = ((acc_delta + self.epsilon).sqrt()
+                / (acc_g + self.epsilon).sqrt()) * g
+        acc_delta[:] = self.rho * acc_delta + (1. - self.rho) * step * step
+        weight[:] = weight - step - wd * weight
 
 
 @register
@@ -575,53 +550,45 @@ class Ftrl(Optimizer):
     """FTRL (reference: optimizer.py Ftrl; op optimizer_op.cc:799)."""
 
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
-        super().__init__(**kwargs)
-        self.lamda1 = lamda1
-        self.beta = beta
-        self.lr = learning_rate
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),  # z
-                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))  # n
+        return (_fresh(weight), _fresh(weight))   # z, n
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin(index)
         z, n = state
         invoke('ftrl_update', [weight, grad, z, n],
-               {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
-                'clip_gradient': self.clip_gradient, 'lamda1': self.lamda1,
-                'beta': self.beta},
+               {'lamda1': self.lamda1, 'beta': self.beta,
+                **self._base_kwargs(lr, wd)},
                out=[weight, z, n])
 
 
 @register
 class Adamax(Optimizer):
-    """AdaMax (reference: optimizer.py Adamax)."""
+    """AdaMax — Adam with an infinity-norm second moment (reference:
+    optimizer.py Adamax)."""
 
-    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
+        self.beta1, self.beta2 = beta1, beta2
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
-                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))
+        return (_fresh(weight), _fresh(weight))   # m, u
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        t = self._index_update_count[index]
-        lr /= (1. - self.beta1 ** t)
-        grad = grad * self.rescale_grad + wd * weight
+        lr, wd = self._begin(index)
+        lr /= 1. - self.beta1 ** self._step_of(index)
+        # reference ordering: rescale, add wd, then clip
+        g = grad * self.rescale_grad + wd * weight
         if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        m_t, u_t = state
-        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
-        u_t[:] = nd.maximum(self.beta2 * u_t, grad.abs())
-        weight[:] = weight - lr * m_t / u_t
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        m, u = state
+        m[:] = self.beta1 * m + (1. - self.beta1) * g
+        u[:] = nd.maximum(self.beta2 * u, g.abs())
+        weight[:] = weight - lr * m / u
 
 
 @register
@@ -633,74 +600,77 @@ class Nadam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
         self.m_schedule = 1.
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx),
-                zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx))
+        return (_fresh(weight), _fresh(weight))
+
+    def _momentum_at(self, t):
+        return self.beta1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        t = self._index_update_count[index]
-        grad = grad * self.rescale_grad + wd * weight
+        lr, wd = self._begin(index)
+        t = self._step_of(index)
+        g = grad * self.rescale_grad + wd * weight
         if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        momentum_t = self.beta1 * (1. - 0.5 * (pow(0.96, t * self.schedule_decay)))
-        momentum_t_1 = self.beta1 * (1. - 0.5 *
-                                     (pow(0.96, (t + 1) * self.schedule_decay)))
-        self.m_schedule = self.m_schedule * momentum_t
-        m_schedule_next = self.m_schedule * momentum_t_1
-        m_t, v_t = state
-        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
-        v_t[:] = self.beta2 * v_t + (1. - self.beta2) * grad * grad
-        grad_prime = grad / (1. - self.m_schedule)
-        m_t_prime = m_t / (1. - m_schedule_next)
-        v_t_prime = v_t / (1. - pow(self.beta2, t))
-        m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
-        weight[:] = weight - lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon)
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mu_t, mu_next = self._momentum_at(t), self._momentum_at(t + 1)
+        self.m_schedule *= mu_t
+        schedule_next = self.m_schedule * mu_next
+        m, v = state
+        m[:] = self.beta1 * m + (1. - self.beta1) * g
+        v[:] = self.beta2 * v + (1. - self.beta2) * g * g
+        g_hat = g / (1. - self.m_schedule)
+        m_hat = m / (1. - schedule_next)
+        v_hat = v / (1. - self.beta2 ** t)
+        m_bar = (1. - mu_t) * g_hat + mu_next * m_hat
+        weight[:] = weight - lr * m_bar / (v_hat.sqrt() + self.epsilon)
 
 
 @register
 class LBSGD(SGD):
-
-    fusable = False  # warmup schedule branches on python state
-
-    """Large-batch SGD with LARS layer-wise lr adaptation
-    (reference: optimizer.py LBSGD; warmup strategies approximated by the
+    """Large-batch SGD with LARS layer-wise lr adaptation (reference:
+    optimizer.py LBSGD; warmup strategies approximated by the
     lr_scheduler warmup — the reference embeds them in the optimizer)."""
+
+    fusable = False  # LARS norms are read back host-side
 
     def __init__(self, momentum=0.0, multi_precision=False,
                  warmup_strategy='linear', warmup_epochs=5, batch_scale=1,
-                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
         super().__init__(momentum=momentum, multi_precision=multi_precision,
                          **kwargs)
         self.eta = 0.001  # LARS trust coefficient
 
     def _update_impl(self, index, weight, grad, state, multi_precision=False):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd = self._begin(index)
         # LARS: scale lr by ||w|| / (||g|| + wd*||w||)
         wnorm = float(weight.norm().asscalar())
         gnorm = float((grad * self.rescale_grad).norm().asscalar())
         if wnorm > 0 and gnorm > 0:
             lr *= self.eta * wnorm / (gnorm + wd * wnorm + 1e-9)
-        kwargs = {'lr': lr, 'wd': wd, 'rescale_grad': self.rescale_grad,
-                  'clip_gradient': self.clip_gradient}
-        if state is not None and not multi_precision:
+        kwargs = self._base_kwargs(lr, wd)
+        # all branches below use the LARS-scaled lr and the single
+        # _begin() count bump above (delegating to SGD._update_impl
+        # would re-bump the count and drop the LARS scale)
+        if multi_precision:
+            master, mom = state
+            if mom is not None:
+                invoke('mp_sgd_mom_update', [weight, grad, mom, master],
+                       dict(momentum=self.momentum, **kwargs),
+                       out=[weight, mom, master])
+            else:
+                invoke('mp_sgd_update', [weight, grad, master], kwargs,
+                       out=[weight, master])
+        elif state is not None:
             invoke('sgd_mom_update', [weight, grad, state],
                    dict(momentum=self.momentum, **kwargs),
                    out=[weight, state])
-        elif not multi_precision:
-            invoke('sgd_update', [weight, grad], kwargs, out=weight)
         else:
-            super()._update_impl(index, weight, grad, state, multi_precision)
+            invoke('sgd_update', [weight, grad], kwargs, out=weight)
 
 
 @register
@@ -708,7 +678,7 @@ class Test(Optimizer):
     """Simple test optimizer (reference: optimizer.py Test)."""
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+        return _fresh(weight)
 
     def update(self, index, weight, grad, state):
         weight[:] = weight + grad * self.rescale_grad
@@ -716,7 +686,9 @@ class Test(Optimizer):
 
 
 class Updater:
-    """KVStore-side updater closure (reference: optimizer.py:1621)."""
+    """KVStore-side updater closure: owns per-index optimizer state and
+    applies updates as (index, grad, weight) triples arrive (reference:
+    optimizer.py:1621)."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer
@@ -724,45 +696,50 @@ class Updater:
         self.states_synced = {}
         self.aggregate_updates = optimizer.aggregate_num > 0
 
+    def _state_for(self, idx, weight):
+        if idx not in self.states:
+            self.states[idx] = \
+                self.optimizer.create_state_multi_precision(idx, weight)
+            self.states_synced[idx] = True
+        elif not self.states_synced[idx]:
+            # states loaded via set_states live on the saver's device
+            self.states[idx] = self.sync_state_context(
+                self.states[idx], weight.context)
+            self.states_synced[idx] = True
+        return self.states[idx]
+
     def __call__(self, index, grad, weight):
-        if not isinstance(index, (list, tuple)):
-            indices = [index]
-            grads = [grad]
-            weights = [weight]
+        if isinstance(index, (list, tuple)):
+            triples = zip(index, grad, weight)
         else:
-            indices, grads, weights = index, grad, weight
-        for i, (idx, g, w) in enumerate(zip(indices, grads, weights)):
-            if idx not in self.states:
-                self.states[idx] = \
-                    self.optimizer.create_state_multi_precision(idx, w)
-                self.states_synced[idx] = True
-            elif not self.states_synced[idx]:
-                self.states[idx] = self.sync_state_context(self.states[idx],
-                                                           w.context)
-                self.states_synced[idx] = True
-            self.optimizer.update_multi_precision(idx, w, g, self.states[idx])
+            triples = [(index, grad, weight)]
+        for idx, g, w in triples:
+            self.optimizer.update_multi_precision(
+                idx, w, g, self._state_for(idx, w))
 
     def sync_state_context(self, state, context):
         if isinstance(state, NDArray):
             return state.as_in_context(context)
         if isinstance(state, (tuple, list)):
             return type(state)(
-                self.sync_state_context(i, context) for i in state)
+                self.sync_state_context(s, context) for s in state)
         return state
 
     def set_states(self, states):
-        states = pickle.loads(states)
-        if isinstance(states, tuple) and len(states) == 2:
-            self.states, self.optimizer = states
+        payload = pickle.loads(states)
+        if isinstance(payload, tuple) and len(payload) == 2:
+            self.states, self.optimizer = payload
         else:
-            self.states = states
-        self.states_synced = dict.fromkeys(self.states.keys(), False)
+            self.states = payload
+        self.states_synced = dict.fromkeys(self.states, False)
 
     def get_states(self, dump_optimizer=False):
-        return pickle.dumps((self.states, self.optimizer) if dump_optimizer
-                            else self.states)
+        payload = (self.states, self.optimizer) if dump_optimizer \
+            else self.states
+        return pickle.dumps(payload)
 
 
 def get_updater(optimizer):
-    """Wrap an optimizer as an updater callable (reference: optimizer.py)."""
+    """Wrap an optimizer as an updater callable (reference:
+    optimizer.py)."""
     return Updater(optimizer)

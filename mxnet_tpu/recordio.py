@@ -13,7 +13,6 @@ decode dominates; see io/ for the multiprocess decode pipeline).
 """
 from __future__ import annotations
 
-import ctypes
 import numbers
 import os
 import struct
@@ -42,59 +41,50 @@ def _decode_lrec(lrec):
 class MXRecordIO:
     """Sequential RecordIO reader/writer (reference: recordio.py:36)."""
 
+    _MODES = {'w': ('wb', True), 'r': ('rb', False)}
+
     def __init__(self, uri, flag):
-        self.uri = uri
-        self.flag = flag
-        self.handle = None
-        self.is_open = False
+        self.uri, self.flag = uri, flag
+        self.handle, self.is_open = None, False
         self.open()
 
     def open(self):
-        if self.flag == 'w':
-            self.handle = open(self.uri, 'wb')
-            self.writable = True
-        elif self.flag == 'r':
-            self.handle = open(self.uri, 'rb')
-            self.writable = False
-        else:
+        if self.flag not in self._MODES:
             raise ValueError('Invalid flag %s' % self.flag)
-        self.pid = os.getpid()
-        self.is_open = True
+        mode, self.writable = self._MODES[self.flag]
+        self.handle = open(self.uri, mode)
+        self.pid, self.is_open = os.getpid(), True
 
     def close(self):
-        if not self.is_open:
-            return
-        self.handle.close()
-        self.is_open = False
-        self.pid = None
+        if self.is_open:
+            self.handle.close()
+            self.is_open, self.pid = False, None
 
     def __del__(self):
         self.close()
 
     def __getstate__(self):
-        """Override pickling behavior (DataLoader workers re-open)."""
-        is_open = self.is_open
+        """Pickling support (DataLoader workers re-open the file)."""
+        was_open = self.is_open
         self.close()
-        d = dict(self.__dict__)
-        d['is_open'] = is_open
-        d.pop('handle', None)
-        return d
+        state = {k: v for k, v in self.__dict__.items() if k != 'handle'}
+        state['is_open'] = was_open
+        return state
 
-    def __setstate__(self, d):
-        self.__dict__ = d
-        is_open = d.get('is_open', False)
-        self.is_open = False
-        self.handle = None
-        if is_open:
+    def __setstate__(self, state):
+        self.__dict__ = state
+        reopen = state.get('is_open', False)
+        self.is_open, self.handle = False, None
+        if reopen:
             self.open()
 
     def _check_pid(self, allow_reset=False):
         """Process-fork safety (reference: recordio.py _check_pid)."""
-        if self.pid != os.getpid():
-            if allow_reset:
-                self.reset()
-            else:
-                raise RuntimeError('Forbidden operation in multiple processes')
+        if self.pid == os.getpid():
+            return
+        if not allow_reset:
+            raise RuntimeError('Forbidden operation in multiple processes')
+        self.reset()
 
     def reset(self):
         """Reset read pointer (re-open)."""
@@ -155,17 +145,14 @@ class MXIndexedRecordIO(MXRecordIO):
     """
 
     def __init__(self, idx_path, uri, flag, key_type=int):
-        self.idx_path = idx_path
-        self.idx = {}
-        self.keys = []
-        self.key_type = key_type
+        self.idx_path, self.key_type = idx_path, key_type
+        self.idx, self.keys = {}, []
         self.fidx = None
         super().__init__(uri, flag)
 
     def open(self):
         super().open()
-        self.idx = {}
-        self.keys = []
+        self.idx, self.keys = {}, []
         if self.flag == 'r' and os.path.isfile(self.idx_path):
             with open(self.idx_path) as fidx:
                 for line in fidx:
@@ -184,7 +171,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
         if self.fidx is not None:
             self.fidx.close()
-            self.fidx = None
+        self.fidx = None
 
     def seek(self, idx):
         """Set read pointer to the record with key idx."""
@@ -199,62 +186,62 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def write_idx(self, idx, buf):
         """Write a record and append its offset to the index."""
-        key = self.key_type(idx)
-        pos = self.tell()
+        key, pos = self.key_type(idx), self.tell()
         self.write(buf)
-        self.fidx.write('%s\t%d\n' % (str(key), pos))
+        self.fidx.write('%s\t%d\n' % (key, pos))
         self.idx[key] = pos
         self.keys.append(key)
 
 
 def pack(header, s):
-    """Pack a header and payload into a record string
-    (reference: recordio.py:344)."""
+    """Serialise IRHeader + payload into one record blob (reference:
+    recordio.py:344). Scalar labels ride in the header (flag 0); vector
+    labels set flag=len and prepend float32 bytes."""
     header = IRHeader(*header)
     if isinstance(header.label, numbers.Number):
-        header = header._replace(flag=0)
-        packed = struct.pack(_IR_FORMAT, header.flag, header.label,
-                             header.id, header.id2)
+        fields = (0, header.label, header.id, header.id2)
+        extra = b''
     else:
-        label = np.asarray(header.label, dtype=np.float32)
-        header = header._replace(flag=label.size, label=0)
-        packed = struct.pack(_IR_FORMAT, header.flag, header.label,
-                             header.id, header.id2) + label.tobytes()
-    return packed + s
+        vec = np.asarray(header.label, dtype=np.float32)
+        fields = (vec.size, 0, header.id, header.id2)
+        extra = vec.tobytes()
+    return struct.pack(_IR_FORMAT, *fields) + extra + s
 
 
 def unpack(s):
-    """Unpack a record into header + payload (reference: recordio.py:368)."""
+    """Split a record blob into IRHeader + payload (reference:
+    recordio.py:368)."""
     header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
-    s = s[_IR_SIZE:]
+    body = s[_IR_SIZE:]
     if header.flag > 0:
-        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
-        header = header._replace(label=label)
-        s = s[header.flag * 4:]
-    return header, s
+        width = header.flag * 4
+        header = header._replace(
+            label=np.frombuffer(body[:width], dtype=np.float32))
+        body = body[width:]
+    return header, body
 
 
 def unpack_img(s, iscolor=1):
-    """Unpack a record into header + decoded image
-    (reference: recordio.py:386)."""
+    """Record blob -> (header, decoded image array) (reference:
+    recordio.py:386)."""
     import cv2
-    header, s = unpack(s)
-    img = np.frombuffer(s, dtype=np.uint8)
-    img = cv2.imdecode(img, iscolor)
-    return header, img
+    header, body = unpack(s)
+    raw = np.frombuffer(body, dtype=np.uint8)
+    return header, cv2.imdecode(raw, iscolor)
 
 
 def pack_img(header, img, quality=95, img_fmt='.jpg'):
-    """Pack a header and image into a record string
-    (reference: recordio.py:411)."""
+    """Encode an image and pack it into a record blob (reference:
+    recordio.py:411)."""
     import cv2
-    jpg_formats = ['.JPG', '.JPEG']
-    png_formats = ['.PNG']
-    encode_params = None
-    if img_fmt.upper() in jpg_formats:
+    fmt = img_fmt.upper()
+    if fmt in ('.JPG', '.JPEG'):
         encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
-    elif img_fmt.upper() in png_formats:
+    elif fmt == '.PNG':
         encode_params = [cv2.IMWRITE_PNG_COMPRESSION, min(quality, 9)]
-    ret, buf = cv2.imencode(img_fmt, img, encode_params)
-    assert ret, 'failed to encode image'
+    else:
+        encode_params = None
+    ok, buf = cv2.imencode(img_fmt, img, encode_params)
+    if not ok:
+        raise AssertionError('failed to encode image')
     return pack(header, buf.tobytes())

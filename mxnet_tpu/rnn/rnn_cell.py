@@ -2,53 +2,84 @@
 Module-era cell zoo used by example/rnn/bucketing/lstm_bucketing.py).
 
 Cells compose Symbols; ``unroll`` builds the length-T graph that
-BucketingModule compiles per bucket (one jit specialization per length).
-FusedRNNCell uses the fused RNN op (lax.scan) — the cuDNN-parity path.
+BucketingModule compiles per bucket (one jit specialization per
+length). FusedRNNCell drives the fused RNN op (lax.scan inside) — the
+cuDNN-parity path. Shared plumbing lives on BaseRNNCell: every gated
+cell projects input and previous hidden state through one i2h/h2h pair
+(``_gate_projections``), which the reference re-spells per cell.
 """
 from __future__ import annotations
 
 from .. import symbol
 from ..symbol import Symbol
 
-__all__ = ['BaseRNNCell', 'RNNCell', 'LSTMCell', 'GRUCell', 'FusedRNNCell',
-           'SequentialRNNCell', 'BidirectionalCell', 'DropoutCell',
-           'ZoneoutCell', 'ResidualCell', 'RNNParams']
+__all__ = ['BaseRNNCell', 'RNNCell', 'LSTMCell', 'GRUCell',
+           'FusedRNNCell', 'SequentialRNNCell', 'BidirectionalCell',
+           'DropoutCell', 'ZoneoutCell', 'ResidualCell', 'RNNParams']
 
 
 class RNNParams:
-    """Container for holding variables (reference: rnn_cell.py RNNParams)."""
+    """Lazy symbol.Variable pool shared between cells (reference:
+    rnn_cell.py RNNParams)."""
 
     def __init__(self, prefix=''):
-        self._prefix = prefix
-        self._params = {}
+        self._prefix, self._params = prefix, {}
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        if name not in self._params:
-            self._params[name] = symbol.Variable(name, **kwargs)
-        return self._params[name]
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = symbol.Variable(full, **kwargs)
+        return self._params[full]
+
+
+def _flat(list_of_lists):
+    return sum(list_of_lists, [])
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """Canonicalise between merged (one (N,T,C) symbol) and per-step
+    (list of T symbols) forms (reference: rnn_cell.py
+    _normalize_sequence)."""
+    if inputs is None:
+        raise AssertionError('unroll requires inputs')
+    axis = layout.find('T')
+    in_axis = axis if in_layout is None else in_layout.find('T')
+    if isinstance(inputs, Symbol) and len(inputs) == 1:
+        if merge is False:
+            if length is None:
+                raise AssertionError('length required to split a merged '
+                                     'sequence symbol')
+            inputs = list(symbol.op.SliceChannel(
+                inputs, axis=in_axis, num_outputs=length, squeeze_axis=1))
+    else:
+        if isinstance(inputs, Symbol):
+            inputs = list(inputs)
+        if length is not None and len(inputs) != length:
+            raise AssertionError('sequence length mismatch')
+        if merge is True:
+            steps = [s.expand_dims(axis=axis) for s in inputs]
+            inputs = symbol.op.Concat(*steps, dim=axis)
+            in_axis = axis
+    if isinstance(inputs, Symbol) and len(inputs) == 1 and axis != in_axis:
+        inputs = symbol.op.SwapAxis(inputs, dim1=axis, dim2=in_axis)
+    return inputs, axis
 
 
 class BaseRNNCell:
-    """Abstract symbolic RNN cell."""
+    """Abstract symbolic cell: step counter, parameter pool, unroll."""
 
     def __init__(self, prefix='', params=None):
-        if params is None:
-            params = RNNParams(prefix)
-            self._own_params = True
-        else:
-            self._own_params = False
+        self._own_params = params is None
         self._prefix = prefix
-        self._params = params
+        self._params = RNNParams(prefix) if params is None else params
         self._modified = False
-        self.reset()
+        self.reset()  # counters live per-graph-build
 
     def reset(self):
-        self._init_counter = -1
-        self._counter = -1
+        self._init_counter = self._counter = -1
 
     def __call__(self, inputs, states):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     @property
     def params(self):
@@ -57,57 +88,64 @@ class BaseRNNCell:
 
     @property
     def state_info(self):
-        raise NotImplementedError()
+        raise NotImplementedError
 
     @property
     def state_shape(self):
-        return [ele['shape'] for ele in self.state_info]
+        return [info['shape'] for info in self.state_info]
 
     @property
     def _gate_names(self):
         return ()
 
     def begin_state(self, func=symbol.zeros, **kwargs):
-        assert not self._modified, \
-            'After applying modifier cells the base cell cannot be called '\
-            'directly. Call the modifier cell instead.'
+        if self._modified:
+            raise AssertionError(
+                'After applying modifier cells the base cell cannot be '
+                'called directly. Call the modifier cell instead.')
         states = []
         for info in self.state_info:
             self._init_counter += 1
-            if info is None:
-                state = func(shape=(0, 0), **kwargs)
-            else:
-                kw = dict(kwargs)
-                kw.update(info)
-                state = func(**{k: v for k, v in kw.items()
-                                if k != '__layout__'})
-            states.append(state)
+            spec = dict(kwargs)
+            if info is not None:
+                spec.update(info)
+            spec.pop('__layout__', None)
+            states.append(func(**spec) if info is not None
+                          else func(shape=(0, 0), **kwargs))
         return states
 
     def unpack_weights(self, args):
-        """Unpack fused weights to unfused (reference: unpack_weights).
-        With matching layouts this is a pass-through plus key renames."""
+        """Fused -> unfused weight table (pass-through here: layouts
+        already match; reference: unpack_weights)."""
         return dict(args)
 
     def pack_weights(self, args):
         return dict(args)
 
-    def unroll(self, length, inputs, begin_state=None, layout='NTC',
-               merge_outputs=None):
-        """Unroll the cell to a length-T symbol graph
-        (reference: rnn_cell.py unroll)."""
-        self.reset()
-        inputs, _ = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self.begin_state()
-        states = begin_state
-        outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
-        outputs, _ = _normalize_sequence(length, outputs, layout,
-                                         merge_outputs)
-        return outputs, states
+    # -- shared projection plumbing ---------------------------------------
+
+    def _declare_linears(self):
+        """Claim the i2h/h2h weight+bias variables every gated cell
+        owns."""
+        self._w_in = self.params.get('i2h_weight')
+        self._b_in = self.params.get('i2h_bias')
+        self._w_hid = self.params.get('h2h_weight')
+        self._b_hid = self.params.get('h2h_bias')
+
+    def _step_prefix(self):
+        self._counter += 1
+        return '%st%d_' % (self._prefix, self._counter)
+
+    def _gate_projections(self, tag, inputs, prev_h, n_gates):
+        """i2h(x) and h2h(h) with n_gates*num_hidden outputs each."""
+        width = self._num_hidden * n_gates
+        i2h = symbol.op.FullyConnected(inputs, self._w_in, self._b_in,
+                                       num_hidden=width,
+                                       name=tag + 'i2h')
+        h2h = symbol.op.FullyConnected(prev_h, self._w_hid, self._b_hid,
+                                       num_hidden=width,
+                                       name=tag + 'h2h')
+        return i2h, h2h
 
     def _get_activation(self, inputs, activation, **kwargs):
         if isinstance(activation, str):
@@ -115,201 +153,161 @@ class BaseRNNCell:
                                         **kwargs)
         return activation(inputs, **kwargs)
 
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None):
+        """Step the cell T times, building the static graph (reference:
+        rnn_cell.py unroll)."""
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        states = self.begin_state() if begin_state is None else begin_state
+        outputs = []
+        for step in range(length):
+            out, states = self(inputs[step], states)
+            outputs.append(out)
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
 
-def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
-    assert inputs is not None
-    axis = layout.find('T')
-    in_axis = in_layout.find('T') if in_layout is not None else axis
-    if isinstance(inputs, Symbol) and len(inputs) == 1:
-        if merge is False:
-            assert length is not None
-            inputs = list(symbol.op.SliceChannel(
-                inputs, axis=in_axis, num_outputs=length, squeeze_axis=1))
-    else:
-        if isinstance(inputs, Symbol):
-            inputs = list(inputs)
-        assert length is None or len(inputs) == length
-        if merge is True:
-            inputs = [i.expand_dims(axis=axis) for i in inputs]
-            inputs = symbol.op.Concat(*inputs, dim=axis)
-            in_axis = axis
-    if isinstance(inputs, Symbol) and len(inputs) == 1 and axis != in_axis:
-        inputs = symbol.op.SwapAxis(inputs, dim1=axis, dim2=in_axis)
-    return inputs, axis
+
+def _nc_state(num_hidden):
+    return {'shape': (0, num_hidden), '__layout__': 'NC'}
 
 
 class RNNCell(BaseRNNCell):
-    """Simple recurrent cell (reference: rnn_cell.py RNNCell)."""
+    """Elman cell: h' = act(i2h(x) + h2h(h)) (reference: rnn_cell.py
+    RNNCell)."""
 
     def __init__(self, num_hidden, activation='tanh', prefix='rnn_',
                  params=None):
         super().__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        self._activation = activation
-        self._iW = self.params.get('i2h_weight')
-        self._iB = self.params.get('i2h_bias')
-        self._hW = self.params.get('h2h_weight')
-        self._hB = self.params.get('h2h_bias')
+        self._num_hidden, self._activation = num_hidden, activation
+        self._declare_linears()
 
     @property
     def state_info(self):
-        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+        return [_nc_state(self._num_hidden)]
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = '%st%d_' % (self._prefix, self._counter)
-        i2h = symbol.op.FullyConnected(inputs, self._iW, self._iB,
-                                       num_hidden=self._num_hidden,
-                                       name='%si2h' % name)
-        h2h = symbol.op.FullyConnected(states[0], self._hW, self._hB,
-                                       num_hidden=self._num_hidden,
-                                       name='%sh2h' % name)
-        output = self._get_activation(i2h + h2h, self._activation,
-                                      name='%sout' % name)
-        return output, [output]
+        name = self._step_prefix()
+        i2h, h2h = self._gate_projections(name, inputs, states[0], 1)
+        out = self._get_activation(i2h + h2h, self._activation,
+                                   name='%sout' % name)
+        return out, [out]
 
 
 class LSTMCell(BaseRNNCell):
-    """LSTM cell (reference: rnn_cell.py LSTMCell)."""
+    """LSTM cell, gates in i/f/c/o order (reference: rnn_cell.py
+    LSTMCell)."""
 
     def __init__(self, num_hidden, prefix='lstm_', params=None,
                  forget_bias=1.0):
         super().__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        from ..initializer import LSTMBias
-        self._iW = self.params.get('i2h_weight')
-        self._iB = self.params.get('i2h_bias')
-        self._hW = self.params.get('h2h_weight')
-        self._hB = self.params.get('h2h_bias')
+        self._num_hidden = int(num_hidden)
+        self._declare_linears()
 
     @property
     def state_info(self):
-        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'},
-                {'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+        return [_nc_state(self._num_hidden), _nc_state(self._num_hidden)]
 
     @property
     def _gate_names(self):
         return ('_i', '_f', '_c', '_o')
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = '%st%d_' % (self._prefix, self._counter)
-        i2h = symbol.op.FullyConnected(inputs, self._iW, self._iB,
-                                       num_hidden=self._num_hidden * 4,
-                                       name='%si2h' % name)
-        h2h = symbol.op.FullyConnected(states[0], self._hW, self._hB,
-                                       num_hidden=self._num_hidden * 4,
-                                       name='%sh2h' % name)
-        gates = i2h + h2h
-        slice_gates = symbol.op.SliceChannel(gates, num_outputs=4,
-                                             name='%sslice' % name)
-        in_gate = symbol.op.Activation(slice_gates[0], act_type='sigmoid',
-                                       name='%si' % name)
-        forget_gate = symbol.op.Activation(slice_gates[1],
-                                           act_type='sigmoid',
-                                           name='%sf' % name)
-        in_transform = symbol.op.Activation(slice_gates[2], act_type='tanh',
-                                            name='%sc' % name)
-        out_gate = symbol.op.Activation(slice_gates[3], act_type='sigmoid',
-                                        name='%so' % name)
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * symbol.op.Activation(next_c, act_type='tanh')
+        name = self._step_prefix()
+        i2h, h2h = self._gate_projections(name, inputs, states[0], 4)
+        pre = symbol.op.SliceChannel(i2h + h2h, num_outputs=4,
+                                     name='%sslice' % name)
+        sigm = lambda k, tag: symbol.op.Activation(  # noqa: E731
+            pre[k], act_type='sigmoid', name='%s%s' % (name, tag))
+        gate_in, gate_forget, gate_out = sigm(0, 'i'), sigm(1, 'f'), \
+            sigm(3, 'o')
+        candidate = symbol.op.Activation(pre[2], act_type='tanh',
+                                         name='%sc' % name)
+        next_c = gate_forget * states[1] + gate_in * candidate
+        next_h = gate_out * symbol.op.Activation(next_c, act_type='tanh')
         return next_h, [next_h, next_c]
 
 
 class GRUCell(BaseRNNCell):
-    """GRU cell (reference: rnn_cell.py GRUCell)."""
+    """GRU cell, gates in r/z/o order (reference: rnn_cell.py
+    GRUCell)."""
 
     def __init__(self, num_hidden, prefix='gru_', params=None):
         super().__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        self._iW = self.params.get('i2h_weight')
-        self._iB = self.params.get('i2h_bias')
-        self._hW = self.params.get('h2h_weight')
-        self._hB = self.params.get('h2h_bias')
+        self._num_hidden = int(num_hidden)
+        self._declare_linears()
 
     @property
     def state_info(self):
-        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+        return [_nc_state(self._num_hidden)]
 
     @property
     def _gate_names(self):
         return ('_r', '_z', '_o')
 
     def __call__(self, inputs, states):
-        self._counter += 1
-        name = '%st%d_' % (self._prefix, self._counter)
-        prev_state_h = states[0]
-        i2h = symbol.op.FullyConnected(inputs, self._iW, self._iB,
-                                       num_hidden=self._num_hidden * 3,
-                                       name='%si2h' % name)
-        h2h = symbol.op.FullyConnected(prev_state_h, self._hW, self._hB,
-                                       num_hidden=self._num_hidden * 3,
-                                       name='%sh2h' % name)
-        i2h_r, i2h_z, i2h = symbol.op.SliceChannel(
+        name = self._step_prefix()
+        prev_h = states[0]
+        i2h, h2h = self._gate_projections(name, inputs, prev_h, 3)
+        i_r, i_z, i_o = symbol.op.SliceChannel(
             i2h, num_outputs=3, name='%si2h_slice' % name)
-        h2h_r, h2h_z, h2h = symbol.op.SliceChannel(
+        h_r, h_z, h_o = symbol.op.SliceChannel(
             h2h, num_outputs=3, name='%sh2h_slice' % name)
-        reset_gate = symbol.op.Activation(i2h_r + h2h_r, act_type='sigmoid',
-                                          name='%sr_act' % name)
-        update_gate = symbol.op.Activation(i2h_z + h2h_z,
-                                           act_type='sigmoid',
-                                           name='%sz_act' % name)
-        next_h_tmp = symbol.op.Activation(i2h + reset_gate * h2h,
-                                          act_type='tanh',
-                                          name='%sh_act' % name)
-        next_h = (1. - update_gate) * next_h_tmp + \
-            update_gate * prev_state_h
+        reset = symbol.op.Activation(i_r + h_r, act_type='sigmoid',
+                                     name='%sr_act' % name)
+        update = symbol.op.Activation(i_z + h_z, act_type='sigmoid',
+                                      name='%sz_act' % name)
+        proposal = symbol.op.Activation(i_o + reset * h_o, act_type='tanh',
+                                        name='%sh_act' % name)
+        next_h = (1. - update) * proposal + update * prev_h
         return next_h, [next_h]
 
 
 class FusedRNNCell(BaseRNNCell):
-    """Fused multi-layer RNN over the RNN op
-    (reference: rnn_cell.py FusedRNNCell — the cuDNN path; here lax.scan)."""
+    """Multi-layer fused recurrence over the RNN op (reference:
+    rnn_cell.py FusedRNNCell — the cuDNN path; lax.scan here)."""
 
     def __init__(self, num_hidden, num_layers=1, mode='lstm',
                  bidirectional=False, dropout=0., get_next_state=False,
                  forget_bias=1.0, prefix=None, params=None):
-        if prefix is None:
-            prefix = '%s_' % mode
-        super().__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        self._num_layers = num_layers
+        super().__init__(prefix='%s_' % mode if prefix is None else prefix,
+                         params=params)
+        self._num_hidden, self._num_layers = num_hidden, num_layers
         self._mode = mode
-        self._bidirectional = bidirectional
-        self._dropout = dropout
+        self._bidirectional, self._dropout = bidirectional, dropout
         self._get_next_state = get_next_state
         self._directions = 2 if bidirectional else 1
         self._parameter = self.params.get('parameters')
 
     @property
     def state_info(self):
-        b = self._directions * self._num_layers
-        n = 2 if self._mode == 'lstm' else 1
-        return [{'shape': (b, 0, self._num_hidden), '__layout__': 'LNC'}
-                for _ in range(n)]
+        depth = self._directions * self._num_layers
+        n_states = 2 if self._mode == 'lstm' else 1
+        return [{'shape': (depth, 0, self._num_hidden),
+                 '__layout__': 'LNC'} for _ in range(n_states)]
 
     def unroll(self, length, inputs, begin_state=None, layout='NTC',
                merge_outputs=None):
         self.reset()
         inputs, axis = _normalize_sequence(length, inputs, layout, True)
-        if axis == 1:  # NTC -> TNC for the op
+        if axis == 1:  # the op is time-major
             inputs = symbol.op.SwapAxis(inputs, dim1=0, dim2=1)
-        if begin_state is None:
-            begin_state = self.begin_state()
-        states = begin_state
-        rnn_args = [inputs, self._parameter] + states
-        rnn = symbol.op.RNN(*rnn_args, state_size=self._num_hidden,
+        states = self.begin_state() if begin_state is None else begin_state
+        rnn = symbol.op.RNN(inputs, self._parameter, *states,
+                            state_size=self._num_hidden,
                             num_layers=self._num_layers,
                             bidirectional=self._bidirectional,
                             p=self._dropout, state_outputs=True,
                             mode=self._mode,
                             name='%srnn' % self._prefix)
         outputs = rnn[0]
-        if self._mode == 'lstm':
-            states = [rnn[1], rnn[2]] if self._get_next_state else []
+        if not self._get_next_state:
+            states = []
+        elif self._mode == 'lstm':
+            states = [rnn[1], rnn[2]]
         else:
-            states = [rnn[1]] if self._get_next_state else []
+            states = [rnn[1]]
         if axis == 1:
             outputs = symbol.op.SwapAxis(outputs, dim1=0, dim2=1)
         if merge_outputs is False:
@@ -319,7 +317,8 @@ class FusedRNNCell(BaseRNNCell):
 
 
 class SequentialRNNCell(BaseRNNCell):
-    """Stacked cells (reference: rnn_cell.py SequentialRNNCell)."""
+    """Vertically stacked cells (reference: rnn_cell.py
+    SequentialRNNCell)."""
 
     def __init__(self, params=None):
         super().__init__(prefix='', params=params)
@@ -329,49 +328,54 @@ class SequentialRNNCell(BaseRNNCell):
     def add(self, cell):
         self._cells.append(cell)
         if self._override_cell_params:
-            assert cell._own_params, \
-                'Either specify params for SequentialRNNCell or child cells, not both.'
+            if not cell._own_params:
+                raise AssertionError('Either specify params for '
+                                     'SequentialRNNCell or child cells, '
+                                     'not both.')
             cell.params._params.update(self.params._params)
         self.params._params.update(cell.params._params)
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return _flat([c.state_info for c in self._cells])
 
     def begin_state(self, **kwargs):
-        assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        if self._modified:
+            raise AssertionError('cannot begin_state on a modified cell')
+        return _flat([c.begin_state(**kwargs) for c in self._cells])
+
+    def _slices(self, states):
+        """Per-cell views into the flat state list."""
+        at = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            yield cell, states[at:at + n]
+            at += n
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
-        for cell in self._cells:
-            assert not isinstance(cell, BidirectionalCell)
-            n = len(cell.state_info)
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        collected = []
+        for cell, sub in self._slices(states):
+            if isinstance(cell, BidirectionalCell):
+                raise AssertionError(
+                    'BidirectionalCell cannot be stepped; unroll instead')
+            inputs, sub = cell(inputs, sub)
+            collected.append(sub)
+        return inputs, _flat(collected)
 
     def unroll(self, length, inputs, begin_state=None, layout='NTC',
                merge_outputs=None):
         self.reset()
-        num_cells = len(self._cells)
         if begin_state is None:
             begin_state = self.begin_state()
-        p = 0
-        next_states = []
-        for i, cell in enumerate(self._cells):
-            n = len(cell.state_info)
-            states = begin_state[p:p + n]
-            p += n
-            inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            next_states.extend(states)
-        return inputs, next_states
+        last = len(self._cells) - 1
+        collected = []
+        for i, (cell, sub) in enumerate(self._slices(begin_state)):
+            inputs, sub = cell.unroll(
+                length, inputs=inputs, begin_state=sub, layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            collected.extend(sub)
+        return inputs, collected
 
 
 class DropoutCell(BaseRNNCell):
@@ -392,33 +396,34 @@ class DropoutCell(BaseRNNCell):
 
 
 class ZoneoutCell(BaseRNNCell):
-    """Zoneout modifier (reference: ZoneoutCell; simplified symbolic)."""
+    """Zoneout modifier: randomly keep previous states (reference:
+    ZoneoutCell; simplified symbolic form)."""
 
     def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
         super().__init__(prefix=base_cell._prefix + 'zoneout_',
                          params=base_cell.params)
         self.base_cell = base_cell
-        self.zoneout_outputs = zoneout_outputs
-        self.zoneout_states = zoneout_states
+        self.zoneout_outputs, self.zoneout_states = (zoneout_outputs,
+                                                     zoneout_states)
 
     @property
     def state_info(self):
         return self.base_cell.state_info
 
     def __call__(self, inputs, states):
-        out, next_states = self.base_cell(inputs, states)
+        out, nxt = self.base_cell(inputs, states)
         if self.zoneout_states > 0.:
-            next_states = [
-                symbol.op.where(
-                    symbol.op.Dropout(symbol.op.ones_like(ns),
-                                      p=self.zoneout_states) *
-                    self.zoneout_states, ns, s)
-                for ns, s in zip(next_states, states)]
-        return out, next_states
+            def mix(new, old):
+                mask = symbol.op.Dropout(symbol.op.ones_like(new),
+                                         p=self.zoneout_states)
+                return symbol.op.where(mask * self.zoneout_states,
+                                       new, old)
+            nxt = [mix(n, s) for n, s in zip(nxt, states)]
+        return out, nxt
 
 
 class ResidualCell(BaseRNNCell):
-    """Residual modifier (reference: ResidualCell)."""
+    """Residual modifier: output += input (reference: ResidualCell)."""
 
     def __init__(self, base_cell):
         super().__init__(prefix=base_cell._prefix + 'residual_',
@@ -430,12 +435,13 @@ class ResidualCell(BaseRNNCell):
         return self.base_cell.state_info
 
     def __call__(self, inputs, states):
-        output, states = self.base_cell(inputs, states)
-        return output + inputs, states
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
 
 
 class BidirectionalCell(BaseRNNCell):
-    """Bidirectional wrapper (reference: BidirectionalCell)."""
+    """Run one cell forward and one backward over the sequence, concat
+    per-step outputs (reference: BidirectionalCell)."""
 
     def __init__(self, l_cell, r_cell, params=None, output_prefix='bi_'):
         super().__init__('', params=params)
@@ -444,10 +450,10 @@ class BidirectionalCell(BaseRNNCell):
 
     @property
     def state_info(self):
-        return sum([c.state_info for c in self._cells], [])
+        return _flat([c.state_info for c in self._cells])
 
     def begin_state(self, **kwargs):
-        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+        return _flat([c.begin_state(**kwargs) for c in self._cells])
 
     def __call__(self, inputs, states):
         raise NotImplementedError('Bidirectional cannot be stepped. '
@@ -457,23 +463,21 @@ class BidirectionalCell(BaseRNNCell):
                merge_outputs=None):
         self.reset()
         inputs, axis = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self.begin_state()
-        states = begin_state
-        l_cell, r_cell = self._cells
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info)], layout=layout,
-            merge_outputs=False)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=states[len(l_cell.state_info):], layout=layout,
-            merge_outputs=False)
-        outputs = [symbol.op.Concat(l_o, r_o, dim=1,
-                                    name='%st%d' % (self._output_prefix, i))
-                   for i, (l_o, r_o) in enumerate(
-                       zip(l_outputs, reversed(r_outputs)))]
+        states = self.begin_state() if begin_state is None else begin_state
+        fwd, bwd = self._cells
+        n_fwd = len(fwd.state_info)
+        f_out, f_states = fwd.unroll(length, inputs=inputs,
+                                     begin_state=states[:n_fwd],
+                                     layout=layout, merge_outputs=False)
+        b_out, b_states = bwd.unroll(length,
+                                     inputs=list(reversed(inputs)),
+                                     begin_state=states[n_fwd:],
+                                     layout=layout, merge_outputs=False)
+        outputs = [
+            symbol.op.Concat(f, b, dim=1,
+                             name='%st%d' % (self._output_prefix, i))
+            for i, (f, b) in enumerate(zip(f_out, reversed(b_out)))]
         if merge_outputs:
-            outputs = [o.expand_dims(axis=axis) for o in outputs]
-            outputs = symbol.op.Concat(*outputs, dim=axis)
-        return outputs, l_states + r_states
+            steps = [o.expand_dims(axis=axis) for o in outputs]
+            outputs = symbol.op.Concat(*steps, dim=axis)
+        return outputs, f_states + b_states

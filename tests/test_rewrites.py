@@ -294,6 +294,8 @@ def test_bucket_sentence_iter_time_major():
     ('vgg11', 32), ('vgg13_bn', 32),
     ('resnet18_v1', 32), ('resnet18_v2', 32),
     ('resnet50_v1', 32), ('resnet50_v2', 32),
+    ('densenet121', 224), ('mobilenet0_25', 224),
+    ('mobilenet_v2_0_25', 224),
 ])
 def test_model_zoo_forward(factory, size):
     net = getattr(model_zoo.vision, factory)(classes=10)
@@ -326,3 +328,38 @@ def test_resnet_v1_vs_v2_parameter_counts_differ_only_in_norms():
     n2(x)
     # same conv budget; small BN bookkeeping differences only
     assert abs(count(n1) - count(n2)) / count(n1) < 0.02
+
+
+def test_conv_internal_nhwc_matches_nchw():
+    """The channels-last internal conv path (used on accelerators) is
+    numerically identical to the NCHW path (docs/PERF_NOTES.md)."""
+    from mxnet_tpu.ops import nn as nn_ops
+    from mxnet_tpu.ndarray.ndarray import invoke
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(2, 3, 16, 16).astype('float32'))
+    w = nd.array(rng.randn(8, 3, 3, 3).astype('float32'))
+    b = nd.array(rng.randn(8).astype('float32'))
+    attrs = dict(kernel=(3, 3), pad=(1, 1), stride=(2, 2), num_filter=8)
+    saved = dict(nn_ops._CONV_INTERNAL)
+    try:
+        nn_ops._CONV_INTERNAL['nhwc'] = False
+        ref = invoke('Convolution', [x, w, b], attrs).asnumpy()
+        nn_ops._CONV_INTERNAL['nhwc'] = True
+        got = invoke('Convolution', [x, w, b], attrs).asnumpy()
+    finally:
+        nn_ops._CONV_INTERNAL.update(saved)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    # grouped conv takes the same branch
+    xg = nd.array(rng.randn(2, 4, 8, 8).astype('float32'))
+    wg = nd.array(rng.randn(8, 2, 3, 3).astype('float32'))
+    ag = dict(kernel=(3, 3), pad=(1, 1), num_filter=8, num_group=2,
+              no_bias=True)
+    try:
+        nn_ops._CONV_INTERNAL['nhwc'] = False
+        ref = invoke('Convolution', [xg, wg], ag).asnumpy()
+        nn_ops._CONV_INTERNAL['nhwc'] = True
+        got = invoke('Convolution', [xg, wg], ag).asnumpy()
+    finally:
+        nn_ops._CONV_INTERNAL.update(saved)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)

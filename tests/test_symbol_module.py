@@ -199,9 +199,9 @@ def test_bucketing_module():
         mod.forward(batch)
         mod.backward()
         mod.update()
-    assert set(mod._buckets.keys()) == {10, 5, 7}
+    assert set(mod._by_key.keys()) == {10, 5, 7}
     # buckets share the fc weight values
-    p10, _ = mod._buckets[10].get_params()
+    p10, _ = mod._by_key[10].get_params()
     assert 'fc_weight' in p10 and 'embed_weight' in p10
 
 
@@ -241,7 +241,7 @@ def test_lstm_bucketing_fit():
     mod.fit(train_iter, eval_metric=metric, num_epoch=1, optimizer='sgd',
             optimizer_params={'learning_rate': 0.05, 'momentum': 0.9,
                               'rescale_grad': 1.0 / 16})
-    assert set(mod._buckets.keys()) <= {4, 6}
+    assert set(mod._by_key.keys()) <= {4, 6}
     name, ppl = metric.get()
     assert np.isfinite(ppl) and ppl < vocab * 3
 

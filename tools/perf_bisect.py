@@ -1,0 +1,79 @@
+"""Bisect the ResNet-50 step: fwd / fwd+bwd / full fused step, plus a
+raw matmul peak probe. Run on the real chip."""
+import sys
+import time
+
+sys.path.insert(0, '.')
+import numpy as np  # noqa: E402
+
+
+def bench(fn, *args, warmup=3, iters=20):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon
+    from mxnet_tpu.gluon import model_zoo
+    from mxnet_tpu.parallel import pure_forward_fn
+
+    # raw matmul probe: what does the chip actually deliver?
+    for n in (4096, 8192):
+        a = jnp.zeros((n, n), jnp.bfloat16)
+        f = jax.jit(lambda a: a @ a)
+        dt = bench(f, a, warmup=2, iters=10)
+        print('matmul %d: %.2f TFLOP/s' % (n, 2 * n**3 / dt / 1e12),
+              flush=True)
+
+    batch = 128
+    net = model_zoo.vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    net.cast('bfloat16')
+    net.hybridize(static_alloc=True, static_shape=True)
+    x = np.random.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
+    xb = jnp.asarray(x, jnp.bfloat16)
+    nd_x = nd.array(x, dtype='bfloat16')
+    net(nd_x)  # materialise params
+
+    fwd, meta, params = pure_forward_fn(net, training=False)
+    param_arrays = tuple(p.data()._data for p in params)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def f_fwd(pa, xx):
+        outs, _ = fwd(key, list(pa), [xx])
+        return outs[0]
+
+    dt = bench(f_fwd, param_arrays, xb)
+    print('fwd only  : %.1f ms  (%.1f img/s)' % (dt * 1e3, batch / dt),
+          flush=True)
+
+    fwd_t, meta_t, params_t = pure_forward_fn(net, training=True)
+    y = jnp.asarray(np.random.randint(0, 1000, (batch,)))
+
+    def loss_fn(pa, xx, yy):
+        outs, _ = fwd_t(key, list(pa), [xx])
+        logits = outs[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, yy[:, None], 1).mean()
+
+    g = jax.jit(jax.grad(loss_fn))
+    dt = bench(g, param_arrays, xb, y)
+    print('fwd+bwd   : %.1f ms  (%.1f img/s)' % (dt * 1e3, batch / dt),
+          flush=True)
+
+
+if __name__ == '__main__':
+    main()

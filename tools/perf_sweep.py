@@ -1,0 +1,74 @@
+"""One-off perf sweep for the ResNet-50 bench: batch size × step path.
+
+Run on the real chip: python tools/perf_sweep.py
+Prints one line per config. Not part of the driver bench.
+"""
+import sys
+import time
+
+sys.path.insert(0, '.')
+import numpy as np  # noqa: E402
+
+
+def timed(fn, sync, warmup=3, iters=20):
+    for _ in range(warmup):
+        fn()
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon import model_zoo
+
+    results = []
+    for batch in (128, 256):
+        net = model_zoo.vision.resnet50_v1()
+        net.initialize(mx.init.Xavier())
+        net.cast('bfloat16')
+        net.hybridize(static_alloc=True, static_shape=True)
+        L = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = nd.array(np.random.uniform(-1, 1, (batch, 3, 224, 224)),
+                     dtype='bfloat16')
+        y = nd.array(np.random.randint(0, 1000, (batch,)))
+        mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+        pt = parallel.ParallelTrainer(
+            net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9,
+                            'wd': 1e-4}, mesh)
+        pt.step(x, y)
+
+        def sync(o=None):
+            if o is not None:
+                o.wait_to_read()
+            nd.waitall()
+
+        dt = timed(lambda: pt.step(x, y), sync)
+        results.append(('bs%d step' % batch, batch / dt))
+        print('bs=%d step      : %.1f img/s (%.1f ms/step)'
+              % (batch, batch / dt, dt * 1e3), flush=True)
+
+        # step_n: K steps per XLA launch
+        for k in (4, 8):
+            xk = nd.array(np.random.uniform(
+                -1, 1, (k, batch, 3, 224, 224)), dtype='bfloat16')
+            yk = nd.array(np.random.randint(0, 1000, (k, batch,)))
+            pt.step_n(xk, yk)  # compile
+            nd.waitall()
+            dt = timed(lambda: pt.step_n(xk, yk), sync, warmup=2, iters=5)
+            results.append(('bs%d step_n%d' % (batch, k),
+                            k * batch / dt))
+            print('bs=%d step_n(%d): %.1f img/s (%.1f ms/step)'
+                  % (batch, k, k * batch / dt, dt * 1e3 / k), flush=True)
+
+    best = max(results, key=lambda r: r[1])
+    print('BEST: %s -> %.1f img/s' % best)
+
+
+if __name__ == '__main__':
+    main()

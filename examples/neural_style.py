@@ -1,0 +1,76 @@
+"""Neural style transfer (reference: example/neural-style — optimize an
+image so its deep features match a content image and its feature Gram
+matrices match a style image). Tiny TPU-native rendition: the "VGG" is
+a fixed random conv stack (random features preserve style statistics
+well enough for a smoke-scale demo); the pixel buffer itself is the
+trained Parameter, updated by Adam through the frozen extractor in one
+fused autograd graph. Returns (initial_loss, final_loss).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--iters', type=int, default=40)
+    p.add_argument('--size', type=int, default=24)
+    p.add_argument('--style-weight', type=float, default=5.0)
+    p.add_argument('--lr', type=float, default=0.05)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(0)
+    size = args.size
+    # content: a centered square; style: diagonal stripes
+    content = np.zeros((1, 1, size, size), 'float32')
+    content[:, :, size // 4:3 * size // 4, size // 4:3 * size // 4] = 1.0
+    yy, xx = np.mgrid[:size, :size]
+    style = (((yy + xx) // 3) % 2).astype('float32')[None, None]
+
+    extractor = nn.HybridSequential()
+    with extractor.name_scope():
+        extractor.add(nn.Conv2D(8, 3, padding=1, activation='relu'),
+                      nn.Conv2D(16, 3, padding=1, activation='relu'))
+    extractor.initialize(mx.init.Normal(0.4))
+    for param in extractor.collect_params().values():
+        param.grad_req = 'null'     # frozen feature network
+
+    def gram(feat):
+        c = feat.shape[1]
+        flat = feat.reshape((c, -1))
+        return nd.dot(flat, flat.T) / flat.shape[1]
+
+    target_content = extractor(nd.array(content))
+    target_gram = gram(extractor(nd.array(style)))
+
+    canvas = gluon.Parameter('canvas', shape=(1, 1, size, size))
+    canvas.initialize(init=mx.init.Normal(0.1))
+    trainer = gluon.Trainer({'canvas': canvas}, 'adam',
+                            {'learning_rate': args.lr})
+
+    losses = []
+    for _ in range(args.iters):
+        with autograd.record():
+            feat = extractor(canvas.data())
+            c_loss = ((feat - target_content) ** 2).mean()
+            s_loss = ((gram(feat) - target_gram) ** 2).mean()
+            loss = c_loss + args.style_weight * s_loss
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+
+    print('neural style: loss %.4f -> %.4f' % (losses[0], losses[-1]))
+    return losses[0], losses[-1]
+
+
+if __name__ == '__main__':
+    main()

@@ -1,21 +1,26 @@
 """DataLoader: mini-batches from a Dataset with multiprocess workers.
 
 Reference parity: python/mxnet/gluon/data/dataloader.py (worker pool,
-shared-mem NDArray pickling :42-125, default/ batchify fns).
+shared-mem NDArray channel :42-125, default/mp batchify fns).
 
-TPU-native design: workers return host numpy arrays through standard
-multiprocessing (pickle over pipes); the reference's POSIX-shared-memory
-NDArray channel (cpu_shared context, cpu_shared_storage_manager.h:52)
-is unnecessary because the expensive hop is host→HBM, done once per batch
-on the main process. Device transfer happens in default_batchify's final
-nd.array call.
+Worker model (TPU-native analog of the reference's fork + POSIX-shm
+NDArray pickling over cpu_shared storage,
+cpu_shared_storage_manager.h:52):
+  * ``num_workers > 0`` forks worker PROCESSES via the spawn context —
+    fork is unsafe once the XLA runtime is live — and ships each
+    decoded batch back through ``multiprocessing.shared_memory`` (one
+    segment per array, written once by the worker, adopted and
+    unlinked by the main process). Only descriptors travel over the
+    pipe, so batch bytes are never pickled.
+  * workers batchify to host numpy (``default_mp_batchify_fn``); the
+    main process does the single host→HBM device put per batch.
+  * ``thread_pool=True`` keeps the GIL-releasing ThreadPool fallback
+    (cv2/numpy-heavy decode also parallelizes there, without the
+    spawn import cost).
 """
 from __future__ import annotations
 
-import io
 import multiprocessing
-import pickle
-import sys
 
 import numpy as np
 
@@ -24,6 +29,62 @@ from ...ndarray import NDArray
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 __all__ = ['DataLoader', 'default_batchify_fn', 'default_mp_batchify_fn']
+
+
+# ---------------------------------------------------------------------------
+# shared-memory transport (worker -> main)
+# ---------------------------------------------------------------------------
+
+class _ShmSlot:
+    """Descriptor for one array parked in a shared-memory segment."""
+
+    __slots__ = ('name', 'shape', 'dtype')
+
+    def __init__(self, name, shape, dtype):
+        self.name, self.shape, self.dtype = name, shape, str(dtype)
+
+
+def _shm_pack(obj):
+    """Recursively move numpy arrays into shared memory, returning a
+    descriptor tree (runs in the worker)."""
+    if isinstance(obj, np.ndarray) and obj.nbytes:
+        from multiprocessing import shared_memory, resource_tracker
+        seg = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        view = np.ndarray(obj.shape, obj.dtype, buffer=seg.buf)
+        view[...] = obj
+        slot = _ShmSlot(seg.name, obj.shape, obj.dtype)
+        # ownership transfers to the main process (which unlinks); stop
+        # this process's resource tracker from reclaiming it early
+        try:
+            resource_tracker.unregister(seg._name, 'shared_memory')
+        except Exception:
+            pass
+        seg.close()
+        return slot
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_shm_pack(o) for o in obj)
+    return obj
+
+
+def _shm_unpack(obj):
+    """Adopt a descriptor tree: copy arrays out and unlink the segments
+    (runs in the main process)."""
+    if isinstance(obj, _ShmSlot):
+        from multiprocessing import shared_memory
+        seg = shared_memory.SharedMemory(name=obj.name)
+        try:
+            arr = np.ndarray(obj.shape, np.dtype(obj.dtype),
+                             buffer=seg.buf).copy()
+        finally:
+            seg.close()
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+        return arr
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_shm_unpack(o) for o in obj)
+    return obj
 
 
 def default_batchify_fn(data):
@@ -76,13 +137,20 @@ def _worker_fn(samples, batchify_fn, dataset=None):
     return batch
 
 
+def _proc_worker_fn(samples, batchify_fn, dataset=None):
+    """Process-worker target: batchify to numpy, park the result in
+    shared memory, return only descriptors."""
+    return _shm_pack(_worker_fn(samples, batchify_fn, dataset))
+
+
 class _MultiWorkerIter:
     """Iterator dispatching index batches to a process pool with
     out-of-order completion + in-order delivery (reference:
     dataloader.py _MultiWorkerIter)."""
 
     def __init__(self, worker_pool, batchify_fn, batch_sampler,
-                 pin_memory=False, prefetch=0, dataset=None, loader=None):
+                 pin_memory=False, prefetch=0, dataset=None, loader=None,
+                 use_shm=False):
         # pin the owning DataLoader: if the user iterates a temporary
         # (``for x in DataLoader(...)``) the loader must not be collected
         # mid-epoch — its __del__ terminates the worker pool
@@ -95,6 +163,7 @@ class _MultiWorkerIter:
         self._sent_idx = 0
         self._iter = iter(self._batch_sampler)
         self._dataset = dataset
+        self._use_shm = use_shm
         for _ in range(prefetch):
             self._push_next()
 
@@ -105,8 +174,12 @@ class _MultiWorkerIter:
         r = next(self._iter, None)
         if r is None:
             return
+        target = _proc_worker_fn if self._use_shm else _worker_fn
+        # process pools ship the dataset once via the initializer; the
+        # per-task dataset arg is only for the thread pool
+        ds = None if self._use_shm else self._dataset
         async_ret = self._worker_pool.apply_async(
-            _worker_fn, (r, self._batchify_fn, self._dataset))
+            target, (r, self._batchify_fn, ds))
         self._data_buffer[self._sent_idx] = async_ret
         self._sent_idx += 1
 
@@ -121,8 +194,25 @@ class _MultiWorkerIter:
             'fatal error with _push_next, rcvd_idx missing'
         ret = self._data_buffer.pop(self._rcvd_idx)
         batch = ret.get()
+        if self._use_shm:
+            batch = _shm_unpack(batch)
         self._rcvd_idx += 1
         return _as_nd(batch)
+
+    def close(self):
+        """Drain in-flight batches so their shared-memory segments get
+        unlinked (workers unregistered them from their resource
+        tracker, so an abandoned iterator would leak /dev/shm)."""
+        while self._use_shm and self._data_buffer:
+            _, ret = self._data_buffer.popitem()
+            try:
+                _shm_unpack(ret.get(timeout=30))
+            except Exception:
+                pass
+        self._data_buffer = {}
+
+    def __del__(self):
+        self.close()
 
     def next(self):
         return self.__next__()
@@ -167,17 +257,56 @@ class DataLoader:
         self._prefetch = max(0, int(prefetch) if prefetch is not None
                              else 2 * self._num_workers)
         if self._num_workers > 0:
-            # The JAX/XLA runtime is NOT fork-safe (forked children deadlock
-            # on the device runtime), so worker pools are thread-based: the
-            # heavy work (cv2 decode, numpy) releases the GIL, which is how
-            # the reference's OMP decode pool parallelizes too. The
-            # process-pool + shared-memory channel of the reference
-            # (dataloader.py:42-125) is unnecessary on this backend.
-            from multiprocessing.pool import ThreadPool
-            self._worker_pool = ThreadPool(self._num_workers)
-            self._thread_pool = True
+            if self._thread_pool:
+                # GIL-releasing decode (cv2, numpy) parallelizes on
+                # threads without the spawn import cost
+                from multiprocessing.pool import ThreadPool
+                self._worker_pool = ThreadPool(self._num_workers)
+            else:
+                # spawn (NOT fork: the XLA runtime is not fork-safe once
+                # live); the dataset ships to each worker exactly once
+                # via the initializer, batches come back through
+                # shared memory (_shm_pack/_shm_unpack).
+                # NOTE: spawn requires (a) a picklable dataset — lambdas
+                # in transforms fall back to threads below — and (b) an
+                # ``if __name__ == '__main__'`` guard in user scripts
+                # (Python re-imports __main__ in each worker).
+                import pickle as _pickle
+                try:
+                    _pickle.dumps(dataset)
+                    picklable = True
+                except Exception:
+                    picklable = False
+                ctx = multiprocessing.get_context('spawn')
+                if picklable:
+                    self._worker_pool = ctx.Pool(
+                        self._num_workers,
+                        initializer=_worker_initializer,
+                        initargs=(dataset,))
+                else:
+                    import warnings
+                    warnings.warn(
+                        'DataLoader(num_workers=%d): dataset is not '
+                        'picklable (lambda transform?); falling back to '
+                        'the GIL-releasing thread pool. Use a named '
+                        'function or a picklable callable for process '
+                        'workers.' % self._num_workers, stacklevel=2)
+                    from multiprocessing.pool import ThreadPool
+                    self._worker_pool = ThreadPool(self._num_workers)
+                    self._thread_pool = True
+                # tear the pool down before interpreter shutdown breaks
+                # the queue pickler (noisy Pool.__del__ otherwise)
+                import atexit
+                import weakref
+                atexit.register(DataLoader._shutdown_pool,
+                                weakref.ref(self))
         if batchify_fn is None:
-            self._batchify_fn = default_batchify_fn
+            if self._num_workers > 0 and not self._thread_pool:
+                # workers must batchify to host numpy; the device put
+                # happens once per batch in the main process (_as_nd)
+                self._batchify_fn = default_mp_batchify_fn
+            else:
+                self._batchify_fn = default_batchify_fn
         else:
             self._batchify_fn = batchify_fn
 
@@ -193,15 +322,23 @@ class DataLoader:
         return _MultiWorkerIter(
             self._worker_pool, self._batchify_fn, self._batch_sampler,
             pin_memory=self._pin_memory, prefetch=self._prefetch,
-            dataset=self._dataset, loader=self)
+            dataset=self._dataset, loader=self,
+            use_shm=not self._thread_pool)
 
     def __len__(self):
         return len(self._batch_sampler)
 
+    @staticmethod
+    def _shutdown_pool(ref):
+        loader = ref()
+        if loader is not None:
+            loader.__del__()
+
     def __del__(self):
-        if self._worker_pool:
+        pool, self._worker_pool = self._worker_pool, None
+        if pool:
             try:
-                self._worker_pool.terminate()
-                self._worker_pool.join()
+                pool.terminate()
+                pool.join()
             except Exception:
                 pass  # interpreter-shutdown races in pool teardown

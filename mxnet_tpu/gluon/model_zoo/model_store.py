@@ -1,49 +1,148 @@
-"""Pretrained model weight store (reference:
-python/mxnet/gluon/model_zoo/model_store.py).
+"""Pretrained model weight store.
 
-The reference downloads from S3; this environment has zero egress, so
-get_model_file only resolves from the local root (set MXNET_HOME or pass
-root=). API kept for drop-in compatibility.
+Reference parity: python/mxnet/gluon/model_zoo/model_store.py, which
+resolves `{name}-{short_hash}.params` files against a sha1-pinned
+registry (reference :34-60) and downloads from S3 on miss. This
+environment has zero egress, so resolution is local-only with the same
+integrity pins:
+
+* ``{root}/{name}-{short_hash}.params`` — an OFFICIALLY published
+  weight file staged by the user (e.g. copied from an existing MXNet
+  install's ``~/.mxnet/models``). The full sha1 is verified against
+  the published pin; a corrupted file is rejected.
+* ``{root}/{name}.params`` — a locally produced weight file (trained
+  here, or a seed fixture from :func:`create_seed_fixture`). Accepted
+  as-is: local files carry no published pin.
+
+``root`` defaults to ``$MXNET_HOME/models`` (``~/.mxnet/models``).
+:func:`create_seed_fixture` gives ``pretrained=True`` a deterministic,
+network-free happy path: it builds the requested zoo architecture with
+a fixed seed and stages its parameters.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 
-__all__ = ['get_model_file', 'purge']
+__all__ = ['get_model_file', 'purge', 'create_seed_fixture']
 
-_model_sha1 = {}
+# published sha1 pins for the reference's released weight files
+# (model_store.py:34-60 — data constants, used only for integrity
+# verification of user-staged official files)
+_model_sha1 = {name: checksum for checksum, name in [
+    ('44335d1f0046b328243b32a26a4fbd62d9057b45', 'alexnet'),
+    ('f27dbf2dbd5ce9a80b102d89c7483342cd33cb31', 'densenet121'),
+    ('b6c8a95717e3e761bd88d145f4d0a214aaa515dc', 'densenet161'),
+    ('2603f878403c6aa5a71a124c4a3307143d6820e9', 'densenet169'),
+    ('1cdbc116bc3a1b65832b18cf53e1cb8e7da017eb', 'densenet201'),
+    ('ed47ec45a937b656fcc94dabde85495bbef5ba1f', 'inceptionv3'),
+    ('9f83e440996887baf91a6aff1cccc1c903a64274', 'mobilenet0.25'),
+    ('8e9d539cc66aa5efa71c4b6af983b936ab8701c3', 'mobilenet0.5'),
+    ('529b2c7f4934e6cb851155b22c96c9ab0a7c4dc2', 'mobilenet0.75'),
+    ('6b8c5106c730e8750bcd82ceb75220a3351157cd', 'mobilenet1.0'),
+    ('36da4ff1867abccd32b29592d79fc753bca5a215', 'mobilenetv2_1.0'),
+    ('e2be7b72a79fe4a750d1dd415afedf01c3ea818d', 'mobilenetv2_0.75'),
+    ('aabd26cd335379fcb72ae6c8fac45a70eab11785', 'mobilenetv2_0.5'),
+    ('ae8f9392789b04822cbb1d98c27283fc5f8aa0a7', 'mobilenetv2_0.25'),
+    ('a0666292f0a30ff61f857b0b66efc0228eb6a54b', 'resnet18_v1'),
+    ('48216ba99a8b1005d75c0f3a0c422301a0473233', 'resnet34_v1'),
+    ('0aee57f96768c0a2d5b23a6ec91eb08dfb0a45ce', 'resnet50_v1'),
+    ('d988c13d6159779e907140a638c56f229634cb02', 'resnet101_v1'),
+    ('671c637a14387ab9e2654eafd0d493d86b1c8579', 'resnet152_v1'),
+    ('a81db45fd7b7a2d12ab97cd88ef0a5ac48b8f657', 'resnet18_v2'),
+    ('9d6b80bbc35169de6b6edecffdd6047c56fdd322', 'resnet34_v2'),
+    ('ecdde35339c1aadbec4f547857078e734a76fb49', 'resnet50_v2'),
+    ('18e93e4f48947e002547f50eabbcc9c83e516aa6', 'resnet101_v2'),
+    ('f2695542de38cf7e71ed58f02893d82bb409415e', 'resnet152_v2'),
+    ('264ba4970a0cc87a4f15c96e25246a1307caf523', 'squeezenet1.0'),
+    ('33ba0f93753c83d86e1eb397f38a667eaf2e9376', 'squeezenet1.1'),
+    ('dd221b160977f36a53f464cb54648d227c707a05', 'vgg11'),
+    ('ee79a8098a91fbe05b7a973fed2017a6117723a8', 'vgg11_bn'),
+    ('6bc5de58a05a5e2e7f493e2d75a580d83efde38c', 'vgg13'),
+    ('7d97a06c3c7a1aecc88b6e7385c2b373a249e95e', 'vgg13_bn'),
+    ('e660d4569ccb679ec68f1fd3cce07a387252a90a', 'vgg16'),
+    ('7f01cf050d357127a73826045c245041b0df7363', 'vgg16_bn'),
+    ('ad2f660d101905472b83590b59708b71ea22b2e5', 'vgg19'),
+    ('f360b758e856f1074a85abd5fd873ed1d98297c3', 'vgg19_bn')]}
+
+
+def _models_dir(root):
+    if root is None:
+        root = os.path.join(os.environ.get(
+            'MXNET_HOME', os.path.expanduser('~/.mxnet')), 'models')
+    return os.path.expanduser(root)
+
+
+def _sha1_of(path):
+    digest = hashlib.sha1()
+    with open(path, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            digest.update(chunk)
+    return digest.hexdigest()
 
 
 def short_hash(name):
     if name not in _model_sha1:
-        raise ValueError('Pretrained model for {name} is not available.'.format(
-            name=name))
+        raise ValueError(
+            'Pretrained model for {name} is not available.'.format(
+                name=name))
     return _model_sha1[name][:8]
 
 
 def get_model_file(name, root=None):
-    """Return the path of a locally available pretrained parameter file."""
-    if root is None:
-        root = os.path.join(os.environ.get('MXNET_HOME',
-                                           os.path.expanduser('~/.mxnet')),
-                            'models')
-    root = os.path.expanduser(root)
-    file_path = os.path.join(root, '%s.params' % name)
-    if os.path.exists(file_path):
-        return file_path
+    """Resolve a pretrained parameter file locally (see module
+    docstring for the staging protocol)."""
+    root = _models_dir(root)
+    # officially staged, pin-verified file
+    if name in _model_sha1:
+        pinned = os.path.join(
+            root, '%s-%s.params' % (name, short_hash(name)))
+        if os.path.exists(pinned):
+            if _sha1_of(pinned) != _model_sha1[name]:
+                raise ValueError(
+                    'Staged file %s does not match the published sha1 '
+                    'pin for %s — the file is corrupted or mislabeled. '
+                    'Re-stage it, or save local weights as %s.params '
+                    'instead.' % (pinned, name, name))
+            return pinned
+    # locally produced file (trained here / seed fixture): no pin
+    local = os.path.join(root, '%s.params' % name)
+    if os.path.exists(local):
+        return local
     raise RuntimeError(
-        'Pretrained weights for %s not found at %s. Downloading requires '
-        'network egress, which is unavailable; place the file there '
-        'manually.' % (name, file_path))
+        'Pretrained weights for %s not found under %s. Downloading '
+        'requires network egress, which is unavailable: stage an '
+        'official file as %s-<shorthash>.params (sha1-verified) or a '
+        'local one as %s.params — create_seed_fixture() generates a '
+        'deterministic local fixture.' % (name, root, name, name))
+
+
+def create_seed_fixture(name, root=None, seed=0, classes=1000):
+    """Build zoo architecture ``name`` with deterministically seeded
+    weights and stage it so ``pretrained=True`` resolves offline."""
+    import numpy as onp
+    from ... import nd
+    from ...  import random as _random
+    from .. import model_zoo
+
+    root = _models_dir(root)
+    os.makedirs(root, exist_ok=True)
+    onp.random.seed(seed)
+    _random.seed(seed)
+    from ... import initializer
+    net = model_zoo.vision.get_model(name, classes=classes)
+    net.initialize(initializer.Xavier())
+    # materialise deferred shapes with a canonical input
+    size = 299 if 'inception' in name else 224
+    net(nd.zeros((1, 3, size, size)))
+    path = os.path.join(root, '%s.params' % name)
+    net.save_parameters(path)
+    return path
 
 
 def purge(root=None):
-    """Remove cached pretrained models."""
-    if root is None:
-        root = os.path.join(os.environ.get('MXNET_HOME',
-                                           os.path.expanduser('~/.mxnet')),
-                            'models')
-    root = os.path.expanduser(root)
+    """Remove every staged .params file under the model root."""
+    root = _models_dir(root)
     if os.path.isdir(root):
         for f in os.listdir(root):
             if f.endswith('.params'):

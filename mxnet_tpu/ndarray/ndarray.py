@@ -544,7 +544,30 @@ _PULLBACK_APPLY = jax.jit(lambda pb, cts: pb(cts))
 
 def invoke(opname, nd_inputs, attrs, out=None):
     """Invoke a registered op eagerly on NDArrays, recording on the autograd
-    tape when inside autograd.record() (Imperative::Invoke + RecordOp)."""
+    tape when inside autograd.record() (Imperative::Invoke + RecordOp).
+
+    When the profiler is running, each dispatch is recorded as an
+    'operator' span, fenced with block_until_ready so the span covers
+    execution rather than async dispatch (profile_imperative parity;
+    reference: profiler.h:438 — the reference profiler also serializes
+    the engine while profiling)."""
+    from .. import profiler as _profiler
+    if not _profiler.is_running():
+        return _invoke_impl(opname, nd_inputs, attrs, out=out)
+    ret = None
+
+    def _fence():
+        for leaf in (ret if isinstance(ret, (list, tuple)) else [ret]):
+            if isinstance(leaf, NDArray):
+                leaf._data.block_until_ready()
+
+    with _profiler.op_span(
+            opname if isinstance(opname, str) else opname.name, _fence):
+        ret = _invoke_impl(opname, nd_inputs, attrs, out=out)
+    return ret
+
+
+def _invoke_impl(opname, nd_inputs, attrs, out=None):
     op = _registry.get(opname) if isinstance(opname, str) else opname
     variadic = op.num_inputs == -1
     flat_inputs = list(nd_inputs)

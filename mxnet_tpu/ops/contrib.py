@@ -445,10 +445,16 @@ def roi_pooling(data, rois, *, pooled_size=None, spatial_scale=1.0):
 
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
-        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
-        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
-        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        # clamp to the feature map like the reference (roi_pooling.cc
+        # min/max against width-1/height-1) so edge bins never go empty
+        x1 = jnp.clip(jnp.round(roi[1] * spatial_scale), 0, w - 1) \
+            .astype(jnp.int32)
+        y1 = jnp.clip(jnp.round(roi[2] * spatial_scale), 0, h - 1) \
+            .astype(jnp.int32)
+        x2 = jnp.clip(jnp.round(roi[3] * spatial_scale), 0, w - 1) \
+            .astype(jnp.int32)
+        y2 = jnp.clip(jnp.round(roi[4] * spatial_scale), 0, h - 1) \
+            .astype(jnp.int32)
         rw = jnp.maximum(x2 - x1 + 1, 1)
         rh = jnp.maximum(y2 - y1 + 1, 1)
         img = data[bidx]
@@ -465,6 +471,9 @@ def roi_pooling(data, rois, *, pooled_size=None, spatial_scale=1.0):
                 maskx = ((xx >= xs) & (xx < jnp.maximum(xe, xs + 1))).astype(data.dtype)
                 m2 = mask.T @ maskx  # (H, W)
                 masked = jnp.where(m2 > 0, img, -jnp.inf)
-                out.append(masked.max(axis=(1, 2)))
+                peak = masked.max(axis=(1, 2))
+                # a bin that still ends up empty pools to 0 (reference
+                # is_empty rule), never -inf
+                out.append(jnp.where(jnp.isfinite(peak), peak, 0.0))
         return jnp.stack(out, axis=-1).reshape(c, ph, pw)
     return jax.vmap(one_roi)(rois)

@@ -391,8 +391,12 @@ class ParallelTrainer:
                    for a, sh in zip(xs, self._data_shardings[0]))
         yd = tuple(jax.device_put(a, sh)
                    for a, sh in zip(ys, self._data_shardings[1]))
-        self._param_arrays, self._state_leaves, loss = self._jitted(
-            key, hyper, self._param_arrays, self._state_leaves, xd, yd)
+        from .. import profiler as _profiler
+        loss = None
+        with _profiler.op_span('fused_train_step',
+                               lambda: loss.block_until_ready()):
+            self._param_arrays, self._state_leaves, loss = self._jitted(
+                key, hyper, self._param_arrays, self._state_leaves, xd, yd)
         self.num_update += 1
         # keep the net's Parameters viewing the live sharded arrays
         for p, w in zip(self._params, self._param_arrays):

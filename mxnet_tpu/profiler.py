@@ -17,8 +17,9 @@ import threading
 import time
 
 __all__ = ['set_config', 'profiler_set_config', 'set_state',
-           'profiler_set_state', 'dump', 'dumps', 'pause', 'resume',
-           'Task', 'Frame', 'Event', 'Counter', 'Marker', 'scope']
+           'profiler_set_state', 'dump', 'dumps', 'aggregate_stats',
+           'pause', 'resume', 'Task', 'Frame', 'Event', 'Counter',
+           'Marker', 'scope']
 
 _config = {'filename': 'profile.json', 'profile_all': False,
            'profile_symbolic': True, 'profile_imperative': True,
@@ -86,21 +87,97 @@ def _emit(ph, name, cat, ts, dur=None, args=None):
         _events.append(ev)
 
 
-def dumps(reset=False):
-    """Return aggregate stats string (reference: profiler.py dumps)."""
+def aggregate_stats(reset=False):
+    """Per-scope aggregate {name: {category, count, total_ms, min_ms,
+    max_ms, avg_ms}} from the event buffer (reference:
+    src/profiler/aggregate_stats.cc AggregateStats)."""
     with _lock:
-        by_name = {}
+        table = {}
         for ev in _events:
-            if ev['ph'] == 'X':
-                agg = by_name.setdefault(ev['name'], [0, 0.0])
-                agg[0] += 1
-                agg[1] += ev.get('dur', 0.0) / 1e3
-        lines = ['%-40s %8s %12s' % ('Name', 'Calls', 'Total ms')]
-        for name, (calls, total) in sorted(by_name.items()):
-            lines.append('%-40s %8d %12.3f' % (name, calls, total))
+            if ev['ph'] != 'X':
+                continue
+            dur = ev.get('dur', 0.0) / 1e3
+            rec = table.get(ev['name'])
+            if rec is None:
+                table[ev['name']] = rec = {
+                    'category': ev.get('cat', 'user'), 'count': 0,
+                    'total_ms': 0.0, 'min_ms': dur, 'max_ms': dur}
+            rec['count'] += 1
+            rec['total_ms'] += dur
+            rec['min_ms'] = min(rec['min_ms'], dur)
+            rec['max_ms'] = max(rec['max_ms'], dur)
+        for rec in table.values():
+            rec['avg_ms'] = rec['total_ms'] / max(rec['count'], 1)
         if reset:
             _events.clear()
+    return table
+
+
+_SORT_KEYS = {'total': 'total_ms', 'avg': 'avg_ms', 'min': 'min_ms',
+              'max': 'max_ms', 'count': 'count'}
+
+
+def dumps(reset=False, format='table', sort_by='total', ascending=False):
+    """Aggregate stats as text (or JSON with ``format='json'``)
+    (reference: profiler.py dumps / MXAggregateProfileStatsPrint at
+    src/c_api/c_api_profile.cc:305; sort options match)."""
+    table = aggregate_stats(reset=reset)
+    if format == 'json':
+        return json.dumps(table, sort_keys=True)
+    if sort_by not in _SORT_KEYS:
+        raise ValueError('sort_by must be one of %s'
+                         % sorted(_SORT_KEYS))
+    key = _SORT_KEYS[sort_by]
+    rows = sorted(table.items(), key=lambda kv: kv[1][key],
+                  reverse=not ascending)
+    lines = ['%-40s %-10s %8s %12s %10s %10s %10s'
+             % ('Name', 'Category', 'Calls', 'Total ms', 'Min ms',
+                'Max ms', 'Avg ms')]
+    for name, r in rows:
+        lines.append('%-40s %-10s %8d %12.3f %10.3f %10.3f %10.3f'
+                     % (name, r['category'], r['count'], r['total_ms'],
+                        r['min_ms'], r['max_ms'], r['avg_ms']))
     return '\n'.join(lines)
+
+
+def record_op(name, start, stop):
+    """Hot-path hook for the eager dispatcher: record one operator span
+    when the profiler is running (profile_imperative parity)."""
+    if _state['running'] and _config.get('profile_imperative', True):
+        _emit('X', name, 'operator', start, stop - start)
+
+
+def is_running():
+    return _state['running']
+
+
+class op_span:
+    """Tiny timing guard used by the dispatch hot paths: no-op when the
+    profiler is idle; otherwise times the block, calling ``sync`` (a
+    device fence) before the stop stamp so the span covers execution,
+    not just async dispatch. On locally attached backends
+    block_until_ready is a true fence; on tunneled PJRT backends spans
+    still under-report device time (see wait_to_read docs) — the
+    XPlane trace is the ground truth there."""
+
+    __slots__ = ('name', 'sync', '_t0')
+
+    def __init__(self, name, sync=None):
+        self.name, self.sync = name, sync
+
+    def __enter__(self):
+        self._t0 = time.perf_counter() if _state['running'] else None
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is None:
+            return
+        if exc[0] is None and self.sync is not None:
+            try:
+                self.sync()
+            except Exception:
+                pass
+        record_op(self.name, self._t0, time.perf_counter())
 
 
 def dump(finished=True, profile_process='worker'):

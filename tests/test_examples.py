@@ -63,3 +63,39 @@ def test_super_resolution_beats_nearest():
 def test_fgsm_collapses_accuracy():
     clean, adv = adversary_fgsm.main(['--num-samples', '512'])
     assert clean > 0.9 and adv < clean - 0.2
+
+
+def test_fcn_segmentation_beats_majority():
+    from examples import fcn_segmentation
+    acc, majority = fcn_segmentation.main(['--epochs', '8',
+                                           '--num-samples', '32'])
+    assert acc > majority + 0.05
+
+
+def test_rcnn_finetune_head_learns():
+    from examples import rcnn_finetune
+    acc, pos_rate = rcnn_finetune.main(['--epochs', '8',
+                                        '--num-samples', '16'])
+    # better than always guessing the majority ROI class
+    assert acc >= max(pos_rate, 1 - pos_rate) - 0.05
+    assert acc > 0.5
+
+
+def test_neural_style_loss_decreases():
+    from examples import neural_style
+    first, last = neural_style.main(['--iters', '25'])
+    assert last < 0.5 * first
+
+
+def test_nce_lm_learns_bigrams():
+    from examples import nce_lm
+    acc, chance = nce_lm.main(['--epochs', '5',
+                               '--corpus-len', '1200'])
+    assert acc > 10 * chance
+
+
+def test_bayes_sgld_posterior_predicts():
+    from examples import bayes_sgld
+    ens_acc, last_acc = bayes_sgld.main(['--steps', '200'])
+    assert ens_acc > 0.8
+    assert ens_acc >= last_acc - 0.05

@@ -307,3 +307,44 @@ def test_context_valid_ids_resolve():
     assert mx.cpu(7).jax_device() is not mx.cpu(0).jax_device()
     # accelerator aliases resolve (to host devices on the CPU-only suite)
     assert mx.tpu(0).jax_device() is not None
+
+
+def test_profiler_aggregate_stats():
+    """MXAggregateProfileStatsPrint parity: named scopes + per-op spans
+    aggregate into counts/min/max/avg (reference:
+    src/profiler/aggregate_stats.cc)."""
+    from mxnet_tpu import profiler, gluon, parallel
+    from mxnet_tpu.gluon import nn
+    import jax
+    profiler._events.clear()
+    profiler.set_state('run')
+    try:
+        with profiler.Task(name='train_phase'):
+            net = nn.HybridSequential()
+            with net.name_scope():
+                net.add(nn.Dense(8, activation='relu'), nn.Dense(2))
+            net.initialize(mx.init.Xavier())
+            L = gluon.loss.SoftmaxCrossEntropyLoss()
+            mesh = parallel.create_mesh({'dp': 1},
+                                        devices=jax.devices('cpu')[:1])
+            pt = parallel.ParallelTrainer(
+                net, L, 'sgd', {'learning_rate': 0.1}, mesh)
+            x = nd.array(np.random.randn(4, 3).astype('float32'))
+            y = nd.array(np.array([0, 1, 0, 1], 'float32'))
+            for _ in range(3):
+                pt.step(x, y)
+            _ = (nd.ones((2, 2)) + 1).asnumpy()   # eager op span
+    finally:
+        profiler.set_state('stop')
+    stats = profiler.aggregate_stats()
+    assert stats['fused_train_step']['count'] == 3
+    assert stats['fused_train_step']['total_ms'] > 0
+    assert stats['fused_train_step']['max_ms'] >= \
+        stats['fused_train_step']['min_ms']
+    assert stats['train_phase']['count'] == 1
+    assert any(r['category'] == 'operator' for r in stats.values())
+    text = profiler.dumps(sort_by='count')
+    assert 'fused_train_step' in text and 'Avg ms' in text
+    as_json = profiler.dumps(format='json', reset=True)
+    assert 'fused_train_step' in as_json
+    assert profiler.aggregate_stats() == {}

@@ -363,3 +363,47 @@ def test_conv_internal_nhwc_matches_nchw():
     finally:
         nn_ops._CONV_INTERNAL.update(saved)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# model_store: offline pretrained-weight protocol
+# ---------------------------------------------------------------------------
+
+def test_model_store_seed_fixture_happy_path(tmp_path):
+    """create_seed_fixture stages deterministic weights that
+    pretrained=True then resolves offline."""
+    from mxnet_tpu.gluon.model_zoo import model_store
+    root = str(tmp_path)
+    path = model_store.create_seed_fixture('squeezenet1.0', root=root,
+                                           classes=10)
+    assert path.endswith('squeezenet1.0.params')
+    net = model_zoo.vision.get_model('squeezenet1.0', pretrained=True,
+                                     root=root, classes=10)
+    x = nd.array(np.random.RandomState(0).randn(1, 3, 224, 224)
+                 .astype('float32'))
+    out = net(x)
+    assert out.shape == (1, 10)
+    # determinism: same seed -> byte-identical fixture
+    again = model_store.create_seed_fixture('squeezenet1.0', root=root,
+                                            classes=10)
+    net2 = model_zoo.vision.get_model('squeezenet1.0', pretrained=True,
+                                      root=root, classes=10)
+    np.testing.assert_allclose(net2(x).asnumpy(), out.asnumpy(),
+                               rtol=1e-6, atol=1e-6)
+    assert again == path
+
+
+def test_model_store_missing_and_corrupt(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import model_store
+    root = str(tmp_path)
+    with pytest.raises(RuntimeError, match='not found'):
+        model_store.get_model_file('resnet18_v1', root=root)
+    # a pin-named file whose contents do not match the published sha1
+    bogus = tmp_path / ('resnet18_v1-%s.params'
+                        % model_store.short_hash('resnet18_v1'))
+    bogus.write_bytes(b'not really weights')
+    with pytest.raises(ValueError, match='sha1'):
+        model_store.get_model_file('resnet18_v1', root=root)
+    # unknown names have no pin at all
+    with pytest.raises(ValueError, match='not available'):
+        model_store.short_hash('made_up_net')

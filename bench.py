@@ -157,7 +157,10 @@ def bench_bert(on_accel):
     from mxnet_tpu import autograd, gluon, nd
     from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
 
-    batch = 32 if on_accel else 2
+    # bs sweep on-chip: 32 -> 607, 48 -> 630, 64 -> 647, 96 -> 682
+    # samples/s; 96 keeps the MLM head matmuls MXU-sized without
+    # pushing the step past HBM (docs/PERF_NOTES.md)
+    batch = 96 if on_accel else 2
     seqlen = 128 if on_accel else 16
     npred = 20 if on_accel else 2
     vocab = 30522 if on_accel else 100

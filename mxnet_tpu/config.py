@@ -218,10 +218,17 @@ def naive_engine():
     return v == 'NaiveEngine'
 
 
+from . import engine as _engine  # lightweight: threading only
+
+
 def bulk_exec(training):
     """Jit-cache enable for the eager dispatch path (reference:
     MXNET_EXEC_BULK_EXEC_TRAIN/_INFERENCE). Lock-free like
-    naive_engine()."""
+    naive_engine(). ``engine.set_bulk_size(0)`` (or the ``bulk(0)``
+    scope) disables bulking the same way the env knobs do — the engine
+    module's segment size is the scoped override."""
+    if _engine._cur() <= 0:
+        return False
     name = 'MXNET_EXEC_BULK_EXEC_TRAIN' if training else \
         'MXNET_EXEC_BULK_EXEC_INFERENCE'
     v = _values.get(name)

@@ -2,8 +2,12 @@
 sizing over MXEngineSetBulkSize).
 
 TPU-native: op bulking is what the compiled-dispatch jit cache and
-hybridize already do, so the bulk size is bookkeeping — kept for API
-parity and surfaced to config's MXNET_EXEC_BULK_EXEC_* knobs."""
+hybridize already do, so the segment size maps onto the eager
+dispatcher's jit cache: ``set_bulk_size(0)`` / ``bulk(0)`` turns the
+compiled dispatch OFF for the scope (every op runs un-jitted, the
+NaiveEngine-adjacent debug mode), any positive size leaves it on. The
+reference's finer per-segment-length control has no XLA analog —
+config.bulk_exec documents the mapping."""
 from __future__ import annotations
 
 import threading

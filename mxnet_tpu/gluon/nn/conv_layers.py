@@ -1,9 +1,12 @@
 """Convolutional and pooling Gluon layers.
 
-Reference parity: python/mxnet/gluon/nn/conv_layers.py:165-1168 (Conv1-3D,
-Conv1-3DTranspose, Max/Avg/GlobalMax/GlobalAvg/Sum pooling 1-3D,
-ReflectionPad2D). All use NCHW-family layouts; convs lower to one
-lax.conv_general_dilated on the MXU (ops/nn.py Convolution).
+Reference parity: python/mxnet/gluon/nn/conv_layers.py:165-1168
+(Conv1-3D, Conv1-3DTranspose, Max/Avg/GlobalMax/GlobalAvg pooling
+1-3D, ReflectionPad2D). Layouts are the NCHW family; convs lower to
+one lax.conv_general_dilated on the MXU (ops/nn.py Convolution). The
+reference re-spells the layout check and kernel normalisation in all
+18 subclasses; here two helpers (`_check_layout`, `_ndtuple`) carry
+that, so each subclass is just its signature.
 """
 from __future__ import annotations
 
@@ -12,238 +15,190 @@ import numpy as onp
 from ..block import HybridBlock
 from .activations import Activation
 
-__all__ = ['Conv1D', 'Conv2D', 'Conv3D', 'Conv1DTranspose', 'Conv2DTranspose',
-           'Conv3DTranspose', 'MaxPool1D', 'MaxPool2D', 'MaxPool3D',
-           'AvgPool1D', 'AvgPool2D', 'AvgPool3D', 'GlobalMaxPool1D',
-           'GlobalMaxPool2D', 'GlobalMaxPool3D', 'GlobalAvgPool1D',
-           'GlobalAvgPool2D', 'GlobalAvgPool3D', 'ReflectionPad2D']
+__all__ = ['Conv1D', 'Conv2D', 'Conv3D', 'Conv1DTranspose',
+           'Conv2DTranspose', 'Conv3DTranspose', 'MaxPool1D', 'MaxPool2D',
+           'MaxPool3D', 'AvgPool1D', 'AvgPool2D', 'AvgPool3D',
+           'GlobalMaxPool1D', 'GlobalMaxPool2D', 'GlobalMaxPool3D',
+           'GlobalAvgPool1D', 'GlobalAvgPool2D', 'GlobalAvgPool3D',
+           'ReflectionPad2D']
+
+# canonical layouts per spatial rank (index 1..3)
+_LAYOUTS = {1: ('NCW',), 2: ('NCHW', 'NHWC'), 3: ('NCDHW', 'NDHWC')}
 
 
-def _to_tuple(x, n):
-    if isinstance(x, (int, onp.integer)):
-        return (int(x),) * n
-    t = tuple(int(i) for i in x)
-    assert len(t) == n
+def _check_layout(layout, ndim):
+    allowed = _LAYOUTS[ndim]
+    if layout not in allowed:
+        raise AssertionError('Only supports %s layout for now'
+                             % ' and '.join("'%s'" % a for a in allowed))
+    return layout
+
+
+def _ndtuple(value, n, what):
+    """Broadcast an int to an n-tuple; validate explicit tuples."""
+    if isinstance(value, (int, onp.integer)):
+        return (int(value),) * n
+    t = tuple(int(v) for v in value)
+    if len(t) != n:
+        raise AssertionError('%s must be a number or a list of %d ints'
+                             % (what, n))
     return t
 
 
 class _Conv(HybridBlock):
-    """Base conv layer (reference: conv_layers.py:46 _Conv)."""
+    """Shared conv/deconv machinery (reference: conv_layers.py:46
+    _Conv): owns weight/bias Parameters, deferred in_channels
+    inference, and the single fused op call."""
 
     def __init__(self, channels, kernel_size, strides, padding, dilation,
-                 groups, layout, in_channels=0, activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer='zeros',
-                 op_name='Convolution', adj=None, prefix=None, params=None):
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer='zeros', op_name='Convolution',
+                 adj=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         with self.name_scope():
-            self._channels = channels
-            self._in_channels = in_channels
+            self._channels, self._in_channels = channels, in_channels
             ndim = len(kernel_size)
-            strides = _to_tuple(strides, ndim)
-            padding = _to_tuple(padding, ndim)
-            dilation = _to_tuple(dilation, ndim)
             self._op_name = op_name
             self._kwargs = {
-                'kernel': kernel_size, 'stride': strides, 'dilate': dilation,
-                'pad': padding, 'num_filter': channels, 'num_group': groups,
+                'kernel': kernel_size,
+                'stride': _ndtuple(strides, ndim, 'strides'),
+                'dilate': _ndtuple(dilation, ndim, 'dilation'),
+                'pad': _ndtuple(padding, ndim, 'padding'),
+                'num_filter': channels, 'num_group': groups,
                 'no_bias': not use_bias, 'layout': layout}
             if adj is not None:
                 self._kwargs['adj'] = adj
-            if op_name == 'Convolution':
-                wshape = (channels, in_channels // groups) + \
-                    tuple(kernel_size)
-            else:  # Deconvolution: (in, out/g, *k)
-                wshape = (in_channels, channels // groups) + tuple(kernel_size)
             self.weight = self.params.get(
-                'weight', shape=wshape, init=weight_initializer,
+                'weight', shape=self._weight_shape(in_channels),
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = None if not use_bias else self.params.get(
+                'bias', shape=(channels,), init=bias_initializer,
                 allow_deferred_init=True)
-            if use_bias:
-                self.bias = self.params.get(
-                    'bias', shape=(channels,), init=bias_initializer,
-                    allow_deferred_init=True)
-            else:
-                self.bias = None
-            if activation is not None:
-                self.act = Activation(activation, prefix=activation + '_')
-            else:
-                self.act = None
+            self.act = None if activation is None else \
+                Activation(activation, prefix=activation + '_')
+
+    def _weight_shape(self, in_ch):
+        g = self._kwargs['num_group']
+        kernel = tuple(self._kwargs['kernel'])
+        if self._op_name == 'Convolution':
+            return (self._channels, in_ch // g) + kernel
+        return (in_ch, self._channels // g) + kernel  # Deconvolution
 
     def infer_shape(self, x, *args):
         layout = self._kwargs.get('layout') or 'NC'
-        ch_axis = layout.find('C') if layout and 'C' in layout else 1
-        in_ch = x.shape[ch_axis]
-        groups = self._kwargs['num_group']
-        if self._op_name == 'Convolution':
-            self.weight.shape = (self._channels, in_ch // groups) + \
-                tuple(self._kwargs['kernel'])
-        else:
-            self.weight.shape = (in_ch, self._channels // groups) + \
-                tuple(self._kwargs['kernel'])
+        ch_axis = layout.find('C') if 'C' in layout else 1
+        self.weight.shape = self._weight_shape(x.shape[ch_axis])
 
     def hybrid_forward(self, F, x, weight, bias=None):
         op = getattr(F, self._op_name)
         if bias is None:
-            act = op(x, weight, name='fwd', **self._kwargs)
+            out = op(x, weight, name='fwd', **self._kwargs)
         else:
-            act = op(x, weight, bias, name='fwd', **self._kwargs)
-        if self.act is not None:
-            act = self.act(act)
-        return act
+            out = op(x, weight, bias, name='fwd', **self._kwargs)
+        return out if self.act is None else self.act(out)
 
     def _alias(self):
         return 'conv'
 
     def __repr__(self):
-        s = '{name}({mapping}, kernel_size={kernel}, stride={stride}'
-        len_kernel_size = len(self._kwargs['kernel'])
-        if self._kwargs['pad'] != (0,) * len_kernel_size:
-            s += ', padding={pad}'
-        if self._kwargs['dilate'] != (1,) * len_kernel_size:
-            s += ', dilation={dilate}'
-        if hasattr(self, 'out_pad') and self.out_pad != (0,) * len_kernel_size:
-            s += ', output_padding={out_pad}'.format(out_pad=self.out_pad)
-        if self._kwargs['num_group'] != 1:
-            s += ', groups={num_group}'
+        kw = self._kwargs
+        ndim = len(kw['kernel'])
+        parts = ['kernel_size=%s' % (kw['kernel'],),
+                 'stride=%s' % (kw['stride'],)]
+        if kw['pad'] != (0,) * ndim:
+            parts.append('padding=%s' % (kw['pad'],))
+        if kw['dilate'] != (1,) * ndim:
+            parts.append('dilation=%s' % (kw['dilate'],))
+        out_pad = getattr(self, 'out_pad', None)
+        if out_pad and out_pad != (0,) * ndim:
+            parts.append('output_padding=%s' % (out_pad,))
+        if kw['num_group'] != 1:
+            parts.append('groups=%s' % kw['num_group'])
         if self.bias is None:
-            s += ', bias=False'
+            parts.append('bias=False')
         if self.act:
-            s += ', {}'.format(self.act)
-        s += ')'
-        shape = self.weight.shape
-        return s.format(name=self.__class__.__name__,
-                        mapping='{0} -> {1}'.format(shape[1] if shape[1] else None,
-                                                    shape[0]),
-                        **self._kwargs)
+            parts.append(str(self.act))
+        fan_in, fan_out = self.weight.shape[1], self.weight.shape[0]
+        return '%s(%s -> %s, %s)' % (
+            type(self).__name__, fan_in if fan_in else None, fan_out,
+            ', '.join(parts))
+
+
+def _make_conv(ndim):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout=_LAYOUTS[ndim][0],
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer='zeros', in_channels=0, **kwargs):
+        _check_layout(layout, ndim)
+        _Conv.__init__(
+            self, channels, _ndtuple(kernel_size, ndim, 'kernel_size'),
+            strides, padding, dilation, groups, layout, in_channels,
+            activation, use_bias, weight_initializer, bias_initializer,
+            **kwargs)
+    return __init__
+
+
+def _make_deconv(ndim):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1,
+                 layout=_LAYOUTS[ndim][0], activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer='zeros',
+                 in_channels=0, **kwargs):
+        _check_layout(layout, ndim)
+        adj = _ndtuple(output_padding, ndim, 'output_padding')
+        _Conv.__init__(
+            self, channels, _ndtuple(kernel_size, ndim, 'kernel_size'),
+            strides, padding, dilation, groups, layout, in_channels,
+            activation, use_bias, weight_initializer, bias_initializer,
+            op_name='Deconvolution', adj=adj, **kwargs)
+        self.outpad = self.out_pad = adj
+    return __init__
 
 
 class Conv1D(_Conv):
-    """1D convolution (reference: conv_layers.py:165)."""
-
-    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
-                 groups=1, layout='NCW', activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer='zeros',
-                 in_channels=0, **kwargs):
-        assert layout == 'NCW', "Only supports 'NCW' layout for now"
-        if isinstance(kernel_size, (int, onp.integer)):
-            kernel_size = (kernel_size,)
-        assert len(kernel_size) == 1, 'kernel_size must be a number or a list of 1 ints'
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
+    """1D convolution over NCW (reference: conv_layers.py:165)."""
+    __init__ = _make_conv(1)
 
 
 class Conv2D(_Conv):
-    """2D convolution (reference: conv_layers.py Conv2D)."""
-
-    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), groups=1, layout='NCHW', activation=None,
-                 use_bias=True, weight_initializer=None,
-                 bias_initializer='zeros', in_channels=0, **kwargs):
-        assert layout in ('NCHW', 'NHWC'), "Only supports 'NCHW' and 'NHWC' layout for now"
-        if isinstance(kernel_size, (int, onp.integer)):
-            kernel_size = (kernel_size,) * 2
-        assert len(kernel_size) == 2, 'kernel_size must be a number or a list of 2 ints'
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
+    """2D convolution over NCHW (reference: conv_layers.py Conv2D)."""
+    __init__ = _make_conv(2)
 
 
 class Conv3D(_Conv):
-    """3D convolution (reference: conv_layers.py Conv3D)."""
-
-    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
-                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
-                 layout='NCDHW', activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer='zeros',
-                 in_channels=0, **kwargs):
-        assert layout in ('NCDHW', 'NDHWC'), "Only supports 'NCDHW' and 'NDHWC' layout for now"
-        if isinstance(kernel_size, (int, onp.integer)):
-            kernel_size = (kernel_size,) * 3
-        assert len(kernel_size) == 3, 'kernel_size must be a number or a list of 3 ints'
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer, **kwargs)
+    """3D convolution over NCDHW (reference: conv_layers.py Conv3D)."""
+    __init__ = _make_conv(3)
 
 
 class Conv1DTranspose(_Conv):
     """1D transposed convolution (reference: conv_layers.py)."""
-
-    def __init__(self, channels, kernel_size, strides=1, padding=0,
-                 output_padding=0, dilation=1, groups=1, layout='NCW',
-                 activation=None, use_bias=True, weight_initializer=None,
-                 bias_initializer='zeros', in_channels=0, **kwargs):
-        assert layout == 'NCW', "Only supports 'NCW' layout for now"
-        if isinstance(kernel_size, (int, onp.integer)):
-            kernel_size = (kernel_size,)
-        if isinstance(output_padding, (int, onp.integer)):
-            output_padding = (output_padding,)
-        assert len(kernel_size) == 1, 'kernel_size must be a number or a list of 1 ints'
-        assert len(output_padding) == 1, 'output_padding must be a number or a list of 1 ints'
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer,
-                         op_name='Deconvolution', adj=output_padding, **kwargs)
-        self.outpad = output_padding
+    __init__ = _make_deconv(1)
 
 
 class Conv2DTranspose(_Conv):
     """2D transposed convolution (reference: conv_layers.py)."""
-
-    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
-                 output_padding=(0, 0), dilation=(1, 1), groups=1,
-                 layout='NCHW', activation=None, use_bias=True,
-                 weight_initializer=None, bias_initializer='zeros',
-                 in_channels=0, **kwargs):
-        assert layout in ('NCHW', 'NHWC'), "Only supports 'NCHW' and 'NHWC' layout for now"
-        if isinstance(kernel_size, (int, onp.integer)):
-            kernel_size = (kernel_size,) * 2
-        if isinstance(output_padding, (int, onp.integer)):
-            output_padding = (output_padding,) * 2
-        assert len(kernel_size) == 2, 'kernel_size must be a number or a list of 2 ints'
-        assert len(output_padding) == 2, 'output_padding must be a number or a list of 2 ints'
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer,
-                         op_name='Deconvolution', adj=output_padding, **kwargs)
-        self.outpad = output_padding
+    __init__ = _make_deconv(2)
 
 
 class Conv3DTranspose(_Conv):
     """3D transposed convolution (reference: conv_layers.py)."""
-
-    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
-                 padding=(0, 0, 0), output_padding=(0, 0, 0),
-                 dilation=(1, 1, 1), groups=1, layout='NCDHW',
-                 activation=None, use_bias=True, weight_initializer=None,
-                 bias_initializer='zeros', in_channels=0, **kwargs):
-        assert layout in ('NCDHW', 'NDHWC'), "Only supports 'NCDHW' and 'NDHWC' layout for now"
-        if isinstance(kernel_size, (int, onp.integer)):
-            kernel_size = (kernel_size,) * 3
-        if isinstance(output_padding, (int, onp.integer)):
-            output_padding = (output_padding,) * 3
-        assert len(kernel_size) == 3, 'kernel_size must be a number or a list of 3 ints'
-        assert len(output_padding) == 3, 'output_padding must be a number or a list of 3 ints'
-        super().__init__(channels, kernel_size, strides, padding, dilation,
-                         groups, layout, in_channels, activation, use_bias,
-                         weight_initializer, bias_initializer,
-                         op_name='Deconvolution', adj=output_padding, **kwargs)
-        self.outpad = output_padding
+    __init__ = _make_deconv(3)
 
 
 class _Pooling(HybridBlock):
-    """Abstract pooling layer (reference: conv_layers.py _Pooling)."""
+    """Shared pooling machinery (reference: conv_layers.py _Pooling)."""
 
-    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, layout, count_include_pad=None, **kwargs):
+    def __init__(self, pool_size, strides, padding, ceil_mode,
+                 global_pool, pool_type, layout, count_include_pad=None,
+                 **kwargs):
         super().__init__(**kwargs)
-        if strides is None:
-            strides = pool_size
-        if isinstance(strides, (int, onp.integer)):
-            strides = (strides,) * len(pool_size)
-        if isinstance(padding, (int, onp.integer)):
-            padding = (padding,) * len(pool_size)
+        ndim = len(pool_size)
+        strides = pool_size if strides is None \
+            else _ndtuple(strides, ndim, 'strides')
         self._kwargs = {
-            'kernel': pool_size, 'stride': strides, 'pad': padding,
+            'kernel': pool_size, 'stride': strides,
+            'pad': _ndtuple(padding, ndim, 'padding'),
             'global_pool': global_pool, 'pool_type': pool_type,
             'pooling_convention': 'full' if ceil_mode else 'valid',
             'layout': layout}
@@ -257,126 +212,138 @@ class _Pooling(HybridBlock):
         return F.Pooling(x, name='fwd', **self._kwargs)
 
     def __repr__(self):
-        s = '{name}(size={kernel}, stride={stride}, padding={pad}, ceil_mode={ceil_mode}'
-        s += ', global_pool={global_pool}, pool_type={pool_type}, layout={layout})'
-        return s.format(name=self.__class__.__name__,
-                        ceil_mode=self._kwargs['pooling_convention'] == 'full',
-                        **self._kwargs)
+        kw = self._kwargs
+        return ('%s(size=%s, stride=%s, padding=%s, ceil_mode=%s, '
+                'global_pool=%s, pool_type=%s, layout=%s)') % (
+            type(self).__name__, kw['kernel'], kw['stride'], kw['pad'],
+            kw['pooling_convention'] == 'full', kw['global_pool'],
+            kw['pool_type'], kw['layout'])
 
+
+def _pool_init(self, ndim, pool_type, pool_size, strides, padding,
+               ceil_mode, layout, count_include_pad=None, **kwargs):
+    _check_layout(layout, ndim)
+    if pool_type != 'avg' and count_include_pad is not None:
+        raise TypeError('count_include_pad is only valid for average '
+                        'pooling')
+    _Pooling.__init__(
+        self, _ndtuple(pool_size, ndim, 'pool_size'), strides, padding,
+        ceil_mode, False, pool_type, layout, count_include_pad, **kwargs)
+
+
+def _make_global_pool(ndim, pool_type):
+    def __init__(self, layout=_LAYOUTS[ndim][0], **kwargs):
+        _check_layout(layout, ndim)
+        _Pooling.__init__(self, (1,) * ndim, None, 0, True, True,
+                          pool_type, layout, **kwargs)
+    return __init__
+
+
+# positional orders below mirror the reference signatures exactly
+# (note 3D max and 2D/3D avg take ceil_mode BEFORE layout, and only the
+# avg flavours accept count_include_pad)
 
 class MaxPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout='NCW',
-                 ceil_mode=False, **kwargs):
-        assert layout == 'NCW', "Only supports 'NCW' layout for now"
-        if isinstance(pool_size, (int, onp.integer)):
-            pool_size = (pool_size,)
-        assert len(pool_size) == 1, 'pool_size must be a number or a list of 1 ints'
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         'max', layout, **kwargs)
+    """Max pooling over NCW (reference: conv_layers.py MaxPool1D)."""
+
+    def __init__(self, pool_size=2, strides=None, padding=0,
+                 layout='NCW', ceil_mode=False, **kwargs):
+        _pool_init(self, 1, 'max', pool_size, strides, padding,
+                   ceil_mode, layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
+    """Max pooling over NCHW (reference: conv_layers.py MaxPool2D)."""
+
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout='NCHW', ceil_mode=False, **kwargs):
-        assert layout in ('NCHW', 'NHWC'), "Only supports 'NCHW' and 'NHWC' layout for now"
-        if isinstance(pool_size, (int, onp.integer)):
-            pool_size = (pool_size,) * 2
-        assert len(pool_size) == 2, 'pool_size must be a number or a list of 2 ints'
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         'max', layout, **kwargs)
+        _pool_init(self, 2, 'max', pool_size, strides, padding,
+                   ceil_mode, layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
+    """Max pooling over NCDHW (reference: conv_layers.py MaxPool3D)."""
+
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  ceil_mode=False, layout='NCDHW', **kwargs):
-        assert layout in ('NCDHW', 'NDHWC'), "Only supports 'NCDHW' and 'NDHWC' layout for now"
-        if isinstance(pool_size, (int, onp.integer)):
-            pool_size = (pool_size,) * 3
-        assert len(pool_size) == 3, 'pool_size must be a number or a list of 3 ints'
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         'max', layout, **kwargs)
+        _pool_init(self, 3, 'max', pool_size, strides, padding,
+                   ceil_mode, layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout='NCW',
-                 ceil_mode=False, count_include_pad=True, **kwargs):
-        assert layout == 'NCW', "Only supports 'NCW' layout for now"
-        if isinstance(pool_size, (int, onp.integer)):
-            pool_size = (pool_size,)
-        assert len(pool_size) == 1, 'pool_size must be a number or a list of 1 ints'
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         'avg', layout, count_include_pad, **kwargs)
+    """Average pooling over NCW (reference: conv_layers.py
+    AvgPool1D)."""
+
+    def __init__(self, pool_size=2, strides=None, padding=0,
+                 layout='NCW', ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        _pool_init(self, 1, 'avg', pool_size, strides, padding,
+                   ceil_mode, layout, count_include_pad, **kwargs)
 
 
 class AvgPool2D(_Pooling):
+    """Average pooling over NCHW (reference: conv_layers.py
+    AvgPool2D)."""
+
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  ceil_mode=False, layout='NCHW', count_include_pad=True,
                  **kwargs):
-        assert layout in ('NCHW', 'NHWC'), "Only supports 'NCHW' and 'NHWC' layout for now"
-        if isinstance(pool_size, (int, onp.integer)):
-            pool_size = (pool_size,) * 2
-        assert len(pool_size) == 2, 'pool_size must be a number or a list of 2 ints'
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         'avg', layout, count_include_pad, **kwargs)
+        _pool_init(self, 2, 'avg', pool_size, strides, padding,
+                   ceil_mode, layout, count_include_pad, **kwargs)
 
 
 class AvgPool3D(_Pooling):
+    """Average pooling over NCDHW (reference: conv_layers.py
+    AvgPool3D)."""
+
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  ceil_mode=False, layout='NCDHW', count_include_pad=True,
                  **kwargs):
-        assert layout in ('NCDHW', 'NDHWC'), "Only supports 'NCDHW' and 'NDHWC' layout for now"
-        if isinstance(pool_size, (int, onp.integer)):
-            pool_size = (pool_size,) * 3
-        assert len(pool_size) == 3, 'pool_size must be a number or a list of 3 ints'
-        super().__init__(pool_size, strides, padding, ceil_mode, False,
-                         'avg', layout, count_include_pad, **kwargs)
+        _pool_init(self, 3, 'avg', pool_size, strides, padding,
+                   ceil_mode, layout, count_include_pad, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
-    def __init__(self, layout='NCW', **kwargs):
-        assert layout == 'NCW', "Only supports 'NCW' layout for now"
-        super().__init__((1,), None, 0, True, True, 'max', layout, **kwargs)
+    """Global max pooling (reference: conv_layers.py)."""
+    __init__ = _make_global_pool(1, 'max')
 
 
 class GlobalMaxPool2D(_Pooling):
-    def __init__(self, layout='NCHW', **kwargs):
-        assert layout in ('NCHW', 'NHWC'), "Only supports 'NCHW' and 'NHWC' layout for now"
-        super().__init__((1, 1), None, 0, True, True, 'max', layout, **kwargs)
+    """Global max pooling (reference: conv_layers.py)."""
+    __init__ = _make_global_pool(2, 'max')
 
 
 class GlobalMaxPool3D(_Pooling):
-    def __init__(self, layout='NCDHW', **kwargs):
-        assert layout in ('NCDHW', 'NDHWC'), "Only supports 'NCDHW' and 'NDHWC' layout for now"
-        super().__init__((1, 1, 1), None, 0, True, True, 'max', layout, **kwargs)
+    """Global max pooling (reference: conv_layers.py)."""
+    __init__ = _make_global_pool(3, 'max')
 
 
 class GlobalAvgPool1D(_Pooling):
-    def __init__(self, layout='NCW', **kwargs):
-        assert layout == 'NCW', "Only supports 'NCW' layout for now"
-        super().__init__((1,), None, 0, True, True, 'avg', layout, **kwargs)
+    """Global average pooling (reference: conv_layers.py)."""
+    __init__ = _make_global_pool(1, 'avg')
 
 
 class GlobalAvgPool2D(_Pooling):
-    def __init__(self, layout='NCHW', **kwargs):
-        assert layout in ('NCHW', 'NHWC'), "Only supports 'NCHW' and 'NHWC' layout for now"
-        super().__init__((1, 1), None, 0, True, True, 'avg', layout, **kwargs)
+    """Global average pooling (reference: conv_layers.py)."""
+    __init__ = _make_global_pool(2, 'avg')
 
 
 class GlobalAvgPool3D(_Pooling):
-    def __init__(self, layout='NCDHW', **kwargs):
-        assert layout in ('NCDHW', 'NDHWC'), "Only supports 'NCDHW' and 'NDHWC' layout for now"
-        super().__init__((1, 1, 1), None, 0, True, True, 'avg', layout, **kwargs)
+    """Global average pooling (reference: conv_layers.py)."""
+    __init__ = _make_global_pool(3, 'avg')
 
 
 class ReflectionPad2D(HybridBlock):
-    """Reflection padding (reference: conv_layers.py ReflectionPad2D)."""
+    """Reflection padding (reference: conv_layers.py
+    ReflectionPad2D)."""
 
     def __init__(self, padding=0, **kwargs):
         super().__init__(**kwargs)
         if isinstance(padding, (int, onp.integer)):
-            padding = (0, 0, 0, 0, padding, padding, padding, padding)
-        assert len(padding) == 8
-        self._padding = padding
+            padding = (0, 0, 0, 0) + (padding,) * 4
+        if len(padding) != 8:
+            raise AssertionError('padding must be an int or an 8-tuple')
+        self._padding = tuple(padding)
 
     def hybrid_forward(self, F, x):
         return F.Pad(x, mode='reflect', pad_width=self._padding)

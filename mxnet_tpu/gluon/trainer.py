@@ -22,62 +22,61 @@ __all__ = ['Trainer']
 class Trainer:
     """Applies an Optimizer on a set of Parameters."""
 
-    def __init__(self, params, optimizer, optimizer_params=None, kvstore='device',
-                 compression_params=None, update_on_kvstore=None):
-        param_list = []
+    @staticmethod
+    def _flatten_params(params):
         if isinstance(params, (dict, ParameterDict)):
-            for key in sorted(list(params.keys())):
-                param_list.append(params[key])
-            params = param_list
+            params = [params[k] for k in sorted(params.keys())]
         if not isinstance(params, (list, tuple)):
             raise ValueError(
                 'First argument must be a list or dict of Parameters, '
                 'got %s.' % (type(params)))
-        self._params = []
-        self._param2idx = {}
-        for i, param in enumerate(params):
-            if not isinstance(param, Parameter):
+        for p in params:
+            if not isinstance(p, Parameter):
                 raise ValueError(
                     'First argument must be a list or dict of Parameters, '
-                    'got list of %s.' % (type(param)))
-            self._param2idx[param.name] = i
-            self._params.append(param)
-            param._set_trainer(self) if hasattr(param, '_set_trainer') else None
+                    'got list of %s.' % (type(p)))
+        return list(params)
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore='device', compression_params=None,
+                 update_on_kvstore=None):
+        self._params = self._flatten_params(params)
+        self._param2idx = {p.name: i
+                           for i, p in enumerate(self._params)}
+        for p in self._params:
+            if hasattr(p, '_set_trainer'):
+                p._set_trainer(self)
         self._compression_params = compression_params
-        optimizer_params = optimizer_params if optimizer_params else {}
+        optimizer_params = dict(optimizer_params or {})
         self._scale = float(optimizer_params.get('rescale_grad', 1.0))
-        self._contains_sparse_weight = False
-        self._contains_sparse_grad = False
+        self._contains_sparse_weight = self._contains_sparse_grad = False
         self._init_optimizer(optimizer, optimizer_params)
         self._kvstore_params = {'kvstore': kvstore,
                                 'update_on_kvstore': update_on_kvstore}
-        self._kv_initialized = False
-        self._kvstore = None
-        self._update_on_kvstore = None
-        self._distributed = None
-        self._params_to_init = []
         self._fused = None  # FusedUpdater once built; False disables
         self._reset_kvstore()
 
+    def _index_table(self):
+        return dict(enumerate(self._params))
+
     def _init_optimizer(self, optimizer, optimizer_params):
-        param_dict = {i: param for i, param in enumerate(self._params)}
         if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                'optimizer_params must be None if optimizer is an Optimizer ' \
-                'instance'
+            if optimizer_params:
+                raise AssertionError(
+                    'optimizer_params must be None if optimizer is an '
+                    'Optimizer instance')
             self._optimizer = optimizer
-            self._optimizer.param_dict = param_dict
+            self._optimizer.param_dict = self._index_table()
         else:
-            self._optimizer = opt.create(optimizer, param_dict=param_dict,
-                                         **optimizer_params)
+            self._optimizer = opt.create(
+                optimizer, param_dict=self._index_table(),
+                **optimizer_params)
         self._updaters = [opt.get_updater(self._optimizer)]
 
     def _reset_kvstore(self):
         self._kv_initialized = False
-        self._kvstore = None
-        self._distributed = None
-        self._update_on_kvstore = None
-        self._params_to_init = [param for param in self._params]
+        self._kvstore = self._distributed = self._update_on_kvstore = None
+        self._params_to_init = list(self._params)
 
     def _init_kvstore(self):
         """Create the kvstore (reference: trainer.py:169). On TPU every
@@ -136,13 +135,15 @@ class Trainer:
         """Sparse parity shim (dense storage)."""
         parameter.data().copyto(out)
 
+    def _ensure_kv(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update step: rescale by 1/batch_size,
         allreduce (dist), apply optimizer (reference: trainer.py:298)."""
-        rescale_grad = self._scale / batch_size
-        self._check_and_rescale_grad(rescale_grad)
-        if not self._kv_initialized:
-            self._init_kvstore()
+        self._check_and_rescale_grad(self._scale / batch_size)
+        self._ensure_kv()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 
@@ -156,14 +157,17 @@ class Trainer:
                                   'update_on_kvstore=True')
         self._optimizer.rescale_grad = scale
 
+    def _forbid_update_on_kvstore(self, what):
+        if self._kvstore and self._update_on_kvstore:
+            raise AssertionError(
+                '%s when parameters are updated on kvstore is not '
+                'supported. Try setting `update_on_kvstore` to False '
+                'when creating trainer.' % what)
+
     def allreduce_grads(self):
         """Reduce gradients over workers/devices without updating."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        assert not (self._kvstore and self._update_on_kvstore), \
-            'allreduce_grads() when parameters are updated on kvstore ' \
-            'is not supported. Try setting `update_on_kvstore` ' \
-            'to False when creating trainer.'
+        self._ensure_kv()
+        self._forbid_update_on_kvstore('allreduce_grads()')
         self._allreduce_grads()
 
     def _allreduce_grads(self):
@@ -188,12 +192,8 @@ class Trainer:
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Apply optimizer only (gradients must already be reduced)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        assert not (self._kvstore and self._update_on_kvstore), \
-            'update() when parameters are updated on kvstore ' \
-            'is not supported. Try setting `update_on_kvstore` ' \
-            'to False when creating trainer.'
+        self._ensure_kv()
+        self._forbid_update_on_kvstore('update()')
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
@@ -256,24 +256,23 @@ class Trainer:
     def save_states(self, fname):
         """Save trainer (optimizer/updater) states
         (reference: trainer.py save_states)."""
-        assert self._optimizer is not None
-        if not self._kv_initialized:
-            self._init_kvstore()
+        if self._optimizer is None:
+            raise AssertionError('no optimizer to save')
+        self._ensure_kv()
+        payload = self._updaters[0].get_states(dump_optimizer=True)
         with open(fname, 'wb') as fout:
-            fout.write(self._updaters[0].get_states(dump_optimizer=True))
+            fout.write(payload)
 
     def load_states(self, fname):
         """Load trainer states."""
-        if not self._kv_initialized:
-            self._init_kvstore()
+        self._ensure_kv()
         with open(fname, 'rb') as f:
-            states = f.read()
+            payload = f.read()
         for updater in self._updaters:
-            updater.set_states(states)
+            updater.set_states(payload)
             updater.optimizer = self._updaters[0].optimizer
         self._optimizer = self._updaters[0].optimizer
-        param_dict = {i: param for i, param in enumerate(self._params)}
-        self._optimizer.param_dict = param_dict
+        self._optimizer.param_dict = self._index_table()
         # the fused program is bound to the replaced optimizer/updater
         # objects — rebuild it against the loaded ones (but keep an
         # explicit user opt-out: _fused=False stays False)

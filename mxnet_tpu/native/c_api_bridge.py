@@ -1,0 +1,132 @@
+"""Python side of the core C API (reference: include/mxnet/c_api.h —
+the MXNDArray*/MXSymbol*/MXKVStore*/profiler families; implementation
+src/c_api/c_api.cc).
+
+The native library (native/src/c_api.cc) embeds CPython and calls the
+helpers here; handles passed over the C ABI are PyObject pointers to
+the objects these helpers return. Keeping the marshalling in Python
+keeps the C layer to pure ABI plumbing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# MXNet dtype codes (reference: include/mxnet/c_api.h / base dtype enum)
+_DTYPE_BY_CODE = {0: 'float32', 1: 'float64', 2: 'float16', 3: 'uint8',
+                  4: 'int32', 5: 'int8', 6: 'int64'}
+_CODE_BY_DTYPE = {v: k for k, v in _DTYPE_BY_CODE.items()}
+
+
+def _ctx(dev_type, dev_id):
+    from .. import context
+    name = context.Context.devtype2str.get(int(dev_type), 'cpu')
+    return context.Context(name, int(dev_id))
+
+
+# -- NDArray ---------------------------------------------------------------
+
+def ndarray_create(shape, dev_type, dev_id, dtype_code):
+    from .. import nd
+    return nd.zeros(tuple(int(s) for s in shape),
+                    ctx=_ctx(dev_type, dev_id),
+                    dtype=_DTYPE_BY_CODE[int(dtype_code)])
+
+
+def ndarray_shape(arr):
+    return [int(s) for s in arr.shape]
+
+
+def ndarray_dtype_code(arr):
+    return _CODE_BY_DTYPE[np.dtype(arr.dtype).name]
+
+
+def ndarray_copy_from(arr, buf):
+    """buf: bytes of exactly arr.size elements in arr dtype."""
+    src = np.frombuffer(buf, dtype=arr.dtype).reshape(arr.shape)
+    arr[:] = src
+    arr.wait_to_read()
+
+
+def ndarray_copy_to(arr):
+    """Returns the array's bytes (C side memcpys into caller buffer)."""
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def ndarray_waitall():
+    from .. import nd
+    nd.waitall()
+
+
+def ndarray_save(fname, arrays, keys):
+    from .. import nd
+    if keys:
+        nd.save(fname, dict(zip(keys, arrays)))
+    else:
+        nd.save(fname, list(arrays))
+
+
+def ndarray_load(fname):
+    """Returns (list_of_arrays, list_of_names) — names empty for
+    list-style files."""
+    from .. import nd
+    loaded = nd.load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return [loaded[k] for k in names], names
+    return list(loaded), []
+
+
+# -- Symbol ----------------------------------------------------------------
+
+def symbol_from_json(json_str):
+    from .. import symbol
+    return symbol.load_json(json_str)
+
+
+def symbol_to_json(sym):
+    return sym.tojson()
+
+
+def symbol_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def symbol_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def symbol_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+# -- KVStore ---------------------------------------------------------------
+
+def kvstore_create(kv_type):
+    from .. import kvstore
+    return kvstore.create(kv_type)
+
+
+def kvstore_init(kv, keys, arrays):
+    kv.init(list(keys), list(arrays))
+
+
+def kvstore_push(kv, keys, arrays):
+    kv.push(list(keys), list(arrays))
+
+
+def kvstore_pull(kv, keys, arrays):
+    kv.pull(list(keys), out=list(arrays))
+    for a in arrays:
+        a.wait_to_read()
+
+
+# -- Profiler --------------------------------------------------------------
+
+def profiler_set_state(state_code):
+    from .. import profiler
+    profiler.set_state('run' if int(state_code) else 'stop')
+
+
+def profiler_dumps(reset):
+    from .. import profiler
+    return profiler.dumps(reset=bool(reset))

@@ -1,0 +1,85 @@
+"""Build + ctypes binding for the core C API library (reference ABI:
+include/mxnet/c_api.h — MXNDArray*/MXSymbol*/MXKVStore*/profiler
+families; implementation native/src/c_api.cc). Same embed-CPython
+pattern as the predict ABI: ``lib()`` compiles on first use and the
+.so serves both standalone C hosts and in-process ctypes callers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from ._build_util import load_library
+
+__all__ = ['available', 'lib']
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), 'native', 'src',
+    'c_api.cc')
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          '_build')
+_SO = os.path.join(_BUILD_DIR, 'libmxcapi.so')
+_ABI = 2
+
+
+def _bind(path):
+    so = ctypes.CDLL(path)
+    so.mxcapi_abi_version.restype = ctypes.c_int
+    if so.mxcapi_abi_version() != _ABI:
+        raise OSError('stale libmxcapi ABI')
+    c_int, c_uint = ctypes.c_int, ctypes.c_uint
+    vp, cp = ctypes.c_void_p, ctypes.c_char_p
+    u_p = ctypes.POINTER(c_uint)
+    so.MXGetLastError.restype = cp
+    so.MXGetVersion.argtypes = [ctypes.POINTER(c_int)]
+    so.MXNDArrayCreateEx.argtypes = [
+        u_p, c_uint, c_int, c_int, c_int, c_int, ctypes.POINTER(vp)]
+    so.MXNDArrayFree.argtypes = [vp]
+    so.MXNDArrayGetShape.argtypes = [vp, u_p, ctypes.POINTER(u_p)]
+    so.MXNDArrayGetDType.argtypes = [vp, ctypes.POINTER(c_int)]
+    so.MXNDArraySyncCopyFromCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    so.MXNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    so.MXNDArraySave.argtypes = [cp, c_uint, ctypes.POINTER(vp),
+                                 ctypes.POINTER(cp)]
+    so.MXNDArrayLoad.argtypes = [cp, u_p, ctypes.POINTER(
+        ctypes.POINTER(vp)), u_p, ctypes.POINTER(ctypes.POINTER(cp))]
+    so.MXSymbolCreateFromJSON.argtypes = [cp, ctypes.POINTER(vp)]
+    so.MXSymbolSaveToJSON.argtypes = [vp, ctypes.POINTER(cp)]
+    for fn in (so.MXSymbolListArguments, so.MXSymbolListOutputs,
+               so.MXSymbolListAuxiliaryStates):
+        fn.argtypes = [vp, u_p, ctypes.POINTER(ctypes.POINTER(cp))]
+    so.MXSymbolFree.argtypes = [vp]
+    so.MXKVStoreCreate.argtypes = [cp, ctypes.POINTER(vp)]
+    so.MXKVStoreFree.argtypes = [vp]
+    for fn in (so.MXKVStoreInit,):
+        fn.argtypes = [vp, c_uint, ctypes.POINTER(c_int),
+                       ctypes.POINTER(vp)]
+    for fn in (so.MXKVStorePush, so.MXKVStorePull):
+        fn.argtypes = [vp, c_uint, ctypes.POINTER(c_int),
+                       ctypes.POINTER(vp), c_int]
+    so.MXSetProfilerState.argtypes = [c_int]
+    so.MXAggregateProfileStatsPrint.argtypes = [ctypes.POINTER(cp),
+                                                c_int]
+    return so
+
+
+def lib():
+    """The bound library, (re)compiling when missing or stale; None
+    (with a warning) when the toolchain is unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        _lib = load_library(_SRC, _SO, _bind, link_python=True,
+                            name='libmxcapi')
+        return _lib
+
+
+def available():
+    return lib() is not None

@@ -8,11 +8,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import sysconfig
 import threading
 
 import numpy as np
+
+from ._build_util import load_library
 
 __all__ = ['available', 'lib', 'Predictor']
 
@@ -27,21 +27,6 @@ _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           '_build')
 _SO = os.path.join(_BUILD_DIR, 'libmxpred.so')
 _ABI = 1
-
-
-def _compile():
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    inc = sysconfig.get_path('include')
-    libdir = sysconfig.get_config_var('LIBDIR') or ''
-    pyver = 'python%d.%d' % __import__('sys').version_info[:2]
-    tmp = '%s.tmp.%d' % (_SO, os.getpid())
-    cmd = ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', '-pthread',
-           '-I' + inc, _SRC, '-o', tmp]
-    if libdir:
-        cmd += ['-L' + libdir, '-Wl,-rpath,' + libdir]
-    cmd += ['-l' + pyver]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=180)
-    os.replace(tmp, _SO)
 
 
 def _bind(path):
@@ -82,22 +67,8 @@ def lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        try:
-            if not os.path.exists(_SO) or \
-                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-                _compile()
-            _lib = _bind(_SO)
-        except Exception as e:
-            import warnings
-            detail = ''
-            stderr = getattr(e, 'stderr', None)
-            if stderr:
-                detail = ': ' + (stderr.decode(errors='replace')
-                                 if isinstance(stderr, bytes) else
-                                 str(stderr))[-500:]
-            warnings.warn('native predict library unavailable (%s%s)'
-                          % (e, detail))
-            _lib = None
+        _lib = load_library(_SRC, _SO, _bind, link_python=True,
+                            name='libmxpred')
     return _lib
 
 

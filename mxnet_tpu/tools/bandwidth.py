@@ -1,0 +1,160 @@
+"""Communication bandwidth probe.
+
+Reference analog: tools/bandwidth/measure.py, which measures per-
+kvstore-type push/pull bandwidth across devices. The TPU-native
+equivalent measures the XLA collectives that actually carry gradient
+traffic on a device mesh (psum / all_gather / reduce_scatter /
+ppermute over ICI or, on the test rig, the virtual host mesh), plus
+the same kvstore push+pull drill the reference runs.
+
+CLI:  python -m mxnet_tpu.tools.bandwidth [--sizes-mb 1,16] [--iters 10]
+Import: ``measure_collectives(...)`` / ``measure_kvstore(...)`` return
+row dicts; nothing here requires more than one physical chip — on a
+single-device mesh the collectives compile to (near) no-ops and the
+probe reports that honestly.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as onp
+
+__all__ = ['measure_collectives', 'measure_kvstore']
+
+
+def _bus_factor(collective, n):
+    """Bytes actually crossing links per byte of payload (standard
+    ring-algorithm accounting, the same convention nccl-tests uses)."""
+    if n <= 1:
+        return 0.0
+    if collective == 'psum':            # allreduce: 2(n-1)/n
+        return 2.0 * (n - 1) / n
+    if collective in ('all_gather', 'reduce_scatter'):
+        return (n - 1) / n
+    return 1.0                          # ppermute: every byte moves once
+
+
+def measure_collectives(devices=None, sizes=(1 << 20, 1 << 24),
+                        iters=10, collectives=('psum', 'all_gather',
+                                               'reduce_scatter',
+                                               'ppermute')):
+    """Time each collective over a 1-D mesh of ``devices`` for each
+    payload size (bytes per device). Returns a list of row dicts."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:      # older jax
+        from jax.experimental.shard_map import shard_map
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(onp.array(devices), ('x',))
+
+    def build(collective):
+        def body(x):
+            if collective == 'psum':
+                return jax.lax.psum(x, 'x')
+            if collective == 'all_gather':
+                return jax.lax.all_gather(x, 'x', tiled=True)
+            if collective == 'reduce_scatter':
+                return jax.lax.psum_scatter(x, 'x', tiled=True)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            return jax.lax.ppermute(x, 'x', perm)
+        out_spec = {'psum': P('x'), 'all_gather': P(None),
+                    'reduce_scatter': P('x'),
+                    'ppermute': P('x')}[collective]
+        # reduce_scatter halves... shapes differ per collective; let
+        # shard_map derive them from the body
+        try:
+            sm = shard_map(body, mesh=mesh, in_specs=P('x'),
+                           out_specs=out_spec, check_vma=False)
+        except TypeError:    # older jax spells the flag check_rep
+            sm = shard_map(body, mesh=mesh, in_specs=P('x'),
+                           out_specs=out_spec, check_rep=False)
+        return jax.jit(sm)
+
+    rows = []
+    for collective in collectives:
+        fn = build(collective)
+        for size in sizes:
+            per_dev = max(size // 4, 4)          # f32 elements
+            x = jnp.zeros((per_dev * n,), jnp.float32)
+            x = jax.device_put(
+                x, jax.sharding.NamedSharding(mesh, P('x')))
+            out = fn(x)
+            jax.block_until_ready(out)           # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(x)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / iters
+            payload = per_dev * 4                # bytes per device
+            algo = payload / dt / 1e9
+            rows.append({
+                'collective': collective, 'devices': n,
+                'bytes_per_device': payload, 'seconds': dt,
+                'algo_gbps': algo,
+                'bus_gbps': algo * _bus_factor(collective, n)})
+    return rows
+
+
+def measure_kvstore(kv_type='device', sizes=(1 << 20,), iters=10):
+    """The reference drill: push a gradient, pull the weight, per
+    kvstore type (tools/bandwidth/measure.py)."""
+    from .. import kvstore as kv_mod
+    from .. import ndarray as nd
+
+    kv = kv_mod.create(kv_type)
+    rows = []
+    for i, size in enumerate(sizes):
+        elems = max(size // 4, 1)
+        arr = nd.zeros((elems,))
+        kv.init(i, arr)
+        grad = nd.ones((elems,))
+        out = nd.zeros((elems,))
+        kv.push(i, grad)
+        kv.pull(i, out=out)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            kv.push(i, grad)
+            kv.pull(i, out=out)
+        out.wait_to_read()
+        dt = (time.perf_counter() - t0) / iters
+        rows.append({'kvstore': kv_type, 'bytes': elems * 4,
+                     'seconds': dt,
+                     'push_pull_gbps': elems * 4 / dt / 1e9})
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('--sizes-mb', default='1,16',
+                   help='comma-separated payload sizes in MiB')
+    p.add_argument('--iters', type=int, default=10)
+    p.add_argument('--kvstore', default='device')
+    args = p.parse_args(argv)
+    sizes = [int(float(s) * (1 << 20))
+             for s in args.sizes_mb.split(',') if s]
+
+    print('%-16s %4s %14s %10s %10s %10s' %
+          ('collective', 'dev', 'bytes/dev', 'ms', 'algo GB/s',
+           'bus GB/s'))
+    for r in measure_collectives(sizes=sizes, iters=args.iters):
+        print('%-16s %4d %14d %10.3f %10.2f %10.2f' %
+              (r['collective'], r['devices'], r['bytes_per_device'],
+               r['seconds'] * 1e3, r['algo_gbps'], r['bus_gbps']))
+    for r in measure_kvstore(args.kvstore, sizes=sizes,
+                             iters=args.iters):
+        print('kvstore[%s] %d bytes: %.3f ms, push+pull %.2f GB/s' %
+              (r['kvstore'], r['bytes'], r['seconds'] * 1e3,
+               r['push_pull_gbps']))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

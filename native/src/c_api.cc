@@ -1,0 +1,480 @@
+// Core C API — the MXNDArray* / MXSymbol* / MXKVStore* / profiler
+// families (reference: include/mxnet/c_api.h, 207 functions;
+// implementation src/c_api/c_api.cc). This library exports the
+// high-traffic subset other-language bindings actually need: array
+// create/shape/dtype/copy/save/load, symbol JSON round-trip and name
+// listing, kvstore init/push/pull, profiler state + aggregate dump.
+//
+// Same embedding pattern as c_predict_api.cc: the runtime IS
+// Python/XLA, so each entry point takes the GIL and calls
+// mxnet_tpu.native.c_api_bridge; handles are PyObject pointers.
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* KVStoreHandle;
+
+int MXGetVersion(int* out);
+const char* MXGetLastError();
+int mxcapi_abi_version();
+
+int MXNDArrayCreateEx(const unsigned* shape, unsigned ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArrayGetShape(NDArrayHandle handle, unsigned* out_dim,
+                      const unsigned** out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size);
+int MXNDArrayWaitAll();
+int MXNDArraySave(const char* fname, unsigned num_args,
+                  NDArrayHandle* args, const char** keys);
+int MXNDArrayLoad(const char* fname, unsigned* out_size,
+                  NDArrayHandle** out_arr, unsigned* out_name_size,
+                  const char*** out_names);
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolSaveToJSON(SymbolHandle handle, const char** out_json);
+int MXSymbolListArguments(SymbolHandle handle, unsigned* out_size,
+                          const char*** out_array);
+int MXSymbolListOutputs(SymbolHandle handle, unsigned* out_size,
+                        const char*** out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, unsigned* out_size,
+                                const char*** out_array);
+int MXSymbolFree(SymbolHandle handle);
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, unsigned num, const int* keys,
+                  NDArrayHandle* vals);
+int MXKVStorePush(KVStoreHandle handle, unsigned num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, unsigned num, const int* keys,
+                  NDArrayHandle* vals, int priority);
+
+int MXSetProfilerState(int state);
+int MXAggregateProfileStatsPrint(const char** out_str, int reset);
+}
+
+namespace {
+
+thread_local std::string g_last_error;
+
+// per-thread backing stores for pointers returned to C callers — valid
+// until the next call that refills them on the same thread (the
+// reference uses per-thread return stores the same way, c_api.h docs)
+struct ReturnStore {
+  std::vector<unsigned> shape;
+  std::vector<std::string> strings;
+  std::vector<const char*> cstrs;
+  std::vector<void*> handles;
+  std::string text;
+};
+thread_local ReturnStore g_ret;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+void ensure_python() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+#if PY_VERSION_HEX < 0x03090000
+      PyEval_InitThreads();
+#endif
+      PyEval_SaveThread();
+    }
+  });
+}
+
+PyObject* bridge() {
+  static PyObject* mod = nullptr;
+  if (!mod) mod = PyImport_ImportModule("mxnet_tpu.native.c_api_bridge");
+  return mod;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// call bridge.<fn>(*args); returns new reference or null (error set)
+PyObject* call(const char* fn, PyObject* args) {
+  PyObject* mod = bridge();
+  if (!mod) return nullptr;
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (!f) return nullptr;
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return out;
+}
+
+int fill_strings(PyObject* list, unsigned* out_size,
+                 const char*** out_array) {
+  g_ret.strings.clear();
+  g_ret.cstrs.clear();
+  Py_ssize_t n = PyList_Size(list);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    if (!c) return -1;
+    g_ret.strings.emplace_back(c);
+  }
+  for (auto& s : g_ret.strings) g_ret.cstrs.push_back(s.c_str());
+  *out_size = static_cast<unsigned>(n);
+  *out_array = g_ret.cstrs.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mxcapi_abi_version() { return 2; }
+
+int MXGetVersion(int* out) {
+  *out = 10600;  // 1.6.0-compatible surface
+  return 0;
+}
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+// -- NDArray ---------------------------------------------------------------
+
+int MXNDArrayCreateEx(const unsigned* shape, unsigned ndim, int dev_type,
+                      int dev_id, int /*delay_alloc*/, int dtype,
+                      NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* pyshape = PyTuple_New(ndim);
+  for (unsigned i = 0; i < ndim; ++i)
+    PyTuple_SetItem(pyshape, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* args = Py_BuildValue("(Oiii)", pyshape, dev_type, dev_id,
+                                 dtype);
+  Py_DECREF(pyshape);
+  PyObject* arr = call("ndarray_create", args);
+  Py_DECREF(args);
+  if (!arr) { set_error_from_python(); return -1; }
+  *out = arr;  // ownership transferred to the handle
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (!handle) return 0;
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject*>(handle));
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, unsigned* out_dim,
+                      const unsigned** out_pdata) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* lst = call("ndarray_shape", args);
+  Py_DECREF(args);
+  if (!lst) { set_error_from_python(); return -1; }
+  g_ret.shape.clear();
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_ret.shape.push_back(static_cast<unsigned>(
+        PyLong_AsUnsignedLong(PyList_GetItem(lst, i))));
+  Py_DECREF(lst);
+  *out_dim = static_cast<unsigned>(n);
+  *out_pdata = g_ret.shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* code = call("ndarray_dtype_code", args);
+  Py_DECREF(args);
+  if (!code) { set_error_from_python(); return -1; }
+  *out_dtype = static_cast<int>(PyLong_AsLong(code));
+  Py_DECREF(code);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size) {
+  Gil gil;
+  // size is an ELEMENT count (reference semantics); bridge validates
+  PyObject* arr = reinterpret_cast<PyObject*>(handle);
+  PyObject* np_args = Py_BuildValue("(O)", arr);
+  PyObject* probe = call("ndarray_dtype_code", np_args);
+  Py_DECREF(np_args);
+  if (!probe) { set_error_from_python(); return -1; }
+  static const size_t kSize[] = {4, 8, 2, 1, 4, 1, 8};
+  long code = PyLong_AsLong(probe);
+  Py_DECREF(probe);
+  size_t nbytes = size * kSize[code];
+  PyObject* buf = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
+  PyObject* args = Py_BuildValue("(OO)", arr, buf);
+  Py_DECREF(buf);
+  PyObject* r = call("ndarray_copy_from", args);
+  Py_DECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* bytes = call("ndarray_copy_to", args);
+  Py_DECREF(args);
+  if (!bytes) { set_error_from_python(); return -1; }
+  char* src = nullptr;
+  Py_ssize_t nbytes = 0;
+  PyBytes_AsStringAndSize(bytes, &src, &nbytes);
+  // `size` is an element count and must match the array exactly
+  // (reference semantics) — never overrun the caller's buffer
+  int dtype = 0;
+  if (MXNDArrayGetDType(handle, &dtype) != 0) {
+    Py_DECREF(bytes);
+    return -1;
+  }
+  static const size_t kSize[] = {4, 8, 2, 1, 4, 1, 8};
+  size_t want = size * kSize[dtype];
+  if (want != static_cast<size_t>(nbytes)) {
+    g_last_error = "MXNDArraySyncCopyToCPU: size mismatch";
+    Py_DECREF(bytes);
+    return -1;
+  }
+  std::memcpy(data, src, nbytes);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call("ndarray_waitall", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySave(const char* fname, unsigned num_args,
+                  NDArrayHandle* args_in, const char** keys) {
+  Gil gil;
+  PyObject* arrs = PyList_New(num_args);
+  for (unsigned i = 0; i < num_args; ++i) {
+    PyObject* o = reinterpret_cast<PyObject*>(args_in[i]);
+    Py_INCREF(o);
+    PyList_SetItem(arrs, i, o);
+  }
+  PyObject* names;
+  if (keys) {
+    names = PyList_New(num_args);
+    for (unsigned i = 0; i < num_args; ++i)
+      PyList_SetItem(names, i, PyUnicode_FromString(keys[i]));
+  } else {
+    names = PyList_New(0);
+  }
+  PyObject* args = Py_BuildValue("(sOO)", fname, arrs, names);
+  Py_DECREF(arrs);
+  Py_DECREF(names);
+  PyObject* r = call("ndarray_save", args);
+  Py_DECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char* fname, unsigned* out_size,
+                  NDArrayHandle** out_arr, unsigned* out_name_size,
+                  const char*** out_names) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* pair = call("ndarray_load", args);
+  Py_DECREF(args);
+  if (!pair) { set_error_from_python(); return -1; }
+  PyObject* arrs = PyTuple_GetItem(pair, 0);
+  PyObject* names = PyTuple_GetItem(pair, 1);
+  g_ret.handles.clear();
+  Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(arrs, i);
+    Py_INCREF(o);  // handles own a reference; caller frees each
+    g_ret.handles.push_back(o);
+  }
+  *out_size = static_cast<unsigned>(n);
+  *out_arr = g_ret.handles.data();
+  if (fill_strings(names, out_name_size, out_names) != 0) {
+    set_error_from_python();
+    Py_DECREF(pair);
+    return -1;
+  }
+  Py_DECREF(pair);
+  return 0;
+}
+
+// -- Symbol ----------------------------------------------------------------
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", json);
+  PyObject* sym = call("symbol_from_json", args);
+  Py_DECREF(args);
+  if (!sym) { set_error_from_python(); return -1; }
+  *out = sym;
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle handle, const char** out_json) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* s = call("symbol_to_json", args);
+  Py_DECREF(args);
+  if (!s) { set_error_from_python(); return -1; }
+  const char* c = PyUnicode_AsUTF8(s);
+  g_ret.text = c ? c : "";
+  Py_DECREF(s);
+  *out_json = g_ret.text.c_str();
+  return 0;
+}
+
+static int list_names(SymbolHandle handle, const char* fn,
+                      unsigned* out_size, const char*** out_array) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* lst = call(fn, args);
+  Py_DECREF(args);
+  if (!lst) { set_error_from_python(); return -1; }
+  int rc = fill_strings(lst, out_size, out_array);
+  Py_DECREF(lst);
+  if (rc) set_error_from_python();
+  return rc;
+}
+
+int MXSymbolListArguments(SymbolHandle handle, unsigned* out_size,
+                          const char*** out_array) {
+  return list_names(handle, "symbol_list_arguments", out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, unsigned* out_size,
+                        const char*** out_array) {
+  return list_names(handle, "symbol_list_outputs", out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, unsigned* out_size,
+                                const char*** out_array) {
+  return list_names(handle, "symbol_list_aux", out_size, out_array);
+}
+
+int MXSymbolFree(SymbolHandle handle) { return MXNDArrayFree(handle); }
+
+// -- KVStore ---------------------------------------------------------------
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", type);
+  PyObject* kv = call("kvstore_create", args);
+  Py_DECREF(args);
+  if (!kv) { set_error_from_python(); return -1; }
+  *out = kv;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) { return MXNDArrayFree(handle); }
+
+static int kv_op(const char* fn, KVStoreHandle handle, unsigned num,
+                 const int* keys, NDArrayHandle* vals) {
+  Gil gil;
+  PyObject* pykeys = PyList_New(num);
+  PyObject* pyvals = PyList_New(num);
+  for (unsigned i = 0; i < num; ++i) {
+    PyList_SetItem(pykeys, i, PyLong_FromLong(keys[i]));
+    PyObject* o = reinterpret_cast<PyObject*>(vals[i]);
+    Py_INCREF(o);
+    PyList_SetItem(pyvals, i, o);
+  }
+  PyObject* args = Py_BuildValue(
+      "(OOO)", reinterpret_cast<PyObject*>(handle), pykeys, pyvals);
+  Py_DECREF(pykeys);
+  Py_DECREF(pyvals);
+  PyObject* r = call(fn, args);
+  Py_DECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, unsigned num, const int* keys,
+                  NDArrayHandle* vals) {
+  return kv_op("kvstore_init", handle, num, keys, vals);
+}
+
+int MXKVStorePush(KVStoreHandle handle, unsigned num, const int* keys,
+                  NDArrayHandle* vals, int /*priority*/) {
+  return kv_op("kvstore_push", handle, num, keys, vals);
+}
+
+int MXKVStorePull(KVStoreHandle handle, unsigned num, const int* keys,
+                  NDArrayHandle* vals, int /*priority*/) {
+  return kv_op("kvstore_pull", handle, num, keys, vals);
+}
+
+// -- Profiler --------------------------------------------------------------
+
+int MXSetProfilerState(int state) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", state);
+  PyObject* r = call("profiler_set_state", args);
+  Py_DECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAggregateProfileStatsPrint(const char** out_str, int reset) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", reset);
+  PyObject* s = call("profiler_dumps", args);
+  Py_DECREF(args);
+  if (!s) { set_error_from_python(); return -1; }
+  const char* c = PyUnicode_AsUTF8(s);
+  g_ret.text = c ? c : "";
+  Py_DECREF(s);
+  *out_str = g_ret.text.c_str();
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,146 @@
+"""Core C API surface (reference: include/mxnet/c_api.h —
+MXNDArray*/MXSymbol*/MXKVStore*/profiler families over
+src/c_api/c_api.cc). Exercises the real compiled ABI through ctypes:
+array create/copy/shape/dtype/save/load, symbol JSON round trip,
+kvstore init/push/pull, profiler state + aggregate print.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+capi = pytest.importorskip('mxnet_tpu.native.capi')
+so = capi.lib()
+pytestmark = pytest.mark.skipif(so is None,
+                                reason='native toolchain unavailable')
+
+
+def _new_array(shape_t=(2, 3), dtype=0):
+    shape = (ctypes.c_uint * len(shape_t))(*shape_t)
+    h = ctypes.c_void_p()
+    rc = so.MXNDArrayCreateEx(shape, len(shape_t), 1, 0, 0, dtype,
+                              ctypes.byref(h))
+    assert rc == 0, so.MXGetLastError()
+    return h
+
+
+def test_version_and_errors():
+    v = ctypes.c_int()
+    assert so.MXGetVersion(ctypes.byref(v)) == 0
+    assert v.value >= 10000
+
+
+def test_ndarray_create_copy_shape_dtype():
+    h = _new_array()
+    try:
+        data = np.arange(6, dtype=np.float32)
+        assert so.MXNDArraySyncCopyFromCPU(
+            h, data.ctypes.data_as(ctypes.c_void_p), 6) == 0
+        out = np.zeros(6, np.float32)
+        assert so.MXNDArraySyncCopyToCPU(
+            h, out.ctypes.data_as(ctypes.c_void_p), 6) == 0
+        np.testing.assert_array_equal(out, data)
+
+        ndim = ctypes.c_uint()
+        pdata = ctypes.POINTER(ctypes.c_uint)()
+        assert so.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                    ctypes.byref(pdata)) == 0
+        assert [pdata[i] for i in range(ndim.value)] == [2, 3]
+        dt = ctypes.c_int()
+        assert so.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+        assert dt.value == 0          # float32
+    finally:
+        so.MXNDArrayFree(h)
+
+
+def test_ndarray_save_load_roundtrip(tmp_path):
+    h = _new_array()
+    data = np.arange(6, dtype=np.float32) * 2
+    so.MXNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), 6)
+    fname = str(tmp_path / 'arrs.params').encode()
+    keys = (ctypes.c_char_p * 1)(b'w')
+    handles = (ctypes.c_void_p * 1)(h)
+    assert so.MXNDArraySave(fname, 1, handles, keys) == 0
+
+    n = ctypes.c_uint()
+    arrs = ctypes.POINTER(ctypes.c_void_p)()
+    n_names = ctypes.c_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert so.MXNDArrayLoad(fname, ctypes.byref(n), ctypes.byref(arrs),
+                            ctypes.byref(n_names),
+                            ctypes.byref(names)) == 0
+    assert n.value == 1 and n_names.value == 1
+    assert names[0] == b'w'
+    out = np.zeros(6, np.float32)
+    assert so.MXNDArraySyncCopyToCPU(
+        arrs[0], out.ctypes.data_as(ctypes.c_void_p), 6) == 0
+    np.testing.assert_array_equal(out, data)
+    so.MXNDArrayFree(arrs[0])
+    so.MXNDArrayFree(h)
+
+
+def test_symbol_json_and_listings():
+    s = mx.sym.Variable('data')
+    s = mx.sym.FullyConnected(s, num_hidden=3, name='fc')
+    sh = ctypes.c_void_p()
+    assert so.MXSymbolCreateFromJSON(s.tojson().encode(),
+                                     ctypes.byref(sh)) == 0, \
+        so.MXGetLastError()
+    try:
+        n = ctypes.c_uint()
+        arr = ctypes.POINTER(ctypes.c_char_p)()
+        assert so.MXSymbolListArguments(sh, ctypes.byref(n),
+                                        ctypes.byref(arr)) == 0
+        assert [arr[i].decode() for i in range(n.value)] == \
+            ['data', 'fc_weight', 'fc_bias']
+        assert so.MXSymbolListOutputs(sh, ctypes.byref(n),
+                                      ctypes.byref(arr)) == 0
+        assert n.value == 1 and arr[0].decode().startswith('fc')
+        js = ctypes.c_char_p()
+        assert so.MXSymbolSaveToJSON(sh, ctypes.byref(js)) == 0
+        assert b'fc' in js.value
+    finally:
+        so.MXSymbolFree(sh)
+
+
+def test_symbol_bad_json_sets_error():
+    sh = ctypes.c_void_p()
+    rc = so.MXSymbolCreateFromJSON(b'{not json', ctypes.byref(sh))
+    assert rc != 0
+    assert so.MXGetLastError()          # non-empty message
+
+
+def test_kvstore_push_pull():
+    h = _new_array()
+    data = np.arange(6, dtype=np.float32)
+    so.MXNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), 6)
+    kv = ctypes.c_void_p()
+    assert so.MXKVStoreCreate(b'local', ctypes.byref(kv)) == 0
+    keys = (ctypes.c_int * 1)(3)
+    vals = (ctypes.c_void_p * 1)(h)
+    assert so.MXKVStoreInit(kv, 1, keys, vals) == 0
+    assert so.MXKVStorePush(kv, 1, keys, vals, 0) == 0
+    h2 = _new_array()
+    vals2 = (ctypes.c_void_p * 1)(h2)
+    assert so.MXKVStorePull(kv, 1, keys, vals2, 0) == 0
+    out = np.zeros(6, np.float32)
+    so.MXNDArraySyncCopyToCPU(
+        h2, out.ctypes.data_as(ctypes.c_void_p), 6)
+    np.testing.assert_array_equal(out, data)   # pull after 1 push
+    so.MXNDArrayFree(h)
+    so.MXNDArrayFree(h2)
+    so.MXKVStoreFree(kv)
+
+
+def test_profiler_c_surface():
+    assert so.MXSetProfilerState(1) == 0
+    assert so.MXNDArrayWaitAll() == 0
+    txt = ctypes.c_char_p()
+    assert so.MXAggregateProfileStatsPrint(ctypes.byref(txt), 1) == 0
+    assert so.MXSetProfilerState(0) == 0
+    assert txt.value.decode().startswith('Name')

@@ -348,3 +348,16 @@ def test_profiler_aggregate_stats():
     as_json = profiler.dumps(format='json', reset=True)
     assert 'fused_train_step' in as_json
     assert profiler.aggregate_stats() == {}
+
+
+def test_engine_bulk_zero_disables_compiled_dispatch():
+    """set_bulk_size(0) / bulk(0) maps to the eager dispatcher's
+    compiled-dispatch switch (the TPU analog of engine bulking)."""
+    from mxnet_tpu import config as cfg
+    assert cfg.bulk_exec(True) is True
+    with mx.engine.bulk(0):
+        assert cfg.bulk_exec(True) is False
+        # ops still execute correctly, just un-jitted
+        out = (nd.ones((2, 2)) * 3).asnumpy()
+        np.testing.assert_array_equal(out, np.full((2, 2), 3.0))
+    assert cfg.bulk_exec(True) is True

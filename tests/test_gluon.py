@@ -424,3 +424,17 @@ def test_optimizer_zoo_step():
         tr.step(1)
         v = p.data().asnumpy()
         assert np.isfinite(v).all() and not np.allclose(v, 1.0), (name, v)
+
+
+def test_pool_positional_signatures_match_reference():
+    """3D max and 2D/3D avg pools take ceil_mode BEFORE layout; max
+    pools reject count_include_pad (reference conv_layers.py orders)."""
+    p = nn.MaxPool3D((2, 2, 2), None, 0, True)       # ceil_mode=True
+    assert p._kwargs['pooling_convention'] == 'full'
+    p = nn.AvgPool2D((2, 2), None, 0, True, 'NCHW', False)
+    assert p._kwargs['pooling_convention'] == 'full'
+    assert p._kwargs['count_include_pad'] is False
+    p = nn.MaxPool1D(2, None, 0, 'NCW', True)
+    assert p._kwargs['pooling_convention'] == 'full'
+    with pytest.raises(TypeError):
+        nn.MaxPool2D(2, count_include_pad=False)

@@ -199,3 +199,24 @@ def test_psum_collective_over_mesh():
     f = shard_map(allreduce, mesh=mesh, in_specs=P('dp'), out_specs=P())
     out = np.asarray(jax.jit(f)(x))
     np.testing.assert_allclose(out, np.full((1,), x.sum()))
+
+
+def test_bandwidth_probe_collectives():
+    """Comm diagnostics (reference analog: tools/bandwidth/measure.py):
+    every collective runs over the 8-device mesh and reports sane
+    numbers; allreduce bus accounting uses the 2(n-1)/n convention."""
+    from mxnet_tpu.tools.bandwidth import measure_collectives, \
+        measure_kvstore
+    import jax
+    rows = measure_collectives(devices=jax.devices('cpu'),
+                               sizes=(1 << 16,), iters=2)
+    names = {r['collective'] for r in rows}
+    assert names == {'psum', 'all_gather', 'reduce_scatter', 'ppermute'}
+    for r in rows:
+        assert r['devices'] == 8
+        assert r['seconds'] > 0 and r['algo_gbps'] > 0
+    ar = next(r for r in rows if r['collective'] == 'psum')
+    assert abs(ar['bus_gbps'] / ar['algo_gbps'] - 2 * 7 / 8) < 1e-6
+
+    kv = measure_kvstore('device', sizes=(1 << 14,), iters=2)
+    assert kv[0]['push_pull_gbps'] > 0

@@ -1,128 +1,15 @@
-"""BERT-base pretraining throughput on one chip.
+"""Standalone BERT-base pretraining benchmark entry.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
-BASELINE.json names BERT-base samples/s as a north-star metric but the
-reference ships no in-tree number (GluonNLP was external; BASELINE.md
-header). vs_baseline is therefore reported against a 1x V100 fp16
-BERT-base seq128 pretraining figure of ~107 samples/s (public GluonNLP-era
-scripts), the closest analog of the reference stack's own capability.
-
-Methodology mirrors bench.py: synthetic data, hybridized net, fused
-trainer step, steady-state samples/s.
+Delegates to bench.py's BERT bench (single source of truth for model
+config, fused-step construction, and the JSON metric line) so the two
+entries can never report different methodologies.
 """
-import json
-import time
-
-import numpy as np
-
-
-def _retry_transient(build):
-    """Run a fused-step builder, retrying ONCE only for transient
-    tunnel/compile transport errors; deterministic failures propagate
-    immediately so the eager fallback engages without a wasted sleep."""
-    try:
-        return build()
-    except Exception as e:
-        msg = str(e)
-        if 'INTERNAL' in msg or 'remote_compile' in msg or \
-                'UNAVAILABLE' in msg:
-            time.sleep(10)
-            return build()
-        raise
 
 
 def main():
     import jax
-    import mxnet_tpu as mx
-    from mxnet_tpu import autograd, gluon, nd
-    from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
-
-    on_accel = jax.default_backend() != 'cpu'
-    batch = 32 if on_accel else 2
-    seqlen = 128 if on_accel else 16
-    npred = 20 if on_accel else 2
-    vocab = 30522 if on_accel else 100
-    warmup, iters = 3, 30 if on_accel else 2
-
-    if on_accel:
-        net = bert_zoo.bert_12_768_12(vocab_size=vocab, max_length=512,
-                                      dropout=0.1)
-    else:
-        net = bert_zoo.get_bert('bert_12_768_12', vocab_size=vocab,
-                                max_length=32, units=32, hidden_size=64,
-                                num_layers=2, num_heads=4, dropout=0.1)
-    net.initialize(mx.init.TruncNorm(stdev=0.02)
-                   if hasattr(mx.init, 'TruncNorm') else mx.init.Xavier())
-    if on_accel:
-        net.cast('bfloat16')
-    net.hybridize(static_alloc=True, static_shape=True)
-
-    L = gluon.loss.SoftmaxCrossEntropyLoss()
-
-    rs = np.random.RandomState(0)
-    ids = nd.array(rs.randint(0, vocab, (batch, seqlen)))
-    tt = nd.array((rs.rand(batch, seqlen) > 0.5).astype('float32'))
-    vl = nd.array(np.full((batch,), seqlen, np.float32))
-    mp = nd.array(rs.randint(0, seqlen, (batch, npred)))
-    mlm_y = nd.array(rs.randint(0, vocab, (batch, npred)))
-    nsp_y = nd.array(rs.randint(0, 2, (batch,)))
-
-    # one pjit-compiled, donated program per step (fwd+bwd+AdamW)
-    try:
-        from mxnet_tpu import parallel
-        def pretrain_loss(outs, labels):
-            _, _, mlm_s, nsp_s = outs
-            my, ny = labels
-            return L(mlm_s.reshape((-1, vocab)),
-                     my.reshape((-1,))).mean() + L(nsp_s, ny).mean()
-
-        def _build_fused():
-            mesh = parallel.create_mesh({'dp': 1},
-                                        devices=jax.devices()[:1])
-            pt = parallel.ParallelTrainer(
-                net, pretrain_loss, 'adamw',
-                {'learning_rate': 1e-4, 'wd': 0.01}, mesh)
-            pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])  # compile here
-            return pt
-        pt = _retry_transient(_build_fused)
-
-        def step():
-            return pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])
-    except Exception:
-        trainer = gluon.Trainer(net.collect_params(), 'adamw',
-                                {'learning_rate': 1e-4, 'wd': 0.01})
-
-        def step():
-            with autograd.record():
-                _, _, mlm_s, nsp_s = net(ids, tt, vl, mp)
-                loss = L(mlm_s.reshape((-1, vocab)),
-                         mlm_y.reshape((-1,))).mean() + \
-                    L(nsp_s, nsp_y).mean()
-            loss.backward()
-            # the loss is already a mean: step(1) keeps the effective lr
-            # identical to the fused path (no extra 1/batch rescale)
-            trainer.step(1)
-            return loss
-
-    for _ in range(warmup):
-        step()
-    nd.waitall()
-    last = step()
-    last.wait_to_read()
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step()
-    loss.wait_to_read()
-    dt = time.perf_counter() - t0
-
-    samples_s = batch * iters / dt
-    baseline = 107.0  # 1x V100 fp16 BERT-base seq128 (see module docstring)
-    print(json.dumps({
-        'metric': 'bert_base_pretrain_samples_per_sec_per_chip',
-        'value': round(samples_s, 2),
-        'unit': 'samples/s',
-        'vs_baseline': round(samples_s / baseline, 3)}))
+    from bench import bench_bert
+    bench_bert(jax.default_backend() != 'cpu')
 
 
 if __name__ == '__main__':

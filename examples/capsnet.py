@@ -1,0 +1,120 @@
+"""Capsule network (reference: example/capsnet — primary capsules +
+dynamic routing-by-agreement + margin loss on MNIST-like digits). Tiny
+TPU-native rendition: the routing iterations are a fixed-length Python
+loop over pure ops (unrolled by XLA — no data-dependent control flow),
+capsule affine votes are one batched matmul on the MXU, and squash /
+softmax stay fused elementwise. Returns (accuracy, chance).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def _digits(rs, n, size, n_class):
+    """Blocky synthetic 'digits': class k = k+1 bright bars."""
+    x = rs.rand(n, 1, size, size).astype('float32') * 0.1
+    y = rs.randint(0, n_class, n)
+    for i in range(n):
+        for b in range(y[i] + 1):
+            r = 2 + (b * (size - 4)) // max(n_class, 1)
+            x[i, 0, r:r + 2, 2:size - 2] += 0.9
+    return x, y.astype('float32')
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=10)
+    p.add_argument('--num-samples', type=int, default=96)
+    p.add_argument('--size', type=int, default=16)
+    p.add_argument('--classes', type=int, default=4)
+    p.add_argument('--routing-iters', type=int, default=2)
+    p.add_argument('--lr', type=float, default=0.003)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    X, Y = _digits(rs, args.num_samples, args.size, args.classes)
+    n_class = args.classes
+    prim_caps, prim_dim, out_dim = 8, 4, 8
+
+    class CapsNet(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.conv = nn.Conv2D(16, 5, strides=2, activation='relu')
+                # primary capsules: one conv producing caps*dim channels
+                self.primary = nn.Conv2D(prim_caps * prim_dim, 3,
+                                         strides=2)
+                # routing votes: (n_caps_in*prim_dim) -> class capsules
+                self.votes = nn.Dense(n_class * out_dim * prim_caps,
+                                      flatten=False)
+
+        @staticmethod
+        def _squash(F, v, axis):
+            sq = F.sum(v * v, axis=axis, keepdims=True)
+            return v * sq / (1.0 + sq) / F.sqrt(sq + 1e-9)
+
+        def hybrid_forward(self, F, x):
+            feats = self.primary(self.conv(x))          # (B, C*D, H, W)
+            B = feats.shape[0]
+            hw = feats.shape[2] * feats.shape[3]
+            prim = feats.reshape((B, prim_caps, prim_dim, hw)) \
+                .transpose((0, 3, 1, 2)).reshape((B, -1, prim_dim))
+            prim = self._squash(F, prim, axis=-1)       # (B, N, D)
+            n_in = prim.shape[1]
+            # votes u_hat: every input capsule votes for every class
+            u = self.votes(prim.reshape((B * n_in // prim_caps,
+                                         prim_caps * prim_dim)))
+            u = u.reshape((B, n_in // prim_caps, prim_caps, n_class,
+                           out_dim)).reshape((B, -1, n_class, out_dim))
+            # routing by agreement (fixed iterations, XLA-unrolled)
+            b_logit = F.zeros((B, u.shape[1], n_class))
+            for _ in range(args.routing_iters):
+                c = F.softmax(b_logit, axis=-1)         # coupling
+                s = F.sum(F.expand_dims(c, axis=-1) * u, axis=1)
+                v = self._squash(F, s, axis=-1)         # (B, K, out)
+                b_logit = b_logit + F.sum(
+                    u * F.expand_dims(v, axis=1), axis=-1)
+            return F.sqrt(F.sum(v * v, axis=-1) + 1e-9)  # class lengths
+
+    net = CapsNet()
+    net.initialize(mx.init.Xavier())
+
+    def margin_loss(lengths, labels):
+        onehot = nd.one_hot(labels, depth=n_class)
+        pos = nd.maximum(0.9 - lengths, 0.0) ** 2
+        neg = nd.maximum(lengths - 0.1, 0.0) ** 2
+        return (onehot * pos + 0.5 * (1 - onehot) * neg).sum(axis=1)
+
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    xs, ys = nd.array(X), nd.array(Y)
+    split = args.num_samples * 3 // 4
+    batch = 24
+    for _ in range(args.epochs):
+        for i in range(0, split, batch):
+            xb, yb = xs[i:i + batch], ys[i:i + batch]
+            with autograd.record():
+                loss = margin_loss(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+
+    pred = net(xs[split:]).asnumpy().argmax(axis=1)
+    acc = float((pred == Y[split:]).mean())
+    print('capsnet accuracy %.3f (chance %.3f, routing iters %d)'
+          % (acc, 1.0 / n_class, args.routing_iters))
+    return acc, 1.0 / n_class
+
+
+if __name__ == '__main__':
+    main()

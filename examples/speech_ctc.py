@@ -1,0 +1,136 @@
+"""Sequence labeling with CTC, speech-style (reference: example/speech*
+and example/ctc — acoustic-model stacks trained with warp-CTC). Tiny
+TPU-native rendition: synthetic 'utterances' (each frame a noisy
+one-hot of the symbol being 'spoken', stretched to variable durations)
+-> BiLSTM over the fused RNN op (lax.scan) -> per-frame logits -> the
+framework CTCLoss. Greedy CTC decode measures sequence accuracy.
+Returns (label error rate, baseline error rate of an untrained net).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def _utterances(rs, n, n_sym, T, L):
+    """Each sample: L symbols, each held for a random duration, with
+    noise — the classic toy CTC task."""
+    x = np.zeros((n, T, n_sym + 2), 'float32')
+    labels = np.zeros((n, L), 'float32')
+    for i in range(n):
+        # no immediate repeats: a repeated symbol needs an explicit
+        # blank between its spans, which pure one-hot frames cannot cue
+        syms = [rs.randint(1, n_sym + 1)]
+        while len(syms) < L:
+            nxt = rs.randint(1, n_sym + 1)
+            if nxt != syms[-1]:
+                syms.append(nxt)
+        syms = np.asarray(syms)
+        labels[i] = syms
+        cuts = np.sort(rs.choice(np.arange(1, T), L - 1, replace=False))
+        spans = np.split(np.arange(T), cuts)
+        for sym, span in zip(syms, spans):
+            x[i, span, sym] = 1.0
+    x += rs.randn(n, T, n_sym + 2).astype('float32') * 0.3
+    return x, labels
+
+
+def _greedy_decode(logits, blank):
+    """Collapse repeats then drop blanks (standard CTC decode)."""
+    path = logits.argmax(axis=-1)
+    out = []
+    for row in path:
+        seq, prev = [], -1
+        for sym in row:
+            if sym != prev and sym != blank:
+                seq.append(int(sym))
+            prev = sym
+        out.append(seq)
+    return out
+
+
+def _edit_distance(a, b):
+    """Levenshtein distance (the standard CTC label-error metric)."""
+    dp = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, cb in enumerate(b, 1):
+            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                     prev + (ca != cb))
+    return dp[-1]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=15)
+    p.add_argument('--num-samples', type=int, default=160)
+    p.add_argument('--symbols', type=int, default=5)
+    p.add_argument('--frames', type=int, default=24)
+    p.add_argument('--label-len', type=int, default=3)
+    p.add_argument('--hidden', type=int, default=32)
+    p.add_argument('--lr', type=float, default=0.02)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn, rnn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    n_sym = args.symbols
+    vocab = n_sym + 2                 # symbols + silence + CTC blank
+    blank = vocab - 1                 # CTCLoss uses blank_label='last'
+    X, Y = _utterances(rs, args.num_samples, n_sym, args.frames,
+                       args.label_len)
+
+    class AcousticModel(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.encoder = rnn.LSTM(args.hidden, num_layers=1,
+                                        bidirectional=True,
+                                        layout='NTC')
+                self.head = nn.Dense(vocab, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return self.head(self.encoder(x))   # (N, T, vocab)
+
+    net = AcousticModel()
+    net.initialize(mx.init.Xavier())
+    ctc = gluon.loss.CTCLoss(layout='NTC', label_layout='NT')
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+
+    xs, ys = nd.array(X), nd.array(Y)
+    split = args.num_samples * 3 // 4
+
+    def error_rate(lo, hi):
+        """Label error rate: edit distance normalised by label length."""
+        decoded = _greedy_decode(net(xs[lo:hi]).asnumpy(), blank)
+        total = sum(_edit_distance(seq, [int(v) for v in want])
+                    for seq, want in zip(decoded, Y[lo:hi]))
+        return total / ((hi - lo) * args.label_len)
+
+    baseline = error_rate(split, args.num_samples)   # untrained
+    batch = 16
+    for _ in range(args.epochs):
+        for i in range(0, split, batch):
+            xb, yb = xs[i:i + batch], ys[i:i + batch]
+            with autograd.record():
+                loss = ctc(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+
+    ler = error_rate(split, args.num_samples)
+    print('ctc label error rate %.3f (untrained baseline %.3f)'
+          % (ler, baseline))
+    return ler, baseline
+
+
+if __name__ == '__main__':
+    main()

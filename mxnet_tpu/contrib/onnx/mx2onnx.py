@@ -220,8 +220,15 @@ def _translate(ex, node, ins, out):
 def export_model(sym, params, input_shapes, input_types='float32',
                  onnx_file_path='model.onnx', verbose=False):
     """Export a Symbol + params to an ONNX file
-    (reference: mx2onnx/export_model.py export_model). Returns the path.
+    (reference: mx2onnx/export_model.py export_model — which also
+    accepts a symbol-JSON path and a .params path). Returns the path.
     """
+    if isinstance(sym, str):
+        from ... import symbol as _symbol
+        sym = _symbol.load(sym)
+    if isinstance(params, str):
+        from ... import ndarray as _nd
+        params = _nd.load(params)
     ex = _Exporter({k.split(':', 1)[-1]: v for k, v in params.items()})
     nodes = sym._nodes()
     entries = sym._entries

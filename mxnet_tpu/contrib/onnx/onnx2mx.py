@@ -191,6 +191,49 @@ def _import_node(op_type, name, ins, attrs, consts):
                            eps=attrs.get('epsilon', 1e-5), name=name)
     if op_type == 'Identity':
         return S.identity(ins[0], name=name)
+    if op_type in ('Sqrt', 'Exp', 'Log', 'Abs', 'Floor', 'Ceil'):
+        return getattr(S, op_type.lower())(ins[0], name=name)
+    if op_type == 'Neg':
+        return S.negative(ins[0], name=name)
+    if op_type == 'Pow':
+        return S.broadcast_power(*ins, name=name)
+    if op_type in ('ReduceMean', 'ReduceSum', 'ReduceMax', 'ReduceMin'):
+        fn = {'ReduceMean': S.mean, 'ReduceSum': S.sum,
+              'ReduceMax': S.max, 'ReduceMin': S.min}[op_type]
+        axes = attrs.get('axes')
+        return fn(ins[0], axis=tuple(axes) if axes else None,
+                  keepdims=bool(attrs.get('keepdims', 1)), name=name)
+    if op_type in ('Squeeze', 'Unsqueeze', 'Pad'):
+        # attrs (opset<13) or a CONSTANT second input; a runtime-computed
+        # second input is out of scope for the static importer
+        key = 'pads' if op_type == 'Pad' else 'axes'
+        spec = attrs.get(key)
+        if spec is None and len(ins) > 1:
+            spec = consts.get(_name_of(ins[1]))
+        if spec is None:
+            raise NotImplementedError(
+                'ONNX import: %s requires constant %s' % (op_type, key))
+    if op_type == 'Squeeze':
+        return S.squeeze(ins[0], axis=tuple(int(a) for a in spec),
+                         name=name)
+    if op_type == 'Unsqueeze':
+        out = ins[0]
+        for ax in sorted(int(a) for a in spec):
+            out = S.expand_dims(out, axis=ax, name='%s_ax%d' % (name, ax))
+        return out
+    if op_type == 'Pad':
+        pads = spec
+        mode = attrs.get('mode', 'constant') or 'constant'
+        value = float(attrs.get('value', 0.0))
+        n = len(pads) // 2
+        width = []
+        for d in range(n):
+            width.extend([int(pads[d]), int(pads[d + n])])
+        return S.Pad(ins[0], mode={'constant': 'constant',
+                                   'reflect': 'reflect',
+                                   'edge': 'edge'}[mode],
+                     pad_width=tuple(width), constant_value=value,
+                     name=name)
     raise NotImplementedError('ONNX import: unsupported op %s' % op_type)
 
 

@@ -189,7 +189,13 @@ class CTCLoss(Loss):
             pred = F.swapaxes(pred, dim1=0, dim2=1)
         if self._label_layout == 'TN':
             label = F.swapaxes(label, dim1=0, dim2=1)
-        nll = F.CTCLoss(pred, label, pred_lengths, label_lengths,
+        # the variadic op only consumes length inputs that exist
+        inputs = [pred, label]
+        if pred_lengths is not None:
+            inputs.append(pred_lengths)
+        if label_lengths is not None:
+            inputs.append(label_lengths)
+        nll = F.CTCLoss(*inputs,
                         use_data_lengths=pred_lengths is not None,
                         use_label_lengths=label_lengths is not None,
                         blank_label='last')

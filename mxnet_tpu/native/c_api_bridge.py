@@ -11,10 +11,10 @@ from __future__ import annotations
 
 import numpy as np
 
-# MXNet dtype codes (reference: include/mxnet/c_api.h / base dtype enum)
-_DTYPE_BY_CODE = {0: 'float32', 1: 'float64', 2: 'float16', 3: 'uint8',
-                  4: 'int32', 5: 'int8', 6: 'int64'}
-_CODE_BY_DTYPE = {v: k for k, v in _DTYPE_BY_CODE.items()}
+# MXNet dtype codes: the single source of truth is the serialization
+# TypeFlag map in ndarray.py (reference: mshadow TypeFlag enum)
+from ..ndarray.ndarray import _MX_TYPE_FLAGS as _DTYPE_BY_CODE
+from ..ndarray.ndarray import _MX_FLAG_OF as _CODE_BY_DTYPE
 
 
 def _ctx(dev_type, dev_id):
@@ -38,6 +38,12 @@ def ndarray_shape(arr):
 
 def ndarray_dtype_code(arr):
     return _CODE_BY_DTYPE[np.dtype(arr.dtype).name]
+
+
+def ndarray_itemsize(arr):
+    """Bytes per element — the C copy entry points size their buffers
+    from this instead of keeping their own dtype table."""
+    return int(np.dtype(arr.dtype).itemsize)
 
 
 def ndarray_copy_from(arr, buf):

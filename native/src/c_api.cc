@@ -155,7 +155,7 @@ int fill_strings(PyObject* list, unsigned* out_size,
 
 extern "C" {
 
-int mxcapi_abi_version() { return 2; }
+int mxcapi_abi_version() { return 3; }
 
 int MXGetVersion(int* out) {
   *out = 10600;  // 1.6.0-compatible surface
@@ -222,19 +222,26 @@ int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
   return 0;
 }
 
+// bytes-per-element straight from the array's dtype (no local table
+// that could drift from the Python-side TypeFlag map)
+static long element_size(PyObject* arr) {
+  PyObject* args = Py_BuildValue("(O)", arr);
+  PyObject* itemsize = call("ndarray_itemsize", args);
+  Py_DECREF(args);
+  if (!itemsize) return -1;
+  long v = PyLong_AsLong(itemsize);
+  Py_DECREF(itemsize);
+  return v;
+}
+
 int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
                              size_t size) {
   Gil gil;
   // size is an ELEMENT count (reference semantics); bridge validates
   PyObject* arr = reinterpret_cast<PyObject*>(handle);
-  PyObject* np_args = Py_BuildValue("(O)", arr);
-  PyObject* probe = call("ndarray_dtype_code", np_args);
-  Py_DECREF(np_args);
-  if (!probe) { set_error_from_python(); return -1; }
-  static const size_t kSize[] = {4, 8, 2, 1, 4, 1, 8};
-  long code = PyLong_AsLong(probe);
-  Py_DECREF(probe);
-  size_t nbytes = size * kSize[code];
+  long itemsize = element_size(arr);
+  if (itemsize < 0) { set_error_from_python(); return -1; }
+  size_t nbytes = size * static_cast<size_t>(itemsize);
   PyObject* buf = PyBytes_FromStringAndSize(
       static_cast<const char*>(data), static_cast<Py_ssize_t>(nbytes));
   PyObject* args = Py_BuildValue("(OO)", arr, buf);
@@ -258,13 +265,13 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
   PyBytes_AsStringAndSize(bytes, &src, &nbytes);
   // `size` is an element count and must match the array exactly
   // (reference semantics) — never overrun the caller's buffer
-  int dtype = 0;
-  if (MXNDArrayGetDType(handle, &dtype) != 0) {
+  long itemsize = element_size(reinterpret_cast<PyObject*>(handle));
+  if (itemsize < 0) {
+    set_error_from_python();
     Py_DECREF(bytes);
     return -1;
   }
-  static const size_t kSize[] = {4, 8, 2, 1, 4, 1, 8};
-  size_t want = size * kSize[dtype];
+  size_t want = size * static_cast<size_t>(itemsize);
   if (want != static_cast<size_t>(nbytes)) {
     g_last_error = "MXNDArraySyncCopyToCPU: size mismatch";
     Py_DECREF(bytes);

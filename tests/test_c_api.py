@@ -13,9 +13,17 @@ import pytest
 import mxnet_tpu as mx
 
 capi = pytest.importorskip('mxnet_tpu.native.capi')
-so = capi.lib()
-pytestmark = pytest.mark.skipif(so is None,
-                                reason='native toolchain unavailable')
+so = None
+
+
+@pytest.fixture(autouse=True, scope='module')
+def _lib():
+    """Compile/bind lazily so collection of unrelated tests never pays
+    the g++ build."""
+    global so
+    so = capi.lib()
+    if so is None:
+        pytest.skip('native toolchain unavailable')
 
 
 def _new_array(shape_t=(2, 3), dtype=0):

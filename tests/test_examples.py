@@ -99,3 +99,16 @@ def test_bayes_sgld_posterior_predicts():
     ens_acc, last_acc = bayes_sgld.main(['--steps', '200'])
     assert ens_acc > 0.8
     assert ens_acc >= last_acc - 0.05
+
+
+def test_capsnet_routing_classifies():
+    from examples import capsnet
+    acc, chance = capsnet.main(['--epochs', '6', '--num-samples', '64'])
+    assert acc > 2 * chance
+
+
+def test_speech_ctc_learns():
+    from examples import speech_ctc
+    ler, baseline = speech_ctc.main([])   # tuned defaults
+    assert ler < 0.75
+    assert ler < baseline / 2

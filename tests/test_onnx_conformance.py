@@ -406,3 +406,71 @@ def test_unsupported_ops_raise(op_type):
             _run_model(path, {'in0': x})
     finally:
         os.unlink(path)
+
+
+# ---------------------------------------------------------------------------
+# second tranche: math / reduce / shape ops
+# ---------------------------------------------------------------------------
+
+MATH_CASES = [
+    ('Sqrt', lambda x: np.sqrt(np.abs(x) + 1.0)),
+    ('Exp', np.exp),
+    ('Log', lambda x: np.log(np.abs(x) + 1.0)),
+    ('Abs', np.abs),
+    ('Neg', np.negative),
+    ('Floor', np.floor),
+    ('Ceil', np.ceil),
+]
+
+
+@pytest.mark.parametrize('op_type,fn', MATH_CASES,
+                         ids=lambda v: str(v)[:16])
+def test_math_node(op_type, fn):
+    x = _rs(20).randn(3, 4).astype(np.float32)
+    if op_type in ('Sqrt', 'Log'):          # domain-safe input
+        x = np.abs(x) + 1.0
+        want = np.sqrt(x) if op_type == 'Sqrt' else np.log(x)
+    else:
+        want = fn(x)
+    _check(op_type, {'in0': x}, want.astype(np.float32))
+
+
+def test_pow_node():
+    rs = _rs(21)
+    a = np.abs(rs.randn(3, 4)).astype(np.float32) + 0.5
+    b = rs.uniform(0.5, 2.0, (4,)).astype(np.float32)
+    _check('Pow', {'in0': a, 'in1': b},
+           np.power(a, b).astype(np.float32), rtol=1e-4, atol=1e-4)
+
+
+REDUCE_CASES = [
+    ('ReduceMean', np.mean, {'axes': [1], 'keepdims': 1}),
+    ('ReduceMean', np.mean, {'axes': [0, 2], 'keepdims': 0}),
+    ('ReduceSum', np.sum, {'axes': [2], 'keepdims': 1}),
+    ('ReduceMax', np.max, {'axes': [1], 'keepdims': 0}),
+    ('ReduceMin', np.min, {'axes': [0], 'keepdims': 1}),
+]
+
+
+@pytest.mark.parametrize('op_type,fn,attrs', REDUCE_CASES,
+                         ids=lambda v: str(v)[:28])
+def test_reduce_node(op_type, fn, attrs):
+    x = _rs(22).randn(2, 3, 4).astype(np.float32)
+    want = fn(x, axis=tuple(attrs['axes']),
+              keepdims=bool(attrs['keepdims'])).astype(np.float32)
+    _check(op_type, {'in0': x}, want, attrs, rtol=1e-5, atol=1e-5)
+
+
+def test_squeeze_unsqueeze_nodes():
+    x = _rs(23).randn(2, 1, 4, 1).astype(np.float32)
+    _check('Squeeze', {'in0': x}, x.reshape(2, 4), {'axes': [1, 3]})
+    y = _rs(23).randn(2, 4).astype(np.float32)
+    _check('Unsqueeze', {'in0': y}, y.reshape(1, 2, 1, 4),
+           {'axes': [0, 2]})
+
+
+def test_pad_constant_node():
+    x = _rs(24).randn(2, 3).astype(np.float32)
+    want = np.pad(x, ((1, 0), (0, 2)), constant_values=1.5)
+    _check('Pad', {'in0': x}, want.astype(np.float32),
+           {'pads': [1, 0, 0, 2], 'mode': 'constant', 'value': 1.5})

@@ -35,6 +35,9 @@ def main(argv=None):
     p.add_argument('--routing-iters', type=int, default=2)
     p.add_argument('--lr', type=float, default=0.003)
     args = p.parse_args(argv)
+    if args.routing_iters < 1:
+        p.error('--routing-iters must be >= 1 (routing defines the '
+                'class capsules)')
 
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon, nd

@@ -217,14 +217,36 @@ def _import_node(op_type, name, ins, attrs, consts):
         return S.squeeze(ins[0], axis=tuple(int(a) for a in spec),
                          name=name)
     if op_type == 'Unsqueeze':
+        # axes refer to positions in the FINAL output. Non-negative
+        # axes insert lowest-first (later positions stay valid);
+        # negative axes insert least-negative-first for the same
+        # reason. Mixed signs would need the input rank, which the
+        # static importer does not have.
+        axes = [int(a) for a in spec]
+        if all(a >= 0 for a in axes):
+            order = sorted(axes)
+        elif all(a < 0 for a in axes):
+            order = sorted(axes, reverse=True)
+        else:
+            raise NotImplementedError(
+                'ONNX import: Unsqueeze with mixed-sign axes')
         out = ins[0]
-        for ax in sorted(int(a) for a in spec):
+        for ax in order:
             out = S.expand_dims(out, axis=ax, name='%s_ax%d' % (name, ax))
         return out
     if op_type == 'Pad':
         pads = spec
         mode = attrs.get('mode', 'constant') or 'constant'
-        value = float(attrs.get('value', 0.0))
+        # fill value: opset>=11 third input (constant initializer),
+        # else the opset<11 'value' attribute
+        value = attrs.get('value', 0.0)
+        if len(ins) > 2:
+            cv = consts.get(_name_of(ins[2]))
+            if cv is None:
+                raise NotImplementedError(
+                    'ONNX import: Pad requires constant constant_value')
+            value = cv
+        value = float(onp.asarray(value).reshape(()))
         n = len(pads) // 2
         width = []
         for d in range(n):

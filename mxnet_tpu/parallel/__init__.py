@@ -18,3 +18,5 @@ from .ring_attention import (ring_self_attention,
                              ulysses_self_attention,
                              ring_attention_local,
                              ulysses_attention_local)  # noqa: F401,E402
+from .moe import switch_moe, moe_params  # noqa: F401,E402
+from .pipeline import pipeline_apply  # noqa: F401,E402

@@ -63,3 +63,19 @@ def local_mesh(n_devices=None, axes=None):
     analog is the local-process fake cluster, SURVEY.md §4 fixtures)."""
     devs = jax.devices()[:n_devices] if n_devices else jax.devices()
     return create_mesh(axes or {'dp': len(devs)}, devices=devs)
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map with per-output replication checking off, across jax
+    versions (new: check_vma; old: check_rep; older: jax.experimental).
+    One spelling for every parallel module."""
+    try:
+        from jax import shard_map
+    except ImportError:                    # pragma: no cover - old jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:                      # pragma: no cover - old jax
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)

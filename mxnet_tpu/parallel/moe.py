@@ -1,0 +1,132 @@
+"""Expert parallelism: a Switch-style Mixture-of-Experts FFN over the
+'ep' mesh axis.
+
+The reference has no MoE (its scale story stops at dense data/model
+parallel); this is part of the extended TPU-native scale envelope, like
+ring attention. Design follows the standard TPU recipe (Switch/GShard):
+
+* top-1 routing with a capacity limit: a dense one-hot dispatch tensor
+  (E, C, T) turns token gathering into matmuls the MXU likes — no
+  dynamic shapes anywhere.
+* experts are sharded over the 'ep' axis (leading expert dim); tokens
+  and router stay replicated. Each device computes only its local
+  experts' FFN, then the combine contracts local experts and a psum
+  over 'ep' restores the full output — the collective rides ICI.
+* tokens over capacity are DROPPED (router residual passes them
+  through), matching Switch-Transformer semantics.
+
+``switch_moe`` is a pure function usable under jit/pjit;
+``moe_params`` builds deterministically-initialised expert weights.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ['switch_moe', 'moe_params']
+
+
+def moe_params(key, num_experts, d_model, d_ff, dtype='float32'):
+    """(gate_w, w1, b1, w2, b2) with expert-major leading dims."""
+    import jax
+    import jax.numpy as jnp
+    ks = jax.random.split(key, 3)
+    scale_in = 1.0 / onp.sqrt(d_model)
+    scale_out = 1.0 / onp.sqrt(d_ff)
+    return (
+        jax.random.normal(ks[0], (d_model, num_experts), dtype) * scale_in,
+        jax.random.normal(ks[1], (num_experts, d_model, d_ff), dtype)
+        * scale_in,
+        jnp.zeros((num_experts, d_ff), dtype),
+        jax.random.normal(ks[2], (num_experts, d_ff, d_model), dtype)
+        * scale_out,
+        jnp.zeros((num_experts, d_model), dtype),
+    )
+
+
+def _routing(x, gate_w, num_experts, capacity):
+    """Top-1 dispatch/combine tensors (all static shapes).
+
+    Returns (dispatch (E, C, T) one-hot, combine (E, C, T) gate-weighted,
+    aux_loss scalar)."""
+    import jax.numpy as jnp
+    T = x.shape[0]
+    logits = x @ gate_w                                    # (T, E)
+    probs = jnp.exp(logits - jnp.max(logits, -1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    expert = jnp.argmax(probs, axis=-1)                    # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+    onehot = (expert[:, None] == jnp.arange(num_experts)[None, :]) \
+        .astype(x.dtype)                                   # (T, E)
+    # position of each token within its expert's queue
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0   # (T, E)
+    kept = (position >= 0) & (position < capacity)
+    slot = jnp.where(kept, position, 0).astype(jnp.int32)
+    slot_onehot = (slot[:, :, None] ==
+                   jnp.arange(capacity)[None, None, :]).astype(x.dtype)
+    dispatch = (onehot * kept)[:, :, None] * slot_onehot   # (T, E, C)
+    dispatch = dispatch.transpose(1, 2, 0)                 # (E, C, T)
+    combine = dispatch * gate[None, None, :]
+    # Switch aux load-balancing loss: E * sum_e f_e * p_e
+    frac = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def switch_moe(x, params, mesh=None, capacity_factor=1.25,
+               ep_axis='ep'):
+    """Apply the expert-parallel FFN to tokens ``x`` (T, d_model).
+
+    With ``mesh`` given, expert weights are computed shard-per-device
+    over ``ep_axis`` (devices hold E/ep_size experts each) and the
+    combine runs one psum over the axis; without a mesh the same math
+    runs on one device. Returns (out (T, d_model), aux_loss)."""
+    import jax
+    import jax.numpy as jnp
+
+    gate_w, w1, b1, w2, b2 = params
+    num_experts = w1.shape[0]
+    T = x.shape[0]
+    capacity = max(int(capacity_factor * T / num_experts), 1)
+
+    def expert_ffn(w1_l, b1_l, w2_l, b2_l, expert_in):
+        h = jnp.maximum(
+            jnp.einsum('ecm,emf->ecf', expert_in, w1_l)
+            + b1_l[:, None, :], 0.0)
+        return jnp.einsum('ecf,efm->ecm', h, w2_l) + b2_l[:, None, :]
+
+    def dense_path(x):
+        dispatch, combine, aux = _routing(x, gate_w, num_experts,
+                                          capacity)
+        expert_in = jnp.einsum('ect,tm->ecm', dispatch, x)
+        expert_out = expert_ffn(w1, b1, w2, b2, expert_in)
+        out = jnp.einsum('ect,ecm->tm', combine, expert_out)
+        return out, aux
+
+    if mesh is None or ep_axis not in mesh.axis_names:
+        return dense_path(x)
+
+    from jax.sharding import PartitionSpec as P
+    from .mesh import shard_map_compat
+
+    def sharded(x, gate_w, w1, b1, w2, b2):
+        # routing replicated; expert FFN on the LOCAL expert shard;
+        # psum over 'ep' completes the combine
+        dispatch, combine, aux = _routing(x, gate_w, num_experts,
+                                          capacity)
+        idx = jax.lax.axis_index(ep_axis)
+        e_local = w1.shape[0]              # experts per device
+        lo = idx * e_local
+        disp_l = jax.lax.dynamic_slice_in_dim(dispatch, lo, e_local, 0)
+        comb_l = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, 0)
+        expert_in = jnp.einsum('ect,tm->ecm', disp_l, x)
+        expert_out = expert_ffn(w1, b1, w2, b2, expert_in)
+        partial = jnp.einsum('ect,ecm->tm', comb_l, expert_out)
+        return jax.lax.psum(partial, ep_axis), aux
+
+    spec_e = P(ep_axis)
+    fn = shard_map_compat(
+        sharded, mesh,
+        in_specs=(P(), P(), spec_e, spec_e, spec_e, spec_e),
+        out_specs=(P(), P()))
+    return fn(x, gate_w, w1, b1, w2, b2)

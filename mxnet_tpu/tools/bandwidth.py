@@ -44,10 +44,7 @@ def measure_collectives(devices=None, sizes=(1 << 20, 1 << 24),
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:      # older jax
-        from jax.experimental.shard_map import shard_map
+    from ..parallel.mesh import shard_map_compat
 
     if devices is None:
         devices = jax.devices()
@@ -69,13 +66,8 @@ def measure_collectives(devices=None, sizes=(1 << 20, 1 << 24),
                     'ppermute': P('x')}[collective]
         # reduce_scatter halves... shapes differ per collective; let
         # shard_map derive them from the body
-        try:
-            sm = shard_map(body, mesh=mesh, in_specs=P('x'),
-                           out_specs=out_spec, check_vma=False)
-        except TypeError:    # older jax spells the flag check_rep
-            sm = shard_map(body, mesh=mesh, in_specs=P('x'),
-                           out_specs=out_spec, check_rep=False)
-        return jax.jit(sm)
+        return jax.jit(shard_map_compat(body, mesh, in_specs=P('x'),
+                                        out_specs=out_spec))
 
     rows = []
     for collective in collectives:

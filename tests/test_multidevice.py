@@ -220,3 +220,56 @@ def test_bandwidth_probe_collectives():
 
     kv = measure_kvstore('device', sizes=(1 << 14,), iters=2)
     assert kv[0]['push_pull_gbps'] > 0
+
+
+def test_switch_moe_expert_parallel_matches_dense():
+    """ep sharding: experts split across 8 devices must produce exactly
+    the single-device dense-dispatch result (token routing, capacity
+    drops and aux loss included)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import parallel
+    key = jax.random.PRNGKey(0)
+    T, d_model, d_ff, E = 32, 16, 32, 8
+    params = parallel.moe_params(key, E, d_model, d_ff)
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, d_model))
+    dense_out, dense_aux = parallel.switch_moe(x, params, mesh=None)
+    mesh = parallel.create_mesh({'ep': 8},
+                                devices=jax.devices('cpu')[:8])
+    ep_out, ep_aux = jax.jit(
+        lambda x: parallel.switch_moe(x, params, mesh=mesh))(x)
+    np.testing.assert_allclose(np.asarray(ep_out),
+                               np.asarray(dense_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(ep_aux), float(dense_aux),
+                               rtol=1e-6)
+    # routing actually uses several experts (not a degenerate collapse)
+    assert np.abs(np.asarray(dense_out)).sum() > 0
+
+
+def test_pipeline_apply_matches_sequential():
+    """pp scheduling: the scan+ppermute pipeline over 4 stages must
+    equal applying the 4 stages back-to-back."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import parallel
+    S, M, mb, dim = 4, 6, 3, 8
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (S, dim, dim)) * 0.3
+    b = jax.random.normal(jax.random.PRNGKey(1), (S, dim)) * 0.1
+    xs = jax.random.normal(jax.random.PRNGKey(2), (M, mb, dim))
+
+    def stage_fn(params, x):
+        wi, bi = params
+        return jnp.maximum(x @ wi + bi, 0.0)
+
+    want = xs
+    for s in range(S):
+        want = jax.vmap(lambda x: stage_fn((w[s], b[s]), x))(want)
+
+    mesh = parallel.create_mesh({'pp': S},
+                                devices=jax.devices('cpu')[:S])
+    got = jax.jit(lambda xs: parallel.pipeline_apply(
+        stage_fn, (w, b), xs, mesh))(xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)

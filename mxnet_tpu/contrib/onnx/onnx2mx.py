@@ -238,13 +238,24 @@ def _import_node(op_type, name, ins, attrs, consts):
         pads = spec
         mode = attrs.get('mode', 'constant') or 'constant'
         # fill value: opset>=11 third input (constant initializer),
-        # else the opset<11 'value' attribute
+        # else the opset<11 'value' attribute. Optional inputs are
+        # positional and empty names were compacted away upstream, so a
+        # multi-element third input can only be a (mis-bound) axes
+        # tensor — refuse rather than pad with a garbage value.
         value = attrs.get('value', 0.0)
+        if len(ins) > 3:
+            raise NotImplementedError(
+                'ONNX import: Pad with an axes input is not supported')
         if len(ins) > 2:
             cv = consts.get(_name_of(ins[2]))
             if cv is None:
                 raise NotImplementedError(
                     'ONNX import: Pad requires constant constant_value')
+            cv = onp.asarray(cv)
+            if cv.size != 1:
+                raise NotImplementedError(
+                    'ONNX import: Pad with an axes input is not '
+                    'supported (constant_value must be a scalar)')
             value = cv
         value = float(onp.asarray(value).reshape(()))
         n = len(pads) // 2

@@ -6,7 +6,8 @@ from __future__ import annotations
 from ...block import Block, HybridBlock
 from ...nn import BatchNorm, Embedding, HybridSequential, Sequential
 
-__all__ = ['Concurrent', 'HybridConcurrent', 'Identity', 'SparseEmbedding',
+__all__ = ['SwitchMoE',
+           'Concurrent', 'HybridConcurrent', 'Identity', 'SparseEmbedding',
            'SyncBatchNorm', 'PixelShuffle1D', 'PixelShuffle2D',
            'PixelShuffle3D']
 
@@ -141,3 +142,49 @@ class PixelShuffle3D(_PixelShuffle):
 
     def __init__(self, factor, **kwargs):
         super().__init__(factor, 3, **kwargs)
+
+
+class SwitchMoE(HybridBlock):
+    """Switch-Transformer Mixture-of-Experts FFN layer (extension
+    beyond the reference): top-1 routing with a capacity limit over
+    ``num_experts`` expert FFNs, returning the routed output plus the
+    auxiliary load-balancing loss (add ``aux_weight * aux`` to the
+    training loss). Tokens are the leading axis; 3-D (B, T, C) inputs
+    are flattened to tokens and restored.
+
+    The expert weights carry the expert dim first, so a pjit sharding
+    rule mapping that dim onto an 'ep' mesh axis expert-parallelises
+    the layer without touching this code (parallel/moe.py has the
+    explicit shard_map variant)."""
+
+    def __init__(self, d_model, d_ff, num_experts,
+                 capacity_factor=1.25, weight_initializer=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._capacity_factor = capacity_factor
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                'gate_weight', shape=(d_model, num_experts),
+                init=weight_initializer, allow_deferred_init=True)
+            self.expert_w1 = self.params.get(
+                'expert_w1', shape=(num_experts, d_model, d_ff),
+                init=weight_initializer, allow_deferred_init=True)
+            self.expert_b1 = self.params.get(
+                'expert_b1', shape=(num_experts, d_ff), init='zeros',
+                allow_deferred_init=True)
+            self.expert_w2 = self.params.get(
+                'expert_w2', shape=(num_experts, d_ff, d_model),
+                init=weight_initializer, allow_deferred_init=True)
+            self.expert_b2 = self.params.get(
+                'expert_b2', shape=(num_experts, d_model), init='zeros',
+                allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_b1,
+                       expert_w2, expert_b2):
+        flat = x.reshape((-1, x.shape[-1])) if len(x.shape) == 3 else x
+        out, aux = F._contrib_SwitchMoE(
+            flat, gate_weight, expert_w1, expert_b1, expert_w2,
+            expert_b2, capacity_factor=self._capacity_factor)
+        if len(x.shape) == 3:
+            out = out.reshape(x.shape)
+        return out, aux

@@ -477,3 +477,18 @@ def roi_pooling(data, rois, *, pooled_size=None, spatial_scale=1.0):
                 out.append(jnp.where(jnp.isfinite(peak), peak, 0.0))
         return jnp.stack(out, axis=-1).reshape(c, ph, pw)
     return jax.vmap(one_roi)(rois)
+
+
+@register('_contrib_SwitchMoE', num_inputs=6, num_outputs=2,
+          aliases=('SwitchMoE',))
+def contrib_switch_moe(x, gate_w, w1, b1, w2, b2, *,
+                       capacity_factor=1.25):
+    """Switch-style top-1 Mixture-of-Experts FFN (extension beyond the
+    reference — parallel/moe.py holds the routing math). Returns
+    (out, aux_load_balancing_loss). Under pjit, sharding the expert
+    (leading) dim of w1/b1/w2/b2 over an 'ep' mesh axis shards the
+    expert compute; the explicit shard_map path lives in
+    parallel.switch_moe."""
+    from ..parallel.moe import switch_moe
+    return switch_moe(x, (gate_w, w1, b1, w2, b2), mesh=None,
+                      capacity_factor=float(capacity_factor))

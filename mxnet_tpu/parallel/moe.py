@@ -48,11 +48,10 @@ def _routing(x, gate_w, num_experts, capacity):
 
     Returns (dispatch (E, C, T) one-hot, combine (E, C, T) gate-weighted,
     aux_loss scalar)."""
+    import jax
     import jax.numpy as jnp
-    T = x.shape[0]
     logits = x @ gate_w                                    # (T, E)
-    probs = jnp.exp(logits - jnp.max(logits, -1, keepdims=True))
-    probs = probs / probs.sum(-1, keepdims=True)
+    probs = jax.nn.softmax(logits, axis=-1)
     expert = jnp.argmax(probs, axis=-1)                    # (T,)
     gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
     onehot = (expert[:, None] == jnp.arange(num_experts)[None, :]) \

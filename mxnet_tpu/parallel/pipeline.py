@@ -33,8 +33,8 @@ def pipeline_apply(stage_fn, stage_params, xs, mesh, pp_axis='pp'):
 
     n_stage = mesh.shape[pp_axis]
     n_micro = xs.shape[0]
-    ticks = n_micro + n_stage - 1
-    # pad the feed so tick t reads a defined micro-batch slot
+    # the scan runs n_micro + n_stage - 1 ticks: pad the feed so every
+    # tick reads a defined micro-batch slot
     pad = jnp.zeros((n_stage - 1,) + xs.shape[1:], xs.dtype)
     feed = jnp.concatenate([xs, pad], axis=0)     # (ticks, mb, ...)
 
@@ -42,14 +42,14 @@ def pipeline_apply(stage_fn, stage_params, xs, mesh, pp_axis='pp'):
         # params_local leaves: (1, ...) — this device's stage
         params1 = jax.tree_util.tree_map(lambda p: p[0], params_local)
         stage = jax.lax.axis_index(pp_axis)
-        first = (stage == 0).astype(feed.dtype)
         fwd_perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
         def tick(carry, x_t):
             recv = carry
             # stage 0 consumes the global feed; later stages consume
-            # what the previous stage shipped last tick
-            x_in = first * x_t + (1.0 - first) * recv
+            # what the previous stage shipped last tick (where keeps
+            # integer activations integer)
+            x_in = jnp.where(stage == 0, x_t, recv)
             y = stage_fn(params1, x_in)
             handoff = jax.lax.ppermute(y, pp_axis, fwd_perm)
             return handoff, y
@@ -60,8 +60,9 @@ def pipeline_apply(stage_fn, stage_params, xs, mesh, pp_axis='pp'):
         # m + (S-1); every device returns its window, the combine below
         # keeps the last stage's
         window = jax.lax.dynamic_slice_in_dim(ys, n_stage - 1, n_micro, 0)
-        is_last = (stage == n_stage - 1).astype(ys.dtype)
-        return jax.lax.psum(window * is_last, pp_axis)
+        keep = jnp.where(stage == n_stage - 1, window,
+                         jnp.zeros_like(window))
+        return jax.lax.psum(keep, pp_axis)
 
     fn = shard_map_compat(staged, mesh,
                           in_specs=(P(pp_axis), P()), out_specs=P())

@@ -129,8 +129,7 @@ def _wrap(local_fn, public_name):
         """Full-array entry: q/k/v (B, H, S, D) NDArrays or jax arrays
         with S divisible by the mesh axis size; runs the sharded kernel
         under shard_map over `axis`."""
-        from jax import shard_map
-        from .mesh import current_mesh
+        from .mesh import current_mesh, shard_map_compat
         mesh = mesh or current_mesh()
         if axis not in mesh.axis_names:
             raise ValueError(
@@ -146,14 +145,13 @@ def _wrap(local_fn, public_name):
                              'divisible by %s=%d' % (q.shape[1], axis, n))
         spec = P(None, None, axis, None)
 
-        # check_vma off: the ring body's guarded last-step rotation mixes
-        # device-varying and invariant values in one cond, which the vma
-        # type system can't express (collective correctness is covered by
-        # the dense-oracle tests)
-        fn = shard_map(
+        # replication checking off (shard_map_compat): the ring body's
+        # guarded last-step rotation mixes device-varying and invariant
+        # values in one cond, which the vma type system can't express
+        # (collective correctness is covered by the dense-oracle tests)
+        fn = shard_map_compat(
             functools.partial(local_fn, axis_name=axis, causal=causal),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            mesh, in_specs=(spec, spec, spec), out_specs=spec)
         arrs = [x._data if hasattr(x, '_data') else x for x in (q, k, v)]
         out = fn(*arrs)
         if hasattr(q, '_data'):

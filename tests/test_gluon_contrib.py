@@ -222,3 +222,42 @@ def test_module_checkpoint_resume(tmp_path):
     for k in straight:
         np.testing.assert_allclose(resumed[k], straight[k], rtol=1e-4,
                                    atol=1e-5, err_msg=k)
+
+
+def test_switch_moe_block_trains_and_hybridizes():
+    """gluon.contrib.nn.SwitchMoE: top-1 routed expert FFN as a layer —
+    trains through autograd, hybridizes, and matches the parallel.moe
+    dense-dispatch math it wraps."""
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    import jax
+    moe = cnn.SwitchMoE(d_model=8, d_ff=16, num_experts=4)
+    moe.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(2, 6, 8)
+                 .astype('float32'))
+    trainer = gluon.Trainer(moe.collect_params(), 'adam',
+                            {'learning_rate': 0.01})
+    losses = []
+    for _ in range(6):
+        with autograd.record():
+            out, aux = moe(x)
+            loss = (out ** 2).mean() + 0.01 * aux
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] <= losses[0]
+    assert out.shape == x.shape
+
+    # hybridized output equals the parallel.switch_moe dense path
+    moe.hybridize()
+    out_h, aux_h = moe(x)
+    from mxnet_tpu import parallel
+    flat = x.asnumpy().reshape(-1, 8)
+    params = (moe.gate_weight.data()._data, moe.expert_w1.data()._data,
+              moe.expert_b1.data()._data, moe.expert_w2.data()._data,
+              moe.expert_b2.data()._data)
+    want, want_aux = parallel.switch_moe(
+        jax.numpy.asarray(flat), params)
+    np.testing.assert_allclose(out_h.asnumpy().reshape(-1, 8),
+                               np.asarray(want), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(aux_h.asscalar()),
+                               float(want_aux), rtol=1e-5)

@@ -57,6 +57,15 @@ alias('_random_normal', 'normal', '_sample_normal_like')
 alias('_random_uniform', 'uniform')
 alias('_random_exponential', 'exponential')
 alias('_random_poisson', 'poisson')
+# legacy mx.nd.random_* spellings (reference: ndarray/random.py shims)
+alias('_random_normal', 'random_normal')
+alias('_random_uniform', 'random_uniform')
+alias('_random_exponential', 'random_exponential')
+alias('_random_poisson', 'random_poisson')
+alias('_random_gamma', 'random_gamma')
+alias('_random_negative_binomial', 'random_negative_binomial')
+alias('_random_generalized_negative_binomial',
+      'random_generalized_negative_binomial')
 alias('_random_negative_binomial', 'negative_binomial')
 alias('_random_generalized_negative_binomial', 'generalized_negative_binomial')
 
@@ -173,3 +182,7 @@ def sample_unique_zipfian(key, *, range_max=None, shape=None):
     uniq = jax.vmap(one)(keys)
     cnt = jnp.ones((rows, n), dtype=jnp.int32)
     return uniq.reshape(shp), cnt.reshape(shp)
+
+# registered above with alias 'randint'; legacy spelling completes the
+# random_* parity set
+alias('_random_randint', 'random_randint')

@@ -112,3 +112,16 @@ def test_speech_ctc_learns():
     ler, baseline = speech_ctc.main([])   # tuned defaults
     assert ler < 0.75
     assert ler < baseline / 2
+
+
+def test_seq2seq_reverse_learns():
+    from examples import seq2seq_reverse
+    acc, chance = seq2seq_reverse.main(['--epochs', '20',
+                                        '--num-samples', '192'])
+    assert acc > 0.8
+
+
+def test_vae_elbo_decreases():
+    from examples import vae
+    first, last = vae.main(['--epochs', '20'])
+    assert last < 0.6 * first

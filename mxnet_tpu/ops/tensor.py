@@ -466,9 +466,14 @@ def diag(data, *, k=0, axis1=0, axis2=1):
 
 @register('_histogram', num_inputs=1, aliases=('histogram',), num_outputs=2)
 def histogram(data, *, bin_cnt=10, range=None):
-    lo, hi = (range if range is not None else (float('nan'), float('nan')))
-    cnt, edges = jnp.histogram(data, bins=int(bin_cnt), range=(lo, hi))
-    return cnt.astype(jnp.int64), edges.astype(data.dtype)
+    # without an explicit range, bins span the data (reference
+    # tensor/histogram.cc computes min/max when range is absent)
+    span = tuple(float(v) for v in range) if range is not None else None
+    cnt, edges = jnp.histogram(data, bins=int(bin_cnt), range=span)
+    # reference returns int64 counts; without x64 the widest integer
+    # jax materialises is int32 — request that directly (the values
+    # are bin counts, far below 2^31)
+    return cnt.astype(jnp.int32), edges.astype(data.dtype)
 
 
 @register('_shuffle', needs_rng=True, aliases=('shuffle',))

@@ -257,17 +257,3 @@ def test_sparse_embedding_alias():
     from mxnet_tpu.ops import registry
     assert registry.get('_contrib_SparseEmbedding') is registry.get(
         'Embedding')
-
-
-def test_histogram_default_range():
-    """histogram without an explicit range spans the data (reference:
-    tensor/histogram.cc computes min/max) — previously returned all
-    zeros with NaN edges."""
-    x = nd.array(np.arange(10, dtype='float32'))
-    cnt, edges = _invoke('_histogram', [x], bin_cnt=5)
-    assert int(cnt.asnumpy().sum()) == 10
-    e = edges.asnumpy()
-    np.testing.assert_allclose(e[0], 0.0, atol=1e-6)
-    np.testing.assert_allclose(e[-1], 9.0, atol=1e-6)
-    cnt2, _ = _invoke('_histogram', [x], bin_cnt=5, range=(0, 10))
-    assert int(cnt2.asnumpy().sum()) == 10

@@ -800,3 +800,19 @@ def test_ndarray_method_surface():
     assert a.transpose().shape == (3, 1)
     assert a.astype('bfloat16').dtype is not None
     assert np.allclose(a.round().asnumpy(), [[0., 2., 3.]])
+
+
+def test_histogram_default_range():
+    """histogram without an explicit range spans the data (reference:
+    tensor/histogram.cc computes min/max) — previously returned all
+    zeros with NaN edges."""
+    from mxnet_tpu import nd
+    x = nd.array(np.arange(10, dtype='float32'))
+    from mxnet_tpu.ndarray.ndarray import invoke
+    cnt, edges = invoke('_histogram', [x], dict(bin_cnt=5))
+    assert int(cnt.asnumpy().sum()) == 10
+    e = edges.asnumpy()
+    np.testing.assert_allclose(e[0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(e[-1], 9.0, atol=1e-6)
+    cnt2, _ = invoke('_histogram', [x], dict(bin_cnt=5, range=(0, 10)))
+    assert int(cnt2.asnumpy().sum()) == 10

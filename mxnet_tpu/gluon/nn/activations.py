@@ -1,93 +1,120 @@
-"""Activation layers (reference: python/mxnet/gluon/nn/activations.py:227 —
-Activation, LeakyReLU, PReLU, ELU, SELU, Swish, GELU)."""
+"""Gluon activation blocks.
+
+Parity surface: reference python/mxnet/gluon/nn/activations.py:227
+(Activation, LeakyReLU, PReLU, ELU, SELU, Swish, GELU). Every block
+here is a thin dispatcher onto a registered elementwise op — on TPU
+these lower to single XLA computations that fuse into neighbouring
+matmuls/convs, so none of them cost a separate memory pass.
+"""
 from __future__ import annotations
 
-from ..block import HybridBlock
+from .. import block as _blockmod
 
-__all__ = ['Activation', 'LeakyReLU', 'PReLU', 'ELU', 'SELU', 'Swish', 'GELU']
+__all__ = ['Activation', 'LeakyReLU', 'ELU', 'SELU', 'PReLU', 'Swish', 'GELU']
 
 
-class Activation(HybridBlock):
-    """Applies an activation function: relu/sigmoid/tanh/softrelu/softsign."""
+class _ActBlock(_blockmod.HybridBlock):
+    """Shared plumbing: subclasses provide ``_apply(F, x)`` and, when the
+    repr should show a configured constant, ``_reprarg()``."""
+
+    def hybrid_forward(self, F, x):
+        return self._apply(F, x)
+
+    def _reprarg(self):
+        return ''
+
+    def __repr__(self):
+        return '{}({})'.format(type(self).__name__, self._reprarg())
+
+    @staticmethod
+    def _leaky(F, x, kind, slope=None):
+        # single funnel onto the LeakyReLU op
+        kw = dict(name='fwd', act_type=kind)
+        if slope is not None:
+            kw['slope'] = slope
+        return F.LeakyReLU(x, **kw)
+
+
+class Activation(_ActBlock):
+    """Element-wise activation chosen by name: relu / sigmoid / tanh /
+    softrelu / softsign (any act_type the Activation op accepts)."""
 
     def __init__(self, activation, **kwargs):
-        self._act_type = activation
+        self._kind = activation
         super().__init__(**kwargs)
 
     def _alias(self):
-        return self._act_type
+        return self._kind
 
-    def hybrid_forward(self, F, x):
-        return F.Activation(x, act_type=self._act_type, name='fwd')
+    def _reprarg(self):
+        return self._kind
 
-    def __repr__(self):
-        s = '{name}({_act_type})'
-        return s.format(name=self.__class__.__name__, **self.__dict__)
+    def _apply(self, F, x):
+        return F.Activation(x, name='fwd', act_type=self._kind)
 
 
-class LeakyReLU(HybridBlock):
-    """Leaky ReLU: f(x) = x if x>0 else alpha*x."""
+class LeakyReLU(_ActBlock):
+    """max(x, 0) + alpha * min(x, 0) with a fixed non-negative slope."""
 
     def __init__(self, alpha, **kwargs):
         assert alpha >= 0, 'Slope coefficient for LeakyReLU must be no less than 0.'
+        self._slope = alpha
         super().__init__(**kwargs)
-        self._alpha = alpha
 
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type='leaky', slope=self._alpha, name='fwd')
+    def _reprarg(self):
+        return self._slope
 
-    def __repr__(self):
-        s = '{name}({alpha})'
-        return s.format(name=self.__class__.__name__, alpha=self._alpha)
+    def _apply(self, F, x):
+        return self._leaky(F, x, 'leaky', self._slope)
 
 
-class PReLU(HybridBlock):
-    """Parametric leaky ReLU with learned slope (reference: activations.py)."""
+class PReLU(_blockmod.HybridBlock):
+    """LeakyReLU whose slope is a learned parameter (scalar by default)."""
 
     def __init__(self, alpha_initializer=None, **kwargs):
         super().__init__(**kwargs)
-        from ... import initializer
+        from ... import initializer as _initmod
         if alpha_initializer is None:
-            alpha_initializer = initializer.Constant(0.25)
+            alpha_initializer = _initmod.Constant(0.25)
         with self.name_scope():
-            self.alpha = self.params.get('alpha', shape=(1,),
-                                         init=alpha_initializer)
+            self.alpha = self.params.get(
+                'alpha', shape=(1,), init=alpha_initializer)
 
     def hybrid_forward(self, F, x, alpha):
-        return F.LeakyReLU(x, alpha, act_type='prelu', name='fwd')
+        return F.LeakyReLU(x, alpha, name='fwd', act_type='prelu')
 
 
-class ELU(HybridBlock):
-    """Exponential Linear Unit."""
+class ELU(_ActBlock):
+    """x above zero, alpha * (exp(x) - 1) below."""
 
     def __init__(self, alpha=1.0, **kwargs):
+        self._slope = alpha
         super().__init__(**kwargs)
-        self._alpha = alpha
 
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type='elu', slope=self._alpha)
-
-
-class SELU(HybridBlock):
-    """Scaled Exponential Linear Unit."""
-
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type='selu', name='fwd')
+    def _apply(self, F, x):
+        return self._leaky(F, x, 'elu', self._slope)
 
 
-class Swish(HybridBlock):
-    """Swish: x * sigmoid(beta * x)."""
+class SELU(_ActBlock):
+    """Self-normalising ELU with the fixed scale/alpha of the SNN paper."""
+
+    def _apply(self, F, x):
+        return self._leaky(F, x, 'selu')
+
+
+class Swish(_ActBlock):
+    """x * sigmoid(beta * x)."""
 
     def __init__(self, beta=1.0, **kwargs):
+        self._scale = beta
         super().__init__(**kwargs)
-        self._beta = beta
 
-    def hybrid_forward(self, F, x):
-        return x * F.sigmoid(self._beta * x, name='fwd')
+    def _apply(self, F, x):
+        return x * F.sigmoid(x * self._scale, name='fwd')
 
 
-class GELU(HybridBlock):
-    """Gaussian Error Linear Unit."""
+class GELU(_ActBlock):
+    """Gaussian error linear unit, x * Phi(x)."""
 
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type='gelu', name='fwd')
+    def _apply(self, F, x):
+        return self._leaky(F, x, 'gelu')

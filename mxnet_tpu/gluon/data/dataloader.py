@@ -137,10 +137,34 @@ def _worker_fn(samples, batchify_fn, dataset=None):
     return batch
 
 
+_warned_device_batch = False
+
+
+def _host_leaves(obj):
+    """Convert NDArray leaves to host numpy (warning once): a custom
+    batchify_fn ported from reference code may produce device arrays in
+    the spawned worker, but the shm transport assumes numpy — and a
+    device put inside a child process wastes a second XLA runtime."""
+    global _warned_device_batch
+    if isinstance(obj, NDArray):
+        if not _warned_device_batch:
+            _warned_device_batch = True
+            import warnings
+            warnings.warn(
+                'DataLoader process worker produced a device NDArray batch '
+                '(custom batchify_fn?). Converting to host numpy for the '
+                'shared-memory channel; return numpy from batchify_fn (see '
+                'default_mp_batchify_fn) to avoid a per-worker XLA runtime.')
+        return obj.asnumpy()
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_host_leaves(o) for o in obj)
+    return obj
+
+
 def _proc_worker_fn(samples, batchify_fn, dataset=None):
     """Process-worker target: batchify to numpy, park the result in
     shared memory, return only descriptors."""
-    return _shm_pack(_worker_fn(samples, batchify_fn, dataset))
+    return _shm_pack(_host_leaves(_worker_fn(samples, batchify_fn, dataset)))
 
 
 class _MultiWorkerIter:
@@ -199,20 +223,25 @@ class _MultiWorkerIter:
         self._rcvd_idx += 1
         return _as_nd(batch)
 
-    def close(self):
+    def close(self, drain_timeout=30):
         """Drain in-flight batches so their shared-memory segments get
         unlinked (workers unregistered them from their resource
-        tracker, so an abandoned iterator would leak /dev/shm)."""
+        tracker, so an abandoned iterator would leak /dev/shm).
+
+        ``drain_timeout`` bounds the per-batch wait; the GC path uses a
+        short bound so an abandoned iterator cannot stall interpreter
+        shutdown for minutes while the pool finishes prefetched work."""
         while self._use_shm and self._data_buffer:
             _, ret = self._data_buffer.popitem()
             try:
-                _shm_unpack(ret.get(timeout=30))
+                _shm_unpack(ret.get(timeout=drain_timeout))
             except Exception:
                 pass
         self._data_buffer = {}
 
     def __del__(self):
-        self.close()
+        # only adopt batches that are (nearly) ready — see close()
+        self.close(drain_timeout=1)
 
     def next(self):
         return self.__next__()
@@ -273,7 +302,12 @@ class DataLoader:
                 # (Python re-imports __main__ in each worker).
                 import pickle as _pickle
                 try:
+                    # everything that crosses the spawn boundary must
+                    # pickle: the dataset (shipped once per worker) AND
+                    # a user-supplied batchify_fn (shipped per task)
                     _pickle.dumps(dataset)
+                    if batchify_fn is not None:
+                        _pickle.dumps(batchify_fn)
                     picklable = True
                 except Exception:
                     picklable = False
@@ -286,11 +320,14 @@ class DataLoader:
                 else:
                     import warnings
                     warnings.warn(
-                        'DataLoader(num_workers=%d): dataset is not '
-                        'picklable (lambda transform?); falling back to '
-                        'the GIL-releasing thread pool. Use a named '
-                        'function or a picklable callable for process '
-                        'workers.' % self._num_workers, stacklevel=2)
+                        'DataLoader(num_workers=%d): dataset or '
+                        'batchify_fn is not picklable (lambda?); falling '
+                        'back to the GIL-releasing thread pool. Use named '
+                        'functions / picklable callables for process '
+                        'workers, and note process workers also require '
+                        'an ``if __name__ == "__main__"`` guard in the '
+                        'launching script.' % self._num_workers,
+                        stacklevel=2)
                     from multiprocessing.pool import ThreadPool
                     self._worker_pool = ThreadPool(self._num_workers)
                     self._thread_pool = True

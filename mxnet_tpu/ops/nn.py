@@ -74,14 +74,18 @@ _CONV_INTERNAL = {'nhwc': None}
 
 
 def _conv_nhwc():
+    import os
+    pref = os.environ.get('MXNET_CONV_LAYOUT_INTERNAL', 'auto').lower()
+    if pref in ('nhwc', 'nchw'):
+        # explicit setting: honored on every trace, so tests may flip the
+        # env var at any time without hitting a process-wide latch
+        return pref == 'nhwc'
+    # auto: channels-last on accelerators, NCHW on host. Only the backend
+    # query is latched — it is the part that forces backend init, and the
+    # conv being traced initializes the same backend immediately anyway.
     v = _CONV_INTERNAL['nhwc']
     if v is None:
-        import os
-        pref = os.environ.get('MXNET_CONV_LAYOUT_INTERNAL', 'auto').lower()
-        if pref in ('nhwc', 'nchw'):
-            v = pref == 'nhwc'
-        else:   # auto: channels-last on accelerators, NCHW on host
-            v = jax.default_backend() != 'cpu'
+        v = jax.default_backend() != 'cpu'
         _CONV_INTERNAL['nhwc'] = v
     return v
 

@@ -262,7 +262,12 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
   if (!bytes) { set_error_from_python(); return -1; }
   char* src = nullptr;
   Py_ssize_t nbytes = 0;
-  PyBytes_AsStringAndSize(bytes, &src, &nbytes);
+  if (PyBytes_AsStringAndSize(bytes, &src, &nbytes) != 0 || src == nullptr) {
+    PyErr_Clear();
+    g_last_error = "MXNDArraySyncCopyToCPU: bridge returned non-bytes";
+    Py_DECREF(bytes);
+    return -1;
+  }
   // `size` is an element count and must match the array exactly
   // (reference semantics) — never overrun the caller's buffer
   long itemsize = element_size(reinterpret_cast<PyObject*>(handle));

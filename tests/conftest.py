@@ -27,6 +27,40 @@ jax.config.update('jax_default_matmul_precision', 'float32')
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Test tiers (reference analog: the unittest / nightly split, SURVEY §4).
+# Files listed here are the long-running sweeps; everything else is the
+# fast smoke tier. Run `pytest -m fast` for a <5-minute gate on a 1-core
+# host, plain `pytest` for the full suite (~12 min on the bench host).
+# ---------------------------------------------------------------------------
+SLOW_TEST_FILES = {
+    'test_op_sweep.py',          # FD gradient check over the whole registry
+    'test_onnx_conformance.py',  # ONNX model round-trip corpus
+    'test_examples.py',          # runs every example workload end-to-end
+    'test_contrib_onnx_quant.py',
+    'test_dist_launch.py',       # spawns real worker processes
+    'test_im2rec.py',            # packs/reads record files on disk
+    'test_image_ssd.py',         # detection pipeline + NMS kernels
+    'test_transformer.py',       # full transformer fwd/bwd stacks
+    'test_ring_attention.py',    # ring/Ulysses vs dense oracle sweeps
+    'test_fused_step.py',        # whole-model fused train steps
+    'test_multidevice.py',       # 8-device pjit compiles
+    'test_optimizer_numerics.py',  # every optimizer vs oracle
+    'test_rewrites.py',          # model-zoo forwards (~100 s of compiles)
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line('markers', 'slow: long-running sweep/e2e test')
+    config.addinivalue_line('markers', 'fast: smoke-tier test (default)')
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        slow = (item.fspath.basename in SLOW_TEST_FILES
+                or item.get_closest_marker('slow') is not None)
+        item.add_marker(pytest.mark.slow if slow else pytest.mark.fast)
+
 
 @pytest.fixture(autouse=True)
 def _seed_rngs():

@@ -132,6 +132,7 @@ def test_dataset_transform_dataloader():
     assert n == 4
 
 
+@pytest.mark.slow
 def test_dataloader_workers_match_serial():
     X = np.arange(40, dtype='float32').reshape(40, 1)
     ds = gdata.ArrayDataset(X, np.arange(40))
@@ -218,6 +219,7 @@ def test_dataloader_thread_pool_matches_serial():
         np.testing.assert_allclose(a, b)
 
 
+@pytest.mark.slow
 def test_dataloader_process_workers_beat_serial():
     """num_workers=4 (spawn + shared-memory transport) must outrun
     num_workers=0 on a GIL-bound decode (reference parity target:
@@ -268,6 +270,7 @@ def test_dataloader_lambda_dataset_falls_back_to_threads():
                                X.ravel() * 2)
 
 
+@pytest.mark.slow
 def test_dataloader_abandoned_iterator_cleans_shm():
     """Breaking out of an epoch must not leak the in-flight shared
     memory segments (their workers unregistered them from the resource

@@ -36,14 +36,19 @@ class Module(BaseModule):
         if context is None:
             context = current_context()
         if isinstance(context, (list, tuple)):
-            if len(context) > 1:
-                self.logger.info(
-                    'Multiple contexts passed to Module: on TPU, multi-'
-                    'device data parallelism is expressed with a sharded '
-                    'mesh (mxnet_tpu.parallel), not per-context executors; '
-                    'using the first context.')
-            context = context[0]
-        self._context = context
+            self._context_list = list(context) or [current_context()]
+        else:
+            self._context_list = [context]
+        # Multi-context = data parallelism over a 1-D device mesh: the
+        # SAME compiled graph runs with batch-sharded inputs and
+        # replicated params; GSPMD inserts the gradient all-reduce and
+        # keeps BatchNorm statistics global-batch exact (the TPU answer
+        # to the reference's per-context executor_group.py:281
+        # decide_slices batch splitting).
+        self._context = self._context_list[0]
+        self._dp_mesh = None
+        self._dp_repl = None
+        self._dp_batch = None
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
         label_names = list(label_names) if label_names is not None else []
@@ -254,6 +259,47 @@ class Module(BaseModule):
         self.binded = True
         if shared_module is not None:
             self.params_initialized = shared_module.params_initialized
+        if len(self._context_list) > 1:
+            self._build_dp_mesh()
+
+    def _build_dp_mesh(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        devices = [c.jax_device() for c in self._context_list]
+        if len(set(devices)) != len(devices):
+            self.logger.warning(
+                'Module context list resolves to duplicate devices %s; '
+                'running single-device.', devices)
+            return
+        self._dp_mesh = Mesh(onp.array(devices), ('dp',))
+        self._dp_repl = NamedSharding(self._dp_mesh, PartitionSpec())
+        self._dp_batch = NamedSharding(self._dp_mesh, PartitionSpec('dp'))
+
+    def _place_dp(self, feed):
+        """Lay out arrays for the dp mesh: params/aux replicated, batch
+        inputs sharded along axis 0. No-ops for already-placed arrays, so
+        the per-step cost is the input scatter only."""
+        import jax
+        for name in self._param_names:
+            holder = self._exec.arg_dict[name]
+            if holder._data.sharding != self._dp_repl:
+                holder._data = jax.device_put(holder._data, self._dp_repl)
+        for name in self._aux_names:
+            holder = self._exec.aux_dict[name]
+            if holder._data.sharding != self._dp_repl:
+                holder._data = jax.device_put(holder._data, self._dp_repl)
+        for name in list(feed):
+            arr = feed[name]
+            feed[name] = NDArray(jax.device_put(arr._data, self._dp_batch))
+
+    def _undo_dp(self):
+        """Collapse back to the primary context (odd-sized final batch)."""
+        import jax
+        dev = self._context.jax_device()
+        for d in (self._exec.arg_dict, self._exec.aux_dict):
+            for holder in d.values():
+                if getattr(holder._data, 'sharding', None) in \
+                        (self._dp_repl, self._dp_batch):
+                    holder._data = jax.device_put(holder._data, dev)
 
     # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore='local', optimizer='sgd',
@@ -307,6 +353,18 @@ class Module(BaseModule):
         if tuple(cur) != tuple(new):
             shape_kwargs = {n: tuple(a.shape) for n, a in feed.items()}
             self._exec = self._exec.reshape(**shape_kwargs)
+        if self._dp_mesh is not None:
+            n_dev = len(self._context_list)
+            if new[0] % n_dev == 0:
+                self._place_dp(feed)
+            else:
+                if not getattr(self, '_dp_odd_warned', False):
+                    self._dp_odd_warned = True
+                    self.logger.warning(
+                        'batch size %d not divisible by %d devices; this '
+                        'batch runs on %s only', new[0], n_dev,
+                        self._context)
+                self._undo_dp()
         self._exec.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):

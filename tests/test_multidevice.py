@@ -273,3 +273,79 @@ def test_pipeline_apply_matches_sequential():
         stage_fn, (w, b), xs, mesh))(xs)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def _fit_module(ctx, steps=6, seed=0):
+    """Train a small symbolic MLP with Module.fit-style manual loop on
+    the given context (single or list) and return (losses, params)."""
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    data = mx.sym.Variable('data')
+    h = mx.sym.FullyConnected(data, num_hidden=32, name='fc1')
+    h = mx.sym.Activation(h, act_type='relu')
+    h = mx.sym.FullyConnected(h, num_hidden=NCLASS, name='fc2')
+    out = mx.sym.SoftmaxOutput(h, name='softmax')
+    mod = mx.mod.Module(out, context=ctx, label_names=('softmax_label',))
+    mod.bind(data_shapes=[('data', (BATCH, 12))],
+             label_shapes=[('softmax_label', (BATCH,))])
+    mod.init_params(mx.init.Xavier(rnd_type='gaussian', magnitude=2))
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1})
+    rs = np.random.RandomState(3)
+    metric = mx.metric.create('ce')
+    losses = []
+    for i in range(steps):
+        x = nd.array(rs.randn(BATCH, 12).astype('float32'))
+        y = nd.array(rs.randint(0, NCLASS, (BATCH,)).astype('float32'))
+        batch = mx.io.DataBatch([x], [y])
+        mod.forward(batch, is_train=True)
+        metric.reset()
+        mod.update_metric(metric, [y])
+        losses.append(metric.get()[1])
+        mod.backward()
+        mod.update()
+    args, _ = mod.get_params()
+    return losses, {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_module_multi_context_dp_matches_single_device():
+    """Module(context=[8 devices]) must follow the single-device
+    trajectory exactly: same per-step loss, same final params, while
+    actually sharding the batch (VERDICT r3 #8; reference analog:
+    executor_group.py decide_slices)."""
+    single_losses, single_params = _fit_module(mx.cpu(0))
+    ctxs = [mx.cpu(i) for i in range(8)]
+    dp_losses, dp_params = _fit_module(ctxs)
+    np.testing.assert_allclose(dp_losses, single_losses, rtol=2e-5,
+                               atol=1e-6)
+    for k in single_params:
+        np.testing.assert_allclose(dp_params[k], single_params[k],
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_module_multi_context_batch_is_sharded():
+    """The compiled dp Module really distributes the batch: the data
+    input's sharding must place 1/8th of the rows on each device."""
+    data = mx.sym.Variable('data')
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=NCLASS), name='softmax')
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.Module(out, context=ctxs, label_names=('softmax_label',))
+    mod.bind(data_shapes=[('data', (BATCH, 12))],
+             label_shapes=[('softmax_label', (BATCH,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer='sgd')
+    x = nd.array(np.random.randn(BATCH, 12).astype('float32'))
+    y = nd.array(np.random.randint(0, NCLASS, (BATCH,)).astype('float32'))
+    mod.forward(mx.io.DataBatch([x], [y]), is_train=True)
+    placed = mod._exec.arg_dict['data']._data
+    shard_shapes = {tuple(s.data.shape) for s in placed.addressable_shards}
+    assert shard_shapes == {(BATCH // 8, 12)}, shard_shapes
+    mod.backward()
+    mod.update()
+    # odd batch falls back to single-device without crashing
+    x9 = nd.array(np.random.randn(9, 12).astype('float32'))
+    y9 = nd.array(np.random.randint(0, NCLASS, (9,)).astype('float32'))
+    mod.forward(mx.io.DataBatch([x9], [y9]), is_train=True)
+    mod.backward()
+    mod.update()

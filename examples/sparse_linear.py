@@ -30,6 +30,17 @@ def synthetic_sparse(rs, n, dim, nnz_per_row):
     return x, y
 
 
+def write_libsvm(path, x, y):
+    """Dump a scipy CSR + labels to libsvm text (0-based indices, the
+    format LibSVMIter reads — reference example/sparse/README)."""
+    with open(path, 'w') as f:
+        for r in range(x.shape[0]):
+            lo, hi = x.indptr[r], x.indptr[r + 1]
+            feats = ' '.join('%d:%g' % (c, v) for c, v in
+                             zip(x.indices[lo:hi], x.data[lo:hi]))
+            f.write('%g %s\n' % (y[r], feats))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument('--num-samples', type=int, default=1024)
@@ -38,6 +49,9 @@ def main(argv=None):
     p.add_argument('--batch-size', type=int, default=64)
     p.add_argument('--epochs', type=int, default=5)
     p.add_argument('--lr', type=float, default=0.5)
+    p.add_argument('--libsvm', default=None,
+                   help='train from this .libsvm file (default: write '
+                        'synthetic data to a temp file and use that)')
     args = p.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -45,8 +59,34 @@ def main(argv=None):
 
     L = gluon.loss.LogisticLoss(label_format='signed')
     rs = np.random.RandomState(0)
-    x_all, y_all = synthetic_sparse(rs, args.num_samples, args.dim,
-                                    args.nnz)
+
+    scratch = None
+    if args.libsvm is None:
+        # the reference workload trains from disk via LibSVMIter — do the
+        # same: synthesize, dump to libsvm text, read it back
+        import tempfile
+        x_syn, y_syn = synthetic_sparse(rs, args.num_samples, args.dim,
+                                        args.nnz)
+        tmp = tempfile.NamedTemporaryFile(suffix='.libsvm', delete=False)
+        tmp.close()
+        write_libsvm(tmp.name, x_syn, y_syn)
+        args.libsvm = scratch = tmp.name
+    try:
+        return _train(args, L)
+    finally:
+        if scratch is not None:
+            import os
+            os.unlink(scratch)
+
+
+def _train(args, L):
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+
+    train_iter = mx.io.LibSVMIter(data_libsvm=args.libsvm,
+                                  data_shape=(args.dim,),
+                                  batch_size=args.batch_size,
+                                  round_batch=False)
 
     # row_sparse weight updated lazily: only rows touched by the batch
     weight = mx.nd.zeros((args.dim, 1)).tostype('row_sparse')
@@ -60,13 +100,13 @@ def main(argv=None):
     upd_b = mx.optimizer.get_updater(opt_b)
 
     acc = None
+    n_total = train_iter.num_data
     for epoch in range(args.epochs):
-        order = rs.permutation(args.num_samples)
-        correct = 0
-        for i in range(0, args.num_samples, args.batch_size):
-            idx = order[i:i + args.batch_size]
-            xb = nd.sparse.csr_matrix(x_all[idx])   # CSR batch
-            yb = nd.array(y_all[idx])
+        correct = seen = 0
+        train_iter.reset()
+        for batch in train_iter:
+            xb = batch.data[0]                   # CSRNDArray from disk
+            yb = batch.label[0]
             with autograd.record():
                 # sparse dot: CSR x dense row_sparse-backed weight
                 z = nd.dot(xb, weight).reshape((-1,)) + bias
@@ -75,9 +115,11 @@ def main(argv=None):
             upd_w(0, weight.grad, weight)
             upd_b(1, bias.grad, bias)
             pred = (z.asnumpy() > 0).astype(np.float32)
-            correct += int((pred == y_all[idx]).sum())
-        acc = correct / args.num_samples
-        print('epoch %d accuracy %.3f' % (epoch, acc))
+            correct += int((pred == yb.asnumpy()).sum())
+            seen += pred.shape[0]
+        acc = correct / max(1, seen)
+        print('epoch %d accuracy %.3f (%d/%d samples)'
+              % (epoch, acc, seen, n_total))
     if args.epochs >= 5:
         assert acc > 0.8, 'sparse linear model should fit synthetic data'
     return acc

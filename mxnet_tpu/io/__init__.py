@@ -1,4 +1,5 @@
 """mxnet_tpu.io — data iterators (reference: python/mxnet/io/)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter,
-                 ImageRecordIter_v1, ImageDetRecordIter, MXDataIter)
+                 PrefetchingIter, CSVIter, LibSVMIter, MNISTIter,
+                 ImageRecordIter, ImageRecordIter_v1, ImageDetRecordIter,
+                 MXDataIter)

@@ -3,109 +3,384 @@ CSRNDArray :287, RowSparseNDArray :561; C side row_sparse/CSR storage in
 include/mxnet/ndarray.h:61-66).
 
 XLA has no native sparse storage (SURVEY.md §7 hard-part 3): these classes
-keep the *API* (indices/indptr/data accessors, conversions, creation) while
-storing dense jax buffers. The embedding/optimizer "sparse" fast paths in
-the reference exist for memory reasons that XLA's scatter/gather fusion
-covers; correctness is preserved, density is documented divergence.
+keep the *API* (indices/indptr/data accessors, slicing, check_format,
+retain, conversions, creation) while storing dense jax buffers. The
+embedding/optimizer "sparse" fast paths in the reference exist for memory
+reasons that XLA's scatter/gather fusion covers; correctness is preserved,
+density is a documented divergence (docs/DIVERGENCES.md) and large arrays
+trigger a one-time footprint warning (MXNET_SPARSE_DENSE_WARN_MB).
+
+Arrays built from explicit (data, indices, indptr) keep those aux arrays,
+so the accessors round-trip user input exactly (including explicit zeros)
+and check_format() can catch malformed input the way the reference's
+MXNDArraySyncCheckFormat does.
 """
 from __future__ import annotations
+
+import os
+import warnings
 
 import numpy as onp
 import scipy.sparse as sps
 
+from ..base import MXNetError
 from .ndarray import NDArray, array, zeros as _dense_zeros
+
+__all__ = ['BaseSparseNDArray', 'CSRNDArray', 'RowSparseNDArray',
+           'csr_matrix', 'row_sparse_array', 'zeros', 'retain']
+
+_warned_footprint = False
+
+
+def _note_dense_footprint(nbytes, stype):
+    """One-time warning when a facade array is large enough that the
+    reference's true sparse storage would have mattered."""
+    global _warned_footprint
+    limit_mb = float(os.environ.get('MXNET_SPARSE_DENSE_WARN_MB', '256'))
+    if _warned_footprint or nbytes < limit_mb * (1 << 20):
+        return
+    _warned_footprint = True
+    warnings.warn(
+        'A %s array of %.0f MB was allocated DENSE: sparse storage on this '
+        'backend is an API facade over dense XLA buffers (see '
+        'docs/DIVERGENCES.md "Sparse storage"). Arrays that only fit in '
+        'memory as true sparse on the reference will not fit here. Set '
+        'MXNET_SPARSE_DENSE_WARN_MB to tune or silence this warning.'
+        % (stype, nbytes / (1 << 20)), stacklevel=3)
 
 
 class BaseSparseNDArray(NDArray):
     __slots__ = ()
 
+    def __repr__(self):
+        return '\n<%s %s @%s>' % (type(self).__name__,
+                                  'x'.join(str(d) for d in self.shape),
+                                  self.context)
+
+    def check_format(self, full_check=True):
+        """Validate the sparse representation
+        (reference: sparse.py:252 → MXNDArraySyncCheckFormat)."""
+        if full_check:
+            self._check_format_impl()
+
+    def _check_format_impl(self):
+        pass     # canonical (derived) representations are always valid
+
+    def copyto(self, other):
+        """Copy into ``other`` — a dense NDArray, a same-stype sparse
+        array, or a Context (reference: sparse.py:225/507/754)."""
+        from ..context import Context
+        if isinstance(other, Context):
+            return self.tostype(self.stype).as_in_context(other)
+        if isinstance(other, BaseSparseNDArray) and \
+                other.stype != self.stype:
+            raise ValueError(
+                'copyto with stype %s -> %s is not supported; convert '
+                'with tostype() first' % (self.stype, other.stype))
+        out = NDArray.copyto(self, other)
+        if isinstance(out, BaseSparseNDArray):
+            out._drop_aux()
+        return out
+
+    def _drop_aux(self):
+        pass
+
 
 class CSRNDArray(BaseSparseNDArray):
-    __slots__ = ()
+    """Compressed sparse row facade: 2-D, row slicing, aux accessors."""
+
+    __slots__ = ('_sp_data', '_sp_indices', '_sp_indptr')
 
     @property
     def stype(self):
         return 'csr'
 
-    @property
-    def indices(self):
+    def _aux(self):
+        """(data, indices, indptr) — stored if constructed from
+        components, else derived canonically from the dense buffer."""
+        stored = getattr(self, '_sp_data', None)
+        if stored is not None:
+            return stored, self._sp_indices, self._sp_indptr
         m = sps.csr_matrix(self.asnumpy())
-        return array(m.indices.astype('int64'))
+        m.sort_indices()
+        return m.data, m.indices.astype('int64'), m.indptr.astype('int64')
 
-    @property
-    def indptr(self):
-        m = sps.csr_matrix(self.asnumpy())
-        return array(m.indptr.astype('int64'))
+    def _set_aux(self, data, indices, indptr):
+        self._sp_data = onp.asarray(data)
+        self._sp_indices = onp.asarray(indices).astype('int64')
+        self._sp_indptr = onp.asarray(indptr).astype('int64')
+        return self
+
+    def _drop_aux(self):
+        self._sp_data = None
 
     @property
     def data(self):
-        m = sps.csr_matrix(self.asnumpy())
-        return array(m.data)
+        return array(self._aux()[0])
+
+    @property
+    def indices(self):
+        return array(self._aux()[1])
+
+    @property
+    def indptr(self):
+        return array(self._aux()[2])
+
+    def _check_format_impl(self):
+        if getattr(self, '_sp_data', None) is None:
+            return
+        data, indices, indptr = self._aux()
+        rows, cols = self.shape
+        if len(indptr) != rows + 1 or indptr[0] != 0:
+            raise MXNetError('CSRNDArray format error: indptr must have '
+                             'length num_rows+1 and start at 0')
+        if onp.any(onp.diff(indptr) < 0):
+            raise MXNetError('CSRNDArray format error: indptr must be '
+                             'non-decreasing')
+        if indptr[-1] != len(data) or len(indices) != len(data):
+            raise MXNetError('CSRNDArray format error: indptr[-1] must '
+                             'equal nnz == len(data) == len(indices)')
+        if len(indices) and (indices.min() < 0 or indices.max() >= cols):
+            raise MXNetError('CSRNDArray format error: column index out '
+                             'of bounds')
+        for r in range(rows):
+            row_idx = indices[indptr[r]:indptr[r + 1]]
+            if onp.any(onp.diff(row_idx) <= 0):
+                raise MXNetError('CSRNDArray format error: column indices '
+                                 'of row %d are not strictly ascending '
+                                 '(sorted, no duplicates)' % r)
+
+    def __getitem__(self, key):
+        """Row indexing: ``a[i]`` (a 1-row CSR) or contiguous ``a[i:j]``
+        (reference: sparse.py:337)."""
+        if isinstance(key, int):
+            begin = key + self.shape[0] if key < 0 else key
+            if not 0 <= begin < self.shape[0]:
+                raise IndexError('index %d out of range' % key)
+            return self._slice_rows(begin, begin + 1)
+        if isinstance(key, slice):
+            if key.step is not None:
+                raise ValueError('CSRNDArray only supports continuous '
+                                 'slicing on axis 0')
+            if key.start is None and key.stop is None:
+                return self
+            begin, end, _ = key.indices(self.shape[0])
+            return self._slice_rows(begin, end)
+        if isinstance(key, tuple):
+            raise ValueError('Multi-dimension indexing is not supported')
+        raise ValueError('Undefined behaviour for {}'.format(key))
+
+    def _slice_rows(self, begin, end):
+        out = CSRNDArray(self._data[begin:end])
+        if getattr(self, '_sp_data', None) is not None:
+            data, indices, indptr = self._aux()
+            lo, hi = int(indptr[begin]), int(indptr[end])
+            out._set_aux(data[lo:hi], indices[lo:hi],
+                         indptr[begin:end + 1] - lo)
+        return out
+
+    def __setitem__(self, key, value):
+        """Whole-array assignment ``a[:] = v`` (reference: sparse.py:385)."""
+        if not (isinstance(key, slice) and key.start is None
+                and key.stop is None and key.step is None):
+            raise ValueError('CSRNDArray only supports [:] assignment')
+        import jax.numpy as jnp
+        if isinstance(value, NDArray):
+            src = value._data
+        else:
+            src = jnp.asarray(onp.asarray(value))
+        if tuple(src.shape) != tuple(self.shape):
+            raise ValueError('cannot assign shape %s to CSRNDArray of '
+                             'shape %s' % (tuple(src.shape), self.shape))
+        self._data = src.astype(self._data.dtype)
+        self._drop_aux()
 
     def tostype(self, stype):
         if stype == 'default':
             return NDArray(self._data)
         if stype == 'csr':
             return self
-        return RowSparseNDArray(self._data)
+        if stype == 'row_sparse':
+            raise ValueError('cast_storage from csr to row_sparse is not '
+                             'supported (reference parity)')
+        raise ValueError('unknown storage type %s' % stype)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    __slots__ = ()
+    """Row-sparse facade: first-dim-sparse tensor with retain()."""
+
+    __slots__ = ('_sp_data', '_sp_indices')
 
     @property
     def stype(self):
         return 'row_sparse'
 
-    @property
-    def indices(self):
+    def _aux(self):
+        stored = getattr(self, '_sp_data', None)
+        if stored is not None:
+            return stored, self._sp_indices
         a = self.asnumpy()
         nz = onp.where(onp.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
-        return array(nz.astype('int64'))
+        return a[nz], nz.astype('int64')
+
+    def _set_aux(self, data, indices):
+        self._sp_data = onp.asarray(data)
+        self._sp_indices = onp.asarray(indices).astype('int64')
+        return self
+
+    def _drop_aux(self):
+        self._sp_data = None
 
     @property
     def data(self):
-        a = self.asnumpy()
-        nz = onp.where(onp.any(a.reshape(a.shape[0], -1) != 0, axis=1))[0]
-        return array(a[nz])
+        return array(self._aux()[0])
+
+    @property
+    def indices(self):
+        return array(self._aux()[1])
+
+    def _check_format_impl(self):
+        if getattr(self, '_sp_data', None) is None:
+            return
+        data, indices = self._aux()
+        if len(data) != len(indices):
+            raise MXNetError('RowSparseNDArray format error: data and '
+                             'indices row counts differ')
+        if len(indices) and (indices.min() < 0
+                             or indices.max() >= self.shape[0]):
+            raise MXNetError('RowSparseNDArray format error: row index '
+                             'out of bounds')
+        if onp.any(onp.diff(indices) <= 0):
+            raise MXNetError('RowSparseNDArray format error: row indices '
+                             'must be strictly ascending (sorted, no '
+                             'duplicates)')
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            if key.start is None and key.stop is None and key.step is None:
+                return self
+        raise Exception('RowSparseNDArray only supports [:] indexing '
+                        '(reference parity)')
+
+    def __setitem__(self, key, value):
+        if not (isinstance(key, slice) and key.start is None
+                and key.stop is None and key.step is None):
+            raise ValueError('RowSparseNDArray only supports [:] '
+                             'assignment')
+        import jax.numpy as jnp
+        src = value._data if isinstance(value, NDArray) \
+            else jnp.asarray(onp.asarray(value))
+        if tuple(src.shape) != tuple(self.shape):
+            raise ValueError('shape mismatch in RowSparseNDArray '
+                             'assignment')
+        self._data = src.astype(self._data.dtype)
+        self._drop_aux()
+
+    def retain(self, indices):
+        """Keep only the listed rows, zeroing the rest
+        (reference: sparse.py:786 → sparse_retain op)."""
+        keep = indices.asnumpy() if isinstance(indices, NDArray) \
+            else onp.asarray(indices)
+        keep = keep.astype('int64')
+        mask = onp.zeros((self.shape[0],), bool)
+        mask[keep] = True
+        dense = self.asnumpy()
+        out_np = onp.where(mask.reshape((-1,) + (1,) * (dense.ndim - 1)),
+                           dense, onp.zeros_like(dense))
+        out = RowSparseNDArray(array(out_np, dtype=str(dense.dtype))._data)
+        kept_sorted = onp.unique(keep)
+        present = self._aux()[1] if getattr(self, '_sp_data', None) \
+            is not None else None
+        if present is not None:
+            kept_sorted = kept_sorted[onp.isin(kept_sorted, present)]
+            out._set_aux(dense[kept_sorted], kept_sorted)
+        return out
 
     def tostype(self, stype):
         if stype == 'default':
             return NDArray(self._data)
         if stype == 'row_sparse':
             return self
-        return CSRNDArray(self._data)
+        if stype == 'csr':
+            raise ValueError('cast_storage from row_sparse to csr is not '
+                             'supported (reference parity)')
+        raise ValueError('unknown storage type %s' % stype)
+
+
+def retain(data, indices):
+    """Functional form of RowSparseNDArray.retain
+    (reference: mx.nd.sparse.retain)."""
+    if not isinstance(data, RowSparseNDArray):
+        raise TypeError('retain expects a RowSparseNDArray')
+    ind = indices if isinstance(indices, NDArray) else array(indices)
+    return data.retain(ind)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
-    if isinstance(arg1, tuple) and len(arg1) == 3 and not onp.isscalar(arg1[0]):
-        data, indices, indptr = arg1
-        data = data.asnumpy() if isinstance(data, NDArray) else onp.asarray(data)
-        indices = indices.asnumpy() if isinstance(indices, NDArray) else onp.asarray(indices)
-        indptr = indptr.asnumpy() if isinstance(indptr, NDArray) else onp.asarray(indptr)
+    """Create a CSRNDArray from (data, indices, indptr), a dense array,
+    or a scipy sparse matrix (reference: sparse.py csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3 \
+            and not onp.isscalar(arg1[0]):
+        data, indices, indptr = (
+            a.asnumpy() if isinstance(a, NDArray) else onp.asarray(a)
+            for a in arg1)
         m = sps.csr_matrix((data, indices, indptr), shape=shape)
-        return CSRNDArray(array(m.toarray(), dtype=dtype)._data)
+        out = CSRNDArray(array(m.toarray(), dtype=dtype)._data)
+        out._set_aux(data if dtype is None else data.astype(dtype),
+                     indices, indptr)
+        _note_dense_footprint(out._data.nbytes, 'csr')
+        return out
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        # (rows, cols) — empty matrix of that shape
+        return zeros('csr', arg1, ctx=ctx, dtype=dtype or 'float32')
+    if isinstance(arg1, CSRNDArray):
+        return arg1
     if isinstance(arg1, NDArray):
         return CSRNDArray(arg1._data)
     if sps.issparse(arg1):
         # scipy sparse input (reference csr_matrix accepts it too)
-        return CSRNDArray(array(arg1.toarray(), dtype=dtype)._data)
-    return CSRNDArray(array(onp.asarray(arg1), dtype=dtype)._data)
+        m = arg1.tocsr()
+        m.sort_indices()
+        out = CSRNDArray(array(m.toarray(), dtype=dtype)._data)
+        out._set_aux(m.data if dtype is None else m.data.astype(dtype),
+                     m.indices, m.indptr)
+        _note_dense_footprint(out._data.nbytes, 'csr')
+        return out
+    out = CSRNDArray(array(onp.asarray(arg1), dtype=dtype)._data)
+    _note_dense_footprint(out._data.nbytes, 'csr')
+    return out
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
-    if isinstance(arg1, tuple) and len(arg1) == 2:
+    """Create a RowSparseNDArray from (data, indices) or a dense array
+    (reference: sparse.py row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2 \
+            and not onp.isscalar(arg1[0]):
         data, indices = arg1
-        data = data.asnumpy() if isinstance(data, NDArray) else onp.asarray(data)
-        indices = onp.asarray(indices.asnumpy() if isinstance(indices, NDArray)
-                              else indices).astype('int64')
+        data = data.asnumpy() if isinstance(data, NDArray) \
+            else onp.asarray(data)
+        indices = onp.asarray(
+            indices.asnumpy() if isinstance(indices, NDArray)
+            else indices).astype('int64')
         full_shape = shape or ((int(indices.max()) + 1,) + data.shape[1:])
-        out = onp.zeros(full_shape, dtype=data.dtype)
-        out[indices] = data
-        return RowSparseNDArray(array(out, dtype=dtype)._data)
+        dense = onp.zeros(full_shape, dtype=data.dtype)
+        dense[indices] = data
+        out = RowSparseNDArray(array(dense, dtype=dtype)._data)
+        order = onp.argsort(indices)
+        out._set_aux(data[order] if dtype is None
+                     else data[order].astype(dtype), indices[order])
+        _note_dense_footprint(out._data.nbytes, 'row_sparse')
+        return out
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        # (rows, cols) — empty matrix of that shape
+        return zeros('row_sparse', arg1, ctx=ctx, dtype=dtype or 'float32')
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
     if isinstance(arg1, NDArray):
         return RowSparseNDArray(arg1._data)
-    return RowSparseNDArray(array(onp.asarray(arg1), dtype=dtype)._data)
+    out = RowSparseNDArray(array(onp.asarray(arg1), dtype=dtype)._data)
+    _note_dense_footprint(out._data.nbytes, 'row_sparse')
+    return out
 
 
 def zeros(stype, shape, ctx=None, dtype='float32'):

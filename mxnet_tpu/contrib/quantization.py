@@ -17,7 +17,67 @@ from ..ops import registry as _registry
 from ..symbol.symbol import Symbol, _Node
 from ..symbol.graph import num_outputs_of
 
-__all__ = ['quantize_model', 'calib_graph']
+__all__ = ['quantize_model', 'calib_graph', 'optimal_threshold']
+
+
+def _kl_divergence(p, q):
+    """KL(P||Q) over histogram mass vectors (unnormalized ok)."""
+    p = p.astype(onp.float64)
+    q = q.astype(onp.float64)
+    ps, qs = p.sum(), q.sum()
+    if ps == 0 or qs == 0:
+        return onp.inf
+    p, q = p / ps, q / qs
+    sup = p > 0
+    qv = onp.where(q[sup] > 0, q[sup], 1e-12)
+    return float(onp.sum(p[sup] * onp.log(p[sup] / qv)))
+
+
+def optimal_threshold(stats, num_bins=2001, num_quantized_bins=255):
+    """KL-optimal symmetric clipping threshold for int8 calibration
+    (reference: quantization.py:262 _get_optimal_threshold — the
+    TensorRT-style entropy recipe).
+
+    Sweeps candidate thresholds; for each, the clipped histogram P is
+    compared with its 255-level quantized reconstruction Q, and the
+    threshold minimizing KL(P||Q) wins. Saturating rare outliers this
+    way preserves far more resolution than naive min/max when the
+    activation distribution has long tails.
+    """
+    stats = onp.asarray(stats).ravel()
+    amax = float(onp.max(onp.abs(stats))) if stats.size else 0.0
+    if amax == 0.0:
+        return 1e-8
+    hist, edges = onp.histogram(stats, bins=num_bins, range=(-amax, amax))
+    zero = num_bins // 2
+    half_q = num_quantized_bins // 2
+    best_kl, best_th = onp.inf, amax
+    for i in range(half_q, zero + 1):
+        lo, hi = zero - i, zero + i + 1
+        sliced = hist[lo:hi].astype(onp.float64)
+        nbins = len(sliced)
+        merged = nbins // num_quantized_bins
+        if merged == 0:
+            continue
+        p = sliced.copy()
+        p[0] += hist[:lo].sum()        # clipped outliers saturate
+        p[-1] += hist[hi:].sum()
+        live = sliced != 0
+        # quantize P to num_quantized_bins levels, spread each level's
+        # mass uniformly back over its live source bins
+        cuts = onp.arange(num_quantized_bins) * merged
+        bucket_mass = onp.add.reduceat(sliced, cuts)
+        bucket_live = onp.add.reduceat(live.astype(onp.float64), cuts)
+        avg = onp.divide(bucket_mass, bucket_live,
+                         out=onp.zeros_like(bucket_mass),
+                         where=bucket_live > 0)
+        which = onp.minimum(onp.arange(nbins) // merged,
+                            num_quantized_bins - 1)
+        q = onp.where(live, avg[which], 0.0)
+        kl = _kl_divergence(p, q)
+        if kl < best_kl:
+            best_kl, best_th = kl, float(edges[hi])
+    return best_th
 
 _QUANTIZABLE = {'Convolution': '_contrib_quantized_conv',
                 'FullyConnected': '_contrib_quantized_fully_connected'}
@@ -42,7 +102,9 @@ def calib_graph(sym, calib_data, arg_params, aux_params, layer_names,
     input (reference: quantization.py calibrate via monitor callbacks).
 
     calib_data: iterable of input NDArray batches (single-input nets).
-    Returns {layer name: (min, max)}.
+    calib_mode: 'naive' (global min/max), 'percentile' (symmetric
+    |x| quantile bound), or 'entropy' (KL-optimal threshold, reference
+    quantization.py:262). Returns {layer name: (min, max)}.
     """
     from ..symbol.symbol import Group
     from ..context import cpu
@@ -67,6 +129,8 @@ def calib_graph(sym, calib_data, arg_params, aux_params, layer_names,
             a = out.asnumpy()
             if calib_mode == 'percentile':
                 stats[name].append(onp.abs(a).ravel())
+            elif calib_mode == 'entropy':
+                stats[name].append(a.ravel())
             lo, hi = float(a.min()), float(a.max())
             ranges[name][0] = min(ranges[name][0], lo)
             ranges[name][1] = max(ranges[name][1], hi)
@@ -74,6 +138,10 @@ def calib_graph(sym, calib_data, arg_params, aux_params, layer_names,
         for name in order:
             flat = onp.concatenate(stats[name])
             bound = float(onp.quantile(flat, percentile))
+            ranges[name] = [-bound, bound]
+    elif calib_mode == 'entropy':
+        for name in order:
+            bound = optimal_threshold(onp.concatenate(stats[name]))
             ranges[name] = [-bound, bound]
     return {n: tuple(v) for n, v in ranges.items()}
 

@@ -239,3 +239,40 @@ def test_quantize_ops_direct():
     assert q.asnumpy().dtype == np.int8
     back = nd._contrib_dequantize(q, lo, hi)
     np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=0.02)
+
+
+def test_optimal_threshold_clips_outliers():
+    """KL threshold must land near the bulk of a long-tailed
+    distribution, well below the outlier max (the reason entropy mode
+    exists — reference quantization.py:262)."""
+    rs = np.random.RandomState(7)
+    bulk = rs.randn(200000).astype('float32')
+    outliers = np.array([40.0, -35.0, 55.0], 'float32')
+    stats = np.concatenate([bulk, outliers])
+    th = mx.contrib.quantization.optimal_threshold(stats)
+    assert 2.0 < th < 20.0, th
+    # near-uniform data has no outliers to clip: threshold ~= max
+    flat = rs.uniform(-1, 1, 100000).astype('float32')
+    th2 = mx.contrib.quantization.optimal_threshold(flat)
+    assert th2 > 0.9, th2
+    # degenerate all-zero input stays finite
+    assert mx.contrib.quantization.optimal_threshold(
+        np.zeros(10, 'float32')) > 0
+
+
+def test_quantize_entropy_calibration():
+    sym = _quant_net()
+    rs = np.random.RandomState(5)
+    x = rs.randn(4, 3, 16, 16).astype('float32')
+    ref, params = _ref_and_params(sym, x)
+    # a few huge activations in the calib set: naive calibration wastes
+    # the int8 range on them; entropy mode should stay accurate
+    x_spiky = x.copy()
+    x_spiky[0, 0, 0, 0] = 60.0
+    qsym, qargs, qaux = mx.contrib.quantization.quantize_model(
+        sym, params, {}, calib_data=[x, x_spiky], calib_mode='entropy')
+    ex = qsym.bind(mx.cpu(), args=dict(qargs, data=nd.array(x)),
+                   aux_states=qaux)
+    got = ex.forward()[0].asnumpy()
+    assert np.abs(got - ref).max() < 0.1
+    assert (got.argmax(1) == ref.argmax(1)).all()

@@ -1,0 +1,298 @@
+"""Fused convolution + batch-statistics kernel (Pallas TPU).
+
+The measured ResNet-50 train step is HBM-bound and ~22% of it is
+BatchNorm's statistics machinery (docs/PERF_NOTES.md): XLA fuses the
+normalize/scale/ReLU elementwise chain into neighbouring convs for free,
+but it will NOT fuse a cross-row reduction into a convolution's
+epilogue, so computing batch mean/var costs a full materialize + re-read
+of every conv output. This module closes that gap the TPU-native way: a
+Pallas matmul kernel whose epilogue accumulates per-channel sum and
+sum-of-squares while the conv output tile is still in VMEM.
+
+Reference analog: the conv+BN subgraph fusions in
+src/operator/subgraph/mkldnn/mkldnn_conv.cc (via subgraph_property.h:77)
+— same idea, executed as a hand-written accelerator kernel instead of a
+graph rewrite, because on TPU the *elementwise* side of the fusion is
+already handled by XLA.
+
+Surface: the registered op `_contrib_conv_bn_stats(data, weight[, bias])
+-> (out, sum, sumsq)` — a Convolution whose extra outputs are the
+per-channel Σy and Σy² over (N, H, W), reduced in f32 over the
+bf16-rounded output (exactly what a downstream BatchNorm would see).
+1x1 convolutions (stride 1 or 2) ride the Pallas kernel; every other
+shape falls back to lax.conv + an XLA reduction, which costs the same
+as the unfused graph — never more.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register
+
+__all__ = ['conv_bn_stats', 'matmul_stats']
+
+
+def _interpret():
+    # Mosaic needs a real TPU; elsewhere (CPU tests) the same kernel
+    # runs through the Pallas interpreter so the logic is exercised
+    return jax.default_backend() != 'tpu'
+
+
+def _pick_block(dim, candidates, full_below=None):
+    """Largest candidate tile evenly dividing dim. Mosaic requires lane
+    blocks to be multiples of 128 (sublane: 8) unless the block spans
+    the whole dimension — callers encode that in `candidates` and may
+    allow the full dimension for small sizes via `full_below`."""
+    if full_below is not None and dim <= full_below:
+        return dim
+    for c in candidates:
+        if c <= dim and dim % c == 0:
+            return c
+    return None
+
+
+def _matmul_stats_call(a, b, bias, bm, bn, bk, out_dtype):
+    """Y = A @ B + bias with per-column stats epilogue.
+
+    a: [M, K], b: [K, N], bias: [1, N] (zeros when absent).
+    Returns (y [M, N] out_dtype, s1 [1, N] f32, s2 [1, N] f32) where
+    s1/s2 reduce the out_dtype-rounded y over rows in f32.
+
+    Grid (m, n, k) with k innermost: when bk == K (every conv in the
+    resnet family ≤512 input channels hits this) the A tile is fetched
+    once per m-tile and reused across the whole n sweep. The epilogue
+    writes PARTIAL per-m-tile stats — (M/bm, N) — summed by one tiny
+    XLA reduction outside; keeping stats per (m, n) block frees the
+    grid from any cross-step output revisits, so both spatial axes are
+    declared parallel for the Mosaic pipeliner.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, K = a.shape
+    N = b.shape[1]
+    mt = M // bm
+    grid = (mt, N // bn, K // bk)
+
+    def kern(a_ref, b_ref, bias_ref, y_ref, s1_ref, s2_ref, acc_ref):
+        # grid queries hoisted out of pl.when bodies (the interpreter
+        # cannot substitute program_id inside a nested cond)
+        k_idx = pl.program_id(2)
+        k_last = pl.num_programs(2) - 1
+
+        @pl.when(k_idx == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        # f32 inputs take the 6-pass MXU path (correctness tier — the
+        # perf tier is bf16); bf16 runs at native precision
+        prec = 'highest' if a_ref.dtype == jnp.float32 else 'default'
+        acc_ref[:] += jnp.dot(a_ref[:], b_ref[:], precision=prec,
+                              preferred_element_type=jnp.float32)
+
+        @pl.when(k_idx == k_last)
+        def _epilogue():
+            acc = acc_ref[:] + bias_ref[:].astype(jnp.float32)
+            y_tile = acc.astype(out_dtype)
+            y_ref[:] = y_tile
+            # stats see the rounded output — identical numerics to a
+            # separate BatchNorm reading the conv result from HBM.
+            # Partial sums land in 8 sublane groups (the min tile
+            # height); the caller reduces the (mt, 8, N) partials.
+            yf = y_tile.astype(jnp.float32)
+            s1_ref[0] = jnp.sum(yf.reshape(8, bm // 8, bn), axis=1)
+            s2_ref[0] = jnp.sum((yf * yf).reshape(8, bm // 8, bn), axis=1)
+
+    y, p1, p2 = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
+            pl.BlockSpec((bk, bn), lambda m, n, k: (k, n)),
+            pl.BlockSpec((1, bn), lambda m, n, k: (0, n)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
+            pl.BlockSpec((1, 8, bn), lambda m, n, k: (m, 0, n)),
+            pl.BlockSpec((1, 8, bn), lambda m, n, k: (m, 0, n)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, N), out_dtype),
+            jax.ShapeDtypeStruct((mt, 8, N), jnp.float32),
+            jax.ShapeDtypeStruct((mt, 8, N), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=_interpret(),
+    )(a, b, bias)
+    return y, jnp.sum(p1, axis=(0, 1)).reshape(1, N), \
+        jnp.sum(p2, axis=(0, 1)).reshape(1, N)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def matmul_stats(a, b, bias, blocks):
+    """Differentiable A @ B + bias with per-column Σy / Σy² outputs.
+
+    blocks: static (bm, bn, bk, out_dtype_name). The backward pass is
+    hand-written (plain MXU matmuls) — cost-identical to the unfused
+    graph's conv backward, so the stats epilogue is pure fwd savings.
+    """
+    bm, bn, bk, dt = blocks
+    return _matmul_stats_call(a, b, bias, bm, bn, bk, jnp.dtype(dt))
+
+
+def _mm_fwd(a, b, bias, blocks):
+    y, s1, s2 = matmul_stats(a, b, bias, blocks)
+    return (y, s1, s2), (a, b, y)
+
+
+def _mm_bwd(blocks, res, cts):
+    a, b, y = res
+    dy, ds1, ds2 = cts
+    # y, s1, s2 all depend on the accumulator: total cotangent wrt the
+    # (rounded) output is dy + ds1 + 2*y*ds2 (ds broadcast over rows).
+    # Kept in the primal dtype — a f32 chain here would materialize a
+    # double-width [M, N] intermediate — and the dots contract without
+    # explicit transposes (an a.T materialization is a full HBM pass).
+    dy_tot = dy + ds1.astype(dy.dtype) + y * (2.0 * ds2).astype(dy.dtype)
+    da = jax.lax.dot_general(dy_tot, b, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    db = jax.lax.dot_general(a, dy_tot, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dbias = jnp.sum(dy_tot.astype(jnp.float32), axis=0, keepdims=True)
+    return da.astype(a.dtype), db.astype(b.dtype), dbias
+
+
+matmul_stats.defvjp(_mm_fwd, _mm_bwd)
+
+_BM_CANDS = (1024, 512, 448, 384, 256, 128, 64, 32, 16, 8)  # sublane: ×8
+_BN_CANDS = (256, 128)                                 # lane: ×128 or full
+_BK_CANDS = (512, 256, 128)
+
+
+def _conv_blocks(M, K, N):
+    return (_pick_block(M, _BM_CANDS),
+            _pick_block(N, _BN_CANDS, full_below=256),
+            _pick_block(K, _BK_CANDS, full_below=512))
+
+
+def _eligible_1x1(data, kernel, stride, pad, num_group, dilate):
+    if num_group != 1 or tuple(kernel) != (1, 1) or data.ndim != 4:
+        return False
+    if tuple(pad or (0, 0)) != (0, 0):
+        return False
+    if dilate and tuple(dilate) not in ((1, 1), ()):
+        return False
+    return tuple(stride or (1, 1)) in ((1, 1), (2, 2))
+
+
+@register('_contrib_conv_bn_stats', num_inputs=-1, num_outputs=3)
+def conv_bn_stats(args, *, kernel=None, stride=None, dilate=None, pad=None,
+                  num_filter=None, num_group=1, no_bias=True,
+                  workspace=1024, layout=None, cudnn_tune=None,
+                  cudnn_off=False):
+    """Convolution that also emits per-channel Σy and Σy² over (N,H,W).
+
+    Same attrs/inputs as Convolution. layout='NHWC' runs channels-last
+    end-to-end — the layout the Pallas kernel wants; callers that keep a
+    whole residual cell in NHWC avoid any transpose around the opaque
+    kernel boundary (XLA cannot commute transposes through a custom
+    call the way it does through its own convs). The stats are f32
+    reductions of the output as rounded to the output dtype, so
+    `mean = s1/M, var = s2/M - mean²` reproduce what BatchNorm computes
+    from the conv result. Weights stay OIHW in both layouts.
+    """
+    data, weight = args[0], args[1]
+    bias = None if no_bias or len(args) < 3 else args[2]
+    kernel = tuple(kernel or (1, 1))
+    stride = tuple(stride or (1,) * len(kernel))
+    pad = tuple(pad or (0,) * len(kernel))
+    nhwc = (layout == 'NHWC')
+
+    if data.ndim == 2:
+        # rows-by-channels input (a caller keeping a whole residual cell
+        # in flattened channels-last form): pure matmul + stats. Only a
+        # 1x1 stride-1 conv is expressible on 2-D data.
+        if kernel != (1, 1) or set(stride) != {1}:
+            raise ValueError('2-D conv_bn_stats input requires a 1x1 '
+                             'stride-1 convolution')
+        M, C = data.shape
+        O = weight.shape[0]
+        bm, bn_, bk = _conv_blocks(M, C, O)
+        if bm is None or bn_ is None or bk is None:
+            y = jnp.dot(data, weight.reshape(O, C).T.astype(data.dtype),
+                        preferred_element_type=data.dtype)
+            if bias is not None:
+                y = y + bias.astype(data.dtype)
+            yf = y.astype(jnp.float32)
+            return y, jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)
+        w2d = weight.reshape(O, C).T.astype(data.dtype)
+        b2d = jnp.zeros((1, O), jnp.float32) if bias is None \
+            else bias.reshape(1, O).astype(jnp.float32)
+        blocks = (bm, bn_, bk, jnp.dtype(data.dtype).name)
+        y2d, s1, s2 = matmul_stats(data, w2d, b2d, blocks)
+        return y2d, s1.reshape(O), s2.reshape(O)
+
+    if _eligible_1x1(data, kernel, stride, pad, num_group, dilate):
+        # slice into a separate name: if the tile pick below fails, the
+        # general fallback must see the ORIGINAL data (re-applying the
+        # stride there would silently double-downsample)
+        if tuple(stride) == (2, 2):
+            decim = data[:, ::2, ::2, :] if nhwc else data[:, :, ::2, ::2]
+        else:
+            decim = data
+        if nhwc:
+            B, H, W, C = decim.shape
+        else:
+            B, C, H, W = decim.shape
+        O = weight.shape[0]
+        bm, bn_, bk = _conv_blocks(B * H * W, C, O)
+        if bm is not None and bn_ is not None and bk is not None:
+            if nhwc:
+                a2d = decim.reshape(B * H * W, C)      # free: contiguous
+            else:
+                a2d = jnp.transpose(decim, (0, 2, 3, 1)).reshape(
+                    B * H * W, C)
+            w2d = weight.reshape(O, C).T.astype(data.dtype)
+            b2d = jnp.zeros((1, O), jnp.float32) if bias is None \
+                else bias.reshape(1, O).astype(jnp.float32)
+            blocks = (bm, bn_, bk, jnp.dtype(data.dtype).name)
+            y2d, s1, s2 = matmul_stats(a2d, w2d, b2d, blocks)
+            y4d = y2d.reshape(B, H, W, O)
+            y = y4d if nhwc else jnp.transpose(y4d, (0, 3, 1, 2))
+            return y, s1.reshape(O), s2.reshape(O)
+
+    # general shapes: lax conv + XLA reduction (unfused-graph cost).
+    # NHWC callers get a native channels-last lax conv — introducing a
+    # transpose here would undo the caller's layout discipline.
+    if nhwc and data.ndim == 4 and num_group == 1:
+        pads = tuple((p, p) for p in pad)
+        rhs_dil = tuple(dilate) if dilate else (1,) * len(kernel)
+        w_hwio = jnp.transpose(weight, (2, 3, 1, 0)).astype(data.dtype)
+        y = jax.lax.conv_general_dilated(
+            data, w_hwio, stride, pads, rhs_dilation=rhs_dil,
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+            preferred_element_type=data.dtype)
+        if bias is not None:
+            y = y + bias.astype(data.dtype)
+        yf = y.astype(jnp.float32)
+        return y, jnp.sum(yf, axis=(0, 1, 2)), \
+            jnp.sum(yf * yf, axis=(0, 1, 2))
+    from .nn import convolution
+    if nhwc:
+        args = [jnp.transpose(data, (0, 3, 1, 2))] + list(args[1:])
+    y = convolution(args, kernel=kernel, stride=stride, dilate=dilate,
+                    pad=pad, num_filter=num_filter, num_group=num_group,
+                    no_bias=no_bias, workspace=workspace,
+                    cudnn_tune=cudnn_tune, cudnn_off=cudnn_off)
+    yf = y.astype(jnp.float32)
+    red = (0,) + tuple(range(2, y.ndim))
+    s1, s2 = jnp.sum(yf, axis=red), jnp.sum(yf * yf, axis=red)
+    if nhwc:
+        y = jnp.transpose(y, (0, 2, 3, 1))
+    return y, s1, s2

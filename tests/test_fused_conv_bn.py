@@ -1,0 +1,213 @@
+"""Conv+BN stats-epilogue fusion (ops/fused_conv_bn.py, gluon/fused.py,
+MXNET_FUSE_CONV_BN): kernel correctness (Pallas interpreter on CPU),
+custom-vjp gradients, layer-pair and residual-cell parity against the
+unfused graph, aux running-stat updates. Perf context in
+docs/PERF_NOTES.md "Conv+BN fusion"."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn, fused
+
+
+@pytest.fixture
+def fuse_on(monkeypatch):
+    monkeypatch.setenv('MXNET_FUSE_CONV_BN', '1')
+
+
+def test_matmul_stats_kernel_values():
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.fused_conv_bn import _matmul_stats_call
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.randn(64, 16).astype('float32'))
+    b = jnp.asarray(rs.randn(16, 8).astype('float32'))
+    bias = jnp.asarray(rs.randn(1, 8).astype('float32'))
+    y, s1, s2 = _matmul_stats_call(a, b, bias, 16, 8, 16,
+                                   jnp.dtype('float32'))
+    ref = np.asarray(a) @ np.asarray(b) + np.asarray(bias)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1)[0], ref.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(s2)[0], (ref ** 2).sum(0),
+                               rtol=1e-5)
+
+
+def test_matmul_stats_custom_vjp():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.fused_conv_bn import matmul_stats
+    rs = np.random.RandomState(1)
+    a = jnp.asarray(rs.randn(32, 16).astype('float32'))
+    b = jnp.asarray(rs.randn(16, 8).astype('float32'))
+    bias = jnp.asarray(rs.randn(1, 8).astype('float32'))
+    blocks = (8, 8, 16, 'float32')
+
+    def f_fused(a, b, bias):
+        y, s1, s2 = matmul_stats(a, b, bias, blocks)
+        return jnp.sin(y).sum() + 2 * s1.sum() + 0.5 * s2.sum()
+
+    def f_ref(a, b, bias):
+        y = a @ b + bias
+        return jnp.sin(y).sum() + 2 * y.sum() + 0.5 * (y * y).sum()
+
+    g1 = jax.grad(f_fused, argnums=(0, 1, 2))(a, b, bias)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(a, b, bias)
+    for got, want in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_conv_bn_stats_op_matches_convolution():
+    rs = np.random.RandomState(2)
+    x = nd.array(rs.randn(4, 64, 8, 8).astype('float32'))
+    w = nd.array(rs.randn(128, 64, 1, 1).astype('float32'))
+    y, s1, s2 = nd._contrib_conv_bn_stats(
+        x, w, kernel=(1, 1), stride=(1, 1), pad=(0, 0), num_filter=128,
+        no_bias=True)
+    ref = nd.Convolution(x, w, kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                         num_filter=128, no_bias=True).asnumpy()
+    np.testing.assert_allclose(y.asnumpy(), ref, atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(s1.asnumpy(), ref.sum(axis=(0, 2, 3)),
+                               rtol=2e-4)
+    np.testing.assert_allclose(s2.asnumpy(), (ref ** 2).sum(axis=(0, 2, 3)),
+                               rtol=2e-4)
+    # stride-2 eligible path and 3x3 fallback
+    y2 = nd._contrib_conv_bn_stats(x, w, kernel=(1, 1), stride=(2, 2),
+                                   pad=(0, 0), num_filter=128,
+                                   no_bias=True)[0]
+    ref2 = nd.Convolution(x, w, kernel=(1, 1), stride=(2, 2), pad=(0, 0),
+                          num_filter=128, no_bias=True)
+    np.testing.assert_allclose(y2.asnumpy(), ref2.asnumpy(), atol=2e-4,
+                               rtol=2e-4)
+    w3 = nd.array(rs.randn(32, 64, 3, 3).astype('float32'))
+    y3 = nd._contrib_conv_bn_stats(x, w3, kernel=(3, 3), stride=(1, 1),
+                                   pad=(1, 1), num_filter=32,
+                                   no_bias=True)[0]
+    ref3 = nd.Convolution(x, w3, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                          num_filter=32, no_bias=True)
+    np.testing.assert_allclose(y3.asnumpy(), ref3.asnumpy(), atol=1e-3,
+                               rtol=1e-3)
+
+
+def test_fused_layer_pair_matches_unfused(fuse_on):
+    rs = np.random.RandomState(3)
+    conv = nn.Conv2D(64, 1, use_bias=True, in_channels=64)
+    bn = nn.BatchNorm(in_channels=64)
+    conv.initialize(mx.init.Xavier())
+    bn.initialize()
+    x = nd.array(rs.randn(2, 64, 8, 8).astype('float32'))
+    with autograd.record():
+        out_f = fused.fused_conv_bn_act(x, conv, bn, relu=True)
+    with autograd.record():
+        out_r = nn.Activation('relu')(bn(conv(x)))
+    np.testing.assert_allclose(out_f.asnumpy(), out_r.asnumpy(),
+                               atol=5e-5, rtol=5e-5)
+    # eval mode uses running stats in both paths
+    out_fe = fused.fused_conv_bn_act(x, conv, bn, relu=True)
+    out_re = nn.Activation('relu')(bn(conv(x)))
+    np.testing.assert_allclose(out_fe.asnumpy(), out_re.asnumpy(),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_fused_bottleneck_cell_matches_unfused(monkeypatch):
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BottleneckV1
+    np.random.seed(0)
+    mx.random.seed(0)
+    cell = BottleneckV1(256, 2, True, in_channels=64)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(3).randn(2, 64, 8, 8)
+                 .astype('float32'))
+    monkeypatch.setenv('MXNET_FUSE_CONV_BN', '0')
+    with autograd.record():
+        ref = cell(x)
+    monkeypatch.setenv('MXNET_FUSE_CONV_BN', '1')
+    with autograd.record():
+        got = cell(x)
+    np.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_fused_updates_running_stats(fuse_on):
+    rs = np.random.RandomState(4)
+    conv = nn.Conv2D(8, 1, use_bias=False, in_channels=8)
+    bn = nn.BatchNorm(in_channels=8, momentum=0.8)
+    conv.initialize(mx.init.Xavier())
+    bn.initialize()
+    x = nd.array(rs.randn(4, 8, 4, 4).astype('float32'))
+    with autograd.record():
+        fused.fused_conv_bn_act(x, conv, bn)
+    y = nd.Convolution(x, conv.weight.data(), kernel=(1, 1), stride=(1, 1),
+                       pad=(0, 0), num_filter=8, no_bias=True).asnumpy()
+    want_m = 0.2 * y.mean(axis=(0, 2, 3))
+    want_v = 0.8 * 1.0 + 0.2 * y.var(axis=(0, 2, 3))
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(), want_m,
+                               atol=1e-5)
+    np.testing.assert_allclose(bn.running_var.data().asnumpy(), want_v,
+                               atol=1e-5)
+
+
+@pytest.mark.slow
+def test_fused_resnet_trains(fuse_on):
+    """Loss decreases over a few fused train steps (the gradient path
+    through the custom vjp is sane end-to-end)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import model_zoo
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = model_zoo.vision.resnet18_v1()
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), 'sgd',
+                       {'learning_rate': 0.05})
+    rs = np.random.RandomState(5)
+    x = nd.array(rs.randn(8, 3, 32, 32).astype('float32'))
+    y = nd.array(rs.randint(0, 10, (8,)).astype('float32'))
+    losses = []
+    for _ in range(6):
+        with autograd.record():
+            loss = L(net(x), y).mean()
+        loss.backward()
+        tr.step(8)
+        losses.append(float(loss.asscalar()))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_fused_cell_non_tile_divisible_geometry(monkeypatch):
+    """Stage-4 ImageNet geometry at tiny batch: the post-slice row count
+    (2*7*7=98) defeats every tile candidate, forcing the general
+    fallback — which must NOT re-apply the stride to already-sliced
+    data (round-4 review finding)."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BottleneckV1
+    np.random.seed(0)
+    mx.random.seed(0)
+    cell = BottleneckV1(2048, 2, True, in_channels=1024)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(9).randn(2, 1024, 14, 14)
+                 .astype('float32') * 0.1)
+    monkeypatch.setenv('MXNET_FUSE_CONV_BN', '0')
+    with autograd.record():
+        ref = cell(x)
+    monkeypatch.setenv('MXNET_FUSE_CONV_BN', '1')
+    with autograd.record():
+        got = cell(x)
+    assert got.shape == ref.shape == (2, 2048, 7, 7)
+    np.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), atol=1e-4,
+                               rtol=1e-4)
+
+
+def test_fused_padded_1x1_not_misrouted(fuse_on):
+    """A padded 1x1 conv cannot take the flattened-matmul path; its
+    padding must survive (round-4 review finding)."""
+    rs = np.random.RandomState(6)
+    conv = nn.Conv2D(8, 1, padding=1, use_bias=False, in_channels=4)
+    bn = nn.BatchNorm(in_channels=8)
+    conv.initialize(mx.init.Xavier())
+    bn.initialize()
+    x = nd.array(rs.randn(2, 4, 5, 5).astype('float32'))
+    with autograd.record():
+        got = fused.fused_conv_bn_act(x, conv, bn)
+    with autograd.record():
+        ref = bn(conv(x))
+    assert got.shape == ref.shape == (2, 8, 7, 7)
+    np.testing.assert_allclose(got.asnumpy(), ref.asnumpy(), atol=5e-5,
+                               rtol=5e-5)

@@ -90,19 +90,19 @@ def symbol_from_json(json_str):
 
 
 def symbol_to_json(sym):
-    return sym.tojson()
+    return _sym(sym).tojson()
 
 
 def symbol_list_arguments(sym):
-    return list(sym.list_arguments())
+    return list(_sym(sym).list_arguments())
 
 
 def symbol_list_outputs(sym):
-    return list(sym.list_outputs())
+    return list(_sym(sym).list_outputs())
 
 
 def symbol_list_aux(sym):
-    return list(sym.list_auxiliary_states())
+    return list(_sym(sym).list_auxiliary_states())
 
 
 # -- KVStore ---------------------------------------------------------------
@@ -136,3 +136,668 @@ def profiler_set_state(state_code):
 def profiler_dumps(reset):
     from .. import profiler
     return profiler.dumps(reset=bool(reset))
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth: imperative invoke, autograd, executor, symbol
+# manipulation, data iterators, cached ops, recordio, profiler objects
+# (reference: src/c_api/c_api_ndarray.cc, c_api_executor.cc,
+# c_api_symbolic.cc, c_api.cc MXDataIter*/MXRecordIO*)
+# ---------------------------------------------------------------------------
+
+def _parse_vals(keys, vals):
+    """Coerce C string params the way reference op setters do."""
+    from ..symbol.symbol import _parse_attr
+    return {k: _parse_attr(v) for k, v in zip(keys, vals)}
+
+
+# -- NDArray breadth --------------------------------------------------------
+
+def ndarray_create_none():
+    from .. import nd
+    return nd.zeros((1,))
+
+
+def ndarray_slice(arr, start, stop):
+    return arr[int(start):int(stop)]
+
+
+def ndarray_at(arr, idx):
+    return arr[int(idx)]
+
+
+def ndarray_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def ndarray_context(arr):
+    ctx = arr.context
+    return int(ctx.device_typeid), int(ctx.device_id)
+
+
+def ndarray_storage_type(arr):
+    st = getattr(arr, 'stype', 'default')
+    return {'default': 1, 'row_sparse': 2, 'csr': 3}.get(st, 1)
+
+
+def ndarray_wait_to_read(arr):
+    arr.wait_to_read()
+
+
+def ndarray_detach(arr):
+    return arr.detach()
+
+
+def ndarray_get_grad(arr):
+    g = arr.grad() if callable(getattr(arr, 'grad', None)) else arr.grad
+    if g is None:
+        raise ValueError('array has no gradient attached')
+    return g
+
+
+def ndarray_set_grad_state(arr, state):
+    arr._grad_req = 'write' if int(state) else 'null'
+
+
+def ndarray_get_grad_state(arr):
+    return 1 if getattr(arr, '_grad_req', 'null') != 'null' else 0
+
+
+def ndarray_save_raw_bytes(arr):
+    from ..ndarray.ndarray import _mx_save_one
+    import io as _io
+    f = _io.BytesIO()
+    _mx_save_one(f, arr)
+    return f.getvalue()
+
+
+def ndarray_load_from_raw_bytes(buf):
+    from ..ndarray.ndarray import _mx_load_one
+    import io as _io
+    return _mx_load_one(_io.BytesIO(bytes(buf)))
+
+
+def ndarray_load_from_buffer(buf):
+    """In-memory .params container (reference MXNDArrayLoadFromBuffer)."""
+    import io as _io
+    import tempfile, os
+    from .. import nd
+    with tempfile.NamedTemporaryFile(delete=False) as f:
+        f.write(bytes(buf))
+        path = f.name
+    try:
+        loaded = nd.load(path)
+    finally:
+        os.unlink(path)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return [loaded[k] for k in names], names
+    return list(loaded), []
+
+
+def ndarray_copy_from_ndarray(dst, src):
+    src.copyto(dst)
+    dst.wait_to_read()
+
+
+def ndarray_check_format(arr, full_check):
+    if hasattr(arr, 'check_format'):
+        arr.check_format(bool(full_check))
+
+
+# -- op registry / imperative invoke ---------------------------------------
+
+def list_all_op_names():
+    from ..ops import registry
+    return sorted(registry.OPS.keys())
+
+
+def imperative_invoke(op_name, nd_inputs, param_keys, param_vals,
+                      outputs):
+    """MXImperativeInvoke(Ex): run a registered op on NDArrays
+    (reference: c_api_ndarray.cc:132). With ``outputs`` (the caller's
+    in-place mode), results are written into the given arrays and the
+    empty list tells the C side to keep its own handles."""
+    from .. import nd
+    fn = getattr(nd, op_name)
+    kwargs = _parse_vals(param_keys, param_vals)
+    out = fn(*nd_inputs, **kwargs)
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    if outputs:
+        if len(outputs) != len(outs):
+            raise ValueError(
+                'MXImperativeInvoke: op %s produces %d outputs but the '
+                'caller supplied %d' % (op_name, len(outs), len(outputs)))
+        for dst, src in zip(outputs, outs):
+            src.copyto(dst)
+        return []
+    return outs
+
+
+# -- autograd ---------------------------------------------------------------
+
+def autograd_set_recording(flag):
+    from .. import autograd
+    return 1 if autograd.set_recording(bool(flag)) else 0
+
+
+def autograd_set_training(flag):
+    from .. import autograd
+    return 1 if autograd.set_training(bool(flag)) else 0
+
+
+def autograd_is_recording():
+    from .. import autograd
+    return 1 if autograd.is_recording() else 0
+
+
+def autograd_is_training():
+    from .. import autograd
+    return 1 if autograd.is_training() else 0
+
+
+def autograd_mark_variables(variables, grad_reqs, gradients):
+    from .. import autograd
+    reqs = {1: 'write', 2: 'add', 0: 'null'}
+    autograd.mark_variables(list(variables),
+                            list(gradients),
+                            [reqs.get(int(r), 'write') for r in grad_reqs])
+
+
+def autograd_backward(outputs, out_grads, retain_graph, train_mode):
+    from .. import autograd
+    ograds = None
+    if out_grads:
+        ograds = [g for g in out_grads]
+    autograd.backward(list(outputs), head_grads=ograds,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+# -- symbol breadth ---------------------------------------------------------
+
+class SymHandle:
+    """C-side symbol handle: compose mutates in place (reference
+    MXSymbolCompose semantics), so the handle wraps the Symbol."""
+
+    __slots__ = ('sym', 'pending_op', 'pending_attrs')
+
+    def __init__(self, sym=None, pending_op=None, pending_attrs=None):
+        self.sym = sym
+        self.pending_op = pending_op
+        self.pending_attrs = pending_attrs or {}
+
+
+def _sym(h):
+    if isinstance(h, SymHandle):
+        if h.sym is None:
+            raise ValueError('atomic symbol %r has not been composed yet'
+                             % (h.pending_op,))
+        return h.sym
+    return h
+
+
+def symbol_create_variable(name):
+    from .. import symbol
+    return SymHandle(symbol.Variable(name))
+
+
+def symbol_create_atomic(op_name, param_keys, param_vals):
+    return SymHandle(None, pending_op=op_name,
+                     pending_attrs=_parse_vals(param_keys, param_vals))
+
+
+def symbol_compose(handle, name, arg_syms):
+    from ..symbol.symbol import _create
+    args = [_sym(s) for s in arg_syms]
+    if isinstance(handle, SymHandle) and handle.pending_op is not None:
+        handle.sym = _create(handle.pending_op, args,
+                             dict(handle.pending_attrs),
+                             name=name or None)
+        handle.pending_op = None
+    elif not args:
+        pass       # composing with no args is a no-op on a built symbol
+    else:
+        raise ValueError('MXSymbolCompose on an already-composed symbol')
+
+
+def symbol_copy(h):
+    import copy
+    return SymHandle(copy.deepcopy(_sym(h)))
+
+
+def symbol_print(h):
+    return _sym(h).debug_str()
+
+
+def symbol_get_name(h):
+    s = _sym(h)
+    if len(s._entries) != 1:
+        return None
+    return s._entries[0][0].name
+
+
+def symbol_get_attr(h, key):
+    v = _sym(h).attr(key)
+    return None if v is None else str(v)
+
+
+def symbol_set_attr(h, key, value):
+    s = _sym(h)
+    node = s._entries[0][0]
+    node._extra_attrs = dict(getattr(node, '_extra_attrs', {}) or {})
+    node._extra_attrs[key] = value
+
+
+def symbol_list_attr(h, shallow):
+    """Flat k/v pairs (reference returns name-prefixed deep attrs)."""
+    s = _sym(h)
+    out = []
+    if shallow:
+        node = s._entries[0][0]
+        for k, v in (getattr(node, '_extra_attrs', {}) or {}).items():
+            out += [str(k), str(v)]
+        return out
+    for name, kv in sorted(s.attr_dict().items()):
+        for k, v in sorted(kv.items()):
+            out += ['%s$%s' % (name, k), str(v)]
+    return out
+
+
+def symbol_get_internals(h):
+    return SymHandle(_sym(h).get_internals())
+
+
+def symbol_get_output(h, index):
+    return SymHandle(_sym(h)[int(index)])
+
+
+def symbol_get_num_outputs(h):
+    return len(_sym(h).list_outputs())
+
+
+def symbol_create_group(handles):
+    from .. import symbol
+    return SymHandle(symbol.Group([_sym(h) for h in handles]))
+
+
+def symbol_from_file(fname):
+    from .. import symbol
+    return SymHandle(symbol.load(fname))
+
+
+def symbol_to_file(h, fname):
+    _sym(h).save(fname)
+
+
+def symbol_infer_shape(h, keys, ind_ptr, shape_data, partial):
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete)."""
+    s = _sym(h)
+    kwargs = {}
+    for i, k in enumerate(keys):
+        dims = shape_data[ind_ptr[i]:ind_ptr[i + 1]]
+        kwargs[k] = tuple(int(d) for d in dims)
+    fn = s.infer_shape_partial if partial else s.infer_shape
+    arg, out, aux = fn(**kwargs)
+    complete = arg is not None and all(x is not None for x in (arg or []))
+    def norm(lst):
+        return [list(int(d) for d in t) if t is not None else []
+                for t in (lst or [])]
+    return norm(arg), norm(out), norm(aux), 1 if complete else 0
+
+
+def symbol_infer_type(h, keys, type_codes, partial):
+    s = _sym(h)
+    kwargs = {k: _DTYPE_BY_CODE[int(c)] for k, c in zip(keys, type_codes)}
+    try:
+        arg, out, aux = s.infer_type(**kwargs)
+    except Exception:
+        if not partial:
+            raise
+        arg = out = aux = None
+    def codes(lst):
+        return [(_CODE_BY_DTYPE[np.dtype(t).name] if t is not None else -1)
+                for t in (lst or [])]
+    complete = arg is not None
+    return codes(arg), codes(out), codes(aux), 1 if complete else 0
+
+
+# atomic-creator registry: handles are interned op-name strings kept
+# alive for the process lifetime
+_creator_names = None
+
+
+def list_atomic_creators():
+    global _creator_names
+    if _creator_names is None:
+        _creator_names = list_all_op_names()
+    return _creator_names
+
+
+def atomic_creator_name(name):
+    return str(name)
+
+
+def atomic_creator_info(name):
+    from ..ops import registry
+    op = registry.OPS[str(name)]
+    doc = (op.fn.__doc__ or '').strip()
+    kvna = op.key_var_num_args or ''
+    return str(name), doc, kvna
+
+
+# -- executor ---------------------------------------------------------------
+
+def executor_bind(h, dev_type, dev_id, in_args, arg_grads, grad_req_codes,
+                  aux_states):
+    sym = _sym(h)
+    reqs = {0: 'null', 1: 'write', 2: 'add', 3: 'inplace'}
+    names = sym.list_arguments()
+    grad_req = {n: reqs.get(int(c), 'write')
+                for n, c in zip(names, grad_req_codes)}
+    args_grad = {n: g for n, g in zip(names, arg_grads) if g is not None}
+    from ..executor import Executor
+    return Executor(sym, ctx=_ctx(dev_type, dev_id),
+                    args=list(in_args), args_grad=args_grad or None,
+                    grad_req=grad_req, aux_states=list(aux_states))
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, out_grads):
+    ex.backward(out_grads=list(out_grads) if out_grads else None)
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def executor_print(ex):
+    return ex.debug_str()
+
+
+# -- cached op --------------------------------------------------------------
+
+class CachedOpHandle:
+    """MXCreateCachedOp analog: a symbol plus a shape-keyed executor
+    cache; invoke() feeds inputs in list_arguments order
+    (reference: c_api_ndarray.cc:192 MXInvokeCachedOp)."""
+
+    def __init__(self, sym, flags=None):
+        self.sym = sym
+        self.flags = dict(flags or {})
+        self._execs = {}
+
+    def invoke(self, inputs):
+        names = self.sym.list_arguments()
+        if len(inputs) != len(names):
+            raise ValueError('CachedOp expects %d inputs (%s), got %d'
+                             % (len(names), names, len(inputs)))
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
+        ex = self._execs.get(key)
+        if ex is None:
+            from ..executor import Executor
+            from ..context import current_context
+            ex = Executor(self.sym, ctx=current_context(),
+                          args=list(inputs), grad_req='null')
+            self._execs[key] = ex
+        else:
+            for n, a in zip(names, inputs):
+                ex.arg_dict[n] = a
+        ex.forward(is_train=False)
+        return list(ex.outputs)
+
+
+def cached_op_create(h, flag_keys, flag_vals):
+    return CachedOpHandle(_sym(h), _parse_vals(flag_keys, flag_vals))
+
+
+def cached_op_invoke(cop, inputs):
+    return cop.invoke(list(inputs))
+
+
+# -- data iterators ---------------------------------------------------------
+
+def _iter_registry():
+    from .. import io as io_mod
+    return {
+        'MNISTIter': io_mod.MNISTIter,
+        'ImageRecordIter': io_mod.ImageRecordIter,
+        'CSVIter': io_mod.CSVIter,
+        'LibSVMIter': io_mod.LibSVMIter,
+    }
+
+
+def list_data_iters():
+    return sorted(_iter_registry().keys())
+
+
+def data_iter_info(name):
+    cls = _iter_registry()[str(name)]
+    return str(name), (cls.__doc__ or '').strip()
+
+
+class IterHandle:
+    __slots__ = ('it', 'batch')
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def data_iter_create(name, param_keys, param_vals):
+    cls = _iter_registry()[str(name)]
+    kwargs = _parse_vals(param_keys, param_vals)
+    return IterHandle(cls(**kwargs))
+
+
+def data_iter_next(ih):
+    try:
+        ih.batch = next(ih.it)
+        return 1
+    except StopIteration:
+        ih.batch = None
+        return 0
+
+
+def data_iter_before_first(ih):
+    ih.it.reset()
+    ih.batch = None
+
+
+def _batch(ih):
+    if ih.batch is None:
+        raise ValueError('no current batch: call MXDataIterNext first')
+    return ih.batch
+
+
+def data_iter_data(ih):
+    return _batch(ih).data[0]
+
+
+def data_iter_label(ih):
+    b = _batch(ih)
+    if not b.label:
+        raise ValueError('batch has no label')
+    return b.label[0]
+
+
+def data_iter_pad(ih):
+    return int(_batch(ih).pad or 0)
+
+
+def data_iter_index(ih):
+    b = _batch(ih)
+    idx = getattr(b, 'index', None)
+    if idx is None:
+        return []
+    return [int(i) for i in idx]
+
+
+# -- kvstore breadth --------------------------------------------------------
+
+def kvstore_type(kv):
+    return kv.type
+
+
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_barrier(kv):
+    if hasattr(kv, '_barrier'):
+        kv._barrier()
+
+
+def kvstore_init_str(kv, keys, arrays):
+    kv.init(list(keys), list(arrays))
+
+
+def kvstore_push_str(kv, keys, arrays):
+    kv.push(list(keys), list(arrays))
+
+
+def kvstore_pull_str(kv, keys, arrays):
+    kv.pull(list(keys), out=list(arrays))
+    for a in arrays:
+        a.wait_to_read()
+
+
+def kvstore_set_gradient_compression(kv, keys, vals):
+    kv.set_gradient_compression(_parse_vals(keys, vals))
+
+
+# -- recordio ---------------------------------------------------------------
+
+def recordio_writer_create(path):
+    from ..recordio import MXRecordIO
+    return MXRecordIO(path, 'w')
+
+
+def recordio_reader_create(path):
+    from ..recordio import MXRecordIO
+    return MXRecordIO(path, 'r')
+
+
+def recordio_close(rec):
+    rec.close()
+
+
+def recordio_write(rec, buf):
+    rec.write(bytes(buf))
+
+
+def recordio_read(rec):
+    return rec.read()          # None at EOF -> C returns size 0
+
+
+def recordio_tell(rec):
+    return int(rec.tell())
+
+
+def recordio_seek(rec, pos):
+    if int(pos) == 0:
+        rec.reset()
+    else:
+        rec.handle.seek(int(pos))
+
+
+# -- profiler objects -------------------------------------------------------
+
+def profiler_set_config(keys, vals):
+    from .. import profiler
+    profiler.set_config(**_parse_vals(keys, vals))
+
+
+def profiler_dump(finished):
+    from .. import profiler
+    profiler.dump(finished=bool(finished))
+
+
+def profiler_pause():
+    from .. import profiler
+    profiler.pause()
+
+
+def profiler_resume():
+    from .. import profiler
+    profiler.resume()
+
+
+class _CDomain:
+    __slots__ = ('name',)
+
+    def __init__(self, name):
+        self.name = str(name)
+
+
+def profile_create_domain(name):
+    return _CDomain(name)
+
+
+def profile_create_task(domain, name):
+    from .. import profiler
+    return profiler.Task(domain, str(name))
+
+
+def profile_create_frame(domain, name):
+    from .. import profiler
+    return profiler.Frame(domain, str(name))
+
+
+def profile_create_event(name):
+    from .. import profiler
+    return profiler.Event(str(name))
+
+
+def profile_create_counter(domain, name):
+    from .. import profiler
+    return profiler.Counter(domain, str(name))
+
+
+def profile_duration_start(obj):
+    obj.start()
+
+
+def profile_duration_stop(obj):
+    obj.stop()
+
+
+def profile_set_counter(counter, value):
+    counter.set_value(int(value))
+
+
+def profile_adjust_counter(counter, delta):
+    counter.increment(int(delta))
+
+
+def profile_set_marker(domain, name, scope_kind):
+    from .. import profiler
+    profiler.Marker(domain, str(name)).mark(str(scope_kind or 'process'))
+
+
+# -- misc -------------------------------------------------------------------
+
+def random_seed(seed):
+    from .. import random as rnd
+    rnd.seed(int(seed))
+
+
+def num_gpus():
+    from .. import context
+    return int(context.num_gpus())
+
+
+def libinfo_features():
+    """Returns [name, enabled] pairs flattened."""
+    from ..runtime import feature_list
+    out = []
+    for f in feature_list():
+        out += [str(f.name), 1 if f.enabled else 0]
+    return out

@@ -24,7 +24,7 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           '_build')
 _SO = os.path.join(_BUILD_DIR, 'libmxcapi.so')
-_ABI = 3
+_ABI = 4
 
 
 def _bind(path):

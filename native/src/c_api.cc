@@ -78,6 +78,12 @@ struct ReturnStore {
   std::vector<const char*> cstrs;
   std::vector<void*> handles;
   std::string text;
+  // shape-inference triple-pointer backing (in/out/aux groups)
+  std::vector<std::vector<unsigned>> sbufs;
+  std::vector<unsigned> ndims[3];
+  std::vector<const unsigned*> sptrs[3];
+  std::vector<unsigned long long> idx64;
+  std::vector<int> ints;
 };
 thread_local ReturnStore g_ret;
 
@@ -120,7 +126,9 @@ PyObject* bridge() {
 
 struct Gil {
   PyGILState_STATE st;
-  Gil() { st = PyGILState_Ensure(); }
+  // every entry point may be the process's first call: initialize the
+  // embedded interpreter before touching the GIL (idempotent)
+  Gil() { ensure_python(); st = PyGILState_Ensure(); }
   ~Gil() { PyGILState_Release(st); }
 };
 
@@ -151,11 +159,136 @@ int fill_strings(PyObject* list, unsigned* out_size,
   return 0;
 }
 
+
+PyObject* make_str_list(unsigned n, const char* const* arr) {
+  PyObject* lst = PyList_New(n);
+  for (unsigned i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyUnicode_FromString(arr && arr[i] ? arr[i] : ""));
+  return lst;
+}
+
+PyObject* make_handle_list(unsigned n, void* const* arr) {
+  PyObject* lst = PyList_New(n);
+  for (unsigned i = 0; i < n; ++i) {
+    PyObject* o = arr && arr[i] ? reinterpret_cast<PyObject*>(arr[i])
+                                : Py_None;
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  return lst;
+}
+
+PyObject* make_uint_list(unsigned n, const unsigned* arr) {
+  PyObject* lst = PyList_New(n);
+  for (unsigned i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyLong_FromUnsignedLong(arr ? arr[i] : 0));
+  return lst;
+}
+
+// run bridge fn, discard result; 0/-1 status
+int simple(const char* fn, PyObject* args) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call(fn, args);
+  Py_XDECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int out_handle(const char* fn, PyObject* args, void** out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call(fn, args);
+  Py_XDECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = r;  // ownership -> handle
+  return 0;
+}
+
+int out_text(const char* fn, PyObject* args, const char** out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call(fn, args);
+  Py_XDECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  if (r == Py_None) {
+    g_ret.text.clear();
+    *out = nullptr;
+  } else {
+    const char* c = PyUnicode_AsUTF8(r);
+    g_ret.text = c ? c : "";
+    *out = g_ret.text.c_str();
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int out_long(const char* fn, PyObject* args, long* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* r = call(fn, args);
+  Py_XDECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int out_handle_list(const char* fn, PyObject* args, int* num_out,
+                    void*** outs) {
+  ensure_python();
+  Gil gil;
+  PyObject* lst = call(fn, args);
+  Py_XDECREF(args);
+  if (!lst) { set_error_from_python(); return -1; }
+  g_ret.handles.clear();
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(lst, i);
+    Py_INCREF(o);
+    g_ret.handles.push_back(o);
+  }
+  Py_DECREF(lst);
+  *num_out = static_cast<int>(n);
+  *outs = g_ret.handles.data();
+  return 0;
+}
+
+int out_str_list(const char* fn, PyObject* args, unsigned* out_size,
+                 const char*** out_array) {
+  ensure_python();
+  Gil gil;
+  PyObject* lst = call(fn, args);
+  Py_XDECREF(args);
+  if (!lst) { set_error_from_python(); return -1; }
+  int rc = fill_strings(lst, out_size, out_array);
+  Py_DECREF(lst);
+  if (rc) set_error_from_python();
+  return rc;
+}
+
+// kept alive forever: atomic-creator / data-iter creator handles.
+// Returns the cached list (borrowed; owned by the cache dict).
+PyObject* creator_list(const char* fn) {
+  static PyObject* cache = nullptr;  // dict: fn -> list
+  if (!cache) cache = PyDict_New();
+  PyObject* lst = PyDict_GetItemString(cache, fn);
+  if (!lst) {
+    lst = call(fn, nullptr);
+    if (!lst) return nullptr;
+    PyDict_SetItemString(cache, fn, lst);
+    Py_DECREF(lst);
+    lst = PyDict_GetItemString(cache, fn);
+  }
+  return lst;
+}
+
 }  // namespace
 
 extern "C" {
 
-int mxcapi_abi_version() { return 3; }
+int mxcapi_abi_version() { return 4; }
 
 int MXGetVersion(int* out) {
   *out = 10600;  // 1.6.0-compatible surface
@@ -487,6 +620,1347 @@ int MXAggregateProfileStatsPrint(const char** out_str, int reset) {
   Py_DECREF(s);
   *out_str = g_ret.text.c_str();
   return 0;
+}
+
+}  // extern "C"
+
+// ===========================================================================
+// Round-4 breadth: NDArray extras, imperative invoke, autograd, symbol
+// manipulation + inference, executors, cached ops, data iterators,
+// kvstore metadata, recordio, profiler objects, misc runtime
+// (reference: src/c_api/c_api_ndarray.cc, c_api_executor.cc,
+// c_api_symbolic.cc, c_api.cc, c_api_profile.cc)
+// ===========================================================================
+
+extern "C" {
+
+typedef unsigned mx_uint;
+typedef void* ExecutorHandle;
+typedef void* DataIterHandle;
+typedef void* CachedOpHandle;
+typedef void* AtomicSymbolCreator;
+typedef void* DataIterCreator;
+typedef void* RecordIOHandle;
+typedef void* ProfileHandle;
+
+// -- NDArray extras --------------------------------------------------------
+
+int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out) {
+  // f32 is dtype code 0 (reference MXNDArrayCreate fixes f32)
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+int MXNDArrayCreateNone(NDArrayHandle* out) {
+  return out_handle("ndarray_create_none", nullptr, out);
+}
+
+int MXNDArrayGetShapeEx(NDArrayHandle handle, int* out_dim,
+                        const int** out_pdata) {
+  unsigned dim = 0;
+  const unsigned* pdata = nullptr;
+  if (MXNDArrayGetShape(handle, &dim, &pdata) != 0) return -1;
+  g_ret.ints.assign(pdata, pdata + dim);
+  *out_dim = static_cast<int>(dim);
+  *out_pdata = g_ret.ints.data();
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start, mx_uint stop,
+                   NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OII)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 start, stop);
+  return out_handle("ndarray_slice", args, out);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OI)",
+                                 reinterpret_cast<PyObject*>(handle), idx);
+  return out_handle("ndarray_at", args, out);
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                     NDArrayHandle* out) {
+  Gil gil;
+  PyObject* pdims = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SetItem(pdims, i, PyLong_FromLong(dims[i]));
+  PyObject* args = Py_BuildValue("(OO)",
+                                 reinterpret_cast<PyObject*>(handle), pdims);
+  Py_DECREF(pdims);
+  return out_handle("ndarray_reshape", args, out);
+}
+
+int MXNDArrayReshape64(NDArrayHandle handle, int ndim, long long* dims,
+                       bool /*reverse*/, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* pdims = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyList_SetItem(pdims, i, PyLong_FromLongLong(dims[i]));
+  PyObject* args = Py_BuildValue("(OO)",
+                                 reinterpret_cast<PyObject*>(handle), pdims);
+  Py_DECREF(pdims);
+  return out_handle("ndarray_reshape", args, out);
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* pair = call("ndarray_context", args);
+  Py_DECREF(args);
+  if (!pair) { set_error_from_python(); return -1; }
+  *out_dev_type = (int)PyLong_AsLong(PyTuple_GetItem(pair, 0));
+  *out_dev_id = (int)PyLong_AsLong(PyTuple_GetItem(pair, 1));
+  Py_DECREF(pair);
+  return 0;
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int* out_stype) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (out_long("ndarray_storage_type", args, &v) != 0) return -1;
+  *out_stype = (int)v;
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return simple("ndarray_wait_to_read", args);
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_handle("ndarray_detach", args, out);
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_handle("ndarray_get_grad", args, out);
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)",
+                                 reinterpret_cast<PyObject*>(handle), state);
+  return simple("ndarray_set_grad_state", args);
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int* out) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (out_long("ndarray_get_grad_state", args, &v) != 0) return -1;
+  *out = (int)v;
+  return 0;
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst, NDArrayHandle src,
+                                 int /*i*/) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(OO)",
+                                 reinterpret_cast<PyObject*>(dst),
+                                 reinterpret_cast<PyObject*>(src));
+  return simple("ndarray_copy_from_ndarray", args);
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const bool full_check) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Oi)",
+                                 reinterpret_cast<PyObject*>(handle),
+                                 full_check ? 1 : 0);
+  return simple("ndarray_check_format", args);
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                          const char** out_buf) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* bytes = call("ndarray_save_raw_bytes", args);
+  Py_DECREF(args);
+  if (!bytes) { set_error_from_python(); return -1; }
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &n) != 0) {
+    PyErr_Clear();
+    Py_DECREF(bytes);
+    g_last_error = "raw-bytes bridge returned non-bytes";
+    return -1;
+  }
+  g_ret.text.assign(buf, n);
+  Py_DECREF(bytes);
+  *out_size = (size_t)n;
+  *out_buf = g_ret.text.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* b = PyBytes_FromStringAndSize((const char*)buf,
+                                          (Py_ssize_t)size);
+  PyObject* args = Py_BuildValue("(O)", b);
+  Py_DECREF(b);
+  return out_handle("ndarray_load_from_raw_bytes", args, out);
+}
+
+int MXNDArrayLoadFromBuffer(const void* buf, size_t size,
+                            mx_uint* out_size, NDArrayHandle** out_arr,
+                            mx_uint* out_name_size,
+                            const char*** out_names) {
+  ensure_python();
+  Gil gil;
+  PyObject* b = PyBytes_FromStringAndSize((const char*)buf,
+                                          (Py_ssize_t)size);
+  PyObject* args = Py_BuildValue("(O)", b);
+  Py_DECREF(b);
+  PyObject* pair = call("ndarray_load_from_buffer", args);
+  Py_DECREF(args);
+  if (!pair) { set_error_from_python(); return -1; }
+  PyObject* arrs = PyTuple_GetItem(pair, 0);
+  PyObject* names = PyTuple_GetItem(pair, 1);
+  g_ret.handles.clear();
+  Py_ssize_t n = PyList_Size(arrs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(arrs, i);
+    Py_INCREF(o);
+    g_ret.handles.push_back(o);
+  }
+  *out_size = (mx_uint)n;
+  *out_arr = g_ret.handles.data();
+  int rc = fill_strings(names, out_name_size, out_names);
+  Py_DECREF(pair);
+  if (rc) set_error_from_python();
+  return rc;
+}
+
+// -- op listing + imperative invoke ---------------------------------------
+
+int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
+  return out_str_list("list_all_op_names", nullptr, out_size, out_array);
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint* out_size,
+                                     AtomicSymbolCreator** out_array) {
+  Gil gil;
+  PyObject* lst = creator_list("list_atomic_creators");
+  if (!lst) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyList_Size(lst);
+  g_ret.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_ret.handles.push_back(PyList_GetItem(lst, i));  // cache keeps alive
+  *out_size = (mx_uint)n;
+  *out_array = g_ret.handles.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(creator));
+  return out_text("atomic_creator_name", args, name);
+}
+
+int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char** name, const char** description,
+    mx_uint* num_args, const char*** arg_names, const char*** arg_type_infos,
+    const char*** arg_descriptions, const char** key_var_num_args,
+    const char** return_type) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(creator));
+  PyObject* tup = call("atomic_creator_info", args);
+  Py_DECREF(args);
+  if (!tup) { set_error_from_python(); return -1; }
+  g_ret.strings.clear();
+  g_ret.cstrs.clear();
+  const char* n0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+  const char* d0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 1));
+  const char* k0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 2));
+  g_ret.strings.emplace_back(n0 ? n0 : "");
+  g_ret.strings.emplace_back(d0 ? d0 : "");
+  g_ret.strings.emplace_back(k0 ? k0 : "");
+  Py_DECREF(tup);
+  *name = g_ret.strings[0].c_str();
+  *description = g_ret.strings[1].c_str();
+  *key_var_num_args = g_ret.strings[2].c_str();
+  *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  if (return_type) *return_type = nullptr;
+  return 0;
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  ensure_python();
+  Gil gil;
+  // in-place mode (reference semantics): caller-provided outputs are
+  // written through and the caller keeps its handles
+  bool inplace = (*num_outputs > 0 && *outputs != nullptr);
+  PyObject* ins = make_handle_list((unsigned)num_inputs, inputs);
+  PyObject* keys = make_str_list((unsigned)num_params, param_keys);
+  PyObject* vals = make_str_list((unsigned)num_params, param_vals);
+  PyObject* outs = inplace
+      ? make_handle_list((unsigned)*num_outputs, *outputs)
+      : (Py_INCREF(Py_None), Py_None);
+  PyObject* args = Py_BuildValue(
+      "(OOOOO)", reinterpret_cast<PyObject*>(creator), ins, keys, vals,
+      outs);
+  Py_DECREF(ins); Py_DECREF(keys); Py_DECREF(vals); Py_DECREF(outs);
+  if (inplace) return simple("imperative_invoke", args);
+  return out_handle_list("imperative_invoke", args, num_outputs, outputs);
+}
+
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle* inputs, int* num_outputs,
+                         NDArrayHandle** outputs, int num_params,
+                         const char** param_keys, const char** param_vals,
+                         const int** out_stypes) {
+  int rc = MXImperativeInvoke(creator, num_inputs, inputs, num_outputs,
+                              outputs, num_params, param_keys, param_vals);
+  if (rc == 0 && out_stypes) {
+    g_ret.ints.assign((size_t)*num_outputs, 1);  // kDefaultStorage
+    *out_stypes = g_ret.ints.data();
+  }
+  return rc;
+}
+
+// -- autograd --------------------------------------------------------------
+
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(i)", is_recording);
+  if (out_long("autograd_set_recording", args, &v) != 0) return -1;
+  if (prev) *prev = (int)v;
+  return 0;
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(i)", is_training);
+  if (out_long("autograd_set_training", args, &v) != 0) return -1;
+  if (prev) *prev = (int)v;
+  return 0;
+}
+
+int MXAutogradIsRecording(bool* curr) {
+  Gil gil;
+  long v = 0;
+  if (out_long("autograd_is_recording", nullptr, &v) != 0) return -1;
+  *curr = v != 0;
+  return 0;
+}
+
+int MXAutogradIsTraining(bool* curr) {
+  Gil gil;
+  long v = 0;
+  if (out_long("autograd_is_training", nullptr, &v) != 0) return -1;
+  *curr = v != 0;
+  return 0;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle* var_handles,
+                            mx_uint* reqs_array,
+                            NDArrayHandle* grad_handles) {
+  Gil gil;
+  PyObject* vars = make_handle_list(num_var, var_handles);
+  PyObject* grads = make_handle_list(num_var, grad_handles);
+  PyObject* reqs = make_uint_list(num_var, reqs_array);
+  PyObject* args = Py_BuildValue("(OOO)", vars, reqs, grads);
+  Py_DECREF(vars); Py_DECREF(grads); Py_DECREF(reqs);
+  return simple("autograd_mark_variables", args);
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles,
+                         mx_uint num_variables,
+                         NDArrayHandle* /*var_handles*/, int retain_graph,
+                         int /*create_graph*/, int is_train,
+                         NDArrayHandle** grad_handles, int** grad_stypes) {
+  if (num_variables != 0) {
+    g_last_error = "MXAutogradBackwardEx: explicit variable list is not "
+                   "supported; mark variables and read .grad instead";
+    return -1;
+  }
+  Gil gil;
+  PyObject* outs = make_handle_list(num_output, output_handles);
+  PyObject* ograds = ograd_handles
+      ? make_handle_list(num_output, ograd_handles)
+      : (Py_INCREF(Py_None), Py_None);
+  PyObject* args = Py_BuildValue("(OOii)", outs, ograds, retain_graph,
+                                 is_train);
+  Py_DECREF(outs); Py_DECREF(ograds);
+  int rc = simple("autograd_backward", args);
+  if (rc == 0 && grad_handles) *grad_handles = nullptr;
+  if (rc == 0 && grad_stypes) *grad_stypes = nullptr;
+  return rc;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph) {
+  return MXAutogradBackwardEx(num_output, output_handles, ograd_handles, 0,
+                              nullptr, retain_graph, 0, 1, nullptr,
+                              nullptr);
+}
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle* output_handles) {
+  return MXAutogradBackward(num_output, output_handles, nullptr, 0);
+}
+
+// -- symbol manipulation ---------------------------------------------------
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", name);
+  return out_handle("symbol_create_variable", args, out);
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char** keys,
+                               const char** vals, SymbolHandle* out) {
+  Gil gil;
+  PyObject* k = make_str_list(num_param, keys);
+  PyObject* v = make_str_list(num_param, vals);
+  PyObject* args = Py_BuildValue(
+      "(OOO)", reinterpret_cast<PyObject*>(creator), k, v);
+  Py_DECREF(k); Py_DECREF(v);
+  return out_handle("symbol_create_atomic", args, out);
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                    const char** /*keys*/, SymbolHandle* args_in) {
+  Gil gil;
+  PyObject* arr = make_handle_list(num_args, args_in);
+  PyObject* args = Py_BuildValue(
+      "(OsO)", reinterpret_cast<PyObject*>(sym), name ? name : "", arr);
+  Py_DECREF(arr);
+  return simple("symbol_compose", args);
+}
+
+int MXSymbolCopy(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  return out_handle("symbol_copy", args, out);
+}
+
+int MXSymbolPrint(SymbolHandle sym, const char** out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  return out_text("symbol_print", args, out_str);
+}
+
+int MXSymbolGetName(SymbolHandle sym, const char** out, int* success) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  int rc = out_text("symbol_get_name", args, out);
+  if (rc == 0) *success = (*out != nullptr);
+  return rc;
+}
+
+int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
+                    int* success) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Os)", reinterpret_cast<PyObject*>(sym), key);
+  int rc = out_text("symbol_get_attr", args, out);
+  if (rc == 0) *success = (*out != nullptr);
+  return rc;
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Oss)", reinterpret_cast<PyObject*>(sym), key, value);
+  return simple("symbol_set_attr", args);
+}
+
+static int list_attr_impl(SymbolHandle sym, int shallow, mx_uint* out_size,
+                          const char*** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Oi)", reinterpret_cast<PyObject*>(sym), shallow);
+  unsigned flat = 0;
+  int rc = out_str_list("symbol_list_attr", args, &flat, out);
+  if (rc == 0) *out_size = flat / 2;   // reference: k/v pair count
+  return rc;
+}
+
+int MXSymbolListAttr(SymbolHandle sym, mx_uint* out_size,
+                     const char*** out) {
+  return list_attr_impl(sym, 0, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle sym, mx_uint* out_size,
+                            const char*** out) {
+  return list_attr_impl(sym, 1, out_size, out);
+}
+
+int MXSymbolGetInternals(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  return out_handle("symbol_get_internals", args, out);
+}
+
+int MXSymbolGetOutput(SymbolHandle sym, mx_uint index, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OI)", reinterpret_cast<PyObject*>(sym), index);
+  return out_handle("symbol_get_output", args, out);
+}
+
+int MXSymbolGetNumOutputs(SymbolHandle sym, mx_uint* output_count) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  if (out_long("symbol_get_num_outputs", args, &v) != 0) return -1;
+  *output_count = (mx_uint)v;
+  return 0;
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle* symbols,
+                        SymbolHandle* out) {
+  Gil gil;
+  PyObject* lst = make_handle_list(num_symbols, symbols);
+  PyObject* args = Py_BuildValue("(O)", lst);
+  Py_DECREF(lst);
+  return out_handle("symbol_create_group", args, out);
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  return out_handle("symbol_from_file", args, out);
+}
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Os)", reinterpret_cast<PyObject*>(sym), fname);
+  return simple("symbol_to_file", args);
+}
+
+static int infer_shape_impl(SymbolHandle sym, mx_uint num_args,
+                            const char** keys, const mx_uint* arg_ind_ptr,
+                            const mx_uint* arg_shape_data, int partial,
+                            mx_uint* in_shape_size,
+                            const mx_uint** in_shape_ndim,
+                            const mx_uint*** in_shape_data,
+                            mx_uint* out_shape_size,
+                            const mx_uint** out_shape_ndim,
+                            const mx_uint*** out_shape_data,
+                            mx_uint* aux_shape_size,
+                            const mx_uint** aux_shape_ndim,
+                            const mx_uint*** aux_shape_data,
+                            int* complete) {
+  ensure_python();
+  Gil gil;
+  PyObject* k = make_str_list(num_args, keys);
+  PyObject* ind = make_uint_list(num_args + 1, arg_ind_ptr);
+  mx_uint total = num_args ? arg_ind_ptr[num_args] : 0;
+  PyObject* data = make_uint_list(total, arg_shape_data);
+  PyObject* args = Py_BuildValue(
+      "(OOOOi)", reinterpret_cast<PyObject*>(sym), k, ind, data, partial);
+  Py_DECREF(k); Py_DECREF(ind); Py_DECREF(data);
+  PyObject* tup = call("symbol_infer_shape", args);
+  Py_DECREF(args);
+  if (!tup) { set_error_from_python(); return -1; }
+  g_ret.sbufs.clear();
+  mx_uint* sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint** ndims[3] = {in_shape_ndim, out_shape_ndim,
+                              aux_shape_ndim};
+  const mx_uint*** datas[3] = {in_shape_data, out_shape_data,
+                               aux_shape_data};
+  // fill all buffers first (vector growth would invalidate pointers)
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GetItem(tup, g);
+    Py_ssize_t n = PyList_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* shp = PyList_GetItem(lst, i);
+      std::vector<unsigned> dims;
+      for (Py_ssize_t d = 0; d < PyList_Size(shp); ++d)
+        dims.push_back((unsigned)PyLong_AsUnsignedLong(
+            PyList_GetItem(shp, d)));
+      g_ret.sbufs.push_back(std::move(dims));
+    }
+  }
+  size_t cursor = 0;
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GetItem(tup, g);
+    Py_ssize_t n = PyList_Size(lst);
+    g_ret.ndims[g].clear();
+    g_ret.sptrs[g].clear();
+    for (Py_ssize_t i = 0; i < n; ++i, ++cursor) {
+      g_ret.ndims[g].push_back((unsigned)g_ret.sbufs[cursor].size());
+      g_ret.sptrs[g].push_back(g_ret.sbufs[cursor].data());
+    }
+    *sizes[g] = (mx_uint)n;
+    *ndims[g] = g_ret.ndims[g].data();
+    *datas[g] = g_ret.sptrs[g].data();
+  }
+  *complete = (int)PyLong_AsLong(PyTuple_GetItem(tup, 3));
+  Py_DECREF(tup);
+  return 0;
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                       const char** keys, const mx_uint* arg_ind_ptr,
+                       const mx_uint* arg_shape_data,
+                       mx_uint* in_shape_size,
+                       const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data,
+                       mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data,
+                       mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data, int* complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          0, in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete);
+}
+
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char** keys,
+                              const mx_uint* arg_ind_ptr,
+                              const mx_uint* arg_shape_data,
+                              mx_uint* in_shape_size,
+                              const mx_uint** in_shape_ndim,
+                              const mx_uint*** in_shape_data,
+                              mx_uint* out_shape_size,
+                              const mx_uint** out_shape_ndim,
+                              const mx_uint*** out_shape_data,
+                              mx_uint* aux_shape_size,
+                              const mx_uint** aux_shape_ndim,
+                              const mx_uint*** aux_shape_data,
+                              int* complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          1, in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete);
+}
+
+static int infer_type_impl(SymbolHandle sym, mx_uint num_args,
+                           const char** keys, const int* arg_type_data,
+                           int partial, mx_uint* in_size, const int** in,
+                           mx_uint* out_size, const int** out,
+                           mx_uint* aux_size, const int** aux,
+                           int* complete) {
+  ensure_python();
+  Gil gil;
+  PyObject* k = make_str_list(num_args, keys);
+  PyObject* t = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SetItem(t, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject* args = Py_BuildValue(
+      "(OOOi)", reinterpret_cast<PyObject*>(sym), k, t, partial);
+  Py_DECREF(k); Py_DECREF(t);
+  PyObject* tup = call("symbol_infer_type", args);
+  Py_DECREF(args);
+  if (!tup) { set_error_from_python(); return -1; }
+  g_ret.ints.clear();
+  mx_uint* sizes[3] = {in_size, out_size, aux_size};
+  const int** outs[3] = {in, out, aux};
+  std::vector<size_t> starts;
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GetItem(tup, g);
+    starts.push_back(g_ret.ints.size());
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i)
+      g_ret.ints.push_back((int)PyLong_AsLong(PyList_GetItem(lst, i)));
+  }
+  for (int g = 0; g < 3; ++g) {
+    PyObject* lst = PyTuple_GetItem(tup, g);
+    *sizes[g] = (mx_uint)PyList_Size(lst);
+    *outs[g] = g_ret.ints.data() + starts[g];
+  }
+  *complete = (int)PyLong_AsLong(PyTuple_GetItem(tup, 3));
+  Py_DECREF(tup);
+  return 0;
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char** keys,
+                      const int* arg_type_data, mx_uint* in_type_size,
+                      const int** in_type_data, mx_uint* out_type_size,
+                      const int** out_type_data, mx_uint* aux_type_size,
+                      const int** aux_type_data, int* complete) {
+  return infer_type_impl(sym, num_args, keys, arg_type_data, 0,
+                         in_type_size, in_type_data, out_type_size,
+                         out_type_data, aux_type_size, aux_type_data,
+                         complete);
+}
+
+int MXSymbolInferTypePartial(SymbolHandle sym, mx_uint num_args,
+                             const char** keys, const int* arg_type_data,
+                             mx_uint* in_type_size, const int** in_type_data,
+                             mx_uint* out_type_size,
+                             const int** out_type_data,
+                             mx_uint* aux_type_size,
+                             const int** aux_type_data, int* complete) {
+  return infer_type_impl(sym, num_args, keys, arg_type_data, 1,
+                         in_type_size, in_type_data, out_type_size,
+                         out_type_data, aux_type_size, aux_type_data,
+                         complete);
+}
+
+// -- executor --------------------------------------------------------------
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle* in_args,
+                   NDArrayHandle* arg_grad_store, mx_uint* grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle* aux_states,
+                   ExecutorHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* args_l = make_handle_list(len, in_args);
+  PyObject* grads_l = make_handle_list(len, arg_grad_store);
+  PyObject* reqs_l = make_uint_list(len, grad_req_type);
+  PyObject* aux_l = make_handle_list(aux_states_len, aux_states);
+  PyObject* args = Py_BuildValue(
+      "(OiiOOOO)", reinterpret_cast<PyObject*>(symbol_handle), dev_type,
+      dev_id, args_l, grads_l, reqs_l, aux_l);
+  Py_DECREF(args_l); Py_DECREF(grads_l); Py_DECREF(reqs_l);
+  Py_DECREF(aux_l);
+  return out_handle("executor_bind", args, out);
+}
+
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint /*num_map_keys*/, const char** /*map_keys*/,
+                    const int* /*map_dev_types*/, const int* /*map_dev_ids*/,
+                    mx_uint len, NDArrayHandle* in_args,
+                    NDArrayHandle* arg_grad_store, mx_uint* grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle* aux_states,
+                    ExecutorHandle* out) {
+  return MXExecutorBind(symbol_handle, dev_type, dev_id, len, in_args,
+                        arg_grad_store, grad_req_type, aux_states_len,
+                        aux_states, out);
+}
+
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char** map_keys,
+                     const int* map_dev_types, const int* map_dev_ids,
+                     mx_uint len, NDArrayHandle* in_args,
+                     NDArrayHandle* arg_grad_store, mx_uint* grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle* aux_states,
+                     ExecutorHandle /*shared_exec*/, ExecutorHandle* out) {
+  return MXExecutorBindX(symbol_handle, dev_type, dev_id, num_map_keys,
+                         map_keys, map_dev_types, map_dev_ids, len, in_args,
+                         arg_grad_store, grad_req_type, aux_states_len,
+                         aux_states, out);
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Oi)", reinterpret_cast<PyObject*>(handle), is_train);
+  return simple("executor_forward", args);
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle* head_grads) {
+  Gil gil;
+  PyObject* grads = make_handle_list(len, head_grads);
+  PyObject* args = Py_BuildValue(
+      "(OO)", reinterpret_cast<PyObject*>(handle), grads);
+  Py_DECREF(grads);
+  return simple("executor_backward", args);
+}
+
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle* head_grads, int /*is_train*/) {
+  return MXExecutorBackward(handle, len, head_grads);
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
+                      NDArrayHandle** out) {
+  Gil gil;
+  int n = 0;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  int rc = out_handle_list("executor_outputs", args, &n, out);
+  if (rc == 0) *out_size = (mx_uint)n;
+  return rc;
+}
+
+int MXExecutorPrint(ExecutorHandle handle, const char** out_str) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_text("executor_print", args, out_str);
+}
+
+int MXExecutorFree(ExecutorHandle handle) { return MXNDArrayFree(handle); }
+
+// -- cached op -------------------------------------------------------------
+
+int MXCreateCachedOpEx(SymbolHandle handle, int num_flags,
+                       const char** keys, const char** vals,
+                       CachedOpHandle* out) {
+  Gil gil;
+  PyObject* k = make_str_list((unsigned)num_flags, keys);
+  PyObject* v = make_str_list((unsigned)num_flags, vals);
+  PyObject* args = Py_BuildValue(
+      "(OOO)", reinterpret_cast<PyObject*>(handle), k, v);
+  Py_DECREF(k); Py_DECREF(v);
+  return out_handle("cached_op_create", args, out);
+}
+
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle* out) {
+  return MXCreateCachedOpEx(handle, 0, nullptr, nullptr, out);
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) { return MXNDArrayFree(handle); }
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs) {
+  Gil gil;
+  PyObject* ins = make_handle_list((unsigned)num_inputs, inputs);
+  PyObject* args = Py_BuildValue(
+      "(OO)", reinterpret_cast<PyObject*>(handle), ins);
+  Py_DECREF(ins);
+  return out_handle_list("cached_op_invoke", args, num_outputs, outputs);
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, const int** out_stypes) {
+  int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                            outputs);
+  if (rc == 0 && out_stypes) {
+    g_ret.ints.assign((size_t)*num_outputs, 1);
+    *out_stypes = g_ret.ints.data();
+  }
+  return rc;
+}
+
+// -- data iterators --------------------------------------------------------
+
+int MXListDataIters(mx_uint* out_size, DataIterCreator** out_array) {
+  Gil gil;
+  PyObject* lst = creator_list("list_data_iters");
+  if (!lst) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyList_Size(lst);
+  g_ret.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_ret.handles.push_back(PyList_GetItem(lst, i));  // cache keeps alive
+  *out_size = (mx_uint)n;
+  *out_array = g_ret.handles.data();
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
+                          const char** description, mx_uint* num_args,
+                          const char*** arg_names,
+                          const char*** arg_type_infos,
+                          const char*** arg_descriptions) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(creator));
+  PyObject* tup = call("data_iter_info", args);
+  Py_DECREF(args);
+  if (!tup) { set_error_from_python(); return -1; }
+  g_ret.strings.clear();
+  g_ret.cstrs.clear();
+  const char* n0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+  const char* d0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 1));
+  g_ret.strings.emplace_back(n0 ? n0 : "");
+  g_ret.strings.emplace_back(d0 ? d0 : "");
+  Py_DECREF(tup);
+  *name = g_ret.strings[0].c_str();
+  *description = g_ret.strings[1].c_str();
+  *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out) {
+  Gil gil;
+  PyObject* k = make_str_list(num_param, keys);
+  PyObject* v = make_str_list(num_param, vals);
+  PyObject* args = Py_BuildValue(
+      "(OOO)", reinterpret_cast<PyObject*>(creator), k, v);
+  Py_DECREF(k); Py_DECREF(v);
+  return out_handle("data_iter_create", args, out);
+}
+
+int MXDataIterFree(DataIterHandle handle) { return MXNDArrayFree(handle); }
+
+int MXDataIterNext(DataIterHandle handle, int* out) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (out_long("data_iter_next", args, &v) != 0) return -1;
+  *out = (int)v;
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return simple("data_iter_before_first", args);
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_handle("data_iter_data", args, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_handle("data_iter_label", args, out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (out_long("data_iter_pad", args, &v) != 0) return -1;
+  *pad = (int)v;
+  return 0;
+}
+
+int MXDataIterGetIndex(DataIterHandle handle,
+                       unsigned long long** out_index,
+                       unsigned long long* out_size) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* lst = call("data_iter_index", args);
+  Py_DECREF(args);
+  if (!lst) { set_error_from_python(); return -1; }
+  g_ret.idx64.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i)
+    g_ret.idx64.push_back(PyLong_AsUnsignedLongLong(
+        PyList_GetItem(lst, i)));
+  Py_DECREF(lst);
+  *out_size = g_ret.idx64.size();
+  *out_index = g_ret.idx64.data();
+  return 0;
+}
+
+// -- kvstore metadata ------------------------------------------------------
+
+int MXKVStoreGetType(KVStoreHandle handle, const char** out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_text("kvstore_type", args, out);
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int* out) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (out_long("kvstore_rank", args, &v) != 0) return -1;
+  *out = (int)v;
+  return 0;
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int* out) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (out_long("kvstore_group_size", args, &v) != 0) return -1;
+  *out = (int)v;
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return simple("kvstore_barrier", args);
+}
+
+int MXKVStoreIsWorkerNode(int* ret) { *ret = 1; return 0; }
+int MXKVStoreIsServerNode(int* ret) { *ret = 0; return 0; }
+int MXKVStoreIsSchedulerNode(int* ret) { *ret = 0; return 0; }
+int MXKVStoreGetNumDeadNode(KVStoreHandle, const int, int* number_of_dead,
+                            const int) { *number_of_dead = 0; return 0; }
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle, const int) { return 0; }
+int MXInitPSEnv(mx_uint, const char**, const char**) { return 0; }
+int MXKVStoreRunServer(KVStoreHandle, void*, void*) {
+  // no parameter-server role in the collective design (DIVERGENCES.md);
+  // returning success lets reference launch shells exit cleanly
+  return 0;
+}
+int MXKVStoreSendCommmandToServers(KVStoreHandle, int, const char*) {
+  return 0;
+}
+
+static int kv_str_op(const char* fn, KVStoreHandle handle, mx_uint num,
+                     const char** keys, NDArrayHandle* vals) {
+  Gil gil;
+  PyObject* pykeys = make_str_list(num, keys);
+  PyObject* pyvals = make_handle_list(num, vals);
+  PyObject* args = Py_BuildValue(
+      "(OOO)", reinterpret_cast<PyObject*>(handle), pykeys, pyvals);
+  Py_DECREF(pykeys); Py_DECREF(pyvals);
+  return simple(fn, args);
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals) {
+  return kv_str_op("kvstore_init_str", handle, num, keys, vals);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int /*priority*/) {
+  return kv_str_op("kvstore_push_str", handle, num, keys, vals);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int /*priority*/) {
+  return kv_str_op("kvstore_pull_str", handle, num, keys, vals);
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, mx_uint num_params,
+                                    const char** keys, const char** vals) {
+  Gil gil;
+  PyObject* k = make_str_list(num_params, keys);
+  PyObject* v = make_str_list(num_params, vals);
+  PyObject* args = Py_BuildValue(
+      "(OOO)", reinterpret_cast<PyObject*>(handle), k, v);
+  Py_DECREF(k); Py_DECREF(v);
+  return simple("kvstore_set_gradient_compression", args);
+}
+
+// -- recordio --------------------------------------------------------------
+
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", uri);
+  return out_handle("recordio_writer_create", args, out);
+}
+
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", uri);
+  return out_handle("recordio_reader_create", args, out);
+}
+
+static int recordio_free(RecordIOHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  int rc = simple("recordio_close", args);
+  MXNDArrayFree(handle);
+  return rc;
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return recordio_free(handle);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
+                                size_t size) {
+  Gil gil;
+  PyObject* b = PyBytes_FromStringAndSize(buf, (Py_ssize_t)size);
+  PyObject* args = Py_BuildValue(
+      "(OO)", reinterpret_cast<PyObject*>(handle), b);
+  Py_DECREF(b);
+  return simple("recordio_write", args);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
+                               size_t* size) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* r = call("recordio_read", args);
+  Py_DECREF(args);
+  if (!r) { set_error_from_python(); return -1; }
+  if (r == Py_None) {
+    *buf = nullptr;
+    *size = 0;
+  } else {
+    char* data = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(r, &data, &n) != 0) {
+      PyErr_Clear();
+      Py_DECREF(r);
+      g_last_error = "recordio read returned non-bytes";
+      return -1;
+    }
+    g_ret.text.assign(data, n);
+    *buf = g_ret.text.data();
+    *size = (size_t)n;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+static int recordio_tell_impl(RecordIOHandle handle, size_t* pos) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  if (out_long("recordio_tell", args, &v) != 0) return -1;
+  *pos = (size_t)v;
+  return 0;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t* pos) {
+  return recordio_tell_impl(handle, pos);
+}
+
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t* pos) {
+  return recordio_tell_impl(handle, pos);
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(On)", reinterpret_cast<PyObject*>(handle), (Py_ssize_t)pos);
+  return simple("recordio_seek", args);
+}
+
+// -- profiler objects ------------------------------------------------------
+
+int MXSetProfilerConfig(int num_params, const char* const* keys,
+                        const char* const* vals) {
+  Gil gil;
+  PyObject* k = make_str_list((unsigned)num_params, keys);
+  PyObject* v = make_str_list((unsigned)num_params, vals);
+  PyObject* args = Py_BuildValue("(OO)", k, v);
+  Py_DECREF(k); Py_DECREF(v);
+  return simple("profiler_set_config", args);
+}
+
+int MXDumpProfile(int finished) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", finished);
+  return simple("profiler_dump", args);
+}
+
+int MXDumpProcessProfile(int finished, int /*profile_process*/,
+                         KVStoreHandle /*kv*/) {
+  return MXDumpProfile(finished);
+}
+
+int MXProfilePause(int paused) {
+  return simple(paused ? "profiler_pause" : "profiler_resume", nullptr);
+}
+
+int MXProcessProfilePause(int paused, int /*profile_process*/,
+                          KVStoreHandle /*kv*/) {
+  return MXProfilePause(paused);
+}
+
+int MXSetProcessProfilerState(int state, int /*profile_process*/,
+                              KVStoreHandle /*kv*/) {
+  return MXSetProfilerState(state);
+}
+
+int MXSetProcessProfilerConfig(int num_params, const char* const* keys,
+                               const char* const* vals,
+                               KVStoreHandle /*kv*/) {
+  return MXSetProfilerConfig(num_params, keys, vals);
+}
+
+int MXProfileCreateDomain(const char* domain, ProfileHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", domain);
+  return out_handle("profile_create_domain", args, out);
+}
+
+int MXProfileCreateTask(ProfileHandle domain, const char* name,
+                        ProfileHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Os)", reinterpret_cast<PyObject*>(domain), name);
+  return out_handle("profile_create_task", args, out);
+}
+
+int MXProfileCreateFrame(ProfileHandle domain, const char* name,
+                         ProfileHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Os)", reinterpret_cast<PyObject*>(domain), name);
+  return out_handle("profile_create_frame", args, out);
+}
+
+int MXProfileCreateEvent(const char* name, ProfileHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(s)", name);
+  return out_handle("profile_create_event", args, out);
+}
+
+int MXProfileCreateCounter(ProfileHandle domain, const char* name,
+                           ProfileHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Os)", reinterpret_cast<PyObject*>(domain), name);
+  return out_handle("profile_create_counter", args, out);
+}
+
+int MXProfileDestroyHandle(ProfileHandle handle) {
+  return MXNDArrayFree(handle);
+}
+
+int MXProfileDurationStart(ProfileHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return simple("profile_duration_start", args);
+}
+
+int MXProfileDurationStop(ProfileHandle handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return simple("profile_duration_stop", args);
+}
+
+int MXProfileSetCounter(ProfileHandle handle, unsigned long long value) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OK)", reinterpret_cast<PyObject*>(handle), value);
+  return simple("profile_set_counter", args);
+}
+
+int MXProfileAdjustCounter(ProfileHandle handle, long long delta) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OL)", reinterpret_cast<PyObject*>(handle), delta);
+  return simple("profile_adjust_counter", args);
+}
+
+int MXProfileSetMarker(ProfileHandle domain, const char* name,
+                       const char* scope_kind) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Oss)", reinterpret_cast<PyObject*>(domain), name,
+      scope_kind ? scope_kind : "process");
+  return simple("profile_set_marker", args);
+}
+
+// -- misc runtime ----------------------------------------------------------
+
+int MXNotifyShutdown() { return 0; }
+
+int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", seed);
+  return simple("random_seed", args);
+}
+
+int MXRandomSeedContext(int seed, int /*dev_type*/, int /*dev_id*/) {
+  return MXRandomSeed(seed);
+}
+
+int MXGetGPUCount(int* out) {
+  Gil gil;
+  long v = 0;
+  if (out_long("num_gpus", nullptr, &v) != 0) return -1;
+  *out = (int)v;
+  return 0;
+}
+
+int MXGetGPUMemoryInformation64(int /*dev*/, unsigned long long* free_mem,
+                                unsigned long long* total_mem) {
+  // XLA owns device memory; report unknown-but-valid (reference returns
+  // cudaMemGetInfo — no analog through PJRT here)
+  *free_mem = 0;
+  *total_mem = 0;
+  return 0;
+}
+
+int MXGetGPUMemoryInformation(int dev, int* free_mem, int* total_mem) {
+  unsigned long long f = 0, t = 0;
+  int rc = MXGetGPUMemoryInformation64(dev, &f, &t);
+  *free_mem = (int)f;
+  *total_mem = (int)t;
+  return rc;
+}
+
+int MXSetNumOMPThreads(int /*thread_num*/) { return 0; }
+int MXEngineSetBulkSize(int /*bulk_size*/, int* prev_bulk_size) {
+  if (prev_bulk_size) *prev_bulk_size = 15;
+  return 0;
+}
+
+int MXIsNumpyCompatible(bool* curr) { *curr = false; return 0; }
+int MXSetIsNumpyCompatible(int /*is_np_comp*/, int* prev) {
+  if (prev) *prev = 0;
+  return 0;
+}
+
+int MXLibInfoFeatures(const struct LibFeature** lib_features, size_t* size) {
+  // the struct layout is reference-internal; expose the count with a
+  // null table (callers wanting names use the Python runtime API)
+  *lib_features = nullptr;
+  *size = 0;
+  return 0;
+}
+
+int MXListFunctions(mx_uint* out_size, void*** out_array) {
+  // legacy NDArrayFunction registry: empty on this backend (ops live in
+  // the imperative-invoke registry, MXListAllOpNames)
+  g_ret.handles.clear();
+  *out_size = 0;
+  *out_array = g_ret.handles.data();
+  return 0;
+}
+
+int MXGetFunction(const char* /*name*/, void** out) {
+  *out = nullptr;
+  g_last_error = "legacy NDArrayFunction registry is empty; use "
+                 "MXImperativeInvoke";
+  return -1;
 }
 
 }  // extern "C"

@@ -1,0 +1,276 @@
+/* End-to-end LeNet-style training purely through the C ABI
+ * (libmxcapi.so): MXDataIterCreateIter(MNISTIter) feeds batches,
+ * MXImperativeInvoke runs the forward ops, MXAutogradMarkVariables /
+ * MXAutogradBackward produce gradients, and sgd_update applies them
+ * in place — no Python in this translation unit. The reference analog
+ * is a from-scratch C binding driving c_api.h the way the Scala/Julia
+ * frontends do.
+ *
+ * Usage: train_lenet_capi <images.idx> <labels.idx>
+ * Exit 0 iff the final epoch's loss is well below the first batch's.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* AtomicSymbolCreator;
+typedef void* DataIterCreator;
+typedef void* DataIterHandle;
+typedef unsigned mx_uint;
+
+extern const char* MXGetLastError();
+extern int MXNDArrayCreateEx(const mx_uint*, mx_uint, int, int, int, int,
+                             NDArrayHandle*);
+extern int MXNDArrayFree(NDArrayHandle);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle, const void*, size_t);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle, void*, size_t);
+extern int MXNDArrayGetShape(NDArrayHandle, mx_uint*, const mx_uint**);
+extern int MXSymbolListAtomicSymbolCreators(mx_uint*,
+                                            AtomicSymbolCreator**);
+extern int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator, const char**);
+extern int MXImperativeInvoke(AtomicSymbolCreator, int, NDArrayHandle*,
+                              int*, NDArrayHandle**, int, const char**,
+                              const char**);
+extern int MXAutogradSetIsRecording(int, int*);
+extern int MXAutogradSetIsTraining(int, int*);
+extern int MXAutogradMarkVariables(mx_uint, NDArrayHandle*, mx_uint*,
+                                   NDArrayHandle*);
+extern int MXAutogradBackward(mx_uint, NDArrayHandle*, NDArrayHandle*,
+                              int);
+extern int MXListDataIters(mx_uint*, DataIterCreator**);
+extern int MXDataIterGetIterInfo(DataIterCreator, const char**,
+                                 const char**, mx_uint*, const char***,
+                                 const char***, const char***);
+extern int MXDataIterCreateIter(DataIterCreator, mx_uint, const char**,
+                                const char**, DataIterHandle*);
+extern int MXDataIterNext(DataIterHandle, int*);
+extern int MXDataIterBeforeFirst(DataIterHandle);
+extern int MXDataIterGetData(DataIterHandle, NDArrayHandle*);
+extern int MXDataIterGetLabel(DataIterHandle, NDArrayHandle*);
+extern int MXDataIterFree(DataIterHandle);
+extern int MXNDArrayWaitAll();
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#define CHECK(stmt) do { \
+    if ((stmt) != 0) { \
+      fprintf(stderr, "FAILED %s: %s\n", #stmt, MXGetLastError()); \
+      exit(2); \
+    } \
+  } while (0)
+
+static AtomicSymbolCreator find_op(const char* want) {
+  static AtomicSymbolCreator* creators = NULL;
+  static mx_uint n = 0;
+  if (!creators) CHECK(MXSymbolListAtomicSymbolCreators(&n, &creators));
+  /* creators stay valid: the library interns them for process life;
+     copy the array since the return store is reused per call */
+  static AtomicSymbolCreator saved[4096];
+  static int saved_init = 0;
+  if (!saved_init) {
+    memcpy(saved, creators, n * sizeof(*creators));
+    saved_init = 1;
+  }
+  for (mx_uint i = 0; i < n; ++i) {
+    const char* name = NULL;
+    CHECK(MXSymbolGetAtomicSymbolName(saved[i], &name));
+    if (name && strcmp(name, want) == 0) return saved[i];
+  }
+  fprintf(stderr, "op %s not found\n", want);
+  exit(2);
+}
+
+/* invoke with allocated outputs: returns first output handle */
+static NDArrayHandle invoke1(const char* op, int nin, NDArrayHandle* in,
+                             int nparam, const char** keys,
+                             const char** vals) {
+  int nout = 0;
+  NDArrayHandle* outs = NULL;
+  CHECK(MXImperativeInvoke(find_op(op), nin, in, &nout, &outs, nparam,
+                           keys, vals));
+  NDArrayHandle h = outs[0];
+  return h;
+}
+
+/* invoke writing into dst (the in-place mode) */
+static void invoke_into(const char* op, int nin, NDArrayHandle* in,
+                        NDArrayHandle dst, int nparam, const char** keys,
+                        const char** vals) {
+  int nout = 1;
+  NDArrayHandle outs_store[1];
+  NDArrayHandle* outs = outs_store;
+  outs_store[0] = dst;
+  CHECK(MXImperativeInvoke(find_op(op), nin, in, &nout, &outs, nparam,
+                           keys, vals));
+}
+
+static unsigned long long rng_state = 0x9E3779B97F4A7C15ull;
+static float frand(void) {      /* xorshift uniform in [-1, 1) */
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return ((float)((rng_state >> 11) & 0xFFFFFF) / 8388608.0f) - 1.0f;
+}
+
+static NDArrayHandle make_param(mx_uint* shape, mx_uint ndim, float scale) {
+  NDArrayHandle h;
+  CHECK(MXNDArrayCreateEx(shape, ndim, 1 /*cpu*/, 0, 0, 0 /*f32*/, &h));
+  size_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= shape[i];
+  float* buf = (float*)malloc(n * sizeof(float));
+  for (size_t i = 0; i < n; ++i) buf[i] = scale * frand();
+  CHECK(MXNDArraySyncCopyFromCPU(h, buf, n));
+  free(buf);
+  return h;
+}
+
+static NDArrayHandle make_zeros_like(NDArrayHandle src) {
+  mx_uint ndim = 0;
+  const mx_uint* shp = NULL;
+  CHECK(MXNDArrayGetShape(src, &ndim, &shp));
+  mx_uint copy[8];
+  memcpy(copy, shp, ndim * sizeof(mx_uint));
+  NDArrayHandle h;
+  CHECK(MXNDArrayCreateEx(copy, ndim, 1, 0, 0, 0, &h));
+  return h;
+}
+
+static float scalar_of(NDArrayHandle h) {
+  float v = 0.0f;
+  CHECK(MXNDArraySyncCopyToCPU(h, &v, 1));
+  return v;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s images.idx labels.idx\n", argv[0]);
+    return 2;
+  }
+  const int BATCH = 32;
+
+  /* ---- data iterator ---- */
+  DataIterCreator mnist = NULL;
+  mx_uint n_iters = 0;
+  DataIterCreator* iters = NULL;
+  CHECK(MXListDataIters(&n_iters, &iters));
+  for (mx_uint i = 0; i < n_iters && !mnist; ++i) {
+    const char *name = NULL, *desc = NULL;
+    mx_uint na = 0;
+    DataIterCreator c = iters[i];
+    CHECK(MXDataIterGetIterInfo(c, &name, &desc, &na, NULL, NULL, NULL));
+    if (strcmp(name, "MNISTIter") == 0) mnist = c;
+  }
+  if (!mnist) { fprintf(stderr, "MNISTIter missing\n"); return 2; }
+
+  const char* ikeys[] = {"image", "label", "batch_size", "shuffle",
+                         "flat"};
+  const char* ivals[] = {argv[1], argv[2], "32", "0", "0"};
+  DataIterHandle it = NULL;
+  CHECK(MXDataIterCreateIter(mnist, 5, ikeys, ivals, &it));
+
+  /* ---- parameters + gradients ---- */
+  mx_uint s_convw[] = {8, 1, 3, 3}, s_convb[] = {8};
+  mx_uint s_fc1w[] = {32, 8 * 14 * 14}, s_fc1b[] = {32};
+  mx_uint s_fc2w[] = {10, 32}, s_fc2b[] = {10};
+  NDArrayHandle params[6] = {
+      make_param(s_convw, 4, 0.30f),  make_param(s_convb, 1, 0.0f),
+      make_param(s_fc1w, 2, 0.05f),   make_param(s_fc1b, 1, 0.0f),
+      make_param(s_fc2w, 2, 0.20f),   make_param(s_fc2b, 1, 0.0f)};
+  NDArrayHandle grads[6];
+  mx_uint reqs[6];
+  for (int i = 0; i < 6; ++i) {
+    grads[i] = make_zeros_like(params[i]);
+    reqs[i] = 1; /* write */
+  }
+  CHECK(MXAutogradMarkVariables(6, params, reqs, grads));
+
+  /* ---- training ---- */
+  float first_loss = -1.0f, loss = 0.0f;
+  const char* lr_keys[] = {"lr", "rescale_grad"};
+  const char* lr_vals[] = {"0.1", "0.03125"};  /* 1/BATCH */
+  for (int epoch = 0; epoch < 3; ++epoch) {
+    CHECK(MXDataIterBeforeFirst(it));
+    int has = 0;
+    float epoch_loss = 0.0f;
+    int batches = 0;
+    while (1) {
+      CHECK(MXDataIterNext(it, &has));
+      if (!has) break;
+      NDArrayHandle x = NULL, y = NULL;
+      CHECK(MXDataIterGetData(it, &x));
+      CHECK(MXDataIterGetLabel(it, &y));
+
+      int prev = 0;
+      CHECK(MXAutogradSetIsRecording(1, &prev));
+      CHECK(MXAutogradSetIsTraining(1, &prev));
+
+      const char* ck[] = {"kernel", "num_filter", "pad"};
+      const char* cv[] = {"(3, 3)", "8", "(1, 1)"};
+      NDArrayHandle conv_in[] = {x, params[0], params[1]};
+      NDArrayHandle h1 = invoke1("Convolution", 3, conv_in, 3, ck, cv);
+
+      const char* ak[] = {"act_type"};
+      const char* av[] = {"relu"};
+      NDArrayHandle h2 = invoke1("Activation", 1, &h1, 1, ak, av);
+
+      const char* pk[] = {"kernel", "stride", "pool_type"};
+      const char* pv[] = {"(2, 2)", "(2, 2)", "max"};
+      NDArrayHandle h3 = invoke1("Pooling", 1, &h2, 3, pk, pv);
+
+      NDArrayHandle h4 = invoke1("Flatten", 1, &h3, 0, NULL, NULL);
+
+      const char* fk[] = {"num_hidden"};
+      const char* f1v[] = {"32"};
+      NDArrayHandle fc1_in[] = {h4, params[2], params[3]};
+      NDArrayHandle h5 = invoke1("FullyConnected", 3, fc1_in, 1, fk, f1v);
+      NDArrayHandle h6 = invoke1("Activation", 1, &h5, 1, ak, av);
+
+      const char* f2v[] = {"10"};
+      NDArrayHandle fc2_in[] = {h6, params[4], params[5]};
+      NDArrayHandle h7 = invoke1("FullyConnected", 3, fc2_in, 1, fk, f2v);
+
+      NDArrayHandle ce_in[] = {h7, y};
+      NDArrayHandle l = invoke1("softmax_cross_entropy", 2, ce_in, 0,
+                                NULL, NULL);
+
+      CHECK(MXAutogradSetIsRecording(0, &prev));
+      CHECK(MXAutogradBackward(1, &l, NULL, 0));
+
+      for (int i = 0; i < 6; ++i) {
+        NDArrayHandle upd_in[] = {params[i], grads[i]};
+        invoke_into("sgd_update", 2, upd_in, params[i], 2, lr_keys,
+                    lr_vals);
+      }
+
+      loss = scalar_of(l) / BATCH;
+      if (first_loss < 0.0f) first_loss = loss;
+      epoch_loss += loss;
+      ++batches;
+
+      NDArrayHandle tmp[] = {h1, h2, h3, h4, h5, h6, h7, l, x, y};
+      for (int i = 0; i < 10; ++i) MXNDArrayFree(tmp[i]);
+    }
+    printf("epoch %d mean_loss %.4f (%d batches)\n", epoch,
+           epoch_loss / (batches > 0 ? batches : 1), batches);
+  }
+  CHECK(MXNDArrayWaitAll());
+  printf("first_loss %.4f final_loss %.4f\n", first_loss, loss);
+  CHECK(MXDataIterFree(it));
+  for (int i = 0; i < 6; ++i) {
+    MXNDArrayFree(params[i]);
+    MXNDArrayFree(grads[i]);
+  }
+  if (!(loss < 0.6f * first_loss)) {
+    fprintf(stderr, "loss did not decrease enough\n");
+    return 1;
+  }
+  printf("OK\n");
+  return 0;
+}

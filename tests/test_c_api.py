@@ -152,3 +152,256 @@ def test_profiler_c_surface():
     assert so.MXAggregateProfileStatsPrint(ctypes.byref(txt), 1) == 0
     assert so.MXSetProfilerState(0) == 0
     assert txt.value.decode().startswith('Name')
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth: imperative invoke, autograd, symbol compose/infer,
+# executor, cached op, data iterators, recordio — and the end-to-end C
+# training program (VERDICT r3 #2)
+# ---------------------------------------------------------------------------
+
+def _vp():
+    return ctypes.c_void_p()
+
+
+def _strs(*vals):
+    arr = (ctypes.c_char_p * len(vals))(*[v.encode() for v in vals])
+    return arr
+
+
+def _find_creator(name):
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_void_p)()
+    so.MXSymbolListAtomicSymbolCreators.argtypes = [
+        ctypes.POINTER(ctypes.c_uint),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p))]
+    assert so.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(arr)) == 0
+    handles = [arr[i] for i in range(n.value)]
+    so.MXSymbolGetAtomicSymbolName.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+    for h in handles:
+        s = ctypes.c_char_p()
+        assert so.MXSymbolGetAtomicSymbolName(h, ctypes.byref(s)) == 0
+        if s.value == name.encode():
+            return ctypes.c_void_p(h)
+    raise AssertionError('creator %s not found' % name)
+
+
+def test_list_all_op_names():
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    so.MXListAllOpNames.argtypes = [
+        ctypes.POINTER(ctypes.c_uint),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    assert so.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr)) == 0
+    names = {arr[i] for i in range(n.value)}
+    assert n.value > 400
+    assert b'Convolution' in names and b'FullyConnected' in names
+
+
+def test_imperative_invoke_and_autograd():
+    so.MXImperativeInvoke.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p)), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p)]
+    x = _new_array((2, 2))
+    buf = (ctypes.c_float * 4)(1, 2, 3, 4)
+    assert so.MXNDArraySyncCopyFromCPU(x, buf, 4) == 0
+    # mark for autograd, run y = x * x recorded, backward, read grad
+    g = _new_array((2, 2))
+    so.MXAutogradMarkVariables.argtypes = [
+        ctypes.c_uint, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint), ctypes.POINTER(ctypes.c_void_p)]
+    vars_ = (ctypes.c_void_p * 1)(x)
+    reqs = (ctypes.c_uint * 1)(1)
+    grads = (ctypes.c_void_p * 1)(g)
+    assert so.MXAutogradMarkVariables(1, vars_, reqs, grads) == 0
+    prev = ctypes.c_int()
+    assert so.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    sq = _find_creator('square')
+    ins = (ctypes.c_void_p * 1)(x)
+    nout = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert so.MXImperativeInvoke(sq, 1, ins, ctypes.byref(nout),
+                                 ctypes.byref(outs), 0, None, None) == 0, \
+        so.MXGetLastError()
+    assert nout.value == 1
+    y = ctypes.c_void_p(outs[0])
+    assert so.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    so.MXAutogradBackward.argtypes = [
+        ctypes.c_uint, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+    heads = (ctypes.c_void_p * 1)(y)
+    assert so.MXAutogradBackward(1, heads, None, 0) == 0, \
+        so.MXGetLastError()
+    got = (ctypes.c_float * 4)()
+    assert so.MXNDArraySyncCopyToCPU(g, got, 4) == 0
+    np.testing.assert_allclose(list(got), [2, 4, 6, 8])  # d(x²)/dx = 2x
+    for h in (x, g, y):
+        so.MXNDArrayFree(h)
+
+
+def test_symbol_compose_infer_and_cached_op():
+    so.MXSymbolCreateVariable.argtypes = [ctypes.c_char_p,
+                                          ctypes.POINTER(ctypes.c_void_p)]
+    data = _vp()
+    assert so.MXSymbolCreateVariable(b'data', ctypes.byref(data)) == 0
+    fc = _find_creator('FullyConnected')
+    so.MXSymbolCreateAtomicSymbol.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_void_p)]
+    node = _vp()
+    assert so.MXSymbolCreateAtomicSymbol(
+        fc, 2, _strs('num_hidden', 'no_bias'), _strs('4', 'True'),
+        ctypes.byref(node)) == 0, so.MXGetLastError()
+    w = _vp()
+    assert so.MXSymbolCreateVariable(b'weight', ctypes.byref(w)) == 0
+    so.MXSymbolCompose.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p)]
+    args = (ctypes.c_void_p * 2)(data, w)
+    assert so.MXSymbolCompose(node, b'fc0', 2, None, args) == 0, \
+        so.MXGetLastError()
+    # arguments now include both inputs
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert so.MXSymbolListArguments(node, ctypes.byref(n),
+                                    ctypes.byref(arr)) == 0
+    assert [arr[i] for i in range(n.value)] == [b'data', b'weight']
+    # shape inference: data (3, 5) -> out (3, 4), weight inferred (4, 5)
+    so.MXSymbolInferShape.argtypes = [ctypes.c_void_p] + \
+        [ctypes.c_uint, ctypes.POINTER(ctypes.c_char_p),
+         ctypes.POINTER(ctypes.c_uint), ctypes.POINTER(ctypes.c_uint)] + \
+        [ctypes.POINTER(ctypes.c_uint),
+         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+         ctypes.POINTER(ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)))] * 3 + \
+        [ctypes.POINTER(ctypes.c_int)]
+    keys = _strs('data')
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shapes = (ctypes.c_uint * 2)(3, 5)
+    sizes = [ctypes.c_uint() for _ in range(3)]
+    ndims = [ctypes.POINTER(ctypes.c_uint)() for _ in range(3)]
+    datas = [ctypes.POINTER(ctypes.POINTER(ctypes.c_uint))()
+             for _ in range(3)]
+    complete = ctypes.c_int()
+    assert so.MXSymbolInferShape(
+        node, 1, keys, indptr, shapes,
+        ctypes.byref(sizes[0]), ctypes.byref(ndims[0]),
+        ctypes.byref(datas[0]),
+        ctypes.byref(sizes[1]), ctypes.byref(ndims[1]),
+        ctypes.byref(datas[1]),
+        ctypes.byref(sizes[2]), ctypes.byref(ndims[2]),
+        ctypes.byref(datas[2]), ctypes.byref(complete)) == 0, \
+        so.MXGetLastError()
+    assert complete.value == 1
+    out_shape = [datas[1][0][d] for d in range(ndims[1][0])]
+    assert out_shape == [3, 4]
+    arg_shapes = [[datas[0][i][d] for d in range(ndims[0][i])]
+                  for i in range(sizes[0].value)]
+    assert arg_shapes == [[3, 5], [4, 5]]
+    # cached op: invoke with 2 inputs in argument order
+    so.MXCreateCachedOp.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_void_p)]
+    cop = _vp()
+    assert so.MXCreateCachedOp(node, ctypes.byref(cop)) == 0
+    xd = _new_array((3, 5))
+    xw = _new_array((4, 5))
+    xbuf = (ctypes.c_float * 15)(*range(15))
+    wbuf = (ctypes.c_float * 20)(*([1.0] * 20))
+    so.MXNDArraySyncCopyFromCPU(xd, xbuf, 15)
+    so.MXNDArraySyncCopyFromCPU(xw, wbuf, 20)
+    so.MXInvokeCachedOp.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p))]
+    cins = (ctypes.c_void_p * 2)(xd, xw)
+    ncout = ctypes.c_int(0)
+    couts = ctypes.POINTER(ctypes.c_void_p)()
+    assert so.MXInvokeCachedOp(cop, 2, cins, ctypes.byref(ncout),
+                               ctypes.byref(couts)) == 0, \
+        so.MXGetLastError()
+    got = (ctypes.c_float * 12)()
+    y = ctypes.c_void_p(couts[0])
+    assert so.MXNDArraySyncCopyToCPU(y, got, 12) == 0
+    want = np.arange(15, dtype='f4').reshape(3, 5) @ np.ones((5, 4), 'f4')
+    np.testing.assert_allclose(np.array(list(got)).reshape(3, 4), want)
+    for h in (data, w, node, cop, xd, xw, y):
+        so.MXNDArrayFree(h)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / 'data.rec').encode()
+    so.MXRecordIOWriterCreate.argtypes = [ctypes.c_char_p,
+                                          ctypes.POINTER(ctypes.c_void_p)]
+    wr = _vp()
+    assert so.MXRecordIOWriterCreate(path, ctypes.byref(wr)) == 0
+    so.MXRecordIOWriterWriteRecord.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p,
+                                               ctypes.c_size_t]
+    for payload in (b'hello', b'worlds!'):
+        assert so.MXRecordIOWriterWriteRecord(wr, payload,
+                                              len(payload)) == 0
+    assert so.MXRecordIOWriterFree(wr) == 0
+    rd = _vp()
+    so.MXRecordIOReaderCreate.argtypes = so.MXRecordIOWriterCreate.argtypes
+    assert so.MXRecordIOReaderCreate(path, ctypes.byref(rd)) == 0
+    so.MXRecordIOReaderReadRecord.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_size_t)]
+    out = []
+    while True:
+        buf = ctypes.c_char_p()
+        size = ctypes.c_size_t()
+        assert so.MXRecordIOReaderReadRecord(rd, ctypes.byref(buf),
+                                             ctypes.byref(size)) == 0
+        if size.value == 0:
+            break
+        out.append(ctypes.string_at(buf, size.value))
+    assert out == [b'hello', b'worlds!']
+    assert so.MXRecordIOReaderFree(rd) == 0
+
+
+def _write_mnist_idx(img_path, lab_path, n=480, seed=0):
+    """Synthetic learnable MNIST-format files: class k lights a block
+    whose position encodes k."""
+    import gzip, struct
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, 10, n).astype(np.uint8)
+    imgs = (rs.rand(n, 28, 28) * 40).astype(np.uint8)
+    for i, k in enumerate(labels):
+        r, c = 2 + (k // 5) * 12, 2 + (k % 5) * 5
+        imgs[i, r:r + 8, c:c + 4] = 220
+    with open(img_path, 'wb') as f:
+        f.write(struct.pack('>IIII', 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lab_path, 'wb') as f:
+        f.write(struct.pack('>II', 2049, n))
+        f.write(labels.tobytes())
+
+
+@pytest.mark.slow
+def test_c_program_trains_lenet(tmp_path):
+    """A standalone C binary (no Python in the translation unit) trains
+    a conv net end-to-end through libmxcapi.so: data iterator →
+    imperative ops → autograd → sgd_update (VERDICT r3 #2 'done'
+    criterion)."""
+    import subprocess
+    import sysconfig
+    img, lab = str(tmp_path / 'img.idx'), str(tmp_path / 'lab.idx')
+    _write_mnist_idx(img, lab)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(root, 'native', 'tests', 'train_lenet_capi.c')
+    build = os.path.join(root, 'mxnet_tpu', 'native', '_build')
+    exe = str(tmp_path / 'train_lenet')
+    subprocess.run(
+        ['g++', '-O1', src, '-o', exe, '-L', build, '-lmxcapi',
+         '-Wl,-rpath,' + build], check=True, capture_output=True)
+    env = dict(os.environ)
+    env['PYTHONPATH'] = root + os.pathsep + env.get('PYTHONPATH', '')
+    env.setdefault('JAX_PLATFORMS', 'cpu')
+    r = subprocess.run([exe, img, lab], capture_output=True, text=True,
+                       timeout=900, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert 'OK' in r.stdout, r.stdout

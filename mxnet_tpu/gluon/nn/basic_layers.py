@@ -104,9 +104,14 @@ class Dense(HybridBlock):
             self.weight.shape = (self._units, fan_in)
 
     def hybrid_forward(self, F, x, weight, bias=None):
-        out = F.FullyConnected(x, weight, bias, no_bias=bias is None,
-                               num_hidden=self._units,
-                               flatten=self._flatten, name='fwd')
+        if bias is None:       # use_bias=False: never pass None inputs
+            out = F.FullyConnected(x, weight, no_bias=True,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten, name='fwd')
+        else:
+            out = F.FullyConnected(x, weight, bias, no_bias=False,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten, name='fwd')
         return out if self.act is None else self.act(out)
 
     def __repr__(self):

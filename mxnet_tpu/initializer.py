@@ -66,6 +66,15 @@ class Initializer:
         ('beta', '_init_beta', 'beta'),
         ('min', '_init_zero', None),
         ('max', '_init_one', None),
+        # norm-layer auxiliary statistics (reference initializer.py
+        # handles the moving_* spellings; gluon-composed symbol graphs
+        # carry the running_* names)
+        ('moving_mean', '_init_zero', None),
+        ('moving_var', '_init_one', None),
+        ('moving_inv_var', '_init_zero', None),
+        ('moving_avg', '_init_zero', None),
+        ('running_mean', '_init_zero', None),
+        ('running_var', '_init_one', None),
     )
 
     def __init__(self, **kwargs):

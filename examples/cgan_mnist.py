@@ -1,0 +1,147 @@
+"""Conditional GAN, AC-GAN style (reference: example/gan — the DCGAN
+family; this is the class-conditional variant). The discriminator has
+an auxiliary class head (Odena 2017), so the generator receives a
+SUPERVISED conditioning gradient — the property that makes class
+control trainable at smoke-test scale where a pure cGAN's implicit
+signal vanishes. Metric: a classifier trained on real data must
+recognize the class each generated sample was asked for. Returns
+(conditional accuracy, chance).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--iters', type=int, default=120)
+    p.add_argument('--num-samples', type=int, default=512)
+    p.add_argument('--classes', type=int, default=4)
+    p.add_argument('--latent', type=int, default=16)
+    p.add_argument('--lr', type=float, default=2e-3)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    from examples.multi_task import synth_digits
+    x_all, y_all = synth_digits(rs, args.num_samples)
+    keep = y_all < args.classes
+    x_np, y_np = x_all[keep], y_all[keep]
+    K, H = args.classes, 16
+
+    def onehot(y):
+        return nd.one_hot(nd.array(y), depth=K)
+
+    class G(gluon.HybridBlock):
+        """Noise MLP plus a learned per-class template: the additive
+        class pathway makes the conditioning signal explicit (the
+        reference's conditional variants concat the label embedding at
+        every layer for the same reason)."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.body = nn.HybridSequential()
+                self.body.add(nn.Dense(128, activation='relu'),
+                              nn.Dense(H * H))
+                self.template = nn.Dense(H * H, use_bias=False)
+
+        def hybrid_forward(self, F, z, c):
+            raw = self.body(F.concat(z, c, dim=1)) + self.template(c)
+            return F.tanh(raw).reshape((-1, 1, H, H))
+
+    class D(gluon.HybridBlock):
+        """Shared trunk with two heads: real/fake logit + class logits
+        (the AC-GAN auxiliary classifier)."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.flat = nn.Flatten()
+                self.trunk = nn.Dense(64, activation='relu')
+                self.rf = nn.Dense(1)
+                self.cls = nn.Dense(K)
+
+        def hybrid_forward(self, F, x):
+            h = self.trunk(self.flat(x))
+            return self.rf(h).reshape((-1,)), self.cls(h)
+
+    gen, dis = G(), D()
+    for b in (gen, dis):
+        b.initialize(mx.init.Xavier())
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    ce_loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    tg = gluon.Trainer(gen.collect_params(), 'adam',
+                       {'learning_rate': args.lr})
+    td = gluon.Trainer(dis.collect_params(), 'adam',
+                       {'learning_rate': args.lr})
+
+    n = len(x_np)
+    xs = nd.array(x_np * 2.0 - 1.0)   # tanh range
+    batch = 64
+    for it in range(args.iters):
+        idx = rs.randint(0, n, batch)
+        real_x = xs[nd.array(idx)]
+        real_y = nd.array(y_np[idx])
+        z = nd.array(rs.randn(batch, args.latent).astype('float32'))
+        fake_y_np = rs.randint(0, K, batch)
+        fake_c = onehot(fake_y_np)
+        fake_y = nd.array(fake_y_np.astype('float32'))
+        # discriminator: real/fake head + class head on real samples
+        with autograd.record():
+            fake_x = gen(z, fake_c).detach()
+            rf_real, cls_real = dis(real_x)
+            rf_fake, _ = dis(fake_x)
+            d_loss = bce(rf_real, nd.ones((batch,)) * 0.9) + \
+                bce(rf_fake, nd.zeros((batch,))) + \
+                ce_loss(cls_real, real_y)
+        d_loss.backward()
+        td.step(batch)
+        # generator: fool the rf head AND hit the requested class
+        with autograd.record():
+            rf_g, cls_g = dis(gen(z, fake_c))
+            g_loss = bce(rf_g, nd.ones((batch,))) + \
+                ce_loss(cls_g, fake_y)
+        g_loss.backward()
+        tg.step(batch)
+
+    # class-conditional fidelity: classifier trained on REAL data must
+    # recognize the class the generator was asked for
+    clf = nn.HybridSequential()
+    with clf.name_scope():
+        clf.add(nn.Flatten(), nn.Dense(64, activation='relu'),
+                nn.Dense(K))
+    clf.initialize(mx.init.Xavier())
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    tc = gluon.Trainer(clf.collect_params(), 'adam',
+                       {'learning_rate': 3e-3})
+    ys = nd.array(y_np)
+    for _ in range(8):
+        for i in range(0, n, batch):
+            with autograd.record():
+                loss = ce(clf(xs[i:i + batch]), ys[i:i + batch])
+            loss.backward()
+            tc.step(min(batch, n - i))
+
+    want = np.arange(256) % K
+    z = nd.array(rs.randn(256, args.latent).astype('float32'))
+    fake = gen(z, onehot(want.astype('float32')))
+    pred = clf(fake).asnumpy().argmax(1)
+    acc = float((pred == want).mean())
+    print('cgan conditional accuracy %.3f (chance %.3f)'
+          % (acc, 1.0 / K))
+    return acc, 1.0 / K
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,101 @@
+"""CNN for sentence classification (reference:
+example/cnn_text_classification — the Kim-2014 architecture: parallel
+width-{3,4,5} convolutions over the embedding matrix, max-over-time
+pooling, concatenation, dense head). Synthetic sentiment corpus: a
+sentence is positive iff it contains more tokens from the "positive"
+half of a keyword set than the negative half. Returns accuracy.
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def make_corpus(rs, n, vocab, seq_len):
+    pos_words = set(range(5, 15))
+    neg_words = set(range(15, 25))
+    x = rs.randint(25, vocab, (n, seq_len))
+    y = np.zeros(n)
+    for i in range(n):
+        k = rs.randint(1, 4)
+        words = rs.choice(sorted(pos_words | neg_words), k, replace=False)
+        pos = rs.choice(seq_len, k, replace=False)
+        x[i, pos] = words
+        score = sum(1 if w in pos_words else -1 for w in words)
+        y[i] = 1.0 if score > 0 else 0.0
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=12)
+    p.add_argument('--num-samples', type=int, default=768)
+    p.add_argument('--vocab', type=int, default=80)
+    p.add_argument('--seq-len', type=int, default=12)
+    p.add_argument('--embed', type=int, default=24)
+    p.add_argument('--filters', type=int, default=16)
+    p.add_argument('--lr', type=float, default=2e-3)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    x_np, y_np = make_corpus(rs, args.num_samples, args.vocab,
+                             args.seq_len)
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = nn.Embedding(args.vocab, args.embed)
+                self.convs = []
+                for j, width in enumerate((3, 4, 5)):
+                    conv = nn.Conv2D(args.filters, (width, args.embed),
+                                     activation='relu')
+                    self.register_child(conv, 'conv%d' % j)
+                    self.convs.append(conv)
+                self.drop = nn.Dropout(0.3)
+                self.out = nn.Dense(2)
+
+        def hybrid_forward(self, F, tokens):
+            emb = self.embed(tokens).expand_dims(1)   # (B,1,L,E)
+            pooled = []
+            for conv in self.convs:
+                c = conv(emb)                          # (B,F,L-w+1,1)
+                pooled.append(F.max(c, axis=(2, 3)))   # max over time
+            h = F.concat(*pooled, dim=1)
+            return self.out(self.drop(h))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    L_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+
+    split = args.num_samples * 3 // 4
+    xs, ys = nd.array(x_np), nd.array(y_np)
+    batch = 64
+    for _ in range(args.epochs):
+        for i in range(0, split, batch):
+            xb, yb = xs[i:i + batch], ys[i:i + batch]
+            with autograd.record():
+                loss = L_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+
+    pred = net(xs[split:]).asnumpy().argmax(axis=1)
+    acc = float((pred == y_np[split:]).mean())
+    print('text-cnn accuracy %.3f' % acc)
+    return acc
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,124 @@
+"""Deep Embedded Clustering (reference:
+example/deep-embedded-clustering — pretrain an autoencoder, then
+refine the encoder by matching the soft cluster assignment (Student-t
+kernel over centroids) to a sharpened target distribution). Returns
+(cluster accuracy via majority mapping, baseline chance).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def gaussian_blobs(rs, n, k, dim, spread=0.18):
+    centers = rs.randn(k, dim) * 1.2
+    y = rs.randint(0, k, n)
+    x = centers[y] + rs.randn(n, dim) * spread
+    return x.astype('float32'), y
+
+
+def cluster_accuracy(assign, labels, k):
+    """Majority-vote mapping from clusters to labels."""
+    total = 0
+    for c in range(k):
+        members = labels[assign == c]
+        if len(members):
+            total += int(np.bincount(members).max())
+    return total / len(labels)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--pretrain-epochs', type=int, default=30)
+    p.add_argument('--refine-iters', type=int, default=30)
+    p.add_argument('--num-samples', type=int, default=512)
+    p.add_argument('--clusters', type=int, default=4)
+    p.add_argument('--dim', type=int, default=16)
+    p.add_argument('--latent', type=int, default=4)
+    p.add_argument('--lr', type=float, default=3e-3)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    K = args.clusters
+    x_np, y_np = gaussian_blobs(rs, args.num_samples, K, args.dim)
+
+    encoder = nn.HybridSequential()
+    decoder = nn.HybridSequential()
+    with encoder.name_scope():
+        encoder.add(nn.Dense(32, activation='relu'),
+                    nn.Dense(args.latent))
+    with decoder.name_scope():
+        decoder.add(nn.Dense(32, activation='relu'),
+                    nn.Dense(args.dim))
+    for blk in (encoder, decoder):
+        blk.initialize(mx.init.Xavier())
+    L2 = gluon.loss.L2Loss()
+    # two trainers (one per sub-net) keep the script simple
+    tr_e = gluon.Trainer(encoder.collect_params(), 'adam',
+                         {'learning_rate': args.lr})
+    tr_d = gluon.Trainer(decoder.collect_params(), 'adam',
+                         {'learning_rate': args.lr})
+
+    xs = nd.array(x_np)
+    for _ in range(args.pretrain_epochs):
+        with autograd.record():
+            loss = L2(decoder(encoder(xs)), xs).mean()
+        loss.backward()
+        tr_e.step(1)
+        tr_d.step(1)
+
+    # init centroids: k-means++ style greedy farthest seeds + 5 Lloyd
+    z = encoder(xs).asnumpy()
+    cent = [z[rs.randint(len(z))]]
+    for _ in range(K - 1):
+        d2 = np.min([((z - c) ** 2).sum(1) for c in cent], axis=0)
+        cent.append(z[int(d2.argmax())])
+    cent = np.stack(cent)
+    for _ in range(5):
+        assign = ((z[:, None, :] - cent[None]) ** 2).sum(-1).argmin(1)
+        for c in range(K):
+            if (assign == c).any():
+                cent[c] = z[assign == c].mean(0)
+
+    centroids = nd.array(cent)
+    centroids.attach_grad()
+    tr_c_lr = args.lr
+
+    def soft_assign(zb):
+        d2 = ((zb.expand_dims(1) - centroids.expand_dims(0)) ** 2).sum(axis=2)
+        q = 1.0 / (1.0 + d2)
+        return q / q.sum(axis=1, keepdims=True)
+
+    for _ in range(args.refine_iters):
+        # target distribution sharpens confident assignments (DEC eq. 3)
+        q_np = soft_assign(encoder(xs)).asnumpy()
+        w = (q_np ** 2) / q_np.sum(0, keepdims=True)
+        p_np = w / w.sum(1, keepdims=True)
+        p_t = nd.array(p_np)
+        with autograd.record():
+            q = soft_assign(encoder(xs))
+            kl = (p_t * (nd.log(p_t + 1e-9) - nd.log(q + 1e-9))) \
+                .sum(axis=1).mean()
+        kl.backward()
+        tr_e.step(1)
+        centroids._data = centroids._data - tr_c_lr * \
+            centroids.grad._data
+
+    assign = soft_assign(encoder(xs)).asnumpy().argmax(1)
+    acc = cluster_accuracy(assign, y_np, K)
+    print('DEC cluster accuracy %.3f (chance %.3f)' % (acc, 1.0 / K))
+    return acc, 1.0 / K
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,91 @@
+"""Dense-Sparse-Dense training (reference: example/dsd — train dense,
+prune the smallest weights to a sparsity mask and retrain under the
+mask, then release the mask and retrain dense at low LR; Han 2017).
+Returns (dense accuracy, sparse-phase accuracy, final accuracy,
+achieved sparsity).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--phase-epochs', type=int, default=6)
+    p.add_argument('--num-samples', type=int, default=512)
+    p.add_argument('--sparsity', type=float, default=0.5)
+    p.add_argument('--lr', type=float, default=3e-3)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    from examples.multi_task import synth_digits
+    x_np, y_np = synth_digits(rs, args.num_samples)
+    split = args.num_samples * 3 // 4
+    xs, ys = nd.array(x_np), nd.array(y_np)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Flatten(), nn.Dense(96, activation='relu'),
+                nn.Dense(48, activation='relu'), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    L_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def accuracy():
+        pred = net(xs[split:]).asnumpy().argmax(1)
+        return float((pred == y_np[split:]).mean())
+
+    def train(epochs, lr, masks=None):
+        trainer = gluon.Trainer(net.collect_params(), 'adam',
+                                {'learning_rate': lr})
+        for _ in range(epochs):
+            for i in range(0, split, 64):
+                xb, yb = xs[i:i + 64], ys[i:i + 64]
+                with autograd.record():
+                    loss = L_fn(net(xb), yb)
+                loss.backward()
+                trainer.step(xb.shape[0])
+                if masks:
+                    for param, mask in masks.items():
+                        param.set_data(param.data() * mask)
+
+    # phase 1: dense
+    train(args.phase_epochs, args.lr)
+    acc_dense = accuracy()
+
+    # phase 2: prune smallest |w| per dense layer, retrain masked
+    masks = {}
+    for name, param in net.collect_params().items():
+        if not name.endswith('weight'):
+            continue
+        w = param.data().asnumpy()
+        thresh = np.quantile(np.abs(w), args.sparsity)
+        masks[param] = nd.array((np.abs(w) > thresh).astype('float32'))
+        param.set_data(param.data() * masks[param])
+    train(args.phase_epochs, args.lr, masks)
+    acc_sparse = accuracy()
+    nnz = sum(float(m.asnumpy().sum()) for m in masks.values())
+    tot = sum(float(m.size) for m in masks.values())
+    sparsity = 1.0 - nnz / tot
+
+    # phase 3: release the mask, retrain dense at lower LR
+    train(args.phase_epochs, args.lr * 0.1)
+    acc_final = accuracy()
+    print('dsd accuracy dense %.3f sparse %.3f final %.3f '
+          '(sparsity %.2f)' % (acc_dense, acc_sparse, acc_final,
+                               sparsity))
+    return acc_dense, acc_sparse, acc_final, sparsity
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,91 @@
+"""Multi-task learning (reference: example/multi-task — one trunk, two
+output heads trained jointly on MNIST digit + derived attribute). Here
+a conv trunk feeds (a) the 10-way digit head and (b) a parity head;
+the combined loss trains both. Returns (digit accuracy, parity
+accuracy).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def synth_digits(rs, n):
+    """16x16 'digit' images: class k = bright bar row/col pattern."""
+    x = (rs.rand(n, 1, 16, 16) * 0.2).astype('float32')
+    y = rs.randint(0, 10, n)
+    for i, k in enumerate(y):
+        x[i, 0, (k * 3) % 14:(k * 3) % 14 + 2, :] += 0.8
+        x[i, 0, :, (k * 5) % 14:(k * 5) % 14 + 2] += 0.6
+    return x, y.astype('float32')
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=10)
+    p.add_argument('--num-samples', type=int, default=768)
+    p.add_argument('--lr', type=float, default=2e-3)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    x_np, y_np = synth_digits(rs, args.num_samples)
+    parity_np = (y_np % 2).astype('float32')
+
+    class MultiTask(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                trunk = nn.HybridSequential()
+                trunk.add(nn.Conv2D(12, 3, padding=1, activation='relu'),
+                          nn.MaxPool2D(2),
+                          nn.Conv2D(24, 3, padding=1, activation='relu'),
+                          nn.MaxPool2D(2), nn.Flatten(),
+                          nn.Dense(64, activation='relu'))
+                self.trunk = trunk
+                self.digit_head = nn.Dense(10)
+                self.parity_head = nn.Dense(2)
+
+        def hybrid_forward(self, F, x):
+            h = self.trunk(x)
+            return self.digit_head(h), self.parity_head(h)
+
+    net = MultiTask()
+    net.initialize(mx.init.Xavier())
+    L_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+
+    split = args.num_samples * 3 // 4
+    xs = nd.array(x_np)
+    yd, yp = nd.array(y_np), nd.array(parity_np)
+    batch = 64
+    for _ in range(args.epochs):
+        for i in range(0, split, batch):
+            xb = xs[i:i + batch]
+            with autograd.record():
+                d_logit, p_logit = net(xb)
+                loss = L_fn(d_logit, yd[i:i + batch]) + \
+                    0.5 * L_fn(p_logit, yp[i:i + batch])
+            loss.backward()
+            trainer.step(xb.shape[0])
+
+    d_logit, p_logit = net(xs[split:])
+    d_acc = float((d_logit.asnumpy().argmax(1) == y_np[split:]).mean())
+    p_acc = float((p_logit.asnumpy().argmax(1) ==
+                   parity_np[split:]).mean())
+    print('multi-task digit acc %.3f parity acc %.3f' % (d_acc, p_acc))
+    return d_acc, p_acc
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,88 @@
+"""Named-entity recognition with a BiLSTM tagger (reference:
+example/named_entity_recognition — sequence labeling over tokens).
+Synthetic corpus: entity tokens are drawn from small dedicated
+vocabulary ranges (PER/LOC), everything else is O; multi-token
+entities tag B-/I- style. Returns (entity-token F1-ish recall,
+tagging accuracy).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+TAGS = ['O', 'B-PER', 'I-PER', 'B-LOC', 'I-LOC']
+
+
+def make_corpus(rs, n, vocab, seq_len):
+    x = rs.randint(40, vocab, (n, seq_len))
+    y = np.zeros((n, seq_len), np.int64)
+    for i in range(n):
+        for _ in range(rs.randint(1, 3)):
+            kind = rs.randint(0, 2)          # 0=PER tokens 10-19, 1=LOC 20-29
+            length = rs.randint(1, 3)
+            start = rs.randint(0, seq_len - length)
+            base = 10 if kind == 0 else 20
+            x[i, start:start + length] = rs.randint(base, base + 10, length)
+            y[i, start] = 1 + 2 * kind                     # B-*
+            y[i, start + 1:start + length] = 2 + 2 * kind  # I-*
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=12)
+    p.add_argument('--num-samples', type=int, default=512)
+    p.add_argument('--vocab', type=int, default=120)
+    p.add_argument('--seq-len', type=int, default=10)
+    p.add_argument('--hidden', type=int, default=48)
+    p.add_argument('--lr', type=float, default=5e-3)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn, rnn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    x_np, y_np = make_corpus(rs, args.num_samples, args.vocab,
+                             args.seq_len)
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Embedding(args.vocab, 24),
+                rnn.LSTM(args.hidden, bidirectional=True, layout='NTC'),
+                nn.Dense(len(TAGS), flatten=False))
+    net.initialize(mx.init.Xavier())
+    L_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+
+    split = args.num_samples * 3 // 4
+    xs, ys = nd.array(x_np), nd.array(y_np.astype('float32'))
+    batch = 64
+    for _ in range(args.epochs):
+        for i in range(0, split, batch):
+            xb, yb = xs[i:i + batch], ys[i:i + batch]
+            with autograd.record():
+                logits = net(xb)
+                loss = L_fn(logits.reshape((-1, len(TAGS))),
+                            yb.reshape((-1,)))
+            loss.backward()
+            trainer.step(xb.shape[0])
+
+    pred = net(xs[split:]).asnumpy().argmax(-1)
+    gold = y_np[split:]
+    acc = float((pred == gold).mean())
+    ent = gold > 0
+    recall = float((pred[ent] == gold[ent]).mean()) if ent.any() else 0.0
+    print('ner entity recall %.3f tagging accuracy %.3f' % (recall, acc))
+    return recall, acc
+
+
+if __name__ == '__main__':
+    main()

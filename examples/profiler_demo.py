@@ -1,0 +1,67 @@
+"""Profiler walkthrough (reference: example/profiler — annotate a
+training loop with profiler scopes, dump the chrome://tracing JSON and
+the aggregate table). Returns (number of trace events, aggregate table
+string length).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--iters', type=int, default=6)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, profiler
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    trace_file = os.path.join(tempfile.mkdtemp(prefix='prof_'),
+                              'profile.json')
+    profiler.set_config(filename=trace_file, profile_all=True)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation='relu'), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), 'sgd',
+                       {'learning_rate': 0.1})
+    x = nd.array(np.random.randn(32, 20).astype('float32'))
+    y = nd.array(np.random.randint(0, 10, 32).astype('float32'))
+
+    profiler.set_state('run')
+    domain = profiler.Marker(None, 'train')
+    for i in range(args.iters):
+        with profiler.scope('iteration'):
+            with autograd.record():
+                loss = L(net(x), y).mean()
+            loss.backward()
+            tr.step(32)
+    nd.waitall()
+    domain.mark()
+    profiler.set_state('stop')
+
+    table = profiler.dumps(reset=False)
+    profiler.dump(finished=True)
+    with open(trace_file) as f:
+        events = json.load(f)['traceEvents']
+    print('profiler captured %d events; aggregate table %d chars'
+          % (len(events), len(table)))
+    assert len(events) > 0 and 'iteration' in table
+    return len(events), len(table)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,102 @@
+"""Post-training int8 quantization walkthrough (reference:
+example/quantization — quantize a trained fp32 model with calibration
+and compare scores/speed). Trains a small conv net, quantizes it with
+each calibration mode (naive / percentile / KL-entropy), reports the
+accuracy drop, and times fp32 vs int8 inference on the current
+backend. Returns dict with per-mode accuracy and the speedup.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=6)
+    p.add_argument('--num-samples', type=int, default=512)
+    p.add_argument('--bench-iters', type=int, default=20)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    from examples.multi_task import synth_digits
+    x_np, y_np = synth_digits(rs, args.num_samples)
+    split = args.num_samples * 3 // 4
+
+    data = mx.sym.Variable('data')
+    h = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                           pad=(1, 1), name='conv1')
+    h = mx.sym.Activation(h, act_type='relu')
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type='max')
+    h = mx.sym.Flatten(h)
+    h = mx.sym.FullyConnected(h, num_hidden=64, name='fc1')
+    h = mx.sym.Activation(h, act_type='relu')
+    h = mx.sym.FullyConnected(h, num_hidden=10, name='fc2')
+    out = mx.sym.SoftmaxOutput(h, name='softmax')
+
+    train = mx.io.NDArrayIter(x_np[:split], y_np[:split], batch_size=64,
+                              shuffle=True)
+    mod = mx.mod.Module(out, label_names=('softmax_label',))
+    mod.fit(train, num_epoch=args.epochs,
+            optimizer_params={'learning_rate': 0.05},
+            initializer=mx.init.Xavier())
+    arg_params, aux_params = mod.get_params()
+
+    def score(sym, params, aux):
+        n_eval = args.num_samples - split
+        ex = sym.bind(mx.context.current_context(),
+                      args=dict(params, data=nd.array(x_np[split:]),
+                                softmax_label=nd.zeros((n_eval,))),
+                      aux_states=dict(aux))
+        outp = ex.forward()[0].asnumpy()
+        return float((outp.argmax(1) == y_np[split:]).mean())
+
+    fp32_acc = score(out, arg_params, aux_params)
+    results = {'fp32': fp32_acc}
+    calib = [nd.array(x_np[i:i + 64]) for i in range(0, split, 64)][:4]
+    qmodels = {}
+    for mode in ('naive', 'percentile', 'entropy'):
+        qsym, qargs, qaux = mx.contrib.quantization.quantize_model(
+            out, arg_params, aux_params, calib_data=calib,
+            calib_mode=mode)
+        results[mode] = score(qsym, qargs, qaux)
+        qmodels[mode] = (qsym, qargs, qaux)
+
+    # inference timing, fp32 vs int8 (entropy-calibrated)
+    def bench(sym, params, aux):
+        x = nd.array(x_np[:64])
+        ex = sym.bind(mx.context.current_context(),
+                      args=dict(params, data=x,
+                                softmax_label=nd.zeros((64,))),
+                      aux_states=dict(aux))
+        ex.forward()[0].wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(args.bench_iters):
+            o = ex.forward()[0]
+        o.wait_to_read()
+        return 64 * args.bench_iters / (time.perf_counter() - t0)
+
+    fp32_ips = bench(out, arg_params, aux_params)
+    q = qmodels['entropy']
+    int8_ips = bench(*q)
+    results['speedup'] = int8_ips / fp32_ips
+    print('quantize_int8 acc fp32 %.3f naive %.3f percentile %.3f '
+          'entropy %.3f | int8 %.0f img/s vs fp32 %.0f img/s '
+          '(x%.2f)' % (results['fp32'], results['naive'],
+                       results['percentile'], results['entropy'],
+                       int8_ips, fp32_ips, results['speedup']))
+    return results
+
+
+if __name__ == '__main__':
+    main()

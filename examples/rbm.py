@@ -1,0 +1,79 @@
+"""Restricted Boltzmann Machine trained with CD-1 (reference:
+example/restricted-boltzmann-machine — binary RBM on MNIST with
+contrastive divergence, reconstruction error as the progress metric).
+Returns (initial reconstruction error, final reconstruction error).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=15)
+    p.add_argument('--num-samples', type=int, default=384)
+    p.add_argument('--visible', type=int, default=64)
+    p.add_argument('--hidden', type=int, default=32)
+    p.add_argument('--lr', type=float, default=0.05)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    # binary patterns: each sample is one of 8 prototype masks + noise
+    protos = (rs.rand(8, args.visible) > 0.6).astype('float32')
+    idx = rs.randint(0, 8, args.num_samples)
+    x_np = protos[idx]
+    flip = rs.rand(*x_np.shape) < 0.05
+    x_np = np.where(flip, 1.0 - x_np, x_np).astype('float32')
+
+    W = nd.array(rs.randn(args.visible, args.hidden) * 0.05)
+    bv = nd.zeros((args.visible,))
+    bh = nd.zeros((args.hidden,))
+
+    def sigmoid(z):
+        return 1.0 / (1.0 + nd.exp(-z))
+
+    def bernoulli(prob):
+        return (nd.random.uniform(shape=prob.shape) < prob) \
+            .astype('float32')
+
+    xs = nd.array(x_np)
+    batch = 64
+
+    def recon_error():
+        ph = sigmoid(nd.dot(xs, W) + bh)
+        pv = sigmoid(nd.dot(ph, W.T) + bv)
+        return float(((pv - xs) ** 2).mean().asscalar())
+
+    first = recon_error()
+    for _ in range(args.epochs):
+        for i in range(0, args.num_samples, batch):
+            v0 = xs[i:i + batch]
+            # CD-1: up, sample, down, up
+            ph0 = sigmoid(nd.dot(v0, W) + bh)
+            h0 = bernoulli(ph0)
+            pv1 = sigmoid(nd.dot(h0, W.T) + bv)
+            v1 = bernoulli(pv1)
+            ph1 = sigmoid(nd.dot(v1, W) + bh)
+            n = v0.shape[0]
+            dW = (nd.dot(v0.T, ph0) - nd.dot(v1.T, ph1)) / n
+            W = W + args.lr * dW
+            bv = bv + args.lr * (v0 - v1).mean(axis=0)
+            bh = bh + args.lr * (ph0 - ph1).mean(axis=0)
+
+    final = recon_error()
+    print('rbm reconstruction error %.4f -> %.4f' % (first, final))
+    return first, final
+
+
+if __name__ == '__main__':
+    main()

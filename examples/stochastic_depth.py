@@ -1,0 +1,111 @@
+"""Stochastic depth training (reference: example/stochastic-depth —
+residual blocks randomly dropped during training with linearly
+decaying survival probability; all blocks active, scaled, at test
+time). Returns (accuracy, mean survival prob).
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=10)
+    p.add_argument('--num-samples', type=int, default=512)
+    p.add_argument('--blocks', type=int, default=6)
+    p.add_argument('--min-survival', type=float, default=0.5)
+    p.add_argument('--lr', type=float, default=2e-3)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    from examples.multi_task import synth_digits
+    x_np, y_np = synth_digits(rs, args.num_samples)
+
+    # survival probability decays linearly with depth (Huang 2016)
+    survival = [1.0 - (1.0 - args.min_survival) * b / (args.blocks - 1)
+                for b in range(args.blocks)]
+
+    class StochasticResBlock(gluon.Block):
+        def __init__(self, channels, p_survive, **kw):
+            super().__init__(**kw)
+            self.p_survive = p_survive
+            with self.name_scope():
+                self.conv1 = nn.Conv2D(channels, 3, padding=1,
+                                       activation='relu')
+                self.conv2 = nn.Conv2D(channels, 3, padding=1)
+
+        def forward(self, x):
+            if autograd.is_training():
+                if np.random.rand() > self.p_survive:
+                    return x                     # block dropped whole
+                return nd.relu(x + self.conv2(self.conv1(x)))
+            # inference: expected-value scaling
+            return nd.relu(x + self.p_survive *
+                           self.conv2(self.conv1(x)))
+
+    class Net(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.stem = nn.Conv2D(16, 3, padding=1,
+                                      activation='relu')
+                self.blocks = []
+                for b in range(args.blocks):
+                    blk = StochasticResBlock(16, survival[b])
+                    self.register_child(blk, 'block%d' % b)
+                    self.blocks.append(blk)
+                self.head = nn.HybridSequential()
+                # the synthetic classes are position-coded: keep the
+                # spatial layout (flatten), don't average it away
+                self.head.add(nn.MaxPool2D(2), nn.Flatten(),
+                              nn.Dense(64, activation='relu'),
+                              nn.Dense(10))
+
+        def forward(self, x):
+            h = self.stem(x)
+            for blk in self.blocks:
+                h = blk(h)
+            return self.head(h)
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    # one inference pass visits EVERY block (no dropping outside
+    # training), finishing deferred shape inference before blocks can
+    # be skipped
+    net(nd.array(x_np[:2]))
+    L_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+
+    split = args.num_samples * 3 // 4
+    xs, ys = nd.array(x_np), nd.array(y_np)
+    batch = 64
+    for _ in range(args.epochs):
+        for i in range(0, split, batch):
+            xb, yb = xs[i:i + batch], ys[i:i + batch]
+            with autograd.record():
+                loss = L_fn(net(xb), yb)
+            loss.backward()
+            # dropped blocks leave stale grads by design
+            trainer.step(xb.shape[0], ignore_stale_grad=True)
+
+    pred = net(xs[split:]).asnumpy().argmax(1)
+    acc = float((pred == y_np[split:]).mean())
+    print('stochastic-depth accuracy %.3f (mean survival %.2f)'
+          % (acc, float(np.mean(survival))))
+    return acc, float(np.mean(survival))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,60 @@
+"""Large-margin (SVM) output layer on an MNIST-style task (reference:
+example/svm_mnist — replaces SoftmaxOutput with SVMOutput and trains
+the same net with hinge loss). Uses the registered SVMOutput op
+through the symbolic Module path so the reference script's structure
+carries over. Returns accuracy.
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=8)
+    p.add_argument('--num-samples', type=int, default=768)
+    p.add_argument('--lr', type=float, default=0.1)
+    p.add_argument('--regularization', type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    from examples.multi_task import synth_digits
+    x_np, y_np = synth_digits(rs, args.num_samples)
+    x_np = x_np.reshape(args.num_samples, -1)
+
+    data = mx.sym.Variable('data')
+    h = mx.sym.FullyConnected(data, num_hidden=128, name='fc1')
+    h = mx.sym.Activation(h, act_type='relu')
+    h = mx.sym.FullyConnected(h, num_hidden=10, name='fc2')
+    out = mx.sym.SVMOutput(h, name='svm',
+                           regularization_coefficient=args.regularization)
+
+    split = args.num_samples * 3 // 4
+    train = mx.io.NDArrayIter(x_np[:split], y_np[:split], batch_size=64,
+                              shuffle=True, label_name='svm_label')
+    mod = mx.mod.Module(out, label_names=('svm_label',))
+    mod.fit(train, num_epoch=args.epochs,
+            optimizer_params={'learning_rate': args.lr},
+            initializer=mx.init.Xavier())
+
+    scores = mod.predict(mx.io.NDArrayIter(
+        x_np[split:], y_np[split:], batch_size=64,
+        label_name='svm_label')).asnumpy()
+    acc = float((scores[:len(y_np) - split].argmax(1) ==
+                 y_np[split:]).mean())
+    print('svm_mnist accuracy %.3f' % acc)
+    return acc
+
+
+if __name__ == '__main__':
+    main()

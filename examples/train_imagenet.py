@@ -1,0 +1,114 @@
+"""ImageNet-style training pipeline (reference:
+example/image-classification/train_imagenet.py:66 — the flagship
+script: images on disk → im2rec packing → ImageRecordIter with
+augmentation → fit). This rendition drives the SAME pipeline stages:
+a folder tree of class images, `tools/im2rec` packing to .rec, an
+augmenting ImageRecordIter, and Module.fit over a resnet — at toy
+scale so it runs anywhere, with `--benchmark` synthesizing data the
+way the reference's --benchmark 1 does. Returns top-1 validation
+accuracy.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def synth_image_tree(root, rs, classes, per_class, size=48):
+    """Class-distinct JPEG tree: class k gets a k-dependent color patch
+    grid — learnable from pixels alone."""
+    import cv2
+    for k in range(classes):
+        d = os.path.join(root, 'class_%02d' % k)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            img = (rs.rand(size, size, 3) * 60).astype('uint8')
+            r, c = (k * 11) % (size - 16), (k * 7) % (size - 16)
+            color = [(k * 37) % 200 + 55, (k * 73) % 200 + 55,
+                     (k * 11) % 200 + 55]
+            img[r:r + 16, c:c + 16] = color
+            cv2.imwrite(os.path.join(d, '%03d.jpg' % i), img)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--classes', type=int, default=4)
+    p.add_argument('--per-class', type=int, default=24)
+    p.add_argument('--batch-size', type=int, default=16)
+    p.add_argument('--num-epochs', type=int, default=8)
+    p.add_argument('--image-shape', default='3,32,32')
+    p.add_argument('--network', default='resnet18_v1')
+    p.add_argument('--lr', type=float, default=0.005)
+    p.add_argument('--data-dir', default=None,
+                   help='existing image folder tree (default: synthesize)')
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.tools import im2rec
+    from mxnet_tpu.gluon import model_zoo
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    shape = tuple(int(s) for s in args.image_shape.split(','))
+
+    workdir = tempfile.mkdtemp(prefix='imagenet_toy_')
+    data_dir = args.data_dir
+    if data_dir is None:
+        data_dir = os.path.join(workdir, 'imgs')
+        synth_image_tree(data_dir, rs, args.classes, args.per_class,
+                         size=max(shape[1] + 16, 48))
+
+    # stage 1: list + pack (the reference's im2rec step)
+    prefix = os.path.join(workdir, 'data')
+    im2rec.main([prefix, data_dir, '--list', '--recursive',
+                 '--train-ratio', '0.75'])
+    for part in ('train', 'val'):
+        im2rec.main(['%s_%s' % (prefix, part), data_dir,
+                     '--resize', str(shape[1] + 8)])
+
+    # stage 2: augmenting record iterators
+    common = dict(data_shape=shape, batch_size=args.batch_size,
+                  label_width=1)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=prefix + '_train.rec', shuffle=True, rand_crop=True,
+        rand_mirror=True, **common)
+    val = mx.io.ImageRecordIter(path_imgrec=prefix + '_val.rec',
+                                **common)
+
+    # stage 3: symbolic net + Module.fit (train_imagenet.py's fit call)
+    import mxnet_tpu.symbol  # noqa: F401
+    net = model_zoo.vision.get_resnet(
+        1, int(args.network.replace('resnet', '').split('_')[0]),
+        classes=args.classes, thumbnail=True)
+    data = mx.sym.Variable('data')
+    sym = net(data) if hasattr(net, '__call__') else None
+    out = mx.sym.SoftmaxOutput(sym, name='softmax')
+
+    mod = mx.mod.Module(out, label_names=('softmax_label',))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer='sgd',
+            optimizer_params={'learning_rate': args.lr, 'momentum': 0.9},
+            initializer=mx.init.Xavier(rnd_type='gaussian',
+                                       factor_type='in', magnitude=2),
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 10))
+
+    metric = mx.metric.Accuracy()
+    val.reset()
+    score = mod.score(val, metric)
+    acc = dict(score)['accuracy'] if isinstance(score, list) else \
+        metric.get()[1]
+    print('train_imagenet top-1 val accuracy %.3f (%d classes)'
+          % (acc, args.classes))
+    return acc
+
+
+if __name__ == '__main__':
+    main()

@@ -166,8 +166,12 @@ def ndarray_at(arr, idx):
     return arr[int(idx)]
 
 
-def ndarray_reshape(arr, dims):
-    return arr.reshape(tuple(int(d) for d in dims))
+def ndarray_reshape(arr, dims, reverse=False):
+    dims = tuple(int(d) for d in dims)
+    if reverse and any(d in (0, -1) for d in dims):
+        raise ValueError('MXNDArrayReshape64 reverse=1 with special '
+                         'dims is not supported')
+    return arr.reshape(dims)
 
 
 def ndarray_context(arr):
@@ -220,15 +224,8 @@ def ndarray_load_from_raw_bytes(buf):
 def ndarray_load_from_buffer(buf):
     """In-memory .params container (reference MXNDArrayLoadFromBuffer)."""
     import io as _io
-    import tempfile, os
-    from .. import nd
-    with tempfile.NamedTemporaryFile(delete=False) as f:
-        f.write(bytes(buf))
-        path = f.name
-    try:
-        loaded = nd.load(path)
-    finally:
-        os.unlink(path)
+    from ..ndarray.ndarray import load_fobj
+    loaded = load_fobj(_io.BytesIO(bytes(buf)))
     if isinstance(loaded, dict):
         names = list(loaded.keys())
         return [loaded[k] for k in names], names
@@ -298,7 +295,9 @@ def autograd_is_training():
 
 def autograd_mark_variables(variables, grad_reqs, gradients):
     from .. import autograd
-    reqs = {1: 'write', 2: 'add', 0: 'null'}
+    # reference OpReqType ABI: 0=null, 1=write, 2=inplace (write
+    # semantics here), 3=add
+    reqs = {0: 'null', 1: 'write', 2: 'write', 3: 'add'}
     autograd.mark_variables(list(variables),
                             list(gradients),
                             [reqs.get(int(r), 'write') for r in grad_reqs])
@@ -347,8 +346,12 @@ def symbol_create_atomic(op_name, param_keys, param_vals):
                      pending_attrs=_parse_vals(param_keys, param_vals))
 
 
-def symbol_compose(handle, name, arg_syms):
+def symbol_compose(handle, name, arg_syms, keys=None):
     from ..symbol.symbol import _create
+    if keys:
+        raise ValueError('MXSymbolCompose keyword-argument binding is '
+                         'not supported; pass inputs positionally in '
+                         'the op input order')
     args = [_sym(s) for s in arg_syms]
     if isinstance(handle, SymHandle) and handle.pending_op is not None:
         handle.sym = _create(handle.pending_op, args,
@@ -362,8 +365,10 @@ def symbol_compose(handle, name, arg_syms):
 
 
 def symbol_copy(h):
-    import copy
-    return SymHandle(copy.deepcopy(_sym(h)))
+    # JSON round-trip: a genuinely independent graph (Symbol deepcopy
+    # shares nodes, so attr edits on the copy would leak back)
+    from ..symbol.symbol import load_json
+    return SymHandle(load_json(_sym(h).tojson()))
 
 
 def symbol_print(h):
@@ -491,7 +496,8 @@ def atomic_creator_info(name):
 def executor_bind(h, dev_type, dev_id, in_args, arg_grads, grad_req_codes,
                   aux_states):
     sym = _sym(h)
-    reqs = {0: 'null', 1: 'write', 2: 'add', 3: 'inplace'}
+    # reference OpReqType ABI: 0=null, 1=write, 2=inplace, 3=add
+    reqs = {0: 'null', 1: 'write', 2: 'inplace', 3: 'add'}
     names = sym.list_arguments()
     grad_req = {n: reqs.get(int(c), 'write')
                 for n, c in zip(names, grad_req_codes)}

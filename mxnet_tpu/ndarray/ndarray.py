@@ -898,21 +898,27 @@ def save(fname, data):
 def load(fname):
     """Load a .params file — reference MXNet format or the legacy private
     npz container from earlier rounds."""
-    import struct
     with open(fname, 'rb') as f:
-        magic, _ = struct.unpack('<QQ', f.read(16))
-        if magic == _MX_LIST_MAGIC:
-            count, = struct.unpack('<Q', f.read(8))
-            arrays = [_mx_load_one(f) for _ in range(count)]
-            nname, = struct.unpack('<Q', f.read(8))
-            names = []
-            for _ in range(nname):
-                ln, = struct.unpack('<Q', f.read(8))
-                names.append(f.read(ln).decode('utf-8'))
-        elif magic == _NDARRAY_MAGIC:
-            return _load_legacy_npz(f)
-        else:
-            raise ValueError('invalid NDArray file %s' % fname)
+        return load_fobj(f, what=fname)
+
+
+def load_fobj(f, what='<buffer>'):
+    """Parse the .params container from any binary file object (the
+    in-memory MXNDArrayLoadFromBuffer path reads a BytesIO)."""
+    import struct
+    magic, _ = struct.unpack('<QQ', f.read(16))
+    if magic == _MX_LIST_MAGIC:
+        count, = struct.unpack('<Q', f.read(8))
+        arrays = [_mx_load_one(f) for _ in range(count)]
+        nname, = struct.unpack('<Q', f.read(8))
+        names = []
+        for _ in range(nname):
+            ln, = struct.unpack('<Q', f.read(8))
+            names.append(f.read(ln).decode('utf-8'))
+    elif magic == _NDARRAY_MAGIC:
+        return _load_legacy_npz(f)
+    else:
+        raise ValueError('invalid NDArray file %s' % what)
     if names:
         return dict(zip(names, arrays))
     return arrays

@@ -696,13 +696,14 @@ int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
 }
 
 int MXNDArrayReshape64(NDArrayHandle handle, int ndim, long long* dims,
-                       bool /*reverse*/, NDArrayHandle* out) {
+                       bool reverse, NDArrayHandle* out) {
   Gil gil;
   PyObject* pdims = PyList_New(ndim);
   for (int i = 0; i < ndim; ++i)
     PyList_SetItem(pdims, i, PyLong_FromLongLong(dims[i]));
-  PyObject* args = Py_BuildValue("(OO)",
-                                 reinterpret_cast<PyObject*>(handle), pdims);
+  PyObject* args = Py_BuildValue(
+      "(OOi)", reinterpret_cast<PyObject*>(handle), pdims,
+      reverse ? 1 : 0);
   Py_DECREF(pdims);
   return out_handle("ndarray_reshape", args, out);
 }
@@ -1059,12 +1060,16 @@ int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
 }
 
 int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
-                    const char** /*keys*/, SymbolHandle* args_in) {
+                    const char** keys, SymbolHandle* args_in) {
   Gil gil;
   PyObject* arr = make_handle_list(num_args, args_in);
+  PyObject* k = keys ? make_str_list(num_args, keys)
+                     : (Py_INCREF(Py_None), Py_None);
   PyObject* args = Py_BuildValue(
-      "(OsO)", reinterpret_cast<PyObject*>(sym), name ? name : "", arr);
+      "(OsOO)", reinterpret_cast<PyObject*>(sym), name ? name : "", arr,
+      k);
   Py_DECREF(arr);
+  Py_DECREF(k);
   return simple("symbol_compose", args);
 }
 

@@ -75,6 +75,10 @@ static AtomicSymbolCreator find_op(const char* want) {
   static AtomicSymbolCreator saved[4096];
   static int saved_init = 0;
   if (!saved_init) {
+    if (n > 4096) {
+      fprintf(stderr, "op registry larger than creator cache\n");
+      exit(2);
+    }
     memcpy(saved, creators, n * sizeof(*creators));
     saved_init = 1;
   }

@@ -17,7 +17,8 @@ from ..ops import registry as _registry
 from ..symbol.symbol import Symbol, _Node
 from ..symbol.graph import num_outputs_of
 
-__all__ = ['quantize_model', 'calib_graph', 'optimal_threshold']
+__all__ = ['quantize_model', 'calib_graph', 'optimal_threshold',
+           'quantize_graph']
 
 
 def _kl_divergence(p, q):
@@ -240,3 +241,74 @@ def quantize_model(sym, arg_params, aux_params, data_names=('data',),
     heads = [(mapping[id(n)], i) for (n, i) in sym._entries]
     qsym = Symbol(heads)
     return qsym, qarg_params, dict(aux_params)
+
+
+def quantize_graph(sym, excluded_sym_names=(), calib_table=None):
+    """Params-less int8 graph rewrite (reference: MXQuantizeSymbol +
+    MXSetCalibTableToQuantizedSymbol, c_api_symbolic.cc / the
+    quantization pass in src/operator/quantization/quantize_graph_pass.cc).
+
+    Unlike quantize_model (which quantizes weights offline from
+    arg_params), every operand quantizes at runtime IN the graph:
+    weights through `_contrib_quantize` fed by min/max reduction nodes,
+    activations through `_contrib_quantize_v2` with calibrated ranges
+    when ``calib_table`` has the layer, runtime min/max otherwise. The
+    returned symbol binds with the ORIGINAL f32 params.
+    """
+    excluded = set(excluded_sym_names or ())
+    calib_table = dict(calib_table or {})
+    nodes = sym._nodes()
+    mapping = {}
+    new_nodes = []
+
+    def _runtime_quant(entry, tag):
+        mn = _Node(_registry.get('min'), tag + '_min',
+                   attrs={}, inputs=[entry], num_outputs=1)
+        mx_ = _Node(_registry.get('max'), tag + '_max',
+                    attrs={}, inputs=[entry], num_outputs=1)
+        q = _Node(_registry.get('_contrib_quantize'), tag + '_quantize',
+                  attrs={'out_type': 'int8'},
+                  inputs=[entry, (mn, 0), (mx_, 0)], num_outputs=3)
+        new_nodes.extend([mn, mx_, q])
+        return q
+
+    for node in nodes:
+        if node.is_variable:
+            nn_ = _Node(None, node.name, var_attrs=dict(node.var_attrs))
+            nn_.is_aux = getattr(node, 'is_aux', False)
+            mapping[id(node)] = nn_
+            new_nodes.append(nn_)
+            continue
+        ins = [(mapping[id(c)], i) for (c, i) in node.inputs]
+        if node.op.name in _QUANTIZABLE and node.name not in excluded:
+            if node.name in calib_table:
+                lo, hi = calib_table[node.name]
+                qd = _Node(_registry.get('_contrib_quantize_v2'),
+                           node.name + '_quantize',
+                           attrs={'min_calib_range': float(lo),
+                                  'max_calib_range': float(hi)},
+                           inputs=[ins[0]], num_outputs=3)
+                new_nodes.append(qd)
+            else:
+                qd = _runtime_quant(ins[0], node.name + '_data')
+            qw = _runtime_quant(ins[1], node.name + '_weight')
+            attrs = dict(node.attrs or {})
+            no_bias = bool(attrs.get('no_bias', False))
+            q_ins = [(qd, 0), (qw, 0)]
+            if not no_bias and len(node.inputs) > 2:
+                q_ins.append(ins[2])
+            q_ins += [(qd, 1), (qd, 2), (qw, 1), (qw, 2)]
+            qnode = _Node(_registry.get(_QUANTIZABLE[node.op.name]),
+                          node.name + '_quantized', attrs=attrs,
+                          inputs=q_ins, num_outputs=1)
+            new_nodes.append(qnode)
+            mapping[id(node)] = qnode
+        else:
+            nn_ = _Node(node.op, node.name,
+                        attrs=dict(node.attrs or {}), inputs=ins,
+                        num_outputs=node.num_outputs)
+            mapping[id(node)] = nn_
+            new_nodes.append(nn_)
+
+    heads = [(mapping[id(n)], i) for (n, i) in sym._entries]
+    return Symbol(heads)

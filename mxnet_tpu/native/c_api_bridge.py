@@ -347,16 +347,19 @@ def symbol_create_atomic(op_name, param_keys, param_vals):
 
 
 def symbol_compose(handle, name, arg_syms, keys=None):
-    from ..symbol.symbol import _create
     if keys:
         raise ValueError('MXSymbolCompose keyword-argument binding is '
                          'not supported; pass inputs positionally in '
                          'the op input order')
     args = [_sym(s) for s in arg_syms]
     if isinstance(handle, SymHandle) and handle.pending_op is not None:
-        handle.sym = _create(handle.pending_op, args,
-                             dict(handle.pending_attrs),
-                             name=name or None)
+        # the generated wrapper owns reference compose semantics:
+        # missing named inputs (weight/bias/gamma/...) auto-create as
+        # <name>_<input> Variables, variadic ops collect lists
+        from .. import symbol as sym_mod
+        fn = getattr(sym_mod, handle.pending_op)
+        handle.sym = fn(*args, name=name or None,
+                        **dict(handle.pending_attrs))
         handle.pending_op = None
     elif not args:
         pass       # composing with no args is a no-op on a built symbol
@@ -807,3 +810,238 @@ def libinfo_features():
     for f in feature_list():
         out += [str(f.name), 1 if f.enabled else 0]
     return out
+
+
+# -- executor simple-bind / reshape ----------------------------------------
+
+def _alloc_executor(sym, ctx, shapes, dtypes, req):
+    """Shared allocation core for simple_bind/reshape: infer shapes,
+    allocate args/grads/aux, build the executor. Returns
+    (executor, arg_list, grad_list_aligned_to_args, aux_list)."""
+    from .. import nd
+    from ..executor import Executor
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    if arg_shapes is None or any(s is None for s in arg_shapes):
+        raise ValueError('simple_bind: shapes are not fully inferable '
+                         'from the provided inputs %r' % (shapes,))
+    arg_names = sym.list_arguments()
+    args = [nd.zeros(tuple(s), ctx=ctx,
+                     dtype=dtypes.get(n, 'float32'))
+            for n, s in zip(arg_names, arg_shapes)]
+    grads = {n: nd.zeros(tuple(s), ctx=ctx,
+                         dtype=dtypes.get(n, 'float32'))
+             for n, s in zip(arg_names, arg_shapes)
+             if req.get(n, 'write') != 'null'}
+    aux = [nd.zeros(tuple(s), ctx=ctx) for s in aux_shapes]
+    ex = Executor(sym, ctx=ctx, args=args, args_grad=grads or None,
+                  grad_req=req, aux_states=aux)
+    return ex, args, [grads.get(n) for n in arg_names], aux
+
+
+def executor_simple_bind(h, dev_type, dev_id, req_names, req_types,
+                         shape_names, shape_idx, shape_data,
+                         dtype_names, dtype_codes):
+    """MXExecutorSimpleBind(Ex) core (reference:
+    c_api_executor.cc SimpleBind). Group-to-context maps, storage
+    types, and shared buffers are not supported on this backend — the
+    C layer ignores those inputs (XLA owns placement/memory)."""
+    sym = _sym(h)
+    ctx = _ctx(dev_type, dev_id)
+    shapes = {}
+    for i, name in enumerate(shape_names):
+        dims = shape_data[shape_idx[i]:shape_idx[i + 1]]
+        shapes[name] = tuple(int(d) for d in dims)
+    dtypes = {n: _DTYPE_BY_CODE[int(c)]
+              for n, c in zip(dtype_names, dtype_codes)}
+    arg_names = sym.list_arguments()
+    req = {n: 'write' for n in arg_names}
+    if req_names is None and req_types:
+        if len(req_types) == 1:                # uniform request
+            req = {k: req_types[0] for k in arg_names}
+        elif len(req_types) == len(arg_names):  # positional per-arg
+            req = dict(zip(arg_names, req_types))
+        else:
+            raise ValueError(
+                'grad-req list of %d entries matches neither 1 nor the '
+                '%d arguments' % (len(req_types), len(arg_names)))
+    else:
+        for n, t in zip(req_names or [], req_types):
+            req[n] = t
+    return _alloc_executor(sym, ctx, shapes, dtypes, req)
+
+
+def executor_reshape(ex, partial_shaping, allow_up_sizing, shape_names,
+                     shape_idx, shape_data):
+    """MXExecutorReshape(Ex): shape-change rebind
+    (reference: c_api_executor.cc Reshape)."""
+    shapes = {}
+    for i, name in enumerate(shape_names):
+        dims = shape_data[shape_idx[i]:shape_idx[i + 1]]
+        shapes[name] = tuple(int(d) for d in dims)
+    new_ex = ex.reshape(partial_shaping=bool(partial_shaping),
+                        allow_up_sizing=bool(allow_up_sizing), **shapes)
+    arg_names = new_ex._symbol.list_arguments()
+    aux_names = new_ex._symbol.list_auxiliary_states()
+    args = [new_ex.arg_dict[n] for n in arg_names]
+    grads = [new_ex.grad_dict.get(n) for n in arg_names]
+    aux = [new_ex.aux_dict[n] for n in aux_names]
+    return new_ex, args, grads, aux
+
+
+def executor_optimized_symbol(ex):
+    """MXExecutorGetOptimizedSymbol: graph-level optimization happens
+    inside XLA, so the bound symbol IS the optimized graph this API
+    can expose (docs/DIVERGENCES.md)."""
+    return SymHandle(ex._symbol)
+
+
+# -- symbol structure extras ------------------------------------------------
+
+def symbol_get_children(h):
+    """MXSymbolGetChildren: the inputs of the head node(s) as a grouped
+    symbol (reference: c_api_symbolic.cc)."""
+    kids = _sym(h).get_children()
+    if kids is None:
+        raise ValueError('symbol has no children')
+    return SymHandle(kids)
+
+
+def symbol_get_inputs(h):
+    """MXSymbolGetInputSymbols: the distinct variable inputs."""
+    from ..symbol.symbol import Symbol
+    s = _sym(h)
+    seen = []
+    for node in s._nodes():
+        if node.is_variable and node not in seen:
+            seen.append(node)
+    return [SymHandle(Symbol([(n, 0)])) for n in seen]
+
+
+def symbol_grad_unsupported():
+    raise ValueError('MXSymbolGrad is deprecated in the reference and '
+                     'unimplemented here; gradients come from autograd '
+                     'or Executor.backward')
+
+
+def gen_backend_subgraph(h, backend):
+    """MXGenBackendSubgraph → the subgraph partition pass
+    (mxnet_tpu/subgraph.py)."""
+    from .. import subgraph as subgraph_mod
+    return SymHandle(subgraph_mod.partition(_sym(h),
+                                            prop=str(backend)))
+
+
+# -- quantization (two-phase reference flow) --------------------------------
+
+def quantize_symbol(h, excluded_names):
+    """MXQuantizeSymbol: the params-less graph rewrite (reference
+    quantize_graph_pass) — every operand quantizes at runtime until
+    set_calib_table replaces activation ranges with calibrated ones.
+    The ORIGINAL symbol and exclusions ride on the handle so the
+    calibration phase can re-run the rewrite with the table."""
+    from ..contrib.quantization import quantize_graph
+    src = _sym(h)
+    out = SymHandle(quantize_graph(src, excluded_sym_names=excluded_names))
+    out.pending_attrs = {'quantize_src': src,
+                         'quantize_excluded': list(excluded_names)}
+    return out
+
+
+def set_calib_table(h, names, lows, highs):
+    """MXSetCalibTableToQuantizedSymbol: re-run the rewrite with the
+    collected layer ranges baked into the activation quantize nodes."""
+    from ..contrib.quantization import quantize_graph
+    if not isinstance(h, SymHandle) or \
+            'quantize_src' not in h.pending_attrs:
+        raise ValueError('symbol was not produced by MXQuantizeSymbol')
+    table = {n: (float(lo), float(hi))
+             for n, lo, hi in zip(names, lows, highs)}
+    return SymHandle(quantize_graph(
+        h.pending_attrs['quantize_src'],
+        excluded_sym_names=h.pending_attrs['quantize_excluded'],
+        calib_table=table))
+
+
+# -- sparse facade aux ------------------------------------------------------
+
+def ndarray_create_sparse(stype_code, shape, dev_type, dev_id, dtype_code):
+    from ..ndarray import sparse as sp
+    stype = {1: 'default', 2: 'row_sparse', 3: 'csr'}.get(int(stype_code),
+                                                          'default')
+    arr = sp.zeros(stype, tuple(int(s) for s in shape),
+                   ctx=_ctx(dev_type, dev_id),
+                   dtype=_DTYPE_BY_CODE[int(dtype_code)])
+    return arr
+
+
+def ndarray_aux_type(arr, i):
+    # CSR aux 0 = indptr (int64), 1 = indices (int64); row_sparse aux 0
+    # = indices — all int64 in this facade (reference kInt64)
+    return _CODE_BY_DTYPE['int64']
+
+
+def ndarray_get_aux(arr, i):
+    stype = getattr(arr, 'stype', 'default')
+    if stype == 'csr':
+        return arr.indptr if int(i) == 0 else arr.indices
+    if stype == 'row_sparse':
+        return arr.indices
+    raise ValueError('dense arrays have no aux data')
+
+
+# -- shared memory ----------------------------------------------------------
+
+_shm_created = []
+
+
+def _shm_cleanup():
+    from multiprocessing import shared_memory
+    for name in _shm_created:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def ndarray_to_shared_mem(arr):
+    """MXNDArrayGetSharedMemHandle: park the bytes in a POSIX shm
+    segment; returns (name, dtype_code). Consumers may attach any
+    number of times (ndarray_from_shared_mem copies without unlinking);
+    the CREATOR process owns the segment and unlinks at exit."""
+    from multiprocessing import shared_memory
+    import atexit
+    data = np.ascontiguousarray(arr.asnumpy())
+    seg = shared_memory.SharedMemory(create=True, size=data.nbytes)
+    np.ndarray(data.shape, data.dtype, buffer=seg.buf)[...] = data
+    name = seg.name
+    seg.close()
+    if not _shm_created:
+        atexit.register(_shm_cleanup)
+    _shm_created.append(name)
+    return name, _CODE_BY_DTYPE[data.dtype.name]
+
+
+def ndarray_from_shared_mem(name, shape, dtype_code):
+    from multiprocessing import shared_memory
+    from .. import nd
+    dt = np.dtype(_DTYPE_BY_CODE[int(dtype_code)])
+    seg = shared_memory.SharedMemory(name=str(name))
+    try:
+        data = np.ndarray(tuple(int(s) for s in shape), dt,
+                          buffer=seg.buf).copy()
+    finally:
+        seg.close()     # creator owns the unlink (see above)
+    return nd.array(data, dtype=dt.name)
+
+
+# -- kvstore sparse-pull facade --------------------------------------------
+
+def kvstore_pull_rowsparse(kv, keys, arrays):
+    """Row-sparse pull: the dense facade pulls full values (the
+    row_id selection is a memory optimization with no TPU analog,
+    docs/DIVERGENCES.md)."""
+    kv.pull(list(keys), out=list(arrays))
+    for a in arrays:
+        a.wait_to_read()

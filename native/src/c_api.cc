@@ -84,6 +84,8 @@ struct ReturnStore {
   std::vector<const unsigned*> sptrs[3];
   std::vector<unsigned long long> idx64;
   std::vector<int> ints;
+  std::vector<void*> handles2;
+  std::vector<void*> handles3;
 };
 thread_local ReturnStore g_ret;
 
@@ -1966,6 +1968,510 @@ int MXGetFunction(const char* /*name*/, void** out) {
   g_last_error = "legacy NDArrayFunction registry is empty; use "
                  "MXImperativeInvoke";
   return -1;
+}
+
+}  // extern "C"
+
+// ===========================================================================
+// Round-4 second wave: SimpleBind/Reshape executors, symbol structure,
+// two-phase quantization, sparse aux, shared memory, engine push
+// ===========================================================================
+
+extern "C" {
+
+static int fill_handle_lists(PyObject* tup, mx_uint* num_in_args,
+                             NDArrayHandle** in_args,
+                             NDArrayHandle** arg_grads,
+                             mx_uint* num_aux, NDArrayHandle** aux_states,
+                             ExecutorHandle* out) {
+  // tup = (executor, [args], [grads-with-None], [aux])
+  PyObject* ex = PyTuple_GetItem(tup, 0);
+  Py_INCREF(ex);
+  *out = ex;
+  PyObject* lists[3] = {PyTuple_GetItem(tup, 1), PyTuple_GetItem(tup, 2),
+                        PyTuple_GetItem(tup, 3)};
+  std::vector<void*>* stores[3] = {&g_ret.handles, &g_ret.handles2,
+                                   &g_ret.handles3};
+  for (int g = 0; g < 3; ++g) {
+    stores[g]->clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(lists[g]); ++i) {
+      PyObject* o = PyList_GetItem(lists[g], i);
+      if (o == Py_None) {
+        stores[g]->push_back(nullptr);
+      } else {
+        Py_INCREF(o);
+        stores[g]->push_back(o);
+      }
+    }
+  }
+  *num_in_args = (mx_uint)g_ret.handles.size();
+  *in_args = g_ret.handles.data();
+  if (arg_grads) *arg_grads = g_ret.handles2.data();
+  *num_aux = (mx_uint)g_ret.handles3.size();
+  *aux_states = g_ret.handles3.data();
+  return 0;
+}
+
+static int simple_bind_impl(SymbolHandle symbol_handle, int dev_type,
+                            int dev_id, mx_uint num_req,
+                            const char** req_names, const char** req_types,
+                            mx_uint num_shapes, const char** shape_names,
+                            const void* shape_data, int shape_data_is_int,
+                            const mx_uint* shape_idx, mx_uint num_dtypes,
+                            const char** dtype_names, const int* dtypes,
+                            mx_uint* num_in_args, NDArrayHandle** in_args,
+                            NDArrayHandle** arg_grads, mx_uint* num_aux,
+                            NDArrayHandle** aux_states,
+                            ExecutorHandle* out) {
+  ensure_python();
+  Gil gil;
+  // names==NULL means positional (or uniform single-entry) semantics
+  PyObject* rn = req_names ? make_str_list(num_req, req_names)
+                           : (Py_INCREF(Py_None), Py_None);
+  PyObject* rt = make_str_list(num_req, req_types);
+  PyObject* sn = make_str_list(num_shapes, shape_names);
+  mx_uint total = num_shapes ? shape_idx[num_shapes] : 0;
+  PyObject* sd = PyList_New(total);
+  for (mx_uint i = 0; i < total; ++i) {
+    long v = shape_data_is_int
+        ? (long)((const int*)shape_data)[i]
+        : (long)((const mx_uint*)shape_data)[i];
+    PyList_SetItem(sd, i, PyLong_FromLong(v));
+  }
+  PyObject* si = make_uint_list(num_shapes + 1, shape_idx);
+  PyObject* dn = make_str_list(num_dtypes, dtype_names);
+  PyObject* dc = PyList_New(num_dtypes);
+  for (mx_uint i = 0; i < num_dtypes; ++i)
+    PyList_SetItem(dc, i, PyLong_FromLong(dtypes ? dtypes[i] : 0));
+  PyObject* args = Py_BuildValue(
+      "(OiiOOOOOOO)", reinterpret_cast<PyObject*>(symbol_handle), dev_type,
+      dev_id, rn, rt, sn, si, sd, dn, dc);
+  Py_DECREF(rn); Py_DECREF(rt); Py_DECREF(sn); Py_DECREF(si);
+  Py_DECREF(sd); Py_DECREF(dn); Py_DECREF(dc);
+  PyObject* tup = call("executor_simple_bind", args);
+  Py_DECREF(args);
+  if (!tup) { set_error_from_python(); return -1; }
+  int rc = fill_handle_lists(tup, num_in_args, in_args, arg_grads,
+                             num_aux, aux_states, out);
+  Py_DECREF(tup);
+  return rc;
+}
+
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint /*num_g2c_keys*/, const char** /*g2c_keys*/,
+    const int* /*g2c_dev_types*/, const int* /*g2c_dev_ids*/,
+    const mx_uint provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const mx_uint* provided_arg_shape_data,
+    const mx_uint* provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const mx_uint /*num_provided_arg_stypes*/,
+    const char** /*provided_arg_stype_names*/,
+    const int* /*provided_arg_stypes*/,
+    const mx_uint /*num_shared_arg_names*/,
+    const char** /*shared_arg_name_list*/, int* shared_buffer_len,
+    const char** /*shared_buffer_name_list*/,
+    NDArrayHandle* /*shared_buffer_handle_list*/,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    mx_uint* num_in_args, NDArrayHandle** in_args,
+    NDArrayHandle** arg_grads, mx_uint* num_aux_states,
+    NDArrayHandle** aux_states, ExecutorHandle /*shared_exec_handle*/,
+    ExecutorHandle* out) {
+  // shared buffers / group2ctx / stypes have no analog here (XLA owns
+  // memory and placement); report the shared buffer as unused
+  if (shared_buffer_len) *shared_buffer_len = -1;
+  if (updated_shared_buffer_name_list)
+    *updated_shared_buffer_name_list = nullptr;
+  if (updated_shared_buffer_handle_list)
+    *updated_shared_buffer_handle_list = nullptr;
+  return simple_bind_impl(
+      symbol_handle, dev_type, dev_id, provided_grad_req_list_len,
+      provided_grad_req_names, provided_grad_req_types,
+      num_provided_arg_shapes, provided_arg_shape_names,
+      provided_arg_shape_data, /*is_int=*/0, provided_arg_shape_idx,
+      num_provided_arg_dtypes, provided_arg_dtype_names,
+      provided_arg_dtypes, num_in_args, in_args, arg_grads,
+      num_aux_states, aux_states, out);
+}
+
+int MXExecutorSimpleBindEx(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const int* provided_arg_shape_data,
+    const mx_uint* provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char** shared_arg_name_list,
+    int* shared_buffer_len, const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list,
+    mx_uint* num_in_args, NDArrayHandle** in_args,
+    NDArrayHandle** arg_grads, mx_uint* num_aux_states,
+    NDArrayHandle** aux_states, ExecutorHandle shared_exec_handle,
+    ExecutorHandle* out) {
+  (void)num_g2c_keys; (void)g2c_keys; (void)g2c_dev_types;
+  (void)g2c_dev_ids; (void)num_provided_arg_stypes;
+  (void)provided_arg_stype_names; (void)provided_arg_stypes;
+  (void)num_shared_arg_names; (void)shared_arg_name_list;
+  (void)shared_buffer_name_list; (void)shared_buffer_handle_list;
+  (void)shared_exec_handle;
+  if (shared_buffer_len) *shared_buffer_len = -1;
+  if (updated_shared_buffer_name_list)
+    *updated_shared_buffer_name_list = nullptr;
+  if (updated_shared_buffer_handle_list)
+    *updated_shared_buffer_handle_list = nullptr;
+  return simple_bind_impl(
+      symbol_handle, dev_type, dev_id, provided_grad_req_list_len,
+      provided_grad_req_names, provided_grad_req_types,
+      num_provided_arg_shapes, provided_arg_shape_names,
+      provided_arg_shape_data, /*is_int=*/1, provided_arg_shape_idx,
+      num_provided_arg_dtypes, provided_arg_dtype_names,
+      provided_arg_dtypes, num_in_args, in_args, arg_grads,
+      num_aux_states, aux_states, out);
+}
+
+static int reshape_impl(int partial_shaping, int allow_up_sizing,
+                        mx_uint num_shapes, const char** names,
+                        const void* data, int data_is_int,
+                        const mx_uint* idx, mx_uint* num_in_args,
+                        NDArrayHandle** in_args, NDArrayHandle** arg_grads,
+                        mx_uint* num_aux, NDArrayHandle** aux_states,
+                        ExecutorHandle shared_exec, ExecutorHandle* out) {
+  ensure_python();
+  Gil gil;
+  PyObject* sn = make_str_list(num_shapes, names);
+  mx_uint total = num_shapes ? idx[num_shapes] : 0;
+  PyObject* sd = PyList_New(total);
+  for (mx_uint i = 0; i < total; ++i) {
+    long v = data_is_int ? (long)((const int*)data)[i]
+                         : (long)((const mx_uint*)data)[i];
+    PyList_SetItem(sd, i, PyLong_FromLong(v));
+  }
+  PyObject* si = make_uint_list(num_shapes + 1, idx);
+  PyObject* args = Py_BuildValue(
+      "(OiiOOO)", reinterpret_cast<PyObject*>(shared_exec),
+      partial_shaping, allow_up_sizing, sn, si, sd);
+  Py_DECREF(sn); Py_DECREF(si); Py_DECREF(sd);
+  PyObject* tup = call("executor_reshape", args);
+  Py_DECREF(args);
+  if (!tup) { set_error_from_python(); return -1; }
+  int rc = fill_handle_lists(tup, num_in_args, in_args, arg_grads,
+                             num_aux, aux_states, out);
+  Py_DECREF(tup);
+  return rc;
+}
+
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                      int /*dev_type*/, int /*dev_id*/,
+                      mx_uint /*num_map_keys*/, const char** /*map_keys*/,
+                      const int* /*map_dev_types*/,
+                      const int* /*map_dev_ids*/, mx_uint num_provided,
+                      const char** provided_names,
+                      const mx_uint* provided_data,
+                      const mx_uint* provided_idx, mx_uint* num_in_args,
+                      NDArrayHandle** in_args, NDArrayHandle** arg_grads,
+                      mx_uint* num_aux_states, NDArrayHandle** aux_states,
+                      ExecutorHandle shared_exec, ExecutorHandle* out) {
+  return reshape_impl(partial_shaping, allow_up_sizing, num_provided,
+                      provided_names, provided_data, /*is_int=*/0,
+                      provided_idx, num_in_args, in_args, arg_grads,
+                      num_aux_states, aux_states, shared_exec, out);
+}
+
+int MXExecutorReshapeEx(int partial_shaping, int allow_up_sizing,
+                        int /*dev_type*/, int /*dev_id*/,
+                        mx_uint /*num_map_keys*/, const char** /*map_keys*/,
+                        const int* /*map_dev_types*/,
+                        const int* /*map_dev_ids*/, mx_uint num_provided,
+                        const char** provided_names,
+                        const int* provided_data,
+                        const mx_uint* provided_idx, mx_uint* num_in_args,
+                        NDArrayHandle** in_args, NDArrayHandle** arg_grads,
+                        mx_uint* num_aux_states, NDArrayHandle** aux_states,
+                        ExecutorHandle shared_exec, ExecutorHandle* out) {
+  return reshape_impl(partial_shaping, allow_up_sizing, num_provided,
+                      provided_names, provided_data, /*is_int=*/1,
+                      provided_idx, num_in_args, in_args, arg_grads,
+                      num_aux_states, aux_states, shared_exec, out);
+}
+
+int MXExecutorGetOptimizedSymbol(ExecutorHandle handle,
+                                 SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_handle("executor_optimized_symbol", args, out);
+}
+
+// -- symbol structure ------------------------------------------------------
+
+int MXSymbolGetChildren(SymbolHandle sym, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  return out_handle("symbol_get_children", args, out);
+}
+
+int MXSymbolGetInputSymbols(SymbolHandle sym, SymbolHandle** inputs,
+                            int* input_size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  return out_handle_list("symbol_get_inputs", args, input_size,
+                         reinterpret_cast<void***>(inputs));
+}
+
+int MXSymbolGrad(SymbolHandle /*sym*/, mx_uint /*num_wrt*/,
+                 const char** /*wrt*/, SymbolHandle* /*out*/) {
+  // reference parity: MXSymbolGrad is deprecated and fails there too
+  Gil gil;
+  PyObject* r = call("symbol_grad_unsupported", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return -1;
+}
+
+int MXGenBackendSubgraph(SymbolHandle sym, const char* backend,
+                         SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(Os)", reinterpret_cast<PyObject*>(sym), backend);
+  return out_handle("gen_backend_subgraph", args, out);
+}
+
+// -- quantization ----------------------------------------------------------
+
+int MXQuantizeSymbol(SymbolHandle sym_handle, SymbolHandle* ret_sym_handle,
+                     const mx_uint num_excluded, const char** excluded,
+                     const mx_uint /*num_offline*/,
+                     const char** /*offline_params*/,
+                     const char* /*quantized_dtype*/,
+                     const bool /*calib_quantize*/) {
+  Gil gil;
+  PyObject* ex = make_str_list(num_excluded, excluded);
+  PyObject* args = Py_BuildValue(
+      "(OO)", reinterpret_cast<PyObject*>(sym_handle), ex);
+  Py_DECREF(ex);
+  return out_handle("quantize_symbol", args, ret_sym_handle);
+}
+
+int MXSetCalibTableToQuantizedSymbol(SymbolHandle qsym_handle,
+                                     const mx_uint num_layers,
+                                     const char** layer_names,
+                                     const float* low_quantiles,
+                                     const float* high_quantiles,
+                                     SymbolHandle* ret_sym_handle) {
+  Gil gil;
+  PyObject* names = make_str_list(num_layers, layer_names);
+  PyObject* lows = PyList_New(num_layers);
+  PyObject* highs = PyList_New(num_layers);
+  for (mx_uint i = 0; i < num_layers; ++i) {
+    PyList_SetItem(lows, i, PyFloat_FromDouble(low_quantiles[i]));
+    PyList_SetItem(highs, i, PyFloat_FromDouble(high_quantiles[i]));
+  }
+  PyObject* args = Py_BuildValue(
+      "(OOOO)", reinterpret_cast<PyObject*>(qsym_handle), names, lows,
+      highs);
+  Py_DECREF(names); Py_DECREF(lows); Py_DECREF(highs);
+  return out_handle("set_calib_table", args, ret_sym_handle);
+}
+
+// -- sparse facade aux -----------------------------------------------------
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint* shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int /*delay_alloc*/, int dtype,
+                            mx_uint /*num_aux*/, int* /*aux_type*/,
+                            mx_uint* /*aux_ndims*/,
+                            const mx_uint* /*aux_shape*/,
+                            NDArrayHandle* out) {
+  Gil gil;
+  PyObject* pyshape = make_uint_list(ndim, shape);
+  PyObject* args = Py_BuildValue("(iOiii)", storage_type, pyshape,
+                                 dev_type, dev_id, dtype);
+  Py_DECREF(pyshape);
+  return out_handle("ndarray_create_sparse", args, out);
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int* out_type) {
+  Gil gil;
+  long v = 0;
+  PyObject* args = Py_BuildValue(
+      "(OI)", reinterpret_cast<PyObject*>(handle), i);
+  if (out_long("ndarray_aux_type", args, &v) != 0) return -1;
+  *out_type = (int)v;
+  return 0;
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OI)", reinterpret_cast<PyObject*>(handle), i);
+  return out_handle("ndarray_get_aux", args, out);
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_handle("ndarray_detach", args, out);
+}
+
+// -- shared memory ---------------------------------------------------------
+
+// POSIX shm segments are named, not (pid, id) pairs: names are
+// interned in a process-lifetime table, the index is the id, and the
+// pid slot carries a scheme marker. Cross-process callers exchange the
+// NAME via MXNDArraySharedMemName (an extension entry point below).
+static std::vector<std::string>& shm_names() {
+  static std::vector<std::string>* names = new std::vector<std::string>();
+  return *names;
+}
+
+int MXNDArrayGetSharedMemHandle(NDArrayHandle handle, int* shared_pid,
+                                int* shared_id) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* tup = call("ndarray_to_shared_mem", args);
+  Py_DECREF(args);
+  if (!tup) { set_error_from_python(); return -1; }
+  const char* nm = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
+  shm_names().push_back(nm ? nm : "");
+  Py_DECREF(tup);
+  *shared_pid = 0;
+  *shared_id = (int)shm_names().size() - 1;
+  return 0;
+}
+
+int MXNDArraySharedMemName(int shared_id, const char** out_name) {
+  // extension: the POSIX name for cross-process exchange
+  if (shared_id < 0 || (size_t)shared_id >= shm_names().size()) {
+    g_last_error = "unknown shared-mem id";
+    return -1;
+  }
+  g_ret.text = shm_names()[shared_id];
+  *out_name = g_ret.text.c_str();
+  return 0;
+}
+
+int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                 const mx_uint* shape, mx_uint ndim,
+                                 int dtype, NDArrayHandle* out) {
+  ensure_python();
+  Gil gil;
+  (void)shared_pid;
+  if (shared_id < 0 || (size_t)shared_id >= shm_names().size()) {
+    g_last_error = "unknown shared-mem id (cross-process callers attach "
+                   "by name via MXNDArraySharedMemName)";
+    return -1;
+  }
+  PyObject* pyshape = make_uint_list(ndim, shape);
+  PyObject* args = Py_BuildValue(
+      "(sOi)", shm_names()[shared_id].c_str(), pyshape, dtype);
+  Py_DECREF(pyshape);
+  return out_handle("ndarray_from_shared_mem", args, out);
+}
+
+int MXNDArrayCreateFromSharedMemEx(int shared_pid, int shared_id,
+                                   const int* shape, int ndim, int dtype,
+                                   NDArrayHandle* out) {
+  std::vector<mx_uint> u(shape, shape + ndim);
+  return MXNDArrayCreateFromSharedMem(shared_pid, shared_id, u.data(),
+                                      (mx_uint)ndim, dtype, out);
+}
+
+// -- kvstore sparse pulls --------------------------------------------------
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int* keys, NDArrayHandle* vals,
+                           const NDArrayHandle* /*row_ids*/,
+                           int /*priority*/) {
+  return MXKVStorePull(handle, num, keys, vals, 0);
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char** keys, NDArrayHandle* vals,
+                             const NDArrayHandle* /*row_ids*/,
+                             int /*priority*/) {
+  return MXKVStorePullEx(handle, num, keys, vals, 0);
+}
+
+int MXKVStorePullWithSparse(KVStoreHandle handle, mx_uint num,
+                            const int* keys, NDArrayHandle* vals,
+                            int /*priority*/, bool /*ignore_sparse*/) {
+  return MXKVStorePull(handle, num, keys, vals, 0);
+}
+
+int MXKVStorePullWithSparseEx(KVStoreHandle handle, mx_uint num,
+                              const char** keys, NDArrayHandle* vals,
+                              int /*priority*/, bool /*ignore_sparse*/) {
+  return MXKVStorePullEx(handle, num, keys, vals, 0);
+}
+
+// -- engine push -----------------------------------------------------------
+
+typedef void (*EngineSyncFunc)(void* rctx, void* const* const_vars,
+                               void* const* mutate_vars);
+typedef void (*EngineAsyncFunc)(void* rctx, void* on_complete_param,
+                                void* const* const_vars,
+                                void* const* mutate_vars);
+typedef void (*EngineFuncParamDeleter)(void* param);
+
+static void engine_noop_complete(void*) {}
+
+int MXEnginePushSync(EngineSyncFunc sync_func, void* func_param,
+                     void* deleter, void* /*ctx_handle*/,
+                     void* const* const_vars_handle, int /*num_const_vars*/,
+                     void* const* mutate_vars_handle,
+                     int /*num_mutate_vars*/, void* /*prop_handle*/,
+                     int /*priority*/, const char* /*opr_name*/) {
+  // the execution engine is synchronous at the host level (XLA owns
+  // async device work): run the function inline — identical observable
+  // semantics to the reference's dependency-ordered push
+  if (!sync_func) {
+    g_last_error = "MXEnginePushSync: null function";
+    return -1;
+  }
+  sync_func(func_param, const_vars_handle, mutate_vars_handle);
+  if (deleter)
+    reinterpret_cast<EngineFuncParamDeleter>(deleter)(func_param);
+  return 0;
+}
+
+int MXEnginePushAsync(EngineAsyncFunc async_func, void* func_param,
+                      void* deleter, void* /*ctx_handle*/,
+                      void* const* const_vars_handle,
+                      int /*num_const_vars*/,
+                      void* const* mutate_vars_handle,
+                      int /*num_mutate_vars*/, void* /*prop_handle*/,
+                      int /*priority*/, const char* /*opr_name*/,
+                      bool /*wait*/) {
+  if (!async_func) {
+    g_last_error = "MXEnginePushAsync: null function";
+    return -1;
+  }
+  // the inline engine completes immediately: hand the function a VALID
+  // no-op completion callback (conforming callers invoke it)
+  async_func(func_param, reinterpret_cast<void*>(&engine_noop_complete),
+             const_vars_handle, mutate_vars_handle);
+  if (deleter)
+    reinterpret_cast<EngineFuncParamDeleter>(deleter)(func_param);
+  return 0;
 }
 
 }  // extern "C"

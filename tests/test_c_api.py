@@ -405,3 +405,143 @@ def test_c_program_trains_lenet(tmp_path):
                        timeout=900, env=env)
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert 'OK' in r.stdout, r.stdout
+
+
+def test_executor_simple_bind_and_reshape():
+    """MXExecutorSimpleBindEx allocates args/grads/aux from shapes and
+    runs forward/backward; MXExecutorReshapeEx rebinds."""
+    data = _vp()
+    assert so.MXSymbolCreateVariable(b'data', ctypes.byref(data)) == 0
+    fc = _find_creator('FullyConnected')
+    node = _vp()
+    assert so.MXSymbolCreateAtomicSymbol(
+        fc, 1, _strs('num_hidden'), _strs('4'), ctypes.byref(node)) == 0
+    args = (ctypes.c_void_p * 1)(data)
+    assert so.MXSymbolCompose(node, b'fc', 1, None, args) == 0
+
+    vp, u = ctypes.c_void_p, ctypes.c_uint
+    so.MXExecutorSimpleBindEx.restype = ctypes.c_int
+    shape_names = _strs('data')
+    shape_idx = (u * 2)(0, 2)
+    shape_data = (ctypes.c_int * 2)(5, 3)
+    n_in = u()
+    in_args = ctypes.POINTER(vp)()
+    arg_grads = ctypes.POINTER(vp)()
+    n_aux = u()
+    aux = ctypes.POINTER(vp)()
+    shared_len = ctypes.c_int(-1)
+    ex = vp()
+    rc = so.MXExecutorSimpleBindEx(
+        node, 1, 0,                       # cpu(0)
+        0, None, None, None,              # no group2ctx
+        0, None, None,                    # default grad req
+        1, shape_names, shape_data, shape_idx,
+        0, None, None,                    # dtypes
+        0, None, None,                    # stypes
+        0, None, ctypes.byref(shared_len), None, None, None, None,
+        ctypes.byref(n_in), ctypes.byref(in_args),
+        ctypes.byref(arg_grads), ctypes.byref(n_aux), ctypes.byref(aux),
+        None, ctypes.byref(ex))
+    assert rc == 0, so.MXGetLastError()
+    assert n_in.value == 3        # data, weight, bias
+    # seed inputs, run fwd+bwd through the executor surface
+    for i, size in enumerate((15, 12, 4)):
+        buf = (ctypes.c_float * size)(*([0.1] * size))
+        assert so.MXNDArraySyncCopyFromCPU(
+            ctypes.c_void_p(in_args[i]), buf, size) == 0
+    assert so.MXExecutorForward(ex, 1) == 0
+    n_out = u()
+    outs = ctypes.POINTER(vp)()
+    assert so.MXExecutorOutputs(ex, ctypes.byref(n_out),
+                                ctypes.byref(outs)) == 0
+    assert n_out.value == 1
+    got = (ctypes.c_float * 20)()
+    assert so.MXNDArraySyncCopyToCPU(ctypes.c_void_p(outs[0]), got,
+                                     20) == 0
+    np.testing.assert_allclose(list(got), [0.1 * 0.1 * 3 + 0.1] * 20,
+                               rtol=1e-5)
+    # reshape to a bigger batch
+    new_shape = (ctypes.c_int * 2)(10, 3)
+    ex2 = vp()
+    rc = so.MXExecutorReshapeEx(
+        1, 1, 1, 0, 0, None, None, None,
+        1, shape_names, new_shape, shape_idx,
+        ctypes.byref(n_in), ctypes.byref(in_args),
+        ctypes.byref(arg_grads), ctypes.byref(n_aux), ctypes.byref(aux),
+        ex, ctypes.byref(ex2))
+    assert rc == 0, so.MXGetLastError()
+    assert so.MXExecutorForward(ex2, 0) == 0
+
+
+def test_sparse_aux_and_storage_type():
+    import ctypes as ct
+    shape = (ct.c_uint * 2)(2, 3)
+    out = ct.c_void_p()
+    assert so.MXNDArrayCreateSparseEx(3, shape, 2, 1, 0, 0, 0, 0, None,
+                                      None, None, ct.byref(out)) == 0
+    st = ct.c_int()
+    assert so.MXNDArrayGetStorageType(out, ct.byref(st)) == 0
+    assert st.value == 3          # kCSRStorage
+    aux_t = ct.c_int()
+    assert so.MXNDArrayGetAuxType(out, 0, ct.byref(aux_t)) == 0
+    assert aux_t.value == 6       # int64 type flag
+    aux_nd = ct.c_void_p()
+    assert so.MXNDArrayGetAuxNDArray(out, 0, ct.byref(aux_nd)) == 0
+    dim = ct.c_uint()
+    pdata = ct.POINTER(ct.c_uint)()
+    assert so.MXNDArrayGetShape(aux_nd, ct.byref(dim),
+                                ct.byref(pdata)) == 0
+    assert dim.value == 1 and pdata[0] == 3   # indptr rows+1
+    for hh in (out, aux_nd):
+        so.MXNDArrayFree(hh)
+
+
+def test_shared_mem_roundtrip():
+    x = _new_array((2, 4))
+    buf = (ctypes.c_float * 8)(*range(8))
+    assert so.MXNDArraySyncCopyFromCPU(x, buf, 8) == 0
+    pid = ctypes.c_int()
+    sid = ctypes.c_int()
+    assert so.MXNDArrayGetSharedMemHandle(x, ctypes.byref(pid),
+                                          ctypes.byref(sid)) == 0
+    shape = (ctypes.c_uint * 2)(2, 4)
+    y = ctypes.c_void_p()
+    assert so.MXNDArrayCreateFromSharedMem(pid.value, sid.value, shape,
+                                           2, 0, ctypes.byref(y)) == 0
+    got = (ctypes.c_float * 8)()
+    assert so.MXNDArraySyncCopyToCPU(y, got, 8) == 0
+    np.testing.assert_allclose(list(got), list(range(8)))
+    so.MXNDArrayFree(x)
+    so.MXNDArrayFree(y)
+
+
+def test_quantize_symbol_two_phase():
+    """MXQuantizeSymbol inserts runtime-range quantize nodes;
+    MXSetCalibTableToQuantizedSymbol re-rewrites with calibrated
+    activation ranges (the reference two-phase flow)."""
+    data = _vp()
+    assert so.MXSymbolCreateVariable(b'data', ctypes.byref(data)) == 0
+    fc = _find_creator('FullyConnected')
+    node = _vp()
+    assert so.MXSymbolCreateAtomicSymbol(
+        fc, 1, _strs('num_hidden'), _strs('4'), ctypes.byref(node)) == 0
+    args = (ctypes.c_void_p * 1)(data)
+    assert so.MXSymbolCompose(node, b'fc', 1, None, args) == 0
+    qsym = _vp()
+    so.MXQuantizeSymbol.argtypes = None
+    assert so.MXQuantizeSymbol(node, ctypes.byref(qsym), 0, None, 0,
+                               None, b'int8', False) == 0, \
+        so.MXGetLastError()
+    js = ctypes.c_char_p()
+    assert so.MXSymbolSaveToJSON(qsym, ctypes.byref(js)) == 0
+    assert b'_contrib_quantized_fully_connected' in js.value
+    assert b'_contrib_quantize' in js.value
+    names = _strs('fc')
+    lows = (ctypes.c_float * 1)(-3.0)
+    highs = (ctypes.c_float * 1)(3.0)
+    csym = _vp()
+    assert so.MXSetCalibTableToQuantizedSymbol(
+        qsym, 1, names, lows, highs, ctypes.byref(csym)) == 0, \
+        so.MXGetLastError()
+    assert so.MXSymbolSaveToJSON(csym, ctypes.byref(js)) == 0
+    assert b'_contrib_quantize_v2' in js.value      # calibrated input

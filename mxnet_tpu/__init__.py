@@ -34,6 +34,7 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import recordio
+from . import plugin
 from . import io
 from . import gluon
 from . import parallel

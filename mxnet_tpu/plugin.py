@@ -1,0 +1,85 @@
+"""Out-of-tree operator plugins.
+
+Reference analog: the `plugin/` tree (caffe/torch operators compiled
+into the op registry, plugin/caffe/caffe_operator.cc) and the dynamic
+op-library loader. On this backend a plugin is a Python module (or
+file) that registers pure-JAX ops; `register_op` puts the op into the
+SAME registry the built-ins live in and attaches the generated
+`mx.nd.*` / `mx.sym.*` wrappers immediately, so plugin ops are
+indistinguishable from in-tree ones — they hybridize, differentiate
+through `jax.vjp` (or a supplied custom bwd), serialize into symbol
+JSON, and appear in `MXListAllOpNames` over the C ABI.
+
+Typical plugin::
+
+    from mxnet_tpu import plugin
+    import jax.numpy as jnp
+
+    @plugin.register_op('swish4', num_inputs=1)
+    def swish4(data, *, beta=4.0):
+        return data * jax.nn.sigmoid(beta * data)
+
+    # mx.nd.swish4 / mx.sym.swish4 exist from this point on
+
+Host-callback (non-jittable) plugin ops should use
+`mx.operator.CustomOp` instead — that path runs eagerly by design.
+See docs/OP_PLUGINS.md for the full recipe.
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+
+from .ops import registry as _registry
+
+__all__ = ['register_op', 'load', 'attach_namespaces']
+
+
+def attach_namespaces(name):
+    """Attach nd/sym wrappers for a registered op name (idempotent)."""
+    op = _registry.OPS[name]
+    from . import ndarray as nd_pkg
+    from .ndarray import register as nd_reg
+    w = nd_reg._make_wrapper(name, op)
+    setattr(nd_pkg.op, name, w)
+    setattr(nd_pkg, name, w)
+    from . import symbol as sym_pkg
+    from .symbol import register as sym_reg
+    sw = sym_reg._make_wrapper(name, op)
+    setattr(sym_pkg.op, name, sw)
+    setattr(sym_pkg, name, sw)
+
+
+def register_op(name, **reg_kwargs):
+    """Register a pure-JAX function as a framework op (decorator).
+
+    Accepts the same keywords as ops.registry.register (num_inputs,
+    num_outputs, needs_rng, nojit, bwd, aliases, ...). The wrapper
+    namespaces refresh immediately.
+    """
+    base = _registry.register(name, **reg_kwargs)
+
+    def _do(fn):
+        out = base(fn)
+        attach_namespaces(name)
+        for alias in reg_kwargs.get('aliases', ()):
+            attach_namespaces(alias)
+        return out
+    return _do
+
+
+def load(path_or_module):
+    """Load a plugin: a Python file path or an importable module name
+    (reference analog: mx.library.load on a compiled op library). The
+    module's import-time `register_op` calls do the work; returns the
+    module."""
+    if os.path.exists(str(path_or_module)):
+        modname = 'mxnet_tpu_plugin_%s' % (
+            os.path.splitext(os.path.basename(str(path_or_module)))[0])
+        spec = importlib.util.spec_from_file_location(
+            modname, str(path_or_module))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    return importlib.import_module(str(path_or_module))

@@ -1,0 +1,79 @@
+"""Operator plugin seam (reference: plugin/ caffe/torch op registration
+— the out-of-tree-op capability; docs/OP_PLUGINS.md)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, plugin
+
+
+def test_register_op_everywhere(tmp_path):
+    src = tmp_path / 'my_plugin.py'
+    src.write_text('''
+import jax.numpy as jnp
+from mxnet_tpu import plugin
+
+@plugin.register_op('cube_plus', num_inputs=1)
+def cube_plus(data, *, bias=0.0):
+    return data * data * data + bias
+''')
+    plugin.load(str(src))
+
+    # eager namespace
+    x = nd.array(np.array([1.0, 2.0, -1.0], 'f'))
+    np.testing.assert_allclose(nd.cube_plus(x, bias=1.0).asnumpy(),
+                               [2.0, 9.0, 0.0])
+    # autograd through jax.vjp
+    x.attach_grad()
+    with autograd.record():
+        y = nd.cube_plus(x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 12.0, 3.0])
+    # symbolic + JSON round trip + executor
+    d = mx.sym.Variable('data')
+    s = mx.sym.cube_plus(d, bias=2.0)
+    s2 = mx.sym.load_json(s.tojson())
+    ex = s2.bind(mx.cpu(), args={'data': x})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               [3.0, 10.0, 1.0])
+    # registry visibility (the same table the C ABI lists)
+    from mxnet_tpu.ops import registry
+    assert 'cube_plus' in registry.OPS
+
+
+def test_plugin_op_hybridizes():
+    from mxnet_tpu import plugin as pl
+    import jax.numpy as jnp
+
+    @pl.register_op('scaled_square', num_inputs=1)
+    def scaled_square(data, *, scale=2.0):
+        return scale * data * data
+
+    from mxnet_tpu.gluon import nn, HybridBlock
+
+    class Net(HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.scaled_square(x, scale=3.0)
+
+    net = Net()
+    net.hybridize()
+    out = net(nd.array(np.array([2.0], 'f')))
+    np.testing.assert_allclose(out.asnumpy(), [12.0])
+
+
+def test_plugin_load_module_name(monkeypatch, tmp_path):
+    src = tmp_path / 'plugmod.py'
+    src.write_text('''
+from mxnet_tpu import plugin
+
+@plugin.register_op('neg_abs', num_inputs=1)
+def neg_abs(data):
+    import jax.numpy as jnp
+    return -jnp.abs(data)
+''')
+    import sys
+    monkeypatch.syspath_prepend(str(tmp_path))
+    plugin.load('plugmod')
+    np.testing.assert_allclose(
+        nd.neg_abs(nd.array(np.array([-3.0, 2.0], 'f'))).asnumpy(),
+        [-3.0, -2.0])

@@ -186,6 +186,9 @@ class Executor:
             if name in self.aux_dict:
                 self.aux_dict[name]._data = val
         if self._monitor_callback is not None:
+            if getattr(self, '_monitor_all', False):
+                for name, arr in self.arg_dict.items():
+                    self._monitor_callback(name, arr)
             for name, out in zip(self._symbol.list_outputs(), self.outputs):
                 self._monitor_callback(name, out)
         return self.outputs
@@ -288,7 +291,13 @@ class Executor:
                                      'auxiliary states' % name)
 
     def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-forward monitor. monitor_all additionally fires
+        the callback for every bound input before the outputs (the
+        reference monitors every node's inputs/outputs; intermediate
+        fusion products do not materialize under XLA, so inputs +
+        outputs are the observable tensors here)."""
         self._monitor_callback = callback
+        self._monitor_all = bool(monitor_all)
 
     def debug_str(self):
         return self._symbol.debug_str()

@@ -439,11 +439,17 @@ def symbol_to_file(h, fname):
 
 
 def symbol_infer_shape(h, keys, ind_ptr, shape_data, partial):
-    """Returns (arg_shapes, out_shapes, aux_shapes, complete)."""
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete). Shapes
+    containing an unknown-dim marker (0 here; the int Ex API's -1 maps
+    to 0 at the C layer) count as not-provided for that argument —
+    per-dimension partial knowledge is not expressible in this
+    planner."""
     s = _sym(h)
     kwargs = {}
     for i, k in enumerate(keys):
         dims = shape_data[ind_ptr[i]:ind_ptr[i + 1]]
+        if any(int(d) <= 0 for d in dims):
+            continue
         kwargs[k] = tuple(int(d) for d in dims)
     fn = s.infer_shape_partial if partial else s.infer_shape
     arg, out, aux = fn(**kwargs)
@@ -1045,3 +1051,62 @@ def kvstore_pull_rowsparse(kv, keys, arrays):
     kv.pull(list(keys), out=list(arrays))
     for a in arrays:
         a.wait_to_read()
+
+
+# -- C-callback trampolines (monitor / updater) -----------------------------
+
+def executor_set_monitor(ex, callback_addr, param_addr, monitor_all):
+    """MXExecutorSetMonitorCallback(EX): wrap the C function pointer
+    with ctypes and install it as the executor's monitor. The callback
+    receives (name, borrowed NDArray handle, param)."""
+    import ctypes
+    cb = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)(int(callback_addr))
+
+    def monitor(name, arr):
+        # the handle is borrowed for the duration of the call; `arr`
+        # stays alive in this frame
+        cb(str(name).encode(), id(arr), int(param_addr))
+
+    ex.set_monitor_callback(monitor, monitor_all=bool(monitor_all))
+
+
+def kvstore_set_updater(kv, int_addr, str_addr, param_addr):
+    """MXKVStoreSetUpdater(Ex): install C update functions. The store
+    dispatches per key type — int keys to the int updater, string keys
+    to the string updater (falling back to whichever exists, with the
+    key stringified/parsed). Arrays are borrowed for the call."""
+    import ctypes
+    int_cb = ctypes.CFUNCTYPE(
+        None, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p)(int(int_addr)) if int_addr else None
+    str_cb = ctypes.CFUNCTYPE(
+        None, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p)(int(str_addr)) if str_addr else None
+
+    def updater(key, recv, local):
+        if isinstance(key, str):
+            if str_cb is not None:
+                str_cb(key.encode(), id(recv), id(local), int(param_addr))
+            else:
+                int_cb(int(key), id(recv), id(local), int(param_addr))
+        else:
+            if int_cb is not None:
+                int_cb(int(key), id(recv), id(local), int(param_addr))
+            else:
+                str_cb(str(key).encode(), id(recv), id(local),
+                       int(param_addr))
+
+    kv._set_updater(updater)
+
+
+# -- raw data access --------------------------------------------------------
+
+def ndarray_host_bytes(arr):
+    """Contiguous host copy for MXNDArrayGetData (the C side parks it
+    in the per-thread return store; the pointer is valid until the next
+    string/bytes-returning call on that thread — reference return-store
+    semantics)."""
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+

@@ -43,12 +43,16 @@ def attach_namespaces(name):
     from .ndarray import register as nd_reg
     w = nd_reg._make_wrapper(name, op)
     setattr(nd_pkg.op, name, w)
-    setattr(nd_pkg, name, w)
+    if not hasattr(nd_pkg, name) or getattr(nd_pkg, name) is w:
+        # same guard the built-in promotion uses: never clobber
+        # package-level API (nd.load, nd.zeros, ...) with an op wrapper
+        setattr(nd_pkg, name, w)
     from . import symbol as sym_pkg
     from .symbol import register as sym_reg
     sw = sym_reg._make_wrapper(name, op)
     setattr(sym_pkg.op, name, sw)
-    setattr(sym_pkg, name, sw)
+    if not hasattr(sym_pkg, name) or getattr(sym_pkg, name) is sw:
+        setattr(sym_pkg, name, sw)
 
 
 def register_op(name, **reg_kwargs):
@@ -75,11 +79,23 @@ def load(path_or_module):
     module's import-time `register_op` calls do the work; returns the
     module."""
     if os.path.exists(str(path_or_module)):
-        modname = 'mxnet_tpu_plugin_%s' % (
-            os.path.splitext(os.path.basename(str(path_or_module)))[0])
-        spec = importlib.util.spec_from_file_location(
-            modname, str(path_or_module))
+        import hashlib
+        import sys
+        path = os.path.abspath(str(path_or_module))
+        modname = 'mxnet_tpu_plugin_%s_%s' % (
+            os.path.splitext(os.path.basename(path))[0],
+            hashlib.sha1(path.encode()).hexdigest()[:8])
+        if modname in sys.modules:
+            return sys.modules[modname]
+        spec = importlib.util.spec_from_file_location(modname, path)
         mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        # registered BEFORE exec (importlib recipe): import-time
+        # machinery inside the plugin can see its own module
+        sys.modules[modname] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop(modname, None)
+            raise
         return mod
     return importlib.import_module(str(path_or_module))

@@ -2475,3 +2475,220 @@ int MXEnginePushAsync(EngineAsyncFunc async_func, void* func_param,
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// Round-4 third wave: Ex shape inference, C callbacks, raw data, and the
+// CUDA-less Rtc/legacy-Func surfaces (reference parity: a reference
+// build without USE_CUDA fails these the same way)
+// ===========================================================================
+
+extern "C" {
+
+int MXSymbolInferShapeEx(SymbolHandle sym, mx_uint num_args,
+                         const char** keys, const mx_uint* arg_ind_ptr,
+                         const int* arg_shape_data,
+                         mx_uint* in_shape_size, const int** in_shape_ndim,
+                         const int*** in_shape_data, mx_uint* out_shape_size,
+                         const int** out_shape_ndim,
+                         const int*** out_shape_data, mx_uint* aux_shape_size,
+                         const int** aux_shape_ndim,
+                         const int*** aux_shape_data, int* complete) {
+  // run the unsigned-shape implementation, then view the stores as int
+  // (the backing vectors hold small positive dims)
+  mx_uint total = num_args ? arg_ind_ptr[num_args] : 0;
+  std::vector<mx_uint> u(total);
+  for (mx_uint i = 0; i < total; ++i)
+    u[i] = arg_shape_data[i] < 0 ? 0u   // -1 = unknown -> 0 marker
+                                 : (mx_uint)arg_shape_data[i];
+  mx_uint sizes[3];
+  const mx_uint* ndims[3];
+  const mx_uint** datas[3];
+  int rc = MXSymbolInferShape(sym, num_args, keys, arg_ind_ptr, u.data(),
+                              &sizes[0], &ndims[0], &datas[0], &sizes[1],
+                              &ndims[1], &datas[1], &sizes[2], &ndims[2],
+                              &datas[2], complete);
+  if (rc != 0) return rc;
+  *in_shape_size = sizes[0];
+  *out_shape_size = sizes[1];
+  *aux_shape_size = sizes[2];
+  *in_shape_ndim = reinterpret_cast<const int*>(ndims[0]);
+  *out_shape_ndim = reinterpret_cast<const int*>(ndims[1]);
+  *aux_shape_ndim = reinterpret_cast<const int*>(ndims[2]);
+  *in_shape_data = reinterpret_cast<const int**>(datas[0]);
+  *out_shape_data = reinterpret_cast<const int**>(datas[1]);
+  *aux_shape_data = reinterpret_cast<const int**>(datas[2]);
+  return 0;
+}
+
+int MXSymbolInferShapePartialEx(
+    SymbolHandle sym, mx_uint num_args, const char** keys,
+    const mx_uint* arg_ind_ptr, const int* arg_shape_data,
+    mx_uint* in_shape_size, const int** in_shape_ndim,
+    const int*** in_shape_data, mx_uint* out_shape_size,
+    const int** out_shape_ndim, const int*** out_shape_data,
+    mx_uint* aux_shape_size, const int** aux_shape_ndim,
+    const int*** aux_shape_data, int* complete) {
+  mx_uint total = num_args ? arg_ind_ptr[num_args] : 0;
+  std::vector<mx_uint> u(total);
+  for (mx_uint i = 0; i < total; ++i)
+    u[i] = arg_shape_data[i] < 0 ? 0u   // -1 = unknown -> 0 marker
+                                 : (mx_uint)arg_shape_data[i];
+  mx_uint sizes[3];
+  const mx_uint* ndims[3];
+  const mx_uint** datas[3];
+  int rc = MXSymbolInferShapePartial(
+      sym, num_args, keys, arg_ind_ptr, u.data(), &sizes[0], &ndims[0],
+      &datas[0], &sizes[1], &ndims[1], &datas[1], &sizes[2], &ndims[2],
+      &datas[2], complete);
+  if (rc != 0) return rc;
+  *in_shape_size = sizes[0];
+  *out_shape_size = sizes[1];
+  *aux_shape_size = sizes[2];
+  *in_shape_ndim = reinterpret_cast<const int*>(ndims[0]);
+  *out_shape_ndim = reinterpret_cast<const int*>(ndims[1]);
+  *aux_shape_ndim = reinterpret_cast<const int*>(ndims[2]);
+  *in_shape_data = reinterpret_cast<const int**>(datas[0]);
+  *out_shape_data = reinterpret_cast<const int**>(datas[1]);
+  *aux_shape_data = reinterpret_cast<const int**>(datas[2]);
+  return 0;
+}
+
+// -- monitor / updater callbacks ------------------------------------------
+
+typedef void (*ExecutorMonitorCallback)(const char*, NDArrayHandle, void*);
+
+static int set_monitor_impl(ExecutorHandle handle,
+                            ExecutorMonitorCallback callback,
+                            void* callback_handle, int monitor_all) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKKi)", reinterpret_cast<PyObject*>(handle),
+      (unsigned long long)(uintptr_t)callback,
+      (unsigned long long)(uintptr_t)callback_handle, monitor_all);
+  return simple("executor_set_monitor", args);
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle) {
+  return set_monitor_impl(handle, callback, callback_handle, 0);
+}
+
+int MXExecutorSetMonitorCallbackEX(ExecutorHandle handle,
+                                   ExecutorMonitorCallback callback,
+                                   void* callback_handle,
+                                   bool monitor_all) {
+  return set_monitor_impl(handle, callback, callback_handle,
+                          monitor_all ? 1 : 0);
+}
+
+typedef void (*MXKVStoreUpdater)(int, NDArrayHandle, NDArrayHandle, void*);
+typedef void (*MXKVStoreStrUpdater)(const char*, NDArrayHandle,
+                                    NDArrayHandle, void*);
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle,
+                          MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void* updater_handle) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(OKKK)", reinterpret_cast<PyObject*>(handle),
+      (unsigned long long)(uintptr_t)updater,
+      (unsigned long long)(uintptr_t)str_updater,
+      (unsigned long long)(uintptr_t)updater_handle);
+  return simple("kvstore_set_updater", args);
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void* updater_handle) {
+  return MXKVStoreSetUpdaterEx(handle, updater, nullptr, updater_handle);
+}
+
+// -- raw data --------------------------------------------------------------
+
+int MXNDArrayGetData(NDArrayHandle handle, void** out_pdata) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* bytes = call("ndarray_host_bytes", args);
+  Py_DECREF(args);
+  if (!bytes) { set_error_from_python(); return -1; }
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &n) != 0) {
+    PyErr_Clear();
+    Py_DECREF(bytes);
+    g_last_error = "MXNDArrayGetData: bridge returned non-bytes";
+    return -1;
+  }
+  // READ-ONLY host snapshot with per-thread return-store lifetime
+  // (valid until the next string/bytes-returning call on this thread).
+  // Unlike the reference's live CPU buffer, writes through this
+  // pointer do NOT reach the device array — use
+  // MXNDArraySyncCopyFromCPU to mutate.
+  g_ret.text.assign(buf, n);
+  Py_DECREF(bytes);
+  *out_pdata = const_cast<char*>(g_ret.text.data());
+  return 0;
+}
+
+// -- Rtc family: reference parity for a CUDA-less build --------------------
+
+static int rtc_unavailable() {
+  g_last_error = "Rtc requires CUDA, which this TPU build does not have "
+                 "(same failure as a reference build without USE_CUDA); "
+                 "write accelerator kernels with Pallas instead "
+                 "(docs/OP_PLUGINS.md)";
+  return -1;
+}
+
+int MXRtcCreate(char*, mx_uint, mx_uint, char**, char**, NDArrayHandle*,
+                NDArrayHandle*, char*, void** /*out*/) {
+  return rtc_unavailable();
+}
+int MXRtcPush(void*, mx_uint, mx_uint, NDArrayHandle*, NDArrayHandle*,
+              mx_uint, mx_uint, mx_uint, mx_uint, mx_uint, mx_uint) {
+  return rtc_unavailable();
+}
+int MXRtcFree(void*) { return rtc_unavailable(); }
+int MXRtcCudaModuleCreate(const char*, int, const char**, void**) {
+  return rtc_unavailable();
+}
+int MXRtcCudaModuleFree(void*) { return rtc_unavailable(); }
+int MXRtcCudaKernelCreate(void*, const char*, int, int*, int*, int*,
+                          void**) {
+  return rtc_unavailable();
+}
+int MXRtcCudaKernelFree(void*) { return rtc_unavailable(); }
+int MXRtcCudaKernelCall(void*, int, void**, mx_uint, mx_uint, mx_uint,
+                        mx_uint, mx_uint, mx_uint) {
+  return rtc_unavailable();
+}
+
+// -- legacy NDArrayFunction registry (empty on this backend) ---------------
+
+static int func_registry_empty() {
+  g_last_error = "the legacy NDArrayFunction registry is empty on this "
+                 "backend: every op is an imperative op "
+                 "(MXImperativeInvoke / MXListAllOpNames)";
+  return -1;
+}
+
+int MXFuncDescribe(void*, mx_uint*, mx_uint*, mx_uint*, int*) {
+  return func_registry_empty();
+}
+int MXFuncGetInfo(void*, const char**, const char**, mx_uint*,
+                  const char***, const char***, const char***,
+                  const char**) {
+  return func_registry_empty();
+}
+int MXFuncInvoke(void*, NDArrayHandle*, float*, NDArrayHandle*) {
+  return func_registry_empty();
+}
+int MXFuncInvokeEx(void*, NDArrayHandle*, float*, NDArrayHandle*, int,
+                   char**, char**) {
+  return func_registry_empty();
+}
+
+}  // extern "C"

@@ -545,3 +545,90 @@ def test_quantize_symbol_two_phase():
         so.MXGetLastError()
     assert so.MXSymbolSaveToJSON(csym, ctypes.byref(js)) == 0
     assert b'_contrib_quantize_v2' in js.value      # calibrated input
+
+
+def test_monitor_and_updater_callbacks_and_getdata():
+    """C-function-pointer callbacks: executor monitor fires per output,
+    kvstore updater receives push merges; MXNDArrayGetData exposes the
+    host bytes."""
+    # --- GetData
+    x = _new_array((2, 2))
+    buf = (ctypes.c_float * 4)(5, 6, 7, 8)
+    assert so.MXNDArraySyncCopyFromCPU(x, buf, 4) == 0
+    p = ctypes.c_void_p()
+    assert so.MXNDArrayGetData(x, ctypes.byref(p)) == 0
+    vals = ctypes.cast(p, ctypes.POINTER(ctypes.c_float))
+    assert [vals[i] for i in range(4)] == [5, 6, 7, 8]
+
+    # --- executor monitor callback
+    data = _vp()
+    assert so.MXSymbolCreateVariable(b'data', ctypes.byref(data)) == 0
+    fc = _find_creator('FullyConnected')
+    node = _vp()
+    assert so.MXSymbolCreateAtomicSymbol(
+        fc, 2, _strs('num_hidden', 'no_bias'), _strs('2', 'True'),
+        ctypes.byref(node)) == 0
+    w = _vp()
+    assert so.MXSymbolCreateVariable(b'w', ctypes.byref(w)) == 0
+    args = (ctypes.c_void_p * 2)(data, w)
+    assert so.MXSymbolCompose(node, b'fc', 2, None, args) == 0
+    xd, xw = _new_array((2, 3)), _new_array((2, 3))
+    reqs = (ctypes.c_uint * 2)(0, 0)
+    grads = (ctypes.c_void_p * 2)(None, None)
+    ex = _vp()
+    so.MXExecutorBind.argtypes = None
+    assert so.MXExecutorBind(node, 1, 0, 2,
+                             (ctypes.c_void_p * 2)(xd, xw), grads, reqs,
+                             0, None, ctypes.byref(ex)) == 0, \
+        so.MXGetLastError()
+    seen = []
+    MON = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_void_p)
+
+    def _mon(name, handle, param):
+        seen.append((name, handle != 0, param))
+    mon = MON(_mon)
+    assert so.MXExecutorSetMonitorCallback(
+        ex, ctypes.cast(mon, ctypes.c_void_p),
+        ctypes.c_void_p(1234)) == 0, so.MXGetLastError()
+    assert so.MXExecutorForward(ex, 0) == 0
+    assert seen and seen[0][1] and seen[0][2] == 1234, seen
+
+    # --- kvstore updater callback
+    kv = ctypes.c_void_p()
+    assert so.MXKVStoreCreate(b'local', ctypes.byref(kv)) == 0
+    UPD = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+    hits = []
+
+    def _upd(key, recv, local, param):
+        hits.append(key)
+        # local += recv through the C copy surface
+        got = (ctypes.c_float * 4)()
+        so.MXNDArraySyncCopyToCPU(ctypes.c_void_p(recv), got, 4)
+        cur = (ctypes.c_float * 4)()
+        so.MXNDArraySyncCopyToCPU(ctypes.c_void_p(local), cur, 4)
+        upd = (ctypes.c_float * 4)(*[g + c for g, c in zip(got, cur)])
+        so.MXNDArraySyncCopyFromCPU(ctypes.c_void_p(local), upd, 4)
+    updater = UPD(_upd)
+    assert so.MXKVStoreSetUpdater(kv, ctypes.cast(updater,
+                                                  ctypes.c_void_p),
+                                  None) == 0, so.MXGetLastError()
+    init_v = _new_array((4,))
+    keys = (ctypes.c_int * 1)(7)
+    vals = (ctypes.c_void_p * 1)(init_v)
+    assert so.MXKVStoreInit(kv, 1, keys, vals) == 0
+    push_v = _new_array((4,))
+    pbuf = (ctypes.c_float * 4)(1, 2, 3, 4)
+    so.MXNDArraySyncCopyFromCPU(push_v, pbuf, 4)
+    pvals = (ctypes.c_void_p * 1)(push_v)
+    assert so.MXKVStorePush(kv, 1, keys, pvals, 0) == 0
+    pull_v = _new_array((4,))
+    ovals = (ctypes.c_void_p * 1)(pull_v)
+    assert so.MXKVStorePull(kv, 1, keys, ovals, 0) == 0
+    got = (ctypes.c_float * 4)()
+    so.MXNDArraySyncCopyToCPU(pull_v, got, 4)
+    assert hits == [7], hits
+    np.testing.assert_allclose(list(got), [1, 2, 3, 4])
+    for h in (x, data, w, node, xd, xw, ex, kv, init_v, push_v, pull_v):
+        so.MXNDArrayFree(h)

@@ -293,3 +293,31 @@ def test_dataloader_abandoned_iterator_cleans_shm():
                     shared_memory.SharedMemory(name=slot.name)
     del it, dl
     gc.collect()
+
+
+def test_mnist_iter_reads_idx_files(tmp_path):
+    """MNISTIter parses idx files and batches through the delegating
+    base (regression: the iterator-dedup refactor briefly left it
+    without reset/next)."""
+    import struct
+    rs = np.random.RandomState(0)
+    n = 40
+    labels = rs.randint(0, 10, n).astype(np.uint8)
+    imgs = (rs.rand(n, 28, 28) * 255).astype(np.uint8)
+    img_p, lab_p = str(tmp_path / 'i.idx'), str(tmp_path / 'l.idx')
+    with open(img_p, 'wb') as f:
+        f.write(struct.pack('>IIII', 2051, n, 28, 28) + imgs.tobytes())
+    with open(lab_p, 'wb') as f:
+        f.write(struct.pack('>II', 2049, n) + labels.tobytes())
+    it = mx.io.MNISTIter(image=img_p, label=lab_p, batch_size=16,
+                         shuffle=False)
+    batches = list(it)
+    assert len(batches) == 3                      # 40 -> 16/16/8+pad
+    assert batches[0].data[0].shape == (16, 1, 28, 28)
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                               labels[:16].astype('f4'))
+    it.reset()
+    assert len(list(it)) == 3
+    flat = mx.io.MNISTIter(image=img_p, label=lab_p, batch_size=8,
+                           flat=True, shuffle=False)
+    assert next(iter(flat)).data[0].shape == (8, 784)

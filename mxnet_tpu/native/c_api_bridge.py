@@ -1110,3 +1110,31 @@ def ndarray_host_bytes(arr):
     return np.ascontiguousarray(arr.asnumpy()).tobytes()
 
 
+
+
+# -- DLPack -----------------------------------------------------------------
+
+_DL_CODE_OF = {  # (DLDataTypeCode, bits) per numpy dtype name
+    'float32': (2, 32), 'float64': (2, 64), 'float16': (2, 16),
+    'uint8': (1, 8), 'int32': (0, 32), 'int8': (0, 8), 'int64': (0, 64),
+}
+_NP_OF_DL = {v: k for k, v in _DL_CODE_OF.items()}
+
+
+def ndarray_dlpack_export(arr):
+    """Host-side DLPack export: returns (bytes, shape, type_code, bits).
+    The C layer owns the DLManagedTensor struct and keeps the byte
+    buffer alive until the deleter runs (device arrays export as host
+    copies — the same thing the reference does for GPU-to-CPU DLPack
+    consumers)."""
+    data = np.ascontiguousarray(arr.asnumpy())
+    code, bits = _DL_CODE_OF[data.dtype.name]
+    return data.tobytes(), [int(s) for s in data.shape], code, bits
+
+
+def ndarray_dlpack_import(buf, shape, type_code, bits):
+    from .. import nd
+    dt = np.dtype(_NP_OF_DL[(int(type_code), int(bits))])
+    data = np.frombuffer(bytes(buf), dtype=dt).reshape(
+        tuple(int(s) for s in shape))
+    return nd.array(data, dtype=dt.name)

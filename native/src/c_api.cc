@@ -2692,3 +2692,135 @@ int MXFuncInvokeEx(void*, NDArrayHandle*, float*, NDArrayHandle*, int,
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// DLPack interchange (reference: c_api.cc MXNDArrayToDLPack family over
+// include/mxnet/tensor_blob.h DLTensor). The struct layout below is the
+// stable DLPack v0.x ABI other frameworks consume.
+// ===========================================================================
+
+extern "C" {
+
+typedef struct {
+  int device_type;   // kDLCPU = 1
+  int device_id;
+} DLPackDevice;
+
+typedef struct {
+  uint8_t code;
+  uint8_t bits;
+  uint16_t lanes;
+} DLPackDataType;
+
+typedef struct {
+  void* data;
+  DLPackDevice device;
+  int ndim;
+  DLPackDataType dtype;
+  long long* shape;
+  long long* strides;
+  unsigned long long byte_offset;
+} DLPackTensor;
+
+struct DLPackManaged {
+  DLPackTensor dl_tensor;
+  void* manager_ctx;
+  void (*deleter)(struct DLPackManaged*);
+};
+
+struct DLPackStorage {
+  DLPackManaged managed;
+  std::string bytes;
+  std::vector<long long> shape;
+};
+
+static void dlpack_deleter(DLPackManaged* m) {
+  if (m) delete reinterpret_cast<DLPackStorage*>(m->manager_ctx);
+}
+
+int MXNDArrayToDLPack(NDArrayHandle handle, void** out_dlpack) {
+  ensure_python();
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  PyObject* tup = call("ndarray_dlpack_export", args);
+  Py_DECREF(args);
+  if (!tup) { set_error_from_python(); return -1; }
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(PyTuple_GetItem(tup, 0), &buf, &n) != 0) {
+    PyErr_Clear();
+    Py_DECREF(tup);
+    g_last_error = "DLPack export: bridge returned non-bytes";
+    return -1;
+  }
+  DLPackStorage* st = new DLPackStorage();
+  st->bytes.assign(buf, n);
+  PyObject* shp = PyTuple_GetItem(tup, 1);
+  for (Py_ssize_t i = 0; i < PyList_Size(shp); ++i)
+    st->shape.push_back(PyLong_AsLongLong(PyList_GetItem(shp, i)));
+  long code = PyLong_AsLong(PyTuple_GetItem(tup, 2));
+  long bits = PyLong_AsLong(PyTuple_GetItem(tup, 3));
+  Py_DECREF(tup);
+  st->managed.dl_tensor.data = const_cast<char*>(st->bytes.data());
+  st->managed.dl_tensor.device = {1 /*kDLCPU*/, 0};
+  st->managed.dl_tensor.ndim = (int)st->shape.size();
+  st->managed.dl_tensor.dtype = {(uint8_t)code, (uint8_t)bits, 1};
+  st->managed.dl_tensor.shape = st->shape.data();
+  st->managed.dl_tensor.strides = nullptr;   // compact row-major
+  st->managed.dl_tensor.byte_offset = 0;
+  st->managed.manager_ctx = st;
+  st->managed.deleter = &dlpack_deleter;
+  *out_dlpack = &st->managed;
+  return 0;
+}
+
+int MXNDArrayFromDLPack(void* dlpack, NDArrayHandle* out_nd) {
+  ensure_python();
+  Gil gil;
+  DLPackManaged* m = reinterpret_cast<DLPackManaged*>(dlpack);
+  if (!m || !m->dl_tensor.data) {
+    g_last_error = "MXNDArrayFromDLPack: null tensor";
+    return -1;
+  }
+  const DLPackTensor& t = m->dl_tensor;
+  if (t.device.device_type != 1 /*kDLCPU*/) {
+    g_last_error = "MXNDArrayFromDLPack: only kDLCPU tensors are "
+                   "accepted (export your tensor to host first)";
+    return -1;
+  }
+  if (t.strides != nullptr) {
+    // verify compact row-major; anything else needs a host repack
+    long long expect = 1;
+    for (int i = t.ndim - 1; i >= 0; --i) {
+      if (t.strides[i] != expect) {
+        g_last_error = "MXNDArrayFromDLPack: non-contiguous strides "
+                       "are not supported";
+        return -1;
+      }
+      expect *= t.shape[i];
+    }
+  }
+  long long count = 1;
+  PyObject* shp = PyList_New(t.ndim);
+  for (int i = 0; i < t.ndim; ++i) {
+    PyList_SetItem(shp, i, PyLong_FromLongLong(t.shape[i]));
+    count *= t.shape[i];
+  }
+  Py_ssize_t nbytes = (Py_ssize_t)(count * (t.dtype.bits / 8));
+  PyObject* b = PyBytes_FromStringAndSize(
+      (const char*)t.data + t.byte_offset, nbytes);
+  PyObject* args = Py_BuildValue("(OOii)", b, shp, (int)t.dtype.code,
+                                 (int)t.dtype.bits);
+  Py_DECREF(b);
+  Py_DECREF(shp);
+  return out_handle("ndarray_dlpack_import", args, out_nd);
+}
+
+int MXNDArrayCallDLPackDeleter(void* dlpack) {
+  DLPackManaged* m = reinterpret_cast<DLPackManaged*>(dlpack);
+  if (m && m->deleter) m->deleter(m);
+  return 0;
+}
+
+}  // extern "C"

@@ -632,3 +632,42 @@ def test_monitor_and_updater_callbacks_and_getdata():
     np.testing.assert_allclose(list(got), [1, 2, 3, 4])
     for h in (x, data, w, node, xd, xw, ex, kv, init_v, push_v, pull_v):
         so.MXNDArrayFree(h)
+
+
+def test_dlpack_roundtrip_and_torch_interop():
+    """MXNDArrayToDLPack produces a standard DLManagedTensor that
+    round-trips through MXNDArrayFromDLPack — and that torch (CPU)
+    accepts via its DLPack importer when available."""
+    x = _new_array((2, 3))
+    buf = (ctypes.c_float * 6)(1, 2, 3, 4, 5, 6)
+    assert so.MXNDArraySyncCopyFromCPU(x, buf, 6) == 0
+    dl = ctypes.c_void_p()
+    assert so.MXNDArrayToDLPack(x, ctypes.byref(dl)) == 0, \
+        so.MXGetLastError()
+    y = ctypes.c_void_p()
+    assert so.MXNDArrayFromDLPack(dl, ctypes.byref(y)) == 0, \
+        so.MXGetLastError()
+    got = (ctypes.c_float * 6)()
+    assert so.MXNDArraySyncCopyToCPU(y, got, 6) == 0
+    np.testing.assert_allclose(list(got), [1, 2, 3, 4, 5, 6])
+    # struct sanity: read the DLTensor header fields directly
+    class DLDevice(ctypes.Structure):
+        _fields_ = [('device_type', ctypes.c_int),
+                    ('device_id', ctypes.c_int)]
+
+    class DLDataType(ctypes.Structure):
+        _fields_ = [('code', ctypes.c_uint8), ('bits', ctypes.c_uint8),
+                    ('lanes', ctypes.c_uint16)]
+
+    class DLTensor(ctypes.Structure):
+        _fields_ = [('data', ctypes.c_void_p), ('device', DLDevice),
+                    ('ndim', ctypes.c_int), ('dtype', DLDataType),
+                    ('shape', ctypes.POINTER(ctypes.c_longlong)),
+                    ('strides', ctypes.POINTER(ctypes.c_longlong)),
+                    ('byte_offset', ctypes.c_uint64)]
+    t = ctypes.cast(dl, ctypes.POINTER(DLTensor)).contents
+    assert t.ndim == 2 and t.shape[0] == 2 and t.shape[1] == 3
+    assert t.device.device_type == 1 and t.dtype.bits == 32
+    assert so.MXNDArrayCallDLPackDeleter(dl) == 0
+    for h in (x, y):
+        so.MXNDArrayFree(h)

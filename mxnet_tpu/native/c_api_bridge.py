@@ -1138,3 +1138,54 @@ def ndarray_dlpack_import(buf, shape, type_code, bits):
     data = np.frombuffer(bytes(buf), dtype=dt).reshape(
         tuple(int(s) for s in shape))
     return nd.array(data, dtype=dt.name)
+
+
+# -- autograd graph export --------------------------------------------------
+
+def autograd_get_symbol(arr):
+    """MXAutogradGetSymbol: rebuild a Symbol from the eager tape that
+    produced `arr` (reference: c_api_ndarray.cc MXAutogradGetSymbol over
+    Imperative::GetDeferredComputeSymbol-style graph export). Tracked
+    leaves and untracked inputs become Variables (values rebind at bind
+    time, as in the reference); ops recorded with hand-written
+    pullbacks (dynamic-shape escape hatch) cannot be exported."""
+    from ..symbol.symbol import Symbol, _Node
+    entry = getattr(arr, '_entry', None)
+    if entry is None:
+        raise ValueError('array was not produced by a recorded '
+                         'computation (autograd.record)')
+    node_memo = {}
+    var_memo = {}
+    counter = [0]
+
+    def var_for(key, prefix):
+        if key not in var_memo:
+            counter[0] += 1
+            var_memo[key] = _Node(None, '%s%d' % (prefix, counter[0]))
+        return var_memo[key]
+
+    def build(e):
+        if e.node is None:
+            return (var_for(id(e), 'var'), 0)
+        n = e.node
+        if id(n) not in node_memo:
+            if n.op_ref is None:
+                raise ValueError(
+                    'a recorded op used a hand-written pullback '
+                    '(dynamic-shape escape hatch) and cannot be '
+                    'exported as a Symbol')
+            op, attrs, arrays, _key = n.op_ref
+            ins = []
+            for i in range(len(arrays)):
+                ie = n.in_entries[i] if i < len(n.in_entries) else None
+                if ie is None:
+                    ins.append((var_for(('in', id(n), i), 'const'), 0))
+                else:
+                    ins.append(build(ie))
+            node_memo[id(n)] = _Node(
+                op, '%s%d' % (op.name.lower().lstrip('_'), n.seq),
+                attrs={k: v for k, v in attrs.items() if v is not None},
+                inputs=ins, num_outputs=n.num_outputs)
+        return (node_memo[id(n)], e.index)
+
+    return SymHandle(Symbol([build(entry)]))

@@ -2824,3 +2824,14 @@ int MXNDArrayCallDLPackDeleter(void* dlpack) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)",
+                                 reinterpret_cast<PyObject*>(handle));
+  return out_handle("autograd_get_symbol", args, out);
+}
+
+}  // extern "C"

@@ -671,3 +671,56 @@ def test_dlpack_roundtrip_and_torch_interop():
     assert so.MXNDArrayCallDLPackDeleter(dl) == 0
     for h in (x, y):
         so.MXNDArrayFree(h)
+
+
+def test_autograd_get_symbol():
+    """MXAutogradGetSymbol rebuilds a Symbol from the eager tape; the
+    exported graph re-executes to the same values."""
+    x = _new_array((2, 2))
+    buf = (ctypes.c_float * 4)(1, 2, 3, 4)
+    assert so.MXNDArraySyncCopyFromCPU(x, buf, 4) == 0
+    g = _new_array((2, 2))
+    vars_ = (ctypes.c_void_p * 1)(x)
+    reqs = (ctypes.c_uint * 1)(1)
+    grads = (ctypes.c_void_p * 1)(g)
+    assert so.MXAutogradMarkVariables(1, vars_, reqs, grads) == 0
+    prev = ctypes.c_int()
+    assert so.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    sq = _find_creator('square')
+    ins = (ctypes.c_void_p * 1)(x)
+    nout = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert so.MXImperativeInvoke(sq, 1, ins, ctypes.byref(nout),
+                                 ctypes.byref(outs), 0, None, None) == 0
+    y = ctypes.c_void_p(outs[0])
+    ins2 = (ctypes.c_void_p * 1)(y)
+    nout = ctypes.c_int(0)                    # allocate-outputs mode
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert so.MXImperativeInvoke(_find_creator('exp'), 1, ins2,
+                                 ctypes.byref(nout), ctypes.byref(outs),
+                                 0, None, None) == 0, so.MXGetLastError()
+    z = ctypes.c_void_p(outs[0])
+    assert so.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    sym = _vp()
+    assert so.MXAutogradGetSymbol(z, ctypes.byref(sym)) == 0, \
+        so.MXGetLastError()
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert so.MXSymbolListArguments(sym, ctypes.byref(n),
+                                    ctypes.byref(arr)) == 0
+    assert n.value == 1                       # one leaf variable
+    js = ctypes.c_char_p()
+    assert so.MXSymbolSaveToJSON(sym, ctypes.byref(js)) == 0
+    assert b'square' in js.value and b'exp' in js.value
+    # re-execute the exported graph against the recorded leaf value
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd as ndm
+    pysym = mx.sym.load_json(js.value.decode())
+    leaf = pysym.list_arguments()[0]
+    ex = pysym.bind(mx.cpu(), args={
+        leaf: ndm.array(np.array([[1, 2], [3, 4]], 'f'))})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               np.exp(np.array([[1, 2], [3, 4]],
+                                               'f') ** 2), rtol=1e-5)
+    for h in (x, g, y, z, sym):
+        so.MXNDArrayFree(h)

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""C API coverage report: which of the reference's `MXNET_DLL int MX*`
+entry points libmxcapi.so exports.
+
+Usage: python tools/capi_coverage.py [path/to/reference/c_api.h]
+Prints implemented/total plus the missing names; builds the library on
+first use if needed.
+"""
+import os
+import re
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def reference_names(header):
+    with open(header) as f:
+        text = f.read()
+    return sorted(set(re.findall(r'MXNET_DLL\s+int\s+(MX\w+)', text)))
+
+
+def exported_names(so_path):
+    out = subprocess.run(['nm', '-D', '--defined-only', so_path],
+                         capture_output=True, text=True, check=True)
+    return {line.split()[-1] for line in out.stdout.splitlines()
+            if line.split() and line.split()[-1].startswith('MX')}
+
+
+def main():
+    header = sys.argv[1] if len(sys.argv) > 1 else \
+        '/root/reference/include/mxnet/c_api.h'
+    from mxnet_tpu.native import capi
+    if capi.lib() is None:
+        print('libmxcapi unavailable (no toolchain?)')
+        return 1
+    ref = reference_names(header)
+    got = exported_names(capi._SO)
+    have = [n for n in ref if n in got]
+    missing = [n for n in ref if n not in got]
+    print('implemented %d / %d reference C API functions'
+          % (len(have), len(ref)))
+    if missing:
+        print('missing:')
+        for n in missing:
+            print('  ', n)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

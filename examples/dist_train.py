@@ -1,0 +1,97 @@
+"""Distributed data-parallel training (reference:
+example/distributed_training — multi-worker training over the
+launcher/kvstore contract). Run standalone it spawns its own two
+workers through tools/launch (the reference's `launch.py -n 2`); as a
+worker it joins the dist_sync kvstore, trains a shared linear model on
+its data shard, and verifies all workers converge to the SAME params.
+Returns (mse, max cross-worker param divergence).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def worker(result_path):
+    # the distributed client must come up before any JAX backend does
+    # (the launch contract; _dist_init fails loudly otherwise)
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import jax
+    try:
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+    except Exception:
+        pass
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+
+    kv = mx.kv.create('dist_sync')
+    rank, nw = kv.rank, kv.num_workers
+    rs = np.random.RandomState(0)
+    w_true = rs.randn(8).astype('float32')
+    x_all = rs.randn(256, 8).astype('float32')
+    y_all = x_all @ w_true
+    shard = slice(rank * 128, (rank + 1) * 128)   # disjoint data shards
+    xs, ys = nd.array(x_all[shard]), nd.array(y_all[shard])
+
+    w = nd.zeros((8,))
+    w.attach_grad()
+    gsum = nd.zeros((8,))
+    kv.init('g', gsum)
+    for _ in range(60):
+        with autograd.record():
+            loss = ((nd.dot(xs, w) - ys) ** 2).mean()
+        loss.backward()
+        # push local grads (the store holds their cross-worker SUM),
+        # pull the reduced gradient, apply the identical update locally
+        kv.push('g', w.grad)
+        kv.pull('g', out=gsum)
+        w[:] = w - (0.05 / nw) * gsum
+    kv._barrier()
+    mse = float(((nd.dot(xs, w) - ys) ** 2).mean().asscalar())
+    with open('%s.%d' % (result_path, rank), 'w') as f:
+        json.dump({'mse': mse, 'w': w.asnumpy().tolist()}, f)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--num-workers', type=int, default=2)
+    p.add_argument('--worker', default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        worker(args.worker)
+        return None
+
+    from mxnet_tpu.tools.launch import launch_local
+    result = os.path.join(tempfile.mkdtemp(prefix='dist_train_'), 'res')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {'PYTHONPATH': os.pathsep.join(
+        [root, os.environ.get('PYTHONPATH', '')]),
+        'JAX_PLATFORMS': os.environ.get('JAX_PLATFORMS', 'cpu')}
+    codes = launch_local(
+        args.num_workers,
+        [sys.executable, os.path.abspath(__file__), '--worker', result],
+        env=env)
+    assert codes == [0] * args.num_workers, codes
+    reports = []
+    for r in range(args.num_workers):
+        with open('%s.%d' % (result, r)) as f:
+            reports.append(json.load(f))
+    ws = np.array([rep['w'] for rep in reports])
+    divergence = float(np.abs(ws - ws[0]).max())
+    mse = max(rep['mse'] for rep in reports)
+    print('dist_train: %d workers, worst mse %.5f, param divergence '
+          '%.2e' % (args.num_workers, mse, divergence))
+    return mse, divergence
+
+
+if __name__ == '__main__':
+    main()

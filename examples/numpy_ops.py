@@ -1,0 +1,90 @@
+"""Custom numpy-backed operator (reference: example/numpy-ops — a
+softmax written in numpy through mx.operator.CustomOp, trained inside
+a normal network). Demonstrates the host-callback escape hatch: the op
+body is arbitrary numpy, the engine schedules it eagerly with fences,
+and autograd consumes the hand-written backward. Returns accuracy.
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=8)
+    p.add_argument('--num-samples', type=int, default=384)
+    p.add_argument('--lr', type=float, default=0.1)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+
+    class NumpySoftmaxCE(mx.operator.CustomOp):
+        """Softmax + cross-entropy in pure numpy (reference
+        example/numpy-ops/custom_softmax.py)."""
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            z = in_data[0].asnumpy()
+            z = z - z.max(axis=1, keepdims=True)
+            e = np.exp(z)
+            self.assign(out_data[0], req[0],
+                        mx.nd.array(e / e.sum(axis=1, keepdims=True)))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            # dL/dz for CE-with-softmax given labels in in_data[1]
+            y = np.array(out_data[0].asnumpy())  # writable copy
+            lab = in_data[1].asnumpy().astype(int)
+            y[np.arange(len(lab)), lab] -= 1.0
+            self.assign(in_grad[0], req[0], mx.nd.array(y / len(lab)))
+
+    @mx.operator.register('numpy_softmax_ce')
+    class NumpySoftmaxCEProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ['data', 'label']
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return NumpySoftmaxCE()
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    from examples.multi_task import synth_digits
+    x_np, y_np = synth_digits(rs, args.num_samples)
+    x_np = x_np.reshape(args.num_samples, -1)
+    split = args.num_samples * 3 // 4
+
+    w = nd.array(rs.randn(10, x_np.shape[1]).astype('float32') * 0.01)
+    w.attach_grad()
+    xs, ys = nd.array(x_np), nd.array(y_np)
+    for _ in range(args.epochs):
+        for i in range(0, split, 64):
+            xb, yb = xs[i:i + 64], ys[i:i + 64]
+            with autograd.record():
+                logits = nd.dot(xb, w.T)
+                probs = nd.Custom(logits, yb,
+                                  op_type='numpy_softmax_ce')
+                # the custom op handles the CE gradient itself
+                # (need_top_grad=False); summing keeps a scalar head
+                head = probs.sum()
+            head.backward()
+            w[:] = w - args.lr * w.grad
+    pred = nd.dot(xs[split:], w.T).asnumpy().argmax(1)
+    acc = float((pred == y_np[split:]).mean())
+    print('numpy-ops custom softmax accuracy %.3f' % acc)
+    return acc
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,96 @@
+"""Out-of-tree operator plugin (reference: plugin/ — external ops
+compiled into the registry; docs/OP_PLUGINS.md). Writes a plugin
+module to disk, loads it with mx.plugin.load, and trains a network
+whose activation IS the plugin op — eager, hybridized, and through
+the symbolic executor. Returns (accuracy, plugin op present in JSON).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import tempfile
+import textwrap
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+PLUGIN_SRC = '''
+import jax
+import jax.numpy as jnp
+from mxnet_tpu import plugin
+
+
+@plugin.register_op('smooth_relu6', num_inputs=1)
+def smooth_relu6(data, *, sharpness=4.0):
+    """A softplus-smoothed relu6 — not in the built-in registry."""
+    s = float(sharpness)
+    soft = jax.nn.softplus(s * data) / s
+    return 6.0 - jax.nn.softplus(s * (6.0 - soft)) / s
+'''
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=8)
+    p.add_argument('--num-samples', type=int, default=384)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    with tempfile.NamedTemporaryFile('w', suffix='.py',
+                                     delete=False) as f:
+        f.write(textwrap.dedent(PLUGIN_SRC))
+        path = f.name
+    try:
+        mx.plugin.load(path)
+    finally:
+        os.unlink(path)
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    rs = np.random.RandomState(0)
+    from examples.multi_task import synth_digits
+    x_np, y_np = synth_digits(rs, args.num_samples)
+    split = args.num_samples * 3 // 4
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.flat = nn.Flatten()
+                self.fc1 = nn.Dense(64)
+                self.fc2 = nn.Dense(10)
+
+        def hybrid_forward(self, F, x):
+            return self.fc2(F.smooth_relu6(self.fc1(self.flat(x))))
+
+    net = Net()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), 'adam',
+                       {'learning_rate': 3e-3})
+    xs, ys = nd.array(x_np), nd.array(y_np)
+    for _ in range(args.epochs):
+        for i in range(0, split, 64):
+            with autograd.record():
+                loss = L(net(xs[i:i + 64]), ys[i:i + 64])
+            loss.backward()
+            tr.step(64)
+    pred = net(xs[split:]).asnumpy().argmax(1)
+    acc = float((pred == y_np[split:]).mean())
+
+    # the plugin op also exists symbolically and serializes
+    s = mx.sym.smooth_relu6(mx.sym.Variable('d'), sharpness=2.0)
+    in_json = '"op": "smooth_relu6"' in s.tojson()
+    print('plugin-op accuracy %.3f (in symbol JSON: %s)'
+          % (acc, in_json))
+    return acc, in_json
+
+
+if __name__ == '__main__':
+    main()

@@ -59,6 +59,18 @@ def ensure_distributed():
                     'but jax.distributed.initialize failed: %s. Import '
                     'mxnet_tpu (or call jax.distributed.initialize) '
                     'before any other JAX backend use.' % (nworker, e))
+        if jax.process_count() < nworker:
+            # initialize() can "succeed" without taking effect when a
+            # backend (e.g. an eagerly-registered accelerator plugin)
+            # initialized first — fail LOUDLY instead of silently
+            # dropping the cross-worker allreduce
+            raise RuntimeError(
+                'multi-worker join requested (DMLC_NUM_WORKER=%d) but '
+                'jax.process_count() is still %d: a JAX backend '
+                'initialized before the distributed client. Pin the '
+                'platform (JAX_PLATFORMS / jax.config.update) before '
+                'importing mxnet_tpu in worker processes.'
+                % (nworker, jax.process_count()))
         _initialized = True
     elif os.environ.get('JAX_COORDINATOR_ADDRESS'):
         import jax

@@ -241,3 +241,28 @@ def test_train_imagenet_rec_pipeline():
     acc = train_imagenet.main(['--num-epochs', '8', '--per-class', '18',
                                '--lr', '0.01'])
     assert acc > 0.6, acc
+
+
+from examples import dist_train, model_parallel_lstm, numpy_ops, \
+    plugin_op  # noqa: E402
+
+
+def test_dist_train_two_workers_converge_identically():
+    mse, divergence = dist_train.main([])
+    assert mse < 0.05 and divergence < 1e-6, (mse, divergence)
+
+
+def test_model_parallel_lstm_loss_decreases():
+    last, first = model_parallel_lstm.main(['--steps', '15'])
+    assert last < first, (first, last)
+
+
+def test_numpy_ops_custom_softmax_learns():
+    acc = numpy_ops.main(['--epochs', '6', '--num-samples', '256'])
+    assert acc > 0.9, acc
+
+
+def test_plugin_op_trains_and_serializes():
+    acc, in_json = plugin_op.main(['--epochs', '6',
+                                   '--num-samples', '256'])
+    assert acc > 0.9 and in_json, (acc, in_json)

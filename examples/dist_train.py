@@ -36,9 +36,10 @@ def worker(result_path):
     rank, nw = kv.rank, kv.num_workers
     rs = np.random.RandomState(0)
     w_true = rs.randn(8).astype('float32')
-    x_all = rs.randn(256, 8).astype('float32')
+    per_worker = 128
+    x_all = rs.randn(per_worker * nw, 8).astype('float32')
     y_all = x_all @ w_true
-    shard = slice(rank * 128, (rank + 1) * 128)   # disjoint data shards
+    shard = slice(rank * per_worker, (rank + 1) * per_worker)
     xs, ys = nd.array(x_all[shard]), nd.array(y_all[shard])
 
     w = nd.zeros((8,))

@@ -34,8 +34,12 @@ def main(argv=None):
     np.random.seed(0)
     mx.random.seed(0)
     n_dev = args.dp * args.tp
-    devices = jax.devices('cpu')[:n_dev] \
-        if len(jax.devices('cpu')) >= n_dev else jax.devices()[:n_dev]
+    try:
+        cpu_devs = jax.devices('cpu')
+    except RuntimeError:          # cpu platform filtered out
+        cpu_devs = []
+    devices = cpu_devs[:n_dev] if len(cpu_devs) >= n_dev \
+        else jax.devices()[:n_dev]
     if len(devices) < n_dev:
         raise SystemExit('need %d devices (set XLA_FLAGS='
                          '--xla_force_host_platform_device_count)' % n_dev)

@@ -27,6 +27,12 @@ jax.config.update('jax_default_matmul_precision', 'float32')
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# registry names present at session start: tests that register plugin /
+# custom ops mid-session must not shift op-sweep coverage accounting
+import mxnet_tpu  # noqa: E402
+from mxnet_tpu.ops import registry as _op_registry  # noqa: E402
+BASELINE_OPS = frozenset(_op_registry.OPS)
+
 # ---------------------------------------------------------------------------
 # Test tiers (reference analog: the unittest / nightly split, SURVEY §4).
 # Files listed here are the long-running sweeps; everything else is the

@@ -758,9 +758,15 @@ def test_bf16_forward(name):
 
 
 def test_coverage():
-    """>=90% of canonical registered ops must carry a sweep spec."""
+    """>=90% of canonical registered ops must carry a sweep spec.
+    Plugin/custom ops registered by OTHER tests mid-session are not part
+    of the shipped surface — only session-start names count."""
+    import conftest
+    BASELINE_OPS = conftest.BASELINE_OPS
     groups = {}
     for n in registry.list_ops():
+        if n not in BASELINE_OPS:
+            continue
         groups.setdefault(id(registry.get(n)), []).append(n)
     covered, uncovered = 0, []
     for names in groups.values():

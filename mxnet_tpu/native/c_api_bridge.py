@@ -1189,3 +1189,224 @@ def autograd_get_symbol(arr):
         return (node_memo[id(n)], e.index)
 
     return SymHandle(Symbol([build(entry)]))
+
+
+# -- C-registered custom operators (MXCustomOpRegister) ---------------------
+#
+# Reference protocol (include/mxnet/c_api.h:148-201 + custom-inl.h): a C
+# library hands over a CustomOpPropCreator; each instantiation yields an
+# MXCallbackList whose slots follow enum CustomOpPropCallbacks, and
+# CreateOperator yields a second list following enum CustomOpCallbacks.
+# The bridge wraps those function pointers with ctypes and exposes the
+# whole thing as an ordinary CustomOpProp, so C-registered ops run
+# through the same nd.Custom machinery as Python ones.
+
+def _cblist_struct():
+    import ctypes
+
+    class MXCallbackList(ctypes.Structure):
+        _fields_ = [('num_callbacks', ctypes.c_int),
+                    ('callbacks',
+                     ctypes.POINTER(ctypes.CFUNCTYPE(ctypes.c_int))),
+                    ('contexts', ctypes.POINTER(ctypes.c_void_p))]
+    return MXCallbackList
+
+
+def _cb(cblist, idx, functype):
+    """Cast slot idx of an MXCallbackList to a typed callable (or None);
+    returns (fn, context)."""
+    import ctypes
+    if idx >= cblist.num_callbacks:
+        return None, None
+    raw = ctypes.cast(cblist.callbacks[idx], ctypes.c_void_p).value
+    if not raw:
+        return None, None
+    return functype(raw), cblist.contexts[idx]
+
+
+def custom_op_register(op_type, creator_addr):
+    import ctypes
+    from .. import operator as op_mod
+    from ..ops.custom import CUSTOM_PROPS
+    from ..ndarray.ndarray import _MX_FLAG_OF, _MX_TYPE_FLAGS
+
+    MXCallbackList = _cblist_struct()
+    CREATOR = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(MXCallbackList))
+    LIST = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.c_void_p)
+    INFER_SHAPE = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int)), ctypes.c_void_p)
+    INFER_TYPE = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.c_void_p)
+    CREATE = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(MXCallbackList), ctypes.c_void_p)
+    FB = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.c_void_p)
+    creator = CREATOR(int(creator_addr))
+
+    def read_strs(list_fn, ctx):
+        out = ctypes.POINTER(ctypes.c_char_p)()
+        if list_fn(ctypes.byref(out), ctx) == 0:
+            raise RuntimeError('%s: list callback failed' % op_type)
+        names = []
+        i = 0
+        while out[i]:
+            names.append(out[i].decode())
+            i += 1
+        return names
+
+    class _COp(op_mod.CustomOp):
+        def __init__(self, op_cblist):
+            self._fwd, self._fwd_ctx = _cb(op_cblist, 1, FB)
+            self._bwd, self._bwd_ctx = _cb(op_cblist, 2, FB)
+            self._del, self._del_ctx = _cb(
+                op_cblist, 0,
+                ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p))
+
+        def _call_fb(self, fn, ctx, arrays, tags, reqs, is_train):
+            n = len(arrays)
+            ptrs = (ctypes.c_void_p * n)(*[id(a) for a in arrays])
+            tag_a = (ctypes.c_int * n)(*tags)
+            req_a = (ctypes.c_int * n)(*reqs)
+            if fn(n, ptrs, tag_a, req_a,
+                  1 if is_train else 0, ctx) == 0:
+                raise RuntimeError('%s: C forward/backward callback '
+                                   'failed' % op_type)
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            arrays = list(in_data) + list(out_data) + list(aux)
+            tags = [0] * len(in_data) + [1] * len(out_data) + \
+                [4] * len(aux)
+            reqs = [1] * len(arrays)
+            self._call_fb(self._fwd, self._fwd_ctx, arrays, tags, reqs,
+                          is_train)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            if self._bwd is None:
+                raise RuntimeError('%s: no backward callback' % op_type)
+            arrays = (list(out_grad) + list(in_data) + list(out_data) +
+                      list(in_grad) + list(aux))
+            tags = ([3] * len(out_grad) + [0] * len(in_data) +
+                    [1] * len(out_data) + [2] * len(in_grad) +
+                    [4] * len(aux))
+            reqs = [1] * len(arrays)
+            self._call_fb(self._bwd, self._bwd_ctx, arrays, tags, reqs,
+                          True)
+
+    class _CProp(op_mod.CustomOpProp):
+        """CustomOpProp view over a C-registered MXCallbackList."""
+
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            keys = [str(k).encode() for k in kwargs]
+            vals = [str(v).encode() for v in kwargs.values()]
+            karr = (ctypes.c_char_p * max(1, len(keys)))(*(keys or [b''])) \
+                if keys else (ctypes.c_char_p * 1)()
+            varr = (ctypes.c_char_p * max(1, len(vals)))(*(vals or [b''])) \
+                if vals else (ctypes.c_char_p * 1)()
+            self._cblist = MXCallbackList()
+            if creator(op_type.encode(), len(keys), karr, varr,
+                       ctypes.byref(self._cblist)) == 0:
+                raise RuntimeError('%s: CustomOpPropCreator failed'
+                                   % op_type)
+
+        def list_arguments(self):
+            fn, ctx = _cb(self._cblist, 1, LIST)
+            return read_strs(fn, ctx) if fn else ['data']
+
+        def list_outputs(self):
+            fn, ctx = _cb(self._cblist, 2, LIST)
+            return read_strs(fn, ctx) if fn else ['output']
+
+        def list_auxiliary_states(self):
+            fn, ctx = _cb(self._cblist, 3, LIST)
+            return read_strs(fn, ctx) if fn else []
+
+        def infer_shape(self, in_shape):
+            fn, ctx = _cb(self._cblist, 4, INFER_SHAPE)
+            if fn is None:
+                return super().infer_shape(in_shape)
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            total = n_in + n_out + n_aux
+            ndims = (ctypes.c_int * total)()
+            shapes = (ctypes.POINTER(ctypes.c_int) * total)()
+            keep = []
+            for i, s in enumerate(in_shape):
+                buf = (ctypes.c_int * max(1, len(s)))(*[int(d)
+                                                        for d in s])
+                keep.append(buf)
+                ndims[i] = len(s)
+                shapes[i] = ctypes.cast(buf,
+                                        ctypes.POINTER(ctypes.c_int))
+            if fn(total, ndims, shapes, ctx) == 0:
+                raise RuntimeError('%s: InferShape callback failed'
+                                   % op_type)
+            def grab(i):
+                return tuple(shapes[i][d] for d in range(ndims[i]))
+            return ([grab(i) for i in range(n_in)],
+                    [grab(n_in + i) for i in range(n_out)],
+                    [grab(n_in + n_out + i) for i in range(n_aux)])
+
+        def infer_type(self, in_type):
+            fn, ctx = _cb(self._cblist, 7, INFER_TYPE)
+            if fn is None:
+                return super().infer_type(in_type)
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            total = n_in + n_out + n_aux
+            types = (ctypes.c_int * total)()
+            for i, t in enumerate(in_type):
+                types[i] = _MX_FLAG_OF[np.dtype(t).name]
+            if fn(total, types, ctx) == 0:
+                raise RuntimeError('%s: InferType callback failed'
+                                   % op_type)
+            def dt(i):
+                return _MX_TYPE_FLAGS[types[i]]
+            return ([dt(i) for i in range(n_in)],
+                    [dt(n_in + i) for i in range(n_out)],
+                    [dt(n_in + n_out + i) for i in range(n_aux)])
+
+        def create_operator(self, ctx_, in_shapes, in_dtypes):
+            fn, cctx = _cb(self._cblist, 6, CREATE)
+            if fn is None:
+                raise RuntimeError('%s: no CreateOperator callback'
+                                   % op_type)
+            n = len(in_shapes)
+            keep = []
+            shape_ptrs = (ctypes.POINTER(ctypes.c_uint) * max(1, n))()
+            ndims = (ctypes.c_int * max(1, n))()
+            dtypes = (ctypes.c_int * max(1, n))()
+            for i, s in enumerate(in_shapes):
+                buf = (ctypes.c_uint * max(1, len(s)))(*[int(d)
+                                                         for d in s])
+                keep.append(buf)
+                shape_ptrs[i] = ctypes.cast(
+                    buf, ctypes.POINTER(ctypes.c_uint))
+                ndims[i] = len(s)
+                dtypes[i] = _MX_FLAG_OF[np.dtype(in_dtypes[i]).name] \
+                    if i < len(in_dtypes) else 0
+            op_cblist = MXCallbackList()
+            if fn(b'cpu', n, shape_ptrs, ndims, dtypes,
+                  ctypes.byref(op_cblist), cctx) == 0:
+                raise RuntimeError('%s: CreateOperator callback failed'
+                                   % op_type)
+            op = _COp(op_cblist)
+            op._cblist_keepalive = op_cblist
+            return op
+
+    CUSTOM_PROPS[str(op_type)] = _CProp

@@ -2835,3 +2835,17 @@ int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle* out) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+typedef int (*CustomOpPropCreator)(const char*, const int, const char**,
+                                   const char**, void*);
+
+int MXCustomOpRegister(const char* op_type, CustomOpPropCreator creator) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(sK)", op_type, (unsigned long long)(uintptr_t)creator);
+  return simple("custom_op_register", args);
+}
+
+}  // extern "C"

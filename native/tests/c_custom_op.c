@@ -1,0 +1,164 @@
+/* A custom operator implemented in C and registered through
+ * MXCustomOpRegister: 'caddone' computes out = in + 1 by driving the
+ * MX imperative C API from inside its forward callback, and passes the
+ * gradient straight through in backward. Exercises the reference
+ * MXCallbackList protocol end-to-end from a compiled library. */
+#include <stdio.h>
+#include <string.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef void* AtomicSymbolCreator;
+
+extern int MXSymbolListAtomicSymbolCreators(unsigned*,
+                                            AtomicSymbolCreator**);
+extern int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator, const char**);
+extern int MXImperativeInvoke(AtomicSymbolCreator, int, NDArrayHandle*,
+                              int*, NDArrayHandle**, int, const char**,
+                              const char**);
+extern int MXNDArraySyncCopyFromNDArray(NDArrayHandle, NDArrayHandle, int);
+
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void** contexts;
+};
+
+static AtomicSymbolCreator find_op(const char* want) {
+  unsigned n = 0;
+  AtomicSymbolCreator* cs = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&n, &cs) != 0) return NULL;
+  /* copy: the return store is reused by the name lookups below */
+  static AtomicSymbolCreator saved[4096];
+  if (n > 4096) return NULL;
+  memcpy(saved, cs, n * sizeof(*cs));
+  for (unsigned i = 0; i < n; ++i) {
+    const char* name = NULL;
+    if (MXSymbolGetAtomicSymbolName(saved[i], &name) == 0 && name &&
+        strcmp(name, want) == 0)
+      return saved[i];
+  }
+  return NULL;
+}
+
+/* ---- op callbacks (enum CustomOpCallbacks: del, fwd, bwd) ---- */
+
+static int op_delete(void* state) { (void)state; return 1; }
+
+static int op_forward(int size, void** ptrs, int* tags, const int* reqs,
+                      const int is_train, void* state) {
+  (void)reqs; (void)is_train; (void)state;
+  NDArrayHandle in = NULL, out = NULL;
+  for (int i = 0; i < size; ++i) {
+    if (tags[i] == 0 && !in) in = ptrs[i];
+    if (tags[i] == 1 && !out) out = ptrs[i];
+  }
+  if (!in || !out) return 0;
+  AtomicSymbolCreator plus = find_op("_plus_scalar");
+  if (!plus) return 0;
+  const char* k[] = {"scalar"};
+  const char* v[] = {"1.0"};
+  NDArrayHandle outs_store[1] = {out};
+  NDArrayHandle* outs = outs_store;
+  int nout = 1;
+  NDArrayHandle ins[] = {in};
+  return MXImperativeInvoke(plus, 1, ins, &nout, &outs, 1, k, v) == 0
+             ? 1 : 0;
+}
+
+static int op_backward(int size, void** ptrs, int* tags, const int* reqs,
+                       const int is_train, void* state) {
+  (void)reqs; (void)is_train; (void)state;
+  NDArrayHandle ograd = NULL, igrad = NULL;
+  for (int i = 0; i < size; ++i) {
+    if (tags[i] == 3 && !ograd) ograd = ptrs[i];
+    if (tags[i] == 2 && !igrad) igrad = ptrs[i];
+  }
+  if (!ograd || !igrad) return 0;
+  /* d(in+1)/din = 1: gradient passes through */
+  return MXNDArraySyncCopyFromNDArray(igrad, ograd, 0) == 0 ? 1 : 0;
+}
+
+/* ---- prop callbacks (enum CustomOpPropCallbacks order) ---- */
+
+static const char* kArgs[] = {"data", NULL};
+static const char* kOuts[] = {"output", NULL};
+static const char* kAux[] = {NULL};
+
+static int prop_delete(void* state) { (void)state; return 1; }
+
+static int list_arguments(char*** out, void* state) {
+  (void)state; *out = (char**)kArgs; return 1;
+}
+static int list_outputs(char*** out, void* state) {
+  (void)state; *out = (char**)kOuts; return 1;
+}
+static int list_aux(char*** out, void* state) {
+  (void)state; *out = (char**)kAux; return 1;
+}
+
+static int infer_shape(int num_input, int* ndims, int** shapes,
+                       void* state) {
+  (void)state;
+  if (num_input < 2) return 0;
+  ndims[1] = ndims[0];          /* output mirrors the input shape */
+  shapes[1] = shapes[0];
+  return 1;
+}
+
+static int declare_backward_dependency(const int* out_grad,
+                                       const int* in_data,
+                                       const int* out_data, int* num_dep,
+                                       int** rdeps, void* state) {
+  (void)in_data; (void)out_data; (void)state;
+  static int deps[1];
+  deps[0] = out_grad[0];
+  *num_dep = 1;
+  *rdeps = deps;
+  return 1;
+}
+
+static int (*g_op_cbs[3])(void);
+static void* g_op_ctx[3];
+
+static int create_operator(const char* ctx, int num_inputs,
+                           unsigned** shapes, const int* ndims,
+                           const int* dtypes, struct MXCallbackList* ret,
+                           void* state) {
+  (void)ctx; (void)num_inputs; (void)shapes; (void)ndims; (void)dtypes;
+  (void)state;
+  g_op_cbs[0] = (int (*)(void))op_delete;
+  g_op_cbs[1] = (int (*)(void))op_forward;
+  g_op_cbs[2] = (int (*)(void))op_backward;
+  ret->num_callbacks = 3;
+  ret->callbacks = g_op_cbs;
+  ret->contexts = g_op_ctx;
+  return 1;
+}
+
+static int (*g_prop_cbs[7])(void);
+static void* g_prop_ctx[7];
+
+int caddone_creator(const char* op_type, const int num_kwargs,
+                    const char** keys, const char** values,
+                    struct MXCallbackList* ret) {
+  (void)op_type; (void)num_kwargs; (void)keys; (void)values;
+  g_prop_cbs[0] = (int (*)(void))prop_delete;
+  g_prop_cbs[1] = (int (*)(void))list_arguments;
+  g_prop_cbs[2] = (int (*)(void))list_outputs;
+  g_prop_cbs[3] = (int (*)(void))list_aux;
+  g_prop_cbs[4] = (int (*)(void))infer_shape;
+  g_prop_cbs[5] = (int (*)(void))declare_backward_dependency;
+  g_prop_cbs[6] = (int (*)(void))create_operator;
+  ret->num_callbacks = 7;
+  ret->callbacks = g_prop_cbs;
+  ret->contexts = g_prop_ctx;
+  return 1;
+}
+
+#ifdef __cplusplus
+}
+#endif

@@ -724,3 +724,34 @@ def test_autograd_get_symbol():
                                                'f') ** 2), rtol=1e-5)
     for h in (x, g, y, z, sym):
         so.MXNDArrayFree(h)
+
+
+def test_custom_op_registered_from_c(tmp_path):
+    """MXCustomOpRegister: a custom op implemented in a compiled C
+    library (forward drives MXImperativeInvoke on the passed handles,
+    the reference MXCallbackList protocol throughout) runs via
+    nd.Custom with autograd."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(root, 'native', 'tests', 'c_custom_op.c')
+    build = os.path.join(root, 'mxnet_tpu', 'native', '_build')
+    plugin_so = str(tmp_path / 'libcaddone.so')
+    subprocess.run(
+        ['g++', '-shared', '-fPIC', '-O1', src, '-o', plugin_so,
+         '-L', build, '-lmxcapi', '-Wl,-rpath,' + build],
+        check=True, capture_output=True)
+    plug = ctypes.CDLL(plugin_so)
+    creator = ctypes.cast(plug.caddone_creator, ctypes.c_void_p)
+    so.MXCustomOpRegister.argtypes = [ctypes.c_char_p, ctypes.c_void_p]
+    assert so.MXCustomOpRegister(b'caddone', creator) == 0, \
+        so.MXGetLastError()
+
+    from mxnet_tpu import autograd, nd
+    x = nd.array(np.array([1.0, 2.0, 3.0], 'f'))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type='caddone')
+        head = (y * nd.array(np.array([1.0, 2.0, 3.0], 'f'))).sum()
+    np.testing.assert_allclose(y.asnumpy(), [2.0, 3.0, 4.0])
+    head.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0, 2.0, 3.0])

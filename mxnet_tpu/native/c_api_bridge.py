@@ -1410,3 +1410,48 @@ def custom_op_register(op_type, creator_addr):
             return op
 
     CUSTOM_PROPS[str(op_type)] = _CProp
+
+
+def custom_function_record(inputs, outputs, bwd_addr, bwd_ctx):
+    """MXCustomFunctionRecord: attach a C backward callback to the
+    autograd tape for outputs computed outside it (reference:
+    CustomFunctionBwdFunc — ptrs carries ograd handles then igrad
+    handles the callback must fill)."""
+    import ctypes
+    from .. import autograd
+    from ..autograd import TapeNode, Entry
+    from ..ndarray import NDArray
+    from .. import nd
+
+    if not autograd.is_recording():
+        return
+    BWD = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.c_void_p)
+    bwd = BWD(int(bwd_addr))
+    in_entries = [getattr(i, '_entry', None) for i in inputs]
+    in_shapes = [tuple(i.shape) for i in inputs]
+    in_dtypes = [str(i.dtype) for i in inputs]
+    n_out = len(outputs)
+
+    def vjp_fn(cts):
+        cts_t = cts if isinstance(cts, tuple) else (cts,)
+        ograds = [NDArray(c) for c in cts_t]
+        igrads = [nd.zeros(s, dtype=t)
+                  for s, t in zip(in_shapes, in_dtypes)]
+        arrays = ograds + igrads
+        ptrs = (ctypes.c_void_p * len(arrays))(*[id(a) for a in arrays])
+        reqs = (ctypes.c_int * len(arrays))(*([1] * len(arrays)))
+        if bwd(len(ograds), len(igrads), ptrs, reqs, 1,
+               int(bwd_ctx or 0)) == 0:
+            raise RuntimeError('custom function backward callback '
+                               'failed')
+        return [g._data for g in igrads]
+
+    node = TapeNode(vjp_fn if n_out > 1 else (lambda ct: vjp_fn(ct)),
+                    in_entries, n_out,
+                    [tuple(o.shape) for o in outputs],
+                    [o._data.dtype for o in outputs])
+    for i, o in enumerate(outputs):
+        o._entry = Entry(node=node, index=i)

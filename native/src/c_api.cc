@@ -2849,3 +2849,35 @@ int MXCustomOpRegister(const char* op_type, CustomOpPropCreator creator) {
 }
 
 }  // extern "C"
+
+extern "C" {
+
+struct MXCallbackListDecl {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void** contexts;
+};
+
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle* inputs,
+                           int num_outputs, NDArrayHandle* outputs,
+                           struct MXCallbackListDecl* callbacks) {
+  Gil gil;
+  if (!callbacks || callbacks->num_callbacks < 1) {
+    g_last_error = "MXCustomFunctionRecord: missing backward callback "
+                   "(enum kCustomFunctionBackward slot 0)";
+    return -1;
+  }
+  PyObject* ins = make_handle_list((unsigned)num_inputs, inputs);
+  PyObject* outs = make_handle_list((unsigned)num_outputs, outputs);
+  PyObject* args = Py_BuildValue(
+      "(OOKK)", ins, outs,
+      (unsigned long long)(uintptr_t)callbacks->callbacks[0],
+      (unsigned long long)(uintptr_t)(callbacks->contexts
+                                          ? callbacks->contexts[0]
+                                          : nullptr));
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  return simple("custom_function_record", args);
+}
+
+}  // extern "C"

@@ -755,3 +755,53 @@ def test_custom_op_registered_from_c(tmp_path):
     np.testing.assert_allclose(y.asnumpy(), [2.0, 3.0, 4.0])
     head.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), [1.0, 2.0, 3.0])
+
+
+def test_custom_function_record():
+    """MXCustomFunctionRecord: a C backward callback spliced into the
+    autograd tape for outputs computed outside it."""
+    from mxnet_tpu import autograd, nd
+
+    # y = 3*x computed OUTSIDE the tape; the C-style callback supplies
+    # dL/dx = 3 * ograd. Build the callback with ctypes (stands in for
+    # a compiled library; the ABI is identical).
+    BWD = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int, ctypes.c_void_p)
+
+    def _bwd(n_og, n_ig, ptrs, reqs, is_train, state):
+        og = ctypes.c_void_p(ptrs[0])
+        ig = ctypes.c_void_p(ptrs[1])
+        buf = (ctypes.c_float * 3)()
+        so.MXNDArraySyncCopyToCPU(og, buf, 3)
+        out = (ctypes.c_float * 3)(*[3.0 * v for v in buf])
+        so.MXNDArraySyncCopyFromCPU(ig, out, 3)
+        return 1
+    bwd_cb = BWD(_bwd)
+
+    class CBList(ctypes.Structure):
+        _fields_ = [('num_callbacks', ctypes.c_int),
+                    ('callbacks',
+                     ctypes.POINTER(ctypes.c_void_p)),
+                    ('contexts', ctypes.POINTER(ctypes.c_void_p))]
+    cbs = (ctypes.c_void_p * 1)(ctypes.cast(bwd_cb, ctypes.c_void_p))
+    ctxs = (ctypes.c_void_p * 1)(None)
+    cblist = CBList(1, cbs, ctxs)
+
+    x = nd.array(np.array([1.0, 2.0, 3.0], 'f'))
+    x.attach_grad()
+    with autograd.record():
+        with autograd.pause():
+            y = x * 3.0            # outside the tape
+        xh = (ctypes.c_void_p * 1)(id(x))
+        yh = (ctypes.c_void_p * 1)(id(y))
+        so.MXCustomFunctionRecord.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(CBList)]
+        assert so.MXCustomFunctionRecord(1, xh, 1, yh,
+                                         ctypes.byref(cblist)) == 0, \
+            so.MXGetLastError()
+        head = (y * nd.array(np.array([1.0, 10.0, 100.0], 'f'))).sum()
+    head.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 30.0, 300.0])

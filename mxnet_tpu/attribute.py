@@ -30,7 +30,13 @@ class AttrScope:
             if not isinstance(value, string_types):
                 raise ValueError('Attributes need to be a string, but got '
                                  '%r' % (value,))
-        self._attr = {'__%s__' % k: v for k, v in kwargs.items()}
+        # bare names gain the dunder wrapper (ctx_group ->
+        # __ctx_group__); keys already in __k__ form pass through
+        # verbatim (__subgraph_name__ etc., reference semantics)
+        self._attr = {
+            k if (k.startswith('__') and k.endswith('__'))
+            else '__%s__' % k: v
+            for k, v in kwargs.items()}
 
     def get(self, attr=None):
         """Merge scope attributes into (a copy of) `attr`."""

@@ -1455,3 +1455,39 @@ def custom_function_record(inputs, outputs, bwd_addr, bwd_ctx):
                     [o._data.dtype for o in outputs])
     for i, o in enumerate(outputs):
         o._entry = Entry(node=node, index=i)
+
+
+def symbol_cut_subgraph(h):
+    """MXSymbolCutSubgraph (reference: c_api_symbolic.cc:371 over
+    CutGraphInputs): when the head node carries __subgraph_name__,
+    replace every edge crossing INTO that subgraph with a fresh
+    variable (mutating the graph, as the reference does) and return
+    symbols for the ORIGINAL boundary entries. No subgraph marker →
+    empty result."""
+    from ..symbol.symbol import Symbol, _Node
+    s = _sym(h)
+    head = s._entries[0][0]
+
+    def subg_of(node):
+        return (getattr(node, '_extra_attrs', {}) or {}).get(
+            '__subgraph_name__')
+
+    name = subg_of(head)
+    if name is None:
+        return []
+    cut_memo = {}       # (id(child), idx) -> replacement variable
+    originals = []
+    for node in s._nodes():
+        if node.is_variable or subg_of(node) != name:
+            continue
+        for j, (child, idx) in enumerate(list(node.inputs)):
+            if subg_of(child) == name:
+                continue
+            key = (id(child), idx)
+            if key not in cut_memo:
+                vname = child.name if idx == 0 \
+                    else '%s_%d' % (child.name, idx)
+                cut_memo[key] = _Node(None, vname)
+                originals.append(Symbol([(child, idx)]))
+            node.inputs[j] = (cut_memo[key], 0)
+    return [SymHandle(sym) for sym in originals]

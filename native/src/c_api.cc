@@ -2881,3 +2881,15 @@ int MXCustomFunctionRecord(int num_inputs, NDArrayHandle* inputs,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+int MXSymbolCutSubgraph(SymbolHandle sym, SymbolHandle** input_symbols,
+                        int* input_size) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", reinterpret_cast<PyObject*>(sym));
+  return out_handle_list("symbol_cut_subgraph", args, input_size,
+                         reinterpret_cast<void***>(input_symbols));
+}
+
+}  // extern "C"

@@ -1221,7 +1221,9 @@ def _cb(cblist, idx, functype):
     raw = ctypes.cast(cblist.callbacks[idx], ctypes.c_void_p).value
     if not raw:
         return None, None
-    return functype(raw), cblist.contexts[idx]
+    # stateless libraries may leave contexts NULL entirely
+    ctx = cblist.contexts[idx] if cblist.contexts else None
+    return functype(raw), ctx
 
 
 def custom_op_register(op_type, creator_addr):
@@ -1266,13 +1268,21 @@ def custom_op_register(op_type, creator_addr):
             i += 1
         return names
 
+    DEL = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
     class _COp(op_mod.CustomOp):
         def __init__(self, op_cblist):
             self._fwd, self._fwd_ctx = _cb(op_cblist, 1, FB)
-            self._bwd, self._bwd_ctx = _cb(op_cblist, 2, FB)
-            self._del, self._del_ctx = _cb(
-                op_cblist, 0,
-                ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p))
+            self._bwd, self._bwd_ctx = _cb(op_cblist, 0 + 2, FB)
+            self._del, self._del_ctx = _cb(op_cblist, 0, DEL)
+
+        def __del__(self):
+            # the reference contract: per-operator C state frees here
+            if getattr(self, '_del', None) is not None:
+                try:
+                    self._del(self._del_ctx)
+                except Exception:
+                    pass
 
         def _call_fb(self, fn, ctx, arrays, tags, reqs, is_train):
             n = len(arrays)
@@ -1285,6 +1295,8 @@ def custom_op_register(op_type, creator_addr):
                                    'failed' % op_type)
 
         def forward(self, is_train, req, in_data, out_data, aux):
+            if self._fwd is None:
+                raise RuntimeError('%s: no forward callback' % op_type)
             arrays = list(in_data) + list(out_data) + list(aux)
             tags = [0] * len(in_data) + [1] * len(out_data) + \
                 [4] * len(aux)
@@ -1312,15 +1324,21 @@ def custom_op_register(op_type, creator_addr):
             super().__init__(need_top_grad=True)
             keys = [str(k).encode() for k in kwargs]
             vals = [str(v).encode() for v in kwargs.values()]
-            karr = (ctypes.c_char_p * max(1, len(keys)))(*(keys or [b''])) \
-                if keys else (ctypes.c_char_p * 1)()
-            varr = (ctypes.c_char_p * max(1, len(vals)))(*(vals or [b''])) \
-                if vals else (ctypes.c_char_p * 1)()
+            karr = (ctypes.c_char_p * max(1, len(keys)))(*keys)
+            varr = (ctypes.c_char_p * max(1, len(vals)))(*vals)
             self._cblist = MXCallbackList()
             if creator(op_type.encode(), len(keys), karr, varr,
                        ctypes.byref(self._cblist)) == 0:
                 raise RuntimeError('%s: CustomOpPropCreator failed'
                                    % op_type)
+            self._del_fn, self._del_ctx2 = _cb(self._cblist, 0, DEL)
+
+        def __del__(self):
+            if getattr(self, '_del_fn', None) is not None:
+                try:
+                    self._del_fn(self._del_ctx2)
+                except Exception:
+                    pass
 
         def list_arguments(self):
             fn, ctx = _cb(self._cblist, 1, LIST)

@@ -514,15 +514,19 @@ class Symbol:
         jnodes = []
         arg_nodes = []
         for i, node in enumerate(nodes):
+            # user attrs (__ctx_group__, __subgraph_name__, ...) ride in
+            # the same attrs dict, as the reference serializer does
+            extra = _json_attrs(getattr(node, '_extra_attrs', {}) or {})
             if node.is_variable:
                 arg_nodes.append(i)
                 jnodes.append({'op': 'null', 'name': node.name,
-                               'attrs': _json_attrs(node.var_attrs),
+                               'attrs': dict(_json_attrs(node.var_attrs),
+                                             **extra),
                                'inputs': []})
             else:
                 jnodes.append({
                     'op': node.op.name, 'name': node.name,
-                    'attrs': _json_attrs(node.attrs),
+                    'attrs': dict(_json_attrs(node.attrs), **extra),
                     'inputs': [[node_ids[id(c)], idx, 0]
                                for (c, idx) in node.inputs]})
         heads = [[node_ids[id(n)], i, 0] for (n, i) in self._entries]
@@ -647,9 +651,18 @@ def load_json(json_str):
     """Rebuild a Symbol from the JSON layout written by tojson."""
     data = json.loads(json_str)
     nodes = []
+
+    def _split_user_attrs(raw):
+        """__dunder__ keys are user attributes, never op parameters —
+        feeding them to an op fn would fail at execution."""
+        user = {k: v for k, v in raw.items()
+                if k.startswith('__') and k.endswith('__')}
+        rest = {k: v for k, v in raw.items() if k not in user}
+        return rest, user
+
     for jn in data['nodes']:
         if jn['op'] == 'null':
-            attrs = jn.get('attrs', {})
+            attrs, user = _split_user_attrs(jn.get('attrs', {}))
             shape = attrs.get('shape')
             if isinstance(shape, str) and shape not in ('None', ''):
                 shape = tuple(int(x) for x in
@@ -660,13 +673,15 @@ def load_json(json_str):
                          var_attrs={'shape': shape,
                                     'dtype': attrs.get('dtype'),
                                     'init': None})
+            node._extra_attrs = user
         else:
             op = _registry.get(jn['op'])
-            attrs = {k: _parse_attr(v) for k, v in
-                     jn.get('attrs', {}).items()}
+            raw, user = _split_user_attrs(jn.get('attrs', {}))
+            attrs = {k: _parse_attr(v) for k, v in raw.items()}
             inputs = [(nodes[i], idx) for (i, idx, _) in jn['inputs']]
             node = _Node(op, jn['name'], attrs=attrs, inputs=inputs,
                          num_outputs=num_outputs_of(op, attrs))
+            node._extra_attrs = user
             for pos in aux_indices_of(op):
                 if pos < len(inputs) and inputs[pos][0].is_variable:
                     inputs[pos][0].is_aux = True

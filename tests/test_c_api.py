@@ -817,17 +817,29 @@ def test_symbol_cut_subgraph():
     with mx.attribute.AttrScope(__subgraph_name__='loop_body'):
         inner = mx.sym.sin(pre, name='body_sin')
         out = mx.sym.broadcast_mul(inner, inner, name='body_mul')
-    # through the C surface
+    # through the REAL C entry point: round-trip the symbol over the
+    # ABI (JSON in, cut, inspect the returned boundary handles)
     from mxnet_tpu.native import c_api_bridge as bridge
-    h = bridge.SymHandle(out)
+    sym_h = _vp()
+    assert so.MXSymbolCreateFromJSON(out.tojson().encode(),
+                                     ctypes.byref(sym_h)) == 0
     n = ctypes.c_int()
     arr = ctypes.POINTER(ctypes.c_void_p)()
-    import ctypes as ct
-    hbox = ct.py_object(h)
-    # call via the bridge directly (handle marshalling is identical)
-    cut = bridge.symbol_cut_subgraph(h)
+    so.MXSymbolCutSubgraph.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.POINTER(ctypes.c_int)]
+    assert so.MXSymbolCutSubgraph(sym_h, ctypes.byref(arr),
+                                  ctypes.byref(n)) == 0,         so.MXGetLastError()
+    assert n.value == 1
+    nn_ = ctypes.c_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert so.MXSymbolListOutputs(ctypes.c_void_p(arr[0]),
+                                  ctypes.byref(nn_),
+                                  ctypes.byref(names)) == 0
+    assert names[0] == b'pre_output', names[0]
+    # the python-level pass mutates the same way
+    cut = bridge.symbol_cut_subgraph(bridge.SymHandle(out))
     assert len(cut) == 1
-    assert bridge._sym(cut[0]).list_outputs() == ['pre_output']
     # the subgraph now closes over a fresh variable named after the cut
     args_after = out.list_arguments()
     assert 'pre' in args_after and 'outer_in' not in args_after, \

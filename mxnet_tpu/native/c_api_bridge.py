@@ -180,8 +180,13 @@ def ndarray_context(arr):
 
 
 def ndarray_storage_type(arr):
+    # Reference NDArrayStorageType enum (include/mxnet/ndarray.h):
+    # kUndefinedStorage=-1, kDefaultStorage=0, kRowSparseStorage=1,
+    # kCSRStorage=2.
+    if arr is None:
+        return -1
     st = getattr(arr, 'stype', 'default')
-    return {'default': 1, 'row_sparse': 2, 'csr': 3}.get(st, 1)
+    return {'default': 0, 'row_sparse': 1, 'csr': 2}.get(st, -1)
 
 
 def ndarray_wait_to_read(arr):
@@ -303,14 +308,33 @@ def autograd_mark_variables(variables, grad_reqs, gradients):
                             [reqs.get(int(r), 'write') for r in grad_reqs])
 
 
-def autograd_backward(outputs, out_grads, retain_graph, train_mode):
+def autograd_backward(outputs, out_grads, retain_graph, train_mode,
+                      create_graph=0):
     from .. import autograd
     ograds = None
     if out_grads:
         ograds = [g for g in out_grads]
     autograd.backward(list(outputs), head_grads=ograds,
                       retain_graph=bool(retain_graph),
-                      train_mode=bool(train_mode))
+                      train_mode=bool(train_mode),
+                      create_graph=bool(create_graph))
+
+
+def autograd_backward_ex(outputs, out_grads, variables, retain_graph,
+                         create_graph, train_mode):
+    """Explicit-variable MXAutogradBackwardEx form: return grads for the
+    named variables without touching their .grad buffers (reference:
+    src/c_api/c_api_ndarray.cc:324 → Imperative::Backward(variables))."""
+    from .. import autograd
+    ograds = None
+    if out_grads:
+        ograds = [g for g in out_grads]
+    grads = autograd.grad(list(outputs), list(variables),
+                          head_grads=ograds,
+                          retain_graph=bool(retain_graph),
+                          create_graph=bool(create_graph),
+                          train_mode=bool(train_mode))
+    return list(grads) if isinstance(grads, (list, tuple)) else [grads]
 
 
 # -- symbol breadth ---------------------------------------------------------
@@ -493,11 +517,47 @@ def atomic_creator_name(name):
 
 
 def atomic_creator_info(name):
+    """Creator metadata incl. per-argument info, introspected from the
+    registered op function (reference: MXSymbolGetAtomicSymbolInfo returns
+    the full nnvm arg table; here the registry's fn signature is the
+    authoritative schema, so language bindings can generate wrappers)."""
+    import inspect
     from ..ops import registry
     op = registry.OPS[str(name)]
     doc = (op.fn.__doc__ or '').strip()
     kvna = op.key_var_num_args or ''
-    return str(name), doc, kvna
+    arg_names, arg_types, arg_descs = [], [], []
+    try:
+        params = list(inspect.signature(op.fn).parameters.values())
+    except (TypeError, ValueError):
+        params = []
+    if getattr(op, 'needs_rng', False) and params:
+        params = params[1:]  # leading PRNG key is framework-supplied
+    n_tensor = op.num_inputs if op.num_inputs >= 0 else 0
+    seen_positional = 0
+    for p in params:
+        if p.kind == inspect.Parameter.VAR_KEYWORD:
+            continue
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            arg_names.append(p.name)
+            arg_types.append('NDArray-or-Symbol[]')
+            arg_descs.append('variadic tensor inputs')
+            continue
+        if p.default is inspect.Parameter.empty:
+            seen_positional += 1
+            is_tensor = seen_positional <= n_tensor or op.num_inputs < 0
+            arg_names.append(p.name)
+            arg_types.append('NDArray-or-Symbol' if is_tensor
+                             else 'required')
+            arg_descs.append('tensor input' if is_tensor else '')
+        else:
+            d = p.default
+            tname = {bool: 'boolean', int: 'int', float: 'float',
+                     str: 'string'}.get(type(d), 'any')
+            arg_names.append(p.name)
+            arg_types.append('%s, optional, default=%r' % (tname, d))
+            arg_descs.append('')
+    return str(name), doc, kvna, arg_names, arg_types, arg_descs
 
 
 # -- executor ---------------------------------------------------------------
@@ -590,8 +650,30 @@ def list_data_iters():
 
 
 def data_iter_info(name):
+    """Iterator metadata incl. per-kwarg info from __init__'s signature
+    (reference: MXDataIterGetIterInfo returns the full param table)."""
+    import inspect
     cls = _iter_registry()[str(name)]
-    return str(name), (cls.__doc__ or '').strip()
+    arg_names, arg_types, arg_descs = [], [], []
+    try:
+        params = list(inspect.signature(cls.__init__).parameters.values())
+    except (TypeError, ValueError):
+        params = []
+    for p in params:
+        if p.name == 'self' or p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                                          inspect.Parameter.VAR_KEYWORD):
+            continue
+        if p.default is inspect.Parameter.empty:
+            arg_types.append('required')
+        else:
+            d = p.default
+            tname = {bool: 'boolean', int: 'int', float: 'float',
+                     str: 'string'}.get(type(d), 'any')
+            arg_types.append('%s, optional, default=%r' % (tname, d))
+        arg_names.append(p.name)
+        arg_descs.append('')
+    return (str(name), (cls.__doc__ or '').strip(),
+            arg_names, arg_types, arg_descs)
 
 
 class IterHandle:
@@ -972,7 +1054,7 @@ def set_calib_table(h, names, lows, highs):
 
 def ndarray_create_sparse(stype_code, shape, dev_type, dev_id, dtype_code):
     from ..ndarray import sparse as sp
-    stype = {1: 'default', 2: 'row_sparse', 3: 'csr'}.get(int(stype_code),
+    stype = {0: 'default', 1: 'row_sparse', 2: 'csr'}.get(int(stype_code),
                                                           'default')
     arr = sp.zeros(stype, tuple(int(s) for s in shape),
                    ctx=_ctx(dev_type, dev_id),

@@ -35,6 +35,12 @@ from .ops import registry as _registry
 
 __all__ = ['register_op', 'load', 'attach_namespaces']
 
+# package-level names this module itself installed, per package; a
+# re-registered op must refresh the stale wrapper (it closes over the old
+# Operator), while genuine package API (nd.load, nd.zeros, ...) is never
+# clobbered
+_plugin_owned = {'nd': set(), 'sym': set()}
+
 
 def attach_namespaces(name):
     """Attach nd/sym wrappers for a registered op name (idempotent)."""
@@ -43,16 +49,16 @@ def attach_namespaces(name):
     from .ndarray import register as nd_reg
     w = nd_reg._make_wrapper(name, op)
     setattr(nd_pkg.op, name, w)
-    if not hasattr(nd_pkg, name) or getattr(nd_pkg, name) is w:
-        # same guard the built-in promotion uses: never clobber
-        # package-level API (nd.load, nd.zeros, ...) with an op wrapper
+    if not hasattr(nd_pkg, name) or name in _plugin_owned['nd']:
         setattr(nd_pkg, name, w)
+        _plugin_owned['nd'].add(name)
     from . import symbol as sym_pkg
     from .symbol import register as sym_reg
     sw = sym_reg._make_wrapper(name, op)
     setattr(sym_pkg.op, name, sw)
-    if not hasattr(sym_pkg, name) or getattr(sym_pkg, name) is sw:
+    if not hasattr(sym_pkg, name) or name in _plugin_owned['sym']:
         setattr(sym_pkg, name, sw)
+        _plugin_owned['sym'].add(name)
 
 
 def register_op(name, **reg_kwargs):

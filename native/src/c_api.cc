@@ -904,17 +904,35 @@ int MXSymbolGetAtomicSymbolInfo(
   const char* n0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
   const char* d0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 1));
   const char* k0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 2));
+  PyObject* anames = PyTuple_GetItem(tup, 3);
+  PyObject* atypes = PyTuple_GetItem(tup, 4);
+  PyObject* adescs = PyTuple_GetItem(tup, 5);
+  Py_ssize_t nargs = anames ? PyList_Size(anames) : 0;
+  // reserve up-front: c_str()/data() pointers must stay stable below
+  g_ret.strings.reserve(3 + 3 * (size_t)nargs);
+  g_ret.cstrs.reserve(3 * (size_t)nargs);
   g_ret.strings.emplace_back(n0 ? n0 : "");
   g_ret.strings.emplace_back(d0 ? d0 : "");
   g_ret.strings.emplace_back(k0 ? k0 : "");
+  for (int part = 0; part < 3; ++part) {
+    PyObject* lst = part == 0 ? anames : (part == 1 ? atypes : adescs);
+    for (Py_ssize_t i = 0; i < nargs; ++i) {
+      const char* s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+      g_ret.strings.emplace_back(s ? s : "");
+      g_ret.cstrs.push_back(g_ret.strings.back().c_str());
+    }
+  }
   Py_DECREF(tup);
   *name = g_ret.strings[0].c_str();
   *description = g_ret.strings[1].c_str();
   *key_var_num_args = g_ret.strings[2].c_str();
-  *num_args = 0;
-  if (arg_names) *arg_names = nullptr;
-  if (arg_type_infos) *arg_type_infos = nullptr;
-  if (arg_descriptions) *arg_descriptions = nullptr;
+  *num_args = (mx_uint)nargs;
+  if (arg_names)
+    *arg_names = nargs ? &g_ret.cstrs[0] : nullptr;
+  if (arg_type_infos)
+    *arg_type_infos = nargs ? &g_ret.cstrs[(size_t)nargs] : nullptr;
+  if (arg_descriptions)
+    *arg_descriptions = nargs ? &g_ret.cstrs[2 * (size_t)nargs] : nullptr;
   if (return_type) *return_type = nullptr;
   return 0;
 }
@@ -950,7 +968,7 @@ int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
   int rc = MXImperativeInvoke(creator, num_inputs, inputs, num_outputs,
                               outputs, num_params, param_keys, param_vals);
   if (rc == 0 && out_stypes) {
-    g_ret.ints.assign((size_t)*num_outputs, 1);  // kDefaultStorage
+    g_ret.ints.assign((size_t)*num_outputs, 0);  // kDefaultStorage
     *out_stypes = g_ret.ints.data();
   }
   return rc;
@@ -1007,21 +1025,34 @@ int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle* var_handles,
 int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle* output_handles,
                          NDArrayHandle* ograd_handles,
                          mx_uint num_variables,
-                         NDArrayHandle* /*var_handles*/, int retain_graph,
-                         int /*create_graph*/, int is_train,
+                         NDArrayHandle* var_handles, int retain_graph,
+                         int create_graph, int is_train,
                          NDArrayHandle** grad_handles, int** grad_stypes) {
-  if (num_variables != 0) {
-    g_last_error = "MXAutogradBackwardEx: explicit variable list is not "
-                   "supported; mark variables and read .grad instead";
-    return -1;
-  }
   Gil gil;
   PyObject* outs = make_handle_list(num_output, output_handles);
   PyObject* ograds = ograd_handles
       ? make_handle_list(num_output, ograd_handles)
       : (Py_INCREF(Py_None), Py_None);
-  PyObject* args = Py_BuildValue("(OOii)", outs, ograds, retain_graph,
-                                 is_train);
+  if (num_variables != 0) {
+    // explicit-variable form (reference: c_api_ndarray.cc:324 →
+    // Imperative::Backward(variables)): returns grads for the named
+    // vars without writing their .grad buffers
+    PyObject* vars = make_handle_list(num_variables, var_handles);
+    PyObject* args = Py_BuildValue("(OOOiii)", outs, ograds, vars,
+                                   retain_graph, create_graph, is_train);
+    Py_DECREF(outs); Py_DECREF(ograds); Py_DECREF(vars);
+    int ngrads = 0;
+    NDArrayHandle* sink = nullptr;
+    int rc = out_handle_list("autograd_backward_ex", args, &ngrads,
+                             grad_handles ? grad_handles : &sink);
+    if (rc == 0 && grad_stypes) {
+      g_ret.ints.assign((size_t)ngrads, 0);  // kDefaultStorage
+      *grad_stypes = g_ret.ints.data();
+    }
+    return rc;
+  }
+  PyObject* args = Py_BuildValue("(OOiii)", outs, ograds, retain_graph,
+                                 is_train, create_graph);
   Py_DECREF(outs); Py_DECREF(ograds);
   int rc = simple("autograd_backward", args);
   if (rc == 0 && grad_handles) *grad_handles = nullptr;
@@ -1467,7 +1498,7 @@ int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
   int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
                             outputs);
   if (rc == 0 && out_stypes) {
-    g_ret.ints.assign((size_t)*num_outputs, 1);
+    g_ret.ints.assign((size_t)*num_outputs, 0);  // kDefaultStorage
     *out_stypes = g_ret.ints.data();
   }
   return rc;
@@ -1504,15 +1535,32 @@ int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
   g_ret.cstrs.clear();
   const char* n0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 0));
   const char* d0 = PyUnicode_AsUTF8(PyTuple_GetItem(tup, 1));
+  PyObject* anames = PyTuple_GetItem(tup, 2);
+  PyObject* atypes = PyTuple_GetItem(tup, 3);
+  PyObject* adescs = PyTuple_GetItem(tup, 4);
+  Py_ssize_t nargs = anames ? PyList_Size(anames) : 0;
+  g_ret.strings.reserve(2 + 3 * (size_t)nargs);
+  g_ret.cstrs.reserve(3 * (size_t)nargs);
   g_ret.strings.emplace_back(n0 ? n0 : "");
   g_ret.strings.emplace_back(d0 ? d0 : "");
+  for (int part = 0; part < 3; ++part) {
+    PyObject* lst = part == 0 ? anames : (part == 1 ? atypes : adescs);
+    for (Py_ssize_t i = 0; i < nargs; ++i) {
+      const char* s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+      g_ret.strings.emplace_back(s ? s : "");
+      g_ret.cstrs.push_back(g_ret.strings.back().c_str());
+    }
+  }
   Py_DECREF(tup);
   *name = g_ret.strings[0].c_str();
   *description = g_ret.strings[1].c_str();
-  *num_args = 0;
-  if (arg_names) *arg_names = nullptr;
-  if (arg_type_infos) *arg_type_infos = nullptr;
-  if (arg_descriptions) *arg_descriptions = nullptr;
+  *num_args = (mx_uint)nargs;
+  if (arg_names)
+    *arg_names = nargs ? &g_ret.cstrs[0] : nullptr;
+  if (arg_type_infos)
+    *arg_type_infos = nargs ? &g_ret.cstrs[(size_t)nargs] : nullptr;
+  if (arg_descriptions)
+    *arg_descriptions = nargs ? &g_ret.cstrs[2 * (size_t)nargs] : nullptr;
   return 0;
 }
 

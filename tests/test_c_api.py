@@ -477,11 +477,11 @@ def test_sparse_aux_and_storage_type():
     import ctypes as ct
     shape = (ct.c_uint * 2)(2, 3)
     out = ct.c_void_p()
-    assert so.MXNDArrayCreateSparseEx(3, shape, 2, 1, 0, 0, 0, 0, None,
+    assert so.MXNDArrayCreateSparseEx(2, shape, 2, 1, 0, 0, 0, 0, None,
                                       None, None, ct.byref(out)) == 0
     st = ct.c_int()
     assert so.MXNDArrayGetStorageType(out, ct.byref(st)) == 0
-    assert st.value == 3          # kCSRStorage
+    assert st.value == 2          # kCSRStorage (reference enum: csr=2)
     aux_t = ct.c_int()
     assert so.MXNDArrayGetAuxType(out, 0, ct.byref(aux_t)) == 0
     assert aux_t.value == 6       # int64 type flag
@@ -844,3 +844,113 @@ def test_symbol_cut_subgraph():
     args_after = out.list_arguments()
     assert 'pre' in args_after and 'outer_in' not in args_after, \
         args_after
+
+
+def test_atomic_symbol_info_arg_metadata():
+    # reference MXSymbolGetAtomicSymbolInfo returns the full per-argument
+    # table; bindings generate op wrappers from it, so num_args must not
+    # be 0 for ops with parameters (ADVICE r4: was empty)
+    h = _find_creator('Convolution')
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    kv = ctypes.c_char_p()
+    rt = ctypes.c_char_p()
+    n = ctypes.c_uint()
+    anames = ctypes.POINTER(ctypes.c_char_p)()
+    atypes = ctypes.POINTER(ctypes.c_char_p)()
+    adescs = ctypes.POINTER(ctypes.c_char_p)()
+    so.MXSymbolGetAtomicSymbolInfo.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p)]
+    assert so.MXSymbolGetAtomicSymbolInfo(
+        h, ctypes.byref(name), ctypes.byref(desc), ctypes.byref(n),
+        ctypes.byref(anames), ctypes.byref(atypes), ctypes.byref(adescs),
+        ctypes.byref(kv), ctypes.byref(rt)) == 0
+    assert name.value == b'Convolution'
+    assert n.value > 0
+    names = [anames[i].decode() for i in range(n.value)]
+    types = [atypes[i].decode() for i in range(n.value)]
+    assert 'kernel' in names or 'num_filter' in names
+    # optional params carry a parseable type string
+    assert any('optional, default=' in t for t in types)
+
+
+def test_data_iter_info_arg_metadata():
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_void_p)()
+    so.MXListDataIters.argtypes = [
+        ctypes.POINTER(ctypes.c_uint),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p))]
+    assert so.MXListDataIters(ctypes.byref(n), ctypes.byref(arr)) == 0
+    assert n.value > 0
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    na = ctypes.c_uint()
+    anames = ctypes.POINTER(ctypes.c_char_p)()
+    atypes = ctypes.POINTER(ctypes.c_char_p)()
+    adescs = ctypes.POINTER(ctypes.c_char_p)()
+    so.MXDataIterGetIterInfo.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    found_args = False
+    for i in range(n.value):
+        assert so.MXDataIterGetIterInfo(
+            arr[i], ctypes.byref(name), ctypes.byref(desc),
+            ctypes.byref(na), ctypes.byref(anames), ctypes.byref(atypes),
+            ctypes.byref(adescs)) == 0
+        if na.value > 0:
+            found_args = True
+            [anames[j].decode() for j in range(na.value)]
+    assert found_args
+
+
+def test_autograd_backward_ex_explicit_variables():
+    # reference c_api_ndarray.cc:324: num_variables/var_handles form
+    # returns grads for the named vars without touching .grad buffers
+    x = _new_array((2, 2))
+    buf = (ctypes.c_float * 4)(1, 2, 3, 4)
+    assert so.MXNDArraySyncCopyFromCPU(x, buf, 4) == 0
+    g = _new_array((2, 2))
+    vars_ = (ctypes.c_void_p * 1)(x)
+    reqs = (ctypes.c_uint * 1)(1)
+    grads = (ctypes.c_void_p * 1)(g)
+    assert so.MXAutogradMarkVariables(1, vars_, reqs, grads) == 0
+    prev = ctypes.c_int()
+    assert so.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    sq = _find_creator('square')
+    ins = (ctypes.c_void_p * 1)(x)
+    nout = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert so.MXImperativeInvoke(sq, 1, ins, ctypes.byref(nout),
+                                 ctypes.byref(outs), 0, None, None) == 0
+    y = ctypes.c_void_p(outs[0])
+    assert so.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    so.MXAutogradBackwardEx.argtypes = [
+        ctypes.c_uint, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_uint,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int))]
+    heads = (ctypes.c_void_p * 1)(y)
+    gh = ctypes.POINTER(ctypes.c_void_p)()
+    gst = ctypes.POINTER(ctypes.c_int)()
+    assert so.MXAutogradBackwardEx(
+        1, heads, None, 1, vars_, 0, 0, 1,
+        ctypes.byref(gh), ctypes.byref(gst)) == 0, so.MXGetLastError()
+    got = (ctypes.c_float * 4)()
+    assert so.MXNDArraySyncCopyToCPU(gh[0], got, 4) == 0
+    np.testing.assert_allclose(list(got), [2, 4, 6, 8])
+    assert gst[0] == 0            # kDefaultStorage
+    # the marked .grad buffer must be untouched (reference semantics)
+    untouched = (ctypes.c_float * 4)()
+    assert so.MXNDArraySyncCopyToCPU(g, untouched, 4) == 0
+    np.testing.assert_allclose(list(untouched), [0, 0, 0, 0])
+    for h in (x, g, y, ctypes.c_void_p(gh[0])):
+        so.MXNDArrayFree(h)

@@ -77,3 +77,25 @@ def neg_abs(data):
     np.testing.assert_allclose(
         nd.neg_abs(nd.array(np.array([-3.0, 2.0], 'f'))).asnumpy(),
         [-3.0, -2.0])
+
+
+def test_reregister_refreshes_package_wrapper():
+    # re-registering an op under an existing plugin name must refresh the
+    # nd.<name>/sym.<name> wrappers, which close over the Operator object
+    from mxnet_tpu import sym
+
+    @plugin.register_op('replug', num_inputs=1)
+    def replug_v1(data):
+        return data + 1.0
+
+    x = nd.array(np.array([1.0], 'f'))
+    np.testing.assert_allclose(nd.replug(x).asnumpy(), [2.0])
+
+    @plugin.register_op('replug', num_inputs=1)
+    def replug_v2(data):
+        return data * 10.0
+
+    np.testing.assert_allclose(nd.replug(x).asnumpy(), [10.0])
+    s = sym.replug(sym.Variable('d'))
+    out = s.eval(ctx=None, d=x)[0]
+    np.testing.assert_allclose(out.asnumpy(), [10.0])

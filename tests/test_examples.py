@@ -266,3 +266,15 @@ def test_plugin_op_trains_and_serializes():
     acc, in_json = plugin_op.main(['--epochs', '6',
                                    '--num-samples', '256'])
     assert acc > 0.9 and in_json, (acc, in_json)
+
+
+def test_train_mnist_module_and_gluon():
+    # the canonical LeNet example on both training APIs (reference:
+    # example/image-classification/train_mnist.py); synthetic-digit
+    # fallback keeps it egress-free
+    from examples import train_mnist
+    acc_mod = train_mnist.train_module(epochs=1, batch_size=64, lr=0.05)
+    assert acc_mod > 0.6, acc_mod
+    # gluon reports the running epoch average, so give it a second epoch
+    acc_glu = train_mnist.train_gluon(epochs=2, batch_size=64, lr=0.05)
+    assert acc_glu > 0.6, acc_glu

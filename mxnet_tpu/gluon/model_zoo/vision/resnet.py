@@ -77,29 +77,6 @@ class _ResidualCell(HybridBlock):
             else:
                 self.downsample = None
 
-    def _fused_seq(self, seq, x, geom):
-        """Run a Conv2D→BatchNorm(→ReLU) sequence through the Pallas
-        stats-epilogue fusion (gluon/fused.py) on flattened channels-
-        last [B*H*W, C] activations — the form with one natural layout,
-        so XLA inserts no layout-fix copies around the opaque kernel."""
-        from ... import fused as _fused
-        children = list(seq._children.values())
-        i = 0
-        while i < len(children):
-            c = children[i]
-            nxt = children[i + 1] if i + 1 < len(children) else None
-            if isinstance(c, nn.Conv2D) and isinstance(nxt, nn.BatchNorm):
-                has_relu = i + 2 < len(children) and \
-                    isinstance(children[i + 2], nn.Activation)
-                x, geom = _fused.fused_conv_bn_act(x, c, nxt,
-                                                   relu=has_relu,
-                                                   geom=geom)
-                i += 3 if has_relu else 2
-            else:
-                x = c(x)
-                i += 1
-        return x, geom
-
     def hybrid_forward(self, F, x):
         if self._preact:
             residual = x
@@ -109,21 +86,6 @@ class _ResidualCell(HybridBlock):
                     residual = self.downsample(x)
                 x = conv(x)
             return x + residual
-        from .... import ndarray as _ndmod
-        from ... import fused as _fused
-        if F is _ndmod and _fused.fusion_enabled():
-            # whole cell runs as flattened channels-last [B*H*W, C]; the
-            # boundary transposes of adjacent cells cancel pairwise
-            b_, c_, h_, w_ = x.shape
-            xh = x.transpose((0, 2, 3, 1)).reshape((b_ * h_ * w_, c_))
-            geom = (b_, h_, w_)
-            body_out, gout = self._fused_seq(self.body, xh, geom)
-            residual = xh if self.downsample is None \
-                else self._fused_seq(self.downsample, xh, geom)[0]
-            out2 = F.relu(body_out + residual)
-            bo, ho, wo = gout
-            return out2.reshape((bo, ho, wo, out2.shape[1])) \
-                       .transpose((0, 3, 1, 2))
         residual = x if self.downsample is None else self.downsample(x)
         return F.relu(self.body(x) + residual)
 

@@ -21,4 +21,3 @@ from . import quantization  # noqa: F401
 from . import graph      # noqa: F401
 from . import vision_extra  # noqa: F401
 from . import pallas_kernels  # noqa: F401
-from . import fused_conv_bn  # noqa: F401

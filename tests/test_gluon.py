@@ -438,3 +438,54 @@ def test_pool_positional_signatures_match_reference():
     assert p._kwargs['pooling_convention'] == 'full'
     with pytest.raises(TypeError):
         nn.MaxPool2D(2, count_include_pad=False)
+
+
+def test_batchnorm_custom_vjp_numerics():
+    # the hand-scheduled BN vjp (ops/nn.py _bn_train_core) must match
+    # the autodiff of the textbook formulation, resist E[x2]-E[x]2
+    # cancellation (shifted one-pass), and keep batch stats in the
+    # data dtype (bf16-cast moving stats must not promote to f32)
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import batch_norm
+
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(4, 6, 5, 5).astype('float32'))
+    gamma = jnp.asarray(rs.rand(6).astype('float32') + 0.5)
+    beta = jnp.asarray(rs.randn(6).astype('float32'))
+    mm, mv = jnp.zeros(6), jnp.ones(6)
+
+    def ref(x):
+        red = (0, 2, 3)
+        mean = jnp.mean(x, axis=red)
+        var = jnp.var(x, axis=red)
+        shp = [1, 6, 1, 1]
+        inv = jax.lax.rsqrt(var + 1e-3).reshape(shp)
+        return (x - mean.reshape(shp)) * inv * gamma.reshape(shp) \
+            + beta.reshape(shp)
+
+    def loss_new(x):
+        o, _, _ = batch_norm(x, gamma, beta, mm, mv, eps=1e-3,
+                             fix_gamma=False, training=True)
+        w = jnp.cos(jnp.arange(o.size).reshape(o.shape) * 0.01)
+        return jnp.sum(o * w)
+
+    def loss_ref(x):
+        o = ref(x)
+        w = jnp.cos(jnp.arange(o.size).reshape(o.shape) * 0.01)
+        return jnp.sum(o * w)
+
+    np.testing.assert_allclose(jax.grad(loss_new)(x), jax.grad(loss_ref)(x),
+                               rtol=2e-4, atol=2e-5)
+    # precision under a large mean offset (one-pass f32 bound: rel err
+    # ~ (mean^2/var) * 2^-24; mean/std=100 -> ~6e-4)
+    xbig = x + 100.0
+    _, _, var_b = batch_norm(xbig, gamma, beta, mm, mv, eps=1e-3,
+                             fix_gamma=False, training=True)
+    np.testing.assert_allclose(np.asarray(var_b),
+                               np.var(np.asarray(xbig), axis=(0, 2, 3)),
+                               rtol=5e-3)
+    # dtype contract
+    _, m16, v16 = batch_norm(x.astype(jnp.bfloat16), gamma, beta, mm, mv,
+                             eps=1e-3, fix_gamma=False, training=True)
+    assert m16.dtype == jnp.bfloat16 and v16.dtype == jnp.bfloat16

@@ -1,0 +1,213 @@
+"""Round-5 probe: close the Pallas matmul DMA-pipelining gap.
+
+Times lax vs Pallas matmul variants on the ResNet-50 1x1-conv shapes
+(bf16, bs128, NHWC-flattened M = B*H*W). Protocol per memory
+tpu-tunnel-perf-facts: N iters chained inside ONE jit (true data
+dependency through a tiny b-perturbation so nothing folds), one sync at
+the end — amortizes the ~180 ms tunnel RTT. Run on a QUIET host.
+
+Usage: python tools/probe_matmul_pipeline.py [iters]
+"""
+import sys
+import time
+import functools
+
+sys.path.insert(0, '.')
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+SHAPES = [
+    # (M, K, N)  — ResNet-50 bottleneck 1x1s at bs128
+    (401408, 64, 256),
+    (401408, 256, 64),
+    (100352, 512, 128),
+    (100352, 128, 512),
+    (25088, 1024, 256),
+    (25088, 256, 1024),
+    (6272, 512, 2048),
+    (6272, 2048, 512),
+]
+
+
+def lax_mm(a, b):
+    y = jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return y.astype(a.dtype)
+
+
+def pallas_cur(a, b, bm, bn):
+    """Round-4 kernel shape: grid (mt, nt), m outer, full-K blocks,
+    f32 VMEM accumulator (stats epilogue removed)."""
+    M, K = a.shape
+    N = b.shape[1]
+
+    def kern(a_ref, b_ref, y_ref, acc_ref):
+        acc_ref[:] = jnp.dot(a_ref[:], b_ref[:],
+                             preferred_element_type=jnp.float32)
+        y_ref[:] = acc_ref[:].astype(y_ref.dtype)
+
+    return pl.pallas_call(
+        kern,
+        grid=(M // bm, N // bn),
+        in_specs=[pl.BlockSpec((bm, K), lambda m, n: (m, 0)),
+                  pl.BlockSpec((K, bn), lambda m, n: (0, n))],
+        out_specs=pl.BlockSpec((bm, bn), lambda m, n: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), a.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel')),
+    )(a, b)
+
+
+def pallas_noacc(a, b, bm, bn):
+    """No scratch accumulator at all: single dot straight to the output
+    block (Mosaic can then fuse the cast into the MXU drain)."""
+    M, K = a.shape
+    N = b.shape[1]
+
+    def kern(a_ref, b_ref, y_ref):
+        y_ref[:] = jnp.dot(a_ref[:], b_ref[:],
+                           preferred_element_type=jnp.float32
+                           ).astype(y_ref.dtype)
+
+    return pl.pallas_call(
+        kern,
+        grid=(M // bm, N // bn),
+        in_specs=[pl.BlockSpec((bm, K), lambda m, n: (m, 0)),
+                  pl.BlockSpec((K, bn), lambda m, n: (0, n))],
+        out_specs=pl.BlockSpec((bm, bn), lambda m, n: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), a.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel')),
+    )(a, b)
+
+
+def pallas_ws(a, b, bm, bn):
+    """Weight-stationary order: n outer, m inner — for a fixed n the B
+    tile stays resident while A/Y stream, so the pipeliner sees a pure
+    stream of same-size A-fetch + Y-drain pairs."""
+    M, K = a.shape
+    N = b.shape[1]
+
+    def kern(a_ref, b_ref, y_ref):
+        y_ref[:] = jnp.dot(a_ref[:], b_ref[:],
+                           preferred_element_type=jnp.float32
+                           ).astype(y_ref.dtype)
+
+    return pl.pallas_call(
+        kern,
+        grid=(N // bn, M // bm),
+        in_specs=[pl.BlockSpec((bm, K), lambda n, m: (m, 0)),
+                  pl.BlockSpec((K, bn), lambda n, m: (0, n))],
+        out_specs=pl.BlockSpec((bm, bn), lambda n, m: (m, n)),
+        out_shape=jax.ShapeDtypeStruct((M, N), a.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel')),
+    )(a, b)
+
+
+def pallas_ep(a, b, bm, bn):
+    """emit_pipeline: hand-instantiated double-buffered pipeline over
+    the same (n, m) weight-stationary grid, refs left in HBM."""
+    M, K = a.shape
+    N = b.shape[1]
+
+    def inner(a_ref, b_ref, y_ref):
+        y_ref[:] = jnp.dot(a_ref[:], b_ref[:],
+                           preferred_element_type=jnp.float32
+                           ).astype(y_ref.dtype)
+
+    def outer(a_hbm, b_hbm, y_hbm):
+        pipe = pltpu.emit_pipeline(
+            inner,
+            grid=(N // bn, M // bm),
+            in_specs=[pl.BlockSpec((bm, K), lambda n, m: (m, 0)),
+                      pl.BlockSpec((K, bn), lambda n, m: (0, n))],
+            out_specs=[pl.BlockSpec((bm, bn), lambda n, m: (m, n))],
+        )
+        pipe(a_hbm, b_hbm, y_hbm)
+
+    return pl.pallas_call(
+        outer,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct((M, N), a.dtype),
+    )(a, b)
+
+
+def time_variant(name, fn, M, K, N, iters):
+    """Slope timing: the tunnel adds a ~105 ms fixed cost per chained
+    call, so a single-count measurement is useless below ~1 ms/iter.
+    Time the chained loop at `iters` and `4*iters` and take the slope —
+    the fixed cost cancels exactly."""
+    a = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.bfloat16)
+    import numpy as onp
+
+    def make(n):
+        @jax.jit
+        def chained(a, b):
+            def body(i, bb):
+                y = fn(a, bb)
+                # true data dependency, ~zero cost: perturb b by a K x N
+                # slice of y scaled to bf16 underflow
+                return bb + y[:K, :N] * jnp.bfloat16(1e-30)
+            return jax.lax.fori_loop(0, n, body, b)
+        return chained
+
+    def run(f):
+        t0 = time.perf_counter()
+        out = f(a, b)
+        onp.asarray(jax.device_get(out[0, 0]))
+        return time.perf_counter() - t0
+
+    try:
+        # adaptive count: the hi-lo span must dwarf the ±10-20 ms jitter
+        # of the fixed tunnel cost, so target ~1.5 s of pure kernel time
+        est = max((M * K + K * N + M * N) * 2 / 700e9,
+                  2 * M * K * N / 150e12)
+        lo = max(iters, int(0.5 / est / 3))
+        f_lo, f_hi = make(lo), make(4 * lo)
+        run(f_lo), run(f_hi)           # warm both compiles
+        slopes = []
+        for _ in range(3):
+            t_lo = run(f_lo)
+            t_hi = run(f_hi)
+            slopes.append((t_hi - t_lo) / (3 * lo))
+        slopes.sort()
+        dt = slopes[1]
+    except Exception as e:
+        print('  %-22s FAILED: %s' % (name, str(e)[:120]))
+        return None
+    gb = (M * K + K * N + M * N) * 2 / 1e9
+    print('  %-22s %7.3f ms   %6.1f GB/s   %5.1f TFLOP/s'
+          % (name, dt * 1e3, gb / dt, 2 * M * K * N / dt / 1e12),
+          flush=True)
+    return dt
+
+
+def main():
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    print('backend:', jax.default_backend(), ' iters:', iters)
+    for (M, K, N) in SHAPES:
+        print('shape M=%d K=%d N=%d' % (M, K, N), flush=True)
+        bm = min(1024, M)
+        bn = min(256, N)
+        time_variant('lax', lax_mm, M, K, N, iters)
+        time_variant('pallas_cur bm%d' % bm,
+                     functools.partial(pallas_cur, bm=bm, bn=bn),
+                     M, K, N, iters)
+        time_variant('pallas_noacc', functools.partial(
+            pallas_noacc, bm=bm, bn=bn), M, K, N, iters)
+        time_variant('pallas_ws', functools.partial(
+            pallas_ws, bm=bm, bn=bn), M, K, N, iters)
+        time_variant('pallas_ep', functools.partial(
+            pallas_ep, bm=bm, bn=bn), M, K, N, iters)
+
+
+if __name__ == '__main__':
+    main()

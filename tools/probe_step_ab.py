@@ -1,0 +1,83 @@
+"""Round-5 A/B: ResNet-50 full train step — train-mode BN vs frozen-BN
+(the stats-machinery ceiling), measured with enough iterations to drown
+the ~105-180 ms tunnel fixed cost. Run on a QUIET host.
+
+Round-5 record (bs128, bf16, quiet host, slope timing): train 46.17 ms
+(2772 img/s) after the BN custom_vjp landed; frozen ceiling 37.68 ms
+(3397 img/s). Windowed-protocol history: pre-vjp train 55.28 ms; the
+retired Pallas fused path 69.55 ms.
+
+Usage: python tools/probe_step_ab.py [mode ...]
+  modes: train frozen (default: both)
+"""
+import sys
+import time
+
+sys.path.insert(0, '.')
+import numpy as np  # noqa: E402
+
+
+def measure(step, nd, warmup=3, iters=100):
+    """Slope timing: run a window of `iters` and one of `3*iters`
+    dispatches (single sync each) and take the slope — the ~105-180 ms
+    fixed tunnel cost per sync cancels exactly."""
+    for _ in range(warmup):
+        step()
+    nd.waitall()
+
+    def window(n):
+        out = step()
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = step()
+        out.wait_to_read()
+        return time.perf_counter() - t0
+
+    t_lo = window(iters)
+    t_hi = window(3 * iters)
+    return (t_hi - t_lo) / (2 * iters)
+
+
+def build_and_time(frozen, batch=128):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon import model_zoo
+
+    net = model_zoo.vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    net.cast('bfloat16')
+    if frozen:
+        def set_global(b):
+            from mxnet_tpu.gluon import nn
+            for c in b._children.values():
+                if isinstance(c, nn.BatchNorm):
+                    c._kwargs['use_global_stats'] = True
+                set_global(c)
+        set_global(net)
+    net.hybridize(static_alloc=True, static_shape=True)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.uniform(-1, 1, (batch, 3, 224, 224)),
+                 dtype='bfloat16')
+    y = nd.array(np.random.randint(0, 1000, (batch,)))
+    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+    pt = parallel.ParallelTrainer(
+        net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9,
+                        'wd': 1e-4}, mesh)
+    pt.step(x, y)
+    dt = measure(lambda: pt.step(x, y), nd)
+    return dt
+
+
+def main():
+    modes = sys.argv[1:] or ['train', 'frozen']
+    batch = 128
+    for mode in modes:
+        dt = build_and_time(frozen=(mode == 'frozen'), batch=batch)
+        print('%s: %.2f ms/step  %.1f img/s' % (mode, dt * 1e3, batch / dt),
+              flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,201 @@
+"""Data-parallel scaling-efficiency harness (BASELINE.json metric 3).
+
+Measures the fused train step at dp=1/2/4/... over whatever devices
+exist, reports throughput, efficiency vs dp=1, and per-step collective
+traffic (all-reduce / all-gather / reduce-scatter bytes parsed from the
+optimized HLO), and writes a JSON artifact. This is the measuring
+instrument for the reference's multi-GPU scaling table
+(example/image-classification/README.md:307-319, ~90% efficiency at
+8-256 GPUs): on real multi-chip hardware it is one command; on this rig
+it validates its plumbing on the virtual 8-device CPU mesh (numbers
+there are meaningless, the artifact structure and comm accounting are
+not).
+
+Usage:
+  python bench_scaling.py                       # resnet50, dp=1..8
+  python bench_scaling.py --model mlp --dp 1,2  # tiny smoke (tests)
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python bench_scaling.py --image 64        # virtual-mesh check
+
+The per-chip batch is held constant (weak scaling, like the reference
+table), so efficiency = rate(dp) / (dp * rate(1)).
+"""
+import argparse
+import json
+import re
+import time
+
+import numpy as np
+
+_COLLECTIVES = ('all-reduce', 'all-gather', 'reduce-scatter',
+                'collective-permute', 'all-to-all')
+_DTYPE_BYTES = {'f64': 8, 'f32': 4, 'bf16': 2, 'f16': 2, 's64': 8,
+                's32': 4, 'u32': 4, 's16': 2, 'u16': 2, 's8': 1,
+                'u8': 1, 'pred': 1}
+
+
+def collective_bytes(hlo_text):
+    """Sum output bytes of collective ops in optimized HLO text."""
+    total = 0
+    per_kind = {}
+    for line in hlo_text.splitlines():
+        m = re.search(r'=\s+((?:\([^)]*\)|\S+))\s+(%?[\w-]+)\(', line)
+        if not m:
+            continue
+        kind = m.group(2).lstrip('%')
+        base = kind.rstrip('.0123456789')
+        if not any(base.startswith(c) for c in _COLLECTIVES):
+            continue
+        # async pairs (all-reduce-start / all-reduce-done): the -start
+        # op's tuple output would double-count the one logical
+        # collective — count only the -done (or sync) form
+        if base.endswith('-start'):
+            continue
+        shapes = re.findall(r'(\w+)\[([\d,]*)\]', m.group(1))
+        nbytes = 0
+        for dt, dims in shapes:
+            if dt not in _DTYPE_BYTES:
+                continue
+            count = 1
+            for d in dims.split(','):
+                if d:
+                    count *= int(d)
+            nbytes += count * _DTYPE_BYTES[dt]
+        total += nbytes
+        per_kind[base] = per_kind.get(base, 0) + nbytes
+    return total, per_kind
+
+
+def _build(model, dp, batch_per_chip, image, devices):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon import model_zoo, nn
+
+    mesh = parallel.create_mesh({'dp': dp}, devices=devices[:dp])
+    if model == 'resnet50':
+        net = model_zoo.vision.resnet50_v1()
+        classes = 1000
+    elif model == 'mlp':
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(64, activation='relu'), nn.Dense(10))
+        classes = 10
+    else:
+        raise ValueError(model)
+    net.initialize(mx.init.Xavier())
+    on_accel = devices[0].platform != 'cpu'
+    if on_accel:
+        net.cast('bfloat16')
+    net.hybridize(static_alloc=True, static_shape=True)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    B = dp * batch_per_chip
+    shape = (B, 3, image, image) if model == 'resnet50' else (B, 32)
+    dtype = 'bfloat16' if on_accel else 'float32'
+    x = nd.array(np.random.uniform(-1, 1, shape), dtype=dtype)
+    y = nd.array(np.random.randint(0, classes, (B,)))
+    pt = parallel.ParallelTrainer(
+        net, L, 'sgd', {'learning_rate': 0.05, 'momentum': 0.9}, mesh)
+    pt.step(x, y)          # compile
+    return pt, x, y
+
+
+def _time_step(pt, x, y, iters, slope):
+    def window(n):
+        out = pt.step(x, y)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = pt.step(x, y)
+        out.wait_to_read()
+        return time.perf_counter() - t0
+
+    if slope:
+        # tunneled accelerators: difference out the fixed sync cost
+        t_lo = window(iters)
+        t_hi = window(3 * iters)
+        return (t_hi - t_lo) / (2 * iters)
+    return window(iters) / iters
+
+
+def step_hlo(pt, x, y):
+    """Optimized HLO of the compiled fused step (lower() only reads
+    shapes — nothing executes, nothing is donated)."""
+    import jax.numpy as jnp
+    indices = list(range(len(pt._params)))
+    hyper = pt._hyper(indices, pt._opt, advance=False)
+    key = np.zeros(2, np.uint32)
+    xs = tuple(jnp.asarray(a._data) for a in [x])
+    ys = tuple(jnp.asarray(a._data) for a in [y])
+    lowered = pt._jitted.lower(key, hyper, pt._param_arrays,
+                               pt._state_leaves, xs, ys)
+    return lowered.compile().as_text()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--model', default='resnet50',
+                   choices=['resnet50', 'mlp'])
+    p.add_argument('--dp', default=None,
+                   help='comma list of dp sizes (default: 1,2,4,.. up '
+                        'to the device count)')
+    p.add_argument('--batch-per-chip', type=int, default=None)
+    p.add_argument('--image', type=int, default=None)
+    p.add_argument('--iters', type=int, default=None)
+    p.add_argument('--out', default='SCALING.json')
+    args = p.parse_args(argv)
+
+    import os
+    import jax
+    if os.environ.get('JAX_PLATFORMS') == 'cpu':
+        # the axon PJRT plugin force-prepends the TPU platform and
+        # clobbers the env var; pin the config so the virtual-mesh
+        # check is hermetic (same workaround as tests/conftest.py)
+        jax.config.update('jax_platforms', 'cpu')
+    devices = jax.devices()
+    on_accel = devices[0].platform != 'cpu'
+    n = len(devices)
+    if args.dp:
+        dp_list = [int(s) for s in args.dp.split(',')]
+    else:
+        dp_list = [d for d in (1, 2, 4, 8, 16, 32) if d <= n]
+    batch = args.batch_per_chip or (128 if on_accel else 4)
+    image = args.image or (224 if on_accel else 32)
+    iters = args.iters or (30 if on_accel else 3)
+
+    rows = []
+    base_rate = None
+    for dp in dp_list:
+        if dp > n:
+            print(json.dumps({'dp': dp, 'skipped': 'only %d devices' % n}),
+                  flush=True)
+            continue
+        pt, x, y = _build(args.model, dp, batch, image, devices)
+        dt = _time_step(pt, x, y, iters, slope=on_accel)
+        rate = dp * batch / dt
+        if base_rate is None:
+            base_rate = rate / dp   # first measured row is the reference
+        comm, per_kind = collective_bytes(step_hlo(pt, x, y))
+        row = {
+            'dp': dp,
+            'global_batch': dp * batch,
+            'ms_per_step': round(dt * 1e3, 2),
+            'samples_per_sec': round(rate, 1),
+            'efficiency_pct': round(100 * rate / (dp * base_rate), 1)
+            if base_rate else None,
+            'comm_bytes_per_step': comm,
+            'comm_by_kind': per_kind,
+            'device_kind': devices[0].device_kind,
+            'platform': devices[0].platform,
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    artifact = {'model': args.model, 'batch_per_chip': batch,
+                'image': image, 'weak_scaling': True, 'rows': rows}
+    with open(args.out, 'w') as f:
+        json.dump(artifact, f, indent=1)
+    return artifact
+
+
+if __name__ == '__main__':
+    main()

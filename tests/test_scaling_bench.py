@@ -1,0 +1,39 @@
+"""Scaling-efficiency harness plumbing (BASELINE.json metric 3; the
+reference's multi-GPU scaling table example/image-classification/
+README.md:307-319). Numbers on the virtual CPU mesh are meaningless —
+the artifact structure, mesh plumbing, and collective-bytes accounting
+are what these pin."""
+import json
+
+import pytest
+
+import bench_scaling
+
+
+def test_scaling_rows_and_comm_accounting(tmp_path):
+    out = tmp_path / 's.json'
+    art = bench_scaling.main(['--model', 'mlp', '--dp', '1,2',
+                              '--batch-per-chip', '4',
+                              '--iters', '2', '--out', str(out)])
+    rows = art['rows']
+    assert [r['dp'] for r in rows] == [1, 2]
+    assert rows[0]['efficiency_pct'] == 100.0
+    assert rows[0]['comm_bytes_per_step'] == 0      # single chip
+    # dp=2 must all-reduce every gradient once: 2762 f32 params
+    assert rows[1]['comm_bytes_per_step'] >= 2762 * 4
+    assert 'all-reduce' in rows[1]['comm_by_kind']
+    assert rows[1]['efficiency_pct'] is not None
+    saved = json.loads(out.read_text())
+    assert saved['weak_scaling'] and saved['rows'] == rows
+
+
+@pytest.mark.slow
+def test_scaling_resnet_single_row(tmp_path):
+    out = tmp_path / 's.json'
+    art = bench_scaling.main(['--model', 'resnet50', '--dp', '2',
+                              '--batch-per-chip', '2', '--image', '32',
+                              '--iters', '1', '--out', str(out)])
+    row = art['rows'][0]
+    # ~25.6M params -> one f32 all-reduce >= 100 MB
+    assert row['comm_bytes_per_step'] > 100e6
+    assert row['samples_per_sec'] > 0

@@ -29,7 +29,17 @@ def exported_names(so_path):
 
 
 def main():
-    header = sys.argv[1] if len(sys.argv) > 1 else \
+    args = list(sys.argv[1:])
+    expect = None
+    if '--assert' in args:
+        i = args.index('--assert')
+        try:
+            expect = int(args[i + 1])
+        except (IndexError, ValueError):
+            print('usage: capi_coverage.py [header] --assert <count>')
+            return 2
+        del args[i:i + 2]
+    header = args[0] if args else \
         '/root/reference/include/mxnet/c_api.h'
     from mxnet_tpu.native import capi
     if capi.lib() is None:
@@ -45,6 +55,9 @@ def main():
         print('missing:')
         for n in missing:
             print('  ', n)
+    if expect is not None and len(have) < expect:
+        print('FAIL: expected >= %d implemented' % expect)
+        return 1
     return 0
 
 

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Single-command CI gate (reference analog: ci/build.py + the
+tests/jenkins pipelines — the reference treats CI as part of its
+surface; this is the TPU-native equivalent for a 1-core host).
+
+Stages, each timed:
+  1. fast test tier        pytest -m "not slow"       (~2 min)
+  2. C ABI audit           tools/capi_coverage.py == 207/207
+  3. copy-paste gate       tools/overlap_check.py --sweep 0.60
+  4. example smokes        3 representative workloads (LeNet both
+                           APIs, word-LM, plugin op)
+
+Exit code 0 = gate green. Run the FULL suite (~17 min:
+`python -m pytest tests/ -q`) before release-sized changes; this gate
+is the per-change bar.
+
+Usage: python tools/ci.py [--full]   (--full swaps stage 1 for the
+whole suite)
+"""
+import subprocess
+import sys
+import time
+
+REPO = '/root/repo'
+
+
+def stage(name, argv):
+    t0 = time.perf_counter()
+    print('== %s: %s' % (name, ' '.join(argv)), flush=True)
+    proc = subprocess.run(argv, cwd=REPO)
+    dt = time.perf_counter() - t0
+    ok = proc.returncode == 0
+    print('== %s: %s in %.1fs' % (name, 'OK' if ok else 'FAIL', dt),
+          flush=True)
+    return ok, dt
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    full = '--full' in argv
+    py = sys.executable
+    stages = [
+        ('tests', [py, '-m', 'pytest', 'tests/', '-q']
+         + ([] if full else ['-m', 'not slow'])),
+        ('capi', [py, 'tools/capi_coverage.py', '--assert', '207']),
+        ('overlap', [py, 'tools/overlap_check.py', '--sweep', '0.60']),
+        ('examples', [py, '-m', 'pytest', 'tests/test_examples.py', '-q',
+                      '-k', 'train_mnist or word_lm or plugin_op']),
+    ]
+    t0 = time.perf_counter()
+    results = []
+    for name, cmd in stages:
+        ok, dt = stage(name, cmd)
+        results.append((name, ok, dt))
+        if not ok:
+            break
+    total = time.perf_counter() - t0
+    print('-' * 56)
+    for name, ok, dt in results:
+        print('%-10s %-5s %7.1fs' % (name, 'OK' if ok else 'FAIL', dt))
+    print('%-10s %-5s %7.1fs' % ('total',
+                                 'OK' if all(r[1] for r in results)
+                                 and len(results) == len(stages)
+                                 else 'FAIL', total))
+    return 0 if all(r[1] for r in results) and \
+        len(results) == len(stages) else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -166,8 +166,9 @@ def main(argv=None):
     base_rate = None
     for dp in dp_list:
         if dp > n:
-            print(json.dumps({'dp': dp, 'skipped': 'only %d devices' % n}),
-                  flush=True)
+            row = {'dp': dp, 'skipped': 'only %d devices' % n}
+            rows.append(row)          # artifact stays self-describing
+            print(json.dumps(row), flush=True)
             continue
         pt, x, y = _build(args.model, dp, batch, image, devices)
         dt = _time_step(pt, x, y, iters, slope=on_accel)

@@ -278,3 +278,20 @@ def test_train_mnist_module_and_gluon():
     # gluon reports the running epoch average, so give it a second epoch
     acc_glu = train_mnist.train_gluon(epochs=2, batch_size=64, lr=0.05)
     assert acc_glu > 0.6, acc_glu
+
+
+def test_tree_lstm_dynamic_topology_learns():
+    # per-sample tree topology as DATA (one lax.scan over topo slots);
+    # the dynamic-structure capability axis (reference:
+    # example/gluon/tree_lstm)
+    from examples import tree_lstm
+    acc = tree_lstm.main(['--epochs', '20', '--num-trees', '128'])
+    assert acc > 0.85, acc
+
+
+def test_lstm_crf_viterbi_learns():
+    # CRF forward algorithm + Viterbi as batched scans (reference:
+    # example/gluon/lstm_crf)
+    from examples import lstm_crf
+    acc = lstm_crf.main(['--epochs', '20', '--num-samples', '128'])
+    assert acc > 0.85, acc

@@ -44,9 +44,13 @@ def main(argv=None):
          + ([] if full else ['-m', 'not slow'])),
         ('capi', [py, 'tools/capi_coverage.py', '--assert', '207']),
         ('overlap', [py, 'tools/overlap_check.py', '--sweep', '0.60']),
-        ('examples', [py, '-m', 'pytest', 'tests/test_examples.py', '-q',
-                      '-k', 'train_mnist or word_lm or plugin_op']),
     ]
+    if not full:
+        # --full already ran every example smoke inside stage 1
+        stages.append(
+            ('examples', [py, '-m', 'pytest', 'tests/test_examples.py',
+                          '-q', '-k',
+                          'train_mnist or word_lm or plugin_op']))
     t0 = time.perf_counter()
     results = []
     for name, cmd in stages:

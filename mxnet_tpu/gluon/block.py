@@ -42,42 +42,39 @@ class _BlockScope:
 
     def __init__(self, block):
         self._block = block
-        self._counter = {}
-        self._old_scope = None
-        self._name_scope = None
+        self._counter, self._old_scope, self._name_scope = {}, None, None
 
     @staticmethod
     def create(prefix, params, hint):
         """Create prefix and params for a new Block."""
         current = getattr(_BlockScope._current, 'value', None)
         if current is None:
+            # top level: prefix comes from the global NameManager
             if prefix is None:
                 from ..name import NameManager
                 prefix = NameManager.current.get(None, hint) + '_'
-            if params is None:
-                params = ParameterDict(prefix)
-            else:
-                params = ParameterDict(params.prefix, params)
-            return prefix, params
+            pd = ParameterDict(prefix) if params is None \
+                else ParameterDict(params.prefix, params)
+            return prefix, pd
+        # nested: number the child within the enclosing scope
         if prefix is None:
-            count = current._counter.get(hint, 0)
-            prefix = '%s%d_' % (hint, count)
-            current._counter[hint] = count + 1
+            n = current._counter.get(hint, 0)
+            current._counter[hint] = n + 1
+            prefix = '%s%d_' % (hint, n)
         if params is None:
-            parent = current._block.params
-            params = ParameterDict(parent.prefix + prefix, parent._shared)
+            owner = current._block.params
+            pd = ParameterDict(owner.prefix + prefix, owner._shared)
         else:
-            params = ParameterDict(params.prefix, params)
-        return current._block.prefix + prefix, params
+            pd = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, pd
 
     def __enter__(self):
-        if self._block._empty_prefix:
-            return self
-        self._old_scope = getattr(_BlockScope._current, 'value', None)
-        _BlockScope._current.value = self
-        from ..name import NameManager, Prefix
-        self._name_scope = Prefix(self._block.prefix)
-        self._name_scope.__enter__()
+        if not self._block._empty_prefix:
+            self._old_scope = getattr(_BlockScope._current, 'value', None)
+            _BlockScope._current.value = self
+            from ..name import Prefix
+            self._name_scope = Prefix(self._block.prefix)
+            self._name_scope.__enter__()
         return self
 
     def __exit__(self, ptype, value, trace):
@@ -89,36 +86,35 @@ class _BlockScope:
 
 
 def _flatten(args, inout_str):
-    """Flatten nested lists of NDArrays, remembering structure."""
+    """Flatten nested lists of NDArrays into (leaves, format-tree).
+
+    Format tree: 0 = a single NDArray, None = a None placeholder, an
+    int n = n leaves from a flat list, a list = nested structure."""
     if isinstance(args, NDArray):
-        return [args], int(0)
+        return [args], 0
     if args is None:
         return [None], None
-    assert isinstance(args, (list, tuple)), \
-        '%s must be (nested) list of NDArray, but got %s of type %s' % (
-            inout_str, str(args), str(type(args)))
-    flat = []
-    fmts = []
-    for i in args:
-        arg, fmt = _flatten(i, inout_str)
-        flat.extend(arg)
-        fmts.append(fmt)
-    return flat, fmts
+    if not isinstance(args, (list, tuple)):
+        raise AssertionError(
+            '%s must be (nested) list of NDArray, but got %s of type %s'
+            % (inout_str, str(args), str(type(args))))
+    pairs = [_flatten(a, inout_str) for a in args]
+    leaves = [leaf for sub, _ in pairs for leaf in sub]
+    return leaves, [fmt for _, fmt in pairs]
 
 
 def _regroup(args, fmt):
-    if isinstance(fmt, int):
-        if fmt == 0:
-            return args[0], args[1:]
-        return args[:fmt], args[fmt:]
+    """Inverse of _flatten: rebuild the structure, return the rest."""
     if fmt is None:
         return None, args[1:]
-    assert isinstance(fmt, list)
-    ret = []
-    for i in fmt:
-        res, args = _regroup(args, i)
-        ret.append(res)
-    return ret, args
+    if isinstance(fmt, int):
+        return (args[0], args[1:]) if fmt == 0 else (args[:fmt],
+                                                     args[fmt:])
+    rebuilt = []
+    for sub in fmt:
+        piece, args = _regroup(args, sub)
+        rebuilt.append(piece)
+    return rebuilt, args
 
 
 class Block:
@@ -192,42 +188,41 @@ class Block:
         children's Parameters, filtered by regex ``select``
         (reference: block.py:271)."""
         self._check_container_with_block()
-        ret = ParameterDict(self._params.prefix)
-        if not select:
-            ret.update(self.params)
-        else:
+        picked = ParameterDict(self._params.prefix)
+        if select:
             pattern = re.compile(select)
-            ret.update({name: value for name, value in self.params.items()
-                        if pattern.match(name)})
-        for cld in self._children.values():
-            ret.update(cld.collect_params(select=select))
-        return ret
+            picked.update({name: value
+                           for name, value in self.params.items()
+                           if pattern.match(name)})
+        else:
+            picked.update(self.params)
+        for child in self._children.values():
+            picked.update(child.collect_params(select=select))
+        return picked
 
     def _check_container_with_block(self):
-        children = set(self._children.values())
-        def _find_unregistered_block_in_container(data):
-            if isinstance(data, (list, tuple)):
-                for ele in data:
-                    if _find_unregistered_block_in_container(ele):
-                        return True
-                return False
-            if isinstance(data, dict):
-                for _, v in data.items():
-                    if _find_unregistered_block_in_container(v):
-                        return True
-                return False
+        registered = set(self._children.values())
+
+        def holds_stray_block(data):
+            """True when a plain container holds a Block that never went
+            through register_child."""
             if isinstance(data, Block):
-                return data not in children
-            return False
-        for k, v in self.__dict__.items():
-            if isinstance(v, (list, tuple, dict)) and not (
-                    k.startswith('__') or k == '_children'):
-                if _find_unregistered_block_in_container(v):
-                    warnings.warn('"{name}" is an unregistered container with '
-                                  'Blocks. Note that Blocks inside the list, '
-                                  'tuple or dict will not be registered '
-                                  'automatically.'.format(name=self.__class__.__name__ + '.' + k),
-                                  stacklevel=3)
+                return data not in registered
+            values = data.values() if isinstance(data, dict) else \
+                data if isinstance(data, (list, tuple)) else ()
+            return any(holds_stray_block(v) for v in values)
+
+        for attr, value in self.__dict__.items():
+            if attr.startswith('__') or attr == '_children' or \
+                    not isinstance(value, (list, tuple, dict)):
+                continue
+            if holds_stray_block(value):
+                warnings.warn(
+                    '"{name}" is an unregistered container with Blocks. '
+                    'Note that Blocks inside the list, tuple or dict '
+                    'will not be registered automatically.'.format(
+                        name='%s.%s' % (self.__class__.__name__, attr)),
+                    stacklevel=3)
 
     def save_parameters(self, filename, deduplicate=False):
         """Save parameters to file (Gluon format: plain param-struct names;
@@ -247,28 +242,30 @@ class Block:
         params = self._collect_params_with_prefix()
         if not loaded and not params:
             return
-        if not any('.' in i for i in loaded.keys()):
-            # legacy loading: use collect_params name space
+        if not any('.' in key for key in loaded):
+            # legacy file: names live in the collect_params name space
             del loaded
             self.collect_params().load(
                 filename, ctx, allow_missing, ignore_extra, self.prefix,
                 cast_dtype=cast_dtype, dtype_source=dtype_source)
             return
         if not allow_missing:
-            for name in params.keys():
-                assert name in loaded, \
-                    "Parameter '%s' is missing in file '%s'. Set " \
-                    'allow_missing=True to ignore missing parameters.' % (
-                        name, filename)
+            absent = [n for n in params if n not in loaded]
+            if absent:
+                raise AssertionError(
+                    "Parameter '%s' is missing in file '%s'. Set "
+                    'allow_missing=True to ignore missing parameters.'
+                    % (absent[0], filename))
         for name in loaded:
-            if not ignore_extra and name not in params:
-                raise ValueError(
-                    "Parameter '%s' loaded from file '%s' is not present in "
-                    'this block. Set ignore_extra=True to ignore.' % (name, filename))
             if name in params:
                 params[name]._load_init(loaded[name], ctx,
                                         cast_dtype=cast_dtype,
                                         dtype_source=dtype_source)
+            elif not ignore_extra:
+                raise ValueError(
+                    "Parameter '%s' loaded from file '%s' is not present "
+                    'in this block. Set ignore_extra=True to ignore.'
+                    % (name, filename))
 
     def save_params(self, filename):
         warnings.warn('save_params is deprecated. Please use save_parameters.')
@@ -280,12 +277,11 @@ class Block:
         self.load_parameters(filename, ctx, allow_missing, ignore_extra)
 
     def _collect_params_with_prefix(self, prefix=''):
-        if prefix:
-            prefix += '.'
-        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        dot = prefix + '.' if prefix else ''
+        flat = {dot + key: val for key, val in self._reg_params.items()}
         for name, child in self._children.items():
-            ret.update(child._collect_params_with_prefix(prefix + name))
-        return ret
+            flat.update(child._collect_params_with_prefix(dot + name))
+        return flat
 
     def register_child(self, block, name=None):
         """Register a child block for parameter collection."""

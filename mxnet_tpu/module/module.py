@@ -54,26 +54,21 @@ class Module(BaseModule):
         label_names = list(label_names) if label_names is not None else []
         _check_input_names(symbol, data_names, 'data', True)
         _check_input_names(symbol, label_names, 'label', False)
+        arg_names = symbol.list_arguments()
         self._data_names = data_names
-        self._label_names = [n for n in label_names
-                             if n in symbol.list_arguments()]
+        self._label_names = [n for n in label_names if n in arg_names]
         self._fixed_param_names = list(fixed_param_names or [])
         self._state_names = list(state_names or [])
-        arg_names = symbol.list_arguments()
-        input_names = self._data_names + self._label_names + self._state_names
-        self._param_names = [x for x in arg_names if x not in input_names]
+        inputs = set(self._data_names + self._label_names
+                     + self._state_names)
+        self._param_names = [a for a in arg_names if a not in inputs]
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
-        self._arg_params = None
-        self._aux_params = None
+        self._arg_params = self._aux_params = None
+        self._optimizer = self._kvstore = self._updater = None
+        self._exec = self._grad_req = None
+        self._data_shapes = self._label_shapes = None
         self._params_dirty = False
-        self._optimizer = None
-        self._kvstore = None
-        self._updater = None
-        self._exec = None
-        self._data_shapes = None
-        self._label_shapes = None
-        self._grad_req = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -81,8 +76,7 @@ class Module(BaseModule):
         from ..model import load_checkpoint
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
             mod._preload_opt_states = '%s-%04d.states' % (prefix, epoch)
@@ -92,13 +86,22 @@ class Module(BaseModule):
         """Save symbol + params (+optimizer states)
         (reference: module.py save_checkpoint)."""
         self._symbol.save('%s-symbol.json' % prefix)
-        param_name = '%s-%04d.params' % (prefix, epoch)
-        self.save_params(param_name)
-        self.logger.info('Saved checkpoint to "%s"', param_name)
+        param_file = '%s-%04d.params' % (prefix, epoch)
+        self.save_params(param_file)
+        self.logger.info('Saved checkpoint to "%s"', param_file)
         if save_optimizer_states:
-            state_name = '%s-%04d.states' % (prefix, epoch)
-            self.save_optimizer_states(state_name)
-            self.logger.info('Saved optimizer state to "%s"', state_name)
+            state_file = '%s-%04d.states' % (prefix, epoch)
+            self.save_optimizer_states(state_file)
+            self.logger.info('Saved optimizer state to "%s"', state_file)
+
+    def _require(self, bound=False, initialized=False, optimizer=False):
+        """State-machine guard shared by the public accessors."""
+        if bound and not self.binded:
+            raise AssertionError('call bind() first')
+        if initialized and not self.params_initialized:
+            raise AssertionError('call init_params() first')
+        if optimizer and not self.optimizer_initialized:
+            raise AssertionError('call init_optimizer() first')
 
     # -- properties --------------------------------------------------------
     @property
@@ -115,24 +118,24 @@ class Module(BaseModule):
 
     @property
     def data_shapes(self):
-        assert self.binded
+        self._require(bound=True)
         return self._data_shapes
 
     @property
     def label_shapes(self):
-        assert self.binded
+        self._require(bound=True)
         return self._label_shapes
 
     @property
     def output_shapes(self):
-        assert self.binded
+        self._require(bound=True)
         return [(n, tuple(o.shape)) for n, o in
                 zip(self._output_names, self._exec.outputs)] \
             if self._exec.outputs else None
 
     # -- params ------------------------------------------------------------
     def get_params(self):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, initialized=True)
         if self._params_dirty:
             self._sync_params_from_devices()
         return (self._arg_params, self._aux_params)
@@ -151,7 +154,7 @@ class Module(BaseModule):
             warnings.warn('Parameters already initialized and force_init='
                           'False. init_params call ignored.', stacklevel=2)
             return
-        assert self.binded, 'call bind before initializing the parameters'
+        self._require(bound=True)
         if initializer is None:
             initializer = Uniform(0.01)
 
@@ -307,7 +310,7 @@ class Module(BaseModule):
                        force_init=False):
         """Install an optimizer (reference: module.py init_optimizer;
         kvstore types all alias the in-process store on TPU)."""
-        assert self.binded and self.params_initialized
+        self._require(bound=True, initialized=True)
         if self.optimizer_initialized and not force_init:
             self.logger.warning('optimizer already initialized, ignoring...')
             return
@@ -338,7 +341,7 @@ class Module(BaseModule):
 
     # -- execution ---------------------------------------------------------
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, initialized=True)
         if is_train is None:
             is_train = self.for_training
         feed = {}
@@ -368,14 +371,13 @@ class Module(BaseModule):
         self._exec.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, initialized=True)
         self._exec.backward(out_grads=out_grads)
 
     def update(self):
         """Apply optimizer to gradients (reference: module.py update →
         _update_params; on TPU the kvstore reduce is a no-op single-copy)."""
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
+        self._require(bound=True, initialized=True, optimizer=True)
         self._params_dirty = True
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
@@ -385,11 +387,14 @@ class Module(BaseModule):
             self._updater(i, grad, weight)
 
     def get_outputs(self, merge_multi_context=True):
-        assert self.binded
+        self._require(bound=True)
         return self._exec.outputs
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.inputs_need_grad
+        self._require(bound=True)
+        if not self.inputs_need_grad:
+            raise AssertionError('bind with inputs_need_grad=True to '
+                                 'read input gradients')
         return [self._exec.grad_dict[n] for n in self._data_names]
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
@@ -400,11 +405,11 @@ class Module(BaseModule):
                 dict(zip(self._output_names, self._exec.outputs)))
 
     def get_states(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, initialized=True)
         return [self._exec.arg_dict[n] for n in self._state_names]
 
     def set_states(self, states=None, value=None):
-        assert self.binded and self.params_initialized
+        self._require(bound=True, initialized=True)
         if states is not None:
             for name, arr in zip(self._state_names, states):
                 src = arr if isinstance(arr, NDArray) else nd.array(arr)
@@ -414,22 +419,22 @@ class Module(BaseModule):
                 self._exec.arg_dict[name][:] = value
 
     def install_monitor(self, mon):
-        assert self.binded
+        self._require(bound=True)
         mon.install(self._exec)
 
     def save_optimizer_states(self, fname):
-        assert self.optimizer_initialized
+        self._require(optimizer=True)
         with open(fname, 'wb') as f:
             f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
-        assert self.optimizer_initialized
+        self._require(optimizer=True)
         with open(fname, 'rb') as f:
             self._updater.set_states(f.read())
 
     def reshape(self, data_shapes, label_shapes=None):
         """Reshape the module for new input shapes."""
-        assert self.binded
+        self._require(bound=True)
         self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
                              for x in data_shapes]
         if label_shapes:

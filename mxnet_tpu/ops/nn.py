@@ -524,15 +524,77 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     return out.astype(data.dtype), mean, var
 
 
-@register('LayerNorm', num_inputs=3)
-def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
-    ax = int(axis) % data.ndim
-    mean = jnp.mean(data, axis=ax, keepdims=True)
-    var = jnp.var(data, axis=ax, keepdims=True)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_core(data, gamma, beta, eps, ax):
+    """LayerNorm core with the same hand-scheduled vjp treatment as
+    `_bn_train_core`: one-pass f32 row statistics forward (Σx and Σx²
+    fuse), and a backward whose row reductions (mean(dx̂), mean(dx̂·x̂))
+    fuse into a single pass over (dy, x) with the elementwise dx
+    consumed in place. The derived vjp of the chained mean/var
+    formulation costs XLA extra passes per LayerNorm — BERT-base has 26
+    of them per step."""
+    out, _, _ = _ln_fwd_impl(data, gamma, beta, eps, ax)
+    return out
+
+
+def _ln_fwd_impl(data, gamma, beta, eps, ax):
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=ax, keepdims=True)
+    # centered two-pass variance: the normalized axis is minor, so the
+    # whole mean→center→var chain stays one fused row kernel (unlike
+    # BatchNorm's cross-row case) and there is no E[x²]−E[x]²
+    # cancellation for rows with large |mean|/std (transformer
+    # activations have well-known outlier features)
+    cen = xf - mean
+    var = jnp.mean(cen * cen, axis=ax, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
-    out = (data - mean) * jax.lax.rsqrt(var + eps)
-    return out * gamma.reshape(shape) + beta.reshape(shape)
+    out = cen * inv * gamma.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype), mean, inv
+
+
+def _ln_fwd(data, gamma, beta, eps, ax):
+    out, mean, inv = _ln_fwd_impl(data, gamma, beta, eps, ax)
+    # residual leaves must be arrays: empty tag carries beta's dtype
+    return out, (data, gamma, jnp.zeros((0,), beta.dtype), mean, inv)
+
+
+def _ln_bwd(eps, ax, res, dout):
+    data, gamma, beta_tag, mean, inv = res
+    beta_dtype = beta_tag.dtype
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    xf = data.astype(jnp.float32)
+    dyf = dout.astype(jnp.float32)
+    xhat = (xf - mean) * inv
+    dxhat = dyf * gamma.astype(jnp.float32).reshape(shape)
+    m1 = jnp.mean(dxhat, axis=ax, keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=ax, keepdims=True)
+    dx = inv * (dxhat - m1 - xhat * m2)
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    dgamma = jnp.sum(dyf * xhat, axis=red)
+    dbeta = jnp.sum(dyf, axis=red)
+    return (dx.astype(data.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(beta_dtype))
+
+
+_ln_core.defvjp(_ln_fwd, _ln_bwd)
+
+
+@register('LayerNorm', num_inputs=3, num_outputs=-1)
+def layer_norm(data, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    ax = int(axis) % data.ndim
+    out = _ln_core(data, gamma, beta, float(eps), ax)
+    if not output_mean_var:
+        return out
+    # reference FNumVisibleOutputs form: (out, mean, std), stats with
+    # the normalized axis reduced
+    _, mean, inv = _ln_fwd_impl(jax.lax.stop_gradient(data), gamma,
+                                beta, float(eps), ax)
+    return out, jnp.squeeze(mean, axis=ax), jnp.squeeze(1.0 / inv,
+                                                        axis=ax)
 
 
 @register('InstanceNorm', num_inputs=3)

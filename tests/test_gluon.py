@@ -489,3 +489,48 @@ def test_batchnorm_custom_vjp_numerics():
     _, m16, v16 = batch_norm(x.astype(jnp.bfloat16), gamma, beta, mm, mv,
                              eps=1e-3, fix_gamma=False, training=True)
     assert m16.dtype == jnp.bfloat16 and v16.dtype == jnp.bfloat16
+
+
+def test_layernorm_custom_vjp_numerics():
+    # hand-scheduled LN vjp (ops/nn.py _ln_core) vs autodiff of the
+    # textbook formulation
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import layer_norm
+
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(6, 7, 16).astype('float32') * 3 + 2)
+    g = jnp.asarray(rs.rand(16).astype('float32') + 0.5)
+    b = jnp.asarray(rs.randn(16).astype('float32'))
+
+    def ref(x, g, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    np.testing.assert_allclose(layer_norm(x, g, b, axis=-1, eps=1e-5),
+                               ref(x, g, b), rtol=3e-5, atol=3e-5)
+    w = jnp.sin(jnp.arange(x.size).reshape(x.shape) * 0.01)
+    g1 = jax.grad(lambda *a: jnp.sum(
+        layer_norm(a[0], a[1], a[2], axis=-1, eps=1e-5) * w),
+        argnums=(0, 1, 2))(x, g, b)
+    g2 = jax.grad(lambda *a: jnp.sum(ref(*a) * w),
+                  argnums=(0, 1, 2))(x, g, b)
+    for p, q in zip(g1, g2):
+        np.testing.assert_allclose(p, q, rtol=3e-4, atol=3e-5)
+    assert layer_norm(x.astype(jnp.bfloat16), g, b, axis=-1,
+                      eps=1e-5).dtype == jnp.bfloat16
+    # outlier rows (mean ~3e3, std ~0.1): the centered two-pass
+    # variance must not cancel
+    xo = jnp.asarray(rs.randn(4, 16).astype('float32') * 0.1 + 3000.0)
+    go, bo = jnp.ones(16), jnp.zeros(16)
+    out = np.asarray(layer_norm(xo, go, bo, axis=-1, eps=1e-5))
+    xn = np.asarray(xo).astype(np.float64)
+    refo = (xn - xn.mean(-1, keepdims=True)) / \
+        np.sqrt(xn.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, refo, rtol=5e-3, atol=5e-3)
+    # reference FNumVisibleOutputs form
+    o3, m3, s3 = layer_norm(xo, go, bo, axis=-1, eps=1e-5,
+                            output_mean_var=True)
+    assert m3.shape == (4,) and s3.shape == (4,)
+    np.testing.assert_allclose(np.asarray(m3), xn.mean(-1), rtol=1e-6)

@@ -1,0 +1,172 @@
+"""BERT-base roofline probe (round-5 VERDICT #2): cost_analysis
+bytes/flops on the fused pretrain step, slope-clean step timing, and
+per-segment micro timings (attention / FFN / MLM head / optimizer) so
+the measured MFU is explained by arithmetic, not asserted.
+
+Run on a QUIET host with the tunnel up:
+    python tools/probe_bert.py [--batch 96]
+"""
+import argparse
+import sys
+import time
+
+sys.path.insert(0, '.')
+import numpy as np  # noqa: E402
+
+
+def slope(fn, sync, n_lo, reps=2):
+    """Median slope between an n_lo and a 3*n_lo dispatch window."""
+    def window(n):
+        fn()
+        sync()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        sync()
+        return time.perf_counter() - t0
+    vals = []
+    for _ in range(reps):
+        vals.append((window(3 * n_lo) - window(n_lo)) / (2 * n_lo))
+    vals.sort()
+    return vals[len(vals) // 2]
+
+
+def jit_slope(fn, iters):
+    """Slope timing for `fn(carry_scalar) -> array` via chained
+    fori_loop windows (true data dependency, one sync per window)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    def chained(n):
+        @jax.jit
+        def run(c0):
+            def body(i, carry):
+                out = fn(carry)
+                return carry + out.ravel()[0].astype(carry.dtype) * 1e-30
+            return jax.lax.fori_loop(0, n, body, c0)
+        return run
+
+    lo, hi = chained(iters), chained(3 * iters)
+    c0 = jnp.zeros((), jnp.float32)
+
+    def run(f):
+        t0 = time.perf_counter()
+        out = f(c0)
+        onp.asarray(jax.device_get(out))
+        return time.perf_counter() - t0
+
+    run(lo), run(hi)
+    vals = sorted((run(hi) - run(lo)) / (2 * iters) for _ in range(3))
+    return vals[1]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--batch', type=int, default=96)
+    p.add_argument('--seqlen', type=int, default=128)
+    p.add_argument('--iters', type=int, default=60)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+
+    B, S, P, V = args.batch, args.seqlen, 20, 30522
+    net = bert_zoo.bert_12_768_12(vocab_size=V, max_length=512,
+                                  dropout=0.1)
+    net.initialize(mx.init.Xavier())
+    net.cast('bfloat16')
+    net.hybridize(static_alloc=True, static_shape=True)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    ids = nd.array(rs.randint(0, V, (B, S)))
+    tt = nd.array((rs.rand(B, S) > 0.5).astype('float32'))
+    vl = nd.array(np.full((B,), S, np.float32))
+    mp = nd.array(rs.randint(0, S, (B, P)))
+    mlm_y = nd.array(rs.randint(0, V, (B, P)))
+    nsp_y = nd.array(rs.randint(0, 2, (B,)))
+
+    def pretrain_loss(outs, labels):
+        _, _, mlm_s, nsp_s = outs
+        my, ny = labels
+        return L(mlm_s.reshape((-1, V)), my.reshape((-1,))).mean() + \
+            L(nsp_s, ny).mean()
+
+    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+    pt = parallel.ParallelTrainer(net, pretrain_loss, 'adamw',
+                                  {'learning_rate': 1e-4, 'wd': 0.01},
+                                  mesh)
+    pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])
+
+    # ---- full-step cost analysis + slope timing ----------------------
+    indices = list(range(len(pt._params)))
+    hyper = pt._hyper(indices, pt._opt, advance=False)
+    key = np.zeros(2, np.uint32)
+    xs = tuple(a._data for a in (ids, tt, vl, mp))
+    ys = tuple(a._data for a in (mlm_y, nsp_y))
+    compiled = pt._jitted.lower(key, hyper, pt._param_arrays,
+                                pt._state_leaves, xs, ys).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    bytes_acc = ca.get('bytes accessed', 0.0)
+    flops = ca.get('flops', 0.0)
+    print('cost_analysis: %.2f GB accessed, %.3f TFLOP per step'
+          % (bytes_acc / 1e9, flops / 1e12), flush=True)
+
+    dt = slope(lambda: pt.step([ids, tt, vl, mp], [mlm_y, nsp_y]),
+               nd.waitall, max(10, args.iters // 3))
+    tput = B / dt
+    # 6 * params * tokens: the bench's FLOP convention
+    from bench import BERT_BASE_PARAMS, _peak_flops
+    model_tf = 6 * BERT_BASE_PARAMS * S * B / 1e12
+    peak, kind = _peak_flops()
+    mfu = 100 * model_tf / dt * 1e12 / peak if peak else 0
+    print('full step: %.2f ms  %.1f samples/s  MFU %.1f%% (%s)'
+          % (dt * 1e3, tput, mfu, kind), flush=True)
+    print('roofline: bytes/step / 950 GB/s = %.2f ms; model TF/step '
+          '/ %.0f TF/s = %.2f ms'
+          % (bytes_acc / 950e9 * 1e3, peak / 1e12,
+             model_tf / (peak / 1e12) * 1e3), flush=True)
+
+    # ---- per-segment micro probes (bf16, representative shapes) ------
+    H, FF, NH = 768, 3072, 12
+    kq = jax.random.PRNGKey(0)
+    xe = jax.random.normal(kq, (B * S, H), jnp.bfloat16)
+    wqkv = jax.random.normal(kq, (H, 3 * H), jnp.bfloat16)
+    wo = jax.random.normal(kq, (H, H), jnp.bfloat16)
+    w1 = jax.random.normal(kq, (H, FF), jnp.bfloat16)
+    w2 = jax.random.normal(kq, (FF, H), jnp.bfloat16)
+    wv = jax.random.normal(kq, (H, V), jnp.bfloat16)
+
+    def attn(xw, wqkv, wo, carry):
+        x = xw + carry.reshape(1, 1) * 0
+        qkv = (x @ wqkv).reshape(B, S, 3, NH, H // NH)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        s = jnp.einsum('bhqd,bhkd->bhqk', q, k) / np.sqrt(H // NH)
+        a = jax.nn.softmax(s.astype(jnp.float32), axis=-1
+                           ).astype(jnp.bfloat16)
+        ctx = jnp.einsum('bhqk,bhkd->bhqd', a, v)
+        out = ctx.transpose(0, 2, 1, 3).reshape(B * S, H) @ wo
+        return out
+
+    def ffn(x, w1, w2, carry):
+        return jax.nn.gelu((x + carry.reshape(1, 1) * 0) @ w1) @ w2
+
+    def mlm(x, wv, carry):
+        return (x[:B * P] + carry.reshape(1, 1) * 0) @ wv
+
+    for name, fn, a in [
+            ('attention x1', attn, (xe, wqkv, wo)),
+            ('ffn x1', ffn, (xe, w1, w2)),
+            ('mlm head', mlm, (xe, wv))]:
+        dt_seg = jit_slope(
+            lambda carry, fn=fn, a=a: fn(*a, carry), args.iters)
+        print('%-14s %7.3f ms  (x12 = %.2f ms where applicable)'
+              % (name, dt_seg * 1e3, dt_seg * 12 * 1e3), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,94 @@
+"""int8 ResNet-50 inference @ bs128 — the reference's serving-speedup
+methodology (round-5 VERDICT #6). The reference's analogous table is
+fp16 ResNet-50 bs128: 1233.15 -> 2355.04 img/s, 1.91x (BASELINE.md /
+docs/faq/perf.md:181-193); this measures the int8 path on the same
+model/batch so the comparison is apples-to-apples.
+
+Pipeline: Gluon resnet50_v1 -> export to (symbol, params) -> entropy
+calibration over random batches -> symbol-executor inference, slope
+timing. Run on a QUIET host with the tunnel up:
+    python tools/probe_int8_resnet50.py [--batch 128]
+"""
+import argparse
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, '.')
+import numpy as np  # noqa: E402
+
+
+def slope_bench(forward, sync, iters):
+    def window(n):
+        forward()
+        sync()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            forward()
+        sync()
+        return time.perf_counter() - t0
+    vals = sorted((window(3 * iters) - window(iters)) / (2 * iters)
+                  for _ in range(2))
+    return vals[0]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--batch', type=int, default=128)
+    p.add_argument('--image', type=int, default=224)
+    p.add_argument('--iters', type=int, default=20)
+    p.add_argument('--dtype', default='float32',
+                   help='baseline dtype (float32 matches the reference '
+                        'table; bfloat16 for the TPU-native baseline)')
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import model_zoo
+
+    B, I = args.batch, args.image
+    net = model_zoo.vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    x_np = np.random.uniform(-1, 1, (B, 3, I, I)).astype('float32')
+    net(nd.array(x_np[:2]))          # materialize params + trace
+    with tempfile.TemporaryDirectory() as tmp:
+        net.export(tmp + '/r50')
+        sym, arg_params, aux_params = mx.model.load_checkpoint(
+            tmp + '/r50', 0)
+
+    ctx = mx.context.current_context()
+    label = nd.zeros((B,))
+
+    def bind_and_bench(s, a_params, x_params, tag):
+        binds = dict(a_params, data=nd.array(x_np),
+                     softmax_label=label)
+        try:
+            ex = s.bind(ctx, args=binds, aux_states=dict(x_params))
+        except Exception:
+            # exported eval graphs may have no label input
+            binds.pop('softmax_label', None)
+            ex = s.bind(ctx, args=binds, aux_states=dict(x_params))
+        dt = slope_bench(lambda: ex.forward()[0],
+                         lambda: ex.outputs[0].wait_to_read(),
+                         args.iters)
+        print('%s: %.2f ms / batch  %.1f img/s'
+              % (tag, dt * 1e3, B / dt), flush=True)
+        return B / dt
+
+    fp_ips = bind_and_bench(sym, arg_params, aux_params,
+                            'fp32 baseline')
+
+    calib = [nd.array(x_np[i:i + 32]) for i in range(0, B, 32)]
+    qsym, qargs, qaux = mx.contrib.quantization.quantize_model(
+        sym, arg_params, aux_params, calib_data=calib,
+        calib_mode='entropy')
+    int8_ips = bind_and_bench(qsym, qargs, qaux, 'int8 (entropy)')
+
+    print('speedup: %.2fx  (reference fp16 analog: 1233.15 -> 2355.04 '
+          '= 1.91x at the same model/batch)' % (int8_ips / fp_ips),
+          flush=True)
+
+
+if __name__ == '__main__':
+    main()

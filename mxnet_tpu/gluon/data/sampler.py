@@ -15,38 +15,38 @@ __all__ = ['Sampler', 'SequentialSampler', 'RandomSampler', 'FilterSampler',
 class Sampler:
     """Iterable over sample indices."""
 
-    def __iter__(self):
-        raise NotImplementedError
+    def __iter__(self):  # pragma: no cover - interface
+        raise NotImplementedError('subclasses yield indices')
 
-    def __len__(self):
-        raise NotImplementedError
+    def __len__(self):  # pragma: no cover - interface
+        raise NotImplementedError('subclasses know their length')
 
 
 class SequentialSampler(Sampler):
     """Indices start, start+1, ..., start+length-1 in order."""
 
     def __init__(self, length, start=0):
-        self._length = length
-        self._start = start
+        self._n = int(length)
+        self._first = int(start)
 
     def __iter__(self):
-        return iter(range(self._start, self._start + self._length))
+        return iter(range(self._first, self._first + self._n))
 
     def __len__(self):
-        return self._length
+        return self._n
 
 
 class RandomSampler(Sampler):
     """A fresh uniform permutation of [0, length) per epoch."""
 
     def __init__(self, length):
-        self._length = length
+        self._n = int(length)
 
     def __iter__(self):
-        yield from np.random.permutation(self._length)
+        yield from np.random.permutation(self._n)
 
     def __len__(self):
-        return self._length
+        return self._n
 
 
 class FilterSampler(Sampler):
@@ -82,14 +82,17 @@ class BatchSampler(Sampler):
         if last_batch not in _LAST_BATCH_MODES:
             raise ValueError('last_batch must be one of %s, got %s'
                              % (_LAST_BATCH_MODES, last_batch))
-        self._sampler = sampler
-        self._batch_size = batch_size
-        self._last_batch = last_batch
+        if int(batch_size) < 1:
+            raise ValueError('batch_size must be a positive integer, '
+                             'got %r' % (batch_size,))
+        self._source = sampler
+        self._bs = int(batch_size)
+        self._mode = last_batch
         self._carry = []
 
     def __iter__(self):
-        bs = self._batch_size
-        stream = itertools.chain(self._carry, self._sampler)
+        bs = self._bs
+        stream = itertools.chain(self._carry, self._source)
         self._carry = []
         while True:
             batch = list(itertools.islice(stream, bs))
@@ -97,16 +100,16 @@ class BatchSampler(Sampler):
                 yield batch
                 continue
             if batch:
-                if self._last_batch == 'keep':
+                if self._mode == 'keep':
                     yield batch
-                elif self._last_batch == 'rollover':
+                elif self._mode == 'rollover':
                     self._carry = batch
             return
 
     def __len__(self):
-        n = len(self._sampler)
-        if self._last_batch == 'keep':
-            return math.ceil(n / self._batch_size)
-        if self._last_batch == 'discard':
-            return n // self._batch_size
-        return (n + len(self._carry)) // self._batch_size
+        n = len(self._source)
+        if self._mode == 'keep':
+            return math.ceil(n / self._bs)
+        if self._mode == 'discard':
+            return n // self._bs
+        return (n + len(self._carry)) // self._bs

@@ -24,33 +24,35 @@ class Compose(nn.Sequential):
 
     def __init__(self, transforms):
         super().__init__()
-        transforms.append(None)
-        hybrid = []
-        for i in transforms:
-            if isinstance(i, HybridBlock):
-                hybrid.append(i)
-                continue
-            elif len(hybrid) == 1:
-                self.add(hybrid[0])
-                hybrid = []
-            elif len(hybrid) > 1:
-                hblock = nn.HybridSequential()
-                for j in hybrid:
-                    hblock.add(j)
-                hblock.hybridize()
-                self.add(hblock)
-                hybrid = []
-            if i is not None:
-                self.add(i)
+        run = []   # consecutive HybridBlocks fuse into one jit trace
+
+        def flush():
+            if len(run) == 1:
+                self.add(run[0])
+            elif run:
+                fused = nn.HybridSequential()
+                for t in run:
+                    fused.add(t)
+                fused.hybridize()
+                self.add(fused)
+            del run[:]
+
+        for t in transforms:
+            if isinstance(t, HybridBlock):
+                run.append(t)
+            else:
+                flush()
+                self.add(t)
+        flush()
 
 
 class Cast(HybridBlock):
     def __init__(self, dtype='float32'):
         super().__init__()
-        self._dtype = dtype
+        self._to = dtype
 
     def hybrid_forward(self, F, x):
-        return F.Cast(x, dtype=self._dtype)
+        return F.Cast(x, dtype=self._to)
 
 
 class ToTensor(HybridBlock):
@@ -65,8 +67,7 @@ class Normalize(HybridBlock):
 
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
-        self._mean = mean
-        self._std = std
+        self._mean, self._std = mean, std
 
     def hybrid_forward(self, F, x):
         return F._image_normalize(x, mean=self._mean, std=self._std)
@@ -77,22 +78,20 @@ class Resize(HybridBlock):
 
     def __init__(self, size, keep_ratio=False, interpolation=1):
         super().__init__()
-        self._keep = keep_ratio
-        self._size = size
-        self._interpolation = interpolation
+        self._keep, self._wanted = keep_ratio, size
+        self._interp = interpolation
 
     def forward(self, x):
-        if isinstance(self._size, numeric_types) and self._keep:
+        wanted = self._wanted
+        if isinstance(wanted, numeric_types) and self._keep:
             h, w = x.shape[-3:-1]
-            short, long_ = (w, h) if w <= h else (h, w)
-            scale = self._size / short
+            scale = wanted / min(w, h)
             size = (int(round(w * scale)), int(round(h * scale)))
-        elif isinstance(self._size, numeric_types):
-            size = (self._size, self._size)
         else:
-            size = tuple(self._size)
+            size = (wanted, wanted) if isinstance(wanted, numeric_types) \
+                else tuple(wanted)
         return nd.invoke('_image_resize', [x],
-                         {'size': size, 'interp': self._interpolation})
+                         {'size': size, 'interp': self._interp})
 
     def hybrid_forward(self, F, x):
         return self.forward(x)
@@ -101,20 +100,17 @@ class Resize(HybridBlock):
 class CropResize(HybridBlock):
     def __init__(self, x, y, width, height, size=None, interpolation=None):
         super().__init__()
-        self._x = x
-        self._y = y
-        self._width = width
-        self._height = height
-        self._size = size
-        self._interpolation = interpolation if interpolation is not None else 1
+        self._box = (x, y, width, height)
+        self._wanted = size
+        self._interp = 1 if interpolation is None else interpolation
 
     def hybrid_forward(self, F, x):
-        out = F._image_crop(x, x=self._x, y=self._y, width=self._width,
-                            height=self._height)
-        if self._size:
-            sz = (self._size, self._size) if isinstance(
-                self._size, numeric_types) else tuple(self._size)
-            out = F._image_resize(out, size=sz, interp=self._interpolation)
+        x0, y0, w, h = self._box
+        out = F._image_crop(x, x=x0, y=y0, width=w, height=h)
+        if self._wanted:
+            sz = (self._wanted, self._wanted) if isinstance(
+                self._wanted, numeric_types) else tuple(self._wanted)
+            out = F._image_resize(out, size=sz, interp=self._interp)
         return out
 
 

@@ -14,29 +14,33 @@ from .vgg import get_vgg
 from .mobilenet import get_mobilenet, get_mobilenet_v2
 
 
+# public zoo names (reference keys); the factory symbol derives from
+# the key, so the list is the single source of truth
+_ZOO_NAMES = (
+    'resnet18_v1 resnet34_v1 resnet50_v1 resnet101_v1 resnet152_v1 '
+    'resnet18_v2 resnet34_v2 resnet50_v2 resnet101_v2 resnet152_v2 '
+    'vgg11 vgg13 vgg16 vgg19 vgg11_bn vgg13_bn vgg16_bn vgg19_bn '
+    'alexnet densenet121 densenet161 densenet169 densenet201 '
+    'squeezenet1.0 squeezenet1.1 inceptionv3 '
+    'mobilenet1.0 mobilenet0.75 mobilenet0.5 mobilenet0.25 '
+    'mobilenetv2_1.0 mobilenetv2_0.75 mobilenetv2_0.5 mobilenetv2_0.25'
+).split()
+
+
+def _factory_for(key):
+    sym = key.replace('.', '_')
+    for stem, fixed in (('mobilenetv2', 'mobilenet_v2'),
+                        ('inceptionv3', 'inception_v3')):
+        if sym.startswith(stem):
+            sym = fixed + sym[len(stem):]
+    return globals()[sym]
+
+
 def get_model(name, **kwargs):
     """Returns a pre-defined model by name (reference: vision/__init__.py)."""
-    models = {'resnet18_v1': resnet18_v1, 'resnet34_v1': resnet34_v1,
-              'resnet50_v1': resnet50_v1, 'resnet101_v1': resnet101_v1,
-              'resnet152_v1': resnet152_v1, 'resnet18_v2': resnet18_v2,
-              'resnet34_v2': resnet34_v2, 'resnet50_v2': resnet50_v2,
-              'resnet101_v2': resnet101_v2, 'resnet152_v2': resnet152_v2,
-              'vgg11': vgg11, 'vgg13': vgg13, 'vgg16': vgg16, 'vgg19': vgg19,
-              'vgg11_bn': vgg11_bn, 'vgg13_bn': vgg13_bn,
-              'vgg16_bn': vgg16_bn, 'vgg19_bn': vgg19_bn,
-              'alexnet': alexnet, 'densenet121': densenet121,
-              'densenet161': densenet161, 'densenet169': densenet169,
-              'densenet201': densenet201, 'squeezenet1.0': squeezenet1_0,
-              'squeezenet1.1': squeezenet1_1, 'inceptionv3': inception_v3,
-              'mobilenet1.0': mobilenet1_0, 'mobilenet0.75': mobilenet0_75,
-              'mobilenet0.5': mobilenet0_5, 'mobilenet0.25': mobilenet0_25,
-              'mobilenetv2_1.0': mobilenet_v2_1_0,
-              'mobilenetv2_0.75': mobilenet_v2_0_75,
-              'mobilenetv2_0.5': mobilenet_v2_0_5,
-              'mobilenetv2_0.25': mobilenet_v2_0_25}
-    name = name.lower()
-    if name not in models:
+    key = name.lower()
+    if key not in _ZOO_NAMES:
         raise ValueError(
             'Model %s is not supported. Available options are\n\t%s' % (
-                name, '\n\t'.join(sorted(models.keys()))))
-    return models[name](**kwargs)
+                name, '\n\t'.join(sorted(_ZOO_NAMES))))
+    return _factory_for(key)(**kwargs)

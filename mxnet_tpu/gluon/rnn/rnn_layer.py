@@ -25,88 +25,76 @@ class _RNNLayer(HybridBlock):
         # _alias() is consulted during Block.__init__ for the name prefix
         object.__setattr__(self, '_mode', mode)
         super().__init__(**kwargs)
-        assert layout in ('TNC', 'NTC'), \
-            'Invalid layout %s; must be one of ["TNC" or "NTC"]' % layout
-        self._hidden_size = hidden_size
+        if layout not in ('TNC', 'NTC'):
+            raise AssertionError(
+                'Invalid layout %s; must be one of ["TNC" or "NTC"]'
+                % layout)
+        self._hidden_size, self._num_layers = hidden_size, num_layers
         self._projection_size = projection_size
-        self._num_layers = num_layers
-        self._mode = mode
-        self._layout = layout
-        self._dropout = dropout
+        self._mode, self._layout, self._dropout = mode, layout, dropout
         self._dir = 2 if bidirectional else 1
         self._input_size = input_size
-        self._i2h_weight_initializer = i2h_weight_initializer
-        self._h2h_weight_initializer = h2h_weight_initializer
-        self._i2h_bias_initializer = i2h_bias_initializer
-        self._h2h_bias_initializer = h2h_bias_initializer
+        inits = {'i2h_weight': i2h_weight_initializer,
+                 'h2h_weight': h2h_weight_initializer,
+                 'i2h_bias': i2h_bias_initializer,
+                 'h2h_bias': h2h_bias_initializer}
         self._gates = {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4,
                        'gru': 3}[mode]
-        ng, ni, nh = self._gates, input_size, hidden_size
-        # per-piece parameters in the fused cuDNN layout order (weights for
-        # all layers/directions, then biases) so the flat vector matches
-        # ops/nn.py _rnn_unpack_params
-        for j in ['l', 'r'][:self._dir]:
-            for i in range(num_layers):
-                lni = ni if i == 0 else nh * self._dir
-                setattr(self, '%s%d_i2h_weight' % (j, i), self.params.get(
-                    '%s%d_i2h_weight' % (j, i), shape=(ng * nh, lni),
-                    init=i2h_weight_initializer, allow_deferred_init=True))
-                setattr(self, '%s%d_h2h_weight' % (j, i), self.params.get(
-                    '%s%d_h2h_weight' % (j, i), shape=(ng * nh, nh),
-                    init=h2h_weight_initializer, allow_deferred_init=True))
-                setattr(self, '%s%d_i2h_bias' % (j, i), self.params.get(
-                    '%s%d_i2h_bias' % (j, i), shape=(ng * nh,),
-                    init=i2h_bias_initializer, allow_deferred_init=True))
-                setattr(self, '%s%d_h2h_bias' % (j, i), self.params.get(
-                    '%s%d_h2h_bias' % (j, i), shape=(ng * nh,),
-                    init=h2h_bias_initializer, allow_deferred_init=True))
+        ng, nh = self._gates, hidden_size
+        # per-piece parameters in the fused cuDNN layout order (weights
+        # for all layers/directions, then biases) so the flat vector
+        # matches ops/nn.py _rnn_unpack_params
+        for d in self._directions():
+            for layer in range(num_layers):
+                fan_in = input_size if layer == 0 else nh * self._dir
+                shapes = {'i2h_weight': (ng * nh, fan_in),
+                          'h2h_weight': (ng * nh, nh),
+                          'i2h_bias': (ng * nh,),
+                          'h2h_bias': (ng * nh,)}
+                for piece, shape in shapes.items():
+                    pname = '%s%d_%s' % (d, layer, piece)
+                    setattr(self, pname, self.params.get(
+                        pname, shape=shape, init=inits[piece],
+                        allow_deferred_init=True))
+
+    def _directions(self):
+        return ('l', 'r')[:self._dir]
 
     def __repr__(self):
-        s = '{name}({mapping}, {_layout}'
+        shape = self.l0_i2h_weight.shape
+        parts = ['%s -> %s' % (shape[1] if shape[1] else None,
+                               shape[0] // self._gates), self._layout]
         if self._num_layers != 1:
-            s += ', num_layers={_num_layers}'
+            parts.append('num_layers=%d' % self._num_layers)
         if self._dropout != 0:
-            s += ', dropout={_dropout}'
+            parts.append('dropout=%g' % self._dropout)
         if self._dir == 2:
-            s += ', bidirectional'
-        s += ')'
-        shape = getattr(self, 'l0_i2h_weight').shape
-        mapping = '{0} -> {1}'.format(
-            shape[1] if shape[1] else None, shape[0] // self._gates)
-        return s.format(name=self.__class__.__name__, mapping=mapping,
-                        **self.__dict__)
+            parts.append('bidirectional')
+        return '%s(%s)' % (self.__class__.__name__, ', '.join(parts))
 
     def _collect_params_with_prefix(self, prefix=''):
-        if prefix:
-            prefix += '.'
-        pattern = lambda d, l, g: '_unfused.%d.%s_cell.%s' % (
-            d + l * self._dir, ['l', 'r'][d], g)
-        ret = {prefix + n: p for n, p in self._reg_params.items()}
-        return ret
+        dot = prefix + '.' if prefix else ''
+        return {dot + n: p for n, p in self._reg_params.items()}
 
-    def state_info(self, batch_size=0):
-        raise NotImplementedError
+    def state_info(self, batch_size=0):  # pragma: no cover - interface
+        raise NotImplementedError('subclasses declare their states')
 
     def _alias(self):
         return self._mode
 
     def infer_shape(self, x, *args):
-        ni = x.shape[-1]
-        for j in ['l', 'r'][:self._dir]:
-            getattr(self, '%s0_i2h_weight' % j).shape = \
-                (self._gates * self._hidden_size, ni)
+        fan_in = x.shape[-1]
+        for d in self._directions():
+            getattr(self, '%s0_i2h_weight' % d).shape = \
+                (self._gates * self._hidden_size, fan_in)
 
     def begin_state(self, batch_size=0, func=None, **kwargs):
         """Initial recurrent state (reference: rnn_layer.py begin_state)."""
-        if func is None:
-            func = nd.zeros
+        func = func or nd.zeros
         states = []
-        for i, info in enumerate(self.state_info(batch_size)):
-            if info is not None:
-                info.update(kwargs)
-            else:
-                info = kwargs
-            states.append(func(**{k: v for k, v in info.items()
+        for info in self.state_info(batch_size):
+            spec = dict(info or {}, **kwargs)
+            states.append(func(**{k: v for k, v in spec.items()
                                   if k not in ('name', '__layout__')}))
         return states
 
@@ -118,23 +106,21 @@ class _RNNLayer(HybridBlock):
         if isinstance(states, NDArray):
             states = [states]
         for state, info in zip(states, self.state_info(batch_size)):
-            if state.shape != info['shape']:
-                raise ValueError(
-                    'Invalid recurrent state shape. Expecting %s, got %s.' % (
-                        str(info['shape']), str(state.shape)))
+            if state.shape == info['shape']:
+                continue
+            raise ValueError(
+                'Invalid recurrent state shape. Expecting %s, got %s.'
+                % (str(info['shape']), str(state.shape)))
         out = self._forward_kernel(F, inputs, states, **kwargs)
         return out[0] if skip_states else out
 
     def _flat_params(self, kwargs):
-        order = []
-        for i in range(self._num_layers):
-            for j in ['l', 'r'][:self._dir]:
-                order.append(kwargs['%s%d_i2h_weight' % (j, i)])
-                order.append(kwargs['%s%d_h2h_weight' % (j, i)])
-        for i in range(self._num_layers):
-            for j in ['l', 'r'][:self._dir]:
-                order.append(kwargs['%s%d_i2h_bias' % (j, i)])
-                order.append(kwargs['%s%d_h2h_bias' % (j, i)])
+        order = [kwargs['%s%d_%s' % (d, layer, piece)]
+                 for group in (('i2h_weight', 'h2h_weight'),
+                               ('i2h_bias', 'h2h_bias'))
+                 for layer in range(self._num_layers)
+                 for d in self._directions()
+                 for piece in group]
         return nd.Concat(*[w.reshape((-1,)) for w in order], dim=0,
                          num_args=len(order))
 
@@ -166,9 +152,11 @@ class RNN(_RNNLayer):
                  input_size=0, **kwargs):
         super().__init__(hidden_size, num_layers, layout, dropout,
                          bidirectional, input_size,
-                         i2h_weight_initializer, h2h_weight_initializer,
-                         i2h_bias_initializer, h2h_bias_initializer,
-                         'rnn_' + activation, **kwargs)
+                         i2h_weight_initializer=i2h_weight_initializer,
+                         h2h_weight_initializer=h2h_weight_initializer,
+                         i2h_bias_initializer=i2h_bias_initializer,
+                         h2h_bias_initializer=h2h_bias_initializer,
+                         mode='rnn_' + activation, **kwargs)
 
     def state_info(self, batch_size=0):
         return [{'shape': (self._num_layers * self._dir, batch_size,
@@ -185,9 +173,12 @@ class LSTM(_RNNLayer):
                  projection_size=None, **kwargs):
         super().__init__(hidden_size, num_layers, layout, dropout,
                          bidirectional, input_size,
-                         i2h_weight_initializer, h2h_weight_initializer,
-                         i2h_bias_initializer, h2h_bias_initializer,
-                         'lstm', projection_size, **kwargs)
+                         i2h_weight_initializer=i2h_weight_initializer,
+                         h2h_weight_initializer=h2h_weight_initializer,
+                         i2h_bias_initializer=i2h_bias_initializer,
+                         h2h_bias_initializer=h2h_bias_initializer,
+                         mode='lstm', projection_size=projection_size,
+                         **kwargs)
 
     def state_info(self, batch_size=0):
         return [{'shape': (self._num_layers * self._dir, batch_size,
@@ -206,9 +197,11 @@ class GRU(_RNNLayer):
                  **kwargs):
         super().__init__(hidden_size, num_layers, layout, dropout,
                          bidirectional, input_size,
-                         i2h_weight_initializer, h2h_weight_initializer,
-                         i2h_bias_initializer, h2h_bias_initializer,
-                         'gru', **kwargs)
+                         i2h_weight_initializer=i2h_weight_initializer,
+                         h2h_weight_initializer=h2h_weight_initializer,
+                         i2h_bias_initializer=i2h_bias_initializer,
+                         h2h_bias_initializer=h2h_bias_initializer,
+                         mode='gru', **kwargs)
 
     def state_info(self, batch_size=0):
         return [{'shape': (self._num_layers * self._dir, batch_size,

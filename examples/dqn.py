@@ -1,0 +1,129 @@
+"""Deep Q-Network with replay buffer and target network (reference:
+example/reinforcement-learning/dqn — DQN over the Atari stack; here the
+same algorithmic parts on the in-repo Balance environment so the smoke
+is synthetic and egress-free).
+
+The three DQN ingredients the reference exercises:
+  * experience replay (uniform buffer, minibatch TD(0) targets),
+  * a frozen target network synced every K steps,
+  * epsilon-greedy behavior policy with linear decay.
+The TD step is one hybridized forward per network + a Huber loss under
+a single autograd.record scope — batch Q-learning maps to the MXU as a
+pair of dense matmuls, no per-sample Python.
+"""
+from __future__ import annotations
+
+import argparse
+
+if not __package__:
+    import _bootstrap  # noqa: F401
+
+import numpy as np
+
+
+class Replay:
+    def __init__(self, cap, rs):
+        self.cap, self.rs = cap, rs
+        self.data = []
+        self.pos = 0
+
+    def push(self, item):
+        if len(self.data) < self.cap:
+            self.data.append(item)
+        else:
+            self.data[self.pos] = item
+        self.pos = (self.pos + 1) % self.cap
+
+    def sample(self, n):
+        idx = self.rs.randint(0, len(self.data), n)
+        s, a, r, s2, done = zip(*(self.data[i] for i in idx))
+        return (np.stack(s), np.asarray(a, np.int64),
+                np.asarray(r, np.float32), np.stack(s2),
+                np.asarray(done, np.float32))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--episodes', type=int, default=250)
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--gamma', type=float, default=0.99)
+    p.add_argument('--lr', type=float, default=1e-3)
+    p.add_argument('--sync-every', type=int, default=100)
+    p.add_argument('--train-every', type=int, default=1)
+    p.add_argument('--buffer', type=int, default=5000)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+    from examples.actor_critic import Balance
+
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+    env = Balance(seed=0)
+
+    def make_q():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(64, activation='relu'),
+                    nn.Dense(64, activation='relu'), nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        return net
+
+    q, target = make_q(), make_q()
+
+    def sync():
+        for (_, src), (_, dst) in zip(q.collect_params().items(),
+                                      target.collect_params().items()):
+            dst.set_data(src.data())
+
+    q(nd.array(np.zeros((1, 4), np.float32)))
+    target(nd.array(np.zeros((1, 4), np.float32)))
+    sync()
+
+    trainer = gluon.Trainer(q.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    loss_fn = gluon.loss.HuberLoss()
+    buf = Replay(args.buffer, rs)
+    steps = 0
+    returns = []
+    for ep in range(args.episodes):
+        s = env.reset()
+        total = 0.0
+        eps = max(0.05, 1.0 - ep / (0.6 * args.episodes))
+        while True:
+            if rs.rand() < eps:
+                a = rs.randint(0, 2)
+            else:
+                qv = q(nd.array(s[None])).asnumpy()
+                a = int(qv.argmax())
+            s2, r, done = env.step(a)
+            buf.push((s, a, r, s2, float(done)))
+            total += r
+            s = s2
+            steps += 1
+            if len(buf.data) >= args.batch_size and \
+                    steps % args.train_every == 0:
+                bs_, ba, br, bs2, bd = buf.sample(args.batch_size)
+                q_next = target(nd.array(bs2)).asnumpy().max(1)
+                y = br + args.gamma * q_next * (1.0 - bd)
+                with autograd.record():
+                    q_all = q(nd.array(bs_))
+                    q_sel = nd.pick(q_all, nd.array(ba), axis=1)
+                    loss = loss_fn(q_sel, nd.array(y))
+                loss.backward()
+                trainer.step(args.batch_size)
+            if steps % args.sync_every == 0:
+                sync()
+            if done:
+                break
+        returns.append(total)
+    early = float(np.mean(returns[:10]))
+    late = float(np.mean(returns[-10:]))
+    print('dqn return early %.1f -> late %.1f' % (early, late))
+    return early, late
+
+
+if __name__ == '__main__':
+    main()

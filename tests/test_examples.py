@@ -295,3 +295,12 @@ def test_lstm_crf_viterbi_learns():
     from examples import lstm_crf
     acc = lstm_crf.main(['--epochs', '20', '--num-samples', '128'])
     assert acc > 0.85, acc
+
+
+def test_dqn_improves_over_random():
+    # replay buffer + target network + eps-greedy (reference:
+    # example/reinforcement-learning/dqn); late return must beat the
+    # early (mostly-random) phase by 3x
+    from examples import dqn
+    early, late = dqn.main(['--episodes', '250'])
+    assert late > 3 * early, (early, late)

@@ -20,7 +20,16 @@ scripts (the reference ships no in-tree BERT number; BASELINE.md).
 
 Methodology mirrors example/image-classification/benchmark_score.py +
 train_imagenet.py --benchmark 1 (synthetic data, steady-state rate).
+
+Degraded-mode contract (docs/RESILIENCE.md): besides the stdout metric
+lines, every run writes an atomic JSON artifact (--out, default
+BENCH.json) with "status": "ok" | "degraded" | "unavailable" and exits
+0 even when the TPU tunnel is down — the BENCH_r05 rc=1 traceback
+failure mode becomes a recorded data point. Backend init goes through
+resilience.acquire_backend (bounded exponential-backoff retries,
+cpu-fallback, typed status) instead of letting RuntimeError escape.
 """
+import argparse
 import json
 import time
 
@@ -47,18 +56,16 @@ def _peak_flops():
 
 
 def _retry_transient(build):
-    """Run a fused-step builder, retrying ONCE only for transient
-    tunnel/compile transport errors; deterministic failures propagate
-    immediately so the eager fallback engages without a wasted sleep."""
+    """Run a fused-step builder, retrying transient tunnel/compile
+    transport errors with backoff (resilience.Retry); deterministic
+    failures propagate immediately so the eager fallback engages
+    without a wasted sleep."""
+    from mxnet_tpu.resilience import Retry, RetryExhausted
     try:
-        return build()
-    except Exception as e:
-        msg = str(e)
-        if 'INTERNAL' in msg or 'remote_compile' in msg or \
-                'UNAVAILABLE' in msg:
-            time.sleep(10)
-            return build()
-        raise
+        return Retry(max_attempts=3, base_delay=10.0,
+                     max_delay=60.0).call(build)
+    except RetryExhausted as e:
+        raise (e.last_error or e)
 
 
 def _measure(step, warmup, iters, nd):
@@ -91,6 +98,7 @@ def _emit(metric, rate, unit, baseline, flops_per_sample, step_path):
     if peak:
         rec['mfu_pct'] = round(100 * tflops * 1e12 / peak, 2)
     print(json.dumps(rec), flush=True)
+    return rec
 
 
 def bench_resnet(on_accel):
@@ -150,8 +158,9 @@ def bench_resnet(on_accel):
             return loss
 
     dt = _measure(step, warmup, iters, nd)
-    _emit('resnet50_train_img_per_sec_per_chip', batch / dt, 'img/s',
-          363.69, RESNET50_TRAIN_FLOPS_PER_IMG, step_path)
+    return _emit('resnet50_train_img_per_sec_per_chip', batch / dt,
+                 'img/s', 363.69, RESNET50_TRAIN_FLOPS_PER_IMG,
+                 step_path)
 
 
 def bench_bert(on_accel):
@@ -233,23 +242,68 @@ def bench_bert(on_accel):
     dt = _measure(step, warmup, iters, nd)
     # transformer train FLOPs ~= 6 * params * tokens per sample
     flops_per_sample = 6 * BERT_BASE_PARAMS * seqlen
-    _emit('bert_base_pretrain_samples_per_sec_per_chip', batch / dt,
-          'samples/s', 107.0, flops_per_sample, step_path)
+    return _emit('bert_base_pretrain_samples_per_sec_per_chip',
+                 batch / dt, 'samples/s', 107.0, flops_per_sample,
+                 step_path)
 
 
-def main():
-    import jax
-    on_accel = jax.default_backend() != 'cpu'
-    bench_resnet(on_accel)
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument('--out', default='BENCH.json',
+                   help='artifact path (atomic write; same schema for '
+                        'ok/degraded/unavailable runs)')
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.resilience import (acquire_backend, artifact_record,
+                                      write_artifact, is_transient,
+                                      InjectedFault)
+    status = acquire_backend()
+    if not status.usable:
+        print('bench: backend unavailable after %d attempt(s): %s — '
+              'recording it in %s instead of crashing'
+              % (status.attempts, status.error, args.out), flush=True)
+        write_artifact(args.out, artifact_record(
+            'bench', 'unavailable', backend=status, error=status.error,
+            payload={'metrics': []}))
+        return 0
+
+    on_accel = status.state == 'tpu'
+    verdict = 'ok' if on_accel else 'degraded'
+    error = status.error
+    metrics = []
     try:
-        bench_bert(on_accel)
+        metrics.append(bench_resnet(on_accel))
     except Exception as e:
-        # BERT line is best-effort; the primary metric already printed
+        # transient/injected mid-run failure degrades the artifact;
+        # anything else is a product bug and must stay a loud crash
+        if not (isinstance(e, InjectedFault) or is_transient(e)):
+            raise
+        verdict = 'degraded'
+        error = '%s: %s' % (type(e).__name__, str(e)[:300])
+        print('bench: resnet leg lost to a transient fault (%s)'
+              % error, flush=True)
+    try:
+        metrics.append(bench_bert(on_accel))
+    except Exception as e:
+        if not (isinstance(e, InjectedFault) or is_transient(e)):
+            raise
+        # BERT line is best-effort (the primary metric already
+        # printed) but a lost leg still degrades the artifact status
+        verdict = 'degraded'
+        error = '%s: %s' % (type(e).__name__, str(e)[:300])
         print(json.dumps({
             'metric': 'bert_base_pretrain_samples_per_sec_per_chip',
             'value': 0, 'unit': 'samples/s', 'vs_baseline': 0,
             'error': str(e)[:200]}), flush=True)
 
+    write_artifact(args.out, artifact_record(
+        'bench', verdict, backend=status, error=error,
+        payload={'metrics': metrics}))
+    print('bench: status=%s artifact=%s' % (verdict, args.out),
+          flush=True)
+    return 0
+
 
 if __name__ == '__main__':
-    main()
+    import sys
+    sys.exit(main())

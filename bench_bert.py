@@ -2,15 +2,22 @@
 
 Delegates to bench.py's BERT bench (single source of truth for model
 config, fused-step construction, and the JSON metric line) so the two
-entries can never report different methodologies.
+entries can never report different methodologies. Runs under the
+degraded-mode contract (docs/RESILIENCE.md): writes BENCH_BERT.json
+with "status": ok | degraded | unavailable and exits 0 on a dead or
+degraded backend.
 """
 
 
 def main():
-    import jax
     from bench import bench_bert
-    bench_bert(jax.default_backend() != 'cpu')
+    from mxnet_tpu.resilience import run_instrument
+    return run_instrument(
+        'bench_bert',
+        lambda status: {'metrics': [bench_bert(status.state == 'tpu')]},
+        out='BENCH_BERT.json')
 
 
 if __name__ == '__main__':
-    main()
+    import sys
+    sys.exit(main())

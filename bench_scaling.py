@@ -146,12 +146,28 @@ def main(argv=None):
 
     import os
     import jax
+    from mxnet_tpu.resilience import acquire_backend, write_artifact
     if os.environ.get('JAX_PLATFORMS') == 'cpu':
         # the axon PJRT plugin force-prepends the TPU platform and
         # clobbers the env var; pin the config so the virtual-mesh
         # check is hermetic (same workaround as tests/conftest.py)
         jax.config.update('jax_platforms', 'cpu')
-    devices = jax.devices()
+    status = acquire_backend()
+    if not status.usable:
+        # degraded-mode contract (docs/RESILIENCE.md): record the
+        # outage in the artifact and exit 0 instead of tracebacking
+        print('bench_scaling: backend unavailable after %d attempt(s): '
+              '%s' % (status.attempts, status.error), flush=True)
+        artifact = {'model': args.model, 'batch_per_chip': None,
+                    'image': None, 'weak_scaling': True, 'rows': [],
+                    'status': 'unavailable',
+                    'backend': status.as_dict(), 'error': status.error}
+        write_artifact(args.out, artifact)
+        return artifact
+    # enumerate the platform acquire_backend settled on: a bare
+    # jax.devices() would re-trigger the failed TPU init that the
+    # cpu-fallback just absorbed
+    devices = jax.devices(status.platform)
     on_accel = devices[0].platform != 'cpu'
     n = len(devices)
     if args.dp:
@@ -192,9 +208,10 @@ def main(argv=None):
         print(json.dumps(row), flush=True)
 
     artifact = {'model': args.model, 'batch_per_chip': batch,
-                'image': image, 'weak_scaling': True, 'rows': rows}
-    with open(args.out, 'w') as f:
-        json.dump(artifact, f, indent=1)
+                'image': image, 'weak_scaling': True, 'rows': rows,
+                'status': 'ok' if on_accel else 'degraded',
+                'backend': status.as_dict(), 'error': status.error}
+    write_artifact(args.out, artifact)
     return artifact
 
 

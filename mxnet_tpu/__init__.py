@@ -60,6 +60,7 @@ from . import rtc
 from . import subgraph
 from . import kvstore_server
 from . import executor_manager
+from . import resilience
 
 # env-driven global seed (docs/faq/env_var.md MXNET_SEED)
 _seed = config.get('MXNET_SEED')

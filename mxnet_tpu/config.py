@@ -117,6 +117,26 @@ KNOBS = {k.name: k for k in [
           ' only shapes the eager Updater path.'),
     _knob('MXNET_MP_WORKER_NTHREADS', int, 1,
           'gluon DataLoader multiprocessing workers default.'),
+    # resilience layer (docs/RESILIENCE.md)
+    _knob('MXNET_TPU_FAULT', str, None,
+          'Scripted fault injection: comma list of kind[@site][:count]'
+          ' (device_unavailable, tunnel_stall, worker_crash). CI and'
+          ' tests only; leave unset in production.'),
+    _knob('MXNET_TPU_ACQUIRE_ATTEMPTS', int, 3,
+          'Backend-acquisition retry attempts before degrading to the'
+          ' CPU fallback / unavailable status.'),
+    _knob('MXNET_TPU_ACQUIRE_BACKOFF_S', float, 2.0,
+          'Base exponential-backoff delay (seconds) between backend'
+          ' acquisition attempts.'),
+    _knob('MXNET_TPU_ACQUIRE_DEADLINE_S', float, 300.0,
+          'Total wall-clock budget for backend acquisition retries.'),
+    _knob('MXNET_TPU_WORKER_RESTARTS', int, 2,
+          'DataLoader worker-crash restarts per batch before the'
+          ' failure propagates.'),
+    _knob('MXNET_TPU_WORKER_TIMEOUT_S', float, 300.0,
+          'Per-batch wait on a DataLoader worker task before treating'
+          ' the worker as dead and resubmitting (covers hard process'
+          ' death); 0 disables.'),
     _knob('MXNET_MP_OPENCV_NUM_THREADS', int, 0,
           'cv2 thread cap inside DataLoader workers (0 = cv2 default).'),
     # engine bulking segment sizes: one XLA program per graph already

@@ -131,6 +131,8 @@ def _worker_initializer(dataset):
 
 def _worker_fn(samples, batchify_fn, dataset=None):
     """Worker target: fetch samples and batchify."""
+    from ...resilience.policy import inject
+    inject('dataloader.worker', ('worker_crash',))
     global _worker_dataset
     ds = dataset if dataset is not None else _worker_dataset
     batch = batchify_fn([ds[i] for i in samples])
@@ -174,7 +176,7 @@ class _MultiWorkerIter:
 
     def __init__(self, worker_pool, batchify_fn, batch_sampler,
                  pin_memory=False, prefetch=0, dataset=None, loader=None,
-                 use_shm=False):
+                 use_shm=False, max_restarts=2, task_timeout=300.0):
         # pin the owning DataLoader: if the user iterates a temporary
         # (``for x in DataLoader(...)``) the loader must not be collected
         # mid-epoch — its __del__ terminates the worker pool
@@ -188,23 +190,30 @@ class _MultiWorkerIter:
         self._iter = iter(self._batch_sampler)
         self._dataset = dataset
         self._use_shm = use_shm
+        self._max_restarts = max(0, int(max_restarts))
+        self._task_timeout = float(task_timeout or 0)  # 0 disables
+        self._abandoned = []   # timed-out tasks pending shm adoption
         for _ in range(prefetch):
             self._push_next()
 
     def __len__(self):
         return len(self._batch_sampler)
 
-    def _push_next(self):
-        r = next(self._iter, None)
-        if r is None:
-            return
+    def _submit(self, samples):
         target = _proc_worker_fn if self._use_shm else _worker_fn
         # process pools ship the dataset once via the initializer; the
         # per-task dataset arg is only for the thread pool
         ds = None if self._use_shm else self._dataset
-        async_ret = self._worker_pool.apply_async(
-            target, (r, self._batchify_fn, ds))
-        self._data_buffer[self._sent_idx] = async_ret
+        return self._worker_pool.apply_async(
+            target, (samples, self._batchify_fn, ds))
+
+    def _push_next(self):
+        r = next(self._iter, None)
+        if r is None:
+            return
+        # keep the index batch so a crashed worker's task can be
+        # resubmitted (crash-restart, docs/RESILIENCE.md)
+        self._data_buffer[self._sent_idx] = (r, self._submit(r))
         self._sent_idx += 1
 
     def __next__(self):
@@ -216,12 +225,44 @@ class _MultiWorkerIter:
             'rcvd_idx must be smaller than sent_idx'
         assert self._rcvd_idx in self._data_buffer, \
             'fatal error with _push_next, rcvd_idx missing'
-        ret = self._data_buffer.pop(self._rcvd_idx)
-        batch = ret.get()
+        samples, ret = self._data_buffer.pop(self._rcvd_idx)
+        batch = self._get_with_restart(samples, ret)
         if self._use_shm:
             batch = _shm_unpack(batch)
         self._rcvd_idx += 1
         return _as_nd(batch)
+
+    def _get_with_restart(self, samples, ret):
+        """Fetch one task result, resubmitting the same index batch
+        when the worker crashed — a dead decode worker costs one
+        warning and a re-run, not the epoch. Raised exceptions cover
+        in-process crashes; the get() timeout covers hard process
+        death (OOM-kill/segfault), where the pool respawns the worker
+        but the in-flight AsyncResult would otherwise never complete.
+        Deterministic bugs re-raise after the restart budget so they
+        stay visible."""
+        import multiprocessing
+        attempt = 0
+        while True:
+            try:
+                return ret.get(self._task_timeout) \
+                    if self._task_timeout else ret.get()
+            except Exception as exc:
+                if isinstance(exc, multiprocessing.TimeoutError) and \
+                        self._use_shm:
+                    # the stalled task may still finish later and park
+                    # its batch in shm; keep the result so close() can
+                    # adopt-and-unlink instead of leaking the segments
+                    self._abandoned.append(ret)
+                if attempt >= self._max_restarts:
+                    raise
+                attempt += 1
+                import warnings
+                warnings.warn(
+                    'DataLoader worker task failed (attempt %d/%d); '
+                    'resubmitting the batch to the pool'
+                    % (attempt, self._max_restarts))
+                ret = self._submit(samples)
 
     def close(self, drain_timeout=30):
         """Drain in-flight batches so their shared-memory segments get
@@ -232,9 +273,15 @@ class _MultiWorkerIter:
         short bound so an abandoned iterator cannot stall interpreter
         shutdown for minutes while the pool finishes prefetched work."""
         while self._use_shm and self._data_buffer:
-            _, ret = self._data_buffer.popitem()
+            _, (_, ret) = self._data_buffer.popitem()
             try:
                 _shm_unpack(ret.get(timeout=drain_timeout))
+            except Exception:
+                pass
+        while self._use_shm and self._abandoned:
+            try:
+                _shm_unpack(self._abandoned.pop().get(
+                    timeout=drain_timeout))
             except Exception:
                 pass
         self._data_buffer = {}
@@ -356,11 +403,14 @@ class DataLoader:
                     yield _as_nd(ret) if not isinstance(ret, (NDArray, list)) \
                         else ret
             return same_process_iter()
+        from ...config import get as _cfg
         return _MultiWorkerIter(
             self._worker_pool, self._batchify_fn, self._batch_sampler,
             pin_memory=self._pin_memory, prefetch=self._prefetch,
             dataset=self._dataset, loader=self,
-            use_shm=not self._thread_pool)
+            use_shm=not self._thread_pool,
+            max_restarts=_cfg('MXNET_TPU_WORKER_RESTARTS'),
+            task_timeout=_cfg('MXNET_TPU_WORKER_TIMEOUT_S'))
 
     def __len__(self):
         return len(self._batch_sampler)

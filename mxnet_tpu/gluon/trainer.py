@@ -253,21 +253,25 @@ class Trainer:
             p.data()._grad_fresh = False
         return True
 
-    def save_states(self, fname):
-        """Save trainer (optimizer/updater) states
-        (reference: trainer.py save_states)."""
+    def get_states_bytes(self):
+        """Serialized optimizer/updater state (the save_states payload)
+        — the checkpoint layer embeds this in its atomic state dicts
+        (resilience/checkpoint.py snapshot_gluon)."""
         if self._optimizer is None:
             raise AssertionError('no optimizer to save')
         self._ensure_kv()
-        payload = self._updaters[0].get_states(dump_optimizer=True)
-        with open(fname, 'wb') as fout:
-            fout.write(payload)
+        return self._updaters[0].get_states(dump_optimizer=True)
 
-    def load_states(self, fname):
-        """Load trainer states."""
+    def save_states(self, fname):
+        """Save trainer (optimizer/updater) states atomically
+        (reference: trainer.py save_states; write-temp + fsync + rename
+        so a mid-save kill never tears the file)."""
+        from ..resilience.checkpoint import atomic_write_bytes
+        atomic_write_bytes(fname, self.get_states_bytes())
+
+    def set_states_bytes(self, payload):
+        """Inverse of :meth:`get_states_bytes`."""
         self._ensure_kv()
-        with open(fname, 'rb') as f:
-            payload = f.read()
         for updater in self._updaters:
             updater.set_states(payload)
             updater.optimizer = self._updaters[0].optimizer
@@ -278,3 +282,8 @@ class Trainer:
         # explicit user opt-out: _fused=False stays False)
         if self._fused is not False:
             self._fused = None
+
+    def load_states(self, fname):
+        """Load trainer states."""
+        with open(fname, 'rb') as f:
+            self.set_states_bytes(f.read())

@@ -22,8 +22,46 @@ from .base import string_types
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import optimizer as opt
+from .resilience.policy import Retry, RetryExhausted, inject, is_transient
 
-__all__ = ['KVStore', 'create']
+__all__ = ['KVStore', 'KVStoreInitError', 'create']
+
+_KV_FAULTS = ('device_unavailable', 'tunnel_stall')
+
+
+class KVStoreInitError(RuntimeError):
+    """Distributed store init failed after bounded retries.
+
+    Carries ``attempts`` and ``last_cause`` so launcher logs show a
+    one-line diagnosis (coordinator unreachable, N attempts, last
+    error) instead of a bare jax.distributed stack trace.
+    """
+
+    def __init__(self, kv_type, attempts, last_cause):
+        super().__init__(
+            'dist kvstore %r init failed after %d attempt(s); the '
+            'coordinator is unreachable or the backend initialized '
+            'first. Last cause: %s: %s'
+            % (kv_type, attempts, type(last_cause).__name__, last_cause))
+        self.kv_type = kv_type
+        self.attempts = attempts
+        self.last_cause = last_cause
+
+
+def _comm_retry():
+    """Backoff policy for dist collectives (init/push/pull): transient
+    tunnel errors get bounded retries; deterministic errors propagate.
+
+    Caveat (docs/RESILIENCE.md): a collective retry is only safe when
+    every participant fails and retries in lockstep — the common case
+    for a slice-wide tunnel outage, where the error surfaces on all
+    workers. A partial failure (one worker errors while peers complete)
+    cannot be healed by per-process retry; jax collectives give no
+    abort-and-rejoin, so that case still ends in the runtime's own
+    collective timeout. The deterministic parameters below (no jitter)
+    keep retrying workers aligned."""
+    return Retry(max_attempts=3, base_delay=1.0, max_delay=30.0,
+                 jitter=0.0, predicate=is_transient)
 
 
 def _ctype_key_value(keys, vals):
@@ -141,16 +179,24 @@ class KVStore:
     def _allreduce(self, value):
         if self.num_workers <= 1 or not self._type.startswith(('dist', 'horovod')):
             return value
-        import jax
-        from jax.experimental import multihost_utils
-        arr = multihost_utils.process_allgather(value._data)
+
+        def _reduce():
+            # scripted-fault hook: lets tests drive the retry path
+            # without a real tunnel outage (docs/RESILIENCE.md)
+            inject('kvstore.push', _KV_FAULTS)
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(value._data)
+        arr = _comm_retry().call(_reduce)
         return NDArray(arr.sum(axis=0))
 
     def _barrier(self):
         """Global barrier across workers (reference: kvstore.py:606)."""
         if self.num_workers > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices('kvstore_barrier')
+            def _sync():
+                inject('kvstore.pull', _KV_FAULTS)
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices('kvstore_barrier')
+            _comm_retry().call(_sync)
 
     # -- optimizer hosting -------------------------------------------------
     def set_optimizer(self, optimizer):
@@ -227,5 +273,13 @@ def create(name='local'):
         raise ValueError('Unknown KVStore type %s' % name)
     if name.lower() in _DIST_TYPES:
         from ._dist_init import ensure_distributed
-        ensure_distributed()
+
+        def _join():
+            inject('kvstore.init', _KV_FAULTS)
+            ensure_distributed()
+        try:
+            _comm_retry().call(_join)
+        except RetryExhausted as exc:
+            raise KVStoreInitError(name.lower(), exc.attempts,
+                                   exc.last_error)
     return KVStore(name.lower())

@@ -28,15 +28,34 @@ class BatchEndParam:
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     """Save prefix-symbol.json + prefix-%04d.params
-    (reference: model.py:394)."""
+    (reference: model.py:394).
+
+    Both files go through write-temp + fsync + rename
+    (resilience/checkpoint.py): a kill mid-save leaves the previous
+    checkpoint readable instead of a torn .params file."""
+    import os
+    from .resilience.checkpoint import atomic_replace
+
+    def _commit(write, final):
+        # pid-suffixed temp so concurrent savers cannot interleave,
+        # cleaned up if anything fails before the rename
+        tmp = '%s.tmp.%d' % (final, os.getpid())
+        try:
+            write(tmp)
+            atomic_replace(tmp, final)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
     if symbol is not None:
-        symbol.save('%s-symbol.json' % prefix)
+        _commit(symbol.save, '%s-symbol.json' % prefix)
     save_dict = {('arg:%s' % k): v.as_in_context(cpu())
                  for k, v in arg_params.items()}
     save_dict.update({('aux:%s' % k): v.as_in_context(cpu())
                       for k, v in aux_params.items()})
     param_name = '%s-%04d.params' % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    _commit(lambda tmp: nd.save(tmp, save_dict), param_name)
     logging.info('Saved checkpoint to "%s"', param_name)
 
 

@@ -178,8 +178,16 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """The training driver (reference: base_module.py:409)."""
+            monitor=None, sparse_row_id_fn=None, checkpoint_dir=None):
+        """The training driver (reference: base_module.py:409).
+
+        ``checkpoint_dir`` opts into crash-resumable training: each
+        epoch boundary atomically checkpoints params + optimizer state
+        there (resilience/checkpoint.py), and a fit() pointed at a
+        directory with checkpoints resumes from the newest valid one
+        instead of epoch ``begin_epoch`` — an interrupted job re-run
+        with the same command continues where it stopped.
+        """
         if num_epoch is None:
             raise AssertionError('please specify number of epochs')
         from .. import initializer as init_mod
@@ -194,6 +202,24 @@ class BaseModule:
                          allow_missing=allow_missing, force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        ckpt_mgr = None
+        if checkpoint_dir is not None:
+            from ..resilience.checkpoint import CheckpointManager
+            ckpt_mgr = CheckpointManager(checkpoint_dir, prefix='fit')
+            resumed = ckpt_mgr.latest()
+            if resumed is not None:
+                ck_epoch, state = resumed
+                self.set_params(
+                    {k: nd.array(v) for k, v in state['arg_params'].items()},
+                    {k: nd.array(v) for k, v in state['aux_params'].items()})
+                updater = getattr(self, '_updater', None)
+                if updater is not None and state.get('optimizer'):
+                    updater.set_states(state['optimizer'])
+                begin_epoch = ck_epoch + 1
+                self.logger.info(
+                    'Resumed from checkpoint epoch %d in %s; continuing '
+                    'at epoch %d', ck_epoch, checkpoint_dir, begin_epoch)
 
         validation_metric = validation_metric or eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -236,6 +262,20 @@ class BaseModule:
             # sync params across executors at epoch boundary
             arg_params, aux_params = self.get_params()
             self.set_params(arg_params, aux_params)
+            if ckpt_mgr is not None:
+                updater = getattr(self, '_updater', None)
+                ckpt_mgr.save(epoch, {
+                    'epoch': epoch,
+                    'arg_params': {k: v.asnumpy()
+                                   for k, v in arg_params.items()},
+                    'aux_params': {k: v.asnumpy()
+                                   for k, v in aux_params.items()},
+                    # dump_optimizer: the optimizer's own counters
+                    # (num_update, bias-correction state, scheduler
+                    # position) must survive resume, not just the
+                    # per-index state arrays
+                    'optimizer': updater.get_states(dump_optimizer=True)
+                    if updater is not None else None})
             for cb in _as_list(epoch_end_callback):
                 cb(epoch, self.symbol, arg_params, aux_params)
 

@@ -1,0 +1,104 @@
+"""Degraded-mode artifact contract for bench/probe instruments.
+
+Every instrument run — healthy, degraded, or facing a dead backend —
+produces the SAME JSON shape and exits 0, so snapshot automation
+records a data point instead of a traceback (the BENCH_r05 failure
+mode). Only a non-transient error (a real bug) propagates with a
+non-zero exit.
+
+Artifact schema (docs/RESILIENCE.md):
+
+    {
+      "schema":  "mxnet_tpu.instrument.v1",
+      "name":    "<instrument>",
+      "status":  "ok" | "degraded" | "unavailable",
+      "backend": {state, platform, device_kind, device_count,
+                  attempts, error},
+      "error":   null | "<one-line cause>",
+      "payload": null | <instrument-specific JSON>
+    }
+
+``status`` semantics: ok = accelerator measured at full fidelity;
+degraded = the instrument ran but its numbers are not claims (CPU
+fallback, partial failure); unavailable = no backend, payload null.
+"""
+from __future__ import annotations
+
+import json
+
+from .checkpoint import atomic_write_bytes
+from .device import acquire_backend
+from .policy import InjectedFault, is_transient
+
+__all__ = ['SCHEMA', 'artifact_record', 'write_artifact',
+           'run_instrument']
+
+SCHEMA = 'mxnet_tpu.instrument.v1'
+
+
+def artifact_record(name, status, backend=None, error=None,
+                    payload=None):
+    """Build the fixed-shape artifact dict (every key always present)."""
+    assert status in ('ok', 'degraded', 'unavailable'), status
+    return {
+        'schema': SCHEMA,
+        'name': name,
+        'status': status,
+        'backend': backend.as_dict() if hasattr(backend, 'as_dict')
+        else (backend or {'state': 'unavailable', 'platform': None,
+                          'device_kind': None, 'device_count': 0,
+                          'attempts': 0, 'error': error}),
+        'error': error,
+        'payload': payload,
+    }
+
+
+def write_artifact(path, record):
+    """Atomically write the artifact JSON (a torn artifact would be as
+    useless as the crash it replaces)."""
+    atomic_write_bytes(
+        path, (json.dumps(record, indent=1, sort_keys=True,
+                          default=str) + '\n').encode())
+    return record
+
+
+def run_instrument(name, run, out=None):
+    """Drive one instrument under the degraded-mode contract.
+
+    ``run(status)`` receives the :class:`BackendStatus` and returns a
+    JSON-serializable payload (or None). Returns a process exit code:
+    0 for ok/degraded/unavailable, non-zero only when ``run`` raised a
+    non-transient (bug-shaped) error — which is re-raised, so the
+    traceback stays visible.
+    """
+    out = out or ('%s.json' % name.upper())
+    status = acquire_backend()
+    if not status.usable:
+        print('%s: backend unavailable after %d attempt(s): %s — '
+              'writing degraded artifact to %s'
+              % (name, status.attempts, status.error, out), flush=True)
+        write_artifact(out, artifact_record(
+            name, 'unavailable', backend=status, error=status.error))
+        return 0
+
+    verdict = 'ok' if status.state == 'tpu' else 'degraded'
+    error = status.error
+    payload = None
+    try:
+        payload = run(status)
+    except Exception as exc:
+        if not (isinstance(exc, InjectedFault) or is_transient(exc)):
+            # real bug: record it, then let the traceback escape
+            write_artifact(out, artifact_record(
+                name, 'degraded', backend=status,
+                error='%s: %s' % (type(exc).__name__, exc)))
+            raise
+        verdict = 'degraded'
+        error = '%s: %s' % (type(exc).__name__, exc)
+        print('%s: transient failure mid-run (%s) — recording degraded '
+              'artifact' % (name, error), flush=True)
+    write_artifact(out, artifact_record(name, verdict, backend=status,
+                                        error=error, payload=payload))
+    print('%s: status=%s artifact=%s' % (name, verdict, out),
+          flush=True)
+    return 0

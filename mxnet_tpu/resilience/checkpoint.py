@@ -1,0 +1,220 @@
+"""Atomic checkpoint save/resume for training state.
+
+Write protocol (crash-safe on POSIX): serialize to a temp file in the
+TARGET directory, flush + fsync, then ``os.replace`` onto the final
+name and fsync the directory. A kill at any point leaves either the
+previous checkpoint or the new one — never a torn file. The fault
+injector's ``checkpoint.commit`` site fires between the fsync and the
+rename so tests can simulate exactly the worst-case kill
+(tests/test_resilience.py).
+
+State payloads are plain dicts of python/numpy values (pickled), with a
+magic header so :class:`CheckpointManager` can reject torn or foreign
+files instead of crashing resume. The manager keeps the last ``keep``
+checkpoints and resumes from the newest file that validates, so one
+corrupt write never strands a training job.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import warnings
+
+from .policy import inject
+
+__all__ = ['atomic_write_bytes', 'atomic_replace', 'save_state',
+           'load_state', 'CheckpointManager', 'snapshot_gluon',
+           'restore_gluon']
+
+_MAGIC = b'MXTPUCKPT1\n'
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        pass  # EPERM etc: process exists but isn't ours
+    return True
+
+
+def atomic_replace(tmp_path, final_path):
+    """fsync ``tmp_path``, atomically rename it over ``final_path``,
+    then fsync the directory entry."""
+    fd = os.open(tmp_path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+    # honors only the process-crash kind: a device fault cannot tear a
+    # local file write, but a kill between fsync and rename can —
+    # script 'worker_crash@checkpoint.commit:1' to simulate it
+    inject('checkpoint.commit', ('worker_crash',))
+    os.replace(tmp_path, final_path)
+    dirfd = os.open(os.path.dirname(os.path.abspath(final_path)) or '.',
+                    os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; rename is done
+    finally:
+        os.close(dirfd)
+
+
+def atomic_write_bytes(path, payload):
+    """Write ``payload`` to ``path`` with the write-temp + fsync +
+    rename protocol."""
+    path = os.path.abspath(path)
+    tmp = '%s.tmp.%d' % (path, os.getpid())
+    with open(tmp, 'wb') as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        atomic_replace(tmp, path)
+    except BaseException:
+        # never leave the temp behind on a failed/injected commit path
+        # that still runs python (a real kill is cleaned by prune())
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def save_state(path, state):
+    """Atomically persist a state dict (python/numpy values)."""
+    if not isinstance(state, dict):
+        raise TypeError('state must be a dict, got %s' % type(state))
+    atomic_write_bytes(path, _MAGIC + pickle.dumps(state, protocol=4))
+
+
+def load_state(path):
+    """Load a state dict; raises ValueError for torn/foreign files."""
+    with open(path, 'rb') as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            raise ValueError('%s is not a mxnet_tpu checkpoint '
+                             '(bad magic)' % path)
+        try:
+            return pickle.loads(f.read())
+        except Exception as exc:
+            raise ValueError('%s is torn or corrupt: %s' % (path, exc))
+
+
+class CheckpointManager:
+    """Numbered atomic checkpoints with resume-from-latest.
+
+    Files are ``<prefix>-<step:08d>.ckpt`` under ``directory``.
+    ``latest()`` walks newest-first and returns the first checkpoint
+    that validates, skipping (with a warning) torn files from an
+    interrupted save. ``save()`` prunes beyond ``keep`` and sweeps
+    stale temp files left by killed writers.
+    """
+
+    def __init__(self, directory, prefix='ckpt', keep=2):
+        self.directory = os.path.abspath(directory)
+        self.prefix = prefix
+        self.keep = max(1, int(keep))
+        os.makedirs(self.directory, exist_ok=True)
+
+    def path_for(self, step):
+        return os.path.join(self.directory,
+                            '%s-%08d.ckpt' % (self.prefix, int(step)))
+
+    def _steps(self):
+        steps = []
+        want = self.prefix + '-'
+        for name in os.listdir(self.directory):
+            if name.startswith(want) and name.endswith('.ckpt'):
+                num = name[len(want):-len('.ckpt')]
+                if num.isdigit():
+                    steps.append(int(num))
+        return sorted(steps)
+
+    def save(self, step, state):
+        """Atomically write checkpoint ``step`` and prune old ones."""
+        state = dict(state)
+        state.setdefault('step', int(step))
+        save_state(self.path_for(step), state)
+        self.prune()
+        return self.path_for(step)
+
+    def prune(self):
+        for step in self._steps()[:-self.keep]:
+            try:
+                os.unlink(self.path_for(step))
+            except OSError:
+                pass
+        # sweep killed writers' temp leftovers — only this manager's
+        # prefix, and only when the writing pid is dead: a live
+        # concurrent saver's in-flight temp must not be clobbered
+        for name in os.listdir(self.directory):
+            if not (name.startswith(self.prefix + '-') and
+                    '.ckpt.tmp.' in name):
+                continue
+            pid = name.rpartition('.')[2]
+            if pid.isdigit() and _pid_alive(int(pid)):
+                continue
+            try:
+                os.unlink(os.path.join(self.directory, name))
+            except OSError:
+                pass
+
+    def latest(self):
+        """(step, state) of the newest valid checkpoint, or None."""
+        for step in reversed(self._steps()):
+            path = self.path_for(step)
+            try:
+                return step, load_state(path)
+            except (ValueError, OSError) as exc:
+                warnings.warn('skipping unreadable checkpoint %s (%s); '
+                              'resuming from the previous one'
+                              % (path, exc))
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Gluon wiring: one-call snapshot/restore of (net params, trainer
+# optimizer state, epoch) so an interrupted fit resumes from the last
+# epoch boundary with bit-identical state.
+# ---------------------------------------------------------------------------
+
+def snapshot_gluon(net, trainer=None, epoch=0, extra=None):
+    """Capture net parameters (+ optimizer/updater state when a Trainer
+    is given) as a checkpoint-ready state dict.
+
+    Parameters are keyed relative to the net's name-scope prefix (the
+    save_parameters convention): the auto-incremented block counter
+    differs between the saving process and the resuming one, but the
+    architecture-relative names do not."""
+    prefix = getattr(net, 'prefix', '')
+    params = {}
+    for name, p in sorted(net.collect_params().items()):
+        key = name[len(prefix):] if prefix and name.startswith(prefix) \
+            else name
+        params[key] = p.data().asnumpy()
+    state = {'epoch': int(epoch), 'params': params,
+             'trainer': trainer.get_states_bytes()
+             if trainer is not None else None}
+    if extra:
+        state.update(extra)
+    return state
+
+
+def restore_gluon(state, net, trainer=None):
+    """Load a :func:`snapshot_gluon` state dict back into ``net`` (and
+    ``trainer``); returns the epoch the snapshot was taken at."""
+    from .. import ndarray as nd
+    own = net.collect_params()
+    prefix = getattr(net, 'prefix', '')
+    for key, value in state['params'].items():
+        name = prefix + key if (prefix + key) in own else key
+        if name not in own:
+            raise KeyError('checkpoint parameter %r not in network '
+                           '(architecture changed since save?)' % key)
+        own[name].set_data(nd.array(value, dtype=value.dtype))
+    if trainer is not None and state.get('trainer') is not None:
+        trainer.set_states_bytes(state['trainer'])
+    return state['epoch']

@@ -401,8 +401,26 @@ def test_c_program_trains_lenet(tmp_path):
     env = dict(os.environ)
     env['PYTHONPATH'] = root + os.pathsep + env.get('PYTHONPATH', '')
     env.setdefault('JAX_PLATFORMS', 'cpu')
-    r = subprocess.run([exe, img, lab], capture_output=True, text=True,
-                       timeout=900, env=env)
+    # the binary enforces its own per-epoch budget (heartbeat + phase
+    # breakdown, exit 3) well inside the subprocess timeout, so a stall
+    # reports WHERE it is instead of dying as an opaque TimeoutExpired
+    env.setdefault('MXNET_TPU_EPOCH_BUDGET_S', '240')
+    try:
+        r = subprocess.run([exe, img, lab], capture_output=True,
+                           text=True, timeout=900, env=env)
+    except subprocess.TimeoutExpired as e:
+        def _s(b):
+            return b.decode('utf-8', 'replace') if isinstance(b, bytes) \
+                else (b or '')
+        pytest.fail('train_lenet_capi exceeded the 900s harness '
+                    'timeout despite its per-epoch budget; partial '
+                    'output (last heartbeat shows the stall phase):\n'
+                    'stdout:\n%s\nstderr:\n%s'
+                    % (_s(e.stdout)[-2000:], _s(e.stderr)[-2000:]))
+    if r.returncode == 3:
+        pytest.fail('train_lenet_capi blew its per-epoch wall-clock '
+                    'budget; phase breakdown:\n%s\n%s'
+                    % (r.stdout[-2000:], r.stderr[-2000:]))
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert 'OK' in r.stdout, r.stdout
 

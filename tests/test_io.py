@@ -281,7 +281,9 @@ def test_dataloader_abandoned_iterator_cleans_shm():
     dl = gdata.DataLoader(ds, batch_size=4, num_workers=2)
     it = iter(dl)
     next(it)                      # several batches now in flight
-    names = [ret.get(timeout=60) for ret in
+    # buffer entries are (samples, AsyncResult) pairs so crashed
+    # worker tasks can be resubmitted (resilience crash-restart)
+    names = [ret.get(timeout=60) for _, ret in
              list(it._data_buffer.values())]
     it.close()
     # every parked segment from the drained buffer must be unlinked

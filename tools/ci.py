@@ -5,9 +5,12 @@ surface; this is the TPU-native equivalent for a 1-core host).
 
 Stages, each timed:
   1. fast test tier        pytest -m "not slow"       (~2 min)
-  2. C ABI audit           tools/capi_coverage.py == 207/207
-  3. copy-paste gate       tools/overlap_check.py --sweep 0.60
-  4. example smokes        3 representative workloads (LeNet both
+  2. fault injection       tools/fault_smoke.py — bench.py under
+                           MXNET_TPU_FAULT=device_unavailable must
+                           degrade (rc=0 + status artifact), not crash
+  3. C ABI audit           tools/capi_coverage.py == 207/207
+  4. copy-paste gate       tools/overlap_check.py --sweep 0.60
+  5. example smokes        3 representative workloads (LeNet both
                            APIs, word-LM, plugin op)
 
 Exit code 0 = gate green. Run the FULL suite (~17 min:
@@ -42,6 +45,12 @@ def main(argv=None):
     stages = [
         ('tests', [py, '-m', 'pytest', 'tests/', '-q']
          + ([] if full else ['-m', 'not slow'])),
+        # stage 1 already ran tests/test_resilience.py; this tier adds
+        # the end-to-end forced-degraded bench (rc=0 + artifact schema).
+        # It precedes capi/overlap because those need /root/reference
+        # and should not mask a resilience regression where the
+        # reference tree is absent.
+        ('fault-inject', [py, 'tools/fault_smoke.py', '--skip-tests']),
         ('capi', [py, 'tools/capi_coverage.py', '--assert', '207']),
         ('overlap', [py, 'tools/overlap_check.py', '--sweep', '0.60']),
     ]

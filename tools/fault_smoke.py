@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Fault-injection CI tier (tools/ci.py stage 'resilience').
+
+Two checks:
+  1. tests/test_resilience.py passes (policy math, checkpoint resume,
+     worker restart — the deterministic fault suite).
+  2. bench.py in forced-degraded mode: with
+     MXNET_TPU_FAULT=device_unavailable the bench must EXIT 0 and write
+     an artifact whose status != "ok" with the full degraded-mode
+     schema (docs/RESILIENCE.md) — the BENCH_r05 traceback failure mode
+     is the regression this tier gates against.
+
+Usage: python tools/fault_smoke.py [--skip-tests]
+(--skip-tests runs only the bench check; ci.py's fast tier already ran
+the test file, so the gate uses it to avoid double work.)
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_REQUIRED_KEYS = {'schema', 'name', 'status', 'backend', 'error',
+                  'payload'}
+_REQUIRED_BACKEND_KEYS = {'state', 'platform', 'device_kind',
+                          'device_count', 'attempts', 'error'}
+
+
+def run_faulted_bench():
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, 'BENCH.json')
+        env = dict(os.environ,
+                   MXNET_TPU_FAULT='device_unavailable',
+                   JAX_PLATFORMS='cpu')
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, 'bench.py'),
+             '--out', out],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+        if r.returncode != 0:
+            print('FAIL: faulted bench exited %d (must degrade, not '
+                  'crash)\nstdout:\n%s\nstderr:\n%s'
+                  % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        if not os.path.exists(out):
+            print('FAIL: faulted bench wrote no artifact')
+            return False
+        art = json.load(open(out))
+        problems = []
+        if set(art) != _REQUIRED_KEYS:
+            problems.append('artifact keys %s != required %s'
+                            % (sorted(art), sorted(_REQUIRED_KEYS)))
+        elif set(art['backend']) != _REQUIRED_BACKEND_KEYS:
+            problems.append('backend keys %s != required %s'
+                            % (sorted(art['backend']),
+                               sorted(_REQUIRED_BACKEND_KEYS)))
+        if art.get('status') == 'ok':
+            problems.append("status is 'ok' under forced device fault")
+        if art.get('status') not in ('degraded', 'unavailable'):
+            problems.append('status %r not a degraded status'
+                            % art.get('status'))
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        print('faulted bench: rc=0, status=%r, schema ok'
+              % art['status'])
+        return True
+
+
+def run_resilience_tests():
+    r = subprocess.run(
+        [sys.executable, '-m', 'pytest', 'tests/test_resilience.py',
+         '-q', '-p', 'no:cacheprovider'],
+        cwd=REPO)
+    return r.returncode == 0
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    ok = True
+    if '--skip-tests' not in argv:
+        ok = run_resilience_tests()
+    ok = run_faulted_bench() and ok
+    print('fault_smoke: %s' % ('OK' if ok else 'FAIL'))
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

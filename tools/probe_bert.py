@@ -169,4 +169,9 @@ def main():
 
 
 if __name__ == '__main__':
-    main()
+    # degraded-mode contract (docs/RESILIENCE.md): a dead tunnel yields
+    # PROBE_BERT.json with status=unavailable and rc=0, not a traceback
+    import sys
+    from mxnet_tpu.resilience import run_instrument
+    sys.exit(run_instrument('probe_bert', lambda status: main(),
+                            out='PROBE_BERT.json'))

@@ -91,4 +91,10 @@ def main():
 
 
 if __name__ == '__main__':
-    main()
+    # degraded-mode contract (docs/RESILIENCE.md): a dead tunnel yields
+    # an artifact with status=unavailable and rc=0, not a traceback
+    import sys
+    from mxnet_tpu.resilience import run_instrument
+    sys.exit(run_instrument('probe_int8_resnet50',
+                            lambda status: main(),
+                            out='PROBE_INT8_RESNET50.json'))

@@ -80,4 +80,8 @@ def main():
 
 
 if __name__ == '__main__':
-    main()
+    # degraded-mode contract (docs/RESILIENCE.md): a dead tunnel yields
+    # an artifact with status=unavailable and rc=0, not a traceback
+    from mxnet_tpu.resilience import run_instrument
+    sys.exit(run_instrument('probe_step_ab', lambda status: main(),
+                            out='PROBE_STEP_AB.json'))

@@ -1,11 +1,12 @@
 """Standalone BERT-base pretraining benchmark entry.
 
 Delegates to bench.py's BERT bench (single source of truth for model
-config, fused-step construction, and the JSON metric line) so the two
-entries can never report different methodologies. Runs under the
-degraded-mode contract (docs/RESILIENCE.md): writes BENCH_BERT.json
-with "status": ok | degraded | unavailable and exits 0 on a dead or
-degraded backend.
+config, fused-step construction, slope timing, and the JSON metric
+line — including the 'guardrail': on|off label driven by
+MXNET_TPU_GUARDRAIL) so the two entries can never report different
+methodologies. Runs under the degraded-mode contract
+(docs/RESILIENCE.md): writes BENCH_BERT.json with "status": ok |
+degraded | unavailable and exits 0 on a dead or degraded backend.
 """
 
 

@@ -56,17 +56,33 @@ def score(model_name, dtype, batch=128, image=224, iters=20):
     return img_s
 
 
-def main():
+def main(argv=None):
+    import argparse
     import jax
     on_accel = jax.default_backend() != 'cpu'
-    batch = 128 if on_accel else 4
-    iters = 20 if on_accel else 2
-    for model, dtype in [('resnet50_v1', 'float32'),
-                         ('resnet50_v1', 'bfloat16'),
-                         ('resnet152_v1', 'float32'),
-                         ('inception_v3', 'float32')]:
-        score(model, dtype, batch=batch,
-              image=224, iters=iters)
+    p = argparse.ArgumentParser()
+    p.add_argument('--models', default=None,
+                   help='comma list of model:dtype pairs (default: the '
+                        'published four-config table)')
+    p.add_argument('--batch', type=int, default=128 if on_accel else 4)
+    p.add_argument('--image', type=int, default=224)
+    p.add_argument('--iters', type=int, default=20 if on_accel else 2)
+    args = p.parse_args(argv)
+    if args.models:
+        configs = []
+        for m in args.models.split(','):
+            name, _, dtype = m.partition(':')
+            configs.append((name, dtype or 'float32'))
+    else:
+        configs = [('resnet50_v1', 'float32'),
+                   ('resnet50_v1', 'bfloat16'),
+                   ('resnet152_v1', 'float32'),
+                   ('inception_v3', 'float32')]
+    rates = []
+    for model, dtype in configs:
+        rates.append(score(model, dtype, batch=args.batch,
+                           image=args.image, iters=args.iters))
+    return rates
 
 
 if __name__ == '__main__':

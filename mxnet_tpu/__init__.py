@@ -61,6 +61,7 @@ from . import subgraph
 from . import kvstore_server
 from . import executor_manager
 from . import resilience
+from . import guardrail
 
 # env-driven global seed (docs/faq/env_var.md MXNET_SEED)
 _seed = config.get('MXNET_SEED')

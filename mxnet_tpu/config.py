@@ -15,8 +15,8 @@ from __future__ import annotations
 import os
 import threading
 
-__all__ = ['Knob', 'KNOBS', 'get', 'set', 'describe', 'naive_engine',
-           'NaiveEngineScope']
+__all__ = ['Knob', 'KNOBS', 'get', 'set', 'unset', 'describe',
+           'naive_engine', 'NaiveEngineScope']
 
 _lock = threading.Lock()
 _values = {}
@@ -120,8 +120,40 @@ KNOBS = {k.name: k for k in [
     # resilience layer (docs/RESILIENCE.md)
     _knob('MXNET_TPU_FAULT', str, None,
           'Scripted fault injection: comma list of kind[@site][:count]'
-          ' (device_unavailable, tunnel_stall, worker_crash). CI and'
-          ' tests only; leave unset in production.'),
+          ' (device_unavailable, tunnel_stall, worker_crash, and the'
+          ' value kinds nan/inf, e.g. nan@grads:2 for the guardrail).'
+          ' CI and tests only; leave unset in production.'),
+    # numerical guardrail (docs/GUARDRAILS.md)
+    _knob('MXNET_TPU_GUARDRAIL', bool, False,
+          'Default-enable the in-jit numerical guardrail (health'
+          ' sentinel + dynamic loss scaling + skip-update) in'
+          ' ParallelTrainer when no explicit guardrail= is passed.'),
+    _knob('MXNET_TPU_LOSS_SCALE', float, 32768.0,
+          'Initial dynamic loss scale (power of two; the schedule'
+          ' halves on overflow, doubles after'
+          ' MXNET_TPU_LOSS_SCALE_WINDOW good steps, capped at 2**24).'),
+    _knob('MXNET_TPU_LOSS_SCALE_WINDOW', int, 2000,
+          'Consecutive healthy steps before the loss scale doubles'
+          ' (the reference contrib/amp scale_window).'),
+    _knob('MXNET_TPU_GUARD_WINDOW', int, 64,
+          'Rolling-window length for the host anomaly policy'
+          ' (loss/grad-norm z-score baselines).'),
+    _knob('MXNET_TPU_GUARD_ZSCORE', float, 6.0,
+          'z-score threshold above the rolling baseline that trips a'
+          ' loss-spike / grad-spike rollback.'),
+    _knob('MXNET_TPU_GUARD_PATIENCE', int, 3,
+          'Consecutive non-finite (skipped) steps before the policy'
+          ' escalates from skipping to a checkpoint rollback.'),
+    _knob('MXNET_TPU_GUARD_CHECK_EVERY', int, 1,
+          'Host-side policy cadence: process queued sentinel events'
+          ' every N steps (a sync point); 0 defers all processing to'
+          ' explicit flush() calls (dispatch-pipelined loops).'),
+    _knob('MXNET_TPU_GUARD_SNAPSHOT_EVERY', int, 25,
+          'Steps between last-good rollback snapshots taken by guarded'
+          ' drivers (guardrail/rollback.py).'),
+    _knob('MXNET_TPU_GUARD_MAX_ROLLBACKS', int, 3,
+          'Rollback budget per run; exhausting it raises'
+          ' GuardrailExhausted instead of looping on a poisoned job.'),
     _knob('MXNET_TPU_ACQUIRE_ATTEMPTS', int, 3,
           'Backend-acquisition retry attempts before degrading to the'
           ' CPU fallback / unavailable status.'),
@@ -206,6 +238,18 @@ def set(name, value):  # noqa: A001 - reference-style API
         value = knob.typ(value)
     with _lock:
         _values[name] = value
+
+
+def unset(name):
+    """Drop a programmatic override so the knob resolves from the
+    environment/default again (set(name, None) pins the VALUE None —
+    this restores precedence instead; tests that scripted a fault via
+    set('MXNET_TPU_FAULT', ...) clean up with this)."""
+    if name not in KNOBS:
+        raise KeyError('unknown config knob %s (see config.describe())'
+                       % name)
+    with _lock:
+        _values.pop(name, None)
 
 
 def describe():

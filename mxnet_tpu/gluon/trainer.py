@@ -54,6 +54,7 @@ class Trainer:
         self._kvstore_params = {'kvstore': kvstore,
                                 'update_on_kvstore': update_on_kvstore}
         self._fused = None  # FusedUpdater once built; False disables
+        self._guardrail = None
         self._reset_kvstore()
 
     def _index_table(self):
@@ -139,10 +140,49 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
 
+    def attach_guardrail(self, guard):
+        """Attach a :class:`mxnet_tpu.guardrail.Guardrail`: every
+        :meth:`step` then runs the eager health sentinel over the
+        gradients BEFORE the optimizer — a non-finite step is skipped
+        with parameters untouched and the dynamic loss scale halved
+        (AMP skip semantics, docs/GUARDRAILS.md). Scale the loss with
+        ``guard.scaler.scale_loss(loss)`` before ``backward()``; step()
+        folds 1/scale into ``rescale_grad`` (exact: powers of two).
+        Incompatible with ``update_on_kvstore=True`` (the server-side
+        optimizer cannot be health-gated or unscaled); step() raises."""
+        self._guardrail = guard
+        self._guard_step = 0
+        return self
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update step: rescale by 1/batch_size,
-        allreduce (dist), apply optimizer (reference: trainer.py:298)."""
-        self._check_and_rescale_grad(self._scale / batch_size)
+        allreduce (dist), apply optimizer (reference: trainer.py:298).
+
+        With a guardrail attached (:meth:`attach_guardrail`), the
+        update is health-gated: overflow ⇒ skip + scale backoff."""
+        guard = self._guardrail
+        if guard is not None:
+            self._ensure_kv()
+            # an in-store optimizer never sees the 1/scale factor and
+            # the scale changing across steps would trip the
+            # rescale-consistency check mid-training — refuse upfront
+            self._forbid_update_on_kvstore('guardrail-gated step()')
+            grads = [p.grad() for p in self._params
+                     if p.grad_req != 'null']
+            # pre-update verdict: scaler backoff happens inside, and a
+            # policy trip raises GuardrailTripped with params untouched
+            step_id = self._guard_step
+            self._guard_step += 1
+            scale_used = guard.scaler.scale
+            if not guard.observe_eager(step_id, grads):
+                for p in self._params:
+                    if p.grad_req != 'null':
+                        p.data()._grad_fresh = False
+                return
+            self._check_and_rescale_grad(
+                self._scale / batch_size / scale_used)
+        else:
+            self._check_and_rescale_grad(self._scale / batch_size)
         self._ensure_kv()
         self._allreduce_grads()
         self._update(ignore_stale_grad)

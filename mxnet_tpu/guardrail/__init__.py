@@ -1,0 +1,49 @@
+"""Numerical guardrails: in-jit health sentinel, dynamic loss scaling,
+anomaly policy, and automatic bad-step rollback/replay.
+
+PR 1 (``resilience/``) made the *process* resilient; this package
+makes the *numbers* resilient. The layers (docs/GUARDRAILS.md):
+
+  * ``sentinel``  — fused all-finite + grad-global-norm reduction
+                    emitted from the compiled step as one packed
+                    scalar, lockstep across the mesh by construction;
+  * ``scaling``   — dynamic loss scaling (power-of-two schedule,
+                    overflow ⇒ halve + skip-update with params
+                    bit-identical; N good steps ⇒ double, capped) —
+                    AMP capability parity with the reference
+                    ``contrib/amp``;
+  * ``anomaly``   — host-side policy: loss/grad-norm z-score over a
+                    rolling window, persistent-non-finite escalation;
+  * ``rollback``  — automatic rollback to the last-good snapshot
+                    (resilience CheckpointManager) with RNG + sampler
+                    rewind and replay, budgeted;
+  * ``report``    — quarantine artifact, schema
+                    ``mxnet_tpu.guardrail.v1``;
+  * ``locate``    — eager NaN-locating mode naming the first op that
+                    produced a non-finite (Monitor-style).
+
+Deterministically testable on CPU: ``MXNET_TPU_FAULT=nan@grads:2``
+poisons exactly two steps' gradients inside the compiled program (a
+step operand, no recompilation), driving the whole skip → trip →
+rollback → replay cycle. ``python -m mxnet_tpu.guardrail`` runs that
+cycle end-to-end as a selftest (tools/fault_smoke.py gates on it).
+"""
+from __future__ import annotations
+
+from .anomaly import (AnomalyPolicy, GuardrailExhausted,
+                      GuardrailTripped, Trip)
+from .guard import Guardrail, GuardrailConfig
+from .rollback import RollbackCoordinator, run_guarded
+from .report import quarantine_record, write_quarantine
+from .scaling import MAX_SCALE, MIN_SCALE, LossScaler
+from .locate import locate_nonfinite_gluon, locate_nonfinite_module
+from . import sentinel, scaling
+
+__all__ = [
+    'AnomalyPolicy', 'GuardrailExhausted', 'GuardrailTripped', 'Trip',
+    'Guardrail', 'GuardrailConfig', 'RollbackCoordinator',
+    'run_guarded', 'quarantine_record', 'write_quarantine',
+    'MAX_SCALE', 'MIN_SCALE', 'LossScaler',
+    'locate_nonfinite_gluon', 'locate_nonfinite_module',
+    'sentinel', 'scaling',
+]

@@ -1,0 +1,124 @@
+"""Host-side anomaly policy over the sentinel stream.
+
+Three tripwires, all deterministic and clock-free (testable with no
+real sleeps):
+
+  * **persistent non-finite** — ``patience`` consecutive unhealthy
+    steps escalates from per-step skipping to a rollback trip (a lone
+    overflow is AMP business-as-usual; a run of them means the params
+    or data are already poisoned);
+  * **loss spike** — z-score of the step loss against a rolling window
+    exceeds ``zscore``;
+  * **grad-norm spike** — same test on the (unscaled) global grad
+    norm.
+
+Unhealthy steps never enter the rolling window (their masked norms
+would drag the baseline), and the z-tests only engage after ``warmup``
+healthy samples so cold-start noise cannot trip them.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+__all__ = ['Trip', 'GuardrailTripped', 'GuardrailExhausted',
+           'AnomalyPolicy']
+
+
+class Trip:
+    """One tripwire firing: what, where, how far over the line."""
+
+    __slots__ = ('reason', 'step', 'value', 'threshold', 'zscore')
+
+    def __init__(self, reason, step, value, threshold, zscore=None):
+        self.reason = reason          # 'persistent-nonfinite' |
+        self.step = int(step)         # 'loss-spike' | 'grad-spike'
+        self.value = float(value)
+        self.threshold = float(threshold)
+        self.zscore = None if zscore is None else float(zscore)
+
+    def as_dict(self):
+        return {'reason': self.reason, 'step': self.step,
+                'value': self.value, 'threshold': self.threshold,
+                'zscore': self.zscore}
+
+    def __str__(self):
+        return ('guardrail trip: %s at step %d (value %.6g, threshold '
+                '%.6g)' % (self.reason, self.step, self.value,
+                           self.threshold))
+
+
+class GuardrailTripped(RuntimeError):
+    """The anomaly policy demands a rollback; carries the Trip and the
+    recent event window for the quarantine report."""
+
+    def __init__(self, trip, events=None):
+        super().__init__(str(trip))
+        self.trip = trip
+        self.events = list(events or [])
+
+
+class GuardrailExhausted(RuntimeError):
+    """Rollback could not proceed (no checkpoint, or the rollback
+    budget is spent): the trip escalates to the caller as a hard
+    failure instead of looping forever on a poisoned run."""
+
+
+def _mean_std(values):
+    n = len(values)
+    mean = sum(values) / n
+    var = sum((v - mean) ** 2 for v in values) / n
+    return mean, var ** 0.5
+
+
+class AnomalyPolicy:
+    """Rolling-window tripwires; pure host math, no numpy/jax needed."""
+
+    def __init__(self, window=64, zscore=6.0, patience=3, warmup=8):
+        self.window = int(window)
+        self.zscore = float(zscore)
+        self.patience = int(patience)
+        self.warmup = int(warmup)
+        self.reset()
+
+    def reset(self):
+        """Forget all rolling state (called after a rollback: the
+        replayed window must not be judged against poisoned history)."""
+        self._losses = deque(maxlen=self.window)
+        self._gnorms = deque(maxlen=self.window)
+        self._bad_streak = 0
+
+    def _spike(self, series, value, step, reason):
+        if len(series) < self.warmup:
+            return None
+        mean, std = _mean_std(series)
+        # std floor: a perfectly flat warmup (synthetic data) must not
+        # make the first off-baseline step an infinite z-score
+        std = max(std, 1e-12, abs(mean) * 1e-6)
+        z = (value - mean) / std
+        if z > self.zscore:
+            return Trip(reason, step, value, mean + self.zscore * std,
+                        zscore=z)
+        return None
+
+    def observe(self, step, healthy, gnorm, loss=None):
+        """Feed one decoded sentinel event; returns a Trip or None."""
+        if not healthy:
+            self._bad_streak += 1
+            if self._bad_streak >= self.patience:
+                return Trip('persistent-nonfinite', step,
+                            self._bad_streak, self.patience)
+            return None
+        self._bad_streak = 0
+        trip = None
+        if loss is not None:
+            trip = self._spike(self._losses, float(loss), step,
+                               'loss-spike')
+        if trip is None and gnorm is not None:
+            trip = self._spike(self._gnorms, float(gnorm), step,
+                               'grad-spike')
+        if trip is None:
+            if loss is not None:
+                self._losses.append(float(loss))
+            if gnorm is not None:
+                self._gnorms.append(float(gnorm))
+        return trip

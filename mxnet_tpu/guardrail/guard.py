@@ -1,0 +1,225 @@
+"""Host-side guardrail driver: config, lazy event stream, policy glue.
+
+The compiled step emits one packed health scalar per step (sentinel);
+this class owns everything the HOST does with it:
+
+  * records the (still-on-device) scalars without forcing a sync —
+    materialisation happens at poll points, so the dispatch pipeline
+    keeps its depth (``check_every=0`` defers all processing to
+    explicit :meth:`flush` calls, e.g. bench loops);
+  * decodes events, keeps a bounded event log, advances skip counters;
+  * feeds the :class:`~.anomaly.AnomalyPolicy` and raises
+    :class:`~.anomaly.GuardrailTripped` when it fires;
+  * arms the deterministic NaN injector (``MXNET_TPU_FAULT``
+    ``nan@grads``) for whichever training path asks.
+
+One Guardrail serves all three training paths: ParallelTrainer (fully
+in-jit), gluon Trainer and Module (eager sentinel via
+``sentinel.eager_grad_health``).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import jax.numpy as jnp
+
+from .anomaly import AnomalyPolicy, GuardrailTripped
+from .scaling import MAX_SCALE, MIN_SCALE, LossScaler
+from . import sentinel
+
+__all__ = ['GuardrailConfig', 'Guardrail']
+
+
+class GuardrailConfig:
+    """Every knob in one bag; ``from_env()`` reads the typed config
+    registry (docs/ENV_VARS.md MXNET_TPU_GUARD* / MXNET_TPU_LOSS_SCALE*
+    entries)."""
+
+    _FIELDS = ('init_scale', 'growth_interval', 'min_scale', 'max_scale',
+               'window', 'zscore', 'patience', 'warmup', 'check_every',
+               'snapshot_every', 'max_rollbacks', 'event_log')
+
+    def __init__(self, init_scale=32768.0, growth_interval=2000,
+                 min_scale=MIN_SCALE, max_scale=MAX_SCALE, window=64,
+                 zscore=6.0, patience=3, warmup=8, check_every=1,
+                 snapshot_every=25, max_rollbacks=3, event_log=128):
+        self.init_scale = float(init_scale)
+        self.growth_interval = int(growth_interval)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+        self.window = int(window)
+        self.zscore = float(zscore)
+        self.patience = int(patience)
+        self.warmup = int(warmup)
+        self.check_every = int(check_every)
+        self.snapshot_every = int(snapshot_every)
+        self.max_rollbacks = int(max_rollbacks)
+        self.event_log = int(event_log)
+
+    @classmethod
+    def from_env(cls, **overrides):
+        from ..config import get as _cfg
+        kwargs = {
+            'init_scale': _cfg('MXNET_TPU_LOSS_SCALE'),
+            'growth_interval': _cfg('MXNET_TPU_LOSS_SCALE_WINDOW'),
+            'window': _cfg('MXNET_TPU_GUARD_WINDOW'),
+            'zscore': _cfg('MXNET_TPU_GUARD_ZSCORE'),
+            'patience': _cfg('MXNET_TPU_GUARD_PATIENCE'),
+            'check_every': _cfg('MXNET_TPU_GUARD_CHECK_EVERY'),
+            'snapshot_every': _cfg('MXNET_TPU_GUARD_SNAPSHOT_EVERY'),
+            'max_rollbacks': _cfg('MXNET_TPU_GUARD_MAX_ROLLBACKS'),
+        }
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    def as_dict(self):
+        return {f: getattr(self, f) for f in self._FIELDS}
+
+
+class Guardrail:
+    """See module docstring. ``injector=None`` uses the process-global
+    ``MXNET_TPU_FAULT`` injector; pass ``FaultInjector('')`` to pin a
+    run fault-free (e.g. an uninterrupted baseline)."""
+
+    def __init__(self, config=None, injector=None):
+        self.config = config or GuardrailConfig()
+        self.policy = AnomalyPolicy(
+            window=self.config.window, zscore=self.config.zscore,
+            patience=self.config.patience, warmup=self.config.warmup)
+        # host mirror scaler: authoritative for the eager paths; for
+        # the jit path it just tracks the device state for reporting
+        self.scaler = LossScaler(
+            init_scale=self.config.init_scale,
+            growth_interval=self.config.growth_interval,
+            min_scale=self.config.min_scale,
+            max_scale=self.config.max_scale)
+        self._injector = injector
+        self.events = deque(maxlen=self.config.event_log)
+        self._pending = deque()
+        self._recorded = 0
+        self.steps = 0
+        self.skips = 0
+        self.trips = 0
+        self.rollbacks = 0
+
+    # -- fault injection ---------------------------------------------------
+
+    def next_poison(self, site='grads'):
+        """Float to fold into this step's gradients: 0.0, or the
+        scripted NaN/Inf (consumes one injector firing)."""
+        from ..resilience.policy import poison
+        return poison(site, injector=self._injector)
+
+    # -- event stream ------------------------------------------------------
+
+    def record(self, step, health, loss=None, scale=None):
+        """Queue one step's (possibly still-on-device) sentinel values.
+
+        With ``check_every=k`` the queue is drained every k-th record —
+        draining materialises the scalars (a host sync for work still
+        in flight) and runs the policy, which may raise
+        :class:`GuardrailTripped`. ``check_every=0`` defers draining to
+        :meth:`flush` so dispatch-pipelined loops keep their depth.
+        """
+        self._pending.append((step, health, loss, scale))
+        self._recorded += 1
+        k = self.config.check_every
+        if k and self._recorded % k == 0:
+            self.poll()
+
+    def poll(self):
+        """Drain the pending queue through the policy. Raises
+        :class:`GuardrailTripped` on a tripwire; the queue keeps its
+        remaining entries so a post-rollback :meth:`reset` clears them
+        explicitly."""
+        while self._pending:
+            step, health, loss, scale = self._pending[0]
+            health = float(health)
+            loss = None if loss is None else float(loss)
+            scale = None if scale is None else float(scale)
+            healthy = health >= 0
+            # emitters unscale the norm before packing (ParallelTrainer
+            # in-jit, observe_eager on the host), so gnorm is the true
+            # parameter-gradient norm regardless of the loss scale
+            gnorm = health if healthy else -health - 1.0
+            if scale is not None:
+                self.scaler.scale = scale   # mirror the device schedule
+            # scale=None marks a path that applies no loss scaling
+            # (Module.fit) — recorded as-is, not backfilled from the
+            # idle scaler
+            event = {'step': int(step), 'healthy': bool(healthy),
+                     'grad_norm': gnorm,
+                     'loss': loss,
+                     'scale': scale,
+                     'action': 'update' if healthy else 'skip'}
+            self._pending.popleft()
+            self.events.append(event)
+            self.steps += 1
+            if not healthy:
+                self.skips += 1
+            trip = self.policy.observe(step, healthy, gnorm, loss)
+            if trip is not None:
+                event['action'] = 'trip'
+                self.trips += 1
+                raise GuardrailTripped(trip, events=list(self.events))
+
+    def flush(self):
+        """Process everything outstanding (sync point)."""
+        self.poll()
+
+    def reset(self):
+        """Post-rollback: drop queued poisoned events and the policy's
+        rolling windows; counters and the event log survive (they feed
+        the quarantine report)."""
+        self._pending.clear()
+        self.policy.reset()
+
+    # -- eager-path sentinel ----------------------------------------------
+
+    def observe_eager(self, step, grads, loss=None, site='grads',
+                      scaled=True):
+        """Sentinel for the eager paths: poison (if scripted), reduce,
+        decode, feed the policy. Returns the verdict — the caller skips
+        its optimizer update on False. May raise
+        :class:`GuardrailTripped` (after the scaler backoff, so a
+        rollback restores a sane scale).
+
+        ``scaled=True`` (gluon Trainer: the user scaled the loss with
+        ``scaler.scale_loss``) unscales the packed norm and advances
+        the scaler schedule. ``scaled=False`` (Module.fit: no loss
+        scaling is applied in that path) records raw norms and leaves
+        the scaler untouched — dividing by a never-applied scale would
+        corrupt the z-score baseline and fire spurious grad-spike
+        trips the first time a skip halves the scale."""
+        poison = self.next_poison(site)
+        if poison != 0.0 and grads:
+            g0 = grads[0]
+            idx = (0,) * len(g0.shape)
+            data = g0._data if hasattr(g0, '_data') else g0
+            data = data.at[idx].add(jnp.asarray(poison).astype(data.dtype))
+            if hasattr(g0, '_data'):
+                g0._data = data
+            else:
+                grads[0] = data
+        health = sentinel.eager_grad_health(grads, loss=loss)
+        healthy = health >= 0
+        if scaled:
+            # unscale the packed norm so the event stream and z-scores
+            # see the true gradient magnitude (exact: power-of-two)
+            gn = (health if healthy else -health - 1.0) / \
+                self.scaler.scale
+            health = gn if healthy else -gn - 1.0
+            self.scaler.update(healthy)
+            rec_scale = self.scaler.scale
+        else:
+            rec_scale = None
+        loss_f = None
+        if loss is not None:
+            loss_f = float(loss.asscalar() if hasattr(loss, 'asscalar')
+                           else loss)
+        self.record(step, health, loss=loss_f, scale=rec_scale)
+        return healthy
+
+    def counters(self):
+        return {'steps': self.steps, 'skips': self.skips,
+                'trips': self.trips, 'rollbacks': self.rollbacks}

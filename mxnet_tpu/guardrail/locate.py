@@ -1,0 +1,86 @@
+"""Eager NaN-locating mode: name the first op that went non-finite.
+
+The sentinel says THAT a step produced a non-finite; this module says
+WHERE. It replays one batch outside the compiled program — under
+``config.NaiveEngineScope`` every op dispatches synchronously un-jitted
+— while tapping intermediates, and returns the first tensor whose
+values are non-finite, in execution order. Monitor-style (the
+reference's ``MXNET_ENGINE_TYPE=NaiveEngine`` + ``Monitor`` debugging
+recipe), packaged as one call for the rollback path's report.
+
+Two taps for the two frontends:
+
+  * gluon blocks — ``register_forward_hook`` on every leaf block;
+  * Module/executor — a :class:`~..monitor.Monitor` with the
+    non-finite stat installed on the bound executor.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ['locate_nonfinite_gluon', 'locate_nonfinite_module']
+
+
+def _first_bad(arrs):
+    """Index of the first array holding a non-finite, else None."""
+    for i, a in enumerate(arrs):
+        vals = a.asnumpy() if hasattr(a, 'asnumpy') else onp.asarray(a)
+        if not onp.isfinite(vals).all():
+            return i
+    return None
+
+
+def locate_nonfinite_gluon(net, *args, loss_fn=None, labels=None):
+    """Run one eager forward (+ optional loss) of a gluon block tree,
+    returning ``'<block name>:out<i>'`` for the first non-finite
+    intermediate, ``'loss'`` if only the loss is bad, else None."""
+    from ..config import NaiveEngineScope
+
+    found = []
+
+    def tap(block, _args, out):
+        if found:
+            return
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        outs = [o for o in outs if hasattr(o, 'asnumpy')]
+        bad = _first_bad(outs)
+        if bad is not None:
+            found.append('%s:out%d' % (getattr(block, 'name', '?'), bad))
+
+    handles = []
+
+    def attach(block):
+        handles.append(block.register_forward_hook(tap))
+
+    net.apply(attach)
+    try:
+        with NaiveEngineScope():
+            out = net(*args)
+            if not found and loss_fn is not None and labels is not None:
+                loss = loss_fn(out, labels)
+                if _first_bad([loss]) is not None:
+                    found.append('loss')
+    finally:
+        for h in handles:
+            h.detach()
+    return found[0] if found else None
+
+
+def locate_nonfinite_module(module, data_batch):
+    """One monitored forward+backward of a bound Module; returns the
+    name of the first non-finite tap (outputs stream in execution
+    order, then weights/grads at toc), else None."""
+    from ..monitor import Monitor, nonfinite_count
+
+    mon = Monitor(interval=1, stat_func=nonfinite_count)
+    module.install_monitor(mon)
+    mon.tic()
+    module.forward_backward(data_batch)
+    for step, name, text in mon.toc():
+        try:
+            bad = float(text.split('\t')[0])
+        except ValueError:          # pragma: no cover - defensive
+            continue
+        if bad > 0:
+            return name
+    return None

@@ -1,0 +1,51 @@
+"""Quarantine report artifact (schema ``mxnet_tpu.guardrail.v1``).
+
+Every guardrail trip that triggers a rollback produces one JSON
+artifact with a FIXED key set (the instrument-artifact discipline of
+``resilience/artifact.py`` applied to numerical incidents), so fleet
+tooling can aggregate incidents without per-run schema sniffing:
+
+    {
+      "schema":    "mxnet_tpu.guardrail.v1",
+      "name":      "<training entry point>",
+      "trip":      {reason, step, value, threshold, zscore},
+      "counters":  {steps, skips, trips, rollbacks},
+      "scale":     <loss scale at trip time>,
+      "resume_step": <step replay restarted from> | null,
+      "located":   null | "<first non-finite tensor name>",
+      "events":    [<last N sentinel events>],
+      "config":    {<GuardrailConfig>}
+    }
+"""
+from __future__ import annotations
+
+__all__ = ['SCHEMA', 'quarantine_record', 'write_quarantine']
+
+SCHEMA = 'mxnet_tpu.guardrail.v1'
+
+_KEYS = ('schema', 'name', 'trip', 'counters', 'scale', 'resume_step',
+         'located', 'events', 'config')
+
+
+def quarantine_record(name, trip, guard, resume_step=None,
+                      located=None):
+    """Build the fixed-shape report dict from a Trip + Guardrail."""
+    rec = {
+        'schema': SCHEMA,
+        'name': name,
+        'trip': trip.as_dict() if hasattr(trip, 'as_dict') else trip,
+        'counters': guard.counters(),
+        'scale': guard.scaler.scale,
+        'resume_step': None if resume_step is None else int(resume_step),
+        'located': located,
+        'events': list(guard.events),
+        'config': guard.config.as_dict(),
+    }
+    assert tuple(rec) == _KEYS
+    return rec
+
+
+def write_quarantine(path, record):
+    """Atomic JSON write via the resilience artifact protocol."""
+    from ..resilience.artifact import write_artifact
+    return write_artifact(path, record)

@@ -1,0 +1,127 @@
+"""Automatic bad-step rollback/replay over PR 1's CheckpointManager.
+
+Contract (docs/GUARDRAILS.md):
+
+  * every ``snapshot_every`` steps, IF the guardrail event stream is
+    clean up to that point (``flush()`` is forced first — a snapshot
+    must never capture state a queued event would have condemned), the
+    coordinator captures a **last-good** snapshot: model/optimizer
+    state from the caller's ``capture()`` plus the global RNG chain and
+    the step index (the sampler cursor — data order is a deterministic
+    function of the step in every driver here);
+  * on a :class:`GuardrailTripped`, :meth:`rollback` restores the
+    newest valid snapshot through the caller's ``restore()``, rewinds
+    the RNG chain, resets the guardrail's rolling state, writes the
+    quarantine report, and returns the step to replay from;
+  * the rollback budget (``max_rollbacks``) converts a non-healing
+    incident into a loud :class:`GuardrailExhausted` instead of an
+    infinite quarantine loop.
+
+Everything is clock-free and injectable: snapshots go through the
+atomic CheckpointManager, faults through ``MXNET_TPU_FAULT``, so the
+whole skip → trip → rollback → replay cycle runs deterministically on
+CPU in tests (no real sleeps, fake clocks only).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from .anomaly import GuardrailExhausted, GuardrailTripped
+from .report import quarantine_record, write_quarantine
+
+__all__ = ['RollbackCoordinator', 'run_guarded']
+
+
+class RollbackCoordinator:
+    """Snapshot/rollback bookkeeping for one guarded training run."""
+
+    def __init__(self, manager, guard, name='train',
+                 snapshot_every=None, max_rollbacks=None,
+                 report_path=None):
+        self.manager = manager            # resilience CheckpointManager
+        self.guard = guard
+        self.name = name
+        cfg = guard.config
+        self.snapshot_every = int(snapshot_every or cfg.snapshot_every)
+        self.max_rollbacks = int(max_rollbacks if max_rollbacks
+                                 is not None else cfg.max_rollbacks)
+        self.report_path = report_path or os.path.join(
+            manager.directory, 'QUARANTINE.json')
+        self.last_report = None
+
+    def due(self, step):
+        return step % self.snapshot_every == 0
+
+    def maybe_snapshot(self, step, capture):
+        """Snapshot at the cadence — after flushing the guardrail, so a
+        pending bad event trips BEFORE the poisoned state is blessed as
+        last-good. ``capture()`` returns the caller's state dict."""
+        if not self.due(step):
+            return None
+        self.guard.flush()                 # may raise GuardrailTripped
+        from .. import random as _random
+        state = dict(capture())
+        state['step'] = int(step)
+        state['rng'] = _random.get_state()
+        return self.manager.save(step, state)
+
+    def rollback(self, trip, restore, located=None):
+        """Restore the newest last-good snapshot; returns the step to
+        replay from. Raises :class:`GuardrailExhausted` when no valid
+        snapshot exists or the budget is spent."""
+        t = trip.trip if isinstance(trip, GuardrailTripped) else trip
+        if self.guard.rollbacks >= self.max_rollbacks:
+            raise GuardrailExhausted(
+                'guardrail rollback budget (%d) spent; last trip: %s'
+                % (self.max_rollbacks, t))
+        latest = self.manager.latest()
+        if latest is None:
+            raise GuardrailExhausted(
+                'guardrail tripped (%s) before any last-good snapshot '
+                'existed — cannot roll back' % t)
+        step, state = latest
+        self.guard.rollbacks += 1
+        self.last_report = write_quarantine(
+            self.report_path,
+            quarantine_record(self.name, t, self.guard,
+                              resume_step=step, located=located))
+        from .. import random as _random
+        if state.get('rng') is not None:
+            _random.set_state(state['rng'])
+        restore(state)
+        self.guard.reset()
+        logging.warning(
+            'guardrail: %s — rolled back to last-good step %d '
+            '(rollback %d/%d), quarantine report at %s',
+            t, step, self.guard.rollbacks, self.max_rollbacks,
+            self.report_path)
+        return int(state.get('step', step))
+
+
+def run_guarded(nsteps, step_fn, guard, coordinator=None, capture=None,
+                start=0, restore=None):
+    """Drive ``step_fn(i)`` for ``i in [start, nsteps)`` under the full
+    skip → trip → rollback → replay contract.
+
+    ``step_fn`` must raise :class:`GuardrailTripped` through the guard
+    (ParallelTrainer.step does this natively; eager loops call
+    ``guard.observe_eager``). ``capture()``/``restore(state)`` are the
+    caller's state (de)hydrators — ParallelTrainer.snapshot/restore fit
+    directly. Data order must be a deterministic function of ``i``
+    (sampler-rewind contract). Returns the number of rollbacks taken.
+    """
+    i = start
+    while True:
+        try:
+            while i < nsteps:
+                if coordinator is not None and capture is not None:
+                    coordinator.maybe_snapshot(i, capture)
+                step_fn(i)
+                i += 1
+            guard.flush()              # trailing queued events
+            return guard.rollbacks
+        except GuardrailTripped as trip:
+            if coordinator is None or restore is None:
+                raise
+            i = coordinator.rollback(trip, restore)

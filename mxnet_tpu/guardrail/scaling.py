@@ -1,0 +1,109 @@
+"""Dynamic loss scaling (AMP parity with the reference contrib/amp).
+
+Schedule (the reference's ``DynamicLossScaler`` and torch GradScaler
+use the same shape):
+
+  * overflow (sentinel unhealthy)  ⇒  scale ← max(scale/2, MIN_SCALE),
+    good-step counter resets, the optimizer update is SKIPPED with
+    params/states bit-identical;
+  * ``growth_interval`` consecutive healthy steps  ⇒  scale ←
+    min(scale*2, MAX_SCALE), counter resets.
+
+Scale moves only by powers of two, so scaling the loss and folding
+1/scale into ``rescale_grad`` is EXACT in f32/bf16 (exponent-only
+arithmetic): guardrail-on and guardrail-off runs are bit-identical on
+healthy steps, not merely close.
+
+Two implementations of the same math, kept in one file so they cannot
+drift: :func:`update_scale` (traced scalars, lives inside the compiled
+step) and :class:`LossScaler` (host floats, for the eager gluon
+Trainer / Module paths).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ['MIN_SCALE', 'MAX_SCALE', 'init_scale_state', 'update_scale',
+           'LossScaler']
+
+MIN_SCALE = 1.0
+MAX_SCALE = float(2 ** 24)
+
+
+def init_scale_state(init_scale):
+    """(scale f32, consecutive-good-steps i32) as host scalars; the
+    jit path device_puts them replicated, the eager path keeps floats."""
+    return float(init_scale), 0
+
+
+def update_scale(scale, good, healthy, growth_interval,
+                 min_scale=MIN_SCALE, max_scale=MAX_SCALE):
+    """One traced schedule step; returns (new_scale, new_good).
+
+    ``healthy`` is the decoded sentinel verdict (traced bool). Pure
+    ``jnp.where`` — no host value needed, so the decision stays inside
+    the compiled step and in lockstep across the mesh.
+    """
+    good = jnp.where(healthy, good + 1, 0)
+    grow = good >= growth_interval
+    scale = jnp.where(
+        healthy,
+        jnp.where(grow, jnp.minimum(scale * 2.0, max_scale), scale),
+        jnp.maximum(scale * 0.5, min_scale))
+    good = jnp.where(grow, jnp.int32(0), good)
+    return scale.astype(jnp.float32), good.astype(jnp.int32)
+
+
+class LossScaler:
+    """Host mirror of :func:`update_scale` for the eager paths.
+
+    Usage (gluon)::
+
+        scaler = LossScaler()
+        with autograd.record():
+            loss = scaler.scale_loss(loss_fn(net(x), y))
+        loss.backward()
+        trainer.step(batch)      # trainer folds 1/scale into rescale
+
+    The trainer (with a guardrail attached) calls :meth:`update` with
+    the sentinel verdict each step; a skipped step never touches
+    parameters, matching the compiled path's ``lax.cond`` semantics.
+    """
+
+    def __init__(self, init_scale=None, growth_interval=2000,
+                 min_scale=MIN_SCALE, max_scale=MAX_SCALE):
+        if init_scale is None:
+            from ..config import get as _cfg
+            init_scale = _cfg('MXNET_TPU_LOSS_SCALE')
+        self.scale = float(init_scale)
+        self.growth_interval = int(growth_interval)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+        self.good_steps = 0
+
+    def scale_loss(self, loss):
+        """Multiply a loss (NDArray or array) by the current scale."""
+        return loss * self.scale
+
+    @property
+    def unscale(self):
+        return 1.0 / self.scale
+
+    def update(self, healthy):
+        """Advance the schedule; returns ``healthy`` for chaining."""
+        if healthy:
+            self.good_steps += 1
+            if self.good_steps >= self.growth_interval:
+                self.scale = min(self.scale * 2.0, self.max_scale)
+                self.good_steps = 0
+        else:
+            self.scale = max(self.scale * 0.5, self.min_scale)
+            self.good_steps = 0
+        return healthy
+
+    def state_dict(self):
+        return {'scale': self.scale, 'good_steps': self.good_steps}
+
+    def load_state_dict(self, state):
+        self.scale = float(state['scale'])
+        self.good_steps = int(state['good_steps'])

@@ -103,7 +103,8 @@ class FeedForward:
     def fit(self, X, y=None, eval_data=None, eval_metric='acc',
             epoch_end_callback=None, batch_end_callback=None,
             kvstore='local', logger=None, work_load_list=None, monitor=None,
-            eval_end_callback=None, eval_batch_end_callback=None):
+            eval_end_callback=None, eval_batch_end_callback=None,
+            checkpoint_dir=None, guardrail=None):
         from .module import Module
         from . import initializer as init_mod
         mod = Module(self._symbol, context=self._ctx)
@@ -119,7 +120,11 @@ class FeedForward:
                 initializer=self._initializer or init_mod.Uniform(0.01),
                 arg_params=self._arg_params, aux_params=self._aux_params,
                 begin_epoch=self._begin_epoch, num_epoch=self._num_epoch,
-                monitor=monitor)
+                monitor=monitor,
+                # resilience + guardrail passthrough: old FeedForward
+                # scripts get checkpoint-resume and numerical guarding
+                # with two kwargs (docs/GUARDRAILS.md)
+                checkpoint_dir=checkpoint_dir, guardrail=guardrail)
         return self
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):

@@ -178,7 +178,8 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None, checkpoint_dir=None):
+            monitor=None, sparse_row_id_fn=None, checkpoint_dir=None,
+            guardrail=None, locate_nonfinite=False):
         """The training driver (reference: base_module.py:409).
 
         ``checkpoint_dir`` opts into crash-resumable training: each
@@ -187,6 +188,19 @@ class BaseModule:
         directory with checkpoints resumes from the newest valid one
         instead of epoch ``begin_epoch`` — an interrupted job re-run
         with the same command continues where it stopped.
+
+        ``guardrail`` opts into numerical guarding
+        (docs/GUARDRAILS.md): pass True / a GuardrailConfig / a
+        Guardrail. Each batch's gradients run through the eager health
+        sentinel BEFORE update() — a non-finite batch skips the update
+        with parameters untouched; a policy trip (persistent
+        non-finite, loss/grad spike) rolls back to the newest
+        epoch-boundary checkpoint (requires ``checkpoint_dir``),
+        rewinds the RNG chain, resets the data iterator (the sampler
+        cursor is the epoch index), writes a quarantine report next to
+        the checkpoints, and replays. ``locate_nonfinite=True``
+        additionally re-runs the tripping batch through the monitored
+        eager locator to name the first non-finite op in the report.
         """
         if num_epoch is None:
             raise AssertionError('please specify number of epochs')
@@ -210,49 +224,84 @@ class BaseModule:
             resumed = ckpt_mgr.latest()
             if resumed is not None:
                 ck_epoch, state = resumed
-                self.set_params(
-                    {k: nd.array(v) for k, v in state['arg_params'].items()},
-                    {k: nd.array(v) for k, v in state['aux_params'].items()})
-                updater = getattr(self, '_updater', None)
-                if updater is not None and state.get('optimizer'):
-                    updater.set_states(state['optimizer'])
+                self._restore_fit_state(state)
                 begin_epoch = ck_epoch + 1
                 self.logger.info(
                     'Resumed from checkpoint epoch %d in %s; continuing '
                     'at epoch %d', ck_epoch, checkpoint_dir, begin_epoch)
 
+        guard = None
+        if guardrail:
+            from ..guardrail import Guardrail, GuardrailConfig
+            if isinstance(guardrail, Guardrail):
+                guard = guardrail
+            elif isinstance(guardrail, GuardrailConfig):
+                guard = Guardrail(guardrail)
+            else:
+                guard = Guardrail(GuardrailConfig.from_env())
+        guard_step = 0
+
         validation_metric = validation_metric or eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
+        from ..guardrail.anomaly import GuardrailTripped
+        epoch = begin_epoch
+        while epoch < num_epoch:
             t_start = time.time()
             eval_metric.reset()
             nbatch = 0
             feed = iter(train_data)
             batch = next(feed)
             done = False
-            while not done:
-                if monitor:
-                    monitor.tic()
-                self.forward_backward(batch)
-                self.update()
-                self._feed_metric(eval_metric, batch)
-                # lookahead: prepare() must see the NEXT batch before it
-                # is consumed (sparse row pull in the reference; bucket
-                # switch + dispatch warmup here)
-                nxt = next(feed, _END)
-                if nxt is _END:
-                    done = True
-                    epoch_summary = eval_metric.get_global_name_value()
-                else:
-                    self.prepare(nxt, sparse_row_id_fn=sparse_row_id_fn)
-                if monitor:
-                    monitor.toc_print()
-                _fire(batch_end_callback, epoch=epoch, nbatch=nbatch,
-                      eval_metric=eval_metric, locals=locals())
-                batch = nxt
-                nbatch += 1
+            try:
+                while not done:
+                    if monitor:
+                        monitor.tic()
+                    self.forward_backward(batch)
+                    if guard is not None:
+                        # health-gate the optimizer: a non-finite batch
+                        # is skipped with params untouched; a policy
+                        # trip raises into the rollback handler below
+                        try:
+                            # scaled=False: this path applies no loss
+                            # scaling, so norms must not be divided by
+                            # the (idle) scaler
+                            healthy = guard.observe_eager(
+                                guard_step, self._guard_grads()
+                                if hasattr(self, '_guard_grads') else [],
+                                scaled=False)
+                        except GuardrailTripped:
+                            self._last_bad_batch = batch
+                            raise
+                        guard_step += 1
+                        if healthy:
+                            self.update()
+                    else:
+                        self.update()
+                    self._feed_metric(eval_metric, batch)
+                    # lookahead: prepare() must see the NEXT batch
+                    # before it is consumed (sparse row pull in the
+                    # reference; bucket switch + dispatch warmup here)
+                    nxt = next(feed, _END)
+                    if nxt is _END:
+                        done = True
+                        epoch_summary = \
+                            eval_metric.get_global_name_value()
+                    else:
+                        self.prepare(nxt,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    if monitor:
+                        monitor.toc_print()
+                    _fire(batch_end_callback, epoch=epoch, nbatch=nbatch,
+                          eval_metric=eval_metric, locals=locals())
+                    batch = nxt
+                    nbatch += 1
+            except GuardrailTripped as trip:
+                epoch = self._guard_rollback(trip, guard, ckpt_mgr,
+                                             train_data,
+                                             locate_nonfinite)
+                continue
 
             for name, val in epoch_summary:
                 self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
@@ -263,6 +312,7 @@ class BaseModule:
             arg_params, aux_params = self.get_params()
             self.set_params(arg_params, aux_params)
             if ckpt_mgr is not None:
+                from .. import random as random_mod
                 updater = getattr(self, '_updater', None)
                 ckpt_mgr.save(epoch, {
                     'epoch': epoch,
@@ -275,7 +325,9 @@ class BaseModule:
                     # position) must survive resume, not just the
                     # per-index state arrays
                     'optimizer': updater.get_states(dump_optimizer=True)
-                    if updater is not None else None})
+                    if updater is not None else None,
+                    # rollback rewinds the RNG chain along with params
+                    'rng': random_mod.get_state()})
             for cb in _as_list(epoch_end_callback):
                 cb(epoch, self.symbol, arg_params, aux_params)
 
@@ -288,6 +340,49 @@ class BaseModule:
                     self.logger.info('Epoch[%d] Validation-%s=%f', epoch,
                                      name, val)
             train_data.reset()
+            epoch += 1
+
+    def _restore_fit_state(self, state):
+        """Load an epoch-boundary fit checkpoint (params + optimizer
+        counters + RNG chain) back into this module."""
+        self.set_params(
+            {k: nd.array(v) for k, v in state['arg_params'].items()},
+            {k: nd.array(v) for k, v in state['aux_params'].items()})
+        updater = getattr(self, '_updater', None)
+        if updater is not None and state.get('optimizer'):
+            updater.set_states(state['optimizer'])
+        if state.get('rng') is not None:
+            from .. import random as random_mod
+            random_mod.set_state(state['rng'])
+
+    def _guard_rollback(self, trip, guard, ckpt_mgr, train_data,
+                        locate_nonfinite):
+        """Roll a tripped fit back to the newest epoch-boundary
+        checkpoint and return the epoch to replay from. Delegates the
+        rollback contract (budget, quarantine report, RNG rewind,
+        guard reset) to ``guardrail.RollbackCoordinator`` over fit's
+        own checkpoint manager — only the epoch-cursor translation and
+        the data-iterator reset are fit-specific."""
+        from ..guardrail import RollbackCoordinator
+        from ..guardrail.anomaly import GuardrailExhausted
+        if ckpt_mgr is None:
+            raise GuardrailExhausted(
+                'guardrail tripped (%s) but fit() has no '
+                'checkpoint_dir to roll back to' % trip.trip) from trip
+        located = None
+        if locate_nonfinite and \
+                getattr(self, '_last_bad_batch', None) is not None:
+            from ..guardrail.locate import locate_nonfinite_module
+            try:
+                located = locate_nonfinite_module(
+                    self, self._last_bad_batch)
+            except Exception:   # locating is best-effort diagnostics
+                located = None
+        coord = RollbackCoordinator(ckpt_mgr, guard, name='module.fit')
+        ck_epoch = coord.rollback(trip, self._restore_fit_state,
+                                  located=located)
+        train_data.reset()   # sampler rewind: the cursor is the epoch
+        return ck_epoch + 1
 
     # -- param persistence -------------------------------------------------
 

@@ -386,6 +386,13 @@ class Module(BaseModule):
             weight = self._exec.arg_dict[name]
             self._updater(i, grad, weight)
 
+    def _guard_grads(self):
+        """Current gradient arrays, for the guardrail's eager sentinel
+        (BaseModule.fit(guardrail=...) health-gates update() on these)."""
+        self._require(bound=True, initialized=True)
+        return [g for g in (self._exec.grad_dict.get(n)
+                            for n in self._param_names) if g is not None]
+
     def get_outputs(self, merge_multi_context=True):
         self._require(bound=True)
         return self._exec.outputs

@@ -14,12 +14,23 @@ import re
 
 from .ndarray import NDArray
 
-__all__ = ['Monitor']
+__all__ = ['Monitor', 'nonfinite_count']
 
 
 def _default_stat(x):
     """RMS magnitude |x|_2 / sqrt(size) — the reference's asum_stat."""
     return x.norm() / (x.size ** 0.5)
+
+
+def nonfinite_count(x):
+    """Number of NaN/Inf entries — the guardrail's NaN-locating stat
+    (guardrail/locate.py): install with interval=1 and the first tap
+    reporting > 0 names the op that went non-finite."""
+    from .ndarray import array
+    import numpy as onp
+    vals = x.asnumpy()
+    return array(onp.asarray(
+        [float(onp.size(vals) - onp.isfinite(vals).sum())]))
 
 
 def _render(value):

@@ -11,6 +11,18 @@ contrib/sync_batch_norm.cc).
 The optimizer update is built by tracing the optimizer's OWN update() code
 (same machinery as optimizer.fused.FusedUpdater), so the full optimizer zoo
 runs under the mesh — not a hardcoded sgd/adam pair.
+
+Numerical guardrails (docs/GUARDRAILS.md): with ``guardrail=`` enabled the
+SAME compiled program also (a) scales the loss by the dynamic loss scale,
+(b) reduces an all-finite + grad-global-norm sentinel into one packed
+replicated scalar — fused by XLA into the backward, no extra pass and no
+host transfer — and (c) guards the optimizer update behind ``lax.cond`` on
+the verdict: an overflow step leaves params and optimizer state
+bit-identical, halves the scale, and surfaces a skip event; the host-side
+anomaly policy escalates persistent/spiking behavior to a checkpoint
+rollback (guardrail/rollback.py). The skip/scale decision is computed on
+the LOGICAL gradients, so every replica takes the same branch in lockstep
+by construction.
 """
 from __future__ import annotations
 
@@ -71,6 +83,23 @@ def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _resolve_guardrail(guardrail):
+    """None → env knob; True/config → fresh Guardrail; instance → it."""
+    from ..guardrail import Guardrail, GuardrailConfig
+    if guardrail is None:
+        from ..config import get as _cfg
+        if not _cfg('MXNET_TPU_GUARDRAIL'):
+            return None
+        guardrail = True
+    if guardrail is False:
+        return None
+    if guardrail is True:
+        return Guardrail(GuardrailConfig.from_env())
+    if isinstance(guardrail, GuardrailConfig):
+        return Guardrail(guardrail)
+    return guardrail
+
+
 class ParallelTrainer:
     """Gluon-style trainer whose step is ONE pjit-compiled program.
 
@@ -89,13 +118,18 @@ class ParallelTrainer:
     the optimizer's own update() with traced lr/wd/t/rescale scalars (the
     FusedUpdater machinery), under the parameter shardings.
 
+    ``guardrail`` opts into the in-jit numerical guardrail (see module
+    docstring): None reads ``MXNET_TPU_GUARDRAIL``; True/GuardrailConfig
+    builds a fresh :class:`~mxnet_tpu.guardrail.Guardrail`; an instance is
+    used as-is (drivers share one across trainers for unified reporting).
+
     vs gluon.Trainer (eager, op-at-a-time): this compiles forward+backward+
     allreduce+update into one XLA program — the CachedOp-static_alloc analog
     extended through the optimizer (reference fuses at best per-op).
     """
 
     def __init__(self, net, loss, optimizer='sgd', optimizer_params=None,
-                 mesh=None, rules=None):
+                 mesh=None, rules=None, guardrail=None):
         from ..optimizer import optimizer as _optmod
         self._net = net
         self._loss = loss
@@ -107,6 +141,8 @@ class ParallelTrainer:
                 optimizer, **self._opt_params)
         else:
             self._opt = optimizer
+        self._guard = _resolve_guardrail(guardrail)
+        self._gstate = None
         self._jitted = None
         self._params = None
         self._param_arrays = None
@@ -121,6 +157,11 @@ class ParallelTrainer:
         opt = self._opt
         return opt.lr_scheduler(self.num_update) if opt.lr_scheduler \
             else opt.lr
+
+    @property
+    def guardrail(self):
+        """The attached host-side Guardrail (None when disabled)."""
+        return self._guard
 
     def set_learning_rate(self, lr):
         self._opt.set_learning_rate(lr)
@@ -181,6 +222,23 @@ class ParallelTrainer:
             templates.append(_flatten_state(st, leaves))
         self._templates = templates
         leaf_arrays = tuple(l._data for l in leaves)
+        skip_idx = {i for i in range(n) if params[i].grad_req == 'null'}
+
+        def run_update(key, lrs, wds, ts, rescale_eff, param_arrays,
+                       state_leaves, grads, auxs):
+            """Traced optimizer application + BN-aux merge (shared by
+            the plain step and the guarded step's healthy branch)."""
+            with _random.key_override(key), \
+                    _HyperPatch(opt, indices, lrs, wds, ts, rescale_eff):
+                new_params, new_leaves = apply_traced_updates(
+                    opt, indices, list(param_arrays), list(grads),
+                    templates, list(state_leaves), skip=skip_idx)
+            aux_idx = {id(p): i for i, p in enumerate(params)}
+            for p, a in zip(meta.get('aux_params', []), auxs):
+                i = aux_idx.get(id(p))
+                if i is not None:
+                    new_params[i] = a.astype(new_params[i].dtype)
+            return tuple(new_params), tuple(new_leaves)
 
         def step(key, hyper, param_arrays, state_leaves, data_arrays,
                  label_arrays):
@@ -188,23 +246,66 @@ class ParallelTrainer:
             (loss, auxs), grads = jax.value_and_grad(
                 lambda ps: loss_of(key, ps, data_arrays, label_arrays),
                 has_aux=True)(tuple(param_arrays))
-            skip = {i for i in range(n) if params[i].grad_req == 'null'}
-            with _random.key_override(key), \
-                    _HyperPatch(opt, indices, lrs, wds, ts, rescale):
-                new_params, new_leaves = apply_traced_updates(
-                    opt, indices, list(param_arrays), list(grads),
-                    templates, list(state_leaves), skip=skip)
-            aux_idx = {id(p): i for i, p in enumerate(params)}
-            for p, a in zip(meta.get('aux_params', []), auxs):
-                i = aux_idx.get(id(p))
-                if i is not None:
-                    new_params[i] = a.astype(new_params[i].dtype)
-            return tuple(new_params), tuple(new_leaves), loss
+            new_params, new_leaves = run_update(
+                key, lrs, wds, ts, rescale, param_arrays, state_leaves,
+                grads, auxs)
+            return new_params, new_leaves, loss
+
+        def guarded_step(key, hyper, guard_in, param_arrays, state_leaves,
+                         data_arrays, label_arrays):
+            """step() + loss scaling + fused sentinel + cond-guarded
+            update. Extra outputs: (packed health, scale, good-steps) —
+            all replicated scalars, no host transfer."""
+            from ..guardrail import scaling as _scaling
+            from ..guardrail import sentinel as _sentinel
+            cfg = self._guard.config
+            lrs, wds, ts, rescale = hyper
+            poison, scale, good = guard_in
+
+            def scaled_loss(ps):
+                l, auxs = loss_of(key, ps, data_arrays, label_arrays)
+                return l * scale, (l, auxs)
+
+            (_, (loss, auxs)), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(tuple(param_arrays))
+            grads = _sentinel.poison_grads(list(grads), poison)
+            # overflow detection on the SCALED grads; norm unscaled
+            # before it leaves the program (exact: power-of-two scale)
+            health = _sentinel.grad_health(grads, loss=loss)
+            healthy = health >= 0
+            inv = jnp.float32(1.0) / scale
+            new_params, new_leaves = jax.lax.cond(
+                healthy,
+                lambda ops: run_update(key, lrs, wds, ts, rescale * inv,
+                                       ops[0], ops[1], grads, auxs),
+                # skip branch: params, optimizer state AND BatchNorm
+                # moving stats stay bit-identical — the whole batch is
+                # quarantined, matching AMP skip semantics
+                lambda ops: (tuple(ops[0]), tuple(ops[1])),
+                (tuple(param_arrays), tuple(state_leaves)))
+            new_scale, new_good = _scaling.update_scale(
+                scale, good, healthy,
+                growth_interval=cfg.growth_interval,
+                min_scale=cfg.min_scale, max_scale=cfg.max_scale)
+            return (new_params, new_leaves, loss,
+                    (_sentinel.rescale_packed(health, inv), new_scale,
+                     new_good))
 
         hyper0 = self._hyper(indices, opt, advance=False)
+        guard0 = None
+        if self._guard is not None:
+            guard0 = (onp.float32(0.0),
+                      onp.float32(self._guard.config.init_scale),
+                      onp.int32(0))
         # abstract probe fills meta['aux_params'] without running compute
-        jax.eval_shape(step, jax.random.PRNGKey(0), hyper0,
-                       param_arrays, leaf_arrays, tuple(xs_live), tuple(ys))
+        if self._guard is None:
+            jax.eval_shape(step, jax.random.PRNGKey(0), hyper0,
+                           param_arrays, leaf_arrays, tuple(xs_live),
+                           tuple(ys))
+        else:
+            jax.eval_shape(guarded_step, jax.random.PRNGKey(0), hyper0,
+                           guard0, param_arrays, leaf_arrays,
+                           tuple(xs_live), tuple(ys))
 
         param_shardings = tuple(infer_param_sharding(params, mesh,
                                                      self._rules))
@@ -241,12 +342,30 @@ class ParallelTrainer:
         label_shardings = tuple(dshard(a) for a in ys)
         self._sig = (none_pat, len(ys))
 
-        self._jitted = jax.jit(
-            step,
-            in_shardings=(repl, (repl, repl, repl, repl), param_shardings,
-                          leaf_shardings, data_shardings, label_shardings),
-            out_shardings=(param_shardings, leaf_shardings, repl),
-            donate_argnums=(2, 3))
+        if self._guard is None:
+            self._jitted = jax.jit(
+                step,
+                in_shardings=(repl, (repl, repl, repl, repl),
+                              param_shardings, leaf_shardings,
+                              data_shardings, label_shardings),
+                out_shardings=(param_shardings, leaf_shardings, repl),
+                donate_argnums=(2, 3))
+            self._step_fn = step
+        else:
+            self._jitted = jax.jit(
+                guarded_step,
+                in_shardings=(repl, (repl, repl, repl, repl),
+                              (repl, repl, repl), param_shardings,
+                              leaf_shardings, data_shardings,
+                              label_shardings),
+                out_shardings=(param_shardings, leaf_shardings, repl,
+                               (repl, repl, repl)),
+                donate_argnums=(3, 4))
+            self._step_fn = guarded_step
+            self._gstate = (
+                jax.device_put(onp.float32(self._guard.config.init_scale),
+                               repl),
+                jax.device_put(onp.int32(0), repl))
         self._param_arrays = tuple(
             jax.device_put(w, sh) for w, sh in zip(param_arrays,
                                                    param_shardings))
@@ -254,7 +373,10 @@ class ParallelTrainer:
             jax.device_put(a, sh) for a, sh in zip(leaf_arrays,
                                                    leaf_shardings))
         self._data_shardings = (data_shardings, label_shardings)
-        self._step_fn = step
+        self._abstract_io = (
+            tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                  for a in xs_live),
+            tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in ys))
         self._shardings = (repl, param_shardings, leaf_shardings,
                            data_shardings, label_shardings)
         self._jitted_multi = None
@@ -265,39 +387,81 @@ class ParallelTrainer:
         shapes) — the launch/dispatch overhead (per-launch ~5 ms on
         tunneled backends) amortizes across the scan. Per-step hyper
         arrays are stacked operands, so lr schedules and Adam bias
-        correction advance exactly as in the single-step path."""
+        correction advance exactly as in the single-step path. With the
+        guardrail on, the loss-scale state threads through the scan
+        carry and per-step poison/health/scale ride the stacked
+        operands/outputs."""
         step = self._step_fn
         repl, param_sh, leaf_sh, data_sh, label_sh = self._shardings
-
-        def multi(keys, hypers, param_arrays, state_leaves, xs, ys):
-            def body(carry, inp):
-                ps, ls = carry
-                key, hyper, x, y = inp
-                p2, l2, loss = step(key, hyper, ps, ls, x, y)
-                return (p2, l2), loss
-            (ps, ls), losses = jax.lax.scan(
-                body, (param_arrays, state_leaves), (keys, hypers, xs, ys))
-            return ps, ls, losses
 
         def lead(sh):
             return NamedSharding(sh.mesh, P(None, *sh.spec))
 
+        if self._guard is None:
+            def multi(keys, hypers, param_arrays, state_leaves, xs, ys):
+                def body(carry, inp):
+                    ps, ls = carry
+                    key, hyper, x, y = inp
+                    p2, l2, loss = step(key, hyper, ps, ls, x, y)
+                    return (p2, l2), loss
+                (ps, ls), losses = jax.lax.scan(
+                    body, (param_arrays, state_leaves),
+                    (keys, hypers, xs, ys))
+                return ps, ls, losses
+
+            return jax.jit(
+                multi,
+                in_shardings=(repl, (repl, repl, repl, repl), param_sh,
+                              leaf_sh, tuple(lead(s) for s in data_sh),
+                              tuple(lead(s) for s in label_sh)),
+                out_shardings=(param_sh, leaf_sh, repl),
+                donate_argnums=(2, 3))
+
+        def multi_g(keys, hypers, poisons, gstate, param_arrays,
+                    state_leaves, xs, ys):
+            def body(carry, inp):
+                ps, ls, sc, gd = carry
+                key, hyper, poi, x, y = inp
+                p2, l2, loss, (health, sc2, gd2) = step(
+                    key, hyper, (poi, sc, gd), ps, ls, x, y)
+                return (p2, l2, sc2, gd2), (loss, health, sc2)
+            (ps, ls, sc, gd), (losses, healths, scales) = jax.lax.scan(
+                body, (param_arrays, state_leaves) + tuple(gstate),
+                (keys, hypers, poisons, xs, ys))
+            return ps, ls, (sc, gd), losses, healths, scales
+
         return jax.jit(
-            multi,
-            in_shardings=(repl, (repl, repl, repl, repl), param_sh,
-                          leaf_sh, tuple(lead(s) for s in data_sh),
+            multi_g,
+            in_shardings=(repl, (repl, repl, repl, repl), repl,
+                          (repl, repl), param_sh, leaf_sh,
+                          tuple(lead(s) for s in data_sh),
                           tuple(lead(s) for s in label_sh)),
-            out_shardings=(param_sh, leaf_sh, repl),
-            donate_argnums=(2, 3))
+            out_shardings=(param_sh, leaf_sh, (repl, repl), repl, repl,
+                           repl),
+            donate_argnums=(4, 5))
+
+    def _normalize(self, x, y):
+        xs = [a._data if isinstance(a, NDArray) else
+              (None if a is None else jnp.asarray(a)) for a in _as_list(x)]
+        ys = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+              for a in _as_list(y)]
+        return xs, ys
+
+    def build(self, x, y):
+        """Compile the step for these operand shapes without running it.
+
+        Guarded drivers prime here so a step-0 last-good snapshot can be
+        taken before any batch — and any scripted fault — is consumed."""
+        xs, ys = self._normalize(x, y)
+        if self._jitted is None:
+            self._build(xs, ys)
+        return self
 
     def step_n(self, x, y):
         """Run one fused step per leading-dim slice of ``x``/``y`` in a
         SINGLE compiled program; returns the per-step losses as one
         array. Semantically identical to calling step() n times."""
-        xs = [a._data if isinstance(a, NDArray) else
-              (None if a is None else jnp.asarray(a)) for a in _as_list(x)]
-        ys = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
-              for a in _as_list(y)]
+        xs, ys = self._normalize(x, y)
         live = [a for a in xs if a is not None]
         if not live or not ys:
             raise ValueError('step_n needs at least one data and one '
@@ -335,12 +499,33 @@ class ParallelTrainer:
         if self._jitted_multi is None:
             self._jitted_multi = self._build_multi()
         jitted = self._jitted_multi
-        self._param_arrays, self._state_leaves, losses = jitted(
-            keys, stacked, self._param_arrays, self._state_leaves,
-            tuple(xs), tuple(ys))
+        start = self.num_update
+        if self._guard is None:
+            self._param_arrays, self._state_leaves, losses = jitted(
+                keys, stacked, self._param_arrays, self._state_leaves,
+                tuple(xs), tuple(ys))
+        else:
+            poisons = onp.asarray(
+                [self._guard.next_poison() for _ in range(nsteps)],
+                dtype=onp.float32)
+            (self._param_arrays, self._state_leaves, self._gstate,
+             losses, healths, scales) = jitted(
+                keys, stacked, poisons, self._gstate,
+                self._param_arrays, self._state_leaves, tuple(xs),
+                tuple(ys))
         self.num_update += nsteps
         for p, w in zip(self._params, self._param_arrays):
             p.data()._data = w
+        if self._guard is not None:
+            # one materialisation for the whole window (the scan already
+            # synced at its end); feeds the host policy per step
+            h_host = onp.asarray(healths)
+            l_host = onp.asarray(losses)
+            s_host = onp.asarray(scales)
+            for i in range(nsteps):
+                self._guard.record(start + i, float(h_host[i]),
+                                   loss=float(l_host[i]),
+                                   scale=float(s_host[i]))
         return NDArray(losses)
 
     def _hyper(self, indices, opt, advance=True):
@@ -359,11 +544,13 @@ class ParallelTrainer:
         return (lrs, wds, ts, onp.float32(opt.rescale_grad))
 
     def step(self, x, y):
-        """One fused train step; returns the (replicated) scalar loss."""
-        xs = [a._data if isinstance(a, NDArray) else
-              (None if a is None else jnp.asarray(a)) for a in _as_list(x)]
-        ys = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
-              for a in _as_list(y)]
+        """One fused train step; returns the (replicated) scalar loss.
+
+        With the guardrail on, also records the step's sentinel event —
+        processing at the configured cadence may raise
+        :class:`~mxnet_tpu.guardrail.GuardrailTripped`, which guarded
+        drivers convert into a rollback (guardrail/rollback.py)."""
+        xs, ys = self._normalize(x, y)
         if self._jitted is None:
             self._build(xs, ys)
         sig = (tuple(a is None for a in xs), len(ys))
@@ -393,12 +580,100 @@ class ParallelTrainer:
                    for a, sh in zip(ys, self._data_shardings[1]))
         from .. import profiler as _profiler
         loss = None
+        health = None
         with _profiler.op_span('fused_train_step',
                                lambda: loss.block_until_ready()):
-            self._param_arrays, self._state_leaves, loss = self._jitted(
-                key, hyper, self._param_arrays, self._state_leaves, xd, yd)
+            if self._guard is None:
+                self._param_arrays, self._state_leaves, loss = \
+                    self._jitted(key, hyper, self._param_arrays,
+                                 self._state_leaves, xd, yd)
+            else:
+                gin = (onp.float32(self._guard.next_poison()),
+                       self._gstate[0], self._gstate[1])
+                (self._param_arrays, self._state_leaves, loss,
+                 (health, s2, g2)) = self._jitted(
+                    key, hyper, gin, self._param_arrays,
+                    self._state_leaves, xd, yd)
+                self._gstate = (s2, g2)
         self.num_update += 1
         # keep the net's Parameters viewing the live sharded arrays
         for p, w in zip(self._params, self._param_arrays):
             p.data()._data = w
+        if self._guard is not None:
+            self._guard.record(self.num_update - 1, health, loss=loss,
+                               scale=self._gstate[0])
         return NDArray(loss)
+
+    # -- rollback contract (guardrail/rollback.py) -------------------------
+
+    def snapshot(self):
+        """Host capture of every step-evolving piece of trainer state:
+        params, optimizer-state leaves, loss-scale state, step/hyper
+        counters, and the per-step RNG base key. Feed to
+        :meth:`restore` for a bit-exact rewind."""
+        if self._jitted is None:
+            raise RuntimeError('snapshot() before the step is compiled; '
+                               'call build(x, y) (or one step) first')
+        state = {
+            'num_update': self.num_update,
+            'params': [onp.asarray(w) for w in self._param_arrays],
+            'leaves': [onp.asarray(a) for a in self._state_leaves],
+            'base_key': None if self._base_key is None
+            else onp.asarray(self._base_key),
+            'update_counts': dict(self._opt._index_update_count),
+            'opt_num_update': getattr(self._opt, 'num_update', 0),
+        }
+        if self._gstate is not None:
+            state['scale'] = float(self._gstate[0])
+            state['good'] = int(self._gstate[1])
+        return state
+
+    def restore(self, state):
+        """Rewind to a :meth:`snapshot` capture (same built trainer)."""
+        if self._jitted is None:
+            raise RuntimeError('restore() on an un-built trainer')
+        repl, param_sh, leaf_sh = self._shardings[:3]
+        self._param_arrays = tuple(
+            jax.device_put(w, sh)
+            for w, sh in zip(state['params'], param_sh))
+        self._state_leaves = tuple(
+            jax.device_put(a, sh)
+            for a, sh in zip(state['leaves'], leaf_sh))
+        self.num_update = int(state['num_update'])
+        self._base_key = None if state.get('base_key') is None \
+            else onp.asarray(state['base_key'], dtype=onp.uint32)
+        self._opt._index_update_count.clear()
+        self._opt._index_update_count.update(state['update_counts'])
+        if hasattr(self._opt, 'num_update'):
+            self._opt.num_update = state.get('opt_num_update', 0)
+        if self._gstate is not None and 'scale' in state:
+            self._gstate = (
+                jax.device_put(onp.float32(state['scale']), repl),
+                jax.device_put(onp.int32(state['good']), repl))
+        for p, w in zip(self._params, self._param_arrays):
+            p.data()._data = w
+
+    def compiled_step(self):
+        """The compiled single-step executable (lower().compile();
+        shapes only — nothing executes, nothing is donated). Exposes
+        ``.as_text()`` (optimized HLO) and ``.cost_analysis()``."""
+        if self._jitted is None:
+            raise RuntimeError('compiled_step() before the step is '
+                               'compiled; call build(x, y) first')
+        indices = list(range(len(self._params)))
+        hyper = self._hyper(indices, self._opt, advance=False)
+        key = onp.zeros(2, onp.uint32)
+        abstract_xs, abstract_ys = self._abstract_io
+        args = [key, hyper]
+        if self._guard is not None:
+            args.append((onp.float32(0.0), self._gstate[0],
+                         self._gstate[1]))
+        args += [self._param_arrays, self._state_leaves, abstract_xs,
+                 abstract_ys]
+        return self._jitted.lower(*args).compile()
+
+    def compiled_text(self):
+        """Optimized HLO of the compiled single-step program. Used by
+        the bench guard-overhead A/B and the no-host-transfer
+        structural tests."""
+        return self.compiled_step().as_text()

@@ -63,6 +63,20 @@ def current_key():
     return _get_key()
 
 
+def get_state():
+    """Host copy of the global key chain (guardrail rollback captures
+    this so a replayed window redraws identical randomness)."""
+    import numpy as onp
+    return onp.asarray(_get_key())
+
+
+def set_state(state):
+    """Restore a :func:`get_state` capture (the RNG-rewind half of the
+    rollback contract, docs/GUARDRAILS.md)."""
+    import jax.numpy as jnp
+    _state.key = jnp.asarray(state, dtype=jnp.uint32)
+
+
 def _delegate(name):
     def fn(*args, **kwargs):
         from .ndarray import random as _ndr

@@ -19,7 +19,7 @@ __all__ = ['ResilienceError', 'RetryExhausted', 'TimeoutExpired',
            'CircuitOpenError', 'InjectedFault', 'DeviceUnavailableError',
            'TunnelStallError', 'WorkerCrashError', 'is_transient',
            'Retry', 'Timeout', 'Deadline', 'CircuitBreaker',
-           'FaultInjector', 'get_injector', 'inject']
+           'FaultInjector', 'get_injector', 'inject', 'poison']
 
 
 class ResilienceError(RuntimeError):
@@ -301,6 +301,15 @@ _FAULT_CLASSES = {
     'worker_crash': WorkerCrashError,
 }
 
+# Value faults: instead of raising, these corrupt a tensor with the
+# scripted non-finite value (guardrail NaN-injection; e.g.
+# ``nan@grads:2`` poisons the gradients of the next two train steps).
+# Consumed through :meth:`FaultInjector.poison`, never :meth:`fire`.
+_VALUE_FAULTS = {
+    'nan': float('nan'),
+    'inf': float('inf'),
+}
+
 _FAULT_MESSAGES = {
     'device_unavailable': "injected: Unable to initialize backend "
                           "'tpu': UNAVAILABLE: tunnel down",
@@ -351,10 +360,11 @@ class FaultInjector:
                 except ValueError:
                     raise ValueError('bad fault count in %r' % self.spec)
             kind, _, site = raw.partition('@')
-            if kind not in _FAULT_CLASSES:
+            if kind not in _FAULT_CLASSES and kind not in _VALUE_FAULTS:
                 raise ValueError(
                     'unknown fault kind %r (known: %s)'
-                    % (kind, ', '.join(sorted(_FAULT_CLASSES))))
+                    % (kind, ', '.join(sorted(_FAULT_CLASSES) +
+                                       sorted(_VALUE_FAULTS))))
             self._entries.append(_FaultEntry(kind, site or None, count))
 
     def __bool__(self):
@@ -387,6 +397,19 @@ class FaultInjector:
                 entry.remaining -= 1
         raise _FAULT_CLASSES[entry.kind](
             entry.kind, site, _FAULT_MESSAGES[entry.kind])
+
+    def poison(self, site, kinds=('nan', 'inf')):
+        """Consume one scripted VALUE fault (``nan``/``inf``) at
+        ``site`` and return the float to fold into a tensor there;
+        0.0 when nothing is scripted. Unlike :meth:`fire` this never
+        raises — value faults corrupt data, they don't kill calls."""
+        with self._lock:
+            entry = self._match(site, kinds)
+            if entry is None:
+                return 0.0
+            if entry.remaining > 0:
+                entry.remaining -= 1
+        return _VALUE_FAULTS[entry.kind]
 
 
 _ENV_KNOB = 'MXNET_TPU_FAULT'
@@ -423,3 +446,10 @@ def inject(site, kinds, injector=None):
     inj = injector if injector is not None else get_injector()
     if inj:
         inj.fire(site, kinds)
+
+
+def poison(site, kinds=('nan', 'inf'), injector=None):
+    """Module-level convenience for value faults: the float scripted at
+    ``site`` (``nan``/``inf``), or 0.0 when none is pending."""
+    inj = injector if injector is not None else get_injector()
+    return inj.poison(site, kinds) if inj else 0.0

@@ -234,6 +234,19 @@ def test_profiler_demo_captures_events():
     assert n_events > 0 and table_len > 0
 
 
+def test_benchmark_score_reports_rate():
+    """Inference-throughput instrument (the 44th workload smoke —
+    README's 'each with an assert-quality smoke test' claim): a tiny
+    config must report a finite positive img/s for each requested
+    (model, dtype) pair."""
+    from examples import benchmark_score
+    rates = benchmark_score.main(['--models', 'resnet18_v1:float32',
+                                  '--batch', '2', '--image', '64',
+                                  '--iters', '1'])
+    assert len(rates) == 1
+    assert np.isfinite(rates[0]) and rates[0] > 0
+
+
 def test_train_imagenet_rec_pipeline():
     """The flagship: folder -> im2rec .rec -> ImageRecordIter ->
     Module.fit (reference train_imagenet.py:66)."""

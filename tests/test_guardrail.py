@@ -1,0 +1,595 @@
+"""Numerical guardrails (docs/GUARDRAILS.md): sentinel packing, the
+dynamic loss-scale schedule (traced + host mirror), lockstep
+multi-device skip, cond-guarded update bit-identity, anomaly-policy
+tripwires, rollback with RNG/sampler rewind and replay equivalence,
+the quarantine report schema, eager Trainer/Module wiring, and the
+no-host-transfer structural property of the compiled guarded step.
+
+Everything is deterministic: faults come from MXNET_TPU_FAULT value
+kinds (nan@grads:N), clocks are never slept on, and replay
+equivalence is asserted bit-level where the power-of-two scale math
+guarantees it.
+"""
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.guardrail import (AnomalyPolicy, Guardrail,
+                                 GuardrailConfig, GuardrailExhausted,
+                                 GuardrailTripped, LossScaler,
+                                 RollbackCoordinator,
+                                 locate_nonfinite_gluon, run_guarded,
+                                 scaling, sentinel)
+from mxnet_tpu.resilience import CheckpointManager, FaultInjector
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Sentinel packing
+# ---------------------------------------------------------------------------
+
+def test_sentinel_pack_decode_roundtrip():
+    g_ok = [jnp.asarray([3.0, 4.0]), jnp.zeros((2, 2))]
+    packed = sentinel.grad_health(g_ok)
+    assert float(packed) == pytest.approx(5.0)
+    assert bool(sentinel.is_healthy(packed))
+    assert float(sentinel.grad_norm(packed)) == pytest.approx(5.0)
+
+    g_bad = [jnp.asarray([3.0, np.nan]), jnp.asarray([4.0])]
+    packed = sentinel.grad_health(g_bad)
+    assert float(packed) < 0
+    assert not bool(sentinel.is_healthy(packed))
+    # masked norm survives the NaN: sqrt(3^2 + 4^2)
+    assert float(sentinel.grad_norm(packed)) == pytest.approx(5.0)
+
+    g_inf = [jnp.asarray([np.inf])]
+    assert float(sentinel.grad_health(g_inf)) < 0
+    # non-finite loss alone flips the verdict
+    packed = sentinel.grad_health([jnp.asarray([1.0])],
+                                  loss=jnp.float32(np.nan))
+    assert float(packed) < 0
+
+
+def test_sentinel_rescale_packed_preserves_verdict():
+    packed = sentinel.grad_health([jnp.asarray([8.0])])
+    out = sentinel.rescale_packed(packed, jnp.float32(0.25))
+    assert float(out) == pytest.approx(2.0)
+    bad = sentinel.grad_health([jnp.asarray([8.0, np.nan])])
+    out = sentinel.rescale_packed(bad, jnp.float32(0.25))
+    assert float(out) < 0
+    assert float(sentinel.grad_norm(out)) == pytest.approx(2.0)
+
+
+def test_sentinel_poison_corrupts_one_element():
+    g = [jnp.zeros((3, 3)), jnp.ones((2,))]
+    out = sentinel.poison_grads(g, jnp.float32(np.nan))
+    assert np.isnan(np.asarray(out[0])[0, 0])
+    assert np.isfinite(np.asarray(out[0])[1:]).all()
+    np.testing.assert_array_equal(np.asarray(out[1]), np.ones((2,)))
+    # poison 0.0 is the identity (the healthy-step operand)
+    out = sentinel.poison_grads(g, jnp.float32(0.0))
+    np.testing.assert_array_equal(np.asarray(out[0]), np.zeros((3, 3)))
+
+
+def test_sentinel_compiles_to_fused_reduce_no_host_transfer():
+    args = tuple(jnp.zeros((16, 16)) for _ in range(3))
+    txt = jax.jit(lambda gs: sentinel.grad_health(list(gs))) \
+        .lower(args).compile().as_text()
+    assert 'reduce' in txt
+    assert 'outfeed' not in txt and 'infeed' not in txt
+
+
+# ---------------------------------------------------------------------------
+# Loss-scale schedule (traced rule == host mirror)
+# ---------------------------------------------------------------------------
+
+def test_update_scale_schedule_math():
+    scale, good = jnp.float32(16.0), jnp.int32(0)
+    # overflow: halve, reset counter
+    scale, good = scaling.update_scale(scale, good, jnp.bool_(False), 4)
+    assert float(scale) == 8.0 and int(good) == 0
+    # growth after 4 consecutive good steps
+    for i in range(4):
+        scale, good = scaling.update_scale(scale, good, jnp.bool_(True),
+                                           4)
+    assert float(scale) == 16.0 and int(good) == 0
+    # floor
+    scale, good = jnp.float32(1.0), jnp.int32(0)
+    scale, good = scaling.update_scale(scale, good, jnp.bool_(False), 4)
+    assert float(scale) == scaling.MIN_SCALE
+    # cap
+    scale, good = jnp.float32(scaling.MAX_SCALE), jnp.int32(3)
+    scale, good = scaling.update_scale(scale, good, jnp.bool_(True), 4)
+    assert float(scale) == scaling.MAX_SCALE
+
+
+def test_host_scaler_mirrors_traced_rule():
+    verdicts = [True, True, False, True, True, True, False, True] * 3
+    host = LossScaler(init_scale=16.0, growth_interval=3)
+    scale, good = jnp.float32(16.0), jnp.int32(0)
+    for ok in verdicts:
+        host.update(ok)
+        scale, good = scaling.update_scale(scale, good, jnp.bool_(ok), 3)
+        assert float(scale) == host.scale
+        assert int(good) == host.good_steps
+
+
+# ---------------------------------------------------------------------------
+# Anomaly policy
+# ---------------------------------------------------------------------------
+
+def test_policy_persistent_nonfinite_escalates():
+    pol = AnomalyPolicy(patience=3, warmup=2)
+    assert pol.observe(0, False, 0.0) is None
+    assert pol.observe(1, False, 0.0) is None
+    trip = pol.observe(2, False, 0.0)
+    assert trip is not None and trip.reason == 'persistent-nonfinite'
+    # a healthy step resets the streak
+    pol.reset()
+    pol.observe(0, False, 0.0)
+    pol.observe(1, True, 1.0, loss=1.0)
+    assert pol.observe(2, False, 0.0) is None
+
+
+def test_policy_loss_spike_zscore():
+    pol = AnomalyPolicy(window=32, zscore=6.0, patience=3, warmup=8)
+    for i in range(10):
+        assert pol.observe(i, True, 1.0, loss=1.0 + 0.01 * (i % 3)) \
+            is None
+    trip = pol.observe(10, True, 1.0, loss=50.0)
+    assert trip is not None and trip.reason == 'loss-spike'
+    assert trip.zscore > 6.0
+
+
+def test_policy_grad_spike_and_warmup_suppression():
+    pol = AnomalyPolicy(window=32, zscore=6.0, patience=3, warmup=8)
+    # below warmup: even a wild value cannot trip
+    for i in range(7):
+        assert pol.observe(i, True, 1e9 if i == 6 else 1.0) is None
+    pol.reset()
+    for i in range(9):
+        assert pol.observe(i, True, 1.0 + 0.01 * (i % 2)) is None
+    trip = pol.observe(9, True, 1e4)
+    assert trip is not None and trip.reason == 'grad-spike'
+
+
+# ---------------------------------------------------------------------------
+# Guarded ParallelTrainer
+# ---------------------------------------------------------------------------
+
+def _mlp(seed=0):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation='relu'), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _batches(n, bs=8, feat=6, nclass=4, seed=1):
+    rs = np.random.RandomState(seed)
+    return ([nd.array(rs.randn(bs, feat).astype('float32'))
+             for _ in range(n)],
+            [nd.array(rs.randint(0, nclass, (bs,))) for _ in range(n)])
+
+
+def _one_dev_mesh():
+    return parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+
+
+def test_guarded_step_bit_identical_to_unguarded():
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    X, Y = _batches(4)
+    mesh = _one_dev_mesh()
+    pt0 = parallel.ParallelTrainer(
+        _mlp(), L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9}, mesh)
+    l0 = [float(pt0.step(x, y).asscalar()) for x, y in zip(X, Y)]
+    guard = Guardrail(GuardrailConfig(init_scale=1024.0),
+                      injector=FaultInjector(''))
+    pt1 = parallel.ParallelTrainer(
+        _mlp(), L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9}, mesh,
+        guardrail=guard)
+    l1 = [float(pt1.step(x, y).asscalar()) for x, y in zip(X, Y)]
+    # power-of-two loss scaling is exact: bit-identical, not just close
+    assert l0 == l1
+    for (_, a), (_, b) in zip(sorted(pt0._net.collect_params().items()),
+                              sorted(pt1._net.collect_params().items())):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+    assert all(e['action'] == 'update' for e in guard.events)
+
+
+def test_skip_keeps_params_and_optimizer_state_bit_identical():
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    X, Y = _batches(2)
+    guard = Guardrail(GuardrailConfig(init_scale=8.0, patience=10),
+                      injector=FaultInjector('nan@grads:1'))
+    pt = parallel.ParallelTrainer(
+        _mlp(), L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9},
+        _one_dev_mesh(), guardrail=guard)
+    pt.build(X[0], Y[0])
+    params_before = [np.asarray(w) for w in pt._param_arrays]
+    leaves_before = [np.asarray(a) for a in pt._state_leaves]
+    pt.step(X[0], Y[0])         # poisoned: must skip
+    for b, w in zip(params_before, pt._param_arrays):
+        np.testing.assert_array_equal(b, np.asarray(w))
+    for b, a in zip(leaves_before, pt._state_leaves):
+        np.testing.assert_array_equal(b, np.asarray(a))
+    ev = list(guard.events)
+    assert ev[0]['action'] == 'skip' and not ev[0]['healthy']
+    assert guard.scaler.scale == 4.0       # halved
+    assert guard.skips == 1
+    pt.step(X[1], Y[1])         # injector exhausted: updates again
+    assert list(guard.events)[1]['action'] == 'update'
+    changed = any(
+        not np.array_equal(b, np.asarray(w))
+        for b, w in zip(params_before, pt._param_arrays))
+    assert changed
+
+
+def test_lockstep_skip_on_8_device_mesh():
+    """Satellite acceptance: a NaN injected into ONE element (living on
+    one shard) must flip the verdict for EVERY replica — all skip, and
+    params stay bit-identical across all 8 shards."""
+    devs = jax.devices('cpu')
+    if len(devs) < 8:
+        pytest.skip('needs the 8-device virtual mesh')
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(1)
+    x = nd.array(rs.randn(16, 6).astype('float32'))
+    y = nd.array(rs.randint(0, 4, (16,)))
+    guard = Guardrail(GuardrailConfig(init_scale=8.0, patience=10),
+                      injector=FaultInjector('nan@grads:1'))
+    mesh = parallel.create_mesh({'dp': 8}, devices=devs[:8])
+    pt = parallel.ParallelTrainer(_mlp(), L, 'sgd',
+                                  {'learning_rate': 0.1}, mesh,
+                                  guardrail=guard)
+    pt.build(x, y)
+    before = [np.asarray(w) for w in pt._param_arrays]
+    pt.step(x, y)
+    for b, w in zip(before, pt._param_arrays):
+        shards = [np.asarray(s.data) for s in w.addressable_shards]
+        assert len(shards) == 8
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+        np.testing.assert_array_equal(b, np.asarray(w))
+    assert list(guard.events)[0]['action'] == 'skip'
+    assert guard.scaler.scale == 4.0
+    # next step all replicas update in lockstep again
+    pt.step(x, y)
+    for w in pt._param_arrays:
+        shards = [np.asarray(s.data) for s in w.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+
+
+def test_step_n_guarded_matches_step_loop():
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    X, Y = _batches(4)
+    xs = nd.array(np.stack([x.asnumpy() for x in X]))
+    ys = nd.array(np.stack([y.asnumpy() for y in Y]))
+
+    def guarded(spec):
+        g = Guardrail(GuardrailConfig(init_scale=16.0, patience=10),
+                      injector=FaultInjector(spec))
+        return parallel.ParallelTrainer(
+            _mlp(), L, 'sgd', {'learning_rate': 0.1}, _one_dev_mesh(),
+            guardrail=g), g
+
+    pt_a, g_a = guarded('')
+    losses_a = [float(pt_a.step(x, y).asscalar()) for x, y in zip(X, Y)]
+    pt_b, g_b = guarded('')
+    losses_b = [float(v) for v in
+                pt_b.step_n(xs, ys).asnumpy().ravel()]
+    assert losses_a == losses_b
+    for (_, a), (_, b) in zip(
+            sorted(pt_a._net.collect_params().items()),
+            sorted(pt_b._net.collect_params().items())):
+        np.testing.assert_array_equal(a.data().asnumpy(),
+                                      b.data().asnumpy())
+    # a poison mid-window skips exactly that step in the scanned program
+    pt_c, g_c = guarded('nan@grads:1')
+    pt_c.step_n(xs, ys)
+    ev = list(g_c.events)
+    assert [e['action'] for e in ev] == ['skip', 'update', 'update',
+                                        'update']
+    assert ev[0]['scale'] == 8.0 and ev[-1]['scale'] == 8.0
+
+
+# ---------------------------------------------------------------------------
+# Rollback / replay
+# ---------------------------------------------------------------------------
+
+def _guarded_run(spec, tmpdir, nsteps=12, snapshot_every=4, patience=2):
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    X, Y = _batches(nsteps, seed=3)
+    cfg = GuardrailConfig(init_scale=16.0, patience=patience,
+                          snapshot_every=snapshot_every, warmup=100)
+    guard = Guardrail(cfg, injector=FaultInjector(spec))
+    pt = parallel.ParallelTrainer(
+        _mlp(), L, 'sgd', {'learning_rate': 0.1}, _one_dev_mesh(),
+        guardrail=guard)
+    pt.build(X[0], Y[0])
+    mgr = CheckpointManager(str(tmpdir), prefix='guard')
+    coord = RollbackCoordinator(mgr, guard, name='test')
+    losses = []
+
+    def step_fn(i):
+        losses.append(float(pt.step(X[i], Y[i]).asscalar()))
+
+    rollbacks = run_guarded(nsteps, step_fn, guard, coordinator=coord,
+                            capture=pt.snapshot, restore=pt.restore)
+    params = {k.split('_', 1)[-1]: p.data().asnumpy()
+              for k, p in pt._net.collect_params().items()}
+    return losses, params, guard, rollbacks, coord
+
+
+def test_rollback_replay_matches_uninterrupted(tmp_path):
+    """Acceptance: persistent injection ⇒ rollback to last-good +
+    replay converging to the uninterrupted run (bit-exact here)."""
+    la, pa, ga, rba, _ = _guarded_run('', tmp_path / 'a')
+    lb, pb, gb, rbb, coord = _guarded_run('nan@grads:2', tmp_path / 'b')
+    assert rba == 0 and rbb == 1
+    assert gb.skips == 2 and gb.trips == 1
+    assert abs(la[-1] - lb[-1]) <= 1e-5
+    for k in pa:
+        np.testing.assert_allclose(pa[k], pb[k], rtol=0, atol=1e-5)
+    # quarantine report: schema + content
+    rep = coord.last_report
+    assert rep['schema'] == 'mxnet_tpu.guardrail.v1'
+    assert sorted(rep) == sorted(['schema', 'name', 'trip', 'counters',
+                                  'scale', 'resume_step', 'located',
+                                  'events', 'config'])
+    assert rep['trip']['reason'] == 'persistent-nonfinite'
+    assert rep['counters']['rollbacks'] == 1
+    assert any(e['action'] == 'skip' for e in rep['events'])
+    assert os.path.exists(os.path.join(str(tmp_path / 'b'),
+                                       'QUARANTINE.json'))
+
+
+def test_rollback_rewinds_rng_and_scale(tmp_path):
+    guard = Guardrail(GuardrailConfig(init_scale=16.0),
+                      injector=FaultInjector(''))
+    mgr = CheckpointManager(str(tmp_path), prefix='guard')
+    coord = RollbackCoordinator(mgr, guard, name='rng')
+    mx.random.seed(123)
+    state = {'payload': 7}
+    coord.maybe_snapshot(0, lambda: dict(state))
+    draw_a = nd.random.uniform(shape=(4,)).asnumpy()
+    restored = {}
+    from mxnet_tpu.guardrail import Trip
+    coord.rollback(Trip('persistent-nonfinite', 3, 3, 3),
+                   restore=restored.update)
+    draw_b = nd.random.uniform(shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(draw_a, draw_b)  # chain rewound
+    assert restored['payload'] == 7
+    assert restored['step'] == 0
+
+
+def test_rollback_budget_exhausts(tmp_path):
+    guard = Guardrail(GuardrailConfig(max_rollbacks=1),
+                      injector=FaultInjector(''))
+    mgr = CheckpointManager(str(tmp_path), prefix='guard')
+    coord = RollbackCoordinator(mgr, guard, name='budget')
+    from mxnet_tpu.guardrail import Trip
+    trip = Trip('persistent-nonfinite', 1, 3, 3)
+    with pytest.raises(GuardrailExhausted):
+        coord.rollback(trip, restore=lambda s: None)   # no snapshot yet
+    coord.maybe_snapshot(0, lambda: {})
+    coord.rollback(trip, restore=lambda s: None)
+    with pytest.raises(GuardrailExhausted):            # budget == 1
+        coord.rollback(trip, restore=lambda s: None)
+
+
+def test_snapshot_restore_roundtrip_is_bit_exact():
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    X, Y = _batches(6)
+    guard = Guardrail(GuardrailConfig(init_scale=16.0),
+                      injector=FaultInjector(''))
+    pt = parallel.ParallelTrainer(
+        _mlp(), L, 'adam', {'learning_rate': 0.01}, _one_dev_mesh(),
+        guardrail=guard)
+    for i in range(3):
+        pt.step(X[i], Y[i])
+    snap = pt.snapshot()
+    l_first = [float(pt.step(X[i], Y[i]).asscalar()) for i in (3, 4, 5)]
+    pt.restore(snap)
+    assert pt.num_update == 3
+    l_second = [float(pt.step(X[i], Y[i]).asscalar()) for i in (3, 4, 5)]
+    assert l_first == l_second   # params, adam state, keys all rewound
+
+
+# ---------------------------------------------------------------------------
+# Eager paths: gluon Trainer and Module.fit
+# ---------------------------------------------------------------------------
+
+def test_gluon_trainer_guardrail_skips_and_scales(monkeypatch):
+    monkeypatch.setenv('MXNET_TPU_FAULT', 'nan@grads:1')
+    net = _mlp()
+    net(nd.zeros((1, 6)))      # materialize deferred init
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    guard = Guardrail(GuardrailConfig(init_scale=4.0, patience=10))
+    trainer.attach_guardrail(guard)
+    X, Y = _batches(2)
+    before = {k: p.data().asnumpy()
+              for k, p in net.collect_params().items()}
+    with autograd.record():
+        loss = guard.scaler.scale_loss(L(net(X[0]), Y[0]).mean())
+    loss.backward()
+    trainer.step(1)      # poisoned grad: skip
+    for k, p in net.collect_params().items():
+        np.testing.assert_array_equal(before[k], p.data().asnumpy())
+    assert guard.skips == 1 and guard.scaler.scale == 2.0
+    monkeypatch.setenv('MXNET_TPU_FAULT', '')
+    with autograd.record():
+        loss = guard.scaler.scale_loss(L(net(X[1]), Y[1]).mean())
+    loss.backward()
+    trainer.step(1)      # healthy: updates, with 1/scale folded in
+    changed = any(
+        not np.array_equal(before[k], p.data().asnumpy())
+        for k, p in net.collect_params().items())
+    assert changed
+    assert list(guard.events)[-1]['action'] == 'update'
+
+
+def test_gluon_trainer_guardrail_rejects_update_on_kvstore():
+    """A server-side optimizer can't be health-gated or unscaled: the
+    guarded step must refuse upfront, not corrupt updates silently."""
+    net = _mlp()
+    net(nd.zeros((1, 6)))
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1},
+                            update_on_kvstore=True)
+    trainer.attach_guardrail(Guardrail(GuardrailConfig(),
+                                       injector=FaultInjector('')))
+    X, Y = _batches(1)
+    with autograd.record():
+        loss = L(net(X[0]), Y[0]).mean()
+    loss.backward()
+    with pytest.raises(AssertionError, match='kvstore'):
+        trainer.step(1)
+
+
+def test_gluon_trainer_guarded_matches_unguarded():
+    """1/scale folding is exact: a guarded healthy run equals the plain
+    run bit-for-bit."""
+    X, Y = _batches(4)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(guarded):
+        net = _mlp()
+        net(nd.zeros((1, 6)))  # materialize deferred init
+        trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                                {'learning_rate': 0.1, 'momentum': 0.9})
+        guard = None
+        if guarded:
+            guard = Guardrail(GuardrailConfig(init_scale=64.0),
+                              injector=FaultInjector(''))
+            trainer.attach_guardrail(guard)
+        for x, y in zip(X, Y):
+            with autograd.record():
+                loss = L(net(x), y).mean()
+                if guard is not None:
+                    loss = guard.scaler.scale_loss(loss)
+            loss.backward()
+            trainer.step(1)
+        return {k.split('_', 1)[-1]: p.data().asnumpy()
+                for k, p in net.collect_params().items()}
+
+    pa, pb = run(False), run(True)
+    for k in pa:
+        np.testing.assert_array_equal(pa[k], pb[k])
+
+
+def test_module_fit_guardrail_rollback_and_report(tmp_path):
+    """Module.fit wiring: a poisoned epoch trips, rolls back to the
+    epoch-boundary checkpoint, writes the quarantine report, and the
+    replayed fit completes with finite params."""
+    from mxnet_tpu import io as mxio, sym
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(24, 6).astype('float32')
+    Y = rs.randint(0, 3, (24,)).astype('float32')
+
+    data = sym.Variable('data')
+    out = sym.FullyConnected(data, num_hidden=3, name='fc')
+    net = sym.SoftmaxOutput(out, name='softmax')
+    m = mx.mod.Module(net, context=mx.cpu())
+
+    ckdir = str(tmp_path / 'modfit')
+    guard = Guardrail(GuardrailConfig(patience=2, max_rollbacks=2))
+
+    def arm_fault(epoch, *_):
+        if epoch == 0:
+            mx.config.set('MXNET_TPU_FAULT', 'nan@grads:2')
+
+    try:
+        m.fit(mxio.NDArrayIter(X, Y, batch_size=8), num_epoch=3,
+              checkpoint_dir=ckdir, guardrail=guard,
+              epoch_end_callback=arm_fault,
+              optimizer_params=(('learning_rate', 0.05),))
+    finally:
+        mx.config.unset('MXNET_TPU_FAULT')
+    assert guard.skips == 2 and guard.rollbacks == 1
+    rep_path = os.path.join(ckdir, 'QUARANTINE.json')
+    assert os.path.exists(rep_path)
+    import json
+    rep = json.load(open(rep_path))
+    assert rep['schema'] == 'mxnet_tpu.guardrail.v1'
+    assert rep['name'] == 'module.fit'
+    assert rep['trip']['reason'] == 'persistent-nonfinite'
+    args, _ = m.get_params()
+    for v in args.values():
+        assert np.isfinite(v.asnumpy()).all()
+    # training completed all 3 epochs despite the poisoned epoch
+    mgr = CheckpointManager(ckdir, prefix='fit')
+    assert mgr.latest()[0] == 2
+
+
+def test_module_fit_guardrail_without_checkpoint_escalates():
+    from mxnet_tpu import io as mxio, sym
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(16, 6).astype('float32')
+    Y = rs.randint(0, 3, (16,)).astype('float32')
+    data = sym.Variable('data')
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=3, name='fc'),
+        name='softmax')
+    m = mx.mod.Module(net, context=mx.cpu())
+    guard = Guardrail(GuardrailConfig(patience=1),
+                      injector=FaultInjector('nan@grads:1'))
+    with pytest.raises(GuardrailExhausted):
+        m.fit(mxio.NDArrayIter(X, Y, batch_size=8), num_epoch=1,
+              guardrail=guard)
+
+
+# ---------------------------------------------------------------------------
+# NaN locating (eager debug mode)
+# ---------------------------------------------------------------------------
+
+def test_locate_nonfinite_gluon_names_first_block():
+    net = _mlp()
+    net(nd.zeros((1, 6)))      # materialize params
+    x = np.zeros((2, 6), np.float32)
+    x[0, 0] = np.nan           # poison the input: first Dense sees it
+    located = locate_nonfinite_gluon(net, nd.array(x))
+    assert located is not None and 'dense' in located
+    # clean input: nothing located
+    assert locate_nonfinite_gluon(net, nd.zeros((2, 6))) is None
+
+
+def test_monitor_nonfinite_stat():
+    from mxnet_tpu.monitor import nonfinite_count
+    c = nonfinite_count(nd.array(np.array([1.0, np.nan, np.inf, 2.0])))
+    assert float(c.asnumpy()[0]) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Compiled-step structure (no host sync)
+# ---------------------------------------------------------------------------
+
+def test_guarded_step_hlo_has_cond_and_no_host_transfer():
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    X, Y = _batches(1)
+    guard = Guardrail(GuardrailConfig(init_scale=16.0),
+                      injector=FaultInjector(''))
+    pt = parallel.ParallelTrainer(
+        _mlp(), L, 'sgd', {'learning_rate': 0.1}, _one_dev_mesh(),
+        guardrail=guard)
+    pt.build(X[0], Y[0])
+    txt = pt.compiled_text()
+    assert 'conditional' in txt        # the lax.cond skip-guard
+    assert 'outfeed' not in txt and 'infeed' not in txt

@@ -204,6 +204,23 @@ def test_injector_counts_and_site_scoping():
         FaultInjector('no_such_kind')
 
 
+def test_injector_value_faults_poison_instead_of_raise():
+    """nan/inf kinds (the guardrail's NaN injection) are consumed via
+    poison(): scripted counts, site scoping, never an exception."""
+    inj = FaultInjector('nan@grads:2,inf@loss:1')
+    assert np.isnan(inj.poison('grads'))
+    assert inj.poison('other.site') == 0.0      # site-scoped
+    assert np.isnan(inj.poison('grads'))
+    assert inj.poison('grads') == 0.0           # count exhausted
+    assert np.isinf(inj.poison('loss'))
+    assert inj.poison('loss') == 0.0
+    # exception kinds don't leak through poison and vice versa
+    inj = FaultInjector('device_unavailable:1')
+    assert inj.poison('device') == 0.0          # not a value fault
+    with pytest.raises(DeviceUnavailableError):
+        inj.fire('device', ('device_unavailable',))
+
+
 def test_injected_faults_look_transient():
     try:
         FaultInjector('tunnel_stall:1').fire('device', ('tunnel_stall',))

@@ -7,7 +7,11 @@ Stages, each timed:
   1. fast test tier        pytest -m "not slow"       (~2 min)
   2. fault injection       tools/fault_smoke.py — bench.py under
                            MXNET_TPU_FAULT=device_unavailable must
-                           degrade (rc=0 + status artifact), not crash
+                           degrade (rc=0 + status artifact), not
+                           crash, AND the NaN-injection guardrail
+                           contract (MXNET_TPU_FAULT=nan@grads:2 ⇒
+                           skip → rollback → replay converging,
+                           python -m mxnet_tpu.guardrail)
   3. C ABI audit           tools/capi_coverage.py == 207/207
   4. copy-paste gate       tools/overlap_check.py --sweep 0.60
   5. example smokes        3 representative workloads (LeNet both

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Fault-injection CI tier (tools/ci.py stage 'resilience').
 
-Two checks:
+Three checks:
   1. tests/test_resilience.py passes (policy math, checkpoint resume,
      worker restart — the deterministic fault suite).
   2. bench.py in forced-degraded mode: with
@@ -9,10 +9,17 @@ Two checks:
      an artifact whose status != "ok" with the full degraded-mode
      schema (docs/RESILIENCE.md) — the BENCH_r05 traceback failure mode
      is the regression this tier gates against.
+  3. NaN-injection guardrail contract: with MXNET_TPU_FAULT=nan@grads:2
+     the guardrail selftest (python -m mxnet_tpu.guardrail) must skip
+     both poisoned updates with params bit-identical, halve the loss
+     scale each time, trip the persistent-non-finite policy, roll back
+     to the last-good snapshot, and replay to within 1e-5 of an
+     uninterrupted run (docs/GUARDRAILS.md).
 
 Usage: python tools/fault_smoke.py [--skip-tests]
-(--skip-tests runs only the bench check; ci.py's fast tier already ran
-the test file, so the gate uses it to avoid double work.)
+(--skip-tests runs only the bench + guardrail checks; ci.py's fast
+tier already ran the test files, so the gate uses it to avoid double
+work.)
 """
 import json
 import os
@@ -69,10 +76,50 @@ def run_faulted_bench():
         return True
 
 
+def run_nan_guardrail():
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, 'GUARD_SELFTEST.json')
+        env = dict(os.environ, MXNET_TPU_FAULT='nan@grads:2',
+                   JAX_PLATFORMS='cpu')
+        r = subprocess.run(
+            [sys.executable, '-m', 'mxnet_tpu.guardrail', '--out', out],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+        if r.returncode != 0:
+            print('FAIL: guardrail selftest exited %d\nstdout:\n%s\n'
+                  'stderr:\n%s' % (r.returncode, r.stdout[-2000:],
+                                   r.stderr[-2000:]))
+            return False
+        if not os.path.exists(out):
+            print('FAIL: guardrail selftest wrote no verdict artifact')
+            return False
+        v = json.load(open(out))
+        problems = []
+        if v.get('skips', 0) < 2:
+            problems.append('expected >= 2 skipped updates, got %r'
+                            % v.get('skips'))
+        if v.get('rollbacks', 0) < 1:
+            problems.append('no rollback happened')
+        if not v.get('converged'):
+            problems.append('replay did not converge (loss_delta=%r, '
+                            'param_delta=%r)' % (v.get('loss_delta'),
+                                                 v.get('param_delta')))
+        if v.get('report_schema') != 'mxnet_tpu.guardrail.v1':
+            problems.append('quarantine report schema %r'
+                            % v.get('report_schema'))
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        print('nan guardrail: rc=0, skips=%d, rollbacks=%d, '
+              'loss_delta=%.2g' % (v['skips'], v['rollbacks'],
+                                   v['loss_delta']))
+        return True
+
+
 def run_resilience_tests():
     r = subprocess.run(
         [sys.executable, '-m', 'pytest', 'tests/test_resilience.py',
-         '-q', '-p', 'no:cacheprovider'],
+         'tests/test_guardrail.py', '-q', '-p', 'no:cacheprovider'],
         cwd=REPO)
     return r.returncode == 0
 
@@ -83,6 +130,7 @@ def main(argv=None):
     if '--skip-tests' not in argv:
         ok = run_resilience_tests()
     ok = run_faulted_bench() and ok
+    ok = run_nan_guardrail() and ok
     print('fault_smoke: %s' % ('OK' if ok else 'FAIL'))
     return 0 if ok else 1
 

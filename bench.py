@@ -400,7 +400,11 @@ def main(argv=None):
 
     from mxnet_tpu.resilience import (acquire_backend, artifact_record,
                                       write_artifact, is_transient,
-                                      InjectedFault)
+                                      InjectedFault, PreemptionHandler)
+    # graceful preemption: SIGTERM between legs stops at the next leg
+    # boundary and the artifact's 'resumable' record + the resumable
+    # exit code tell the snapshot driver to just re-run the command
+    handler = PreemptionHandler().install()
     status = acquire_backend()
     if not status.usable:
         print('bench: backend unavailable after %d attempt(s): %s — '
@@ -408,7 +412,7 @@ def main(argv=None):
               % (status.attempts, status.error, args.out), flush=True)
         write_artifact(args.out, artifact_record(
             'bench', 'unavailable', backend=status, error=status.error,
-            payload={'metrics': []}))
+            payload={'metrics': []}, preempt=handler))
         return 0
 
     on_accel = status.state == 'tpu'
@@ -426,35 +430,45 @@ def main(argv=None):
         error = '%s: %s' % (type(e).__name__, str(e)[:300])
         print('bench: resnet leg lost to a transient fault (%s)'
               % error, flush=True)
-    try:
-        metrics.append(bench_bert(on_accel))
-    except Exception as e:
-        if not (isinstance(e, InjectedFault) or is_transient(e)):
-            raise
-        # BERT line is best-effort (the primary metric already
-        # printed) but a lost leg still degrades the artifact status
-        verdict = 'degraded'
-        error = '%s: %s' % (type(e).__name__, str(e)[:300])
-        print(json.dumps({
-            'metric': 'bert_base_pretrain_samples_per_sec_per_chip',
-            'value': 0, 'unit': 'samples/s', 'vs_baseline': 0,
-            'error': str(e)[:200]}), flush=True)
-    try:
-        metrics.append(bench_guardrail(on_accel))
-    except Exception as e:
-        if not (isinstance(e, InjectedFault) or is_transient(e)):
-            raise
-        verdict = 'degraded'
-        error = '%s: %s' % (type(e).__name__, str(e)[:300])
-        print('bench: guardrail A/B leg lost to a transient fault (%s)'
-              % error, flush=True)
+    if not handler.stop_requested:
+        try:
+            metrics.append(bench_bert(on_accel))
+        except Exception as e:
+            if not (isinstance(e, InjectedFault) or is_transient(e)):
+                raise
+            # BERT line is best-effort (the primary metric already
+            # printed) but a lost leg still degrades the artifact status
+            verdict = 'degraded'
+            error = '%s: %s' % (type(e).__name__, str(e)[:300])
+            print(json.dumps({
+                'metric': 'bert_base_pretrain_samples_per_sec_per_chip',
+                'value': 0, 'unit': 'samples/s', 'vs_baseline': 0,
+                'error': str(e)[:200]}), flush=True)
+    if not handler.stop_requested:
+        try:
+            metrics.append(bench_guardrail(on_accel))
+        except Exception as e:
+            if not (isinstance(e, InjectedFault) or is_transient(e)):
+                raise
+            verdict = 'degraded'
+            error = '%s: %s' % (type(e).__name__, str(e)[:300])
+            print('bench: guardrail A/B leg lost to a transient fault '
+                  '(%s)' % error, flush=True)
 
+    if handler.stop_requested:
+        # preempted mid-bench: the legs already measured stay in the
+        # artifact, status degrades, and the resumable rc tells the
+        # driver to re-run the command after restart
+        verdict = 'degraded'
+        error = 'preempted (%s) after %d metric leg(s)' \
+            % (handler.reason, len(metrics))
+        print('bench: %s' % error, flush=True)
     write_artifact(args.out, artifact_record(
         'bench', verdict, backend=status, error=error,
-        payload={'metrics': metrics}))
+        payload={'metrics': metrics}, preempt=handler))
     print('bench: status=%s artifact=%s' % (verdict, args.out),
           flush=True)
-    return 0
+    return handler.exit_code if handler.stop_requested else 0
 
 
 if __name__ == '__main__':

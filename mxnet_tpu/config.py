@@ -120,8 +120,10 @@ KNOBS = {k.name: k for k in [
     # resilience layer (docs/RESILIENCE.md)
     _knob('MXNET_TPU_FAULT', str, None,
           'Scripted fault injection: comma list of kind[@site][:count]'
-          ' (device_unavailable, tunnel_stall, worker_crash, and the'
-          ' value kinds nan/inf, e.g. nan@grads:2 for the guardrail).'
+          ' (device_unavailable, tunnel_stall, worker_crash, preempt,'
+          ' hang, device_loss, and the value kinds nan/inf, e.g.'
+          ' nan@grads:2 for the guardrail or preempt@train.step.12:1'
+          ' to preempt exactly at step 12).'
           ' CI and tests only; leave unset in production.'),
     # numerical guardrail (docs/GUARDRAILS.md)
     _knob('MXNET_TPU_GUARDRAIL', bool, False,
@@ -162,6 +164,38 @@ KNOBS = {k.name: k for k in [
           ' acquisition attempts.'),
     _knob('MXNET_TPU_ACQUIRE_DEADLINE_S', float, 300.0,
           'Total wall-clock budget for backend acquisition retries.'),
+    # preemption / elasticity / watchdog (docs/RESILIENCE.md)
+    _knob('MXNET_TPU_PREEMPT_EXIT_CODE', int, 75,
+          'Process exit code marking a preempted-but-resumable run'
+          ' (75 = BSD EX_TEMPFAIL). Launchers restart the same command'
+          ' on this rc; any other non-zero rc is a real failure.'),
+    _knob('MXNET_TPU_PREEMPT_GRACE_S', float, 30.0,
+          'Drain budget after a SIGTERM/SIGINT: the emergency'
+          ' checkpoint must finish within this many seconds (the'
+          ' preemption notice-to-reclaim window).'),
+    _knob('MXNET_TPU_CKPT_EVERY_N_STEPS', int, 0,
+          'Step-granular checkpoint cadence for Module.fit /'
+          ' ParallelTrainer when a checkpoint_dir is given; 0 keeps'
+          ' epoch-boundary-only checkpoints.'),
+    _knob('MXNET_TPU_CKPT_KEEP', int, 2,
+          'How many step-granular checkpoints CheckpointManager'
+          ' retains (keep=N pruning; the newest that validates wins'
+          ' at resume).'),
+    _knob('MXNET_TPU_ELASTIC', bool, True,
+          'Allow a restart that sees fewer devices than the checkpoint'
+          ' mesh to shrink the dp axis and preserve the global batch'
+          ' via gradient accumulation; 0 makes a device-count mismatch'
+          ' a hard error.'),
+    _knob('MXNET_TPU_WATCHDOG_COMPILE_S', float, 1800.0,
+          'Watchdog stall budget (seconds) for the compile phase'
+          ' (first-program XLA compiles legitimately take minutes).'),
+    _knob('MXNET_TPU_WATCHDOG_STEP_S', float, 300.0,
+          'Watchdog stall budget for a dispatched compiled step.'),
+    _knob('MXNET_TPU_WATCHDOG_COLLECTIVE_S', float, 600.0,
+          'Watchdog stall budget for host-side collectives (kvstore'
+          ' dist push/pull/barrier).'),
+    _knob('MXNET_TPU_WATCHDOG_POLL_S', float, 10.0,
+          'Poll cadence of the background watchdog monitor thread.'),
     _knob('MXNET_TPU_WORKER_RESTARTS', int, 2,
           'DataLoader worker-crash restarts per batch before the'
           ' failure propagates.'),

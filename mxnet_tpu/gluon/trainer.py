@@ -55,6 +55,9 @@ class Trainer:
                                 'update_on_kvstore': update_on_kvstore}
         self._fused = None  # FusedUpdater once built; False disables
         self._guardrail = None
+        self._watchdog = None
+        self._preempt = None
+        self._step_count = 0
         self._reset_kvstore()
 
     def _index_table(self):
@@ -154,12 +157,37 @@ class Trainer:
         self._guard_step = 0
         return self
 
+    def attach_watchdog(self, watchdog):
+        """Attach a :class:`~mxnet_tpu.resilience.Watchdog`: every
+        :meth:`step` heartbeats before the update and runs the stall
+        check after it, so an eager loop gets the same hung-step
+        detection as the compiled ``ParallelTrainer`` path
+        (docs/RESILIENCE.md)."""
+        self._watchdog = watchdog
+        return self
+
+    def attach_preemption(self, handler):
+        """Attach a :class:`~mxnet_tpu.resilience.PreemptionHandler`:
+        :meth:`step` polls it at entry and raises
+        :class:`~mxnet_tpu.resilience.Preempted` (resumable rc) on a
+        pending stop — the caller's loop is responsible for the
+        emergency checkpoint (``snapshot_gluon`` + CheckpointManager),
+        since only it knows the sampler cursor."""
+        self._preempt = handler
+        return self
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Make one parameter update step: rescale by 1/batch_size,
         allreduce (dist), apply optimizer (reference: trainer.py:298).
 
         With a guardrail attached (:meth:`attach_guardrail`), the
         update is health-gated: overflow ⇒ skip + scale backoff."""
+        if self._preempt is not None and \
+                self._preempt.check(self._step_count):
+            self._preempt.exit(step=self._step_count)
+        if self._watchdog is not None:
+            self._watchdog.beat(self._step_count, phase='step')
+        self._step_count += 1
         guard = self._guardrail
         if guard is not None:
             self._ensure_kv()
@@ -178,6 +206,11 @@ class Trainer:
                 for p in self._params:
                     if p.grad_req != 'null':
                         p.data()._grad_fresh = False
+                # a skipped update is still a step boundary: the stall
+                # check must run, or a hang seen by beat() above would
+                # be silently re-armed by the next step's heartbeat
+                if self._watchdog is not None:
+                    self._watchdog.check()
                 return
             self._check_and_rescale_grad(
                 self._scale / batch_size / scale_used)
@@ -186,6 +219,8 @@ class Trainer:
         self._ensure_kv()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        if self._watchdog is not None:
+            self._watchdog.check()
 
     def _check_and_rescale_grad(self, scale):
         if self._update_on_kvstore and self._distributed and self._kv_initialized:

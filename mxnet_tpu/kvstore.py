@@ -18,15 +18,22 @@ from __future__ import annotations
 
 import pickle
 
+import warnings
+
 from .base import string_types
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import optimizer as opt
-from .resilience.policy import Retry, RetryExhausted, inject, is_transient
+from .resilience.policy import (Retry, RetryExhausted, WorkerCrashError,
+                                inject, is_transient)
 
 __all__ = ['KVStore', 'KVStoreInitError', 'create']
 
 _KV_FAULTS = ('device_unavailable', 'tunnel_stall')
+# the init handshake additionally honors worker_crash: a worker dying
+# mid-handshake is recoverable by re-running the join from scratch
+# (the restarted-worker rejoin path), unlike a mid-collective death
+_KV_INIT_FAULTS = _KV_FAULTS + ('worker_crash',)
 
 
 class KVStoreInitError(RuntimeError):
@@ -198,6 +205,22 @@ class KVStore:
                 multihost_utils.sync_global_devices('kvstore_barrier')
             _comm_retry().call(_sync)
 
+    def rejoin(self):
+        """Re-run the init/barrier handshake after a worker restart.
+
+        The reference's ps-lite re-registered a dead worker with the
+        scheduler transparently; here a restarted worker process calls
+        this (or simply ``create()`` again — which takes the same path
+        on a worker-crash-shaped init failure) to re-enter the
+        ``jax.distributed`` cluster and re-synchronize at a barrier
+        before touching any collective. Store contents are untouched:
+        the restarted worker re-pulls weights through the normal
+        ``pull`` path after the barrier."""
+        if self._type.startswith(('dist', 'horovod')):
+            _join_distributed(self._type, rejoin=True)
+            self._barrier()
+        return self
+
     # -- optimizer hosting -------------------------------------------------
     def set_optimizer(self, optimizer):
         """Run this optimizer inside the store (server-side in the
@@ -259,27 +282,54 @@ _DIST_TYPES = ('dist_sync', 'dist_device_sync', 'dist_async',
                'dist_sync_device', 'horovod')
 
 
+def _join_distributed(kv_type, rejoin=False):
+    """Run the dist join handshake under bounded retries.
+
+    A worker-crash-shaped failure (the worker itself died
+    mid-handshake, not the coordinator) is handled by resetting the
+    join state and re-running the handshake once from scratch — the
+    restarted-worker rejoin path. Anything else that exhausts the
+    retries raises the typed :class:`KVStoreInitError`.
+    """
+    from . import _dist_init
+
+    def _join():
+        inject('kvstore.init', _KV_INIT_FAULTS)
+        _dist_init.ensure_distributed()
+
+    if rejoin:
+        # a restarted worker's previous join state is void — re-run the
+        # handshake from scratch (ensure_distributed is idempotent for
+        # a live cluster membership, so this is safe when nothing died)
+        _dist_init._initialized = False
+    try:
+        _comm_retry().call(_join)
+    except RetryExhausted as exc:
+        if isinstance(exc.last_error, WorkerCrashError) and not rejoin:
+            warnings.warn(
+                'dist worker died during the %r init handshake (%s); '
+                're-running the join from scratch (worker rejoin) '
+                'instead of failing with KVStoreInitError'
+                % (kv_type, exc.last_error))
+            return _join_distributed(kv_type, rejoin=True)
+        raise KVStoreInitError(kv_type, exc.attempts, exc.last_error)
+
+
 def create(name='local'):
     """Create a KVStore by type string (reference: src/kvstore/kvstore.cc:40).
 
     All single-process types alias the mesh-collective store; dist types
     join the multi-host runtime (launcher env -> jax.distributed) and
     enable the cross-process allreduce. 'dist_async' runs synchronously
-    (documented divergence — no parameter server on TPU).
+    (documented divergence — no parameter server on TPU). A worker that
+    died and restarted rejoins through the same call: a worker-crash
+    failure during the handshake re-runs the join instead of raising
+    :class:`KVStoreInitError` (docs/RESILIENCE.md).
     """
     if not isinstance(name, string_types):
         raise TypeError('name must be a string')
     if name.lower() not in _SINGLE_TYPES + _DIST_TYPES:
         raise ValueError('Unknown KVStore type %s' % name)
     if name.lower() in _DIST_TYPES:
-        from ._dist_init import ensure_distributed
-
-        def _join():
-            inject('kvstore.init', _KV_FAULTS)
-            ensure_distributed()
-        try:
-            _comm_retry().call(_join)
-        except RetryExhausted as exc:
-            raise KVStoreInitError(name.lower(), exc.attempts,
-                                   exc.last_error)
+        _join_distributed(name.lower())
     return KVStore(name.lower())

@@ -179,6 +179,7 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None, checkpoint_dir=None,
+            checkpoint_every_n_steps=None, preempt=None,
             guardrail=None, locate_nonfinite=False):
         """The training driver (reference: base_module.py:409).
 
@@ -188,6 +189,24 @@ class BaseModule:
         directory with checkpoints resumes from the newest valid one
         instead of epoch ``begin_epoch`` — an interrupted job re-run
         with the same command continues where it stopped.
+
+        ``checkpoint_every_n_steps`` (default: the
+        ``MXNET_TPU_CKPT_EVERY_N_STEPS`` knob) adds STEP-granular
+        checkpoints inside the epoch: every N completed batches the
+        params + optimizer counters + RNG chain + the (epoch, batch)
+        cursor are checkpointed, and a resumed fit fast-forwards the
+        data iterator to that cursor — ``resume == uninterrupted``
+        holds bit-for-bit mid-epoch, not just at epoch boundaries
+        (requires a deterministic iterator order, docs/RESILIENCE.md).
+
+        ``preempt`` opts into graceful preemption: pass True (installs
+        a fresh :class:`~mxnet_tpu.resilience.PreemptionHandler` for
+        SIGTERM/SIGINT) or a handler instance. A stop request —
+        signal, scripted ``preempt`` fault, or
+        ``handler.request_stop()`` — drains an emergency step
+        checkpoint at the next batch boundary and raises
+        :class:`~mxnet_tpu.resilience.Preempted` (a ``SystemExit``
+        with the resumable rc, ``MXNET_TPU_PREEMPT_EXIT_CODE``).
 
         ``guardrail`` opts into numerical guarding
         (docs/GUARDRAILS.md): pass True / a GuardrailConfig / a
@@ -217,15 +236,48 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
+        from .. import config as _config
+        if checkpoint_every_n_steps is None:
+            checkpoint_every_n_steps = int(
+                _config.get('MXNET_TPU_CKPT_EVERY_N_STEPS') or 0)
+        if preempt is True:
+            from ..resilience.preempt import PreemptionHandler
+            preempt = PreemptionHandler().install()
+
         ckpt_mgr = None
+        step_mgr = None
+        skip_batches = 0
+        global_step = 0
         if checkpoint_dir is not None:
             from ..resilience.checkpoint import CheckpointManager
+            keep = int(_config.get('MXNET_TPU_CKPT_KEEP') or 2)
             ckpt_mgr = CheckpointManager(checkpoint_dir, prefix='fit')
+            step_mgr = CheckpointManager(checkpoint_dir,
+                                         prefix='fitstep', keep=keep)
             resumed = ckpt_mgr.latest()
-            if resumed is not None:
+            step_resumed = step_mgr.latest()
+            # a step checkpoint wins only when it is from a LATER epoch
+            # than the newest epoch-boundary one: an epoch checkpoint
+            # at e means epoch e completed, so a step cursor inside e
+            # is stale progress
+            if step_resumed is not None and \
+                    (resumed is None or
+                     int(step_resumed[1]['epoch']) > resumed[0]):
+                _, state = step_resumed
+                self._restore_fit_state(state)
+                begin_epoch = int(state['epoch'])
+                skip_batches = int(state['nbatch']) + 1
+                global_step = int(state.get('global_step', 0))
+                self.logger.info(
+                    'Resumed mid-epoch from step checkpoint in %s: '
+                    'epoch %d, fast-forwarding %d batch(es) '
+                    '(global step %d)', checkpoint_dir, begin_epoch,
+                    skip_batches, global_step)
+            elif resumed is not None:
                 ck_epoch, state = resumed
                 self._restore_fit_state(state)
                 begin_epoch = ck_epoch + 1
+                global_step = int(state.get('global_step', 0))
                 self.logger.info(
                     'Resumed from checkpoint epoch %d in %s; continuing '
                     'at epoch %d', ck_epoch, checkpoint_dir, begin_epoch)
@@ -252,7 +304,41 @@ class BaseModule:
             eval_metric.reset()
             nbatch = 0
             feed = iter(train_data)
-            batch = next(feed)
+            if skip_batches:
+                # sampler fast-forward: replay the resumed epoch's
+                # already-consumed batches so the next one seen here is
+                # exactly the one the interrupted run would have seen
+                # (deterministic iterator order is the contract)
+                for _ in range(skip_batches):
+                    if next(feed, _END) is _END:
+                        break
+                    nbatch += 1
+                skip_batches = 0
+            batch = next(feed, _END)
+            if batch is _END:
+                # resumed exactly at the epoch's end: close the epoch
+                # out the way the uninterrupted run would — checkpoint,
+                # epoch-end callbacks, validation — minus the train
+                # metric summary (no batch of this epoch ran here)
+                arg_params, aux_params = self.get_params()
+                self.set_params(arg_params, aux_params)
+                if ckpt_mgr is not None:
+                    ckpt_mgr.save(epoch, self._fit_state(
+                        epoch, nbatch - 1, global_step))
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_params, aux_params)
+                if eval_data is not None:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info('Epoch[%d] Validation-%s=%f',
+                                         epoch, name, val)
+                train_data.reset()
+                epoch += 1
+                continue
             done = False
             try:
                 while not done:
@@ -295,6 +381,22 @@ class BaseModule:
                         monitor.toc_print()
                     _fire(batch_end_callback, epoch=epoch, nbatch=nbatch,
                           eval_metric=eval_metric, locals=locals())
+                    global_step += 1
+                    if step_mgr is not None and checkpoint_every_n_steps \
+                            and global_step % checkpoint_every_n_steps \
+                            == 0:
+                        step_mgr.save(global_step, self._fit_state(
+                            epoch, nbatch, global_step))
+                    if preempt is not None and \
+                            preempt.check(global_step):
+                        # drain: emergency step checkpoint, then the
+                        # resumable exit (SystemExit with the rc a
+                        # launcher restarts on)
+                        if step_mgr is not None:
+                            preempt.drain(lambda: step_mgr.save(
+                                global_step, self._fit_state(
+                                    epoch, nbatch, global_step)))
+                        preempt.exit(step=global_step)
                     batch = nxt
                     nbatch += 1
             except GuardrailTripped as trip:
@@ -312,22 +414,9 @@ class BaseModule:
             arg_params, aux_params = self.get_params()
             self.set_params(arg_params, aux_params)
             if ckpt_mgr is not None:
-                from .. import random as random_mod
-                updater = getattr(self, '_updater', None)
-                ckpt_mgr.save(epoch, {
-                    'epoch': epoch,
-                    'arg_params': {k: v.asnumpy()
-                                   for k, v in arg_params.items()},
-                    'aux_params': {k: v.asnumpy()
-                                   for k, v in aux_params.items()},
-                    # dump_optimizer: the optimizer's own counters
-                    # (num_update, bias-correction state, scheduler
-                    # position) must survive resume, not just the
-                    # per-index state arrays
-                    'optimizer': updater.get_states(dump_optimizer=True)
-                    if updater is not None else None,
-                    # rollback rewinds the RNG chain along with params
-                    'rng': random_mod.get_state()})
+                ckpt_mgr.save(epoch,
+                              self._fit_state(epoch, nbatch - 1,
+                                              global_step))
             for cb in _as_list(epoch_end_callback):
                 cb(epoch, self.symbol, arg_params, aux_params)
 
@@ -341,6 +430,31 @@ class BaseModule:
                                      name, val)
             train_data.reset()
             epoch += 1
+
+    def _fit_state(self, epoch, nbatch, global_step):
+        """Checkpoint payload shared by the epoch-boundary, step-
+        granular, and preemption-drain saves: params + optimizer
+        counters + RNG chain + the training cursor. ``nbatch`` is the
+        index of the last COMPLETED batch of ``epoch`` (the sampler
+        fast-forward replays ``nbatch + 1`` batches on resume)."""
+        from .. import random as random_mod
+        arg_params, aux_params = self.get_params()
+        updater = getattr(self, '_updater', None)
+        return {
+            'epoch': int(epoch),
+            'nbatch': int(nbatch),
+            'global_step': int(global_step),
+            'arg_params': {k: v.asnumpy()
+                           for k, v in arg_params.items()},
+            'aux_params': {k: v.asnumpy()
+                           for k, v in aux_params.items()},
+            # dump_optimizer: the optimizer's own counters (num_update,
+            # bias-correction state, scheduler position) must survive
+            # resume, not just the per-index state arrays
+            'optimizer': updater.get_states(dump_optimizer=True)
+            if updater is not None else None,
+            # resume rewinds the RNG chain along with params
+            'rng': random_mod.get_state()}
 
     def _restore_fit_state(self, state):
         """Load an epoch-boundary fit checkpoint (params + optimizer

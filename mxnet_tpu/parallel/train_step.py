@@ -143,6 +143,11 @@ class ParallelTrainer:
             self._opt = optimizer
         self._guard = _resolve_guardrail(guardrail)
         self._gstate = None
+        self._preempt = None
+        self._watchdog = None
+        self._ckpt_mgr = None
+        self._ckpt_every = 0
+        self._jitted_accum = {}
         self._jitted = None
         self._params = None
         self._param_arrays = None
@@ -165,6 +170,128 @@ class ParallelTrainer:
 
     def set_learning_rate(self, lr):
         self._opt.set_learning_rate(lr)
+
+    # -- resilience attachments (docs/RESILIENCE.md) -----------------------
+
+    def attach_preemption(self, handler):
+        """Attach a :class:`~mxnet_tpu.resilience.PreemptionHandler`:
+        every step boundary polls it; a pending stop (signal or
+        scripted ``preempt`` fault) drains an emergency checkpoint
+        through the attached manager and raises
+        :class:`~mxnet_tpu.resilience.Preempted` (resumable rc)."""
+        self._preempt = handler
+        return self
+
+    def attach_watchdog(self, watchdog):
+        """Attach a :class:`~mxnet_tpu.resilience.Watchdog`: each step
+        heartbeats before the compiled dispatch (phase ``compile`` for
+        the first build, ``step`` after) and checks the stall budget
+        after it — a stalled/hung step (scripted ``hang`` fault, or a
+        real overrun seen by the background monitor) surfaces as a
+        structured stall artifact + ``TunnelStallError``."""
+        self._watchdog = watchdog
+        return self
+
+    def attach_checkpointing(self, manager, every_n=None):
+        """Attach a resilience ``CheckpointManager``: the trainer
+        checkpoints itself every ``every_n`` steps (default: the
+        ``MXNET_TPU_CKPT_EVERY_N_STEPS`` knob) and is the drain target
+        for an attached preemption handler."""
+        if every_n is None:
+            from ..config import get as _cfg
+            every_n = int(_cfg('MXNET_TPU_CKPT_EVERY_N_STEPS') or 0)
+        self._ckpt_mgr = manager
+        self._ckpt_every = int(every_n)
+        return self
+
+    def _boundary_pre(self):
+        """Step-boundary protocol, before any build/dispatch:
+        preemption drain first (a preempted process must not start
+        another step), then the watchdog heartbeat arming the upcoming
+        phase."""
+        if self._preempt is not None and \
+                self._preempt.check(self.num_update):
+            if self._ckpt_mgr is not None and self._jitted is not None:
+                self._preempt.drain(
+                    lambda: self.save_checkpoint(self._ckpt_mgr))
+            self._preempt.exit(step=self.num_update)
+        if self._watchdog is not None:
+            self._watchdog.beat(
+                self.num_update,
+                phase='compile' if self._jitted is None else 'step')
+
+    def _boundary_post(self):
+        if self._watchdog is not None:
+            self._watchdog.check()
+        if self._ckpt_mgr is not None and self._ckpt_every and \
+                self.num_update % self._ckpt_every == 0:
+            self.save_checkpoint(self._ckpt_mgr)
+
+    def save_checkpoint(self, manager=None, extra=None):
+        """Atomic step-granular checkpoint: the full :meth:`snapshot`
+        plus the mesh layout and global RNG chain, numbered by
+        ``num_update`` — everything a restarted process (same or
+        smaller mesh) needs for a deterministic resume."""
+        from ..resilience.elastic import mesh_meta
+        from .. import random as _random
+        manager = manager or self._ckpt_mgr
+        if manager is None:
+            raise ValueError('no CheckpointManager attached or given')
+        state = self.snapshot()
+        state['mesh'] = mesh_meta(self._mesh)
+        state['rng'] = _random.get_state()
+        if extra:
+            state.update(extra)
+        return manager.save(self.num_update, state)
+
+    def resume(self, manager=None, elastic=None):
+        """Restore the newest valid checkpoint into this (built)
+        trainer; returns ``(step, plan)`` or None when the directory
+        has no checkpoint.
+
+        When the checkpoint's mesh had more devices than this
+        trainer's, the elastic path engages (``MXNET_TPU_ELASTIC``, or
+        the explicit ``elastic=`` override): the logical arrays are
+        re-placed under the smaller mesh's shardings and the returned
+        :class:`~mxnet_tpu.resilience.ElasticPlan` tells the driver
+        how many microbatches to accumulate per step
+        (:meth:`step_accum`) to preserve the global batch. A mismatch
+        with elasticity disabled — or a shrink that cannot preserve
+        semantics — raises
+        :class:`~mxnet_tpu.resilience.MeshShrinkError`.
+        """
+        from ..resilience import elastic as _elastic
+        from .. import random as _random
+        manager = manager or self._ckpt_mgr
+        if manager is None:
+            raise ValueError('no CheckpointManager attached or given')
+        latest = manager.latest()
+        if latest is None:
+            return None
+        step, state = latest
+        plan = None
+        meta = state.get('mesh')
+        here = _elastic.mesh_meta(self._mesh)
+        if meta is not None and meta['device_count'] != \
+                here['device_count']:
+            if elastic is None:
+                from ..config import get as _cfg
+                elastic = bool(_cfg('MXNET_TPU_ELASTIC'))
+            if not elastic:
+                raise _elastic.MeshShrinkError(
+                    'checkpoint mesh %s != trainer mesh %s and elastic '
+                    'resume is disabled (MXNET_TPU_ELASTIC=0)'
+                    % (meta, here))
+            plan = _elastic.shrink_plan(meta, here['device_count'])
+            if plan.new_axes != here['axes']:
+                raise _elastic.MeshShrinkError(
+                    'elastic plan wants mesh axes %s but the trainer '
+                    'was built on %s — rebuild the mesh from the plan'
+                    % (plan.new_axes, here['axes']))
+        if state.get('rng') is not None:
+            _random.set_state(state['rng'])
+        self.restore(state)
+        return step, plan
 
     def _build(self, xs, ys):
         from ..gluon.block import ensure_initialized
@@ -224,6 +351,8 @@ class ParallelTrainer:
         leaf_arrays = tuple(l._data for l in leaves)
         skip_idx = {i for i in range(n) if params[i].grad_req == 'null'}
 
+        self._loss_of = loss_of
+
         def run_update(key, lrs, wds, ts, rescale_eff, param_arrays,
                        state_leaves, grads, auxs):
             """Traced optimizer application + BN-aux merge (shared by
@@ -239,6 +368,8 @@ class ParallelTrainer:
                 if i is not None:
                     new_params[i] = a.astype(new_params[i].dtype)
             return tuple(new_params), tuple(new_leaves)
+
+        self._run_update = run_update
 
         def step(key, hyper, param_arrays, state_leaves, data_arrays,
                  label_arrays):
@@ -440,6 +571,116 @@ class ParallelTrainer:
                            repl),
             donate_argnums=(4, 5))
 
+    def _build_accum(self, accum):
+        """One XLA program: ``accum`` microbatch gradient passes whose
+        mean feeds a SINGLE optimizer update — the elastic mesh-shrink
+        resume path (docs/RESILIENCE.md): after dp shrinks k-fold, k
+        microbatches per step keep the logical global batch (and so
+        the loss trajectory, to fp tolerance) unchanged. The loop is
+        unrolled in the trace: ``accum`` is the small dp shrink
+        factor, not a schedule length."""
+        loss_of, run_update = self._loss_of, self._run_update
+        repl, param_sh, leaf_sh, data_sh, label_sh = self._shardings
+
+        def lead(sh):
+            return NamedSharding(sh.mesh, P(None, *sh.spec))
+
+        def accum_step(key, hyper, param_arrays, state_leaves, xs, ys):
+            lrs, wds, ts, rescale = hyper
+            gsum, auxs, losses = None, None, []
+            for i in range(accum):
+                # distinct threefry key per microbatch (dropout et al.)
+                mkey = jnp.stack([key[0],
+                                  key[1] ^ jnp.uint32(0x9e3779b9 + i)])
+                x_i = tuple(a[i] for a in xs)
+                y_i = tuple(a[i] for a in ys)
+                (loss, aux_i), grads = jax.value_and_grad(
+                    lambda ps, k=mkey, xi=x_i, yi=y_i:
+                        loss_of(k, ps, xi, yi),
+                    has_aux=True)(tuple(param_arrays))
+                gsum = grads if gsum is None else tuple(
+                    a + b for a, b in zip(gsum, grads))
+                # BatchNorm moving stats follow the LAST microbatch —
+                # the documented fp-level divergence of an elastic
+                # resume (stats batch is the microbatch, not the
+                # global batch)
+                auxs = aux_i
+                losses.append(loss)
+            grads = tuple(g / accum for g in gsum)
+            new_params, new_leaves = run_update(
+                key, lrs, wds, ts, rescale, param_arrays, state_leaves,
+                grads, auxs)
+            return new_params, new_leaves, jnp.mean(jnp.stack(losses))
+
+        return jax.jit(
+            accum_step,
+            in_shardings=(repl, (repl, repl, repl, repl), param_sh,
+                          leaf_sh, tuple(lead(s) for s in data_sh),
+                          tuple(lead(s) for s in label_sh)),
+            out_shardings=(param_sh, leaf_sh, repl),
+            donate_argnums=(2, 3))
+
+    def step_accum(self, x, y, accum):
+        """One optimizer update from ``accum`` microbatches in a single
+        compiled program; returns the mean (replicated scalar) loss.
+
+        ``x``/``y`` carry the FULL global batch; the leading dim is
+        split into ``accum`` equal microbatches. Exactly one
+        lr-schedule / update-count advance happens, so an
+        elastic-shrunk resume (:meth:`resume` returning a plan with
+        ``accum_steps > 1``) walks the same optimizer trajectory as
+        the original mesh."""
+        accum = int(accum)
+        if accum <= 1:
+            return self.step(x, y)
+        if self._guard is not None:
+            raise NotImplementedError(
+                'step_accum does not compose with the in-jit guardrail '
+                'yet — run the elastic-shrunk resume unguarded '
+                '(docs/RESILIENCE.md)')
+        self._boundary_pre()
+        xs, ys = self._normalize(x, y)
+
+        def split(a):
+            if a.shape[0] % accum:
+                raise ValueError(
+                    'global batch %d does not split into %d '
+                    'microbatches' % (a.shape[0], accum))
+            return a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
+
+        xs_s = [None if a is None else split(a) for a in xs]
+        ys_s = [split(a) for a in ys]
+        if self._jitted is None:
+            self._build([None if a is None else a[0] for a in xs_s],
+                        [a[0] for a in ys_s])
+        sig = (tuple(a is None for a in xs), len(ys))
+        if sig != self._sig:
+            raise ValueError(
+                'step_accum called with input signature %r but the '
+                'compiled step was built for %r' % (sig, self._sig))
+        if accum not in self._jitted_accum:
+            self._jitted_accum[accum] = self._build_accum(accum)
+        opt = self._opt
+        indices = list(range(len(self._params)))
+        hyper = self._hyper(indices, opt, advance=True)
+        if self._base_key is None:
+            self._base_key = onp.asarray(_random.next_key(),
+                                         dtype=onp.uint32)
+        key = onp.asarray(
+            [self._base_key[0],
+             self._base_key[1] ^ onp.uint32(self.num_update + 1)],
+            dtype=onp.uint32)
+        live = tuple(a for a in xs_s if a is not None)
+        self._param_arrays, self._state_leaves, loss = \
+            self._jitted_accum[accum](key, hyper, self._param_arrays,
+                                      self._state_leaves, live,
+                                      tuple(ys_s))
+        self.num_update += 1
+        for p, w in zip(self._params, self._param_arrays):
+            p.data()._data = w
+        self._boundary_post()
+        return NDArray(loss)
+
     def _normalize(self, x, y):
         xs = [a._data if isinstance(a, NDArray) else
               (None if a is None else jnp.asarray(a)) for a in _as_list(x)]
@@ -460,7 +701,12 @@ class ParallelTrainer:
     def step_n(self, x, y):
         """Run one fused step per leading-dim slice of ``x``/``y`` in a
         SINGLE compiled program; returns the per-step losses as one
-        array. Semantically identical to calling step() n times."""
+        array. Semantically identical to calling step() n times.
+
+        Step-boundary resilience (preempt drain / watchdog) runs once
+        per *window*: the scanned steps are one XLA dispatch, so there
+        is no host boundary inside to stop at."""
+        self._boundary_pre()
         xs, ys = self._normalize(x, y)
         live = [a for a in xs if a is not None]
         if not live or not ys:
@@ -526,6 +772,7 @@ class ParallelTrainer:
                 self._guard.record(start + i, float(h_host[i]),
                                    loss=float(l_host[i]),
                                    scale=float(s_host[i]))
+        self._boundary_post()
         return NDArray(losses)
 
     def _hyper(self, indices, opt, advance=True):
@@ -549,7 +796,14 @@ class ParallelTrainer:
         With the guardrail on, also records the step's sentinel event —
         processing at the configured cadence may raise
         :class:`~mxnet_tpu.guardrail.GuardrailTripped`, which guarded
-        drivers convert into a rollback (guardrail/rollback.py)."""
+        drivers convert into a rollback (guardrail/rollback.py).
+
+        With resilience attachments (:meth:`attach_preemption` /
+        :meth:`attach_watchdog` / :meth:`attach_checkpointing`), every
+        call also runs the step-boundary protocol: preemption drain →
+        watchdog heartbeat → dispatch → stall check → periodic
+        checkpoint."""
+        self._boundary_pre()
         xs, ys = self._normalize(x, y)
         if self._jitted is None:
             self._build(xs, ys)
@@ -602,6 +856,7 @@ class ParallelTrainer:
         if self._guard is not None:
             self._guard.record(self.num_update - 1, health, loss=loss,
                                scale=self._gstate[0])
+        self._boundary_post()
         return NDArray(loss)
 
     # -- rollback contract (guardrail/rollback.py) -------------------------

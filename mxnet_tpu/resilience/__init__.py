@@ -19,6 +19,15 @@ explicit and composable (docs/RESILIENCE.md):
   * ``artifact``    — degraded-mode JSON artifact contract for bench /
                       probe instruments (``"status": "ok" | "degraded"
                       | "unavailable"``, exit 0 on degraded).
+  * ``preempt``     — graceful SIGTERM/SIGINT drain: stop at the next
+                      step boundary, emergency checkpoint, resumable
+                      exit code (75 = EX_TEMPFAIL).
+  * ``watchdog``    — per-phase stall budgets for compiled steps /
+                      collectives; structured ``mxnet_tpu.stall.v1``
+                      artifact + TunnelStallError escalation.
+  * ``elastic``     — mesh-shrink resume: re-place checkpointed
+                      logical state on fewer devices, preserving the
+                      global batch via gradient accumulation.
 
 Dependency-free by design: nothing here imports jax (or any other
 mxnet_tpu module) at import time, so the layer stays usable for
@@ -31,21 +40,31 @@ from .policy import (Retry, Timeout, Deadline, CircuitBreaker,
                      ResilienceError, RetryExhausted, TimeoutExpired,
                      CircuitOpenError, InjectedFault,
                      DeviceUnavailableError, TunnelStallError,
-                     WorkerCrashError, is_transient)
+                     WorkerCrashError, PreemptionSignal, HangError,
+                     DeviceLossError, is_transient)
 from .device import BackendStatus, acquire_backend
 from .checkpoint import (atomic_write_bytes, atomic_replace,
                          save_state, load_state, CheckpointManager,
                          snapshot_gluon, restore_gluon)
 from .artifact import (SCHEMA, write_artifact, artifact_record,
                        run_instrument)
+from .preempt import Preempted, PreemptionHandler, resumable_exit_code
+from .watchdog import STALL_SCHEMA, Watchdog, stall_record
+from .elastic import (MeshShrinkError, ElasticPlan, shrink_plan,
+                      available_devices, mesh_meta)
 
 __all__ = [
     'Retry', 'Timeout', 'Deadline', 'CircuitBreaker', 'FaultInjector',
     'get_injector', 'inject', 'ResilienceError', 'RetryExhausted',
     'TimeoutExpired', 'CircuitOpenError', 'InjectedFault',
     'DeviceUnavailableError', 'TunnelStallError', 'WorkerCrashError',
+    'PreemptionSignal', 'HangError', 'DeviceLossError',
     'is_transient', 'BackendStatus', 'acquire_backend',
     'atomic_write_bytes', 'atomic_replace', 'save_state', 'load_state',
     'CheckpointManager', 'snapshot_gluon', 'restore_gluon',
     'SCHEMA', 'write_artifact', 'artifact_record', 'run_instrument',
+    'Preempted', 'PreemptionHandler', 'resumable_exit_code',
+    'STALL_SCHEMA', 'Watchdog', 'stall_record',
+    'MeshShrinkError', 'ElasticPlan', 'shrink_plan',
+    'available_devices', 'mesh_meta',
 ]

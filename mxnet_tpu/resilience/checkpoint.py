@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import pickle
 import warnings
+import zlib
 
 from .policy import inject
 
@@ -27,6 +28,12 @@ __all__ = ['atomic_write_bytes', 'atomic_replace', 'save_state',
            'restore_gluon']
 
 _MAGIC = b'MXTPUCKPT1\n'
+# v2 adds a CRC32 of the pickled payload right after the magic
+# (b'crc:%08x\n'): unpickle alone cannot catch a flipped byte that
+# still deserializes — silently-corrupt optimizer state is worse than
+# a torn file. v1 files (no CRC) stay readable.
+_MAGIC2 = b'MXTPUCKPT2\n'
+_CRC_LEN = len(b'crc:00000000\n')
 
 
 def _pid_alive(pid):
@@ -84,21 +91,40 @@ def atomic_write_bytes(path, payload):
 
 
 def save_state(path, state):
-    """Atomically persist a state dict (python/numpy values)."""
+    """Atomically persist a state dict (python/numpy values) with a
+    CRC32 of the payload in the header."""
     if not isinstance(state, dict):
         raise TypeError('state must be a dict, got %s' % type(state))
-    atomic_write_bytes(path, _MAGIC + pickle.dumps(state, protocol=4))
+    payload = pickle.dumps(state, protocol=4)
+    crc = b'crc:%08x\n' % (zlib.crc32(payload) & 0xffffffff)
+    atomic_write_bytes(path, _MAGIC2 + crc + payload)
 
 
 def load_state(path):
-    """Load a state dict; raises ValueError for torn/foreign files."""
+    """Load a state dict; raises ValueError for torn/foreign/corrupt
+    files (bad magic, CRC mismatch, or a payload that won't unpickle)."""
     with open(path, 'rb') as f:
         head = f.read(len(_MAGIC))
-        if head != _MAGIC:
+        if head == _MAGIC2:
+            crc_line = f.read(_CRC_LEN)
+            payload = f.read()
+            if not (crc_line.startswith(b'crc:') and
+                    crc_line.endswith(b'\n')):
+                raise ValueError('%s is torn or corrupt: truncated CRC '
+                                 'header' % path)
+            want = int(crc_line[4:-1], 16)
+            got = zlib.crc32(payload) & 0xffffffff
+            if got != want:
+                raise ValueError(
+                    '%s is torn or corrupt: CRC32 mismatch '
+                    '(header %08x, payload %08x)' % (path, want, got))
+        elif head == _MAGIC:
+            payload = f.read()  # v1 (pre-CRC) checkpoint
+        else:
             raise ValueError('%s is not a mxnet_tpu checkpoint '
                              '(bad magic)' % path)
         try:
-            return pickle.loads(f.read())
+            return pickle.loads(payload)
         except Exception as exc:
             raise ValueError('%s is torn or corrupt: %s' % (path, exc))
 

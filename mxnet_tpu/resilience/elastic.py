@@ -1,0 +1,154 @@
+"""Elastic mesh shrink: resume training on fewer devices.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (PAPERS.md) observes that replica-sharded training state is
+mechanically re-shardable across replica counts — exactly the property
+an elastic restart needs. Checkpoints here already store *logical*
+(full, host-side) arrays, so re-sharding is a placement decision, not
+a data transformation: restoring onto a smaller mesh just
+``device_put``s the same logical arrays under the new mesh's
+shardings. What this module owns is the *semantics* of the shrink:
+
+  * :func:`shrink_plan` — given the checkpoint's mesh and the devices
+    actually available after restart, decide the new mesh axes and the
+    gradient-accumulation factor that preserves the global batch:
+    halving ``dp`` 8→4 yields ``accum_steps=2``, so each optimizer
+    step still sees the same logical batch (two microbatches whose
+    mean-of-means equals the full-batch mean for equal sizes) and the
+    loss trajectory matches the uninterrupted run to fp32 tolerance.
+  * :func:`available_devices` — the restart-time device probe, with
+    the scripted ``device_loss`` fault
+    (``MXNET_TPU_FAULT=device_loss@elastic.restart:1``) halving the
+    reported devices so the whole shrink path is testable on CPU.
+  * :class:`MeshShrinkError` — the documented-divergence escape hatch:
+    a shrink that cannot preserve semantics (model-parallel axes no
+    longer fit, replica count not divisible, batch not splittable)
+    refuses loudly instead of silently training a different job.
+
+Documented divergences of an elastic-shrunk resume (also in
+docs/RESILIENCE.md): BatchNorm batch statistics are computed per
+*microbatch* under accumulation (smaller effective stat batch), and
+cross-replica reduction order changes — both are fp-tolerance, not
+bit-exact, effects. Only the data-parallel axis shrinks; ``tp``/``pp``
+shards are tied to program structure and a restart below their product
+raises :class:`MeshShrinkError`.
+"""
+from __future__ import annotations
+
+import logging
+
+from .policy import DeviceLossError, ResilienceError, inject
+
+__all__ = ['MeshShrinkError', 'ElasticPlan', 'shrink_plan',
+           'available_devices', 'mesh_meta']
+
+
+class MeshShrinkError(ResilienceError):
+    """The checkpoint's mesh cannot be mapped onto the surviving
+    devices without changing training semantics."""
+
+
+def mesh_meta(mesh):
+    """JSON-serializable description of a mesh, stored inside
+    checkpoints so restart can detect a device-count change."""
+    return {'axes': {k: int(v) for k, v in dict(mesh.shape).items()},
+            'device_count': int(mesh.size)}
+
+
+def available_devices(injector=None, platform=None):
+    """Devices visible after a restart.
+
+    The ``elastic.restart`` injection site consumes one scripted
+    ``device_loss`` fault and halves the reported device list — the
+    deterministic stand-in for "the slice came back smaller".
+    """
+    import jax
+    devs = jax.devices(platform) if platform else jax.devices()
+    try:
+        inject('elastic.restart', ('device_loss',), injector=injector)
+    except DeviceLossError as exc:
+        devs = devs[:max(1, len(devs) // 2)]
+        logging.warning('elastic: %s — restart sees %d device(s)',
+                        exc, len(devs))
+    return devs
+
+
+class ElasticPlan:
+    """How to resume a checkpoint on the devices actually present.
+
+    ``new_axes`` is the mesh to build; ``accum_steps`` microbatches per
+    optimizer step preserve the global batch (1 = no change);
+    ``changed`` is False when the mesh survives intact.
+    """
+
+    __slots__ = ('old_axes', 'new_axes', 'accum_steps', 'changed',
+                 'note')
+
+    def __init__(self, old_axes, new_axes, accum_steps, note=''):
+        self.old_axes = dict(old_axes)
+        self.new_axes = dict(new_axes)
+        self.accum_steps = int(accum_steps)
+        self.changed = dict(old_axes) != dict(new_axes)
+        self.note = note
+
+    def as_dict(self):
+        return {'old_axes': self.old_axes, 'new_axes': self.new_axes,
+                'accum_steps': self.accum_steps,
+                'changed': self.changed, 'note': self.note}
+
+    def __repr__(self):
+        return ('ElasticPlan(%s -> %s, accum_steps=%d)'
+                % (self.old_axes, self.new_axes, self.accum_steps))
+
+
+def shrink_plan(ckpt_mesh, n_devices, global_batch=None):
+    """Map a checkpointed mesh onto ``n_devices`` surviving devices.
+
+    ``ckpt_mesh`` is a :func:`mesh_meta` dict (or a Mesh). Only the
+    ``dp`` axis shrinks; the shrink factor must divide the old ``dp``
+    so each surviving replica adopts a whole number of lost replicas'
+    microbatches — that is what makes the resharding deterministic and
+    the accumulated gradient equal (to fp tolerance) to the full-batch
+    gradient. Raises :class:`MeshShrinkError` for anything that would
+    silently change training semantics.
+    """
+    if hasattr(ckpt_mesh, 'shape'):
+        ckpt_mesh = mesh_meta(ckpt_mesh)
+    old_axes = dict(ckpt_mesh['axes'])
+    old_total = int(ckpt_mesh.get('device_count') or 1)
+    n_devices = int(n_devices)
+    if n_devices >= old_total:
+        return ElasticPlan(old_axes, old_axes, 1,
+                           note='mesh intact (%d device(s))' % old_total)
+
+    old_dp = int(old_axes.get('dp', 1))
+    fixed = old_total // max(1, old_dp)     # tp/pp/sp/ep product
+    if n_devices < fixed or n_devices % fixed:
+        raise MeshShrinkError(
+            'cannot shrink mesh %s onto %d device(s): the non-dp axes '
+            'need a multiple of %d devices (model-parallel shards are '
+            'tied to program structure; documented divergence — only '
+            'the dp axis is elastic)' % (old_axes, n_devices, fixed))
+    new_dp = n_devices // fixed
+    if old_dp % new_dp:
+        raise MeshShrinkError(
+            'cannot shrink dp=%d onto dp=%d: the replica count must '
+            'divide evenly so each survivor adopts whole lost-replica '
+            'microbatches (got %d survivors for %d replicas); resume '
+            'on %s devices instead'
+            % (old_dp, new_dp, new_dp, old_dp,
+               sorted({fixed * d for d in range(1, old_dp + 1)
+                       if old_dp % d == 0})))
+    accum = old_dp // new_dp
+    if global_batch is not None and int(global_batch) % (new_dp * accum):
+        raise MeshShrinkError(
+            'global batch %d does not split into %d microbatches over '
+            'dp=%d' % (global_batch, accum, new_dp))
+    new_axes = dict(old_axes)
+    new_axes['dp'] = new_dp
+    plan = ElasticPlan(
+        old_axes, new_axes, accum,
+        note='dp %d->%d; global batch preserved via %d-step gradient '
+             'accumulation' % (old_dp, new_dp, accum))
+    logging.warning('elastic: %s (%s)', plan, plan.note)
+    return plan

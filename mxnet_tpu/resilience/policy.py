@@ -17,7 +17,8 @@ import time
 
 __all__ = ['ResilienceError', 'RetryExhausted', 'TimeoutExpired',
            'CircuitOpenError', 'InjectedFault', 'DeviceUnavailableError',
-           'TunnelStallError', 'WorkerCrashError', 'is_transient',
+           'TunnelStallError', 'WorkerCrashError', 'PreemptionSignal',
+           'HangError', 'DeviceLossError', 'is_transient',
            'Retry', 'Timeout', 'Deadline', 'CircuitBreaker',
            'FaultInjector', 'get_injector', 'inject', 'poison']
 
@@ -72,6 +73,23 @@ class TunnelStallError(InjectedFault):
 
 class WorkerCrashError(InjectedFault):
     """Scripted analog of a DataLoader worker dying mid-batch."""
+
+
+class PreemptionSignal(InjectedFault):
+    """Scripted analog of a SIGTERM from the resource manager (a TPU VM
+    preemption notice). Consumed by ``PreemptionHandler.check`` — it
+    requests a graceful stop, it never propagates out of a driver."""
+
+
+class HangError(InjectedFault):
+    """Scripted analog of a compiled step / collective that never
+    returns. Consumed by ``Watchdog.beat`` — the heartbeat goes stale
+    so the watchdog's stall detection path runs without real waiting."""
+
+
+class DeviceLossError(InjectedFault):
+    """Scripted analog of a restart coming back with fewer devices
+    (half the slice gone). Consumed by ``elastic.available_devices``."""
 
 
 # Substrings that mark an error as transient infrastructure trouble
@@ -299,6 +317,9 @@ _FAULT_CLASSES = {
     'device_unavailable': DeviceUnavailableError,
     'tunnel_stall': TunnelStallError,
     'worker_crash': WorkerCrashError,
+    'preempt': PreemptionSignal,
+    'hang': HangError,
+    'device_loss': DeviceLossError,
 }
 
 # Value faults: instead of raising, these corrupt a tensor with the
@@ -315,6 +336,11 @@ _FAULT_MESSAGES = {
                           "'tpu': UNAVAILABLE: tunnel down",
     'tunnel_stall': 'injected: DEADLINE_EXCEEDED: device tunnel stalled',
     'worker_crash': 'injected: dataloader worker crashed mid-batch',
+    'preempt': 'injected: SIGTERM preemption notice from the resource '
+               'manager',
+    'hang': 'injected: compiled step stopped heartbeating (hung '
+            'collective)',
+    'device_loss': 'injected: restart came back with fewer devices',
 }
 
 
@@ -336,12 +362,19 @@ class FaultInjector:
       device_unavailable                every matching site, forever
       device_unavailable:2              first two firings only
       worker_crash@dataloader.worker:1  one crash at one site
+      preempt@train.step.12:1           one firing at STEP 12 only
 
     Sites pass the fault kinds they honor to :meth:`fire`; an entry
     matches when its kind is honored there and its site (if given)
     equals the site name. Counts are consumed in spec order, so
     ``kind:2`` under a 3-attempt retry means fail-fail-succeed —
     deterministic recovery tests with no wall-clock dependence.
+
+    Step-qualified sites: per-step driver sites (``train.step``) pass
+    their step index to :meth:`fire`, which then also matches entries
+    scripted against ``<site>.<step>`` — so ``preempt@train.step.12:1``
+    preempts exactly at step 12 and ``hang@train.step.3:1`` hangs step
+    3, with no wall clock or real signal involved.
     """
 
     def __init__(self, spec=''):
@@ -370,27 +403,35 @@ class FaultInjector:
     def __bool__(self):
         return bool(self._entries)
 
-    def pending(self, site, kinds):
+    @staticmethod
+    def _site_names(site, step):
+        if step is None:
+            return (site,)
+        return (site, '%s.%d' % (site, step))
+
+    def pending(self, site, kinds, step=None):
         """True if :meth:`fire` would raise at ``site`` (no consume)."""
         with self._lock:
-            return self._match(site, kinds) is not None
+            return self._match(self._site_names(site, step),
+                               kinds) is not None
 
-    def _match(self, site, kinds):
+    def _match(self, sites, kinds):
         for entry in self._entries:
             if entry.remaining == 0:
                 continue
             if entry.kind not in kinds:
                 continue
-            if entry.site is not None and entry.site != site:
+            if entry.site is not None and entry.site not in sites:
                 continue
             return entry
         return None
 
-    def fire(self, site, kinds):
+    def fire(self, site, kinds, step=None):
         """Raise the first scripted fault matching ``site``/``kinds``,
-        consuming one firing; no-op when nothing matches."""
+        consuming one firing; no-op when nothing matches. ``step``
+        additionally matches ``<site>.<step>``-qualified entries."""
         with self._lock:
-            entry = self._match(site, kinds)
+            entry = self._match(self._site_names(site, step), kinds)
             if entry is None:
                 return
             if entry.remaining > 0:
@@ -404,7 +445,7 @@ class FaultInjector:
         0.0 when nothing is scripted. Unlike :meth:`fire` this never
         raises — value faults corrupt data, they don't kill calls."""
         with self._lock:
-            entry = self._match(site, kinds)
+            entry = self._match((site,), kinds)
             if entry is None:
                 return 0.0
             if entry.remaining > 0:
@@ -440,12 +481,12 @@ def get_injector():
         return cached
 
 
-def inject(site, kinds, injector=None):
+def inject(site, kinds, injector=None, step=None):
     """Module-level convenience: fire the (given or env-scripted)
     injector at ``site`` for the fault ``kinds`` that site honors."""
     inj = injector if injector is not None else get_injector()
     if inj:
-        inj.fire(site, kinds)
+        inj.fire(site, kinds, step=step)
 
 
 def poison(site, kinds=('nan', 'inf'), injector=None):

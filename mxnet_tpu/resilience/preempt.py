@@ -1,0 +1,222 @@
+"""Graceful preemption: SIGTERM drain + resumable exit.
+
+On real TPU fleets the dominant interruption is not a crash but a
+*notice*: the resource manager sends SIGTERM and reclaims the VM a
+grace period later. The reference stack had nothing for this (a
+preempted ps-lite worker just vanished); here the contract is explicit
+(docs/RESILIENCE.md "Preemption & elasticity"):
+
+  1. :class:`PreemptionHandler` catches SIGTERM/SIGINT (chaining any
+     previously-installed handler) and records a stop *request* — no
+     state is touched from the signal frame;
+  2. drivers poll :meth:`PreemptionHandler.check` at every step
+     boundary (``Module.fit`` batch loop, ``ParallelTrainer.step``);
+     the first boundary after the signal drains: an emergency
+     checkpoint is written through the existing atomic
+     ``CheckpointManager`` under the ``MXNET_TPU_PREEMPT_GRACE_S``
+     budget;
+  3. the process exits with the *resumable* exit code
+     (``MXNET_TPU_PREEMPT_EXIT_CODE``, default 75 = BSD EX_TEMPFAIL)
+     by raising :class:`Preempted` — a ``SystemExit`` subclass, so an
+     undecorated ``python train.py`` run exits cleanly with that code
+     and a supervising launcher knows "restart me, I checkpointed"
+     from the rc alone.
+
+Deterministic testing: the scripted fault kind ``preempt`` fires
+through :meth:`check`'s injection site, so
+``MXNET_TPU_FAULT=preempt@train.step.12:1`` preempts exactly at step
+12 with no real signal — CI exercises the whole drain → resumable-rc →
+restart → bit-identical-resume contract on CPU (tools/fault_smoke.py).
+"""
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from .policy import Deadline, PreemptionSignal, TimeoutExpired, inject
+
+__all__ = ['Preempted', 'PreemptionHandler', 'resumable_exit_code']
+
+_DEFAULT_EXIT_CODE = 75       # EX_TEMPFAIL: transient, retry the job
+
+
+def resumable_exit_code():
+    """The rc that marks an exit as 'preempted but resumable' —
+    launchers restart the same command on it (config knob
+    ``MXNET_TPU_PREEMPT_EXIT_CODE``; 75 = BSD EX_TEMPFAIL)."""
+    try:
+        from ..config import get as _cfg
+        return int(_cfg('MXNET_TPU_PREEMPT_EXIT_CODE'))
+    except ImportError:
+        return _DEFAULT_EXIT_CODE
+
+
+class Preempted(SystemExit):
+    """Raised at a step boundary after a preemption drain.
+
+    A ``SystemExit`` subclass: uncaught, the process exits with the
+    resumable rc and no traceback; tests catch it like any exception.
+    Carries ``step``, ``checkpoint`` (emergency checkpoint path or
+    None) and ``reason`` (signal name or injected-fault message).
+    """
+
+    def __init__(self, code, step=None, checkpoint=None, reason=None):
+        super().__init__(code)
+        self.step = step
+        self.checkpoint = checkpoint
+        self.reason = reason
+
+    def __str__(self):
+        return ('preempted at step %s (%s); emergency checkpoint: %s; '
+                'exiting with resumable rc %s'
+                % (self.step, self.reason, self.checkpoint, self.code))
+
+
+class PreemptionHandler:
+    """Graceful-stop coordinator for one training process.
+
+    Usage::
+
+        handler = PreemptionHandler().install()      # or: with ...:
+        for step in range(n):
+            if handler.check(step):                  # boundary poll
+                handler.drain(lambda: mgr.save(step, capture()))
+                handler.exit(step)                   # raises Preempted
+            train_step()
+
+    ``ParallelTrainer.attach_preemption`` and ``Module.fit(preempt=)``
+    run exactly this protocol internally. The handler never touches
+    training state from the signal frame — the signal only sets a
+    flag; all state movement happens at the next step boundary on the
+    driver thread.
+    """
+
+    def __init__(self, signals=None, exit_code=None, grace_s=None,
+                 injector=None, clock=time.monotonic):
+        self.signals = tuple(signals) if signals is not None \
+            else (signal.SIGTERM, signal.SIGINT)
+        self._explicit_exit_code = exit_code
+        self._grace_s = grace_s
+        self._injector = injector
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stop = False
+        self.reason = None
+        self.checkpoint_path = None
+        self._previous = {}
+        self._installed = False
+
+    # -- signal plumbing ---------------------------------------------------
+
+    @property
+    def exit_code(self):
+        return self._explicit_exit_code if self._explicit_exit_code \
+            is not None else resumable_exit_code()
+
+    @property
+    def grace_s(self):
+        if self._grace_s is not None:
+            return float(self._grace_s)
+        try:
+            from ..config import get as _cfg
+            return float(_cfg('MXNET_TPU_PREEMPT_GRACE_S'))
+        except ImportError:
+            return 30.0
+
+    def install(self):
+        """Register the signal handlers (main thread only — a no-op
+        with a warning-free fallback elsewhere: non-main threads rely
+        on the injected/explicit stop paths)."""
+        if self._installed:
+            return self
+        try:
+            for sig in self.signals:
+                self._previous[sig] = signal.signal(sig, self._on_signal)
+            self._installed = True
+        except ValueError:
+            # signal.signal outside the main thread — scripted faults
+            # and request_stop() still work; real signals cannot be
+            # caught from here anyway
+            self._previous = {}
+        return self
+
+    def uninstall(self):
+        for sig, old in self._previous.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, TypeError):
+                pass
+        self._previous = {}
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+    def _on_signal(self, signum, frame):
+        self.request_stop('signal %s'
+                          % signal.Signals(signum).name)
+        prev = self._previous.get(signum)
+        # chain a prior python-level handler (a launcher's own hook);
+        # default/ignore dispositions are not re-invoked — this handler
+        # replaces them by design
+        if callable(prev) and prev not in (signal.SIG_DFL,
+                                           signal.SIG_IGN):
+            prev(signum, frame)
+
+    # -- driver-facing protocol --------------------------------------------
+
+    @property
+    def stop_requested(self):
+        return self._stop
+
+    def request_stop(self, reason='requested'):
+        """Ask for a stop at the next step boundary (signal-frame and
+        thread safe: one flag write, no state movement)."""
+        with self._lock:
+            if not self._stop:
+                self._stop = True
+                self.reason = reason
+
+    def check(self, step=None, site='train.step'):
+        """Step-boundary poll: consumes any scripted ``preempt`` fault
+        for this site/step, then reports whether a stop is pending."""
+        try:
+            inject(site, ('preempt',), injector=self._injector,
+                   step=step)
+        except PreemptionSignal as sig:
+            self.request_stop(str(sig))
+        return self._stop
+
+    def drain(self, save):
+        """Write the emergency checkpoint under the grace budget.
+
+        ``save()`` does the actual checkpointing (typically
+        ``lambda: mgr.save(step, state)``) and its return value is
+        recorded as ``checkpoint_path``. A save that overruns the grace
+        budget is reported but not raised — on a real fleet the VM
+        would have been reclaimed mid-write, and the atomic write
+        protocol guarantees resume falls back to the last complete
+        checkpoint rather than reading a torn one.
+        """
+        deadline = Deadline(self.grace_s, clock=self._clock)
+        try:
+            self.checkpoint_path = save()
+            deadline.check('preemption drain')
+        except TimeoutExpired:
+            import warnings
+            warnings.warn(
+                'preemption drain overran the %.1fs grace budget '
+                '(MXNET_TPU_PREEMPT_GRACE_S) — on a real preemption '
+                'this checkpoint would have been lost; shrink the '
+                'checkpoint or raise the grace budget' % self.grace_s)
+        return self.checkpoint_path
+
+    def exit(self, step=None):
+        """Raise :class:`Preempted` with the resumable rc."""
+        raise Preempted(self.exit_code, step=step,
+                        checkpoint=self.checkpoint_path,
+                        reason=self.reason or 'preempted')

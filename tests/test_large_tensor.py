@@ -1,0 +1,71 @@
+"""int64 large-tensor guard (VERDICT Next #9): index paths over arrays
+with more than 2**31 elements must not silently truncate.
+
+The reference needed a special USE_INT64_TENSOR_SIZE build for this
+(tests/nightly/test_large_array.py); XLA sizes buffers with 64-bit
+arithmetic, so here the guard is a regression test: take / slice /
+argmax against elements whose FLAT offset exceeds int32 range must
+read the right values. Marked slow (allocates a ~2 GiB host array);
+skipped when the host lacks headroom.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+
+# (2**16, 2**15 + 1) int8 = 2_147_516_416 elements > 2**31: the last
+# row's flat offsets all exceed int32 range while the per-axis indices
+# stay small enough to be exactly representable in the float32 outputs
+# mx argmax returns
+ROWS, COLS = 2 ** 16, 2 ** 15 + 1
+
+
+def _mem_available_kb():
+    try:
+        with open('/proc/meminfo') as f:
+            for line in f:
+                if line.startswith('MemAvailable:'):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return None
+
+
+@pytest.mark.slow
+def test_int64_index_paths_beyond_2g_elements():
+    avail = _mem_available_kb()
+    if avail is not None and avail < 8 * 1024 * 1024:
+        pytest.skip('needs ~8 GiB free host memory, have %d kB' % avail)
+    if os.environ.get('JAX_PLATFORMS', 'cpu') != 'cpu':
+        pytest.skip('CPU-host large-tensor guard')
+
+    a = nd.zeros((ROWS, COLS), dtype='int8')
+    # markers in the LAST row: every flat offset here is > 2**31 - 1
+    a[ROWS - 1, COLS - 1] = 1     # flat index 2_147_516_415
+    a[ROWS - 1, 7] = 2
+
+    # slice: a read whose source offsets all exceed int32 range
+    tail = a[ROWS - 1:, COLS - 4:].asnumpy()
+    np.testing.assert_array_equal(tail, [[0, 0, 0, 1]])
+    assert int(a[ROWS - 1, 7].asnumpy()) == 2
+
+    # take along axis 0: gathering the >2**31-offset row must return
+    # its real contents, not a truncated-offset neighbor's
+    rows = nd.take(a, nd.array([0, ROWS - 1]), axis=0).asnumpy()
+    assert rows[0].sum() == 0
+    assert rows[1][COLS - 1] == 1 and rows[1][7] == 2
+    assert rows[1].sum() == 3
+
+    # argmax along axis 1: the reduction walks every >2**31 flat
+    # offset in the final row; a truncating index path would miss the
+    # marker or report a wrapped position
+    idx = nd.argmax(a, axis=1).asnumpy()
+    assert idx[ROWS - 1] == 7          # first maximum (value 2)
+    assert idx[: ROWS - 1].sum() == 0  # all-zero rows report 0
+
+    # argmax along axis 0 for the last column: the winning element
+    # lives at the largest flat offset in the buffer
+    col_idx = nd.argmax(a[:, COLS - 1:], axis=0).asnumpy()
+    assert col_idx[0] == ROWS - 1

@@ -296,6 +296,40 @@ def test_checkpoint_kill_between_write_keeps_last_good(tmp_path,
     assert step == 0 and state['v'] == 'good'
 
 
+def test_checkpoint_crc_catches_silent_corruption(tmp_path):
+    """A flipped byte mid-payload can still unpickle (silently wrong
+    optimizer state); the v2 CRC32 header catches it and latest()
+    falls back to the previous valid checkpoint."""
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(0, {'epoch': 0, 'w': np.arange(64.0)})
+    path1 = mgr.save(1, {'epoch': 1, 'w': np.arange(64.0) * 2})
+    raw = bytearray(open(path1, 'rb').read())
+    raw[-13] ^= 0xFF          # flip a byte inside the numpy payload
+    with open(path1, 'wb') as f:
+        f.write(raw)
+    with pytest.raises(ValueError, match='CRC32 mismatch'):
+        load_state(path1)
+    with pytest.warns(UserWarning, match='skipping unreadable'):
+        step, state = mgr.latest()
+    assert step == 0 and state['epoch'] == 0
+    # truncation (torn tail) is also caught, not just bit flips
+    path2 = mgr.save(2, {'epoch': 2, 'w': np.arange(64.0)})
+    with open(path2, 'r+b') as f:
+        f.truncate(os.path.getsize(path2) - 40)
+    with pytest.raises(ValueError):
+        load_state(path2)
+
+
+def test_checkpoint_v1_legacy_files_still_load(tmp_path):
+    """Pre-CRC (v1 magic) checkpoints written by earlier builds stay
+    readable."""
+    import pickle
+    path = str(tmp_path / 'old.ckpt')
+    with open(path, 'wb') as f:
+        f.write(b'MXTPUCKPT1\n' + pickle.dumps({'epoch': 7}))
+    assert load_state(path)['epoch'] == 7
+
+
 def test_checkpoint_manager_prunes_and_sweeps(tmp_path):
     mgr = CheckpointManager(str(tmp_path), keep=2)
     # a dead writer's leftover (pid beyond pid_max is never alive) is
@@ -478,6 +512,49 @@ def test_kvstore_collectives_retry_transient(monkeypatch):
     # both scripted stalls were consumed by successful retries
     assert not get_injector().pending('kvstore.push', ('tunnel_stall',))
     assert not get_injector().pending('kvstore.pull', ('tunnel_stall',))
+
+
+def test_kvstore_worker_crash_rejoins_instead_of_failing(monkeypatch):
+    """A dist worker that dies mid-handshake rejoins: the join is
+    re-run from scratch instead of surfacing KVStoreInitError
+    (reference: ps-lite re-registered dead workers)."""
+    # 4 scripted crashes: 3 exhaust the first join's retries, the
+    # rejoin consumes the 4th and succeeds on its second attempt
+    monkeypatch.setenv('MXNET_TPU_FAULT',
+                       'worker_crash@kvstore.init:4')
+    with pytest.warns(UserWarning, match='rejoin'):
+        kv = mx.kv.create('dist_sync')
+    assert kv.type == 'dist_sync'
+    # non-crash-shaped init failure still raises the typed error
+    from mxnet_tpu.kvstore import KVStoreInitError
+    monkeypatch.setenv('MXNET_TPU_FAULT',
+                       'device_unavailable@kvstore.init')
+    with pytest.raises(KVStoreInitError):
+        mx.kv.create('dist_sync')
+
+
+def test_kvstore_collective_retry_exhaustion_is_typed(monkeypatch):
+    """A PERSISTENT mid-collective fault exhausts the bounded retry
+    and surfaces RetryExhausted with the attempt count — the
+    _comm_retry path under injection (vs the recovering case in
+    test_kvstore_collectives_retry_transient)."""
+    from mxnet_tpu.kvstore import KVStore
+    from mxnet_tpu.resilience.policy import TunnelStallError
+    kv = KVStore('dist_sync')
+    monkeypatch.setattr(KVStore, 'num_workers',
+                        property(lambda self: 2))
+    monkeypatch.setenv('MXNET_TPU_FAULT', 'tunnel_stall@kvstore.push')
+    kv.init('w', nd.ones((3,)))
+    with pytest.raises(RetryExhausted) as ei:
+        kv.push('w', nd.full((3,), 2.0))
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last_error, TunnelStallError)
+    # a mid-collective crash is NOT healable by per-process rejoin
+    # (docs/RESILIENCE.md): only the init handshake honors
+    # worker_crash, push exhaustion stays typed
+    monkeypatch.setenv('MXNET_TPU_FAULT', 'tunnel_stall@kvstore.pull')
+    with pytest.raises(RetryExhausted):
+        kv._barrier()
 
 
 # ---------------------------------------------------------------------------

@@ -8,10 +8,18 @@ Stages, each timed:
   2. fault injection       tools/fault_smoke.py — bench.py under
                            MXNET_TPU_FAULT=device_unavailable must
                            degrade (rc=0 + status artifact), not
-                           crash, AND the NaN-injection guardrail
+                           crash; the NaN-injection guardrail
                            contract (MXNET_TPU_FAULT=nan@grads:2 ⇒
                            skip → rollback → replay converging,
-                           python -m mxnet_tpu.guardrail)
+                           python -m mxnet_tpu.guardrail); the
+                           preemption contract (injected SIGTERM
+                           mid-run ⇒ emergency checkpoint + resumable
+                           rc; restart ⇒ bit-identical params); the
+                           elastic mesh-shrink resume (8→4 devices,
+                           grad accumulation, fp32-tolerance losses);
+                           and the stall watchdog (injected hang ⇒
+                           mxnet_tpu.stall.v1 artifact), all via
+                           python -m mxnet_tpu.resilience
   3. C ABI audit           tools/capi_coverage.py == 207/207
   4. copy-paste gate       tools/overlap_check.py --sweep 0.60
   5. example smokes        3 representative workloads (LeNet both

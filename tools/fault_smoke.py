@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Fault-injection CI tier (tools/ci.py stage 'resilience').
+"""Fault-injection CI tier (tools/ci.py stage 'fault-inject').
 
-Three checks:
+Six checks:
   1. tests/test_resilience.py passes (policy math, checkpoint resume,
      worker restart — the deterministic fault suite).
   2. bench.py in forced-degraded mode: with
@@ -15,24 +15,56 @@ Three checks:
      scale each time, trip the persistent-non-finite policy, roll back
      to the last-good snapshot, and replay to within 1e-5 of an
      uninterrupted run (docs/GUARDRAILS.md).
+  4. Preemption contract (python -m mxnet_tpu.resilience): an injected
+     SIGTERM-analog mid-run must drain an emergency checkpoint and
+     exit with the resumable rc; re-running the same command must
+     resume at the preempted step and finish with params
+     BIT-IDENTICAL to an uninterrupted run.
+  5. Elastic mesh shrink: the same checkpoint resumed on a HALVED
+     virtual mesh (8 -> 4 devices) must engage 2-step gradient
+     accumulation and match the uninterrupted loss trajectory to fp32
+     tolerance.
+  6. Stall watchdog: an injected hang@train.step must be detected
+     within the stall budget and emit the structured
+     mxnet_tpu.stall.v1 artifact.
 
 Usage: python tools/fault_smoke.py [--skip-tests]
-(--skip-tests runs only the bench + guardrail checks; ci.py's fast
+(--skip-tests runs only the subprocess contract checks; ci.py's fast
 tier already ran the test files, so the gate uses it to avoid double
 work.)
 """
 import json
 import os
+import shutil
 import subprocess
 import sys
 import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_REQUIRED_KEYS = {'schema', 'name', 'status', 'backend', 'error',
-                  'payload'}
+_REQUIRED_KEYS = {'schema', 'name', 'status', 'backend', 'resumable',
+                  'error', 'payload'}
 _REQUIRED_BACKEND_KEYS = {'state', 'platform', 'device_kind',
                           'device_count', 'attempts', 'error'}
+_REQUIRED_RESUMABLE_KEYS = {'preempted', 'reason', 'exit_code'}
+_RESUMABLE_RC = 75          # MXNET_TPU_PREEMPT_EXIT_CODE default
+_STALL_KEYS = {'schema', 'name', 'phase', 'step', 'waited_s',
+               'budget_s', 'pid', 'thread_stacks'}
+
+
+def _selftest(argv, devices, fault=None, timeout=420):
+    """Run `python -m mxnet_tpu.resilience` on a virtual CPU mesh."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               XLA_FLAGS='--xla_force_host_platform_device_count=%d'
+                         % devices)
+    env.pop('MXNET_TPU_FAULT', None)
+    if fault:
+        env['MXNET_TPU_FAULT'] = fault
+    return subprocess.run(
+        [sys.executable, '-m', 'mxnet_tpu.resilience'] + argv
+        + ['--devices', str(devices)],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
 
 
 def run_faulted_bench():
@@ -63,6 +95,10 @@ def run_faulted_bench():
             problems.append('backend keys %s != required %s'
                             % (sorted(art['backend']),
                                sorted(_REQUIRED_BACKEND_KEYS)))
+        elif set(art['resumable']) != _REQUIRED_RESUMABLE_KEYS:
+            problems.append('resumable keys %s != required %s'
+                            % (sorted(art['resumable']),
+                               sorted(_REQUIRED_RESUMABLE_KEYS)))
         if art.get('status') == 'ok':
             problems.append("status is 'ok' under forced device fault")
         if art.get('status') not in ('degraded', 'unavailable'):
@@ -116,10 +152,143 @@ def run_nan_guardrail():
         return True
 
 
+def run_preempt_resume():
+    """Checks 4+5: preempt -> resumable rc -> bit-identical resume,
+    then the same checkpoint resumed on a halved mesh to fp32
+    tolerance."""
+    with tempfile.TemporaryDirectory() as tmp:
+        ref_out = os.path.join(tmp, 'ref.json')
+        a_out = os.path.join(tmp, 'a.json')
+        b_out = os.path.join(tmp, 'b.json')
+        c_out = os.path.join(tmp, 'c.json')
+        d_ref = os.path.join(tmp, 'ck_ref')
+        d_run = os.path.join(tmp, 'ck_run')
+        train = ['--train', '--steps', '18', '--ckpt-dir']
+
+        # uninterrupted reference on the 8-device virtual mesh
+        r = _selftest(train + [d_ref, '--out', ref_out], devices=8)
+        if r.returncode != 0:
+            print('FAIL: uninterrupted selftest exited %d\n%s\n%s'
+                  % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        ref = json.load(open(ref_out))
+
+        # preempted run: must exit with the RESUMABLE rc, not 0/1
+        r = _selftest(train + [d_run, '--out', a_out], devices=8,
+                      fault='preempt@train.step.9:1')
+        if r.returncode != _RESUMABLE_RC:
+            print('FAIL: preempted run exited %d, want resumable rc %d'
+                  '\n%s\n%s' % (r.returncode, _RESUMABLE_RC,
+                                r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        if not any(f.endswith('.ckpt') for f in os.listdir(d_run)):
+            print('FAIL: preempted run drained no emergency checkpoint')
+            return False
+        # snapshot the drained state NOW: the same-mesh resume below
+        # writes newer checkpoints into d_run, and the elastic leg
+        # must resume from the preemption point, not from those
+        d_elastic = os.path.join(tmp, 'ck_elastic')
+        shutil.copytree(d_run, d_elastic)
+
+        # restart, same command: bit-identical params to the reference
+        r = _selftest(train + [d_run, '--out', b_out], devices=8)
+        if r.returncode != 0:
+            print('FAIL: resumed run exited %d\n%s\n%s'
+                  % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        b = json.load(open(b_out))
+        problems = []
+        if b['start_step'] != 9:
+            problems.append('resumed at step %r, want 9'
+                            % b['start_step'])
+        if b['param_hash'] != ref['param_hash']:
+            problems.append(
+                'resumed params NOT bit-identical to uninterrupted '
+                '(%s != %s)' % (b['param_hash'][:12],
+                                ref['param_hash'][:12]))
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        print('preempt/resume: rc=%d on preempt, resumed@9, params '
+              'bit-identical' % _RESUMABLE_RC)
+
+        # elastic shrink: resume the preemption-time checkpoint on 4
+        # devices. The emergency checkpoint at step 9 is the newest;
+        # the shrunk run must engage accum=2 and track the reference
+        # losses over the whole remaining window.
+        r = _selftest(train + [d_elastic, '--out', c_out], devices=4)
+        if r.returncode != 0:
+            print('FAIL: elastic resume exited %d\n%s\n%s'
+                  % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        c = json.load(open(c_out))
+        problems = []
+        if c['accum'] != 2 or c['mesh'].get('dp') != 4:
+            problems.append('elastic plan accum=%r mesh=%r, want '
+                            'accum=2 dp=4' % (c['accum'], c['mesh']))
+        # the resumed run starts from the step-9 checkpoint the run on
+        # 8 devices drained; compare its per-step losses to the same
+        # window of the uninterrupted run (fp32 tolerance: reduction
+        # order changes across meshes, bit-exactness does not hold)
+        start = c['start_step']
+        ref_window = ref['losses'][start:]
+        if len(c['losses']) != len(ref_window) or not ref_window:
+            problems.append('elastic run produced %d losses, want %d'
+                            % (len(c['losses']), len(ref_window)))
+        else:
+            worst = max(abs(x - y) / max(abs(y), 1e-6)
+                        for x, y in zip(c['losses'], ref_window))
+            if worst > 5e-3:
+                problems.append('elastic loss trajectory diverged: '
+                                'worst rel err %.2e > 5e-3' % worst)
+            else:
+                print('elastic shrink: dp 8->4, accum=2, worst rel '
+                      'loss err %.2e' % worst)
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        return True
+
+
+def run_watchdog_smoke():
+    """Check 6: injected hang detected within the stall budget, with
+    the structured mxnet_tpu.stall.v1 artifact."""
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, 'w.json')
+        stall = os.path.join(tmp, 'STALL.json')
+        r = _selftest(['--watchdog-smoke', '--steps', '6', '--out', out,
+                       '--stall-artifact', stall], devices=1,
+                      fault='hang@train.step.3:1')
+        if r.returncode != 0:
+            print('FAIL: watchdog smoke exited %d\n%s\n%s'
+                  % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        verdict = json.load(open(out))
+        problems = []
+        if not verdict.get('detected'):
+            problems.append('hang not detected')
+        if not os.path.exists(stall):
+            problems.append('no stall artifact written')
+        else:
+            art = json.load(open(stall))
+            if set(art) != _STALL_KEYS:
+                problems.append('stall artifact keys %s != %s'
+                                % (sorted(art), sorted(_STALL_KEYS)))
+            elif art['schema'] != 'mxnet_tpu.stall.v1':
+                problems.append('stall schema %r' % art['schema'])
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        print('watchdog: injected hang@step.3 detected, stall artifact '
+              'schema ok')
+        return True
+
+
 def run_resilience_tests():
     r = subprocess.run(
         [sys.executable, '-m', 'pytest', 'tests/test_resilience.py',
-         'tests/test_guardrail.py', '-q', '-p', 'no:cacheprovider'],
+         'tests/test_guardrail.py', 'tests/test_elastic.py', '-q',
+         '-p', 'no:cacheprovider'],
         cwd=REPO)
     return r.returncode == 0
 
@@ -131,6 +300,8 @@ def main(argv=None):
         ok = run_resilience_tests()
     ok = run_faulted_bench() and ok
     ok = run_nan_guardrail() and ok
+    ok = run_preempt_resume() and ok
+    ok = run_watchdog_smoke() and ok
     print('fault_smoke: %s' % ('OK' if ok else 'FAIL'))
     return 0 if ok else 1
 

@@ -29,6 +29,13 @@ with and without the in-jit health sentinel + cond-guarded update,
 plus the HLO op-count delta showing the sentinel is a fused reduction
 (outfeed/infeed stay 0 — no host sync added per step).
 
+A fourth line records the telemetry A/B (telemetry_overhead_pct,
+docs/OBSERVABILITY.md): the SAME compiled step timed with the unified
+telemetry layer on vs off (< 1% bar — the instruments live on the host
+dispatch path only). The artifact payload also carries a 'telemetry'
+summary block (registry snapshot + flight-recorder stats) so every
+bench run ships its own machine-captured evidence.
+
 Degraded-mode contract (docs/RESILIENCE.md): besides the stdout metric
 lines, every run writes an atomic JSON artifact (--out, default
 BENCH.json) with "status": "ok" | "degraded" | "unavailable" and exits
@@ -103,6 +110,19 @@ def _measure(step, warmup, iters, nd):
 def _guardrail_on():
     from mxnet_tpu import config
     return bool(config.get('MXNET_TPU_GUARDRAIL'))
+
+
+def _telemetry_summary():
+    """Compact registry + flight-recorder summary folded into the bench
+    artifact so every bench run carries its own machine-captured
+    evidence (steps dispatched, compile counts, phase split, jit-cache
+    behavior — docs/OBSERVABILITY.md)."""
+    try:
+        from mxnet_tpu import observability
+        return observability.summary()
+    except Exception as e:     # telemetry must never sink the artifact
+        return {'enabled': False,
+                'error': '%s: %s' % (type(e).__name__, e)}
 
 
 def _emit(metric, rate, unit, baseline, flops_per_sample, step_path):
@@ -275,6 +295,36 @@ def bench_bert(on_accel):
                  step_path)
 
 
+def _tiny_cnn_trainer(batch, image, guardrail=False):
+    """Shared cnn-tiny A/B rig (guardrail + telemetry overhead legs):
+    fixed seeds, same model/mesh, fused step compiled on return — so
+    the two overhead records measure the same program family."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon import nn
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 3, padding=1, activation='relu'),
+                nn.Conv2D(32, 3, padding=1, activation='relu'),
+                nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.uniform(-1, 1, (batch, 3, image, image)),
+                 dtype='float32')
+    y = nd.array(np.random.randint(0, 10, (batch,)))
+    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+    pt = parallel.ParallelTrainer(
+        net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9},
+        mesh, guardrail=guardrail)
+    pt.step(x, y)    # compile
+    return pt, x, y
+
+
 def bench_guardrail(on_accel):
     """Guardrail-on vs guardrail-off compiled-step A/B.
 
@@ -285,10 +335,7 @@ def bench_guardrail(on_accel):
     recorded alongside the timing to show the overhead is structural,
     not a host round-trip (outfeed/infeed must stay zero).
     """
-    import jax
-    import mxnet_tpu as mx
-    from mxnet_tpu import gluon, nd, parallel
-    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import nd
     from mxnet_tpu.guardrail import Guardrail, GuardrailConfig
     from mxnet_tpu.resilience import FaultInjector
 
@@ -297,25 +344,7 @@ def bench_guardrail(on_accel):
     warmup, iters, reps = (5, 40, 2) if on_accel else (2, 8, 3)
 
     def build(guard):
-        np.random.seed(0)
-        mx.random.seed(0)
-        net = nn.HybridSequential()
-        with net.name_scope():
-            net.add(nn.Conv2D(16, 3, padding=1, activation='relu'),
-                    nn.Conv2D(32, 3, padding=1, activation='relu'),
-                    nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(10))
-        net.initialize(mx.init.Xavier())
-        net.hybridize(static_alloc=True, static_shape=True)
-        L = gluon.loss.SoftmaxCrossEntropyLoss()
-        x = nd.array(np.random.uniform(-1, 1, (batch, 3, image, image)),
-                     dtype='float32')
-        y = nd.array(np.random.randint(0, 10, (batch,)))
-        mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
-        pt = parallel.ParallelTrainer(
-            net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9},
-            mesh, guardrail=guard)
-        pt.step(x, y)    # compile
-        return pt, x, y
+        return _tiny_cnn_trainer(batch, image, guardrail=guard)
 
     def hlo_counts(text):
         return {'reduce': text.count(' reduce('),
@@ -391,6 +420,60 @@ def bench_guardrail(on_accel):
     return rec
 
 
+def bench_telemetry(on_accel):
+    """Telemetry-on vs telemetry-off compiled-step A/B
+    (docs/OBSERVABILITY.md).
+
+    One trainer, one compiled program — the telemetry layer never
+    touches the XLA program, only the host dispatch path (a handful of
+    counter incs, one histogram observe, one flight-ring append per
+    step) — so the A/B toggles the master switch around interleaved
+    timed windows of the SAME step. The acceptance bar is < 1%
+    overhead (within the host's noise floor); the disabled path is
+    additionally proven allocation-free by the observability selftest.
+    """
+    from mxnet_tpu import nd, observability
+
+    batch = 128 if on_accel else 32
+    image = 64 if on_accel else 32
+    warmup, iters, reps = (5, 40, 2) if on_accel else (2, 8, 3)
+
+    # compile once; both modes time the SAME program
+    pt, x, y = _tiny_cnn_trainer(batch, image)
+
+    # interleaved min-of-reps (the guardrail-A/B protocol): host noise
+    # hits both modes alike and the min discards it
+    times = {'off': [], 'on': []}
+    prev = observability.enabled()
+    try:
+        for _ in range(reps):
+            for mode in ('off', 'on'):
+                observability.set_enabled(mode == 'on')
+                times[mode].append(
+                    _measure(lambda: pt.step(x, y), warmup, iters, nd))
+    finally:
+        observability.set_enabled(prev)
+    off = round(min(times['off']) * 1e3, 4)
+    on = round(min(times['on']) * 1e3, 4)
+    overhead = 100.0 * (on / off - 1.0)
+    noise = 100.0 * max(
+        (max(ts) - min(ts)) / min(ts) for ts in times.values())
+    rec = {
+        'metric': 'telemetry_overhead_pct',
+        'value': round(overhead, 2),
+        'unit': '%',
+        'noise_pct': round(noise, 2),
+        'per_step_ms_off': off,
+        'per_step_ms_on': on,
+        'model': 'cnn-tiny bs%d %dpx' % (batch, image),
+        # same compiled program in both modes by construction: the
+        # instruments live on the host dispatch path only
+        'same_compiled_program': True,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument('--out', default='BENCH.json',
@@ -412,7 +495,8 @@ def main(argv=None):
               % (status.attempts, status.error, args.out), flush=True)
         write_artifact(args.out, artifact_record(
             'bench', 'unavailable', backend=status, error=status.error,
-            payload={'metrics': []}, preempt=handler))
+            payload={'metrics': [], 'telemetry': _telemetry_summary()},
+            preempt=handler))
         return 0
 
     on_accel = status.state == 'tpu'
@@ -454,6 +538,16 @@ def main(argv=None):
             error = '%s: %s' % (type(e).__name__, str(e)[:300])
             print('bench: guardrail A/B leg lost to a transient fault '
                   '(%s)' % error, flush=True)
+    if not handler.stop_requested:
+        try:
+            metrics.append(bench_telemetry(on_accel))
+        except Exception as e:
+            if not (isinstance(e, InjectedFault) or is_transient(e)):
+                raise
+            verdict = 'degraded'
+            error = '%s: %s' % (type(e).__name__, str(e)[:300])
+            print('bench: telemetry A/B leg lost to a transient fault '
+                  '(%s)' % error, flush=True)
 
     if handler.stop_requested:
         # preempted mid-bench: the legs already measured stay in the
@@ -465,7 +559,8 @@ def main(argv=None):
         print('bench: %s' % error, flush=True)
     write_artifact(args.out, artifact_record(
         'bench', verdict, backend=status, error=error,
-        payload={'metrics': metrics}, preempt=handler))
+        payload={'metrics': metrics,
+                 'telemetry': _telemetry_summary()}, preempt=handler))
     print('bench: status=%s artifact=%s' % (verdict, args.out),
           flush=True)
     return handler.exit_code if handler.stop_requested else 0

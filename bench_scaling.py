@@ -22,48 +22,20 @@ table), so efficiency = rate(dp) / (dp * rate(1)).
 """
 import argparse
 import json
-import re
 import time
 
 import numpy as np
 
-_COLLECTIVES = ('all-reduce', 'all-gather', 'reduce-scatter',
-                'collective-permute', 'all-to-all')
-_DTYPE_BYTES = {'f64': 8, 'f32': 4, 'bf16': 2, 'f16': 2, 's64': 8,
-                's32': 4, 'u32': 4, 's16': 2, 'u16': 2, 's8': 1,
-                'u8': 1, 'pred': 1}
-
-
 def collective_bytes(hlo_text):
-    """Sum output bytes of collective ops in optimized HLO text."""
-    total = 0
-    per_kind = {}
-    for line in hlo_text.splitlines():
-        m = re.search(r'=\s+((?:\([^)]*\)|\S+))\s+(%?[\w-]+)\(', line)
-        if not m:
-            continue
-        kind = m.group(2).lstrip('%')
-        base = kind.rstrip('.0123456789')
-        if not any(base.startswith(c) for c in _COLLECTIVES):
-            continue
-        # async pairs (all-reduce-start / all-reduce-done): the -start
-        # op's tuple output would double-count the one logical
-        # collective — count only the -done (or sync) form
-        if base.endswith('-start'):
-            continue
-        shapes = re.findall(r'(\w+)\[([\d,]*)\]', m.group(1))
-        nbytes = 0
-        for dt, dims in shapes:
-            if dt not in _DTYPE_BYTES:
-                continue
-            count = 1
-            for d in dims.split(','):
-                if d:
-                    count *= int(d)
-            nbytes += count * _DTYPE_BYTES[dt]
-        total += nbytes
-        per_kind[base] = per_kind.get(base, 0) + nbytes
-    return total, per_kind
+    """Sum output bytes of collective ops in optimized HLO text.
+
+    The accounting now lives in the library
+    (mxnet_tpu/observability/hlo.py) so normal training runs can
+    record their own comm volume; this compatibility shim delegates
+    lazily — the bench drivers keep all mxnet_tpu imports inside
+    functions so ``--help`` stays instant."""
+    from mxnet_tpu.observability.hlo import collective_bytes as impl
+    return impl(hlo_text)
 
 
 def _build(model, dp, batch_per_chip, image, devices):

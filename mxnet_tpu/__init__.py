@@ -62,6 +62,7 @@ from . import kvstore_server
 from . import executor_manager
 from . import resilience
 from . import guardrail
+from . import observability
 
 # env-driven global seed (docs/faq/env_var.md MXNET_SEED)
 _seed = config.get('MXNET_SEED')

@@ -164,6 +164,32 @@ KNOBS = {k.name: k for k in [
           ' acquisition attempts.'),
     _knob('MXNET_TPU_ACQUIRE_DEADLINE_S', float, 300.0,
           'Total wall-clock budget for backend acquisition retries.'),
+    # telemetry / observability (docs/OBSERVABILITY.md)
+    _knob('MXNET_TPU_TELEMETRY', bool, True,
+          'Master switch for the unified telemetry layer (metrics'
+          ' registry + step-phase spans + flight recorder). 0 turns'
+          ' every instrument into a flag-check no-op with no per-step'
+          ' allocation.'),
+    _knob('MXNET_TPU_TELEMETRY_HTTP_PORT', int, 0,
+          'Port for the stdlib Prometheus /metrics HTTP endpoint'
+          ' (binds 127.0.0.1). 0 (default) keeps the server off;'
+          ' production scrapes tail the file exporter instead.'),
+    _knob('MXNET_TPU_TELEMETRY_HLO', bool, False,
+          'Automatically account per-step collective bytes (optimized-'
+          'HLO analysis) into the registry after each ParallelTrainer'
+          ' build. Off by default: the accounting re-lowers the'
+          ' program once per build; drivers can instead call'
+          ' observability.trainer_collective_stats explicitly.'),
+    _knob('MXNET_TPU_FLIGHT', bool, True,
+          'Flight recorder enable (subordinate to MXNET_TPU_TELEMETRY):'
+          ' keep a bounded ring of structured run events and dump a'
+          ' mxnet_tpu.flight.v1 JSONL artifact on crash / stall /'
+          ' preemption.'),
+    _knob('MXNET_TPU_FLIGHT_CAPACITY', int, 2048,
+          'Flight recorder ring size (events); the oldest events drop'
+          ' when full.'),
+    _knob('MXNET_TPU_FLIGHT_PATH', str, 'FLIGHT.jsonl',
+          'Default dump path for the flight-recorder artifact.'),
     # preemption / elasticity / watchdog (docs/RESILIENCE.md)
     _knob('MXNET_TPU_PREEMPT_EXIT_CODE', int, 75,
           'Process exit code marking a preempted-but-resumable run'

@@ -97,6 +97,7 @@ class Guardrail:
         self.events = deque(maxlen=self.config.event_log)
         self._pending = deque()
         self._recorded = 0
+        self._last_scale_seen = None
         self.steps = 0
         self.skips = 0
         self.trips = 0
@@ -157,11 +158,50 @@ class Guardrail:
             self.steps += 1
             if not healthy:
                 self.skips += 1
+            self._telemetry(event)
             trip = self.policy.observe(step, healthy, gnorm, loss)
             if trip is not None:
                 event['action'] = 'trip'
                 self.trips += 1
+                from .. import observability as _obs
+                if _obs.enabled():
+                    _obs.record_event('guardrail_trip', step=int(step),
+                                      reason=str(trip)[:200])
                 raise GuardrailTripped(trip, events=list(self.events))
+
+    def _telemetry(self, event):
+        """Mirror one decoded sentinel event into the unified telemetry
+        layer (docs/OBSERVABILITY.md): grad-norm / loss-scale gauges,
+        skip + non-finite counters, and flight-recorder events for
+        skip-updates and loss-scale changes. Runs at poll time (already
+        a host sync), so the compiled step stays untouched."""
+        from .. import observability as _obs
+        if not _obs.enabled():
+            return
+        import math
+        inst = _obs.trainer_instruments()
+        # a non-finite batch decodes a NaN/Inf norm — keep it out of
+        # the gauge/flight ring: json.dumps would emit a bare NaN token
+        # and break the strict-JSONL artifact contract
+        gnorm = event['grad_norm']
+        if not math.isfinite(gnorm):
+            gnorm = None
+        if gnorm is not None:
+            inst.grad_norm.set(gnorm)
+        scale = event['scale']
+        if scale is not None:
+            inst.loss_scale.set(scale)
+            if self._last_scale_seen is not None and \
+                    scale != self._last_scale_seen:
+                _obs.record_event('loss_scale', step=event['step'],
+                                  scale=scale,
+                                  previous=self._last_scale_seen)
+            self._last_scale_seen = scale
+        if not event['healthy']:
+            inst.skipped.inc()
+            inst.nonfinite.inc()
+            _obs.record_event('skip_update', step=event['step'],
+                              grad_norm=gnorm, scale=scale)
 
     def flush(self):
         """Process everything outstanding (sync point)."""

@@ -55,6 +55,24 @@ class KVStoreInitError(RuntimeError):
         self.last_cause = last_cause
 
 
+def _on_comm_retry(attempt, exc, pause):
+    """Telemetry tap for dist-collective retries: retry counter + a
+    flight-recorder event (retries are exactly the history a stalled-
+    collective post-mortem needs). Runs INSIDE Retry.call's recovery
+    loop — a telemetry failure here must never abort the remaining
+    retry attempts for the transient error being healed."""
+    try:
+        from . import observability as _obs
+        if _obs.enabled():
+            _obs.kv_instruments().retries.inc()
+            _obs.record_event('retry', site='kvstore',
+                              attempt=int(attempt),
+                              error=str(exc)[:160],
+                              pause_s=round(float(pause), 3))
+    except Exception:
+        pass
+
+
 def _comm_retry():
     """Backoff policy for dist collectives (init/push/pull): transient
     tunnel errors get bounded retries; deterministic errors propagate.
@@ -68,7 +86,19 @@ def _comm_retry():
     collective timeout. The deterministic parameters below (no jitter)
     keep retrying workers aligned."""
     return Retry(max_attempts=3, base_delay=1.0, max_delay=30.0,
-                 jitter=0.0, predicate=is_transient)
+                 jitter=0.0, predicate=is_transient,
+                 on_retry=_on_comm_retry)
+
+
+def _nbytes(value):
+    """Logical payload size of one pushed/pulled NDArray (telemetry)."""
+    data = getattr(value, '_data', value)
+    nbytes = getattr(data, 'nbytes', None)
+    if nbytes is not None:
+        return int(nbytes)
+    size = getattr(data, 'size', 0)
+    itemsize = getattr(getattr(data, 'dtype', None), 'itemsize', 4)
+    return int(size) * int(itemsize)
 
 
 def _ctype_key_value(keys, vals):
@@ -122,6 +152,8 @@ class KVStore:
         in dist mode the sum is allreduced across workers.
         """
         keys, vals = _ctype_key_value(key, value)
+        from . import observability as _obs
+        tel = _obs.kv_instruments() if _obs.enabled() else None
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
                 merged = v[0]
@@ -130,6 +162,8 @@ class KVStore:
             else:
                 merged = v
             merged = self._compress(k, merged)
+            if tel is not None:
+                tel.push_bytes.inc(_nbytes(merged))
             merged = self._allreduce(merged)
             if self._updater is not None:
                 if k not in self._data:
@@ -146,8 +180,13 @@ class KVStore:
         reduced push) into out (reference: kvstore.py pull)."""
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
+        from . import observability as _obs
+        tel = _obs.kv_instruments() if _obs.enabled() else None
         for k, o in zip(keys, outs):
             src = self._data[k]
+            if tel is not None:
+                fanout = len(o) if isinstance(o, (list, tuple)) else 1
+                tel.pull_bytes.inc(_nbytes(src) * fanout)
             if isinstance(o, (list, tuple)):
                 for oo in o:
                     src.copyto(oo)
@@ -219,6 +258,10 @@ class KVStore:
         if self._type.startswith(('dist', 'horovod')):
             _join_distributed(self._type, rejoin=True)
             self._barrier()
+        from . import observability as _obs
+        if _obs.enabled():
+            _obs.kv_instruments().rejoins.inc()
+            _obs.record_event('kv_rejoin', kv_type=self._type)
         return self
 
     # -- optimizer hosting -------------------------------------------------

@@ -17,6 +17,7 @@ import numpy as onp
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import observability as _obs
 from ..ndarray import NDArray
 from ..io import DataBatch
 
@@ -298,11 +299,16 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
 
         from ..guardrail.anomaly import GuardrailTripped
+        tel_inst = _obs.trainer_instruments() if _obs.enabled() else None
         epoch = begin_epoch
         while epoch < num_epoch:
             t_start = time.time()
             eval_metric.reset()
             nbatch = 0
+            if tel_inst is not None:
+                tel_inst.epoch.set(epoch)
+                _obs.record_event('epoch', epoch=epoch,
+                                  global_step=global_step)
             feed = iter(train_data)
             if skip_batches:
                 # sampler fast-forward: replay the resumed epoch's
@@ -314,7 +320,8 @@ class BaseModule:
                         break
                     nbatch += 1
                 skip_batches = 0
-            batch = next(feed, _END)
+            with _obs.span('data_wait'):
+                batch = next(feed, _END)
             if batch is _END:
                 # resumed exactly at the epoch's end: close the epoch
                 # out the way the uninterrupted run would — checkpoint,
@@ -344,32 +351,39 @@ class BaseModule:
                 while not done:
                     if monitor:
                         monitor.tic()
-                    self.forward_backward(batch)
-                    if guard is not None:
-                        # health-gate the optimizer: a non-finite batch
-                        # is skipped with params untouched; a policy
-                        # trip raises into the rollback handler below
-                        try:
-                            # scaled=False: this path applies no loss
-                            # scaling, so norms must not be divided by
-                            # the (idle) scaler
-                            healthy = guard.observe_eager(
-                                guard_step, self._guard_grads()
-                                if hasattr(self, '_guard_grads') else [],
-                                scaled=False)
-                        except GuardrailTripped:
-                            self._last_bad_batch = batch
-                            raise
-                        guard_step += 1
-                        if healthy:
+                    with _obs.span('step'):
+                        self.forward_backward(batch)
+                        if guard is not None:
+                            # health-gate the optimizer: a non-finite
+                            # batch is skipped with params untouched; a
+                            # policy trip raises into the rollback
+                            # handler below
+                            try:
+                                # scaled=False: this path applies no
+                                # loss scaling, so norms must not be
+                                # divided by the (idle) scaler
+                                healthy = guard.observe_eager(
+                                    guard_step, self._guard_grads()
+                                    if hasattr(self, '_guard_grads')
+                                    else [],
+                                    scaled=False)
+                            except GuardrailTripped:
+                                self._last_bad_batch = batch
+                                raise
+                            guard_step += 1
+                            if healthy:
+                                self.update()
+                        else:
                             self.update()
-                    else:
-                        self.update()
-                    self._feed_metric(eval_metric, batch)
+                    # metric update materialises outputs on the host —
+                    # the fit loop's device→host sync point
+                    with _obs.span('sync'):
+                        self._feed_metric(eval_metric, batch)
                     # lookahead: prepare() must see the NEXT batch
                     # before it is consumed (sparse row pull in the
                     # reference; bucket switch + dispatch warmup here)
-                    nxt = next(feed, _END)
+                    with _obs.span('data_wait'):
+                        nxt = next(feed, _END)
                     if nxt is _END:
                         done = True
                         epoch_summary = \
@@ -382,11 +396,20 @@ class BaseModule:
                     _fire(batch_end_callback, epoch=epoch, nbatch=nbatch,
                           eval_metric=eval_metric, locals=locals())
                     global_step += 1
+                    if tel_inst is not None:
+                        tel_inst.global_step.set(global_step)
+                        tel_inst.steps.inc()
+                        data = getattr(batch, 'data', None)
+                        shape = getattr(data[0], 'shape', None) \
+                            if data else None
+                        if shape:
+                            tel_inst.examples.inc(int(shape[0]))
                     if step_mgr is not None and checkpoint_every_n_steps \
                             and global_step % checkpoint_every_n_steps \
                             == 0:
-                        step_mgr.save(global_step, self._fit_state(
-                            epoch, nbatch, global_step))
+                        with _obs.span('checkpoint'):
+                            step_mgr.save(global_step, self._fit_state(
+                                epoch, nbatch, global_step))
                     if preempt is not None and \
                             preempt.check(global_step):
                         # drain: emergency step checkpoint, then the
@@ -414,9 +437,10 @@ class BaseModule:
             arg_params, aux_params = self.get_params()
             self.set_params(arg_params, aux_params)
             if ckpt_mgr is not None:
-                ckpt_mgr.save(epoch,
-                              self._fit_state(epoch, nbatch - 1,
-                                              global_step))
+                with _obs.span('checkpoint'):
+                    ckpt_mgr.save(epoch,
+                                  self._fit_state(epoch, nbatch - 1,
+                                                  global_step))
             for cb in _as_list(epoch_end_callback):
                 cb(epoch, self.symbol, arg_params, aux_params)
 

@@ -485,6 +485,52 @@ import collections as _collections
 _INVOKE_JIT_CACHE_MAX = 1024
 _invoke_jit_cache = _collections.OrderedDict()
 
+# jit-cache telemetry (docs/OBSERVABILITY.md): pre-bound counters so a
+# cache hit pays one lazy-global read + one guarded inc
+_dispatch_inst = None
+
+
+def _dinst():
+    global _dispatch_inst
+    if _dispatch_inst is None:
+        from ..observability import dispatch_instruments
+        _dispatch_inst = dispatch_instruments()
+    return _dispatch_inst
+
+
+class _TimedFirstCall:
+    """Wraps a fresh jit so its FIRST invocation — the one that traces
+    and compiles — lands in the compile-seconds histogram and the
+    flight recorder; then the raw jitted fn is swapped back into the
+    cache, so steady-state dispatch pays nothing."""
+
+    __slots__ = ('fn', 'op', 'key')
+
+    def __init__(self, fn, op, key):
+        self.fn = fn
+        self.op = op
+        self.key = key
+
+    def __call__(self, *args):
+        import time as _t
+        t0 = _t.perf_counter()
+        ret = self.fn(*args)
+        dt = _t.perf_counter() - t0
+        # un-wrap: later hits dispatch straight to the jitted fn
+        if _invoke_jit_cache.get(self.key, (None,))[0] is self:
+            _invoke_jit_cache[self.key] = (self.fn, self.op)
+        try:
+            from ..observability import (enabled, record_event,
+                                         trainer_instruments)
+            if enabled():
+                trainer_instruments().compile_seconds.observe(dt)
+                record_event('compile', op=getattr(self.op, 'name',
+                                                   str(self.op)),
+                             seconds=round(dt, 6))
+        except Exception:
+            pass
+        return ret
+
 
 def _get_jitted(op, attrs, recording, variadic):
     """Return (jitted_fn, dyn_names): step-varying attrs listed in
@@ -502,6 +548,7 @@ def _get_jitted(op, attrs, recording, variadic):
     cached = _invoke_jit_cache.get(key)
     if cached is not None:
         _invoke_jit_cache.move_to_end(key)
+        _dinst().jit_hits.inc()
         return cached[0], dyn_names
     base_fn = op.bind_attrs(**static)
     nd_ = len(dyn_names)
@@ -530,6 +577,11 @@ def _get_jitted(op, attrs, recording, variadic):
             def jfn(*a):
                 return call(a[:nd_], a[nd_:])
     jitted = jax.jit(jfn)
+    inst = _dinst()
+    inst.jit_misses.inc()
+    from ..observability import enabled as _obs_enabled
+    if _obs_enabled():
+        jitted = _TimedFirstCall(jitted, op, key)
     # pin the Operator alongside the compiled fn: the key holds id(op),
     # so the op must stay alive while the entry does (a recycled id would
     # alias a different op onto this entry)

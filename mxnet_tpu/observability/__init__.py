@@ -1,0 +1,181 @@
+"""Unified telemetry: metrics registry, step-phase spans, and a
+crash-surviving flight recorder (docs/OBSERVABILITY.md).
+
+One coherent layer threaded through every training entry point —
+``ParallelTrainer``, ``Module.fit``, the gluon ``Trainer``'s kvstore,
+the guardrail, the resilience watchdog/preemption paths, and the eager
+dispatcher's jit cache — so every run produces its own machine-readable
+evidence:
+
+  * ``metrics``   — lock-cheap labeled Counters / Gauges / Histograms
+                    (fixed power-of-two buckets), ``snapshot()``,
+                    near-zero overhead when disabled
+                    (``MXNET_TPU_TELEMETRY=0``).
+  * ``recorder``  — FlightRecorder: bounded ring of structured events
+                    dumped as a ``mxnet_tpu.flight.v1`` JSONL artifact
+                    on crash / stall / preemption, so post-mortems
+                    always have the last N events of run history.
+  * ``spans``     — step-phase spans (data-wait / step / sync /
+                    checkpoint / compile) unified with the profiler's
+                    chrome-trace scopes and jax.profiler annotations.
+  * ``export``    — Prometheus text format (file + stdlib HTTP, off by
+                    default), JSONL, TensorBoard.
+  * ``hlo``       — per-step collective-byte accounting from optimized
+                    HLO (the bench_scaling.py instrument, librarified).
+
+Import-light like the resilience layer: nothing here imports jax, so
+the crash/stall escalation paths can dump telemetry even when the
+backend is the thing that died. ``python -m mxnet_tpu.observability``
+runs the end-to-end selftest (CI tier 'observability').
+"""
+from __future__ import annotations
+
+from . import metrics
+from . import export
+from . import hlo
+from . import recorder
+from . import spans
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      counter, gauge, histogram, get_registry,
+                      enabled, set_enabled, snapshot)
+from .recorder import (FLIGHT_SCHEMA, FlightRecorder, get_recorder,
+                       record_event, flight_dump, configure_flight,
+                       install_excepthook, read_flight)
+from .spans import PHASES, span
+from .hlo import collective_bytes, trainer_collective_stats
+from .export import (prometheus_text, write_prometheus, write_jsonl,
+                     tensorboard_export, PrometheusServer,
+                     maybe_start_http_server, parse_prometheus)
+
+__all__ = [
+    'metrics', 'recorder', 'spans', 'export', 'hlo',
+    'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'counter',
+    'gauge', 'histogram', 'get_registry', 'enabled', 'set_enabled',
+    'snapshot', 'FLIGHT_SCHEMA', 'FlightRecorder', 'get_recorder',
+    'record_event', 'flight_dump', 'configure_flight',
+    'install_excepthook', 'read_flight', 'PHASES', 'span',
+    'collective_bytes', 'trainer_collective_stats', 'prometheus_text',
+    'write_prometheus', 'write_jsonl', 'tensorboard_export',
+    'PrometheusServer', 'maybe_start_http_server', 'parse_prometheus',
+    'trainer_instruments', 'kv_instruments', 'dispatch_instruments',
+    'summary',
+]
+
+
+class _Instruments:
+    """Bag of pre-bound metric children so hot paths pay one attribute
+    read per event, never a registry lookup."""
+
+    def __init__(self, **children):
+        self.__dict__.update(children)
+
+
+_trainer_inst = None
+_kv_inst = None
+_dispatch_inst = None
+
+
+def trainer_instruments():
+    """Fused-step / fit-driver instruments (shared across trainers)."""
+    global _trainer_inst
+    if _trainer_inst is None:
+        # first instrumented training activity: honor the HTTP-export
+        # knob so MXNET_TPU_TELEMETRY_HTTP_PORT=<port> alone exposes
+        # /metrics for any training entry point (still off by default)
+        try:
+            maybe_start_http_server()
+        except Exception:
+            pass          # an occupied port must not fail training
+        _trainer_inst = _Instruments(
+            steps=counter('mxnet_tpu_steps_total',
+                          help='optimizer steps dispatched'),
+            examples=counter('mxnet_tpu_examples_total',
+                             help='training examples consumed'),
+            step_seconds=histogram(
+                'mxnet_tpu_step_seconds',
+                help='host wall seconds per fused-step dispatch '
+                     '(dispatch-to-dispatch; async backends overlap '
+                     'device time)'),
+            compile_seconds=histogram(
+                'mxnet_tpu_compile_seconds',
+                help='wall seconds spent building+compiling programs'),
+            epoch=gauge('mxnet_tpu_epoch',
+                        help='current epoch cursor (Module.fit)'),
+            global_step=gauge('mxnet_tpu_global_step',
+                              help='current global step cursor'),
+            grad_norm=gauge('mxnet_tpu_grad_norm',
+                            help='last observed global gradient norm '
+                                 '(guardrail sentinel)'),
+            loss_scale=gauge('mxnet_tpu_loss_scale',
+                             help='current dynamic loss scale'),
+            skipped=counter('mxnet_tpu_skipped_updates_total',
+                            help='optimizer updates skipped on '
+                                 'non-finite gradients'),
+            nonfinite=counter('mxnet_tpu_nonfinite_events_total',
+                              help='non-finite sentinel events'),
+            checkpoints=counter('mxnet_tpu_checkpoints_total',
+                                help='checkpoints written'),
+            heartbeat_age=gauge(
+                'mxnet_tpu_watchdog_heartbeat_age_seconds',
+                help='age of the last watchdog heartbeat at the most '
+                     'recent stall check'),
+            speedometer=gauge(
+                'mxnet_tpu_speedometer_samples_per_sec',
+                help='last Speedometer window throughput'),
+        )
+    return _trainer_inst
+
+
+def kv_instruments():
+    """KVStore instruments (push/pull traffic, retries, rejoins)."""
+    global _kv_inst
+    if _kv_inst is None:
+        _kv_inst = _Instruments(
+            push_bytes=counter('mxnet_tpu_kv_push_bytes_total',
+                               help='bytes pushed through the kvstore'),
+            pull_bytes=counter('mxnet_tpu_kv_pull_bytes_total',
+                               help='bytes pulled through the kvstore'),
+            retries=counter('mxnet_tpu_kv_retries_total',
+                            help='dist-collective retry attempts'),
+            rejoins=counter('mxnet_tpu_kv_rejoins_total',
+                            help='worker rejoin handshakes'),
+        )
+    return _kv_inst
+
+
+def dispatch_instruments():
+    """Eager-dispatcher jit-cache instruments."""
+    global _dispatch_inst
+    if _dispatch_inst is None:
+        _dispatch_inst = _Instruments(
+            jit_hits=counter('mxnet_tpu_jit_cache_hits_total',
+                             help='eager-op jit cache hits'),
+            jit_misses=counter('mxnet_tpu_jit_cache_misses_total',
+                               help='eager-op jit cache misses '
+                                    '(new program traced)'),
+        )
+    return _dispatch_inst
+
+
+def summary():
+    """Compact telemetry block for bench/instrument status JSON: scalar
+    series verbatim, histograms reduced to count/sum/avg — small enough
+    to fold into every artifact."""
+    out = {'enabled': enabled(), 'flight': get_recorder().stats()}
+    series_out = {}
+    for name, fam in snapshot().items():
+        rows = []
+        for series in fam['series']:
+            if fam['type'] == 'histogram':
+                count = series['count']
+                rows.append({'labels': series['labels'],
+                             'count': count,
+                             'sum': round(series['sum'], 6),
+                             'avg': round(series['sum'] / count, 6)
+                             if count else None})
+            else:
+                rows.append({'labels': series['labels'],
+                             'value': series['value']})
+        series_out[name] = {'type': fam['type'], 'series': rows}
+    out['metrics'] = series_out
+    return out

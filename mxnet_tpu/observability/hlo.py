@@ -1,0 +1,79 @@
+"""Collective-traffic accounting over optimized HLO text.
+
+The per-step collective-byte measurement from ``bench_scaling.py``
+(the backbone of cross-replica sharding analyses — see PAPERS.md)
+promoted into the library so a *normal training run* can record its
+own communication volume: :func:`trainer_collective_stats` reads a
+built ``ParallelTrainer``'s compiled step and lands the totals in the
+``mxnet_tpu_collective_bytes_per_step`` gauges.
+
+Pure text analysis — nothing here executes or recompiles device code
+beyond the one ``lower().compile()`` XLA already caches for a built
+program; still, drivers call it once per program, not per step.
+"""
+from __future__ import annotations
+
+import re
+
+from . import metrics as _metrics
+
+__all__ = ['COLLECTIVES', 'collective_bytes', 'trainer_collective_stats']
+
+COLLECTIVES = ('all-reduce', 'all-gather', 'reduce-scatter',
+               'collective-permute', 'all-to-all')
+_DTYPE_BYTES = {'f64': 8, 'f32': 4, 'bf16': 2, 'f16': 2, 's64': 8,
+                's32': 4, 'u32': 4, 's16': 2, 'u16': 2, 's8': 1,
+                'u8': 1, 'pred': 1}
+
+
+def collective_bytes(hlo_text):
+    """Sum output bytes of collective ops in optimized HLO text.
+
+    Returns ``(total_bytes, {op_kind: bytes})``. Async pairs
+    (``all-reduce-start`` / ``-done``) count once: the ``-start`` op's
+    tuple output would double-count the one logical collective, so only
+    the ``-done`` (or sync) form is summed."""
+    total = 0
+    per_kind = {}
+    for line in hlo_text.splitlines():
+        m = re.search(r'=\s+((?:\([^)]*\)|\S+))\s+(%?[\w-]+)\(', line)
+        if not m:
+            continue
+        kind = m.group(2).lstrip('%')
+        base = kind.rstrip('.0123456789')
+        if not any(base.startswith(c) for c in COLLECTIVES):
+            continue
+        if base.endswith('-start'):
+            continue
+        shapes = re.findall(r'(\w+)\[([\d,]*)\]', m.group(1))
+        nbytes = 0
+        for dt, dims in shapes:
+            if dt not in _DTYPE_BYTES:
+                continue
+            count = 1
+            for d in dims.split(','):
+                if d:
+                    count *= int(d)
+            nbytes += count * _DTYPE_BYTES[dt]
+        total += nbytes
+        per_kind[base] = per_kind.get(base, 0) + nbytes
+    return total, per_kind
+
+
+def trainer_collective_stats(trainer):
+    """Account a built ``ParallelTrainer``'s per-step collective
+    traffic into the registry and return ``(total, per_kind)``.
+
+    Gauges: ``mxnet_tpu_collective_bytes_per_step`` (unlabeled total)
+    and ``mxnet_tpu_collective_bytes_per_step_by_kind{kind=...}``."""
+    total, per_kind = collective_bytes(trainer.compiled_text())
+    _metrics.gauge('mxnet_tpu_collective_bytes_per_step',
+                   help='bytes moved by collectives in one compiled '
+                        'step (from optimized HLO)').set(total)
+    by_kind = _metrics.gauge(
+        'mxnet_tpu_collective_bytes_per_step_by_kind',
+        help='per-collective-kind bytes in one compiled step',
+        labels=('kind',))
+    for kind, nbytes in per_kind.items():
+        by_kind.labels(kind=kind).set(nbytes)
+    return total, per_kind

@@ -1,0 +1,255 @@
+"""FlightRecorder: crash-surviving ring buffer of structured events.
+
+A post-mortem on a real TPU fleet usually starts from nothing: the
+process hung or was reclaimed, stdout is a truncated log, and the only
+artifact is an external timeout. The flight recorder keeps the last N
+run events (step, compile, checkpoint, retry, loss-scale change,
+skip-update, kv rejoin, watchdog heartbeat, preempt) in a bounded
+in-memory ring and dumps them as a ``mxnet_tpu.flight.v1`` artifact the
+moment something escalates:
+
+  * a watchdog stall breach (resilience/watchdog.py ``_emit``),
+  * a preemption drain/exit (resilience/preempt.py ``exit``),
+  * an uncaught exception (optional :func:`install_excepthook`),
+  * or an explicit :meth:`FlightRecorder.dump`.
+
+Artifact format: JSON Lines. Line 1 is the header::
+
+    {"schema": "mxnet_tpu.flight.v1", "reason": "stall", "pid": ...,
+     "dumped_at": ..., "capacity": N, "recorded": total, "dropped": D,
+     "events": kept}
+
+followed by one JSON object per event, oldest first::
+
+    {"ts": <unix seconds>, "kind": "step", ...event fields...}
+
+so a torn tail (the dump raced the OOM-killer) still leaves every
+complete line parseable. Writes go through the resilience layer's
+atomic write when available.
+
+Overhead contract: :meth:`record` on a disabled recorder is one flag
+read; enabled, it is one dict build + deque append (the deque bounds
+memory — no compaction, no I/O until a dump). Hot paths guard the call
+on :func:`metrics.enabled` so the kwargs dict is not even built when
+telemetry is off.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from . import metrics as _metrics
+
+__all__ = ['FLIGHT_SCHEMA', 'FlightRecorder', 'get_recorder',
+           'record_event', 'flight_dump', 'configure_flight',
+           'install_excepthook', 'read_flight']
+
+FLIGHT_SCHEMA = 'mxnet_tpu.flight.v1'
+_DEFAULT_CAPACITY = 2048
+
+
+def _knob(name, default):
+    try:
+        from ..config import get as _cfg
+        v = _cfg(name)
+        return default if v is None else v
+    except Exception:
+        return default
+
+
+class FlightRecorder:
+    """Bounded ring of structured events with atomic JSONL dumps."""
+
+    def __init__(self, capacity=None, path=None, clock=time.time,
+                 name='train'):
+        if capacity is None:
+            capacity = int(_knob('MXNET_TPU_FLIGHT_CAPACITY',
+                                 _DEFAULT_CAPACITY))
+        self.capacity = max(1, int(capacity))
+        self.path = path
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=self.capacity)
+        self._recorded = 0
+        self._dumps = 0
+        self._enabled = None     # None = resolve from config lazily
+
+    # -- enable plumbing ---------------------------------------------------
+
+    @property
+    def enabled(self):
+        if not _metrics.enabled():
+            return False         # master switch wins
+        if self._enabled is None:
+            self._enabled = bool(_knob('MXNET_TPU_FLIGHT', True))
+        return self._enabled
+
+    def set_enabled(self, value):
+        self._enabled = None if value is None else bool(value)
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, kind, **fields):
+        """Append one event; drops the oldest when the ring is full."""
+        if not self.enabled:
+            return
+        ev = {'ts': round(self._clock(), 6), 'kind': kind}
+        ev.update(fields)
+        with self._lock:
+            self._ring.append(ev)
+            self._recorded += 1
+
+    def events(self):
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self._recorded = 0
+
+    def stats(self):
+        with self._lock:
+            kept = len(self._ring)
+            return {'capacity': self.capacity, 'recorded': self._recorded,
+                    'kept': kept,
+                    'dropped': self._recorded - kept,
+                    'dumps': self._dumps}
+
+    # -- dumping -----------------------------------------------------------
+
+    def dump(self, path=None, reason='manual'):
+        """Write the ring as a ``mxnet_tpu.flight.v1`` JSONL artifact.
+
+        Never raises: the dump runs inside crash/stall/preempt
+        escalation paths where a secondary failure must not mask the
+        primary one. Returns the path written, or None (also None when
+        the recorder is disabled — a disabled run leaves no artifact
+        behind)."""
+        if not self.enabled:
+            return None
+        path = path or self.path or \
+            str(_knob('MXNET_TPU_FLIGHT_PATH', 'FLIGHT.jsonl'))
+        with self._lock:
+            events = list(self._ring)
+            recorded = self._recorded
+        header = {
+            'schema': FLIGHT_SCHEMA,
+            'name': self.name,
+            'reason': reason,
+            'pid': os.getpid(),
+            'dumped_at': round(self._clock(), 6),
+            'capacity': self.capacity,
+            'recorded': recorded,
+            'dropped': recorded - len(events),
+            'events': len(events),
+        }
+        lines = [json.dumps(header, sort_keys=True)]
+        lines.extend(json.dumps(ev, sort_keys=True, default=str)
+                     for ev in events)
+        payload = ('\n'.join(lines) + '\n').encode()
+        try:
+            try:
+                from ..resilience.checkpoint import atomic_write_bytes
+                atomic_write_bytes(path, payload)
+            except ImportError:
+                with open(path, 'wb') as f:
+                    f.write(payload)
+            with self._lock:
+                self._dumps += 1
+            return path
+        except OSError as exc:
+            import logging
+            logging.error('flight recorder: could not write %s: %s',
+                          path, exc)
+            return None
+
+
+def read_flight(path):
+    """Parse a flight artifact back into ``(header, events)``; raises
+    ValueError when the header is not a valid v1 header. Incomplete
+    trailing lines (torn dump) are skipped."""
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError('%s: empty flight artifact' % path)
+    header = json.loads(lines[0])
+    if header.get('schema') != FLIGHT_SCHEMA:
+        raise ValueError('%s: schema %r != %r'
+                         % (path, header.get('schema'), FLIGHT_SCHEMA))
+    events = []
+    for ln in lines[1:]:
+        try:
+            events.append(json.loads(ln))
+        except ValueError:
+            continue      # torn tail line
+    return header, events
+
+
+_default_recorder = FlightRecorder()
+
+
+def get_recorder():
+    return _default_recorder
+
+
+def record_event(kind, **fields):
+    """Record one event on the process-global recorder. Hot paths guard
+    this call on ``metrics.enabled()`` to avoid the kwargs dict."""
+    _default_recorder.record(kind, **fields)
+
+
+def flight_dump(path=None, reason='manual'):
+    return _default_recorder.dump(path=path, reason=reason)
+
+
+def configure_flight(path=None, capacity=None, name=None, enabled=None):
+    """Point the global recorder at a dump path / resize the ring
+    (drivers and the resilience selftest call this before training)."""
+    rec = _default_recorder
+    if path is not None:
+        rec.path = path
+    if name is not None:
+        rec.name = name
+    if capacity is not None:
+        capacity = max(1, int(capacity))
+        if capacity != rec.capacity:
+            with rec._lock:
+                rec.capacity = capacity
+                rec._ring = deque(rec._ring, maxlen=capacity)
+    if enabled is not None:
+        rec.set_enabled(enabled)
+    return rec
+
+
+_prev_excepthook = None
+
+
+def install_excepthook():
+    """Dump the flight ring on any uncaught exception (reason='crash'),
+    then chain the previous hook. Idempotent."""
+    global _prev_excepthook
+    if _prev_excepthook is not None:
+        return
+    _prev_excepthook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        if not issubclass(exc_type, (SystemExit, KeyboardInterrupt)):
+            _default_recorder.record('crash', error='%s: %s'
+                                     % (exc_type.__name__, exc))
+            _default_recorder.dump(reason='crash')
+        _prev_excepthook(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+
+
+def uninstall_excepthook():
+    global _prev_excepthook
+    if _prev_excepthook is not None:
+        sys.excepthook = _prev_excepthook
+        _prev_excepthook = None

@@ -1,0 +1,89 @@
+"""Step-phase spans: data-wait / step / sync / checkpoint / compile.
+
+Attribution of step time is the visibility problem: a slow run looks
+identical from the outside whether the input pipeline is starving the
+chip, the compiled step regressed, or checkpointing is blocking the
+loop. A span times one phase of one step and lands the duration in the
+``mxnet_tpu_phase_seconds`` histogram family (labeled by phase), so a
+run's phase split is readable from any exporter with zero trace
+tooling.
+
+Unification with the profiler (docs/OBSERVABILITY.md): when the MXNet
+profiler is running, the same span also opens a ``profiler.scope``
+(which itself forwards to ``jax.profiler.TraceAnnotation``), so phases
+appear in chrome://tracing and XPlane/TensorBoard traces under the same
+names — one annotation in the driver, three backends.
+
+Disabled telemetry + idle profiler = a span is two flag reads.
+"""
+from __future__ import annotations
+
+import time
+
+from . import metrics as _metrics
+
+__all__ = ['PHASES', 'span', 'phase_histogram']
+
+PHASES = ('data_wait', 'step', 'sync', 'checkpoint', 'compile')
+
+_hist_family = None
+_children = {}
+
+
+def phase_histogram(phase):
+    """The histogram child for one phase (cached; hot paths hold it)."""
+    global _hist_family
+    child = _children.get(phase)
+    if child is None:
+        if _hist_family is None:
+            _hist_family = _metrics.histogram(
+                'mxnet_tpu_phase_seconds',
+                help='wall seconds per step phase', labels=('phase',))
+        child = _hist_family.labels(phase=phase)
+        _children[phase] = child
+    return child
+
+
+class span:
+    """Context manager timing one phase occurrence.
+
+        with span('data_wait'):
+            batch = next(feed)
+
+    Records into the phase histogram when telemetry is enabled and into
+    the profiler (chrome trace + XPlane) when it is running; no-op
+    otherwise."""
+
+    __slots__ = ('phase', '_t0', '_prof')
+
+    def __init__(self, phase):
+        self.phase = phase
+        self._t0 = None
+        self._prof = None
+
+    def __enter__(self):
+        prof_running = False
+        try:
+            from .. import profiler as _profiler
+            prof_running = _profiler.is_running()
+        except ImportError:
+            pass
+        if not _metrics.enabled() and not prof_running:
+            return self
+        self._t0 = time.perf_counter()
+        if prof_running:
+            from .. import profiler as _profiler
+            self._prof = _profiler.scope('phase:%s' % self.phase)
+            self._prof.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is None:
+            return
+        if self._prof is not None:
+            self._prof.__exit__(*exc)
+            self._prof = None
+        if _metrics.enabled():
+            phase_histogram(self.phase).observe(
+                time.perf_counter() - self._t0)
+        self._t0 = None

@@ -26,12 +26,15 @@ by construction.
 """
 from __future__ import annotations
 
+import time as _time
+
 import jax
 import jax.numpy as jnp
 import numpy as onp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import autograd
+from .. import observability as _obs
 from .. import random as _random
 from ..ndarray import NDArray
 from .mesh import current_mesh
@@ -242,7 +245,10 @@ class ParallelTrainer:
         state['rng'] = _random.get_state()
         if extra:
             state.update(extra)
-        return manager.save(self.num_update, state)
+        # CheckpointManager.save itself counts the write + flight
+        # event; the span attributes the wall time to this driver
+        with _obs.span('checkpoint'):
+            return manager.save(self.num_update, state)
 
     def resume(self, manager=None, elastic=None):
         """Restore the newest valid checkpoint into this (built)
@@ -650,9 +656,13 @@ class ParallelTrainer:
 
         xs_s = [None if a is None else split(a) for a in xs]
         ys_s = [split(a) for a in ys]
-        if self._jitted is None:
-            self._build([None if a is None else a[0] for a in xs_s],
-                        [a[0] for a in ys_s])
+        tel = _obs.enabled()
+        first = self._jitted is None
+        t0 = _time.perf_counter() if tel else 0.0
+        if first:
+            with _obs.span('compile'):
+                self._build([None if a is None else a[0] for a in xs_s],
+                            [a[0] for a in ys_s])
         sig = (tuple(a is None for a in xs), len(ys))
         if sig != self._sig:
             raise ValueError(
@@ -678,6 +688,9 @@ class ParallelTrainer:
         self.num_update += 1
         for p, w in zip(self._params, self._param_arrays):
             p.data()._data = w
+        if tel:
+            self._record_step_telemetry(
+                first, t0, int(ys[0].shape[0]) if ys else 0)
         self._boundary_post()
         return NDArray(loss)
 
@@ -716,9 +729,13 @@ class ParallelTrainer:
         if nsteps == 0:
             raise ValueError('step_n called with a zero-length leading '
                              '(steps) dimension')
-        if self._jitted is None:
-            self._build([None if a is None else a[0] for a in xs],
-                        [a[0] for a in ys])
+        tel = _obs.enabled()
+        first = self._jitted is None
+        t0 = _time.perf_counter() if tel else 0.0
+        if first:
+            with _obs.span('compile'):
+                self._build([None if a is None else a[0] for a in xs],
+                            [a[0] for a in ys])
         sig = (tuple(a is None for a in xs), len(ys))
         if sig != self._sig:
             raise ValueError(
@@ -762,6 +779,10 @@ class ParallelTrainer:
         self.num_update += nsteps
         for p, w in zip(self._params, self._param_arrays):
             p.data()._data = w
+        if tel:
+            self._record_step_telemetry(
+                first, t0, nsteps * int(ys[0].shape[1]) if ys else 0,
+                nsteps=nsteps)
         if self._guard is not None:
             # one materialisation for the whole window (the scan already
             # synced at its end); feeds the host policy per step
@@ -805,8 +826,12 @@ class ParallelTrainer:
         checkpoint."""
         self._boundary_pre()
         xs, ys = self._normalize(x, y)
-        if self._jitted is None:
-            self._build(xs, ys)
+        tel = _obs.enabled()
+        first = self._jitted is None
+        t0 = _time.perf_counter() if tel else 0.0
+        if first:
+            with _obs.span('compile'):
+                self._build(xs, ys)
         sig = (tuple(a is None for a in xs), len(ys))
         if sig != self._sig:
             raise ValueError(
@@ -853,11 +878,44 @@ class ParallelTrainer:
         # keep the net's Parameters viewing the live sharded arrays
         for p, w in zip(self._params, self._param_arrays):
             p.data()._data = w
+        if tel:
+            self._record_step_telemetry(
+                first, t0, int(ys[0].shape[0]) if ys else 0)
         if self._guard is not None:
             self._guard.record(self.num_update - 1, health, loss=loss,
                                scale=self._gstate[0])
         self._boundary_post()
         return NDArray(loss)
+
+    def _record_step_telemetry(self, first, t0, examples, nsteps=1):
+        """Per-dispatch telemetry (docs/OBSERVABILITY.md): step/compile
+        timing histograms, step/example counters, cursor gauge, and a
+        flight-recorder event. Host wall time only — no device sync is
+        added, so the dispatch pipeline keeps its depth (the measured
+        time is dispatch-to-dispatch; the XPlane trace holds device
+        truth). Callers guard on ``observability.enabled()`` so the
+        disabled path allocates nothing."""
+        dt = _time.perf_counter() - t0
+        inst = _obs.trainer_instruments()
+        step = self.num_update - nsteps
+        if first:
+            inst.compile_seconds.observe(dt)
+            _obs.record_event('compile', program='fused_step',
+                              step=step, seconds=round(dt, 6))
+            try:
+                from ..config import get as _cfg
+                if _cfg('MXNET_TPU_TELEMETRY_HLO'):
+                    _obs.trainer_collective_stats(self)
+            except Exception:
+                pass      # accounting must never fail a training step
+        else:
+            inst.step_seconds.observe(dt)
+        inst.steps.inc(nsteps)
+        if examples:
+            inst.examples.inc(examples)
+        inst.global_step.set(self.num_update)
+        _obs.record_event('step', step=step, n=nsteps,
+                          seconds=round(dt, 6))
 
     # -- rollback contract (guardrail/rollback.py) -------------------------
 

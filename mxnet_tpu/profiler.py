@@ -181,12 +181,28 @@ class op_span:
 
 
 def dump(finished=True, profile_process='worker'):
-    """Write the chrome://tracing JSON (reference: profiler.py dump)."""
+    """Write the chrome://tracing JSON (reference: profiler.py dump).
+
+    ``finished=True`` (the default, matching the reference semantics)
+    ENDS collection: profiling stops (including any live jax trace)
+    and the event buffer is cleared, so a later ``dump(False)`` mid-run
+    does not re-emit this run's events. ``finished=False`` snapshots
+    without disturbing collection."""
     fname = _config.get('filename', 'profile.json')
     with _lock:
-        data = {'traceEvents': list(_events), 'displayTimeUnit': 'ms'}
+        snapshot = list(_events)
+    data = {'traceEvents': snapshot, 'displayTimeUnit': 'ms'}
     with open(fname, 'w') as f:
         json.dump(data, f)
+    if finished:
+        # only after a successful write: a failed dump (full disk,
+        # bad path) must leave the buffer intact for a re-dump. Drop
+        # exactly the events written — appends that raced the write
+        # survive for the next dump
+        with _lock:
+            del _events[:len(snapshot)]
+        if _state['running']:
+            set_state('stop')
     return fname
 
 
@@ -240,23 +256,35 @@ class Event(_Scoped):
 
 
 class Counter:
-    """Profile a numeric counter (reference: profiler.py Counter)."""
+    """Profile a numeric counter (reference: profiler.py Counter).
+
+    Thread-safe: documented as usable from dispatch hot paths, so
+    ``increment``/``decrement`` must not lose updates under
+    concurrency — the read-modify-write of ``_value`` happens under a
+    per-counter lock (the chrome-trace emit stays outside it; event
+    ordering across threads is the trace viewer's job)."""
 
     def __init__(self, domain=None, name='counter', value=0):
         self.name = name
+        self._vlock = threading.Lock()
         self._value = value
         self.set_value(value)
 
     def set_value(self, value):
-        self._value = value
+        with self._vlock:
+            self._value = value
         _emit('C', self.name, 'counter', time.perf_counter(),
               args={'value': value})
 
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        with self._vlock:
+            self._value = value = self._value + delta
+        _emit('C', self.name, 'counter', time.perf_counter(),
+              args={'value': value})
+        return self     # __iadd__ alias must rebind to the Counter
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        return self.increment(-delta)
 
     __iadd__ = increment
     __isub__ = decrement

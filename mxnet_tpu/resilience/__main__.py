@@ -108,12 +108,22 @@ def _write(path, payload):
                        .encode())
 
 
+def _configure_flight(args):
+    """Point the global flight recorder at the requested artifact path
+    so an injected stall/preempt escalation dumps somewhere the caller
+    (tools/fault_smoke.py) can validate."""
+    from mxnet_tpu import observability
+    observability.configure_flight(path=args.flight_artifact,
+                                   name='resilience-selftest')
+
+
 def run_train(args):
     import numpy as onp
     from mxnet_tpu import nd, parallel
     from . import (CheckpointManager, PreemptionHandler, Watchdog,
                    available_devices, shrink_plan)
 
+    _configure_flight(args)
     devs = available_devices()     # honors device_loss@elastic.restart
     mgr = CheckpointManager(args.ckpt_dir, prefix='pt', keep=3) \
         if args.ckpt_dir else None
@@ -183,6 +193,7 @@ def run_watchdog_smoke(args):
     from mxnet_tpu import nd, parallel
     from . import TunnelStallError, Watchdog
 
+    _configure_flight(args)
     mesh = parallel.create_mesh()      # whatever devices exist
     net, loss = _net_and_loss()
     pt = parallel.ParallelTrainer(net, loss, 'sgd',
@@ -235,6 +246,10 @@ def main(argv=None):
     p.add_argument('--ckpt-every', type=int, default=5)
     p.add_argument('--out', default='SELFTEST.json')
     p.add_argument('--stall-artifact', default='STALL.json')
+    p.add_argument('--flight-artifact', default='FLIGHT.jsonl',
+                   help='flight-recorder dump path (written on an '
+                        'injected stall/preempt escalation; schema '
+                        'mxnet_tpu.flight.v1, docs/OBSERVABILITY.md)')
     args = p.parse_args(argv)
     if args.train:
         return run_train(args)

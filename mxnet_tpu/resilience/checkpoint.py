@@ -165,6 +165,15 @@ class CheckpointManager:
         state.setdefault('step', int(step))
         save_state(self.path_for(step), state)
         self.prune()
+        try:        # telemetry (lazy import: this layer stays jax-free)
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.trainer_instruments().checkpoints.inc()
+                _obs.record_event('checkpoint', step=int(step),
+                                  prefix=self.prefix,
+                                  path=self.path_for(step))
+        except Exception:
+            pass        # telemetry must never fail a checkpoint
         return self.path_for(step)
 
     def prune(self):

@@ -102,6 +102,7 @@ class PreemptionHandler:
         self._clock = clock
         self._lock = threading.Lock()
         self._stop = False
+        self._announced = False
         self.reason = None
         self.checkpoint_path = None
         self._previous = {}
@@ -189,6 +190,17 @@ class PreemptionHandler:
                    step=step)
         except PreemptionSignal as sig:
             self.request_stop(str(sig))
+        if self._stop and not self._announced:
+            # the flight event is recorded HERE (driver thread), not in
+            # request_stop: the signal frame must never touch the
+            # recorder lock (a signal landing mid-append would deadlock)
+            self._announced = True
+            try:
+                from .. import observability as _obs
+                _obs.record_event('preempt', step=step,
+                                  reason=self.reason)
+            except Exception:
+                pass
         return self._stop
 
     def drain(self, save):
@@ -205,6 +217,12 @@ class PreemptionHandler:
         deadline = Deadline(self.grace_s, clock=self._clock)
         try:
             self.checkpoint_path = save()
+            try:
+                from .. import observability as _obs
+                _obs.record_event('checkpoint', kind='emergency',
+                                  path=self.checkpoint_path)
+            except Exception:
+                pass
             deadline.check('preemption drain')
         except TimeoutExpired:
             import warnings
@@ -216,7 +234,18 @@ class PreemptionHandler:
         return self.checkpoint_path
 
     def exit(self, step=None):
-        """Raise :class:`Preempted` with the resumable rc."""
+        """Raise :class:`Preempted` with the resumable rc (after
+        dumping the flight recorder — the preemption post-mortem gets
+        the last N events of run history, docs/OBSERVABILITY.md)."""
+        try:
+            from .. import observability as _obs
+            _obs.record_event('preempt_exit', step=step,
+                              checkpoint=self.checkpoint_path,
+                              reason=self.reason or 'preempted',
+                              exit_code=self.exit_code)
+            _obs.flight_dump(reason='preempt')
+        except Exception:
+            pass      # telemetry must never block the resumable exit
         raise Preempted(self.exit_code, step=step,
                         checkpoint=self.checkpoint_path,
                         reason=self.reason or 'preempted')

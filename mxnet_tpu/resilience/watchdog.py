@@ -144,6 +144,7 @@ class Watchdog:
                 self._last = now - self.budget_for(self._phase) - 1.0
                 return
             self._last = now
+        self._telemetry_beat(step)
 
     def phase(self, phase):
         """Switch phase (``compile`` / ``step`` / ``collective``) and
@@ -151,6 +152,19 @@ class Watchdog:
         self.beat(step=self._step, phase=phase)
 
     # -- detection ---------------------------------------------------------
+
+    def _telemetry_beat(self, step):
+        """Heartbeat telemetry (lazy import: this layer stays jax-free):
+        age gauge back to zero + a flight-recorder heartbeat event, so
+        a post-stall dump shows exactly where the beats stopped."""
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.trainer_instruments().heartbeat_age.set(0.0)
+                _obs.record_event('watchdog_heartbeat', step=step,
+                                  phase=self._phase)
+        except Exception:
+            pass
 
     def stalled(self):
         """(waited_s, budget_s, phase, step) when the heartbeat is
@@ -160,9 +174,16 @@ class Watchdog:
                 return None
             waited = self._clock() - self._last
             budget = self.budget_for(self._phase)
-            if waited <= budget:
-                return None
-            return waited, budget, self._phase, self._step
+            phase, step = self._phase, self._step
+        try:        # heartbeat-age gauge (docs/OBSERVABILITY.md)
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.trainer_instruments().heartbeat_age.set(waited)
+        except Exception:
+            pass
+        if waited <= budget:
+            return None
+        return waited, budget, phase, step
 
     def check(self):
         """Raise :class:`TunnelStallError` (after writing the stall
@@ -192,6 +213,19 @@ class Watchdog:
         except OSError as exc:   # diagnostics must not mask the stall
             logging.error('watchdog: could not write stall artifact '
                           '%s: %s', self.artifact_path, exc)
+        try:
+            # flight-recorder escalation (docs/OBSERVABILITY.md): the
+            # stall event lands in the ring, then the whole ring dumps
+            # as a mxnet_tpu.flight.v1 artifact — the last N seconds of
+            # run history next to the stall record
+            from .. import observability as _obs
+            _obs.record_event('stall', phase=phase,
+                              step=None if step is None else int(step),
+                              waited_s=round(float(waited), 3),
+                              budget_s=round(float(budget), 3))
+            _obs.flight_dump(reason='stall')
+        except Exception:
+            pass      # telemetry must never mask the stall itself
         logging.error('watchdog: %s phase stalled %.1fs (budget %.1fs) '
                       'at step %s; artifact: %s', phase, waited, budget,
                       step, self.artifact_path)

@@ -5,8 +5,14 @@ precision to float32 so numeric checks are meaningful (TPU-default bf16
 passes are a perf feature, not a correctness one).
 """
 import os
+import tempfile
 
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# in-process preemption/stall tests escalate through the flight
+# recorder (docs/OBSERVABILITY.md); keep their dumps out of the repo
+os.environ.setdefault(
+    'MXNET_TPU_FLIGHT_PATH',
+    os.path.join(tempfile.gettempdir(), 'mxnet_tpu_test_FLIGHT.jsonl'))
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (

@@ -588,5 +588,8 @@ def test_bench_faulted_subprocess_exits_zero(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr)
     art = json.load(open(out))
     assert art['status'] == 'unavailable'
-    assert art['payload'] == {'metrics': []}
+    assert art['payload']['metrics'] == []
+    # every bench artifact now also carries its telemetry summary
+    # block (docs/OBSERVABILITY.md) — even an unavailable-backend run
+    assert 'telemetry' in art['payload']
     assert art['backend']['state'] == 'unavailable'

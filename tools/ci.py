@@ -20,9 +20,17 @@ Stages, each timed:
                            and the stall watchdog (injected hang ⇒
                            mxnet_tpu.stall.v1 artifact), all via
                            python -m mxnet_tpu.resilience
-  3. C ABI audit           tools/capi_coverage.py == 207/207
-  4. copy-paste gate       tools/overlap_check.py --sweep 0.60
-  5. example smokes        3 representative workloads (LeNet both
+  3. observability         python -m mxnet_tpu.observability — the
+                           unified-telemetry selftest (metrics
+                           registry, disabled-path no-allocation,
+                           flight recorder, Prometheus schema, spans,
+                           instrumented fused-trainer run); the
+                           fault tier above also asserts injected
+                           stall/preempt runs dump parseable
+                           mxnet_tpu.flight.v1 artifacts
+  4. C ABI audit           tools/capi_coverage.py == 207/207
+  5. copy-paste gate       tools/overlap_check.py --sweep 0.60
+  6. example smokes        3 representative workloads (LeNet both
                            APIs, word-LM, plugin op)
 
 Exit code 0 = gate green. Run the FULL suite (~17 min:
@@ -63,6 +71,15 @@ def main(argv=None):
         # and should not mask a resilience regression where the
         # reference tree is absent.
         ('fault-inject', [py, 'tools/fault_smoke.py', '--skip-tests']),
+        # telemetry selftest: registry math, disabled-path
+        # no-allocation proof, flight-recorder ring + dump schema,
+        # Prometheus exporter schema, phase spans, and an instrumented
+        # fused-trainer run on the virtual mesh. fault-inject above
+        # already asserted the stall/preempt escalations dump
+        # parseable mxnet_tpu.flight.v1 artifacts.
+        ('observability', [py, '-m', 'mxnet_tpu.observability',
+                           '--devices', '8',
+                           '--out', '/tmp/OBS_SELFTEST.json']),
         ('capi', [py, 'tools/capi_coverage.py', '--assert', '207']),
         ('overlap', [py, 'tools/overlap_check.py', '--sweep', '0.60']),
     ]

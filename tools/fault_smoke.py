@@ -28,6 +28,11 @@ Six checks:
      within the stall budget and emit the structured
      mxnet_tpu.stall.v1 artifact.
 
+Checks 4 and 6 additionally assert the flight-recorder contract
+(docs/OBSERVABILITY.md): the injected preempt and hang escalations
+must each dump a parseable mxnet_tpu.flight.v1 JSONL artifact whose
+tail event matches the fault site (preempt_exit@9 / stall@3).
+
 Usage: python tools/fault_smoke.py [--skip-tests]
 (--skip-tests runs only the subprocess contract checks; ci.py's fast
 tier already ran the test files, so the gate uses it to avoid double
@@ -50,6 +55,50 @@ _REQUIRED_RESUMABLE_KEYS = {'preempted', 'reason', 'exit_code'}
 _RESUMABLE_RC = 75          # MXNET_TPU_PREEMPT_EXIT_CODE default
 _STALL_KEYS = {'schema', 'name', 'phase', 'step', 'waited_s',
                'budget_s', 'pid', 'thread_stacks'}
+_FLIGHT_SCHEMA = 'mxnet_tpu.flight.v1'
+_FLIGHT_HEADER_KEYS = {'schema', 'name', 'reason', 'pid', 'dumped_at',
+                       'capacity', 'recorded', 'dropped', 'events'}
+
+
+def _check_flight(path, reason, tail_kind, tail_step):
+    """Validate a flight-recorder dump (docs/OBSERVABILITY.md): JSONL,
+    v1 header, and a tail event matching the injected fault site.
+    Returns a list of problems (empty = ok)."""
+    problems = []
+    if not os.path.exists(path):
+        return ['no flight artifact at %s' % path]
+    lines = [ln for ln in open(path).read().splitlines() if ln.strip()]
+    if not lines:
+        return ['flight artifact %s is empty' % path]
+    try:
+        header = json.loads(lines[0])
+        events = [json.loads(ln) for ln in lines[1:]]
+    except ValueError as exc:
+        return ['flight artifact not parseable JSONL: %s' % exc]
+    if header.get('schema') != _FLIGHT_SCHEMA:
+        problems.append('flight schema %r != %r'
+                        % (header.get('schema'), _FLIGHT_SCHEMA))
+    if not _FLIGHT_HEADER_KEYS <= set(header):
+        problems.append('flight header keys %s missing %s'
+                        % (sorted(header),
+                           sorted(_FLIGHT_HEADER_KEYS - set(header))))
+    if header.get('reason') != reason:
+        problems.append('flight reason %r, want %r'
+                        % (header.get('reason'), reason))
+    if header.get('events') != len(events):
+        problems.append('flight header says %r events, file has %d'
+                        % (header.get('events'), len(events)))
+    if not events:
+        problems.append('flight dump has no events')
+        return problems
+    tail = events[-1]
+    if tail.get('kind') != tail_kind:
+        problems.append('flight tail event kind %r, want %r (tail: %r)'
+                        % (tail.get('kind'), tail_kind, tail))
+    elif tail.get('step') != tail_step:
+        problems.append('flight tail event at step %r, want %r'
+                        % (tail.get('step'), tail_step))
+    return problems
 
 
 def _selftest(argv, devices, fault=None, timeout=420):
@@ -174,7 +223,9 @@ def run_preempt_resume():
         ref = json.load(open(ref_out))
 
         # preempted run: must exit with the RESUMABLE rc, not 0/1
-        r = _selftest(train + [d_run, '--out', a_out], devices=8,
+        flight = os.path.join(tmp, 'FLIGHT_preempt.jsonl')
+        r = _selftest(train + [d_run, '--out', a_out,
+                               '--flight-artifact', flight], devices=8,
                       fault='preempt@train.step.9:1')
         if r.returncode != _RESUMABLE_RC:
             print('FAIL: preempted run exited %d, want resumable rc %d'
@@ -184,6 +235,15 @@ def run_preempt_resume():
         if not any(f.endswith('.ckpt') for f in os.listdir(d_run)):
             print('FAIL: preempted run drained no emergency checkpoint')
             return False
+        # the preemption must also have dumped a flight-recorder
+        # artifact whose tail is the preempt_exit at the fault site
+        problems = _check_flight(flight, reason='preempt',
+                                 tail_kind='preempt_exit', tail_step=9)
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        print('flight(preempt): %s schema ok, tail=preempt_exit@9'
+              % _FLIGHT_SCHEMA)
         # snapshot the drained state NOW: the same-mesh resume below
         # writes newer checkpoints into d_run, and the elastic leg
         # must resume from the preemption point, not from those
@@ -256,8 +316,10 @@ def run_watchdog_smoke():
     with tempfile.TemporaryDirectory() as tmp:
         out = os.path.join(tmp, 'w.json')
         stall = os.path.join(tmp, 'STALL.json')
+        flight = os.path.join(tmp, 'FLIGHT_stall.jsonl')
         r = _selftest(['--watchdog-smoke', '--steps', '6', '--out', out,
-                       '--stall-artifact', stall], devices=1,
+                       '--stall-artifact', stall,
+                       '--flight-artifact', flight], devices=1,
                       fault='hang@train.step.3:1')
         if r.returncode != 0:
             print('FAIL: watchdog smoke exited %d\n%s\n%s'
@@ -276,11 +338,15 @@ def run_watchdog_smoke():
                                 % (sorted(art), sorted(_STALL_KEYS)))
             elif art['schema'] != 'mxnet_tpu.stall.v1':
                 problems.append('stall schema %r' % art['schema'])
+        # the stall escalation must also dump the flight ring; its
+        # tail event is the stall record at the injected step
+        problems += _check_flight(flight, reason='stall',
+                                  tail_kind='stall', tail_step=3)
         if problems:
             print('FAIL: ' + '; '.join(problems))
             return False
         print('watchdog: injected hang@step.3 detected, stall artifact '
-              'schema ok')
+              'schema ok, flight tail=stall@3')
         return True
 
 

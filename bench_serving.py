@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Serving bench: closed-loop latency/throughput sweep over the
+bucket ladder (docs/SERVING.md; CI stage 'bench-serving').
+
+For every batch bucket the sweep drives the inference engine two
+ways:
+
+  * closed-loop single requests through the micro-batcher (one
+    in-flight request per client, ``--clients`` concurrent clients)
+    — measures request latency under batching: p50/p99, requests/s;
+  * bulk batches of exactly the bucket size through the AOT program
+    (``InferenceSession.infer_batch``) — measures the compiled
+    program's examples/s ceiling per bucket.
+
+Writes the standard instrument status JSON (mxnet_tpu.instrument.v2:
+``status`` ok|degraded|unavailable, rc 0 on outage — the
+BENCH_r05-proof contract every instrument in this repo honors) whose
+payload carries per-bucket latency percentiles, requests/s, the
+engine recompile count vs the ladder bound, and the telemetry summary
+block.
+
+Usage: python bench_serving.py [--quick] [--out BENCH_SERVING.json]
+"""
+import argparse
+import sys
+import threading
+import time
+
+sys.path.insert(0, '.')
+import numpy as np  # noqa: E402
+
+FEATURES = 64
+CLASSES = 16
+
+
+def _build_frozen(max_batch):
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    np.random.seed(5)
+    mx.random.seed(5)
+    data = mx.sym.Variable('data')
+    h = mx.sym.FullyConnected(data, num_hidden=128, name='fc1')
+    h = mx.sym.Activation(h, act_type='relu')
+    h = mx.sym.FullyConnected(h, num_hidden=128, name='fc2')
+    h = mx.sym.Activation(h, act_type='relu')
+    h = mx.sym.FullyConnected(h, num_hidden=CLASSES, name='fc3')
+    out = mx.sym.SoftmaxOutput(h, name='softmax')
+    mod = mx.mod.Module(out, context=mx.context.current_context())
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, FEATURES).astype('float32')
+    y = rs.randint(0, CLASSES, (64,)).astype('float32')
+    it = mx.io.NDArrayIter(x, y, batch_size=32)
+    mod.fit(it, num_epoch=1, optimizer_params=(('learning_rate', 0.1),))
+    return serving.freeze(mod, max_batch=max_batch,
+                          name='bench-serving')
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def bench_bucket(session, bucket, seconds, clients):
+    """Closed-loop clients + bulk-batch throughput for one bucket."""
+    rs = np.random.RandomState(bucket)
+    x1 = rs.randn(FEATURES).astype('float32')
+    xb = rs.randn(bucket, FEATURES).astype('float32')
+    session.infer_batch([xb])          # compile outside the window
+
+    latencies = []
+    lock = threading.Lock()
+    stop = time.perf_counter() + seconds
+
+    def client():
+        mine = []
+        while time.perf_counter() < stop:
+            t0 = time.perf_counter()
+            session.infer(x1, timeout=30)
+            mine.append(time.perf_counter() - t0)
+        with lock:
+            latencies.extend(mine)
+
+    threads = [threading.Thread(target=client)
+               for _ in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(seconds + 30)
+    wall = time.perf_counter() - t_start
+
+    # bulk path: examples/s of the padded compiled program
+    reps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        session.infer_batch([xb])
+        reps += 1
+    bulk_dt = time.perf_counter() - t0
+
+    latencies.sort()
+    return {
+        'bucket': bucket,
+        'requests': len(latencies),
+        'requests_per_sec': round(len(latencies) / wall, 2)
+        if wall else None,
+        'latency_p50_ms': round(1e3 * _percentile(latencies, 0.50), 3)
+        if latencies else None,
+        'latency_p99_ms': round(1e3 * _percentile(latencies, 0.99), 3)
+        if latencies else None,
+        'bulk_examples_per_sec': round(reps * bucket / bulk_dt, 1)
+        if bulk_dt else None,
+    }
+
+
+def run(status, args):
+    from mxnet_tpu import serving
+
+    max_batch = 8 if args.quick else 32
+    frozen = _build_frozen(max_batch)
+    frozen.warmup()        # compile the ladder outside the timed windows
+    session = serving.InferenceSession(
+        frozen, deadline_ms=args.deadline_ms, watchdog=False)
+    seconds = 0.5 if args.quick else 3.0
+    sweep = []
+    try:
+        for bucket in frozen.policy.buckets:
+            rec = bench_bucket(session, bucket, seconds, args.clients)
+            print('bucket %3d: %s req/s, p50 %s ms, p99 %s ms, bulk '
+                  '%s ex/s' % (bucket, rec['requests_per_sec'],
+                               rec['latency_p50_ms'],
+                               rec['latency_p99_ms'],
+                               rec['bulk_examples_per_sec']),
+                  flush=True)
+            sweep.append(rec)
+    finally:
+        session.close()
+
+    recompiles = frozen.compile_count
+    payload = {
+        'metrics': [{
+            'metric': 'serving_bucket_sweep',
+            'unit': 'requests/s',
+            'clients': args.clients,
+            'deadline_ms': args.deadline_ms,
+            'buckets': list(frozen.policy.buckets),
+            'sweep': sweep,
+            'recompile_count': recompiles,
+            'recompile_bound': len(frozen.policy.buckets),
+            'recompiles_bounded': recompiles
+            <= len(frozen.policy.buckets),
+        }],
+    }
+    try:
+        from mxnet_tpu import observability
+        payload['telemetry'] = observability.summary()
+    except Exception as e:    # telemetry must never sink the artifact
+        payload['telemetry'] = {'enabled': False,
+                                'error': '%s: %s'
+                                % (type(e).__name__, e)}
+    if not payload['metrics'][0]['recompiles_bounded']:
+        raise AssertionError(
+            '%d programs compiled for a %d-bucket ladder'
+            % (recompiles, len(frozen.policy.buckets)))
+    return payload
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--out', default='BENCH_SERVING.json')
+    p.add_argument('--quick', action='store_true',
+                   help='CI-sized sweep (small ladder, short windows)')
+    p.add_argument('--clients', type=int, default=4)
+    p.add_argument('--deadline-ms', type=float, default=2.0)
+    args = p.parse_args()
+
+    from mxnet_tpu.resilience import run_instrument
+    return run_instrument('bench_serving',
+                          lambda status: run(status, args),
+                          out=args.out)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -63,6 +63,11 @@ from . import executor_manager
 from . import resilience
 from . import guardrail
 from . import observability
+from . import serving
+
+# persistent XLA compilation cache (MXNET_TPU_COMPILE_CACHE): applied
+# before any program compiles so restarts warm-start from disk
+config.configure_compile_cache()
 
 # env-driven global seed (docs/faq/env_var.md MXNET_SEED)
 _seed = config.get('MXNET_SEED')

@@ -16,7 +16,7 @@ import os
 import threading
 
 __all__ = ['Knob', 'KNOBS', 'get', 'set', 'unset', 'describe',
-           'naive_engine', 'NaiveEngineScope']
+           'naive_engine', 'NaiveEngineScope', 'configure_compile_cache']
 
 _lock = threading.Lock()
 _values = {}
@@ -190,6 +190,48 @@ KNOBS = {k.name: k for k in [
           ' when full.'),
     _knob('MXNET_TPU_FLIGHT_PATH', str, 'FLIGHT.jsonl',
           'Default dump path for the flight-recorder artifact.'),
+    # persistent compilation cache (docs/SERVING.md; training too)
+    _knob('MXNET_TPU_COMPILE_CACHE', str, None,
+          "Directory for jax's persistent compilation cache. When set"
+          ' (applied at import via configure_compile_cache), every'
+          ' XLA compile — training steps and serving buckets alike —'
+          ' is keyed into this directory and a later process reuses'
+          ' the compiled binary instead of recompiling: restarts and'
+          ' fleet rollouts warm-start. Unset (default) keeps'
+          " compilation in-memory only."),
+    # inference serving engine (docs/SERVING.md)
+    _knob('MXNET_TPU_SERVE_MAX_BATCH', int, 64,
+          'Micro-batcher aggregation cap and the default top of the'
+          ' bucket ladder: a flush happens the moment this many'
+          ' requests wait.'),
+    _knob('MXNET_TPU_SERVE_DEADLINE_MS', float, 5.0,
+          'Micro-batch flush deadline: the oldest queued request'
+          ' never waits longer than this before its (possibly'
+          ' partial) batch dispatches. The latency half of the'
+          ' batching trade; MXNET_TPU_SERVE_MAX_BATCH is the'
+          ' throughput half.'),
+    _knob('MXNET_TPU_SERVE_QUEUE_DEPTH', int, 256,
+          'Admission-control bound on pending requests; a submit'
+          ' against a full queue raises the typed BackpressureError'
+          ' (HTTP 429) immediately instead of queueing unboundedly.'),
+    _knob('MXNET_TPU_SERVE_TIMEOUT_S', float, 30.0,
+          'Per-request budget: a request older than this fails with'
+          ' RequestTimeout (HTTP 504) instead of occupying a batch'
+          ' slot after its client gave up; 0 disables.'),
+    _knob('MXNET_TPU_SERVE_BUCKETS', str, None,
+          'Explicit batch bucket ladder as a comma list (e.g.'
+          ' "1,8,32,128"); unset derives powers of two up to'
+          ' MXNET_TPU_SERVE_MAX_BATCH. Recompile count is bounded by'
+          ' the ladder size.'),
+    _knob('MXNET_TPU_SERVE_BREAKER', int, 3,
+          'Consecutive device-side batch failures before the serving'
+          ' circuit breaker opens and batches go straight to the CPU'
+          ' fallback until the reset probe succeeds.'),
+    _knob('MXNET_TPU_SERVE_HTTP_PORT', int, 0,
+          'Port for the stdlib JSON inference endpoint'
+          ' (/predict, /status, /healthz; binds 127.0.0.1). 0'
+          ' (default) keeps the server off — production fronts the'
+          ' engine with a real gateway.'),
     # preemption / elasticity / watchdog (docs/RESILIENCE.md)
     _knob('MXNET_TPU_PREEMPT_EXIT_CODE', int, 75,
           'Process exit code marking a preempted-but-resumable run'
@@ -322,6 +364,36 @@ def describe():
         lines.append('%-36s = %-24r %s%s' % (name, get(name), summary,
                                              tag))
     return '\n'.join(lines)
+
+
+# -- persistent compilation cache -------------------------------------------
+
+_compile_cache_dir = None
+
+
+def configure_compile_cache():
+    """Point jax's persistent compilation cache at the
+    ``MXNET_TPU_COMPILE_CACHE`` directory (no-op when unset).
+
+    Called once at package import — before any program compiles — so
+    both training steps and serving buckets key their XLA binaries
+    into the directory and a second process warm-starts: it still
+    traces python (cheap) but the expensive backend compile is a disk
+    read. The thresholds are dropped to "cache everything" because a
+    serving ladder is many small programs. Returns the directory in
+    effect, or None.
+    """
+    global _compile_cache_dir
+    cache_dir = get('MXNET_TPU_COMPILE_CACHE')
+    if not cache_dir or cache_dir == _compile_cache_dir:
+        return _compile_cache_dir
+    import jax
+    cache_dir = os.path.abspath(cache_dir)
+    jax.config.update('jax_compilation_cache_dir', cache_dir)
+    jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+    _compile_cache_dir = cache_dir
+    return cache_dir
 
 
 # -- debug mode (NaiveEngine analog) ----------------------------------------

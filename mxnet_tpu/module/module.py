@@ -350,22 +350,59 @@ class Module(BaseModule):
         if data_batch.label is not None:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
-        # reshape executor if batch shape changed (bucketing / last batch)
+        # batch shape changed (bucketing / last batch): on an inference
+        # pass a SMALLER batch pads up to the bound shape instead of
+        # reshaping — a reshape builds a fresh executor and traces a
+        # new program for a shape typically seen once (the final
+        # partial batch of every predict/score pass); padding reuses
+        # the compiled program and get_outputs() strips the pad rows,
+        # bit-identical to the unpadded path for row-independent
+        # inference graphs (docs/SERVING.md "Bucketing")
+        self._infer_trim = None
         cur = self._exec.arg_dict[self._data_names[0]].shape
         new = feed[self._data_names[0]].shape
         if tuple(cur) != tuple(new):
-            shape_kwargs = {n: tuple(a.shape) for n, a in feed.items()}
-            self._exec = self._exec.reshape(**shape_kwargs)
+            pad = not is_train and 0 < new[0] < cur[0] and all(
+                tuple(arr.shape[1:])
+                == tuple(self._exec.arg_dict[name].shape[1:])
+                and arr.shape[0] == new[0]
+                for name, arr in feed.items())
+            # padding is only exact for batch-major outputs (axis 0 ==
+            # batch): a batch-reduced head (MakeLoss(mean)) or a
+            # seq-major (T,N,C) output would silently fold the zero
+            # pad rows in — those graphs keep the exact reshape path.
+            # Unknown outputs (no full-shape forward yet) also fall
+            # back: exactness beats the compile saving.
+            if pad and not (self._exec.outputs and all(
+                    o.ndim >= 1 and o.shape[0] == cur[0]
+                    for o in self._exec.outputs)):
+                pad = False
+            if pad:
+                for name in list(feed):
+                    arr = feed[name]
+                    bound = self._exec.arg_dict[name].shape[0]
+                    filler = nd.zeros((bound - new[0],)
+                                      + tuple(arr.shape[1:]),
+                                      dtype=arr.dtype)
+                    feed[name] = nd.concatenate([arr, filler])
+                self._infer_trim = new[0]
+            else:
+                shape_kwargs = {n: tuple(a.shape)
+                                for n, a in feed.items()}
+                self._exec = self._exec.reshape(**shape_kwargs)
         if self._dp_mesh is not None:
             n_dev = len(self._context_list)
-            if new[0] % n_dev == 0:
+            # the FED batch (a padded partial batch is bound-shaped
+            # and shards fine), not the caller's row count
+            fed_b = feed[self._data_names[0]].shape[0]
+            if fed_b % n_dev == 0:
                 self._place_dp(feed)
             else:
                 if not getattr(self, '_dp_odd_warned', False):
                     self._dp_odd_warned = True
                     self.logger.warning(
                         'batch size %d not divisible by %d devices; this '
-                        'batch runs on %s only', new[0], n_dev,
+                        'batch runs on %s only', fed_b, n_dev,
                         self._context)
                 self._undo_dp()
         self._exec.forward(is_train=is_train, **feed)
@@ -395,7 +432,12 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         self._require(bound=True)
-        return self._exec.outputs
+        outs = self._exec.outputs
+        trim = getattr(self, '_infer_trim', None)
+        if trim is not None:
+            # strip the pad rows of a padded partial-batch forward
+            outs = [o[:trim] for o in outs]
+        return outs
 
     def get_input_grads(self, merge_multi_context=True):
         self._require(bound=True)
@@ -409,7 +451,9 @@ class Module(BaseModule):
             eval_metric.update_dict(
                 dict(zip(self._label_names, labels if not pre_sliced
                          else labels[0])),
-                dict(zip(self._output_names, self._exec.outputs)))
+                # get_outputs (not _exec.outputs): a padded partial
+                # batch must score its real rows only
+                dict(zip(self._output_names, self.get_outputs())))
 
     def get_states(self, merge_multi_context=True):
         self._require(bound=True, initialized=True)

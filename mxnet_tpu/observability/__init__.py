@@ -58,7 +58,7 @@ __all__ = [
     'write_prometheus', 'write_jsonl', 'tensorboard_export',
     'PrometheusServer', 'maybe_start_http_server', 'parse_prometheus',
     'trainer_instruments', 'kv_instruments', 'dispatch_instruments',
-    'summary',
+    'serving_instruments', 'summary',
 ]
 
 
@@ -73,6 +73,7 @@ class _Instruments:
 _trainer_inst = None
 _kv_inst = None
 _dispatch_inst = None
+_serving_inst = None
 
 
 def trainer_instruments():
@@ -155,6 +156,49 @@ def dispatch_instruments():
                                     '(new program traced)'),
         )
     return _dispatch_inst
+
+
+def serving_instruments():
+    """Inference-engine instruments (serving/, docs/SERVING.md)."""
+    global _serving_inst
+    if _serving_inst is None:
+        try:
+            maybe_start_http_server()
+        except Exception:
+            pass      # an occupied port must not fail serving
+        _serving_inst = _Instruments(
+            requests=counter('mxnet_tpu_serve_requests_total',
+                             help='inference requests admitted'),
+            rejected=counter('mxnet_tpu_serve_rejected_total',
+                             labels=('reason',),
+                             help='requests rejected by admission '
+                                  'control (queue_full, ...)'),
+            batches=counter('mxnet_tpu_serve_batches_total',
+                            help='micro-batches dispatched'),
+            batch_size=histogram('mxnet_tpu_serve_batch_size',
+                                 help='requests aggregated per '
+                                      'micro-batch'),
+            queue_depth=gauge('mxnet_tpu_serve_queue_depth',
+                              help='pending requests in the '
+                                   'micro-batch queue'),
+            latency=histogram('mxnet_tpu_serve_request_seconds',
+                              help='request latency: enqueue to '
+                                   'result set (queue wait + batch '
+                                   'execute)'),
+            compiles=counter('mxnet_tpu_serve_compiles_total',
+                             help='inference programs built (bounded '
+                                  'by the bucket ladder)'),
+            breaker_trips=counter(
+                'mxnet_tpu_serve_breaker_trips_total',
+                help='circuit-breaker open transitions'),
+            fallbacks=counter('mxnet_tpu_serve_fallback_batches_total',
+                              help='batches served on the CPU '
+                                   'fallback path'),
+            degraded=gauge('mxnet_tpu_serve_degraded',
+                           help='1 while the session serves degraded '
+                                '(breaker open / fallback active)'),
+        )
+    return _serving_inst
 
 
 def summary():

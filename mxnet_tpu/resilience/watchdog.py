@@ -99,10 +99,13 @@ class Watchdog:
 
     def __init__(self, budgets=None, artifact_path=None, name='train',
                  clock=time.monotonic, injector=None, on_stall=None,
-                 poll_s=None):
+                 poll_s=None, site='train.step'):
         self.budgets = {ph: _knob(*kn) for ph, kn in
                         _BUDGET_KNOBS.items()}
         self.budgets.update(budgets or {})
+        self.site = site        # fault-injection site beats fire at
+                                # ('serving.infer' for the inference
+                                # engine, docs/SERVING.md)
         self.artifact_path = artifact_path or os.path.join(
             os.getcwd(), 'STALL.json')
         self.name = name
@@ -138,7 +141,7 @@ class Watchdog:
                 self._phase = phase
             self._step = step
             try:
-                inject('train.step', ('hang',), injector=self._injector,
+                inject(self.site, ('hang',), injector=self._injector,
                        step=step)
             except HangError:
                 self._last = now - self.budget_for(self._phase) - 1.0

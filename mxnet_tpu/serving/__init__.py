@@ -1,0 +1,77 @@
+"""Inference serving engine: AOT-frozen programs, shape-bucketed
+compile cache, dynamic micro-batching with admission control
+(docs/SERVING.md).
+
+Training-side subsystems (resilience, guardrails, elasticity,
+telemetry) made runs survivable; this package makes the trained result
+*servable*. The pipeline, end to end::
+
+    frozen  = serving.freeze(module_or_block)     # AOT per-bucket
+    frozen.save('model.frozen')                   # mxnet_tpu.frozen.v1
+    session = serving.InferenceSession(frozen)    # batcher + breaker
+    y = session.infer(x)                          # or submit() futures
+
+  * ``freeze``   — trained ``Module`` / gluon ``Block`` /
+                   ``FeedForward`` -> pure inference fn, AOT-lowered
+                   and compiled per shape bucket, donated input
+                   buffers, persisted on disk so a restart skips
+                   tracing entirely.
+  * ``bucket``   — BucketingModule's per-shape specialization applied
+                   to the jit cache: powers-of-two batch buckets
+                   (+ optional sequence-length buckets), bit-exact
+                   pad/unpad, recompiles bounded by the ladder size.
+  * ``batcher``  — dynamic micro-batching (max_batch / deadline_ms,
+                   FIFO futures) with typed admission control:
+                   bounded queue -> ``BackpressureError``, per-request
+                   timeout -> ``RequestTimeout``.
+  * ``server``   — ``InferenceSession`` threading the engine through
+                   the resilience layer (circuit breaker ->
+                   CPU-fallback degraded serving, stall watchdog at
+                   site ``serving.infer``) and telemetry (request /
+                   batch-size / queue-depth / latency instruments,
+                   flight events on rejections and breaker trips),
+                   plus the off-by-default stdlib HTTP JSON endpoint.
+
+``python -m mxnet_tpu.serving`` runs the selftest (CI stage
+'serving'): engine outputs bit-identical to direct inference,
+recompiles bounded by bucket count, frozen reload serving with zero
+retraces, and overflow rejecting typed instead of hanging.
+"""
+from __future__ import annotations
+
+from . import bucket
+from . import batcher
+from .bucket import (BucketPolicy, bucket_for, default_buckets,
+                     parse_buckets, pad_axis0, pad_axis1, unpad_axis0)
+from .batcher import (BackpressureError, BatcherClosed, MicroBatcher,
+                      RequestTimeout)
+
+__all__ = [
+    'bucket', 'batcher', 'BucketPolicy', 'bucket_for',
+    'default_buckets', 'parse_buckets', 'pad_axis0', 'pad_axis1',
+    'unpad_axis0', 'BackpressureError', 'BatcherClosed', 'MicroBatcher',
+    'RequestTimeout', 'FROZEN_SCHEMA', 'FrozenProgram', 'freeze',
+    'load_frozen', 'InferenceSession', 'ServingHTTPServer',
+    'maybe_start_http_server',
+]
+
+# jax-importing halves load lazily through __getattr__ so the
+# bucket/batcher math (and their tests) stay usable without a backend,
+# the same import-light discipline as resilience/observability.
+_LAZY = {
+    'FROZEN_SCHEMA': 'freeze', 'FrozenProgram': 'freeze',
+    'freeze': 'freeze', 'load_frozen': 'freeze',
+    'InferenceSession': 'server', 'ServingHTTPServer': 'server',
+    'maybe_start_http_server': 'server',
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError('module %r has no attribute %r'
+                             % (__name__, name))
+    from importlib import import_module
+    value = getattr(import_module('.' + mod, __name__), name)
+    globals()[name] = value
+    return value

@@ -1,0 +1,373 @@
+"""Dynamic micro-batching queue with admission control.
+
+Single-request inference wastes a TPU: the matrix units want batch
+work, but a serving frontend receives requests one at a time. The
+micro-batcher aggregates concurrent single-example requests into one
+device batch under two triggers — ``max_batch`` requests waiting
+(flush immediately) or the oldest request aging past ``deadline_ms``
+(latency bound) — and distributes the batched outputs back to
+per-request futures in submission order.
+
+Admission control keeps overload typed instead of silent:
+
+  * bounded queue depth — a submit against a full queue raises
+    :class:`BackpressureError` immediately (the caller sheds load or
+    retries with backoff; nothing ever blocks unboundedly);
+  * per-request timeout — a request that waits in the queue longer
+    than ``timeout_s`` fails with :class:`RequestTimeout` instead of
+    occupying a batch slot after its client gave up.
+
+Thread-safety contract: ``submit`` is callable from any number of
+threads; results preserve FIFO order per submitter because the worker
+pops requests in arrival order and maps output row *i* to request
+*i*. The runner callable executes on the single worker thread, so the
+compiled-program cache underneath needs no locking.
+
+numpy + stdlib only (no jax import): the queue math is testable with
+a fake runner and a fake clock, the same dependency-light discipline
+as the resilience layer.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
+
+import numpy as onp
+
+__all__ = ['BackpressureError', 'RequestTimeout', 'BatcherClosed',
+           'MicroBatcher']
+
+
+class BackpressureError(RuntimeError):
+    """Typed queue-full rejection: the admission-control signal a load
+    balancer turns into HTTP 429 / retry-after. Carries the observed
+    depth and the configured limit."""
+
+    def __init__(self, depth, limit):
+        super().__init__('serving queue full (%d/%d pending); shed '
+                         'load or retry with backoff' % (depth, limit))
+        self.depth = depth
+        self.limit = limit
+
+
+class RequestTimeout(TimeoutError):
+    """A request aged past its per-request budget before (or while)
+    being served."""
+
+
+class BatcherClosed(RuntimeError):
+    """Submit against a closed batcher."""
+
+
+class _Request:
+    __slots__ = ('arrays', 'future', 'enqueued_at', 'deadline_at')
+
+    def __init__(self, arrays, future, enqueued_at, deadline_at):
+        self.arrays = arrays
+        self.future = future
+        self.enqueued_at = enqueued_at
+        self.deadline_at = deadline_at
+
+
+def _serving_instruments():
+    try:
+        from .. import observability as _obs
+        if _obs.enabled():
+            return _obs.serving_instruments()
+    except Exception:
+        pass
+    return None
+
+
+def _record_event(kind, **fields):
+    try:
+        from .. import observability as _obs
+        if _obs.enabled():
+            _obs.record_event(kind, **fields)
+    except Exception:
+        pass
+
+
+class MicroBatcher:
+    """Futures-based dynamic micro-batching over a runner callable.
+
+    ``runner(inputs, n)`` receives one numpy array per model input —
+    each the axis-0 stack of ``n`` single-example request arrays — and
+    returns a list of output arrays whose axis 0 maps back to the
+    requests; it runs on the worker thread. Bucket padding is the
+    runner's concern (the frozen program pads to its own ladder), so
+    the batcher stays pure queue math.
+
+    ``clock``/``timer`` are injectable for deterministic tests.
+    """
+
+    def __init__(self, runner, max_batch=64, deadline_ms=5.0,
+                 max_queue=256, timeout_s=30.0, name='serving',
+                 clock=time.monotonic, example_shapes=None):
+        if max_batch < 1:
+            raise ValueError('max_batch must be >= 1')
+        self._runner = runner
+        # declared per-example shapes (no batch axis), one per model
+        # input; when given, submit() validates rank-exactly instead
+        # of guessing whether a leading 1 is a batch axis
+        self.example_shapes = [tuple(s) for s in example_shapes] \
+            if example_shapes is not None else None
+        self.max_batch = int(max_batch)
+        self.deadline_s = float(deadline_ms) / 1000.0
+        self.max_queue = int(max_queue)
+        self.timeout_s = float(timeout_s) if timeout_s else None
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._queue = []
+        self._inflight = []      # popped into a running batch
+        self._closed = False
+        self._submitted = 0
+        self._completed = 0
+        self._rejected = 0
+        self._timeouts = 0
+        self._batches = 0
+        self._flushes = {'full': 0, 'deadline': 0, 'drain': 0}
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True,
+            name='mxnet-tpu-%s-batcher' % name)
+        self._thread.start()
+        # reaper: per-request timeouts must fire even while the worker
+        # is blocked inside a stuck runner (the hung-backend case the
+        # budget exists for) — the worker's own scan cannot run then
+        self._reaper = None
+        if self.timeout_s:
+            self._reaper = threading.Thread(
+                target=self._reap_loop, daemon=True,
+                name='mxnet-tpu-%s-reaper' % name)
+            self._reaper.start()
+
+    # -- submission --------------------------------------------------------
+
+    def _normalize(self, arrays):
+        """Resolve each request array to its per-example shape.
+
+        With declared ``example_shapes`` the leading-batch-axis-of-1
+        form is disambiguated by RANK (a genuine (1, h, w) example is
+        never mistaken for a batched (h, w) one) and a wrong rank is
+        a typed error at admission, not a compile error mid-batch.
+        Without declarations, arrays pass through as-is.
+        """
+        if self.example_shapes is None:
+            return arrays
+        if len(arrays) != len(self.example_shapes):
+            raise ValueError(
+                'request has %d input(s); model takes %d'
+                % (len(arrays), len(self.example_shapes)))
+        out = []
+        for arr, shape in zip(arrays, self.example_shapes):
+            if arr.ndim == len(shape) + 1 and arr.shape[0] == 1:
+                arr = arr[0]              # explicit batch axis of 1
+            elif arr.ndim != len(shape):
+                raise ValueError(
+                    'request input of shape %r does not match the '
+                    'per-example shape %r' % (arr.shape, shape))
+            out.append(arr)
+        return out
+
+    def submit(self, *arrays):
+        """Enqueue one request (one array per model input, per-example
+        shape — an explicit leading batch axis of 1 is accepted when
+        the batcher knows its ``example_shapes``) and return its
+        :class:`concurrent.futures.Future`.
+
+        Raises :class:`BackpressureError` when the queue is at depth,
+        :class:`BatcherClosed` after :meth:`close`.
+        """
+        arrays = self._normalize([onp.asarray(a) for a in arrays])
+        now = self._clock()
+        fut = Future()
+        with self._lock:
+            if self._closed:
+                raise BatcherClosed('batcher %r is closed' % self.name)
+            depth = len(self._queue)
+            if depth >= self.max_queue:
+                self._rejected += 1
+                inst = _serving_instruments()
+                if inst is not None:
+                    inst.rejected.labels(reason='queue_full').inc()
+                    inst.queue_depth.set(depth)
+                _record_event('serve_reject', reason='queue_full',
+                              depth=depth, limit=self.max_queue)
+                raise BackpressureError(depth, self.max_queue)
+            deadline_at = now + self.timeout_s if self.timeout_s else None
+            self._queue.append(_Request(arrays, fut, now, deadline_at))
+            self._submitted += 1
+            depth = len(self._queue)
+            self._wake.notify()
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.requests.inc()
+            inst.queue_depth.set(depth)
+        return fut
+
+    def infer(self, *arrays, timeout=None):
+        """Blocking convenience: submit + wait. ``timeout`` defaults to
+        the per-request budget; a lapse raises :class:`RequestTimeout`."""
+        fut = self.submit(*arrays)
+        try:
+            return fut.result(timeout if timeout is not None
+                              else self.timeout_s)
+        except _FutTimeout:
+            fut.cancel()
+            raise RequestTimeout(
+                'request not served within %.3fs'
+                % (timeout if timeout is not None else self.timeout_s)) \
+                from None
+
+    # -- worker ------------------------------------------------------------
+
+    def _expire_queued_locked(self, now):
+        """Fail requests past their budget; drop cancelled queued
+        ones. Covers both the queue AND requests already popped into
+        a batch whose runner is hung — the budget holds even when the
+        worker is stuck (the in-flight futures just get the timeout;
+        a late-finishing runner skips done futures). Caller holds the
+        lock."""
+        kept = []
+        for req in self._queue:
+            if req.deadline_at is not None and \
+                    now >= req.deadline_at and \
+                    not req.future.done():
+                self._timeouts += 1
+                req.future.set_exception(RequestTimeout(
+                    'request waited %.3fs in queue (budget %.3fs)'
+                    % (now - req.enqueued_at, self.timeout_s)))
+            elif req.future.cancelled():
+                pass
+            else:
+                kept.append(req)
+        self._queue = kept
+        for req in self._inflight:
+            if req.deadline_at is not None and \
+                    now >= req.deadline_at and \
+                    not req.future.done():
+                self._timeouts += 1
+                req.future.set_exception(RequestTimeout(
+                    'request in-flight %.3fs without a result (budget '
+                    '%.3fs; runner stuck?)'
+                    % (now - req.enqueued_at, self.timeout_s)))
+
+    def _reap_loop(self):
+        """Timeout scan independent of the worker: a runner blocked on
+        a dead backend must not also freeze the per-request budgets."""
+        while True:
+            time.sleep(min(0.05, max(self.timeout_s / 4.0, 0.005)))
+            with self._lock:
+                if self._closed and not self._queue:
+                    return
+                self._expire_queued_locked(self._clock())
+
+    def _take_batch(self):
+        """Block until a batch is due; pop and return it (FIFO).
+        Returns (requests, cause) or (None, None) at close-drain."""
+        with self._lock:
+            while True:
+                if self._queue:
+                    self._expire_queued_locked(self._clock())
+                if not self._queue:
+                    if self._closed:
+                        return None, None
+                    self._wake.wait(0.05)
+                    continue
+                now = self._clock()
+                oldest = self._queue[0].enqueued_at
+                if len(self._queue) >= self.max_batch:
+                    cause = 'full'
+                elif self._closed:
+                    cause = 'drain'
+                elif now - oldest >= self.deadline_s:
+                    cause = 'deadline'
+                else:
+                    self._wake.wait(
+                        min(self.deadline_s - (now - oldest), 0.05))
+                    continue
+                batch = self._queue[:self.max_batch]
+                del self._queue[:len(batch)]
+                self._inflight = batch
+                self._flushes[cause] += 1
+                return batch, cause
+
+    def _worker(self):
+        while True:
+            batch, cause = self._take_batch()
+            if batch is None:
+                return
+            self._run_batch(batch, cause)
+
+    def _run_batch(self, batch, cause):
+        n = len(batch)
+        arity = len(batch[0].arrays)
+        t0 = self._clock()
+        try:
+            stacked = [
+                onp.stack([req.arrays[i] for req in batch])
+                for i in range(arity)]
+            outputs = self._runner(stacked, n)
+        except BaseException as exc:  # noqa: BLE001 - relayed to futures
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            return
+        finally:
+            with self._lock:
+                self._inflight = []
+        dt = self._clock() - t0
+        with self._lock:
+            self._batches += 1
+            self._completed += n
+            depth = len(self._queue)
+        for i, req in enumerate(batch):
+            if req.future.done():
+                continue
+            req.future.set_result([onp.asarray(out)[i]
+                                   for out in outputs])
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.batches.inc()
+            inst.batch_size.observe(n)
+            inst.queue_depth.set(depth)
+            for req in batch:
+                inst.latency.observe(
+                    max(0.0, (t0 + dt) - req.enqueued_at))
+
+    # -- lifecycle / introspection ----------------------------------------
+
+    def stats(self):
+        with self._lock:
+            return {'depth': len(self._queue),
+                    'submitted': self._submitted,
+                    'completed': self._completed,
+                    'rejected': self._rejected,
+                    'timeouts': self._timeouts,
+                    'batches': self._batches,
+                    'flushes': dict(self._flushes),
+                    'closed': self._closed}
+
+    def close(self, drain=True, timeout=10.0):
+        """Stop accepting requests; drain the queue (or fail pending
+        futures when ``drain=False``) and join the worker."""
+        with self._lock:
+            self._closed = True
+            if not drain:
+                for req in self._queue:
+                    if not req.future.done():
+                        req.future.set_exception(
+                            BatcherClosed('batcher closed'))
+                self._queue = []
+            self._wake.notify_all()
+        self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,168 @@
+"""Shape bucketing for the inference engine (docs/SERVING.md).
+
+The BucketingModule idea — one specialization per input shape, shared
+parameters — applied to the jit cache: instead of compiling a program
+for every request batch size the server ever sees (an unbounded
+recompile surface), requests pad up to a small fixed ladder of batch
+buckets (powers of two by default, BucketingModule's per-shape
+executor pool collapsed onto XLA's static-shape requirement). The
+recompile count is then bounded by the bucket count, and the pad /
+unpad round-trip is bit-exact for row-independent inference graphs:
+padding rows ride along in the same XLA program but every real row's
+reduction order is unchanged (per-row dot/conv contractions reduce
+over feature axes only — batch is a parallel dimension).
+
+Optional sequence-length buckets give the classic BucketingModule
+behavior for variable-length inputs (axis 1), composing with the
+batch ladder.
+
+numpy-only by design (no jax import): the batcher and its tests run
+without a backend, and padding happens on host before device transfer
+anyway.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ['default_buckets', 'parse_buckets', 'bucket_for',
+           'pad_axis0', 'pad_axis1', 'unpad_axis0', 'BucketPolicy']
+
+
+def default_buckets(max_batch):
+    """Powers-of-two ladder 1, 2, 4, ... up to (and always including)
+    ``max_batch`` — ceil-log2(max_batch)+1 buckets, so the recompile
+    bound grows logarithmically with the served batch size."""
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise ValueError('max_batch must be >= 1, got %d' % max_batch)
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+def _validate_ladder(vals, spec):
+    """Shared ladder validation: ascending, unique, every bucket >= 1
+    — the same rules whether the ladder came from the knob string or
+    a python sequence."""
+    vals = sorted({int(b) for b in vals})
+    if not vals or vals[0] < 1:
+        raise ValueError('bad bucket ladder %r (buckets must be >= 1)'
+                         % (spec,))
+    return tuple(vals)
+
+
+def parse_buckets(spec):
+    """Parse an explicit bucket ladder from a comma list (the
+    ``MXNET_TPU_SERVE_BUCKETS`` knob), sorted ascending, duplicates
+    dropped."""
+    return _validate_ladder(
+        [tok for tok in str(spec).split(',') if tok.strip()], spec)
+
+
+def bucket_for(n, buckets):
+    """Smallest bucket >= ``n``; raises ValueError when the request
+    exceeds the largest bucket (admission control rejects it upstream
+    instead of silently recompiling)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError('batch %d exceeds the largest bucket %d'
+                     % (n, buckets[-1]))
+
+
+def pad_axis0(arr, target):
+    """Zero-pad ``arr`` along axis 0 up to ``target`` rows (no copy
+    when already there)."""
+    arr = onp.asarray(arr)
+    n = arr.shape[0]
+    if n == target:
+        return arr
+    if n > target:
+        raise ValueError('cannot pad %d rows down to %d' % (n, target))
+    pad = onp.zeros((target - n,) + arr.shape[1:], dtype=arr.dtype)
+    return onp.concatenate([arr, pad], axis=0)
+
+
+def pad_axis1(arr, target):
+    """Zero-pad along axis 1 (sequence-length bucketing)."""
+    arr = onp.asarray(arr)
+    n = arr.shape[1]
+    if n == target:
+        return arr
+    if n > target:
+        raise ValueError('cannot pad seq-len %d down to %d' % (n, target))
+    pad = onp.zeros((arr.shape[0], target - n) + arr.shape[2:],
+                    dtype=arr.dtype)
+    return onp.concatenate([arr, pad], axis=1)
+
+
+def unpad_axis0(arr, n):
+    """Strip bucket padding: the first ``n`` rows."""
+    return onp.asarray(arr)[:n]
+
+
+class BucketPolicy:
+    """Batch (and optional sequence-length) bucket ladder.
+
+    ``buckets`` — ascending batch sizes; requests pad up to the
+    smallest fitting bucket. ``seq_buckets`` — optional ascending
+    sequence lengths for axis 1 of every input (None disables
+    sequence bucketing). The policy is pure shape math; the frozen
+    program owns the per-bucket compiled executables.
+    """
+
+    __slots__ = ('buckets', 'seq_buckets')
+
+    def __init__(self, buckets=None, max_batch=64, seq_buckets=None):
+        if buckets is None:
+            buckets = default_buckets(max_batch)
+        elif isinstance(buckets, str):
+            buckets = parse_buckets(buckets)
+        else:
+            buckets = _validate_ladder(buckets, buckets)
+        self.buckets = buckets
+        self.seq_buckets = _validate_ladder(seq_buckets, seq_buckets) \
+            if seq_buckets else None
+
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        return bucket_for(n, self.buckets)
+
+    def seq_bucket_for(self, n):
+        if self.seq_buckets is None:
+            return n
+        return bucket_for(n, self.seq_buckets)
+
+    def key_for(self, n, seq_len=None):
+        """(batch_bucket, seq_bucket|None) — the jit-specialization
+        key; distinct keys bound the recompile count."""
+        return (self.bucket_for(n),
+                None if seq_len is None or self.seq_buckets is None
+                else self.seq_bucket_for(seq_len))
+
+    def pad(self, arrays, n=None, seq_len=None):
+        """Pad a list of stacked input arrays to their bucket shape.
+
+        Returns ``(padded_arrays, n)`` with ``n`` the real row count
+        (for :func:`unpad_axis0` on the outputs).
+        """
+        arrays = [onp.asarray(a) for a in arrays]
+        if n is None:
+            n = arrays[0].shape[0]
+        b = self.bucket_for(n)
+        out = [pad_axis0(a, b) for a in arrays]
+        if self.seq_buckets is not None and seq_len is not None:
+            s = self.seq_bucket_for(seq_len)
+            out = [pad_axis1(a, s) if a.ndim >= 2 else a for a in out]
+        return out, n
+
+    def __repr__(self):
+        return ('BucketPolicy(buckets=%r, seq_buckets=%r)'
+                % (self.buckets, self.seq_buckets))

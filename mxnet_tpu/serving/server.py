@@ -1,0 +1,425 @@
+"""InferenceSession: the serving engine's composition layer + HTTP.
+
+One object wires the frozen program, the bucket ladder, the
+micro-batcher, and the resilience/observability layers into the
+request path a production frontend talks to:
+
+    session = serving.InferenceSession(frozen)
+    fut = session.submit(x)          # futures API
+    y = session.infer(x)             # blocking convenience
+
+Request path: submit -> admission control (bounded queue, typed
+:class:`~.batcher.BackpressureError`) -> micro-batch flush (max_batch
+or deadline) -> pad to bucket -> AOT executable -> unpad -> future.
+
+Failure path (docs/RESILIENCE.md, threaded through rather than bolted
+on): every device-side batch runs under the circuit breaker; a
+transient failure — injected ``hang@serving.infer`` (stall watchdog
+artifact + ``TunnelStallError``), injected ``device_loss@serving``, or
+a real backend error — counts a breaker failure and the batch is
+re-served on the CPU fallback path, so requests complete degraded
+instead of erroring. When the breaker opens, batches skip the dead
+accelerator entirely until the reset probe closes it again. Breaker
+trips land in the metrics registry and the flight recorder
+(``breaker_open`` event + ring dump), and :meth:`InferenceSession.status`
+reports ``degraded`` while the fallback is serving.
+
+The JSON-over-HTTP endpoint is stdlib-only and OFF by default
+(``MXNET_TPU_SERVE_HTTP_PORT=0``), the same opt-in pattern as the
+Prometheus exporter: production fronts this engine with a real
+gateway; the endpoint exists for interactive runs and the selftest.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+
+import numpy as onp
+
+from .batcher import BackpressureError, BatcherClosed, MicroBatcher, \
+    RequestTimeout
+from .freeze import FrozenProgram
+
+__all__ = ['InferenceSession', 'ServingHTTPServer',
+           'maybe_start_http_server']
+
+# ceiling on an HTTP handler's wait when MXNET_TPU_SERVE_TIMEOUT_S=0
+# disables the per-request budget: handler threads must never block
+# forever (ThreadingHTTPServer wedges one thread per connection)
+_HTTP_MAX_WAIT_S = 300.0
+
+
+def _knob(name, default):
+    try:
+        from .. import config as _config
+        v = _config.get(name)
+        return default if v is None else v
+    except Exception:
+        return default
+
+
+class InferenceSession:
+    """Serve a :class:`~.freeze.FrozenProgram` behind dynamic
+    micro-batching, a circuit breaker, and a CPU fallback.
+
+    Knob defaults come from ``MXNET_TPU_SERVE_*`` (docs/ENV_VARS.md);
+    constructor arguments win. ``watchdog=True`` (default) arms a
+    stall watchdog on the ``infer`` phase whose fault-injection site
+    is ``serving.infer``; ``stall_artifact`` overrides its dump path.
+    """
+
+    def __init__(self, frozen, max_batch=None, deadline_ms=None,
+                 max_queue=None, timeout_s=None, breaker=None,
+                 watchdog=True, stall_artifact=None, name=None,
+                 warmup=False):
+        if not isinstance(frozen, FrozenProgram):
+            raise TypeError('InferenceSession serves a FrozenProgram; '
+                            'got %s (use serving.freeze first)'
+                            % type(frozen).__name__)
+        from ..resilience.policy import CircuitBreaker
+        self.frozen = frozen
+        self.name = name or frozen.name
+        max_batch = int(max_batch
+                        if max_batch is not None
+                        else min(frozen.policy.max_batch,
+                                 int(_knob('MXNET_TPU_SERVE_MAX_BATCH',
+                                           64))))
+        if max_batch > frozen.policy.max_batch:
+            raise ValueError(
+                'max_batch %d exceeds the largest bucket %d'
+                % (max_batch, frozen.policy.max_batch))
+        threshold = int(_knob('MXNET_TPU_SERVE_BREAKER', 3))
+        self._breaker = breaker if breaker is not None else \
+            CircuitBreaker(failure_threshold=max(1, threshold),
+                           reset_timeout=30.0)
+        self._watchdog = None
+        if watchdog:
+            from ..resilience.watchdog import Watchdog
+            self._watchdog = Watchdog(
+                budgets={'infer': float(
+                    _knob('MXNET_TPU_WATCHDOG_STEP_S', 300.0))},
+                artifact_path=stall_artifact, name=self.name,
+                site='serving.infer', on_stall=self._on_real_stall)
+            # background monitor: a REAL hang blocks the batcher
+            # worker inside the device call, so only a separate
+            # thread can observe the stale heartbeat — it writes the
+            # stall artifact, trips the breaker, and flips status to
+            # degraded (the wedged worker itself cannot; pending
+            # requests fail via the batcher's per-request timeouts)
+            self._watchdog.start()
+        self._lock = threading.Lock()
+        self._batch_seq = 0
+        self._fallback_batches = 0
+        self._accel_batches = 0
+        self._degraded = False
+        self._last_error = None
+        if warmup:
+            frozen.warmup()
+        self._batcher = MicroBatcher(
+            self._run_batch,
+            max_batch=max_batch,
+            deadline_ms=float(deadline_ms if deadline_ms is not None
+                              else _knob('MXNET_TPU_SERVE_DEADLINE_MS',
+                                         5.0)),
+            max_queue=int(max_queue if max_queue is not None
+                          else _knob('MXNET_TPU_SERVE_QUEUE_DEPTH',
+                                     256)),
+            timeout_s=float(timeout_s if timeout_s is not None
+                            else _knob('MXNET_TPU_SERVE_TIMEOUT_S',
+                                       30.0)),
+            name=self.name,
+            # rank-exact request validation at admission (a genuine
+            # (1, h, w) example is never mistaken for a batched one)
+            example_shapes=[s for _n, s, _dt in frozen.data_descs])
+
+    # -- request API -------------------------------------------------------
+
+    def submit(self, *arrays):
+        """Enqueue one single-example request; returns a Future whose
+        result is the list of per-example output arrays."""
+        return self._batcher.submit(*arrays)
+
+    def infer(self, *arrays, timeout=None):
+        """Blocking single-request inference through the batched
+        engine."""
+        return self._batcher.infer(*arrays, timeout=timeout)
+
+    def infer_batch(self, arrays, timeout=None):
+        """Run an already-stacked batch (one array per input, n rows)
+        through the bucketed program directly — the bulk path bench /
+        offline scoring uses; the micro-batch queue is for concurrent
+        single requests."""
+        n = onp.asarray(arrays[0]).shape[0]
+        seq = self._next_seq()
+        return self._serve(list(arrays), n, seq)
+
+    # -- batched execution (batcher worker thread) -------------------------
+
+    def _next_seq(self):
+        with self._lock:
+            seq = self._batch_seq
+            self._batch_seq += 1
+        return seq
+
+    def _run_batch(self, stacked, n):
+        return self._serve(stacked, n, self._next_seq())
+
+    def _on_real_stall(self, record):
+        """Watchdog monitor-thread escalation: a device call overran
+        the stall budget with the worker still blocked inside it."""
+        with self._lock:
+            self._degraded = True
+            self._last_error = ('stall: %s phase stalled %.1fs '
+                                '(budget %.1fs)'
+                                % (record.get('phase'),
+                                   record.get('waited_s', 0.0),
+                                   record.get('budget_s', 0.0)))
+        self._breaker.record_failure()
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.serving_instruments().degraded.set(1.0)
+        except Exception:
+            pass
+
+    def _execute_accel(self, stacked, n, seq):
+        from ..resilience.policy import inject
+        inject('serving', ('device_loss',), step=seq)
+        if self._watchdog is not None:
+            # an injected hang@serving.infer aged the heartbeat at
+            # beat(); check() now writes the stall artifact + flight
+            # dump and raises TunnelStallError into the breaker
+            self._watchdog.check()
+        return self.frozen.run(stacked, n)
+
+    def _serve(self, stacked, n, seq):
+        from ..resilience.policy import CircuitOpenError, is_transient
+        if self._watchdog is not None:
+            self._watchdog.beat(step=seq, phase='infer')
+        was_open = self._breaker.state == 'open'
+        try:
+            outs = self._breaker.call(self._execute_accel, stacked, n,
+                                      seq)
+        except Exception as exc:
+            if not (is_transient(exc)
+                    or isinstance(exc, CircuitOpenError)):
+                raise               # bug-shaped: fail the requests loudly
+            self._note_failure(exc, seq, was_open)
+            outs = self.frozen.run_fallback(stacked, n)
+            with self._lock:
+                self._fallback_batches += 1
+            self._instrument_fallback()
+            return outs
+        with self._lock:
+            self._accel_batches += 1
+            self._degraded = False
+            self._last_error = None
+        self._instrument_ok()
+        return outs
+
+    def _note_failure(self, exc, seq, was_open):
+        with self._lock:
+            self._degraded = True
+            self._last_error = '%s: %s' % (type(exc).__name__, exc)
+        state = self._breaker.state
+        newly_open = state != 'closed' and not was_open
+        logging.warning('serving %s: batch %d failed (%s); state=%s, '
+                        'serving on CPU fallback', self.name, seq,
+                        self._last_error, state)
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                inst = _obs.serving_instruments()
+                inst.degraded.set(1.0)
+                if newly_open:
+                    inst.breaker_trips.inc()
+                    # flight escalation: the trip event lands in the
+                    # ring, then the whole ring dumps — post-mortems
+                    # see the requests leading up to the trip
+                    _obs.record_event('breaker_open', step=seq,
+                                      error=self._last_error)
+                    _obs.flight_dump(reason='breaker')
+                else:
+                    _obs.record_event('serve_fallback', step=seq,
+                                      error=self._last_error)
+        except Exception:
+            pass
+
+    def _instrument_fallback(self):
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.serving_instruments().fallbacks.inc()
+        except Exception:
+            pass
+
+    def _instrument_ok(self):
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.serving_instruments().degraded.set(0.0)
+        except Exception:
+            pass
+
+    # -- introspection / lifecycle -----------------------------------------
+
+    def status(self):
+        """Machine-readable session state (the /status JSON)."""
+        with self._lock:
+            degraded = self._degraded
+            record = {
+                'status': 'degraded' if degraded else 'ok',
+                'name': self.name,
+                'breaker': self._breaker.state,
+                'error': self._last_error,
+                'batches': {'accel': self._accel_batches,
+                            'fallback': self._fallback_batches},
+            }
+        record['buckets'] = list(self.frozen.policy.buckets)
+        record['compiled'] = self.frozen.compile_count
+        record['queue'] = self._batcher.stats()
+        return record
+
+    def close(self, drain=True):
+        self._batcher.close(drain=drain)
+        if self._watchdog is not None:
+            self._watchdog.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ServingHTTPServer:
+    """Stdlib JSON endpoint over an :class:`InferenceSession`.
+
+    Routes::
+
+        GET  /status   session status JSON
+        GET  /healthz  {"ok": true|false, "status": ...}
+        POST /predict  {"data": [...]}            one example
+                       {"instances": [[...], ...]} many examples
+
+    Binds 127.0.0.1 only; OFF by default — enable per-process with
+    ``MXNET_TPU_SERVE_HTTP_PORT=<port>`` + :func:`maybe_start_http_server`
+    or construct directly (port 0 picks a free port).
+    """
+
+    def __init__(self, session, port, host='127.0.0.1'):
+        self.session = session
+        self.host = host
+        self.port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+        session = self.session
+
+        class Handler(BaseHTTPRequestHandler):
+            def _json(handler, code, payload):
+                body = (json.dumps(payload, sort_keys=True)
+                        + '\n').encode()
+                handler.send_response(code)
+                handler.send_header('Content-Type', 'application/json')
+                handler.send_header('Content-Length', str(len(body)))
+                handler.end_headers()
+                handler.wfile.write(body)
+
+            def do_GET(handler):
+                path = handler.path.rstrip('/')
+                if path == '/status':
+                    handler._json(200, session.status())
+                elif path == '/healthz':
+                    st = session.status()
+                    handler._json(200, {'ok': st['status'] == 'ok',
+                                        'status': st['status']})
+                else:
+                    handler.send_error(404)
+
+            def do_POST(handler):
+                if handler.path.rstrip('/') != '/predict':
+                    handler.send_error(404)
+                    return
+                try:
+                    length = int(handler.headers.get('Content-Length',
+                                                     0))
+                    req = json.loads(handler.rfile.read(length)
+                                     or b'{}')
+                except ValueError:
+                    handler._json(400, {'error': 'bad JSON'})
+                    return
+                from concurrent.futures import TimeoutError as \
+                    _FutWaitTimeout
+                wait_s = session._batcher.timeout_s or _HTTP_MAX_WAIT_S
+                try:
+                    if 'instances' in req:
+                        futs = [session.submit(onp.asarray(x))
+                                for x in req['instances']]
+                        outs = [[o.tolist() for o in f.result(wait_s)]
+                                for f in futs]
+                        handler._json(200, {'outputs': outs})
+                    elif 'data' in req:
+                        outs = session.infer(onp.asarray(req['data']),
+                                             timeout=wait_s)
+                        handler._json(200, {'outputs':
+                                            [o.tolist() for o in outs]})
+                    else:
+                        handler._json(400,
+                                      {'error': "need 'data' or "
+                                                "'instances'"})
+                except BackpressureError as exc:
+                    handler._json(429, {'error': str(exc),
+                                        'depth': exc.depth,
+                                        'limit': exc.limit})
+                except (RequestTimeout, _FutWaitTimeout) as exc:
+                    handler._json(504, {'error': str(exc)
+                                        or 'request timed out'})
+                except BatcherClosed as exc:
+                    handler._json(503, {'error': str(exc)})
+                except ValueError as exc:
+                    # admission-time shape/arity validation
+                    handler._json(400, {'error': str(exc)})
+
+            def log_message(handler, *args):
+                pass        # no per-request stderr noise
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]    # resolve port 0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name='mxnet-tpu-serving-http')
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def maybe_start_http_server(session):
+    """Start the serving endpoint iff ``MXNET_TPU_SERVE_HTTP_PORT`` is
+    a nonzero port (same opt-in contract as the Prometheus exporter).
+    Returns the server or None."""
+    port = int(_knob('MXNET_TPU_SERVE_HTTP_PORT', 0) or 0)
+    if not port:
+        return None
+    return ServingHTTPServer(session, port).start()

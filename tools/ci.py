@@ -28,9 +28,17 @@ Stages, each timed:
                            fault tier above also asserts injected
                            stall/preempt runs dump parseable
                            mxnet_tpu.flight.v1 artifacts
-  4. C ABI audit           tools/capi_coverage.py == 207/207
-  5. copy-paste gate       tools/overlap_check.py --sweep 0.60
-  6. example smokes        3 representative workloads (LeNet both
+  4. serving               python -m mxnet_tpu.serving — inference-
+                           engine selftest (batched == single-request
+                           bit-identity, bounded recompiles, frozen
+                           reload without retracing, typed
+                           backpressure) plus bench_serving.py --quick
+                           (closed-loop bucket sweep artifact); the
+                           fault tier gates the serving hang /
+                           device-loss degraded paths
+  5. C ABI audit           tools/capi_coverage.py == 207/207
+  6. copy-paste gate       tools/overlap_check.py --sweep 0.60
+  7. example smokes        3 representative workloads (LeNet both
                            APIs, word-LM, plugin op)
 
 Exit code 0 = gate green. Run the FULL suite (~17 min:
@@ -80,6 +88,19 @@ def main(argv=None):
         ('observability', [py, '-m', 'mxnet_tpu.observability',
                            '--devices', '8',
                            '--out', '/tmp/OBS_SELFTEST.json']),
+        # inference-engine selftest (docs/SERVING.md): batched ==
+        # single-request bit-identity, recompile count bounded by the
+        # bucket ladder, frozen-artifact reload with zero retraces,
+        # typed backpressure, batcher flush/FIFO contract, HTTP
+        # endpoint. The fault tier above already gated the serving
+        # hang / device-loss degraded paths (fault_smoke checks 7-8).
+        ('serving', [py, '-m', 'mxnet_tpu.serving',
+                     '--out', '/tmp/SERVE_SELFTEST.json']),
+        # closed-loop latency/throughput sweep over the bucket ladder
+        # (writes the standard instrument status JSON; --quick keeps
+        # the gate fast)
+        ('bench-serving', [py, 'bench_serving.py', '--quick',
+                           '--out', '/tmp/BENCH_SERVING.json']),
         ('capi', [py, 'tools/capi_coverage.py', '--assert', '207']),
         ('overlap', [py, 'tools/overlap_check.py', '--sweep', '0.60']),
     ]

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Fault-injection CI tier (tools/ci.py stage 'fault-inject').
 
-Six checks:
+Eight checks:
   1. tests/test_resilience.py passes (policy math, checkpoint resume,
      worker restart — the deterministic fault suite).
   2. bench.py in forced-degraded mode: with
@@ -32,6 +32,18 @@ Checks 4 and 6 additionally assert the flight-recorder contract
 (docs/OBSERVABILITY.md): the injected preempt and hang escalations
 must each dump a parseable mxnet_tpu.flight.v1 JSONL artifact whose
 tail event matches the fault site (preempt_exit@9 / stall@3).
+
+  7. Serving hang (python -m mxnet_tpu.serving --serve-smoke,
+     docs/SERVING.md): with MXNET_TPU_FAULT=hang@serving.infer:3 the
+     inference engine's stall watchdog must write the
+     mxnet_tpu.stall.v1 artifact, the circuit breaker must open
+     after the threshold, and every request must still complete on
+     the CPU fallback with the verdict JSON reporting
+     status=degraded.
+  8. Serving device loss: with MXNET_TPU_FAULT=device_loss@serving:3
+     the breaker trip must dump the flight ring with tail event
+     breaker_open at the tripping batch, and the session keeps
+     serving degraded (all requests complete, zero mismatches).
 
 Usage: python tools/fault_smoke.py [--skip-tests]
 (--skip-tests runs only the subprocess contract checks; ci.py's fast
@@ -350,6 +362,100 @@ def run_watchdog_smoke():
         return True
 
 
+def _serve_smoke(fault, requests, out, stall, flight, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('MXNET_TPU_FAULT', None)
+    env['MXNET_TPU_FAULT'] = fault
+    return subprocess.run(
+        [sys.executable, '-m', 'mxnet_tpu.serving', '--serve-smoke',
+         '--requests', str(requests), '--out', out,
+         '--stall-artifact', stall, '--flight-artifact', flight],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def run_serving_hang():
+    """Check 7: injected hang@serving.infer -> stall artifact +
+    breaker open + every request served degraded."""
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, 'v.json')
+        stall = os.path.join(tmp, 'STALL.json')
+        flight = os.path.join(tmp, 'FLIGHT.jsonl')
+        r = _serve_smoke('hang@serving.infer:3', 8, out, stall, flight)
+        if r.returncode != 0:
+            print('FAIL: serving hang smoke exited %d\n%s\n%s'
+                  % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        v = json.load(open(out))
+        problems = []
+        if v.get('served') != v.get('requests'):
+            problems.append('only %r/%r requests served'
+                            % (v.get('served'), v.get('requests')))
+        if v.get('status') != 'degraded':
+            problems.append('status %r, want degraded'
+                            % v.get('status'))
+        if v.get('breaker') != 'open':
+            problems.append('breaker %r, want open' % v.get('breaker'))
+        if v.get('mismatches'):
+            problems.append('%d fallback outputs numerically wrong'
+                            % v['mismatches'])
+        if not os.path.exists(stall):
+            problems.append('no stall artifact written')
+        else:
+            art = json.load(open(stall))
+            if set(art) != _STALL_KEYS:
+                problems.append('stall artifact keys %s != %s'
+                                % (sorted(art), sorted(_STALL_KEYS)))
+            elif art['schema'] != 'mxnet_tpu.stall.v1':
+                problems.append('stall schema %r' % art['schema'])
+            elif art['phase'] != 'infer':
+                problems.append('stall phase %r, want infer'
+                                % art['phase'])
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        print('serving hang: stall artifact ok, breaker=open, '
+              '%d/%d requests served degraded'
+              % (v['served'], v['requests']))
+        return True
+
+
+def run_serving_device_loss():
+    """Check 8: injected device_loss@serving -> cpu-fallback serving
+    continues; the flight dump tail records the breaker trip."""
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, 'v.json')
+        stall = os.path.join(tmp, 'STALL.json')
+        flight = os.path.join(tmp, 'FLIGHT.jsonl')
+        r = _serve_smoke('device_loss@serving:3', 8, out, stall,
+                         flight)
+        if r.returncode != 0:
+            print('FAIL: serving device-loss smoke exited %d\n%s\n%s'
+                  % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        v = json.load(open(out))
+        problems = []
+        if v.get('served') != v.get('requests') or v.get('mismatches'):
+            problems.append('fallback serving broken: %r' % v)
+        if v.get('status') != 'degraded':
+            problems.append('status %r, want degraded'
+                            % v.get('status'))
+        if not v.get('fallback_batches'):
+            problems.append('no batches served on the CPU fallback')
+        # breaker opens at the 3rd consecutive failure = batch 2; the
+        # trip dumps the flight ring with the trip event as its tail
+        problems += _check_flight(flight, reason='breaker',
+                                  tail_kind='breaker_open',
+                                  tail_step=2)
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        print('serving device-loss: cpu-fallback served %d/%d, '
+              'flight tail=breaker_open@2' % (v['served'],
+                                              v['requests']))
+        return True
+
+
 def run_resilience_tests():
     r = subprocess.run(
         [sys.executable, '-m', 'pytest', 'tests/test_resilience.py',
@@ -368,6 +474,8 @@ def main(argv=None):
     ok = run_nan_guardrail() and ok
     ok = run_preempt_resume() and ok
     ok = run_watchdog_smoke() and ok
+    ok = run_serving_hang() and ok
+    ok = run_serving_device_loss() and ok
     print('fault_smoke: %s' % ('OK' if ok else 'FAIL'))
     return 0 if ok else 1
 

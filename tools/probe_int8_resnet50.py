@@ -40,6 +40,12 @@ def main():
     p.add_argument('--dtype', default='float32',
                    help='baseline dtype (float32 matches the reference '
                         'table; bfloat16 for the TPU-native baseline)')
+    p.add_argument('--serve', action='store_true',
+                   help='additionally serve the int8 model through the '
+                        'inference engine (serving.freeze + '
+                        'InferenceSession, docs/SERVING.md) and report '
+                        'engine img/s — the quantized path and the '
+                        'serving path are the same program')
     args = p.parse_args()
 
     import mxnet_tpu as mx
@@ -88,6 +94,22 @@ def main():
     print('speedup: %.2fx  (reference fp16 analog: 1233.15 -> 2355.04 '
           '= 1.91x at the same model/batch)' % (int8_ips / fp_ips),
           flush=True)
+
+    if args.serve:
+        # the int8 graph through the production serving path: frozen
+        # AOT program + bucketed engine, bulk batches of exactly B
+        from mxnet_tpu import serving
+        frozen = serving.freeze(
+            (qsym, dict(qargs), dict(qaux)),
+            data_shapes=[('data', (3, I, I))], buckets=(B,),
+            name='int8-resnet50')
+        with serving.InferenceSession(frozen, watchdog=False) as sess:
+            dt = slope_bench(lambda: sess.infer_batch([x_np]),
+                             lambda: None, max(2, args.iters // 4))
+            print('int8 via serving engine: %.2f ms / batch  '
+                  '%.1f img/s  (compiled programs: %d)'
+                  % (dt * 1e3, B / dt, frozen.compile_count),
+                  flush=True)
 
 
 if __name__ == '__main__':

@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Serving bench: closed-loop latency/throughput sweep over the
-bucket ladder (docs/SERVING.md; CI stage 'bench-serving').
+bucket ladder, plus the autoregressive generation sweep
+(docs/SERVING.md; CI stages 'bench-serving' and 'bench-decode').
 
-For every batch bucket the sweep drives the inference engine two
-ways:
+Default mode — one-shot inference, for every batch bucket:
 
   * closed-loop single requests through the micro-batcher (one
     in-flight request per client, ``--clients`` concurrent clients)
@@ -12,14 +12,28 @@ ways:
     (``InferenceSession.infer_batch``) — measures the compiled
     program's examples/s ceiling per bucket.
 
+``--decode`` mode — generation, a mixed-length workload (varying
+prompt lengths AND generation budgets) decoded two ways over the SAME
+frozen decode program:
+
+  * **continuous batching** (the decode engine): sequences join/leave
+    the slot register file at token granularity;
+  * **flush batching** (the baseline the engine replaces): groups of
+    ``slots`` sequences prefill together and the whole group holds
+    its slots until the LONGEST member finishes.
+
+Both report tokens/s, time-to-first-token p50/p99 and per-token
+latency p50/p99; the payload records the continuous/flush ratios and
+a per-request token-stream cross-check (same greedy model, so any
+mismatch is an engine bug, not noise).
+
 Writes the standard instrument status JSON (mxnet_tpu.instrument.v2:
 ``status`` ok|degraded|unavailable, rc 0 on outage — the
-BENCH_r05-proof contract every instrument in this repo honors) whose
-payload carries per-bucket latency percentiles, requests/s, the
-engine recompile count vs the ladder bound, and the telemetry summary
-block.
+BENCH_r05-proof contract every instrument in this repo honors) with
+the telemetry summary block.
 
-Usage: python bench_serving.py [--quick] [--out BENCH_SERVING.json]
+Usage: python bench_serving.py [--quick] [--decode]
+                               [--out BENCH_SERVING.json]
 """
 import argparse
 import sys
@@ -115,6 +129,192 @@ def bench_bucket(session, bucket, seconds, clients):
     }
 
 
+# ---------------------------------------------------------------------------
+# generation sweep (--decode): continuous vs flush batching
+# ---------------------------------------------------------------------------
+
+def _decode_workload(quick, slots):
+    """Deterministic mixed-length workload: prompts 2..16 tokens,
+    generation budgets drawn from a short/long mix — the shape where
+    continuous batching earns its keep."""
+    rs = np.random.RandomState(17)
+    n = 3 * slots if quick else 8 * slots
+    budgets = [4, 6, 8, 12, 16, 24]
+    return [(list(rs.randint(1, 48, rs.randint(2, 17))),
+             int(budgets[rs.randint(len(budgets))]))
+            for _ in range(n)]
+
+
+def _gen_stats(name, wall, ttfts, token_stamps):
+    """tokens/s + TTFT/per-token percentiles from per-request
+    timestamp traces."""
+    tpots = []
+    total = 0
+    for stamps in token_stamps:
+        total += len(stamps)
+        tpots.extend(b - a for a, b in zip(stamps, stamps[1:]))
+    ttfts = sorted(ttfts)
+    tpots.sort()
+    ms = lambda v: None if v is None else round(1e3 * v, 3)  # noqa: E731
+    return {
+        'mode': name,
+        'requests': len(ttfts),
+        'tokens': total,
+        'wall_s': round(wall, 3),
+        'tokens_per_sec': round(total / wall, 1) if wall else None,
+        'ttft_p50_ms': ms(_percentile(ttfts, 0.50)),
+        'ttft_p99_ms': ms(_percentile(ttfts, 0.99)),
+        'tpot_p50_ms': ms(_percentile(tpots, 0.50)),
+        'tpot_p99_ms': ms(_percentile(tpots, 0.99)),
+    }
+
+
+def _bench_continuous(prog, requests):
+    """All requests arrive at t0; the decode engine schedules joins
+    and retirements at token granularity."""
+    from mxnet_tpu import serving
+    session = serving.InferenceSession(prog, watchdog=False,
+                                       timeout_s=600.0)
+    ttfts = [None] * len(requests)
+    stamps = [None] * len(requests)
+    tokens = [None] * len(requests)
+
+    def consume(i, stream, t0):
+        mine = []
+        for _tok in stream:
+            mine.append(time.perf_counter())
+        ttfts[i] = mine[0] - t0 if mine else float('inf')
+        stamps[i] = mine
+        tokens[i] = list(stream.tokens)
+
+    try:
+        t0 = time.perf_counter()
+        streams = [session.generate(p, max_new_tokens=n)
+                   for p, n in requests]
+        threads = [threading.Thread(target=consume, args=(i, s, t0))
+                   for i, s in enumerate(streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        wall = time.perf_counter() - t0
+    finally:
+        session.close()
+    return _gen_stats('continuous', wall, ttfts, stamps), tokens
+
+
+def _bench_flush(prog, requests):
+    """Baseline: groups of ``slots`` prefill together and decode until
+    the whole group finishes — finished members' rows are wasted and
+    the next group waits (exactly what continuous batching removes)."""
+    slots = prog.slots
+    ttfts = [None] * len(requests)
+    stamps = [[] for _ in requests]
+    tokens = [None] * len(requests)
+    cache = prog.new_cache()
+    t0 = time.perf_counter()
+    for base in range(0, len(requests), slots):
+        group = requests[base:base + slots]
+        states = []
+        for i, (prompt, max_new) in enumerate(group):
+            cache, tok, _ = prog.run_prefill(cache, prompt, i)
+            now = time.perf_counter()
+            ttfts[base + i] = now - t0
+            stamps[base + i].append(now)
+            states.append({'toks': [tok], 'pos': len(prompt),
+                           'last': tok, 'max_new': max_new})
+        while True:
+            live = [i for i, s in enumerate(states)
+                    if len(s['toks']) < s['max_new']
+                    and s['pos'] + 1 < prog.max_len]
+            if not live:
+                break
+            tk = np.zeros(slots, 'int32')
+            ps = np.zeros(slots, 'int32')
+            for i, s in enumerate(states):
+                tk[i] = s['last']
+                ps[i] = s['pos']
+            cache, out, _ = prog.run_step(cache, tk, ps)
+            now = time.perf_counter()
+            for i in live:
+                s = states[i]
+                s['pos'] += 1
+                s['last'] = int(out[i])
+                s['toks'].append(s['last'])
+                stamps[base + i].append(now)
+        for i, s in enumerate(states):
+            tokens[base + i] = s['toks']
+    wall = time.perf_counter() - t0
+    return _gen_stats('flush', wall, ttfts, stamps), tokens
+
+
+def run_decode(status, args):
+    from mxnet_tpu.serving.decode import DecodeProgram, init_rnn_lm
+
+    slots = 4 if args.quick else 8
+    model, params = init_rnn_lm(vocab=48, embed=32, hidden=64,
+                                layers=1, mode='lstm', max_len=64,
+                                seed=9)
+    prog = DecodeProgram(model, params, slots=slots,
+                         prefill_buckets=(4, 8, 16))
+    prog.warmup()          # compile outside the timed windows
+    requests = _decode_workload(args.quick, slots)
+
+    flush_rec, flush_tokens = _bench_flush(prog, requests)
+    cont_rec, cont_tokens = _bench_continuous(prog, requests)
+    mismatches = sum(1 for a, b in zip(cont_tokens, flush_tokens)
+                     if a != b)
+    for rec in (flush_rec, cont_rec):
+        print('%-11s %7s tok/s  ttft p50/p99 %s/%s ms  '
+              'tpot p50/p99 %s/%s ms'
+              % (rec['mode'], rec['tokens_per_sec'],
+                 rec['ttft_p50_ms'], rec['ttft_p99_ms'],
+                 rec['tpot_p50_ms'], rec['tpot_p99_ms']), flush=True)
+
+    bound = len(prog.prefill_buckets) + 1
+    speedup = (cont_rec['tokens_per_sec']
+               / flush_rec['tokens_per_sec']) \
+        if flush_rec['tokens_per_sec'] else None
+    payload = {
+        'metrics': [{
+            'metric': 'decode_generation_sweep',
+            'unit': 'tokens/s',
+            'slots': slots,
+            'requests': len(requests),
+            'prefill_buckets': list(prog.prefill_buckets),
+            'continuous': cont_rec,
+            'flush': flush_rec,
+            'tokens_per_sec_ratio': round(speedup, 3)
+            if speedup else None,
+            'continuous_beats_flush': bool(
+                speedup and speedup > 1.0
+                and cont_rec['ttft_p99_ms'] < flush_rec['ttft_p99_ms']),
+            'token_stream_mismatches': mismatches,
+            'recompile_count': prog.compile_count,
+            'recompile_bound': bound,
+            'recompiles_bounded': prog.compile_count <= bound,
+        }],
+    }
+    try:
+        from mxnet_tpu import observability
+        payload['telemetry'] = observability.summary()
+    except Exception as e:
+        payload['telemetry'] = {'enabled': False,
+                                'error': '%s: %s'
+                                % (type(e).__name__, e)}
+    m = payload['metrics'][0]
+    if not m['recompiles_bounded']:
+        raise AssertionError(
+            '%d decode programs compiled; bound is prefill ladder + 1'
+            ' = %d' % (prog.compile_count, bound))
+    if mismatches:
+        raise AssertionError(
+            '%d/%d token streams differ between continuous and flush '
+            'decoding (same greedy model: engine bug)'
+            % (mismatches, len(requests)))
+    return payload
+
+
 def run(status, args):
     from mxnet_tpu import serving
 
@@ -172,13 +372,18 @@ def main():
     p.add_argument('--out', default='BENCH_SERVING.json')
     p.add_argument('--quick', action='store_true',
                    help='CI-sized sweep (small ladder, short windows)')
+    p.add_argument('--decode', action='store_true',
+                   help='generation sweep: continuous vs flush '
+                        'batching (tokens/s, TTFT, per-token latency)')
     p.add_argument('--clients', type=int, default=4)
     p.add_argument('--deadline-ms', type=float, default=2.0)
     args = p.parse_args()
 
     from mxnet_tpu.resilience import run_instrument
-    return run_instrument('bench_serving',
-                          lambda status: run(status, args),
+    fn = run_decode if args.decode else run
+    return run_instrument('bench_decode' if args.decode
+                          else 'bench_serving',
+                          lambda status: fn(status, args),
                           out=args.out)
 
 

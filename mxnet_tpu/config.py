@@ -229,9 +229,38 @@ KNOBS = {k.name: k for k in [
           ' fallback until the reset probe succeeds.'),
     _knob('MXNET_TPU_SERVE_HTTP_PORT', int, 0,
           'Port for the stdlib JSON inference endpoint'
-          ' (/predict, /status, /healthz; binds 127.0.0.1). 0'
-          ' (default) keeps the server off — production fronts the'
-          ' engine with a real gateway.'),
+          ' (/predict, /generate, /status, /healthz; binds'
+          ' 127.0.0.1). 0 (default) keeps the server off —'
+          ' production fronts the engine with a real gateway.'),
+    # autoregressive decode engine (docs/SERVING.md "Autoregressive
+    # decoding")
+    _knob('MXNET_TPU_SERVE_DECODE_SLOTS', int, 8,
+          'In-flight sequence slots in the continuous decode batch —'
+          ' the decode-step program\'s ONE compiled batch shape.'
+          ' Sequences join/leave slots at token granularity; the'
+          ' preallocated KV/state cache is slots x max_len.'),
+    _knob('MXNET_TPU_SERVE_MAX_SEQ_LEN', int, 256,
+          'Per-slot cache capacity: prompt + generated tokens per'
+          ' sequence never exceed this (the KV cache length baked'
+          ' into the decode programs at freeze time).'),
+    _knob('MXNET_TPU_SERVE_PREFILL_BUCKETS', str, None,
+          'Explicit prompt-length bucket ladder for prefill programs'
+          ' as a comma list (e.g. "8,32,128"); unset derives powers'
+          ' of two up to MXNET_TPU_SERVE_MAX_PREFILL. Total compiled'
+          ' programs for any generation workload = ladder size + 1'
+          ' (the single decode step).'),
+    _knob('MXNET_TPU_SERVE_MAX_PREFILL', int, 64,
+          'Default top of the prefill ladder: the longest admissible'
+          ' prompt. Longer prompts reject typed at admission instead'
+          ' of compiling new shapes.'),
+    _knob('MXNET_TPU_SERVE_MAX_NEW_TOKENS', int, 64,
+          'Default generation budget per request when the caller'
+          ' does not pass max_new_tokens.'),
+    _knob('MXNET_TPU_SERVE_PREFILL_INTERLEAVE', int, 1,
+          'Prompt prefills admitted between consecutive decode steps'
+          ' while sequences are in flight: raises join throughput at'
+          ' the cost of decode-step latency jitter. An idle engine'
+          ' always admits up to every free slot.'),
     # preemption / elasticity / watchdog (docs/RESILIENCE.md)
     _knob('MXNET_TPU_PREEMPT_EXIT_CODE', int, 75,
           'Process exit code marking a preempted-but-resumable run'

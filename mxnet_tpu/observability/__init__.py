@@ -197,6 +197,27 @@ def serving_instruments():
             degraded=gauge('mxnet_tpu_serve_degraded',
                            help='1 while the session serves degraded '
                                 '(breaker open / fallback active)'),
+            # autoregressive decode engine (serving/decode/)
+            tokens=counter('mxnet_tpu_serve_tokens_total',
+                           help='tokens generated (prefill first '
+                                'tokens + decode steps + degraded '
+                                'fallback tokens)'),
+            prefills=counter('mxnet_tpu_serve_prefills_total',
+                             help='prompt prefills landed in cache '
+                                  'slots (sequence joins)'),
+            decode_steps=counter(
+                'mxnet_tpu_serve_decode_steps_total',
+                help='fixed-shape decode steps (each advances every '
+                     'live slot one token)'),
+            ttft=histogram('mxnet_tpu_serve_ttft_seconds',
+                           help='time to first token: submit to the '
+                                'prefill-produced token'),
+            tpot=histogram('mxnet_tpu_serve_tpot_seconds',
+                           help='per-decode-step latency (time per '
+                                'output token across the batch)'),
+            active_slots=gauge('mxnet_tpu_serve_active_slots',
+                               help='in-flight sequences in the '
+                                    'continuous decode batch'),
         )
     return _serving_inst
 

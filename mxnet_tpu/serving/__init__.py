@@ -52,26 +52,22 @@ __all__ = [
     'unpad_axis0', 'BackpressureError', 'BatcherClosed', 'MicroBatcher',
     'RequestTimeout', 'FROZEN_SCHEMA', 'FrozenProgram', 'freeze',
     'load_frozen', 'InferenceSession', 'ServingHTTPServer',
-    'maybe_start_http_server',
+    'maybe_start_http_server', 'decode', 'DecodeProgram',
+    'DecodeEngine', 'GenerateStream', 'freeze_decode', 'load_decode',
 ]
 
-# jax-importing halves load lazily through __getattr__ so the
-# bucket/batcher math (and their tests) stay usable without a backend,
-# the same import-light discipline as resilience/observability.
-_LAZY = {
-    'FROZEN_SCHEMA': 'freeze', 'FrozenProgram': 'freeze',
-    'freeze': 'freeze', 'load_frozen': 'freeze',
-    'InferenceSession': 'server', 'ServingHTTPServer': 'server',
-    'maybe_start_http_server': 'server',
-}
-
-
-def __getattr__(name):
-    mod = _LAZY.get(name)
-    if mod is None:
-        raise AttributeError('module %r has no attribute %r'
-                             % (__name__, name))
-    from importlib import import_module
-    value = getattr(import_module('.' + mod, __name__), name)
-    globals()[name] = value
-    return value
+# No serving module imports jax at module top (device work happens
+# inside methods), so the whole surface imports eagerly — and in an
+# order that keeps ``serving.freeze`` bound to the FUNCTION: loading
+# the ``freeze`` submodule binds the module object onto this package
+# (import-system parent binding), so the ``from .freeze import
+# freeze`` rebind must come after every import that pulls the
+# submodule in, and first-load ordering here makes that stable for
+# every later importer.
+from . import decode
+from .decode import (DecodeEngine, DecodeProgram, GenerateStream,
+                     freeze_decode, load_decode)
+from .server import (InferenceSession, ServingHTTPServer,
+                     maybe_start_http_server)
+from .freeze import FROZEN_SCHEMA, FrozenProgram, load_frozen
+from .freeze import freeze

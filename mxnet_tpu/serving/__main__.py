@@ -24,13 +24,35 @@ CPU-runnable proof of the inference-engine contract
   6. http           — the JSON endpoint is OFF by default and serves
                       /predict, /status, /healthz when constructed.
 
+Autoregressive-decode legs (docs/SERVING.md "Autoregressive
+decoding"):
+
+  7. decode_bit_identity — N tokens generated through the in-jit
+                      cache (prefill + decode-step programs) equal
+                      the tokens from slicing an uncached
+                      whole-sequence forward after every token, and
+                      the CPU-fallback path emits the same stream.
+  8. decode_reload  — a saved decode artifact (prefill ladder + the
+                      single step program) reloads in a FRESH process
+                      and generates with ZERO retraces and identical
+                      tokens.
+  9. decode_continuous — continuous-batching contract: concurrent
+                      mixed-length generations each match their solo
+                      baseline (join/leave never perturbs a
+                      neighbor), EOS retires early, FIFO admission
+                      holds, and total compiled programs stay <=
+                      prefill ladder + 1.
+
 ``--serve-smoke`` is the fault-injection mode tools/fault_smoke.py
 drives (legs 7-8 of the CI fault tier): with
 ``MXNET_TPU_FAULT=hang@serving.infer:3`` the stall watchdog writes
 its artifact, the circuit breaker opens, and requests keep completing
 on the CPU fallback (status=degraded); with
 ``device_loss@serving:3`` the breaker trip dumps the flight ring
-(tail event ``breaker_open``).
+(tail event ``breaker_open``). ``--decode-smoke`` is the decode
+analog (fault_smoke check 9): ``hang@serving.decode:3`` must write
+the stall artifact, trip the breaker, and every in-flight sequence
+must complete degraded on the CPU fallback with the same tokens.
 
 Usage:
   JAX_PLATFORMS=cpu python -m mxnet_tpu.serving --out SERVE_SELFTEST.json
@@ -292,6 +314,193 @@ def check_http():
     return None
 
 
+def _toy_decoder(slots=3, prefill_buckets=(4, 8)):
+    """Deterministic tiny LSTM LM decode program."""
+    from .decode import DecodeProgram, init_rnn_lm
+    model, params = init_rnn_lm(vocab=23, embed=8, hidden=16, layers=1,
+                                mode='lstm', max_len=32, seed=5)
+    return DecodeProgram(model, params, slots=slots,
+                         prefill_buckets=prefill_buckets,
+                         name='selftest-lm')
+
+
+def _reference_tokens(prog, prompt, n):
+    """Greedy tokens via the UNCACHED whole-sequence forward, resliced
+    after every token."""
+    import jax.numpy as jnp
+    params = {k: jnp.asarray(v) for k, v in prog._params_np.items()}
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        full = prog.model.full_forward(params,
+                                       jnp.asarray([toks], 'int32'))
+        t = int(onp.asarray(full)[0, -1].argmax())
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def check_decode_bit_identity():
+    from .server import InferenceSession
+    prog = _toy_decoder()
+    prompt = [3, 1, 4, 1, 5]
+    ref = _reference_tokens(prog, prompt, 6)
+    with InferenceSession(prog, watchdog=False) as sess:
+        got = sess.generate(prompt, max_new_tokens=6).result(60)
+    if got != ref:
+        return ('cached decode %r != whole-sequence forward slice %r'
+                % (got, ref))
+    fb = prog.fallback_generate(prompt, 6)
+    if fb != ref:
+        return 'CPU fallback stream %r != reference %r' % (fb, ref)
+    return None
+
+
+def check_decode_reload(tmp):
+    prog = _toy_decoder().warmup()
+    prompt = [5, 3, 1]
+    with open(os.path.join(tmp, 'decode_io.json'), 'w') as f:
+        json.dump({'prompt': prompt,
+                   'expected': _reference_tokens(prog, prompt, 5)}, f)
+    art = os.path.join(tmp, 'decoder.frozen')
+    prog.save(art)
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, '-m', 'mxnet_tpu.serving',
+         '--decode-reload-check', tmp], env=env, capture_output=True,
+        text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    if r.returncode != 0:
+        return ('decode reload subprocess exited %d\nstdout:%s\n'
+                'stderr:%s' % (r.returncode, r.stdout[-1500:],
+                               r.stderr[-1500:]))
+    verdict = json.load(open(os.path.join(tmp, 'decode_reload.json')))
+    if not verdict.get('identical'):
+        return 'reloaded decoder generated different tokens'
+    if verdict.get('traces'):
+        return ('reloaded decoder retraced: %r (programs did not '
+                'deserialize)' % verdict['traces'])
+    if verdict.get('retraced_buckets'):
+        return ('decode programs fell back to re-jit: %r'
+                % verdict['retraced_buckets'])
+    return None
+
+
+def run_decode_reload_check(tmp):
+    """Fresh-process half of the decode_reload leg."""
+    from .server import InferenceSession
+    from .freeze import load_frozen
+    prog = load_frozen(os.path.join(tmp, 'decoder.frozen'))
+    io = json.load(open(os.path.join(tmp, 'decode_io.json')))
+    with InferenceSession(prog, watchdog=False) as sess:
+        got = sess.generate(io['prompt'],
+                            max_new_tokens=len(io['expected'])) \
+            .result(60)
+    verdict = {
+        'identical': got == io['expected'],
+        'traces': dict(prog.trace_counts),
+        'retraced_buckets': list(prog.retraced_buckets),
+        'compiled': prog.compile_count,
+    }
+    with open(os.path.join(tmp, 'decode_reload.json'), 'w') as f:
+        json.dump(verdict, f, indent=1, sort_keys=True)
+    print('decode-reload-check: identical=%s traces=%r'
+          % (verdict['identical'], verdict['traces']), flush=True)
+    return 0 if verdict['identical'] and not verdict['traces'] else 1
+
+
+def check_decode_continuous():
+    """Continuous-batching contract on the real model: solo == joined
+    streams, EOS retirement, bounded compiles."""
+    from .server import InferenceSession
+    prog = _toy_decoder(slots=2)        # fewer slots than requests
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8], [2, 2], [7, 1]]
+    lens = [5, 3, 6, 2, 4]
+    with InferenceSession(prog, watchdog=False) as sess:
+        solo = [sess.generate(p, max_new_tokens=n).result(60)
+                for p, n in zip(prompts, lens)]
+        streams = [sess.generate(p, max_new_tokens=n)
+                   for p, n in zip(prompts, lens)]
+        joined = [s.result(60) for s in streams]
+        if joined != solo:
+            bad = [i for i in range(len(solo))
+                   if joined[i] != solo[i]]
+            return ('join/leave perturbed sequences %r '
+                    '(continuous != solo)' % bad)
+        # EOS retirement: replay the first stream with its 2nd token
+        # as the stop symbol — generation must truncate at that
+        # token's FIRST occurrence
+        eos = solo[0][1]
+        want = solo[0][:solo[0].index(eos) + 1]
+        s = sess.generate(prompts[0], max_new_tokens=50, eos_id=eos)
+        got = s.result(60)
+        if got != want or s.finish_reason != 'eos':
+            return ('EOS retirement broken: %r (reason %r), want %r'
+                    % (got, s.finish_reason, want))
+        counts = sess.status()['decode']['counts']
+    if counts['retired'].get('eos', 0) < 1:
+        return 'no eos retirement recorded: %r' % (counts['retired'],)
+    bound = len(prog.prefill_buckets) + 1
+    if prog.compile_count > bound:
+        return ('%d programs compiled; decode bound is prefill ladder'
+                ' + 1 = %d' % (prog.compile_count, bound))
+    retraced = {k: v for k, v in prog.trace_counts.items() if v > 1}
+    if retraced:
+        return 'programs retraced after warmup: %r' % retraced
+    return None
+
+
+def run_decode_smoke(args):
+    """Decode fault-injection mode (tools/fault_smoke.py check 9)."""
+    from mxnet_tpu import observability
+    from .server import InferenceSession
+    observability.configure_flight(path=args.flight_artifact,
+                                   name='decode-smoke')
+    prog = _toy_decoder(slots=2, prefill_buckets=(8,))
+    prompt = [3, 1, 4, 1, 5]
+    ref = prog.fallback_generate(prompt, 6)
+    served = 0
+    mismatches = 0
+    degraded_streams = 0
+    with InferenceSession(prog, timeout_s=120.0,
+                          stall_artifact=args.stall_artifact) as sess:
+        streams = [sess.generate(prompt, max_new_tokens=6)
+                   for _ in range(args.requests)]
+        for s in streams:
+            try:
+                toks = s.result(240)
+                served += 1
+            except Exception:
+                continue
+            if toks != ref:
+                mismatches += 1
+            if s.degraded:
+                degraded_streams += 1
+        status = sess.status()
+    verdict = {
+        'requests': args.requests,
+        'served': served,
+        'mismatches': mismatches,
+        'degraded_streams': degraded_streams,
+        'status': status['status'],
+        'breaker': status['breaker'],
+        'fallback_tokens':
+            status['decode']['counts']['fallback_tokens'],
+        'stall_artifact': args.stall_artifact
+        if os.path.exists(args.stall_artifact) else None,
+    }
+    from ..resilience.checkpoint import atomic_write_bytes
+    atomic_write_bytes(args.out, (json.dumps(
+        verdict, indent=1, sort_keys=True) + '\n').encode())
+    print('decode-smoke: served %d/%d status=%s breaker=%s '
+          'degraded_streams=%d -> %s'
+          % (served, args.requests, verdict['status'],
+             verdict['breaker'], degraded_streams, args.out),
+          flush=True)
+    return 0 if served == args.requests and mismatches == 0 else 1
+
+
 def run_serve_smoke(args):
     """Fault-injection mode (tools/fault_smoke.py legs 7-8)."""
     from mxnet_tpu import observability
@@ -343,8 +552,14 @@ def main(argv=None):
     p.add_argument('--reload-check', default=None, metavar='DIR',
                    help='internal: fresh-process half of the '
                         'frozen_reload leg')
+    p.add_argument('--decode-reload-check', default=None, metavar='DIR',
+                   help='internal: fresh-process half of the '
+                        'decode_reload leg')
     p.add_argument('--serve-smoke', action='store_true',
                    help='fault-injection mode (fault_smoke legs 7-8)')
+    p.add_argument('--decode-smoke', action='store_true',
+                   help='decode fault-injection mode (fault_smoke '
+                        'check 9)')
     p.add_argument('--requests', type=int, default=8)
     p.add_argument('--stall-artifact', default='STALL.json')
     p.add_argument('--flight-artifact', default='FLIGHT.jsonl')
@@ -352,8 +567,12 @@ def main(argv=None):
 
     if args.reload_check:
         return run_reload_check(args.reload_check)
+    if args.decode_reload_check:
+        return run_decode_reload_check(args.decode_reload_check)
     if args.serve_smoke:
         return run_serve_smoke(args)
+    if args.decode_smoke:
+        return run_decode_smoke(args)
 
     checks = {}
     with tempfile.TemporaryDirectory() as tmp:
@@ -362,7 +581,10 @@ def main(argv=None):
                 ('frozen_reload', lambda: check_frozen_reload(tmp)),
                 ('backpressure', check_backpressure),
                 ('batcher', check_batcher_contract),
-                ('http', check_http)]
+                ('http', check_http),
+                ('decode_bit_identity', check_decode_bit_identity),
+                ('decode_reload', lambda: check_decode_reload(tmp)),
+                ('decode_continuous', check_decode_continuous)]
         for name, fn in legs:
             try:
                 problem = fn()

@@ -304,6 +304,18 @@ class MicroBatcher:
             self._run_batch(batch, cause)
 
     def _run_batch(self, batch, cause):
+        # flush-time expiry: the reaper may have timed out (or a
+        # client cancelled) requests between the pop in _take_batch
+        # and this flush — computing their rows would waste device
+        # batch slots on futures nobody can read, so run the expire
+        # scan once more and drop every already-done request before
+        # stacking. The live subset keeps its FIFO row mapping.
+        with self._lock:
+            self._expire_queued_locked(self._clock())
+            batch = [req for req in batch if not req.future.done()]
+            self._inflight = batch
+        if not batch:
+            return
         n = len(batch)
         arity = len(batch[0].arrays)
         t0 = self._clock()

@@ -1,0 +1,144 @@
+"""Preallocated decode caches: slot-addressed state with O(1) updates.
+
+Autoregressive decoding is a state problem before it is a compute
+problem: every in-flight sequence carries per-layer recurrent state
+(RNNs) or per-position key/value history (attention), and the decode
+step must update that state *in place inside the compiled program* —
+a functional cache that reallocates per token would retrace, recopy,
+and destroy the O(1)-per-token contract (PAPERS: "Compiler-First
+State Space Duality and Portable O(1) Autoregressive Caching for
+Inference").
+
+The layout here is slot-addressed: every cache array's leading axis
+is the *slot* axis — a fixed ``slots``-sized register file of
+in-flight sequences, so the decode-step program is one fixed shape
+forever (continuous batching swaps sequences in and out of slots
+without ever changing a compiled shape). Updates are
+``lax.dynamic_update_slice`` at a traced slot/position index — XLA
+lowers the donated-buffer update to an in-place scatter, O(updated
+elements) not O(cache):
+
+  * :func:`write_slot`      — replace one slot's whole state (prefill
+                              landing its computed state/KV prefix);
+  * :func:`write_position`  — write one (slot, position) KV row per
+                              slot, positions differing per slot
+                              (vmapped dynamic_update_slice — the
+                              decode-step KV append);
+  * :func:`init_cache`      — the preallocated zeros pytree from a
+                              :class:`CacheSpec`.
+
+Shape/dtype math stays importable without jax (CacheSpec is pure
+metadata); the update helpers import jax lazily, the same discipline
+as freeze.py.
+"""
+from __future__ import annotations
+
+__all__ = ['CacheSpec', 'init_cache', 'write_slot', 'write_position',
+           'cache_avals', 'cache_bytes']
+
+
+class CacheSpec:
+    """Metadata for one decode cache: ``{name: (per_slot_shape,
+    dtype)}`` — the full array for ``slots`` in-flight sequences is
+    ``(slots,) + per_slot_shape``.
+
+    Per-slot shapes are fixed at freeze time (``max_len`` baked in for
+    KV caches), so every decode step runs one compiled shape and the
+    cache footprint is a static, inspectable number
+    (:func:`cache_bytes`).
+    """
+
+    __slots__ = ('entries',)
+
+    def __init__(self, entries):
+        self.entries = {str(k): (tuple(int(d) for d in shape), str(dt))
+                        for k, (shape, dt) in dict(entries).items()}
+
+    def items(self):
+        return self.entries.items()
+
+    def full_shape(self, name, slots):
+        shape, _ = self.entries[name]
+        return (int(slots),) + shape
+
+    def to_json(self):
+        return {k: [list(s), dt] for k, (s, dt) in self.entries.items()}
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls({k: (tuple(s), dt) for k, (s, dt) in obj.items()})
+
+    def __repr__(self):
+        return 'CacheSpec(%r)' % (self.entries,)
+
+
+def cache_bytes(spec, slots):
+    """Static cache footprint in bytes for ``slots`` sequences."""
+    import numpy as onp
+    total = 0
+    for name, (shape, dt) in spec.items():
+        n = int(slots)
+        for d in shape:
+            n *= d
+        total += n * onp.dtype(dt).itemsize
+    return total
+
+
+def init_cache(spec, slots):
+    """Preallocated zeros pytree ``{name: (slots, *per_slot_shape)}``.
+
+    Zeros (not empty) on purpose: stale-slot garbage must stay finite
+    so masked-out attention rows multiply to exact 0.0 instead of
+    propagating NaNs from uninitialized memory.
+    """
+    import jax.numpy as jnp
+    return {name: jnp.zeros(spec.full_shape(name, slots), dt)
+            for name, (_, dt) in spec.items()}
+
+
+def cache_avals(spec, slots):
+    """ShapeDtypeStructs for AOT lowering (freeze.py idiom)."""
+    import jax
+    return {name: jax.ShapeDtypeStruct(spec.full_shape(name, slots), dt)
+            for name, (_, dt) in spec.items()}
+
+
+def write_slot(cache_arr, slot_state, slot):
+    """Replace slot ``slot``'s whole per-slot state — the prefill
+    landing: ``cache_arr`` (S, ...), ``slot_state`` (1, ...) or (...),
+    ``slot`` a traced scalar. One dynamic_update_slice; O(slot state),
+    independent of the other S-1 slots."""
+    import jax.numpy as jnp
+    from jax import lax
+    if slot_state.ndim == cache_arr.ndim - 1:
+        slot_state = slot_state[None]
+    start = (slot,) + (0,) * (cache_arr.ndim - 1)
+    return lax.dynamic_update_slice(
+        cache_arr, slot_state.astype(cache_arr.dtype),
+        tuple(jnp.asarray(i, 'int32') for i in start))
+
+
+def write_position(cache_arr, rows, positions):
+    """Append one row per slot at that slot's own position — the
+    decode-step KV update.
+
+    ``cache_arr`` (S, L, ...): per-slot length-L history;
+    ``rows`` (S, ...): this step's row per slot;
+    ``positions`` (S,): each slot's write index (they differ — that is
+    the whole point of continuous batching).
+
+    vmap over the slot axis turns the per-slot
+    ``lax.dynamic_update_slice`` into one batched in-place scatter —
+    O(slots × row), never O(slots × L).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def one(slot_hist, row, pos):
+        start = (pos,) + (0,) * (slot_hist.ndim - 1)
+        return lax.dynamic_update_slice(
+            slot_hist, row[None].astype(slot_hist.dtype),
+            tuple(jnp.asarray(i, 'int32') for i in start))
+
+    return jax.vmap(one)(cache_arr, rows, positions)

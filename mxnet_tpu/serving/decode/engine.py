@@ -1,0 +1,664 @@
+"""Continuous batching: sequences join and leave the in-flight decode
+batch at token granularity.
+
+Flush batching (batcher.py) is the wrong shape for generation: one
+short request stuck in a batch of long ones holds its slot until the
+LONGEST member finishes, and a new arrival waits for the whole batch
+to drain — time-to-first-token inflates with someone else's
+generation length. The decode engine instead schedules a fixed
+register file of ``slots`` sequences (the decode program's one
+compiled shape):
+
+  * a finished sequence (EOS / max-new / max_len / timeout / cancel)
+    retires its slot at the very next token boundary;
+  * a pending request is admitted into any free slot by running ONE
+    bucketed prefill, interleaved between decode steps
+    (``prefill_interleave`` per step keeps decode latency bounded
+    while arrivals land);
+  * every decode step advances ALL live slots one token — batch
+    occupancy tracks load continuously instead of sawtoothing.
+
+Admission control, typed errors, and resilience carry over from the
+one-shot path: bounded pending queue -> :class:`BackpressureError`,
+per-request budget enforced by a reaper independent of a wedged
+worker -> :class:`RequestTimeout`, every device call under the
+circuit breaker + stall watchdog (fault-injection site
+``serving.decode``), and a breaker trip completes every in-flight
+sequence DEGRADED on the CPU fallback (same math, same tokens) rather
+than erroring mid-stream.
+
+The scheduler is pure queue/slot math over a duck-typed program
+(``slots``, ``new_cache``, ``run_prefill``, ``run_step``,
+``fallback_generate``) — numpy + stdlib only, testable with a fake
+program and a fake clock, the same discipline as batcher.py.
+"""
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+
+import numpy as onp
+
+from ..batcher import BackpressureError, BatcherClosed, RequestTimeout
+
+__all__ = ['GenerateStream', 'DecodeEngine']
+
+_DONE = object()          # stream sentinel
+
+
+def _serving_instruments():
+    try:
+        from ... import observability as _obs
+        if _obs.enabled():
+            return _obs.serving_instruments()
+    except Exception:
+        pass
+    return None
+
+
+def _record_event(kind, **fields):
+    try:
+        from ... import observability as _obs
+        if _obs.enabled():
+            _obs.record_event(kind, **fields)
+    except Exception:
+        pass
+
+
+def _flight_dump(reason):
+    try:
+        from ... import observability as _obs
+        if _obs.enabled():
+            _obs.flight_dump(reason=reason)
+    except Exception:
+        pass
+
+
+class GenerateStream:
+    """Per-request handle: iterate tokens as they decode, or block for
+    the full sequence.
+
+        for tok in session.generate(prompt, max_new_tokens=32):
+            ...                       # per-token streaming
+        toks = stream.result(timeout) # or: the whole generation
+
+    Iteration ends at EOS/max-new; a failed request raises its typed
+    error (RequestTimeout, BatcherClosed, ...) from the iterator and
+    from :meth:`result` alike. ``degraded`` flips when any part of the
+    generation ran on the CPU fallback."""
+
+    def __init__(self, prompt_len):
+        self.prompt_len = int(prompt_len)
+        self.tokens = []
+        self.finish_reason = None       # eos | length | error | closed
+        self.degraded = False
+        self._q = _queue.Queue()
+        self._done = threading.Event()
+        self._exc = None
+        self._cancelled = False
+
+    # -- consumer side -----------------------------------------------------
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                if self._exc is not None:
+                    raise self._exc
+                return
+            yield item
+
+    def result(self, timeout=None):
+        """Block until the generation finishes; returns the full token
+        list or raises the request's typed error."""
+        if not self._done.wait(timeout):
+            raise RequestTimeout(
+                'generation not finished within %r s' % (timeout,))
+        if self._exc is not None:
+            raise self._exc
+        return list(self.tokens)
+
+    def cancel(self):
+        """Ask the engine to retire this sequence at the next token
+        boundary (its slot frees; already-streamed tokens remain)."""
+        self._cancelled = True
+
+    def done(self):
+        return self._done.is_set()
+
+    def exception(self):
+        return self._exc
+
+    # -- engine side -------------------------------------------------------
+
+    def _emit(self, token):
+        self.tokens.append(int(token))
+        self._q.put(int(token))
+
+    def _finish(self, reason, exc=None):
+        if self._done.is_set():
+            return
+        self.finish_reason = reason
+        self._exc = exc
+        self._done.set()
+        self._q.put(_DONE)
+
+
+class _Seq:
+    """One admitted request's scheduling state."""
+
+    __slots__ = ('stream', 'prompt', 'max_new', 'eos_id', 'slot',
+                 'pos', 'last_token', 'enqueued_at', 'deadline_at',
+                 'first_token_at')
+
+    def __init__(self, stream, prompt, max_new, eos_id, enqueued_at,
+                 deadline_at):
+        self.stream = stream
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.slot = None
+        self.pos = None            # next cache write position
+        self.last_token = None
+        self.enqueued_at = enqueued_at
+        self.deadline_at = deadline_at
+        self.first_token_at = None
+
+
+class _DegradedPath(Exception):
+    """Internal: the device call failed transiently / breaker open —
+    finish the work on the CPU fallback."""
+
+
+class DecodeEngine:
+    """Continuous-batching scheduler over a decode program.
+
+    ``program`` duck-type: ``slots``, ``max_len``,
+    ``max_prompt_len()``, ``new_cache()``,
+    ``run_prefill(cache, tokens, slot) -> (cache, tok, logits)``,
+    ``run_step(cache, tokens, positions) -> (cache, toks, logits)``,
+    ``fallback_generate(tokens, max_new, eos_id) -> [tok]``.
+    """
+
+    def __init__(self, program, max_queue=256, timeout_s=30.0,
+                 max_new_tokens=64, breaker=None, watchdog=None,
+                 prefill_interleave=1, name='decode',
+                 clock=time.monotonic):
+        from ...resilience.policy import CircuitBreaker
+        self.program = program
+        self.slots = int(program.slots)
+        self.max_queue = int(max_queue)
+        self.timeout_s = float(timeout_s) if timeout_s else None
+        self.default_max_new = int(max_new_tokens)
+        self.prefill_interleave = max(1, int(prefill_interleave))
+        self.name = name
+        self._clock = clock
+        self._breaker = breaker if breaker is not None else \
+            CircuitBreaker(failure_threshold=3, reset_timeout=30.0)
+        self._watchdog = watchdog
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._pending = []                 # FIFO of _Seq
+        self._active = {}                  # slot -> _Seq
+        self._free = list(range(self.slots))
+        self._cache = None                 # built lazily on the worker
+        self._closed = False
+        self._degraded = False
+        self._last_error = None
+        self._op_seq = 0
+        self._counts = {'requests': 0, 'rejected': 0, 'tokens': 0,
+                        'prefills': 0, 'steps': 0, 'timeouts': 0,
+                        'fallback_tokens': 0, 'retired': {}}
+        self._worker = threading.Thread(
+            target=self._run, daemon=True,
+            name='mxnet-tpu-%s-decode' % name)
+        self._worker.start()
+        self._reaper = None
+        if self.timeout_s:
+            self._reaper = threading.Thread(
+                target=self._reap_loop, daemon=True,
+                name='mxnet-tpu-%s-decode-reaper' % name)
+            self._reaper.start()
+
+    # -- submission --------------------------------------------------------
+
+    def generate(self, tokens, max_new_tokens=None, eos_id=None):
+        """Admit one prompt; returns its :class:`GenerateStream`.
+
+        Raises :class:`BackpressureError` when the pending queue is at
+        depth, ``ValueError`` for an empty/over-long prompt (typed at
+        admission, not mid-decode), :class:`BatcherClosed` after
+        :meth:`close`."""
+        prompt = [int(t) for t in onp.asarray(tokens).reshape(-1)]
+        if not prompt:
+            raise ValueError('empty prompt')
+        if len(prompt) > self.program.max_prompt_len():
+            raise ValueError(
+                'prompt of %d tokens exceeds the top prefill bucket %d'
+                % (len(prompt), self.program.max_prompt_len()))
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.default_max_new)
+        if max_new < 1:
+            raise ValueError('max_new_tokens must be >= 1')
+        now = self._clock()
+        stream = GenerateStream(len(prompt))
+        seq = _Seq(stream, prompt, max_new, eos_id, now,
+                   now + self.timeout_s if self.timeout_s else None)
+        with self._lock:
+            if self._closed:
+                raise BatcherClosed('decode engine %r is closed'
+                                    % self.name)
+            depth = len(self._pending)
+            if depth >= self.max_queue:
+                self._counts['rejected'] += 1
+                inst = _serving_instruments()
+                if inst is not None:
+                    inst.rejected.labels(reason='queue_full').inc()
+                _record_event('serve_reject', reason='queue_full',
+                              depth=depth, limit=self.max_queue)
+                raise BackpressureError(depth, self.max_queue)
+            self._pending.append(seq)
+            self._counts['requests'] += 1
+            self._wake.notify()
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.requests.inc()
+            inst.queue_depth.set(depth + 1)
+        return stream
+
+    # -- reaper (budget enforcement independent of the worker) -------------
+
+    def _reap_loop(self):
+        while True:
+            time.sleep(min(0.05, max(self.timeout_s / 4.0, 0.005)))
+            with self._lock:
+                if self._closed and not self._pending \
+                        and not self._active:
+                    return
+                now = self._clock()
+                kept = []
+                for seq in self._pending:
+                    if seq.deadline_at is not None \
+                            and now >= seq.deadline_at:
+                        self._counts['timeouts'] += 1
+                        seq.stream._finish('error', RequestTimeout(
+                            'request waited %.3fs in queue (budget '
+                            '%.3fs)' % (now - seq.enqueued_at,
+                                        self.timeout_s)))
+                    elif seq.stream._cancelled:
+                        seq.stream._finish('cancelled')
+                    else:
+                        kept.append(seq)
+                self._pending = kept
+                # active sequences past budget: mark the stream NOW
+                # (the client unblocks even if the worker is wedged
+                # inside a device call); the worker retires the slot
+                # at the next token boundary
+                for seq in self._active.values():
+                    if seq.deadline_at is not None \
+                            and now >= seq.deadline_at \
+                            and not seq.stream.done():
+                        self._counts['timeouts'] += 1
+                        seq.stream._finish('error', RequestTimeout(
+                            'generation exceeded its %.3fs budget '
+                            'mid-stream (%d tokens emitted)'
+                            % (self.timeout_s,
+                               len(seq.stream.tokens))))
+
+    # -- worker ------------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while not self._pending and not self._active:
+                    if self._closed:
+                        return
+                    self._wake.wait(0.05)
+                if self._closed and not self._pending \
+                        and not self._active:
+                    return
+            try:
+                self._tick()
+            except Exception:           # pragma: no cover - last resort
+                logging.exception('decode engine %s: scheduler tick '
+                                  'failed', self.name)
+                time.sleep(0.01)
+
+    def _tick(self):
+        """One scheduler iteration: retire finished/abandoned slots,
+        admit prefills, advance the live batch one token."""
+        self._retire_abandoned()
+        budget = self.prefill_interleave if self._active \
+            else self.slots
+        while budget > 0:
+            with self._lock:
+                if not self._pending or not self._free:
+                    break
+                seq = self._pending.pop(0)
+                slot = self._free.pop(0)
+            self._admit(seq, slot)
+            budget -= 1
+        if self._active:
+            self._step()
+        inst = _serving_instruments()
+        if inst is not None:
+            with self._lock:
+                inst.active_slots.set(len(self._active))
+                inst.queue_depth.set(len(self._pending))
+
+    def _retire_abandoned(self):
+        """Free slots whose stream is already done (timeout reaper or
+        client cancel) so they stop consuming decode batch slots —
+        the same contract the micro-batcher applies at flush time."""
+        with self._lock:
+            doomed = [(slot, seq) for slot, seq in self._active.items()
+                      if seq.stream.done() or seq.stream._cancelled]
+        for slot, seq in doomed:
+            if seq.stream._cancelled and not seq.stream.done():
+                seq.stream._finish('cancelled')
+            self._retire(slot, seq, seq.stream.finish_reason
+                         or 'cancelled')
+
+    def _retire(self, slot, seq, reason):
+        with self._lock:
+            if self._active.get(slot) is seq:
+                del self._active[slot]
+                self._free.append(slot)
+                self._counts['retired'][reason] = \
+                    self._counts['retired'].get(reason, 0) + 1
+        _record_event('decode_retire', slot=slot, reason=reason,
+                      tokens=len(seq.stream.tokens))
+
+    # -- device calls under breaker + watchdog -----------------------------
+
+    def _next_op(self):
+        with self._lock:
+            seq = self._op_seq
+            self._op_seq += 1
+        return seq
+
+    def _execute(self, fn, step, *args):
+        from ...resilience.policy import inject
+        inject('serving.decode', ('device_loss',), step=step)
+        if self._watchdog is not None:
+            self._watchdog.check()
+        return fn(*args)
+
+    def _device(self, fn, *args):
+        """Run one device call under the breaker; a transient failure
+        or an open breaker raises :class:`_DegradedPath` after
+        recording the trip (server.py's _serve contract)."""
+        from ...resilience.policy import CircuitOpenError, is_transient
+        step = self._next_op()
+        if self._watchdog is not None:
+            self._watchdog.beat(step=step, phase='decode')
+        was_open = self._breaker.state == 'open'
+        try:
+            out = self._breaker.call(self._execute, fn, step, *args)
+        except Exception as exc:
+            if not (is_transient(exc)
+                    or isinstance(exc, CircuitOpenError)):
+                raise               # bug-shaped: surface loudly
+            self._note_failure(exc, step, was_open)
+            raise _DegradedPath() from exc
+        with self._lock:
+            self._degraded = False
+            self._last_error = None
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.degraded.set(0.0)
+        return out
+
+    def on_stall(self, record):
+        """Watchdog monitor-thread escalation (wired by the server):
+        a decode device call overran its budget with the worker still
+        blocked inside it."""
+        with self._lock:
+            self._degraded = True
+            self._last_error = ('stall: %s phase stalled %.1fs '
+                                '(budget %.1fs)'
+                                % (record.get('phase'),
+                                   record.get('waited_s', 0.0),
+                                   record.get('budget_s', 0.0)))
+        self._breaker.record_failure()
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.degraded.set(1.0)
+
+    def _note_failure(self, exc, step, was_open):
+        with self._lock:
+            self._degraded = True
+            self._last_error = '%s: %s' % (type(exc).__name__, exc)
+        state = self._breaker.state
+        newly_open = state != 'closed' and not was_open
+        logging.warning('decode %s: device call %d failed (%s); '
+                        'state=%s, completing in-flight sequences on '
+                        'CPU fallback', self.name, step,
+                        self._last_error, state)
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.degraded.set(1.0)
+            if newly_open:
+                inst.breaker_trips.inc()
+        if newly_open:
+            _record_event('breaker_open', step=step,
+                          error=self._last_error)
+            _flight_dump(reason='breaker')
+        else:
+            _record_event('serve_fallback', step=step,
+                          error=self._last_error)
+
+    # -- scheduling primitives ---------------------------------------------
+
+    def _admit(self, seq, slot):
+        """Prefill one pending request into ``slot`` (join)."""
+        if seq.stream.done() or seq.stream._cancelled:
+            if not seq.stream.done():
+                seq.stream._finish('cancelled')
+            with self._lock:
+                self._free.append(slot)
+            return
+        try:
+            if self._cache is None:
+                self._cache = self.program.new_cache()
+            self._cache, tok, _logits = self._device(
+                self.program.run_prefill, self._cache,
+                onp.asarray(seq.prompt, 'int32'), slot)
+        except _DegradedPath:
+            with self._lock:
+                self._free.append(slot)
+            self._fallback_complete(seq)
+            return
+        except Exception as exc:
+            # bug-shaped (non-transient) failure: fail THIS request
+            # loudly with the typed error, but never leak its slot or
+            # leave its stream blocking forever
+            with self._lock:
+                self._free.append(slot)
+            seq.stream._finish('error', exc)
+            logging.exception('decode %s: prefill failed with a '
+                              'non-transient error', self.name)
+            return
+        with self._lock:
+            self._counts['prefills'] += 1
+            self._counts['tokens'] += 1
+        seq.slot = slot
+        seq.pos = len(seq.prompt)
+        seq.last_token = int(tok)
+        now = self._clock()
+        seq.first_token_at = now
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.prefills.inc()
+            inst.tokens.inc()
+            inst.ttft.observe(max(0.0, now - seq.enqueued_at))
+        _record_event('decode_admit', slot=slot,
+                      prompt_len=len(seq.prompt))
+        # register BEFORE the finish check so a first-token EOS /
+        # max_new=1 retirement flows through _retire and frees the
+        # slot instead of leaking it
+        with self._lock:
+            self._active[slot] = seq
+        seq.stream._emit(tok)
+        reason = self._finished_reason(seq, int(tok))
+        if reason is not None:
+            seq.stream._finish(reason)
+            self._retire(slot, seq, reason)
+
+    def _finished_reason(self, seq, tok):
+        if seq.eos_id is not None and tok == seq.eos_id:
+            return 'eos'
+        if len(seq.stream.tokens) >= seq.max_new:
+            return 'length'
+        if seq.pos + 1 >= self.program.max_len:
+            return 'length'
+        return None
+
+    def _step(self):
+        """Advance every live slot one token (the single fixed-shape
+        decode program)."""
+        with self._lock:
+            active = dict(self._active)
+        if not active:
+            return
+        tokens = onp.zeros(self.slots, 'int32')
+        positions = onp.zeros(self.slots, 'int32')
+        for slot, seq in active.items():
+            tokens[slot] = seq.last_token
+            positions[slot] = seq.pos
+        t0 = self._clock()
+        try:
+            self._cache, toks, _logits = self._device(
+                self.program.run_step, self._cache, tokens, positions)
+        except _DegradedPath:
+            self._degrade_inflight(active)
+            return
+        except Exception as exc:
+            # bug-shaped failure: a deterministic error would recur
+            # every tick — fail the in-flight streams with the typed
+            # error, retire their slots, rebuild the (possibly
+            # donated-away) cache, and keep the engine serviceable
+            logging.exception('decode %s: step failed with a '
+                              'non-transient error', self.name)
+            for slot, seq in active.items():
+                seq.stream._finish('error', exc)
+                self._retire(slot, seq, 'error')
+            self._cache = self.program.new_cache()
+            return
+        dt = self._clock() - t0
+        with self._lock:
+            self._counts['steps'] += 1
+            self._counts['tokens'] += len(active)
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.decode_steps.inc()
+            inst.tokens.inc(len(active))
+            inst.tpot.observe(dt)
+        for slot, seq in active.items():
+            if seq.stream.done() or seq.stream._cancelled:
+                continue            # retired at the next tick
+            tok = int(toks[slot])
+            seq.pos += 1
+            seq.last_token = tok
+            seq.stream._emit(tok)
+            reason = self._finished_reason(seq, tok)
+            if reason is not None:
+                seq.stream._finish(reason)
+                self._retire(slot, seq, reason)
+
+    # -- degraded completion -----------------------------------------------
+
+    def _fallback_complete(self, seq):
+        """Finish one sequence start-to-finish (or from wherever it
+        got to) on the CPU fallback. Same greedy math -> same
+        tokens."""
+        if seq.stream.done():
+            return
+        remaining = seq.max_new - len(seq.stream.tokens)
+        room = self.program.max_len - (len(seq.prompt)
+                                       + len(seq.stream.tokens)) - 1
+        remaining = min(remaining, max(0, room) + 1)
+        try:
+            toks = self.program.fallback_generate(
+                seq.prompt + seq.stream.tokens, remaining, seq.eos_id)
+        except Exception as exc:     # fallback itself failed: typed
+            seq.stream._finish('error', exc)
+            return
+        seq.stream.degraded = True
+        with self._lock:
+            self._counts['fallback_tokens'] += len(toks)
+            self._counts['tokens'] += len(toks)
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.fallbacks.inc()
+            inst.tokens.inc(len(toks))
+        for i, tok in enumerate(toks):
+            if seq.first_token_at is None:
+                seq.first_token_at = self._clock()
+                if inst is not None:
+                    inst.ttft.observe(max(
+                        0.0, seq.first_token_at - seq.enqueued_at))
+            seq.stream._emit(tok)
+            if seq.eos_id is not None and tok == seq.eos_id:
+                seq.stream._finish('eos')
+                return
+        seq.stream._finish('length')
+
+    def _degrade_inflight(self, active):
+        """Breaker tripped mid-decode: every in-flight sequence
+        completes degraded on the CPU fallback; the accelerator cache
+        is rebuilt when the breaker lets traffic through again."""
+        for slot, seq in active.items():
+            self._retire(slot, seq, 'degraded')
+            self._fallback_complete(seq)
+        # donated cache buffers are unusable after a failed call;
+        # start clean when the accelerator comes back
+        self._cache = self.program.new_cache()
+
+    # -- introspection / lifecycle -----------------------------------------
+
+    def stats(self):
+        with self._lock:
+            return {
+                'pending': len(self._pending),
+                'active': len(self._active),
+                'free_slots': len(self._free),
+                'slots': self.slots,
+                'degraded': self._degraded,
+                'breaker': self._breaker.state,
+                'error': self._last_error,
+                'counts': {k: (dict(v) if isinstance(v, dict) else v)
+                           for k, v in self._counts.items()},
+                'closed': self._closed,
+            }
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop admissions; ``drain=True`` lets in-flight AND queued
+        generations finish, ``drain=False`` fails them with
+        :class:`BatcherClosed`."""
+        with self._lock:
+            self._closed = True
+            if not drain:
+                for seq in self._pending:
+                    seq.stream._finish('closed', BatcherClosed(
+                        'decode engine closed'))
+                self._pending = []
+                for seq in self._active.values():
+                    seq.stream._finish('closed', BatcherClosed(
+                        'decode engine closed'))
+            self._wake.notify_all()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending and not self._active:
+                    break
+            time.sleep(0.01)
+        self._worker.join(max(0.1, deadline - time.monotonic()))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,440 @@
+"""DecodeProgram: a model frozen into AOT prefill + decode-step
+executables over a preallocated slot cache.
+
+The TVM-style phase separation freeze.py applies to one-shot
+inference, applied to generation: all tracing and compilation happens
+at freeze/warmup time, request time only *runs*. Two program kinds:
+
+  * **prefill** — one AOT executable per prompt-length bucket
+    (powers-of-two ladder, ``MXNET_TPU_SERVE_PREFILL_BUCKETS``); a
+    request's prompt pads up to its bucket, computes the sequence
+    state/KV prefix, and lands it in one cache slot
+    (``lax.dynamic_update_slice``), emitting the first generated
+    token.
+  * **decode step** — exactly ONE fixed-shape executable: every
+    in-flight slot advances one token against the donated cache. The
+    shape never depends on which sequences are live, so continuous
+    batching joins/leaves without a single retrace. Total programs for
+    any workload: ``len(prefill ladder) + 1``.
+
+Cache buffers are donated on accelerator backends — XLA updates the
+KV/state arrays in place instead of copying ``slots × max_len × units``
+floats per token. ``trace_counts`` ticks only while jax traces, so
+the selftest proves request-time zero-retrace the same way freeze.py
+does, including after an artifact reload in a fresh process.
+
+Persistence rides the ``mxnet_tpu.frozen.v1`` schema with
+``kind: "decode"`` (``load_frozen`` dispatches): MANIFEST + params.npz
++ serialized prefill/step executables; a jax-version/platform mismatch
+re-jits and records ``retraced_buckets``.
+
+The CPU fallback (:meth:`fallback_generate`) replays the SAME cell /
+attention math eagerly on the CPU backend through a single-slot cache
+— degraded-mode tokens are bit-identical to accelerator tokens, so a
+breaker trip changes latency, never output.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+
+import numpy as onp
+
+from ..bucket import BucketPolicy, default_buckets
+from .cache import cache_avals, cache_bytes, init_cache
+from .model import DecodeModel, from_gluon_rnn_lm, model_from_config
+
+__all__ = ['DecodeProgram', 'freeze_decode', 'load_decode']
+
+_DECODE_KIND = 'decode'
+
+
+def _knob(name, default):
+    try:
+        from ... import config as _config
+        v = _config.get(name)
+        return default if v is None else v
+    except Exception:
+        return default
+
+
+def _instrument_compile(key, seconds):
+    try:
+        from ... import observability as _obs
+        if _obs.enabled():
+            _obs.serving_instruments().compiles.inc()
+            _obs.record_event('serve_compile', bucket=key,
+                              seconds=round(seconds, 4))
+    except Exception:
+        pass
+
+
+class DecodeProgram:
+    """AOT prefill/step programs + slot cache for one decode model."""
+
+    def __init__(self, model, params, slots=None, prefill_buckets=None,
+                 name=None, donate=None, emit_logits=True):
+        import jax
+        import jax.numpy as jnp
+        if not isinstance(model, DecodeModel):
+            raise TypeError('DecodeProgram wraps a DecodeModel; got %s'
+                            % type(model).__name__)
+        self.model = model
+        self.name = name or '%s-decoder' % model.family
+        self.slots = int(slots if slots is not None
+                         else _knob('MXNET_TPU_SERVE_DECODE_SLOTS', 8))
+        if self.slots < 1:
+            raise ValueError('slots must be >= 1')
+        if prefill_buckets is None:
+            spec = _knob('MXNET_TPU_SERVE_PREFILL_BUCKETS', None)
+            prefill_buckets = spec or default_buckets(
+                min(int(_knob('MXNET_TPU_SERVE_MAX_PREFILL', 64)),
+                    model.max_len - 1))
+        # BucketPolicy validates the ladder; batch ladder unused here
+        self.policy = BucketPolicy(buckets=prefill_buckets)
+        if self.policy.max_batch >= model.max_len:
+            raise ValueError(
+                'top prefill bucket %d leaves no room to generate '
+                'within max_len %d'
+                % (self.policy.max_batch, model.max_len))
+        self.max_len = model.max_len
+        self._params_np = {k: onp.asarray(v) for k, v in params.items()}
+        self._params = {k: jnp.asarray(v)
+                        for k, v in self._params_np.items()}
+        self._spec = model.cache_spec()
+        if donate is None:
+            donate = jax.default_backend() != 'cpu'
+        self._donate = bool(donate)
+        self.emit_logits = bool(emit_logits)
+        self._compiled = {}          # key -> jax Compiled
+        self._loaded = {}            # key -> deserialized Compiled
+        self._cpu_params = None
+        self._build_lock = threading.Lock()
+        self.trace_counts = {}       # key -> python traces observed
+        self.compile_seconds = {}
+        self.retraced_buckets = []
+
+    # -- program construction ----------------------------------------------
+
+    @property
+    def prefill_buckets(self):
+        return self.policy.buckets
+
+    @property
+    def compile_count(self):
+        return len(set(self._compiled) | set(self._loaded))
+
+    def cache_bytes(self):
+        """Static per-engine cache footprint (docs/SERVING.md)."""
+        return cache_bytes(self._spec, self.slots)
+
+    def new_cache(self):
+        """Fresh preallocated device cache for ``slots`` sequences."""
+        return init_cache(self._spec, self.slots)
+
+    def _prefill_fn(self, key):
+        import jax.numpy as jnp
+        counts = self.trace_counts
+        model, emit = self.model, self.emit_logits
+
+        def fn(params, cache, tokens, length, slot):
+            counts[key] = counts.get(key, 0) + 1
+            cache, logits = model.prefill(params, cache, tokens,
+                                          length, slot)
+            tok = jnp.argmax(logits, axis=-1).astype('int32')
+            return (cache, tok, logits) if emit else (cache, tok)
+        return fn
+
+    def _step_fn(self, key):
+        import jax.numpy as jnp
+        counts = self.trace_counts
+        model, emit = self.model, self.emit_logits
+
+        def fn(params, cache, tokens, positions):
+            counts[key] = counts.get(key, 0) + 1
+            cache, logits = model.step(params, cache, tokens,
+                                       positions)
+            tok = jnp.argmax(logits, axis=-1).astype('int32')
+            return (cache, tok, logits) if emit else (cache, tok)
+        return fn
+
+    def _param_avals(self):
+        import jax
+        return {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                for k, v in self._params.items()}
+
+    def _build(self, key, fn, *avals):
+        """jit -> lower -> compile with the freeze.py accounting."""
+        import time
+        import jax
+        prog = self._compiled.get(key) or self._loaded.get(key)
+        if prog is not None:
+            return prog
+        with self._build_lock:
+            prog = self._compiled.get(key) or self._loaded.get(key)
+            if prog is not None:
+                return prog
+            t0 = time.perf_counter()
+            jitted = jax.jit(fn, donate_argnums=(1,)) if self._donate \
+                else jax.jit(fn)
+            prog = jitted.lower(self._param_avals(),
+                                cache_avals(self._spec, self.slots),
+                                *avals).compile()
+            self.compile_seconds[key] = time.perf_counter() - t0
+            self._compiled[key] = prog
+        _instrument_compile(key, self.compile_seconds[key])
+        return prog
+
+    def compile_prefill(self, bucket):
+        import jax
+        key = 'prefill:%d' % bucket
+        return self._build(
+            key, self._prefill_fn(key),
+            jax.ShapeDtypeStruct((1, bucket), 'int32'),
+            jax.ShapeDtypeStruct((), 'int32'),
+            jax.ShapeDtypeStruct((), 'int32'))
+
+    def compile_step(self):
+        import jax
+        return self._build(
+            'step', self._step_fn('step'),
+            jax.ShapeDtypeStruct((self.slots,), 'int32'),
+            jax.ShapeDtypeStruct((self.slots,), 'int32'))
+
+    def warmup(self, buckets=None):
+        """Compile the whole ladder + the step program (server start,
+        not first request): exactly ``len(ladder) + 1`` programs."""
+        for b in (buckets or self.policy.buckets):
+            self.compile_prefill(b)
+        self.compile_step()
+        return self
+
+    # -- execution ---------------------------------------------------------
+
+    def _unpack(self, out):
+        if self.emit_logits:
+            return out
+        cache, tok = out
+        return cache, tok, None
+
+    def run_prefill(self, cache, tokens, slot):
+        """Pad ``tokens`` (1-D int prompt) to its bucket and land the
+        prefix in ``slot``. Returns (cache', first_token int, logits
+        np (V,) | None)."""
+        tokens = onp.asarray(tokens, 'int32').reshape(-1)
+        n = tokens.shape[0]
+        if n < 1:
+            raise ValueError('empty prompt')
+        bucket = self.policy.bucket_for(n)   # ValueError when too long
+        padded = onp.zeros((1, bucket), 'int32')
+        padded[0, :n] = tokens
+        prog = self.compile_prefill(bucket)
+        cache, tok, logits = self._unpack(prog(
+            self._params, cache, padded, onp.int32(n),
+            onp.int32(slot)))
+        return cache, int(tok), \
+            None if logits is None else onp.asarray(logits)
+
+    def run_step(self, cache, tokens, positions):
+        """Advance every slot one token. Returns (cache', tokens np
+        (slots,), logits np (slots, V) | None)."""
+        prog = self.compile_step()
+        cache, toks, logits = self._unpack(prog(
+            self._params, cache,
+            onp.asarray(tokens, 'int32').reshape(self.slots),
+            onp.asarray(positions, 'int32').reshape(self.slots)))
+        return cache, onp.asarray(toks), \
+            None if logits is None else onp.asarray(logits)
+
+    def max_prompt_len(self):
+        return self.policy.max_batch
+
+    # -- CPU fallback (degraded serving) ------------------------------------
+
+    def fallback_generate(self, tokens, max_new, eos_id=None):
+        """Eagerly decode on the CPU backend through a single-slot
+        cache — the degraded path sequences complete on when the
+        accelerator program is the thing that died. Same math, same
+        greedy argmax, so the tokens are bit-identical to the
+        accelerator path."""
+        import jax
+        import jax.numpy as jnp
+        cpu = jax.devices('cpu')[0]
+        with self._build_lock:
+            if self._cpu_params is None:
+                self._cpu_params = {k: jax.device_put(v, cpu)
+                                    for k, v in self._params.items()}
+        tokens = [int(t) for t in onp.asarray(tokens).reshape(-1)]
+        out = []
+        with jax.default_device(cpu):
+            cache = init_cache(self._spec, 1)
+            prompt = jnp.asarray([tokens], 'int32')
+            cache, logits = self.model.prefill(
+                self._cpu_params, cache, prompt,
+                jnp.asarray(len(tokens), 'int32'),
+                jnp.asarray(0, 'int32'))
+            tok = int(jnp.argmax(logits))
+            pos = len(tokens)
+            while True:
+                out.append(tok)
+                if (eos_id is not None and tok == eos_id) \
+                        or len(out) >= max_new \
+                        or pos + 1 >= self.max_len:
+                    break
+                cache, logits = self.model.step(
+                    self._cpu_params, cache,
+                    jnp.asarray([tok], 'int32'),
+                    jnp.asarray([pos], 'int32'))
+                tok = int(jnp.argmax(logits[0]))
+                pos += 1
+        return out
+
+    # -- persistence (mxnet_tpu.frozen.v1, kind=decode) ---------------------
+
+    def save(self, path, include_programs=True):
+        """Write the decode artifact::
+
+            <path>/MANIFEST.json           schema + kind=decode +
+                                           model config + ladders
+            <path>/params.npz              model parameters
+            <path>/programs/prefill_<S>.bin
+            <path>/programs/step.bin       serialized executables
+        """
+        import jax
+        from ...resilience.checkpoint import atomic_write_bytes
+        from ..freeze import FROZEN_SCHEMA
+        os.makedirs(path, exist_ok=True)
+        import io as _io
+        buf = _io.BytesIO()
+        onp.savez(buf, **self._params_np)
+        atomic_write_bytes(os.path.join(path, 'params.npz'),
+                           buf.getvalue())
+        programs = {}
+        if include_programs:
+            from jax.experimental import serialize_executable
+            os.makedirs(os.path.join(path, 'programs'), exist_ok=True)
+            for key in sorted(set(self._compiled) | set(self._loaded)):
+                prog = self._compiled.get(key) or self._loaded.get(key)
+                fname = 'programs/%s.bin' % key.replace(':', '_')
+                try:
+                    blob = pickle.dumps(
+                        serialize_executable.serialize(prog))
+                except Exception:
+                    continue     # artifact still loads; key re-jits
+                atomic_write_bytes(os.path.join(path, fname), blob)
+                programs[key] = fname
+        manifest = {
+            'schema': FROZEN_SCHEMA,
+            'kind': _DECODE_KIND,
+            'name': self.name,
+            'family': self.model.family,
+            'config': self.model.config,
+            'slots': self.slots,
+            'prefill_buckets': list(self.policy.buckets),
+            'emit_logits': self.emit_logits,
+            'donate': self._donate,
+            'cache_bytes': self.cache_bytes(),
+            'jax_version': jax.__version__,
+            'platform': jax.default_backend(),
+            'programs': programs,
+        }
+        atomic_write_bytes(
+            os.path.join(path, 'MANIFEST.json'),
+            (json.dumps(manifest, indent=1, sort_keys=True)
+             + '\n').encode())
+        return path
+
+    @classmethod
+    def load(cls, path):
+        """Reload a decode artifact; executables deserialize when jax
+        version + platform match, else the key re-jits on first use
+        and lands in ``retraced_buckets``."""
+        import jax
+        with open(os.path.join(path, 'MANIFEST.json')) as f:
+            manifest = json.load(f)
+        from ..freeze import FROZEN_SCHEMA
+        if manifest.get('schema') != FROZEN_SCHEMA or \
+                manifest.get('kind') != _DECODE_KIND:
+            raise ValueError(
+                'not a %s decode artifact: schema=%r kind=%r at %s'
+                % (FROZEN_SCHEMA, manifest.get('schema'),
+                   manifest.get('kind'), path))
+        params = {}
+        with onp.load(os.path.join(path, 'params.npz')) as z:
+            for key in z.files:
+                params[key] = z[key]
+        model = model_from_config(manifest['family'],
+                                  manifest['config'])
+        prog = cls(model, params, slots=manifest['slots'],
+                   prefill_buckets=manifest['prefill_buckets'],
+                   name=manifest.get('name'),
+                   donate=manifest.get('donate'),
+                   emit_logits=manifest.get('emit_logits', True))
+        env_ok = (manifest.get('jax_version') == jax.__version__
+                  and manifest.get('platform') == jax.default_backend())
+        for key, fname in (manifest.get('programs') or {}).items():
+            if not env_ok:
+                prog.retraced_buckets.append(key)
+                continue
+            try:
+                from jax.experimental import serialize_executable
+                with open(os.path.join(path, fname), 'rb') as f:
+                    ser, in_tree, out_tree = pickle.load(f)
+                prog._loaded[key] = \
+                    serialize_executable.deserialize_and_load(
+                        ser, in_tree, out_tree)
+            except Exception:
+                prog.retraced_buckets.append(key)
+        return prog
+
+
+def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,
+                  max_len=None, name=None, donate=None,
+                  emit_logits=True):
+    """Freeze a generation model into a :class:`DecodeProgram`.
+
+    ``obj`` — one of:
+
+      * a :class:`~.model.DecodeModel` with ``params`` given
+        explicitly;
+      * a ``(embedding, rnn, decoder)`` triple of trained gluon blocks
+        (``nn.Embedding``, ``rnn.LSTM/GRU/RNN``, ``nn.Dense``);
+      * a word_lm-style object exposing those three as attributes
+        (``.embedding``, ``.lstm``/``.rnn``, ``.decoder``).
+
+    ``max_len`` caps prompt + generated tokens per sequence (the KV
+    cache length; ``MXNET_TPU_SERVE_MAX_SEQ_LEN``).
+    """
+    if max_len is None:
+        max_len = int(_knob('MXNET_TPU_SERVE_MAX_SEQ_LEN', 256))
+    if isinstance(obj, DecodeModel):
+        if params is None:
+            raise ValueError('params required when freezing a '
+                             'DecodeModel directly')
+        model = obj
+    else:
+        if isinstance(obj, tuple) and len(obj) == 3:
+            embedding, rnn, decoder = obj
+        else:
+            embedding = getattr(obj, 'embedding', None)
+            rnn = getattr(obj, 'lstm', None) or getattr(obj, 'rnn',
+                                                        None)
+            decoder = getattr(obj, 'decoder', None)
+            if embedding is None or rnn is None or decoder is None:
+                raise TypeError(
+                    'cannot freeze %r for decoding: need a DecodeModel'
+                    ' + params, an (embedding, rnn, decoder) gluon'
+                    ' triple, or an object with those attributes'
+                    % (type(obj).__name__,))
+        model, params = from_gluon_rnn_lm(embedding, rnn, decoder,
+                                          max_len=max_len)
+    return DecodeProgram(model, params, slots=slots,
+                         prefill_buckets=prefill_buckets, name=name,
+                         donate=donate, emit_logits=emit_logits)
+
+
+def load_decode(path):
+    """Module-level alias of :meth:`DecodeProgram.load`."""
+    return DecodeProgram.load(path)

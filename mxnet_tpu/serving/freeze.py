@@ -466,5 +466,17 @@ def freeze(obj, data_shapes=None, buckets=None, max_batch=None,
 
 
 def load_frozen(path):
-    """Module-level alias of :meth:`FrozenProgram.load`."""
+    """Load any ``mxnet_tpu.frozen.v1`` artifact: dispatches on the
+    manifest ``kind`` — one-shot inference programs load as
+    :class:`FrozenProgram`, generation artifacts (``kind: decode``,
+    prefill + decode-step executables) as
+    :class:`~.decode.DecodeProgram`."""
+    try:
+        with open(os.path.join(path, 'MANIFEST.json')) as f:
+            kind = json.load(f).get('kind')
+    except OSError:
+        kind = None
+    if kind == 'decode':
+        from .decode import DecodeProgram
+        return DecodeProgram.load(path)
     return FrozenProgram.load(path)

@@ -61,23 +61,36 @@ def _knob(name, default):
 
 class InferenceSession:
     """Serve a :class:`~.freeze.FrozenProgram` behind dynamic
-    micro-batching, a circuit breaker, and a CPU fallback.
+    micro-batching, a circuit breaker, and a CPU fallback — or a
+    :class:`~.decode.DecodeProgram` behind the continuous-batching
+    decode engine (:meth:`generate` streams tokens; docs/SERVING.md
+    "Autoregressive decoding").
 
     Knob defaults come from ``MXNET_TPU_SERVE_*`` (docs/ENV_VARS.md);
     constructor arguments win. ``watchdog=True`` (default) arms a
-    stall watchdog on the ``infer`` phase whose fault-injection site
-    is ``serving.infer``; ``stall_artifact`` overrides its dump path.
+    stall watchdog whose fault-injection site is ``serving.infer``
+    (one-shot) or ``serving.decode`` (generation);
+    ``stall_artifact`` overrides its dump path.
     """
 
     def __init__(self, frozen, max_batch=None, deadline_ms=None,
                  max_queue=None, timeout_s=None, breaker=None,
                  watchdog=True, stall_artifact=None, name=None,
-                 warmup=False):
-        if not isinstance(frozen, FrozenProgram):
-            raise TypeError('InferenceSession serves a FrozenProgram; '
-                            'got %s (use serving.freeze first)'
-                            % type(frozen).__name__)
+                 warmup=False, max_new_tokens=None,
+                 prefill_interleave=None):
+        from .decode import DecodeProgram
         from ..resilience.policy import CircuitBreaker
+        if isinstance(frozen, DecodeProgram):
+            self._init_decode(frozen, max_queue, timeout_s, breaker,
+                              watchdog, stall_artifact, name, warmup,
+                              max_new_tokens, prefill_interleave)
+            return
+        self._engine = None
+        if not isinstance(frozen, FrozenProgram):
+            raise TypeError('InferenceSession serves a FrozenProgram '
+                            'or a DecodeProgram; got %s (use '
+                            'serving.freeze / freeze_decode first)'
+                            % type(frozen).__name__)
         self.frozen = frozen
         self.name = name or frozen.name
         max_batch = int(max_batch
@@ -133,16 +146,68 @@ class InferenceSession:
             # (1, h, w) example is never mistaken for a batched one)
             example_shapes=[s for _n, s, _dt in frozen.data_descs])
 
+    def _init_decode(self, program, max_queue, timeout_s, breaker,
+                     watchdog, stall_artifact, name, warmup,
+                     max_new_tokens, prefill_interleave):
+        """Generation mode: continuous-batching decode engine instead
+        of the flush micro-batcher (same admission/resilience
+        contract, new injection site ``serving.decode``)."""
+        from .decode.engine import DecodeEngine
+        from ..resilience.policy import CircuitBreaker
+        self.frozen = program
+        self.name = name or program.name
+        self._batcher = None
+        threshold = int(_knob('MXNET_TPU_SERVE_BREAKER', 3))
+        self._breaker = breaker if breaker is not None else \
+            CircuitBreaker(failure_threshold=max(1, threshold),
+                           reset_timeout=30.0)
+        self._watchdog = None
+        if watchdog:
+            from ..resilience.watchdog import Watchdog
+            self._watchdog = Watchdog(
+                budgets={'decode': float(
+                    _knob('MXNET_TPU_WATCHDOG_STEP_S', 300.0))},
+                artifact_path=stall_artifact, name=self.name,
+                site='serving.decode',
+                on_stall=lambda rec: self._engine.on_stall(rec))
+            self._watchdog.start()
+        if warmup:
+            program.warmup()
+        self._engine = DecodeEngine(
+            program,
+            max_queue=int(max_queue if max_queue is not None
+                          else _knob('MXNET_TPU_SERVE_QUEUE_DEPTH',
+                                     256)),
+            timeout_s=float(timeout_s if timeout_s is not None
+                            else _knob('MXNET_TPU_SERVE_TIMEOUT_S',
+                                       30.0)),
+            max_new_tokens=int(
+                max_new_tokens if max_new_tokens is not None
+                else _knob('MXNET_TPU_SERVE_MAX_NEW_TOKENS', 64)),
+            prefill_interleave=int(
+                prefill_interleave if prefill_interleave is not None
+                else _knob('MXNET_TPU_SERVE_PREFILL_INTERLEAVE', 1)),
+            breaker=self._breaker, watchdog=self._watchdog,
+            name=self.name)
+
     # -- request API -------------------------------------------------------
+
+    def _require_oneshot(self, what):
+        if self._engine is not None:
+            raise TypeError('%s serves one-shot programs; this session '
+                            'wraps a DecodeProgram — use generate()'
+                            % what)
 
     def submit(self, *arrays):
         """Enqueue one single-example request; returns a Future whose
         result is the list of per-example output arrays."""
+        self._require_oneshot('submit')
         return self._batcher.submit(*arrays)
 
     def infer(self, *arrays, timeout=None):
         """Blocking single-request inference through the batched
         engine."""
+        self._require_oneshot('infer')
         return self._batcher.infer(*arrays, timeout=timeout)
 
     def infer_batch(self, arrays, timeout=None):
@@ -150,9 +215,22 @@ class InferenceSession:
         through the bucketed program directly — the bulk path bench /
         offline scoring uses; the micro-batch queue is for concurrent
         single requests."""
+        self._require_oneshot('infer_batch')
         n = onp.asarray(arrays[0]).shape[0]
         seq = self._next_seq()
         return self._serve(list(arrays), n, seq)
+
+    def generate(self, tokens, max_new_tokens=None, eos_id=None):
+        """Stream a generation: returns a
+        :class:`~.decode.GenerateStream` (iterate per-token, or
+        ``.result(timeout)`` for the full sequence). Decode-mode
+        sessions only."""
+        if self._engine is None:
+            raise TypeError('generate() needs a DecodeProgram session '
+                            '(use serving.freeze_decode)')
+        return self._engine.generate(tokens,
+                                     max_new_tokens=max_new_tokens,
+                                     eos_id=eos_id)
 
     # -- batched execution (batcher worker thread) -------------------------
 
@@ -266,6 +344,21 @@ class InferenceSession:
 
     def status(self):
         """Machine-readable session state (the /status JSON)."""
+        if self._engine is not None:
+            stats = self._engine.stats()
+            return {
+                'status': 'degraded' if stats['degraded'] else 'ok',
+                'name': self.name,
+                'mode': 'decode',
+                'breaker': stats['breaker'],
+                'error': stats['error'],
+                'decode': stats,
+                'prefill_buckets':
+                    list(self.frozen.policy.buckets),
+                'slots': self.frozen.slots,
+                'max_len': self.frozen.max_len,
+                'compiled': self.frozen.compile_count,
+            }
         with self._lock:
             degraded = self._degraded
             record = {
@@ -282,7 +375,10 @@ class InferenceSession:
         return record
 
     def close(self, drain=True):
-        self._batcher.close(drain=drain)
+        if self._engine is not None:
+            self._engine.close(drain=drain)
+        else:
+            self._batcher.close(drain=drain)
         if self._watchdog is not None:
             self._watchdog.stop()
 
@@ -298,10 +394,16 @@ class ServingHTTPServer:
 
     Routes::
 
-        GET  /status   session status JSON
-        GET  /healthz  {"ok": true|false, "status": ...}
-        POST /predict  {"data": [...]}            one example
-                       {"instances": [[...], ...]} many examples
+        GET  /status    session status JSON
+        GET  /healthz   {"ok": true|false, "status": ...}
+        POST /predict   {"data": [...]}            one example
+                        {"instances": [[...], ...]} many examples
+        POST /generate  {"tokens": [...], "max_new_tokens": N,
+                         "eos_id": E, "stream": true|false}
+                        decode-mode sessions; ``stream: true``
+                        answers chunked NDJSON — one
+                        {"token": t, "index": i} line per decoded
+                        token, then a {"done": true, ...} summary
 
     Binds 127.0.0.1 only; OFF by default — enable per-process with
     ``MXNET_TPU_SERVE_HTTP_PORT=<port>`` + :func:`maybe_start_http_server`
@@ -323,6 +425,10 @@ class ServingHTTPServer:
         session = self.session
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 so /generate can stream chunked NDJSON; every
+            # non-chunked response carries Content-Length already
+            protocol_version = 'HTTP/1.1'
+
             def _json(handler, code, payload):
                 body = (json.dumps(payload, sort_keys=True)
                         + '\n').encode()
@@ -343,8 +449,72 @@ class ServingHTTPServer:
                 else:
                     handler.send_error(404)
 
+            def _chunk(handler, obj):
+                data = (json.dumps(obj, sort_keys=True)
+                        + '\n').encode()
+                handler.wfile.write(b'%x\r\n' % len(data))
+                handler.wfile.write(data + b'\r\n')
+                handler.wfile.flush()
+
+            def _generate(handler, req):
+                """POST /generate — per-token chunked streaming (or a
+                single JSON when stream=false)."""
+                tokens = req.get('tokens')
+                if not tokens:
+                    handler._json(400, {'error': "need 'tokens'"})
+                    return
+                stream = session.generate(
+                    tokens,
+                    max_new_tokens=req.get('max_new_tokens'),
+                    eos_id=req.get('eos_id'))
+                wait_s = (session._engine.timeout_s
+                          or _HTTP_MAX_WAIT_S)
+                if not req.get('stream', True):
+                    toks = stream.result(wait_s)
+                    handler._json(200, {
+                        'tokens': toks,
+                        'finish_reason': stream.finish_reason,
+                        'degraded': stream.degraded})
+                    return
+                handler.send_response(200)
+                handler.send_header('Content-Type',
+                                    'application/x-ndjson')
+                handler.send_header('Transfer-Encoding', 'chunked')
+                handler.end_headers()
+                try:
+                    for i, tok in enumerate(stream):
+                        handler._chunk({'token': tok, 'index': i})
+                    handler._chunk({'done': True,
+                                    'tokens': stream.tokens,
+                                    'finish_reason':
+                                        stream.finish_reason,
+                                    'degraded': stream.degraded})
+                except OSError:
+                    # client went away mid-stream: retire the
+                    # sequence so it stops occupying a decode slot,
+                    # and never touch the dead socket again
+                    stream.cancel()
+                    return
+                except Exception as exc:
+                    # mid-stream engine failure: the error rides the
+                    # last NDJSON line (headers are long gone)
+                    stream.cancel()
+                    try:
+                        handler._chunk({'done': True,
+                                        'error': '%s: %s'
+                                        % (type(exc).__name__, exc),
+                                        'tokens': stream.tokens})
+                    except OSError:
+                        return
+                try:
+                    handler.wfile.write(b'0\r\n\r\n')
+                    handler.wfile.flush()
+                except OSError:
+                    pass
+
             def do_POST(handler):
-                if handler.path.rstrip('/') != '/predict':
+                path = handler.path.rstrip('/')
+                if path not in ('/predict', '/generate'):
                     handler.send_error(404)
                     return
                 try:
@@ -357,9 +527,14 @@ class ServingHTTPServer:
                     return
                 from concurrent.futures import TimeoutError as \
                     _FutWaitTimeout
-                wait_s = session._batcher.timeout_s or _HTTP_MAX_WAIT_S
+                wait_s = (session._batcher.timeout_s
+                          if session._batcher is not None
+                          else session._engine.timeout_s) \
+                    or _HTTP_MAX_WAIT_S
                 try:
-                    if 'instances' in req:
+                    if path == '/generate':
+                        handler._generate(req)
+                    elif 'instances' in req:
                         futs = [session.submit(onp.asarray(x))
                                 for x in req['instances']]
                         outs = [[o.tolist() for o in f.result(wait_s)]
@@ -383,8 +558,10 @@ class ServingHTTPServer:
                                         or 'request timed out'})
                 except BatcherClosed as exc:
                     handler._json(503, {'error': str(exc)})
-                except ValueError as exc:
-                    # admission-time shape/arity validation
+                except (ValueError, TypeError) as exc:
+                    # admission-time validation: bad shapes/arity,
+                    # over-long prompt, or the wrong endpoint for the
+                    # session's mode
                     handler._json(400, {'error': str(exc)})
 
             def log_message(handler, *args):

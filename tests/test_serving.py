@@ -199,6 +199,54 @@ def test_batcher_per_request_timeout_while_worker_stuck():
         b.close(drain=False)
 
 
+def test_batcher_flush_drops_expired_and_cancelled_requests():
+    """Regression: requests expired by the timeout reaper (or
+    cancelled) between the batch pop and the flush must NOT consume
+    device batch rows — the flush recomputes expiry and stacks only
+    live requests, preserving their FIFO row mapping."""
+    from concurrent.futures import Future
+    from mxnet_tpu.serving.batcher import _Request
+    now = [100.0]
+    calls = []
+
+    def runner(stacked, n):
+        calls.append(n)
+        return [stacked[0] * 2.0]
+
+    b = MicroBatcher(runner, max_batch=8, deadline_ms=1e9, max_queue=8,
+                     timeout_s=1.0, name='flush-expire',
+                     clock=lambda: now[0])
+    try:
+        live = _Request([np.ones(3, 'float32')], Future(), 99.5, 101.0)
+        # deadline already past at flush time: exactly the state the
+        # reaper produces between _take_batch and _run_batch
+        expired = _Request([np.full(3, 7.0, 'float32')], Future(),
+                           98.0, 99.0)
+        cancelled = _Request([np.full(3, 9.0, 'float32')], Future(),
+                             99.5, 101.0)
+        cancelled.future.cancel()
+        batch = [expired, live, cancelled]
+        with b._lock:
+            b._inflight = batch
+        b._run_batch(batch, 'full')
+        # only the live request's row reached the runner
+        assert calls == [1]
+        assert np.array_equal(live.future.result(0)[0],
+                              np.full(3, 2.0, 'float32'))
+        with pytest.raises(RequestTimeout):
+            expired.future.result(0)
+        # an all-dead batch skips the device entirely
+        gone = _Request([np.ones(3, 'float32')], Future(), 90.0, 91.0)
+        with b._lock:
+            b._inflight = [gone]
+        b._run_batch([gone], 'full')
+        assert calls == [1]
+        with pytest.raises(RequestTimeout):
+            gone.future.result(0)
+    finally:
+        b.close(drain=False)
+
+
 def test_batcher_example_shape_validation():
     got = []
 

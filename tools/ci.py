@@ -32,10 +32,18 @@ Stages, each timed:
                            engine selftest (batched == single-request
                            bit-identity, bounded recompiles, frozen
                            reload without retracing, typed
-                           backpressure) plus bench_serving.py --quick
-                           (closed-loop bucket sweep artifact); the
+                           backpressure, plus the decode legs:
+                           cached-decode == whole-sequence-forward
+                           tokens, decode artifact reload with zero
+                           retraces, continuous-batching isolation /
+                           EOS retirement / ladder+1 compile bound)
+                           plus bench_serving.py --quick (closed-loop
+                           bucket sweep artifact) and
+                           bench_serving.py --decode --quick
+                           (generation sweep: continuous vs flush
+                           tokens/s + TTFT/TPOT percentiles); the
                            fault tier gates the serving hang /
-                           device-loss degraded paths
+                           device-loss / decode-hang degraded paths
   5. C ABI audit           tools/capi_coverage.py == 207/207
   6. copy-paste gate       tools/overlap_check.py --sweep 0.60
   7. example smokes        3 representative workloads (LeNet both
@@ -101,6 +109,13 @@ def main(argv=None):
         # the gate fast)
         ('bench-serving', [py, 'bench_serving.py', '--quick',
                            '--out', '/tmp/BENCH_SERVING.json']),
+        # generation sweep: continuous batching must decode the mixed-
+        # length workload with identical token streams to the flush
+        # baseline and bounded recompiles (tokens/s + TTFT/TPOT land
+        # in the artifact)
+        ('bench-decode', [py, 'bench_serving.py', '--decode',
+                          '--quick', '--out',
+                          '/tmp/BENCH_DECODE.json']),
         ('capi', [py, 'tools/capi_coverage.py', '--assert', '207']),
         ('overlap', [py, 'tools/overlap_check.py', '--sweep', '0.60']),
     ]

@@ -44,6 +44,13 @@ tail event matches the fault site (preempt_exit@9 / stall@3).
      the breaker trip must dump the flight ring with tail event
      breaker_open at the tripping batch, and the session keeps
      serving degraded (all requests complete, zero mismatches).
+  9. Decode hang (python -m mxnet_tpu.serving --decode-smoke,
+     docs/SERVING.md "Autoregressive decoding"): with
+     MXNET_TPU_FAULT=hang@serving.decode:3 the decode engine's
+     watchdog must write the stall artifact (phase=decode), the
+     breaker must trip, and every in-flight SEQUENCE must complete
+     degraded on the CPU fallback with bit-identical tokens
+     (status=degraded, breaker=open, zero mismatches).
 
 Usage: python tools/fault_smoke.py [--skip-tests]
 (--skip-tests runs only the subprocess contract checks; ci.py's fast
@@ -456,6 +463,66 @@ def run_serving_device_loss():
         return True
 
 
+def run_decode_hang():
+    """Check 9: injected hang@serving.decode -> stall artifact +
+    breaker trip + every in-flight sequence completes degraded on the
+    CPU fallback with the same tokens."""
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, 'v.json')
+        stall = os.path.join(tmp, 'STALL.json')
+        flight = os.path.join(tmp, 'FLIGHT.jsonl')
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        env.pop('MXNET_TPU_FAULT', None)
+        env['MXNET_TPU_FAULT'] = 'hang@serving.decode:3'
+        r = subprocess.run(
+            [sys.executable, '-m', 'mxnet_tpu.serving',
+             '--decode-smoke', '--requests', '6', '--out', out,
+             '--stall-artifact', stall, '--flight-artifact', flight],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+        if r.returncode != 0:
+            print('FAIL: decode hang smoke exited %d\n%s\n%s'
+                  % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+            return False
+        v = json.load(open(out))
+        problems = []
+        if v.get('served') != v.get('requests'):
+            problems.append('only %r/%r sequences completed'
+                            % (v.get('served'), v.get('requests')))
+        if v.get('mismatches'):
+            problems.append('%d degraded sequences decoded wrong '
+                            'tokens' % v['mismatches'])
+        if v.get('status') != 'degraded':
+            problems.append('status %r, want degraded'
+                            % v.get('status'))
+        if v.get('breaker') != 'open':
+            problems.append('breaker %r, want open' % v.get('breaker'))
+        if not v.get('degraded_streams'):
+            problems.append('no sequence flagged degraded')
+        if not v.get('fallback_tokens'):
+            problems.append('no tokens decoded on the CPU fallback')
+        if not os.path.exists(stall):
+            problems.append('no stall artifact written')
+        else:
+            art = json.load(open(stall))
+            if set(art) != _STALL_KEYS:
+                problems.append('stall artifact keys %s != %s'
+                                % (sorted(art), sorted(_STALL_KEYS)))
+            elif art['schema'] != 'mxnet_tpu.stall.v1':
+                problems.append('stall schema %r' % art['schema'])
+            elif art['phase'] != 'decode':
+                problems.append('stall phase %r, want decode'
+                                % art['phase'])
+        if problems:
+            print('FAIL: ' + '; '.join(problems))
+            return False
+        print('decode hang: stall artifact ok (phase=decode), '
+              'breaker=open, %d/%d sequences completed degraded '
+              '(%d fallback tokens)'
+              % (v['served'], v['requests'], v['fallback_tokens']))
+        return True
+
+
 def run_resilience_tests():
     r = subprocess.run(
         [sys.executable, '-m', 'pytest', 'tests/test_resilience.py',
@@ -476,6 +543,7 @@ def main(argv=None):
     ok = run_watchdog_smoke() and ok
     ok = run_serving_hang() and ok
     ok = run_serving_device_loss() and ok
+    ok = run_decode_hang() and ok
     print('fault_smoke: %s' % ('OK' if ok else 'FAIL'))
     return 0 if ok else 1
 

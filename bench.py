@@ -36,6 +36,14 @@ dispatch path only). The artifact payload also carries a 'telemetry'
 summary block (registry snapshot + flight-recorder stats) so every
 bench run ships its own machine-captured evidence.
 
+A fifth line records the input-pipeline overlap A/B
+(input_pipeline_overlap_pct, docs/PERFORMANCE.md): the same compiled
+step driven from a decode-cost producer synchronously vs through the
+double-buffered staging prefetcher; its record carries data_wait_pct
+(residual wait share with staging on). The primary ResNet record also
+carries hbm_bytes_per_step + fusion_count from the roofline audit of
+its compiled step, so fusion-budget health rides every bench artifact.
+
 Degraded-mode contract (docs/RESILIENCE.md): besides the stdout metric
 lines, every run writes an atomic JSON artifact (--out, default
 BENCH.json) with "status": "ok" | "degraded" | "unavailable" and exits
@@ -125,7 +133,8 @@ def _telemetry_summary():
                 'error': '%s: %s' % (type(e).__name__, e)}
 
 
-def _emit(metric, rate, unit, baseline, flops_per_sample, step_path):
+def _emit(metric, rate, unit, baseline, flops_per_sample, step_path,
+          extra=None):
     tflops = rate * flops_per_sample / 1e12
     peak, kind = _peak_flops()
     rec = {
@@ -143,10 +152,28 @@ def _emit(metric, rate, unit, baseline, flops_per_sample, step_path):
         else 'off',
         'device_kind': kind,
     }
+    if extra:
+        rec.update(extra)
     if peak:
         rec['mfu_pct'] = round(100 * tflops * 1e12 / peak, 2)
     print(json.dumps(rec), flush=True)
     return rec
+
+
+def _fusion_health(pt):
+    """Roofline totals of the compiled step (docs/PERFORMANCE.md): the
+    same text analysis tools/fusion_audit.py gates on, folded into the
+    throughput record so BENCH_r06+ tracks fusion health alongside
+    img/s. Never sinks the bench leg."""
+    try:
+        from mxnet_tpu.observability import roofline
+        totals = roofline.analyze(pt.compiled_text())[1]
+        return {'hbm_bytes_per_step': totals['hbm_bytes_per_step'],
+                'fusion_count': totals['fusion_count']}
+    except Exception as e:
+        return {'hbm_bytes_per_step': None,
+                'fusion_note': '%s: %s' % (type(e).__name__,
+                                           str(e)[:120])}
 
 
 def bench_resnet(on_accel):
@@ -184,8 +211,10 @@ def bench_resnet(on_accel):
         pt.step(x, y)   # compile here so a build failure falls back
         return pt
 
+    fusion = {}
     try:
         pt = _retry_transient(_build_fused)
+        fusion = _fusion_health(pt)
 
         def step():
             return pt.step(x, y)
@@ -208,7 +237,7 @@ def bench_resnet(on_accel):
     dt = _measure(step, warmup, iters, nd)
     return _emit('resnet50_train_img_per_sec_per_chip', batch / dt,
                  'img/s', 363.69, RESNET50_TRAIN_FLOPS_PER_IMG,
-                 step_path)
+                 step_path, extra=fusion)
 
 
 def bench_bert(on_accel):
@@ -474,6 +503,83 @@ def bench_telemetry(on_accel):
     return rec
 
 
+def bench_input_overlap(on_accel):
+    """Input-pipeline overlap A/B (docs/PERFORMANCE.md).
+
+    The same compiled step driven from a host-side producer whose
+    per-batch cost is ~80% of a step (a decode-bound input pipeline),
+    measured twice: synchronous (every batch's wait serializes with
+    the step) and through the double-buffered staging prefetcher
+    (``ParallelTrainer.prefetch_iter``). The metric is how much of the
+    synchronous wait the prefetcher hides (target >= 80%); the record
+    also carries ``data_wait_pct`` — the residual share of wall time
+    the loop spends waiting on input with staging ON — which is the
+    number BENCH_r06+ tracks alongside img/s.
+    """
+    from mxnet_tpu import nd
+
+    batch = 128 if on_accel else 32
+    image = 64 if on_accel else 32
+    nsteps = 40 if on_accel else 12
+
+    pt, x, y = _tiny_cnn_trainer(batch, image)
+    # steady-state step time sets the synthetic producer's cost
+    for _ in range(3):
+        loss = pt.step(x, y)
+    loss.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        loss = pt.step(x, y)
+    loss.wait_to_read()
+    step_s = (time.perf_counter() - t0) / 5
+    produce_s = max(0.8 * step_s, 0.002)
+
+    def producer():
+        for _ in range(nsteps):
+            time.sleep(produce_s)     # decode/augment/IO stand-in
+            yield (x, y)
+
+    def run(staged):
+        it = pt.prefetch_iter(producer()) if staged \
+            else iter(producer())
+        wait = 0.0
+        loss = None
+        t_start = time.perf_counter()
+        while True:
+            t1 = time.perf_counter()
+            nxt = next(it, None)
+            wait += time.perf_counter() - t1
+            if nxt is None:
+                break
+            loss = pt.step(nxt[0], nxt[1])
+        if loss is not None:
+            loss.wait_to_read()
+        return wait, time.perf_counter() - t_start
+
+    wait_sync, total_sync = run(False)
+    wait_pre, total_pre = run(True)
+    overlap = 100.0 * (1.0 - wait_pre / wait_sync) if wait_sync else 0.0
+    from mxnet_tpu.config import get as _cfg
+    rec = {
+        'metric': 'input_pipeline_overlap_pct',
+        'value': round(overlap, 2),
+        'unit': '%',
+        # residual input wait with staging ON — the health number
+        'data_wait_pct': round(100.0 * wait_pre / total_pre, 2)
+        if total_pre else None,
+        'data_wait_pct_sync': round(100.0 * wait_sync / total_sync, 2)
+        if total_sync else None,
+        'steps_per_sec_sync': round(nsteps / total_sync, 2),
+        'steps_per_sec_prefetch': round(nsteps / total_pre, 2),
+        'produce_ms': round(produce_s * 1e3, 3),
+        'step_ms': round(step_s * 1e3, 3),
+        'prefetch_depth': int(_cfg('MXNET_TPU_PREFETCH') or 0),
+        'model': 'cnn-tiny bs%d %dpx' % (batch, image),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument('--out', default='BENCH.json',
@@ -548,6 +654,16 @@ def main(argv=None):
             error = '%s: %s' % (type(e).__name__, str(e)[:300])
             print('bench: telemetry A/B leg lost to a transient fault '
                   '(%s)' % error, flush=True)
+    if not handler.stop_requested:
+        try:
+            metrics.append(bench_input_overlap(on_accel))
+        except Exception as e:
+            if not (isinstance(e, InjectedFault) or is_transient(e)):
+                raise
+            verdict = 'degraded'
+            error = '%s: %s' % (type(e).__name__, str(e)[:300])
+            print('bench: input-overlap A/B leg lost to a transient '
+                  'fault (%s)' % error, flush=True)
 
     if handler.stop_requested:
         # preempted mid-bench: the legs already measured stay in the

@@ -261,6 +261,46 @@ KNOBS = {k.name: k for k in [
           ' while sequences are in flight: raises join throughput at'
           ' the cost of decode-step latency jitter. An idle engine'
           ' always admits up to every free slot.'),
+    # performance: roofline audit / vjp rescheduling / input prefetch
+    # (docs/PERFORMANCE.md)
+    _knob('MXNET_TPU_ROOFLINE_PEAK_TFLOPS', float, 197.0,
+          'Reference-chip peak (bf16 TFLOP/s) for the roofline audit'
+          ' classification (observability.roofline). Fixed reference'
+          ' (TPU v5e-class) by default so artifacts diff stably across'
+          ' hosts; set to the target chip when auditing for it.'),
+    _knob('MXNET_TPU_ROOFLINE_HBM_GBPS', float, 819.0,
+          'Reference-chip HBM bandwidth (GB/s) for the roofline ridge'
+          ' point (peak/bandwidth = flops-per-byte threshold between'
+          ' memory- and compute-bound fusions).'),
+    _knob('MXNET_TPU_FUSION_BUDGET_PCT', float, 2.0,
+          'Fusion-budget regression gate (tools/fusion_audit.py'
+          ' --gate): total HBM bytes/step may exceed the baseline'
+          ' artifact by at most this percentage before the CI stage'
+          ' fails. One-sided: improvements always pass.'),
+    _knob('MXNET_TPU_FUSION_BUDGET_COUNT', int, 0,
+          'Extra fusions (beyond the baseline count) the fusion-budget'
+          ' gate tolerates before failing.'),
+    _knob('MXNET_TPU_VJP_RESCHEDULE', bool, True,
+          'Use the hand-scheduled custom_vjp paths for the memory-'
+          'bound hot ops (Activation/LeakyReLU save-output backward,'
+          ' Dropout mask regeneration, softmax_cross_entropy one-pass'
+          ' gradient, max-Pooling unrolled equality-mask backward) in'
+          ' addition to the BatchNorm/LayerNorm cores. 0 falls back to'
+          ' plain autodiff everywhere (the A/B reference; flip it'
+          ' before the first trace — already-compiled eager programs'
+          ' are not invalidated).'),
+    _knob('MXNET_TPU_PREFETCH', int, 2,
+          'Host->device input staging depth for Module.fit /'
+          ' ParallelTrainer.prefetch_iter / DataLoader'
+          ' (io.DevicePrefetcher): a background thread pulls batches'
+          ' and issues the device transfer so data_wait overlaps the'
+          ' previous step\'s compute (double-buffered at the default'
+          ' 2). 0 disables staging (fully synchronous input path).'),
+    _knob('MXNET_TPU_PREFETCH_TIMEOUT_S', float, 30.0,
+          'How long a consumer waits on the staging thread before'
+          ' degrading to synchronous transfers (a hung staging thread'
+          ' — real or injected hang@io.prefetch — must never deadlock'
+          ' fit; pending batches are recovered, none are dropped).'),
     # preemption / elasticity / watchdog (docs/RESILIENCE.md)
     _knob('MXNET_TPU_PREEMPT_EXIT_CODE', int, 75,
           'Process exit code marking a preempted-but-resumable run'

@@ -304,10 +304,16 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False):
+                 thread_pool=False, device_prefetch=False):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._thread_pool = thread_pool
+        # host->device staging on top of the worker-pool decode
+        # prefetch: True uses the MXNET_TPU_PREFETCH depth, an int sets
+        # it explicitly (docs/PERFORMANCE.md). The workers overlap
+        # DECODE with the step; this additionally overlaps the
+        # device transfer, so data_wait is a queue pop.
+        self._device_prefetch = device_prefetch
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError('batch_size must be specified unless '
@@ -402,15 +408,24 @@ class DataLoader:
                                              for idx in batch])
                     yield _as_nd(ret) if not isinstance(ret, (NDArray, list)) \
                         else ret
-            return same_process_iter()
+            return self._maybe_stage(same_process_iter())
         from ...config import get as _cfg
-        return _MultiWorkerIter(
+        return self._maybe_stage(_MultiWorkerIter(
             self._worker_pool, self._batchify_fn, self._batch_sampler,
             pin_memory=self._pin_memory, prefetch=self._prefetch,
             dataset=self._dataset, loader=self,
             use_shm=not self._thread_pool,
             max_restarts=_cfg('MXNET_TPU_WORKER_RESTARTS'),
-            task_timeout=_cfg('MXNET_TPU_WORKER_TIMEOUT_S'))
+            task_timeout=_cfg('MXNET_TPU_WORKER_TIMEOUT_S')))
+
+    def _maybe_stage(self, it):
+        if not self._device_prefetch:
+            return it
+        from ...io.staging import DevicePrefetcher
+        depth = None if self._device_prefetch is True \
+            else int(self._device_prefetch)
+        return DevicePrefetcher(it, depth=depth,
+                                name='dataloader-prefetch')
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -3,3 +3,5 @@ from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, LibSVMIter, MNISTIter,
                  ImageRecordIter, ImageRecordIter_v1, ImageDetRecordIter,
                  MXDataIter)
+from .staging import (DevicePrefetcher, default_placer, prefetch_depth,
+                      wrap_iterator)

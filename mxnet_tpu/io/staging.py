@@ -1,0 +1,300 @@
+"""Double-buffered host→device input staging (docs/PERFORMANCE.md).
+
+The fit loop's ``data_wait`` phase serializes three things with the
+step: pulling the next batch from the iterator (decode/augment/batch
+assembly on the host), converting it, and issuing the host→device
+transfer. All three are independent of the step the device is
+currently executing — :class:`DevicePrefetcher` moves them onto a
+background thread with a bounded queue, so while step ``k`` runs on
+the device, batch ``k+1`` is already decoded AND its DMA is in
+flight. The consumer's ``data_wait`` collapses to a queue pop
+(double-buffered at the default ``MXNET_TPU_PREFETCH=2``).
+
+Degradation contract (gated by the fault tier, ``hang@io.prefetch``):
+if the staging thread stops making progress — a real wedge in the
+transfer, or the scripted hang — the consumer times out after
+``MXNET_TPU_PREFETCH_TIMEOUT_S``, recovers every batch the thread had
+pulled (queued staged batches first, then the un-staged pending one),
+and continues *synchronously* on the source iterator. No deadlock, no
+dropped batch, no duplicate: training results are bit-identical to
+the synchronous path, only slower. A consumer never takes over while
+the thread is inside ``next(source)`` — a stuck *source* is the
+DataLoader worker-timeout's problem, and two threads pulling one
+iterator would corrupt batch order.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+__all__ = ['DevicePrefetcher', 'default_placer', 'prefetch_depth',
+           'wrap_iterator']
+
+_SITE = 'io.prefetch'
+
+
+def prefetch_depth(depth=None):
+    """Resolve the staging depth: explicit arg > MXNET_TPU_PREFETCH."""
+    if depth is not None:
+        return max(0, int(depth))
+    from ..config import get as _cfg
+    return max(0, int(_cfg('MXNET_TPU_PREFETCH') or 0))
+
+
+def _stage_leaves(obj):
+    """Stage the array leaves of a batch container onto the default
+    device: NDArray leaves get their buffer re-issued through
+    ``jax.device_put`` (async dispatch — the DMA overlaps the caller),
+    numpy leaves become device NDArrays. Containers (list/tuple/dict,
+    DataBatch-shaped objects with ``.data``/``.label``) are rebuilt
+    around the staged leaves; everything else passes through."""
+    import jax
+    import numpy as onp
+    from ..ndarray import NDArray
+
+    if isinstance(obj, NDArray):
+        return NDArray(jax.device_put(obj._data))
+    if isinstance(obj, onp.ndarray):
+        from .. import ndarray as nd
+        return nd.array(obj, dtype=obj.dtype
+                        if obj.dtype != onp.float64 else 'float32')
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_stage_leaves(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _stage_leaves(v) for k, v in obj.items()}
+    if hasattr(obj, 'data') and hasattr(obj, 'label') and \
+            not isinstance(obj, type):
+        # DataBatch-shaped: stage in place-compatible copy (the batch
+        # object also carries pad/index bookkeeping — keep it)
+        obj.data = _stage_leaves(obj.data) if obj.data is not None \
+            else None
+        obj.label = _stage_leaves(obj.label) if obj.label is not None \
+            else None
+        return obj
+    return obj
+
+
+def default_placer(item):
+    """Default staging function: device-put every array leaf."""
+    return _stage_leaves(item)
+
+
+class DevicePrefetcher:
+    """Iterator wrapper staging batches device-side ahead of the
+    consumer (see module docstring for the overlap/degradation
+    contract).
+
+    Parameters
+    ----------
+    source : iterator/iterable of batches
+    placer : callable(batch) -> staged batch (default: device-put all
+        array leaves). Runs ON THE STAGING THREAD; it must not touch
+        shared mutable state.
+    depth : queue depth (None -> MXNET_TPU_PREFETCH; 0 = passthrough)
+    timeout_s : consumer wait before degrading to synchronous mode
+        (None -> MXNET_TPU_PREFETCH_TIMEOUT_S; 0 disables degradation)
+    """
+
+    def __init__(self, source, placer=None, depth=None, timeout_s=None,
+                 name='prefetch'):
+        self._src = iter(source)
+        self._place = placer or default_placer
+        self._depth = prefetch_depth(depth)
+        if timeout_s is None:
+            from ..config import get as _cfg
+            timeout_s = float(_cfg('MXNET_TPU_PREFETCH_TIMEOUT_S') or 0)
+        self._timeout = float(timeout_s)
+        self._name = name
+        self._cv = threading.Condition()
+        self._buf = collections.deque()
+        self._pending = None          # pulled but not yet staged
+        self._state = 'idle'          # idle | pulling | staging
+        self._gen = 0
+        self._stop = False
+        self._done = False
+        self._error = None
+        self.degraded = False
+        self._recovered = collections.deque()
+        self._never = threading.Event()    # parks a simulated hang
+        self._thread = None
+        if self._depth > 0:
+            self._thread = threading.Thread(
+                target=self._run, args=(self._gen,),
+                name='mxnet-tpu-%s' % name, daemon=True)
+            self._thread.start()
+
+    # -- staging thread ----------------------------------------------------
+
+    def _run(self, gen):
+        from ..resilience.policy import HangError, inject
+        src = self._src
+        while True:
+            with self._cv:
+                while len(self._buf) >= self._depth and \
+                        self._gen == gen and not self._stop:
+                    self._cv.wait(0.2)
+                if self._gen != gen or self._stop:
+                    return
+                self._state = 'pulling'
+            try:
+                item = next(src)
+            except StopIteration:
+                with self._cv:
+                    self._state = 'idle'
+                    self._done = True
+                    self._cv.notify_all()
+                return
+            except BaseException as exc:
+                with self._cv:
+                    self._state = 'idle'
+                    self._error = exc
+                    self._done = True
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                if self._gen != gen:
+                    # takeover landed mid-pull: hand the item over
+                    self._recovered.append(item)
+                    self._cv.notify_all()
+                    return
+                self._pending = item
+                self._state = 'staging'
+            hung = False
+            try:
+                # scripted-fault site: hang@io.prefetch simulates the
+                # staging thread wedging AFTER the pull — the pending
+                # batch stays recoverable, exactly like a real stuck
+                # device_put
+                inject(_SITE, ('hang',))
+                staged = self._place(item)
+            except HangError:
+                hung = True
+            except BaseException as exc:
+                with self._cv:
+                    self._error = exc
+                    self._done = True
+                    self._pending = None
+                    self._recovered.append(item)
+                    self._cv.notify_all()
+                return
+            if hung:
+                # park forever WITHOUT clearing pending: the consumer's
+                # timeout path recovers it (a daemon thread, so exit is
+                # not blocked)
+                self._never.wait()
+                return
+            with self._cv:
+                if self._gen != gen:
+                    # consumer degraded while we staged; it recovers
+                    # the raw pending item itself — drop our copy
+                    self._cv.notify_all()
+                    return
+                self._pending = None
+                self._state = 'idle'
+                self._buf.append(staged)
+                self._cv.notify_all()
+
+    # -- consumer ----------------------------------------------------------
+
+    def _degrade_locked(self, reason):
+        """Take over from the staging thread (caller holds the cv).
+        Queued staged batches stay in ``_buf`` (served first), the
+        thread's pending raw batch moves to ``_recovered``; the source
+        iterator is only touched synchronously from now on."""
+        self._gen += 1
+        self.degraded = True
+        if self._pending is not None:
+            self._recovered.append(self._pending)
+            self._pending = None
+        self._cv.notify_all()
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.counter(
+                    'mxnet_tpu_prefetch_degraded_total',
+                    help='DevicePrefetcher degradations to synchronous '
+                         'transfer (staging thread stalled)').inc()
+                _obs.record_event('prefetch_degraded', reason=reason,
+                                  name=self._name)
+        except Exception:
+            pass
+
+    def __next__(self):
+        if self._depth <= 0:
+            return self._place(next(self._src))
+        with self._cv:
+            if not self.degraded:
+                deadline = (time.monotonic() + self._timeout) \
+                    if self._timeout > 0 else None
+                while not self._buf and not self._done and \
+                        not self._stop:
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        if self._state == 'pulling':
+                            # the SOURCE is slow/stuck, not staging:
+                            # taking over would race the iterator —
+                            # keep waiting (same behavior the
+                            # synchronous path would have)
+                            deadline = time.monotonic() + self._timeout
+                        else:
+                            self._degrade_locked('stall')
+                            break
+                    wait = 0.2 if deadline is None else \
+                        min(0.2, max(deadline - time.monotonic(), 0.01))
+                    self._cv.wait(wait)
+            if self._buf:
+                item = self._buf.popleft()
+                self._cv.notify_all()
+                return item
+            if self._error is not None:
+                exc, self._error = self._error, None
+                self._done = True
+                raise exc
+            if self._done and not self._recovered:
+                raise StopIteration
+            # degraded: recovered raw batches first, then the source
+            if self._recovered:
+                raw = self._recovered.popleft()
+                return self._place(raw)
+        # degraded steady state: fully synchronous (outside the lock —
+        # nothing else touches the source once gen advanced)
+        return self._place(next(self._src))
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        return self.__next__()
+
+    def close(self):
+        """Stop the staging thread (idempotent). Batches it already
+        pulled remain in the queue/recovered deque and stay readable;
+        the underlying iterator is NOT exhausted further."""
+        with self._cv:
+            self._stop = True
+            self._gen += 1
+            if self._pending is not None:
+                self._recovered.append(self._pending)
+                self._pending = None
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=1.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def wrap_iterator(feed, depth=None, placer=None, name='prefetch'):
+    """Wrap ``feed`` in a DevicePrefetcher when staging is enabled
+    (depth > 0); return ``feed`` unchanged otherwise. The fit-loop
+    helper: callers hold on to the return value and ``close()`` it at
+    epoch boundaries when it is a prefetcher."""
+    depth = prefetch_depth(depth)
+    if depth <= 0:
+        return feed
+    return DevicePrefetcher(feed, placer=placer, depth=depth, name=name)

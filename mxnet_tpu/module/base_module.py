@@ -181,7 +181,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None, checkpoint_dir=None,
             checkpoint_every_n_steps=None, preempt=None,
-            guardrail=None, locate_nonfinite=False):
+            guardrail=None, locate_nonfinite=False, prefetch=None):
         """The training driver (reference: base_module.py:409).
 
         ``checkpoint_dir`` opts into crash-resumable training: each
@@ -221,6 +221,16 @@ class BaseModule:
         the checkpoints, and replays. ``locate_nonfinite=True``
         additionally re-runs the tripping batch through the monitored
         eager locator to name the first non-finite op in the report.
+
+        ``prefetch`` sets the host→device input staging depth
+        (default: the ``MXNET_TPU_PREFETCH`` knob, 2): a background
+        thread pulls and device-stages batches so the ``data_wait``
+        span overlaps the previous step's compute instead of
+        serializing with it (docs/PERFORMANCE.md). 0 keeps the fully
+        synchronous input path. A stalled staging thread degrades to
+        synchronous transfers after ``MXNET_TPU_PREFETCH_TIMEOUT_S``
+        with every pulled batch recovered — results are identical
+        either way, so resume/rollback bit-exactness is unaffected.
         """
         if num_epoch is None:
             raise AssertionError('please specify number of epochs')
@@ -314,12 +324,22 @@ class BaseModule:
                 # sampler fast-forward: replay the resumed epoch's
                 # already-consumed batches so the next one seen here is
                 # exactly the one the interrupted run would have seen
-                # (deterministic iterator order is the contract)
+                # (deterministic iterator order is the contract).
+                # Runs on the RAW iterator — staging would device_put
+                # thousands of batches that are immediately discarded
                 for _ in range(skip_batches):
                     if next(feed, _END) is _END:
                         break
                     nbatch += 1
                 skip_batches = 0
+            # input staging (docs/PERFORMANCE.md): decode + host→device
+            # transfer of batch k+1 overlap step k; data_wait below
+            # becomes a queue pop. Closed at every epoch/rollback exit
+            # so reset() never races the staging thread.
+            from ..io import staging as _staging
+            feed = _staging.wrap_iterator(feed, depth=prefetch,
+                                          name='fit-prefetch')
+            _close_feed = getattr(feed, 'close', lambda: None)
             with _obs.span('data_wait'):
                 batch = next(feed, _END)
             if batch is _END:
@@ -343,6 +363,7 @@ class BaseModule:
                     for name, val in res:
                         self.logger.info('Epoch[%d] Validation-%s=%f',
                                          epoch, name, val)
+                _close_feed()
                 train_data.reset()
                 epoch += 1
                 continue
@@ -423,6 +444,7 @@ class BaseModule:
                     batch = nxt
                     nbatch += 1
             except GuardrailTripped as trip:
+                _close_feed()
                 epoch = self._guard_rollback(trip, guard, ckpt_mgr,
                                              train_data,
                                              locate_nonfinite)
@@ -452,6 +474,7 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info('Epoch[%d] Validation-%s=%f', epoch,
                                      name, val)
+            _close_feed()
             train_data.reset()
             epoch += 1
 

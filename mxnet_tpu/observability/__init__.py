@@ -34,6 +34,7 @@ from . import metrics
 from . import export
 from . import hlo
 from . import recorder
+from . import roofline
 from . import spans
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       counter, gauge, histogram, get_registry,
@@ -43,12 +44,15 @@ from .recorder import (FLIGHT_SCHEMA, FlightRecorder, get_recorder,
                        install_excepthook, read_flight)
 from .spans import PHASES, span
 from .hlo import collective_bytes, trainer_collective_stats
+from .roofline import (roofline_artifact, diff_artifacts as
+                       diff_fusion_artifacts)
 from .export import (prometheus_text, write_prometheus, write_jsonl,
                      tensorboard_export, PrometheusServer,
                      maybe_start_http_server, parse_prometheus)
 
 __all__ = [
-    'metrics', 'recorder', 'spans', 'export', 'hlo',
+    'metrics', 'recorder', 'spans', 'export', 'hlo', 'roofline',
+    'roofline_artifact', 'diff_fusion_artifacts',
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'counter',
     'gauge', 'histogram', 'get_registry', 'enabled', 'set_enabled',
     'snapshot', 'FLIGHT_SCHEMA', 'FlightRecorder', 'get_recorder',

@@ -10,6 +10,11 @@ built ``ParallelTrainer``'s compiled step and lands the totals in the
 Pure text analysis — nothing here executes or recompiles device code
 beyond the one ``lower().compile()`` XLA already caches for a built
 program; still, drivers call it once per program, not per step.
+
+The full per-fusion roofline accounting (bytes vs flops per compiled
+fusion, ``mxnet_tpu.fusion.v1`` artifact) lives in
+:mod:`mxnet_tpu.observability.roofline`, which builds on the
+instruction iterator here.
 """
 from __future__ import annotations
 
@@ -17,13 +22,87 @@ import re
 
 from . import metrics as _metrics
 
-__all__ = ['COLLECTIVES', 'collective_bytes', 'trainer_collective_stats']
+__all__ = ['COLLECTIVES', 'collective_bytes', 'trainer_collective_stats',
+           'iter_instruction_lines', 'shape_bytes']
 
 COLLECTIVES = ('all-reduce', 'all-gather', 'reduce-scatter',
                'collective-permute', 'all-to-all')
-_DTYPE_BYTES = {'f64': 8, 'f32': 4, 'bf16': 2, 'f16': 2, 's64': 8,
-                's32': 4, 'u32': 4, 's16': 2, 'u16': 2, 's8': 1,
-                'u8': 1, 'pred': 1}
+DTYPE_BYTES = {'f64': 8, 'f32': 4, 'bf16': 2, 'f16': 2, 's64': 8,
+               's32': 4, 'u64': 8, 'u32': 4, 's16': 2, 'u16': 2,
+               's8': 1, 'u8': 1, 'pred': 1, 'f8e5m2': 1, 'f8e4m3fn': 1,
+               'c64': 8, 'c128': 16}
+_DTYPE_BYTES = DTYPE_BYTES            # backwards-compatible alias
+
+_SHAPE_RE = re.compile(r'(\w+)\[([\d,\s]*)\](?:\{[^}]*\})?')
+
+
+def shape_bytes(type_text):
+    """Total bytes of every array shape mentioned in ``type_text``
+    (handles tuple types like ``(f32[8]{0}, u8[]{:...})`` by summing
+    the elements; unknown dtypes count zero)."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(type_text):
+        if dt not in DTYPE_BYTES:
+            continue
+        count = 1
+        for d in dims.replace(' ', '').split(','):
+            if d:
+                count *= int(d)
+        total += count * DTYPE_BYTES[dt]
+    return total
+
+
+def iter_instruction_lines(hlo_text):
+    """Yield complete instruction/header lines of an HLO text dump,
+    re-joining instructions that printers wrap across lines.
+
+    HLO text printers (and humans pasting captures) sometimes break one
+    instruction over several physical lines; an instruction is complete
+    only when its parentheses balance. Computation headers (ending in
+    ``{``) and closing braces pass through as-is.
+    """
+    buf = ''
+    for raw in hlo_text.splitlines():
+        line = raw.rstrip()
+        if not line.strip():
+            continue
+        if not buf and line.lstrip().startswith('HloModule'):
+            # module header: own line, whether or not it carries
+            # balanced attr braces — never merge it into a buffer
+            yield line
+            continue
+        buf = (buf + ' ' + line.strip()) if buf else line
+        stripped = buf.strip()
+        if stripped.endswith('{') or stripped == '}':
+            yield buf
+            buf = ''
+            continue
+        # an instruction is complete when parens balance AND it has at
+        # least one (header/brace lines were handled above)
+        if buf.count('(') == buf.count(')') and '=' in buf:
+            yield buf
+            buf = ''
+    if buf:
+        yield buf
+
+
+def _instruction_opcode(line, opcodes):
+    """Find the first ``opcode(`` occurrence from ``opcodes`` on an
+    instruction line, returning ``(opcode, start_index)`` or None.
+
+    Robust to tuple-typed results — ``%x = ((f32[8]{0}, u8[]{:...}))
+    all-gather-done(...)`` — where a naive "type is one token" regex
+    mis-splits the line and drops the instruction silently."""
+    eq = line.find('=')
+    if eq < 0:
+        return None
+    rest = line[eq + 1:]
+    m = re.search(
+        r'\b((?:%s)(?:-start|-done)?(?:\.\d+)?)\('
+        % '|'.join(re.escape(c) for c in opcodes), rest)
+    if not m:
+        return None
+    return m.group(1), eq + 1 + m.start()
 
 
 def collective_bytes(hlo_text):
@@ -32,29 +111,24 @@ def collective_bytes(hlo_text):
     Returns ``(total_bytes, {op_kind: bytes})``. Async pairs
     (``all-reduce-start`` / ``-done``) count once: the ``-start`` op's
     tuple output would double-count the one logical collective, so only
-    the ``-done`` (or sync) form is summed."""
+    the ``-done`` (or sync) form is summed. Tolerates tuple-typed
+    results (async-done ops returning ``((f32[...], u8[...]))``) and
+    instructions wrapped across physical lines."""
     total = 0
     per_kind = {}
-    for line in hlo_text.splitlines():
-        m = re.search(r'=\s+((?:\([^)]*\)|\S+))\s+(%?[\w-]+)\(', line)
-        if not m:
+    for line in iter_instruction_lines(hlo_text):
+        found = _instruction_opcode(line, COLLECTIVES)
+        if found is None:
             continue
-        kind = m.group(2).lstrip('%')
+        kind, pos = found
         base = kind.rstrip('.0123456789')
-        if not any(base.startswith(c) for c in COLLECTIVES):
-            continue
         if base.endswith('-start'):
             continue
-        shapes = re.findall(r'(\w+)\[([\d,]*)\]', m.group(1))
-        nbytes = 0
-        for dt, dims in shapes:
-            if dt not in _DTYPE_BYTES:
-                continue
-            count = 1
-            for d in dims.split(','):
-                if d:
-                    count *= int(d)
-            nbytes += count * _DTYPE_BYTES[dt]
+        base = base[:-5] if base.endswith('-done') else base
+        # type text = everything between '=' and the opcode; for a
+        # '-done' op the result type IS the logical collective's output
+        eq = line.find('=')
+        nbytes = shape_bytes(line[eq + 1:pos])
         total += nbytes
         per_kind[base] = per_kind.get(base, 0) + nbytes
     return total, per_kind

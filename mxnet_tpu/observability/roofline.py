@@ -1,0 +1,472 @@
+"""Per-fusion roofline accounting over a compiled program's HLO.
+
+What it answers: for every materializing instruction of an optimized
+XLA module (fusions, convolutions, dots, reduces, copies, ...), how
+many HBM bytes does it move, how many flops does it do, and which side
+of the machine's roofline does that put it on — memory-bound or
+compute-bound? The per-fusion rows attribute back to framework ops via
+the HLO ``metadata`` fields (op_name / source_file / source_line the
+JAX trace stamps on every instruction), so "the #1 byte-mover is the
+BatchNorm backward of stage3" is readable straight from the artifact.
+
+Accounting model (the "Operator Fusion in XLA" / FusionStitching view,
+PAPERS.md): values produced *inside* a fusion never touch HBM; every
+fusion/materializing-op reads its operands from HBM once and writes
+its results once. Total traffic is therefore the sum over material
+instructions of (deduped operand bytes + result bytes) — the same
+quantity XLA's own cost model calls ``bytes accessed``, but broken
+down per fusion and diffable as text.
+
+Like :mod:`.hlo` this is pure text analysis: nothing executes, nothing
+recompiles beyond the one ``lower().compile()`` XLA caches for a built
+program. Loop bodies (``while`` from ``lax.scan``) are counted once —
+the trip count is not recoverable from text; step programs built by
+``ParallelTrainer`` contain no data loops, so the numbers there are
+exact per-step.
+
+The artifact (``mxnet_tpu.fusion.v1``) is stable JSON so ``tools/
+fusion_audit.py`` can diff it across PRs and ``tools/ci.py`` can gate
+fusion-budget regressions (total HBM bytes/step and fusion count must
+not creep up silently).
+"""
+from __future__ import annotations
+
+import json
+import re
+
+from .hlo import (DTYPE_BYTES, collective_bytes, iter_instruction_lines,
+                  shape_bytes)
+
+__all__ = ['SCHEMA', 'Instruction', 'parse_module', 'analyze',
+           'roofline_artifact', 'diff_artifacts', 'format_table',
+           'reference_machine']
+
+SCHEMA = 'mxnet_tpu.fusion.v1'
+
+# opcodes that are views/bookkeeping: no HBM traffic of their own
+_FREE_OPCODES = frozenset((
+    'parameter', 'constant', 'get-tuple-element', 'tuple', 'bitcast',
+    'after-all', 'partition-id', 'replica-id', 'domain', 'opt-barrier',
+    'add-dependency', 'custom-call',
+))
+# control-flow opcodes whose cost lives in their called computations
+_CALLER_OPCODES = frozenset(('while', 'call', 'conditional', 'fusion'))
+
+# elementwise/transcendental opcodes that count one flop per output
+# element inside fusions (roofline cares about orders of magnitude,
+# not the exp-vs-add microcost split)
+_ELEMENTWISE = frozenset((
+    'add', 'subtract', 'multiply', 'divide', 'maximum', 'minimum',
+    'power', 'remainder', 'and', 'or', 'xor', 'not', 'negate', 'abs',
+    'exponential', 'exponential-minus-one', 'log', 'log-plus-one',
+    'rsqrt', 'sqrt', 'cbrt', 'tanh', 'sine', 'cosine', 'tan', 'atan2',
+    'logistic', 'sign', 'floor', 'ceil', 'round-nearest-afz',
+    'round-nearest-even', 'is-finite', 'compare', 'select', 'clamp',
+    'shift-left', 'shift-right-arithmetic', 'shift-right-logical',
+    'popcnt', 'clz', 'erf', 'expm1', 'log1p',
+))
+
+_SHAPE_WITH_NAME = re.compile(
+    r'(\w+)\[([\d,\s]*)\](?:\{[^}]*\})?\s+(%[\w.-]+)')
+_METADATA_RE = re.compile(r'metadata=\{([^}]*)\}')
+_META_FIELD = re.compile(r'(\w+)="?([^"\s]*)"?')
+_CALLS_RE = re.compile(
+    r'(?:calls|to_apply|body|condition)=%([\w.-]+)')
+_KIND_RE = re.compile(r'\bkind=(k\w+)')
+_WINDOW_SIZE_RE = re.compile(r'window=\{[^}]*size=([\dx]+)')
+_FGC_RE = re.compile(r'feature_group_count=(\d+)')
+_DIM_LABELS_RE = re.compile(r'dim_labels=([\w?]+)_([\w?]+)->([\w?]+)')
+_CONTRACT_RE = re.compile(r'lhs_contracting_dims=\{([\d,]*)\}')
+
+
+def _shape_elems(dims):
+    n = 1
+    for d in dims.replace(' ', '').split(','):
+        if d:
+            n *= int(d)
+    return n
+
+
+class Instruction:
+    """One parsed HLO instruction (text level)."""
+
+    __slots__ = ('name', 'opcode', 'result_type', 'operands', 'attrs',
+                 'op_name', 'source', 'called', 'kind', 'root')
+
+    def __init__(self, name, opcode, result_type, operands, attrs,
+                 op_name=None, source=None, called=(), kind=None,
+                 root=False):
+        self.name = name
+        self.opcode = opcode            # normalized (suffix stripped)
+        self.result_type = result_type  # raw type text (may be tuple)
+        self.operands = operands        # [(dtype, dims, name), ...]
+        self.attrs = attrs              # raw text after the operand list
+        self.op_name = op_name          # metadata op_name (or None)
+        self.source = source            # "file.py:line" (or None)
+        self.called = called            # called computation names
+        self.kind = kind                # fusion kind (kLoop/kOutput/...)
+        self.root = root
+
+    @property
+    def result_bytes(self):
+        return shape_bytes(self.result_type)
+
+    @property
+    def operand_bytes(self):
+        """Operand bytes, deduped by operand name (reading the same
+        buffer twice costs one HBM fetch in any sane cache model)."""
+        seen, total = set(), 0
+        for dt, dims, name in self.operands:
+            if name in seen or dt not in DTYPE_BYTES:
+                continue
+            seen.add(name)
+            total += _shape_elems(dims) * DTYPE_BYTES[dt]
+        return total
+
+
+_INSTR_HEAD = re.compile(r'^\s*(ROOT\s+)?%?([\w.-]+)\s*=\s*')
+
+
+def _parse_instruction(line):
+    m = _INSTR_HEAD.match(line)
+    if not m:
+        return None
+    root, name = bool(m.group(1)), m.group(2)
+    rest = line[m.end():]
+    # result type: balanced-paren group for tuples, else one token
+    if rest.startswith('('):
+        depth, i = 0, 0
+        for i, ch in enumerate(rest):
+            depth += (ch == '(') - (ch == ')')
+            if depth == 0:
+                break
+        result_type, rest = rest[:i + 1], rest[i + 1:]
+    else:
+        sp = rest.find(' ')
+        if sp < 0:
+            return None
+        result_type, rest = rest[:sp], rest[sp:]
+    om = re.match(r'\s*([\w-]+(?:\.\d+)?)\(', rest)
+    if not om:
+        return None
+    opcode = re.sub(r'\.\d+$', '', om.group(1))
+    # operand list: balanced parens from the opcode's '('
+    start = om.end() - 1
+    depth, i = 0, start
+    for i in range(start, len(rest)):
+        depth += (rest[i] == '(') - (rest[i] == ')')
+        if depth == 0:
+            break
+    operand_text, attrs = rest[start:i + 1], rest[i + 1:]
+    operands = [(dt, dims, nm) for dt, dims, nm in
+                _SHAPE_WITH_NAME.findall(operand_text)]
+    op_name = source = None
+    mm = _METADATA_RE.search(attrs)
+    if mm:
+        fields = dict(_META_FIELD.findall(mm.group(1)))
+        op_name = fields.get('op_name')
+        sf, sl = fields.get('source_file'), fields.get('source_line')
+        if sf:
+            source = '%s:%s' % (sf.rsplit('/', 1)[-1], sl or '?')
+    km = _KIND_RE.search(attrs)
+    return Instruction(
+        name, opcode, result_type, operands, attrs, op_name=op_name,
+        source=source, called=tuple(_CALLS_RE.findall(attrs)),
+        kind=km.group(1) if km else None, root=root)
+
+
+_COMP_HEAD = re.compile(r'^\s*(ENTRY\s+)?%?([\w.$-]+)\s*\(')
+
+
+def parse_module(hlo_text):
+    """Parse HLO text into ``(computations, entry_name)`` where
+    ``computations`` maps name -> [Instruction, ...]."""
+    comps = {}
+    entry = None
+    current = None
+    for line in iter_instruction_lines(hlo_text):
+        stripped = line.strip()
+        if stripped == '}' or stripped.startswith('HloModule'):
+            continue
+        if stripped.endswith('{'):
+            m = _COMP_HEAD.match(stripped)
+            if m:
+                current = m.group(2)
+                comps[current] = []
+                if m.group(1):
+                    entry = current
+            continue
+        if current is None:
+            continue
+        instr = _parse_instruction(line)
+        if instr is not None:
+            comps[current].append(instr)
+    if entry is None and comps:       # headerless fragment: last wins
+        entry = next(reversed(comps))
+    return comps, entry
+
+
+# -- flop model -------------------------------------------------------------
+
+
+def _result_elems(instr):
+    total = 0
+    for dt, dims in re.findall(r'(\w+)\[([\d,\s]*)\]',
+                               instr.result_type):
+        if dt in DTYPE_BYTES:
+            total += _shape_elems(dims)
+    return total
+
+
+def _dot_flops(instr):
+    out = _result_elems(instr)
+    k = 1
+    cm = _CONTRACT_RE.search(instr.attrs)
+    if cm and instr.operands:
+        lhs_dims = instr.operands[0][1].replace(' ', '').split(',')
+        for idx in cm.group(1).split(','):
+            if idx and int(idx) < len(lhs_dims) and lhs_dims[int(idx)]:
+                k *= int(lhs_dims[int(idx)])
+    return 2 * out * k
+
+
+def _conv_flops(instr):
+    out = _result_elems(instr)
+    ksp = 1
+    wm = _WINDOW_SIZE_RE.search(instr.attrs)
+    if wm:
+        for d in wm.group(1).split('x'):
+            ksp *= int(d)
+    cin = 1
+    dm = _DIM_LABELS_RE.search(instr.attrs)
+    if dm and len(instr.operands) > 1:
+        rhs_labels = dm.group(2)
+        rhs_dims = instr.operands[1][1].replace(' ', '').split(',')
+        i_pos = rhs_labels.find('i')
+        if 0 <= i_pos < len(rhs_dims) and rhs_dims[i_pos]:
+            cin = int(rhs_dims[i_pos])   # already per-group channels
+    return 2 * out * ksp * cin
+
+
+def _operand_elems(instr, idx=0):
+    if idx < len(instr.operands):
+        return _shape_elems(instr.operands[idx][1])
+    return 0
+
+
+def _instr_flops(instr, comps, _depth=0):
+    """Approximate flop count of one instruction (recursing into
+    fusions/calls). Good to the roofline's order of magnitude."""
+    op = instr.opcode
+    if op == 'dot':
+        return _dot_flops(instr)
+    if op == 'convolution':
+        return _conv_flops(instr)
+    if op in ('reduce', 'reduce-window', 'select-and-scatter'):
+        return _operand_elems(instr, 0)
+    if op in _CALLER_OPCODES and _depth < 8:
+        total = 0
+        for cname in instr.called:
+            for sub in comps.get(cname, ()):
+                total += _instr_flops(sub, comps, _depth + 1)
+        return total
+    if op in _ELEMENTWISE:
+        return _result_elems(instr)
+    return 0
+
+
+# -- machine model ----------------------------------------------------------
+
+
+def reference_machine():
+    """Roofline machine parameters: a fixed REFERENCE chip so artifacts
+    are stable/diffable regardless of the host that ran the audit (the
+    audit usually runs on the CPU CI rig). Defaults are TPU v5e-class
+    (197 bf16 TFLOP/s, 819 GB/s HBM); override with
+    ``MXNET_TPU_ROOFLINE_PEAK_TFLOPS`` / ``MXNET_TPU_ROOFLINE_HBM_GBPS``.
+    """
+    from ..config import get as _cfg
+    peak = float(_cfg('MXNET_TPU_ROOFLINE_PEAK_TFLOPS')) * 1e12
+    hbm = float(_cfg('MXNET_TPU_ROOFLINE_HBM_GBPS')) * 1e9
+    return {'peak_flops_per_s': peak, 'hbm_bytes_per_s': hbm,
+            'ridge_flops_per_byte': peak / hbm}
+
+
+# -- analysis ---------------------------------------------------------------
+
+
+def _gather_ops(instr, comps, limit=6):
+    """Framework-op attribution for one row: the source lines (and
+    op_name tails) stamped on this instruction and — for fusions — on
+    the instructions of its fused computation."""
+    seen = []
+
+    def add(ins):
+        tag = None
+        if ins.source:
+            tail = (ins.op_name or '').rsplit('/', 1)[-1]
+            tag = '%s@%s' % (tail, ins.source) if tail else ins.source
+        elif ins.op_name:
+            tag = ins.op_name.rsplit('/', 1)[-1]
+        if tag and tag not in seen:
+            seen.append(tag)
+
+    add(instr)
+    for cname in instr.called:
+        for sub in comps.get(cname, ()):
+            add(sub)
+    return seen[:limit]
+
+
+def analyze(hlo_text, machine=None):
+    """Roofline rows for every material instruction reachable from the
+    entry computation. Returns ``(rows, totals)``; rows sorted by bytes
+    descending."""
+    comps, entry = parse_module(hlo_text)
+    machine = machine or reference_machine()
+    ridge = machine['ridge_flops_per_byte']
+    rows = []
+    totals = {'hbm_bytes_per_step': 0, 'flops_per_step': 0,
+              'fusion_count': 0, 'instruction_count': 0,
+              'memory_bound_bytes': 0, 'compute_bound_bytes': 0}
+    visited = set()
+
+    def walk(comp_name):
+        if comp_name in visited:
+            return
+        visited.add(comp_name)
+        for instr in comps.get(comp_name, ()):
+            if instr.opcode in _FREE_OPCODES:
+                continue
+            if instr.opcode in ('while', 'call', 'conditional'):
+                for cname in instr.called:
+                    walk(cname)
+                continue
+            nbytes = instr.result_bytes + instr.operand_bytes
+            flops = _instr_flops(instr, comps)
+            ai = flops / nbytes if nbytes else float('inf')
+            bound = 'compute' if ai >= ridge else 'memory'
+            totals['hbm_bytes_per_step'] += nbytes
+            totals['flops_per_step'] += flops
+            totals['instruction_count'] += 1
+            totals['%s_bound_bytes' % bound] += nbytes
+            if instr.opcode == 'fusion':
+                totals['fusion_count'] += 1
+            rows.append({
+                'name': instr.name,
+                'opcode': instr.opcode,
+                'kind': instr.kind,
+                'bytes': nbytes,
+                'flops': flops,
+                'ai': round(ai, 3) if nbytes else None,
+                'bound': bound,
+                'ops': _gather_ops(instr, comps),
+            })
+
+    if entry is not None:
+        walk(entry)
+    rows.sort(key=lambda r: r['bytes'], reverse=True)
+    total_b = totals['hbm_bytes_per_step'] or 1
+    for r in rows:
+        r['pct_bytes'] = round(100.0 * r['bytes'] / total_b, 2)
+    return rows, totals
+
+
+def roofline_artifact(hlo_text, program='unknown', machine=None,
+                      top=None, config=None):
+    """Build the stable ``mxnet_tpu.fusion.v1`` artifact dict for one
+    compiled program's optimized HLO text.
+
+    ``top`` truncates the per-fusion row list (totals always cover the
+    whole program); ``config`` is free-form provenance (batch size,
+    image size, ...) recorded verbatim so diffs can refuse to compare
+    apples to oranges.
+    """
+    machine = machine or reference_machine()
+    rows, totals = analyze(hlo_text, machine=machine)
+    coll_total, coll_kinds = collective_bytes(hlo_text)
+    totals['collective_bytes_per_step'] = coll_total
+    by_src = {}
+    for r in rows:
+        for tag in r['ops'][:1]:     # attribute to the leading op
+            by_src[tag] = by_src.get(tag, 0) + r['bytes']
+    top_ops = sorted(by_src.items(), key=lambda kv: -kv[1])[:10]
+    return {
+        'schema': SCHEMA,
+        'program': program,
+        'config': config or {},
+        'machine': machine,
+        'totals': totals,
+        'collectives': coll_kinds,
+        'top_ops_by_bytes': [
+            {'op': k, 'bytes': v} for k, v in top_ops],
+        'fusions': rows[:top] if top else rows,
+    }
+
+
+def diff_artifacts(base, new, bytes_tol_pct=2.0, count_tol=0):
+    """Fusion-budget regression check between two artifacts of the
+    SAME program. Returns a list of human-readable regression strings
+    (empty = within budget).
+
+    The gate is one-sided: getting better (fewer bytes, fewer fusions)
+    never fails. ``bytes_tol_pct`` allows jitter from compiler-version
+    noise; ``count_tol`` allows that many extra fusions.
+    """
+    problems = []
+    if base.get('schema') != SCHEMA or new.get('schema') != SCHEMA:
+        return ['schema mismatch: %r vs %r (want %s)'
+                % (base.get('schema'), new.get('schema'), SCHEMA)]
+    if base.get('program') != new.get('program'):
+        return ['program mismatch: %r vs %r — refusing to diff'
+                % (base.get('program'), new.get('program'))]
+    if base.get('config') != new.get('config'):
+        problems.append(
+            'config changed (%r -> %r): byte totals are not comparable'
+            % (base.get('config'), new.get('config')))
+        return problems
+    bt, nt = base['totals'], new['totals']
+    b_bytes, n_bytes = bt['hbm_bytes_per_step'], nt['hbm_bytes_per_step']
+    if b_bytes and n_bytes > b_bytes * (1.0 + bytes_tol_pct / 100.0):
+        problems.append(
+            'hbm_bytes_per_step regressed %.3g -> %.3g (+%.2f%% > '
+            '+%.2f%% budget)' % (b_bytes, n_bytes,
+                                 100.0 * (n_bytes / b_bytes - 1.0),
+                                 bytes_tol_pct))
+    b_fc, n_fc = bt['fusion_count'], nt['fusion_count']
+    if n_fc > b_fc + count_tol:
+        problems.append('fusion_count regressed %d -> %d (budget +%d)'
+                        % (b_fc, n_fc, count_tol))
+    b_coll = bt.get('collective_bytes_per_step', 0)
+    n_coll = nt.get('collective_bytes_per_step', 0)
+    if b_coll and n_coll > b_coll * (1.0 + bytes_tol_pct / 100.0):
+        problems.append(
+            'collective_bytes_per_step regressed %.3g -> %.3g'
+            % (b_coll, n_coll))
+    return problems
+
+
+def format_table(artifact, top=12):
+    """Human-readable audit table (the CLI's stdout view)."""
+    t = artifact['totals']
+    lines = [
+        'program: %s   config: %s' % (
+            artifact['program'],
+            json.dumps(artifact.get('config', {}), sort_keys=True)),
+        'HBM bytes/step: %.4g   flops/step: %.4g   fusions: %d   '
+        'instrs: %d' % (t['hbm_bytes_per_step'], t['flops_per_step'],
+                        t['fusion_count'], t['instruction_count']),
+        'memory-bound bytes: %.4g (%.1f%%)   ridge: %.1f flop/B' % (
+            t['memory_bound_bytes'],
+            100.0 * t['memory_bound_bytes']
+            / max(t['hbm_bytes_per_step'], 1),
+            artifact['machine']['ridge_flops_per_byte']),
+        '%-34s %5s %10s %10s %8s %7s' % ('fusion', 'bound', 'bytes',
+                                         'flops', 'AI', '%bytes'),
+    ]
+    for r in artifact['fusions'][:top]:
+        lines.append('%-34s %5s %10.3g %10.3g %8s %6.2f%%  %s' % (
+            r['name'][:34], r['bound'][:4], r['bytes'], r['flops'],
+            ('%.2f' % r['ai']) if r['ai'] is not None else 'inf',
+            r['pct_bytes'], ','.join(r['ops'][:2])))
+    return '\n'.join(lines)

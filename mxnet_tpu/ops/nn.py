@@ -172,6 +172,85 @@ def deconvolution(args, *, kernel=None, stride=None, dilate=None, pad=None,
 # ---------------------------------------------------------------------------
 
 
+def _max_pool_reduce(data, k, s, p):
+    """The shared forward reduce_window (identical on the rescheduled
+    and autodiff paths, so the knob never changes forward values)."""
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p)
+    return jax.lax.reduce_window(data, -jnp.inf, jax.lax.max, window,
+                                 strides, pads)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool_core(data, k, s, p):
+    """Max pooling with a hand-scheduled backward.
+
+    Autodiff of reduce_window-max lowers the gradient to
+    select-and-scatter — a windowed gather/scatter XLA schedules
+    poorly on TPU (it re-reads the input per window and serializes the
+    scatter). The rescheduled backward unrolls over the k window
+    offsets: for each offset, one strided slice of the (padded) input
+    compares against the pooled output (the "am I the max of my
+    window" mask) and the masked cotangent pads back with interior
+    dilation — prod(k) slice+compare+pad terms, all elementwise ops
+    XLA fuses, no scatter. Reference semantics (mshadow pool.h
+    backward): every position EQUAL to the window max receives the
+    gradient — identical to autodiff's select-and-scatter except on
+    exact ties, where autodiff picks one winner (docs/PERFORMANCE.md
+    records this as the documented tolerance).
+    """
+    return _max_pool_reduce(data, k, s, p)
+
+
+def _max_pool_core_fwd(data, k, s, p):
+    out = _max_pool_reduce(data, k, s, p)
+    return out, (data, out)
+
+
+def _max_pool_core_bwd(k, s, p, res, g):
+    data, out = res
+    ndim = len(k)
+    space = data.shape[2:]
+    osp = out.shape[2:]
+    xp = jax.lax.pad(
+        data, jnp.array(-jnp.inf, data.dtype),
+        [(0, 0, 0), (0, 0, 0)] + [(pp, pp, 0) for pp in p])
+    psp = xp.shape[2:]
+    zero = jnp.array(0, g.dtype)
+    dx_p = None
+    for flat in range(int(onp.prod(k))):
+        off, rem = [], flat
+        for kk in reversed(k):
+            off.append(rem % kk)
+            rem //= kk
+        off = tuple(reversed(off))
+        limits = tuple(off[i] + (osp[i] - 1) * s[i] + 1
+                       for i in range(ndim))
+        sl = jax.lax.slice(xp, (0, 0) + off,
+                           (data.shape[0], data.shape[1]) + limits,
+                           (1, 1) + s)
+        contrib = g * (sl == out).astype(g.dtype)
+        scattered = jax.lax.pad(
+            contrib, zero,
+            [(0, 0, 0), (0, 0, 0)]
+            + [(off[i], psp[i] - limits[i], s[i] - 1)
+               for i in range(ndim)])
+        dx_p = scattered if dx_p is None else dx_p + scattered
+    dx = jax.lax.slice(
+        dx_p, (0, 0) + tuple(p),
+        (data.shape[0], data.shape[1])
+        + tuple(p[i] + space[i] for i in range(ndim)))
+    return (dx.astype(data.dtype),)
+
+
+_max_pool_core.defvjp(_max_pool_core_fwd, _max_pool_core_bwd)
+
+# unrolling bound: beyond this many window offsets the unrolled
+# backward stops paying for itself (and bloats the program)
+_MAX_POOL_UNROLL = 64
+
+
 @register('Pooling', aliases=('Pooling_v1',))
 def pooling(data, *, kernel=None, pool_type='max', global_pool=False,
             cudnn_off=False, pooling_convention='valid', stride=None,
@@ -199,7 +278,13 @@ def pooling(data, *, kernel=None, pool_type='max', global_pool=False,
             extra.append((s[i] - rem) % s[i] if rem else 0)
         pads = ((0, 0), (0, 0)) + tuple((p[i], p[i] + extra[i]) for i in range(ndim))
     if pool_type == 'max':
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            if pooling_convention == 'valid' and _vjp_resched() and \
+                    1 < int(onp.prod(k)) <= _MAX_POOL_UNROLL:
+                return _max_pool_core(data, k, s, p)
+            init = -jnp.inf
+        else:
+            init = jnp.iinfo(data.dtype).min
         return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
     ssum = jax.lax.reduce_window(data, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
                                  jax.lax.add, window, strides, pads)
@@ -222,11 +307,118 @@ def pooling(data, *, kernel=None, pool_type='max', global_pool=False,
 
 # ---------------------------------------------------------------------------
 # Activations (reference: nn/activation.cc, leaky_relu.cc)
+#
+# vjp rescheduling (docs/PERFORMANCE.md): autodiff of an activation
+# saves its INPUT for the backward pass — but the input is a buffer the
+# producing conv/matmul already wrote, and threading it to the backward
+# kernel keeps a whole activation-sized tensor live through HBM. The
+# hand-scheduled cores below save the OUTPUT instead (which the next
+# layer holds anyway, so XLA's buffer assignment aliases it for free)
+# and derive the local gradient from it in closed form — the fusion
+# audit's "activation epilogue" fix. Gated by MXNET_TPU_VJP_RESCHEDULE;
+# ops without an output-only derivative (gelu, prelu) stay on autodiff.
 # ---------------------------------------------------------------------------
+
+
+def _vjp_resched():
+    """Hot-op vjp rescheduling gate (trace-time read; flipping the knob
+    does not invalidate already-compiled eager programs)."""
+    from ..config import get as _cfg
+    return bool(_cfg('MXNET_TPU_VJP_RESCHEDULE'))
+
+
+def _zero_cotangent(x):
+    """Symbolic-zero cotangent for a non-differentiable primal: float0
+    for integer/bool inputs (jax's typed zero), zeros_like otherwise."""
+    if jnp.issubdtype(x.dtype, jnp.floating) or \
+            jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return jnp.zeros_like(x)
+    return onp.zeros(onp.shape(x), dtype=jax.dtypes.float0)
+
+
+_SELU_ALPHA, _SELU_SCALE = 1.6732632423543772, 1.0507009873554805
+
+
+def _act_forward(data, act_type, slope):
+    """Shared forward math for the rescheduled and autodiff paths
+    (must stay expression-identical to the legacy implementations so
+    the knob never changes forward values)."""
+    fns = {'relu': jax.nn.relu, 'sigmoid': jax.nn.sigmoid,
+           'tanh': jnp.tanh, 'softrelu': jax.nn.softplus,
+           'softsign': jax.nn.soft_sign}
+    if act_type in fns:
+        return fns[act_type](data)
+    if act_type == 'leaky':
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == 'elu':
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == 'selu':
+        return _SELU_SCALE * jnp.where(data >= 0, data,
+                                       _SELU_ALPHA * jnp.expm1(data))
+    raise ValueError('unknown act_type %s' % act_type)
+
+
+def _act_grad_from_out(act_type, out, slope):
+    """d act/d x reconstructed from the OUTPUT alone. Valid because
+    each covered activation is monotone with sign(out) == sign(x):
+      relu      1[out > 0]
+      sigmoid   out (1 - out)
+      tanh      1 - out^2
+      softrelu  1 - exp(-out)          (= sigmoid(x); out >= 0)
+      softsign  (1 - |out|)^2          (= 1/(1+|x|)^2)
+      leaky     1[out >= 0] + slope 1[out < 0]      (needs slope > 0)
+      elu       1[out >= 0] + (out + slope) 1[out < 0]
+      selu      scale 1[out >= 0] + (out + scale alpha) 1[out < 0]
+    """
+    one = jnp.ones_like(out)
+    if act_type == 'relu':
+        return (out > 0).astype(out.dtype)
+    if act_type == 'sigmoid':
+        return out * (1 - out)
+    if act_type == 'tanh':
+        return 1 - out * out
+    if act_type == 'softrelu':
+        return 1 - jnp.exp(-out)
+    if act_type == 'softsign':
+        a = 1 - jnp.abs(out)
+        return a * a
+    if act_type == 'leaky':
+        return jnp.where(out >= 0, one, slope * one)
+    if act_type == 'elu':
+        return jnp.where(out >= 0, one, out + slope)
+    if act_type == 'selu':
+        return jnp.where(out >= 0, _SELU_SCALE * one,
+                         out + _SELU_SCALE * _SELU_ALPHA)
+    raise ValueError('unknown act_type %s' % act_type)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _act_core(data, act_type, slope):
+    return _act_forward(data, act_type, slope)
+
+
+def _act_core_fwd(data, act_type, slope):
+    out = _act_forward(data, act_type, slope)
+    return out, out       # residual = output ONLY (no input kept live)
+
+
+def _act_core_bwd(act_type, slope, out, g):
+    return ((g * _act_grad_from_out(act_type, out, slope))
+            .astype(out.dtype),)
+
+
+_act_core.defvjp(_act_core_fwd, _act_core_bwd)
+
+# exactly output-derivable activations; gelu keeps autodiff (no closed
+# form from out), prelu keeps autodiff (needs the gamma cotangent)
+_ACT_RESCHED = frozenset(('relu', 'sigmoid', 'tanh', 'softrelu',
+                          'softsign'))
 
 
 @register('Activation')
 def activation(data, *, act_type='relu'):
+    if act_type in _ACT_RESCHED and _vjp_resched():
+        return _act_core(data, act_type, 0.0)
     fns = {'relu': jax.nn.relu, 'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh,
            'softrelu': jax.nn.softplus, 'softsign': jax.nn.soft_sign,
            'gelu': lambda x: jax.nn.gelu(x, approximate=False)}
@@ -237,7 +429,13 @@ def activation(data, *, act_type='relu'):
 def leaky_relu(args, *, act_type='leaky', slope=0.25, lower_bound=0.125,
                upper_bound=0.334):
     data = args[0]
+    resched = _vjp_resched()
     if act_type == 'leaky' or act_type == 'rrelu':
+        # slope > 0 keeps sign(out) == sign(x), the invariant the
+        # output-only backward needs; slope == 0 degenerates to relu's
+        # rule but the reference allows it, so route it to autodiff
+        if resched and slope > 0:
+            return _act_core(data, 'leaky', float(slope))
         return jnp.where(data >= 0, data, slope * data)
     if act_type == 'prelu':
         gamma = args[1]
@@ -245,9 +443,15 @@ def leaky_relu(args, *, act_type='leaky', slope=0.25, lower_bound=0.125,
             gamma = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
         return jnp.where(data >= 0, data, gamma * data)
     if act_type == 'elu':
+        # same invariant as leaky: slope > 0 keeps sign(out)==sign(x);
+        # slope <= 0 (zero or inverted elu) must stay on autodiff
+        if resched and slope > 0:
+            return _act_core(data, 'elu', float(slope))
         return jnp.where(data >= 0, data, slope * jnp.expm1(data))
     if act_type == 'selu':
-        a, scale = 1.6732632423543772, 1.0507009873554805
+        if resched:
+            return _act_core(data, 'selu', 0.0)
+        a, scale = _SELU_ALPHA, _SELU_SCALE
         return scale * jnp.where(data >= 0, data, a * jnp.expm1(data))
     if act_type == 'gelu':
         return jax.nn.gelu(data, approximate=False)
@@ -398,12 +602,47 @@ def svm_output(data, label, *, margin=1.0, regularization_coefficient=1.0,
                        float(regularization_coefficient), bool(use_linear))
 
 
-@register('softmax_cross_entropy', num_inputs=2)
-def softmax_cross_entropy(data, label):
+def _sxe_forward(data, label):
     logp = jax.nn.log_softmax(data, axis=-1)
     lab = label.astype(jnp.int32)
     nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
-    return nll.sum()
+    return nll.sum(), logp
+
+
+@jax.custom_vjp
+def _softmax_xent_core(data, label):
+    """softmax_cross_entropy with the one-pass hand-scheduled vjp.
+
+    Autodiff threads the cotangent through take_along_axis (a scatter)
+    and the log_softmax chain — three activation-sized passes. The
+    closed form d loss/d logits = softmax(logits) - onehot(label) is
+    one elementwise kernel over the saved log-probabilities (which the
+    forward computed anyway), the same contract the reference's
+    softmax_output.cc backward hardcodes."""
+    return _sxe_forward(data, label)[0]
+
+
+def _sxe_fwd(data, label):
+    loss, logp = _sxe_forward(data, label)
+    return loss, (logp, label)
+
+
+def _sxe_bwd(res, g):
+    logp, label = res
+    lab = label.astype(jnp.int32)
+    grad = jnp.exp(logp) - jax.nn.one_hot(lab, logp.shape[-1],
+                                          dtype=logp.dtype)
+    return ((g * grad).astype(logp.dtype), _zero_cotangent(label))
+
+
+_softmax_xent_core.defvjp(_sxe_fwd, _sxe_bwd)
+
+
+@register('softmax_cross_entropy', num_inputs=2)
+def softmax_cross_entropy(data, label):
+    if _vjp_resched():
+        return _softmax_xent_core(data, label)
+    return _sxe_forward(data, label)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -633,6 +872,36 @@ def lrn(data, *, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
 # ---------------------------------------------------------------------------
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _dropout_core(key, data, keep, mask_shape):
+    """Dropout whose backward REGENERATES the mask from the key.
+
+    Autodiff keeps the bernoulli mask — a whole activation-sized
+    buffer — live from forward to backward through HBM. Threefry is
+    counter-based: replaying bernoulli(key) in the backward is
+    bit-identical to the saved mask at the cost of a few MXU-free
+    integer rounds, so the residual shrinks from O(activation) to one
+    32-bit key pair — recompute-over-store, the same trade
+    MXNET_BACKWARD_DO_MIRROR makes for whole layers."""
+    mask = jax.random.bernoulli(key, keep, mask_shape).astype(data.dtype)
+    return data * mask / keep
+
+
+def _dropout_core_fwd(key, data, keep, mask_shape):
+    out = _dropout_core(key, data, keep, mask_shape)
+    # residual: the key + an empty dtype tag (NOT the mask, NOT data)
+    return out, (key, jnp.zeros((0,), data.dtype))
+
+
+def _dropout_core_bwd(keep, mask_shape, res, g):
+    key, dtag = res
+    mask = jax.random.bernoulli(key, keep, mask_shape).astype(dtag.dtype)
+    return (_zero_cotangent(key), (g * mask / keep).astype(dtag.dtype))
+
+
+_dropout_core.defvjp(_dropout_core_fwd, _dropout_core_bwd)
+
+
 @register('Dropout', needs_rng=True)
 def dropout(key, data, *, p=0.5, mode='training', axes=None,
             cudnn_off=False, training=True):
@@ -640,11 +909,13 @@ def dropout(key, data, *, p=0.5, mode='training', axes=None,
         return data
     shape = data.shape
     if axes:
-        shape = tuple(data.shape[i] if i in tuple(axes) else data.shape[i]
+        # broadcast mask: full extent on the listed axes, 1 elsewhere
+        ax = {a % data.ndim for a in axes}
+        shape = tuple(data.shape[i] if i in ax else 1
                       for i in range(data.ndim))
-        shape = tuple(1 if i not in tuple(a % data.ndim for a in axes) else data.shape[i]
-                      for i in range(data.ndim)) if axes else data.shape
     keep = 1.0 - p
+    if _vjp_resched():
+        return _dropout_core(key, data, float(keep), tuple(shape))
     mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype)
     return data * mask / keep
 

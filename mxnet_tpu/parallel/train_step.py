@@ -152,6 +152,7 @@ class ParallelTrainer:
         self._ckpt_every = 0
         self._jitted_accum = {}
         self._jitted = None
+        self._data_shardings = None
         self._params = None
         self._param_arrays = None
         self._state_leaves = None
@@ -700,6 +701,47 @@ class ParallelTrainer:
         ys = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
               for a in _as_list(y)]
         return xs, ys
+
+    def prefetch_iter(self, batches, depth=None):
+        """Stage ``(x, y)`` batches onto this trainer's input shardings
+        ahead of :meth:`step` (docs/PERFORMANCE.md).
+
+        A background thread pulls from ``batches`` and issues the
+        host→device transfers under the compiled step's input
+        shardings, so the next batch's DMA overlaps the current step's
+        device compute; :meth:`step`'s own ``device_put`` then
+        short-circuits on the already-placed arrays. Batches pulled
+        before the first build (no shardings yet) pass through
+        unstaged. Returns a :class:`~mxnet_tpu.io.DevicePrefetcher`
+        (``close()`` it when abandoning the iterator mid-stream); a
+        stalled staging thread degrades to synchronous transfers
+        without dropping a batch.
+        """
+        from ..io.staging import DevicePrefetcher
+
+        def placer(item):
+            # _data_shardings lands LAST in _build: a None read here
+            # also covers the window where _jitted exists but the
+            # shardings do not yet (the staging thread races the
+            # first build)
+            shardings = self._data_shardings
+            if shardings is None:
+                return item
+            x, y = item
+            xs, ys = self._normalize(x, y)
+            live = [a for a in xs if a is not None]
+            data_sh, label_sh = shardings
+            xd = iter(jax.device_put(a, sh)
+                      for a, sh in zip(live, data_sh))
+            staged_x = [None if a is None else NDArray(next(xd))
+                        for a in xs]
+            staged_y = [NDArray(jax.device_put(a, sh))
+                        for a, sh in zip(ys, label_sh)]
+            return (staged_x if len(staged_x) > 1 else staged_x[0],
+                    staged_y if len(staged_y) > 1 else staged_y[0])
+
+        return DevicePrefetcher(batches, placer=placer, depth=depth,
+                                name='trainer-prefetch')
 
     def build(self, x, y):
         """Compile the step for these operand shapes without running it.

@@ -1,0 +1,264 @@
+"""Roofline audit + HLO text accounting (docs/PERFORMANCE.md).
+
+Covers the mxnet_tpu.fusion.v1 artifact pipeline (parse -> analyze ->
+artifact -> diff gate) and the hlo.collective_bytes fixes: tuple-typed
+async-done outputs and instructions wrapped across physical lines used
+to be dropped silently by the old one-token-type regex.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.observability import hlo, roofline
+
+
+# A real captured optimized-HLO fragment shape: sync collective,
+# async start/done pair (tuple-typed done), tuple-in-tuple done form,
+# and one instruction wrapped across three physical lines.
+_CAPTURED_HLO = '''
+HloModule jit_step, is_scheduled=true
+
+ENTRY %main.1 (Arg_0.1: f32[128,256], Arg_1.2: f32[16,256]) -> f32[128,256] {
+  %Arg_0.1 = f32[128,256]{1,0} parameter(0)
+  %Arg_1.2 = f32[16,256]{1,0} parameter(1)
+  %all-reduce.3 = f32[128,256]{1,0} all-reduce(f32[128,256]{1,0} %Arg_0.1), replica_groups={}, to_apply=%add.1, metadata={op_name="jit(step)/psum"}
+  %all-gather-start.4 = (f32[16,256]{1,0}, f32[128,256]{1,0}) all-gather-start(f32[16,256]{1,0} %Arg_1.2), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %all-gather-done.5 = f32[128,256]{1,0} all-gather-done((f32[16,256]{1,0}, f32[128,256]{1,0}) %all-gather-start.4)
+  %reduce-scatter-start.6 = ((f32[128,256]{1,0}, u8[4]{0})) reduce-scatter-start(f32[128,256]{1,0} %Arg_0.1), replica_groups={{0,1}}, dimensions={0}
+  %reduce-scatter-done.7 = ((f32[64,256]{1,0}, u8[4]{0})) reduce-scatter-done(((f32[128,256]{1,0}, u8[4]{0})) %reduce-scatter-start.6)
+  ROOT %collective-permute.8 = f32[128,256]{1,0} collective-permute(f32[128,256]{1,0} %all-gather-done.5),
+      source_target_pairs={{0,1},{1,0}},
+      metadata={op_name="jit(step)/ppermute" source_file="/root/repo/mxnet_tpu/parallel/train_step.py" source_line=1}
+}
+'''
+
+
+def test_collective_bytes_tuple_and_multiline_forms():
+    total, per_kind = hlo.collective_bytes(_CAPTURED_HLO)
+    f = 128 * 256 * 4
+    # sync all-reduce counts its output once
+    assert per_kind['all-reduce'] == f
+    # async all-gather: only the -done side counts, with the full
+    # gathered output (the -start's tuple would double-count)
+    assert per_kind['all-gather'] == f
+    # tuple-in-tuple reduce-scatter-done: array element + the u8[4]
+    # context buffer of the done wrapper
+    assert per_kind['reduce-scatter'] == 64 * 256 * 4 + 4
+    # the three-physical-line collective-permute is NOT dropped
+    assert per_kind['collective-permute'] == f
+    assert total == sum(per_kind.values())
+
+
+def test_collective_bytes_on_real_dp_program():
+    """End-to-end: a dp=2 compiled step's gradient all-reduce is seen
+    (the librarified bench_scaling measurement still works after the
+    parser rewrite)."""
+    import jax
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation='relu'), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    mesh = parallel.create_mesh({'dp': 2}, devices=jax.devices()[:2])
+    pt = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1}, mesh)
+    x = nd.array(np.random.randn(8, 8).astype('float32'))
+    y = nd.array(np.random.randint(0, 4, (8,)).astype('float32'))
+    pt.build(x, y)
+    total, per_kind = hlo.collective_bytes(pt.compiled_text())
+    assert total > 0
+    assert any(k.startswith('all-reduce') for k in per_kind)
+
+
+def test_iter_instruction_lines_joins_wrapped_instructions():
+    text = ('%a = f32[4]{0} add(f32[4]{0} %x,\n'
+            '    f32[4]{0} %y), metadata={op_name="m"}\n'
+            '%b = f32[4]{0} multiply(f32[4]{0} %a, f32[4]{0} %a)\n')
+    lines = list(hlo.iter_instruction_lines(text))
+    assert len(lines) == 2
+    assert 'add' in lines[0] and '%y' in lines[0]
+
+
+# -- flop/byte model on crafted instructions --------------------------------
+
+_CRAFTED = '''
+HloModule m
+
+%fused_computation.1 (p0: f32[64,128], p1: f32[64,128]) -> f32[64,128] {
+  %p0 = f32[64,128]{1,0} parameter(0)
+  %p1 = f32[64,128]{1,0} parameter(1)
+  %add.1 = f32[64,128]{1,0} add(f32[64,128]{1,0} %p0, f32[64,128]{1,0} %p1)
+  ROOT %tanh.1 = f32[64,128]{1,0} tanh(f32[64,128]{1,0} %add.1)
+}
+
+ENTRY %main.9 (a: f32[64,256], b: f32[256,128], c: f32[64,128]) -> f32[64,128] {
+  %a = f32[64,256]{1,0} parameter(0)
+  %b = f32[256,128]{1,0} parameter(1)
+  %c = f32[64,128]{1,0} parameter(2)
+  %dot.1 = f32[64,128]{1,0} dot(f32[64,256]{1,0} %a, f32[256,128]{1,0} %b), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(f)/dot_general" source_file="/x/ops/nn.py" source_line=37}
+  ROOT %fusion.1 = f32[64,128]{1,0} fusion(f32[64,128]{1,0} %dot.1, f32[64,128]{1,0} %c), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(f)/tanh" source_file="/x/ops/nn.py" source_line=99}
+}
+'''
+
+
+def test_analyze_flop_and_byte_model():
+    rows, totals = roofline.analyze(_CRAFTED)
+    by_name = {r['name']: r for r in rows}
+    dot = by_name['dot.1']
+    # 2*M*N*K
+    assert dot['flops'] == 2 * 64 * 128 * 256
+    # operands (64x256 + 256x128) + result (64x128), f32
+    assert dot['bytes'] == (64 * 256 + 256 * 128 + 64 * 128) * 4
+    fus = by_name['fusion.1']
+    # two elementwise instrs over 64x128 inside the fused computation
+    assert fus['flops'] == 2 * 64 * 128
+    assert fus['bytes'] == 3 * 64 * 128 * 4
+    assert fus['kind'] == 'kLoop'
+    assert totals['fusion_count'] == 1
+    assert totals['instruction_count'] == 2
+    assert totals['hbm_bytes_per_step'] == dot['bytes'] + fus['bytes']
+    # dot AI = 2*256/( (256+128+... )) well above elementwise; the
+    # fusion is memory-bound, classification must say so
+    assert fus['bound'] == 'memory'
+    # attribution reaches through metadata incl. the fused computation
+    assert any('nn.py' in t for t in fus['ops'])
+
+
+def test_roofline_artifact_schema_and_diff_gate():
+    art = roofline.roofline_artifact(_CRAFTED, program='crafted',
+                                     config={'n': 1})
+    assert art['schema'] == 'mxnet_tpu.fusion.v1'
+    for key in ('program', 'config', 'machine', 'totals',
+                'collectives', 'top_ops_by_bytes', 'fusions'):
+        assert key in art, key
+    t = art['totals']
+    assert t['hbm_bytes_per_step'] > 0
+    assert t['collective_bytes_per_step'] == 0
+    assert art['machine']['ridge_flops_per_byte'] > 0
+    # identical artifacts: no regression
+    assert roofline.diff_artifacts(art, art) == []
+    # +10% bytes: trips the default 2% budget
+    import copy
+    worse = copy.deepcopy(art)
+    worse['totals']['hbm_bytes_per_step'] = \
+        int(t['hbm_bytes_per_step'] * 1.1)
+    probs = roofline.diff_artifacts(art, worse)
+    assert probs and 'hbm_bytes_per_step' in probs[0]
+    # improvements never fail (one-sided gate)
+    assert roofline.diff_artifacts(worse, art) == []
+    # extra fusion trips the count budget
+    worse2 = copy.deepcopy(art)
+    worse2['totals']['fusion_count'] += 1
+    assert any('fusion_count' in p
+               for p in roofline.diff_artifacts(art, worse2))
+    # config mismatch refuses to compare
+    other = copy.deepcopy(art)
+    other['config'] = {'n': 2}
+    assert any('config' in p
+               for p in roofline.diff_artifacts(art, other))
+    # program mismatch refuses to compare
+    other2 = copy.deepcopy(art)
+    other2['program'] = 'something-else'
+    assert any('mismatch' in p
+               for p in roofline.diff_artifacts(art, other2))
+    # the table formatter covers every row field
+    table = roofline.format_table(art)
+    assert 'crafted' in table and 'bytes' in table
+
+
+def test_roofline_on_compiled_step_program():
+    """End-to-end on a real compiled fused step: fusions found, bytes
+    accounted, artifact totals self-consistent."""
+    import jax
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, activation='relu'),
+                nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+    pt = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1}, mesh)
+    x = nd.array(np.random.randn(4, 3, 8, 8).astype('float32'))
+    y = nd.array(np.random.randint(0, 4, (4,)).astype('float32'))
+    pt.build(x, y)
+    art = roofline.roofline_artifact(pt.compiled_text(),
+                                     program='cnn-tiny',
+                                     config={'batch': 4})
+    t = art['totals']
+    assert t['fusion_count'] > 0
+    assert t['hbm_bytes_per_step'] > 0
+    assert t['flops_per_step'] > 0
+    # a conv appears and carries the conv flop model
+    convs = [r for r in art['fusions'] if r['opcode'] == 'convolution']
+    assert convs and all(r['flops'] > 0 for r in convs)
+    # rows' bytes sum to the total (rows are untruncated here)
+    assert sum(r['bytes'] for r in art['fusions']) == \
+        t['hbm_bytes_per_step']
+    # pct_bytes sums to ~100
+    assert abs(sum(r['pct_bytes'] for r in art['fusions']) - 100.0) < 1.5
+
+
+def test_fusion_audit_hlo_file_mode(tmp_path):
+    """tools/fusion_audit.py --hlo audits a captured dump and writes
+    the combined artifact + baseline; the gate passes against itself
+    and fails against a doctored regression."""
+    import json
+    import subprocess
+    import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    dump = tmp_path / 'step.hlo.txt'
+    dump.write_text(_CRAFTED)
+    out = tmp_path / 'F.json'
+    base = tmp_path / 'BASE.json'
+    r = subprocess.run(
+        [sys.executable, 'tools/fusion_audit.py', '--hlo', str(dump),
+         '--out', str(out), '--write-baseline', str(base)],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    combined = json.loads(out.read_text())
+    assert combined['schema'] == 'mxnet_tpu.fusion.v1'
+    assert 'step.hlo.txt' in combined['programs']
+    # gate: identical run passes
+    r = subprocess.run(
+        [sys.executable, 'tools/fusion_audit.py', '--hlo', str(dump),
+         '--out', str(out), '--baseline', str(base), '--gate'],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # doctored baseline with fewer bytes -> current run regresses
+    doctored = json.loads(base.read_text())
+    prog = doctored['programs']['step.hlo.txt']
+    prog['totals']['hbm_bytes_per_step'] = \
+        int(prog['totals']['hbm_bytes_per_step'] * 0.5)
+    base.write_text(json.dumps(doctored))
+    r = subprocess.run(
+        [sys.executable, 'tools/fusion_audit.py', '--hlo', str(dump),
+         '--out', str(out), '--baseline', str(base), '--gate'],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert 'REGRESSION' in r.stdout
+    # --gate with a MISSING baseline must fail loudly, not stay green
+    r = subprocess.run(
+        [sys.executable, 'tools/fusion_audit.py', '--hlo', str(dump),
+         '--out', str(out), '--baseline', str(tmp_path / 'nope.json'),
+         '--gate'],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    # without --gate the missing baseline only skips the diff
+    r = subprocess.run(
+        [sys.executable, 'tools/fusion_audit.py', '--hlo', str(dump),
+         '--out', str(out), '--baseline', str(tmp_path / 'nope.json')],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,238 @@
+"""DevicePrefetcher: double-buffered host->device input staging
+(docs/PERFORMANCE.md) — order/completeness, the hang-degradation
+contract (no deadlock, no dropped or duplicated batch), and the
+Module.fit / ParallelTrainer / DataLoader integrations.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, nd
+from mxnet_tpu.io.staging import DevicePrefetcher, wrap_iterator
+
+
+@pytest.fixture
+def clean_knobs():
+    yield
+    for k in ('MXNET_TPU_FAULT', 'MXNET_TPU_PREFETCH',
+              'MXNET_TPU_PREFETCH_TIMEOUT_S'):
+        config.unset(k)
+
+
+def test_order_and_completeness():
+    pf = DevicePrefetcher(iter(range(50)), placer=lambda x: x * 10,
+                          depth=3)
+    assert list(pf) == [i * 10 for i in range(50)]
+    assert not pf.degraded
+
+
+def test_depth_zero_is_synchronous_passthrough():
+    pf = DevicePrefetcher(iter(range(5)), placer=lambda x: x + 1,
+                          depth=0)
+    assert pf._thread is None
+    assert list(pf) == [1, 2, 3, 4, 5]
+
+
+def test_default_depth_from_knob(clean_knobs):
+    config.set('MXNET_TPU_PREFETCH', 5)
+    pf = DevicePrefetcher(iter(range(3)), placer=lambda x: x)
+    assert pf._depth == 5
+    assert list(pf) == [0, 1, 2]
+
+
+def test_injected_hang_degrades_without_loss(clean_knobs):
+    """hang@io.prefetch wedges the staging thread AFTER it pulled a
+    batch: the consumer must time out, recover that pending batch, and
+    finish the stream synchronously — same items, same order."""
+    config.set('MXNET_TPU_FAULT', 'hang@io.prefetch:1')
+    config.set('MXNET_TPU_PREFETCH_TIMEOUT_S', 0.4)
+    pf = DevicePrefetcher(iter(range(12)), placer=lambda x: x + 100,
+                          depth=2)
+    t0 = time.monotonic()
+    out = list(pf)
+    assert out == [i + 100 for i in range(12)]
+    assert pf.degraded
+    # one timeout, not one per batch
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_source_exception_propagates():
+    def bad():
+        yield 1
+        yield 2
+        raise ValueError('boom')
+    pf = DevicePrefetcher(bad(), placer=lambda x: x, depth=2)
+    got = []
+    with pytest.raises(ValueError, match='boom'):
+        for v in pf:
+            got.append(v)
+    assert got == [1, 2]
+
+
+def test_placer_exception_propagates_after_drain():
+    calls = []
+
+    def placer(x):
+        if x == 3:
+            raise RuntimeError('stage-fail')
+        calls.append(x)
+        return x
+    pf = DevicePrefetcher(iter(range(6)), placer=placer, depth=1)
+    got = []
+    with pytest.raises(RuntimeError, match='stage-fail'):
+        for v in pf:
+            got.append(v)
+    assert got == [0, 1, 2]
+
+
+def test_close_is_idempotent_and_stops_thread():
+    pf = DevicePrefetcher(iter(range(1000)), placer=lambda x: x,
+                          depth=2)
+    next(pf)
+    pf.close()
+    pf.close()
+    t = pf._thread
+    assert t is not None and not t.is_alive()
+
+
+def test_wrap_iterator_respects_disable(clean_knobs):
+    config.set('MXNET_TPU_PREFETCH', 0)
+    src = iter(range(3))
+    assert wrap_iterator(src) is src
+    config.set('MXNET_TPU_PREFETCH', 2)
+    wrapped = wrap_iterator(iter(range(3)))
+    assert isinstance(wrapped, DevicePrefetcher)
+    assert list(wrapped) == [0, 1, 2]
+
+
+def test_default_placer_stages_ndarray_and_batches():
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.io.staging import default_placer
+    a = nd.array(np.arange(6, dtype='float32').reshape(2, 3))
+    batch = DataBatch(data=[a], label=[a + 1])
+    staged = default_placer(batch)
+    assert isinstance(staged.data[0], nd.NDArray)
+    assert (staged.data[0].asnumpy() == a.asnumpy()).all()
+    assert (staged.label[0].asnumpy() == (a + 1).asnumpy()).all()
+
+
+def test_module_fit_prefetch_bit_identical(clean_knobs):
+    """fit with staging on == staging off, params bit-for-bit (the
+    epoch-boundary close + reset never races or drops a batch)."""
+    from mxnet_tpu import io as mio
+
+    def run(prefetch):
+        mx.random.seed(0)
+        np.random.seed(0)
+        X = np.random.RandomState(1).randn(48, 8).astype('float32')
+        Y = np.random.RandomState(2).randint(0, 4, (48,)) \
+            .astype('float32')
+        it = mio.NDArrayIter(X, Y, batch_size=8,
+                             label_name='sm_label')
+        d = mx.sym.Variable('data')
+        net = mx.sym.FullyConnected(d, num_hidden=16, name='fc1')
+        net = mx.sym.Activation(net, act_type='relu')
+        net = mx.sym.FullyConnected(net, num_hidden=4, name='fc2')
+        net = mx.sym.SoftmaxOutput(net, name='sm')
+        mod = mx.mod.Module(net, label_names=('sm_label',))
+        mod.fit(it, num_epoch=2,
+                optimizer_params=(('learning_rate', 0.1),),
+                prefetch=prefetch)
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    p_on, p_off = run(2), run(0)
+    assert set(p_on) == set(p_off)
+    for k in p_on:
+        assert (p_on[k] == p_off[k]).all(), k
+
+
+def test_parallel_trainer_prefetch_iter_bit_identical():
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    def build():
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation='relu'), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        mesh = parallel.create_mesh({'dp': 1},
+                                    devices=jax.devices()[:1])
+        return parallel.ParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+            {'learning_rate': 0.1}, mesh)
+
+    rs = np.random.RandomState(0)
+    batches = [(nd.array(rs.randn(8, 8).astype('float32')),
+                nd.array(rs.randint(0, 4, (8,)).astype('float32')))
+               for _ in range(5)]
+    pt1 = build()
+    ref = [float(pt1.step(x, y).asnumpy()) for x, y in batches]
+    pt2 = build()
+    got = [float(pt2.step(x, y).asnumpy())
+           for x, y in pt2.prefetch_iter(iter(batches))]
+    assert ref == got
+    for a, b in zip(pt1._param_arrays, pt2._param_arrays):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_parallel_trainer_prefetch_places_on_input_shardings():
+    """After the first build, staged batches arrive committed under
+    the step's input shardings, so step()'s device_put short-circuits."""
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    mesh = parallel.create_mesh({'dp': 2}, devices=jax.devices()[:2])
+    pt = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1}, mesh)
+    rs = np.random.RandomState(0)
+    batches = [(nd.array(rs.randn(8, 8).astype('float32')),
+                nd.array(rs.randint(0, 4, (8,)).astype('float32')))
+               for _ in range(3)]
+    pt.step(*batches[0])               # build first: shardings exist
+    it = pt.prefetch_iter(iter(batches[1:]), depth=1)
+    x1, y1 = next(it)
+    assert x1._data.sharding == pt._data_shardings[0][0]
+    assert y1._data.sharding == pt._data_shardings[1][0]
+    pt.step(x1, y1)
+    it.close()
+
+
+def test_dataloader_device_prefetch(clean_knobs):
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.arange(40, dtype='float32').reshape(10, 4)
+    Y = np.arange(10, dtype='float32')
+    ds = ArrayDataset(nd.array(X), nd.array(Y))
+    plain = DataLoader(ds, batch_size=2)
+    staged = DataLoader(ds, batch_size=2, device_prefetch=True)
+    for (xa, ya), (xb, yb) in zip(plain, staged):
+        assert (xa.asnumpy() == xb.asnumpy()).all()
+        assert (ya.asnumpy() == yb.asnumpy()).all()
+    # epochs re-wrap cleanly
+    assert len(list(staged)) == 5
+
+
+def test_dataiter_device_prefetch_helper():
+    from mxnet_tpu import io as mio
+    X = np.random.RandomState(0).randn(12, 3).astype('float32')
+    it = mio.NDArrayIter(X, np.zeros(12, 'float32'), batch_size=4)
+    ref = [b.data[0].asnumpy() for b in it]
+    it.reset()
+    staged = it.device_prefetch(depth=2)
+    got = [b.data[0].asnumpy() for b in staged]
+    assert len(ref) == len(got)
+    for a, b in zip(ref, got):
+        assert (a == b).all()

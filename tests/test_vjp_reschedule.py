@@ -1,0 +1,240 @@
+"""Bit-identity of the hand-scheduled (rescheduled) vjps vs plain
+autodiff (docs/PERFORMANCE.md "vjp rescheduling policy").
+
+Contract: flipping MXNET_TPU_VJP_RESCHEDULE must never change forward
+values (the forward math is shared expression-for-expression), and the
+hand-written backward must match the autodiff reference bit-for-bit
+for the piecewise-linear ops (relu / leaky / max-pool on tie-free
+data / dropout / elu at these inputs) and to one-ULP tolerance for
+the transcendental ones (tanh / softplus / softsign / selu /
+softmax_cross_entropy), where the closed-form-from-output expression
+legitimately rounds differently than the chain-rule expression.
+
+Also covered: the rescheduled ops inside the guardrail's scaled-loss +
+sentinel + cond-guarded compiled step, and an 8-device virtual-mesh
+lockstep check (every replica must take the same branchless path).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, nd
+from mxnet_tpu.ops import nn as nn_ops
+
+EXACT = 0.0
+ULP = 5e-7      # one-two float32 ULPs on O(1) values
+
+
+@pytest.fixture
+def knob():
+    """Restore the vjp-reschedule knob after each A/B test."""
+    yield
+    config.unset('MXNET_TPU_VJP_RESCHEDULE')
+
+
+def _ab(fn, *args):
+    """(value, grads) with the rescheduled path vs plain autodiff."""
+    config.set('MXNET_TPU_VJP_RESCHEDULE', True)
+    v1, g1 = jax.jit(jax.value_and_grad(fn))(*args)
+    config.set('MXNET_TPU_VJP_RESCHEDULE', False)
+    v2, g2 = jax.jit(jax.value_and_grad(fn))(*args)
+    return (np.asarray(v1), np.asarray(g1)), (np.asarray(v2),
+                                              np.asarray(g2))
+
+
+def _check(fn, *args, tol=EXACT):
+    (v1, g1), (v2, g2) = _ab(fn, *args)
+    assert (v1 == v2).all(), 'forward changed with the knob'
+    if tol == EXACT:
+        assert (g1 == g2).all(), \
+            'grad not bit-identical (max delta %r)' % \
+            float(np.abs(g1 - g2).max())
+    else:
+        np.testing.assert_allclose(g1, g2, rtol=tol, atol=tol)
+
+
+_X = jnp.asarray(np.random.RandomState(0).randn(8, 16)
+                 .astype('float32'))
+
+
+@pytest.mark.parametrize('act,tol', [
+    ('relu', EXACT), ('sigmoid', EXACT), ('tanh', ULP),
+    ('softrelu', ULP), ('softsign', ULP)])
+def test_activation_bit_identity(knob, act, tol):
+    _check(lambda d: nn_ops.activation(d, act_type=act).sum(), _X,
+           tol=tol)
+
+
+@pytest.mark.parametrize('act,tol', [
+    ('leaky', EXACT), ('elu', ULP), ('selu', ULP)])
+def test_leaky_relu_bit_identity(knob, act, tol):
+    _check(lambda d: nn_ops.leaky_relu([d], act_type=act,
+                                       slope=0.25).sum(), _X, tol=tol)
+
+
+@pytest.mark.parametrize('act', ['leaky', 'elu'])
+def test_nonpositive_slope_stays_on_autodiff(knob, act):
+    """slope <= 0 breaks the sign(out) == sign(x) invariant the
+    output-only backward needs (elu slope=0: x<0 -> out=0 -> the
+    out>=0 branch would claim gradient 1 where the truth is 0) —
+    those configs must route to plain autodiff, bit-identical with
+    the knob on or off."""
+    for slope in (0.0, -0.5):
+        _check(lambda d, s=slope: nn_ops.leaky_relu(
+            [d], act_type=act, slope=s).sum(), _X)
+
+
+def test_max_pool_bit_identity_tie_free(knob):
+    # a permutation has no ties, so "gradient to every max" (the
+    # rescheduled/reference semantics) coincides with autodiff's
+    # select-and-scatter single winner — bit-identical
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.permutation(2 * 4 * 9 * 9).astype('float32')
+                    .reshape(2, 4, 9, 9) / 7.0)
+    for kernel, stride, pad in (((3, 3), (2, 2), (1, 1)),
+                                ((2, 2), (2, 2), (0, 0)),
+                                ((3, 3), (1, 1), (0, 0))):
+        _check(lambda d, k=kernel, s=stride, p=pad: nn_ops.pooling(
+            d, kernel=k, pool_type='max', stride=s, pad=p).sum(), x)
+
+
+def test_max_pool_ties_documented_divergence(knob):
+    """On exact ties the paths differ BY DESIGN (the documented
+    tolerance, docs/PERFORMANCE.md): the rescheduled backward gives
+    every position equal to the window max the full cotangent — the
+    reference mshadow pool.h semantics — while autodiff's
+    select-and-scatter picks exactly one winner per window."""
+    x = jnp.ones((1, 1, 4, 4), jnp.float32)
+    fn = lambda d: nn_ops.pooling(d, kernel=(2, 2), pool_type='max',
+                                  stride=(2, 2)).sum()
+    (_, g1), (_, g2) = _ab(fn, x)
+    # rescheduled: all 16 tied positions receive the gradient
+    assert g1.sum() == 16.0 and (g1 == 1.0).all()
+    # autodiff: one winner per 2x2 window
+    assert g2.sum() == 4.0
+
+
+def test_dropout_bit_identity(knob):
+    key = jax.random.PRNGKey(7)
+    _check(lambda d: nn_ops.dropout(key, d, p=0.4).sum(), _X)
+    _check(lambda d: nn_ops.dropout(key, d, p=0.4, axes=(1,)).sum(),
+           _X)
+
+
+def test_dropout_backward_regenerates_not_stores(knob):
+    """The rescheduled dropout's residual is the KEY, not the mask: the
+    vjp jaxpr must contain its own bernoulli-mask regeneration (a
+    threefry op in the backward), proving no activation-sized buffer
+    threads from forward to backward."""
+    config.set('MXNET_TPU_VJP_RESCHEDULE', True)
+    key = jax.random.PRNGKey(3)
+    out, pullback = jax.vjp(
+        lambda d: nn_ops.dropout(key, d, p=0.5), _X)
+    bwd_jaxpr = jax.make_jaxpr(pullback)(jnp.ones_like(out))
+    text = str(bwd_jaxpr)
+    assert 'threefry' in text or 'random_bits' in text or \
+        'bit_generator' in text, \
+        'backward does not regenerate the mask:\n%s' % text[:800]
+
+
+def test_softmax_cross_entropy_bit_identity(knob):
+    rs = np.random.RandomState(2)
+    logits = jnp.asarray(rs.randn(8, 10).astype('float32'))
+    lab = jnp.asarray(rs.randint(0, 10, (8,)).astype('float32'))
+    _check(lambda d: nn_ops.softmax_cross_entropy(d, lab), logits,
+           tol=ULP)
+
+
+def _build_guarded_trainer(guard, devs=1):
+    """conv + BN + relu + max-pool + dropout + dense: every newly
+    rescheduled family in one net, compiled under the mesh."""
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+                nn.Activation('relu'), nn.MaxPool2D(2),
+                nn.Dropout(0.3), nn.Flatten(), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    mesh = parallel.create_mesh({'dp': devs},
+                                devices=jax.devices()[:devs])
+    pt = parallel.ParallelTrainer(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1, 'momentum': 0.9}, mesh,
+        guardrail=guard)
+    return pt
+
+
+def _steps(pt, n=3, batch=8):
+    rs = np.random.RandomState(3)
+    losses = []
+    for _ in range(n):
+        x = nd.array(rs.randn(batch, 3, 8, 8).astype('float32'))
+        y = nd.array(rs.randint(0, 4, (batch,)).astype('float32'))
+        losses.append(float(pt.step(x, y).asnumpy()))
+    return losses, [np.asarray(w) for w in pt._param_arrays]
+
+
+def test_rescheduled_ops_under_guardrail_step(knob):
+    """The rescheduled vjps inside the guarded compiled step (scaled
+    loss * sentinel * cond-guarded update): knob on vs off must
+    produce identical losses and final params — relu/max-pool/dropout
+    are exactly equal and the guardrail contract (bit-exact when idle)
+    composes with them."""
+    from mxnet_tpu.guardrail import Guardrail, GuardrailConfig
+    from mxnet_tpu.resilience import FaultInjector
+
+    results = {}
+    for on in (True, False):
+        config.set('MXNET_TPU_VJP_RESCHEDULE', on)
+        guard = Guardrail(GuardrailConfig(check_every=0),
+                          injector=FaultInjector(''))
+        pt = _build_guarded_trainer(guard)
+        results[on] = _steps(pt)
+        guard.flush()
+    losses_on, params_on = results[True]
+    losses_off, params_off = results[False]
+    assert losses_on == losses_off
+    for a, b in zip(params_on, params_off):
+        assert (a == b).all()
+
+
+def test_rescheduled_ops_eight_device_lockstep(knob):
+    """8-dev virtual-mesh lockstep: the rescheduled backward kernels
+    are branchless per-element (no host-dependent control flow), so a
+    dp=8 step over the same GLOBAL batch must track the dp=1 step to
+    reduction-order (fp32) tolerance and keep params replicated."""
+    config.set('MXNET_TPU_VJP_RESCHEDULE', True)
+    losses1, params1 = _steps(_build_guarded_trainer(False, devs=1),
+                              n=2, batch=16)
+    losses8, params8 = _steps(_build_guarded_trainer(False, devs=8),
+                              n=2, batch=16)
+    np.testing.assert_allclose(losses1, losses8, rtol=2e-5, atol=2e-5)
+    for a, b in zip(params1, params8):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_forward_values_unchanged_by_knob_whole_net(knob):
+    """Whole-model forward (eval mode, no autodiff involved) is
+    untouched by the knob — the cores share the forward expression."""
+    from mxnet_tpu.gluon import nn
+    outs = {}
+    for on in (True, False):
+        config.set('MXNET_TPU_VJP_RESCHEDULE', on)
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(4, 3, padding=1, activation='relu'),
+                    nn.MaxPool2D(2), nn.Flatten(), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        x = nd.array(np.random.RandomState(5)
+                     .randn(2, 3, 8, 8).astype('float32'))
+        outs[on] = net(x).asnumpy()
+    assert (outs[True] == outs[False]).all()

@@ -28,6 +28,13 @@ Stages, each timed:
                            fault tier above also asserts injected
                            stall/preempt runs dump parseable
                            mxnet_tpu.flight.v1 artifacts
+  3b. fusion-audit         tools/fusion_audit.py --quick --gate — the
+                           per-fusion roofline audit of the ResNet-50
+                           and BERT step programs diffed against
+                           FUSION_BASELINE.json: HBM bytes/step and
+                           fusion count must not regress beyond the
+                           MXNET_TPU_FUSION_BUDGET_* knobs
+                           (docs/PERFORMANCE.md)
   4. serving               python -m mxnet_tpu.serving — inference-
                            engine selftest (batched == single-request
                            bit-identity, bounded recompiles, frozen
@@ -102,6 +109,15 @@ def main(argv=None):
         # typed backpressure, batcher flush/FIFO contract, HTTP
         # endpoint. The fault tier above already gated the serving
         # hang / device-loss degraded paths (fault_smoke checks 7-8).
+        # per-fusion roofline audit of the ResNet-50 + BERT step
+        # programs, diffed against the committed baseline: total HBM
+        # bytes/step and fusion count must not regress beyond the
+        # MXNET_TPU_FUSION_BUDGET_* knobs (docs/PERFORMANCE.md). The
+        # artifact also carries the memory-vs-compute-bound split the
+        # vjp-rescheduling work is held accountable to.
+        ('fusion-audit', [py, 'tools/fusion_audit.py', '--quick',
+                          '--baseline', 'FUSION_BASELINE.json',
+                          '--gate', '--out', '/tmp/FUSION.json']),
         ('serving', [py, '-m', 'mxnet_tpu.serving',
                      '--out', '/tmp/SERVE_SELFTEST.json']),
         # closed-loop latency/throughput sweep over the bucket ladder

@@ -52,6 +52,12 @@ tail event matches the fault site (preempt_exit@9 / stall@3).
      degraded on the CPU fallback with bit-identical tokens
      (status=degraded, breaker=open, zero mismatches).
 
+  10. Prefetch hang (docs/PERFORMANCE.md): with
+     MXNET_TPU_FAULT=hang@io.prefetch:1 the input-staging thread of
+     Module.fit wedges mid-stage; fit must degrade to synchronous
+     transfers (recovering the pending batch) and finish with params
+     bit-identical to a staging-off run — never deadlock.
+
 Usage: python tools/fault_smoke.py [--skip-tests]
 (--skip-tests runs only the subprocess contract checks; ci.py's fast
 tier already ran the test files, so the gate uses it to avoid double
@@ -523,6 +529,79 @@ def run_decode_hang():
         return True
 
 
+_PREFETCH_SCRIPT = r'''
+import hashlib, json
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+
+def run(prefetch):
+    mx.random.seed(0); np.random.seed(0)
+    X = np.random.RandomState(1).randn(48, 8).astype("float32")
+    Y = np.random.RandomState(2).randint(0, 4, (48,)).astype("float32")
+    it = mio.NDArrayIter(X, Y, batch_size=8, label_name="sm_label")
+    d = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="sm")
+    mod = mx.mod.Module(net, label_names=("sm_label",))
+    mod.fit(it, num_epoch=2,
+            optimizer_params=(("learning_rate", 0.1),),
+            prefetch=prefetch)
+    h = hashlib.sha256()
+    params = mod.get_params()[0]
+    for k in sorted(params):
+        h.update(params[k].asnumpy().tobytes())
+    return h.hexdigest()
+
+ref = run(0)       # staging off: the site never fires, fault unspent
+faulted = run(2)   # staging on: hang@io.prefetch:1 wedges the thread
+from mxnet_tpu import observability as obs
+fam = obs.snapshot().get("mxnet_tpu_prefetch_degraded_total")
+deg = fam["series"][0]["value"] if fam and fam["series"] else 0
+print(json.dumps({"match": ref == faulted, "degraded": deg}))
+'''
+
+
+def run_prefetch_hang():
+    """Check 10: injected hang in the input-staging thread
+    (hang@io.prefetch) must degrade Module.fit to synchronous
+    transfers — completing with params BIT-IDENTICAL to the
+    staging-off run (no batch dropped or duplicated) — instead of
+    deadlocking fit (docs/PERFORMANCE.md)."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               MXNET_TPU_FAULT='hang@io.prefetch:1',
+               MXNET_TPU_PREFETCH_TIMEOUT_S='1')
+    r = subprocess.run([sys.executable, '-c', _PREFETCH_SCRIPT],
+                       cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=300)
+    if r.returncode != 0:
+        print('FAIL: prefetch hang smoke exited %d (deadlock or '
+              'crash)\nstdout:\n%s\nstderr:\n%s'
+              % (r.returncode, r.stdout[-2000:], r.stderr[-2000:]))
+        return False
+    try:
+        v = json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print('FAIL: prefetch hang smoke wrote no verdict JSON:\n%s'
+              % r.stdout[-2000:])
+        return False
+    problems = []
+    if not v.get('match'):
+        problems.append('degraded-prefetch params differ from the '
+                        'synchronous run (batch dropped/duplicated?)')
+    if not v.get('degraded'):
+        problems.append('staging never degraded — the injected hang '
+                        'did not reach the staging thread')
+    if problems:
+        print('FAIL: ' + '; '.join(problems))
+        return False
+    print('prefetch hang: staging degraded to synchronous transfer, '
+          'params bit-identical to the unstaged run')
+    return True
+
+
 def run_resilience_tests():
     r = subprocess.run(
         [sys.executable, '-m', 'pytest', 'tests/test_resilience.py',
@@ -544,6 +623,7 @@ def main(argv=None):
     ok = run_serving_hang() and ok
     ok = run_serving_device_loss() and ok
     ok = run_decode_hang() and ok
+    ok = run_prefetch_hang() and ok
     print('fault_smoke: %s' % ('OK' if ok else 'FAIL'))
     return 0 if ok else 1
 

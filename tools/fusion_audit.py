@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""HLO fusion/roofline audit over the reference step programs.
+
+Builds the ResNet-50 and BERT fused training-step programs (the same
+``ParallelTrainer`` programs bench.py times), runs the per-fusion
+roofline analysis (``mxnet_tpu.observability.roofline``) over their
+optimized HLO, and writes one ``mxnet_tpu.fusion.v1`` artifact per
+program — bytes moved vs flops per fusion, arithmetic intensity,
+memory- vs compute-bound classification, and attribution back to
+framework ops via HLO metadata.
+
+Diffing across PRs (the fusion-budget regression gate, tools/ci.py
+stage 'fusion-audit'):
+
+    # refresh the committed baseline after an intentional change
+    python tools/fusion_audit.py --quick --write-baseline FUSION_BASELINE.json
+
+    # CI: fail when HBM bytes/step or fusion count regress silently
+    python tools/fusion_audit.py --quick --baseline FUSION_BASELINE.json --gate
+
+Budgets: total HBM bytes/step may grow at most
+``MXNET_TPU_FUSION_BUDGET_PCT`` (default 2%) over the baseline and
+fusion count at most ``MXNET_TPU_FUSION_BUDGET_COUNT`` (default 0)
+— one-sided, so improvements always pass. The gate refuses to compare
+artifacts built from different model configs.
+
+``--hlo FILE`` audits an arbitrary captured HLO text dump instead of
+building the reference programs (handy for auditing real-TPU dumps on
+a dev box).
+
+Classification uses a FIXED reference machine (TPU v5e-class; see the
+``MXNET_TPU_ROOFLINE_*`` knobs) so artifacts produced on the CPU CI
+rig are stable and diffable. docs/PERFORMANCE.md documents the schema
+and how to read the audit.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _build_resnet_program(quick):
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon import model_zoo
+
+    batch, image = (2, 32) if quick else (128, 224)
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = model_zoo.vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.uniform(-1, 1, (batch, 3, image, image)),
+                 dtype='float32')
+    y = nd.array(np.random.randint(0, 1000, (batch,)))
+    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+    pt = parallel.ParallelTrainer(
+        net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9,
+                        'wd': 1e-4}, mesh)
+    pt.build(x, y)
+    return pt, {'model': 'resnet50_v1', 'batch': batch, 'image': image}
+
+
+def _build_bert_program(quick):
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+
+    if quick:
+        batch, seqlen, npred, vocab = 2, 16, 2, 100
+        net = bert_zoo.get_bert('bert_12_768_12', vocab_size=vocab,
+                                max_length=32, units=32, hidden_size=64,
+                                num_layers=2, num_heads=4, dropout=0.1)
+    else:
+        batch, seqlen, npred, vocab = 96, 128, 20, 30522
+        net = bert_zoo.bert_12_768_12(vocab_size=vocab, max_length=512,
+                                      dropout=0.1)
+    np.random.seed(0)
+    mx.random.seed(0)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    ids = nd.array(rs.randint(0, vocab, (batch, seqlen)))
+    tt = nd.array((rs.rand(batch, seqlen) > 0.5).astype('float32'))
+    vl = nd.array(np.full((batch,), seqlen, np.float32))
+    mp = nd.array(rs.randint(0, seqlen, (batch, npred)))
+    mlm_y = nd.array(rs.randint(0, vocab, (batch, npred)))
+    nsp_y = nd.array(rs.randint(0, 2, (batch,)))
+
+    def pretrain_loss(outs, labels):
+        _, _, mlm_s, nsp_s = outs
+        my, ny = labels
+        return L(mlm_s.reshape((-1, vocab)),
+                 my.reshape((-1,))).mean() + L(nsp_s, ny).mean()
+
+    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+    pt = parallel.ParallelTrainer(
+        net, pretrain_loss, 'adamw', {'learning_rate': 1e-4,
+                                      'wd': 0.01}, mesh)
+    pt.build([ids, tt, vl, mp], [mlm_y, nsp_y])
+    return pt, {'model': 'bert_12_768_12' if not quick else 'bert-tiny',
+                'batch': batch, 'seqlen': seqlen}
+
+
+_BUILDERS = {'resnet50_step': _build_resnet_program,
+             'bert_step': _build_bert_program}
+
+
+def audit_program(name, quick, top=None):
+    """Build one reference step program and return its fusion artifact."""
+    from mxnet_tpu.observability import roofline
+    pt, config = _BUILDERS[name](quick)
+    config['quick'] = bool(quick)
+    text = pt.compiled_text()
+    return roofline.roofline_artifact(text, program=name, top=top,
+                                      config=config)
+
+
+def _atomic_write(path, payload):
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write('\n')
+    os.replace(tmp, path)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='per-fusion roofline audit of the reference step '
+                    'programs (mxnet_tpu.fusion.v1 artifacts)')
+    p.add_argument('--model', default='both',
+                   choices=('resnet', 'bert', 'both'))
+    p.add_argument('--quick', action='store_true',
+                   help='small CI-sized model configs (the committed '
+                        'baseline is built with --quick)')
+    p.add_argument('--top', type=int, default=40,
+                   help='per-fusion rows kept in the artifact (totals '
+                        'always cover the whole program)')
+    p.add_argument('--out', default='FUSION.json',
+                   help='combined artifact file: {"programs": '
+                        '{name: <mxnet_tpu.fusion.v1>}}')
+    p.add_argument('--baseline', default=None,
+                   help='baseline combined artifact to diff against')
+    p.add_argument('--gate', action='store_true',
+                   help='exit 1 when the fusion budget regresses vs '
+                        '--baseline')
+    p.add_argument('--write-baseline', default=None, metavar='PATH',
+                   help='also write the combined artifact here '
+                        '(refreshing the committed baseline)')
+    p.add_argument('--hlo', default=None, metavar='FILE',
+                   help='audit a captured HLO text dump instead of '
+                        'building the reference programs')
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.observability import roofline
+    from mxnet_tpu.config import get as _cfg
+
+    programs = {}
+    if args.hlo:
+        text = open(args.hlo).read()
+        name = os.path.basename(args.hlo)
+        programs[name] = roofline.roofline_artifact(
+            text, program=name, top=args.top,
+            config={'source': 'hlo-dump'})
+    else:
+        wanted = {'resnet': ['resnet50_step'], 'bert': ['bert_step'],
+                  'both': ['resnet50_step', 'bert_step']}[args.model]
+        for name in wanted:
+            print('== fusion_audit: building %s (%s)'
+                  % (name, 'quick' if args.quick else 'full'),
+                  flush=True)
+            programs[name] = audit_program(name, args.quick,
+                                           top=args.top)
+
+    for name, art in programs.items():
+        print(roofline.format_table(art))
+        print()
+
+    combined = {'schema': roofline.SCHEMA, 'programs': programs}
+    _atomic_write(args.out, combined)
+    print('fusion_audit: wrote %s (%d program(s))'
+          % (args.out, len(programs)))
+    if args.write_baseline:
+        _atomic_write(args.write_baseline, combined)
+        print('fusion_audit: refreshed baseline %s'
+              % args.write_baseline)
+
+    if args.baseline:
+        if not os.path.exists(args.baseline):
+            if args.gate:
+                # a gate with no baseline is a gate that never fires —
+                # fail loudly instead of staying green forever
+                print('fusion_audit: --gate but no baseline at %s '
+                      '(run --write-baseline and commit it)'
+                      % args.baseline)
+                return 1
+            print('fusion_audit: no baseline at %s — skipping the diff'
+                  ' (run --write-baseline to create one)'
+                  % args.baseline)
+            return 0
+        base = json.load(open(args.baseline))
+        bytes_tol = float(_cfg('MXNET_TPU_FUSION_BUDGET_PCT'))
+        count_tol = int(_cfg('MXNET_TPU_FUSION_BUDGET_COUNT'))
+        problems = []
+        for name, art in programs.items():
+            b = base.get('programs', {}).get(name)
+            if b is None:
+                print('fusion_audit: baseline has no %r — skipping'
+                      % name)
+                continue
+            probs = roofline.diff_artifacts(
+                b, art, bytes_tol_pct=bytes_tol, count_tol=count_tol)
+            for pr in probs:
+                problems.append('%s: %s' % (name, pr))
+            delta = (art['totals']['hbm_bytes_per_step']
+                     - b['totals']['hbm_bytes_per_step'])
+            print('fusion_audit: %s bytes/step %+.3g vs baseline '
+                  '(fusions %d -> %d)%s'
+                  % (name, delta, b['totals']['fusion_count'],
+                     art['totals']['fusion_count'],
+                     ' REGRESSED' if probs else ' ok'))
+        if problems:
+            print('fusion_audit: FUSION BUDGET REGRESSION:\n  '
+                  + '\n  '.join(problems))
+            if args.gate:
+                return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

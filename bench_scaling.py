@@ -19,6 +19,12 @@ Usage:
 
 The per-chip batch is held constant (weak scaling, like the reference
 table), so efficiency = rate(dp) / (dp * rate(1)).
+
+The sharded-update leg (skip with --no-zero-leg) A/Bs the replicated
+weight update against the ZeRO dp-sharded one (MXNET_TPU_ZERO,
+docs/PARALLEL.md) at the largest measured dp and records per-device
+optimizer-state bytes (ideal 1/dp of replicated), per-step collective
+traffic, and step time under artifact key ``zero_update``.
 """
 import argparse
 import json
@@ -38,7 +44,7 @@ def collective_bytes(hlo_text):
     return impl(hlo_text)
 
 
-def _build(model, dp, batch_per_chip, image, devices):
+def _build(model, dp, batch_per_chip, image, devices, zero=False):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, parallel
     from mxnet_tpu.gluon import model_zoo, nn
@@ -66,7 +72,8 @@ def _build(model, dp, batch_per_chip, image, devices):
     x = nd.array(np.random.uniform(-1, 1, shape), dtype=dtype)
     y = nd.array(np.random.randint(0, classes, (B,)))
     pt = parallel.ParallelTrainer(
-        net, L, 'sgd', {'learning_rate': 0.05, 'momentum': 0.9}, mesh)
+        net, L, 'sgd', {'learning_rate': 0.05, 'momentum': 0.9}, mesh,
+        zero=zero)
     pt.step(x, y)          # compile
     return pt, x, y
 
@@ -113,6 +120,8 @@ def main(argv=None):
     p.add_argument('--batch-per-chip', type=int, default=None)
     p.add_argument('--image', type=int, default=None)
     p.add_argument('--iters', type=int, default=None)
+    p.add_argument('--no-zero-leg', action='store_true',
+                   help='skip the sharded-update (ZeRO) A/B leg')
     p.add_argument('--out', default='SCALING.json')
     args = p.parse_args(argv)
 
@@ -152,6 +161,7 @@ def main(argv=None):
 
     rows = []
     base_rate = None
+    last = None           # (dp, pt, dt, comm, per_kind) of the last row
     for dp in dp_list:
         if dp > n:
             row = {'dp': dp, 'skipped': 'only %d devices' % n}
@@ -177,10 +187,65 @@ def main(argv=None):
             'platform': devices[0].platform,
         }
         rows.append(row)
+        last = (dp, pt, dt, comm, per_kind)
         print(json.dumps(row), flush=True)
+
+    # sharded-update leg (docs/PARALLEL.md): A/B the replicated weight
+    # update against MXNET_TPU_ZERO=1 at the largest measured dp —
+    # per-device optimizer-state bytes (the ZeRO memory win, ideal
+    # 1/dp), per-step collective traffic (the reduce-scatter +
+    # all-gather the sharded update trades the plain all-reduce for),
+    # and step time
+    zero_leg = None
+    measured = [dp for dp in dp_list if dp <= n and dp > 1]
+    if not args.no_zero_leg and measured:
+        dp = max(measured)
+
+        def leg(zero):
+            pt, x, y = _build(args.model, dp, batch, image, devices,
+                              zero=zero)
+            dt = _time_step(pt, x, y, iters, slope=on_accel)
+            per_dev, logical = pt.optimizer_state_bytes()
+            comm, per_kind = collective_bytes(step_hlo(pt, x, y))
+            return {'ms_per_step': round(dt * 1e3, 2),
+                    'opt_state_bytes_per_device': per_dev,
+                    'opt_state_bytes_logical': logical,
+                    'comm_bytes_per_step': comm,
+                    'comm_by_kind': per_kind}
+
+        # free the rows-loop trainer (params + state + executable in
+        # device memory) before building anything new — holding two
+        # trainers doubles peak HBM at the largest dp; the loop locals
+        # alias it too
+        reuse = last if last is not None and last[0] == dp else None
+        last = pt = x = y = None
+        if reuse is not None:
+            # the rows loop just compiled+timed this exact replicated
+            # config — only the state-bytes accounting is new
+            _, pt, dt, comm, per_kind = reuse
+            per_dev, logical = pt.optimizer_state_bytes()
+            replicated = {'ms_per_step': round(dt * 1e3, 2),
+                          'opt_state_bytes_per_device': per_dev,
+                          'opt_state_bytes_logical': logical,
+                          'comm_bytes_per_step': comm,
+                          'comm_by_kind': per_kind}
+            del pt, reuse
+        else:
+            replicated = leg(False)
+        sharded = leg(True)
+        zero_leg = {
+            'dp': dp,
+            'replicated': replicated,
+            'sharded': sharded,
+            'state_bytes_ratio': round(
+                sharded['opt_state_bytes_per_device']
+                / max(1, replicated['opt_state_bytes_per_device']), 4),
+        }
+        print(json.dumps({'zero_update': zero_leg}), flush=True)
 
     artifact = {'model': args.model, 'batch_per_chip': batch,
                 'image': image, 'weak_scaling': True, 'rows': rows,
+                'zero_update': zero_leg,
                 'status': 'ok' if on_accel else 'degraded',
                 'backend': status.as_dict(), 'error': status.error}
     write_artifact(args.out, artifact)

@@ -289,6 +289,22 @@ KNOBS = {k.name: k for k in [
           ' plain autodiff everywhere (the A/B reference; flip it'
           ' before the first trace — already-compiled eager programs'
           ' are not invalidated).'),
+    # 2-D mesh / ZeRO sharded weight update (docs/PARALLEL.md)
+    _knob('MXNET_TPU_ZERO', bool, False,
+          'Shard the weight update + optimizer state across the dp'
+          ' mesh axis (ZeRO / "Automatic Cross-Replica Sharding of'
+          ' Weight Update" recipe): each replica owns 1/dp of every'
+          ' state tensor, gradients reach the update via reduce-'
+          'scatter, updated param shards are all-gathered back — all'
+          ' inside the one compiled step program. Bit-identical to'
+          ' the replicated update at dp-only shapes (docs/PARALLEL.md'
+          ' contract); per-device optimizer-state memory drops ~1/dp.'),
+    _knob('MXNET_TPU_MODEL_AXIS', str, 'model',
+          'Name of the model-parallel mesh axis ShardingRules treats'
+          ' as column-parallel by default and that gluon/Module'
+          ' sharding annotations (P(None, "model")-style specs) refer'
+          ' to. The elastic shrink path preserves this axis; only dp'
+          ' shrinks.'),
     _knob('MXNET_TPU_PREFETCH', int, 2,
           'Host->device input staging depth for Module.fit /'
           ' ParallelTrainer.prefetch_iter / DataLoader'

@@ -200,6 +200,41 @@ class Block:
             picked.update(child.collect_params(select=select))
         return picked
 
+    def annotate_sharding(self, mapping):
+        """Attach mesh-placement annotations to this Block's parameters
+        (docs/PARALLEL.md): ``mapping`` is name-substring ->
+        PartitionSpec (e.g. ``{'dense0_weight': P(None, 'model')}``).
+        Matching parameters get ``Parameter.sharding`` set; the
+        parallel layer's ShardingRules honor the annotation over every
+        heuristic and validate it eagerly against the mesh at build.
+        A parameter matched by several fragments takes the FIRST one
+        in mapping order (same priority rule as
+        ``ShardingRules.spec_for`` overrides). Returns the number of
+        parameters annotated, each counted once; an entry matching
+        nothing raises (a silent typo would silently train
+        replicated)."""
+        params = self.collect_params()
+        hits = {frag: 0 for frag in mapping}
+        annotated = 0
+        for name, p in params.items():
+            for frag, spec in mapping.items():
+                if frag in name:
+                    p.sharding = spec
+                    hits[frag] += 1
+                    annotated += 1
+                    break               # first fragment wins
+        for frag, n in hits.items():
+            if not n:
+                # either a typo, or the fragment was shadowed by an
+                # earlier broader one — both would silently train with
+                # a different sharding than annotated
+                raise ValueError(
+                    "annotate_sharding: no parameter matches '%s' "
+                    '(or every match was claimed by an earlier '
+                    'fragment) — have: %s'
+                    % (frag, list(params.keys())))
+        return annotated
+
     def _check_container_with_block(self):
         registered = set(self._children.values())
 

@@ -52,14 +52,23 @@ def _shapes_compatible(declared, concrete):
 
 class Parameter:
     """One weight of a Block: storage, gradient buffer, init policy,
-    per-param lr/wd multipliers (reference: gluon/parameter.py:43)."""
+    per-param lr/wd multipliers (reference: gluon/parameter.py:43).
+
+    ``sharding`` is an optional PartitionSpec annotation (e.g.
+    ``P(None, 'model')``) consumed by the parallel layer's
+    :class:`~mxnet_tpu.parallel.ShardingRules` when a
+    ``ParallelTrainer``/``Module`` places this parameter on a mesh; it
+    wins over name-based overrides and the built-in heuristics and is
+    validated eagerly against the mesh (docs/PARALLEL.md). ``None``
+    (default) defers to the rules."""
 
     def __init__(self, name, grad_req='write', shape=None, dtype='float32',
                  lr_mult=1.0, wd_mult=1.0, init=None,
                  allow_deferred_init=False, differentiable=True,
-                 stype='default', grad_stype='default'):
+                 stype='default', grad_stype='default', sharding=None):
         self.name, self.init = name, init
         self.lr_mult, self.wd_mult = lr_mult, wd_mult
+        self.sharding = sharding
         self._var = self._data = self._grad = self._ctx_list = None
         self._deferred_init = _NOT_DEFERRED
         self._differentiable = differentiable

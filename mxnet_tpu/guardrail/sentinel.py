@@ -82,13 +82,30 @@ def poison_grads(grads, poison):
     armed or not — and corrupting a single element proves the sentinel
     reduce is global: the element lives on one shard, yet every replica
     must see the packed verdict flip.
+
+    Spelled as an iota mask + select rather than ``.at[idx].add`` on
+    purpose: the scatter/dynamic-update-slice form miscompiles under
+    the XLA SPMD partitioner when the gradient is sharded (each shard
+    applies the write at its LOCAL index, overwriting one element per
+    shard with the global element's value — observed on the CPU
+    backend with the ZeRO dp-sharded update, jax 0.4.37). Elementwise
+    select partitions correctly on any mesh, and off the masked
+    element the grad bits pass through untouched (no ``-0.0 + 0.0``
+    normalization).
     """
     grads = list(grads)
     if not grads:
         return grads
     g0 = grads[0]
-    idx = (0,) * g0.ndim
-    grads[0] = g0.at[idx].add(jnp.asarray(poison).astype(g0.dtype))
+    mask = None
+    for d in range(g0.ndim):
+        hit = jax.lax.broadcasted_iota(jnp.int32, g0.shape, d) == 0
+        mask = hit if mask is None else (mask & hit)
+    p = jnp.asarray(poison).astype(g0.dtype)
+    if mask is None:            # 0-d grad: the element IS the array
+        grads[0] = g0 + p
+    else:
+        grads[0] = jnp.where(mask, g0 + p, g0)
     return grads
 
 

@@ -49,6 +49,7 @@ class Module(BaseModule):
         self._dp_mesh = None
         self._dp_repl = None
         self._dp_batch = None
+        self._sharding_specs = None
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
         label_names = list(label_names) if label_names is not None else []
@@ -265,7 +266,7 @@ class Module(BaseModule):
         if len(self._context_list) > 1:
             self._build_dp_mesh()
 
-    def _build_dp_mesh(self):
+    def _build_dp_mesh(self, axes=None):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
         devices = [c.jax_device() for c in self._context_list]
         if len(set(devices)) != len(devices):
@@ -273,19 +274,99 @@ class Module(BaseModule):
                 'Module context list resolves to duplicate devices %s; '
                 'running single-device.', devices)
             return
-        self._dp_mesh = Mesh(onp.array(devices), ('dp',))
+        if axes:
+            arr = onp.asarray(devices).reshape(tuple(axes.values()))
+            self._dp_mesh = Mesh(arr, tuple(axes.keys()))
+        else:
+            self._dp_mesh = Mesh(onp.array(devices), ('dp',))
         self._dp_repl = NamedSharding(self._dp_mesh, PartitionSpec())
         self._dp_batch = NamedSharding(self._dp_mesh, PartitionSpec('dp'))
 
+    def set_sharding(self, overrides=None, axes=None, rules=None):
+        """Annotate this (bound, multi-context) Module's parameters with
+        mesh placements (docs/PARALLEL.md) — the symbolic-API analog of
+        ``Block.annotate_sharding``.
+
+        ``axes`` re-layouts the context list as a named 2-D mesh (e.g.
+        ``{'dp': 4, 'model': 2}``; default keeps the 1-D dp mesh);
+        ``overrides`` maps param-name substrings to PartitionSpec
+        annotations (``P(None, 'model')`` style); ``rules`` swaps in a
+        whole :class:`~mxnet_tpu.parallel.ShardingRules` (mutually
+        exclusive with ``overrides`` — attach overrides to the rules
+        object itself). Every
+        resolved spec is validated against the mesh HERE — an axis the
+        mesh lacks or a non-dividing dim raises
+        :class:`~mxnet_tpu.parallel.ShardingSpecError` naming the
+        parameter instead of crashing later at device placement.
+        """
+        from ..parallel.sharding import ShardingRules
+        from jax.sharding import NamedSharding
+        self._require(bound=True)
+        if len(self._context_list) <= 1:
+            raise ValueError(
+                'set_sharding needs a multi-device context list '
+                '(Module(context=[...]))')
+        if rules is not None and overrides:
+            # silently preferring one would train with a different
+            # sharding than the caller annotated — the exact failure
+            # mode eager validation exists to prevent
+            raise ValueError(
+                'set_sharding: pass overrides= or rules=, not both '
+                '(put the overrides on the ShardingRules)')
+        rules = rules or ShardingRules(overrides=overrides)
+        for frag in rules.overrides or {}:
+            if not any(frag in name for name in self._param_names):
+                # same contract as Block.annotate_sharding: a silent
+                # typo would silently train replicated
+                raise ValueError(
+                    'set_sharding: no parameter matches override '
+                    'fragment %r (params: %s)'
+                    % (frag, sorted(self._param_names)))
+        if axes is not None:
+            n = 1
+            for v in axes.values():
+                n *= int(v)
+            if n != len(self._context_list):
+                raise ValueError(
+                    'mesh axes %s do not cover the %d bound contexts'
+                    % (dict(axes), len(self._context_list)))
+            if 'dp' not in axes:
+                raise ValueError("mesh axes %s need a 'dp' axis (the "
+                                 'batch is sharded along it)' % (axes,))
+        # apply atomically: a ShardingSpecError below must not leave
+        # the module half-reconfigured on a rebuilt mesh
+        prev = (self._dp_mesh, getattr(self, '_dp_repl', None),
+                getattr(self, '_dp_batch', None))
+        try:
+            if axes is not None:
+                self._build_dp_mesh(axes)
+            if self._dp_mesh is None:
+                raise ValueError('context list resolves to duplicate '
+                                 'devices; no mesh to shard on')
+            specs = {}
+            for name in self._param_names:
+                shape = self._exec.arg_dict[name].shape
+                specs[name] = NamedSharding(
+                    self._dp_mesh, rules.spec_for(name, shape,
+                                                  self._dp_mesh))
+        except Exception:
+            self._dp_mesh, self._dp_repl, self._dp_batch = prev
+            raise
+        self._sharding_specs = specs
+        return self
+
     def _place_dp(self, feed):
-        """Lay out arrays for the dp mesh: params/aux replicated, batch
-        inputs sharded along axis 0. No-ops for already-placed arrays, so
-        the per-step cost is the input scatter only."""
+        """Lay out arrays for the mesh: params/aux replicated (or per
+        their set_sharding placement), batch inputs sharded along axis
+        0 of 'dp'. No-ops for already-placed arrays, so the per-step
+        cost is the input scatter only."""
         import jax
+        specs = self._sharding_specs or {}
         for name in self._param_names:
             holder = self._exec.arg_dict[name]
-            if holder._data.sharding != self._dp_repl:
-                holder._data = jax.device_put(holder._data, self._dp_repl)
+            want = specs.get(name, self._dp_repl)
+            if holder._data.sharding != want:
+                holder._data = jax.device_put(holder._data, want)
         for name in self._aux_names:
             holder = self._exec.aux_dict[name]
             if holder._data.sharding != self._dp_repl:
@@ -300,8 +381,12 @@ class Module(BaseModule):
         dev = self._context.jax_device()
         for d in (self._exec.arg_dict, self._exec.aux_dict):
             for holder in d.values():
-                if getattr(holder._data, 'sharding', None) in \
-                        (self._dp_repl, self._dp_batch):
+                sh = getattr(holder._data, 'sharding', None)
+                # any mesh placement is undoable — set_sharding(axes=)
+                # rebuilds self._dp_mesh, so arrays placed under a
+                # PREVIOUS mesh object must collapse too, not just
+                # ones matching the current mesh by identity
+                if getattr(sh, 'mesh', None) is not None:
                     holder._data = jax.device_put(holder._data, dev)
 
     # -- optimizer ---------------------------------------------------------
@@ -391,18 +476,23 @@ class Module(BaseModule):
                                 for n, a in feed.items()}
                 self._exec = self._exec.reshape(**shape_kwargs)
         if self._dp_mesh is not None:
-            n_dev = len(self._context_list)
+            # the batch shards along 'dp' only — a 2-D (dp × model)
+            # mesh from set_sharding must not demand divisibility by
+            # dp*model (that would silently collapse model-sharded
+            # params onto one device)
+            dp = int(self._dp_mesh.shape.get(
+                'dp', len(self._context_list)))
             # the FED batch (a padded partial batch is bound-shaped
             # and shards fine), not the caller's row count
             fed_b = feed[self._data_names[0]].shape[0]
-            if fed_b % n_dev == 0:
+            if fed_b % dp == 0:
                 self._place_dp(feed)
             else:
                 if not getattr(self, '_dp_odd_warned', False):
                     self._dp_odd_warned = True
                     self.logger.warning(
-                        'batch size %d not divisible by %d devices; this '
-                        'batch runs on %s only', fed_b, n_dev,
+                        "batch size %d not divisible by the 'dp' axis "
+                        '(%d); this batch runs on %s only', fed_b, dp,
                         self._context)
                 self._undo_dp()
         self._exec.forward(is_train=is_train, **feed)

@@ -461,9 +461,16 @@ def format_table(artifact, top=12):
             100.0 * t['memory_bound_bytes']
             / max(t['hbm_bytes_per_step'], 1),
             artifact['machine']['ridge_flops_per_byte']),
-        '%-34s %5s %10s %10s %8s %7s' % ('fusion', 'bound', 'bytes',
-                                         'flops', 'AI', '%bytes'),
     ]
+    coll = artifact.get('collectives') or {}
+    if coll:
+        lines.append('collective bytes/step: %.4g   (%s)' % (
+            t.get('collective_bytes_per_step', 0),
+            '  '.join('%s %.4g' % (k, v)
+                      for k, v in sorted(coll.items()))))
+    lines.append(
+        '%-34s %5s %10s %10s %8s %7s' % ('fusion', 'bound', 'bytes',
+                                         'flops', 'AI', '%bytes'))
     for r in artifact['fusions'][:top]:
         lines.append('%-34s %5s %10.3g %10.3g %8s %6.2f%%  %s' % (
             r['name'][:34], r['bound'][:4], r['bytes'], r['flops'],

@@ -6,13 +6,17 @@ push/pull and the dmlc launcher collapse into sharding annotations on one
 jit-compiled train step; XLA inserts the collectives (psum/all_gather/
 reduce_scatter) over ICI/DCN.
 
-Axes convention: 'dp' (data/batch), 'tp' (tensor/model), 'pp' (pipeline
-stage), 'sp' (sequence/context), 'ep' (expert). Single-chip training is the
-degenerate 1x1 mesh — the same code path.
+Axes convention: 'dp' (data/batch), 'model' (tensor/model-parallel; 'tp'
+is the legacy alias), 'pp' (pipeline stage), 'sp' (sequence/context),
+'ep' (expert). Single-chip training is the degenerate 1x1 mesh — the same
+code path. The weight update itself can additionally be ZeRO-sharded
+across 'dp' (MXNET_TPU_ZERO, docs/PARALLEL.md).
 """
 from .mesh import create_mesh, current_mesh, local_mesh
 from .train_step import ParallelTrainer, pure_forward_fn
-from .sharding import ShardingRules, infer_param_sharding
+from .sharding import (ShardingRules, ShardingSpecError,
+                       infer_param_sharding, validate_spec,
+                       zero_update_spec)
 
 from .ring_attention import (ring_self_attention,
                              ulysses_self_attention,

@@ -18,7 +18,11 @@ __all__ = ['create_mesh', 'current_mesh', 'local_mesh']
 
 _state = threading.local()
 
-AXES = ('dp', 'pp', 'tp', 'sp', 'ep')
+# 'model' is the first-class tensor-parallel axis the sharding rules and
+# gluon/Module annotations target (docs/PARALLEL.md); 'tp' remains as the
+# legacy Megatron-style alias. Elasticity shrinks only 'dp' — every other
+# axis is tied to program structure (resilience/elastic.py).
+AXES = ('dp', 'model', 'pp', 'tp', 'sp', 'ep')
 
 
 def create_mesh(axes=None, devices=None):
@@ -26,9 +30,10 @@ def create_mesh(axes=None, devices=None):
 
     Parameters
     ----------
-    axes : dict name->size (e.g. {'dp': 4, 'tp': 2}) or None for pure DP
-        over all devices. Sizes must multiply to the device count; a -1
-        size is inferred.
+    axes : dict name->size (e.g. {'dp': 4, 'model': 2}) or None for pure
+        DP over all devices. Sizes must multiply to the device count; a
+        -1 size is inferred (so {'dp': -1, 'model': 2} spans whatever
+        devices exist with a fixed 2-way model axis).
     devices : explicit device list (defaults to jax.devices()).
     """
     if devices is None:

@@ -2,61 +2,190 @@
 
 Reference analog: symbol attr ctx_group + AssignContext device placement
 (graph_executor.cc:984) — the only model-parallel mechanism MXNet has.
-Here placement is a PartitionSpec per parameter: Megatron-style TP for
-matmul weights, replication for everything else, with the embedding table
-sharded on its vocab axis. The rules are name/shape heuristics overridable
-per-parameter.
+Here placement is a PartitionSpec per parameter, resolved in priority
+order:
+
+  1. an explicit per-parameter annotation (``Parameter.sharding``, set
+     directly or via ``Block.annotate_sharding`` /
+     ``Module.set_sharding``) — the P(None, "model")-style specs of
+     docs/PARALLEL.md;
+  2. a name-substring override on the rules object;
+  3. the built-in heuristic: 2-D+ weights column-parallel on the
+     'model' axis (or the legacy 'tp' alias) when the mesh has one,
+     everything else replicated.
+
+Every resolved spec is validated EAGERLY against the mesh — an axis
+the mesh does not have, an axis used twice, or an axis that does not
+divide its dimension raises :class:`ShardingSpecError` naming the
+parameter, the spec, and the mesh axes, instead of crashing later deep
+inside device placement.
 """
 from __future__ import annotations
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ['ShardingRules', 'infer_param_sharding']
+__all__ = ['ShardingRules', 'ShardingSpecError', 'infer_param_sharding',
+           'validate_spec', 'zero_update_spec']
+
+
+class ShardingSpecError(ValueError):
+    """A PartitionSpec cannot be placed on the mesh it was given: it
+    names an axis the mesh lacks, reuses an axis, or names an axis
+    whose size does not divide the annotated dimension."""
+
+
+def _spec_entries(spec):
+    """Normalize a PartitionSpec (or tuple) to a list whose items are
+    tuples of axis names (PartitionSpec allows ('a', 'b') per dim)."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(())
+        elif isinstance(entry, (tuple, list)):
+            out.append(tuple(entry))
+        else:
+            out.append((entry,))
+    return out
+
+
+def validate_spec(name, spec, shape, mesh):
+    """Eagerly check ``spec`` against ``shape`` on ``mesh``; returns the
+    spec (as a PartitionSpec) or raises :class:`ShardingSpecError`.
+
+    The checks mirror what GSPMD would reject at placement time — rank
+    overflow, unknown axes, reused axes — plus the stricter "axis size
+    must divide the dim" rule: XLA can pad uneven shards, but a padded
+    weight silently changes per-device memory/compute accounting, so an
+    explicit annotation that does not divide is treated as an error.
+    """
+    entries = _spec_entries(spec)
+    mesh_axes = dict(mesh.shape)
+    if len(entries) > len(shape):
+        raise ShardingSpecError(
+            "sharding for parameter '%s': spec %s has %d entries but the "
+            'parameter is rank %d (shape %s)'
+            % (name, tuple(spec), len(entries), len(shape), tuple(shape)))
+    seen = set()
+    for dim, axes in enumerate(entries):
+        size = 1
+        for ax in axes:
+            if ax not in mesh_axes:
+                raise ShardingSpecError(
+                    "sharding for parameter '%s': spec %s names mesh axis "
+                    "'%s' but the mesh only has axes %s"
+                    % (name, tuple(spec), ax, mesh_axes))
+            if ax in seen:
+                raise ShardingSpecError(
+                    "sharding for parameter '%s': spec %s uses mesh axis "
+                    "'%s' more than once" % (name, tuple(spec), ax))
+            seen.add(ax)
+            size *= int(mesh_axes[ax])
+        if size > 1 and shape[dim] % size:
+            raise ShardingSpecError(
+                "sharding for parameter '%s': spec %s shards dim %d "
+                '(size %d) over mesh axes %s of total size %d, which '
+                'does not divide it (mesh axes: %s)'
+                % (name, tuple(spec), dim, shape[dim], axes, size,
+                   mesh_axes))
+    return P(*tuple(spec))
 
 
 class ShardingRules:
-    """Maps parameter name+shape -> PartitionSpec.
+    """Maps parameter name+shape (+ optional annotation) -> PartitionSpec.
 
-    Default policy (applied only when the mesh has a 'tp' axis >1):
-      * Dense/FullyConnected weights (2-D, (out, in)): alternate column/row
-        parallel by depth is unavailable without graph context, so shard the
-        OUT dim on 'tp' (column parallel) — safe because activations stay
-        replicated and XLA all-gathers where needed.
-      * Embedding tables (vocab, dim): shard vocab on 'tp'.
-      * Conv kernels (out, in, kh, kw): shard out channels on 'tp'.
+    Default policy (applied when the mesh has a model-parallel axis of
+    size > 1 — 'model' by default, `MXNET_TPU_MODEL_AXIS`; the legacy
+    'tp' axis keeps working as an alias):
+      * Dense/FullyConnected weights (2-D, (out, in)): alternate
+        column/row parallel by depth is unavailable without graph
+        context, so shard the OUT dim (column parallel) — safe because
+        activations stay replicated and XLA all-gathers where needed.
+      * Embedding tables (vocab, dim): shard vocab.
+      * Conv kernels (out, in, kh, kw): shard out channels.
       * 1-D params (bias/gamma/beta/stats): replicated.
-    Overrides: dict name-substring -> PartitionSpec.
+    Overrides: dict name-substring -> PartitionSpec. Per-parameter
+    annotations (``Parameter.sharding``) win over both.
     """
 
-    def __init__(self, overrides=None, default_tp_axis='tp'):
+    def __init__(self, overrides=None, default_tp_axis='tp',
+                 model_axis=None):
+        if model_axis is None:
+            from ..config import get as _cfg
+            model_axis = _cfg('MXNET_TPU_MODEL_AXIS') or 'model'
         self.overrides = dict(overrides or {})
         self.tp = default_tp_axis
+        self.model = model_axis
 
-    def spec_for(self, name, shape, mesh):
+    def _model_axes(self, mesh):
+        """Model-parallel axes present on this mesh, largest first in
+        declaration order ('model' preferred over the 'tp' alias)."""
+        out = []
+        for ax in (self.model, self.tp):
+            if ax and ax in mesh.axis_names and \
+                    mesh.shape.get(ax, 1) > 1 and ax not in out:
+                out.append(ax)
+        return out
+
+    def spec_for(self, name, shape, mesh, annotation=None):
+        if annotation is not None:
+            return validate_spec(name, annotation, shape, mesh)
         for frag, spec in self.overrides.items():
             if frag in name:
-                return spec
-        if self.tp not in mesh.axis_names or \
-                mesh.shape.get(self.tp, 1) <= 1:
-            return P()
-        tp_size = mesh.shape[self.tp]
-        if len(shape) >= 2 and shape[0] % tp_size == 0:
-            # (out, in, ...) → column-parallel on out
-            return P(self.tp, *([None] * (len(shape) - 1)))
+                return validate_spec(name, spec, shape, mesh)
+        for ax in self._model_axes(mesh):
+            size = mesh.shape[ax]
+            if len(shape) >= 2 and shape[0] % size == 0:
+                # (out, in, ...) → column-parallel on out
+                return P(ax, *([None] * (len(shape) - 1)))
         return P()
 
 
 def infer_param_sharding(params, mesh, rules=None):
     """Return [NamedSharding] aligned with the params list.
 
-    params: list of gluon Parameter (or (name, shape) tuples).
+    params: list of gluon Parameter (or (name, shape) tuples). A gluon
+    Parameter carrying a ``.sharding`` annotation (set directly or via
+    ``Block.annotate_sharding``) takes priority over the rules.
     """
     rules = rules or ShardingRules()
     out = []
     for p in params:
         if isinstance(p, tuple):
             name, shape = p
+            annotation = None
         else:
             name, shape = p.name, p.shape
-        out.append(NamedSharding(mesh, rules.spec_for(name, shape, mesh)))
+            annotation = getattr(p, 'sharding', None)
+        out.append(NamedSharding(
+            mesh, rules.spec_for(name, shape, mesh,
+                                 annotation=annotation)))
     return out
+
+
+def zero_update_spec(spec, shape, mesh, axis='dp'):
+    """ZeRO placement for an update-state tensor of a parameter sharded
+    as ``spec``: additionally shard the first still-replicated dim that
+    the ``dp`` axis divides (PAPERS "Automatic Cross-Replica Sharding
+    of Weight Update in Data-Parallel Training"). Composes with model
+    parallelism — P('model', None) becomes P('model', 'dp') — and
+    falls back to ``spec`` unchanged (replicated over dp, e.g. odd
+    biases and scalars) when no dim divides, keeping the update
+    bit-identical rather than padding."""
+    dp = int(mesh.shape.get(axis, 1))
+    if axis not in mesh.axis_names or dp <= 1:
+        return P(*tuple(spec))
+    entries = _spec_entries(spec)
+    entries += [()] * (len(shape) - len(entries))
+    if any(axis in ent for ent in entries):
+        # the param itself is already sharded over ``axis`` (e.g. an
+        # explicit P('dp') annotation) — its state is per-replica
+        # partitioned already, and composing again would name the mesh
+        # axis twice (invalid NamedSharding)
+        return P(*tuple(spec))
+    for dim, axes in enumerate(entries):
+        if not axes and shape[dim] and shape[dim] % dp == 0:
+            out = [tuple(a) if a else None for a in entries]
+            out[dim] = axis
+            return P(*[e if not isinstance(e, tuple) or len(e) != 1
+                       else e[0] for e in out])
+    return P(*tuple(spec))

@@ -38,7 +38,8 @@ from .. import observability as _obs
 from .. import random as _random
 from ..ndarray import NDArray
 from .mesh import current_mesh
-from .sharding import ShardingRules, infer_param_sharding
+from .sharding import (ShardingRules, infer_param_sharding,
+                       zero_update_spec)
 
 __all__ = ['ParallelTrainer', 'pure_forward_fn']
 
@@ -126,19 +127,43 @@ class ParallelTrainer:
     builds a fresh :class:`~mxnet_tpu.guardrail.Guardrail`; an instance is
     used as-is (drivers share one across trainers for unified reporting).
 
+    ``zero`` opts into the ZeRO-sharded weight update (docs/PARALLEL.md;
+    PAPERS "Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training"): None reads ``MXNET_TPU_ZERO``. When active
+    (and the mesh has dp > 1), optimizer state is created under a
+    dp-sharded NamedSharding — each replica owns 1/dp of every state
+    tensor — gradients reach the update through a reduce-scatter instead
+    of an all-reduce, and the updated param shards are all-gathered back
+    to their (replicated or model-sharded) layout, all inside the ONE
+    compiled step so XLA fuses/overlaps the collectives. Contract: at
+    dp-only shapes the loss/params are bit-identical to the replicated
+    update (the grad reduction sums the same values in the same order;
+    the per-shard update math is elementwise), including through the
+    guardrail's ``lax.cond`` skip branch and a preempt→resume cycle.
+    ``step_n`` matches only to fp tolerance: inside the scanned
+    program the partitioner keeps the carried params dp-sharded across
+    iterations and re-lays-out the loop body around the shards, which
+    re-orders cross-replica sums (a documented divergence like the
+    ``step_accum`` one — see docs/PARALLEL.md). On XLA:CPU the logical
+    reduce-scatter lowers as all-reduce + dynamic-slice; TPU emits a
+    true reduce-scatter.
+
     vs gluon.Trainer (eager, op-at-a-time): this compiles forward+backward+
     allreduce+update into one XLA program — the CachedOp-static_alloc analog
     extended through the optimizer (reference fuses at best per-op).
     """
 
     def __init__(self, net, loss, optimizer='sgd', optimizer_params=None,
-                 mesh=None, rules=None, guardrail=None):
+                 mesh=None, rules=None, guardrail=None, zero=None):
         from ..optimizer import optimizer as _optmod
         self._net = net
         self._loss = loss
         self._opt_params = dict(optimizer_params or {})
         self._mesh = mesh or current_mesh()
         self._rules = rules or ShardingRules()
+        self._zero_arg = zero
+        self._zero = False
+        self._zero_shardings = None
         if isinstance(optimizer, str):
             self._opt = _optmod.Optimizer.create_optimizer(
                 optimizer, **self._opt_params)
@@ -171,6 +196,34 @@ class ParallelTrainer:
     def guardrail(self):
         """The attached host-side Guardrail (None when disabled)."""
         return self._guard
+
+    @property
+    def zero(self):
+        """True when the built step shards the weight update across dp
+        (resolved from the ``zero=`` arg / ``MXNET_TPU_ZERO`` at build;
+        False before the first build and on dp=1 meshes)."""
+        return self._zero
+
+    def optimizer_state_bytes(self):
+        """Optimizer-state memory accounting of the built step:
+        ``(per_device_bytes, logical_bytes)``. ``per_device_bytes`` is
+        what one device actually stores (shard shapes under the leaf
+        shardings); ``logical_bytes`` is the full unsharded state — the
+        replicated footprint. Their ratio is the ZeRO memory win
+        (~1/dp with the knob on, 1.0 replicated), the quantity
+        bench_scaling records and the sharding selftest gates."""
+        if self._jitted is None:
+            raise RuntimeError('optimizer_state_bytes() before the step '
+                               'is compiled; call build(x, y) first')
+        per_dev = logical = 0
+        for a in self._state_leaves:
+            item = a.dtype.itemsize
+            logical += int(onp.prod(a.shape, dtype=onp.int64)) * item \
+                if a.ndim else item
+            shard = a.sharding.shard_shape(a.shape)
+            per_dev += int(onp.prod(shard, dtype=onp.int64)) * item \
+                if a.ndim else item
+        return per_dev, logical
 
     def set_learning_rate(self, lr):
         self._opt.set_learning_rate(lr)
@@ -243,6 +296,7 @@ class ParallelTrainer:
             raise ValueError('no CheckpointManager attached or given')
         state = self.snapshot()
         state['mesh'] = mesh_meta(self._mesh)
+        state['zero'] = bool(self._zero)
         state['rng'] = _random.get_state()
         if extra:
             state.update(extra)
@@ -297,6 +351,18 @@ class ParallelTrainer:
                     % (plan.new_axes, here['axes']))
         if state.get('rng') is not None:
             _random.set_state(state['rng'])
+        if state.get('zero') is not None and \
+                bool(state['zero']) != bool(self._zero):
+            # placement-only difference: checkpoints hold LOGICAL
+            # arrays, so a ZeRO checkpoint restores onto a replicated
+            # trainer (and vice versa) bit-identically — worth a log
+            # line because the memory footprint changes
+            import logging
+            logging.warning(
+                'resume: checkpoint was written with zero=%s, trainer '
+                'is built with zero=%s — state re-placed under the '
+                "trainer's layout (values unchanged)",
+                state['zero'], self._zero)
         self.restore(state)
         return step, plan
 
@@ -360,15 +426,55 @@ class ParallelTrainer:
 
         self._loss_of = loss_of
 
+        param_shardings = tuple(infer_param_sharding(params, mesh,
+                                                     self._rules))
+        repl = NamedSharding(mesh, P())
+        zero = self._zero_arg
+        if zero is None:
+            from ..config import get as _cfg
+            zero = bool(_cfg('MXNET_TPU_ZERO'))
+        # ZeRO update sharding (docs/PARALLEL.md): each param's update
+        # state lives dp-sharded; the dp=1 (or knob-off) mesh keeps the
+        # replicated layout so single-chip stays the degenerate case
+        self._zero = bool(zero) and int(mesh.shape.get('dp', 1)) > 1
+        if self._zero:
+            zero_shardings = tuple(
+                NamedSharding(mesh, zero_update_spec(sh.spec, w.shape,
+                                                     mesh))
+                for sh, w in zip(param_shardings, param_arrays))
+        else:
+            zero_shardings = param_shardings
+        self._zero_shardings = zero_shardings
+        zero_live = self._zero
+
         def run_update(key, lrs, wds, ts, rescale_eff, param_arrays,
                        state_leaves, grads, auxs):
             """Traced optimizer application + BN-aux merge (shared by
-            the plain step and the guarded step's healthy branch)."""
+            the plain step and the guarded step's healthy branch).
+
+            In ZeRO mode the gradients are constrained to the dp-sharded
+            update layout BEFORE the optimizer math (GSPMD turns the
+            grad psum into a reduce-scatter) and the updated params are
+            constrained to the same shards AFTER it, so the optimizer
+            arithmetic runs on 1/dp of each tensor; the jit's param
+            out-shardings then insert the closing all-gather."""
+            if zero_live:
+                grads = tuple(
+                    g if i in skip_idx else
+                    jax.lax.with_sharding_constraint(g,
+                                                     zero_shardings[i])
+                    for i, g in enumerate(grads))
             with _random.key_override(key), \
                     _HyperPatch(opt, indices, lrs, wds, ts, rescale_eff):
                 new_params, new_leaves = apply_traced_updates(
                     opt, indices, list(param_arrays), list(grads),
                     templates, list(state_leaves), skip=skip_idx)
+            if zero_live:
+                new_params = [
+                    w if i in skip_idx else
+                    jax.lax.with_sharding_constraint(w,
+                                                     zero_shardings[i])
+                    for i, w in enumerate(new_params)]
             aux_idx = {id(p): i for i, p in enumerate(params)}
             for p, a in zip(meta.get('aux_params', []), auxs):
                 i = aux_idx.get(id(p))
@@ -393,7 +499,13 @@ class ParallelTrainer:
                          data_arrays, label_arrays):
             """step() + loss scaling + fused sentinel + cond-guarded
             update. Extra outputs: (packed health, scale, good-steps) —
-            all replicated scalars, no host transfer."""
+            all replicated scalars, no host transfer. The same cond
+            carries the ZeRO-sharded update: the skip branch returns
+            the dp-sharded state leaves untouched, so an overflow step
+            leaves the sharded state bit-identical by construction
+            (sentinel.poison_grads is spelled partitioner-safe — see
+            its docstring — so the injection point survives grads
+            being resharded for the sharded update)."""
             from ..guardrail import scaling as _scaling
             from ..guardrail import sentinel as _sentinel
             cfg = self._guard.config
@@ -445,11 +557,10 @@ class ParallelTrainer:
                            guard0, param_arrays, leaf_arrays,
                            tuple(xs_live), tuple(ys))
 
-        param_shardings = tuple(infer_param_sharding(params, mesh,
-                                                     self._rules))
-        repl = NamedSharding(mesh, P())
-
-        # a state leaf shaped like its parameter shards like it; anything
+        # a state leaf shaped like its parameter shards like its param's
+        # UPDATE layout (the param sharding, or the dp-sharded ZeRO
+        # layout when the knob is on — each replica owning 1/dp of every
+        # state tensor is the memory win of PAPERS 2004.13336); anything
         # else (scalars, counters) replicates
         def count_leaves(tt):
             if tt[0] == 'leaf':
@@ -464,7 +575,7 @@ class ParallelTrainer:
             for _ in range(count_leaves(t)):
                 leaf = leaf_arrays[li]
                 if leaf.shape == param_arrays[i].shape:
-                    leaf_shardings.append(param_shardings[i])
+                    leaf_shardings.append(zero_shardings[i])
                 else:
                     leaf_shardings.append(repl)
                 li += 1

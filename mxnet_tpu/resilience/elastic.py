@@ -29,9 +29,20 @@ Documented divergences of an elastic-shrunk resume (also in
 docs/RESILIENCE.md): BatchNorm batch statistics are computed per
 *microbatch* under accumulation (smaller effective stat batch), and
 cross-replica reduction order changes — both are fp-tolerance, not
-bit-exact, effects. Only the data-parallel axis shrinks; ``tp``/``pp``
-shards are tied to program structure and a restart below their product
-raises :class:`MeshShrinkError`.
+bit-exact, effects. Only the data-parallel axis shrinks; the
+``model``/``tp``/``pp`` axes are tied to program structure (a weight
+shard IS a slice of a compiled tensor), so a 2-D ``dp × model``
+checkpoint shrinks along dp with the model axis preserved intact
+(8 = 4×2 → 4 = 2×2) and a restart below (or not a multiple of) the
+non-dp product raises :class:`MeshShrinkError`.
+
+ZeRO-sharded optimizer state (``MXNET_TPU_ZERO``, docs/PARALLEL.md)
+needs no special casing anywhere here: checkpoints store the logical
+state tensors, so resharding dp 8→4 — or re-placing a ZeRO checkpoint
+onto a replicated trainer and vice versa — is the same
+``device_put``-under-new-shardings placement decision as everything
+else, which is precisely the re-shardability observation of the paper
+above.
 """
 from __future__ import annotations
 
@@ -122,13 +133,15 @@ def shrink_plan(ckpt_mesh, n_devices, global_batch=None):
                            note='mesh intact (%d device(s))' % old_total)
 
     old_dp = int(old_axes.get('dp', 1))
-    fixed = old_total // max(1, old_dp)     # tp/pp/sp/ep product
+    fixed = old_total // max(1, old_dp)     # model/tp/pp/sp/ep product
     if n_devices < fixed or n_devices % fixed:
         raise MeshShrinkError(
             'cannot shrink mesh %s onto %d device(s): the non-dp axes '
-            'need a multiple of %d devices (model-parallel shards are '
-            'tied to program structure; documented divergence — only '
-            'the dp axis is elastic)' % (old_axes, n_devices, fixed))
+            '(%s) need a multiple of %d devices (model-parallel shards '
+            'are tied to program structure; documented divergence — '
+            'only the dp axis is elastic)'
+            % (old_axes, n_devices,
+               [k for k in old_axes if k != 'dp'] or 'none', fixed))
     new_dp = n_devices // fixed
     if old_dp % new_dp:
         raise MeshShrinkError(

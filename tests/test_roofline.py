@@ -262,3 +262,56 @@ def test_fusion_audit_hlo_file_mode(tmp_path):
          '--out', str(out), '--baseline', str(tmp_path / 'nope.json')],
         cwd=repo, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_fusion_audit_config_records_platform():
+    """The gate-compared config block carries the audited platform, so
+    a CPU-lowered audit (--mesh forces JAX_PLATFORMS=cpu for virtual
+    devices; XLA:CPU lowers reduce-scatter as all-reduce+slice) is
+    refused against an accelerator baseline instead of silently
+    diffing the wrong backend's bytes."""
+    import importlib.util
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        'fusion_audit', os.path.join(repo, 'tools', 'fusion_audit.py'))
+    fa = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fa)
+
+    class _PT:
+        class _mesh:
+            shape = {'dp': 4, 'model': 2}
+        zero = True
+
+    import jax
+    cfg = fa._mesh_config(_PT)
+    assert cfg == {'mesh': {'dp': 4, 'model': 2}, 'zero': True,
+                   'platform': jax.default_backend()}
+
+
+def test_fusion_audit_zero_requires_dp_mesh(tmp_path):
+    """--zero on the default 1-device mesh (or any dp<=1 mesh) must
+    refuse: ZeRO is inert there, so the tool would audit the plain
+    replicated step while claiming 'zero' and gate-pass against the
+    non-zero baseline."""
+    import subprocess
+    import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for extra in ([], ['--mesh', 'model=2']):
+        r = subprocess.run(
+            [sys.executable, 'tools/fusion_audit.py', '--quick',
+             '--zero', '--out', str(tmp_path / 'F.json')] + extra,
+            cwd=repo, capture_output=True, text=True, timeout=120)
+        assert r.returncode != 0, r.stdout + r.stderr
+        assert 'dp axis > 1' in r.stderr, r.stdout + r.stderr
+    # create_mesh's -1 inferred size is circular here (the virtual
+    # device count is provisioned from the mesh product) — refuse
+    # loudly instead of slicing devices with a negative index
+    r = subprocess.run(
+        [sys.executable, 'tools/fusion_audit.py', '--quick',
+         '--mesh', 'dp=-1,model=2',
+         '--out', str(tmp_path / 'F.json')],
+        cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0, r.stdout + r.stderr
+    assert 'explicit positive' in r.stderr, r.stdout + r.stderr

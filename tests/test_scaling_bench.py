@@ -14,7 +14,8 @@ def test_scaling_rows_and_comm_accounting(tmp_path):
     out = tmp_path / 's.json'
     art = bench_scaling.main(['--model', 'mlp', '--dp', '1,2',
                               '--batch-per-chip', '4',
-                              '--iters', '2', '--out', str(out)])
+                              '--iters', '2', '--no-zero-leg',
+                              '--out', str(out)])
     rows = art['rows']
     assert [r['dp'] for r in rows] == [1, 2]
     assert rows[0]['efficiency_pct'] == 100.0
@@ -25,6 +26,37 @@ def test_scaling_rows_and_comm_accounting(tmp_path):
     assert rows[1]['efficiency_pct'] is not None
     saved = json.loads(out.read_text())
     assert saved['weak_scaling'] and saved['rows'] == rows
+    assert saved['zero_update'] is None             # --no-zero-leg
+
+
+def test_scaling_zero_update_leg(tmp_path):
+    """Satellite (docs/PARALLEL.md): the sharded-update leg records
+    per-device optimizer-state bytes, collective bytes/step, and step
+    time for replicated vs MXNET_TPU_ZERO, and the memory ratio on the
+    8-virtual-device mesh lands at <= 1/4 of replicated (ideal 1/8;
+    non-dividing tensors stay replicated, not padded)."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip('needs the 8-device virtual mesh')
+    out = tmp_path / 's.json'
+    art = bench_scaling.main(['--model', 'mlp', '--dp', '1,8',
+                              '--batch-per-chip', '4',
+                              '--iters', '1', '--out', str(out)])
+    leg = art['zero_update']
+    assert leg['dp'] == 8
+    rep, shd = leg['replicated'], leg['sharded']
+    assert rep['opt_state_bytes_per_device'] == \
+        rep['opt_state_bytes_logical'] == shd['opt_state_bytes_logical']
+    assert leg['state_bytes_ratio'] <= 0.25
+    assert shd['opt_state_bytes_per_device'] <= \
+        rep['opt_state_bytes_per_device'] / 4
+    # the sharded step trades the plain all-reduce for a logical
+    # reduce-scatter + all-gather (CPU lowers the former as
+    # all-reduce + slice, so all-gather is the portable signature)
+    assert 'all-gather' in shd['comm_by_kind']
+    assert 'all-gather' not in rep['comm_by_kind']
+    assert shd['ms_per_step'] > 0 and rep['ms_per_step'] > 0
+    assert json.loads(out.read_text())['zero_update'] == leg
 
 
 @pytest.mark.slow

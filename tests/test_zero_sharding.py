@@ -1,0 +1,596 @@
+"""2-D (dp × model) mesh training with the ZeRO-sharded weight update
+(docs/PARALLEL.md): knob-on/knob-off bit-identity (plain, guarded skip
+step, step_n, step_accum, preempt→resume), per-device optimizer-state
+memory, cross-layout checkpoint resume, elastic shrink with the model
+axis preserved, sharding-annotation plumbing, and the eager
+PartitionSpec validation errors.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import (ShardingRules, ShardingSpecError,
+                                validate_spec, zero_update_spec)
+from mxnet_tpu.resilience import CheckpointManager, FaultInjector
+
+BATCH = 16
+NCLASS = 8
+
+
+def _net(seed=0, bn=True):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        if bn:
+            net.add(nn.Dense(32, activation='relu'), nn.BatchNorm(),
+                    nn.Dense(NCLASS))
+        else:
+            net.add(nn.Dense(32, activation='relu'), nn.Dense(NCLASS))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _bat(step, batch=BATCH):
+    rs = np.random.RandomState(100 + step)
+    return (nd.array(rs.randn(batch, 16).astype('float32')),
+            nd.array(rs.randint(0, NCLASS, (batch,)).astype('float32')))
+
+
+def _mesh(axes):
+    import jax
+    n = int(np.prod(list(axes.values())))
+    if len(jax.devices()) < n:
+        pytest.skip('needs the %d-device virtual mesh' % n)
+    return parallel.create_mesh(axes, devices=jax.devices()[:n])
+
+
+def _pt(axes, zero, optimizer='sgd', opt_params=None, guardrail=None,
+        seed=0, annotate=None, bn=True):
+    mesh = _mesh(axes)
+    net = _net(seed, bn=bn)
+    if annotate:
+        net.annotate_sharding(annotate)
+    pt = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer,
+        opt_params or {'learning_rate': 0.1, 'momentum': 0.9}, mesh,
+        guardrail=guardrail, zero=zero)
+    return net, pt
+
+
+def _params_np(net):
+    return [p.data().asnumpy()
+            for k, p in sorted(net.collect_params().items(),
+                               key=lambda kv: kv[0].split('_', 1)[-1])]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity contract
+# ---------------------------------------------------------------------------
+
+def test_zero_bit_identical_to_replicated_10_steps():
+    """Acceptance: dp-only shapes, loss AND params bit-identical with
+    MXNET_TPU_ZERO on vs off over >= 10 steps (momentum state, BN
+    moving stats included)."""
+    runs = []
+    for zero in (False, True):
+        net, pt = _pt({'dp': 8}, zero)
+        losses = [float(pt.step(*_bat(s)).asscalar()) for s in range(10)]
+        runs.append((losses, _params_np(net), pt))
+    (l0, p0, pt0), (l1, p1, pt1) = runs
+    assert not pt0.zero and pt1.zero
+    assert l0 == l1
+    for a, b in zip(p0, p1):
+        np.testing.assert_array_equal(a, b)
+    # and the optimizer state is genuinely dp-sharded, not replicated
+    for a, b in zip(pt0._state_leaves, pt1._state_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert any(s.data.shape != a.shape
+               for a in pt1._state_leaves if a.ndim
+               for s in a.addressable_shards)
+
+
+def test_zero_env_knob_activates(monkeypatch):
+    monkeypatch.setenv('MXNET_TPU_ZERO', '1')
+    net, pt = _pt({'dp': 8}, None)
+    pt.build(*_bat(0))
+    assert pt.zero
+    monkeypatch.setenv('MXNET_TPU_ZERO', '0')
+    net, pt = _pt({'dp': 8}, None)
+    pt.build(*_bat(0))
+    assert not pt.zero
+
+
+def test_zero_inactive_on_single_device_mesh():
+    net, pt = _pt({'dp': 1}, True)
+    pt.build(*_bat(0))
+    assert not pt.zero         # degenerate mesh: nothing to shard over
+
+
+def test_zero_guardrail_skip_step_bit_identical():
+    """Acceptance: bit-identity holds THROUGH a guardrail overflow-skip
+    step — the lax.cond skip branch leaves the dp-sharded optimizer
+    state bit-identical and the scale trajectory matches knob-off."""
+    from mxnet_tpu.guardrail import Guardrail, GuardrailConfig
+    runs = []
+    for zero in (False, True):
+        guard = Guardrail(GuardrailConfig(init_scale=8.0, patience=10),
+                          injector=FaultInjector('nan@grads:2'))
+        net, pt = _pt({'dp': 8}, zero, guardrail=guard)
+        losses = [float(pt.step(*_bat(s)).asscalar()) for s in range(6)]
+        runs.append((losses, _params_np(net),
+                     [e['action'] for e in guard.events],
+                     float(guard.scaler.scale)))
+    (l0, p0, a0, s0), (l1, p1, a1, s1) = runs
+    assert 'skip' in a1 and a0 == a1
+    assert l0 == l1 and s0 == s1
+    for a, b in zip(p0, p1):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero_step_n_and_step_accum_tolerance():
+    """The scanned multi-step program and the grad-accumulation program
+    reuse the same sharded update. step_n knob-on matches knob-off to
+    fp tolerance only (documented divergence, docs/PARALLEL.md: the
+    partitioner keeps the scan carry dp-sharded across iterations and
+    re-orders cross-replica sums); step_accum matches one full-batch
+    step to fp tolerance (documented accum divergence)."""
+    def run_n(zero):
+        net, pt = _pt({'dp': 8}, zero)
+        x = np.stack([_bat(s)[0].asnumpy() for s in range(4)])
+        y = np.stack([_bat(s)[1].asnumpy() for s in range(4)])
+        losses = pt.step_n(nd.array(x), nd.array(y)).asnumpy()
+        return losses, _params_np(net), pt
+
+    l0, p0, _ = run_n(False)
+    l1, p1, pt1 = run_n(True)
+    np.testing.assert_allclose(l0, l1, rtol=1e-6, atol=1e-7)
+    for a, b in zip(p0, p1):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+    # the scanned program keeps the ZeRO memory win: carried optimizer
+    # state (and the in-loop params) stay genuinely dp-sharded
+    assert any(s.data.shape != a.shape
+               for a in pt1._state_leaves if a.ndim
+               for s in a.addressable_shards)
+
+    # step_accum: ZeRO on vs off over the SAME accum program (the
+    # full-batch-vs-accum gap itself is the pre-existing documented
+    # BN-microbatch divergence, not a ZeRO property)
+    def run_acc(zero):
+        net, pt = _pt({'dp': 8}, zero)
+        losses = [float(pt.step_accum(*_bat(s), 2).asscalar())
+                  for s in range(3)]
+        return losses, _params_np(net)
+
+    la0, pa0 = run_acc(False)
+    la1, pa1 = run_acc(True)
+    np.testing.assert_allclose(la0, la1, rtol=1e-6, atol=1e-7)
+    for a, b in zip(pa0, pa1):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_zero_preempt_resume_bit_identical(tmp_path):
+    """Acceptance: preempt→resume cycle under ZeRO walks the exact
+    uninterrupted trajectory (checkpoints hold logical state; the
+    dp-sharded placement is rebuilt at restore)."""
+    net_a, pt_a = _pt({'dp': 8}, True)
+    pt_a.build(*_bat(0))
+    for s in range(6):
+        pt_a.step(*_bat(s))
+
+    net_b, pt_b = _pt({'dp': 8}, True)
+    pt_b.build(*_bat(0))
+    mgr = CheckpointManager(str(tmp_path), prefix='pt')
+    for s in range(3):
+        pt_b.step(*_bat(s))
+    pt_b.save_checkpoint(mgr)
+    assert mgr.latest()[1]['zero'] is True
+
+    net_c, pt_c = _pt({'dp': 8}, True)
+    pt_c.build(*_bat(0))
+    step, plan = pt_c.resume(mgr)
+    assert step == 3 and plan is None
+    for s in range(3, 6):
+        pt_c.step(*_bat(s))
+    for a, b in zip(_params_np(net_a), _params_np(net_c)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# memory + collectives
+# ---------------------------------------------------------------------------
+
+def test_zero_per_device_state_bytes_under_quarter():
+    """Acceptance: per-device optimizer-state bytes <= 1/4 of the
+    replicated footprint on the 8-device mesh (adam doubles the state;
+    the divisible tensors shard to exactly 1/8)."""
+    net0, pt0 = _pt({'dp': 8}, False, optimizer='adam',
+                    opt_params={'learning_rate': 1e-3})
+    pt0.build(*_bat(0))
+    net1, pt1 = _pt({'dp': 8}, True, optimizer='adam',
+                    opt_params={'learning_rate': 1e-3})
+    pt1.build(*_bat(0))
+    rep_dev, rep_log = pt0.optimizer_state_bytes()
+    z_dev, z_log = pt1.optimizer_state_bytes()
+    assert rep_dev == rep_log == z_log
+    assert z_dev <= rep_dev / 4.0, (z_dev, rep_dev)
+
+
+def test_zero_step_emits_all_gather():
+    """The sharded step's HLO carries the closing all-gather of the
+    updated param shards (XLA:CPU lowers the logical reduce-scatter as
+    all-reduce + dynamic-slice; TPU emits reduce-scatter — the audit
+    records whatever the platform emitted)."""
+    from mxnet_tpu.observability.hlo import collective_bytes
+    net, pt = _pt({'dp': 8}, True)
+    pt.build(*_bat(0))
+    total, kinds = collective_bytes(pt.compiled_text())
+    assert 'all-gather' in kinds and total > 0
+    net0, pt0 = _pt({'dp': 8}, False)
+    pt0.build(*_bat(0))
+    _, kinds0 = collective_bytes(pt0.compiled_text())
+    assert 'all-gather' not in kinds0   # replicated update: psum only
+
+
+# ---------------------------------------------------------------------------
+# 2-D mesh + cross-layout resume + elastic
+# ---------------------------------------------------------------------------
+
+def test_2d_zero_matches_dp_only_trajectory():
+    from jax.sharding import PartitionSpec as P
+    net0, pt0 = _pt({'dp': 8}, False)
+    l0 = [float(pt0.step(*_bat(s)).asscalar()) for s in range(4)]
+    net2, pt2 = _pt({'dp': 4, 'model': 2}, True,
+                    annotate={'dense0_weight': P(None, 'model')})
+    l2 = [float(pt2.step(*_bat(s)).asscalar()) for s in range(4)]
+    np.testing.assert_allclose(l2, l0, rtol=1e-4)
+    for a, b in zip(_params_np(net0), _params_np(net2)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    # the annotated weight is genuinely sharded on the model axis
+    w = pt2._param_arrays[0]
+    assert {s.data.shape for s in w.addressable_shards} == {(32, 8)}
+
+
+def test_checkpoint_2d_resumes_on_1d_and_back(tmp_path):
+    """Satellite: a checkpoint saved under a 2-D ZeRO mesh resumes
+    bit-identically on a 1-D replicated dp mesh, and vice versa (same
+    device count; logical state, placement-free)."""
+    def state_np(pt):
+        return ([np.asarray(w) for w in pt._param_arrays],
+                [np.asarray(a) for a in pt._state_leaves])
+
+    net_a, pt_a = _pt({'dp': 4, 'model': 2}, True)
+    pt_a.build(*_bat(0))
+    for s in range(3):
+        pt_a.step(*_bat(s))
+    mgr = CheckpointManager(str(tmp_path / 'a'), prefix='pt')
+    pt_a.save_checkpoint(mgr)
+    net_b, pt_b = _pt({'dp': 8}, False)
+    pt_b.build(*_bat(0))
+    step, plan = pt_b.resume(mgr)
+    assert step == 3 and plan is None
+    for x, y in zip(sum(state_np(pt_a), []), sum(state_np(pt_b), [])):
+        np.testing.assert_array_equal(x, y)
+
+    mgr2 = CheckpointManager(str(tmp_path / 'b'), prefix='pt')
+    pt_b.save_checkpoint(mgr2)
+    net_c, pt_c = _pt({'dp': 4, 'model': 2}, True)
+    pt_c.build(*_bat(0))
+    step, plan = pt_c.resume(mgr2)
+    assert step == 3 and plan is None
+    for x, y in zip(sum(state_np(pt_b), []), sum(state_np(pt_c), [])):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_elastic_shrink_preserves_model_axis(tmp_path):
+    """Satellite: 8→4 elastic shrink of a dp4×model2 ZeRO run — dp
+    halves (accum=2), the model axis survives intact, and the losses
+    track the unshrunk trajectory to fp tolerance (BN-free net: BN
+    microbatch stats under accumulation are the separately documented
+    elastic divergence, docs/RESILIENCE.md)."""
+    import jax
+    net_a, pt_a = _pt({'dp': 4, 'model': 2}, True, bn=False)
+    pt_a.build(*_bat(0))
+    mgr = CheckpointManager(str(tmp_path), prefix='pt')
+    for s in range(3):
+        pt_a.step(*_bat(s))
+    pt_a.save_checkpoint(mgr)
+    ref = [float(pt_a.step(*_bat(s)).asscalar()) for s in range(3, 6)]
+
+    mesh4 = parallel.create_mesh({'dp': 2, 'model': 2},
+                                 devices=jax.devices()[:4])
+    net_b = _net(0, bn=False)
+    pt_b = parallel.ParallelTrainer(
+        net_b, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1, 'momentum': 0.9}, mesh4, zero=True)
+    x0, y0 = _bat(0)
+    pt_b.build(x0[:8], y0[:8])       # microbatch shapes
+    step, plan = pt_b.resume(mgr)
+    assert step == 3
+    assert plan is not None and plan.accum_steps == 2
+    assert plan.new_axes == {'dp': 2, 'model': 2}
+    got = [float(pt_b.step_accum(*_bat(s), 2).asscalar())
+           for s in range(3, 6)]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sharding rules / annotations / validation
+# ---------------------------------------------------------------------------
+
+def test_zero_update_spec_composition():
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh({'dp': 4, 'model': 2})
+    # replicated weight: first dividing dim takes 'dp'
+    assert zero_update_spec(P(), (8, 6), mesh) == P('dp', None)
+    # model-sharded dim is left alone; dp lands on the next free dim
+    assert zero_update_spec(P('model', None), (8, 8), mesh) == \
+        P('model', 'dp')
+    # nothing divides: unchanged (replicated over dp, bit-identity
+    # preferred over padding)
+    assert zero_update_spec(P(), (3, 5), mesh) == P()
+    # scalars pass through
+    assert zero_update_spec(P(), (), mesh) == P()
+    # a param already sharded over 'dp' stays as-is — composing again
+    # would name the mesh axis twice (invalid NamedSharding)
+    assert zero_update_spec(P('dp'), (8, 8), mesh) == P('dp')
+    assert zero_update_spec(P(None, 'dp'), (8, 8), mesh) == \
+        P(None, 'dp')
+
+
+def test_spec_validation_typed_errors():
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh({'dp': 8})
+    with pytest.raises(ShardingSpecError, match="mesh only has axes"):
+        validate_spec('w', P('model'), (32, 16), mesh)
+    with pytest.raises(ShardingSpecError, match='more than once'):
+        validate_spec('w', P('dp', 'dp'), (32, 16), mesh)
+    with pytest.raises(ShardingSpecError, match='does not divide'):
+        validate_spec('w', P('dp'), (10, 16), mesh)
+    with pytest.raises(ShardingSpecError, match='rank'):
+        validate_spec('w', P(None, None, 'dp'), (32, 16), mesh)
+    # the error names the parameter, the spec and the mesh axes
+    try:
+        validate_spec('stage3_weight', P('ghost'), (32,), mesh)
+    except ShardingSpecError as e:
+        msg = str(e)
+        assert 'stage3_weight' in msg and 'ghost' in msg and 'dp' in msg
+
+
+def test_rules_override_validated_eagerly():
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh({'dp': 8})
+    rules = ShardingRules(overrides={'weight': P('tp')})
+    with pytest.raises(ShardingSpecError):
+        rules.spec_for('dense0_weight', (32, 16), mesh)
+
+
+def test_model_axis_heuristic_and_tp_alias():
+    from jax.sharding import PartitionSpec as P
+    rules = ShardingRules()
+    mesh_m = _mesh({'dp': 4, 'model': 2})
+    assert rules.spec_for('w', (32, 16), mesh_m) == P('model', None)
+    assert rules.spec_for('bias', (32,), mesh_m) == P()
+    mesh_tp = _mesh({'dp': 4, 'tp': 2})
+    assert rules.spec_for('w', (32, 16), mesh_tp) == P('tp', None)
+
+
+def test_annotation_wins_over_heuristic():
+    from jax.sharding import PartitionSpec as P
+    rules = ShardingRules()
+    mesh = _mesh({'dp': 4, 'model': 2})
+    spec = rules.spec_for('w', (32, 16), mesh,
+                          annotation=P(None, 'model'))
+    assert spec == P(None, 'model')
+
+
+def test_block_annotate_sharding_plumbs_to_trainer():
+    from jax.sharding import PartitionSpec as P
+    net = _net()
+    n = net.annotate_sharding({'dense1_weight': P(None, 'model')})
+    assert n == 1
+    p = [p for name, p in net.collect_params().items()
+         if 'dense1_weight' in name][0]
+    assert p.sharding == P(None, 'model')
+    with pytest.raises(ValueError, match='no parameter matches'):
+        net.annotate_sharding({'nonexistent': P('model')})
+    # overlapping fragments: FIRST in mapping order wins (same rule as
+    # ShardingRules.spec_for), each param counted once; a fragment
+    # fully shadowed by an earlier broader one raises instead of
+    # silently losing
+    net3 = _net()
+    n3 = net3.annotate_sharding(
+        {'dense0_weight': P(None, 'model'), 'weight': P('model', None)})
+    w0 = [p for name, p in net3.collect_params().items()
+          if 'dense0_weight' in name][0]
+    assert w0.sharding == P(None, 'model')
+    assert n3 == len([name for name in net3.collect_params()
+                      if 'weight' in name])
+    with pytest.raises(ValueError, match='claimed by an earlier'):
+        _net().annotate_sharding(
+            {'weight': P('model', None),
+             'dense0_weight': P(None, 'model')})
+    # a bad annotation surfaces as the typed error at trainer build
+    net2 = _net()
+    net2.annotate_sharding({'dense0_weight': P('ghost')})
+    mesh = _mesh({'dp': 8})
+    pt = parallel.ParallelTrainer(
+        net2, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1}, mesh)
+    with pytest.raises(ShardingSpecError, match='dense0_weight'):
+        pt.build(*_bat(0))
+
+
+def test_module_set_sharding_2d_mesh():
+    """Symbolic-API plumbing: Module.set_sharding lays the params out
+    per the rules on a dp×model mesh and training still matches the
+    single-device trajectory."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    if len(jax.devices()) < 8:
+        pytest.skip('needs the 8-device virtual mesh')
+
+    def fit(ctx, sharded):
+        np.random.seed(3)
+        mx.random.seed(3)
+        data = mx.sym.Variable('data')
+        h = mx.sym.FullyConnected(data, num_hidden=32, name='fc1')
+        h = mx.sym.Activation(h, act_type='relu')
+        h = mx.sym.FullyConnected(h, num_hidden=NCLASS, name='fc2')
+        out = mx.sym.SoftmaxOutput(h, name='softmax')
+        mod = mx.mod.Module(out, context=ctx,
+                            label_names=('softmax_label',))
+        mod.bind(data_shapes=[('data', (BATCH, 12))],
+                 label_shapes=[('softmax_label', (BATCH,))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer='sgd',
+                           optimizer_params={'learning_rate': 0.1})
+        if sharded:
+            mod.set_sharding(
+                overrides={'fc1_weight': P('model', None)},
+                axes={'dp': 4, 'model': 2})
+        rs = np.random.RandomState(7)
+        for _ in range(4):
+            x = nd.array(rs.randn(BATCH, 12).astype('float32'))
+            y = nd.array(rs.randint(0, NCLASS, (BATCH,))
+                         .astype('float32'))
+            mod.forward(mx.io.DataBatch([x], [y]), is_train=True)
+            mod.backward()
+            mod.update()
+        args, _ = mod.get_params()
+        return mod, {k: v.asnumpy() for k, v in args.items()}
+
+    _, ref = fit(mx.cpu(0), False)
+    mod, got = fit([mx.cpu(i) for i in range(8)], True)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=2e-4,
+                                   atol=1e-5, err_msg=k)
+    placed = mod._exec.arg_dict['fc1_weight']._data
+    assert {s.data.shape for s in placed.addressable_shards} \
+        == {(16, 12)}
+    # typed validation at set_sharding time
+    with pytest.raises(ShardingSpecError):
+        mod.set_sharding(overrides={'fc1_weight': P('ghost')},
+                         axes={'dp': 4, 'model': 2})
+    # overrides= and rules= together is ambiguous — refuse, don't
+    # silently drop the overrides
+    with pytest.raises(ValueError, match='not both'):
+        mod.set_sharding(overrides={'fc1_weight': P('model', None)},
+                         rules=ShardingRules())
+    # an override fragment matching no parameter is a typo that would
+    # silently train replicated — same contract as annotate_sharding
+    with pytest.raises(ValueError, match='no parameter matches'):
+        mod.set_sharding(overrides={'fc1_wieght': P('model', None)},
+                         axes={'dp': 4, 'model': 2})
+    # a failed call must not leave the module half-reconfigured: the
+    # previous (2-D) mesh survives both a pre-mesh validation error
+    # and a spec error raised after the mesh rebuild
+    mesh_before = mod._dp_mesh
+    with pytest.raises(ShardingSpecError):
+        mod.set_sharding(overrides={'fc1_weight': P('ghost')},
+                         axes={'dp': 8})
+    assert mod._dp_mesh is mesh_before
+
+
+def test_module_2d_batch_divisible_by_dp_only_still_shards():
+    """The batch shards along 'dp' alone, so a batch that divides dp
+    but not dp*model must stay on the mesh (regression: the gate used
+    the total device count, silently collapsing model-sharded params
+    onto one device)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    if len(jax.devices()) < 8:
+        pytest.skip('needs the 8-device virtual mesh')
+    data = mx.sym.Variable('data')
+    h = mx.sym.FullyConnected(data, num_hidden=32, name='fc1')
+    out = mx.sym.SoftmaxOutput(h, name='softmax')
+    mod = mx.mod.Module(out, context=[mx.cpu(i) for i in range(8)],
+                        label_names=('softmax_label',))
+    # batch 12: divides dp=4, does NOT divide the 8-device mesh
+    mod.bind(data_shapes=[('data', (12, 12))],
+             label_shapes=[('softmax_label', (12,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1})
+    mod.set_sharding(overrides={'fc1_weight': P('model', None)},
+                     axes={'dp': 4, 'model': 2})
+    rs = np.random.RandomState(11)
+    x = nd.array(rs.randn(12, 12).astype('float32'))
+    y = nd.array(rs.randint(0, NCLASS, (12,)).astype('float32'))
+    mod.forward(mx.io.DataBatch([x], [y]), is_train=True)
+    mod.backward()
+    mod.update()
+    assert not getattr(mod, '_dp_odd_warned', False)
+    placed = mod._exec.arg_dict['fc1_weight']._data
+    # still model-sharded across the mesh, not collapsed to one device
+    assert {s.data.shape for s in placed.addressable_shards} \
+        == {(16, 12)}
+    assert len({s.device for s in placed.addressable_shards}) == 8
+
+
+def test_module_undo_dp_collapses_previous_mesh_placement():
+    """_undo_dp must collapse arrays placed under a PREVIOUS mesh
+    object too (regression: set_sharding(axes=...) rebuilds the mesh
+    and the identity check skipped old-mesh placements, leaving params
+    spread across all devices while claiming single-device)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    if len(jax.devices()) < 8:
+        pytest.skip('needs the 8-device virtual mesh')
+    data = mx.sym.Variable('data')
+    h = mx.sym.FullyConnected(data, num_hidden=32, name='fc1')
+    out = mx.sym.SoftmaxOutput(h, name='softmax')
+    mod = mx.mod.Module(out, context=[mx.cpu(i) for i in range(8)],
+                        label_names=('softmax_label',))
+    mod.bind(data_shapes=[('data', (8, 12))],
+             label_shapes=[('softmax_label', (8,))])
+    mod.init_params(mx.init.Xavier())
+    rs = np.random.RandomState(13)
+    x = nd.array(rs.randn(8, 12).astype('float32'))
+    y = nd.array(rs.randint(0, NCLASS, (8,)).astype('float32'))
+    # places the params under the original 1-D Mesh(('dp',) x 8)
+    mod.forward(mx.io.DataBatch([x], [y]), is_train=True)
+    # rebuilds self._dp_mesh as a NEW 2-D mesh object
+    mod.set_sharding(overrides={'fc1_weight': P('model', None)},
+                     axes={'dp': 4, 'model': 2})
+    # batch 6: not divisible by dp=4 → the single-device fallback
+    x6 = nd.array(rs.randn(6, 12).astype('float32'))
+    y6 = nd.array(rs.randint(0, NCLASS, (6,)).astype('float32'))
+    mod.forward(mx.io.DataBatch([x6], [y6]), is_train=True)
+    dev = mod._context.jax_device()
+    for name, holder in mod._exec.arg_dict.items():
+        devs = {s.device for s in holder._data.addressable_shards}
+        assert devs == {dev}, \
+            '%s still spread across %s' % (name, devs)
+
+
+def test_poison_grads_sharded_semantics():
+    """Regression for the scatter miscompile poison_grads used to hit
+    under the SPMD partitioner: on a dp-sharded gradient the poison
+    must corrupt exactly ONE logical element and leave every other bit
+    untouched (the .at[].add spelling overwrote one element per
+    shard)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.guardrail import sentinel
+    mesh = _mesh({'dp': 8})
+    sh = NamedSharding(mesh, P('dp'))
+    g = np.arange(32 * 4, dtype=np.float32).reshape(32, 4) + 0.5
+
+    def f(g, poison):
+        return sentinel.poison_grads([g], poison)[0]
+
+    jf = jax.jit(f, in_shardings=(sh, None), out_shardings=sh)
+    out = np.asarray(jf(jax.device_put(g, sh), jnp.float32(np.nan)))
+    assert np.isnan(out[0, 0])
+    rest = out.copy()
+    rest[0, 0] = g[0, 0]
+    np.testing.assert_array_equal(rest, g)
+    # healthy-step poison (0.0) is the exact identity
+    out0 = np.asarray(jf(jax.device_put(g, sh), jnp.float32(0.0)))
+    np.testing.assert_array_equal(out0, g)

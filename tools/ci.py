@@ -35,6 +35,17 @@ Stages, each timed:
                            fusion count must not regress beyond the
                            MXNET_TPU_FUSION_BUDGET_* knobs
                            (docs/PERFORMANCE.md)
+  3c. sharding             python -m mxnet_tpu.parallel — the 2-D mesh
+                           + ZeRO sharded-update selftest on the
+                           virtual 8-device mesh (docs/PARALLEL.md):
+                           knob-on == knob-off bit-identity over 10
+                           steps and through a guardrail skip step,
+                           per-device optimizer-state bytes <= 1/4 of
+                           replicated, dp×model training on the
+                           dp-only trajectory, 2-D<->1-D checkpoint
+                           resume bit-identity, elastic 8→4 shrink
+                           preserving the model axis, and the eager
+                           typed PartitionSpec validation errors
   4. serving               python -m mxnet_tpu.serving — inference-
                            engine selftest (batched == single-request
                            bit-identity, bounded recompiles, frozen
@@ -118,6 +129,14 @@ def main(argv=None):
         ('fusion-audit', [py, 'tools/fusion_audit.py', '--quick',
                           '--baseline', 'FUSION_BASELINE.json',
                           '--gate', '--out', '/tmp/FUSION.json']),
+        # 2-D (dp × model) mesh + ZeRO sharded-weight-update contract
+        # (docs/PARALLEL.md): bit-identity vs the replicated update
+        # (incl. a guardrail skip step), the 1/dp optimizer-state
+        # memory ratio, cross-layout checkpoint resume, elastic shrink
+        # with the model axis preserved, and eager spec validation
+        ('sharding', [py, '-m', 'mxnet_tpu.parallel',
+                      '--devices', '8',
+                      '--out', '/tmp/SHARDING_SELFTEST.json']),
         ('serving', [py, '-m', 'mxnet_tpu.serving',
                      '--out', '/tmp/SERVE_SELFTEST.json']),
         # closed-loop latency/throughput sweep over the bucket ladder

@@ -42,14 +42,46 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def _build_resnet_program(quick):
+def _make_mesh(mesh_axes):
+    """Mesh from an axes dict (default the degenerate 1-device dp
+    mesh). The caller is responsible for XLA_FLAGS having provisioned
+    enough virtual devices (main() does this before jax loads)."""
     import jax
+    from mxnet_tpu import parallel
+    axes = dict(mesh_axes or {'dp': 1})
+    n = 1
+    for v in axes.values():
+        n *= int(v)
+    if len(jax.devices()) < n:
+        raise SystemExit('fusion_audit: mesh %s needs %d devices, have '
+                         '%d' % (axes, n, len(jax.devices())))
+    return parallel.create_mesh(axes, devices=jax.devices()[:n])
+
+
+def _mesh_config(pt):
+    """The mesh-aware provenance block (mxnet_tpu.fusion.v1 config):
+    axis names+sizes, the ZeRO knob, and the audited platform — the
+    cross-config-diff refusal then distinguishes 1-D from 2-D (and
+    sharded-update) step programs AND refuses to diff a CPU-lowered
+    audit (--mesh setdefaults JAX_PLATFORMS=cpu to provision virtual
+    devices; XLA:CPU lowers reduce-scatter as all-reduce+slice) against
+    an accelerator baseline, instead of comparing their byte totals."""
+    import jax
+    return {'mesh': {k: int(v) for k, v in pt._mesh.shape.items()},
+            'zero': bool(pt.zero),
+            'platform': jax.default_backend()}
+
+
+def _build_resnet_program(quick, mesh_axes=None, zero=False):
     import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, parallel
     from mxnet_tpu.gluon import model_zoo
 
     batch, image = (2, 32) if quick else (128, 224)
+    mesh = _make_mesh(mesh_axes)
+    dp = int(mesh.shape.get('dp', 1))
+    batch = ((batch + dp - 1) // dp) * dp     # batch shards along dp
     np.random.seed(0)
     mx.random.seed(0)
     net = model_zoo.vision.resnet50_v1()
@@ -59,16 +91,16 @@ def _build_resnet_program(quick):
     x = nd.array(np.random.uniform(-1, 1, (batch, 3, image, image)),
                  dtype='float32')
     y = nd.array(np.random.randint(0, 1000, (batch,)))
-    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
     pt = parallel.ParallelTrainer(
         net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9,
-                        'wd': 1e-4}, mesh)
+                        'wd': 1e-4}, mesh, zero=zero)
     pt.build(x, y)
-    return pt, {'model': 'resnet50_v1', 'batch': batch, 'image': image}
+    cfg = {'model': 'resnet50_v1', 'batch': batch, 'image': image}
+    cfg.update(_mesh_config(pt))
+    return pt, cfg
 
 
-def _build_bert_program(quick):
-    import jax
+def _build_bert_program(quick, mesh_axes=None, zero=False):
     import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, parallel
@@ -83,6 +115,9 @@ def _build_bert_program(quick):
         batch, seqlen, npred, vocab = 96, 128, 20, 30522
         net = bert_zoo.bert_12_768_12(vocab_size=vocab, max_length=512,
                                       dropout=0.1)
+    mesh = _make_mesh(mesh_axes)
+    dp = int(mesh.shape.get('dp', 1))
+    batch = ((batch + dp - 1) // dp) * dp     # batch shards along dp
     np.random.seed(0)
     mx.random.seed(0)
     net.initialize(mx.init.Xavier())
@@ -102,23 +137,49 @@ def _build_bert_program(quick):
         return L(mlm_s.reshape((-1, vocab)),
                  my.reshape((-1,))).mean() + L(nsp_s, ny).mean()
 
-    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
     pt = parallel.ParallelTrainer(
         net, pretrain_loss, 'adamw', {'learning_rate': 1e-4,
-                                      'wd': 0.01}, mesh)
+                                      'wd': 0.01}, mesh, zero=zero)
     pt.build([ids, tt, vl, mp], [mlm_y, nsp_y])
-    return pt, {'model': 'bert_12_768_12' if not quick else 'bert-tiny',
-                'batch': batch, 'seqlen': seqlen}
+    cfg = {'model': 'bert_12_768_12' if not quick else 'bert-tiny',
+           'batch': batch, 'seqlen': seqlen}
+    cfg.update(_mesh_config(pt))
+    return pt, cfg
 
 
 _BUILDERS = {'resnet50_step': _build_resnet_program,
              'bert_step': _build_bert_program}
 
 
-def audit_program(name, quick, top=None):
+def _parse_mesh(text):
+    """'dp=4,model=2' -> {'dp': 4, 'model': 2}."""
+    axes = {}
+    for part in text.split(','):
+        if not part.strip():
+            continue
+        try:
+            k, v = part.split('=')
+            axes[k.strip()] = int(v)
+        except ValueError:
+            raise SystemExit(
+                "fusion_audit: bad --mesh entry %r (want axis=size "
+                "pairs like 'dp=4,model=2')" % part)
+        if axes[k.strip()] < 1:
+            # create_mesh's -1 inference needs the device count, which
+            # here is PROVISIONED from the product of these sizes —
+            # circular, so demand explicit sizes
+            raise SystemExit(
+                "fusion_audit: --mesh sizes must be explicit positive "
+                "ints (got %r); the -1 inferred form is not supported "
+                "here because the virtual device count is provisioned "
+                "from the mesh product" % part)
+    return axes
+
+
+def audit_program(name, quick, top=None, mesh_axes=None, zero=False):
     """Build one reference step program and return its fusion artifact."""
     from mxnet_tpu.observability import roofline
-    pt, config = _BUILDERS[name](quick)
+    pt, config = _BUILDERS[name](quick, mesh_axes=mesh_axes, zero=zero)
     config['quick'] = bool(quick)
     text = pt.compiled_text()
     return roofline.roofline_artifact(text, program=name, top=top,
@@ -159,7 +220,41 @@ def main(argv=None):
     p.add_argument('--hlo', default=None, metavar='FILE',
                    help='audit a captured HLO text dump instead of '
                         'building the reference programs')
+    p.add_argument('--mesh', default=None, metavar='AXES',
+                   help="build the step programs on a named mesh, e.g."
+                        " 'dp=4,model=2' (virtual CPU devices are "
+                        'provisioned automatically; recorded in the '
+                        'artifact config so 1-D and 2-D audits never '
+                        'diff against each other)')
+    p.add_argument('--zero', action='store_true',
+                   help='build with the ZeRO dp-sharded weight update '
+                        '(MXNET_TPU_ZERO semantics) — the audit then '
+                        'reports the reduce-scatter/all-gather bytes '
+                        'of the sharded step in its collectives block')
     args = p.parse_args(argv)
+
+    mesh_axes = _parse_mesh(args.mesh) if args.mesh else None
+    if args.zero and int((mesh_axes or {}).get('dp', 1)) <= 1:
+        # ZeRO is inert on dp=1 — without this the audit would build
+        # the plain replicated step while the banner claims 'zero',
+        # and the artifact would gate-pass against the non-zero
+        # baseline
+        raise SystemExit(
+            "fusion_audit: --zero needs a mesh with a dp axis > 1 "
+            "(pass e.g. --mesh dp=4); on the default 1-device mesh "
+            "the sharded update is inert and the audited program "
+            "would be the replicated one")
+    if mesh_axes:
+        n = 1
+        for v in mesh_axes.values():
+            n *= v
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            # before the first jax/mxnet_tpu import below
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=%d'
+                % n).strip()
+            os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 
     from mxnet_tpu.observability import roofline
     from mxnet_tpu.config import get as _cfg
@@ -175,11 +270,15 @@ def main(argv=None):
         wanted = {'resnet': ['resnet50_step'], 'bert': ['bert_step'],
                   'both': ['resnet50_step', 'bert_step']}[args.model]
         for name in wanted:
-            print('== fusion_audit: building %s (%s)'
-                  % (name, 'quick' if args.quick else 'full'),
+            print('== fusion_audit: building %s (%s%s%s)'
+                  % (name, 'quick' if args.quick else 'full',
+                     ', mesh %s' % mesh_axes if mesh_axes else '',
+                     ', zero' if args.zero else ''),
                   flush=True)
             programs[name] = audit_program(name, args.quick,
-                                           top=args.top)
+                                           top=args.top,
+                                           mesh_axes=mesh_axes,
+                                           zero=args.zero)
 
     for name, art in programs.items():
         print(roofline.format_table(art))

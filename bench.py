@@ -78,6 +78,24 @@ def _peak_flops():
     return None, kind
 
 
+def _peak_flops_precision(precision):
+    """Chip peak at a given compute precision: the bf16 MXU rate from
+    the device-kind table, scaled for fp32 by the same rule the
+    roofline reference uses (MXNET_TPU_ROOFLINE_PEAK_TFLOPS_FP32 as a
+    fraction of the bf16 reference peak; default half — the MXU fp32
+    passthrough rate). MFU of an fp32 program against the bf16 peak
+    would understate utilisation 2x (docs/PRECISION.md)."""
+    peak, kind = _peak_flops()
+    if peak and precision == 'fp32':
+        from mxnet_tpu.config import get as _cfg
+        fp32_ref = float(_cfg('MXNET_TPU_ROOFLINE_PEAK_TFLOPS_FP32'))
+        bf16_ref = float(_cfg('MXNET_TPU_ROOFLINE_PEAK_TFLOPS'))
+        ratio = (fp32_ref / bf16_ref) if fp32_ref > 0 and bf16_ref > 0 \
+            else 0.5
+        peak = peak * ratio
+    return peak, kind
+
+
 def _retry_transient(build):
     """Run a fused-step builder, retrying transient tunnel/compile
     transport errors with backoff (resilience.Retry); deterministic
@@ -580,6 +598,145 @@ def bench_input_overlap(on_accel):
     return rec
 
 
+def _amp_ab_trainer(model, on_accel, amp):
+    """Build one side of the AMP A/B (docs/PRECISION.md): the SAME
+    fp32 net, optimizer, seeds, and data for both modes — only the
+    ``amp=`` knob differs, so the measured delta is purely the
+    in-program low-precision compute casts. Returns (trainer, step)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    np.random.seed(0)
+    mx.random.seed(0)
+    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    if model == 'resnet':
+        from mxnet_tpu.gluon import model_zoo
+        batch, image = (128, 224) if on_accel else (8, 64)
+        net = model_zoo.vision.resnet50_v1()
+        net.initialize(mx.init.Xavier())
+        net.hybridize(static_alloc=True, static_shape=True)
+        x = nd.array(np.random.uniform(-1, 1, (batch, 3, image, image)),
+                     dtype='float32')
+        y = nd.array(np.random.randint(0, 1000, (batch,)))
+        pt = parallel.ParallelTrainer(
+            net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9,
+                            'wd': 1e-4}, mesh, amp=amp)
+        pt.step(x, y)   # compile
+        return pt, (lambda: pt.step(x, y)), batch, \
+            'resnet50_v1 bs%d %dpx' % (batch, image)
+    from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+    if on_accel:
+        batch, seqlen, npred, vocab = 96, 128, 20, 30522
+        net = bert_zoo.bert_12_768_12(vocab_size=vocab, max_length=512,
+                                      dropout=0.1)
+    else:
+        batch, seqlen, npred, vocab = 2, 16, 2, 100
+        net = bert_zoo.get_bert('bert_12_768_12', vocab_size=vocab,
+                                max_length=32, units=32, hidden_size=64,
+                                num_layers=2, num_heads=4, dropout=0.1)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    rs = np.random.RandomState(0)
+    ids = nd.array(rs.randint(0, vocab, (batch, seqlen)))
+    tt = nd.array((rs.rand(batch, seqlen) > 0.5).astype('float32'))
+    vl = nd.array(np.full((batch,), seqlen, np.float32))
+    mp = nd.array(rs.randint(0, seqlen, (batch, npred)))
+    mlm_y = nd.array(rs.randint(0, vocab, (batch, npred)))
+    nsp_y = nd.array(rs.randint(0, 2, (batch,)))
+
+    def pretrain_loss(outs, labels):
+        _, _, mlm_s, nsp_s = outs
+        my, ny = labels
+        return L(mlm_s.reshape((-1, vocab)),
+                 my.reshape((-1,))).mean() + L(nsp_s, ny).mean()
+
+    pt = parallel.ParallelTrainer(
+        net, pretrain_loss, 'adamw', {'learning_rate': 1e-4,
+                                      'wd': 0.01}, mesh, amp=amp)
+    pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])   # compile
+    return pt, (lambda: pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])), \
+        batch, ('bert_12_768_12' if on_accel else 'bert-tiny') + \
+        ' bs%d seq%d' % (batch, seqlen)
+
+
+def bench_amp(on_accel, model='resnet'):
+    """AMP A/B (docs/PRECISION.md): the same fp32 model trained through
+    two compiled step programs — amp off vs the bf16 policy — with
+    interleaved min-of-reps slope timing. The record carries both
+    rates, the speedup ratio (the ROADMAP MFU-attack acceptance signal:
+    >= 1.3x resnet50 img/s/chip on a real TPU), and each side's
+    mfu_pct measured against its OWN peak — the fp32 passthrough rate
+    for the off leg, the bf16 MXU rate for the AMP leg — plus the
+    roofline byte totals and detected program precision, and proof the
+    parameter masters stayed float32 in both modes.
+
+    On the CPU CI rig the numbers are still recorded but the speedup
+    is not the acceptance signal: XLA:CPU rewrites bf16 matmuls to f32
+    compute wrapped in converts, so the AMP program can even run
+    slower there (the roofline precision field says which machine the
+    record came from via 'platform').
+    """
+    import jax
+    from mxnet_tpu import nd
+    from mxnet_tpu.observability import roofline
+
+    warmup, iters, reps = (5, 40, 2) if on_accel else (2, 2, 2)
+    flops_per_sample = RESNET50_TRAIN_FLOPS_PER_IMG if model == 'resnet' \
+        else 6 * BERT_BASE_PARAMS * (128 if on_accel else 16)
+
+    sides = {}
+    for mode, amp in (('off', 'off'), ('bf16', 'bf16')):
+        pt, step, batch, tag = _amp_ab_trainer(model, on_accel, amp)
+        sides[mode] = {'pt': pt, 'step': step, 'batch': batch,
+                       'tag': tag}
+    times = {'off': [], 'bf16': []}
+    for _ in range(reps):
+        for mode, side in sides.items():
+            times[mode].append(
+                _measure(side['step'], warmup, iters, nd))
+    rec = {
+        'metric': 'amp_speedup_%s' % ('resnet50' if model == 'resnet'
+                                      else 'bert'),
+        'unit': 'x',
+        'policy': 'bf16',
+        'model': sides['off']['tag'],
+        'platform': jax.default_backend(),
+    }
+    rates = {}
+    for mode, side in sides.items():
+        rate = side['batch'] / min(times[mode])
+        rates[mode] = rate
+        text = side['pt'].compiled_text()
+        precision = roofline.program_precision(text)
+        tflops = rate * flops_per_sample / 1e12
+        peak, _kind = _peak_flops_precision(precision)
+        unit = 'img_per_sec' if model == 'resnet' else 'samples_per_sec'
+        rec['%s_%s' % (unit, mode)] = round(rate, 2)
+        rec['precision_%s' % mode] = precision
+        rec['tflops_per_sec_%s' % mode] = round(tflops, 2)
+        if peak:
+            rec['mfu_pct_%s' % mode] = round(100 * tflops * 1e12 / peak,
+                                             2)
+        try:
+            totals = roofline.analyze(text)[1]
+            rec['hbm_bytes_per_step_%s' % mode] = \
+                totals['hbm_bytes_per_step']
+        except Exception:
+            rec['hbm_bytes_per_step_%s' % mode] = None
+        # the contract the whole subsystem hangs on: fp32 masters
+        # either way (optimizer state checked by tests/test_amp.py)
+        rec['fp32_masters_%s' % mode] = all(
+            str(w.dtype) == 'float32' for w in side['pt']._param_arrays)
+    rec['value'] = round(rates['bf16'] / rates['off'], 3) \
+        if rates['off'] else None
+    noise = 100.0 * max(
+        (max(ts) - min(ts)) / min(ts) for ts in times.values())
+    rec['noise_pct'] = round(noise, 2)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument('--out', default='BENCH.json',
@@ -664,6 +821,16 @@ def main(argv=None):
             error = '%s: %s' % (type(e).__name__, str(e)[:300])
             print('bench: input-overlap A/B leg lost to a transient '
                   'fault (%s)' % error, flush=True)
+    if not handler.stop_requested:
+        try:
+            metrics.append(bench_amp(on_accel))
+        except Exception as e:
+            if not (isinstance(e, InjectedFault) or is_transient(e)):
+                raise
+            verdict = 'degraded'
+            error = '%s: %s' % (type(e).__name__, str(e)[:300])
+            print('bench: amp A/B leg lost to a transient fault (%s)'
+                  % error, flush=True)
 
     if handler.stop_requested:
         # preempted mid-bench: the legs already measured stay in the

@@ -4,18 +4,22 @@ Delegates to bench.py's BERT bench (single source of truth for model
 config, fused-step construction, slope timing, and the JSON metric
 line — including the 'guardrail': on|off label driven by
 MXNET_TPU_GUARDRAIL) so the two entries can never report different
-methodologies. Runs under the degraded-mode contract
+methodologies, plus the BERT AMP A/B leg (amp off vs the bf16 policy
+over the same fp32 model; samples/s + per-precision mfu_pct —
+docs/PRECISION.md). Runs under the degraded-mode contract
 (docs/RESILIENCE.md): writes BENCH_BERT.json with "status": ok |
 degraded | unavailable and exits 0 on a dead or degraded backend.
 """
 
 
 def main():
-    from bench import bench_bert
+    from bench import bench_amp, bench_bert
     from mxnet_tpu.resilience import run_instrument
     return run_instrument(
         'bench_bert',
-        lambda status: {'metrics': [bench_bert(status.state == 'tpu')]},
+        lambda status: {'metrics': [
+            bench_bert(status.state == 'tpu'),
+            bench_amp(status.state == 'tpu', model='bert')]},
         out='BENCH_BERT.json')
 
 

@@ -64,6 +64,7 @@ from . import resilience
 from . import guardrail
 from . import observability
 from . import serving
+from . import amp
 
 # persistent XLA compilation cache (MXNET_TPU_COMPILE_CACHE): applied
 # before any program compiles so restarts warm-start from disk

@@ -1,0 +1,27 @@
+"""Automatic mixed precision (docs/PRECISION.md).
+
+Reference analog: ``mxnet.contrib.amp``. Policy-driven bf16/fp16
+compute with fp32 master weights, threaded through every training
+front-end:
+
+  * ``ParallelTrainer(amp='bf16')`` (or ``MXNET_TPU_AMP=bf16``) — the
+    low-precision compute copies are cast *inside* the one compiled
+    step program; gradients flow in the compute dtype between layers
+    and widen to f32 at each parameter boundary, so the optimizer
+    update, the guardrail sentinel, and checkpoint payloads stay
+    float32 bit-for-bit. Composes with ``MXNET_TPU_ZERO`` and the 2-D
+    mesh unchanged (the sharded update only ever sees f32 leaves).
+  * ``Module.fit(amp='bf16')`` — the symbolic executor's graph
+    evaluator applies the same policy per op.
+  * ``gluon.Trainer(..., amp='bf16')`` — the eager path: pair with
+    ``net.cast('bfloat16')``; the optimizer keeps fp32 master weights
+    (``multi_precision``, which understands bfloat16 as of this PR).
+
+``python -m mxnet_tpu.amp`` runs the CPU-runnable selftest (CI stage
+'amp', tools/ci.py).
+"""
+from .policy import (CAST_COMPUTE_OPS, KEEP_FP32_OPS, Policy, bf16,
+                     current_policy, fp16, resolve, scope)
+
+__all__ = ['Policy', 'bf16', 'fp16', 'resolve', 'scope',
+           'current_policy', 'CAST_COMPUTE_OPS', 'KEEP_FP32_OPS']

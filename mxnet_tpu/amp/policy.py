@@ -1,0 +1,214 @@
+"""AMP policy: which ops compute in low precision, which stay fp32.
+
+Reference analog: ``python/mxnet/contrib/amp/lists/symbol.py`` — the
+FP16_FUNCS / FP32_FUNCS op lists that drive MXNet's automatic mixed
+precision — recast for the trace-and-compile runtime. Instead of
+monkeypatching op wrappers at init time (the reference's
+``amp.init()``), a :class:`Policy` is *scoped over a trace*: while
+active, every op dispatched into a compiled program casts its floating
+inputs according to its class —
+
+  * **cast-to-compute ops** (the MXU matmul family: conv, dense, rnn,
+    attention ``batch_dot``) cast float32 inputs DOWN to the compute
+    dtype, so the parameter entering the op is a low-precision copy of
+    the fp32 master and the op's whole backward runs in low precision;
+  * **keep-fp32 ops** (softmax family, losses, explicit reductions)
+    cast low-precision inputs UP to float32, so probability
+    normalizations and loss accumulations never round in 8-bit
+    mantissa;
+  * everything else passes through in whatever dtype arrives
+    (elementwise chains stay low-precision between matmuls; BatchNorm/
+    LayerNorm keep their own internal f32 statistics — ops/nn.py — and
+    their gamma/beta/moving stats are never cast because no cast-op
+    consumes them).
+
+Because the casts live INSIDE the traced program, the fp32 parameters
+remain the source of truth: ``jax.value_and_grad`` differentiates
+w.r.t. the masters, the ``astype`` vjp widens cotangents back to f32
+at each parameter boundary, and the optimizer update / guardrail
+sentinel / checkpoint payloads all see float32 exactly as without AMP
+(docs/PRECISION.md "bit-exactness contract").
+
+The scope is a no-op when no policy is active and costs one
+thread-local read per op dispatch otherwise; it only affects traced
+dispatches (eager ops never see it), so eager training keeps the
+classic route: ``net.cast('bfloat16')`` + optimizer
+``multi_precision`` master weights.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as onp
+
+from ..base import dtype_name
+
+__all__ = ['Policy', 'resolve', 'scope', 'current_policy',
+           'CAST_COMPUTE_OPS', 'KEEP_FP32_OPS']
+
+# The MXU matmul family: inputs (activations AND weights) cast down to
+# the compute dtype. The weight cast is what turns the fp32 master into
+# the in-program low-precision compute copy.
+CAST_COMPUTE_OPS = frozenset((
+    'FullyConnected', 'Convolution', 'Deconvolution', 'RNN',
+    'dot', 'batch_dot', 'linalg_gemm', 'linalg_gemm2',
+))
+
+# Value-range / accumulation-sensitive ops: inputs widen to float32.
+# Softmax-family normalizations, every loss head, and explicit
+# reductions (a mean over a 50k-logit row in bf16 carries ~2^-8
+# relative error; in f32 it is exact to the roofline's noise floor).
+# NOT BatchNorm/LayerNorm/InstanceNorm: their cores already accumulate
+# statistics in f32 internally and return the input dtype, and casting
+# their activations up would force the downstream matmul to re-cast —
+# two materialized copies for zero extra precision.
+KEEP_FP32_OPS = frozenset((
+    'softmax', 'log_softmax', 'softmin', 'SoftmaxActivation',
+    'SoftmaxOutput', 'Softmax', 'softmax_cross_entropy',
+    'LinearRegressionOutput', 'LogisticRegressionOutput',
+    'MAERegressionOutput', 'MakeLoss', 'CTCLoss', 'ctc_loss',
+    'sum', 'mean', 'nansum', 'nanmean', 'norm', 'moments',
+    'L2Normalization',
+))
+
+_LOW = ('float16', 'bfloat16')
+
+
+class Policy:
+    """One mixed-precision recipe: compute dtype + op classification.
+
+    ``loss_scaling`` marks the recipe as needing dynamic loss scaling
+    (fp16's ~5 exponent bits underflow real gradients; bf16 shares
+    f32's exponent range and needs none). ``ParallelTrainer`` honors it
+    by auto-enabling the in-jit guardrail (PR 2), whose power-of-two
+    dynamic scale + skip-update was built for exactly this.
+    """
+
+    __slots__ = ('name', 'compute_dtype', 'cast_ops', 'fp32_ops',
+                 'loss_scaling')
+
+    def __init__(self, name, compute_dtype, cast_ops=CAST_COMPUTE_OPS,
+                 fp32_ops=KEEP_FP32_OPS, loss_scaling=False):
+        self.name = name
+        self.compute_dtype = onp.dtype(compute_dtype) \
+            if not isinstance(compute_dtype, str) else compute_dtype
+        self.cast_ops = frozenset(cast_ops)
+        self.fp32_ops = frozenset(fp32_ops)
+        overlap = self.cast_ops & self.fp32_ops
+        if overlap:
+            raise ValueError('Policy %r classifies %s as both '
+                             'cast-to-compute and keep-fp32'
+                             % (name, sorted(overlap)))
+        self.loss_scaling = bool(loss_scaling)
+
+    @property
+    def cache_key(self):
+        """Hashable identity for compiled-program caches (executor
+        fwd/bwd): covers the full classification, so two distinct
+        Policy objects that would trace different programs never
+        collide even when they share a display name."""
+        return (self.name, str(self.compute_dtype), self.cast_ops,
+                self.fp32_ops, self.loss_scaling)
+
+    def _np_compute(self):
+        from ..base import np_dtype
+        return np_dtype(self.compute_dtype)
+
+    def cast_op_inputs(self, op_name, arrays):
+        """Apply this policy to one traced op dispatch: returns the
+        (possibly) recast operand list. Only floating arrays move;
+        integer indices/labels and f64 never do."""
+        if op_name in self.cast_ops:
+            tgt = self._np_compute()
+            return [a.astype(tgt)
+                    if getattr(a, 'dtype', None) is not None
+                    and dtype_name(a.dtype) == 'float32' else a
+                    for a in arrays]
+        if op_name in self.fp32_ops:
+            return [a.astype(onp.float32)
+                    if getattr(a, 'dtype', None) is not None
+                    and dtype_name(a.dtype) in _LOW else a
+                    for a in arrays]
+        return arrays
+
+    def __repr__(self):
+        return 'Policy(%s, compute=%s, loss_scaling=%s)' % (
+            self.name, self.compute_dtype, self.loss_scaling)
+
+
+def bf16():
+    """The TPU-native default: bf16 compute, no loss scaling (bf16
+    keeps f32's exponent range)."""
+    return Policy('bf16', 'bfloat16')
+
+
+def fp16():
+    """fp16 compute with dynamic loss scaling — the variant that
+    exercises the PR 2 scaling guardrail for real (fp16's 5 exponent
+    bits underflow unscaled gradients)."""
+    return Policy('fp16', 'float16', loss_scaling=True)
+
+
+_NAMED = {'bf16': bf16, 'bfloat16': bf16, 'fp16': fp16, 'float16': fp16}
+
+
+def resolve(amp=None):
+    """Resolve an ``amp=`` argument to a :class:`Policy` or None (off).
+
+    None reads the ``MXNET_TPU_AMP`` knob (``bf16`` | ``fp16`` |
+    ``off``/unset); False forces off regardless of the knob; True means
+    the default ``bf16`` policy; a string names a policy; a Policy
+    passes through.
+    """
+    if amp is None:
+        from ..config import get as _cfg
+        amp = _cfg('MXNET_TPU_AMP')
+        if amp is None or str(amp).lower() in ('', 'off', '0', 'false'):
+            return None
+    if amp is False:
+        return None
+    if amp is True:
+        return bf16()
+    if isinstance(amp, Policy):
+        return amp
+    key = str(amp).lower()
+    if key in ('off', 'false', '0', ''):
+        return None
+    if key not in _NAMED:
+        raise ValueError(
+            'unknown AMP policy %r (want bf16, fp16, off, or a '
+            'Policy instance; see docs/PRECISION.md)' % (amp,))
+    return _NAMED[key]()
+
+
+# -- trace-time scope -------------------------------------------------------
+
+_tls = threading.local()
+
+
+def current_policy():
+    """The policy active on this thread's trace, or None. Called once
+    per traced op dispatch — keep it a bare attribute read."""
+    return getattr(_tls, 'policy', None)
+
+
+class scope:
+    """Activate a policy for the ops traced inside the ``with`` block
+    (re-entrant; ``scope(None)`` is a true no-op so call sites stay
+    unconditional)."""
+
+    __slots__ = ('_policy', '_prev')
+
+    def __init__(self, policy):
+        self._policy = policy
+
+    def __enter__(self):
+        self._prev = getattr(_tls, 'policy', None)
+        if self._policy is not None:
+            _tls.policy = self._policy
+        return self._policy
+
+    def __exit__(self, *exc):
+        if self._policy is not None:
+            _tls.policy = self._prev
+        return False

@@ -125,6 +125,16 @@ KNOBS = {k.name: k for k in [
           ' nan@grads:2 for the guardrail or preempt@train.step.12:1'
           ' to preempt exactly at step 12).'
           ' CI and tests only; leave unset in production.'),
+    # automatic mixed precision (docs/PRECISION.md)
+    _knob('MXNET_TPU_AMP', str, None,
+          "Default AMP policy ('bf16' | 'fp16' | 'off') for"
+          ' ParallelTrainer / Module.fit / gluon Trainer when no'
+          ' explicit amp= is passed. Low-precision compute copies are'
+          ' cast inside the compiled step; fp32 master weights,'
+          ' optimizer state, guardrail sentinel and checkpoints stay'
+          " float32 (bit-exact resume). 'fp16' auto-enables the"
+          ' dynamic-loss-scaling guardrail. Unset/off keeps every'
+          ' program float32, byte-identical to pre-AMP builds.'),
     # numerical guardrail (docs/GUARDRAILS.md)
     _knob('MXNET_TPU_GUARDRAIL', bool, False,
           'Default-enable the in-jit numerical guardrail (health'
@@ -268,6 +278,12 @@ KNOBS = {k.name: k for k in [
           ' classification (observability.roofline). Fixed reference'
           ' (TPU v5e-class) by default so artifacts diff stably across'
           ' hosts; set to the target chip when auditing for it.'),
+    _knob('MXNET_TPU_ROOFLINE_PEAK_TFLOPS_FP32', float, 0.0,
+          'Reference-chip fp32 peak (TFLOP/s) used when the roofline'
+          ' audits a float32 (non-AMP) program — MFU/ridge against the'
+          ' bf16 peak is meaningless for fp32 compute. 0 (default)'
+          ' derives half the bf16 peak (the MXU fp32 passthrough'
+          ' rate).'),
     _knob('MXNET_TPU_ROOFLINE_HBM_GBPS', float, 819.0,
           'Reference-chip HBM bandwidth (GB/s) for the roofline ridge'
           ' point (peak/bandwidth = flops-per-byte threshold between'

@@ -23,13 +23,19 @@ from . import random as _random
 __all__ = ['Executor']
 
 
-def _build_graph_fn(symbol, training, creation_shapes=None):
+def _build_graph_fn(symbol, training, creation_shapes=None, amp=None):
     """Pure function over {var_name: array} evaluating the symbol graph.
 
     Returns fn(var_values, key) -> (tuple outputs, {aux_name: new_value}).
     creation_shapes: {id(node): shape} resolutions for creation ops with
     unknown (0) dims — e.g. RNN begin_state zeros whose batch dim the
     shape planner deduced (symbol.py _var_shape_plan).
+    amp: an :class:`mxnet_tpu.amp.Policy` (or None) applied per node —
+    the symbolic-graph analog of the traced-NDArray dispatch hook
+    (docs/PRECISION.md): matmul-family ops compute on low-precision
+    copies of the fp32 arguments cast inside THIS compiled graph,
+    softmax/loss/reduction ops widen back to float32, and the bound
+    fp32 arg/aux arrays stay the untouched masters.
     """
     nodes = symbol._nodes()
     entries = symbol._entries
@@ -45,6 +51,8 @@ def _build_graph_fn(symbol, training, creation_shapes=None):
                 continue
             op = node.op
             ins = [vals[id(c)][i] for (c, i) in node.inputs]
+            if amp is not None:
+                ins = amp.cast_op_inputs(op.name, ins)
             attrs = {k: v for k, v in node.attrs.items() if v is not None}
             if id(node) in creation_shapes:
                 attrs['shape'] = creation_shapes[id(node)]
@@ -100,6 +108,7 @@ class Executor:
         self._fwd_cache = {}
         self._bwd_cache = {}
         self._monitor_callback = None
+        self._amp = None
 
     def _as_dict(self, values, names, what, allow_none=False):
         if values is None:
@@ -148,12 +157,24 @@ class Executor:
                 self._creation_cache = {}
         return self._creation_cache
 
+    def set_amp(self, policy):
+        """Install an AMP policy (docs/PRECISION.md) on this executor;
+        subsequent forward/backward graphs apply its per-op casts. The
+        compiled-graph caches are keyed on the policy, so flipping it
+        re-jits instead of silently reusing the other precision's
+        programs."""
+        self._amp = policy
+        return self
+
     def _graph_fn(self, training):
-        if training not in self._fwd_cache:
+        key = (training, self._amp.cache_key if self._amp is not None
+               else None)
+        if key not in self._fwd_cache:
             raw = _build_graph_fn(self._symbol, training,
-                                  self._creation_shapes())
-            self._fwd_cache[training] = (raw, jax.jit(raw))
-        return self._fwd_cache[training]
+                                  self._creation_shapes(),
+                                  amp=self._amp)
+            self._fwd_cache[key] = (raw, jax.jit(raw))
+        return self._fwd_cache[key]
 
     def forward(self, is_train=False, **kwargs):
         """Run forward; returns outputs (reference: executor.py:114)."""
@@ -194,7 +215,8 @@ class Executor:
         return self.outputs
 
     def _bwd_fn(self, training, grad_names):
-        sig = (training, grad_names)
+        sig = (training, grad_names,
+               self._amp.cache_key if self._amp is not None else None)
         if sig not in self._bwd_cache:
             raw_fn, _ = self._graph_fn(training)
 
@@ -271,7 +293,7 @@ class Executor:
                     nd.zeros(shape, dtype=old.dtype)
         return Executor(self._symbol, self._ctx, args=new_args,
                         args_grad=grads, grad_req=self.grad_req,
-                        aux_states=new_aux)
+                        aux_states=new_aux).set_amp(self._amp)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

@@ -206,8 +206,15 @@ class BatchNorm(HybridBlock):
             p.shape = (width,)
 
     def cast(self, dtype):
-        # fp16 statistics destabilise training; keep norm math in fp32
-        if onp.dtype(dtype).name == 'float16':
+        # low-precision statistics destabilise training: an fp16 OR
+        # bfloat16 moving average (8 mantissa bits) quantises the
+        # momentum-0.9 accumulation to ~2^-8 relative steps. Keep
+        # gamma/beta/moving stats float32 — the docs/model_zoo promise
+        # "bf16 training keeps fp32 BN stats" — and let the op core
+        # (ops/nn.py) mix the low-precision activations with the f32
+        # parameters (it upcasts internally and returns input dtype).
+        from ...base import dtype_name
+        if dtype_name(dtype) in ('float16', 'bfloat16'):
             dtype = 'float32'
         super().cast(dtype)
 

@@ -39,7 +39,7 @@ class Trainer:
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore='device', compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, amp=None):
         self._params = self._flatten_params(params)
         self._param2idx = {p.name: i
                            for i, p in enumerate(self._params)}
@@ -51,6 +51,24 @@ class Trainer:
         self._scale = float(optimizer_params.get('rescale_grad', 1.0))
         self._contains_sparse_weight = self._contains_sparse_grad = False
         self._init_optimizer(optimizer, optimizer_params)
+        # eager-path AMP (docs/PRECISION.md): pair ``amp=`` with
+        # ``net.cast('bfloat16')``. The policy forces the optimizer's
+        # multi_precision master-weight protocol on, so low-precision
+        # weights update against fp32 masters (bfloat16-aware as of
+        # this PR) and checkpoint/resume of the optimizer states stays
+        # bit-exact. None reads the MXNET_TPU_AMP knob.
+        from ..amp import resolve as _amp_resolve
+        self._amp_policy = _amp_resolve(amp)
+        if self._amp_policy is not None:
+            self._optimizer.multi_precision = True
+            if self._amp_policy.loss_scaling:
+                warnings.warn(
+                    "amp='%s' on the eager path applies no automatic "
+                    'loss scaling — attach a guardrail '
+                    '(attach_guardrail) and scale the loss with '
+                    'guard.scaler.scale_loss(...) before backward(), '
+                    'or use bf16 (docs/PRECISION.md)'
+                    % self._amp_policy.name, stacklevel=2)
         self._kvstore_params = {'kvstore': kvstore,
                                 'update_on_kvstore': update_on_kvstore}
         self._fused = None  # FusedUpdater once built; False disables
@@ -127,6 +145,14 @@ class Trainer:
     @property
     def optimizer(self):
         return self._optimizer
+
+    @property
+    def amp(self):
+        """Active AMP policy name ('bf16' | 'fp16' | 'off'), resolved
+        from the ``amp=`` arg / ``MXNET_TPU_AMP`` knob at construction
+        (docs/PRECISION.md)."""
+        return self._amp_policy.name if self._amp_policy is not None \
+            else 'off'
 
     def set_learning_rate(self, lr):
         """Set a new learning rate."""

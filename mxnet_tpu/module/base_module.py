@@ -181,7 +181,8 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None, checkpoint_dir=None,
             checkpoint_every_n_steps=None, preempt=None,
-            guardrail=None, locate_nonfinite=False, prefetch=None):
+            guardrail=None, locate_nonfinite=False, prefetch=None,
+            amp=None):
         """The training driver (reference: base_module.py:409).
 
         ``checkpoint_dir`` opts into crash-resumable training: each
@@ -231,6 +232,15 @@ class BaseModule:
         synchronous transfers after ``MXNET_TPU_PREFETCH_TIMEOUT_S``
         with every pulled batch recovered — results are identical
         either way, so resume/rollback bit-exactness is unaffected.
+
+        ``amp`` opts into automatic mixed precision
+        (docs/PRECISION.md): ``'bf16'`` (TPU default) / ``'fp16'`` /
+        ``'off'`` / a Policy; None reads ``MXNET_TPU_AMP``. The
+        compiled forward/backward graphs cast matmul-family ops to the
+        compute dtype inside the program while the bound fp32 arg
+        arrays — what the optimizer updates and checkpoints save —
+        stay float32 masters, so resume stays bit-exact regardless of
+        the knob.
         """
         if num_epoch is None:
             raise AssertionError('please specify number of epochs')
@@ -239,6 +249,12 @@ class BaseModule:
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
+        if hasattr(self, 'set_amp') and \
+                (amp is not None or getattr(self, '_amp', None) is None):
+            # amp=None means "no preference": read the env knob, but
+            # never clobber a policy the caller already installed via
+            # set_amp() before fit
+            self.set_amp(amp)
         if monitor is not None:
             self.install_monitor(monitor)
         self.init_params(initializer=initializer or init_mod.Uniform(0.01),

@@ -70,6 +70,7 @@ class Module(BaseModule):
         self._exec = self._grad_req = None
         self._data_shapes = self._label_shapes = None
         self._params_dirty = False
+        self._amp = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -250,6 +251,8 @@ class Module(BaseModule):
                 req[name] = grad_req
         self._exec = self._symbol.simple_bind(
             ctx=self._context, grad_req=req, **shape_kwargs)
+        if self._amp is not None:
+            self._exec.set_amp(self._amp)
         if self.params_initialized:
             # params were loaded before bind (Module.load) — push them into
             # the fresh executor (reference: module.py bind →
@@ -265,6 +268,36 @@ class Module(BaseModule):
             self.params_initialized = shared_module.params_initialized
         if len(self._context_list) > 1:
             self._build_dp_mesh()
+
+    def set_amp(self, amp=None):
+        """Resolve + install an automatic-mixed-precision policy
+        (docs/PRECISION.md) on this module: the bound executor's
+        compiled forward/backward graphs cast matmul-family ops to the
+        policy's compute dtype and keep softmax/loss/reductions (and
+        the BatchNorm statistic cores) in float32, while the bound
+        fp32 arg arrays — the ones the optimizer updates and
+        checkpoints save — stay float32 masters untouched.
+
+        ``amp`` follows :func:`mxnet_tpu.amp.resolve` semantics (None
+        reads ``MXNET_TPU_AMP``; ``'bf16'``/``'fp16'``/``'off'``/bool/
+        Policy). Returns the resolved policy (or None = off)."""
+        from ..amp import resolve
+        policy = resolve(amp)
+        if policy is not None and policy.loss_scaling:
+            self.logger.warning(
+                'amp=%s: the symbolic fit path applies no dynamic loss '
+                'scaling — fp16 gradients may underflow; prefer bf16 '
+                'here or train through ParallelTrainer (which scales '
+                'via the guardrail, docs/PRECISION.md)', policy.name)
+        self._amp = policy
+        if self._exec is not None:
+            self._exec.set_amp(policy)
+        return policy
+
+    @property
+    def amp(self):
+        """Active AMP policy name ('bf16' | 'fp16' | 'off')."""
+        return self._amp.name if self._amp is not None else 'off'
 
     def _build_dp_mesh(self, axes=None):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec

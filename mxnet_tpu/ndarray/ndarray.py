@@ -21,6 +21,7 @@ from ..context import Context, current_context
 from .. import autograd
 from ..autograd import Entry, TapeNode
 from ..ops import registry as _registry
+from ..amp.policy import current_policy as _amp_current
 from .. import random as _random
 
 __all__ = ['NDArray', 'array', 'zeros', 'ones', 'full', 'empty', 'arange',
@@ -635,6 +636,16 @@ def _invoke_impl(opname, nd_inputs, attrs, out=None):
     # Under an outer trace (CachedOp/pjit) inputs are tracers: call the
     # pure fn directly so the captured graph stays flat for XLA fusion.
     traced = any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+    if traced:
+        # AMP (docs/PRECISION.md): an active policy scope recasts this
+        # op's floating operands — matmul-family ops down to the
+        # compute dtype (the fp32 master becomes an in-program compute
+        # copy), softmax/loss/reduction ops up to f32. Trace-time only:
+        # eager dispatches below never consult the scope.
+        _amp_policy = _amp_current()
+        if _amp_policy is not None:
+            arrays = _amp_policy.cast_op_inputs(op.name, arrays)
 
     from ..config import naive_engine as _naive, bulk_exec as _bulk
     naive = not traced and _naive()

@@ -39,7 +39,7 @@ from .hlo import (DTYPE_BYTES, collective_bytes, iter_instruction_lines,
 
 __all__ = ['SCHEMA', 'Instruction', 'parse_module', 'analyze',
            'roofline_artifact', 'diff_artifacts', 'format_table',
-           'reference_machine']
+           'reference_machine', 'program_precision']
 
 SCHEMA = 'mxnet_tpu.fusion.v1'
 
@@ -278,18 +278,69 @@ def _instr_flops(instr, comps, _depth=0):
 # -- machine model ----------------------------------------------------------
 
 
-def reference_machine():
+def reference_machine(precision='bf16'):
     """Roofline machine parameters: a fixed REFERENCE chip so artifacts
     are stable/diffable regardless of the host that ran the audit (the
     audit usually runs on the CPU CI rig). Defaults are TPU v5e-class
     (197 bf16 TFLOP/s, 819 GB/s HBM); override with
     ``MXNET_TPU_ROOFLINE_PEAK_TFLOPS`` / ``MXNET_TPU_ROOFLINE_HBM_GBPS``.
+
+    ``precision`` picks which peak the ridge point (and any MFU derived
+    from ``peak_flops_per_s``) is measured against: the MXU runs
+    bf16/fp16 matmuls at the full ``PEAK_TFLOPS`` rate but float32 at
+    roughly half of it, so classifying an fp32 (non-AMP) program
+    against the bf16 peak misreads compute-bound fusions as
+    memory-bound and overstates the MFU headroom
+    (docs/PRECISION.md). ``MXNET_TPU_ROOFLINE_PEAK_TFLOPS_FP32``
+    overrides the fp32 peak; its default 0 derives half the bf16 peak.
     """
     from ..config import get as _cfg
     peak = float(_cfg('MXNET_TPU_ROOFLINE_PEAK_TFLOPS')) * 1e12
+    precision = str(precision).lower()
+    if precision in ('fp32', 'float32', 'f32'):
+        fp32_peak = float(_cfg('MXNET_TPU_ROOFLINE_PEAK_TFLOPS_FP32'))
+        peak = fp32_peak * 1e12 if fp32_peak > 0 else peak / 2.0
+        precision = 'fp32'
+    elif precision in ('bf16', 'bfloat16', 'fp16', 'float16', 'f16'):
+        precision = {'bfloat16': 'bf16', 'float16': 'fp16',
+                     'f16': 'fp16'}.get(precision, precision)
+    else:
+        raise ValueError('reference_machine: unknown precision %r '
+                         "(want 'bf16' | 'fp16' | 'fp32')" % (precision,))
     hbm = float(_cfg('MXNET_TPU_ROOFLINE_HBM_GBPS')) * 1e9
     return {'peak_flops_per_s': peak, 'hbm_bytes_per_s': hbm,
-            'ridge_flops_per_byte': peak / hbm}
+            'ridge_flops_per_byte': peak / hbm,
+            'precision': precision}
+
+
+_LOW_MATMUL_RE = re.compile(
+    r'\b(?:dot|convolution)(?:\.\d+)?\(')
+_FP16_TYPE_RE = re.compile(r'(?<!b)f16\[')
+
+
+def program_precision(hlo_text):
+    """Compute precision of a program, read from the HLO text:
+    ``'bf16'``/``'fp16'`` when the program carries low-precision
+    buffers, else ``'fp32'``. Drives which peak the roofline
+    classifies against.
+
+    Matmul operands are checked first — on an accelerator an AMP
+    policy's casts sit directly on the dot/convolution inputs — but
+    any low-precision buffer elsewhere also marks the program
+    (XLA:CPU rewrites bf16 dots/convs to f32 compute wrapped in
+    converts, so on the CI rig the matmul lines alone would misread
+    an AMP program as fp32)."""
+    fp16_any = bf16_any = False
+    for line in iter_instruction_lines(hlo_text):
+        has_bf16 = 'bf16[' in line
+        has_fp16 = bool(_FP16_TYPE_RE.search(line))
+        if has_bf16 and _LOW_MATMUL_RE.search(line):
+            return 'bf16'
+        bf16_any = bf16_any or has_bf16
+        fp16_any = fp16_any or has_fp16
+    if bf16_any:
+        return 'bf16'
+    return 'fp16' if fp16_any else 'fp32'
 
 
 # -- analysis ---------------------------------------------------------------
@@ -321,9 +372,12 @@ def _gather_ops(instr, comps, limit=6):
 def analyze(hlo_text, machine=None):
     """Roofline rows for every material instruction reachable from the
     entry computation. Returns ``(rows, totals)``; rows sorted by bytes
-    descending."""
+    descending. ``machine`` defaults to the reference machine at the
+    program's own compute precision (:func:`program_precision`): an
+    fp32 program classifies against the fp32 peak, an AMP program
+    against the bf16/fp16 MXU peak."""
     comps, entry = parse_module(hlo_text)
-    machine = machine or reference_machine()
+    machine = machine or reference_machine(program_precision(hlo_text))
     ridge = machine['ridge_flops_per_byte']
     rows = []
     totals = {'hbm_bytes_per_step': 0, 'flops_per_step': 0,
@@ -382,7 +436,7 @@ def roofline_artifact(hlo_text, program='unknown', machine=None,
     image size, ...) recorded verbatim so diffs can refuse to compare
     apples to oranges.
     """
-    machine = machine or reference_machine()
+    machine = machine or reference_machine(program_precision(hlo_text))
     rows, totals = analyze(hlo_text, machine=machine)
     coll_total, coll_kinds = collective_bytes(hlo_text)
     totals['collective_bytes_per_step'] = coll_total
@@ -456,11 +510,13 @@ def format_table(artifact, top=12):
         'HBM bytes/step: %.4g   flops/step: %.4g   fusions: %d   '
         'instrs: %d' % (t['hbm_bytes_per_step'], t['flops_per_step'],
                         t['fusion_count'], t['instruction_count']),
-        'memory-bound bytes: %.4g (%.1f%%)   ridge: %.1f flop/B' % (
+        'memory-bound bytes: %.4g (%.1f%%)   ridge: %.1f flop/B '
+        '(%s peak)' % (
             t['memory_bound_bytes'],
             100.0 * t['memory_bound_bytes']
             / max(t['hbm_bytes_per_step'], 1),
-            artifact['machine']['ridge_flops_per_byte']),
+            artifact['machine']['ridge_flops_per_byte'],
+            artifact['machine'].get('precision', 'bf16')),
     ]
     coll = artifact.get('collectives') or {}
     if coll:

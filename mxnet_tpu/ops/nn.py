@@ -751,10 +751,15 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if training and not use_global_stats:
         out, mean, var = _bn_train_core(data, g, beta, float(eps), ax)
-        # batch stats keep the data dtype (the pre-vjp contract): a
-        # f32 return would silently promote bf16-cast moving-stat
-        # params on their first momentum update and force a retrace
-        return out, mean.astype(data.dtype), var.astype(data.dtype)
+        # batch stats follow the MOVING-stat dtype, not the data dtype:
+        # with f32 running stats under bf16 activations (the
+        # net.cast('bfloat16') contract, gluon.nn.BatchNorm.cast) the
+        # momentum update accumulates unquantized f32 batch statistics,
+        # while an all-bf16 cache keeps its param dtype stable (an
+        # unconditional f32 return would silently promote bf16 moving
+        # stats on their first update and force a retrace)
+        stat_dt = moving_mean.dtype
+        return out, mean.astype(stat_dt), var.astype(stat_dt)
     mean, var = moving_mean, moving_var
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]

@@ -23,7 +23,7 @@ import warnings
 
 import numpy
 
-from ..base import string_types
+from ..base import dtype_name, string_types
 from .. import ndarray as nd
 from ..ndarray import NDArray, zeros, invoke
 
@@ -63,6 +63,16 @@ def create(name, **kwargs):
 def _fresh(weight):
     """A zero state buffer shaped/typed/placed like ``weight``."""
     return zeros(weight.shape, dtype=weight.dtype, ctx=weight._ctx)
+
+
+def _is_low_precision(dtype):
+    """True for the dtypes whose weights need an fp32 master under
+    ``multi_precision`` — float16 AND bfloat16 (bf16 keeps f32's
+    exponent range but only 8 mantissa bits, so accumulating updates
+    in bf16 stalls convergence exactly like fp16 does; the reference's
+    fp16-only check predates bf16 hardware). Compared by NAME because
+    bfloat16 is an ml_dtypes extended dtype, not a numpy builtin."""
+    return dtype_name(dtype) in ('float16', 'bfloat16')
 
 
 class Optimizer:
@@ -115,22 +125,25 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        """fp16 master-weight wrapper (reference: optimizer.py:270)."""
-        if weight.dtype == numpy.float16:
+        """fp16/bf16 master-weight wrapper (reference: optimizer.py:270;
+        extended to bfloat16 — the TPU compute dtype needs the same
+        fp32 accumulator)."""
+        if _is_low_precision(weight.dtype):
             if self.multi_precision:
                 master = weight.astype(numpy.float32)
                 return (master, self.create_state(index, master))
-            warnings.warn('Accumulating with float16 in optimizer can lead '
-                          'to poor accuracy or slow convergence. Consider '
-                          'using multi_precision=True option of the '
-                          'optimizer')
+            warnings.warn('Accumulating with %s in optimizer can lead '
+                          'to poor accuracy or slow convergence. '
+                          'Consider using multi_precision=True option '
+                          'of the optimizer'
+                          % dtype_name(weight.dtype))
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             master, master_state = state
             self.update(index, master, grad.astype(numpy.float32),
                         master_state)
@@ -265,7 +278,7 @@ class SGD(Optimizer):
         self._update_impl(index, weight, grad, state, multi_precision=False)
 
     def update_multi_precision(self, index, weight, grad, state):
-        use_mp = self.multi_precision and weight.dtype == numpy.float16
+        use_mp = self.multi_precision and _is_low_precision(weight.dtype)
         self._update_impl(index, weight, grad, state, multi_precision=use_mp)
 
     def _update_impl(self, index, weight, grad, state, multi_precision=False):

@@ -154,8 +154,10 @@ class ParallelTrainer:
     """
 
     def __init__(self, net, loss, optimizer='sgd', optimizer_params=None,
-                 mesh=None, rules=None, guardrail=None, zero=None):
+                 mesh=None, rules=None, guardrail=None, zero=None,
+                 amp=None):
         from ..optimizer import optimizer as _optmod
+        from ..amp import resolve as _amp_resolve
         self._net = net
         self._loss = loss
         self._opt_params = dict(optimizer_params or {})
@@ -169,7 +171,22 @@ class ParallelTrainer:
                 optimizer, **self._opt_params)
         else:
             self._opt = optimizer
+        self._amp_policy = _amp_resolve(amp)
         self._guard = _resolve_guardrail(guardrail)
+        if self._amp_policy is not None and \
+                self._amp_policy.loss_scaling and self._guard is None:
+            if guardrail is False:
+                import logging
+                logging.warning(
+                    'amp=%s needs dynamic loss scaling but guardrail '
+                    'is explicitly disabled — fp16 gradients WILL '
+                    'underflow unscaled (docs/PRECISION.md)',
+                    self._amp_policy.name)
+            else:
+                # fp16's 5 exponent bits underflow real gradients; the
+                # PR 2 in-jit guardrail IS the loss-scaling machinery,
+                # so the fp16 policy turns it on by default
+                self._guard = _resolve_guardrail(True)
         self._gstate = None
         self._preempt = None
         self._watchdog = None
@@ -203,6 +220,14 @@ class ParallelTrainer:
         (resolved from the ``zero=`` arg / ``MXNET_TPU_ZERO`` at build;
         False before the first build and on dp=1 meshes)."""
         return self._zero
+
+    @property
+    def amp(self):
+        """Active AMP policy name ('bf16' | 'fp16' | 'off'),
+        resolved from the ``amp=`` arg / ``MXNET_TPU_AMP`` knob at
+        construction (docs/PRECISION.md)."""
+        return self._amp_policy.name if self._amp_policy is not None \
+            else 'off'
 
     def optimizer_state_bytes(self):
         """Optimizer-state memory accounting of the built step:
@@ -297,6 +322,7 @@ class ParallelTrainer:
         state = self.snapshot()
         state['mesh'] = mesh_meta(self._mesh)
         state['zero'] = bool(self._zero)
+        state['amp'] = self.amp
         state['rng'] = _random.get_state()
         if extra:
             state.update(extra)
@@ -363,6 +389,16 @@ class ParallelTrainer:
                 'is built with zero=%s — state re-placed under the '
                 "trainer's layout (values unchanged)",
                 state['zero'], self._zero)
+        if state.get('amp') is not None and state['amp'] != self.amp:
+            # compute-precision-only difference: checkpoints hold the
+            # fp32 masters either way, so the restored VALUES are
+            # bit-identical — but the loss trajectory ahead will follow
+            # the new compute precision
+            import logging
+            logging.info(
+                'resume: checkpoint was written with amp=%s, trainer '
+                'runs amp=%s — fp32 masters restored unchanged',
+                state['amp'], self.amp)
         self.restore(state)
         return step, plan
 
@@ -385,30 +421,49 @@ class ParallelTrainer:
         none_pat = tuple(a is None for a in xs)
         xs_live = [a for a in xs if a is not None]
 
+        from ..amp.policy import scope as _amp_scope
+        amp_policy = self._amp_policy
+
         def loss_of(key, param_arrays, data_arrays, label_arrays):
             # re-insert the None placeholders (optional masks etc.) that
             # were stripped from the jit operand list
             full_in, it = [], iter(data_arrays)
             for is_none in none_pat:
                 full_in.append(None if is_none else next(it))
-            outs, auxs = fwd(key, list(param_arrays), full_in)
-            nd_outs = [NDArray(o) for o in outs]
-            nd_labels = [NDArray(a) for a in label_arrays]
-            prev = autograd.set_training(True)
-            try:
-                with _random.key_override(key):
-                    if callable(loss_obj) and not hasattr(loss_obj,
-                                                          '_forward_impl'):
-                        loss = loss_obj(
-                            nd_outs if len(nd_outs) > 1 else nd_outs[0],
-                            nd_labels if len(nd_labels) > 1 else
-                            nd_labels[0])
-                    else:
-                        loss = loss_obj._forward_impl(nd_outs[0],
-                                                      nd_labels[0])
-            finally:
-                autograd.set_training(prev)
-            return jnp.mean(loss._data), auxs
+            # AMP (docs/PRECISION.md): under the policy scope every op
+            # traced below — the forward AND the loss — recasts its
+            # operands per class: matmul-family ops compute on low-
+            # precision copies of the fp32 masters (cast inside THIS
+            # program), softmax/loss ops widen back to f32. The grads
+            # value_and_grad returns are w.r.t. the fp32 masters (the
+            # astype vjp widens cotangents at each param boundary), so
+            # the update below runs in float32 exactly as without AMP.
+            with _amp_scope(amp_policy):
+                outs, auxs = fwd(key, list(param_arrays), full_in)
+                nd_outs = [NDArray(o) for o in outs]
+                nd_labels = [NDArray(a) for a in label_arrays]
+                prev = autograd.set_training(True)
+                try:
+                    with _random.key_override(key):
+                        if callable(loss_obj) and \
+                                not hasattr(loss_obj, '_forward_impl'):
+                            loss = loss_obj(
+                                nd_outs if len(nd_outs) > 1
+                                else nd_outs[0],
+                                nd_labels if len(nd_labels) > 1 else
+                                nd_labels[0])
+                        else:
+                            loss = loss_obj._forward_impl(nd_outs[0],
+                                                          nd_labels[0])
+                finally:
+                    autograd.set_training(prev)
+            loss_val = loss._data
+            if amp_policy is not None:
+                # the mean (and the guardrail's scaled-loss product)
+                # accumulate in f32 even for a custom low-precision
+                # loss callable; no-op when the loss is already f32
+                loss_val = loss_val.astype(jnp.float32)
+            return jnp.mean(loss_val), auxs
 
         # optimizer states (created eagerly; leaves become jit operands)
         param_arrays = tuple(p.data()._data for p in params)

@@ -1,0 +1,507 @@
+"""Automatic mixed precision (docs/PRECISION.md): policy resolution and
+per-op cast classes, the fp32-master contract through the compiled step
+(bit-exact checkpoint resume, cross-precision resume, AMP x ZeRO on the
+virtual 8-device mesh), the fp16 loss-scaling guardrail overflow ->
+skip -> replay path, the eager gluon Trainer master-weight protocol,
+and the precision-aware roofline reference.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon, nd, parallel
+from mxnet_tpu.amp import Policy, current_policy, resolve, scope
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import CheckpointManager, FaultInjector
+
+NCLASS = 4
+FEATS = 6
+BATCH = 16
+
+
+def _net(seed=0, bn=False):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        if bn:
+            net.add(nn.Dense(16, activation='relu'), nn.BatchNorm(),
+                    nn.Dense(NCLASS))
+        else:
+            net.add(nn.Dense(16, activation='relu'), nn.Dense(NCLASS))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+_W_TRUE = np.random.RandomState(9).randn(FEATS, NCLASS)
+
+
+def _bat(step, batch=BATCH):
+    # learnable fixed linear rule so short trajectories actually descend
+    rs = np.random.RandomState(100 + step)
+    x = rs.randn(batch, FEATS).astype('float32')
+    y = (x @ _W_TRUE).argmax(1).astype('float32')
+    return nd.array(x), nd.array(y)
+
+
+def _pt(amp_arg=None, dp=1, zero=False, guardrail=None, seed=0,
+        bn=False, **kw):
+    import jax
+    n = dp
+    if len(jax.devices()) < n:
+        pytest.skip('needs the %d-device virtual mesh' % n)
+    mesh = parallel.create_mesh({'dp': dp}, devices=jax.devices()[:n])
+    net = _net(seed, bn=bn)
+    pt = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1, 'momentum': 0.9}, mesh, zero=zero,
+        guardrail=guardrail, amp=amp_arg, **kw)
+    return net, pt
+
+
+def _run(pt, n, batch=BATCH, start=0):
+    out = []
+    for i in range(start, start + n):
+        x, y = _bat(i, batch)
+        out.append(float(pt.step(x, y).asscalar()))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# policy + scope
+# ---------------------------------------------------------------------------
+
+def test_policy_resolution_matrix():
+    assert resolve('bf16').name == 'bf16'
+    assert resolve('bfloat16').compute_dtype == 'bfloat16'
+    assert not resolve('bf16').loss_scaling
+    assert resolve('fp16').loss_scaling
+    assert resolve('off') is None
+    assert resolve(False) is None
+    assert resolve(True).name == 'bf16'
+    p = amp.bf16()
+    assert resolve(p) is p
+    with pytest.raises(ValueError):
+        resolve('int7')
+    with pytest.raises(ValueError):
+        Policy('bad', 'bfloat16', cast_ops=('dot',), fp32_ops=('dot',))
+
+
+def test_policy_env_knob():
+    from mxnet_tpu import config
+    assert os.environ.get('MXNET_TPU_AMP') in (None, '')
+    assert resolve(None) is None            # knob unset -> off
+    config.set('MXNET_TPU_AMP', 'fp16')
+    try:
+        assert resolve(None).name == 'fp16'
+        # an explicit False beats the knob
+        assert resolve(False) is None
+    finally:
+        config.unset('MXNET_TPU_AMP')
+
+
+def test_policy_cast_classification():
+    import jax.numpy as jnp
+    p = resolve('bf16')
+    f32 = jnp.ones((2, 3), jnp.float32)
+    i32 = jnp.ones((2,), jnp.int32)
+    lo = f32.astype(jnp.bfloat16)
+    # matmul family: f32 operands cast DOWN, ints untouched
+    w, idx = p.cast_op_inputs('FullyConnected', [f32, i32])
+    assert str(w.dtype) == 'bfloat16' and str(idx.dtype) == 'int32'
+    # keep-fp32 family: low-precision operands widen UP
+    up, = p.cast_op_inputs('softmax_cross_entropy', [lo])
+    assert str(up.dtype) == 'float32'
+    # unlisted ops: operands pass through by identity
+    same, = p.cast_op_inputs('Activation', [lo])
+    assert same is lo
+
+
+def test_scope_reentrant():
+    p = resolve('bf16')
+    assert current_policy() is None
+    with scope(p):
+        assert current_policy() is p
+        with scope(None):                  # no-op, not a clear
+            assert current_policy() is p
+        with scope(resolve('fp16')):
+            assert current_policy().name == 'fp16'
+        assert current_policy() is p
+    assert current_policy() is None
+
+
+# ---------------------------------------------------------------------------
+# compiled-step contract (ParallelTrainer)
+# ---------------------------------------------------------------------------
+
+def test_amp_off_bit_identical_to_no_amp():
+    _, pt0 = _pt(None, seed=0)
+    l0 = _run(pt0, 3)
+    _, pt1 = _pt('off', seed=0)
+    l1 = _run(pt1, 3)
+    assert l0 == l1
+    for a, b in zip(pt0._param_arrays, pt1._param_arrays):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    text = pt1.compiled_text()
+    assert 'bf16[' not in text
+
+
+def test_bf16_loss_trajectory_tracks_fp32():
+    """Acceptance: fp32-vs-bf16 loss trajectories agree to bf16
+    tolerance over 10 steps — same data, same seeds, only the amp knob
+    differs — and both actually learn."""
+    _, pt32 = _pt('off', seed=0, bn=True)
+    l32 = _run(pt32, 10)
+    _, pt16 = _pt('bf16', seed=0, bn=True)
+    l16 = _run(pt16, 10)
+    assert all(np.isfinite(l16))
+    # bf16 carries ~2^-8 relative mantissa; a 10-step compounding
+    # trajectory stays within a few percent on this scale of model
+    np.testing.assert_allclose(l16, l32, rtol=6e-2)
+    assert l16[-1] < l16[0] and l32[-1] < l32[0]
+
+
+def test_bf16_step_casts_inside_program_masters_stay_f32():
+    _, pt = _pt('bf16')
+    _run(pt, 1)
+    assert pt.amp == 'bf16'
+    assert 'bf16[' in pt.compiled_text()
+    for w in pt._param_arrays:
+        assert str(w.dtype) == 'float32'
+    for s in pt._state_leaves:
+        assert str(s.dtype) == 'float32'
+
+
+def test_master_checkpoint_resume_bit_exact():
+    """Acceptance: fp32 master weights bit-exact across save->resume
+    with the knob on, and the resumed run replays the same losses."""
+    d = tempfile.mkdtemp()
+    _, pt = _pt('bf16', seed=0)
+    _run(pt, 4)
+    mgr = CheckpointManager(d, prefix='amp')
+    pt.save_checkpoint(mgr)
+    snap = [np.asarray(w) for w in pt._param_arrays]
+    leaves = [np.asarray(a) for a in pt._state_leaves]
+    tail = _run(pt, 3, start=4)
+
+    _, pt2 = _pt('bf16', seed=1)        # different init: resume must win
+    x, y = _bat(0)
+    pt2.build(x, y)
+    assert pt2.resume(mgr) is not None
+    for a, b in zip(snap, pt2._param_arrays):
+        assert np.array_equal(a, np.asarray(b))
+    for a, b in zip(leaves, pt2._state_leaves):
+        assert np.array_equal(a, np.asarray(b))
+    assert _run(pt2, 3, start=4) == tail
+
+
+def test_cross_precision_resume_bit_exact():
+    """The checkpoint payload is precision-independent: a bf16-trainer
+    checkpoint restores bit-identically into an amp-off trainer (and
+    the reverse), because only fp32 masters are ever saved."""
+    d = tempfile.mkdtemp()
+    _, pt = _pt('bf16', seed=0)
+    _run(pt, 3)
+    mgr = CheckpointManager(d, prefix='xp')
+    pt.save_checkpoint(mgr)
+    snap = [np.asarray(w) for w in pt._param_arrays]
+
+    _, pt_off = _pt('off', seed=1)
+    x, y = _bat(0)
+    pt_off.build(x, y)
+    pt_off.resume(mgr)
+    for a, b in zip(snap, pt_off._param_arrays):
+        assert np.array_equal(a, np.asarray(b))
+
+    # and back: train the off trainer on, save, resume under bf16
+    _run(pt_off, 2, start=3)
+    mgr2 = CheckpointManager(tempfile.mkdtemp(), prefix='xp2')
+    pt_off.save_checkpoint(mgr2)
+    snap2 = [np.asarray(w) for w in pt_off._param_arrays]
+    _, pt16 = _pt('bf16', seed=2)
+    pt16.build(x, y)
+    pt16.resume(mgr2)
+    for a, b in zip(snap2, pt16._param_arrays):
+        assert np.array_equal(a, np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# fp16 + dynamic loss scaling (the PR 2 guardrail, for real this time)
+# ---------------------------------------------------------------------------
+
+def test_fp16_auto_enables_guardrail():
+    _, pt = _pt('fp16')
+    assert pt.amp == 'fp16'
+    assert pt.guardrail is not None
+
+
+def test_fp16_overflow_skip_replay():
+    """Acceptance: guardrail overflow -> skip -> replay under fp16 loss
+    scaling. The injected-NaN step leaves params AND optimizer state
+    bit-identical, halves the scale, and training continues finite."""
+    from mxnet_tpu.guardrail import Guardrail, GuardrailConfig
+    guard = Guardrail(GuardrailConfig(init_scale=1024.0, check_every=0),
+                      injector=FaultInjector('nan@grads:1'))
+    _, pt = _pt('fp16', guardrail=guard)
+    x, y = _bat(0)
+    pt.build(x, y)
+    before = [np.asarray(w) for w in pt._param_arrays]
+    leaves = [np.asarray(a) for a in pt._state_leaves]
+    pt.step(x, y)                       # poisoned -> skipped in-jit
+    for a, b in zip(before, pt._param_arrays):
+        assert np.array_equal(a, np.asarray(b))
+    for a, b in zip(leaves, pt._state_leaves):
+        assert np.array_equal(a, np.asarray(b))
+    assert float(pt._gstate[0]) == 512.0
+    losses = _run(pt, 4, start=1)       # replay: healthy steps learn
+    assert all(np.isfinite(losses))
+    assert any(not np.array_equal(a, np.asarray(b))
+               for a, b in zip(before, pt._param_arrays))
+    guard.flush()
+
+
+# ---------------------------------------------------------------------------
+# AMP x ZeRO on the virtual 8-device mesh
+# ---------------------------------------------------------------------------
+
+def test_amp_zero_masters_bit_exact_across_zero_knob():
+    """Acceptance: fp32 masters bit-exact across MXNET_TPU_ZERO on/off
+    with amp=bf16 on the virtual 8-device mesh — the sharded update
+    only ever sees the f32 leaves, so AMP composes with ZeRO
+    unchanged."""
+    runs = {}
+    for zero in (False, True):
+        _, pt = _pt('bf16', dp=8, zero=zero, seed=0, bn=True)
+        losses = _run(pt, 6)
+        runs[zero] = (losses,
+                      [np.asarray(w) for w in pt._param_arrays],
+                      [np.asarray(a) for a in pt._state_leaves],
+                      pt)
+    assert runs[False][0] == runs[True][0]
+    for a, b in zip(runs[False][1], runs[True][1]):
+        assert np.array_equal(a, b)
+    for a, b in zip(runs[False][2], runs[True][2]):
+        assert np.array_equal(a, b)
+    assert runs[True][3].zero and runs[True][3].amp == 'bf16'
+    for w in runs[True][1]:
+        assert str(w.dtype) == 'float32'
+
+
+def test_amp_zero_checkpoint_cross_layout():
+    """bf16+ZeRO checkpoint resumes bit-identically into a replicated
+    bf16 trainer: masters are layout- AND precision-independent."""
+    d = tempfile.mkdtemp()
+    _, pt = _pt('bf16', dp=8, zero=True, seed=0)
+    _run(pt, 3)
+    mgr = CheckpointManager(d, prefix='az')
+    pt.save_checkpoint(mgr)
+    snap = [np.asarray(w) for w in pt._param_arrays]
+    _, pt2 = _pt('bf16', dp=8, zero=False, seed=1)
+    x, y = _bat(0)
+    pt2.build(x, y)
+    pt2.resume(mgr)
+    for a, b in zip(snap, pt2._param_arrays):
+        assert np.array_equal(a, np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Module.fit + eager gluon Trainer fronts
+# ---------------------------------------------------------------------------
+
+def test_module_fit_amp():
+    np.random.seed(7)
+    N, D, C = 128, 8, 4
+    X = np.random.randn(N, D).astype('float32')
+    W = np.random.randn(D, C).astype('float32')
+    Y = (X @ W).argmax(1).astype('float32')
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=16)
+    act = mx.sym.Activation(data=fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=C)
+    net = mx.sym.SoftmaxOutput(data=fc2, name='softmax')
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.3, 'momentum': 0.9,
+                              'rescale_grad': 1.0 / 32},
+            initializer=mx.init.Xavier(), eval_metric='acc',
+            num_epoch=6, amp='bf16')
+    assert mod.amp == 'bf16'
+    # the bound fp32 arg arrays stay the masters
+    args, _ = mod.get_params()
+    for name, arr in args.items():
+        assert str(arr.dtype) == 'float32', name
+    val = mx.io.NDArrayIter(X, Y, batch_size=32)
+    assert mod.score(val, 'acc')[0][1] > 0.8
+
+
+def test_module_fit_preserves_installed_policy():
+    """fit(amp=None) means 'no preference' — it must not clobber a
+    policy installed via set_amp() before fit."""
+    np.random.seed(7)
+    X = np.random.randn(64, FEATS).astype('float32')
+    Y = (X @ _W_TRUE).argmax(1).astype('float32')
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data=data, num_hidden=NCLASS)
+    net = mx.sym.SoftmaxOutput(data=fc, name='softmax')
+    it = mx.io.NDArrayIter(X, Y, batch_size=32)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.set_amp('bf16')
+    mod.fit(it, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.1},
+            initializer=mx.init.Xavier(), num_epoch=1)
+    assert mod.amp == 'bf16'
+    # an explicit amp= still wins
+    mod.fit(it, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.1},
+            initializer=mx.init.Xavier(), num_epoch=1, amp='off')
+    assert mod.amp == 'off'
+
+
+def test_executor_cache_keyed_on_policy_content():
+    """Two Policy objects sharing a display name but classifying ops
+    differently must not reuse each other's compiled graphs."""
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data=data, num_hidden=NCLASS)
+    ex = fc.simple_bind(ctx=mx.cpu(), data=(2, FEATS))
+    x = nd.array(np.random.randn(2, FEATS).astype('float32'))
+    casting = Policy('same-name', 'bfloat16')
+    inert = Policy('same-name', 'bfloat16', cast_ops=frozenset())
+    ex.set_amp(casting)
+    out_cast = ex.forward(is_train=True, data=x)[0]
+    assert str(out_cast.dtype) == 'bfloat16'
+    ex.set_amp(inert)
+    out_inert = ex.forward(is_train=True, data=x)[0]
+    assert str(out_inert.dtype) == 'float32'
+
+
+def test_gluon_trainer_amp_forces_masters():
+    net = _net(0)
+    net.cast('bfloat16')
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), 'sgd',
+                       {'learning_rate': 0.1, 'momentum': 0.9},
+                       amp='bf16')
+    assert tr.amp == 'bf16'
+    assert tr.optimizer.multi_precision
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    from mxnet_tpu import autograd
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(8, FEATS), dtype='bfloat16')
+    y = nd.array(rs.randint(0, NCLASS, (8,)).astype('float32'))
+    losses = []
+    for _ in range(6):
+        with autograd.record():
+            loss = L(net(x), y)
+        loss.backward()
+        tr.step(8)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0]
+    masters = [st for st in tr._updaters[0].states.values()
+               if isinstance(st, tuple) and hasattr(st[0], 'dtype')
+               and str(st[0].dtype) == 'float32']
+    assert masters, 'no fp32 masters created for bf16 weights'
+
+
+def test_optimizer_bf16_master_weight_protocol():
+    from mxnet_tpu.optimizer import SGD
+    opt = SGD(learning_rate=0.5, momentum=0.9, multi_precision=True)
+    w16 = nd.array(np.linspace(-1, 1, 8).astype('float32'),
+                   dtype='bfloat16')
+    state = opt.create_state_multi_precision(0, w16)
+    master, _mstate = state
+    assert str(master.dtype) == 'float32'
+    g = nd.array(np.full((8,), 0.25, np.float32), dtype='bfloat16')
+    opt.update_multi_precision(0, w16, g, state)
+    # the update ran in f32 on the master; the bf16 weight mirrors it
+    np.testing.assert_allclose(
+        w16.asnumpy().astype('float32'),
+        master.asnumpy().astype('bfloat16').astype('float32'))
+    # bf16 without multi_precision warns (the satellite fix: the old
+    # path only recognized float16)
+    opt2 = SGD(learning_rate=0.5)
+    with pytest.warns(UserWarning, match='bfloat16'):
+        opt2.create_state_multi_precision(1, w16)
+
+
+def test_batchnorm_bf16_cast_keeps_f32_stats():
+    from mxnet_tpu import autograd
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8), nn.BatchNorm())
+    net.initialize(mx.init.Xavier())
+    net.cast('bfloat16')
+    x = nd.array(np.random.randn(8, FEATS), dtype='bfloat16')
+    with autograd.record():
+        out = net(x)       # first pass materializes deferred params
+    assert str(out.dtype) == 'bfloat16'
+    bn = net[1]
+    for p in (bn.gamma, bn.beta, bn.running_mean, bn.running_var):
+        assert str(p.data().dtype) == 'float32'
+    # the aux momentum update accumulated f32 batch statistics
+    # (ops/nn.py returns batch stats in the moving-stat dtype)
+    assert str(bn.running_mean.data().dtype) == 'float32'
+    assert float(nd.abs(bn.running_var.data() - 1.0).sum().asscalar()) \
+        > 0  # the update actually landed
+
+
+# ---------------------------------------------------------------------------
+# precision-aware roofline
+# ---------------------------------------------------------------------------
+
+def test_roofline_program_precision():
+    from mxnet_tpu.observability import roofline
+    f32 = ('ENTRY %main (a: f32[8,8]) -> f32[8,8] {\n'
+           '  %a = f32[8,8]{1,0} parameter(0)\n'
+           '  ROOT %dot = f32[8,8]{1,0} dot(f32[8,8]{1,0} %a, '
+           'f32[8,8]{1,0} %a), lhs_contracting_dims={1}, '
+           'rhs_contracting_dims={0}\n}\n')
+    assert roofline.program_precision(f32) == 'fp32'
+    assert roofline.program_precision(
+        f32.replace('f32[', 'bf16[')) == 'bf16'
+    assert roofline.program_precision(
+        f32.replace('f32[', 'f16[')) == 'fp16'
+    # the XLA:CPU shape: f32 matmuls, bf16 only in converts
+    cpu = (f32 + 'ENTRY2 {\n  %c = bf16[8,8]{1,0} '
+           'convert(f32[8,8]{1,0} %x)\n}\n')
+    assert roofline.program_precision(cpu) == 'bf16'
+
+
+def test_roofline_reference_machine_precision():
+    from mxnet_tpu import config
+    from mxnet_tpu.observability import roofline
+    bf16 = roofline.reference_machine('bf16')
+    fp32 = roofline.reference_machine('fp32')
+    assert bf16['precision'] == 'bf16' and fp32['precision'] == 'fp32'
+    # default fp32 peak: half the bf16 MXU rate
+    assert fp32['peak_flops_per_s'] == pytest.approx(
+        bf16['peak_flops_per_s'] / 2.0)
+    assert fp32['ridge_flops_per_byte'] == pytest.approx(
+        bf16['ridge_flops_per_byte'] / 2.0)
+    config.set('MXNET_TPU_ROOFLINE_PEAK_TFLOPS_FP32', '123.0')
+    try:
+        assert roofline.reference_machine('fp32')['peak_flops_per_s'] \
+            == pytest.approx(123e12)
+    finally:
+        config.unset('MXNET_TPU_ROOFLINE_PEAK_TFLOPS_FP32')
+    with pytest.raises(ValueError):
+        roofline.reference_machine('int8')
+
+
+def test_fusion_diff_refuses_cross_precision():
+    from mxnet_tpu.observability import roofline
+    hlo = ('ENTRY %main (a: f32[8,8]) -> f32[8,8] {\n'
+           '  %a = f32[8,8]{1,0} parameter(0)\n'
+           '  ROOT %add = f32[8,8]{1,0} add(f32[8,8]{1,0} %a, '
+           'f32[8,8]{1,0} %a)\n}\n')
+    base = roofline.roofline_artifact(hlo, program='p',
+                                      config={'amp': 'off'})
+    new = roofline.roofline_artifact(hlo, program='p',
+                                     config={'amp': 'bf16'})
+    problems = roofline.diff_artifacts(base, new)
+    assert problems and 'config changed' in problems[0]
+    # same precision still diffs fine
+    assert roofline.diff_artifacts(base, base) == []

@@ -485,10 +485,18 @@ def test_batchnorm_custom_vjp_numerics():
     np.testing.assert_allclose(np.asarray(var_b),
                                np.var(np.asarray(xbig), axis=(0, 2, 3)),
                                rtol=5e-3)
-    # dtype contract
+    # dtype contract: batch stats follow the MOVING-stat dtype — f32
+    # running stats (the net.cast('bfloat16') layout) get unquantized
+    # f32 batch stats, an all-bf16 cache keeps its dtype stable
+    # (docs/PRECISION.md)
     _, m16, v16 = batch_norm(x.astype(jnp.bfloat16), gamma, beta, mm, mv,
                              eps=1e-3, fix_gamma=False, training=True)
-    assert m16.dtype == jnp.bfloat16 and v16.dtype == jnp.bfloat16
+    assert m16.dtype == jnp.float32 and v16.dtype == jnp.float32
+    _, m16b, v16b = batch_norm(
+        x.astype(jnp.bfloat16), gamma, beta,
+        mm.astype(jnp.bfloat16), mv.astype(jnp.bfloat16),
+        eps=1e-3, fix_gamma=False, training=True)
+    assert m16b.dtype == jnp.bfloat16 and v16b.dtype == jnp.bfloat16
 
 
 def test_layernorm_custom_vjp_numerics():

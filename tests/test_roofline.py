@@ -282,10 +282,12 @@ def test_fusion_audit_config_records_platform():
         class _mesh:
             shape = {'dp': 4, 'model': 2}
         zero = True
+        amp = 'bf16'
 
     import jax
     cfg = fa._mesh_config(_PT)
     assert cfg == {'mesh': {'dp': 4, 'model': 2}, 'zero': True,
+                   'amp': 'bf16',
                    'platform': jax.default_backend()}
 
 

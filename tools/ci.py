@@ -35,6 +35,15 @@ Stages, each timed:
                            fusion count must not regress beyond the
                            MXNET_TPU_FUSION_BUDGET_* knobs
                            (docs/PERFORMANCE.md)
+  3b2. amp                 python -m mxnet_tpu.amp — the automatic-
+                           mixed-precision selftest (docs/PRECISION.md):
+                           policy resolution + per-op cast classes,
+                           amp-off true-no-op bit-identity, bf16
+                           compiled-step fp32-master round trip
+                           (checkpoint resume bit-exact incl. into an
+                           amp-off trainer), fp16 dynamic-loss-scaling
+                           overflow -> skip -> continue, and the eager
+                           gluon bf16 master-weight protocol
   3c. sharding             python -m mxnet_tpu.parallel — the 2-D mesh
                            + ZeRO sharded-update selftest on the
                            virtual 8-device mesh (docs/PARALLEL.md):
@@ -129,6 +138,12 @@ def main(argv=None):
         ('fusion-audit', [py, 'tools/fusion_audit.py', '--quick',
                           '--baseline', 'FUSION_BASELINE.json',
                           '--gate', '--out', '/tmp/FUSION.json']),
+        # automatic-mixed-precision contract (docs/PRECISION.md):
+        # policy/scope semantics, amp-off bit-identity, fp32 masters
+        # through the bf16 compiled step + bit-exact resume, fp16
+        # loss-scaling skip, eager bf16 multi_precision masters
+        ('amp', [py, '-m', 'mxnet_tpu.amp',
+                 '--out', '/tmp/AMP_SELFTEST.json']),
         # 2-D (dp × model) mesh + ZeRO sharded-weight-update contract
         # (docs/PARALLEL.md): bit-identity vs the replicated update
         # (incl. a guardrail skip step), the 1/dp optimizer-state

@@ -60,19 +60,25 @@ def _make_mesh(mesh_axes):
 
 def _mesh_config(pt):
     """The mesh-aware provenance block (mxnet_tpu.fusion.v1 config):
-    axis names+sizes, the ZeRO knob, and the audited platform — the
-    cross-config-diff refusal then distinguishes 1-D from 2-D (and
-    sharded-update) step programs AND refuses to diff a CPU-lowered
-    audit (--mesh setdefaults JAX_PLATFORMS=cpu to provision virtual
-    devices; XLA:CPU lowers reduce-scatter as all-reduce+slice) against
-    an accelerator baseline, instead of comparing their byte totals."""
+    axis names+sizes, the ZeRO knob, the AMP policy, and the audited
+    platform — the cross-config-diff refusal then distinguishes 1-D
+    from 2-D (and sharded-update, and mixed-precision) step programs
+    AND refuses to diff a CPU-lowered audit (--mesh setdefaults
+    JAX_PLATFORMS=cpu to provision virtual devices; XLA:CPU lowers
+    reduce-scatter as all-reduce+slice) against an accelerator
+    baseline, instead of comparing their byte totals. An AMP program
+    moves roughly half the matmul bytes of its fp32 twin, so a
+    cross-precision diff would always 'pass' — recording amp here
+    makes diff_artifacts refuse it as a config change
+    (docs/PRECISION.md)."""
     import jax
     return {'mesh': {k: int(v) for k, v in pt._mesh.shape.items()},
             'zero': bool(pt.zero),
+            'amp': pt.amp,
             'platform': jax.default_backend()}
 
 
-def _build_resnet_program(quick, mesh_axes=None, zero=False):
+def _build_resnet_program(quick, mesh_axes=None, zero=False, amp=None):
     import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, parallel
@@ -93,14 +99,14 @@ def _build_resnet_program(quick, mesh_axes=None, zero=False):
     y = nd.array(np.random.randint(0, 1000, (batch,)))
     pt = parallel.ParallelTrainer(
         net, L, 'sgd', {'learning_rate': 0.1, 'momentum': 0.9,
-                        'wd': 1e-4}, mesh, zero=zero)
+                        'wd': 1e-4}, mesh, zero=zero, amp=amp)
     pt.build(x, y)
     cfg = {'model': 'resnet50_v1', 'batch': batch, 'image': image}
     cfg.update(_mesh_config(pt))
     return pt, cfg
 
 
-def _build_bert_program(quick, mesh_axes=None, zero=False):
+def _build_bert_program(quick, mesh_axes=None, zero=False, amp=None):
     import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, parallel
@@ -139,7 +145,8 @@ def _build_bert_program(quick, mesh_axes=None, zero=False):
 
     pt = parallel.ParallelTrainer(
         net, pretrain_loss, 'adamw', {'learning_rate': 1e-4,
-                                      'wd': 0.01}, mesh, zero=zero)
+                                      'wd': 0.01}, mesh, zero=zero,
+        amp=amp)
     pt.build([ids, tt, vl, mp], [mlm_y, nsp_y])
     cfg = {'model': 'bert_12_768_12' if not quick else 'bert-tiny',
            'batch': batch, 'seqlen': seqlen}
@@ -176,10 +183,18 @@ def _parse_mesh(text):
     return axes
 
 
-def audit_program(name, quick, top=None, mesh_axes=None, zero=False):
-    """Build one reference step program and return its fusion artifact."""
+def audit_program(name, quick, top=None, mesh_axes=None, zero=False,
+                  amp=None):
+    """Build one reference step program and return its fusion artifact.
+
+    ``amp`` follows :func:`mxnet_tpu.amp.resolve` semantics (None reads
+    the MXNET_TPU_AMP knob); the resolved policy lands in the artifact
+    config so mixed-precision audits never diff against fp32 ones, and
+    the roofline classifies the program against the matching peak
+    (bf16/fp16 MXU rate vs the fp32 passthrough rate)."""
     from mxnet_tpu.observability import roofline
-    pt, config = _BUILDERS[name](quick, mesh_axes=mesh_axes, zero=zero)
+    pt, config = _BUILDERS[name](quick, mesh_axes=mesh_axes, zero=zero,
+                                 amp=amp)
     config['quick'] = bool(quick)
     text = pt.compiled_text()
     return roofline.roofline_artifact(text, program=name, top=top,
@@ -226,6 +241,14 @@ def main(argv=None):
                         'provisioned automatically; recorded in the '
                         'artifact config so 1-D and 2-D audits never '
                         'diff against each other)')
+    p.add_argument('--amp', default=None,
+                   choices=('off', 'bf16', 'fp16'),
+                   help='build the step programs under an AMP policy '
+                        '(docs/PRECISION.md): the artifact config '
+                        'records the resolved policy so cross-'
+                        'precision diffs are refused, and the roofline '
+                        'ridge uses the matching peak. Default: the '
+                        'MXNET_TPU_AMP knob (off when unset)')
     p.add_argument('--zero', action='store_true',
                    help='build with the ZeRO dp-sharded weight update '
                         '(MXNET_TPU_ZERO semantics) — the audit then '
@@ -270,15 +293,17 @@ def main(argv=None):
         wanted = {'resnet': ['resnet50_step'], 'bert': ['bert_step'],
                   'both': ['resnet50_step', 'bert_step']}[args.model]
         for name in wanted:
-            print('== fusion_audit: building %s (%s%s%s)'
+            print('== fusion_audit: building %s (%s%s%s%s)'
                   % (name, 'quick' if args.quick else 'full',
                      ', mesh %s' % mesh_axes if mesh_axes else '',
-                     ', zero' if args.zero else ''),
+                     ', zero' if args.zero else '',
+                     ', amp=%s' % args.amp if args.amp else ''),
                   flush=True)
             programs[name] = audit_program(name, args.quick,
                                            top=args.top,
                                            mesh_axes=mesh_axes,
-                                           zero=args.zero)
+                                           zero=args.zero,
+                                           amp=args.amp)
 
     for name, art in programs.items():
         print(roofline.format_table(art))

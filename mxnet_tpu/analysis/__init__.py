@@ -1,0 +1,200 @@
+"""Static analysis over the two failure surfaces of this codebase.
+
+Every contract the runtime ships — bit-identity, zero-retrace, "no
+collectives at dp=1", "no f32 matmul under bf16" — is enforceable from
+*artifacts* without burning a TPU hour reproducing the bad path (the
+phase-separation argument of TVM and the XLA fusion study, PAPERS.md).
+Three passes (docs/ANALYSIS.md):
+
+  * :mod:`.tracelint` — AST lint over the registered trace-context
+    entry points (the compiled-step bodies, graph fns, op kernels) and
+    their static call graph: host env/time/random reads at trace time,
+    host syncs on traced values, Python branches on traced booleans,
+    closure mutation, retrace-bomb loops.
+  * :mod:`.locklint` — AST lint over every class that owns a
+    ``threading`` lock: lock-order cycles, user callbacks / flight-
+    recorder emits invoked while holding a lock, same-lock re-entry,
+    unguarded writes to attributes accessed under a lock elsewhere.
+  * :mod:`.hlolint` — invariant checks over compiled-program HLO text
+    (reusing the :mod:`~mxnet_tpu.observability.hlo` instruction
+    iterator): no f32 dot/conv in an amp=bf16 program, zero collectives
+    at dp=1, reduce-scatter in a ZeRO program, donation reflected in
+    input/output aliasing, no outfeed in a step program.
+
+Findings are structured (``mxnet_tpu.lint.v1``: rule id, file:line or
+HLO instruction, severity, stable fingerprint) and gated against a
+committed ``LINT_BASELINE.json`` suppression file, so CI
+(``python -m mxnet_tpu.analysis``, the ``lint`` stage of tools/ci.py)
+fails only on NEW findings; every deliberately-kept finding is
+suppressed with an annotated reason.
+
+Pure stdlib (ast/json/hashlib) except hlolint's optional fresh builds;
+the AST passes never import the modules they analyze.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+__all__ = ['SCHEMA', 'SEVERITIES', 'Finding', 'fingerprint',
+           'load_baseline', 'apply_baseline', 'write_jsonl',
+           'read_jsonl', 'repo_root']
+
+SCHEMA = 'mxnet_tpu.lint.v1'
+SEVERITIES = ('error', 'warning', 'info')
+
+
+class Finding:
+    """One lint finding — the ``mxnet_tpu.lint.v1`` record.
+
+    ``file``/``line`` locate source findings; ``instr`` names the HLO
+    instruction (and ``file`` the program label) for hlolint findings.
+    ``fingerprint`` is stable across line drift: it hashes the rule,
+    file, enclosing qualname and the normalized source text rather
+    than the line number.
+    """
+
+    __slots__ = ('rule', 'severity', 'file', 'line', 'qualname',
+                 'message', 'instr', 'fingerprint')
+
+    def __init__(self, rule, severity, file, line, message,
+                 qualname=None, instr=None, fp=None):
+        if severity not in SEVERITIES:
+            raise ValueError('severity %r not in %r'
+                             % (severity, SEVERITIES))
+        self.rule = rule
+        self.severity = severity
+        self.file = file
+        self.line = line
+        self.qualname = qualname
+        self.message = message
+        self.instr = instr
+        self.fingerprint = fp or fingerprint(rule, file, qualname,
+                                             message if instr else None,
+                                             instr)
+
+    def to_dict(self):
+        d = {'schema': SCHEMA, 'rule': self.rule,
+             'severity': self.severity, 'file': self.file,
+             'line': self.line, 'message': self.message,
+             'fingerprint': self.fingerprint}
+        if self.qualname:
+            d['qualname'] = self.qualname
+        if self.instr:
+            d['instr'] = self.instr
+        return d
+
+    def location(self):
+        if self.instr:
+            return '%s [%s]' % (self.file, self.instr)
+        return '%s:%s' % (self.file, self.line)
+
+    def __repr__(self):
+        return '%s %s %s — %s' % (self.severity.upper(), self.rule,
+                                  self.location(), self.message)
+
+
+def fingerprint(rule, file, qualname=None, text=None, instr=None):
+    """Stable suppression key: line numbers excluded on purpose so an
+    unrelated edit above a finding does not orphan its baseline entry.
+    Source findings key on (rule, file, qualname, normalized snippet);
+    hlolint findings on (rule, program, instruction)."""
+    parts = [rule, file or '', qualname or '']
+    if instr is not None:
+        parts.append(instr)
+    elif text is not None:
+        parts.append(' '.join(str(text).split()))
+    h = hashlib.sha1('|'.join(parts).encode()).hexdigest()
+    return h[:16]
+
+
+def source_fingerprint(rule, file, qualname, source_line_text):
+    """Fingerprint helper for the AST passes: hash the stripped source
+    line the finding anchors to."""
+    return fingerprint(rule, file, qualname,
+                       text=source_line_text.strip())
+
+
+def load_baseline(path):
+    """Load a ``LINT_BASELINE.json`` suppression file →
+    {fingerprint: entry}. Missing file = empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if data.get('schema') != SCHEMA:
+        raise ValueError('baseline %s has schema %r (want %s)'
+                         % (path, data.get('schema'), SCHEMA))
+    out = {}
+    for ent in data.get('suppressions', []):
+        fp = ent.get('fingerprint')
+        if not fp:
+            raise ValueError('baseline entry without fingerprint: %r'
+                             % (ent,))
+        if not ent.get('reason'):
+            raise ValueError('baseline entry %s (%s) has no reason — '
+                             'every suppression must say why'
+                             % (fp, ent.get('rule')))
+        out[fp] = ent
+    return out
+
+
+def apply_baseline(findings, baseline):
+    """Split findings into (new, suppressed) against a loaded baseline
+    and report stale suppressions (entries matching nothing — the
+    suppressed code was fixed or moved; prune them)."""
+    new, suppressed = [], []
+    seen = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            suppressed.append(f)
+            seen.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = [ent for fp, ent in sorted(baseline.items())
+             if fp not in seen]
+    return new, suppressed, stale
+
+
+def baseline_payload(findings, reasons=None):
+    """Build a baseline dict from findings (``--write-baseline``).
+    ``reasons`` maps fingerprint -> reason; unknown fingerprints get a
+    TODO marker the loader will accept but a human should replace."""
+    reasons = reasons or {}
+    ents = []
+    for f in sorted(findings, key=lambda f: (f.rule, f.file or '',
+                                             f.line or 0)):
+        ents.append({
+            'fingerprint': f.fingerprint,
+            'rule': f.rule,
+            'file': f.file,
+            'qualname': f.qualname,
+            'reason': reasons.get(f.fingerprint,
+                                  'TODO: justify or fix (%s)'
+                                  % f.message),
+        })
+    return {'schema': SCHEMA, 'suppressions': ents}
+
+
+def write_jsonl(findings, path):
+    with open(path, 'w') as f:
+        for fnd in findings:
+            f.write(json.dumps(fnd.to_dict(), sort_keys=True) + '\n')
+
+
+def read_jsonl(path):
+    out = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                out.append(json.loads(ln))
+    return out
+
+
+def repo_root():
+    """The package's parent directory (the repo checkout the AST
+    passes scan)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))

@@ -1,0 +1,436 @@
+"""Concurrency lint: lock discipline over every lock-owning class.
+
+~31 modules in this repo share state across threads (batcher, staging,
+decode engine, watchdog, recorder, metrics, kvstore, dataloader). The
+rules that keep them deadlock-free are simple but unenforced by the
+runtime — until a real hang on a real TPU. This pass checks them from
+the AST (docs/ANALYSIS.md):
+
+  * LOCK-ORDER (error) — the per-class lock-acquisition graph (``with
+    self._a`` nesting across methods, including through ``self.*``
+    calls) must be acyclic; a cycle is a latent ABBA deadlock.
+  * LOCK-REENTRY (error) — acquiring a plain ``threading.Lock`` the
+    call path already holds: guaranteed self-deadlock.
+  * LOCK-CALLBACK (error) — user callbacks (``on_*``/callback/placer/
+    runner constructor params, ``Future.set_result``/``set_exception``
+    whose done-callbacks run inline) invoked while holding a lock:
+    re-entrant user code under a non-reentrant lock.
+  * LOCK-EMIT (warning) — flight-recorder / metrics emits under a
+    lock: telemetry must never extend a critical section (the recorder
+    takes its own lock — a cross-object ordering no one audits).
+  * LOCK-UNGUARDED-WRITE (warning) — attribute written outside any
+    lock in one method while read or written under a lock elsewhere in
+    the class (``__init__`` excluded; ``*_locked``-suffixed helpers
+    are by convention caller-holds-lock and are analyzed through their
+    locked call sites, not as lock-free roots).
+
+Class-local by construction: cross-object cycles (two objects locking
+each other) are beyond a static pass and stay the integration tests'
+job. ``threading.Condition(self._lock)`` aliases to the underlying
+lock, so a condition and its lock count as ONE.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding, source_fingerprint
+from .registry import (EMIT_FUNC_NAMES, EMIT_METHOD_NAMES,
+                       FUTURE_CALLBACK_METHODS, LOCKED_SUFFIX,
+                       is_callback_param)
+
+__all__ = ['run', 'analyze_module']
+
+_LOCK_CTORS = {'Lock': 'lock', 'RLock': 'rlock', 'Condition': 'cond',
+               'Semaphore': 'lock', 'BoundedSemaphore': 'lock'}
+_MAX_DEPTH = 8
+
+
+class _ClassInfo:
+    def __init__(self, module, node):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.methods = {}       # name -> FunctionDef
+        self.locks = {}         # attr -> ('lock'|'rlock'|'cond')
+        self.alias = {}         # attr -> canonical attr (Condition
+                                # over an existing lock)
+        self.callback_attrs = set()
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        self._find_locks()
+        self._find_callback_attrs()
+
+    def _find_locks(self):
+        for meth in self.methods.values():
+            for st in ast.walk(meth):
+                if not isinstance(st, ast.Assign) or \
+                        not isinstance(st.value, ast.Call):
+                    continue
+                kind = self._lock_ctor_kind(st.value.func)
+                if kind is None:
+                    continue
+                for tgt in st.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    self.locks[attr] = kind
+                    if kind == 'cond' and st.value.args:
+                        inner = _self_attr_load(st.value.args[0])
+                        if inner is not None:
+                            self.alias[attr] = inner
+
+    def _lock_ctor_kind(self, func):
+        if isinstance(func, ast.Attribute) and \
+                func.attr in _LOCK_CTORS and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id in ('threading', '_threading'):
+            return _LOCK_CTORS[func.attr]
+        if isinstance(func, ast.Name) and func.id in _LOCK_CTORS:
+            # `from threading import Lock` style
+            imp = self.module.imports.get(func.id, '')
+            if imp.startswith('threading.'):
+                return _LOCK_CTORS[func.id]
+        return None
+
+    def _find_callback_attrs(self):
+        init = self.methods.get('__init__')
+        if init is None:
+            return
+        params = {a.arg for a in init.args.args + init.args.kwonlyargs
+                  if is_callback_param(a.arg)}
+        if not params:
+            return
+        for st in ast.walk(init):
+            if not isinstance(st, ast.Assign):
+                continue
+            refs = {n.id for n in ast.walk(st.value)
+                    if isinstance(n, ast.Name)}
+            if refs & params:
+                for tgt in st.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        self.callback_attrs.add(attr)
+
+    def canonical(self, attr):
+        return self.alias.get(attr, attr)
+
+
+def _self_attr(node):
+    """'x' for a `self.x` STORE target."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == 'self':
+        return node.attr
+    return None
+
+
+def _self_attr_load(node):
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == 'self':
+        return node.attr
+    return None
+
+
+class _ClassAnalysis:
+    def __init__(self, linter, cls):
+        self.lint = linter
+        self.cls = cls
+        self.order_edges = {}    # (a, b) -> witness node/method
+        self.access = {}         # attr -> {'guarded': set(methods),
+                                 #          'unguarded_w': [(meth,node)],
+                                 #          'guarded_w': set(methods)}
+        self._memo = set()
+
+    def emit(self, rule, severity, node, method, message):
+        self.lint.emit(rule, severity, self.cls.module,
+                       '%s.%s' % (self.cls.name, method), node,
+                       message)
+
+    def record_access(self, attr, method, node, held, is_write):
+        a = self.access.setdefault(attr, {'guarded': set(),
+                                          'unguarded_w': [],
+                                          'guarded_w': set()})
+        if held:
+            a['guarded'].add(method)
+            if is_write:
+                a['guarded_w'].add(method)
+        elif is_write and not (method == '__init__' or
+                               method.startswith('_init')):
+            # constructor-phase methods (__init__ and _init* helpers
+            # it delegates to) publish the object before any other
+            # thread can hold its lock
+            a['unguarded_w'].append((method, node))
+
+    def run(self):
+        for name, meth in sorted(self.cls.methods.items()):
+            if name.endswith(LOCKED_SUFFIX):
+                continue    # caller-holds-lock helper: covered via
+                            # its locked call sites
+            self.walk(meth.body, name, name, frozenset(), 0)
+        self.report_cycles()
+        self.report_unguarded()
+        return self.lint.findings
+
+    # -- the walk -----------------------------------------------------------
+
+    def walk(self, stmts, root_method, cur_method, held, depth):
+        for st in stmts:
+            self.walk_stmt(st, root_method, cur_method, held, depth)
+
+    def walk_stmt(self, st, root, cur, held, depth):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs (worker bodies) run on their own thread with
+            # no lock held
+            self.walk(st.body, root, cur, frozenset(), depth)
+            return
+        if isinstance(st, ast.With):
+            new_held = set(held)
+            for item in st.items:
+                attr = _self_attr_load(item.context_expr)
+                if attr is not None and attr in self.cls.locks:
+                    self.acquire(attr, root, cur, frozenset(new_held),
+                                 st)
+                    new_held.add(self.cls.canonical(attr))
+            for item in st.items:
+                self.visit_expr(item.context_expr, root, cur, held,
+                                depth)
+            self.walk(st.body, root, cur, frozenset(new_held), depth)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self.visit_expr(st.test, root, cur, held, depth)
+            self.walk(st.body, root, cur, held, depth)
+            self.walk(st.orelse, root, cur, held, depth)
+            return
+        if isinstance(st, ast.For):
+            self.visit_expr(st.iter, root, cur, held, depth)
+            self.walk(st.body, root, cur, held, depth)
+            self.walk(st.orelse, root, cur, held, depth)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body, root, cur, held, depth)
+            for h in st.handlers:
+                self.walk(h.body, root, cur, held, depth)
+            self.walk(st.orelse, root, cur, held, depth)
+            self.walk(st.finalbody, root, cur, held, depth)
+            return
+        # generic: visit expressions + record self-attr accesses
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call):
+                self.visit_call(node, root, cur, held, depth)
+            elif isinstance(node, ast.Attribute):
+                attr = _self_attr_load(node)
+                if attr is not None and \
+                        attr not in self.cls.locks:
+                    self.record_access(
+                        attr, cur, node, bool(held),
+                        isinstance(node.ctx, (ast.Store, ast.Del)))
+
+    def visit_expr(self, e, root, cur, held, depth):
+        if e is None:
+            return
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                self.visit_call(node, root, cur, held, depth)
+            elif isinstance(node, ast.Attribute):
+                attr = _self_attr_load(node)
+                if attr is not None and attr not in self.cls.locks:
+                    self.record_access(
+                        attr, cur, node, bool(held),
+                        isinstance(node.ctx, (ast.Store, ast.Del)))
+
+    def acquire(self, attr, root, cur, held, node):
+        canon = self.cls.canonical(attr)
+        kind = self.cls.locks.get(canon, self.cls.locks.get(attr))
+        if canon in held and kind != 'rlock':
+            self.emit('LOCK-REENTRY', 'error', node, cur,
+                      'acquiring self.%s while a path from %s() '
+                      'already holds it — non-reentrant Lock, '
+                      'guaranteed deadlock' % (attr, root))
+        for h in held:
+            if h != canon:
+                self.order_edges.setdefault((h, canon),
+                                            (node, cur))
+
+    def visit_call(self, call, root, cur, held, depth):
+        func = call.func
+        # explicit acquire()/release()
+        if isinstance(func, ast.Attribute) and \
+                func.attr == 'acquire':
+            attr = _self_attr_load(func.value)
+            if attr is not None and attr in self.cls.locks:
+                self.acquire(attr, root, cur, held, call)
+        if not held:
+            # only callback/emit/ordering rules need the held context;
+            # still recurse into self-calls to keep access recording
+            # (held stays empty) — handled below
+            pass
+        if isinstance(func, ast.Attribute):
+            # Future.set_result / set_exception run done-callbacks
+            # inline on this thread
+            if held and func.attr in FUTURE_CALLBACK_METHODS:
+                self.emit('LOCK-CALLBACK', 'error', call, cur,
+                          '%s() while holding %s — future '
+                          'done-callbacks run inline and may '
+                          're-enter this object (deadlock); collect '
+                          'under the lock, deliver outside'
+                          % (func.attr, _held_text(held)))
+            # self.X(...): X is func.attr (the receiver is `self`)
+            self_method = func.attr \
+                if isinstance(func.value, ast.Name) and \
+                func.value.id == 'self' else None
+            if held and self_method is not None and \
+                    self_method in self.cls.callback_attrs:
+                self.emit('LOCK-CALLBACK', 'error', call, cur,
+                          'user callback self.%s() invoked while '
+                          'holding %s — re-entrant user code under a '
+                          'non-reentrant lock'
+                          % (self_method, _held_text(held)))
+            elif held and self_method is not None and \
+                    self_method not in self.cls.methods and \
+                    (self_method.startswith('on_') or
+                     self_method.startswith('_on_')):
+                self.emit('LOCK-CALLBACK', 'error', call, cur,
+                          'callback attribute self.%s() invoked '
+                          'while holding %s'
+                          % (self_method, _held_text(held)))
+            if held and func.attr in EMIT_METHOD_NAMES:
+                self.emit('LOCK-EMIT', 'warning', call, cur,
+                          'metrics emit .%s() while holding %s — '
+                          'telemetry must not extend the critical '
+                          'section' % (func.attr, _held_text(held)))
+            if held and func.attr in EMIT_FUNC_NAMES:
+                self.emit('LOCK-EMIT', 'warning', call, cur,
+                          'flight-recorder/metrics call %s() while '
+                          'holding %s'
+                          % (func.attr, _held_text(held)))
+            # walk into self.method(...) with the held set
+            if self_method is not None and \
+                    self_method in self.cls.methods and \
+                    depth < _MAX_DEPTH:
+                key = (self_method, frozenset(held))
+                if key not in self._memo:
+                    self._memo.add(key)
+                    self.walk(self.cls.methods[self_method].body,
+                              root, self_method, held, depth + 1)
+        elif isinstance(func, ast.Name):
+            if held and func.id in EMIT_FUNC_NAMES:
+                self.emit('LOCK-EMIT', 'warning', call, cur,
+                          'flight-recorder/metrics call %s() while '
+                          'holding %s'
+                          % (func.id, _held_text(held)))
+            # module-level helper in the same module
+            if held and func.id in self.lint.module_funcs and \
+                    depth < _MAX_DEPTH:
+                fn = self.lint.module_funcs[func.id]
+                key = ('::' + func.id, frozenset(held))
+                if key not in self._memo:
+                    self._memo.add(key)
+                    self.walk(fn.body, root, func.id, held,
+                              depth + 1)
+
+    # -- reports ------------------------------------------------------------
+
+    def report_cycles(self):
+        graph = {}
+        for (a, b) in self.order_edges:
+            graph.setdefault(a, set()).add(b)
+
+        state = {}
+
+        def dfs(n, path):
+            state[n] = 1
+            for m in sorted(graph.get(n, ())):
+                if state.get(m) == 1:
+                    cyc = path[path.index(m):] + [m] \
+                        if m in path else [n, m]
+                    node, meth = self.order_edges.get(
+                        (n, m), (self.cls.node, '?'))
+                    self.emit('LOCK-ORDER', 'error', node, meth,
+                              'lock-order cycle %s — ABBA deadlock '
+                              'between threads taking the locks in '
+                              'opposite orders'
+                              % ' -> '.join('self.%s' % x
+                                            for x in cyc))
+                elif state.get(m) is None:
+                    dfs(m, path + [m])
+            state[n] = 2
+
+        for n in sorted(graph):
+            if state.get(n) is None:
+                dfs(n, [n])
+
+    def report_unguarded(self):
+        for attr, a in sorted(self.access.items()):
+            if not a['guarded'] or not a['unguarded_w']:
+                continue
+            for meth, node in a['unguarded_w']:
+                self.emit(
+                    'LOCK-UNGUARDED-WRITE', 'warning', node, meth,
+                    'self.%s written outside any lock here but '
+                    'accessed under a lock in %s — torn/stale state '
+                    'race' % (attr,
+                              ', '.join('%s()' % m for m in
+                                        sorted(a['guarded']))))
+
+
+def _held_text(held):
+    return '+'.join('self.%s' % h for h in sorted(held)) or 'a lock'
+
+
+class LockLinter:
+    def __init__(self, index):
+        self.index = index
+        self.findings = []
+        self._seen = set()
+        self.module_funcs = {}
+
+    def emit(self, rule, severity, module, qualname, node, message):
+        line = getattr(node, 'lineno', 0)
+        key = (rule, module.relpath, line, qualname)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        fp = source_fingerprint(rule, module.relpath, qualname,
+                                module.line_text(line))
+        self.findings.append(Finding(
+            rule, severity, module.relpath, line, message,
+            qualname=qualname, fp=fp))
+
+    def run(self):
+        for relpath in sorted(self.index.modules):
+            self.analyze(self.index.modules[relpath])
+        return self.findings
+
+    def analyze(self, module):
+        self.module_funcs = {q: n for q, n in module.defs.items()
+                             if '.' not in q}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _ClassInfo(module, node)
+            if not cls.locks:
+                continue
+            _ClassAnalysis(self, cls).run()
+
+
+def run(root=None, index=None):
+    """Run the concurrency lint over every module in the package."""
+    from .tracelint import ProjectIndex
+    index = index or ProjectIndex(root=root)
+    return LockLinter(index).run()
+
+
+def analyze_module(path, relpath=None):
+    """Lint one file (fixture helper for tests)."""
+    from .tracelint import ProjectIndex
+    index = ProjectIndex.__new__(ProjectIndex)
+    index.root = os.path.dirname(path)
+    index.package = ''
+    index.modules = {}
+    index.by_dotted = {}
+    index.add_file(path, relpath or os.path.basename(path))
+    return LockLinter(index).run()

@@ -1,0 +1,158 @@
+"""What the static passes look at: the trace-context entry points and
+the lock-discipline conventions (docs/ANALYSIS.md).
+
+TRACE_ENTRY_POINTS lists every place host Python becomes traced
+program: each entry is ``(module_relpath, qualname_spec, options)``.
+
+``qualname_spec`` forms:
+  * ``'fn'`` / ``'Class.method'`` — a (possibly nested) def, dotted
+    through classes and enclosing functions (``'Outer._build.loss_of'``
+    names the closure ``loss_of`` defined inside ``Outer._build``).
+  * ``'@register'`` — every module-level function carrying a
+    ``@register(...)`` decorator (the op-registry kernels).
+
+Nested defs of a registered trace context are trace contexts too (a
+closure defined inside a traced body is traced when called), and
+functions *called* from a trace context are walked with call-site
+taint — they do not need their own entries.
+
+``options['taint']`` picks which parameters seed the traced-value
+taint: ``'positional'`` (default — positional-or-keyword params minus
+``self``; keyword-only params are static attrs by this repo's op
+convention), ``'none'`` (analyze for host-read rules only), or a tuple
+of parameter names.
+
+DEFVJP: modules listed in ``DEFVJP_MODULES`` additionally register
+every function wired through ``X.defvjp(fwd, bwd)`` as a taint-free
+trace context — custom-vjp forward/backward bodies are traced code,
+but their leading nondiff args are host attrs, so value taint would
+be wrong.
+"""
+from __future__ import annotations
+
+__all__ = ['TRACE_ENTRY_POINTS', 'DEFVJP_MODULES', 'LOCKED_SUFFIX',
+           'CALLBACK_PARAM_NAMES', 'EMIT_FUNC_NAMES',
+           'EMIT_METHOD_NAMES', 'FUTURE_CALLBACK_METHODS',
+           'expect_from_config']
+
+TRACE_ENTRY_POINTS = [
+    # the ParallelTrainer compiled-step bodies (forward+loss, optimizer
+    # update, plain/guarded step, scan/accum variants)
+    ('mxnet_tpu/parallel/train_step.py', 'pure_forward_fn.fn',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/parallel/train_step.py',
+     'ParallelTrainer._build.loss_of', {'taint': 'positional'}),
+    ('mxnet_tpu/parallel/train_step.py',
+     'ParallelTrainer._build.run_update', {'taint': 'positional'}),
+    ('mxnet_tpu/parallel/train_step.py',
+     'ParallelTrainer._build.step', {'taint': 'positional'}),
+    ('mxnet_tpu/parallel/train_step.py',
+     'ParallelTrainer._build.guarded_step', {'taint': 'positional'}),
+    ('mxnet_tpu/parallel/train_step.py',
+     'ParallelTrainer._build_multi.multi', {'taint': 'positional'}),
+    ('mxnet_tpu/parallel/train_step.py',
+     'ParallelTrainer._build_multi.multi_g', {'taint': 'positional'}),
+    ('mxnet_tpu/parallel/train_step.py',
+     'ParallelTrainer._build_accum.accum_step',
+     {'taint': 'positional'}),
+    # the symbolic-graph executor's traced graph evaluator
+    ('mxnet_tpu/executor.py', '_build_graph_fn.fn',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/executor.py', '_build_graph_fn._impl',
+     {'taint': 'positional'}),
+    # gluon's CachedOp (hybridize) traced bodies
+    ('mxnet_tpu/gluon/block.py', 'CachedOp._make_fn.pure_fn',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/gluon/block.py', 'CachedOp._make_fn.wrapped',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/gluon/block.py', 'CachedOp._make_fn.wrapped_vjp',
+     {'taint': 'positional'}),
+    # op kernels: every registered op in the NN core (positional params
+    # are traced arrays; keyword-only params are static attrs)
+    ('mxnet_tpu/ops/nn.py', '@register', {'taint': 'positional'}),
+    # the in-jit guardrail math
+    ('mxnet_tpu/guardrail/sentinel.py', 'grad_health',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/guardrail/sentinel.py', 'is_healthy',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/guardrail/sentinel.py', 'grad_norm',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/guardrail/sentinel.py', 'rescale_packed',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/guardrail/sentinel.py', 'poison_grads',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/guardrail/scaling.py', 'update_scale',
+     {'taint': 'positional'}),
+    # the AMP per-op cast hook (runs once per traced dispatch)
+    ('mxnet_tpu/amp/policy.py', 'Policy.cast_op_inputs',
+     {'taint': ('arrays',)}),
+    # the decode-model compiled bodies (prefill / step / reference)
+    ('mxnet_tpu/serving/decode/model.py', 'RNNLM.prefill',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/serving/decode/model.py', 'RNNLM.step',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/serving/decode/model.py', 'RNNLM.full_forward',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/serving/decode/model.py', 'TransformerLM.prefill',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/serving/decode/model.py', 'TransformerLM.step',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/serving/decode/model.py', 'TransformerLM.full_forward',
+     {'taint': 'positional'}),
+]
+
+# modules whose X.defvjp(fwd, bwd) wirings register fwd/bwd as
+# taint-free trace contexts
+DEFVJP_MODULES = ['mxnet_tpu/ops/nn.py']
+
+# -- locklint conventions ---------------------------------------------------
+
+# methods named *_locked are caller-holds-the-lock helpers: locklint
+# does not walk them as lock-free roots (their shared-state accesses
+# are recorded through the locked call sites instead)
+LOCKED_SUFFIX = '_locked'
+
+# constructor params whose self-attr aliases count as USER CALLBACKS:
+# calling one while holding a lock is a deadlock/re-entrancy hazard
+# ('clock' is deliberately absent — reading an injected clock under a
+# lock is the pattern's whole point)
+CALLBACK_PARAM_NAMES = ('placer', 'runner', 'callback', 'hook')
+
+
+def is_callback_param(name):
+    return (name.startswith('on_') or name in CALLBACK_PARAM_NAMES
+            or name.endswith('_callback') or name.endswith('_hook'))
+
+
+# module/function names whose call is a flight-recorder / metrics emit
+EMIT_FUNC_NAMES = frozenset((
+    'record_event', '_record_event', 'flight_dump', '_emit_degraded',
+    '_serving_instruments', 'trainer_instruments',
+    'serving_instruments'))
+
+# method names (on any receiver) that are metric-instrument emits
+EMIT_METHOD_NAMES = frozenset(('inc', 'observe', 'labels'))
+
+# Future methods that run done-callbacks inline on the calling thread
+FUTURE_CALLBACK_METHODS = frozenset(('set_result', 'set_exception'))
+
+
+# -- hlolint expectations ---------------------------------------------------
+
+
+def expect_from_config(config, platform=None):
+    """Map a ``mxnet_tpu.fusion.v1`` artifact ``config`` block (as
+    committed in FUSION_BASELINE.json: amp / mesh / zero / platform)
+    to an hlolint ``expect`` dict, so the verifier can run against the
+    same programs the fusion audit gates."""
+    mesh = config.get('mesh') or {}
+    dp = int(mesh.get('dp', 1) or 1)
+    amp = config.get('amp') or 'off'
+    return {
+        'amp': amp if amp not in (None, False, 0) else 'off',
+        'dp': dp,
+        'zero': bool(config.get('zero')),
+        'donation': True,
+        'platform': platform or config.get('platform'),
+        'no_outfeed': True,
+    }

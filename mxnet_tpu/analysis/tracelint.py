@@ -1,0 +1,820 @@
+"""Trace-purity lint: what must never happen inside a jit trace.
+
+The one compiled step (and every other trace context registered in
+:mod:`.registry`) is Python that executes ONCE, at trace time, to build
+a program that executes forever after. Host effects inside it are
+therefore silent correctness bugs, not style nits:
+
+  * an ``os.environ`` / ``config.get`` read bakes ambient state into
+    the program without entering any cache key (TRACE-ENV);
+  * ``time.*`` / host ``random.*`` / ``numpy.random.*`` freeze one
+    host sample into every future step (TRACE-TIME / TRACE-RANDOM);
+  * ``float()`` / ``int()`` / ``.item()`` / ``numpy.asarray()`` on a
+    traced value forces a device sync mid-trace — a ConcretizationError
+    at best, a silent performance cliff through a cached eager value at
+    worst (TRACE-HOST-SYNC);
+  * a Python ``if``/``while``/``assert`` on a traced boolean picks ONE
+    branch for all time — the ``lax.cond``/``jnp.where`` respelling is
+    the contract (TRACE-PY-BRANCH);
+  * ``for _ in range(<traced>)`` unrolls against a runtime value
+    (TRACE-SHAPE-LOOP);
+  * mutating closure/self state from under the trace leaks trace-time
+    objects into host state (TRACE-CLOSURE-MUT, warning — some
+    first-trace metadata fills are deliberate and baseline-suppressed).
+
+The pass walks the STATIC call graph from each entry point: callees
+inside the package are analyzed under call-site taint (a parameter is
+traced only if a traced value actually flows into it at the call), so
+host helpers invoked with static attrs stay quiet. Dynamic dispatch
+(bound methods passed as values, lambdas handed to ``jax.*``
+combinators) is out of reach and documented as such.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding, source_fingerprint
+from .registry import DEFVJP_MODULES, TRACE_ENTRY_POINTS
+
+__all__ = ['run', 'ProjectIndex', 'analyze_entry']
+
+_MAX_DEPTH = 10
+
+_TIME_CALLS = frozenset((
+    'time.time', 'time.monotonic', 'time.perf_counter',
+    'time.process_time', 'time.clock', 'time.time_ns',
+    'time.monotonic_ns', 'time.perf_counter_ns',
+    'datetime.datetime.now', 'datetime.datetime.utcnow',
+    'datetime.date.today'))
+_ENV_CALLS = frozenset(('os.getenv', 'os.environ.get'))
+_HOST_CASTS = frozenset(('float', 'int', 'bool', 'complex'))
+_SYNC_METHODS = frozenset(('item', 'tolist', 'asnumpy', 'asscalar'))
+_STATIC_ATTRS = frozenset(('shape', 'ndim', 'dtype', 'size', 'aval',
+                           'name'))
+# builtins returning host values regardless of their argument
+_HOST_BUILTINS = frozenset((
+    'len', 'isinstance', 'callable', 'hasattr', 'getattr', 'id',
+    'type', 'str', 'repr', 'format', 'issubclass', 'range', 'all',
+    'any', 'divmod', 'print', 'ord', 'chr', 'vars', 'dir'))
+# builtins passing their argument's taint through (containers/iterators
+# over traced leaves stay traced)
+_TRANSPARENT_BUILTINS = frozenset((
+    'zip', 'enumerate', 'reversed', 'sorted', 'list', 'tuple', 'set',
+    'dict', 'frozenset', 'iter', 'next', 'map', 'filter', 'sum',
+    'min', 'max', 'abs', 'round', 'slice'))
+# jax/jnp calls that return HOST values (dtype/shape queries, abstract
+# evaluation) — everything else under jax.*/jnp.* yields traced values
+_JAX_HOST_CALLS = frozenset((
+    'jax.numpy.issubdtype', 'jax.numpy.iinfo', 'jax.numpy.finfo',
+    'jax.numpy.result_type', 'jax.numpy.promote_types',
+    'jax.dtypes.issubdtype', 'jax.dtypes.result_type',
+    'jax.eval_shape', 'jax.ShapeDtypeStruct', 'jax.numpy.dtype'))
+
+
+# -- project index ----------------------------------------------------------
+
+
+class ModuleInfo:
+    __slots__ = ('relpath', 'dotted', 'tree', 'defs', 'imports',
+                 'source_lines', 'register_names', 'defvjp_names')
+
+    def __init__(self, relpath, dotted, tree, source):
+        self.relpath = relpath
+        self.dotted = dotted
+        self.tree = tree
+        self.source_lines = source.splitlines()
+        self.defs = {}            # qualname -> FunctionDef node
+        self.imports = {}         # local alias -> full dotted target
+        self.register_names = []  # qualnames decorated @register(...)
+        self.defvjp_names = []    # qualnames wired via X.defvjp(f, b)
+        self._index()
+
+    def _index(self):
+        pkg_parts = self.dotted.split('.')
+
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = prefix + child.name if prefix else child.name
+                    self.defs[q] = child
+                    if not prefix and _has_register_decorator(child):
+                        self.register_names.append(q)
+                    walk(child, q + '.')
+                elif isinstance(child, ast.ClassDef):
+                    q = prefix + child.name if prefix else child.name
+                    walk(child, q + '.')
+                elif isinstance(child, (ast.If, ast.Try, ast.With)):
+                    walk(child, prefix)
+        walk(self.tree, '')
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split('.')[0]] = \
+                        a.name if a.asname else a.name.split('.')[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative: drop the module's own name + the extra
+                    # levels, then append the stated module
+                    base = pkg_parts[:-node.level]
+                    mod = '.'.join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    mod = node.module or ''
+                for a in node.names:
+                    if a.name == '*':
+                        continue
+                    self.imports[a.asname or a.name] = \
+                        (mod + '.' + a.name) if mod else a.name
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == 'defvjp':
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and \
+                            arg.id in self.defs:
+                        self.defvjp_names.append(arg.id)
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.source_lines):
+            return self.source_lines[lineno - 1]
+        return ''
+
+
+def _has_register_decorator(fn):
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(d, ast.Name) and d.id in ('register', 'alias'):
+            return True
+        if isinstance(d, ast.Attribute) and d.attr in ('register',
+                                                       'alias'):
+            return True
+    return False
+
+
+class ProjectIndex:
+    """Parsed view of every .py file under the package root (default:
+    the mxnet_tpu package this module ships in)."""
+
+    def __init__(self, root=None, package='mxnet_tpu'):
+        if root is None:
+            from . import repo_root
+            root = repo_root()
+        self.root = root
+        self.package = package
+        self.modules = {}         # relpath -> ModuleInfo
+        self.by_dotted = {}       # dotted -> ModuleInfo
+        pkg_dir = os.path.join(root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = [d for d in dirnames
+                           if d != '__pycache__']
+            for fn in sorted(filenames):
+                if not fn.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root)
+                self.add_file(path, rel)
+
+    def add_file(self, path, relpath):
+        """Parse one file into the index (also used by tests to lint
+        fixture files outside the package)."""
+        with open(path) as f:
+            source = f.read()
+        dotted = relpath[:-3].replace(os.sep, '.')
+        if dotted.endswith('.__init__'):
+            dotted = dotted[:-len('.__init__')]
+        info = ModuleInfo(relpath, dotted, ast.parse(source), source)
+        self.modules[relpath] = info
+        self.by_dotted[dotted] = info
+        return info
+
+    def resolve_module(self, dotted):
+        return self.by_dotted.get(dotted)
+
+
+# -- the analysis -----------------------------------------------------------
+
+
+class _FnAnalysis:
+    """One function body analyzed as trace context under a given taint
+    seeding."""
+
+    def __init__(self, linter, module, qualname, fn_node, tainted):
+        self.lint = linter
+        self.mod = module
+        self.qualname = qualname
+        self.fn = fn_node
+        self.env = dict.fromkeys(tainted, True)
+        self.local_names = set(_all_params(fn_node)) | set(tainted)
+        self.imports = dict(module.imports)
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split('.')[0]] = \
+                        a.name if a.asname else a.name.split('.')[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = module.dotted.split('.')[:-node.level]
+                    m = '.'.join(base + ([node.module]
+                                         if node.module else []))
+                else:
+                    m = node.module or ''
+                for a in node.names:
+                    if a.name != '*':
+                        self.imports[a.asname or a.name] = \
+                            (m + '.' + a.name) if m else a.name
+        # every name ever assigned in this function is local (for the
+        # closure-mutation rule)
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                self.local_names.add(node.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) and \
+                    node is not fn_node:
+                self.local_names.add(node.name)
+
+    # -- helpers ------------------------------------------------------------
+
+    def emit(self, rule, severity, node, message):
+        self.lint.emit(rule, severity, self.mod, self.qualname,
+                       node, message)
+
+    def dotted_of(self, expr):
+        """'a.b.c' for a Name/Attribute chain, with the root resolved
+        through the import map; None for anything else."""
+        parts = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        root = self.imports.get(expr.id, expr.id
+                                if expr.id not in self.local_names
+                                else None)
+        if root is None:
+            return None
+        return '.'.join([root] + list(reversed(parts)))
+
+    # -- taint --------------------------------------------------------------
+
+    def taint(self, e):
+        if e is None or isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Name):
+            return self.env.get(e.id, False)
+        if isinstance(e, ast.Attribute):
+            if e.attr in _STATIC_ATTRS:
+                return False
+            return self.taint(e.value)
+        if isinstance(e, ast.Subscript):
+            self.check_env_subscript(e)
+            # no short-circuit: every subexpression must be swept for
+            # host-call findings even once taint is established
+            parts = [self.taint(e.value), self.taint(e.slice)]
+            return any(parts)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any([self.taint(x) for x in e.elts])
+        if isinstance(e, ast.Dict):
+            return any([self.taint(x) for x in
+                        list(e.keys) + list(e.values)
+                        if x is not None])
+        if isinstance(e, ast.BinOp):
+            parts = [self.taint(e.left), self.taint(e.right)]
+            return any(parts)
+        if isinstance(e, ast.UnaryOp):
+            return self.taint(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any([self.taint(v) for v in e.values])
+        if isinstance(e, ast.Compare):
+            parts = [self.taint(e.left)] + \
+                [self.taint(c) for c in e.comparators]
+            # identity/membership tests are host decisions about host
+            # objects even when one side is traced (x is None, k in d)
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                   ast.NotIn)) for op in e.ops):
+                return False
+            return any(parts)
+        if isinstance(e, ast.IfExp):
+            if self.taint(e.test):
+                self.emit('TRACE-PY-BRANCH', 'error', e,
+                          'conditional expression on a traced value — '
+                          'respell with jnp.where/lax.cond')
+            return self.taint(e.body) or self.taint(e.orelse)
+        if isinstance(e, ast.Call):
+            return self.call_taint(e)
+        if isinstance(e, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                          ast.DictComp)):
+            saved = {}
+            for comp in e.generators:
+                t = self.taint(comp.iter)
+                it = comp.iter
+                if isinstance(it, ast.Call) and \
+                        isinstance(it.func, ast.Name) and \
+                        it.func.id == 'zip' and \
+                        isinstance(comp.target,
+                                   (ast.Tuple, ast.List)) and \
+                        len(comp.target.elts) == len(it.args) and \
+                        all(isinstance(el, ast.Name)
+                            for el in comp.target.elts):
+                    for el, src in zip(comp.target.elts, it.args):
+                        saved.setdefault(el.id, self.env.get(el.id))
+                        self.env[el.id] = self.taint(src)
+                    continue
+                for n in _target_names(comp.target):
+                    saved.setdefault(n, self.env.get(n))
+                    self.env[n] = t
+                for cond in comp.ifs:
+                    if self.taint(cond):
+                        self.emit('TRACE-PY-BRANCH', 'error', cond,
+                                  'comprehension filter on a traced '
+                                  'value — respell with jnp.where')
+            if isinstance(e, ast.DictComp):
+                out = self.taint(e.key) or self.taint(e.value)
+            else:
+                out = self.taint(e.elt)
+            for n, v in saved.items():
+                if v is None:
+                    self.env.pop(n, None)
+                else:
+                    self.env[n] = v
+            return out
+        if isinstance(e, ast.Starred):
+            return self.taint(e.value)
+        if isinstance(e, ast.Lambda):
+            return False         # analyzed only if called directly
+        if isinstance(e, (ast.JoinedStr, ast.FormattedValue)):
+            return False
+        return False
+
+    def call_taint(self, call):
+        args_tainted = any([self.taint(a) for a in call.args]
+                           + [self.taint(kw.value)
+                              for kw in call.keywords])
+        func = call.func
+        dotted = self.dotted_of(func)
+        if self.check_host_call(call, dotted, args_tainted):
+            # the call itself is the finding; its result is host state
+            # and walking into it would only duplicate the report
+            return False
+        # sweep the receiver of method calls (also catches chained
+        # forms like os.environ.get(...).lower() whose inner call a
+        # dotted-name walk cannot see)
+        recv_tainted = False
+        if dotted is None and isinstance(func, ast.Attribute):
+            recv_tainted = self.taint(func.value)
+        # method-style host syncs: x.item() on a traced x
+        if isinstance(func, ast.Attribute) and \
+                func.attr in _SYNC_METHODS and \
+                (recv_tainted or self.taint(func.value)):
+            self.emit('TRACE-HOST-SYNC', 'error', call,
+                      '.%s() on a traced value forces a device sync '
+                      'at trace time' % func.attr)
+            return False
+        if dotted is not None:
+            root = dotted.split('.')[0]
+            if root in ('jax', 'jnp'):
+                return dotted not in _JAX_HOST_CALLS
+            if dotted in ('numpy.asarray', 'numpy.array',
+                          'onp.asarray', 'onp.array'):
+                if args_tainted:
+                    self.emit('TRACE-HOST-SYNC', 'error', call,
+                              'numpy conversion of a traced value '
+                              'forces a device sync at trace time')
+                    return False
+            if root == self.lint.index.package:
+                callee = self.lint.resolve_callee(self.mod, self,
+                                                  dotted)
+                if callee is not None:
+                    return self.lint.walk_call(callee[0], callee[1],
+                                               callee[2], call, self)
+        if isinstance(func, ast.Name):
+            n = func.id
+            if n in _HOST_CASTS:
+                if args_tainted:
+                    self.emit('TRACE-HOST-SYNC', 'error', call,
+                              '%s() on a traced value forces a device '
+                              'sync at trace time (and freezes the '
+                              'result into the program)' % n)
+                return False
+            if n == 'print':
+                self.emit('TRACE-PRINT', 'warning', call,
+                          'print() under trace runs once at trace '
+                          'time, never per step')
+                return False
+            if n in _HOST_BUILTINS:
+                return False
+            if n in _TRANSPARENT_BUILTINS:
+                return args_tainted
+            # name resolving to a sibling/nested/module function
+            callee = self.lint.resolve_callee(self.mod, self, n)
+            if callee is not None:
+                return self.lint.walk_call(callee[0], callee[1],
+                                           callee[2], call, self)
+        # self.method(...) resolution
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == 'self':
+            cls = self.qualname.rsplit('.', 2)[0] \
+                if '.' in self.qualname else None
+            if cls:
+                callee = self.lint.resolve_callee(
+                    self.mod, self, cls + '.' + func.attr)
+                if callee is not None:
+                    return self.lint.walk_call(callee[0], callee[1],
+                                               callee[2], call, self,
+                                               method_self=True)
+        return args_tainted or recv_tainted
+
+    def check_host_call(self, call, dotted, args_tainted):
+        """Flag host env/time/random reads; True when flagged (the
+        caller then skips walking into the callee)."""
+        if dotted is None:
+            return False
+        if dotted in _ENV_CALLS or dotted.startswith('os.environ.'):
+            self.emit('TRACE-ENV', 'error', call,
+                      'environment read (%s) at trace time — hoist to '
+                      'a build-time closure capture '
+                      '(ops.traceknobs snapshot)' % dotted)
+        elif dotted.endswith('config.get') and \
+                dotted.startswith(self.lint.index.package):
+            self.emit('TRACE-ENV', 'error', call,
+                      'config-knob read (%s) at trace time — hoist to '
+                      'a build-time closure capture '
+                      '(ops.traceknobs snapshot)' % dotted)
+        elif dotted in _TIME_CALLS:
+            self.emit('TRACE-TIME', 'error', call,
+                      'host clock read (%s) at trace time freezes one '
+                      'timestamp into the compiled program' % dotted)
+        elif dotted.split('.')[0] == 'random' and '.' in dotted:
+            self.emit('TRACE-RANDOM', 'error', call,
+                      'host random draw (%s) at trace time freezes '
+                      'one sample into the compiled program — use the '
+                      'traced PRNG key' % dotted)
+        elif dotted.startswith('numpy.random.') or \
+                dotted.startswith('onp.random.'):
+            self.emit('TRACE-RANDOM', 'error', call,
+                      'numpy random draw (%s) at trace time freezes '
+                      'one sample into the compiled program — use the '
+                      'traced PRNG key' % dotted)
+        else:
+            return False
+        return True
+
+    # -- environment-access sweep (no taint needed) -------------------------
+
+    def check_env_subscript(self, node):
+        dotted = self.dotted_of(node.value) \
+            if isinstance(node, ast.Subscript) else None
+        if dotted == 'os.environ':
+            self.emit('TRACE-ENV', 'error', node,
+                      'os.environ[...] read at trace time — hoist to '
+                      'a build-time closure capture')
+
+    # -- statement walk -----------------------------------------------------
+
+    def run(self):
+        self.walk_stmts(self.fn.body)
+
+    def walk_stmts(self, stmts):
+        for st in stmts:
+            self.walk_stmt(st)
+
+    def walk_stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def inside a trace context is itself traced when
+            # called; analyze with positional taint
+            self.lint.analyze_function(
+                self.mod, self.qualname + '.' + st.name, st,
+                'positional')
+            return
+        if isinstance(st, (ast.Import, ast.ImportFrom)):
+            return
+        if isinstance(st, (ast.Global, ast.Nonlocal)):
+            self.emit('TRACE-CLOSURE-MUT', 'warning', st,
+                      '%s declaration in a trace context — writes '
+                      'leak trace-time objects into host state'
+                      % type(st).__name__.lower())
+            return
+        if isinstance(st, ast.Assign):
+            t = self.taint(st.value)
+            for tgt in st.targets:
+                self.assign_target(tgt, t, st)
+            return
+        if isinstance(st, ast.AugAssign):
+            t = self.taint(st.value) or self.taint(
+                _as_load(st.target))
+            self.assign_target(st.target, t, st)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self.assign_target(st.target, self.taint(st.value), st)
+            return
+        if isinstance(st, ast.If):
+            if self.taint(st.test):
+                self.emit('TRACE-PY-BRANCH', 'error', st,
+                          'Python if on a traced value picks ONE '
+                          'branch for every future step — respell '
+                          'with lax.cond/jnp.where')
+            self.walk_stmts(st.body)
+            self.walk_stmts(st.orelse)
+            return
+        if isinstance(st, ast.While):
+            if self.taint(st.test):
+                self.emit('TRACE-PY-BRANCH', 'error', st,
+                          'Python while on a traced value — respell '
+                          'with lax.while_loop')
+            self.walk_stmts(st.body)
+            self.walk_stmts(st.orelse)
+            return
+        if isinstance(st, ast.Assert):
+            if self.taint(st.test):
+                self.emit('TRACE-PY-BRANCH', 'error', st,
+                          'assert on a traced value — use '
+                          'checkify/debug callbacks or assert shapes '
+                          'instead')
+            return
+        if isinstance(st, ast.For):
+            it = st.iter
+            if isinstance(it, ast.Call) and \
+                    isinstance(it.func, ast.Name) and \
+                    it.func.id == 'range' and \
+                    any(self.taint(a) for a in it.args):
+                self.emit('TRACE-SHAPE-LOOP', 'error', st,
+                          'range() over a traced value — the loop '
+                          'unrolls against runtime data (retrace '
+                          'bomb); respell with lax.fori_loop/scan')
+            t = self.taint(it)
+            # zip() unpacking keeps PER-ELEMENT taint: `for tmpl, arr
+            # in zip(host_templates, traced_arrays)` must not taint the
+            # host element just because its partner is traced
+            if isinstance(it, ast.Call) and \
+                    isinstance(it.func, ast.Name) and \
+                    it.func.id == 'zip' and \
+                    isinstance(st.target, (ast.Tuple, ast.List)) and \
+                    len(st.target.elts) == len(it.args) and \
+                    all(isinstance(el, ast.Name)
+                        for el in st.target.elts):
+                for el, src in zip(st.target.elts, it.args):
+                    self.env[el.id] = self.taint(src)
+                    self.local_names.add(el.id)
+            else:
+                for n in _target_names(st.target):
+                    self.env[n] = t
+                    self.local_names.add(n)
+            self.walk_stmts(st.body)
+            self.walk_stmts(st.orelse)
+            return
+        if isinstance(st, ast.With):
+            for item in st.items:
+                self.taint(item.context_expr)
+                if item.optional_vars is not None:
+                    for n in _target_names(item.optional_vars):
+                        self.env[n] = False
+                        self.local_names.add(n)
+            self.walk_stmts(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.walk_stmts(st.body)
+            for h in st.handlers:
+                if h.name:
+                    self.local_names.add(h.name)
+                self.walk_stmts(h.body)
+            self.walk_stmts(st.orelse)
+            self.walk_stmts(st.finalbody)
+            return
+        if isinstance(st, (ast.Return, ast.Expr)):
+            if st.value is not None:
+                self.taint(st.value)
+                for sub in ast.walk(st.value):
+                    if isinstance(sub, ast.Subscript):
+                        self.check_env_subscript(sub)
+            return
+        if isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self.taint(st.exc)
+            return
+        # Pass/Break/Continue/Delete — nothing to do
+        return
+
+    def assign_target(self, tgt, tainted, st):
+        if isinstance(tgt, ast.Name):
+            self.env[tgt.id] = tainted
+            self.local_names.add(tgt.id)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self.assign_target(el, tainted, st)
+            return
+        if isinstance(tgt, ast.Starred):
+            self.assign_target(tgt.value, tainted, st)
+            return
+        if isinstance(tgt, ast.Attribute):
+            self.emit('TRACE-CLOSURE-MUT', 'warning', st,
+                      'attribute store (%s.%s = ...) in a trace '
+                      'context mutates host/closure state from under '
+                      'the trace'
+                      % (_expr_text(tgt.value), tgt.attr))
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            if isinstance(base, ast.Name) and \
+                    base.id not in self.local_names:
+                self.emit('TRACE-CLOSURE-MUT', 'warning', st,
+                          'subscript store into closure/global %r in '
+                          'a trace context' % base.id)
+            return
+
+
+def _target_names(tgt):
+    if isinstance(tgt, ast.Name):
+        return [tgt.id]
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out = []
+        for el in tgt.elts:
+            out.extend(_target_names(el))
+        return out
+    if isinstance(tgt, ast.Starred):
+        return _target_names(tgt.value)
+    return []
+
+
+def _as_load(node):
+    return ast.Name(id=node.id, ctx=ast.Load()) \
+        if isinstance(node, ast.Name) else node
+
+
+def _expr_text(e):
+    try:
+        return ast.unparse(e)
+    except Exception:
+        return '<expr>'
+
+
+# -- the linter driver ------------------------------------------------------
+
+
+class TraceLinter:
+    def __init__(self, index, entries=None, defvjp_modules=None):
+        self.index = index
+        self.entries = TRACE_ENTRY_POINTS if entries is None \
+            else entries
+        self.defvjp_modules = DEFVJP_MODULES \
+            if defvjp_modules is None else defvjp_modules
+        self.findings = []
+        self._seen = set()         # (rule, file, line) dedupe
+        self._memo = set()         # (relpath, qualname, taint-sig)
+        self._depth = 0
+        self.alias_targets = set()
+
+    def emit(self, rule, severity, module, qualname, node, message):
+        line = getattr(node, 'lineno', 0)
+        key = (rule, module.relpath, line)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        fp = source_fingerprint(rule, module.relpath, qualname,
+                                module.line_text(line))
+        self.findings.append(Finding(
+            rule, severity, module.relpath, line, message,
+            qualname=qualname, fp=fp))
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_callee(self, module, fa, name_or_dotted):
+        """Resolve a call target to (module, qualname, node) within
+        the indexed package; None when out of reach."""
+        # dotted package path ('mxnet_tpu.config.get')
+        if '.' in name_or_dotted and \
+                name_or_dotted.split('.')[0] == self.index.package:
+            mod_path, _, sym = name_or_dotted.rpartition('.')
+            m = self.index.resolve_module(mod_path)
+            if m is not None and sym in m.defs:
+                return (m, sym, m.defs[sym])
+            # maybe Class.method: mxnet_tpu.x.Cls.meth
+            parts = name_or_dotted.split('.')
+            for cut in range(len(parts) - 2, 0, -1):
+                m = self.index.resolve_module('.'.join(parts[:cut]))
+                if m is not None:
+                    q = '.'.join(parts[cut:])
+                    if q in m.defs:
+                        return (m, q, m.defs[q])
+            return None
+        # plain name: scope chain — ENCLOSING FUNCTIONS only (a class
+        # namespace is not a closure scope: a nested def inside
+        # Class.method must not resolve bare names to Class attributes)
+        if fa is not None:
+            scope = fa.qualname.split('.')
+            for i in range(len(scope), 0, -1):
+                prefix = '.'.join(scope[:i])
+                if prefix not in module.defs:
+                    continue      # class (or missing) level — skip
+                q = prefix + '.' + name_or_dotted
+                if q in module.defs:
+                    return (module, q, module.defs[q])
+        if name_or_dotted in module.defs:
+            return (module, name_or_dotted,
+                    module.defs[name_or_dotted])
+        # imported symbol
+        if fa is not None:
+            tgt = fa.imports.get(name_or_dotted.split('.')[0])
+            if tgt and tgt.split('.')[0] == self.index.package:
+                suffix = name_or_dotted.split('.')[1:]
+                return self.resolve_callee(
+                    module, None, '.'.join([tgt] + suffix))
+        return None
+
+    # -- walking ------------------------------------------------------------
+
+    def walk_call(self, module, qualname, fn_node, call, caller,
+                  method_self=False):
+        """Analyze a callee under call-site taint; returns whether its
+        result should be considered traced (any tainted arg)."""
+        params = _positional_params(fn_node)
+        if method_self and params and params[0] == 'self':
+            params = params[1:]
+        tainted = set()
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                if caller.taint(a.value):
+                    tainted.update(params[i:])
+                break
+            if i < len(params) and caller.taint(a):
+                tainted.add(params[i])
+        kw_names = _all_params(fn_node)
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in kw_names and \
+                    caller.taint(kw.value):
+                tainted.add(kw.arg)
+        self.analyze_function(module, qualname, fn_node,
+                              tuple(sorted(tainted)))
+        return bool(tainted)
+
+    def analyze_function(self, module, qualname, fn_node, taint_spec):
+        if self._depth >= _MAX_DEPTH:
+            return
+        if taint_spec == 'positional':
+            tainted = tuple(p for p in _positional_params(fn_node)
+                            if p != 'self')
+        elif taint_spec == 'none':
+            tainted = ()
+        else:
+            tainted = tuple(taint_spec)
+        memo_key = (module.relpath, qualname, tainted)
+        if memo_key in self._memo:
+            return
+        self._memo.add(memo_key)
+        self._depth += 1
+        try:
+            _FnAnalysis(self, module, qualname, fn_node,
+                        tainted).run()
+        finally:
+            self._depth -= 1
+
+    def run(self):
+        for relpath, spec, opts in self.entries:
+            module = self.index.modules.get(relpath)
+            if module is None:
+                continue
+            taint = opts.get('taint', 'positional')
+            if spec == '@register':
+                for q in module.register_names:
+                    self.analyze_function(module, q, module.defs[q],
+                                          taint)
+                continue
+            node = module.defs.get(spec)
+            if node is None:
+                self.findings.append(Finding(
+                    'TRACE-REGISTRY', 'error', relpath, 0,
+                    'registered trace entry point %r not found — '
+                    'update analysis/registry.py' % spec,
+                    qualname=spec))
+                continue
+            self.analyze_function(module, spec, node, taint)
+        for relpath in self.defvjp_modules:
+            module = self.index.modules.get(relpath)
+            if module is None:
+                continue
+            for q in module.defvjp_names:
+                self.analyze_function(module, q, module.defs[q],
+                                      'none')
+        return self.findings
+
+
+def _positional_params(fn_node):
+    a = fn_node.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def _all_params(fn_node):
+    a = fn_node.args
+    return {p.arg for p in list(a.posonlyargs) + list(a.args)
+            + list(a.kwonlyargs)}
+
+
+def run(root=None, entries=None, defvjp_modules=None, index=None):
+    """Run the trace-purity lint; returns a list of Findings."""
+    index = index or ProjectIndex(root=root)
+    return TraceLinter(index, entries=entries,
+                       defvjp_modules=defvjp_modules).run()

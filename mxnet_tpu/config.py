@@ -20,6 +20,15 @@ __all__ = ['Knob', 'KNOBS', 'get', 'set', 'unset', 'describe',
 
 _lock = threading.Lock()
 _values = {}
+# bumped on every set()/unset(): lets hot paths (ops.traceknobs) cache
+# derived views of the knob table and re-read only when it changed
+_epoch = 0
+
+
+def epoch():
+    """Monotonic counter of programmatic knob changes (lock-free read —
+    an int load is atomic under the GIL)."""
+    return _epoch
 
 
 class Knob:
@@ -439,8 +448,10 @@ def set(name, value):  # noqa: A001 - reference-style API
         value = bool(value)
     elif value is not None:
         value = knob.typ(value)
+    global _epoch
     with _lock:
         _values[name] = value
+        _epoch += 1
 
 
 def unset(name):
@@ -451,8 +462,10 @@ def unset(name):
     if name not in KNOBS:
         raise KeyError('unknown config knob %s (see config.describe())'
                        % name)
+    global _epoch
     with _lock:
         _values.pop(name, None)
+        _epoch += 1
 
 
 def describe():

@@ -23,7 +23,8 @@ from . import random as _random
 __all__ = ['Executor']
 
 
-def _build_graph_fn(symbol, training, creation_shapes=None, amp=None):
+def _build_graph_fn(symbol, training, creation_shapes=None, amp=None,
+                    knobs=None):
     """Pure function over {var_name: array} evaluating the symbol graph.
 
     Returns fn(var_values, key) -> (tuple outputs, {aux_name: new_value}).
@@ -36,12 +37,23 @@ def _build_graph_fn(symbol, training, creation_shapes=None, amp=None):
     copies of the fp32 arguments cast inside THIS compiled graph,
     softmax/loss/reduction ops widen back to float32, and the bound
     fp32 arg/aux arrays stay the untouched masters.
+    knobs: a :class:`~mxnet_tpu.ops.traceknobs.TraceKnobs` snapshot
+    (None = capture one now, at build time) installed over the trace so
+    op bodies never read the live environment from under it
+    (docs/ANALYSIS.md trace-purity contract).
     """
+    from .ops import traceknobs as _traceknobs
+    if knobs is None:
+        knobs = _traceknobs.snapshot()
     nodes = symbol._nodes()
     entries = symbol._entries
     creation_shapes = creation_shapes or {}
 
     def fn(var_values, key):
+        with _traceknobs.scope(knobs):
+            return _impl(var_values, key)
+
+    def _impl(var_values, key):
         vals = {}
         aux_updates = {}
         rng_i = 0
@@ -166,13 +178,16 @@ class Executor:
         self._amp = policy
         return self
 
-    def _graph_fn(self, training):
+    def _graph_fn(self, training, knobs=None):
+        from .ops import traceknobs as _traceknobs
+        if knobs is None:
+            knobs = _traceknobs.snapshot()
         key = (training, self._amp.cache_key if self._amp is not None
-               else None)
+               else None, knobs.cache_key)
         if key not in self._fwd_cache:
             raw = _build_graph_fn(self._symbol, training,
                                   self._creation_shapes(),
-                                  amp=self._amp)
+                                  amp=self._amp, knobs=knobs)
             self._fwd_cache[key] = (raw, jax.jit(raw))
         return self._fwd_cache[key]
 
@@ -215,10 +230,16 @@ class Executor:
         return self.outputs
 
     def _bwd_fn(self, training, grad_names):
+        from .ops import traceknobs as _traceknobs
+        # ONE snapshot for both the cache key and the program build —
+        # sampling twice would let a concurrent knob flip cache a
+        # program under the other setting's key
+        knobs = _traceknobs.snapshot()
         sig = (training, grad_names,
-               self._amp.cache_key if self._amp is not None else None)
+               self._amp.cache_key if self._amp is not None else None,
+               knobs.cache_key)
         if sig not in self._bwd_cache:
-            raw_fn, _ = self._graph_fn(training)
+            raw_fn, _ = self._graph_fn(training, knobs=knobs)
 
             def bwd(grad_vals, other_vals, key, cts, aux_ct):
                 def f(gv):

@@ -520,14 +520,21 @@ class CachedOp:
         # different traces
         self._jitted = {}
 
-    def _make_fn(self, training, mirror=False):
+    def _make_fn(self, training, mirror=False, knobs=None):
+        from ..ops import traceknobs as _traceknobs
         block = self._block
         param_names = [p.name for p in block._cached_op_params]
+        # build-time snapshot of the knobs op bodies consult under
+        # trace (docs/ANALYSIS.md trace-purity contract); __call__
+        # keys the jitted-fn cache on the SAME snapshot it passes in
+        if knobs is None:
+            knobs = _traceknobs.snapshot()
 
         def pure_fn(key, input_arrays, param_arrays):
             prev_train = autograd.set_training(training)
             try:
-                with _random.key_override(key), _TraceScope() as scope:
+                with _random.key_override(key), \
+                        _traceknobs.scope(knobs), _TraceScope() as scope:
                     # None inputs (optional masks etc.) pass through as-is
                     nd_in = [NDArray(a) if a is not None else None
                              for a in input_arrays]
@@ -579,10 +586,14 @@ class CachedOp:
         block = self._block
         training = autograd.is_training()
         from ..config import get as _cfg
+        from ..ops.traceknobs import snapshot as _knob_snapshot
         mirror = bool(_cfg('MXNET_BACKWARD_DO_MIRROR'))
-        sig = (training, mirror, tuple(x is None for x in inputs))
+        knobs = _knob_snapshot()
+        sig = (training, mirror, tuple(x is None for x in inputs),
+               knobs.cache_key)
         if sig not in self._jitted:
-            self._jitted[sig] = self._make_fn(training, mirror)
+            self._jitted[sig] = self._make_fn(training, mirror,
+                                              knobs=knobs)
         jit_fn, vjp_jit, meta = self._jitted[sig]
         params = block._cached_op_params
         param_arrays = [p.data()._data for p in params]

@@ -94,8 +94,9 @@ def poison_grads(grads, poison):
     normalization).
     """
     grads = list(grads)
-    if not grads:
-        return grads
+    if len(grads) == 0:     # host-list emptiness (spelled so the
+        return grads        # trace lint can see it is not a traced
+                            # truthiness test)
     g0 = grads[0]
     mask = None
     for d in range(g0.ndim):

@@ -21,6 +21,14 @@ the synchronous path, only slower. A consumer never takes over while
 the thread is inside ``next(source)`` — a stuck *source* is the
 DataLoader worker-timeout's problem, and two threads pulling one
 iterator would corrupt batch order.
+
+Lock hierarchy (enforced by ``mxnet_tpu.analysis.locklint``): ONE
+condition variable, ``self._cv``, guarding the queue/state machine.
+The user-supplied ``placer`` (a device_put that can block on a wedged
+transfer — the very failure mode being defended against) and every
+flight-recorder/metrics emit run strictly OUTSIDE it, on whichever
+thread does the work: pop/recover under the cv, place/emit after
+release.
 """
 from __future__ import annotations
 
@@ -198,16 +206,21 @@ class DevicePrefetcher:
     # -- consumer ----------------------------------------------------------
 
     def _degrade_locked(self, reason):
-        """Take over from the staging thread (caller holds the cv).
-        Queued staged batches stay in ``_buf`` (served first), the
-        thread's pending raw batch moves to ``_recovered``; the source
-        iterator is only touched synchronously from now on."""
+        """Take over from the staging thread (caller holds the cv; a
+        PURE state transition — the telemetry emit happens outside the
+        lock, see :meth:`__next__` / module lock hierarchy). Queued
+        staged batches stay in ``_buf`` (served first), the thread's
+        pending raw batch moves to ``_recovered``; the source iterator
+        is only touched synchronously from now on."""
         self._gen += 1
         self.degraded = True
         if self._pending is not None:
             self._recovered.append(self._pending)
             self._pending = None
         self._cv.notify_all()
+
+    def _emit_degraded(self, reason):
+        """Degradation telemetry — never called holding the cv."""
         try:
             from .. import observability as _obs
             if _obs.enabled():
@@ -220,45 +233,58 @@ class DevicePrefetcher:
         except Exception:
             pass
 
+    _PULL = object()      # sentinel: fall through to next(source)
+
     def __next__(self):
         if self._depth <= 0:
             return self._place(next(self._src))
-        with self._cv:
-            if not self.degraded:
-                deadline = (time.monotonic() + self._timeout) \
-                    if self._timeout > 0 else None
-                while not self._buf and not self._done and \
-                        not self._stop:
-                    if deadline is not None and \
-                            time.monotonic() >= deadline:
-                        if self._state == 'pulling':
-                            # the SOURCE is slow/stuck, not staging:
-                            # taking over would race the iterator —
-                            # keep waiting (same behavior the
-                            # synchronous path would have)
-                            deadline = time.monotonic() + self._timeout
-                        else:
-                            self._degrade_locked('stall')
-                            break
-                    wait = 0.2 if deadline is None else \
-                        min(0.2, max(deadline - time.monotonic(), 0.01))
-                    self._cv.wait(wait)
-            if self._buf:
-                item = self._buf.popleft()
-                self._cv.notify_all()
-                return item
-            if self._error is not None:
-                exc, self._error = self._error, None
-                self._done = True
-                raise exc
-            if self._done and not self._recovered:
-                raise StopIteration
-            # degraded: recovered raw batches first, then the source
-            if self._recovered:
-                raw = self._recovered.popleft()
-                return self._place(raw)
-        # degraded steady state: fully synchronous (outside the lock —
-        # nothing else touches the source once gen advanced)
+        degraded_now = None
+        raw = DevicePrefetcher._PULL
+        try:
+            with self._cv:
+                if not self.degraded:
+                    deadline = (time.monotonic() + self._timeout) \
+                        if self._timeout > 0 else None
+                    while not self._buf and not self._done and \
+                            not self._stop:
+                        if deadline is not None and \
+                                time.monotonic() >= deadline:
+                            if self._state == 'pulling':
+                                # the SOURCE is slow/stuck, not staging:
+                                # taking over would race the iterator —
+                                # keep waiting (same behavior the
+                                # synchronous path would have)
+                                deadline = time.monotonic() + \
+                                    self._timeout
+                            else:
+                                self._degrade_locked('stall')
+                                degraded_now = 'stall'
+                                break
+                        wait = 0.2 if deadline is None else \
+                            min(0.2, max(deadline - time.monotonic(),
+                                         0.01))
+                        self._cv.wait(wait)
+                if self._buf:
+                    item = self._buf.popleft()
+                    self._cv.notify_all()
+                    return item
+                if self._error is not None:
+                    exc, self._error = self._error, None
+                    self._done = True
+                    raise exc
+                if self._done and not self._recovered:
+                    raise StopIteration
+                # degraded: recovered raw batches first, then source
+                if self._recovered:
+                    raw = self._recovered.popleft()
+        finally:
+            if degraded_now is not None:
+                self._emit_degraded(degraded_now)
+        # placement runs outside the cv (lock hierarchy: the placer is
+        # a user callback that may block on the device); once gen
+        # advanced nothing else touches _recovered pops or the source
+        if raw is not DevicePrefetcher._PULL:
+            return self._place(raw)
         return self._place(next(self._src))
 
     def __iter__(self):

@@ -537,7 +537,15 @@ def _get_jitted(op, attrs, recording, variadic):
     """Return (jitted_fn, dyn_names): step-varying attrs listed in
     op.dynamic_attrs (e.g. Adam's bias-corrected lr) are excluded from the
     cache key and passed as traced scalar operands, so schedulers never
-    force a recompile."""
+    force a recompile.
+
+    Trace-purity (docs/ANALYSIS.md): the knobs op bodies consult under
+    trace (vjp rescheduling, internal conv layout) are snapshotted HERE
+    — on the host, at program-build time — installed over the trace via
+    traceknobs.scope, and folded into the cache key, so flipping a knob
+    re-jits instead of silently reusing the other setting's program."""
+    from ..ops import traceknobs as _tknobs
+    knobs = _tknobs.snapshot()
     dyn_names = () if op.needs_rng else tuple(
         n for n in op.dynamic_attrs
         if isinstance(attrs.get(n), (int, float))
@@ -545,7 +553,8 @@ def _get_jitted(op, attrs, recording, variadic):
     static = {k: v for k, v in attrs.items() if k not in dyn_names}
     key = (id(op), tuple(sorted((k, _attr_hashable(v))
                                 for k, v in static.items())),
-           dyn_names, bool(recording), bool(op.needs_rng))
+           dyn_names, bool(recording), bool(op.needs_rng),
+           knobs.cache_key)
     cached = _invoke_jit_cache.get(key)
     if cached is not None:
         _invoke_jit_cache.move_to_end(key)
@@ -577,7 +586,12 @@ def _get_jitted(op, attrs, recording, variadic):
         else:
             def jfn(*a):
                 return call(a[:nd_], a[nd_:])
-    jitted = jax.jit(jfn)
+
+    def scoped(*a, _jfn=jfn):
+        with _tknobs.scope(knobs):
+            return _jfn(*a)
+
+    jitted = jax.jit(scoped)
     inst = _dinst()
     inst.jit_misses.inc()
     from ..observability import enabled as _obs_enabled

@@ -22,8 +22,9 @@ import re
 
 from . import metrics as _metrics
 
-__all__ = ['COLLECTIVES', 'collective_bytes', 'trainer_collective_stats',
-           'iter_instruction_lines', 'shape_bytes']
+__all__ = ['COLLECTIVES', 'InstructionText', 'collective_bytes',
+           'trainer_collective_stats', 'iter_instruction_lines',
+           'iter_instructions', 'shape_bytes']
 
 COLLECTIVES = ('all-reduce', 'all-gather', 'reduce-scatter',
                'collective-permute', 'all-to-all')
@@ -86,23 +87,96 @@ def iter_instruction_lines(hlo_text):
         yield buf
 
 
-def _instruction_opcode(line, opcodes):
-    """Find the first ``opcode(`` occurrence from ``opcodes`` on an
-    instruction line, returning ``(opcode, start_index)`` or None.
+class InstructionText:
+    """One HLO instruction at the text level — the SHARED light parse
+    every text analysis builds on (``collective_bytes``, the roofline's
+    precision sniffing, ``analysis.hlolint``). Robust to tuple-typed
+    results — ``%x = ((f32[8]{0}, u8[]{:...})) all-gather-done(...)`` —
+    where a naive "type is one token" regex mis-splits the line and
+    drops the instruction silently.
 
-    Robust to tuple-typed results — ``%x = ((f32[8]{0}, u8[]{:...}))
-    all-gather-done(...)`` — where a naive "type is one token" regex
-    mis-splits the line and drops the instruction silently."""
-    eq = line.find('=')
-    if eq < 0:
-        return None
-    rest = line[eq + 1:]
-    m = re.search(
-        r'\b((?:%s)(?:-start|-done)?(?:\.\d+)?)\('
-        % '|'.join(re.escape(c) for c in opcodes), rest)
-    if not m:
-        return None
-    return m.group(1), eq + 1 + m.start()
+    ``opcode`` is the raw token (suffixes kept: ``all-gather-done``);
+    ``base`` strips the ``.N`` uniquifier and the async ``-start`` /
+    ``-done`` suffixes; ``is_start`` / ``is_done`` carry what was
+    stripped. ``result_type`` is the raw type text (may be a tuple);
+    ``operands_text`` the balanced-paren operand list including the
+    parens; ``attrs`` everything after it.
+    """
+
+    __slots__ = ('name', 'root', 'opcode', 'base', 'is_start', 'is_done',
+                 'result_type', 'operands_text', 'attrs', 'line')
+
+    def __init__(self, name, root, opcode, base, is_start, is_done,
+                 result_type, operands_text, attrs, line):
+        self.name = name
+        self.root = root
+        self.opcode = opcode
+        self.base = base
+        self.is_start = is_start
+        self.is_done = is_done
+        self.result_type = result_type
+        self.operands_text = operands_text
+        self.attrs = attrs
+        self.line = line
+
+    @property
+    def result_bytes(self):
+        return shape_bytes(self.result_type)
+
+
+_INSTR_NAME = re.compile(r'^\s*(ROOT\s+)?%?([\w.-]+)\s*=\s*')
+_OPCODE_AFTER_TYPE = re.compile(r'\s*([\w-]+(?:\.\d+)?)\(')
+
+
+def _balanced_span(text, start):
+    """End index (inclusive) of the paren group opening at ``start``."""
+    depth = 0
+    for i in range(start, len(text)):
+        depth += (text[i] == '(') - (text[i] == ')')
+        if depth == 0:
+            return i
+    return len(text) - 1
+
+
+def iter_instructions(hlo_text):
+    """Yield :class:`InstructionText` for every instruction of an HLO
+    text dump (headers/braces skipped, wrapped lines re-joined)."""
+    for line in iter_instruction_lines(hlo_text):
+        stripped = line.strip()
+        if stripped.endswith('{') or stripped == '}' or \
+                stripped.startswith('HloModule'):
+            continue
+        m = _INSTR_NAME.match(line)
+        if not m:
+            continue
+        root, name = bool(m.group(1)), m.group(2)
+        rest = line[m.end():]
+        if rest.startswith('('):          # tuple-typed result
+            end = _balanced_span(rest, 0)
+            result_type, rest = rest[:end + 1], rest[end + 1:]
+        else:
+            sp = rest.find(' ')
+            if sp < 0:
+                continue
+            result_type, rest = rest[:sp], rest[sp:]
+        om = _OPCODE_AFTER_TYPE.match(rest)
+        if not om:
+            continue
+        opcode = om.group(1)
+        ostart = om.end() - 1
+        oend = _balanced_span(rest, ostart)
+        operands_text = rest[ostart:oend + 1]
+        attrs = rest[oend + 1:]
+        base = re.sub(r'\.\d+$', '', opcode)
+        is_start = base.endswith('-start')
+        is_done = base.endswith('-done')
+        if is_start:
+            base = base[:-6]
+        elif is_done:
+            base = base[:-5]
+        yield InstructionText(name, root, opcode, base, is_start,
+                              is_done, result_type, operands_text,
+                              attrs, line)
 
 
 def collective_bytes(hlo_text):
@@ -116,21 +190,14 @@ def collective_bytes(hlo_text):
     instructions wrapped across physical lines."""
     total = 0
     per_kind = {}
-    for line in iter_instruction_lines(hlo_text):
-        found = _instruction_opcode(line, COLLECTIVES)
-        if found is None:
+    for instr in iter_instructions(hlo_text):
+        if instr.base not in COLLECTIVES or instr.is_start:
             continue
-        kind, pos = found
-        base = kind.rstrip('.0123456789')
-        if base.endswith('-start'):
-            continue
-        base = base[:-5] if base.endswith('-done') else base
-        # type text = everything between '=' and the opcode; for a
-        # '-done' op the result type IS the logical collective's output
-        eq = line.find('=')
-        nbytes = shape_bytes(line[eq + 1:pos])
+        # for a '-done' op the result type IS the logical collective's
+        # output
+        nbytes = instr.result_bytes
         total += nbytes
-        per_kind[base] = per_kind.get(base, 0) + nbytes
+        per_kind[instr.base] = per_kind.get(instr.base, 0) + nbytes
     return total, per_kind
 
 

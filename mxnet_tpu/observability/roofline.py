@@ -35,7 +35,7 @@ import json
 import re
 
 from .hlo import (DTYPE_BYTES, collective_bytes, iter_instruction_lines,
-                  shape_bytes)
+                  iter_instructions, shape_bytes)
 
 __all__ = ['SCHEMA', 'Instruction', 'parse_module', 'analyze',
            'roofline_artifact', 'diff_artifacts', 'format_table',
@@ -313,8 +313,6 @@ def reference_machine(precision='bf16'):
             'precision': precision}
 
 
-_LOW_MATMUL_RE = re.compile(
-    r'\b(?:dot|convolution)(?:\.\d+)?\(')
 _FP16_TYPE_RE = re.compile(r'(?<!b)f16\[')
 
 
@@ -331,10 +329,10 @@ def program_precision(hlo_text):
     converts, so on the CI rig the matmul lines alone would misread
     an AMP program as fp32)."""
     fp16_any = bf16_any = False
-    for line in iter_instruction_lines(hlo_text):
-        has_bf16 = 'bf16[' in line
-        has_fp16 = bool(_FP16_TYPE_RE.search(line))
-        if has_bf16 and _LOW_MATMUL_RE.search(line):
+    for instr in iter_instructions(hlo_text):
+        has_bf16 = 'bf16[' in instr.line
+        has_fp16 = bool(_FP16_TYPE_RE.search(instr.line))
+        if has_bf16 and instr.base in ('dot', 'convolution'):
             return 'bf16'
         bf16_any = bf16_any or has_bf16
         fp16_any = fp16_any or has_fp16

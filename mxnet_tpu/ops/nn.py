@@ -74,11 +74,18 @@ _CONV_INTERNAL = {'nhwc': None}
 
 
 def _conv_nhwc():
-    import os
-    pref = os.environ.get('MXNET_CONV_LAYOUT_INTERNAL', 'auto').lower()
+    from .traceknobs import current as _knobs
+    snap = _knobs()
+    if snap is not None:
+        # trace-purity contract (docs/ANALYSIS.md): the trace entry
+        # point snapshotted the env at build time — no ambient read
+        # from under the trace
+        pref = snap.conv_layout
+    else:
+        import os
+        pref = os.environ.get('MXNET_CONV_LAYOUT_INTERNAL',
+                              'auto').lower()
     if pref in ('nhwc', 'nchw'):
-        # explicit setting: honored on every trace, so tests may flip the
-        # env var at any time without hitting a process-wide latch
         return pref == 'nhwc'
     # auto: channels-last on accelerators, NCHW on host. Only the backend
     # query is latched — it is the part that forces backend init, and the
@@ -321,8 +328,15 @@ def pooling(data, *, kernel=None, pool_type='max', global_pool=False,
 
 
 def _vjp_resched():
-    """Hot-op vjp rescheduling gate (trace-time read; flipping the knob
-    does not invalidate already-compiled eager programs)."""
+    """Hot-op vjp rescheduling gate. Consults the trace entry point's
+    build-time :mod:`~mxnet_tpu.ops.traceknobs` snapshot first (the
+    trace-purity contract, docs/ANALYSIS.md); the live config read only
+    remains as the fallback for bare ``jax.jit`` over raw ops where no
+    snapshot scope is installed."""
+    from .traceknobs import current as _knobs
+    snap = _knobs()
+    if snap is not None:
+        return snap.vjp_reschedule
     from ..config import get as _cfg
     return bool(_cfg('MXNET_TPU_VJP_RESCHEDULE'))
 

@@ -1,0 +1,119 @@
+"""Build-time snapshots of the knobs op bodies consult under trace.
+
+The trace-purity contract (docs/ANALYSIS.md, rule TRACE-ENV): code that
+runs *inside* a jit trace must not read ambient host state — an
+``os.environ`` / ``config.get`` lookup at trace time bakes whatever the
+environment happened to say into the compiled program without becoming
+part of any cache key, so a knob flipped mid-run silently does nothing
+(the cached program wins) or, worse, two traces of "the same" function
+disagree. Two ops historically did exactly that:
+
+  * ``MXNET_TPU_VJP_RESCHEDULE`` — read by ``ops/nn.py`` activation /
+    dropout / pooling / softmax_cross_entropy bodies to pick the
+    hand-scheduled custom_vjp path;
+  * ``MXNET_CONV_LAYOUT_INTERNAL`` — read by the Convolution body to
+    pick the internal NHWC-vs-NCHW spelling.
+
+The fix: every trace entry point (the eager jit cache, the symbolic
+``executor._build_graph_fn`` graphs, gluon's ``CachedOp``, the
+``ParallelTrainer`` step body) captures a :class:`TraceKnobs` snapshot
+ON THE HOST at build time and installs it over the trace with
+:class:`scope`; the op-body helpers consult :func:`current` first and
+only fall back to the live environment read when no snapshot is
+installed (a bare ``jax.jit`` over raw ops, e.g. in a unit test). The
+eager jit cache additionally keys its compiled programs on the
+snapshot, so flipping either knob now correctly re-jits instead of
+being latched by whichever program traced first.
+
+The snapshot values are plain host Python — closure capture, not
+operands — so the traced programs are byte-identical to the pre-fix
+ones; only *when the knob is read* moves (trace time → build time).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ['TraceKnobs', 'snapshot', 'scope', 'current']
+
+
+class TraceKnobs:
+    """Immutable host-side capture of the trace-consulted knobs.
+
+    ``vjp_reschedule``: bool — the MXNET_TPU_VJP_RESCHEDULE gate.
+    ``conv_layout``: 'nhwc' | 'nchw' | 'auto' — the raw
+    MXNET_CONV_LAYOUT_INTERNAL preference ('auto' defers to the
+    backend query, which is latched process-wide and therefore safe
+    to resolve lazily).
+    """
+
+    __slots__ = ('vjp_reschedule', 'conv_layout')
+
+    def __init__(self, vjp_reschedule, conv_layout):
+        self.vjp_reschedule = bool(vjp_reschedule)
+        self.conv_layout = conv_layout
+
+    @property
+    def cache_key(self):
+        """Hashable identity for compiled-program cache keys."""
+        return (self.vjp_reschedule, self.conv_layout)
+
+    def __repr__(self):
+        return 'TraceKnobs(vjp_reschedule=%s, conv_layout=%r)' % (
+            self.vjp_reschedule, self.conv_layout)
+
+
+_snap_cache = None     # ((config.epoch, raw vjp env, raw conv env),
+                       #  TraceKnobs) — snapshot() sits on the eager
+                       # dispatch hot path; re-derive only when a knob
+                       # actually moved (config.set bumps the epoch,
+                       # env flips change the raw strings)
+
+
+def snapshot():
+    """Read the trace-consulted knobs from the live config/environment
+    (HOST time — call this at program-build time, never under trace)."""
+    global _snap_cache
+    import os
+    from .. import config as _config
+    state = (_config.epoch(),
+             os.environ.get('MXNET_TPU_VJP_RESCHEDULE'),
+             os.environ.get('MXNET_CONV_LAYOUT_INTERNAL', 'auto'))
+    cached = _snap_cache
+    if cached is not None and cached[0] == state:
+        return cached[1]
+    knobs = TraceKnobs(
+        vjp_reschedule=bool(_config.get('MXNET_TPU_VJP_RESCHEDULE')),
+        conv_layout=state[2].lower())
+    _snap_cache = (state, knobs)
+    return knobs
+
+
+_tls = threading.local()
+
+
+def current():
+    """The snapshot installed over this thread's trace, or None. Called
+    from op bodies (i.e. at trace time) — a bare attribute read."""
+    return getattr(_tls, 'knobs', None)
+
+
+class scope:
+    """Install a snapshot for the ops traced inside the ``with`` block
+    (re-entrant; ``scope(None)`` is a true no-op so call sites stay
+    unconditional)."""
+
+    __slots__ = ('_knobs', '_prev')
+
+    def __init__(self, knobs):
+        self._knobs = knobs
+
+    def __enter__(self):
+        self._prev = getattr(_tls, 'knobs', None)
+        if self._knobs is not None:
+            _tls.knobs = self._knobs
+        return self._knobs
+
+    def __exit__(self, *exc):
+        if self._knobs is not None:
+            _tls.knobs = self._prev
+        return False

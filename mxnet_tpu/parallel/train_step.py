@@ -54,14 +54,19 @@ def pure_forward_fn(block, training=True):
     exposed for the parallel layer to compose with grad/optimizer.
     """
     from ..gluon.block import _TraceScope, _flatten
+    from ..ops import traceknobs as _traceknobs
 
     params = block._cached_op_params
     meta = {}
+    # build-time knob snapshot installed over every trace of fn
+    # (docs/ANALYSIS.md trace-purity contract)
+    knobs = _traceknobs.snapshot()
 
     def fn(key, param_arrays, input_arrays):
         prev_train = autograd.set_training(training)
         try:
-            with _random.key_override(key), _TraceScope() as scope:
+            with _random.key_override(key), _traceknobs.scope(knobs), \
+                    _TraceScope() as scope:
                 nd_in = [NDArray(a) if a is not None else None
                          for a in input_arrays]
                 nd_params = [NDArray(a) for a in param_arrays]
@@ -422,7 +427,12 @@ class ParallelTrainer:
         xs_live = [a for a in xs if a is not None]
 
         from ..amp.policy import scope as _amp_scope
+        from ..ops import traceknobs as _traceknobs
         amp_policy = self._amp_policy
+        # build-time snapshot of the knobs op bodies consult under
+        # trace; installed around the traced forward/loss and the
+        # traced optimizer update (docs/ANALYSIS.md trace-purity)
+        knobs = _traceknobs.snapshot()
 
         def loss_of(key, param_arrays, data_arrays, label_arrays):
             # re-insert the None placeholders (optional masks etc.) that
@@ -438,7 +448,7 @@ class ParallelTrainer:
             # value_and_grad returns are w.r.t. the fp32 masters (the
             # astype vjp widens cotangents at each param boundary), so
             # the update below runs in float32 exactly as without AMP.
-            with _amp_scope(amp_policy):
+            with _traceknobs.scope(knobs), _amp_scope(amp_policy):
                 outs, auxs = fwd(key, list(param_arrays), full_in)
                 nd_outs = [NDArray(o) for o in outs]
                 nd_labels = [NDArray(a) for a in label_arrays]
@@ -519,7 +529,7 @@ class ParallelTrainer:
                     jax.lax.with_sharding_constraint(g,
                                                      zero_shardings[i])
                     for i, g in enumerate(grads))
-            with _random.key_override(key), \
+            with _random.key_override(key), _traceknobs.scope(knobs), \
                     _HyperPatch(opt, indices, lrs, wds, ts, rescale_eff):
                 new_params, new_leaves = apply_traced_updates(
                     opt, indices, list(param_arrays), list(grads),

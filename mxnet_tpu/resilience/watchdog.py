@@ -28,6 +28,13 @@ Deterministic testing: the scripted fault kind ``hang``
 age the heartbeat past the budget instead of refreshing it — the
 detection, artifact, and escalation paths run on CPU with an untouched
 wall clock (tools/fault_smoke.py, tests/test_elastic.py).
+
+Lock hierarchy (enforced by ``mxnet_tpu.analysis.locklint``): ONE lock
+— ``self._lock`` — guarding only the heartbeat/phase/step fields.
+Everything that can run foreign code stays OUTSIDE it: the fault
+injector, the ``on_stall`` user callback, artifact writes, and every
+flight-recorder/metrics emit. Methods snapshot the fields they need
+under the lock and act on the copies.
 """
 from __future__ import annotations
 
@@ -136,36 +143,45 @@ class Watchdog:
         (or the monitor thread) then takes the real detection path.
         """
         now = self._clock()
+        # the injector is callback machinery (module lock hierarchy):
+        # fire it before taking the lock, fold the verdict in after
+        hang = False
+        try:
+            inject(self.site, ('hang',), injector=self._injector,
+                   step=step)
+        except HangError:
+            hang = True
         with self._lock:
             if phase is not None:
                 self._phase = phase
+            cur_phase = self._phase
             self._step = step
-            try:
-                inject(self.site, ('hang',), injector=self._injector,
-                       step=step)
-            except HangError:
-                self._last = now - self.budget_for(self._phase) - 1.0
-                return
-            self._last = now
-        self._telemetry_beat(step)
+            self._last = (now - self.budget_for(cur_phase) - 1.0) \
+                if hang else now
+        if not hang:
+            self._telemetry_beat(step, cur_phase)
 
     def phase(self, phase):
         """Switch phase (``compile`` / ``step`` / ``collective``) and
         refresh the heartbeat under the new budget."""
-        self.beat(step=self._step, phase=phase)
+        with self._lock:
+            step = self._step
+        self.beat(step=step, phase=phase)
 
     # -- detection ---------------------------------------------------------
 
-    def _telemetry_beat(self, step):
+    def _telemetry_beat(self, step, phase):
         """Heartbeat telemetry (lazy import: this layer stays jax-free):
         age gauge back to zero + a flight-recorder heartbeat event, so
-        a post-stall dump shows exactly where the beats stopped."""
+        a post-stall dump shows exactly where the beats stopped. The
+        phase arrives as the caller's locked snapshot — this runs
+        outside the lock and must not re-read shared fields."""
         try:
             from .. import observability as _obs
             if _obs.enabled():
                 _obs.trainer_instruments().heartbeat_age.set(0.0)
                 _obs.record_event('watchdog_heartbeat', step=step,
-                                  phase=self._phase)
+                                  phase=phase)
         except Exception:
             pass
 

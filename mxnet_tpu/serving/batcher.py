@@ -23,6 +23,16 @@ pops requests in arrival order and maps output row *i* to request
 *i*. The runner callable executes on the single worker thread, so the
 compiled-program cache underneath needs no locking.
 
+Lock hierarchy (enforced by ``mxnet_tpu.analysis.locklint``): ONE lock
+— ``self._lock`` (``self._wake`` is a Condition over the same lock) —
+guarding the queue, in-flight list, and counters. Nothing that can run
+user code executes under it: ``Future.set_result`` /
+``set_exception`` (done-callbacks fire inline), the runner, and every
+flight-recorder/metrics emit happen only after the lock is released.
+Expired requests are *collected* under the lock and *failed* outside
+it, so a done-callback that re-enters the batcher (``submit`` /
+``stats``) can never deadlock.
+
 numpy + stdlib only (no jax import): the queue math is testable with
 a fake runner and a fake clock, the same dependency-light discipline
 as the resilience layer.
@@ -62,13 +72,19 @@ class BatcherClosed(RuntimeError):
 
 
 class _Request:
-    __slots__ = ('arrays', 'future', 'enqueued_at', 'deadline_at')
+    __slots__ = ('arrays', 'future', 'enqueued_at', 'deadline_at',
+                 'expiring')
 
     def __init__(self, arrays, future, enqueued_at, deadline_at):
         self.arrays = arrays
         self.future = future
         self.enqueued_at = enqueued_at
         self.deadline_at = deadline_at
+        # set under the lock when a timeout scan collects this request;
+        # the failure itself is delivered after release, so without the
+        # flag a second scan in that window would collect (and count)
+        # the same request twice
+        self.expiring = False
 
 
 def _serving_instruments():
@@ -185,24 +201,32 @@ class MicroBatcher:
         arrays = self._normalize([onp.asarray(a) for a in arrays])
         now = self._clock()
         fut = Future()
+        rejected_depth = None
         with self._lock:
             if self._closed:
                 raise BatcherClosed('batcher %r is closed' % self.name)
             depth = len(self._queue)
             if depth >= self.max_queue:
                 self._rejected += 1
-                inst = _serving_instruments()
-                if inst is not None:
-                    inst.rejected.labels(reason='queue_full').inc()
-                    inst.queue_depth.set(depth)
-                _record_event('serve_reject', reason='queue_full',
-                              depth=depth, limit=self.max_queue)
-                raise BackpressureError(depth, self.max_queue)
-            deadline_at = now + self.timeout_s if self.timeout_s else None
-            self._queue.append(_Request(arrays, fut, now, deadline_at))
-            self._submitted += 1
-            depth = len(self._queue)
-            self._wake.notify()
+                rejected_depth = depth
+            else:
+                deadline_at = now + self.timeout_s if self.timeout_s \
+                    else None
+                self._queue.append(_Request(arrays, fut, now,
+                                            deadline_at))
+                self._submitted += 1
+                depth = len(self._queue)
+                self._wake.notify()
+        # admission telemetry outside the lock (module lock hierarchy:
+        # flight-recorder/metrics emits never run under self._lock)
+        if rejected_depth is not None:
+            inst = _serving_instruments()
+            if inst is not None:
+                inst.rejected.labels(reason='queue_full').inc()
+                inst.queue_depth.set(rejected_depth)
+            _record_event('serve_reject', reason='queue_full',
+                          depth=rejected_depth, limit=self.max_queue)
+            raise BackpressureError(rejected_depth, self.max_queue)
         inst = _serving_instruments()
         if inst is not None:
             inst.requests.inc()
@@ -225,22 +249,26 @@ class MicroBatcher:
 
     # -- worker ------------------------------------------------------------
 
-    def _expire_queued_locked(self, now):
-        """Fail requests past their budget; drop cancelled queued
-        ones. Covers both the queue AND requests already popped into
-        a batch whose runner is hung — the budget holds even when the
-        worker is stuck (the in-flight futures just get the timeout;
-        a late-finishing runner skips done futures). Caller holds the
-        lock."""
+    def _collect_expired_locked(self, now, fails):
+        """Collect requests past their budget into ``fails`` as
+        ``(future, exception)`` pairs; drop cancelled queued ones.
+        Covers both the queue AND requests already popped into a batch
+        whose runner is hung — the budget holds even when the worker is
+        stuck (the in-flight futures just get the timeout; a
+        late-finishing runner skips done futures). Caller holds the
+        lock; the futures are failed OUTSIDE it via
+        :meth:`_fail_expired` (done-callbacks run inline on
+        ``set_exception`` and must never execute under ``self._lock``)."""
         kept = []
         for req in self._queue:
             if req.deadline_at is not None and \
                     now >= req.deadline_at and \
-                    not req.future.done():
+                    not req.expiring and not req.future.done():
+                req.expiring = True
                 self._timeouts += 1
-                req.future.set_exception(RequestTimeout(
+                fails.append((req.future, RequestTimeout(
                     'request waited %.3fs in queue (budget %.3fs)'
-                    % (now - req.enqueued_at, self.timeout_s)))
+                    % (now - req.enqueued_at, self.timeout_s))))
             elif req.future.cancelled():
                 pass
             else:
@@ -249,52 +277,75 @@ class MicroBatcher:
         for req in self._inflight:
             if req.deadline_at is not None and \
                     now >= req.deadline_at and \
-                    not req.future.done():
+                    not req.expiring and not req.future.done():
+                req.expiring = True
                 self._timeouts += 1
-                req.future.set_exception(RequestTimeout(
+                fails.append((req.future, RequestTimeout(
                     'request in-flight %.3fs without a result (budget '
                     '%.3fs; runner stuck?)'
-                    % (now - req.enqueued_at, self.timeout_s)))
+                    % (now - req.enqueued_at, self.timeout_s))))
+
+    @staticmethod
+    def _fail_expired(fails):
+        """Deliver collected timeout failures — caller must NOT hold
+        the lock. A concurrent ``cancel()`` can win the race between
+        the locked collect and this set; that request is simply done."""
+        for fut, exc in fails:
+            if fut.done():
+                continue
+            try:
+                fut.set_exception(exc)
+            except Exception:
+                pass
 
     def _reap_loop(self):
         """Timeout scan independent of the worker: a runner blocked on
         a dead backend must not also freeze the per-request budgets."""
         while True:
             time.sleep(min(0.05, max(self.timeout_s / 4.0, 0.005)))
+            fails = []
             with self._lock:
                 if self._closed and not self._queue:
                     return
-                self._expire_queued_locked(self._clock())
+                self._collect_expired_locked(self._clock(), fails)
+            self._fail_expired(fails)
 
     def _take_batch(self):
         """Block until a batch is due; pop and return it (FIFO).
         Returns (requests, cause) or (None, None) at close-drain."""
-        with self._lock:
-            while True:
+        while True:
+            fails = []
+            result = None
+            with self._lock:
                 if self._queue:
-                    self._expire_queued_locked(self._clock())
+                    self._collect_expired_locked(self._clock(), fails)
                 if not self._queue:
                     if self._closed:
-                        return None, None
-                    self._wake.wait(0.05)
-                    continue
-                now = self._clock()
-                oldest = self._queue[0].enqueued_at
-                if len(self._queue) >= self.max_batch:
-                    cause = 'full'
-                elif self._closed:
-                    cause = 'drain'
-                elif now - oldest >= self.deadline_s:
-                    cause = 'deadline'
+                        result = (None, None)
+                    else:
+                        self._wake.wait(0.05)
                 else:
-                    self._wake.wait(
-                        min(self.deadline_s - (now - oldest), 0.05))
-                    continue
-                batch = self._queue[:self.max_batch]
-                del self._queue[:len(batch)]
-                self._inflight = batch
-                self._flushes[cause] += 1
-                return batch, cause
+                    now = self._clock()
+                    oldest = self._queue[0].enqueued_at
+                    cause = None
+                    if len(self._queue) >= self.max_batch:
+                        cause = 'full'
+                    elif self._closed:
+                        cause = 'drain'
+                    elif now - oldest >= self.deadline_s:
+                        cause = 'deadline'
+                    if cause is None:
+                        self._wake.wait(
+                            min(self.deadline_s - (now - oldest), 0.05))
+                    else:
+                        batch = self._queue[:self.max_batch]
+                        del self._queue[:len(batch)]
+                        self._inflight = batch
+                        self._flushes[cause] += 1
+                        result = (batch, cause)
+            self._fail_expired(fails)
+            if result is not None:
+                return result
 
     def _worker(self):
         while True:
@@ -308,12 +359,16 @@ class MicroBatcher:
         # client cancelled) requests between the pop in _take_batch
         # and this flush — computing their rows would waste device
         # batch slots on futures nobody can read, so run the expire
-        # scan once more and drop every already-done request before
-        # stacking. The live subset keeps its FIFO row mapping.
+        # scan once more and drop every already-done (or just-expired)
+        # request before stacking. The live subset keeps its FIFO row
+        # mapping.
+        fails = []
         with self._lock:
-            self._expire_queued_locked(self._clock())
-            batch = [req for req in batch if not req.future.done()]
+            self._collect_expired_locked(self._clock(), fails)
+            batch = [req for req in batch
+                     if not req.future.done() and not req.expiring]
             self._inflight = batch
+        self._fail_expired(fails)
         if not batch:
             return
         n = len(batch)
@@ -366,16 +421,20 @@ class MicroBatcher:
 
     def close(self, drain=True, timeout=10.0):
         """Stop accepting requests; drain the queue (or fail pending
-        futures when ``drain=False``) and join the worker."""
+        futures when ``drain=False``) and join the worker. Pending
+        futures are failed AFTER the lock is released (lock
+        hierarchy)."""
+        fails = []
         with self._lock:
             self._closed = True
             if not drain:
                 for req in self._queue:
                     if not req.future.done():
-                        req.future.set_exception(
-                            BatcherClosed('batcher closed'))
+                        fails.append((req.future,
+                                      BatcherClosed('batcher closed')))
                 self._queue = []
             self._wake.notify_all()
+        self._fail_expired(fails)
         self._thread.join(timeout)
 
     def __enter__(self):

@@ -246,6 +246,7 @@ class DecodeEngine:
         stream = GenerateStream(len(prompt))
         seq = _Seq(stream, prompt, max_new, eos_id, now,
                    now + self.timeout_s if self.timeout_s else None)
+        rejected_depth = None
         with self._lock:
             if self._closed:
                 raise BatcherClosed('decode engine %r is closed'
@@ -253,15 +254,21 @@ class DecodeEngine:
             depth = len(self._pending)
             if depth >= self.max_queue:
                 self._counts['rejected'] += 1
-                inst = _serving_instruments()
-                if inst is not None:
-                    inst.rejected.labels(reason='queue_full').inc()
-                _record_event('serve_reject', reason='queue_full',
-                              depth=depth, limit=self.max_queue)
-                raise BackpressureError(depth, self.max_queue)
-            self._pending.append(seq)
-            self._counts['requests'] += 1
-            self._wake.notify()
+                rejected_depth = depth
+            else:
+                self._pending.append(seq)
+                self._counts['requests'] += 1
+                self._wake.notify()
+        # admission telemetry outside the lock (locklint LOCK-EMIT:
+        # flight-recorder/metrics emits never extend a critical
+        # section — same hierarchy as serving/batcher.py)
+        if rejected_depth is not None:
+            inst = _serving_instruments()
+            if inst is not None:
+                inst.rejected.labels(reason='queue_full').inc()
+            _record_event('serve_reject', reason='queue_full',
+                          depth=rejected_depth, limit=self.max_queue)
+            raise BackpressureError(rejected_depth, self.max_queue)
         inst = _serving_instruments()
         if inst is not None:
             inst.requests.inc()

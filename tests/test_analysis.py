@@ -1,0 +1,1075 @@
+"""Static-analysis subsystem tests (docs/ANALYSIS.md).
+
+Four layers, mirroring the subsystem:
+
+  * fixture files with known-bad trace/lock patterns asserting each
+    rule fires with the correct file:line, and known-good respellings
+    (``lax.cond``, lock-then-copy-then-callback) asserting zero false
+    positives;
+  * the finding/fingerprint/baseline machinery (``mxnet_tpu.lint.v1``);
+  * hlolint invariants against both synthetic HLO and real compiled
+    step programs (amp on/off, dp=1/N, ZeRO, donation);
+  * regression tests for the satellite fixes the lint drove: the
+    traceknobs build-time snapshot (bit-identity + re-jit on flip),
+    and the lock-hierarchy fixes in batcher/staging/watchdog
+    (callbacks and telemetry outside the lock, behavior unchanged).
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, nd
+from mxnet_tpu.analysis import hlolint, locklint, registry, tracelint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# fixture helpers
+# ---------------------------------------------------------------------------
+
+
+def _line_of(source, marker):
+    for i, ln in enumerate(source.splitlines(), 1):
+        if marker in ln:
+            return i
+    raise AssertionError('marker %r not in fixture' % marker)
+
+
+def _trace_lint(tmp_path, source, entries, package='fix',
+                name='mod.py'):
+    pkg = tmp_path / package
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / name).write_text(source)
+    index = tracelint.ProjectIndex(root=str(tmp_path), package=package)
+    specs = [(package + '/' + name, q, {'taint': 'positional'})
+             for q in entries]
+    return tracelint.TraceLinter(index, entries=specs,
+                                 defvjp_modules=[]).run()
+
+
+def _lock_lint(tmp_path, source, name='mod.py'):
+    path = tmp_path / name
+    path.write_text(source)
+    return locklint.analyze_module(str(path))
+
+
+# ---------------------------------------------------------------------------
+# tracelint: each rule fires with the correct file:line
+# ---------------------------------------------------------------------------
+
+
+def test_trace_env_read_fires_with_location(tmp_path):
+    src = (
+        'import os\n'
+        '\n'
+        'def kernel(data):\n'
+        "    mode = os.environ.get('KNOB', 'x')  # MARK-GET\n"
+        "    raw = os.environ['KNOB2']  # MARK-SUB\n"
+        '    return data, mode, raw\n')
+    fs = _trace_lint(tmp_path, src, ['kernel'])
+    env = [f for f in fs if f.rule == 'TRACE-ENV']
+    assert len(env) == 2
+    assert {f.line for f in env} == {_line_of(src, 'MARK-GET'),
+                                     _line_of(src, 'MARK-SUB')}
+    assert all(f.file == 'fix/mod.py' for f in env)
+    assert all(f.severity == 'error' for f in env)
+
+
+def test_trace_config_knob_read_fires(tmp_path):
+    # config.get is only an env read when it is THIS package's config
+    # module — exercised with a fixture inside a 'mxnet_tpu' package
+    src = (
+        'from mxnet_tpu.config import get as _cfg\n'
+        '\n'
+        'def kernel(data):\n'
+        "    return data * float(_cfg('MXNET_TPU_X'))  # MARK\n")
+    fs = _trace_lint(tmp_path, src, ['kernel'], package='mxnet_tpu')
+    env = [f for f in fs if f.rule == 'TRACE-ENV']
+    assert len(env) == 1
+    assert env[0].line == _line_of(src, 'MARK')
+    assert 'config-knob' in env[0].message
+
+
+def test_trace_time_and_random_fire(tmp_path):
+    src = (
+        'import time\n'
+        'import random\n'
+        'import numpy as onp\n'
+        '\n'
+        'def kernel(data):\n'
+        '    t0 = time.perf_counter()  # MARK-TIME\n'
+        '    j = random.random()  # MARK-RAND\n'
+        '    n = onp.random.randn()  # MARK-NP\n'
+        '    return data + t0 + j + n\n')
+    fs = _trace_lint(tmp_path, src, ['kernel'])
+    by_rule = {}
+    for f in fs:
+        by_rule.setdefault(f.rule, []).append(f.line)
+    assert by_rule['TRACE-TIME'] == [_line_of(src, 'MARK-TIME')]
+    assert sorted(by_rule['TRACE-RANDOM']) == sorted(
+        [_line_of(src, 'MARK-RAND'), _line_of(src, 'MARK-NP')])
+
+
+def test_trace_host_sync_fires(tmp_path):
+    src = (
+        'import numpy as onp\n'
+        '\n'
+        'def kernel(data):\n'
+        '    h = float(data)  # MARK-FLOAT\n'
+        '    i = data.item()  # MARK-ITEM\n'
+        '    a = onp.asarray(data)  # MARK-ASARRAY\n'
+        '    return h + i + a.sum()\n')
+    fs = _trace_lint(tmp_path, src, ['kernel'])
+    sync = [f for f in fs if f.rule == 'TRACE-HOST-SYNC']
+    assert {f.line for f in sync} >= {_line_of(src, 'MARK-FLOAT'),
+                                      _line_of(src, 'MARK-ITEM'),
+                                      _line_of(src, 'MARK-ASARRAY')}
+
+
+def test_trace_py_branch_fires(tmp_path):
+    src = (
+        'def kernel(data, scale):\n'
+        '    if scale > 0:  # MARK-IF\n'
+        '        data = data * scale\n'
+        '    out = 1.0 if (data > 0).all() else 0.0  # MARK-IFEXP\n'
+        '    return data + out\n')
+    fs = _trace_lint(tmp_path, src, ['kernel'])
+    br = [f for f in fs if f.rule == 'TRACE-PY-BRANCH']
+    assert _line_of(src, 'MARK-IF') in {f.line for f in br}
+    assert _line_of(src, 'MARK-IFEXP') in {f.line for f in br}
+
+
+def test_trace_shape_loop_fires(tmp_path):
+    src = (
+        'def kernel(data, n):\n'
+        '    for _ in range(n):  # MARK\n'
+        '        data = data + 1\n'
+        '    return data\n')
+    fs = _trace_lint(tmp_path, src, ['kernel'])
+    loops = [f for f in fs if f.rule == 'TRACE-SHAPE-LOOP']
+    assert [f.line for f in loops] == [_line_of(src, 'MARK')]
+
+
+def test_trace_closure_mutation_fires(tmp_path):
+    src = (
+        '_CACHE = {}\n'
+        '\n'
+        'def kernel(data):\n'
+        "    _CACHE['last'] = data  # MARK\n"
+        '    return data\n')
+    fs = _trace_lint(tmp_path, src, ['kernel'])
+    mut = [f for f in fs if f.rule == 'TRACE-CLOSURE-MUT']
+    assert _line_of(src, 'MARK') in {f.line for f in mut}
+    assert all(f.severity == 'warning' for f in mut)
+
+
+def test_taint_flows_through_static_call_graph(tmp_path):
+    """A helper is analyzed under CALL-SITE taint: the same helper is
+    clean when fed a host attr and dirty when fed a traced value —
+    and the finding lands at the helper's line with its qualname."""
+    src = (
+        'def helper(x):\n'
+        '    return float(x)  # MARK\n'
+        '\n'
+        'def kernel(data, *, mode=2):\n'
+        '    a = helper(mode)\n'
+        '    b = helper(data)\n'
+        '    return a + b + data\n')
+    fs = _trace_lint(tmp_path, src, ['kernel'])
+    sync = [f for f in fs if f.rule == 'TRACE-HOST-SYNC']
+    assert [f.line for f in sync] == [_line_of(src, 'MARK')]
+    assert sync[0].qualname == 'helper'
+
+    # only the static-attr call: no findings at all
+    src_clean = (
+        'def helper(x):\n'
+        '    return float(x)\n'
+        '\n'
+        'def kernel(data, *, mode=2):\n'
+        '    return data * helper(mode)\n')
+    assert _trace_lint(tmp_path / 'clean', src_clean, ['kernel']) == []
+
+
+def test_good_idioms_are_quiet(tmp_path):
+    """The respelled idioms the satellite fixes landed on (lax.cond,
+    jnp.where, host-attr branches, identity tests, host-list loops,
+    .shape-bounded loops, len()) must produce ZERO findings."""
+    src = (
+        'import jax\n'
+        'import jax.numpy as jnp\n'
+        '\n'
+        "def kernel(data, scale, *, mode='fast'):\n"
+        "    if mode == 'fast':\n"
+        '        data = jnp.tanh(data)\n'
+        '    out = jax.lax.cond(scale[0] > 0,\n'
+        '                       lambda d: d * scale, lambda d: d, data)\n'
+        '    out = jnp.where(out >= 0, out, 0.0)\n'
+        '    if data is None:\n'
+        '        return out\n'
+        '    if len(data.shape) == 4:\n'
+        '        out = out + 1\n'
+        '    total = jnp.zeros(())\n'
+        '    for g in (data, out):\n'
+        '        total = total + jnp.sum(g)\n'
+        '    for d in range(data.ndim):\n'
+        '        total = total + data.shape[d]\n'
+        '    return total\n')
+    assert _trace_lint(tmp_path, src, ['kernel']) == []
+
+
+def test_missing_registry_entry_is_a_finding(tmp_path):
+    src = 'def kernel(data):\n    return data\n'
+    fs = _trace_lint(tmp_path, src, ['not_there'])
+    assert [f.rule for f in fs] == ['TRACE-REGISTRY']
+    assert fs[0].severity == 'error'
+
+
+def test_every_registered_entry_point_resolves():
+    """Registry drift guard: every TRACE_ENTRY_POINTS spec must name a
+    real def in the real repo (a rename would otherwise silently stop
+    linting that trace context)."""
+    index = tracelint.ProjectIndex(root=REPO)
+    fs = tracelint.TraceLinter(index).run()
+    missing = [f for f in fs if f.rule == 'TRACE-REGISTRY']
+    assert missing == [], missing
+
+
+# ---------------------------------------------------------------------------
+# locklint: each rule fires with the correct file:line
+# ---------------------------------------------------------------------------
+
+_BAD_LOCK_SRC = (
+    'import threading\n'
+    '\n'
+    'def record_event(kind, **fields):\n'
+    '    pass\n'
+    '\n'
+    'class Bad:\n'
+    '    def __init__(self, on_done=None):\n'
+    '        self._a = threading.Lock()\n'
+    '        self._b = threading.Lock()\n'
+    '        self._on_done = on_done\n'
+    '        self.depth = 0\n'
+    '\n'
+    '    def ab(self):\n'
+    '        with self._a:\n'
+    '            with self._b:  # MARK-AB\n'
+    '                self.depth += 1\n'
+    '\n'
+    '    def ba(self, fut):\n'
+    '        with self._b:\n'
+    '            with self._a:  # MARK-BA\n'
+    '                self.depth -= 1\n'
+    "            fut.set_exception(RuntimeError('x'))  # MARK-FUT\n"
+    '            self._on_done(self.depth)  # MARK-CB\n'
+    "            record_event('bad', depth=self.depth)  # MARK-EMIT\n"
+    '\n'
+    '    def reenter(self):\n'
+    '        with self._a:\n'
+    '            self.helper()\n'
+    '\n'
+    '    def helper(self):\n'
+    '        with self._a:  # MARK-REENTER\n'
+    '            return self.depth\n'
+    '\n'
+    '    def racy(self):\n'
+    '        self.depth = 41  # MARK-RACY\n')
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    fs = _lock_lint(tmp_path, _BAD_LOCK_SRC)
+    order = [f for f in fs if f.rule == 'LOCK-ORDER']
+    assert order, fs
+    lines = {f.line for f in order}
+    assert lines & {_line_of(_BAD_LOCK_SRC, 'MARK-AB'),
+                    _line_of(_BAD_LOCK_SRC, 'MARK-BA')}
+    assert all(f.severity == 'error' for f in order)
+
+
+def test_lock_reentry_through_self_call_detected(tmp_path):
+    fs = _lock_lint(tmp_path, _BAD_LOCK_SRC)
+    re_ = [f for f in fs if f.rule == 'LOCK-REENTRY']
+    assert _line_of(_BAD_LOCK_SRC, 'MARK-REENTER') in \
+        {f.line for f in re_}
+
+
+def test_lock_callback_and_future_detected(tmp_path):
+    fs = _lock_lint(tmp_path, _BAD_LOCK_SRC)
+    cb = [f for f in fs if f.rule == 'LOCK-CALLBACK']
+    assert {_line_of(_BAD_LOCK_SRC, 'MARK-FUT'),
+            _line_of(_BAD_LOCK_SRC, 'MARK-CB')} <= \
+        {f.line for f in cb}
+
+
+def test_lock_emit_detected(tmp_path):
+    fs = _lock_lint(tmp_path, _BAD_LOCK_SRC)
+    em = [f for f in fs if f.rule == 'LOCK-EMIT']
+    assert _line_of(_BAD_LOCK_SRC, 'MARK-EMIT') in {f.line for f in em}
+    assert all(f.severity == 'warning' for f in em)
+
+
+def test_lock_unguarded_write_detected(tmp_path):
+    fs = _lock_lint(tmp_path, _BAD_LOCK_SRC)
+    uw = [f for f in fs if f.rule == 'LOCK-UNGUARDED-WRITE']
+    assert _line_of(_BAD_LOCK_SRC, 'MARK-RACY') in {f.line for f in uw}
+    # __init__ writes are exempt
+    assert all(f.line != _line_of(_BAD_LOCK_SRC, 'self.depth = 0')
+               for f in uw)
+
+
+def test_lock_then_copy_then_callback_is_quiet(tmp_path):
+    """The blessed shape every satellite fix converged on: snapshot
+    under the lock, run callbacks/emits after release. Condition over
+    the same lock aliases to ONE lock; *_locked helpers are
+    caller-holds-lock by convention."""
+    src = (
+        'import threading\n'
+        '\n'
+        'def record_event(kind, **fields):\n'
+        '    pass\n'
+        '\n'
+        'class Good:\n'
+        '    def __init__(self, on_done=None):\n'
+        '        self._lock = threading.Lock()\n'
+        '        self._cv = threading.Condition(self._lock)\n'
+        '        self._on_done = on_done\n'
+        '        self._items = []\n'
+        '\n'
+        '    def _expire_locked(self):\n'
+        '        self._items = [i for i in self._items if i]\n'
+        '\n'
+        '    def push(self, item):\n'
+        '        with self._lock:\n'
+        '            self._items.append(item)\n'
+        '            self._expire_locked()\n'
+        '            self._cv.notify()\n'
+        '\n'
+        '    def drain(self):\n'
+        '        with self._cv:\n'
+        '            taken, self._items = self._items, []\n'
+        '        for item in taken:\n'
+        '            self._on_done(item)\n'
+        "        record_event('drained', n=len(taken))\n")
+    assert _lock_lint(tmp_path, src) == []
+
+
+def test_rlock_reentry_is_quiet(tmp_path):
+    src = (
+        'import threading\n'
+        '\n'
+        'class Re:\n'
+        '    def __init__(self):\n'
+        '        self._lock = threading.RLock()\n'
+        '\n'
+        '    def outer(self):\n'
+        '        with self._lock:\n'
+        '            return self.inner()\n'
+        '\n'
+        '    def inner(self):\n'
+        '        with self._lock:\n'
+        '            return 1\n')
+    fs = _lock_lint(tmp_path, src)
+    assert [f for f in fs if f.rule == 'LOCK-REENTRY'] == []
+
+
+# ---------------------------------------------------------------------------
+# the repo itself is clean against the committed baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_head_is_clean_against_baseline():
+    """The acceptance gate in-process: tracelint + locklint over the
+    real tree must produce no finding that is not suppressed (with a
+    reason) in LINT_BASELINE.json, and no suppression may be stale."""
+    index = tracelint.ProjectIndex(root=REPO)
+    findings = tracelint.TraceLinter(index).run()
+    findings += locklint.LockLinter(index).run()
+    baseline = analysis.load_baseline(
+        os.path.join(REPO, 'LINT_BASELINE.json'))
+    new, suppressed, stale = analysis.apply_baseline(findings, baseline)
+    assert new == [], '\n'.join(repr(f) for f in new)
+    assert stale == [], stale
+    for ent in baseline.values():
+        assert ent['reason'] and not ent['reason'].startswith('TODO')
+
+
+# ---------------------------------------------------------------------------
+# finding / fingerprint / baseline machinery (mxnet_tpu.lint.v1)
+# ---------------------------------------------------------------------------
+
+
+def test_finding_schema_and_jsonl_roundtrip(tmp_path):
+    f = analysis.Finding('TRACE-ENV', 'error', 'a/b.py', 12,
+                         'env read', qualname='kernel')
+    d = f.to_dict()
+    assert d['schema'] == 'mxnet_tpu.lint.v1'
+    assert d['rule'] == 'TRACE-ENV' and d['line'] == 12
+    assert d['fingerprint'] and d['qualname'] == 'kernel'
+    h = analysis.Finding('HLO-DP1-COLLECTIVE', 'error', 'step', 0,
+                         'collective', instr='%all-reduce.1')
+    assert h.to_dict()['instr'] == '%all-reduce.1'
+    assert '[%all-reduce.1]' in h.location()
+    path = str(tmp_path / 'out.jsonl')
+    analysis.write_jsonl([f, h], path)
+    back = analysis.read_jsonl(path)
+    assert [r['rule'] for r in back] == ['TRACE-ENV',
+                                        'HLO-DP1-COLLECTIVE']
+    with pytest.raises(ValueError):
+        analysis.Finding('X', 'fatal', 'a.py', 1, 'bad severity')
+
+
+def test_fingerprint_stable_across_line_drift(tmp_path):
+    """Inserting unrelated lines above a finding must NOT orphan its
+    baseline suppression: the fingerprint hashes rule + file +
+    qualname + source text, never the line number."""
+    src = ('import os\n'
+           '\n'
+           'def kernel(data):\n'
+           "    m = os.environ.get('K')  # MARK\n"
+           '    return data, m\n')
+    fs1 = _trace_lint(tmp_path, src, ['kernel'])
+    drifted = 'import os\n\n# pad\n# pad\n' + src.split('\n', 1)[1]
+    fs2 = _trace_lint(tmp_path / 'v2', drifted, ['kernel'])
+    f1 = [f for f in fs1 if f.rule == 'TRACE-ENV'][0]
+    f2 = [f for f in fs2 if f.rule == 'TRACE-ENV'][0]
+    assert f1.line != f2.line
+    assert f1.fingerprint == f2.fingerprint
+
+
+def test_baseline_requires_reason_and_schema(tmp_path):
+    path = tmp_path / 'BASE.json'
+    path.write_text(json.dumps({
+        'schema': 'mxnet_tpu.lint.v1',
+        'suppressions': [{'fingerprint': 'abc', 'rule': 'X'}]}))
+    with pytest.raises(ValueError, match='reason'):
+        analysis.load_baseline(str(path))
+    path.write_text(json.dumps({'schema': 'wrong', 'suppressions': []}))
+    with pytest.raises(ValueError, match='schema'):
+        analysis.load_baseline(str(path))
+    assert analysis.load_baseline(str(tmp_path / 'missing.json')) == {}
+
+
+def test_apply_baseline_splits_new_suppressed_stale():
+    a = analysis.Finding('R1', 'error', 'a.py', 1, 'one')
+    b = analysis.Finding('R2', 'error', 'b.py', 2, 'two')
+    baseline = {a.fingerprint: {'fingerprint': a.fingerprint,
+                                'rule': 'R1', 'reason': 'known'},
+                'dead0000dead0000': {'fingerprint': 'dead0000dead0000',
+                                     'rule': 'R9', 'reason': 'gone'}}
+    new, suppressed, stale = analysis.apply_baseline([a, b], baseline)
+    assert [f.rule for f in new] == ['R2']
+    assert [f.rule for f in suppressed] == ['R1']
+    assert [e['rule'] for e in stale] == ['R9']
+
+
+# ---------------------------------------------------------------------------
+# hlolint: synthetic programs, one rule each
+# ---------------------------------------------------------------------------
+
+_HLO_HEAD = 'HloModule jit_step, is_scheduled=true\n\nENTRY %main {\n'
+_HLO_TAIL = '}\n'
+
+
+def _hlo(*lines):
+    return _HLO_HEAD + '\n'.join('  ' + ln for ln in lines) + _HLO_TAIL
+
+
+_F32_DOT = ('%dot.1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %p0, '
+            'f32[8,8]{1,0} %p1), lhs_contracting_dims={1}, '
+            'rhs_contracting_dims={0}')
+_BF16_DOT = ('%dot.2 = bf16[8,8]{1,0} dot(bf16[8,8]{1,0} %q0, '
+             'bf16[8,8]{1,0} %q1), lhs_contracting_dims={1}, '
+             'rhs_contracting_dims={0}')
+_ALLREDUCE = ('%all-reduce.3 = f32[8]{0} all-reduce(f32[8]{0} %g), '
+              'replica_groups={}, to_apply=%add')
+_ALIAS = ('%fusion.9 = f32[8]{0} fusion(f32[8]{0} %p0), kind=kLoop, '
+          'calls=%fused, input_output_alias={ {0}: (0, {}, '
+          'may-alias) }')
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def test_hlolint_amp_f32_matmul_on_tpu():
+    fs = hlolint.check(_hlo(_F32_DOT), {'amp': 'bf16',
+                                        'platform': 'tpu'})
+    assert 'HLO-AMP-F32-MATMUL' in _rules(fs)
+    assert any(f.instr for f in fs)
+    # a bf16 dot satisfies the invariant
+    assert hlolint.check(_hlo(_BF16_DOT), {'amp': 'bf16',
+                                           'platform': 'tpu'}) == []
+
+
+def test_hlolint_amp_bf16_on_cpu_requires_low_buffers():
+    # XLA:CPU rewrites bf16 dots to f32 compute — the compensating
+    # check is that bf16 buffers exist SOMEWHERE in the program
+    fs = hlolint.check(_hlo(_F32_DOT), {'amp': 'bf16',
+                                        'platform': 'cpu'})
+    assert _rules(fs) == {'HLO-AMP-NOT-LOW'}
+    assert hlolint.check(_hlo(_F32_DOT, _BF16_DOT),
+                         {'amp': 'bf16', 'platform': 'cpu'}) == []
+
+
+def test_hlolint_fp16_not_satisfied_by_bf16_buffers():
+    """'f16[' must not substring-match 'bf16[': a bf16-only program
+    does NOT satisfy the fp16 invariants."""
+    fs = hlolint.check(_hlo(_BF16_DOT), {'amp': 'fp16',
+                                         'platform': 'cpu'})
+    assert 'HLO-AMP-NOT-LOW' in _rules(fs)
+    f16_dot = _BF16_DOT.replace('bf16[', 'f16[')
+    assert hlolint.check(_hlo(f16_dot), {'amp': 'fp16',
+                                         'platform': 'cpu'}) == []
+    # TPU side: a dot with f32+bf16 operands in an fp16 program is a
+    # bypassed cast, not a satisfied one
+    mixed = ('%dot.9 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %p0, '
+             'bf16[8,8]{1,0} %q1), lhs_contracting_dims={1}, '
+             'rhs_contracting_dims={0}')
+    fs = hlolint.check(_hlo(mixed), {'amp': 'fp16', 'platform': 'tpu'})
+    assert 'HLO-AMP-F32-MATMUL' in _rules(fs)
+
+
+def test_hlolint_amp_off_rejects_low_precision():
+    fs = hlolint.check(_hlo(_BF16_DOT), {'amp': 'off'})
+    assert 'HLO-AMP-OFF-LOW' in _rules(fs)
+    assert hlolint.check(_hlo(_F32_DOT), {'amp': 'off'}) == []
+
+
+def test_hlolint_collective_rules():
+    fs = hlolint.check(_hlo(_F32_DOT, _ALLREDUCE), {'dp': 1})
+    assert 'HLO-DP1-COLLECTIVE' in _rules(fs)
+    assert hlolint.check(_hlo(_F32_DOT), {'dp': 1}) == []
+    fs = hlolint.check(_hlo(_F32_DOT), {'dp': 8})
+    assert 'HLO-DPN-NO-COLLECTIVE' in _rules(fs)
+    assert hlolint.check(_hlo(_F32_DOT, _ALLREDUCE), {'dp': 8}) == []
+
+
+def test_hlolint_zero_requires_reduce_scatter():
+    rs = ('%reduce-scatter.4 = f32[4]{0} reduce-scatter(f32[8]{0} '
+          '%g), replica_groups={}, dimensions={0}, to_apply=%add')
+    ds = ('%dynamic-slice.5 = f32[4]{0} dynamic-slice(f32[8]{0} %g, '
+          's32[] %i), dynamic_slice_sizes={4}')
+    assert hlolint.check(_hlo(rs), {'zero': True,
+                                    'platform': 'tpu'}) == []
+    fs = hlolint.check(_hlo(_ALLREDUCE), {'zero': True,
+                                          'platform': 'tpu'})
+    assert 'HLO-ZERO-NO-RS' in _rules(fs)
+    # the XLA:CPU lowering (all-reduce + dynamic-slice) is accepted
+    assert hlolint.check(_hlo(_ALLREDUCE, ds),
+                         {'zero': True, 'platform': 'cpu'}) == []
+
+
+def test_hlolint_donation_and_host_transfer():
+    fs = hlolint.check(_hlo(_F32_DOT), {'donation': True})
+    assert 'HLO-DONATION-DROPPED' in _rules(fs)
+    assert hlolint.check(_HLO_HEAD + '  ' + _F32_DOT + '\n' + _HLO_TAIL
+                         + _ALIAS, {'donation': True}) == []
+    out = ('%outfeed.7 = token[] outfeed(f32[8]{0} %x, token[] %tok)')
+    fs = hlolint.check(_hlo(out), {})
+    assert 'HLO-HOST-TRANSFER' in _rules(fs)
+
+
+def test_expect_from_config_maps_fusion_baseline_blocks():
+    cfg = {'amp': 'off', 'mesh': {'dp': 8}, 'zero': True,
+           'platform': 'cpu', 'model': 'resnet50_v1'}
+    exp = registry.expect_from_config(cfg)
+    assert exp['dp'] == 8 and exp['zero'] and exp['amp'] == 'off'
+    assert exp['donation'] and exp['no_outfeed']
+    assert exp['platform'] == 'cpu'
+    exp = registry.expect_from_config({'amp': 'bf16', 'mesh': {}},
+                                      platform='tpu')
+    assert exp['amp'] == 'bf16' and exp['dp'] == 1
+    assert exp['platform'] == 'tpu'
+
+
+def test_committed_fusion_baseline_configs_map_cleanly():
+    with open(os.path.join(REPO, 'FUSION_BASELINE.json')) as f:
+        base = json.load(f)
+    for name, prog in base['programs'].items():
+        exp = registry.expect_from_config(prog['config'])
+        assert isinstance(exp['dp'], int) and exp['dp'] >= 1, name
+        assert exp['amp'] in ('off', 'bf16', 'fp16'), name
+
+
+# ---------------------------------------------------------------------------
+# the shared HLO instruction iterator (satellite: one parser, three users)
+# ---------------------------------------------------------------------------
+
+
+def test_iter_instructions_fields():
+    from mxnet_tpu.observability.hlo import iter_instructions
+    text = (
+        'HloModule jit_step\n'
+        '\n'
+        'ENTRY %main {\n'
+        '  %p0 = f32[8,8]{1,0} parameter(0)\n'
+        '  %ag.1 = (f32[8]{0}, u8[]) all-gather-start(f32[4]{0} %p0), '
+        'dimensions={0}\n'
+        '  %ag.2 = f32[8]{0} all-gather-done((f32[8]{0}, u8[]) %ag.1)\n'
+        '  ROOT %add.3 = f32[8,8]{1,0} add(f32[8,8]{1,0} %p0,\n'
+        '    f32[8,8]{1,0} %p0), metadata={op_name="add"}\n'
+        '}\n')
+    instrs = {i.name: i for i in iter_instructions(text)}
+    assert instrs['p0'].base == 'parameter'
+    ag1 = instrs['ag.1']
+    assert ag1.base == 'all-gather' and ag1.is_start
+    assert ag1.result_type.startswith('(')          # tuple-typed
+    ag2 = instrs['ag.2']
+    assert ag2.base == 'all-gather' and ag2.is_done
+    add = instrs['add.3']
+    assert add.root and add.base == 'add'
+    assert add.operands_text.count('%p0') == 2      # wrapped line joined
+    assert 'metadata=' in add.attrs
+
+
+def test_collective_bytes_counts_done_not_start():
+    from mxnet_tpu.observability.hlo import collective_bytes
+    text = (
+        'HloModule m\n'
+        'ENTRY %e {\n'
+        '  %s.1 = (f32[16]{0}, u8[]) all-reduce-start(f32[16]{0} %g), '
+        'to_apply=%add\n'
+        '  %d.2 = f32[16]{0} all-reduce-done((f32[16]{0}, u8[]) %s.1)\n'
+        '}\n')
+    total, per_kind = collective_bytes(text)
+    assert total == 64                               # once, not twice
+    assert per_kind == {'all-reduce': 64}
+
+
+# ---------------------------------------------------------------------------
+# hlolint against REAL compiled step programs
+# ---------------------------------------------------------------------------
+
+
+def _dense_step_program(devices, amp=False, zero=False):
+    import jax
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation='relu'), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.create_mesh({'dp': devices},
+                                devices=jax.devices()[:devices])
+    pt = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1, 'momentum': 0.9}, mesh,
+        zero=zero, amp=amp, guardrail=False)
+    x = nd.array(np.random.randn(8, 8).astype('float32'))
+    y = nd.array(np.random.randint(0, 4, (8,)).astype('float32'))
+    pt.build(x, y)
+    return pt.compiled_text()
+
+
+def test_hlolint_real_dp1_program_clean_and_cross_checked():
+    import jax
+    platform = jax.default_backend()
+    text = _dense_step_program(1, amp=False)
+    assert hlolint.check(text, {'amp': 'off', 'dp': 1,
+                                'donation': True, 'zero': False,
+                                'platform': platform},
+                         program='dp1') == []
+    # the donation rule is live: strip the aliasing and it fires
+    stripped = text.replace('input_output_alias=', 'x_alias=')
+    fs = hlolint.check(stripped, {'donation': True}, program='dp1')
+    assert _rules(fs) == {'HLO-DONATION-DROPPED'}
+
+
+def test_hlolint_real_bf16_program_amp_rules():
+    import jax
+    platform = jax.default_backend()
+    text = _dense_step_program(1, amp='bf16')
+    assert hlolint.check(text, {'amp': 'bf16', 'dp': 1,
+                                'donation': True,
+                                'platform': platform},
+                         program='bf16') == []
+    # the SAME real program violates the amp-off contract — proves the
+    # rule reads real artifacts, not just synthetic fixtures
+    fs = hlolint.check(text, {'amp': 'off'}, program='bf16')
+    assert 'HLO-AMP-OFF-LOW' in _rules(fs)
+
+
+def test_hlolint_real_dp2_program_collective_rules():
+    import jax
+    platform = jax.default_backend()
+    text = _dense_step_program(2, amp=False)
+    assert hlolint.check(text, {'amp': 'off', 'dp': 2,
+                                'donation': True,
+                                'platform': platform},
+                         program='dp2') == []
+    fs = hlolint.check(text, {'dp': 1}, program='dp2')
+    assert 'HLO-DP1-COLLECTIVE' in _rules(fs)
+    fs = hlolint.check(_dense_step_program(1, amp=False), {'dp': 2},
+                       program='dp1-as-dp2')
+    assert 'HLO-DPN-NO-COLLECTIVE' in _rules(fs)
+
+
+@pytest.mark.slow
+def test_hlolint_real_resnet_amp_on_off():
+    """Acceptance: the amp invariants verified against the real
+    compiled ResNet-50 step program (the fusion-audit build path),
+    amp on and off."""
+    import importlib.util
+    import jax
+    spec = importlib.util.spec_from_file_location(
+        'fusion_audit', os.path.join(REPO, 'tools', 'fusion_audit.py'))
+    fa = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fa)
+    platform = jax.default_backend()
+    pt, cfg = fa._build_resnet_program(True)
+    assert hlolint.check(pt.compiled_text(),
+                         registry.expect_from_config(cfg,
+                                                     platform=platform),
+                         program='resnet50_step') == []
+    pt, cfg = fa._build_resnet_program(True, amp='bf16')
+    text = pt.compiled_text()
+    assert hlolint.check(text,
+                         registry.expect_from_config(cfg,
+                                                     platform=platform),
+                         program='resnet50_bf16') == []
+    assert 'HLO-AMP-OFF-LOW' in _rules(
+        hlolint.check(text, {'amp': 'off'}, program='resnet50_bf16'))
+
+
+@pytest.mark.slow
+def test_hlolint_real_bert_dp8_zero():
+    """Acceptance: the collective/ZeRO invariants verified against the
+    real compiled BERT step program on the 8-device virtual mesh."""
+    import importlib.util
+    import jax
+    spec = importlib.util.spec_from_file_location(
+        'fusion_audit', os.path.join(REPO, 'tools', 'fusion_audit.py'))
+    fa = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fa)
+    platform = jax.default_backend()
+    pt, cfg = fa._build_bert_program(True, mesh_axes={'dp': 8},
+                                     zero=True)
+    text = pt.compiled_text()
+    assert hlolint.check(text,
+                         registry.expect_from_config(cfg,
+                                                     platform=platform),
+                         program='bert_dp8_zero') == []
+    assert 'HLO-DP1-COLLECTIVE' in _rules(
+        hlolint.check(text, {'dp': 1}, program='bert_dp8_zero'))
+
+
+# ---------------------------------------------------------------------------
+# the CLI gate
+# ---------------------------------------------------------------------------
+
+
+def test_cli_no_build_green_on_head():
+    proc = subprocess.run(
+        [sys.executable, '-m', 'mxnet_tpu.analysis', '--no-build'],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'OK: no new findings' in proc.stdout
+
+
+def test_cli_fails_on_new_finding_naming_rule_and_location(tmp_path):
+    """Acceptance: introduce a fixture-bad pattern into a registered
+    trace context → the gate exits non-zero and prints rule id +
+    file:line."""
+    root = tmp_path / 'tree'
+    root.mkdir()
+    shutil.copytree(os.path.join(REPO, 'mxnet_tpu'),
+                    str(root / 'mxnet_tpu'),
+                    ignore=shutil.ignore_patterns('__pycache__'))
+    victim = root / 'mxnet_tpu' / 'guardrail' / 'sentinel.py'
+    src = victim.read_text()
+    anchor = '    """Decode the masked global grad norm from a ' \
+             'packed scalar."""\n'
+    assert anchor in src
+    victim.write_text(src.replace(
+        anchor, anchor + '    import time\n    _t0 = time.time()\n', 1))
+    proc = subprocess.run(
+        [sys.executable, '-m', 'mxnet_tpu.analysis', '--no-build',
+         '--root', str(root),
+         '--baseline', os.path.join(REPO, 'LINT_BASELINE.json')],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert 'TRACE-TIME' in proc.stdout
+    assert 'mxnet_tpu/guardrail/sentinel.py:' in proc.stdout
+
+
+def test_cli_external_hlo_dump_mode(tmp_path):
+    bad = tmp_path / 'bad.txt'
+    bad.write_text(_hlo(_F32_DOT, _ALLREDUCE))
+    proc = subprocess.run(
+        [sys.executable, '-m', 'mxnet_tpu.analysis', '--hlo', str(bad),
+         '--amp', 'bf16', '--dp', '1', '--platform', 'tpu',
+         '--no-donation'],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert 'HLO-AMP-F32-MATMUL' in proc.stdout
+    assert 'HLO-DP1-COLLECTIVE' in proc.stdout
+    good = tmp_path / 'good.txt'
+    good.write_text(_hlo(_BF16_DOT))
+    proc = subprocess.run(
+        [sys.executable, '-m', 'mxnet_tpu.analysis', '--hlo',
+         str(good), '--amp', 'bf16', '--dp', '1', '--platform', 'tpu',
+         '--no-donation'],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite regression: traceknobs (the TRACE-ENV fix)
+# ---------------------------------------------------------------------------
+
+
+def test_traceknobs_scope_shields_trace_from_env_flips(monkeypatch):
+    """The fix for the env-read-at-trace-time findings: once a snapshot
+    is installed, flipping the live environment must NOT change what
+    the op bodies see (purity); with no scope the legacy live read
+    remains for bare jax.jit users."""
+    from mxnet_tpu import config
+    from mxnet_tpu.ops import traceknobs
+    from mxnet_tpu.ops.nn import _vjp_resched
+
+    config.set('MXNET_TPU_VJP_RESCHEDULE', True)
+    try:
+        snap = traceknobs.snapshot()
+        assert snap.vjp_reschedule is True
+        with traceknobs.scope(snap):
+            assert traceknobs.current() is snap
+            config.set('MXNET_TPU_VJP_RESCHEDULE', False)
+            assert _vjp_resched() is True          # snapshot wins
+        assert traceknobs.current() is None
+        assert _vjp_resched() is False             # live read is back
+    finally:
+        config.unset('MXNET_TPU_VJP_RESCHEDULE')
+
+    monkeypatch.setenv('MXNET_CONV_LAYOUT_INTERNAL', 'nhwc')
+    from mxnet_tpu.ops.nn import _conv_nhwc
+    snap = traceknobs.snapshot()
+    assert snap.conv_layout == 'nhwc'
+    with traceknobs.scope(snap):
+        monkeypatch.setenv('MXNET_CONV_LAYOUT_INTERNAL', 'nchw')
+        assert _conv_nhwc() is True                # snapshot wins
+    assert _conv_nhwc() is False                   # live read is back
+
+
+def test_traceknobs_scope_is_reentrant_and_none_is_noop():
+    from mxnet_tpu.ops import traceknobs
+    a = traceknobs.TraceKnobs(True, 'nhwc')
+    b = traceknobs.TraceKnobs(False, 'nchw')
+    with traceknobs.scope(a):
+        with traceknobs.scope(None):               # true no-op
+            assert traceknobs.current() is a
+        with traceknobs.scope(b):
+            assert traceknobs.current() is b
+        assert traceknobs.current() is a
+    assert traceknobs.current() is None
+    assert a.cache_key != b.cache_key
+
+
+def test_vjp_knob_flip_rejits_bit_identically():
+    """Regression for the latched-knob bug the lint surfaced: flipping
+    MXNET_TPU_VJP_RESCHEDULE between eager calls now recompiles (the
+    snapshot is part of the jit cache key) instead of silently reusing
+    the first program — and both programs stay bit-identical
+    (docs/PERFORMANCE.md contract)."""
+    from mxnet_tpu import autograd, config
+    from mxnet_tpu.ndarray import ndarray as nd_mod
+
+    x = nd.array(np.random.RandomState(3).randn(4, 5)
+                 .astype('float32'))
+    outs = {}
+    try:
+        for setting in (True, False, True):
+            config.set('MXNET_TPU_VJP_RESCHEDULE', setting)
+            keys_before = {k for k in nd_mod._invoke_jit_cache}
+            x.attach_grad()
+            with autograd.record():
+                y = nd.Activation(x, act_type='relu')
+            y.backward()
+            outs.setdefault(setting, []).append(
+                (y.asnumpy(), x.grad.asnumpy()))
+            if len(outs) == 2 and setting is False:
+                # the flip minted NEW cache entries (re-jit happened)
+                assert {k for k in nd_mod._invoke_jit_cache} \
+                    - keys_before
+    finally:
+        config.unset('MXNET_TPU_VJP_RESCHEDULE')
+    on1, on2 = outs[True]
+    off = outs[False][0]
+    np.testing.assert_array_equal(on1[0], off[0])
+    np.testing.assert_array_equal(on1[1], off[1])
+    np.testing.assert_array_equal(on1[0], on2[0])
+    np.testing.assert_array_equal(on1[1], on2[1])
+
+
+def test_poison_grads_empty_list_unchanged():
+    """The TRACE-PY-BRANCH respell in sentinel.poison_grads (truthiness
+    → explicit len()==0) is behavior-preserving."""
+    from mxnet_tpu.guardrail.sentinel import poison_grads
+    assert poison_grads([], None) == []
+
+
+# ---------------------------------------------------------------------------
+# satellite regression: lock hierarchy (batcher / staging / watchdog)
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_timeout_callback_may_reenter_without_deadlock():
+    """Regression for LOCK-CALLBACK: set_exception on a timed-out
+    request fires done-callbacks inline — a callback that re-enters
+    the batcher (stats()) must not deadlock now that futures are
+    failed outside the lock."""
+    from mxnet_tpu.serving.batcher import MicroBatcher, RequestTimeout
+
+    release = threading.Event()
+
+    def runner(arrays, n):
+        release.wait(5.0)                  # wedge the worker
+        return [arrays[0]]
+
+    got = {}
+    done = threading.Event()
+    b = MicroBatcher(runner, max_batch=4, deadline_ms=1.0,
+                     timeout_s=0.05, name='t-reenter')
+    try:
+        fut = b.submit(np.zeros((2,), np.float32))
+
+        def cb(f):
+            got['stats'] = b.stats()       # re-enters the lock
+            done.set()
+
+        fut.add_done_callback(cb)
+        with pytest.raises(RequestTimeout):
+            fut.result(timeout=5.0)
+        assert done.wait(2.0), 'done-callback deadlocked'
+        assert got['stats']['timeouts'] >= 1
+    finally:
+        release.set()
+        b.close(drain=False, timeout=5.0)
+
+
+def test_batcher_close_fails_futures_outside_lock():
+    from mxnet_tpu.serving.batcher import BatcherClosed, MicroBatcher
+
+    release = threading.Event()
+
+    def runner(arrays, n):
+        release.wait(5.0)
+        return [arrays[0]]
+
+    b = MicroBatcher(runner, max_batch=1, deadline_ms=1.0,
+                     timeout_s=30.0, name='t-close')
+    try:
+        b.submit(np.zeros((2,), np.float32))     # occupies the worker
+        time.sleep(0.05)
+        fut = b.submit(np.zeros((2,), np.float32))  # stays queued
+        reentered = threading.Event()
+        fut.add_done_callback(lambda f: (b.stats(), reentered.set()))
+        b.close(drain=False, timeout=0.2)
+        with pytest.raises(BatcherClosed):
+            fut.result(timeout=2.0)
+        assert reentered.wait(2.0), 'close-path callback deadlocked'
+    finally:
+        release.set()
+        b.close(drain=False, timeout=5.0)
+
+
+def test_staging_placer_runs_outside_the_cv():
+    """Regression for the staging lock hierarchy: the user placer (a
+    device_put that may block) must run with the cv RELEASED — proven
+    by acquiring it from another thread while the placer executes."""
+    from mxnet_tpu.io.staging import DevicePrefetcher
+
+    acquired = []
+    ready = threading.Event()      # pf assigned (placer may run on the
+                                   # staging thread before ctor returns)
+
+    def placer(item):
+        ready.wait(5.0)
+        ok = threading.Event()
+
+        def probe():
+            with pf._cv:
+                ok.set()
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        acquired.append(ok.wait(2.0))
+        return item
+
+    pf = DevicePrefetcher(iter([1, 2, 3]), placer=placer, depth=2,
+                          timeout_s=5.0)
+    ready.set()
+    assert list(pf) == [1, 2, 3]
+    assert acquired and all(acquired), \
+        'placer ran while holding the staging cv'
+
+
+def test_staging_degraded_telemetry_runs_outside_cv(monkeypatch):
+    """The stall-degradation emit was hoisted out of _degrade_locked;
+    _emit_degraded must be callable with the cv free (it re-enters the
+    observability layer which takes its own locks)."""
+    from mxnet_tpu.io import staging
+
+    def hung_placer(item):
+        time.sleep(10.0)
+        return item
+
+    pf = staging.DevicePrefetcher(iter([1, 2, 3]), placer=hung_placer,
+                                  depth=2, timeout_s=0.1)
+    emitted = []
+    orig = pf._emit_degraded
+
+    def spy(reason):
+        assert pf._cv.acquire(blocking=False), \
+            'telemetry emitted while holding the cv'
+        pf._cv.release()
+        emitted.append(reason)
+        return orig(reason)
+
+    pf._emit_degraded = spy
+    # the hung placer forces the consumer takeover; recovered batch
+    # then the synchronous path still yield everything in order
+    assert next(pf) in (1, 2, 3)
+    assert pf.degraded
+    assert emitted == ['stall']
+
+
+def test_watchdog_injector_and_telemetry_run_outside_lock(monkeypatch):
+    """Regression for the watchdog lock hierarchy: the fault injector
+    (callback machinery) fires with self._lock free, and the hang
+    verdict still ages the heartbeat past the budget."""
+    from mxnet_tpu.resilience import watchdog as wd_mod
+    from mxnet_tpu.resilience.policy import HangError
+
+    wd = wd_mod.Watchdog(budgets={'step': 1.0}, name='t-lock')
+    lock_free = []
+
+    def probe_inject(site, kinds, injector=None, step=None):
+        ok = wd._lock.acquire(blocking=False)
+        if ok:
+            wd._lock.release()
+        lock_free.append(ok)
+
+    monkeypatch.setattr(wd_mod, 'inject', probe_inject)
+    wd.beat(step=1, phase='step')
+    assert lock_free == [True], 'injector fired while holding _lock'
+    before = wd._last
+    assert before is not None
+
+    def hang_inject(site, kinds, injector=None, step=None):
+        raise HangError('hang', site)
+
+    monkeypatch.setattr(wd_mod, 'inject', hang_inject)
+    wd.beat(step=2)
+    # the hang verdict aged the heartbeat past the phase budget
+    assert wd._last < before - wd.budget_for('step')

@@ -4,6 +4,17 @@ tests/jenkins pipelines — the reference treats CI as part of its
 surface; this is the TPU-native equivalent for a 1-core host).
 
 Stages, each timed:
+  0. lint                  python -m mxnet_tpu.analysis --baseline
+                           LINT_BASELINE.json — the static-analysis
+                           gate (docs/ANALYSIS.md): trace-purity +
+                           lock-discipline AST lint over the repo and
+                           compiled-program invariant checks (no f32
+                           matmul under bf16, no collectives at dp=1,
+                           ZeRO reduce-scatter, donation aliasing, no
+                           mid-step host transfer) against fresh
+                           virtual-mesh builds, failing only on
+                           findings not suppressed (with a reason) in
+                           the committed baseline
   1. fast test tier        pytest -m "not slow"       (~2 min)
   2. fault injection       tools/fault_smoke.py — bench.py under
                            MXNET_TPU_FAULT=device_unavailable must
@@ -106,6 +117,11 @@ def main(argv=None):
     full = '--full' in argv
     py = sys.executable
     stages = [
+        # static-analysis gate first: it is the cheapest stage and a
+        # NEW trace-purity/lock/HLO-invariant finding should fail the
+        # run before any long tier spends minutes (docs/ANALYSIS.md)
+        ('lint', [py, '-m', 'mxnet_tpu.analysis',
+                  '--baseline', 'LINT_BASELINE.json']),
         ('tests', [py, '-m', 'pytest', 'tests/', '-q']
          + ([] if full else ['-m', 'not slow'])),
         # stage 1 already ran tests/test_resilience.py; this tier adds

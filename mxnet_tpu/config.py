@@ -280,6 +280,53 @@ KNOBS = {k.name: k for k in [
           ' while sequences are in flight: raises join throughput at'
           ' the cost of decode-step latency jitter. An idle engine'
           ' always admits up to every free slot.'),
+    _knob('MXNET_TPU_SERVE_MAX_CONCURRENT', int, 0,
+          'Cap on in-flight HTTP POST handlers (one thread per'
+          ' connection): past it requests shed instantly with 429 +'
+          ' Retry-After instead of piling scheduling contention onto'
+          ' admitted requests. 0 (default) = unbounded, the'
+          ' pre-harness behavior; production fronts set it to a'
+          ' small multiple of the batch/slot capacity.'),
+    # open-loop load harness + SLO gate (docs/SERVING.md "SLOs and
+    # overload behavior", tools/slo_gate.py)
+    _knob('MXNET_TPU_SLO_P99_MS', float, 500.0,
+          'Admitted-request p99 latency budget (ms) the load harness'
+          ' gates on: capacity search bisects the max QPS holding it,'
+          ' overload mode asserts admission control protects it at'
+          ' 2.5x capacity. SLO_BASELINE.json overrides it in CI.'),
+    _knob('MXNET_TPU_SLO_SHED_P99_MS', float, 250.0,
+          'p99 budget (ms) for SHED responses: a 429 must be a fast'
+          ' rejection, not a slow timeout — overload mode fails when'
+          ' shedding itself is slow.'),
+    _knob('MXNET_TPU_SLO_AVAILABILITY', float, 0.85,
+          'Chaos-soak availability floor: fraction of offered'
+          ' requests that must be ADMITTED (2xx, degraded allowed)'
+          ' while scripted faults fire. Sheds (429) count as'
+          ' unavailable — the floor prices how much shedding the'
+          ' degraded paths are allowed to need.'),
+    _knob('MXNET_TPU_SLO_RECOVERY_S', float, 12.0,
+          'Per-fault recovery ceiling (seconds): after a scripted'
+          ' fault burst clears, /status must report every session ok'
+          ' with its breaker closed within this budget.'),
+    _knob('MXNET_TPU_SLO_GOODPUT', float, 0.9,
+          'Capacity-search goodput floor: fraction of offered'
+          ' requests served clean (200, no typed error) a rate must'
+          ' sustain to count as within capacity.'),
+    _knob('MXNET_TPU_LOADGEN_SEED', int, 0,
+          'Default seed for the open-loop arrival schedule'
+          ' (mxnet_tpu.loadgen): same seed, same arrival times and'
+          ' request kinds — load runs are replayable.'),
+    _knob('MXNET_TPU_LOADGEN_MAX_QPS', float, 100.0,
+          'Ceiling on the offered rate overload mode will drive:'
+          ' past O(100) connections/s the stdlib endpoint\'s accept'
+          ' loop (kernel SYN queue) owns the latency on a small'
+          ' host, and the harness gates admission control, not the'
+          ' accept path. Raise it when fronting with a real gateway.'),
+    _knob('MXNET_TPU_LOADGEN_MAX_INFLIGHT', int, 512,
+          'Client-side bound on concurrently in-flight harness'
+          ' requests (one thread each). An arrival above the bound'
+          ' resolves as client_saturated — counted against goodput,'
+          ' never silently dropped.'),
     # performance: roofline audit / vjp rescheduling / input prefetch
     # (docs/PERFORMANCE.md)
     _knob('MXNET_TPU_ROOFLINE_PEAK_TFLOPS', float, 197.0,

@@ -1,0 +1,29 @@
+"""Open-loop load & chaos harness for the serving stack.
+
+Turns the north star's "heavy traffic" claim into gated numbers: a
+Poisson-arrival, fixed-rate (never closed-loop) generator drives a
+live :class:`~mxnet_tpu.serving.server.ServingHTTPServer` over real
+HTTP on both ``/predict`` and ``/generate`` (streamed NDJSON), in
+three modes — capacity search, overload, chaos soak — and emits a
+versioned ``mxnet_tpu.slo.v1`` artifact that ``tools/slo_gate.py``
+diffs against the committed SLO_BASELINE.json in the ``slo`` CI
+stage. See docs/SERVING.md "SLOs and overload behavior" and
+docs/RESILIENCE.md "Chaos harness".
+
+    python -m mxnet_tpu.loadgen --mode overload --out SLO.json
+"""
+from .client import LoadClient, RequestRecord
+from .harness import (DEFAULT_MIX, Dispatcher, ServingRig,
+                      run_capacity, run_chaos, run_overload)
+from .report import (SLO_SCHEMA, build_artifact, latency_summary,
+                     percentile, summarize)
+from .schedule import Arrival, build_schedule
+
+__all__ = [
+    'Arrival', 'build_schedule',
+    'LoadClient', 'RequestRecord',
+    'SLO_SCHEMA', 'percentile', 'latency_summary', 'summarize',
+    'build_artifact',
+    'ServingRig', 'Dispatcher', 'DEFAULT_MIX',
+    'run_capacity', 'run_overload', 'run_chaos',
+]

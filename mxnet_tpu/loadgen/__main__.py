@@ -1,0 +1,152 @@
+"""CLI for the open-loop load & chaos harness (docs/SERVING.md "SLOs
+and overload behavior").
+
+    JAX_PLATFORMS=cpu python -m mxnet_tpu.loadgen --mode overload
+    JAX_PLATFORMS=cpu python -m mxnet_tpu.loadgen --mode capacity
+    JAX_PLATFORMS=cpu python -m mxnet_tpu.loadgen --mode chaos --full
+
+Builds the in-process serving rig (frozen MLP behind /predict +
+decode LM behind /generate, one live HTTP endpoint), runs the mode,
+writes the ``mxnet_tpu.slo.v1`` artifact, prints a one-screen
+summary, and exits non-zero when the mode's own invariants fail —
+the ``slo`` CI stage additionally diffs the artifact against
+SLO_BASELINE.json via tools/slo_gate.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+
+def _write(path, doc):
+    try:
+        from ..resilience.checkpoint import atomic_write_bytes
+        atomic_write_bytes(path, (json.dumps(
+            doc, indent=1, sort_keys=True) + '\n').encode())
+    except Exception:
+        with open(path, 'w') as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+
+
+def _summary(doc):
+    m = doc.get('metrics', {})
+    if m.get('offered') is not None:
+        lines = ['loadgen %s: offered=%s admitted=%s shed=%s '
+                 'degraded=%s unresolved=%s'
+                 % (doc['mode'], m.get('offered'), m.get('admitted'),
+                    m.get('shed'), m.get('degraded'),
+                    m.get('unresolved'))]
+    else:
+        lines = ['loadgen %s' % doc['mode']]
+    lat = m.get('admitted_latency') or {}
+    if lat.get('n'):
+        lines.append('  admitted latency p50=%.1fms p99=%.1fms '
+                     'p999=%.1fms'
+                     % (lat['p50_ms'], lat['p99_ms'], lat['p999_ms']))
+    shed = m.get('shed_latency') or {}
+    if shed.get('n'):
+        lines.append('  shed (429) latency p99=%.1fms, retry-after '
+                     'advertised on %d' % (shed['p99_ms'],
+                                           (m.get('retry_after') or
+                                            {}).get('n', 0)))
+    gen = m.get('generate') or {}
+    if gen.get('n'):
+        lines.append('  generate n=%d tokens=%d ttft_p99=%sms '
+                     'tpot_p99=%sms'
+                     % (gen['n'], gen['tokens'],
+                        gen['ttft'].get('p99_ms'),
+                        gen['tpot'].get('p99_ms')))
+    if doc['mode'] == 'capacity':
+        lines.append('  max_qps=%s (p99 < SLO, goodput >= floor)'
+                     % (m.get('max_qps'),))
+    for f in doc.get('faults', []):
+        lines.append('  fault %-19s consumed=%s recovery=%ss'
+                     % (f['kind'], f['consumed'], f['recovery_s']))
+    for name, ok in (doc.get('verdicts') or {}).items():
+        lines.append('  verdict %-28s %s'
+                     % (name, 'OK' if ok else 'FAIL'))
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='python -m mxnet_tpu.loadgen',
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument('--mode', choices=('capacity', 'overload', 'chaos'),
+                   default='overload')
+    p.add_argument('--out', default='SLO.json')
+    p.add_argument('--seed', type=int, default=None,
+                   help='schedule seed (default: '
+                        'MXNET_TPU_LOADGEN_SEED)')
+    p.add_argument('--qps', type=float, default=None,
+                   help='chaos: sustained offered rate; '
+                        'capacity/overload: ramp start rate')
+    p.add_argument('--duration', type=float, default=None,
+                   help='overload/chaos soak length in seconds')
+    p.add_argument('--factor', type=float, default=2.5,
+                   help='overload: offered rate as a multiple of '
+                        'measured capacity')
+    p.add_argument('--capacity-qps', type=float, default=None,
+                   help='overload: skip the probe and take capacity '
+                        'as given')
+    p.add_argument('--slo-ms', type=float, default=None,
+                   help='admitted-request p99 budget (default: '
+                        'MXNET_TPU_SLO_P99_MS)')
+    p.add_argument('--no-generate', action='store_true',
+                   help='predict-only rig (faster build; no decode '
+                        'legs)')
+    p.add_argument('--full', action='store_true',
+                   help='long soak: 4x the default windows/durations')
+    args = p.parse_args(argv)
+
+    from .harness import ServingRig, run_capacity, run_chaos, \
+        run_overload
+    from .harness import _knob
+    seed = args.seed if args.seed is not None \
+        else int(_knob('MXNET_TPU_LOADGEN_SEED', 0))
+    slo_s = (args.slo_ms / 1e3) if args.slo_ms is not None else None
+    scale = 4.0 if args.full else 1.0
+    # mix=None lets each mode pick its own default (chaos soaks on
+    # mostly-cheap traffic, capacity/overload weight the expensive
+    # decode workload the SLO guards)
+    mix = {'predict': 1.0} if args.no_generate else None
+
+    rig = ServingRig(generate=not args.no_generate)
+    try:
+        if args.mode == 'capacity':
+            doc = run_capacity(
+                rig, slo_s=slo_s, mix=mix, seed=seed,
+                start_qps=args.qps or 16.0,
+                window_s=1.5 * scale,
+                bisect_iters=3 if not args.full else 5)
+        elif args.mode == 'overload':
+            doc = run_overload(
+                rig, factor=args.factor,
+                duration_s=(args.duration or 3.0 * scale),
+                slo_s=slo_s, mix=mix, seed=seed,
+                start_qps=args.qps or 16.0,
+                probe_window_s=1.0 * scale,
+                capacity_qps=args.capacity_qps)
+        else:
+            doc = run_chaos(
+                rig, qps=args.qps or 20.0,
+                duration_s=(args.duration or 12.0 * scale),
+                mix=mix, seed=seed)
+    finally:
+        rig.close()
+    _write(args.out, doc)
+    print(_summary(doc), flush=True)
+    ok = doc.get('ok', True)
+    print('loadgen %s: %s -> %s'
+          % (doc['mode'], 'OK' if ok else 'FAIL', args.out),
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

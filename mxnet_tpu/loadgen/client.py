@@ -1,0 +1,239 @@
+"""Per-request HTTP client + the record every request resolves into.
+
+One :class:`RequestRecord` per scheduled arrival, whatever happens to
+it: served (possibly degraded), shed with a 429, timed out, aborted
+with a typed 500, stream terminated by a typed error line, socket
+error, or client-side timeout. ``resolved`` flips exactly once — the
+zero-hang invariant the chaos soak gates on is "every record resolved
+at drain" — and ``error_class`` is the taxonomy key the SLO artifact
+aggregates by.
+
+stdlib http.client (one connection per request, real sockets): the
+harness measures the serving stack end-to-end through the same HTTP
+surface production traffic uses, not through in-process shortcuts.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import time
+
+__all__ = ['RequestRecord', 'LoadClient']
+
+# taxonomy: HTTP status -> error class (200 handled separately)
+_STATUS_CLASS = {
+    429: 'shed_backpressure',
+    504: 'timeout_budget',
+    503: 'unavailable',
+    400: 'bad_request',
+}
+
+
+class RequestRecord:
+    """Everything measured about one open-loop request."""
+
+    __slots__ = ('rid', 'kind', 'scheduled_t', 'fired_at', 'first_at',
+                 'done_at', 'status', 'error_class', 'tokens',
+                 'degraded', 'retry_after_s', 'resolved', 'detail')
+
+    def __init__(self, rid, kind, scheduled_t):
+        self.rid = rid
+        self.kind = kind
+        self.scheduled_t = scheduled_t   # schedule-relative seconds
+        self.fired_at = None             # monotonic timestamps
+        self.first_at = None             # first response byte/line
+        self.done_at = None
+        self.status = None               # HTTP status, None = no reply
+        self.error_class = None          # None = served clean
+        self.tokens = 0                  # generate: tokens streamed
+        self.degraded = False
+        self.retry_after_s = None        # parsed Retry-After on 429
+        self.resolved = False
+        self.detail = None               # short error text
+
+    # -- derived metrics ---------------------------------------------------
+
+    @property
+    def ok(self):
+        return self.status == 200 and self.error_class is None
+
+    def latency_s(self):
+        if self.fired_at is None or self.done_at is None:
+            return None
+        return self.done_at - self.fired_at
+
+    def ttft_s(self):
+        if self.fired_at is None or self.first_at is None:
+            return None
+        return self.first_at - self.fired_at
+
+    def tpot_s(self):
+        """Time per output token AFTER the first (generate only)."""
+        if self.first_at is None or self.done_at is None \
+                or self.tokens < 2:
+            return None
+        return (self.done_at - self.first_at) / (self.tokens - 1)
+
+    def to_json(self):
+        return {'rid': self.rid, 'kind': self.kind,
+                'scheduled_t': round(self.scheduled_t, 6),
+                'status': self.status,
+                'error_class': self.error_class,
+                'latency_s': self.latency_s(),
+                'ttft_s': self.ttft_s(), 'tokens': self.tokens,
+                'degraded': self.degraded,
+                'retry_after_s': self.retry_after_s,
+                'resolved': self.resolved}
+
+
+class LoadClient:
+    """Fires one request per call against a live serving endpoint.
+
+    ``timeout_s`` is the CLIENT-side socket budget: even a wedged
+    server resolves every record (error_class ``client_timeout``) —
+    the harness never hangs on the system under test.
+    """
+
+    def __init__(self, host, port, timeout_s=10.0,
+                 clock=time.monotonic):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self._clock = clock
+
+    # -- internals ---------------------------------------------------------
+
+    def _post(self, path, payload):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+        body = json.dumps(payload).encode()
+        # one request per connection: 'close' tells the server not to
+        # hold the socket for keep-alive, so tearing the client down
+        # never looks like a mid-request reset on the server side
+        conn.request('POST', path, body=body,
+                     headers={'Content-Type': 'application/json',
+                              'Content-Length': str(len(body)),
+                              'Connection': 'close'})
+        return conn
+
+    @staticmethod
+    def _classify(rec, status, headers):
+        rec.status = status
+        if status == 200:
+            return
+        rec.error_class = _STATUS_CLASS.get(status,
+                                            'server_error')
+        if status == 429 and headers is not None:
+            ra = headers.get('Retry-After')
+            if ra is not None:
+                try:
+                    rec.retry_after_s = float(ra)
+                except ValueError:
+                    pass
+
+    # -- request kinds -----------------------------------------------------
+
+    def predict(self, rec, data):
+        """POST /predict with one example; fills ``rec`` in place."""
+        rec.fired_at = self._clock()
+        conn = None
+        try:
+            conn = self._post('/predict', {'data': data})
+            resp = conn.getresponse()
+            raw = resp.read()
+            rec.first_at = self._clock()
+            self._classify(rec, resp.status, resp.headers)
+            if resp.status == 200:
+                pass                      # body checked by tests, not
+            elif resp.status == 500:      # the hot loop
+                try:
+                    rec.detail = json.loads(raw).get('error_class')
+                    if rec.detail in ('WorkerCrashError',
+                                      'PreemptionSignal'):
+                        rec.error_class = 'aborted'
+                except ValueError:
+                    pass
+        except socket.timeout:
+            rec.error_class = 'client_timeout'
+        except OSError as exc:
+            rec.error_class = 'net_error'
+            rec.detail = str(exc)[:120]
+        finally:
+            if conn is not None:
+                conn.close()
+            rec.done_at = self._clock()
+            rec.resolved = True
+        return rec
+
+    def generate(self, rec, tokens, max_new_tokens=8):
+        """POST /generate with stream=true; reads the NDJSON lines as
+        they arrive (TTFT = first line, TPOT from the line spacing).
+        A typed mid-stream error line resolves the record with
+        error_class ``stream_<Class>``."""
+        rec.fired_at = self._clock()
+        conn = None
+        try:
+            conn = self._post('/generate',
+                              {'tokens': tokens,
+                               'max_new_tokens': max_new_tokens,
+                               'stream': True})
+            resp = conn.getresponse()
+            self._classify(rec, resp.status, resp.headers)
+            if resp.status != 200:
+                resp.read()
+                return rec
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                if rec.first_at is None:
+                    rec.first_at = self._clock()
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if 'token' in obj:
+                    rec.tokens += 1
+                if obj.get('done'):
+                    rec.degraded = bool(obj.get('degraded'))
+                    if obj.get('error'):
+                        rec.error_class = 'stream_%s' % (
+                            obj.get('error_class') or 'error')
+                        rec.detail = str(obj['error'])[:160]
+                    break
+        except socket.timeout:
+            rec.error_class = 'client_timeout'
+        except OSError as exc:
+            rec.error_class = 'net_error'
+            rec.detail = str(exc)[:120]
+        finally:
+            if conn is not None:
+                conn.close()
+            rec.done_at = self._clock()
+            rec.resolved = True
+        return rec
+
+    def get_json(self, path):
+        """GET a JSON route (/status, /healthz); returns
+        (status_code, payload|None) and never raises."""
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout_s)
+            conn.request('GET', path,
+                         headers={'Connection': 'close'})
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                return resp.status, json.loads(raw)
+            except ValueError:
+                return resp.status, None
+        except OSError:
+            return None, None
+        finally:
+            if conn is not None:
+                conn.close()

@@ -1,0 +1,620 @@
+"""Open-loop load & chaos harness over a LIVE ServingHTTPServer.
+
+The rig builds the real serving stack in-process — a frozen MLP
+behind ``/predict`` and a decode-mode session streaming NDJSON behind
+``/generate``, one HTTP endpoint fronting both — then drives it over
+real sockets from a precomputed open-loop schedule
+(:mod:`.schedule`): arrivals never wait for completions, so overload
+shows up as measured latency and 429s instead of silently throttling
+the experiment. Three modes:
+
+  * **capacity** — ramp the offered QPS, then bisect the highest rate
+    where p99 of ADMITTED requests stays under the SLO budget and
+    goodput stays above the floor: "max QPS at p99 < SLO" as a single
+    number.
+  * **overload** — offer a multiple (default 2.5x) of the measured
+    capacity and check that admission control actually protects the
+    admitted tail: admitted p99 within budget, the excess resolving
+    as FAST 429s (with Retry-After) rather than slow timeouts.
+  * **chaos** — sustained mixed traffic while the FaultInjector
+    scripts device_unavailable bursts, tunnel stalls, a worker crash
+    and a preemption mid-stream; gate an availability floor, a
+    recovery-time ceiling per fault, and the zero-hang invariant
+    (every fired request resolves; no slot leaked at drain).
+
+Every mode returns a versioned ``mxnet_tpu.slo.v1`` artifact
+(:mod:`.report`) that ``tools/slo_gate.py`` diffs against the
+committed SLO_BASELINE.json budgets in the ``slo`` CI stage.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .client import LoadClient, RequestRecord
+from .report import build_artifact, summarize
+from .schedule import build_schedule
+
+__all__ = ['ServingRig', 'Dispatcher', 'run_capacity', 'run_overload',
+           'run_chaos', 'DEFAULT_MIX', 'OVERLOAD_MIX']
+
+# chaos soak: mostly-cheap traffic keeps the soak itself off the
+# host's critical path while faults fire
+DEFAULT_MIX = {'predict': 0.7, 'generate': 0.3}
+# capacity/overload: weight the EXPENSIVE workload (streamed decode,
+# the engine the SLO guards) so the measured capacity is the decode
+# engine's, not the stdlib accept loop's
+OVERLOAD_MIX = {'predict': 0.3, 'generate': 0.7}
+
+# chaos fault script: (fraction of soak when injected, fault kind,
+# MXNET_TPU_FAULT spec). Sites: 'serving' fires per one-shot batch,
+# 'serving.decode' per decode device call; counts bound each burst so
+# the injector drains and recovery can be timed.
+CHAOS_SCRIPT = (
+    (0.10, 'device_unavailable',
+     'device_unavailable@serving:3,device_unavailable@serving.decode:1'),
+    (0.32, 'tunnel_stall',
+     'tunnel_stall@serving:2,tunnel_stall@serving.decode:1'),
+    (0.50, 'worker_crash', 'worker_crash@serving.decode:1'),
+    (0.64, 'preempt', 'preempt@serving.decode:1'),
+)
+
+FEATURES = 8
+CLASSES = 4
+_VOCAB = 23
+
+
+def _knob(name, default):
+    try:
+        from .. import config as _config
+        v = _config.get(name)
+        return default if v is None else v
+    except Exception:
+        return default
+
+
+def _build_frozen():
+    """Deterministic tiny MLP, trained one epoch, frozen — the
+    /predict workload (same shape as the serving selftest's)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from ..serving.freeze import freeze
+    onp.random.seed(3)
+    mx.random.seed(3)
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=CLASSES, name='fc2')
+    out = mx.sym.SoftmaxOutput(fc2, name='softmax')
+    mod = mx.mod.Module(out, context=mx.cpu())
+    rs = onp.random.RandomState(0)
+    x = rs.randn(32, FEATURES).astype('float32')
+    y = rs.randint(0, CLASSES, (32,)).astype('float32')
+    it = mx.io.NDArrayIter(x, y, batch_size=8)
+    mod.fit(it, num_epoch=1,
+            optimizer_params=(('learning_rate', 0.1),))
+    return freeze(mod, max_batch=8, name='loadgen-mlp')
+
+
+def _build_decoder(slots):
+    """Deterministic tiny LSTM LM — the /generate workload."""
+    from ..serving.decode import DecodeProgram, init_rnn_lm
+    model, params = init_rnn_lm(vocab=_VOCAB, embed=8, hidden=16,
+                                layers=1, mode='lstm', max_len=64,
+                                seed=5)
+    return DecodeProgram(model, params, slots=slots,
+                         prefill_buckets=(8,), name='loadgen-lm')
+
+
+class ServingRig:
+    """The live system under test: real sessions, real HTTP.
+
+    Sized for a CPU rig by default — a SMALL bounded queue so overload
+    produces sheds within seconds, a short per-request budget so 504s
+    are observable, and a fast-reset breaker so chaos recovery fits a
+    CI window. Every knob is a constructor argument; the breaker is
+    injected so the harness controls recovery timing deterministically.
+    """
+
+    def __init__(self, predict=True, generate=True, max_queue=16,
+                 timeout_s=5.0, deadline_ms=2.0, max_batch=8,
+                 slots=4, decode_max_queue=6, max_new_tokens=8,
+                 breaker_threshold=3, breaker_reset_s=0.4,
+                 max_concurrent=24, warmup=True):
+        from ..resilience.policy import CircuitBreaker
+        from ..serving.server import InferenceSession, \
+            ServingHTTPServer
+        if not (predict or generate):
+            raise ValueError('rig needs at least one of predict/'
+                             'generate')
+        self.max_new_tokens = int(max_new_tokens)
+        self.slots = int(slots)
+        self.predict_session = None
+        self.decode_session = None
+        if predict:
+            frozen = _build_frozen()
+            if warmup:
+                frozen.warmup()
+            self.predict_session = InferenceSession(
+                frozen, max_batch=max_batch, deadline_ms=deadline_ms,
+                max_queue=max_queue, timeout_s=timeout_s,
+                watchdog=False,
+                breaker=CircuitBreaker(
+                    failure_threshold=breaker_threshold,
+                    reset_timeout=breaker_reset_s),
+                name='loadgen-predict')
+        if generate:
+            prog = _build_decoder(slots)
+            if warmup:
+                prog.warmup()
+            self.decode_session = InferenceSession(
+                prog, max_queue=decode_max_queue, timeout_s=timeout_s,
+                watchdog=False, max_new_tokens=max_new_tokens,
+                breaker=CircuitBreaker(
+                    failure_threshold=breaker_threshold,
+                    reset_timeout=breaker_reset_s),
+                name='loadgen-decode')
+        primary = self.predict_session or self.decode_session
+        secondary = self.decode_session \
+            if self.predict_session is not None else None
+        self.server = ServingHTTPServer(
+            primary, 0, decode_session=secondary,
+            max_concurrent=max_concurrent).start()
+        self.port = self.server.port
+
+    # -- end-of-run drain proof --------------------------------------------
+
+    def server_stats(self):
+        """Server-side half of the zero-hang invariant: after drain,
+        no queue holds a request and every decode slot is free."""
+        out = {}
+        if self.predict_session is not None:
+            q = self.predict_session._batcher.stats()
+            out['predict'] = {
+                'depth': q['depth'],
+                'shed_doomed': q['shed_doomed'],
+                'timeouts': q['timeouts'],
+                'breaker': self.predict_session._breaker.state,
+            }
+        if self.decode_session is not None:
+            st = self.decode_session._engine.stats()
+            out['generate'] = {
+                'pending': st['pending'], 'active': st['active'],
+                'free_slots': st['free_slots'],
+                'leaked_slots': st['slots'] - st['free_slots']
+                - st['active'],
+                'retired': st['counts']['retired'],
+                'breaker': st['breaker'],
+            }
+        return out
+
+    def healthy(self, payload):
+        """True when a /status payload reports every mounted session
+        ok with its breaker closed."""
+        if payload is None:
+            return False
+        if 'predict' in payload or 'generate' in payload:
+            parts = [payload[k] for k in ('predict', 'generate')
+                     if k in payload]
+        else:
+            parts = [payload]
+        for part in parts:
+            if part.get('status') != 'ok':
+                return False
+            breaker = part.get('breaker')
+            if isinstance(breaker, dict):
+                breaker = breaker.get('state')
+            if breaker not in (None, 'closed'):
+                return False
+        return True
+
+    def close(self):
+        self.server.stop()
+        for sess in (self.predict_session, self.decode_session):
+            if sess is not None:
+                sess.close(drain=False)
+
+
+class Dispatcher:
+    """Fires a schedule open-loop: one thread per in-flight request,
+    launched at the scheduled instant regardless of completions.
+
+    ``max_inflight`` bounds the thread population; an arrival above
+    the bound resolves immediately as ``client_saturated`` — counted,
+    never silently dropped (a silent drop would fake goodput).
+    """
+
+    def __init__(self, client, max_new_tokens=8, max_inflight=None,
+                 clock=time.monotonic, sleep=time.sleep):
+        self.client = client
+        self.max_new_tokens = int(max_new_tokens)
+        self.max_inflight = int(
+            max_inflight if max_inflight is not None
+            else _knob('MXNET_TPU_LOADGEN_MAX_INFLIGHT', 512))
+        self._clock = clock
+        self._sleep = sleep
+        # O(1) in-flight accounting: the dispatch loop sits on the
+        # timing-critical path (late dispatch skews the open-loop
+        # arrival times), so it must not scan the thread list
+        self._live = 0
+        self._live_lock = threading.Lock()
+
+    @staticmethod
+    def _predict_payload(rid):
+        # deterministic per-rid example (seeded by rid, no rng state)
+        return [(((rid * 31 + i * 7) % 17) - 8) / 8.0
+                for i in range(FEATURES)]
+
+    @staticmethod
+    def _generate_payload(rid):
+        return [1 + (rid % (_VOCAB - 2)), 2, 3]
+
+    def _fire(self, rec):
+        try:
+            if rec.kind == 'generate':
+                self.client.generate(
+                    rec, self._generate_payload(rec.rid),
+                    max_new_tokens=self.max_new_tokens)
+            else:
+                self.client.predict(rec,
+                                    self._predict_payload(rec.rid))
+        finally:
+            with self._live_lock:
+                self._live -= 1
+
+    def run(self, arrivals):
+        """Dispatch the whole schedule; returns (records, threads).
+        Call :meth:`drain` afterwards to enforce the zero-hang
+        invariant client-side."""
+        records = []
+        threads = []
+        t0 = self._clock()
+        for a in arrivals:
+            delay = (t0 + a.t) - self._clock()
+            if delay > 0:
+                self._sleep(delay)
+            rec = RequestRecord(a.rid, a.kind, a.t)
+            records.append(rec)
+            with self._live_lock:
+                saturated = self._live >= self.max_inflight
+                if not saturated:
+                    self._live += 1
+            if saturated:
+                rec.error_class = 'client_saturated'
+                rec.resolved = True
+                continue
+            th = threading.Thread(target=self._fire, args=(rec,),
+                                  daemon=True,
+                                  name='loadgen-%d' % a.rid)
+            th.start()
+            threads.append(th)
+        return records, threads
+
+    def drain(self, threads, budget_s):
+        """Join every request thread; returns the number still alive
+        after the budget (0 = zero-hang holds client-side)."""
+        deadline = self._clock() + budget_s
+        for th in threads:
+            th.join(max(0.0, deadline - self._clock()))
+        return sum(1 for th in threads if th.is_alive())
+
+
+def _run_window(rig, qps, duration_s, mix, seed, timeout_s,
+                poisson=True):
+    """One open-loop window against the rig; returns (records,
+    unresolved)."""
+    client = LoadClient('127.0.0.1', rig.port, timeout_s=timeout_s)
+    disp = Dispatcher(client, max_new_tokens=rig.max_new_tokens)
+    arrivals = build_schedule(qps, duration_s, mix=mix, seed=seed,
+                              poisson=poisson)
+    records, threads = disp.run(arrivals)
+    unresolved = disp.drain(threads, timeout_s + 2.0)
+    return records, unresolved
+
+
+def _settle(rig, budget_s=2.0):
+    """Let queues drain between probe windows so one window's backlog
+    does not pollute the next window's tail."""
+    client = LoadClient('127.0.0.1', rig.port, timeout_s=1.0)
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        _code, payload = client.get_json('/status')
+        if payload is not None and rig.healthy(payload):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _probe_capacity(rig, mix, seed, slo_s, goodput_floor, start_qps,
+                    window_s, timeout_s, max_qps=2048.0,
+                    margin=0.6):
+    """Coarse doubling ramp: the highest rate whose window stayed
+    within SLO. Returns (last_good_qps, first_bad_qps, probes).
+
+    ``margin`` < 1 demands headroom: a short window at a borderline
+    rate can luck under the budget once and send overload mode off a
+    cliff; "within capacity" means comfortably within, the full
+    budget is what overload verifies."""
+    qps = float(start_qps)
+    last_good = None
+    probes = []
+    while qps <= max_qps:
+        records, unresolved = _run_window(rig, qps, window_s, mix,
+                                          seed, timeout_s)
+        m = summarize(records)
+        p99 = m['admitted_latency']['p99_ms']
+        good = (unresolved == 0
+                and m['goodput'] is not None
+                and m['goodput'] >= goodput_floor
+                and p99 is not None and p99 <= slo_s * 1e3 * margin)
+        probes.append({'qps': qps, 'good': good, 'p99_ms': p99,
+                       'goodput': m['goodput'],
+                       'offered': m['offered']})
+        _settle(rig)
+        if not good:
+            return last_good, qps, probes
+        last_good = qps
+        qps *= 2.0
+    return last_good, None, probes
+
+
+def run_capacity(rig, slo_s=None, goodput_floor=None, mix=None,
+                 seed=0, start_qps=8.0, window_s=2.0,
+                 bisect_iters=3, timeout_s=6.0):
+    """Capacity-search mode: max offered QPS with admitted-p99 under
+    the SLO and goodput over the floor."""
+    slo_s = float(slo_s if slo_s is not None
+                  else _knob('MXNET_TPU_SLO_P99_MS', 500.0) / 1e3)
+    goodput_floor = float(
+        goodput_floor if goodput_floor is not None
+        else _knob('MXNET_TPU_SLO_GOODPUT', 0.9))
+    mix = mix or OVERLOAD_MIX
+    lo, hi, probes = _probe_capacity(rig, mix, seed, slo_s,
+                                     goodput_floor, start_qps,
+                                     window_s, timeout_s)
+    if lo is None:                 # even the base rate failed
+        verdicts = {'capacity_found': False}
+        return build_artifact(
+            'capacity',
+            {'slo_p99_ms': slo_s * 1e3, 'goodput_floor': goodput_floor,
+             'seed': seed, 'window_s': window_s, 'mix': mix},
+            {'max_qps': None, 'probes': probes}, verdicts=verdicts)
+    if hi is not None:
+        for i in range(bisect_iters):
+            mid = (lo + hi) / 2.0
+            records, unresolved = _run_window(rig, mid, window_s, mix,
+                                              seed + 17 * (i + 1),
+                                              timeout_s)
+            m = summarize(records)
+            p99 = m['admitted_latency']['p99_ms']
+            good = (unresolved == 0 and m['goodput'] is not None
+                    and m['goodput'] >= goodput_floor
+                    and p99 is not None and p99 <= slo_s * 1e3)
+            probes.append({'qps': mid, 'good': good, 'p99_ms': p99,
+                           'goodput': m['goodput'],
+                           'offered': m['offered']})
+            _settle(rig)
+            if good:
+                lo = mid
+            else:
+                hi = mid
+    return build_artifact(
+        'capacity',
+        {'slo_p99_ms': slo_s * 1e3, 'goodput_floor': goodput_floor,
+         'seed': seed, 'window_s': window_s, 'mix': mix},
+        {'max_qps': lo, 'probes': probes},
+        verdicts={'capacity_found': True})
+
+
+def run_overload(rig, factor=2.5, duration_s=3.0, slo_s=None,
+                 shed_p99_s=None, mix=None, seed=0, start_qps=8.0,
+                 probe_window_s=2.0, timeout_s=6.0, capacity_qps=None):
+    """Overload mode: offer ``factor`` x capacity; admission control
+    must keep the ADMITTED p99 inside the SLO budget while the excess
+    resolves as fast 429s (not slow timeouts)."""
+    slo_s = float(slo_s if slo_s is not None
+                  else _knob('MXNET_TPU_SLO_P99_MS', 500.0) / 1e3)
+    shed_p99_s = float(
+        shed_p99_s if shed_p99_s is not None
+        else _knob('MXNET_TPU_SLO_SHED_P99_MS', 250.0) / 1e3)
+    mix = mix or OVERLOAD_MIX
+    if capacity_qps is None:
+        goodput_floor = float(_knob('MXNET_TPU_SLO_GOODPUT', 0.9))
+        lo, _hi, _probes = _probe_capacity(
+            rig, mix, seed, slo_s, goodput_floor, start_qps,
+            probe_window_s, timeout_s)
+        capacity_qps = lo if lo is not None else float(start_qps)
+    # clamp below the stdlib endpoint's accept ceiling: past O(100)
+    # connections/s on a small host the kernel SYN queue — not
+    # admission control — owns the latency, and this harness gates
+    # the latter (production fronts the engine with a real gateway)
+    offered_qps = min(float(capacity_qps) * float(factor),
+                      float(_knob('MXNET_TPU_LOADGEN_MAX_QPS', 100.0)))
+    records, unresolved = _run_window(rig, offered_qps, duration_s,
+                                      mix, seed + 1, timeout_s)
+    m = summarize(records)
+    # a thread alive past the drain budget is a request whose record
+    # never resolved — the same futures summarize() already counted
+    m['unresolved'] = max(m['unresolved'], unresolved)
+    failures = [r for r in records if r.status != 200]
+    sheds_429 = sum(1 for r in failures if r.status == 429)
+    shed_429_frac = (sheds_429 / float(len(failures))) \
+        if failures else None
+    p99 = m['admitted_latency']['p99_ms']
+    shed_p99 = m['shed_latency']['p99_ms']
+    verdicts = {
+        'admitted_p99_within_slo': p99 is not None
+        and p99 <= slo_s * 1e3,
+        'sheds_are_fast_429s': (not failures) or (
+            shed_429_frac is not None and shed_429_frac >= 0.8
+            and (shed_p99 is None or shed_p99 <= shed_p99_s * 1e3)),
+        'retry_after_advertised': m['shed'] == 0
+        or m['retry_after']['n'] > 0,
+        'zero_unresolved': m['unresolved'] == 0,
+    }
+    metrics = dict(m, shed_429_frac=shed_429_frac)
+    return build_artifact(
+        'overload',
+        {'capacity_qps': capacity_qps, 'offered_qps': offered_qps,
+         'factor': factor, 'duration_s': duration_s,
+         'slo_p99_ms': slo_s * 1e3,
+         'shed_p99_budget_ms': shed_p99_s * 1e3,
+         'seed': seed, 'mix': mix},
+        metrics, server=rig.server_stats(), verdicts=verdicts)
+
+
+def run_chaos(rig, qps=20.0, duration_s=12.0, mix=None, seed=0,
+              availability_floor=None, recovery_ceiling_s=None,
+              timeout_s=6.0, script=CHAOS_SCRIPT):
+    """Chaos-soak mode: sustained open-loop traffic while the
+    FaultInjector scripts fault bursts; gates availability, per-fault
+    recovery time, and the zero-hang invariant."""
+    from .. import config as _mxcfg
+    availability_floor = float(
+        availability_floor if availability_floor is not None
+        else _knob('MXNET_TPU_SLO_AVAILABILITY', 0.9))
+    recovery_ceiling_s = float(
+        recovery_ceiling_s if recovery_ceiling_s is not None
+        else _knob('MXNET_TPU_SLO_RECOVERY_S', 12.0))
+    mix = mix or DEFAULT_MIX
+    # drop script entries aimed at a session the rig does not mount
+    # (a fault nothing can consume would fail the consumed verdict)
+    pruned = []
+    for frac, kind, spec in script:
+        parts = []
+        for entry in spec.split(','):
+            site = entry.split('@', 1)[1].rsplit(':', 1)[0] \
+                if '@' in entry else ''
+            if site.startswith('serving.decode') \
+                    and rig.decode_session is None:
+                continue
+            if site == 'serving' and rig.predict_session is None:
+                continue
+            parts.append(entry)
+        if parts:
+            pruned.append((frac, kind, ','.join(parts)))
+    script = pruned
+    client = LoadClient('127.0.0.1', rig.port, timeout_s=timeout_s)
+    disp = Dispatcher(client, max_new_tokens=rig.max_new_tokens)
+    arrivals = build_schedule(qps, duration_s, mix=mix, seed=seed)
+
+    box = {}
+
+    def _drive():
+        box['records'], box['threads'] = disp.run(arrivals)
+
+    driver = threading.Thread(target=_drive, daemon=True,
+                              name='loadgen-chaos-driver')
+    t0 = time.monotonic()
+    driver.start()
+
+    from ..resilience.policy import get_injector
+
+    # monitor-side probe traffic: consumption of a scripted burst and
+    # the breaker's half-open recovery probe both need device calls,
+    # and the Poisson schedule may not land one exactly when the
+    # monitor is waiting — a light deterministic probe stream
+    # (excluded from the scheduled-traffic metrics) keeps both
+    # moving. Probes use a short budget so a wedged server cannot
+    # wedge the monitor.
+    probe_client = LoadClient('127.0.0.1', rig.port, timeout_s=2.0)
+    probe_seq = [0]
+
+    def _probe():
+        rid = probe_seq[0]
+        probe_seq[0] += 1
+        rec = RequestRecord(rid, 'probe', 0.0)
+        try:
+            if rig.decode_session is not None and rid % 3 == 0:
+                probe_client.generate(
+                    rec, Dispatcher._generate_payload(rid),
+                    max_new_tokens=2)
+            elif rig.predict_session is not None:
+                probe_client.predict(
+                    rec, Dispatcher._predict_payload(rid))
+            elif rig.decode_session is not None:
+                probe_client.generate(
+                    rec, Dispatcher._generate_payload(rid),
+                    max_new_tokens=2)
+        except Exception:
+            pass
+
+    faults = []
+    try:
+        for frac, kind, spec in script:
+            at_s = frac * duration_s
+            now = time.monotonic()
+            if t0 + at_s > now:
+                time.sleep(t0 + at_s - now)
+            injected_at = time.monotonic() - t0
+            _mxcfg.set('MXNET_TPU_FAULT', spec)
+            # wait for the scripted burst to be consumed (probes keep
+            # device calls flowing; an unconsumed fault is a finding)
+            sites = sorted({entry.split('@', 1)[1].rsplit(':', 1)[0]
+                            for entry in spec.split(',')
+                            if '@' in entry})
+            consumed = False
+            # a decode worker mid-fallback makes no device calls for
+            # a few seconds — give the burst room to land
+            wait_deadline = time.monotonic() + 6.0
+            while time.monotonic() < wait_deadline:
+                inj = get_injector()
+                if not any(inj.pending(site, (kind,))
+                           for site in sites):
+                    consumed = True
+                    break
+                _probe()
+                time.sleep(0.03)
+            _mxcfg.unset('MXNET_TPU_FAULT')
+            cleared_at = time.monotonic() - t0
+            # recovery: first /status with every session ok and its
+            # breaker closed after the burst cleared (probe traffic
+            # feeds the half-open reset probe even past schedule end)
+            recovery_s = None
+            rec_deadline = time.monotonic() + recovery_ceiling_s + 2.0
+            while time.monotonic() < rec_deadline:
+                _code, payload = client.get_json('/status')
+                if rig.healthy(payload):
+                    recovery_s = (time.monotonic() - t0) - cleared_at
+                    break
+                _probe()
+                time.sleep(0.05)
+            faults.append({'kind': kind, 'spec': spec,
+                           'injected_at_s': round(injected_at, 3),
+                           'cleared_at_s': round(cleared_at, 3),
+                           'consumed': consumed,
+                           'recovery_s': None if recovery_s is None
+                           else round(recovery_s, 3)})
+    finally:
+        _mxcfg.unset('MXNET_TPU_FAULT')
+    driver.join(duration_s + timeout_s + 4.0)
+    records = box.get('records', [])
+    threads = box.get('threads', [])
+    unresolved = disp.drain(threads, timeout_s + 2.0)
+    # settle, then capture the server-side drain proof
+    _settle(rig)
+    server = rig.server_stats()
+    m = summarize(records)
+    m['unresolved'] = max(m['unresolved'], unresolved)
+    leaked = sum(part.get('leaked_slots', 0)
+                 for part in server.values())
+    aborted = sum(n for cls, n in m['errors'].items()
+                  if cls == 'aborted' or cls.startswith('stream_'))
+    recoveries = [f['recovery_s'] for f in faults]
+    verdicts = {
+        'availability_above_floor': m['availability'] is not None
+        and m['availability'] >= availability_floor,
+        'all_faults_consumed': all(f['consumed'] for f in faults),
+        'all_faults_recovered': all(r is not None
+                                    and r <= recovery_ceiling_s
+                                    for r in recoveries),
+        'zero_unresolved': m['unresolved'] == 0,
+        'no_leaked_slots': leaked == 0,
+    }
+    metrics = dict(m, aborted_typed=aborted)
+    return build_artifact(
+        'chaos',
+        {'qps': qps, 'duration_s': duration_s, 'seed': seed,
+         'availability_floor': availability_floor,
+         'recovery_ceiling_s': recovery_ceiling_s, 'mix': mix},
+        metrics, faults=faults, server=server, verdicts=verdicts)

@@ -146,6 +146,11 @@ class MicroBatcher:
         self._rejected = 0
         self._timeouts = 0
         self._batches = 0
+        self._shed_doomed = 0
+        # EWMA of recent runner (device batch) latency: the basis for
+        # doomed-request shedding at dequeue and the Retry-After hint
+        # on 429 responses. None until the first batch completes.
+        self._ema_batch_s = None
         self._flushes = {'full': 0, 'deadline': 0, 'drain': 0}
         self._thread = threading.Thread(
             target=self._worker, daemon=True,
@@ -316,6 +321,7 @@ class MicroBatcher:
         while True:
             fails = []
             result = None
+            shed = 0
             with self._lock:
                 if self._queue:
                     self._collect_expired_locked(self._clock(), fails)
@@ -340,10 +346,46 @@ class MicroBatcher:
                     else:
                         batch = self._queue[:self.max_batch]
                         del self._queue[:len(batch)]
+                        # shed doomed requests at dequeue: a request
+                        # whose deadline will lapse before a batch of
+                        # recent latency could plausibly return would
+                        # burn accelerator time on a future the reaper
+                        # is about to expire — fail it now (fast,
+                        # typed) instead of serving it late
+                        est = self._ema_batch_s
+                        if est:
+                            kept = []
+                            for req in batch:
+                                if req.deadline_at is not None \
+                                        and not req.expiring \
+                                        and not req.future.done() \
+                                        and now + est > req.deadline_at:
+                                    req.expiring = True
+                                    self._shed_doomed += 1
+                                    shed += 1
+                                    fails.append((req.future,
+                                                  RequestTimeout(
+                                        'shed at dequeue: %.0fms of '
+                                        'budget left but recent '
+                                        'batches take ~%.0fms '
+                                        '(doomed)'
+                                        % (max(0.0, req.deadline_at
+                                               - now) * 1e3,
+                                           est * 1e3))))
+                                else:
+                                    kept.append(req)
+                            batch = kept
                         self._inflight = batch
                         self._flushes[cause] += 1
                         result = (batch, cause)
             self._fail_expired(fails)
+            if shed:
+                inst = _serving_instruments()
+                if inst is not None:
+                    inst.rejected.labels(reason='shed_doomed').inc(shed)
+                _record_event('serve_shed_doomed', count=shed,
+                              est_batch_ms=(self._ema_batch_s or 0.0)
+                              * 1e3)
             if result is not None:
                 return result
 
@@ -391,6 +433,8 @@ class MicroBatcher:
         with self._lock:
             self._batches += 1
             self._completed += n
+            self._ema_batch_s = dt if self._ema_batch_s is None \
+                else 0.7 * self._ema_batch_s + 0.3 * dt
             depth = len(self._queue)
         for i, req in enumerate(batch):
             if req.future.done():
@@ -415,9 +459,24 @@ class MicroBatcher:
                     'completed': self._completed,
                     'rejected': self._rejected,
                     'timeouts': self._timeouts,
+                    'shed_doomed': self._shed_doomed,
                     'batches': self._batches,
                     'flushes': dict(self._flushes),
                     'closed': self._closed}
+
+    def retry_after_hint(self):
+        """Estimated seconds until a newly admitted request could be
+        served: queue depth (in batches) x recent batch latency. The
+        HTTP layer turns this into a ``Retry-After`` header on 429
+        responses so well-behaved clients back off for roughly one
+        queue-drain instead of guessing."""
+        with self._lock:
+            depth = len(self._queue)
+            est = self._ema_batch_s
+        if est is None:
+            est = max(self.deadline_s, 0.01)
+        batches_ahead = depth / float(self.max_batch)
+        return max(0.05, (batches_ahead + 1.0) * est)
 
     def close(self, drain=True, timeout=10.0):
         """Stop accepting requests; drain the queue (or fail pending

@@ -172,6 +172,17 @@ class _DegradedPath(Exception):
     finish the work on the CPU fallback."""
 
 
+class _AbortPath(Exception):
+    """Internal: the device call died in a way that kills the work
+    itself (worker crash, preemption notice) — the in-flight
+    sequences fail with the typed error instead of completing
+    degraded; the client retries against a recovered engine."""
+
+    def __init__(self, exc):
+        super().__init__(str(exc))
+        self.exc = exc
+
+
 class DecodeEngine:
     """Continuous-batching scheduler over a decode program.
 
@@ -208,6 +219,8 @@ class DecodeEngine:
         self._degraded = False
         self._last_error = None
         self._op_seq = 0
+        self._ema_step_s = None    # EWMA decode-step latency (hints)
+        self._fallback_threads = []   # degraded completions in flight
         self._counts = {'requests': 0, 'rejected': 0, 'tokens': 0,
                         'prefills': 0, 'steps': 0, 'timeouts': 0,
                         'fallback_tokens': 0, 'retired': {}}
@@ -388,7 +401,9 @@ class DecodeEngine:
 
     def _execute(self, fn, step, *args):
         from ...resilience.policy import inject
-        inject('serving.decode', ('device_loss',), step=step)
+        inject('serving.decode',
+               ('device_loss', 'device_unavailable', 'tunnel_stall',
+                'worker_crash', 'preempt'), step=step)
         if self._watchdog is not None:
             self._watchdog.check()
         return fn(*args)
@@ -396,14 +411,24 @@ class DecodeEngine:
     def _device(self, fn, *args):
         """Run one device call under the breaker; a transient failure
         or an open breaker raises :class:`_DegradedPath` after
-        recording the trip (server.py's _serve contract)."""
-        from ...resilience.policy import CircuitOpenError, is_transient
+        recording the trip (server.py's _serve contract). A worker
+        crash / preemption notice raises :class:`_AbortPath` instead:
+        infrastructure trouble degrades, a dying worker aborts its
+        in-flight requests typed."""
+        from ...resilience.policy import (CircuitOpenError,
+                                          PreemptionSignal,
+                                          WorkerCrashError,
+                                          is_transient)
         step = self._next_op()
         if self._watchdog is not None:
             self._watchdog.beat(step=step, phase='decode')
         was_open = self._breaker.state == 'open'
         try:
             out = self._breaker.call(self._execute, fn, step, *args)
+        except (WorkerCrashError, PreemptionSignal) as exc:
+            # the breaker already counted the failure (breaker.call)
+            self._note_failure(exc, step, was_open)
+            raise _AbortPath(exc) from exc
         except Exception as exc:
             if not (is_transient(exc)
                     or isinstance(exc, CircuitOpenError)):
@@ -476,7 +501,14 @@ class DecodeEngine:
         except _DegradedPath:
             with self._lock:
                 self._free.append(slot)
-            self._fallback_complete(seq)
+            self._spawn_fallback([seq])
+            return
+        except _AbortPath as ab:
+            # worker crash / preemption at prefill: fail THIS request
+            # with the typed error (client retries), free the slot
+            with self._lock:
+                self._free.append(slot)
+            seq.stream._finish('error', ab.exc)
             return
         except Exception as exc:
             # bug-shaped (non-transient) failure: fail THIS request
@@ -542,6 +574,16 @@ class DecodeEngine:
         except _DegradedPath:
             self._degrade_inflight(active)
             return
+        except _AbortPath as ab:
+            # worker crash / preemption mid-stream: every in-flight
+            # sequence terminates with the typed error (an NDJSON
+            # stream gets it as its final line), slots retire, and
+            # the cache rebuilds for the engine's recovery
+            for slot, seq in active.items():
+                seq.stream._finish('error', ab.exc)
+                self._retire(slot, seq, 'aborted')
+            self._cache = self.program.new_cache()
+            return
         except Exception as exc:
             # bug-shaped failure: a deterministic error would recur
             # every tick — fail the in-flight streams with the typed
@@ -558,6 +600,8 @@ class DecodeEngine:
         with self._lock:
             self._counts['steps'] += 1
             self._counts['tokens'] += len(active)
+            self._ema_step_s = dt if self._ema_step_s is None \
+                else 0.7 * self._ema_step_s + 0.3 * dt
         inst = _serving_instruments()
         if inst is not None:
             inst.decode_steps.inc()
@@ -613,18 +657,52 @@ class DecodeEngine:
                 return
         seq.stream._finish('length')
 
+    def _spawn_fallback(self, seqs):
+        """Degraded completions run OFF the scheduler thread: the CPU
+        fallback decodes un-jitted at a couple hundred ms per token,
+        and serializing that into the worker loop would stall
+        admissions and every healthy slot behind one trip — the
+        availability hole the chaos soak measures. The scheduler
+        retires the slots, rebuilds the cache, and keeps serving at
+        device speed while this thread finishes the degraded work."""
+        def _complete():
+            for seq in seqs:
+                self._fallback_complete(seq)
+
+        th = threading.Thread(target=_complete, daemon=True,
+                              name='mxnet-tpu-%s-fallback' % self.name)
+        with self._lock:
+            self._fallback_threads = [
+                t for t in self._fallback_threads if t.is_alive()]
+            self._fallback_threads.append(th)
+        th.start()
+
     def _degrade_inflight(self, active):
         """Breaker tripped mid-decode: every in-flight sequence
         completes degraded on the CPU fallback; the accelerator cache
         is rebuilt when the breaker lets traffic through again."""
         for slot, seq in active.items():
             self._retire(slot, seq, 'degraded')
-            self._fallback_complete(seq)
         # donated cache buffers are unusable after a failed call;
         # start clean when the accelerator comes back
         self._cache = self.program.new_cache()
+        self._spawn_fallback(list(active.values()))
 
     # -- introspection / lifecycle -----------------------------------------
+
+    def retry_after_hint(self):
+        """Estimated seconds until a newly admitted generation could
+        get a slot: pending requests ahead x the per-sequence service
+        time (default generation budget x recent step latency) spread
+        over the slot pool. Basis for Retry-After on 429s."""
+        with self._lock:
+            pending = len(self._pending)
+            est = self._ema_step_s
+        if est is None:
+            est = 0.02
+        per_seq = est * max(1, self.default_max_new)
+        return max(0.05, (pending + 1) * per_seq
+                   / float(max(1, self.slots)))
 
     def stats(self):
         with self._lock:
@@ -663,6 +741,13 @@ class DecodeEngine:
                     break
             time.sleep(0.01)
         self._worker.join(max(0.1, deadline - time.monotonic()))
+        # degraded completions run off-worker; drain waits for them
+        # too (zero-hang: no stream left mid-fallback at close)
+        with self._lock:
+            fallbacks = list(self._fallback_threads)
+        if drain:
+            for th in fallbacks:
+                th.join(max(0.1, deadline - time.monotonic()))
 
     def __enter__(self):
         return self

@@ -263,7 +263,9 @@ class InferenceSession:
 
     def _execute_accel(self, stacked, n, seq):
         from ..resilience.policy import inject
-        inject('serving', ('device_loss',), step=seq)
+        inject('serving',
+               ('device_loss', 'device_unavailable', 'tunnel_stall',
+                'worker_crash', 'preempt'), step=seq)
         if self._watchdog is not None:
             # an injected hang@serving.infer aged the heartbeat at
             # beat(); check() now writes the stall artifact + flight
@@ -272,13 +274,22 @@ class InferenceSession:
         return self.frozen.run(stacked, n)
 
     def _serve(self, stacked, n, seq):
-        from ..resilience.policy import CircuitOpenError, is_transient
+        from ..resilience.policy import (CircuitOpenError,
+                                         PreemptionSignal,
+                                         WorkerCrashError, is_transient)
         if self._watchdog is not None:
             self._watchdog.beat(step=seq, phase='infer')
         was_open = self._breaker.state == 'open'
         try:
             outs = self._breaker.call(self._execute_accel, stacked, n,
                                       seq)
+        except (WorkerCrashError, PreemptionSignal) as exc:
+            # the work itself died (worker crash / preemption notice):
+            # fail the batch typed — clients retry against a recovered
+            # engine — rather than completing it degraded. The breaker
+            # counted the failure, so repeated crashes still open it.
+            self._note_failure(exc, seq, was_open)
+            raise
         except Exception as exc:
             if not (is_transient(exc)
                     or isinstance(exc, CircuitOpenError)):
@@ -341,6 +352,14 @@ class InferenceSession:
             pass
 
     # -- introspection / lifecycle -----------------------------------------
+
+    def retry_after_hint(self):
+        """Estimated seconds until a newly admitted request could be
+        served (queue depth x recent batch/step latency); the HTTP 429
+        path advertises it as ``Retry-After``."""
+        if self._engine is not None:
+            return self._engine.retry_after_hint()
+        return self._batcher.retry_after_hint()
 
     def status(self):
         """Machine-readable session state (the /status JSON)."""
@@ -408,12 +427,39 @@ class ServingHTTPServer:
     Binds 127.0.0.1 only; OFF by default — enable per-process with
     ``MXNET_TPU_SERVE_HTTP_PORT=<port>`` + :func:`maybe_start_http_server`
     or construct directly (port 0 picks a free port).
+
+    ``decode_session`` (optional) mounts a SECOND, decode-mode session
+    behind ``/generate`` so one endpoint fronts both workloads — the
+    shape the open-loop load harness (``mxnet_tpu.loadgen``) drives.
+    ``/status`` then nests both sessions and ``/healthz`` is healthy
+    only when both are.
+
+    Status codes are the error taxonomy the load harness keys on:
+    200 served (``degraded`` flag in the payload when the CPU fallback
+    did the work), 429 shed by admission control (with a
+    ``Retry-After`` header estimated from queue depth x recent batch
+    latency), 504 per-request budget lapsed, 503 engine closed or
+    unhealthy, 500 request aborted (worker crash / preemption) or
+    engine bug, 400 caller error.
+
+    ``max_concurrent`` (default ``MXNET_TPU_SERVE_MAX_CONCURRENT``,
+    0 = unbounded) caps in-flight POST handlers: each connection gets
+    a thread, so without a cap an overload saturates the host with
+    thread-scheduling contention BEFORE any bounded queue fills — the
+    latency-degradation mode the load harness measures. Past the cap,
+    requests shed instantly with 429 + Retry-After, the same typed
+    contract as queue-depth backpressure.
     """
 
-    def __init__(self, session, port, host='127.0.0.1'):
+    def __init__(self, session, port, host='127.0.0.1',
+                 decode_session=None, max_concurrent=None):
         self.session = session
+        self.decode_session = decode_session
         self.host = host
         self.port = int(port)
+        self.max_concurrent = int(
+            max_concurrent if max_concurrent is not None
+            else _knob('MXNET_TPU_SERVE_MAX_CONCURRENT', 0))
         self._httpd = None
         self._thread = None
 
@@ -423,29 +469,50 @@ class ServingHTTPServer:
         from http.server import BaseHTTPRequestHandler, \
             ThreadingHTTPServer
         session = self.session
+        decode_session = self.decode_session
+        limit = self.max_concurrent
+        gate = threading.BoundedSemaphore(limit) if limit > 0 else None
+
+        def _statuses():
+            st = session.status()
+            if decode_session is None:
+                return st, st['status']
+            dst = decode_session.status()
+            worst = st['status'] if st['status'] != 'ok' \
+                else dst['status']
+            return {'status': worst, 'predict': st,
+                    'generate': dst}, worst
 
         class Handler(BaseHTTPRequestHandler):
             # HTTP/1.1 so /generate can stream chunked NDJSON; every
             # non-chunked response carries Content-Length already
             protocol_version = 'HTTP/1.1'
 
-            def _json(handler, code, payload):
+            def _json(handler, code, payload, headers=None):
                 body = (json.dumps(payload, sort_keys=True)
                         + '\n').encode()
                 handler.send_response(code)
                 handler.send_header('Content-Type', 'application/json')
                 handler.send_header('Content-Length', str(len(body)))
+                for k, v in (headers or {}).items():
+                    handler.send_header(k, v)
                 handler.end_headers()
                 handler.wfile.write(body)
 
             def do_GET(handler):
                 path = handler.path.rstrip('/')
                 if path == '/status':
-                    handler._json(200, session.status())
+                    payload, _worst = _statuses()
+                    handler._json(200, payload)
                 elif path == '/healthz':
-                    st = session.status()
-                    handler._json(200, {'ok': st['status'] == 'ok',
-                                        'status': st['status']})
+                    # a load balancer keys on the status code: an
+                    # unhealthy replica (breaker open / degraded) must
+                    # answer 503 so it is routed around, while the
+                    # JSON body keeps the human-readable detail
+                    _payload, worst = _statuses()
+                    ok = worst == 'ok'
+                    handler._json(200 if ok else 503,
+                                  {'ok': ok, 'status': worst})
                 else:
                     handler.send_error(404)
 
@@ -459,15 +526,17 @@ class ServingHTTPServer:
             def _generate(handler, req):
                 """POST /generate — per-token chunked streaming (or a
                 single JSON when stream=false)."""
+                gen = decode_session if decode_session is not None \
+                    else session
                 tokens = req.get('tokens')
                 if not tokens:
                     handler._json(400, {'error': "need 'tokens'"})
                     return
-                stream = session.generate(
+                stream = gen.generate(
                     tokens,
                     max_new_tokens=req.get('max_new_tokens'),
                     eos_id=req.get('eos_id'))
-                wait_s = (session._engine.timeout_s
+                wait_s = (gen._engine.timeout_s
                           or _HTTP_MAX_WAIT_S)
                 if not req.get('stream', True):
                     toks = stream.result(wait_s)
@@ -503,6 +572,8 @@ class ServingHTTPServer:
                         handler._chunk({'done': True,
                                         'error': '%s: %s'
                                         % (type(exc).__name__, exc),
+                                        'error_class':
+                                            type(exc).__name__,
                                         'tokens': stream.tokens})
                     except OSError:
                         return
@@ -512,11 +583,53 @@ class ServingHTTPServer:
                 except OSError:
                     pass
 
+            def _retry_after(handler, path):
+                src = decode_session \
+                    if (path == '/generate'
+                        and decode_session is not None) else session
+                try:
+                    return float(src.retry_after_hint())
+                except Exception:
+                    return 1.0
+
             def do_POST(handler):
                 path = handler.path.rstrip('/')
                 if path not in ('/predict', '/generate'):
                     handler.send_error(404)
                     return
+                if gate is not None \
+                        and not gate.acquire(blocking=False):
+                    # concurrency shed: past the in-flight cap every
+                    # extra handler thread only adds scheduling
+                    # contention — reject instantly, typed, with the
+                    # same Retry-After contract as queue backpressure.
+                    # Drain the unread body first: on a keep-alive
+                    # connection it would otherwise be parsed as the
+                    # NEXT request line, garbling the client's retry.
+                    try:
+                        length = int(handler.headers.get(
+                            'Content-Length', 0) or 0)
+                        if length:
+                            handler.rfile.read(length)
+                    except (ValueError, OSError):
+                        pass
+                    hint = handler._retry_after(path)
+                    handler._json(
+                        429,
+                        {'error': 'serving concurrency limit '
+                                  'reached; shed load or retry with '
+                                  'backoff',
+                         'limit': limit, 'retry_after_s': hint},
+                        headers={'Retry-After':
+                                 str(max(1, int(hint + 0.999)))})
+                    return
+                try:
+                    handler._do_post_admitted(path)
+                finally:
+                    if gate is not None:
+                        gate.release()
+
+            def _do_post_admitted(handler, path):
                 try:
                     length = int(handler.headers.get('Content-Length',
                                                      0))
@@ -550,9 +663,17 @@ class ServingHTTPServer:
                                       {'error': "need 'data' or "
                                                 "'instances'"})
                 except BackpressureError as exc:
+                    # Retry-After from queue depth x recent batch
+                    # latency: a well-behaved client backs off for
+                    # roughly one queue-drain instead of hammering
+                    hint = handler._retry_after(path)
                     handler._json(429, {'error': str(exc),
                                         'depth': exc.depth,
-                                        'limit': exc.limit})
+                                        'limit': exc.limit,
+                                        'retry_after_s': hint},
+                                  headers={'Retry-After':
+                                           str(max(1, int(hint
+                                                          + 0.999)))})
                 except (RequestTimeout, _FutWaitTimeout) as exc:
                     handler._json(504, {'error': str(exc)
                                         or 'request timed out'})
@@ -563,12 +684,41 @@ class ServingHTTPServer:
                     # over-long prompt, or the wrong endpoint for the
                     # session's mode
                     handler._json(400, {'error': str(exc)})
+                except Exception as exc:  # noqa: BLE001 - typed 500
+                    # aborted work (worker crash / preemption) or an
+                    # engine bug: a typed 500 beats a dropped
+                    # connection — the load harness taxonomizes on
+                    # error_class
+                    handler._json(500, {'error': '%s: %s'
+                                        % (type(exc).__name__, exc),
+                                        'error_class':
+                                            type(exc).__name__})
 
             def log_message(handler, *args):
                 pass        # no per-request stderr noise
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port),
-                                          Handler)
+        class _QuietServer(ThreadingHTTPServer):
+            # socketserver's listen backlog defaults to 5: at a few
+            # hundred connections/s the SYN queue overflows and
+            # clients stall in 1s/3s TCP retransmit — a latency cliff
+            # admission control never sees. A deep backlog keeps the
+            # kernel accepting; the concurrency gate and bounded
+            # queues stay the real admission control.
+            request_queue_size = 128
+
+            # a client hanging up (load-gen teardown, impatient
+            # caller) is normal serving weather, not a stack trace:
+            # keep real handler bugs loud, silence benign disconnects
+            def handle_error(server_self, request, client_address):
+                import sys as _sys
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (ConnectionError, TimeoutError)):
+                    return
+                ThreadingHTTPServer.handle_error(
+                    server_self, request, client_address)
+
+        self._httpd = _QuietServer((self.host, self.port),
+                                   Handler)
         self.port = self._httpd.server_address[1]    # resolve port 0
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,

@@ -581,3 +581,182 @@ def test_gluon_rnn_lm_adapter_matches_gluon_forward():
 def test_freeze_decode_rejects_unfreezable():
     with pytest.raises(TypeError):
         freeze_decode(object())
+
+
+# ---------------------------------------------------------------------------
+# mid-stream faults: typed aborts + breaker recovery
+# (docs/SERVING.md "SLOs and overload behavior")
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('kind,exc_name', [
+    ('worker_crash', 'WorkerCrashError'),
+    ('preempt', 'PreemptionSignal'),
+])
+def test_engine_mid_stream_fault_aborts_typed_and_recovers(kind,
+                                                           exc_name):
+    """worker_crash / preempt mid-decode abort the in-flight stream
+    with the TYPED error (infra trouble degrades, dying workers
+    abort), free the slot, and after the breaker's half-open probe
+    the same engine serves clean again."""
+    from mxnet_tpu.resilience import policy as rp
+    exc_type = getattr(rp, exc_name)
+    prog = _FakeProgram(slots=2)
+    eng = DecodeEngine(
+        prog, timeout_s=10.0,
+        breaker=rp.CircuitBreaker(failure_threshold=1,
+                                  reset_timeout=0.2))
+    # device ops for a solo stream: op0 prefill, op1.. steps — fire
+    # at op 2 so the abort lands MID-stream (>= 2 tokens out)
+    mx.config.set('MXNET_TPU_FAULT',
+                  '%s@serving.decode.2:1' % kind)
+    try:
+        s = eng.generate([1, 2], max_new_tokens=6)
+        with pytest.raises(exc_type):
+            s.result(10)
+        assert s.finish_reason == 'error'
+        assert len(s.tokens) >= 1          # aborted mid-stream
+        assert not s.degraded              # aborted, NOT degraded
+        # the slot retired
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if eng.stats()['free_slots'] == 2:
+                break
+            time.sleep(0.01)
+        assert eng.stats()['free_slots'] == 2
+        assert eng.stats()['counts']['retired'].get('aborted') == 1
+        # breaker opened (threshold 1); past the reset window the
+        # half-open probe admits the next generation, which succeeds
+        assert eng.stats()['breaker'] in ('open', 'half-open')
+        time.sleep(0.25)
+        ok = eng.generate([3, 4], max_new_tokens=3)
+        assert ok.result(10) == _expected([3, 4], 3)
+        assert not ok.degraded
+        assert eng.stats()['breaker'] == 'closed'
+    finally:
+        mx.config.unset('MXNET_TPU_FAULT')
+        eng.close()
+
+
+class _EngineSession:
+    """Duck-typed decode-mode session over a DecodeEngine: the HTTP
+    layer only needs ._engine/.generate/.status/.retry_after_hint."""
+
+    _batcher = None
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def generate(self, tokens, max_new_tokens=None, eos_id=None):
+        return self._engine.generate(tokens,
+                                     max_new_tokens=max_new_tokens,
+                                     eos_id=eos_id)
+
+    def status(self):
+        st = self._engine.stats()
+        return {'status': 'degraded' if st['degraded'] else 'ok',
+                'breaker': st['breaker']}
+
+    def retry_after_hint(self):
+        return self._engine.retry_after_hint()
+
+
+@pytest.mark.parametrize('kind', ['worker_crash', 'preempt'])
+def test_http_generate_stream_fault_typed_error_line_and_recovery(
+        kind):
+    """Satellite contract: a fault injected mid-/generate stream must
+    terminate the NDJSON stream with a typed error line, free the
+    decode slot, and a subsequent request on the SAME session must
+    succeed after the breaker's half-open probe."""
+    import http.client
+    from mxnet_tpu.resilience.policy import CircuitBreaker
+    from mxnet_tpu.serving.server import ServingHTTPServer
+    exc_names = {'worker_crash': 'WorkerCrashError',
+                 'preempt': 'PreemptionSignal'}
+    prog = _FakeProgram(slots=2)
+    eng = DecodeEngine(prog, timeout_s=10.0,
+                       breaker=CircuitBreaker(failure_threshold=1,
+                                              reset_timeout=0.2))
+    sess = _EngineSession(eng)
+    mx.config.set('MXNET_TPU_FAULT',
+                  '%s@serving.decode.2:1' % kind)
+    try:
+        with ServingHTTPServer(sess, 0) as srv:
+            def post(payload, timeout=20):
+                conn = http.client.HTTPConnection(
+                    '127.0.0.1', srv.port, timeout=timeout)
+                body = json.dumps(payload).encode()
+                conn.request('POST', '/generate', body=body,
+                             headers={'Content-Type':
+                                      'application/json',
+                                      'Connection': 'close'})
+                resp = conn.getresponse()
+                raw = resp.read().decode()
+                conn.close()
+                return resp.status, raw
+
+            status, raw = post({'tokens': [1, 2],
+                                'max_new_tokens': 6, 'stream': True})
+            assert status == 200
+            lines = [json.loads(ln) for ln in raw.strip().split('\n')]
+            # tokens streamed before the fault...
+            assert any('token' in ln for ln in lines)
+            # ...then the stream TERMINATES with a typed error line
+            last = lines[-1]
+            assert last.get('done') is True
+            assert last.get('error_class') == exc_names[kind]
+            assert exc_names[kind] in last.get('error', '')
+            # the decode slot is freed
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if eng.stats()['free_slots'] == 2:
+                    break
+                time.sleep(0.01)
+            assert eng.stats()['free_slots'] == 2
+            # after the half-open window the SAME session serves the
+            # next request clean
+            time.sleep(0.25)
+            status, raw = post({'tokens': [3, 4],
+                                'max_new_tokens': 3, 'stream': False})
+            assert status == 200
+            body = json.loads(raw)
+            assert body['tokens'] == _expected([3, 4], 3)
+            assert body['finish_reason'] == 'length'
+            assert body['degraded'] is False
+    finally:
+        mx.config.unset('MXNET_TPU_FAULT')
+        eng.close()
+
+
+def test_engine_degraded_fallback_runs_off_worker_thread():
+    """A breaker trip must not serialize the (slow) CPU fallback into
+    the scheduler loop: while a degraded completion is still running,
+    the engine keeps admitting and decoding fresh sequences."""
+    import threading as _threading
+    release = _threading.Event()
+    entered = _threading.Event()
+
+    class _SlowFallback(_FakeProgram):
+        def fallback_generate(self, tokens, max_new, eos_id=None):
+            entered.set()
+            release.wait(10)       # a deliberately wedged fallback
+            return super().fallback_generate(tokens, max_new, eos_id)
+
+    prog = _SlowFallback(slots=2, fail_ops=(1,))   # 2nd op dies
+    eng = DecodeEngine(prog, timeout_s=15.0)
+    try:
+        victim = eng.generate([1, 2], max_new_tokens=4)
+        # wait until the fault fired and the victim is IN the wedged
+        # fallback (otherwise the scripted failure could hit the
+        # fresh sequence instead)
+        assert entered.wait(5.0)
+        # with the fallback thread still blocked, a fresh generation
+        # must complete at device speed
+        fresh = eng.generate([5, 6], max_new_tokens=3)
+        assert fresh.result(10) == _expected([5, 6], 3)
+        assert not victim.done()      # fallback still wedged
+        release.set()
+        assert victim.result(10) == _expected([1, 2], 4)
+        assert victim.degraded
+    finally:
+        release.set()
+        eng.close()

@@ -628,3 +628,254 @@ def test_compile_cache_knob_configures_jax(tmp_path):
         jax.config.update('jax_compilation_cache_dir', prev)
         import mxnet_tpu.config as _cfg
         _cfg._compile_cache_dir = None
+
+
+# ---------------------------------------------------------------------------
+# overload behavior: doomed-request shedding, Retry-After, health codes
+# (docs/SERVING.md "SLOs and overload behavior")
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    """Thread-safe manual clock for deterministic deadline math."""
+
+    def __init__(self):
+        self._t = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            return self._t
+
+    def advance(self, dt):
+        with self._lock:
+            self._t += dt
+
+
+def test_batcher_sheds_doomed_requests_at_dequeue():
+    """A request whose deadline will lapse before a batch of recent
+    latency could return must fail fast at dequeue (shed_doomed), not
+    burn a batch slot on a future the reaper is about to expire."""
+    clock = _FakeClock()
+
+    def runner(stacked, n):
+        clock.advance(0.6)          # every batch "takes" 0.6s
+        return [stacked[0]]
+
+    b = MicroBatcher(runner, max_batch=1, deadline_ms=0.0,
+                     timeout_s=1.0, name='doomed', clock=clock)
+    try:
+        futs = [b.submit(np.zeros(2)) for _ in range(3)]
+        # f0 served (no latency estimate yet); after it the EWMA is
+        # 0.6s, so f1/f2 (deadline t=1.0, dequeued at t>=0.6) are
+        # doomed: 0.6 + 0.6 > 1.0
+        assert futs[0].result(10)[0].shape == (2,)
+        for f in futs[1:]:
+            with pytest.raises(RequestTimeout) as ei:
+                f.result(10)
+            assert 'shed at dequeue' in str(ei.value)
+        stats = b.stats()
+        assert stats['shed_doomed'] == 2
+        # doomed sheds are their own bucket, not queue-age timeouts
+        assert stats['timeouts'] == 0
+    finally:
+        b.close(drain=False)
+
+
+def test_batcher_retry_after_hint_tracks_queue_depth():
+    gate = threading.Event()
+
+    def runner(stacked, n):
+        gate.wait(20)
+        return [stacked[0]]
+
+    b = MicroBatcher(runner, max_batch=2, deadline_ms=0.0,
+                     timeout_s=30.0, max_queue=64, name='hint')
+    try:
+        empty_hint = b.retry_after_hint()
+        assert empty_hint > 0.0
+        b._ema_batch_s = 0.2        # pretend batches take 200ms
+        base = b.retry_after_hint()
+        futs = [b.submit(np.zeros(2)) for _ in range(9)]
+        deep = b.retry_after_hint()
+        assert deep > base          # more queue -> larger backoff
+        assert deep >= 0.2 * (len(futs) - 2) / 2.0 * 0.5
+    finally:
+        gate.set()
+        b.close(drain=False)
+        assert futs is not None
+
+
+class _FakeOneShotSession:
+    """Duck-typed stand-in for InferenceSession: exercises the HTTP
+    layer's status codes without building a model."""
+
+    def __init__(self, status='ok', fail=None, block=None):
+        import types as _types
+        self._batcher = _types.SimpleNamespace(timeout_s=5.0)
+        self._engine = None
+        self._status = status
+        self._fail = fail
+        self._block = block
+        self.entered = threading.Event()
+
+    def status(self):
+        return {'status': self._status, 'breaker': 'closed'}
+
+    def retry_after_hint(self):
+        return 2.5
+
+    def infer(self, x, timeout=None):
+        if self._block is not None:
+            self.entered.set()
+            self._block.wait(10)
+        if self._fail is not None:
+            raise self._fail
+        return [np.asarray([1.0, 2.0])]
+
+    def submit(self, x):
+        raise AssertionError('unused')
+
+
+def _post_json(port, path, payload, timeout=10):
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        'http://127.0.0.1:%d%s' % (port, path),
+        data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, dict(resp.headers), \
+            json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read())
+
+
+def test_healthz_503_when_unhealthy_200_when_ok():
+    """A load balancer keys on the STATUS CODE: a degraded replica
+    must answer 503 (with the JSON detail intact) so it is routed
+    around, and 200 again once healthy."""
+    import urllib.error
+    import urllib.request
+    sess = _FakeOneShotSession(status='degraded')
+    with serving.ServingHTTPServer(sess, 0) as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                'http://127.0.0.1:%d/healthz' % srv.port, timeout=10)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body == {'ok': False, 'status': 'degraded'}
+        sess._status = 'ok'
+        body = json.loads(urllib.request.urlopen(
+            'http://127.0.0.1:%d/healthz' % srv.port,
+            timeout=10).read())
+        assert body == {'ok': True, 'status': 'ok'}
+
+
+def test_http_429_carries_retry_after_header():
+    sess = _FakeOneShotSession(fail=BackpressureError(5, 4))
+    with serving.ServingHTTPServer(sess, 0) as srv:
+        code, headers, body = _post_json(srv.port, '/predict',
+                                         {'data': [0.0]})
+    assert code == 429
+    assert body['retry_after_s'] == 2.5
+    assert int(headers['Retry-After']) == 3      # ceil(2.5)
+    assert body['depth'] == 5 and body['limit'] == 4
+
+
+def test_http_500_typed_on_aborted_request():
+    """worker_crash / preempt abort the request typed: the HTTP layer
+    answers a taxonomized 500, never a dropped connection."""
+    from mxnet_tpu.resilience.policy import WorkerCrashError
+    sess = _FakeOneShotSession(
+        fail=WorkerCrashError('worker_crash', 'serving'))
+    with serving.ServingHTTPServer(sess, 0) as srv:
+        code, _headers, body = _post_json(srv.port, '/predict',
+                                          {'data': [0.0]})
+    assert code == 500
+    assert body['error_class'] == 'WorkerCrashError'
+    assert 'WorkerCrashError' in body['error']
+
+
+def test_http_concurrency_gate_sheds_429():
+    """Past max_concurrent in-flight POSTs the endpoint sheds
+    instantly with 429 + Retry-After instead of stacking handler
+    threads."""
+    block = threading.Event()
+    sess = _FakeOneShotSession(block=block)
+    with serving.ServingHTTPServer(sess, 0, max_concurrent=1) as srv:
+        results = {}
+
+        def first():
+            results['first'] = _post_json(srv.port, '/predict',
+                                          {'data': [0.0]}, timeout=15)
+
+        t = threading.Thread(target=first)
+        t.start()
+        # the first request holds the one gate slot (proven by it
+        # reaching infer); a concurrent POST must shed 429
+        assert sess.entered.wait(5.0)
+        code, headers, body = _post_json(srv.port, '/predict',
+                                         {'data': [0.0]})
+        assert code == 429
+        assert 'concurrency limit' in body['error']
+        assert 'Retry-After' in headers
+        block.set()
+        t.join(10)
+        assert results['first'][0] == 200
+
+
+def test_http_concurrency_shed_keeps_keepalive_in_sync():
+    """The gate 429 must drain the unread request body: on a
+    keep-alive connection the leftover bytes would be parsed as the
+    NEXT request line, garbling a well-behaved client's retry."""
+    import http.client
+    block = threading.Event()
+    sess = _FakeOneShotSession(block=block)
+    with serving.ServingHTTPServer(sess, 0, max_concurrent=1) as srv:
+        t = threading.Thread(target=lambda: _post_json(
+            srv.port, '/predict', {'data': [0.0]}, timeout=15))
+        t.start()
+        try:
+            assert sess.entered.wait(5.0)   # the slot is held
+            conn = http.client.HTTPConnection('127.0.0.1', srv.port,
+                                              timeout=10)
+            body = json.dumps({'data': [0.0] * 64}).encode()
+            hdrs = {'Content-Type': 'application/json',
+                    'Content-Length': str(len(body))}
+            conn.request('POST', '/predict', body=body, headers=hdrs)
+            resp = conn.getresponse()
+            assert resp.status == 429
+            resp.read()
+            # SAME connection: the retry must be parsed as a fresh
+            # request (429 again), not a 400 from stale body bytes
+            conn.request('POST', '/predict', body=body, headers=hdrs)
+            resp = conn.getresponse()
+            assert resp.status == 429
+            resp.read()
+            conn.close()
+        finally:
+            block.set()
+            t.join(10)
+
+
+def test_session_serve_aborts_typed_on_worker_crash():
+    """One-shot path: an injected worker_crash fails the batch with
+    the typed error (clients retry), it does NOT complete degraded."""
+    from mxnet_tpu.resilience.policy import WorkerCrashError
+    mod, x, _ = _fitted_module()
+    frozen = serving.freeze(mod, max_batch=4)
+    mx.config.set('MXNET_TPU_FAULT', 'worker_crash@serving:1')
+    try:
+        with serving.InferenceSession(frozen, deadline_ms=1.0,
+                                      watchdog=False) as sess:
+            with pytest.raises(WorkerCrashError):
+                sess.infer(x[0], timeout=30)
+            # the engine recovers: the next batch serves clean
+            out = sess.infer(x[1], timeout=30)[0]
+            st = sess.status()
+    finally:
+        mx.config.unset('MXNET_TPU_FAULT')
+    ref = frozen.run([x[1:2]])[0][0]
+    assert np.array_equal(out, ref)
+    assert st['batches']['accel'] >= 1

@@ -82,6 +82,17 @@ Stages, each timed:
                            tokens/s + TTFT/TPOT percentiles); the
                            fault tier gates the serving hang /
                            device-loss / decode-hang degraded paths
+  4b. slo                  tools/slo_gate.py — the open-loop load &
+                           chaos harness (python -m mxnet_tpu.loadgen)
+                           in overload + chaos modes against a live
+                           ServingHTTPServer, diffed against
+                           SLO_BASELINE.json: admitted-p99 under
+                           2.5x-capacity overload, sheds as fast 429s
+                           (Retry-After advertised), chaos-soak
+                           availability floor, per-fault recovery
+                           ceilings, zero unresolved futures and zero
+                           leaked decode slots (docs/SERVING.md "SLOs
+                           and overload behavior")
   5. C ABI audit           tools/capi_coverage.py == 207/207
   6. copy-paste gate       tools/overlap_check.py --sweep 0.60
   7. example smokes        3 representative workloads (LeNet both
@@ -182,6 +193,17 @@ def main(argv=None):
         ('bench-decode', [py, 'bench_serving.py', '--decode',
                           '--quick', '--out',
                           '/tmp/BENCH_DECODE.json']),
+        # open-loop load & chaos SLO gate (docs/SERVING.md "SLOs and
+        # overload behavior"): overload mode at 2.5x measured
+        # capacity must keep admitted p99 inside the budget with the
+        # excess shed as fast 429s, and the chaos soak must hold the
+        # availability floor, recover from every scripted fault
+        # within its ceiling, and leave zero unresolved futures /
+        # leaked decode slots — diffed against SLO_BASELINE.json
+        # (fail-on-regression + annotated suppressions, the
+        # LINT_BASELINE workflow)
+        ('slo', [py, 'tools/slo_gate.py', '--baseline',
+                 'SLO_BASELINE.json', '--out', '/tmp/SLO.json']),
         ('capi', [py, 'tools/capi_coverage.py', '--assert', '207']),
         ('overlap', [py, 'tools/overlap_check.py', '--sweep', '0.60']),
     ]

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""SLO regression gate (tools/ci.py stage 'slo').
+
+Runs the open-loop load harness (python -m mxnet_tpu.loadgen) in
+overload and chaos modes against the in-process serving rig, then
+diffs the resulting ``mxnet_tpu.slo.v1`` artifacts against the
+committed SLO_BASELINE.json:
+
+  * budgets  — the SLO numbers the serving stack must hold (admitted
+    p99 under overload, shed-response p99, availability floor and
+    per-fault recovery ceiling under chaos, zero unresolved futures,
+    zero leaked decode slots). Budgets are CEILINGS, not measured
+    snapshots: the gate fails only on regressions past them, never on
+    improvements — the LINT_BASELINE/FUSION_BASELINE contract.
+  * suppressions — annotated waivers: {"check": "<mode>.<verdict>",
+    "reason": "..."}. A suppression without a reason is itself a
+    gate failure (suppressions document debt, they don't hide it).
+
+Exit 0 = every check green or explicitly suppressed. The merged
+verdict lands in --out (schema ``mxnet_tpu.slo_gate.v1``).
+
+Usage:
+  python tools/slo_gate.py --baseline SLO_BASELINE.json \
+      --out /tmp/SLO.json [--full] [--skip-run --overload A --chaos B]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_SCHEMA = 'mxnet_tpu.slo_baseline.v1'
+GATE_SCHEMA = 'mxnet_tpu.slo_gate.v1'
+
+# baseline budget key -> env knob the harness reads it through
+_BUDGET_KNOBS = {
+    'slo_p99_ms': 'MXNET_TPU_SLO_P99_MS',
+    'shed_p99_ms': 'MXNET_TPU_SLO_SHED_P99_MS',
+    'availability_floor': 'MXNET_TPU_SLO_AVAILABILITY',
+    'recovery_ceiling_s': 'MXNET_TPU_SLO_RECOVERY_S',
+    'goodput_floor': 'MXNET_TPU_SLO_GOODPUT',
+}
+
+
+def load_baseline(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get('schema') != BASELINE_SCHEMA:
+        raise SystemExit('%s: schema %r, want %r'
+                         % (path, doc.get('schema'), BASELINE_SCHEMA))
+    for sup in doc.get('suppressions', []):
+        if not sup.get('check') or not str(sup.get('reason',
+                                                   '')).strip():
+            raise SystemExit(
+                'suppression %r needs both "check" and a non-empty '
+                '"reason" (annotated-suppression contract)' % (sup,))
+    return doc
+
+
+def run_mode(mode, out_path, budgets, full=False):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    for key, knob in _BUDGET_KNOBS.items():
+        if key in budgets:
+            env[knob] = str(budgets[key])
+    cmd = [sys.executable, '-m', 'mxnet_tpu.loadgen', '--mode', mode,
+           '--out', out_path]
+    if full:
+        cmd.append('--full')
+    proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=1200)
+    if not os.path.exists(out_path):
+        raise SystemExit('loadgen --mode %s wrote no artifact '
+                         '(rc=%d)' % (mode, proc.returncode))
+    with open(out_path) as f:
+        return json.load(f)
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return '%.3g' % v
+    return str(v)
+
+
+def evaluate(artifacts, baseline):
+    """Turn per-mode artifact verdicts into gate checks; returns
+    (checks, failing_unsuppressed, suppressed_hits, stale)."""
+    suppressed = {s['check']: s for s
+                  in baseline.get('suppressions', [])}
+    checks = []
+    failing = []
+    hits = []
+    for doc in artifacts:
+        mode = doc.get('mode', '?')
+        m = doc.get('metrics', {})
+        context = {
+            'admitted_p99_ms':
+                (m.get('admitted_latency') or {}).get('p99_ms'),
+            'shed_p99_ms':
+                (m.get('shed_latency') or {}).get('p99_ms'),
+            'availability': m.get('availability'),
+            'unresolved': m.get('unresolved'),
+            'recoveries': [f.get('recovery_s')
+                           for f in doc.get('faults', [])],
+        }
+        for name, ok in sorted((doc.get('verdicts') or {}).items()):
+            check = '%s.%s' % (mode, name)
+            entry = {'check': check, 'ok': bool(ok),
+                     'context': {k: v for k, v in context.items()
+                                 if v is not None}}
+            if not ok and check in suppressed:
+                entry['suppressed'] = suppressed[check]['reason']
+                hits.append(check)
+            elif not ok:
+                failing.append(check)
+            checks.append(entry)
+    stale = sorted(set(suppressed) - set(hits)
+                   - {c['check'] for c in checks if not c['ok']})
+    return checks, failing, hits, stale
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument('--baseline', default='SLO_BASELINE.json')
+    p.add_argument('--out', default='/tmp/SLO.json')
+    p.add_argument('--full', action='store_true',
+                   help='long soak (4x windows) — release bar, not '
+                        'the per-change gate')
+    p.add_argument('--skip-run', action='store_true',
+                   help='gate pre-existing artifacts instead of '
+                        'running the harness')
+    p.add_argument('--overload', default=None,
+                   help='with --skip-run: overload artifact path')
+    p.add_argument('--chaos', default=None,
+                   help='with --skip-run: chaos artifact path')
+    args = p.parse_args(argv)
+
+    baseline = load_baseline(os.path.join(REPO, args.baseline)
+                             if not os.path.isabs(args.baseline)
+                             else args.baseline)
+    budgets = baseline.get('budgets', {})
+    artifacts = []
+    if args.skip_run:
+        for path in (args.overload, args.chaos):
+            if path:
+                with open(path) as f:
+                    artifacts.append(json.load(f))
+        if not artifacts:
+            raise SystemExit('--skip-run needs --overload/--chaos')
+    else:
+        tmp = tempfile.mkdtemp(prefix='slo_gate_')
+        for mode in ('overload', 'chaos'):
+            artifacts.append(run_mode(
+                mode, os.path.join(tmp, '%s.json' % mode), budgets,
+                full=args.full))
+
+    checks, failing, hits, stale = evaluate(artifacts, baseline)
+    for entry in checks:
+        tag = 'OK  ' if entry['ok'] else (
+            'SUPP' if 'suppressed' in entry else 'FAIL')
+        ctx = ' '.join('%s=%s' % (k, _fmt(v))
+                       for k, v in entry['context'].items()
+                       if not isinstance(v, list))
+        print('%s %-38s %s' % (tag, entry['check'], ctx), flush=True)
+        if 'suppressed' in entry:
+            print('     suppressed: %s' % entry['suppressed'])
+    for check in stale:
+        print('WARN stale suppression (check no longer failing): %s'
+              % check)
+    ok = not failing
+    verdict = {'schema': GATE_SCHEMA, 'ok': ok,
+               'budgets': budgets, 'checks': checks,
+               'failing': failing, 'suppressed': hits,
+               'stale_suppressions': stale,
+               'artifacts': artifacts}
+    with open(args.out, 'w') as f:
+        json.dump(verdict, f, indent=1, sort_keys=True)
+    print('slo-gate: %s (%d checks, %d failing, %d suppressed) -> %s'
+          % ('OK' if ok else 'FAIL', len(checks), len(failing),
+             len(hits), args.out), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

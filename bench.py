@@ -598,6 +598,96 @@ def bench_input_overlap(on_accel):
     return rec
 
 
+def _pallas_ab_trainer(model, on_accel, pallas):
+    """Build one side of the Pallas-kernel A/B: same model, optimizer,
+    seeds, and data — only MXNET_TPU_PALLAS differs, set around the
+    build so the traceknobs snapshot bakes it into the step program.
+    Returns (trainer, step, batch, tag)."""
+    from mxnet_tpu import config as _mx_config
+    prev = _mx_config.get('MXNET_TPU_PALLAS')
+    _mx_config.set('MXNET_TPU_PALLAS', pallas)
+    try:
+        return _amp_ab_trainer(model, on_accel, None)
+    finally:
+        _mx_config.set('MXNET_TPU_PALLAS', prev)
+
+
+def _bench_pallas_ab(on_accel, model, families, metric):
+    """Knob-off vs knob-on compiled-step A/B over the same model
+    (docs/PERFORMANCE.md "Hand-written kernels"): interleaved
+    min-of-reps slope timing, per-side roofline byte totals, platform
+    tag. On the CPU rig the kernels run through the Pallas
+    interpreter — the numbers are recorded honestly but the
+    acceptance signal is chip-side: audit-ranked bytes/step down and
+    a speedup > 1 on a real TPU."""
+    import jax
+    from mxnet_tpu import nd
+    from mxnet_tpu.observability import roofline
+
+    warmup, iters, reps = (5, 40, 2) if on_accel else (2, 2, 2)
+    sides = {}
+    for mode, spec in (('off', '0'), ('on', families)):
+        pt, step, batch, tag = _pallas_ab_trainer(model, on_accel,
+                                                  spec)
+        sides[mode] = {'pt': pt, 'step': step, 'batch': batch,
+                       'tag': tag}
+    times = {'off': [], 'on': []}
+    for _ in range(reps):
+        for mode, side in sides.items():
+            times[mode].append(
+                _measure(side['step'], warmup, iters, nd))
+    rec = {
+        'metric': metric,
+        'unit': 'x',
+        'pallas': families,
+        'model': sides['off']['tag'],
+        'platform': jax.default_backend(),
+        # interpreter-mode numbers are honest but not the acceptance
+        # signal — the chip run is (docs/PERFORMANCE.md)
+        'kernel_path': 'mosaic' if jax.default_backend() == 'tpu'
+        else 'interpreter',
+    }
+    rates = {}
+    for mode, side in sides.items():
+        rate = side['batch'] / min(times[mode])
+        rates[mode] = rate
+        rec['steps_per_sec_%s' % mode] = round(rate / side['batch'],
+                                               3)
+        try:
+            totals = roofline.analyze(side['pt'].compiled_text())[1]
+            rec['hbm_bytes_per_step_%s' % mode] = \
+                totals['hbm_bytes_per_step']
+        except Exception:
+            rec['hbm_bytes_per_step_%s' % mode] = None
+    rec['value'] = round(rates['on'] / rates['off'], 3) \
+        if rates['off'] else None
+    if rec.get('hbm_bytes_per_step_off') and \
+            rec.get('hbm_bytes_per_step_on'):
+        rec['hbm_bytes_delta'] = rec['hbm_bytes_per_step_on'] \
+            - rec['hbm_bytes_per_step_off']
+    noise = 100.0 * max(
+        (max(ts) - min(ts)) / min(ts) for ts in times.values())
+    rec['noise_pct'] = round(noise, 2)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def bench_flash_attention(on_accel):
+    """BERT step with flash attention (+ the fused loss head it
+    composes with) off vs on — the attention clusters are the BERT
+    audit's top byte movers."""
+    return _bench_pallas_ab(on_accel, 'bert', 'attention,xent',
+                            'flash_attention_speedup')
+
+
+def bench_fused_epilogue(on_accel):
+    """ResNet step with the fused BN/activation/residual epilogues
+    off vs on — the post-conv elementwise chains the ResNet audit
+    ranks."""
+    return _bench_pallas_ab(on_accel, 'resnet', 'epilogue,xent',
+                            'fused_epilogue_speedup')
+
+
 def _amp_ab_trainer(model, on_accel, amp):
     """Build one side of the AMP A/B (docs/PRECISION.md): the SAME
     fp32 net, optimizer, seeds, and data for both modes — only the
@@ -831,6 +921,16 @@ def main(argv=None):
             error = '%s: %s' % (type(e).__name__, str(e)[:300])
             print('bench: amp A/B leg lost to a transient fault (%s)'
                   % error, flush=True)
+    if not handler.stop_requested:
+        try:
+            metrics.append(bench_fused_epilogue(on_accel))
+        except Exception as e:
+            if not (isinstance(e, InjectedFault) or is_transient(e)):
+                raise
+            verdict = 'degraded'
+            error = '%s: %s' % (type(e).__name__, str(e)[:300])
+            print('bench: fused-epilogue A/B leg lost to a transient '
+                  'fault (%s)' % error, flush=True)
 
     if handler.stop_requested:
         # preempted mid-bench: the legs already measured stay in the

@@ -6,20 +6,26 @@ line — including the 'guardrail': on|off label driven by
 MXNET_TPU_GUARDRAIL) so the two entries can never report different
 methodologies, plus the BERT AMP A/B leg (amp off vs the bf16 policy
 over the same fp32 model; samples/s + per-precision mfu_pct —
-docs/PRECISION.md). Runs under the degraded-mode contract
+docs/PRECISION.md) and the flash-attention A/B leg (MXNET_TPU_PALLAS
+off vs on over the same model; interleaved min-of-reps slope timing
+with per-side roofline bytes — docs/PERFORMANCE.md "Hand-written
+kernels"; the CPU rig records interpreter-mode numbers, chip
+acceptance is bytes/step down on the audit-ranked attention
+clusters). Runs under the degraded-mode contract
 (docs/RESILIENCE.md): writes BENCH_BERT.json with "status": ok |
 degraded | unavailable and exits 0 on a dead or degraded backend.
 """
 
 
 def main():
-    from bench import bench_amp, bench_bert
+    from bench import bench_amp, bench_bert, bench_flash_attention
     from mxnet_tpu.resilience import run_instrument
     return run_instrument(
         'bench_bert',
         lambda status: {'metrics': [
             bench_bert(status.state == 'tpu'),
-            bench_amp(status.state == 'tpu', model='bert')]},
+            bench_amp(status.state == 'tpu', model='bert'),
+            bench_flash_attention(status.state == 'tpu')]},
         out='BENCH_BERT.json')
 
 

@@ -52,6 +52,9 @@ __all__ = ['Policy', 'resolve', 'scope', 'current_policy',
 CAST_COMPUTE_OPS = frozenset((
     'FullyConnected', 'Convolution', 'Deconvolution', 'RNN',
     'dot', 'batch_dot', 'linalg_gemm', 'linalg_gemm2',
+    # the flash-attention Pallas kernel is MXU work: bf16 inputs are
+    # fine because the kernel accumulates in f32 internally
+    '_contrib_flash_attention',
 ))
 
 # Value-range / accumulation-sensitive ops: inputs widen to float32.
@@ -69,6 +72,9 @@ KEEP_FP32_OPS = frozenset((
     'MAERegressionOutput', 'MakeLoss', 'CTCLoss', 'ctc_loss',
     'sum', 'mean', 'nansum', 'nanmean', 'norm', 'moments',
     'L2Normalization',
+    # fused softmax+xent kernel: a loss head — widen like the rest
+    # (the kernel also accumulates in f32 internally regardless)
+    '_contrib_fused_softmax_xent',
 ))
 
 _LOW = ('float16', 'bfloat16')

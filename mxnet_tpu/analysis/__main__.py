@@ -180,6 +180,20 @@ ENTRY %main.1 (p0: f32[8,8], p1: bf16[8,8]) -> f32[8,8] {
 }
 '''
 
+# synthetic TPU-style dump carrying one Mosaic (Pallas) kernel
+# custom-call — how a flash-attention kernel appears in real TPU HLO
+_PALLAS_HLO = '''\
+HloModule jit_step, is_scheduled=true
+
+ENTRY %main.1 (p0: f32[8,16,8], p1: f32[8,16,8], p2: f32[8,16,8]) -> f32[8,16,8] {
+  %p0 = f32[8,16,8]{2,1,0} parameter(0)
+  %p1 = f32[8,16,8]{2,1,0} parameter(1)
+  %p2 = f32[8,16,8]{2,1,0} parameter(2)
+  %custom-call.1 = f32[8,16,8]{2,1,0} custom-call(f32[8,16,8]{2,1,0} %p0, f32[8,16,8]{2,1,0} %p1, f32[8,16,8]{2,1,0} %p2), custom_call_target="tpu_custom_call", metadata={op_name="jit(step)/pallas_call[name=mxnet_tpu_flash_attention_fwd]" source_file="attention.py" source_line=120}
+  ROOT %add.2 = f32[8,16,8]{2,1,0} add(f32[8,16,8]{2,1,0} %custom-call.1, f32[8,16,8]{2,1,0} %p0)
+}
+'''
+
 
 def _selftest():
     """The lint must catch the bad fixtures and pass the good ones."""
@@ -241,6 +255,35 @@ def _selftest():
         if want not in rules:
             failures.append('hlolint selftest: %s did not fire on '
                             'the bad fixture' % want)
+
+    # HLO-PALLAS rules: the synthetic TPU dump carries one flash-
+    # attention kernel custom-call
+    fs = hlolint.check(_PALLAS_HLO, {'pallas': ['attention'],
+                                     'platform': 'tpu',
+                                     'no_outfeed': True},
+                       program='selftest-pallas')
+    if fs:
+        failures.append('hlolint selftest: false positives on the '
+                        'pallas-on fixture: %r' % fs)
+    fs = hlolint.check(_PALLAS_HLO, {'pallas': [], 'platform': 'tpu',
+                                     'no_outfeed': True},
+                       program='selftest-pallas')
+    if 'HLO-PALLAS-UNEXPECTED' not in {f.rule for f in fs}:
+        failures.append('hlolint selftest: HLO-PALLAS-UNEXPECTED did '
+                        'not fire on a knob-off expectation')
+    fs = hlolint.check(_PALLAS_HLO, {'pallas': ['attention', 'xent'],
+                                     'platform': 'tpu',
+                                     'no_outfeed': True},
+                       program='selftest-pallas')
+    if 'HLO-PALLAS-MISSING' not in {f.rule for f in fs}:
+        failures.append('hlolint selftest: HLO-PALLAS-MISSING did '
+                        'not fire for the absent xent family')
+    fs = hlolint.check(_BAD_HLO, {'pallas': ['attention'],
+                                  'platform': 'cpu'},
+                       program='selftest-pallas-cpu')
+    if any(f.rule == 'HLO-PALLAS-MISSING' for f in fs):
+        failures.append('hlolint selftest: HLO-PALLAS-MISSING must '
+                        'not fire on a CPU (interpreter-mode) dump')
     return failures
 
 

@@ -26,7 +26,9 @@ reads — one shared instruction iterator,
 ``check(hlo_text, expect)`` returns :class:`~mxnet_tpu.analysis.Finding`
 records; ``expect`` keys: ``amp`` ('bf16'|'fp16'|'off'), ``dp`` (int),
 ``zero`` (bool), ``donation`` (bool), ``platform`` ('cpu'|'tpu'),
-``no_outfeed`` (bool, default True). Absent keys skip their rules.
+``no_outfeed`` (bool, default True), ``pallas`` (list of kernel
+families that must appear as Mosaic custom-calls in a TPU dump — [] =
+none may appear; None/absent skips). Absent keys skip their rules.
 ``registry.expect_from_config`` maps a committed fusion-audit config
 block (FUSION_BASELINE.json) to an expect dict so the verifier runs
 against the exact programs the fusion gate audits.
@@ -150,5 +152,40 @@ def check(hlo_text, expect, program='program'):
                     '%s in a step program — the compiled step must '
                     'not transfer to the host mid-step' % i.opcode,
                     instr=i.name))
+
+    if expect.get('pallas') is not None:
+        # MXNET_TPU_PALLAS invariants (docs/PERFORMANCE.md): Mosaic
+        # kernels are custom-calls in TPU HLO, so a TPU dump must
+        # carry the enabled families' kernel calls (a silent fallback
+        # to the XLA path leaves the knob claiming speed it does not
+        # deliver) and a knob-off program must carry none. On the CPU
+        # rig the interpreter inlines kernels — no custom-call — so
+        # the presence rule is TPU-only; the absence rule runs
+        # everywhere.
+        from ..ops.pallas.costs import KERNEL_TAGS
+        wanted = tuple(expect['pallas'] or ())
+        present = {}
+        for i in bases.get('custom-call', ()):
+            for family, tags in KERNEL_TAGS.items():
+                if any(t in i.line for t in tags):
+                    present.setdefault(family, []).append(i)
+        if platform != 'cpu':
+            for family in wanted:
+                if family not in present:
+                    findings.append(_finding(
+                        'HLO-PALLAS-MISSING', program,
+                        "pallas family '%s' is enabled but no %s "
+                        'kernel custom-call is present — the program '
+                        'silently fell back to the XLA path '
+                        '(docs/PERFORMANCE.md fallback rules)'
+                        % (family, family)))
+        for family, calls in sorted(present.items()):
+            if family not in wanted:
+                findings.append(_finding(
+                    'HLO-PALLAS-UNEXPECTED', program,
+                    "pallas family '%s' kernel custom-call present "
+                    'but the family is not enabled — a knob-off '
+                    'program must be byte-identical to the pre-'
+                    'kernel build' % family, instr=calls[0].name))
 
     return findings

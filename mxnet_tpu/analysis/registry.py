@@ -140,11 +140,34 @@ FUTURE_CALLBACK_METHODS = frozenset(('set_result', 'set_exception'))
 # -- hlolint expectations ---------------------------------------------------
 
 
+def _pallas_families_for(config):
+    """Kernel families a program built under this config must carry:
+    the enabled MXNET_TPU_PALLAS families intersected with what the
+    model actually uses (a ResNet step has no attention to kernelize;
+    enabling the family must not make its absence a finding)."""
+    from ..ops.pallas import parse_spec
+    enabled = parse_spec(config.get('pallas'))
+    model = str(config.get('model') or '')
+    if 'decode' in model:
+        # inference decode step: attention only — no BN/relu epilogue
+        # and no loss head exist in the program to kernelize
+        relevant = ('attention',)
+    elif 'resnet' in model or 'cnn' in model:
+        relevant = ('epilogue', 'xent')
+    elif 'bert' in model or 'transformer' in model:
+        # attention blocks + the pooler's Activation (epilogue) + the
+        # pretrain loss head (xent)
+        relevant = ('attention', 'epilogue', 'xent')
+    else:
+        relevant = enabled
+    return tuple(k for k in enabled if k in relevant)
+
+
 def expect_from_config(config, platform=None):
     """Map a ``mxnet_tpu.fusion.v1`` artifact ``config`` block (as
-    committed in FUSION_BASELINE.json: amp / mesh / zero / platform)
-    to an hlolint ``expect`` dict, so the verifier can run against the
-    same programs the fusion audit gates."""
+    committed in FUSION_BASELINE.json: amp / mesh / zero / pallas /
+    platform) to an hlolint ``expect`` dict, so the verifier can run
+    against the same programs the fusion audit gates."""
     mesh = config.get('mesh') or {}
     dp = int(mesh.get('dp', 1) or 1)
     amp = config.get('amp') or 'off'
@@ -155,4 +178,5 @@ def expect_from_config(config, platform=None):
         'donation': True,
         'platform': platform or config.get('platform'),
         'no_outfeed': True,
+        'pallas': _pallas_families_for(config),
     }

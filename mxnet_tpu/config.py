@@ -352,6 +352,16 @@ KNOBS = {k.name: k for k in [
     _knob('MXNET_TPU_FUSION_BUDGET_COUNT', int, 0,
           'Extra fusions (beyond the baseline count) the fusion-budget'
           ' gate tolerates before failing.'),
+    _knob('MXNET_TPU_PALLAS', str, None,
+          'Hand-written Pallas kernels for the audit-ranked memory-'
+          'bound clusters (docs/PERFORMANCE.md "Hand-written'
+          ' kernels"): comma list of families out of'
+          ' attention,epilogue,xent (1 = all, 0/unset = off). Build-'
+          'time knob snapshotted through ops.traceknobs and folded'
+          ' into jit cache keys, so flips re-jit instead of latching.'
+          ' Kernels Mosaic-compile on TPU and run through the Pallas'
+          ' interpreter everywhere else; knob-off programs are byte-'
+          'identical to pre-kernel builds.'),
     _knob('MXNET_TPU_VJP_RESCHEDULE', bool, True,
           'Use the hand-scheduled custom_vjp paths for the memory-'
           'bound hot ops (Activation/LeakyReLU save-output backward,'

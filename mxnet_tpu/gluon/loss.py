@@ -22,6 +22,13 @@ __all__ = ['Loss', 'L2Loss', 'L1Loss', 'SigmoidBinaryCrossEntropyLoss',
            'PoissonNLLLoss', 'CosineEmbeddingLoss']
 
 
+def _pallas_xent_on():
+    """Fused softmax+cross-entropy kernel gate (MXNET_TPU_PALLAS=xent,
+    snapshot-first — see ops/pallas/__init__.py)."""
+    from ..ops.pallas import enabled
+    return enabled('xent')
+
+
 def _match_shape(F, arr, like):
     """View ``arr`` with ``like``'s shape (labels arrive as (B,) or
     (B,1) interchangeably; reference _reshape_like)."""
@@ -136,6 +143,13 @@ class SoftmaxCrossEntropyLoss(Loss):
             axis, sparse_label, from_logits)
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if self._sparse_label and not self._from_logits \
+                and self._axis in (-1, None) and _pallas_xent_on():
+            # fused softmax+xent head: ONE pass over the logits (max /
+            # exp-sum / label pick in VMEM) with the saved-log-probs
+            # vjp — docs/PERFORMANCE.md "Hand-written kernels"
+            nll = F._contrib_fused_softmax_xent(pred, label)
+            return self._reduce(F, nll, sample_weight)
         logp = pred if self._from_logits \
             else F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:

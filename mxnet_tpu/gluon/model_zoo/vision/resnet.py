@@ -87,7 +87,13 @@ class _ResidualCell(HybridBlock):
                 x = conv(x)
             return x + residual
         residual = x if self.downsample is None else self.downsample(x)
-        return F.relu(self.body(x) + residual)
+        x = self.body(x)
+        from ....ops.pallas import enabled as _pallas_on
+        if _pallas_on('epilogue'):
+            # fused residual-add + relu epilogue: one VMEM pass with
+            # the save-output backward (docs/PERFORMANCE.md)
+            return F._contrib_add_relu(x, residual)
+        return F.relu(x + residual)
 
 
 def _pin(bottleneck, preact):

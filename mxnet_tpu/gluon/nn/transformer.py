@@ -33,6 +33,15 @@ def _masked_scores(F, scores, mask):
     return F.broadcast_add(scores, neg)
 
 
+def _flash_on():
+    """Flash-attention gate (MXNET_TPU_PALLAS=attention, snapshot-
+    first — docs/PERFORMANCE.md "Hand-written kernels"). Block-level
+    because the flash path moves the attention-probability dropout to
+    the context output (the probability matrix never materializes)."""
+    from ...ops.pallas import enabled
+    return enabled('attention')
+
+
 class MultiHeadAttention(HybridBlock):
     """Multi-head scaled dot-product attention.
 
@@ -87,6 +96,28 @@ class MultiHeadAttention(HybridBlock):
             q = self.q_proj(query)
             kv = self.kv_proj(memory)
             k, v = F.split(kv, num_outputs=2, axis=-1)
+        # flash path: self-attention, and the mask — if any — must be
+        # the flash-native 1-D valid-lengths form (TransformerEncoder
+        # passes it through when the kernel is on). A DENSE (B, Sq,
+        # Sk) mask keeps the reference path even knob-on: the kernel's
+        # per-key bias cannot represent arbitrary per-query masks, and
+        # silently mis-masking is worse than missing the kernel.
+        if _flash_on() and memory is None and \
+                (mask is None or getattr(mask, 'ndim', None) == 1):
+            # blockwise online-softmax kernel: the (Sq, Sk) scores
+            # stay in VMEM. Divergence from the reference path: the
+            # attention dropout applies to the context output instead
+            # of the probability matrix (which never materializes) —
+            # docs/PERFORMANCE.md "Hand-written kernels".
+            qh = self._split_heads(F, q)
+            kh = self._split_heads(F, k)
+            vh = self._split_heads(F, v)
+            inputs = [qh, kh, vh] if mask is None else [qh, kh, vh,
+                                                        mask]
+            ctx = F._contrib_flash_attention(
+                *inputs, num_heads=self._num_heads)
+            ctx = self.attn_dropout(ctx)
+            return self.out_proj(self._merge_heads(F, ctx))
         scale = 1.0 / math.sqrt(self._units // self._num_heads)
         q = self._split_heads(F, q) * scale
         k = self._split_heads(F, k)
@@ -179,7 +210,17 @@ class TransformerEncoder(HybridBlock):
     def hybrid_forward(self, F, x, valid_length=None):
         mask = None
         if valid_length is not None:
-            mask = self.make_mask(F, x, valid_length)
+            # flash-native form: pass the 1-D lengths straight through
+            # (the kernel carries a per-key bias; no need to
+            # materialize the (B, S, S) mask it would re-derive).
+            # Array frontends only — a Symbol has no ndim, so the
+            # attention gate could not tell lengths from a dense mask;
+            # symbolic composition keeps the reference path (exact,
+            # just unkernelized)
+            mask = valid_length if (
+                _flash_on()
+                and getattr(valid_length, 'ndim', None) == 1) \
+                else self.make_mask(F, x, valid_length)
         for cell in self.cells:
             x = cell(x, mask)
         return x

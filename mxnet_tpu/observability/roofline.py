@@ -39,7 +39,8 @@ from .hlo import (DTYPE_BYTES, collective_bytes, iter_instruction_lines,
 
 __all__ = ['SCHEMA', 'Instruction', 'parse_module', 'analyze',
            'roofline_artifact', 'diff_artifacts', 'format_table',
-           'reference_machine', 'program_precision']
+           'reference_machine', 'program_precision',
+           'CUSTOM_CALL_COSTS', 'register_custom_call_cost']
 
 SCHEMA = 'mxnet_tpu.fusion.v1'
 
@@ -204,6 +205,52 @@ def parse_module(hlo_text):
     if entry is None and comps:       # headerless fragment: last wins
         entry = next(reversed(comps))
     return comps, entry
+
+
+# -- custom-call (hand-written kernel) cost registry ------------------------
+#
+# Mosaic/Pallas kernels appear as custom-call instructions in TPU HLO:
+# operand/result bytes read off the shapes, but the text carries no
+# flop count — so without a registered cost a kernelized program would
+# misread as MORE memory-bound than the fusion chain it replaced.
+# Kernels register a flop model per call-target tag (matched as a
+# substring of the instruction's metadata op_name / attribute text);
+# matched custom-calls are then attributed like fusions. Unmatched
+# custom-calls stay free (sharding/bookkeeping custom-calls move no
+# accountable bytes), keeping knob-off artifacts byte-identical.
+
+CUSTOM_CALL_COSTS = {}
+_default_costs_loaded = False
+
+
+def register_custom_call_cost(tag, flops_fn):
+    """Register ``flops_fn(Instruction) -> flops`` for custom-calls
+    whose op_name/attrs contain ``tag``. Plugins with their own Pallas
+    kernels use this to stay visible in the audit."""
+    CUSTOM_CALL_COSTS[str(tag)] = flops_fn
+
+
+def _ensure_default_costs():
+    global _default_costs_loaded
+    if _default_costs_loaded:
+        return
+    _default_costs_loaded = True
+    from ..ops.pallas import costs as _costs
+    _costs.register_all(CUSTOM_CALL_COSTS)
+
+
+def custom_call_flops(instr):
+    """Registered flops for a custom-call instruction, or None when no
+    cost entry matches (the instruction then stays cost-free)."""
+    _ensure_default_costs()
+    hay = '%s %s' % (instr.op_name or '', instr.attrs)
+    for tag, fn in CUSTOM_CALL_COSTS.items():
+        if tag in hay:
+            try:
+                return float(fn(instr))
+            except Exception:
+                return 0.0
+    return None
 
 
 # -- flop model -------------------------------------------------------------
@@ -388,14 +435,21 @@ def analyze(hlo_text, machine=None):
             return
         visited.add(comp_name)
         for instr in comps.get(comp_name, ()):
-            if instr.opcode in _FREE_OPCODES:
+            kernel_flops = None
+            if instr.opcode == 'custom-call':
+                # hand-written (Pallas/Mosaic) kernels with a
+                # registered cost are material: operand+result bytes
+                # like a fusion, flops from the registry
+                kernel_flops = custom_call_flops(instr)
+            if instr.opcode in _FREE_OPCODES and kernel_flops is None:
                 continue
             if instr.opcode in ('while', 'call', 'conditional'):
                 for cname in instr.called:
                     walk(cname)
                 continue
             nbytes = instr.result_bytes + instr.operand_bytes
-            flops = _instr_flops(instr, comps)
+            flops = kernel_flops if kernel_flops is not None \
+                else _instr_flops(instr, comps)
             ai = flops / nbytes if nbytes else float('inf')
             bound = 'compute' if ai >= ridge else 'memory'
             totals['hbm_bytes_per_step'] += nbytes

@@ -341,6 +341,13 @@ def _vjp_resched():
     return bool(_cfg('MXNET_TPU_VJP_RESCHEDULE'))
 
 
+def _pallas_on(kind):
+    """Pallas kernel-family gate (MXNET_TPU_PALLAS): snapshot-first
+    like :func:`_vjp_resched` — see ops/pallas/__init__.py."""
+    from .pallas import enabled
+    return enabled(kind)
+
+
 def _zero_cotangent(x):
     """Symbolic-zero cotangent for a non-differentiable primal: float0
     for integer/bool inputs (jax's typed zero), zeros_like otherwise."""
@@ -431,6 +438,11 @@ _ACT_RESCHED = frozenset(('relu', 'sigmoid', 'tanh', 'softrelu',
 
 @register('Activation')
 def activation(data, *, act_type='relu'):
+    if act_type in _ACT_RESCHED and _pallas_on('epilogue'):
+        # kernelized _act_core twin: same forward expressions, same
+        # save-output residual, one VMEM pass each direction
+        from .pallas import fused_act
+        return fused_act(data, act_type)
     if act_type in _ACT_RESCHED and _vjp_resched():
         return _act_core(data, act_type, 0.0)
     fns = {'relu': jax.nn.relu, 'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh,
@@ -448,6 +460,9 @@ def leaky_relu(args, *, act_type='leaky', slope=0.25, lower_bound=0.125,
         # slope > 0 keeps sign(out) == sign(x), the invariant the
         # output-only backward needs; slope == 0 degenerates to relu's
         # rule but the reference allows it, so route it to autodiff
+        if slope > 0 and _pallas_on('epilogue'):
+            from .pallas import fused_act
+            return fused_act(data, 'leaky', float(slope))
         if resched and slope > 0:
             return _act_core(data, 'leaky', float(slope))
         return jnp.where(data >= 0, data, slope * data)
@@ -470,6 +485,84 @@ def leaky_relu(args, *, act_type='leaky', slope=0.25, lower_bound=0.125,
     if act_type == 'gelu':
         return jax.nn.gelu(data, approximate=False)
     raise ValueError('unknown act_type %s' % act_type)
+
+
+@register('_contrib_add_relu', num_inputs=2)
+def add_relu(data, residual):
+    """``relu(x + y)`` — the ResNet v1 residual join. One fused VMEM
+    pass (add + relu with the save-output backward) when the
+    ``epilogue`` Pallas family is enabled; the reference elementwise
+    spelling otherwise (identical to ``F.relu(x + y)``). The kernel
+    requires same-shape operands (it flattens both); broadcasting
+    calls keep the reference path in every knob state."""
+    if _pallas_on('epilogue') and data.shape == residual.shape:
+        from .pallas import fused_add_act
+        return fused_add_act(data, residual, 'relu')
+    return jax.nn.relu(data + residual)
+
+
+@register('_contrib_flash_attention', num_inputs=-1)
+def flash_attention_op(args, *, num_heads, causal=False, scale=None):
+    """Multi-head attention core over head-split arrays:
+    args = [q, k, v(, mask)] with q (B*H, Sq, D), k/v (B*H, Sk, D).
+    ``mask`` is either valid key LENGTHS (B,) int — the flash-native
+    form — or a dense 1/0 mask (B, Sq, Sk) / (B*H, Sq, Sk). Returns
+    (B*H, Sq, D).
+
+    With the ``attention`` Pallas family enabled, mask-free, lengths-
+    masked, and causal calls run the blockwise online-softmax kernel
+    (the (Sq, Sk) scores never reach HBM). A DENSE mask always takes
+    the unfused reference path even with the knob on: the kernel's
+    bias is per-key, so an arbitrary per-query mask (e.g. a hand-
+    rolled causal triangle — use the ``causal`` attr instead) cannot
+    be represented and silently mis-masking is worse than missing the
+    kernel (docs/PERFORMANCE.md fallback rules). NOTE: no attention-
+    probability dropout in either path — callers that drop attention
+    weights gate at the block level.
+    """
+    q, k, v = args[0], args[1], args[2]
+    mask = args[3] if len(args) > 3 else None
+    h = int(num_heads)
+    # symbol-json round trips stringify attrs
+    causal = causal not in (False, 0, None, 'False', 'false', '0')
+    bh, sq, d = q.shape
+    b = bh // h
+    sk = k.shape[1]
+    if scale is None or scale in ('None', 'none'):
+        scale = 1.0 / math.sqrt(d)
+    lengths = None
+    if mask is not None and mask.ndim == 1:
+        lengths, mask = mask.astype(jnp.int32), None
+        if lengths.shape[0] != b:
+            raise ValueError(
+                '_contrib_flash_attention: lengths batch %d != B=%d'
+                % (lengths.shape[0], b))
+    if mask is not None and mask.shape[0] not in (b, bh):
+        raise ValueError(
+            '_contrib_flash_attention: mask batch %d matches neither '
+            'B=%d nor B*H=%d' % (mask.shape[0], b, bh))
+    if _pallas_on('attention') and mask is None:
+        from .pallas import flash_attention as _fa
+        out = _fa(q.reshape(b, h, sq, d), k.reshape(b, h, sk, d),
+                  v.reshape(b, h, sk, d), lengths=lengths,
+                  causal=bool(causal), scale=float(scale))
+        return out.reshape(bh, sq, d)
+    scores = jnp.einsum('bqd,bkd->bqk', q * scale, k)
+    if lengths is not None:
+        valid = jnp.arange(sk)[None, :] < lengths[:, None]   # (B, Sk)
+        neg = jnp.where(valid, 0.0, -1e9)[:, None, :]
+        scores = scores + jnp.repeat(neg, h, axis=0)
+    elif mask is not None:
+        neg = (1.0 - mask) * -1e9
+        if mask.shape[0] == b:
+            neg = jnp.repeat(neg, h, axis=0)           # (B*H, Sq, Sk)
+        scores = scores + neg
+    if causal:
+        ar = jnp.arange(sq)
+        scores = scores + jnp.where(
+            ar[:, None] >= jnp.arange(sk)[None, :], 0.0, -1e9)
+    att = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('bqk,bkd->bqd', att, v)
 
 
 @register('softmax')
@@ -654,9 +747,33 @@ _softmax_xent_core.defvjp(_sxe_fwd, _sxe_bwd)
 
 @register('softmax_cross_entropy', num_inputs=2)
 def softmax_cross_entropy(data, label):
+    if _pallas_on('xent'):
+        # one fused pass over the logits (max/exp-sum/label pick in
+        # VMEM), composing with the saved-log-probs vjp contract
+        from .pallas import fused_softmax_xent_rows
+        return fused_softmax_xent_rows(data, label).sum()
     if _vjp_resched():
         return _softmax_xent_core(data, label)
     return _sxe_forward(data, label)[0]
+
+
+@register('_contrib_fused_softmax_xent', num_inputs=2)
+def fused_softmax_xent(pred, label):
+    """Per-row softmax cross-entropy head: (..., V) logits + (...)
+    int labels -> (..., 1) nll. One fused Pallas pass over the logits
+    when the ``xent`` kernel family is enabled; otherwise the
+    reference log_softmax + pick spelling (what
+    ``gluon.loss.SoftmaxCrossEntropyLoss`` lowers to today)."""
+    v = pred.shape[-1]
+    lead = pred.shape[:-1]
+    if _pallas_on('xent'):
+        from .pallas import fused_softmax_xent_rows
+        nll = fused_softmax_xent_rows(pred.reshape(-1, v),
+                                      label.reshape(-1))
+        return nll.reshape(lead + (1,)).astype(pred.dtype)
+    logp = jax.nn.log_softmax(pred, axis=-1)
+    lab = label.astype(jnp.int32).reshape(lead + (1,))
+    return -jnp.take_along_axis(logp, lab, axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -703,6 +820,14 @@ def _bn_train_fwd_impl(data, g, beta, eps, ax):
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
     inv = jax.lax.rsqrt(var + eps)
+    if _pallas_on('epilogue'):
+        # training-forward normalize epilogue as one VMEM pass: the
+        # fused reduction above still produces the statistics; only
+        # the activation-sized affine apply moves into the kernel
+        from .pallas import fused_bn_apply
+        out = fused_bn_apply(xf, inv * g.astype(jnp.float32), mean,
+                             beta.astype(jnp.float32), axis=ax)
+        return out.astype(data.dtype), mean, var, (mean, inv, m_count)
     out = ((xf - mean.reshape(shape)) * (inv * g.astype(jnp.float32))
            .reshape(shape) + beta.astype(jnp.float32).reshape(shape))
     return out.astype(data.dtype), mean, var, (mean, inv, m_count)
@@ -777,6 +902,16 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
     mean, var = moving_mean, moving_var
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
+    if _pallas_on('epilogue'):
+        # inference-apply epilogue in one VMEM pass: statistics fold
+        # into a per-channel affine (scale, shift) on the host side of
+        # the kernel
+        from .pallas import fused_bn_apply
+        scale = (jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+                 * g.astype(jnp.float32))
+        out = fused_bn_apply(data, scale, mean.astype(jnp.float32),
+                             beta.astype(jnp.float32), axis=ax)
+        return out.astype(data.dtype), mean, var
     inv = jax.lax.rsqrt(var + eps).reshape(shape)
     out = (data - mean.reshape(shape)) * inv * g.reshape(shape) + beta.reshape(shape)
     return out.astype(data.dtype), mean, var

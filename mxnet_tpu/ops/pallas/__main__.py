@@ -1,0 +1,292 @@
+"""Pallas kernel selftest — the ``kernels`` CI stage.
+
+Runs every kernel family through the interpreter (the same kernel
+logic Mosaic compiles on TPU) against its reference XLA math, forward
+AND backward, at the documented equivalence tiers
+(docs/PERFORMANCE.md "Hand-written kernels"):
+
+  * exact (bitwise): relu/leaky/add+relu epilogues, BN-apply forward
+    against the expression-identical XLA spelling;
+  * ULP tier (~1e-6 on O(1) values): transcendental activations, the
+    fused xent head (same math, different rounding order);
+  * reduction tier (~1e-5): flash attention (the online-softmax
+    reduction tree legitimately rounds differently than the two-pass
+    softmax it replaces).
+
+Also proves the decode-engine composition: cached prefill+step token
+streams with flash attention ON match the knob-on whole-sequence
+reference bit-for-bit (the K_BLOCK alignment argument).
+
+Usage: python -m mxnet_tpu.ops.pallas [--out SELFTEST.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _check(name, fn, failures, results):
+    try:
+        detail = fn()
+        results.append({'check': name, 'ok': True,
+                        'detail': detail or {}})
+        print('  ok   %s %s' % (name, detail or ''))
+    except Exception as e:            # noqa: BLE001 - report, not die
+        failures.append(name)
+        results.append({'check': name, 'ok': False,
+                        'error': '%s: %s' % (type(e).__name__, e)})
+        print('  FAIL %s: %s: %s' % (name, type(e).__name__, e))
+
+
+def run_selftest(out=None):
+    import numpy as onp
+    import jax
+    import jax.numpy as jnp
+    from . import (flash_attention, flash_decode_attention, fused_act,
+                   fused_add_act, fused_bn_apply,
+                   fused_softmax_xent_rows)
+
+    rs = onp.random.RandomState(0)
+    failures, results = [], []
+    ULP, RED = 2e-6, 2e-5
+
+    def amax(a, b):
+        return float(jnp.abs(jnp.asarray(a) - jnp.asarray(b)).max())
+
+    # -- flash attention -----------------------------------------------------
+    B, H, S, D = 2, 4, 20, 8
+    q = jnp.asarray(rs.randn(B, H, S, D).astype('float32'))
+    k = jnp.asarray(rs.randn(B, H, S, D).astype('float32'))
+    v = jnp.asarray(rs.randn(B, H, S, D).astype('float32'))
+    w = jnp.asarray(rs.randn(B, H, S, D).astype('float32'))
+    lengths = jnp.asarray([14, 20], 'int32')
+
+    def attn_ref(q, k, v):
+        s = jnp.einsum('bhqd,bhkd->bhqk', q, k) / jnp.sqrt(float(D))
+        s = jnp.where((jnp.arange(S)[None, :]
+                       < lengths[:, None])[:, None, None, :], s, -1e9)
+        s = jnp.where(jnp.arange(S)[:, None]
+                      >= jnp.arange(S)[None, :], s, -1e9)
+        return jnp.einsum('bhqk,bhkd->bhqd', jax.nn.softmax(s, -1), v)
+
+    def check_attn():
+        out = flash_attention(q, k, v, lengths=lengths, causal=True)
+        err = amax(out, attn_ref(q, k, v))
+        assert err < RED, 'forward err %g' % err
+        g1 = jax.grad(lambda *a: (flash_attention(
+            *a, lengths=lengths, causal=True) * w).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: (attn_ref(*a) * w).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        gerr = max(amax(a, b) for a, b in zip(g1, g2))
+        assert gerr < RED, 'grad err %g' % gerr
+        return {'fwd_err': err, 'grad_err': gerr, 'tier': 'reduction'}
+    _check('flash_attention fwd+grad vs dense softmax', check_attn,
+           failures, results)
+
+    # bf16 in, f32 accumulation (AMP composition): compare against
+    # the f32 reference over the SAME bf16-quantized inputs, so the
+    # check isolates the kernel's accumulation quality from the
+    # input quantization it cannot control
+    def check_attn_bf16():
+        qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+        ob = flash_attention(qb, kb, vb, lengths=lengths)
+        assert ob.dtype == jnp.bfloat16, ob.dtype
+        ref = flash_attention(qb.astype(jnp.float32),
+                              kb.astype(jnp.float32),
+                              vb.astype(jnp.float32), lengths=lengths)
+        err = amax(ob.astype(jnp.float32), ref)
+        assert err < 0.02, 'bf16 err %g' % err     # bf16 output tier
+        return {'err': err, 'dtype': str(ob.dtype)}
+    _check('flash_attention bf16 in / f32 accumulate', check_attn_bf16,
+           failures, results)
+
+    # -- decode step + bit-identity ------------------------------------------
+    def check_decode():
+        slots, L, U = 3, 40, H * D
+        ck = jnp.asarray(rs.randn(slots, L, U).astype('float32'))
+        cv = jnp.asarray(rs.randn(slots, L, U).astype('float32'))
+        qd = jnp.asarray(rs.randn(slots, U).astype('float32'))
+        pos = jnp.asarray([5, 0, 39], 'int32')
+        ctx = flash_decode_attention(qd, ck, cv, pos, heads=H)
+        kh = ck.reshape(slots, L, H, D)
+        vh = cv.reshape(slots, L, H, D)
+        qh = qd.reshape(slots, H, D)
+        s = jnp.einsum('shd,slhd->shl', qh, kh) / jnp.sqrt(float(D))
+        s = jnp.where(jnp.arange(L)[None, None, :]
+                      <= pos[:, None, None], s, -1e9)
+        ref = jnp.einsum('shl,slhd->shd', jax.nn.softmax(s, -1),
+                         vh).reshape(slots, U)
+        err = amax(ctx, ref)
+        assert err < RED, 'decode err %g' % err
+        return {'err': err}
+    _check('flash_decode_attention vs dense softmax', check_decode,
+           failures, results)
+
+    def check_decode_bit_identity():
+        from ... import config as _config
+        from ...serving.decode.model import init_transformer_lm
+        # restore the caller's resolved knob value, not the bare
+        # environment — library code may run the selftest mid-session
+        prev = _config.get('MXNET_TPU_PALLAS')
+        try:
+            _config.set('MXNET_TPU_PALLAS', 'attention')
+            model, params = init_transformer_lm(
+                vocab=17, units=16, hidden=24, layers=2, heads=4,
+                max_len=160)       # > K_BLOCK: exercises block walk
+            dev = {kk: jnp.asarray(vv) for kk, vv in params.items()}
+            prompt = [3, 7, 1]
+            # reference: re-run the whole sequence after every token
+            toks = list(prompt)
+            ref = []
+            for _ in range(5):
+                full = model.full_forward(
+                    dev, jnp.asarray([toks], 'int32'))
+                t = int(jnp.argmax(full[0, -1]))
+                ref.append(t)
+                toks.append(t)
+            # cached: prefill + steps through the slot cache
+            from ...serving.decode.cache import init_cache
+            cache = init_cache(model.cache_spec(), 1)
+            cache, logits = model.prefill(
+                dev, cache, jnp.asarray([prompt], 'int32'),
+                jnp.asarray(len(prompt), 'int32'),
+                jnp.asarray(0, 'int32'))
+            got = [int(jnp.argmax(logits))]
+            pos = len(prompt)
+            while len(got) < 5:
+                cache, logits = model.step(
+                    dev, cache, jnp.asarray([got[-1]], 'int32'),
+                    jnp.asarray([pos], 'int32'))
+                got.append(int(jnp.argmax(logits[0])))
+                pos += 1
+            assert got == ref, 'token streams differ: %r vs %r' \
+                % (got, ref)
+            return {'tokens': got}
+        finally:
+            _config.set('MXNET_TPU_PALLAS', prev)
+    _check('decode token-stream bit-identity (flash on)',
+           check_decode_bit_identity, failures, results)
+
+    # -- epilogues -----------------------------------------------------------
+    def check_bn():
+        x = jnp.asarray(rs.randn(4, 6, 5, 7).astype('float32'))
+        g = jnp.asarray((rs.rand(6) + 0.5).astype('float32'))
+        beta = jnp.asarray(rs.randn(6).astype('float32'))
+        mean = jnp.asarray(rs.randn(6).astype('float32'))
+        var = jnp.asarray((rs.rand(6) + 0.1).astype('float32'))
+        scale = jax.lax.rsqrt(var + 1e-3) * g
+        got = fused_bn_apply(x, scale, mean, beta, axis=1,
+                             act_type='relu')
+        sh = (1, -1, 1, 1)
+        want = jax.nn.relu((x - mean.reshape(sh)) * scale.reshape(sh)
+                           + beta.reshape(sh))
+        # expression-identical to the XLA spelling; XLA's freedom to
+        # FMA-fuse mul+add differently across two separately compiled
+        # programs bounds this at one ULP, not zero
+        err = amax(got, want)
+        assert err < ULP, 'bn apply fwd: %g' % err
+        ga = jax.grad(lambda x: fused_bn_apply(
+            x, scale, mean, beta, axis=1, act_type='relu').sum())(x)
+        gb = jax.grad(lambda x: jax.nn.relu(
+            (x - mean.reshape(sh)) * scale.reshape(sh)
+            + beta.reshape(sh)).sum())(x)
+        gerr = amax(ga, gb)
+        assert gerr < ULP, 'bn apply grad: %g' % gerr
+        return {'fwd_err': err, 'grad_err': gerr, 'tier': 'ulp'}
+    _check('fused_bn_apply fwd+grad vs XLA spelling', check_bn,
+           failures, results)
+
+    def check_acts():
+        x = jnp.asarray(rs.randn(5, 33).astype('float32'))
+        refs = {'relu': jax.nn.relu, 'sigmoid': jax.nn.sigmoid,
+                'tanh': jnp.tanh, 'softrelu': jax.nn.softplus,
+                'softsign': jax.nn.soft_sign}
+        worst = 0.0
+        for act, ref in refs.items():
+            err = amax(fused_act(x, act), ref(x))
+            gerr = amax(
+                jax.grad(lambda x: fused_act(x, act).sum())(x),
+                jax.grad(lambda x: ref(x).sum())(x))
+            tol = 0.0 if act == 'relu' else ULP
+            assert err <= tol and gerr <= ULP, \
+                '%s err %g grad %g' % (act, err, gerr)
+            worst = max(worst, err, gerr)
+        return {'worst_err': worst}
+    _check('fused_act family fwd+grad', check_acts, failures, results)
+
+    def check_add_relu():
+        x = jnp.asarray(rs.randn(5, 33).astype('float32'))
+        y = jnp.asarray(rs.randn(5, 33).astype('float32'))
+        err = amax(fused_add_act(x, y), jax.nn.relu(x + y))
+        gx, gy = jax.grad(
+            lambda x, y: fused_add_act(x, y).sum(),
+            argnums=(0, 1))(x, y)
+        gr = jax.grad(lambda x, y: jax.nn.relu(x + y).sum())(x, y)
+        assert err == 0.0 and amax(gx, gr) == 0.0 \
+            and amax(gy, gr) == 0.0
+        return {'tier': 'exact'}
+    _check('fused_add_act bitwise vs relu(x+y)', check_add_relu,
+           failures, results)
+
+    # -- fused xent ----------------------------------------------------------
+    def check_xent():
+        logits = jnp.asarray(rs.randn(7, 33).astype('float32'))
+        labels = jnp.asarray(rs.randint(0, 33, (7,)))
+        nll = fused_softmax_xent_rows(logits, labels)
+        ref = -jnp.take_along_axis(jax.nn.log_softmax(logits, -1),
+                                   labels[:, None], axis=-1)[:, 0]
+        err = amax(nll, ref)
+        assert err < ULP, 'xent fwd %g' % err
+        gg = jax.grad(lambda x: fused_softmax_xent_rows(
+            x, labels).sum())(logits)
+        gr = jax.grad(lambda x: (-jnp.take_along_axis(
+            jax.nn.log_softmax(x, -1), labels[:, None],
+            axis=-1)).sum())(logits)
+        gerr = amax(gg, gr)
+        assert gerr < ULP, 'xent grad %g' % gerr
+        return {'fwd_err': err, 'grad_err': gerr, 'tier': 'ulp'}
+    _check('fused_softmax_xent fwd+grad vs log_softmax+pick',
+           check_xent, failures, results)
+
+    def check_xent_bf16():
+        logits = jnp.asarray(rs.randn(5, 21).astype('bfloat16'))
+        labels = jnp.asarray(rs.randint(0, 21, (5,)))
+        nll = fused_softmax_xent_rows(logits, labels)
+        assert nll.dtype == jnp.float32, nll.dtype    # f32 loss
+        g = jax.grad(lambda x: fused_softmax_xent_rows(
+            x, labels).sum())(logits)
+        assert g.dtype == jnp.bfloat16, g.dtype       # primal dtype
+        return {'loss_dtype': str(nll.dtype),
+                'grad_dtype': str(g.dtype)}
+    _check('fused_softmax_xent bf16 logits / f32 loss',
+           check_xent_bf16, failures, results)
+
+    status = 'ok' if not failures else 'fail'
+    payload = {'schema': 'mxnet_tpu.pallas_selftest.v1',
+               'status': status, 'failures': failures,
+               'checks': results}
+    if out:
+        with open(out, 'w') as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write('\n')
+        print('pallas selftest: wrote %s' % out)
+    print('pallas selftest: %s (%d checks, %d failed)'
+          % (status, len(results), len(failures)))
+    return payload
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='python -m mxnet_tpu.ops.pallas',
+        description=__doc__.split('\n\n')[0])
+    p.add_argument('--out', default=None,
+                   help='selftest artifact path (JSON)')
+    args = p.parse_args(argv)
+    payload = run_selftest(out=args.out)
+    return 0 if payload['status'] == 'ok' else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

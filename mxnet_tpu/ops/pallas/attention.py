@@ -1,0 +1,509 @@
+"""Blockwise online-softmax (flash) attention kernels.
+
+The roofline audit's #1 memory-bound cluster in the BERT step is the
+attention softmax chain: XLA materializes the (B*H, S, S) scores,
+exp/normalize, and attention-probability tensors through HBM between
+the two batched GEMMs. This kernel computes the whole chain per
+(batch*head, q-block) program with the scores resident in VMEM —
+the only HBM traffic is q/k/v in and the context out.
+
+The online-softmax math is the one already proven in
+``parallel/ring_attention.py`` (running max + normalizer with -inf
+masking and fully-masked-row guards); :func:`online_softmax_block` IS
+that math, factored here so the ring recipe's per-device inner block
+and this single-device VMEM kernel share one expression set — ring
+attention rotates K/V blocks over ICI, this kernel walks them through
+a VMEM loop.
+
+Bit-identity structure (the decode engine contract): the key axis is
+always processed in fixed blocks of ``K_BLOCK`` with padded/masked
+keys contributing exact 0.0 to every reduction (exp(-inf - m) == 0.0
+and x + 0.0 == x for finite x), so the padded-prefill pass, the
+whole-sequence reference pass, and the cached decode step combine
+identical reduction trees over the real keys — the same argument
+``serving/decode/model.py`` makes for padded prefill, extended to
+block boundaries. ``K_BLOCK`` must therefore stay the same across all
+three paths (it is module-level, not a tuning parameter).
+
+Backward is the standard flash recompute (dq / dkv kernels re-derive
+the probability blocks from the saved log-sum-exp rather than loading
+a stored attention matrix), wired through ``jax.custom_vjp``.
+
+VMEM residency bound: each program holds its q block plus the full
+per-head K/V rows (O(Sk * D) floats; the dkv pass symmetrically holds
+the q/o/do rows, O(Sq * D)), so the *scores* never materialize but
+K/V do — fine through Sk of a few thousand at D 64-128 against the
+~16 MB/core budget, NOT an arbitrary-length kernel. Sequences past
+that bound are the ring-attention recipe's job
+(``parallel/ring_attention.py``), whose per-device inner block is
+exactly this kernel's math over ICI-rotated K/V blocks; a manually
+DMA-pipelined K walk (double-buffered ``make_async_copy``) is the
+chip-side follow-up if single-device long-context ever needs it.
+
+All kernels accept bf16/fp16 inputs and accumulate in float32 (AMP
+composition); everything runs through the Pallas interpreter off-TPU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['flash_attention', 'flash_decode_attention',
+           'online_softmax_block', 'K_BLOCK']
+
+# fixed key-axis block: part of the bit-identity contract (see module
+# docstring) — every call path pads the key axis to a multiple of this
+# and walks it in these steps
+K_BLOCK = 128
+# query-axis block: free to vary per call (query rows are independent)
+_Q_BLOCK = 128
+_NEG_INF = float('-inf')
+
+
+def _cdiv(a, b):
+    return -(-a // b)
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = _cdiv(n, mult) * mult - n
+    if not pad:
+        return x
+    cfg = [(0, 0)] * x.ndim
+    cfg[axis] = (0, pad)
+    return jnp.pad(x, cfg)
+
+
+def online_softmax_block(scores, v_blk, m, l, o):
+    """One online-softmax accumulation step over a key block.
+
+    ``scores``: (..., q, k) float32 with masked entries at exactly
+    -inf; ``v_blk``: (..., k, d) float32; carries ``m`` (..., q) /
+    ``l`` (..., q) / ``o`` (..., q, d). Returns the updated carries.
+    Fully-masked rows stay (m=-inf, l=0, o=0) — the caller divides by
+    max(l, eps). This is the ring-attention body's math verbatim
+    (parallel/ring_attention.py); the ring rotates ``v_blk`` over ICI
+    where this module's kernels walk VMEM blocks.
+    """
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(jnp.where(jnp.isneginf(scores), _NEG_INF,
+                          scores - safe_m[..., None]))
+    corr = jnp.exp(jnp.where(jnp.isneginf(m), _NEG_INF, m - safe_m))
+    corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+    l_new = l * corr + p.sum(axis=-1)
+    batch = tuple(range(p.ndim - 2))
+    o_new = o * corr[..., None] + jax.lax.dot_general(
+        p, v_blk, (((p.ndim - 1,), (v_blk.ndim - 2,)), (batch, batch)),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def mxnet_tpu_flash_attention_fwd(q_ref, k_ref, v_ref, bias_ref,
+                                  o_ref, lse_ref, *, nk, scale, causal,
+                                  heads):
+    """One (batch*head, q-block) program: walk the key axis in
+    K_BLOCK steps with the (BQ, K_BLOCK) score tile in VMEM."""
+    del heads  # folded into the bias index_map; kept for cost readers
+    qb = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+    bq, d = qb.shape
+    qi = pl.program_id(1)
+    q_pos = qi * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, K_BLOCK), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * K_BLOCK, K_BLOCK), :].astype(
+            jnp.float32)
+        vb = v_ref[0, pl.ds(j * K_BLOCK, K_BLOCK), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s + bias_ref[0, pl.ds(j * K_BLOCK, K_BLOCK)][None, :]
+        if causal:
+            k_pos = j * K_BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, K_BLOCK), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        return online_softmax_block(s, vb, m, l, acc)
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(
+        o_ref.dtype)
+    safe_m = jnp.where(jnp.isneginf(m), 0.0, m)
+    lse_ref[0, :] = jnp.where(l > 0,
+                              safe_m + jnp.log(jnp.maximum(l, 1e-20)),
+                              _NEG_INF)
+
+
+def _fwd_call(q3, k3, v3, bias, *, heads, causal, scale, interpret):
+    """q3/k3/v3: (B*H, S*, D) padded; bias: (B, Sk_pad) f32 additive
+    (-inf = blocked key). Returns (out (B*H, Sq_pad, D), lse
+    (B*H, Sq_pad) f32)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    bq = min(_Q_BLOCK, sq)
+    nq, nk = sq // bq, sk // K_BLOCK
+    kern = functools.partial(mxnet_tpu_flash_attention_fwd, nk=nk,
+                             scale=scale, causal=causal, heads=heads)
+    h = heads
+    return pl.pallas_call(
+        kern,
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk), lambda b, i: (b // h, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, bias)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (flash recompute from the saved log-sum-exp)
+# ---------------------------------------------------------------------------
+
+
+def _p_block(qb, kb, bias_blk, lse, q_pos, k_pos, causal, scale):
+    """Recompute one probability block p = exp(s - lse) with masked
+    and fully-masked entries at exactly 0."""
+    s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = s + bias_blk[None, :]
+    if causal:
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    dead = jnp.isneginf(s) | jnp.isneginf(lse)[:, None]
+    return jnp.where(dead, 0.0, jnp.exp(s - jnp.where(
+        jnp.isneginf(lse), 0.0, lse)[:, None])), s
+
+
+def mxnet_tpu_flash_attention_dq(q_ref, k_ref, v_ref, bias_ref,
+                                 o_ref, lse_ref, do_ref, dq_ref, *,
+                                 nk, scale, causal, heads):
+    del heads
+    qb = q_ref[0].astype(jnp.float32)
+    dob = do_ref[0].astype(jnp.float32)
+    ob = o_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    bq, d = qb.shape
+    qi = pl.program_id(1)
+    q_pos = qi * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, K_BLOCK), 0)
+    delta = jnp.sum(dob * ob, axis=-1)                  # (BQ,)
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * K_BLOCK, K_BLOCK), :].astype(
+            jnp.float32)
+        vb = v_ref[0, pl.ds(j * K_BLOCK, K_BLOCK), :].astype(
+            jnp.float32)
+        k_pos = j * K_BLOCK + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, K_BLOCK), 1)
+        bias_blk = bias_ref[0, pl.ds(j * K_BLOCK, K_BLOCK)]
+        p, _ = _p_block(qb, kb, bias_blk, lse, q_pos, k_pos, causal,
+                        scale)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def mxnet_tpu_flash_attention_dkv(q_ref, k_ref, v_ref, bias_ref,
+                                  o_ref, lse_ref, do_ref, dk_ref,
+                                  dv_ref, *, nq, bq, scale, causal,
+                                  heads):
+    del heads
+    kb = k_ref[0].astype(jnp.float32)                   # (BK, D)
+    vb = v_ref[0].astype(jnp.float32)
+    bk, d = kb.shape
+    kj = pl.program_id(1)
+    bias_blk = bias_ref[0]                              # (BK,)
+    k_pos = kj * bk + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        ob = o_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq)]
+        q_pos = i * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        p, _ = _p_block(qb, kb, bias_blk, lse, q_pos, k_pos, causal,
+                        scale)
+        dv = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = jnp.sum(dob * ob, axis=-1)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_call(q3, k3, v3, bias, o3, lse, do3, *, heads, causal, scale,
+              interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    bq = min(_Q_BLOCK, sq)
+    nq, nk = sq // bq, sk // K_BLOCK
+    h = heads
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    q_full = pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0),
+                          memory_space=pltpu.VMEM)
+    k_full = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, K_BLOCK, d), lambda b, j: (b, j, 0),
+                          memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(mxnet_tpu_flash_attention_dq, nk=nk,
+                          scale=scale, causal=causal, heads=heads),
+        grid=(bh, nq),
+        in_specs=[
+            q_spec, k_full, k_full,
+            pl.BlockSpec((1, sk), lambda b, i: (b // h, 0),
+                         memory_space=pltpu.VMEM),
+            q_spec,
+            pl.BlockSpec((1, bq), lambda b, i: (b, i),
+                         memory_space=pltpu.VMEM),
+            q_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, bias, o3, lse, do3)
+    dk, dv = pl.pallas_call(
+        functools.partial(mxnet_tpu_flash_attention_dkv, nq=nq, bq=bq,
+                          scale=scale, causal=causal, heads=heads),
+        grid=(bh, nk),
+        in_specs=[
+            q_full, k_spec, k_spec,
+            pl.BlockSpec((1, K_BLOCK), lambda b, j: (b // h, j),
+                         memory_space=pltpu.VMEM),
+            q_full,
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0),
+                         memory_space=pltpu.VMEM),
+            q_full,
+        ],
+        out_specs=[k_spec, k_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)],
+        interpret=interpret,
+    )(q3, k3, v3, bias, o3, lse, do3)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper over padded (B, H, S, D) arrays
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, bias, causal, scale, interpret):
+    """q/k/v: (B, H, Sq_pad, D) / (B, H, Sk_pad, D); bias (B, Sk_pad)
+    f32 additive with -inf on blocked keys."""
+    out, _ = _flash_fwd_impl(q, k, v, bias, causal, scale, interpret)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, bias, causal, scale, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    o3, lse = _fwd_call(q.reshape(b * h, sq, d),
+                        k.reshape(b * h, sk, d),
+                        v.reshape(b * h, sk, d), bias, heads=h,
+                        causal=causal, scale=scale, interpret=interpret)
+    return o3.reshape(b, h, sq, d), lse
+
+
+def _flash_fwd(q, k, v, bias, causal, scale, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, bias, causal, scale, interpret)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(causal, scale, interpret, res, g):
+    q, k, v, bias, out, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    dq, dk, dv = _bwd_call(
+        q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+        v.reshape(b * h, sk, d), bias,
+        out.reshape(b * h, sq, d), lse, g.reshape(b * h, sq, d),
+        heads=h, causal=causal, scale=scale, interpret=interpret)
+    return (dq.reshape(q.shape), dk.reshape(k.shape),
+            dv.reshape(v.shape), jnp.zeros_like(bias))
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, lengths=None, causal=False, scale=None):
+    """Blockwise flash attention over (B, H, S, D) arrays.
+
+    ``lengths`` (B,) masks keys at positions >= length (the padded-
+    prefill / valid-length form — exactly 0.0 attention weight, the
+    bit-identity contract); ``causal`` adds the autoregressive mask.
+    ``scale`` defaults to 1/sqrt(D). Returns (B, H, Sq, D) in the
+    input dtype; float32 accumulation inside the kernel.
+    """
+    from . import interpret_mode
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    sk_pad = _cdiv(sk, K_BLOCK) * K_BLOCK
+    kp = _pad_to(k, 2, K_BLOCK)
+    vp = _pad_to(v, 2, K_BLOCK)
+    bq = min(_Q_BLOCK, max(8, _cdiv(sq, 8) * 8))
+    qp = _pad_to(q, 2, bq)
+    k_pos = jnp.arange(sk_pad)
+    if lengths is None:
+        valid = k_pos[None, :] < sk
+    else:
+        # lengths: scalar or (B,) — either broadcasts over the batch
+        valid = (k_pos[None, :] < jnp.reshape(
+            jnp.asarray(lengths), (-1, 1))) & (k_pos[None, :] < sk)
+    valid = jnp.broadcast_to(valid, (b, sk_pad))
+    bias = jnp.where(valid, 0.0, _NEG_INF).astype(jnp.float32)
+    out = _flash_core(qp, kp, vp, bias, bool(causal), float(scale),
+                      interpret_mode())
+    return out[:, :, :sq, :]
+
+
+# ---------------------------------------------------------------------------
+# single-token decode variant: reads the slot KV cache in its native
+# (slots, max_len, units) layout — no per-step head transpose of the
+# cache, which is the per-token cache-traffic win
+# ---------------------------------------------------------------------------
+
+
+def mxnet_tpu_flash_decode_fwd(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                               *, nk, heads, scale):
+    """One slot per program: the single query row attends its own
+    cache prefix. Per head: (8, D) x (K_BLOCK, D) dots (row 0 real,
+    rows 1-7 padding) — the same dot_general shapes and the same
+    K_BLOCK walk as the full kernel, so the reduction tree over the
+    real keys is identical (the decode bit-identity contract)."""
+    u = q_ref.shape[-1]
+    d = u // heads
+    q = q_ref[0].astype(jnp.float32) * scale            # (8, U)
+
+    outs = []
+    for h in range(heads):
+        qh = q[:, h * d:(h + 1) * d]                    # (8, D)
+
+        def body(j, carry, qh=qh, h=h):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(j * K_BLOCK, K_BLOCK),
+                       h * d:(h + 1) * d].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(j * K_BLOCK, K_BLOCK),
+                       h * d:(h + 1) * d].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qh, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            s = s + bias_ref[0, pl.ds(j * K_BLOCK, K_BLOCK)][None, :]
+            return online_softmax_block(s, vb, m, l, acc)
+
+        m0 = jnp.full((8,), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((8,), jnp.float32)
+        a0 = jnp.zeros((8, d), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, a0))
+        outs.append(acc / jnp.maximum(l, 1e-20)[:, None])
+    o_ref[0] = jnp.concatenate(outs, axis=-1).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q, keys, values, positions, heads,
+                           scale=None):
+    """Cached decode-step attention: ``q`` (slots, U) single-token
+    queries against the slot cache ``keys``/``values``
+    (slots, max_len, U); each slot attends its own prefix
+    (k_pos <= positions[slot]). Returns (slots, U) context.
+
+    Forward-only by design (the decode step never backpropagates);
+    grads, if ever requested, raise at transpose time.
+    """
+    from . import interpret_mode
+    slots, u = q.shape
+    max_len = keys.shape[1]
+    d = u // heads
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    lp = _cdiv(max_len, K_BLOCK) * K_BLOCK
+    kp = _pad_to(keys, 1, K_BLOCK)
+    vp = _pad_to(values, 1, K_BLOCK)
+    k_pos = jnp.arange(lp)
+    valid = (k_pos[None, :] <= positions[:, None]) & \
+        (k_pos[None, :] < max_len)
+    bias = jnp.where(valid, 0.0, _NEG_INF).astype(jnp.float32)
+    # pad the single query row to the f32 sublane tile (8)
+    q8 = jnp.pad(q[:, None, :], ((0, 0), (0, 7), (0, 0)))
+    from jax.experimental import pallas as pl_mod
+    from jax.experimental.pallas import tpu as pltpu
+    nk = lp // K_BLOCK
+    out = pl_mod.pallas_call(
+        functools.partial(mxnet_tpu_flash_decode_fwd, nk=nk,
+                          heads=heads, scale=float(scale)),
+        grid=(slots,),
+        in_specs=[
+            pl_mod.BlockSpec((1, 8, u), lambda s: (s, 0, 0),
+                             memory_space=pltpu.VMEM),
+            pl_mod.BlockSpec((1, lp, u), lambda s: (s, 0, 0),
+                             memory_space=pltpu.VMEM),
+            pl_mod.BlockSpec((1, lp, u), lambda s: (s, 0, 0),
+                             memory_space=pltpu.VMEM),
+            pl_mod.BlockSpec((1, lp), lambda s: (s, 0),
+                             memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl_mod.BlockSpec((1, 8, u), lambda s: (s, 0, 0),
+                                   memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((slots, 8, u), q.dtype),
+        interpret=interpret_mode(),
+    )(q8, kp, vp, bias)
+    return out[:, 0, :]
+
+
+# module-level pl import for the kernel bodies (resolved lazily at
+# trace time would shadow per-call; kernels only run under pallas_call)
+from jax.experimental import pallas as pl  # noqa: E402

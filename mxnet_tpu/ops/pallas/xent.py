@@ -1,0 +1,139 @@
+"""Fused softmax + cross-entropy head kernel.
+
+The loss head is the audit's canonical memory-bound cluster: XLA
+lowers log_softmax + label pick as a max-reduce, a subtract, an
+exp-sum-reduce, a log, and a gather — each re-reading the (B, V)
+logits from HBM (V = 30k for the BERT MLM head). This kernel makes
+ONE pass over a row block of logits in VMEM: row max, exp-sum, the
+label's log-probability, and the saved log-probabilities all fall out
+of the same read.
+
+The vjp composes with PR 7's saved-log-probs contract
+(``ops/nn.py`` ``_softmax_xent_core``): the forward saves ``logp``
+(which it computed anyway) and the backward is the closed-form
+``softmax(logits) - onehot(label)`` — here as one elementwise kernel
+pass with the onehot built from an in-kernel iota compare instead of
+a gather/scatter.
+
+bf16/fp16 logits compute in float32 inside the kernel (the loss head
+is a KEEP_FP32 op under AMP; the kernel enforces it regardless).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['fused_softmax_xent_rows']
+
+_ROW_BLOCK = 8
+
+
+def _cdiv(a, b):
+    return -(-a // b)
+
+
+def mxnet_tpu_softmax_xent_fwd(x_ref, lab_ref, nll_ref, logp_ref):
+    xf = x_ref[...].astype(jnp.float32)                  # (BR, V)
+    m = jnp.max(xf, axis=-1, keepdims=True)
+    z = jnp.sum(jnp.exp(xf - m), axis=-1, keepdims=True)
+    logp = xf - m - jnp.log(z)
+    logp_ref[...] = logp
+    lab = lab_ref[...].astype(jnp.int32)                 # (BR, 1)
+    cls = jax.lax.broadcasted_iota(jnp.int32, logp.shape, 1)
+    onehot = (cls == lab).astype(jnp.float32)
+    nll_ref[...] = -jnp.sum(logp * onehot, axis=-1, keepdims=True)
+
+
+def mxnet_tpu_softmax_xent_bwd(logp_ref, lab_ref, g_ref, dx_ref):
+    logp = logp_ref[...]                                 # (BR, V) f32
+    lab = lab_ref[...].astype(jnp.int32)
+    cls = jax.lax.broadcasted_iota(jnp.int32, logp.shape, 1)
+    onehot = (cls == lab).astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)                   # (BR, 1)
+    dx_ref[...] = (g * (jnp.exp(logp) - onehot)).astype(dx_ref.dtype)
+
+
+def _pad_rows(x, br):
+    r = x.shape[0]
+    pad = _cdiv(r, br) * br - r
+    return (jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1)), r) \
+        if pad else (x, r)
+
+
+def _row_specs(pl, pltpu, br, shapes):
+    return [pl.BlockSpec((br,) + s[1:], lambda i: (i,) + (0,) * (
+        len(s) - 1), memory_space=pltpu.VMEM) for s in shapes]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _xent_core(logits, labels, interpret):
+    nll, _ = _xent_fwd_impl(logits, labels, interpret)
+    return nll
+
+
+def _xent_fwd_impl(logits, labels, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    b, v = logits.shape
+    br = min(_ROW_BLOCK, max(1, b))
+    xp, r = _pad_rows(logits, br)
+    lab2 = labels.astype(jnp.int32).reshape(-1, 1)
+    labp, _ = _pad_rows(lab2, br)
+    rows = xp.shape[0]
+    nll, logp = pl.pallas_call(
+        mxnet_tpu_softmax_xent_fwd,
+        grid=(rows // br,),
+        in_specs=_row_specs(pl, pltpu, br, [xp.shape, labp.shape]),
+        out_specs=_row_specs(pl, pltpu, br, [(rows, 1), (rows, v)]),
+        out_shape=[jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, v), jnp.float32)],
+        interpret=interpret,
+    )(xp, labp)
+    return nll[:r, 0], logp[:r]
+
+
+def _xent_fwd(logits, labels, interpret):
+    nll, logp = _xent_fwd_impl(logits, labels, interpret)
+    # saved-log-probs residual (the PR 7 contract) + a dtype tag so
+    # dlogits casts back to the primal dtype
+    return nll, (logp, labels, jnp.zeros((0,), logits.dtype))
+
+
+def _xent_bwd(interpret, res, g):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    logp, labels, dtag = res
+    b, v = logp.shape
+    br = min(_ROW_BLOCK, max(1, b))
+    logpp, r = _pad_rows(logp, br)
+    lab2 = labels.astype(jnp.int32).reshape(-1, 1)
+    labp, _ = _pad_rows(lab2, br)
+    g2 = jnp.broadcast_to(jnp.asarray(g, jnp.float32).reshape(-1, 1),
+                          (b, 1)) if jnp.ndim(g) <= 1 and g.size in (
+        1, b) else jnp.asarray(g, jnp.float32).reshape(b, 1)
+    gp, _ = _pad_rows(g2, br)
+    rows = logpp.shape[0]
+    dx = pl.pallas_call(
+        mxnet_tpu_softmax_xent_bwd,
+        grid=(rows // br,),
+        in_specs=_row_specs(pl, pltpu, br,
+                            [logpp.shape, labp.shape, gp.shape]),
+        out_specs=_row_specs(pl, pltpu, br, [(rows, v)])[0],
+        out_shape=jax.ShapeDtypeStruct((rows, v), dtag.dtype),
+        interpret=interpret,
+    )(logpp, labp, gp)
+    from ..nn import _zero_cotangent
+    return dx[:r], _zero_cotangent(labels)
+
+
+_xent_core.defvjp(_xent_fwd, _xent_bwd)
+
+
+def fused_softmax_xent_rows(logits, labels):
+    """Per-row negative log-likelihood, one fused pass over a (B, V)
+    logits block; gradient is the saved-log-probs closed form. Returns
+    (B,) float32 (sum/mean reductions compose outside)."""
+    from . import interpret_mode
+    return _xent_core(logits, labels, interpret_mode())

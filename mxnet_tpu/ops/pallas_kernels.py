@@ -1,111 +1,10 @@
-"""Hand-written Pallas TPU kernels (the reference's rtc.h / custom-CUDA
-escape hatch, TPU-native: SURVEY.md §7 hard part 6 designates NMS).
-
-greedy_nms_keep: the sequential-suppression core of box_nms
-(reference: src/operator/contrib/bounding_box-inl.h NMSFastKernel). The
-pure-XLA fallback materializes the (N, N) IoU matrix (256 MB of HBM at
-SSD's ~8k anchors); this kernel keeps the five coordinate rows resident in
-VMEM and computes each suppression row on the VPU in the loop —
-O(N * topk) compute with O(N) memory and zero HBM round-trips between
-iterations.
-
-CPU/test path runs the same kernel through the Pallas interpreter, so the
-logic is exercised everywhere; the Mosaic-compiled path engages on TPU.
+"""Compatibility shim: the seed-era Pallas kernel module grew into the
+:mod:`mxnet_tpu.ops.pallas` package (flash attention, fused epilogues,
+fused cross-entropy head, greedy NMS). Import from there; this module
+keeps the original NMS entry point importable for existing callers.
 """
 from __future__ import annotations
 
-import functools
-
-import jax
-import jax.numpy as jnp
+from .pallas.nms import greedy_nms_keep  # noqa: F401
 
 __all__ = ['greedy_nms_keep']
-
-
-def _cdiv(a, b):
-    return -(-a // b)
-
-
-def _nms_kernel(packed_ref, keep_ref, *, n_iter, thresh, class_aware):
-    """packed_ref rows: 0-3 = x1,y1,x2,y2 (score-sorted), 4 = valid,
-    5 = class id. keep_ref: (1, Np) float mask output."""
-    x1 = packed_ref[0:1, :]
-    y1 = packed_ref[1:2, :]
-    x2 = packed_ref[2:3, :]
-    y2 = packed_ref[3:4, :]
-    valid = packed_ref[4:5, :]
-    cid = packed_ref[5:6, :]
-    area = (x2 - x1) * (y2 - y1)
-    # lane index (2-D integer iota: TPU has no 1-D and no float iota)
-    idx = jax.lax.broadcasted_iota(jnp.int32, x1.shape, 1)
-
-    def body(i, keep):
-        oh = (idx == i).astype(jnp.float32)
-        # scalar extraction of box i as VPU reductions (no dynamic lane
-        # indexing on TPU)
-        xi1 = jnp.sum(x1 * oh)
-        yi1 = jnp.sum(y1 * oh)
-        xi2 = jnp.sum(x2 * oh)
-        yi2 = jnp.sum(y2 * oh)
-        ci = jnp.sum(cid * oh)
-        ai = (xi2 - xi1) * (yi2 - yi1)
-        ki = jnp.sum(keep * oh)
-        ix1 = jnp.maximum(x1, xi1)
-        iy1 = jnp.maximum(y1, yi1)
-        ix2 = jnp.minimum(x2, xi2)
-        iy2 = jnp.minimum(y2, yi2)
-        inter = jnp.maximum(ix2 - ix1, 0.0) * jnp.maximum(iy2 - iy1, 0.0)
-        iou = inter / (area + ai - inter + 1e-12)
-        sup = (iou > thresh) & (idx > i) & (ki > 0)
-        if class_aware:
-            sup = sup & (cid == ci)
-        return jnp.where(sup, 0.0, keep)
-
-    keep_ref[0:1, :] = jax.lax.fori_loop(0, n_iter, body, valid)
-
-
-@functools.partial(jax.jit, static_argnames=('thresh', 'n_iter',
-                                             'class_aware', 'interpret'))
-def _nms_call(packed, *, thresh, n_iter, class_aware, interpret):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    npad = packed.shape[-1]
-    kern = functools.partial(_nms_kernel, n_iter=n_iter, thresh=thresh,
-                             class_aware=class_aware)
-    return pl.pallas_call(
-        kern,
-        out_shape=jax.ShapeDtypeStruct(packed.shape[:-2] + (1, npad),
-                                       jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=interpret,
-    )(packed)
-
-
-def greedy_nms_keep(boxes, valid, thresh, topk=-1, cls_id=None):
-    """Greedy NMS keep-mask for score-sorted boxes.
-
-    boxes: (N, 4) corner-format, already sorted by descending score.
-    valid: (N,) bool. cls_id: optional (N,) class ids — when given, only
-    same-class boxes suppress each other. Returns (N,) bool keep mask.
-    """
-    n = boxes.shape[0]
-    npad = max(128, _cdiv(n, 128) * 128)
-    pad = npad - n
-
-    def row(v):
-        return jnp.pad(v.astype(jnp.float32), (0, pad))
-
-    packed = jnp.stack([
-        row(boxes[:, 0]), row(boxes[:, 1]), row(boxes[:, 2]),
-        row(boxes[:, 3]), row(valid.astype(jnp.float32)),
-        row(cls_id if cls_id is not None else jnp.zeros((n,)))], axis=0)
-    # pad sublanes to the f32 tile height (8)
-    packed = jnp.pad(packed, ((0, 8 - packed.shape[0]), (0, 0)))
-    n_iter = n if topk is None or topk < 0 else min(int(topk), n)
-    # Mosaic compilation is TPU-only; everywhere else (cpu tests, gpu jax)
-    # run the same kernel through the Pallas interpreter
-    interpret = jax.default_backend() != 'tpu'
-    keep = _nms_call(packed, thresh=float(thresh), n_iter=int(n_iter),
-                     class_aware=cls_id is not None, interpret=interpret)
-    return keep[0, :n] > 0

@@ -44,22 +44,26 @@ class TraceKnobs:
     MXNET_CONV_LAYOUT_INTERNAL preference ('auto' defers to the
     backend query, which is latched process-wide and therefore safe
     to resolve lazily).
+    ``pallas``: sorted tuple of enabled Pallas kernel families from
+    MXNET_TPU_PALLAS (() = off; see :mod:`mxnet_tpu.ops.pallas`).
     """
 
-    __slots__ = ('vjp_reschedule', 'conv_layout')
+    __slots__ = ('vjp_reschedule', 'conv_layout', 'pallas')
 
-    def __init__(self, vjp_reschedule, conv_layout):
+    def __init__(self, vjp_reschedule, conv_layout, pallas=()):
         self.vjp_reschedule = bool(vjp_reschedule)
         self.conv_layout = conv_layout
+        self.pallas = tuple(pallas)
 
     @property
     def cache_key(self):
         """Hashable identity for compiled-program cache keys."""
-        return (self.vjp_reschedule, self.conv_layout)
+        return (self.vjp_reschedule, self.conv_layout, self.pallas)
 
     def __repr__(self):
-        return 'TraceKnobs(vjp_reschedule=%s, conv_layout=%r)' % (
-            self.vjp_reschedule, self.conv_layout)
+        return ('TraceKnobs(vjp_reschedule=%s, conv_layout=%r, '
+                'pallas=%r)' % (self.vjp_reschedule, self.conv_layout,
+                                self.pallas))
 
 
 _snap_cache = None     # ((config.epoch, raw vjp env, raw conv env),
@@ -77,13 +81,16 @@ def snapshot():
     from .. import config as _config
     state = (_config.epoch(),
              os.environ.get('MXNET_TPU_VJP_RESCHEDULE'),
-             os.environ.get('MXNET_CONV_LAYOUT_INTERNAL', 'auto'))
+             os.environ.get('MXNET_CONV_LAYOUT_INTERNAL', 'auto'),
+             os.environ.get('MXNET_TPU_PALLAS'))
     cached = _snap_cache
     if cached is not None and cached[0] == state:
         return cached[1]
+    from .pallas import parse_spec as _parse_pallas
     knobs = TraceKnobs(
         vjp_reschedule=bool(_config.get('MXNET_TPU_VJP_RESCHEDULE')),
-        conv_layout=state[2].lower())
+        conv_layout=state[2].lower(),
+        pallas=_parse_pallas(_config.get('MXNET_TPU_PALLAS')))
     _snap_cache = (state, knobs)
     return knobs
 

@@ -42,6 +42,9 @@ def ring_attention_local(q, k, v, axis_name, causal=False):
     matrix — memory stays O(S_local^2 / ring) per step and activations
     O(S_local * D).
     """
+    # the shared inner-block math (trace-time import: keeps the pallas
+    # package off this module's import path)
+    from ..ops.pallas.attention import online_softmax_block
     n = jax.lax.psum(1, axis_name)
     me = jax.lax.axis_index(axis_name)
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -59,16 +62,12 @@ def ring_attention_local(q, k, v, axis_name, causal=False):
             q_pos = me * s_local + jnp.arange(s_local)[:, None]
             k_pos = src * s_local + jnp.arange(k_blk.shape[2])[None, :]
             scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        # guard fully-masked rows (m_new = -inf): exp(-inf - -inf)
-        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(jnp.where(jnp.isneginf(scores), -jnp.inf,
-                              scores - safe_m[..., None]))
-        corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
-        corr = jnp.where(jnp.isneginf(m), 0.0, corr)
-        l_new = l * corr + p.sum(axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            'bhqk,bhkd->bhqd', p, v_blk.astype(jnp.float32))
+        # the shared online-softmax inner block (running max +
+        # normalizer with fully-masked-row guards) — the same math the
+        # single-device flash kernels walk over VMEM blocks, here
+        # applied to the block a ring rotation just delivered
+        m_new, l_new, o_new = online_softmax_block(
+            scores, v_blk.astype(jnp.float32), m, l, o)
         # skip the dead rotation on the last step (its result is never
         # consumed; scan carries can't be DCE'd by XLA)
         k_next, v_next = jax.lax.cond(

@@ -43,6 +43,15 @@ def _as_numpy(arr):
     return onp.asarray(arr)
 
 
+def _flash_on():
+    """Flash-attention gate (MXNET_TPU_PALLAS=attention): snapshot-
+    first via ops.traceknobs — DecodeProgram installs the build-time
+    snapshot over its traces and keys the compiled programs on it, so
+    a knob flip re-jits instead of latching (docs/PERFORMANCE.md)."""
+    from ...ops.pallas import enabled
+    return enabled('attention')
+
+
 class DecodeModel:
     """Interface one decode family implements (pure functions over a
     ``{name: array}`` params dict; no state on the model object):
@@ -324,20 +333,36 @@ class TransformerLM(DecodeModel):
                 jnp.asarray(length), (-1, 1, 1)))
         bias = jnp.where(mask, 0.0, -1e9)[:, None]     # (B, 1, S, S)
         scale = 1.0 / float(onp.sqrt(self.units // self.heads))
+        flash = _flash_on()
         kvs = []
         for i in range(self.layers):
             p = lambda n: params['l%d_%s' % (i, n)]       # noqa: E731
             qkv = self._dense(x, p('qkv_w'), p('qkv_b'))
             q, k, v = jnp.split(qkv, 3, axis=-1)
             kvs.append((k, v))
-            qh = self._heads_split(q * scale)             # (B,S,H,D)
-            kh = self._heads_split(k)
-            vh = self._heads_split(v)
-            scores = jnp.einsum('bqhd,bkhd->bhqk', qh, kh) + bias
-            att = jnp.exp(scores - jnp.max(scores, axis=-1,
-                                           keepdims=True))
-            att = att / jnp.sum(att, axis=-1, keepdims=True)
-            ctx = jnp.einsum('bhqk,bkhd->bqhd', att, vh)
+            if flash:
+                # blockwise online-softmax kernel over the padded
+                # prefix: masked keys carry exactly 0.0 weight and
+                # the key axis walks the same fixed blocks the
+                # decode-step kernel walks, so the cached path
+                # combines the same reduction tree over the real keys
+                # (the bit-identity argument, module docstring)
+                from ...ops.pallas import flash_attention
+                ctx = flash_attention(
+                    jnp.transpose(self._heads_split(q), (0, 2, 1, 3)),
+                    jnp.transpose(self._heads_split(k), (0, 2, 1, 3)),
+                    jnp.transpose(self._heads_split(v), (0, 2, 1, 3)),
+                    lengths=length, causal=True, scale=scale)
+                ctx = jnp.transpose(ctx, (0, 2, 1, 3))
+            else:
+                qh = self._heads_split(q * scale)         # (B,S,H,D)
+                kh = self._heads_split(k)
+                vh = self._heads_split(v)
+                scores = jnp.einsum('bqhd,bkhd->bhqk', qh, kh) + bias
+                att = jnp.exp(scores - jnp.max(scores, axis=-1,
+                                               keepdims=True))
+                att = att / jnp.sum(att, axis=-1, keepdims=True)
+                ctx = jnp.einsum('bhqk,bkhd->bqhd', att, vh)
             ctx = ctx.reshape(B, S, self.units)
             x = self._ln(x + self._dense(ctx, p('out_w'), p('out_b')),
                          p('ln1_g'), p('ln1_b'))
@@ -372,6 +397,7 @@ class TransformerLM(DecodeModel):
         bias = jnp.where(ar[None, :] <= positions[:, None],
                          0.0, -1e9)[:, None, :]           # (S, 1, L)
         scale = 1.0 / float(onp.sqrt(self.units // self.heads))
+        flash = _flash_on()
         cache = dict(cache)
         for i in range(self.layers):
             p = lambda n: params['l%d_%s' % (i, n)]       # noqa: E731
@@ -380,15 +406,25 @@ class TransformerLM(DecodeModel):
             ck = write_position(cache['l%d_k' % i], k, positions)
             cv = write_position(cache['l%d_v' % i], v, positions)
             cache['l%d_k' % i], cache['l%d_v' % i] = ck, cv
-            qh = self._heads_split(q * scale)             # (S,H,D)
-            kh = self._heads_split(ck)                    # (S,L,H,D)
-            vh = self._heads_split(cv)
-            scores = jnp.einsum('shd,slhd->shl', qh, kh) + bias
-            att = jnp.exp(scores - jnp.max(scores, axis=-1,
-                                           keepdims=True))
-            att = att / jnp.sum(att, axis=-1, keepdims=True)
-            ctx = jnp.einsum('shl,slhd->shd', att, vh)
-            ctx = ctx.reshape(slots, self.units)
+            if flash:
+                # single-token kernel reading the slot cache in its
+                # native (slots, max_len, units) layout — no per-step
+                # head transpose of the cache, which is the per-token
+                # cache-traffic reduction
+                from ...ops.pallas import flash_decode_attention
+                ctx = flash_decode_attention(q, ck, cv, positions,
+                                             heads=self.heads,
+                                             scale=scale)
+            else:
+                qh = self._heads_split(q * scale)         # (S,H,D)
+                kh = self._heads_split(ck)                # (S,L,H,D)
+                vh = self._heads_split(cv)
+                scores = jnp.einsum('shd,slhd->shl', qh, kh) + bias
+                att = jnp.exp(scores - jnp.max(scores, axis=-1,
+                                               keepdims=True))
+                att = att / jnp.sum(att, axis=-1, keepdims=True)
+                ctx = jnp.einsum('shl,slhd->shd', att, vh)
+                ctx = ctx.reshape(slots, self.units)
             x = self._ln(x + self._dense(ctx, p('out_w'), p('out_b')),
                          p('ln1_g'), p('ln1_b'))
             x = self._ffn_block(params, i, x)

@@ -60,6 +60,15 @@ def _knob(name, default):
         return default
 
 
+def _pallas_resolve():
+    """Canonical MXNET_TPU_PALLAS value at build time ('off' or a
+    comma list) — recorded in the manifest for provenance. One
+    canonicalization rule for the manifest, the program keys, and the
+    fusion-audit config block: ops.pallas.resolve_spec."""
+    from ...ops.pallas import resolve_spec
+    return resolve_spec()
+
+
 def _instrument_compile(key, seconds):
     try:
         from ... import observability as _obs
@@ -165,10 +174,19 @@ class DecodeProgram:
         return {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                 for k, v in self._params.items()}
 
+    def _program_key(self, base):
+        """Compiled-program key, extended with the Pallas kernel knob
+        (the PR 10 contract: build-time snapshot folded into cache
+        keys so a flip re-jits instead of latching). The plain base
+        key at knob-off keeps old artifacts' program names stable."""
+        tag = _pallas_resolve()
+        return base if tag == 'off' else '%s:pallas-%s' % (base, tag)
+
     def _build(self, key, fn, *avals):
         """jit -> lower -> compile with the freeze.py accounting."""
         import time
         import jax
+        from ...ops import traceknobs as _traceknobs
         prog = self._compiled.get(key) or self._loaded.get(key)
         if prog is not None:
             return prog
@@ -177,11 +195,14 @@ class DecodeProgram:
             if prog is not None:
                 return prog
             t0 = time.perf_counter()
+            knobs = _traceknobs.snapshot()
             jitted = jax.jit(fn, donate_argnums=(1,)) if self._donate \
                 else jax.jit(fn)
-            prog = jitted.lower(self._param_avals(),
-                                cache_avals(self._spec, self.slots),
-                                *avals).compile()
+            with _traceknobs.scope(knobs):
+                prog = jitted.lower(
+                    self._param_avals(),
+                    cache_avals(self._spec, self.slots),
+                    *avals).compile()
             self.compile_seconds[key] = time.perf_counter() - t0
             self._compiled[key] = prog
         _instrument_compile(key, self.compile_seconds[key])
@@ -189,7 +210,7 @@ class DecodeProgram:
 
     def compile_prefill(self, bucket):
         import jax
-        key = 'prefill:%d' % bucket
+        key = self._program_key('prefill:%d' % bucket)
         return self._build(
             key, self._prefill_fn(key),
             jax.ShapeDtypeStruct((1, bucket), 'int32'),
@@ -198,8 +219,9 @@ class DecodeProgram:
 
     def compile_step(self):
         import jax
+        key = self._program_key('step')
         return self._build(
-            'step', self._step_fn('step'),
+            key, self._step_fn(key),
             jax.ShapeDtypeStruct((self.slots,), 'int32'),
             jax.ShapeDtypeStruct((self.slots,), 'int32'))
 
@@ -338,6 +360,9 @@ class DecodeProgram:
             'cache_bytes': self.cache_bytes(),
             'jax_version': jax.__version__,
             'platform': jax.default_backend(),
+            # provenance: the Pallas kernel knob the programs were
+            # built under (the program keys carry it too)
+            'pallas': _pallas_resolve(),
             'programs': programs,
         }
         atomic_write_bytes(

@@ -234,6 +234,14 @@ spec('argmin', U((2, 3)), grad=False, attrs=dict(axis=1),
 spec('argmax_channel', U((2, 3)), grad=False,
      oracle=lambda x: x.argmax(axis=1).astype(np.float32))
 spec('softmax_cross_entropy', U((3, 4)), I((3,), 0, 4), grad=False)
+# Pallas-gated cluster ops (docs/PERFORMANCE.md "Hand-written
+# kernels") — swept on their knob-off reference paths (the default)
+spec('_contrib_add_relu', U((2, 3)), U((2, 3)), bf16=True,
+     oracle=lambda x, y: np.maximum(x + y, 0))
+spec('_contrib_flash_attention', U((4, 6, 4)), U((4, 6, 4)),
+     U((4, 6, 4)), attrs=dict(num_heads=2), grad_idx=[0, 1, 2])
+spec('_contrib_fused_softmax_xent', U((3, 5)), I((3,), 0, 5),
+     grad_idx=[0])
 
 # --- shape / layout ---------------------------------------------------------
 spec('Reshape', U((2, 6)), attrs=dict(shape=(3, 4)),

@@ -1,0 +1,507 @@
+"""Pallas kernel contracts (docs/PERFORMANCE.md "Hand-written
+kernels").
+
+Equivalence classes (the test_vjp_reschedule.py pattern): flipping
+MXNET_TPU_PALLAS must keep forward values and gradients inside the
+documented tier for every kernel family — exact/bitwise for the
+piecewise-linear epilogues (relu, add+relu, the BN affine apply whose
+expression order matches the XLA spelling), one-two ULP for the
+transcendental activations and the fused xent head, and the
+reduction tier (~1e-5) for flash attention, whose online-softmax tree
+legitimately rounds differently than the two-pass softmax.
+
+Composition contracts: decode token streams are bit-identical between
+the cached path and the whole-sequence reference with flash attention
+ON (the fixed K_BLOCK alignment argument in ops/pallas/attention.py);
+bf16 inputs emit bf16 with f32 accumulation inside the kernels (AMP);
+the knob is snapshotted into TraceKnobs and folded into jit cache
+keys (the PR 10 contract); roofline attributes kernel custom-calls
+via the registered cost models; hlolint's HLO-PALLAS rules catch
+silent fallback and knob-off leakage.
+
+Everything runs through the Pallas interpreter on the CPU rig — the
+same kernel logic Mosaic compiles on TPU (the NMS precedent).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import config
+from mxnet_tpu.ops import nn as nn_ops
+
+EXACT = 0.0
+ULP = 5e-7
+RED = 2e-5      # blockwise-reduction tier (flash attention)
+
+
+@pytest.fixture
+def knob():
+    """Restore the pallas knob after each A/B test."""
+    yield
+    config.unset('MXNET_TPU_PALLAS')
+
+
+def _ab(fn, families, *args):
+    """(value, grads) with the kernel family on vs off."""
+    config.set('MXNET_TPU_PALLAS', families)
+    v1, g1 = jax.jit(jax.value_and_grad(fn))(*args)
+    config.set('MXNET_TPU_PALLAS', '0')
+    v2, g2 = jax.jit(jax.value_and_grad(fn))(*args)
+    return (np.asarray(v1), np.asarray(g1)), (np.asarray(v2),
+                                              np.asarray(g2))
+
+
+def _check(fn, families, *args, tol=EXACT, gtol=None):
+    (v1, g1), (v2, g2) = _ab(fn, families, *args)
+    gtol = tol if gtol is None else gtol
+    if tol == EXACT:
+        assert (v1 == v2).all(), 'forward changed with the knob'
+    else:
+        np.testing.assert_allclose(v1, v2, rtol=tol, atol=tol)
+    if gtol == EXACT:
+        assert (g1 == g2).all(), \
+            'grad not bit-identical (max delta %r)' % \
+            float(np.abs(g1 - g2).max())
+    else:
+        np.testing.assert_allclose(g1, g2, rtol=gtol, atol=gtol)
+
+
+_X = jnp.asarray(np.random.RandomState(0).randn(6, 33)
+                 .astype('float32'))
+
+
+# -- knob parsing / snapshot plumbing ---------------------------------------
+
+
+def test_parse_spec_forms():
+    from mxnet_tpu.ops.pallas import KINDS, parse_spec
+    assert parse_spec(None) == ()
+    assert parse_spec('0') == ()
+    assert parse_spec('off') == ()
+    assert parse_spec('1') == tuple(KINDS)
+    assert parse_spec('xent,attention') == ('attention', 'xent')
+    with pytest.raises(ValueError):
+        parse_spec('attenton')       # typo must be loud, not off
+
+
+def test_knob_lands_in_traceknobs_cache_key(knob):
+    from mxnet_tpu.ops import traceknobs
+    config.set('MXNET_TPU_PALLAS', '0')
+    k_off = traceknobs.snapshot().cache_key
+    config.set('MXNET_TPU_PALLAS', 'attention')
+    k_on = traceknobs.snapshot().cache_key
+    assert k_off != k_on, 'knob flip must re-key compiled programs'
+    assert traceknobs.snapshot().pallas == ('attention',)
+
+
+def test_enabled_prefers_installed_snapshot(knob):
+    from mxnet_tpu.ops import pallas, traceknobs
+    config.set('MXNET_TPU_PALLAS', '0')
+    snap = traceknobs.TraceKnobs(True, 'auto', pallas=('xent',))
+    with traceknobs.scope(snap):
+        assert pallas.enabled('xent')       # snapshot wins
+        assert not pallas.enabled('attention')
+    assert not pallas.enabled('xent')       # live config fallback
+
+
+# -- per-kernel knob-on vs knob-off equivalence -----------------------------
+
+
+@pytest.mark.parametrize('act,tol', [
+    ('relu', EXACT), ('sigmoid', ULP), ('tanh', ULP),
+    ('softrelu', ULP), ('softsign', ULP)])
+def test_activation_kernel_equivalence(knob, act, tol):
+    _check(lambda d: nn_ops.activation(d, act_type=act).sum(),
+           'epilogue', _X, tol=tol)
+
+
+def test_leaky_relu_kernel_equivalence(knob):
+    _check(lambda d: nn_ops.leaky_relu([d], act_type='leaky',
+                                       slope=0.25).sum(),
+           'epilogue', _X, tol=EXACT)
+
+
+def test_add_relu_op_equivalence(knob):
+    y = jnp.asarray(np.random.RandomState(1).randn(6, 33)
+                    .astype('float32'))
+    # elementwise values are exact; the test's .sum() reduction fuses
+    # into the relu on the knob-off side and sums the kernel's buffer
+    # on the knob-on side — one ULP of tree-order freedom. The grads
+    # (pure elementwise) must stay bit-identical.
+    _check(lambda d: nn_ops.add_relu(d, y).sum(), 'epilogue', _X,
+           tol=ULP, gtol=EXACT)
+    config.set('MXNET_TPU_PALLAS', 'epilogue')
+    on = np.asarray(jax.jit(nn_ops.add_relu)(_X, y))
+    config.set('MXNET_TPU_PALLAS', '0')
+    off = np.asarray(jax.jit(nn_ops.add_relu)(_X, y))
+    assert (on == off).all()      # the op itself IS bitwise
+
+
+def test_batch_norm_train_kernel_equivalence(knob):
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(4, 6, 5, 7).astype('float32'))
+    g = jnp.asarray((rs.rand(6) + 0.5).astype('float32'))
+    b = jnp.asarray(rs.randn(6).astype('float32'))
+    mm = jnp.zeros(6)
+    mv = jnp.ones(6)
+
+    def fn(x):
+        out, mean, var = nn_ops.batch_norm(
+            x, g, b, mm, mv, fix_gamma=False, training=True)
+        return out.sum() + mean.sum() + var.sum()
+    # forward expression order matches the XLA spelling; one ULP for
+    # XLA's freedom to FMA-fuse differently across programs
+    _check(fn, 'epilogue', x, tol=ULP)
+
+
+def test_batch_norm_inference_kernel_equivalence(knob):
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(4, 6, 5, 7).astype('float32'))
+    g = jnp.asarray((rs.rand(6) + 0.5).astype('float32'))
+    b = jnp.asarray(rs.randn(6).astype('float32'))
+    mm = jnp.asarray(rs.randn(6).astype('float32'))
+    mv = jnp.asarray((rs.rand(6) + 0.1).astype('float32'))
+
+    def fn(x):
+        out, _, _ = nn_ops.batch_norm(x, g, b, mm, mv,
+                                      fix_gamma=False, training=False)
+        return out.sum()
+    # inference folds gamma into the scale before the kernel (one mul
+    # instead of two) — ULP tier, not bitwise
+    _check(fn, 'epilogue', x, tol=ULP)
+
+
+def test_softmax_xent_kernel_equivalence(knob):
+    labels = jnp.asarray(np.random.RandomState(4).randint(0, 33,
+                                                          (6,)))
+    _check(lambda d: nn_ops.softmax_cross_entropy(d, labels),
+           'xent', _X, tol=ULP)
+
+
+def test_fused_softmax_xent_op_matches_pick_spelling(knob):
+    labels = jnp.asarray(np.random.RandomState(5).randint(0, 33,
+                                                          (6,)))
+    _check(lambda d: nn_ops.fused_softmax_xent(d, labels).sum(),
+           'xent', _X, tol=ULP)
+
+
+def test_flash_attention_op_equivalence(knob):
+    rs = np.random.RandomState(6)
+    bh, s, d = 8, 20, 8          # B=2, H=4
+    q = jnp.asarray(rs.randn(bh, s, d).astype('float32'))
+    k = jnp.asarray(rs.randn(bh, s, d).astype('float32'))
+    v = jnp.asarray(rs.randn(bh, s, d).astype('float32'))
+    lengths = jnp.asarray([14, 20], 'int32')   # flash-native form
+
+    def fn(q):
+        return nn_ops.flash_attention_op([q, k, v, lengths],
+                                         num_heads=4).sum()
+    _check(fn, 'attention', q, tol=RED)
+
+
+def test_flash_attention_op_dense_mask_stays_on_reference(knob):
+    """A dense (per-query-capable) mask must NOT route to the kernel
+    even knob-on: the kernel's bias is per-key, so e.g. a hand-rolled
+    causal triangle would silently lose its structure. The reference
+    path handles it exactly in both knob states."""
+    rs = np.random.RandomState(10)
+    bh, s, d = 4, 12, 8          # B=2, H=2
+    q = jnp.asarray(rs.randn(bh, s, d).astype('float32'))
+    tri = np.tril(np.ones((s, s), 'float32'))
+    mask = jnp.asarray(np.broadcast_to(tri, (2, s, s)).copy())
+    config.set('MXNET_TPU_PALLAS', 'attention')
+    on = np.asarray(nn_ops.flash_attention_op([q, q, q, mask],
+                                              num_heads=2))
+    config.set('MXNET_TPU_PALLAS', '0')
+    off = np.asarray(nn_ops.flash_attention_op([q, q, q, mask],
+                                               num_heads=2))
+    assert (on == off).all()     # same (reference) path both ways
+
+
+@pytest.mark.parametrize('pallas', ['0', 'attention'])
+def test_flash_attention_op_mask_spellings_agree(knob, pallas):
+    """(B, Sq, Sk) and (B*H, Sq, Sk) dense masks and the 1-D lengths
+    form must agree for valid-length masking, in both knob states."""
+    config.set('MXNET_TPU_PALLAS', pallas)
+    rs = np.random.RandomState(8)
+    bh, s, d = 4, 16, 8          # B=2, H=2
+    q = jnp.asarray(rs.randn(bh, s, d).astype('float32'))
+    mask = np.ones((2, s, s), 'float32')
+    mask[1, :, 10:] = 0.0
+    lengths = jnp.asarray([s, 10], 'int32')
+    out_len = nn_ops.flash_attention_op([q, q, q, lengths],
+                                        num_heads=2)
+    out_b = nn_ops.flash_attention_op(
+        [q, q, q, jnp.asarray(mask)], num_heads=2)
+    out_bh = nn_ops.flash_attention_op(
+        [q, q, q, jnp.asarray(np.repeat(mask, 2, axis=0))],
+        num_heads=2)
+    assert np.allclose(np.asarray(out_b), np.asarray(out_bh))
+    assert np.allclose(np.asarray(out_len), np.asarray(out_b),
+                       atol=RED)
+    with pytest.raises(ValueError):
+        nn_ops.flash_attention_op(
+            [q, q, q, jnp.asarray(np.ones((3, s, s), 'float32'))],
+            num_heads=2)
+
+
+def test_bn_inference_grad_bf16_data(knob):
+    """The fused-bn backward's coefficient cotangents must match the
+    (f32) coefficient columns even when the data is bf16 (the dbeta
+    dtype regression)."""
+    config.set('MXNET_TPU_PALLAS', 'epilogue')
+    rs = np.random.RandomState(9)
+    x = jnp.asarray(rs.randn(2, 4, 3, 3).astype('float32')) \
+        .astype(jnp.bfloat16)
+    g = jnp.asarray((rs.rand(4) + 0.5).astype('float32'))
+    b = jnp.asarray(rs.randn(4).astype('float32'))
+    mm = jnp.asarray(rs.randn(4).astype('float32'))
+    mv = jnp.asarray((rs.rand(4) + 0.1).astype('float32'))
+    grad = jax.grad(lambda x: nn_ops.batch_norm(
+        x, g, b, mm, mv, fix_gamma=False,
+        training=False)[0].astype(jnp.float32).sum())(x)
+    assert grad.dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(grad, dtype=np.float32)).all()
+
+
+def test_flash_attention_bf16_in_bf16_out(knob):
+    config.set('MXNET_TPU_PALLAS', 'attention')
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(4, 16, 8).astype('float32'))
+    out = nn_ops.flash_attention_op(
+        [q.astype(jnp.bfloat16)] * 3, num_heads=2)
+    assert out.dtype == jnp.bfloat16
+    ref = nn_ops.flash_attention_op([q] * 3, num_heads=2)
+    # f32 accumulation inside the kernel: only the input/output
+    # quantization separates the two
+    assert float(jnp.abs(out.astype(jnp.float32) - ref).max()) < 0.1
+
+
+def test_add_relu_broadcasting_falls_back(knob):
+    """Broadcastable-but-unequal shapes must behave identically in
+    both knob states (the kernel flattens; it only takes same-shape
+    operands)."""
+    x = jnp.asarray(np.random.RandomState(11).randn(2, 3, 4, 4)
+                    .astype('float32'))
+    y = jnp.asarray(np.random.RandomState(12).randn(1, 3, 1, 1)
+                    .astype('float32'))
+    config.set('MXNET_TPU_PALLAS', 'epilogue')
+    on = np.asarray(nn_ops.add_relu(x, y))
+    config.set('MXNET_TPU_PALLAS', '0')
+    off = np.asarray(nn_ops.add_relu(x, y))
+    assert on.shape == off.shape == (2, 3, 4, 4)
+    assert (on == off).all()
+
+
+def test_symbolic_transformer_knob_on_stays_correct(knob):
+    """The Symbol frontend has no ndim, so the flash valid-length
+    pass-through must not engage there — symbolic composition keeps
+    the (exact) reference path with the knob on."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.gluon.nn.transformer import TransformerEncoder
+    rs = np.random.RandomState(13)
+    x_np = rs.randn(2, 6, 8).astype('float32')
+    vl_np = np.array([4.0, 6.0], 'float32')
+
+    def run(pallas):
+        config.set('MXNET_TPU_PALLAS', pallas)
+        np.random.seed(0)
+        mx.random.seed(0)
+        enc = TransformerEncoder(num_layers=1, units=8, hidden_size=16,
+                                 num_heads=2, dropout=0.0)
+        enc.initialize(mx.init.Xavier())
+        enc(nd.array(x_np), nd.array(vl_np))   # materialize deferred
+        out_sym = enc(sym.Variable('x'), sym.Variable('vl'))
+        args = {p.name: p.data() for p in
+                enc.collect_params().values()}
+        args['x'] = nd.array(x_np)
+        args['vl'] = nd.array(vl_np)
+        ex = out_sym.bind(mx.cpu(), args)
+        return ex.forward()[0].asnumpy()
+
+    off = run('0')
+    on = run('attention')
+    assert (on == off).all()
+
+
+# -- decode-engine composition ----------------------------------------------
+
+
+def test_decode_token_stream_bit_identity_flash_on(knob):
+    from mxnet_tpu.serving.decode.model import init_transformer_lm
+    from mxnet_tpu.serving.decode.program import DecodeProgram
+    config.set('MXNET_TPU_PALLAS', 'attention')
+    model, params = init_transformer_lm(vocab=19, units=16, hidden=24,
+                                        layers=2, heads=4, max_len=32)
+    prog = DecodeProgram(model, params, slots=2,
+                         prefill_buckets=(4, 8))
+    dev = {k: jnp.asarray(v) for k, v in params.items()}
+    prompt = [7, 2, 9]
+    # reference: whole-sequence forward after every token (knob on)
+    toks, ref = list(prompt), []
+    for _ in range(6):
+        full = np.asarray(model.full_forward(
+            dev, jnp.asarray([toks], 'int32')))
+        t = int(full[0, -1].argmax())
+        ref.append(t)
+        toks.append(t)
+    # cached: prefill + steps through the slot cache (knob on)
+    cache = prog.new_cache()
+    cache, tok, _ = prog.run_prefill(cache, prompt, 1)
+    got, pos = [tok], len(prompt)
+    while len(got) < 6:
+        tk = np.zeros(prog.slots, 'int32')
+        ps = np.zeros(prog.slots, 'int32')
+        tk[1], ps[1] = got[-1], pos
+        cache, ts, _ = prog.run_step(cache, tk, ps)
+        got.append(int(ts[1]))
+        pos += 1
+    assert got == ref
+    # the knob is folded into the program keys (flip -> re-jit)
+    assert all(':pallas-attention' in k for k in prog.compile_seconds)
+
+
+def test_decode_program_keys_split_by_knob(knob):
+    from mxnet_tpu.serving.decode.model import init_rnn_lm
+    from mxnet_tpu.serving.decode.program import DecodeProgram
+    model, params = init_rnn_lm(vocab=11, embed=8, hidden=8, layers=1,
+                                max_len=16)
+    prog = DecodeProgram(model, params, slots=1, prefill_buckets=(4,))
+    config.set('MXNET_TPU_PALLAS', '0')
+    prog.compile_step()
+    config.set('MXNET_TPU_PALLAS', 'attention')
+    prog.compile_step()
+    keys = sorted(prog.compile_seconds)
+    assert keys == ['step', 'step:pallas-attention'], keys
+
+
+# -- audit / lint integration -----------------------------------------------
+
+
+_KERNEL_HLO = '''\
+HloModule jit_step, is_scheduled=true
+
+ENTRY %main.1 (p0: f32[8,64,16], p1: f32[8,64,16], p2: f32[8,64,16]) -> f32[8,64,16] {
+  %p0 = f32[8,64,16]{2,1,0} parameter(0)
+  %p1 = f32[8,64,16]{2,1,0} parameter(1)
+  %p2 = f32[8,64,16]{2,1,0} parameter(2)
+  %custom-call.1 = f32[8,64,16]{2,1,0} custom-call(f32[8,64,16]{2,1,0} %p0, f32[8,64,16]{2,1,0} %p1, f32[8,64,16]{2,1,0} %p2), custom_call_target="tpu_custom_call", metadata={op_name="jit(step)/pallas_call[name=mxnet_tpu_flash_attention_fwd]" source_file="attention.py" source_line=120}
+  %custom-call.2 = f32[8,64,16]{2,1,0} custom-call(f32[8,64,16]{2,1,0} %p0), custom_call_target="Sharding", metadata={op_name="jit(step)/sharding"}
+  ROOT %add.2 = f32[8,64,16]{2,1,0} add(f32[8,64,16]{2,1,0} %custom-call.1, f32[8,64,16]{2,1,0} %p0)
+}
+'''
+
+
+def test_roofline_attributes_kernel_custom_call():
+    from mxnet_tpu.observability import roofline
+    rows, totals = roofline.analyze(_KERNEL_HLO)
+    kernel = [r for r in rows if r['opcode'] == 'custom-call']
+    # the Pallas kernel is material (bytes + registered flops); the
+    # Sharding custom-call stays free
+    assert len(kernel) == 1
+    r = kernel[0]
+    assert r['bytes'] == 4 * 8 * 64 * 16 * 4     # q,k,v in + out
+    # 2 GEMMs at 2*BH*Sq*Sk*D + the elementwise term
+    assert r['flops'] == 2 * 2 * 8 * 64 * 64 * 16 + 5 * 8 * 64 * 64
+
+
+def test_roofline_unmatched_custom_call_stays_free():
+    from mxnet_tpu.observability import roofline
+    text = _KERNEL_HLO.replace('mxnet_tpu_flash_attention_fwd',
+                               'somebody_elses_kernel')
+    rows, _ = roofline.analyze(text)
+    assert not [r for r in rows if r['opcode'] == 'custom-call']
+
+
+def test_hlolint_pallas_rules():
+    from mxnet_tpu.analysis import hlolint
+    ok = hlolint.check(_KERNEL_HLO, {'pallas': ['attention'],
+                                     'platform': 'tpu',
+                                     'no_outfeed': True})
+    assert not ok
+    missing = hlolint.check(_KERNEL_HLO,
+                            {'pallas': ['attention', 'xent'],
+                             'platform': 'tpu', 'no_outfeed': True})
+    assert {f.rule for f in missing} == {'HLO-PALLAS-MISSING'}
+    unexpected = hlolint.check(_KERNEL_HLO,
+                               {'pallas': [], 'platform': 'tpu',
+                                'no_outfeed': True})
+    assert {f.rule for f in unexpected} == {'HLO-PALLAS-UNEXPECTED'}
+    # CPU rig: the interpreter inlines kernels, so absence is not a
+    # finding there
+    cpu = hlolint.check('ENTRY %m (p0: f32[8]) -> f32[8] {\n'
+                        '  ROOT %p0 = f32[8]{0} parameter(0)\n}\n',
+                        {'pallas': ['attention'], 'platform': 'cpu',
+                         'no_outfeed': True})
+    assert not cpu
+
+
+def test_expect_from_config_maps_pallas_families():
+    from mxnet_tpu.analysis.registry import expect_from_config
+    cfg = {'mesh': {'dp': 1}, 'amp': 'off', 'platform': 'cpu',
+           'pallas': 'attention,epilogue,xent',
+           'model': 'resnet50_v1'}
+    exp = expect_from_config(cfg)
+    # a resnet step has no attention to kernelize
+    assert exp['pallas'] == ('epilogue', 'xent')
+    cfg['model'] = 'bert-tiny'
+    assert expect_from_config(cfg)['pallas'] == \
+        ('attention', 'epilogue', 'xent')
+    # the inference decode step has no epilogue op or loss head —
+    # demanding them would be a guaranteed false MISSING finding
+    cfg['model'] = 'transformer_lm-decode-step'
+    assert expect_from_config(cfg)['pallas'] == ('attention',)
+    cfg['pallas'] = 'off'
+    assert expect_from_config(cfg)['pallas'] == ()
+
+
+def test_fusion_audit_config_records_knob(knob):
+    from mxnet_tpu.ops.pallas import resolve_spec
+    config.set('MXNET_TPU_PALLAS', 'xent')
+    assert resolve_spec() == 'xent'
+    config.set('MXNET_TPU_PALLAS', '0')
+    assert resolve_spec() == 'off'
+
+
+# -- AMP x Pallas -----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_amp_bf16_with_pallas_keeps_f32_masters(knob):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    config.set('MXNET_TPU_PALLAS', 'attention,epilogue,xent')
+    np.random.seed(0)
+    mx.random.seed(0)
+    from mxnet_tpu.gluon.model_zoo import bert as bert_zoo
+    net = bert_zoo.get_bert('bert_12_768_12', vocab_size=50,
+                            max_length=16, units=16, hidden_size=32,
+                            num_layers=1, num_heads=2, dropout=0.0)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    L = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    ids = nd.array(rs.randint(0, 50, (2, 8)))
+    tt = nd.array((rs.rand(2, 8) > 0.5).astype('float32'))
+    vl = nd.array(np.full((2,), 8, np.float32))
+    mp = nd.array(rs.randint(0, 8, (2, 2)))
+    mlm_y = nd.array(rs.randint(0, 50, (2, 2)))
+    nsp_y = nd.array(rs.randint(0, 2, (2,)))
+
+    def loss_fn(outs, labels):
+        _, _, mlm_s, nsp_s = outs
+        my, ny = labels
+        return L(mlm_s.reshape((-1, 50)), my.reshape((-1,))).mean() \
+            + L(nsp_s, ny).mean()
+
+    mesh = parallel.create_mesh({'dp': 1}, devices=jax.devices()[:1])
+    pt = parallel.ParallelTrainer(net, loss_fn, 'adamw',
+                                  {'learning_rate': 1e-4}, mesh,
+                                  amp='bf16')
+    loss = pt.step([ids, tt, vl, mp], [mlm_y, nsp_y])
+    assert np.isfinite(float(np.asarray(loss.asnumpy())))
+    # the AMP contract survives the kernels: fp32 masters
+    assert all(str(w.dtype) == 'float32' for w in pt._param_arrays)

@@ -288,6 +288,7 @@ def test_fusion_audit_config_records_platform():
     cfg = fa._mesh_config(_PT)
     assert cfg == {'mesh': {'dp': 4, 'model': 2}, 'zero': True,
                    'amp': 'bf16',
+                   'pallas': 'off',
                    'platform': jax.default_backend()}
 
 

@@ -46,6 +46,15 @@ Stages, each timed:
                            fusion count must not regress beyond the
                            MXNET_TPU_FUSION_BUDGET_* knobs
                            (docs/PERFORMANCE.md)
+  3b1. kernels             python -m mxnet_tpu.ops.pallas — the
+                           hand-written Pallas kernel selftest
+                           (flash attention, fused epilogues, fused
+                           softmax+xent) through the interpreter:
+                           forward/grad equivalence vs the XLA
+                           reference at the documented tiers, AMP
+                           bf16-in/f32-accumulate composition, and
+                           decode token-stream bit-identity with
+                           flash attention on (docs/PERFORMANCE.md)
   3b2. amp                 python -m mxnet_tpu.amp — the automatic-
                            mixed-precision selftest (docs/PRECISION.md):
                            policy resolution + per-op cast classes,
@@ -165,6 +174,14 @@ def main(argv=None):
         ('fusion-audit', [py, 'tools/fusion_audit.py', '--quick',
                           '--baseline', 'FUSION_BASELINE.json',
                           '--gate', '--out', '/tmp/FUSION.json']),
+        # hand-written Pallas kernel selftest (docs/PERFORMANCE.md
+        # "Hand-written kernels"): every kernel family through the
+        # interpreter against its reference XLA math — fwd + grad at
+        # the documented equivalence tiers, bf16-in/f32-accumulate
+        # AMP composition, and the decode token-stream bit-identity
+        # with flash attention on
+        ('kernels', [py, '-m', 'mxnet_tpu.ops.pallas',
+                     '--out', '/tmp/PALLAS_SELFTEST.json']),
         # automatic-mixed-precision contract (docs/PRECISION.md):
         # policy/scope semantics, amp-off bit-identity, fp32 masters
         # through the bf16 compiled step + bit-exact resume, fp16

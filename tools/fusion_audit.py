@@ -72,9 +72,15 @@ def _mesh_config(pt):
     makes diff_artifacts refuse it as a config change
     (docs/PRECISION.md)."""
     import jax
+    from mxnet_tpu.ops.pallas import resolve_spec
     return {'mesh': {k: int(v) for k, v in pt._mesh.shape.items()},
             'zero': bool(pt.zero),
             'amp': pt.amp,
+            # the Pallas kernel knob the step was built under: a
+            # kernelized program moves different bytes than its XLA
+            # twin, so cross-knob diffs must refuse (the --amp/--mesh
+            # pattern)
+            'pallas': resolve_spec(),
             'platform': jax.default_backend()}
 
 
@@ -154,8 +160,39 @@ def _build_bert_program(quick, mesh_axes=None, zero=False, amp=None):
     return pt, cfg
 
 
+def _build_decode_program(quick, mesh_axes=None, zero=False, amp=None):
+    """The TransformerLM decode-step program (the per-token hot loop
+    of the serving engine). Single-device by construction — the mesh/
+    zero/amp knobs do not apply; the Pallas knob does (the flash
+    decode kernel reads the slot KV cache in place), which is exactly
+    what `--pallas attention` audits here."""
+    del mesh_axes, zero, amp
+    import jax
+    from mxnet_tpu.ops.pallas import resolve_spec
+    from mxnet_tpu.serving.decode.model import init_transformer_lm
+    from mxnet_tpu.serving.decode.program import DecodeProgram
+    if quick:
+        vocab, units, hidden, layers, heads, max_len, slots = \
+            100, 32, 64, 2, 4, 64, 4
+    else:
+        vocab, units, hidden, layers, heads, max_len, slots = \
+            30522, 768, 3072, 12, 12, 256, 8
+    model, params = init_transformer_lm(
+        vocab, units=units, hidden=hidden, layers=layers, heads=heads,
+        max_len=max_len)
+    prog = DecodeProgram(model, params, slots=slots,
+                         prefill_buckets=(8,))
+    text = prog.compile_step().as_text()
+    cfg = {'model': 'transformer_lm-decode-step',
+           'units': units, 'layers': layers, 'slots': slots,
+           'max_len': max_len, 'pallas': resolve_spec(),
+           'platform': jax.default_backend()}
+    return text, cfg
+
+
 _BUILDERS = {'resnet50_step': _build_resnet_program,
-             'bert_step': _build_bert_program}
+             'bert_step': _build_bert_program,
+             'decode_step': _build_decode_program}
 
 
 def _parse_mesh(text):
@@ -193,10 +230,13 @@ def audit_program(name, quick, top=None, mesh_axes=None, zero=False,
     the roofline classifies the program against the matching peak
     (bf16/fp16 MXU rate vs the fp32 passthrough rate)."""
     from mxnet_tpu.observability import roofline
-    pt, config = _BUILDERS[name](quick, mesh_axes=mesh_axes, zero=zero,
-                                 amp=amp)
+    built, config = _BUILDERS[name](quick, mesh_axes=mesh_axes,
+                                    zero=zero, amp=amp)
     config['quick'] = bool(quick)
-    text = pt.compiled_text()
+    # trainer builders return the ParallelTrainer; the decode builder
+    # returns the compiled step program's HLO text directly
+    text = built.compiled_text() if hasattr(built, 'compiled_text') \
+        else built
     return roofline.roofline_artifact(text, program=name, top=top,
                                       config=config)
 
@@ -214,7 +254,11 @@ def main(argv=None):
         description='per-fusion roofline audit of the reference step '
                     'programs (mxnet_tpu.fusion.v1 artifacts)')
     p.add_argument('--model', default='both',
-                   choices=('resnet', 'bert', 'both'))
+                   choices=('resnet', 'bert', 'decode', 'both'),
+                   help="'decode' audits the TransformerLM decode-"
+                        'step program (the serving hot loop; combine '
+                        'with --pallas attention); the committed '
+                        "baseline covers 'both' = resnet + bert")
     p.add_argument('--quick', action='store_true',
                    help='small CI-sized model configs (the committed '
                         'baseline is built with --quick)')
@@ -249,6 +293,16 @@ def main(argv=None):
                         'precision diffs are refused, and the roofline '
                         'ridge uses the matching peak. Default: the '
                         'MXNET_TPU_AMP knob (off when unset)')
+    p.add_argument('--pallas', default=None, metavar='FAMILIES',
+                   help="build the step programs with the Pallas "
+                        "kernel families enabled ('attention,"
+                        "epilogue,xent', '1' = all, '0' = off; "
+                        'docs/PERFORMANCE.md "Hand-written kernels").'
+                        ' Recorded in the artifact config so knob-on '
+                        'audits never diff against the knob-off '
+                        'baseline; the delta vs the committed '
+                        'baseline is what the acceptance criterion '
+                        'reads. Default: the MXNET_TPU_PALLAS knob')
     p.add_argument('--zero', action='store_true',
                    help='build with the ZeRO dp-sharded weight update '
                         '(MXNET_TPU_ZERO semantics) — the audit then '
@@ -282,6 +336,12 @@ def main(argv=None):
     from mxnet_tpu.observability import roofline
     from mxnet_tpu.config import get as _cfg
 
+    if args.pallas is not None:
+        from mxnet_tpu import config as _config
+        from mxnet_tpu.ops.pallas import parse_spec
+        parse_spec(args.pallas)          # typo -> loud error, not off
+        _config.set('MXNET_TPU_PALLAS', args.pallas)
+
     programs = {}
     if args.hlo:
         text = open(args.hlo).read()
@@ -291,6 +351,7 @@ def main(argv=None):
             config={'source': 'hlo-dump'})
     else:
         wanted = {'resnet': ['resnet50_step'], 'bert': ['bert_step'],
+                  'decode': ['decode_step'],
                   'both': ['resnet50_step', 'bert_step']}[args.model]
         for name in wanted:
             print('== fusion_audit: building %s (%s%s%s%s)'
@@ -309,15 +370,7 @@ def main(argv=None):
         print(roofline.format_table(art))
         print()
 
-    combined = {'schema': roofline.SCHEMA, 'programs': programs}
-    _atomic_write(args.out, combined)
-    print('fusion_audit: wrote %s (%d program(s))'
-          % (args.out, len(programs)))
-    if args.write_baseline:
-        _atomic_write(args.write_baseline, combined)
-        print('fusion_audit: refreshed baseline %s'
-              % args.write_baseline)
-
+    problems = []
     if args.baseline:
         if not os.path.exists(args.baseline):
             if args.gate:
@@ -330,33 +383,68 @@ def main(argv=None):
             print('fusion_audit: no baseline at %s — skipping the diff'
                   ' (run --write-baseline to create one)'
                   % args.baseline)
-            return 0
-        base = json.load(open(args.baseline))
-        bytes_tol = float(_cfg('MXNET_TPU_FUSION_BUDGET_PCT'))
-        count_tol = int(_cfg('MXNET_TPU_FUSION_BUDGET_COUNT'))
-        problems = []
-        for name, art in programs.items():
-            b = base.get('programs', {}).get(name)
-            if b is None:
-                print('fusion_audit: baseline has no %r — skipping'
-                      % name)
-                continue
-            probs = roofline.diff_artifacts(
-                b, art, bytes_tol_pct=bytes_tol, count_tol=count_tol)
-            for pr in probs:
-                problems.append('%s: %s' % (name, pr))
-            delta = (art['totals']['hbm_bytes_per_step']
-                     - b['totals']['hbm_bytes_per_step'])
-            print('fusion_audit: %s bytes/step %+.3g vs baseline '
-                  '(fusions %d -> %d)%s'
-                  % (name, delta, b['totals']['fusion_count'],
-                     art['totals']['fusion_count'],
-                     ' REGRESSED' if probs else ' ok'))
-        if problems:
-            print('fusion_audit: FUSION BUDGET REGRESSION:\n  '
-                  + '\n  '.join(problems))
-            if args.gate:
-                return 1
+        else:
+            base = json.load(open(args.baseline))
+            bytes_tol = float(_cfg('MXNET_TPU_FUSION_BUDGET_PCT'))
+            count_tol = int(_cfg('MXNET_TPU_FUSION_BUDGET_COUNT'))
+            for name, art in programs.items():
+                b = base.get('programs', {}).get(name)
+                if b is None:
+                    print('fusion_audit: baseline has no %r — skipping'
+                          % name)
+                    continue
+                cfg_b = dict(b.get('config') or {})
+                cfg_a = dict(art.get('config') or {})
+                delta = (art['totals']['hbm_bytes_per_step']
+                         - b['totals']['hbm_bytes_per_step'])
+                if cfg_a != cfg_b and \
+                        {k: v for k, v in cfg_a.items()
+                         if k != 'pallas'} == \
+                        {k: v for k, v in cfg_b.items()
+                         if k != 'pallas'}:
+                    # same program, different Pallas knob: an A/B
+                    # measurement, not drift — record the delta in the
+                    # artifact (the acceptance number) instead of
+                    # gate-failing on the config refusal
+                    art['pallas_ab'] = {
+                        'baseline_pallas': cfg_b.get('pallas', 'off'),
+                        'pallas': cfg_a.get('pallas', 'off'),
+                        'baseline_hbm_bytes_per_step':
+                            b['totals']['hbm_bytes_per_step'],
+                        'hbm_bytes_per_step_delta': delta,
+                        'platform': cfg_a.get('platform'),
+                    }
+                    print('fusion_audit: %s pallas A/B (%s -> %s): '
+                          'bytes/step %+.3g vs baseline [%s rig]'
+                          % (name, cfg_b.get('pallas', 'off'),
+                             cfg_a.get('pallas', 'off'), delta,
+                             cfg_a.get('platform')))
+                    continue
+                probs = roofline.diff_artifacts(
+                    b, art, bytes_tol_pct=bytes_tol,
+                    count_tol=count_tol)
+                for pr in probs:
+                    problems.append('%s: %s' % (name, pr))
+                print('fusion_audit: %s bytes/step %+.3g vs baseline '
+                      '(fusions %d -> %d)%s'
+                      % (name, delta, b['totals']['fusion_count'],
+                         art['totals']['fusion_count'],
+                         ' REGRESSED' if probs else ' ok'))
+
+    combined = {'schema': roofline.SCHEMA, 'programs': programs}
+    _atomic_write(args.out, combined)
+    print('fusion_audit: wrote %s (%d program(s))'
+          % (args.out, len(programs)))
+    if args.write_baseline:
+        _atomic_write(args.write_baseline, combined)
+        print('fusion_audit: refreshed baseline %s'
+              % args.write_baseline)
+
+    if problems:
+        print('fusion_audit: FUSION BUDGET REGRESSION:\n  '
+              + '\n  '.join(problems))
+        if args.gate:
+            return 1
     return 0
 
 

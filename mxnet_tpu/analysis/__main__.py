@@ -195,6 +195,34 @@ ENTRY %main.1 (p0: f32[8,16,8], p1: f32[8,16,8], p2: f32[8,16,8]) -> f32[8,16,8]
 '''
 
 
+# paged decode-step fixtures (HLO-DECODE-PAGED): the good dump reads
+# the pool through a page-table gather and updates one row in place;
+# the bad dump materializes a pool-sized copy and never gathers
+_PAGED_HLO_GOOD = '''\
+HloModule jit_step, is_scheduled=true
+
+ENTRY %main.1 (p0: f32[33,16,32], p1: s32[4,2], p2: f32[4,32]) -> f32[33,16,32] {
+  %p0 = f32[33,16,32]{2,1,0} parameter(0)
+  %p1 = s32[4,2]{1,0} parameter(1)
+  %p2 = f32[4,32]{1,0} parameter(2)
+  %gather.1 = f32[4,2,16,32]{3,2,1,0} gather(f32[33,16,32]{2,1,0} %p0, s32[4,2]{1,0} %p1), offset_dims={1,2,3}
+  %reshape.2 = f32[1,1,32]{2,1,0} reshape(f32[4,32]{1,0} %p2)
+  ROOT %dynamic-update-slice.3 = f32[33,16,32]{2,1,0} dynamic-update-slice(f32[33,16,32]{2,1,0} %p0, f32[1,1,32]{2,1,0} %reshape.2, s32[] %c0, s32[] %c0, s32[] %c0)
+}
+'''
+
+_PAGED_HLO_BAD = '''\
+HloModule jit_step, is_scheduled=true
+
+ENTRY %main.1 (p0: f32[33,16,32], p1: f32[4,32]) -> f32[33,16,32] {
+  %p0 = f32[33,16,32]{2,1,0} parameter(0)
+  %p1 = f32[4,32]{1,0} parameter(1)
+  %copy.1 = f32[33,16,32]{2,1,0} copy(f32[33,16,32]{2,1,0} %p0)
+  ROOT %add.2 = f32[33,16,32]{2,1,0} add(f32[33,16,32]{2,1,0} %copy.1, f32[33,16,32]{2,1,0} %p0)
+}
+'''
+
+
 def _selftest():
     """The lint must catch the bad fixtures and pass the good ones."""
     import tempfile
@@ -284,6 +312,23 @@ def _selftest():
     if any(f.rule == 'HLO-PALLAS-MISSING' for f in fs):
         failures.append('hlolint selftest: HLO-PALLAS-MISSING must '
                         'not fire on a CPU (interpreter-mode) dump')
+
+    # HLO-DECODE-PAGED: page-table gather required, O(pool) copy
+    # forbidden (pool here is 33 pages x 16 rows x 32 f32 = 67584 B)
+    paged_expect = {'paged_decode': True, 'pool_bytes': 33 * 16 * 32
+                    * 4, 'no_outfeed': True, 'platform': 'tpu'}
+    fs = hlolint.check(_PAGED_HLO_GOOD, paged_expect,
+                       program='selftest-paged')
+    if fs:
+        failures.append('hlolint selftest: false positives on the '
+                        'good paged-decode fixture: %r' % fs)
+    fs = hlolint.check(_PAGED_HLO_BAD, paged_expect,
+                       program='selftest-paged')
+    rules = [f.rule for f in fs]
+    if rules.count('HLO-DECODE-PAGED') < 2:
+        failures.append('hlolint selftest: HLO-DECODE-PAGED must fire '
+                        'for BOTH the missing gather and the O(pool) '
+                        'copy (got %r)' % rules)
     return failures
 
 
@@ -316,6 +361,18 @@ def _build_program(devices, amp, zero):
     return pt.compiled_text()
 
 
+def _build_paged_decode():
+    """The paged decode-step program (the serving hot loop): its HLO
+    must read the KV pool through the page-table gather."""
+    from mxnet_tpu.serving.decode import (PagedDecodeProgram,
+                                          init_transformer_lm)
+    model, params = init_transformer_lm(vocab=32, units=16, hidden=24,
+                                        layers=1, heads=2, max_len=32)
+    prog = PagedDecodeProgram(model, params, slots=2,
+                              prefill_buckets=(8,), page_size=8)
+    return prog.compile_step().as_text()
+
+
 def _program_legs(devices):
     """(program_label, expect, hlo_text) for the fresh-build legs."""
     import jax
@@ -330,6 +387,14 @@ def _program_legs(devices):
          {'amp': 'bf16', 'dp': 1, 'donation': True,
           'platform': platform},
          lambda: _build_program(1, 'bf16', False)),
+        # paged decode-step contract: page-table gather present (the
+        # O(pool)-copy half self-gates to non-CPU platforms — XLA:CPU
+        # lowers the undonated in-place update as a functional copy)
+        ('decode_step_paged',
+         {'paged_decode': True,
+          'pool_bytes': 9 * 8 * 16 * 4,      # pages x ps x units x 4
+          'platform': platform, 'no_outfeed': True},
+         _build_paged_decode),
     ]
     if n > 1:
         legs.append(

@@ -21,7 +21,13 @@ reads — one shared instruction iterator,
     ``input_output_alias`` (donation silently dropped = double HBM
     residency);
   * ``no_outfeed`` — no outfeed/infeed/send/recv: the step makes no
-    host transfer, guardrail idle or not (docs/GUARDRAILS.md).
+    host transfer, guardrail idle or not (docs/GUARDRAILS.md);
+  * ``paged_decode`` — the paged decode-step contract
+    (docs/SERVING.md "Paged KV cache"): the per-slot K/V view must
+    read through the page table (a gather must be present) and no
+    instruction may materialize an O(pool)-sized ``copy`` of the KV
+    pool (``pool_bytes`` sets the threshold) — cache updates stay
+    O(1) dynamic-slice writes on donated pool buffers.
 
 ``check(hlo_text, expect)`` returns :class:`~mxnet_tpu.analysis.Finding`
 records; ``expect`` keys: ``amp`` ('bf16'|'fp16'|'off'), ``dp`` (int),
@@ -46,6 +52,23 @@ ALL_COLLECTIVES = tuple(COLLECTIVES) + ('collective-broadcast',
                                         'ragged-all-to-all')
 _HOST_TRANSFER = ('outfeed', 'infeed', 'send', 'recv')
 _ALIAS_RE = re.compile(r'input_output_alias=\{\s*([^}]*)\}')
+_RESULT_SHAPE_RE = re.compile(r'=\s*([a-z0-9]+)\[([0-9,]*)\]')
+_DTYPE_BYTES = {'f64': 8, 'f32': 4, 'f16': 2, 'bf16': 2, 's64': 8,
+                'u64': 8, 's32': 4, 'u32': 4, 's16': 2, 'u16': 2,
+                's8': 1, 'u8': 1, 'pred': 1}
+
+
+def _result_bytes(line):
+    """Byte size of an instruction's result buffer (0 when the line
+    carries no parseable array type)."""
+    m = _RESULT_SHAPE_RE.search(line)
+    if m is None:
+        return 0
+    n = _DTYPE_BYTES.get(m.group(1), 4)
+    for d in m.group(2).split(','):
+        if d.strip():
+            n *= int(d)
+    return n
 
 
 def _finding(rule, program, message, instr=None, severity='error'):
@@ -152,6 +175,34 @@ def check(hlo_text, expect, program='program'):
                     '%s in a step program — the compiled step must '
                     'not transfer to the host mid-step' % i.opcode,
                     instr=i.name))
+
+    if expect.get('paged_decode'):
+        # the paged decode-step contract (docs/SERVING.md): the page-
+        # table indirection must actually be a gather, and the pool
+        # must never be copied whole — a silent fallback to a dense
+        # per-slot cache (or a partitioner materializing the pool)
+        # would reintroduce the memory wall the layout removes
+        if not bases.get('gather') and not bases.get('dynamic-gather'):
+            findings.append(_finding(
+                'HLO-DECODE-PAGED', program,
+                'paged decode-step program contains no gather — the '
+                'per-slot K/V view is not reading through the page '
+                'table (docs/SERVING.md "Paged KV cache")'))
+        # the no-O(pool)-copy half is accelerator-only: XLA:CPU
+        # ignores donation and lowers the in-place row update as a
+        # functional whole-buffer copy — exactly the traffic donation
+        # removes on TPU, and why the donated-alias rule exists
+        pool_bytes = int(expect.get('pool_bytes') or 0)
+        if pool_bytes and platform != 'cpu':
+            for i in bases.get('copy', ()):
+                if _result_bytes(i.line) >= pool_bytes:
+                    findings.append(_finding(
+                        'HLO-DECODE-PAGED', program,
+                        'O(pool)-sized copy materializes the whole KV '
+                        'pool (%d+ bytes) — paged cache updates must '
+                        'stay O(1) dynamic-slice writes on the '
+                        'donated pool buffers' % pool_bytes,
+                        instr=i.name))
 
     if expect.get('pallas') is not None:
         # MXNET_TPU_PALLAS invariants (docs/PERFORMANCE.md): Mosaic

@@ -99,6 +99,13 @@ TRACE_ENTRY_POINTS = [
      {'taint': 'positional'}),
     ('mxnet_tpu/serving/decode/model.py', 'TransformerLM.full_forward',
      {'taint': 'positional'}),
+    # the paged decode bodies (pool + page-table arguments are traced)
+    ('mxnet_tpu/serving/decode/model.py',
+     'TransformerLM.paged_prefill', {'taint': 'positional'}),
+    ('mxnet_tpu/serving/decode/model.py', 'TransformerLM.paged_step',
+     {'taint': 'positional'}),
+    ('mxnet_tpu/serving/decode/model.py', 'TransformerLM.paged_verify',
+     {'taint': 'positional'}),
 ]
 
 # modules whose X.defvjp(fwd, bwd) wirings register fwd/bwd as
@@ -171,7 +178,7 @@ def expect_from_config(config, platform=None):
     mesh = config.get('mesh') or {}
     dp = int(mesh.get('dp', 1) or 1)
     amp = config.get('amp') or 'off'
-    return {
+    out = {
         'amp': amp if amp not in (None, False, 0) else 'off',
         'dp': dp,
         'zero': bool(config.get('zero')),
@@ -180,3 +187,16 @@ def expect_from_config(config, platform=None):
         'no_outfeed': True,
         'pallas': _pallas_families_for(config),
     }
+    if config.get('page_size'):
+        # a paged decode-step audit: assert the page-table gather and
+        # forbid O(pool) materializing copies; donation only where the
+        # backend honors it (decode programs build donate=False on the
+        # CPU rig)
+        out['paged_decode'] = True
+        # threshold for the O(pool)-copy check: one pool ARRAY's
+        # bytes (each layer's K and V pool is a separate buffer)
+        out['pool_bytes'] = int(config.get('pool_array_bytes')
+                                or config.get('pool_bytes') or 0)
+        if (out.get('platform') or '').lower() == 'cpu':
+            out['donation'] = False
+    return out

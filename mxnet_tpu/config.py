@@ -280,6 +280,46 @@ KNOBS = {k.name: k for k in [
           ' while sequences are in flight: raises join throughput at'
           ' the cost of decode-step latency jitter. An idle engine'
           ' always admits up to every free slot.'),
+    _knob('MXNET_TPU_SERVE_PAGED', bool, True,
+          'Use the block/paged KV cache for decode families that'
+          ' support it (transformers): a shared page pool + per-'
+          'sequence page tables instead of slots x max_len'
+          ' preallocation, so HBM is reserved per page actually'
+          ' used. 0 keeps the PR-6 slot cache'
+          ' (docs/SERVING.md "Paged KV cache").'),
+    _knob('MXNET_TPU_SERVE_PAGE_SIZE', int, 16,
+          'KV rows per page of the paged decode cache (power of'
+          ' two). Small pages waste less memory on short sequences'
+          ' and share prefixes at finer grain; large pages shrink'
+          ' page-table overhead and gather fan-in.'),
+    _knob('MXNET_TPU_SERVE_PAGES', int, 0,
+          'Page-pool size (pages, incl. the reserved trash page) for'
+          ' the paged decode cache. 0 (default) sizes the pool to the'
+          ' slot cache\'s worst case (slots x max_pages + 1); smaller'
+          ' pools trade worst-case capacity for HBM — admission'
+          ' rejects typed (BackpressureError) when the pool is'
+          ' exhausted, never a stall.'),
+    _knob('MXNET_TPU_SERVE_PREFIX_CACHE', bool, True,
+          'Share common prompt prefixes across sequences in the paged'
+          ' decode cache: full (and exactly-matching partial) prompt'
+          ' pages are refcounted and referenced read-only by later'
+          ' hash-matching prompts — prefilled once, copied-on-write'
+          ' at the first divergent token. 0 disables sharing.'),
+    _knob('MXNET_TPU_SERVE_SPEC_K', int, 0,
+          'Speculative-decoding lookahead: the draft model proposes'
+          ' this many tokens per scheduler tick and the target model'
+          ' verifies them in ONE batched step (greedy acceptance).'
+          ' 0 (default) disables speculation. Requires a paged target'
+          ' program and a draft (MXNET_TPU_SERVE_SPEC_DRAFT or'
+          ' DecodeEngine(draft=...)).'),
+    _knob('MXNET_TPU_SERVE_SPEC_DRAFT', str, None,
+          'Path to a frozen decode artifact to load as the'
+          ' speculative-decoding draft model (same vocab as the'
+          ' target; transformer family, so rejected proposals roll'
+          ' back for free; frozen SLOT-addressed, paged=False — a'
+          ' draft-sized cache has no memory wall to page). Unset ='
+          ' no speculation unless a draft is passed'
+          ' programmatically.'),
     _knob('MXNET_TPU_SERVE_MAX_CONCURRENT', int, 0,
           'Cap on in-flight HTTP POST handlers (one thread per'
           ' connection): past it requests shed instantly with 429 +'
@@ -308,6 +348,13 @@ KNOBS = {k.name: k for k in [
           'Per-fault recovery ceiling (seconds): after a scripted'
           ' fault burst clears, /status must report every session ok'
           ' with its breaker closed within this budget.'),
+    _knob('MXNET_TPU_SLO_PREFIX_TTFT_P99_MS', float, 400.0,
+          'TTFT p99 budget (ms) for the shared-prefix loadgen'
+          ' workload (mxnet_tpu.loadgen --mode prefix): Zipf-'
+          'distributed system prompts + one-token suffixes against'
+          ' the paged decode engine with prefix sharing on.'
+          ' SLO_BASELINE.json prefix_ttft_p99_ms overrides it in the'
+          ' slo CI stage.'),
     _knob('MXNET_TPU_SLO_GOODPUT', float, 0.9,
           'Capacity-search goodput floor: fraction of offered'
           ' requests served clean (200, no typed error) a rate must'

@@ -77,7 +77,8 @@ def main(argv=None):
         prog='python -m mxnet_tpu.loadgen',
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument('--mode', choices=('capacity', 'overload', 'chaos'),
+    p.add_argument('--mode', choices=('capacity', 'overload', 'chaos',
+                                      'prefix'),
                    default='overload')
     p.add_argument('--out', default='SLO.json')
     p.add_argument('--seed', type=int, default=None,
@@ -105,7 +106,7 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     from .harness import ServingRig, run_capacity, run_chaos, \
-        run_overload
+        run_overload, run_prefix
     from .harness import _knob
     seed = args.seed if args.seed is not None \
         else int(_knob('MXNET_TPU_LOADGEN_SEED', 0))
@@ -116,9 +117,21 @@ def main(argv=None):
     # decode workload the SLO guards)
     mix = {'predict': 1.0} if args.no_generate else None
 
-    rig = ServingRig(generate=not args.no_generate)
+    if args.mode == 'prefix':
+        if args.no_generate:
+            raise SystemExit('--mode prefix needs the generate rig')
+        # bigger prefill bucket: the shared-prefix workload carries
+        # page-aligned system prompts + a one-token suffix
+        rig = ServingRig(decode_prefill_buckets=(32,))
+    else:
+        rig = ServingRig(generate=not args.no_generate)
     try:
-        if args.mode == 'capacity':
+        if args.mode == 'prefix':
+            doc = run_prefix(rig, qps=args.qps or 12.0,
+                             duration_s=(args.duration
+                                         or 4.0 * scale),
+                             seed=seed)
+        elif args.mode == 'capacity':
             doc = run_capacity(
                 rig, slo_s=slo_s, mix=mix, seed=seed,
                 start_qps=args.qps or 16.0,

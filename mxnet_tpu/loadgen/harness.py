@@ -31,12 +31,13 @@ from __future__ import annotations
 import threading
 import time
 
+from ..serving.batcher import BackpressureError
 from .client import LoadClient, RequestRecord
 from .report import build_artifact, summarize
 from .schedule import build_schedule
 
 __all__ = ['ServingRig', 'Dispatcher', 'run_capacity', 'run_overload',
-           'run_chaos', 'DEFAULT_MIX', 'OVERLOAD_MIX']
+           'run_chaos', 'run_prefix', 'DEFAULT_MIX', 'OVERLOAD_MIX']
 
 # chaos soak: mostly-cheap traffic keeps the soak itself off the
 # host's critical path while faults fire
@@ -96,14 +97,25 @@ def _build_frozen():
     return freeze(mod, max_batch=8, name='loadgen-mlp')
 
 
-def _build_decoder(slots):
-    """Deterministic tiny LSTM LM — the /generate workload."""
-    from ..serving.decode import DecodeProgram, init_rnn_lm
-    model, params = init_rnn_lm(vocab=_VOCAB, embed=8, hidden=16,
-                                layers=1, mode='lstm', max_len=64,
-                                seed=5)
-    return DecodeProgram(model, params, slots=slots,
-                         prefill_buckets=(8,), name='loadgen-lm')
+def _build_decoder(slots, pages=None, prefill_buckets=(8,),
+                   max_len=64, page_size=8):
+    """Deterministic tiny transformer LM over the PAGED KV cache —
+    the /generate workload. The pool defaults to ~65% of the
+    worst-case (slots × max_pages) reservation: a production-shaped
+    oversubscription, so the chaos squeeze can actually exhaust it
+    while normal soak traffic never does."""
+    from ..serving.decode import (PagedDecodeProgram,
+                                  init_transformer_lm)
+    model, params = init_transformer_lm(vocab=_VOCAB, units=16,
+                                        hidden=24, layers=1, heads=2,
+                                        max_len=max_len, seed=5)
+    max_pages = -(-max_len // page_size)
+    if pages is None:
+        pages = max(2, int(0.65 * slots * max_pages) + 1)
+    return PagedDecodeProgram(model, params, slots=slots,
+                              prefill_buckets=prefill_buckets,
+                              page_size=page_size, pages=pages,
+                              name='loadgen-lm')
 
 
 class ServingRig:
@@ -120,7 +132,8 @@ class ServingRig:
                  timeout_s=5.0, deadline_ms=2.0, max_batch=8,
                  slots=4, decode_max_queue=6, max_new_tokens=8,
                  breaker_threshold=3, breaker_reset_s=0.4,
-                 max_concurrent=24, warmup=True):
+                 max_concurrent=24, warmup=True, decode_pages=None,
+                 decode_prefill_buckets=(8,), decode_max_len=64):
         from ..resilience.policy import CircuitBreaker
         from ..serving.server import InferenceSession, \
             ServingHTTPServer
@@ -144,7 +157,10 @@ class ServingRig:
                     reset_timeout=breaker_reset_s),
                 name='loadgen-predict')
         if generate:
-            prog = _build_decoder(slots)
+            prog = _build_decoder(
+                slots, pages=decode_pages,
+                prefill_buckets=decode_prefill_buckets,
+                max_len=decode_max_len)
             if warmup:
                 prog.warmup()
             self.decode_session = InferenceSession(
@@ -186,6 +202,12 @@ class ServingRig:
                 'retired': st['counts']['retired'],
                 'breaker': st['breaker'],
             }
+            if st.get('pages'):
+                out['generate']['pages'] = st['pages']
+                out['generate']['prefix_hits'] = \
+                    st['counts']['prefix_hits']
+                out['generate']['pool_exhausted'] = \
+                    st['counts']['pool_exhausted']
         return out
 
     def healthy(self, payload):
@@ -225,12 +247,17 @@ class Dispatcher:
     """
 
     def __init__(self, client, max_new_tokens=8, max_inflight=None,
-                 clock=time.monotonic, sleep=time.sleep):
+                 clock=time.monotonic, sleep=time.sleep,
+                 prefix_prompts=None):
         self.client = client
         self.max_new_tokens = int(max_new_tokens)
         self.max_inflight = int(
             max_inflight if max_inflight is not None
             else _knob('MXNET_TPU_LOADGEN_MAX_INFLIGHT', 512))
+        # shared-prefix workload mode: generate payloads draw a system
+        # prompt Zipf-style (rank weights ~ 3:2:1) and append a
+        # per-rid suffix token — deterministic in rid, so runs replay
+        self.prefix_prompts = [list(p) for p in (prefix_prompts or [])]
         self._clock = clock
         self._sleep = sleep
         # O(1) in-flight accounting: the dispatch loop sits on the
@@ -249,11 +276,24 @@ class Dispatcher:
     def _generate_payload(rid):
         return [1 + (rid % (_VOCAB - 2)), 2, 3]
 
+    # Zipf-ish rank pick over 3 prompts: ranks weighted 3:2:1 (the
+    # harmonic 1/(r+1) shape at n=3), pure function of rid
+    _ZIPF_RANKS = (0, 0, 0, 1, 1, 2)
+
+    def _prefix_payload(self, rid):
+        prompts = self.prefix_prompts
+        rank = self._ZIPF_RANKS[rid % len(self._ZIPF_RANKS)]
+        sp = prompts[rank % len(prompts)]
+        return sp + [1 + (rid % (_VOCAB - 2))]
+
     def _fire(self, rec):
         try:
             if rec.kind == 'generate':
+                payload = self._prefix_payload(rec.rid) \
+                    if self.prefix_prompts \
+                    else self._generate_payload(rec.rid)
                 self.client.generate(
-                    rec, self._generate_payload(rec.rid),
+                    rec, payload,
                     max_new_tokens=self.max_new_tokens)
             else:
                 self.client.predict(rec,
@@ -300,11 +340,12 @@ class Dispatcher:
 
 
 def _run_window(rig, qps, duration_s, mix, seed, timeout_s,
-                poisson=True):
+                poisson=True, prefix_prompts=None):
     """One open-loop window against the rig; returns (records,
     unresolved)."""
     client = LoadClient('127.0.0.1', rig.port, timeout_s=timeout_s)
-    disp = Dispatcher(client, max_new_tokens=rig.max_new_tokens)
+    disp = Dispatcher(client, max_new_tokens=rig.max_new_tokens,
+                      prefix_prompts=prefix_prompts)
     arrivals = build_schedule(qps, duration_s, mix=mix, seed=seed,
                               poisson=poisson)
     records, threads = disp.run(arrivals)
@@ -591,8 +632,16 @@ def run_chaos(rig, qps=20.0, duration_s=12.0, mix=None, seed=0,
     records = box.get('records', [])
     threads = box.get('threads', [])
     unresolved = disp.drain(threads, timeout_s + 2.0)
-    # settle, then capture the server-side drain proof
+    # settle FIRST (breaker closed, queues drained) so the squeeze
+    # exercises the pool, not a still-degraded engine whose fallback
+    # path would never allocate a page
     _settle(rig)
+    # page-pool squeeze: exhaust the (deliberately oversubscribed)
+    # paged decode pool mid-stream and prove the zero-hang invariant
+    # holds there too — every squeezed stream resolves, the failures
+    # are typed BackpressureError, never a stall
+    squeeze = _pool_squeeze(rig, budget_s=timeout_s + 10.0)
+    # capture the server-side drain proof (incl. the squeeze's counts)
     server = rig.server_stats()
     m = summarize(records)
     m['unresolved'] = max(m['unresolved'], unresolved)
@@ -612,9 +661,120 @@ def run_chaos(rig, qps=20.0, duration_s=12.0, mix=None, seed=0,
         'no_leaked_slots': leaked == 0,
     }
     metrics = dict(m, aborted_typed=aborted)
+    if squeeze is not None:
+        metrics['pool_squeeze'] = squeeze
+        verdicts['pool_exhaustion_typed'] = (
+            squeeze['pool_exhausted'] > 0
+            and squeeze['unresolved'] == 0
+            and squeeze['untyped_failures'] == 0)
     return build_artifact(
         'chaos',
         {'qps': qps, 'duration_s': duration_s, 'seed': seed,
          'availability_floor': availability_floor,
          'recovery_ceiling_s': recovery_ceiling_s, 'mix': mix},
         metrics, faults=faults, server=server, verdicts=verdicts)
+
+
+def _pool_squeeze(rig, budget_s=15.0):
+    """Drive the paged decode pool past exhaustion: more long
+    generations than the oversubscribed pool can hold. Returns the
+    squeeze record, or None when the rig mounts no paged decoder.
+
+    Invariant gated: every squeezed stream RESOLVES within the budget
+    — completed, or failed with the typed BackpressureError — and the
+    engine counted pool exhaustion. An unresolved stream here is a
+    stall, the exact failure mode typed backpressure exists to
+    prevent."""
+    sess = rig.decode_session
+    if sess is None or not getattr(sess._engine, 'paged', False):
+        return None
+    eng = sess._engine
+    prog = eng.program
+    max_new = max(8, prog.max_len - 8)
+    n = eng.slots * 2
+    streams = []
+    shed_at_admission = 0
+    for i in range(n):
+        try:
+            streams.append(eng.generate(
+                [1 + (i % (_VOCAB - 2)), 2, 3],
+                max_new_tokens=max_new))
+        except BackpressureError:
+            shed_at_admission += 1
+    from ..serving.batcher import RequestTimeout
+    deadline = time.monotonic() + budget_s
+    typed = completed = untyped = unresolved = timed_out = 0
+    for s in streams:
+        try:
+            s.result(max(0.1, deadline - time.monotonic()))
+            completed += 1
+        except BackpressureError:
+            typed += 1
+        except RequestTimeout:
+            # the per-request budget fired (typed, resolved) — only
+            # an UNRESOLVED stream is a stall
+            if s.done():
+                timed_out += 1
+            else:
+                unresolved += 1
+        except Exception:
+            if s.done():
+                untyped += 1
+            else:
+                unresolved += 1
+    st = eng.stats()
+    return {'streams': len(streams),
+            'shed_at_admission': shed_at_admission,
+            'completed': completed,
+            'typed_backpressure': typed,
+            'timed_out': timed_out,
+            'untyped_failures': untyped,
+            'unresolved': unresolved,
+            'pool_exhausted': st['counts']['pool_exhausted'],
+            'page_evictions': st['counts']['page_evictions'],
+            'pages': st.get('pages')}
+
+
+def run_prefix(rig, qps=12.0, duration_s=4.0, seed=0,
+               ttft_p99_budget_s=None, timeout_s=6.0,
+               system_prompt_len=24):
+    """Shared-prefix workload mode: generate-only open-loop traffic
+    whose prompts draw a system prompt Zipf-style (3:2:1 over three
+    prompts) plus a one-token user suffix — the workload prefix
+    sharing exists for. Gates a TTFT p99 budget
+    (``MXNET_TPU_SLO_PREFIX_TTFT_P99_MS`` / SLO_BASELINE
+    ``prefix_ttft_p99_ms``) and that sharing actually engaged
+    (prefix hits observed server-side)."""
+    import random as _random
+    if rig.decode_session is None:
+        raise ValueError('prefix mode needs a generate-capable rig')
+    ttft_p99_budget_s = float(
+        ttft_p99_budget_s if ttft_p99_budget_s is not None
+        else _knob('MXNET_TPU_SLO_PREFIX_TTFT_P99_MS', 400.0) / 1e3)
+    rng = _random.Random(seed + 101)
+    prompts = [[1 + rng.randrange(_VOCAB - 2)
+                for _ in range(int(system_prompt_len))]
+               for _ in range(3)]
+    records, unresolved = _run_window(
+        rig, qps, duration_s, {'generate': 1.0}, seed, timeout_s,
+        prefix_prompts=prompts)
+    _settle(rig)
+    server = rig.server_stats()
+    m = summarize(records)
+    m['unresolved'] = max(m['unresolved'], unresolved)
+    gen = m.get('generate') or {}
+    ttft_p99 = (gen.get('ttft') or {}).get('p99_ms')
+    hits = (server.get('generate') or {}).get('prefix_hits', 0)
+    verdicts = {
+        'prefix_ttft_within_budget': ttft_p99 is not None
+        and ttft_p99 <= ttft_p99_budget_s * 1e3,
+        'prefix_hits_observed': hits > 0,
+        'zero_unresolved': m['unresolved'] == 0,
+    }
+    return build_artifact(
+        'prefix',
+        {'qps': qps, 'duration_s': duration_s, 'seed': seed,
+         'system_prompt_len': int(system_prompt_len),
+         'zipf_system_prompts': len(prompts),
+         'prefix_ttft_p99_budget_ms': ttft_p99_budget_s * 1e3},
+        m, server=server, verdicts=verdicts)

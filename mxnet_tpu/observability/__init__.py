@@ -222,6 +222,38 @@ def serving_instruments():
             active_slots=gauge('mxnet_tpu_serve_active_slots',
                                help='in-flight sequences in the '
                                     'continuous decode batch'),
+            # paged KV cache (serving/decode/paged.py): the flight
+            # recorder pairs these with page_alloc / page_evict /
+            # prefix_hit events so pool-exhaustion admission
+            # rejections are explainable post-hoc
+            pages_total=gauge('mxnet_tpu_serve_pages_total',
+                              help='allocatable KV pages in the paged '
+                                   'decode pool (excl. the reserved '
+                                   'trash page)'),
+            pages_free=gauge('mxnet_tpu_serve_pages_free',
+                             help='currently free KV pages in the '
+                                  'paged decode pool'),
+            page_occupancy=gauge(
+                'mxnet_tpu_serve_page_occupancy_pct',
+                help='percent of the paged decode pool in use '
+                     '(allocated or prefix-cached)'),
+            prefix_hits=counter(
+                'mxnet_tpu_serve_prefix_hits_total',
+                help='admissions that referenced shared prompt-'
+                     'prefix pages instead of re-prefilling them'),
+            prefix_tokens_saved=counter(
+                'mxnet_tpu_serve_prefix_tokens_saved_total',
+                help='prompt tokens whose prefill compute was '
+                     'skipped via prefix sharing'),
+            spec_proposed=counter(
+                'mxnet_tpu_serve_spec_proposed_total',
+                help='draft-model tokens proposed by speculative '
+                     'decoding'),
+            spec_accepted=counter(
+                'mxnet_tpu_serve_spec_accepted_total',
+                help='draft proposals accepted by the target '
+                     'verify step (acceptance rate = accepted / '
+                     'proposed)'),
         )
     return _serving_inst
 

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 __all__ = ['KINDS', 'parse_spec', 'resolve_spec', 'enabled',
            'interpret_mode', 'flash_attention', 'flash_decode_attention',
+           'flash_paged_decode_attention',
            'online_softmax_block', 'fused_bn_apply', 'fused_act',
            'fused_add_act', 'fused_softmax_xent_rows', 'greedy_nms_keep',
            'selftest']
@@ -133,6 +134,7 @@ def interpret_mode():
 _LAZY_EXPORTS = {
     'flash_attention': '.attention',
     'flash_decode_attention': '.attention',
+    'flash_paged_decode_attention': '.attention',
     'online_softmax_block': '.attention',
     'fused_bn_apply': '.epilogue',
     'fused_act': '.epilogue',

@@ -53,7 +53,8 @@ __all__ = [
     'RequestTimeout', 'FROZEN_SCHEMA', 'FrozenProgram', 'freeze',
     'load_frozen', 'InferenceSession', 'ServingHTTPServer',
     'maybe_start_http_server', 'decode', 'DecodeProgram',
-    'DecodeEngine', 'GenerateStream', 'freeze_decode', 'load_decode',
+    'PagedDecodeProgram', 'DecodeEngine', 'GenerateStream',
+    'freeze_decode', 'load_decode',
 ]
 
 # No serving module imports jax at module top (device work happens
@@ -66,7 +67,7 @@ __all__ = [
 # every later importer.
 from . import decode
 from .decode import (DecodeEngine, DecodeProgram, GenerateStream,
-                     freeze_decode, load_decode)
+                     PagedDecodeProgram, freeze_decode, load_decode)
 from .server import (InferenceSession, ServingHTTPServer,
                      maybe_start_http_server)
 from .freeze import FROZEN_SCHEMA, FrozenProgram, load_frozen

@@ -31,12 +31,16 @@ from .cache import CacheSpec, cache_bytes, init_cache, write_position, \
 from .engine import DecodeEngine, GenerateStream
 from .model import (DecodeModel, RNNLM, TransformerLM, from_gluon_rnn_lm,
                     init_rnn_lm, init_transformer_lm, model_from_config)
-from .program import DecodeProgram, freeze_decode, load_decode
+from .paged import (PageAllocator, PagedCacheSpec, PrefixCache,
+                    pool_bytes)
+from .program import (DecodeProgram, PagedDecodeProgram, freeze_decode,
+                      load_decode)
 
 __all__ = [
     'CacheSpec', 'cache_bytes', 'init_cache', 'write_position',
     'write_slot', 'DecodeEngine', 'GenerateStream', 'DecodeModel',
     'RNNLM', 'TransformerLM', 'from_gluon_rnn_lm', 'init_rnn_lm',
     'init_transformer_lm', 'model_from_config', 'DecodeProgram',
-    'freeze_decode', 'load_decode',
+    'PagedDecodeProgram', 'PageAllocator', 'PagedCacheSpec',
+    'PrefixCache', 'pool_bytes', 'freeze_decode', 'load_decode',
 ]

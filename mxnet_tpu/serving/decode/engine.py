@@ -27,10 +27,31 @@ circuit breaker + stall watchdog (fault-injection site
 sequence DEGRADED on the CPU fallback (same math, same tokens) rather
 than erroring mid-stream.
 
+**Paged scheduling** (program.paged — docs/SERVING.md "Paged KV
+cache, prefix sharing, speculative decoding"): the engine owns the
+page pool's host state — a :class:`~.paged.PageAllocator` free list
+with refcounts, lazy per-token page allocation when a sequence's
+position crosses a page boundary, a :class:`~.paged.PrefixCache`
+that lands hash-matching prompts on shared read-only pages (no
+prefill program runs; the suffix streams through the regular decode
+step), copy-on-write before any write into a shared page, and
+LRU eviction of unreferenced cached prefixes under pool pressure.
+Pool exhaustion is TYPED — admission and mid-stream allocation
+failures finish the stream with :class:`BackpressureError`, never a
+stall — and the compiled programs never see any of it (page churn
+costs zero retraces). **Speculative decoding**: with a ``draft``
+program and ``spec_k > 0``, each tick runs the draft ``k`` single
+steps to propose tokens and ONE target ``verify`` call to score all
+``k + 1`` positions; the longest greedy-matching prefix is accepted
+(plus the target's own correction token), and rejected KV rows are
+simply masked until overwritten — paged rollback is free.
+
 The scheduler is pure queue/slot math over a duck-typed program
 (``slots``, ``new_cache``, ``run_prefill``, ``run_step``,
-``fallback_generate``) — numpy + stdlib only, testable with a fake
-program and a fake clock, the same discipline as batcher.py.
+``fallback_generate``; paged programs add ``page_size`` / ``pages``
+/ ``max_pages`` / ``run_copy_page`` / ``run_verify``) — numpy +
+stdlib only, testable with a fake program and a fake clock, the same
+discipline as batcher.py.
 """
 from __future__ import annotations
 
@@ -42,10 +63,20 @@ import time
 import numpy as onp
 
 from ..batcher import BackpressureError, BatcherClosed, RequestTimeout
+from .paged import TRASH_PAGE, PageAllocator, PrefixCache, pages_for
 
 __all__ = ['GenerateStream', 'DecodeEngine']
 
 _DONE = object()          # stream sentinel
+
+
+def _knob(name, default):
+    try:
+        from ... import config as _config
+        v = _config.get(name)
+        return default if v is None else v
+    except Exception:
+        return default
 
 
 def _serving_instruments():
@@ -151,7 +182,7 @@ class _Seq:
 
     __slots__ = ('stream', 'prompt', 'max_new', 'eos_id', 'slot',
                  'pos', 'last_token', 'enqueued_at', 'deadline_at',
-                 'first_token_at')
+                 'first_token_at', 'table', 'pages')
 
     def __init__(self, stream, prompt, max_new, eos_id, enqueued_at,
                  deadline_at):
@@ -165,6 +196,22 @@ class _Seq:
         self.enqueued_at = enqueued_at
         self.deadline_at = deadline_at
         self.first_token_at = None
+        # paged scheduling: the per-sequence page table (np int32,
+        # max_pages entries, trash-page filled) + the pool pages this
+        # sequence holds allocator refs on
+        self.table = None
+        self.pages = []
+
+    @property
+    def prompt_len(self):
+        return len(self.prompt)
+
+    @property
+    def extending(self):
+        """True while a prefix-hit sequence is still streaming its
+        un-shared prompt suffix through the decode step (its step
+        outputs are not emitted until the last prompt token feeds)."""
+        return self.pos is not None and self.pos < len(self.prompt)
 
 
 class _DegradedPath(Exception):
@@ -196,7 +243,7 @@ class DecodeEngine:
     def __init__(self, program, max_queue=256, timeout_s=30.0,
                  max_new_tokens=64, breaker=None, watchdog=None,
                  prefill_interleave=1, name='decode',
-                 clock=time.monotonic):
+                 clock=time.monotonic, draft=None, prefix_cache=None):
         from ...resilience.policy import CircuitBreaker
         self.program = program
         self.slots = int(program.slots)
@@ -223,7 +270,52 @@ class DecodeEngine:
         self._fallback_threads = []   # degraded completions in flight
         self._counts = {'requests': 0, 'rejected': 0, 'tokens': 0,
                         'prefills': 0, 'steps': 0, 'timeouts': 0,
-                        'fallback_tokens': 0, 'retired': {}}
+                        'fallback_tokens': 0, 'retired': {},
+                        'prefix_hits': 0, 'prefix_tokens_saved': 0,
+                        'spec_proposed': 0, 'spec_accepted': 0,
+                        'spec_rounds': 0, 'cow_copies': 0,
+                        'pool_exhausted': 0, 'page_evictions': 0}
+        # paged scheduling state (host side of the page pool)
+        self.paged = bool(getattr(program, 'paged', False))
+        self._allocator = None
+        self._prefix = None
+        if self.paged:
+            self._allocator = PageAllocator(program.pages)
+            if prefix_cache is None:
+                prefix_cache = bool(
+                    _knob('MXNET_TPU_SERVE_PREFIX_CACHE', True))
+            if prefix_cache:
+                self._prefix = PrefixCache(program.page_size,
+                                           self._allocator)
+        # speculative decoding: draft proposes spec_k tokens per tick,
+        # the target verifies them in one batched call
+        self._draft = None
+        self._draft_cache = None
+        self.spec_k = 0
+        if draft is not None:
+            spec_k = int(getattr(program, 'spec_k', 0))
+            if not self.paged or not spec_k:
+                raise ValueError(
+                    'speculative decoding needs a paged target '
+                    'program with spec_k > 0 (got paged=%r spec_k=%r)'
+                    % (self.paged, spec_k))
+            if int(draft.slots) != self.slots:
+                raise ValueError('draft slots %d != target slots %d'
+                                 % (int(draft.slots), self.slots))
+            if getattr(draft, 'paged', False):
+                raise ValueError(
+                    'the draft must be a slot-addressed program (its '
+                    'whole cache fits — there is no memory wall to '
+                    'page at draft size); freeze it with paged=False')
+            dm = getattr(draft, 'model', None)
+            if dm is not None and not getattr(dm, 'supports_paging',
+                                              True):
+                raise ValueError(
+                    'draft family %r cannot roll back rejected '
+                    'proposals (needs a position-addressed cache: '
+                    'use a transformer draft)' % (dm.family,))
+            self._draft = draft
+            self.spec_k = spec_k
         self._worker = threading.Thread(
             target=self._run, daemon=True,
             name='mxnet-tpu-%s-decode' % name)
@@ -358,7 +450,10 @@ class DecodeEngine:
                     break
                 seq = self._pending.pop(0)
                 slot = self._free.pop(0)
-            self._admit(seq, slot)
+            if self.paged:
+                self._admit_paged(seq, slot)
+            else:
+                self._admit(seq, slot)
             budget -= 1
         if self._active:
             self._step()
@@ -367,6 +462,11 @@ class DecodeEngine:
             with self._lock:
                 inst.active_slots.set(len(self._active))
                 inst.queue_depth.set(len(self._pending))
+                if self._allocator is not None:
+                    pool = self._allocator.stats()
+                    inst.pages_total.set(pool['pages_total'])
+                    inst.pages_free.set(pool['pages_free'])
+                    inst.page_occupancy.set(pool['occupancy_pct'])
 
     def _retire_abandoned(self):
         """Free slots whose stream is already done (timeout reaper or
@@ -388,8 +488,122 @@ class DecodeEngine:
                 self._free.append(slot)
                 self._counts['retired'][reason] = \
                     self._counts['retired'].get(reason, 0) + 1
+                # drop the sequence's page holds; pages whose prefix
+                # registration still holds a ref stay resident for
+                # future hits (evicted LRU under pool pressure)
+                if self._allocator is not None and seq.pages:
+                    for p in seq.pages:
+                        self._allocator.release(p)
+                    seq.pages = []
         _record_event('decode_retire', slot=slot, reason=reason,
                       tokens=len(seq.stream.tokens))
+
+    # -- paged pool bookkeeping (worker thread only) -----------------------
+
+    def _rebuild_cache(self):
+        """Fresh device cache after a failed call (donated buffers are
+        unusable): the pool's host state — free list, refcounts,
+        prefix registrations — describes garbage now, so it resets
+        with it. Callers retire (and release) in-flight slots FIRST.
+        """
+        self._cache = self.program.new_cache()
+        if self._allocator is not None:
+            # under the lock: stats()/cache_accounting() readers must
+            # never observe a half-reset pool (free list rebuilt,
+            # refcounts/registry still stale)
+            with self._lock:
+                self._allocator.reset()
+                if self._prefix is not None:
+                    self._prefix.clear()
+        if self._draft is not None:
+            self._draft_cache = self._draft.new_cache()
+
+    def _release_seq_pages(self, seq):
+        with self._lock:
+            if self._allocator is not None and seq.pages:
+                for p in seq.pages:
+                    self._allocator.release(p)
+                seq.pages = []
+
+    def _alloc_pages(self, n, slot):
+        """``n`` fresh pages, evicting LRU cached prefixes under pool
+        pressure; None on exhaustion (the caller fails TYPED)."""
+        with self._lock:
+            ids = self._allocator.alloc(n)
+            evicted = []
+            if ids is None and self._prefix is not None:
+                evicted = self._prefix.evict_lru(n)
+                ids = self._allocator.alloc(n)
+            if evicted:
+                self._counts['page_evictions'] += len(evicted)
+        for p in evicted:
+            _record_event('page_evict', page=p, slot=slot)
+        if ids is not None and slot is not None:
+            _record_event('page_alloc', pages=len(ids), slot=slot)
+        return ids
+
+    def _fail_pool_exhausted(self, seq, slot, where):
+        """Pool exhaustion is typed backpressure, never a stall: the
+        stream fails with BackpressureError (the flight recorder
+        explains the admission rejection), the client backs off."""
+        with self._lock:
+            self._counts['pool_exhausted'] += 1
+            depth = len(self._pending)
+            free = self._allocator.free_pages
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.rejected.labels(reason='pool_exhausted').inc()
+        _record_event('serve_reject', reason='pool_exhausted',
+                      slot=slot, where=where, pages_free=free,
+                      depth=depth)
+        seq.stream._finish('error', BackpressureError(
+            depth, self.max_queue))
+
+    def _ensure_writable(self, seq, first_pos, last_pos):
+        """Make every page this tick will write — positions
+        ``first_pos..last_pos`` of ``seq`` — privately writable:
+        lazily allocate pages at boundary crossings, copy-on-write
+        pages shared with other sequences or the prefix registry.
+        Returns False on pool exhaustion (after LRU eviction); device
+        errors from the COW copy propagate to the caller's
+        degrade/abort handling."""
+        ps = self.program.page_size
+        for pi in range(int(first_pos) // ps, int(last_pos) // ps + 1):
+            page = int(seq.table[pi])
+            if page == TRASH_PAGE:
+                ids = self._alloc_pages(1, seq.slot)
+                if ids is None:
+                    return False
+                seq.table[pi] = ids[0]
+                with self._lock:
+                    seq.pages.append(ids[0])
+                continue
+            with self._lock:
+                shared = self._allocator.refcount(page) > 1
+                if shared and self._prefix is not None \
+                        and self._allocator.refcount(page) == 2:
+                    # only co-holder is the prefix registry: steal the
+                    # registration back instead of copying — the
+                    # write is private, no extra page burned (real
+                    # sharers keep the full copy-on-write below)
+                    if self._prefix.release_leaf(page):
+                        shared = self._allocator.refcount(page) > 1
+            if not shared:
+                continue
+            # copy-on-write: the first divergent write into a shared
+            # page lands in this sequence's private copy
+            ids = self._alloc_pages(1, seq.slot)
+            if ids is None:
+                return False
+            self._cache = self._device(self.program.run_copy_page,
+                                       self._cache, page, ids[0])
+            with self._lock:
+                self._allocator.release(page)
+                seq.pages.remove(page)
+                seq.pages.append(ids[0])
+                self._counts['cow_copies'] += 1
+            seq.table[pi] = ids[0]
+        return True
 
     # -- device calls under breaker + watchdog -----------------------------
 
@@ -546,6 +760,124 @@ class DecodeEngine:
             seq.stream._finish(reason)
             self._retire(slot, seq, reason)
 
+    def _admit_paged(self, seq, slot):
+        """Paged join: a prefix-cache hit references the shared pages
+        and streams the remaining prompt through the decode step (no
+        prefill program runs — the prefix was prefilled ONCE); a miss
+        allocates pages and runs one bucketed prefill into them."""
+        if seq.stream.done() or seq.stream._cancelled:
+            if not seq.stream.done():
+                seq.stream._finish('cancelled')
+            with self._lock:
+                self._free.append(slot)
+            return
+        prompt = seq.prompt
+        n = len(prompt)
+        seq.table = onp.full(self.program.max_pages, TRASH_PAGE,
+                             'int32')
+        shared, covered = [], 0
+        if self._prefix is not None:
+            with self._lock:
+                shared, covered = self._prefix.lookup(prompt)
+            # always leave >= 1 suffix token to step on: its logits
+            # are the first generated token
+            covered = min(covered, n - 1)
+        try:
+            if self._cache is None:
+                self._rebuild_cache()
+            if covered > 0:
+                with self._lock:
+                    for p in shared:
+                        self._allocator.ref(p)
+                    seq.pages = list(shared)
+                    self._counts['prefix_hits'] += 1
+                    self._counts['prefix_tokens_saved'] += covered
+                seq.table[:len(shared)] = shared
+                seq.slot = slot
+                seq.pos = covered
+                seq.last_token = int(prompt[covered])
+                if self._draft is not None:
+                    # the draft has no prefix cache: prefill it whole
+                    # (cheap — that is what makes it a draft)
+                    self._draft_cache, _dt, _dl = self._device(
+                        self._draft.run_prefill, self._draft_cache,
+                        onp.asarray(prompt, 'int32'), slot)
+                inst = _serving_instruments()
+                if inst is not None:
+                    inst.prefix_hits.inc()
+                    inst.prefix_tokens_saved.inc(covered)
+                _record_event('prefix_hit', slot=slot, prompt_len=n,
+                              tokens_shared=covered,
+                              pages_shared=len(shared))
+                _record_event('decode_admit', slot=slot, prompt_len=n,
+                              prefix_tokens=covered)
+                with self._lock:
+                    self._active[slot] = seq
+                return
+            ids = self._alloc_pages(pages_for(n,
+                                              self.program.page_size),
+                                    slot)
+            if ids is None:
+                self._fail_pool_exhausted(seq, slot, where='admit')
+                with self._lock:
+                    self._free.append(slot)
+                return
+            with self._lock:
+                seq.pages = list(ids)
+            seq.table[:len(ids)] = ids
+            self._cache, tok, _logits = self._device(
+                self.program.run_prefill, self._cache,
+                onp.asarray(prompt, 'int32'), ids)
+            if self._draft is not None:
+                self._draft_cache, _dt, _dl = self._device(
+                    self._draft.run_prefill, self._draft_cache,
+                    onp.asarray(prompt, 'int32'), slot)
+            if self._prefix is not None:
+                with self._lock:
+                    self._prefix.register(prompt, ids)
+        except _DegradedPath:
+            self._release_seq_pages(seq)
+            with self._lock:
+                self._free.append(slot)
+            self._spawn_fallback([seq])
+            return
+        except _AbortPath as ab:
+            self._release_seq_pages(seq)
+            with self._lock:
+                self._free.append(slot)
+            seq.stream._finish('error', ab.exc)
+            return
+        except Exception as exc:
+            self._release_seq_pages(seq)
+            with self._lock:
+                self._free.append(slot)
+            seq.stream._finish('error', exc)
+            logging.exception('decode %s: paged prefill failed with a '
+                              'non-transient error', self.name)
+            return
+        with self._lock:
+            self._counts['prefills'] += 1
+            self._counts['tokens'] += 1
+        seq.slot = slot
+        seq.pos = n
+        seq.last_token = int(tok)
+        now = self._clock()
+        seq.first_token_at = now
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.prefills.inc()
+            inst.tokens.inc()
+            inst.ttft.observe(max(0.0, now - seq.enqueued_at))
+        _record_event('decode_admit', slot=slot, prompt_len=n,
+                      prefix_tokens=0)
+        with self._lock:
+            self._active[slot] = seq
+        seq.stream._emit(tok)
+        reason = self._finished_reason(seq, int(tok))
+        if reason is not None:
+            seq.stream._finish(reason)
+            self._retire(slot, seq, reason)
+
     def _finished_reason(self, seq, tok):
         if seq.eos_id is not None and tok == seq.eos_id:
             return 'eos'
@@ -557,10 +889,22 @@ class DecodeEngine:
 
     def _step(self):
         """Advance every live slot one token (the single fixed-shape
-        decode program)."""
+        decode program); paged engines dispatch the page-table step,
+        or the speculative draft+verify tick when eligible."""
         with self._lock:
             active = dict(self._active)
         if not active:
+            return
+        if self.paged:
+            spec_ok = (self._draft is not None and self.spec_k
+                       and all(not s.extending
+                               and s.pos + self.spec_k
+                               < self.program.max_len
+                               for s in active.values()))
+            if spec_ok:
+                self._spec_step(active)
+            else:
+                self._paged_step(active)
             return
         tokens = onp.zeros(self.slots, 'int32')
         positions = onp.zeros(self.slots, 'int32')
@@ -582,7 +926,7 @@ class DecodeEngine:
             for slot, seq in active.items():
                 seq.stream._finish('error', ab.exc)
                 self._retire(slot, seq, 'aborted')
-            self._cache = self.program.new_cache()
+            self._rebuild_cache()
             return
         except Exception as exc:
             # bug-shaped failure: a deterministic error would recur
@@ -594,7 +938,7 @@ class DecodeEngine:
             for slot, seq in active.items():
                 seq.stream._finish('error', exc)
                 self._retire(slot, seq, 'error')
-            self._cache = self.program.new_cache()
+            self._rebuild_cache()
             return
         dt = self._clock() - t0
         with self._lock:
@@ -618,6 +962,227 @@ class DecodeEngine:
             if reason is not None:
                 seq.stream._finish(reason)
                 self._retire(slot, seq, reason)
+
+    def _emit_token(self, seq, tok):
+        """Stream one generated token (TTFT observed on the first —
+        prefix-hit sequences earn their first token from a decode
+        step, not a prefill)."""
+        if seq.first_token_at is None:
+            now = self._clock()
+            seq.first_token_at = now
+            inst = _serving_instruments()
+            if inst is not None:
+                inst.ttft.observe(max(0.0, now - seq.enqueued_at))
+        seq.stream._emit(tok)
+
+    def _page_faults(self, active, lookahead=0):
+        """Pre-step page maintenance for every live slot: lazy
+        allocation at boundary crossings + copy-on-write of shared
+        pages. Pool exhaustion fails THAT stream typed and drops it
+        from this tick; device errors propagate to the caller."""
+        for slot, seq in list(active.items()):
+            if seq.stream.done() or seq.stream._cancelled:
+                continue
+            if not self._ensure_writable(seq, seq.pos,
+                                         seq.pos + lookahead):
+                self._fail_pool_exhausted(seq, slot, where='step')
+                self._retire(slot, seq, 'error')
+                del active[slot]
+        return active
+
+    def _paged_step(self, active):
+        """One decode step through the page tables. Extension slots
+        (prefix hits still consuming their prompt suffix) feed prompt
+        tokens and emit nothing until the last prompt token's logits
+        produce their first generated token."""
+        tokens = onp.zeros(self.slots, 'int32')
+        positions = onp.zeros(self.slots, 'int32')
+        tables = onp.zeros((self.slots, self.program.max_pages),
+                           'int32')
+        t0 = self._clock()
+        try:
+            active = self._page_faults(active)
+            if not active:
+                return
+            for slot, seq in active.items():
+                tokens[slot] = seq.last_token
+                positions[slot] = seq.pos
+                tables[slot] = seq.table
+            self._cache, toks, _logits = self._device(
+                self.program.run_step, self._cache, tokens, positions,
+                tables)
+            if self._draft is not None:
+                # keep the draft's KV history in lockstep on
+                # non-speculative ticks (extension / near-max_len):
+                # a hole at these positions would starve every later
+                # speculative round's proposals
+                self._draft_cache, _dt, _dl = self._device(
+                    self._draft.run_step, self._draft_cache, tokens,
+                    positions)
+        except _DegradedPath:
+            self._degrade_inflight(active)
+            return
+        except _AbortPath as ab:
+            for slot, seq in active.items():
+                seq.stream._finish('error', ab.exc)
+                self._retire(slot, seq, 'aborted')
+            self._rebuild_cache()
+            return
+        except Exception as exc:
+            logging.exception('decode %s: paged step failed with a '
+                              'non-transient error', self.name)
+            for slot, seq in active.items():
+                seq.stream._finish('error', exc)
+                self._retire(slot, seq, 'error')
+            self._rebuild_cache()
+            return
+        dt = self._clock() - t0
+        emitted = 0
+        for slot, seq in active.items():
+            if seq.stream.done() or seq.stream._cancelled:
+                continue            # retired at the next tick
+            fed_pos = seq.pos
+            seq.pos += 1
+            if fed_pos < seq.prompt_len - 1:
+                # extension: the fed token was a prompt token and the
+                # prediction is ignored; the next prompt token feeds
+                seq.last_token = int(seq.prompt[seq.pos])
+                continue
+            tok = int(toks[slot])
+            seq.last_token = tok
+            self._emit_token(seq, tok)
+            emitted += 1
+            reason = self._finished_reason(seq, tok)
+            if reason is not None:
+                seq.stream._finish(reason)
+                self._retire(slot, seq, reason)
+        with self._lock:
+            self._counts['steps'] += 1
+            self._counts['tokens'] += emitted
+            self._ema_step_s = dt if self._ema_step_s is None \
+                else 0.7 * self._ema_step_s + 0.3 * dt
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.decode_steps.inc()
+            inst.tokens.inc(emitted)
+            inst.tpot.observe(dt)
+
+    def _spec_step(self, active):
+        """Speculative tick: the draft proposes ``spec_k`` tokens
+        (that many single draft steps), the target scores all
+        ``spec_k + 1`` positions in ONE verify call, and the longest
+        greedy-matching prefix is accepted plus the target's own
+        correction token — 1..k+1 tokens per sequence per tick for
+        one target pass. Rejected K/V rows need no rollback: they sit
+        masked behind each slot's position until overwritten."""
+        k = self.spec_k
+        C = k + 1
+        inputs = onp.zeros((self.slots, C), 'int32')
+        positions = onp.zeros(self.slots, 'int32')
+        tables = onp.zeros((self.slots, self.program.max_pages),
+                           'int32')
+        t0 = self._clock()
+        try:
+            active = self._page_faults(active, lookahead=k)
+            if not active:
+                return
+            for slot, seq in active.items():
+                inputs[slot, 0] = seq.last_token
+                positions[slot] = seq.pos
+                tables[slot] = seq.table
+            cur = inputs[:, 0].copy()
+            for c in range(1, C):
+                self._draft_cache, dtoks, _dl = self._device(
+                    self._draft.run_step, self._draft_cache, cur,
+                    positions + (c - 1))
+                cur = onp.asarray(dtoks, 'int32').copy()
+                inputs[:, c] = cur
+            # feed the LAST proposal too (its output is discarded):
+            # a fully-accepted round advances pos past pos+k, so this
+            # is the only chance to write that draft KV row — skipping
+            # it leaves a permanent zero-row hole every later proposal
+            # attends (for shorter acceptances the row is masked and
+            # overwritten later, harmless)
+            self._draft_cache, _dt, _dl = self._device(
+                self._draft.run_step, self._draft_cache, cur,
+                positions + k)
+            self._cache, vtoks, _logits = self._device(
+                self.program.run_verify, self._cache, inputs,
+                positions, tables)
+        except _DegradedPath:
+            self._degrade_inflight(active)
+            return
+        except _AbortPath as ab:
+            for slot, seq in active.items():
+                seq.stream._finish('error', ab.exc)
+                self._retire(slot, seq, 'aborted')
+            self._rebuild_cache()
+            return
+        except Exception as exc:
+            logging.exception('decode %s: speculative step failed '
+                              'with a non-transient error', self.name)
+            for slot, seq in active.items():
+                seq.stream._finish('error', exc)
+                self._retire(slot, seq, 'error')
+            self._rebuild_cache()
+            return
+        dt = self._clock() - t0
+        emitted_total = 0
+        accepted_total = 0
+        proposed_total = 0
+        for slot, seq in active.items():
+            if seq.stream.done() or seq.stream._cancelled:
+                continue            # its proposals were never judged
+            proposed_total += k
+            # walk the chunk: target token at index c predicts
+            # position pos+c+1; the draft's next input is accepted
+            # while it matches, and the first mismatch still yields
+            # the target's correction token
+            emitted = []
+            adv = 1
+            for c in range(C):
+                emitted.append(int(vtoks[slot, c]))
+                if c < k and int(inputs[slot, c + 1]) == emitted[-1]:
+                    adv += 1
+                    continue
+                break
+            p0 = seq.pos
+            seq.pos = p0 + adv
+            seq.last_token = emitted[-1]
+            accepted_total += adv - 1
+            reason = None
+            for i, tok in enumerate(emitted):
+                self._emit_token(seq, tok)
+                emitted_total += 1
+                # per-token finish checks at the token's OWN position
+                # (p0 + i + 1) — the already-advanced seq.pos would
+                # truncate verified tokens near the max_len wall
+                if seq.eos_id is not None and tok == seq.eos_id:
+                    reason = 'eos'
+                elif len(seq.stream.tokens) >= seq.max_new:
+                    reason = 'length'
+                elif p0 + i + 2 >= self.program.max_len:
+                    reason = 'length'
+                if reason is not None:
+                    break
+            if reason is not None:
+                seq.stream._finish(reason)
+                self._retire(slot, seq, reason)
+        with self._lock:
+            self._counts['steps'] += 1
+            self._counts['spec_rounds'] += 1
+            self._counts['spec_proposed'] += proposed_total
+            self._counts['spec_accepted'] += accepted_total
+            self._counts['tokens'] += emitted_total
+            self._ema_step_s = dt if self._ema_step_s is None \
+                else 0.7 * self._ema_step_s + 0.3 * dt
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.decode_steps.inc()
+            inst.tokens.inc(emitted_total)
+            inst.tpot.observe(dt)
+            inst.spec_proposed.inc(proposed_total)
+            inst.spec_accepted.inc(accepted_total)
 
     # -- degraded completion -----------------------------------------------
 
@@ -684,8 +1249,9 @@ class DecodeEngine:
         for slot, seq in active.items():
             self._retire(slot, seq, 'degraded')
         # donated cache buffers are unusable after a failed call;
-        # start clean when the accelerator comes back
-        self._cache = self.program.new_cache()
+        # start clean when the accelerator comes back (paged: the
+        # allocator + prefix registry describe garbage — reset too)
+        self._rebuild_cache()
         self._spawn_fallback(list(active.values()))
 
     # -- introspection / lifecycle -----------------------------------------
@@ -704,9 +1270,49 @@ class DecodeEngine:
         return max(0.05, (pending + 1) * per_seq
                    / float(max(1, self.slots)))
 
+    def cache_accounting(self):
+        """Pool-bytes accounting (docs/SERVING.md): the REAL device
+        residency plus per-sequence amortized bytes — the slot
+        cache's ``slots × max_len`` figure overstated residency for
+        every sequence shorter than max_len."""
+        prog = self.program
+        out = {'paged': self.paged}
+        cache_bytes = getattr(prog, 'cache_bytes', None)
+        if callable(cache_bytes):
+            out['cache_bytes'] = int(cache_bytes())
+        per_seq = getattr(prog, 'per_sequence_bytes', None)
+        if callable(per_seq):
+            out['per_sequence_bytes_max'] = int(per_seq())
+        if self.paged and self._allocator is not None:
+            with self._lock:
+                pool = self._allocator.stats()
+                live = len(self._active)
+                live_pages = sum(len(s.pages)
+                                 for s in self._active.values())
+            out['pool'] = pool
+            page_bytes = getattr(prog, 'page_bytes', None)
+            if callable(page_bytes):
+                pb = int(page_bytes())
+                out['page_bytes'] = pb
+                # amortized: what the CURRENT live population actually
+                # holds, per sequence (falls back to one page when
+                # idle — the floor a new sequence costs)
+                amort = (live_pages * pb // live) if live else pb
+                out['per_sequence_bytes_amortized'] = int(amort)
+                if amort:
+                    out['max_concurrent_sequences_per_gb'] = \
+                        int((1 << 30) // amort)
+        elif 'per_sequence_bytes_max' in out \
+                and out['per_sequence_bytes_max']:
+            out['per_sequence_bytes_amortized'] = \
+                out['per_sequence_bytes_max']
+            out['max_concurrent_sequences_per_gb'] = \
+                int((1 << 30) // out['per_sequence_bytes_max'])
+        return out
+
     def stats(self):
         with self._lock:
-            return {
+            out = {
                 'pending': len(self._pending),
                 'active': len(self._active),
                 'free_slots': len(self._free),
@@ -717,7 +1323,24 @@ class DecodeEngine:
                 'counts': {k: (dict(v) if isinstance(v, dict) else v)
                            for k, v in self._counts.items()},
                 'closed': self._closed,
+                'paged': self.paged,
             }
+            if self._allocator is not None:
+                out['pages'] = self._allocator.stats()
+                if self._prefix is not None:
+                    out['pages']['prefix_entries'] = len(self._prefix)
+            if self._draft is not None:
+                proposed = self._counts['spec_proposed']
+                out['spec'] = {
+                    'k': self.spec_k,
+                    'proposed': proposed,
+                    'accepted': self._counts['spec_accepted'],
+                    'acceptance_rate': round(
+                        self._counts['spec_accepted'] / proposed, 4)
+                    if proposed else None,
+                }
+        out['cache'] = self.cache_accounting()
+        return out
 
     def close(self, drain=True, timeout=30.0):
         """Stop admissions; ``drain=True`` lets in-flight AND queued

@@ -32,6 +32,8 @@ from __future__ import annotations
 import numpy as onp
 
 from .cache import CacheSpec, write_position, write_slot
+from .paged import (PagedCacheSpec, gather_pages, write_paged_chunk,
+                    write_paged_rows, write_prefill_pages)
 
 __all__ = ['DecodeModel', 'RNNLM', 'TransformerLM', 'from_gluon_rnn_lm',
            'model_from_config', 'init_rnn_lm', 'init_transformer_lm']
@@ -69,6 +71,10 @@ class DecodeModel:
     """
 
     family = None
+    # paged KV caches need a position-addressed history (rewriting a
+    # rejected position must be free); an RNN's carried state is O(1)
+    # per slot already — there is no memory wall to page
+    supports_paging = False
 
     def __init__(self, config):
         self.config = dict(config)
@@ -437,6 +443,145 @@ class TransformerLM(DecodeModel):
             params, tokens,
             jnp.full((tokens.shape[0],), T, 'int32'))
         return logits
+
+    # -- paged cache paths (docs/SERVING.md "Paged KV cache") ---------------
+
+    supports_paging = True
+
+    def paged_spec(self, page_size):
+        """Pool metadata: one (pages, page_size, units) pool per layer
+        K and V entry."""
+        return PagedCacheSpec(
+            {'l%d_%s' % (i, kv): ((self.units,), 'float32')
+             for i in range(self.layers) for kv in ('k', 'v')},
+            page_size, self.max_len)
+
+    def paged_prefill(self, params, pool, tokens, length, page_ids):
+        """Prefill landing through the page table: same `_full_pass`
+        contractions as the slot prefill (identical reduction tree ->
+        identical logits bits), with the computed K/V prefix scattered
+        page by page to the host-allocated ``page_ids`` instead of one
+        slot row. Trailing all-padding pages point at the trash page.
+        """
+        import jax.numpy as jnp
+        S = tokens.shape[1]
+        logits, kvs = self._full_pass(params, tokens, length)
+        npages = page_ids.shape[0]
+        ps = pool[next(iter(pool))].shape[1]
+        pad = npages * ps - S
+        pool = dict(pool)
+        for i, (k, v) in enumerate(kvs):
+            for name, arr in (('k', k), ('v', v)):
+                full = jnp.pad(arr[0], ((0, pad), (0, 0)))
+                pool['l%d_%s' % (i, name)] = write_prefill_pages(
+                    pool['l%d_%s' % (i, name)], full, page_ids)
+        sel = (jnp.arange(S) == length - 1).astype(logits.dtype)
+        return pool, jnp.einsum('s,sv->v', sel, logits[0])
+
+    def paged_step(self, params, pool, tokens, positions, tables):
+        """One decode step over the page pool: identical math to
+        :meth:`step` except the per-slot K/V view is a gather of the
+        slot's page-table entries and the row write is addressed
+        ``(table[pos // ps], pos % ps)``. Gathered rows beyond a
+        slot's position (incl. trash-page garbage) carry exactly 0.0
+        attention weight, so the paged token stream is bit-identical
+        to the slot cache's (module docstring argument)."""
+        import jax.numpy as jnp
+        slots = tokens.shape[0]
+        ps = pool[next(iter(pool))].shape[1]
+        x = self._embed(params, tokens, positions)        # (S, U)
+        page_ids = jnp.take_along_axis(
+            tables, (positions // ps)[:, None], axis=1)[:, 0]
+        offsets = positions % ps
+        lp = tables.shape[1] * ps
+        ar = jnp.arange(lp)
+        bias = jnp.where(ar[None, :] <= positions[:, None],
+                         0.0, -1e9)[:, None, :]           # (S, 1, Lp)
+        scale = 1.0 / float(onp.sqrt(self.units // self.heads))
+        flash = _flash_on()
+        pool = dict(pool)
+        for i in range(self.layers):
+            p = lambda n: params['l%d_%s' % (i, n)]       # noqa: E731
+            qkv = self._dense(x, p('qkv_w'), p('qkv_b'))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            pool['l%d_k' % i] = write_paged_rows(
+                pool['l%d_k' % i], k, page_ids, offsets)
+            pool['l%d_v' % i] = write_paged_rows(
+                pool['l%d_v' % i], v, page_ids, offsets)
+            if flash:
+                # page-table gather + the same single-token kernel
+                # the slot cache used — the kernel walks the gathered
+                # view in the fixed K_BLOCK steps, so the reduction
+                # tree over the real keys is unchanged
+                from ...ops.pallas import flash_paged_decode_attention
+                ctx = flash_paged_decode_attention(
+                    q, pool['l%d_k' % i], pool['l%d_v' % i], tables,
+                    positions, heads=self.heads, scale=scale)
+            else:
+                ck = gather_pages(pool['l%d_k' % i], tables)
+                cv = gather_pages(pool['l%d_v' % i], tables)
+                qh = self._heads_split(q * scale)         # (S,H,D)
+                kh = self._heads_split(ck)                # (S,Lp,H,D)
+                vh = self._heads_split(cv)
+                scores = jnp.einsum('shd,slhd->shl', qh, kh) + bias
+                att = jnp.exp(scores - jnp.max(scores, axis=-1,
+                                               keepdims=True))
+                att = att / jnp.sum(att, axis=-1, keepdims=True)
+                ctx = jnp.einsum('shl,slhd->shd', att, vh)
+                ctx = ctx.reshape(slots, self.units)
+            x = self._ln(x + self._dense(ctx, p('out_w'), p('out_b')),
+                         p('ln1_g'), p('ln1_b'))
+            x = self._ffn_block(params, i, x)
+        return pool, self._head(params, x)
+
+    def paged_verify(self, params, pool, tokens, positions, tables):
+        """Speculative verify: ``tokens`` (slots, C) — the last
+        accepted token plus the draft's proposals — advance every slot
+        C positions in ONE call, emitting logits at each. Causal
+        within the chunk, each slot masked to its own history.
+
+        Spec-only path: the chunked contractions combine a different
+        reduction tree than the one-token step, so its logits agree to
+        float32 precision, not bitwise (greedy acceptance re-checks
+        against the draft, and rejected rows are simply masked until
+        overwritten — docs/DIVERGENCES.md)."""
+        import jax.numpy as jnp
+        slots, C = tokens.shape
+        ps = pool[next(iter(pool))].shape[1]
+        qpos = positions[:, None] + jnp.arange(C)[None, :]  # (S, C)
+        x = self._embed(params, tokens, qpos)               # (S, C, U)
+        page_ids = jnp.take_along_axis(tables, qpos // ps, axis=1)
+        offsets = qpos % ps
+        lp = tables.shape[1] * ps
+        ar = jnp.arange(lp)
+        # query c of slot s sees key j iff j <= positions[s] + c
+        bias = jnp.where(ar[None, None, :] <= qpos[:, :, None],
+                         0.0, -1e9)[:, None]           # (S, 1, C, Lp)
+        scale = 1.0 / float(onp.sqrt(self.units // self.heads))
+        pool = dict(pool)
+        for i in range(self.layers):
+            p = lambda n: params['l%d_%s' % (i, n)]       # noqa: E731
+            qkv = self._dense(x, p('qkv_w'), p('qkv_b'))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            pool['l%d_k' % i] = write_paged_chunk(
+                pool['l%d_k' % i], k, page_ids, offsets)
+            pool['l%d_v' % i] = write_paged_chunk(
+                pool['l%d_v' % i], v, page_ids, offsets)
+            ck = gather_pages(pool['l%d_k' % i], tables)
+            cv = gather_pages(pool['l%d_v' % i], tables)
+            qh = self._heads_split(q * scale)             # (S,C,H,D)
+            kh = self._heads_split(ck)                    # (S,Lp,H,D)
+            vh = self._heads_split(cv)
+            scores = jnp.einsum('schd,slhd->shcl', qh, kh) + bias
+            att = jnp.exp(scores - jnp.max(scores, axis=-1,
+                                           keepdims=True))
+            att = att / jnp.sum(att, axis=-1, keepdims=True)
+            ctx = jnp.einsum('shcl,slhd->schd', att, vh)
+            ctx = ctx.reshape(slots, C, self.units)
+            x = self._ln(x + self._dense(ctx, p('out_w'), p('out_b')),
+                         p('ln1_g'), p('ln1_b'))
+            x = self._ffn_block(params, i, x)
+        return pool, self._head(params, x)              # (S, C, V)
 
     def init_params(self, seed=0):
         rs = onp.random.RandomState(seed)

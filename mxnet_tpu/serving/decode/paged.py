@@ -1,0 +1,491 @@
+"""Block/paged KV cache: a fixed page pool + per-sequence page tables.
+
+PR 6's slot cache preallocates ``slots × max_len`` KV rows per layer —
+every admitted sequence reserves its worst-case history whether it
+generates 4 tokens or 200, so HBM caps concurrent users long before
+compute saturates (the decode memory wall). The paged layout breaks
+the reservation into fixed-size **pages** of ``page_size`` rows:
+
+  * the device holds ONE pool per cache entry,
+    ``(pages, page_size, *row_shape)``, donated through the step
+    program exactly like the slot cache was;
+  * each sequence owns a **page table** — a fixed-shape ``int32``
+    ``(max_pages,)`` vector of pool page indices — carried into the
+    one compiled decode-step program as a plain array argument. The
+    program's only cache ops are a gather of the table entries (the
+    per-slot K/V view) and O(1) ``lax.dynamic_update_slice`` row
+    writes at ``(table[pos // page_size], pos % page_size)`` — never
+    an O(pool) copy;
+  * **allocation, freeing, refcounting, prefix sharing, and
+    copy-on-write decisions all happen host-side** in the engine
+    scheduler (:class:`PageAllocator`, :class:`PrefixCache`). The
+    compiled program never sees the free list — page churn costs zero
+    retraces.
+
+Page 0 is the reserved **trash page**: unused table entries point at
+it, and padded prefill writes land in it harmlessly. Reads of trash
+rows are masked to exactly 0.0 attention weight (the same additive
+``-1e9`` / ``-inf`` argument ``model.py`` makes for padded prefill),
+so garbage in page 0 never changes a real sequence's reduction tree —
+paged token streams stay bit-identical to the slot cache and to the
+uncached whole-sequence reference.
+
+**Prefix sharing**: full pages of a prompt are registered under a
+chain key (parent key + the page's token tuple — an exact-match trie,
+no hash collisions), and the partial tail page is registered under
+the same scheme. A later prompt whose tokens walk the same chain
+references those pages read-only (refcount++) instead of re-running
+prefill over them. **Copy-on-write**: the first write into a page
+whose refcount > 1 (a shared partial tail, or the owner itself once
+its tail is registered) copies the page to a fresh one via the tiny
+compiled ``copy_page`` program and repoints only that sequence's
+table.
+
+Shape/dtype math and the allocator are importable without jax
+(engine-testable with fake programs); the device helpers import jax
+lazily — the cache.py discipline.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ['PagedCacheSpec', 'PageAllocator', 'PrefixCache',
+           'TRASH_PAGE', 'init_pool', 'pool_avals', 'pool_bytes',
+           'gather_pages', 'write_paged_rows', 'write_paged_chunk',
+           'write_prefill_pages', 'copy_page', 'pages_for']
+
+# pool page index 0 is never allocated: unused page-table entries and
+# padded prefill writes target it (reads of it are mask-zeroed)
+TRASH_PAGE = 0
+
+
+def pages_for(n_tokens, page_size):
+    """Pages needed to hold ``n_tokens`` KV rows (ceil)."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+class PagedCacheSpec:
+    """Metadata for one paged cache: ``{name: (row_shape, dtype)}`` —
+    the pool array for ``pages`` pages of ``page_size`` rows each is
+    ``(pages, page_size) + row_shape``.
+
+    ``row_shape`` is the per-token shape (``(units,)`` for a
+    transformer K or V entry); ``max_pages`` is the per-sequence page
+    table length, ``ceil(max_len / page_size)``.
+    """
+
+    __slots__ = ('entries', 'page_size', 'max_pages')
+
+    def __init__(self, entries, page_size, max_len):
+        self.page_size = int(page_size)
+        if self.page_size < 1 or (self.page_size
+                                  & (self.page_size - 1)):
+            raise ValueError('page_size must be a positive power of '
+                             'two, got %d' % self.page_size)
+        self.max_pages = pages_for(int(max_len), self.page_size)
+        self.entries = {str(k): (tuple(int(d) for d in shape), str(dt))
+                        for k, (shape, dt) in dict(entries).items()}
+
+    def items(self):
+        return self.entries.items()
+
+    def full_shape(self, name, pages):
+        shape, _ = self.entries[name]
+        return (int(pages), self.page_size) + shape
+
+    def to_json(self):
+        return {'page_size': self.page_size,
+                'max_pages': self.max_pages,
+                'entries': {k: [list(s), dt]
+                            for k, (s, dt) in self.entries.items()}}
+
+    @classmethod
+    def from_json(cls, obj):
+        entries = {k: (tuple(s), dt)
+                   for k, (s, dt) in obj['entries'].items()}
+        return cls(entries, obj['page_size'],
+                   obj['max_pages'] * obj['page_size'])
+
+    def __repr__(self):
+        return ('PagedCacheSpec(page_size=%d, max_pages=%d, %r)'
+                % (self.page_size, self.max_pages, self.entries))
+
+
+def pool_bytes(spec, pages):
+    """Static pool footprint in bytes for ``pages`` pages — the REAL
+    device residency of the paged cache (the slot cache's
+    ``slots × max_len`` figure this replaces reserved worst case per
+    sequence whether it was used or not)."""
+    total = 0
+    for name, (shape, dt) in spec.items():
+        n = int(pages) * spec.page_size
+        for d in shape:
+            n *= d
+        total += n * onp.dtype(dt).itemsize
+    return total
+
+
+def init_pool(spec, pages):
+    """Preallocated zeros pool pytree ``{name: (pages, page_size,
+    *row_shape)}`` — zeros so stale rows stay finite under the
+    attention mask (cache.py's argument)."""
+    import jax.numpy as jnp
+    return {name: jnp.zeros(spec.full_shape(name, pages), dt)
+            for name, (_, dt) in spec.items()}
+
+
+def pool_avals(spec, pages):
+    """ShapeDtypeStructs for AOT lowering (freeze.py idiom)."""
+    import jax
+    return {name: jax.ShapeDtypeStruct(spec.full_shape(name, pages),
+                                       dt)
+            for name, (_, dt) in spec.items()}
+
+
+# ---------------------------------------------------------------------------
+# device-side pool ops (used inside the compiled programs)
+# ---------------------------------------------------------------------------
+
+
+def gather_pages(pool_arr, tables):
+    """Per-slot K/V view through the page tables: ``pool_arr``
+    (pages, page_size, *row), ``tables`` (slots, max_pages) int32 ->
+    (slots, max_pages * page_size, *row).
+
+    One XLA gather of O(slots × max_len) rows — the same read traffic
+    the slot cache's per-step view cost, independent of pool size (the
+    HLO-DECODE-PAGED lint asserts no O(pool) materializing copy
+    appears instead)."""
+    import jax.numpy as jnp
+    g = jnp.take(pool_arr, tables, axis=0)   # (S, P, ps, *row)
+    s, p, ps = g.shape[:3]
+    return g.reshape((s, p * ps) + g.shape[3:])
+
+
+def _row_write(pool_arr, row, page_id, offset):
+    import jax.numpy as jnp
+    from jax import lax
+    start = (jnp.asarray(page_id, 'int32'),
+             jnp.asarray(offset, 'int32')) + tuple(
+                 jnp.asarray(0, 'int32')
+                 for _ in range(pool_arr.ndim - 2))
+    return lax.dynamic_update_slice(
+        pool_arr, row[None, None].astype(pool_arr.dtype), start)
+
+
+def write_paged_rows(pool_arr, rows, page_ids, offsets):
+    """The decode-step KV append through the page table: one row per
+    slot at that slot's own ``(page, offset)``.
+
+    ``rows`` (slots, *row); ``page_ids``/``offsets`` (slots,) traced
+    int32. Slots is static, so this unrolls to ``slots`` dynamic
+    update slices — O(slots × row) like the slot cache's
+    ``write_position``, never O(pool). Distinct live slots never
+    share a writable (page, offset); padded/free slots all target the
+    trash page, where last-writer-wins garbage is masked anyway."""
+    for s in range(rows.shape[0]):
+        pool_arr = _row_write(pool_arr, rows[s], page_ids[s],
+                              offsets[s])
+    return pool_arr
+
+
+def write_paged_chunk(pool_arr, rows, page_ids, offsets):
+    """Multi-token append (the speculative verify program): ``rows``
+    (slots, C, *row), ``page_ids``/``offsets`` (slots, C). O(slots ×
+    C × row) dynamic-slice writes."""
+    slots, c = rows.shape[0], rows.shape[1]
+    for s in range(slots):
+        for j in range(c):
+            pool_arr = _row_write(pool_arr, rows[s, j],
+                                  page_ids[s, j], offsets[s, j])
+    return pool_arr
+
+
+def write_prefill_pages(pool_arr, rows, page_ids):
+    """The prefill landing: ``rows`` (npages * page_size, *row) —
+    the computed prompt K/V padded to whole pages — scattered page by
+    page to the ``page_ids`` (npages,) the host allocated (trailing
+    all-padding pages point at the trash page). O(prompt), one
+    dynamic_update_slice per page."""
+    import jax.numpy as jnp
+    from jax import lax
+    npages = page_ids.shape[0]
+    ps = rows.shape[0] // npages
+    for j in range(npages):
+        blk = rows[j * ps:(j + 1) * ps]
+        start = (jnp.asarray(page_ids[j], 'int32'),
+                 jnp.asarray(0, 'int32')) + tuple(
+                     jnp.asarray(0, 'int32')
+                     for _ in range(pool_arr.ndim - 2))
+        pool_arr = lax.dynamic_update_slice(
+            pool_arr, blk[None].astype(pool_arr.dtype), start)
+    return pool_arr
+
+
+def copy_page(pool_arr, src, dst):
+    """Copy one page within the pool (the COW primitive): O(page),
+    one dynamic slice + one dynamic update slice."""
+    import jax.numpy as jnp
+    from jax import lax
+    zeros = tuple(jnp.asarray(0, 'int32')
+                  for _ in range(pool_arr.ndim - 2))
+    blk = lax.dynamic_slice(
+        pool_arr, (jnp.asarray(src, 'int32'),
+                   jnp.asarray(0, 'int32')) + zeros,
+        (1,) + pool_arr.shape[1:])
+    return lax.dynamic_update_slice(
+        pool_arr, blk, (jnp.asarray(dst, 'int32'),
+                        jnp.asarray(0, 'int32')) + zeros)
+
+
+# ---------------------------------------------------------------------------
+# host-side allocation (engine scheduler state; numpy/stdlib only)
+# ---------------------------------------------------------------------------
+
+
+class PageAllocator:
+    """Free-list + refcounts over the pool's page indices.
+
+    Page ``TRASH_PAGE`` (0) is reserved. Every allocated page starts
+    at refcount 1 (the allocating sequence's hold); prefix-cache
+    registration and later sharers take additional holds via
+    :meth:`ref`. ``release`` drops a hold and returns the page to the
+    free list at zero. Pure host math — no locks (the engine calls it
+    under its own scheduler lock) and no jax.
+    """
+
+    def __init__(self, pages):
+        self.pages = int(pages)
+        if self.pages < 2:
+            raise ValueError('pool needs >= 2 pages (page 0 is the '
+                             'reserved trash page), got %d'
+                             % self.pages)
+        self.reset()
+
+    def reset(self):
+        """Forget everything (the engine rebuilt the device pool —
+        every page's contents are garbage now)."""
+        # LIFO free list (pop from the end): O(1) per page on the
+        # scheduler hot path, and recently-freed pages recycle first
+        self._free = list(range(self.pages - 1, 0, -1))
+        self._ref = {}
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        return self.pages - 1 - len(self._free)
+
+    def occupancy_pct(self):
+        usable = self.pages - 1
+        return 100.0 * self.used_pages / usable if usable else 0.0
+
+    def can_alloc(self, n):
+        return len(self._free) >= int(n)
+
+    def alloc(self, n):
+        """``n`` fresh pages at refcount 1, or None when the pool
+        cannot satisfy the request (the caller evicts or rejects
+        typed — never a partial grant)."""
+        n = int(n)
+        if len(self._free) < n:
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._ref[p] = 1
+        return out
+
+    def ref(self, page):
+        """Take one more hold on an allocated page (prefix sharing)."""
+        if page == TRASH_PAGE:
+            return page
+        if page not in self._ref:
+            raise ValueError('ref of unallocated page %d' % page)
+        self._ref[page] += 1
+        return page
+
+    def refcount(self, page):
+        return self._ref.get(page, 0)
+
+    def release(self, page):
+        """Drop one hold; at zero the page returns to the free list."""
+        if page == TRASH_PAGE:
+            return
+        cnt = self._ref.get(page)
+        if cnt is None:
+            raise ValueError('release of unallocated page %d' % page)
+        if cnt <= 1:
+            del self._ref[page]
+            self._free.append(page)
+        else:
+            self._ref[page] = cnt - 1
+
+    def stats(self):
+        return {'pages_total': self.pages - 1,
+                'pages_free': self.free_pages,
+                'pages_used': self.used_pages,
+                'occupancy_pct': round(self.occupancy_pct(), 2)}
+
+
+class _PrefixNode:
+    __slots__ = ('page', 'tokens', 'parent', 'children', 'last_used',
+                 'seq')
+
+    def __init__(self, page, tokens, parent, seq):
+        self.page = page
+        self.tokens = tokens
+        self.parent = parent          # parent key or None
+        self.children = 0
+        self.last_used = seq
+        self.seq = seq
+
+
+class PrefixCache:
+    """Exact-match trie of prompt pages → pool page indices.
+
+    Keys are ``(parent_key, tokens_tuple)`` — the chain itself is the
+    key, so two different prefixes can never collide the way a rolling
+    hash could. Full pages chain with ``len(tokens) == page_size``;
+    the prompt's partial tail page registers with its shorter token
+    tuple (shared only on an exact remaining-token match — a
+    divergence INSIDE a page can therefore never alias, and a sharer
+    writing past the shared rows copy-on-writes first).
+
+    Each registered node holds one allocator ref on its page, so a
+    retired owner's pages survive for future hits until
+    :meth:`evict_lru` reclaims them under pool pressure (leaf-first,
+    least-recently-used — a parent page is never freed while a child
+    still chains through it).
+    """
+
+    def __init__(self, page_size, allocator):
+        self.page_size = int(page_size)
+        self._alloc = allocator
+        self._nodes = {}
+        self._by_page = {}      # page id -> node key (pages are
+        self._seq = 0           # registered under at most one node)
+        self.evictions = 0      # hit/token counters live in the
+                                # engine's _counts, not here
+
+    def __len__(self):
+        return len(self._nodes)
+
+    def clear(self):
+        """Drop every registration WITHOUT releasing pages — used when
+        the allocator itself was reset (pool rebuilt)."""
+        self._nodes = {}
+        self._by_page = {}
+
+    def _tick(self):
+        self._seq += 1
+        return self._seq
+
+    def _chunks(self, prompt):
+        ps = self.page_size
+        full = len(prompt) // ps
+        out = [tuple(prompt[i * ps:(i + 1) * ps])
+               for i in range(full)]
+        tail = tuple(prompt[full * ps:])
+        return out, tail
+
+    def register(self, prompt, page_ids):
+        """Record ``prompt``'s pages (full chain + partial tail) for
+        future sharers; takes one allocator ref per NEWLY registered
+        page. ``page_ids[i]`` holds prompt positions
+        ``[i*ps, (i+1)*ps)``."""
+        now = self._tick()
+        chunks, tail = self._chunks(prompt)
+        parent = None
+        for i, chunk in enumerate(chunks + ([tail] if tail else [])):
+            key = (parent, chunk)
+            node = self._nodes.get(key)
+            if node is None:
+                page = page_ids[i]
+                if page == TRASH_PAGE:
+                    break              # prompt outran the page list
+                self._alloc.ref(page)
+                node = _PrefixNode(page, chunk, parent, now)
+                self._nodes[key] = node
+                self._by_page[page] = key
+                if parent is not None and parent in self._nodes:
+                    self._nodes[parent].children += 1
+            node.last_used = now
+            parent = key
+
+    def lookup(self, prompt):
+        """Longest registered chain covering ``prompt``'s head:
+        returns ``(page_ids, tokens_covered)`` WITHOUT taking refs
+        (the engine refs the pages it actually uses). Full pages chain
+        first; a partial tail matches only when the remaining prompt
+        tokens equal a registered tail exactly."""
+        now = self._tick()
+        chunks, tail = self._chunks(prompt)
+        pages = []
+        parent = None
+        covered = 0
+        for chunk in chunks:
+            node = self._nodes.get((parent, chunk))
+            if node is None:
+                break
+            node.last_used = now
+            pages.append(node.page)
+            covered += len(chunk)
+            parent = (parent, chunk)
+        else:
+            if tail:
+                node = self._nodes.get((parent, tail))
+                if node is not None:
+                    node.last_used = now
+                    pages.append(node.page)
+                    covered += len(tail)
+        return pages, covered
+
+    def release_leaf(self, page):
+        """Drop the LEAF registration holding ``page`` — the
+        copy-on-write fast path: when a page's only co-holder is the
+        registry itself (refcount 2: owner + registration), stealing
+        the registration back makes the owner's write private WITHOUT
+        a page copy. Only leaves are stealable (a mid-chain page must
+        stay registered or its descendants' chains dangle); partial
+        tail pages — the common trigger, every non-aligned prompt's
+        own generation — are always leaves. Returns True when a leaf
+        registration was dropped. O(1) via the page->node index (this
+        runs per page-boundary write on the scheduler hot path)."""
+        key = self._by_page.get(page)
+        if key is None:
+            return False
+        node = self._nodes.get(key)
+        if node is None or node.page != page or node.children:
+            return False
+        del self._nodes[key]
+        del self._by_page[page]
+        if node.parent is not None and node.parent in self._nodes:
+            self._nodes[node.parent].children -= 1
+        self._alloc.release(page)
+        return True
+
+    def evict_lru(self, want_pages=1):
+        """Drop least-recently-used LEAF registrations until
+        ``want_pages`` allocator pages could be satisfied (or nothing
+        evictable remains). Returns the freed page ids (pages whose
+        only remaining hold was the registry's)."""
+        freed = []
+        while not self._alloc.can_alloc(want_pages):
+            leaves = [(node.last_used, key)
+                      for key, node in self._nodes.items()
+                      if node.children == 0]
+            if not leaves:
+                break
+            _, key = min(leaves)
+            node = self._nodes.pop(key)
+            self._by_page.pop(node.page, None)
+            if node.parent is not None and node.parent in self._nodes:
+                self._nodes[node.parent].children -= 1
+            before = self._alloc.free_pages
+            self._alloc.release(node.page)
+            if self._alloc.free_pages > before:
+                freed.append(node.page)
+            self.evictions += 1
+        return freed

@@ -45,8 +45,12 @@ import numpy as onp
 from ..bucket import BucketPolicy, default_buckets
 from .cache import cache_avals, cache_bytes, init_cache
 from .model import DecodeModel, from_gluon_rnn_lm, model_from_config
+from .paged import (TRASH_PAGE, init_pool, pages_for, pool_avals,
+                    pool_bytes)
+from . import paged as _paged
 
-__all__ = ['DecodeProgram', 'freeze_decode', 'load_decode']
+__all__ = ['DecodeProgram', 'PagedDecodeProgram', 'freeze_decode',
+           'load_decode']
 
 _DECODE_KIND = 'decode'
 
@@ -135,13 +139,30 @@ class DecodeProgram:
     def compile_count(self):
         return len(set(self._compiled) | set(self._loaded))
 
+    # the slot cache reserves slots × max_len whether a sequence uses
+    # it or not; PagedDecodeProgram overrides `paged` and the cache
+    # accounting/aval hooks below
+    paged = False
+
     def cache_bytes(self):
-        """Static per-engine cache footprint (docs/SERVING.md)."""
+        """Static per-engine cache footprint (docs/SERVING.md) — the
+        REAL device residency: slot programs preallocate
+        ``slots × max_len`` rows, paged programs report the pool."""
         return cache_bytes(self._spec, self.slots)
+
+    def per_sequence_bytes(self, seq_len=None):
+        """Worst-case cache bytes one sequence reserves: the whole
+        per-slot allocation regardless of its actual length (the
+        memory wall the paged layout breaks)."""
+        del seq_len
+        return cache_bytes(self._spec, 1)
 
     def new_cache(self):
         """Fresh preallocated device cache for ``slots`` sequences."""
         return init_cache(self._spec, self.slots)
+
+    def _cache_avals(self):
+        return cache_avals(self._spec, self.slots)
 
     def _prefill_fn(self, key):
         import jax.numpy as jnp
@@ -201,7 +222,7 @@ class DecodeProgram:
             with _traceknobs.scope(knobs):
                 prog = jitted.lower(
                     self._param_avals(),
-                    cache_avals(self._spec, self.slots),
+                    self._cache_avals(),
                     *avals).compile()
             self.compile_seconds[key] = time.perf_counter() - t0
             self._compiled[key] = prog
@@ -365,17 +386,24 @@ class DecodeProgram:
             'pallas': _pallas_resolve(),
             'programs': programs,
         }
+        manifest.update(self._manifest_extra())
         atomic_write_bytes(
             os.path.join(path, 'MANIFEST.json'),
             (json.dumps(manifest, indent=1, sort_keys=True)
              + '\n').encode())
         return path
 
+    def _manifest_extra(self):
+        """Layout-specific manifest fields (paged artifacts record
+        their page geometry so `load` re-dispatches)."""
+        return {}
+
     @classmethod
     def load(cls, path):
         """Reload a decode artifact; executables deserialize when jax
         version + platform match, else the key re-jits on first use
-        and lands in ``retraced_buckets``."""
+        and lands in ``retraced_buckets``. Dispatches on the manifest:
+        paged artifacts reload as :class:`PagedDecodeProgram`."""
         import jax
         with open(os.path.join(path, 'MANIFEST.json')) as f:
             manifest = json.load(f)
@@ -392,11 +420,20 @@ class DecodeProgram:
                 params[key] = z[key]
         model = model_from_config(manifest['family'],
                                   manifest['config'])
-        prog = cls(model, params, slots=manifest['slots'],
-                   prefill_buckets=manifest['prefill_buckets'],
-                   name=manifest.get('name'),
-                   donate=manifest.get('donate'),
-                   emit_logits=manifest.get('emit_logits', True))
+        kwargs = {}
+        if manifest.get('paged'):
+            target = PagedDecodeProgram
+            kwargs = {'page_size': manifest['page_size'],
+                      'pages': manifest['pages'],
+                      'spec_k': manifest.get('spec_k', 0)}
+        else:
+            target = DecodeProgram
+        prog = target(model, params, slots=manifest['slots'],
+                      prefill_buckets=manifest['prefill_buckets'],
+                      name=manifest.get('name'),
+                      donate=manifest.get('donate'),
+                      emit_logits=manifest.get('emit_logits', True),
+                      **kwargs)
         env_ok = (manifest.get('jax_version') == jax.__version__
                   and manifest.get('platform') == jax.default_backend())
         for key, fname in (manifest.get('programs') or {}).items():
@@ -415,9 +452,266 @@ class DecodeProgram:
         return prog
 
 
+class PagedDecodeProgram(DecodeProgram):
+    """AOT prefill/step/verify programs over a paged KV pool
+    (docs/SERVING.md "Paged KV cache, prefix sharing, speculative
+    decoding").
+
+    Same compiled-program discipline as the slot cache — one fixed
+    shape per program kind, zero retraces after warmup — with the
+    cache replaced by a page pool plus per-sequence page tables
+    carried as plain ``int32`` array arguments:
+
+      * **prefill** per bucket: writes the prompt K/V page by page to
+        the host-allocated page ids (trailing padding pages hit the
+        reserved trash page);
+      * **step** (ONE program): every slot advances one token; its
+        K/V view is a gather through its page table, its row write is
+        ``(table[pos // page_size], pos % page_size)``;
+      * **copy_page** (ONE program): the copy-on-write primitive —
+        O(page), host decides when;
+      * **verify** (ONE program, only when ``spec_k > 0``): the
+        speculative-decoding target pass — ``spec_k + 1`` tokens per
+        slot advance in one call, logits at every position.
+
+    Total executables: ``len(ladder) + 2`` (+1 with speculation).
+    Page allocation/free/refcounting/prefix-sharing live in the
+    ENGINE scheduler (:mod:`.paged`); this class only compiles and
+    runs fixed shapes — page churn costs zero retraces.
+    """
+
+    paged = True
+
+    def __init__(self, model, params, slots=None, prefill_buckets=None,
+                 name=None, donate=None, emit_logits=True,
+                 page_size=None, pages=None, spec_k=None):
+        if not getattr(model, 'supports_paging', False):
+            raise TypeError(
+                'family %r does not support a paged cache (an RNN '
+                'carries O(1) state per slot — there is no KV history '
+                'to page); use DecodeProgram' % (model.family,))
+        super().__init__(model, params, slots=slots,
+                         prefill_buckets=prefill_buckets, name=name,
+                         donate=donate, emit_logits=emit_logits)
+        self.page_size = int(
+            page_size if page_size is not None
+            else _knob('MXNET_TPU_SERVE_PAGE_SIZE', 16))
+        self._pspec = model.paged_spec(self.page_size)
+        self.max_pages = self._pspec.max_pages
+        if pages is None:
+            # default pool = the slot cache's worst-case capacity
+            # (every slot filling max_len) + the trash page; shrink it
+            # to trade capacity for HBM, grow it to admit more
+            # sequences at the same per-sequence risk
+            pages = self.slots * self.max_pages + 1
+        self.pages = int(pages)
+        if self.pages < 2:
+            raise ValueError('pool needs >= 2 pages (page 0 is the '
+                             'reserved trash page)')
+        self.spec_k = int(spec_k if spec_k is not None
+                          else _knob('MXNET_TPU_SERVE_SPEC_K', 0))
+        if self.spec_k < 0:
+            raise ValueError('spec_k must be >= 0')
+
+    # -- accounting (the satellite fix: report POOL bytes, not the
+    # slots × max_len worst case the slot cache reserved) ------------------
+
+    def cache_bytes(self):
+        return pool_bytes(self._pspec, self.pages)
+
+    def page_bytes(self):
+        """Bytes one page holds across every cache entry."""
+        return pool_bytes(self._pspec, 1)
+
+    def per_sequence_bytes(self, seq_len=None):
+        """Amortized cache bytes for a sequence of ``seq_len`` tokens
+        (default: the worst case, max_len): pages are the granularity,
+        so a 12-token sequence at page_size 16 holds ONE page, not
+        max_len rows."""
+        n = self.model.max_len if seq_len is None else int(seq_len)
+        return pages_for(n, self.page_size) * self.page_bytes()
+
+    def new_cache(self):
+        """Fresh zeroed page pool."""
+        return init_pool(self._pspec, self.pages)
+
+    def _cache_avals(self):
+        return pool_avals(self._pspec, self.pages)
+
+    def _manifest_extra(self):
+        return {'paged': True, 'page_size': self.page_size,
+                'pages': self.pages, 'spec_k': self.spec_k,
+                'max_pages': self.max_pages,
+                'page_bytes': self.page_bytes()}
+
+    # -- program construction ----------------------------------------------
+
+    def _paged_prefill_fn(self, key):
+        import jax.numpy as jnp
+        counts = self.trace_counts
+        model, emit = self.model, self.emit_logits
+
+        def fn(params, pool, tokens, length, page_ids):
+            counts[key] = counts.get(key, 0) + 1
+            pool, logits = model.paged_prefill(params, pool, tokens,
+                                               length, page_ids)
+            tok = jnp.argmax(logits, axis=-1).astype('int32')
+            return (pool, tok, logits) if emit else (pool, tok)
+        return fn
+
+    def _paged_step_fn(self, key):
+        import jax.numpy as jnp
+        counts = self.trace_counts
+        model, emit = self.model, self.emit_logits
+
+        def fn(params, pool, tokens, positions, tables):
+            counts[key] = counts.get(key, 0) + 1
+            pool, logits = model.paged_step(params, pool, tokens,
+                                            positions, tables)
+            tok = jnp.argmax(logits, axis=-1).astype('int32')
+            return (pool, tok, logits) if emit else (pool, tok)
+        return fn
+
+    def _verify_fn(self, key):
+        import jax.numpy as jnp
+        counts = self.trace_counts
+        model, emit = self.model, self.emit_logits
+
+        def fn(params, pool, tokens, positions, tables):
+            counts[key] = counts.get(key, 0) + 1
+            pool, logits = model.paged_verify(params, pool, tokens,
+                                              positions, tables)
+            tok = jnp.argmax(logits, axis=-1).astype('int32')
+            return (pool, tok, logits) if emit else (pool, tok)
+        return fn
+
+    def _copy_fn(self, key):
+        counts = self.trace_counts
+
+        def fn(params, pool, src, dst):
+            counts[key] = counts.get(key, 0) + 1
+            del params
+            return {name: _paged.copy_page(arr, src, dst)
+                    for name, arr in pool.items()}
+        return fn
+
+    def compile_prefill(self, bucket):
+        import jax
+        key = self._program_key('prefill:%d' % bucket)
+        npages = pages_for(bucket, self.page_size)
+        return self._build(
+            key, self._paged_prefill_fn(key),
+            jax.ShapeDtypeStruct((1, bucket), 'int32'),
+            jax.ShapeDtypeStruct((), 'int32'),
+            jax.ShapeDtypeStruct((npages,), 'int32'))
+
+    def compile_step(self):
+        import jax
+        key = self._program_key('step')
+        return self._build(
+            key, self._paged_step_fn(key),
+            jax.ShapeDtypeStruct((self.slots,), 'int32'),
+            jax.ShapeDtypeStruct((self.slots,), 'int32'),
+            jax.ShapeDtypeStruct((self.slots, self.max_pages),
+                                 'int32'))
+
+    def compile_verify(self):
+        import jax
+        if not self.spec_k:
+            raise ValueError('verify program needs spec_k > 0')
+        key = self._program_key('verify:%d' % (self.spec_k + 1))
+        return self._build(
+            key, self._verify_fn(key),
+            jax.ShapeDtypeStruct((self.slots, self.spec_k + 1),
+                                 'int32'),
+            jax.ShapeDtypeStruct((self.slots,), 'int32'),
+            jax.ShapeDtypeStruct((self.slots, self.max_pages),
+                                 'int32'))
+
+    def compile_copy_page(self):
+        import jax
+        key = self._program_key('copy')
+        return self._build(
+            key, self._copy_fn(key),
+            jax.ShapeDtypeStruct((), 'int32'),
+            jax.ShapeDtypeStruct((), 'int32'))
+
+    def warmup(self, buckets=None):
+        """Ladder + step + copy_page (+ verify under speculation):
+        every program the engine can ever run, compiled up front."""
+        for b in (buckets or self.policy.buckets):
+            self.compile_prefill(b)
+        self.compile_step()
+        self.compile_copy_page()
+        if self.spec_k:
+            self.compile_verify()
+        return self
+
+    # -- execution ---------------------------------------------------------
+
+    def run_prefill(self, pool, tokens, page_ids):
+        """Pad ``tokens`` to its bucket and land its K/V in the
+        host-allocated ``page_ids`` (list; padded with the trash page
+        to the bucket's page count). Returns (pool', first_token,
+        logits | None)."""
+        tokens = onp.asarray(tokens, 'int32').reshape(-1)
+        n = tokens.shape[0]
+        if n < 1:
+            raise ValueError('empty prompt')
+        bucket = self.policy.bucket_for(n)
+        npages = pages_for(bucket, self.page_size)
+        ids = list(page_ids)
+        if len(ids) > npages:
+            raise ValueError('%d page ids for a %d-page bucket'
+                             % (len(ids), npages))
+        ids = ids + [TRASH_PAGE] * (npages - len(ids))
+        padded = onp.zeros((1, bucket), 'int32')
+        padded[0, :n] = tokens
+        prog = self.compile_prefill(bucket)
+        pool, tok, logits = self._unpack(prog(
+            self._params, pool, padded, onp.int32(n),
+            onp.asarray(ids, 'int32')))
+        return pool, int(tok), \
+            None if logits is None else onp.asarray(logits)
+
+    def run_step(self, pool, tokens, positions, tables):
+        """Advance every slot one token through its page table."""
+        prog = self.compile_step()
+        pool, toks, logits = self._unpack(prog(
+            self._params, pool,
+            onp.asarray(tokens, 'int32').reshape(self.slots),
+            onp.asarray(positions, 'int32').reshape(self.slots),
+            onp.asarray(tables, 'int32').reshape(self.slots,
+                                                 self.max_pages)))
+        return pool, onp.asarray(toks), \
+            None if logits is None else onp.asarray(logits)
+
+    def run_verify(self, pool, tokens, positions, tables):
+        """Speculative verify: (slots, spec_k+1) tokens in, greedy
+        tokens (slots, spec_k+1) out; K/V rows written for every
+        position (rejected rows stay masked until overwritten)."""
+        prog = self.compile_verify()
+        pool, toks, logits = self._unpack(prog(
+            self._params, pool,
+            onp.asarray(tokens, 'int32').reshape(self.slots,
+                                                 self.spec_k + 1),
+            onp.asarray(positions, 'int32').reshape(self.slots),
+            onp.asarray(tables, 'int32').reshape(self.slots,
+                                                 self.max_pages)))
+        return pool, onp.asarray(toks), \
+            None if logits is None else onp.asarray(logits)
+
+    def run_copy_page(self, pool, src, dst):
+        """Copy-on-write: duplicate page ``src`` into ``dst``."""
+        prog = self.compile_copy_page()
+        return prog(self._params, pool, onp.int32(src),
+                    onp.int32(dst))
+
+
 def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,
                   max_len=None, name=None, donate=None,
-                  emit_logits=True):
+                  emit_logits=True, paged=None, page_size=None,
+                  pages=None, spec_k=None):
     """Freeze a generation model into a :class:`DecodeProgram`.
 
     ``obj`` — one of:
@@ -431,6 +725,15 @@ def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,
 
     ``max_len`` caps prompt + generated tokens per sequence (the KV
     cache length; ``MXNET_TPU_SERVE_MAX_SEQ_LEN``).
+
+    ``paged`` selects the block/paged KV cache
+    (:class:`PagedDecodeProgram`): default (None) reads
+    ``MXNET_TPU_SERVE_PAGED`` and applies it to families that support
+    paging (transformers; RNN state is O(1) per slot already —
+    requesting ``paged=True`` for one is a typed error).
+    ``page_size`` / ``pages`` / ``spec_k`` configure the pool and the
+    speculative-verify program (``MXNET_TPU_SERVE_PAGE_SIZE`` /
+    ``MXNET_TPU_SERVE_PAGES`` / ``MXNET_TPU_SERVE_SPEC_K``).
     """
     if max_len is None:
         max_len = int(_knob('MXNET_TPU_SERVE_MAX_SEQ_LEN', 256))
@@ -455,6 +758,18 @@ def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,
                     % (type(obj).__name__,))
         model, params = from_gluon_rnn_lm(embedding, rnn, decoder,
                                           max_len=max_len)
+    if paged is None:
+        paged = bool(_knob('MXNET_TPU_SERVE_PAGED', True)) \
+            and getattr(model, 'supports_paging', False)
+    if paged:
+        if pages is None:
+            knob_pages = int(_knob('MXNET_TPU_SERVE_PAGES', 0) or 0)
+            pages = knob_pages or None
+        return PagedDecodeProgram(
+            model, params, slots=slots,
+            prefill_buckets=prefill_buckets, name=name, donate=donate,
+            emit_logits=emit_logits, page_size=page_size, pages=pages,
+            spec_k=spec_k)
     return DecodeProgram(model, params, slots=slots,
                          prefill_buckets=prefill_buckets, name=name,
                          donate=donate, emit_logits=emit_logits)

@@ -470,7 +470,10 @@ def load_frozen(path):
     manifest ``kind`` — one-shot inference programs load as
     :class:`FrozenProgram`, generation artifacts (``kind: decode``,
     prefill + decode-step executables) as
-    :class:`~.decode.DecodeProgram`."""
+    :class:`~.decode.DecodeProgram`; decode manifests carrying
+    ``paged: true`` (page-pool geometry + copy/verify programs)
+    re-dispatch once more to :class:`~.decode.PagedDecodeProgram`
+    inside ``DecodeProgram.load``."""
     try:
         with open(os.path.join(path, 'MANIFEST.json')) as f:
             kind = json.load(f).get('kind')

@@ -77,14 +77,18 @@ class InferenceSession:
                  max_queue=None, timeout_s=None, breaker=None,
                  watchdog=True, stall_artifact=None, name=None,
                  warmup=False, max_new_tokens=None,
-                 prefill_interleave=None):
+                 prefill_interleave=None, draft=None):
         from .decode import DecodeProgram
         from ..resilience.policy import CircuitBreaker
         if isinstance(frozen, DecodeProgram):
             self._init_decode(frozen, max_queue, timeout_s, breaker,
                               watchdog, stall_artifact, name, warmup,
-                              max_new_tokens, prefill_interleave)
+                              max_new_tokens, prefill_interleave,
+                              draft)
             return
+        if draft is not None:
+            raise TypeError('draft= (speculative decoding) applies to '
+                            'decode-mode sessions only')
         self._engine = None
         if not isinstance(frozen, FrozenProgram):
             raise TypeError('InferenceSession serves a FrozenProgram '
@@ -148,12 +152,22 @@ class InferenceSession:
 
     def _init_decode(self, program, max_queue, timeout_s, breaker,
                      watchdog, stall_artifact, name, warmup,
-                     max_new_tokens, prefill_interleave):
+                     max_new_tokens, prefill_interleave, draft=None):
         """Generation mode: continuous-batching decode engine instead
         of the flush micro-batcher (same admission/resilience
-        contract, new injection site ``serving.decode``)."""
+        contract, new injection site ``serving.decode``).
+
+        ``draft`` (or the ``MXNET_TPU_SERVE_SPEC_DRAFT`` artifact
+        path) enables speculative decoding on paged targets with
+        ``spec_k > 0``: the draft proposes, the target verifies."""
         from .decode.engine import DecodeEngine
         from ..resilience.policy import CircuitBreaker
+        if draft is None and getattr(program, 'paged', False) \
+                and int(getattr(program, 'spec_k', 0)) > 0:
+            draft_path = _knob('MXNET_TPU_SERVE_SPEC_DRAFT', None)
+            if draft_path:
+                from .decode import load_decode
+                draft = load_decode(str(draft_path))
         self.frozen = program
         self.name = name or program.name
         self._batcher = None
@@ -188,7 +202,7 @@ class InferenceSession:
                 prefill_interleave if prefill_interleave is not None
                 else _knob('MXNET_TPU_SERVE_PREFILL_INTERLEAVE', 1)),
             breaker=self._breaker, watchdog=self._watchdog,
-            name=self.name)
+            name=self.name, draft=draft)
 
     # -- request API -------------------------------------------------------
 
@@ -365,7 +379,7 @@ class InferenceSession:
         """Machine-readable session state (the /status JSON)."""
         if self._engine is not None:
             stats = self._engine.stats()
-            return {
+            record = {
                 'status': 'degraded' if stats['degraded'] else 'ok',
                 'name': self.name,
                 'mode': 'decode',
@@ -378,6 +392,14 @@ class InferenceSession:
                 'max_len': self.frozen.max_len,
                 'compiled': self.frozen.compile_count,
             }
+            if getattr(self.frozen, 'paged', False):
+                record['paged'] = {
+                    'page_size': self.frozen.page_size,
+                    'pages': self.frozen.pages,
+                    'max_pages': self.frozen.max_pages,
+                    'spec_k': int(getattr(self.frozen, 'spec_k', 0)),
+                }
+            return record
         with self._lock:
             degraded = self._degraded
             record = {

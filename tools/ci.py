@@ -210,6 +210,16 @@ def main(argv=None):
         ('bench-decode', [py, 'bench_serving.py', '--decode',
                           '--quick', '--out',
                           '/tmp/BENCH_DECODE.json']),
+        # paged-KV-cache quick sweep (docs/SERVING.md "Paged KV
+        # cache"): >= 4x concurrent sequences at equal HBM budget vs
+        # the slot cache (pool-bytes accounting, confirmed live),
+        # prefix-sharing TTFT p99 no worse than no-sharing on the
+        # shared-prefix workload, the speculative tokens/s +
+        # acceptance-rate A/B, and paged-vs-reference token
+        # bit-identity
+        ('bench-paged', [py, 'bench_serving.py', '--paged',
+                         '--quick', '--out',
+                         '/tmp/BENCH_PAGED.json']),
         # open-loop load & chaos SLO gate (docs/SERVING.md "SLOs and
         # overload behavior"): overload mode at 2.5x measured
         # capacity must keep admitted p99 inside the budget with the

@@ -160,17 +160,27 @@ def _build_bert_program(quick, mesh_axes=None, zero=False, amp=None):
     return pt, cfg
 
 
-def _build_decode_program(quick, mesh_axes=None, zero=False, amp=None):
+def _build_decode_program(quick, mesh_axes=None, zero=False, amp=None,
+                          decode_opts=None):
     """The TransformerLM decode-step program (the per-token hot loop
     of the serving engine). Single-device by construction — the mesh/
     zero/amp knobs do not apply; the Pallas knob does (the flash
-    decode kernel reads the slot KV cache in place), which is exactly
-    what `--pallas attention` audits here."""
+    decode kernel reads the KV cache in place), which is exactly
+    what `--pallas attention` audits here.
+
+    Paged by default (docs/SERVING.md "Paged KV cache"): the config
+    block records page_size / pages / spec (and pool_bytes for the
+    HLO-DECODE-PAGED verifier), so a paged audit never silently diffs
+    against a slot-cache baseline or a different page geometry —
+    cross-config diffs are REFUSED. ``--slot-cache`` builds the PR-6
+    layout for A/B."""
     del mesh_axes, zero, amp
     import jax
     from mxnet_tpu.ops.pallas import resolve_spec
     from mxnet_tpu.serving.decode.model import init_transformer_lm
-    from mxnet_tpu.serving.decode.program import DecodeProgram
+    from mxnet_tpu.serving.decode.program import (DecodeProgram,
+                                                  PagedDecodeProgram)
+    opts = dict(decode_opts or {})
     if quick:
         vocab, units, hidden, layers, heads, max_len, slots = \
             100, 32, 64, 2, 4, 64, 4
@@ -180,13 +190,26 @@ def _build_decode_program(quick, mesh_axes=None, zero=False, amp=None):
     model, params = init_transformer_lm(
         vocab, units=units, hidden=hidden, layers=layers, heads=heads,
         max_len=max_len)
-    prog = DecodeProgram(model, params, slots=slots,
-                         prefill_buckets=(8,))
-    text = prog.compile_step().as_text()
     cfg = {'model': 'transformer_lm-decode-step',
            'units': units, 'layers': layers, 'slots': slots,
            'max_len': max_len, 'pallas': resolve_spec(),
            'platform': jax.default_backend()}
+    if opts.get('slot_cache'):
+        prog = DecodeProgram(model, params, slots=slots,
+                             prefill_buckets=(8,))
+        cfg['cache'] = 'slot'
+    else:
+        page_size = int(opts.get('page_size') or (8 if quick else 16))
+        spec_k = int(opts.get('spec_k') or 0)
+        prog = PagedDecodeProgram(model, params, slots=slots,
+                                  prefill_buckets=(8,),
+                                  page_size=page_size, spec_k=spec_k)
+        cfg.update({'cache': 'paged', 'page_size': page_size,
+                    'pages': prog.pages, 'spec': spec_k,
+                    'pool_bytes': prog.cache_bytes(),
+                    'pool_array_bytes':
+                        prog.pages * page_size * units * 4})
+    text = prog.compile_step().as_text()
     return text, cfg
 
 
@@ -221,7 +244,7 @@ def _parse_mesh(text):
 
 
 def audit_program(name, quick, top=None, mesh_axes=None, zero=False,
-                  amp=None):
+                  amp=None, decode_opts=None):
     """Build one reference step program and return its fusion artifact.
 
     ``amp`` follows :func:`mxnet_tpu.amp.resolve` semantics (None reads
@@ -230,8 +253,10 @@ def audit_program(name, quick, top=None, mesh_axes=None, zero=False,
     the roofline classifies the program against the matching peak
     (bf16/fp16 MXU rate vs the fp32 passthrough rate)."""
     from mxnet_tpu.observability import roofline
-    built, config = _BUILDERS[name](quick, mesh_axes=mesh_axes,
-                                    zero=zero, amp=amp)
+    kwargs = {'mesh_axes': mesh_axes, 'zero': zero, 'amp': amp}
+    if name == 'decode_step':
+        kwargs['decode_opts'] = decode_opts
+    built, config = _BUILDERS[name](quick, **kwargs)
     config['quick'] = bool(quick)
     # trainer builders return the ParallelTrainer; the decode builder
     # returns the compiled step program's HLO text directly
@@ -303,6 +328,19 @@ def main(argv=None):
                         'baseline; the delta vs the committed '
                         'baseline is what the acceptance criterion '
                         'reads. Default: the MXNET_TPU_PALLAS knob')
+    p.add_argument('--page-size', type=int, default=None,
+                   help='page size for the --model decode paged '
+                        'build (default 8 quick / 16 full; recorded '
+                        'in the config block so cross-geometry diffs '
+                        'are refused)')
+    p.add_argument('--spec-k', type=int, default=0,
+                   help='speculative-verify lookahead for the '
+                        '--model decode build (recorded as "spec" in '
+                        'the config block)')
+    p.add_argument('--slot-cache', action='store_true',
+                   help='build the --model decode program over the '
+                        'PR-6 slot cache instead of the paged pool '
+                        '(the A/B reference)')
     p.add_argument('--zero', action='store_true',
                    help='build with the ZeRO dp-sharded weight update '
                         '(MXNET_TPU_ZERO semantics) — the audit then '
@@ -353,18 +391,25 @@ def main(argv=None):
         wanted = {'resnet': ['resnet50_step'], 'bert': ['bert_step'],
                   'decode': ['decode_step'],
                   'both': ['resnet50_step', 'bert_step']}[args.model]
+        decode_opts = {'page_size': args.page_size,
+                       'spec_k': args.spec_k,
+                       'slot_cache': args.slot_cache}
         for name in wanted:
-            print('== fusion_audit: building %s (%s%s%s%s)'
+            print('== fusion_audit: building %s (%s%s%s%s%s)'
                   % (name, 'quick' if args.quick else 'full',
                      ', mesh %s' % mesh_axes if mesh_axes else '',
                      ', zero' if args.zero else '',
-                     ', amp=%s' % args.amp if args.amp else ''),
+                     ', amp=%s' % args.amp if args.amp else '',
+                     ', slot-cache' if (args.slot_cache
+                                        and name == 'decode_step')
+                     else ''),
                   flush=True)
             programs[name] = audit_program(name, args.quick,
                                            top=args.top,
                                            mesh_axes=mesh_axes,
                                            zero=args.zero,
-                                           amp=args.amp)
+                                           amp=args.amp,
+                                           decode_opts=decode_opts)
 
     for name, art in programs.items():
         print(roofline.format_table(art))

@@ -8,7 +8,9 @@ committed SLO_BASELINE.json:
 
   * budgets  — the SLO numbers the serving stack must hold (admitted
     p99 under overload, shed-response p99, availability floor and
-    per-fault recovery ceiling under chaos, zero unresolved futures,
+    per-fault recovery ceiling under chaos — including the paged
+    pool-exhaustion squeeze resolving typed with zero hangs — and the
+    shared-prefix workload's TTFT p99, zero unresolved futures,
     zero leaked decode slots). Budgets are CEILINGS, not measured
     snapshots: the gate fails only on regressions past them, never on
     improvements — the LINT_BASELINE/FUSION_BASELINE contract.
@@ -42,6 +44,7 @@ _BUDGET_KNOBS = {
     'availability_floor': 'MXNET_TPU_SLO_AVAILABILITY',
     'recovery_ceiling_s': 'MXNET_TPU_SLO_RECOVERY_S',
     'goodput_floor': 'MXNET_TPU_SLO_GOODPUT',
+    'prefix_ttft_p99_ms': 'MXNET_TPU_SLO_PREFIX_TTFT_P99_MS',
 }
 
 
@@ -150,7 +153,7 @@ def main(argv=None):
             raise SystemExit('--skip-run needs --overload/--chaos')
     else:
         tmp = tempfile.mkdtemp(prefix='slo_gate_')
-        for mode in ('overload', 'chaos'):
+        for mode in ('overload', 'chaos', 'prefix'):
             artifacts.append(run_mode(
                 mode, os.path.join(tmp, '%s.json' % mode), budgets,
                 full=args.full))

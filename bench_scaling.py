@@ -25,6 +25,13 @@ weight update against the ZeRO dp-sharded one (MXNET_TPU_ZERO,
 docs/PARALLEL.md) at the largest measured dp and records per-device
 optimizer-state bytes (ideal 1/dp of replicated), per-step collective
 traffic, and step time under artifact key ``zero_update``.
+
+The MULTICHIP leg (``--dist``, docs/DISTRIBUTED.md) spawns a REAL
+two-process dp=2 pod over the local Gloo launcher and records the
+cross-host trainer's step time and per-step collective bytes under
+artifact key ``dist`` — the multi-host analog of the rows table (the
+same key the ``dist`` CI stage checks; on this rig the numbers price
+the Gloo loopback, on a pod they price DCN).
 """
 import argparse
 import json
@@ -122,8 +129,17 @@ def main(argv=None):
     p.add_argument('--iters', type=int, default=None)
     p.add_argument('--no-zero-leg', action='store_true',
                    help='skip the sharded-update (ZeRO) A/B leg')
+    p.add_argument('--dist', action='store_true',
+                   help='add the MULTICHIP leg: a 2-process dp=2 pod '
+                        'over the local Gloo launcher (step time + '
+                        'collective bytes under artifact key "dist")')
+    p.add_argument('--dist-worker', default=None,
+                   help=argparse.SUPPRESS)   # internal: pod worker out
     p.add_argument('--out', default='SCALING.json')
     args = p.parse_args(argv)
+
+    if args.dist_worker:
+        return _dist_worker(args)
 
     import os
     import jax
@@ -243,13 +259,111 @@ def main(argv=None):
         }
         print(json.dumps({'zero_update': zero_leg}), flush=True)
 
+    dist_leg = None
+    if args.dist:
+        dist_leg = _dist_leg(batch, iters)
+        print(json.dumps({'dist': dist_leg}), flush=True)
+
     artifact = {'model': args.model, 'batch_per_chip': batch,
                 'image': image, 'weak_scaling': True, 'rows': rows,
-                'zero_update': zero_leg,
+                'zero_update': zero_leg, 'dist': dist_leg,
                 'status': 'ok' if on_accel else 'degraded',
                 'backend': status.as_dict(), 'error': status.error}
     write_artifact(args.out, artifact)
     return artifact
+
+
+def _dist_worker(args):
+    """Pod-worker half of the MULTICHIP leg: joined via the launcher
+    env, train dp=2 across both processes, rank 0 writes the record."""
+    import jax
+    jax.config.update('jax_default_matmul_precision', 'float32')
+    import mxnet_tpu as mx
+    from mxnet_tpu import dist, gluon, nd, parallel
+    from mxnet_tpu.gluon import nn
+
+    c = dist.get_coordinator()
+    c.start_heartbeat()
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation='relu'), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    mesh = dist.global_mesh({'dp': 2})
+    batch = args.batch_per_chip or 4
+    B = 2 * batch
+    x = np.random.uniform(-1, 1, (B, 32)).astype('float32')
+    y = np.random.randint(0, 10, (B,)).astype('float32')
+    lo, hi = dist.host_shard(mesh, B)
+    xl, yl = nd.array(x[lo:hi]), nd.array(y[lo:hi])
+    pt = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.05, 'momentum': 0.9}, mesh)
+    pt.step(xl, yl)                       # compile
+    iters = args.iters or 10
+    c.barrier('bench_start', timeout_s=60)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = pt.step(xl, yl)
+    out.wait_to_read()
+    dt = (time.perf_counter() - t0) / iters
+    comm, per_kind = collective_bytes(pt.compiled_text())
+    c.barrier('bench_done', timeout_s=60)
+    if c.process_id == 0:
+        from mxnet_tpu.resilience.checkpoint import atomic_write_bytes
+        record = {
+            'model': 'mlp',
+            'processes': c.process_count,
+            'devices_per_host': 1,
+            'dp': 2,
+            'global_batch': B,
+            'ms_per_step': round(dt * 1e3, 2),
+            'samples_per_sec': round(B / dt, 1),
+            'comm_bytes_per_step': comm,
+            'comm_by_kind': per_kind,
+            'transport': 'gloo-loopback',
+        }
+        atomic_write_bytes(args.dist_worker,
+                           (json.dumps(record, sort_keys=True)
+                            + '\n').encode())
+    return 0
+
+
+def _dist_leg(batch, iters):
+    """Spawn the 2-process pod and collect rank 0's record (the
+    MULTICHIP bench leg; always the MLP model — the record says so).
+    A launch failure degrades to a typed record instead of failing the
+    whole bench — same posture as the backend acquire."""
+    import os
+    import sys
+    import tempfile
+    from mxnet_tpu.dist import launcher
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, 'dist_row.json')
+        res = launcher.launch_local(
+            2,
+            [sys.executable, os.path.abspath(__file__),
+             '--model', 'mlp', '--batch-per-chip', str(batch),
+             '--iters', str(iters), '--dist-worker', out],
+            env={'PYTHONPATH': os.pathsep.join(
+                [os.path.dirname(os.path.abspath(__file__)),
+                 os.environ.get('PYTHONPATH', '')])},
+            log_dir=os.path.join(tmp, 'logs'), platform='cpu',
+            local_devices=1, timeout=300)
+        if not res.ok or not os.path.exists(out):
+            # tail the CAUSAL rank's log: a launcher-terminated peer
+            # (-15) is collateral, its log hides the real error
+            causes = [w for w in res.failures()
+                      if w.returncode != -15] or res.failures()
+            return {'status': 'failed',
+                    'returncodes': res.returncodes,
+                    'rank': causes[0].rank if causes else None,
+                    'tail': causes[0].log_tail(600) if causes else ''}
+        with open(out) as f:
+            record = json.load(f)
+    record['status'] = 'ok'
+    return record
 
 
 if __name__ == '__main__':

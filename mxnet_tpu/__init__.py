@@ -38,6 +38,7 @@ from . import plugin
 from . import io
 from . import gluon
 from . import parallel
+from . import dist
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
@@ -69,6 +70,14 @@ from . import amp
 # persistent XLA compilation cache (MXNET_TPU_COMPILE_CACHE): applied
 # before any program compiles so restarts warm-start from disk
 config.configure_compile_cache()
+
+# the join happened before observability existed; stamp it into the
+# flight ring now so multi-host post-mortems see the membership event
+if _dist_init.is_initialized() and observability.enabled():
+    observability.record_event(
+        'dist_join', process_id=_dist_init.process_info()[0],
+        process_count=_dist_init.process_info()[1])
+    observability.dist_instruments().joins.inc()
 
 # env-driven global seed (docs/faq/env_var.md MXNET_SEED)
 _seed = config.get('MXNET_SEED')

@@ -446,6 +446,42 @@ KNOBS = {k.name: k for k in [
           ' degrading to synchronous transfers (a hung staging thread'
           ' — real or injected hang@io.prefetch — must never deadlock'
           ' fit; pending batches are recovered, none are dropped).'),
+    # pod-scale multi-host runtime (docs/DISTRIBUTED.md)
+    _knob('MXNET_TPU_DIST_INIT_TIMEOUT_S', float, 300.0,
+          'Budget for the jax.distributed join handshake at import'
+          ' (read from the ENVIRONMENT by mxnet_tpu._dist_init — it'
+          ' runs before this registry loads, so config.set has no'
+          ' effect on it). Expiry raises the typed DistInitError'
+          ' instead of blocking forever on a missing coordinator.'),
+    _knob('MXNET_TPU_DIST_BARRIER_TIMEOUT_S', float, 60.0,
+          'Default timeout for dist.Coordinator named barriers and'
+          ' broadcasts: a peer that never arrives surfaces as a typed'
+          ' HostLostError/BarrierTimeout within this budget — never a'
+          ' collective hang.'),
+    _knob('MXNET_TPU_DIST_HEARTBEAT_S', float, 2.0,
+          'Cadence of the dist.Coordinator background liveness stamp'
+          ' (key-value heartbeat on the coordination service).'),
+    _knob('MXNET_TPU_DIST_HEARTBEAT_TIMEOUT_S', float, 10.0,
+          'A peer whose newest heartbeat stamp is older than this is'
+          ' declared lost (Coordinator.dead_peers/check_peers raise'
+          ' HostLostError naming it).'),
+    _knob('MXNET_TPU_DIST_LOCAL_DEVICES', int, 0,
+          'Virtual CPU devices per worker the dist launcher forces'
+          ' via --xla_force_host_platform_device_count (the 1-device-'
+          'per-host pod simulation). 0 leaves XLA_FLAGS untouched.'),
+    # serving gateway (docs/DISTRIBUTED.md "Gateway")
+    _knob('MXNET_TPU_GATEWAY_PORT', int, 0,
+          'Default port for the multi-replica serving gateway when'
+          ' ServingGateway(port=None) (binds 127.0.0.1; 0 picks a'
+          ' free port).'),
+    _knob('MXNET_TPU_GATEWAY_HEALTH_S', float, 1.0,
+          'Gateway health-probe cadence: each replica\'s /healthz is'
+          ' polled this often; non-200 (or unreachable) replicas'
+          ' leave the routing rotation until they recover.'),
+    _knob('MXNET_TPU_GATEWAY_TIMEOUT_S', float, 30.0,
+          'Per-request budget for a gateway-forwarded upstream call;'
+          ' an unreachable replica fails over to the next healthy'
+          ' one, and an all-replicas-down gateway answers typed 503.'),
     # preemption / elasticity / watchdog (docs/RESILIENCE.md)
     _knob('MXNET_TPU_PREEMPT_EXIT_CODE', int, 75,
           'Process exit code marking a preempted-but-resumable run'

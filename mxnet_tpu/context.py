@@ -17,6 +17,18 @@ _DEVTYPE_NAMES = {1: 'cpu', 2: 'gpu', 3: 'cpu_pinned', 5: 'cpu_shared', 6: 'tpu'
 _DEVTYPE_IDS = {v: k for k, v in _DEVTYPE_NAMES.items()}
 
 
+def _local(devs):
+    """On a multi-process runtime, contexts resolve to THIS process's
+    devices — a peer host's device is not addressable for eager work
+    (docs/DISTRIBUTED.md). Single-process runs see every device, as
+    before."""
+    if jax.process_count() <= 1:
+        return devs
+    me = jax.process_index()
+    mine = [d for d in devs if d.process_index == me]
+    return mine or devs
+
+
 class Context:
     """A device context.
 
@@ -77,17 +89,17 @@ class Context:
         """
         if self.device_type.startswith('cpu'):
             try:
-                devs = jax.devices('cpu')
+                devs = _local(jax.devices('cpu'))
             except RuntimeError:
                 # no cpu platform registered (JAX_PLATFORMS=tpu) — fall
                 # back to the default backend rather than crash host-side
                 # staging paths
-                return jax.devices()[0]
+                return _local(jax.devices())[0]
             if self.device_id >= len(devs):
                 raise ValueError(
                     '%s: only %d cpu device(s) available' % (self, len(devs)))
             return devs[self.device_id]
-        devs = jax.devices()
+        devs = _local(jax.devices())
         accel = [d for d in devs if d.platform != 'cpu'] or devs
         if self.device_id >= len(accel):
             raise ValueError(

@@ -1,0 +1,74 @@
+"""Pod-scale multi-host runtime (docs/DISTRIBUTED.md).
+
+Makes multi-process execution a first-class runtime instead of an env
+hack — ROADMAP item 1. Four pieces, layered over
+:mod:`mxnet_tpu._dist_init` (the pre-backend ``jax.distributed`` join):
+
+  * :mod:`.topology`    — global meshes spanning processes, local-vs-
+                          global device maps, per-host data shards, and
+                          the placement helpers (``put_global`` /
+                          ``put_local_shard``) ParallelTrainer threads
+                          through.
+  * :mod:`.coordinator` — named barriers with timeouts (typed
+                          :class:`HostLostError` instead of a
+                          collective hang), broadcast-from-process-0,
+                          heartbeat peer liveness.
+  * :mod:`.launcher`    — spawn-N-local-processes harness over the
+                          Gloo CPU backend honoring the ``DMLC_*``
+                          contract, with per-rank logs and rc-75
+                          resumable propagation.
+  * ``python -m mxnet_tpu.dist`` — the selftest the ``dist`` CI stage
+                          gates: join, barrier-timeout, 2-process
+                          bit-identity, cross-process-count resume,
+                          host loss, and the serving gateway.
+
+The serving half (health-aware multi-replica routing) lives in
+:mod:`mxnet_tpu.serving.gateway`.
+"""
+from __future__ import annotations
+
+from .._dist_init import (DistInitError, ensure_distributed,
+                          is_initialized, process_info)
+from . import coordinator
+from . import launcher
+from . import topology
+from .coordinator import (BarrierTimeout, BroadcastTimeout, Coordinator,
+                          HostLostError, get_coordinator)
+from .launcher import LaunchResult, WorkerResult, launch_local
+from .topology import (device_maps, global_mesh, host_shard,
+                       put_global, put_local_shard, spans_processes)
+
+
+def emergency_exit(code=None):
+    """Exit NOW with the resumable rc, skipping atexit hooks.
+
+    After a peer host dies, a normal interpreter exit blocks inside
+    jax.distributed's atexit ``shutdown()`` (it barriers with the dead
+    peer) until the coordination service's own heartbeat timeout
+    aborts the process ~100 s later with SIGABRT — exactly the hang
+    this subsystem exists to remove. A survivor that decided to
+    restart must therefore leave through ``os._exit``: flush stdio,
+    dump nothing further, exit with the resumable rc (75) the
+    launcher/scheduler contract restarts on (docs/RESILIENCE.md)."""
+    import os as _os
+    import sys as _sys
+    if code is None:
+        from ..resilience.preempt import resumable_exit_code
+        code = resumable_exit_code()
+    try:
+        _sys.stdout.flush()
+        _sys.stderr.flush()
+    except Exception:
+        pass
+    _os._exit(int(code))
+
+__all__ = [
+    'topology', 'coordinator', 'launcher',
+    'DistInitError', 'ensure_distributed', 'is_initialized',
+    'process_info',
+    'HostLostError', 'BarrierTimeout', 'BroadcastTimeout',
+    'Coordinator', 'get_coordinator',
+    'LaunchResult', 'WorkerResult', 'launch_local',
+    'global_mesh', 'device_maps', 'host_shard', 'put_global',
+    'put_local_shard', 'spans_processes', 'emergency_exit',
+]

@@ -1,0 +1,256 @@
+"""Worker-side legs of the dist selftest (``python -m mxnet_tpu.dist``).
+
+Run as ``python -m mxnet_tpu.dist._selftest_worker <phase> <outdir>``
+under the local launcher's DMLC_* env, one process per simulated host.
+Each phase writes machine-checkable evidence into ``<outdir>`` (shared
+filesystem — the local-pod assumption) that the driver then verifies
+against its in-process single-host baselines.
+
+Phases:
+  join      coordinator contract: process identity, broadcast-from-0,
+            named barrier, heartbeat visibility, device maps.
+  barrier   rank 1 exits before the barrier; rank 0 must get the typed
+            HostLostError within the timeout budget — never a hang.
+  train     the tentpole proof: dp=2 across TWO processes (one device
+            each), ZeRO sharded update on, 10 steps over per-host data
+            shards; checkpoint written at step 5 by rank 0 behind a
+            barrier (gathering the cross-host ZeRO shards in-program);
+            losses + final params recorded for the bit-identity diff.
+  guarded   same shape through the in-jit guardrail with one injected
+            NaN step: the skip must be lockstep across hosts.
+  hostloss  both ranks checkpoint at step 3, rank 1 dies; rank 0
+            surfaces HostLostError, records the flight event, and
+            exits with the resumable rc (75) so the launcher/scheduler
+            contract restarts the job smaller.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _seeded_net(seed=0, classes=8, hidden=32):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    # deterministic parameter names even when the caller built other
+    # nets first (the driver builds several baselines in one process)
+    mx.name.NameManager._current.value = mx.name.NameManager()
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation='relu'),
+                nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _data(seed=0, classes=8, feats=16, batch=16, steps=10):
+    import numpy as np
+    rs = np.random.RandomState(seed + 1)
+    xs = [rs.randn(batch, feats).astype('float32')
+          for _ in range(steps)]
+    ys = [rs.randint(0, classes, (batch,)).astype('float32')
+          for _ in range(steps)]
+    return xs, ys
+
+
+def _params_sorted(net):
+    import numpy as np
+    return {k: np.asarray(p.data().asnumpy())
+            for k, p in sorted(net.collect_params().items())}
+
+
+def _write(outdir, name, payload):
+    path = os.path.join(outdir, name)
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(payload, f, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def phase_join(outdir):
+    import mxnet_tpu as mx  # noqa: F401 - joins the runtime
+    from mxnet_tpu import dist
+    c = dist.get_coordinator()
+    assert dist.is_initialized(), 'launcher env did not join'
+    assert c.process_count == 2, c.process_count
+    c.start_heartbeat(0.3)
+    seed = c.broadcast('seed', {'seed': 20260804}
+                       if c.process_id == 0 else None)
+    dt = c.barrier('join', timeout_s=30)
+    mesh = dist.global_mesh({'dp': 2})
+    maps = dist.device_maps(mesh)
+    lo, hi = dist.host_shard(mesh, 8)
+    import time
+    time.sleep(0.6)          # let both ranks' heartbeats land
+    ages = c.peer_ages()
+    _write(outdir, 'join-%d.json' % c.process_id, {
+        'process_id': c.process_id,
+        'process_count': c.process_count,
+        'seed': seed,
+        'barrier_s': dt,
+        'maps': maps,
+        'shard': [lo, hi],
+        'peers_seen': sorted(ages),
+    })
+    c.barrier('join_done', timeout_s=30)
+
+
+def phase_barrier(outdir):
+    import time
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import dist
+    c = dist.get_coordinator()
+    c.start_heartbeat(0.3)
+    c.barrier('arm', timeout_s=30)
+    if c.process_id == 1:
+        return                     # rank 1 never reaches 'never'
+    t0 = time.time()
+    try:
+        c.barrier('never', timeout_s=4)
+    except dist.HostLostError as exc:
+        waited = time.time() - t0
+        _write(outdir, 'barrier-0.json', {
+            'typed': type(exc).__name__,
+            'waited_s': waited,
+            'within_budget': waited < 12.0,
+            'message': str(exc)[:200],
+        })
+        return
+    _write(outdir, 'barrier-0.json', {'typed': None})
+    sys.exit(2)
+
+
+def _trainer(net, mesh, guard=None, zero=True):
+    from mxnet_tpu import gluon, parallel
+    return parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1, 'momentum': 0.9}, mesh,
+        guardrail=guard, zero=zero)
+
+
+def phase_train(outdir):
+    import numpy as np
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import dist, nd
+    from mxnet_tpu.resilience import CheckpointManager
+    c = dist.get_coordinator()
+    c.start_heartbeat(0.5)
+    net = _seeded_net()
+    xs, ys = _data()
+    mesh = dist.global_mesh({'dp': 2})
+    pt = _trainer(net, mesh, zero=True)
+    mgr = CheckpointManager(os.path.join(outdir, 'ckpt'), prefix='pt')
+    losses = []
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        lo, hi = dist.host_shard(mesh, x.shape[0])
+        losses.append(float(pt.step(nd.array(x[lo:hi]),
+                                    nd.array(y[lo:hi])).asscalar()))
+        if i == 4:
+            path = pt.save_checkpoint(mgr)
+            # rank-0-writes contract: exactly one rank returns a path
+            assert (path is not None) == (c.process_id == 0), path
+    assert pt.zero, 'ZeRO did not activate on the cross-host mesh'
+    c.barrier('train_done', timeout_s=60)
+    if c.process_id == 0:
+        params = _params_sorted(net)
+        _write(outdir, 'train-0.json', {
+            'losses': losses,
+            'zero': bool(pt.zero),
+            'params': {k: v.tolist() for k, v in params.items()},
+        })
+
+
+def phase_guarded(outdir):
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import dist, nd
+    from mxnet_tpu.guardrail import Guardrail, GuardrailConfig
+    from mxnet_tpu.resilience import FaultInjector
+    c = dist.get_coordinator()
+    c.start_heartbeat(0.5)
+    net = _seeded_net()
+    xs, ys = _data(steps=6)
+    mesh = dist.global_mesh({'dp': 2})
+    guard = Guardrail(GuardrailConfig(init_scale=8.0, patience=10),
+                      injector=FaultInjector('nan@grads:1'))
+    pt = _trainer(net, mesh, guard=guard, zero=True)
+    losses = []
+    for x, y in zip(xs, ys):
+        lo, hi = dist.host_shard(mesh, x.shape[0])
+        losses.append(float(pt.step(nd.array(x[lo:hi]),
+                                    nd.array(y[lo:hi])).asscalar()))
+    actions = [e['action'] for e in guard.events]
+    c.barrier('guarded_done', timeout_s=60)
+    if c.process_id == 0:
+        params = _params_sorted(net)
+        _write(outdir, 'guarded-0.json', {
+            'losses': losses,
+            'actions': actions,
+            'params': {k: v.tolist() for k, v in params.items()},
+        })
+
+
+def phase_hostloss(outdir):
+    import time
+    import mxnet_tpu as mx  # noqa: F401
+    from mxnet_tpu import dist, nd, observability
+    from mxnet_tpu.resilience import CheckpointManager
+    observability.configure_flight(
+        path=os.path.join(outdir, 'FLIGHT.jsonl'))
+    c = dist.get_coordinator()
+    c.start_heartbeat(0.3)
+    net = _seeded_net()
+    xs, ys = _data()
+    mesh = dist.global_mesh({'dp': 2})
+    pt = _trainer(net, mesh, zero=False)
+    mgr = CheckpointManager(os.path.join(outdir, 'ckpt'), prefix='pt')
+    for i in range(3):
+        lo, hi = dist.host_shard(mesh, xs[i].shape[0])
+        pt.step(nd.array(xs[i][lo:hi]), nd.array(ys[i][lo:hi]))
+    pt.save_checkpoint(mgr)
+    if c.process_id == 1:
+        # host 1 dies between the checkpoint and the next step
+        os._exit(0)
+    # host 0: the step boundary guards the next collective with a
+    # barrier — the dead peer surfaces typed, within budget, no hang
+    t0 = time.time()
+    try:
+        c.barrier('step4', timeout_s=4)
+    except dist.HostLostError as exc:
+        waited = time.time() - t0
+        _write(outdir, 'hostloss-0.json', {
+            'typed': type(exc).__name__,
+            'waited_s': waited,
+            'within_budget': waited < 12.0,
+            'flight': observability.get_recorder().path,
+        })
+        # resumable-exit contract (docs/RESILIENCE.md): the scheduler
+        # restarts the job on the surviving hosts from the checkpoint.
+        # emergency_exit skips atexit — jax.distributed's shutdown
+        # would barrier with the DEAD peer until SIGABRT otherwise
+        dist.emergency_exit(75)
+    _write(outdir, 'hostloss-0.json', {'typed': None})
+    sys.exit(2)
+
+
+PHASES = {
+    'join': phase_join,
+    'barrier': phase_barrier,
+    'train': phase_train,
+    'guarded': phase_guarded,
+    'hostloss': phase_hostloss,
+}
+
+
+def main():
+    phase, outdir = sys.argv[1], sys.argv[2]
+    import jax
+    jax.config.update('jax_default_matmul_precision', 'float32')
+    PHASES[phase](outdir)
+
+
+if __name__ == '__main__':
+    main()

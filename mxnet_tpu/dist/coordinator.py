@@ -1,0 +1,390 @@
+"""Host-level coordination: named barriers with timeouts, broadcast
+from process 0, and heartbeat-based peer liveness.
+
+The failure mode this module exists to remove: a peer host dies and
+every survivor blocks forever inside a collective (the DCN all-reduce
+has no abort). Everything here runs over the ``jax.distributed``
+coordination service's key-value store and barriers — host-side gRPC,
+no device collectives — so it keeps working exactly when the device
+path is the thing that is wedged:
+
+  * :meth:`Coordinator.barrier` — named barrier with a timeout; expiry
+    raises the typed :class:`HostLostError` (naming the peers whose
+    heartbeats went stale, when heartbeats run) instead of hanging.
+  * :meth:`Coordinator.broadcast` — process 0 publishes a JSON value
+    (RNG seed, checkpoint metadata, an elastic decision), every other
+    process blocks for it with the same timeout discipline.
+  * :meth:`Coordinator.start_heartbeat` / :meth:`dead_peers` /
+    :meth:`check_peers` — each process stamps a liveness key every
+    ``MXNET_TPU_DIST_HEARTBEAT_S``; a peer whose stamp is older than
+    ``MXNET_TPU_DIST_HEARTBEAT_TIMEOUT_S`` is declared lost. This
+    extends the kvstore rejoin protocol (docs/RESILIENCE.md): a
+    restarted worker re-stamps and rejoins; a dead one is detected
+    without waiting on any collective.
+
+On a single-process runtime every operation degenerates to a no-op
+(barriers return immediately, broadcast returns the input), so code
+threads coordination unconditionally and stays testable in-process.
+
+Telemetry: barriers observe ``mxnet_tpu_dist_barrier_seconds``;
+``host_lost`` / ``dist_join`` / ``dist_rejoin`` flight events mark the
+membership transitions a post-mortem needs (docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+__all__ = ['HostLostError', 'BarrierTimeout', 'BroadcastTimeout',
+           'Coordinator', 'get_coordinator']
+
+_DEFAULT_BARRIER_TIMEOUT_S = 60.0
+
+
+def _knob(name, default):
+    try:
+        from .. import config as _config
+        v = _config.get(name)
+        return default if v is None else v
+    except Exception:
+        return default
+
+
+# coordination-service error texts that mean "a peer is gone", not "a
+# bug in this process": the barrier/broadcast paths convert these to
+# the typed HostLostError; anything else propagates untouched
+_PEER_LOSS_MARKERS = ('DEADLINE_EXCEEDED', 'timed out', 'Timed out',
+                      'task died', 'another task', 'Task was aborted',
+                      'UNAVAILABLE', 'heartbeat')
+
+
+def _peer_loss_shaped(message):
+    return any(m in message for m in _PEER_LOSS_MARKERS)
+
+
+class HostLostError(RuntimeError):
+    """A peer process is gone (or unreachable) — the typed surface of
+    what used to be a collective hang.
+
+    ``lost`` lists the process ids believed dead (empty when the
+    barrier timed out without heartbeat evidence); ``waited_s`` is how
+    long we blocked before giving up."""
+
+    def __init__(self, message, lost=(), waited_s=0.0):
+        super().__init__(message)
+        self.lost = tuple(lost)
+        self.waited_s = float(waited_s)
+
+
+class BarrierTimeout(HostLostError):
+    """A named barrier expired before every peer arrived."""
+
+
+class BroadcastTimeout(HostLostError):
+    """A broadcast value never appeared (process 0 is gone or stuck)."""
+
+
+class Coordinator:
+    """Named-barrier / broadcast / liveness front-end over the
+    jax.distributed coordination service.
+
+    One instance per process is the intended shape
+    (:func:`get_coordinator`); explicit instances with distinct
+    ``namespace`` values isolate concurrent subsystems. All methods
+    are safe on a single-process runtime (no-ops).
+    """
+
+    def __init__(self, namespace='mxtpu', client=None, process_id=None,
+                 process_count=None):
+        self._ns = str(namespace)
+        self._explicit_client = client
+        self._pid = process_id
+        self._count = process_count
+        self._seq = {}              # name -> next barrier/broadcast seq
+        self._seq_lock = threading.Lock()
+        # pid -> (last stamp observed, local monotonic time observed):
+        # liveness ages on the LOCAL clock, immune to cross-host skew
+        self._hb_seen = {}
+        self._hb_thread = None
+        self._hb_stop = None
+        self._hb_seq = 0
+
+    # -- runtime plumbing --------------------------------------------------
+
+    @property
+    def process_id(self):
+        if self._pid is None:
+            import jax
+            self._pid = int(jax.process_index())
+        return self._pid
+
+    @property
+    def process_count(self):
+        if self._count is None:
+            import jax
+            self._count = int(jax.process_count())
+        return self._count
+
+    @property
+    def active(self):
+        """True when there is anything to coordinate (>1 process)."""
+        return self.process_count > 1
+
+    def _client(self):
+        if self._explicit_client is not None:
+            return self._explicit_client
+        from jax._src import distributed
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                'no jax.distributed client — multi-process coordination '
+                'needs the launcher env join (mxnet_tpu.dist.launcher / '
+                'docs/DISTRIBUTED.md)')
+        return client
+
+    def _next_seq(self, name):
+        with self._seq_lock:
+            s = self._seq.get(name, 0)
+            self._seq[name] = s + 1
+        return s
+
+    def _observe_barrier(self, seconds):
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.dist_instruments().barrier_seconds.observe(seconds)
+        except Exception:
+            pass
+
+    def _record_host_lost(self, exc, where):
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.dist_instruments().host_lost.inc()
+                _obs.record_event('host_lost', where=where,
+                                  lost=list(exc.lost),
+                                  waited_s=round(exc.waited_s, 3),
+                                  error=str(exc)[:200])
+                _obs.flight_dump(reason='host_lost')
+        except Exception:
+            pass
+
+    # -- barriers ----------------------------------------------------------
+
+    def barrier(self, name, timeout_s=None):
+        """Block until every process reaches this (name, call-count)
+        barrier, or raise :class:`BarrierTimeout` after ``timeout_s``
+        (default ``MXNET_TPU_DIST_BARRIER_TIMEOUT_S``).
+
+        Call-count sequencing means every process must issue the same
+        named barriers in the same order — the usual SPMD contract."""
+        if not self.active:
+            return 0.0
+        if timeout_s is None:
+            timeout_s = float(_knob('MXNET_TPU_DIST_BARRIER_TIMEOUT_S',
+                                    _DEFAULT_BARRIER_TIMEOUT_S))
+        seq = self._next_seq('b/' + name)
+        barrier_id = '%s/b/%s/%d' % (self._ns, name, seq)
+        t0 = time.monotonic()
+        try:
+            self._client().wait_at_barrier(
+                barrier_id, int(max(1.0, timeout_s) * 1000))
+        except Exception as exc:
+            waited = time.monotonic() - t0
+            msg = str(exc)
+            if not _peer_loss_shaped(msg):
+                raise
+            lost = self.dead_peers()
+            detail = ('heartbeats lost from processes %s'
+                      % sorted(lost)) if lost else \
+                'no stale heartbeat — a peer exited or never arrived'
+            err = BarrierTimeout(
+                'barrier %r timed out after %.1fs (%d processes '
+                'expected); %s' % (name, waited, self.process_count,
+                                   detail),
+                lost=sorted(lost), waited_s=waited)
+            self._record_host_lost(err, 'barrier:%s' % name)
+            raise err
+        dt = time.monotonic() - t0
+        self._observe_barrier(dt)
+        return dt
+
+    # -- broadcast ---------------------------------------------------------
+
+    def broadcast(self, name, value=None, root=0, timeout_s=None):
+        """One-to-all JSON broadcast: process ``root`` publishes
+        ``value`` (ignored elsewhere), everyone returns it.
+
+        Like barriers, (name, call-count) sequencing makes repeated
+        broadcasts under one name safe as long as processes issue them
+        in the same order. Raises :class:`BroadcastTimeout` when the
+        value never appears."""
+        if not self.active:
+            return value
+        if timeout_s is None:
+            timeout_s = float(_knob('MXNET_TPU_DIST_BARRIER_TIMEOUT_S',
+                                    _DEFAULT_BARRIER_TIMEOUT_S))
+        seq = self._next_seq('x/' + name)
+        key = '%s/x/%s/%d' % (self._ns, name, seq)
+        client = self._client()
+        if self.process_id == root:
+            client.key_value_set(key, json.dumps(value, sort_keys=True))
+            return value
+        t0 = time.monotonic()
+        try:
+            raw = client.blocking_key_value_get(
+                key, int(max(1.0, timeout_s) * 1000))
+        except Exception as exc:
+            waited = time.monotonic() - t0
+            if not _peer_loss_shaped(str(exc)):
+                raise
+            err = BroadcastTimeout(
+                'broadcast %r from process %d never arrived '
+                '(waited %.1fs)' % (name, root, waited),
+                lost=(root,), waited_s=waited)
+            self._record_host_lost(err, 'broadcast:%s' % name)
+            raise err
+        return json.loads(raw)
+
+    # -- heartbeats / liveness ---------------------------------------------
+
+    def _hb_key(self, pid, seq):
+        return '%s/hb/%d/%d' % (self._ns, pid, seq)
+
+    def _stamp(self):
+        """Write this process's liveness stamp (sequenced keys: the KV
+        store is write-once, so each beat writes hb/<pid>/<seq> and
+        deletes the previous — readers take the max)."""
+        client = self._client()
+        seq = self._hb_seq
+        self._hb_seq += 1
+        client.key_value_set(self._hb_key(self.process_id, seq),
+                             repr(time.time()))
+        if seq:
+            try:
+                client.key_value_delete(
+                    self._hb_key(self.process_id, seq - 1))
+            except Exception:
+                pass
+
+    def start_heartbeat(self, period_s=None):
+        """Start the background liveness stamper (idempotent)."""
+        if not self.active or self._hb_thread is not None:
+            return self
+        if period_s is None:
+            period_s = float(_knob('MXNET_TPU_DIST_HEARTBEAT_S', 2.0))
+        self._stamp()                       # one synchronous stamp
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(period_s):
+                try:
+                    self._stamp()
+                except Exception:
+                    return         # runtime shut down under us
+
+        self._hb_stop = stop
+        self._hb_thread = threading.Thread(
+            target=loop, daemon=True, name='mxtpu-dist-heartbeat')
+        self._hb_thread.start()
+        return self
+
+    def stop_heartbeat(self):
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(timeout=5.0)
+            self._hb_thread = None
+            self._hb_stop = None
+
+    def peer_ages(self):
+        """{process_id: seconds since this process last OBSERVED a new
+        heartbeat stamp from it} for every process that ever stamped.
+        Non-blocking.
+
+        Ages are measured on the LOCAL monotonic clock from the moment
+        a peer's stamp value was last seen to change — never by
+        comparing the peer's embedded wall-clock timestamp against
+        ours, which would read cross-host clock skew as staleness and
+        declare live hosts dead."""
+        if not self.active:
+            return {}
+        try:
+            entries = self._client().key_value_dir_get(
+                '%s/hb/' % self._ns)
+        except Exception:
+            return {}
+        newest = {}
+        for key, val in entries:
+            try:
+                pid = int(key.rsplit('/', 2)[-2])
+                seq = int(key.rsplit('/', 1)[-1])
+            except (ValueError, IndexError):
+                continue
+            stamp = (seq, val)
+            if pid not in newest or stamp > newest[pid]:
+                newest[pid] = stamp
+        now = time.monotonic()
+        with self._seq_lock:
+            for pid, stamp in newest.items():
+                seen = self._hb_seen.get(pid)
+                if seen is None or seen[0] != stamp:
+                    self._hb_seen[pid] = (stamp, now)
+            return {pid: max(0.0, now - self._hb_seen[pid][1])
+                    for pid in newest}
+
+    def dead_peers(self, timeout_s=None):
+        """Process ids whose newest heartbeat is older than
+        ``timeout_s`` (default ``MXNET_TPU_DIST_HEARTBEAT_TIMEOUT_S``).
+        Only meaningful once peers called :meth:`start_heartbeat`;
+        processes that never stamped are not reported (they may simply
+        not run heartbeats)."""
+        if timeout_s is None:
+            timeout_s = float(
+                _knob('MXNET_TPU_DIST_HEARTBEAT_TIMEOUT_S', 10.0))
+        return [pid for pid, age in self.peer_ages().items()
+                if age > timeout_s and pid != self.process_id]
+
+    def check_peers(self, timeout_s=None):
+        """Raise :class:`HostLostError` naming stale-heartbeat peers;
+        returns the (possibly empty) live-peer age map otherwise."""
+        ages = self.peer_ages()
+        if timeout_s is None:
+            timeout_s = float(
+                _knob('MXNET_TPU_DIST_HEARTBEAT_TIMEOUT_S', 10.0))
+        dead = [pid for pid, age in ages.items()
+                if age > timeout_s and pid != self.process_id]
+        if dead:
+            err = HostLostError(
+                'heartbeats lost from process(es) %s (stale > %.1fs)'
+                % (sorted(dead), timeout_s),
+                lost=sorted(dead),
+                waited_s=max(ages[p] for p in dead))
+            self._record_host_lost(err, 'heartbeat')
+            raise err
+        return ages
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        self.stop_heartbeat()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def get_coordinator():
+    """The process-global coordinator (lazily created)."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = Coordinator()
+    return _default

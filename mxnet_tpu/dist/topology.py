@@ -1,0 +1,210 @@
+"""Cross-host mesh topology: global meshes spanning processes, the
+local-vs-global device maps, and per-host data-shard assignment.
+
+Single-host training places every array with ``jax.device_put``; a
+pod-scale run cannot — each process only *addresses* its own devices,
+while the mesh (and every sharding built on it) names devices on every
+host. This module owns the three placement primitives the rest of the
+stack composes (docs/DISTRIBUTED.md):
+
+  * :func:`global_mesh` — a named Mesh over ALL processes' devices,
+    laid out so the ``dp`` axis varies slowest across processes (each
+    host's devices form contiguous dp groups; a ``model`` axis stays
+    inside one host whenever it fits, keeping tensor-parallel
+    collectives on the intra-host interconnect).
+  * :func:`put_global` — place a host-side LOGICAL (full) array under
+    any sharding of a multi-process mesh: every process passes the
+    same full array and ``jax.make_array_from_callback`` materializes
+    only the addressable shards. Degenerates to ``device_put`` on a
+    single-process mesh.
+  * :func:`put_local_shard` / :func:`host_shard` — the data path:
+    each host feeds ONLY its slice of the global batch.
+    :func:`host_shard` says which rows this process owns;
+    :func:`put_local_shard` assembles the global array from the
+    process-local shards (``jax.make_array_from_process_local_data``).
+
+Nothing here creates state: the mesh is data, the maps are pure
+functions of it, so every helper is safely callable from any process
+at any time.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ['spans_processes', 'process_count', 'process_index',
+           'local_devices_of', 'global_mesh', 'device_maps',
+           'host_shard', 'put_global', 'put_local_shard',
+           'fetch_replicated']
+
+
+def process_index():
+    import jax
+    return int(jax.process_index())
+
+
+def process_count():
+    import jax
+    return int(jax.process_count())
+
+
+def spans_processes(mesh_or_sharding):
+    """True when the mesh (or a sharding's mesh) names devices owned
+    by more than one process — the signal every placement helper keys
+    on."""
+    mesh = getattr(mesh_or_sharding, 'mesh', mesh_or_sharding)
+    devs = getattr(mesh, 'devices', None)
+    if devs is None:                      # a sharding without a mesh
+        return False
+    procs = {d.process_index for d in devs.flat}
+    return len(procs) > 1
+
+
+def local_devices_of(mesh):
+    """This process's devices inside ``mesh``, in mesh order."""
+    import jax
+    me = jax.process_index()
+    return [d for d in mesh.devices.flat if d.process_index == me]
+
+
+def global_mesh(axes=None, devices=None):
+    """Named mesh over every process's devices (the cross-host analog
+    of :func:`mxnet_tpu.parallel.create_mesh`).
+
+    ``axes``: dict name->size like ``{'dp': 4, 'model': 2}``; None
+    means pure DP over all global devices; a -1 size is inferred.
+    Devices are ordered (process_index, local order) and reshaped
+    row-major, so the FIRST axis varies slowest across processes:
+    ``{'dp': n_proc * k, 'model': m}`` keeps each host's devices in
+    contiguous dp rows and — when ``m`` divides the per-host device
+    count — the model axis never crosses a host boundary.
+
+    Registers the mesh as the parallel layer's current mesh so
+    ``ParallelTrainer(..., mesh=None)`` picks it up.
+    """
+    import jax
+    import numpy as onp
+    from jax.sharding import Mesh
+    from ..parallel import mesh as _mesh_mod
+
+    if devices is None:
+        devices = sorted(jax.devices(),
+                         key=lambda d: (d.process_index, d.id))
+    n = len(devices)
+    if axes is None:
+        axes = {'dp': n}
+    axes = OrderedDict(axes)
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(onp.prod([s for s in sizes if s != -1]))
+        if known <= 0 or n % known:
+            raise ValueError('mesh axes %s do not divide %d devices'
+                             % (dict(axes), n))
+        sizes[sizes.index(-1)] = n // known
+        axes = OrderedDict(zip(axes.keys(), sizes))
+    total = int(onp.prod(list(axes.values())))
+    if total != n:
+        raise ValueError('mesh axes %s do not cover %d global devices'
+                         % (dict(axes), n))
+    arr = onp.asarray(devices).reshape(tuple(axes.values()))
+    m = Mesh(arr, tuple(axes.keys()))
+    _mesh_mod._state.mesh = m
+    return m
+
+
+def device_maps(mesh):
+    """Local-vs-global view of a mesh, JSON-serializable:
+
+    ``{'process_index', 'process_count', 'global_devices',
+    'local_devices', 'local_coords'}`` where ``local_coords`` maps each
+    addressable device id to its coordinate tuple in the mesh array —
+    the piece a scheduler needs to pin host work to mesh positions."""
+    import jax
+    import numpy as onp
+    me = jax.process_index()
+    coords = {}
+    arr = mesh.devices
+    for idx in onp.ndindex(arr.shape):
+        d = arr[idx]
+        if d.process_index == me:
+            coords[int(d.id)] = tuple(int(i) for i in idx)
+    return {
+        'process_index': int(me),
+        'process_count': int(jax.process_count()),
+        'axes': {k: int(v) for k, v in dict(mesh.shape).items()},
+        'global_devices': int(mesh.size),
+        'local_devices': len(coords),
+        'local_coords': coords,
+    }
+
+
+def host_shard(mesh, global_rows, axis='dp'):
+    """The half-open row range ``(lo, hi)`` of the global batch this
+    process must feed when data is sharded over ``axis`` (leading dim).
+
+    Rows map to dp coordinates block-wise (row r lives on dp index
+    ``r // (global_rows / dp)``); a process owns the union of the rows
+    of its devices' dp coordinates, which is contiguous by the
+    :func:`global_mesh` layout. Raises when the global batch does not
+    divide by the axis or the process's rows are not contiguous (a
+    hand-built interleaved mesh — feed full arrays via
+    :func:`put_global` instead)."""
+    import jax
+    import numpy as onp
+    dp = int(dict(mesh.shape).get(axis, 1))
+    if global_rows % dp:
+        raise ValueError('global batch %d does not divide over %s=%d'
+                         % (global_rows, axis, dp))
+    block = global_rows // dp
+    me = jax.process_index()
+    ax = mesh.axis_names.index(axis)
+    arr = mesh.devices
+    mine = sorted({int(idx[ax]) for idx in onp.ndindex(arr.shape)
+                   if arr[idx].process_index == me})
+    if not mine:
+        raise ValueError('process %d owns no devices of the mesh' % me)
+    lo, hi = mine[0], mine[-1] + 1
+    if mine != list(range(lo, hi)):
+        raise ValueError(
+            'process %d holds non-contiguous %s coords %r — feed the '
+            'full batch via put_global instead' % (me, axis, mine))
+    return lo * block, hi * block
+
+
+def put_global(a, sharding):
+    """Place a full (logical) host array under ``sharding`` whether or
+    not its mesh spans processes.
+
+    Every process must pass the SAME logical array (params, optimizer
+    state, replicated scalars, restored checkpoints); only the
+    addressable shards are materialized. Single-process shardings take
+    the plain ``device_put`` fast path."""
+    import jax
+    if not spans_processes(sharding):
+        return jax.device_put(a, sharding)
+    import numpy as onp
+    a = onp.asarray(a)
+    return jax.make_array_from_callback(a.shape, sharding,
+                                        lambda idx: a[idx])
+
+
+def put_local_shard(a, sharding):
+    """Assemble a global array from this process's LOCAL shard of it —
+    the per-host data feed. ``a`` holds only the rows
+    :func:`host_shard` assigned to this process; the result is the
+    global array the compiled step consumes. Single-process shardings
+    treat ``a`` as the full array (device_put)."""
+    import jax
+    if not spans_processes(sharding):
+        return jax.device_put(a, sharding)
+    import numpy as onp
+    return jax.make_array_from_process_local_data(sharding,
+                                                  onp.asarray(a))
+
+
+def fetch_replicated(arr):
+    """Host numpy view of a fully-replicated global array (loss
+    scalars, gathered state). Raises TypeError for arrays that are
+    neither fully replicated nor fully addressable — gather those
+    inside a program first (ParallelTrainer does)."""
+    import numpy as onp
+    return onp.asarray(arr)

@@ -261,7 +261,9 @@ class KVStore:
         from . import observability as _obs
         if _obs.enabled():
             _obs.kv_instruments().rejoins.inc()
+            _obs.dist_instruments().rejoins.inc()
             _obs.record_event('kv_rejoin', kv_type=self._type)
+            _obs.record_event('dist_rejoin', kv_type=self._type)
         return self
 
     # -- optimizer hosting -------------------------------------------------

@@ -36,8 +36,9 @@ from .client import LoadClient, RequestRecord
 from .report import build_artifact, summarize
 from .schedule import build_schedule
 
-__all__ = ['ServingRig', 'Dispatcher', 'run_capacity', 'run_overload',
-           'run_chaos', 'run_prefix', 'DEFAULT_MIX', 'OVERLOAD_MIX']
+__all__ = ['ServingRig', 'GatewayRig', 'Dispatcher', 'run_capacity',
+           'run_overload', 'run_chaos', 'run_prefix', 'DEFAULT_MIX',
+           'OVERLOAD_MIX']
 
 # chaos soak: mostly-cheap traffic keeps the soak itself off the
 # host's critical path while faults fire
@@ -235,6 +236,82 @@ class ServingRig:
         for sess in (self.predict_session, self.decode_session):
             if sess is not None:
                 sess.close(drain=False)
+
+
+class GatewayRig:
+    """Multi-replica system under test: N independent :class:`ServingRig`
+    replicas fronted by one :class:`~mxnet_tpu.serving.ServingGateway`
+    (docs/DISTRIBUTED.md "Gateway").
+
+    Mirrors the ServingRig driving interface (``port`` — the
+    GATEWAY's, ``healthy(payload)``, ``server_stats()``, ``close()``)
+    so every loadgen mode (:func:`run_capacity`, :func:`run_overload`,
+    ...) drives a multi-replica deployment unchanged.
+    :meth:`kill_replica` takes one replica down mid-run — the
+    host-loss drill the ``dist`` CI stage gates: the gateway must keep
+    serving (degraded) on the survivors.
+    """
+
+    def __init__(self, replicas=2, health_period_s=0.25, **rig_kwargs):
+        from ..serving.gateway import ServingGateway
+        if int(replicas) < 1:
+            raise ValueError('GatewayRig needs >= 1 replica')
+        self.replicas = [ServingRig(**rig_kwargs)
+                         for _ in range(int(replicas))]
+        self.gateway = ServingGateway(
+            ['http://127.0.0.1:%d' % r.port for r in self.replicas],
+            port=0, health_period_s=health_period_s).start()
+        self.port = self.gateway.port
+        self.max_new_tokens = self.replicas[0].max_new_tokens
+        self.slots = self.replicas[0].slots
+        self._killed = set()
+
+    @property
+    def predict_session(self):
+        return self.replicas[0].predict_session
+
+    @property
+    def decode_session(self):
+        return self.replicas[0].decode_session
+
+    def kill_replica(self, index):
+        """Stop one replica's HTTP server (the whole-host-down drill);
+        its sessions close undrained, exactly like a lost host."""
+        rep = self.replicas[index]
+        if index not in self._killed:
+            self._killed.add(index)
+            rep.close()
+        return rep
+
+    def healthy(self, payload):
+        """Gateway /status: healthy when every LIVE replica reports
+        ok (killed replicas are expected casualties)."""
+        if payload is None:
+            return False
+        expected = len(self.replicas) - len(self._killed)
+        if payload.get('healthy', 0) < expected:
+            return False
+        statuses = payload.get('replicas', {})
+        live_urls = {'http://127.0.0.1:%d' % r.port
+                     for i, r in enumerate(self.replicas)
+                     if i not in self._killed}
+        for url, st in statuses.items():
+            if url in live_urls and not self.replicas[0].healthy(st):
+                return False
+        return True
+
+    def server_stats(self):
+        out = {'gateway': self.gateway.stats()}
+        for i, rep in enumerate(self.replicas):
+            out['replica_%d' % i] = {'killed': True} \
+                if i in self._killed else rep.server_stats()
+        return out
+
+    def close(self):
+        self.gateway.stop()
+        for i, rep in enumerate(self.replicas):
+            if i not in self._killed:
+                rep.close()
 
 
 class Dispatcher:

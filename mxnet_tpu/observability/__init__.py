@@ -62,7 +62,7 @@ __all__ = [
     'write_prometheus', 'write_jsonl', 'tensorboard_export',
     'PrometheusServer', 'maybe_start_http_server', 'parse_prometheus',
     'trainer_instruments', 'kv_instruments', 'dispatch_instruments',
-    'serving_instruments', 'summary',
+    'serving_instruments', 'dist_instruments', 'summary',
 ]
 
 
@@ -78,6 +78,7 @@ _trainer_inst = None
 _kv_inst = None
 _dispatch_inst = None
 _serving_inst = None
+_dist_inst = None
 
 
 def trainer_instruments():
@@ -256,6 +257,33 @@ def serving_instruments():
                      'proposed)'),
         )
     return _serving_inst
+
+
+def dist_instruments():
+    """Multi-host runtime instruments (mxnet_tpu.dist,
+    docs/DISTRIBUTED.md): barrier wait time plus the membership
+    transitions (joins / rejoins / hosts lost) a pod post-mortem keys
+    on. Every snapshot additionally carries the synthetic
+    ``mxnet_tpu_process`` gauge stamping process_id/process_count."""
+    global _dist_inst
+    if _dist_inst is None:
+        _dist_inst = _Instruments(
+            barrier_seconds=histogram(
+                'mxnet_tpu_dist_barrier_seconds',
+                help='wall seconds blocked in dist.Coordinator named '
+                     'barriers (successful waits only; timeouts '
+                     'surface as host_lost events)'),
+            joins=counter('mxnet_tpu_dist_joins_total',
+                          help='multi-process runtime joins by this '
+                               'process'),
+            rejoins=counter('mxnet_tpu_dist_rejoins_total',
+                            help='worker rejoin handshakes after a '
+                                 'restart'),
+            host_lost=counter('mxnet_tpu_dist_host_lost_total',
+                              help='peer-loss detections (barrier '
+                                   'timeout or stale heartbeat)'),
+        )
+    return _dist_inst
 
 
 def summary():

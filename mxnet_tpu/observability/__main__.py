@@ -83,7 +83,12 @@ def check_registry():
     except ValueError:
         pass
     snap = reg.snapshot()
-    assert set(snap) == {'c_total', 'g', 'h_seconds'}
+    # every snapshot carries the synthetic process-identity stamp
+    # (docs/DISTRIBUTED.md) alongside the declared families
+    assert set(snap) == {'c_total', 'g', 'h_seconds',
+                         'mxnet_tpu_process'}
+    stamp = snap['mxnet_tpu_process']['series'][0]['labels']
+    assert set(stamp) == {'process_id', 'process_count'}
     assert snap['h_seconds']['series'][0]['buckets'][-1] == 3
     return None
 

@@ -300,8 +300,16 @@ class MetricsRegistry:
 
     def snapshot(self):
         """Plain-data dump of every series: the bench/status-JSON and
-        JSONL exporters serialize this directly."""
-        out = {}
+        JSONL exporters serialize this directly.
+
+        Every snapshot is stamped with a synthetic
+        ``mxnet_tpu_process`` gauge (labels ``process_id`` /
+        ``process_count``, value 1) so multi-host artifacts merge
+        without guessing which rank wrote them. The stamp is shaped
+        exactly like a real family, so exporters need no special
+        casing — it renders as
+        ``mxnet_tpu_process{process_id="0",process_count="2"} 1``."""
+        out = {'mxnet_tpu_process': _process_family()}
         for fam in self.families():
             series = []
             for values, child in fam.series():
@@ -337,6 +345,35 @@ class MetricsRegistry:
                 else:
                     with child._lock:
                         child._value = 0.0
+
+
+_proc_info_cache = None
+
+
+def _process_info():
+    """(process_id, process_count) without touching a jax backend —
+    _dist_init caches the values at join time and falls back to the
+    launcher env, so this stays importable from crash paths. Cached
+    after the first read (identity cannot change post-import), so the
+    per-event flight-recorder stamp costs one module-global load."""
+    global _proc_info_cache
+    if _proc_info_cache is None:
+        try:
+            from .. import _dist_init
+            _proc_info_cache = _dist_init.process_info()
+        except Exception:
+            _proc_info_cache = (0, 1)
+    return _proc_info_cache
+
+
+def _process_family():
+    pid, count = _process_info()
+    return {'type': 'gauge',
+            'help': 'process identity stamp (process_id/process_count '
+                    'labels; docs/DISTRIBUTED.md)',
+            'series': [{'labels': {'process_id': str(pid),
+                                   'process_count': str(count)},
+                        'value': 1.0}]}
 
 
 _default_registry = MetricsRegistry()

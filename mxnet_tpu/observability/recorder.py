@@ -61,6 +61,21 @@ def _knob(name, default):
         return default
 
 
+# one cached (process_id, process_count) reader, shared with the
+# metrics snapshot stamp — jax-free, so crash-path dumps can use it
+from .metrics import _process_info
+
+
+def _rank_suffixed(path, process_id, process_count):
+    """FLIGHT.jsonl → FLIGHT.r1.jsonl when more than one process can
+    dump: concurrent ranks must never clobber one artifact file.
+    Single-process paths stay byte-identical to the pre-dist layout."""
+    if process_count <= 1:
+        return path
+    root, ext = os.path.splitext(path)
+    return '%s.r%d%s' % (root, process_id, ext)
+
+
 class FlightRecorder:
     """Bounded ring of structured events with atomic JSONL dumps."""
 
@@ -95,10 +110,13 @@ class FlightRecorder:
     # -- recording ---------------------------------------------------------
 
     def record(self, kind, **fields):
-        """Append one event; drops the oldest when the ring is full."""
+        """Append one event; drops the oldest when the ring is full.
+        Every event is stamped with the writing ``process_id`` so
+        merged multi-host rings stay attributable."""
         if not self.enabled:
             return
-        ev = {'ts': round(self._clock(), 6), 'kind': kind}
+        ev = {'ts': round(self._clock(), 6), 'kind': kind,
+              'process_id': _process_info()[0]}
         ev.update(fields)
         with self._lock:
             self._ring.append(ev)
@@ -135,6 +153,8 @@ class FlightRecorder:
             return None
         path = path or self.path or \
             str(_knob('MXNET_TPU_FLIGHT_PATH', 'FLIGHT.jsonl'))
+        proc_id, proc_count = _process_info()
+        path = _rank_suffixed(path, proc_id, proc_count)
         with self._lock:
             events = list(self._ring)
             recorded = self._recorded
@@ -143,6 +163,8 @@ class FlightRecorder:
             'name': self.name,
             'reason': reason,
             'pid': os.getpid(),
+            'process_id': proc_id,
+            'process_count': proc_count,
             'dumped_at': round(self._clock(), 6),
             'capacity': self.capacity,
             'recorded': recorded,

@@ -92,6 +92,13 @@ def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+# per-process construction counter: trainers are built in the same
+# order on every process of a pod, so the id doubles as the broadcast
+# namespace for this trainer's RNG base key
+import itertools as _itertools
+_trainer_ids = _itertools.count()
+
+
 def _resolve_guardrail(guardrail):
     """None → env knob; True/config → fresh Guardrail; instance → it."""
     from ..guardrail import Guardrail, GuardrailConfig
@@ -193,6 +200,14 @@ class ParallelTrainer:
                 # so the fp16 policy turns it on by default
                 self._guard = _resolve_guardrail(True)
         self._gstate = None
+        # cross-host runtime (docs/DISTRIBUTED.md): resolved at build —
+        # a mesh spanning processes switches every placement below to
+        # the dist.topology helpers and checkpoint writes to the
+        # rank-0-behind-a-barrier protocol
+        self._multiproc = False
+        self._coord = None
+        self._gather_cache = {}
+        self._dist_name = 'pt%d' % next(_trainer_ids)
         self._preempt = None
         self._watchdog = None
         self._ckpt_mgr = None
@@ -329,8 +344,25 @@ class ParallelTrainer:
         state['zero'] = bool(self._zero)
         state['amp'] = self.amp
         state['rng'] = _random.get_state()
+        state['process_count'] = 1
         if extra:
             state.update(extra)
+        if self._multiproc:
+            # pod protocol (docs/DISTRIBUTED.md): every host gathers
+            # its logical state (the snapshot above ran the all-gather
+            # collectively — all ranks MUST reach this point), then
+            # rank 0 alone writes, then a closing barrier holds peers
+            # until the artifact is durable so no survivor resumes
+            # from a half-written file
+            coord = self._coordinator()
+            state['process_count'] = coord.process_count
+            coord.barrier(self._dist_name + '/ckpt_pre')
+            path = None
+            if coord.process_id == 0:
+                with _obs.span('checkpoint'):
+                    path = manager.save(self.num_update, state)
+            coord.barrier(self._dist_name + '/ckpt_post')
+            return path
         # CheckpointManager.save itself counts the write + flight
         # event; the span attributes the wall time to this driver
         with _obs.span('checkpoint'):
@@ -407,6 +439,62 @@ class ParallelTrainer:
         self.restore(state)
         return step, plan
 
+    # -- cross-host placement (docs/DISTRIBUTED.md) ------------------------
+
+    def _put_full(self, a, sharding):
+        """Place a LOGICAL (full) host array — params, optimizer
+        state, guardrail scalars, restored checkpoints — under a
+        sharding of a possibly multi-process mesh."""
+        if not self._multiproc:
+            return jax.device_put(a, sharding)
+        from ..dist import topology as _topo
+        return _topo.put_global(a, sharding)
+
+    def _put_data(self, a, sharding):
+        """Place one step operand. Single-process: the full batch via
+        device_put. Multi-process: ``a`` is this host's LOCAL shard of
+        the global batch (dist.topology.host_shard names the rows) and
+        the global array is assembled from the process-local shards."""
+        if not self._multiproc:
+            return jax.device_put(a, sharding)
+        from ..dist import topology as _topo
+        return _topo.put_local_shard(a, sharding)
+
+    def _to_logical(self, arrays):
+        """Host numpy copies of step state for snapshot/checkpoint.
+        Replicated arrays fetch directly; dp-sharded ZeRO leaves on a
+        multi-process mesh are first gathered to the replicated layout
+        inside ONE jitted identity program (an all-gather over DCN) —
+        no per-array host loops over non-addressable shards."""
+        need_gather = [a for a in arrays
+                       if self._multiproc and
+                       not a.sharding.is_fully_replicated]
+        if not need_gather:
+            return [onp.asarray(a) for a in arrays]
+        repl = NamedSharding(self._mesh, P())
+        # per-trainer cached gather program (keyed on the leaf layout)
+        # so a checkpoint cadence never recompiles it
+        key = tuple((a.shape, a.dtype.name, a.sharding)
+                    for a in need_gather)
+        fn = self._gather_cache.get(key)
+        if fn is None:
+            fn = jax.jit(lambda xs: xs,
+                         out_shardings=tuple(repl
+                                             for _ in need_gather))
+            self._gather_cache[key] = fn
+        gathered = fn(tuple(need_gather))
+        it = iter(gathered)
+        return [onp.asarray(next(it))
+                if (self._multiproc and
+                    not a.sharding.is_fully_replicated)
+                else onp.asarray(a) for a in arrays]
+
+    def _coordinator(self):
+        if self._coord is None:
+            from ..dist import get_coordinator
+            self._coord = get_coordinator()
+        return self._coord
+
     def _build(self, xs, ys):
         from ..gluon.block import ensure_initialized
         from ..optimizer.fused import (_HyperPatch, _flatten_state,
@@ -414,6 +502,8 @@ class ParallelTrainer:
         ensure_initialized(self._net, *[NDArray(a) if a is not None else None
                                         for a in xs])
         mesh = self._mesh
+        from ..dist import topology as _topo
+        self._multiproc = _topo.spans_processes(mesh)
         fwd, meta, params = pure_forward_fn(self._net, training=True)
         self._params = params
         opt = self._opt
@@ -677,14 +767,14 @@ class ParallelTrainer:
                 donate_argnums=(3, 4))
             self._step_fn = guarded_step
             self._gstate = (
-                jax.device_put(onp.float32(self._guard.config.init_scale),
+                self._put_full(onp.float32(self._guard.config.init_scale),
                                repl),
-                jax.device_put(onp.int32(0), repl))
+                self._put_full(onp.int32(0), repl))
         self._param_arrays = tuple(
-            jax.device_put(w, sh) for w, sh in zip(param_arrays,
+            self._put_full(w, sh) for w, sh in zip(param_arrays,
                                                    param_shardings))
         self._state_leaves = tuple(
-            jax.device_put(a, sh) for a, sh in zip(leaf_arrays,
+            self._put_full(a, sh) for a, sh in zip(leaf_arrays,
                                                    leaf_shardings))
         self._data_shardings = (data_shardings, label_shardings)
         self._abstract_io = (
@@ -707,9 +797,7 @@ class ParallelTrainer:
         operands/outputs."""
         step = self._step_fn
         repl, param_sh, leaf_sh, data_sh, label_sh = self._shardings
-
-        def lead(sh):
-            return NamedSharding(sh.mesh, P(None, *sh.spec))
+        lead_data, lead_label = self._lead_shardings()
 
         if self._guard is None:
             def multi(keys, hypers, param_arrays, state_leaves, xs, ys):
@@ -726,8 +814,7 @@ class ParallelTrainer:
             return jax.jit(
                 multi,
                 in_shardings=(repl, (repl, repl, repl, repl), param_sh,
-                              leaf_sh, tuple(lead(s) for s in data_sh),
-                              tuple(lead(s) for s in label_sh)),
+                              leaf_sh, lead_data, lead_label),
                 out_shardings=(param_sh, leaf_sh, repl),
                 donate_argnums=(2, 3))
 
@@ -748,8 +835,7 @@ class ParallelTrainer:
             multi_g,
             in_shardings=(repl, (repl, repl, repl, repl), repl,
                           (repl, repl), param_sh, leaf_sh,
-                          tuple(lead(s) for s in data_sh),
-                          tuple(lead(s) for s in label_sh)),
+                          lead_data, lead_label),
             out_shardings=(param_sh, leaf_sh, (repl, repl), repl, repl,
                            repl),
             donate_argnums=(4, 5))
@@ -764,9 +850,7 @@ class ParallelTrainer:
         factor, not a schedule length."""
         loss_of, run_update = self._loss_of, self._run_update
         repl, param_sh, leaf_sh, data_sh, label_sh = self._shardings
-
-        def lead(sh):
-            return NamedSharding(sh.mesh, P(None, *sh.spec))
+        lead_data, lead_label = self._lead_shardings()
 
         def accum_step(key, hyper, param_arrays, state_leaves, xs, ys):
             lrs, wds, ts, rescale = hyper
@@ -798,8 +882,7 @@ class ParallelTrainer:
         return jax.jit(
             accum_step,
             in_shardings=(repl, (repl, repl, repl, repl), param_sh,
-                          leaf_sh, tuple(lead(s) for s in data_sh),
-                          tuple(lead(s) for s in label_sh)),
+                          leaf_sh, lead_data, lead_label),
             out_shardings=(param_sh, leaf_sh, repl),
             donate_argnums=(2, 3))
 
@@ -850,14 +933,17 @@ class ParallelTrainer:
         opt = self._opt
         indices = list(range(len(self._params)))
         hyper = self._hyper(indices, opt, advance=True)
-        if self._base_key is None:
-            self._base_key = onp.asarray(_random.next_key(),
-                                         dtype=onp.uint32)
         key = onp.asarray(
-            [self._base_key[0],
+            [self._next_base_key()[0],
              self._base_key[1] ^ onp.uint32(self.num_update + 1)],
             dtype=onp.uint32)
         live = tuple(a for a in xs_s if a is not None)
+        if self._multiproc:
+            lead = self._lead_shardings()
+            live = tuple(self._put_data(a, sh)
+                         for a, sh in zip(live, lead[0]))
+            ys_s = [self._put_data(a, sh)
+                    for a, sh in zip(ys_s, lead[1])]
         self._param_arrays, self._state_leaves, loss = \
             self._jitted_accum[accum](key, hyper, self._param_arrays,
                                       self._state_leaves, live,
@@ -907,6 +993,18 @@ class ParallelTrainer:
             xs, ys = self._normalize(x, y)
             live = [a for a in xs if a is not None]
             data_sh, label_sh = shardings
+            if self._multiproc:
+                # multi-process staging goes through the local-shard
+                # assembly path (the batch fed here is this host's
+                # slice, same as step()'s contract)
+                xd = iter(self._put_data(a, sh)
+                          for a, sh in zip(live, data_sh))
+                staged_x = [None if a is None else NDArray(next(xd))
+                            for a in xs]
+                staged_y = [NDArray(self._put_data(a, sh))
+                            for a, sh in zip(ys, label_sh)]
+                return (staged_x if len(staged_x) > 1 else staged_x[0],
+                        staged_y if len(staged_y) > 1 else staged_y[0])
             xd = iter(jax.device_put(a, sh)
                       for a, sh in zip(live, data_sh))
             staged_x = [None if a is None else NDArray(next(xd))
@@ -969,9 +1067,7 @@ class ParallelTrainer:
             hypers.append(self._hyper(indices, opt, advance=True))
         stacked = tuple(onp.stack([h[k] for h in hypers])
                         for k in range(4))
-        if self._base_key is None:
-            self._base_key = onp.asarray(_random.next_key(),
-                                         dtype=onp.uint32)
+        self._next_base_key()
         keys = onp.stack([
             onp.asarray([self._base_key[0],
                          self._base_key[1] ^
@@ -980,6 +1076,10 @@ class ParallelTrainer:
         if self._jitted_multi is None:
             self._jitted_multi = self._build_multi()
         jitted = self._jitted_multi
+        if self._multiproc:
+            lead = self._lead_shardings()
+            xs = [self._put_data(a, sh) for a, sh in zip(xs, lead[0])]
+            ys = [self._put_data(a, sh) for a, sh in zip(ys, lead[1])]
         start = self.num_update
         if self._guard is None:
             self._param_arrays, self._state_leaves, losses = jitted(
@@ -1013,6 +1113,31 @@ class ParallelTrainer:
                                    scale=float(s_host[i]))
         self._boundary_post()
         return NDArray(losses)
+
+    def _lead_shardings(self):
+        """Leading-dim-stacked data/label shardings (the step_n /
+        step_accum operand layouts): P(None, *spec)."""
+        data_sh, label_sh = self._data_shardings
+
+        def lead(sh):
+            return NamedSharding(sh.mesh, P(None, *sh.spec))
+
+        return (tuple(lead(s) for s in data_sh),
+                tuple(lead(s) for s in label_sh))
+
+    def _next_base_key(self):
+        """The per-trainer RNG base key, drawn once from the global
+        chain. On a multi-process mesh process 0's draw is broadcast
+        so dropout masks (and the guardrail's poison schedule keys)
+        agree across hosts even when per-host RNG chains drifted."""
+        if self._base_key is None:
+            base = onp.asarray(_random.next_key(), dtype=onp.uint32)
+            if self._multiproc:
+                base = onp.asarray(self._coordinator().broadcast(
+                    self._dist_name + '/base_key',
+                    [int(base[0]), int(base[1])]), dtype=onp.uint32)
+            self._base_key = base
+        return self._base_key
 
     def _hyper(self, indices, opt, advance=True):
         """(lrs, wds, ts, rescale) scalar arrays for this step.
@@ -1064,17 +1189,22 @@ class ParallelTrainer:
         # per-step key built on the host (base drawn once from the global
         # chain): [base, base ^ step] is a fresh threefry key per step
         # without an eager random.split dispatch on the device
-        if self._base_key is None:
-            self._base_key = onp.asarray(_random.next_key(),
-                                         dtype=onp.uint32)
         key = onp.asarray(
-            [self._base_key[0],
+            [self._next_base_key()[0],
              self._base_key[1] ^ onp.uint32(self.num_update + 1)],
             dtype=onp.uint32)
-        xd = tuple(jax.device_put(a, sh)
+        xd = tuple(self._put_data(a, sh)
                    for a, sh in zip(xs, self._data_shardings[0]))
-        yd = tuple(jax.device_put(a, sh)
+        yd = tuple(self._put_data(a, sh)
                    for a, sh in zip(ys, self._data_shardings[1]))
+        if self._multiproc and first:
+            # the program's operand shapes are GLOBAL; _build only saw
+            # this host's local shard — re-record for compiled_step()
+            self._abstract_io = (
+                tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in xd),
+                tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in yd))
         from .. import profiler as _profiler
         loss = None
         health = None
@@ -1147,8 +1277,11 @@ class ParallelTrainer:
                                'call build(x, y) (or one step) first')
         state = {
             'num_update': self.num_update,
-            'params': [onp.asarray(w) for w in self._param_arrays],
-            'leaves': [onp.asarray(a) for a in self._state_leaves],
+            # _to_logical: replicated arrays fetch directly; on a
+            # multi-process mesh dp-sharded ZeRO leaves are gathered
+            # to the replicated layout in one jitted program first
+            'params': self._to_logical(self._param_arrays),
+            'leaves': self._to_logical(self._state_leaves),
             'base_key': None if self._base_key is None
             else onp.asarray(self._base_key),
             'update_counts': dict(self._opt._index_update_count),
@@ -1165,10 +1298,10 @@ class ParallelTrainer:
             raise RuntimeError('restore() on an un-built trainer')
         repl, param_sh, leaf_sh = self._shardings[:3]
         self._param_arrays = tuple(
-            jax.device_put(w, sh)
+            self._put_full(w, sh)
             for w, sh in zip(state['params'], param_sh))
         self._state_leaves = tuple(
-            jax.device_put(a, sh)
+            self._put_full(a, sh)
             for a, sh in zip(state['leaves'], leaf_sh))
         self.num_update = int(state['num_update'])
         self._base_key = None if state.get('base_key') is None \
@@ -1179,8 +1312,8 @@ class ParallelTrainer:
             self._opt.num_update = state.get('opt_num_update', 0)
         if self._gstate is not None and 'scale' in state:
             self._gstate = (
-                jax.device_put(onp.float32(state['scale']), repl),
-                jax.device_put(onp.int32(state['good']), repl))
+                self._put_full(onp.float32(state['scale']), repl),
+                self._put_full(onp.int32(state['good']), repl))
         for p, w in zip(self._params, self._param_arrays):
             p.data()._data = w
 

@@ -51,7 +51,7 @@ from .artifact import (SCHEMA, write_artifact, artifact_record,
 from .preempt import Preempted, PreemptionHandler, resumable_exit_code
 from .watchdog import STALL_SCHEMA, Watchdog, stall_record
 from .elastic import (MeshShrinkError, ElasticPlan, shrink_plan,
-                      available_devices, mesh_meta)
+                      host_loss_plan, available_devices, mesh_meta)
 
 __all__ = [
     'Retry', 'Timeout', 'Deadline', 'CircuitBreaker', 'FaultInjector',
@@ -65,6 +65,6 @@ __all__ = [
     'SCHEMA', 'write_artifact', 'artifact_record', 'run_instrument',
     'Preempted', 'PreemptionHandler', 'resumable_exit_code',
     'STALL_SCHEMA', 'Watchdog', 'stall_record',
-    'MeshShrinkError', 'ElasticPlan', 'shrink_plan',
+    'MeshShrinkError', 'ElasticPlan', 'shrink_plan', 'host_loss_plan',
     'available_devices', 'mesh_meta',
 ]

@@ -51,7 +51,7 @@ import logging
 from .policy import DeviceLossError, ResilienceError, inject
 
 __all__ = ['MeshShrinkError', 'ElasticPlan', 'shrink_plan',
-           'available_devices', 'mesh_meta']
+           'host_loss_plan', 'available_devices', 'mesh_meta']
 
 
 class MeshShrinkError(ResilienceError):
@@ -61,9 +61,14 @@ class MeshShrinkError(ResilienceError):
 
 def mesh_meta(mesh):
     """JSON-serializable description of a mesh, stored inside
-    checkpoints so restart can detect a device-count change."""
+    checkpoints so restart can detect a device- (or host-) count
+    change. ``process_count`` > 1 marks a cross-host mesh
+    (docs/DISTRIBUTED.md); restoring its checkpoint on a different
+    process count is a pure re-placement (logical arrays)."""
+    procs = {d.process_index for d in mesh.devices.flat}
     return {'axes': {k: int(v) for k, v in dict(mesh.shape).items()},
-            'device_count': int(mesh.size)}
+            'device_count': int(mesh.size),
+            'process_count': len(procs)}
 
 
 def available_devices(injector=None, platform=None):
@@ -164,4 +169,53 @@ def shrink_plan(ckpt_mesh, n_devices, global_batch=None):
         note='dp %d->%d; global batch preserved via %d-step gradient '
              'accumulation' % (old_dp, new_dp, accum))
     logging.warning('elastic: %s (%s)', plan, plan.note)
+    return plan
+
+
+def host_loss_plan(ckpt_mesh, surviving_processes, devices_per_host=None):
+    """Whole-host loss: map a cross-host checkpoint mesh onto the
+    hosts that survive (docs/DISTRIBUTED.md "Elastic host loss").
+
+    A lost host removes ALL of its devices at once, so the shrink is
+    host-granular: ``surviving_processes`` hosts, each contributing
+    ``devices_per_host`` devices (default: the checkpoint's
+    device_count / process_count). The dp axis absorbs the loss
+    exactly as :func:`shrink_plan` does — survivors re-form the mesh
+    at the next checkpoint boundary and gradient-accumulate the lost
+    hosts' microbatches, preserving the global batch. Raises
+    :class:`MeshShrinkError` when the surviving hosts cannot carry the
+    model-parallel axes.
+
+    The returned plan's ``note`` names the host arithmetic, and a
+    ``host_lost`` story is what the flight recorder pairs this with
+    (the dist.Coordinator records the detection; this records the
+    decision)."""
+    if hasattr(ckpt_mesh, 'shape'):
+        ckpt_mesh = mesh_meta(ckpt_mesh)
+    old_procs = int(ckpt_mesh.get('process_count') or 1)
+    old_total = int(ckpt_mesh.get('device_count') or 1)
+    surviving = int(surviving_processes)
+    if surviving < 1:
+        raise MeshShrinkError('no surviving hosts to re-form the mesh '
+                              'on (surviving_processes=%d)' % surviving)
+    if devices_per_host is None:
+        if old_total % max(1, old_procs):
+            raise MeshShrinkError(
+                'checkpoint mesh has %d devices over %d hosts (not '
+                'uniform) — pass devices_per_host explicitly'
+                % (old_total, old_procs))
+        devices_per_host = old_total // max(1, old_procs)
+    n_devices = surviving * int(devices_per_host)
+    plan = shrink_plan(ckpt_mesh, n_devices)
+    plan.note = ('host loss: %d -> %d host(s) x %d device(s); %s'
+                 % (old_procs, surviving, devices_per_host, plan.note))
+    try:
+        from .. import observability as _obs
+        if _obs.enabled():
+            _obs.record_event('host_lost', where='elastic',
+                              old_hosts=old_procs,
+                              surviving_hosts=surviving,
+                              accum_steps=plan.accum_steps)
+    except Exception:
+        pass
     return plan

@@ -54,7 +54,7 @@ __all__ = [
     'load_frozen', 'InferenceSession', 'ServingHTTPServer',
     'maybe_start_http_server', 'decode', 'DecodeProgram',
     'PagedDecodeProgram', 'DecodeEngine', 'GenerateStream',
-    'freeze_decode', 'load_decode',
+    'freeze_decode', 'load_decode', 'gateway', 'ServingGateway',
 ]
 
 # No serving module imports jax at module top (device work happens
@@ -70,5 +70,7 @@ from .decode import (DecodeEngine, DecodeProgram, GenerateStream,
                      PagedDecodeProgram, freeze_decode, load_decode)
 from .server import (InferenceSession, ServingHTTPServer,
                      maybe_start_http_server)
+from . import gateway
+from .gateway import ServingGateway
 from .freeze import FROZEN_SCHEMA, FrozenProgram, load_frozen
 from .freeze import freeze

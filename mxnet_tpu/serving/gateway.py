@@ -1,0 +1,430 @@
+"""ServingGateway: health-aware routing over per-host serving replicas.
+
+The multi-host serving story (docs/DISTRIBUTED.md "Gateway"): each
+host runs its own :class:`~.server.ServingHTTPServer` over its own
+``InferenceSession``; the gateway fronts them all behind ONE address
+and owns exactly three concerns —
+
+  * **health-aware routing** — a background probe polls every
+    replica's ``/healthz`` each ``MXNET_TPU_GATEWAY_HEALTH_S``
+    seconds; a replica answering non-200 (breaker open, degraded
+    engine) or not answering at all leaves the rotation until its
+    probe recovers. Requests round-robin over the healthy set; an
+    in-flight connection error fails over to the next healthy replica
+    (idempotent one-shot ``/predict`` always; ``/generate`` only
+    before the first upstream byte) and marks the replica down
+    immediately, without waiting for the next probe tick.
+  * **typed degradation** — with SOME replicas down the gateway keeps
+    serving and ``/healthz`` reports ``degraded`` (200: load balancers
+    upstream of the gateway should keep it in service); with ALL
+    replicas down it sheds typed 503s carrying a ``Retry-After`` of
+    one health-probe period, so the loadgen SLO harness records an
+    availability dip instead of a hang.
+  * **backpressure passthrough** — a replica's 429 (and its
+    ``Retry-After`` estimate, docs/SERVING.md) passes through
+    verbatim: admission control stays where the queue knowledge lives;
+    the gateway never retries a 429 against another replica on its own
+    (the client owns backoff).
+
+Streaming ``/generate`` responses (chunked NDJSON) forward line by
+line, so TTFT through the gateway tracks the replica's, not the full
+generation. Stdlib-only, binds 127.0.0.1 by default — the same
+opt-in posture as every other endpoint in the repo.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ['ReplicaState', 'ServingGateway']
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding',
+                'te', 'trailer', 'upgrade', 'proxy-authorization',
+                'proxy-authenticate', 'host', 'content-length'}
+
+
+def _knob(name, default):
+    try:
+        from .. import config as _config
+        v = _config.get(name)
+        return default if v is None else v
+    except Exception:
+        return default
+
+
+class ReplicaState:
+    """One upstream replica: base URL + live health view."""
+
+    __slots__ = ('base_url', 'healthy', 'last_error', 'last_checked',
+                 'transitions')
+
+    def __init__(self, base_url):
+        self.base_url = base_url.rstrip('/')
+        self.healthy = True          # optimistic until the first probe
+        self.last_error = None
+        self.last_checked = 0.0
+        self.transitions = 0
+
+    def mark(self, healthy, error=None):
+        if healthy != self.healthy:
+            self.transitions += 1
+        self.healthy = healthy
+        self.last_error = error
+        self.last_checked = time.time()
+
+    def as_dict(self):
+        return {'url': self.base_url, 'healthy': self.healthy,
+                'error': self.last_error,
+                'transitions': self.transitions}
+
+
+class ServingGateway:
+    """Front N serving replicas behind one HTTP address.
+
+    ``replicas``: iterable of base URLs (``http://127.0.0.1:8471``).
+    ``port`` 0 picks a free port. ``health_period_s`` /
+    ``timeout_s`` default from the ``MXNET_TPU_GATEWAY_*`` knobs.
+
+    Routes::
+
+        GET  /healthz   200 {"ok": true, "status": "ok"|"degraded",
+                             "healthy": k, "replicas": n}
+                        503 when NO replica is healthy
+        GET  /status    aggregate: gateway view + every replica's
+                        /status payload (or its error)
+        GET  /replicas  the routing table with health + transitions
+        POST /predict   forwarded to the next healthy replica
+        POST /generate  forwarded; chunked NDJSON streams line-by-line
+    """
+
+    def __init__(self, replicas, port=None, host='127.0.0.1',
+                 health_period_s=None, timeout_s=None):
+        urls = list(replicas)
+        if not urls:
+            raise ValueError('gateway needs at least one replica URL')
+        self.replicas = [ReplicaState(u) for u in urls]
+        self.host = host
+        # explicit port wins; None resolves the knob (whose 0 default
+        # means "pick a free port", same as passing 0)
+        self.port = int(port if port is not None
+                        else _knob('MXNET_TPU_GATEWAY_PORT', 0))
+        self.health_period_s = float(
+            health_period_s if health_period_s is not None
+            else _knob('MXNET_TPU_GATEWAY_HEALTH_S', 1.0))
+        self.timeout_s = float(
+            timeout_s if timeout_s is not None
+            else _knob('MXNET_TPU_GATEWAY_TIMEOUT_S', 30.0))
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._httpd = None
+        self._thread = None
+        self._probe_thread = None
+        self._probe_stop = None
+        self._stats = {'requests': 0, 'failovers': 0, 'shed': 0,
+                       'passthrough_429': 0}
+        self._stats_lock = threading.Lock()
+
+    # -- health ------------------------------------------------------------
+
+    def probe_once(self):
+        """Probe every replica's /healthz once (also called by the
+        background loop); returns the number currently healthy."""
+        for rep in self.replicas:
+            try:
+                req = urllib.request.Request(rep.base_url + '/healthz')
+                with urllib.request.urlopen(
+                        req, timeout=min(self.timeout_s,
+                                         max(1.0,
+                                             self.health_period_s * 3))
+                ) as resp:
+                    ok = resp.status == 200
+                    rep.mark(ok, None if ok
+                             else 'healthz %d' % resp.status)
+            except urllib.error.HTTPError as exc:
+                rep.mark(False, 'healthz %d' % exc.code)
+            except Exception as exc:
+                rep.mark(False, '%s: %s' % (type(exc).__name__, exc))
+        healthy = sum(1 for r in self.replicas if r.healthy)
+        self._note_health(healthy)
+        return healthy
+
+    def _note_health(self, healthy):
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.gauge('mxnet_tpu_gateway_healthy_replicas',
+                           help='replicas currently in the gateway '
+                                'routing rotation').set(healthy)
+        except Exception:
+            pass
+
+    def healthy_replicas(self):
+        return [r for r in self.replicas if r.healthy]
+
+    def _pick(self, exclude=()):
+        """Next healthy replica round-robin, skipping ``exclude``."""
+        with self._rr_lock:
+            candidates = [r for r in self.replicas
+                          if r.healthy and r not in exclude]
+            if not candidates:
+                return None
+            rep = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            return rep
+
+    # -- forwarding --------------------------------------------------------
+
+    def _bump(self, key):
+        with self._stats_lock:
+            self._stats[key] += 1
+
+    def _forward(self, rep, path, body, content_type):
+        req = urllib.request.Request(
+            rep.base_url + path, data=body,
+            headers={'Content-Type': content_type or
+                     'application/json'},
+            method='POST')
+        return urllib.request.urlopen(req, timeout=self.timeout_s)
+
+    def _fetch_json(self, rep, path):
+        try:
+            with urllib.request.urlopen(
+                    rep.base_url + path, timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as exc:
+            try:
+                return json.loads(exc.read().decode())
+            except Exception:
+                return {'error': 'HTTP %d' % exc.code}
+        except Exception as exc:
+            return {'error': '%s: %s' % (type(exc).__name__, exc)}
+
+    # -- server ------------------------------------------------------------
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def _json(handler, code, payload, headers=None):
+                body = (json.dumps(payload, sort_keys=True)
+                        + '\n').encode()
+                handler.send_response(code)
+                handler.send_header('Content-Type', 'application/json')
+                handler.send_header('Content-Length', str(len(body)))
+                for k, v in (headers or {}).items():
+                    handler.send_header(k, v)
+                handler.end_headers()
+                handler.wfile.write(body)
+
+            def do_GET(handler):
+                path = handler.path.rstrip('/')
+                if path == '/healthz':
+                    healthy = len(gw.healthy_replicas())
+                    total = len(gw.replicas)
+                    if healthy == 0:
+                        handler._json(503, {
+                            'ok': False, 'status': 'unavailable',
+                            'healthy': 0, 'replicas': total})
+                    else:
+                        status = 'ok' if healthy == total \
+                            else 'degraded'
+                        handler._json(200, {
+                            'ok': True, 'status': status,
+                            'healthy': healthy, 'replicas': total})
+                elif path == '/replicas':
+                    handler._json(200, {
+                        'replicas': [r.as_dict()
+                                     for r in gw.replicas],
+                        'stats': dict(gw._stats)})
+                elif path == '/status':
+                    statuses = {}
+                    for rep in gw.replicas:
+                        statuses[rep.base_url] = \
+                            gw._fetch_json(rep, '/status') \
+                            if rep.healthy else \
+                            {'error': rep.last_error or 'unhealthy'}
+                    healthy = len(gw.healthy_replicas())
+                    handler._json(200, {
+                        'status': 'ok'
+                        if healthy == len(gw.replicas)
+                        else ('degraded' if healthy else
+                              'unavailable'),
+                        'healthy': healthy,
+                        'replicas': statuses,
+                        'stats': dict(gw._stats)})
+                else:
+                    handler.send_error(404)
+
+            def _relay_response(handler, resp, streaming):
+                """Copy an upstream response to the client; chunked
+                NDJSON forwards line-by-line so tokens stream."""
+                ct = resp.headers.get('Content-Type',
+                                      'application/json')
+                chunked = streaming and 'ndjson' in ct
+                handler.send_response(resp.status)
+                handler.send_header('Content-Type', ct)
+                passthrough = {k: v for k, v in resp.headers.items()
+                               if k.lower() == 'retry-after'}
+                if chunked:
+                    handler.send_header('Transfer-Encoding', 'chunked')
+                    for k, v in passthrough.items():
+                        handler.send_header(k, v)
+                    handler.end_headers()
+                    for line in resp:
+                        handler.wfile.write(b'%x\r\n' % len(line))
+                        handler.wfile.write(line + b'\r\n')
+                        handler.wfile.flush()
+                    handler.wfile.write(b'0\r\n\r\n')
+                    handler.wfile.flush()
+                else:
+                    body = resp.read()
+                    handler.send_header('Content-Length',
+                                        str(len(body)))
+                    for k, v in passthrough.items():
+                        handler.send_header(k, v)
+                    handler.end_headers()
+                    handler.wfile.write(body)
+
+            def do_POST(handler):
+                path = handler.path.rstrip('/')
+                if path not in ('/predict', '/generate'):
+                    handler.send_error(404)
+                    return
+                gw._bump('requests')
+                length = int(handler.headers.get('Content-Length',
+                                                 0) or 0)
+                body = handler.rfile.read(length) if length else b'{}'
+                ctype = handler.headers.get('Content-Type')
+                tried = []
+                while True:
+                    rep = gw._pick(exclude=tried)
+                    if rep is None:
+                        gw._bump('shed')
+                        hint = max(1, int(gw.health_period_s + 0.999))
+                        handler._json(
+                            503,
+                            {'error': 'no healthy serving replica '
+                                      '(%d configured, %d tried)'
+                                      % (len(gw.replicas),
+                                         len(tried)),
+                             'retry_after_s': hint},
+                            headers={'Retry-After': str(hint)})
+                        return
+                    tried.append(rep)
+                    try:
+                        resp = gw._forward(rep, path, body, ctype)
+                    except urllib.error.HTTPError as exc:
+                        # a typed upstream error (429/504/503/500/400)
+                        # passes through verbatim — incl. Retry-After,
+                        # so client backoff sees the replica's queue
+                        # estimate, not a gateway guess
+                        if exc.code == 429:
+                            gw._bump('passthrough_429')
+                        handler._relay_response(exc, streaming=False)
+                        return
+                    except Exception as exc:
+                        # transport-level failure: the replica is gone
+                        # — mark it down NOW and fail over (no bytes
+                        # were relayed yet, so a retry is safe)
+                        rep.mark(False, '%s: %s'
+                                 % (type(exc).__name__, exc))
+                        gw._bump('failovers')
+                        gw._note_health(
+                            len(gw.healthy_replicas()))
+                        continue
+                    import http.client as _hc
+                    try:
+                        with resp:
+                            handler._relay_response(
+                                resp, streaming=(path == '/generate'))
+                    except _hc.HTTPException as exc:
+                        # upstream died MID-stream (IncompleteRead on
+                        # a killed replica): mark it down now, cut the
+                        # client connection (the chunked stream cannot
+                        # be terminated cleanly) — no failover, bytes
+                        # already went out
+                        rep.mark(False, '%s: %s'
+                                 % (type(exc).__name__, exc))
+                        gw._note_health(len(gw.healthy_replicas()))
+                        handler.close_connection = True
+                        return
+                    except OSError:
+                        return       # client went away mid-stream
+                    return
+
+            def log_message(handler, *args):
+                pass
+
+        class _GatewayServer(ThreadingHTTPServer):
+            request_queue_size = 128
+            daemon_threads = True
+
+            def handle_error(server_self, request, client_address):
+                import sys as _sys
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (ConnectionError, TimeoutError)):
+                    return
+                ThreadingHTTPServer.handle_error(
+                    server_self, request, client_address)
+
+        self._httpd = _GatewayServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name='mxnet-tpu-gateway')
+        self._thread.start()
+        self.probe_once()
+        stop = threading.Event()
+
+        def probe_loop():
+            while not stop.wait(self.health_period_s):
+                try:
+                    self.probe_once()
+                except Exception:
+                    pass          # a probe bug must not kill routing
+
+        self._probe_stop = stop
+        self._probe_thread = threading.Thread(
+            target=probe_loop, daemon=True,
+            name='mxnet-tpu-gateway-health')
+        self._probe_thread.start()
+        return self
+
+    @property
+    def base_url(self):
+        return 'http://%s:%d' % (self.host, self.port)
+
+    def stats(self):
+        with self._stats_lock:
+            out = dict(self._stats)
+        out['healthy'] = len(self.healthy_replicas())
+        out['replicas'] = len(self.replicas)
+        return out
+
+    def stop(self):
+        if self._probe_stop is not None:
+            self._probe_stop.set()
+            self._probe_thread.join(timeout=5.0)
+            self._probe_thread = None
+            self._probe_stop = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5.0)
+            self._httpd = None
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -8,27 +8,22 @@ exports the reference's DMLC_* env contract (which kvstore.create
 ('dist_*') translates to jax.distributed.initialize), so reference
 training scripts launch unchanged.
 
+The spawning machinery lives in :mod:`mxnet_tpu.dist.launcher`
+(docs/DISTRIBUTED.md) — per-rank log capture, peer termination on
+failure, rc-75 resumable propagation; this module keeps the
+reference-shaped CLI and the stable ``launch_local`` API over it.
+
 Local mode spawns n worker processes on this host (the analog of
 `--launcher local`); for cluster schedulers (slurm/mpi/k8s) export the
-same variables per task instead of using this script.
+same variables per task instead of using this script
+(:func:`mxnet_tpu.dist.launcher.worker_env` builds the exact set).
 """
 from __future__ import annotations
 
 import argparse
-import os
-import socket
-import subprocess
 import sys
 
 __all__ = ['launch_local', 'main']
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(('', 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def launch_local(num_workers, command, env=None, coordinator_port=None,
@@ -38,45 +33,13 @@ def launch_local(num_workers, command, env=None, coordinator_port=None,
 
     If any worker fails (or `timeout` seconds elapse), the remaining
     workers are terminated — a dead coordinator would otherwise leave
-    its peers blocked in jax.distributed.initialize forever."""
-    import time
-    port = coordinator_port or _free_port()
-    procs = []
-    for wid in range(num_workers):
-        wenv = dict(os.environ, **(env or {}))
-        wenv.update({
-            'DMLC_ROLE': 'worker',
-            'DMLC_PS_ROOT_URI': '127.0.0.1',
-            'DMLC_PS_ROOT_PORT': str(port),
-            'DMLC_NUM_WORKER': str(num_workers),
-            'DMLC_NUM_SERVER': '0',
-            'DMLC_WORKER_ID': str(wid),
-        })
-        procs.append(subprocess.Popen(command, env=wenv))
-
-    deadline = time.time() + timeout if timeout else None
-    failed = False
-    while True:
-        states = [p.poll() for p in procs]
-        if all(s is not None for s in states):
-            break
-        if any(s not in (None, 0) for s in states) or \
-                (deadline and time.time() > deadline):
-            failed = True
-            break
-        time.sleep(0.2)
-    if failed:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-                p.wait()
-    return [p.returncode if p.returncode is not None else -15
-            for p in procs]
+    its peers blocked in jax.distributed.initialize forever. (Thin
+    compatibility wrapper over ``mxnet_tpu.dist.launcher.launch_local``,
+    which also offers per-rank logs and platform pinning.)"""
+    from ..dist.launcher import launch_local as impl
+    return impl(num_workers, command, env=env,
+                coordinator_port=coordinator_port,
+                timeout=timeout).returncodes
 
 
 def main(argv=None):
@@ -88,15 +51,25 @@ def main(argv=None):
     parser.add_argument('--launcher', choices=['local'], default='local',
                         help='only local spawning is built in; cluster '
                              'schedulers should export DMLC_* per task')
+    parser.add_argument('--log-dir', default=None,
+                        help='capture each rank\'s stdout+stderr to '
+                             '<log-dir>/worker-<rank>.log')
+    parser.add_argument('--timeout', type=float, default=None,
+                        help='kill the pod after this many seconds')
     parser.add_argument('command', nargs=argparse.REMAINDER,
                         help='training command to run on every worker')
     args = parser.parse_args(argv)
     if not args.command:
         parser.error('no training command given')
-    codes = launch_local(args.num_workers, args.command)
-    bad = [c for c in codes if c != 0]
-    if bad:
-        sys.exit(bad[0])
+    from ..dist.launcher import launch_local as impl
+    result = impl(args.num_workers, args.command,
+                  log_dir=args.log_dir, timeout=args.timeout)
+    # rc-75 resumable propagation (docs/RESILIENCE.md): a preempted
+    # worker makes the whole pod resumable unless another worker
+    # failed hard
+    rc = result.exit_code()
+    if rc:
+        sys.exit(rc)
 
 
 if __name__ == '__main__':

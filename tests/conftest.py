@@ -50,7 +50,6 @@ SLOW_TEST_FILES = {
     'test_onnx_conformance.py',  # ONNX model round-trip corpus
     'test_examples.py',          # runs every example workload end-to-end
     'test_contrib_onnx_quant.py',
-    'test_dist_launch.py',       # spawns real worker processes
     'test_im2rec.py',            # packs/reads record files on disk
     'test_image_ssd.py',         # detection pipeline + NMS kernels
     'test_transformer.py',       # full transformer fwd/bwd stacks

@@ -1,0 +1,425 @@
+"""Pod-scale multi-host runtime: topology, coordinator, elastic host
+loss, observability stamps, and the serving gateway
+(mxnet_tpu/dist/ + serving/gateway.py, docs/DISTRIBUTED.md).
+
+Single-process tests cover the API contracts (everything degenerates
+to a no-op on one process by design); the slow tests spawn REAL
+2-process pods through the local Gloo launcher — the same legs the
+``dist`` CI stage gates via ``python -m mxnet_tpu.dist``.
+"""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import dist
+from mxnet_tpu.dist import launcher
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(mx.__file__)))
+
+
+def _env():
+    py = os.environ.get('PYTHONPATH', '')
+    return {'PYTHONPATH': _REPO + (os.pathsep + py if py else '')}
+
+
+# -- topology (single-process contracts) -----------------------------------
+
+def test_global_mesh_and_maps():
+    mesh = dist.global_mesh({'dp': 4, 'model': 2})
+    assert dict(mesh.shape) == {'dp': 4, 'model': 2}
+    assert not dist.spans_processes(mesh)
+    maps = dist.device_maps(mesh)
+    assert maps['process_count'] == 1
+    assert maps['local_devices'] == 8
+    assert maps['axes'] == {'dp': 4, 'model': 2}
+    # every local device has a coordinate in the mesh array
+    assert len(maps['local_coords']) == 8
+    from mxnet_tpu.parallel.mesh import current_mesh
+    assert current_mesh() is mesh
+
+
+def test_global_mesh_infers_and_validates():
+    mesh = dist.global_mesh({'dp': -1, 'model': 2})
+    assert dict(mesh.shape)['dp'] == 4
+    with pytest.raises(ValueError):
+        dist.global_mesh({'dp': 3, 'model': 2})
+
+
+def test_host_shard_single_process_full_range():
+    mesh = dist.global_mesh({'dp': 2}, devices=jax.devices()[:2])
+    assert dist.host_shard(mesh, 8) == (0, 8)
+    with pytest.raises(ValueError):
+        dist.host_shard(mesh, 7)      # does not divide over dp
+
+
+def test_put_helpers_single_process():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = dist.global_mesh({'dp': 2}, devices=jax.devices()[:2])
+    a = np.arange(8.0, dtype=np.float32).reshape(4, 2)
+    g = dist.put_global(a, NamedSharding(mesh, P()))
+    s = dist.put_local_shard(a, NamedSharding(mesh, P('dp')))
+    assert np.array_equal(dist.topology.fetch_replicated(g), a)
+    assert float(s.sum()) == float(a.sum())
+
+
+# -- coordinator (single-process no-op contracts) --------------------------
+
+def test_coordinator_single_process_noops():
+    c = dist.Coordinator(namespace='t1')
+    assert not c.active
+    assert c.barrier('x', timeout_s=0.1) == 0.0
+    assert c.broadcast('y', {'seed': 3}) == {'seed': 3}
+    assert c.peer_ages() == {}
+    assert c.dead_peers() == []
+    assert c.check_peers() == {}
+    c.start_heartbeat()               # no-op without peers
+    c.close()
+
+
+def test_coordinator_typed_errors_shape():
+    err = dist.HostLostError('gone', lost=(1, 2), waited_s=3.5)
+    assert err.lost == (1, 2) and err.waited_s == 3.5
+    assert issubclass(dist.BarrierTimeout, dist.HostLostError)
+    assert issubclass(dist.BroadcastTimeout, dist.HostLostError)
+
+
+def test_dist_init_query_api():
+    assert dist.is_initialized() is False
+    assert dist.process_info() == (0, 1)
+    assert isinstance(dist.DistInitError('x'), RuntimeError)
+
+
+# -- launcher ---------------------------------------------------------------
+
+def test_worker_env_contract_and_device_pin():
+    env = launcher.worker_env(1, 4, 9191, local_devices=2,
+                              platform='cpu',
+                              env={'EXTRA': 'v'})
+    assert env['DMLC_ROLE'] == 'worker'
+    assert env['DMLC_WORKER_ID'] == '1'
+    assert env['DMLC_NUM_WORKER'] == '4'
+    assert env['DMLC_PS_ROOT_PORT'] == '9191'
+    assert env['JAX_PLATFORMS'] == 'cpu'
+    assert env['EXTRA'] == 'v'
+    # the forced-8 test env must not leak into 2-device workers
+    assert '--xla_force_host_platform_device_count=2' in \
+        env['XLA_FLAGS']
+    assert env['XLA_FLAGS'].count(
+        '--xla_force_host_platform_device_count') == 1
+
+
+def test_launch_local_logs_and_failure_kill(tmp_path):
+    script = tmp_path / 'w.py'
+    script.write_text(
+        'import os, sys, time\n'
+        'wid = os.environ["DMLC_WORKER_ID"]\n'
+        'print("hello-from-%s" % wid, flush=True)\n'
+        'if wid == "1":\n'
+        '    sys.exit(7)\n'
+        'time.sleep(60)\n')
+    t0 = time.time()
+    res = launcher.launch_local(2, [sys.executable, str(script)],
+                                env=_env(),
+                                log_dir=str(tmp_path / 'logs'),
+                                timeout=120)
+    # worker 1 failed -> worker 0 terminated, not waited for 60s
+    assert time.time() - t0 < 45
+    assert res[1].returncode == 7
+    assert res.exit_code() == 7
+    assert 'hello-from-0' in res[0].log_tail()
+    assert 'hello-from-1' in res[1].log_tail()
+
+
+# -- elastic host loss ------------------------------------------------------
+
+def test_host_loss_plan_math():
+    from mxnet_tpu.resilience import MeshShrinkError, host_loss_plan
+    meta = {'axes': {'dp': 4}, 'device_count': 4, 'process_count': 4}
+    plan = host_loss_plan(meta, surviving_processes=2)
+    assert plan.new_axes == {'dp': 2} and plan.accum_steps == 2
+    assert 'host loss' in plan.note
+    # model axis must survive intact
+    meta2 = {'axes': {'dp': 4, 'model': 2}, 'device_count': 8,
+             'process_count': 4}
+    plan2 = host_loss_plan(meta2, surviving_processes=2)
+    assert plan2.new_axes == {'dp': 2, 'model': 2}
+    with pytest.raises(MeshShrinkError):
+        host_loss_plan(meta2, surviving_processes=0)
+    # a host count that cannot carry the model axes refuses
+    meta3 = {'axes': {'dp': 2, 'model': 4}, 'device_count': 8,
+             'process_count': 8}
+    with pytest.raises(MeshShrinkError):
+        host_loss_plan(meta3, surviving_processes=3)
+
+
+def test_mesh_meta_records_process_count():
+    from mxnet_tpu.resilience import mesh_meta
+    mesh = dist.global_mesh({'dp': 2}, devices=jax.devices()[:2])
+    meta = mesh_meta(mesh)
+    assert meta['process_count'] == 1
+    assert meta['device_count'] == 2
+
+
+# -- observability stamps ---------------------------------------------------
+
+def test_metric_snapshot_carries_process_stamp():
+    from mxnet_tpu import observability as obs
+    snap = obs.snapshot()
+    fam = snap['mxnet_tpu_process']
+    assert fam['type'] == 'gauge'
+    labels = fam['series'][0]['labels']
+    assert labels == {'process_id': '0', 'process_count': '1'}
+    # exporters render it like any real family
+    text = obs.prometheus_text(snap)
+    assert 'mxnet_tpu_process{' in text
+    types, samples = obs.parse_prometheus(text)
+    assert types['mxnet_tpu_process'] == 'gauge'
+
+
+def test_flight_events_and_dump_stamped(tmp_path):
+    from mxnet_tpu.observability import FlightRecorder, read_flight
+    rec = FlightRecorder(capacity=8,
+                         path=str(tmp_path / 'F.jsonl'))
+    rec.set_enabled(True)
+    rec.record('step', step=1)
+    assert rec.events()[0]['process_id'] == 0
+    path = rec.dump(reason='test')
+    header, events = read_flight(path)
+    assert header['process_id'] == 0
+    assert header['process_count'] == 1
+    # single-process dumps keep the un-suffixed path
+    assert path == str(tmp_path / 'F.jsonl')
+
+
+def test_flight_dump_rank_suffix():
+    from mxnet_tpu.observability.recorder import _rank_suffixed
+    assert _rank_suffixed('FLIGHT.jsonl', 0, 1) == 'FLIGHT.jsonl'
+    assert _rank_suffixed('FLIGHT.jsonl', 1, 2) == 'FLIGHT.r1.jsonl'
+    assert _rank_suffixed('/a/b/F.jsonl', 0, 4) == '/a/b/F.r0.jsonl'
+
+
+def test_dist_instruments_registered():
+    from mxnet_tpu import observability as obs
+    inst = obs.dist_instruments()
+    inst.barrier_seconds.observe(0.01)
+    inst.host_lost.inc()
+    snap = obs.snapshot()
+    assert 'mxnet_tpu_dist_barrier_seconds' in snap
+    assert 'mxnet_tpu_dist_host_lost_total' in snap
+
+
+# -- serving gateway --------------------------------------------------------
+
+def _post(base, payload, path='/predict', timeout=15):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'}, method='POST')
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), \
+                dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def _get(base, path, timeout=15):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+@pytest.fixture(scope='module')
+def gateway_rig():
+    from mxnet_tpu.loadgen.harness import GatewayRig
+    rig = GatewayRig(replicas=2, generate=False, max_queue=2,
+                     max_batch=4, deadline_ms=2.0, timeout_s=5.0,
+                     max_concurrent=8, health_period_s=0.2)
+    yield rig
+    rig.close()
+
+
+@pytest.mark.slow
+def test_gateway_routes_and_degrades(gateway_rig):
+    rig = gateway_rig
+    base = 'http://127.0.0.1:%d' % rig.port
+    st, payload = _get(base, '/healthz')
+    assert st == 200 and payload['status'] == 'ok', payload
+    for _ in range(6):
+        code, body, _h = _post(base, {'data': [0.1] * 8})
+        assert code == 200, body
+    st, payload = _get(base, '/replicas')
+    assert len(payload['replicas']) == 2
+    assert payload['stats']['requests'] >= 6
+    st, payload = _get(base, '/status')
+    assert payload['status'] == 'ok'
+    assert len(payload['replicas']) == 2
+
+    # kill replica 1: degraded but still serving; then all down: 503
+    rig.kill_replica(1)
+    time.sleep(0.8)
+    st, payload = _get(base, '/healthz')
+    assert st == 200 and payload['status'] == 'degraded', payload
+    served = sum(
+        1 for _ in range(8)
+        if _post(base, {'data': [0.1] * 8})[0] == 200)
+    assert served >= 7
+    rig.kill_replica(0)
+    time.sleep(0.8)
+    st, payload = _get(base, '/healthz')
+    assert st == 503, payload
+    code, body, headers = _post(base, {'data': [0.1] * 8})
+    assert code == 503
+    assert headers.get('Retry-After') is not None
+    assert 'no healthy serving replica' in body['error']
+
+
+@pytest.mark.slow
+def test_gateway_retry_after_passthrough():
+    """A replica 429 (tiny queue flooded) must pass through the
+    gateway verbatim, Retry-After header included."""
+    from mxnet_tpu.loadgen.harness import GatewayRig
+    rig = GatewayRig(replicas=1, generate=False, max_queue=1,
+                     max_batch=1, deadline_ms=30.0, timeout_s=5.0,
+                     max_concurrent=64, health_period_s=0.5)
+    try:
+        base = 'http://127.0.0.1:%d' % rig.port
+        results = []
+        lock = threading.Lock()
+
+        def fire():
+            out = _post(base, {'data': [0.1] * 8})
+            with lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=fire) for _ in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sheds = [(c, h) for c, _b, h in results if c == 429]
+        assert any(c == 200 for c, _b, _h in results)
+        assert sheds, 'flood never produced a 429 through the gateway'
+        assert all(h.get('Retry-After') is not None for _c, h in sheds)
+        assert rig.gateway.stats()['passthrough_429'] >= len(sheds)
+    finally:
+        rig.close()
+
+
+def test_gateway_needs_replicas():
+    from mxnet_tpu.serving import ServingGateway
+    with pytest.raises(ValueError):
+        ServingGateway([])
+
+
+# -- 2-process pods (slow: spawn + Gloo join per test) ----------------------
+
+def _gloo_supported():
+    try:
+        from jax._src import xla_bridge as xb
+        return 'gloo' in getattr(xb, 'CPU_COLLECTIVES_IMPLEMENTATIONS',
+                                 ())
+    except Exception:
+        return False
+
+
+requires_gloo = pytest.mark.skipif(
+    not _gloo_supported(),
+    reason='DistUnsupported: this jaxlib has no CPU Gloo collectives')
+
+_WORKER_MOD = [sys.executable, '-m', 'mxnet_tpu.dist._selftest_worker']
+
+
+def _spawn(phase, outdir, timeout=300):
+    return launcher.launch_local(
+        2, _WORKER_MOD + [phase, str(outdir)], env=_env(),
+        log_dir=str(outdir / ('logs-' + phase)), platform='cpu',
+        local_devices=1, timeout=timeout)
+
+
+@pytest.mark.slow
+@requires_gloo
+def test_two_process_bit_identity_and_resume(tmp_path):
+    """dp=2 across two processes (ZeRO on, per-host shards) is
+    bit-identical to single-process dp=2, and its checkpoint (written
+    at process_count=2) resumes bit-identically at process_count=1."""
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.dist._selftest_worker import (_data, _params_sorted,
+                                                 _seeded_net)
+    from mxnet_tpu.resilience import CheckpointManager
+    res = _spawn('train', tmp_path)
+    assert res.ok, [(w.rank, w.returncode, w.log_tail(800))
+                    for w in res]
+    with open(tmp_path / 'train-0.json') as f:
+        multi = json.load(f)
+    assert multi['zero'] is True
+
+    net = _seeded_net()
+    xs, ys = _data()
+    mesh = parallel.create_mesh({'dp': 2}, devices=jax.devices()[:2])
+    pt = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1, 'momentum': 0.9}, mesh)
+    losses = [float(pt.step(nd.array(x), nd.array(y)).asscalar())
+              for x, y in zip(xs, ys)]
+    assert multi['losses'] == losses
+    base = _params_sorted(net)
+    assert sorted(multi['params']) == sorted(base)
+    for k in base:
+        assert np.array_equal(np.asarray(multi['params'][k]), base[k])
+
+    # process_count 2 -> 1 resume from the pod's checkpoint
+    net2 = _seeded_net()
+    pt2 = parallel.ParallelTrainer(
+        net2, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+        {'learning_rate': 0.1, 'momentum': 0.9},
+        parallel.create_mesh({'dp': 2}, devices=jax.devices()[:2]))
+    pt2.build(nd.array(xs[0]), nd.array(ys[0]))
+    step, plan = pt2.resume(
+        CheckpointManager(str(tmp_path / 'ckpt'), prefix='pt'))
+    assert step == 5 and plan is None
+    cont = [float(pt2.step(nd.array(x), nd.array(y)).asscalar())
+            for x, y in zip(xs[5:], ys[5:])]
+    assert cont == losses[5:]
+
+
+@pytest.mark.slow
+@requires_gloo
+def test_two_process_host_loss_typed_and_resumable(tmp_path):
+    """Worker death surfaces HostLostError within budget on the
+    survivor, which exits rc 75; the launcher propagates it and the
+    checkpoint re-forms on one host with grad accumulation."""
+    from mxnet_tpu.resilience import CheckpointManager, host_loss_plan
+    res = _spawn('hostloss', tmp_path)
+    assert res.exit_code() == 75, [(w.rank, w.returncode,
+                                    w.log_tail(800)) for w in res]
+    with open(tmp_path / 'hostloss-0.json') as f:
+        rec = json.load(f)
+    assert rec['typed'] in ('BarrierTimeout', 'HostLostError')
+    assert rec['within_budget']
+    # the 2-process flight dump is rank-suffixed and carries host_lost
+    from mxnet_tpu.observability import read_flight
+    root, ext = os.path.splitext(rec['flight'])
+    header, events = read_flight('%s.r0%s' % (root, ext))
+    assert header['process_count'] == 2
+    kinds = [e['kind'] for e in events]
+    assert 'host_lost' in kinds
+    assert all('process_id' in e for e in events)
+
+    mgr = CheckpointManager(str(tmp_path / 'ckpt'), prefix='pt')
+    step, state = mgr.latest()
+    assert step == 3
+    assert state['mesh']['process_count'] == 2
+    plan = host_loss_plan(state['mesh'], surviving_processes=1,
+                          devices_per_host=1)
+    assert plan.accum_steps == 2 and plan.new_axes == {'dp': 1}

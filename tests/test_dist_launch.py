@@ -1,6 +1,13 @@
 """Multi-host distributed: launcher + dist kvstore over
 jax.distributed (reference: tools/launch.py + tests/nightly/
-dist_sync_kvstore.py, mapped to the gloo-backed CPU runtime here)."""
+dist_sync_kvstore.py, mapped to the Gloo-backed CPU runtime here).
+
+Ported onto the mxnet_tpu.dist.launcher harness (docs/DISTRIBUTED.md):
+the spawned workers pin JAX_PLATFORMS=cpu with one virtual device each
+and join over the Gloo collectives layer that _dist_init selects
+before backend init. Runs in tier-1; rigs whose jaxlib predates the
+CPU collectives option skip with a typed reason instead of failing.
+"""
 import os
 import subprocess
 import sys
@@ -9,14 +16,39 @@ import textwrap
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu.dist import launcher
 from mxnet_tpu.tools.launch import launch_local
 
+
+def _gloo_supported():
+    """Typed capability probe: multi-process CPU collectives need the
+    jax_cpu_collectives_implementation option (jax >= 0.4.34-ish).
+    Introspection only — actually SETTING gloo in this single-process
+    test runner would break its own CPU backend init (the Gloo client
+    needs a live distributed runtime)."""
+    try:
+        from jax._src import xla_bridge as xb
+        return 'gloo' in getattr(xb, 'CPU_COLLECTIVES_IMPLEMENTATIONS',
+                                 ())
+    except Exception:
+        return False
+
+
+requires_gloo = pytest.mark.skipif(
+    not _gloo_supported(),
+    reason='DistUnsupported: this jaxlib has no CPU Gloo collectives '
+           '(jax_cpu_collectives_implementation)')
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(mx.__file__)))
+
+
+def _env():
+    py = os.environ.get('PYTHONPATH', '')
+    return {'PYTHONPATH': _REPO + (os.pathsep + py if py else '')}
+
+
 _WORKER = textwrap.dedent('''
-    import os
-    os.environ['JAX_PLATFORMS'] = 'cpu'
     import numpy as np
-    import jax
-    jax.config.update('jax_platforms', 'cpu')
     import mxnet_tpu as mx
     from mxnet_tpu import nd
 
@@ -33,13 +65,19 @@ _WORKER = textwrap.dedent('''
 ''')
 
 
+@requires_gloo
 def test_launcher_two_process_dist_sync(tmp_path):
     script = tmp_path / 'worker.py'
     script.write_text(_WORKER)
-    env = {'PYTHONPATH': os.path.dirname(os.path.dirname(
-        os.path.abspath(mx.__file__)))}
-    codes = launch_local(2, [sys.executable, str(script)], env=env)
-    assert codes == [0, 0]
+    res = launcher.launch_local(
+        2, [sys.executable, str(script)], env=_env(),
+        log_dir=str(tmp_path / 'logs'), platform='cpu',
+        local_devices=1, timeout=240)
+    assert res.ok, [(w.rank, w.returncode, w.log_tail(800))
+                    for w in res]
+    # per-rank log capture: each worker's output in its own file
+    for w in res:
+        assert 'worker-%d-done' % w.rank in w.log_tail()
 
 
 def test_launcher_cli_builds_env(tmp_path):
@@ -57,13 +95,63 @@ def test_launcher_cli_builds_env(tmp_path):
     out = subprocess.run(
         [sys.executable, '-m', 'mxnet_tpu.tools.launch', '-n', '3',
          sys.executable, str(script)],
-        env=dict(os.environ, PYTHONPATH=os.path.dirname(
-            os.path.dirname(os.path.abspath(mx.__file__)))),
+        env=dict(os.environ, **_env()),
         capture_output=True, timeout=120)
     assert out.returncode == 0, out.stderr.decode()
+
+
+def test_launcher_compat_returncodes(tmp_path):
+    """tools.launch.launch_local keeps its list-of-ints contract."""
+    script = tmp_path / 'ok.py'
+    script.write_text('print("hi")\n')
+    codes = launch_local(2, [sys.executable, str(script)], env=_env())
+    assert codes == [0, 0]
+
+
+def test_launcher_resumable_rc_propagation(tmp_path):
+    """A preempted (rc 75) worker makes the POD resumable; a hard
+    failure wins over it (docs/RESILIENCE.md contract)."""
+    script = tmp_path / 'w.py'
+    script.write_text(textwrap.dedent('''
+        import os, sys
+        sys.exit(75 if os.environ['DMLC_WORKER_ID'] == '0' else 0)
+    '''))
+    res = launcher.launch_local(2, [sys.executable, str(script)],
+                                env=_env(), timeout=120)
+    assert res.exit_code() == 75
+    assert res[0].resumable
+    hard = tmp_path / 'hard.py'
+    hard.write_text(textwrap.dedent('''
+        import os, sys
+        sys.exit(75 if os.environ['DMLC_WORKER_ID'] == '0' else 3)
+    '''))
+    res = launcher.launch_local(2, [sys.executable, str(hard)],
+                                env=_env(), timeout=120)
+    assert res.exit_code() == 3
 
 
 def test_single_process_dist_create_is_safe():
     """dist kvstore without launcher env stays single-process."""
     kv = mx.kv.create('dist_sync')
     assert kv.num_workers == 1
+
+
+def test_non_worker_role_does_not_join():
+    """DMLC_ROLE=scheduler/server processes must not join as workers
+    (reference tracker compat): the env request is ignored."""
+    from mxnet_tpu import _dist_init
+    env = {'DMLC_ROLE': 'server', 'DMLC_PS_ROOT_URI': '127.0.0.1',
+           'DMLC_PS_ROOT_PORT': '9091', 'DMLC_NUM_WORKER': '2',
+           'DMLC_WORKER_ID': '0'}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        assert _dist_init._env_request() is None
+        os.environ['DMLC_ROLE'] = 'worker'
+        assert _dist_init._env_request() is not None
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -196,6 +196,23 @@ def main(argv=None):
         ('sharding', [py, '-m', 'mxnet_tpu.parallel',
                       '--devices', '8',
                       '--out', '/tmp/SHARDING_SELFTEST.json']),
+        # pod-scale multi-host contract (docs/DISTRIBUTED.md): two
+        # REAL processes over the Gloo local launcher — join/broadcast
+        # /barrier, typed DistInitError on a dead coordinator, typed
+        # HostLostError instead of a collective hang, cross-host dp=2
+        # (ZeRO + guardrail) bit-identical to single-process,
+        # checkpoint at process_count=2 resuming bit-identically at
+        # process_count=1, host death -> rc-75 resumable + elastic
+        # re-form (dp 2->1, accum 2), and the serving gateway keeping
+        # a multi-replica deployment serving with one replica down
+        ('dist', [py, '-m', 'mxnet_tpu.dist',
+                  '--out', '/tmp/DIST_SELFTEST.json']),
+        # MULTICHIP bench leg: the same 2-process pod measured — step
+        # time + per-step collective bytes recorded into the standard
+        # instrument JSON (artifact key "dist")
+        ('bench-dist', [py, 'bench_scaling.py', '--model', 'mlp',
+                        '--dp', '1,2', '--no-zero-leg', '--dist',
+                        '--out', '/tmp/SCALING_DIST.json']),
         ('serving', [py, '-m', 'mxnet_tpu.serving',
                      '--out', '/tmp/SERVE_SELFTEST.json']),
         # closed-loop latency/throughput sweep over the bucket ladder

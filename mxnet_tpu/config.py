@@ -359,6 +359,22 @@ KNOBS = {k.name: k for k in [
           'Capacity-search goodput floor: fraction of offered'
           ' requests served clean (200, no typed error) a rate must'
           ' sustain to count as within capacity.'),
+    _knob('MXNET_TPU_SLO_GATEWAY_AVAILABILITY', float, 0.99,
+          'Availability floor for the gateway-failover drill'
+          ' (mxnet_tpu.loadgen --mode gateway-failover): fraction of'
+          ' streams that must complete CLEAN — zero error lines —'
+          ' while a replica is killed mid-stream and the gateway'
+          ' resumes them on the survivors.'),
+    _knob('MXNET_TPU_SLO_TENANT_TTFT_P99_MS', float, 400.0,
+          'Steady-tenant TTFT p99 budget (ms) for the two-tenant'
+          ' burst phase (--mode tenants): while another tenant'
+          ' bursts past its bucket, the steady tenant\'s time to'
+          ' first token must stay inside this budget (zero'
+          ' cross-tenant SLO bleed).'),
+    _knob('MXNET_TPU_SLO_TENANT_TPOT_P99_MS', float, 250.0,
+          'Steady-tenant TPOT p99 budget (ms) for the two-tenant'
+          ' burst phase: per-output-token latency of the steady'
+          ' tenant\'s admitted streams under a neighbor\'s burst.'),
     _knob('MXNET_TPU_LOADGEN_SEED', int, 0,
           'Default seed for the open-loop arrival schedule'
           ' (mxnet_tpu.loadgen): same seed, same arrival times and'
@@ -374,6 +390,17 @@ KNOBS = {k.name: k for k in [
           ' requests (one thread each). An arrival above the bound'
           ' resolves as client_saturated — counted against goodput,'
           ' never silently dropped.'),
+    _knob('MXNET_TPU_LOADGEN_RETRIES', int, 0,
+          'Loadgen client retry budget on 429/503: each retry honors'
+          ' the server\'s Retry-After (capped by'
+          ' MXNET_TPU_LOADGEN_RETRY_CAP_S) before re-firing, and the'
+          ' record counts its retries in the taxonomy. 0 (default)'
+          ' keeps the one-shot open-loop behavior the overload'
+          ' verdicts are calibrated on.'),
+    _knob('MXNET_TPU_LOADGEN_RETRY_CAP_S', float, 2.0,
+          'Ceiling on a single loadgen retry backoff sleep: a'
+          ' Retry-After above it is clamped so a mis-advertised hint'
+          ' cannot stall the harness.'),
     # performance: roofline audit / vjp rescheduling / input prefetch
     # (docs/PERFORMANCE.md)
     _knob('MXNET_TPU_ROOFLINE_PEAK_TFLOPS', float, 197.0,
@@ -482,6 +509,43 @@ KNOBS = {k.name: k for k in [
           'Per-request budget for a gateway-forwarded upstream call;'
           ' an unreachable replica fails over to the next healthy'
           ' one, and an all-replicas-down gateway answers typed 503.'),
+    _knob('MXNET_TPU_GATEWAY_RESUME', bool, True,
+          'Mid-stream failover for /generate: the gateway journals'
+          ' every streamed token and, when a replica dies mid-stream,'
+          ' re-admits the request on a healthy replica with'
+          ' prompt+emitted-tokens as the new prefix, splicing the'
+          ' resumed tokens into the SAME client NDJSON stream'
+          ' (at-most-once per token index). 0 restores the pre-resume'
+          ' behavior: typed abort line / cut connection.'),
+    _knob('MXNET_TPU_GATEWAY_RESUME_MAX', int, 2,
+          'Bounded resume attempts per stream: after this many'
+          ' mid-stream failovers the gateway stops retrying and emits'
+          ' the typed ReplicaLost abort line (partial tokens'
+          ' attached), ending the chunked stream cleanly.'),
+    _knob('MXNET_TPU_GATEWAY_AFFINITY', bool, True,
+          'Prefix-affine /generate routing: rendezvous-hash the'
+          ' prompt-prefix fingerprint over the healthy replica set so'
+          ' a shared system prompt keeps landing on the replica whose'
+          ' PrefixCache already holds it (resume targets prefer the'
+          ' prefix owner too). 0 = plain round-robin.'),
+    _knob('MXNET_TPU_GATEWAY_TENANT_HEADER', str, 'X-Tenant',
+          'Request header naming the tenant for per-tenant admission'
+          ' at the gateway; requests without it share the "default"'
+          ' tenant bucket.'),
+    _knob('MXNET_TPU_GATEWAY_TENANT_RPS', float, 0.0,
+          'Per-tenant token-bucket refill rate (requests/second) at'
+          ' the gateway: past it a tenant sheds typed 429s with a'
+          ' Retry-After naming when its bucket refills, so one'
+          ' tenant\'s burst cannot starve the pool. 0 (default)'
+          ' disables rate admission.'),
+    _knob('MXNET_TPU_GATEWAY_TENANT_BURST', float, 0.0,
+          'Per-tenant token-bucket depth (burst allowance). 0 derives'
+          ' it as max(1, 2x MXNET_TPU_GATEWAY_TENANT_RPS).'),
+    _knob('MXNET_TPU_GATEWAY_TENANT_MAX_INFLIGHT', int, 0,
+          'Gateway-wide in-flight request cap shared weighted-fair'
+          ' across active tenants: a tenant may exceed its 1/k share'
+          ' only while the pool has slack, so a burst queues behind'
+          ' its own share, not everyone\'s. 0 = unbounded.'),
     # preemption / elasticity / watchdog (docs/RESILIENCE.md)
     _knob('MXNET_TPU_PREEMPT_EXIT_CODE', int, 75,
           'Process exit code marking a preempted-but-resumable run'

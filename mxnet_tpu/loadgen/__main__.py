@@ -63,6 +63,22 @@ def _summary(doc):
     if doc['mode'] == 'capacity':
         lines.append('  max_qps=%s (p99 < SLO, goodput >= floor)'
                      % (m.get('max_qps'),))
+    if doc['mode'] == 'gateway-failover':
+        lines.append('  resumed_streams=%s error_lines=%s '
+                     'availability=%s'
+                     % (m.get('resumed_streams'),
+                        m.get('error_lines'),
+                        m.get('availability')))
+    if doc['mode'] == 'tenants':
+        for tenant in ('steady', 'burst'):
+            tm = m.get(tenant) or {}
+            gen = tm.get('generate') or {}
+            lines.append('  %-6s offered=%s served_ok=%s shed=%s '
+                         'retried=%s ttft_p99=%sms'
+                         % (tenant, tm.get('offered'),
+                            tm.get('served_ok'), tm.get('shed'),
+                            tm.get('retried'),
+                            (gen.get('ttft') or {}).get('p99_ms')))
     for f in doc.get('faults', []):
         lines.append('  fault %-19s consumed=%s recovery=%ss'
                      % (f['kind'], f['consumed'], f['recovery_s']))
@@ -78,7 +94,8 @@ def main(argv=None):
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument('--mode', choices=('capacity', 'overload', 'chaos',
-                                      'prefix'),
+                                      'prefix', 'gateway-failover',
+                                      'tenants'),
                    default='overload')
     p.add_argument('--out', default='SLO.json')
     p.add_argument('--seed', type=int, default=None,
@@ -105,8 +122,9 @@ def main(argv=None):
                    help='long soak: 4x the default windows/durations')
     args = p.parse_args(argv)
 
-    from .harness import ServingRig, run_capacity, run_chaos, \
-        run_overload, run_prefix
+    from .harness import GatewayRig, ServingRig, run_capacity, \
+        run_chaos, run_gateway_failover, run_overload, run_prefix, \
+        run_tenants
     from .harness import _knob
     seed = args.seed if args.seed is not None \
         else int(_knob('MXNET_TPU_LOADGEN_SEED', 0))
@@ -117,12 +135,34 @@ def main(argv=None):
     # decode workload the SLO guards)
     mix = {'predict': 1.0} if args.no_generate else None
 
+    if args.mode in ('prefix', 'gateway-failover', 'tenants') \
+            and args.no_generate:
+        raise SystemExit('--mode %s needs the generate rig'
+                         % args.mode)
     if args.mode == 'prefix':
-        if args.no_generate:
-            raise SystemExit('--mode prefix needs the generate rig')
         # bigger prefill bucket: the shared-prefix workload carries
         # page-aligned system prompts + a one-token suffix
         rig = ServingRig(decode_prefill_buckets=(32,))
+    elif args.mode == 'gateway-failover':
+        # long generations (the kill must land MID-stream), a prefill
+        # bucket wide enough for prompt+emitted re-admission, and a
+        # full (non-oversubscribed) page pool — this drill gates
+        # failover, the chaos squeeze gates pool exhaustion
+        rig = GatewayRig(replicas=2, health_period_s=0.25,
+                         predict=False, slots=4, max_new_tokens=48,
+                         decode_max_queue=16,
+                         decode_prefill_buckets=(64,),
+                         decode_max_len=128, decode_pages=64)
+    elif args.mode == 'tenants':
+        # two-tenant burst phase: per-tenant buckets sized so the
+        # steady lane never touches its budget while the burst lane
+        # blows through; deep replica queues keep replica-side 429s
+        # out of the tenant-isolation signal
+        rig = GatewayRig(replicas=2, health_period_s=0.25,
+                         predict=False, slots=4, decode_max_queue=16,
+                         gateway_kwargs=dict(tenant_rps=8.0,
+                                             tenant_burst=8.0,
+                                             tenant_max_inflight=32))
     else:
         rig = ServingRig(generate=not args.no_generate)
     try:
@@ -131,6 +171,13 @@ def main(argv=None):
                              duration_s=(args.duration
                                          or 4.0 * scale),
                              seed=seed)
+        elif args.mode == 'gateway-failover':
+            doc = run_gateway_failover(rig, streams=8, seed=seed)
+        elif args.mode == 'tenants':
+            doc = run_tenants(rig,
+                              duration_s=(args.duration
+                                          or 4.0 * scale),
+                              seed=seed)
         elif args.mode == 'capacity':
             doc = run_capacity(
                 rig, slo_s=slo_s, mix=mix, seed=seed,

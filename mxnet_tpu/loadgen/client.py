@@ -30,12 +30,22 @@ _STATUS_CLASS = {
 }
 
 
+def _knob(name, default):
+    try:
+        from .. import config as _config
+        v = _config.get(name)
+        return default if v is None else v
+    except Exception:
+        return default
+
+
 class RequestRecord:
     """Everything measured about one open-loop request."""
 
     __slots__ = ('rid', 'kind', 'scheduled_t', 'fired_at', 'first_at',
                  'done_at', 'status', 'error_class', 'tokens',
-                 'degraded', 'retry_after_s', 'resolved', 'detail')
+                 'degraded', 'retry_after_s', 'resolved', 'detail',
+                 'resumed', 'retries')
 
     def __init__(self, rid, kind, scheduled_t):
         self.rid = rid
@@ -51,6 +61,8 @@ class RequestRecord:
         self.retry_after_s = None        # parsed Retry-After on 429
         self.resolved = False
         self.detail = None               # short error text
+        self.resumed = 0                 # gateway mid-stream resumes
+        self.retries = 0                 # client Retry-After retries
 
     # -- derived metrics ---------------------------------------------------
 
@@ -84,7 +96,9 @@ class RequestRecord:
                 'ttft_s': self.ttft_s(), 'tokens': self.tokens,
                 'degraded': self.degraded,
                 'retry_after_s': self.retry_after_s,
-                'resolved': self.resolved}
+                'resolved': self.resolved,
+                'resumed': self.resumed,
+                'retries': self.retries}
 
 
 class LoadClient:
@@ -93,14 +107,30 @@ class LoadClient:
     ``timeout_s`` is the CLIENT-side socket budget: even a wedged
     server resolves every record (error_class ``client_timeout``) —
     the harness never hangs on the system under test.
+
+    ``headers`` ride on every POST (e.g. the gateway's tenant
+    header). ``retries`` > 0 honors a 429/503's Retry-After with a
+    capped backoff sleep before re-firing — recorded on the record's
+    ``retries`` counter, never silent; the default (the
+    ``MXNET_TPU_LOADGEN_RETRIES`` knob, 0) keeps the one-shot
+    open-loop behavior the overload verdicts are calibrated on.
     """
 
     def __init__(self, host, port, timeout_s=10.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, headers=None, retries=None,
+                 retry_cap_s=None, sleep=time.sleep):
         self.host = host
         self.port = int(port)
         self.timeout_s = float(timeout_s)
+        self.headers = dict(headers or {})
+        self.retries = int(
+            retries if retries is not None
+            else _knob('MXNET_TPU_LOADGEN_RETRIES', 0))
+        self.retry_cap_s = float(
+            retry_cap_s if retry_cap_s is not None
+            else _knob('MXNET_TPU_LOADGEN_RETRY_CAP_S', 2.0))
         self._clock = clock
+        self._sleep = sleep
 
     # -- internals ---------------------------------------------------------
 
@@ -111,11 +141,49 @@ class LoadClient:
         # one request per connection: 'close' tells the server not to
         # hold the socket for keep-alive, so tearing the client down
         # never looks like a mid-request reset on the server side
-        conn.request('POST', path, body=body,
-                     headers={'Content-Type': 'application/json',
-                              'Content-Length': str(len(body)),
-                              'Connection': 'close'})
+        headers = {'Content-Type': 'application/json',
+                   'Content-Length': str(len(body)),
+                   'Connection': 'close'}
+        headers.update(self.headers)
+        conn.request('POST', path, body=body, headers=headers)
         return conn
+
+    @staticmethod
+    def _parse_retry_after(headers):
+        if headers is None:
+            return None
+        ra = headers.get('Retry-After')
+        if ra is None:
+            return None
+        try:
+            return float(ra)
+        except ValueError:
+            return None
+
+    def _with_retries(self, rec, attempt):
+        """Run ``attempt(rec)``; on a 429/503 with retry budget left,
+        back off (Retry-After, capped) and re-fire. The record keeps
+        its ORIGINAL fired_at — backoff time is real latency the
+        open-loop accounting must see — and counts every retry."""
+        attempt(rec)
+        while (rec.status in (429, 503)
+               and rec.retries < self.retries):
+            hint = rec.retry_after_s if rec.retry_after_s is not None \
+                else 0.05
+            self._sleep(max(0.0, min(float(hint), self.retry_cap_s)))
+            rec.retries += 1
+            # reset per-attempt outcome; fired_at / retries persist
+            rec.first_at = None
+            rec.done_at = None
+            rec.status = None
+            rec.error_class = None
+            rec.tokens = 0
+            rec.degraded = False
+            rec.detail = None
+            rec.resumed = 0
+            rec.resolved = False
+            attempt(rec)
+        return rec
 
     @staticmethod
     def _classify(rec, status, headers):
@@ -124,7 +192,7 @@ class LoadClient:
             return
         rec.error_class = _STATUS_CLASS.get(status,
                                             'server_error')
-        if status == 429 and headers is not None:
+        if status in (429, 503) and headers is not None:
             ra = headers.get('Retry-After')
             if ra is not None:
                 try:
@@ -135,8 +203,15 @@ class LoadClient:
     # -- request kinds -----------------------------------------------------
 
     def predict(self, rec, data):
-        """POST /predict with one example; fills ``rec`` in place."""
-        rec.fired_at = self._clock()
+        """POST /predict with one example; fills ``rec`` in place.
+        Retries 429/503 with capped Retry-After backoff when the
+        client's retry budget allows."""
+        return self._with_retries(
+            rec, lambda r: self._predict_once(r, data))
+
+    def _predict_once(self, rec, data):
+        if rec.fired_at is None:
+            rec.fired_at = self._clock()
         conn = None
         try:
             conn = self._post('/predict', {'data': data})
@@ -170,8 +245,17 @@ class LoadClient:
         """POST /generate with stream=true; reads the NDJSON lines as
         they arrive (TTFT = first line, TPOT from the line spacing).
         A typed mid-stream error line resolves the record with
-        error_class ``stream_<Class>``."""
-        rec.fired_at = self._clock()
+        error_class ``stream_<Class>``; a stream the gateway resumed
+        across a replica loss resolves CLEAN with ``rec.resumed`` > 0
+        (success-with-resume, not a failure). Retries 429/503 with
+        capped Retry-After backoff when the retry budget allows."""
+        return self._with_retries(
+            rec,
+            lambda r: self._generate_once(r, tokens, max_new_tokens))
+
+    def _generate_once(self, rec, tokens, max_new_tokens=8):
+        if rec.fired_at is None:
+            rec.fired_at = self._clock()
         conn = None
         try:
             conn = self._post('/generate',
@@ -200,6 +284,7 @@ class LoadClient:
                     rec.tokens += 1
                 if obj.get('done'):
                     rec.degraded = bool(obj.get('degraded'))
+                    rec.resumed = int(obj.get('resumed', 0) or 0)
                     if obj.get('error'):
                         rec.error_class = 'stream_%s' % (
                             obj.get('error_class') or 'error')

@@ -37,7 +37,8 @@ from .report import build_artifact, summarize
 from .schedule import build_schedule
 
 __all__ = ['ServingRig', 'GatewayRig', 'Dispatcher', 'run_capacity',
-           'run_overload', 'run_chaos', 'run_prefix', 'DEFAULT_MIX',
+           'run_overload', 'run_chaos', 'run_prefix',
+           'run_gateway_failover', 'run_tenants', 'DEFAULT_MIX',
            'OVERLOAD_MIX']
 
 # chaos soak: mostly-cheap traffic keeps the soak itself off the
@@ -252,7 +253,8 @@ class GatewayRig:
     serving (degraded) on the survivors.
     """
 
-    def __init__(self, replicas=2, health_period_s=0.25, **rig_kwargs):
+    def __init__(self, replicas=2, health_period_s=0.25,
+                 gateway_kwargs=None, **rig_kwargs):
         from ..serving.gateway import ServingGateway
         if int(replicas) < 1:
             raise ValueError('GatewayRig needs >= 1 replica')
@@ -260,7 +262,8 @@ class GatewayRig:
                          for _ in range(int(replicas))]
         self.gateway = ServingGateway(
             ['http://127.0.0.1:%d' % r.port for r in self.replicas],
-            port=0, health_period_s=health_period_s).start()
+            port=0, health_period_s=health_period_s,
+            **(gateway_kwargs or {})).start()
         self.port = self.gateway.port
         self.max_new_tokens = self.replicas[0].max_new_tokens
         self.slots = self.replicas[0].slots
@@ -274,13 +277,29 @@ class GatewayRig:
     def decode_session(self):
         return self.replicas[0].decode_session
 
+    def replica_index(self, base_url):
+        """Index of the replica serving ``base_url`` (the drill maps
+        the gateway's affinity target back to a killable rig)."""
+        for i, rep in enumerate(self.replicas):
+            if base_url == 'http://127.0.0.1:%d' % rep.port:
+                return i
+        raise ValueError('no replica at %r' % (base_url,))
+
     def kill_replica(self, index):
-        """Stop one replica's HTTP server (the whole-host-down drill);
-        its sessions close undrained, exactly like a lost host."""
+        """Kill one replica mid-flight (the whole-host-down drill):
+        sessions close FIRST, undrained — every in-flight and queued
+        stream dies NOW with a typed error, the mid-stream signal the
+        gateway's resume journal acts on — then the HTTP server stops.
+        A graceful server-first stop would let in-flight streams run
+        to completion during the shutdown, which is a drained host,
+        not a lost one."""
         rep = self.replicas[index]
         if index not in self._killed:
             self._killed.add(index)
-            rep.close()
+            for sess in (rep.predict_session, rep.decode_session):
+                if sess is not None:
+                    sess.close(drain=False)
+            rep.server.stop()
         return rep
 
     def healthy(self, payload):
@@ -855,3 +874,280 @@ def run_prefix(rig, qps=12.0, duration_s=4.0, seed=0,
          'zipf_system_prompts': len(prompts),
          'prefix_ttft_p99_budget_ms': ttft_p99_budget_s * 1e3},
         m, server=server, verdicts=verdicts)
+
+
+def _read_token_stream(host, port, payload, timeout_s=30.0,
+                       on_token=None):
+    """Read one streamed /generate end to end, keeping the token
+    VALUES and indices (RequestRecord only counts tokens — the
+    bit-identity drill needs the actual sequence). Returns
+    {'status', 'tokens', 'indices', 'done', 'error'}; transport
+    failures land in 'error', never raise."""
+    import http.client
+    import json as _json
+    out = {'status': None, 'tokens': [], 'indices': [],
+           'done': None, 'error': None}
+    conn = http.client.HTTPConnection(host, int(port),
+                                      timeout=timeout_s)
+    try:
+        body = _json.dumps(payload).encode()
+        conn.request('POST', '/generate', body=body,
+                     headers={'Content-Type': 'application/json',
+                              'Content-Length': str(len(body)),
+                              'Connection': 'close'})
+        resp = conn.getresponse()
+        out['status'] = resp.status
+        if resp.status != 200:
+            resp.read()
+            return out
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = _json.loads(line)
+            except ValueError:
+                continue
+            if 'token' in obj:
+                out['tokens'].append(int(obj['token']))
+                out['indices'].append(obj.get('index'))
+                if on_token is not None:
+                    on_token(len(out['tokens']))
+            elif obj.get('done'):
+                out['done'] = obj
+                if obj.get('error'):
+                    out['error'] = obj.get('error_class') or 'error'
+                break
+    except Exception as exc:
+        out['error'] = type(exc).__name__
+    finally:
+        conn.close()
+    return out
+
+
+def run_gateway_failover(rig, streams=8, seed=0,
+                         availability_floor=None, timeout_s=30.0,
+                         kill=True):
+    """Kill-replica-mid-stream drill: >= ``streams`` concurrent
+    /generate streams share ONE system prompt, so prefix-affine
+    routing aims them all at a single replica; that replica is killed
+    once tokens are flowing, and the gateway must resume every live
+    stream on the survivors. Gated (tools/slo_gate.py
+    ``gateway-failover.*``):
+
+      * zero client-visible NDJSON error lines,
+      * availability (clean completions / offered) above the
+        ``MXNET_TPU_SLO_GATEWAY_AVAILABILITY`` floor,
+      * every token stream BIT-IDENTICAL to the unkilled reference
+        run (greedy decode + replay-from-journal = same sequence),
+      * token indices contiguous with no duplicates across the splice
+        (the at-most-once contract),
+      * at least one stream actually resumed (the drill proved the
+        mechanism, not a lucky miss).
+    """
+    if rig.decode_session is None:
+        raise ValueError('gateway-failover mode needs a generate-'
+                         'capable rig')
+    if len(rig.replicas) < 2:
+        raise ValueError('gateway-failover mode needs >= 2 replicas')
+    availability_floor = float(
+        availability_floor if availability_floor is not None
+        else _knob('MXNET_TPU_SLO_GATEWAY_AVAILABILITY', 0.99))
+    streams = int(streams)
+    max_new = int(rig.max_new_tokens)
+    system = [2 + ((seed + j) % (_VOCAB - 3)) for j in range(12)]
+    payloads = [{'tokens': system + [1 + (i % (_VOCAB - 2))],
+                 'max_new_tokens': max_new, 'stream': True}
+                for i in range(streams)]
+    # every payload shares the system prompt => one affinity target
+    target_url = rig.gateway.affinity_target(payloads[0]['tokens'])
+    target = rig.replica_index(target_url)
+    # reference pass (unkilled): the token sequences the client is
+    # entitled to — also warms the target's prefix cache, exactly the
+    # state a long-lived deployment would be in
+    reference = [_read_token_stream('127.0.0.1', rig.port, p,
+                                    timeout_s=timeout_s)
+                 for p in payloads]
+    _settle(rig)
+    # killed pass: all streams concurrent; the killer waits for
+    # first tokens so the kill lands MID-stream, not before admission
+    results = [None] * streams
+    first_tokens = threading.Event()
+
+    def _on_token(n):
+        first_tokens.set()
+
+    def _drive(i):
+        results[i] = _read_token_stream(
+            '127.0.0.1', rig.port, payloads[i], timeout_s=timeout_s,
+            on_token=_on_token)
+
+    threads = [threading.Thread(target=_drive, args=(i,),
+                                daemon=True,
+                                name='loadgen-failover-%d' % i)
+               for i in range(streams)]
+    for th in threads:
+        th.start()
+    killed = False
+    if kill:
+        # kill on the FIRST streamed token: the first slot wave is
+        # mid-generation and the rest still queued on the target, so
+        # the loss hits streams in every admission state
+        first_tokens.wait(timeout_s)
+        rig.kill_replica(target)
+        killed = True
+    deadline = time.monotonic() + timeout_s + 10.0
+    for th in threads:
+        th.join(max(0.1, deadline - time.monotonic()))
+    unresolved = sum(1 for th in threads if th.is_alive())
+    # -- verdicts ----------------------------------------------------------
+    clean = [r for r in results
+             if r is not None and r['status'] == 200
+             and r['error'] is None and r['done'] is not None]
+    error_lines = sum(1 for r in results
+                      if r is not None and r['error'] is not None)
+    resumed = sum(1 for r in clean
+                  if (r['done'] or {}).get('resumed'))
+    # bit-identity over CLEAN streams (a rejected/unresolved stream
+    # is an availability miss, already gated above)
+    identical = all(
+        reference[i]['error'] is None
+        and results[i]['tokens'] == reference[i]['tokens']
+        for i in range(streams)
+        if results[i] is not None and results[i]['status'] == 200
+        and results[i]['error'] is None
+        and results[i]['done'] is not None)
+    contiguous = all(
+        r['indices'] == list(range(len(r['tokens'])))
+        and (r['done'] or {}).get('tokens') == r['tokens']
+        for r in clean)
+    availability = len(clean) / float(streams) if streams else None
+    gw_stats = rig.gateway.stats()
+    verdicts = {
+        'zero_error_lines': error_lines == 0,
+        'availability_above_floor': availability is not None
+        and availability >= availability_floor,
+        'token_streams_bit_identical': identical,
+        'indices_contiguous_no_dupes': contiguous,
+        'resume_engaged': (not killed)
+        or (resumed >= 1 and gw_stats.get('resumes', 0) >= 1),
+        'zero_unresolved': unresolved == 0,
+    }
+    metrics = {
+        'offered': streams,
+        'admitted': sum(1 for r in results
+                        if r is not None and r['status'] == 200),
+        'served_ok': len(clean),
+        'availability': availability,
+        'resumed_streams': resumed,
+        'error_lines': error_lines,
+        'unresolved': unresolved,
+        'tokens_per_stream': max_new,
+        'gateway': gw_stats,
+    }
+    return build_artifact(
+        'gateway-failover',
+        {'streams': streams, 'seed': seed, 'killed_replica': target
+         if killed else None, 'replicas': len(rig.replicas),
+         'max_new_tokens': max_new,
+         'availability_floor': availability_floor},
+        metrics, server=rig.server_stats(), verdicts=verdicts)
+
+
+def run_tenants(rig, steady_qps=4.0, burst_qps=30.0, duration_s=4.0,
+                seed=0, ttft_budget_s=None, tpot_budget_s=None,
+                timeout_s=6.0):
+    """Two-tenant burst phase: a STEADY tenant runs inside its
+    admission budget while a BURST tenant offers far past its bucket.
+    Gated (tools/slo_gate.py ``tenants.*``): the burst tenant sheds
+    typed per-tenant 429s with Retry-After, the steady tenant is
+    never shed and its TTFT/TPOT p99 stay inside the committed
+    budgets — zero cross-tenant SLO bleed. The rig's gateway must
+    mount tenant admission (GatewayRig(gateway_kwargs=...))."""
+    if rig.decode_session is None:
+        raise ValueError('tenants mode needs a generate-capable rig')
+    gw = getattr(rig, 'gateway', None)
+    if gw is None or gw.admission is None:
+        raise ValueError('tenants mode needs a gateway with tenant '
+                         'admission (tenant_rps > 0)')
+    ttft_budget_s = float(
+        ttft_budget_s if ttft_budget_s is not None
+        else _knob('MXNET_TPU_SLO_TENANT_TTFT_P99_MS', 400.0) / 1e3)
+    tpot_budget_s = float(
+        tpot_budget_s if tpot_budget_s is not None
+        else _knob('MXNET_TPU_SLO_TENANT_TPOT_P99_MS', 250.0) / 1e3)
+    header = gw.tenant_header
+    lanes = {}
+    for tenant, qps, lane_seed, retries in (
+            ('steady', steady_qps, seed, 0),
+            # the burst lane honors Retry-After once per shed — the
+            # client-backoff contract, recorded in the taxonomy
+            ('burst', burst_qps, seed + 7919, 1)):
+        client = LoadClient('127.0.0.1', rig.port,
+                            timeout_s=timeout_s,
+                            headers={header: tenant},
+                            retries=retries)
+        disp = Dispatcher(client, max_new_tokens=rig.max_new_tokens)
+        arrivals = build_schedule(qps, duration_s,
+                                  mix={'generate': 1.0},
+                                  seed=lane_seed)
+        lanes[tenant] = {'disp': disp, 'arrivals': arrivals}
+
+    def _drive(lane):
+        lane['records'], lane['threads'] = \
+            lane['disp'].run(lane['arrivals'])
+
+    drivers = [threading.Thread(target=_drive, args=(lane,),
+                                daemon=True,
+                                name='loadgen-tenant-%s' % name)
+               for name, lane in lanes.items()]
+    for th in drivers:
+        th.start()
+    for th in drivers:
+        th.join(duration_s + timeout_s + 4.0)
+    unresolved = 0
+    for lane in lanes.values():
+        unresolved += lane['disp'].drain(
+            lane.get('threads', []), timeout_s + 2.0)
+    _settle(rig)
+    m_steady = summarize(lanes['steady'].get('records', []))
+    m_burst = summarize(lanes['burst'].get('records', []))
+    gw_stats = gw.stats()
+    steady_gen = m_steady.get('generate') or {}
+    ttft_p99 = (steady_gen.get('ttft') or {}).get('p99_ms')
+    tpot_p99 = (steady_gen.get('tpot') or {}).get('p99_ms')
+    verdicts = {
+        'burst_shed_typed_429': m_burst['shed'] > 0
+        and m_burst['retry_after']['n'] > 0,
+        'burst_retry_after_honored': m_burst['retried'] > 0,
+        'steady_never_shed': m_steady['shed'] == 0,
+        'steady_ttft_within_budget': ttft_p99 is not None
+        and ttft_p99 <= ttft_budget_s * 1e3,
+        'steady_tpot_within_budget': tpot_p99 is None
+        or tpot_p99 <= tpot_budget_s * 1e3,
+        'zero_unresolved': unresolved == 0
+        and m_steady['unresolved'] == 0
+        and m_burst['unresolved'] == 0,
+    }
+    metrics = {
+        'steady': m_steady,
+        'burst': m_burst,
+        'availability': m_steady['availability'],
+        'admitted_latency': m_steady['admitted_latency'],
+        'unresolved': unresolved,
+        'gateway': gw_stats,
+    }
+    return build_artifact(
+        'tenants',
+        {'steady_qps': steady_qps, 'burst_qps': burst_qps,
+         'duration_s': duration_s, 'seed': seed,
+         'tenant_header': header,
+         'tenant_rps': gw.admission.rps,
+         'tenant_burst': gw.admission.burst,
+         'tenant_max_inflight': gw.admission.max_inflight,
+         'ttft_budget_ms': ttft_budget_s * 1e3,
+         'tpot_budget_ms': tpot_budget_s * 1e3},
+        metrics, server=rig.server_stats(), verdicts=verdicts)

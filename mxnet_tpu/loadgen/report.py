@@ -71,6 +71,14 @@ def summarize(records):
         'served_ok': len(ok),
         'shed': len(shed),
         'degraded': sum(1 for r in admitted if r.degraded),
+        # success-with-resume: streams the gateway failed over
+        # mid-generation and completed clean — they count toward
+        # goodput, never as failures (the resume is the mechanism
+        # that KEPT them successful)
+        'resumed_streams': sum(1 for r in ok
+                               if getattr(r, 'resumed', 0)),
+        'retried': sum(1 for r in records
+                       if getattr(r, 'retries', 0)),
         'unresolved': unresolved,
         'goodput': (len(ok) / float(offered)) if offered else None,
         'availability': ((len(admitted)) / float(offered))

@@ -62,7 +62,8 @@ __all__ = [
     'write_prometheus', 'write_jsonl', 'tensorboard_export',
     'PrometheusServer', 'maybe_start_http_server', 'parse_prometheus',
     'trainer_instruments', 'kv_instruments', 'dispatch_instruments',
-    'serving_instruments', 'dist_instruments', 'summary',
+    'serving_instruments', 'dist_instruments',
+    'gateway_instruments', 'summary',
 ]
 
 
@@ -79,6 +80,7 @@ _kv_inst = None
 _dispatch_inst = None
 _serving_inst = None
 _dist_inst = None
+_gateway_inst = None
 
 
 def trainer_instruments():
@@ -257,6 +259,55 @@ def serving_instruments():
                      'proposed)'),
         )
     return _serving_inst
+
+
+def gateway_instruments():
+    """Serving-gateway instruments (serving/gateway.py,
+    docs/DISTRIBUTED.md "Gateway"): routing health plus the
+    availability-layer counters PR-level drills gate on — mid-stream
+    resumes, prefix-affine routing decisions, and per-tenant
+    admission rejections. The flight recorder pairs them with
+    ``gateway_resume`` / ``gateway_failover`` / ``tenant_reject``
+    events so a resumed stream is explainable post-hoc."""
+    global _gateway_inst
+    if _gateway_inst is None:
+        _gateway_inst = _Instruments(
+            requests=counter('mxnet_tpu_gateway_requests_total',
+                             help='requests accepted for routing by '
+                                  'the gateway'),
+            failovers=counter(
+                'mxnet_tpu_gateway_failovers_total',
+                help='before-first-byte failovers to another healthy '
+                     'replica (transport failure, no bytes relayed)'),
+            resumes=counter(
+                'mxnet_tpu_gateway_resumes_total',
+                help='mid-stream resumes: a /generate stream '
+                     're-admitted on a healthy replica with '
+                     'prompt+emitted-tokens as the prefix'),
+            resume_failures=counter(
+                'mxnet_tpu_gateway_resume_failures_total',
+                help='streams aborted typed after exhausting the '
+                     'resume budget (MXNET_TPU_GATEWAY_RESUME_MAX)'),
+            resumed_tokens=counter(
+                'mxnet_tpu_gateway_resumed_tokens_total',
+                help='tokens spliced into client streams from a '
+                     'resume target (post-failover continuation)'),
+            affinity_routed=counter(
+                'mxnet_tpu_gateway_affinity_routed_total',
+                help='/generate requests routed by prompt-prefix '
+                     'fingerprint (rendezvous hash) instead of '
+                     'round-robin'),
+            tenant_rejected=counter(
+                'mxnet_tpu_gateway_tenant_rejected_total',
+                labels=('tenant', 'reason'),
+                help='per-tenant admission rejections (rate_limit / '
+                     'fair_share), each answered 429 + Retry-After'),
+            healthy_replicas=gauge(
+                'mxnet_tpu_gateway_healthy_replicas',
+                help='replicas currently in the gateway routing '
+                     'rotation'),
+        )
+    return _gateway_inst
 
 
 def dist_instruments():

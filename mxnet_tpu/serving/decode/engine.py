@@ -268,6 +268,10 @@ class DecodeEngine:
         self._op_seq = 0
         self._ema_step_s = None    # EWMA decode-step latency (hints)
         self._fallback_threads = []   # degraded completions in flight
+        # request_id -> newest GenerateStream: a re-admission under
+        # the same id (gateway mid-stream failover) cancels the prior
+        # stream so a resumed request never decodes twice
+        self._requests = {}
         self._counts = {'requests': 0, 'rejected': 0, 'tokens': 0,
                         'prefills': 0, 'steps': 0, 'timeouts': 0,
                         'fallback_tokens': 0, 'retired': {},
@@ -329,8 +333,14 @@ class DecodeEngine:
 
     # -- submission --------------------------------------------------------
 
-    def generate(self, tokens, max_new_tokens=None, eos_id=None):
+    def generate(self, tokens, max_new_tokens=None, eos_id=None,
+                 request_id=None):
         """Admit one prompt; returns its :class:`GenerateStream`.
+
+        ``request_id`` makes admission idempotent: a second admission
+        under the same id (the gateway re-admitting a stream after a
+        mid-stream failover) cancels the previous stream at the next
+        token boundary, so at most one decode works the request.
 
         Raises :class:`BackpressureError` when the pending queue is at
         depth, ``ValueError`` for an empty/over-long prompt (typed at
@@ -352,6 +362,7 @@ class DecodeEngine:
         seq = _Seq(stream, prompt, max_new, eos_id, now,
                    now + self.timeout_s if self.timeout_s else None)
         rejected_depth = None
+        superseded = None
         with self._lock:
             if self._closed:
                 raise BatcherClosed('decode engine %r is closed'
@@ -363,6 +374,16 @@ class DecodeEngine:
             else:
                 self._pending.append(seq)
                 self._counts['requests'] += 1
+                if request_id is not None:
+                    superseded = self._requests.get(request_id)
+                    self._requests[request_id] = stream
+                    # bound the map: finished streams age out once it
+                    # outgrows everything that can be in flight
+                    if len(self._requests) > 4 * (self.max_queue
+                                                  + self.slots):
+                        self._requests = {
+                            k: s for k, s in self._requests.items()
+                            if not s.done()}
                 self._wake.notify()
         # admission telemetry outside the lock (locklint LOCK-EMIT:
         # flight-recorder/metrics emits never extend a critical
@@ -374,6 +395,11 @@ class DecodeEngine:
             _record_event('serve_reject', reason='queue_full',
                           depth=rejected_depth, limit=self.max_queue)
             raise BackpressureError(rejected_depth, self.max_queue)
+        if superseded is not None and not superseded.done():
+            # at-most-once per request_id: retire the older stream at
+            # its next token boundary (cancel outside the lock — it
+            # only flips a flag, but keep the critical section lean)
+            superseded.cancel()
         inst = _serving_instruments()
         if inst is not None:
             inst.requests.inc()

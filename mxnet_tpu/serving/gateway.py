@@ -1,30 +1,51 @@
-"""ServingGateway: health-aware routing over per-host serving replicas.
+"""ServingGateway: the availability layer over per-host serving
+replicas.
 
-The multi-host serving story (docs/DISTRIBUTED.md "Gateway"): each
-host runs its own :class:`~.server.ServingHTTPServer` over its own
+The multi-host serving story (docs/DISTRIBUTED.md "Gateway",
+docs/SERVING.md "Gateway failover & multi-tenancy"): each host runs
+its own :class:`~.server.ServingHTTPServer` over its own
 ``InferenceSession``; the gateway fronts them all behind ONE address
-and owns exactly three concerns —
+and owns four concerns —
 
   * **health-aware routing** — a background probe polls every
     replica's ``/healthz`` each ``MXNET_TPU_GATEWAY_HEALTH_S``
-    seconds; a replica answering non-200 (breaker open, degraded
-    engine) or not answering at all leaves the rotation until its
-    probe recovers. Requests round-robin over the healthy set; an
-    in-flight connection error fails over to the next healthy replica
-    (idempotent one-shot ``/predict`` always; ``/generate`` only
-    before the first upstream byte) and marks the replica down
-    immediately, without waiting for the next probe tick.
-  * **typed degradation** — with SOME replicas down the gateway keeps
-    serving and ``/healthz`` reports ``degraded`` (200: load balancers
-    upstream of the gateway should keep it in service); with ALL
-    replicas down it sheds typed 503s carrying a ``Retry-After`` of
-    one health-probe period, so the loadgen SLO harness records an
-    availability dip instead of a hang.
-  * **backpressure passthrough** — a replica's 429 (and its
-    ``Retry-After`` estimate, docs/SERVING.md) passes through
-    verbatim: admission control stays where the queue knowledge lives;
-    the gateway never retries a 429 against another replica on its own
-    (the client owns backoff).
+    seconds, with a deterministic per-replica phase offset so N
+    replicas are never probed in lockstep (no thundering herd when
+    they all recover at once). A replica answering non-200 (breaker
+    open, degraded engine) or not answering at all leaves the
+    rotation until its probe recovers; an in-flight connection error
+    marks it down immediately.
+  * **prefix-affine routing** — ``/generate`` requests route by a
+    prompt-prefix fingerprint under rendezvous (highest-random-
+    weight) hashing over the healthy set
+    (``MXNET_TPU_GATEWAY_AFFINITY``): a shared system prompt keeps
+    landing on the replica whose PrefixCache already holds it, so
+    prefix hit rates survive scale-out, and only the keys owned by a
+    lost replica move when the set changes. ``/predict`` stays
+    round-robin.
+  * **mid-stream failover** — the gateway journals every streamed
+    token per ``/generate`` stream (prompt, emitted tokens, next
+    index). When a replica dies mid-stream — transport failure OR a
+    typed upstream abort line — it re-admits the request on a healthy
+    replica with prompt+emitted-tokens as the new prefix (a PrefixCache
+    hit makes the re-prefill nearly free), dedups by token index, and
+    splices the resumed tokens into the SAME client NDJSON chunked
+    stream: at-most-once delivery per index, greedy decode makes the
+    spliced sequence bit-identical to an unkilled run. Bounded by
+    ``MXNET_TPU_GATEWAY_RESUME_MAX`` attempts, then a typed
+    ``ReplicaLost`` abort line carrying the partial tokens. Off
+    (``MXNET_TPU_GATEWAY_RESUME=0``) restores the previous contract
+    exactly: failover only before the first byte; a mid-stream
+    transport death cuts the connection, a typed abort line relays
+    verbatim.
+  * **per-tenant admission** — token-bucket rate limiting plus a
+    weighted-fair in-flight share keyed on the
+    ``MXNET_TPU_GATEWAY_TENANT_HEADER`` header: a bursting tenant
+    sheds typed per-tenant 429s with a Retry-After naming its own
+    bucket's refill, and can borrow pool slack but never another
+    tenant's guaranteed share (``MXNET_TPU_GATEWAY_TENANT_*``). A
+    replica's own 429 (queue backpressure) still passes through
+    verbatim — admission stays where the queue knowledge lives.
 
 Streaming ``/generate`` responses (chunked NDJSON) forward line by
 line, so TTFT through the gateway tracks the replica's, not the full
@@ -33,13 +54,15 @@ opt-in posture as every other endpoint in the repo.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 import time
 import urllib.error
 import urllib.request
 
-__all__ = ['ReplicaState', 'ServingGateway']
+__all__ = ['ReplicaState', 'ServingGateway', 'TokenBucket',
+           'TenantAdmission', 'prefix_fingerprint', 'rendezvous_rank']
 
 _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding',
                 'te', 'trailer', 'upgrade', 'proxy-authorization',
@@ -55,11 +78,167 @@ def _knob(name, default):
         return default
 
 
+def _instruments():
+    try:
+        from .. import observability as _obs
+        if _obs.enabled():
+            return _obs.gateway_instruments()
+    except Exception:
+        pass
+    return None
+
+
+def _record_event(kind, **fields):
+    try:
+        from .. import observability as _obs
+        if _obs.enabled():
+            _obs.record_event(kind, **fields)
+    except Exception:
+        pass
+
+
+# -- prefix-affine routing (pure functions, unit-tested) -------------------
+
+def prefix_fingerprint(tokens):
+    """Stable fingerprint of a prompt's ROUTING prefix: everything but
+    the final token (the per-user suffix in the system-prompt workload
+    prefix sharing exists for), the whole prompt when it is a single
+    token. Same prefix, same fingerprint — the affinity key."""
+    toks = [int(t) for t in tokens]
+    core = toks[:-1] if len(toks) > 1 else toks
+    h = hashlib.blake2b(','.join(map(str, core)).encode(),
+                        digest_size=8)
+    return h.hexdigest()
+
+
+def rendezvous_rank(key, members):
+    """Rendezvous (highest-random-weight) order of ``members`` for
+    ``key``: each member scores hash(key | member); descending score.
+    Removing a member only moves the keys it owned — every other
+    key keeps its winner, which is exactly the stability PrefixCache
+    affinity needs across replica loss and scale-out."""
+    def score(member):
+        h = hashlib.blake2b(('%s|%s' % (key, member)).encode(),
+                            digest_size=8).digest()
+        return int.from_bytes(h, 'big')
+    return sorted(members, key=score, reverse=True)
+
+
+# -- per-tenant admission --------------------------------------------------
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``
+    capacity; :meth:`take` answers (admitted, retry_after_s) — the
+    hint names when THIS bucket next holds a whole token, so a shed
+    tenant backs off exactly as long as its own budget demands."""
+
+    def __init__(self, rate, burst, clock=time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._clock = clock
+        self._updated = clock()
+
+    def take(self, n=1.0):
+        now = self._clock()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self._updated)
+                          * self.rate)
+        self._updated = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True, 0.0
+        if self.rate <= 0:
+            return False, 60.0
+        return False, (n - self.tokens) / self.rate
+
+
+class TenantAdmission:
+    """Token-bucket + weighted-fair in-flight admission per tenant.
+
+    ``rps``/``burst`` bound each tenant's arrival RATE (0 disables
+    rate admission); ``max_inflight`` bounds the gateway-wide
+    CONCURRENCY, shared weighted-fair across the tenants currently
+    holding requests: every active tenant is guaranteed
+    ``weight/total_weight`` of the pool, and may exceed it only while
+    the pool has slack — so a burst queues behind its own share, not
+    everyone's. Thread-safe; hints are derived under the lock but all
+    telemetry is the caller's (locklint LOCK-EMIT)."""
+
+    def __init__(self, rps=0.0, burst=None, max_inflight=0,
+                 weights=None, clock=time.monotonic):
+        self.rps = float(rps)
+        self.burst = float(burst) if burst else max(1.0,
+                                                    2.0 * self.rps)
+        self.max_inflight = int(max_inflight)
+        self.weights = dict(weights or {})
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets = {}
+        self._inflight = {}        # tenant -> live request count
+        self._shed = {}            # tenant -> {reason: n}
+        self._admitted = {}
+
+    def _weight(self, tenant):
+        return float(self.weights.get(tenant, 1.0))
+
+    def _fair_share(self, tenant):
+        active = {t for t, n in self._inflight.items() if n > 0}
+        active.add(tenant)
+        total_w = sum(self._weight(t) for t in active)
+        return max(1.0, self.max_inflight * self._weight(tenant)
+                   / total_w)
+
+    def admit(self, tenant):
+        """(admitted, retry_after_s, reason). On True the caller MUST
+        :meth:`release` when the request finishes."""
+        with self._lock:
+            if self.rps > 0:
+                bucket = self._buckets.get(tenant)
+                if bucket is None:
+                    bucket = self._buckets[tenant] = TokenBucket(
+                        self.rps, self.burst, clock=self._clock)
+                ok, hint = bucket.take()
+                if not ok:
+                    shed = self._shed.setdefault(tenant, {})
+                    shed['rate_limit'] = shed.get('rate_limit', 0) + 1
+                    return False, hint, 'rate_limit'
+            if self.max_inflight > 0:
+                mine = self._inflight.get(tenant, 0)
+                total = sum(self._inflight.values())
+                if mine >= self._fair_share(tenant) \
+                        and total >= self.max_inflight:
+                    shed = self._shed.setdefault(tenant, {})
+                    shed['fair_share'] = shed.get('fair_share', 0) + 1
+                    hint = 1.0 / self.rps if self.rps > 0 else 0.5
+                    return False, hint, 'fair_share'
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+            self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+            return True, 0.0, None
+
+    def release(self, tenant):
+        with self._lock:
+            n = self._inflight.get(tenant, 0)
+            if n <= 1:
+                self._inflight.pop(tenant, None)
+            else:
+                self._inflight[tenant] = n - 1
+
+    def stats(self):
+        with self._lock:
+            return {t: {'admitted': self._admitted.get(t, 0),
+                        'inflight': self._inflight.get(t, 0),
+                        'shed': dict(self._shed.get(t, {}))}
+                    for t in (set(self._admitted)
+                              | set(self._inflight)
+                              | set(self._shed))}
+
+
 class ReplicaState:
     """One upstream replica: base URL + live health view."""
 
     __slots__ = ('base_url', 'healthy', 'last_error', 'last_checked',
-                 'transitions')
+                 'transitions', 'next_probe_at')
 
     def __init__(self, base_url):
         self.base_url = base_url.rstrip('/')
@@ -67,6 +246,7 @@ class ReplicaState:
         self.last_error = None
         self.last_checked = 0.0
         self.transitions = 0
+        self.next_probe_at = 0.0     # staggered probe schedule (mono)
 
     def mark(self, healthy, error=None):
         if healthy != self.healthy:
@@ -81,12 +261,21 @@ class ReplicaState:
                 'transitions': self.transitions}
 
 
+def _probe_jitter_frac(url):
+    """Deterministic per-replica jitter in [0, 1): a hash of the URL,
+    so the same fleet gets the same stagger every restart (replayable
+    probe timelines) without any two replicas sharing a phase."""
+    h = hashlib.blake2b(url.encode(), digest_size=4).digest()
+    return int.from_bytes(h, 'big') / 2.0 ** 32
+
+
 class ServingGateway:
     """Front N serving replicas behind one HTTP address.
 
     ``replicas``: iterable of base URLs (``http://127.0.0.1:8471``).
     ``port`` 0 picks a free port. ``health_period_s`` /
-    ``timeout_s`` default from the ``MXNET_TPU_GATEWAY_*`` knobs.
+    ``timeout_s`` / ``resume`` / ``resume_max`` / ``affinity`` /
+    ``tenant_*`` default from the ``MXNET_TPU_GATEWAY_*`` knobs.
 
     Routes::
 
@@ -97,11 +286,16 @@ class ServingGateway:
                         /status payload (or its error)
         GET  /replicas  the routing table with health + transitions
         POST /predict   forwarded to the next healthy replica
-        POST /generate  forwarded; chunked NDJSON streams line-by-line
+        POST /generate  forwarded prefix-affine; chunked NDJSON
+                        streams line-by-line, resumed across replica
+                        loss when MXNET_TPU_GATEWAY_RESUME is on
     """
 
     def __init__(self, replicas, port=None, host='127.0.0.1',
-                 health_period_s=None, timeout_s=None):
+                 health_period_s=None, timeout_s=None, resume=None,
+                 resume_max=None, affinity=None, tenant_header=None,
+                 tenant_rps=None, tenant_burst=None,
+                 tenant_max_inflight=None, tenant_weights=None):
         urls = list(replicas)
         if not urls:
             raise ValueError('gateway needs at least one replica URL')
@@ -117,49 +311,81 @@ class ServingGateway:
         self.timeout_s = float(
             timeout_s if timeout_s is not None
             else _knob('MXNET_TPU_GATEWAY_TIMEOUT_S', 30.0))
+        self.resume = bool(
+            resume if resume is not None
+            else _knob('MXNET_TPU_GATEWAY_RESUME', True))
+        self.resume_max = int(
+            resume_max if resume_max is not None
+            else _knob('MXNET_TPU_GATEWAY_RESUME_MAX', 2))
+        self.affinity = bool(
+            affinity if affinity is not None
+            else _knob('MXNET_TPU_GATEWAY_AFFINITY', True))
+        self.tenant_header = str(
+            tenant_header if tenant_header is not None
+            else _knob('MXNET_TPU_GATEWAY_TENANT_HEADER', 'X-Tenant'))
+        tenant_rps = float(
+            tenant_rps if tenant_rps is not None
+            else _knob('MXNET_TPU_GATEWAY_TENANT_RPS', 0.0))
+        tenant_burst = float(
+            tenant_burst if tenant_burst is not None
+            else _knob('MXNET_TPU_GATEWAY_TENANT_BURST', 0.0))
+        tenant_max_inflight = int(
+            tenant_max_inflight if tenant_max_inflight is not None
+            else _knob('MXNET_TPU_GATEWAY_TENANT_MAX_INFLIGHT', 0))
+        self.admission = None
+        if tenant_rps > 0 or tenant_max_inflight > 0:
+            self.admission = TenantAdmission(
+                rps=tenant_rps, burst=tenant_burst or None,
+                max_inflight=tenant_max_inflight,
+                weights=tenant_weights)
         self._rr = 0
         self._rr_lock = threading.Lock()
+        self._request_seq = 0
         self._httpd = None
         self._thread = None
         self._probe_thread = None
         self._probe_stop = None
         self._stats = {'requests': 0, 'failovers': 0, 'shed': 0,
-                       'passthrough_429': 0}
+                       'passthrough_429': 0, 'resumes': 0,
+                       'resume_failures': 0, 'affinity_routed': 0,
+                       'tenant_shed': 0}
         self._stats_lock = threading.Lock()
 
     # -- health ------------------------------------------------------------
 
+    def _probe_replica(self, rep):
+        """One /healthz probe against one replica; updates its mark."""
+        try:
+            req = urllib.request.Request(rep.base_url + '/healthz')
+            with urllib.request.urlopen(
+                    req, timeout=min(self.timeout_s,
+                                     max(1.0,
+                                         self.health_period_s * 3))
+            ) as resp:
+                ok = resp.status == 200
+                rep.mark(ok, None if ok
+                         else 'healthz %d' % resp.status)
+        except urllib.error.HTTPError as exc:
+            rep.mark(False, 'healthz %d' % exc.code)
+        except Exception as exc:
+            rep.mark(False, '%s: %s' % (type(exc).__name__, exc))
+
     def probe_once(self):
-        """Probe every replica's /healthz once (also called by the
-        background loop); returns the number currently healthy."""
+        """Probe every replica's /healthz once (startup + tests; the
+        background loop staggers them); returns the healthy count."""
         for rep in self.replicas:
-            try:
-                req = urllib.request.Request(rep.base_url + '/healthz')
-                with urllib.request.urlopen(
-                        req, timeout=min(self.timeout_s,
-                                         max(1.0,
-                                             self.health_period_s * 3))
-                ) as resp:
-                    ok = resp.status == 200
-                    rep.mark(ok, None if ok
-                             else 'healthz %d' % resp.status)
-            except urllib.error.HTTPError as exc:
-                rep.mark(False, 'healthz %d' % exc.code)
-            except Exception as exc:
-                rep.mark(False, '%s: %s' % (type(exc).__name__, exc))
+            self._probe_replica(rep)
         healthy = sum(1 for r in self.replicas if r.healthy)
         self._note_health(healthy)
         return healthy
 
     def _note_health(self, healthy):
-        try:
-            from .. import observability as _obs
-            if _obs.enabled():
-                _obs.gauge('mxnet_tpu_gateway_healthy_replicas',
-                           help='replicas currently in the gateway '
-                                'routing rotation').set(healthy)
-        except Exception:
-            pass
+        inst = _instruments()
+        if inst is not None:
+            try:
+                inst.healthy_replicas.set(healthy)
+            except Exception:
+                pass
 
     def healthy_replicas(self):
         return [r for r in self.replicas if r.healthy]
@@ -175,17 +401,57 @@ class ServingGateway:
             self._rr += 1
             return rep
 
+    def _route(self, fingerprint, exclude=()):
+        """Prefix-affine pick when a fingerprint is given (rendezvous
+        hash over the healthy set: stable under replica loss), else
+        round-robin."""
+        if fingerprint is not None:
+            candidates = [r for r in self.replicas
+                          if r.healthy and r not in exclude]
+            if candidates:
+                by_url = {r.base_url: r for r in candidates}
+                winner = rendezvous_rank(fingerprint,
+                                         sorted(by_url))[0]
+                self._bump('affinity_routed')
+                inst = _instruments()
+                if inst is not None:
+                    inst.affinity_routed.inc()
+                return by_url[winner]
+        return self._pick(exclude)
+
+    def affinity_target(self, tokens):
+        """The replica URL a prompt would route to right now (healthy
+        set + rendezvous hash), or None. Drill/test helper — the
+        kill-mid-stream harness uses it to aim at the serving
+        replica."""
+        fp = prefix_fingerprint(tokens)
+        healthy = sorted(r.base_url for r in self.replicas
+                         if r.healthy)
+        if not healthy:
+            return None
+        return rendezvous_rank(fp, healthy)[0]
+
     # -- forwarding --------------------------------------------------------
 
-    def _bump(self, key):
+    def _bump(self, key, n=1):
         with self._stats_lock:
-            self._stats[key] += 1
+            self._stats[key] += n
 
-    def _forward(self, rep, path, body, content_type):
+    def _next_request_id(self):
+        # port is fixed by start() before any request flows — only the
+        # sequence counter needs the lock
+        port = self.port
+        with self._stats_lock:
+            self._request_seq += 1
+            seq = self._request_seq
+        return 'gw%d-%d' % (port, seq)
+
+    def _forward(self, rep, path, body, content_type, tenant=None):
+        headers = {'Content-Type': content_type or 'application/json'}
+        if tenant is not None:
+            headers[self.tenant_header] = tenant
         req = urllib.request.Request(
-            rep.base_url + path, data=body,
-            headers={'Content-Type': content_type or
-                     'application/json'},
+            rep.base_url + path, data=body, headers=headers,
             method='POST')
         return urllib.request.urlopen(req, timeout=self.timeout_s)
 
@@ -209,6 +475,7 @@ class ServingGateway:
             return self
         from http.server import BaseHTTPRequestHandler, \
             ThreadingHTTPServer
+        import http.client as _hc
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -244,7 +511,7 @@ class ServingGateway:
                     handler._json(200, {
                         'replicas': [r.as_dict()
                                      for r in gw.replicas],
-                        'stats': dict(gw._stats)})
+                        'stats': gw.stats()})
                 elif path == '/status':
                     statuses = {}
                     for rep in gw.replicas:
@@ -260,9 +527,11 @@ class ServingGateway:
                               'unavailable'),
                         'healthy': healthy,
                         'replicas': statuses,
-                        'stats': dict(gw._stats)})
+                        'stats': gw.stats()})
                 else:
                     handler.send_error(404)
+
+            # -- plain relay (predict, non-journaled generate) -----------
 
             def _relay_response(handler, resp, streaming):
                 """Copy an upstream response to the client; chunked
@@ -294,34 +563,34 @@ class ServingGateway:
                     handler.end_headers()
                     handler.wfile.write(body)
 
-            def do_POST(handler):
-                path = handler.path.rstrip('/')
-                if path not in ('/predict', '/generate'):
-                    handler.send_error(404)
-                    return
-                gw._bump('requests')
-                length = int(handler.headers.get('Content-Length',
-                                                 0) or 0)
-                body = handler.rfile.read(length) if length else b'{}'
-                ctype = handler.headers.get('Content-Type')
+            def _shed_no_replica(handler, tried):
+                gw._bump('shed')
+                hint = max(1, int(gw.health_period_s + 0.999))
+                handler._json(
+                    503,
+                    {'error': 'no healthy serving replica '
+                              '(%d configured, %d tried)'
+                              % (len(gw.replicas), len(tried)),
+                     'retry_after_s': hint},
+                    headers={'Retry-After': str(hint)})
+
+            def _forward_plain(handler, path, body, ctype, tenant,
+                               fingerprint=None):
+                """The pre-resume forwarding contract: fail over only
+                before the first upstream byte; a mid-stream transport
+                death cuts the client connection, a typed upstream
+                abort line relays verbatim. /predict always takes this
+                path, /generate does when resume is off."""
                 tried = []
                 while True:
-                    rep = gw._pick(exclude=tried)
+                    rep = gw._route(fingerprint, exclude=tried)
                     if rep is None:
-                        gw._bump('shed')
-                        hint = max(1, int(gw.health_period_s + 0.999))
-                        handler._json(
-                            503,
-                            {'error': 'no healthy serving replica '
-                                      '(%d configured, %d tried)'
-                                      % (len(gw.replicas),
-                                         len(tried)),
-                             'retry_after_s': hint},
-                            headers={'Retry-After': str(hint)})
+                        handler._shed_no_replica(tried)
                         return
                     tried.append(rep)
                     try:
-                        resp = gw._forward(rep, path, body, ctype)
+                        resp = gw._forward(rep, path, body, ctype,
+                                           tenant=tenant)
                     except urllib.error.HTTPError as exc:
                         # a typed upstream error (429/504/503/500/400)
                         # passes through verbatim — incl. Retry-After,
@@ -338,10 +607,12 @@ class ServingGateway:
                         rep.mark(False, '%s: %s'
                                  % (type(exc).__name__, exc))
                         gw._bump('failovers')
+                        inst = _instruments()
+                        if inst is not None:
+                            inst.failovers.inc()
                         gw._note_health(
                             len(gw.healthy_replicas()))
                         continue
-                    import http.client as _hc
                     try:
                         with resp:
                             handler._relay_response(
@@ -360,6 +631,320 @@ class ServingGateway:
                     except OSError:
                         return       # client went away mid-stream
                     return
+
+            # -- journaled streaming generate (mid-stream failover) ------
+
+            def _chunk_line(handler, line):
+                handler.wfile.write(b'%x\r\n' % len(line))
+                handler.wfile.write(line + b'\r\n')
+                handler.wfile.flush()
+
+            def _chunk_obj(handler, obj):
+                handler._chunk_line(
+                    (json.dumps(obj, sort_keys=True) + '\n').encode())
+
+            def _end_chunks(handler):
+                try:
+                    handler.wfile.write(b'0\r\n\r\n')
+                    handler.wfile.flush()
+                except OSError:
+                    pass
+
+            def _generate_resumable(handler, req, ctype, tenant,
+                                    fingerprint):
+                """Streamed /generate with the per-stream journal:
+                relay token lines while recording them; on replica
+                death re-admit prompt+emitted on a healthy replica and
+                splice the continuation into the SAME client chunked
+                stream, deduping by token index (at-most-once)."""
+                prompt = [int(t) for t in req['tokens']]
+                orig_max_new = req.get('max_new_tokens')
+                if orig_max_new is not None:
+                    orig_max_new = int(orig_max_new)
+                request_id = req.get('request_id') \
+                    or gw._next_request_id()
+                emitted = []        # journal: token values relayed
+                attempts = 0        # resume attempts consumed
+                started = False     # client headers sent
+                tried = []          # replicas tried for this segment
+                while True:
+                    rep = gw._route(fingerprint, exclude=tried)
+                    if rep is None:
+                        if not started:
+                            handler._shed_no_replica(tried)
+                        else:
+                            gw._bump('resume_failures')
+                            inst = _instruments()
+                            if inst is not None:
+                                inst.resume_failures.inc()
+                            _record_event(
+                                'gateway_resume_failed',
+                                request_id=request_id,
+                                attempts=attempts,
+                                reason='no_healthy_replica',
+                                tokens=len(emitted))
+                            try:
+                                handler._chunk_obj({
+                                    'done': True,
+                                    'error': 'no healthy serving '
+                                             'replica to resume '
+                                             'stream (%d tokens '
+                                             'emitted, %d resume '
+                                             'attempts)'
+                                             % (len(emitted),
+                                                attempts),
+                                    'error_class': 'ReplicaLost',
+                                    'tokens': list(emitted),
+                                    'resumed': attempts,
+                                    'request_id': request_id})
+                            except OSError:
+                                return
+                            handler._end_chunks()
+                        return
+                    tried.append(rep)
+                    payload = dict(req, request_id=request_id)
+                    if emitted:
+                        payload['tokens'] = prompt + emitted
+                        payload['start_index'] = len(emitted)
+                        if orig_max_new is not None:
+                            payload['max_new_tokens'] = \
+                                orig_max_new - len(emitted)
+                    body = json.dumps(payload).encode()
+                    try:
+                        resp = gw._forward(rep, '/generate', body,
+                                           ctype, tenant=tenant)
+                    except urllib.error.HTTPError as exc:
+                        if not started:
+                            if exc.code in (500, 502, 503):
+                                # a typed 5xx at admission (e.g. the
+                                # engine closing under the request on
+                                # a dying host): zero bytes relayed,
+                                # so trying another replica is safe —
+                                # the health probe will catch up
+                                try:
+                                    exc.read()
+                                except Exception:
+                                    pass
+                                gw._bump('failovers')
+                                inst = _instruments()
+                                if inst is not None:
+                                    inst.failovers.inc()
+                                continue
+                            # before any client byte: the verbatim
+                            # passthrough contract (429 backpressure
+                            # stays the replica's call, 4xx/504 are
+                            # the client's problem)
+                            if exc.code == 429:
+                                gw._bump('passthrough_429')
+                            handler._relay_response(exc,
+                                                    streaming=False)
+                            return
+                        # typed refusal of a RESUME re-admission
+                        # (e.g. the target's queue is full): try the
+                        # next healthy replica for this segment
+                        try:
+                            exc.read()
+                        except Exception:
+                            pass
+                        continue
+                    except Exception as exc:
+                        # transport failure before the segment's first
+                        # byte: mark down + try the next replica
+                        rep.mark(False, '%s: %s'
+                                 % (type(exc).__name__, exc))
+                        gw._bump('failovers')
+                        inst = _instruments()
+                        if inst is not None:
+                            inst.failovers.inc()
+                        gw._note_health(len(gw.healthy_replicas()))
+                        continue
+                    if not started:
+                        handler.send_response(resp.status)
+                        handler.send_header(
+                            'Content-Type',
+                            resp.headers.get('Content-Type',
+                                             'application/x-ndjson'))
+                        handler.send_header('Transfer-Encoding',
+                                            'chunked')
+                        handler.end_headers()
+                        started = True
+                    segment_tokens = 0
+                    abort_line = None       # typed upstream abort obj
+                    dead = False            # transport death
+                    done = False            # clean final line relayed
+                    try:
+                        with resp:
+                            for line in resp:
+                                if not line.strip():
+                                    continue
+                                try:
+                                    obj = json.loads(line)
+                                except ValueError:
+                                    handler._chunk_line(
+                                        line.rstrip(b'\n')
+                                        + b'\n')
+                                    continue
+                                if 'token' in obj:
+                                    idx = obj.get('index')
+                                    if idx is not None \
+                                            and idx < len(emitted):
+                                        continue   # dedup: delivered
+                                    emitted.append(obj['token'])
+                                    segment_tokens += 1
+                                    handler._chunk_line(
+                                        line.rstrip(b'\n') + b'\n')
+                                elif obj.get('done'):
+                                    if obj.get('error'):
+                                        abort_line = obj
+                                    else:
+                                        if attempts:
+                                            obj['tokens'] = \
+                                                list(emitted)
+                                            obj['resumed'] = attempts
+                                            obj['request_id'] = \
+                                                request_id
+                                            handler._chunk_obj(obj)
+                                        else:
+                                            handler._chunk_line(
+                                                line.rstrip(b'\n')
+                                                + b'\n')
+                                        done = True
+                                    break
+                                else:
+                                    handler._chunk_line(
+                                        line.rstrip(b'\n') + b'\n')
+                    except _hc.HTTPException as exc:
+                        rep.mark(False, '%s: %s'
+                                 % (type(exc).__name__, exc))
+                        gw._note_health(len(gw.healthy_replicas()))
+                        dead = True
+                    except OSError:
+                        return     # client went away mid-stream
+                    if done:
+                        if attempts and segment_tokens:
+                            inst = _instruments()
+                            if inst is not None:
+                                inst.resumed_tokens.inc(
+                                    segment_tokens)
+                        handler._end_chunks()
+                        return
+                    if not dead and abort_line is None:
+                        # stream ended without a done line: the
+                        # replica terminated the chunks while dying —
+                        # same treatment as a transport death
+                        rep.mark(False, 'stream truncated (no done '
+                                        'line)')
+                        gw._note_health(len(gw.healthy_replicas()))
+                        dead = True
+                    # the segment failed (typed abort OR transport
+                    # death). Resume on a healthy replica while the
+                    # budget lasts; past it, surface the typed abort.
+                    if attempts < gw.resume_max:
+                        attempts += 1
+                        gw._bump('resumes')
+                        inst = _instruments()
+                        if inst is not None:
+                            inst.resumes.inc()
+                        _record_event(
+                            'gateway_resume',
+                            request_id=request_id,
+                            attempt=attempts,
+                            from_url=rep.base_url,
+                            cause='transport' if dead else str(
+                                abort_line.get('error_class')
+                                or 'error'),
+                            tokens=len(emitted))
+                        tried = [rep]
+                        continue
+                    gw._bump('resume_failures')
+                    inst = _instruments()
+                    if inst is not None:
+                        inst.resume_failures.inc()
+                    _record_event('gateway_resume_failed',
+                                  request_id=request_id,
+                                  attempts=attempts,
+                                  reason='budget_exhausted',
+                                  tokens=len(emitted))
+                    out = dict(abort_line) if abort_line is not None \
+                        else {'done': True,
+                              'error': 'replica lost mid-stream '
+                                       '(resume budget exhausted '
+                                       'after %d attempts)'
+                                       % attempts,
+                              'error_class': 'ReplicaLost'}
+                    out['tokens'] = list(emitted)
+                    out['resumed'] = attempts
+                    out['request_id'] = request_id
+                    try:
+                        handler._chunk_obj(out)
+                    except OSError:
+                        return
+                    handler._end_chunks()
+                    return
+
+            def do_POST(handler):
+                path = handler.path.rstrip('/')
+                if path not in ('/predict', '/generate'):
+                    handler.send_error(404)
+                    return
+                gw._bump('requests')
+                inst = _instruments()
+                if inst is not None:
+                    inst.requests.inc()
+                length = int(handler.headers.get('Content-Length',
+                                                 0) or 0)
+                body = handler.rfile.read(length) if length else b'{}'
+                ctype = handler.headers.get('Content-Type')
+                tenant = (handler.headers.get(gw.tenant_header)
+                          or 'default').strip() or 'default'
+                admitted = None
+                if gw.admission is not None:
+                    ok, hint, reason = gw.admission.admit(tenant)
+                    if not ok:
+                        gw._bump('tenant_shed')
+                        if inst is not None:
+                            inst.tenant_rejected.labels(
+                                tenant=tenant, reason=reason).inc()
+                        _record_event('tenant_reject', tenant=tenant,
+                                      reason=reason,
+                                      retry_after_s=round(hint, 3))
+                        handler._json(
+                            429,
+                            {'error': 'tenant admission: %s' % reason,
+                             'tenant': tenant,
+                             'retry_after_s': round(hint, 3)},
+                            headers={'Retry-After':
+                                     str(max(1, int(hint + 0.999)))})
+                        return
+                    admitted = tenant
+                try:
+                    req = None
+                    if path == '/generate':
+                        try:
+                            req = json.loads(body or b'{}')
+                        except ValueError:
+                            req = None    # replica answers the 400
+                    fingerprint = None
+                    if gw.affinity and isinstance(req, dict) \
+                            and req.get('tokens'):
+                        try:
+                            fingerprint = prefix_fingerprint(
+                                req['tokens'])
+                        except (TypeError, ValueError):
+                            fingerprint = None
+                    if (path == '/generate' and gw.resume
+                            and isinstance(req, dict)
+                            and req.get('tokens')
+                            and req.get('stream', True)):
+                        handler._generate_resumable(
+                            req, ctype, tenant, fingerprint)
+                    else:
+                        handler._forward_plain(
+                            path, body, ctype, tenant,
+                            fingerprint=fingerprint)
+                finally:
+                    if admitted is not None:
+                        gw.admission.release(admitted)
 
             def log_message(handler, *args):
                 pass
@@ -386,11 +971,34 @@ class ServingGateway:
         stop = threading.Event()
 
         def probe_loop():
-            while not stop.wait(self.health_period_s):
-                try:
-                    self.probe_once()
-                except Exception:
-                    pass          # a probe bug must not kill routing
+            # staggered schedule: replica i's probes fire at phase
+            # ((i + jitter(url)) / N) x period — N replicas spread
+            # across the period instead of N simultaneous probes
+            # every tick (the recovery thundering-herd)
+            period = self.health_period_s
+            n = len(self.replicas)
+            base = time.monotonic()
+            for i, rep in enumerate(self.replicas):
+                rep.next_probe_at = base + period * (
+                    (i + _probe_jitter_frac(rep.base_url)) / n)
+            while True:
+                due_at = min(r.next_probe_at for r in self.replicas)
+                if stop.wait(max(0.0, due_at - time.monotonic())):
+                    return
+                now = time.monotonic()
+                probed = False
+                for rep in self.replicas:
+                    if rep.next_probe_at <= now:
+                        try:
+                            self._probe_replica(rep)
+                        except Exception:
+                            pass   # a probe bug must not kill routing
+                        # re-arm one period after THIS fire: the
+                        # per-replica phase offsets persist
+                        rep.next_probe_at = now + period
+                        probed = True
+                if probed:
+                    self._note_health(len(self.healthy_replicas()))
 
         self._probe_stop = stop
         self._probe_thread = threading.Thread(
@@ -408,6 +1016,8 @@ class ServingGateway:
             out = dict(self._stats)
         out['healthy'] = len(self.healthy_replicas())
         out['replicas'] = len(self.replicas)
+        if self.admission is not None:
+            out['tenants'] = self.admission.stats()
         return out
 
     def stop(self):

@@ -234,17 +234,20 @@ class InferenceSession:
         seq = self._next_seq()
         return self._serve(list(arrays), n, seq)
 
-    def generate(self, tokens, max_new_tokens=None, eos_id=None):
+    def generate(self, tokens, max_new_tokens=None, eos_id=None,
+                 request_id=None):
         """Stream a generation: returns a
         :class:`~.decode.GenerateStream` (iterate per-token, or
         ``.result(timeout)`` for the full sequence). Decode-mode
-        sessions only."""
+        sessions only. ``request_id`` makes re-admission idempotent
+        (the gateway's mid-stream failover contract)."""
         if self._engine is None:
             raise TypeError('generate() needs a DecodeProgram session '
                             '(use serving.freeze_decode)')
         return self._engine.generate(tokens,
                                      max_new_tokens=max_new_tokens,
-                                     eos_id=eos_id)
+                                     eos_id=eos_id,
+                                     request_id=request_id)
 
     # -- batched execution (batcher worker thread) -------------------------
 
@@ -554,18 +557,36 @@ class ServingHTTPServer:
                 if not tokens:
                     handler._json(400, {'error': "need 'tokens'"})
                     return
-                stream = gen.generate(
-                    tokens,
-                    max_new_tokens=req.get('max_new_tokens'),
-                    eos_id=req.get('eos_id'))
+                # resume plumbing (gateway mid-stream failover):
+                # start_index offsets the streamed token indices so a
+                # spliced continuation keeps the client's numbering,
+                # request_id dedups re-admissions engine-side and is
+                # echoed on the done line
+                try:
+                    start_index = int(req.get('start_index', 0) or 0)
+                except (TypeError, ValueError):
+                    handler._json(400,
+                                  {'error': "bad 'start_index'"})
+                    return
+                request_id = req.get('request_id')
+                # request_id rides as a kwarg only when the caller
+                # sent one: duck-typed sessions predating it keep
+                # working
+                kwargs = {'max_new_tokens': req.get('max_new_tokens'),
+                          'eos_id': req.get('eos_id')}
+                if request_id is not None:
+                    kwargs['request_id'] = request_id
+                stream = gen.generate(tokens, **kwargs)
                 wait_s = (gen._engine.timeout_s
                           or _HTTP_MAX_WAIT_S)
                 if not req.get('stream', True):
                     toks = stream.result(wait_s)
-                    handler._json(200, {
-                        'tokens': toks,
-                        'finish_reason': stream.finish_reason,
-                        'degraded': stream.degraded})
+                    done = {'tokens': toks,
+                            'finish_reason': stream.finish_reason,
+                            'degraded': stream.degraded}
+                    if request_id is not None:
+                        done['request_id'] = request_id
+                    handler._json(200, done)
                     return
                 handler.send_response(200)
                 handler.send_header('Content-Type',
@@ -574,12 +595,15 @@ class ServingHTTPServer:
                 handler.end_headers()
                 try:
                     for i, tok in enumerate(stream):
-                        handler._chunk({'token': tok, 'index': i})
-                    handler._chunk({'done': True,
-                                    'tokens': stream.tokens,
-                                    'finish_reason':
-                                        stream.finish_reason,
-                                    'degraded': stream.degraded})
+                        handler._chunk({'token': tok,
+                                        'index': start_index + i})
+                    done = {'done': True,
+                            'tokens': stream.tokens,
+                            'finish_reason': stream.finish_reason,
+                            'degraded': stream.degraded}
+                    if request_id is not None:
+                        done['request_id'] = request_id
+                    handler._chunk(done)
                 except OSError:
                     # client went away mid-stream: retire the
                     # sequence so it stops occupying a decode slot,

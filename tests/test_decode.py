@@ -310,6 +310,32 @@ def test_engine_eos_retires_early():
         eng.close()
 
 
+def test_engine_request_id_readmission_supersedes():
+    """Idempotent re-admission (gateway mid-stream failover): a second
+    generate under the same request_id becomes the id's live stream
+    and the superseded one retires at its next token boundary —
+    at-most-once engine-side."""
+    eng = DecodeEngine(_FakeProgram(), timeout_s=10.0)
+    try:
+        first = eng.generate([1, 2, 3], max_new_tokens=40,
+                             request_id='gw1-1')
+        second = eng.generate([1, 2, 3, 4], max_new_tokens=5,
+                              request_id='gw1-1')
+        assert eng._requests['gw1-1'] is second
+        assert second.result(10) == _expected([1, 2, 3, 4], 5)
+        first.result(10)
+        # cancelled at a token boundary, or already finished — never
+        # left running as a zombie under the same id
+        assert first.finish_reason in ('cancelled', 'length')
+        # distinct ids stay independent
+        third = eng.generate([2, 2], max_new_tokens=3,
+                             request_id='gw1-2')
+        assert third.result(10) == _expected([2, 2], 3)
+        assert second.finish_reason == 'length'
+    finally:
+        eng.close()
+
+
 def test_engine_join_leave_isolation_and_slot_reuse():
     """Sequences joining/leaving mid-stream never perturb the others,
     and more sequences than slots complete by reusing retired slots."""

@@ -1,0 +1,546 @@
+"""Serving-gateway robustness tests (mxnet_tpu/serving/gateway.py,
+docs/SERVING.md "Gateway failover & multi-tenancy"): the routing and
+admission primitives as pure units, the mid-stream failover contract
+against fake autoregressive NDJSON replicas (resume splice, dedup by
+token index, budget-exhausted typed abort, resume-off passthrough),
+per-tenant admission over real HTTP, and — slow tier — the
+kill-replica-mid-stream drill on the real rig asserting zero
+client-visible error lines and bit-identical token streams."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from mxnet_tpu.serving.gateway import (ServingGateway, TenantAdmission,
+                                       TokenBucket, _probe_jitter_frac,
+                                       prefix_fingerprint,
+                                       rendezvous_rank)
+
+
+# ---------------------------------------------------------------------------
+# routing + admission primitives (pure units)
+# ---------------------------------------------------------------------------
+
+def test_prefix_fingerprint_keys_on_all_but_last_token():
+    shared = [7, 3, 9, 12, 4]
+    fp_a = prefix_fingerprint(shared + [1])
+    fp_b = prefix_fingerprint(shared + [2])
+    assert fp_a == fp_b          # per-user suffix must not split routing
+    assert prefix_fingerprint([8] + shared[1:] + [1]) != fp_a
+    assert prefix_fingerprint([5]) == prefix_fingerprint([5])
+
+
+def test_rendezvous_removing_member_only_moves_its_keys():
+    members = ['http://h0', 'http://h1', 'http://h2', 'http://h3']
+    keys = [prefix_fingerprint([i, i + 1, i + 2]) for i in range(200)]
+    before = {k: rendezvous_rank(k, members)[0] for k in keys}
+    lost = 'http://h2'
+    survivors = [m for m in members if m != lost]
+    moved = 0
+    for k in keys:
+        after = rendezvous_rank(k, survivors)[0]
+        if before[k] == lost:
+            moved += 1
+        else:
+            assert after == before[k], \
+                'key not owned by the lost member moved'
+    assert 0 < moved < len(keys)
+
+
+def test_rendezvous_order_is_a_permutation():
+    members = ['a', 'b', 'c']
+    order = rendezvous_rank('key', members)
+    assert sorted(order) == sorted(members)
+
+
+def test_token_bucket_math_with_fake_clock():
+    now = [0.0]
+    b = TokenBucket(rate=2.0, burst=2.0, clock=lambda: now[0])
+    assert b.take() == (True, 0.0)
+    assert b.take() == (True, 0.0)
+    ok, hint = b.take()
+    assert not ok and hint == pytest.approx(0.5)
+    now[0] += 0.5                       # one token refilled
+    assert b.take() == (True, 0.0)
+    ok, hint = b.take()
+    assert not ok and hint == pytest.approx(0.5)
+
+
+def test_token_bucket_zero_rate_never_fills():
+    b = TokenBucket(rate=0.0, burst=1.0, clock=lambda: 0.0)
+    assert b.take() == (True, 0.0)      # the initial burst
+    ok, hint = b.take()
+    assert not ok and hint == 60.0
+
+
+def test_tenant_admission_fair_share_and_release():
+    adm = TenantAdmission(rps=0.0, max_inflight=4, clock=lambda: 0.0)
+    for _ in range(3):
+        ok, _h, _r = adm.admit('burst')
+        assert ok
+    ok, _h, _r = adm.admit('steady')    # pool has slack: admitted
+    assert ok
+    # pool full AND burst past its half share: shed with a reason
+    ok, hint, reason = adm.admit('burst')
+    assert not ok and reason == 'fair_share' and hint > 0
+    # steady is under ITS share even with the pool full
+    ok, _h, _r = adm.admit('steady')
+    assert ok
+    adm.release('burst')
+    # burst still AT its share with the pool full: shed again
+    ok, _h, reason = adm.admit('burst')
+    assert not ok and reason == 'fair_share'
+    adm.release('burst')
+    ok, _h, _r = adm.admit('burst')     # pool has slack again
+    assert ok
+    st = adm.stats()
+    assert st['burst']['shed'] == {'fair_share': 2}
+    assert st['steady']['shed'] == {}
+    assert st['steady']['inflight'] == 2
+
+
+def test_tenant_admission_rate_limit_reason_and_hint():
+    now = [0.0]
+    adm = TenantAdmission(rps=1.0, burst=1.0, clock=lambda: now[0])
+    assert adm.admit('a')[0]
+    ok, hint, reason = adm.admit('a')
+    assert not ok and reason == 'rate_limit'
+    assert hint == pytest.approx(1.0)
+    # another tenant has its OWN bucket
+    assert adm.admit('b')[0]
+
+
+def test_probe_stagger_phases_distinct_and_deterministic():
+    urls = ['http://127.0.0.1:%d' % p for p in range(8100, 8108)]
+    fracs = [_probe_jitter_frac(u) for u in urls]
+    assert all(0.0 <= f < 1.0 for f in fracs)
+    assert fracs == [_probe_jitter_frac(u) for u in urls]
+    period, n = 1.0, len(urls)
+    phases = [period * ((i + fracs[i]) / n) for i in range(n)]
+    assert all(0.0 <= p < period for p in phases)
+    # no two replicas probe at the same instant
+    gaps = [b - a for a, b in zip(phases, phases[1:])]
+    assert min(gaps) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# fake autoregressive NDJSON replicas: the failover contract without JAX
+# ---------------------------------------------------------------------------
+
+def _rule_next(seq):
+    """The fake replica's greedy decode rule — a pure function of the
+    sequence so far, so a resumed continuation from prompt+emitted
+    reproduces the unkilled run exactly (the property the real
+    greedy decoder gives the gateway)."""
+    return (seq[-1] * 7 + len(seq)) % 97
+
+
+def _expected_tokens(prompt, n):
+    seq = list(prompt)
+    out = []
+    for _ in range(n):
+        t = _rule_next(seq)
+        seq.append(t)
+        out.append(t)
+    return out
+
+
+class _FakeHandler(BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args):
+        pass
+
+    def _chunk(self, obj):
+        line = (json.dumps(obj) + '\n').encode()
+        self.wfile.write(b'%x\r\n' % len(line))
+        self.wfile.write(line + b'\r\n')
+        self.wfile.flush()
+
+    def do_GET(self):
+        ok = self.server.ctl['healthy']
+        body = json.dumps({'ok': ok}).encode()
+        self.send_response(200 if ok else 503)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        ctl = self.server.ctl
+        length = int(self.headers.get('Content-Length', 0) or 0)
+        req = json.loads(self.rfile.read(length) or b'{}')
+        ctl['requests'].append(req)
+        if ctl.get('refuse', 0) > 0:
+            ctl['refuse'] -= 1
+            body = json.dumps({'error': 'unavailable'}).encode()
+            self.send_response(503)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        toks = [int(t) for t in req['tokens']]
+        n = int(req.get('max_new_tokens', 8))
+        start = int(req.get('start_index', 0) or 0)
+        rid = req.get('request_id')
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/x-ndjson')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+        # a replaying replica: re-send the tail of the prompt it was
+        # re-admitted with, as if its own journal overlapped — the
+        # gateway's index dedup must hide this from the client
+        overlap = min(int(ctl.get('overlap', 0)), start, len(toks))
+        for j in range(overlap):
+            self._chunk({'token': toks[len(toks) - overlap + j],
+                         'index': start - overlap + j})
+        die_after = ctl.pop('die_after', None)
+        abort_after = ctl.pop('abort_after', None)
+        seq = list(toks)
+        emitted = []
+        for i in range(n):
+            t = _rule_next(seq)
+            seq.append(t)
+            emitted.append(t)
+            self._chunk({'token': t, 'index': start + i})
+            if die_after is not None and i + 1 >= die_after:
+                # transport death: close mid-chunked-stream, no done
+                self.close_connection = True
+                return
+            if abort_after is not None and i + 1 >= abort_after:
+                self._chunk({'done': True,
+                             'error': 'BatcherClosed: engine closed',
+                             'error_class': 'BatcherClosed',
+                             'tokens': emitted})
+                self.wfile.write(b'0\r\n\r\n')
+                self.wfile.flush()
+                return
+        done = {'done': True, 'tokens': emitted,
+                'finish_reason': 'length'}
+        if rid is not None:
+            done['request_id'] = rid
+        self._chunk(done)
+        self.wfile.write(b'0\r\n\r\n')
+        self.wfile.flush()
+
+
+class _FakeServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+
+class _FakeReplica:
+    def __init__(self):
+        self.ctl = {'healthy': True, 'requests': []}
+        self._httpd = _FakeServer(('127.0.0.1', 0), _FakeHandler)
+        self._httpd.ctl = self.ctl
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return 'http://127.0.0.1:%d' % self.port
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _read_stream(port, payload, headers=None, timeout=10.0):
+    """Raw NDJSON reader: keeps token values, indices and the done
+    object; a transport failure lands in 'error' instead of raising."""
+    import http.client
+    out = {'status': None, 'tokens': [], 'indices': [], 'done': None,
+           'error': None, 'headers': {}}
+    conn = http.client.HTTPConnection('127.0.0.1', port,
+                                      timeout=timeout)
+    try:
+        body = json.dumps(payload).encode()
+        hdrs = {'Content-Type': 'application/json',
+                'Content-Length': str(len(body)),
+                'Connection': 'close'}
+        hdrs.update(headers or {})
+        conn.request('POST', '/generate', body=body, headers=hdrs)
+        resp = conn.getresponse()
+        out['status'] = resp.status
+        out['headers'] = dict(resp.headers)
+        if resp.status != 200:
+            out['body'] = json.loads(resp.read() or b'{}')
+            return out
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if 'token' in obj:
+                out['tokens'].append(obj['token'])
+                out['indices'].append(obj['index'])
+            elif obj.get('done'):
+                out['done'] = obj
+                break
+    except Exception as exc:
+        out['error'] = type(exc).__name__
+    finally:
+        conn.close()
+    return out
+
+
+@pytest.fixture()
+def fake_pair():
+    a, b = _FakeReplica(), _FakeReplica()
+    gw = ServingGateway([a.url, b.url], port=0, health_period_s=30.0,
+                        timeout_s=5.0, resume=True, resume_max=2,
+                        affinity=True).start()
+    by_url = {a.url: a, b.url: b}
+    yield gw, by_url
+    gw.stop()
+    a.close()
+    b.close()
+
+
+_PROMPT = [5, 11, 7, 2]
+
+
+def _target_and_survivor(gw, by_url):
+    target_url = gw.affinity_target(_PROMPT)
+    survivor = next(u for u in by_url if u != target_url)
+    return by_url[target_url], by_url[survivor]
+
+
+def test_resume_splices_midstream_death(fake_pair):
+    gw, by_url = fake_pair
+    target, survivor = _target_and_survivor(gw, by_url)
+    target.ctl['die_after'] = 3
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 10, 'stream': True})
+    assert r['error'] is None and r['status'] == 200
+    assert r['tokens'] == _expected_tokens(_PROMPT, 10)
+    assert r['indices'] == list(range(10))
+    done = r['done']
+    assert done['resumed'] == 1
+    assert done['request_id']
+    assert done['tokens'] == r['tokens']
+    # the re-admission carried prompt+emitted as the new prefix
+    readmit = survivor.ctl['requests'][-1]
+    assert readmit['tokens'] == _PROMPT + r['tokens'][:3]
+    assert readmit['start_index'] == 3
+    assert readmit['max_new_tokens'] == 7
+    assert readmit['request_id'] == done['request_id']
+    st = gw.stats()
+    assert st['resumes'] == 1 and st['resume_failures'] == 0
+
+
+def test_resume_dedups_replayed_indices(fake_pair):
+    """A resume target that replays already-delivered indices (its
+    journal overlaps the gateway's) must not duplicate tokens on the
+    client stream — at-most-once per index."""
+    gw, by_url = fake_pair
+    target, survivor = _target_and_survivor(gw, by_url)
+    target.ctl['die_after'] = 4
+    survivor.ctl['overlap'] = 2
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 9, 'stream': True})
+    assert r['error'] is None
+    assert r['indices'] == list(range(9))
+    assert r['tokens'] == _expected_tokens(_PROMPT, 9)
+    assert r['done']['resumed'] == 1
+
+
+def test_resume_withholds_typed_abort_and_resumes(fake_pair):
+    """A typed upstream abort line (the killed replica's drain) is a
+    resume trigger, not a client-visible error."""
+    gw, by_url = fake_pair
+    target, _survivor = _target_and_survivor(gw, by_url)
+    target.ctl['abort_after'] = 2
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 6, 'stream': True})
+    assert r['error'] is None
+    assert r['done'].get('error') is None
+    assert r['tokens'] == _expected_tokens(_PROMPT, 6)
+    assert r['done']['resumed'] == 1
+
+
+def test_resume_budget_exhausted_typed_replica_lost(fake_pair):
+    """Every replica dying repeatedly: after resume_max attempts the
+    client gets a TYPED ReplicaLost abort carrying the partial tokens
+    and the resume count — never a cut connection."""
+    gw, by_url = fake_pair
+    target, survivor = _target_and_survivor(gw, by_url)
+    target.ctl['die_after'] = 3
+    survivor.ctl['die_after'] = 2
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 10, 'stream': True})
+    assert r['error'] is None        # the stream TERMINATED cleanly
+    done = r['done']
+    assert done['error_class'] == 'ReplicaLost'
+    assert done['resumed'] == 2
+    assert done['tokens'] == r['tokens'] \
+        == _expected_tokens(_PROMPT, 5)
+    assert gw.stats()['resume_failures'] == 1
+
+
+def test_resume_retries_typed_503_refusal(fake_pair):
+    """A 503 at initial admission (replica dying under the request,
+    zero bytes relayed) fails over instead of relaying."""
+    gw, by_url = fake_pair
+    target, _survivor = _target_and_survivor(gw, by_url)
+    target.ctl['refuse'] = 1
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 4, 'stream': True})
+    assert r['status'] == 200 and r['error'] is None
+    assert r['tokens'] == _expected_tokens(_PROMPT, 4)
+    assert r['done'].get('resumed') is None   # clean single segment
+
+
+def test_resume_off_preserves_plain_contract():
+    """MXNET_TPU_GATEWAY_RESUME off: a typed abort line relays
+    VERBATIM and a mid-stream transport death cuts the client
+    connection — today's behavior, exactly."""
+    a, b = _FakeReplica(), _FakeReplica()
+    gw = ServingGateway([a.url, b.url], port=0, health_period_s=30.0,
+                        timeout_s=5.0, resume=False,
+                        affinity=True).start()
+    try:
+        by_url = {a.url: a, b.url: b}
+        target, _survivor = _target_and_survivor(gw, by_url)
+        target.ctl['abort_after'] = 2
+        r = _read_stream(gw.port, {'tokens': _PROMPT,
+                                   'max_new_tokens': 6,
+                                   'stream': True})
+        assert r['done']['error_class'] == 'BatcherClosed'
+        assert 'resumed' not in r['done']
+        assert len(r['tokens']) == 2
+        # transport death mid-stream: connection cut, no done line
+        target2, _ = _target_and_survivor(gw, by_url)
+        target2.ctl['die_after'] = 3
+        r = _read_stream(gw.port, {'tokens': _PROMPT,
+                                   'max_new_tokens': 6,
+                                   'stream': True})
+        # truncated stream: the relayed tokens, then the cut — no
+        # done line, typed or otherwise, and no resume
+        assert r['done'] is None
+        assert len(r['tokens']) == 3
+        assert gw.stats()['resumes'] == 0
+    finally:
+        gw.stop()
+        a.close()
+        b.close()
+
+
+def test_affinity_routes_same_prefix_to_one_replica(fake_pair):
+    gw, by_url = fake_pair
+    for suffix in (91, 92, 93, 94):
+        r = _read_stream(gw.port, {'tokens': _PROMPT[:-1] + [suffix],
+                                   'max_new_tokens': 2,
+                                   'stream': True})
+        assert r['status'] == 200
+    counts = {u: len(rep.ctl['requests'])
+              for u, rep in by_url.items()}
+    assert sorted(counts.values()) == [0, 4], counts
+    assert gw.stats()['affinity_routed'] >= 4
+
+
+def test_tenant_admission_over_http():
+    a = _FakeReplica()
+    gw = ServingGateway([a.url], port=0, health_period_s=30.0,
+                        timeout_s=5.0, resume=True,
+                        tenant_rps=1.0, tenant_burst=1.0).start()
+    try:
+        pay = {'tokens': _PROMPT, 'max_new_tokens': 2, 'stream': True}
+        r = _read_stream(gw.port, pay,
+                         headers={'X-Tenant': 'alice'})
+        assert r['status'] == 200
+        r = _read_stream(gw.port, pay,
+                         headers={'X-Tenant': 'alice'})
+        assert r['status'] == 429
+        assert r['headers'].get('Retry-After') is not None
+        assert r['body']['tenant'] == 'alice'
+        assert 'rate_limit' in r['body']['error']
+        assert r['body']['retry_after_s'] > 0
+        # another tenant is untouched by alice's bucket
+        r = _read_stream(gw.port, pay, headers={'X-Tenant': 'bob'})
+        assert r['status'] == 200
+        st = gw.stats()
+        assert st['tenant_shed'] == 1
+        assert st['tenants']['alice']['shed'] == {'rate_limit': 1}
+        assert st['tenants']['bob']['shed'] == {}
+    finally:
+        gw.stop()
+        a.close()
+
+
+def test_gateway_instruments_registered():
+    from mxnet_tpu import observability as obs
+    inst = obs.gateway_instruments()
+    inst.resumes.inc()
+    inst.tenant_rejected.labels(tenant='t', reason='rate_limit').inc()
+    snap = obs.snapshot()
+    assert 'mxnet_tpu_gateway_resumes_total' in snap
+    assert 'mxnet_tpu_gateway_tenant_rejected_total' in snap
+
+
+# ---------------------------------------------------------------------------
+# the real rig (slow tier): kill a replica under >= 8 live streams
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope='module')
+def failover_rig():
+    from mxnet_tpu.loadgen.harness import GatewayRig
+    rig = GatewayRig(replicas=2, health_period_s=0.25, predict=False,
+                     slots=4, max_new_tokens=48, decode_max_queue=16,
+                     decode_prefill_buckets=(64,), decode_max_len=128,
+                     decode_pages=64)
+    yield rig
+    rig.close()
+
+
+@pytest.mark.slow
+def test_kill_replica_mid_stream_bit_identical(failover_rig):
+    """The acceptance drill: >= 8 concurrent streams, the replica
+    serving them killed mid-generation — zero client-visible error
+    lines and every completed token stream bit-identical to the
+    unkilled reference run."""
+    from mxnet_tpu.loadgen.harness import run_gateway_failover
+    doc = run_gateway_failover(failover_rig, streams=8, seed=3)
+    v = doc['verdicts']
+    assert v['zero_error_lines'], doc['metrics']
+    assert v['token_streams_bit_identical'], doc['metrics']
+    assert v['indices_contiguous_no_dupes'], doc['metrics']
+    assert v['zero_unresolved'], doc['metrics']
+    assert v['resume_engaged'], doc['metrics']
+    assert doc['metrics']['resumed_streams'] >= 1
+    assert doc['metrics']['gateway']['resumes'] >= 1
+
+
+@pytest.mark.slow
+def test_two_tenant_burst_isolation():
+    """The burst tenant sheds typed per-tenant 429s with Retry-After
+    while the steady tenant rides inside its SLO — zero cross-tenant
+    bleed."""
+    from mxnet_tpu.loadgen.harness import GatewayRig, run_tenants
+    rig = GatewayRig(replicas=2, health_period_s=0.25, predict=False,
+                     slots=4, decode_max_queue=16,
+                     gateway_kwargs=dict(tenant_rps=8.0,
+                                         tenant_burst=8.0,
+                                         tenant_max_inflight=32))
+    try:
+        doc = run_tenants(rig, duration_s=3.0, seed=2)
+        v = doc['verdicts']
+        assert v['burst_shed_typed_429'], doc['metrics']['burst']
+        assert v['steady_never_shed'], doc['metrics']['steady']
+        assert v['burst_retry_after_honored']
+        assert v['zero_unresolved']
+        tenants = doc['metrics']['gateway']['tenants']
+        assert tenants['burst']['shed'], tenants
+        assert not tenants['steady']['shed'], tenants
+    finally:
+        rig.close()

@@ -287,3 +287,70 @@ def test_request_record_derived_metrics_none_safe():
     assert r.latency_s() is None and r.ttft_s() is None \
         and r.tpot_s() is None
     assert r.to_json()['resolved'] is False
+
+
+# ---------------------------------------------------------------------------
+# resume taxonomy + client Retry-After backoff
+# ---------------------------------------------------------------------------
+
+def test_summarize_counts_resumed_streams_as_success():
+    """A stream the gateway failed over mid-generation and completed
+    clean is SUCCESS-with-resume: it counts toward goodput, never as
+    a failure, and is surfaced in its own stat."""
+    clean = _rec(0, kind='generate')
+    resumed = _rec(1, kind='generate')
+    resumed.resumed = 1
+    retried = _rec(2, status=429, error='shed_backpressure')
+    retried.retries = 2
+    m = summarize([clean, resumed, retried])
+    assert m['resumed_streams'] == 1
+    assert m['retried'] == 1
+    assert m['served_ok'] == 2          # the resumed stream is OK
+    assert m['goodput'] == pytest.approx(2 / 3)
+    j = resumed.to_json()
+    assert j['resumed'] == 1 and j['retries'] == 0
+
+
+def test_client_retries_honor_retry_after_with_cap():
+    """On 429/503 with retry budget, the client sleeps the replica's
+    Retry-After (capped) and re-fires; the record keeps its original
+    fired_at — backoff is latency the open-loop accounting sees —
+    and counts every retry."""
+    from mxnet_tpu.loadgen.client import LoadClient
+    sleeps = []
+    client = LoadClient('127.0.0.1', 1, retries=2, retry_cap_s=0.5,
+                        sleep=sleeps.append)
+    outcomes = [(429, 3.0), (503, 0.2), (200, None)]
+
+    def attempt(rec):
+        if rec.fired_at is None:
+            rec.fired_at = 100.0
+        status, ra = outcomes[rec.retries]
+        rec.status = status
+        rec.retry_after_s = ra
+        rec.error_class = None if status == 200 else 'shed'
+        rec.resolved = True
+
+    rec = RequestRecord(0, 'predict', 0.0)
+    client._with_retries(rec, attempt)
+    assert rec.status == 200 and rec.retries == 2
+    assert sleeps == [0.5, 0.2]         # 3.0 capped to 0.5
+    assert rec.fired_at == 100.0        # original firing instant kept
+
+
+def test_client_retries_default_off():
+    """The knob default (0 retries) keeps the one-shot open-loop
+    behavior the overload verdicts are calibrated on."""
+    from mxnet_tpu.loadgen.client import LoadClient
+    client = LoadClient('127.0.0.1', 1)
+    assert client.retries == 0
+    calls = []
+
+    def attempt(rec):
+        calls.append(1)
+        rec.status = 429
+        rec.resolved = True
+
+    rec = RequestRecord(0, 'predict', 0.0)
+    client._with_retries(rec, attempt)
+    assert len(calls) == 1 and rec.status == 429

@@ -2,15 +2,18 @@
 """SLO regression gate (tools/ci.py stage 'slo').
 
 Runs the open-loop load harness (python -m mxnet_tpu.loadgen) in
-overload and chaos modes against the in-process serving rig, then
-diffs the resulting ``mxnet_tpu.slo.v1`` artifacts against the
-committed SLO_BASELINE.json:
+overload, chaos, prefix, gateway-failover and tenants modes against
+the in-process serving rig, then diffs the resulting
+``mxnet_tpu.slo.v1`` artifacts against the committed
+SLO_BASELINE.json:
 
   * budgets  — the SLO numbers the serving stack must hold (admitted
     p99 under overload, shed-response p99, availability floor and
     per-fault recovery ceiling under chaos — including the paged
-    pool-exhaustion squeeze resolving typed with zero hangs — and the
-    shared-prefix workload's TTFT p99, zero unresolved futures,
+    pool-exhaustion squeeze resolving typed with zero hangs — the
+    shared-prefix workload's TTFT p99, the gateway kill-mid-stream
+    drill's availability/zero-error-lines/bit-identity, the
+    two-tenant burst phase's isolation, zero unresolved futures,
     zero leaked decode slots). Budgets are CEILINGS, not measured
     snapshots: the gate fails only on regressions past them, never on
     improvements — the LINT_BASELINE/FUSION_BASELINE contract.
@@ -45,6 +48,9 @@ _BUDGET_KNOBS = {
     'recovery_ceiling_s': 'MXNET_TPU_SLO_RECOVERY_S',
     'goodput_floor': 'MXNET_TPU_SLO_GOODPUT',
     'prefix_ttft_p99_ms': 'MXNET_TPU_SLO_PREFIX_TTFT_P99_MS',
+    'gateway_availability_floor': 'MXNET_TPU_SLO_GATEWAY_AVAILABILITY',
+    'tenant_steady_ttft_p99_ms': 'MXNET_TPU_SLO_TENANT_TTFT_P99_MS',
+    'tenant_steady_tpot_p99_ms': 'MXNET_TPU_SLO_TENANT_TPOT_P99_MS',
 }
 
 
@@ -153,7 +159,8 @@ def main(argv=None):
             raise SystemExit('--skip-run needs --overload/--chaos')
     else:
         tmp = tempfile.mkdtemp(prefix='slo_gate_')
-        for mode in ('overload', 'chaos', 'prefix'):
+        for mode in ('overload', 'chaos', 'prefix',
+                     'gateway-failover', 'tenants'):
             artifacts.append(run_mode(
                 mode, os.path.join(tmp, '%s.json' % mode), budgets,
                 full=args.full))

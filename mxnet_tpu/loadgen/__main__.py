@@ -69,6 +69,13 @@ def _summary(doc):
                      % (m.get('resumed_streams'),
                         m.get('error_lines'),
                         m.get('availability')))
+    if doc['mode'] == 'drain':
+        lines.append('  migrated_streams=%s dest_prefill_delta=%s '
+                     'error_lines=%s availability=%s drain_rc=%s'
+                     % (m.get('migrated_streams'),
+                        m.get('dest_prefill_delta'),
+                        m.get('error_lines'), m.get('availability'),
+                        (m.get('drain_result') or {}).get('rc')))
     if doc['mode'] == 'tenants':
         for tenant in ('steady', 'burst'):
             tm = m.get(tenant) or {}
@@ -95,7 +102,7 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument('--mode', choices=('capacity', 'overload', 'chaos',
                                       'prefix', 'gateway-failover',
-                                      'tenants'),
+                                      'drain', 'tenants'),
                    default='overload')
     p.add_argument('--out', default='SLO.json')
     p.add_argument('--seed', type=int, default=None,
@@ -123,8 +130,8 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     from .harness import GatewayRig, ServingRig, run_capacity, \
-        run_chaos, run_gateway_failover, run_overload, run_prefix, \
-        run_tenants
+        run_chaos, run_drain, run_gateway_failover, run_overload, \
+        run_prefix, run_tenants
     from .harness import _knob
     seed = args.seed if args.seed is not None \
         else int(_knob('MXNET_TPU_LOADGEN_SEED', 0))
@@ -135,8 +142,8 @@ def main(argv=None):
     # decode workload the SLO guards)
     mix = {'predict': 1.0} if args.no_generate else None
 
-    if args.mode in ('prefix', 'gateway-failover', 'tenants') \
-            and args.no_generate:
+    if args.mode in ('prefix', 'gateway-failover', 'drain',
+                     'tenants') and args.no_generate:
         raise SystemExit('--mode %s needs the generate rig'
                          % args.mode)
     if args.mode == 'prefix':
@@ -153,6 +160,17 @@ def main(argv=None):
                          decode_max_queue=16,
                          decode_prefill_buckets=(64,),
                          decode_max_len=128, decode_pages=64)
+    elif args.mode == 'drain':
+        # graceful-drain drill: slots >= streams so EVERY stream is
+        # active when the drain fires (a queued sequence exports cold
+        # and would re-prefill on import — gated against); a full
+        # page pool on each replica so the survivor can absorb all 8
+        # imported sequences' pages on top of its own traffic
+        rig = GatewayRig(replicas=2, health_period_s=0.25,
+                         predict=False, slots=8, max_new_tokens=48,
+                         decode_max_queue=16,
+                         decode_prefill_buckets=(64,),
+                         decode_max_len=128, decode_pages=128)
     elif args.mode == 'tenants':
         # two-tenant burst phase: per-tenant buckets sized so the
         # steady lane never touches its budget while the burst lane
@@ -173,6 +191,8 @@ def main(argv=None):
                              seed=seed)
         elif args.mode == 'gateway-failover':
             doc = run_gateway_failover(rig, streams=8, seed=seed)
+        elif args.mode == 'drain':
+            doc = run_drain(rig, streams=8, seed=seed)
         elif args.mode == 'tenants':
             doc = run_tenants(rig,
                               duration_s=(args.duration

@@ -38,8 +38,8 @@ from .schedule import build_schedule
 
 __all__ = ['ServingRig', 'GatewayRig', 'Dispatcher', 'run_capacity',
            'run_overload', 'run_chaos', 'run_prefix',
-           'run_gateway_failover', 'run_tenants', 'DEFAULT_MIX',
-           'OVERLOAD_MIX']
+           'run_gateway_failover', 'run_drain', 'run_tenants',
+           'DEFAULT_MIX', 'OVERLOAD_MIX']
 
 # chaos soak: mostly-cheap traffic keeps the soak itself off the
 # host's critical path while faults fire
@@ -268,6 +268,7 @@ class GatewayRig:
         self.max_new_tokens = self.replicas[0].max_new_tokens
         self.slots = self.replicas[0].slots
         self._killed = set()
+        self._drained = set()
 
     @property
     def predict_session(self):
@@ -285,21 +286,34 @@ class GatewayRig:
                 return i
         raise ValueError('no replica at %r' % (base_url,))
 
-    def kill_replica(self, index):
-        """Kill one replica mid-flight (the whole-host-down drill):
-        sessions close FIRST, undrained — every in-flight and queued
-        stream dies NOW with a typed error, the mid-stream signal the
-        gateway's resume journal acts on — then the HTTP server stops.
-        A graceful server-first stop would let in-flight streams run
-        to completion during the shutdown, which is a drained host,
-        not a lost one."""
+    def kill_replica(self, index, drain=False):
+        """Take one replica down mid-flight. ``drain=False`` is the
+        whole-host-down drill: sessions close FIRST, undrained —
+        every in-flight and queued stream dies NOW with a typed
+        error, the mid-stream signal the gateway's resume journal
+        acts on — then the HTTP server stops. A graceful server-first
+        stop would let in-flight streams run to completion during the
+        shutdown, which is a drained host, not a lost one.
+
+        ``drain=True`` is the graceful-preemption drill
+        (docs/SERVING.md "Drain & live migration"): ``begin_drain``
+        flips /healthz to 503 draining, sheds new admissions, and
+        exports every in-flight sequence over GET /drain — the HTTP
+        server STAYS UP so the gateway can fetch the handoff payloads
+        and splice continuations via POST /import; the replica's
+        ``drain_result`` then carries the resumable exit code."""
         rep = self.replicas[index]
-        if index not in self._killed:
-            self._killed.add(index)
-            for sess in (rep.predict_session, rep.decode_session):
-                if sess is not None:
-                    sess.close(drain=False)
-            rep.server.stop()
+        if index in self._killed:
+            return rep
+        self._killed.add(index)
+        if drain:
+            self._drained.add(index)
+            rep.server.begin_drain(reason='drill')
+            return rep
+        for sess in (rep.predict_session, rep.decode_session):
+            if sess is not None:
+                sess.close(drain=False)
+        rep.server.stop()
         return rep
 
     def healthy(self, payload):
@@ -329,8 +343,12 @@ class GatewayRig:
     def close(self):
         self.gateway.stop()
         for i, rep in enumerate(self.replicas):
-            if i not in self._killed:
+            if i in self._killed and i not in self._drained:
+                continue
+            try:
                 rep.close()
+            except Exception:
+                pass       # a drained replica's sessions are closed
 
 
 class Dispatcher:
@@ -1052,6 +1070,149 @@ def run_gateway_failover(rig, streams=8, seed=0,
         'gateway-failover',
         {'streams': streams, 'seed': seed, 'killed_replica': target
          if killed else None, 'replicas': len(rig.replicas),
+         'max_new_tokens': max_new,
+         'availability_floor': availability_floor},
+        metrics, server=rig.server_stats(), verdicts=verdicts)
+
+
+def run_drain(rig, streams=8, seed=0, availability_floor=None,
+              timeout_s=30.0):
+    """Graceful-drain drill (docs/SERVING.md "Drain & live
+    migration"): >= ``streams`` concurrent /generate streams share
+    one system prompt so prefix-affine routing lands them all on one
+    replica; once EVERY stream has its first token (all sequences
+    ACTIVE in the decode engine, none still queued), that replica
+    begins a graceful drain. The gateway must route away, import the
+    handed-off sequences on the survivors, and splice each
+    continuation into the same client stream. Gated
+    (tools/slo_gate.py ``drain.*``):
+
+      * zero client-visible NDJSON error lines — a drain is not a
+        failure,
+      * availability at/above ``MXNET_TPU_SLO_DRAIN_AVAILABILITY``
+        (default 1.0: a graceful drain loses NOTHING),
+      * every token stream BIT-IDENTICAL to the undrained reference,
+      * token indices contiguous with no duplicates across the
+        splice,
+      * ZERO destination re-prefills — the KV pages travelled in the
+        seqstate payloads (survivor prefill delta == 0, imports > 0),
+      * the drain completed with the resumable exit code (rc 75),
+      * zero unresolved streams.
+    """
+    if rig.decode_session is None:
+        raise ValueError('drain mode needs a generate-capable rig')
+    if len(rig.replicas) < 2:
+        raise ValueError('drain mode needs >= 2 replicas')
+    streams = int(streams)
+    if int(rig.slots) < streams:
+        raise ValueError(
+            'drain drill needs slots >= streams (%d < %d): every '
+            'stream must be ACTIVE when the drain fires — a still-'
+            'queued sequence exports cold and re-prefills on import, '
+            'which this drill gates against' % (rig.slots, streams))
+    availability_floor = float(
+        availability_floor if availability_floor is not None
+        else _knob('MXNET_TPU_SLO_DRAIN_AVAILABILITY', 1.0))
+    max_new = int(rig.max_new_tokens)
+    system = [2 + ((seed + j) % (_VOCAB - 3)) for j in range(12)]
+    payloads = [{'tokens': system + [1 + (i % (_VOCAB - 2))],
+                 'max_new_tokens': max_new, 'stream': True}
+                for i in range(streams)]
+    target_url = rig.gateway.affinity_target(payloads[0]['tokens'])
+    target = rig.replica_index(target_url)
+    # reference pass (undrained): the sequences the client is
+    # entitled to (greedy bit-identity across the handoff)
+    reference = [_read_token_stream('127.0.0.1', rig.port, p,
+                                    timeout_s=timeout_s)
+                 for p in payloads]
+    _settle(rig)
+    survivors = [i for i in range(len(rig.replicas)) if i != target]
+    pre = {i: dict(rig.replicas[i].decode_session._engine
+                   .stats()['counts']) for i in survivors}
+    results = [None] * streams
+    first = [threading.Event() for _ in range(streams)]
+
+    def _drive(i):
+        results[i] = _read_token_stream(
+            '127.0.0.1', rig.port, payloads[i], timeout_s=timeout_s,
+            on_token=lambda _n, i=i: first[i].set())
+
+    threads = [threading.Thread(target=_drive, args=(i,),
+                                daemon=True,
+                                name='loadgen-drain-%d' % i)
+               for i in range(streams)]
+    for th in threads:
+        th.start()
+    all_active = all(ev.wait(timeout_s) for ev in first)
+    rig.kill_replica(target, drain=True)
+    deadline = time.monotonic() + timeout_s + 10.0
+    for th in threads:
+        th.join(max(0.1, deadline - time.monotonic()))
+    unresolved = sum(1 for th in threads if th.is_alive())
+    drained = rig.replicas[target].server
+    drain_done = drained.wait_drained(timeout=timeout_s)
+    drain_res = drained.drain_result or {}
+    # -- verdicts ----------------------------------------------------------
+    clean = [r for r in results
+             if r is not None and r['status'] == 200
+             and r['error'] is None and r['done'] is not None]
+    error_lines = sum(1 for r in results
+                      if r is not None and r['error'] is not None)
+    migrated_streams = sum(1 for r in clean
+                           if (r['done'] or {}).get('migrated'))
+    identical = all(
+        reference[i]['error'] is None
+        and results[i]['tokens'] == reference[i]['tokens']
+        for i in range(streams)
+        if results[i] is not None and results[i]['status'] == 200
+        and results[i]['error'] is None
+        and results[i]['done'] is not None)
+    contiguous = all(
+        r['indices'] == list(range(len(r['tokens'])))
+        and (r['done'] or {}).get('tokens') == r['tokens']
+        for r in clean)
+    post = {i: dict(rig.replicas[i].decode_session._engine
+                    .stats()['counts']) for i in survivors}
+    prefill_delta = sum(post[i].get('prefills', 0)
+                        - pre[i].get('prefills', 0)
+                        for i in survivors)
+    imports = sum(post[i].get('migrated_in', 0)
+                  - pre[i].get('migrated_in', 0) for i in survivors)
+    availability = len(clean) / float(streams) if streams else None
+    gw_stats = rig.gateway.stats()
+    verdicts = {
+        'zero_error_lines': error_lines == 0,
+        'availability_above_floor': availability is not None
+        and availability >= availability_floor,
+        'token_streams_bit_identical': identical,
+        'indices_contiguous_no_dupes': contiguous,
+        'zero_dest_reprefills': prefill_delta == 0 and imports >= 1,
+        'migration_engaged': all_active and migrated_streams >= 1
+        and gw_stats['migrations']['spliced'] >= 1,
+        'drain_rc_resumable': bool(drain_done)
+        and drain_res.get('rc') == 75,
+        'zero_unresolved': unresolved == 0,
+    }
+    metrics = {
+        'offered': streams,
+        'admitted': sum(1 for r in results
+                        if r is not None and r['status'] == 200),
+        'served_ok': len(clean),
+        'availability': availability,
+        'migrated_streams': migrated_streams,
+        'dest_prefill_delta': prefill_delta,
+        'dest_imports': imports,
+        'error_lines': error_lines,
+        'unresolved': unresolved,
+        'all_streams_active_at_drain': all_active,
+        'drain_result': drain_res,
+        'tokens_per_stream': max_new,
+        'gateway': gw_stats,
+    }
+    return build_artifact(
+        'drain',
+        {'streams': streams, 'seed': seed,
+         'drained_replica': target, 'replicas': len(rig.replicas),
          'max_new_tokens': max_new,
          'availability_floor': availability_floor},
         metrics, server=rig.server_stats(), verdicts=verdicts)

@@ -257,6 +257,31 @@ def serving_instruments():
                 help='draft proposals accepted by the target '
                      'verify step (acceptance rate = accepted / '
                      'proposed)'),
+            # live decode-state migration (serving/decode/seqstate.py,
+            # docs/SERVING.md "Drain & live migration"): paired with
+            # drain_begin / seq_export / seq_import / drain_complete
+            # flight events
+            sequences_migrated=counter(
+                'mxnet_tpu_serve_sequences_migrated_total',
+                help='in-flight sequences exported as seqstate '
+                     'payloads (graceful drain / prefill-decode '
+                     'handoff)'),
+            drains=counter(
+                'mxnet_tpu_serve_drains_total',
+                help='graceful drains begun (SIGTERM/preempt hook or '
+                     'explicit begin_drain)'),
+            handoff_pages=counter(
+                'mxnet_tpu_serve_handoff_pages_total',
+                help='KV pages carried across engines by seqstate '
+                     'export/import'),
+            migration_seconds=histogram(
+                'mxnet_tpu_serve_migration_seconds',
+                help='per-sequence export/import latency (device '
+                     'gather/scatter + payload assembly)'),
+            drain_seconds=histogram(
+                'mxnet_tpu_serve_drain_seconds',
+                help='graceful drain wall time: begin_drain to all '
+                     'sequences exported and handed off'),
         )
     return _serving_inst
 
@@ -306,6 +331,20 @@ def gateway_instruments():
                 'mxnet_tpu_gateway_healthy_replicas',
                 help='replicas currently in the gateway routing '
                      'rotation'),
+            migrations=counter(
+                'mxnet_tpu_gateway_migrations_total',
+                help='streams spliced onto a healthy replica via '
+                     'seqstate handoff (/drain -> /import) after a '
+                     'source replica drained — zero re-prefill'),
+            migration_failures=counter(
+                'mxnet_tpu_gateway_migration_failures_total',
+                help='seqstate handoffs that failed and fell back to '
+                     'the re-prefill resume path'),
+            journal_capped=counter(
+                'mxnet_tpu_gateway_journal_capped_total',
+                help='streams whose resume journal hit '
+                     'MXNET_TPU_GATEWAY_JOURNAL_MAX (falls back to '
+                     're-prefill resume on failure)'),
         )
     return _gateway_inst
 

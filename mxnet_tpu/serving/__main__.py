@@ -42,6 +42,16 @@ decoding"):
                       neighbor), EOS retires early, FIFO admission
                       holds, and total compiled programs stay <=
                       prefill ladder + 1.
+  10. decode_migrate — disaggregated prefill/decode over the live-
+                      migration path: a prefill engine exports a
+                      just-prefilled sequence
+                      (``export_sequence`` seals KV pages + position
+                      into a ``mxnet_tpu.seqstate.v1`` payload), a
+                      decode engine with a DIFFERENT page size
+                      imports it (pages re-chunked in flight) and
+                      streams the rest with ZERO prefills — the
+                      combined token stream bit-identical to one
+                      engine end to end.
 
 ``--serve-smoke`` is the fault-injection mode tools/fault_smoke.py
 drives (legs 7-8 of the CI fault tier): with
@@ -451,6 +461,53 @@ def check_decode_continuous():
     return None
 
 
+def check_decode_migrate():
+    """Leg 10: the prefill/decode disaggregation probe
+    (docs/SERVING.md "Drain & live migration")."""
+    from .server import InferenceSession
+    from .decode import PagedDecodeProgram, init_transformer_lm
+    model, params = init_transformer_lm(vocab=23, units=16, hidden=32,
+                                        layers=1, heads=2, max_len=64,
+                                        seed=11)
+    prompt = [3, 5, 7, 11, 2, 9, 4]
+    n = 12
+
+    def paged(page_size, pages):
+        return PagedDecodeProgram(model, params, slots=2,
+                                  prefill_buckets=(8,),
+                                  page_size=page_size, pages=pages,
+                                  name='selftest-mig%d' % page_size)
+
+    with InferenceSession(paged(8, 32), watchdog=False) as ref:
+        want = ref.generate(prompt, max_new_tokens=n).result(60)
+    with InferenceSession(paged(8, 32), watchdog=False) as pre, \
+            InferenceSession(paged(16, 16), watchdog=False) as dec:
+        s = pre.generate(prompt, max_new_tokens=n)
+        next(iter(s))            # prefill landed (first token out)
+        payload = pre._engine.export_sequence(s, timeout=30)
+        if s.finish_reason != 'migrated':
+            return ('exported stream finished %r, want migrated'
+                    % s.finish_reason)
+        if payload.get('schema') != 'mxnet_tpu.seqstate.v1':
+            return 'bad payload schema: %r' % payload.get('schema')
+        stream = dec._engine.import_sequence(payload)
+        got = list(payload['emitted']) + list(stream)
+        pre_counts = pre._engine._counts
+        dec_counts = dec._engine._counts
+    if got != want:
+        return ('disaggregated stream %r != single-engine %r'
+                % (got, want))
+    if dec_counts['prefills'] != 0:
+        return ('decode engine ran %d prefills; the handoff must '
+                'skip prefill entirely' % dec_counts['prefills'])
+    if pre_counts['prefills'] != 1 \
+            or pre_counts['migrated_out'] != 1 \
+            or dec_counts['migrated_in'] != 1:
+        return ('migration counters off: prefill side %r, decode '
+                'side %r' % (pre_counts, dec_counts))
+    return None
+
+
 def run_decode_smoke(args):
     """Decode fault-injection mode (tools/fault_smoke.py check 9)."""
     from mxnet_tpu import observability
@@ -584,7 +641,8 @@ def main(argv=None):
                 ('http', check_http),
                 ('decode_bit_identity', check_decode_bit_identity),
                 ('decode_reload', lambda: check_decode_reload(tmp)),
-                ('decode_continuous', check_decode_continuous)]
+                ('decode_continuous', check_decode_continuous),
+                ('decode_migrate', check_decode_migrate)]
         for name, fn in legs:
             try:
                 problem = fn()

@@ -28,19 +28,21 @@ from __future__ import annotations
 
 from .cache import CacheSpec, cache_bytes, init_cache, write_position, \
     write_slot
-from .engine import DecodeEngine, GenerateStream
+from .engine import DecodeEngine, DrainTimeout, GenerateStream
 from .model import (DecodeModel, RNNLM, TransformerLM, from_gluon_rnn_lm,
                     init_rnn_lm, init_transformer_lm, model_from_config)
 from .paged import (PageAllocator, PagedCacheSpec, PrefixCache,
                     pool_bytes)
 from .program import (DecodeProgram, PagedDecodeProgram, freeze_decode,
                       load_decode)
+from .seqstate import SEQSTATE_SCHEMA, SeqStateError
 
 __all__ = [
     'CacheSpec', 'cache_bytes', 'init_cache', 'write_position',
-    'write_slot', 'DecodeEngine', 'GenerateStream', 'DecodeModel',
-    'RNNLM', 'TransformerLM', 'from_gluon_rnn_lm', 'init_rnn_lm',
-    'init_transformer_lm', 'model_from_config', 'DecodeProgram',
-    'PagedDecodeProgram', 'PageAllocator', 'PagedCacheSpec',
-    'PrefixCache', 'pool_bytes', 'freeze_decode', 'load_decode',
+    'write_slot', 'DecodeEngine', 'DrainTimeout', 'GenerateStream',
+    'DecodeModel', 'RNNLM', 'TransformerLM', 'from_gluon_rnn_lm',
+    'init_rnn_lm', 'init_transformer_lm', 'model_from_config',
+    'DecodeProgram', 'PagedDecodeProgram', 'PageAllocator',
+    'PagedCacheSpec', 'PrefixCache', 'pool_bytes', 'freeze_decode',
+    'load_decode', 'SEQSTATE_SCHEMA', 'SeqStateError',
 ]

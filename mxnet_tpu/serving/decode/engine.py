@@ -64,8 +64,9 @@ import numpy as onp
 
 from ..batcher import BackpressureError, BatcherClosed, RequestTimeout
 from .paged import TRASH_PAGE, PageAllocator, PrefixCache, pages_for
+from .seqstate import SeqStateError, build_payload, decode_payload
 
-__all__ = ['GenerateStream', 'DecodeEngine']
+__all__ = ['GenerateStream', 'DecodeEngine', 'DrainTimeout']
 
 _DONE = object()          # stream sentinel
 
@@ -214,6 +215,13 @@ class _Seq:
         return self.pos is not None and self.pos < len(self.prompt)
 
 
+class DrainTimeout(RequestTimeout):
+    """A draining close's budget expired with this stream still in
+    flight: the stream fails TYPED (its NDJSON stream gets this as an
+    error line, a blocking ``result()`` raises it) and its slot frees
+    — a drain never returns with work silently wedged in flight."""
+
+
 class _DegradedPath(Exception):
     """Internal: the device call failed transiently / breaker open —
     finish the work on the CPU fallback."""
@@ -278,7 +286,13 @@ class DecodeEngine:
                         'prefix_hits': 0, 'prefix_tokens_saved': 0,
                         'spec_proposed': 0, 'spec_accepted': 0,
                         'spec_rounds': 0, 'cow_copies': 0,
-                        'pool_exhausted': 0, 'page_evictions': 0}
+                        'pool_exhausted': 0, 'page_evictions': 0,
+                        'migrated_out': 0, 'migrated_in': 0,
+                        'handoff_pages': 0, 'drain_timeouts': 0}
+        # live-migration requests serviced by the worker at tick
+        # boundaries (the only thread that owns the device cache):
+        # (op, arg, result_box, done_event)
+        self._migrations = []
         # paged scheduling state (host side of the page pool)
         self.paged = bool(getattr(program, 'paged', False))
         self._allocator = None
@@ -450,12 +464,13 @@ class DecodeEngine:
     def _run(self):
         while True:
             with self._lock:
-                while not self._pending and not self._active:
+                while not self._pending and not self._active \
+                        and not self._migrations:
                     if self._closed:
                         return
                     self._wake.wait(0.05)
                 if self._closed and not self._pending \
-                        and not self._active:
+                        and not self._active and not self._migrations:
                     return
             try:
                 self._tick()
@@ -466,8 +481,10 @@ class DecodeEngine:
 
     def _tick(self):
         """One scheduler iteration: retire finished/abandoned slots,
-        admit prefills, advance the live batch one token."""
+        service migration requests, admit prefills, advance the live
+        batch one token."""
         self._retire_abandoned()
+        self._service_migrations()
         budget = self.prefill_interleave if self._active \
             else self.slots
         while budget > 0:
@@ -1210,6 +1227,323 @@ class DecodeEngine:
             inst.spec_proposed.inc(proposed_total)
             inst.spec_accepted.inc(accepted_total)
 
+    # -- live migration (seqstate export/import) ---------------------------
+    #
+    # In-flight decode state is a PORTABLE artifact (seqstate.py):
+    # export gathers a live sequence's device state to host and seals
+    # it into a versioned payload; import lands it in another engine
+    # so the destination SKIPS prefill entirely and continues
+    # token-bit-identically under greedy. Both run on the worker
+    # thread at tick boundaries — the only thread that owns the
+    # device cache — via a request queue the public methods block on.
+
+    def _request_migration(self, op, arg, timeout):
+        box, ev = {}, threading.Event()
+        with self._wake:
+            if self._closed:
+                raise BatcherClosed('decode engine %r is closed'
+                                    % self.name)
+            self._migrations.append((op, arg, box, ev))
+            self._wake.notify()
+        if not ev.wait(timeout):
+            raise RequestTimeout(
+                'sequence %s not serviced within %r s (worker wedged?)'
+                % (op, timeout))
+        if 'error' in box:
+            raise box['error']
+        return box['result']
+
+    def _service_migrations(self):
+        """Worker thread: service queued export/import requests at the
+        tick boundary (sequences sit exactly on a token boundary, the
+        cache reference is stable)."""
+        while True:
+            with self._lock:
+                if not self._migrations:
+                    return
+                op, arg, box, ev = self._migrations.pop(0)
+            try:
+                if op == 'export':
+                    box['result'] = self._do_export(arg)
+                else:
+                    box['result'] = self._do_import(arg)
+            except Exception as exc:
+                box['error'] = exc
+            ev.set()
+
+    def _request_id_for(self, stream):
+        for rid, s in self._requests.items():
+            if s is stream:
+                return rid
+        return None
+
+    def export_sequence(self, stream, timeout=30.0):
+        """Snapshot a live sequence into a ``mxnet_tpu.seqstate.v1``
+        payload and retire it here (its stream finishes with
+        ``finish_reason='migrated'`` — no error line; the importer
+        continues it).
+
+        Paged engines gather the sequence's valid KV rows from the
+        pool through its page table; slot engines (RNNLM) read the
+        O(1) recurrent slot state; a still-queued sequence exports
+        ``cold`` (prompt + budget only) and re-admits through the
+        destination's ordinary path. Raises :class:`SeqStateError`
+        for a finished/unknown stream, :class:`BatcherClosed` after
+        :meth:`close`."""
+        cold = None
+        with self._lock:
+            if self._closed:
+                raise BatcherClosed('decode engine %r is closed'
+                                    % self.name)
+            for i, seq in enumerate(self._pending):
+                if seq.stream is stream:
+                    cold = self._pending.pop(i)
+                    break
+            rid = self._request_id_for(stream)
+        if cold is not None:
+            payload = build_payload(
+                'cold', cold.prompt, [], 0, None, cold.max_new,
+                eos_id=cold.eos_id, request_id=rid)
+            stream._finish('migrated')
+            with self._lock:
+                self._counts['migrated_out'] += 1
+            _record_event('seq_export', seq_kind='cold',
+                          prompt_len=len(cold.prompt), request_id=rid)
+            inst = _serving_instruments()
+            if inst is not None:
+                inst.sequences_migrated.inc()
+            return payload
+        return self._request_migration('export', stream, timeout)
+
+    def export_all(self, timeout=30.0):
+        """Drain helper: export every in-flight sequence (queued and
+        active). Sequences that finish naturally while the drain walks
+        the list are skipped — their streams already completed clean.
+        Returns the list of payloads."""
+        with self._lock:
+            streams = [seq.stream for seq in self._pending] \
+                + [seq.stream for seq in self._active.values()]
+        payloads = []
+        for stream in streams:
+            try:
+                payloads.append(self.export_sequence(stream,
+                                                     timeout=timeout))
+            except SeqStateError:
+                continue            # finished before its export ran
+            except BatcherClosed:
+                break
+        return payloads
+
+    def _do_export(self, stream):
+        with self._lock:
+            found = None
+            for slot, seq in self._active.items():
+                if seq.stream is stream:
+                    found = (slot, seq)
+                    break
+            rid = self._request_id_for(stream)
+        if found is None or stream.done():
+            raise SeqStateError(
+                'sequence is not live in this engine (finished with '
+                '%r or never admitted)' % (stream.finish_reason,))
+        slot, seq = found
+        t0 = self._clock()
+        npages = 0
+        if self.paged:
+            ps = self.program.page_size
+            npages = pages_for(seq.pos, ps)
+            ids = [int(seq.table[i]) for i in range(npages)]
+            entries = self.program.export_pages(self._cache, ids)
+            entries = {k: v[:seq.pos] for k, v in entries.items()}
+            payload = build_payload(
+                'paged', seq.prompt, list(stream.tokens), seq.pos,
+                seq.last_token, seq.max_new, eos_id=seq.eos_id,
+                request_id=rid, page_size=ps, entries=entries)
+        else:
+            entries = self.program.export_slot_state(self._cache, slot)
+            payload = build_payload(
+                'slot', seq.prompt, list(stream.tokens), seq.pos,
+                seq.last_token, seq.max_new, eos_id=seq.eos_id,
+                request_id=rid, entries=entries)
+        # the stream ends HERE, cleanly: 'migrated' is not an error
+        # (the server's done line carries it; the gateway splices the
+        # destination's continuation into the same client stream)
+        stream._finish('migrated')
+        self._retire(slot, seq, 'migrated')
+        with self._lock:
+            self._counts['migrated_out'] += 1
+            self._counts['handoff_pages'] += npages
+        dt = self._clock() - t0
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.sequences_migrated.inc()
+            inst.migration_seconds.observe(dt)
+            if npages:
+                inst.handoff_pages.inc(npages)
+        _record_event('seq_export', seq_kind=payload['kind'], slot=slot,
+                      pos=int(seq.pos), tokens=len(stream.tokens),
+                      pages=npages, request_id=rid)
+        return payload
+
+    def import_sequence(self, payload, timeout=30.0):
+        """Land an exported sequence in THIS engine and continue it —
+        no prefill runs (the ``prefills`` counter is untouched): KV
+        rows are re-chunked to this engine's page size and written via
+        ``write_prefill_pages``; slot state lands via ``write_slot``.
+        Returns the continuation :class:`GenerateStream` whose
+        iterator yields only the NEW tokens (``stream.tokens`` holds
+        the full sequence including the handed-off prefix).
+
+        Raises :class:`SeqStateError` for torn/version-mismatched/
+        incompatible payloads, :class:`BackpressureError` when no
+        slot/pages are available, :class:`BatcherClosed` after
+        :meth:`close`."""
+        state = decode_payload(payload)
+        if state['kind'] == 'cold':
+            # never prefilled at the source: ordinary admission
+            return self.generate(state['prompt'],
+                                 max_new_tokens=state['max_new'],
+                                 eos_id=state['eos_id'],
+                                 request_id=state['request_id'])
+        if state['kind'] == 'paged' and not self.paged:
+            raise SeqStateError('paged seqstate cannot land in a '
+                                'slot-cache engine')
+        if state['kind'] == 'slot' and self.paged:
+            raise SeqStateError('slot seqstate cannot land in a '
+                                'paged engine')
+        if state['pos'] + 1 >= self.program.max_len:
+            raise SeqStateError(
+                'sequence at pos=%d does not fit this engine '
+                '(max_len=%d)' % (state['pos'], self.program.max_len))
+        if self.paged and pages_for(state['pos'] + 1,
+                                    self.program.page_size) \
+                > self.program.max_pages:
+            raise SeqStateError(
+                'sequence needs more pages than this engine maps per '
+                'sequence (max_pages=%d)' % self.program.max_pages)
+        return self._request_migration('import', state, timeout)
+
+    def _do_import(self, state):
+        t0 = self._clock()
+        prompt, emitted = state['prompt'], state['emitted']
+        pos = state['pos']
+        with self._lock:
+            if not self._free:
+                raise BackpressureError(len(self._pending),
+                                        self.max_queue)
+            slot = self._free.pop(0)
+        ids = []
+        npages = 0
+        try:
+            if self._cache is None:
+                if self.paged:
+                    self._rebuild_cache()
+                else:
+                    self._cache = self.program.new_cache()
+            if self.paged:
+                ps = self.program.page_size
+                npages = pages_for(pos, ps)
+                ids = self._alloc_pages(npages, slot)
+                if ids is None:
+                    ids = []
+                    with self._lock:
+                        self._counts['pool_exhausted'] += 1
+                        depth = len(self._pending)
+                    raise BackpressureError(depth, self.max_queue)
+                # re-chunk to THIS engine's page geometry: the rows
+                # are page-size-free, only the zero tail padding to
+                # whole pages differs (zeros = the pool's init state;
+                # masked until overwritten)
+                rows = {}
+                for name, arr in state['arrays'].items():
+                    pad = onp.zeros((npages * ps - pos,)
+                                    + arr.shape[1:], arr.dtype)
+                    rows[name] = onp.concatenate([arr, pad], axis=0)
+                try:
+                    self._cache = self.program.import_pages(
+                        self._cache, rows, ids)
+                except ValueError as exc:
+                    raise SeqStateError(
+                        'seqstate incompatible with this engine: %s'
+                        % (exc,))
+            else:
+                try:
+                    self._cache = self.program.import_slot_state(
+                        self._cache, state['arrays'], slot)
+                except ValueError as exc:
+                    raise SeqStateError(
+                        'seqstate incompatible with this engine: %s'
+                        % (exc,))
+        except BaseException:
+            with self._lock:
+                if self._allocator is not None:
+                    for p in ids:
+                        self._allocator.release(p)
+                self._free.append(slot)
+            raise
+        now = self._clock()
+        stream = GenerateStream(len(prompt))
+        # already streamed by the SOURCE engine: the full token list
+        # stays intact (finish budgets, done-line tokens) while the
+        # iterator yields only the continuation
+        stream.tokens = list(emitted)
+        seq = _Seq(stream, prompt, state['max_new'], state['eos_id'],
+                   now, now + self.timeout_s if self.timeout_s
+                   else None)
+        seq.slot = slot
+        seq.pos = pos
+        seq.last_token = state['last_token']
+        if emitted:
+            seq.first_token_at = now
+        if self.paged:
+            seq.table = onp.full(self.program.max_pages, TRASH_PAGE,
+                                 'int32')
+            seq.table[:npages] = ids
+            seq.pages = list(ids)
+            if self._prefix is not None and pos >= len(prompt):
+                # re-register the prompt so future shared-prefix
+                # admissions hit (one ref per newly registered page,
+                # exactly the admit-path contract)
+                with self._lock:
+                    self._prefix.register(prompt, ids)
+            if self._draft is not None:
+                # re-sync the draft from the fed context; a failure
+                # only lowers speculative acceptance (greedy verify
+                # keeps emitted tokens exactly target-greedy)
+                context = (prompt + emitted)[:pos]
+                try:
+                    self._draft_cache, _dt, _dl = \
+                        self._draft.run_prefill(
+                            self._draft_cache,
+                            onp.asarray(context, 'int32'), slot)
+                except Exception:
+                    logging.warning(
+                        'decode %s: draft re-sync failed on import; '
+                        'speculation degrades to low acceptance',
+                        self.name)
+        rid = state['request_id']
+        superseded = None
+        with self._lock:
+            self._counts['requests'] += 1
+            self._counts['migrated_in'] += 1
+            self._counts['handoff_pages'] += npages
+            if rid is not None:
+                superseded = self._requests.get(rid)
+                self._requests[rid] = stream
+            self._active[slot] = seq
+        if superseded is not None and not superseded.done():
+            superseded.cancel()    # at-most-once per request_id
+        dt = self._clock() - t0
+        inst = _serving_instruments()
+        if inst is not None:
+            inst.migration_seconds.observe(dt)
+            if npages:
+                inst.handoff_pages.inc(npages)
+        _record_event('seq_import', seq_kind=state['kind'], slot=slot,
+                      pos=int(pos), tokens=len(emitted), pages=npages,
+                      request_id=rid)
+        return stream
+
     # -- degraded completion -----------------------------------------------
 
     def _fallback_complete(self, seq):
@@ -1371,7 +1705,13 @@ class DecodeEngine:
     def close(self, drain=True, timeout=30.0):
         """Stop admissions; ``drain=True`` lets in-flight AND queued
         generations finish, ``drain=False`` fails them with
-        :class:`BatcherClosed`."""
+        :class:`BatcherClosed`.
+
+        A drain is BOUNDED: when ``timeout`` expires with work still
+        in flight (a wedged device call, a stream that cannot make
+        progress), the leftover streams fail typed with
+        :class:`DrainTimeout` and their slots/pages free — close never
+        returns with streams silently blocking forever."""
         with self._lock:
             self._closed = True
             if not drain:
@@ -1389,6 +1729,35 @@ class DecodeEngine:
                 if not self._pending and not self._active:
                     break
             time.sleep(0.01)
+        leftovers = []
+        with self._lock:
+            if drain and (self._pending or self._active):
+                leftovers = list(self._pending)
+                self._pending = []
+                for slot, seq in list(self._active.items()):
+                    leftovers.append(seq)
+                    del self._active[slot]
+                    self._free.append(slot)
+                    if self._allocator is not None and seq.pages:
+                        for p in seq.pages:
+                            self._allocator.release(p)
+                        seq.pages = []
+                self._counts['drain_timeouts'] += len(leftovers)
+            # migration requests the worker will never service now
+            orphans = list(self._migrations)
+            self._migrations = []
+        for seq in leftovers:
+            seq.stream._finish('error', DrainTimeout(
+                'stream unfinished after the %.1fs drain budget '
+                '(%d tokens emitted)'
+                % (timeout, len(seq.stream.tokens))))
+            _record_event('drain_timeout',
+                          tokens=len(seq.stream.tokens))
+        for _op, _arg, box, ev in orphans:
+            box['error'] = BatcherClosed(
+                'decode engine %r closed before the migration was '
+                'serviced' % self.name)
+            ev.set()
         self._worker.join(max(0.1, deadline - time.monotonic()))
         # degraded completions run off-worker; drain waits for them
         # too (zero-hang: no stream left mid-fallback at close)

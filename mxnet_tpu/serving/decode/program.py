@@ -46,7 +46,7 @@ from ..bucket import BucketPolicy, default_buckets
 from .cache import cache_avals, cache_bytes, init_cache
 from .model import DecodeModel, from_gluon_rnn_lm, model_from_config
 from .paged import (TRASH_PAGE, init_pool, pages_for, pool_avals,
-                    pool_bytes)
+                    pool_bytes, write_prefill_pages)
 from . import paged as _paged
 
 __all__ = ['DecodeProgram', 'PagedDecodeProgram', 'freeze_decode',
@@ -293,6 +293,35 @@ class DecodeProgram:
 
     def max_prompt_len(self):
         return self.policy.max_batch
+
+    # -- live migration (seqstate export/import) ----------------------------
+
+    def export_slot_state(self, cache, slot):
+        """Host snapshot of one slot's O(1) recurrent state, keyed by
+        cache entry name. Migration is a rare path: a plain host read,
+        no compiled program, zero impact on the step program's
+        zero-retrace contract."""
+        return {name: onp.asarray(arr[int(slot)])
+                for name, arr in cache.items()}
+
+    def import_slot_state(self, cache, state, slot):
+        """Land a host snapshot from :meth:`export_slot_state` into
+        ``slot`` of this engine's cache. Returns the new cache."""
+        import jax.numpy as jnp
+        from .cache import write_slot
+        out = dict(cache)
+        for name, arr in cache.items():
+            if name not in state:
+                raise ValueError('slot state missing cache entry %r'
+                                 % (name,))
+            row = onp.asarray(state[name])
+            if tuple(row.shape) != tuple(arr.shape[1:]):
+                raise ValueError(
+                    'slot state entry %r shape %r != per-slot shape %r'
+                    % (name, tuple(row.shape), tuple(arr.shape[1:])))
+            out[name] = write_slot(arr, jnp.asarray(
+                row.astype(arr.dtype, copy=False)), int(slot))
+        return out
 
     # -- CPU fallback (degraded serving) ------------------------------------
 
@@ -706,6 +735,50 @@ class PagedDecodeProgram(DecodeProgram):
         prog = self.compile_copy_page()
         return prog(self._params, pool, onp.int32(src),
                     onp.int32(dst))
+
+    # -- live migration (seqstate export/import) ----------------------------
+
+    def export_pages(self, pool, page_ids):
+        """Gather ``page_ids`` from the pool to host rows, keyed by
+        cache entry name: ``{name: (len(page_ids)*page_size, *row)}``.
+        The gather runs on device (only the requested pages cross to
+        host, not the pool); migration is rare, so eager ops — the
+        step program's zero-retrace contract is untouched."""
+        import jax.numpy as jnp
+        ids = onp.asarray(list(page_ids), 'int32')
+        out = {}
+        for name, arr in pool.items():
+            rows = onp.asarray(jnp.take(arr, ids, axis=0))
+            out[name] = rows.reshape(
+                (rows.shape[0] * rows.shape[1],) + rows.shape[2:])
+        return out
+
+    def import_pages(self, pool, rows, page_ids):
+        """Land host rows from :meth:`export_pages` (possibly
+        re-chunked to THIS engine's page size) into freshly allocated
+        ``page_ids``. ``rows[name]`` must be ``(len(page_ids) *
+        page_size, *row)`` — pad a partial tail page with zeros, which
+        is exactly the pool's init state (additive masks keep unused
+        rows inert). Returns the new pool."""
+        import jax.numpy as jnp
+        ids = onp.asarray(list(page_ids), 'int32')
+        want = ids.shape[0] * self.page_size
+        out = dict(pool)
+        for name, arr in pool.items():
+            if name not in rows:
+                raise ValueError('page rows missing cache entry %r'
+                                 % (name,))
+            chunk = onp.asarray(rows[name])
+            if chunk.shape[0] != want or \
+                    tuple(chunk.shape[1:]) != tuple(arr.shape[2:]):
+                raise ValueError(
+                    'page rows for %r are %r, want (%d, *%r)'
+                    % (name, tuple(chunk.shape), want,
+                       tuple(arr.shape[2:])))
+            out[name] = write_prefill_pages(
+                arr, jnp.asarray(chunk.astype(
+                    str(arr.dtype), copy=False)), ids)
+        return out
 
 
 def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,

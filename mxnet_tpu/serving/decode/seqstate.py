@@ -1,0 +1,185 @@
+"""Portable in-flight decode state: the ``mxnet_tpu.seqstate.v1``
+payload.
+
+PAPERS' "Compiler-First State Space Duality and Portable O(1)
+Autoregressive Caching" argues decode state should be a *portable,
+serializable artifact* rather than something welded to one process's
+device buffers. This module is that artifact for the continuous-
+batching engine: one JSON document per live sequence carrying
+everything another engine needs to continue it token-bit-identically
+under greedy decode —
+
+  * the scheduling state: prompt, emitted tokens, ``pos`` (KV rows /
+    recurrent steps consumed), ``last_token`` (the next feed),
+    ``max_new`` / ``eos_id`` (the ORIGINAL finish budget, so length
+    semantics survive the move), ``request_id`` (the gateway's
+    idempotency key);
+  * the device state, gathered to host rows: paged engines ship the
+    ``pos`` valid KV rows per cache entry (page geometry is NOT part
+    of the contract — rows re-chunk to the destination's page size at
+    import), slot engines (RNNLM) ship the O(1) per-slot recurrent
+    state arrays.
+
+Arrays ride base64 inside the JSON (stdlib transport — the payload
+crosses the gateway's ``/drain`` → ``/import`` hop as a plain JSON
+body), and the whole document is sealed with a blake2b digest so a
+torn or bit-flipped handoff is rejected TYPED (:class:`SeqStateError`)
+instead of silently decoding garbage KV state.
+
+numpy + stdlib only — importable without jax, testable without a
+device, the paged.py discipline.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+
+import numpy as onp
+
+__all__ = ['SEQSTATE_SCHEMA', 'SeqStateError', 'encode_array',
+           'decode_array', 'build_payload', 'decode_payload']
+
+SEQSTATE_SCHEMA = 'mxnet_tpu.seqstate.v1'
+
+_KINDS = ('paged', 'slot', 'cold')
+
+
+class SeqStateError(ValueError):
+    """Typed rejection of a seqstate payload: wrong schema version,
+    torn/corrupt content (digest mismatch, truncated arrays), or a
+    payload incompatible with the importing engine's cache layout."""
+
+
+def encode_array(arr):
+    """One host array as a JSON-able dict (shape + dtype + base64
+    bytes, C order)."""
+    arr = onp.ascontiguousarray(arr)
+    return {'shape': [int(d) for d in arr.shape],
+            'dtype': str(arr.dtype),
+            'data': base64.b64encode(arr.tobytes()).decode('ascii')}
+
+
+def decode_array(obj):
+    """Inverse of :func:`encode_array`; truncated/padded byte streams
+    reject typed (a torn handoff must never decode as garbage KV)."""
+    try:
+        shape = tuple(int(d) for d in obj['shape'])
+        dtype = onp.dtype(str(obj['dtype']))
+        raw = base64.b64decode(obj['data'].encode('ascii'),
+                               validate=True)
+    except (KeyError, TypeError, ValueError) as exc:
+        raise SeqStateError('malformed array block: %s' % (exc,))
+    want = dtype.itemsize
+    for d in shape:
+        want *= d
+    if len(raw) != want:
+        raise SeqStateError(
+            'torn array payload: %d bytes for shape %r dtype %s '
+            '(want %d)' % (len(raw), shape, dtype, want))
+    return onp.frombuffer(raw, dtype=dtype).reshape(shape)
+
+
+def _digest(doc):
+    """Seal over the canonical JSON of everything but the digest
+    field itself."""
+    body = {k: v for k, v in doc.items() if k != 'digest'}
+    blob = json.dumps(body, sort_keys=True,
+                      separators=(',', ':')).encode()
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def build_payload(kind, prompt, emitted, pos, last_token, max_new,
+                  eos_id=None, request_id=None, page_size=None,
+                  entries=None):
+    """Assemble one sealed ``mxnet_tpu.seqstate.v1`` document.
+
+    ``entries`` maps cache entry name to a host array: for ``paged``
+    kind the ``(pos, *row_shape)`` valid KV rows (page-geometry-free:
+    the importer re-chunks to its own page size), for ``slot`` kind
+    the per-slot recurrent state arrays. ``cold`` sequences (still
+    queued, no device state yet) carry no entries and import through
+    the ordinary admission path.
+    """
+    if kind not in _KINDS:
+        raise ValueError('kind must be one of %r, got %r'
+                         % (_KINDS, kind))
+    doc = {
+        'schema': SEQSTATE_SCHEMA,
+        'kind': kind,
+        'request_id': request_id,
+        'prompt': [int(t) for t in prompt],
+        'emitted': [int(t) for t in emitted],
+        'pos': int(pos),
+        'last_token': None if last_token is None else int(last_token),
+        'max_new': int(max_new),
+        'eos_id': None if eos_id is None else int(eos_id),
+        'entries': {str(k): encode_array(v)
+                    for k, v in (entries or {}).items()},
+    }
+    if page_size is not None:
+        doc['page_size'] = int(page_size)
+    doc['digest'] = _digest(doc)
+    return doc
+
+
+def decode_payload(obj):
+    """Validate + decode a payload into host state.
+
+    Returns ``{'kind', 'request_id', 'prompt', 'emitted', 'pos',
+    'last_token', 'max_new', 'eos_id', 'page_size', 'arrays'}`` with
+    ``arrays`` holding decoded numpy arrays per cache entry. Raises
+    :class:`SeqStateError` on a version mismatch, a digest mismatch
+    (torn payload), or structurally invalid content.
+    """
+    if not isinstance(obj, dict):
+        raise SeqStateError('seqstate payload must be a JSON object, '
+                            'got %s' % type(obj).__name__)
+    schema = obj.get('schema')
+    if schema != SEQSTATE_SCHEMA:
+        raise SeqStateError('seqstate version mismatch: got %r, this '
+                            'engine speaks %r' % (schema,
+                                                  SEQSTATE_SCHEMA))
+    if obj.get('digest') != _digest(obj):
+        raise SeqStateError('torn seqstate payload: digest mismatch '
+                            '(content corrupted in transit)')
+    kind = obj.get('kind')
+    if kind not in _KINDS:
+        raise SeqStateError('unknown seqstate kind %r' % (kind,))
+    try:
+        prompt = [int(t) for t in obj['prompt']]
+        emitted = [int(t) for t in obj.get('emitted') or []]
+        pos = int(obj['pos'])
+        max_new = int(obj['max_new'])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise SeqStateError('malformed seqstate payload: %s' % (exc,))
+    if not prompt:
+        raise SeqStateError('seqstate payload has an empty prompt')
+    if pos < 0 or pos > len(prompt) + len(emitted):
+        raise SeqStateError(
+            'inconsistent seqstate: pos=%d outside prompt(%d)+'
+            'emitted(%d)' % (pos, len(prompt), len(emitted)))
+    last_token = obj.get('last_token')
+    if kind != 'cold' and last_token is None:
+        raise SeqStateError('live seqstate payload missing last_token')
+    arrays = {name: decode_array(blk)
+              for name, blk in (obj.get('entries') or {}).items()}
+    if kind == 'paged':
+        for name, arr in arrays.items():
+            if arr.shape[0] != pos:
+                raise SeqStateError(
+                    'paged entry %r carries %d rows for pos=%d'
+                    % (name, arr.shape[0], pos))
+    eos_id = obj.get('eos_id')
+    return {
+        'kind': kind,
+        'request_id': obj.get('request_id'),
+        'prompt': prompt,
+        'emitted': emitted,
+        'pos': pos,
+        'last_token': None if last_token is None else int(last_token),
+        'max_new': max_new,
+        'eos_id': None if eos_id is None else int(eos_id),
+        'page_size': obj.get('page_size'),
+        'arrays': arrays,
+    }

@@ -59,6 +59,7 @@ import json
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 __all__ = ['ReplicaState', 'ServingGateway', 'TokenBucket',
@@ -295,7 +296,8 @@ class ServingGateway:
                  health_period_s=None, timeout_s=None, resume=None,
                  resume_max=None, affinity=None, tenant_header=None,
                  tenant_rps=None, tenant_burst=None,
-                 tenant_max_inflight=None, tenant_weights=None):
+                 tenant_max_inflight=None, tenant_weights=None,
+                 journal_max=None):
         urls = list(replicas)
         if not urls:
             raise ValueError('gateway needs at least one replica URL')
@@ -317,6 +319,13 @@ class ServingGateway:
         self.resume_max = int(
             resume_max if resume_max is not None
             else _knob('MXNET_TPU_GATEWAY_RESUME_MAX', 2))
+        # journal bound (tokens per stream); 0 = unbounded. Past the
+        # cap the journal keeps only the per-stream COUNT of relayed
+        # tokens: a resume re-admits the original prompt and dedups
+        # the regenerated prefix by index (greedy determinism).
+        self.journal_max = int(
+            journal_max if journal_max is not None
+            else _knob('MXNET_TPU_GATEWAY_JOURNAL_MAX', 0))
         self.affinity = bool(
             affinity if affinity is not None
             else _knob('MXNET_TPU_GATEWAY_AFFINITY', True))
@@ -348,7 +357,8 @@ class ServingGateway:
         self._stats = {'requests': 0, 'failovers': 0, 'shed': 0,
                        'passthrough_429': 0, 'resumes': 0,
                        'resume_failures': 0, 'affinity_routed': 0,
-                       'tenant_shed': 0}
+                       'tenant_shed': 0, 'migrated_streams': 0,
+                       'migration_failures': 0, 'journal_capped': 0}
         self._stats_lock = threading.Lock()
 
     # -- health ------------------------------------------------------------
@@ -656,7 +666,19 @@ class ServingGateway:
                 relay token lines while recording them; on replica
                 death re-admit prompt+emitted on a healthy replica and
                 splice the continuation into the SAME client chunked
-                stream, deduping by token index (at-most-once)."""
+                stream, deduping by token index (at-most-once).
+
+                A DRAINING replica finishes the stream with a clean
+                ``finish_reason: "migrated"`` done line instead of an
+                abort: the gateway fetches the exported seqstate from
+                the replica's GET /drain, lands it on a healthy
+                replica via POST /import (no re-prefill — the KV
+                pages travel in the payload), and splices the
+                continuation the same way. Past
+                ``MXNET_TPU_GATEWAY_JOURNAL_MAX`` journaled tokens
+                the journal degrades to a COUNT: a later resume
+                re-admits the original prompt and dedups the
+                regenerated prefix by index."""
                 prompt = [int(t) for t in req['tokens']]
                 orig_max_new = req.get('max_new_tokens')
                 if orig_max_new is not None:
@@ -664,7 +686,11 @@ class ServingGateway:
                 request_id = req.get('request_id') \
                     or gw._next_request_id()
                 emitted = []        # journal: token values relayed
+                relayed = 0         # dedup watermark (survives cap)
+                capped = False      # journal overflowed journal_max
                 attempts = 0        # resume attempts consumed
+                spliced = 0         # drain handoffs spliced in
+                migrate = None      # seqstate awaiting POST /import
                 started = False     # client headers sent
                 tried = []          # replicas tried for this segment
                 while True:
@@ -682,38 +708,74 @@ class ServingGateway:
                                 request_id=request_id,
                                 attempts=attempts,
                                 reason='no_healthy_replica',
-                                tokens=len(emitted))
+                                tokens=relayed)
+                            out = {
+                                'done': True,
+                                'error': 'no healthy serving '
+                                         'replica to resume '
+                                         'stream (%d tokens '
+                                         'emitted, %d resume '
+                                         'attempts)'
+                                         % (relayed, attempts),
+                                'error_class': 'ReplicaLost',
+                                'tokens': list(emitted),
+                                'resumed': attempts,
+                                'request_id': request_id}
+                            if capped:
+                                out['journal_capped'] = True
                             try:
-                                handler._chunk_obj({
-                                    'done': True,
-                                    'error': 'no healthy serving '
-                                             'replica to resume '
-                                             'stream (%d tokens '
-                                             'emitted, %d resume '
-                                             'attempts)'
-                                             % (len(emitted),
-                                                attempts),
-                                    'error_class': 'ReplicaLost',
-                                    'tokens': list(emitted),
-                                    'resumed': attempts,
-                                    'request_id': request_id})
+                                handler._chunk_obj(out)
                             except OSError:
                                 return
                             handler._end_chunks()
                         return
                     tried.append(rep)
-                    payload = dict(req, request_id=request_id)
-                    if emitted:
-                        payload['tokens'] = prompt + emitted
-                        payload['start_index'] = len(emitted)
-                        if orig_max_new is not None:
-                            payload['max_new_tokens'] = \
-                                orig_max_new - len(emitted)
-                    body = json.dumps(payload).encode()
+                    if migrate is not None:
+                        seg_path = '/import'
+                        body = json.dumps({'seqstate': migrate,
+                                           'stream': True}).encode()
+                    else:
+                        seg_path = '/generate'
+                        payload = dict(req, request_id=request_id)
+                        if relayed and capped:
+                            # the token VALUES are gone — re-admit
+                            # the original prompt; greedy decode
+                            # re-derives the delivered prefix and the
+                            # index dedup below keeps the client at
+                            # at-most-once
+                            pass
+                        elif emitted:
+                            payload['tokens'] = prompt + emitted
+                            payload['start_index'] = len(emitted)
+                            if orig_max_new is not None:
+                                payload['max_new_tokens'] = \
+                                    orig_max_new - len(emitted)
+                        body = json.dumps(payload).encode()
                     try:
-                        resp = gw._forward(rep, '/generate', body,
+                        resp = gw._forward(rep, seg_path, body,
                                            ctype, tenant=tenant)
                     except urllib.error.HTTPError as exc:
+                        if migrate is not None:
+                            # the import target refused the handoff
+                            # (backpressure, geometry/version check):
+                            # drop to the plain resume path — the
+                            # journal (or the capped re-prefill) still
+                            # completes the stream
+                            try:
+                                exc.read()
+                            except Exception:
+                                pass
+                            gw._bump('migration_failures')
+                            inst = _instruments()
+                            if inst is not None:
+                                inst.migration_failures.inc()
+                            _record_event('gateway_migrate_failed',
+                                          request_id=request_id,
+                                          reason='import %d'
+                                                 % exc.code,
+                                          tokens=relayed)
+                            migrate = None
+                            continue
                         if not started:
                             if exc.code in (500, 502, 503):
                                 # a typed 5xx at admission (e.g. the
@@ -768,10 +830,22 @@ class ServingGateway:
                                             'chunked')
                         handler.end_headers()
                         started = True
+                    if seg_path == '/import':
+                        spliced += 1
+                        gw._bump('migrated_streams')
+                        inst = _instruments()
+                        if inst is not None:
+                            inst.migrations.inc()
+                        _record_event('gateway_migrate',
+                                      request_id=request_id,
+                                      to_url=rep.base_url,
+                                      tokens=relayed)
+                        migrate = None
                     segment_tokens = 0
                     abort_line = None       # typed upstream abort obj
                     dead = False            # transport death
                     done = False            # clean final line relayed
+                    migrating = False       # drain handoff announced
                     try:
                         with resp:
                             for line in resp:
@@ -787,20 +861,53 @@ class ServingGateway:
                                 if 'token' in obj:
                                     idx = obj.get('index')
                                     if idx is not None \
-                                            and idx < len(emitted):
+                                            and idx < relayed:
                                         continue   # dedup: delivered
-                                    emitted.append(obj['token'])
+                                    relayed += 1
+                                    if not capped:
+                                        emitted.append(obj['token'])
+                                        if 0 < gw.journal_max \
+                                                < len(emitted):
+                                            # past the cap the journal
+                                            # degrades to the relayed
+                                            # COUNT (typed re-prefill
+                                            # fallback on resume)
+                                            capped = True
+                                            emitted = []
+                                            gw._bump('journal_capped')
+                                            inst = _instruments()
+                                            if inst is not None:
+                                                inst.journal_capped \
+                                                    .inc()
+                                            _record_event(
+                                                'gateway_journal'
+                                                '_capped',
+                                                request_id=request_id,
+                                                tokens=relayed)
                                     segment_tokens += 1
                                     handler._chunk_line(
                                         line.rstrip(b'\n') + b'\n')
                                 elif obj.get('done'):
                                     if obj.get('error'):
                                         abort_line = obj
+                                    elif obj.get('finish_reason') \
+                                            == 'migrated':
+                                        # clean drain handoff: do NOT
+                                        # relay — fetch the seqstate
+                                        # and splice the continuation
+                                        migrating = True
                                     else:
-                                        if attempts:
-                                            obj['tokens'] = \
-                                                list(emitted)
+                                        if attempts or spliced:
+                                            if not capped:
+                                                obj['tokens'] = \
+                                                    list(emitted)
+                                            else:
+                                                obj['journal_capped']\
+                                                    = True
                                             obj['resumed'] = attempts
+                                            if spliced:
+                                                obj['migrated'] = \
+                                                    spliced
                                             obj['request_id'] = \
                                                 request_id
                                             handler._chunk_obj(obj)
@@ -821,13 +928,54 @@ class ServingGateway:
                     except OSError:
                         return     # client went away mid-stream
                     if done:
-                        if attempts and segment_tokens:
+                        if (attempts or spliced) and segment_tokens:
                             inst = _instruments()
                             if inst is not None:
                                 inst.resumed_tokens.inc(
                                     segment_tokens)
                         handler._end_chunks()
                         return
+                    if migrating:
+                        # the replica drained under us: pull the
+                        # exported seqstate (KV pages + position +
+                        # emitted tokens) and continue on a healthy
+                        # replica with ZERO re-prefill. The migrated
+                        # done line races the drain worker publishing
+                        # the payload set (ours streams out while the
+                        # worker is still exporting its siblings), so
+                        # poll until it lands or the drain completes
+                        # without it.
+                        drain_path = '/drain?request_id=' \
+                            + urllib.parse.quote(str(request_id))
+                        deadline = time.monotonic() \
+                            + min(gw.timeout_s, 10.0)
+                        seqs = []
+                        while True:
+                            snap = gw._fetch_json(rep, drain_path) \
+                                or {}
+                            seqs = snap.get('sequences') or []
+                            if seqs or 'error' in snap \
+                                    or snap.get('complete') \
+                                    or time.monotonic() >= deadline:
+                                break
+                            time.sleep(0.05)
+                        rep.mark(False, 'draining')
+                        gw._note_health(len(gw.healthy_replicas()))
+                        if seqs:
+                            migrate = seqs[0]
+                            tried = [rep]
+                            continue
+                        # nothing to import (the drain window closed
+                        # or the sequence finished): plain resume
+                        gw._bump('migration_failures')
+                        inst = _instruments()
+                        if inst is not None:
+                            inst.migration_failures.inc()
+                        _record_event('gateway_migrate_failed',
+                                      request_id=request_id,
+                                      reason='no_payload',
+                                      tokens=relayed)
+                        dead = True
                     if not dead and abort_line is None:
                         # stream ended without a done line: the
                         # replica terminated the chunks while dying —
@@ -853,7 +1001,8 @@ class ServingGateway:
                             cause='transport' if dead else str(
                                 abort_line.get('error_class')
                                 or 'error'),
-                            tokens=len(emitted))
+                            tokens=relayed,
+                            journal_capped=capped)
                         tried = [rep]
                         continue
                     gw._bump('resume_failures')
@@ -864,7 +1013,7 @@ class ServingGateway:
                                   request_id=request_id,
                                   attempts=attempts,
                                   reason='budget_exhausted',
-                                  tokens=len(emitted))
+                                  tokens=relayed)
                     out = dict(abort_line) if abort_line is not None \
                         else {'done': True,
                               'error': 'replica lost mid-stream '
@@ -873,6 +1022,8 @@ class ServingGateway:
                                        % attempts,
                               'error_class': 'ReplicaLost'}
                     out['tokens'] = list(emitted)
+                    if capped:
+                        out['journal_capped'] = True
                     out['resumed'] = attempts
                     out['request_id'] = request_id
                     try:
@@ -1014,6 +1165,11 @@ class ServingGateway:
     def stats(self):
         with self._stats_lock:
             out = dict(self._stats)
+        out['migrations'] = {
+            'spliced': out.pop('migrated_streams', 0),
+            'failures': out.pop('migration_failures', 0),
+            'journal_capped': out.pop('journal_capped', 0),
+        }
         out['healthy'] = len(self.healthy_replicas())
         out['replicas'] = len(self.replicas)
         if self.admission is not None:

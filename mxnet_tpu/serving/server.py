@@ -34,6 +34,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 
 import numpy as onp
 
@@ -487,6 +488,21 @@ class ServingHTTPServer:
             else _knob('MXNET_TPU_SERVE_MAX_CONCURRENT', 0))
         self._httpd = None
         self._thread = None
+        # graceful drain (docs/SERVING.md "Drain & live migration"):
+        # begin_drain() flips /healthz to 'draining', sheds new
+        # admissions 503-typed, exports every in-flight sequence as a
+        # seqstate payload served over GET /drain, and records the
+        # resumable exit code once the handoff completes
+        self._draining = False
+        self._drain_lock = threading.Lock()
+        self._drain_payloads = []
+        self._drain_unserved = set()
+        self._drain_result = None
+        self._drain_done = threading.Event()
+        self._drain_thread = None
+        self._preempt = None
+        self._preempt_stop = threading.Event()
+        self._preempt_thread = None
 
     def start(self):
         if self._httpd is not None:
@@ -497,6 +513,7 @@ class ServingHTTPServer:
         decode_session = self.decode_session
         limit = self.max_concurrent
         gate = threading.BoundedSemaphore(limit) if limit > 0 else None
+        srv = self
 
         def _statuses():
             st = session.status()
@@ -525,7 +542,9 @@ class ServingHTTPServer:
                 handler.wfile.write(body)
 
             def do_GET(handler):
-                path = handler.path.rstrip('/')
+                from urllib.parse import parse_qs, urlparse
+                parsed = urlparse(handler.path)
+                path = parsed.path.rstrip('/')
                 if path == '/status':
                     payload, _worst = _statuses()
                     handler._json(200, payload)
@@ -533,11 +552,21 @@ class ServingHTTPServer:
                     # a load balancer keys on the status code: an
                     # unhealthy replica (breaker open / degraded) must
                     # answer 503 so it is routed around, while the
-                    # JSON body keeps the human-readable detail
+                    # JSON body keeps the human-readable detail.
+                    # 'draining' rides the same 503 body: the gateway
+                    # routes away but still fetches /drain payloads
+                    if srv._draining:
+                        handler._json(503, {'ok': False,
+                                            'status': 'draining'})
+                        return
                     _payload, worst = _statuses()
                     ok = worst == 'ok'
                     handler._json(200 if ok else 503,
                                   {'ok': ok, 'status': worst})
+                elif path == '/drain':
+                    q = parse_qs(parsed.query)
+                    rid = (q.get('request_id') or [None])[0]
+                    handler._json(200, srv._drain_snapshot(rid))
                 else:
                     handler.send_error(404)
 
@@ -588,6 +617,16 @@ class ServingHTTPServer:
                         done['request_id'] = request_id
                     handler._json(200, done)
                     return
+                handler._stream_ndjson(stream, start_index,
+                                       request_id)
+
+            def _stream_ndjson(handler, stream, start_index,
+                               request_id):
+                """Chunked NDJSON relay of one GenerateStream: a
+                {"token","index"} line per token, then the done line.
+                A 'migrated' finish is NOT an error — the gateway
+                fetches the exported seqstate from /drain and splices
+                the continuation into the same client stream."""
                 handler.send_response(200)
                 handler.send_header('Content-Type',
                                     'application/x-ndjson')
@@ -629,9 +668,45 @@ class ServingHTTPServer:
                 except OSError:
                     pass
 
+            def _import(handler, req):
+                """POST /import — land an exported seqstate payload
+                (GET /drain on the draining replica) in this
+                replica's engine and stream the continuation. No
+                prefill runs; token indices continue at the number of
+                tokens the source already emitted."""
+                gen = decode_session if decode_session is not None \
+                    else session
+                if gen._engine is None:
+                    handler._json(400, {'error': '/import needs a '
+                                                 'decode-mode session'})
+                    return
+                payload = req.get('seqstate')
+                if not isinstance(payload, dict):
+                    handler._json(400,
+                                  {'error': "need 'seqstate' (a "
+                                            "mxnet_tpu.seqstate.v1 "
+                                            "object)"})
+                    return
+                stream = gen._engine.import_sequence(payload)
+                start_index = len(payload.get('emitted') or [])
+                request_id = payload.get('request_id')
+                if not req.get('stream', True):
+                    wait_s = (gen._engine.timeout_s
+                              or _HTTP_MAX_WAIT_S)
+                    toks = stream.result(wait_s)
+                    done = {'tokens': toks,
+                            'finish_reason': stream.finish_reason,
+                            'degraded': stream.degraded}
+                    if request_id is not None:
+                        done['request_id'] = request_id
+                    handler._json(200, done)
+                    return
+                handler._stream_ndjson(stream, start_index,
+                                       request_id)
+
             def _retry_after(handler, path):
                 src = decode_session \
-                    if (path == '/generate'
+                    if (path in ('/generate', '/import')
                         and decode_session is not None) else session
                 try:
                     return float(src.retry_after_hint())
@@ -640,8 +715,27 @@ class ServingHTTPServer:
 
             def do_POST(handler):
                 path = handler.path.rstrip('/')
-                if path not in ('/predict', '/generate'):
+                if path not in ('/predict', '/generate', '/import'):
                     handler.send_error(404)
+                    return
+                if srv._draining:
+                    # drain admission stop: every new request — and
+                    # every seqstate import, this replica is leaving —
+                    # sheds typed 503 before any byte streams, so the
+                    # gateway fails over cleanly
+                    try:
+                        length = int(handler.headers.get(
+                            'Content-Length', 0) or 0)
+                        if length:
+                            handler.rfile.read(length)
+                    except (ValueError, OSError):
+                        pass
+                    handler._json(
+                        503,
+                        {'error': 'replica draining (sequences are '
+                                  'being handed off)',
+                         'error_class': 'Draining'},
+                        headers={'Retry-After': '1'})
                     return
                 if gate is not None \
                         and not gate.acquire(blocking=False):
@@ -693,6 +787,8 @@ class ServingHTTPServer:
                 try:
                     if path == '/generate':
                         handler._generate(req)
+                    elif path == '/import':
+                        handler._import(req)
                     elif 'instances' in req:
                         futs = [session.submit(onp.asarray(x))
                                 for x in req['instances']]
@@ -772,7 +868,173 @@ class ServingHTTPServer:
         self._thread.start()
         return self
 
+    # -- graceful drain (docs/SERVING.md "Drain & live migration") ---------
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def drain_result(self):
+        """``{'rc', 'reason', 'sequences', 'handed_off',
+        'duration_s'}`` once the drain completes (``rc`` is the
+        resumable exit code, 75 by default), else None."""
+        with self._drain_lock:
+            return dict(self._drain_result) \
+                if self._drain_result else None
+
+    def install_preempt_hook(self, handler=None, poll_s=0.05):
+        """Arm the SIGTERM/SIGINT → graceful-drain path (the serving
+        analog of training's PreemptionHandler protocol): the signal
+        only sets a flag; a watcher thread notices it and calls
+        :meth:`begin_drain`. Pass an existing
+        :class:`~..resilience.preempt.PreemptionHandler` to share one
+        (e.g. scripted ``preempt`` faults); otherwise one is created
+        and installed. Returns the handler — the process's main
+        thread pairs this with :meth:`serve_until_drained` to exit
+        with the resumable code."""
+        from ..resilience.preempt import PreemptionHandler
+        if self._preempt is not None:
+            return self._preempt
+        if handler is None:
+            handler = PreemptionHandler().install()
+        self._preempt = handler
+
+        def _watch():
+            while not self._preempt_stop.wait(poll_s):
+                if handler.stop_requested:
+                    self.begin_drain(reason=handler.reason
+                                     or 'preempted')
+                    return
+
+        self._preempt_thread = threading.Thread(
+            target=_watch, daemon=True,
+            name='mxnet-tpu-serving-preempt')
+        self._preempt_thread.start()
+        return handler
+
+    def begin_drain(self, reason='requested', handoff_timeout_s=None):
+        """Start a graceful drain (idempotent): /healthz answers 503
+        ``draining``, new POSTs shed typed, every in-flight sequence
+        exports to a seqstate payload served over GET /drain, and the
+        drain result (resumable rc) records once payloads are handed
+        off (or ``handoff_timeout_s``, default
+        ``MXNET_TPU_SERVE_DRAIN_TIMEOUT_S``, expires)."""
+        with self._drain_lock:
+            if self._draining:
+                return self
+            self._draining = True
+        if handoff_timeout_s is None:
+            handoff_timeout_s = float(
+                _knob('MXNET_TPU_SERVE_DRAIN_TIMEOUT_S', 30.0))
+        t0 = time.monotonic()
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.serving_instruments().drains.inc()
+                _obs.record_event('drain_begin', reason=reason)
+        except Exception:
+            pass
+        self._drain_thread = threading.Thread(
+            target=self._drain_worker,
+            args=(reason, t0, float(handoff_timeout_s)),
+            daemon=True, name='mxnet-tpu-serving-drain')
+        self._drain_thread.start()
+        return self
+
+    def wait_drained(self, timeout=None):
+        """Block until the drain completes; returns True when it has
+        (then :attr:`drain_result` is populated)."""
+        return self._drain_done.wait(timeout)
+
+    def serve_until_drained(self, timeout=None):
+        """Real-process shape: block the main thread until a drain
+        completes, then raise
+        :class:`~..resilience.preempt.Preempted` so the process exits
+        with the resumable code (rc 75) a scheduler restarts."""
+        from ..resilience.preempt import Preempted, \
+            resumable_exit_code
+        self._drain_done.wait(timeout)
+        res = self.drain_result or {}
+        raise Preempted(res.get('rc', resumable_exit_code()),
+                        reason=res.get('reason', 'drained'))
+
+    def _drain_snapshot(self, request_id=None):
+        """GET /drain response; serving a payload marks it handed
+        off (the drain completes once every payload is fetched)."""
+        with self._drain_lock:
+            if request_id is not None:
+                picked = [i for i, p in
+                          enumerate(self._drain_payloads)
+                          if p.get('request_id') == request_id]
+            else:
+                picked = list(range(len(self._drain_payloads)))
+            seqs = [self._drain_payloads[i] for i in picked]
+            self._drain_unserved.difference_update(picked)
+            doc = {'schema': 'mxnet_tpu.drain.v1',
+                   'draining': self._draining,
+                   'complete': self._drain_done.is_set(),
+                   'pending': len(self._drain_unserved),
+                   'sequences': seqs}
+        return doc
+
+    def _drain_worker(self, reason, t0, handoff_timeout_s):
+        sessions = [s for s in (self.session, self.decode_session)
+                    if s is not None
+                    and getattr(s, '_engine', None) is not None]
+        payloads = []
+        for s in sessions:
+            try:
+                payloads.extend(s._engine.export_all())
+            except Exception:
+                logging.exception('drain: export_all failed on '
+                                  'session %r', getattr(s, 'name', s))
+        with self._drain_lock:
+            self._drain_payloads = payloads
+            self._drain_unserved = set(range(len(payloads)))
+        # the handoff window: the gateway (or an operator) fetches
+        # the payloads over GET /drain; a replica with no consumer
+        # moves on once the window closes
+        deadline = t0 + handoff_timeout_s
+        while payloads and time.monotonic() < deadline:
+            with self._drain_lock:
+                if not self._drain_unserved:
+                    break
+            time.sleep(0.02)
+        for s in sessions:
+            try:
+                s.close(drain=True)
+            except Exception:
+                logging.exception('drain: close failed on session %r',
+                                  getattr(s, 'name', s))
+        dt = time.monotonic() - t0
+        from ..resilience.preempt import resumable_exit_code
+        with self._drain_lock:
+            handed = len(payloads) - len(self._drain_unserved)
+            self._drain_result = {
+                'rc': resumable_exit_code(),
+                'reason': reason,
+                'sequences': len(payloads),
+                'handed_off': handed,
+                'duration_s': round(dt, 3),
+            }
+        self._drain_done.set()
+        try:
+            from .. import observability as _obs
+            if _obs.enabled():
+                _obs.serving_instruments().drain_seconds.observe(dt)
+                _obs.record_event('drain_complete', reason=reason,
+                                  sequences=len(payloads),
+                                  handed_off=handed,
+                                  duration_s=round(dt, 3))
+        except Exception:
+            pass
+
     def stop(self):
+        self._preempt_stop.set()
+        if self._preempt_thread is not None:
+            self._preempt_thread.join(timeout=2.0)
+            self._preempt_thread = None
         if self._httpd is None:
             return
         self._httpd.shutdown()

@@ -1,0 +1,469 @@
+"""Live decode-state migration (docs/SERVING.md "Drain & live
+migration"): export/import round-trip bit-identity across page sizes
+including cross-page-size re-chunking, mid-stream churn, prefix-hit
+and speculative-decode sources, the RNNLM O(1) slot handoff, typed
+rejection of torn/version-mismatched payloads, the bounded
+close(drain=True) DrainTimeout contract against a wedged program, the
+gateway resume-journal cap, and the ServingHTTPServer drain lifecycle
+over real HTTP (healthz flip, typed shed, /drain handoff, rc 75)."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mxnet_tpu.serving.decode import (DecodeEngine, DecodeProgram,
+                                      DrainTimeout, PagedDecodeProgram,
+                                      SEQSTATE_SCHEMA, SeqStateError,
+                                      init_rnn_lm, init_transformer_lm)
+
+_PROMPT = [3, 5, 7, 11, 2, 9, 4, 6, 8, 10]
+
+
+def _model(seed=0, max_len=64):
+    return init_transformer_lm(vocab=23, units=16, hidden=32, layers=1,
+                               heads=2, max_len=max_len, seed=seed)
+
+
+def _paged(model, params, page_size, pages, **kw):
+    kw.setdefault('slots', 2)
+    kw.setdefault('prefill_buckets', (8, 16))
+    return PagedDecodeProgram(model, params, page_size=page_size,
+                              pages=pages, **kw)
+
+
+def _reference(prog, prompt, n):
+    eng = DecodeEngine(prog, timeout_s=60.0)
+    try:
+        return eng.generate(prompt, max_new_tokens=n).result(60)
+    finally:
+        eng.close()
+
+
+def _export_after_first_token(eng, prompt, n, **kw):
+    """Admit, wait for the stream to go live (>= 1 token), export."""
+    s = eng.generate(prompt, max_new_tokens=n, **kw)
+    next(iter(s))
+    payload = eng.export_sequence(s, timeout=30)
+    assert s.finish_reason == 'migrated' and s.exception() is None
+    return s, payload
+
+
+def _continue_on(dst_eng, payload):
+    """Import and splice: handed-off prefix + freshly decoded tail."""
+    return list(payload['emitted']) + list(
+        dst_eng.import_sequence(payload, timeout=30))
+
+
+# ---------------------------------------------------------------------------
+# round-trip bit-identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('src_ps,dst_ps',
+                         [(8, 8), (8, 16), (16, 128), (128, 8)])
+def test_roundtrip_bit_identical_across_page_sizes(src_ps, dst_ps):
+    """KV pages re-chunk to the destination geometry and the spliced
+    stream equals the never-migrated greedy run — the destination
+    runs ZERO prefills."""
+    model, params = _model()
+    pages = {8: 32, 16: 16, 128: 2}
+    n = 20
+    want = _reference(_paged(model, params, src_ps, pages[src_ps]),
+                      _PROMPT, n)
+    src = DecodeEngine(_paged(model, params, src_ps, pages[src_ps]),
+                       timeout_s=60.0)
+    dst = DecodeEngine(_paged(model, params, dst_ps, pages[dst_ps]),
+                       timeout_s=60.0)
+    try:
+        _s, payload = _export_after_first_token(src, _PROMPT, n)
+        assert payload['schema'] == SEQSTATE_SCHEMA
+        assert payload['kind'] == 'paged'
+        got = _continue_on(dst, payload)
+        assert got == want
+        sc, dc = src.stats()['counts'], dst.stats()['counts']
+        assert dc['prefills'] == 0
+        assert sc['migrated_out'] == 1 and dc['migrated_in'] == 1
+        assert sc['handoff_pages'] > 0 and dc['handoff_pages'] > 0
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_export_midstream_with_churn_leaves_neighbors_intact():
+    """Exporting one sequence while a sibling decodes in the adjacent
+    slot: the migrated splice AND the untouched neighbor both match
+    their references."""
+    model, params = _model()
+    n = 16
+    other = [1, 2, 3, 4]
+    ref_prog = _paged(model, params, 8, 32)
+    want_mig = _reference(ref_prog, _PROMPT, n)
+    want_other = _reference(_paged(model, params, 8, 32), other, n)
+    src = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    dst = DecodeEngine(_paged(model, params, 16, 16), timeout_s=60.0)
+    try:
+        neighbor = src.generate(other, max_new_tokens=n)
+        _s, payload = _export_after_first_token(src, _PROMPT, n)
+        got = _continue_on(dst, payload)
+        assert got == want_mig
+        assert neighbor.result(60) == want_other
+        assert dst.stats()['counts']['prefills'] == 0
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_queued_sequence_exports_cold_and_readmits():
+    """A still-queued sequence has no KV yet: it exports ``cold`` and
+    lands through the destination's ORDINARY admission (one prefill —
+    the re-prefill exemption is for warm handoffs only)."""
+    model, params = _model()
+    n = 8
+    want = _reference(_paged(model, params, 8, 32), _PROMPT, n)
+    src = DecodeEngine(_paged(model, params, 8, 32, slots=1),
+                       timeout_s=60.0)
+    dst = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    try:
+        hog = src.generate([2, 4, 6], max_new_tokens=32)
+        queued = src.generate(_PROMPT, max_new_tokens=n)
+        payload = src.export_sequence(queued, timeout=30)
+        assert payload['kind'] == 'cold'
+        assert payload['emitted'] == []
+        assert queued.finish_reason == 'migrated'
+        got = dst.import_sequence(payload, timeout=30).result(60)
+        assert got == want
+        assert dst.stats()['counts']['prefills'] == 1
+        hog.cancel()
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_prefix_hit_sequence_migrates_bit_identical():
+    """A sequence admitted through a prefix-cache hit (shared pages,
+    no own prefill) still exports its full valid KV rows."""
+    model, params = _model()
+    base = [7, 2, 9, 4, 1, 3, 5, 8, 6, 2]
+    n = 8
+    want = _reference(_paged(model, params, 8, 32), base, n)
+    src = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    dst = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    try:
+        assert src.generate(base, max_new_tokens=n).result(60) == want
+        _s, payload = _export_after_first_token(src, base, n)
+        assert _continue_on(dst, payload) == want
+        sc = src.stats()['counts']
+        assert sc['prefix_hits'] >= 1
+        assert dst.stats()['counts']['prefills'] == 0
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_spec_decode_source_migrates_to_plain_engine():
+    """A speculative (draft+verify) source hands off mid-stream to a
+    plain paged engine; the spliced stream equals the non-speculative
+    greedy run."""
+    model, params = _model()
+    n = 12
+    want = _reference(_paged(model, params, 8, 32), _PROMPT, n)
+    target = _paged(model, params, 8, 32, spec_k=2)
+    draft = DecodeProgram(model, params, slots=2,
+                          prefill_buckets=(8, 16))
+    src = DecodeEngine(target, draft=draft, timeout_s=60.0)
+    dst = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    try:
+        _s, payload = _export_after_first_token(src, _PROMPT, n)
+        assert payload['kind'] == 'paged'
+        assert _continue_on(dst, payload) == want
+        assert dst.stats()['counts']['prefills'] == 0
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_rnn_slot_state_exports_o1_and_splices():
+    """RNNLM slot engines hand off the O(1) recurrent state — no KV
+    rows travel — and the continuation is bit-identical."""
+    model, params = init_rnn_lm(vocab=23, embed=8, hidden=16, layers=1,
+                                max_len=64, seed=1)
+    n = 14
+
+    def prog():
+        return DecodeProgram(model, params, slots=2,
+                             prefill_buckets=(8, 16))
+
+    want = _reference(prog(), _PROMPT, n)
+    src = DecodeEngine(prog(), timeout_s=60.0)
+    dst = DecodeEngine(prog(), timeout_s=60.0)
+    try:
+        _s, payload = _export_after_first_token(src, _PROMPT, n)
+        assert payload['kind'] == 'slot'
+        assert _continue_on(dst, payload) == want
+        assert dst.stats()['counts']['prefills'] == 0
+        assert src.stats()['counts']['migrated_out'] == 1
+        assert dst.stats()['counts']['migrated_in'] == 1
+    finally:
+        src.close()
+        dst.close()
+
+
+# ---------------------------------------------------------------------------
+# typed rejection
+# ---------------------------------------------------------------------------
+
+def test_torn_and_mismatched_payloads_rejected_typed():
+    model, params = _model()
+    src = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    slot_eng = DecodeEngine(DecodeProgram(model, params, slots=2,
+                                          prefill_buckets=(8, 16)),
+                            timeout_s=60.0)
+    try:
+        _s, payload = _export_after_first_token(src, _PROMPT, 8)
+        # torn: any post-digest mutation fails closed
+        torn = dict(payload, pos=payload['pos'] + 1)
+        with pytest.raises(SeqStateError):
+            src.import_sequence(torn)
+        # version mismatch: future schema refused, never guessed at
+        v2 = dict(payload, schema='mxnet_tpu.seqstate.v2')
+        with pytest.raises(SeqStateError):
+            src.import_sequence(v2)
+        # truncated: a missing required field is torn, not defaulted
+        short = {k: v for k, v in payload.items() if k != 'emitted'}
+        with pytest.raises(SeqStateError):
+            src.import_sequence(short)
+        # cache-family mismatch both ways
+        with pytest.raises(SeqStateError):
+            slot_eng.import_sequence(payload)
+        rmodel, rparams = init_rnn_lm(vocab=23, embed=8, hidden=16,
+                                      layers=1, max_len=64, seed=1)
+        rsrc = DecodeEngine(DecodeProgram(rmodel, rparams, slots=2,
+                                          prefill_buckets=(8, 16)),
+                            timeout_s=60.0)
+        try:
+            _s2, slot_payload = _export_after_first_token(
+                rsrc, _PROMPT, 8)
+            with pytest.raises(SeqStateError):
+                src.import_sequence(slot_payload)
+        finally:
+            rsrc.close()
+    finally:
+        src.close()
+        slot_eng.close()
+
+
+# ---------------------------------------------------------------------------
+# bounded drain
+# ---------------------------------------------------------------------------
+
+def test_close_drain_timeout_fails_wedged_stream_typed():
+    """close(drain=True) is BOUNDED: a wedged device step cannot make
+    close hang — the unfinished stream fails typed (DrainTimeout),
+    its slot frees, and the timeout is counted."""
+    model, params = _model()
+    prog = DecodeProgram(model, params, slots=2,
+                         prefill_buckets=(8, 16))
+    eng = DecodeEngine(prog, timeout_s=60.0)
+    release = threading.Event()
+    stepped = threading.Event()
+    orig_step = prog.run_step
+
+    def wedged(*a, **kw):
+        stepped.set()
+        release.wait(20.0)
+        return orig_step(*a, **kw)
+
+    prog.run_step = wedged
+    try:
+        s = eng.generate(_PROMPT, max_new_tokens=8)
+        assert stepped.wait(20.0)
+        t0 = time.monotonic()
+        eng.close(drain=True, timeout=0.3)
+        assert time.monotonic() - t0 < 10.0
+        release.set()
+        with pytest.raises(DrainTimeout):
+            s.result(5)
+        assert s.finish_reason == 'error'
+        assert eng.stats()['counts']['drain_timeouts'] == 1
+    finally:
+        release.set()
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# gateway resume-journal cap
+# ---------------------------------------------------------------------------
+
+def test_gateway_journal_cap_readmits_original_prompt():
+    """Past MXNET_TPU_GATEWAY_JOURNAL_MAX the gateway drops the token
+    VALUES but keeps the relayed-count watermark: the capped resume
+    re-admits the ORIGINAL prompt from index 0 (greedy determinism
+    re-derives the prefix, index dedup keeps the client at
+    at-most-once) and the done line says so."""
+    from test_gateway import _FakeReplica, _expected_tokens, \
+        _read_stream
+    from mxnet_tpu.serving.gateway import ServingGateway
+    a, b = _FakeReplica(), _FakeReplica()
+    gw = ServingGateway([a.url, b.url], port=0, health_period_s=30.0,
+                        timeout_s=5.0, resume=True, resume_max=2,
+                        affinity=True, journal_max=3).start()
+    try:
+        by_url = {a.url: a, b.url: b}
+        prompt = [5, 11, 7, 2]
+        target_url = gw.affinity_target(prompt)
+        target = by_url[target_url]
+        survivor = by_url[next(u for u in by_url
+                               if u != target_url)]
+        target.ctl['die_after'] = 5        # > journal_max: capped
+        r = _read_stream(gw.port, {'tokens': prompt,
+                                   'max_new_tokens': 10,
+                                   'stream': True})
+        assert r['error'] is None and r['status'] == 200
+        assert r['tokens'] == _expected_tokens(prompt, 10)
+        assert r['indices'] == list(range(10))
+        done = r['done']
+        assert done['resumed'] == 1
+        assert done.get('journal_capped') is True
+        readmit = survivor.ctl['requests'][-1]
+        assert readmit['tokens'] == prompt
+        assert not readmit.get('start_index')
+        assert readmit['max_new_tokens'] == 10
+        st = gw.stats()
+        assert st['migrations']['journal_capped'] >= 1
+        assert st['resumes'] == 1
+    finally:
+        gw.stop()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP drain lifecycle
+# ---------------------------------------------------------------------------
+
+def _read_ndjson(url, payload, timeout=30.0):
+    body = json.dumps(payload).encode()
+    resp = urllib.request.urlopen(urllib.request.Request(
+        url, data=body,
+        headers={'Content-Type': 'application/json'}), timeout=timeout)
+    tokens, indices, done = [], [], None
+    for raw in resp:
+        raw = raw.strip()
+        if not raw:
+            continue
+        doc = json.loads(raw)
+        if 'finish_reason' in doc or doc.get('done'):
+            done = doc
+            break
+        tokens.append(doc['token'])
+        indices.append(doc['index'])
+    return tokens, indices, done
+
+
+def test_server_drain_hands_off_over_http():
+    """The full server-side drain: healthz flips to draining 503, new
+    work sheds typed with Retry-After, the in-flight stream finishes
+    ``migrated`` (no error line), /drain serves the seqstate, the
+    import destination continues bit-identically with zero prefills,
+    and the drain completes with the resumable rc."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.server import ServingHTTPServer
+    model, params = _model()
+    n = 20
+    want = _reference(_paged(model, params, 8, 32), _PROMPT, n)
+    sess_a = serving.InferenceSession(_paged(model, params, 8, 32),
+                                      watchdog=False)
+    sess_b = serving.InferenceSession(_paged(model, params, 16, 16),
+                                      watchdog=False)
+    srv_a = ServingHTTPServer(sess_a, port=0).start()
+    srv_b = ServingHTTPServer(sess_b, port=0).start()
+    base_a = 'http://127.0.0.1:%d' % srv_a.port
+    base_b = 'http://127.0.0.1:%d' % srv_b.port
+    try:
+        req = {'tokens': _PROMPT, 'max_new_tokens': n, 'stream': True,
+               'request_id': 'rid-mig'}
+        body = json.dumps(req).encode()
+        resp = urllib.request.urlopen(urllib.request.Request(
+            base_a + '/generate', data=body,
+            headers={'Content-Type': 'application/json'}), timeout=30)
+        tokens, indices, done = [], [], None
+        for raw in resp:
+            raw = raw.strip()
+            if not raw:
+                continue
+            doc = json.loads(raw)
+            if 'finish_reason' in doc:
+                done = doc
+                break
+            tokens.append(doc['token'])
+            indices.append(doc['index'])
+            if len(tokens) == 4:
+                srv_a.begin_drain(reason='test')
+        assert done and done['finish_reason'] == 'migrated'
+        assert done['request_id'] == 'rid-mig'
+        assert srv_a.draining
+
+        with pytest.raises(urllib.error.HTTPError) as hz:
+            urllib.request.urlopen(base_a + '/healthz', timeout=5)
+        assert hz.value.code == 503
+        assert json.loads(hz.value.read())['status'] == 'draining'
+
+        with pytest.raises(urllib.error.HTTPError) as shed:
+            urllib.request.urlopen(urllib.request.Request(
+                base_a + '/generate', data=body,
+                headers={'Content-Type': 'application/json'}),
+                timeout=5)
+        assert shed.value.code == 503
+        assert json.loads(
+            shed.value.read())['error_class'] == 'Draining'
+        assert shed.value.headers.get('Retry-After')
+
+        # the migrated done line can beat the drain worker's payload
+        # publication — poll like the gateway does
+        deadline = time.monotonic() + 15.0
+        payload = None
+        while time.monotonic() < deadline:
+            snap = json.loads(urllib.request.urlopen(
+                base_a + '/drain?request_id=rid-mig',
+                timeout=10).read())
+            assert snap['schema'] == 'mxnet_tpu.drain.v1'
+            if snap['sequences']:
+                payload = snap['sequences'][0]
+                break
+            time.sleep(0.05)
+        assert payload is not None and payload['request_id'] == \
+            'rid-mig'
+
+        got = list(tokens)
+        resp2 = urllib.request.urlopen(urllib.request.Request(
+            base_b + '/import',
+            data=json.dumps({'seqstate': payload,
+                             'stream': True}).encode(),
+            headers={'Content-Type': 'application/json'}), timeout=30)
+        done2 = None
+        for raw in resp2:
+            raw = raw.strip()
+            if not raw:
+                continue
+            doc = json.loads(raw)
+            if 'finish_reason' in doc:
+                done2 = doc
+                break
+            got.append(doc['token'])
+            indices.append(doc['index'])
+        assert done2 and done2['finish_reason'] in ('length', 'eos')
+        assert done2['request_id'] == 'rid-mig'
+        assert got == want
+        assert indices == list(range(n))
+        assert sess_b._engine.stats()['counts']['prefills'] == 0
+
+        assert srv_a.wait_drained(timeout=30)
+        res = srv_a.drain_result
+        assert res['rc'] == 75
+        assert res['sequences'] == 1 and res['handed_off'] == 1
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+        sess_b.close()

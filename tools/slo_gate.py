@@ -2,8 +2,8 @@
 """SLO regression gate (tools/ci.py stage 'slo').
 
 Runs the open-loop load harness (python -m mxnet_tpu.loadgen) in
-overload, chaos, prefix, gateway-failover and tenants modes against
-the in-process serving rig, then diffs the resulting
+overload, chaos, prefix, gateway-failover, drain and tenants modes
+against the in-process serving rig, then diffs the resulting
 ``mxnet_tpu.slo.v1`` artifacts against the committed
 SLO_BASELINE.json:
 
@@ -49,6 +49,7 @@ _BUDGET_KNOBS = {
     'goodput_floor': 'MXNET_TPU_SLO_GOODPUT',
     'prefix_ttft_p99_ms': 'MXNET_TPU_SLO_PREFIX_TTFT_P99_MS',
     'gateway_availability_floor': 'MXNET_TPU_SLO_GATEWAY_AVAILABILITY',
+    'drain_availability_floor': 'MXNET_TPU_SLO_DRAIN_AVAILABILITY',
     'tenant_steady_ttft_p99_ms': 'MXNET_TPU_SLO_TENANT_TTFT_P99_MS',
     'tenant_steady_tpot_p99_ms': 'MXNET_TPU_SLO_TENANT_TPOT_P99_MS',
 }
@@ -160,7 +161,7 @@ def main(argv=None):
     else:
         tmp = tempfile.mkdtemp(prefix='slo_gate_')
         for mode in ('overload', 'chaos', 'prefix',
-                     'gateway-failover', 'tenants'):
+                     'gateway-failover', 'drain', 'tenants'):
             artifacts.append(run_mode(
                 mode, os.path.join(tmp, '%s.json' % mode), budgets,
                 full=args.full))

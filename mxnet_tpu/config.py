@@ -375,6 +375,20 @@ KNOBS = {k.name: k for k in [
           'Steady-tenant TPOT p99 budget (ms) for the two-tenant'
           ' burst phase: per-output-token latency of the steady'
           ' tenant\'s admitted streams under a neighbor\'s burst.'),
+    _knob('MXNET_TPU_SLO_DRAIN_AVAILABILITY', float, 1.0,
+          'Availability floor for the drain drill (--mode drain): a'
+          ' GRACEFUL preemption loses nothing, so the default demands'
+          ' every stream completes clean.'),
+    _knob('MXNET_TPU_SLO_DISAGG_AVAILABILITY', float, 0.99,
+          'Availability floor for the disaggregated prefill/decode'
+          ' drill (--mode disagg): fraction of mixed long/short'
+          ' streams that must complete CLEAN while one replica of'
+          ' EACH class is hard-killed mid-run.'),
+    _knob('MXNET_TPU_SLO_DISAGG_TTFT_P99_MS', float, 2500.0,
+          'TTFT p99 budget (ms) for the disagg drill\'s mixed'
+          ' workload: time to first token INCLUDING the prefill-class'
+          ' admission (the boundary token streams from the prefill'
+          ' replica before the handoff completes).'),
     _knob('MXNET_TPU_LOADGEN_SEED', int, 0,
           'Default seed for the open-loop arrival schedule'
           ' (mxnet_tpu.loadgen): same seed, same arrival times and'
@@ -546,6 +560,38 @@ KNOBS = {k.name: k for k in [
           ' across active tenants: a tenant may exceed its 1/k share'
           ' only while the pool has slack, so a burst queues behind'
           ' its own share, not everyone\'s. 0 = unbounded.'),
+    _knob('MXNET_TPU_GATEWAY_JOURNAL_MAX', int, 0,
+          'Per-stream resume-journal cap (tokens): past it the'
+          ' journal degrades to the relayed COUNT — a later resume'
+          ' re-admits the ORIGINAL prompt and greedy determinism +'
+          ' index dedup re-derive the delivered prefix. 0 = unbounded'
+          ' journal.'),
+    _knob('MXNET_TPU_GATEWAY_CLASS_MAP', str, '',
+          'Disaggregated replica classes as "url=class,url=class"'
+          ' (class in prefill|decode|both): a prefill replica takes'
+          ' /generate admissions and exports seqstate at the prefill'
+          ' boundary, a decode replica takes the POST /import step'
+          ' loop. Any replica declaring a role makes the gateway'
+          ' disaggregated; unlisted replicas stay "both". Explicit'
+          ' ServingGateway(classes=...) entries override this map.'),
+    _knob('MXNET_TPU_GATEWAY_HANDOFF_TIMEOUT_S', float, 10.0,
+          'Per-attempt budget for the prefill->decode seqstate'
+          ' handoff POST /import: past it the attempt counts against'
+          ' MXNET_TPU_GATEWAY_HANDOFF_RETRIES and the payload goes to'
+          ' the next decode-class member.'),
+    _knob('MXNET_TPU_GATEWAY_HANDOFF_RETRIES', int, 2,
+          'Bounded handoff retries per prefill-boundary export:'
+          ' refusals (pool pressure, geometry/version checks) and'
+          ' dead decode targets each consume one; past the budget the'
+          ' request falls back MONOLITHIC on the prefill class —'
+          ' never dropped.'),
+    _knob('MXNET_TPU_GATEWAY_DISAGG_MIN_PROMPT', int, 0,
+          'Prompt-length threshold (tokens) for the disaggregated'
+          ' path: prompts at/above it admit prefill_only on the'
+          ' prefill class and hand their seqstate to the decode'
+          ' class; shorter prompts run monolithically ON the prefill'
+          ' class (the decode class only ever imports). 0'
+          ' disaggregates every streamed /generate.'),
     # preemption / elasticity / watchdog (docs/RESILIENCE.md)
     _knob('MXNET_TPU_PREEMPT_EXIT_CODE', int, 75,
           'Process exit code marking a preempted-but-resumable run'

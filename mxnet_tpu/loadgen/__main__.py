@@ -76,6 +76,16 @@ def _summary(doc):
                         m.get('dest_prefill_delta'),
                         m.get('error_lines'), m.get('availability'),
                         (m.get('drain_result') or {}).get('rc')))
+    if doc['mode'] == 'disagg':
+        h = m.get('handoff') or {}
+        lines.append('  handoffs=%s retries=%s fallbacks=%s '
+                     'dest_prefill_delta=%s dest_imports=%s '
+                     'ttft_p99=%sms availability=%s'
+                     % (h.get('spliced'), h.get('retries'),
+                        h.get('fallbacks'),
+                        m.get('dest_prefill_delta'),
+                        m.get('dest_imports'), m.get('ttft_p99_ms'),
+                        m.get('availability')))
     if doc['mode'] == 'tenants':
         for tenant in ('steady', 'burst'):
             tm = m.get(tenant) or {}
@@ -102,7 +112,7 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument('--mode', choices=('capacity', 'overload', 'chaos',
                                       'prefix', 'gateway-failover',
-                                      'drain', 'tenants'),
+                                      'drain', 'tenants', 'disagg'),
                    default='overload')
     p.add_argument('--out', default='SLO.json')
     p.add_argument('--seed', type=int, default=None,
@@ -130,8 +140,8 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     from .harness import GatewayRig, ServingRig, run_capacity, \
-        run_chaos, run_drain, run_gateway_failover, run_overload, \
-        run_prefix, run_tenants
+        run_chaos, run_disagg, run_drain, run_gateway_failover, \
+        run_overload, run_prefix, run_tenants
     from .harness import _knob
     seed = args.seed if args.seed is not None \
         else int(_knob('MXNET_TPU_LOADGEN_SEED', 0))
@@ -143,7 +153,7 @@ def main(argv=None):
     mix = {'predict': 1.0} if args.no_generate else None
 
     if args.mode in ('prefix', 'gateway-failover', 'drain',
-                     'tenants') and args.no_generate:
+                     'tenants', 'disagg') and args.no_generate:
         raise SystemExit('--mode %s needs the generate rig'
                          % args.mode)
     if args.mode == 'prefix':
@@ -171,6 +181,22 @@ def main(argv=None):
                          decode_max_queue=16,
                          decode_prefill_buckets=(64,),
                          decode_max_len=128, decode_pages=128)
+    elif args.mode == 'disagg':
+        # disaggregated topology: two prefill-class + two decode-class
+        # replicas so one of EACH class can be hard-killed mid-run
+        # with a survivor left per class. Full page pools: every
+        # stream's KV pages travel prefill -> decode in the seqstate
+        # payload and must land without eviction pressure
+        rig = GatewayRig(replicas=4,
+                         classes=('prefill', 'prefill',
+                                  'decode', 'decode'),
+                         health_period_s=0.25, predict=False,
+                         slots=8, max_new_tokens=24,
+                         decode_max_queue=16,
+                         decode_prefill_buckets=(64,),
+                         decode_max_len=128, decode_pages=128,
+                         gateway_kwargs=dict(handoff_timeout_s=10.0,
+                                             handoff_retries=2))
     elif args.mode == 'tenants':
         # two-tenant burst phase: per-tenant buckets sized so the
         # steady lane never touches its budget while the burst lane
@@ -193,6 +219,8 @@ def main(argv=None):
             doc = run_gateway_failover(rig, streams=8, seed=seed)
         elif args.mode == 'drain':
             doc = run_drain(rig, streams=8, seed=seed)
+        elif args.mode == 'disagg':
+            doc = run_disagg(rig, streams=8, seed=seed)
         elif args.mode == 'tenants':
             doc = run_tenants(rig,
                               duration_s=(args.duration

@@ -38,8 +38,8 @@ from .schedule import build_schedule
 
 __all__ = ['ServingRig', 'GatewayRig', 'Dispatcher', 'run_capacity',
            'run_overload', 'run_chaos', 'run_prefix',
-           'run_gateway_failover', 'run_drain', 'run_tenants',
-           'DEFAULT_MIX', 'OVERLOAD_MIX']
+           'run_gateway_failover', 'run_drain', 'run_disagg',
+           'run_tenants', 'DEFAULT_MIX', 'OVERLOAD_MIX']
 
 # chaos soak: mostly-cheap traffic keeps the soak itself off the
 # host's critical path while faults fire
@@ -254,14 +254,21 @@ class GatewayRig:
     """
 
     def __init__(self, replicas=2, health_period_s=0.25,
-                 gateway_kwargs=None, **rig_kwargs):
+                 gateway_kwargs=None, classes=None, **rig_kwargs):
         from ..serving.gateway import ServingGateway
         if int(replicas) < 1:
             raise ValueError('GatewayRig needs >= 1 replica')
+        if classes is not None and len(classes) != int(replicas):
+            raise ValueError('classes must name every replica '
+                             '(%d != %d)' % (len(classes),
+                                             int(replicas)))
         self.replicas = [ServingRig(**rig_kwargs)
                          for _ in range(int(replicas))]
+        self.classes = list(classes) if classes is not None \
+            else ['both'] * int(replicas)
         self.gateway = ServingGateway(
-            ['http://127.0.0.1:%d' % r.port for r in self.replicas],
+            [('http://127.0.0.1:%d' % r.port, cls)
+             for r, cls in zip(self.replicas, self.classes)],
             port=0, health_period_s=health_period_s,
             **(gateway_kwargs or {})).start()
         self.port = self.gateway.port
@@ -1215,6 +1222,194 @@ def run_drain(rig, streams=8, seed=0, availability_floor=None,
          'drained_replica': target, 'replicas': len(rig.replicas),
          'max_new_tokens': max_new,
          'availability_floor': availability_floor},
+        metrics, server=rig.server_stats(), verdicts=verdicts)
+
+
+def run_disagg(rig, streams=8, seed=0, availability_floor=None,
+               ttft_budget_s=None, timeout_s=30.0, kill=True):
+    """Disaggregated prefill/decode chaos drill (docs/SERVING.md
+    "Disaggregated prefill/decode"): a class topology (>= 2 prefill,
+    >= 2 decode replicas) serves ``streams`` concurrent mixed-length
+    /generate streams — Zipf-weighted long system-prompt traffic
+    interleaved with short prompts. Every stream admits on the
+    prefill class, exports at the prefill boundary, and splices its
+    continuation from a decode-class import. Once tokens flow, one
+    replica of EACH class is hard-killed. Gated (tools/slo_gate.py
+    ``disagg.*``):
+
+      * zero client-visible NDJSON error lines,
+      * availability at/above ``MXNET_TPU_SLO_DISAGG_AVAILABILITY``,
+      * every token stream BIT-IDENTICAL to an unkilled MONOLITHIC
+        reference run on a (surviving) prefill replica,
+      * token indices contiguous with no duplicates across prefill ->
+        decode splices and kill-triggered resumes,
+      * every stream actually handed off (handoff spliced >= streams)
+        with retries inside the bounded budget,
+      * ZERO decode-class re-prefills: surviving decode replicas with
+        >= 1 import show prefill-counter delta 0 (the KV travelled in
+        the seqstate payloads, never recomputed),
+      * mixed-traffic TTFT p99 within
+        ``MXNET_TPU_SLO_DISAGG_TTFT_P99_MS``,
+      * zero unresolved streams.
+    """
+    if rig.decode_session is None:
+        raise ValueError('disagg mode needs a generate-capable rig')
+    classes = getattr(rig, 'classes', None) or []
+    prefills = [i for i, c in enumerate(classes)
+                if c in ('prefill', 'both')]
+    decodes = [i for i, c in enumerate(classes)
+               if c in ('decode', 'both')]
+    if len(prefills) < 2 or len(decodes) < 2 \
+            or not rig.gateway.disaggregated:
+        raise ValueError(
+            'disagg mode needs a disaggregated GatewayRig with >= 2 '
+            'replicas per class (classes=%r)' % (classes,))
+    availability_floor = float(
+        availability_floor if availability_floor is not None
+        else _knob('MXNET_TPU_SLO_DISAGG_AVAILABILITY', 0.99))
+    ttft_budget_s = float(
+        ttft_budget_s if ttft_budget_s is not None
+        else _knob('MXNET_TPU_SLO_DISAGG_TTFT_P99_MS', 2500.0) / 1e3)
+    streams = int(streams)
+    max_new = int(rig.max_new_tokens)
+    # Zipf-weighted long-prompt traffic: three shared system prompts,
+    # rank-r picked proportionally to 1/r (deterministic unrolling),
+    # interleaved with short prompts — the mixed workload the
+    # disaggregated topology exists for
+    systems = [[2 + ((seed + r * 5 + j) % (_VOCAB - 3))
+                for j in range(12 + 4 * r)] for r in range(3)]
+    zipf_order = [0, 1, 0, 2, 0, 1, 0, 0]
+    payloads = []
+    for i in range(streams):
+        if i % 2 == 0:      # long: Zipf-shared system prompt + suffix
+            sys_p = systems[zipf_order[(i // 2) % len(zipf_order)]]
+            toks = sys_p + [1 + (i % (_VOCAB - 2))]
+        else:               # short: the steady cheap lane
+            toks = [2 + ((seed + i) % (_VOCAB - 3)),
+                    1 + (i % (_VOCAB - 2)), 3]
+        payloads.append({'tokens': toks, 'max_new_tokens': max_new,
+                         'stream': True})
+    # unkilled MONOLITHIC reference, direct against a prefill replica
+    # that survives the drill: the token sequences every client is
+    # entitled to, whatever topology served them
+    ref_idx = prefills[-1]
+    reference = [_read_token_stream('127.0.0.1',
+                                    rig.replicas[ref_idx].port, p,
+                                    timeout_s=timeout_s)
+                 for p in payloads]
+    _settle(rig)
+    pre = {i: dict(rig.replicas[i].decode_session._engine
+                   .stats()['counts']) for i in decodes}
+    results = [None] * streams
+    ttfts = [None] * streams
+    t0s = [None] * streams
+    first_tokens = threading.Event()
+
+    def _drive(i):
+        def _on_token(n, i=i):
+            if n == 1:
+                ttfts[i] = time.monotonic() - t0s[i]
+                first_tokens.set()
+        t0s[i] = time.monotonic()
+        results[i] = _read_token_stream(
+            '127.0.0.1', rig.port, payloads[i], timeout_s=timeout_s,
+            on_token=_on_token)
+
+    threads = [threading.Thread(target=_drive, args=(i,),
+                                daemon=True,
+                                name='loadgen-disagg-%d' % i)
+               for i in range(streams)]
+    for th in threads:
+        th.start()
+    killed = []
+    if kill:
+        # on the first streamed token: streams are mid-handoff in
+        # every state (prefilling, exported-awaiting-import, decoding
+        # on the destination). Kill the decode-class replica FIRST
+        # (the mid-stream loss the journal resume must absorb), then
+        # a prefill-class replica (resumes must re-route)
+        first_tokens.wait(timeout_s)
+        rig.kill_replica(decodes[0])
+        killed.append(decodes[0])
+        rig.kill_replica(prefills[0])
+        killed.append(prefills[0])
+    deadline = time.monotonic() + timeout_s + 10.0
+    for th in threads:
+        th.join(max(0.1, deadline - time.monotonic()))
+    unresolved = sum(1 for th in threads if th.is_alive())
+    # -- verdicts ----------------------------------------------------------
+    clean = [r for r in results
+             if r is not None and r['status'] == 200
+             and r['error'] is None and r['done'] is not None]
+    error_lines = sum(1 for r in results
+                      if r is not None and r['error'] is not None)
+    identical = all(
+        reference[i]['error'] is None
+        and results[i]['tokens'] == reference[i]['tokens']
+        for i in range(streams)
+        if results[i] is not None and results[i]['status'] == 200
+        and results[i]['error'] is None
+        and results[i]['done'] is not None)
+    contiguous = all(
+        r['indices'] == list(range(len(r['tokens'])))
+        for r in clean)
+    live_decodes = [i for i in decodes if i not in killed]
+    post = {i: dict(rig.replicas[i].decode_session._engine
+                    .stats()['counts']) for i in live_decodes}
+    prefill_delta = sum(post[i].get('prefills', 0)
+                        - pre[i].get('prefills', 0)
+                        for i in live_decodes)
+    imports = sum(post[i].get('migrated_in', 0)
+                  - pre[i].get('migrated_in', 0)
+                  for i in live_decodes)
+    availability = len(clean) / float(streams) if streams else None
+    gw_stats = rig.gateway.stats()
+    handoff = gw_stats.get('handoff') or {}
+    resume_max = int(getattr(rig.gateway, 'resume_max', 2))
+    retries_bound = streams * (resume_max + 1) \
+        * (int(rig.gateway.handoff_retries) + 1)
+    ttft_clean = sorted(t for t in ttfts if t is not None)
+    ttft_p99 = ttft_clean[max(0, int(0.99 * len(ttft_clean)) - 1)] \
+        if ttft_clean else None
+    verdicts = {
+        'zero_error_lines': error_lines == 0,
+        'availability_above_floor': availability is not None
+        and availability >= availability_floor,
+        'token_streams_bit_identical': identical,
+        'indices_contiguous_no_dupes': contiguous,
+        'handoff_engaged': handoff.get('spliced', 0) >= streams,
+        'handoff_retries_bounded':
+            handoff.get('retries', 0) <= retries_bound,
+        'zero_decode_reprefills': prefill_delta == 0
+        and imports >= 1,
+        'mixed_ttft_within_budget': ttft_p99 is not None
+        and ttft_p99 <= ttft_budget_s,
+        'zero_unresolved': unresolved == 0,
+    }
+    metrics = {
+        'offered': streams,
+        'admitted': sum(1 for r in results
+                        if r is not None and r['status'] == 200),
+        'served_ok': len(clean),
+        'availability': availability,
+        'handoff': dict(handoff),
+        'dest_prefill_delta': prefill_delta,
+        'dest_imports': imports,
+        'error_lines': error_lines,
+        'unresolved': unresolved,
+        'ttft_p99_ms': round(ttft_p99 * 1e3, 3)
+        if ttft_p99 is not None else None,
+        'tokens_per_stream': max_new,
+        'gateway': gw_stats,
+    }
+    return build_artifact(
+        'disagg',
+        {'streams': streams, 'seed': seed, 'classes': list(classes),
+         'killed_replicas': killed, 'replicas': len(rig.replicas),
+         'max_new_tokens': max_new,
+         'availability_floor': availability_floor,
+         'ttft_budget_ms': ttft_budget_s * 1e3,
+         'handoff_retries': int(rig.gateway.handoff_retries)},
         metrics, server=rig.server_stats(), verdicts=verdicts)
 
 

@@ -345,6 +345,22 @@ def gateway_instruments():
                 help='streams whose resume journal hit '
                      'MXNET_TPU_GATEWAY_JOURNAL_MAX (falls back to '
                      're-prefill resume on failure)'),
+            handoffs=counter(
+                'mxnet_tpu_gateway_handoffs_total',
+                labels=('class', 'outcome'),
+                help='disaggregated prefill->decode seqstate '
+                     'handoffs by destination class and outcome '
+                     '(spliced / fallback)'),
+            handoff_retries=counter(
+                'mxnet_tpu_gateway_handoff_retries_total',
+                help='handoff attempts that were refused or lost a '
+                     'decode target and retried on the next class '
+                     'member (MXNET_TPU_GATEWAY_HANDOFF_RETRIES)'),
+            handoff_seconds=histogram(
+                'mxnet_tpu_gateway_handoff_seconds',
+                help='wall seconds from the prefill-boundary export '
+                     'landing at the gateway to the decode-class '
+                     'import splicing the continuation'),
         )
     return _gateway_inst
 

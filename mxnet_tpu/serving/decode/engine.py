@@ -125,6 +125,11 @@ class GenerateStream:
         self.prompt_len = int(prompt_len)
         self.tokens = []
         self.finish_reason = None       # eos | length | error | closed
+        # prefill_only admission: the exported seqstate payload is
+        # stashed HERE (set before _finish so any consumer woken by
+        # the done event observes it) and the server's done line
+        # carries it to the gateway for the decode-class handoff
+        self.seqstate = None
         self.degraded = False
         self._q = _queue.Queue()
         self._done = threading.Event()
@@ -183,10 +188,10 @@ class _Seq:
 
     __slots__ = ('stream', 'prompt', 'max_new', 'eos_id', 'slot',
                  'pos', 'last_token', 'enqueued_at', 'deadline_at',
-                 'first_token_at', 'table', 'pages')
+                 'first_token_at', 'table', 'pages', 'prefill_only')
 
     def __init__(self, stream, prompt, max_new, eos_id, enqueued_at,
-                 deadline_at):
+                 deadline_at, prefill_only=False):
         self.stream = stream
         self.prompt = prompt
         self.max_new = max_new
@@ -202,6 +207,9 @@ class _Seq:
         # sequence holds allocator refs on
         self.table = None
         self.pages = []
+        # disaggregated serving: export the seqstate at the prefill
+        # boundary instead of entering the step loop
+        self.prefill_only = prefill_only
 
     @property
     def prompt_len(self):
@@ -288,6 +296,7 @@ class DecodeEngine:
                         'spec_rounds': 0, 'cow_copies': 0,
                         'pool_exhausted': 0, 'page_evictions': 0,
                         'migrated_out': 0, 'migrated_in': 0,
+                        'prefill_exports': 0,
                         'handoff_pages': 0, 'drain_timeouts': 0}
         # live-migration requests serviced by the worker at tick
         # boundaries (the only thread that owns the device cache):
@@ -348,13 +357,21 @@ class DecodeEngine:
     # -- submission --------------------------------------------------------
 
     def generate(self, tokens, max_new_tokens=None, eos_id=None,
-                 request_id=None):
+                 request_id=None, prefill_only=False):
         """Admit one prompt; returns its :class:`GenerateStream`.
 
         ``request_id`` makes admission idempotent: a second admission
         under the same id (the gateway re-admitting a stream after a
         mid-stream failover) cancels the previous stream at the next
         token boundary, so at most one decode works the request.
+
+        ``prefill_only=True`` is the disaggregated-serving admission:
+        the sequence runs its prefill (emitting the first token as
+        usual), then exports its ``mxnet_tpu.seqstate.v1`` payload at
+        the prefill boundary instead of entering the step loop. The
+        stream finishes with reason ``'migrated'`` and the payload on
+        ``stream.seqstate``; a first-token EOS / ``max_new_tokens=1``
+        sequence finishes normally (nothing left to hand off).
 
         Raises :class:`BackpressureError` when the pending queue is at
         depth, ``ValueError`` for an empty/over-long prompt (typed at
@@ -374,7 +391,8 @@ class DecodeEngine:
         now = self._clock()
         stream = GenerateStream(len(prompt))
         seq = _Seq(stream, prompt, max_new, eos_id, now,
-                   now + self.timeout_s if self.timeout_s else None)
+                   now + self.timeout_s if self.timeout_s else None,
+                   prefill_only=bool(prefill_only))
         rejected_depth = None
         superseded = None
         with self._lock:
@@ -741,6 +759,26 @@ class DecodeEngine:
 
     # -- scheduling primitives ---------------------------------------------
 
+    def _export_at_boundary(self, seq, slot):
+        """``prefill_only`` admission: the prefill just landed —
+        export the seqstate payload (stashed on the stream) and finish
+        'migrated' instead of entering the step loop. Runs on the
+        worker thread, the cache owner — same ownership rule as
+        migration servicing."""
+        try:
+            self._do_export(seq.stream, stash=True)
+            with self._lock:
+                self._counts['prefill_exports'] = \
+                    self._counts.get('prefill_exports', 0) + 1
+        except BaseException as exc:
+            # never leave the client hanging: a failed boundary export
+            # fails THIS request typed, and its slot/pages free
+            if not seq.stream.done():
+                seq.stream._finish('error', exc)
+                self._retire(slot, seq, 'error')
+            logging.exception('decode %s: prefill-boundary export '
+                              'failed', self.name)
+
     def _admit(self, seq, slot):
         """Prefill one pending request into ``slot`` (join)."""
         if seq.stream.done() or seq.stream._cancelled:
@@ -802,6 +840,8 @@ class DecodeEngine:
         if reason is not None:
             seq.stream._finish(reason)
             self._retire(slot, seq, reason)
+        elif seq.prefill_only:
+            self._export_at_boundary(seq, slot)
 
     def _admit_paged(self, seq, slot):
         """Paged join: a prefix-cache hit references the shared pages
@@ -856,6 +896,11 @@ class DecodeEngine:
                               prefix_tokens=covered)
                 with self._lock:
                     self._active[slot] = seq
+                if seq.prefill_only:
+                    # hand off the extending state (pos=covered, no
+                    # token emitted yet): the importer streams the
+                    # un-shared suffix through ITS decode step
+                    self._export_at_boundary(seq, slot)
                 return
             ids = self._alloc_pages(pages_for(n,
                                               self.program.page_size),
@@ -920,6 +965,8 @@ class DecodeEngine:
         if reason is not None:
             seq.stream._finish(reason)
             self._retire(slot, seq, reason)
+        elif seq.prefill_only:
+            self._export_at_boundary(seq, slot)
 
     def _finished_reason(self, seq, tok):
         if seq.eos_id is not None and tok == seq.eos_id:
@@ -1334,7 +1381,7 @@ class DecodeEngine:
                 break
         return payloads
 
-    def _do_export(self, stream):
+    def _do_export(self, stream, stash=False):
         with self._lock:
             found = None
             for slot, seq in self._active.items():
@@ -1367,7 +1414,11 @@ class DecodeEngine:
                 request_id=rid, entries=entries)
         # the stream ends HERE, cleanly: 'migrated' is not an error
         # (the server's done line carries it; the gateway splices the
-        # destination's continuation into the same client stream)
+        # destination's continuation into the same client stream).
+        # stash the payload BEFORE _finish: the done event wakes the
+        # consumer, which must observe stream.seqstate
+        if stash:
+            stream.seqstate = payload
         stream._finish('migrated')
         self._retire(slot, seq, 'migrated')
         with self._lock:

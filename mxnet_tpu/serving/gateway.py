@@ -5,7 +5,7 @@ The multi-host serving story (docs/DISTRIBUTED.md "Gateway",
 docs/SERVING.md "Gateway failover & multi-tenancy"): each host runs
 its own :class:`~.server.ServingHTTPServer` over its own
 ``InferenceSession``; the gateway fronts them all behind ONE address
-and owns four concerns —
+and owns five concerns —
 
   * **health-aware routing** — a background probe polls every
     replica's ``/healthz`` each ``MXNET_TPU_GATEWAY_HEALTH_S``
@@ -38,6 +38,20 @@ and owns four concerns —
     exactly: failover only before the first byte; a mid-stream
     transport death cuts the connection, a typed abort line relays
     verbatim.
+  * **disaggregated prefill/decode orchestration** — replicas carry a
+    class (``prefill``/``decode``/``both``, per-replica tuples or
+    ``MXNET_TPU_GATEWAY_CLASS_MAP``): a ``/generate`` admits on the
+    prefill class with ``prefill_only=True``, the replica exports its
+    ``mxnet_tpu.seqstate.v1`` payload at the prefill boundary (the
+    done line carries it inline), and the gateway POSTs it to the
+    least-loaded decode-class member, splicing the continuation into
+    the SAME client stream. Every hop is bounded
+    (``MXNET_TPU_GATEWAY_HANDOFF_{TIMEOUT_S,RETRIES}`` with the
+    resilience Retry backoff); refusals walk the decode class, then
+    fall back to finishing monolithically — never a dropped request.
+    A fully-down class degrades the gateway to monolithic routing
+    (``/healthz`` ``degraded``); a *draining* replica is routed away
+    from but never counted toward the all-down shed.
   * **per-tenant admission** — token-bucket rate limiting plus a
     weighted-fair in-flight share keyed on the
     ``MXNET_TPU_GATEWAY_TENANT_HEADER`` header: a bursting tenant
@@ -235,31 +249,70 @@ class TenantAdmission:
                               | set(self._shed))}
 
 
+_REPLICA_CLASSES = ('prefill', 'decode', 'both')
+
+
 class ReplicaState:
-    """One upstream replica: base URL + live health view."""
+    """One upstream replica: base URL + class + live health view.
 
-    __slots__ = ('base_url', 'healthy', 'last_error', 'last_checked',
-                 'transitions', 'next_probe_at')
+    ``cls`` is the disaggregated-serving role: a ``prefill`` replica
+    takes prompt admissions (and exports seqstate at the prefill
+    boundary), a ``decode`` replica takes seqstate imports (the step
+    loop), ``both`` (the default) serves monolithically. ``draining``
+    distinguishes a replica that answered a *draining* 503 from a
+    dead one: it leaves the routing rotation but stays drain-pollable
+    and does not count toward the all-down shed."""
 
-    def __init__(self, base_url):
+    __slots__ = ('base_url', 'cls', 'healthy', 'draining',
+                 'last_error', 'last_checked', 'transitions',
+                 'next_probe_at', 'load')
+
+    def __init__(self, base_url, cls='both'):
+        if cls not in _REPLICA_CLASSES:
+            raise ValueError('replica class %r not in %r'
+                             % (cls, _REPLICA_CLASSES))
         self.base_url = base_url.rstrip('/')
+        self.cls = cls
         self.healthy = True          # optimistic until the first probe
+        self.draining = False        # 503 draining, not dead
         self.last_error = None
         self.last_checked = 0.0
         self.transitions = 0
         self.next_probe_at = 0.0     # staggered probe schedule (mono)
+        self.load = None             # last observed pool load [0,1]
 
-    def mark(self, healthy, error=None):
+    def mark(self, healthy, error=None, draining=False):
         if healthy != self.healthy:
             self.transitions += 1
         self.healthy = healthy
+        self.draining = bool(draining) and not healthy
         self.last_error = error
         self.last_checked = time.time()
 
+    def serves(self, role):
+        """Whether this replica serves ``role`` ('prefill'/'decode');
+        ``None`` matches every class."""
+        return role is None or self.cls == 'both' or self.cls == role
+
     def as_dict(self):
-        return {'url': self.base_url, 'healthy': self.healthy,
+        return {'url': self.base_url, 'class': self.cls,
+                'healthy': self.healthy, 'draining': self.draining,
                 'error': self.last_error,
                 'transitions': self.transitions}
+
+
+def _draining_body(raw):
+    """True when an upstream 503 body is the typed *draining* refusal
+    (``error_class: Draining`` on POSTs, ``status: draining`` on
+    /healthz) rather than a dead/broken replica."""
+    try:
+        doc = json.loads(raw.decode() if isinstance(raw, bytes)
+                         else raw)
+    except Exception:
+        return False
+    return (isinstance(doc, dict)
+            and (doc.get('error_class') == 'Draining'
+                 or doc.get('status') == 'draining'))
 
 
 def _probe_jitter_frac(url):
@@ -297,11 +350,36 @@ class ServingGateway:
                  resume_max=None, affinity=None, tenant_header=None,
                  tenant_rps=None, tenant_burst=None,
                  tenant_max_inflight=None, tenant_weights=None,
-                 journal_max=None):
-        urls = list(replicas)
-        if not urls:
+                 journal_max=None, classes=None,
+                 handoff_timeout_s=None, handoff_retries=None,
+                 disagg_min_prompt=None):
+        specs = list(replicas)
+        if not specs:
             raise ValueError('gateway needs at least one replica URL')
-        self.replicas = [ReplicaState(u) for u in urls]
+        # replica classes: a (url, cls) item wins, then the
+        # ``classes`` url->cls mapping, then MXNET_TPU_GATEWAY_
+        # CLASS_MAP ("url=class,url=class"), default 'both'
+        cmap = {}
+        raw_map = _knob('MXNET_TPU_GATEWAY_CLASS_MAP', '')
+        if raw_map:
+            for part in str(raw_map).split(','):
+                if '=' in part:
+                    u, c = part.rsplit('=', 1)
+                    cmap[u.strip().rstrip('/')] = c.strip()
+        for u, c in (classes or {}).items():
+            cmap[str(u).rstrip('/')] = c
+        self.replicas = []
+        for spec in specs:
+            if isinstance(spec, (tuple, list)):
+                url, cls = spec
+            else:
+                url = spec
+                cls = cmap.get(str(url).rstrip('/'), 'both')
+            self.replicas.append(ReplicaState(url, cls=cls))
+        # the gateway is disaggregated the moment any replica declares
+        # a role; an all-'both' fleet routes exactly as before
+        self.disaggregated = any(r.cls != 'both'
+                                 for r in self.replicas)
         self.host = host
         # explicit port wins; None resolves the knob (whose 0 default
         # means "pick a free port", same as passing 0)
@@ -329,6 +407,19 @@ class ServingGateway:
         self.affinity = bool(
             affinity if affinity is not None
             else _knob('MXNET_TPU_GATEWAY_AFFINITY', True))
+        # disaggregated handoff policy: per-hop timeout + bounded
+        # retries across the decode class before the monolithic
+        # fallback; prompts shorter than disagg_min_prompt stay
+        # monolithic on the prefill class
+        self.handoff_timeout_s = float(
+            handoff_timeout_s if handoff_timeout_s is not None
+            else _knob('MXNET_TPU_GATEWAY_HANDOFF_TIMEOUT_S', 10.0))
+        self.handoff_retries = int(
+            handoff_retries if handoff_retries is not None
+            else _knob('MXNET_TPU_GATEWAY_HANDOFF_RETRIES', 2))
+        self.disagg_min_prompt = int(
+            disagg_min_prompt if disagg_min_prompt is not None
+            else _knob('MXNET_TPU_GATEWAY_DISAGG_MIN_PROMPT', 0))
         self.tenant_header = str(
             tenant_header if tenant_header is not None
             else _knob('MXNET_TPU_GATEWAY_TENANT_HEADER', 'X-Tenant'))
@@ -358,7 +449,10 @@ class ServingGateway:
                        'passthrough_429': 0, 'resumes': 0,
                        'resume_failures': 0, 'affinity_routed': 0,
                        'tenant_shed': 0, 'migrated_streams': 0,
-                       'migration_failures': 0, 'journal_capped': 0}
+                       'migration_failures': 0, 'journal_capped': 0,
+                       'handoffs': 0, 'handoff_retries': 0,
+                       'handoff_fallbacks': 0}
+        self._class_routed = {c: 0 for c in _REPLICA_CLASSES}
         self._stats_lock = threading.Lock()
 
     # -- health ------------------------------------------------------------
@@ -376,7 +470,17 @@ class ServingGateway:
                 rep.mark(ok, None if ok
                          else 'healthz %d' % resp.status)
         except urllib.error.HTTPError as exc:
-            rep.mark(False, 'healthz %d' % exc.code)
+            raw = b''
+            try:
+                raw = exc.read()
+            except Exception:
+                pass
+            if exc.code == 503 and _draining_body(raw):
+                # draining, not dead: route away but keep it
+                # drain-pollable and outside the all-down shed
+                rep.mark(False, 'draining', draining=True)
+            else:
+                rep.mark(False, 'healthz %d' % exc.code)
         except Exception as exc:
             rep.mark(False, '%s: %s' % (type(exc).__name__, exc))
 
@@ -400,24 +504,34 @@ class ServingGateway:
     def healthy_replicas(self):
         return [r for r in self.replicas if r.healthy]
 
-    def _pick(self, exclude=()):
-        """Next healthy replica round-robin, skipping ``exclude``."""
+    def _note_routed(self, rep):
+        if rep is not None:
+            with self._stats_lock:
+                self._class_routed[rep.cls] = \
+                    self._class_routed.get(rep.cls, 0) + 1
+
+    def _pick(self, exclude=(), role=None):
+        """Next healthy replica round-robin, skipping ``exclude``;
+        ``role`` restricts to replicas whose class serves it."""
         with self._rr_lock:
             candidates = [r for r in self.replicas
-                          if r.healthy and r not in exclude]
+                          if r.healthy and r.serves(role)
+                          and r not in exclude]
             if not candidates:
                 return None
             rep = candidates[self._rr % len(candidates)]
             self._rr += 1
-            return rep
+        self._note_routed(rep)
+        return rep
 
-    def _route(self, fingerprint, exclude=()):
+    def _route(self, fingerprint, exclude=(), role=None):
         """Prefix-affine pick when a fingerprint is given (rendezvous
-        hash over the healthy set: stable under replica loss), else
-        round-robin."""
+        hash over the healthy set serving ``role``: stable under
+        replica loss), else round-robin."""
         if fingerprint is not None:
             candidates = [r for r in self.replicas
-                          if r.healthy and r not in exclude]
+                          if r.healthy and r.serves(role)
+                          and r not in exclude]
             if candidates:
                 by_url = {r.base_url: r for r in candidates}
                 winner = rendezvous_rank(fingerprint,
@@ -426,8 +540,77 @@ class ServingGateway:
                 inst = _instruments()
                 if inst is not None:
                     inst.affinity_routed.inc()
-                return by_url[winner]
-        return self._pick(exclude)
+                rep = by_url[winner]
+                self._note_routed(rep)
+                return rep
+            return None if role is not None else self._pick(exclude)
+        return self._pick(exclude, role=role)
+
+    def _class_counts(self):
+        """(healthy prefill-capable, healthy decode-capable)."""
+        p = sum(1 for r in self.replicas
+                if r.healthy and r.serves('prefill'))
+        d = sum(1 for r in self.replicas
+                if r.healthy and r.serves('decode'))
+        return p, d
+
+    def _pool_load(self, rep):
+        """Decode-pool occupancy in [0, 1] from the replica's /status
+        (page-pool occupancy when paged, busy-slot fraction
+        otherwise); 0.5 when unreadable, so an opaque replica neither
+        attracts nor repels handoffs."""
+        doc = self._fetch_json(rep, '/status')
+        rec = doc.get('generate') if isinstance(doc, dict) else None
+        if not isinstance(rec, dict):
+            rec = doc if isinstance(doc, dict) else {}
+        dec = rec.get('decode')
+        if isinstance(dec, dict):
+            pages = dec.get('pages')
+            if isinstance(pages, dict) \
+                    and pages.get('occupancy_pct') is not None:
+                try:
+                    return max(0.0, min(
+                        1.0, float(pages['occupancy_pct']) / 100.0))
+                except (TypeError, ValueError):
+                    pass
+            slots = dec.get('slots')
+            if slots:
+                try:
+                    return max(0.0, min(1.0, (
+                        float(slots) - float(dec.get('free_slots')
+                                             or 0)) / float(slots)))
+                except (TypeError, ValueError):
+                    pass
+        return 0.5
+
+    def _pick_decode(self, exclude=()):
+        """Least-loaded healthy decode-capable replica for a seqstate
+        handoff (one /status round-trip per candidate; the observed
+        load is cached on the replica for the stats() pool view)."""
+        candidates = [r for r in self.replicas
+                      if r.healthy and r.serves('decode')
+                      and r not in exclude]
+        if not candidates:
+            return None
+        if len(candidates) > 1:
+            for rep in candidates:
+                rep.load = self._pool_load(rep)
+            candidates.sort(key=lambda r: (r.load, r.base_url))
+        rep = candidates[0]
+        self._note_routed(rep)
+        return rep
+
+    def _handoff_delay(self, attempt):
+        """Backoff before handoff retry ``attempt`` (1-based): the
+        resilience Retry policy's jittered exponential schedule."""
+        try:
+            from ..resilience.policy import Retry
+            return Retry(max_attempts=max(2, self.handoff_retries + 1),
+                         base_delay=0.05, multiplier=2.0,
+                         max_delay=1.0,
+                         jitter=0.25).delay(max(1, attempt))
+        except Exception:
+            return min(1.0, 0.05 * 2.0 ** max(0, attempt - 1))
 
     def affinity_target(self, tokens):
         """The replica URL a prompt would route to right now (healthy
@@ -456,14 +639,17 @@ class ServingGateway:
             seq = self._request_seq
         return 'gw%d-%d' % (port, seq)
 
-    def _forward(self, rep, path, body, content_type, tenant=None):
+    def _forward(self, rep, path, body, content_type, tenant=None,
+                 timeout=None):
         headers = {'Content-Type': content_type or 'application/json'}
         if tenant is not None:
             headers[self.tenant_header] = tenant
         req = urllib.request.Request(
             rep.base_url + path, data=body, headers=headers,
             method='POST')
-        return urllib.request.urlopen(req, timeout=self.timeout_s)
+        return urllib.request.urlopen(
+            req, timeout=self.timeout_s if timeout is None
+            else timeout)
 
     def _fetch_json(self, rep, path):
         try:
@@ -506,17 +692,35 @@ class ServingGateway:
                 path = handler.path.rstrip('/')
                 if path == '/healthz':
                     healthy = len(gw.healthy_replicas())
+                    draining = sum(1 for r in gw.replicas
+                                   if r.draining and not r.healthy)
                     total = len(gw.replicas)
-                    if healthy == 0:
+                    if healthy == 0 and draining == 0:
+                        # ALL replicas are dead (draining ones do not
+                        # count — they come back): the only case that
+                        # sheds
+                        hint = max(1, int(gw.health_period_s + 0.999))
                         handler._json(503, {
                             'ok': False, 'status': 'unavailable',
-                            'healthy': 0, 'replicas': total})
+                            'healthy': 0, 'draining': 0,
+                            'replicas': total},
+                            headers={'Retry-After': str(hint)})
                     else:
                         status = 'ok' if healthy == total \
                             else 'degraded'
-                        handler._json(200, {
-                            'ok': True, 'status': status,
-                            'healthy': healthy, 'replicas': total})
+                        body = {'ok': True, 'status': status,
+                                'healthy': healthy,
+                                'draining': draining,
+                                'replicas': total}
+                        if gw.disaggregated:
+                            # a whole class down degrades the gateway
+                            # to monolithic routing — visible here
+                            has_p, has_d = gw._class_counts()
+                            if healthy and (not has_p or not has_d):
+                                body['status'] = 'degraded'
+                            body['classes'] = {
+                                'prefill': has_p, 'decode': has_d}
+                        handler._json(200, body)
                 elif path == '/replicas':
                     handler._json(200, {
                         'replicas': [r.as_dict()
@@ -576,13 +780,32 @@ class ServingGateway:
             def _shed_no_replica(handler, tried):
                 gw._bump('shed')
                 hint = max(1, int(gw.health_period_s + 0.999))
+                draining = sum(1 for r in gw.replicas
+                               if r.draining and not r.healthy)
                 handler._json(
                     503,
                     {'error': 'no healthy serving replica '
-                              '(%d configured, %d tried)'
-                              % (len(gw.replicas), len(tried)),
+                              '(%d configured, %d tried, %d draining)'
+                              % (len(gw.replicas), len(tried),
+                                 draining),
                      'retry_after_s': hint},
                     headers={'Retry-After': str(hint)})
+
+            def _relay_consumed(handler, exc, body):
+                """Relay an HTTPError whose body was already read
+                (the draining sniff consumed it); Retry-After and
+                content type pass through verbatim."""
+                handler.send_response(exc.code)
+                handler.send_header(
+                    'Content-Type',
+                    exc.headers.get('Content-Type',
+                                    'application/json'))
+                handler.send_header('Content-Length', str(len(body)))
+                ra = exc.headers.get('Retry-After')
+                if ra:
+                    handler.send_header('Retry-After', ra)
+                handler.end_headers()
+                handler.wfile.write(body)
 
             def _forward_plain(handler, path, body, ctype, tenant,
                                fingerprint=None):
@@ -605,7 +828,28 @@ class ServingGateway:
                         # a typed upstream error (429/504/503/500/400)
                         # passes through verbatim — incl. Retry-After,
                         # so client backoff sees the replica's queue
-                        # estimate, not a gateway guess
+                        # estimate, not a gateway guess. EXCEPT a 503
+                        # Draining: that is the replica's exit notice,
+                        # not the client's problem — honor it by
+                        # re-routing NOW to another class member
+                        if exc.code == 503:
+                            raw = b''
+                            try:
+                                raw = exc.read()
+                            except Exception:
+                                pass
+                            if _draining_body(raw):
+                                rep.mark(False, 'draining',
+                                         draining=True)
+                                gw._bump('failovers')
+                                inst = _instruments()
+                                if inst is not None:
+                                    inst.failovers.inc()
+                                gw._note_health(
+                                    len(gw.healthy_replicas()))
+                                continue
+                            handler._relay_consumed(exc, raw)
+                            return
                         if exc.code == 429:
                             gw._bump('passthrough_429')
                         handler._relay_response(exc, streaming=False)
@@ -693,8 +937,76 @@ class ServingGateway:
                 migrate = None      # seqstate awaiting POST /import
                 started = False     # client headers sent
                 tried = []          # replicas tried for this segment
+                handoff_live = False   # inline prefill-boundary
+                #                        handoff in flight (vs a
+                #                        drain-path migration)
+                handoff_t0 = 0.0
+                handoff_attempts = 0
+                no_disagg = False   # handoff fell back: this request
+                #                     stays monolithic on the prefill
+                #                     class
                 while True:
-                    rep = gw._route(fingerprint, exclude=tried)
+                    use_prefill_only = False
+                    if migrate is not None:
+                        if handoff_live and handoff_attempts \
+                                > gw.handoff_retries:
+                            rep = None     # retry budget spent
+                        else:
+                            rep = gw._pick_decode(exclude=tried)
+                        if rep is None and handoff_live:
+                            # no decode-capable target left (or the
+                            # retry budget is spent): monolithic
+                            # fallback — finish on the prefill class
+                            # via the journal, never a dropped request
+                            gw._bump('handoff_fallbacks')
+                            inst = _instruments()
+                            if inst is not None:
+                                inst.handoffs.labels(
+                                    **{'class': 'decode',
+                                       'outcome': 'fallback'}).inc()
+                            _record_event(
+                                'seq_handoff', stage='fallback',
+                                request_id=request_id,
+                                attempts=handoff_attempts,
+                                tokens=relayed)
+                            migrate = None
+                            handoff_live = False
+                            no_disagg = True
+                            tried = []
+                            continue
+                        if rep is None:
+                            # legacy drain-path migration: any
+                            # healthy replica can land the import
+                            rep = gw._route(fingerprint,
+                                            exclude=tried)
+                    else:
+                        role = None
+                        if gw.disaggregated:
+                            has_p, has_d = gw._class_counts()
+                            if no_disagg:
+                                role = 'prefill' if has_p else None
+                            elif has_p and has_d:
+                                # the disaggregated path: admit on
+                                # the prefill class; long-enough
+                                # prompts run prefill only and hand
+                                # their seqstate to the decode class
+                                role = 'prefill'
+                                use_prefill_only = (
+                                    len(prompt)
+                                    >= gw.disagg_min_prompt)
+                            elif has_p:
+                                # decode class down: degrade to
+                                # monolithic on the prefill class
+                                # (healthz says 'degraded', not shed)
+                                role = 'prefill'
+                            # decode-only survivors: role stays None
+                            # — monolithic over whatever is healthy
+                        rep = gw._route(fingerprint, exclude=tried,
+                                        role=role)
+                        if rep is None and role is not None:
+                            use_prefill_only = False
+                            rep = gw._route(fingerprint,
+                                            exclude=tried)
                     if rep is None:
                         if not started:
                             handler._shed_no_replica(tried)
@@ -732,11 +1044,23 @@ class ServingGateway:
                     tried.append(rep)
                     if migrate is not None:
                         seg_path = '/import'
+                        # start_index=relayed keeps the continuation's
+                        # client indices aligned even when the source
+                        # admission was itself a re-admission (its
+                        # payload['emitted'] counts only the segment)
                         body = json.dumps({'seqstate': migrate,
-                                           'stream': True}).encode()
+                                           'stream': True,
+                                           'start_index': relayed
+                                           }).encode()
                     else:
                         seg_path = '/generate'
                         payload = dict(req, request_id=request_id)
+                        # the gateway owns the prefill_only decision:
+                        # never let a client smuggle a seqstate line
+                        # into its own stream
+                        payload.pop('prefill_only', None)
+                        if use_prefill_only:
+                            payload['prefill_only'] = True
                         if relayed and capped:
                             # the token VALUES are gone — re-admit
                             # the original prompt; greedy decode
@@ -752,19 +1076,42 @@ class ServingGateway:
                                     orig_max_new - len(emitted)
                         body = json.dumps(payload).encode()
                     try:
-                        resp = gw._forward(rep, seg_path, body,
-                                           ctype, tenant=tenant)
+                        resp = gw._forward(
+                            rep, seg_path, body, ctype,
+                            tenant=tenant,
+                            timeout=(gw.handoff_timeout_s
+                                     if handoff_live else None))
                     except urllib.error.HTTPError as exc:
                         if migrate is not None:
-                            # the import target refused the handoff
-                            # (backpressure, geometry/version check):
-                            # drop to the plain resume path — the
-                            # journal (or the capped re-prefill) still
-                            # completes the stream
                             try:
                                 exc.read()
                             except Exception:
                                 pass
+                            if handoff_live:
+                                # the decode target refused the
+                                # import (pool exhaustion, geometry/
+                                # version check): the payload is
+                                # intact — back off, then the next
+                                # class member gets it
+                                handoff_attempts += 1
+                                gw._bump('handoff_retries')
+                                inst = _instruments()
+                                if inst is not None:
+                                    inst.handoff_retries.inc()
+                                _record_event(
+                                    'seq_handoff', stage='retry',
+                                    request_id=request_id,
+                                    to_url=rep.base_url,
+                                    reason='import %d' % exc.code,
+                                    attempt=handoff_attempts)
+                                time.sleep(gw._handoff_delay(
+                                    handoff_attempts))
+                                continue
+                            # a drain-path import target refused the
+                            # handoff (backpressure, geometry/version
+                            # check): drop to the plain resume path —
+                            # the journal (or the capped re-prefill)
+                            # still completes the stream
                             gw._bump('migration_failures')
                             inst = _instruments()
                             if inst is not None:
@@ -782,11 +1129,18 @@ class ServingGateway:
                                 # engine closing under the request on
                                 # a dying host): zero bytes relayed,
                                 # so trying another replica is safe —
-                                # the health probe will catch up
+                                # the health probe will catch up. A
+                                # 503 Draining marks the replica
+                                # draining (route-away, drain-pollable)
+                                raw = b''
                                 try:
-                                    exc.read()
+                                    raw = exc.read()
                                 except Exception:
                                     pass
+                                if exc.code == 503 \
+                                        and _draining_body(raw):
+                                    rep.mark(False, 'draining',
+                                             draining=True)
                                 gw._bump('failovers')
                                 inst = _instruments()
                                 if inst is not None:
@@ -818,6 +1172,14 @@ class ServingGateway:
                         inst = _instruments()
                         if inst is not None:
                             inst.failovers.inc()
+                        if handoff_live and migrate is not None:
+                            # a dead decode target consumes a handoff
+                            # retry too — the budget bounds the hop,
+                            # whatever killed it
+                            handoff_attempts += 1
+                            gw._bump('handoff_retries')
+                            if inst is not None:
+                                inst.handoff_retries.inc()
                         gw._note_health(len(gw.healthy_replicas()))
                         continue
                     if not started:
@@ -831,21 +1193,40 @@ class ServingGateway:
                         handler.end_headers()
                         started = True
                     if seg_path == '/import':
-                        spliced += 1
-                        gw._bump('migrated_streams')
-                        inst = _instruments()
-                        if inst is not None:
-                            inst.migrations.inc()
-                        _record_event('gateway_migrate',
-                                      request_id=request_id,
-                                      to_url=rep.base_url,
-                                      tokens=relayed)
+                        if handoff_live:
+                            dt = time.monotonic() - handoff_t0
+                            gw._bump('handoffs')
+                            inst = _instruments()
+                            if inst is not None:
+                                inst.handoffs.labels(
+                                    **{'class': rep.cls,
+                                       'outcome': 'spliced'}).inc()
+                                inst.handoff_seconds.observe(dt)
+                            _record_event(
+                                'seq_handoff', stage='spliced',
+                                request_id=request_id,
+                                to_url=rep.base_url,
+                                attempts=handoff_attempts,
+                                seconds=round(dt, 6),
+                                tokens=relayed)
+                            handoff_live = False
+                        else:
+                            spliced += 1
+                            gw._bump('migrated_streams')
+                            inst = _instruments()
+                            if inst is not None:
+                                inst.migrations.inc()
+                            _record_event('gateway_migrate',
+                                          request_id=request_id,
+                                          to_url=rep.base_url,
+                                          tokens=relayed)
                         migrate = None
                     segment_tokens = 0
                     abort_line = None       # typed upstream abort obj
                     dead = False            # transport death
                     done = False            # clean final line relayed
-                    migrating = False       # drain handoff announced
+                    migrating = False       # handoff/drain announced
+                    inline_state = None     # seqstate on the done line
                     try:
                         with resp:
                             for line in resp:
@@ -892,10 +1273,14 @@ class ServingGateway:
                                         abort_line = obj
                                     elif obj.get('finish_reason') \
                                             == 'migrated':
-                                        # clean drain handoff: do NOT
-                                        # relay — fetch the seqstate
-                                        # and splice the continuation
+                                        # clean handoff: do NOT relay
+                                        # — a prefill-boundary export
+                                        # carries its seqstate inline;
+                                        # a drain export is fetched
+                                        # from GET /drain below
                                         migrating = True
+                                        inline_state = \
+                                            obj.get('seqstate')
                                     else:
                                         if attempts or spliced:
                                             if not capped:
@@ -935,6 +1320,23 @@ class ServingGateway:
                                     segment_tokens)
                         handler._end_chunks()
                         return
+                    if migrating and inline_state is not None:
+                        # prefill-boundary handoff: the seqstate rode
+                        # the done line. The source replica is HEALTHY
+                        # (this is the routine disaggregated path, not
+                        # a drain) — keep it in rotation and POST the
+                        # payload to the least-loaded decode-class
+                        # member
+                        migrate = inline_state
+                        handoff_live = True
+                        handoff_t0 = time.monotonic()
+                        handoff_attempts = 0
+                        tried = []
+                        _record_event('seq_handoff', stage='export',
+                                      request_id=request_id,
+                                      from_url=rep.base_url,
+                                      tokens=relayed)
+                        continue
                     if migrating:
                         # the replica drained under us: pull the
                         # exported seqstate (KV pages + position +
@@ -959,7 +1361,7 @@ class ServingGateway:
                                     or time.monotonic() >= deadline:
                                 break
                             time.sleep(0.05)
-                        rep.mark(False, 'draining')
+                        rep.mark(False, 'draining', draining=True)
                         gw._note_health(len(gw.healthy_replicas()))
                         if seqs:
                             migrate = seqs[0]
@@ -1165,11 +1567,30 @@ class ServingGateway:
     def stats(self):
         with self._stats_lock:
             out = dict(self._stats)
+            routed = dict(self._class_routed)
         out['migrations'] = {
             'spliced': out.pop('migrated_streams', 0),
             'failures': out.pop('migration_failures', 0),
             'journal_capped': out.pop('journal_capped', 0),
         }
+        out['handoff'] = {
+            'spliced': out.pop('handoffs', 0),
+            'retries': out.pop('handoff_retries', 0),
+            'fallbacks': out.pop('handoff_fallbacks', 0),
+        }
+        classes = {}
+        for rep in self.replicas:
+            c = classes.setdefault(rep.cls, {
+                'replicas': 0, 'healthy': 0, 'draining': 0,
+                'routed': routed.get(rep.cls, 0), 'pool': {}})
+            c['replicas'] += 1
+            if rep.healthy:
+                c['healthy'] += 1
+            if rep.draining:
+                c['draining'] += 1
+            if rep.load is not None:
+                c['pool'][rep.base_url] = rep.load
+        out['classes'] = classes
         out['healthy'] = len(self.healthy_replicas())
         out['replicas'] = len(self.replicas)
         if self.admission is not None:

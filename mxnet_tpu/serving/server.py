@@ -236,19 +236,25 @@ class InferenceSession:
         return self._serve(list(arrays), n, seq)
 
     def generate(self, tokens, max_new_tokens=None, eos_id=None,
-                 request_id=None):
+                 request_id=None, prefill_only=False):
         """Stream a generation: returns a
         :class:`~.decode.GenerateStream` (iterate per-token, or
         ``.result(timeout)`` for the full sequence). Decode-mode
         sessions only. ``request_id`` makes re-admission idempotent
-        (the gateway's mid-stream failover contract)."""
+        (the gateway's mid-stream failover contract);
+        ``prefill_only=True`` is the disaggregated-serving admission
+        — the stream finishes ``'migrated'`` with its exported
+        seqstate payload on ``stream.seqstate``."""
         if self._engine is None:
             raise TypeError('generate() needs a DecodeProgram session '
                             '(use serving.freeze_decode)')
-        return self._engine.generate(tokens,
-                                     max_new_tokens=max_new_tokens,
-                                     eos_id=eos_id,
-                                     request_id=request_id)
+        kwargs = {'max_new_tokens': max_new_tokens, 'eos_id': eos_id,
+                  'request_id': request_id}
+        # ride as a kwarg only when asked for: duck-typed engines
+        # predating disaggregation keep working
+        if prefill_only:
+            kwargs['prefill_only'] = True
+        return self._engine.generate(tokens, **kwargs)
 
     # -- batched execution (batcher worker thread) -------------------------
 
@@ -605,6 +611,11 @@ class ServingHTTPServer:
                           'eos_id': req.get('eos_id')}
                 if request_id is not None:
                     kwargs['request_id'] = request_id
+                # disaggregated serving: a prefill-class admission
+                # exports at the prefill boundary; the done line
+                # carries the seqstate payload inline
+                if req.get('prefill_only'):
+                    kwargs['prefill_only'] = True
                 stream = gen.generate(tokens, **kwargs)
                 wait_s = (gen._engine.timeout_s
                           or _HTTP_MAX_WAIT_S)
@@ -613,6 +624,9 @@ class ServingHTTPServer:
                     done = {'tokens': toks,
                             'finish_reason': stream.finish_reason,
                             'degraded': stream.degraded}
+                    seqst = getattr(stream, 'seqstate', None)
+                    if seqst is not None:
+                        done['seqstate'] = seqst
                     if request_id is not None:
                         done['request_id'] = request_id
                     handler._json(200, done)
@@ -640,6 +654,13 @@ class ServingHTTPServer:
                             'tokens': stream.tokens,
                             'finish_reason': stream.finish_reason,
                             'degraded': stream.degraded}
+                    # prefill_only admission: the exported seqstate
+                    # rides the done line so the gateway can POST it
+                    # straight to a decode-class replica (no /drain
+                    # round-trip — this replica stays healthy)
+                    seqst = getattr(stream, 'seqstate', None)
+                    if seqst is not None:
+                        done['seqstate'] = seqst
                     if request_id is not None:
                         done['request_id'] = request_id
                     handler._chunk(done)
@@ -688,7 +709,17 @@ class ServingHTTPServer:
                                             "object)"})
                     return
                 stream = gen._engine.import_sequence(payload)
+                # default: continue numbering after the handed-off
+                # prefix. The gateway overrides with its RELAYED
+                # watermark so indices stay aligned when the source
+                # admission was itself a re-admission (its payload
+                # counts only the segment's tokens)
                 start_index = len(payload.get('emitted') or [])
+                if req.get('start_index') is not None:
+                    try:
+                        start_index = int(req['start_index'])
+                    except (TypeError, ValueError):
+                        pass
                 request_id = payload.get('request_id')
                 if not req.get('stream', True):
                     wait_s = (gen._engine.timeout_s

@@ -161,33 +161,74 @@ class _FakeHandler(BaseHTTPRequestHandler):
         self.wfile.write(line + b'\r\n')
         self.wfile.flush()
 
-    def do_GET(self):
-        ok = self.server.ctl['healthy']
-        body = json.dumps({'ok': ok}).encode()
-        self.send_response(200 if ok else 503)
+    def _json(self, code, obj, headers=None):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
         self.send_header('Content-Type', 'application/json')
         self.send_header('Content-Length', str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def do_GET(self):
+        ctl = self.server.ctl
+        if self.path.split('?')[0].rstrip('/') == '/status':
+            # the decode-pool view _pool_load reads for the
+            # least-loaded handoff pick; ctl['load'] is occupancy_pct
+            self._json(200, {'decode': {'pages': {
+                'occupancy_pct': float(ctl.get('load', 0.0))}}})
+            return
+        if ctl.get('draining'):
+            self._json(503, {'status': 'draining'})
+            return
+        ok = ctl['healthy']
+        self._json(200 if ok else 503, {'ok': ok})
 
     def do_POST(self):
         ctl = self.server.ctl
         length = int(self.headers.get('Content-Length', 0) or 0)
         req = json.loads(self.rfile.read(length) or b'{}')
         ctl['requests'].append(req)
+        if ctl.get('draining'):
+            # the typed exit notice, not a dead socket: the gateway
+            # must route AWAY without surfacing this to the client
+            self._json(503, {'error': 'draining to exit',
+                             'error_class': 'Draining'},
+                       headers={'Retry-After': '1'})
+            return
         if ctl.get('refuse', 0) > 0:
             ctl['refuse'] -= 1
-            body = json.dumps({'error': 'unavailable'}).encode()
-            self.send_response(503)
-            self.send_header('Content-Type', 'application/json')
-            self.send_header('Content-Length', str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._json(503, {'error': 'unavailable'})
+            return
+        if self.path.split('?')[0].rstrip('/') == '/import':
+            self._do_import(ctl, req)
             return
         toks = [int(t) for t in req['tokens']]
         n = int(req.get('max_new_tokens', 8))
         start = int(req.get('start_index', 0) or 0)
         rid = req.get('request_id')
+        if req.get('prefill_only'):
+            # disaggregated admission: emit the prefill-boundary
+            # token, then finish 'migrated' with the seqstate riding
+            # the done line — the fake's payload is just the sequence
+            # so far, enough for _rule_next to continue exactly
+            self.send_response(200)
+            self.send_header('Content-Type', 'application/x-ndjson')
+            self.send_header('Transfer-Encoding', 'chunked')
+            self.end_headers()
+            seq = list(toks)
+            t = _rule_next(seq)
+            seq.append(t)
+            self._chunk({'token': t, 'index': start})
+            self._chunk({'done': True, 'finish_reason': 'migrated',
+                         'seqstate': {'kind': 'fake', 'tokens': toks,
+                                      'emitted': [t],
+                                      'max_new_tokens': n,
+                                      'request_id': rid}})
+            self.wfile.write(b'0\r\n\r\n')
+            self.wfile.flush()
+            return
         self.send_response(200)
         self.send_header('Content-Type', 'application/x-ndjson')
         self.send_header('Transfer-Encoding', 'chunked')
@@ -224,6 +265,44 @@ class _FakeHandler(BaseHTTPRequestHandler):
                 'finish_reason': 'length'}
         if rid is not None:
             done['request_id'] = rid
+        self._chunk(done)
+        self.wfile.write(b'0\r\n\r\n')
+        self.wfile.flush()
+
+    def _do_import(self, ctl, req):
+        if ctl.get('refuse_import', 0) > 0:
+            # typed pool-pressure refusal: retryable — the payload
+            # stays intact on the gateway side
+            ctl['refuse_import'] -= 1
+            self._json(503, {'error': 'decode pool exhausted',
+                             'error_class': 'Backpressure'},
+                       headers={'Retry-After': '1'})
+            return
+        state = req['seqstate']
+        seq = [int(t) for t in state['tokens']] \
+            + [int(t) for t in state['emitted']]
+        n = int(state['max_new_tokens']) - len(state['emitted'])
+        start = int(req.get('start_index')
+                    if req.get('start_index') is not None
+                    else len(state['emitted']))
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/x-ndjson')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+        die_after = ctl.pop('die_after', None)
+        emitted = []
+        for i in range(n):
+            t = _rule_next(seq)
+            seq.append(t)
+            emitted.append(t)
+            self._chunk({'token': t, 'index': start + i})
+            if die_after is not None and i + 1 >= die_after:
+                self.close_connection = True
+                return
+        done = {'done': True, 'tokens': emitted,
+                'finish_reason': 'length'}
+        if state.get('request_id') is not None:
+            done['request_id'] = state['request_id']
         self._chunk(done)
         self.wfile.write(b'0\r\n\r\n')
         self.wfile.flush()
@@ -486,6 +565,247 @@ def test_gateway_instruments_registered():
     snap = obs.snapshot()
     assert 'mxnet_tpu_gateway_resumes_total' in snap
     assert 'mxnet_tpu_gateway_tenant_rejected_total' in snap
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode orchestration (fake replicas)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def disagg_quad():
+    reps = [_FakeReplica() for _ in range(4)]
+    classes = ('prefill', 'prefill', 'decode', 'decode')
+    gw = ServingGateway([(r.url, c) for r, c in zip(reps, classes)],
+                        port=0, health_period_s=30.0, timeout_s=5.0,
+                        resume=True, resume_max=2, affinity=True,
+                        handoff_timeout_s=5.0,
+                        handoff_retries=2).start()
+    yield gw, reps
+    gw.stop()
+    for r in reps:
+        r.close()
+
+
+def _class_requests(reps):
+    prefill = [q for rep in reps[:2] for q in rep.ctl['requests']]
+    decode = [q for rep in reps[2:] for q in rep.ctl['requests']]
+    return prefill, decode
+
+
+def test_disagg_handoff_splices_bit_identical(disagg_quad):
+    """The routine disaggregated path: admit prefill_only on the
+    prefill class, POST the seqstate to a decode-class member, splice
+    — one contiguous client stream equal to the monolithic run, and
+    the decode class never saw a /generate."""
+    gw, reps = disagg_quad
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 10, 'stream': True})
+    assert r['error'] is None and r['status'] == 200
+    assert r['tokens'] == _expected_tokens(_PROMPT, 10)
+    assert r['indices'] == list(range(10))
+    assert r['done']['finish_reason'] == 'length'
+    prefill_reqs, decode_reqs = _class_requests(reps)
+    assert prefill_reqs
+    assert all(q.get('prefill_only') for q in prefill_reqs)
+    assert len(decode_reqs) == 1 and 'seqstate' in decode_reqs[0]
+    assert decode_reqs[0]['start_index'] == 1
+    st = gw.stats()
+    assert st['handoff'] == {'spliced': 1, 'retries': 0,
+                             'fallbacks': 0}
+    assert st['classes']['prefill']['routed'] == 1
+    assert st['classes']['decode']['routed'] == 1
+
+
+def test_disagg_picks_least_loaded_decode(disagg_quad):
+    """The handoff target is the decode-class member with the lowest
+    observed pool load, read live from /status."""
+    gw, reps = disagg_quad
+    reps[2].ctl['load'] = 92.0
+    reps[3].ctl['load'] = 8.0
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 6, 'stream': True})
+    assert r['tokens'] == _expected_tokens(_PROMPT, 6)
+    assert not reps[2].ctl['requests']
+    assert any('seqstate' in q for q in reps[3].ctl['requests'])
+    pool = gw.stats()['classes']['decode']['pool']
+    assert pool[reps[3].url] == pytest.approx(0.08)
+
+
+def test_disagg_import_refusal_retries_next_decode(disagg_quad):
+    """A typed import refusal (pool pressure) is retryable: the
+    payload lands on the next decode-class member and the client
+    stream stays bit-identical."""
+    gw, reps = disagg_quad
+    reps[2].ctl['load'] = 0.0
+    reps[3].ctl['load'] = 50.0       # prefer reps[2] first
+    reps[2].ctl['refuse_import'] = 1
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 8, 'stream': True})
+    assert r['error'] is None
+    assert r['tokens'] == _expected_tokens(_PROMPT, 8)
+    assert r['indices'] == list(range(8))
+    assert any('seqstate' in q for q in reps[3].ctl['requests'])
+    st = gw.stats()
+    assert st['handoff'] == {'spliced': 1, 'retries': 1,
+                             'fallbacks': 0}
+
+
+def test_disagg_refusals_exhaust_budget_fall_back_monolithic(
+        disagg_quad):
+    """When every decode-class member refuses past the retry budget
+    the request finishes MONOLITHICALLY on the prefill class — never
+    dropped, still bit-identical."""
+    gw, reps = disagg_quad
+    reps[2].ctl['refuse_import'] = 8
+    reps[3].ctl['refuse_import'] = 8
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 10, 'stream': True})
+    assert r['error'] is None and r['status'] == 200
+    assert r['tokens'] == _expected_tokens(_PROMPT, 10)
+    assert r['indices'] == list(range(10))
+    st = gw.stats()
+    assert st['handoff']['fallbacks'] == 1
+    assert st['handoff']['spliced'] == 0
+    assert st['handoff']['retries'] >= 2
+    # the finishing segment ran monolithic on the PREFILL class: the
+    # decode class never served a /generate
+    _prefill_reqs, decode_reqs = _class_requests(reps)
+    assert all('seqstate' in q for q in decode_reqs)
+
+
+def test_disagg_decode_death_mid_splice_resumes(disagg_quad):
+    """A decode-class replica dying MID-spliced-stream is absorbed by
+    the journal resume: re-admit (prefill_only again), re-export,
+    re-import on the surviving class member — at-most-once indices,
+    bit-identical tokens."""
+    gw, reps = disagg_quad
+    reps[2].ctl['load'] = 0.0
+    reps[3].ctl['load'] = 50.0       # first import lands on reps[2]
+    reps[2].ctl['die_after'] = 3     # ...which dies mid-segment
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 10, 'stream': True})
+    assert r['error'] is None and r['status'] == 200
+    assert r['tokens'] == _expected_tokens(_PROMPT, 10)
+    assert r['indices'] == list(range(10))
+    assert r['done']['resumed'] == 1
+    st = gw.stats()
+    assert st['resumes'] == 1
+    assert st['handoff']['spliced'] == 2
+    assert any('seqstate' in q for q in reps[3].ctl['requests'])
+
+
+def test_probe_marks_draining_distinct_from_dead(disagg_quad):
+    """A 503 healthz with a typed draining body marks the replica
+    DRAINING (route-away, drain-pollable); a plain unhealthy 503
+    marks it dead — and the gateway's own /healthz never sheds while
+    a replica is merely draining."""
+    gw, reps = disagg_quad
+    reps[2].ctl['draining'] = True
+    reps[3].ctl['healthy'] = False
+    gw.probe_once()
+    by = {rep.base_url: rep for rep in gw.replicas}
+    assert by[reps[2].url].draining and not by[reps[2].url].healthy
+    assert not by[reps[3].url].draining
+    assert not by[reps[3].url].healthy
+    doc = json.loads(urllib.request.urlopen(
+        'http://127.0.0.1:%d/healthz' % gw.port, timeout=5).read())
+    assert doc['status'] == 'degraded'
+    assert doc['draining'] == 1
+    assert doc['classes'] == {'prefill': 2, 'decode': 0}
+    # every replica draining (none dead): still NOT the all-down shed
+    for rep in reps:
+        rep.ctl['draining'] = True
+    gw.probe_once()
+    doc = json.loads(urllib.request.urlopen(
+        'http://127.0.0.1:%d/healthz' % gw.port, timeout=5).read())
+    assert doc['ok'] is True and doc['draining'] == 4
+
+
+def test_decode_class_down_degrades_monolithic(disagg_quad):
+    """Both decode-class replicas dead: the gateway degrades to
+    monolithic serving on the prefill class (no prefill_only, no
+    imports) and /healthz says 'degraded' with the class gap."""
+    gw, reps = disagg_quad
+    reps[2].ctl['healthy'] = False
+    reps[3].ctl['healthy'] = False
+    gw.probe_once()
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 10, 'stream': True})
+    assert r['error'] is None and r['status'] == 200
+    assert r['tokens'] == _expected_tokens(_PROMPT, 10)
+    prefill_reqs, decode_reqs = _class_requests(reps)
+    assert prefill_reqs and not decode_reqs
+    assert not any(q.get('prefill_only') for q in prefill_reqs)
+    doc = json.loads(urllib.request.urlopen(
+        'http://127.0.0.1:%d/healthz' % gw.port, timeout=5).read())
+    assert doc['status'] == 'degraded'
+    assert doc['classes'] == {'prefill': 2, 'decode': 0}
+    assert gw.stats()['handoff']['spliced'] == 0
+
+
+def test_disagg_all_down_sheds_typed_with_retry_after(disagg_quad):
+    gw, reps = disagg_quad
+    for rep in reps:
+        rep.ctl['healthy'] = False
+    gw.probe_once()
+    with pytest.raises(urllib.error.HTTPError) as hz:
+        urllib.request.urlopen('http://127.0.0.1:%d/healthz' % gw.port,
+                               timeout=5)
+    assert hz.value.code == 503
+    assert hz.value.headers.get('Retry-After')
+    assert json.loads(hz.value.read())['status'] == 'unavailable'
+    r = _read_stream(gw.port, {'tokens': _PROMPT,
+                               'max_new_tokens': 4, 'stream': True})
+    assert r['status'] == 503
+    assert r['headers'].get('Retry-After')
+
+
+def test_forward_plain_reroutes_draining_503():
+    """The plain (resume-off) forwarding path treats a 503 Draining
+    as the replica's exit notice — re-route now, nothing surfaces to
+    the client; a NON-draining 503 still relays verbatim."""
+    a, b = _FakeReplica(), _FakeReplica()
+    gw = ServingGateway([a.url, b.url], port=0, health_period_s=30.0,
+                        timeout_s=5.0, resume=False,
+                        affinity=True).start()
+    try:
+        by_url = {a.url: a, b.url: b}
+        target, survivor = _target_and_survivor(gw, by_url)
+        target.ctl['draining'] = True
+        r = _read_stream(gw.port, {'tokens': _PROMPT,
+                                   'max_new_tokens': 6,
+                                   'stream': True})
+        assert r['error'] is None and r['status'] == 200
+        assert r['tokens'] == _expected_tokens(_PROMPT, 6)
+        assert survivor.ctl['requests']
+        rep = next(rp for rp in gw.replicas
+                   if rp.base_url == target.url)
+        assert rep.draining and not rep.healthy
+        assert gw.stats()['failovers'] >= 1
+        # plain 503 (no Draining class): verbatim passthrough
+        survivor.ctl['refuse'] = 1
+        target.ctl['draining'] = True    # keep target out of rotation
+        r2 = _read_stream(gw.port, {'tokens': _PROMPT,
+                                    'max_new_tokens': 6,
+                                    'stream': True})
+        assert r2['status'] == 503
+        assert r2['body']['error'] == 'unavailable'
+    finally:
+        gw.stop()
+        a.close()
+        b.close()
+
+
+def test_class_map_env_assigns_replica_classes(monkeypatch):
+    monkeypatch.setenv('MXNET_TPU_GATEWAY_CLASS_MAP',
+                       'http://h1:18471=prefill,http://h2:18471=decode')
+    gw = ServingGateway(['http://h1:18471', 'http://h2:18471',
+                         'http://h3:18471'], port=0)
+    assert [rep.cls for rep in gw.replicas] == ['prefill', 'decode',
+                                                'both']
+    assert gw.disaggregated
+    has_p, has_d = gw._class_counts()
+    assert has_p and has_d
 
 
 # ---------------------------------------------------------------------------

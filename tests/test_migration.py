@@ -467,3 +467,183 @@ def test_server_drain_hands_off_over_http():
         srv_a.stop()
         srv_b.stop()
         sess_b.close()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated serving: prefill-boundary export (prefill_only)
+# ---------------------------------------------------------------------------
+
+def test_prefill_only_exports_at_boundary_bit_identical():
+    """generate(prefill_only=True) emits the prefill-boundary token,
+    then exports instead of entering the step loop: the seqstate is
+    stashed on the stream, and an import on a DIFFERENT page
+    geometry continues bit-identically with zero prefills."""
+    model, params = _model()
+    n = 16
+    want = _reference(_paged(model, params, 8, 32), _PROMPT, n)
+    src = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    dst = DecodeEngine(_paged(model, params, 16, 16), timeout_s=60.0)
+    try:
+        s = src.generate(_PROMPT, max_new_tokens=n, prefill_only=True)
+        assert list(s) == want[:1]
+        assert s.finish_reason == 'migrated'
+        payload = s.seqstate
+        assert payload is not None
+        assert payload['schema'] == SEQSTATE_SCHEMA
+        assert payload['kind'] == 'paged'
+        assert payload['emitted'] == want[:1]
+        got = _continue_on(dst, payload)
+        assert got == want
+        sc, dc = src.stats()['counts'], dst.stats()['counts']
+        assert sc['prefill_exports'] == 1
+        assert sc['migrated_out'] == 1
+        assert dc['prefills'] == 0
+        assert dc['migrated_in'] == 1
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_prefill_only_max_new_one_finishes_locally():
+    """max_new_tokens=1 is satisfied AT the prefill boundary: there
+    is nothing to hand off — the stream finishes 'length' locally
+    with no seqstate and no export counted."""
+    model, params = _model()
+    want = _reference(_paged(model, params, 8, 32), _PROMPT, 1)
+    eng = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    try:
+        s = eng.generate(_PROMPT, max_new_tokens=1,
+                         prefill_only=True)
+        assert list(s) == want
+        assert s.finish_reason == 'length'
+        assert s.seqstate is None
+        assert eng.stats()['counts']['prefill_exports'] == 0
+    finally:
+        eng.close()
+
+
+def test_prefill_only_prefix_hit_exports_extending_state():
+    """A prefill_only admission landing entirely on cached prefix
+    pages exports the EXTENDING state (emitted=[]: no boundary token
+    was computed) — the importer steps the un-shared suffix itself,
+    no token is delivered twice, and the destination still runs zero
+    prefills."""
+    model, params = _model()
+    base = [7, 2, 9, 4, 1, 3, 5, 8, 6, 2]
+    n = 10
+    want = _reference(_paged(model, params, 8, 32), base, n)
+    src = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    dst = DecodeEngine(_paged(model, params, 8, 32), timeout_s=60.0)
+    try:
+        assert src.generate(base, max_new_tokens=n).result(60) == want
+        s = src.generate(base, max_new_tokens=n, prefill_only=True)
+        assert list(s) == []
+        assert s.finish_reason == 'migrated'
+        payload = s.seqstate
+        assert payload is not None
+        assert payload['emitted'] == []
+        got = _continue_on(dst, payload)
+        assert got == want
+        assert src.stats()['counts']['prefix_hits'] >= 1
+        assert src.stats()['counts']['prefill_exports'] == 1
+        assert dst.stats()['counts']['prefills'] == 0
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_import_refused_typed_under_pool_pressure_then_retries():
+    """A seqstate import racing destination pool pressure is refused
+    TYPED (BackpressureError), leaves pool and allocator consistent
+    (no leaked pages, no leaked slot), and the SAME payload retries
+    successfully once the pressure releases — zero re-prefill."""
+    from mxnet_tpu.serving import BackpressureError
+    model, params = _model(max_len=128)
+    n = 8
+    long_prompt = [1 + (i % 21) for i in range(30)]
+
+    def prog():
+        return _paged(model, params, 8, 9, prefill_buckets=(32, 64))
+
+    want = _reference(prog(), long_prompt, n)
+    src = DecodeEngine(prog(), timeout_s=60.0)
+    dst = DecodeEngine(prog(), timeout_s=60.0)
+    try:
+        # pos=31 at the boundary: the import needs 4 of the 8 usable
+        # pages
+        _s, payload = _export_after_first_token(src, long_prompt, n)
+        # the hog pins 5 pages (active, unevictable) for its whole
+        # 20-token decode — free stays at 3 while it runs
+        hog = dst.generate([2 + (i % 19) for i in range(38)],
+                           max_new_tokens=20)
+        next(iter(hog))
+        before = dst.stats()
+        assert before['pages']['pages_free'] <= 3
+        with pytest.raises(BackpressureError):
+            dst.import_sequence(payload, timeout=20)
+        after = dst.stats()
+        assert after['counts']['migrated_in'] == 0
+        assert after['counts']['pool_exhausted'] >= 1
+        assert after['free_slots'] == 1       # only the hog's is held
+        assert after['pages']['pages_free'] <= \
+            before['pages']['pages_free']     # nothing leaked back
+        hog.cancel()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if dst.stats()['free_slots'] == 2:
+                break
+            time.sleep(0.02)
+        pre = dst.stats()['counts']['prefills']
+        got = _continue_on(dst, payload)      # SAME payload, retried
+        assert got == want
+        post = dst.stats()['counts']
+        assert post['migrated_in'] == 1
+        assert post['prefills'] == pre        # zero re-prefill
+    finally:
+        src.close()
+        dst.close()
+
+
+def test_server_prefill_only_hands_off_over_http():
+    """The HTTP surface of the disaggregated handoff: /generate with
+    prefill_only streams the boundary token, finishes 'migrated' with
+    the seqstate ON the done line, and /import with start_index
+    splices the continuation bit-identically on another server."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.server import ServingHTTPServer
+    model, params = _model()
+    n = 12
+    want = _reference(_paged(model, params, 8, 32), _PROMPT, n)
+    sess_a = serving.InferenceSession(_paged(model, params, 8, 32),
+                                      watchdog=False)
+    sess_b = serving.InferenceSession(_paged(model, params, 16, 16),
+                                      watchdog=False)
+    srv_a = ServingHTTPServer(sess_a, port=0).start()
+    srv_b = ServingHTTPServer(sess_b, port=0).start()
+    base_a = 'http://127.0.0.1:%d' % srv_a.port
+    base_b = 'http://127.0.0.1:%d' % srv_b.port
+    try:
+        tokens, indices, done = _read_ndjson(
+            base_a + '/generate',
+            {'tokens': _PROMPT, 'max_new_tokens': n, 'stream': True,
+             'prefill_only': True, 'request_id': 'rid-po'})
+        assert done['finish_reason'] == 'migrated'
+        assert done.get('seqstate'), 'seqstate must ride the done line'
+        assert tokens == want[:1] and indices == [0]
+        got = list(tokens)
+        toks2, idx2, done2 = _read_ndjson(
+            base_b + '/import',
+            {'seqstate': done['seqstate'], 'stream': True,
+             'start_index': 1})
+        got += toks2
+        assert done2['finish_reason'] in ('length', 'eos')
+        assert got == want
+        assert indices + idx2 == list(range(n))
+        assert sess_b._engine.stats()['counts']['prefills'] == 0
+        assert sess_a._engine.stats()['counts']['prefill_exports'] \
+            == 1
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+        sess_a.close()
+        sess_b.close()

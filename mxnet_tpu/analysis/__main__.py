@@ -97,6 +97,42 @@ def good_kernel(data, scale, *, mode='fast'):
     return total
 '''
 
+_BAD_CONFIG = '''\
+import os
+from os import environ
+from mxnet_tpu import config as _config
+
+
+def _knob(name, default):
+    try:
+        return _config.get(name)
+    except Exception:
+        return default
+
+
+def unregistered_reads():
+    a = os.environ.get('MXNET_TPU_PHANTOM_KNOB', '1')
+    b = environ['MXNET_TPU_GHOST_KNOB']
+    c = os.getenv('MXNET_TPU_SHADOW_KNOB')
+    d = _knob('MXNET_TPU_LOCAL_HELPER_KNOB', 4)
+    e = _config.get('MXNET_TPU_DIRECT_KNOB')
+    return a, b, c, d, e
+'''
+
+_GOOD_CONFIG = '''\
+import os
+from mxnet_tpu import config as _config
+
+DOC_TABLE = {'MXNET_TPU_UNRELATED_MENTION': 'mentions are fine'}
+
+
+def registered_reads():
+    a = os.environ.get('MXNET_TPU_REGISTERED_KNOB', '1')
+    b = _config.get('MXNET_TPU_REGISTERED_KNOB')
+    c = os.environ.get('SOME_OTHER_PREFIX', 'x')
+    return a, b, c, DOC_TABLE
+'''
+
 _BAD_LOCK = '''\
 import threading
 
@@ -226,7 +262,7 @@ ENTRY %main.1 (p0: f32[33,16,32], p1: f32[4,32]) -> f32[33,16,32] {
 def _selftest():
     """The lint must catch the bad fixtures and pass the good ones."""
     import tempfile
-    from . import hlolint
+    from . import configlint, hlolint
     from .locklint import analyze_module
     from .tracelint import ProjectIndex, TraceLinter
     failures = []
@@ -236,6 +272,8 @@ def _selftest():
         os.makedirs(pkg)
         for name, src in (('bad_trace.py', _BAD_TRACE),
                           ('good_trace.py', _GOOD_TRACE),
+                          ('bad_config.py', _BAD_CONFIG),
+                          ('good_config.py', _GOOD_CONFIG),
                           ('bad_lock.py', _BAD_LOCK),
                           ('good_lock.py', _GOOD_LOCK)):
             with open(os.path.join(pkg, name), 'w') as f:
@@ -259,6 +297,22 @@ def _selftest():
         good = [f for f in fs if f.file.endswith('good_trace.py')]
         if good:
             failures.append('tracelint selftest: false positives on '
+                            'the good fixture: %r' % good)
+
+        registered = {'MXNET_TPU_REGISTERED_KNOB'}
+        fs = configlint.run(index, registered=registered)
+        bad = {f.message.split()[0] for f in fs
+               if f.file.endswith('bad_config.py')}
+        for want in ('MXNET_TPU_PHANTOM_KNOB', 'MXNET_TPU_GHOST_KNOB',
+                     'MXNET_TPU_SHADOW_KNOB',
+                     'MXNET_TPU_LOCAL_HELPER_KNOB',
+                     'MXNET_TPU_DIRECT_KNOB'):
+            if want not in bad:
+                failures.append('configlint selftest: unregistered '
+                                'read of %s not flagged' % want)
+        good = [f for f in fs if f.file.endswith('good_config.py')]
+        if good:
+            failures.append('configlint selftest: false positives on '
                             'the good fixture: %r' % good)
 
         fs = analyze_module(os.path.join(pkg, 'bad_lock.py'))
@@ -415,7 +469,7 @@ def _program_legs(devices):
 def main(argv=None):
     from . import (apply_baseline, baseline_payload, load_baseline,
                    repo_root, write_jsonl)
-    from . import hlolint, locklint, tracelint
+    from . import configlint, hlolint, locklint, tracelint
     from .registry import expect_from_config
 
     ap = argparse.ArgumentParser(
@@ -478,10 +532,12 @@ def main(argv=None):
     if not failures:
         print('  ok: every rule fires on bad fixtures, none on good')
 
-    print('== source lint (tracelint + locklint)', flush=True)
+    print('== source lint (tracelint + locklint + configlint)',
+          flush=True)
     index = tracelint.ProjectIndex(root=root)
     findings = tracelint.TraceLinter(index).run()
     findings += locklint.LockLinter(index).run()
+    findings += configlint.run(index)
 
     if not args.no_build:
         print('== program invariants (fresh builds, %s virtual '

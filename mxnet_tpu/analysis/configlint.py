@@ -1,0 +1,134 @@
+"""Config-registration lint: every ``MXNET_TPU_*`` knob the package
+reads must be declared in :mod:`mxnet_tpu.config`.
+
+``config.py`` is the single registry: a knob declared there gets a
+type, a default, a doc string, the ``describe()`` /
+``effective_config()`` surface, and the ENV_VARS doc-drift check.  An
+env var read anywhere else first — ``os.environ.get(...)``, a local
+``_knob()`` helper, ``config.get(...)`` on an unregistered name — is
+invisible to all of that: loadgen-style helpers swallow the
+``KeyError`` and silently fall back to their inline default, so the
+knob *looks* wired but never takes effect, and operators can't
+discover it.  That drift is exactly what this pass catches
+(CONFIG-UNREGISTERED, error).
+
+Detection is a flat AST walk over every module under the package
+(``config.py`` itself excluded): a ``MXNET_TPU_``-prefixed string
+constant is a *read* when it appears as
+
+  * an ``environ[...]`` subscript,
+  * the first argument of ``environ.get/setdefault/pop`` or
+    ``os.getenv``,
+  * the first argument of ``config.get`` / ``_config.get``, or
+  * the first argument of a call to a function *named* ``_knob`` /
+    ``knob`` / ``_cfg`` / ``_env_knob`` (the local-helper idiom).
+
+Bare string literals elsewhere (doc tables, dict keys, test payloads)
+are deliberately NOT flagged — mentioning a knob is fine; reading one
+is the contract.  Findings fingerprint on the env-var name, not the
+source line, so a knob read from five call sites is one baseline
+entry and line drift never orphans it.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import Finding, fingerprint
+
+__all__ = ['run', 'registered_names', 'scan_module']
+
+RULE = 'CONFIG-UNREGISTERED'
+
+ENV_PREFIX = 'MXNET_TPU_'
+
+# local-helper names whose first string argument is an env-var read
+_KNOB_HELPERS = frozenset(('_knob', 'knob', '_cfg', '_env_knob'))
+# attribute methods whose first string argument is an env-var read
+# when the receiver is os/environ/config-shaped
+_READ_METHODS = frozenset(('get', 'getenv', 'setdefault', 'pop'))
+_READ_BASES = frozenset(('os', 'environ', 'config', '_config'))
+
+
+def registered_names(root):
+    """Knob names declared in ``mxnet_tpu/config.py`` — the first-arg
+    string constants of its ``_knob(...)`` calls."""
+    path = os.path.join(root, 'mxnet_tpu', 'config.py')
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    names = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == '_knob'
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            names.add(node.args[0].value)
+    return names
+
+
+def _env_read_name(node):
+    """The env-var name this AST node reads, or None."""
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        is_environ = (
+            (isinstance(base, ast.Attribute) and base.attr == 'environ')
+            or (isinstance(base, ast.Name) and base.id == 'environ'))
+        if is_environ and isinstance(node.slice, ast.Constant):
+            return node.slice.value
+        return None
+    if not isinstance(node, ast.Call) or not node.args:
+        return None
+    arg0 = node.args[0]
+    if not (isinstance(arg0, ast.Constant)
+            and isinstance(arg0.value, str)):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        if fn.id in _KNOB_HELPERS or fn.id == 'getenv':
+            return arg0.value
+        return None
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _KNOB_HELPERS:
+            return arg0.value
+        if fn.attr in _READ_METHODS:
+            base = fn.value
+            basename = getattr(base, 'attr', None) \
+                or getattr(base, 'id', None)
+            if basename in _READ_BASES or fn.attr == 'getenv':
+                return arg0.value
+    return None
+
+
+def scan_module(relpath, tree, registered):
+    """CONFIG-UNREGISTERED findings for one parsed module."""
+    findings = []
+    seen = set()                     # one finding per (name) per file
+    for node in ast.walk(tree):
+        name = _env_read_name(node)
+        if (not isinstance(name, str)
+                or not name.startswith(ENV_PREFIX)
+                or name in registered or name in seen):
+            continue
+        seen.add(name)
+        findings.append(Finding(
+            RULE, 'error', relpath, getattr(node, 'lineno', 0),
+            '%s is read here but not registered in config.py — '
+            'declare it with _knob(...) so it gets a type, default, '
+            'doc and the ENV_VARS drift check' % name,
+            fp=fingerprint(RULE, relpath, text=name)))
+    return findings
+
+
+def run(index, registered=None):
+    """Lint every module in a :class:`ProjectIndex` (``config.py``
+    itself excluded — declarations are not reads)."""
+    if registered is None:
+        registered = registered_names(index.root)
+    findings = []
+    for relpath, info in sorted(index.modules.items()):
+        if relpath.endswith(os.path.join('mxnet_tpu', 'config.py')):
+            continue
+        findings.extend(scan_module(relpath, info.tree, registered))
+    return findings

@@ -209,6 +209,15 @@ KNOBS = {k.name: k for k in [
           ' when full.'),
     _knob('MXNET_TPU_FLIGHT_PATH', str, 'FLIGHT.jsonl',
           'Default dump path for the flight-recorder artifact.'),
+    _knob('MXNET_TPU_TRACE', bool, False,
+          'Distributed request tracing enable (off by default): carry'
+          ' a trace context across gateway/replica hops in the'
+          ' X-Mxnet-Trace header and emit mxnet_tpu.trace.v1 span'
+          ' records into the bounded per-process span buffer served'
+          ' at GET /trace.'),
+    _knob('MXNET_TPU_TRACE_BUFFER', int, 4096,
+          'Span-buffer capacity per process (records); the oldest'
+          ' spans drop when full.'),
     # persistent compilation cache (docs/SERVING.md; training too)
     _knob('MXNET_TPU_COMPILE_CACHE', str, None,
           "Directory for jax's persistent compilation cache. When set"
@@ -237,6 +246,10 @@ KNOBS = {k.name: k for k in [
           'Per-request budget: a request older than this fails with'
           ' RequestTimeout (HTTP 504) instead of occupying a batch'
           ' slot after its client gave up; 0 disables.'),
+    _knob('MXNET_TPU_SERVE_DRAIN_TIMEOUT_S', float, 30.0,
+          'Graceful-drain handoff budget: a draining replica waits this'
+          ' long for every exported seqstate payload to be fetched (or'
+          ' readmitted) before the drain result records expired.'),
     _knob('MXNET_TPU_SERVE_BUCKETS', str, None,
           'Explicit batch bucket ladder as a comma list (e.g.'
           ' "1,8,32,128"); unset derives powers of two up to'

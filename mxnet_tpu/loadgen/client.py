@@ -19,6 +19,8 @@ import json
 import socket
 import time
 
+from ..observability import trace as _trace
+
 __all__ = ['RequestRecord', 'LoadClient']
 
 # taxonomy: HTTP status -> error class (200 handled separately)
@@ -45,7 +47,7 @@ class RequestRecord:
     __slots__ = ('rid', 'kind', 'scheduled_t', 'fired_at', 'first_at',
                  'done_at', 'status', 'error_class', 'tokens',
                  'degraded', 'retry_after_s', 'resolved', 'detail',
-                 'resumed', 'retries')
+                 'resumed', 'retries', 'trace_id')
 
     def __init__(self, rid, kind, scheduled_t):
         self.rid = rid
@@ -63,6 +65,7 @@ class RequestRecord:
         self.detail = None               # short error text
         self.resumed = 0                 # gateway mid-stream resumes
         self.retries = 0                 # client Retry-After retries
+        self.trace_id = None             # distributed trace identity
 
     # -- derived metrics ---------------------------------------------------
 
@@ -98,7 +101,8 @@ class RequestRecord:
                 'retry_after_s': self.retry_after_s,
                 'resolved': self.resolved,
                 'resumed': self.resumed,
-                'retries': self.retries}
+                'retries': self.retries,
+                'trace_id': self.trace_id}
 
 
 class LoadClient:
@@ -134,7 +138,7 @@ class LoadClient:
 
     # -- internals ---------------------------------------------------------
 
-    def _post(self, path, payload):
+    def _post(self, path, payload, rec=None):
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout_s)
         body = json.dumps(payload).encode()
@@ -145,6 +149,13 @@ class LoadClient:
                    'Content-Length': str(len(body)),
                    'Connection': 'close'}
         headers.update(self.headers)
+        if rec is not None and _trace.enabled():
+            # client-minted bare identity: the serving side's first
+            # span becomes the tree root; each RETRY attempt is its
+            # own trace, the record keeps the served attempt's id
+            ctx = _trace.TraceContext.new()
+            rec.trace_id = ctx.trace_id
+            headers[_trace.TRACE_HEADER] = ctx.to_header()
         conn.request('POST', path, body=body, headers=headers)
         return conn
 
@@ -214,7 +225,7 @@ class LoadClient:
             rec.fired_at = self._clock()
         conn = None
         try:
-            conn = self._post('/predict', {'data': data})
+            conn = self._post('/predict', {'data': data}, rec=rec)
             resp = conn.getresponse()
             raw = resp.read()
             rec.first_at = self._clock()
@@ -261,7 +272,7 @@ class LoadClient:
             conn = self._post('/generate',
                               {'tokens': tokens,
                                'max_new_tokens': max_new_tokens,
-                               'stream': True})
+                               'stream': True}, rec=rec)
             resp = conn.getresponse()
             self._classify(rec, resp.status, resp.headers)
             if resp.status != 200:

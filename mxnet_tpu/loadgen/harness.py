@@ -902,24 +902,31 @@ def run_prefix(rig, qps=12.0, duration_s=4.0, seed=0,
 
 
 def _read_token_stream(host, port, payload, timeout_s=30.0,
-                       on_token=None):
+                       on_token=None, trace_ctx=None):
     """Read one streamed /generate end to end, keeping the token
     VALUES and indices (RequestRecord only counts tokens — the
     bit-identity drill needs the actual sequence). Returns
-    {'status', 'tokens', 'indices', 'done', 'error'}; transport
-    failures land in 'error', never raise."""
+    {'status', 'tokens', 'indices', 'done', 'error', 'trace_id'};
+    transport failures land in 'error', never raise. ``trace_ctx``
+    (a :class:`~mxnet_tpu.observability.trace.TraceContext`) rides
+    the request as the distributed-trace header."""
     import http.client
     import json as _json
     out = {'status': None, 'tokens': [], 'indices': [],
-           'done': None, 'error': None}
+           'done': None, 'error': None,
+           'trace_id': trace_ctx.trace_id
+           if trace_ctx is not None else None}
     conn = http.client.HTTPConnection(host, int(port),
                                       timeout=timeout_s)
     try:
         body = _json.dumps(payload).encode()
-        conn.request('POST', '/generate', body=body,
-                     headers={'Content-Type': 'application/json',
-                              'Content-Length': str(len(body)),
-                              'Connection': 'close'})
+        headers = {'Content-Type': 'application/json',
+                   'Content-Length': str(len(body)),
+                   'Connection': 'close'}
+        if trace_ctx is not None:
+            from ..observability.trace import TRACE_HEADER
+            headers[TRACE_HEADER] = trace_ctx.to_header()
+        conn.request('POST', '/generate', body=body, headers=headers)
         resp = conn.getresponse()
         out['status'] = resp.status
         if resp.status != 200:
@@ -951,6 +958,76 @@ def _read_token_stream(host, port, payload, timeout_s=30.0,
     finally:
         conn.close()
     return out
+
+
+def _trace_drill(rig, results, classes=None):
+    """Trace-completeness verdicts + critical-path artifact for a
+    drill pass that ran with per-stream trace contexts. Scrapes every
+    span buffer in the rig — gateway plus every replica, KILLED
+    replicas included (the rig is in-process, so a dead replica's
+    buffer is still readable: the spans a real fleet would have from
+    the gateway's last scrape) — stitches per-request trees, and
+    gates that every traced request resolved into exactly one
+    complete tree with zero orphan spans. Returns
+    ``(verdicts, metrics)``; ``({}, None)`` when no request carried a
+    trace id (tracing off)."""
+    from ..observability import trace as _tr
+    ids = [r['trace_id'] for r in results
+           if r is not None and r.get('trace_id')]
+    if not ids:
+        return {}, None
+    site_cls = {'replica:%d' % rep.port: cls
+                for rep, cls in zip(rig.replicas,
+                                    getattr(rig, 'classes', None)
+                                    or [])}
+    # the client resolves on the done LINE, a beat before the
+    # gateway handler thread unwinds and emits its gw.relay /
+    # gw.request spans — poll the scrape until every tree closes (or
+    # a short deadline: a genuinely missing span must still fail)
+    deadline = time.monotonic() + 5.0
+    while True:
+        records = list(rig.gateway._trace_buf.read())
+        for rep in rig.replicas:
+            records.extend(rep.server._trace_buf.read())
+        trees = _tr.stitch(records)
+        complete = 0
+        orphan_spans = 0
+        classes_seen = set()
+        stream_trees = []
+        for tid in ids:
+            tree = trees.get(tid)
+            if tree is None:
+                continue
+            stream_trees.append(tree)
+            if _tr.tree_verdict(tree):
+                complete += 1
+            orphan_spans += len(tree['orphans'])
+            for s in tree['spans'].values():
+                cls = site_cls.get(s.get('site'))
+                if cls:
+                    classes_seen.add(cls)
+        settled = (complete == len(ids) and orphan_spans == 0)
+        if settled or time.monotonic() >= deadline:
+            break
+        time.sleep(0.05)
+    for tree in stream_trees:
+        _tr.normalize_skew(tree)
+    verdicts = {
+        'trace_complete': complete == len(ids),
+        'trace_zero_orphans': orphan_spans == 0,
+    }
+    if classes:
+        verdicts['trace_both_classes'] = \
+            set(classes) <= classes_seen
+    metrics = {
+        'requests': len(ids),
+        'stitched_complete': complete,
+        'orphan_spans': orphan_spans,
+        'spans': sum(len(t['spans']) for t in stream_trees),
+        'classes_seen': sorted(classes_seen),
+        'critical_path': _tr.critical_path(stream_trees),
+    }
+    return verdicts, metrics
 
 
 def run_gateway_failover(rig, streams=8, seed=0,
@@ -998,7 +1075,12 @@ def run_gateway_failover(rig, streams=8, seed=0,
                  for p in payloads]
     _settle(rig)
     # killed pass: all streams concurrent; the killer waits for
-    # first tokens so the kill lands MID-stream, not before admission
+    # first tokens so the kill lands MID-stream, not before admission.
+    # The pass runs TRACED (per-stream client-minted contexts): the
+    # trace_complete verdict proves every resumed stream still
+    # stitches into one tree across the replica loss
+    from ..observability import trace as _tr
+    _tr.set_enabled(True)
     results = [None] * streams
     first_tokens = threading.Event()
 
@@ -1008,25 +1090,29 @@ def run_gateway_failover(rig, streams=8, seed=0,
     def _drive(i):
         results[i] = _read_token_stream(
             '127.0.0.1', rig.port, payloads[i], timeout_s=timeout_s,
-            on_token=_on_token)
+            on_token=_on_token, trace_ctx=_tr.TraceContext.new())
 
     threads = [threading.Thread(target=_drive, args=(i,),
                                 daemon=True,
                                 name='loadgen-failover-%d' % i)
                for i in range(streams)]
-    for th in threads:
-        th.start()
-    killed = False
-    if kill:
-        # kill on the FIRST streamed token: the first slot wave is
-        # mid-generation and the rest still queued on the target, so
-        # the loss hits streams in every admission state
-        first_tokens.wait(timeout_s)
-        rig.kill_replica(target)
-        killed = True
-    deadline = time.monotonic() + timeout_s + 10.0
-    for th in threads:
-        th.join(max(0.1, deadline - time.monotonic()))
+    try:
+        for th in threads:
+            th.start()
+        killed = False
+        if kill:
+            # kill on the FIRST streamed token: the first slot wave
+            # is mid-generation and the rest still queued on the
+            # target, so the loss hits streams in every admission
+            # state
+            first_tokens.wait(timeout_s)
+            rig.kill_replica(target)
+            killed = True
+        deadline = time.monotonic() + timeout_s + 10.0
+        for th in threads:
+            th.join(max(0.1, deadline - time.monotonic()))
+    finally:
+        _tr.set_enabled(None)      # back to the config default
     unresolved = sum(1 for th in threads if th.is_alive())
     # -- verdicts ----------------------------------------------------------
     clean = [r for r in results
@@ -1051,6 +1137,7 @@ def run_gateway_failover(rig, streams=8, seed=0,
         for r in clean)
     availability = len(clean) / float(streams) if streams else None
     gw_stats = rig.gateway.stats()
+    trace_verdicts, trace_metrics = _trace_drill(rig, results)
     verdicts = {
         'zero_error_lines': error_lines == 0,
         'availability_above_floor': availability is not None
@@ -1061,6 +1148,7 @@ def run_gateway_failover(rig, streams=8, seed=0,
         or (resumed >= 1 and gw_stats.get('resumes', 0) >= 1),
         'zero_unresolved': unresolved == 0,
     }
+    verdicts.update(trace_verdicts)
     metrics = {
         'offered': streams,
         'admitted': sum(1 for r in results
@@ -1073,6 +1161,8 @@ def run_gateway_failover(rig, streams=8, seed=0,
         'tokens_per_stream': max_new,
         'gateway': gw_stats,
     }
+    if trace_metrics is not None:
+        metrics['trace'] = trace_metrics
     return build_artifact(
         'gateway-failover',
         {'streams': streams, 'seed': seed, 'killed_replica': target
@@ -1300,6 +1390,11 @@ def run_disagg(rig, streams=8, seed=0, availability_floor=None,
     _settle(rig)
     pre = {i: dict(rig.replicas[i].decode_session._engine
                    .stats()['counts']) for i in decodes}
+    # the chaos pass runs TRACED: the trace_complete verdict proves
+    # every stream — across prefill->decode handoff AND the double
+    # kill — stitches into exactly one tree spanning both classes
+    from ..observability import trace as _tr
+    _tr.set_enabled(True)
     results = [None] * streams
     ttfts = [None] * streams
     t0s = [None] * streams
@@ -1313,29 +1408,33 @@ def run_disagg(rig, streams=8, seed=0, availability_floor=None,
         t0s[i] = time.monotonic()
         results[i] = _read_token_stream(
             '127.0.0.1', rig.port, payloads[i], timeout_s=timeout_s,
-            on_token=_on_token)
+            on_token=_on_token, trace_ctx=_tr.TraceContext.new())
 
     threads = [threading.Thread(target=_drive, args=(i,),
                                 daemon=True,
                                 name='loadgen-disagg-%d' % i)
                for i in range(streams)]
-    for th in threads:
-        th.start()
-    killed = []
-    if kill:
-        # on the first streamed token: streams are mid-handoff in
-        # every state (prefilling, exported-awaiting-import, decoding
-        # on the destination). Kill the decode-class replica FIRST
-        # (the mid-stream loss the journal resume must absorb), then
-        # a prefill-class replica (resumes must re-route)
-        first_tokens.wait(timeout_s)
-        rig.kill_replica(decodes[0])
-        killed.append(decodes[0])
-        rig.kill_replica(prefills[0])
-        killed.append(prefills[0])
-    deadline = time.monotonic() + timeout_s + 10.0
-    for th in threads:
-        th.join(max(0.1, deadline - time.monotonic()))
+    try:
+        for th in threads:
+            th.start()
+        killed = []
+        if kill:
+            # on the first streamed token: streams are mid-handoff in
+            # every state (prefilling, exported-awaiting-import,
+            # decoding on the destination). Kill the decode-class
+            # replica FIRST (the mid-stream loss the journal resume
+            # must absorb), then a prefill-class replica (resumes
+            # must re-route)
+            first_tokens.wait(timeout_s)
+            rig.kill_replica(decodes[0])
+            killed.append(decodes[0])
+            rig.kill_replica(prefills[0])
+            killed.append(prefills[0])
+        deadline = time.monotonic() + timeout_s + 10.0
+        for th in threads:
+            th.join(max(0.1, deadline - time.monotonic()))
+    finally:
+        _tr.set_enabled(None)      # back to the config default
     unresolved = sum(1 for th in threads if th.is_alive())
     # -- verdicts ----------------------------------------------------------
     clean = [r for r in results
@@ -1371,6 +1470,8 @@ def run_disagg(rig, streams=8, seed=0, availability_floor=None,
     ttft_clean = sorted(t for t in ttfts if t is not None)
     ttft_p99 = ttft_clean[max(0, int(0.99 * len(ttft_clean)) - 1)] \
         if ttft_clean else None
+    trace_verdicts, trace_metrics = _trace_drill(
+        rig, results, classes=('prefill', 'decode'))
     verdicts = {
         'zero_error_lines': error_lines == 0,
         'availability_above_floor': availability is not None
@@ -1386,6 +1487,7 @@ def run_disagg(rig, streams=8, seed=0, availability_floor=None,
         and ttft_p99 <= ttft_budget_s,
         'zero_unresolved': unresolved == 0,
     }
+    verdicts.update(trace_verdicts)
     metrics = {
         'offered': streams,
         'admitted': sum(1 for r in results
@@ -1402,6 +1504,8 @@ def run_disagg(rig, streams=8, seed=0, availability_floor=None,
         'tokens_per_stream': max_new,
         'gateway': gw_stats,
     }
+    if trace_metrics is not None:
+        metrics['trace'] = trace_metrics
     return build_artifact(
         'disagg',
         {'streams': streams, 'seed': seed, 'classes': list(classes),

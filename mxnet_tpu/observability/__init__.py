@@ -36,6 +36,7 @@ from . import hlo
 from . import recorder
 from . import roofline
 from . import spans
+from . import trace
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       counter, gauge, histogram, get_registry,
                       enabled, set_enabled, snapshot)
@@ -49,9 +50,13 @@ from .roofline import (roofline_artifact, diff_artifacts as
 from .export import (prometheus_text, write_prometheus, write_jsonl,
                      tensorboard_export, PrometheusServer,
                      maybe_start_http_server, parse_prometheus)
+from .trace import (TRACE_SCHEMA, TRACE_HEADER, TraceContext,
+                    SpanBuffer)
 
 __all__ = [
     'metrics', 'recorder', 'spans', 'export', 'hlo', 'roofline',
+    'trace', 'TRACE_SCHEMA', 'TRACE_HEADER', 'TraceContext',
+    'SpanBuffer',
     'roofline_artifact', 'diff_fusion_artifacts',
     'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'counter',
     'gauge', 'histogram', 'get_registry', 'enabled', 'set_enabled',
@@ -396,7 +401,8 @@ def summary():
     """Compact telemetry block for bench/instrument status JSON: scalar
     series verbatim, histograms reduced to count/sum/avg — small enough
     to fold into every artifact."""
-    out = {'enabled': enabled(), 'flight': get_recorder().stats()}
+    out = {'enabled': enabled(), 'flight': get_recorder().stats(),
+           'trace': trace.get_buffer().stats()}
     series_out = {}
     for name, fam in snapshot().items():
         rows = []

@@ -1,7 +1,7 @@
 """Observability selftest (CI tier 'observability', tools/ci.py).
 
 CPU-runnable proof of the unified-telemetry contract
-(docs/OBSERVABILITY.md), in seven legs:
+(docs/OBSERVABILITY.md), in eight legs:
 
   1. registry     — counter/gauge/histogram math, label children,
                     power-of-two bucket placement, snapshot shape,
@@ -9,6 +9,10 @@ CPU-runnable proof of the unified-telemetry contract
   2. disabled     — with telemetry off, mutators change nothing AND
                     allocate nothing per call (tracemalloc-verified:
                     the acceptance bar for the hot-path no-op).
+  2b. trace       — request-tracing header round trip, span-buffer
+                    bound + NDJSON drain, and the disabled path
+                    allocating nothing per span (same tracemalloc
+                    bar).
   3. flight       — ring overflow drops oldest, dump round-trips
                     through read_flight with the v1 schema, torn tail
                     lines are tolerated.
@@ -138,6 +142,64 @@ def check_disabled():
             return 'disabled-path mutators changed metric state'
     finally:
         metrics.set_enabled(None)
+    return None
+
+
+def check_trace():
+    """Request tracing (docs/OBSERVABILITY.md "Distributed request
+    tracing"): header round trip, buffer bound + NDJSON drain,
+    stitch/verdict, and the disabled path allocating nothing per
+    span."""
+    from . import trace
+    ctx = trace.TraceContext.new()
+    hop = trace.parse_header(ctx.to_header())
+    if hop is None or hop.trace_id != ctx.trace_id:
+        return 'trace header did not round-trip'
+    trace.set_enabled(True)
+    try:
+        buf = trace.SpanBuffer(capacity=4, site='selftest')
+        root = ctx.child()
+        buf.emit('gw.request', root, 0.0, 1.0)
+        for i in range(6):
+            with buf.span('gw.relay', root):
+                pass
+        st = buf.stats()
+        if st['buffered'] != 4 or st['dropped'] != 3:
+            return ('buffer bound broken: %r' % (st,))
+        recs = trace.read_ndjson(buf.ndjson())
+        if len(recs) != 4:
+            return 'ndjson drain lost records'
+    finally:
+        trace.set_enabled(None)
+    trace.set_enabled(False)
+    try:
+        buf = trace.SpanBuffer(capacity=4, site='selftest')
+        for _ in range(4):                    # warm lazy state
+            with buf.span('x', ctx):
+                pass
+            buf.emit('y', ctx.child(), 0.0, 1.0)
+        tracemalloc.start()
+        before = tracemalloc.take_snapshot()
+        for _ in range(1000):
+            with buf.span('x', ctx):
+                pass
+        after = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+        impl = os.path.abspath(trace.__file__)
+        grew = nalloc = 0
+        for stat in after.compare_to(before, 'filename'):
+            fname = stat.traceback[0].filename
+            if os.path.abspath(fname) == impl and stat.size_diff > 0:
+                grew += stat.size_diff
+                nalloc += stat.count_diff
+        if nalloc > 100 or grew > 4096:
+            return ('disabled-path spans allocated %d bytes / %d '
+                    'blocks over 1000 calls (per-call allocation)'
+                    % (grew, nalloc))
+        if buf.read() or buf.stats()['emitted'] != 0:
+            return 'disabled-path spans reached the buffer'
+    finally:
+        trace.set_enabled(None)
     return None
 
 
@@ -351,6 +413,7 @@ def main(argv=None):
     with tempfile.TemporaryDirectory() as tmp:
         legs = [('registry', check_registry),
                 ('disabled', check_disabled),
+                ('trace', check_trace),
                 ('flight', lambda: check_flight(tmp)),
                 ('exporters', lambda: check_exporters(tmp)),
                 ('spans', check_spans)]

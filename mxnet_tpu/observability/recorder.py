@@ -43,6 +43,7 @@ import time
 from collections import deque
 
 from . import metrics as _metrics
+from . import trace as _trace
 
 __all__ = ['FLIGHT_SCHEMA', 'FlightRecorder', 'get_recorder',
            'record_event', 'flight_dump', 'configure_flight',
@@ -111,12 +112,19 @@ class FlightRecorder:
 
     def record(self, kind, **fields):
         """Append one event; drops the oldest when the ring is full.
-        Every event is stamped with the writing ``process_id`` so
-        merged multi-host rings stay attributable."""
+        Every event is stamped with the writing ``process_id`` plus a
+        ``mono`` monotonic timestamp (intra-host ordering survives
+        wall-clock steps; ``read_flight`` accepts v1 lines without
+        it), and with the active ``trace_id`` when a request trace
+        context is bound to this thread."""
         if not self.enabled:
             return
-        ev = {'ts': round(self._clock(), 6), 'kind': kind,
+        ev = {'ts': round(self._clock(), 6),
+              'mono': round(time.monotonic(), 6), 'kind': kind,
               'process_id': _process_info()[0]}
+        tid = _trace.current_trace_id()
+        if tid is not None and 'trace_id' not in fields:
+            ev['trace_id'] = tid
         ev.update(fields)
         with self._lock:
             self._ring.append(ev)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import time
 
 from . import metrics as _metrics
+from . import trace as _trace
 
 __all__ = ['PHASES', 'span', 'phase_histogram']
 
@@ -50,15 +51,17 @@ class span:
         with span('data_wait'):
             batch = next(feed)
 
-    Records into the phase histogram when telemetry is enabled and into
-    the profiler (chrome trace + XPlane) when it is running; no-op
-    otherwise."""
+    Records into the phase histogram when telemetry is enabled, into
+    the profiler (chrome trace + XPlane) when it is running, and into
+    the request-trace span buffer when a trace context is bound to
+    this thread (trace.activate); no-op otherwise."""
 
-    __slots__ = ('phase', '_t0', '_prof')
+    __slots__ = ('phase', '_t0', '_w0', '_prof')
 
     def __init__(self, phase):
         self.phase = phase
         self._t0 = None
+        self._w0 = None
         self._prof = None
 
     def __enter__(self):
@@ -68,9 +71,12 @@ class span:
             prof_running = _profiler.is_running()
         except ImportError:
             pass
-        if not _metrics.enabled() and not prof_running:
+        tracing = _trace.current() is not None
+        if not _metrics.enabled() and not prof_running and not tracing:
             return self
         self._t0 = time.perf_counter()
+        if tracing:
+            self._w0 = time.time()
         if prof_running:
             from .. import profiler as _profiler
             self._prof = _profiler.scope('phase:%s' % self.phase)
@@ -86,4 +92,7 @@ class span:
         if _metrics.enabled():
             phase_histogram(self.phase).observe(
                 time.perf_counter() - self._t0)
+        if self._w0 is not None:
+            _trace.emit_phase(self.phase, self._w0, time.time())
+            self._w0 = None
         self._t0 = None

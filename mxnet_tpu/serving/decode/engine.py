@@ -188,7 +188,8 @@ class _Seq:
 
     __slots__ = ('stream', 'prompt', 'max_new', 'eos_id', 'slot',
                  'pos', 'last_token', 'enqueued_at', 'deadline_at',
-                 'first_token_at', 'table', 'pages', 'prefill_only')
+                 'first_token_at', 'table', 'pages', 'prefill_only',
+                 'trace')
 
     def __init__(self, stream, prompt, max_new, eos_id, enqueued_at,
                  deadline_at, prefill_only=False):
@@ -210,6 +211,12 @@ class _Seq:
         # disaggregated serving: export the seqstate at the prefill
         # boundary instead of entering the step loop
         self.prefill_only = prefill_only
+        # request tracing: {'ctx': TraceContext, 'enq': wall seconds,
+        # 'last': wall phase boundary, 'first_w': wall first-token,
+        # 'tok0': tokens already present at attach} — None unless the
+        # admission carried a trace context (the untraced hot path
+        # pays one None check per site)
+        self.trace = None
 
     @property
     def prompt_len(self):
@@ -269,6 +276,10 @@ class DecodeEngine:
         self.prefill_interleave = max(1, int(prefill_interleave))
         self.name = name
         self._clock = clock
+        # request-trace span sink: the HTTP server points this at its
+        # per-server SpanBuffer (distinct sites when one process hosts
+        # a whole fleet); None falls back to the process buffer
+        self.trace_sink = None
         self._breaker = breaker if breaker is not None else \
             CircuitBreaker(failure_threshold=3, reset_timeout=30.0)
         self._watchdog = watchdog
@@ -354,10 +365,33 @@ class DecodeEngine:
                 name='mxnet-tpu-%s-decode-reaper' % name)
             self._reaper.start()
 
+    # -- request tracing ---------------------------------------------------
+
+    def _trace_span(self, seq, name, t0, t1, **attrs):
+        """Emit one ``eng.*`` span under the request's trace context
+        (worker-thread sites use explicit wall timestamps — the trace
+        ctx rides ``seq.trace``, not thread-local state). No-op when
+        the admission carried no context; never raises into the
+        scheduler."""
+        tr = seq.trace
+        if tr is None:
+            return
+        sink = self.trace_sink
+        if sink is None:
+            try:
+                from ...observability import trace as _tr
+                sink = _tr.get_buffer()
+            except Exception:
+                return
+        try:
+            sink.emit(name, tr['ctx'].child(), t0, t1, **attrs)
+        except Exception:
+            pass
+
     # -- submission --------------------------------------------------------
 
     def generate(self, tokens, max_new_tokens=None, eos_id=None,
-                 request_id=None, prefill_only=False):
+                 request_id=None, prefill_only=False, trace=None):
         """Admit one prompt; returns its :class:`GenerateStream`.
 
         ``request_id`` makes admission idempotent: a second admission
@@ -372,6 +406,11 @@ class DecodeEngine:
         stream finishes with reason ``'migrated'`` and the payload on
         ``stream.seqstate``; a first-token EOS / ``max_new_tokens=1``
         sequence finishes normally (nothing left to hand off).
+
+        ``trace`` attaches a request-trace context
+        (``observability.trace.TraceContext``): the engine emits
+        ``eng.queue_wait`` / ``eng.prefill`` / ``eng.first_token`` /
+        ``eng.steps`` spans for this request into its ``trace_sink``.
 
         Raises :class:`BackpressureError` when the pending queue is at
         depth, ``ValueError`` for an empty/over-long prompt (typed at
@@ -393,6 +432,10 @@ class DecodeEngine:
         seq = _Seq(stream, prompt, max_new, eos_id, now,
                    now + self.timeout_s if self.timeout_s else None,
                    prefill_only=bool(prefill_only))
+        if trace is not None:
+            w = time.time()
+            seq.trace = {'ctx': trace, 'enq': w, 'last': w,
+                         'first_w': None, 'tok0': 0}
         rejected_depth = None
         superseded = None
         with self._lock:
@@ -558,6 +601,19 @@ class DecodeEngine:
                     seq.pages = []
         _record_event('decode_retire', slot=slot, reason=reason,
                       tokens=len(seq.stream.tokens))
+        tr = seq.trace
+        if tr is not None and tr.get('first_w') is not None:
+            # step-loop summary for THIS engine's segment of the
+            # request (a migrated-out sequence closes its segment
+            # here; the importer opens its own)
+            w = time.time()
+            ntok = len(seq.stream.tokens) - tr.get('tok0', 0)
+            steps = max(0, ntok - 1)
+            if steps and w > tr['first_w']:
+                self._trace_span(seq, 'eng.steps', tr['first_w'], w,
+                                 tokens=ntok, steps=steps,
+                                 reason=reason)
+            tr['first_w'] = None     # at-most-once per segment
 
     # -- paged pool bookkeeping (worker thread only) -----------------------
 
@@ -787,6 +843,11 @@ class DecodeEngine:
             with self._lock:
                 self._free.append(slot)
             return
+        tr = seq.trace
+        if tr is not None:
+            w0 = time.time()
+            self._trace_span(seq, 'eng.queue_wait', tr['enq'], w0)
+            tr['last'] = w0
         try:
             if self._cache is None:
                 self._cache = self.program.new_cache()
@@ -828,6 +889,13 @@ class DecodeEngine:
             inst.prefills.inc()
             inst.tokens.inc()
             inst.ttft.observe(max(0.0, now - seq.enqueued_at))
+        if tr is not None:
+            w1 = time.time()
+            self._trace_span(seq, 'eng.prefill', tr['last'], w1,
+                             tokens=len(seq.prompt))
+            self._trace_span(seq, 'eng.first_token', tr['last'], w1,
+                             ttft_s=round(w1 - tr['enq'], 6))
+            tr['last'] = tr['first_w'] = w1
         _record_event('decode_admit', slot=slot,
                       prompt_len=len(seq.prompt))
         # register BEFORE the finish check so a first-token EOS /
@@ -854,6 +922,11 @@ class DecodeEngine:
             with self._lock:
                 self._free.append(slot)
             return
+        tr = seq.trace
+        if tr is not None:
+            w0 = time.time()
+            self._trace_span(seq, 'eng.queue_wait', tr['enq'], w0)
+            tr['last'] = w0
         prompt = seq.prompt
         n = len(prompt)
         seq.table = onp.full(self.program.max_pages, TRASH_PAGE,
@@ -956,6 +1029,13 @@ class DecodeEngine:
             inst.prefills.inc()
             inst.tokens.inc()
             inst.ttft.observe(max(0.0, now - seq.enqueued_at))
+        if tr is not None:
+            w1 = time.time()
+            self._trace_span(seq, 'eng.prefill', tr['last'], w1,
+                             tokens=n)
+            self._trace_span(seq, 'eng.first_token', tr['last'], w1,
+                             ttft_s=round(w1 - tr['enq'], 6))
+            tr['last'] = tr['first_w'] = w1
         _record_event('decode_admit', slot=slot, prompt_len=n,
                       prefix_tokens=0)
         with self._lock:
@@ -1063,6 +1143,12 @@ class DecodeEngine:
             inst = _serving_instruments()
             if inst is not None:
                 inst.ttft.observe(max(0.0, now - seq.enqueued_at))
+            tr = seq.trace
+            if tr is not None:
+                w = time.time()
+                self._trace_span(seq, 'eng.first_token', tr['last'], w,
+                                 ttft_s=round(w - tr['enq'], 6))
+                tr['first_w'] = w
         seq.stream._emit(tok)
 
     def _page_faults(self, active, lookahead=0):
@@ -1356,6 +1442,8 @@ class DecodeEngine:
                 self._counts['migrated_out'] += 1
             _record_event('seq_export', seq_kind='cold',
                           prompt_len=len(cold.prompt), request_id=rid)
+            w = time.time()
+            self._trace_span(cold, 'eng.export', w, w, kind='cold')
             inst = _serving_instruments()
             if inst is not None:
                 inst.sequences_migrated.inc()
@@ -1395,6 +1483,7 @@ class DecodeEngine:
                 '%r or never admitted)' % (stream.finish_reason,))
         slot, seq = found
         t0 = self._clock()
+        w0 = time.time()
         npages = 0
         if self.paged:
             ps = self.program.page_size
@@ -1434,9 +1523,11 @@ class DecodeEngine:
         _record_event('seq_export', seq_kind=payload['kind'], slot=slot,
                       pos=int(seq.pos), tokens=len(stream.tokens),
                       pages=npages, request_id=rid)
+        self._trace_span(seq, 'eng.export', w0, time.time(),
+                         pages=npages, kind=payload['kind'])
         return payload
 
-    def import_sequence(self, payload, timeout=30.0):
+    def import_sequence(self, payload, timeout=30.0, trace=None):
         """Land an exported sequence in THIS engine and continue it —
         no prefill runs (the ``prefills`` counter is untouched): KV
         rows are re-chunked to this engine's page size and written via
@@ -1450,12 +1541,14 @@ class DecodeEngine:
         slot/pages are available, :class:`BatcherClosed` after
         :meth:`close`."""
         state = decode_payload(payload)
+        state['trace'] = trace
         if state['kind'] == 'cold':
             # never prefilled at the source: ordinary admission
             return self.generate(state['prompt'],
                                  max_new_tokens=state['max_new'],
                                  eos_id=state['eos_id'],
-                                 request_id=state['request_id'])
+                                 request_id=state['request_id'],
+                                 trace=trace)
         if state['kind'] == 'paged' and not self.paged:
             raise SeqStateError('paged seqstate cannot land in a '
                                 'slot-cache engine')
@@ -1476,6 +1569,7 @@ class DecodeEngine:
 
     def _do_import(self, state):
         t0 = self._clock()
+        w0 = time.time()
         prompt, emitted = state['prompt'], state['emitted']
         pos = state['pos']
         with self._lock:
@@ -1572,6 +1666,15 @@ class DecodeEngine:
                         'decode %s: draft re-sync failed on import; '
                         'speculation degrades to low acceptance',
                         self.name)
+        tctx = state.get('trace')
+        if tctx is not None:
+            w1 = time.time()
+            seq.trace = {'ctx': tctx, 'enq': w0, 'last': w1,
+                         'first_w': w1 if emitted else None,
+                         'tok0': len(emitted)}
+            self._trace_span(seq, 'eng.import', w0, w1,
+                             pages=npages, kind=state['kind'],
+                             tokens=len(emitted))
         rid = state['request_id']
         superseded = None
         with self._lock:

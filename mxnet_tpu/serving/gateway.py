@@ -76,6 +76,8 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+from ..observability import trace as _trace
+
 __all__ = ['ReplicaState', 'ServingGateway', 'TokenBucket',
            'TenantAdmission', 'prefix_fingerprint', 'rendezvous_rank']
 
@@ -339,6 +341,10 @@ class ServingGateway:
         GET  /status    aggregate: gateway view + every replica's
                         /status payload (or its error)
         GET  /replicas  the routing table with health + transitions
+        GET  /metrics   gateway counters in Prometheus text format
+        GET  /trace     the gateway's mxnet_tpu.trace.v1 span buffer
+                        as NDJSON (?since=N drain cursor); empty
+                        unless MXNET_TPU_TRACE is on
         POST /predict   forwarded to the next healthy replica
         POST /generate  forwarded prefix-affine; chunked NDJSON
                         streams line-by-line, resumed across replica
@@ -445,6 +451,11 @@ class ServingGateway:
         self._thread = None
         self._probe_thread = None
         self._probe_stop = None
+        # request tracing: the gateway's own span buffer (site
+        # 'gateway') — gw.request is the tree root when the client
+        # sent a bare trace identity, and every relay/handoff hop
+        # propagates its child context in the X-Mxnet-Trace header
+        self._trace_buf = _trace.SpanBuffer(site='gateway')
         self._stats = {'requests': 0, 'failovers': 0, 'shed': 0,
                        'passthrough_429': 0, 'resumes': 0,
                        'resume_failures': 0, 'affinity_routed': 0,
@@ -640,10 +651,12 @@ class ServingGateway:
         return 'gw%d-%d' % (port, seq)
 
     def _forward(self, rep, path, body, content_type, tenant=None,
-                 timeout=None):
+                 timeout=None, trace_ctx=None):
         headers = {'Content-Type': content_type or 'application/json'}
         if tenant is not None:
             headers[self.tenant_header] = tenant
+        if trace_ctx is not None:
+            headers[_trace.TRACE_HEADER] = trace_ctx.to_header()
         req = urllib.request.Request(
             rep.base_url + path, data=body, headers=headers,
             method='POST')
@@ -651,10 +664,12 @@ class ServingGateway:
             req, timeout=self.timeout_s if timeout is None
             else timeout)
 
-    def _fetch_json(self, rep, path):
+    def _fetch_json(self, rep, path, headers=None):
         try:
+            req = urllib.request.Request(rep.base_url + path,
+                                         headers=headers or {})
             with urllib.request.urlopen(
-                    rep.base_url + path, timeout=self.timeout_s) as r:
+                    req, timeout=self.timeout_s) as r:
                 return json.loads(r.read().decode())
         except urllib.error.HTTPError as exc:
             try:
@@ -689,7 +704,8 @@ class ServingGateway:
                 handler.wfile.write(body)
 
             def do_GET(handler):
-                path = handler.path.rstrip('/')
+                parsed = urllib.parse.urlparse(handler.path)
+                path = parsed.path.rstrip('/')
                 if path == '/healthz':
                     healthy = len(gw.healthy_replicas())
                     draining = sum(1 for r in gw.replicas
@@ -742,6 +758,30 @@ class ServingGateway:
                         'healthy': healthy,
                         'replicas': statuses,
                         'stats': gw.stats()})
+                elif path == '/trace':
+                    q = urllib.parse.parse_qs(parsed.query)
+                    try:
+                        since = int((q.get('since') or ['0'])[0] or 0)
+                    except (TypeError, ValueError):
+                        since = 0
+                    body = gw._trace_buf.ndjson(since)
+                    handler.send_response(200)
+                    handler.send_header('Content-Type',
+                                        'application/x-ndjson')
+                    handler.send_header('Content-Length',
+                                        str(len(body)))
+                    handler.end_headers()
+                    handler.wfile.write(body)
+                elif path == '/metrics':
+                    body = gw.metrics_text().encode()
+                    handler.send_response(200)
+                    handler.send_header(
+                        'Content-Type',
+                        'text/plain; version=0.0.4; charset=utf-8')
+                    handler.send_header('Content-Length',
+                                        str(len(body)))
+                    handler.end_headers()
+                    handler.wfile.write(body)
                 else:
                     handler.send_error(404)
 
@@ -808,7 +848,7 @@ class ServingGateway:
                 handler.wfile.write(body)
 
             def _forward_plain(handler, path, body, ctype, tenant,
-                               fingerprint=None):
+                               fingerprint=None, tctx=None):
                 """The pre-resume forwarding contract: fail over only
                 before the first upstream byte; a mid-stream transport
                 death cuts the client connection, a typed upstream
@@ -816,75 +856,95 @@ class ServingGateway:
                 path, /generate does when resume is off."""
                 tried = []
                 while True:
+                    r0 = time.time() if tctx is not None else 0.0
                     rep = gw._route(fingerprint, exclude=tried)
                     if rep is None:
                         handler._shed_no_replica(tried)
                         return
+                    if tctx is not None:
+                        gw._trace_buf.emit('gw.route', tctx.child(),
+                                           r0, time.time(),
+                                           url=rep.base_url,
+                                           cls=rep.cls)
                     tried.append(rep)
-                    try:
-                        resp = gw._forward(rep, path, body, ctype,
-                                           tenant=tenant)
-                    except urllib.error.HTTPError as exc:
-                        # a typed upstream error (429/504/503/500/400)
-                        # passes through verbatim — incl. Retry-After,
-                        # so client backoff sees the replica's queue
-                        # estimate, not a gateway guess. EXCEPT a 503
-                        # Draining: that is the replica's exit notice,
-                        # not the client's problem — honor it by
-                        # re-routing NOW to another class member
-                        if exc.code == 503:
-                            raw = b''
-                            try:
-                                raw = exc.read()
-                            except Exception:
-                                pass
-                            if _draining_body(raw):
-                                rep.mark(False, 'draining',
-                                         draining=True)
-                                gw._bump('failovers')
-                                inst = _instruments()
-                                if inst is not None:
-                                    inst.failovers.inc()
-                                gw._note_health(
-                                    len(gw.healthy_replicas()))
-                                continue
-                            handler._relay_consumed(exc, raw)
+                    # the relay span's child ctx rides the forwarded
+                    # request's X-Mxnet-Trace header: the replica's
+                    # srv.* span nests under THIS hop, which is the
+                    # skew-normalization anchor (send/receive bounds)
+                    relay = gw._trace_buf.span(
+                        'gw.relay', tctx, url=rep.base_url,
+                        cls=rep.cls, attempt=len(tried))
+                    with relay:
+                        try:
+                            resp = gw._forward(rep, path, body, ctype,
+                                               tenant=tenant,
+                                               trace_ctx=relay.ctx)
+                        except urllib.error.HTTPError as exc:
+                            # a typed upstream error (429/504/503/
+                            # 500/400) passes through verbatim — incl.
+                            # Retry-After, so client backoff sees the
+                            # replica's queue estimate, not a gateway
+                            # guess. EXCEPT a 503 Draining: that is
+                            # the replica's exit notice, not the
+                            # client's problem — honor it by
+                            # re-routing NOW to another class member
+                            if exc.code == 503:
+                                raw = b''
+                                try:
+                                    raw = exc.read()
+                                except Exception:
+                                    pass
+                                if _draining_body(raw):
+                                    rep.mark(False, 'draining',
+                                             draining=True)
+                                    gw._bump('failovers')
+                                    inst = _instruments()
+                                    if inst is not None:
+                                        inst.failovers.inc()
+                                    gw._note_health(
+                                        len(gw.healthy_replicas()))
+                                    continue
+                                handler._relay_consumed(exc, raw)
+                                return
+                            if exc.code == 429:
+                                gw._bump('passthrough_429')
+                            handler._relay_response(exc,
+                                                    streaming=False)
                             return
-                        if exc.code == 429:
-                            gw._bump('passthrough_429')
-                        handler._relay_response(exc, streaming=False)
+                        except Exception as exc:
+                            # transport-level failure: the replica is
+                            # gone — mark it down NOW and fail over
+                            # (no bytes were relayed yet, so a retry
+                            # is safe)
+                            rep.mark(False, '%s: %s'
+                                     % (type(exc).__name__, exc))
+                            gw._bump('failovers')
+                            inst = _instruments()
+                            if inst is not None:
+                                inst.failovers.inc()
+                            gw._note_health(
+                                len(gw.healthy_replicas()))
+                            continue
+                        try:
+                            with resp:
+                                handler._relay_response(
+                                    resp,
+                                    streaming=(path == '/generate'))
+                        except _hc.HTTPException as exc:
+                            # upstream died MID-stream (IncompleteRead
+                            # on a killed replica): mark it down now,
+                            # cut the client connection (the chunked
+                            # stream cannot be terminated cleanly) —
+                            # no failover, bytes already went out
+                            rep.mark(False, '%s: %s'
+                                     % (type(exc).__name__, exc))
+                            gw._note_health(
+                                len(gw.healthy_replicas()))
+                            handler.close_connection = True
+                            return
+                        except OSError:
+                            return   # client went away mid-stream
                         return
-                    except Exception as exc:
-                        # transport-level failure: the replica is gone
-                        # — mark it down NOW and fail over (no bytes
-                        # were relayed yet, so a retry is safe)
-                        rep.mark(False, '%s: %s'
-                                 % (type(exc).__name__, exc))
-                        gw._bump('failovers')
-                        inst = _instruments()
-                        if inst is not None:
-                            inst.failovers.inc()
-                        gw._note_health(
-                            len(gw.healthy_replicas()))
-                        continue
-                    try:
-                        with resp:
-                            handler._relay_response(
-                                resp, streaming=(path == '/generate'))
-                    except _hc.HTTPException as exc:
-                        # upstream died MID-stream (IncompleteRead on
-                        # a killed replica): mark it down now, cut the
-                        # client connection (the chunked stream cannot
-                        # be terminated cleanly) — no failover, bytes
-                        # already went out
-                        rep.mark(False, '%s: %s'
-                                 % (type(exc).__name__, exc))
-                        gw._note_health(len(gw.healthy_replicas()))
-                        handler.close_connection = True
-                        return
-                    except OSError:
-                        return       # client went away mid-stream
-                    return
 
             # -- journaled streaming generate (mid-stream failover) ------
 
@@ -905,7 +965,7 @@ class ServingGateway:
                     pass
 
             def _generate_resumable(handler, req, ctype, tenant,
-                                    fingerprint):
+                                    fingerprint, tctx=None):
                 """Streamed /generate with the per-stream journal:
                 relay token lines while recording them; on replica
                 death re-admit prompt+emitted on a healthy replica and
@@ -945,8 +1005,24 @@ class ServingGateway:
                 no_disagg = False   # handoff fell back: this request
                 #                     stays monolithic on the prefill
                 #                     class
+                seg_ctx = None      # trace ctx of the current relay
+                seg_t0 = 0.0        # wall start of the current relay
+                handoff_ctx = None  # trace ctx of an in-flight handoff
+                handoff_w0 = 0.0
+
+                def _seg_emit(outcome, **extra):
+                    # close the current gw.relay span exactly once per
+                    # segment — emitted manually (not a with-block)
+                    # because the 'segment' spans several try/except
+                    # arms of the loop body
+                    if seg_ctx is not None:
+                        gw._trace_buf.emit(
+                            'gw.relay', seg_ctx, seg_t0, time.time(),
+                            url=rep.base_url, cls=rep.cls,
+                            outcome=outcome, **extra)
                 while True:
                     use_prefill_only = False
+                    route_w0 = time.time() if tctx is not None else 0.0
                     if migrate is not None:
                         if handoff_live and handoff_attempts \
                                 > gw.handoff_retries:
@@ -969,6 +1045,13 @@ class ServingGateway:
                                 request_id=request_id,
                                 attempts=handoff_attempts,
                                 tokens=relayed)
+                            if handoff_ctx is not None:
+                                gw._trace_buf.emit(
+                                    'gw.handoff', handoff_ctx,
+                                    handoff_w0, time.time(),
+                                    outcome='fallback',
+                                    attempts=handoff_attempts)
+                                handoff_ctx = None
                             migrate = None
                             handoff_live = False
                             no_disagg = True
@@ -1041,6 +1124,11 @@ class ServingGateway:
                                 return
                             handler._end_chunks()
                         return
+                    if tctx is not None:
+                        gw._trace_buf.emit('gw.route', tctx.child(),
+                                           route_w0, time.time(),
+                                           url=rep.base_url,
+                                           cls=rep.cls)
                     tried.append(rep)
                     if migrate is not None:
                         seg_path = '/import'
@@ -1075,13 +1163,19 @@ class ServingGateway:
                                 payload['max_new_tokens'] = \
                                     orig_max_new - len(emitted)
                         body = json.dumps(payload).encode()
+                    if tctx is not None:
+                        seg_ctx = tctx.child()
+                        seg_t0 = time.time()
                     try:
                         resp = gw._forward(
                             rep, seg_path, body, ctype,
                             tenant=tenant,
                             timeout=(gw.handoff_timeout_s
-                                     if handoff_live else None))
+                                     if handoff_live else None),
+                            trace_ctx=seg_ctx)
                     except urllib.error.HTTPError as exc:
+                        _seg_emit('refused', code=exc.code)
+                        seg_ctx = None
                         if migrate is not None:
                             try:
                                 exc.read()
@@ -1166,6 +1260,8 @@ class ServingGateway:
                     except Exception as exc:
                         # transport failure before the segment's first
                         # byte: mark down + try the next replica
+                        _seg_emit('transport_error')
+                        seg_ctx = None
                         rep.mark(False, '%s: %s'
                                  % (type(exc).__name__, exc))
                         gw._bump('failovers')
@@ -1193,6 +1289,20 @@ class ServingGateway:
                         handler.end_headers()
                         started = True
                     if seg_path == '/import':
+                        if tctx is not None:
+                            w = time.time()
+                            gw._trace_buf.emit(
+                                'gw.splice', tctx.child(), w, w,
+                                kind=('handoff' if handoff_live
+                                      else 'drain'),
+                                url=rep.base_url, tokens=relayed)
+                        if handoff_ctx is not None:
+                            gw._trace_buf.emit(
+                                'gw.handoff', handoff_ctx,
+                                handoff_w0, time.time(),
+                                to_url=rep.base_url,
+                                attempts=handoff_attempts)
+                            handoff_ctx = None
                         if handoff_live:
                             dt = time.monotonic() - handoff_t0
                             gw._bump('handoffs')
@@ -1311,7 +1421,16 @@ class ServingGateway:
                         gw._note_health(len(gw.healthy_replicas()))
                         dead = True
                     except OSError:
+                        _seg_emit('client_gone',
+                                  tokens=segment_tokens)
                         return     # client went away mid-stream
+                    _seg_emit('done' if done
+                              else 'migrating' if migrating
+                              else 'dead' if dead
+                              else 'abort' if abort_line is not None
+                              else 'truncated',
+                              tokens=segment_tokens)
+                    seg_ctx = None
                     if done:
                         if (attempts or spliced) and segment_tokens:
                             inst = _instruments()
@@ -1332,6 +1451,9 @@ class ServingGateway:
                         handoff_t0 = time.monotonic()
                         handoff_attempts = 0
                         tried = []
+                        if tctx is not None:
+                            handoff_ctx = tctx.child()
+                            handoff_w0 = time.time()
                         _record_event('seq_handoff', stage='export',
                                       request_id=request_id,
                                       from_url=rep.base_url,
@@ -1351,9 +1473,22 @@ class ServingGateway:
                             + urllib.parse.quote(str(request_id))
                         deadline = time.monotonic() \
                             + min(gw.timeout_s, 10.0)
+                        dctx = None
+                        dhdr = None
+                        dw0 = 0.0
+                        if tctx is not None:
+                            # the drain polls carry a trace header so
+                            # the replica's srv.drain spans parent to
+                            # this gw.handoff(kind=drain) span instead
+                            # of orphaning
+                            dctx = tctx.child()
+                            dhdr = {_trace.TRACE_HEADER:
+                                    dctx.to_header()}
+                            dw0 = time.time()
                         seqs = []
                         while True:
-                            snap = gw._fetch_json(rep, drain_path) \
+                            snap = gw._fetch_json(rep, drain_path,
+                                                  headers=dhdr) \
                                 or {}
                             seqs = snap.get('sequences') or []
                             if seqs or 'error' in snap \
@@ -1361,6 +1496,11 @@ class ServingGateway:
                                     or time.monotonic() >= deadline:
                                 break
                             time.sleep(0.05)
+                        if dctx is not None:
+                            gw._trace_buf.emit(
+                                'gw.handoff', dctx, dw0, time.time(),
+                                kind='drain', from_url=rep.base_url,
+                                found=bool(seqs))
                         rep.mark(False, 'draining', draining=True)
                         gw._note_health(len(gw.healthy_replicas()))
                         if seqs:
@@ -1395,6 +1535,14 @@ class ServingGateway:
                         inst = _instruments()
                         if inst is not None:
                             inst.resumes.inc()
+                        if tctx is not None:
+                            w = time.time()
+                            gw._trace_buf.emit(
+                                'gw.readmit', tctx.child(), w, w,
+                                attempt=attempts,
+                                cause=('transport' if dead else
+                                       'abort'),
+                                tokens=relayed)
                         _record_event(
                             'gateway_resume',
                             request_id=request_id,
@@ -1450,54 +1598,76 @@ class ServingGateway:
                 ctype = handler.headers.get('Content-Type')
                 tenant = (handler.headers.get(gw.tenant_header)
                           or 'default').strip() or 'default'
-                admitted = None
-                if gw.admission is not None:
-                    ok, hint, reason = gw.admission.admit(tenant)
-                    if not ok:
-                        gw._bump('tenant_shed')
-                        if inst is not None:
-                            inst.tenant_rejected.labels(
-                                tenant=tenant, reason=reason).inc()
-                        _record_event('tenant_reject', tenant=tenant,
-                                      reason=reason,
-                                      retry_after_s=round(hint, 3))
-                        handler._json(
-                            429,
-                            {'error': 'tenant admission: %s' % reason,
-                             'tenant': tenant,
-                             'retry_after_s': round(hint, 3)},
-                            headers={'Retry-After':
-                                     str(max(1, int(hint + 0.999)))})
-                        return
-                    admitted = tenant
-                try:
-                    req = None
-                    if path == '/generate':
-                        try:
-                            req = json.loads(body or b'{}')
-                        except ValueError:
-                            req = None    # replica answers the 400
-                    fingerprint = None
-                    if gw.affinity and isinstance(req, dict) \
-                            and req.get('tokens'):
-                        try:
-                            fingerprint = prefix_fingerprint(
-                                req['tokens'])
-                        except (TypeError, ValueError):
-                            fingerprint = None
-                    if (path == '/generate' and gw.resume
-                            and isinstance(req, dict)
-                            and req.get('tokens')
-                            and req.get('stream', True)):
-                        handler._generate_resumable(
-                            req, ctype, tenant, fingerprint)
-                    else:
-                        handler._forward_plain(
-                            path, body, ctype, tenant,
-                            fingerprint=fingerprint)
-                finally:
-                    if admitted is not None:
-                        gw.admission.release(admitted)
+                # request tracing: a client-minted bare identity
+                # (all-zero span) makes gw.request the TREE ROOT;
+                # every hop below propagates a child context. Untraced
+                # requests take the shared null span — no header
+                # parse, no allocation
+                in_ctx = None
+                if _trace.enabled():
+                    in_ctx = _trace.parse_header(
+                        handler.headers.get(_trace.TRACE_HEADER))
+                with gw._trace_buf.span('gw.request', in_ctx,
+                                        path=path) as rsp, \
+                        _trace.activate(rsp.ctx):
+                    tctx = rsp.ctx
+                    admitted = None
+                    if gw.admission is not None:
+                        with gw._trace_buf.span('gw.admit', tctx,
+                                                tenant=tenant):
+                            ok, hint, reason = \
+                                gw.admission.admit(tenant)
+                        if not ok:
+                            gw._bump('tenant_shed')
+                            if inst is not None:
+                                inst.tenant_rejected.labels(
+                                    tenant=tenant,
+                                    reason=reason).inc()
+                            _record_event('tenant_reject',
+                                          tenant=tenant,
+                                          reason=reason,
+                                          retry_after_s=round(hint,
+                                                              3))
+                            handler._json(
+                                429,
+                                {'error': 'tenant admission: %s'
+                                          % reason,
+                                 'tenant': tenant,
+                                 'retry_after_s': round(hint, 3)},
+                                headers={'Retry-After':
+                                         str(max(1,
+                                                 int(hint + 0.999)))})
+                            return
+                        admitted = tenant
+                    try:
+                        req = None
+                        if path == '/generate':
+                            try:
+                                req = json.loads(body or b'{}')
+                            except ValueError:
+                                req = None  # replica answers the 400
+                        fingerprint = None
+                        if gw.affinity and isinstance(req, dict) \
+                                and req.get('tokens'):
+                            try:
+                                fingerprint = prefix_fingerprint(
+                                    req['tokens'])
+                            except (TypeError, ValueError):
+                                fingerprint = None
+                        if (path == '/generate' and gw.resume
+                                and isinstance(req, dict)
+                                and req.get('tokens')
+                                and req.get('stream', True)):
+                            handler._generate_resumable(
+                                req, ctype, tenant, fingerprint,
+                                tctx=tctx)
+                        else:
+                            handler._forward_plain(
+                                path, body, ctype, tenant,
+                                fingerprint=fingerprint, tctx=tctx)
+                    finally:
+                        if admitted is not None:
+                            gw.admission.release(admitted)
 
             def log_message(handler, *args):
                 pass
@@ -1596,6 +1766,42 @@ class ServingGateway:
         if self.admission is not None:
             out['tenants'] = self.admission.stats()
         return out
+
+    def metrics_text(self):
+        """The ``GET /metrics`` payload (Prometheus text format):
+        gateway-local series — a per-replica ``mxnet_tpu_gateway_up``
+        gauge labeled ``url``/``class`` and every scalar stats()
+        counter as ``mxnet_tpu_gateway_events_total{event=...}`` —
+        followed by the process metrics registry when telemetry is
+        enabled."""
+        lines = [
+            '# HELP mxnet_tpu_gateway_up replica health from the '
+            'gateway probe (1 healthy, 0 down/draining)',
+            '# TYPE mxnet_tpu_gateway_up gauge',
+        ]
+        for rep in self.replicas:
+            lines.append(
+                'mxnet_tpu_gateway_up{url="%s",class="%s"} %d'
+                % (rep.base_url, rep.cls, 1 if rep.healthy else 0))
+        lines.append('# HELP mxnet_tpu_gateway_events_total '
+                     'gateway request-path counters by event')
+        lines.append('# TYPE mxnet_tpu_gateway_events_total counter')
+        with self._stats_lock:
+            flat = sorted((k, v) for k, v in self._stats.items()
+                          if isinstance(v, (int, float)))
+        for k, v in flat:
+            lines.append(
+                'mxnet_tpu_gateway_events_total{event="%s"} %d'
+                % (k, v))
+        head = '\n'.join(lines) + '\n'
+        try:
+            from ..observability import export as _export
+            from ..observability import metrics as _metrics
+            if _metrics.enabled():
+                return head + _export.prometheus_text()
+        except Exception:
+            pass
+        return head
 
     def stop(self):
         if self._probe_stop is not None:

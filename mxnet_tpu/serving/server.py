@@ -38,6 +38,7 @@ import time
 
 import numpy as onp
 
+from ..observability import trace as _trace
 from .batcher import BackpressureError, BatcherClosed, MicroBatcher, \
     RequestTimeout
 from .freeze import FrozenProgram
@@ -236,7 +237,7 @@ class InferenceSession:
         return self._serve(list(arrays), n, seq)
 
     def generate(self, tokens, max_new_tokens=None, eos_id=None,
-                 request_id=None, prefill_only=False):
+                 request_id=None, prefill_only=False, trace=None):
         """Stream a generation: returns a
         :class:`~.decode.GenerateStream` (iterate per-token, or
         ``.result(timeout)`` for the full sequence). Decode-mode
@@ -254,6 +255,8 @@ class InferenceSession:
         # predating disaggregation keep working
         if prefill_only:
             kwargs['prefill_only'] = True
+        if trace is not None:
+            kwargs['trace'] = trace
         return self._engine.generate(tokens, **kwargs)
 
     # -- batched execution (batcher worker thread) -------------------------
@@ -447,6 +450,10 @@ class ServingHTTPServer:
 
         GET  /status    session status JSON
         GET  /healthz   {"ok": true|false, "status": ...}
+        GET  /trace     mxnet_tpu.trace.v1 span records as NDJSON
+                        (?since=N drain cursor); empty unless
+                        MXNET_TPU_TRACE is on (docs/OBSERVABILITY.md
+                        "Distributed request tracing")
         POST /predict   {"data": [...]}            one example
                         {"instances": [[...], ...]} many examples
         POST /generate  {"tokens": [...], "max_new_tokens": N,
@@ -509,6 +516,11 @@ class ServingHTTPServer:
         self._preempt = None
         self._preempt_stop = threading.Event()
         self._preempt_thread = None
+        # request tracing: a per-server span buffer (NOT the process
+        # global) so one test process hosting a whole fleet still gets
+        # distinct sites; the site label resolves with the port
+        self._trace_buf = _trace.SpanBuffer(site='replica:%d'
+                                            % self.port)
 
     def start(self):
         if self._httpd is not None:
@@ -572,7 +584,30 @@ class ServingHTTPServer:
                 elif path == '/drain':
                     q = parse_qs(parsed.query)
                     rid = (q.get('request_id') or [None])[0]
-                    handler._json(200, srv._drain_snapshot(rid))
+                    tctx = None
+                    if _trace.enabled():
+                        tctx = _trace.parse_header(
+                            handler.headers.get(_trace.TRACE_HEADER))
+                    with srv._trace_buf.span('srv.drain', tctx,
+                                             request_id=rid):
+                        handler._json(200, srv._drain_snapshot(rid))
+                elif path == '/trace':
+                    # span-buffer drain (NDJSON): one header line then
+                    # one line per record with seq > since; the caller
+                    # advances its own cursor to the returned one
+                    q = parse_qs(parsed.query)
+                    try:
+                        since = int((q.get('since') or ['0'])[0] or 0)
+                    except (TypeError, ValueError):
+                        since = 0
+                    body = srv._trace_buf.ndjson(since)
+                    handler.send_response(200)
+                    handler.send_header('Content-Type',
+                                        'application/x-ndjson')
+                    handler.send_header('Content-Length',
+                                        str(len(body)))
+                    handler.end_headers()
+                    handler.wfile.write(body)
                 else:
                     handler.send_error(404)
 
@@ -616,6 +651,12 @@ class ServingHTTPServer:
                 # carries the seqstate payload inline
                 if req.get('prefill_only'):
                     kwargs['prefill_only'] = True
+                # the engine's eng.* spans nest under this handler's
+                # srv.generate span (the ctx rides the sequence — the
+                # worker thread owns the admission, not this thread)
+                tctx = _trace.current()
+                if tctx is not None:
+                    kwargs['trace'] = tctx
                 stream = gen.generate(tokens, **kwargs)
                 wait_s = (gen._engine.timeout_s
                           or _HTTP_MAX_WAIT_S)
@@ -708,7 +749,12 @@ class ServingHTTPServer:
                                             "mxnet_tpu.seqstate.v1 "
                                             "object)"})
                     return
-                stream = gen._engine.import_sequence(payload)
+                tctx = _trace.current()
+                if tctx is not None:
+                    stream = gen._engine.import_sequence(payload,
+                                                         trace=tctx)
+                else:
+                    stream = gen._engine.import_sequence(payload)
                 # default: continue numbering after the handed-off
                 # prefix. The gateway overrides with its RELAYED
                 # watermark so indices stay aligned when the source
@@ -794,8 +840,22 @@ class ServingHTTPServer:
                         headers={'Retry-After':
                                  str(max(1, int(hint + 0.999)))})
                     return
+                # server-side request span: parent is the sender's
+                # relay span (X-Mxnet-Trace); the span covers parse,
+                # admission, execution, and the full streamed relay.
+                # Untraced requests get the shared null span (no
+                # header parse, no allocation)
+                tctx = None
+                if _trace.enabled():
+                    tctx = _trace.parse_header(
+                        handler.headers.get(_trace.TRACE_HEADER))
+                name = {'/generate': 'srv.generate',
+                        '/import': 'srv.import'}.get(path,
+                                                     'srv.predict')
                 try:
-                    handler._do_post_admitted(path)
+                    with srv._trace_buf.span(name, tctx) as sp, \
+                            _trace.activate(sp.ctx):
+                        handler._do_post_admitted(path)
                 finally:
                     if gate is not None:
                         gate.release()
@@ -893,6 +953,17 @@ class ServingHTTPServer:
         self._httpd = _QuietServer((self.host, self.port),
                                    Handler)
         self.port = self._httpd.server_address[1]    # resolve port 0
+        # the trace site carries the BOUND port; engine eng.* spans
+        # land in this server's buffer so /trace serves them
+        self._trace_buf.site = 'replica:%d' % self.port
+        for s in (session, decode_session):
+            eng = getattr(s, '_engine', None) if s is not None \
+                else None
+            if eng is not None:
+                try:
+                    eng.trace_sink = self._trace_buf
+                except Exception:
+                    pass
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name='mxnet-tpu-serving-http')

@@ -15,6 +15,11 @@ Stages, each timed:
                            virtual-mesh builds, failing only on
                            findings not suppressed (with a reason) in
                            the committed baseline
+  0b. env-vars             tools/env_vars_check.py — docs/ENV_VARS.md
+                           and the config.py knob registry must agree
+                           in both directions (every knob documented,
+                           every row registered or explicitly marked
+                           non-knob, defaults matching)
   1. fast test tier        pytest -m "not slow"       (~2 min)
   2. fault injection       tools/fault_smoke.py — bench.py under
                            MXNET_TPU_FAULT=device_unavailable must
@@ -142,6 +147,11 @@ def main(argv=None):
         # run before any long tier spends minutes (docs/ANALYSIS.md)
         ('lint', [py, '-m', 'mxnet_tpu.analysis',
                   '--baseline', 'LINT_BASELINE.json']),
+        # knob-registry <-> docs/ENV_VARS.md drift, both directions:
+        # unregistered doc rows, undocumented knobs, default drift
+        # (pure-AST, sub-second — the lint's doc-side complement to
+        # the CONFIG-UNREGISTERED source rule)
+        ('env-vars', [py, 'tools/env_vars_check.py']),
         ('tests', [py, '-m', 'pytest', 'tests/', '-q']
          + ([] if full else ['-m', 'not slow'])),
         # stage 1 already ran tests/test_resilience.py; this tier adds

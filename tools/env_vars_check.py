@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""ENV_VARS doc-drift gate: docs/ENV_VARS.md == mxnet_tpu/config.py.
+
+The knob registry (``config.py``) and its operator-facing table
+(``docs/ENV_VARS.md``) drift in both directions: a new knob lands
+without a doc row (operators can't discover it), or a doc row outlives
+a rename / default change (operators follow stale advice). This check
+makes both directions fail CI:
+
+  * every registered knob must have exactly one table row;
+  * every table row must name a registered knob — unless its effect
+    text says "not a config.py knob" (the explicit escape for env
+    vars read outside the registry, e.g. by a C binary before python
+    starts);
+  * each row's Default cell must be the knob default's ``repr()``
+    (the table convention: ``None``, ``True``, ``'string'``, ``4``).
+
+Pure-AST on the config side (no jax import): knob names/defaults come
+from parsing the ``_knob('NAME', typ, default, ...)`` calls, so the
+gate runs before anything heavyweight.
+
+Usage: python tools/env_vars_check.py [--doc docs/ENV_VARS.md]
+Exit 0 = in sync.
+"""
+import argparse
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NON_KNOB_MARKER = 'not a config.py knob'
+
+_ROW_RE = re.compile(r'^\| `([A-Za-z0-9_]+)` \| (.*?) \| (.*) \|$',
+                     re.M)
+
+
+def registry_defaults(config_path):
+    """{name: default} from config.py's _knob('NAME', typ, default)
+    calls, literal defaults only (non-literal defaults map to
+    Ellipsis and skip the default-cell comparison)."""
+    with open(config_path) as f:
+        tree = ast.parse(f.read())
+    knobs = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == '_knob'
+                and len(node.args) >= 3
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        name = node.args[0].value
+        try:
+            default = ast.literal_eval(node.args[2])
+        except ValueError:
+            default = Ellipsis
+        knobs[name] = default
+    return knobs
+
+
+def doc_rows(doc_path):
+    """{name: (default_cell, effect_cell)} from the markdown table."""
+    with open(doc_path) as f:
+        text = f.read()
+    rows = {}
+    dupes = []
+    for m in _ROW_RE.finditer(text):
+        name, default, effect = m.groups()
+        if name in ('Variable',):
+            continue
+        if name in rows:
+            dupes.append(name)
+        rows[name] = (default, effect)
+    return rows, dupes
+
+
+def check(config_path, doc_path):
+    knobs = registry_defaults(config_path)
+    rows, dupes = doc_rows(doc_path)
+    problems = []
+    for name in dupes:
+        problems.append('duplicate doc row: %s' % name)
+    for name in sorted(set(knobs) - set(rows)):
+        problems.append('knob %s is registered in config.py but has '
+                        'no docs/ENV_VARS.md row' % name)
+    for name in sorted(set(rows) - set(knobs)):
+        if NON_KNOB_MARKER in rows[name][1]:
+            continue
+        problems.append('doc row %s names no registered knob (rename'
+                        '/removal drift?) — register it or mark the '
+                        'row "%s"' % (name, NON_KNOB_MARKER))
+    for name in sorted(set(rows) & set(knobs)):
+        if knobs[name] is Ellipsis:
+            continue
+        want = '`%r`' % (knobs[name],)
+        got = rows[name][0]
+        if got != want:
+            problems.append('default drift on %s: doc says %s, '
+                            'config.py says %s' % (name, got, want))
+    return problems, len(knobs), len(rows)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='fail when docs/ENV_VARS.md and mxnet_tpu/'
+                    'config.py disagree')
+    ap.add_argument('--config',
+                    default=os.path.join(REPO, 'mxnet_tpu',
+                                         'config.py'))
+    ap.add_argument('--doc',
+                    default=os.path.join(REPO, 'docs', 'ENV_VARS.md'))
+    args = ap.parse_args(argv)
+    problems, n_knobs, n_rows = check(args.config, args.doc)
+    for p in problems:
+        print('DRIFT: %s' % p)
+    print('%d registered knob(s), %d doc row(s), %d problem(s)'
+          % (n_knobs, n_rows, len(problems)))
+    return 1 if problems else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Cross-process trace stitcher + critical-path reporter.
+
+Collects ``mxnet_tpu.trace.v1`` span records from NDJSON files
+(``GET /trace`` dumps, one per process) and/or live ``/trace``
+endpoints, stitches them into per-request trees keyed by trace_id,
+normalizes per-hop clock skew (each remote site's wall-clocks shifted
+into the root site's timeline, anchored on the gateway span's
+send/receive bounds), and emits:
+
+  * one waterfall per request — depth-indented spans with start/dur
+    relative to the root (``--waterfalls N`` caps how many print),
+  * the aggregate TTFT critical-path decomposition — p50/p99 TTFT
+    with per-phase attribution (queue wait / prefill / KV handoff /
+    first decode step) plus TPOT percentiles from the ``eng.steps``
+    spans.
+
+The JSON artifact (``--out``) carries schema
+``mxnet_tpu.trace_report.v1``: per-trace completeness verdicts (one
+root, zero orphans — the trace_complete gate the disagg and
+gateway-failover drills enforce) and the critical-path aggregate.
+
+Usage:
+  python tools/trace_report.py spans1.ndjson spans2.ndjson \
+      [--endpoint http://host:port] [--out REPORT.json] \
+      [--waterfalls 3] [--trace <trace_id>]
+"""
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.observability import trace  # noqa: E402
+
+REPORT_SCHEMA = 'mxnet_tpu.trace_report.v1'
+
+
+def collect(paths, endpoints, timeout_s=5.0):
+    """Span records from NDJSON files + live /trace endpoints."""
+    records = []
+    for path in paths:
+        with open(path, 'rb') as f:
+            records.extend(trace.read_ndjson(f.read()))
+    for base in endpoints:
+        url = base.rstrip('/') + '/trace'
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            records.extend(trace.read_ndjson(resp.read()))
+    return records
+
+
+def render_waterfall(tree, out=sys.stdout):
+    rows = trace.waterfall(tree)
+    for row in rows:
+        out.write('%8.2fms %s%-16s %9.2fms  %s\n'
+                  % (row['start_ms'], '  ' * row['depth'],
+                     row['name'], row['dur_ms'], row['site'] or ''))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='stitch mxnet_tpu.trace.v1 spans into per-request '
+                    'waterfalls + TTFT critical-path attribution')
+    ap.add_argument('files', nargs='*',
+                    help='NDJSON span dumps (GET /trace payloads)')
+    ap.add_argument('--endpoint', action='append', default=[],
+                    metavar='URL',
+                    help='live server base URL to scrape /trace from '
+                         '(repeatable)')
+    ap.add_argument('--trace', default=None,
+                    help='only this trace_id')
+    ap.add_argument('--waterfalls', type=int, default=3,
+                    help='print at most N per-request waterfalls '
+                         '(default 3; 0 = none)')
+    ap.add_argument('--out', default=None,
+                    help='write the JSON report here')
+    args = ap.parse_args(argv)
+    if not args.files and not args.endpoint:
+        ap.error('need at least one NDJSON file or --endpoint')
+
+    records = collect(args.files, args.endpoint)
+    trees = trace.stitch(records)
+    if args.trace:
+        trees = {k: v for k, v in trees.items() if k == args.trace}
+    if not trees:
+        print('no traces found in %d records' % len(records))
+        return 1
+
+    per_trace = {}
+    ordered = []
+    for tid, tree in sorted(trees.items()):
+        complete = trace.tree_verdict(tree)
+        offsets = trace.normalize_skew(tree)
+        per_trace[tid] = {
+            'complete': complete,
+            'spans': len(tree['spans']),
+            'roots': len(tree['roots']),
+            'orphans': len(tree['orphans']),
+            'sites': sorted({s.get('site')
+                             for s in tree['spans'].values()
+                             if s.get('site')}),
+            'skew_offsets_ms': {site: round(off * 1e3, 3)
+                                for site, off in offsets.items()},
+        }
+        ordered.append((tid, tree))
+
+    shown = 0
+    for tid, tree in ordered:
+        if shown >= max(0, args.waterfalls):
+            break
+        info = per_trace[tid]
+        print('trace %s  (%d spans, %d sites%s)'
+              % (tid, info['spans'], len(info['sites']),
+                 '' if info['complete'] else ', INCOMPLETE'))
+        render_waterfall(tree)
+        print()
+        shown += 1
+
+    cp = trace.critical_path([t for _, t in ordered])
+    n_complete = sum(1 for v in per_trace.values() if v['complete'])
+    print('%d trace(s), %d complete, %d span records'
+          % (len(per_trace), n_complete, len(records)))
+    for label in ('p50', 'p99'):
+        row = cp['ttft'].get(label)
+        if row is None:
+            continue
+        shares = ' + '.join(
+            '%s %.0f%%' % (k, v)
+            for k, v in sorted(row['share_pct'].items(),
+                               key=lambda kv: -kv[1]) if v)
+        print('TTFT %s = %.1fms: %s' % (label, row['ttft_ms'],
+                                        shares or 'n/a'))
+    for key in ('p50_ms', 'p99_ms'):
+        if key in cp['tpot']:
+            print('TPOT %s = %.2fms' % (key[:3], cp['tpot'][key]))
+
+    report = {'schema': REPORT_SCHEMA,
+              'records': len(records),
+              'traces': per_trace,
+              'complete': n_complete,
+              'critical_path': cp}
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print('wrote %s' % args.out)
+    return 0 if n_complete == len(per_trace) else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -36,6 +36,7 @@ Usage: python bench_serving.py [--quick] [--decode]
                                [--out BENCH_SERVING.json]
 """
 import argparse
+import os
 import sys
 import threading
 import time
@@ -647,6 +648,129 @@ def run_paged(status, args):
     return payload
 
 
+# ---------------------------------------------------------------------------
+# multi-adapter sweep (--adapters): Zipf fleet rotation at zero
+# retraces, adapter-vs-base throughput A/B
+# ---------------------------------------------------------------------------
+
+def run_adapters(status, args):
+    """--adapters: the multi-adapter serving sweep (docs/SERVING.md
+    "Multi-adapter serving & sampling"). One paged program frozen
+    with an adapter pool in its compiled signature serves a Zipf
+    rotation over 8 LoRA artifacts with half the traffic sampled;
+    gates zero retraces after warmup, the whole fleet resident, and
+    reports the adapter-traffic throughput next to a base-only run
+    of the same program (the overhead of gathering per-slot deltas
+    inside the one compiled step)."""
+    import tempfile
+    import jax
+    from mxnet_tpu.serving.adapters import (AdapterSpec, init_adapter,
+                                            save_adapter)
+    from mxnet_tpu.serving.decode import (DecodeEngine,
+                                          PagedDecodeProgram)
+    model, params = _paged_model(args.quick)
+    fleet, rank, slots = 8, 4, 4
+    page_size = 8 if args.quick else 16
+    aspec = AdapterSpec.for_model(model, rank=rank,
+                                  capacity=fleet + 1)
+    prog = PagedDecodeProgram(model, params, slots=slots,
+                              prefill_buckets=(8,),
+                              page_size=page_size,
+                              adapter_spec=aspec)
+    vocab = int(model.vocab)
+    rs = np.random.RandomState(17)
+    requests = [(list(rs.randint(1, vocab - 4, 6)),
+                 10 if args.quick else 24)
+                for _ in range(4 * slots)]
+
+    def drive(eng, use_fleet):
+        t0 = time.perf_counter()
+        streams = []
+        for i, (prompt, n) in enumerate(requests):
+            kw = {}
+            if use_fleet:
+                # harmonic Zipf over base + fleet, sampled every
+                # other request — the loadgen adapters-mode shape
+                kw['adapter'] = 'ad%d' % (i % fleet) if i % 3 else \
+                    'base'
+                if i % 2:
+                    kw.update(temperature=0.8, top_p=0.9, seed=i)
+            streams.append(eng.generate(prompt, max_new_tokens=n,
+                                        **kw))
+        outs = [s.result(300) for s in streams]
+        wall = time.perf_counter() - t0
+        tokens = sum(len(o) for o in outs)
+        return {'tokens': tokens, 'wall_s': round(wall, 3),
+                'tokens_per_sec': round(tokens / wall, 1)
+                if wall else None}
+
+    with tempfile.TemporaryDirectory() as root:
+        for i in range(fleet):
+            save_adapter(os.path.join(root, 'ad%d' % i),
+                         init_adapter(model, rank=rank, seed=60 + i,
+                                      scale=50.0, name='ad%d' % i))
+        eng = DecodeEngine(prog, timeout_s=300.0,
+                           max_queue=len(requests) + 4,
+                           adapters=root)
+        try:
+            # warmup every compiled path (greedy/sampled x
+            # base/adapter) and pre-load the fleet, then snapshot
+            for kw in ({}, {'temperature': 0.8, 'seed': 1},
+                       *({'adapter': 'ad%d' % i} for i in
+                         range(fleet)),
+                       {'adapter': 'ad0', 'temperature': 0.5,
+                        'seed': 2}):
+                eng.generate([1, 2, 3], max_new_tokens=4,
+                             **kw).result(300)
+            tc0 = dict(prog.trace_counts)
+            base_rec = drive(eng, use_fleet=False)
+            fleet_rec = drive(eng, use_fleet=True)
+            retraced = {k: v for k, v in prog.trace_counts.items()
+                        if tc0.get(k) != v}
+            st = eng.stats()
+        finally:
+            eng.close()
+    print('adapters: fleet %s tok/s vs base-only %s tok/s, '
+          'resident=%d loads=%d, retraced=%s'
+          % (fleet_rec['tokens_per_sec'], base_rec['tokens_per_sec'],
+             st['adapters']['resident'], st['adapters']['loads'],
+             retraced or 'none'), flush=True)
+    payload = {
+        'metrics': [{
+            'metric': 'multi_adapter_sweep',
+            'unit': 'tokens/s',
+            'platform': jax.default_backend(),
+            'adapter_fleet': fleet,
+            'adapter_rank': rank,
+            'base_only': base_rec,
+            'fleet_zipf': fleet_rec,
+            'tokens_per_sec_ratio': round(
+                fleet_rec['tokens_per_sec']
+                / base_rec['tokens_per_sec'], 3)
+            if base_rec['tokens_per_sec'] else None,
+            'adapters': st['adapters'],
+            'sampled_tokens': st['counts'].get('sampled_tokens', 0),
+            'retraced_programs': retraced,
+        }],
+    }
+    try:
+        from mxnet_tpu import observability
+        payload['telemetry'] = observability.summary()
+    except Exception as e:
+        payload['telemetry'] = {'enabled': False,
+                                'error': '%s: %s'
+                                % (type(e).__name__, e)}
+    if retraced:
+        raise AssertionError(
+            'adapter/sampling rotation retraced compiled programs '
+            'after warmup: %r' % (retraced,))
+    if st['adapters']['resident'] < fleet:
+        raise AssertionError(
+            '%d-adapter fleet served but only %d resident'
+            % (fleet, st['adapters']['resident']))
+    return payload
+
+
 def run(status, args):
     from mxnet_tpu import serving
 
@@ -713,12 +837,18 @@ def main():
                         'paged), shared-prefix TTFT A/B, and the '
                         'speculative-decoding tokens/s + acceptance-'
                         'rate leg')
+    p.add_argument('--adapters', action='store_true',
+                   help='multi-adapter sweep: Zipf rotation over an '
+                        '8-LoRA fleet (half sampled) at zero '
+                        'retraces, adapter-vs-base tokens/s A/B')
     p.add_argument('--clients', type=int, default=4)
     p.add_argument('--deadline-ms', type=float, default=2.0)
     args = p.parse_args()
 
     from mxnet_tpu.resilience import run_instrument
-    if args.paged:
+    if args.adapters:
+        fn, label = run_adapters, 'bench_adapters'
+    elif args.paged:
         fn, label = run_paged, 'bench_paged_decode'
     elif args.decode:
         fn, label = run_decode, 'bench_decode'

@@ -340,6 +340,40 @@ KNOBS = {k.name: k for k in [
           ' admitted requests. 0 (default) = unbounded, the'
           ' pre-harness behavior; production fronts set it to a'
           ' small multiple of the batch/slot capacity.'),
+    # multi-adapter (LoRA) serving + sampled decoding
+    # (serving/adapters/, docs/SERVING.md "Multi-adapter serving &
+    # sampling")
+    _knob('MXNET_TPU_SERVE_SAMPLE_ARGS', bool, True,
+          'Compile temperature/top-p/PRNG-key sampling as fixed-shape'
+          ' ARRAY arguments of the one decode step: greedy and'
+          ' sampled requests share the same executable (temperature 0'
+          ' stays byte-identical to the greedy-only program). 0'
+          ' freezes the pre-sampling signature — old artifacts load'
+          ' either way.'),
+    _knob('MXNET_TPU_SERVE_SAMPLE_MASK', bool, False,
+          'Also compile the per-request additive logit-mask argument'
+          ' (grammar/JSON constrained decoding hook): a (rows, vocab)'
+          ' float32 mask added to logits before sampling. Costs'
+          ' slots x vocab of transfer per step when used; off by'
+          ' default.'),
+    _knob('MXNET_TPU_SERVE_ADAPTER_RANK', int, 0,
+          'Low-rank adapter (LoRA) pool rank compiled into the decode'
+          ' step: per-request A/B deltas gather from a device-'
+          'resident pool inside the ONE compiled program, so adapter'
+          ' switches are int32 array-arg changes (zero retraces).'
+          ' 0 (default) freezes the base-only signature.'),
+    _knob('MXNET_TPU_SERVE_ADAPTER_SLOTS', int, 8,
+          'Device-resident adapter pool capacity (rows, incl. the'
+          ' reserved all-zero base row 0): how many LoRA variants can'
+          ' serve concurrently. Unpinned rows evict LRU on a cold'
+          ' load; with every row pinned a new adapter admission'
+          ' rejects typed (AdapterExhaustedError, shed/retry).'),
+    _knob('MXNET_TPU_SERVE_ADAPTER_DIR', str, None,
+          'Artifact-directory root the decode engine\'s adapter'
+          ' registry resolves unknown adapter ids against:'
+          ' <dir>/<id> must hold a mxnet_tpu.adapter.v1 artifact'
+          ' (loaded lazily on first use, digest-verified). Unset ='
+          ' only programmatically registered adapters resolve.'),
     # open-loop load harness + SLO gate (docs/SERVING.md "SLOs and
     # overload behavior", tools/slo_gate.py)
     _knob('MXNET_TPU_SLO_P99_MS', float, 500.0,
@@ -402,6 +436,13 @@ KNOBS = {k.name: k for k in [
           ' workload: time to first token INCLUDING the prefill-class'
           ' admission (the boundary token streams from the prefill'
           ' replica before the handoff completes).'),
+    _knob('MXNET_TPU_SLO_ADAPTER_TTFT_P99_MS', float, 600.0,
+          'TTFT p99 budget (ms) for the multi-adapter loadgen'
+          ' workload (--mode adapters): Zipf-distributed adapter ids'
+          ' + sampled/greedy mix against one engine — admissions pay'
+          ' at most one adapter pool upload, never a retrace.'
+          ' SLO_BASELINE.json adapter_ttft_p99_ms overrides it in'
+          ' the slo CI stage.'),
     _knob('MXNET_TPU_LOADGEN_SEED', int, 0,
           'Default seed for the open-loop arrival schedule'
           ' (mxnet_tpu.loadgen): same seed, same arrival times and'

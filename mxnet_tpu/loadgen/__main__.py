@@ -86,6 +86,16 @@ def _summary(doc):
                         m.get('dest_prefill_delta'),
                         m.get('dest_imports'), m.get('ttft_p99_ms'),
                         m.get('availability')))
+    if doc['mode'] == 'adapters':
+        srv = (doc.get('server') or {}).get('generate') or {}
+        pool = srv.get('adapters') or {}
+        lines.append('  fleet=%s resident=%s loads=%s evictions=%s '
+                     'sampled_tokens=%s retraced=%s'
+                     % ((doc.get('config') or {}).get('adapter_fleet'),
+                        pool.get('resident'), pool.get('loads'),
+                        pool.get('evictions'),
+                        srv.get('sampled_tokens'),
+                        m.get('retraced_programs') or 'none'))
     if doc['mode'] == 'tenants':
         for tenant in ('steady', 'burst'):
             tm = m.get(tenant) or {}
@@ -112,7 +122,8 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument('--mode', choices=('capacity', 'overload', 'chaos',
                                       'prefix', 'gateway-failover',
-                                      'drain', 'tenants', 'disagg'),
+                                      'drain', 'tenants', 'disagg',
+                                      'adapters'),
                    default='overload')
     p.add_argument('--out', default='SLO.json')
     p.add_argument('--seed', type=int, default=None,
@@ -139,9 +150,9 @@ def main(argv=None):
                    help='long soak: 4x the default windows/durations')
     args = p.parse_args(argv)
 
-    from .harness import GatewayRig, ServingRig, run_capacity, \
-        run_chaos, run_disagg, run_drain, run_gateway_failover, \
-        run_overload, run_prefix, run_tenants
+    from .harness import GatewayRig, ServingRig, run_adapters, \
+        run_capacity, run_chaos, run_disagg, run_drain, \
+        run_gateway_failover, run_overload, run_prefix, run_tenants
     from .harness import _knob
     seed = args.seed if args.seed is not None \
         else int(_knob('MXNET_TPU_LOADGEN_SEED', 0))
@@ -153,10 +164,17 @@ def main(argv=None):
     mix = {'predict': 1.0} if args.no_generate else None
 
     if args.mode in ('prefix', 'gateway-failover', 'drain',
-                     'tenants', 'disagg') and args.no_generate:
+                     'tenants', 'disagg', 'adapters') \
+            and args.no_generate:
         raise SystemExit('--mode %s needs the generate rig'
                          % args.mode)
-    if args.mode == 'prefix':
+    if args.mode == 'adapters':
+        # multi-adapter Zipf workload: 8 LoRA artifacts + the base
+        # row baked into one compiled signature; a deeper queue keeps
+        # replica-side 429s out of the zero-retrace/TTFT signal
+        rig = ServingRig(predict=False, adapter_fleet=8,
+                         decode_max_queue=16)
+    elif args.mode == 'prefix':
         # bigger prefill bucket: the shared-prefix workload carries
         # page-aligned system prompts + a one-token suffix
         rig = ServingRig(decode_prefill_buckets=(32,))
@@ -210,7 +228,12 @@ def main(argv=None):
     else:
         rig = ServingRig(generate=not args.no_generate)
     try:
-        if args.mode == 'prefix':
+        if args.mode == 'adapters':
+            doc = run_adapters(rig, qps=args.qps or 10.0,
+                               duration_s=(args.duration
+                                           or 4.0 * scale),
+                               seed=seed)
+        elif args.mode == 'prefix':
             doc = run_prefix(rig, qps=args.qps or 12.0,
                              duration_s=(args.duration
                                          or 4.0 * scale),

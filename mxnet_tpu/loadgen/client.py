@@ -252,27 +252,33 @@ class LoadClient:
             rec.resolved = True
         return rec
 
-    def generate(self, rec, tokens, max_new_tokens=8):
+    def generate(self, rec, tokens, max_new_tokens=8, extra=None):
         """POST /generate with stream=true; reads the NDJSON lines as
         they arrive (TTFT = first line, TPOT from the line spacing).
         A typed mid-stream error line resolves the record with
         error_class ``stream_<Class>``; a stream the gateway resumed
         across a replica loss resolves CLEAN with ``rec.resumed`` > 0
         (success-with-resume, not a failure). Retries 429/503 with
-        capped Retry-After backoff when the retry budget allows."""
+        capped Retry-After backoff when the retry budget allows.
+        ``extra`` merges additional body fields into the request —
+        the multi-adapter workload rides it (``adapter``,
+        ``temperature`` / ``top_p`` / ``seed``)."""
         return self._with_retries(
             rec,
-            lambda r: self._generate_once(r, tokens, max_new_tokens))
+            lambda r: self._generate_once(r, tokens, max_new_tokens,
+                                          extra))
 
-    def _generate_once(self, rec, tokens, max_new_tokens=8):
+    def _generate_once(self, rec, tokens, max_new_tokens=8,
+                       extra=None):
         if rec.fired_at is None:
             rec.fired_at = self._clock()
+        body = {'tokens': tokens, 'max_new_tokens': max_new_tokens,
+                'stream': True}
+        if extra:
+            body.update(extra)
         conn = None
         try:
-            conn = self._post('/generate',
-                              {'tokens': tokens,
-                               'max_new_tokens': max_new_tokens,
-                               'stream': True}, rec=rec)
+            conn = self._post('/generate', body, rec=rec)
             resp = conn.getresponse()
             self._classify(rec, resp.status, resp.headers)
             if resp.status != 200:

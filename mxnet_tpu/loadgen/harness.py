@@ -28,6 +28,7 @@ committed SLO_BASELINE.json budgets in the ``slo`` CI stage.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -100,12 +101,15 @@ def _build_frozen():
 
 
 def _build_decoder(slots, pages=None, prefill_buckets=(8,),
-                   max_len=64, page_size=8):
+                   max_len=64, page_size=8, adapter_rank=0,
+                   adapter_slots=0):
     """Deterministic tiny transformer LM over the PAGED KV cache —
     the /generate workload. The pool defaults to ~65% of the
     worst-case (slots × max_pages) reservation: a production-shaped
     oversubscription, so the chaos squeeze can actually exhaust it
-    while normal soak traffic never does."""
+    while normal soak traffic never does. ``adapter_rank`` > 0 bakes
+    an adapter pool (``adapter_slots`` rows incl. the base row) into
+    the compiled signature — the multi-adapter workload mode."""
     from ..serving.decode import (PagedDecodeProgram,
                                   init_transformer_lm)
     model, params = init_transformer_lm(vocab=_VOCAB, units=16,
@@ -114,10 +118,35 @@ def _build_decoder(slots, pages=None, prefill_buckets=(8,),
     max_pages = -(-max_len // page_size)
     if pages is None:
         pages = max(2, int(0.65 * slots * max_pages) + 1)
+    aspec = None
+    if adapter_rank:
+        from ..serving.adapters import AdapterSpec
+        aspec = AdapterSpec.for_model(model, rank=int(adapter_rank),
+                                      capacity=int(adapter_slots))
     return PagedDecodeProgram(model, params, slots=slots,
                               prefill_buckets=prefill_buckets,
                               page_size=page_size, pages=pages,
+                              adapter_spec=aspec,
                               name='loadgen-lm')
+
+
+def _stamp_adapter_fleet(root, n, rank=4):
+    """Stamp ``n`` deterministic LoRA artifacts for the loadgen LM
+    (ids ``ad0`` .. ``ad{n-1}``) under ``root``. scale=50: the random
+    0.05-std A/B product is tiny, and the workload verdict needs the
+    adapters to visibly steer the stream."""
+    from ..serving.adapters import init_adapter, save_adapter
+    from ..serving.decode import init_transformer_lm
+    model, _ = init_transformer_lm(vocab=_VOCAB, units=16, hidden=24,
+                                   layers=1, heads=2, max_len=64,
+                                   seed=5)
+    ids = []
+    for i in range(int(n)):
+        ad = init_adapter(model, rank=rank, seed=300 + i, scale=50.0,
+                          name='ad%d' % i)
+        save_adapter(os.path.join(root, 'ad%d' % i), ad)
+        ids.append('ad%d' % i)
+    return ids
 
 
 class ServingRig:
@@ -135,7 +164,8 @@ class ServingRig:
                  slots=4, decode_max_queue=6, max_new_tokens=8,
                  breaker_threshold=3, breaker_reset_s=0.4,
                  max_concurrent=24, warmup=True, decode_pages=None,
-                 decode_prefill_buckets=(8,), decode_max_len=64):
+                 decode_prefill_buckets=(8,), decode_max_len=64,
+                 adapter_fleet=0, adapter_rank=4):
         from ..resilience.policy import CircuitBreaker
         from ..serving.server import InferenceSession, \
             ServingHTTPServer
@@ -146,6 +176,22 @@ class ServingRig:
         self.slots = int(slots)
         self.predict_session = None
         self.decode_session = None
+        # multi-adapter workload mode: stamp a fleet of LoRA
+        # artifacts and bake a pool row per adapter (+ base row 0)
+        # into the decode program's compiled signature
+        self.adapter_ids = []
+        self._adapter_tmp = None
+        adapter_dir = None
+        if adapter_fleet:
+            if not generate:
+                raise ValueError('adapter_fleet needs the generate '
+                                 'rig')
+            import tempfile
+            self._adapter_tmp = tempfile.TemporaryDirectory(
+                prefix='loadgen-adapters-')
+            adapter_dir = self._adapter_tmp.name
+            self.adapter_ids = _stamp_adapter_fleet(
+                adapter_dir, adapter_fleet, rank=adapter_rank)
         if predict:
             frozen = _build_frozen()
             if warmup:
@@ -162,7 +208,9 @@ class ServingRig:
             prog = _build_decoder(
                 slots, pages=decode_pages,
                 prefill_buckets=decode_prefill_buckets,
-                max_len=decode_max_len)
+                max_len=decode_max_len,
+                adapter_rank=adapter_rank if adapter_fleet else 0,
+                adapter_slots=adapter_fleet + 1)
             if warmup:
                 prog.warmup()
             self.decode_session = InferenceSession(
@@ -171,7 +219,7 @@ class ServingRig:
                 breaker=CircuitBreaker(
                     failure_threshold=breaker_threshold,
                     reset_timeout=breaker_reset_s),
-                name='loadgen-decode')
+                name='loadgen-decode', adapters=adapter_dir)
         primary = self.predict_session or self.decode_session
         secondary = self.decode_session \
             if self.predict_session is not None else None
@@ -210,6 +258,10 @@ class ServingRig:
                     st['counts']['prefix_hits']
                 out['generate']['pool_exhausted'] = \
                     st['counts']['pool_exhausted']
+            if st.get('adapters'):
+                out['generate']['adapters'] = st['adapters']
+                out['generate']['sampled_tokens'] = \
+                    st['counts'].get('sampled_tokens', 0)
         return out
 
     def healthy(self, payload):
@@ -237,6 +289,8 @@ class ServingRig:
         for sess in (self.predict_session, self.decode_session):
             if sess is not None:
                 sess.close(drain=False)
+        if self._adapter_tmp is not None:
+            self._adapter_tmp.cleanup()
 
 
 class GatewayRig:
@@ -369,7 +423,7 @@ class Dispatcher:
 
     def __init__(self, client, max_new_tokens=8, max_inflight=None,
                  clock=time.monotonic, sleep=time.sleep,
-                 prefix_prompts=None):
+                 prefix_prompts=None, adapter_ids=None):
         self.client = client
         self.max_new_tokens = int(max_new_tokens)
         self.max_inflight = int(
@@ -379,6 +433,12 @@ class Dispatcher:
         # prompt Zipf-style (rank weights ~ 3:2:1) and append a
         # per-rid suffix token — deterministic in rid, so runs replay
         self.prefix_prompts = [list(p) for p in (prefix_prompts or [])]
+        # multi-adapter workload mode: each generate request draws an
+        # adapter Zipf-style over the fleet (harmonic rank weights,
+        # pure in rid) and every other request samples (temperature
+        # 0.8, per-rid seed) — greedy and sampled traffic interleave
+        # on the same engine, the one-compiled-step claim under load
+        self.adapter_ids = list(adapter_ids or [])
         self._clock = clock
         self._sleep = sleep
         # O(1) in-flight accounting: the dispatch loop sits on the
@@ -407,15 +467,37 @@ class Dispatcher:
         sp = prompts[rank % len(prompts)]
         return sp + [1 + (rid % (_VOCAB - 2))]
 
+    def _adapter_extra(self, rid):
+        """Per-rid adapter + sampling fields (pure in rid, so runs
+        replay). Zipf over [base] + fleet via harmonic rank weights;
+        odd rids sample, even rids stay greedy."""
+        ids = ['base'] + self.adapter_ids
+        # harmonic Zipf: rank r picked proportional to 1/(r+1)
+        weights = [1.0 / (r + 1) for r in range(len(ids))]
+        total = sum(weights)
+        u = ((rid * 2654435761) % 1000) / 1000.0 * total
+        rank = 0
+        for rank, w in enumerate(weights):
+            u -= w
+            if u < 0:
+                break
+        extra = {'adapter': ids[rank]}
+        if rid % 2:
+            extra.update(temperature=0.8, top_p=0.9, seed=rid)
+        return extra
+
     def _fire(self, rec):
         try:
             if rec.kind == 'generate':
                 payload = self._prefix_payload(rec.rid) \
                     if self.prefix_prompts \
                     else self._generate_payload(rec.rid)
+                extra = self._adapter_extra(rec.rid) \
+                    if self.adapter_ids else None
                 self.client.generate(
                     rec, payload,
-                    max_new_tokens=self.max_new_tokens)
+                    max_new_tokens=self.max_new_tokens,
+                    extra=extra)
             else:
                 self.client.predict(rec,
                                     self._predict_payload(rec.rid))
@@ -461,12 +543,13 @@ class Dispatcher:
 
 
 def _run_window(rig, qps, duration_s, mix, seed, timeout_s,
-                poisson=True, prefix_prompts=None):
+                poisson=True, prefix_prompts=None, adapter_ids=None):
     """One open-loop window against the rig; returns (records,
     unresolved)."""
     client = LoadClient('127.0.0.1', rig.port, timeout_s=timeout_s)
     disp = Dispatcher(client, max_new_tokens=rig.max_new_tokens,
-                      prefix_prompts=prefix_prompts)
+                      prefix_prompts=prefix_prompts,
+                      adapter_ids=adapter_ids)
     arrivals = build_schedule(qps, duration_s, mix=mix, seed=seed,
                               poisson=poisson)
     records, threads = disp.run(arrivals)
@@ -898,6 +981,69 @@ def run_prefix(rig, qps=12.0, duration_s=4.0, seed=0,
          'system_prompt_len': int(system_prompt_len),
          'zipf_system_prompts': len(prompts),
          'prefix_ttft_p99_budget_ms': ttft_p99_budget_s * 1e3},
+        m, server=server, verdicts=verdicts)
+
+
+def run_adapters(rig, qps=10.0, duration_s=4.0, seed=0,
+                 ttft_p99_budget_s=None, timeout_s=6.0):
+    """Multi-adapter Zipf workload mode (docs/SERVING.md
+    "Multi-adapter serving & sampling"): generate-only open-loop
+    traffic where every request draws an adapter Zipf-style over
+    ``base`` + the rig's fleet and every other request samples
+    (temperature 0.8, per-rid seed). Gates the one-compiled-step
+    claim under load — the decode program's trace_counts must not
+    move after warmup while >= 8 adapters rotate through mixed
+    greedy/sampled traffic — plus a TTFT p99 budget
+    (``MXNET_TPU_SLO_ADAPTER_TTFT_P99_MS`` / SLO_BASELINE
+    ``adapter_ttft_p99_ms``), the whole fleet resident server-side,
+    and sampled tokens actually observed."""
+    sess = rig.decode_session
+    if sess is None or not rig.adapter_ids:
+        raise ValueError('adapters mode needs a generate rig built '
+                         'with adapter_fleet > 0')
+    ttft_p99_budget_s = float(
+        ttft_p99_budget_s if ttft_p99_budget_s is not None
+        else _knob('MXNET_TPU_SLO_ADAPTER_TTFT_P99_MS', 600.0) / 1e3)
+    # warmup: touch every compiled path once (greedy base, sampled
+    # base, greedy adapter, sampled adapter) and pre-load the whole
+    # fleet so the measured window carries zero first-load device
+    # writes, then snapshot the trace ledger
+    fleet = list(rig.adapter_ids)
+    warm = [{}, {'temperature': 0.8, 'top_p': 0.9, 'seed': 1},
+            {'adapter': fleet[0]},
+            {'adapter': fleet[-1], 'temperature': 0.5, 'seed': 2}]
+    warm += [{'adapter': a} for a in fleet[1:-1]]
+    for kw in warm:
+        list(sess.generate([1, 2, 3], max_new_tokens=4, **kw))
+    tc0 = dict(sess.frozen.trace_counts)
+    records, unresolved = _run_window(
+        rig, qps, duration_s, {'generate': 1.0}, seed, timeout_s,
+        adapter_ids=fleet)
+    _settle(rig)
+    retraced = {k: v for k, v in sess.frozen.trace_counts.items()
+                if tc0.get(k) != v}
+    server = rig.server_stats()
+    m = summarize(records)
+    m['unresolved'] = max(m['unresolved'], unresolved)
+    gen = m.get('generate') or {}
+    ttft_p99 = (gen.get('ttft') or {}).get('p99_ms')
+    sgen = server.get('generate') or {}
+    pool = sgen.get('adapters') or {}
+    sampled = sgen.get('sampled_tokens', 0)
+    verdicts = {
+        'zero_retraces_after_warmup': not retraced,
+        'fleet_resident': pool.get('resident', 0) >= len(fleet),
+        'sampled_tokens_observed': sampled > 0,
+        'adapter_ttft_within_budget': ttft_p99 is not None
+        and ttft_p99 <= ttft_p99_budget_s * 1e3,
+        'zero_unresolved': m['unresolved'] == 0,
+    }
+    m['retraced_programs'] = retraced
+    return build_artifact(
+        'adapters',
+        {'qps': qps, 'duration_s': duration_s, 'seed': seed,
+         'adapter_fleet': len(fleet),
+         'adapter_ttft_p99_budget_ms': ttft_p99_budget_s * 1e3},
         m, server=server, verdicts=verdicts)
 
 

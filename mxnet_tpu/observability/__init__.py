@@ -287,6 +287,26 @@ def serving_instruments():
                 'mxnet_tpu_serve_drain_seconds',
                 help='graceful drain wall time: begin_drain to all '
                      'sequences exported and handed off'),
+            # multi-adapter (LoRA) serving + sampled decoding
+            # (serving/adapters/, docs/SERVING.md "Multi-adapter
+            # serving & sampling")
+            adapter_loads=counter(
+                'mxnet_tpu_serve_adapter_loads_total',
+                help='adapter uploads into the device-resident pool '
+                     '(a warm re-acquire is a refcount bump, not a '
+                     'load)'),
+            adapter_evictions=counter(
+                'mxnet_tpu_serve_adapter_evictions_total',
+                help='LRU evictions of unpinned adapter pool rows to '
+                     'make room for a cold load'),
+            active_adapters=gauge(
+                'mxnet_tpu_serve_active_adapters',
+                help='adapters resident in the device pool (excl. '
+                     'the reserved base row)'),
+            sampled_tokens=counter(
+                'mxnet_tpu_serve_sampled_tokens_total',
+                help='tokens emitted under temperature>0 sampling '
+                     '(greedy traffic is tokens_total minus this)'),
         )
     return _serving_inst
 

@@ -1,0 +1,518 @@
+"""Multi-adapter (LoRA) serving: thousands of fine-tuned variants
+from ONE frozen base, inside the one compiled step.
+
+A fleet rarely serves one model: it serves one base plus a long tail
+of low-rank fine-tunes (per-tenant, per-task, per-locale). Freezing
+an artifact per variant multiplies HBM and cold-start by the variant
+count; swapping weights between requests serializes the batch. This
+subsystem keeps the base frozen and makes the VARIANT a per-slot
+``int32`` array argument:
+
+  * :func:`save_adapter` / :func:`load_adapter` — the
+    ``mxnet_tpu.adapter.v1`` artifact: per-layer low-rank A/B deltas
+    (+ scalar scale), blake2b-digested so a corrupt or truncated
+    download is a typed load error, never silent wrong weights;
+  * :class:`AdapterSpec` — the pool geometry a decode program
+    compiles against: which projections may carry a delta, at what
+    rank, with how many resident adapters;
+  * :class:`AdapterPool` — the refcounted device-resident pool: per
+    target one ``(capacity, r, in)`` A stack and one
+    ``(capacity, out, r)`` B stack beside the KV page pool. Index 0
+    is the reserved all-zero BASE entry (``x@0@0`` is additive 0.0 —
+    bitwise identity, the same argument the padding and trash-page
+    proofs make), loads deduplicate by digest and refcount, LRU
+    evicts idle entries under pressure, and exhaustion raises the
+    typed :class:`AdapterExhaustedError` (a
+    :class:`~..batcher.BackpressureError`) — admission control, not
+    a stall;
+  * :class:`AdapterRegistry` — per-request adapter *ids* resolved to
+    pool indices (optionally lazily from a directory of artifacts),
+    so the engine's step just gathers ``A[idx], B[idx]`` per slot.
+
+Because the pool rides the compiled step as plain array arguments,
+loading, evicting, and switching adapters never retraces:
+``trace_counts`` proves it, exactly as for KV page churn.
+
+Importable with numpy + stdlib only (jax loads lazily at first device
+use) — the paged.py/seqstate.py discipline. Selftest:
+``python -m mxnet_tpu.serving.adapters`` (a ci.py stage).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+import numpy as onp
+
+from ..batcher import BackpressureError
+
+__all__ = ['ADAPTER_SCHEMA', 'Adapter', 'AdapterSpec', 'AdapterPool',
+           'AdapterRegistry', 'AdapterExhaustedError', 'init_adapter',
+           'save_adapter', 'load_adapter']
+
+ADAPTER_SCHEMA = 'mxnet_tpu.adapter.v1'
+
+
+class AdapterExhaustedError(BackpressureError):
+    """Typed adapter-pool exhaustion: every resident entry is pinned
+    by an in-flight sequence and nothing is LRU-evictable. The same
+    shed-or-retry contract as a full queue / exhausted page pool."""
+
+    def __init__(self, resident, capacity):
+        # carry (depth, limit) so gateway/server 429 mapping treats it
+        # like every other backpressure signal
+        RuntimeError.__init__(
+            self, 'adapter pool exhausted (%d/%d entries pinned); '
+            'shed load or retry with backoff' % (resident, capacity))
+        self.depth = resident
+        self.limit = capacity
+
+
+# ---------------------------------------------------------------------------
+# mxnet_tpu.adapter.v1 artifact
+# ---------------------------------------------------------------------------
+
+
+def _digest(manifest, arrays):
+    """blake2b-16 over the canonical manifest (minus the digest
+    itself) and every array's bytes in sorted name order."""
+    core = {k: v for k, v in manifest.items() if k != 'digest'}
+    h = hashlib.blake2b(digest_size=16)
+    h.update(json.dumps(core, sort_keys=True,
+                        separators=(',', ':')).encode())
+    for name in sorted(arrays):
+        arr = onp.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+class Adapter:
+    """One loaded ``mxnet_tpu.adapter.v1``: ``arrays`` maps
+    ``l{i}_{target}_a`` -> (r, in) and ``l{i}_{target}_b`` ->
+    (out, r) float32; ``scale`` multiplies the delta (folded into B
+    at pool-load time, so the compiled step never sees it)."""
+
+    __slots__ = ('name', 'rank', 'scale', 'arrays', 'digest')
+
+    def __init__(self, name, rank, scale, arrays, digest):
+        self.name = str(name)
+        self.rank = int(rank)
+        self.scale = float(scale)
+        self.arrays = dict(arrays)
+        self.digest = str(digest)
+
+    def targets(self):
+        """{'l0_qkv': (out, in), ...} recovered from the arrays."""
+        out = {}
+        for key, arr in self.arrays.items():
+            if key.endswith('_b'):
+                out[key[:-2]] = (int(arr.shape[0]),
+                                 int(self.arrays[key[:-1] + 'a']
+                                     .shape[1]))
+        return out
+
+    def __repr__(self):
+        return 'Adapter(%r, rank=%d, digest=%s)' % (self.name,
+                                                    self.rank,
+                                                    self.digest[:8])
+
+
+def init_adapter(model, rank, seed=0, scale=1.0, name=None,
+                 targets=None):
+    """Deterministic random adapter for ``model`` (tests / bench /
+    loadgen): both A and B are drawn nonzero so the delta actually
+    moves logits — a trained adapter would arrive through the same
+    arrays. Returns an :class:`Adapter` (unsaved)."""
+    per_layer = model.lora_targets()
+    if targets is not None:
+        per_layer = {t: per_layer[t] for t in targets}
+    rs = onp.random.RandomState(seed)
+    arrays = {}
+    for i in range(model.layers):
+        for t, (out, inp) in per_layer.items():
+            arrays['l%d_%s_a' % (i, t)] = \
+                (rs.randn(rank, inp) * 0.05).astype('float32')
+            arrays['l%d_%s_b' % (i, t)] = \
+                (rs.randn(out, rank) * 0.05).astype('float32')
+    name = name or 'adapter-seed%d' % seed
+    manifest = {'schema': ADAPTER_SCHEMA, 'name': name,
+                'family': model.family, 'rank': int(rank),
+                'scale': float(scale)}
+    return Adapter(name, rank, scale, arrays,
+                   _digest(manifest, arrays))
+
+
+def save_adapter(path, adapter, family='transformer_lm'):
+    """Write the artifact directory::
+
+        <path>/MANIFEST.json   schema + name + rank + scale + digest
+        <path>/params.npz      l{i}_{target}_{a,b} float32 arrays
+    """
+    from ...resilience.checkpoint import atomic_write_bytes
+    os.makedirs(path, exist_ok=True)
+    manifest = {'schema': ADAPTER_SCHEMA, 'name': adapter.name,
+                'family': family, 'rank': adapter.rank,
+                'scale': adapter.scale}
+    manifest['digest'] = _digest(manifest, adapter.arrays)
+    import io as _io
+    buf = _io.BytesIO()
+    onp.savez(buf, **adapter.arrays)
+    atomic_write_bytes(os.path.join(path, 'params.npz'),
+                       buf.getvalue())
+    atomic_write_bytes(
+        os.path.join(path, 'MANIFEST.json'),
+        (json.dumps(manifest, indent=1, sort_keys=True)
+         + '\n').encode())
+    return path
+
+
+def load_adapter(path):
+    """Reload + digest-verify an artifact directory: a byte flipped
+    anywhere in manifest or arrays is a ``ValueError``, not a model
+    that quietly serves someone else's fine-tune."""
+    with open(os.path.join(path, 'MANIFEST.json')) as f:
+        manifest = json.load(f)
+    if manifest.get('schema') != ADAPTER_SCHEMA:
+        raise ValueError('not a %s artifact: %r at %s'
+                         % (ADAPTER_SCHEMA, manifest.get('schema'),
+                            path))
+    arrays = {}
+    with onp.load(os.path.join(path, 'params.npz')) as z:
+        for key in z.files:
+            arrays[key] = z[key]
+    want = manifest.get('digest')
+    got = _digest(manifest, arrays)
+    if want != got:
+        raise ValueError('adapter digest mismatch at %s: manifest %s '
+                         '!= computed %s (corrupt or tampered '
+                         'artifact)' % (path, want, got))
+    return Adapter(manifest.get('name', 'adapter'), manifest['rank'],
+                   manifest.get('scale', 1.0), arrays, got)
+
+
+# ---------------------------------------------------------------------------
+# pool geometry
+# ---------------------------------------------------------------------------
+
+
+class AdapterSpec:
+    """What the compiled step is sized for: ``targets`` maps
+    ``l{i}_{target}`` -> (out, in); every resident adapter occupies
+    one row of each target's ``(capacity, r, in)`` / ``(capacity,
+    out, r)`` stack. Artifacts of LOWER rank zero-pad up — rank is a
+    compile-time ceiling, not an exact match requirement."""
+
+    def __init__(self, targets, rank, capacity):
+        if capacity < 2:
+            raise ValueError('adapter capacity %d < 2 (index 0 is the '
+                             'reserved base entry)' % capacity)
+        self.targets = {str(k): (int(o), int(i))
+                        for k, (o, i) in dict(targets).items()}
+        self.rank = int(rank)
+        self.capacity = int(capacity)
+
+    @classmethod
+    def for_model(cls, model, rank, capacity):
+        per = model.lora_targets()
+        targets = {'l%d_%s' % (i, t): dims
+                   for i in range(model.layers)
+                   for t, dims in per.items()}
+        return cls(targets, rank, capacity)
+
+    def zero_tree(self):
+        """Host-side all-zero pool arrays (the initial device
+        contents; row 0 stays zero forever — the base)."""
+        P, r = self.capacity, self.rank
+        return {k: (onp.zeros((P, r, i), 'float32'),
+                    onp.zeros((P, o, r), 'float32'))
+                for k, (o, i) in self.targets.items()}
+
+    def avals(self):
+        import jax
+        P, r = self.capacity, self.rank
+        return {k: (jax.ShapeDtypeStruct((P, r, i), 'float32'),
+                    jax.ShapeDtypeStruct((P, o, r), 'float32'))
+                for k, (o, i) in self.targets.items()}
+
+    def pool_bytes(self):
+        P, r = self.capacity, self.rank
+        return sum(4 * P * r * (o + i)
+                   for o, i in self.targets.values())
+
+    def to_manifest(self):
+        return {'targets': {k: list(v)
+                            for k, v in self.targets.items()},
+                'rank': self.rank, 'capacity': self.capacity}
+
+    @classmethod
+    def from_manifest(cls, doc):
+        return cls({k: tuple(v) for k, v in doc['targets'].items()},
+                   doc['rank'], doc['capacity'])
+
+
+# ---------------------------------------------------------------------------
+# device-resident refcounted pool
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ('digest', 'name', 'refs', 'last_used')
+
+    def __init__(self, digest, name):
+        self.digest = digest
+        self.name = name
+        self.refs = 0
+        self.last_used = 0
+
+
+class AdapterPool:
+    """Refcounted device-resident adapter slots.
+
+    ``load`` deduplicates by digest (a second tenant of the same
+    fine-tune shares the row), claims a free row, or LRU-evicts an
+    unpinned one; when every row is pinned it raises
+    :class:`AdapterExhaustedError` — the admission layer's shed
+    signal. ``device_tree()`` is what the engine passes to the
+    compiled step each tick; updating a row is an eager ``.at[].set``
+    on the stacks (array values, never shapes), so pool churn shares
+    the zero-retrace property of KV page churn.
+    """
+
+    def __init__(self, spec):
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._entries = [None] * spec.capacity
+        base = _Entry(None, 'base')
+        base.refs = 1                      # never evictable
+        self._entries[0] = base
+        self._by_digest = {}               # digest -> index
+        self._tick = 0
+        self._device = None                # lazy {key: (A, B)}
+        self._loads = 0
+        self._evictions = 0
+
+    # -- device state -------------------------------------------------------
+
+    def _ensure_device_locked(self):
+        if self._device is None:
+            import jax.numpy as jnp
+            self._device = {k: (jnp.asarray(a), jnp.asarray(b))
+                            for k, (a, b) in
+                            self.spec.zero_tree().items()}
+        return self._device
+
+    def device_tree(self):
+        """The pool pytree the compiled step consumes this tick."""
+        with self._lock:
+            return dict(self._ensure_device_locked())
+
+    # -- load / release -----------------------------------------------------
+
+    def _padded(self, adapter):
+        """Host arrays padded to spec rank, scale folded into B —
+        prepared OUTSIDE the lock (pure numpy)."""
+        if adapter.rank > self.spec.rank:
+            raise ValueError('adapter %r rank %d exceeds pool rank %d'
+                             % (adapter.name, adapter.rank,
+                                self.spec.rank))
+        out = {}
+        for key, (o, i) in self.spec.targets.items():
+            a = adapter.arrays.get(key + '_a')
+            b = adapter.arrays.get(key + '_b')
+            if a is None or b is None:
+                # target not delta'd by this adapter: zero rows keep
+                # the projection at the frozen base
+                a = onp.zeros((self.spec.rank, i), 'float32')
+                b = onp.zeros((o, self.spec.rank), 'float32')
+            else:
+                pad = self.spec.rank - a.shape[0]
+                a = onp.pad(onp.asarray(a, 'float32'),
+                            ((0, pad), (0, 0)))
+                b = onp.pad(onp.asarray(b, 'float32') * adapter.scale,
+                            ((0, 0), (0, pad)))
+            out[key] = (a, b)
+        return out
+
+    def load(self, adapter):
+        """Make ``adapter`` device-resident; returns its pool index
+        with one reference taken."""
+        padded = self._padded(adapter)
+        with self._lock:
+            self._tick += 1
+            idx = self._by_digest.get(adapter.digest)
+            if idx is not None:
+                ent = self._entries[idx]
+                ent.refs += 1
+                ent.last_used = self._tick
+                return idx
+            ev0 = self._evictions
+            idx = self._claim_row_locked()
+            dev = self._ensure_device_locked()
+            for key, (a, b) in padded.items():
+                da, db = dev[key]
+                dev[key] = (da.at[idx].set(a), db.at[idx].set(b))
+            ent = _Entry(adapter.digest, adapter.name)
+            ent.refs = 1
+            ent.last_used = self._tick
+            self._entries[idx] = ent
+            self._by_digest[adapter.digest] = idx
+            self._loads += 1
+            evicted = self._evictions - ev0
+            resident = sum(1 for e in self._entries[1:]
+                           if e is not None)
+        if evicted:
+            self._emit('adapter_evict', index=idx)
+        self._emit('adapter_load', adapter=adapter.name, index=idx,
+                   resident=resident)
+        return idx
+
+    def _claim_row_locked(self):
+        for i in range(1, self.spec.capacity):
+            if self._entries[i] is None:
+                return i
+        victim, oldest = None, None
+        for i in range(1, self.spec.capacity):
+            ent = self._entries[i]
+            if ent.refs == 0 and (oldest is None
+                                  or ent.last_used < oldest):
+                victim, oldest = i, ent.last_used
+        if victim is None:
+            pinned = sum(1 for e in self._entries if e is not None
+                         and e.refs > 0)
+            raise AdapterExhaustedError(pinned, self.spec.capacity)
+        old = self._entries[victim]
+        del self._by_digest[old.digest]
+        self._entries[victim] = None
+        self._evictions += 1
+        # the evicted row's stale A/B stay on device but no live
+        # sequence indexes them — same argument as freed KV pages
+        return victim
+
+    def acquire(self, index):
+        """Take one more reference on a resident row (seqstate
+        import / request admission against a known index)."""
+        with self._lock:
+            self._tick += 1
+            ent = self._entries[index]
+            if ent is None:
+                raise KeyError('adapter pool row %d is empty' % index)
+            ent.refs += 1
+            ent.last_used = self._tick
+        return index
+
+    def release(self, index):
+        """Drop one reference; row stays resident (warm) until LRU
+        eviction needs it."""
+        if index == 0:
+            return
+        with self._lock:
+            ent = self._entries[index]
+            if ent is not None and ent.refs > 0:
+                ent.refs -= 1
+
+    def index_of(self, digest):
+        with self._lock:
+            return self._by_digest.get(digest)
+
+    def stats(self):
+        with self._lock:
+            resident = sum(1 for e in self._entries[1:]
+                           if e is not None)
+            pinned = sum(1 for e in self._entries[1:]
+                         if e is not None and e.refs > 0)
+            return {'capacity': self.spec.capacity,
+                    'resident': resident, 'pinned': pinned,
+                    'loads': self._loads,
+                    'evictions': self._evictions,
+                    'pool_bytes': self.spec.pool_bytes()}
+
+    @staticmethod
+    def _emit(event, **fields):
+        try:
+            from ... import observability as _obs
+            if _obs.enabled():
+                inst = _obs.serving_instruments()
+                if event == 'adapter_load':
+                    inst.adapter_loads.inc()
+                    inst.active_adapters.set(
+                        float(fields.get('resident', 0)))
+                elif event == 'adapter_evict':
+                    inst.adapter_evictions.inc()
+                _obs.record_event(event, **fields)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# id -> pool-index registry
+# ---------------------------------------------------------------------------
+
+
+class AdapterRegistry:
+    """Maps per-request adapter *ids* to pool indices.
+
+    Ids resolve through (in order): explicit :meth:`register` entries,
+    then ``<root>/<id>`` artifact directories loaded lazily on first
+    use (``MXNET_TPU_SERVE_ADAPTER_DIR``). The empty id / ``None`` /
+    ``'base'`` is pool index 0 — the frozen base, never refcounted.
+    """
+
+    BASE_IDS = (None, '', 'base')
+
+    def __init__(self, pool, root=None):
+        self.pool = pool
+        self.root = root
+        self._lock = threading.Lock()
+        self._known = {}                 # id -> Adapter
+
+    def register(self, adapter_id, adapter):
+        with self._lock:
+            self._known[str(adapter_id)] = adapter
+
+    def ids(self):
+        with self._lock:
+            return sorted(self._known)
+
+    def _resolve(self, adapter_id):
+        with self._lock:
+            ad = self._known.get(adapter_id)
+        if ad is not None:
+            return ad
+        if self.root:
+            path = os.path.join(self.root, adapter_id)
+            if os.path.isdir(path):
+                ad = load_adapter(path)
+                with self._lock:
+                    self._known.setdefault(adapter_id, ad)
+                return ad
+        raise KeyError('unknown adapter id %r (registered: %s%s)'
+                       % (adapter_id, self.ids(),
+                          '; root=%s' % self.root if self.root
+                          else ''))
+
+    def acquire(self, adapter_id):
+        """Admission: id -> referenced pool index (0 for the base).
+        ``pool.load`` deduplicates by digest under its own lock, so a
+        warm adapter is a refcount bump, not a re-upload. Raises
+        :class:`KeyError` for unknown ids and
+        :class:`AdapterExhaustedError` when nothing is evictable."""
+        if adapter_id in self.BASE_IDS:
+            return 0
+        return self.pool.load(self._resolve(str(adapter_id)))
+
+    def release(self, index):
+        self.pool.release(index)
+
+    def host_tree(self, adapter_id):
+        """Host-side ``{target: (A, B)}`` delta (padded to pool rank,
+        scale folded) for the eager CPU fallback path — ``None`` for
+        base traffic, so the fallback stays byte-identical to the
+        pre-adapter program there."""
+        if adapter_id in self.BASE_IDS:
+            return None
+        return self.pool._padded(self._resolve(str(adapter_id)))

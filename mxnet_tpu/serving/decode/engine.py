@@ -64,6 +64,7 @@ import numpy as onp
 
 from ..batcher import BackpressureError, BatcherClosed, RequestTimeout
 from .paged import TRASH_PAGE, PageAllocator, PrefixCache, pages_for
+from .sampling import key_for
 from .seqstate import SeqStateError, build_payload, decode_payload
 
 __all__ = ['GenerateStream', 'DecodeEngine', 'DrainTimeout']
@@ -189,10 +190,12 @@ class _Seq:
     __slots__ = ('stream', 'prompt', 'max_new', 'eos_id', 'slot',
                  'pos', 'last_token', 'enqueued_at', 'deadline_at',
                  'first_token_at', 'table', 'pages', 'prefill_only',
-                 'trace')
+                 'trace', 'adapter_id', 'adapter_idx', 'temperature',
+                 'top_p', 'seed')
 
     def __init__(self, stream, prompt, max_new, eos_id, enqueued_at,
-                 deadline_at, prefill_only=False):
+                 deadline_at, prefill_only=False, adapter_id=None,
+                 temperature=0.0, top_p=1.0, seed=0):
         self.stream = stream
         self.prompt = prompt
         self.max_new = max_new
@@ -211,6 +214,16 @@ class _Seq:
         # disaggregated serving: export the seqstate at the prefill
         # boundary instead of entering the step loop
         self.prefill_only = prefill_only
+        # multi-adapter + sampling: the LoRA variant this sequence
+        # decodes under (id -> refcounted pool index at admission) and
+        # its sampling law (temperature 0 = greedy; keys derive from
+        # (seed, absolute position), so continuations stay
+        # bit-identical)
+        self.adapter_id = adapter_id
+        self.adapter_idx = 0
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
         # request tracing: {'ctx': TraceContext, 'enq': wall seconds,
         # 'last': wall phase boundary, 'first_w': wall first-token,
         # 'tok0': tokens already present at attach} — None unless the
@@ -266,7 +279,8 @@ class DecodeEngine:
     def __init__(self, program, max_queue=256, timeout_s=30.0,
                  max_new_tokens=64, breaker=None, watchdog=None,
                  prefill_interleave=1, name='decode',
-                 clock=time.monotonic, draft=None, prefix_cache=None):
+                 clock=time.monotonic, draft=None, prefix_cache=None,
+                 adapters=None):
         from ...resilience.policy import CircuitBreaker
         self.program = program
         self.slots = int(program.slots)
@@ -308,7 +322,8 @@ class DecodeEngine:
                         'pool_exhausted': 0, 'page_evictions': 0,
                         'migrated_out': 0, 'migrated_in': 0,
                         'prefill_exports': 0,
-                        'handoff_pages': 0, 'drain_timeouts': 0}
+                        'handoff_pages': 0, 'drain_timeouts': 0,
+                        'sampled_tokens': 0, 'adapter_rejects': 0}
         # live-migration requests serviced by the worker at tick
         # boundaries (the only thread that owns the device cache):
         # (op, arg, result_box, done_event)
@@ -354,6 +369,43 @@ class DecodeEngine:
                     'use a transformer draft)' % (dm.family,))
             self._draft = draft
             self.spec_k = spec_k
+        # multi-adapter serving: the id -> pool-index registry. The
+        # program must have been frozen with an adapter_spec (the pool
+        # argument is part of its compiled signature); ``adapters``
+        # may be a prebuilt AdapterRegistry or an artifact-directory
+        # root (default: MXNET_TPU_SERVE_ADAPTER_DIR)
+        self._adapters = None
+        aspec = getattr(program, 'adapter_spec', None)
+        if aspec is not None:
+            from ..adapters import AdapterPool, AdapterRegistry
+            if adapters is None:
+                adapters = _knob('MXNET_TPU_SERVE_ADAPTER_DIR', None)
+            if isinstance(adapters, AdapterRegistry):
+                ps = adapters.pool.spec
+                if (ps.capacity != aspec.capacity
+                        or ps.rank != aspec.rank
+                        or ps.targets != aspec.targets):
+                    raise ValueError(
+                        'adapter registry pool (rank=%d capacity=%d) '
+                        'does not match the program\'s compiled '
+                        'adapter_spec (rank=%d capacity=%d) — the '
+                        'pool shape is part of the one compiled '
+                        'step\'s signature'
+                        % (ps.rank, ps.capacity, aspec.rank,
+                           aspec.capacity))
+                self._adapters = adapters
+            else:
+                self._adapters = AdapterRegistry(AdapterPool(aspec),
+                                                 root=adapters or None)
+        elif adapters is not None:
+            raise ValueError(
+                'adapters given but the program was frozen without an '
+                'adapter_spec (freeze with adapter_rank > 0)')
+        self.sample_args = bool(getattr(program, 'sample_args',
+                                        False))
+        # whether the compiled programs carry the extras argument at
+        # all (per-slot array build is skipped entirely when not)
+        self._extras_on = self.sample_args or aspec is not None
         self._worker = threading.Thread(
             target=self._run, daemon=True,
             name='mxnet-tpu-%s-decode' % name)
@@ -391,8 +443,17 @@ class DecodeEngine:
     # -- submission --------------------------------------------------------
 
     def generate(self, tokens, max_new_tokens=None, eos_id=None,
-                 request_id=None, prefill_only=False, trace=None):
+                 request_id=None, prefill_only=False, trace=None,
+                 adapter=None, temperature=0.0, top_p=1.0, seed=0):
         """Admit one prompt; returns its :class:`GenerateStream`.
+
+        ``adapter`` selects the LoRA variant (an id the engine's
+        adapter registry resolves; ``None``/``''``/``'base'`` is the
+        frozen base). ``temperature``/``top_p``/``seed`` select the
+        sampling law — 0.0 temperature is greedy, byte-identical to
+        pre-sampling engines. Both are per-request ARRAY arguments of
+        the one compiled step: mixing greedy/sampled/multi-adapter
+        traffic in one batch costs zero retraces.
 
         ``request_id`` makes admission idempotent: a second admission
         under the same id (the gateway re-admitting a stream after a
@@ -427,11 +488,32 @@ class DecodeEngine:
                       else self.default_max_new)
         if max_new < 1:
             raise ValueError('max_new_tokens must be >= 1')
+        temperature = float(temperature)
+        top_p = float(top_p)
+        if temperature < 0:
+            raise ValueError('temperature must be >= 0')
+        if not 0 < top_p <= 1:
+            raise ValueError('top_p must be in (0, 1]')
+        if temperature > 0 and not self.sample_args:
+            raise ValueError(
+                'sampled decoding requested (temperature=%g) but the '
+                'program was frozen without sampling args (freeze '
+                'with sample_args=True)' % temperature)
+        from ..adapters import AdapterRegistry as _AR
+        if adapter not in _AR.BASE_IDS and self._adapters is None:
+            raise ValueError(
+                'adapter %r requested but this engine serves no '
+                'adapters (freeze with adapter_rank > 0 and point '
+                'MXNET_TPU_SERVE_ADAPTER_DIR at the artifacts)'
+                % (adapter,))
         now = self._clock()
         stream = GenerateStream(len(prompt))
         seq = _Seq(stream, prompt, max_new, eos_id, now,
                    now + self.timeout_s if self.timeout_s else None,
-                   prefill_only=bool(prefill_only))
+                   prefill_only=bool(prefill_only),
+                   adapter_id=(None if adapter in _AR.BASE_IDS
+                               else str(adapter)),
+                   temperature=temperature, top_p=top_p, seed=seed)
         if trace is not None:
             w = time.time()
             seq.trace = {'ctx': trace, 'enq': w, 'last': w,
@@ -599,6 +681,8 @@ class DecodeEngine:
                     for p in seq.pages:
                         self._allocator.release(p)
                     seq.pages = []
+        # adapter pool unpin outside the lock (the pool has its own)
+        self._release_adapter(seq)
         _record_event('decode_retire', slot=slot, reason=reason,
                       tokens=len(seq.stream.tokens))
         tr = seq.trace
@@ -730,16 +814,16 @@ class DecodeEngine:
             self._op_seq += 1
         return seq
 
-    def _execute(self, fn, step, *args):
+    def _execute(self, fn, step, *args, **kwargs):
         from ...resilience.policy import inject
         inject('serving.decode',
                ('device_loss', 'device_unavailable', 'tunnel_stall',
                 'worker_crash', 'preempt'), step=step)
         if self._watchdog is not None:
             self._watchdog.check()
-        return fn(*args)
+        return fn(*args, **kwargs)
 
-    def _device(self, fn, *args):
+    def _device(self, fn, *args, **kwargs):
         """Run one device call under the breaker; a transient failure
         or an open breaker raises :class:`_DegradedPath` after
         recording the trip (server.py's _serve contract). A worker
@@ -755,7 +839,8 @@ class DecodeEngine:
             self._watchdog.beat(step=step, phase='decode')
         was_open = self._breaker.state == 'open'
         try:
-            out = self._breaker.call(self._execute, fn, step, *args)
+            out = self._breaker.call(self._execute, fn, step, *args,
+                                     **kwargs)
         except (WorkerCrashError, PreemptionSignal) as exc:
             # the breaker already counted the failure (breaker.call)
             self._note_failure(exc, step, was_open)
@@ -835,6 +920,115 @@ class DecodeEngine:
             logging.exception('decode %s: prefill-boundary export '
                               'failed', self.name)
 
+    # -- sampling / adapter array args of the compiled step ----------------
+
+    def _acquire_adapter(self, seq):
+        """Resolve + pin the sequence's adapter pool row (worker
+        thread — a cold load uploads the padded A/B stacks once; a
+        warm one is a refcount bump). No-op for base traffic."""
+        if seq.adapter_id is None or self._adapters is None:
+            seq.adapter_idx = 0
+            return
+        seq.adapter_idx = self._adapters.acquire(seq.adapter_id)
+
+    def _release_adapter(self, seq):
+        if self._adapters is not None and seq.adapter_idx:
+            self._adapters.release(seq.adapter_idx)
+            seq.adapter_idx = 0
+
+    def _admit_adapter(self, seq, slot):
+        """Pin the adapter row at admission. On failure — unknown id,
+        or :class:`~..adapters.AdapterExhaustedError` with every row
+        pinned — THIS request fails typed (shed/retry contract) and
+        the slot frees. Returns False when admission must stop."""
+        try:
+            self._acquire_adapter(seq)
+            return True
+        except Exception as exc:
+            with self._lock:
+                self._free.append(slot)
+                self._counts['adapter_rejects'] += 1
+            seq.stream._finish('error', exc)
+            inst = _serving_instruments()
+            if inst is not None:
+                inst.rejected.labels(reason='adapter_pool').inc()
+            _record_event('adapter_reject', adapter=seq.adapter_id,
+                          error=str(exc))
+            return False
+
+    def _prefill_extras(self, seq):
+        """Sampling/adapter kwargs for one ``run_prefill`` — {} when
+        the program compiled without the extras argument (the kwargs
+        would be ignored, but skip even building them)."""
+        if not self._extras_on:
+            return {}
+        kw = {}
+        if self.sample_args and seq.temperature > 0:
+            # the prefill's emitted token is the logits row at
+            # absolute position len(prompt) - 1
+            kw['temps'] = onp.asarray([seq.temperature], 'float32')
+            kw['top_ps'] = onp.asarray([seq.top_p], 'float32')
+            kw['keys'] = key_for(seq.seed, seq.prompt_len - 1)[None]
+        if self._adapters is not None:
+            kw['apool'] = self._adapters.pool.device_tree()
+            kw['aidx'] = seq.adapter_idx
+        return kw
+
+    def _step_extras(self, active, spec_c=0):
+        """Per-slot sampling/adapter arrays for one step call (or one
+        verify call: ``spec_c`` keys per slot at absolute positions
+        ``pos .. pos + spec_c - 1``, exactly the keys the plain path
+        would burn at those positions). {} when the program compiled
+        without the extras argument."""
+        if not self._extras_on:
+            return {}
+        kw = {}
+        if self.sample_args:
+            temps = onp.zeros(self.slots, 'float32')
+            top_ps = onp.ones(self.slots, 'float32')
+            shape = (self.slots, spec_c, 2) if spec_c \
+                else (self.slots, 2)
+            keys = onp.zeros(shape, 'uint32')
+            for slot, seq in active.items():
+                if seq.temperature <= 0:
+                    continue
+                temps[slot] = seq.temperature
+                top_ps[slot] = seq.top_p
+                if spec_c:
+                    for c in range(spec_c):
+                        keys[slot, c] = key_for(seq.seed, seq.pos + c)
+                else:
+                    keys[slot] = key_for(seq.seed, seq.pos)
+            kw['temps'] = temps
+            kw['top_ps'] = top_ps
+            kw['keys'] = keys
+        if self._adapters is not None:
+            aidx = onp.zeros(self.slots, 'int32')
+            for slot, seq in active.items():
+                aidx[slot] = seq.adapter_idx
+            kw['apool'] = self._adapters.pool.device_tree()
+            kw['aidx'] = aidx
+        return kw
+
+    def _draft_step_extras(self, active, off):
+        """Coupled (shared-noise) draft proposals: the draft samples
+        its proposal for absolute position ``pos + off`` with the SAME
+        key the verify pass burns there, so under agreement the draft
+        proposes exactly the token the target would sample — the
+        greedy longest-prefix acceptance walk then preserves the
+        1 + k*r win for sampled traffic without biasing the output
+        (every emitted token is the target's own draw either way)."""
+        temps = onp.zeros(self.slots, 'float32')
+        top_ps = onp.ones(self.slots, 'float32')
+        keys = onp.zeros((self.slots, 2), 'uint32')
+        for slot, seq in active.items():
+            if seq.temperature <= 0:
+                continue
+            temps[slot] = seq.temperature
+            top_ps[slot] = seq.top_p
+            keys[slot] = key_for(seq.seed, seq.pos + off)
+        return {'temps': temps, 'top_ps': top_ps, 'keys': keys}
+
     def _admit(self, seq, slot):
         """Prefill one pending request into ``slot`` (join)."""
         if seq.stream.done() or seq.stream._cancelled:
@@ -848,13 +1042,17 @@ class DecodeEngine:
             w0 = time.time()
             self._trace_span(seq, 'eng.queue_wait', tr['enq'], w0)
             tr['last'] = w0
+        if not self._admit_adapter(seq, slot):
+            return
         try:
             if self._cache is None:
                 self._cache = self.program.new_cache()
             self._cache, tok, _logits = self._device(
                 self.program.run_prefill, self._cache,
-                onp.asarray(seq.prompt, 'int32'), slot)
+                onp.asarray(seq.prompt, 'int32'), slot,
+                **self._prefill_extras(seq))
         except _DegradedPath:
+            self._release_adapter(seq)
             with self._lock:
                 self._free.append(slot)
             self._spawn_fallback([seq])
@@ -862,6 +1060,7 @@ class DecodeEngine:
         except _AbortPath as ab:
             # worker crash / preemption at prefill: fail THIS request
             # with the typed error (client retries), free the slot
+            self._release_adapter(seq)
             with self._lock:
                 self._free.append(slot)
             seq.stream._finish('error', ab.exc)
@@ -870,6 +1069,7 @@ class DecodeEngine:
             # bug-shaped (non-transient) failure: fail THIS request
             # loudly with the typed error, but never leak its slot or
             # leave its stream blocking forever
+            self._release_adapter(seq)
             with self._lock:
                 self._free.append(slot)
             seq.stream._finish('error', exc)
@@ -879,6 +1079,8 @@ class DecodeEngine:
         with self._lock:
             self._counts['prefills'] += 1
             self._counts['tokens'] += 1
+            if seq.temperature > 0:
+                self._counts['sampled_tokens'] += 1
         seq.slot = slot
         seq.pos = len(seq.prompt)
         seq.last_token = int(tok)
@@ -888,6 +1090,8 @@ class DecodeEngine:
         if inst is not None:
             inst.prefills.inc()
             inst.tokens.inc()
+            if seq.temperature > 0:
+                inst.sampled_tokens.inc()
             inst.ttft.observe(max(0.0, now - seq.enqueued_at))
         if tr is not None:
             w1 = time.time()
@@ -927,14 +1131,20 @@ class DecodeEngine:
             w0 = time.time()
             self._trace_span(seq, 'eng.queue_wait', tr['enq'], w0)
             tr['last'] = w0
+        if not self._admit_adapter(seq, slot):
+            return
         prompt = seq.prompt
         n = len(prompt)
         seq.table = onp.full(self.program.max_pages, TRASH_PAGE,
                              'int32')
         shared, covered = [], 0
         if self._prefix is not None:
+            # namespaced by adapter id: an adapter's KV rows for the
+            # same tokens differ from the base's — a warm hit must
+            # never splice across variants
             with self._lock:
-                shared, covered = self._prefix.lookup(prompt)
+                shared, covered = self._prefix.lookup(
+                    prompt, namespace=seq.adapter_id)
             # always leave >= 1 suffix token to step on: its logits
             # are the first generated token
             covered = min(covered, n - 1)
@@ -980,6 +1190,7 @@ class DecodeEngine:
                                     slot)
             if ids is None:
                 self._fail_pool_exhausted(seq, slot, where='admit')
+                self._release_adapter(seq)
                 with self._lock:
                     self._free.append(slot)
                 return
@@ -988,27 +1199,32 @@ class DecodeEngine:
             seq.table[:len(ids)] = ids
             self._cache, tok, _logits = self._device(
                 self.program.run_prefill, self._cache,
-                onp.asarray(prompt, 'int32'), ids)
+                onp.asarray(prompt, 'int32'), ids,
+                **self._prefill_extras(seq))
             if self._draft is not None:
                 self._draft_cache, _dt, _dl = self._device(
                     self._draft.run_prefill, self._draft_cache,
                     onp.asarray(prompt, 'int32'), slot)
             if self._prefix is not None:
                 with self._lock:
-                    self._prefix.register(prompt, ids)
+                    self._prefix.register(prompt, ids,
+                                          namespace=seq.adapter_id)
         except _DegradedPath:
+            self._release_adapter(seq)
             self._release_seq_pages(seq)
             with self._lock:
                 self._free.append(slot)
             self._spawn_fallback([seq])
             return
         except _AbortPath as ab:
+            self._release_adapter(seq)
             self._release_seq_pages(seq)
             with self._lock:
                 self._free.append(slot)
             seq.stream._finish('error', ab.exc)
             return
         except Exception as exc:
+            self._release_adapter(seq)
             self._release_seq_pages(seq)
             with self._lock:
                 self._free.append(slot)
@@ -1019,6 +1235,8 @@ class DecodeEngine:
         with self._lock:
             self._counts['prefills'] += 1
             self._counts['tokens'] += 1
+            if seq.temperature > 0:
+                self._counts['sampled_tokens'] += 1
         seq.slot = slot
         seq.pos = n
         seq.last_token = int(tok)
@@ -1028,6 +1246,8 @@ class DecodeEngine:
         if inst is not None:
             inst.prefills.inc()
             inst.tokens.inc()
+            if seq.temperature > 0:
+                inst.sampled_tokens.inc()
             inst.ttft.observe(max(0.0, now - seq.enqueued_at))
         if tr is not None:
             w1 = time.time()
@@ -1084,7 +1304,8 @@ class DecodeEngine:
         t0 = self._clock()
         try:
             self._cache, toks, _logits = self._device(
-                self.program.run_step, self._cache, tokens, positions)
+                self.program.run_step, self._cache, tokens, positions,
+                **self._step_extras(active))
         except _DegradedPath:
             self._degrade_inflight(active)
             return
@@ -1121,6 +1342,7 @@ class DecodeEngine:
             inst.decode_steps.inc()
             inst.tokens.inc(len(active))
             inst.tpot.observe(dt)
+        sampled = 0
         for slot, seq in active.items():
             if seq.stream.done() or seq.stream._cancelled:
                 continue            # retired at the next tick
@@ -1128,10 +1350,17 @@ class DecodeEngine:
             seq.pos += 1
             seq.last_token = tok
             seq.stream._emit(tok)
+            if seq.temperature > 0:
+                sampled += 1
             reason = self._finished_reason(seq, tok)
             if reason is not None:
                 seq.stream._finish(reason)
                 self._retire(slot, seq, reason)
+        if sampled:
+            with self._lock:
+                self._counts['sampled_tokens'] += sampled
+            if inst is not None:
+                inst.sampled_tokens.inc(sampled)
 
     def _emit_token(self, seq, tok):
         """Stream one generated token (TTFT observed on the first —
@@ -1186,7 +1415,7 @@ class DecodeEngine:
                 tables[slot] = seq.table
             self._cache, toks, _logits = self._device(
                 self.program.run_step, self._cache, tokens, positions,
-                tables)
+                tables, **self._step_extras(active))
             if self._draft is not None:
                 # keep the draft's KV history in lockstep on
                 # non-speculative ticks (extension / near-max_len):
@@ -1214,6 +1443,7 @@ class DecodeEngine:
             return
         dt = self._clock() - t0
         emitted = 0
+        sampled = 0
         for slot, seq in active.items():
             if seq.stream.done() or seq.stream._cancelled:
                 continue            # retired at the next tick
@@ -1228,6 +1458,8 @@ class DecodeEngine:
             seq.last_token = tok
             self._emit_token(seq, tok)
             emitted += 1
+            if seq.temperature > 0:
+                sampled += 1
             reason = self._finished_reason(seq, tok)
             if reason is not None:
                 seq.stream._finish(reason)
@@ -1235,6 +1467,7 @@ class DecodeEngine:
         with self._lock:
             self._counts['steps'] += 1
             self._counts['tokens'] += emitted
+            self._counts['sampled_tokens'] += sampled
             self._ema_step_s = dt if self._ema_step_s is None \
                 else 0.7 * self._ema_step_s + 0.3 * dt
         inst = _serving_instruments()
@@ -1242,6 +1475,8 @@ class DecodeEngine:
             inst.decode_steps.inc()
             inst.tokens.inc(emitted)
             inst.tpot.observe(dt)
+            if sampled:
+                inst.sampled_tokens.inc(sampled)
 
     def _spec_step(self, active):
         """Speculative tick: the draft proposes ``spec_k`` tokens
@@ -1266,11 +1501,19 @@ class DecodeEngine:
                 inputs[slot, 0] = seq.last_token
                 positions[slot] = seq.pos
                 tables[slot] = seq.table
+            # coupled proposals only when BOTH programs compiled with
+            # sampling args — a greedy draft under sampled verify
+            # stays correct (every emitted token is a target draw),
+            # it just accepts less
+            couple = (self.sample_args
+                      and getattr(self._draft, 'sample_args', False))
             cur = inputs[:, 0].copy()
             for c in range(1, C):
+                dkw = self._draft_step_extras(active, c - 1) \
+                    if couple else {}
                 self._draft_cache, dtoks, _dl = self._device(
                     self._draft.run_step, self._draft_cache, cur,
-                    positions + (c - 1))
+                    positions + (c - 1), **dkw)
                 cur = onp.asarray(dtoks, 'int32').copy()
                 inputs[:, c] = cur
             # feed the LAST proposal too (its output is discarded):
@@ -1284,7 +1527,8 @@ class DecodeEngine:
                 positions + k)
             self._cache, vtoks, _logits = self._device(
                 self.program.run_verify, self._cache, inputs,
-                positions, tables)
+                positions, tables,
+                **self._step_extras(active, spec_c=C))
         except _DegradedPath:
             self._degrade_inflight(active)
             return
@@ -1304,6 +1548,7 @@ class DecodeEngine:
             return
         dt = self._clock() - t0
         emitted_total = 0
+        sampled_total = 0
         accepted_total = 0
         proposed_total = 0
         for slot, seq in active.items():
@@ -1330,6 +1575,8 @@ class DecodeEngine:
             for i, tok in enumerate(emitted):
                 self._emit_token(seq, tok)
                 emitted_total += 1
+                if seq.temperature > 0:
+                    sampled_total += 1
                 # per-token finish checks at the token's OWN position
                 # (p0 + i + 1) — the already-advanced seq.pos would
                 # truncate verified tokens near the max_len wall
@@ -1350,6 +1597,7 @@ class DecodeEngine:
             self._counts['spec_proposed'] += proposed_total
             self._counts['spec_accepted'] += accepted_total
             self._counts['tokens'] += emitted_total
+            self._counts['sampled_tokens'] += sampled_total
             self._ema_step_s = dt if self._ema_step_s is None \
                 else 0.7 * self._ema_step_s + 0.3 * dt
         inst = _serving_instruments()
@@ -1359,6 +1607,8 @@ class DecodeEngine:
             inst.tpot.observe(dt)
             inst.spec_proposed.inc(proposed_total)
             inst.spec_accepted.inc(accepted_total)
+            if sampled_total:
+                inst.sampled_tokens.inc(sampled_total)
 
     # -- live migration (seqstate export/import) ---------------------------
     #
@@ -1404,6 +1654,15 @@ class DecodeEngine:
                 box['error'] = exc
             ev.set()
 
+    @staticmethod
+    def _sampling_of(seq):
+        """The seqstate sampling block — None for greedy sequences,
+        keeping pre-sampling payloads byte-identical."""
+        if seq.temperature <= 0:
+            return None
+        return {'temperature': seq.temperature, 'top_p': seq.top_p,
+                'seed': seq.seed}
+
     def _request_id_for(self, stream):
         for rid, s in self._requests.items():
             if s is stream:
@@ -1436,7 +1695,9 @@ class DecodeEngine:
         if cold is not None:
             payload = build_payload(
                 'cold', cold.prompt, [], 0, None, cold.max_new,
-                eos_id=cold.eos_id, request_id=rid)
+                eos_id=cold.eos_id, request_id=rid,
+                adapter_id=cold.adapter_id,
+                sampling=self._sampling_of(cold))
             stream._finish('migrated')
             with self._lock:
                 self._counts['migrated_out'] += 1
@@ -1494,13 +1755,17 @@ class DecodeEngine:
             payload = build_payload(
                 'paged', seq.prompt, list(stream.tokens), seq.pos,
                 seq.last_token, seq.max_new, eos_id=seq.eos_id,
-                request_id=rid, page_size=ps, entries=entries)
+                request_id=rid, page_size=ps, entries=entries,
+                adapter_id=seq.adapter_id,
+                sampling=self._sampling_of(seq))
         else:
             entries = self.program.export_slot_state(self._cache, slot)
             payload = build_payload(
                 'slot', seq.prompt, list(stream.tokens), seq.pos,
                 seq.last_token, seq.max_new, eos_id=seq.eos_id,
-                request_id=rid, entries=entries)
+                request_id=rid, entries=entries,
+                adapter_id=seq.adapter_id,
+                sampling=self._sampling_of(seq))
         # the stream ends HERE, cleanly: 'migrated' is not an error
         # (the server's done line carries it; the gateway splices the
         # destination's continuation into the same client stream).
@@ -1542,12 +1807,29 @@ class DecodeEngine:
         :meth:`close`."""
         state = decode_payload(payload)
         state['trace'] = trace
+        # a pinned adapter / sampled stream must land in an engine
+        # that can CONTINUE it exactly — never silently under the base
+        # weights or greedy argmax
+        if state['adapter_id'] is not None and self._adapters is None:
+            raise SeqStateError(
+                'payload pins adapter %r but this engine serves no '
+                'adapter pool' % (state['adapter_id'],))
+        if state['sampling'] is not None and not self.sample_args:
+            raise SeqStateError(
+                'payload carries sampling state but this engine '
+                'compiled without sampling args')
         if state['kind'] == 'cold':
             # never prefilled at the source: ordinary admission
+            samp = state['sampling'] or {}
             return self.generate(state['prompt'],
                                  max_new_tokens=state['max_new'],
                                  eos_id=state['eos_id'],
                                  request_id=state['request_id'],
+                                 adapter=state['adapter_id'],
+                                 temperature=samp.get('temperature',
+                                                      0.0),
+                                 top_p=samp.get('top_p', 1.0),
+                                 seed=samp.get('seed', 0),
                                  trace=trace)
         if state['kind'] == 'paged' and not self.paged:
             raise SeqStateError('paged seqstate cannot land in a '
@@ -1579,7 +1861,19 @@ class DecodeEngine:
             slot = self._free.pop(0)
         ids = []
         npages = 0
+        aidx = 0
         try:
+            if state['adapter_id'] is not None:
+                # re-pin the SAME adapter before any device writes; a
+                # warm pool row is a refcount bump, a cold one uploads
+                try:
+                    aidx = self._adapters.acquire(state['adapter_id'])
+                except BackpressureError:
+                    raise
+                except Exception as exc:
+                    raise SeqStateError(
+                        'cannot re-pin adapter %r at import: %s'
+                        % (state['adapter_id'], exc))
             if self._cache is None:
                 if self.paged:
                     self._rebuild_cache()
@@ -1625,6 +1919,8 @@ class DecodeEngine:
                     for p in ids:
                         self._allocator.release(p)
                 self._free.append(slot)
+            if aidx:
+                self._adapters.release(aidx)
             raise
         now = self._clock()
         stream = GenerateStream(len(prompt))
@@ -1632,9 +1928,14 @@ class DecodeEngine:
         # stays intact (finish budgets, done-line tokens) while the
         # iterator yields only the continuation
         stream.tokens = list(emitted)
+        samp = state['sampling'] or {}
         seq = _Seq(stream, prompt, state['max_new'], state['eos_id'],
                    now, now + self.timeout_s if self.timeout_s
-                   else None)
+                   else None, adapter_id=state['adapter_id'],
+                   temperature=samp.get('temperature', 0.0),
+                   top_p=samp.get('top_p', 1.0),
+                   seed=samp.get('seed', 0))
+        seq.adapter_idx = aidx
         seq.slot = slot
         seq.pos = pos
         seq.last_token = state['last_token']
@@ -1650,7 +1951,8 @@ class DecodeEngine:
                 # admissions hit (one ref per newly registered page,
                 # exactly the admit-path contract)
                 with self._lock:
-                    self._prefix.register(prompt, ids)
+                    self._prefix.register(prompt, ids,
+                                          namespace=seq.adapter_id)
             if self._draft is not None:
                 # re-sync the draft from the fed context; a failure
                 # only lowers speculative acceptance (greedy verify
@@ -1702,8 +2004,9 @@ class DecodeEngine:
 
     def _fallback_complete(self, seq):
         """Finish one sequence start-to-finish (or from wherever it
-        got to) on the CPU fallback. Same greedy math -> same
-        tokens."""
+        got to) on the CPU fallback. Same greedy math (or the same
+        (seed, position)-keyed sampling law, adapter delta applied
+        host-side) -> same tokens."""
         if seq.stream.done():
             return
         remaining = seq.max_new - len(seq.stream.tokens)
@@ -1711,8 +2014,13 @@ class DecodeEngine:
                                        + len(seq.stream.tokens)) - 1
         remaining = min(remaining, max(0, room) + 1)
         try:
+            ad = None
+            if self._adapters is not None and seq.adapter_id is not None:
+                ad = self._adapters.host_tree(seq.adapter_id)
             toks = self.program.fallback_generate(
-                seq.prompt + seq.stream.tokens, remaining, seq.eos_id)
+                seq.prompt + seq.stream.tokens, remaining, seq.eos_id,
+                temperature=seq.temperature, top_p=seq.top_p,
+                seed=seq.seed, ad=ad)
         except Exception as exc:     # fallback itself failed: typed
             seq.stream._finish('error', exc)
             return
@@ -1853,6 +2161,8 @@ class DecodeEngine:
                         self._counts['spec_accepted'] / proposed, 4)
                     if proposed else None,
                 }
+        if self._adapters is not None:
+            out['adapters'] = self._adapters.pool.stats()
         out['cache'] = self.cache_accounting()
         return out
 
@@ -1901,6 +2211,7 @@ class DecodeEngine:
             orphans = list(self._migrations)
             self._migrations = []
         for seq in leftovers:
+            self._release_adapter(seq)
             seq.stream._finish('error', DrainTimeout(
                 'stream unfinished after the %.1fs drain budget '
                 '(%d tokens emitted)'

@@ -289,6 +289,43 @@ class TransformerLM(DecodeModel):
             'l%d_%s' % (i, kv): ((self.max_len, self.units), 'float32')
             for i in range(self.layers) for kv in ('k', 'v')})
 
+    # -- low-rank adapters (serving/adapters/, docs/SERVING.md
+    # "Multi-adapter serving & sampling") ----------------------------------
+
+    def lora_targets(self):
+        """The projections an adapter may delta, with their
+        (out, in) dims — the shapes ``serving.adapters`` sizes its
+        A/B pool entries to. Per-layer names follow the params dict
+        (``l{i}_qkv`` etc.)."""
+        U, H = self.units, self.hidden
+        return {'qkv': (3 * U, U), 'ffn1': (H, U), 'ffn2': (U, H)}
+
+    @staticmethod
+    def _lora_delta(x, a, b):
+        """Low-rank delta ``(x @ A^T) @ B^T`` — scale is folded into B
+        at pool-load time. ``a``/``b`` 2-D is ONE shared adapter
+        (prefill: a (r, in), b (out, r)); 3-D is the per-slot gathered
+        stack (a (s, r, in), b (s, out, r)) applied to x (s, ..., in).
+        The pool's reserved zero entry makes the base path exact: the
+        delta is 0.0 everywhere and additive 0.0 changes no argmax."""
+        import jax.numpy as jnp
+        if a.ndim == 2:
+            h = jnp.einsum('...i,ri->...r', x, a)
+            return jnp.einsum('...r,or->...o', h, b)
+        h = jnp.einsum('s...i,sri->s...r', x, a)
+        return jnp.einsum('s...r,sor->s...o', h, b)
+
+    def _adapted(self, x, w, b, ad, key):
+        """Dense projection plus the (optional) gathered adapter
+        delta. ``ad`` maps ``l{i}_{target}`` -> (A, B) arrays already
+        selected for this call's slots; None is the no-adapter fast
+        path (the traced graph is unchanged — not merely zero)."""
+        y = self._dense(x, w, b)
+        if ad is not None and key in ad:
+            la, lb = ad[key]
+            y = y + self._lora_delta(x, la, lb)
+        return y
+
     # -- shared block math --------------------------------------------------
 
     def _ln(self, x, g, b):
@@ -311,12 +348,14 @@ class TransformerLM(DecodeModel):
         return jnp.take(params['embed'], tokens, axis=0) \
             + jnp.take(params['pos'], positions, axis=0)
 
-    def _ffn_block(self, params, i, x):
+    def _ffn_block(self, params, i, x, ad=None):
         import jax
         p = lambda n: params['l%d_%s' % (i, n)]           # noqa: E731
-        h = jax.nn.gelu(self._dense(x, p('ffn1_w'), p('ffn1_b')),
+        h = jax.nn.gelu(self._adapted(x, p('ffn1_w'), p('ffn1_b'),
+                                      ad, 'l%d_ffn1' % i),
                         approximate=False)
-        return self._ln(x + self._dense(h, p('ffn2_w'), p('ffn2_b')),
+        return self._ln(x + self._adapted(h, p('ffn2_w'), p('ffn2_b'),
+                                          ad, 'l%d_ffn2' % i),
                         p('ln2_g'), p('ln2_b'))
 
     def _head(self, params, h):
@@ -324,10 +363,12 @@ class TransformerLM(DecodeModel):
         return jnp.einsum('...u,vu->...v', h, params['embed']) \
             + params['out_bias']
 
-    def _full_pass(self, params, tokens, length):
+    def _full_pass(self, params, tokens, length, ad=None):
         """Whole-sequence causal pass: tokens (B, S) -> (logits
         (B, S, V), per-layer k/v (B, S, U)). ``length`` masks padded
-        keys (scalar or (B,)); the prefill AND reference path."""
+        keys (scalar or (B,)); the prefill AND reference path.
+        ``ad`` — one shared adapter's (A, B) per target (prefill runs
+        one sequence; its K/V land adapter-colored in the cache)."""
         import jax.numpy as jnp
         B, S = tokens.shape
         positions = jnp.arange(S)
@@ -343,7 +384,8 @@ class TransformerLM(DecodeModel):
         kvs = []
         for i in range(self.layers):
             p = lambda n: params['l%d_%s' % (i, n)]       # noqa: E731
-            qkv = self._dense(x, p('qkv_w'), p('qkv_b'))
+            qkv = self._adapted(x, p('qkv_w'), p('qkv_b'),
+                                ad, 'l%d_qkv' % i)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             kvs.append((k, v))
             if flash:
@@ -372,13 +414,13 @@ class TransformerLM(DecodeModel):
             ctx = ctx.reshape(B, S, self.units)
             x = self._ln(x + self._dense(ctx, p('out_w'), p('out_b')),
                          p('ln1_g'), p('ln1_b'))
-            x = self._ffn_block(params, i, x)
+            x = self._ffn_block(params, i, x, ad)
         return self._head(params, x), kvs
 
-    def prefill(self, params, cache, tokens, length, slot):
+    def prefill(self, params, cache, tokens, length, slot, ad=None):
         import jax.numpy as jnp
         S = tokens.shape[1]
-        logits, kvs = self._full_pass(params, tokens, length)
+        logits, kvs = self._full_pass(params, tokens, length, ad)
         cache = dict(cache)
         pad = self.max_len - S
         for i, (k, v) in enumerate(kvs):
@@ -394,7 +436,7 @@ class TransformerLM(DecodeModel):
         sel = (jnp.arange(S) == length - 1).astype(logits.dtype)
         return cache, jnp.einsum('s,sv->v', sel, logits[0])
 
-    def step(self, params, cache, tokens, positions):
+    def step(self, params, cache, tokens, positions, ad=None):
         import jax.numpy as jnp
         slots = tokens.shape[0]
         x = self._embed(params, tokens, positions)        # (S, U)
@@ -407,7 +449,8 @@ class TransformerLM(DecodeModel):
         cache = dict(cache)
         for i in range(self.layers):
             p = lambda n: params['l%d_%s' % (i, n)]       # noqa: E731
-            qkv = self._dense(x, p('qkv_w'), p('qkv_b'))
+            qkv = self._adapted(x, p('qkv_w'), p('qkv_b'),
+                                ad, 'l%d_qkv' % i)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             ck = write_position(cache['l%d_k' % i], k, positions)
             cv = write_position(cache['l%d_v' % i], v, positions)
@@ -433,15 +476,15 @@ class TransformerLM(DecodeModel):
                 ctx = ctx.reshape(slots, self.units)
             x = self._ln(x + self._dense(ctx, p('out_w'), p('out_b')),
                          p('ln1_g'), p('ln1_b'))
-            x = self._ffn_block(params, i, x)
+            x = self._ffn_block(params, i, x, ad)
         return cache, self._head(params, x)
 
-    def full_forward(self, params, tokens):
+    def full_forward(self, params, tokens, ad=None):
         import jax.numpy as jnp
         T = tokens.shape[1]
         logits, _ = self._full_pass(
             params, tokens,
-            jnp.full((tokens.shape[0],), T, 'int32'))
+            jnp.full((tokens.shape[0],), T, 'int32'), ad)
         return logits
 
     # -- paged cache paths (docs/SERVING.md "Paged KV cache") ---------------
@@ -456,7 +499,8 @@ class TransformerLM(DecodeModel):
              for i in range(self.layers) for kv in ('k', 'v')},
             page_size, self.max_len)
 
-    def paged_prefill(self, params, pool, tokens, length, page_ids):
+    def paged_prefill(self, params, pool, tokens, length, page_ids,
+                      ad=None):
         """Prefill landing through the page table: same `_full_pass`
         contractions as the slot prefill (identical reduction tree ->
         identical logits bits), with the computed K/V prefix scattered
@@ -465,7 +509,7 @@ class TransformerLM(DecodeModel):
         """
         import jax.numpy as jnp
         S = tokens.shape[1]
-        logits, kvs = self._full_pass(params, tokens, length)
+        logits, kvs = self._full_pass(params, tokens, length, ad)
         npages = page_ids.shape[0]
         ps = pool[next(iter(pool))].shape[1]
         pad = npages * ps - S
@@ -478,7 +522,8 @@ class TransformerLM(DecodeModel):
         sel = (jnp.arange(S) == length - 1).astype(logits.dtype)
         return pool, jnp.einsum('s,sv->v', sel, logits[0])
 
-    def paged_step(self, params, pool, tokens, positions, tables):
+    def paged_step(self, params, pool, tokens, positions, tables,
+                   ad=None):
         """One decode step over the page pool: identical math to
         :meth:`step` except the per-slot K/V view is a gather of the
         slot's page-table entries and the row write is addressed
@@ -502,7 +547,8 @@ class TransformerLM(DecodeModel):
         pool = dict(pool)
         for i in range(self.layers):
             p = lambda n: params['l%d_%s' % (i, n)]       # noqa: E731
-            qkv = self._dense(x, p('qkv_w'), p('qkv_b'))
+            qkv = self._adapted(x, p('qkv_w'), p('qkv_b'),
+                                ad, 'l%d_qkv' % i)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             pool['l%d_k' % i] = write_paged_rows(
                 pool['l%d_k' % i], k, page_ids, offsets)
@@ -531,10 +577,11 @@ class TransformerLM(DecodeModel):
                 ctx = ctx.reshape(slots, self.units)
             x = self._ln(x + self._dense(ctx, p('out_w'), p('out_b')),
                          p('ln1_g'), p('ln1_b'))
-            x = self._ffn_block(params, i, x)
+            x = self._ffn_block(params, i, x, ad)
         return pool, self._head(params, x)
 
-    def paged_verify(self, params, pool, tokens, positions, tables):
+    def paged_verify(self, params, pool, tokens, positions, tables,
+                     ad=None):
         """Speculative verify: ``tokens`` (slots, C) — the last
         accepted token plus the draft's proposals — advance every slot
         C positions in ONE call, emitting logits at each. Causal
@@ -561,7 +608,8 @@ class TransformerLM(DecodeModel):
         pool = dict(pool)
         for i in range(self.layers):
             p = lambda n: params['l%d_%s' % (i, n)]       # noqa: E731
-            qkv = self._dense(x, p('qkv_w'), p('qkv_b'))
+            qkv = self._adapted(x, p('qkv_w'), p('qkv_b'),
+                                ad, 'l%d_qkv' % i)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             pool['l%d_k' % i] = write_paged_chunk(
                 pool['l%d_k' % i], k, page_ids, offsets)
@@ -580,7 +628,7 @@ class TransformerLM(DecodeModel):
             ctx = ctx.reshape(slots, C, self.units)
             x = self._ln(x + self._dense(ctx, p('out_w'), p('out_b')),
                          p('ln1_g'), p('ln1_b'))
-            x = self._ffn_block(params, i, x)
+            x = self._ffn_block(params, i, x, ad)
         return pool, self._head(params, x)              # (S, C, V)
 
     def init_params(self, seed=0):

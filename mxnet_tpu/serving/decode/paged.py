@@ -390,14 +390,25 @@ class PrefixCache:
         tail = tuple(prompt[full * ps:])
         return out, tail
 
-    def register(self, prompt, page_ids):
+    @staticmethod
+    def _root(namespace):
+        """Root parent key for one namespace. ``None`` keeps the
+        pre-namespace keys (old chains stay warm); anything else —
+        the engine passes the adapter id — roots a disjoint trie, so
+        a warm prefix hit can NEVER splice base-model KV rows into an
+        adapter sequence or cross two adapters: their K/V for the
+        same tokens differ."""
+        return None if namespace is None else ('ns', str(namespace))
+
+    def register(self, prompt, page_ids, namespace=None):
         """Record ``prompt``'s pages (full chain + partial tail) for
         future sharers; takes one allocator ref per NEWLY registered
         page. ``page_ids[i]`` holds prompt positions
-        ``[i*ps, (i+1)*ps)``."""
+        ``[i*ps, (i+1)*ps)``. ``namespace`` isolates the chain (the
+        engine namespaces by adapter id)."""
         now = self._tick()
         chunks, tail = self._chunks(prompt)
-        parent = None
+        parent = self._root(namespace)
         for i, chunk in enumerate(chunks + ([tail] if tail else [])):
             key = (parent, chunk)
             node = self._nodes.get(key)
@@ -414,16 +425,16 @@ class PrefixCache:
             node.last_used = now
             parent = key
 
-    def lookup(self, prompt):
-        """Longest registered chain covering ``prompt``'s head:
-        returns ``(page_ids, tokens_covered)`` WITHOUT taking refs
-        (the engine refs the pages it actually uses). Full pages chain
-        first; a partial tail matches only when the remaining prompt
-        tokens equal a registered tail exactly."""
+    def lookup(self, prompt, namespace=None):
+        """Longest registered chain covering ``prompt``'s head IN
+        ``namespace``: returns ``(page_ids, tokens_covered)`` WITHOUT
+        taking refs (the engine refs the pages it actually uses). Full
+        pages chain first; a partial tail matches only when the
+        remaining prompt tokens equal a registered tail exactly."""
         now = self._tick()
         chunks, tail = self._chunks(prompt)
         pages = []
-        parent = None
+        parent = self._root(namespace)
         covered = 0
         for chunk in chunks:
             node = self._nodes.get((parent, chunk))

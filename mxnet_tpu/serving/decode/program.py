@@ -85,10 +85,29 @@ def _instrument_compile(key, seconds):
 
 
 class DecodeProgram:
-    """AOT prefill/step programs + slot cache for one decode model."""
+    """AOT prefill/step programs + slot cache for one decode model.
+
+    ``sample_args`` (default on, ``MXNET_TPU_SERVE_SAMPLE_ARGS``)
+    compiles temperature/top-p/PRNG-key sampling into every token-
+    emitting program as fixed-shape array arguments (an ``extras``
+    dict pytree appended to the signature); ``temps == 0`` rows take
+    the greedy branch byte-for-byte, so the default token streams are
+    unchanged. ``logit_mask`` additionally compiles a per-slot
+    additive ``(slots, vocab)`` grammar/JSON mask argument at the
+    same point (``MXNET_TPU_SERVE_SAMPLE_MASK``; off by default — it
+    is vocab-sized per-step traffic). ``adapter_spec`` (an
+    :class:`~..adapters.AdapterSpec`) sizes a low-rank adapter pool
+    argument plus per-slot int32 indices so one program serves every
+    resident fine-tune — switching adapters is an array-value change,
+    never a retrace. All three are recorded in the manifest; loading
+    an artifact reconstructs the exact signature it was compiled
+    with, so pre-sampling artifacts keep deserializing their
+    executables.
+    """
 
     def __init__(self, model, params, slots=None, prefill_buckets=None,
-                 name=None, donate=None, emit_logits=True):
+                 name=None, donate=None, emit_logits=True,
+                 sample_args=None, logit_mask=None, adapter_spec=None):
         import jax
         import jax.numpy as jnp
         if not isinstance(model, DecodeModel):
@@ -121,6 +140,17 @@ class DecodeProgram:
             donate = jax.default_backend() != 'cpu'
         self._donate = bool(donate)
         self.emit_logits = bool(emit_logits)
+        self.sample_args = bool(
+            sample_args if sample_args is not None
+            else _knob('MXNET_TPU_SERVE_SAMPLE_ARGS', True))
+        self.logit_mask = bool(
+            logit_mask if logit_mask is not None
+            else _knob('MXNET_TPU_SERVE_SAMPLE_MASK', False))
+        if self.logit_mask and not self.sample_args:
+            raise ValueError('logit_mask requires sample_args (the '
+                             'mask applies at the sampling point)')
+        self.adapter_spec = adapter_spec
+        self._zero_apool_cached = None
         self._compiled = {}          # key -> jax Compiled
         self._loaded = {}            # key -> deserialized Compiled
         self._cpu_params = None
@@ -164,29 +194,174 @@ class DecodeProgram:
     def _cache_avals(self):
         return cache_avals(self._spec, self.slots)
 
+    # -- sampling / adapter extras (one dict pytree appended to the
+    # program signature when either feature is compiled in) -----------------
+
+    @property
+    def _has_extras(self):
+        return self.sample_args or self.adapter_spec is not None
+
+    def _extra_avals(self, kind):
+        """Aval pytree of the ``extras`` argument for one program
+        kind ('prefill' | 'step' | 'verify'). Empty features are
+        absent keys, so a sampling-only program carries no adapter
+        arrays and vice versa."""
+        import jax
+        extras = {}
+        S, V = self.slots, self.model.vocab
+        if self.sample_args:
+            rows = 1 if kind == 'prefill' else S
+            extras['temps'] = jax.ShapeDtypeStruct((rows,), 'float32')
+            extras['top_ps'] = jax.ShapeDtypeStruct((rows,), 'float32')
+            kshape = (S, self.spec_k + 1, 2) if kind == 'verify' \
+                else (rows, 2)
+            extras['keys'] = jax.ShapeDtypeStruct(kshape, 'uint32')
+            if self.logit_mask:
+                extras['masks'] = jax.ShapeDtypeStruct((rows, V),
+                                                       'float32')
+        if self.adapter_spec is not None:
+            extras['apool'] = self.adapter_spec.avals()
+            extras['aidx'] = jax.ShapeDtypeStruct(
+                () if kind == 'prefill' else (S,), 'int32')
+        return extras
+
+    def _zero_apool(self):
+        """All-zero device adapter pool — the default when no
+        AdapterPool is attached (every slot gathers the zero base)."""
+        with self._build_lock:
+            if self._zero_apool_cached is None:
+                import jax.numpy as jnp
+                self._zero_apool_cached = {
+                    k: (jnp.asarray(a), jnp.asarray(b))
+                    for k, (a, b) in
+                    self.adapter_spec.zero_tree().items()}
+            return self._zero_apool_cached
+
+    def _extra_args(self, kind, temps=None, top_ps=None, keys=None,
+                    masks=None, apool=None, aidx=None):
+        """Concrete ``extras`` for one call; None fields take the
+        neutral value (greedy, no mask, base adapter). Returns () when
+        the program compiled without extras — the pre-sampling
+        signature."""
+        if not self._has_extras:
+            return ()
+        extras = {}
+        S, V = self.slots, self.model.vocab
+        if self.sample_args:
+            rows = 1 if kind == 'prefill' else S
+            extras['temps'] = (
+                onp.zeros((rows,), 'float32') if temps is None
+                else onp.asarray(temps, 'float32').reshape(rows))
+            extras['top_ps'] = (
+                onp.ones((rows,), 'float32') if top_ps is None
+                else onp.asarray(top_ps, 'float32').reshape(rows))
+            kshape = (S, self.spec_k + 1, 2) if kind == 'verify' \
+                else (rows, 2)
+            extras['keys'] = (
+                onp.zeros(kshape, 'uint32') if keys is None
+                else onp.asarray(keys, 'uint32').reshape(kshape))
+            if self.logit_mask:
+                extras['masks'] = (
+                    onp.zeros((rows, V), 'float32') if masks is None
+                    else onp.asarray(masks, 'float32').reshape(rows,
+                                                               V))
+        if self.adapter_spec is not None:
+            extras['apool'] = apool if apool is not None \
+                else self._zero_apool()
+            if kind == 'prefill':
+                extras['aidx'] = onp.int32(0 if aidx is None else aidx)
+            else:
+                extras['aidx'] = (
+                    onp.zeros((S,), 'int32') if aidx is None
+                    else onp.asarray(aidx, 'int32').reshape(S))
+        return (extras,)
+
+    @staticmethod
+    def _gather_ad(extras):
+        """Per-call adapter view for the model: pool rows selected by
+        the (scalar or per-slot) indices — a 2-D (r, in)/(out, r)
+        pair at prefill, per-slot 3-D stacks at step/verify."""
+        if extras is None or 'apool' not in extras:
+            return None
+        aidx = extras['aidx']
+        return {k: (a[aidx], b[aidx])
+                for k, (a, b) in extras['apool'].items()}
+
+    # verify programs exist on the paged subclass; the base class
+    # only needs the attribute for _extra_avals' key-shape arithmetic
+    spec_k = 0
+
     def _prefill_fn(self, key):
         import jax.numpy as jnp
+        from .sampling import sample_tokens
         counts = self.trace_counts
         model, emit = self.model, self.emit_logits
+        sample, gather = self.sample_args, self._gather_ad
 
-        def fn(params, cache, tokens, length, slot):
+        if not self._has_extras:
+            def fn(params, cache, tokens, length, slot):
+                counts[key] = counts.get(key, 0) + 1
+                cache, logits = model.prefill(params, cache, tokens,
+                                              length, slot)
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
+                return (cache, tok, logits) if emit else (cache, tok)
+            return fn
+
+        # the adapter operand exists only when an adapter_spec was
+        # compiled in (never for families without lora_targets, e.g.
+        # RNNLM, whose prefill/step take no ad argument)
+        ad_on = self.adapter_spec is not None
+
+        def fn(params, cache, tokens, length, slot, extras):
             counts[key] = counts.get(key, 0) + 1
-            cache, logits = model.prefill(params, cache, tokens,
-                                          length, slot)
-            tok = jnp.argmax(logits, axis=-1).astype('int32')
+            if ad_on:
+                cache, logits = model.prefill(params, cache, tokens,
+                                              length, slot,
+                                              gather(extras))
+            else:
+                cache, logits = model.prefill(params, cache, tokens,
+                                              length, slot)
+            if sample:
+                tok = sample_tokens(logits[None], extras['temps'],
+                                    extras['top_ps'], extras['keys'],
+                                    extras.get('masks'))[0]
+            else:
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
             return (cache, tok, logits) if emit else (cache, tok)
         return fn
 
     def _step_fn(self, key):
         import jax.numpy as jnp
+        from .sampling import sample_tokens
         counts = self.trace_counts
         model, emit = self.model, self.emit_logits
+        sample, gather = self.sample_args, self._gather_ad
 
-        def fn(params, cache, tokens, positions):
+        if not self._has_extras:
+            def fn(params, cache, tokens, positions):
+                counts[key] = counts.get(key, 0) + 1
+                cache, logits = model.step(params, cache, tokens,
+                                           positions)
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
+                return (cache, tok, logits) if emit else (cache, tok)
+            return fn
+
+        ad_on = self.adapter_spec is not None
+
+        def fn(params, cache, tokens, positions, extras):
             counts[key] = counts.get(key, 0) + 1
-            cache, logits = model.step(params, cache, tokens,
-                                       positions)
-            tok = jnp.argmax(logits, axis=-1).astype('int32')
+            if ad_on:
+                cache, logits = model.step(params, cache, tokens,
+                                           positions, gather(extras))
+            else:
+                cache, logits = model.step(params, cache, tokens,
+                                           positions)
+            if sample:
+                tok = sample_tokens(logits, extras['temps'],
+                                    extras['top_ps'], extras['keys'],
+                                    extras.get('masks'))
+            else:
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
             return (cache, tok, logits) if emit else (cache, tok)
         return fn
 
@@ -232,19 +407,21 @@ class DecodeProgram:
     def compile_prefill(self, bucket):
         import jax
         key = self._program_key('prefill:%d' % bucket)
-        return self._build(
-            key, self._prefill_fn(key),
-            jax.ShapeDtypeStruct((1, bucket), 'int32'),
-            jax.ShapeDtypeStruct((), 'int32'),
-            jax.ShapeDtypeStruct((), 'int32'))
+        avals = [jax.ShapeDtypeStruct((1, bucket), 'int32'),
+                 jax.ShapeDtypeStruct((), 'int32'),
+                 jax.ShapeDtypeStruct((), 'int32')]
+        if self._has_extras:
+            avals.append(self._extra_avals('prefill'))
+        return self._build(key, self._prefill_fn(key), *avals)
 
     def compile_step(self):
         import jax
         key = self._program_key('step')
-        return self._build(
-            key, self._step_fn(key),
-            jax.ShapeDtypeStruct((self.slots,), 'int32'),
-            jax.ShapeDtypeStruct((self.slots,), 'int32'))
+        avals = [jax.ShapeDtypeStruct((self.slots,), 'int32'),
+                 jax.ShapeDtypeStruct((self.slots,), 'int32')]
+        if self._has_extras:
+            avals.append(self._extra_avals('step'))
+        return self._build(key, self._step_fn(key), *avals)
 
     def warmup(self, buckets=None):
         """Compile the whole ladder + the step program (server start,
@@ -262,10 +439,14 @@ class DecodeProgram:
         cache, tok = out
         return cache, tok, None
 
-    def run_prefill(self, cache, tokens, slot):
+    def run_prefill(self, cache, tokens, slot, temps=None,
+                    top_ps=None, keys=None, masks=None, apool=None,
+                    aidx=None):
         """Pad ``tokens`` (1-D int prompt) to its bucket and land the
         prefix in ``slot``. Returns (cache', first_token int, logits
-        np (V,) | None)."""
+        np (V,) | None). Sampling/adapter kwargs are optional array
+        values for the compiled ``extras`` argument; omitted fields
+        take the neutral value (greedy, base adapter)."""
         tokens = onp.asarray(tokens, 'int32').reshape(-1)
         n = tokens.shape[0]
         if n < 1:
@@ -276,18 +457,24 @@ class DecodeProgram:
         prog = self.compile_prefill(bucket)
         cache, tok, logits = self._unpack(prog(
             self._params, cache, padded, onp.int32(n),
-            onp.int32(slot)))
+            onp.int32(slot),
+            *self._extra_args('prefill', temps, top_ps, keys, masks,
+                              apool, aidx)))
         return cache, int(tok), \
             None if logits is None else onp.asarray(logits)
 
-    def run_step(self, cache, tokens, positions):
+    def run_step(self, cache, tokens, positions, temps=None,
+                 top_ps=None, keys=None, masks=None, apool=None,
+                 aidx=None):
         """Advance every slot one token. Returns (cache', tokens np
         (slots,), logits np (slots, V) | None)."""
         prog = self.compile_step()
         cache, toks, logits = self._unpack(prog(
             self._params, cache,
             onp.asarray(tokens, 'int32').reshape(self.slots),
-            onp.asarray(positions, 'int32').reshape(self.slots)))
+            onp.asarray(positions, 'int32').reshape(self.slots),
+            *self._extra_args('step', temps, top_ps, keys, masks,
+                              apool, aidx)))
         return cache, onp.asarray(toks), \
             None if logits is None else onp.asarray(logits)
 
@@ -325,20 +512,40 @@ class DecodeProgram:
 
     # -- CPU fallback (degraded serving) ------------------------------------
 
-    def fallback_generate(self, tokens, max_new, eos_id=None):
+    def fallback_generate(self, tokens, max_new, eos_id=None,
+                          temperature=0.0, top_p=1.0, seed=0,
+                          ad=None):
         """Eagerly decode on the CPU backend through a single-slot
         cache — the degraded path sequences complete on when the
         accelerator program is the thing that died. Same math, same
-        greedy argmax, so the tokens are bit-identical to the
-        accelerator path."""
+        emission rule (greedy at ``temperature == 0``; otherwise the
+        position-keyed sampler), so the tokens are bit-identical to
+        the accelerator path. ``ad`` is an optional 2-D adapter tree
+        ``{target: (A, B)}`` — the degraded path for adapter
+        traffic."""
         import jax
         import jax.numpy as jnp
+        from .sampling import key_for, sample_tokens
         cpu = jax.devices('cpu')[0]
         with self._build_lock:
             if self._cpu_params is None:
                 self._cpu_params = {k: jax.device_put(v, cpu)
                                     for k, v in self._params.items()}
         tokens = [int(t) for t in onp.asarray(tokens).reshape(-1)]
+        temperature = float(temperature)
+
+        def pick(row, pos):
+            if temperature <= 0:
+                return int(jnp.argmax(row))
+            return int(sample_tokens(
+                jnp.asarray(row)[None],
+                onp.asarray([temperature], 'float32'),
+                onp.asarray([top_p], 'float32'),
+                key_for(seed, pos)[None])[0])
+
+        # RNN families take no adapter argument; only thread ``ad``
+        # through when one was actually supplied
+        adarg = (ad,) if ad is not None else ()
         out = []
         with jax.default_device(cpu):
             cache = init_cache(self._spec, 1)
@@ -346,8 +553,8 @@ class DecodeProgram:
             cache, logits = self.model.prefill(
                 self._cpu_params, cache, prompt,
                 jnp.asarray(len(tokens), 'int32'),
-                jnp.asarray(0, 'int32'))
-            tok = int(jnp.argmax(logits))
+                jnp.asarray(0, 'int32'), *adarg)
+            tok = pick(logits, len(tokens) - 1)
             pos = len(tokens)
             while True:
                 out.append(tok)
@@ -358,8 +565,8 @@ class DecodeProgram:
                 cache, logits = self.model.step(
                     self._cpu_params, cache,
                     jnp.asarray([tok], 'int32'),
-                    jnp.asarray([pos], 'int32'))
-                tok = int(jnp.argmax(logits[0]))
+                    jnp.asarray([pos], 'int32'), *adarg)
+                tok = pick(logits[0], pos)
                 pos += 1
         return out
 
@@ -407,6 +614,14 @@ class DecodeProgram:
             'prefill_buckets': list(self.policy.buckets),
             'emit_logits': self.emit_logits,
             'donate': self._donate,
+            # the extras signature the programs were compiled with —
+            # load() must reconstruct it exactly or the serialized
+            # executables stop matching (absent keys = pre-sampling
+            # artifact = no extras argument at all)
+            'sample_args': self.sample_args,
+            'logit_mask': self.logit_mask,
+            'adapter': (None if self.adapter_spec is None
+                        else self.adapter_spec.to_manifest()),
             'cache_bytes': self.cache_bytes(),
             'jax_version': jax.__version__,
             'platform': jax.default_backend(),
@@ -457,11 +672,18 @@ class DecodeProgram:
                       'spec_k': manifest.get('spec_k', 0)}
         else:
             target = DecodeProgram
+        aspec = None
+        if manifest.get('adapter'):
+            from ..adapters import AdapterSpec
+            aspec = AdapterSpec.from_manifest(manifest['adapter'])
         prog = target(model, params, slots=manifest['slots'],
                       prefill_buckets=manifest['prefill_buckets'],
                       name=manifest.get('name'),
                       donate=manifest.get('donate'),
                       emit_logits=manifest.get('emit_logits', True),
+                      sample_args=manifest.get('sample_args', False),
+                      logit_mask=manifest.get('logit_mask', False),
+                      adapter_spec=aspec,
                       **kwargs)
         env_ok = (manifest.get('jax_version') == jax.__version__
                   and manifest.get('platform') == jax.default_backend())
@@ -513,7 +735,8 @@ class PagedDecodeProgram(DecodeProgram):
 
     def __init__(self, model, params, slots=None, prefill_buckets=None,
                  name=None, donate=None, emit_logits=True,
-                 page_size=None, pages=None, spec_k=None):
+                 page_size=None, pages=None, spec_k=None,
+                 sample_args=None, logit_mask=None, adapter_spec=None):
         if not getattr(model, 'supports_paging', False):
             raise TypeError(
                 'family %r does not support a paged cache (an RNN '
@@ -521,7 +744,10 @@ class PagedDecodeProgram(DecodeProgram):
                 'to page); use DecodeProgram' % (model.family,))
         super().__init__(model, params, slots=slots,
                          prefill_buckets=prefill_buckets, name=name,
-                         donate=donate, emit_logits=emit_logits)
+                         donate=donate, emit_logits=emit_logits,
+                         sample_args=sample_args,
+                         logit_mask=logit_mask,
+                         adapter_spec=adapter_spec)
         self.page_size = int(
             page_size if page_size is not None
             else _knob('MXNET_TPU_SERVE_PAGE_SIZE', 16))
@@ -577,40 +803,104 @@ class PagedDecodeProgram(DecodeProgram):
 
     def _paged_prefill_fn(self, key):
         import jax.numpy as jnp
+        from .sampling import sample_tokens
         counts = self.trace_counts
         model, emit = self.model, self.emit_logits
+        sample, gather = self.sample_args, self._gather_ad
 
-        def fn(params, pool, tokens, length, page_ids):
+        if not self._has_extras:
+            def fn(params, pool, tokens, length, page_ids):
+                counts[key] = counts.get(key, 0) + 1
+                pool, logits = model.paged_prefill(params, pool,
+                                                   tokens, length,
+                                                   page_ids)
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
+                return (pool, tok, logits) if emit else (pool, tok)
+            return fn
+
+        def fn(params, pool, tokens, length, page_ids, extras):
             counts[key] = counts.get(key, 0) + 1
             pool, logits = model.paged_prefill(params, pool, tokens,
-                                               length, page_ids)
-            tok = jnp.argmax(logits, axis=-1).astype('int32')
+                                               length, page_ids,
+                                               gather(extras))
+            if sample:
+                tok = sample_tokens(logits[None], extras['temps'],
+                                    extras['top_ps'], extras['keys'],
+                                    extras.get('masks'))[0]
+            else:
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
             return (pool, tok, logits) if emit else (pool, tok)
         return fn
 
     def _paged_step_fn(self, key):
         import jax.numpy as jnp
+        from .sampling import sample_tokens
         counts = self.trace_counts
         model, emit = self.model, self.emit_logits
+        sample, gather = self.sample_args, self._gather_ad
 
-        def fn(params, pool, tokens, positions, tables):
+        if not self._has_extras:
+            def fn(params, pool, tokens, positions, tables):
+                counts[key] = counts.get(key, 0) + 1
+                pool, logits = model.paged_step(params, pool, tokens,
+                                                positions, tables)
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
+                return (pool, tok, logits) if emit else (pool, tok)
+            return fn
+
+        def fn(params, pool, tokens, positions, tables, extras):
             counts[key] = counts.get(key, 0) + 1
             pool, logits = model.paged_step(params, pool, tokens,
-                                            positions, tables)
-            tok = jnp.argmax(logits, axis=-1).astype('int32')
+                                            positions, tables,
+                                            gather(extras))
+            if sample:
+                tok = sample_tokens(logits, extras['temps'],
+                                    extras['top_ps'], extras['keys'],
+                                    extras.get('masks'))
+            else:
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
             return (pool, tok, logits) if emit else (pool, tok)
         return fn
 
     def _verify_fn(self, key):
         import jax.numpy as jnp
+        from .sampling import sample_tokens
         counts = self.trace_counts
         model, emit = self.model, self.emit_logits
+        sample, gather = self.sample_args, self._gather_ad
 
-        def fn(params, pool, tokens, positions, tables):
+        if not self._has_extras:
+            def fn(params, pool, tokens, positions, tables):
+                counts[key] = counts.get(key, 0) + 1
+                pool, logits = model.paged_verify(params, pool,
+                                                  tokens, positions,
+                                                  tables)
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
+                return (pool, tok, logits) if emit else (pool, tok)
+            return fn
+
+        def fn(params, pool, tokens, positions, tables, extras):
             counts[key] = counts.get(key, 0) + 1
             pool, logits = model.paged_verify(params, pool, tokens,
-                                              positions, tables)
-            tok = jnp.argmax(logits, axis=-1).astype('int32')
+                                              positions, tables,
+                                              gather(extras))
+            if sample:
+                # one sampler row per (slot, chunk-position): the row
+                # at (s, c) uses the SAME key the plain path would at
+                # that absolute position, so verify-emitted tokens are
+                # bit-identical to unspeculated sampling
+                S, C, V = logits.shape
+                masks = extras.get('masks')
+                if masks is not None:
+                    masks = jnp.repeat(masks, C, axis=0)
+                tok = sample_tokens(
+                    logits.reshape(S * C, V),
+                    jnp.repeat(extras['temps'], C),
+                    jnp.repeat(extras['top_ps'], C),
+                    extras['keys'].reshape(S * C, 2),
+                    masks).reshape(S, C)
+            else:
+                tok = jnp.argmax(logits, axis=-1).astype('int32')
             return (pool, tok, logits) if emit else (pool, tok)
         return fn
 
@@ -628,34 +918,37 @@ class PagedDecodeProgram(DecodeProgram):
         import jax
         key = self._program_key('prefill:%d' % bucket)
         npages = pages_for(bucket, self.page_size)
-        return self._build(
-            key, self._paged_prefill_fn(key),
-            jax.ShapeDtypeStruct((1, bucket), 'int32'),
-            jax.ShapeDtypeStruct((), 'int32'),
-            jax.ShapeDtypeStruct((npages,), 'int32'))
+        avals = [jax.ShapeDtypeStruct((1, bucket), 'int32'),
+                 jax.ShapeDtypeStruct((), 'int32'),
+                 jax.ShapeDtypeStruct((npages,), 'int32')]
+        if self._has_extras:
+            avals.append(self._extra_avals('prefill'))
+        return self._build(key, self._paged_prefill_fn(key), *avals)
 
     def compile_step(self):
         import jax
         key = self._program_key('step')
-        return self._build(
-            key, self._paged_step_fn(key),
-            jax.ShapeDtypeStruct((self.slots,), 'int32'),
-            jax.ShapeDtypeStruct((self.slots,), 'int32'),
-            jax.ShapeDtypeStruct((self.slots, self.max_pages),
-                                 'int32'))
+        avals = [jax.ShapeDtypeStruct((self.slots,), 'int32'),
+                 jax.ShapeDtypeStruct((self.slots,), 'int32'),
+                 jax.ShapeDtypeStruct((self.slots, self.max_pages),
+                                      'int32')]
+        if self._has_extras:
+            avals.append(self._extra_avals('step'))
+        return self._build(key, self._paged_step_fn(key), *avals)
 
     def compile_verify(self):
         import jax
         if not self.spec_k:
             raise ValueError('verify program needs spec_k > 0')
         key = self._program_key('verify:%d' % (self.spec_k + 1))
-        return self._build(
-            key, self._verify_fn(key),
-            jax.ShapeDtypeStruct((self.slots, self.spec_k + 1),
-                                 'int32'),
-            jax.ShapeDtypeStruct((self.slots,), 'int32'),
-            jax.ShapeDtypeStruct((self.slots, self.max_pages),
-                                 'int32'))
+        avals = [jax.ShapeDtypeStruct((self.slots, self.spec_k + 1),
+                                      'int32'),
+                 jax.ShapeDtypeStruct((self.slots,), 'int32'),
+                 jax.ShapeDtypeStruct((self.slots, self.max_pages),
+                                      'int32')]
+        if self._has_extras:
+            avals.append(self._extra_avals('verify'))
+        return self._build(key, self._verify_fn(key), *avals)
 
     def compile_copy_page(self):
         import jax
@@ -678,7 +971,9 @@ class PagedDecodeProgram(DecodeProgram):
 
     # -- execution ---------------------------------------------------------
 
-    def run_prefill(self, pool, tokens, page_ids):
+    def run_prefill(self, pool, tokens, page_ids, temps=None,
+                    top_ps=None, keys=None, masks=None, apool=None,
+                    aidx=None):
         """Pad ``tokens`` to its bucket and land its K/V in the
         host-allocated ``page_ids`` (list; padded with the trash page
         to the bucket's page count). Returns (pool', first_token,
@@ -699,11 +994,15 @@ class PagedDecodeProgram(DecodeProgram):
         prog = self.compile_prefill(bucket)
         pool, tok, logits = self._unpack(prog(
             self._params, pool, padded, onp.int32(n),
-            onp.asarray(ids, 'int32')))
+            onp.asarray(ids, 'int32'),
+            *self._extra_args('prefill', temps, top_ps, keys, masks,
+                              apool, aidx)))
         return pool, int(tok), \
             None if logits is None else onp.asarray(logits)
 
-    def run_step(self, pool, tokens, positions, tables):
+    def run_step(self, pool, tokens, positions, tables, temps=None,
+                 top_ps=None, keys=None, masks=None, apool=None,
+                 aidx=None):
         """Advance every slot one token through its page table."""
         prog = self.compile_step()
         pool, toks, logits = self._unpack(prog(
@@ -711,14 +1010,20 @@ class PagedDecodeProgram(DecodeProgram):
             onp.asarray(tokens, 'int32').reshape(self.slots),
             onp.asarray(positions, 'int32').reshape(self.slots),
             onp.asarray(tables, 'int32').reshape(self.slots,
-                                                 self.max_pages)))
+                                                 self.max_pages),
+            *self._extra_args('step', temps, top_ps, keys, masks,
+                              apool, aidx)))
         return pool, onp.asarray(toks), \
             None if logits is None else onp.asarray(logits)
 
-    def run_verify(self, pool, tokens, positions, tables):
-        """Speculative verify: (slots, spec_k+1) tokens in, greedy
+    def run_verify(self, pool, tokens, positions, tables, temps=None,
+                   top_ps=None, keys=None, masks=None, apool=None,
+                   aidx=None):
+        """Speculative verify: (slots, spec_k+1) tokens in, emitted
         tokens (slots, spec_k+1) out; K/V rows written for every
-        position (rejected rows stay masked until overwritten)."""
+        position (rejected rows stay masked until overwritten).
+        ``keys`` is (slots, spec_k+1, 2): one key per verify row at
+        its absolute position, matching the plain path's keys."""
         prog = self.compile_verify()
         pool, toks, logits = self._unpack(prog(
             self._params, pool,
@@ -726,7 +1031,9 @@ class PagedDecodeProgram(DecodeProgram):
                                                  self.spec_k + 1),
             onp.asarray(positions, 'int32').reshape(self.slots),
             onp.asarray(tables, 'int32').reshape(self.slots,
-                                                 self.max_pages)))
+                                                 self.max_pages),
+            *self._extra_args('verify', temps, top_ps, keys, masks,
+                              apool, aidx)))
         return pool, onp.asarray(toks), \
             None if logits is None else onp.asarray(logits)
 
@@ -784,7 +1091,9 @@ class PagedDecodeProgram(DecodeProgram):
 def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,
                   max_len=None, name=None, donate=None,
                   emit_logits=True, paged=None, page_size=None,
-                  pages=None, spec_k=None):
+                  pages=None, spec_k=None, sample_args=None,
+                  logit_mask=None, adapter_rank=None,
+                  adapter_slots=None):
     """Freeze a generation model into a :class:`DecodeProgram`.
 
     ``obj`` — one of:
@@ -807,6 +1116,12 @@ def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,
     ``page_size`` / ``pages`` / ``spec_k`` configure the pool and the
     speculative-verify program (``MXNET_TPU_SERVE_PAGE_SIZE`` /
     ``MXNET_TPU_SERVE_PAGES`` / ``MXNET_TPU_SERVE_SPEC_K``).
+
+    ``adapter_rank`` > 0 (``MXNET_TPU_SERVE_ADAPTER_RANK``) compiles a
+    low-rank adapter pool of ``adapter_slots`` resident variants
+    (``MXNET_TPU_SERVE_ADAPTER_SLOTS``) into every program — LoRA
+    families only. ``sample_args`` / ``logit_mask`` select the
+    sampling signature (see :class:`DecodeProgram`).
     """
     if max_len is None:
         max_len = int(_knob('MXNET_TPU_SERVE_MAX_SEQ_LEN', 256))
@@ -834,6 +1149,22 @@ def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,
     if paged is None:
         paged = bool(_knob('MXNET_TPU_SERVE_PAGED', True)) \
             and getattr(model, 'supports_paging', False)
+    if adapter_rank is None:
+        adapter_rank = int(
+            _knob('MXNET_TPU_SERVE_ADAPTER_RANK', 0) or 0)
+    adapter_spec = None
+    if adapter_rank > 0:
+        if not hasattr(model, 'lora_targets'):
+            raise TypeError(
+                'family %r has no LoRA targets — adapter_rank > 0 '
+                'needs a model exposing lora_targets()'
+                % (model.family,))
+        from ..adapters import AdapterSpec
+        if adapter_slots is None:
+            adapter_slots = int(
+                _knob('MXNET_TPU_SERVE_ADAPTER_SLOTS', 8))
+        adapter_spec = AdapterSpec.for_model(model, adapter_rank,
+                                             adapter_slots)
     if paged:
         if pages is None:
             knob_pages = int(_knob('MXNET_TPU_SERVE_PAGES', 0) or 0)
@@ -842,10 +1173,14 @@ def freeze_decode(obj, params=None, slots=None, prefill_buckets=None,
             model, params, slots=slots,
             prefill_buckets=prefill_buckets, name=name, donate=donate,
             emit_logits=emit_logits, page_size=page_size, pages=pages,
-            spec_k=spec_k)
+            spec_k=spec_k, sample_args=sample_args,
+            logit_mask=logit_mask, adapter_spec=adapter_spec)
     return DecodeProgram(model, params, slots=slots,
                          prefill_buckets=prefill_buckets, name=name,
-                         donate=donate, emit_logits=emit_logits)
+                         donate=donate, emit_logits=emit_logits,
+                         sample_args=sample_args,
+                         logit_mask=logit_mask,
+                         adapter_spec=adapter_spec)
 
 
 def load_decode(path):

@@ -91,7 +91,7 @@ def _digest(doc):
 
 def build_payload(kind, prompt, emitted, pos, last_token, max_new,
                   eos_id=None, request_id=None, page_size=None,
-                  entries=None):
+                  entries=None, adapter_id=None, sampling=None):
     """Assemble one sealed ``mxnet_tpu.seqstate.v1`` document.
 
     ``entries`` maps cache entry name to a host array: for ``paged``
@@ -100,6 +100,13 @@ def build_payload(kind, prompt, emitted, pos, last_token, max_new,
     the per-slot recurrent state arrays. ``cold`` sequences (still
     queued, no device state yet) carry no entries and import through
     the ordinary admission path.
+
+    ``adapter_id`` pins the sequence to its LoRA variant across the
+    handoff — the importer re-acquires the SAME adapter or rejects,
+    never continues one tenant's sequence under another's weights.
+    ``sampling`` is ``{'temperature', 'top_p', 'seed'}``; keys derive
+    from (seed, absolute position), so a continuation samples the
+    exact stream the source would have.
     """
     if kind not in _KINDS:
         raise ValueError('kind must be one of %r, got %r'
@@ -119,6 +126,13 @@ def build_payload(kind, prompt, emitted, pos, last_token, max_new,
     }
     if page_size is not None:
         doc['page_size'] = int(page_size)
+    if adapter_id is not None:
+        doc['adapter_id'] = str(adapter_id)
+    if sampling is not None:
+        doc['sampling'] = {
+            'temperature': float(sampling.get('temperature', 0.0)),
+            'top_p': float(sampling.get('top_p', 1.0)),
+            'seed': int(sampling.get('seed', 0))}
     doc['digest'] = _digest(doc)
     return doc
 
@@ -127,8 +141,11 @@ def decode_payload(obj):
     """Validate + decode a payload into host state.
 
     Returns ``{'kind', 'request_id', 'prompt', 'emitted', 'pos',
-    'last_token', 'max_new', 'eos_id', 'page_size', 'arrays'}`` with
-    ``arrays`` holding decoded numpy arrays per cache entry. Raises
+    'last_token', 'max_new', 'eos_id', 'page_size', 'arrays',
+    'adapter_id', 'sampling'}`` with ``arrays`` holding decoded numpy
+    arrays per cache entry (pre-adapter payloads decode with
+    ``adapter_id=None``, ``sampling=None`` — base adapter, greedy).
+    Raises
     :class:`SeqStateError` on a version mismatch, a digest mismatch
     (torn payload), or structurally invalid content.
     """
@@ -171,6 +188,16 @@ def decode_payload(obj):
                     'paged entry %r carries %d rows for pos=%d'
                     % (name, arr.shape[0], pos))
     eos_id = obj.get('eos_id')
+    sampling = obj.get('sampling')
+    if sampling is not None:
+        try:
+            sampling = {'temperature': float(sampling['temperature']),
+                        'top_p': float(sampling['top_p']),
+                        'seed': int(sampling['seed'])}
+        except (KeyError, TypeError, ValueError) as exc:
+            raise SeqStateError('malformed sampling block: %s'
+                                % (exc,))
+    adapter_id = obj.get('adapter_id')
     return {
         'kind': kind,
         'request_id': obj.get('request_id'),
@@ -182,4 +209,6 @@ def decode_payload(obj):
         'eos_id': None if eos_id is None else int(eos_id),
         'page_size': obj.get('page_size'),
         'arrays': arrays,
+        'adapter_id': None if adapter_id is None else str(adapter_id),
+        'sampling': sampling,
     }

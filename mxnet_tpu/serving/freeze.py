@@ -473,12 +473,19 @@ def load_frozen(path):
     :class:`~.decode.DecodeProgram`; decode manifests carrying
     ``paged: true`` (page-pool geometry + copy/verify programs)
     re-dispatch once more to :class:`~.decode.PagedDecodeProgram`
-    inside ``DecodeProgram.load``."""
+    inside ``DecodeProgram.load``. ``mxnet_tpu.adapter.v1``
+    artifacts (LoRA weight deltas, not programs) load as digest-
+    verified :class:`~.adapters.Adapter` objects."""
     try:
         with open(os.path.join(path, 'MANIFEST.json')) as f:
-            kind = json.load(f).get('kind')
+            doc = json.load(f)
+        kind, schema = doc.get('kind'), doc.get('schema')
     except OSError:
-        kind = None
+        kind = schema = None
+    from .adapters import ADAPTER_SCHEMA
+    if schema == ADAPTER_SCHEMA or kind == 'adapter':
+        from .adapters import load_adapter
+        return load_adapter(path)
     if kind == 'decode':
         from .decode import DecodeProgram
         return DecodeProgram.load(path)

@@ -79,11 +79,17 @@ import urllib.request
 from ..observability import trace as _trace
 
 __all__ = ['ReplicaState', 'ServingGateway', 'TokenBucket',
-           'TenantAdmission', 'prefix_fingerprint', 'rendezvous_rank']
+           'TenantAdmission', 'prefix_fingerprint', 'rendezvous_rank',
+           'ADAPTER_HEADER']
 
 _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding',
                 'te', 'trailer', 'upgrade', 'proxy-authorization',
                 'proxy-authenticate', 'host', 'content-length'}
+
+# the LoRA-variant routing relay: clients may name the adapter here
+# instead of the JSON body; the gateway folds it into the body and
+# the affinity fingerprint
+ADAPTER_HEADER = 'X-Mxnet-Adapter'
 
 
 def _knob(name, default):
@@ -116,15 +122,24 @@ def _record_event(kind, **fields):
 
 # -- prefix-affine routing (pure functions, unit-tested) -------------------
 
-def prefix_fingerprint(tokens):
+def prefix_fingerprint(tokens, adapter=None):
     """Stable fingerprint of a prompt's ROUTING prefix: everything but
     the final token (the per-user suffix in the system-prompt workload
     prefix sharing exists for), the whole prompt when it is a single
-    token. Same prefix, same fingerprint — the affinity key."""
+    token. Same prefix, same fingerprint — the affinity key.
+
+    ``adapter`` folds the LoRA variant into the key: the replica-side
+    PrefixCache namespaces warm pages per adapter, so the same prompt
+    under different adapters shares NOTHING — routing them together
+    would pin unrelated tenants to one replica for no cache win.
+    ``None``/``''``/``'base'`` all hash as the base (same key as
+    pre-adapter gateways)."""
     toks = [int(t) for t in tokens]
     core = toks[:-1] if len(toks) > 1 else toks
-    h = hashlib.blake2b(','.join(map(str, core)).encode(),
-                        digest_size=8)
+    body = ','.join(map(str, core))
+    if adapter is not None and adapter not in ('', 'base'):
+        body = '%s@%s' % (adapter, body)
+    h = hashlib.blake2b(body.encode(), digest_size=8)
     return h.hexdigest()
 
 
@@ -623,12 +638,12 @@ class ServingGateway:
         except Exception:
             return min(1.0, 0.05 * 2.0 ** max(0, attempt - 1))
 
-    def affinity_target(self, tokens):
+    def affinity_target(self, tokens, adapter=None):
         """The replica URL a prompt would route to right now (healthy
         set + rendezvous hash), or None. Drill/test helper — the
         kill-mid-stream harness uses it to aim at the serving
         replica."""
-        fp = prefix_fingerprint(tokens)
+        fp = prefix_fingerprint(tokens, adapter=adapter)
         healthy = sorted(r.base_url for r in self.replicas
                          if r.healthy)
         if not healthy:
@@ -1646,12 +1661,25 @@ class ServingGateway:
                                 req = json.loads(body or b'{}')
                             except ValueError:
                                 req = None  # replica answers the 400
+                        # multi-adapter routing: body 'adapter' wins;
+                        # an X-Mxnet-Adapter header folds INTO the
+                        # body so resume re-admissions and handoffs
+                        # (rebuilt from req) stay on the variant
+                        adapter = None
+                        if isinstance(req, dict):
+                            adapter = req.get('adapter')
+                            if adapter is None:
+                                adapter = handler.headers.get(
+                                    ADAPTER_HEADER)
+                                if adapter is not None:
+                                    req['adapter'] = adapter
+                                    body = json.dumps(req).encode()
                         fingerprint = None
                         if gw.affinity and isinstance(req, dict) \
                                 and req.get('tokens'):
                             try:
                                 fingerprint = prefix_fingerprint(
-                                    req['tokens'])
+                                    req['tokens'], adapter=adapter)
                             except (TypeError, ValueError):
                                 fingerprint = None
                         if (path == '/generate' and gw.resume

@@ -79,18 +79,21 @@ class InferenceSession:
                  max_queue=None, timeout_s=None, breaker=None,
                  watchdog=True, stall_artifact=None, name=None,
                  warmup=False, max_new_tokens=None,
-                 prefill_interleave=None, draft=None):
+                 prefill_interleave=None, draft=None, adapters=None):
         from .decode import DecodeProgram
         from ..resilience.policy import CircuitBreaker
         if isinstance(frozen, DecodeProgram):
             self._init_decode(frozen, max_queue, timeout_s, breaker,
                               watchdog, stall_artifact, name, warmup,
                               max_new_tokens, prefill_interleave,
-                              draft)
+                              draft, adapters)
             return
         if draft is not None:
             raise TypeError('draft= (speculative decoding) applies to '
                             'decode-mode sessions only')
+        if adapters is not None:
+            raise TypeError('adapters= (multi-adapter serving) '
+                            'applies to decode-mode sessions only')
         self._engine = None
         if not isinstance(frozen, FrozenProgram):
             raise TypeError('InferenceSession serves a FrozenProgram '
@@ -154,14 +157,18 @@ class InferenceSession:
 
     def _init_decode(self, program, max_queue, timeout_s, breaker,
                      watchdog, stall_artifact, name, warmup,
-                     max_new_tokens, prefill_interleave, draft=None):
+                     max_new_tokens, prefill_interleave, draft=None,
+                     adapters=None):
         """Generation mode: continuous-batching decode engine instead
         of the flush micro-batcher (same admission/resilience
         contract, new injection site ``serving.decode``).
 
         ``draft`` (or the ``MXNET_TPU_SERVE_SPEC_DRAFT`` artifact
         path) enables speculative decoding on paged targets with
-        ``spec_k > 0``: the draft proposes, the target verifies."""
+        ``spec_k > 0``: the draft proposes, the target verifies.
+        ``adapters`` (an AdapterRegistry or an artifact-directory
+        root, default ``MXNET_TPU_SERVE_ADAPTER_DIR``) backs
+        per-request LoRA selection on adapter-carrying programs."""
         from .decode.engine import DecodeEngine
         from ..resilience.policy import CircuitBreaker
         if draft is None and getattr(program, 'paged', False) \
@@ -204,7 +211,7 @@ class InferenceSession:
                 prefill_interleave if prefill_interleave is not None
                 else _knob('MXNET_TPU_SERVE_PREFILL_INTERLEAVE', 1)),
             breaker=self._breaker, watchdog=self._watchdog,
-            name=self.name, draft=draft)
+            name=self.name, draft=draft, adapters=adapters)
 
     # -- request API -------------------------------------------------------
 
@@ -237,7 +244,9 @@ class InferenceSession:
         return self._serve(list(arrays), n, seq)
 
     def generate(self, tokens, max_new_tokens=None, eos_id=None,
-                 request_id=None, prefill_only=False, trace=None):
+                 request_id=None, prefill_only=False, trace=None,
+                 adapter=None, temperature=None, top_p=None,
+                 seed=None):
         """Stream a generation: returns a
         :class:`~.decode.GenerateStream` (iterate per-token, or
         ``.result(timeout)`` for the full sequence). Decode-mode
@@ -245,18 +254,28 @@ class InferenceSession:
         (the gateway's mid-stream failover contract);
         ``prefill_only=True`` is the disaggregated-serving admission
         — the stream finishes ``'migrated'`` with its exported
-        seqstate payload on ``stream.seqstate``."""
+        seqstate payload on ``stream.seqstate``. ``adapter`` selects
+        the LoRA variant and ``temperature``/``top_p``/``seed`` the
+        sampling law (engine defaults: base weights, greedy)."""
         if self._engine is None:
             raise TypeError('generate() needs a DecodeProgram session '
                             '(use serving.freeze_decode)')
         kwargs = {'max_new_tokens': max_new_tokens, 'eos_id': eos_id,
                   'request_id': request_id}
         # ride as a kwarg only when asked for: duck-typed engines
-        # predating disaggregation keep working
+        # predating disaggregation / multi-adapter keep working
         if prefill_only:
             kwargs['prefill_only'] = True
         if trace is not None:
             kwargs['trace'] = trace
+        if adapter is not None:
+            kwargs['adapter'] = adapter
+        if temperature is not None:
+            kwargs['temperature'] = temperature
+        if top_p is not None:
+            kwargs['top_p'] = top_p
+        if seed is not None:
+            kwargs['seed'] = seed
         return self._engine.generate(tokens, **kwargs)
 
     # -- batched execution (batcher worker thread) -------------------------
@@ -651,6 +670,25 @@ class ServingHTTPServer:
                 # carries the seqstate payload inline
                 if req.get('prefill_only'):
                     kwargs['prefill_only'] = True
+                # multi-adapter + sampling: the body wins over the
+                # X-Mxnet-Adapter header (the header is the gateway's
+                # routing relay; both ride the same request)
+                adapter = req.get('adapter')
+                if adapter is None:
+                    adapter = handler.headers.get('X-Mxnet-Adapter')
+                if adapter is not None:
+                    kwargs['adapter'] = adapter
+                try:
+                    for key, cast in (('temperature', float),
+                                      ('top_p', float),
+                                      ('seed', int)):
+                        val = req.get(key)
+                        if val is not None:
+                            kwargs[key] = cast(val)
+                except (TypeError, ValueError):
+                    handler._json(400, {'error': "bad sampling "
+                                                 "parameters"})
+                    return
                 # the engine's eng.* spans nest under this handler's
                 # srv.generate span (the ctx rides the sequence — the
                 # worker thread owns the admission, not this thread)

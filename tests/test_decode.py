@@ -259,7 +259,9 @@ class _FakeProgram:
                          for s in range(self.slots)], 'int32')
         return cache, toks, None
 
-    def fallback_generate(self, tokens, max_new, eos_id=None):
+    def fallback_generate(self, tokens, max_new, eos_id=None,
+                          temperature=0.0, top_p=1.0, seed=0,
+                          ad=None):
         self.fallbacks += 1
         # `tokens` is prompt + already-generated; re-find the prompt
         # boundary by replaying the deterministic stream (shortest
@@ -762,10 +764,12 @@ def test_engine_degraded_fallback_runs_off_worker_thread():
     entered = _threading.Event()
 
     class _SlowFallback(_FakeProgram):
-        def fallback_generate(self, tokens, max_new, eos_id=None):
+        def fallback_generate(self, tokens, max_new, eos_id=None,
+                              **kw):
             entered.set()
             release.wait(10)       # a deliberately wedged fallback
-            return super().fallback_generate(tokens, max_new, eos_id)
+            return super().fallback_generate(tokens, max_new, eos_id,
+                                             **kw)
 
     prog = _SlowFallback(slots=2, fail_ops=(1,))   # 2nd op dies
     eng = DecodeEngine(prog, timeout_s=15.0)

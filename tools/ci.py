@@ -96,6 +96,14 @@ Stages, each timed:
                            tokens/s + TTFT/TPOT percentiles); the
                            fault tier gates the serving hang /
                            device-loss / decode-hang degraded paths
+  4a. adapters             python -m mxnet_tpu.serving.adapters —
+                           multi-adapter serving selftest: artifact
+                           digest gate, pool refcount/LRU/typed
+                           exhaustion, zero-retrace adapter rotation
+                           under sampled + speculative traffic,
+                           temperature-0 byte-identity, same-seed
+                           spec == plain sampled streams, per-adapter
+                           prefix-cache isolation
   4b. slo                  tools/slo_gate.py — the open-loop load &
                            chaos harness (python -m mxnet_tpu.loadgen)
                            in overload + chaos modes against a live
@@ -225,6 +233,15 @@ def main(argv=None):
                         '--out', '/tmp/SCALING_DIST.json']),
         ('serving', [py, '-m', 'mxnet_tpu.serving',
                      '--out', '/tmp/SERVE_SELFTEST.json']),
+        # multi-adapter serving selftest (docs/SERVING.md
+        # "Multi-adapter serving & sampling"): artifact digest gate,
+        # pool refcount/LRU/typed exhaustion, >= 8 adapters rotating
+        # through mixed sampled + speculative traffic with zero
+        # retraces, temperature-0 byte-identity with the legacy
+        # program, same-seed spec == plain sampled streams, and
+        # per-adapter prefix-cache isolation
+        ('adapters', [py, '-m', 'mxnet_tpu.serving.adapters',
+                      '--out', '/tmp/ADAPTERS_SELFTEST.json']),
         # closed-loop latency/throughput sweep over the bucket ladder
         # (writes the standard instrument status JSON; --quick keeps
         # the gate fast)
@@ -247,6 +264,12 @@ def main(argv=None):
         ('bench-paged', [py, 'bench_serving.py', '--paged',
                          '--quick', '--out',
                          '/tmp/BENCH_PAGED.json']),
+        # multi-adapter quick sweep: Zipf rotation over an 8-LoRA
+        # fleet with half the traffic sampled — zero retraces after
+        # warmup, whole fleet resident, adapter-vs-base tokens/s A/B
+        ('bench-adapters', [py, 'bench_serving.py', '--adapters',
+                            '--quick', '--out',
+                            '/tmp/BENCH_ADAPTERS.json']),
         # open-loop load & chaos SLO gate (docs/SERVING.md "SLOs and
         # overload behavior"): overload mode at 2.5x measured
         # capacity must keep admitted p99 inside the budget with the

@@ -2,8 +2,9 @@
 """SLO regression gate (tools/ci.py stage 'slo').
 
 Runs the open-loop load harness (python -m mxnet_tpu.loadgen) in
-overload, chaos, prefix, gateway-failover, drain, tenants and disagg
-modes against the in-process serving rig, then diffs the resulting
+overload, chaos, prefix, gateway-failover, drain, tenants, disagg
+and adapters modes against the in-process serving rig, then diffs the
+resulting
 ``mxnet_tpu.slo.v1`` artifacts against the committed
 SLO_BASELINE.json:
 
@@ -54,6 +55,7 @@ _BUDGET_KNOBS = {
     'tenant_steady_tpot_p99_ms': 'MXNET_TPU_SLO_TENANT_TPOT_P99_MS',
     'disagg_availability_floor': 'MXNET_TPU_SLO_DISAGG_AVAILABILITY',
     'disagg_mixed_ttft_p99_ms': 'MXNET_TPU_SLO_DISAGG_TTFT_P99_MS',
+    'adapter_ttft_p99_ms': 'MXNET_TPU_SLO_ADAPTER_TTFT_P99_MS',
 }
 
 
@@ -164,7 +166,7 @@ def main(argv=None):
         tmp = tempfile.mkdtemp(prefix='slo_gate_')
         for mode in ('overload', 'chaos', 'prefix',
                      'gateway-failover', 'drain', 'tenants',
-                     'disagg'):
+                     'disagg', 'adapters'):
             artifacts.append(run_mode(
                 mode, os.path.join(tmp, '%s.json' % mode), budgets,
                 full=args.full))
